@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Tests never touch the real TPU: JAX runs on a virtual 8-device CPU platform
+(so Mesh/pjit/collective paths are exercised exactly as they would be on an
+8-chip slice).  Must run before anything imports jax.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,54 @@
+"""Color policy tests (reference behavior: app.py:41-68)."""
+
+from tpudash.colors import (
+    COLOR_BANDS,
+    band_for_value,
+    band_steps,
+    color_for_value,
+    plate_color_for_value,
+)
+
+
+def test_five_bands_cover_unit_interval():
+    assert len(COLOR_BANDS) == 5
+    assert [b.upper for b in COLOR_BANDS] == [0.2, 0.4, 0.6, 0.8, 1.0]
+
+
+def test_band_edges_are_inclusive_upper():
+    # value/max == 0.2 → first band (reference's `<=` chain, app.py:58-68)
+    assert band_for_value(20, 100) is COLOR_BANDS[0]
+    assert band_for_value(20.0001, 100) is COLOR_BANDS[1]
+    assert band_for_value(40, 100) is COLOR_BANDS[1]
+    assert band_for_value(60, 100) is COLOR_BANDS[2]
+    assert band_for_value(80, 100) is COLOR_BANDS[3]
+    assert band_for_value(100, 100) is COLOR_BANDS[4]
+
+
+def test_scaling_with_max_val():
+    # bands scale with the axis max (power gauges use model TDP maxima)
+    assert color_for_value(100, 560) == COLOR_BANDS[0].bar
+    assert color_for_value(500, 560) == COLOR_BANDS[4].bar
+
+
+def test_degenerate_inputs_clamp():
+    assert band_for_value(-5, 100) is COLOR_BANDS[0]
+    assert band_for_value(50, 0) is COLOR_BANDS[0]
+    assert band_for_value(150, 100) is COLOR_BANDS[-1]
+
+
+def test_bar_and_plate_pair_up():
+    for frac in (0.1, 0.3, 0.5, 0.7, 0.9):
+        band = band_for_value(frac * 100, 100)
+        assert color_for_value(frac * 100, 100) == band.bar
+        assert plate_color_for_value(frac * 100, 100) == band.plate
+
+
+def test_band_steps_tile_axis():
+    steps = band_steps(300.0)
+    assert len(steps) == 5
+    assert steps[0]["range"] == [0.0, 60.0]
+    assert steps[-1]["range"][1] == 300.0
+    # contiguous, no gaps
+    for a, b in zip(steps, steps[1:]):
+        assert a["range"][1] == b["range"][0]
+    assert [s["color"] for s in steps] == [b.plate for b in COLOR_BANDS]

@@ -1,0 +1,41 @@
+"""Config tests (reference behavior: app.py:22-24 env vars + defaults)."""
+
+from tpudash.config import Config, load_config
+
+
+def test_reference_parity_defaults():
+    cfg = load_config(env={})
+    assert cfg.prometheus_endpoint == "http://localhost:9090/api/v1/query"
+    assert cfg.prometheus_podname == "prometheus"
+    assert cfg.refresh_interval == 5.0
+
+
+def test_reference_env_var_names_still_work():
+    cfg = load_config(env={
+        "PROMETHEUS_METRICS_ENDPOINT": "http://prom:9090/api/v1/query",
+        "PROMETHEUS_METRICS_PODNAME": "my-prom",
+    })
+    assert cfg.prometheus_endpoint == "http://prom:9090/api/v1/query"
+    assert cfg.prometheus_podname == "my-prom"
+
+
+def test_promoted_knobs():
+    cfg = load_config(env={
+        "TPUDASH_REFRESH_INTERVAL": "2.5",
+        "TPUDASH_GRID_COLUMNS": "8",
+        "TPUDASH_SOURCE": "fixture",
+        "TPUDASH_SYNTHETIC_CHIPS": "256",
+        "TPUDASH_PORT": "9999",
+    })
+    assert cfg.refresh_interval == 2.5
+    assert cfg.selection_grid_columns == 8
+    assert cfg.source == "fixture"
+    assert cfg.synthetic_chips == 256
+    assert cfg.port == 9999
+
+
+def test_defaults_match_reference_hardcoded_knobs():
+    cfg = Config()
+    assert cfg.selection_grid_columns == 4   # app.py:268
+    assert cfg.avg_panel_height == 300       # app.py:323
+    assert cfg.device_panel_height == 200    # app.py:324

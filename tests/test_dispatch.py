@@ -1,0 +1,61 @@
+"""Viz dispatcher tests (reference behavior: app.py:234-245)."""
+
+import os
+
+from tpudash import schema
+from tpudash.normalize import to_wide
+from tpudash.registry import DEFAULT_POWER_W, TPU_GENERATIONS
+from tpudash.sources.fixture import FixtureSource
+from tpudash.viz.dispatch import accel_types_for, create_visualization, panel_max
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+POWER_SPEC = next(p for p in schema.PANELS if p.max_policy == "power")
+UTIL_SPEC = next(p for p in schema.PANELS if p.column == schema.TENSORCORE_UTIL)
+ICI_SPEC = next(p for p in schema.EXTRA_PANELS if p.max_policy == "ici")
+
+
+def test_power_max_resolves_model_tdp():
+    # TPU analogue of the TDP override (app.py:236-240)
+    assert panel_max(POWER_SPEC, ["tpu-v5-lite-podslice"]) == TPU_GENERATIONS["v5e"].nominal_power_w
+    assert panel_max(POWER_SPEC, ["v5p"]) == TPU_GENERATIONS["v5p"].nominal_power_w
+
+
+def test_power_max_unknown_model_defaults():
+    assert panel_max(POWER_SPEC, ["mystery-board"]) == DEFAULT_POWER_W
+    assert panel_max(POWER_SPEC, None) == DEFAULT_POWER_W
+
+
+def test_power_max_mixed_fleet_takes_max():
+    # NOT the reference's first-selected-device quirk (app.py:359, 404)
+    got = panel_max(POWER_SPEC, ["v5e", "v5p"])
+    assert got == TPU_GENERATIONS["v5p"].nominal_power_w
+
+
+def test_fixed_max_ignores_models():
+    assert panel_max(UTIL_SPEC, ["v5p"]) == 100.0
+
+
+def test_ici_max_from_link_count():
+    gen = TPU_GENERATIONS["v5e"]
+    assert panel_max(ICI_SPEC, ["v5e"]) == 2 * gen.ici_links_per_chip * gen.ici_link_gbps
+
+
+def test_dispatch_gauge_vs_bar():
+    fig = create_visualization(50.0, UTIL_SPEC, use_gauge=True, height=300)
+    assert fig["data"][0]["type"] == "indicator"
+    assert fig["layout"]["height"] == 300
+    fig = create_visualization(50.0, UTIL_SPEC, use_gauge=False, height=200)
+    assert fig["data"][0]["type"] == "bar"
+
+
+def test_dispatch_title_override():
+    fig = create_visualization(50.0, UTIL_SPEC, title="Avg TensorCore Utilization (%)")
+    assert fig["data"][0]["title"]["text"] == "Avg TensorCore Utilization (%)"
+
+
+def test_accel_types_for():
+    df = to_wide(FixtureSource(FIXTURE).fetch())
+    assert accel_types_for(df) == ["tpu-v5-lite-podslice"]
+    assert accel_types_for(df, ["slice-0/0"]) == ["tpu-v5-lite-podslice"]
+    assert accel_types_for(df, ["nope"]) == []

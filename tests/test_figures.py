@@ -1,0 +1,102 @@
+"""Figure-builder tests (reference behavior: app.py:70-151).
+
+Figures are pure dicts, so tests assert structure directly — the seam the
+reference never exposed (SURVEY.md §4).
+"""
+
+from tpudash.colors import COLOR_BANDS
+from tpudash.topology import topology_for
+from tpudash.viz.figures import (
+    create_gauge,
+    create_horizontal_bar,
+    create_topology_heatmap,
+)
+
+
+def test_gauge_structure():
+    fig = create_gauge(62.5, "TensorCore Utilization (%)", height=300)
+    (trace,) = fig["data"]
+    assert trace["type"] == "indicator"
+    assert trace["mode"] == "gauge+number"
+    assert trace["value"] == 62.5
+    assert trace["gauge"]["axis"]["range"] == [0.0, 100.0]
+    assert trace["gauge"]["axis"]["dtick"] == 20.0  # max/5 (app.py dtick rule)
+    assert len(trace["gauge"]["steps"]) == 5
+    assert fig["layout"]["height"] == 300
+    assert fig["layout"]["margin"] == {"l": 30, "r": 30, "t": 0, "b": 0}
+
+
+def test_gauge_bar_color_follows_policy():
+    fig = create_gauge(90, "x", max_val=100)
+    assert fig["data"][0]["gauge"]["bar"]["color"] == COLOR_BANDS[4].bar
+    assert fig["data"][0]["gauge"]["bar"]["line"] == {"color": "black", "width": 1}
+    fig = create_gauge(10, "x", max_val=100)
+    assert fig["data"][0]["gauge"]["bar"]["color"] == COLOR_BANDS[0].bar
+
+
+def test_gauge_scales_axis_to_max():
+    fig = create_gauge(400, "Power Usage (W)", max_val=560, height=200)
+    assert fig["data"][0]["gauge"]["axis"]["range"] == [0.0, 560]
+    assert fig["data"][0]["gauge"]["axis"]["dtick"] == 112.0
+
+
+def test_bar_structure():
+    fig = create_horizontal_bar(41.0, "HBM Usage (%)", height=200)
+    (trace,) = fig["data"]
+    assert trace["type"] == "bar"
+    assert trace["orientation"] == "h"
+    assert trace["x"] == [41.0]
+    assert trace["width"] == 0.5
+    assert trace["marker"]["line"] == {"color": "gray", "width": 2}
+    assert fig["layout"]["xaxis"]["range"] == [0.0, 100.0]
+    assert fig["layout"]["yaxis"]["showticklabels"] is False
+
+
+def test_bar_band_rects():
+    fig = create_horizontal_bar(50, "x", max_val=100)
+    shapes = fig["layout"]["shapes"]
+    assert len(shapes) == 5
+    for shape, band in zip(shapes, COLOR_BANDS):
+        assert shape["opacity"] == 0.3
+        assert shape["layer"] == "below"
+        assert shape["fillcolor"] == band.plate
+    assert shapes[0]["x0"] == 0.0 and shapes[-1]["x1"] == 100
+
+
+def test_heatmap_2d_256():
+    topo = topology_for("v5e", 256)
+    values = {cid: float(cid % 100) for cid in range(256)}
+    fig = create_topology_heatmap(topo, values, "Utilization", max_val=100, unit="%")
+    (trace,) = fig["data"]
+    assert trace["type"] == "heatmap"
+    z = trace["z"]
+    assert len(z) == 16 and len(z[0]) == 16
+    assert trace["zmax"] == 100
+    assert "chip 0" in trace["text"][0][0]
+
+
+def test_heatmap_3d_planes():
+    topo = topology_for("v4", 8)  # 2x2x2 → 2 planes + gap col
+    fig = create_topology_heatmap(topo, {cid: 1.0 for cid in range(8)}, "t")
+    z = fig["data"][0]["z"]
+    assert len(z[0]) == 5
+    assert z[0][2] is None
+
+
+def test_heatmap_missing_chips_are_gaps():
+    topo = topology_for("v5e", 16)
+    fig = create_topology_heatmap(topo, {0: 5.0}, "t")
+    z = fig["data"][0]["z"]
+    assert z[0][0] == 5.0 and z[0][1] is None
+
+
+def test_figures_are_json_serializable():
+    import json
+
+    topo = topology_for("v5e", 16)
+    for fig in (
+        create_gauge(50, "a"),
+        create_horizontal_bar(50, "b"),
+        create_topology_heatmap(topo, {0: 1.0}, "c"),
+    ):
+        json.dumps(fig)

@@ -1,0 +1,106 @@
+"""Normalization tests (reference behavior: app.py:182-227, 341-345)."""
+
+import os
+
+import pytest
+
+from tpudash import schema
+from tpudash.normalize import (
+    NormalizeError,
+    averages,
+    column_average,
+    compute_stats,
+    filter_selected,
+    numeric_columns,
+    to_wide,
+)
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _df():
+    return to_wide(FixtureSource(FIXTURE).fetch())
+
+
+def test_pivot_shape_and_index():
+    df = _df()
+    assert list(df.index) == ["slice-0/0", "slice-0/1"]
+    assert df.loc["slice-0/0", schema.TENSORCORE_UTIL] == 62.5
+    assert df.loc["slice-0/1", schema.TEMPERATURE] == 47.0
+    assert df.loc["slice-0/0", schema.ACCEL_TYPE] == "tpu-v5-lite-podslice"
+    assert df.loc["slice-0/0", "chip_id"] == 0
+
+
+def test_derived_hbm_ratio():
+    # used/total × 100 (reference vram_usage_ratio, app.py:210-212)
+    df = _df()
+    assert df.loc["slice-0/0", schema.HBM_USAGE_RATIO] == pytest.approx(50.0)
+    assert df.loc["slice-0/1", schema.HBM_USAGE_RATIO] == pytest.approx(25.0)
+    assert df.loc["slice-0/0", schema.HBM_USED_GIB] == pytest.approx(8.0)
+
+
+def test_derived_ici_gbps():
+    df = _df()
+    assert df.loc["slice-0/0", schema.ICI_TOTAL_GBPS] == pytest.approx(40.0)
+
+
+def test_empty_samples_raise():
+    with pytest.raises(NormalizeError):
+        to_wide([])
+
+
+def test_numeric_columns_exclude_identity():
+    cols = numeric_columns(_df())
+    assert schema.ACCEL_TYPE not in cols
+    assert "slice_id" not in cols and "host" not in cols and "chip_id" not in cols
+    assert schema.TENSORCORE_UTIL in cols
+
+
+def test_stats_mean_max_min():
+    stats = compute_stats(_df())
+    u = stats[schema.TENSORCORE_UTIL]
+    assert u["mean"] == pytest.approx(51.75)
+    assert u["max"] == 62.5
+    assert u["min"] == 41.0
+    assert schema.ACCEL_TYPE not in stats
+
+
+def test_zero_exclusion_power_average():
+    # chip 1 reports 0 W → excluded from the power mean (app.py:341-345)
+    df = _df()
+    assert column_average(df, schema.POWER) == pytest.approx(112.0)
+    # but NOT excluded for other metrics
+    assert column_average(df, schema.TENSORCORE_UTIL) == pytest.approx(51.75)
+
+
+def test_zero_exclusion_all_idle_returns_none():
+    df = _df()
+    df[schema.POWER] = 0.0
+    assert column_average(df, schema.POWER) is None
+
+
+def test_averages_dict():
+    avg = averages(_df())
+    assert avg[schema.POWER] == pytest.approx(112.0)
+    assert avg[schema.HBM_USAGE_RATIO] == pytest.approx(37.5)
+
+
+def test_filter_selected_prunes_stale_keys():
+    df = _df()
+    out = filter_selected(df, ["slice-0/1", "slice-0/99"])
+    assert list(out.index) == ["slice-0/1"]
+
+
+def test_normalize_256_chips():
+    df = to_wide(SyntheticSource(num_chips=256).fetch())
+    assert len(df) == 256
+    assert schema.HBM_USAGE_RATIO in df.columns
+    stats = compute_stats(df)
+    assert stats[schema.TENSORCORE_UTIL]["max"] <= 100.0
+
+
+def test_sorted_numerically_not_lexically():
+    # chip 10 must sort after chip 2 (index is built from (slice, chip_id))
+    df = to_wide(SyntheticSource(num_chips=12).fetch())
+    assert list(df["chip_id"]) == list(range(12))

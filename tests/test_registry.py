@@ -1,0 +1,56 @@
+"""Device-model registry tests (reference behavior: app.py:26-38, 229-245)."""
+
+from tpudash.registry import (
+    DEFAULT_HBM_GIB,
+    DEFAULT_POWER_W,
+    TPU_GENERATIONS,
+    hbm_limit_for,
+    power_limit_for,
+    resolve_generation,
+)
+
+
+def test_all_generations_present():
+    assert set(TPU_GENERATIONS) == {"v4", "v5e", "v5p", "v6e"}
+
+
+def test_resolve_by_short_name():
+    assert resolve_generation("v5e").name == "v5e"
+    assert resolve_generation("v4").hbm_gib == 32.0
+
+
+def test_resolve_by_gke_accelerator_label():
+    # the TPU analogue of board-ID→model (app.py:26-30)
+    assert resolve_generation("tpu-v5-lite-podslice").name == "v5e"
+    assert resolve_generation("tpu-v4-podslice").name == "v4"
+    assert resolve_generation("tpu-v5p-slice").name == "v5p"
+    assert resolve_generation("tpu-v6e-slice").name == "v6e"
+
+
+def test_resolve_by_topology_string():
+    assert resolve_generation("v5e-256").name == "v5e"
+    assert resolve_generation("v5litepod-16").name == "v5e"
+
+
+def test_unknown_returns_none_not_crash():
+    assert resolve_generation("h100") is None
+    assert resolve_generation("") is None
+    assert resolve_generation(None) is None
+
+
+def test_power_limit_defaults_like_reference():
+    # unknown model → default ceiling (app.py:38 `.get(..., 300)`)
+    assert power_limit_for("no-such-board") == DEFAULT_POWER_W
+    assert power_limit_for("v5p") == TPU_GENERATIONS["v5p"].nominal_power_w
+
+
+def test_hbm_limit():
+    assert hbm_limit_for("v5p") == 95.0
+    assert hbm_limit_for(None) == DEFAULT_HBM_GIB
+
+
+def test_torus_ranks():
+    assert TPU_GENERATIONS["v5e"].torus_rank == 2
+    assert TPU_GENERATIONS["v4"].torus_rank == 3
+    assert TPU_GENERATIONS["v5p"].torus_rank == 3
+    assert TPU_GENERATIONS["v6e"].torus_rank == 2

@@ -1,0 +1,138 @@
+"""HTTP server tests — the L4 surface (reference shell: app.py:247-489)."""
+
+import asyncio
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.app.server import DashboardServer, make_app
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _client_app(cfg=None, source=None):
+    cfg = cfg or Config(source="fixture", fixture_path=FIXTURE, refresh_interval=0.0)
+    service = DashboardService(cfg, source or FixtureSource(cfg.fixture_path))
+    return DashboardServer(service).build_app()
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_index_serves_page():
+    async def go(client):
+        resp = await client.get("/")
+        assert resp.status == 200
+        text = await resp.text()
+        assert "TPU Metrics Dashboard" in text
+        assert "/api/frame" in text
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_frame_endpoint():
+    async def go(client):
+        resp = await client.get("/api/frame")
+        assert resp.status == 200
+        frame = await resp.json()
+        assert frame["error"] is None
+        assert frame["selected"] == ["slice-0/0"]
+        assert frame["average"] is not None
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_select_toggle_roundtrip():
+    async def go(client):
+        await client.get("/api/frame")
+        resp = await client.post("/api/select", json={"toggle": "slice-0/1"})
+        assert (await resp.json())["selected"] == ["slice-0/0", "slice-0/1"]
+        resp = await client.post("/api/select", json={"none": True})
+        assert (await resp.json())["selected"] == []
+        resp = await client.post("/api/select", json={"all": True})
+        assert (await resp.json())["selected"] == ["slice-0/0", "slice-0/1"]
+        resp = await client.post("/api/select", json={"selected": ["slice-0/1", "junk"]})
+        assert (await resp.json())["selected"] == ["slice-0/1"]
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_select_bad_body():
+    async def go(client):
+        resp = await client.post("/api/select", data=b"not json",
+                                 headers={"Content-Type": "application/json"})
+        assert resp.status == 400
+        resp = await client.post("/api/select", json={})
+        assert resp.status == 400
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_style_toggle():
+    async def go(client):
+        resp = await client.post("/api/style", json={"use_gauge": False})
+        assert (await resp.json())["use_gauge"] is False
+        frame = await (await client.get("/api/frame")).json()
+        assert frame["use_gauge"] is False
+        fig = frame["average"]["figures"][0]["figure"]
+        assert fig["data"][0]["type"] == "bar"
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_healthz_and_timings():
+    async def go(client):
+        health = await (await client.get("/healthz")).json()
+        assert health["ok"] is True and health["source"] == "fixture"
+        await client.get("/api/frame")
+        t = await (await client.get("/api/timings")).json()
+        assert t["frames"] >= 1
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_frame_cache_one_scrape_per_interval():
+    calls = {"n": 0}
+
+    class Counting(FixtureSource):
+        def fetch(self):
+            calls["n"] += 1
+            return super().fetch()
+
+    cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=60.0)
+    app = _client_app(cfg, Counting(FIXTURE))
+
+    async def go(client):
+        for _ in range(5):
+            await client.get("/api/frame")
+        assert calls["n"] == 1  # many requests, one scrape per interval
+
+    _run(_with_client(app, go))
+
+
+def test_select_before_first_frame_primes_chip_list():
+    # select-all as the FIRST request must see the full chip list, not []
+    async def go(client):
+        resp = await client.post("/api/select", json={"all": True})
+        assert (await resp.json())["selected"] == ["slice-0/0", "slice-0/1"]
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_make_app_from_config():
+    cfg = Config(source="synthetic", synthetic_chips=4)
+    app = make_app(cfg)
+    assert app is not None

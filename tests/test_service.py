@@ -1,0 +1,170 @@
+"""DashboardService frame tests (reference render loop: app.py:320-486)."""
+
+import json
+import os
+
+from tpudash import schema
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _svc(source=None, **cfg_kwargs):
+    cfg = Config(**cfg_kwargs)
+    return DashboardService(cfg, source or FixtureSource(FIXTURE))
+
+
+def test_frame_shape_and_default_selection():
+    frame = _svc().render_frame()
+    assert frame["error"] is None
+    assert [c["key"] for c in frame["chips"]] == ["slice-0/0", "slice-0/1"]
+    assert frame["selected"] == ["slice-0/0"]  # first chip default
+    assert frame["chips"][0]["selected"] is True
+    assert frame["chips"][1]["selected"] is False
+    assert frame["last_updated"]
+    json.dumps(frame)  # JSON-able end to end
+
+
+def test_average_row_four_reference_panels_plus_ici():
+    frame = _svc().render_frame()
+    cols = [f["panel"] for f in frame["average"]["figures"]]
+    # the reference's four panels (SURVEY §2 panel table)…
+    assert schema.TENSORCORE_UTIL in cols
+    assert schema.HBM_USAGE_RATIO in cols
+    assert schema.TEMPERATURE in cols
+    assert schema.POWER in cols
+    # …plus the TPU-native ICI panel (fixture provides ici series)
+    assert schema.ICI_TOTAL_GBPS in cols
+    titles = [f["figure"]["data"][0]["title"]["text"] for f in frame["average"]["figures"]]
+    assert any(t.startswith("Avg ") for t in titles)
+
+
+def test_device_rows_and_headers():
+    svc = _svc()
+    svc.state.set_selected(["slice-0/0", "slice-0/1"], ["slice-0/0", "slice-0/1"])
+    frame = svc.render_frame()
+    rows = frame["device_rows"]
+    assert [r["title"] for r in rows] == ["TPU 0 (v5e)", "TPU 1 (v5e)"]
+    assert frame["heatmaps"] == []
+    # per-device panel height (app.py:324)
+    h = rows[0]["figures"][0]["figure"]["layout"]["height"]
+    assert h == Config().device_panel_height
+
+
+def test_power_gauge_uses_model_ceiling():
+    frame = _svc().render_frame()
+    power_fig = next(
+        f["figure"] for f in frame["average"]["figures"] if f["panel"] == schema.POWER
+    )
+    # v5e nominal power, not the 300 W default (app.py:236-240 analogue)
+    assert power_fig["data"][0]["gauge"]["axis"]["range"][1] == 150.0
+
+
+def test_zero_exclusion_in_average_power():
+    svc = _svc()
+    svc.state.set_selected(["slice-0/0", "slice-0/1"], ["slice-0/0", "slice-0/1"])
+    frame = svc.render_frame()
+    power_fig = next(
+        f["figure"] for f in frame["average"]["figures"] if f["panel"] == schema.POWER
+    )
+    # chip 1 reports 0 W → excluded (app.py:341-345): avg = 112, not 56
+    assert power_fig["data"][0]["value"] == 112.0
+
+
+def test_heatmap_mode_above_panel_limit():
+    svc = _svc(SyntheticSource(num_chips=64), per_chip_panel_limit=16)
+    svc.state.select_all([f"slice-0/{i}" for i in range(64)])
+    frame = svc.render_frame()
+    assert frame["device_rows"] == []
+    assert len(frame["heatmaps"]) >= 4
+    hm = frame["heatmaps"][0]["figure"]
+    z = hm["data"][0]["z"]
+    assert len(z) == 8 and len(z[0]) == 8  # v5e-64 topology
+
+
+def test_heatmap_partial_selection_keeps_full_slice_topology():
+    # 17 of 64 chips selected → still an 8×8 torus, not a 1×17 strip
+    svc = _svc(SyntheticSource(num_chips=64), per_chip_panel_limit=16)
+    avail = [f"slice-0/{i}" for i in range(64)]
+    svc.render_frame()
+    svc.state.set_selected(avail[:17], avail)
+    frame = svc.render_frame()
+    z = frame["heatmaps"][0]["figure"]["data"][0]["z"]
+    assert len(z) == 8 and len(z[0]) == 8
+    # unselected chips are gaps
+    assert z[7][7] is None
+
+
+def test_stats_rounded_two_dp():
+    frame = _svc().render_frame()
+    for s in frame["stats"].values():
+        for v in s.values():
+            assert round(v, 2) == v  # app.py:480-481
+
+
+def test_bar_style_toggle():
+    svc = _svc()
+    svc.state.use_gauge = False
+    frame = svc.render_frame()
+    fig = frame["average"]["figures"][0]["figure"]
+    assert fig["data"][0]["type"] == "bar"
+
+
+class _BoomSource(MetricsSource):
+    name = "boom"
+
+    def __init__(self):
+        self.calls = 0
+
+    def fetch(self):
+        self.calls += 1
+        raise SourceError("connection refused")
+
+
+def test_error_banner_and_keep_polling():
+    src = _BoomSource()
+    svc = _svc(src)
+    frame = svc.render_frame()
+    assert "Error fetching TPU metrics" in frame["error"]  # app.py:225-227
+    assert frame["chips"] == []
+    # next cycle tries again (reference keeps looping, app.py:333)
+    frame2 = svc.render_frame()
+    assert src.calls == 2
+    assert frame2["error"]
+
+
+def test_recovery_after_error_preserves_selection():
+    good = FixtureSource(FIXTURE)
+
+    class Flaky(MetricsSource):
+        name = "flaky"
+
+        def __init__(self):
+            self.fail = False
+
+        def fetch(self):
+            if self.fail:
+                raise SourceError("blip")
+            return good.fetch()
+
+    src = Flaky()
+    svc = _svc(src)
+    svc.render_frame()
+    svc.state.set_selected(["slice-0/1"], svc.available)
+    src.fail = True
+    svc.render_frame()  # error cycle
+    src.fail = False
+    frame = svc.render_frame()
+    assert frame["selected"] == ["slice-0/1"]  # state survives error cycles
+
+
+def test_timings_present():
+    svc = _svc()
+    svc.render_frame()
+    t = svc.timer.summary()
+    assert t["frames"] == 1
+    for key in ("scrape", "normalize", "render", "total"):
+        assert key in t

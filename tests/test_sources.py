@@ -1,0 +1,221 @@
+"""Source-layer tests: parser contract, fixture, synthetic, prometheus.
+
+The parser contract mirrors the reference's consumption of
+``data.result[].metric{...}`` + ``value:[ts,"str"]`` (app.py:164, 183-192).
+"""
+
+import json
+import os
+
+import pytest
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.sources import make_source
+from tpudash.sources.base import SourceError, parse_instant_query
+from tpudash.sources.fixture import FixtureSource, SyntheticSource, synthetic_payload
+from tpudash.sources.prometheus import PrometheusSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+# --- parser -----------------------------------------------------------------
+
+def test_parse_fixture_payload():
+    with open(FIXTURE) as f:
+        payload = json.load(f)
+    samples = parse_instant_query(payload)
+    assert len(samples) == 12
+    s0 = next(
+        s for s in samples
+        if s.metric == schema.TENSORCORE_UTIL and s.chip.chip_id == 0
+    )
+    assert s0.value == 62.5
+    assert s0.chip.slice_id == "slice-0"
+    assert s0.chip.host == "host-0"
+    assert s0.accelerator_type == "tpu-v5-lite-podslice"
+    assert s0.chip.key == "slice-0/0"
+
+
+def test_parse_accepts_legacy_gpu_labels():
+    # gpu_id/card_model labels (the reference's exporter shape) still parse
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "tpu_power_watts", "gpu_id": "3",
+                        "card_model": "tpu-v4-podslice", "instance": "10.0.0.1:9400"},
+             "value": [0, "55.5"]},
+        ]},
+    }
+    (s,) = parse_instant_query(payload)
+    assert s.chip.chip_id == 3
+    assert s.accelerator_type == "tpu-v4-podslice"
+    assert s.chip.host == "10.0.0.1:9400"  # instance fallback
+
+
+def test_parse_skips_malformed_series_not_whole_scrape():
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "0"}, "value": [0, "5"]},
+            {"metric": {"__name__": "tpu_power_watts"}, "value": [0, "5"]},        # no chip id
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "x"}, "value": [0, "5"]},  # bad id
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "1"}, "value": [0, "NaN?"]},  # bad val
+            {"metric": {"chip_id": "2"}, "value": [0, "5"]},                       # no name
+        ]},
+    }
+    samples = parse_instant_query(payload)
+    assert [s.chip.chip_id for s in samples] == [0]
+
+
+def test_parse_rejects_error_status():
+    with pytest.raises(SourceError):
+        parse_instant_query({"status": "error", "error": "boom"})
+
+
+def test_parse_rejects_malformed_payload():
+    with pytest.raises(SourceError):
+        parse_instant_query({"status": "success", "data": None})
+
+
+# --- fixture source ---------------------------------------------------------
+
+def test_fixture_source_roundtrip():
+    src = FixtureSource(FIXTURE)
+    samples = src.fetch()
+    assert len(samples) == 12
+
+
+def test_fixture_source_missing_file():
+    with pytest.raises(SourceError):
+        FixtureSource("/nonexistent.json").fetch()
+
+
+def test_fixture_source_requires_path():
+    with pytest.raises(SourceError):
+        FixtureSource("")
+
+
+# --- synthetic source -------------------------------------------------------
+
+def test_synthetic_256_chip_slice():
+    src = SyntheticSource(num_chips=256, generation="v5e")
+    samples = src.fetch()
+    chips = {s.chip.chip_id for s in samples}
+    assert chips == set(range(256))
+    metrics = {s.metric for s in samples}
+    assert schema.TENSORCORE_UTIL in metrics
+    assert schema.HBM_TOTAL in metrics
+    assert schema.POWER in metrics
+    util = [s for s in samples if s.metric == schema.TENSORCORE_UTIL]
+    assert all(0 <= s.value <= 100 for s in util)
+
+
+def test_synthetic_is_deterministic_given_t():
+    p1 = synthetic_payload(num_chips=4, t=1000.0)
+    p2 = synthetic_payload(num_chips=4, t=1000.0)
+    assert p1 == p2
+
+
+def test_synthetic_idle_chips_report_zero_power():
+    payload = synthetic_payload(num_chips=4, t=1000.0, idle_chips=(2,))
+    samples = parse_instant_query(payload)
+    p2 = next(s for s in samples if s.metric == schema.POWER and s.chip.chip_id == 2)
+    assert p2.value == 0.0
+
+
+def test_synthetic_multislice_emits_dcn():
+    payload = synthetic_payload(num_chips=4, t=1000.0, num_slices=2)
+    samples = parse_instant_query(payload)
+    assert {s.chip.slice_id for s in samples} == {"slice-0", "slice-1"}
+    assert any(s.metric == schema.DCN_TX for s in samples)
+
+
+# --- prometheus source ------------------------------------------------------
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self._payload = payload
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self._payload
+
+
+class _FakeSession:
+    """Stands in for requests.Session; records queries."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def get(self, url, params=None, timeout=None):
+        self.calls.append((url, params))
+        return _FakeResponse(self.responses.pop(0))
+
+    def close(self):
+        pass
+
+
+def test_prometheus_slice_scoped_single_query():
+    with open(FIXTURE) as f:
+        payload = json.load(f)
+    cfg = Config()  # default discovery="selector" → no discovery query
+    sess = _FakeSession([payload])
+    src = PrometheusSource(cfg, session=sess)
+    samples = src.fetch()
+    assert len(samples) == 12
+    assert len(sess.calls) == 1
+    query = sess.calls[0][1]["query"]
+    assert '__name__=~"' in query
+    assert schema.TENSORCORE_UTIL in query
+
+
+def test_prometheus_series_selector_matchers_injected():
+    with open(FIXTURE) as f:
+        payload = json.load(f)
+    cfg = Config(series_selector='cluster="tpu-a", slice=~"slice-[01]"')
+    sess = _FakeSession([payload])
+    PrometheusSource(cfg, session=sess).fetch()
+    query = sess.calls[0][1]["query"]
+    assert 'cluster="tpu-a", slice=~"slice-[01]"' in query
+    assert query.startswith("{") and query.endswith("}")
+
+
+def test_prometheus_podname_fallback_two_queries():
+    # reference parity mode: discovery via kube_pod_info (app.py:157-164)
+    discovery = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"host_ip": "10.1.2.3"}, "value": [0, "1"]},
+        ]},
+    }
+    with open(FIXTURE) as f:
+        payload = json.load(f)
+    cfg = Config(discovery="podname")
+    sess = _FakeSession([discovery, payload])
+    src = PrometheusSource(cfg, session=sess)
+    samples = src.fetch()
+    assert len(samples) == 12
+    assert len(sess.calls) == 2
+    assert "kube_pod_info" in sess.calls[0][1]["query"]
+    assert 'instance=~"10.1.2.3:.+"' in sess.calls[1][1]["query"]
+
+
+def test_prometheus_empty_result_raises():
+    cfg = Config()
+    sess = _FakeSession([{"status": "success", "data": {"result": []}}])
+    with pytest.raises(SourceError):
+        PrometheusSource(cfg, session=sess).fetch()
+
+
+# --- factory ----------------------------------------------------------------
+
+def test_make_source_kinds():
+    assert make_source(Config(source="synthetic", synthetic_chips=4)).name == "synthetic"
+    assert make_source(Config(source="fixture", fixture_path=FIXTURE)).name == "fixture"
+    assert make_source(Config(source="prometheus")).name == "prometheus"
+    with pytest.raises(ValueError):
+        make_source(Config(source="nope"))

@@ -1,0 +1,65 @@
+"""Selection-state tests (reference semantics: app.py:252-313, SURVEY §3.4)."""
+
+from tpudash.app.state import SelectionState
+
+AVAIL = [f"slice-0/{i}" for i in range(4)]
+
+
+def test_default_selects_first_chip():
+    s = SelectionState()
+    assert s.sync(AVAIL) == ["slice-0/0"]  # app.py:284-285
+
+
+def test_default_applies_only_once():
+    # clearing the selection must not snap back to the first chip next sync
+    s = SelectionState()
+    s.sync(AVAIL)
+    s.clear()
+    assert s.sync(AVAIL) == []
+
+
+def test_prunes_stale_selections():
+    s = SelectionState()
+    s.set_selected(["slice-0/1", "slice-0/3"], AVAIL)
+    assert s.sync(["slice-0/1"]) == ["slice-0/1"]  # app.py:281
+
+
+def test_selection_sorted_numerically():
+    avail = [f"slice-0/{i}" for i in range(12)]
+    s = SelectionState()
+    s.set_selected(["slice-0/10", "slice-0/2", "slice-0/1"], avail)
+    assert s.selected == ["slice-0/1", "slice-0/2", "slice-0/10"]
+
+
+def test_set_selected_rejects_unknown_keys():
+    s = SelectionState()
+    s.set_selected(["slice-0/1", "bogus"], AVAIL)
+    assert s.selected == ["slice-0/1"]
+
+
+def test_toggle_and_last_selection():
+    s = SelectionState()
+    s.sync(AVAIL)
+    s.toggle("slice-0/2", AVAIL)
+    assert s.selected == ["slice-0/0", "slice-0/2"]
+    assert s.last_selection == ["slice-0/0"]  # app.py:274-275, 310
+    s.toggle("slice-0/0", AVAIL)
+    assert s.selected == ["slice-0/2"]
+
+
+def test_toggle_unknown_key_noop_add():
+    s = SelectionState()
+    s.sync(AVAIL)
+    s.toggle("slice-9/0", AVAIL)
+    assert s.selected == ["slice-0/0"]
+
+
+def test_select_all_and_clear():
+    s = SelectionState()
+    assert s.select_all(AVAIL) == AVAIL
+    assert s.clear() == []
+    assert s.last_selection == AVAIL
+
+
+def test_use_gauge_default_true():
+    assert SelectionState().use_gauge is True  # app.py:254-255

@@ -1,0 +1,94 @@
+"""Topology model tests (new TPU-specific layer, SURVEY.md §7.4)."""
+
+import pytest
+
+from tpudash.topology import Topology, heatmap_grid, topology_for
+
+
+def test_v5e_256_is_16x16():
+    topo = topology_for("v5e", 256)
+    assert topo.dims == (16, 16)
+    assert topo.num_chips == 256
+
+
+def test_v5e_published_shapes():
+    assert topology_for("v5e", 8).dims == (2, 4)
+    assert topology_for("v5e", 16).dims == (4, 4)
+    assert topology_for("v5e", 64).dims == (8, 8)
+
+
+def test_v4_shapes_are_3d():
+    assert topology_for("v4", 64).dims == (4, 4, 4)
+    assert topology_for("v4", 8).dims == (2, 2, 2)
+    assert topology_for("tpu-v5p-slice", 64).dims == (4, 4, 4)
+
+
+def test_fallback_factorization():
+    assert topology_for("v5e", 12).dims == (3, 4)
+    assert topology_for(None, 6).dims == (2, 3)
+    t = topology_for("v4", 24)
+    assert t.num_chips == 24 and len(t.dims) == 3
+
+
+def test_coords_roundtrip_2d():
+    topo = topology_for("v5e", 16)
+    for cid in range(16):
+        assert topo.chip_id(topo.coords(cid)) == cid
+    assert topo.coords(0) == (0, 0)
+    assert topo.coords(5) == (1, 1)
+
+
+def test_coords_roundtrip_3d():
+    topo = topology_for("v4", 64)
+    for cid in range(64):
+        assert topo.chip_id(topo.coords(cid)) == cid
+
+
+def test_coords_out_of_range():
+    topo = topology_for("v5e", 16)
+    with pytest.raises(ValueError):
+        topo.coords(16)
+    with pytest.raises(ValueError):
+        topo.chip_id((4, 0))
+
+
+def test_torus_neighbors_2d():
+    topo = topology_for("v5e", 16)  # 4x4
+    n = topo.neighbors(0)  # corner (0,0): wraps to (3,0) and (0,3)
+    assert sorted(n) == sorted([
+        topo.chip_id((1, 0)), topo.chip_id((3, 0)),
+        topo.chip_id((0, 1)), topo.chip_id((0, 3)),
+    ])
+    assert len(topo.neighbors(5)) == 4
+
+
+def test_torus_neighbors_3d():
+    topo = topology_for("v4", 64)  # 4x4x4
+    assert len(topo.neighbors(0)) == 6
+
+
+def test_neighbors_degenerate_axes():
+    # extent-1 axis → no link; extent-2 axis → single shared link
+    topo = Topology("v4", (2, 2, 1))
+    assert len(topo.neighbors(0)) == 2
+
+
+def test_heatmap_grid_2d():
+    topo = topology_for("v5e", 16)
+    grid = heatmap_grid(topo, {0: 1.0, 5: 2.0, 15: 3.0})
+    assert len(grid) == 4 and len(grid[0]) == 4
+    assert grid[0][0] == 1.0
+    assert grid[1][1] == 2.0
+    assert grid[3][3] == 3.0
+    assert grid[0][1] is None  # missing chips render as gaps
+
+
+def test_heatmap_grid_3d_unrolls_planes():
+    topo = topology_for("v4", 8)  # 2x2x2
+    values = {cid: float(cid) for cid in range(8)}
+    grid = heatmap_grid(topo, values)
+    # 2 rows, planes side by side with 1-col gap: width = 2*2 + 1
+    assert len(grid) == 2 and len(grid[0]) == 5
+    assert grid[0][0] == 0.0        # z=0 plane, (0,0)
+    assert grid[0][2] is None       # gap column
+    assert grid[0][3] == 4.0        # z=1 plane, (0,0)

@@ -1,0 +1,221 @@
+"""Dashboard page — single self-contained HTML document.
+
+Renders the frame JSON from ``/api/frame``.  Uses plotly.js when the page
+can load it (CDN); otherwise a built-in dependency-free renderer draws the
+same figure dicts as HTML/SVG (gauges/bars as banded meters, heatmaps as CSS
+grids), so the dashboard works fully air-gapped — the figure dicts are the
+contract, the renderer is swappable.
+"""
+
+PAGE = r"""<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>TPU Metrics Dashboard</title>
+<script src="https://cdn.plot.ly/plotly-2.32.0.min.js" onerror="window._noPlotly=true"></script>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif; margin: 0;
+         background: #f7f9fb; color: #1c2733; }
+  header { display: flex; align-items: baseline; gap: 16px; padding: 12px 20px;
+           background: #fff; border-bottom: 1px solid #e3e8ee; position: sticky; top: 0; z-index: 5;}
+  h1 { font-size: 20px; margin: 0; }
+  #last-updated { color: #6b7a8c; font-size: 13px; margin-left: auto; }
+  .wrap { padding: 16px 20px; }
+  #error-banner { display: none; background: #fdeaea; color: #a8322a;
+                  border: 1px solid #e74c3c; border-radius: 6px; padding: 10px 14px; margin-bottom: 12px; }
+  .controls { display: flex; gap: 18px; align-items: center; margin-bottom: 10px; flex-wrap: wrap;}
+  .controls label { font-size: 14px; }
+  #chip-grid { display: grid; grid-template-columns: repeat(var(--grid-cols, 4), minmax(120px, 1fr));
+               gap: 4px 14px; margin: 8px 0 16px; max-height: 180px; overflow-y: auto;
+               border: 1px solid #e3e8ee; border-radius: 6px; padding: 10px; background: #fff;}
+  #chip-grid label { font-size: 13px; white-space: nowrap; }
+  .row-title { font-size: 16px; font-weight: 600; margin: 14px 0 6px; }
+  .panel-row { display: grid; grid-template-columns: repeat(auto-fit, minmax(230px, 1fr)); gap: 10px; }
+  .panel { background: #fff; border: 1px solid #e3e8ee; border-radius: 6px; padding: 6px; }
+  table { border-collapse: collapse; background: #fff; font-size: 13px; margin-top: 8px;}
+  th, td { border: 1px solid #e3e8ee; padding: 5px 10px; text-align: right; }
+  th:first-child, td:first-child { text-align: left; }
+  .meter { position: relative; height: 26px; border-radius: 4px; overflow: hidden;
+           background: #eef2f6; margin-top: 8px; }
+  .meter .band { position: absolute; top: 0; bottom: 0; }
+  .meter .fill { position: absolute; top: 4px; bottom: 4px; left: 0; border: 1px solid rgba(0,0,0,.55); }
+  .fig-title { font-size: 13px; color: #44556a; }
+  .fig-value { font-size: 26px; font-weight: 700; }
+  .heat { display: grid; gap: 2px; margin-top: 6px; }
+  .heat div { aspect-ratio: 1; border-radius: 2px; min-width: 10px; }
+  #debug { color: #6b7a8c; font-size: 12px; margin-top: 18px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>📊 TPU Metrics Dashboard</h1>
+  <span id="last-updated"></span>
+</header>
+<div class="wrap">
+  <div id="error-banner"></div>
+  <div class="controls">
+    <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
+    <button id="select-all">Select all</button>
+    <button id="select-none">Clear</button>
+    <span id="chip-count"></span>
+  </div>
+  <div id="chip-grid"></div>
+  <div id="panels"></div>
+  <div class="row-title">Statistics (selected chips)</div>
+  <div id="stats"></div>
+  <div id="debug"></div>
+</div>
+<script>
+const usePlotly = () => !window._noPlotly && window.Plotly;
+
+// Scraped label values (chip keys, slice ids, model names, metric names) are
+// untrusted — escape anything interpolated into innerHTML.
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+
+// ---- dependency-free fallback renderer over the same figure dicts --------
+function renderMeter(el, title, value, maxVal, steps, color) {
+  const pct = maxVal > 0 ? Math.min(100, Math.max(0, value / maxVal * 100)) : 0;
+  let bands = '';
+  for (const s of steps || []) {
+    const l = s.range[0] / maxVal * 100, w = (s.range[1] - s.range[0]) / maxVal * 100;
+    bands += `<div class="band" style="left:${l}%;width:${w}%;background:${s.color}"></div>`;
+  }
+  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
+    <div class="fig-value" style="color:${esc(color)}">${(+value).toFixed(1)}</div>
+    <div class="meter">${bands}<div class="fill" style="width:${pct}%;background:${esc(color)}"></div></div>
+    <div class="fig-title">max ${+maxVal}</div>`;
+}
+
+function colorFromScale(scale, frac) {
+  let c = scale[0][1];
+  for (const [stop, col] of scale) { if (frac >= stop) c = col; }
+  return c;
+}
+
+function renderHeatFallback(el, trace, layoutTitle) {
+  const z = trace.z, zmax = trace.zmax || 100;
+  const cols = z.length ? z[0].length : 0;
+  let cells = '';
+  for (const row of z) for (const v of row) {
+    if (v === null || v === undefined) { cells += '<div style="background:transparent"></div>'; continue; }
+    const col = colorFromScale(trace.colorscale, Math.min(1, Math.max(0, v / zmax)));
+    cells += `<div style="background:${col}" title="${(+v).toFixed(1)}"></div>`;
+  }
+  el.innerHTML = `<div class="fig-title">${esc(layoutTitle)}</div>
+    <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
+}
+
+function renderFigure(el, fig) {
+  if (usePlotly()) { Plotly.react(el, fig.data, fig.layout, {displayModeBar: false}); return; }
+  const t = fig.data[0];
+  const title = (t.title && t.title.text) || (fig.layout.title && fig.layout.title.text) || '';
+  if (t.type === 'indicator') {
+    renderMeter(el, title, t.value, t.gauge.axis.range[1], t.gauge.steps, t.gauge.bar.color);
+  } else if (t.type === 'bar') {
+    const steps = (fig.layout.shapes || []).map(s => ({range: [s.x0, s.x1], color: s.fillcolor}));
+    renderMeter(el, title, t.x[0], fig.layout.xaxis.range[1], steps, t.marker.color);
+  } else if (t.type === 'heatmap') {
+    renderHeatFallback(el, t, title);
+  }
+}
+
+// ---- state + API ----------------------------------------------------------
+async function post(url, body) {
+  await fetch(url, {method: 'POST', headers: {'Content-Type': 'application/json'},
+                    body: JSON.stringify(body)});
+  await refresh();
+}
+
+function renderChips(chips) {
+  const grid = document.getElementById('chip-grid');
+  grid.innerHTML = '';
+  for (const c of chips) {
+    const id = 'chip_checkbox_' + c.key;
+    const label = document.createElement('label');
+    label.innerHTML = `<input type="checkbox" id="${esc(id)}" ${c.selected ? 'checked' : ''}> ` +
+                      `TPU ${+c.chip_id} <small>(${esc(c.model)}, ${esc(c.slice)})</small>`;
+    label.querySelector('input').addEventListener('change',
+      () => post('/api/select', {toggle: c.key}));
+    grid.appendChild(label);
+  }
+  document.getElementById('chip-count').textContent =
+    chips.filter(c => c.selected).length + ' / ' + chips.length + ' chips selected';
+}
+
+function panelRow(container, rowTitle, figures) {
+  const title = document.createElement('div');
+  title.className = 'row-title'; title.textContent = rowTitle;
+  container.appendChild(title);
+  const row = document.createElement('div');
+  row.className = 'panel-row';
+  for (const f of figures) {
+    const cell = document.createElement('div');
+    cell.className = 'panel';
+    row.appendChild(cell);
+    renderFigure(cell, f.figure);
+  }
+  container.appendChild(row);
+}
+
+function renderStats(stats) {
+  const el = document.getElementById('stats');
+  const metrics = Object.keys(stats);
+  if (!metrics.length) { el.innerHTML = '<em>no data</em>'; return; }
+  let html = '<table><tr><th>metric</th><th>mean</th><th>max</th><th>min</th></tr>';
+  for (const m of metrics) {
+    const s = stats[m];
+    html += `<tr><td>${esc(m)}</td><td>${+s.mean}</td><td>${+s.max}</td><td>${+s.min}</td></tr>`;
+  }
+  el.innerHTML = html + '</table>';
+}
+
+async function refresh() {
+  let frame;
+  try {
+    frame = await (await fetch('/api/frame')).json();
+  } catch (e) {
+    showError('Dashboard server unreachable: ' + e);
+    if (!timer) timer = setInterval(refresh, 5000);  // keep retrying
+    return;
+  }
+  document.getElementById('last-updated').textContent = 'Last updated: ' + frame.last_updated;
+  if (!timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
+  showError(frame.error);
+  if (frame.error) return;  // keep last good panels (reference skips the cycle)
+  document.getElementById('use-gauge').checked = frame.use_gauge;
+  renderChips(frame.chips);
+  const panels = document.getElementById('panels');
+  panels.innerHTML = '';
+  if (frame.average) panelRow(panels, frame.average.title, frame.average.figures);
+  for (const row of frame.device_rows || []) panelRow(panels, row.title, row.figures);
+  // heatmaps group per panel metric
+  const heat = frame.heatmaps || [];
+  if (heat.length) panelRow(panels, 'Topology heatmaps', heat);
+  renderStats(frame.stats || {});
+  const t = frame.timings || {};
+  document.getElementById('debug').textContent =
+    'Debug: frames=' + (t.frames || 0) +
+    (t.total ? (', scrape→render p50=' + t.total.p50_ms.toFixed(1) + ' ms') : '') +
+    (window._noPlotly ? ' · fallback renderer (plotly.js unavailable)' : '');
+}
+
+document.getElementById('use-gauge').addEventListener('change',
+  e => post('/api/style', {use_gauge: e.target.checked}));
+document.getElementById('select-all').addEventListener('click',
+  () => post('/api/select', {all: true}));
+document.getElementById('select-none').addEventListener('click',
+  () => post('/api/select', {none: true}));
+
+function showError(msg) {
+  const b = document.getElementById('error-banner');
+  if (msg) { b.style.display = 'block'; b.textContent = msg; }
+  else b.style.display = 'none';
+}
+
+let timer = null;
+refresh();
+</script>
+</body>
+</html>
+"""

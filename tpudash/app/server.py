@@ -1,0 +1,145 @@
+"""Async dashboard server.
+
+Replaces the reference's Streamlit shell (app.py:247-489): the browser polls
+``/api/frame`` every refresh interval instead of the server blocking in
+``while True: time.sleep(5)`` (app.py:326, 486).  Source fetches are
+blocking (requests / on-chip probes), so frames are built in a worker
+executor and never stall the event loop; a frame cache ensures many browser
+tabs cost one scrape per interval, not one per tab.
+
+Routes:
+  GET  /             dashboard page
+  GET  /api/frame    current frame (cached within the refresh interval)
+  POST /api/select   {"toggle": key} | {"selected": [keys]} | {"all": true} | {"none": true}
+  POST /api/style    {"use_gauge": bool}
+  GET  /api/timings  stage-timing summary (tracing, SURVEY.md §5)
+  GET  /healthz      liveness
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+from tpudash.app.html import PAGE
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.sources import make_source
+
+
+class DashboardServer:
+    def __init__(self, service: DashboardService):
+        self.service = service
+        self._lock = asyncio.Lock()
+        self._cached_frame: dict | None = None
+        self._cached_at: float = 0.0
+
+    # -- frame caching -------------------------------------------------------
+    async def _get_frame(self, force: bool = False) -> dict:
+        async with self._lock:
+            age = time.monotonic() - self._cached_at
+            if (
+                not force
+                and self._cached_frame is not None
+                and age < self.service.cfg.refresh_interval
+            ):
+                return self._cached_frame
+            loop = asyncio.get_running_loop()
+            frame = await loop.run_in_executor(None, self.service.render_frame)
+            self._cached_frame = frame
+            self._cached_at = time.monotonic()
+            return frame
+
+    async def _mutate(self, fn):
+        """Run a state mutation under the frame lock: render_frame executes
+        on the worker thread only while the lock is held, so mutations are
+        serialized against frame builds (no torn selection lists)."""
+        async with self._lock:
+            return fn()
+
+    # -- handlers ------------------------------------------------------------
+    async def index(self, request: web.Request) -> web.Response:
+        return web.Response(text=PAGE, content_type="text/html")
+
+    async def frame(self, request: web.Request) -> web.Response:
+        frame = await self._get_frame()
+        return web.json_response(frame)
+
+    async def select(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+        state = self.service.state
+        if not self.service.available:
+            # No successful frame yet this session — prime one so selection
+            # ops validate against a real chip list.
+            await self._get_frame(force=True)
+        available = self.service.available
+        if body.get("all"):
+            await self._mutate(lambda: state.select_all(available))
+        elif body.get("none"):
+            await self._mutate(state.clear)
+        elif "toggle" in body:
+            await self._mutate(lambda: state.toggle(str(body["toggle"]), available))
+        elif "selected" in body:
+            if not isinstance(body["selected"], list):
+                raise web.HTTPBadRequest(text="'selected' must be a list")
+            await self._mutate(
+                lambda: state.set_selected(
+                    [str(k) for k in body["selected"]], available
+                )
+            )
+        else:
+            raise web.HTTPBadRequest(text="no selection operation in body")
+        frame = await self._get_frame(force=True)
+        return web.json_response(
+            {"selected": list(state.selected), "frame_ok": frame["error"] is None}
+        )
+
+    async def style(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+        use_gauge = bool(body.get("use_gauge", True))
+
+        def _set():
+            self.service.state.use_gauge = use_gauge
+
+        await self._mutate(_set)
+        await self._get_frame(force=True)
+        return web.json_response({"use_gauge": self.service.state.use_gauge})
+
+    async def timings(self, request: web.Request) -> web.Response:
+        return web.json_response(self.service.timer.summary())
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "source": self.service.source.name,
+             "error": self.service.last_error}
+        )
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.index)
+        app.router.add_get("/api/frame", self.frame)
+        app.router.add_post("/api/select", self.select)
+        app.router.add_post("/api/style", self.style)
+        app.router.add_get("/api/timings", self.timings)
+        app.router.add_get("/healthz", self.healthz)
+        return app
+
+
+def make_app(cfg: Config | None = None) -> web.Application:
+    cfg = cfg or load_config()
+    service = DashboardService(cfg, make_source(cfg))
+    return DashboardServer(service).build_app()
+
+
+def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    cfg = cfg or load_config()
+    web.run_app(make_app(cfg), host=cfg.host, port=cfg.port)

@@ -1,0 +1,230 @@
+"""DashboardService — one frame = scrape → normalize → figures.
+
+The testable core of L4 (the reference mixes this into its render loop,
+app.py:320-486).  ``render_frame()`` returns a JSON-able dict with:
+
+- ``chips``: the selection-grid model (key, chip_id, slice, host, model) —
+  the reference's checkbox grid source (app.py:266-313);
+- ``average``: panel row averaged over selected chips, zero-exclusion
+  power policy applied (app.py:341-345), plus chip count;
+- ``device_rows``: per-chip panel rows with model-aware power maxima and
+  headers "TPU {id} ({model})" (app.py:411-476) — only emitted while the
+  selection is small (config.per_chip_panel_limit);
+- ``heatmaps``: one topology heatmap per panel metric across ALL selected
+  chips — the O(1)-figures path that replaces per-chip rows at 256-chip
+  scale (SURVEY.md §3.2 scaling wall);
+- ``stats``: mean/max/min table rounded to 2 dp (app.py:478-481);
+- ``error``: the error-banner string when the source failed this cycle —
+  the app keeps polling (app.py:225-227, 333);
+- ``timings``: scrape/normalize/render stage p50s (SURVEY.md §5 tracing).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+import pandas as pd
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.normalize import (
+    column_average,
+    compute_stats,
+    filter_selected,
+    to_wide,
+)
+from tpudash.app.state import SelectionState
+from tpudash.registry import resolve_generation
+from tpudash.sources.base import MetricsSource
+from tpudash.topology import topology_for
+from tpudash.utils.timing import StageTimer
+from tpudash.viz.dispatch import accel_types_for, create_visualization, panel_max
+from tpudash.viz.figures import create_topology_heatmap
+
+
+def _model_name(accel: str) -> str:
+    gen = resolve_generation(accel)
+    # Unknown models render as "unknown", not "None" (reference quirk at
+    # app.py:415 not replicated).
+    return gen.name if gen else (accel or "unknown")
+
+
+class DashboardService:
+    def __init__(self, cfg: Config, source: MetricsSource):
+        self.cfg = cfg
+        self.source = source
+        self.state = SelectionState()
+        self.timer = StageTimer()
+        self.last_error: str | None = None
+        #: chip keys seen in the last successful frame — the "currently
+        #: available devices" selection ops validate against (app.py:281).
+        self.available: list[str] = []
+
+    # -- panel helpers -------------------------------------------------------
+    def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
+        """The reference's fixed four panels plus TPU extras whose series
+        the source actually provides."""
+        panels = [p for p in schema.PANELS if p.column in df.columns]
+        panels += [p for p in schema.EXTRA_PANELS if p.column in df.columns]
+        return panels
+
+    def _average_row(self, sel_df: pd.DataFrame, panels, use_gauge: bool) -> dict:
+        accels = accel_types_for(sel_df)
+        figures = []
+        for spec in panels:
+            avg = column_average(sel_df, spec.column)
+            value = 0.0 if avg is None else avg  # reference renders 0 on empty
+            figures.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_visualization(
+                        value,
+                        spec,
+                        use_gauge=use_gauge,
+                        height=self.cfg.avg_panel_height,
+                        accel_types=accels,
+                        title=f"Avg {spec.title}",
+                    ),
+                }
+            )
+        return {"title": "Average (selected chips)", "figures": figures}
+
+    def _device_rows(self, sel_df: pd.DataFrame, panels, use_gauge: bool) -> list:
+        rows = []
+        for key, row in sel_df.iterrows():
+            accel = row.get(schema.ACCEL_TYPE, "")
+            figures = []
+            for spec in panels:
+                value = row.get(spec.column)
+                if value is None or pd.isna(value):
+                    continue
+                figures.append(
+                    {
+                        "panel": spec.column,
+                        "figure": create_visualization(
+                            float(value),
+                            spec,
+                            use_gauge=use_gauge,
+                            height=self.cfg.device_panel_height,
+                            accel_types=[accel] if accel else None,
+                        ),
+                    }
+                )
+            rows.append(
+                {
+                    # header parity: "### GPU {id} ({model})" app.py:415
+                    "title": f"TPU {row['chip_id']} ({_model_name(accel)})",
+                    "key": key,
+                    "figures": figures,
+                }
+            )
+        return rows
+
+    def _heatmaps(self, sel_df: pd.DataFrame, df: pd.DataFrame, panels) -> list:
+        """One heatmap per panel metric, per slice, over selected chips."""
+        out = []
+        for slice_id, sdf in sel_df.groupby("slice_id", sort=True):
+            accels = accel_types_for(sdf)
+            generation = accels[0] if accels else self.cfg.generation
+            # topology sized to the FULL slice population (not just the
+            # selection) so partial selections keep real torus coordinates
+            n = int(df.loc[df["slice_id"] == slice_id, "chip_id"].max()) + 1
+            topo = topology_for(generation, n)
+            for spec in panels:
+                if spec.column not in sdf.columns:
+                    continue
+                series = pd.to_numeric(sdf[spec.column], errors="coerce").dropna()
+                values = {
+                    int(sdf.loc[k, "chip_id"]): float(v)
+                    for k, v in series.items()
+                }
+                if not values:
+                    continue
+                out.append(
+                    {
+                        "panel": spec.column,
+                        "slice": str(slice_id),
+                        "figure": create_topology_heatmap(
+                            topo,
+                            values,
+                            title=f"{slice_id} — {spec.title}",
+                            max_val=panel_max(spec, accels),
+                            unit=spec.unit,
+                        ),
+                    }
+                )
+        return out
+
+    # -- the frame -----------------------------------------------------------
+    def render_frame(self) -> dict:
+        self.timer.start_frame()
+        frame: dict = {
+            "last_updated": _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+            "refresh_interval": self.cfg.refresh_interval,
+            "use_gauge": self.state.use_gauge,
+            "error": None,
+        }
+        try:
+            with self.timer.stage("scrape"):
+                samples = self.source.fetch()
+            with self.timer.stage("normalize"):
+                df = to_wide(samples)
+        except Exception as e:  # noqa: BLE001 — error banner path catches all
+            # Graceful degradation (app.py:225-227, 333): banner + keep state.
+            self.last_error = f"Error fetching TPU metrics: {e}"
+            frame["error"] = self.last_error
+            frame["chips"] = []
+            self.timer.end_frame()
+            frame["timings"] = self.timer.summary()
+            return frame
+
+        self.last_error = None
+        with self.timer.stage("render"):
+            available = list(df.index)
+            self.available = available
+            selected = self.state.sync(available)
+            sel_df = filter_selected(df, selected)
+            panels = self._active_panels(df)
+            use_gauge = self.state.use_gauge
+
+            frame["chips"] = [
+                {
+                    "key": key,
+                    "chip_id": int(row["chip_id"]),
+                    "slice": row["slice_id"],
+                    "host": row["host"],
+                    "model": _model_name(row.get(schema.ACCEL_TYPE, "")),
+                    "selected": key in set(selected),
+                }
+                for key, row in df.iterrows()
+            ]
+            # copy: the cached frame must not alias the live selection list
+            frame["selected"] = list(selected)
+            frame["panel_specs"] = [
+                {"column": p.column, "title": p.title, "unit": p.unit}
+                for p in panels
+            ]
+
+            if not sel_df.empty:
+                frame["average"] = self._average_row(sel_df, panels, use_gauge)
+                if len(sel_df) <= self.cfg.per_chip_panel_limit:
+                    frame["device_rows"] = self._device_rows(sel_df, panels, use_gauge)
+                    frame["heatmaps"] = []
+                else:
+                    frame["device_rows"] = []
+                    frame["heatmaps"] = self._heatmaps(sel_df, df, panels)
+                stats = compute_stats(sel_df)
+                # display rounding parity (app.py:480-481)
+                frame["stats"] = {
+                    m: {k: round(v, 2) for k, v in s.items()}
+                    for m, s in stats.items()
+                }
+            else:
+                frame["average"] = None
+                frame["device_rows"] = []
+                frame["heatmaps"] = []
+                frame["stats"] = {}
+
+        self.timer.end_frame()
+        frame["timings"] = self.timer.summary()
+        return frame

@@ -1,0 +1,68 @@
+"""Selection / style state with the reference's session semantics.
+
+The reference keeps three session keys (SURVEY.md §3.4): ``selected_gpus``
+(pruned against available devices app.py:281, defaulting to the first device
+when empty app.py:284-285, re-sorted after changes app.py:313),
+``use_gauge`` (app.py:254-260) and ``last_selection`` (app.py:274-275, 310).
+SelectionState reproduces exactly those behaviors keyed by chip key strings,
+sorting numerically by (slice, chip) — not lexically.
+"""
+
+from __future__ import annotations
+
+
+def _sort_key(chip_key: str):
+    slice_id, _, chip = chip_key.rpartition("/")
+    try:
+        return (slice_id, int(chip))
+    except ValueError:
+        return (slice_id, -1)
+
+
+class SelectionState:
+    def __init__(self) -> None:
+        self.selected: list[str] = []
+        self.last_selection: list[str] = []
+        self.use_gauge: bool = True
+        self._initialized = False
+
+    def sync(self, available: list[str]) -> list[str]:
+        """Reconcile selections with the currently available chips:
+        prune stale keys (app.py:281), default to the first chip when the
+        selection is empty (app.py:284-285), keep sorted (app.py:313)."""
+        avail = sorted(available, key=_sort_key)
+        self.selected = [k for k in self.selected if k in set(avail)]
+        if not self.selected and avail and not self._initialized:
+            self.selected = [avail[0]]
+        self._initialized = True
+        self.selected.sort(key=_sort_key)
+        return self.selected
+
+    def set_selected(self, keys: list[str], available: list[str]) -> list[str]:
+        """Replace the selection (checkbox-grid change, app.py:292-313)."""
+        self.last_selection = list(self.selected)
+        avail = set(available)
+        self.selected = sorted(
+            {k for k in keys if k in avail}, key=_sort_key
+        )
+        return self.selected
+
+    def toggle(self, chip_key: str, available: list[str]) -> list[str]:
+        """Flip one checkbox (app.py:292-309)."""
+        self.last_selection = list(self.selected)
+        if chip_key in self.selected:
+            self.selected.remove(chip_key)
+        elif chip_key in set(available):
+            self.selected.append(chip_key)
+            self.selected.sort(key=_sort_key)
+        return self.selected
+
+    def select_all(self, available: list[str]) -> list[str]:
+        self.last_selection = list(self.selected)
+        self.selected = sorted(available, key=_sort_key)
+        return self.selected
+
+    def clear(self) -> list[str]:
+        self.last_selection = list(self.selected)
+        self.selected = []
+        return self.selected

@@ -1,0 +1,104 @@
+"""Configuration.
+
+The reference configures exactly two env vars with localhost defaults and
+hardcodes every other knob (reference app.py:22-24: PROMETHEUS_METRICS_ENDPOINT,
+PROMETHEUS_METRICS_PODNAME, REFRESH_INTERVAL = 5).  tpudash keeps the same
+env-var names/defaults for drop-in parity and promotes the hardcoded knobs
+(refresh interval, panel heights, grid width, color thresholds are in
+colors.py) to first-class config, per SURVEY.md §7.2.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- parity with the reference (app.py:22-24) ---------------------------
+    #: Prometheus instant-query endpoint.
+    prometheus_endpoint: str = "http://localhost:9090/api/v1/query"
+    #: Substring used to locate the Prometheus pod via kube_pod_info
+    #: (reference app.py:157-164 discovery quirk; kept as a fallback).
+    prometheus_podname: str = "prometheus"
+    #: Dashboard refresh cadence, seconds (reference app.py:24).
+    refresh_interval: float = 5.0
+
+    # --- promoted knobs (hardcoded in the reference) ------------------------
+    #: Device-selection grid width (reference app.py:268 `num_columns = 4`).
+    selection_grid_columns: int = 4
+    #: Panel heights, px (reference app.py:323-324: avg 300, per-device 200).
+    avg_panel_height: int = 300
+    device_panel_height: int = 200
+    #: HTTP timeout for Prometheus queries, seconds.
+    http_timeout: float = 4.0
+
+    # --- TPU-native additions ----------------------------------------------
+    #: Metrics source: "prometheus" | "fixture" | "probe" | "synthetic".
+    source: str = "prometheus"
+    #: Path to a fixture JSON (Prometheus response shape) for source=fixture.
+    fixture_path: str = ""
+    #: Synthetic-source chip count (scale testing; 256 = v5e pod slice).
+    synthetic_chips: int = 256
+    #: TPU generation hint for the synthetic source / topology fallback.
+    generation: str = "v5e"
+    #: Target discovery mode: "selector" (default — trust the Prometheus
+    #: scrape config / series labels; slice-wide scope, single query) or
+    #: "podname" (reference-parity fallback: scope to the node hosting the
+    #: Prometheus pod via kube_pod_info, app.py:157-164).
+    discovery: str = "selector"
+    #: Extra PromQL label matchers appended verbatim to the metrics query's
+    #: selector, e.g. 'cluster="tpu-a", slice=~"slice-[01]"' — the
+    #: slice-scoped narrowing the reference could not express.
+    series_selector: str = ""
+    #: Dashboard server bind.
+    host: str = "0.0.0.0"
+    port: int = 8050
+    #: Above this many selected chips the per-chip gauge rows collapse into
+    #: the topology heatmap (the reference's O(N) figure wall, SURVEY §3.2).
+    per_chip_panel_limit: int = 16
+
+    extra: dict = field(default_factory=dict)
+
+
+_ENV_MAP = {
+    "prometheus_endpoint": "PROMETHEUS_METRICS_ENDPOINT",
+    "prometheus_podname": "PROMETHEUS_METRICS_PODNAME",
+    "refresh_interval": "TPUDASH_REFRESH_INTERVAL",
+    "selection_grid_columns": "TPUDASH_GRID_COLUMNS",
+    "avg_panel_height": "TPUDASH_AVG_PANEL_HEIGHT",
+    "device_panel_height": "TPUDASH_DEVICE_PANEL_HEIGHT",
+    "http_timeout": "TPUDASH_HTTP_TIMEOUT",
+    "source": "TPUDASH_SOURCE",
+    "fixture_path": "TPUDASH_FIXTURE_PATH",
+    "synthetic_chips": "TPUDASH_SYNTHETIC_CHIPS",
+    "generation": "TPUDASH_GENERATION",
+    "discovery": "TPUDASH_DISCOVERY",
+    "series_selector": "TPUDASH_SERIES_SELECTOR",
+    "host": "TPUDASH_HOST",
+    "port": "TPUDASH_PORT",
+    "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
+}
+
+
+def load_config(env: dict | None = None) -> Config:
+    """Build a Config from the environment (or a dict standing in for it)."""
+    src = os.environ if env is None else env
+    kwargs = {}
+    for f in fields(Config):
+        var = _ENV_MAP.get(f.name)
+        if var is None or var not in src:
+            continue
+        raw = src[var]
+        if f.type in ("int", int):
+            kwargs[f.name] = int(raw)
+        elif f.type in ("float", float):
+            kwargs[f.name] = float(raw)
+        else:
+            kwargs[f.name] = raw
+    return Config(**kwargs)

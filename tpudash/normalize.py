@@ -1,0 +1,132 @@
+"""Normalization: long samples → wide per-chip table + stats.
+
+Parity with the reference's fetch/normalize stage (app.py:182-223): long-form
+rows pivot to a wide ``device × metric`` table, a derived memory-usage ratio
+is added, and mean/max/min stats are computed over numeric columns.  Beyond
+the reference: rows are keyed by (slice, host, chip) instead of a flat
+gpu_id, extra derived columns convert byte counts to display units, and
+zero-exclusion averaging (reference app.py:341-345, power only) is a general
+policy applied per metric via schema.ZERO_EXCLUDED_METRICS.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from tpudash import schema
+from tpudash.schema import Sample
+
+
+class NormalizeError(RuntimeError):
+    pass
+
+
+def to_wide(samples: list[Sample]) -> pd.DataFrame:
+    """Pivot long samples into a wide table indexed by chip key.
+
+    Index: "slice/chip" string (sorted by (slice_id, chip_id)).
+    Columns: raw metric columns (float), derived columns, plus identity
+    columns ``slice_id``, ``host``, ``chip_id`` and the accelerator-type
+    pseudo-metric (the reference's card_model column, app.py:191-201).
+    """
+    if not samples:
+        raise NormalizeError("no samples to normalize")
+
+    rows = {}
+    for s in samples:
+        key = s.chip.key
+        row = rows.get(key)
+        if row is None:
+            row = {
+                "slice_id": s.chip.slice_id,
+                "host": s.chip.host,
+                "chip_id": s.chip.chip_id,
+                schema.ACCEL_TYPE: s.accelerator_type,
+            }
+            rows[key] = row
+        row[s.metric] = s.value
+        if s.accelerator_type and not row[schema.ACCEL_TYPE]:
+            row[schema.ACCEL_TYPE] = s.accelerator_type
+
+    df = pd.DataFrame.from_dict(rows, orient="index")
+    df = df.sort_values(["slice_id", "chip_id"])
+    df.index.name = "chip"
+    return _derive(df)
+
+
+def _derive(df: pd.DataFrame) -> pd.DataFrame:
+    """Add derived display columns (reference app.py:210-212 for the ratio)."""
+    if schema.HBM_USED in df and schema.HBM_TOTAL in df:
+        total = df[schema.HBM_TOTAL]
+        df[schema.HBM_USAGE_RATIO] = (
+            df[schema.HBM_USED] / total.where(total > 0) * 100.0
+        )
+        df[schema.HBM_USED_GIB] = df[schema.HBM_USED] / 1024**3
+    if schema.ICI_TX in df or schema.ICI_RX in df:
+        tx = df.get(schema.ICI_TX, 0.0)
+        rx = df.get(schema.ICI_RX, 0.0)
+        df[schema.ICI_TOTAL_GBPS] = (tx + rx) / 1e9
+    if schema.DCN_TX in df or schema.DCN_RX in df:
+        tx = df.get(schema.DCN_TX, 0.0)
+        rx = df.get(schema.DCN_RX, 0.0)
+        df[schema.DCN_TOTAL_GBPS] = (tx + rx) / 1e9
+    return df
+
+
+def numeric_columns(df: pd.DataFrame) -> list[str]:
+    """Metric columns eligible for stats — excludes identity and
+    pseudo-metric columns (the reference excludes card_model,
+    app.py:216-221)."""
+    skip = set(schema.NON_NUMERIC_COLUMNS) | {"slice_id", "host", "chip_id"}
+    return [c for c in df.columns if c not in skip]
+
+
+def compute_stats(df: pd.DataFrame) -> dict:
+    """{metric: {"mean": .., "max": .., "min": ..}} over numeric columns
+    (reference app.py:216-221; display rounds to 2 dp at app.py:480-481 —
+    rounding is presentation, so it lives in the app layer)."""
+    stats: dict = {}
+    for col in numeric_columns(df):
+        series = pd.to_numeric(df[col], errors="coerce").dropna()
+        if series.empty:
+            continue
+        stats[col] = {
+            "mean": float(series.mean()),
+            "max": float(series.max()),
+            "min": float(series.min()),
+        }
+    return stats
+
+
+def column_average(df: pd.DataFrame, column: str) -> float | None:
+    """Average of a column over the (already filtered) table, honoring
+    zero-exclusion policy: for metrics in ZERO_EXCLUDED_METRICS, chips
+    reporting exactly 0 are treated as idle/parked and excluded so they
+    don't drag the mean down (reference app.py:341-345).  Returns None when
+    the column is absent or has no eligible values (the reference renders 0
+    in that case; the app layer makes that call)."""
+    if column not in df:
+        return None
+    series = pd.to_numeric(df[column], errors="coerce").dropna()
+    if column in schema.ZERO_EXCLUDED_METRICS:
+        series = series[series != 0]
+    if series.empty:
+        return None
+    return float(series.mean())
+
+
+def averages(df: pd.DataFrame) -> dict:
+    """Per-column averages with zero-exclusion policy applied."""
+    return {
+        col: avg
+        for col in numeric_columns(df)
+        if (avg := column_average(df, col)) is not None
+    }
+
+
+def filter_selected(df: pd.DataFrame, selected: list[str]) -> pd.DataFrame:
+    """Restrict the table to the selected chip keys (reference app.py:335),
+    ignoring selections that no longer exist (pruning semantics of
+    app.py:281)."""
+    present = [k for k in selected if k in df.index]
+    return df.loc[present]

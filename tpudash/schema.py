@@ -1,0 +1,129 @@
+"""TPU metric schema.
+
+Replaces the reference's five hardcoded ``amd_gpu_*`` series and their regex
+query (reference app.py:167-176) with the TPU-native series exposed by the
+GKE tpu-device-plugin / ``tpu-info`` / libtpu runtime metrics, plus the
+derived columns the dashboard computes.
+
+Label model: where the reference keys rows by a flat ``gpu_id`` label
+(app.py:183-189), TPU series are keyed by (slice, host, chip) with torus
+topology coordinates — the unit of scale is a pod slice, not a node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+# --- raw series (scraped) ---------------------------------------------------
+#: TensorCore duty cycle, percent [0, 100].
+TENSORCORE_UTIL = "tpu_tensorcore_utilization"
+#: High-bandwidth memory, bytes.
+HBM_USED = "tpu_hbm_used_bytes"
+HBM_TOTAL = "tpu_hbm_total_bytes"
+#: Inter-chip interconnect, aggregate across the chip's links, bytes/s.
+ICI_TX = "tpu_ici_tx_bytes_per_second"
+ICI_RX = "tpu_ici_rx_bytes_per_second"
+#: Cross-slice data-center network (multi-slice), bytes/s.
+DCN_TX = "tpu_dcn_tx_bytes_per_second"
+DCN_RX = "tpu_dcn_rx_bytes_per_second"
+#: Package temperature, °C, and board power, W (where the platform exposes
+#: them; the probe/synthetic sources always do).
+TEMPERATURE = "tpu_temperature_celsius"
+POWER = "tpu_power_watts"
+
+#: The scrape set — role of the reference's 5-series regex (app.py:169-170).
+SCRAPE_SERIES: tuple[str, ...] = (
+    TENSORCORE_UTIL,
+    HBM_USED,
+    HBM_TOTAL,
+    ICI_TX,
+    ICI_RX,
+    DCN_TX,
+    DCN_RX,
+    TEMPERATURE,
+    POWER,
+)
+
+# --- derived columns (normalize.py) ----------------------------------------
+#: used/total × 100 — reference's vram_usage_ratio (app.py:210-212).
+HBM_USAGE_RATIO = "hbm_usage_ratio"
+#: HBM used expressed in GiB for display.
+HBM_USED_GIB = "hbm_used_gib"
+#: ICI tx+rx in GB/s for display.
+ICI_TOTAL_GBPS = "ici_total_gbps"
+DCN_TOTAL_GBPS = "dcn_total_gbps"
+
+#: Pseudo-metric column carrying the device model string through the wide
+#: table — the reference smuggles ``card_model`` the same way (app.py:191-201).
+ACCEL_TYPE = "accelerator_type"
+
+#: Non-numeric columns excluded from stats (reference app.py:216-221 excludes
+#: card_model).
+NON_NUMERIC_COLUMNS: tuple[str, ...] = (ACCEL_TYPE,)
+
+#: Metrics whose zero values mean "idle/parked" and are excluded from
+#: averages (reference's zero-exclusion power averaging, app.py:341-345).
+ZERO_EXCLUDED_METRICS: tuple[str, ...] = (POWER,)
+
+
+@dataclass(frozen=True)
+class ChipKey:
+    """Identity of one chip: (slice, host, chip) + global dashboard id.
+
+    ``chip_id`` is the flat per-slice index used for topology coordinates and
+    selection state — the role the reference's ``gpu_id`` label plays
+    (app.py:183-189), extended with slice/host scoping for multi-host and
+    multi-slice configs.
+    """
+
+    slice_id: str
+    host: str
+    chip_id: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.slice_id}/{self.chip_id}"
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One Prometheus-style instant sample, already label-parsed.
+
+    Mirrors the fields the reference pulls out of
+    ``data.result[].metric{__name__, gpu_id, card_model, instance}`` +
+    ``.value[1]`` (app.py:164, 183-192).
+    """
+
+    metric: str
+    value: float
+    chip: ChipKey
+    accelerator_type: str = ""
+    labels: dict | None = None
+
+
+# The four panels every row displays, with their value column and axis-max
+# policy — parity with the reference's panel table (SURVEY.md §2 end;
+# app.py:347-476) retargeted to TPU series.
+@dataclass(frozen=True)
+class PanelSpec:
+    title: str           # per-chip panel title; avg row prefixes "Avg "
+    column: str          # wide-table column to display
+    max_policy: str      # "fixed" | "power" | "hbm" | "ici"
+    fixed_max: float = 100.0
+    unit: str = "%"
+
+
+PANELS: tuple[PanelSpec, ...] = (
+    PanelSpec("TensorCore Utilization (%)", TENSORCORE_UTIL, "fixed", 100.0, "%"),
+    PanelSpec("HBM Usage (%)", HBM_USAGE_RATIO, "fixed", 100.0, "%"),
+    PanelSpec("Temperature (°C)", TEMPERATURE, "fixed", 100.0, "°C"),
+    PanelSpec("Power Usage (W)", POWER, "power", 300.0, "W"),
+)
+
+#: Extra TPU-native panels (beyond the reference's four) shown when the
+#: source provides the series: aggregate ICI and DCN bandwidth.
+EXTRA_PANELS: tuple[PanelSpec, ...] = (
+    PanelSpec("ICI Bandwidth (GB/s)", ICI_TOTAL_GBPS, "ici", 200.0, "GB/s"),
+    PanelSpec("DCN Bandwidth (GB/s)", DCN_TOTAL_GBPS, "fixed", 50.0, "GB/s"),
+)

@@ -1,0 +1,34 @@
+"""Metrics sources — the seam the reference never had (SURVEY.md §4, §7.1).
+
+Every source speaks the same protocol (``MetricsSource.fetch() ->
+list[Sample]``), so L2 normalization, L3 figures, and the L4 app are
+identical whether samples come from a live Prometheus in a GKE cluster, a
+static JSON fixture, a synthetic N-chip generator, or live on-chip JAX
+probes.
+"""
+
+from tpudash.sources.base import MetricsSource, SourceError  # noqa: F401
+from tpudash.sources.fixture import FixtureSource, SyntheticSource  # noqa: F401
+from tpudash.sources.prometheus import PrometheusSource  # noqa: F401
+
+
+def make_source(cfg) -> MetricsSource:
+    """Source factory driven by Config.source."""
+    kind = cfg.source
+    if kind == "prometheus":
+        return PrometheusSource(cfg)
+    if kind == "fixture":
+        return FixtureSource(cfg.fixture_path)
+    if kind == "synthetic":
+        return SyntheticSource(
+            num_chips=cfg.synthetic_chips, generation=cfg.generation
+        )
+    if kind == "probe":
+        try:
+            from tpudash.sources.probe import ProbeSource  # deferred: imports jax
+        except ImportError as e:
+            raise SourceError(
+                f"probe source unavailable (jax import failed: {e})"
+            ) from e
+        return ProbeSource(cfg)
+    raise ValueError(f"unknown source kind: {kind!r}")

@@ -1,0 +1,94 @@
+"""Source protocol and the Prometheus instant-query JSON parser.
+
+The parser implements exactly the response contract the reference consumes
+(app.py:164, 183-192): ``data.result[].metric{__name__, ...labels}`` +
+``.value == [ts, "str"]`` — retargeted to TPU label names.
+
+Label mapping (TPU series → reference analogue):
+  chip_id       ← gpu_id            (app.py:183-189)
+  accelerator   ← card_model        (app.py:191-201)
+  slice / host  ← (new) multi-host, multi-slice scoping
+  instance      ← instance          (app.py:173-176 node scoping)
+"""
+
+from __future__ import annotations
+
+import abc
+
+from tpudash.schema import ChipKey, Sample
+
+
+class SourceError(RuntimeError):
+    """Raised by sources on fetch/parse failure.  The app catches this and
+    renders an error banner while continuing to poll — the reference's
+    `except Exception → st.error → (None, None)` path (app.py:225-227)."""
+
+
+class MetricsSource(abc.ABC):
+    """A provider of instant metric samples for the dashboard."""
+
+    name: str = "source"
+
+    @abc.abstractmethod
+    def fetch(self) -> list[Sample]:
+        """Return the current samples for every chip in scope.
+
+        Raises SourceError on failure.  Never returns partial garbage: a
+        source either yields a parseable sample list or raises.
+        """
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[Sample]:
+    """Parse a Prometheus ``/api/v1/query`` JSON payload into Samples.
+
+    Tolerates both TPU-native labels (chip_id/accelerator/slice/host) and
+    generic exporter labels; skips series without a parseable chip id or
+    value rather than failing the whole scrape (more forgiving than the
+    reference, whose single try/except drops the entire cycle on one bad
+    series, app.py:225-227).
+    """
+    if payload.get("status") != "success":
+        raise SourceError(f"prometheus status={payload.get('status')!r}")
+    try:
+        results = payload["data"]["result"]
+    except (KeyError, TypeError) as e:
+        raise SourceError(f"malformed prometheus payload: {e}") from e
+
+    samples: list[Sample] = []
+    for item in results:
+        metric = item.get("metric", {})
+        name = metric.get("__name__")
+        value = item.get("value")
+        if not name or not isinstance(value, (list, tuple)) or len(value) != 2:
+            continue
+        try:
+            val = float(value[1])
+        except (TypeError, ValueError):
+            continue
+        chip_label = metric.get("chip_id", metric.get("gpu_id"))
+        if chip_label is None:
+            continue
+        try:
+            chip_id = int(chip_label)
+        except (TypeError, ValueError):
+            continue
+        chip = ChipKey(
+            slice_id=metric.get("slice", default_slice),
+            host=metric.get("host", metric.get("instance", "")),
+            chip_id=chip_id,
+        )
+        samples.append(
+            Sample(
+                metric=name,
+                value=val,
+                chip=chip,
+                accelerator_type=metric.get(
+                    "accelerator", metric.get("card_model", "")
+                ),
+                labels=dict(metric),
+            )
+        )
+    return samples

@@ -1,0 +1,169 @@
+"""Figure builders (pure functions → plotly-JSON dicts).
+
+Gauge and bar reproduce the reference's two visualization styles with the
+shared 5-band color policy:
+- gauge: ``go.Indicator`` mode "gauge+number", linear ticks dtick=max/5,
+  colored value bar with 1-px black outline, 5 pastel background step bands,
+  tight margins (reference create_gauge, app.py:70-103);
+- bar: horizontal ``go.Bar`` width 0.5 with gray 2-px outline, x-range
+  clamped to [min,max], hidden y ticks, 5 translucent band rects layered
+  below (reference create_horizontal_bar, app.py:105-151).
+
+The topology heatmap is the TPU-native addition (SURVEY.md §7.4) that
+carries per-chip detail at 256-chip scale where one-figure-per-chip cannot
+(SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from tpudash.colors import band_steps, color_for_value
+from tpudash.topology import Topology, heatmap_grid
+
+
+def create_gauge(
+    value: float,
+    title: str,
+    min_val: float = 0.0,
+    max_val: float = 100.0,
+    height: int = 400,
+) -> dict:
+    bar_color = color_for_value(value, max_val)
+    return {
+        "data": [
+            {
+                "type": "indicator",
+                "mode": "gauge+number",
+                "value": value,
+                "title": {"text": title, "font": {"size": 16}},
+                "gauge": {
+                    "axis": {
+                        "range": [min_val, max_val],
+                        "dtick": (max_val - min_val) / 5 if max_val > min_val else 1,
+                        "tickwidth": 1,
+                    },
+                    "bar": {
+                        "color": bar_color,
+                        "line": {"color": "black", "width": 1},
+                    },
+                    "steps": band_steps(max_val),
+                },
+            }
+        ],
+        "layout": {
+            "height": height,
+            "margin": {"l": 30, "r": 30, "t": 0, "b": 0},
+        },
+    }
+
+
+def create_horizontal_bar(
+    value: float,
+    title: str,
+    min_val: float = 0.0,
+    max_val: float = 100.0,
+    height: int = 400,
+) -> dict:
+    bar_color = color_for_value(value, max_val)
+    shapes = [
+        {
+            "type": "rect",
+            "x0": step["range"][0],
+            "x1": step["range"][1],
+            "y0": -0.5,
+            "y1": 0.5,
+            "fillcolor": step["color"],
+            "opacity": 0.3,
+            "layer": "below",
+            "line": {"width": 0},
+        }
+        for step in band_steps(max_val)
+    ]
+    return {
+        "data": [
+            {
+                "type": "bar",
+                "orientation": "h",
+                "x": [value],
+                "y": [title],
+                "width": 0.5,
+                "marker": {
+                    "color": bar_color,
+                    "line": {"color": "gray", "width": 2},
+                },
+            }
+        ],
+        "layout": {
+            "title": {"text": title, "font": {"size": 16}},
+            "height": height,
+            "margin": {"l": 30, "r": 30, "t": 40, "b": 20},
+            "xaxis": {"range": [min_val, max_val]},
+            "yaxis": {"showticklabels": False},
+            "shapes": shapes,
+        },
+    }
+
+
+#: Colorscale for heatmaps, matching the 5-band policy's green→red ramp.
+_HEAT_COLORSCALE = [
+    [0.0, "#2ecc71"],
+    [0.2, "#2ecc71"],
+    [0.2, "#a3d977"],
+    [0.4, "#a3d977"],
+    [0.4, "#f1c40f"],
+    [0.6, "#f1c40f"],
+    [0.6, "#e67e22"],
+    [0.8, "#e67e22"],
+    [0.8, "#e74c3c"],
+    [1.0, "#e74c3c"],
+]
+
+
+def create_topology_heatmap(
+    topo: Topology,
+    values: dict[int, float],
+    title: str,
+    max_val: float = 100.0,
+    height: int = 480,
+    unit: str = "",
+) -> dict:
+    """Per-chip values on the slice's torus as one figure.
+
+    One heatmap replaces N gauges: a v5e-256 slice is a single 16×16 grid
+    (3D toruses unroll into Z-planes side by side).  Cell (x, y) is chip
+    (x, y) in torus coordinates; hover text carries chip id and value.
+    """
+    grid = heatmap_grid(topo, values)
+    ny = len(grid)
+    nx = len(grid[0]) if grid else 0
+
+    hover = [["" for _ in range(nx)] for _ in range(ny)]
+    for cid, v in values.items():
+        coords = topo.coords(cid)
+        x, y = coords[0], coords[1]
+        col = x if topo.rank == 2 else coords[2] * (topo.dims[0] + 1) + x
+        label = f"chip {cid} {tuple(coords)}<br>{v:.1f}{unit}"
+        hover[y][col] = label
+
+    return {
+        "data": [
+            {
+                "type": "heatmap",
+                "z": grid,
+                "zmin": 0,
+                "zmax": max_val,
+                "text": hover,
+                "hoverinfo": "text",
+                "colorscale": _HEAT_COLORSCALE,
+                "xgap": 2,
+                "ygap": 2,
+                "colorbar": {"title": {"text": unit}, "thickness": 12},
+            }
+        ],
+        "layout": {
+            "title": {"text": title, "font": {"size": 16}},
+            "height": height,
+            "margin": {"l": 40, "r": 20, "t": 40, "b": 30},
+            "xaxis": {"scaleanchor": "y", "constrain": "domain", "showgrid": False},
+            "yaxis": {"autorange": "reversed", "showgrid": False},
+        },
+    }
