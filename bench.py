@@ -1,0 +1,90 @@
+"""Benchmark — prints ONE JSON line.
+
+North-star metric (BASELINE.json): scrape→render p50 latency for a full
+dashboard frame over a 256-chip v5e pod slice, all chips selected.  The
+reference's implicit budget is its 5 s refresh cadence (reference app.py:24,
+486): a frame must complete well inside it.  ``vs_baseline`` is therefore
+(5 s budget) / (measured p50) — how many frames we could render per refresh
+window (>1 beats the baseline; the reference's per-device-figure design
+could not hold the budget at 256 chips, SURVEY.md §3.2).
+
+When real accelerator hardware is present, on-chip probe numbers
+(achieved matmul TFLOP/s, HBM streaming GB/s) are attached as extra keys.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+BUDGET_S = 5.0  # the reference's refresh cadence == our frame budget
+N_CHIPS = 256
+N_FRAMES = 30
+
+
+def bench_dashboard() -> dict:
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(source="synthetic", synthetic_chips=N_CHIPS)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=N_CHIPS, generation="v5e"))
+    svc.render_frame()  # warm (imports, first pivot)
+    svc.state.select_all(svc.available)
+    for _ in range(N_FRAMES):
+        frame = svc.render_frame()
+        assert frame["error"] is None
+        assert len(frame["selected"]) == N_CHIPS
+        assert frame["heatmaps"], "256-chip frame must use heatmap mode"
+    p50 = svc.timer.percentile(0.5)
+    p95 = svc.timer.percentile(0.95)
+    return {"p50_s": p50, "p95_s": p95}
+
+
+def bench_probes() -> dict:
+    try:
+        import jax
+
+        from tpudash.ops.probes import (
+            device_info,
+            hbm_bandwidth_probe,
+            matmul_flops_probe,
+        )
+
+        info = device_info()
+        if info["platform"] not in ("tpu",):
+            return {"platform": info["platform"]}
+        mm = matmul_flops_probe(size=4096, iters=16)
+        hbm = hbm_bandwidth_probe(mb=512)
+        return {
+            "platform": info["platform"],
+            "device_kind": info["device_kind"],
+            "matmul_bf16_tflops": round(mm.value, 2),
+            "hbm_stream_gbps": round(hbm.value, 1),
+        }
+    except Exception as e:  # bench must still report the headline number
+        return {"probe_error": str(e)}
+
+
+def main() -> None:
+    t0 = time.time()
+    dash = bench_dashboard()
+    probes = bench_probes()
+    p50 = dash["p50_s"]
+    result = {
+        "metric": f"scrape_to_render_p50_at_{N_CHIPS}_chips",
+        "value": round(p50 * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(BUDGET_S / p50, 1),
+        "p95_ms": round(dash["p95_s"] * 1e3, 2),
+        "frames": N_FRAMES,
+        "budget_s": BUDGET_S,
+        "probes": probes,
+        "bench_wall_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,17 +2,23 @@
 
 Tests never touch the real TPU: JAX runs on a virtual 8-device CPU platform
 (so Mesh/pjit/collective paths are exercised exactly as they would be on an
-8-chip slice).  Must run before anything imports jax.
+8-chip slice).  The ambient environment on a TPU host pins
+JAX_PLATFORMS to the accelerator plugin and ignores a plain env override,
+so we force the platform through jax.config before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

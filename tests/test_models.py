@@ -1,0 +1,115 @@
+"""Demo-workload model tests: forward correctness properties + sharded
+train step over the virtual 8-device mesh (dp=2 × tp=4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudash.models.workload import (
+    WorkloadConfig,
+    flops_per_step,
+    forward,
+    init_params,
+    loss_fn,
+    make_sharded_train_step,
+    make_train_state,
+    param_shardings,
+)
+from tpudash.parallel.mesh import build_mesh
+
+CFG = WorkloadConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64, seq=16, batch=4
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _tokens(batch=CFG.batch, seq=CFG.seq, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (batch, seq), 0, CFG.vocab)
+
+
+def test_forward_shapes(params):
+    logits = forward(params, _tokens(), CFG)
+    assert logits.shape == (CFG.batch, CFG.seq, CFG.vocab)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_forward_is_causal(params):
+    """Changing a future token must not change past logits."""
+    t1 = _tokens(batch=1)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % CFG.vocab)
+    l1 = forward(params, t1, CFG)
+    l2 = forward(params, t2, CFG)
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], rtol=1e-5)
+    assert not np.allclose(l1[0, -1], l2[0, -1])
+
+
+def test_loss_finite_and_near_uniform_at_init(params):
+    loss = loss_fn(params, _tokens(), CFG)
+    assert bool(jnp.isfinite(loss))
+    # 0.02-scale init ≈ uniform predictive distribution → loss ≈ ln(vocab)
+    assert abs(float(loss) - np.log(CFG.vocab)) < 1.0
+
+
+def test_train_step_decreases_loss_single_device():
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), CFG)
+    tokens = _tokens()
+    from tpudash.models.workload import train_step
+
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = jax.jit(
+            lambda p, o, t: train_step(p, o, t, CFG)
+        )(params, opt_state, tokens)
+    losses.append(float(loss))
+    first = float(loss_fn(init_params(jax.random.PRNGKey(0), CFG), tokens, CFG))
+    assert losses[-1] < first  # memorizing one batch must reduce loss
+
+
+def test_sharded_train_step_dp2_tp4():
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), CFG)
+    step, shard_inputs = make_sharded_train_step(mesh, CFG)
+    tokens = _tokens()
+    params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
+    params2, opt_state2, loss = step(params, opt_state, tokens)
+    assert bool(jnp.isfinite(loss))
+    # params stay tp-sharded after the step
+    wqkv_sharding = params2["blocks"]["wqkv"].sharding
+    assert "tp" in str(wqkv_sharding.spec)
+
+
+def test_sharded_matches_unsharded_loss():
+    """dp×tp sharding must not change the math."""
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), CFG)
+    tokens = _tokens()
+
+    from tpudash.models.workload import train_step
+
+    _, _, loss_ref = jax.jit(lambda p, o, t: train_step(p, o, t, CFG))(
+        jax.tree.map(jnp.copy, params),
+        jax.tree.map(jnp.copy, opt_state),
+        tokens,
+    )
+
+    step, shard_inputs = make_sharded_train_step(mesh, CFG)
+    sp, so, st = shard_inputs(params, opt_state, tokens)
+    _, _, loss_sharded = step(sp, so, st)
+    np.testing.assert_allclose(float(loss_ref), float(loss_sharded), rtol=1e-4)
+
+
+def test_param_shardings_tree_matches_params(params):
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    shardings = param_shardings(mesh)
+    # same tree structure → device_put works leaf-wise
+    jax.tree.map(lambda a, b: None, params, shardings)
+
+
+def test_flops_estimate_positive():
+    assert flops_per_step(CFG) > 0

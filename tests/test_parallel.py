@@ -1,0 +1,61 @@
+"""Mesh + collective probe tests over the virtual 8-device CPU mesh."""
+
+import jax
+import pytest
+
+from tpudash.parallel.collectives import (
+    all_gather_bandwidth_probe,
+    ppermute_ring_bandwidth_probe,
+    psum_latency_probe,
+)
+from tpudash.parallel.mesh import build_mesh, mesh_axes_for
+
+
+def test_mesh_axes_factorization():
+    assert mesh_axes_for(8) == {"dp": 1, "tp": 8}
+    assert mesh_axes_for(16) == {"dp": 2, "tp": 8}
+    assert mesh_axes_for(4) == {"dp": 1, "tp": 4}
+    assert mesh_axes_for(6) == {"dp": 3, "tp": 2}
+    assert mesh_axes_for(1) == {"dp": 1, "tp": 1}
+
+
+def test_build_mesh_default():
+    mesh = build_mesh()
+    assert mesh.devices.size == 8
+    assert set(mesh.axis_names) == {"dp", "tp"}
+
+
+def test_build_mesh_explicit_axes():
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+
+
+def test_build_mesh_wrong_product():
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 3, "tp": 3})
+
+
+def test_ppermute_ring_probe():
+    mesh = build_mesh({"tp": 8})
+    r = ppermute_ring_bandwidth_probe(mesh, "tp", mb_per_device=1, steps=2)
+    assert r.value > 0
+    assert r.detail["devices"] == 8
+
+
+def test_all_gather_probe():
+    mesh = build_mesh({"tp": 8})
+    r = all_gather_bandwidth_probe(mesh, "tp", mb_per_device=1)
+    assert r.value > 0
+
+
+def test_psum_latency_probe():
+    mesh = build_mesh({"tp": 8})
+    r = psum_latency_probe(mesh, "tp")
+    assert r.value > 0  # microseconds
+    assert r.detail["unit"] == "us"
+
+
+def test_probes_on_sub_axis_of_2d_mesh():
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    r = ppermute_ring_bandwidth_probe(mesh, "tp", mb_per_device=1, steps=1)
+    assert r.detail["devices"] == 4

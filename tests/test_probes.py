@@ -1,0 +1,46 @@
+"""On-chip probe tests (CPU backend; numbers are meaningless, machinery is
+exercised exactly as on TPU)."""
+
+import jax
+
+from tpudash.ops.probes import (
+    device_info,
+    hbm_bandwidth_probe,
+    hbm_memory_stats,
+    matmul_flops_probe,
+)
+
+
+def test_device_info():
+    info = device_info()
+    assert info["platform"] == "cpu"
+    assert info["num_local_devices"] == 8  # virtual mesh from conftest
+
+
+def test_matmul_probe_runs_and_is_positive():
+    r = matmul_flops_probe(size=256, iters=2)
+    assert r.value > 0
+    assert r.elapsed_s > 0
+    assert r.detail["size"] == 256
+
+
+def test_matmul_probe_rounds_size_up():
+    r = matmul_flops_probe(size=100, iters=1)
+    assert r.detail["size"] == 256  # MXU-friendly multiple of 256
+
+
+def test_hbm_probe_runs_interpret_on_cpu():
+    r = hbm_bandwidth_probe(mb=4, block_rows=256)
+    assert r.value > 0
+    assert r.detail["mb"] == 4
+
+
+def test_hbm_memory_stats_shape():
+    stats = hbm_memory_stats()
+    assert set(stats) == {"used_bytes", "total_bytes"}
+    assert stats["used_bytes"] >= 0
+
+
+def test_memory_stats_specific_device():
+    stats = hbm_memory_stats(jax.local_devices()[-1])
+    assert stats["total_bytes"] >= 0

@@ -1,0 +1,172 @@
+"""Single-chip probes: MXU throughput, HBM bandwidth, HBM occupancy.
+
+Design notes (TPU-first):
+- The MXU probe is a chain of large bf16 matmuls under one jit — static
+  shapes, no host round-trips inside the loop (lax.fori_loop), so XLA tiles
+  the whole chain onto the MXU.  Achieved TFLOP/s ÷ the generation's peak
+  gives the TensorCore-utilization % the dashboard displays.
+- The HBM probe is a Pallas grid kernel streaming a large buffer through
+  VMEM (read + write ≈ 2× traffic); on non-TPU backends it runs in
+  interpret mode so tests stay cluster-free.
+
+Timing methodology: on tunneled/async device platforms,
+``block_until_ready`` can return at dispatch time, and any single
+measurement includes a fixed host↔device round-trip.  Every probe therefore
+(a) reduces its result to a scalar fetched to the host — a true completion
+barrier — and (b) measures at two work multiples and uses the DELTA, which
+cancels the fixed round-trip overhead:
+
+    value = extra_work / (t(k2) - t(k1))
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_MIN_DELTA_S = 1e-5  # guard against clock noise producing absurd rates
+
+
+def _dev() -> jax.Device:
+    return jax.local_devices()[0]
+
+
+def device_info() -> dict:
+    """Platform/device identity for labels (the probe-source analogue of the
+    reference's card_model label, app.py:191-201)."""
+    d = _dev()
+    return {
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", str(d)),
+        "num_local_devices": jax.local_device_count(),
+    }
+
+
+@dataclass(frozen=True)
+class ProbeResult:
+    value: float      # headline number (TFLOP/s or GB/s or µs)
+    elapsed_s: float  # wall seconds of the larger timed run
+    detail: dict
+
+
+def _timed_scalar(fn, *args, trials: int = 2) -> float:
+    """Best-of-N wall time of fn(*args) where fn returns a scalar jax array;
+    float() forces a device→host readback (true completion barrier)."""
+    float(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# --- MXU throughput ---------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _matmul_chain_sum(x: jax.Array, w: jax.Array, iters: int) -> jax.Array:
+    """iters dependent matmuls; data dependence defeats CSE/folding; scalar
+    output forces completion when fetched."""
+
+    def body(_, acc):
+        return jnp.dot(acc, w, preferred_element_type=jnp.bfloat16)
+
+    return jnp.sum(lax.fori_loop(0, iters, body, x).astype(jnp.float32))
+
+
+def matmul_flops_probe(size: int = 2048, iters: int = 8, dtype=jnp.bfloat16) -> ProbeResult:
+    """Achieved matmul TFLOP/s on the local chip (delta-timed).
+
+    size is rounded up to an MXU-friendly multiple of 256; measured at
+    ``iters`` and ``3·iters`` chained (size×size) matmuls — 2·size³ FLOPs
+    each — and rated on the difference.
+    """
+    size = max(256, (size + 255) // 256 * 256)
+    iters = max(1, iters)
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (size, size), dtype=dtype)
+    # small weights keep the chain numerically tame over many iterations
+    w = jax.random.normal(kw, (size, size), dtype=dtype) * (size**-0.5)
+
+    t1 = _timed_scalar(_matmul_chain_sum, x, w, iters)
+    t2 = _timed_scalar(_matmul_chain_sum, x, w, 3 * iters)
+    dt = max(t2 - t1, _MIN_DELTA_S)
+    flops = 2.0 * size**3 * (2 * iters)
+    return ProbeResult(
+        value=flops / dt / 1e12,
+        elapsed_s=t2,
+        detail={"size": size, "iters": iters, "dtype": jnp.dtype(dtype).name},
+    )
+
+
+# --- HBM bandwidth (Pallas) -------------------------------------------------
+
+def _copy_kernel(in_ref, out_ref):
+    out_ref[:] = in_ref[:]
+
+
+def _hbm_stream_once(x: jax.Array, block_rows: int):
+    from jax.experimental import pallas as pl
+
+    rows, cols = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        interpret=jax.default_backend() != "tpu",
+    )(x)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "repeats"))
+def _hbm_stream_sum(x: jax.Array, block_rows: int, repeats: int) -> jax.Array:
+    def body(_, acc):
+        return _hbm_stream_once(acc, block_rows)
+
+    return jnp.sum(lax.fori_loop(0, repeats, body, x)[0, :8])
+
+
+def hbm_bandwidth_probe(mb: int = 256, block_rows: int = 1024) -> ProbeResult:
+    """Achieved HBM streaming bandwidth (GB/s), counting read + write.
+
+    Buffer is (rows, 1024) float32 sized to ``mb`` MiB, streamed block-wise
+    through VMEM (block_rows×1024×4B = 4 MiB/block by default, well under
+    the ~16 MiB VMEM budget); delta-timed at 1 vs 3 passes.
+    """
+    cols = 1024
+    rows = max(block_rows, (mb * 1024 * 1024) // (cols * 4))
+    rows = (rows // block_rows) * block_rows
+    x = jnp.ones((rows, cols), jnp.float32)
+
+    t1 = _timed_scalar(_hbm_stream_sum, x, block_rows, 1)
+    t2 = _timed_scalar(_hbm_stream_sum, x, block_rows, 3)
+    dt = max(t2 - t1, _MIN_DELTA_S)
+    nbytes = x.size * 4
+    return ProbeResult(
+        value=2.0 * nbytes * 2 / dt / 1e9,  # 2 extra passes × (read+write)
+        elapsed_s=t2,
+        detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows},
+    )
+
+
+# --- HBM occupancy ----------------------------------------------------------
+
+def hbm_memory_stats(device: "jax.Device | None" = None) -> dict:
+    """Allocator view of one device's HBM: {used_bytes, total_bytes} — the
+    probe-source feed for the tpu_hbm_* series.  Backends without
+    memory_stats (CPU) return zeros; callers treat 0 total as "unknown"."""
+    dev = device if device is not None else _dev()
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:  # some backends raise instead of returning None
+        stats = {}
+    return {
+        "used_bytes": float(stats.get("bytes_in_use", 0)),
+        "total_bytes": float(stats.get("bytes_limit", 0)),
+    }

@@ -1,0 +1,16 @@
+"""Device-mesh utilities and ICI collective probes.
+
+The reference has no distributed backend at all (SURVEY.md §2: its only IPC
+is HTTP GET to Prometheus).  The TPU-native equivalent of its "inter-device"
+story is observational (ICI/DCN bandwidth series) — but to *measure* those
+we need real collectives over a jax Mesh, and the demo workload
+(tpudash.models) trains sharded over the same mesh.  Everything here works
+identically on a virtual 8-device CPU mesh (tests) and a real slice.
+"""
+
+from tpudash.parallel.mesh import build_mesh, mesh_axes_for  # noqa: F401
+from tpudash.parallel.collectives import (  # noqa: F401
+    all_gather_bandwidth_probe,
+    ppermute_ring_bandwidth_probe,
+    psum_latency_probe,
+)

@@ -109,7 +109,7 @@ class Sample:
 class PanelSpec:
     title: str           # per-chip panel title; avg row prefixes "Avg "
     column: str          # wide-table column to display
-    max_policy: str      # "fixed" | "power" | "hbm" | "ici"
+    max_policy: str      # "fixed" | "power" | "hbm" | "ici" | "hbm_bw"
     fixed_max: float = 100.0
     unit: str = "%"
 
@@ -121,9 +121,15 @@ PANELS: tuple[PanelSpec, ...] = (
     PanelSpec("Power Usage (W)", POWER, "power", 300.0, "W"),
 )
 
+#: Achieved HBM streaming bandwidth, GB/s — emitted by the on-chip probe
+#: source (tpudash.sources.probe), not by cluster exporters.
+HBM_BANDWIDTH = "tpu_hbm_bandwidth_gbps"
+
 #: Extra TPU-native panels (beyond the reference's four) shown when the
-#: source provides the series: aggregate ICI and DCN bandwidth.
+#: source provides the series: aggregate ICI/DCN bandwidth and probe-mode
+#: HBM bandwidth.
 EXTRA_PANELS: tuple[PanelSpec, ...] = (
     PanelSpec("ICI Bandwidth (GB/s)", ICI_TOTAL_GBPS, "ici", 200.0, "GB/s"),
     PanelSpec("DCN Bandwidth (GB/s)", DCN_TOTAL_GBPS, "fixed", 50.0, "GB/s"),
+    PanelSpec("HBM Bandwidth (GB/s)", HBM_BANDWIDTH, "hbm_bw", 1000.0, "GB/s"),
 )

@@ -1,0 +1,142 @@
+"""Live on-chip probe source.
+
+Turns local JAX probes into the same Sample stream the Prometheus source
+produces, so the dashboard can monitor the chip it is running on with zero
+cluster infrastructure (BASELINE.json configs[1]: "single TPU VM: libtpu
+metrics → local Prometheus" — here without even the Prometheus hop):
+
+- tpu_tensorcore_utilization  ← achieved/peak bf16 TFLOP/s (MXU probe)
+- tpu_hbm_used/total_bytes    ← allocator memory stats (falls back to the
+                                generation's capacity for the total)
+- tpu_hbm_bandwidth_gbps      ← Pallas streaming probe (extra series)
+- tpu_ici_tx/rx_bytes_per_second ← ring / all-gather collective probes
+                                   (multi-device hosts only)
+
+Probe cost is bounded by config (sizes/iters) and heavyweight probes run at
+most once per ``probe_heavy_interval`` seconds — in between, the last
+measurement is re-emitted (hardware counters vs. sampling cadence being the
+classic exporter trade-off).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from tpudash.config import Config
+from tpudash.registry import TPU_GENERATIONS, resolve_generation
+from tpudash.schema import (
+    HBM_BANDWIDTH,
+    HBM_TOTAL,
+    HBM_USED,
+    ICI_RX,
+    ICI_TX,
+    TENSORCORE_UTIL,
+    ChipKey,
+    Sample,
+)
+from tpudash.sources.base import MetricsSource, SourceError
+
+
+def _generation_for_device(dev) -> str | None:
+    kind = getattr(dev, "device_kind", "") or ""
+    low = kind.lower().replace(" ", "")
+    if "v5lite" in low or "v5e" in low:
+        return "v5e"
+    if "v5p" in low or "v5" == low[-2:]:
+        return "v5p"
+    if "v6" in low:
+        return "v6e"
+    if "v4" in low:
+        return "v4"
+    return None
+
+
+class ProbeSource(MetricsSource):
+    name = "probe"
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.matmul_size = int(cfg.extra.get("probe_matmul_size", 2048))
+        self.matmul_iters = int(cfg.extra.get("probe_matmul_iters", 8))
+        self.hbm_mb = int(cfg.extra.get("probe_hbm_mb", 64))
+        self.ici_mb = int(cfg.extra.get("probe_ici_mb", 16))
+        self.heavy_interval = float(cfg.extra.get("probe_heavy_interval", 30.0))
+        self._last_heavy: float = 0.0
+        self._cache: dict[str, float] = {}
+
+    # -- probes --------------------------------------------------------------
+    def _run_heavy_probes(self) -> None:
+        from tpudash.ops.probes import hbm_bandwidth_probe, matmul_flops_probe
+
+        mm = matmul_flops_probe(self.matmul_size, self.matmul_iters)
+        self._cache["tflops"] = mm.value
+        hbm = hbm_bandwidth_probe(self.hbm_mb)
+        self._cache["hbm_gbps"] = hbm.value
+
+        if jax.local_device_count() > 1:
+            from tpudash.parallel.collectives import (
+                all_gather_bandwidth_probe,
+                ppermute_ring_bandwidth_probe,
+            )
+            from tpudash.parallel.mesh import build_mesh
+
+            # local devices only: in multi-process runtimes jax.devices() is
+            # global and would not match local_device_count
+            mesh = build_mesh(
+                {"tp": jax.local_device_count()}, devices=jax.local_devices()
+            )
+            tx = ppermute_ring_bandwidth_probe(mesh, "tp", self.ici_mb)
+            rx = all_gather_bandwidth_probe(mesh, "tp", self.ici_mb)
+            self._cache["ici_tx"] = tx.value * 1e9
+            self._cache["ici_rx"] = rx.value * 1e9
+
+    def fetch(self):
+        try:
+            devices = jax.local_devices()
+        except Exception as e:  # jax init failure
+            raise SourceError(f"jax unavailable: {e}") from e
+        if not devices:
+            raise SourceError("no local jax devices")
+
+        now = time.monotonic()
+        if now - self._last_heavy >= self.heavy_interval or not self._cache:
+            try:
+                self._run_heavy_probes()
+            except Exception as e:
+                raise SourceError(f"probe failed: {e}") from e
+            self._last_heavy = now
+
+        from tpudash.ops.probes import hbm_memory_stats
+
+        dev = devices[0]
+        gen_name = _generation_for_device(dev) or self.cfg.generation
+        gen = resolve_generation(gen_name) or TPU_GENERATIONS["v5e"]
+        accel = gen.accelerator_types[0]
+        host = "localhost"
+        samples: list[Sample] = []
+
+        def emit(metric: str, chip_id: int, value: float) -> None:
+            samples.append(
+                Sample(
+                    metric=metric,
+                    value=value,
+                    chip=ChipKey(slice_id="local", host=host, chip_id=chip_id),
+                    accelerator_type=accel,
+                )
+            )
+
+        util_pct = min(100.0, self._cache["tflops"] / gen.peak_bf16_tflops * 100.0)
+
+        for i, d in enumerate(devices):
+            mem = hbm_memory_stats(d)
+            hbm_total = mem["total_bytes"] or gen.hbm_gib * 1024**3
+            emit(TENSORCORE_UTIL, i, util_pct)
+            emit(HBM_USED, i, mem["used_bytes"])
+            emit(HBM_TOTAL, i, hbm_total)
+            emit(HBM_BANDWIDTH, i, self._cache["hbm_gbps"])
+            if "ici_tx" in self._cache:
+                emit(ICI_TX, i, self._cache["ici_tx"])
+                emit(ICI_RX, i, self._cache["ici_rx"])
+        return samples

@@ -6,7 +6,7 @@ model's TDP resolved through the board-ID→model→TDP tables.  Differences
 here, per SURVEY.md §7.5 and the documented reference quirks:
 
 - axis-max resolution is a declared per-panel policy (schema.PanelSpec:
-  "fixed" | "power" | "hbm" | "ici") instead of string-matching the panel
+  "fixed" | "power" | "hbm" | "ici" | "hbm_bw") instead of string-matching the panel
   title on ``"Power Usage (W)"`` (app.py:237);
 - the lookup goes through registry.power_limit_for — the reference's
   get_power_limit was dead code re-implemented inline (app.py:229-232 vs
@@ -52,6 +52,11 @@ def panel_max(
             if gen:
                 # aggregate tx+rx ceiling across the chip's links
                 limits.append(2 * gen.ici_links_per_chip * gen.ici_link_gbps)
+        return max(limits) if limits else spec.fixed_max
+    if spec.max_policy == "hbm_bw":
+        limits = [
+            gen.hbm_gbps for a in accel_types if (gen := resolve_generation(a))
+        ]
         return max(limits) if limits else spec.fixed_max
     return spec.fixed_max
 
