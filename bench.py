@@ -32,6 +32,7 @@ def bench_dashboard() -> dict:
     svc = DashboardService(cfg, SyntheticSource(num_chips=N_CHIPS, generation="v5e"))
     svc.render_frame()  # warm (imports, first pivot)
     svc.state.select_all(svc.available)
+    svc.timer.history.clear()  # warm-up frame must not contaminate p50/p95
     for _ in range(N_FRAMES):
         frame = svc.render_frame()
         assert frame["error"] is None
@@ -56,7 +57,7 @@ def bench_probes() -> dict:
         if info["platform"] not in ("tpu",):
             return {"platform": info["platform"]}
         mm = matmul_flops_probe(size=4096, iters=16)
-        hbm = hbm_bandwidth_probe(mb=512)
+        hbm = hbm_bandwidth_probe(mb=512, k2=9)
         return {
             "platform": info["platform"],
             "device_kind": info["device_kind"],

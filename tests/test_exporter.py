@@ -1,0 +1,175 @@
+"""Exporter tests: text-format round-trip, HTTP endpoint, scrape source."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.exporter.server import ExporterServer
+from tpudash.exporter.textfmt import (
+    TextFormatError,
+    encode_samples,
+    parse_text_format,
+)
+from tpudash.schema import ChipKey, Sample
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.fixture import SyntheticSource
+from tpudash.sources.scrape import ScrapeSource
+
+
+def _samples():
+    return SyntheticSource(num_chips=4, generation="v5e").fetch()
+
+
+# --- text format ------------------------------------------------------------
+
+def test_encode_has_help_type_and_series():
+    text = encode_samples(_samples())
+    assert "# HELP tpu_tensorcore_utilization" in text
+    assert "# TYPE tpu_tensorcore_utilization gauge" in text
+    assert 'chip_id="0"' in text
+    assert 'slice="slice-0"' in text
+    assert 'accelerator="tpu-v5-lite-podslice"' in text
+
+
+def test_roundtrip_preserves_samples():
+    original = _samples()
+    parsed = parse_text_format(encode_samples(original))
+    assert len(parsed) == len(original)
+    orig = {(s.metric, s.chip.key): s for s in original}
+    for s in parsed:
+        o = orig[(s.metric, s.chip.key)]
+        assert s.value == pytest.approx(o.value, rel=1e-9)
+        assert s.chip == o.chip
+        assert s.accelerator_type == o.accelerator_type
+
+
+def test_label_escaping_roundtrip():
+    s = Sample(
+        metric="tpu_power_watts",
+        value=1.5,
+        chip=ChipKey(slice_id='we"ird\\sl\nice', host="h", chip_id=0),
+        accelerator_type="v5e",
+    )
+    (parsed,) = parse_text_format(encode_samples([s]))
+    assert parsed.chip.slice_id == 'we"ird\\sl\nice'
+
+
+def test_parse_skips_unlabeled_and_bad_lines():
+    text = (
+        "# comment\n"
+        "\n"
+        "process_cpu_seconds_total 1.5\n"            # no labels → skipped
+        'tpu_power_watts{chip_id="0"} 5.0\n'
+        'tpu_power_watts{chip_id="x"} 5.0\n'          # bad chip id → skipped
+        'tpu_power_watts{chip_id="1"} not_a_number\n'  # bad value → skipped
+        'tpu_power_watts{chip_id="2"} NaN\n'           # non-finite → skipped
+    )
+    samples = parse_text_format(text)
+    assert [s.chip.chip_id for s in samples] == [0]
+
+
+def test_parse_accepts_legacy_gpu_labels():
+    (s,) = parse_text_format('amd_gpu_power{gpu_id="3",card_model="x"} 7\n')
+    assert s.chip.chip_id == 3
+    assert s.accelerator_type == "x"
+
+
+def test_parse_malformed_labels_raise():
+    with pytest.raises(TextFormatError):
+        parse_text_format('tpu_power_watts{chip_id=0} 5.0\n')  # unquoted
+
+
+# --- exporter HTTP ----------------------------------------------------------
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_metrics_endpoint_serves_text():
+    app = ExporterServer(SyntheticSource(num_chips=4)).build_app()
+
+    async def go(client):
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        text = await resp.text()
+        assert "tpu_tensorcore_utilization{" in text
+        health = await (await client.get("/healthz")).json()
+        assert health["ok"] is True
+
+    _run(_with_client(app, go))
+
+
+def test_metrics_endpoint_503_on_probe_failure():
+    class Boom(MetricsSource):
+        name = "boom"
+
+        def fetch(self):
+            raise SourceError("no chip")
+
+    app = ExporterServer(Boom()).build_app()
+
+    async def go(client):
+        resp = await client.get("/metrics")
+        assert resp.status == 503
+        health = await (await client.get("/healthz")).json()
+        assert "no chip" in health["error"]
+
+    _run(_with_client(app, go))
+
+
+# --- scrape source ----------------------------------------------------------
+
+class _FakeResp:
+    def __init__(self, text, status=200):
+        self.text = text
+        self.status = status
+
+    def raise_for_status(self):
+        if self.status >= 400:
+            import requests
+
+            raise requests.HTTPError(f"{self.status}")
+
+
+class _FakeSession:
+    def __init__(self, text, status=200):
+        self._text, self._status = text, status
+
+    def get(self, url, timeout=None):
+        return _FakeResp(self._text, self._status)
+
+    def close(self):
+        pass
+
+
+def test_scrape_source_roundtrip():
+    text = encode_samples(_samples())
+    src = ScrapeSource(Config(source="scrape"), session=_FakeSession(text))
+    samples = src.fetch()
+    assert len(samples) == len(_samples())
+    assert {s.metric for s in samples} >= {schema.TENSORCORE_UTIL, schema.POWER}
+
+
+def test_scrape_source_empty_exposition_raises():
+    src = ScrapeSource(Config(), session=_FakeSession("# nothing here\n"))
+    with pytest.raises(SourceError):
+        src.fetch()
+
+
+def test_scrape_source_http_error_raises():
+    src = ScrapeSource(Config(), session=_FakeSession("", status=500))
+    with pytest.raises(SourceError):
+        src.fetch()

@@ -59,6 +59,11 @@ class Config:
     #: Dashboard server bind.
     host: str = "0.0.0.0"
     port: int = 8050
+    #: Node-exporter bind port (python -m tpudash.exporter).
+    exporter_port: int = 9100
+    #: /metrics URL for source="scrape" (direct exporter consumption,
+    #: no Prometheus server in between).
+    scrape_url: str = "http://localhost:9100/metrics"
     #: Above this many selected chips the per-chip gauge rows collapse into
     #: the topology heatmap (the reference's O(N) figure wall, SURVEY §3.2).
     per_chip_panel_limit: int = 16
@@ -82,6 +87,8 @@ _ENV_MAP = {
     "series_selector": "TPUDASH_SERIES_SELECTOR",
     "host": "TPUDASH_HOST",
     "port": "TPUDASH_PORT",
+    "exporter_port": "TPUDASH_EXPORTER_PORT",
+    "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
 }
 

@@ -1,0 +1,72 @@
+"""Exporter HTTP server — ``/metrics`` in Prometheus exposition format.
+
+Deployment shape matches the node exporter the reference scrapes
+(reference app.py:167-176 consumes amd_gpu_* from such an endpoint): run
+one exporter per TPU host, point a Prometheus scrape config (or a tpudash
+``scrape`` source directly) at it.
+
+    python -m tpudash.exporter         # serves :9100/metrics from probes
+
+The underlying source is shared, so concurrent scrapes serialize on one
+probe run; heavy probes are already interval-cached inside ProbeSource.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from aiohttp import web
+
+from tpudash.config import Config, load_config
+from tpudash.exporter.textfmt import encode_samples
+from tpudash.sources import make_source
+from tpudash.sources.base import MetricsSource, SourceError
+
+
+class ExporterServer:
+    def __init__(self, source: MetricsSource):
+        self.source = source
+        self._lock = asyncio.Lock()
+        self.last_error: str | None = None
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            loop = asyncio.get_running_loop()
+            try:
+                samples = await loop.run_in_executor(None, self.source.fetch)
+            except SourceError as e:
+                self.last_error = str(e)
+                # 503 keeps Prometheus' `up` metric honest for this target
+                raise web.HTTPServiceUnavailable(text=f"probe failed: {e}")
+        self.last_error = None
+        return web.Response(
+            text=encode_samples(samples),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "source": self.source.name, "error": self.last_error}
+        )
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/healthz", self.healthz)
+        return app
+
+
+def make_app(cfg: Config | None = None) -> web.Application:
+    cfg = cfg or load_config()
+    # exporters default to the on-chip probe source — exporting what this
+    # host's chips are doing is the whole point
+    if cfg.source == "prometheus":
+        cfg = dataclasses.replace(cfg, source="probe")
+    return ExporterServer(make_source(cfg)).build_app()
+
+
+def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    cfg = cfg or load_config()
+    web.run_app(make_app(cfg), host=cfg.host, port=cfg.exporter_port)

@@ -1,0 +1,148 @@
+"""Prometheus text exposition format: encode and parse.
+
+Encoding emits the standard ``# HELP`` / ``# TYPE`` headers and one
+``name{labels} value`` line per sample, with the TPU label model
+(chip_id/slice/host/accelerator — the labels parse_instant_query expects on
+the query side, tpudash.sources.base).  The parser accepts the same format
+back, so exporter and dashboard round-trip without a Prometheus server in
+between (the "scrape" source).
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudash.schema import ChipKey, Sample
+
+#: HELP strings for known series (unknown series get a generic line).
+_HELP: dict[str, str] = {
+    "tpu_tensorcore_utilization": "TensorCore duty cycle percent [0,100]",
+    "tpu_hbm_used_bytes": "High-bandwidth memory used, bytes",
+    "tpu_hbm_total_bytes": "High-bandwidth memory capacity, bytes",
+    "tpu_ici_tx_bytes_per_second": "Inter-chip interconnect transmit rate",
+    "tpu_ici_rx_bytes_per_second": "Inter-chip interconnect receive rate",
+    "tpu_dcn_tx_bytes_per_second": "Cross-slice network transmit rate",
+    "tpu_dcn_rx_bytes_per_second": "Cross-slice network receive rate",
+    "tpu_temperature_celsius": "Package temperature, degrees Celsius",
+    "tpu_power_watts": "Board power draw, watts",
+    "tpu_hbm_bandwidth_gbps": "Achieved HBM streaming bandwidth, GB/s",
+}
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def encode_samples(samples: list[Sample]) -> str:
+    """Samples → exposition text.  Series are grouped (HELP/TYPE emitted
+    once per metric name, in first-seen order); all series are gauges."""
+    by_metric: dict[str, list[Sample]] = {}
+    for s in samples:
+        by_metric.setdefault(s.metric, []).append(s)
+
+    lines: list[str] = []
+    for metric, group in by_metric.items():
+        lines.append(f"# HELP {metric} {_HELP.get(metric, 'tpudash series')}")
+        lines.append(f"# TYPE {metric} gauge")
+        for s in group:
+            labels = {
+                "chip_id": str(s.chip.chip_id),
+                "slice": s.chip.slice_id,
+                "host": s.chip.host,
+            }
+            if s.accelerator_type:
+                labels["accelerator"] = s.accelerator_type
+            label_str = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in labels.items()
+            )
+            lines.append(f"{metric}{{{label_str}}} {s.value:.10g}")
+    return "\n".join(lines) + "\n"
+
+
+class TextFormatError(ValueError):
+    pass
+
+
+def _parse_labels(body: str) -> dict:
+    """Parse the inside of {...}: k="v" pairs with escape handling."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        while i < n and body[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = body.find("=", i)
+        if eq < 0:
+            raise TextFormatError(f"malformed labels: {body!r}")
+        key = body[i:eq].strip()
+        if eq + 1 >= n or body[eq + 1] != '"':
+            raise TextFormatError(f"unquoted label value in {body!r}")
+        j = eq + 2
+        out: list[str] = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            j += 1
+        if j >= n:
+            raise TextFormatError(f"unterminated label value in {body!r}")
+        labels[key] = "".join(out)
+        i = j + 1
+    return labels
+
+
+def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]:
+    """Exposition text → Samples.  Lines without a parseable chip_id (or
+    gpu_id) label are skipped, mirroring parse_instant_query's tolerance."""
+    samples: list[Sample] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace < 0:
+            continue  # unlabeled series carry no chip identity — skip
+        close = line.rfind("}")
+        if close < brace:
+            raise TextFormatError(f"malformed series line: {line!r}")
+        name = line[:brace].strip()
+        labels = _parse_labels(line[brace + 1 : close])
+        rest = line[close + 1 :].split()
+        if not name or not rest:
+            continue
+        try:
+            value = float(rest[0])
+        except ValueError:
+            continue
+        if not math.isfinite(value):
+            continue
+        chip_label = labels.get("chip_id", labels.get("gpu_id"))
+        if chip_label is None:
+            continue
+        try:
+            chip_id = int(chip_label)
+        except ValueError:
+            continue
+        samples.append(
+            Sample(
+                metric=name,
+                value=value,
+                chip=ChipKey(
+                    slice_id=labels.get("slice", default_slice),
+                    host=labels.get("host", labels.get("instance", "")),
+                    chip_id=chip_id,
+                ),
+                accelerator_type=labels.get(
+                    "accelerator", labels.get("card_model", "")
+                ),
+                labels=labels,
+            )
+        )
+    return samples

@@ -79,12 +79,18 @@ def _matmul_chain_sum(x: jax.Array, w: jax.Array, iters: int) -> jax.Array:
     return jnp.sum(lax.fori_loop(0, iters, body, x).astype(jnp.float32))
 
 
-def matmul_flops_probe(size: int = 2048, iters: int = 8, dtype=jnp.bfloat16) -> ProbeResult:
-    """Achieved matmul TFLOP/s on the local chip (delta-timed).
+def matmul_flops_probe(
+    size: int = 2048,
+    iters: int = 8,
+    dtype=jnp.bfloat16,
+    device: "jax.Device | None" = None,
+) -> ProbeResult:
+    """Achieved matmul TFLOP/s on one chip (delta-timed).
 
     size is rounded up to an MXU-friendly multiple of 256; measured at
     ``iters`` and ``3·iters`` chained (size×size) matmuls — 2·size³ FLOPs
-    each — and rated on the difference.
+    each — and rated on the difference.  ``device`` selects which local
+    chip runs the probe (default: first).
     """
     size = max(256, (size + 255) // 256 * 256)
     iters = max(1, iters)
@@ -92,6 +98,8 @@ def matmul_flops_probe(size: int = 2048, iters: int = 8, dtype=jnp.bfloat16) -> 
     x = jax.random.normal(kx, (size, size), dtype=dtype)
     # small weights keep the chain numerically tame over many iterations
     w = jax.random.normal(kw, (size, size), dtype=dtype) * (size**-0.5)
+    if device is not None:
+        x, w = jax.device_put(x, device), jax.device_put(w, device)
 
     t1 = _timed_scalar(_matmul_chain_sum, x, w, iters)
     t2 = _timed_scalar(_matmul_chain_sum, x, w, 3 * iters)
@@ -132,26 +140,40 @@ def _hbm_stream_sum(x: jax.Array, block_rows: int, repeats: int) -> jax.Array:
     return jnp.sum(lax.fori_loop(0, repeats, body, x)[0, :8])
 
 
-def hbm_bandwidth_probe(mb: int = 256, block_rows: int = 1024) -> ProbeResult:
+def hbm_bandwidth_probe(
+    mb: int = 256,
+    block_rows: int = 1024,
+    k1: int = 1,
+    k2: int = 9,
+    device: "jax.Device | None" = None,
+) -> ProbeResult:
     """Achieved HBM streaming bandwidth (GB/s), counting read + write.
 
     Buffer is (rows, 1024) float32 sized to ``mb`` MiB, streamed block-wise
     through VMEM (block_rows×1024×4B = 4 MiB/block by default, well under
-    the ~16 MiB VMEM budget); delta-timed at 1 vs 3 passes.
+    the ~16 MiB VMEM budget); delta-timed at ``k1`` vs ``k2`` passes.  The
+    (k2-k1) contrast must represent several milliseconds of traffic or the
+    delta drowns in host↔device jitter — at 256 MiB × 8 extra passes ×
+    read+write ≈ 4 GiB, ~5 ms on a v5e.
     """
+    if k2 <= k1:
+        raise ValueError("k2 must exceed k1")
     cols = 1024
     rows = max(block_rows, (mb * 1024 * 1024) // (cols * 4))
     rows = (rows // block_rows) * block_rows
     x = jnp.ones((rows, cols), jnp.float32)
+    if device is not None:
+        x = jax.device_put(x, device)
 
-    t1 = _timed_scalar(_hbm_stream_sum, x, block_rows, 1)
-    t2 = _timed_scalar(_hbm_stream_sum, x, block_rows, 3)
+    t1 = _timed_scalar(_hbm_stream_sum, x, block_rows, k1)
+    t2 = _timed_scalar(_hbm_stream_sum, x, block_rows, k2)
     dt = max(t2 - t1, _MIN_DELTA_S)
     nbytes = x.size * 4
     return ProbeResult(
-        value=2.0 * nbytes * 2 / dt / 1e9,  # 2 extra passes × (read+write)
+        value=2.0 * nbytes * (k2 - k1) / dt / 1e9,  # (read+write) per pass
         elapsed_s=t2,
-        detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows},
+        detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows,
+                "k1": k1, "k2": k2},
     )
 
 

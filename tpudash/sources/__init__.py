@@ -23,6 +23,10 @@ def make_source(cfg) -> MetricsSource:
         return SyntheticSource(
             num_chips=cfg.synthetic_chips, generation=cfg.generation
         )
+    if kind == "scrape":
+        from tpudash.sources.scrape import ScrapeSource
+
+        return ScrapeSource(cfg)
     if kind == "probe":
         try:
             from tpudash.sources.probe import ProbeSource  # deferred: imports jax

@@ -59,8 +59,9 @@ class ProbeSource(MetricsSource):
     def __init__(self, cfg: Config):
         self.cfg = cfg
         self.matmul_size = int(cfg.extra.get("probe_matmul_size", 2048))
-        self.matmul_iters = int(cfg.extra.get("probe_matmul_iters", 8))
-        self.hbm_mb = int(cfg.extra.get("probe_hbm_mb", 64))
+        self.matmul_iters = int(cfg.extra.get("probe_matmul_iters", 16))
+        self.hbm_mb = int(cfg.extra.get("probe_hbm_mb", 256))
+        self.hbm_k2 = int(cfg.extra.get("probe_hbm_k2", 9))
         self.ici_mb = int(cfg.extra.get("probe_ici_mb", 16))
         self.heavy_interval = float(cfg.extra.get("probe_heavy_interval", 30.0))
         self._last_heavy: float = 0.0
@@ -70,10 +71,16 @@ class ProbeSource(MetricsSource):
     def _run_heavy_probes(self) -> None:
         from tpudash.ops.probes import hbm_bandwidth_probe, matmul_flops_probe
 
-        mm = matmul_flops_probe(self.matmul_size, self.matmul_iters)
-        self._cache["tflops"] = mm.value
-        hbm = hbm_bandwidth_probe(self.hbm_mb)
-        self._cache["hbm_gbps"] = hbm.value
+        # per-device placement: each chip gets its OWN measurement (a shared
+        # number would hide per-chip divergence, e.g. one chip saturated by
+        # another process)
+        for i, dev in enumerate(jax.local_devices()):
+            mm = matmul_flops_probe(
+                self.matmul_size, self.matmul_iters, device=dev
+            )
+            self._cache[f"tflops_{i}"] = mm.value
+            hbm = hbm_bandwidth_probe(self.hbm_mb, k2=self.hbm_k2, device=dev)
+            self._cache[f"hbm_gbps_{i}"] = hbm.value
 
         if jax.local_device_count() > 1:
             from tpudash.parallel.collectives import (
@@ -127,16 +134,20 @@ class ProbeSource(MetricsSource):
                 )
             )
 
-        util_pct = min(100.0, self._cache["tflops"] / gen.peak_bf16_tflops * 100.0)
-
         for i, d in enumerate(devices):
             mem = hbm_memory_stats(d)
             hbm_total = mem["total_bytes"] or gen.hbm_gib * 1024**3
+            util_pct = min(
+                100.0,
+                self._cache[f"tflops_{i}"] / gen.peak_bf16_tflops * 100.0,
+            )
             emit(TENSORCORE_UTIL, i, util_pct)
             emit(HBM_USED, i, mem["used_bytes"])
             emit(HBM_TOTAL, i, hbm_total)
-            emit(HBM_BANDWIDTH, i, self._cache["hbm_gbps"])
+            emit(HBM_BANDWIDTH, i, self._cache[f"hbm_gbps_{i}"])
             if "ici_tx" in self._cache:
+                # ring/all-gather are symmetric: every chip moves the same
+                # bytes, so the per-chip value is genuinely per-chip
                 emit(ICI_TX, i, self._cache["ici_tx"])
                 emit(ICI_RX, i, self._cache["ici_rx"])
         return samples
