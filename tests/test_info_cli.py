@@ -1,0 +1,40 @@
+"""Terminal CLI tests (tpudash.info)."""
+
+from tpudash import schema
+from tpudash.info import main, render_table
+from tpudash.normalize import compute_stats, to_wide
+from tpudash.sources.fixture import SyntheticSource
+
+
+def test_render_table_contents():
+    df = to_wide(SyntheticSource(num_chips=4).fetch())
+    out = render_table(df, compute_stats(df))
+    lines = out.splitlines()
+    assert "chip" in lines[0] and "MXU%" in lines[0]
+    assert any("slice-0/0" in ln for ln in lines)
+    assert any(ln.startswith("mean") for ln in lines)
+    assert any(ln.startswith("max") for ln in lines)
+    # 4 chips + 3 stats + header/separators
+    assert len(lines) == 2 + 4 + 1 + 3
+
+
+def test_render_table_multislice_includes_dcn():
+    df = to_wide(SyntheticSource(num_chips=2, num_slices=2).fetch())
+    out = render_table(df, compute_stats(df))
+    assert "DCN GB/s" in out
+    assert "slice-1/0" in out
+
+
+def test_main_one_shot(capsys):
+    rc = main(["--source", "synthetic", "--chips", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "slice-0/3" in out
+    assert "source=synthetic" in out
+
+
+def test_main_source_error(capsys, monkeypatch):
+    monkeypatch.setenv("TPUDASH_FIXTURE_PATH", "/nonexistent.json")
+    rc = main(["--source", "fixture"])
+    assert rc == 0
+    assert "error:" in capsys.readouterr().out

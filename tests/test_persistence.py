@@ -1,0 +1,97 @@
+"""UI-state persistence tests (checkpoint/resume the reference lacks)."""
+
+import json
+import os
+
+from tpudash.app.service import DashboardService
+from tpudash.app.state import SelectionState
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+AVAIL = [f"slice-0/{i}" for i in range(4)]
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "state.json")
+    s = SelectionState()
+    s.set_selected(["slice-0/1", "slice-0/3"], AVAIL)
+    s.use_gauge = False
+    s.save(path)
+
+    s2 = SelectionState()
+    assert s2.load(path) is True
+    assert s2.selected == ["slice-0/1", "slice-0/3"]
+    assert s2.use_gauge is False
+
+
+def test_load_missing_and_corrupt(tmp_path):
+    s = SelectionState()
+    assert s.load(str(tmp_path / "nope.json")) is False
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert s.load(str(bad)) is False
+    assert s.selected == []  # state untouched
+
+
+def test_load_valid_json_wrong_shape(tmp_path):
+    # valid JSON that isn't an object must be ignored, not crash startup
+    s = SelectionState()
+    for content in ("[]", '"x"', "123"):
+        p = tmp_path / "shape.json"
+        p.write_text(content)
+        assert s.load(str(p)) is False
+
+
+def test_load_bad_field_leaves_state_untouched(tmp_path):
+    # a checkpoint with one bad field must not half-restore
+    p = tmp_path / "half.json"
+    p.write_text('{"selected": ["slice-0/1"], "use_gauge": true, "last_selection": 5}')
+    s = SelectionState()
+    s.set_selected(["slice-0/2"], AVAIL)
+    assert s.load(str(p)) is False
+    assert s.selected == ["slice-0/2"]  # untouched
+
+
+def test_restored_empty_selection_not_overridden_by_default():
+    # an explicitly cleared selection must survive restart (no first-chip
+    # default snap-back)
+    s = SelectionState()
+    s.sync(AVAIL)
+    s.clear()
+    d = s.to_dict()
+
+    s2 = SelectionState()
+    s2.selected = d["selected"]
+    s2._initialized = True
+    assert s2.sync(AVAIL) == []
+
+
+def test_save_disabled_with_empty_path():
+    SelectionState().save("")  # no-op, no crash
+
+
+def test_service_restores_state_across_restart(tmp_path):
+    path = str(tmp_path / "dash-state.json")
+    cfg = Config(source="fixture", fixture_path=FIXTURE, state_path=path)
+
+    svc1 = DashboardService(cfg, FixtureSource(FIXTURE))
+    svc1.render_frame()
+    svc1.state.set_selected(["slice-0/1"], svc1.available)
+    svc1.state.use_gauge = False
+    svc1.state.save(path)
+
+    svc2 = DashboardService(cfg, FixtureSource(FIXTURE))  # "restart"
+    frame = svc2.render_frame()
+    assert frame["selected"] == ["slice-0/1"]
+    assert frame["use_gauge"] is False
+
+
+def test_persisted_file_is_json(tmp_path):
+    path = str(tmp_path / "state.json")
+    s = SelectionState()
+    s.set_selected(["slice-0/2"], AVAIL)
+    s.save(path)
+    data = json.load(open(path))
+    assert data["selected"] == ["slice-0/2"]

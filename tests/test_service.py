@@ -98,6 +98,22 @@ def test_heatmap_partial_selection_keeps_full_slice_topology():
     assert z[7][7] is None
 
 
+def test_multislice_heatmaps_grouped_per_slice():
+    # 2 slices × 32 chips, all selected → heatmaps per (slice, panel), DCN
+    # panel present (multi-slice synthetic emits dcn series)
+    src = SyntheticSource(num_chips=32, num_slices=2)
+    svc = _svc(src, per_chip_panel_limit=16)
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = svc.render_frame()
+    slices = {h["slice"] for h in frame["heatmaps"]}
+    assert slices == {"slice-0", "slice-1"}
+    assert any(h["panel"] == schema.DCN_TOTAL_GBPS for h in frame["heatmaps"])
+    # each slice's heatmap is a 32-chip topology (4x8), not 64
+    z = frame["heatmaps"][0]["figure"]["data"][0]["z"]
+    assert len(z) * len(z[0]) == 32
+
+
 def test_stats_rounded_two_dp():
     frame = _svc().render_frame()
     for s in frame["stats"].values():

@@ -56,9 +56,13 @@ class DashboardServer:
     async def _mutate(self, fn):
         """Run a state mutation under the frame lock: render_frame executes
         on the worker thread only while the lock is held, so mutations are
-        serialized against frame builds (no torn selection lists)."""
+        serialized against frame builds (no torn selection lists).  The
+        post-mutation state is persisted inside the same critical section —
+        saving outside it could snapshot a list mid-sort."""
         async with self._lock:
-            return fn()
+            result = fn()
+            self.service.state.save(self.service.cfg.state_path)
+            return result
 
     # -- handlers ------------------------------------------------------------
     async def index(self, request: web.Request) -> web.Response:
@@ -141,5 +145,8 @@ def make_app(cfg: Config | None = None) -> web.Application:
 
 
 def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    from tpudash.config import configure_logging
+
+    configure_logging()
     cfg = cfg or load_config()
     web.run_app(make_app(cfg), host=cfg.host, port=cfg.port)

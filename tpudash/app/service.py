@@ -22,8 +22,11 @@ app.py:320-486).  ``render_frame()`` returns a JSON-able dict with:
 from __future__ import annotations
 
 import datetime as _dt
+import logging
 
 import pandas as pd
+
+log = logging.getLogger(__name__)
 
 from tpudash import schema
 from tpudash.config import Config
@@ -59,6 +62,8 @@ class DashboardService:
         #: chip keys seen in the last successful frame — the "currently
         #: available devices" selection ops validate against (app.py:281).
         self.available: list[str] = []
+        if cfg.state_path and self.state.load(cfg.state_path):
+            log.info("restored UI state from %s", cfg.state_path)
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -171,13 +176,18 @@ class DashboardService:
                 df = to_wide(samples)
         except Exception as e:  # noqa: BLE001 — error banner path catches all
             # Graceful degradation (app.py:225-227, 333): banner + keep state.
-            self.last_error = f"Error fetching TPU metrics: {e}"
+            err = f"Error fetching TPU metrics: {e}"
+            if err != self.last_error:  # log streaks once, not per cycle
+                log.warning("%s", err)
+            self.last_error = err
             frame["error"] = self.last_error
             frame["chips"] = []
             self.timer.end_frame()
             frame["timings"] = self.timer.summary()
             return frame
 
+        if self.last_error is not None:
+            log.info("metrics source recovered")
         self.last_error = None
         with self.timer.stage("render"):
             available = list(df.index)

@@ -10,6 +10,13 @@ sorting numerically by (slice, chip) — not lexically.
 
 from __future__ import annotations
 
+import json
+import logging
+import os
+import tempfile
+
+log = logging.getLogger(__name__)
+
 
 def _sort_key(chip_key: str):
     slice_id, _, chip = chip_key.rpartition("/")
@@ -66,3 +73,51 @@ class SelectionState:
         self.last_selection = list(self.selected)
         self.selected = []
         return self.selected
+
+    # -- persistence (checkpoint/resume for UI state — the reference resets
+    # -- on any refresh, SURVEY.md §5) ---------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "selected": list(self.selected),
+            "use_gauge": self.use_gauge,
+            "last_selection": list(self.last_selection),
+        }
+
+    def load(self, path: str) -> bool:
+        """Restore state from a JSON checkpoint; missing/corrupt files are
+        ignored (fresh state).  Returns True when state was restored."""
+        if not path or not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise TypeError(f"checkpoint is {type(data).__name__}, not object")
+            # parse everything before assigning anything: a bad field must
+            # not leave the state half-restored
+            selected = [str(k) for k in data.get("selected", [])]
+            use_gauge = bool(data.get("use_gauge", True))
+            last_selection = [str(k) for k in data.get("last_selection", [])]
+        except (OSError, json.JSONDecodeError, TypeError) as e:
+            log.warning("ignoring unreadable state checkpoint %s: %s", path, e)
+            return False
+        self.selected = selected
+        self.use_gauge = use_gauge
+        self.last_selection = last_selection
+        # a restored (possibly empty) selection is deliberate — don't
+        # re-apply the first-chip default over it
+        self._initialized = True
+        return True
+
+    def save(self, path: str) -> None:
+        """Atomically persist state (write-temp + rename)."""
+        if not path:
+            return
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
+            with os.fdopen(fd, "w") as f:
+                json.dump(self.to_dict(), f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("could not persist state to %s: %s", path, e)

@@ -45,6 +45,9 @@ class Config:
     fixture_path: str = ""
     #: Synthetic-source chip count (scale testing; 256 = v5e pod slice).
     synthetic_chips: int = 256
+    #: Synthetic-source slice count (>1 emits cross-slice DCN series —
+    #: BASELINE.json configs[4] multi-slice shape).
+    synthetic_slices: int = 1
     #: TPU generation hint for the synthetic source / topology fallback.
     generation: str = "v5e"
     #: Target discovery mode: "selector" (default — trust the Prometheus
@@ -67,6 +70,10 @@ class Config:
     #: Above this many selected chips the per-chip gauge rows collapse into
     #: the topology heatmap (the reference's O(N) figure wall, SURVEY §3.2).
     per_chip_panel_limit: int = 16
+    #: Path for persisted UI state (selection, style) so it survives server
+    #: restarts — the reference loses state on any refresh (SURVEY §5
+    #: checkpoint/resume: "none").  Empty string disables persistence.
+    state_path: str = ""
 
     extra: dict = field(default_factory=dict)
 
@@ -82,6 +89,7 @@ _ENV_MAP = {
     "source": "TPUDASH_SOURCE",
     "fixture_path": "TPUDASH_FIXTURE_PATH",
     "synthetic_chips": "TPUDASH_SYNTHETIC_CHIPS",
+    "synthetic_slices": "TPUDASH_SYNTHETIC_SLICES",
     "generation": "TPUDASH_GENERATION",
     "discovery": "TPUDASH_DISCOVERY",
     "series_selector": "TPUDASH_SERIES_SELECTOR",
@@ -90,7 +98,18 @@ _ENV_MAP = {
     "exporter_port": "TPUDASH_EXPORTER_PORT",
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
+    "state_path": "TPUDASH_STATE_PATH",
 }
+
+
+def configure_logging(level: str = "INFO") -> None:
+    """Shared logging setup for the CLI entry points."""
+    import logging
+
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
 
 
 def load_config(env: dict | None = None) -> Config:

@@ -68,5 +68,8 @@ def make_app(cfg: Config | None = None) -> web.Application:
 
 
 def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    from tpudash.config import configure_logging
+
+    configure_logging()
     cfg = cfg or load_config()
     web.run_app(make_app(cfg), host=cfg.host, port=cfg.exporter_port)

@@ -1,0 +1,101 @@
+"""``python -m tpudash.info`` — terminal metrics table (tpu-info style).
+
+The terminal counterpart of the web dashboard, for SSH sessions on TPU VMs
+(the role ``tpu-info`` / ``rocm-smi`` play next to the reference): one
+aligned table of per-chip metrics + the stats row, from any configured
+source.  ``--watch`` redraws every refresh interval.
+
+    TPUDASH_SOURCE=probe python -m tpudash.info
+    python -m tpudash.info --source synthetic --chips 16 --watch
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+
+from tpudash import schema
+from tpudash.config import load_config
+from tpudash.normalize import compute_stats, to_wide
+from tpudash.sources import make_source
+from tpudash.sources.base import SourceError
+
+#: column → (header, format) for display, in order.
+_COLUMNS: tuple = (
+    (schema.TENSORCORE_UTIL, "MXU%", "{:.1f}"),
+    (schema.HBM_USAGE_RATIO, "HBM%", "{:.1f}"),
+    (schema.HBM_USED_GIB, "HBM GiB", "{:.2f}"),
+    (schema.TEMPERATURE, "Temp°C", "{:.0f}"),
+    (schema.POWER, "Power W", "{:.1f}"),
+    (schema.ICI_TOTAL_GBPS, "ICI GB/s", "{:.1f}"),
+    (schema.DCN_TOTAL_GBPS, "DCN GB/s", "{:.1f}"),
+    (schema.HBM_BANDWIDTH, "HBM GB/s", "{:.0f}"),
+)
+
+
+def render_table(df, stats) -> str:
+    cols = [(c, h, f) for c, h, f in _COLUMNS if c in df.columns]
+    headers = ["chip", "model"] + [h for _, h, _ in cols]
+    rows: list[list[str]] = []
+    for key, row in df.iterrows():
+        cells = [str(key), str(row.get(schema.ACCEL_TYPE, "") or "?")]
+        for c, _, fmt in cols:
+            v = row.get(c)
+            cells.append("-" if v is None or v != v else fmt.format(v))
+        rows.append(cells)
+    for stat in ("mean", "max", "min"):
+        cells = [stat, ""]
+        for c, _, fmt in cols:
+            s = stats.get(c)
+            cells.append(fmt.format(s[stat]) if s else "-")
+        rows.append(cells)
+
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in rows)) for i in range(len(headers))
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    body = ["  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    # separator between per-chip rows and the stats block
+    lines += body[: len(df)] + ["  ".join("-" * w for w in widths)] + body[len(df):]
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="TPU metrics table")
+    ap.add_argument("--source", help="override TPUDASH_SOURCE")
+    ap.add_argument("--chips", type=int, help="synthetic chip count")
+    ap.add_argument("--watch", action="store_true", help="redraw continuously")
+    args = ap.parse_args(argv)
+
+    cfg = load_config()
+    if args.source:
+        cfg = dataclasses.replace(cfg, source=args.source)
+    if args.chips:
+        cfg = dataclasses.replace(cfg, synthetic_chips=args.chips)
+    source = make_source(cfg)
+
+    try:
+        while True:
+            try:
+                df = to_wide(source.fetch())
+                out = render_table(df, compute_stats(df))
+            except SourceError as e:
+                out = f"error: {e}"
+            if args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(out)
+            print(f"\nsource={source.name}  {time.strftime('%Y-%m-%d %H:%M:%S')}")
+            if not args.watch:
+                return 0
+            time.sleep(cfg.refresh_interval)
+    except KeyboardInterrupt:  # Ctrl-C during fetch or sleep exits cleanly
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,9 @@ def make_source(cfg) -> MetricsSource:
         return FixtureSource(cfg.fixture_path)
     if kind == "synthetic":
         return SyntheticSource(
-            num_chips=cfg.synthetic_chips, generation=cfg.generation
+            num_chips=cfg.synthetic_chips,
+            generation=cfg.generation,
+            num_slices=cfg.synthetic_slices,
         )
     if kind == "scrape":
         from tpudash.sources.scrape import ScrapeSource
