@@ -90,6 +90,21 @@ def test_heatmap_missing_chips_are_gaps():
     assert z[0][0] == 5.0 and z[0][1] is None
 
 
+def test_sparkline_structure():
+    from tpudash.viz.figures import create_sparkline
+
+    fig = create_sparkline(
+        ["10:00:00", "10:00:05", "10:00:10"], [10.0, 50.0, 90.0],
+        "MXU — trend", max_val=100.0, unit="%",
+    )
+    (trace,) = fig["data"]
+    assert trace["type"] == "scatter"
+    assert trace["y"] == [10.0, 50.0, 90.0]
+    # line colored by the LATEST value's band (90 → red)
+    assert trace["line"]["color"] == COLOR_BANDS[4].bar
+    assert fig["layout"]["yaxis"]["range"] == [0, 100.0]
+
+
 def test_figures_are_json_serializable():
     import json
 

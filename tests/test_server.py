@@ -123,6 +123,17 @@ def test_frame_cache_one_scrape_per_interval():
     _run(_with_client(app, go))
 
 
+def test_history_endpoint():
+    async def go(client):
+        await client.get("/api/frame")
+        data = await (await client.get("/api/history")).json()
+        assert len(data["history"]) == 1
+        entry = data["history"][0]
+        assert "ts" in entry and "tpu_power_watts" in entry["averages"]
+
+    _run(_with_client(_client_app(), go))
+
+
 def test_select_before_first_frame_primes_chip_list():
     # select-all as the FIRST request must see the full chip list, not []
     async def go(client):

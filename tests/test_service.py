@@ -177,6 +177,53 @@ def test_recovery_after_error_preserves_selection():
     assert frame["selected"] == ["slice-0/1"]  # state survives error cycles
 
 
+def test_trends_appear_after_two_frames():
+    svc = _svc(refresh_interval=0.0)  # history gates on the refresh cadence
+    f1 = svc.render_frame()
+    assert f1["trends"] == []  # one history point is not a trend
+    f2 = svc.render_frame()
+    trends = f2["trends"]
+    assert trends, "expected sparklines after two frames"
+    cols = {t["panel"] for t in trends}
+    assert schema.TENSORCORE_UTIL in cols
+    fig = trends[0]["figure"]
+    assert fig["data"][0]["type"] == "scatter"
+    assert len(fig["data"][0]["y"]) == 2
+    assert len(svc.history) == 2
+
+
+def test_trends_downsampled_and_anchored_at_latest():
+    svc = _svc(refresh_interval=0.0)
+    for _ in range(5):
+        svc.render_frame()
+    # force a big history with a marker at the end
+    svc.history.clear()
+    for i in range(500):
+        svc.history.append((float(i), {schema.TENSORCORE_UTIL: float(i)}))
+    frame = svc.render_frame()
+    trend = next(
+        t for t in frame["trends"] if t["panel"] == schema.TENSORCORE_UTIL
+    )
+    ys = trend["figure"]["data"][0]["y"]
+    assert len(ys) <= 121
+    # the newest history point (the freshly rendered frame's average) is last
+    assert ys[-1] == svc.history[-1][1][schema.TENSORCORE_UTIL]
+
+
+def test_history_one_point_per_refresh_interval():
+    # selection POSTs force extra renders; they must not add burst samples
+    svc = _svc(refresh_interval=60.0)
+    for _ in range(5):
+        svc.render_frame()
+    assert len(svc.history) == 1
+
+
+def test_history_excludes_error_frames():
+    svc = _svc(_BoomSource())
+    svc.render_frame()
+    assert len(svc.history) == 0
+
+
 def test_timings_present():
     svc = _svc()
     svc.render_frame()

@@ -106,6 +106,23 @@ function renderHeatFallback(el, trace, layoutTitle) {
     <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
 }
 
+function renderLineFallback(el, trace, fig, title) {
+  const ys = trace.y, n = ys.length;
+  const ymax = (fig.layout.yaxis.range && fig.layout.yaxis.range[1]) || Math.max(...ys, 1);
+  const W = 240, H = 64;
+  let pts = '';
+  for (let i = 0; i < n; i++) {
+    const x = n > 1 ? i / (n - 1) * W : 0;
+    const y = H - Math.min(1, Math.max(0, ys[i] / ymax)) * H;
+    pts += `${x.toFixed(1)},${y.toFixed(1)} `;
+  }
+  const col = trace.line.color;
+  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
+    <svg viewBox="0 0 ${W} ${H}" style="width:100%;height:64px;background:#f2f6fa;border-radius:4px">
+      <polyline points="${pts}" fill="none" stroke="${esc(col)}" stroke-width="2"/></svg>
+    <div class="fig-title">now ${(+ys[n-1]).toFixed(1)} · max ${+ymax}</div>`;
+}
+
 function renderFigure(el, fig) {
   if (usePlotly()) { Plotly.react(el, fig.data, fig.layout, {displayModeBar: false}); return; }
   const t = fig.data[0];
@@ -117,6 +134,8 @@ function renderFigure(el, fig) {
     renderMeter(el, title, t.x[0], fig.layout.xaxis.range[1], steps, t.marker.color);
   } else if (t.type === 'heatmap') {
     renderHeatFallback(el, t, title);
+  } else if (t.type === 'scatter') {
+    renderLineFallback(el, t, fig, title);
   }
 }
 
@@ -188,6 +207,7 @@ async function refresh() {
   const panels = document.getElementById('panels');
   panels.innerHTML = '';
   if (frame.average) panelRow(panels, frame.average.title, frame.average.figures);
+  if (frame.trends && frame.trends.length) panelRow(panels, 'Trends', frame.trends);
   for (const row of frame.device_rows || []) panelRow(panels, row.title, row.figures);
   // heatmaps group per panel metric
   const heat = frame.heatmaps || [];

@@ -121,6 +121,14 @@ class DashboardServer:
     async def timings(self, request: web.Request) -> web.Response:
         return web.json_response(self.service.timer.summary())
 
+    async def history(self, request: web.Request) -> web.Response:
+        """Raw rolling history of selected-average values per metric."""
+        async with self._lock:  # render_frame appends from the worker thread
+            snapshot = list(self.service.history)
+        return web.json_response(
+            {"history": [{"ts": ts, "averages": avgs} for ts, avgs in snapshot]}
+        )
+
     async def healthz(self, request: web.Request) -> web.Response:
         return web.json_response(
             {"ok": True, "source": self.service.source.name,
@@ -134,6 +142,7 @@ class DashboardServer:
         app.router.add_post("/api/select", self.select)
         app.router.add_post("/api/style", self.style)
         app.router.add_get("/api/timings", self.timings)
+        app.router.add_get("/api/history", self.history)
         app.router.add_get("/healthz", self.healthz)
         return app
 

@@ -22,8 +22,12 @@ app.py:320-486).  ``render_frame()`` returns a JSON-able dict with:
 from __future__ import annotations
 
 import datetime as _dt
+import functools
 import logging
+import time
+from collections import deque
 
+import numpy as np
 import pandas as pd
 
 log = logging.getLogger(__name__)
@@ -42,9 +46,10 @@ from tpudash.sources.base import MetricsSource
 from tpudash.topology import topology_for
 from tpudash.utils.timing import StageTimer
 from tpudash.viz.dispatch import accel_types_for, create_visualization, panel_max
-from tpudash.viz.figures import create_topology_heatmap
+from tpudash.viz.figures import create_sparkline, create_topology_heatmap
 
 
+@functools.lru_cache(maxsize=256)
 def _model_name(accel: str) -> str:
     gen = resolve_generation(accel)
     # Unknown models render as "unknown", not "None" (reference quirk at
@@ -64,6 +69,10 @@ class DashboardService:
         self.available: list[str] = []
         if cfg.state_path and self.state.load(cfg.state_path):
             log.info("restored UI state from %s", cfg.state_path)
+        #: rolling (wall_ts, {column: selected-average}) per successful
+        #: frame — trend history the reference never kept.  At the default
+        #: 5 s cadence, 720 points ≈ one hour.
+        self.history: deque = deque(maxlen=720)
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -73,11 +82,13 @@ class DashboardService:
         panels += [p for p in schema.EXTRA_PANELS if p.column in df.columns]
         return panels
 
-    def _average_row(self, sel_df: pd.DataFrame, panels, use_gauge: bool) -> dict:
+    def _average_row(
+        self, sel_df: pd.DataFrame, panels, use_gauge: bool, avgs: dict
+    ) -> dict:
         accels = accel_types_for(sel_df)
         figures = []
         for spec in panels:
-            avg = column_average(sel_df, spec.column)
+            avg = avgs.get(spec.column)
             value = 0.0 if avg is None else avg  # reference renders 0 on empty
             figures.append(
                 {
@@ -135,14 +146,20 @@ class DashboardService:
             # selection) so partial selections keep real torus coordinates
             n = int(df.loc[df["slice_id"] == slice_id, "chip_id"].max()) + 1
             topo = topology_for(generation, n)
+            chip_ids = sdf["chip_id"].to_numpy()
             for spec in panels:
                 if spec.column not in sdf.columns:
                     continue
-                series = pd.to_numeric(sdf[spec.column], errors="coerce").dropna()
-                values = {
-                    int(sdf.loc[k, "chip_id"]): float(v)
-                    for k, v in series.items()
-                }
+                vals = pd.to_numeric(sdf[spec.column], errors="coerce").to_numpy(
+                    dtype=float, na_value=np.nan
+                )
+                mask = ~np.isnan(vals)
+                values = dict(
+                    zip(
+                        (int(c) for c in chip_ids[mask]),
+                        (float(v) for v in vals[mask]),
+                    )
+                )
                 if not values:
                     continue
                 out.append(
@@ -158,6 +175,43 @@ class DashboardService:
                         ),
                     }
                 )
+        return out
+
+    def _trends(self, sel_df: pd.DataFrame, panels, max_points: int = 120) -> list:
+        """Sparkline per panel over the rolling average history, downsampled
+        to ≤max_points (strided from the end so the latest point always
+        shows)."""
+        if len(self.history) < 2:
+            return []
+        accels = accel_types_for(sel_df)
+        pts = list(self.history)
+        stride = max(1, -(-len(pts) // max_points))
+        pts = pts[::-1][::stride][::-1]  # stride anchored at the newest point
+        out = []
+        for spec in panels:
+            series = [
+                (ts, avgs[spec.column])
+                for ts, avgs in pts
+                if avgs.get(spec.column) is not None
+            ]
+            if len(series) < 2:
+                continue
+            times = [
+                _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+                for ts, _ in series
+            ]
+            out.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_sparkline(
+                        times,
+                        [v for _, v in series],
+                        title=f"{spec.title} — trend",
+                        max_val=panel_max(spec, accels),
+                        unit=spec.unit,
+                    ),
+                }
+            )
         return out
 
     # -- the frame -----------------------------------------------------------
@@ -197,16 +251,28 @@ class DashboardService:
             panels = self._active_panels(df)
             use_gauge = self.state.use_gauge
 
+            sel_set = set(selected)
+            accels = (
+                df[schema.ACCEL_TYPE].fillna("").tolist()
+                if schema.ACCEL_TYPE in df
+                else [""] * len(df)
+            )
             frame["chips"] = [
                 {
                     "key": key,
-                    "chip_id": int(row["chip_id"]),
-                    "slice": row["slice_id"],
-                    "host": row["host"],
-                    "model": _model_name(row.get(schema.ACCEL_TYPE, "")),
-                    "selected": key in set(selected),
+                    "chip_id": int(cid),
+                    "slice": sl,
+                    "host": host,
+                    "model": _model_name(accel),
+                    "selected": key in sel_set,
                 }
-                for key, row in df.iterrows()
+                for key, cid, sl, host, accel in zip(
+                    df.index.tolist(),
+                    df["chip_id"].tolist(),
+                    df["slice_id"].tolist(),
+                    df["host"].tolist(),
+                    accels,
+                )
             ]
             # copy: the cached frame must not alias the live selection list
             frame["selected"] = list(selected)
@@ -216,7 +282,23 @@ class DashboardService:
             ]
 
             if not sel_df.empty:
-                frame["average"] = self._average_row(sel_df, panels, use_gauge)
+                avgs = {
+                    spec.column: column_average(sel_df, spec.column)
+                    for spec in panels
+                }
+                # one history point per refresh interval: selection/style
+                # POSTs force extra renders whose burst samples (different
+                # selections, duplicate timestamps) would pollute the trend
+                now = time.time()
+                if (
+                    not self.history
+                    or now - self.history[-1][0] >= self.cfg.refresh_interval
+                ):
+                    self.history.append((now, avgs))
+                frame["average"] = self._average_row(
+                    sel_df, panels, use_gauge, avgs
+                )
+                frame["trends"] = self._trends(sel_df, panels)
                 if len(sel_df) <= self.cfg.per_chip_panel_limit:
                     frame["device_rows"] = self._device_rows(sel_df, panels, use_gauge)
                     frame["heatmaps"] = []
@@ -233,6 +315,7 @@ class DashboardService:
                 frame["average"] = None
                 frame["device_rows"] = []
                 frame["heatmaps"] = []
+                frame["trends"] = []
                 frame["stats"] = {}
 
         self.timer.end_frame()

@@ -136,7 +136,11 @@ def forward(params: dict, tokens: jax.Array, cfg: WorkloadConfig) -> jax.Array:
         h = h + _mlp(_rmsnorm(h, layer["ln2"]), layer["w_up"], layer["w_down"])
         return h, None
 
-    x, _ = lax.scan(block, x, params["blocks"])
+    # remat each layer: without it, scan saves every layer's T×T attention
+    # probabilities for backward (O(L·B·H·T²) HBM — OOMs a 16 GiB chip at
+    # modest sizes); recomputing them trades ~1/3 more FLOPs for O(1)-layer
+    # activation memory
+    x, _ = lax.scan(jax.checkpoint(block), x, params["blocks"])
     x = _rmsnorm(x, params["ln_f"])
     return jnp.einsum(
         "btd,dv->btv", x, params["unembed"], preferred_element_type=jnp.float32

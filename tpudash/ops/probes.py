@@ -50,7 +50,10 @@ def device_info() -> dict:
 @dataclass(frozen=True)
 class ProbeResult:
     value: float      # headline number (TFLOP/s or GB/s or µs)
-    elapsed_s: float  # wall seconds of the larger timed run
+    #: the rate denominator: for delta-timed probes, the median paired
+    #: (large − small) work delta in wall seconds — NOT the probe's total
+    #: wall cost; for single-shot probes, that run's wall time.
+    elapsed_s: float
     detail: dict
 
 
@@ -64,6 +67,28 @@ def _timed_scalar(fn, *args, trials: int = 2) -> float:
         float(fn(*args))
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def _delta_time(fn_small, fn_large, pairs: int = 5) -> float:
+    """Median of paired (large - small) wall-time deltas.
+
+    Each pair times the small and large work variants back to back, so slow
+    drift (tunnel congestion, host load) affects both sides of a pair
+    equally and cancels; the median rejects a pair hit by a one-off spike —
+    a lone spike on either side otherwise produces absurd rates.
+    """
+    float(fn_small())  # compile + warm both variants
+    float(fn_large())
+    deltas = []
+    for _ in range(pairs):
+        t0 = time.perf_counter()
+        float(fn_small())
+        t1 = time.perf_counter()
+        float(fn_large())
+        t2 = time.perf_counter()
+        deltas.append((t2 - t1) - (t1 - t0))
+    deltas.sort()
+    return max(deltas[len(deltas) // 2], _MIN_DELTA_S)
 
 
 # --- MXU throughput ---------------------------------------------------------
@@ -101,13 +126,14 @@ def matmul_flops_probe(
     if device is not None:
         x, w = jax.device_put(x, device), jax.device_put(w, device)
 
-    t1 = _timed_scalar(_matmul_chain_sum, x, w, iters)
-    t2 = _timed_scalar(_matmul_chain_sum, x, w, 3 * iters)
-    dt = max(t2 - t1, _MIN_DELTA_S)
+    dt = _delta_time(
+        lambda: _matmul_chain_sum(x, w, iters),
+        lambda: _matmul_chain_sum(x, w, 3 * iters),
+    )
     flops = 2.0 * size**3 * (2 * iters)
     return ProbeResult(
         value=flops / dt / 1e12,
-        elapsed_s=t2,
+        elapsed_s=dt,
         detail={"size": size, "iters": iters, "dtype": jnp.dtype(dtype).name},
     )
 
@@ -165,13 +191,14 @@ def hbm_bandwidth_probe(
     if device is not None:
         x = jax.device_put(x, device)
 
-    t1 = _timed_scalar(_hbm_stream_sum, x, block_rows, k1)
-    t2 = _timed_scalar(_hbm_stream_sum, x, block_rows, k2)
-    dt = max(t2 - t1, _MIN_DELTA_S)
+    dt = _delta_time(
+        lambda: _hbm_stream_sum(x, block_rows, k1),
+        lambda: _hbm_stream_sum(x, block_rows, k2),
+    )
     nbytes = x.size * 4
     return ProbeResult(
         value=2.0 * nbytes * (k2 - k1) / dt / 1e9,  # (read+write) per pass
-        elapsed_s=t2,
+        elapsed_s=dt,
         detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows,
                 "k1": k1, "k2": k2},
     )

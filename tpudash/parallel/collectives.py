@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudash.ops.probes import ProbeResult, _MIN_DELTA_S, _timed_scalar
+from tpudash.ops.probes import ProbeResult, _delta_time, _timed_scalar
 
 shard_map = jax.shard_map
 
@@ -69,13 +69,13 @@ def ppermute_ring_bandwidth_probe(
     x = _sharded_ones(mesh, axis, mb_per_device)
     ring_sum = _ring_sum_fn(mesh, axis)
 
-    t1 = _timed_scalar(ring_sum, x, steps)
-    t2 = _timed_scalar(ring_sum, x, 3 * steps)
-    dt = max(t2 - t1, _MIN_DELTA_S)
+    dt = _delta_time(
+        lambda: ring_sum(x, steps), lambda: ring_sum(x, 3 * steps)
+    )
     shard_bytes = x.nbytes // n
     return ProbeResult(
         value=shard_bytes * (2 * steps) / dt / 1e9,
-        elapsed_s=t2,
+        elapsed_s=dt,
         detail={"axis": axis, "devices": n, "mb_per_device": mb_per_device,
                 "steps": steps},
     )
@@ -119,13 +119,11 @@ def all_gather_bandwidth_probe(
     fn = _gather_sum_fn(mesh, axis)
     x1 = _sharded_ones(mesh, axis, mb_per_device)
     x3 = _sharded_ones(mesh, axis, 3 * mb_per_device)
-    t1 = _timed_scalar(fn, x1)
-    t2 = _timed_scalar(fn, x3)
-    dt = max(t2 - t1, _MIN_DELTA_S)
+    dt = _delta_time(lambda: fn(x1), lambda: fn(x3))
     extra_bytes = (x3.nbytes - x1.nbytes) // n * (n - 1)
     return ProbeResult(
         value=extra_bytes / dt / 1e9,
-        elapsed_s=t2,
+        elapsed_s=dt,
         detail={"axis": axis, "devices": n, "mb_per_device": mb_per_device},
     )
 

@@ -125,6 +125,24 @@ def resolve_generation(label: str | None) -> TpuGeneration | None:
     return None
 
 
+def resolve_generation_from_device_kind(kind: str | None) -> TpuGeneration | None:
+    """Resolve a generation from a jax device_kind string (e.g. "TPU v5
+    lite") — the on-host analogue of the board-ID lookup, used by the
+    probe/workload sources."""
+    low = (kind or "").lower().replace(" ", "")
+    if not low:
+        return None
+    if "v5lite" in low or "v5e" in low:
+        return TPU_GENERATIONS["v5e"]
+    if "v5p" in low or low.endswith("v5"):
+        return TPU_GENERATIONS["v5p"]
+    if "v6" in low:
+        return TPU_GENERATIONS["v6e"]
+    if "v4" in low:
+        return TPU_GENERATIONS["v4"]
+    return None
+
+
 def power_limit_for(label: str | None) -> float:
     """Power gauge ceiling for a generation/accelerator label (reference
     `get_power_limit`, app.py:229-232 — there dead code duplicated inline at

@@ -29,6 +29,10 @@ def make_source(cfg) -> MetricsSource:
         from tpudash.sources.scrape import ScrapeSource
 
         return ScrapeSource(cfg)
+    if kind == "workload":
+        from tpudash.sources.workload import WorkloadSource  # imports jax
+
+        return WorkloadSource(cfg)
     if kind == "probe":
         try:
             from tpudash.sources.probe import ProbeSource  # deferred: imports jax

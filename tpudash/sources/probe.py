@@ -40,17 +40,10 @@ from tpudash.sources.base import MetricsSource, SourceError
 
 
 def _generation_for_device(dev) -> str | None:
-    kind = getattr(dev, "device_kind", "") or ""
-    low = kind.lower().replace(" ", "")
-    if "v5lite" in low or "v5e" in low:
-        return "v5e"
-    if "v5p" in low or "v5" == low[-2:]:
-        return "v5p"
-    if "v6" in low:
-        return "v6e"
-    if "v4" in low:
-        return "v4"
-    return None
+    from tpudash.registry import resolve_generation_from_device_kind
+
+    gen = resolve_generation_from_device_kind(getattr(dev, "device_kind", ""))
+    return gen.name if gen else None
 
 
 class ProbeSource(MetricsSource):

@@ -118,6 +118,44 @@ _HEAT_COLORSCALE = [
 ]
 
 
+def create_sparkline(
+    times: list,
+    values: list,
+    title: str,
+    max_val: float = 100.0,
+    height: int = 120,
+    unit: str = "",
+) -> dict:
+    """Compact trend line for one metric's rolling average — history the
+    reference never kept (its panels show only the instant value,
+    SURVEY.md §5 'tracing: absent').  Color follows the latest value's
+    band."""
+    latest = values[-1] if values else 0.0
+    return {
+        "data": [
+            {
+                "type": "scatter",
+                "mode": "lines",
+                "x": times,
+                "y": values,
+                "line": {"color": color_for_value(latest, max_val), "width": 2},
+                "hoverinfo": "x+y",
+            }
+        ],
+        "layout": {
+            "title": {"text": title, "font": {"size": 12}},
+            "height": height,
+            "margin": {"l": 30, "r": 10, "t": 24, "b": 18},
+            "xaxis": {"showgrid": False, "tickfont": {"size": 9}},
+            "yaxis": {
+                "range": [0, max_val],
+                "tickfont": {"size": 9},
+                "title": {"text": unit, "font": {"size": 9}},
+            },
+        },
+    }
+
+
 def create_topology_heatmap(
     topo: Topology,
     values: dict[int, float],
