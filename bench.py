@@ -50,19 +50,24 @@ def bench_probes() -> dict:
         from tpudash.ops.probes import (
             device_info,
             hbm_bandwidth_probe,
+            hbm_copy_probe,
             matmul_flops_probe,
         )
 
         info = device_info()
         if info["platform"] not in ("tpu",):
             return {"platform": info["platform"]}
-        mm = matmul_flops_probe(size=4096, iters=16)
-        hbm = hbm_bandwidth_probe(mb=512, k2=9)
+        mm = matmul_flops_probe(size=4096, iters=32)
+        # publication-grade long windows (~70 ms of traffic per delta) so the
+        # tunneled host↔device dispatch jitter (±10 ms) stays <15% of signal
+        hbm = hbm_bandwidth_probe(mb=256, k1=10, k2=210)
+        cp = hbm_copy_probe(mb=256, k1=5, k2=105)
         return {
             "platform": info["platform"],
             "device_kind": info["device_kind"],
             "matmul_bf16_tflops": round(mm.value, 2),
             "hbm_stream_gbps": round(hbm.value, 1),
+            "hbm_copy_gbps": round(cp.value, 1),
         }
     except Exception as e:  # bench must still report the headline number
         return {"probe_error": str(e)}

@@ -3,9 +3,12 @@ exercised exactly as on TPU)."""
 
 import jax
 
+import pytest
+
 from tpudash.ops.probes import (
     device_info,
     hbm_bandwidth_probe,
+    hbm_copy_probe,
     hbm_memory_stats,
     matmul_flops_probe,
 )
@@ -33,6 +36,22 @@ def test_hbm_probe_runs_interpret_on_cpu():
     r = hbm_bandwidth_probe(mb=4, block_rows=256)
     assert r.value > 0
     assert r.detail["mb"] == 4
+    assert r.detail["mode"] == "read-stream"
+    # block_rows is clamped to the buffer's row count (4 MiB / 32 KiB rows)
+    assert r.detail["block_rows"] == 128
+
+
+def test_hbm_copy_probe_runs_interpret_on_cpu():
+    r = hbm_copy_probe(mb=4, block_rows=64, k1=1, k2=3)
+    assert r.value > 0
+    assert r.detail["mode"] == "copy"
+
+
+def test_hbm_probe_rejects_bad_contrast():
+    with pytest.raises(ValueError):
+        hbm_bandwidth_probe(mb=4, k1=5, k2=5)
+    with pytest.raises(ValueError):
+        hbm_copy_probe(mb=4, k1=5, k2=4)
 
 
 def test_hbm_memory_stats_shape():

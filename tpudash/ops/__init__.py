@@ -5,12 +5,14 @@ ROCm node exporter (SURVEY.md §2: the amd_gpu_* series are implemented
 elsewhere).  tpudash ships the measurement side too: small, bounded-cost
 probe workloads that measure what the chip can actually do right now —
 MXU throughput (achieved bf16 TFLOP/s → TensorCore-utilization series),
-HBM bandwidth (Pallas copy kernel), and HBM occupancy (allocator stats).
+HBM read-streaming bandwidth (Pallas reduction kernel; a read+write copy
+variant is a secondary probe), and HBM occupancy (allocator stats).
 """
 
 from tpudash.ops.probes import (  # noqa: F401
     device_info,
     hbm_bandwidth_probe,
+    hbm_copy_probe,
     hbm_memory_stats,
     matmul_flops_probe,
 )

@@ -5,9 +5,11 @@ Design notes (TPU-first):
   shapes, no host round-trips inside the loop (lax.fori_loop), so XLA tiles
   the whole chain onto the MXU.  Achieved TFLOP/s ÷ the generation's peak
   gives the TensorCore-utilization % the dashboard displays.
-- The HBM probe is a Pallas grid kernel streaming a large buffer through
-  VMEM (read + write ≈ 2× traffic); on non-TPU backends it runs in
-  interpret mode so tests stay cluster-free.
+- The headline HBM probe is a Pallas grid *reduction* streaming a large
+  buffer through VMEM and counting bytes READ only (read-only streaming
+  reaches ~93% of HBM peak where a read+write copy saturates near half —
+  the copy is kept as a secondary probe, :func:`hbm_copy_probe`).  On
+  non-TPU backends both run in interpret mode so tests stay cluster-free.
 
 Timing methodology: on tunneled/async device platforms,
 ``block_until_ready`` can return at dispatch time, and any single
@@ -139,12 +141,63 @@ def matmul_flops_probe(
 
 
 # --- HBM bandwidth (Pallas) -------------------------------------------------
+#
+# Two kernels, both pipelined block-wise through VMEM by the Pallas grid:
+#
+# - READ-STREAMING (headline): a grid reduction that only *reads* the big
+#   buffer (the (1, cols) accumulator output is noise).  Measured ~93% of
+#   the v5e's 819 GB/s aggregate on hardware — this is the STREAM-style
+#   number the dashboard reports as ``hbm_bandwidth``.
+# - COPY (secondary): read+write of the full buffer.  Reads and writes
+#   contend on the shared HBM bus and the measured aggregate sits near
+#   ~40-50% of peak on v5e, so it is a distinct, complementary signal.
+#
+# Each loop iteration carries a data dependency (the accumulator / the
+# copied buffer), so XLA cannot CSE or fold the repeated pallas_calls the
+# way it folds repeated elementwise ops — the traffic is guaranteed.
+
+
+def _hbm_read_kernel(in_ref, prev_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = prev_ref[:]
+
+    out_ref[:] += jnp.sum(in_ref[:], axis=0, keepdims=True)
+
+
+def _hbm_read_once(x: jax.Array, prev: jax.Array, block_rows: int):
+    from jax.experimental import pallas as pl
+
+    rows, cols = x.shape
+    return pl.pallas_call(
+        _hbm_read_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, cols), x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, cols), lambda i: (0, 0)),
+        interpret=jax.default_backend() != "tpu",
+    )(x, prev)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "repeats"))
+def _hbm_read_loop(x: jax.Array, block_rows: int, repeats: int) -> jax.Array:
+    def body(_, prev):
+        return _hbm_read_once(x, prev, block_rows)
+
+    prev = jnp.zeros((1, x.shape[1]), x.dtype)
+    return jnp.sum(lax.fori_loop(0, repeats, body, prev)[0, :8])
+
 
 def _copy_kernel(in_ref, out_ref):
     out_ref[:] = in_ref[:]
 
 
-def _hbm_stream_once(x: jax.Array, block_rows: int):
+def _hbm_copy_once(x: jax.Array, block_rows: int):
     from jax.experimental import pallas as pl
 
     rows, cols = x.shape
@@ -159,48 +212,88 @@ def _hbm_stream_once(x: jax.Array, block_rows: int):
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "repeats"))
-def _hbm_stream_sum(x: jax.Array, block_rows: int, repeats: int) -> jax.Array:
+def _hbm_copy_loop(x: jax.Array, block_rows: int, repeats: int) -> jax.Array:
     def body(_, acc):
-        return _hbm_stream_once(acc, block_rows)
+        return _hbm_copy_once(acc, block_rows)
 
     return jnp.sum(lax.fori_loop(0, repeats, body, x)[0, :8])
 
 
-def hbm_bandwidth_probe(
-    mb: int = 256,
-    block_rows: int = 1024,
-    k1: int = 1,
-    k2: int = 9,
-    device: "jax.Device | None" = None,
-) -> ProbeResult:
-    """Achieved HBM streaming bandwidth (GB/s), counting read + write.
-
-    Buffer is (rows, 1024) float32 sized to ``mb`` MiB, streamed block-wise
-    through VMEM (block_rows×1024×4B = 4 MiB/block by default, well under
-    the ~16 MiB VMEM budget); delta-timed at ``k1`` vs ``k2`` passes.  The
-    (k2-k1) contrast must represent several milliseconds of traffic or the
-    delta drowns in host↔device jitter — at 256 MiB × 8 extra passes ×
-    read+write ≈ 4 GiB, ~5 ms on a v5e.
-    """
-    if k2 <= k1:
-        raise ValueError("k2 must exceed k1")
-    cols = 1024
-    rows = max(block_rows, (mb * 1024 * 1024) // (cols * 4))
-    rows = (rows // block_rows) * block_rows
+def _hbm_buffer(
+    mb: int, block_rows: int, cols: int, device: "jax.Device | None"
+):
+    rows = max(1, (mb * 1024 * 1024) // (cols * 4))
+    block_rows = max(1, min(block_rows, rows))
+    rows = max(block_rows, (rows // block_rows) * block_rows)
     x = jnp.ones((rows, cols), jnp.float32)
     if device is not None:
         x = jax.device_put(x, device)
+    return x, block_rows
 
+
+def hbm_bandwidth_probe(
+    mb: int = 256,
+    block_rows: int = 128,
+    k1: int = 4,
+    k2: int = 44,
+    cols: int = 8192,
+    device: "jax.Device | None" = None,
+) -> ProbeResult:
+    """Achieved HBM read-streaming bandwidth (GB/s, bytes READ per second).
+
+    Buffer is (rows, cols) float32 sized to ``mb`` MiB, reduced block-wise
+    through VMEM (block_rows×cols×4B = 4 MiB/block by default, double
+    buffered by the grid pipeline well under the ~16 MiB VMEM budget);
+    delta-timed at ``k1`` vs ``k2`` read passes.  The (k2-k1) contrast must
+    represent tens of milliseconds of traffic or the delta drowns in
+    host↔device jitter (tunneled dispatch jitters ±10 ms); at 256 MiB ×
+    40 extra passes = 10 GiB, ~13 ms on a v5e.  For publication-grade
+    numbers use k1=10, k2=210 (50 GiB, ~70 ms windows).
+    """
+    if k2 <= k1:
+        raise ValueError("k2 must exceed k1")
+    x, block_rows = _hbm_buffer(mb, block_rows, cols, device)
     dt = _delta_time(
-        lambda: _hbm_stream_sum(x, block_rows, k1),
-        lambda: _hbm_stream_sum(x, block_rows, k2),
+        lambda: _hbm_read_loop(x, block_rows, k1),
+        lambda: _hbm_read_loop(x, block_rows, k2),
     )
     nbytes = x.size * 4
     return ProbeResult(
-        value=2.0 * nbytes * (k2 - k1) / dt / 1e9,  # (read+write) per pass
+        value=nbytes * (k2 - k1) / dt / 1e9,  # read traffic per pass
         elapsed_s=dt,
         detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows,
-                "k1": k1, "k2": k2},
+                "cols": cols, "k1": k1, "k2": k2, "mode": "read-stream"},
+    )
+
+
+def hbm_copy_probe(
+    mb: int = 256,
+    block_rows: int = 128,
+    k1: int = 2,
+    k2: int = 22,
+    cols: int = 8192,
+    device: "jax.Device | None" = None,
+) -> ProbeResult:
+    """Achieved HBM copy bandwidth (GB/s, read+write bytes per second).
+
+    Same delta-timed methodology as :func:`hbm_bandwidth_probe` but each
+    pass copies the buffer (read + write), so the value counts 2× the
+    buffer size per pass.  On v5e hardware read/write contention holds the
+    aggregate near ~340 GB/s vs ~764 GB/s read-only — report both.
+    """
+    if k2 <= k1:
+        raise ValueError("k2 must exceed k1")
+    x, block_rows = _hbm_buffer(mb, block_rows, cols, device)
+    dt = _delta_time(
+        lambda: _hbm_copy_loop(x, block_rows, k1),
+        lambda: _hbm_copy_loop(x, block_rows, k2),
+    )
+    nbytes = x.size * 4
+    return ProbeResult(
+        value=2.0 * nbytes * (k2 - k1) / dt / 1e9,
+        elapsed_s=dt,
+        detail={"mb": nbytes // (1024 * 1024), "block_rows": block_rows,
+                "cols": cols, "k1": k1, "k2": k2, "mode": "copy"},
     )
 
 

@@ -54,7 +54,13 @@ class ProbeSource(MetricsSource):
         self.matmul_size = int(cfg.extra.get("probe_matmul_size", 2048))
         self.matmul_iters = int(cfg.extra.get("probe_matmul_iters", 16))
         self.hbm_mb = int(cfg.extra.get("probe_hbm_mb", 256))
-        self.hbm_k2 = int(cfg.extra.get("probe_hbm_k2", 9))
+        self.hbm_k1 = int(cfg.extra.get("probe_hbm_k1", 4))
+        self.hbm_k2 = int(cfg.extra.get("probe_hbm_k2", 44))
+        if self.hbm_k2 <= self.hbm_k1:
+            raise ValueError(
+                f"probe_hbm_k2 ({self.hbm_k2}) must exceed probe_hbm_k1 "
+                f"({self.hbm_k1})"
+            )
         self.ici_mb = int(cfg.extra.get("probe_ici_mb", 16))
         self.heavy_interval = float(cfg.extra.get("probe_heavy_interval", 30.0))
         self._last_heavy: float = 0.0
@@ -72,7 +78,9 @@ class ProbeSource(MetricsSource):
                 self.matmul_size, self.matmul_iters, device=dev
             )
             self._cache[f"tflops_{i}"] = mm.value
-            hbm = hbm_bandwidth_probe(self.hbm_mb, k2=self.hbm_k2, device=dev)
+            hbm = hbm_bandwidth_probe(
+                self.hbm_mb, k1=self.hbm_k1, k2=self.hbm_k2, device=dev
+            )
             self._cache[f"hbm_gbps_{i}"] = hbm.value
 
         if jax.local_device_count() > 1:
