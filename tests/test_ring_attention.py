@@ -1,0 +1,90 @@
+"""Ring attention (sequence parallelism) on the virtual 8-device CPU mesh.
+
+Correctness oracle: the unsharded softmax attention — ring + online
+softmax must reproduce it exactly (up to f32 accumulation order)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudash.models.ring_attention import (
+    make_ring_train_step,
+    reference_attention,
+    ring_attention,
+)
+from tpudash.models.workload import WorkloadConfig, make_train_state
+from tpudash.parallel.mesh import build_mesh
+
+
+def _mesh(dp, sp):
+    return build_mesh({"dp": dp, "sp": sp}, devices=jax.devices()[: dp * sp])
+
+
+def _qkv(key, B, T, H, hd, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (B, T, H, hd), dtype),
+        jax.random.normal(kk, (B, T, H, hd), dtype),
+        jax.random.normal(kv, (B, T, H, hd), dtype),
+    )
+
+
+def test_ring_matches_reference_causal():
+    mesh = _mesh(2, 4)
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 32, 2, 8, jnp.float32)
+    out = ring_attention(q, k, v, mesh)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_matches_reference_non_causal():
+    mesh = _mesh(1, 8)
+    q, k, v = _qkv(jax.random.PRNGKey(1), 1, 64, 4, 16, jnp.float32)
+    out = ring_attention(q, k, v, mesh, causal=False)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_sp1_degenerates_to_local():
+    mesh = _mesh(8, 1)
+    q, k, v = _qkv(jax.random.PRNGKey(2), 8, 16, 2, 8, jnp.float32)
+    out = ring_attention(q, k, v, mesh)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_is_differentiable():
+    mesh = _mesh(2, 4)
+    q, k, v = _qkv(jax.random.PRNGKey(3), 2, 16, 2, 8, jnp.float32)
+
+    def scalar(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    def scalar_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g = jax.grad(scalar)(q, k, v)
+    g_ref = jax.grad(scalar_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+
+def test_ring_train_step_runs_and_loss_decreases():
+    mesh = _mesh(2, 4)
+    cfg = dataclasses.replace(
+        WorkloadConfig(), vocab=64, d_model=32, n_heads=2, n_layers=2,
+        d_ff=64, seq=32, batch=4, lr=1e-2,
+    )
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), cfg)
+    step, shard_inputs = make_ring_train_step(mesh, cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab
+    )
+    params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
+    params, opt_state, first = step(params, opt_state, tokens)
+    first = float(first)
+    assert np.isfinite(first)
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    assert float(loss) < first
