@@ -1,0 +1,132 @@
+"""MultiSource: the multi-slice (DCN) scrape join."""
+
+import pytest
+
+from tpudash.config import Config
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.fixture import SyntheticSource
+from tpudash.sources.multi import EndpointSpec, MultiSource, parse_endpoints
+
+
+class _Failing(MetricsSource):
+    name = "failing"
+
+    def fetch(self):
+        raise SourceError("boom")
+
+
+def _child(slice_name, url="http://x/api/v1/query", chips=4):
+    return (EndpointSpec(url=url, slice_name=slice_name),
+            SyntheticSource(num_chips=chips))
+
+
+def test_parse_endpoint_specs():
+    eps = parse_endpoints(
+        "slice-a=http://prom-a:9090/api/v1/query, http://host:9100/metrics"
+    )
+    assert eps[0].slice_name == "slice-a"
+    assert eps[0].url == "http://prom-a:9090/api/v1/query"
+    assert eps[1].slice_name is None
+    assert eps[1].url == "http://host:9100/metrics"
+
+
+def test_parse_endpoints_rejects_empty():
+    with pytest.raises(ValueError):
+        parse_endpoints("  , ")
+
+
+def test_url_with_port_and_no_name_is_not_split_on_equals():
+    # '=' only counts as a name separator when it precedes the scheme
+    ep = EndpointSpec.parse("http://prom:9090/api/v1/query?x=1")
+    assert ep.slice_name is None
+    assert ep.url.endswith("x=1")
+
+
+def test_join_relabels_slices():
+    src = MultiSource(Config(), children=[_child("slice-a"), _child("slice-b")])
+    samples = src.fetch()
+    slices = {s.chip.slice_id for s in samples}
+    assert slices == {"slice-a", "slice-b"}
+    assert src.last_errors == {}
+
+
+def test_join_without_relabel_keeps_child_labels():
+    src = MultiSource(Config(), children=[_child(None)])
+    samples = src.fetch()
+    assert {s.chip.slice_id for s in samples} == {"slice-0"}
+
+
+def test_partial_failure_keeps_healthy_slices():
+    children = [
+        _child("slice-a"),
+        (EndpointSpec(url="http://bad", slice_name="slice-b"), _Failing()),
+    ]
+    src = MultiSource(Config(), children=children)
+    samples = src.fetch()
+    assert {s.chip.slice_id for s in samples} == {"slice-a"}
+    assert "slice-b" in src.last_errors
+
+
+def test_all_failures_raise():
+    children = [
+        (EndpointSpec(url="http://bad1", slice_name="a"), _Failing()),
+        (EndpointSpec(url="http://bad2", slice_name="b"), _Failing()),
+    ]
+    src = MultiSource(Config(), children=children)
+    with pytest.raises(SourceError, match="all 2 endpoints failed"):
+        src.fetch()
+
+
+def test_factory_builds_prometheus_and_scrape_children():
+    from tpudash.sources import make_source
+
+    cfg = Config(
+        source="multi",
+        multi_endpoints=(
+            "s0=http://prom-a:9090/api/v1/query,s1=http://host:9100/metrics"
+        ),
+    )
+    src = make_source(cfg)
+    kinds = [type(child).__name__ for _, child in src.children]
+    assert kinds == ["PrometheusSource", "ScrapeSource"]
+    # each child got its own endpoint
+    assert src.children[0][1].cfg.prometheus_endpoint == "http://prom-a:9090/api/v1/query"
+    assert src.children[1][1].cfg.scrape_url == "http://host:9100/metrics"
+
+
+def test_multi_slice_frame_renders_dcn_panel():
+    """End-to-end: joined 2-slice samples → normalized frame with DCN panel
+    and per-slice heatmaps."""
+    from tpudash.app.service import DashboardService
+
+    # two single-slice children, each an exporter that emits its own DCN
+    # counters — the realistic multi-slice join shape
+    children = [
+        (EndpointSpec("u0", "slice-a"), SyntheticSource(num_chips=8, emit_dcn=True)),
+        (EndpointSpec("u1", "slice-b"), SyntheticSource(num_chips=8, emit_dcn=True)),
+    ]
+    cfg = Config(source="multi", per_chip_panel_limit=4)
+    svc = DashboardService(cfg, MultiSource(cfg, children=children))
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    panels = [p["column"] for p in frame["panel_specs"]]
+    assert "dcn_total_gbps" in panels
+    heatmap_slices = {h["slice"] for h in frame["heatmaps"]}
+    assert heatmap_slices == {"slice-a", "slice-b"}
+
+
+def test_partial_failure_surfaces_frame_warnings():
+    from tpudash.app.service import DashboardService
+
+    children = [
+        (EndpointSpec("u0", "slice-a"), SyntheticSource(num_chips=4)),
+        (EndpointSpec("u1", "slice-b"), _Failing()),
+    ]
+    cfg = Config(source="multi")
+    svc = DashboardService(cfg, MultiSource(cfg, children=children))
+    frame = svc.render_frame()
+    assert frame["error"] is None  # healthy slice still renders
+    assert any("slice-b" in w for w in frame["warnings"])
+    assert {c["slice"] for c in frame["chips"]} == {"slice-a"}

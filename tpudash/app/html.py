@@ -23,6 +23,8 @@ PAGE = r"""<!DOCTYPE html>
   .wrap { padding: 16px 20px; }
   #error-banner { display: none; background: #fdeaea; color: #a8322a;
                   border: 1px solid #e74c3c; border-radius: 6px; padding: 10px 14px; margin-bottom: 12px; }
+  #warning-banner { display: none; background: #fdf6e3; color: #8a6d1a;
+                    border: 1px solid #e0b93f; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px; }
   .controls { display: flex; gap: 18px; align-items: center; margin-bottom: 10px; flex-wrap: wrap;}
   .controls label { font-size: 14px; }
   #chip-grid { display: grid; grid-template-columns: repeat(var(--grid-cols, 4), minmax(120px, 1fr));
@@ -53,6 +55,7 @@ PAGE = r"""<!DOCTYPE html>
 </header>
 <div class="wrap">
   <div id="error-banner"></div>
+  <div id="warning-banner"></div>
   <div class="controls">
     <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
     <button id="select-all">Select all</button>
@@ -201,6 +204,7 @@ async function refresh() {
   document.getElementById('last-updated').textContent = 'Last updated: ' + frame.last_updated;
   if (!timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
   showError(frame.error);
+  showWarnings(frame.warnings);
   if (frame.error) return;  // keep last good panels (reference skips the cycle)
   document.getElementById('use-gauge').checked = frame.use_gauge;
   renderChips(frame.chips);
@@ -230,6 +234,12 @@ document.getElementById('select-none').addEventListener('click',
 function showError(msg) {
   const b = document.getElementById('error-banner');
   if (msg) { b.style.display = 'block'; b.textContent = msg; }
+  else b.style.display = 'none';
+}
+
+function showWarnings(list) {
+  const b = document.getElementById('warning-banner');
+  if (list && list.length) { b.style.display = 'block'; b.textContent = 'Degraded: ' + list.join(' · '); }
   else b.style.display = 'none';
 }
 

@@ -243,6 +243,13 @@ class DashboardService:
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
+        # partial degradation (MultiSource): healthy slices render, failed
+        # endpoints surface as warnings instead of blanking the page
+        partial = getattr(self.source, "last_errors", None)
+        if partial:
+            frame["warnings"] = [
+                f"endpoint {name}: {err}" for name, err in partial.items()
+            ]
         with self.timer.stage("render"):
             available = list(df.index)
             self.available = available

@@ -74,6 +74,11 @@ class Config:
     #: restarts — the reference loses state on any refresh (SURVEY §5
     #: checkpoint/resume: "none").  Empty string disables persistence.
     state_path: str = ""
+    #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
+    #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
+    #: URLs ending in /metrics are scraped directly; others are Prometheus
+    #: instant-query endpoints.
+    multi_endpoints: str = ""
 
     extra: dict = field(default_factory=dict)
 
@@ -99,6 +104,7 @@ _ENV_MAP = {
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
+    "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
 }
 
 

@@ -29,6 +29,10 @@ def make_source(cfg) -> MetricsSource:
         from tpudash.sources.scrape import ScrapeSource
 
         return ScrapeSource(cfg)
+    if kind == "multi":
+        from tpudash.sources.multi import MultiSource
+
+        return MultiSource(cfg)
     if kind == "workload":
         from tpudash.sources.workload import WorkloadSource  # imports jax
 

@@ -58,13 +58,17 @@ def synthetic_payload(
     num_slices: int = 1,
     chips_per_host: int = 4,
     idle_chips: tuple = (),
+    emit_dcn: bool | None = None,
 ) -> dict:
     """Build a Prometheus-shaped payload for a synthetic pod slice.
 
     Values vary smoothly with ``t`` (seconds) so the dashboard looks alive;
     they are deterministic functions of (chip, t) so tests can pin t.
     ``idle_chips`` report 0 W power (exercising the zero-exclusion averaging
-    path, reference app.py:341-345) and 0% utilization.
+    path, reference app.py:341-345) and 0% utilization.  ``emit_dcn``
+    defaults to (num_slices > 1); pass True to model a single slice of a
+    multi-slice deployment whose exporter emits its own DCN counters (the
+    MultiSource join shape).
     """
     gen = resolve_generation(generation) or TPU_GENERATIONS["v5e"]
     accel = gen.accelerator_types[0]
@@ -100,7 +104,7 @@ def synthetic_payload(
             emit(HBM_TOTAL, chip, sl, hbm_total)
             emit(ICI_TX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.8)
             emit(ICI_RX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.78)
-            if num_slices > 1:
+            if emit_dcn or (emit_dcn is None and num_slices > 1):
                 emit(DCN_TX, chip, sl, wave * 12e9)
                 emit(DCN_RX, chip, sl, wave * 11e9)
             emit(TEMPERATURE, chip, sl, 35.0 + 45.0 * wave)
@@ -120,11 +124,13 @@ class SyntheticSource(MetricsSource):
         generation: str = "v5e",
         num_slices: int = 1,
         idle_chips: tuple = (),
+        emit_dcn: bool | None = None,
     ):
         self.num_chips = num_chips
         self.generation = generation
         self.num_slices = num_slices
         self.idle_chips = tuple(idle_chips)
+        self.emit_dcn = emit_dcn
 
     def fetch(self):
         payload = synthetic_payload(
@@ -132,5 +138,6 @@ class SyntheticSource(MetricsSource):
             generation=self.generation,
             num_slices=self.num_slices,
             idle_chips=self.idle_chips,
+            emit_dcn=self.emit_dcn,
         )
         return parse_instant_query(payload)
