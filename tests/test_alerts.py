@@ -1,0 +1,118 @@
+"""Alert rules: parsing, hysteresis state machine, frame integration."""
+
+import pandas as pd
+import pytest
+
+from tpudash.alerts import (
+    DEFAULT_RULES_SPEC,
+    AlertEngine,
+    AlertRule,
+    parse_rules,
+)
+
+
+def _df(temp_by_chip: dict, **extra_cols):
+    df = pd.DataFrame(
+        {"tpu_temperature_celsius": pd.Series(temp_by_chip), **extra_cols}
+    )
+    df.index.name = "chip"
+    return df
+
+
+# --- parsing ----------------------------------------------------------------
+
+def test_parse_full_grammar():
+    rules = parse_rules("tpu_temperature_celsius>85:critical@3, hbm_usage_ratio>=90")
+    assert rules[0] == AlertRule(
+        "tpu_temperature_celsius", ">", 85.0, "critical", 3
+    )
+    assert rules[1] == AlertRule("hbm_usage_ratio", ">=", 90.0, "warning", 1)
+
+
+def test_parse_severity_aliases_and_lt():
+    (r,) = parse_rules("tpu_tensorcore_utilization<5:warn@4")
+    assert r.severity == "warning" and r.op == "<" and r.for_cycles == 4
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rules("temp !! 85")
+    with pytest.raises(ValueError):
+        parse_rules("temp>85:fatal")
+
+
+def test_default_spec_parses():
+    assert len(parse_rules(DEFAULT_RULES_SPEC)) == 2
+
+
+# --- state machine ----------------------------------------------------------
+
+def test_pending_then_firing_after_for_cycles():
+    eng = AlertEngine.from_spec("tpu_temperature_celsius>85:critical@2", clock=lambda: 100.0)
+    hot = _df({"s/0": 90.0, "s/1": 60.0})
+    first = eng.evaluate(hot)
+    assert [a["state"] for a in first] == ["pending"]
+    second = eng.evaluate(hot)
+    assert [a["state"] for a in second] == ["firing"]
+    assert second[0]["chip"] == "s/0"
+    assert second[0]["since"] == 100.0
+    assert second[0]["streak"] == 2
+
+
+def test_recovery_resets_streak():
+    eng = AlertEngine.from_spec("tpu_temperature_celsius>85@2")
+    eng.evaluate(_df({"s/0": 90.0}))
+    assert eng.evaluate(_df({"s/0": 70.0})) == []  # breach cleared
+    # breach again: streak restarts at 1 → pending, not firing
+    assert eng.evaluate(_df({"s/0": 90.0}))[0]["state"] == "pending"
+
+
+def test_chip_disappearing_resolves_alert():
+    eng = AlertEngine.from_spec("tpu_temperature_celsius>85@1")
+    assert eng.evaluate(_df({"s/0": 90.0}))[0]["state"] == "firing"
+    eng.evaluate(_df({"s/1": 50.0}))  # s/0 left the table
+    # s/0 returns breaching: treated as a fresh alert (streak 1)
+    assert eng.evaluate(_df({"s/0": 90.0}))[0]["streak"] == 1
+
+
+def test_missing_column_is_skipped():
+    eng = AlertEngine.from_spec("no_such_column>1")
+    assert eng.evaluate(_df({"s/0": 90.0})) == []
+
+
+def test_ordering_firing_and_critical_first():
+    eng = AlertEngine.from_spec(
+        "tpu_temperature_celsius>85:warning@1, hbm_usage_ratio>90:critical@1"
+    )
+    df = _df({"s/0": 90.0, "s/1": 91.0}, hbm_usage_ratio=pd.Series({"s/1": 95.0}))
+    out = eng.evaluate(df)
+    assert out[0]["severity"] == "critical"
+
+
+# --- frame integration ------------------------------------------------------
+
+def test_frame_carries_alerts_and_endpoint_serves_them():
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(
+        source="synthetic",
+        alert_rules="tpu_tensorcore_utilization>=0@1",  # always firing
+    )
+    svc = DashboardService(cfg, SyntheticSource(num_chips=4))
+    frame = svc.render_frame()
+    assert len(frame["alerts"]) == 4
+    assert all(a["state"] == "firing" for a in frame["alerts"])
+    assert svc.last_alerts == frame["alerts"]
+
+
+def test_alerts_disabled():
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(source="synthetic", alert_rules="off")
+    svc = DashboardService(cfg, SyntheticSource(num_chips=4))
+    frame = svc.render_frame()
+    assert "alerts" not in frame

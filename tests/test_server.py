@@ -147,3 +147,20 @@ def test_make_app_from_config():
     cfg = Config(source="synthetic", synthetic_chips=4)
     app = make_app(cfg)
     assert app is not None
+
+
+def test_alerts_endpoint():
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        alert_rules="tpu_tensorcore_utilization>=0@1",
+    )
+
+    async def go(client):
+        await client.get("/api/frame")  # render once to populate alerts
+        resp = await client.get("/api/alerts")
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["alerts"], "expected firing alerts from the >=0 rule"
+        assert data["alerts"][0]["state"] == "firing"
+
+    _run(_with_client(_client_app(cfg=cfg), go))

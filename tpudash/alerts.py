@@ -1,0 +1,185 @@
+"""Threshold alert rules over the per-chip wide table.
+
+The reference has no alerting of any kind (SURVEY.md §5 "failure
+detection: limited to the catch-all error banner", app.py:225-227) — the
+operator is expected to stare at gauges.  tpudash evaluates Prometheus
+`alerting rule`-style threshold rules on every frame, with a ``for``-style
+hysteresis (a rule must breach N consecutive frames before it fires, so a
+single noisy scrape doesn't page anyone), and surfaces firing alerts in
+the frame, the ``/api/alerts`` endpoint and the page banner.
+
+Rule spec grammar (``TPUDASH_ALERT_RULES``, comma-separated):
+
+    column OP threshold [: severity] [@ cycles]
+
+e.g. ``tpu_temperature_celsius>85:critical@2, hbm_usage_ratio>90:warning``.
+OP is one of ``>`` ``>=`` ``<`` ``<=``; severity defaults to "warning";
+cycles (the consecutive-breach requirement) defaults to 1.
+"""
+
+from __future__ import annotations
+
+import operator
+import re
+import time
+from dataclasses import dataclass, field
+
+import pandas as pd
+
+_OPS = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "<": operator.lt,
+    "<=": operator.le,
+}
+
+SEVERITIES = ("warning", "critical")
+
+#: Default rules: conservative hardware-health thresholds.  Temperature and
+#: HBM-pressure limits apply across generations; both require 2 consecutive
+#: breaching frames.
+DEFAULT_RULES_SPEC = (
+    "tpu_temperature_celsius>85:critical@2,"
+    "hbm_usage_ratio>92:warning@2"
+)
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    column: str
+    op: str
+    threshold: float
+    severity: str = "warning"
+    for_cycles: int = 1
+
+    @property
+    def name(self) -> str:
+        return f"{self.column}{self.op}{self.threshold:g}"
+
+    def breaches(self, value: float) -> bool:
+        return bool(_OPS[self.op](value, self.threshold))
+
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<column>[A-Za-z_][A-Za-z0-9_]*)\s*"
+    r"(?P<op>>=|<=|>|<)\s*"
+    r"(?P<threshold>-?[0-9.]+)\s*"
+    r"(?::\s*(?P<severity>[A-Za-z]+))?\s*"
+    r"(?:@\s*(?P<cycles>[0-9]+))?\s*$"
+)
+
+
+def parse_rules(spec: str) -> list[AlertRule]:
+    rules = []
+    for item in spec.split(","):
+        if not item.strip():
+            continue
+        m = _RULE_RE.match(item)
+        if not m:
+            raise ValueError(f"bad alert rule spec: {item!r}")
+        severity = (m.group("severity") or "warning").lower()
+        if severity in ("crit", "critical"):
+            severity = "critical"
+        elif severity in ("warn", "warning"):
+            severity = "warning"
+        else:
+            raise ValueError(
+                f"bad severity {severity!r} in rule {item!r} "
+                f"(expected one of {SEVERITIES})"
+            )
+        rules.append(
+            AlertRule(
+                column=m.group("column"),
+                op=m.group("op"),
+                threshold=float(m.group("threshold")),
+                severity=severity,
+                for_cycles=int(m.group("cycles") or 1),
+            )
+        )
+    return rules
+
+
+@dataclass
+class _Track:
+    streak: int = 0
+    firing_since: float | None = None
+    last_value: float = 0.0
+
+
+@dataclass
+class AlertEngine:
+    """Per-frame rule evaluation with consecutive-breach hysteresis.
+
+    State machine per (rule, chip): ok → pending (breaching, streak <
+    for_cycles) → firing; any non-breaching frame resets to ok.
+    """
+
+    rules: list[AlertRule]
+    clock: "object" = time.time
+    _tracks: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_spec(cls, spec: str | None = None, clock=time.time) -> "AlertEngine":
+        return cls(rules=parse_rules(
+            DEFAULT_RULES_SPEC if spec is None else spec
+        ), clock=clock)
+
+    def evaluate(self, df: pd.DataFrame) -> list[dict]:
+        """Evaluate all rules against the wide table (index = chip key).
+
+        Returns firing+pending alerts, critical first, then by chip key.
+        Chips that left the table (scrape gap, reconfiguration) are
+        dropped from tracking — their alerts resolve implicitly.
+        """
+        now = float(self.clock())
+        seen = set()
+        out = []
+        for rule in self.rules:
+            if rule.column not in df.columns:
+                continue
+            series = pd.to_numeric(df[rule.column], errors="coerce")
+            for chip_key, value in series.items():
+                if pd.isna(value):
+                    continue
+                tkey = (rule.name, chip_key)
+                seen.add(tkey)
+                track = self._tracks.get(tkey)
+                if not rule.breaches(float(value)):
+                    if track is not None:
+                        del self._tracks[tkey]
+                    continue
+                if track is None:
+                    track = self._tracks[tkey] = _Track()
+                track.streak += 1
+                track.last_value = float(value)
+                firing = track.streak >= rule.for_cycles
+                if firing and track.firing_since is None:
+                    track.firing_since = now
+                out.append(
+                    {
+                        "rule": rule.name,
+                        "column": rule.column,
+                        "severity": rule.severity,
+                        "chip": str(chip_key),
+                        "value": round(float(value), 2),
+                        "threshold": rule.threshold,
+                        "state": "firing" if firing else "pending",
+                        "since": track.firing_since,
+                        "streak": track.streak,
+                    }
+                )
+        # implicit resolution for chips/rules not seen this frame
+        for tkey in list(self._tracks):
+            if tkey not in seen:
+                del self._tracks[tkey]
+        out.sort(
+            key=lambda a: (
+                a["state"] != "firing",
+                a["severity"] != "critical",
+                a["chip"],
+            )
+        )
+        return out
+
+    def firing(self, alerts: list[dict] | None = None) -> list[dict]:
+        return [a for a in (alerts or []) if a["state"] == "firing"]

@@ -25,6 +25,9 @@ PAGE = r"""<!DOCTYPE html>
                   border: 1px solid #e74c3c; border-radius: 6px; padding: 10px 14px; margin-bottom: 12px; }
   #warning-banner { display: none; background: #fdf6e3; color: #8a6d1a;
                     border: 1px solid #e0b93f; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px; }
+  #alert-banner { display: none; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px;
+                  background: #fdeaea; color: #a8322a; border: 1px solid #e74c3c; }
+  #alert-banner.warning { background: #fdf6e3; color: #8a6d1a; border-color: #e0b93f; }
   .controls { display: flex; gap: 18px; align-items: center; margin-bottom: 10px; flex-wrap: wrap;}
   .controls label { font-size: 14px; }
   #chip-grid { display: grid; grid-template-columns: repeat(var(--grid-cols, 4), minmax(120px, 1fr));
@@ -56,6 +59,7 @@ PAGE = r"""<!DOCTYPE html>
 <div class="wrap">
   <div id="error-banner"></div>
   <div id="warning-banner"></div>
+  <div id="alert-banner"></div>
   <div class="controls">
     <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
     <button id="select-all">Select all</button>
@@ -205,6 +209,7 @@ async function refresh() {
   if (!timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
   showError(frame.error);
   showWarnings(frame.warnings);
+  showAlerts(frame.alerts);
   if (frame.error) return;  // keep last good panels (reference skips the cycle)
   document.getElementById('use-gauge').checked = frame.use_gauge;
   renderChips(frame.chips);
@@ -235,6 +240,18 @@ function showError(msg) {
   const b = document.getElementById('error-banner');
   if (msg) { b.style.display = 'block'; b.textContent = msg; }
   else b.style.display = 'none';
+}
+
+function showAlerts(list) {
+  const b = document.getElementById('alert-banner');
+  const firing = (list || []).filter(a => a.state === 'firing');
+  if (!firing.length) { b.style.display = 'none'; return; }
+  const critical = firing.some(a => a.severity === 'critical');
+  b.className = critical ? '' : 'warning';
+  b.style.display = 'block';
+  b.textContent = '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
+    .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
+    (firing.length > 8 ? ' \u2026' : '');
 }
 
 function showWarnings(list) {

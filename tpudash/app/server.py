@@ -129,6 +129,12 @@ class DashboardServer:
             {"history": [{"ts": ts, "averages": avgs} for ts, avgs in snapshot]}
         )
 
+    async def alerts(self, request: web.Request) -> web.Response:
+        """Current alert states (firing + pending), critical first."""
+        async with self._lock:
+            snapshot = list(self.service.last_alerts)
+        return web.json_response({"alerts": snapshot})
+
     async def healthz(self, request: web.Request) -> web.Response:
         return web.json_response(
             {"ok": True, "source": self.service.source.name,
@@ -143,6 +149,7 @@ class DashboardServer:
         app.router.add_post("/api/style", self.style)
         app.router.add_get("/api/timings", self.timings)
         app.router.add_get("/api/history", self.history)
+        app.router.add_get("/api/alerts", self.alerts)
         app.router.add_get("/healthz", self.healthz)
         return app
 

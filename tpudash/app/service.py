@@ -73,6 +73,15 @@ class DashboardService:
         #: frame — trend history the reference never kept.  At the default
         #: 5 s cadence, 720 points ≈ one hour.
         self.history: deque = deque(maxlen=720)
+        #: threshold alerting over every chip in the table (not just the
+        #: selected ones) — see tpudash.alerts
+        if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
+            self.alert_engine = None
+        else:
+            from tpudash.alerts import AlertEngine
+
+            self.alert_engine = AlertEngine.from_spec(cfg.alert_rules or None)
+        self.last_alerts: list[dict] = []
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -243,6 +252,10 @@ class DashboardService:
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
+        if self.alert_engine is not None:
+            with self.timer.stage("alerts"):
+                self.last_alerts = self.alert_engine.evaluate(df)
+            frame["alerts"] = self.last_alerts
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)

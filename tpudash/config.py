@@ -74,6 +74,9 @@ class Config:
     #: restarts — the reference loses state on any refresh (SURVEY §5
     #: checkpoint/resume: "none").  Empty string disables persistence.
     state_path: str = ""
+    #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
+    #: defaults; "off" disables alerting.
+    alert_rules: str = ""
     #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
     #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
     #: URLs ending in /metrics are scraped directly; others are Prometheus
@@ -105,6 +108,7 @@ _ENV_MAP = {
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
+    "alert_rules": "TPUDASH_ALERT_RULES",
 }
 
 
