@@ -67,7 +67,7 @@ NON_NUMERIC_COLUMNS: tuple[str, ...] = (ACCEL_TYPE,)
 ZERO_EXCLUDED_METRICS: tuple[str, ...] = (POWER,)
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ChipKey:
     """Identity of one chip: (slice, host, chip) + global dashboard id.
 
@@ -86,7 +86,7 @@ class ChipKey:
         return f"{self.slice_id}/{self.chip_id}"
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Sample:
     """One Prometheus-style instant sample, already label-parsed.
 

@@ -58,6 +58,11 @@ def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[S
         raise SourceError(f"malformed prometheus payload: {e}") from e
 
     samples: list[Sample] = []
+    # chips repeat across the ~9 series each emits — intern the ChipKey per
+    # (slice, host, chip) so a 256-chip scrape builds 256 keys, not 2300
+    # (this parse is the hottest stage of the frame at 256 chips)
+    chip_cache: dict[tuple, ChipKey] = {}
+    append = samples.append
     for item in results:
         metric = item.get("metric", {})
         name = metric.get("__name__")
@@ -68,27 +73,35 @@ def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[S
             val = float(value[1])
         except (TypeError, ValueError):
             continue
-        chip_label = metric.get("chip_id", metric.get("gpu_id"))
+        chip_label = metric.get("chip_id")
         if chip_label is None:
-            continue
+            chip_label = metric.get("gpu_id")
+            if chip_label is None:
+                continue
         try:
             chip_id = int(chip_label)
         except (TypeError, ValueError):
             continue
-        chip = ChipKey(
-            slice_id=metric.get("slice", default_slice),
-            host=metric.get("host", metric.get("instance", "")),
-            chip_id=chip_id,
-        )
-        samples.append(
+        slice_id = metric.get("slice", default_slice)
+        host = metric.get("host")
+        if host is None:
+            host = metric.get("instance", "")
+        ckey = (slice_id, host, chip_id)
+        chip = chip_cache.get(ckey)
+        if chip is None:
+            chip = chip_cache[ckey] = ChipKey(
+                slice_id=slice_id, host=host, chip_id=chip_id
+            )
+        accel = metric.get("accelerator")
+        if accel is None:
+            accel = metric.get("card_model", "")
+        append(
             Sample(
                 metric=name,
                 value=val,
                 chip=chip,
-                accelerator_type=metric.get(
-                    "accelerator", metric.get("card_model", "")
-                ),
-                labels=dict(metric),
+                accelerator_type=accel,
+                labels=metric,
             )
         )
     return samples

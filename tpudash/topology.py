@@ -15,6 +15,7 @@ Conventions:
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 
@@ -140,23 +141,38 @@ def topology_for(generation: str | TpuGeneration | None, num_chips: int) -> Topo
     return Topology(generation=str(name), dims=tuple(dims))
 
 
-def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:
-    """Project per-chip values onto the torus as a 2D grid (list of rows) for
-    the heatmap figure.  3D toruses are unrolled into Z-planes laid out side
-    by side with a one-column gap (None) between planes; missing chips are
-    None (rendered as gaps)."""
+@functools.lru_cache(maxsize=64)
+def grid_layout(topo: Topology) -> tuple:
+    """Cached per-topology grid geometry: (ny, width, cells) where
+    ``cells[chip_id] == (row, col)`` in the rendered 2D grid.  3D toruses
+    are unrolled into Z-planes laid out side by side with a one-column gap
+    between planes.  Heatmaps rebuild every frame; the geometry never
+    changes for a given topology, so it is computed once."""
     nx = topo.dims[0]
     ny = topo.dims[1] if topo.rank >= 2 else 1
     if topo.rank == 2:
-        grid = [[None] * nx for _ in range(ny)]
-        for cid, v in values.items():
-            x, y = topo.coords(cid)
-            grid[y][x] = v
-        return grid
-    nz = topo.dims[2]
-    width = nz * nx + (nz - 1)  # planes side by side, 1-col gaps
+        width = nx
+        cells = tuple(
+            (cid // nx, cid % nx) for cid in range(topo.num_chips)
+        )
+    else:
+        nz = topo.dims[2]
+        width = nz * nx + (nz - 1)  # planes side by side, 1-col gaps
+        plane = nx * ny
+        cells = tuple(
+            ((cid % plane) // nx, (cid // plane) * (nx + 1) + cid % nx)
+            for cid in range(topo.num_chips)
+        )
+    return ny, width, cells
+
+
+def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:
+    """Project per-chip values onto the torus as a 2D grid (list of rows) for
+    the heatmap figure; missing chips and inter-plane gap columns are None
+    (rendered as gaps)."""
+    ny, width, cells = grid_layout(topo)
     grid = [[None] * width for _ in range(ny)]
     for cid, v in values.items():
-        x, y, z = topo.coords(cid)
-        grid[y][z * (nx + 1) + x] = v
+        y, x = cells[cid]
+        grid[y][x] = v
     return grid

@@ -16,8 +16,19 @@ carries per-chip detail at 256-chip scale where one-figure-per-chip cannot
 
 from __future__ import annotations
 
+import functools
+
 from tpudash.colors import band_steps, color_for_value
-from tpudash.topology import Topology, heatmap_grid
+from tpudash.topology import Topology, grid_layout, heatmap_grid
+
+
+@functools.lru_cache(maxsize=64)
+def _hover_prefixes(topo: Topology) -> tuple:
+    """Cached per-topology hover-text prefixes ("chip N (x, y)<br>") — the
+    only per-frame part of the hover label is the value suffix."""
+    return tuple(
+        f"chip {cid} {topo.coords(cid)}<br>" for cid in range(topo.num_chips)
+    )
 
 
 def create_gauge(
@@ -171,16 +182,13 @@ def create_topology_heatmap(
     (x, y) in torus coordinates; hover text carries chip id and value.
     """
     grid = heatmap_grid(topo, values)
-    ny = len(grid)
-    nx = len(grid[0]) if grid else 0
+    ny, nx, cells = grid_layout(topo)
 
-    hover = [["" for _ in range(nx)] for _ in range(ny)]
+    prefixes = _hover_prefixes(topo)
+    hover = [[""] * nx for _ in range(ny)]
     for cid, v in values.items():
-        coords = topo.coords(cid)
-        x, y = coords[0], coords[1]
-        col = x if topo.rank == 2 else coords[2] * (topo.dims[0] + 1) + x
-        label = f"chip {cid} {tuple(coords)}<br>{v:.1f}{unit}"
-        hover[y][col] = label
+        y, col = cells[cid]
+        hover[y][col] = f"{prefixes[cid]}{v:.1f}{unit}"
 
     return {
         "data": [
