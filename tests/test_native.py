@@ -1,0 +1,339 @@
+"""Native frame-kernel parity: the C++ data plane must produce frames
+bit-identical to the pure-Python parsers (sources/base.parse_instant_query,
+exporter/textfmt.parse_text_format) and stats identical to
+normalize.compute_stats / column_average.
+
+The kernel auto-builds from tpudash/native/frame_kernel.cc on first load
+(g++ is part of the supported toolchain); if a build is genuinely
+impossible the whole module skips — every production caller falls back to
+Python transparently.
+"""
+
+import json
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpudash import native, schema
+from tpudash.exporter.textfmt import encode_samples, parse_text_format
+from tpudash.normalize import column_average, compute_stats, to_wide
+from tpudash.sources.base import SourceError, parse_instant_query
+from tpudash.sources.fixture import synthetic_payload
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(), reason="native kernel unavailable (no g++?)"
+)
+
+
+def assert_frames_equal(batch, df_py):
+    """Columnar batch ≡ the Python wide table (values, order, identity)."""
+    assert batch.keys == list(df_py.index)
+    assert batch.slices == df_py["slice_id"].tolist()
+    assert batch.hosts == df_py["host"].tolist()
+    assert [int(c) for c in batch.chip_ids] == df_py["chip_id"].tolist()
+    assert batch.accels == df_py[schema.ACCEL_TYPE].tolist()
+    for i, m in enumerate(batch.metrics):
+        np.testing.assert_allclose(
+            batch.matrix[:, i],
+            df_py[m].to_numpy(dtype=float),
+            equal_nan=True,
+            err_msg=m,
+        )
+
+
+# --- instant-query JSON parity ---------------------------------------------
+
+def test_promjson_parity_synthetic_multislice():
+    payload = synthetic_payload(num_chips=16, t=1234.5, num_slices=2)
+    batch = native.parse_promjson(json.dumps(payload))
+    df_py = to_wide(parse_instant_query(payload))
+    assert_frames_equal(batch, df_py)
+
+
+def test_promjson_parity_tolerant_skipping():
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "0"},
+             "value": [0, "5"]},
+            {"metric": {"__name__": "tpu_power_watts"}, "value": [0, "5"]},
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "x"},
+             "value": [0, "5"]},
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "1"},
+             "value": [0, "5.5.5"]},
+            {"metric": {"chip_id": "2"}, "value": [0, "5"]},
+            {"metric": {"__name__": "tpu_power_watts", "chip_id": "3"},
+             "value": [0]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    df_py = to_wide(parse_instant_query(payload))
+    assert batch.nrows == 1
+    assert_frames_equal(batch, df_py)
+
+
+def test_promjson_legacy_gpu_labels():
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "tpu_power_watts", "gpu_id": "3",
+                        "card_model": "tpu-v4-podslice",
+                        "instance": "10.0.0.1:9400"},
+             "value": [0, "55.5"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    df_py = to_wide(parse_instant_query(payload))
+    assert batch.hosts == ["10.0.0.1:9400"]
+    assert batch.accels == ["tpu-v4-podslice"]
+    assert_frames_equal(batch, df_py)
+
+
+def test_promjson_numeric_value_and_escapes():
+    # JSON-number value element; escaped + unicode label values
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "m", "chip_id": "0",
+                        "host": 'a"b\\c\nd é€'},
+             "value": [0, 7.25]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    df_py = to_wide(parse_instant_query(payload))
+    assert batch.hosts == ['a"b\\c\nd éé'.replace("éé", "é€")]
+    assert_frames_equal(batch, df_py)
+
+
+def test_promjson_error_status_and_malformed():
+    with pytest.raises(native.NativeParseError, match="status"):
+        native.parse_promjson(b'{"status": "error", "error": "boom"}')
+    with pytest.raises(native.NativeParseError, match="malformed"):
+        native.parse_promjson(b'{"status": "success", "data": {}}')
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(b"not json at all")
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(b'{"status": "success", "data": {"result": [')
+
+
+def test_promjson_numeric_chip_id_label():
+    # numeric label values are illegal Prometheus output but legal JSON;
+    # integer chip ids must still resolve (json.loads hands int through and
+    # Python's int() accepts it)
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "m", "chip_id": 5}, "value": [0, "1.5"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    assert batch.nrows == 1 and int(batch.chip_ids[0]) == 5
+    df_py = to_wide(parse_instant_query(payload))
+    assert_frames_equal(batch, df_py)
+
+
+def test_promjson_duplicate_label_keys_last_wins():
+    raw = (
+        b'{"status":"success","data":{"result":['
+        b'{"metric":{"__name__":"m","chip_id":"0","host":"a","host":"b"},'
+        b'"value":[0,"1"]}]}}'
+    )
+    batch = native.parse_promjson(raw)
+    assert batch.hosts == ["b"]  # json.loads semantics
+
+
+def test_promjson_large_chip_ids_stay_distinct():
+    # out-of-int32 ids must not wrap onto other chips' rows
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "m", "chip_id": "0"}, "value": [0, "1"]},
+            {"metric": {"__name__": "m", "chip_id": "4294967296"},
+             "value": [0, "99"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    assert batch.nrows == 2
+    assert sorted(int(c) for c in batch.chip_ids) == [0, 4294967296]
+    assert batch.matrix[list(batch.chip_ids).index(0), 0] == 1.0
+
+
+def test_promjson_nan_valued_samples_still_count():
+    # Prometheus legally returns "NaN" sample values; the frame must render
+    # (not error) exactly as the Python path does
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "m", "chip_id": "0"}, "value": [0, "NaN"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    assert len(batch) == len(parse_instant_query(payload)) == 1
+    df = to_wide(batch)  # renders a frame with a NaN cell, no raise
+    assert np.isnan(df["m"].iloc[0])
+
+
+def test_promjson_duplicate_series_last_write_wins():
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "m", "chip_id": "0"}, "value": [0, "1"]},
+            {"metric": {"__name__": "m", "chip_id": "0"}, "value": [0, "2"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    assert batch.matrix[0, 0] == 2.0
+
+
+# --- exposition text parity -------------------------------------------------
+
+def test_text_parity_roundtrip():
+    payload = synthetic_payload(num_chips=8, t=99.0)
+    samples = parse_instant_query(payload)
+    text = encode_samples(samples)
+    batch = native.parse_text(text)
+    df_py = to_wide(parse_text_format(text))
+    assert_frames_equal(batch, df_py)
+
+
+def test_text_parity_edge_cases():
+    text = "\n".join([
+        "# HELP m help",
+        "# TYPE m gauge",
+        'm{chip_id="0",slice="s",host="h"} 1.5',
+        "unlabeled_series 7",                       # skipped: no labels
+        'm{chip_id="1"} NaN',                       # skipped: non-finite
+        'm{chip_id="2"} +Inf',                      # skipped: non-finite
+        'm{slice="s"} 3',                           # skipped: no chip id
+        'm{chip_id="bad"} 3',                       # skipped: bad chip id
+        'm{gpu_id="4",card_model="x"} 2.25',        # legacy labels
+        'esc{chip_id="5",host="a\\"b\\\\c\\nd"} 1', # escapes
+        'm{chip_id="6"} 4 1700000000',              # trailing timestamp
+    ]) + "\n"
+    batch = native.parse_text(text)
+    df_py = to_wide(parse_text_format(text))
+    assert_frames_equal(batch, df_py)
+    assert 'a"b\\c\nd' in batch.hosts
+
+
+def test_text_malformed_raises_like_python():
+    bad = 'm{chip_id="0" 5\n'  # no closing brace
+    with pytest.raises(native.NativeParseError):
+        native.parse_text(bad)
+    from tpudash.exporter.textfmt import TextFormatError
+    with pytest.raises(TextFormatError):
+        parse_text_format(bad)
+
+
+def test_text_default_slice_applied():
+    batch = native.parse_text('m{chip_id="0"} 1\n', default_slice="sliceX")
+    assert batch.slices == ["sliceX"]
+
+
+# --- stats kernel parity ----------------------------------------------------
+
+def test_column_stats_parity_with_compute_stats():
+    payload = synthetic_payload(num_chips=32, t=77.0, idle_chips=(3, 9))
+    df = to_wide(parse_instant_query(payload))
+    batch = native.parse_promjson(json.dumps(payload))
+    df_b = to_wide(batch)
+    # both frame paths produce identical stats dicts
+    assert compute_stats(df).keys() == compute_stats(df_b).keys()
+    for m, s in compute_stats(df).items():
+        for k, v in s.items():
+            assert math.isclose(v, compute_stats(df_b)[m][k], rel_tol=1e-12), (m, k)
+
+
+def test_column_stats_zero_exclusion_and_empty():
+    m = np.array([
+        [0.0, 1.0, np.nan],
+        [2.0, np.nan, np.nan],
+        [4.0, 3.0, np.nan],
+    ])
+    mean, mx, mn, zmean, count = native.column_stats(
+        m, zero_excluded=np.array([1, 0, 0], dtype=np.uint8)
+    )
+    assert mean[0] == 2.0          # plain mean includes the zero
+    assert zmean[0] == 3.0         # zero-exclusion drops it
+    assert zmean[1] == mean[1] == 2.0
+    assert count.tolist() == [3, 2, 0]
+    assert np.isnan(mean[2]) and np.isnan(mx[2]) and np.isnan(mn[2])
+
+
+def test_column_average_parity_zero_exclusion():
+    payload = synthetic_payload(num_chips=8, t=50.0, idle_chips=(1,))
+    df_py = to_wide(parse_instant_query(payload))
+    df_b = to_wide(native.parse_promjson(json.dumps(payload)))
+    for col in (schema.POWER, schema.TENSORCORE_UTIL, schema.HBM_USAGE_RATIO):
+        a, b = column_average(df_py, col), column_average(df_b, col)
+        assert a is not None and b is not None
+        assert math.isclose(a, b, rel_tol=1e-12), col
+
+
+# --- batch utilities --------------------------------------------------------
+
+def test_batch_from_samples_matches_native():
+    payload = synthetic_payload(num_chips=8, t=42.0, num_slices=2)
+    samples = parse_instant_query(payload)
+    batch_py = schema.SampleBatch.from_samples(samples)
+    batch_n = native.parse_promjson(json.dumps(payload))
+    assert batch_py.keys == batch_n.keys
+    assert batch_py.metrics == batch_n.metrics
+    np.testing.assert_allclose(batch_py.matrix, batch_n.matrix, equal_nan=True)
+
+
+def test_batch_to_samples_roundtrip():
+    payload = synthetic_payload(num_chips=4, t=42.0)
+    batch = native.parse_promjson(json.dumps(payload))
+    df_roundtrip = to_wide(batch.to_samples())
+    assert_frames_equal(batch, df_roundtrip)
+    assert len(batch) == len(batch.to_samples())
+
+
+def test_batch_concat_merges_and_relabels():
+    p0 = synthetic_payload(num_chips=4, t=1.0)
+    p1 = synthetic_payload(num_chips=4, t=2.0)
+    b0 = native.parse_promjson(json.dumps(p0)).relabel_slice("east")
+    b1 = native.parse_promjson(json.dumps(p1)).relabel_slice("west")
+    joined = schema.SampleBatch.concat([b0, b1])
+    assert joined.nrows == 8
+    assert joined.slices == ["east"] * 4 + ["west"] * 4
+    # duplicate keys: later batch wins per cell
+    dup = schema.SampleBatch.concat(
+        [b0, native.parse_promjson(json.dumps(p1)).relabel_slice("east")]
+    )
+    assert dup.nrows == 4
+    i = dup.metrics.index(schema.TEMPERATURE)
+    expect = to_wide(parse_instant_query(p1))[schema.TEMPERATURE].to_numpy()
+    np.testing.assert_allclose(dup.matrix[:, i], expect)
+
+
+# --- end-to-end through the service ----------------------------------------
+
+def test_service_frame_identical_python_vs_native(monkeypatch):
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource, SyntheticSource
+
+    cfg = Config(source="synthetic", synthetic_chips=6, alert_rules="off")
+    payload_bytes = json.dumps(synthetic_payload(num_chips=6, t=500.0))
+
+    svc_native = DashboardService(cfg, JsonReplaySource([payload_bytes]))
+    frame_n = svc_native.render_frame()
+
+    class PySource(SyntheticSource):
+        def fetch(self):
+            return parse_instant_query(json.loads(payload_bytes))
+
+    svc_py = DashboardService(cfg, PySource(num_chips=6))
+    frame_p = svc_py.render_frame()
+
+    assert frame_n["error"] is None and frame_p["error"] is None
+    assert frame_n["chips"] == frame_p["chips"]
+    assert frame_n["stats"] == frame_p["stats"]
+    assert frame_n["selected"] == frame_p["selected"]
+    assert [r["title"] for r in frame_n["device_rows"]] == [
+        r["title"] for r in frame_p["device_rows"]
+    ]

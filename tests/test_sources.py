@@ -143,6 +143,11 @@ class _FakeResponse:
     def json(self):
         return self._payload
 
+    @property
+    def content(self):
+        # raw bytes for the native parse path
+        return json.dumps(self._payload).encode("utf-8")
+
 
 class _FakeSession:
     """Stands in for requests.Session; records queries."""

@@ -92,3 +92,13 @@ def test_heatmap_grid_3d_unrolls_planes():
     assert grid[0][0] == 0.0        # z=0 plane, (0,0)
     assert grid[0][2] is None       # gap column
     assert grid[0][3] == 4.0        # z=1 plane, (0,0)
+
+
+def test_heatmap_grid_rejects_out_of_range_chip_ids():
+    import pytest
+
+    topo = topology_for("v5e", 4)
+    with pytest.raises(ValueError, match="out of range"):
+        heatmap_grid(topo, {-1: 7.0})
+    with pytest.raises(ValueError, match="out of range"):
+        heatmap_grid(topo, {4: 7.0})

@@ -1,0 +1,910 @@
+// tpudash native frame kernel — the C++ data plane.
+//
+// Parses metric payloads (Prometheus exposition text and instant-query
+// JSON) directly into a dense columnar frame: a row per chip, a column per
+// metric, float64 matrix with NaN for missing cells, plus per-row identity
+// (slice, host, chip_id, accelerator).  This replaces the Python hot path
+// (sources/base.py parse_instant_query + normalize.to_wide's dict pivot,
+// the two hottest stages of a 256-chip frame) with a single pass over the
+// raw bytes.  Semantics mirror the Python implementations exactly — the
+// test suite asserts byte-for-byte frame parity (tests/test_native.py).
+//
+// Also provides td_column_stats: one-pass per-column mean/max/min with
+// NaN-skipping and zero-exclusion means (reference app.py:341-345 policy,
+// generalized per normalize.column_average).
+//
+// ABI: plain C, consumed via ctypes (tpudash/native/__init__.py).  The
+// parse functions return an opaque TdFrame*; accessors copy results into
+// caller-allocated buffers; td_frame_free releases it.
+
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <algorithm>
+#include <limits>
+#include <numeric>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr double kNaN = std::numeric_limits<double>::quiet_NaN();
+
+struct TdFrame {
+  std::vector<std::string> metrics;  // column names, first-seen order
+  // per-row identity, sorted by (slice, chip_id), stable
+  std::vector<std::string> slices, hosts, accels;
+  std::vector<int64_t> chip_ids;
+  std::vector<double> matrix;   // row-major nrows * ncols
+  int64_t n_samples = 0;        // emitted samples, incl. duplicates/NaN —
+                                // parity with len(list[Sample])
+};
+
+// Accumulates samples as (row, col, value) triplets, then materializes a
+// sorted dense frame.  Duplicate (row, col) samples: last write wins, same
+// as the Python dict-pivot.
+struct Builder {
+  std::vector<std::string> metrics;
+  std::unordered_map<std::string, int32_t> metric_idx;
+  struct ChipRow {
+    std::string slice, host, accel;
+    int64_t chip_id;
+  };
+  std::vector<ChipRow> chips;
+  std::unordered_map<std::string, int32_t> chip_idx;
+  struct Trip {
+    int32_t row, col;
+    double val;
+  };
+  std::vector<Trip> trips;
+
+  int32_t metric(const std::string& name) {
+    auto it = metric_idx.find(name);
+    if (it != metric_idx.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(metrics.size());
+    metrics.push_back(name);
+    metric_idx.emplace(name, idx);
+    return idx;
+  }
+
+  int32_t chip(const std::string& slice, const std::string& host,
+               int64_t chip_id) {
+    std::string key;
+    key.reserve(slice.size() + host.size() + 14);
+    key.append(slice).push_back('\x1f');
+    key.append(host).push_back('\x1f');
+    key.append(std::to_string(chip_id));
+    auto it = chip_idx.find(key);
+    if (it != chip_idx.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(chips.size());
+    chips.push_back(ChipRow{slice, host, std::string(), chip_id});
+    chip_idx.emplace(std::move(key), idx);
+    return idx;
+  }
+
+  // First non-empty accelerator label wins (normalize.to_wide semantics).
+  void set_accel(int32_t row, const std::string& accel) {
+    if (!accel.empty() && chips[row].accel.empty()) chips[row].accel = accel;
+  }
+
+  void add(int32_t row, int32_t col, double val) {
+    trips.push_back(Trip{row, col, val});
+  }
+
+  TdFrame* finish() {
+    const size_t nrows = chips.size(), ncols = metrics.size();
+    std::vector<int32_t> order(nrows);
+    std::iota(order.begin(), order.end(), 0);
+    std::stable_sort(order.begin(), order.end(),
+                     [this](int32_t a, int32_t b) {
+                       int c = chips[a].slice.compare(chips[b].slice);
+                       if (c != 0) return c < 0;
+                       return chips[a].chip_id < chips[b].chip_id;
+                     });
+    std::vector<int32_t> inverse(nrows);
+    for (size_t i = 0; i < nrows; ++i) inverse[order[i]] = static_cast<int32_t>(i);
+
+    auto* f = new TdFrame();
+    f->metrics = std::move(metrics);
+    f->slices.reserve(nrows);
+    f->hosts.reserve(nrows);
+    f->accels.reserve(nrows);
+    f->chip_ids.reserve(nrows);
+    for (size_t i = 0; i < nrows; ++i) {
+      ChipRow& c = chips[order[i]];
+      f->slices.push_back(std::move(c.slice));
+      f->hosts.push_back(std::move(c.host));
+      f->accels.push_back(std::move(c.accel));
+      f->chip_ids.push_back(c.chip_id);
+    }
+    f->matrix.assign(nrows * ncols, kNaN);
+    for (const Trip& t : trips)
+      f->matrix[static_cast<size_t>(inverse[t.row]) * ncols + t.col] = t.val;
+    f->n_samples = static_cast<int64_t>(trips.size());
+    return f;
+  }
+};
+
+void set_err(char* err, int64_t errcap, const std::string& msg) {
+  if (err == nullptr || errcap <= 0) return;
+  size_t n = std::min(msg.size(), static_cast<size_t>(errcap - 1));
+  std::memcpy(err, msg.data(), n);
+  err[n] = '\0';
+}
+
+// Full-token numeric parse (Python float()/int() reject trailing garbage).
+bool parse_full_double(const char* s, size_t len, double* out) {
+  std::string buf(s, len);
+  const char* b = buf.c_str();
+  char* endp = nullptr;
+  double v = std::strtod(b, &endp);
+  if (endp == b) return false;
+  while (*endp == ' ' || *endp == '\t') ++endp;
+  if (*endp != '\0') return false;
+  *out = v;
+  return true;
+}
+
+bool parse_full_int(const std::string& s, int64_t* out) {
+  const char* b = s.c_str();
+  while (*b == ' ' || *b == '\t') ++b;
+  char* endp = nullptr;
+  errno = 0;
+  long long v = std::strtoll(b, &endp, 10);
+  if (endp == b || errno == ERANGE) return false;  // overflow → skip series
+  while (*endp == ' ' || *endp == '\t') ++endp;
+  if (*endp != '\0') return false;
+  *out = static_cast<int64_t>(v);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Prometheus exposition text (exporter/textfmt.py parse_text_format parity)
+// ---------------------------------------------------------------------------
+
+// Parse the inside of {...}: k="v" pairs; escapes \n \\ \" pass through,
+// unknown escapes keep the escaped character (textfmt.py:_parse_labels).
+bool parse_labels(const char* body, size_t n,
+                  std::vector<std::pair<std::string, std::string>>* labels) {
+  size_t i = 0;
+  while (i < n) {
+    while (i < n && (body[i] == ',' || body[i] == ' ')) ++i;
+    if (i >= n) break;
+    size_t eq = i;
+    while (eq < n && body[eq] != '=') ++eq;
+    if (eq >= n) return false;  // malformed labels
+    size_t ks = i, ke = eq;
+    while (ks < ke && (body[ks] == ' ' || body[ks] == '\t')) ++ks;
+    while (ke > ks && (body[ke - 1] == ' ' || body[ke - 1] == '\t')) --ke;
+    std::string key(body + ks, ke - ks);
+    if (eq + 1 >= n || body[eq + 1] != '"') return false;  // unquoted value
+    size_t j = eq + 2;
+    std::string val;
+    while (j < n) {
+      char c = body[j];
+      if (c == '\\' && j + 1 < n) {
+        char nxt = body[j + 1];
+        if (nxt == 'n')
+          val.push_back('\n');
+        else
+          val.push_back(nxt);
+        j += 2;
+        continue;
+      }
+      if (c == '"') break;
+      val.push_back(c);
+      ++j;
+    }
+    if (j >= n) return false;  // unterminated value
+    labels->emplace_back(std::move(key), std::move(val));
+    i = j + 1;
+  }
+  return true;
+}
+
+const std::string* find_label(
+    const std::vector<std::pair<std::string, std::string>>& labels,
+    const char* key) {
+  for (const auto& kv : labels)
+    if (kv.first == key) return &kv.second;
+  return nullptr;
+}
+
+TdFrame* parse_text_impl(const char* text, int64_t len,
+                         const std::string& default_slice, char* err,
+                         int64_t errcap) {
+  Builder b;
+  const char* p = text;
+  const char* end = text + len;
+  std::vector<std::pair<std::string, std::string>> labels;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* ls = p;
+    p = nl ? nl + 1 : end;
+    // strip
+    while (ls < line_end && (*ls == ' ' || *ls == '\t' || *ls == '\r')) ++ls;
+    const char* le = line_end;
+    while (le > ls && (le[-1] == ' ' || le[-1] == '\t' || le[-1] == '\r')) --le;
+    if (ls >= le || *ls == '#') continue;
+    const char* brace =
+        static_cast<const char*>(memchr(ls, '{', le - ls));
+    if (brace == nullptr) continue;  // unlabeled series: no chip identity
+    // last '}' on the line (textfmt.py uses rfind)
+    const char* close = nullptr;
+    for (const char* q = le - 1; q > brace; --q)
+      if (*q == '}') {
+        close = q;
+        break;
+      }
+    if (close == nullptr) {
+      set_err(err, errcap, "malformed series line");
+      return nullptr;
+    }
+    // metric name, stripped
+    const char* ne = brace;
+    while (ne > ls && (ne[-1] == ' ' || ne[-1] == '\t')) --ne;
+    std::string name(ls, ne - ls);
+    labels.clear();
+    if (!parse_labels(brace + 1, close - brace - 1, &labels)) {
+      set_err(err, errcap, "malformed labels");
+      return nullptr;
+    }
+    // first whitespace-separated token after '}'
+    const char* vs = close + 1;
+    while (vs < le && (*vs == ' ' || *vs == '\t')) ++vs;
+    const char* ve = vs;
+    while (ve < le && *ve != ' ' && *ve != '\t') ++ve;
+    if (name.empty() || vs >= ve) continue;
+    double value;
+    if (!parse_full_double(vs, ve - vs, &value)) continue;
+    if (!std::isfinite(value)) continue;
+    const std::string* chip_label = find_label(labels, "chip_id");
+    if (chip_label == nullptr) chip_label = find_label(labels, "gpu_id");
+    if (chip_label == nullptr) continue;
+    int64_t chip_id;
+    if (!parse_full_int(*chip_label, &chip_id)) continue;
+    const std::string* slice = find_label(labels, "slice");
+    const std::string* host = find_label(labels, "host");
+    if (host == nullptr) host = find_label(labels, "instance");
+    const std::string* accel = find_label(labels, "accelerator");
+    if (accel == nullptr) accel = find_label(labels, "card_model");
+    static const std::string kEmpty;
+    int32_t row = b.chip(slice ? *slice : default_slice,
+                         host ? *host : kEmpty, chip_id);
+    if (accel != nullptr) b.set_accel(row, *accel);
+    b.add(row, b.metric(name), value);
+  }
+  return b.finish();
+}
+
+// ---------------------------------------------------------------------------
+// Prometheus instant-query JSON (sources/base.py parse_instant_query parity)
+// ---------------------------------------------------------------------------
+
+struct JParser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit JParser(const char* text, int64_t len) : p(text), end(text + len) {}
+
+  void ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool fail(const char* msg) {
+    err = msg;
+    return false;
+  }
+
+  bool expect(char c) {
+    ws();
+    if (p >= end || *p != c) return fail("unexpected token");
+    ++p;
+    return true;
+  }
+
+  bool peek(char c) {
+    ws();
+    return p < end && *p == c;
+  }
+
+  // JSON string; out==nullptr skips without building.
+  bool parse_string(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    while (p < end) {
+      char c = *p;
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return fail("bad escape");
+        char e = *p++;
+        if (out == nullptr) {
+          if (e == 'u') {
+            if (end - p < 4) return fail("bad \\u escape");
+            p += 4;
+          }
+          continue;
+        }
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 4) return fail("bad \\u escape");
+            unsigned cp = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = p[i];
+              cp <<= 4;
+              if (h >= '0' && h <= '9')
+                cp |= h - '0';
+              else if (h >= 'a' && h <= 'f')
+                cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F')
+                cp |= h - 'A' + 10;
+              else
+                return fail("bad \\u escape");
+            }
+            p += 4;
+            // surrogate pair
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {
+              unsigned lo = 0;
+              bool ok = true;
+              for (int i = 0; i < 4; ++i) {
+                char h = p[2 + i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9')
+                  lo |= h - '0';
+                else if (h >= 'a' && h <= 'f')
+                  lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F')
+                  lo |= h - 'A' + 10;
+                else {
+                  ok = false;
+                  break;
+                }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            // UTF-8 encode
+            if (cp < 0x80) {
+              out->push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return fail("bad escape");
+        }
+        continue;
+      }
+      if (out != nullptr) out->push_back(c);
+      ++p;
+    }
+    return fail("unterminated string");
+  }
+
+  bool skip_number() {
+    ws();
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '-' || *p == '+'))
+      ++p;
+    return p > start;
+  }
+
+  bool parse_number(double* out) {
+    ws();
+    char* endp = nullptr;
+    std::string buf(p, std::min<size_t>(end - p, 64));
+    double v = std::strtod(buf.c_str(), &endp);
+    if (endp == buf.c_str()) return fail("bad number");
+    *out = v;
+    p += endp - buf.c_str();
+    return true;
+  }
+
+  bool skip_literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (static_cast<size_t>(end - p) < n || std::strncmp(p, lit, n) != 0)
+      return fail("bad literal");
+    p += n;
+    return true;
+  }
+
+  bool skip_value() {
+    ws();
+    if (p >= end) return fail("truncated value");
+    switch (*p) {
+      case '{': {
+        ++p;
+        if (peek('}')) {
+          ++p;
+          return true;
+        }
+        while (true) {
+          if (!parse_string(nullptr)) return false;
+          if (!expect(':')) return false;
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          return expect('}');
+        }
+      }
+      case '[': {
+        ++p;
+        if (peek(']')) {
+          ++p;
+          return true;
+        }
+        while (true) {
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          return expect(']');
+        }
+      }
+      case '"':
+        return parse_string(nullptr);
+      case 't':
+        return skip_literal("true");
+      case 'f':
+        return skip_literal("false");
+      case 'n':
+        return skip_literal("null");
+      default:
+        return skip_number();
+    }
+  }
+};
+
+// Labels parse_instant_query reads from each result's "metric" object.
+struct MetricLabels {
+  std::string name, chip_id, gpu_id, slice, host, instance, accel, card_model;
+  bool has_chip_id = false, has_gpu_id = false, has_slice = false,
+       has_host = false, has_instance = false, has_accel = false,
+       has_card_model = false;
+};
+
+bool parse_metric_obj(JParser& jp, MetricLabels* m) {
+  if (!jp.expect('{')) return false;
+  if (jp.peek('}')) {
+    ++jp.p;
+    return true;
+  }
+  std::string key;
+  while (true) {
+    key.clear();
+    if (!jp.parse_string(&key)) return false;
+    if (!jp.expect(':')) return false;
+    std::string* dst = nullptr;
+    bool* flag = nullptr;
+    if (key == "__name__") {
+      dst = &m->name;
+    } else if (key == "chip_id") {
+      dst = &m->chip_id;
+      flag = &m->has_chip_id;
+    } else if (key == "gpu_id") {
+      dst = &m->gpu_id;
+      flag = &m->has_gpu_id;
+    } else if (key == "slice") {
+      dst = &m->slice;
+      flag = &m->has_slice;
+    } else if (key == "host") {
+      dst = &m->host;
+      flag = &m->has_host;
+    } else if (key == "instance") {
+      dst = &m->instance;
+      flag = &m->has_instance;
+    } else if (key == "accelerator") {
+      dst = &m->accel;
+      flag = &m->has_accel;
+    } else if (key == "card_model") {
+      dst = &m->card_model;
+      flag = &m->has_card_model;
+    }
+    if (dst != nullptr) {
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == '"') {
+        dst->clear();  // duplicate JSON keys: last one wins (json.loads)
+        if (!jp.parse_string(dst)) return false;
+        if (flag != nullptr) *flag = true;
+      } else if (jp.p < jp.end &&
+                 (*jp.p == '-' || (*jp.p >= '0' && *jp.p <= '9'))) {
+        // numeric label value (illegal in Prometheus exposition but legal
+        // JSON; Python's json.loads would hand int/float through) —
+        // capture its raw text so integer chip ids still resolve
+        const char* start = jp.p;
+        if (!jp.skip_number()) return false;
+        dst->assign(start, jp.p - start);
+        if (flag != nullptr) *flag = true;
+      } else {
+        // other non-string label value (bool/null/object): skip it
+        if (!jp.skip_value()) return false;
+      }
+    } else {
+      if (!jp.skip_value()) return false;
+    }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      ++jp.p;
+      continue;
+    }
+    return jp.expect('}');
+  }
+}
+
+// "value": [ts, "1.23"] — returns true with *ok=false to skip the series
+// (malformed shape), mirrors Python's per-series tolerance.
+bool parse_value_arr(JParser& jp, double* out, bool* ok) {
+  *ok = false;
+  if (!jp.expect('[')) return false;
+  if (jp.peek(']')) {
+    ++jp.p;
+    return true;  // wrong arity → skip series
+  }
+  int count = 0;
+  std::string sval;
+  bool have_str = false, have_num = false;
+  double num = 0.0;
+  while (true) {
+    jp.ws();
+    ++count;
+    if (jp.p < jp.end && *jp.p == '"') {
+      sval.clear();
+      if (!jp.parse_string(&sval)) return false;
+      if (count == 2) have_str = true;
+    } else if (jp.p < jp.end &&
+               (*jp.p == '{' || *jp.p == '[' || *jp.p == 't' || *jp.p == 'f' ||
+                *jp.p == 'n')) {
+      if (!jp.skip_value()) return false;
+    } else {
+      double v;
+      if (!jp.parse_number(&v)) return false;
+      if (count == 2) {
+        num = v;
+        have_num = true;
+      }
+    }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      ++jp.p;
+      continue;
+    }
+    if (!jp.expect(']')) return false;
+    break;
+  }
+  if (count != 2) return true;  // skip: Python requires len == 2
+  if (have_str) {
+    // Python float(str): accepts inf/nan/whitespace, rejects garbage
+    const char* s = sval.c_str();
+    while (*s == ' ' || *s == '\t') ++s;
+    if (!parse_full_double(s, std::strlen(s), out)) return true;  // skip
+    *ok = true;
+  } else if (have_num) {
+    *out = num;
+    *ok = true;
+  }
+  return true;
+}
+
+TdFrame* parse_promjson_impl(const char* text, int64_t len,
+                             const std::string& default_slice, char* err,
+                             int64_t errcap) {
+  JParser jp(text, len);
+  Builder b;
+  std::string status;
+  bool saw_result = false;
+
+  auto bad = [&](const std::string& msg) -> TdFrame* {
+    set_err(err, errcap, msg);
+    return nullptr;
+  };
+
+  if (!jp.expect('{')) return bad("malformed prometheus payload: not an object");
+  if (!jp.peek('}')) {
+    std::string key;
+    while (true) {
+      key.clear();
+      if (!jp.parse_string(&key)) return bad("malformed prometheus payload");
+      if (!jp.expect(':')) return bad("malformed prometheus payload");
+      if (key == "status") {
+        jp.ws();
+        if (jp.p < jp.end && *jp.p == '"') {
+          if (!jp.parse_string(&status)) return bad("malformed prometheus payload");
+        } else {
+          if (!jp.skip_value()) return bad("malformed prometheus payload");
+        }
+      } else if (key == "data") {
+        // object containing "result"
+        if (!jp.expect('{')) return bad("malformed prometheus payload: 'data'");
+        if (!jp.peek('}')) {
+          std::string dkey;
+          while (true) {
+            dkey.clear();
+            if (!jp.parse_string(&dkey)) return bad("malformed prometheus payload");
+            if (!jp.expect(':')) return bad("malformed prometheus payload");
+            if (dkey == "result") {
+              saw_result = true;
+              if (!jp.expect('['))
+                return bad("malformed prometheus payload: 'result'");
+              if (jp.peek(']')) {
+                ++jp.p;
+              } else {
+                while (true) {
+                  // one result item
+                  if (!jp.expect('{'))
+                    return bad("malformed prometheus payload: result item");
+                  MetricLabels m;
+                  double val = 0.0;
+                  bool have_val = false;
+                  if (!jp.peek('}')) {
+                    std::string ikey;
+                    while (true) {
+                      ikey.clear();
+                      if (!jp.parse_string(&ikey))
+                        return bad("malformed prometheus payload");
+                      if (!jp.expect(':'))
+                        return bad("malformed prometheus payload");
+                      if (ikey == "metric") {
+                        jp.ws();
+                        if (jp.p < jp.end && *jp.p == '{') {
+                          if (!parse_metric_obj(jp, &m))
+                            return bad("malformed prometheus payload: metric");
+                        } else {
+                          if (!jp.skip_value())
+                            return bad("malformed prometheus payload");
+                        }
+                      } else if (ikey == "value") {
+                        jp.ws();
+                        if (jp.p < jp.end && *jp.p == '[') {
+                          bool ok = false;
+                          if (!parse_value_arr(jp, &val, &ok))
+                            return bad("malformed prometheus payload: value");
+                          have_val = ok;
+                        } else {
+                          if (!jp.skip_value())
+                            return bad("malformed prometheus payload");
+                        }
+                      } else {
+                        if (!jp.skip_value())
+                          return bad("malformed prometheus payload");
+                      }
+                      jp.ws();
+                      if (jp.p < jp.end && *jp.p == ',') {
+                        ++jp.p;
+                        continue;
+                      }
+                      if (!jp.expect('}'))
+                        return bad("malformed prometheus payload");
+                      break;
+                    }
+                  } else {
+                    ++jp.p;  // empty item object
+                  }
+                  // emit sample (tolerant per-series skipping)
+                  do {
+                    if (m.name.empty() || !have_val) break;
+                    const std::string* chip_label = nullptr;
+                    if (m.has_chip_id)
+                      chip_label = &m.chip_id;
+                    else if (m.has_gpu_id)
+                      chip_label = &m.gpu_id;
+                    else
+                      break;
+                    int64_t chip_id;
+                    if (!parse_full_int(*chip_label, &chip_id)) break;
+                    const std::string& slice =
+                        m.has_slice ? m.slice : default_slice;
+                    static const std::string kEmpty;
+                    const std::string& host =
+                        m.has_host ? m.host
+                                   : (m.has_instance ? m.instance : kEmpty);
+                    int32_t row = b.chip(slice, host, chip_id);
+                    const std::string& accel =
+                        m.has_accel ? m.accel
+                                    : (m.has_card_model ? m.card_model : kEmpty);
+                    b.set_accel(row, accel);
+                    b.add(row, b.metric(m.name), val);
+                  } while (false);
+                  jp.ws();
+                  if (jp.p < jp.end && *jp.p == ',') {
+                    ++jp.p;
+                    continue;
+                  }
+                  if (!jp.expect(']'))
+                    return bad("malformed prometheus payload");
+                  break;
+                }
+              }
+            } else {
+              if (!jp.skip_value()) return bad("malformed prometheus payload");
+            }
+            jp.ws();
+            if (jp.p < jp.end && *jp.p == ',') {
+              ++jp.p;
+              continue;
+            }
+            if (!jp.expect('}')) return bad("malformed prometheus payload");
+            break;
+          }
+        } else {
+          ++jp.p;  // empty data object
+        }
+      } else {
+        if (!jp.skip_value()) return bad("malformed prometheus payload");
+      }
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ',') {
+        ++jp.p;
+        continue;
+      }
+      if (!jp.expect('}')) return bad("malformed prometheus payload");
+      break;
+    }
+  } else {
+    ++jp.p;
+  }
+
+  if (status != "success")
+    return bad("prometheus status='" + status + "'");
+  if (!saw_result)
+    return bad("malformed prometheus payload: 'result'");
+  return b.finish();
+}
+
+// Length-prefixed packing (uint32 LE + bytes per string) — label values may
+// legally contain newlines, so a separator-joined transfer is not safe.
+std::string pack_strings(const std::vector<std::string>& v) {
+  std::string out;
+  size_t total = 0;
+  for (const auto& s : v) total += s.size() + 4;
+  out.reserve(total);
+  for (const auto& s : v) {
+    uint32_t n = static_cast<uint32_t>(s.size());
+    char hdr[4] = {static_cast<char>(n & 0xFF), static_cast<char>((n >> 8) & 0xFF),
+                   static_cast<char>((n >> 16) & 0xFF),
+                   static_cast<char>((n >> 24) & 0xFF)};
+    out.append(hdr, 4);
+    out.append(s);
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* td_parse_text(const char* text, int64_t len, const char* default_slice,
+                    char* err, int64_t errcap) {
+  return parse_text_impl(text, len, default_slice ? default_slice : "slice-0",
+                         err, errcap);
+}
+
+void* td_parse_promjson(const char* text, int64_t len,
+                        const char* default_slice, char* err, int64_t errcap) {
+  return parse_promjson_impl(text, len,
+                             default_slice ? default_slice : "slice-0", err,
+                             errcap);
+}
+
+int64_t td_frame_nrows(void* f) {
+  return static_cast<TdFrame*>(f)->chip_ids.size();
+}
+
+int64_t td_frame_ncols(void* f) {
+  return static_cast<TdFrame*>(f)->metrics.size();
+}
+
+void td_frame_matrix(void* f, double* out) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  std::memcpy(out, fr->matrix.data(), fr->matrix.size() * sizeof(double));
+}
+
+void td_frame_chip_ids(void* f, int64_t* out) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  std::memcpy(out, fr->chip_ids.data(), fr->chip_ids.size() * sizeof(int64_t));
+}
+
+int64_t td_frame_nsamples(void* f) {
+  return static_cast<TdFrame*>(f)->n_samples;
+}
+
+// which: 0 = metric names (ncols lines), 1 = slices, 2 = hosts, 3 = accels
+// (nrows lines each).  Returns bytes needed; fills buf if cap suffices.
+int64_t td_frame_strings(void* f, int32_t which, char* buf, int64_t cap) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  const std::vector<std::string>* v = nullptr;
+  switch (which) {
+    case 0: v = &fr->metrics; break;
+    case 1: v = &fr->slices; break;
+    case 2: v = &fr->hosts; break;
+    case 3: v = &fr->accels; break;
+    default: return -1;
+  }
+  std::string packed = pack_strings(*v);
+  if (buf != nullptr && cap >= static_cast<int64_t>(packed.size()))
+    std::memcpy(buf, packed.data(), packed.size());
+  return static_cast<int64_t>(packed.size());
+}
+
+void td_frame_free(void* f) { delete static_cast<TdFrame*>(f); }
+
+// One-pass per-column stats over a row-major float64 matrix.  NaNs are
+// skipped.  zero_excluded[c] != 0 additionally computes zmean excluding
+// exact zeros (normalize.column_average policy).  Outputs per column:
+// mean/mx/mn (NaN when no finite values), zmean (NaN when no nonzero
+// values), count of non-NaN values.
+void td_column_stats(const double* m, int64_t nrows, int64_t ncols,
+                     const uint8_t* zero_excluded, double* mean, double* mx,
+                     double* mn, double* zmean, int64_t* count) {
+  std::vector<double> sum(ncols, 0.0), zsum(ncols, 0.0);
+  std::vector<int64_t> cnt(ncols, 0), zcnt(ncols, 0);
+  std::vector<double> vmax(ncols, -std::numeric_limits<double>::infinity());
+  std::vector<double> vmin(ncols, std::numeric_limits<double>::infinity());
+  for (int64_t r = 0; r < nrows; ++r) {
+    const double* row = m + r * ncols;
+    for (int64_t c = 0; c < ncols; ++c) {
+      double v = row[c];
+      if (std::isnan(v)) continue;
+      sum[c] += v;
+      ++cnt[c];
+      if (v > vmax[c]) vmax[c] = v;
+      if (v < vmin[c]) vmin[c] = v;
+      if (v != 0.0) {
+        zsum[c] += v;
+        ++zcnt[c];
+      }
+    }
+  }
+  for (int64_t c = 0; c < ncols; ++c) {
+    count[c] = cnt[c];
+    mean[c] = cnt[c] > 0 ? sum[c] / cnt[c] : kNaN;
+    mx[c] = cnt[c] > 0 ? vmax[c] : kNaN;
+    mn[c] = cnt[c] > 0 ? vmin[c] : kNaN;
+    if (zero_excluded != nullptr && zero_excluded[c])
+      zmean[c] = zcnt[c] > 0 ? zsum[c] / zcnt[c] : kNaN;
+    else
+      zmean[c] = mean[c];
+  }
+}
+
+}  // extern "C"

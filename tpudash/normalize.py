@@ -11,24 +11,34 @@ policy applied per metric via schema.ZERO_EXCLUDED_METRICS.
 
 from __future__ import annotations
 
+import contextlib
+import warnings
+
+import numpy as np
 import pandas as pd
 
-from tpudash import schema
-from tpudash.schema import Sample
+from tpudash import native, schema
+from tpudash.schema import Sample, SampleBatch
 
 
 class NormalizeError(RuntimeError):
     pass
 
 
-def to_wide(samples: list[Sample]) -> pd.DataFrame:
+def to_wide(samples: "list[Sample] | SampleBatch") -> pd.DataFrame:
     """Pivot long samples into a wide table indexed by chip key.
 
     Index: "slice/chip" string (sorted by (slice_id, chip_id)).
     Columns: raw metric columns (float), derived columns, plus identity
     columns ``slice_id``, ``host``, ``chip_id`` and the accelerator-type
     pseudo-metric (the reference's card_model column, app.py:191-201).
+
+    Accepts either the Sample-list (pure-Python sources) or the columnar
+    SampleBatch the native frame kernel produces — the batch path skips the
+    dict pivot entirely (rows arrive pre-sorted with a dense float matrix).
     """
+    if isinstance(samples, SampleBatch):
+        return _batch_to_wide(samples)
     if not samples:
         raise NormalizeError("no samples to normalize")
 
@@ -51,6 +61,25 @@ def to_wide(samples: list[Sample]) -> pd.DataFrame:
     df = pd.DataFrame.from_dict(rows, orient="index")
     df = df.sort_values(["slice_id", "chip_id"])
     df.index.name = "chip"
+    return _derive(df)
+
+
+def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
+    """Columnar batch → the same wide table shape as the dict pivot.
+
+    Rows arrive sorted by (slice_id, chip_id) and the metric block is one
+    contiguous float64 matrix, so this is a constant number of numpy-level
+    ops regardless of chip count."""
+    if len(b) == 0:
+        raise NormalizeError("no samples to normalize")
+    df = pd.DataFrame(
+        b.matrix, index=pd.Index(b.keys, name="chip"), columns=b.metrics
+    )
+    # identity columns in the same order the dict pivot produces
+    df.insert(0, schema.ACCEL_TYPE, b.accels)
+    df.insert(0, "chip_id", b.chip_ids.astype(np.int64))
+    df.insert(0, "host", b.hosts)
+    df.insert(0, "slice_id", b.slices)
     return _derive(df)
 
 
@@ -81,12 +110,43 @@ def numeric_columns(df: pd.DataFrame) -> list[str]:
     return [c for c in df.columns if c not in skip]
 
 
+def _dense_block(df: pd.DataFrame, cols: list[str]) -> "np.ndarray | None":
+    """The numeric columns as one contiguous float64 matrix, or None when
+    any column needs coercion (legacy mixed-dtype frames)."""
+    if not cols:
+        return None
+    sub = df[cols]
+    if not all(dt.kind in "fi" for dt in sub.dtypes):
+        return None
+    return sub.to_numpy(dtype=np.float64)
+
+
 def compute_stats(df: pd.DataFrame) -> dict:
     """{metric: {"mean": .., "max": .., "min": ..}} over numeric columns
     (reference app.py:216-221; display rounds to 2 dp at app.py:480-481 —
     rounding is presentation, so it lives in the app layer)."""
+    cols = numeric_columns(df)
+    arr = _dense_block(df, cols)
+    if arr is not None:
+        if native.is_available():
+            mean, mx, mn, _, count = native.column_stats(arr)
+        else:
+            count = (~np.isnan(arr)).sum(axis=0)
+            with np.errstate(invalid="ignore"), _nanwarn_silenced():
+                mean = np.nanmean(arr, axis=0)
+                mx = np.nanmax(arr, axis=0)
+                mn = np.nanmin(arr, axis=0)
+        return {
+            c: {
+                "mean": float(mean[i]),
+                "max": float(mx[i]),
+                "min": float(mn[i]),
+            }
+            for i, c in enumerate(cols)
+            if count[i] > 0
+        }
     stats: dict = {}
-    for col in numeric_columns(df):
+    for col in cols:
         series = pd.to_numeric(df[col], errors="coerce").dropna()
         if series.empty:
             continue
@@ -98,6 +158,15 @@ def compute_stats(df: pd.DataFrame) -> dict:
     return stats
 
 
+@contextlib.contextmanager
+def _nanwarn_silenced():
+    """Suppress numpy's all-NaN-slice RuntimeWarning (empty columns are a
+    legal frame state — the stats dict simply omits them)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        yield
+
+
 def column_average(df: pd.DataFrame, column: str) -> float | None:
     """Average of a column over the (already filtered) table, honoring
     zero-exclusion policy: for metrics in ZERO_EXCLUDED_METRICS, chips
@@ -107,7 +176,16 @@ def column_average(df: pd.DataFrame, column: str) -> float | None:
     in that case; the app layer makes that call)."""
     if column not in df:
         return None
-    series = pd.to_numeric(df[column], errors="coerce").dropna()
+    col = df[column]
+    if col.dtype.kind in "fi":
+        arr = col.to_numpy(dtype=np.float64)
+        mask = ~np.isnan(arr)
+        if column in schema.ZERO_EXCLUDED_METRICS:
+            mask &= arr != 0
+        if not mask.any():
+            return None
+        return float(arr[mask].mean())
+    series = pd.to_numeric(col, errors="coerce").dropna()
     if column in schema.ZERO_EXCLUDED_METRICS:
         series = series[series != 0]
     if series.empty:

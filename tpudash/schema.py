@@ -12,7 +12,9 @@ topology coordinates — the unit of scale is a pod slice, not a node.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+import numpy as np
 
 
 # --- raw series (scraped) ---------------------------------------------------
@@ -100,6 +102,201 @@ class Sample:
     chip: ChipKey
     accelerator_type: str = ""
     labels: dict | None = None
+
+
+@dataclass(slots=True)
+class SampleBatch:
+    """Columnar scrape result: one row per chip, one column per metric.
+
+    The native frame kernel (tpudash/native) parses raw payload bytes
+    straight into this shape, skipping per-sample Python objects — the role
+    ``list[Sample]`` plays on the pure-Python path.  Rows are sorted by
+    (slice_id, chip_id); ``matrix`` is float64 with NaN for missing cells.
+    Sources may return either representation; normalize.to_wide accepts both.
+    """
+
+    metrics: list[str]
+    slices: list[str]
+    hosts: list[str]
+    chip_ids: np.ndarray  # int32, shape (nrows,)
+    accels: list[str]
+    matrix: np.ndarray  # float64, shape (nrows, len(metrics))
+    #: per-endpoint errors etc. may be attached by joining sources
+    meta: dict = field(default_factory=dict)
+    _n_samples: "int | None" = None
+
+    def __len__(self) -> int:
+        """Number of samples — parity with len(list[Sample]) so
+        `if not samples` and sample-count assertions behave identically
+        whichever representation a source returns.  Producers (the native
+        parsers, from_samples, concat) record the exact emitted-sample
+        count (including duplicates and NaN-valued samples); for manually
+        constructed batches the non-NaN cell count is the fallback."""
+        if self._n_samples is None:
+            self._n_samples = int(np.count_nonzero(~np.isnan(self.matrix)))
+        return self._n_samples
+
+    @property
+    def nrows(self) -> int:
+        return len(self.slices)
+
+    def __iter__(self):
+        """Iterate as Sample objects — the batch is a drop-in for
+        list[Sample] anywhere sample-level access is needed (slow path;
+        frame rendering never materializes these)."""
+        return iter(self.to_samples())
+
+    @property
+    def keys(self) -> list[str]:
+        return [f"{s}/{c}" for s, c in zip(self.slices, self.chip_ids)]
+
+    def relabel_slice(self, name: str) -> "SampleBatch":
+        """All rows re-labeled to one slice name (multi-source join)."""
+        out = SampleBatch(
+            metrics=list(self.metrics),
+            slices=[name] * len(self.slices),
+            hosts=list(self.hosts),
+            chip_ids=self.chip_ids.copy(),
+            accels=list(self.accels),
+            matrix=self.matrix.copy(),
+            _n_samples=self._n_samples,
+        )
+        return out._sorted()
+
+    def _sorted(self) -> "SampleBatch":
+        order = sorted(
+            range(len(self.slices)),
+            key=lambda i: (self.slices[i], int(self.chip_ids[i])),
+        )
+        if order == list(range(len(order))):
+            return self
+        self.slices = [self.slices[i] for i in order]
+        self.hosts = [self.hosts[i] for i in order]
+        self.accels = [self.accels[i] for i in order]
+        self.chip_ids = self.chip_ids[order]
+        self.matrix = self.matrix[order]
+        return self
+
+    @classmethod
+    def from_samples(cls, samples: "list[Sample]") -> "SampleBatch":
+        """Pivot a Sample list into the columnar shape (same dedup/overwrite
+        semantics as normalize.to_wide's dict pivot)."""
+        metrics: list[str] = []
+        mcol: dict[str, int] = {}
+        rows: dict[tuple, int] = {}
+        slices: list[str] = []
+        hosts: list[str] = []
+        accels: list[str] = []
+        chip_ids: list[int] = []
+        trips: list[tuple] = []
+        for s in samples:
+            ck = (s.chip.slice_id, s.chip.host, s.chip.chip_id)
+            r = rows.get(ck)
+            if r is None:
+                r = rows[ck] = len(slices)
+                slices.append(s.chip.slice_id)
+                hosts.append(s.chip.host)
+                accels.append(s.accelerator_type or "")
+                chip_ids.append(s.chip.chip_id)
+            elif s.accelerator_type and not accels[r]:
+                accels[r] = s.accelerator_type
+            c = mcol.get(s.metric)
+            if c is None:
+                c = mcol[s.metric] = len(metrics)
+                metrics.append(s.metric)
+            trips.append((r, c, s.value))
+        matrix = np.full((len(slices), len(metrics)), np.nan)
+        for r, c, v in trips:
+            matrix[r, c] = v
+        batch = cls(
+            metrics=metrics,
+            slices=slices,
+            hosts=hosts,
+            chip_ids=np.asarray(chip_ids, dtype=np.int64),
+            accels=accels,
+            matrix=matrix,
+            _n_samples=len(samples),
+        )
+        return batch._sorted()
+
+    def to_samples(self) -> "list[Sample]":
+        """Materialize Sample objects (fallback interop path)."""
+        out: list[Sample] = []
+        for r in range(len(self.slices)):
+            chip = ChipKey(
+                slice_id=self.slices[r],
+                host=self.hosts[r],
+                chip_id=int(self.chip_ids[r]),
+            )
+            row = self.matrix[r]
+            for c, metric in enumerate(self.metrics):
+                v = row[c]
+                if np.isnan(v):
+                    continue
+                out.append(
+                    Sample(
+                        metric=metric,
+                        value=float(v),
+                        chip=chip,
+                        accelerator_type=self.accels[r],
+                    )
+                )
+        return out
+
+    @classmethod
+    def concat(cls, batches: "list[SampleBatch]") -> "SampleBatch":
+        """Union of several batches (multi-endpoint join).  Duplicate
+        (slice, host, chip) rows merge; a later batch's non-NaN cells win —
+        the same last-write semantics as the Sample-list pivot."""
+        metrics: list[str] = []
+        mcol: dict[str, int] = {}
+        rows: dict[tuple, int] = {}
+        slices: list[str] = []
+        hosts: list[str] = []
+        accels: list[str] = []
+        chip_ids: list[int] = []
+        chunks: list[tuple] = []  # (row_idx array, col_idx array, matrix)
+        for b in batches:
+            col_idx = np.empty(len(b.metrics), dtype=np.int64)
+            for j, m in enumerate(b.metrics):
+                c = mcol.get(m)
+                if c is None:
+                    c = mcol[m] = len(metrics)
+                    metrics.append(m)
+                col_idx[j] = c
+            row_idx = np.empty(len(b.slices), dtype=np.int64)
+            for i in range(len(b.slices)):
+                ck = (b.slices[i], b.hosts[i], int(b.chip_ids[i]))
+                r = rows.get(ck)
+                if r is None:
+                    r = rows[ck] = len(slices)
+                    slices.append(b.slices[i])
+                    hosts.append(b.hosts[i])
+                    accels.append(b.accels[i])
+                    chip_ids.append(int(b.chip_ids[i]))
+                elif b.accels[i] and not accels[r]:
+                    accels[r] = b.accels[i]
+                row_idx[i] = r
+            chunks.append((row_idx, col_idx, b.matrix))
+        matrix = np.full((len(slices), len(metrics)), np.nan)
+        for row_idx, col_idx, m in chunks:
+            mask = ~np.isnan(m)
+            if mask.all():
+                matrix[np.ix_(row_idx, col_idx)] = m
+            else:
+                sub = matrix[np.ix_(row_idx, col_idx)]
+                sub[mask] = m[mask]
+                matrix[np.ix_(row_idx, col_idx)] = sub
+        batch = cls(
+            metrics=metrics,
+            slices=slices,
+            hosts=hosts,
+            chip_ids=np.asarray(chip_ids, dtype=np.int64),
+            accels=accels,
+            matrix=matrix,
+            _n_samples=sum(len(b) for b in batches),
+        )
+        return batch._sorted()
 
 
 # The four panels every row displays, with their value column and axis-max

@@ -14,8 +14,10 @@ Label mapping (TPU series → reference analogue):
 from __future__ import annotations
 
 import abc
+import json
 
-from tpudash.schema import ChipKey, Sample
+from tpudash import native
+from tpudash.schema import ChipKey, Sample, SampleBatch
 
 
 class SourceError(RuntimeError):
@@ -39,6 +41,45 @@ class MetricsSource(abc.ABC):
 
     def close(self) -> None:  # pragma: no cover - trivial default
         pass
+
+
+def parse_json_bytes(data: "bytes | str") -> "SampleBatch | list[Sample]":
+    """Instant-query JSON bytes → samples.
+
+    The single dispatch point between the native frame kernel (fused JSON
+    decode + pivot, tpudash/native) and the pure-Python json.loads →
+    parse_instant_query path.  Raises SourceError on any parse failure.
+    """
+    if native.is_available():
+        try:
+            return native.parse_promjson(data)
+        except native.NativeParseError as e:
+            raise SourceError(str(e)) from e
+    try:
+        payload = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise SourceError(f"invalid JSON: {e}") from e
+    return parse_instant_query(payload)
+
+
+def parse_text_bytes(text: "str | bytes") -> "SampleBatch | list[Sample]":
+    """Prometheus exposition text → samples (native kernel when built,
+    exporter/textfmt fallback).  Raises SourceError on malformed text."""
+    if native.is_available():
+        try:
+            return native.parse_text(text)
+        except native.NativeParseError as e:
+            raise SourceError(
+                f"exporter returned malformed text format: {e}"
+            ) from e
+    from tpudash.exporter.textfmt import TextFormatError, parse_text_format
+
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", errors="replace")
+    try:
+        return parse_text_format(text)
+    except TextFormatError as e:
+        raise SourceError(f"exporter returned malformed text format: {e}") from e
 
 
 def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[Sample]:

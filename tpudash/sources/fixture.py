@@ -26,7 +26,12 @@ from tpudash.schema import (
     TEMPERATURE,
     TENSORCORE_UTIL,
 )
-from tpudash.sources.base import MetricsSource, SourceError, parse_instant_query
+from tpudash.sources.base import (
+    MetricsSource,
+    SourceError,
+    parse_instant_query,
+    parse_json_bytes,
+)
 
 
 class FixtureSource(MetricsSource):
@@ -41,11 +46,14 @@ class FixtureSource(MetricsSource):
 
     def fetch(self):
         try:
-            with open(self.path) as f:
-                payload = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError as e:
             raise SourceError(f"cannot load fixture {self.path!r}: {e}") from e
-        samples = parse_instant_query(payload)
+        try:
+            samples = parse_json_bytes(data)
+        except SourceError as e:
+            raise SourceError(f"cannot load fixture {self.path!r}: {e}") from e
         if not samples:
             raise SourceError(f"fixture {self.path!r} contains no parseable samples")
         return samples
@@ -111,6 +119,45 @@ def synthetic_payload(
             emit(POWER, chip, sl, 0.0 if idle else gen.nominal_power_w * (0.35 + 0.6 * wave))
 
     return {"status": "success", "data": {"resultType": "vector", "result": results}}
+
+
+class JsonReplaySource(MetricsSource):
+    """Cycle through pre-serialized instant-query payload *bytes*.
+
+    Models exactly what a production dashboard does each refresh — parse a
+    Prometheus response off the wire — so a frame benchmark over this source
+    charges the real decode cost (native frame kernel when available) and
+    nothing else.  Unlike SyntheticSource, payload fabrication happens once
+    at construction, not per fetch.
+    """
+
+    name = "replay"
+
+    def __init__(self, payloads: list):
+        if not payloads:
+            raise SourceError("replay source needs at least one payload")
+        self.payloads = [
+            p.encode("utf-8") if isinstance(p, str) else p for p in payloads
+        ]
+        self._i = 0
+
+    @classmethod
+    def synthetic(cls, num_chips: int, generation: str = "v5e", frames: int = 8):
+        """Pre-serialize `frames` synthetic payloads at distinct times."""
+        return cls(
+            [
+                json.dumps(
+                    synthetic_payload(num_chips=num_chips, generation=generation,
+                                      t=1000.0 + 5.0 * i)
+                )
+                for i in range(frames)
+            ]
+        )
+
+    def fetch(self):
+        data = self.payloads[self._i % len(self.payloads)]
+        self._i += 1
+        return parse_json_bytes(data)
 
 
 class SyntheticSource(MetricsSource):

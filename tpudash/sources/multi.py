@@ -31,6 +31,7 @@ import dataclasses
 import logging
 
 from tpudash.config import Config
+from tpudash.schema import SampleBatch
 from tpudash.sources.base import MetricsSource, SourceError
 
 log = logging.getLogger("tpudash.sources.multi")
@@ -89,7 +90,7 @@ class MultiSource(MetricsSource):
         self.last_errors: dict[str, str] = {}
 
     def fetch(self):
-        samples = []
+        results = []  # per healthy child: list[Sample] or SampleBatch
         errors: dict[str, str] = {}
         for ep, child in self.children:
             label = ep.slice_name or ep.url
@@ -99,8 +100,11 @@ class MultiSource(MetricsSource):
                 errors[label] = str(e)
                 log.warning("multi: child %s failed: %s", label, e)
                 continue
+            is_batch = isinstance(got, SampleBatch)
             if ep.slice_name is not None:
-                child_slices = {s.chip.slice_id for s in got}
+                child_slices = (
+                    set(got.slices) if is_batch else {s.chip.slice_id for s in got}
+                )
                 if len(child_slices) > 1:
                     # relabeling a multi-slice child collapses distinct
                     # (slice, chip) keys onto one name → duplicate rows
@@ -109,15 +113,25 @@ class MultiSource(MetricsSource):
                         "%s — chip keys may collide",
                         label, len(child_slices), sorted(child_slices),
                     )
-                got = [
-                    dataclasses.replace(
-                        s, chip=dataclasses.replace(s.chip, slice_id=ep.slice_name)
-                    )
-                    for s in got
-                ]
-            samples.extend(got)
+                if is_batch:
+                    got = got.relabel_slice(ep.slice_name)
+                else:
+                    got = [
+                        dataclasses.replace(
+                            s, chip=dataclasses.replace(s.chip, slice_id=ep.slice_name)
+                        )
+                        for s in got
+                    ]
+            results.append(got)
         self.last_errors = errors
-        if not samples:
+        if not any(len(r) for r in results):
             detail = "; ".join(f"{k}: {v}" for k, v in errors.items())
             raise SourceError(f"all {len(self.children)} endpoints failed: {detail}")
+        if all(isinstance(r, SampleBatch) for r in results):
+            return SampleBatch.concat(results)
+        # mixed representations (e.g. a synthetic child among scrapes):
+        # flatten to the Sample-list path
+        samples: list = []
+        for r in results:
+            samples.extend(r.to_samples() if isinstance(r, SampleBatch) else r)
         return samples

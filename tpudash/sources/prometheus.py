@@ -19,9 +19,15 @@ from __future__ import annotations
 
 import requests
 
+from tpudash import native
 from tpudash.config import Config
 from tpudash.schema import SCRAPE_SERIES
-from tpudash.sources.base import MetricsSource, SourceError, parse_instant_query
+from tpudash.sources.base import (
+    MetricsSource,
+    SourceError,
+    parse_instant_query,
+    parse_json_bytes,
+)
 
 
 class PrometheusSource(MetricsSource):
@@ -65,8 +71,13 @@ class PrometheusSource(MetricsSource):
 
     def fetch(self):
         instances = self.discover_instances()
-        payload = self._get({"query": self.build_query(instances)})
-        samples = parse_instant_query(payload)
+        params = {"query": self.build_query(instances)}
+        if native.is_available():
+            # native fast path: JSON decode + label parse + pivot fused in
+            # one pass over the raw response bytes (tpudash/native)
+            samples = parse_json_bytes(self._get_raw(params))
+        else:
+            samples = parse_instant_query(self._get(params))
         if not samples:
             raise SourceError(
                 "prometheus returned no parseable TPU series "
@@ -87,6 +98,18 @@ class PrometheusSource(MetricsSource):
             raise SourceError(f"prometheus query failed: {e}") from e
         except ValueError as e:  # json decode
             raise SourceError(f"prometheus returned invalid JSON: {e}") from e
+
+    def _get_raw(self, params: dict) -> bytes:
+        try:
+            resp = self.session.get(
+                self.cfg.prometheus_endpoint,
+                params=params,
+                timeout=self.cfg.http_timeout,
+            )
+            resp.raise_for_status()
+            return resp.content
+        except requests.RequestException as e:
+            raise SourceError(f"prometheus query failed: {e}") from e
 
     def close(self) -> None:
         self.session.close()

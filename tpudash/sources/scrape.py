@@ -12,8 +12,7 @@ from __future__ import annotations
 import requests
 
 from tpudash.config import Config
-from tpudash.exporter.textfmt import TextFormatError, parse_text_format
-from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.base import MetricsSource, SourceError, parse_text_bytes
 
 
 class ScrapeSource(MetricsSource):
@@ -30,10 +29,7 @@ class ScrapeSource(MetricsSource):
             text = resp.text
         except requests.RequestException as e:
             raise SourceError(f"scrape of {self.cfg.scrape_url} failed: {e}") from e
-        try:
-            samples = parse_text_format(text)
-        except TextFormatError as e:
-            raise SourceError(f"exporter returned malformed text format: {e}") from e
+        samples = parse_text_bytes(text)
         if not samples:
             raise SourceError(
                 f"{self.cfg.scrape_url} exposed no chip-labeled TPU series"

@@ -173,6 +173,10 @@ def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:
     ny, width, cells = grid_layout(topo)
     grid = [[None] * width for _ in range(ny)]
     for cid, v in values.items():
+        if not 0 <= cid < len(cells):
+            raise ValueError(
+                f"chip_id {cid} out of range for {topo.num_chips}-chip topology"
+            )
         y, x = cells[cid]
         grid[y][x] = v
     return grid
