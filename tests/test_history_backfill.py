@@ -1,0 +1,159 @@
+"""Range-query parsing + trend-history backfill tests.
+
+The reference keeps no history; tpudash seeds its rolling trend from a
+Prometheus ``query_range`` at startup (Config.history_backfill) so the
+sparklines show a real trend on the first frame.
+"""
+
+import os
+
+from tpudash import schema
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.sources.base import parse_range_query
+from tpudash.sources.fixture import FixtureSource
+from tpudash.sources.prometheus import PrometheusSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _range_payload():
+    def series(name, chip, pts):
+        return {
+            "metric": {
+                "__name__": name,
+                "chip_id": str(chip),
+                "slice": "slice-0",
+                "host": "host-0",
+                "accelerator": "tpu-v5-lite-podslice",
+            },
+            "values": [[float(ts), str(v)] for ts, v in pts],
+        }
+
+    return {
+        "status": "success",
+        "data": {
+            "resultType": "matrix",
+            "result": [
+                series(schema.TENSORCORE_UTIL, 0, [(100, 50), (105, 60), (110, 70)]),
+                series(schema.TENSORCORE_UTIL, 1, [(100, 30), (105, 40), (110, 50)]),
+                series(schema.POWER, 0, [(100, 120), (110, 140)]),
+                {"metric": {"__name__": "x"}, "values": [[100, "1"]]},  # no chip id
+                {"metric": {"__name__": schema.POWER, "chip_id": "2"},
+                 "values": [[100, "bad"], "junk"]},  # unparseable points
+            ],
+        },
+    }
+
+
+def test_parse_range_query_groups_by_timestamp():
+    points = parse_range_query(_range_payload())
+    assert [ts for ts, _ in points] == [100.0, 105.0, 110.0]
+    at_100 = {(s.metric, s.chip.chip_id): s.value for s in points[0][1]}
+    assert at_100[(schema.TENSORCORE_UTIL, 0)] == 50.0
+    assert at_100[(schema.TENSORCORE_UTIL, 1)] == 30.0
+    assert at_100[(schema.POWER, 0)] == 120.0
+    # ts=105 has no power point — only the two util series
+    assert len(points[1][1]) == 2
+
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self._payload = payload
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self._payload
+
+
+class _FakeSession:
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def get(self, url, params=None, timeout=None):
+        self.calls.append((url, params))
+        return _FakeResponse(self.responses.pop(0))
+
+    def close(self):
+        pass
+
+
+def test_fetch_history_hits_range_endpoint():
+    sess = _FakeSession([_range_payload()])
+    src = PrometheusSource(Config(), session=sess)
+    points = src.fetch_history(duration_s=600, step_s=5)
+    assert len(points) == 3
+    url, params = sess.calls[0]
+    assert url == "http://localhost:9090/api/v1/query_range"
+    assert float(params["end"]) - float(params["start"]) == 600.0
+    assert params["step"] == "5"
+    assert schema.TENSORCORE_UTIL in params["query"]
+
+
+def test_range_endpoint_derivation():
+    src = PrometheusSource(Config(prometheus_endpoint="http://p:9090/api/v1/query"))
+    assert src.range_endpoint() == "http://p:9090/api/v1/query_range"
+    src2 = PrometheusSource(Config(prometheus_endpoint="http://p:9090/prom"))
+    assert src2.range_endpoint() == "http://p:9090/prom/query_range"
+
+
+class _HistoryFixtureSource(FixtureSource):
+    """Fixture source that also answers fetch_history with a canned trend."""
+
+    def fetch_history(self, duration_s, step_s):
+        return parse_range_query(_range_payload())
+
+
+def test_service_backfills_and_first_frame_has_trends():
+    cfg = Config(history_backfill=600, fetch_retries=0)
+    svc = DashboardService(cfg, _HistoryFixtureSource(FIXTURE))
+    assert len(svc.history) == 3
+    ts0, avgs0 = svc.history[0]
+    assert ts0 == 100.0
+    assert avgs0[schema.TENSORCORE_UTIL] == 40.0  # mean of 50, 30
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    trend_panels = [t["panel"] for t in frame["trends"]]
+    assert schema.TENSORCORE_UTIL in trend_panels  # sparkline on frame #1
+
+
+def test_backfill_failure_degrades_to_empty_history():
+    class Boom(FixtureSource):
+        def fetch_history(self, duration_s, step_s):
+            raise RuntimeError("range query exploded")
+
+    svc = DashboardService(
+        Config(history_backfill=600, fetch_retries=0), Boom(FIXTURE)
+    )
+    assert len(svc.history) == 0
+    assert svc.render_frame()["error"] is None  # startup survives
+
+
+def test_backfill_duration_clamped_to_deque_capacity():
+    # a 24h request with a 720-point deque at 5 s cadence asks Prometheus
+    # for 3600 s, not 86400 (avoids the per-series point-count cap)
+    seen = {}
+
+    class Recording(FixtureSource):
+        def fetch_history(self, duration_s, step_s):
+            seen["duration"] = duration_s
+            seen["step"] = step_s
+            return []
+
+    DashboardService(
+        Config(history_backfill=86400, fetch_retries=0), Recording(FIXTURE)
+    )
+    assert seen["duration"] == 720 * 5.0
+    assert seen["step"] == 5.0
+
+
+def test_backfill_disabled_by_default():
+    svc = DashboardService(Config(fetch_retries=0), _HistoryFixtureSource(FIXTURE))
+    assert len(svc.history) == 0
+
+
+def test_env_knob():
+    assert load_config({"TPUDASH_HISTORY_BACKFILL": "900"}).history_backfill == 900.0

@@ -1,0 +1,159 @@
+"""Retry/backoff + source-health tests (fault injection).
+
+The reference's only failure handling is banner-and-wait (app.py:225-227);
+these tests pin the rebuild's stronger contract: transient failures are
+retried within the frame, persistent failures flip health through
+degraded → down, and recovery resets the streak.
+"""
+
+import os
+import random
+
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.sources import make_source
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.fixture import FixtureSource
+from tpudash.sources.retry import ResilientSource, RetryPolicy, SourceHealth
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+class FlakySource(MetricsSource):
+    """Fails the first ``fail_times`` fetches, then succeeds forever."""
+
+    name = "flaky"
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.calls = 0
+        self.inner = FixtureSource(FIXTURE)
+
+    def fetch(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise SourceError(f"injected fault #{self.calls}")
+        return self.inner.fetch()
+
+
+def _resilient(fail_times, retries=2):
+    sleeps = []
+    src = ResilientSource(
+        FlakySource(fail_times),
+        RetryPolicy(retries=retries, base_backoff=0.25, max_backoff=2.0),
+        sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    return src, sleeps
+
+
+def test_transient_failure_recovers_within_one_fetch():
+    src, sleeps = _resilient(fail_times=2, retries=2)
+    samples = src.fetch()
+    assert samples  # third attempt succeeded
+    assert src.inner.calls == 3
+    assert len(sleeps) == 2  # slept between attempts, not after success
+    assert src.health.status == "healthy"
+    assert src.health.retried_fetches == 1
+    assert src.health.total_failures == 0  # the *fetch* succeeded
+
+
+def test_exhausted_retries_raise_and_count_one_failure():
+    src, sleeps = _resilient(fail_times=10, retries=2)
+    try:
+        src.fetch()
+        raise AssertionError("expected SourceError")
+    except SourceError as e:
+        assert "after 3 attempts" in str(e)
+    assert src.inner.calls == 3
+    assert src.health.total_failures == 1
+    assert src.health.status == "degraded"
+
+
+def test_backoff_grows_and_is_capped():
+    src, sleeps = _resilient(fail_times=10, retries=4)
+    try:
+        src.fetch()
+    except SourceError:
+        pass
+    # full jitter: each sleep is within [0, min(max, base*2^k)]
+    caps = [0.25, 0.5, 1.0, 2.0]
+    assert len(sleeps) == 4
+    for s, cap in zip(sleeps, caps):
+        assert 0.0 <= s <= cap
+
+
+def test_frame_budget_stops_retries():
+    # a slow/down endpoint must not stall the frame lock: once the budget
+    # is spent, no further attempts are made this fetch
+    flaky = FlakySource(fail_times=10)
+    src = ResilientSource(
+        flaky,
+        RetryPolicy(retries=5, frame_budget=0.0),  # budget already spent
+        sleep=lambda s: None,
+    )
+    try:
+        src.fetch()
+        raise AssertionError("expected SourceError")
+    except SourceError as e:
+        assert "after 1 attempt" in str(e)
+    assert flaky.calls == 1
+
+
+def test_health_transitions_down_and_back():
+    h = SourceHealth(clock=lambda: 123.0)
+    assert h.status == "healthy"
+    h.record_failure()
+    assert h.status == "degraded"
+    h.record_failure()
+    h.record_failure()
+    assert h.status == "down"
+    assert h.summary()["consecutive_failures"] == 3
+    h.record_success(retried=False)
+    assert h.status == "healthy"
+    assert h.summary()["last_success_ts"] == 123.0
+    assert h.summary()["total_failures"] == 3
+
+
+def test_make_source_wraps_with_retry_by_default():
+    cfg = Config(source="fixture", fixture_path=FIXTURE)
+    src = make_source(cfg)
+    assert isinstance(src, ResilientSource)
+    assert src.name == "fixture+retry"
+    assert src.fetch()  # delegation works
+    # retries disabled → bare source (reference one-shot behavior)
+    bare = make_source(Config(source="fixture", fixture_path=FIXTURE, fetch_retries=0))
+    assert not isinstance(bare, ResilientSource)
+
+
+def test_env_knobs():
+    cfg = load_config({"TPUDASH_FETCH_RETRIES": "5", "TPUDASH_RETRY_BACKOFF": "0.5"})
+    assert cfg.fetch_retries == 5
+    assert cfg.retry_backoff == 0.5
+
+
+def test_frame_carries_source_health():
+    cfg = Config(source="fixture", fixture_path=FIXTURE)
+    svc = DashboardService(cfg, make_source(cfg))
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    assert frame["source_health"]["status"] == "healthy"
+    assert frame["source_health"]["total_fetches"] == 1
+
+
+def test_frame_health_goes_down_after_streak():
+    src = ResilientSource(
+        FlakySource(fail_times=10**6),
+        RetryPolicy(retries=0),
+        sleep=lambda s: None,
+    )
+    svc = DashboardService(Config(), src)
+    for _ in range(3):
+        frame = svc.render_frame()
+        assert frame["error"] is not None
+    assert frame["source_health"]["status"] == "down"
+    # recovery resets the streak
+    src.inner.fail_times = 0
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    assert frame["source_health"]["status"] == "healthy"

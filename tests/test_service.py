@@ -85,6 +85,39 @@ def test_heatmap_mode_above_panel_limit():
     assert len(z) == 8 and len(z[0]) == 8  # v5e-64 topology
 
 
+def test_heatmap_survives_bogus_chip_ids():
+    # per-series tolerance policy: a rogue chip_id=-1 (raises in
+    # heatmap_grid) or chip_id=2e9 (would size a 2-billion-cell grid)
+    # drops that cell — it must not 500 or hang the frame
+    class WithBogus(SyntheticSource):
+        def fetch(self):
+            samples = super().fetch()
+            bad = samples[0]
+            for cid in (-1, 2_000_000_000):
+                samples.append(
+                    type(bad)(
+                        metric=bad.metric,
+                        value=1.0,
+                        chip=type(bad.chip)(
+                            slice_id="slice-0", host="h", chip_id=cid
+                        ),
+                        accelerator_type=bad.accelerator_type,
+                    )
+                )
+            return samples
+
+    svc = _svc(WithBogus(num_chips=64), per_chip_panel_limit=16)
+    keys = [f"slice-0/{i}" for i in range(64)]
+    keys += ["slice-0/-1", "slice-0/2000000000"]
+    svc.state.select_all(keys)
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    assert len(frame["heatmaps"]) >= 4
+    # topology stayed sized to the real slice, not the bogus id
+    z = frame["heatmaps"][0]["figure"]["data"][0]["z"]
+    assert len(z) == 8 and len(z[0]) == 8
+
+
 def test_heatmap_partial_selection_keeps_full_slice_topology():
     # 17 of 64 chips selected → still an 8×8 torus, not a 1×17 strip
     svc = _svc(SyntheticSource(num_chips=64), per_chip_panel_limit=16)

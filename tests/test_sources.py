@@ -219,8 +219,9 @@ def test_prometheus_empty_result_raises():
 # --- factory ----------------------------------------------------------------
 
 def test_make_source_kinds():
-    assert make_source(Config(source="synthetic", synthetic_chips=4)).name == "synthetic"
-    assert make_source(Config(source="fixture", fixture_path=FIXTURE)).name == "fixture"
-    assert make_source(Config(source="prometheus")).name == "prometheus"
+    # every source is wrapped in the retry layer by default (sources/retry.py)
+    assert make_source(Config(source="synthetic", synthetic_chips=4)).inner.name == "synthetic"
+    assert make_source(Config(source="fixture", fixture_path=FIXTURE)).inner.name == "fixture"
+    assert make_source(Config(source="prometheus")).inner.name == "prometheus"
     with pytest.raises(ValueError):
         make_source(Config(source="nope"))

@@ -136,9 +136,11 @@ class DashboardServer:
         return web.json_response({"alerts": snapshot})
 
     async def healthz(self, request: web.Request) -> web.Response:
+        health = self.service.source_health()
         return web.json_response(
             {"ok": True, "source": self.service.source.name,
-             "error": self.service.last_error}
+             "error": self.service.last_error,
+             "source_health": health}
         )
 
     def build_app(self) -> web.Application:

@@ -73,6 +73,8 @@ class DashboardService:
         #: frame — trend history the reference never kept.  At the default
         #: 5 s cadence, 720 points ≈ one hour.
         self.history: deque = deque(maxlen=720)
+        if cfg.history_backfill > 0:
+            self._backfill_history()
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
         if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
@@ -82,6 +84,51 @@ class DashboardService:
 
             self.alert_engine = AlertEngine.from_spec(cfg.alert_rules or None)
         self.last_alerts: list[dict] = []
+
+    def _backfill_history(self) -> None:
+        """Seed the trend history from the source's range query (Prometheus
+        ``query_range``) so sparklines show Config.history_backfill seconds
+        of real trend on the very first frame.  Backfilled averages cover
+        ALL chips in scope (the live loop averages the *selected* chips);
+        failures degrade to an empty history, never a startup crash."""
+        fetch_history = getattr(self.source, "fetch_history", None)
+        if fetch_history is None:
+            return
+        # clamp to what the rolling deque can keep: asking for more points
+        # than maxlen both wastes the transfer and risks Prometheus's
+        # per-series point cap (11k) rejecting the whole range query
+        step = max(self.cfg.refresh_interval, 1.0)
+        duration = min(
+            self.cfg.history_backfill, (self.history.maxlen or 0) * step
+        )
+        try:
+            points = fetch_history(duration, step)
+        except Exception as e:  # noqa: BLE001 — backfill is best-effort
+            log.warning("history backfill failed: %s", e)
+            return
+        columns = [p.column for p in (*schema.PANELS, *schema.EXTRA_PANELS)]
+        n = 0
+        for ts, samples in points[-(self.history.maxlen or 0) :]:
+            try:
+                df = to_wide(samples)
+            except Exception:  # noqa: BLE001 — skip malformed slots
+                continue
+            avgs = {
+                col: column_average(df, col) for col in columns if col in df.columns
+            }
+            if avgs:
+                self.history.append((float(ts), avgs))
+                n += 1
+        if n:
+            log.info(
+                "backfilled %d trend points covering %.0f s", n, self.cfg.history_backfill
+            )
+
+    def source_health(self) -> "dict | None":
+        """Health summary from the ResilientSource wrapper (None when
+        retries are disabled and the wrapper is absent)."""
+        health = getattr(self.source, "health", None)
+        return health.summary() if health is not None else None
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -152,17 +199,26 @@ class DashboardService:
             accels = accel_types_for(sdf)
             generation = accels[0] if accels else self.cfg.generation
             # topology sized to the FULL slice population (not just the
-            # selection) so partial selections keep real torus coordinates
-            n = int(df.loc[df["slice_id"] == slice_id, "chip_id"].max()) + 1
+            # selection) so partial selections keep real torus coordinates.
+            # Bogus ids (negative, or beyond any real pod size — v5p tops
+            # out near 9k chips) are excluded from sizing AND rendering:
+            # per-series tolerance (sources/base.py), a corrupt series
+            # drops its cell, it must not size a 2e9-cell grid or raise.
+            slice_ids = df.loc[df["slice_id"] == slice_id, "chip_id"]
+            sane = slice_ids[(slice_ids >= 0) & (slice_ids < 16384)]
+            if sane.empty:
+                continue
+            n = int(sane.max()) + 1
             topo = topology_for(generation, n)
             chip_ids = sdf["chip_id"].to_numpy()
+            in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
             for spec in panels:
                 if spec.column not in sdf.columns:
                     continue
                 vals = pd.to_numeric(sdf[spec.column], errors="coerce").to_numpy(
                     dtype=float, na_value=np.nan
                 )
-                mask = ~np.isnan(vals)
+                mask = ~np.isnan(vals) & in_range
                 values = dict(
                     zip(
                         (int(c) for c in chip_ids[mask]),
@@ -245,6 +301,7 @@ class DashboardService:
             self.last_error = err
             frame["error"] = self.last_error
             frame["chips"] = []
+            frame["source_health"] = self.source_health()
             self.timer.end_frame()
             frame["timings"] = self.timer.summary()
             return frame
@@ -252,6 +309,7 @@ class DashboardService:
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
+        frame["source_health"] = self.source_health()
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
                 self.last_alerts = self.alert_engine.evaluate(df)

@@ -37,6 +37,12 @@ class Config:
     device_panel_height: int = 200
     #: HTTP timeout for Prometheus queries, seconds.
     http_timeout: float = 4.0
+    #: Extra fetch attempts after a failure, within one frame (exponential
+    #: backoff + jitter; see sources/retry.py).  0 = reference behavior
+    #: (one shot per cycle, app.py:225-227).
+    fetch_retries: int = 2
+    #: First retry backoff, seconds (attempt k waits ≤ backoff·2^k, capped).
+    retry_backoff: float = 0.25
 
     # --- TPU-native additions ----------------------------------------------
     #: Metrics source: "prometheus" | "fixture" | "probe" | "synthetic".
@@ -77,6 +83,16 @@ class Config:
     #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
     #: defaults; "off" disables alerting.
     alert_rules: str = ""
+    #: Seed the trend history from a Prometheus range query covering this
+    #: many seconds at startup (0 disables; only sources with
+    #: ``fetch_history`` participate).  Sparklines show a real trend on the
+    #: first frame instead of growing from empty.
+    history_backfill: float = 0.0
+    #: source="workload": checkpoint/resume for the background train loop
+    #: (models/checkpoint.py) — save every N steps into this directory and
+    #: resume from its latest step on restart.  "" disables.
+    workload_checkpoint_dir: str = ""
+    workload_checkpoint_every: int = 64
     #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
     #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
     #: URLs ending in /metrics are scraped directly; others are Prometheus
@@ -94,6 +110,8 @@ _ENV_MAP = {
     "avg_panel_height": "TPUDASH_AVG_PANEL_HEIGHT",
     "device_panel_height": "TPUDASH_DEVICE_PANEL_HEIGHT",
     "http_timeout": "TPUDASH_HTTP_TIMEOUT",
+    "fetch_retries": "TPUDASH_FETCH_RETRIES",
+    "retry_backoff": "TPUDASH_RETRY_BACKOFF",
     "source": "TPUDASH_SOURCE",
     "fixture_path": "TPUDASH_FIXTURE_PATH",
     "synthetic_chips": "TPUDASH_SYNTHETIC_CHIPS",
@@ -108,6 +126,9 @@ _ENV_MAP = {
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
+    "history_backfill": "TPUDASH_HISTORY_BACKFILL",
+    "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
+    "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",
 }
 

@@ -13,7 +13,28 @@ from tpudash.sources.prometheus import PrometheusSource  # noqa: F401
 
 
 def make_source(cfg) -> MetricsSource:
-    """Source factory driven by Config.source."""
+    """Source factory driven by Config.source.  Every source is wrapped in
+    ResilientSource (per-fetch retry/backoff + health tracking,
+    sources/retry.py) unless Config.fetch_retries == 0."""
+    src = _make_source(cfg)
+    retries = getattr(cfg, "fetch_retries", 0)
+    if retries > 0:
+        from tpudash.sources.retry import ResilientSource, RetryPolicy
+
+        src = ResilientSource(
+            src,
+            RetryPolicy(
+                retries=retries,
+                base_backoff=getattr(cfg, "retry_backoff", 0.25),
+                # a down endpoint must not stall the frame lock past its
+                # slot: stop retrying once the refresh interval is spent
+                frame_budget=getattr(cfg, "refresh_interval", None) or None,
+            ),
+        )
+    return src
+
+
+def _make_source(cfg) -> MetricsSource:
     kind = cfg.source
     if kind == "prometheus":
         return PrometheusSource(cfg)

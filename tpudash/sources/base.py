@@ -82,6 +82,92 @@ def parse_text_bytes(text: "str | bytes") -> "SampleBatch | list[Sample]":
         raise SourceError(f"exporter returned malformed text format: {e}") from e
 
 
+def _series_identity(
+    metric: dict, chip_cache: dict, default_slice: str
+) -> "tuple[str, ChipKey, str] | None":
+    """Shared label rules for instant and range parsers: metric-labels dict
+    → (series name, interned ChipKey, accelerator type), or None when the
+    series lacks a name or parseable chip id (skip it, don't fail the
+    scrape).  TPU-native labels win; the reference exporter's gpu_id /
+    card_model / instance shapes are accepted as fallbacks (app.py:183-201)."""
+    name = metric.get("__name__")
+    if not name:
+        return None
+    chip_label = metric.get("chip_id")
+    if chip_label is None:
+        chip_label = metric.get("gpu_id")
+        if chip_label is None:
+            return None
+    try:
+        chip_id = int(chip_label)
+    except (TypeError, ValueError):
+        return None
+    slice_id = metric.get("slice", default_slice)
+    host = metric.get("host")
+    if host is None:
+        host = metric.get("instance", "")
+    ckey = (slice_id, host, chip_id)
+    chip = chip_cache.get(ckey)
+    if chip is None:
+        chip = chip_cache[ckey] = ChipKey(
+            slice_id=slice_id, host=host, chip_id=chip_id
+        )
+    accel = metric.get("accelerator")
+    if accel is None:
+        accel = metric.get("card_model", "")
+    return name, chip, accel
+
+
+def parse_range_query(
+    payload: dict, default_slice: str = "slice-0"
+) -> list[tuple[float, list[Sample]]]:
+    """Parse a Prometheus ``/api/v1/query_range`` payload into per-timestamp
+    sample lists, sorted by timestamp.
+
+    The range shape differs from the instant shape only in
+    ``result[].values == [[ts, "str"], ...]`` replacing ``.value`` —
+    each (series, ts) pair is parsed with the same label rules as
+    :func:`parse_instant_query`.  Used to backfill the trend history on
+    dashboard startup (the reference keeps no history at all).
+    """
+    if payload.get("status") != "success":
+        raise SourceError(f"prometheus status={payload.get('status')!r}")
+    try:
+        results = payload["data"]["result"]
+    except (KeyError, TypeError) as e:
+        raise SourceError(f"malformed prometheus payload: {e}") from e
+
+    by_ts: dict[float, list[Sample]] = {}
+    chip_cache: dict[tuple, ChipKey] = {}
+    for item in results:
+        values = item.get("values")
+        metric = item.get("metric", {})
+        if not isinstance(values, (list, tuple)):
+            continue
+        # labels are constant per series: parse once, reuse for every point
+        ident = _series_identity(metric, chip_cache, default_slice)
+        if ident is None:
+            continue
+        name, chip, accel = ident
+        for point in values:
+            if not isinstance(point, (list, tuple)) or len(point) != 2:
+                continue
+            try:
+                ts, val = float(point[0]), float(point[1])
+            except (TypeError, ValueError):
+                continue
+            by_ts.setdefault(ts, []).append(
+                Sample(
+                    metric=name,
+                    value=val,
+                    chip=chip,
+                    accelerator_type=accel,
+                    labels=metric,
+                )
+            )
+    return sorted(by_ts.items())
+
+
 def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[Sample]:
     """Parse a Prometheus ``/api/v1/query`` JSON payload into Samples.
 
@@ -106,36 +192,17 @@ def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[S
     append = samples.append
     for item in results:
         metric = item.get("metric", {})
-        name = metric.get("__name__")
         value = item.get("value")
-        if not name or not isinstance(value, (list, tuple)) or len(value) != 2:
+        if not isinstance(value, (list, tuple)) or len(value) != 2:
             continue
         try:
             val = float(value[1])
         except (TypeError, ValueError):
             continue
-        chip_label = metric.get("chip_id")
-        if chip_label is None:
-            chip_label = metric.get("gpu_id")
-            if chip_label is None:
-                continue
-        try:
-            chip_id = int(chip_label)
-        except (TypeError, ValueError):
+        ident = _series_identity(metric, chip_cache, default_slice)
+        if ident is None:
             continue
-        slice_id = metric.get("slice", default_slice)
-        host = metric.get("host")
-        if host is None:
-            host = metric.get("instance", "")
-        ckey = (slice_id, host, chip_id)
-        chip = chip_cache.get(ckey)
-        if chip is None:
-            chip = chip_cache[ckey] = ChipKey(
-                slice_id=slice_id, host=host, chip_id=chip_id
-            )
-        accel = metric.get("accelerator")
-        if accel is None:
-            accel = metric.get("card_model", "")
+        name, chip, accel = ident
         append(
             Sample(
                 metric=name,

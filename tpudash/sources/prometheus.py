@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import requests
 
+import time
+
 from tpudash import native
 from tpudash.config import Config
 from tpudash.schema import SCRAPE_SERIES
@@ -27,6 +29,7 @@ from tpudash.sources.base import (
     SourceError,
     parse_instant_query,
     parse_json_bytes,
+    parse_range_query,
 )
 
 
@@ -84,6 +87,39 @@ class PrometheusSource(MetricsSource):
                 "(is the tpu exporter scraped?)"
             )
         return samples
+
+    # -- history backfill ----------------------------------------------------
+    def range_endpoint(self) -> str:
+        """``/api/v1/query`` → ``/api/v1/query_range`` (same base URL)."""
+        ep = self.cfg.prometheus_endpoint
+        if ep.rstrip("/").endswith("/query"):
+            return ep.rstrip("/") + "_range"
+        return ep.rstrip("/") + "/query_range"
+
+    def fetch_history(self, duration_s: float, step_s: float):
+        """Range-query the last ``duration_s`` seconds at ``step_s``
+        resolution → sorted [(ts, samples)] for trend backfill.  Same
+        series selector as the live fetch, so the trend seed matches what
+        the dashboard will keep appending."""
+        instances = self.discover_instances()
+        end = time.time()
+        params = {
+            "query": self.build_query(instances),
+            "start": f"{end - duration_s:.3f}",
+            "end": f"{end:.3f}",
+            "step": f"{max(1.0, step_s):g}",
+        }
+        try:
+            resp = self.session.get(
+                self.range_endpoint(), params=params, timeout=self.cfg.http_timeout
+            )
+            resp.raise_for_status()
+            payload = resp.json()
+        except requests.RequestException as e:
+            raise SourceError(f"prometheus range query failed: {e}") from e
+        except ValueError as e:
+            raise SourceError(f"prometheus returned invalid JSON: {e}") from e
+        return parse_range_query(payload)
 
     def _get(self, params: dict) -> dict:
         try:
