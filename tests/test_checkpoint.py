@@ -1,0 +1,97 @@
+"""Checkpoint/resume tests (SURVEY.md §5: the reference has none).
+
+Round-trip fidelity, retention, and a full stop-the-runner/start-a-new-one
+resume cycle on the CPU test mesh.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpudash.models.checkpoint import WorkloadCheckpointer
+from tpudash.models.runner import WorkloadRunner
+from tpudash.models.workload import WorkloadConfig, make_train_state, train_step
+
+TINY = WorkloadConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64, seq=16, batch=4
+)
+
+
+def _trees_equal(a, b) -> bool:
+    eq = jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(jnp.asarray(x), jnp.asarray(y))), a, b
+    )
+    return all(jax.tree_util.tree_leaves(eq))
+
+
+def test_round_trip_exact(tmp_path):
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), TINY)
+    # advance one real step so opt_state is non-trivial (adamw mu/nu ≠ 0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (TINY.batch, TINY.seq), 0, TINY.vocab)
+    params, opt_state, _ = train_step(params, opt_state, tokens, TINY)
+
+    ck = WorkloadCheckpointer(str(tmp_path))
+    ck.save(7, params, opt_state)
+    tmpl_p, tmpl_o = make_train_state(jax.random.PRNGKey(9), TINY)
+    restored = ck.restore_latest(tmpl_p, tmpl_o)
+    assert restored is not None
+    r_params, r_opt, step = restored
+    assert step == 7
+    assert _trees_equal(r_params, params)
+    assert _trees_equal(r_opt, opt_state)
+    # optax NamedTuple structure round-trips (restore can feed train_step)
+    assert jax.tree_util.tree_structure(r_opt) == jax.tree_util.tree_structure(opt_state)
+    train_step(r_params, r_opt, tokens, TINY)
+
+
+def test_empty_dir_restores_none(tmp_path):
+    ck = WorkloadCheckpointer(str(tmp_path))
+    p, o = make_train_state(jax.random.PRNGKey(0), TINY)
+    assert ck.restore_latest(p, o) is None
+    assert ck.latest_step() is None
+
+
+def test_retention_keeps_newest(tmp_path):
+    ck = WorkloadCheckpointer(str(tmp_path), keep=2)
+    p, o = make_train_state(jax.random.PRNGKey(0), TINY)
+    for step in (1, 2, 3, 4):
+        ck.save(step, p, o)
+    assert ck.steps() == [3, 4]
+    assert ck.latest_step() == 4
+
+
+def _wait(pred, timeout=90.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.mark.slow
+def test_runner_resumes_across_restart(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    r1 = WorkloadRunner(
+        TINY, steps_per_sync=1, checkpoint_dir=ckdir, checkpoint_every=2
+    ).start()
+    try:
+        assert _wait(lambda: r1.steps >= 4), f"runner stalled (error={r1.error})"
+    finally:
+        r1.stop()
+    ck = WorkloadCheckpointer(ckdir)
+    saved = ck.latest_step()
+    assert saved is not None and saved >= 2
+
+    r2 = WorkloadRunner(
+        TINY, steps_per_sync=1, checkpoint_dir=ckdir, checkpoint_every=2
+    ).start()
+    try:
+        assert _wait(lambda: r2.steps > saved), f"resume stalled (error={r2.error})"
+        m = r2.metrics()
+        assert m["resumed_from"] == saved
+        assert m["steps"] > saved
+    finally:
+        r2.stop()

@@ -10,10 +10,13 @@ the busy-cluster picture the reference dashboard was built to watch.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 import jax
+
+_log = logging.getLogger(__name__)
 
 from tpudash.models.workload import (
     WorkloadConfig,
@@ -25,12 +28,23 @@ from tpudash.models.workload import (
 
 
 class WorkloadRunner:
-    def __init__(self, cfg: WorkloadConfig | None = None, steps_per_sync: int = 8):
+    def __init__(
+        self,
+        cfg: WorkloadConfig | None = None,
+        steps_per_sync: int = 8,
+        checkpoint_dir: str = "",
+        checkpoint_every: int = 0,
+    ):
         self.cfg = cfg or WorkloadConfig()
         #: dispatch this many steps back-to-back before one host readback —
         #: a per-step readback would serialize on the host↔device round
         #: trip (~80 ms on tunneled platforms) and idle the chip
         self.steps_per_sync = max(1, steps_per_sync)
+        #: checkpoint/resume (models/checkpoint.py): save every N steps into
+        #: checkpoint_dir and resume from its latest step on start.  Empty
+        #: dir or N=0 disables.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = max(0, checkpoint_every)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -39,6 +53,7 @@ class WorkloadRunner:
         self.loss = float("nan")
         self.step_time_ema = float("nan")  # seconds
         self.error: str | None = None
+        self.resumed_from: int | None = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "WorkloadRunner":
@@ -66,6 +81,26 @@ class WorkloadRunner:
             cfg = self.cfg
             key = jax.random.PRNGKey(0)
             params, opt_state = make_train_state(key, cfg)
+
+            # checkpointing is best-effort: a missing orbax install, an
+            # unwritable dir, or a corrupt checkpoint must degrade to
+            # "train without checkpoints", never kill the workload
+            ckptr = None
+            if self.checkpoint_dir and self.checkpoint_every:
+                try:
+                    from tpudash.models.checkpoint import WorkloadCheckpointer
+
+                    ckptr = WorkloadCheckpointer(self.checkpoint_dir)
+                    restored = ckptr.restore_latest(params, opt_state)
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("checkpointing disabled: %s", e)
+                    ckptr, restored = None, None
+                if restored is not None:
+                    params, opt_state, step0 = restored
+                    with self._lock:
+                        self.steps = step0
+                        self.resumed_from = step0
+
             n = jax.local_device_count()
             if n > 1:
                 from tpudash.parallel.mesh import build_mesh, mesh_axes_for
@@ -83,6 +118,7 @@ class WorkloadRunner:
             params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
 
             k = self.steps_per_sync
+            last_saved = self.steps
             while not self._stop.is_set():
                 t0 = time.perf_counter()
                 loss = None
@@ -102,6 +138,18 @@ class WorkloadRunner:
                         if self.step_time_ema != self.step_time_ema  # NaN
                         else 0.7 * self.step_time_ema + 0.3 * dt
                     )
+                if ckptr and self.steps - last_saved >= self.checkpoint_every:
+                    try:
+                        ckptr.save(self.steps, params, opt_state)
+                        last_saved = self.steps
+                    except Exception as e:  # noqa: BLE001 — disk full etc.
+                        _log.warning("checkpoint save failed, disabling: %s", e)
+                        ckptr = None
+            if ckptr and self.steps > last_saved:
+                try:
+                    ckptr.save(self.steps, params, opt_state)  # final save
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("final checkpoint save failed: %s", e)
         except Exception as e:  # surface crashes to the source, don't die mute
             with self._lock:
                 self.error = f"workload crashed: {e}"
@@ -115,6 +163,7 @@ class WorkloadRunner:
             ok = st == st and st > 0
             return {
                 "steps": self.steps,
+                "resumed_from": self.resumed_from,
                 "loss": self.loss,
                 "steps_per_second": (1.0 / st) if ok else 0.0,
                 "achieved_tflops": (

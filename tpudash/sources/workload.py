@@ -57,7 +57,10 @@ class WorkloadSource(MetricsSource):
             batch=int(cfg.extra.get("workload_batch", 16)),
         )
         self.runner = WorkloadRunner(
-            wcfg, steps_per_sync=int(cfg.extra.get("workload_steps_per_sync", 8))
+            wcfg,
+            steps_per_sync=int(cfg.extra.get("workload_steps_per_sync", 8)),
+            checkpoint_dir=cfg.workload_checkpoint_dir,
+            checkpoint_every=cfg.workload_checkpoint_every,
         )
 
     def fetch(self):
