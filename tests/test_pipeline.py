@@ -1,0 +1,92 @@
+"""Pipeline-parallelism tests (8-device CPU mesh, dp×pp).
+
+The pipeline computes the same function as the serial demo transformer —
+the strongest possible pin: loss AND gradients must match the unsharded
+oracle up to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudash.models import workload as w
+from tpudash.models.pipeline import make_pipeline_loss, make_pipeline_train_step
+from tpudash.models.workload import WorkloadConfig, make_train_state
+from tpudash.parallel.mesh import build_mesh
+
+CFG = WorkloadConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=4, d_ff=64, seq=16, batch=8
+)
+
+
+def _mesh(dp=2, pp=4):
+    return build_mesh({"dp": dp, "pp": pp})
+
+
+def _data(cfg=CFG):
+    params, opt_state = make_train_state(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab
+    )
+    return params, opt_state, tokens
+
+
+def test_pipeline_loss_matches_serial():
+    params, _, tokens = _data()
+    mesh = _mesh()
+    for M in (1, 2, 4):  # microbatch counts incl. the degenerate M=1
+        pipe_loss = make_pipeline_loss(mesh, CFG, num_microbatches=M)
+        got = jax.jit(pipe_loss)(params, tokens)
+        want = w.loss_fn(params, tokens, CFG)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-4), M
+
+
+def test_pipeline_grads_match_serial():
+    params, _, tokens = _data()
+    mesh = _mesh()
+    pipe_loss = make_pipeline_loss(mesh, CFG, num_microbatches=2)
+    g_pipe = jax.jit(jax.grad(pipe_loss))(params, tokens)
+    g_ser = jax.grad(lambda p: w.loss_fn(p, tokens, CFG))(params)
+    flat_p, _ = jax.tree_util.tree_flatten(g_pipe)
+    flat_s, _ = jax.tree_util.tree_flatten(g_ser)
+    for a, b in zip(flat_p, flat_s):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=4e-3,
+        )
+
+
+def test_pipeline_train_step_runs_and_learns():
+    params, opt_state, tokens = _data()
+    mesh = _mesh()
+    step, shard_inputs = make_pipeline_train_step(mesh, CFG, num_microbatches=2)
+    params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # same batch → loss must drop
+    # the layer stack is genuinely pp-sharded
+    sharding = params["blocks"]["wqkv"].sharding
+    assert "pp" in str(sharding.spec)
+
+
+def test_pipeline_rejects_bad_layer_split():
+    mesh = _mesh()
+    bad = WorkloadConfig(
+        vocab=64, d_model=32, n_heads=2, n_layers=3, d_ff=64, seq=16, batch=8
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipeline_loss(mesh, bad, num_microbatches=2)
+
+
+def test_pipeline_single_stage_degenerates_to_serial():
+    # pp=1 must also work (pure dp) — guards the schedule's edge arithmetic
+    params, _, tokens = _data()
+    mesh = build_mesh({"dp": 8, "pp": 1})
+    pipe_loss = make_pipeline_loss(mesh, CFG, num_microbatches=1)
+    got = jax.jit(pipe_loss)(params, tokens)
+    want = w.loss_fn(params, tokens, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
