@@ -1,0 +1,119 @@
+"""Expert-parallel (MoE) tests (8-device CPU mesh, ep axis).
+
+The sharded switch FFN (two all_to_alls over ``ep``) is pinned against a
+dense per-token oracle with capacity set high enough that nothing drops.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudash.models.moe import (
+    MoEConfig,
+    _route,
+    dense_moe_reference,
+    init_moe_params,
+    make_moe_loss,
+    make_moe_train_state,
+    make_moe_train_step,
+    moe_ffn_local,
+    moe_param_specs,
+)
+from tpudash.models.ring_attention import _SHARD_MAP_KW, shard_map
+from tpudash.parallel.mesh import build_mesh
+
+CFG = MoEConfig(
+    vocab=64, d_model=32, d_ff=64, n_experts=8, seq=8, batch=8,
+    capacity_factor=8.0,  # C = S → nothing drops → oracle-exact
+)
+
+
+def _mesh(ep=4):
+    return build_mesh({"ep": ep}, devices=jax.devices()[:ep])
+
+
+def _sharded_ffn(mesh, cfg):
+    G = mesh.shape["ep"]
+    fn = shard_map(
+        lambda p, x: moe_ffn_local(x, p, cfg, G)[0],
+        mesh=mesh,
+        in_specs=(moe_param_specs(), P("ep", None)),
+        out_specs=P("ep", None),
+        **_SHARD_MAP_KW,
+    )
+    return jax.jit(fn)
+
+
+@pytest.mark.parametrize("ep", [1, 4, 8])
+def test_moe_ffn_matches_dense_oracle(ep):
+    cfg = CFG
+    mesh = _mesh(ep)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    S_global = 64  # 8 tokens per shard at ep=8
+    x = (
+        jax.random.normal(jax.random.PRNGKey(1), (S_global, cfg.d_model))
+        .astype(jnp.bfloat16)
+    )
+    got = _sharded_ffn(mesh, cfg)(params, x)
+    # each shard routes ITS OWN tokens independently — the oracle applies
+    # per-token math, which is shard-layout invariant
+    want = dense_moe_reference(x, params, cfg)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=2e-2,
+    )
+
+
+def test_route_capacity_drops_tokens():
+    cfg = MoEConfig(n_experts=2, capacity_factor=0.5)  # S=8 → C=2 per expert
+    x = jnp.ones((8, cfg.d_model), jnp.float32)
+    router = jnp.zeros((cfg.d_model, cfg.n_experts), jnp.float32)
+    dispatch, combine, aux = _route(x, router, cfg, capacity=2)
+    # zero router → ties → every token argmaxes expert 0; only 2 fit
+    assert float(dispatch.sum()) == 2.0
+    # switch aux loss for fully-skewed routing with uniform probs = 1.0
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
+
+
+def test_moe_train_step_runs_and_learns():
+    cfg = MoEConfig(
+        vocab=64, d_model=32, d_ff=64, n_experts=8, seq=8, batch=8,
+        capacity_factor=2.0,
+    )
+    mesh = _mesh(4)
+    params, opt_state = make_moe_train_state(jax.random.PRNGKey(0), cfg)
+    step, shard_inputs = make_moe_train_step(mesh, cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab
+    )
+    params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # experts genuinely ep-sharded
+    assert "ep" in str(params["w_up"].sharding.spec)
+
+
+def test_moe_loss_finite_under_heavy_drop():
+    cfg = MoEConfig(
+        vocab=64, d_model=32, d_ff=64, n_experts=8, seq=8, batch=8,
+        capacity_factor=0.25,  # most tokens dropped
+    )
+    mesh = _mesh(4)
+    params, _ = make_moe_train_state(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab
+    )
+    loss = jax.jit(make_moe_loss(mesh, cfg))(params, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_moe_rejects_bad_expert_split():
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_moe_loss(mesh, MoEConfig(n_experts=6))
