@@ -144,6 +144,45 @@ def test_promjson_duplicate_label_keys_last_wins():
     assert batch.hosts == ["b"]  # json.loads semantics
 
 
+def test_promjson_host_collision_merges_rows_like_python():
+    # same (slice, chip) under two different host labels (one series has
+    # only Prometheus's instance label) must merge into ONE row — row
+    # identity is (slice, chip), first-seen host kept (normalize.to_wide)
+    payload = {
+        "status": "success",
+        "data": {"result": [
+            {"metric": {"__name__": "a", "chip_id": "0", "slice": "s",
+                        "host": "h1"}, "value": [0, "1"]},
+            {"metric": {"__name__": "b", "chip_id": "0", "slice": "s",
+                        "instance": "10.0.0.9:9100"}, "value": [0, "2"]},
+        ]},
+    }
+    batch = native.parse_promjson(json.dumps(payload))
+    df_py = to_wide(parse_instant_query(payload))
+    assert len(df_py) == 1
+    assert_frames_equal(batch, df_py)
+    assert batch.hosts == ["h1"]
+
+
+def test_promjson_deep_nesting_errors_instead_of_crashing():
+    # 100k nested brackets in a skipped field: must be a parse error (→
+    # SourceError banner), never a C-stack overflow
+    deep = "[" * 100_000 + "]" * 100_000
+    raw = '{"junk": ' + deep + ', "status":"success","data":{"result":[]}}'
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(raw)
+
+
+def test_text_duplicate_label_keys_last_wins():
+    # Python label parsing builds a dict (last duplicate wins); the native
+    # path must agree on which chip the sample lands on
+    text = 'm{chip_id="0",chip_id="1"} 5\n'
+    batch = native.parse_text(text)
+    df_py = to_wide(parse_text_format(text))
+    assert df_py["chip_id"].tolist() == [1]
+    assert_frames_equal(batch, df_py)
+
+
 def test_promjson_large_chip_ids_stay_distinct():
     # out-of-int32 ids must not wrap onto other chips' rows
     payload = {

@@ -70,12 +70,15 @@ struct Builder {
     return idx;
   }
 
+  // Row identity is (slice, chip_id) — NOT host — matching the Python
+  // pivot (ChipKey.key = "slice/chip", normalize.to_wide): series that
+  // disagree on host/instance labels merge into one row, first-seen host
+  // kept, exactly like the dict pivot's first-sample row init.
   int32_t chip(const std::string& slice, const std::string& host,
                int64_t chip_id) {
     std::string key;
-    key.reserve(slice.size() + host.size() + 14);
+    key.reserve(slice.size() + 14);
     key.append(slice).push_back('\x1f');
-    key.append(host).push_back('\x1f');
     key.append(std::to_string(chip_id));
     auto it = chip_idx.find(key);
     if (it != chip_idx.end()) return it->second;
@@ -208,8 +211,11 @@ bool parse_labels(const char* body, size_t n,
 const std::string* find_label(
     const std::vector<std::pair<std::string, std::string>>& labels,
     const char* key) {
-  for (const auto& kv : labels)
-    if (kv.first == key) return &kv.second;
+  // last-wins on duplicate label names — Python builds a dict, so a later
+  // duplicate overwrites (textfmt._parse_labels); the JSON path already
+  // keys last-wins the same way
+  for (auto it = labels.rbegin(); it != labels.rend(); ++it)
+    if (it->first == key) return &it->second;
   return nullptr;
 }
 
@@ -444,7 +450,13 @@ struct JParser {
     return true;
   }
 
-  bool skip_value() {
+  // bounded recursion: a hostile/broken payload of 100k nested brackets
+  // must surface as a parse error (→ SourceError banner, like the Python
+  // json.loads RecursionError path), not a C-stack overflow
+  static constexpr int kMaxSkipDepth = 256;
+
+  bool skip_value(int depth = 0) {
+    if (depth > kMaxSkipDepth) return fail("value nesting too deep");
     ws();
     if (p >= end) return fail("truncated value");
     switch (*p) {
@@ -457,7 +469,7 @@ struct JParser {
         while (true) {
           if (!parse_string(nullptr)) return false;
           if (!expect(':')) return false;
-          if (!skip_value()) return false;
+          if (!skip_value(depth + 1)) return false;
           ws();
           if (p < end && *p == ',') {
             ++p;
@@ -473,7 +485,7 @@ struct JParser {
           return true;
         }
         while (true) {
-          if (!skip_value()) return false;
+          if (!skip_value(depth + 1)) return false;
           ws();
           if (p < end && *p == ',') {
             ++p;
