@@ -1,6 +1,7 @@
 """HTTP server tests — the L4 surface (reference shell: app.py:247-489)."""
 
 import asyncio
+import json
 import os
 
 from aiohttp.test_utils import TestClient, TestServer
@@ -91,6 +92,82 @@ def test_style_toggle():
         assert fig["data"][0]["type"] == "bar"
 
     _run(_with_client(_client_app(), go))
+
+
+def test_stream_pushes_frames():
+    async def go(client):
+        resp = await client.get("/api/stream")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        for _ in range(2):  # frames keep flowing, not just one
+            raw = await asyncio.wait_for(
+                resp.content.readuntil(b"\n\n"), timeout=10
+            )
+            events.append(json.loads(raw.decode()[len("data: ") :]))
+        assert events[0]["error"] is None
+        assert [c["key"] for c in events[0]["chips"]] == [
+            "slice-0/0", "slice-0/1",
+        ]
+        assert events[1]["chips"]
+        resp.close()
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_export_csv():
+    async def go(client):
+        resp = await client.get("/api/export.csv")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/csv")
+        text = await resp.text()
+        lines = text.strip().splitlines()
+        assert lines[0].startswith("chip,")
+        assert "tpu_power_watts" in lines[0]
+        assert any(line.startswith("slice-0/0,") for line in lines[1:])
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_export_csv_unavailable_when_source_down():
+    from tpudash.sources.base import MetricsSource, SourceError
+
+    class Boom(MetricsSource):
+        name = "boom"
+
+        def fetch(self):
+            raise SourceError("down")
+
+    async def go(client):
+        resp = await client.get("/api/export.csv")
+        assert resp.status == 503
+
+    _run(_with_client(_client_app(source=Boom()), go))
+
+
+def test_export_csv_refuses_stale_data_during_outage():
+    # one good frame, then the source dies: export must 503, not serve the
+    # pre-outage table as current
+    class Flaky(FixtureSource):
+        fail = False
+
+        def fetch(self):
+            from tpudash.sources.base import SourceError
+
+            if self.fail:
+                raise SourceError("down")
+            return super().fetch()
+
+    src = Flaky(FIXTURE)
+
+    async def go(client):
+        resp = await client.get("/api/export.csv")
+        assert resp.status == 200
+        src.fail = True
+        resp = await client.get("/api/export.csv")
+        assert resp.status == 503
+
+    _run(_with_client(_client_app(source=src), go))
 
 
 def test_healthz_and_timings():

@@ -202,11 +202,15 @@ async function refresh() {
     frame = await (await fetch('/api/frame')).json();
   } catch (e) {
     showError('Dashboard server unreachable: ' + e);
-    if (!timer) timer = setInterval(refresh, 5000);  // keep retrying
+    if (!streaming && !timer) timer = setInterval(refresh, 5000);  // keep retrying
     return;
   }
+  applyFrame(frame);
+}
+
+function applyFrame(frame) {
   document.getElementById('last-updated').textContent = 'Last updated: ' + frame.last_updated;
-  if (!timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
+  if (!streaming && !timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
   showError(frame.error);
   showWarnings(frame.warnings);
   showAlerts(frame.alerts);
@@ -226,7 +230,29 @@ async function refresh() {
   document.getElementById('debug').textContent =
     'Debug: frames=' + (t.frames || 0) +
     (t.total ? (', scrape→render p50=' + t.total.p50_ms.toFixed(1) + ' ms') : '') +
+    (streaming ? ' · live (SSE)' : ' · polling') +
     (window._noPlotly ? ' · fallback renderer (plotly.js unavailable)' : '');
+}
+
+// ---- transport: SSE push with polling fallback ----------------------------
+function startStream() {
+  if (!window.EventSource) return;  // old browser → polling stays active
+  const es = new EventSource('/api/stream');
+  es.onmessage = e => {
+    streaming = true;
+    if (timer) { clearInterval(timer); timer = null; }
+    applyFrame(JSON.parse(e.data));
+  };
+  es.onerror = () => {
+    // server restart / proxy hiccup: drop to polling; EventSource
+    // auto-reconnects transient errors, but a CLOSED stream (non-200
+    // from a proxy) never retries itself — re-open it on a backoff
+    streaming = false;
+    if (!timer) timer = setInterval(refresh, 5000);
+    if (es.readyState === EventSource.CLOSED) {
+      setTimeout(startStream, 15000);
+    }
+  };
 }
 
 document.getElementById('use-gauge').addEventListener('change',
@@ -261,7 +287,9 @@ function showWarnings(list) {
 }
 
 let timer = null;
+let streaming = false;
 refresh();
+startStream();
 </script>
 </body>
 </html>

@@ -8,12 +8,16 @@ executor and never stall the event loop; a frame cache ensures many browser
 tabs cost one scrape per interval, not one per tab.
 
 Routes:
-  GET  /             dashboard page
-  GET  /api/frame    current frame (cached within the refresh interval)
-  POST /api/select   {"toggle": key} | {"selected": [keys]} | {"all": true} | {"none": true}
-  POST /api/style    {"use_gauge": bool}
-  GET  /api/timings  stage-timing summary (tracing, SURVEY.md §5)
-  GET  /healthz      liveness
+  GET  /               dashboard page
+  GET  /api/frame      current frame (cached within the refresh interval)
+  GET  /api/stream     server-sent events: one frame per refresh interval
+                       (push path; the page falls back to polling without
+                       EventSource support)
+  POST /api/select     {"toggle": key} | {"selected": [keys]} | {"all": true} | {"none": true}
+  POST /api/style      {"use_gauge": bool}
+  GET  /api/timings    stage-timing summary (tracing, SURVEY.md §5)
+  GET  /api/export.csv current wide per-chip table as CSV
+  GET  /healthz        liveness
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ class DashboardServer:
         self._lock = asyncio.Lock()
         self._cached_frame: dict | None = None
         self._cached_at: float = 0.0
+        self._cached_sse: bytes | None = None  # serialized once per frame
 
     # -- frame caching -------------------------------------------------------
     async def _get_frame(self, force: bool = False) -> dict:
@@ -51,7 +56,22 @@ class DashboardServer:
             frame = await loop.run_in_executor(None, self.service.render_frame)
             self._cached_frame = frame
             self._cached_at = time.monotonic()
+            self._cached_sse = None  # new frame → stale serialization
             return frame
+
+    async def _get_sse_payload(self) -> bytes:
+        """Current frame as a serialized SSE event.  Serialized ONCE per
+        frame no matter how many stream subscribers tick — frames embed
+        full figure JSON, so per-subscriber json.dumps would stall the
+        event loop at many open tabs."""
+        frame = await self._get_frame()
+        async with self._lock:
+            if self._cached_frame is frame and self._cached_sse is not None:
+                return self._cached_sse
+            payload = f"data: {json.dumps(frame)}\n\n".encode()
+            if self._cached_frame is frame:
+                self._cached_sse = payload
+            return payload
 
     async def _mutate(self, fn):
         """Run a state mutation under the frame lock: render_frame executes
@@ -71,6 +91,46 @@ class DashboardServer:
     async def frame(self, request: web.Request) -> web.Response:
         frame = await self._get_frame()
         return web.json_response(frame)
+
+    async def stream(self, request: web.Request) -> web.StreamResponse:
+        """Server-sent events: push a frame every refresh interval.  Many
+        subscribers share the frame cache, so N open tabs still cost one
+        scrape per interval."""
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        await resp.prepare(request)
+        try:
+            while True:
+                await resp.write(await self._get_sse_payload())
+                await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away — normal termination
+        return resp
+
+    async def export_csv(self, request: web.Request) -> web.Response:
+        """The current wide per-chip table as CSV (one row per chip,
+        identity columns + every metric column).  Always refreshes through
+        the cache-gated frame path so the export is at most one refresh
+        interval old, never an hours-stale snapshot."""
+        frame = await self._get_frame()
+        if frame.get("error"):
+            # don't serve pre-outage data as if it were current
+            raise web.HTTPServiceUnavailable(text=frame["error"])
+        df = self.service.last_df
+        if df is None:
+            raise web.HTTPServiceUnavailable(text="no frame rendered yet")
+        return web.Response(
+            text=df.to_csv(index_label="chip"),
+            content_type="text/csv",
+            headers={
+                "Content-Disposition": "attachment; filename=tpudash.csv"
+            },
+        )
 
     async def select(self, request: web.Request) -> web.Response:
         try:
@@ -147,6 +207,8 @@ class DashboardServer:
         app = web.Application()
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
+        app.router.add_get("/api/stream", self.stream)
+        app.router.add_get("/api/export.csv", self.export_csv)
         app.router.add_post("/api/select", self.select)
         app.router.add_post("/api/style", self.style)
         app.router.add_get("/api/timings", self.timings)

@@ -64,6 +64,8 @@ class DashboardService:
         self.state = SelectionState()
         self.timer = StageTimer()
         self.last_error: str | None = None
+        #: wide per-chip table from the last successful frame (CSV export)
+        self.last_df: "pd.DataFrame | None" = None
         #: chip keys seen in the last successful frame — the "currently
         #: available devices" selection ops validate against (app.py:281).
         self.available: list[str] = []
@@ -309,6 +311,7 @@ class DashboardService:
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
+        self.last_df = df
         frame["source_health"] = self.source_health()
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
