@@ -1,0 +1,104 @@
+"""Concurrency stress: the server's lock discipline under concurrent load.
+
+SURVEY.md §5 notes the reference is single-threaded by construction; the
+rebuild's server runs frame builds on a worker executor while selection /
+style mutations and SSE subscribers hit the same state concurrently.
+These tests hammer that surface: every response must be well-formed and
+the final state consistent (no torn selection lists, no crashed stream)."""
+
+import asyncio
+import json
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.app.server import DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _app(chips=32):
+    cfg = Config(source="synthetic", refresh_interval=0.0, fetch_retries=0)
+    service = DashboardService(cfg, SyntheticSource(num_chips=chips))
+    return DashboardServer(service).build_app()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_concurrent_frames_selects_and_styles():
+    async def go(client):
+        keys = [f"slice-0/{i}" for i in range(32)]
+
+        async def frame():
+            resp = await client.get("/api/frame")
+            assert resp.status == 200
+            f = await resp.json()
+            # selection list must never be torn: always sorted, valid keys
+            assert f["selected"] == sorted(f["selected"], key=keys.index)
+            assert set(f["selected"]) <= set(keys)
+
+        async def toggle(i):
+            resp = await client.post(
+                "/api/select", json={"toggle": f"slice-0/{i % 32}"}
+            )
+            assert resp.status == 200
+
+        async def style(on):
+            resp = await client.post("/api/style", json={"use_gauge": on})
+            assert resp.status == 200
+
+        tasks = []
+        for i in range(12):
+            tasks += [frame(), toggle(i), style(i % 2 == 0)]
+        await asyncio.gather(*tasks)
+
+        # state converged to something valid and persists across one more op
+        resp = await client.post("/api/select", json={"all": True})
+        sel = (await resp.json())["selected"]
+        assert sel == keys
+
+    _run(_with_client(_app(), go))
+
+
+def test_sse_subscribers_while_mutating():
+    async def go(client):
+        streams = [await client.get("/api/stream") for _ in range(4)]
+
+        async def read_events(resp, n=2):
+            out = []
+            for _ in range(n):
+                raw = await asyncio.wait_for(
+                    resp.content.readuntil(b"\n\n"), timeout=10
+                )
+                out.append(json.loads(raw.decode()[len("data: ") :]))
+            return out
+
+        async def mutate():
+            for i in range(6):
+                await client.post("/api/select", json={"toggle": f"slice-0/{i}"})
+
+        results = await asyncio.gather(
+            *(read_events(s) for s in streams), mutate()
+        )
+        for events in results[:-1]:
+            for f in events:
+                assert f["error"] is None
+                assert len(f["chips"]) == 32
+        for s in streams:
+            s.close()
+
+    _run(_with_client(_app(), go))

@@ -1,0 +1,135 @@
+"""Record/replay source tests: capture live scrapes, play them back."""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.normalize import to_wide
+from tpudash.sources import make_source
+from tpudash.sources.base import SourceError
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+from tpudash.sources.recorder import FileReplaySource, RecordingSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def test_record_then_replay_roundtrips_the_frame(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    rec = RecordingSource(FixtureSource(FIXTURE), path)
+    live = rec.fetch()
+    rec.fetch()  # second snapshot
+    with open(path) as f:
+        lines = f.readlines()
+    assert len(lines) == 2
+    assert "ts" in json.loads(lines[0])
+
+    replay = FileReplaySource(path)
+    assert len(replay) == 2
+    df_live = to_wide(live if isinstance(live, list) else live.to_samples())
+    df_replay = to_wide(replay.fetch())
+    pd.testing.assert_frame_equal(
+        df_live.sort_index(axis=1), df_replay.sort_index(axis=1),
+        check_dtype=False, atol=1e-9,
+    )
+
+
+def test_replay_loops_by_default(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    rec = RecordingSource(SyntheticSource(num_chips=4), path)
+    rec.fetch()
+    replay = FileReplaySource(path)
+    for _ in range(3):  # 1 snapshot, 3 fetches → loops
+        assert replay.fetch()
+
+
+def test_replay_no_loop_exhausts(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    RecordingSource(SyntheticSource(num_chips=4), path).fetch()
+    replay = FileReplaySource(path, loop=False)
+    replay.fetch()
+    with pytest.raises(SourceError, match="exhausted"):
+        replay.fetch()
+
+
+def test_replay_missing_and_malformed(tmp_path):
+    with pytest.raises(SourceError, match="cannot open"):
+        FileReplaySource(str(tmp_path / "nope.jsonl"))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ts": 1}\n')  # no "text"
+    # only offsets load eagerly; the malformed line surfaces at fetch
+    with pytest.raises(SourceError, match="malformed recording line 1"):
+        FileReplaySource(str(bad)).fetch()
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("\n\n")
+    with pytest.raises(SourceError, match="no snapshots"):
+        FileReplaySource(str(empty))
+
+
+def test_make_source_wiring(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    cfg = Config(source="fixture", fixture_path=FIXTURE, record_path=path)
+    src = make_source(cfg)
+    assert src.name == "fixture+record+retry"
+    src.fetch()
+    assert os.path.exists(path)
+
+    replay_cfg = Config(source="replay", replay_path=path)
+    rsrc = make_source(replay_cfg)
+    assert rsrc.name == "replay-file+retry"
+    svc = DashboardService(replay_cfg, rsrc)
+    frame = svc.render_frame()
+    assert frame["error"] is None
+    assert [c["key"] for c in frame["chips"]] == ["slice-0/0", "slice-0/1"]
+
+
+def test_failed_fetches_are_not_recorded(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+
+    class Boom(FixtureSource):
+        def fetch(self):
+            raise SourceError("down")
+
+    rec = RecordingSource(Boom(FIXTURE), path)  # validation creates the file
+    with pytest.raises(SourceError):
+        rec.fetch()
+    assert os.path.getsize(path) == 0  # ...but no snapshot was written
+
+
+def test_record_path_fails_fast_at_startup(tmp_path):
+    with pytest.raises(SourceError, match="cannot record"):
+        RecordingSource(
+            FixtureSource(FIXTURE), str(tmp_path / "no" / "dir" / "rec.jsonl")
+        )
+
+
+def test_record_write_failure_does_not_fail_the_fetch(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    rec = RecordingSource(FixtureSource(FIXTURE), path)
+    rec.path = str(tmp_path)  # a directory: appends now fail
+    samples = rec.fetch()  # scrape still succeeds, warning logged
+    assert samples
+
+
+def test_replay_source_is_never_wrapped_in_recorder(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    cfg = Config(source="fixture", fixture_path=FIXTURE, record_path=path)
+    make_source(cfg).fetch()
+    # same path for record + replay must not self-append
+    replay_cfg = Config(source="replay", replay_path=path, record_path=path)
+    rsrc = make_source(replay_cfg)
+    assert "+record" not in rsrc.name
+    size = os.path.getsize(path)
+    rsrc.fetch()
+    assert os.path.getsize(path) == size
+
+
+def test_env_knobs():
+    cfg = load_config(
+        {"TPUDASH_RECORD_PATH": "/tmp/r.jsonl", "TPUDASH_REPLAY_PATH": "/tmp/p.jsonl"}
+    )
+    assert cfg.record_path == "/tmp/r.jsonl"
+    assert cfg.replay_path == "/tmp/p.jsonl"

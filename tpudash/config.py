@@ -83,6 +83,13 @@ class Config:
     #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
     #: defaults; "off" disables alerting.
     alert_rules: str = ""
+    #: Append every successful scrape (any source) to this JSONL file for
+    #: later replay ("" disables).  Snapshots are exposition-text — the
+    #: exporter's own wire format.
+    record_path: str = ""
+    #: source="replay": play a recorded JSONL back through the normal
+    #: normalize→render path, looping.
+    replay_path: str = ""
     #: Seed the trend history from a Prometheus range query covering this
     #: many seconds at startup (0 disables; only sources with
     #: ``fetch_history`` participate).  Sparklines show a real trend on the
@@ -126,6 +133,8 @@ _ENV_MAP = {
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
+    "record_path": "TPUDASH_RECORD_PATH",
+    "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",

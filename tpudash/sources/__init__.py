@@ -17,6 +17,15 @@ def make_source(cfg) -> MetricsSource:
     ResilientSource (per-fetch retry/backoff + health tracking,
     sources/retry.py) unless Config.fetch_retries == 0."""
     src = _make_source(cfg)
+    record_path = getattr(cfg, "record_path", "")
+    if record_path and cfg.source != "replay":
+        # record inside the retry wrapper: only successful fetches land in
+        # the file, and retried attempts aren't double-recorded.  Never
+        # record a replay — with a stale TPUDASH_RECORD_PATH that would
+        # append the recording onto itself forever.
+        from tpudash.sources.recorder import RecordingSource
+
+        src = RecordingSource(src, record_path)
     retries = getattr(cfg, "fetch_retries", 0)
     if retries > 0:
         from tpudash.sources.retry import ResilientSource, RetryPolicy
@@ -54,6 +63,10 @@ def _make_source(cfg) -> MetricsSource:
         from tpudash.sources.multi import MultiSource
 
         return MultiSource(cfg)
+    if kind == "replay":
+        from tpudash.sources.recorder import FileReplaySource
+
+        return FileReplaySource(cfg.replay_path)
     if kind == "workload":
         from tpudash.sources.workload import WorkloadSource  # imports jax
 

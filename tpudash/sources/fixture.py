@@ -142,13 +142,19 @@ class JsonReplaySource(MetricsSource):
         self._i = 0
 
     @classmethod
-    def synthetic(cls, num_chips: int, generation: str = "v5e", frames: int = 8):
+    def synthetic(
+        cls,
+        num_chips: int,
+        generation: str = "v5e",
+        frames: int = 8,
+        num_slices: int = 1,
+    ):
         """Pre-serialize `frames` synthetic payloads at distinct times."""
         return cls(
             [
                 json.dumps(
                     synthetic_payload(num_chips=num_chips, generation=generation,
-                                      t=1000.0 + 5.0 * i)
+                                      t=1000.0 + 5.0 * i, num_slices=num_slices)
                 )
                 for i in range(frames)
             ]

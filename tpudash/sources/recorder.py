@@ -1,0 +1,127 @@
+"""Record/replay: capture live scrapes to disk, play them back later.
+
+Ops tooling the reference never had: debugging a production incident or
+demoing the dashboard should not require the cluster that produced the
+data.  ``TPUDASH_RECORD_PATH`` wraps ANY configured source and appends
+every successful fetch to a JSONL file; ``TPUDASH_SOURCE=replay`` +
+``TPUDASH_REPLAY_PATH`` plays a recording back through the identical
+normalize→render path (looping by default, so the page keeps refreshing).
+
+Snapshots are stored as Prometheus exposition text (exporter/textfmt) —
+the same wire format the exporter emits — so recordings are portable,
+diffable, and parse through the native frame kernel on replay exactly
+like a live scrape would.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from tpudash.schema import SampleBatch
+from tpudash.sources.base import MetricsSource, SourceError, parse_text_bytes
+
+log = logging.getLogger(__name__)
+
+
+class RecordingSource(MetricsSource):
+    """Transparent wrapper: fetch from the inner source, append the
+    snapshot to ``path``, return the samples unchanged.  Failed fetches
+    are not recorded (a replay reproduces the data, not the outages).
+
+    The path is validated at construction (fail fast on a bad
+    TPUDASH_RECORD_PATH); a write failure mid-run (disk full) degrades to
+    a logged warning — the scrape succeeded, the frame must still render."""
+
+    def __init__(self, inner: MetricsSource, path: str):
+        self.inner = inner
+        self.path = path
+        self.name = f"{inner.name}+record"
+        self._write_failed = False
+        try:
+            with open(path, "a", encoding="utf-8"):
+                pass
+        except OSError as e:
+            raise SourceError(f"cannot record to {path!r}: {e}") from e
+
+    def fetch(self):
+        samples = self.inner.fetch()
+        as_list = (
+            samples.to_samples()
+            if isinstance(samples, SampleBatch)
+            else samples
+        )
+        from tpudash.exporter.textfmt import encode_samples
+
+        rec = {"ts": time.time(), "text": encode_samples(as_list)}
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+            self._write_failed = False
+        except OSError as e:
+            if not self._write_failed:  # log streaks once, not per cycle
+                log.warning("recording write failed (frame unaffected): %s", e)
+            self._write_failed = True
+        return samples
+
+    def __getattr__(self, item):  # health/fetch_history etc. fall through
+        return getattr(self.inner, item)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class FileReplaySource(MetricsSource):
+    """Replay a RecordingSource JSONL, one snapshot per fetch.
+
+    Only byte offsets are kept resident (a day-long 256-chip recording is
+    gigabytes of exposition text — ~200 KB per snapshot); each fetch seeks
+    and parses ONE line, so memory stays O(1) in recording length."""
+
+    name = "replay-file"
+
+    def __init__(self, path: str, loop: bool = True):
+        if not path:
+            raise SourceError("replay source requires TPUDASH_REPLAY_PATH")
+        self.path = path
+        offsets = []
+        try:
+            with open(path, "rb") as f:
+                pos = 0
+                for line in f:
+                    if line.strip():
+                        offsets.append(pos)
+                    pos += len(line)
+        except OSError as e:
+            raise SourceError(f"cannot open recording {path!r}: {e}") from e
+        if not offsets:
+            raise SourceError(f"recording {path!r} holds no snapshots")
+        self.offsets = offsets
+        self.loop = loop
+        self._i = 0
+
+    def __len__(self) -> int:
+        return len(self.offsets)
+
+    def fetch(self):
+        if self._i >= len(self.offsets):
+            if not self.loop:
+                raise SourceError("recording exhausted")
+            self._i = 0
+        idx = self._i
+        self._i += 1
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offsets[idx])
+                line = f.readline()
+        except OSError as e:
+            raise SourceError(f"cannot read recording {self.path!r}: {e}") from e
+        try:
+            rec = json.loads(line)
+            text = rec["text"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            raise SourceError(
+                f"malformed recording line {idx + 1} in {self.path!r}: {e}"
+            ) from e
+        return parse_text_bytes(text)
