@@ -170,6 +170,92 @@ def test_export_csv_refuses_stale_data_during_outage():
     _run(_with_client(_client_app(source=src), go))
 
 
+def test_profile_frames_mode():
+    async def go(client):
+        resp = await client.post("/api/profile", json={"frames": 3})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["mode"] == "frames"
+        assert body["frames"] == 3
+        assert body["top"], "profile must name hot functions"
+        entry = body["top"][0]
+        assert {"function", "calls", "tottime_ms", "cumtime_ms"} <= set(entry)
+        # render_frame itself must appear among the hottest entries
+        assert any("render_frame" in e["function"] for e in body["top"])
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_profile_clamps_frames_and_rejects_garbage():
+    async def go(client):
+        resp = await client.post("/api/profile", json={"frames": 10_000})
+        assert (await resp.json())["requested"] == 100
+        assert (await client.post("/api/profile", json={"frames": "abc"})).status == 400
+        assert (
+            await client.post("/api/profile", json={"device": True, "seconds": "x"})
+        ).status == 400
+
+    _run(_with_client(_client_app(), go))
+
+
+def test_profile_does_not_advance_alert_hysteresis():
+    # a rule needing 1000 consecutive breaches must not fire because an
+    # operator profiled 50 frames during a breach window
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        alert_rules="tpu_temperature_celsius>0:warning@1000",
+    )
+
+    service = DashboardService(cfg, FixtureSource(FIXTURE))
+    app = DashboardServer(service).build_app()
+
+    async def go(client):
+        await client.get("/api/frame")  # streak = 1
+        streak_before = {
+            k: t.streak for k, t in service.alert_engine._tracks.items()
+        }
+        assert streak_before  # temp>0 matched every chip
+        await client.post("/api/profile", json={"frames": 50})
+        streak_after = {
+            k: t.streak for k, t in service.alert_engine._tracks.items()
+        }
+        assert streak_after == streak_before
+
+    _run(_with_client(app, go))
+
+
+def test_auth_token_gates_everything_but_healthz():
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        auth_token="s3cret",
+    )
+
+    async def go(client):
+        # no token → 401 on page and API
+        assert (await client.get("/")).status == 401
+        assert (await client.get("/api/frame")).status == 401
+        assert (await client.post("/api/select", json={"all": True})).status == 401
+        # healthz stays open for k8s probes
+        assert (await client.get("/healthz")).status == 200
+        # bearer header works
+        ok = await client.get(
+            "/api/frame", headers={"Authorization": "Bearer s3cret"}
+        )
+        assert ok.status == 200
+        # query param works (EventSource transport)
+        assert (await client.get("/api/stream?token=s3cret")).status == 200
+        assert (await client.get("/api/frame?token=wrong")).status == 401
+
+    _run(_with_client(_client_app(cfg), go))
+
+
+def test_no_auth_token_leaves_routes_open():
+    async def go(client):
+        assert (await client.get("/api/frame")).status == 200
+
+    _run(_with_client(_client_app(), go))
+
+
 def test_healthz_and_timings():
     async def go(client):
         health = await (await client.get("/healthz")).json()

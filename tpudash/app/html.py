@@ -147,9 +147,18 @@ function renderFigure(el, fig) {
 }
 
 // ---- state + API ----------------------------------------------------------
+// auth: when the server runs with TPUDASH_AUTH_TOKEN, the operator opens
+// the page as /?token=...; forward it on every call (EventSource cannot
+// set an Authorization header, so the query param is the transport)
+const TOKEN = new URLSearchParams(location.search).get('token');
+function api(url) {
+  if (!TOKEN) return url;
+  return url + (url.includes('?') ? '&' : '?') + 'token=' + encodeURIComponent(TOKEN);
+}
+
 async function post(url, body) {
-  await fetch(url, {method: 'POST', headers: {'Content-Type': 'application/json'},
-                    body: JSON.stringify(body)});
+  await fetch(api(url), {method: 'POST', headers: {'Content-Type': 'application/json'},
+                         body: JSON.stringify(body)});
   await refresh();
 }
 
@@ -199,7 +208,7 @@ function renderStats(stats) {
 async function refresh() {
   let frame;
   try {
-    frame = await (await fetch('/api/frame')).json();
+    frame = await (await fetch(api('/api/frame'))).json();
   } catch (e) {
     showError('Dashboard server unreachable: ' + e);
     if (!streaming && !timer) timer = setInterval(refresh, 5000);  // keep retrying
@@ -237,7 +246,7 @@ function applyFrame(frame) {
 // ---- transport: SSE push with polling fallback ----------------------------
 function startStream() {
   if (!window.EventSource) return;  // old browser → polling stays active
-  const es = new EventSource('/api/stream');
+  const es = new EventSource(api('/api/stream'));
   es.onmessage = e => {
     streaming = true;
     if (timer) { clearInterval(timer); timer = null; }

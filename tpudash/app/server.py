@@ -23,7 +23,9 @@ Routes:
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
+import tempfile
 import time
 
 from aiohttp import web
@@ -41,6 +43,7 @@ class DashboardServer:
         self._cached_frame: dict | None = None
         self._cached_at: float = 0.0
         self._cached_sse: bytes | None = None  # serialized once per frame
+        self._device_trace_active = False  # jax profiler is a singleton
 
     # -- frame caching -------------------------------------------------------
     async def _get_frame(self, force: bool = False) -> dict:
@@ -181,6 +184,120 @@ class DashboardServer:
     async def timings(self, request: web.Request) -> web.Response:
         return web.json_response(self.service.timer.summary())
 
+    async def profile(self, request: web.Request) -> web.Response:
+        """On-demand profiling (tracing, SURVEY.md §5 — the reference has
+        none).  Two modes:
+
+        - ``{"frames": N}`` (default 10, ≤100): cProfile N frame renders
+          through the live service and return the hottest functions by
+          cumulative time — works with every source;
+        - ``{"device": true, "seconds": S}`` (≤30): capture a JAX device
+          trace (TPU: XLA ops, ICI transfers; CPU: host trace) while the
+          in-process probe/workload source keeps running; returns the
+          trace directory for ``tensorboard --logdir`` / xprof.
+        """
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+
+        if body.get("device"):
+            try:
+                seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
+            except (TypeError, ValueError):
+                raise web.HTTPBadRequest(text="'seconds' must be a number")
+            try:
+                import jax  # the probe/workload sources already paid this
+            except ImportError as e:
+                raise web.HTTPBadRequest(text=f"jax unavailable: {e}")
+            if self._device_trace_active:
+                raise web.HTTPConflict(text="a device trace is already running")
+            self._device_trace_active = True
+            trace_dir = tempfile.mkdtemp(prefix="tpudash-trace-")
+
+            def capture():
+                with jax.profiler.trace(trace_dir):
+                    # trace whatever the in-process source keeps the chip
+                    # doing (workload steps / probes) for the window
+                    time.sleep(seconds)
+
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(None, capture)
+            except Exception as e:  # noqa: BLE001 — profiler errors → clean 500
+                import shutil
+
+                shutil.rmtree(trace_dir, ignore_errors=True)
+                raise web.HTTPInternalServerError(
+                    text=f"device trace failed: {e}"
+                )
+            finally:
+                self._device_trace_active = False
+            return web.json_response(
+                {"mode": "device", "seconds": seconds, "trace_dir": trace_dir}
+            )
+
+        try:
+            frames = min(100, max(1, int(body.get("frames", 10))))
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="'frames' must be an integer")
+
+        def run_profile():
+            import cProfile
+            import copy
+            import pstats
+
+            # profiling frames are synthetic load, not monitoring cycles:
+            # snapshot alert hysteresis state so N profiled renders don't
+            # advance for-cycles streaks N intervals in under a second
+            engine = self.service.alert_engine
+            saved_tracks = (
+                copy.deepcopy(engine._tracks) if engine is not None else None
+            )
+            deadline = time.monotonic() + 10.0  # bound lock-hold wall time
+            done = 0
+            prof = cProfile.Profile()
+            prof.enable()
+            try:
+                for _ in range(frames):
+                    self.service.render_frame()
+                    done += 1
+                    if time.monotonic() >= deadline:
+                        break
+            finally:
+                prof.disable()
+                if engine is not None:
+                    engine._tracks = saved_tracks
+            stats = pstats.Stats(prof)
+            top = []
+            for func, (cc, nc, tt, ct, _callers) in stats.stats.items():
+                filename, lineno, name = func
+                top.append(
+                    {
+                        "function": f"{filename}:{lineno}({name})",
+                        "calls": nc,
+                        "tottime_ms": round(tt * 1e3, 3),
+                        "cumtime_ms": round(ct * 1e3, 3),
+                    }
+                )
+            top.sort(key=lambda e: -e["cumtime_ms"])
+            return done, top[:40]
+
+        async with self._lock:  # serialize against normal frame builds
+            loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
+            done, top = await loop.run_in_executor(None, run_profile)
+            wall = time.monotonic() - t0
+        return web.json_response(
+            {
+                "mode": "frames",
+                "frames": done,
+                "requested": frames,
+                "wall_ms": round(wall * 1e3, 2),
+                "top": top,
+            }
+        )
+
     async def history(self, request: web.Request) -> web.Response:
         """Raw rolling history of selected-average values per metric."""
         async with self._lock:  # render_frame appends from the worker thread
@@ -203,8 +320,27 @@ class DashboardServer:
              "source_health": health}
         )
 
+    @web.middleware
+    async def _auth(self, request: web.Request, handler):
+        """Bearer/query-token gate (Config.auth_token).  /healthz stays
+        open so Kubernetes probes don't need the secret."""
+        token = self.service.cfg.auth_token
+        if not token or request.path == "/healthz":
+            return await handler(request)
+        header = request.headers.get("Authorization", "")
+        supplied = header[7:] if header.startswith("Bearer ") else None
+        if supplied is None:
+            supplied = request.query.get("token")
+        # compare as bytes: str compare_digest raises on non-ASCII input,
+        # which would turn a bad token into a 500 instead of a 401
+        if not supplied or not hmac.compare_digest(
+            supplied.encode(), token.encode()
+        ):
+            raise web.HTTPUnauthorized(text="missing or invalid token")
+        return await handler(request)
+
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._auth])
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/api/stream", self.stream)
@@ -212,6 +348,7 @@ class DashboardServer:
         app.router.add_post("/api/select", self.select)
         app.router.add_post("/api/style", self.style)
         app.router.add_get("/api/timings", self.timings)
+        app.router.add_post("/api/profile", self.profile)
         app.router.add_get("/api/history", self.history)
         app.router.add_get("/api/alerts", self.alerts)
         app.router.add_get("/healthz", self.healthz)

@@ -68,6 +68,11 @@ class Config:
     #: Dashboard server bind.
     host: str = "0.0.0.0"
     port: int = 8050
+    #: Shared-secret auth for every route except /healthz ("" = open, the
+    #: reference's posture).  Clients send ``Authorization: Bearer <token>``
+    #: or ``?token=`` (the page forwards its URL token automatically —
+    #: EventSource cannot set headers).
+    auth_token: str = ""
     #: Node-exporter bind port (python -m tpudash.exporter).
     exporter_port: int = 9100
     #: /metrics URL for source="scrape" (direct exporter consumption,
@@ -128,6 +133,7 @@ _ENV_MAP = {
     "series_selector": "TPUDASH_SERIES_SELECTOR",
     "host": "TPUDASH_HOST",
     "port": "TPUDASH_PORT",
+    "auth_token": "TPUDASH_AUTH_TOKEN",
     "exporter_port": "TPUDASH_EXPORTER_PORT",
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
