@@ -45,6 +45,24 @@ def test_derived_ici_gbps():
     assert df.loc["slice-0/0", schema.ICI_TOTAL_GBPS] == pytest.approx(40.0)
 
 
+def test_derived_overwrites_source_series_of_same_name():
+    # an exporter that exports its OWN hbm_usage_ratio gauge must not
+    # produce duplicate column labels — the derived value wins (the
+    # pre-concat in-place assignment semantics)
+    from tpudash.schema import ChipKey, Sample
+
+    chip = ChipKey(slice_id="s", host="h", chip_id=0)
+    samples = [
+        Sample(metric=schema.HBM_USED, value=2.0 * 1024**3, chip=chip),
+        Sample(metric=schema.HBM_TOTAL, value=4.0 * 1024**3, chip=chip),
+        Sample(metric=schema.HBM_USAGE_RATIO, value=99.0, chip=chip),  # clash
+    ]
+    df = to_wide(samples)
+    assert list(df.columns).count(schema.HBM_USAGE_RATIO) == 1
+    assert df.loc["s/0", schema.HBM_USAGE_RATIO] == pytest.approx(50.0)
+    assert column_average(df, schema.HBM_USAGE_RATIO) == pytest.approx(50.0)
+
+
 def test_empty_samples_raise():
     with pytest.raises(NormalizeError):
         to_wide([])

@@ -215,11 +215,16 @@ def test_profile_does_not_advance_alert_hysteresis():
             k: t.streak for k, t in service.alert_engine._tracks.items()
         }
         assert streak_before  # temp>0 matched every chip
+        alerts_before = service.last_alerts
         await client.post("/api/profile", json={"frames": 50})
         streak_after = {
             k: t.streak for k, t in service.alert_engine._tracks.items()
         }
         assert streak_after == streak_before
+        # /api/alerts must not see the synthetic renders' inflated streaks
+        assert service.last_alerts is alerts_before
+        body = await (await client.get("/api/alerts")).json()
+        assert all(a["streak"] <= 1 for a in body["alerts"])
 
     _run(_with_client(app, go))
 

@@ -24,6 +24,7 @@ import re
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
 import pandas as pd
 
 _OPS = {
@@ -138,16 +139,25 @@ class AlertEngine:
             if rule.column not in df.columns:
                 continue
             series = pd.to_numeric(df[rule.column], errors="coerce")
-            for chip_key, value in series.items():
-                if pd.isna(value):
-                    continue
+            # vectorized breach test: on a healthy fleet no chip breaches,
+            # so the per-chip Python loop below runs zero times instead of
+            # chips×rules times (profiled ~10% of a 256-chip frame).
+            # Non-breaching chips never enter `seen`, so their stale
+            # tracks fall to the implicit-resolution sweep — the same
+            # delete the explicit else-branch used to do.
+            values = series.to_numpy(dtype=float, na_value=np.nan)
+            with np.errstate(invalid="ignore"):
+                mask = _OPS[rule.op](values, rule.threshold)
+            mask &= ~np.isnan(values)
+            if not mask.any():
+                continue
+            keys = series.index
+            for i in np.nonzero(mask)[0]:
+                chip_key = keys[i]
+                value = values[i]
                 tkey = (rule.name, chip_key)
                 seen.add(tkey)
                 track = self._tracks.get(tkey)
-                if not rule.breaches(float(value)):
-                    if track is not None:
-                        del self._tracks[tkey]
-                    continue
                 if track is None:
                     track = self._tracks[tkey] = _Track()
                 track.streak += 1

@@ -254,6 +254,7 @@ class DashboardServer:
             saved_tracks = (
                 copy.deepcopy(engine._tracks) if engine is not None else None
             )
+            saved_alerts = self.service.last_alerts
             deadline = time.monotonic() + 10.0  # bound lock-hold wall time
             done = 0
             prof = cProfile.Profile()
@@ -268,6 +269,9 @@ class DashboardServer:
                 prof.disable()
                 if engine is not None:
                     engine._tracks = saved_tracks
+                    # /api/alerts must not serve the synthetic renders'
+                    # inflated streaks until the next real frame
+                    self.service.last_alerts = saved_alerts
             stats = pstats.Stats(prof)
             top = []
             for func, (cc, nc, tt, ct, _callers) in stats.stats.items():
