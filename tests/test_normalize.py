@@ -63,6 +63,38 @@ def test_derived_overwrites_source_series_of_same_name():
     assert column_average(df, schema.HBM_USAGE_RATIO) == pytest.approx(50.0)
 
 
+def test_batch_path_matches_reference_construction():
+    # the numpy batch fast path must stay frame-identical to the
+    # "identity inserts + _derive" construction the dict pivot uses — a
+    # derivation added to one path and not the other must fail here
+    import pandas as pd
+
+    from tpudash.normalize import _batch_to_wide, _derive
+    from tpudash.schema import SampleBatch
+    from tpudash.sources.fixture import synthetic_payload
+    from tpudash.sources.base import parse_instant_query
+    import numpy as np
+
+    for kwargs in (
+        {"num_chips": 8},
+        {"num_chips": 8, "num_slices": 2},            # DCN series on
+        {"num_chips": 4, "idle_chips": (1,)},         # zeros present
+    ):
+        samples = parse_instant_query(synthetic_payload(t=42.0, **kwargs))
+        b = SampleBatch.from_samples(samples)
+        got = _batch_to_wide(b)
+
+        ref = pd.DataFrame(
+            b.matrix, index=pd.Index(b.keys, name="chip"), columns=b.metrics
+        )
+        ref.insert(0, schema.ACCEL_TYPE, b.accels)
+        ref.insert(0, "chip_id", b.chip_ids.astype(np.int64))
+        ref.insert(0, "host", b.hosts)
+        ref.insert(0, "slice_id", b.slices)
+        ref = _derive(ref)
+        pd.testing.assert_frame_equal(got, ref), kwargs
+
+
 def test_empty_samples_raise():
     with pytest.raises(NormalizeError):
         to_wide([])

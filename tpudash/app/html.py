@@ -64,6 +64,7 @@ PAGE = r"""<!DOCTYPE html>
     <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
     <button id="select-all">Select all</button>
     <button id="select-none">Clear</button>
+    <a id="csv-link" href="/api/export.csv" download="tpudash.csv">Export CSV</a>
     <span id="chip-count"></span>
   </div>
   <div id="chip-grid"></div>
@@ -266,6 +267,7 @@ function startStream() {
 
 document.getElementById('use-gauge').addEventListener('change',
   e => post('/api/style', {use_gauge: e.target.checked}));
+document.getElementById('csv-link').href = api('/api/export.csv');
 document.getElementById('select-all').addEventListener('click',
   () => post('/api/select', {all: true}));
 document.getElementById('select-none').addEventListener('click',

@@ -69,18 +69,67 @@ def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
 
     Rows arrive sorted by (slice_id, chip_id) and the metric block is one
     contiguous float64 matrix, so this is a constant number of numpy-level
-    ops regardless of chip count."""
+    ops regardless of chip count: derived columns are computed straight
+    from matrix slices and the frame is assembled with ONE concat (four
+    identity inserts + per-column derivation profiled as ~20% of the
+    256-chip frame)."""
     if len(b) == 0:
         raise NormalizeError("no samples to normalize")
-    df = pd.DataFrame(
-        b.matrix, index=pd.Index(b.keys, name="chip"), columns=b.metrics
+    metrics = list(b.metrics)
+    mat = b.matrix
+    col_idx = {m: i for i, m in enumerate(metrics)}
+
+    def col(name, default=None):
+        i = col_idx.get(name)
+        if i is None:
+            return default
+        return mat[:, i]
+
+    # same formulas (and NaN semantics) as _derive, in plain numpy
+    derived: dict = {}
+    with np.errstate(invalid="ignore", divide="ignore"):
+        used, total = col(schema.HBM_USED), col(schema.HBM_TOTAL)
+        if used is not None and total is not None:
+            safe_total = np.where(total > 0, total, np.nan)
+            derived[schema.HBM_USAGE_RATIO] = used / safe_total * 100.0
+            derived[schema.HBM_USED_GIB] = used / 1024**3
+        tx, rx = col(schema.ICI_TX), col(schema.ICI_RX)
+        if tx is not None or rx is not None:
+            derived[schema.ICI_TOTAL_GBPS] = (
+                (tx if tx is not None else 0.0)
+                + (rx if rx is not None else 0.0)
+            ) / 1e9
+        tx, rx = col(schema.DCN_TX), col(schema.DCN_RX)
+        if tx is not None or rx is not None:
+            derived[schema.DCN_TOTAL_GBPS] = (
+                (tx if tx is not None else 0.0)
+                + (rx if rx is not None else 0.0)
+            ) / 1e9
+
+    # derived overwrite same-named source series (see _derive)
+    kept = [m for m in metrics if m not in derived]
+    kept_mat = mat[:, [col_idx[m] for m in kept]] if len(kept) < len(metrics) else mat
+    if derived:
+        data = np.concatenate(
+            [kept_mat, np.column_stack(list(derived.values()))], axis=1
+        )
+    else:
+        data = kept_mat
+    index = pd.Index(b.keys, name="chip")
+    metric_df = pd.DataFrame(
+        data, index=index, columns=kept + list(derived.keys())
     )
-    # identity columns in the same order the dict pivot produces
-    df.insert(0, schema.ACCEL_TYPE, b.accels)
-    df.insert(0, "chip_id", b.chip_ids.astype(np.int64))
-    df.insert(0, "host", b.hosts)
-    df.insert(0, "slice_id", b.slices)
-    return _derive(df)
+    # identity columns first, same order the dict pivot produces
+    ident = pd.DataFrame(
+        {
+            "slice_id": b.slices,
+            "host": b.hosts,
+            "chip_id": b.chip_ids.astype(np.int64),
+            schema.ACCEL_TYPE: b.accels,
+        },
+        index=index,
+    )
+    return pd.concat([ident, metric_df], axis=1)
 
 
 def _derive(df: pd.DataFrame) -> pd.DataFrame:
