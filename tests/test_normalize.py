@@ -92,7 +92,7 @@ def test_batch_path_matches_reference_construction():
         ref.insert(0, "host", b.hosts)
         ref.insert(0, "slice_id", b.slices)
         ref = _derive(ref)
-        pd.testing.assert_frame_equal(got, ref), kwargs
+        pd.testing.assert_frame_equal(got, ref, obj=f"case {kwargs}")
 
 
 def test_empty_samples_raise():

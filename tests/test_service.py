@@ -118,6 +118,23 @@ def test_heatmap_survives_bogus_chip_ids():
     assert len(z) == 8 and len(z[0]) == 8
 
 
+def test_heatmap_cells_carry_selection_keys():
+    # customdata mirrors the z grid with chip selection keys so the page
+    # can toggle a chip by clicking its torus cell — keys cover the FULL
+    # slice (not just the selection) so deselected chips are clickable
+    # back on
+    svc = _svc(SyntheticSource(num_chips=64), per_chip_panel_limit=16)
+    svc.state.select_all([f"slice-0/{i}" for i in range(64)])
+    svc.state.toggle("slice-0/7", [f"slice-0/{i}" for i in range(64)])
+    frame = svc.render_frame()
+    assert len(frame["selected"]) == 63  # chip 7 deselected
+    trace = frame["heatmaps"][0]["figure"]["data"][0]
+    cd = trace["customdata"]
+    assert len(cd) == len(trace["z"]) and len(cd[0]) == len(trace["z"][0])
+    keys = {k for row in cd for k in row if k}
+    assert keys == {f"slice-0/{i}" for i in range(64)}  # incl. chip 7
+
+
 def test_heatmap_partial_selection_keeps_full_slice_topology():
     # 17 of 64 chips selected → still an 8×8 torus, not a 1×17 strip
     svc = _svc(SyntheticSource(num_chips=64), per_chip_panel_limit=16)

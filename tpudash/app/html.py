@@ -102,16 +102,29 @@ function colorFromScale(scale, frac) {
 }
 
 function renderHeatFallback(el, trace, layoutTitle) {
-  const z = trace.z, zmax = trace.zmax || 100;
+  const z = trace.z, zmax = trace.zmax || 100, cd = trace.customdata;
   const cols = z.length ? z[0].length : 0;
   let cells = '';
-  for (const row of z) for (const v of row) {
-    if (v === null || v === undefined) { cells += '<div style="background:transparent"></div>'; continue; }
+  for (let y = 0; y < z.length; y++) for (let x = 0; x < z[y].length; x++) {
+    const v = z[y][x];
+    const key = cd && cd[y] && cd[y][x];
+    if (v === null || v === undefined) {
+      // deselected chips keep their key so a click re-selects them
+      cells += key
+        ? `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(key)}" title="deselected"></div>`
+        : '<div style="background:transparent"></div>';
+      continue;
+    }
     const col = colorFromScale(trace.colorscale, Math.min(1, Math.max(0, v / zmax)));
-    cells += `<div style="background:${col}" title="${(+v).toFixed(1)}"></div>`;
+    cells += `<div style="background:${col};cursor:pointer" title="${(+v).toFixed(1)}"` +
+             (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
   }
   el.innerHTML = `<div class="fig-title">${esc(layoutTitle)}</div>
     <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
+  el.querySelector('.heat').addEventListener('click', e => {
+    const key = e.target.getAttribute && e.target.getAttribute('data-key');
+    if (key) post('/api/select', {toggle: key});
+  });
 }
 
 function renderLineFallback(el, trace, fig, title) {
@@ -132,7 +145,18 @@ function renderLineFallback(el, trace, fig, title) {
 }
 
 function renderFigure(el, fig) {
-  if (usePlotly()) { Plotly.react(el, fig.data, fig.layout, {displayModeBar: false}); return; }
+  if (usePlotly()) {
+    Plotly.react(el, fig.data, fig.layout, {displayModeBar: false});
+    const tr = fig.data[0];
+    if (tr.type === 'heatmap' && tr.customdata && !el._heatClick) {
+      el._heatClick = true;  // panel divs are rebuilt per frame
+      el.on('plotly_click', ev => {
+        const key = ev.points && ev.points[0] && ev.points[0].customdata;
+        if (key) post('/api/select', {toggle: key});
+      });
+    }
+    return;
+  }
   const t = fig.data[0];
   const title = (t.title && t.title.text) || (fig.layout.title && fig.layout.title.text) || '';
   if (t.type === 'indicator') {

@@ -46,7 +46,11 @@ from tpudash.sources.base import MetricsSource
 from tpudash.topology import topology_for
 from tpudash.utils.timing import StageTimer
 from tpudash.viz.dispatch import accel_types_for, create_visualization, panel_max
-from tpudash.viz.figures import create_sparkline, create_topology_heatmap
+from tpudash.viz.figures import (
+    create_sparkline,
+    create_topology_heatmap,
+    key_grid,
+)
 
 
 @functools.lru_cache(maxsize=256)
@@ -214,6 +218,19 @@ class DashboardService:
             topo = topology_for(generation, n)
             chip_ids = sdf["chip_id"].to_numpy()
             in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
+            # clickable cells: keys come from the FULL slice population so
+            # a deselected chip can be clicked back on (symmetric toggle),
+            # built once per slice and shared by every panel's figure
+            all_rows = df[df["slice_id"] == slice_id]
+            all_ids = all_rows["chip_id"].to_numpy()
+            ok = (all_ids >= 0) & (all_ids < topo.num_chips)
+            custom_grid = key_grid(
+                topo,
+                {
+                    int(cid): key
+                    for cid, key in zip(all_ids[ok], all_rows.index[ok])
+                },
+            )
             for spec in panels:
                 if spec.column not in sdf.columns:
                     continue
@@ -239,6 +256,7 @@ class DashboardService:
                             title=f"{slice_id} — {spec.title}",
                             max_val=panel_max(spec, accels),
                             unit=spec.unit,
+                            custom_grid=custom_grid,
                         ),
                     }
                 )

@@ -167,6 +167,19 @@ def create_sparkline(
     }
 
 
+def key_grid(topo: Topology, cell_keys: "dict[int, str]") -> list:
+    """chip id → selection key, projected onto the torus grid (the
+    customdata for clickable heatmap cells).  Build ONCE per slice and
+    share across that slice's panel figures."""
+    ny, nx, cells = grid_layout(topo)
+    grid = [[None] * nx for _ in range(ny)]
+    for cid, key in cell_keys.items():
+        if 0 <= cid < len(cells):
+            y, col = cells[cid]
+            grid[y][col] = key
+    return grid
+
+
 def create_topology_heatmap(
     topo: Topology,
     values: dict[int, float],
@@ -174,12 +187,16 @@ def create_topology_heatmap(
     max_val: float = 100.0,
     height: int = 480,
     unit: str = "",
+    custom_grid: "list | None" = None,
 ) -> dict:
     """Per-chip values on the slice's torus as one figure.
 
     One heatmap replaces N gauges: a v5e-256 slice is a single 16×16 grid
     (3D toruses unroll into Z-planes side by side).  Cell (x, y) is chip
     (x, y) in torus coordinates; hover text carries chip id and value.
+    ``custom_grid`` (built once per slice via :func:`key_grid`) rides
+    along as customdata so the page can toggle a chip's selection by
+    clicking its cell — including cells of currently-deselected chips.
     """
     grid = heatmap_grid(topo, values)
     ny, nx, cells = grid_layout(topo)
@@ -190,21 +207,23 @@ def create_topology_heatmap(
         y, col = cells[cid]
         hover[y][col] = f"{prefixes[cid]}{v:.1f}{unit}"
 
+    trace = {
+        "type": "heatmap",
+        "z": grid,
+        "zmin": 0,
+        "zmax": max_val,
+        "text": hover,
+        "hoverinfo": "text",
+        "colorscale": _HEAT_COLORSCALE,
+        "xgap": 2,
+        "ygap": 2,
+        "colorbar": {"title": {"text": unit}, "thickness": 12},
+    }
+    if custom_grid is not None:
+        trace["customdata"] = custom_grid
+
     return {
-        "data": [
-            {
-                "type": "heatmap",
-                "z": grid,
-                "zmin": 0,
-                "zmax": max_val,
-                "text": hover,
-                "hoverinfo": "text",
-                "colorscale": _HEAT_COLORSCALE,
-                "xgap": 2,
-                "ygap": 2,
-                "colorbar": {"title": {"text": unit}, "thickness": 12},
-            }
-        ],
+        "data": [trace],
         "layout": {
             "title": {"text": title, "font": {"size": 16}},
             "height": height,
