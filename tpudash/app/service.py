@@ -210,9 +210,10 @@ class DashboardService:
             # out near 9k chips) are excluded from sizing AND rendering:
             # per-series tolerance (sources/base.py), a corrupt series
             # drops its cell, it must not size a 2e9-cell grid or raise.
-            slice_ids = df.loc[df["slice_id"] == slice_id, "chip_id"]
-            sane = slice_ids[(slice_ids >= 0) & (slice_ids < 16384)]
-            if sane.empty:
+            all_rows = df[df["slice_id"] == slice_id]  # full slice, once
+            all_ids = all_rows["chip_id"].to_numpy()
+            sane = all_ids[(all_ids >= 0) & (all_ids < 16384)]
+            if sane.size == 0:
                 continue
             n = int(sane.max()) + 1
             topo = topology_for(generation, n)
@@ -221,8 +222,6 @@ class DashboardService:
             # clickable cells: keys come from the FULL slice population so
             # a deselected chip can be clicked back on (symmetric toggle),
             # built once per slice and shared by every panel's figure
-            all_rows = df[df["slice_id"] == slice_id]
-            all_ids = all_rows["chip_id"].to_numpy()
             ok = (all_ids >= 0) & (all_ids < topo.num_chips)
             custom_grid = key_grid(
                 topo,

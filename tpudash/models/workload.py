@@ -100,6 +100,24 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x32 * rms * scale).astype(jnp.bfloat16)
 
 
+def _sdpa(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal scaled-dot-product attention on head-major (B, H, T, hd)
+    tensors — the core shared by the fused-qkv serial path and the
+    tp-sharded 3D pipeline (models/pipeline.py), so the mask/dtype points
+    cannot diverge between them."""
+    T, hd = q.shape[2], q.shape[3]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    rows = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    scores = jnp.where(cols <= rows, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.bfloat16
+    )
+
+
 def _attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array, cfg: WorkloadConfig) -> jax.Array:
     B, T, d = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
@@ -108,14 +126,7 @@ def _attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array, cfg: WorkloadConfig
     q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * (hd**-0.5)
-    rows = lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    cols = lax.broadcasted_iota(jnp.int32, (T, T), 1)
-    scores = jnp.where(cols <= rows, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.bfloat16)
+    out = _sdpa(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, d)
     return jnp.einsum("btd,de->bte", out, wo, preferred_element_type=jnp.bfloat16)
 
