@@ -118,6 +118,37 @@ def test_heatmap_survives_bogus_chip_ids():
     assert len(z) == 8 and len(z[0]) == 8
 
 
+def test_breakdown_by_slice_and_host():
+    # 2 slices × 32 chips, 4 chips/host; chips 0-3 (= the first host of
+    # each slice) idle at 0 W → both breakdown dimensions + the
+    # zero-exclusion policy per group
+    svc = _svc(SyntheticSource(num_chips=32, num_slices=2, idle_chips=(0, 1, 2, 3)))
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = svc.render_frame()
+    bd = frame["breakdown"]
+    assert set(bd["by_slice"]) == {"slice-0", "slice-1"}
+    assert bd["by_slice"]["slice-0"]["chips"] == 32
+    assert schema.TENSORCORE_UTIL in bd["by_slice"]["slice-0"]
+    assert len(bd["by_host"]) == 16  # 8 hosts per slice
+    # zero-exclusion per group: an all-idle host has NO eligible power
+    # values (column dropped), other hosts keep a positive mean, and the
+    # slice mean excludes the zeros entirely
+    idle_host = bd["by_host"]["host-0-0"]
+    assert schema.POWER not in idle_host
+    assert idle_host["chips"] == 4
+    busy_host = bd["by_host"]["host-0-1"]
+    assert busy_host[schema.POWER] > 0
+    assert bd["by_slice"]["slice-0"][schema.POWER] > 0
+
+
+def test_breakdown_absent_for_single_slice_single_host():
+    svc = _svc()  # 2-chip fixture, one slice, one host
+    svc.state.set_selected(["slice-0/0", "slice-0/1"], ["slice-0/0", "slice-0/1"])
+    frame = svc.render_frame()
+    assert frame["breakdown"] == {}
+
+
 def test_heatmap_cells_carry_selection_keys():
     # customdata mirrors the z grid with chip selection keys so the page
     # can toggle a chip by clicking its torus cell — keys cover the FULL

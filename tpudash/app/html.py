@@ -71,6 +71,7 @@ PAGE = r"""<!DOCTYPE html>
   <div id="panels"></div>
   <div class="row-title">Statistics (selected chips)</div>
   <div id="stats"></div>
+  <div id="breakdown"></div>
   <div id="debug"></div>
 </div>
 <script>
@@ -218,6 +219,31 @@ function panelRow(container, rowTitle, figures) {
   container.appendChild(row);
 }
 
+function renderBreakdown(bd, panelSpecs) {
+  const el = document.getElementById('breakdown');
+  if (!bd || !Object.keys(bd).length) { el.innerHTML = ''; return; }
+  const titles = {by_slice: 'Per-slice averages', by_host: 'Per-host averages'};
+  let html = '';
+  for (const dim of Object.keys(bd)) {
+    const rows = bd[dim];
+    const keys = Object.keys(rows);
+    const cols = (panelSpecs || []).filter(p => keys.some(k => p.column in rows[k]));
+    html += `<div class="row-title">${esc(titles[dim] || dim)}</div><table><tr><th>${dim === 'by_host' ? 'host' : 'slice'}</th><th>chips</th>`;
+    for (const p of cols) html += `<th>${esc(p.title)}</th>`;
+    html += '</tr>';
+    for (const k of keys) {
+      html += `<tr><td>${esc(k)}</td><td>${+rows[k].chips}</td>`;
+      for (const p of cols) {
+        const v = rows[k][p.column];
+        html += `<td>${v === undefined ? '—' : +v}</td>`;
+      }
+      html += '</tr>';
+    }
+    html += '</table>';
+  }
+  el.innerHTML = html;
+}
+
 function renderStats(stats) {
   const el = document.getElementById('stats');
   const metrics = Object.keys(stats);
@@ -260,6 +286,7 @@ function applyFrame(frame) {
   const heat = frame.heatmaps || [];
   if (heat.length) panelRow(panels, 'Topology heatmaps', heat);
   renderStats(frame.stats || {});
+  renderBreakdown(frame.breakdown, frame.panel_specs);
   const t = frame.timings || {};
   document.getElementById('debug').textContent =
     'Debug: frames=' + (t.frames || 0) +

@@ -261,6 +261,60 @@ class DashboardService:
                 )
         return out
 
+    def _breakdown(self, sel_df: pd.DataFrame, panels) -> dict:
+        """Per-slice and per-host averages over the selection — the fleet
+        drill-down the reference's flat per-GPU list couldn't offer.  A
+        dimension appears only when it actually distinguishes rows (>1
+        distinct value).  Averages use the same zero-exclusion policy as
+        the headline row."""
+        cols = [p.column for p in panels if p.column in sel_df.columns]
+        if not cols:
+            return {}
+        # pure-numpy group means (factorize + add.at), not groups×columns
+        # column_average calls or pandas groupby machinery — at 256 chips
+        # the host dimension alone has 64+ groups and this runs per frame
+        sub = sel_df[cols]
+        if all(dt.kind in "fi" for dt in sub.dtypes):
+            arr = sub.to_numpy(dtype=np.float64, copy=True)
+        else:  # legacy mixed-dtype frames
+            arr = sub.apply(pd.to_numeric, errors="coerce").to_numpy(
+                dtype=np.float64, copy=True
+            )
+        for i, column in enumerate(cols):
+            # zero-exclusion becomes NaN-exclusion (app.py:341-345 policy)
+            if column in schema.ZERO_EXCLUDED_METRICS:
+                arr[arr[:, i] == 0.0, i] = np.nan
+        valid = ~np.isnan(arr)
+        filled = np.where(valid, arr, 0.0)
+
+        out: dict = {}
+        for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
+            if col not in sel_df.columns:
+                continue
+            codes, uniques = pd.factorize(sel_df[col], sort=True)
+            if len(uniques) <= 1:
+                continue
+            sums = np.zeros((len(uniques), len(cols)))
+            counts = np.zeros((len(uniques), len(cols)))
+            np.add.at(sums, codes, filled)
+            np.add.at(counts, codes, valid)
+            with np.errstate(invalid="ignore"):
+                means = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+            sizes = np.bincount(codes, minlength=len(uniques))
+            rows: dict = {}
+            for g, key in enumerate(uniques):
+                vals = {
+                    c: round(float(means[g, i]), 2)
+                    for i, c in enumerate(cols)
+                    if means[g, i] == means[g, i]  # drop no-eligible-value cols
+                }
+                if vals:
+                    vals["chips"] = int(sizes[g])
+                    rows[str(key)] = vals
+            if rows:
+                out[dim] = rows
+        return out
+
     def _trends(self, sel_df: pd.DataFrame, panels, max_points: int = 120) -> list:
         """Sparkline per panel over the rolling average history, downsampled
         to ≤max_points (strided from the end so the latest point always
@@ -409,12 +463,14 @@ class DashboardService:
                     m: {k: round(v, 2) for k, v in s.items()}
                     for m, s in stats.items()
                 }
+                frame["breakdown"] = self._breakdown(sel_df, panels)
             else:
                 frame["average"] = None
                 frame["device_rows"] = []
                 frame["heatmaps"] = []
                 frame["trends"] = []
                 frame["stats"] = {}
+                frame["breakdown"] = {}
 
         self.timer.end_frame()
         frame["timings"] = self.timer.summary()
