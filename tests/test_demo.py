@@ -1,0 +1,42 @@
+"""Single-process demo: exporter + dashboard wired end to end."""
+
+import asyncio
+import json
+
+from tpudash.config import Config
+from tpudash.demo import demo_configs, start_demo
+
+
+def test_demo_configs_wire_dashboard_to_exporter(monkeypatch):
+    monkeypatch.setenv("TPUDASH_DEMO_SOURCE", "synthetic")
+    exporter_cfg, dash_cfg = demo_configs(Config(exporter_port=19311))
+    assert exporter_cfg.source == "synthetic"
+    assert dash_cfg.source == "scrape"
+    assert dash_cfg.scrape_url == "http://127.0.0.1:19311/metrics"
+
+
+def test_demo_end_to_end(monkeypatch):
+    monkeypatch.setenv("TPUDASH_DEMO_SOURCE", "synthetic")
+    cfg = Config(
+        host="127.0.0.1", port=19413, exporter_port=19412,
+        synthetic_chips=8, refresh_interval=0.0,
+    )
+
+    async def go():
+        import aiohttp
+
+        runners = await start_demo(cfg)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get("http://127.0.0.1:19412/metrics") as r:
+                    assert r.status == 200
+                    assert "tpu_tensorcore_utilization" in await r.text()
+                async with s.get("http://127.0.0.1:19413/api/frame") as r:
+                    frame = json.loads(await r.text())
+                    assert frame["error"] is None
+                    assert len(frame["chips"]) == 8  # scraped via the exporter
+        finally:
+            for runner in runners:
+                await runner.cleanup()
+
+    asyncio.run(go())

@@ -270,6 +270,15 @@ class DashboardService:
         cols = [p.column for p in panels if p.column in sel_df.columns]
         if not cols:
             return {}
+        # the common single-slice single-host case: skip the matrix prep
+        # entirely when neither dimension distinguishes any rows
+        dims = [
+            (dim, col)
+            for dim, col in (("by_slice", "slice_id"), ("by_host", "host"))
+            if col in sel_df.columns and sel_df[col].nunique() > 1
+        ]
+        if not dims:
+            return {}
         # pure-numpy group means (factorize + add.at), not groups×columns
         # column_average calls or pandas groupby machinery — at 256 chips
         # the host dimension alone has 64+ groups and this runs per frame
@@ -288,12 +297,8 @@ class DashboardService:
         filled = np.where(valid, arr, 0.0)
 
         out: dict = {}
-        for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
-            if col not in sel_df.columns:
-                continue
+        for dim, col in dims:
             codes, uniques = pd.factorize(sel_df[col], sort=True)
-            if len(uniques) <= 1:
-                continue
             sums = np.zeros((len(uniques), len(cols)))
             counts = np.zeros((len(uniques), len(cols)))
             np.add.at(sums, codes, filled)
