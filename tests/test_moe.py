@@ -47,8 +47,11 @@ def _sharded_ffn(mesh, cfg):
 
 
 @pytest.mark.parametrize("ep", [1, 4, 8])
-def test_moe_ffn_matches_dense_oracle(ep):
-    cfg = CFG
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_ffn_matches_dense_oracle(ep, top_k):
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, top_k=top_k)
     mesh = _mesh(ep)
     params = init_moe_params(jax.random.PRNGKey(0), cfg)
     S_global = 64  # 8 tokens per shard at ep=8
@@ -97,6 +100,57 @@ def test_moe_train_step_runs_and_learns():
     assert losses[-1] < losses[0]
     # experts genuinely ep-sharded
     assert "ep" in str(params["w_up"].sharding.spec)
+
+
+def test_top2_gates_renormalize_and_top1_keeps_raw_prob():
+    import dataclasses
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, CFG.d_model))
+    router = jax.random.normal(jax.random.PRNGKey(1), (CFG.d_model, CFG.n_experts))
+    probs = jax.nn.softmax(
+        jnp.einsum("sd,de->se", x.astype(jnp.float32), router), axis=-1
+    )
+    # K=1: combine weight equals the raw top-1 probability (switch)
+    _, combine1, _ = _route(x, router, dataclasses.replace(CFG, top_k=1), capacity=8)
+    np.testing.assert_allclose(
+        np.asarray(combine1.sum(axis=(1, 2))),
+        np.asarray(probs.max(axis=-1)),
+        rtol=1e-5,
+    )
+    # K=2: the two gates renormalize to 1 per token (Mixtral)
+    _, combine2, _ = _route(x, router, dataclasses.replace(CFG, top_k=2), capacity=8)
+    np.testing.assert_allclose(
+        np.asarray(combine2.sum(axis=(1, 2))), np.ones(8), rtol=1e-5
+    )
+
+
+def test_top2_capacity_drops_secondary_before_primary():
+    import dataclasses
+
+    # zero router → all tokens pick experts 0 (primary) and 1 (secondary);
+    # capacity 2 keeps 2 primary slots on expert 0 and 2 secondary on 1
+    cfg = dataclasses.replace(
+        MoEConfig(n_experts=4, top_k=2), capacity_factor=1.0
+    )
+    x = jnp.ones((8, cfg.d_model), jnp.float32)
+    router = jnp.zeros((cfg.d_model, cfg.n_experts), jnp.float32)
+    dispatch, _, _ = _route(x, router, cfg, capacity=2)
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert per_expert[0] == 2.0  # primary assignments fill first
+    assert per_expert[1] == 2.0
+    assert per_expert[2] == per_expert[3] == 0.0
+
+
+def test_capacity_scales_with_top_k():
+    import dataclasses
+
+    from tpudash.models.moe import _capacity
+
+    base = MoEConfig(n_experts=8, capacity_factor=1.25)
+    # K·S assignments need K× the slots (GShard convention) — otherwise
+    # top-2 drops ~37% of assignments even under perfectly balanced load
+    assert _capacity(64, dataclasses.replace(base, top_k=1)) == 10
+    assert _capacity(64, dataclasses.replace(base, top_k=2)) == 20
 
 
 def test_moe_loss_finite_under_heavy_drop():

@@ -270,13 +270,18 @@ class DashboardService:
         cols = [p.column for p in panels if p.column in sel_df.columns]
         if not cols:
             return {}
-        # the common single-slice single-host case: skip the matrix prep
-        # entirely when neither dimension distinguishes any rows
-        dims = [
-            (dim, col)
-            for dim, col in (("by_slice", "slice_id"), ("by_host", "host"))
-            if col in sel_df.columns and sel_df[col].nunique() > 1
-        ]
+        # factorize each dimension ONCE (also the degenerate-case gate):
+        # the common single-slice single-host frame skips the matrix prep
+        # entirely.  Rows whose group label is missing (factorize code -1,
+        # e.g. a joined source without the host label) are excluded from
+        # that dimension rather than corrupting a group.
+        dims = []
+        for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
+            if col not in sel_df.columns:
+                continue
+            codes, uniques = pd.factorize(sel_df[col], sort=True)
+            if len(uniques) > 1:
+                dims.append((dim, codes, uniques))
         if not dims:
             return {}
         # pure-numpy group means (factorize + add.at), not groups×columns
@@ -297,15 +302,16 @@ class DashboardService:
         filled = np.where(valid, arr, 0.0)
 
         out: dict = {}
-        for dim, col in dims:
-            codes, uniques = pd.factorize(sel_df[col], sort=True)
+        for dim, codes, uniques in dims:
+            labeled = codes >= 0  # drop rows with a missing group label
+            lcodes = codes[labeled]
             sums = np.zeros((len(uniques), len(cols)))
             counts = np.zeros((len(uniques), len(cols)))
-            np.add.at(sums, codes, filled)
-            np.add.at(counts, codes, valid)
+            np.add.at(sums, lcodes, filled[labeled])
+            np.add.at(counts, lcodes, valid[labeled])
             with np.errstate(invalid="ignore"):
                 means = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
-            sizes = np.bincount(codes, minlength=len(uniques))
+            sizes = np.bincount(lcodes, minlength=len(uniques))
             rows: dict = {}
             for g, key in enumerate(uniques):
                 vals = {

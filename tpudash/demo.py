@@ -59,9 +59,13 @@ async def start_demo(cfg: Config | None = None) -> "tuple[web.AppRunner, web.App
 
     exporter_runner = web.AppRunner(make_exporter_app(exporter_cfg))
     await exporter_runner.setup()
-    await web.TCPSite(
-        exporter_runner, dash_cfg.host, exporter_cfg.exporter_port
-    ).start()
+    try:
+        await web.TCPSite(
+            exporter_runner, exporter_cfg.host, exporter_cfg.exporter_port
+        ).start()
+    except Exception:
+        await exporter_runner.cleanup()  # setup() ran on_startup hooks
+        raise
     log.info(
         "exporter (%s source) on :%d/metrics",
         exporter_cfg.source,

@@ -46,6 +46,9 @@ class MoEConfig:
     n_experts: int = 8
     seq: int = 16
     batch: int = 8
+    #: experts per token: 1 = switch routing, 2 = Mixtral-style top-2
+    #: (gates renormalized over the chosen experts).
+    top_k: int = 1
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
     lr: float = 3e-4
@@ -84,32 +87,52 @@ def moe_param_specs() -> dict:
 
 
 def _capacity(tokens_per_group: int, cfg: MoEConfig) -> int:
+    # K·S assignments spread over E experts (GShard convention): without
+    # the top_k factor, top-2 at cf=1.25 would drop ~37% of assignments
+    # even under perfectly balanced load
     return max(
-        1, math.ceil(tokens_per_group / cfg.n_experts * cfg.capacity_factor)
+        1,
+        math.ceil(
+            cfg.top_k * tokens_per_group / cfg.n_experts * cfg.capacity_factor
+        ),
     )
 
 
 def _route(x: jax.Array, router: jax.Array, cfg: MoEConfig, capacity: int):
-    """Top-1 routing for local tokens x (S, d) → (dispatch (S,E,C),
-    combine (S,E,C), aux-loss scalar)."""
-    S = x.shape[0]
-    E = cfg.n_experts
+    """Top-k routing for local tokens x (S, d) → (dispatch (S,E,C),
+    combine (S,E,C), aux-loss scalar).
+
+    k=1 is switch routing; k=2 is Mixtral-style with gates renormalized
+    over the chosen experts.  Capacity positions are assigned choice-rank
+    first (all primary assignments, then secondary), the standard
+    mesh-tensorflow ordering, so a full expert drops secondary traffic
+    before primary."""
+    E, K = cfg.n_experts, cfg.top_k
     logits = jnp.einsum("sd,de->se", x.astype(jnp.float32), router)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # (S,) top-1
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    mask = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (S, E)
-    # position of each token within its expert's capacity buffer
-    pos = jnp.cumsum(mask, axis=0) * mask - mask  # 0-indexed where routed
-    keep = mask * (pos < capacity)
-    dispatch = keep[..., None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity, dtype=jnp.float32
-    )  # (S, E, C)
-    combine = dispatch * gate[:, None, None]
-    # switch aux loss: E · Σ_e (token fraction_e · mean router prob_e)
-    frac = jnp.mean(mask, axis=0)
+    top_gates, top_idx = jax.lax.top_k(probs, K)  # (S, K)
+    if K > 1:  # Mixtral renormalizes over chosen experts; switch (K=1)
+        # keeps the raw top-1 probability as the gate
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((x.shape[0], E, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    usage = jnp.zeros((E,), jnp.float32)  # slots taken per expert so far
+    frac = jnp.zeros((E,), jnp.float32)
+    for j in range(K):  # static, tiny (K ≤ 2)
+        mask = jax.nn.one_hot(top_idx[:, j], E, dtype=jnp.float32)  # (S, E)
+        pos = jnp.cumsum(mask, axis=0) * mask - mask + usage[None, :] * mask
+        keep = mask * (pos < capacity)
+        d_j = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )
+        dispatch = dispatch + d_j
+        combine = combine + d_j * top_gates[:, j, None, None]
+        usage = usage + jnp.sum(keep, axis=0)
+        frac = frac + jnp.mean(mask, axis=0)
+    # load-balance aux: E · Σ_e (assigned fraction_e / K · mean prob_e)
     mean_prob = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * mean_prob)
+    aux = E * jnp.sum(frac / K * mean_prob)
     return dispatch, combine, aux
 
 
@@ -236,21 +259,26 @@ def make_moe_train_state(key: jax.Array, cfg: MoEConfig):
 # --- correctness oracle ------------------------------------------------------
 
 def dense_moe_reference(x: jax.Array, params: dict, cfg: MoEConfig) -> jax.Array:
-    """Per-token oracle: y[s] = gate[s] · FFN_{expert(s)}(x[s]), no
-    capacity drops.  Matches moe_ffn_local exactly when capacity ≥ the
+    """Per-token oracle: y[s] = Σ_j gate_j[s] · FFN_{expert_j(s)}(x[s]),
+    no capacity drops.  Matches moe_ffn_local exactly when capacity ≥ the
     largest per-expert token count (tests use capacity_factor=n_experts)."""
     logits = jnp.einsum("sd,de->se", x.astype(jnp.float32), params["router"])
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    w_up = params["w_up"][expert]  # (S, d, f)
-    w_down = params["w_down"][expert]
-    h = jnp.einsum(
-        "sd,sdf->sf", x.astype(jnp.bfloat16), w_up,
-        preferred_element_type=jnp.bfloat16,
-    )
-    h = jax.nn.gelu(h)
-    y = jnp.einsum(
-        "sf,sfd->sd", h, w_down, preferred_element_type=jnp.float32
-    )
-    return (gate[:, None] * y).astype(x.dtype)
+    top_gates, top_idx = jax.lax.top_k(probs, cfg.top_k)
+    if cfg.top_k > 1:
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
+    y = jnp.zeros((x.shape[0], cfg.d_model), jnp.float32)
+    for j in range(cfg.top_k):
+        expert = top_idx[:, j]
+        w_up = params["w_up"][expert]  # (S, d, f)
+        w_down = params["w_down"][expert]
+        h = jnp.einsum(
+            "sd,sdf->sf", x.astype(jnp.bfloat16), w_up,
+            preferred_element_type=jnp.bfloat16,
+        )
+        h = jax.nn.gelu(h)
+        yj = jnp.einsum(
+            "sf,sfd->sd", h, w_down, preferred_element_type=jnp.float32
+        )
+        y = y + top_gates[:, j, None] * yj
+    return y.astype(x.dtype)
