@@ -1,0 +1,115 @@
+"""Alert webhook notifications: transition-edge delivery, best-effort."""
+
+import pandas as pd
+import pytest
+
+from tpudash import schema
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.schema import ChipKey, Sample
+from tpudash.sources.base import MetricsSource
+
+
+class _TempSource(MetricsSource):
+    """One chip whose temperature we steer per fetch."""
+
+    name = "steered"
+
+    def __init__(self):
+        self.temp = 50.0
+
+    def fetch(self):
+        chip = ChipKey(slice_id="s", host="h", chip_id=0)
+        return [
+            Sample(metric=schema.TEMPERATURE, value=self.temp, chip=chip),
+            Sample(metric=schema.POWER, value=100.0, chip=chip),
+        ]
+
+
+@pytest.fixture
+def posts(monkeypatch):
+    calls = []
+
+    def fake_post(url, json=None, timeout=None):
+        calls.append((url, json))
+
+        class R:
+            def raise_for_status(self):
+                pass
+
+        return R()
+
+    import requests
+
+    monkeypatch.setattr(requests, "post", fake_post)
+    return calls
+
+
+def _svc(src, **kw):
+    cfg = Config(
+        alert_rules=f"{schema.TEMPERATURE}>90:critical@2",
+        alert_webhook="http://pager.example/hook",
+        fetch_retries=0,
+        **kw,
+    )
+    return DashboardService(cfg, src)
+
+
+def test_webhook_fires_on_transition_edges_only(posts):
+    src = _TempSource()
+    svc = _svc(src)
+    svc.render_frame()  # healthy
+    assert posts == []
+    src.temp = 95.0
+    svc.render_frame()  # streak 1 → pending, no page yet (hysteresis @2)
+    assert posts == []
+    svc.render_frame()  # streak 2 → firing: ONE notification
+    svc.flush_webhooks()
+    assert len(posts) == 1
+    url, body = posts[0]
+    assert url == "http://pager.example/hook"
+    assert body["fired"][0]["chip"] == "s/0"
+    assert body["fired"][0]["severity"] == "critical"
+    assert body["resolved"] == []
+    svc.render_frame()  # still firing → no repeat page
+    svc.flush_webhooks()
+    assert len(posts) == 1
+    src.temp = 50.0
+    svc.render_frame()  # recovered → resolved notification
+    svc.flush_webhooks()
+    assert len(posts) == 2
+    assert posts[1][1]["fired"] == []
+    assert posts[1][1]["resolved"] == [
+        {"rule": f"{schema.TEMPERATURE}>90", "chip": "s/0"}
+    ]
+
+
+def test_webhook_failure_never_fails_the_frame(monkeypatch):
+    import requests
+
+    def boom(*a, **k):
+        raise requests.ConnectionError("pager down")
+
+    monkeypatch.setattr(requests, "post", boom)
+    src = _TempSource()
+    src.temp = 95.0
+    svc = _svc(src)
+    for _ in range(3):
+        frame = svc.render_frame()
+        svc.flush_webhooks()
+        assert frame["error"] is None  # delivery failure only logs
+
+
+def test_no_webhook_configured_skips_requests(posts):
+    src = _TempSource()
+    src.temp = 95.0
+    cfg = Config(alert_rules=f"{schema.TEMPERATURE}>90:critical@1", fetch_retries=0)
+    svc = DashboardService(cfg, src)
+    svc.render_frame()
+    svc.flush_webhooks()
+    assert posts == []
+
+
+def test_env_knob():
+    cfg = load_config({"TPUDASH_ALERT_WEBHOOK": "http://x/h"})
+    assert cfg.alert_webhook == "http://x/h"

@@ -255,9 +255,11 @@ class DashboardServer:
                 copy.deepcopy(engine._tracks) if engine is not None else None
             )
             saved_alerts = self.service.last_alerts
+            saved_firing = set(self.service._firing_keys)
             deadline = time.monotonic() + 10.0  # bound lock-hold wall time
             done = 0
             prof = cProfile.Profile()
+            self.service.mute_notifications = True  # no paging from profiling
             prof.enable()
             try:
                 for _ in range(frames):
@@ -267,11 +269,13 @@ class DashboardServer:
                         break
             finally:
                 prof.disable()
+                self.service.mute_notifications = False
                 if engine is not None:
                     engine._tracks = saved_tracks
                     # /api/alerts must not serve the synthetic renders'
                     # inflated streaks until the next real frame
                     self.service.last_alerts = saved_alerts
+                    self.service._firing_keys = saved_firing
             stats = pstats.Stats(prof)
             top = []
             for func, (cc, nc, tt, ct, _callers) in stats.stats.items():

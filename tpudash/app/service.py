@@ -90,6 +90,68 @@ class DashboardService:
 
             self.alert_engine = AlertEngine.from_spec(cfg.alert_rules or None)
         self.last_alerts: list[dict] = []
+        #: (rule, chip) pairs firing in the previous frame — webhook
+        #: notifications are sent on transitions only, not every cycle
+        self._firing_keys: set = set()
+        #: set by the profile endpoint while it replays synthetic renders
+        #: (those must never page anyone)
+        self.mute_notifications = False
+        self._webhook_thread = None
+
+    def _notify_alert_transitions(self) -> None:
+        """POST newly-firing and resolved alerts to Config.alert_webhook
+        (the pager integration the reference's error banner couldn't be).
+        Transition-edge only — a steadily-firing alert posts once.
+        Delivery is best-effort: failures log and never fail the frame."""
+        firing = {
+            (a["rule"], a["chip"]): a
+            for a in self.last_alerts
+            if a["state"] == "firing"
+        }
+        fired = [firing[k] for k in firing.keys() - self._firing_keys]
+        resolved = sorted(self._firing_keys - firing.keys())
+        self._firing_keys = set(firing)
+        if (
+            not self.cfg.alert_webhook
+            or self.mute_notifications
+            or not (fired or resolved)
+        ):
+            return
+        payload = {
+            "source": "tpudash",
+            "fired": sorted(fired, key=lambda a: (a["rule"], a["chip"])),
+            "resolved": [
+                {"rule": rule, "chip": chip} for rule, chip in resolved
+            ],
+        }
+        # deliver OFF the frame path: render_frame runs under the server's
+        # frame lock, so a black-holed pager endpoint must not stall every
+        # /api/* route for http_timeout seconds
+        import threading
+
+        t = threading.Thread(
+            target=self._deliver_webhook, args=(payload,), daemon=True
+        )
+        self._webhook_thread = t
+        t.start()
+
+    def _deliver_webhook(self, payload: dict) -> None:
+        try:
+            import requests
+
+            requests.post(
+                self.cfg.alert_webhook,
+                json=payload,
+                timeout=self.cfg.http_timeout,
+            ).raise_for_status()
+        except Exception as e:  # noqa: BLE001 — notification is best-effort
+            log.warning("alert webhook delivery failed: %s", e)
+
+    def flush_webhooks(self, timeout: float = 5.0) -> None:
+        """Wait for the in-flight webhook delivery (tests, shutdown)."""
+        t = self._webhook_thread
+        if t is not None:
+            t.join(timeout)
 
     def _backfill_history(self) -> None:
         """Seed the trend history from the source's range query (Prometheus
@@ -399,6 +461,7 @@ class DashboardService:
             with self.timer.stage("alerts"):
                 self.last_alerts = self.alert_engine.evaluate(df)
             frame["alerts"] = self.last_alerts
+            self._notify_alert_transitions()
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)

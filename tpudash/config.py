@@ -88,6 +88,10 @@ class Config:
     #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
     #: defaults; "off" disables alerting.
     alert_rules: str = ""
+    #: POST firing/resolved alert transitions to this URL as JSON ("" =
+    #: off).  Fire-and-forget with the frame's HTTP timeout; delivery
+    #: failures are logged, never fail the frame.
+    alert_webhook: str = ""
     #: Append every successful scrape (any source) to this JSONL file for
     #: later replay ("" disables).  Snapshots are exposition-text — the
     #: exporter's own wire format.
@@ -145,6 +149,7 @@ _ENV_MAP = {
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",
+    "alert_webhook": "TPUDASH_ALERT_WEBHOOK",
 }
 
 
