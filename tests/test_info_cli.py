@@ -38,3 +38,24 @@ def test_main_source_error(capsys, monkeypatch):
     rc = main(["--source", "fixture"])
     assert rc == 0
     assert "error:" in capsys.readouterr().out
+
+
+def test_main_shows_health_and_alerts(capsys, monkeypatch):
+    # util>0 fires immediately at @1 on every synthetic chip
+    monkeypatch.setenv("TPUDASH_ALERT_RULES", "tpu_tensorcore_utilization>0:warning@1")
+    from tpudash.info import main
+
+    assert main(["--source", "synthetic", "--chips", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "ALERTS:" in out
+    assert "health=healthy" in out  # retry wrapper health on the footer
+
+
+def test_main_bad_alert_rules_degrades_to_warning(capsys, monkeypatch):
+    monkeypatch.setenv("TPUDASH_ALERT_RULES", "temp>>90")  # malformed
+    from tpudash.info import main
+
+    assert main(["--source", "synthetic", "--chips", "2"]) == 0
+    captured = capsys.readouterr()
+    assert "alerting disabled" in captured.err
+    assert "MXU%" in captured.out  # table still renders

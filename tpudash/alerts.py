@@ -125,6 +125,16 @@ class AlertEngine:
             DEFAULT_RULES_SPEC if spec is None else spec
         ), clock=clock)
 
+    @classmethod
+    def from_config(cls, cfg, clock=time.time) -> "AlertEngine | None":
+        """The one place Config.alert_rules is interpreted (dashboard
+        service and terminal CLI both call this): disable sentinels →
+        None, "" → built-in defaults, anything else parsed as a spec
+        (ValueError on a malformed one)."""
+        if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
+            return None
+        return cls.from_spec(cfg.alert_rules or None, clock=clock)
+
     def evaluate(self, df: pd.DataFrame) -> list[dict]:
         """Evaluate all rules against the wide table (index = chip key).
 

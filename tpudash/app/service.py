@@ -83,12 +83,9 @@ class DashboardService:
             self._backfill_history()
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
-        if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
-            self.alert_engine = None
-        else:
-            from tpudash.alerts import AlertEngine
+        from tpudash.alerts import AlertEngine
 
-            self.alert_engine = AlertEngine.from_spec(cfg.alert_rules or None)
+        self.alert_engine = AlertEngine.from_config(cfg)
         self.last_alerts: list[dict] = []
         #: (rule, chip) pairs firing in the previous frame — webhook
         #: notifications are sent on transitions only, not every cycle

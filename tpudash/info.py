@@ -79,17 +79,43 @@ def main(argv: "list[str] | None" = None) -> int:
         cfg = dataclasses.replace(cfg, synthetic_chips=args.chips)
     source = make_source(cfg)
 
+    from tpudash.alerts import AlertEngine
+
+    try:
+        engine = AlertEngine.from_config(cfg)
+    except ValueError as e:
+        # a bad TPUDASH_ALERT_RULES in the shell must not hide the table
+        print(f"warning: alerting disabled ({e})", file=sys.stderr)
+        engine = None
+
     try:
         while True:
+            alert_line = ""
             try:
                 df = to_wide(source.fetch())
                 out = render_table(df, compute_stats(df))
+                if engine is not None:
+                    firing = [
+                        a for a in engine.evaluate(df) if a["state"] == "firing"
+                    ]
+                    if firing:
+                        alert_line = "ALERTS: " + "  ".join(
+                            f"{a['chip']} {a['rule']} (={a['value']}, {a['severity']})"
+                            for a in firing[:6]
+                        ) + (" …" if len(firing) > 6 else "")
             except SourceError as e:
                 out = f"error: {e}"
             if args.watch:
                 sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
             print(out)
-            print(f"\nsource={source.name}  {time.strftime('%Y-%m-%d %H:%M:%S')}")
+            if alert_line:
+                print("\n" + alert_line)
+            health = getattr(source, "health", None)
+            status = f"  health={health.status}" if health else ""
+            print(
+                f"\nsource={source.name}{status}  "
+                f"{time.strftime('%Y-%m-%d %H:%M:%S')}"
+            )
             if not args.watch:
                 return 0
             time.sleep(cfg.refresh_interval)
