@@ -116,3 +116,12 @@ def test_alerts_disabled():
     svc = DashboardService(cfg, SyntheticSource(num_chips=4))
     frame = svc.render_frame()
     assert "alerts" not in frame
+
+
+def test_from_config_whitespace_means_defaults():
+    from tpudash.alerts import AlertEngine
+    from tpudash.config import Config
+
+    engine = AlertEngine.from_config(Config(alert_rules="   "))
+    assert engine is not None and engine.rules  # built-in defaults, not []
+    assert AlertEngine.from_config(Config(alert_rules=" off ")) is None

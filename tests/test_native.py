@@ -376,3 +376,94 @@ def test_service_frame_identical_python_vs_native(monkeypatch):
     assert [r["title"] for r in frame_n["device_rows"]] == [
         r["title"] for r in frame_p["device_rows"]
     ]
+
+
+# --- differential fuzz: native parser vs Python parser ----------------------
+
+def _fuzz_payload(rng):
+    """Random instant-query payloads mixing valid, edge-case, and junk
+    series — the adversarial surface both parsers must agree on."""
+    metrics = ["tpu_power_watts", "tpu_temperature_celsius", "m", "x_y"]
+    result = []
+    for _ in range(rng.randrange(0, 25)):
+        kind = rng.random()
+        metric = {}
+        if kind < 0.8:  # plausibly-valid series
+            metric["__name__"] = rng.choice(metrics)
+            if rng.random() < 0.9:
+                metric["chip_id"] = rng.choice(
+                    ["0", "1", "7", "255", "-1", "12", "00", "bad", ""]
+                )
+            if rng.random() < 0.5:
+                metric["slice"] = rng.choice(["slice-0", "slice-1", "s"])
+            if rng.random() < 0.5:
+                metric["host"] = rng.choice(["h0", "h1", 'q"uote', "esc\\ape"])
+            if rng.random() < 0.4:
+                metric["instance"] = "10.0.0.1:9100"
+            if rng.random() < 0.4:
+                metric["accelerator"] = rng.choice(
+                    ["tpu-v5-lite-podslice", "tpu-v4-podslice", ""]
+                )
+            if rng.random() < 0.2:
+                metric["gpu_id"] = rng.choice(["2", "3"])
+            if rng.random() < 0.2:
+                metric["card_model"] = "legacy"
+            value = [
+                rng.randrange(0, 2_000_000_000),
+                rng.choice(
+                    ["0", "1.5", "-3.25", "1e9", "NaN", "+Inf", "-Inf",
+                     "bad", "", "0x1", "1_5", "nan(7)",
+                     "1.7976931348623157e308"]
+                ),
+            ]
+        else:  # structural junk
+            if rng.random() < 0.5:
+                metric = {"chip_id": "1"}  # no __name__
+            else:
+                metric = {"__name__": "m"}  # no chip id
+            value = rng.choice(
+                [[1, "2"], [1], "nope", None, [1, "2", "3"], {}]
+            )
+        result.append({"metric": metric, "value": value})
+    return {"status": "success", "data": {"result": result}}
+
+
+def test_differential_fuzz_json_parser():
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    for case in range(200):
+        payload = _fuzz_payload(rng)
+        raw = json.dumps(payload)
+        py_samples = parse_instant_query(payload)
+        try:
+            batch = native.parse_promjson(raw)
+        except native.NativeParseError:
+            # native may only reject what Python also yields nothing for
+            assert not py_samples, f"case {case}: native rejected, python parsed"
+            continue
+        if not py_samples:
+            assert len(batch) == 0, f"case {case}: python empty, native not"
+            continue
+        df_py = to_wide(py_samples)
+        assert_frames_equal(batch, df_py)
+
+
+def test_differential_fuzz_text_parser():
+    import random
+
+    rng = random.Random(0xBEEF)
+    for case in range(120):
+        payload = _fuzz_payload(rng)
+        samples = parse_instant_query(payload)
+        if not samples:
+            continue
+        text = encode_samples(samples)
+        batch = native.parse_text(text)
+        py_samples = parse_text_format(text)
+        if not py_samples:
+            # every sample was non-finite → both sides drop everything
+            assert len(batch) == 0, f"case {case}"
+            continue
+        df_py = to_wide(py_samples)
+        assert_frames_equal(batch, df_py)

@@ -133,7 +133,8 @@ class AlertEngine:
         (ValueError on a malformed one)."""
         if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
             return None
-        return cls.from_spec(cfg.alert_rules or None, clock=clock)
+        # strip so a stray-whitespace value still means "built-in defaults"
+        return cls.from_spec(cfg.alert_rules.strip() or None, clock=clock)
 
     def evaluate(self, df: pd.DataFrame) -> list[dict]:
         """Evaluate all rules against the wide table (index = chip key).

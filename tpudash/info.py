@@ -95,14 +95,16 @@ def main(argv: "list[str] | None" = None) -> int:
                 df = to_wide(source.fetch())
                 out = render_table(df, compute_stats(df))
                 if engine is not None:
-                    firing = [
-                        a for a in engine.evaluate(df) if a["state"] == "firing"
-                    ]
-                    if firing:
+                    # pending included: a one-shot run evaluates once, so
+                    # @N>1 rules can never reach "firing" here — a breach
+                    # in progress must still be visible
+                    active = engine.evaluate(df)
+                    if active:
                         alert_line = "ALERTS: " + "  ".join(
-                            f"{a['chip']} {a['rule']} (={a['value']}, {a['severity']})"
-                            for a in firing[:6]
-                        ) + (" …" if len(firing) > 6 else "")
+                            f"{a['chip']} {a['rule']} (={a['value']}, "
+                            f"{a['severity']}, {a['state']})"
+                            for a in active[:6]
+                        ) + (" …" if len(active) > 6 else "")
             except SourceError as e:
                 out = f"error: {e}"
             if args.watch:

@@ -141,6 +141,11 @@ void set_err(char* err, int64_t errcap, const std::string& msg) {
 // Full-token numeric parse (Python float()/int() reject trailing garbage).
 bool parse_full_double(const char* s, size_t len, double* out) {
   std::string buf(s, len);
+  // strtod accepts C extensions Python float() rejects — hex floats
+  // ("0x1") and nan payloads ("nan(123)"); both paths must skip the same
+  // series (found by the differential fuzz tests)
+  for (char c : buf)
+    if (c == 'x' || c == 'X' || c == '(') return false;
   const char* b = buf.c_str();
   char* endp = nullptr;
   double v = std::strtod(b, &endp);

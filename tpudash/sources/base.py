@@ -195,8 +195,14 @@ def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[S
         value = item.get("value")
         if not isinstance(value, (list, tuple)) or len(value) != 2:
             continue
+        raw_val = value[1]
+        # Python float() accepts underscore-grouped literals ("1_5" → 15)
+        # that Prometheus never emits and the native kernel rejects — skip
+        # them so both parsers drop the same series (differential fuzz)
+        if isinstance(raw_val, str) and "_" in raw_val:
+            continue
         try:
-            val = float(value[1])
+            val = float(raw_val)
         except (TypeError, ValueError):
             continue
         ident = _series_identity(metric, chip_cache, default_slice)
