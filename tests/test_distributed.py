@@ -1,0 +1,112 @@
+"""Multi-host initialization plumbing (single-process behavior only —
+the real rendezvous needs a multi-host slice; dryrun_multichip covers the
+sharded programs on a virtual mesh)."""
+
+from tpudash.parallel import distributed
+
+
+def test_should_initialize_detects_multiprocess_env():
+    assert not distributed.should_initialize({})
+    assert distributed.should_initialize(
+        {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:8476"}
+    )
+    assert distributed.should_initialize({"TPU_WORKER_HOSTNAMES": "a,b"})
+    # single-host TPU VMs set a one-entry list — NOT a multi-process job
+    assert not distributed.should_initialize({"TPU_WORKER_HOSTNAMES": "localhost"})
+    assert distributed.should_initialize(
+        {"MEGASCALE_COORDINATOR_ADDRESS": "c:1234"}
+    )
+    # explicit kill switch wins
+    assert not distributed.should_initialize(
+        {"JAX_COORDINATOR_ADDRESS": "x", "TPUDASH_DISTRIBUTED": "off"}
+    )
+
+
+def test_maybe_initialize_noop_single_process(monkeypatch):
+    # no coordination env → returns False and touches nothing
+    for var in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "TPU_WORKER_HOSTNAMES",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.maybe_initialize() is False
+    assert distributed._initialized is False
+
+
+def test_maybe_initialize_failure_degrades(monkeypatch):
+    # a failed rendezvous must log and fall back, never raise (the
+    # metrics plane keeps working when the workload plane cannot)
+    import jax
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "bad:1")
+
+    def boom(*a, **k):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    if hasattr(jax.distributed, "is_initialized"):
+        monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    assert distributed.maybe_initialize() is False
+    assert distributed._initialized is False
+
+
+def test_maybe_initialize_respects_external_init(monkeypatch):
+    # a launcher that already initialized jax.distributed counts as
+    # success — initialize() must NOT be called a second time
+    import jax
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        return  # older jax: the pre-check is simply absent
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+
+    def boom(*a, **k):
+        raise AssertionError("double initialize")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    assert distributed.maybe_initialize() is True
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+
+def test_entry_points_call_maybe_initialize():
+    # the rendezvous only works BEFORE any device query, so every process
+    # entry must call it first.  The chokepoints are the server run()
+    # functions (shared by `python -m` AND the installed console scripts
+    # from [project.scripts]), plus the demo/info mains.
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "tpudash"
+    for rel in ("app/server.py", "exporter/server.py", "demo.py", "info.py"):
+        text = (root / rel).read_text()
+        assert "maybe_initialize" in text, f"{rel} misses the rendezvous call"
+
+
+def test_parallel_package_imports_without_jax_side_effects(monkeypatch):
+    # tpudash.parallel sits on the CLI startup path via .distributed;
+    # importing it (or distributed) must not pull jax in eagerly — a
+    # jax-free install runs the dashboard with non-chip sources
+    import importlib
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None  # poison: any import attempt raises\n"
+        "import tpudash.parallel\n"
+        "from tpudash.parallel.distributed import maybe_initialize\n"
+        "assert maybe_initialize() is False  # single-process, jax untouched\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(
+            __import__("pathlib").Path(__file__).resolve().parent.parent
+        )},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout

@@ -371,7 +371,11 @@ def make_app(cfg: Config | None = None) -> web.Application:
 
 def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
     from tpudash.config import configure_logging
+    from tpudash.parallel.distributed import maybe_initialize
 
     configure_logging()
+    # multi-host rendezvous must precede any device query; also covers
+    # the installed `tpudash` console script, not just `python -m`
+    maybe_initialize()
     cfg = cfg or load_config()
     web.run_app(make_app(cfg), host=cfg.host, port=cfg.port)

@@ -95,5 +95,8 @@ async def _main() -> None:  # pragma: no cover - blocking entry
 
 
 if __name__ == "__main__":  # pragma: no cover
-    configure_logging()
+    from tpudash.parallel.distributed import maybe_initialize
+
+    configure_logging()  # first, so the rendezvous outcome is visible
+    maybe_initialize()  # before demo_configs queries jax.devices()
     asyncio.run(_main())

@@ -66,6 +66,9 @@ def render_table(df, stats) -> str:
 
 
 def main(argv: "list[str] | None" = None) -> int:
+    from tpudash.parallel.distributed import maybe_initialize
+
+    maybe_initialize()  # multi-host rendezvous before any device query
     ap = argparse.ArgumentParser(description="TPU metrics table")
     ap.add_argument("--source", help="override TPUDASH_SOURCE")
     ap.add_argument("--chips", type=int, help="synthetic chip count")

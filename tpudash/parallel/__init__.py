@@ -8,9 +8,23 @@ we need real collectives over a jax Mesh, and the demo workload
 identically on a virtual 8-device CPU mesh (tests) and a real slice.
 """
 
-from tpudash.parallel.mesh import build_mesh, mesh_axes_for  # noqa: F401
-from tpudash.parallel.collectives import (  # noqa: F401
-    all_gather_bandwidth_probe,
-    ppermute_ring_bandwidth_probe,
-    psum_latency_probe,
-)
+# Lazy re-exports: mesh/collectives import jax at module level, but this
+# package is also on the CLI startup path via parallel.distributed (whose
+# jax use is deliberately lazy) — a jax-free install must still run the
+# dashboard with non-chip sources.
+_LAZY = {
+    "build_mesh": "tpudash.parallel.mesh",
+    "mesh_axes_for": "tpudash.parallel.mesh",
+    "all_gather_bandwidth_probe": "tpudash.parallel.collectives",
+    "ppermute_ring_bandwidth_probe": "tpudash.parallel.collectives",
+    "psum_latency_probe": "tpudash.parallel.collectives",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
