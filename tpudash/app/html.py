@@ -34,6 +34,7 @@ PAGE = r"""<!DOCTYPE html>
                gap: 4px 14px; margin: 8px 0 16px; max-height: 180px; overflow-y: auto;
                border: 1px solid #e3e8ee; border-radius: 6px; padding: 10px; background: #fff;}
   #chip-grid label { font-size: 13px; white-space: nowrap; }
+  .slice-bar { grid-column: 1 / -1; display: flex; gap: 6px; flex-wrap: wrap; }
   .row-title { font-size: 16px; font-weight: 600; margin: 14px 0 6px; }
   .panel-row { display: grid; grid-template-columns: repeat(auto-fit, minmax(230px, 1fr)); gap: 10px; }
   .panel { background: #fff; border: 1px solid #e3e8ee; border-radius: 6px; padding: 6px; }
@@ -191,6 +192,21 @@ async function post(url, body) {
 function renderChips(chips) {
   const grid = document.getElementById('chip-grid');
   grid.innerHTML = '';
+  // multi-slice fleets: one-click slice selection above the checkbox grid
+  const slices = [...new Set(chips.map(c => c.slice))];
+  if (slices.length > 1) {
+    const bar = document.createElement('div');
+    bar.className = 'slice-bar';
+    for (const s of slices) {
+      const keys = chips.filter(c => c.slice === s).map(c => c.key);
+      const btn = document.createElement('button');
+      btn.textContent = `${s} (${keys.length})`;
+      btn.title = `select only ${s}`;
+      btn.addEventListener('click', () => post('/api/select', {selected: keys}));
+      bar.appendChild(btn);
+    }
+    grid.appendChild(bar);
+  }
   for (const c of chips) {
     const id = 'chip_checkbox_' + c.key;
     const label = document.createElement('label');
