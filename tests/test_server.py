@@ -170,6 +170,27 @@ def test_export_csv_refuses_stale_data_during_outage():
     _run(_with_client(_client_app(source=src), go))
 
 
+def test_schema_endpoint_self_documents():
+    async def go(client):
+        resp = await client.get("/api/schema")
+        assert resp.status == 200
+        body = await resp.json()
+        names = {e["name"] for e in body["scrape_series"]}
+        assert "tpu_tensorcore_utilization" in names
+        assert "tpu_hbm_bandwidth_gbps" in names  # probe-emitted series too
+        assert all(e["help"] for e in body["scrape_series"])
+        # canonical lists from schema.py, not hand-maintained copies
+        from tpudash import schema as s
+
+        assert body["derived_columns"] == list(s.DERIVED_COLUMNS)
+        assert "accelerator_type" in body["identity_columns"]
+        panel_cols = {p["column"] for p in body["panels"]}
+        assert "tpu_power_watts" in panel_cols
+        assert body["generations"]["v5e"]["hbm_gib"] == 16
+
+    _run(_with_client(_client_app(), go))
+
+
 def test_profile_frames_mode():
     async def go(client):
         resp = await client.post("/api/profile", json={"frames": 3})

@@ -16,6 +16,7 @@ Routes:
   POST /api/select     {"toggle": key} | {"selected": [keys]} | {"all": true} | {"none": true}
   POST /api/style      {"use_gauge": bool}
   GET  /api/timings    stage-timing summary (tracing, SURVEY.md §5)
+  GET  /api/schema     series/panels/generations metadata (API consumers)
   GET  /api/export.csv current wide per-chip table as CSV
   GET  /healthz        liveness
 """
@@ -320,6 +321,44 @@ class DashboardServer:
             snapshot = list(self.service.last_alerts)
         return web.json_response({"alerts": snapshot})
 
+    async def schema(self, request: web.Request) -> web.Response:
+        """Self-documenting API: every scraped series (with exporter help
+        text), derived columns, panels, and generation registry — what a
+        programmatic consumer needs to interpret /api/frame and the CSV."""
+        from tpudash import schema as s
+        from tpudash.registry import TPU_GENERATIONS
+
+        return web.json_response(
+            {
+                "scrape_series": [
+                    {"name": name, "help": s.SERIES_HELP.get(name, "")}
+                    for name in (*s.SCRAPE_SERIES, s.HBM_BANDWIDTH)
+                ],
+                "derived_columns": list(s.DERIVED_COLUMNS),
+                "identity_columns": ["slice_id", "host", "chip_id", s.ACCEL_TYPE],
+                "panels": [
+                    {
+                        "column": p.column,
+                        "title": p.title,
+                        "unit": p.unit,
+                        "max_policy": p.max_policy,
+                        "fixed_max": p.fixed_max,
+                    }
+                    for p in (*s.PANELS, *s.EXTRA_PANELS)
+                ],
+                "generations": {
+                    name: {
+                        "hbm_gib": g.hbm_gib,
+                        "nominal_power_w": g.nominal_power_w,
+                        "peak_bf16_tflops": g.peak_bf16_tflops,
+                        "ici_link_gbps": g.ici_link_gbps,
+                        "accelerator_types": list(g.accelerator_types),
+                    }
+                    for name, g in TPU_GENERATIONS.items()
+                },
+            }
+        )
+
     async def healthz(self, request: web.Request) -> web.Response:
         health = self.service.source_health()
         return web.json_response(
@@ -356,6 +395,7 @@ class DashboardServer:
         app.router.add_post("/api/select", self.select)
         app.router.add_post("/api/style", self.style)
         app.router.add_get("/api/timings", self.timings)
+        app.router.add_get("/api/schema", self.schema)
         app.router.add_post("/api/profile", self.profile)
         app.router.add_get("/api/history", self.history)
         app.router.add_get("/api/alerts", self.alerts)

@@ -15,18 +15,7 @@ import math
 from tpudash.schema import ChipKey, Sample
 
 #: HELP strings for known series (unknown series get a generic line).
-_HELP: dict[str, str] = {
-    "tpu_tensorcore_utilization": "TensorCore duty cycle percent [0,100]",
-    "tpu_hbm_used_bytes": "High-bandwidth memory used, bytes",
-    "tpu_hbm_total_bytes": "High-bandwidth memory capacity, bytes",
-    "tpu_ici_tx_bytes_per_second": "Inter-chip interconnect transmit rate",
-    "tpu_ici_rx_bytes_per_second": "Inter-chip interconnect receive rate",
-    "tpu_dcn_tx_bytes_per_second": "Cross-slice network transmit rate",
-    "tpu_dcn_rx_bytes_per_second": "Cross-slice network receive rate",
-    "tpu_temperature_celsius": "Package temperature, degrees Celsius",
-    "tpu_power_watts": "Board power draw, watts",
-    "tpu_hbm_bandwidth_gbps": "Achieved HBM streaming bandwidth, GB/s",
-}
+from tpudash.schema import SERIES_HELP as _HELP  # single source of truth
 
 
 def _escape_label_value(v: str) -> str:

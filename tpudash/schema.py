@@ -56,6 +56,15 @@ HBM_USED_GIB = "hbm_used_gib"
 ICI_TOTAL_GBPS = "ici_total_gbps"
 DCN_TOTAL_GBPS = "dcn_total_gbps"
 
+#: Every derived column normalize.py can add — the canonical list the
+#: /api/schema endpoint publishes (add new derivations HERE too).
+DERIVED_COLUMNS: tuple[str, ...] = (
+    HBM_USAGE_RATIO,
+    HBM_USED_GIB,
+    ICI_TOTAL_GBPS,
+    DCN_TOTAL_GBPS,
+)
+
 #: Pseudo-metric column carrying the device model string through the wide
 #: table — the reference smuggles ``card_model`` the same way (app.py:191-201).
 ACCEL_TYPE = "accelerator_type"
@@ -321,6 +330,21 @@ PANELS: tuple[PanelSpec, ...] = (
 #: Achieved HBM streaming bandwidth, GB/s — emitted by the on-chip probe
 #: source (tpudash.sources.probe), not by cluster exporters.
 HBM_BANDWIDTH = "tpu_hbm_bandwidth_gbps"
+
+#: Human help text per series — exporter HELP lines and /api/schema both
+#: read this (single source of truth).
+SERIES_HELP: dict[str, str] = {
+    TENSORCORE_UTIL: "TensorCore duty cycle percent [0,100]",
+    HBM_USED: "High-bandwidth memory used, bytes",
+    HBM_TOTAL: "High-bandwidth memory capacity, bytes",
+    ICI_TX: "Inter-chip interconnect transmit rate",
+    ICI_RX: "Inter-chip interconnect receive rate",
+    DCN_TX: "Cross-slice network transmit rate",
+    DCN_RX: "Cross-slice network receive rate",
+    TEMPERATURE: "Package temperature, degrees Celsius",
+    POWER: "Board power draw, watts",
+    HBM_BANDWIDTH: "Achieved HBM streaming bandwidth, GB/s",
+}
 
 #: Extra TPU-native panels (beyond the reference's four) shown when the
 #: source provides the series: aggregate ICI/DCN bandwidth and probe-mode
