@@ -14,8 +14,9 @@ def test_render_table_contents():
     assert any("slice-0/0" in ln for ln in lines)
     assert any(ln.startswith("mean") for ln in lines)
     assert any(ln.startswith("max") for ln in lines)
-    # 4 chips + 3 stats + header/separators
-    assert len(lines) == 2 + 4 + 1 + 3
+    assert any(ln.startswith("p95") for ln in lines)
+    # 4 chips + 5 stats (mean/p50/p95/max/min) + header/separators
+    assert len(lines) == 2 + 4 + 1 + 5
 
 
 def test_render_table_multislice_includes_dcn():

@@ -113,6 +113,9 @@ def test_stats_mean_max_min():
     assert u["mean"] == pytest.approx(51.75)
     assert u["max"] == 62.5
     assert u["min"] == 41.0
+    # fleet-scale percentiles (linear interpolation over {41.0, 62.5})
+    assert u["p50"] == pytest.approx(51.75)
+    assert u["p95"] == pytest.approx(41.0 + 0.95 * 21.5)
     assert schema.ACCEL_TYPE not in stats
 
 

@@ -264,10 +264,15 @@ function renderStats(stats) {
   const el = document.getElementById('stats');
   const metrics = Object.keys(stats);
   if (!metrics.length) { el.innerHTML = '<em>no data</em>'; return; }
-  let html = '<table><tr><th>metric</th><th>mean</th><th>max</th><th>min</th></tr>';
+  // mean/max/min = reference parity; p50/p95 = fleet-scale additions
+  const keys = ['mean', 'p50', 'p95', 'max', 'min']
+    .filter(k => k in (stats[metrics[0]] || {}));
+  let html = '<table><tr><th>metric</th>' +
+    keys.map(k => `<th>${k}</th>`).join('') + '</tr>';
   for (const m of metrics) {
     const s = stats[m];
-    html += `<tr><td>${esc(m)}</td><td>${+s.mean}</td><td>${+s.max}</td><td>${+s.min}</td></tr>`;
+    html += `<tr><td>${esc(m)}</td>` +
+      keys.map(k => `<td>${k in s ? +s[k] : '—'}</td>`).join('') + '</tr>';
   }
   el.innerHTML = html + '</table>';
 }

@@ -335,7 +335,7 @@ class DashboardServer:
                     for name in (*s.SCRAPE_SERIES, s.HBM_BANDWIDTH)
                 ],
                 "derived_columns": list(s.DERIVED_COLUMNS),
-                "identity_columns": ["slice_id", "host", "chip_id", s.ACCEL_TYPE],
+                "identity_columns": list(s.IDENTITY_COLUMNS),
                 "panels": [
                     {
                         "column": p.column,

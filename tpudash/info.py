@@ -45,7 +45,7 @@ def render_table(df, stats) -> str:
             v = row.get(c)
             cells.append("-" if v is None or v != v else fmt.format(v))
         rows.append(cells)
-    for stat in ("mean", "max", "min"):
+    for stat in ("mean", "p50", "p95", "max", "min"):
         cells = [stat, ""]
         for c, _, fmt in cols:
             s = stats.get(c)

@@ -168,7 +168,7 @@ def numeric_columns(df: pd.DataFrame) -> list[str]:
     """Metric columns eligible for stats — excludes identity and
     pseudo-metric columns (the reference excludes card_model,
     app.py:216-221)."""
-    skip = set(schema.NON_NUMERIC_COLUMNS) | {"slice_id", "host", "chip_id"}
+    skip = set(schema.NON_NUMERIC_COLUMNS) | set(schema.IDENTITY_COLUMNS)
     return [c for c in df.columns if c not in skip]
 
 
@@ -184,8 +184,10 @@ def _dense_block(df: pd.DataFrame, cols: list[str]) -> "np.ndarray | None":
 
 
 def compute_stats(df: pd.DataFrame) -> dict:
-    """{metric: {"mean": .., "max": .., "min": ..}} over numeric columns
-    (reference app.py:216-221; display rounds to 2 dp at app.py:480-481 —
+    """{metric: {"mean", "max", "min", "p50", "p95"}} over numeric columns
+    (mean/max/min are reference parity, app.py:216-221; the percentiles
+    are the fleet-scale addition — at 256 chips a max hides whether one
+    chip or forty are hot.  Display rounds to 2 dp at app.py:480-481 —
     rounding is presentation, so it lives in the app layer)."""
     cols = numeric_columns(df)
     arr = _dense_block(df, cols)
@@ -198,11 +200,14 @@ def compute_stats(df: pd.DataFrame) -> dict:
                 mean = np.nanmean(arr, axis=0)
                 mx = np.nanmax(arr, axis=0)
                 mn = np.nanmin(arr, axis=0)
+        pcts = _nan_percentiles(arr, count, (0.5, 0.95))
         return {
             c: {
                 "mean": float(mean[i]),
                 "max": float(mx[i]),
                 "min": float(mn[i]),
+                "p50": float(pcts[0, i]),
+                "p95": float(pcts[1, i]),
             }
             for i, c in enumerate(cols)
             if count[i] > 0
@@ -216,8 +221,33 @@ def compute_stats(df: pd.DataFrame) -> dict:
             "mean": float(series.mean()),
             "max": float(series.max()),
             "min": float(series.min()),
+            "p50": float(series.quantile(0.5)),
+            "p95": float(series.quantile(0.95)),
         }
     return stats
+
+
+def _nan_percentiles(
+    arr: np.ndarray, count: np.ndarray, qs: tuple
+) -> np.ndarray:
+    """NaN-aware per-column percentiles, fully vectorized: one C-level
+    sort (NaNs sort last) + take_along_axis interpolation.  numpy's own
+    nanpercentile falls back to a per-column apply_along_axis Python loop
+    whenever any NaN is present — which a mixed-source fleet frame always
+    has — and that would negate the native stats kernel on the hot path.
+    Returns (len(qs), ncols); columns with count==0 yield NaN."""
+    order = np.sort(arr, axis=0)  # NaNs last → first `count` are valid
+    n = np.maximum(count, 1).astype(np.float64)
+    out = np.empty((len(qs), arr.shape[1]))
+    for qi, q in enumerate(qs):
+        pos = (n - 1.0) * q
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.ceil(pos).astype(np.int64)
+        frac = pos - lo
+        v_lo = np.take_along_axis(order, lo[None, :], axis=0)[0]
+        v_hi = np.take_along_axis(order, hi[None, :], axis=0)[0]
+        out[qi] = np.where(count > 0, v_lo * (1.0 - frac) + v_hi * frac, np.nan)
+    return out
 
 
 @contextlib.contextmanager

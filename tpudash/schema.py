@@ -73,6 +73,10 @@ ACCEL_TYPE = "accelerator_type"
 #: card_model).
 NON_NUMERIC_COLUMNS: tuple[str, ...] = (ACCEL_TYPE,)
 
+#: Row-identity columns of the wide table — the canonical list shared by
+#: stats exclusion (normalize.numeric_columns) and /api/schema.
+IDENTITY_COLUMNS: tuple[str, ...] = ("slice_id", "host", "chip_id", ACCEL_TYPE)
+
 #: Metrics whose zero values mean "idle/parked" and are excluded from
 #: averages (reference's zero-exclusion power averaging, app.py:341-345).
 ZERO_EXCLUDED_METRICS: tuple[str, ...] = (POWER,)
