@@ -1,0 +1,227 @@
+"""Real-world metric-name compatibility (tpudash.compat).
+
+The reference consumed a real exporter's real series names and labels
+(``amd_gpu_*`` + gpu_id/card_model, reference app.py:167-201).  These tests
+prove tpudash does the same for the real TPU scrape surfaces — the GKE
+tpu-device-plugin metrics server and libtpu runtime metrics — using
+fixtures captured in their actual response shapes, with zero configuration.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpudash import compat, native, schema
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.exporter.textfmt import parse_text_format
+from tpudash.normalize import to_wide
+from tpudash.registry import resolve_generation
+from tpudash.sources.base import parse_instant_query, parse_text_bytes
+from tpudash.sources.fixture import FixtureSource
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+GKE_JSON = os.path.join(FIXTURES, "gke_device_plugin_instant.json")
+GKE_TEXT = os.path.join(FIXTURES, "gke_device_plugin_metrics.txt")
+LIBTPU_JSON = os.path.join(FIXTURES, "libtpu_monitoring_instant.json")
+
+
+# --- unit: alias + accelerator_id rules -------------------------------------
+
+def test_canonical_series_known_aliases():
+    assert compat.canonical_series("duty_cycle") == schema.TENSORCORE_UTIL
+    assert compat.canonical_series("memory_used") == schema.HBM_USED
+    assert compat.canonical_series("memory_total") == schema.HBM_TOTAL
+    assert compat.canonical_series("tensorcore_utilization") == schema.MXU_UTIL
+    assert (
+        compat.canonical_series("memory_bandwidth_utilization")
+        == schema.MEMBW_UTIL
+    )
+    # libtpu dotted ids and their Prometheus-sanitized forms
+    assert (
+        compat.canonical_series("tpu.runtime.tensorcore.dutycycle.percent")
+        == schema.TENSORCORE_UTIL
+    )
+    assert (
+        compat.canonical_series("tpu_runtime_hbm_memory_usage_bytes")
+        == schema.HBM_USED
+    )
+    # monitoring-library short ids
+    assert compat.canonical_series("duty_cycle_pct") == schema.TENSORCORE_UTIL
+    assert compat.canonical_series("hbm_capacity_total") == schema.HBM_TOTAL
+    # unknown names pass through untouched
+    assert compat.canonical_series("tpu_power_watts") == "tpu_power_watts"
+    assert compat.canonical_series("something_else") == "something_else"
+
+
+def test_split_accelerator_id():
+    assert compat.split_accelerator_id("4804027577389733510-3") == (
+        "4804027577389733510",
+        3,
+    )
+    assert compat.split_accelerator_id("a-b-12") == ("a-b", 12)
+    assert compat.split_accelerator_id("7") == ("", 7)
+    assert compat.split_accelerator_id("-5") == ("", 5)
+    assert compat.split_accelerator_id("board-") is None
+    assert compat.split_accelerator_id("board-x") is None
+    assert compat.split_accelerator_id("") is None
+    assert compat.split_accelerator_id("board-1_5") is None  # strtoll parity
+    assert compat.split_accelerator_id("board-99999999999999999999") is None
+
+
+def test_resolve_identity_fallback_chains():
+    # GKE device-plugin labels: accelerator_id prefix becomes the slice,
+    # node becomes the host, model becomes the accelerator type
+    ident = compat.resolve_identity(
+        {
+            "accelerator_id": "1234-2",
+            "node": "gke-node-1",
+            "instance": "10.0.0.1:2112",
+            "model": "tpu-v5-lite-podslice",
+        },
+        "slice-0",
+    )
+    assert ident == ("1234", "gke-node-1", 2, "tpu-v5-lite-podslice")
+    # explicit slice label beats the prefix hint
+    ident = compat.resolve_identity(
+        {"accelerator_id": "1234-2", "slice": "pod-a"}, "slice-0"
+    )
+    assert ident == ("pod-a", "", 2, "")
+    # canonical chip_id label wins over accelerator_id
+    ident = compat.resolve_identity(
+        {"chip_id": "9", "accelerator_id": "1234-2"}, "slice-0"
+    )
+    assert ident == ("slice-0", "", 9, "")
+    # unparseable chip_id skips the series even with accelerator_id present
+    assert (
+        compat.resolve_identity(
+            {"chip_id": "bad", "accelerator_id": "1234-2"}, "s"
+        )
+        is None
+    )
+
+
+# --- GKE device-plugin JSON fixture -----------------------------------------
+
+def test_gke_instant_fixture_parses_canonically():
+    with open(GKE_JSON, "rb") as f:
+        payload = json.load(f)
+    samples = parse_instant_query(payload)
+    df = to_wide(samples)
+    # 2 nodes x 4 chips, grouped per board id (the accelerator_id prefix)
+    assert len(df) == 8
+    assert sorted(set(df["slice_id"])) == [
+        "4804027577389733510",
+        "6519083247719150387",
+    ]
+    assert sorted(set(df["chip_id"])) == [0, 1, 2, 3]
+    # hosts come from the GKE node label, not the scrape instance
+    assert set(df["host"]) == {
+        "gke-tpu-a31c5c8f-7wx2",
+        "gke-tpu-a31c5c8f-p9qd",
+    }
+    # foreign names landed on the canonical schema
+    for col in (
+        schema.TENSORCORE_UTIL,
+        schema.HBM_USED,
+        schema.HBM_TOTAL,
+        schema.MXU_UTIL,
+        schema.MEMBW_UTIL,
+        schema.HBM_USAGE_RATIO,  # derived: proves normalize sees the aliases
+    ):
+        assert col in df.columns, col
+    # model label resolves to a real generation → axis maxima work
+    gen = resolve_generation(df[schema.ACCEL_TYPE].iloc[0])
+    assert gen is not None and gen.name == "v5e"
+    # spot value: node 0 chip 0 duty_cycle
+    key = "4804027577389733510/0"
+    assert df.loc[key, schema.TENSORCORE_UTIL] == pytest.approx(87.5)
+    assert df.loc[key, schema.HBM_USAGE_RATIO] == pytest.approx(
+        11811160064 / 17179869184 * 100
+    )
+
+
+@pytest.mark.skipif(not native.is_available(), reason="no native kernel")
+def test_gke_instant_fixture_native_parity():
+    from test_native import assert_frames_equal
+
+    with open(GKE_JSON, "rb") as f:
+        raw = f.read()
+    df_py = to_wide(parse_instant_query(json.loads(raw)))
+    batch = native.parse_promjson(raw)
+    assert_frames_equal(batch, df_py)
+
+
+# --- GKE device-plugin exposition text ---------------------------------------
+
+def test_gke_text_fixture_parses_canonically():
+    with open(GKE_TEXT) as f:
+        text = f.read()
+    df = to_wide(parse_text_format(text))
+    assert len(df) == 4  # one node's 4 chips
+    assert set(df["slice_id"]) == {"4804027577389733510"}
+    assert schema.TENSORCORE_UTIL in df.columns
+    assert schema.MXU_UTIL in df.columns
+    assert df[schema.ACCEL_TYPE].iloc[0] == "tpu-v5-lite-podslice"
+
+
+@pytest.mark.skipif(not native.is_available(), reason="no native kernel")
+def test_gke_text_fixture_native_parity():
+    from test_native import assert_frames_equal
+
+    with open(GKE_TEXT, "rb") as f:
+        raw = f.read()
+    df_py = to_wide(parse_text_format(raw.decode()))
+    batch = native.parse_text(raw)
+    assert_frames_equal(batch, df_py)
+
+
+# --- libtpu runtime metrics ---------------------------------------------------
+
+def test_libtpu_fixture_parses_canonically():
+    with open(LIBTPU_JSON, "rb") as f:
+        payload = json.load(f)
+    df = to_wide(parse_instant_query(payload))
+    assert len(df) == 4
+    assert schema.TENSORCORE_UTIL in df.columns
+    assert schema.HBM_USAGE_RATIO in df.columns
+    gen = resolve_generation(df[schema.ACCEL_TYPE].iloc[0])
+    assert gen is not None and gen.name == "v4"
+    assert df[schema.TENSORCORE_UTIL].max() == pytest.approx(96.1)
+
+
+# --- the VERDICT "done" bar: realistic payload → full frame, zero config ------
+
+def test_gke_payload_renders_full_frame_zero_config():
+    cfg = Config(source="fixture", fixture_path=GKE_JSON)
+    service = DashboardService(cfg, FixtureSource(GKE_JSON))
+    frame = service.render_frame()
+    assert frame["error"] is None
+    assert len(frame["chips"]) == 8
+    # all four chips of board 0 + board 1 present with real models
+    assert all(c["model"] == "v5e" for c in frame["chips"])
+    # the default selection renders panels
+    assert frame["average"] is not None
+    panel_cols = {p["column"] for p in frame["panel_specs"]}
+    assert schema.TENSORCORE_UTIL in panel_cols
+    assert schema.HBM_USAGE_RATIO in panel_cols
+    assert schema.MXU_UTIL in panel_cols
+    assert schema.MEMBW_UTIL in panel_cols
+    # stats table covers the canonical columns (display contract)
+    service.state.select_all(service.available)
+    frame = service.render_frame()
+    assert frame["stats"], "stats table empty"
+    assert schema.TENSORCORE_UTIL in frame["stats"]
+    assert len(frame["device_rows"]) == 8  # 8 <= per-chip limit → rows
+
+
+def test_scrape_source_contract_with_gke_text(tmp_path):
+    """parse_text_bytes (the scrape source's parser) handles a raw
+    device-plugin /metrics body both with and without the native kernel."""
+    with open(GKE_TEXT, "rb") as f:
+        raw = f.read()
+    batch = parse_text_bytes(raw)
+    df = to_wide(batch)
+    assert len(df) == 4
+    assert schema.TENSORCORE_UTIL in df.columns

@@ -383,16 +383,34 @@ def test_service_frame_identical_python_vs_native(monkeypatch):
 def _fuzz_payload(rng):
     """Random instant-query payloads mixing valid, edge-case, and junk
     series — the adversarial surface both parsers must agree on."""
-    metrics = ["tpu_power_watts", "tpu_temperature_celsius", "m", "x_y"]
+    metrics = [
+        "tpu_power_watts", "tpu_temperature_celsius", "m", "x_y",
+        # foreign names exercising the compat alias map (tpudash.compat)
+        "duty_cycle", "memory_used", "memory_total",
+        "tensorcore_utilization", "duty_cycle_pct",
+        "tpu.runtime.hbm.memory.usage.bytes",
+    ]
     result = []
     for _ in range(rng.randrange(0, 25)):
         kind = rng.random()
         metric = {}
         if kind < 0.8:  # plausibly-valid series
             metric["__name__"] = rng.choice(metrics)
-            if rng.random() < 0.9:
+            if rng.random() < 0.7:
                 metric["chip_id"] = rng.choice(
                     ["0", "1", "7", "255", "-1", "12", "00", "bad", ""]
+                )
+            if rng.random() < 0.4:
+                metric["accelerator_id"] = rng.choice(
+                    ["4804027577389733510-0", "1234-3", "1234-1_5",
+                     "7", "-5", "board-", "board-x", "", "a-b-12",
+                     "board-99999999999999999999"]
+                )
+            if rng.random() < 0.3:
+                metric["node"] = rng.choice(["gke-n1", "gke-n2"])
+            if rng.random() < 0.3:
+                metric["model"] = rng.choice(
+                    ["tpu-v5-lite-podslice", "tpu-v4-podslice", ""]
                 )
             if rng.random() < 0.5:
                 metric["slice"] = rng.choice(["slice-0", "slice-1", "s"])

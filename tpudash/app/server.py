@@ -325,6 +325,7 @@ class DashboardServer:
         """Self-documenting API: every scraped series (with exporter help
         text), derived columns, panels, and generation registry — what a
         programmatic consumer needs to interpret /api/frame and the CSV."""
+        from tpudash import compat
         from tpudash import schema as s
         from tpudash.registry import TPU_GENERATIONS
 
@@ -332,8 +333,14 @@ class DashboardServer:
             {
                 "scrape_series": [
                     {"name": name, "help": s.SERIES_HELP.get(name, "")}
-                    for name in (*s.SCRAPE_SERIES, s.HBM_BANDWIDTH)
+                    for name in (
+                        *s.SCRAPE_SERIES, s.HBM_BANDWIDTH,
+                        s.MXU_UTIL, s.MEMBW_UTIL,
+                    )
                 ],
+                # real-world dialects accepted with zero config: GKE
+                # tpu-device-plugin + libtpu runtime metric names
+                "series_aliases": dict(sorted(compat.SERIES_ALIASES.items())),
                 "derived_columns": list(s.DERIVED_COLUMNS),
                 "identity_columns": list(s.IDENTITY_COLUMNS),
                 "panels": [

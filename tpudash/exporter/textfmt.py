@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import math
 
+from tpudash import compat
 from tpudash.schema import ChipKey, Sample
 
 #: HELP strings for known series (unknown series get a generic line).
@@ -112,25 +113,16 @@ def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]
             continue
         if not math.isfinite(value):
             continue
-        chip_label = labels.get("chip_id", labels.get("gpu_id"))
-        if chip_label is None:
+        ident = compat.resolve_identity(labels, default_slice)
+        if ident is None:
             continue
-        try:
-            chip_id = int(chip_label)
-        except ValueError:
-            continue
+        slice_id, host, chip_id, accel = ident
         samples.append(
             Sample(
-                metric=name,
+                metric=compat.canonical_series(name),
                 value=value,
-                chip=ChipKey(
-                    slice_id=labels.get("slice", default_slice),
-                    host=labels.get("host", labels.get("instance", "")),
-                    chip_id=chip_id,
-                ),
-                accelerator_type=labels.get(
-                    "accelerator", labels.get("card_model", "")
-                ),
+                chip=ChipKey(slice_id=slice_id, host=host, chip_id=chip_id),
+                accelerator_type=accel,
                 labels=labels,
             )
         )

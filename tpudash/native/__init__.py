@@ -28,6 +28,7 @@ log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "frame_kernel.cc")
+_INC = os.path.join(_DIR, "series_aliases.inc")
 _LIB = os.path.join(_DIR, "libtpudash_native.so")
 
 _lib: "ctypes.CDLL | None" = None
@@ -37,6 +38,26 @@ _tried = False
 class NativeParseError(ValueError):
     """Parse failure reported by the native kernel (message mirrors the
     Python parsers' error strings so callers can map it 1:1)."""
+
+
+def _ensure_inc() -> None:
+    """(Re)generate series_aliases.inc from tpudash.compat — the C++ alias
+    table stays in lock-step with the Python one; a content change bumps the
+    file's mtime, which triggers a rebuild in load()."""
+    from tpudash import compat
+
+    content = compat.native_alias_table()
+    try:
+        with open(_INC) as f:
+            if f.read() == content:
+                return
+    except OSError:
+        pass
+    try:
+        with open(_INC, "w") as f:
+            f.write(content)
+    except OSError as e:  # pragma: no cover - read-only install
+        log.warning("cannot write %s: %s", _INC, e)
 
 
 def _build() -> bool:
@@ -50,7 +71,8 @@ def _build() -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
         proc = subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC],
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+             f"-I{_DIR}", "-o", tmp, _SRC],
             capture_output=True, text=True, timeout=120,
         )
         if proc.returncode != 0:
@@ -112,9 +134,10 @@ def load() -> "ctypes.CDLL | None":
     _tried = True
     if os.environ.get("TPUDASH_NATIVE", "").strip() == "0":
         return None
-    needs_build = not os.path.exists(_LIB) or (
-        os.path.exists(_SRC)
-        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    _ensure_inc()
+    needs_build = not os.path.exists(_LIB) or any(
+        os.path.exists(p) and os.path.getmtime(p) > os.path.getmtime(_LIB)
+        for p in (_SRC, _INC)
     )
     if needs_build and not _build():
         return None

@@ -169,6 +169,36 @@ bool parse_full_int(const std::string& s, int64_t* out) {
   return true;
 }
 
+// Real-world series-name aliases (GKE tpu-device-plugin, libtpu runtime
+// metrics) — the table is generated from tpudash.compat.SERIES_ALIASES at
+// build time so the C++ and Python parsers cannot drift.
+#include "series_aliases.inc"
+
+const std::string* canonical_series(const std::string& name) {
+  static const std::unordered_map<std::string, std::string>* kMap = [] {
+    auto* m = new std::unordered_map<std::string, std::string>();
+    for (const auto& a : kSeriesAliases) (*m)[a.from] = a.to;
+    return m;
+  }();
+  auto it = kMap->find(name);
+  return it == kMap->end() ? nullptr : &it->second;
+}
+
+// "<board-id>-<chip-index>" → (board prefix, chip index); bare integers map
+// to ("", chip).  Exact mirror of tpudash.compat.split_accelerator_id.
+bool split_accelerator_id(const std::string& v, std::string* prefix,
+                          int64_t* chip) {
+  size_t pos = v.rfind('-');
+  if (pos == std::string::npos) {
+    if (!parse_full_int(v, chip)) return false;
+    prefix->clear();
+    return true;
+  }
+  if (!parse_full_int(v.substr(pos + 1), chip)) return false;
+  *prefix = v.substr(0, pos);
+  return true;
+}
+
 // ---------------------------------------------------------------------------
 // Prometheus exposition text (exporter/textfmt.py parse_text_format parity)
 // ---------------------------------------------------------------------------
@@ -275,19 +305,31 @@ TdFrame* parse_text_impl(const char* text, int64_t len,
     if (!std::isfinite(value)) continue;
     const std::string* chip_label = find_label(labels, "chip_id");
     if (chip_label == nullptr) chip_label = find_label(labels, "gpu_id");
-    if (chip_label == nullptr) continue;
     int64_t chip_id;
-    if (!parse_full_int(*chip_label, &chip_id)) continue;
+    std::string slice_hint;
+    bool have_hint = false;
+    if (chip_label != nullptr) {
+      if (!parse_full_int(*chip_label, &chip_id)) continue;
+    } else {
+      const std::string* accel_id = find_label(labels, "accelerator_id");
+      if (accel_id == nullptr) continue;
+      if (!split_accelerator_id(*accel_id, &slice_hint, &chip_id)) continue;
+      have_hint = !slice_hint.empty();
+    }
     const std::string* slice = find_label(labels, "slice");
     const std::string* host = find_label(labels, "host");
+    if (host == nullptr) host = find_label(labels, "node");
     if (host == nullptr) host = find_label(labels, "instance");
     const std::string* accel = find_label(labels, "accelerator");
     if (accel == nullptr) accel = find_label(labels, "card_model");
+    if (accel == nullptr) accel = find_label(labels, "model");
     static const std::string kEmpty;
-    int32_t row = b.chip(slice ? *slice : default_slice,
-                         host ? *host : kEmpty, chip_id);
+    int32_t row =
+        b.chip(slice ? *slice : (have_hint ? slice_hint : default_slice),
+               host ? *host : kEmpty, chip_id);
     if (accel != nullptr) b.set_accel(row, *accel);
-    b.add(row, b.metric(name), value);
+    const std::string* canon = canonical_series(name);
+    b.add(row, b.metric(canon ? *canon : name), value);
   }
   return b.finish();
 }
@@ -516,9 +558,11 @@ struct JParser {
 // Labels parse_instant_query reads from each result's "metric" object.
 struct MetricLabels {
   std::string name, chip_id, gpu_id, slice, host, instance, accel, card_model;
+  std::string accelerator_id, node, model;
   bool has_chip_id = false, has_gpu_id = false, has_slice = false,
        has_host = false, has_instance = false, has_accel = false,
-       has_card_model = false;
+       has_card_model = false, has_accelerator_id = false, has_node = false,
+       has_model = false;
 };
 
 bool parse_metric_obj(JParser& jp, MetricLabels* m) {
@@ -557,6 +601,15 @@ bool parse_metric_obj(JParser& jp, MetricLabels* m) {
     } else if (key == "card_model") {
       dst = &m->card_model;
       flag = &m->has_card_model;
+    } else if (key == "accelerator_id") {
+      dst = &m->accelerator_id;
+      flag = &m->has_accelerator_id;
+    } else if (key == "node") {
+      dst = &m->node;
+      flag = &m->has_node;
+    } else if (key == "model") {
+      dst = &m->model;
+      flag = &m->has_model;
     }
     if (dst != nullptr) {
       jp.ws();
@@ -740,27 +793,41 @@ TdFrame* parse_promjson_impl(const char* text, int64_t len,
                   // emit sample (tolerant per-series skipping)
                   do {
                     if (m.name.empty() || !have_val) break;
-                    const std::string* chip_label = nullptr;
-                    if (m.has_chip_id)
-                      chip_label = &m.chip_id;
-                    else if (m.has_gpu_id)
-                      chip_label = &m.gpu_id;
-                    else
-                      break;
                     int64_t chip_id;
-                    if (!parse_full_int(*chip_label, &chip_id)) break;
+                    std::string slice_hint;
+                    bool have_hint = false;
+                    if (m.has_chip_id || m.has_gpu_id) {
+                      const std::string& chip_label =
+                          m.has_chip_id ? m.chip_id : m.gpu_id;
+                      if (!parse_full_int(chip_label, &chip_id)) break;
+                    } else if (m.has_accelerator_id) {
+                      if (!split_accelerator_id(m.accelerator_id, &slice_hint,
+                                                &chip_id))
+                        break;
+                      have_hint = !slice_hint.empty();
+                    } else {
+                      break;
+                    }
                     const std::string& slice =
-                        m.has_slice ? m.slice : default_slice;
+                        m.has_slice ? m.slice
+                                    : (have_hint ? slice_hint : default_slice);
                     static const std::string kEmpty;
                     const std::string& host =
-                        m.has_host ? m.host
-                                   : (m.has_instance ? m.instance : kEmpty);
+                        m.has_host
+                            ? m.host
+                            : (m.has_node
+                                   ? m.node
+                                   : (m.has_instance ? m.instance : kEmpty));
                     int32_t row = b.chip(slice, host, chip_id);
                     const std::string& accel =
-                        m.has_accel ? m.accel
-                                    : (m.has_card_model ? m.card_model : kEmpty);
+                        m.has_accel
+                            ? m.accel
+                            : (m.has_card_model
+                                   ? m.card_model
+                                   : (m.has_model ? m.model : kEmpty));
                     b.set_accel(row, accel);
-                    b.add(row, b.metric(m.name), val);
+                    const std::string* canon = canonical_series(m.name);
+                    b.add(row, b.metric(canon ? *canon : m.name), val);
                   } while (false);
                   jp.ws();
                   if (jp.p < jp.end && *jp.p == ',') {

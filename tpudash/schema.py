@@ -33,6 +33,13 @@ DCN_RX = "tpu_dcn_rx_bytes_per_second"
 #: them; the probe/synthetic sources always do).
 TEMPERATURE = "tpu_temperature_celsius"
 POWER = "tpu_power_watts"
+#: MXU (matrix-unit) utilization percent — the GKE device-plugin's
+#: ``tensorcore_utilization`` series (distinct from the duty cycle: FLOPs
+#: achieved vs time-busy).  Arrives via the compat alias map only.
+MXU_UTIL = "tpu_mxu_utilization"
+#: HBM bandwidth utilization percent — the GKE device-plugin's
+#: ``memory_bandwidth_utilization`` series, via the compat alias map.
+MEMBW_UTIL = "tpu_membw_utilization"
 
 #: The scrape set — role of the reference's 5-series regex (app.py:169-170).
 SCRAPE_SERIES: tuple[str, ...] = (
@@ -348,6 +355,8 @@ SERIES_HELP: dict[str, str] = {
     TEMPERATURE: "Package temperature, degrees Celsius",
     POWER: "Board power draw, watts",
     HBM_BANDWIDTH: "Achieved HBM streaming bandwidth, GB/s",
+    MXU_UTIL: "MXU (matrix unit) utilization percent [0,100]",
+    MEMBW_UTIL: "HBM bandwidth utilization percent [0,100]",
 }
 
 #: Extra TPU-native panels (beyond the reference's four) shown when the
@@ -357,4 +366,6 @@ EXTRA_PANELS: tuple[PanelSpec, ...] = (
     PanelSpec("ICI Bandwidth (GB/s)", ICI_TOTAL_GBPS, "ici", 200.0, "GB/s"),
     PanelSpec("DCN Bandwidth (GB/s)", DCN_TOTAL_GBPS, "fixed", 50.0, "GB/s"),
     PanelSpec("HBM Bandwidth (GB/s)", HBM_BANDWIDTH, "hbm_bw", 1000.0, "GB/s"),
+    PanelSpec("MXU Utilization (%)", MXU_UTIL, "fixed", 100.0, "%"),
+    PanelSpec("HBM BW Utilization (%)", MEMBW_UTIL, "fixed", 100.0, "%"),
 )

@@ -16,7 +16,7 @@ from __future__ import annotations
 import abc
 import json
 
-from tpudash import native
+from tpudash import compat, native
 from tpudash.schema import ChipKey, Sample, SampleBatch
 
 
@@ -89,33 +89,24 @@ def _series_identity(
     → (series name, interned ChipKey, accelerator type), or None when the
     series lacks a name or parseable chip id (skip it, don't fail the
     scrape).  TPU-native labels win; the reference exporter's gpu_id /
-    card_model / instance shapes are accepted as fallbacks (app.py:183-201)."""
+    card_model / instance shapes (app.py:183-201) and the real GKE
+    tpu-device-plugin / libtpu shapes (tpudash.compat) are accepted as
+    fallbacks, with foreign series names alias-resolved to the canonical
+    schema."""
     name = metric.get("__name__")
     if not name:
         return None
-    chip_label = metric.get("chip_id")
-    if chip_label is None:
-        chip_label = metric.get("gpu_id")
-        if chip_label is None:
-            return None
-    try:
-        chip_id = int(chip_label)
-    except (TypeError, ValueError):
+    ident = compat.resolve_identity(metric, default_slice)
+    if ident is None:
         return None
-    slice_id = metric.get("slice", default_slice)
-    host = metric.get("host")
-    if host is None:
-        host = metric.get("instance", "")
+    slice_id, host, chip_id, accel = ident
     ckey = (slice_id, host, chip_id)
     chip = chip_cache.get(ckey)
     if chip is None:
         chip = chip_cache[ckey] = ChipKey(
             slice_id=slice_id, host=host, chip_id=chip_id
         )
-    accel = metric.get("accelerator")
-    if accel is None:
-        accel = metric.get("card_model", "")
-    return name, chip, accel
+    return compat.canonical_series(name), chip, accel
 
 
 def parse_range_query(
