@@ -113,3 +113,38 @@ def test_no_webhook_configured_skips_requests(posts):
 def test_env_knob():
     cfg = load_config({"TPUDASH_ALERT_WEBHOOK": "http://x/h"})
     assert cfg.alert_webhook == "http://x/h"
+
+
+def test_flush_waits_for_all_inflight_deliveries(monkeypatch):
+    # two transitions back-to-back spawn two delivery threads; flushing
+    # must wait for BOTH, not just the most recent one
+    import threading
+
+    import requests
+
+    release = threading.Event()
+    delivered = []
+
+    def slow_post(url, json=None, timeout=None):
+        release.wait(5)
+        delivered.append(json)
+
+        class R:
+            def raise_for_status(self):
+                pass
+
+        return R()
+
+    monkeypatch.setattr(requests, "post", slow_post)
+    src = _TempSource()
+    svc = _svc(src)
+    src.temp = 95.0
+    svc.render_frame()
+    svc.render_frame()  # firing edge → delivery 1 (blocked on the event)
+    src.temp = 50.0
+    svc.render_frame()  # resolved edge → delivery 2 (blocked too)
+    assert len(svc._webhook_threads) == 2
+    release.set()
+    svc.flush_webhooks()
+    assert len(delivered) == 2
+    assert svc._webhook_threads == set()

@@ -40,3 +40,43 @@ def test_demo_end_to_end(monkeypatch):
                 await runner.cleanup()
 
     asyncio.run(go())
+
+
+def test_demo_cleans_up_both_runners_when_dashboard_port_taken(monkeypatch):
+    # TCPSite.start() fails for the dashboard AFTER its runner setup: both
+    # the dash runner and the already-listening exporter must be cleaned
+    import socket
+
+    import pytest
+    from aiohttp import web
+
+    monkeypatch.setenv("TPUDASH_DEMO_SOURCE", "synthetic")
+    cleaned = []
+    orig_cleanup = web.AppRunner.cleanup
+
+    async def spy(self):
+        cleaned.append(self)
+        return await orig_cleanup(self)
+
+    monkeypatch.setattr(web.AppRunner, "cleanup", spy)
+
+    async def go():
+        blocker = socket.socket()
+        try:
+            blocker.bind(("127.0.0.1", 19515))
+            blocker.listen(1)
+            cfg = Config(
+                host="127.0.0.1", port=19515, exporter_port=19514,
+                synthetic_chips=2, refresh_interval=0.0,
+            )
+            with pytest.raises(OSError):
+                await start_demo(cfg)
+        finally:
+            blocker.close()
+        assert len(cleaned) == 2  # exporter runner AND dash runner
+        # the exporter socket is actually released, not leaked
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 19514))
+        probe.close()
+
+    asyncio.run(go())

@@ -157,3 +157,40 @@ def test_frame_health_goes_down_after_streak():
     frame = svc.render_frame()
     assert frame["error"] is None
     assert frame["source_health"]["status"] == "healthy"
+
+
+class BuggySource(MetricsSource):
+    """Raises a non-SourceError — a parser/wrapper bug, not a scrape fault."""
+
+    name = "buggy"
+
+    def fetch(self):
+        raise TypeError("labels must be a mapping")
+
+
+def test_unexpected_exception_counts_against_health():
+    # a crashing source must not report "healthy" forever: the bug is NOT
+    # retried (it isn't transient) but the ledger records the failure
+    sleeps = []
+    import pytest
+
+    src = ResilientSource(BuggySource(), RetryPolicy(retries=3), sleep=sleeps.append)
+    for n in range(1, 4):
+        with pytest.raises(TypeError):
+            src.fetch()
+        assert src.health.total_failures == n
+        assert src.health.consecutive_failures == n
+    assert sleeps == []  # no retry/backoff for non-transient bugs
+    assert src.health.status == "down"
+
+
+def test_health_snapshot_restore_rolls_back_counters():
+    src, _ = _resilient(fail_times=1, retries=2)
+    src.fetch()
+    snap = src.health.snapshot()
+    before = src.health.summary()
+    for _ in range(5):
+        src.fetch()
+    assert src.health.summary() != before
+    src.health.restore(snap)
+    assert src.health.summary() == before

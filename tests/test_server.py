@@ -250,6 +250,39 @@ def test_profile_does_not_advance_alert_hysteresis():
     _run(_with_client(app, go))
 
 
+def test_profile_does_not_pollute_recording_health_or_history(tmp_path):
+    # profiled renders are synthetic load: the recording file, the health
+    # ledger, and the trend history must all come out exactly as they went in
+    from tpudash.sources import make_source
+
+    record = tmp_path / "rec.jsonl"
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        record_path=str(record), fetch_retries=2,
+    )
+    source = make_source(cfg)  # ResilientSource(RecordingSource(Fixture))
+    service = DashboardService(cfg, source)
+    app = DashboardServer(service).build_app()
+
+    async def go(client):
+        await client.get("/api/frame")  # one real cycle
+        lines_before = record.read_text().count("\n")
+        health_before = source.health.summary()
+        history_before = list(service.history)
+        assert lines_before == 1 and health_before["total_fetches"] == 1
+        resp = await client.post("/api/profile", json={"frames": 20})
+        assert (await resp.json())["frames"] == 20
+        assert record.read_text().count("\n") == lines_before
+        assert source.health.summary() == health_before
+        assert list(service.history) == history_before
+        # and the wrappers resume normally after the profile
+        await client.post("/api/select", json={"all": True})  # forces a frame
+        assert record.read_text().count("\n") > lines_before
+        assert source.health.summary()["total_fetches"] > 1
+
+    _run(_with_client(app, go))
+
+
 def test_auth_token_gates_everything_but_healthz():
     cfg = Config(
         source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
@@ -268,9 +301,13 @@ def test_auth_token_gates_everything_but_healthz():
             "/api/frame", headers={"Authorization": "Bearer s3cret"}
         )
         assert ok.status == 200
-        # query param works (EventSource transport)
+        # query param works ONLY on /api/stream (EventSource can't set
+        # headers); everywhere else it must 401 — query strings leak into
+        # access logs and browser history
         assert (await client.get("/api/stream?token=s3cret")).status == 200
+        assert (await client.get("/api/frame?token=s3cret")).status == 401
         assert (await client.get("/api/frame?token=wrong")).status == 401
+        assert (await client.get("/api/stream?token=wrong")).status == 401
 
     _run(_with_client(_client_app(cfg), go))
 
@@ -353,3 +390,34 @@ def test_alerts_endpoint():
         assert data["alerts"][0]["state"] == "firing"
 
     _run(_with_client(_client_app(cfg=cfg), go))
+
+
+def test_profile_preserves_outage_error_state():
+    # /healthz serves last_error: a synthetic render that succeeds mid-outage
+    # must not clear the real outage banner (and vice versa)
+    class Flaky(FixtureSource):
+        fail = False
+
+        def fetch(self):
+            from tpudash.sources.base import SourceError
+
+            if self.fail:
+                raise SourceError("real outage")
+            return super().fetch()
+
+    src = Flaky(FIXTURE)
+    cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=0.0)
+    service = DashboardService(cfg, src)
+    app = DashboardServer(service).build_app()
+
+    async def go(client):
+        src.fail = True
+        await client.get("/api/frame")
+        assert service.last_error is not None
+        src.fail = False  # profiled renders would succeed...
+        await client.post("/api/profile", json={"frames": 3})
+        assert service.last_error is not None  # ...but the outage stands
+        health = await (await client.get("/healthz")).json()
+        assert "real outage" in health["error"]
+
+    _run(_with_client(app, go))

@@ -175,17 +175,25 @@ function renderFigure(el, fig) {
 
 // ---- state + API ----------------------------------------------------------
 // auth: when the server runs with TPUDASH_AUTH_TOKEN, the operator opens
-// the page as /?token=...; forward it on every call (EventSource cannot
-// set an Authorization header, so the query param is the transport)
+// the page as /?token=....  fetch() calls carry it as an Authorization
+// header; ONLY the EventSource stream uses the query param (EventSource
+// cannot set headers, and the server accepts ?token= on /api/stream alone
+// so the secret stays out of access logs for every other route).
 const TOKEN = new URLSearchParams(location.search).get('token');
-function api(url) {
+function streamUrl(url) {
   if (!TOKEN) return url;
   return url + (url.includes('?') ? '&' : '?') + 'token=' + encodeURIComponent(TOKEN);
 }
+function authHeaders(extra) {
+  const h = Object.assign({}, extra || {});
+  if (TOKEN) h['Authorization'] = 'Bearer ' + TOKEN;
+  return h;
+}
 
 async function post(url, body) {
-  await fetch(api(url), {method: 'POST', headers: {'Content-Type': 'application/json'},
-                         body: JSON.stringify(body)});
+  await fetch(url, {method: 'POST',
+                    headers: authHeaders({'Content-Type': 'application/json'}),
+                    body: JSON.stringify(body)});
   await refresh();
 }
 
@@ -280,7 +288,7 @@ function renderStats(stats) {
 async function refresh() {
   let frame;
   try {
-    frame = await (await fetch(api('/api/frame'))).json();
+    frame = await (await fetch('/api/frame', {headers: authHeaders()})).json();
   } catch (e) {
     showError('Dashboard server unreachable: ' + e);
     if (!streaming && !timer) timer = setInterval(refresh, 5000);  // keep retrying
@@ -319,7 +327,7 @@ function applyFrame(frame) {
 // ---- transport: SSE push with polling fallback ----------------------------
 function startStream() {
   if (!window.EventSource) return;  // old browser → polling stays active
-  const es = new EventSource(api('/api/stream'));
+  const es = new EventSource(streamUrl('/api/stream'));
   es.onmessage = e => {
     streaming = true;
     if (timer) { clearInterval(timer); timer = null; }
@@ -339,7 +347,18 @@ function startStream() {
 
 document.getElementById('use-gauge').addEventListener('change',
   e => post('/api/style', {use_gauge: e.target.checked}));
-document.getElementById('csv-link').href = api('/api/export.csv');
+// a plain <a href> navigation cannot send the Authorization header, so the
+// export fetches the CSV and hands the browser a blob download instead
+document.getElementById('csv-link').addEventListener('click', async e => {
+  e.preventDefault();
+  const resp = await fetch('/api/export.csv', {headers: authHeaders()});
+  if (!resp.ok) { showError('CSV export failed: HTTP ' + resp.status); return; }
+  const url = URL.createObjectURL(await resp.blob());
+  const a = document.createElement('a');
+  a.href = url; a.download = 'tpudash.csv';
+  a.click();
+  URL.revokeObjectURL(url);
+});
 document.getElementById('select-all').addEventListener('click',
   () => post('/api/select', {all: true}));
 document.getElementById('select-none').addEventListener('click',

@@ -245,38 +245,24 @@ class DashboardServer:
 
         def run_profile():
             import cProfile
-            import copy
             import pstats
 
-            # profiling frames are synthetic load, not monitoring cycles:
-            # snapshot alert hysteresis state so N profiled renders don't
-            # advance for-cycles streaks N intervals in under a second
-            engine = self.service.alert_engine
-            saved_tracks = (
-                copy.deepcopy(engine._tracks) if engine is not None else None
-            )
-            saved_alerts = self.service.last_alerts
-            saved_firing = set(self.service._firing_keys)
+            # synthetic_load: profiled renders must not page anyone,
+            # advance alert hysteresis, append to a recording, or inflate
+            # source-health counters (tpudash.app.service.synthetic_load)
             deadline = time.monotonic() + 10.0  # bound lock-hold wall time
             done = 0
             prof = cProfile.Profile()
-            self.service.mute_notifications = True  # no paging from profiling
-            prof.enable()
-            try:
-                for _ in range(frames):
-                    self.service.render_frame()
-                    done += 1
-                    if time.monotonic() >= deadline:
-                        break
-            finally:
-                prof.disable()
-                self.service.mute_notifications = False
-                if engine is not None:
-                    engine._tracks = saved_tracks
-                    # /api/alerts must not serve the synthetic renders'
-                    # inflated streaks until the next real frame
-                    self.service.last_alerts = saved_alerts
-                    self.service._firing_keys = saved_firing
+            with self.service.synthetic_load():
+                prof.enable()
+                try:
+                    for _ in range(frames):
+                        self.service.render_frame()
+                        done += 1
+                        if time.monotonic() >= deadline:
+                            break
+                finally:
+                    prof.disable()
             stats = pstats.Stats(prof)
             top = []
             for func, (cc, nc, tt, ct, _callers) in stats.stats.items():
@@ -376,14 +362,19 @@ class DashboardServer:
 
     @web.middleware
     async def _auth(self, request: web.Request, handler):
-        """Bearer/query-token gate (Config.auth_token).  /healthz stays
-        open so Kubernetes probes don't need the secret."""
+        """Bearer-token gate (Config.auth_token); only /api/stream also
+        accepts ``?token=`` (EventSource transport).  /healthz stays open
+        so Kubernetes probes don't need the secret."""
         token = self.service.cfg.auth_token
         if not token or request.path == "/healthz":
             return await handler(request)
         header = request.headers.get("Authorization", "")
         supplied = header[7:] if header.startswith("Bearer ") else None
-        if supplied is None:
+        if supplied is None and request.path == "/api/stream":
+            # EventSource cannot set headers, so /api/stream alone may pass
+            # the token in the query string; every other route is
+            # header-only (query strings leak into access logs, referrers,
+            # and browser history)
             supplied = request.query.get("token")
         # compare as bytes: str compare_digest raises on non-ASCII input,
         # which would turn a bad token into a 500 instead of a 401

@@ -21,6 +21,8 @@ app.py:320-486).  ``render_frame()`` returns a JSON-able dict with:
 
 from __future__ import annotations
 
+import contextlib
+import copy
 import datetime as _dt
 import functools
 import logging
@@ -93,7 +95,10 @@ class DashboardService:
         #: set by the profile endpoint while it replays synthetic renders
         #: (those must never page anyone)
         self.mute_notifications = False
-        self._webhook_thread = None
+        #: every in-flight webhook delivery thread — a set, not "the latest
+        #: one": two back-to-back transitions spawn two deliveries and
+        #: flush_webhooks must wait for both
+        self._webhook_threads: set = set()
 
     def _notify_alert_transitions(self) -> None:
         """POST newly-firing and resolved alerts to Config.alert_webhook
@@ -126,10 +131,15 @@ class DashboardService:
         # /api/* route for http_timeout seconds
         import threading
 
+        # prune finished deliveries so the set stays bounded over a
+        # long-running server, then track the new one
+        self._webhook_threads = {
+            th for th in self._webhook_threads if th.is_alive()
+        }
         t = threading.Thread(
             target=self._deliver_webhook, args=(payload,), daemon=True
         )
-        self._webhook_thread = t
+        self._webhook_threads.add(t)
         t.start()
 
     def _deliver_webhook(self, payload: dict) -> None:
@@ -145,10 +155,67 @@ class DashboardService:
             log.warning("alert webhook delivery failed: %s", e)
 
     def flush_webhooks(self, timeout: float = 5.0) -> None:
-        """Wait for the in-flight webhook delivery (tests, shutdown)."""
-        t = self._webhook_thread
-        if t is not None:
-            t.join(timeout)
+        """Wait for ALL in-flight webhook deliveries (tests, shutdown),
+        sharing one wall-clock budget across them."""
+        deadline = time.monotonic() + timeout
+        for t in list(self._webhook_threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+            if not t.is_alive():
+                self._webhook_threads.discard(t)
+
+    @contextlib.contextmanager
+    def synthetic_load(self):
+        """Treat renders inside this block as synthetic load (the profile
+        endpoint may burn 100 frames in a second), not monitoring cycles:
+        webhooks are muted, alert hysteresis / last-alerts / trend history
+        are restored on exit, recording wrappers skip their appends, and
+        source-health counters roll back — a replay file, ``/api/alerts``
+        and ``/healthz`` must reflect real cycles only."""
+        from tpudash.sources.recorder import RecordingSource
+
+        engine = self.alert_engine
+        saved_tracks = (
+            copy.deepcopy(engine._tracks) if engine is not None else None
+        )
+        saved_alerts = self.last_alerts
+        saved_firing = set(self._firing_keys)
+        saved_history = list(self.history)
+        # /healthz and the error banner serve last_error too: a synthetic
+        # render must neither clear a real outage nor leave a fake one
+        saved_error = self.last_error
+        paused_recorders: list = []
+        health_snaps: list = []
+        # walk the wrapper chain via instance attrs only (both wrappers
+        # define __getattr__ fall-through, so plain getattr would read
+        # through to the inner source and loop)
+        src, seen = self.source, set()
+        while src is not None and id(src) not in seen:
+            seen.add(id(src))
+            if isinstance(src, RecordingSource) and not src.paused:
+                src.paused = True
+                paused_recorders.append(src)
+            health = src.__dict__.get("health")
+            if health is not None and hasattr(health, "snapshot"):
+                health_snaps.append((health, health.snapshot()))
+            src = src.__dict__.get("inner")
+        self.mute_notifications = True
+        try:
+            yield
+        finally:
+            self.mute_notifications = False
+            for rec in paused_recorders:
+                rec.paused = False
+            for health, snap in health_snaps:
+                health.restore(snap)
+            if engine is not None:
+                engine._tracks = saved_tracks
+            # /api/alerts must not serve the synthetic renders' inflated
+            # streaks until the next real frame
+            self.last_alerts = saved_alerts
+            self._firing_keys = saved_firing
+            self.last_error = saved_error
+            self.history.clear()
+            self.history.extend(saved_history)
 
     def _backfill_history(self) -> None:
         """Seed the trend history from the source's range query (Prometheus

@@ -69,9 +69,10 @@ class Config:
     host: str = "0.0.0.0"
     port: int = 8050
     #: Shared-secret auth for every route except /healthz ("" = open, the
-    #: reference's posture).  Clients send ``Authorization: Bearer <token>``
-    #: or ``?token=`` (the page forwards its URL token automatically —
-    #: EventSource cannot set headers).
+    #: reference's posture).  Clients send ``Authorization: Bearer <token>``;
+    #: ONLY /api/stream also accepts ``?token=`` (EventSource cannot set
+    #: headers).  The page forwards its ``/?token=...`` URL secret on both
+    #: transports automatically.
     auth_token: str = ""
     #: Node-exporter bind port (python -m tpudash.exporter).
     exporter_port: int = 9100

@@ -14,6 +14,7 @@ laptop with ``TPUDASH_DEMO_SOURCE=synthetic``.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import logging
 import os
@@ -72,14 +73,25 @@ async def start_demo(cfg: Config | None = None) -> "tuple[web.AppRunner, web.App
         exporter_cfg.exporter_port,
     )
 
+    # don't leak sockets when the dashboard can't start (e.g. its port is
+    # taken) — the caller never gets handles, so everything already live
+    # (the exporter, and the dash runner once set up) is cleaned here.
+    # cleanup failures are suppressed so the ORIGINAL error (which port,
+    # what failed) propagates, and one failed cleanup can't skip the next
     try:
         dash_runner = web.AppRunner(make_dash_app(dash_cfg))
         await dash_runner.setup()
+    except Exception:
+        with contextlib.suppress(Exception):
+            await exporter_runner.cleanup()
+        raise
+    try:
         await web.TCPSite(dash_runner, dash_cfg.host, dash_cfg.port).start()
     except Exception:
-        # don't leak the live exporter socket when the dashboard can't
-        # start (e.g. its port is taken) — the caller never gets handles
-        await exporter_runner.cleanup()
+        with contextlib.suppress(Exception):
+            await dash_runner.cleanup()
+        with contextlib.suppress(Exception):
+            await exporter_runner.cleanup()
         raise
     log.info("dashboard on :%d (scraping the exporter)", dash_cfg.port)
     return exporter_runner, dash_runner

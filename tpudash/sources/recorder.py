@@ -39,6 +39,10 @@ class RecordingSource(MetricsSource):
         self.path = path
         self.name = f"{inner.name}+record"
         self._write_failed = False
+        #: while True, fetches pass through without appending — the profile
+        #: endpoint's synthetic renders must not land in the recording (a
+        #: replay reproduces monitoring cycles, not profiling bursts)
+        self.paused = False
         try:
             with open(path, "a", encoding="utf-8"):
                 pass
@@ -47,6 +51,8 @@ class RecordingSource(MetricsSource):
 
     def fetch(self):
         samples = self.inner.fetch()
+        if self.paused:
+            return samples
         as_list = (
             samples.to_samples()
             if isinstance(samples, SampleBatch)

@@ -73,6 +73,16 @@ class SourceHealth:
         self.consecutive_failures += 1
         self.last_failure_ts = self._clock()
 
+    def snapshot(self) -> dict:
+        """Counter state for rollback — profiling renders are synthetic
+        load and must not advance the health ledger (app/server.py)."""
+        d = dict(self.__dict__)
+        d.pop("_clock")
+        return d
+
+    def restore(self, snap: dict) -> None:
+        self.__dict__.update(snap)
+
     @property
     def status(self) -> str:
         if self.consecutive_failures >= self.DOWN_AFTER:
@@ -125,7 +135,7 @@ class ResilientSource(MetricsSource):
         for attempt in range(attempts):
             try:
                 samples = self.inner.fetch()
-            except SourceError as e:
+            except SourceError as e:  # noqa: PERF203 — transient, retryable
                 last_exc = e
                 made = attempt + 1
                 out_of_time = (
@@ -136,6 +146,13 @@ class ResilientSource(MetricsSource):
                     self._sleep(self.policy.backoff(attempt, self._rng))
                     continue
                 break
+            except Exception:
+                # a bug (parser, wrapper) is not a transient scrape failure:
+                # don't retry it, but the health ledger MUST see it — a
+                # crashing source otherwise reports "healthy" forever while
+                # every frame shows the error banner
+                self.health.record_failure()
+                raise
             self.health.record_success(retried=attempt > 0)
             return samples
         self.health.record_failure()
