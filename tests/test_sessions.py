@@ -1,0 +1,256 @@
+"""Per-browser-session UI state (reference st.session_state, app.py:252-260).
+
+Two viewers of one dashboard must hold independent selections and gauge
+styles; anonymous API consumers keep the old single-global-state behavior;
+the session map is bounded and TTL-evicted.
+"""
+
+import asyncio
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.app.server import SESSION_COOKIE, DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.app.sessions import SessionStore
+from tpudash.app.state import SelectionState
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _server(cfg=None):
+    cfg = cfg or Config(source="fixture", fixture_path=FIXTURE, refresh_interval=0.0)
+    service = DashboardService(cfg, FixtureSource(cfg.fixture_path))
+    return DashboardServer(service)
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_index_issues_session_cookie_once():
+    async def go():
+        client = await _client(_server().build_app())
+        try:
+            resp = await client.get("/")
+            cookie = resp.cookies.get(SESSION_COOKIE)
+            assert cookie is not None and len(cookie.value) >= 16
+            assert "HttpOnly" in str(cookie)
+            # cookie jar now carries it: no re-issue on the next visit
+            resp2 = await client.get("/")
+            assert resp2.cookies.get(SESSION_COOKIE) is None
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_two_viewers_hold_independent_selections_and_styles():
+    async def go():
+        server = _server()
+        app = server.build_app()
+        client = await _client(app)
+        try:
+            # two browsers = two cookie values (TestClient shares a jar, so
+            # pass cookies explicitly per simulated viewer)
+            a = {SESSION_COOKIE: "viewer-a"}
+            b = {SESSION_COOKIE: "viewer-b"}
+            await client.post("/api/select", json={"all": True}, cookies=a)
+            await client.post(
+                "/api/select", json={"selected": ["slice-0/1"]}, cookies=b
+            )
+            await client.post("/api/style", json={"use_gauge": False}, cookies=b)
+
+            fa = await (await client.get("/api/frame", cookies=a)).json()
+            fb = await (await client.get("/api/frame", cookies=b)).json()
+            assert fa["selected"] == ["slice-0/0", "slice-0/1"]
+            assert fb["selected"] == ["slice-0/1"]
+            assert fa["use_gauge"] is True
+            assert fb["use_gauge"] is False
+            # viewer A's figures still render gauges, B's render bars
+            assert fa["average"]["figures"][0]["figure"]["data"][0]["type"] == "indicator"
+            assert fb["average"]["figures"][0]["figure"]["data"][0]["type"] == "bar"
+
+            # the anonymous default session is untouched by either viewer
+            f0 = await (await client.get("/api/frame")).json()
+            assert f0["selected"] == ["slice-0/0"]
+            assert f0["use_gauge"] is True
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_anonymous_requests_share_the_global_state():
+    async def go():
+        server = _server()
+        client = await _client(server.build_app())
+        try:
+            await client.post("/api/select", json={"all": True})
+            frame = await (await client.get("/api/frame")).json()
+            assert frame["selected"] == ["slice-0/0", "slice-0/1"]
+            # the service-level global state IS the anonymous session state
+            assert server.service.state.selected == ["slice-0/0", "slice-0/1"]
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_only_default_session_persists(tmp_path):
+    state_path = str(tmp_path / "state.json")
+
+    async def go():
+        cfg = Config(
+            source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+            state_path=state_path,
+        )
+        client = await _client(_server(cfg).build_app())
+        try:
+            await client.post(
+                "/api/select", json={"all": True},
+                cookies={SESSION_COOKIE: "viewer-a"},
+            )
+            assert not os.path.exists(state_path)  # ephemeral, like the reference
+            await client.post("/api/select", json={"all": True})
+            assert os.path.exists(state_path)  # the global default persists
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_one_scrape_serves_many_sessions():
+    calls = {"n": 0}
+
+    class Counting(FixtureSource):
+        def fetch(self):
+            calls["n"] += 1
+            return super().fetch()
+
+    async def go():
+        cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=60.0)
+        service = DashboardService(cfg, Counting(FIXTURE))
+        client = await _client(DashboardServer(service).build_app())
+        try:
+            for sid in ("a", "b", "c"):
+                await client.get("/api/frame", cookies={SESSION_COOKIE: sid})
+            await client.get("/api/frame")
+            assert calls["n"] == 1  # four sessions, one scrape
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_selection_change_does_not_rescrape():
+    calls = {"n": 0}
+
+    class Counting(FixtureSource):
+        def fetch(self):
+            calls["n"] += 1
+            return super().fetch()
+
+    async def go():
+        cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=60.0)
+        service = DashboardService(cfg, Counting(FIXTURE))
+        client = await _client(DashboardServer(service).build_app())
+        try:
+            await client.get("/api/frame")
+            before = calls["n"]
+            resp = await client.post("/api/select", json={"all": True})
+            assert (await resp.json())["selected"] == ["slice-0/0", "slice-0/1"]
+            assert calls["n"] == before  # recompose, not refetch
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+# -- SessionStore unit behavior ---------------------------------------------
+
+def test_store_default_entry_is_the_global_state():
+    state = SelectionState()
+    store = SessionStore(state)
+    assert store.entry(None).state is state
+    assert store.entry("").state is state
+    assert store.entry("sid").state is not state
+
+
+def test_store_ttl_eviction():
+    clock = {"t": 0.0}
+    store = SessionStore(SelectionState(), ttl=10.0, clock=lambda: clock["t"])
+    e1 = store.entry("a")
+    clock["t"] = 5.0
+    assert store.entry("a") is e1  # refreshed recency
+    clock["t"] = 14.0
+    assert store.entry("a") is e1  # 9s idle < ttl
+    clock["t"] = 25.0
+    store.entry("b")  # insertion evicts the 11s-idle "a"
+    assert len(store) == 1
+    e1b = store.entry("a")
+    assert e1b is not e1  # fresh session after eviction
+
+
+def test_store_size_bound_evicts_lru():
+    clock = {"t": 0.0}
+    store = SessionStore(
+        SelectionState(), limit=3, ttl=1e9, clock=lambda: clock["t"]
+    )
+    for i, sid in enumerate(("a", "b", "c")):
+        clock["t"] = float(i)
+        store.entry(sid)
+    clock["t"] = 10.0
+    store.entry("a")  # refresh "a" — "b" becomes LRU
+    clock["t"] = 11.0
+    store.entry("d")
+    assert len(store) == 3
+    snapshot = dict(store._entries)
+    assert set(snapshot) == {"a", "c", "d"}
+
+
+def test_stream_keeps_session_alive_and_tracks_replacement():
+    # an actively-streamed session must refresh its TTL each tick, and if
+    # the entry is ever replaced (eviction) the stream must pick up the
+    # NEW entry — pushed frames reflect mutations made after replacement
+    import json as _json
+
+    async def go():
+        cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=0.0)
+        server = _server(cfg)
+        client = await _client(server.build_app())
+        try:
+            sid = {SESSION_COOKIE: "watcher"}
+            resp = await client.get("/api/stream", cookies=sid)
+            raw = await asyncio.wait_for(resp.content.readuntil(b"\n\n"), timeout=10)
+            first = _json.loads(raw.decode()[len("data: "):])
+            assert first["selected"] == ["slice-0/0"]
+            watcher = server.sessions.entry("watcher")
+            seen_before = watcher.last_seen
+            # simulate an eviction: drop the entry behind the stream's back
+            del server.sessions._entries["watcher"]
+            await client.post("/api/select", json={"all": True}, cookies=sid)
+            for _ in range(4):  # the replacement entry's frames flow through
+                raw = await asyncio.wait_for(
+                    resp.content.readuntil(b"\n\n"), timeout=10
+                )
+                frame = _json.loads(raw.decode()[len("data: "):])
+                if frame["selected"] == ["slice-0/0", "slice-0/1"]:
+                    break
+            else:
+                raise AssertionError("stream never reflected the new entry")
+            # ticking refreshed recency on the (new) entry
+            assert server.sessions.entry("watcher").last_seen >= seen_before
+            resp.close()
+        finally:
+            await client.close()
+
+    _run(go())

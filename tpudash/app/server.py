@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import hmac
 import json
+import secrets
 import tempfile
 import time
 
@@ -33,73 +34,141 @@ from aiohttp import web
 
 from tpudash.app.html import PAGE
 from tpudash.app.service import DashboardService
+from tpudash.app.sessions import SessionEntry, SessionStore
 from tpudash.config import Config, load_config
 from tpudash.sources import make_source
+
+#: per-browser session id (the reference's st.session_state scoping,
+#: app.py:252-260).  No Max-Age: it lives for the browser session, exactly
+#: like a Streamlit session.
+SESSION_COOKIE = "tpudash_sid"
 
 
 class DashboardServer:
     def __init__(self, service: DashboardService):
         self.service = service
         self._lock = asyncio.Lock()
-        self._cached_frame: dict | None = None
-        self._cached_at: float = 0.0
-        self._cached_sse: bytes | None = None  # serialized once per frame
+        self.sessions = SessionStore(
+            service.state,
+            limit=service.cfg.session_limit,
+            ttl=service.cfg.session_ttl,
+        )
+        #: bumped after every refresh_data(); pairs with each session's
+        #: state_version to key the per-session compose caches
+        self._data_version = 0
+        self._data_at: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
 
+    def _entry(self, request: web.Request) -> SessionEntry:
+        return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
+
     # -- frame caching -------------------------------------------------------
-    async def _get_frame(self, force: bool = False) -> dict:
-        async with self._lock:
-            age = time.monotonic() - self._cached_at
-            if (
-                not force
-                and self._cached_frame is not None
-                and age < self.service.cfg.refresh_interval
-            ):
-                return self._cached_frame
+    async def _refresh_locked(self, force: bool) -> None:
+        """Refresh the shared scrape data when stale.  Caller holds _lock."""
+        age = time.monotonic() - self._data_at
+        if (
+            force
+            or self._data_version == 0
+            or age >= self.service.cfg.refresh_interval
+        ):
             loop = asyncio.get_running_loop()
-            frame = await loop.run_in_executor(None, self.service.render_frame)
-            self._cached_frame = frame
-            self._cached_at = time.monotonic()
-            self._cached_sse = None  # new frame → stale serialization
+            await loop.run_in_executor(None, self.service.refresh_data)
+            self._data_version += 1
+            self._data_at = time.monotonic()
+
+    async def _get_frame(
+        self, force: bool = False, entry: SessionEntry | None = None
+    ) -> dict:
+        """Frame for one viewer session.  The scrape/normalize half runs at
+        most once per refresh interval across ALL sessions; the per-session
+        compose is cached against (data_version, state_version), so many
+        tabs of one browser cost one render and a selection change on one
+        session never re-scrapes or re-renders the others."""
+        entry = entry if entry is not None else self.sessions.entry(None)
+        async with self._lock:
+            await self._refresh_locked(force)
+            key = (self._data_version, entry.state_version)
+            if entry.frame is not None and entry.frame_key == key:
+                return entry.frame
+            loop = asyncio.get_running_loop()
+            frame = await loop.run_in_executor(
+                None, self.service.compose_frame, entry.state
+            )
+            entry.frame = frame
+            entry.frame_key = key
             return frame
 
-    async def _get_sse_payload(self) -> bytes:
+    async def _get_sse_payload(self, entry: SessionEntry | None = None) -> bytes:
         """Current frame as a serialized SSE event.  Serialized ONCE per
-        frame no matter how many stream subscribers tick — frames embed
-        full figure JSON, so per-subscriber json.dumps would stall the
-        event loop at many open tabs."""
-        frame = await self._get_frame()
+        (data, state) version per session no matter how many stream
+        subscribers tick — frames embed full figure JSON, so per-subscriber
+        json.dumps would stall the event loop at many open tabs.
+
+        Runs refresh → compose → serialize under ONE lock hold so the
+        cached bytes are always stamped with the version they were actually
+        composed from.  A streaming session keeps only the serialized bytes
+        (the frame dict is dropped) — one cached payload per session, not
+        two."""
+        entry = entry if entry is not None else self.sessions.entry(None)
         async with self._lock:
-            if self._cached_frame is frame and self._cached_sse is not None:
-                return self._cached_sse
-            payload = f"data: {json.dumps(frame)}\n\n".encode()
-            if self._cached_frame is frame:
-                self._cached_sse = payload
+            await self._refresh_locked(False)
+            key = (self._data_version, entry.state_version)
+            if entry.sse_bytes is not None and entry.sse_key == key:
+                return entry.sse_bytes
+            loop = asyncio.get_running_loop()
+            if entry.frame is not None and entry.frame_key == key:
+                frame = entry.frame
+            else:
+                frame = await loop.run_in_executor(
+                    None, self.service.compose_frame, entry.state
+                )
+            payload = await loop.run_in_executor(
+                None, lambda: f"data: {json.dumps(frame)}\n\n".encode()
+            )
+            entry.sse_bytes = payload
+            entry.sse_key = key
+            entry.frame = None
+            entry.frame_key = None
             return payload
 
-    async def _mutate(self, fn):
-        """Run a state mutation under the frame lock: render_frame executes
-        on the worker thread only while the lock is held, so mutations are
-        serialized against frame builds (no torn selection lists).  The
-        post-mutation state is persisted inside the same critical section —
-        saving outside it could snapshot a list mid-sort."""
+    async def _mutate(self, entry: SessionEntry, fn):
+        """Run a state mutation under the frame lock: service renders on
+        the worker thread only while the lock is held, so mutations are
+        serialized against frame builds (no torn selection lists).  Bumps
+        the session's state version (cache invalidation).  Only the
+        anonymous default session persists to disk — per-browser sessions
+        are ephemeral like the reference's (SURVEY §5)."""
         async with self._lock:
             result = fn()
-            self.service.state.save(self.service.cfg.state_path)
+            entry.state_version += 1
+            if entry is self.sessions.default:
+                self.service.state.save(self.service.cfg.state_path)
             return result
 
     # -- handlers ------------------------------------------------------------
     async def index(self, request: web.Request) -> web.Response:
-        return web.Response(text=PAGE, content_type="text/html")
+        resp = web.Response(text=PAGE, content_type="text/html")
+        if not request.cookies.get(SESSION_COOKIE):
+            # first visit: issue the per-browser session id the reference
+            # gets for free from Streamlit (app.py:252-260)
+            resp.set_cookie(
+                SESSION_COOKIE,
+                secrets.token_urlsafe(16),
+                httponly=True,
+                samesite="Lax",
+            )
+        return resp
 
     async def frame(self, request: web.Request) -> web.Response:
-        frame = await self._get_frame()
+        frame = await self._get_frame(entry=self._entry(request))
         return web.json_response(frame)
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
-        """Server-sent events: push a frame every refresh interval.  Many
-        subscribers share the frame cache, so N open tabs still cost one
-        scrape per interval."""
+        """Server-sent events: push a frame every refresh interval.  All
+        subscribers share the scrape; subscribers of one session share its
+        serialized payload, so N open tabs still cost one scrape per
+        interval and one compose per session."""
+        sid = request.cookies.get(SESSION_COOKIE)
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -110,7 +179,11 @@ class DashboardServer:
         await resp.prepare(request)
         try:
             while True:
-                await resp.write(await self._get_sse_payload())
+                # re-resolve every tick: touches last_seen so an actively
+                # streamed session is never TTL-evicted, and picks up the
+                # replacement entry if it somehow was
+                entry = self.sessions.entry(sid)
+                await resp.write(await self._get_sse_payload(entry))
                 await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
         except (ConnectionResetError, asyncio.CancelledError):
             pass  # client went away — normal termination
@@ -121,7 +194,7 @@ class DashboardServer:
         identity columns + every metric column).  Always refreshes through
         the cache-gated frame path so the export is at most one refresh
         interval old, never an hours-stale snapshot."""
-        frame = await self._get_frame()
+        frame = await self._get_frame(entry=self._entry(request))
         if frame.get("error"):
             # don't serve pre-outage data as if it were current
             raise web.HTTPServiceUnavailable(text=frame["error"])
@@ -141,29 +214,35 @@ class DashboardServer:
             body = await request.json()
         except json.JSONDecodeError:
             raise web.HTTPBadRequest(text="invalid JSON")
-        state = self.service.state
+        entry = self._entry(request)
+        state = entry.state
         if not self.service.available:
-            # No successful frame yet this session — prime one so selection
-            # ops validate against a real chip list.
-            await self._get_frame(force=True)
+            # No successful frame yet — prime one so selection ops
+            # validate against a real chip list.
+            await self._get_frame(force=True, entry=entry)
         available = self.service.available
         if body.get("all"):
-            await self._mutate(lambda: state.select_all(available))
+            await self._mutate(entry, lambda: state.select_all(available))
         elif body.get("none"):
-            await self._mutate(state.clear)
+            await self._mutate(entry, state.clear)
         elif "toggle" in body:
-            await self._mutate(lambda: state.toggle(str(body["toggle"]), available))
+            await self._mutate(
+                entry, lambda: state.toggle(str(body["toggle"]), available)
+            )
         elif "selected" in body:
             if not isinstance(body["selected"], list):
                 raise web.HTTPBadRequest(text="'selected' must be a list")
             await self._mutate(
+                entry,
                 lambda: state.set_selected(
                     [str(k) for k in body["selected"]], available
-                )
+                ),
             )
         else:
             raise web.HTTPBadRequest(text="no selection operation in body")
-        frame = await self._get_frame(force=True)
+        # recompose this session's frame (data untouched: a selection
+        # change must not trigger a re-scrape, the table didn't change)
+        frame = await self._get_frame(entry=entry)
         return web.json_response(
             {"selected": list(state.selected), "frame_ok": frame["error"] is None}
         )
@@ -174,13 +253,14 @@ class DashboardServer:
         except json.JSONDecodeError:
             raise web.HTTPBadRequest(text="invalid JSON")
         use_gauge = bool(body.get("use_gauge", True))
+        entry = self._entry(request)
 
         def _set():
-            self.service.state.use_gauge = use_gauge
+            entry.state.use_gauge = use_gauge
 
-        await self._mutate(_set)
-        await self._get_frame(force=True)
-        return web.json_response({"use_gauge": self.service.state.use_gauge})
+        await self._mutate(entry, _set)
+        await self._get_frame(entry=entry)
+        return web.json_response({"use_gauge": entry.state.use_gauge})
 
     async def timings(self, request: web.Request) -> web.Response:
         return web.json_response(self.service.timer.summary())
@@ -364,9 +444,12 @@ class DashboardServer:
     async def _auth(self, request: web.Request, handler):
         """Bearer-token gate (Config.auth_token); only /api/stream also
         accepts ``?token=`` (EventSource transport).  /healthz stays open
-        so Kubernetes probes don't need the secret."""
+        so Kubernetes probes don't need the secret, and the index page —
+        a static shell with no metric data — stays open so a browser
+        navigation (which cannot send headers) can load it; the page's
+        JS then authenticates every data call."""
         token = self.service.cfg.auth_token
-        if not token or request.path == "/healthz":
+        if not token or request.path in ("/", "/healthz"):
             return await handler(request)
         header = request.headers.get("Authorization", "")
         supplied = header[7:] if header.startswith("Bearer ") else None

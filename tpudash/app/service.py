@@ -69,6 +69,9 @@ class DashboardService:
         self.source = source
         self.state = SelectionState()
         self.timer = StageTimer()
+        #: True between refresh_data() and the first compose_frame() that
+        #: records the render stage and closes the timer frame
+        self._frame_open = False
         self.last_error: str | None = None
         #: wide per-chip table from the last successful frame (CSV export)
         self.last_df: "pd.DataFrame | None" = None
@@ -490,14 +493,19 @@ class DashboardService:
         return out
 
     # -- the frame -----------------------------------------------------------
-    def render_frame(self) -> dict:
+    def refresh_data(self) -> "pd.DataFrame | None":
+        """Scrape → normalize → alerts → trend history: the shared half of
+        a frame, run ONCE per refresh interval no matter how many viewer
+        sessions compose frames from it.  Returns the wide table, or None
+        when the source failed (``last_error`` carries the banner text —
+        the reference's error path, app.py:225-227).
+
+        The timer frame opened here is completed by the first
+        :meth:`compose_frame` that renders from this data, so the
+        north-star scrape→render number still measures one full cycle.
+        """
         self.timer.start_frame()
-        frame: dict = {
-            "last_updated": _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
-            "refresh_interval": self.cfg.refresh_interval,
-            "use_gauge": self.state.use_gauge,
-            "error": None,
-        }
+        self._frame_open = True
         try:
             with self.timer.stage("scrape"):
                 samples = self.source.fetch()
@@ -509,23 +517,56 @@ class DashboardService:
             if err != self.last_error:  # log streaks once, not per cycle
                 log.warning("%s", err)
             self.last_error = err
-            frame["error"] = self.last_error
-            frame["chips"] = []
-            frame["source_health"] = self.source_health()
+            self._frame_open = False
             self.timer.end_frame()
-            frame["timings"] = self.timer.summary()
-            return frame
+            return None
 
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
         self.last_df = df
-        frame["source_health"] = self.source_health()
+        self.available = list(df.index)
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
                 self.last_alerts = self.alert_engine.evaluate(df)
-            frame["alerts"] = self.last_alerts
             self._notify_alert_transitions()
+        # Fleet-wide trend history, one point per refresh interval (burst
+        # renders from selection POSTs must not pollute the cadence).
+        # Averages cover ALL chips in scope — per-browser selections are
+        # session-local now and must not steer the shared sparklines; this
+        # also matches the backfill scope (_backfill_history).
+        now = time.time()
+        if (
+            not self.history
+            or now - self.history[-1][0] >= self.cfg.refresh_interval
+        ):
+            avgs = {
+                p.column: column_average(df, p.column)
+                for p in self._active_panels(df)
+            }
+            self.history.append((now, avgs))
+        return df
+
+    def compose_frame(self, state: "SelectionState | None" = None) -> dict:
+        """Selection-dependent frame assembly for ONE viewer session over
+        the table :meth:`refresh_data` last pulled — the render half of the
+        reference's loop (app.py:320-486), cheap enough to run per session.
+        ``state`` defaults to the anonymous/global session."""
+        state = state if state is not None else self.state
+        frame: dict = {
+            "last_updated": _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+            "refresh_interval": self.cfg.refresh_interval,
+            "use_gauge": state.use_gauge,
+            "error": self.last_error,
+            "source_health": self.source_health(),
+        }
+        df = self.last_df
+        if self.last_error is not None or df is None:
+            frame["chips"] = []
+            frame["timings"] = self.timer.summary()
+            return frame
+        if self.alert_engine is not None:
+            frame["alerts"] = self.last_alerts
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)
@@ -533,13 +574,20 @@ class DashboardService:
             frame["warnings"] = [
                 f"endpoint {name}: {err}" for name, err in partial.items()
             ]
-        with self.timer.stage("render"):
-            available = list(df.index)
-            self.available = available
-            selected = self.state.sync(available)
+        # only the FIRST compose after a refresh lands in the timer frame:
+        # further sessions' composes must not append render-only entries
+        # that would skew the scrape→render percentiles
+        render_timing = (
+            self.timer.stage("render")
+            if self._frame_open
+            else contextlib.nullcontext()
+        )
+        with render_timing:
+            available = self.available
+            selected = state.sync(available)
             sel_df = filter_selected(df, selected)
             panels = self._active_panels(df)
-            use_gauge = self.state.use_gauge
+            use_gauge = state.use_gauge
 
             sel_set = set(selected)
             accels = (
@@ -576,15 +624,6 @@ class DashboardService:
                     spec.column: column_average(sel_df, spec.column)
                     for spec in panels
                 }
-                # one history point per refresh interval: selection/style
-                # POSTs force extra renders whose burst samples (different
-                # selections, duplicate timestamps) would pollute the trend
-                now = time.time()
-                if (
-                    not self.history
-                    or now - self.history[-1][0] >= self.cfg.refresh_interval
-                ):
-                    self.history.append((now, avgs))
                 frame["average"] = self._average_row(
                     sel_df, panels, use_gauge, avgs
                 )
@@ -610,6 +649,14 @@ class DashboardService:
                 frame["stats"] = {}
                 frame["breakdown"] = {}
 
-        self.timer.end_frame()
+        if self._frame_open:
+            self._frame_open = False
+            self.timer.end_frame()
         frame["timings"] = self.timer.summary()
         return frame
+
+    def render_frame(self, state: "SelectionState | None" = None) -> dict:
+        """One full cycle — refresh + compose — for a single session (the
+        reference's single-viewer loop; bench.py and the CLI use this)."""
+        self.refresh_data()
+        return self.compose_frame(state)

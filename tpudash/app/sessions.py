@@ -1,0 +1,108 @@
+"""Per-browser-session UI state — the reference's ``st.session_state``.
+
+The reference scopes ``selected_gpus`` / ``use_gauge`` / ``last_selection``
+to one browser session (reference app.py:252-260): two people watching the
+same dashboard never fight over each other's checkboxes or gauge style.
+tpudash's aiohttp shell restores those semantics with a cookie-identified,
+bounded, TTL-evicted server-side map of :class:`SelectionState`.
+
+The pre-existing global state remains as the **anonymous default**: requests
+without a session cookie (curl, API consumers, k8s probes) see exactly the
+old single-state behavior, and only the default state participates in
+``TPUDASH_STATE_PATH`` persistence — per-browser sessions are ephemeral,
+like the reference's (a browser restart resets them, SURVEY.md §5
+checkpoint/resume note).
+
+Each entry also carries the per-session composed-frame and SSE-payload
+caches keyed by ``(data_version, state_version)``: the expensive scrape/
+normalize runs once per refresh interval for ALL sessions (the shared half
+lives in ``DashboardService.refresh_data``), while the cheap per-selection
+compose is cached per session so many tabs of one browser still cost one
+render.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from tpudash.app.state import SelectionState
+
+
+class SessionEntry:
+    """One viewer session: its selection state plus render caches."""
+
+    __slots__ = (
+        "state",
+        "state_version",
+        "frame",
+        "frame_key",
+        "sse_bytes",
+        "sse_key",
+        "last_seen",
+    )
+
+    def __init__(self, state: SelectionState):
+        self.state = state
+        #: bumped by the server on every mutation (select/style POSTs);
+        #: part of the compose-cache key
+        self.state_version = 0
+        self.frame: "dict | None" = None
+        self.frame_key: "tuple | None" = None
+        self.sse_bytes: "bytes | None" = None
+        self.sse_key: "tuple | None" = None
+        self.last_seen = 0.0
+
+
+class SessionStore:
+    """Bounded, TTL-evicted map of session id → :class:`SessionEntry`.
+
+    ``entry(None)`` / ``entry("")`` returns the default (anonymous) entry,
+    which is never evicted.  Unknown ids lazily create fresh sessions (a
+    stale cookie after a server restart simply becomes a new session — the
+    reference's browser-refresh-resets-state behavior).  Access refreshes
+    recency; eviction removes TTL-expired entries first (they are exactly
+    the least-recently-used ones) and then trims to the size bound.
+    """
+
+    def __init__(
+        self,
+        default_state: SelectionState,
+        limit: int = 256,
+        ttl: float = 1800.0,
+        clock=time.monotonic,
+    ):
+        self.default = SessionEntry(default_state)
+        self.limit = max(1, int(limit))
+        self.ttl = float(ttl)
+        self._clock = clock
+        self._entries: "OrderedDict[str, SessionEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entry(self, sid: "str | None") -> SessionEntry:
+        if not sid:
+            return self.default
+        now = self._clock()
+        e = self._entries.get(sid)
+        if e is None:
+            self._evict(now)
+            e = self._entries[sid] = SessionEntry(SelectionState())
+        else:
+            self._entries.move_to_end(sid)
+        e.last_seen = now
+        return e
+
+    def _evict(self, now: float) -> None:
+        # LRU order == insertion-after-move_to_end order, so TTL-expired
+        # entries cluster at the front; stop at the first live one
+        while self._entries:
+            sid, e = next(iter(self._entries.items()))
+            if now - e.last_seen >= self.ttl:
+                del self._entries[sid]
+            else:
+                break
+        # keep room for the entry the caller is about to insert
+        while len(self._entries) >= self.limit:
+            self._entries.popitem(last=False)

@@ -68,11 +68,12 @@ class Config:
     #: Dashboard server bind.
     host: str = "0.0.0.0"
     port: int = 8050
-    #: Shared-secret auth for every route except /healthz ("" = open, the
-    #: reference's posture).  Clients send ``Authorization: Bearer <token>``;
-    #: ONLY /api/stream also accepts ``?token=`` (EventSource cannot set
-    #: headers).  The page forwards its ``/?token=...`` URL secret on both
-    #: transports automatically.
+    #: Shared-secret auth for every data route ("" = open, the reference's
+    #: posture).  Clients send ``Authorization: Bearer <token>``; ONLY
+    #: /api/stream also accepts ``?token=`` (EventSource cannot set
+    #: headers).  The index page and /healthz stay open (static shell /
+    #: k8s probes); opening ``/?token=...`` hands the page JS the secret,
+    #: which it forwards on both transports automatically.
     auth_token: str = ""
     #: Node-exporter bind port (python -m tpudash.exporter).
     exporter_port: int = 9100
@@ -110,6 +111,11 @@ class Config:
     #: resume from its latest step on restart.  "" disables.
     workload_checkpoint_dir: str = ""
     workload_checkpoint_every: int = 64
+    #: Per-browser UI sessions (cookie ``tpudash_sid`` — the reference's
+    #: st.session_state scoping, app.py:252-260): bound on the server-side
+    #: session map and idle TTL in seconds before eviction.
+    session_limit: int = 256
+    session_ttl: float = 1800.0
     #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
     #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
     #: URLs ending in /metrics are scraped directly; others are Prometheus
@@ -143,6 +149,8 @@ _ENV_MAP = {
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
+    "session_limit": "TPUDASH_SESSION_LIMIT",
+    "session_ttl": "TPUDASH_SESSION_TTL",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
