@@ -47,7 +47,40 @@ def bench_dashboard() -> dict:
         assert frame["heatmaps"], "256-chip frame must use heatmap mode"
     p50 = svc.timer.percentile(0.5)
     p95 = svc.timer.percentile(0.95)
-    return {"p50_s": p50, "p95_s": p95}
+    # wire cost: one full SSE tick for this 256-chip select-all frame —
+    # what every subscriber downloads per refresh interval
+    payload = f"data: {json.dumps(frame)}\n\n".encode()
+    return {"p50_s": p50, "p95_s": p95, "sse_bytes": len(payload)}
+
+
+def bench_3d_torus() -> dict:
+    """3D-torus proof (v4, 4×4×8 = 128 chips): render cost plus a geometry
+    assertion that the Z-planes actually unroll side by side (8 planes of
+    4×4 with 1-column gaps → 4 rows × 39 columns)."""
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    chips = 128  # v4 4×4×8 (topology._V4_SHAPES)
+    cfg = Config(source="synthetic", synthetic_chips=chips, generation="v4")
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(chips, generation="v4", frames=8)
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    svc.timer.history.clear()
+    for _ in range(N_FRAMES):
+        frame = svc.render_frame()
+        assert frame["error"] is None
+        assert frame["heatmaps"], "128-chip selection must render heatmaps"
+    z = frame["heatmaps"][0]["figure"]["data"][0]["z"]
+    ny, width = len(z), len(z[0])
+    assert (ny, width) == (4, 8 * 4 + 7), f"bad 3D unroll: {ny}x{width}"
+    return {
+        "p50_s": svc.timer.percentile(0.5),
+        "shape": "4x4x8",
+        "grid": f"{ny}x{width}",
+    }
 
 
 def bench_multislice() -> dict:
@@ -110,6 +143,7 @@ def main() -> None:
     t0 = time.time()
     dash = bench_dashboard()
     multi = bench_multislice()
+    torus3d = bench_3d_torus()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -120,7 +154,10 @@ def main() -> None:
         "p95_ms": round(dash["p95_s"] * 1e3, 2),
         "frames": N_FRAMES,
         "budget_s": BUDGET_S,
+        "sse_full_frame_bytes": dash["sse_bytes"],
         "multislice_2x256_p50_ms": round(multi["p50_s"] * 1e3, 2),
+        "torus3d_v4_4x4x8_p50_ms": round(torus3d["p50_s"] * 1e3, 2),
+        "torus3d_grid": torus3d["grid"],
         "probes": probes,
         "bench_wall_s": round(time.time() - t0, 1),
     }

@@ -312,3 +312,28 @@ def test_timings_present():
     assert t["frames"] == 1
     for key in ("scrape", "normalize", "render", "total"):
         assert key in t
+
+
+def test_3d_torus_frame_renders_z_plane_geometry():
+    # v4 slices are 3D toruses; a 128-chip slice is 4x4x8 and the heatmap
+    # must unroll its 8 Z-planes side by side: 4 rows x (8*4 + 7 gap cols).
+    # Chip ids are row-major (z*ny + y)*nx + x (topology.py conventions).
+    svc = _svc(
+        SyntheticSource(num_chips=128, generation="v4"),
+        generation="v4",
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = svc.render_frame()
+    assert frame["heatmaps"], "128 selected chips must render heatmaps"
+    fig = frame["heatmaps"][0]["figure"]
+    z = fig["data"][0]["z"]
+    assert len(z) == 4 and len(z[0]) == 8 * 4 + 7
+    # gap columns between planes carry no cells
+    for row in z:
+        assert row[4] is None and row[9] is None
+    # chip 16 = (z=1, y=0, x=0) → row 0, col (4+1)*1 = 5 must hold a value
+    assert z[0][5] is not None
+    # every selected chip's value landed somewhere: 128 non-None cells
+    filled = sum(1 for row in z for v in row if v is not None)
+    assert filled == 128

@@ -254,3 +254,24 @@ def test_stream_keeps_session_alive_and_tracks_replacement():
             await client.close()
 
     _run(go())
+
+
+def test_last_updated_reflects_scrape_time_not_compose_time():
+    # a selection toggle late in a long refresh interval recomposes from
+    # cached data — the frame must keep the SCRAPE timestamp, not claim
+    # interval-old metrics are current
+    async def go():
+        cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=60.0)
+        server = _server(cfg)
+        client = await _client(server.build_app())
+        try:
+            f1 = await (await client.get("/api/frame")).json()
+            server.service.last_updated = "1999-01-01 00:00:00"  # mark the pull
+            await client.post("/api/select", json={"all": True})
+            f2 = await (await client.get("/api/frame")).json()
+            assert f2["last_updated"] == "1999-01-01 00:00:00"
+            assert f1["error"] is None and f2["error"] is None
+        finally:
+            await client.close()
+
+    _run(go())

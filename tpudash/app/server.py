@@ -76,6 +76,21 @@ class DashboardServer:
             self._data_version += 1
             self._data_at = time.monotonic()
 
+    async def _compose_locked(self, entry: SessionEntry) -> "tuple[dict, tuple]":
+        """Per-session compose with its (data_version, state_version) cache
+        key.  Caller holds _lock and has already run _refresh_locked — the
+        single copy of the cache-keying protocol both transports share."""
+        key = (self._data_version, entry.state_version)
+        if entry.frame is not None and entry.frame_key == key:
+            return entry.frame, key
+        loop = asyncio.get_running_loop()
+        frame = await loop.run_in_executor(
+            None, self.service.compose_frame, entry.state
+        )
+        entry.frame = frame
+        entry.frame_key = key
+        return frame, key
+
     async def _get_frame(
         self, force: bool = False, entry: SessionEntry | None = None
     ) -> dict:
@@ -87,15 +102,7 @@ class DashboardServer:
         entry = entry if entry is not None else self.sessions.entry(None)
         async with self._lock:
             await self._refresh_locked(force)
-            key = (self._data_version, entry.state_version)
-            if entry.frame is not None and entry.frame_key == key:
-                return entry.frame
-            loop = asyncio.get_running_loop()
-            frame = await loop.run_in_executor(
-                None, self.service.compose_frame, entry.state
-            )
-            entry.frame = frame
-            entry.frame_key = key
+            frame, _ = await self._compose_locked(entry)
             return frame
 
     async def _get_sse_payload(self, entry: SessionEntry | None = None) -> bytes:
@@ -115,13 +122,8 @@ class DashboardServer:
             key = (self._data_version, entry.state_version)
             if entry.sse_bytes is not None and entry.sse_key == key:
                 return entry.sse_bytes
+            frame, key = await self._compose_locked(entry)
             loop = asyncio.get_running_loop()
-            if entry.frame is not None and entry.frame_key == key:
-                frame = entry.frame
-            else:
-                frame = await loop.run_in_executor(
-                    None, self.service.compose_frame, entry.state
-                )
             payload = await loop.run_in_executor(
                 None, lambda: f"data: {json.dumps(frame)}\n\n".encode()
             )

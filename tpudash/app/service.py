@@ -37,8 +37,10 @@ log = logging.getLogger(__name__)
 from tpudash import schema
 from tpudash.config import Config
 from tpudash.normalize import (
+    block_average,
     column_average,
     compute_stats,
+    dense_block,
     filter_selected,
     to_wide,
 )
@@ -72,6 +74,16 @@ class DashboardService:
         #: True between refresh_data() and the first compose_frame() that
         #: records the render stage and closes the timer frame
         self._frame_open = False
+        #: data-pull wall time shown on every frame composed from it
+        self.last_updated: str = _dt.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        )
+        #: per-refresh identity extraction shared across session composes
+        self._chips_base: list = []
+        self._ident_chips = None
+        self._ident_slices = None
+        self._ident_keys = None
+        self._ident_accels: list = []
         self.last_error: str | None = None
         #: wide per-chip table from the last successful frame (CSV export)
         self.last_df: "pd.DataFrame | None" = None
@@ -327,11 +339,60 @@ class DashboardService:
             )
         return rows
 
-    def _heatmaps(self, sel_df: pd.DataFrame, df: pd.DataFrame, panels) -> list:
-        """One heatmap per panel metric, per slice, over selected chips."""
+    def _heatmaps(
+        self, sel_df: pd.DataFrame, df: pd.DataFrame, panels, block=None
+    ) -> list:
+        """One heatmap per panel metric, per slice, over selected chips.
+
+        Pure-numpy grouping: the old groupby/boolean-mask version copied
+        the full mixed-dtype frame twice per slice (~8 ms/frame at 256
+        chips); this touches only the identity arrays and the shared
+        numeric block."""
         out = []
-        for slice_id, sdf in sel_df.groupby("slice_id", sort=True):
-            accels = accel_types_for(sdf)
+        arr, cols = block if block is not None else dense_block(sel_df)
+        col_pos = {c: i for i, c in enumerate(cols)}
+        # identity arrays come from the shared per-refresh extraction; the
+        # select-all fast path (filter_selected returns df itself) reuses
+        # them for the selection side too
+        ident_ok = (
+            self._ident_slices is not None
+            and len(self._ident_slices) == len(df)
+        )
+        if ident_ok:
+            all_slices = self._ident_slices
+            all_chips = self._ident_chips
+            all_keys = self._ident_keys
+        else:  # compose without a matching refresh (direct test calls)
+            all_slices = df["slice_id"].to_numpy()
+            all_chips = df["chip_id"].to_numpy()
+            all_keys = df.index.to_numpy()
+        if sel_df is df and ident_ok:
+            sel_slices, sel_chips = all_slices, all_chips
+            sel_accels = np.asarray(self._ident_accels, dtype=object)
+        else:
+            sel_slices = sel_df["slice_id"].to_numpy()
+            sel_chips = sel_df["chip_id"].to_numpy()
+            sel_accels = (
+                sel_df[schema.ACCEL_TYPE].fillna("").to_numpy()
+                if schema.ACCEL_TYPE in sel_df
+                else None
+            )
+        codes, uniques = pd.factorize(sel_slices, sort=True)
+        everything = len(sel_df) == len(df)  # select-all fast path
+        for g, slice_id in enumerate(uniques):
+            if len(uniques) == 1:
+                sel_idx = np.arange(len(sel_df))
+            else:
+                sel_idx = np.nonzero(codes == g)[0]
+            if everything and len(uniques) == 1:
+                all_ids, a_keys = all_chips, all_keys
+            else:
+                amask = all_slices == slice_id
+                all_ids, a_keys = all_chips[amask], all_keys[amask]
+            if sel_accels is not None:
+                accels = sorted({a for a in sel_accels[sel_idx] if a})
+            else:
+                accels = []
             generation = accels[0] if accels else self.cfg.generation
             # topology sized to the FULL slice population (not just the
             # selection) so partial selections keep real torus coordinates.
@@ -339,38 +400,36 @@ class DashboardService:
             # out near 9k chips) are excluded from sizing AND rendering:
             # per-series tolerance (sources/base.py), a corrupt series
             # drops its cell, it must not size a 2e9-cell grid or raise.
-            all_rows = df[df["slice_id"] == slice_id]  # full slice, once
-            all_ids = all_rows["chip_id"].to_numpy()
             sane = all_ids[(all_ids >= 0) & (all_ids < 16384)]
             if sane.size == 0:
                 continue
             n = int(sane.max()) + 1
             topo = topology_for(generation, n)
-            chip_ids = sdf["chip_id"].to_numpy()
+            chip_ids = sel_chips[sel_idx]
             in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
             # clickable cells: keys come from the FULL slice population so
             # a deselected chip can be clicked back on (symmetric toggle),
             # built once per slice and shared by every panel's figure
             ok = (all_ids >= 0) & (all_ids < topo.num_chips)
+            # .tolist() yields native ints/strs in one C pass (a per-cell
+            # int()/str() genexpr profiled at ~1 ms/frame at 256 chips)
             custom_grid = key_grid(
-                topo,
-                {
-                    int(cid): key
-                    for cid, key in zip(all_ids[ok], all_rows.index[ok])
-                },
+                topo, dict(zip(all_ids[ok].tolist(), a_keys[ok].tolist()))
             )
             for spec in panels:
-                if spec.column not in sdf.columns:
-                    continue
-                vals = pd.to_numeric(sdf[spec.column], errors="coerce").to_numpy(
-                    dtype=float, na_value=np.nan
-                )
+                ci = col_pos.get(spec.column)
+                if ci is None:
+                    if arr is not None or spec.column not in sel_df.columns:
+                        continue
+                if arr is not None:
+                    vals = arr[sel_idx, ci]
+                else:  # legacy mixed-dtype frames
+                    vals = pd.to_numeric(
+                        sel_df[spec.column].iloc[sel_idx], errors="coerce"
+                    ).to_numpy(dtype=float, na_value=np.nan)
                 mask = ~np.isnan(vals) & in_range
                 values = dict(
-                    zip(
-                        (int(c) for c in chip_ids[mask]),
-                        (float(v) for v in vals[mask]),
-                    )
+                    zip(chip_ids[mask].tolist(), vals[mask].tolist())
                 )
                 if not values:
                     continue
@@ -390,7 +449,7 @@ class DashboardService:
                 )
         return out
 
-    def _breakdown(self, sel_df: pd.DataFrame, panels) -> dict:
+    def _breakdown(self, sel_df: pd.DataFrame, panels, block=None) -> dict:
         """Per-slice and per-host averages over the selection — the fleet
         drill-down the reference's flat per-GPU list couldn't offer.  A
         dimension appears only when it actually distinguishes rows (>1
@@ -415,14 +474,23 @@ class DashboardService:
             return {}
         # pure-numpy group means (factorize + add.at), not groups×columns
         # column_average calls or pandas groupby machinery — at 256 chips
-        # the host dimension alone has 64+ groups and this runs per frame
-        sub = sel_df[cols]
-        if all(dt.kind in "fi" for dt in sub.dtypes):
-            arr = sub.to_numpy(dtype=np.float64, copy=True)
-        else:  # legacy mixed-dtype frames
-            arr = sub.apply(pd.to_numeric, errors="coerce").to_numpy(
-                dtype=np.float64, copy=True
-            )
+        # the host dimension alone has 64+ groups and this runs per frame.
+        # The numeric matrix comes from the shared per-frame block when the
+        # caller already extracted it (copy: zero-exclusion mutates cells).
+        blk_arr, blk_cols = (
+            block if block is not None else (None, [])
+        )
+        if blk_arr is not None and all(c in blk_cols for c in cols):
+            pos = [blk_cols.index(c) for c in cols]
+            arr = blk_arr[:, pos].copy()
+        else:
+            sub = sel_df[cols]
+            if all(dt.kind in "fi" for dt in sub.dtypes):
+                arr = sub.to_numpy(dtype=np.float64, copy=True)
+            else:  # legacy mixed-dtype frames
+                arr = sub.apply(pd.to_numeric, errors="coerce").to_numpy(
+                    dtype=np.float64, copy=True
+                )
         for i, column in enumerate(cols):
             # zero-exclusion becomes NaN-exclusion (app.py:341-345 policy)
             if column in schema.ZERO_EXCLUDED_METRICS:
@@ -465,6 +533,12 @@ class DashboardService:
         pts = list(self.history)
         stride = max(1, -(-len(pts) // max_points))
         pts = pts[::-1][::stride][::-1]  # stride anchored at the newest point
+        # timestamps are shared across panels: format each once, not once
+        # per panel (~1k strftime calls per frame otherwise)
+        fmt = {
+            ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+            for ts, _ in pts
+        }
         out = []
         for spec in panels:
             series = [
@@ -474,10 +548,7 @@ class DashboardService:
             ]
             if len(series) < 2:
                 continue
-            times = [
-                _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
-                for ts, _ in series
-            ]
+            times = [fmt[ts] for ts, _ in series]
             out.append(
                 {
                     "panel": spec.column,
@@ -506,6 +577,11 @@ class DashboardService:
         """
         self.timer.start_frame()
         self._frame_open = True
+        # stamped at SCRAPE time: composed frames must report when the data
+        # was pulled, not when a session re-rendered it (a selection toggle
+        # near the end of a refresh interval must not present interval-old
+        # metrics as current)
+        self.last_updated = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
         try:
             with self.timer.stage("scrape"):
                 samples = self.source.fetch()
@@ -525,7 +601,37 @@ class DashboardService:
             log.info("metrics source recovered")
         self.last_error = None
         self.last_df = df
-        self.available = list(df.index)
+        # Identity columns extracted ONCE per refresh and shared by every
+        # session's compose (arrow-backed string columns iterate per value
+        # on .tolist()/.to_numpy() — at 256 chips doing this per compose
+        # profiled at ~2 ms, and the chip-grid model is identical across
+        # sessions except for the per-session "selected" flag).
+        keys = df.index.tolist()
+        chip_id_list = df["chip_id"].tolist()
+        slice_list = df["slice_id"].tolist()
+        host_list = df["host"].tolist()
+        accel_list = (
+            df[schema.ACCEL_TYPE].fillna("").tolist()
+            if schema.ACCEL_TYPE in df
+            else [""] * len(df)
+        )
+        self._ident_chips = np.asarray(chip_id_list, dtype=np.int64)
+        self._ident_slices = np.asarray(slice_list, dtype=object)
+        self._ident_keys = np.asarray(keys, dtype=object)
+        self._ident_accels = accel_list
+        self._chips_base = [
+            {
+                "key": k,
+                "chip_id": int(c),
+                "slice": s,
+                "host": h,
+                "model": _model_name(a),
+            }
+            for k, c, s, h, a in zip(
+                keys, chip_id_list, slice_list, host_list, accel_list
+            )
+        ]
+        self.available = keys
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
                 self.last_alerts = self.alert_engine.evaluate(df)
@@ -554,7 +660,7 @@ class DashboardService:
         ``state`` defaults to the anonymous/global session."""
         state = state if state is not None else self.state
         frame: dict = {
-            "last_updated": _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+            "last_updated": self.last_updated,
             "refresh_interval": self.cfg.refresh_interval,
             "use_gauge": state.use_gauge,
             "error": self.last_error,
@@ -590,27 +696,8 @@ class DashboardService:
             use_gauge = state.use_gauge
 
             sel_set = set(selected)
-            accels = (
-                df[schema.ACCEL_TYPE].fillna("").tolist()
-                if schema.ACCEL_TYPE in df
-                else [""] * len(df)
-            )
             frame["chips"] = [
-                {
-                    "key": key,
-                    "chip_id": int(cid),
-                    "slice": sl,
-                    "host": host,
-                    "model": _model_name(accel),
-                    "selected": key in sel_set,
-                }
-                for key, cid, sl, host, accel in zip(
-                    df.index.tolist(),
-                    df["chip_id"].tolist(),
-                    df["slice_id"].tolist(),
-                    df["host"].tolist(),
-                    accels,
-                )
+                dict(c, selected=c["key"] in sel_set) for c in self._chips_base
             ]
             # copy: the cached frame must not alias the live selection list
             frame["selected"] = list(selected)
@@ -620,10 +707,25 @@ class DashboardService:
             ]
 
             if not sel_df.empty:
-                avgs = {
-                    spec.column: column_average(sel_df, spec.column)
-                    for spec in panels
-                }
+                # ONE numeric-matrix extraction shared by averages, stats,
+                # breakdowns, and heatmap values — each pandas column-subset
+                # copy profiled at ~3 ms/frame at 256 chips
+                block = dense_block(sel_df)
+                arr, cols = block
+                col_pos = {c: i for i, c in enumerate(cols)}
+                if arr is not None:
+                    avgs = {
+                        spec.column: block_average(
+                            arr, col_pos[spec.column], spec.column
+                        )
+                        for spec in panels
+                        if spec.column in col_pos
+                    }
+                else:  # legacy mixed-dtype frames
+                    avgs = {
+                        spec.column: column_average(sel_df, spec.column)
+                        for spec in panels
+                    }
                 frame["average"] = self._average_row(
                     sel_df, panels, use_gauge, avgs
                 )
@@ -633,14 +735,16 @@ class DashboardService:
                     frame["heatmaps"] = []
                 else:
                     frame["device_rows"] = []
-                    frame["heatmaps"] = self._heatmaps(sel_df, df, panels)
-                stats = compute_stats(sel_df)
+                    frame["heatmaps"] = self._heatmaps(
+                        sel_df, df, panels, block=block
+                    )
+                stats = compute_stats(sel_df, block=block)
                 # display rounding parity (app.py:480-481)
                 frame["stats"] = {
                     m: {k: round(v, 2) for k, v in s.items()}
                     for m, s in stats.items()
                 }
-                frame["breakdown"] = self._breakdown(sel_df, panels)
+                frame["breakdown"] = self._breakdown(sel_df, panels, block=block)
             else:
                 frame["average"] = None
                 frame["device_rows"] = []

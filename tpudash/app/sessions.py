@@ -82,12 +82,19 @@ class SessionStore:
         return len(self._entries)
 
     def entry(self, sid: "str | None") -> SessionEntry:
+        # TTL-sweep on EVERY access, not just inserts: each retained entry
+        # pins a cached full-figure payload, so expired sessions must not
+        # linger until the next brand-new visitor happens to arrive
+        now = self._clock()
+        self._evict(now)
         if not sid:
             return self.default
-        now = self._clock()
         e = self._entries.get(sid)
         if e is None:
-            self._evict(now)
+            # size bound applies only when inserting — never evict a live
+            # LRU entry just because an existing session was accessed
+            while len(self._entries) >= self.limit:
+                self._entries.popitem(last=False)
             e = self._entries[sid] = SessionEntry(SelectionState())
         else:
             self._entries.move_to_end(sid)
@@ -103,6 +110,3 @@ class SessionStore:
                 del self._entries[sid]
             else:
                 break
-        # keep room for the entry the caller is about to insert
-        while len(self._entries) >= self.limit:
-            self._entries.popitem(last=False)

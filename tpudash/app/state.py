@@ -36,13 +36,18 @@ class SelectionState:
     def sync(self, available: list[str]) -> list[str]:
         """Reconcile selections with the currently available chips:
         prune stale keys (app.py:281), default to the first chip when the
-        selection is empty (app.py:284-285), keep sorted (app.py:313)."""
-        avail = sorted(available, key=_sort_key)
-        self.selected = [k for k in self.selected if k in set(avail)]
-        if not self.selected and avail and not self._initialized:
-            self.selected = [avail[0]]
+        selection is empty (app.py:284-285), keep sorted (app.py:313).
+
+        Sorting invariant: every mutator (set_selected/toggle/select_all)
+        and load() keeps ``selected`` sorted, and pruning preserves order —
+        so this per-compose hot path (it ran two full sorts per frame at
+        256 chips, ~3 ms) does no sorting at all; the first-chip default
+        uses an O(n) min."""
+        avail_set = set(available)
+        self.selected = [k for k in self.selected if k in avail_set]
+        if not self.selected and available and not self._initialized:
+            self.selected = [min(available, key=_sort_key)]
         self._initialized = True
-        self.selected.sort(key=_sort_key)
         return self.selected
 
     def set_selected(self, keys: list[str], available: list[str]) -> list[str]:
@@ -101,7 +106,9 @@ class SelectionState:
         except (OSError, json.JSONDecodeError, TypeError) as e:
             log.warning("ignoring unreadable state checkpoint %s: %s", path, e)
             return False
-        self.selected = selected
+        # restore sorted (sync() relies on the mutator-maintained invariant
+        # and never re-sorts; a hand-edited checkpoint must not break it)
+        self.selected = sorted(selected, key=_sort_key)
         self.use_gauge = use_gauge
         self.last_selection = last_selection
         # a restored (possibly empty) selection is deliberate — don't
