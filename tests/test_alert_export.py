@@ -1,0 +1,141 @@
+"""Prometheus alerting-rule export: one rule source, two enforcement points.
+
+The YAML served by /api/alert-rules.yaml must round-trip: parsed back with
+a real YAML loader, every in-app rule appears with the same expression,
+severity, and hysteresis window.
+"""
+
+import asyncio
+import os
+
+import yaml
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.alerts import (
+    AlertEngine,
+    AlertRule,
+    parse_rules,
+    prometheus_rules_yaml,
+    rule_promql,
+)
+from tpudash.app.server import DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def test_roundtrip_against_engine_rules():
+    spec = (
+        "tpu_temperature_celsius>85:critical@2,"
+        "hbm_usage_ratio>90:warning@3,"
+        "tpu_power_watts<=5"
+    )
+    rules = parse_rules(spec)
+    text = prometheus_rules_yaml(rules, refresh_interval=5.0)
+    doc = yaml.safe_load(text)
+    group = doc["groups"][0]
+    assert group["name"] == "tpudash"
+    assert group["interval"] == "5s"
+    assert len(group["rules"]) == len(rules)
+    by_expr = {r["expr"]: r for r in group["rules"]}
+    for rule in rules:
+        expr = rule_promql(rule)
+        assert expr in by_expr, f"missing rule for {rule.name}"
+        out = by_expr[expr]
+        assert out["labels"]["severity"] == rule.severity
+        assert out["for"] == f"{(rule.for_cycles - 1) * 5}s"
+        assert rule.name in out["annotations"]["description"]
+
+
+def test_derived_columns_expand_to_raw_series_promql():
+    rule = parse_rules("hbm_usage_ratio>92@2")[0]
+    expr = rule_promql(rule)
+    # Prometheus can't see the dashboard's derived column — the export
+    # recomputes it from the raw scraped series
+    assert "tpu_hbm_used_bytes" in expr and "tpu_hbm_total_bytes" in expr
+    assert expr.endswith("> 92")
+    # raw series pass through untouched
+    assert rule_promql(parse_rules("tpu_power_watts>400")[0]) == (
+        "tpu_power_watts > 400"
+    )
+
+
+def test_hysteresis_maps_to_for_duration():
+    rules = [AlertRule("tpu_temperature_celsius", ">", 85.0, "critical", 4)]
+    doc = yaml.safe_load(prometheus_rules_yaml(rules, refresh_interval=10.0))
+    assert doc["groups"][0]["rules"][0]["for"] == "30s"
+
+
+def test_default_rules_export_parses():
+    engine = AlertEngine.from_spec(None)
+    doc = yaml.safe_load(prometheus_rules_yaml(engine.rules))
+    names = {r["alert"] for r in doc["groups"][0]["rules"]}
+    assert "TpudashTpuTemperatureCelsiusGt85" in names
+    assert "TpudashHbmUsageRatioGt92" in names
+
+
+def test_endpoint_serves_yaml_and_404s_when_disabled():
+    def app_for(alert_rules):
+        cfg = Config(
+            source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+            alert_rules=alert_rules,
+        )
+        service = DashboardService(cfg, FixtureSource(FIXTURE))
+        return DashboardServer(service).build_app()
+
+    async def go():
+        client = TestClient(TestServer(app_for("")))
+        await client.start_server()
+        try:
+            resp = await client.get("/api/alert-rules.yaml")
+            assert resp.status == 200
+            assert "yaml" in resp.headers["Content-Type"]
+            doc = yaml.safe_load(await resp.text())
+            assert doc["groups"][0]["rules"]
+        finally:
+            await client.close()
+        client = TestClient(TestServer(app_for("off")))
+        await client.start_server()
+        try:
+            assert (await client.get("/api/alert-rules.yaml")).status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_alias_aware_exprs_fire_on_raw_dialect_series():
+    # the Prometheus loading these rules scrapes the RAW exporter — GKE
+    # device-plugin series keep their native names there, so the expr must
+    # match both the canonical and the dialect spellings
+    expr = rule_promql(parse_rules("tpu_tensorcore_utilization>95")[0])
+    assert "duty_cycle" in expr and " or " in expr
+    assert expr.startswith("(") and expr.endswith("> 95")
+    # dotted libtpu ids are not valid PromQL metric names and stay out
+    assert "tpu.runtime" not in expr
+
+
+def test_one_sided_bandwidth_sum_still_matches():
+    expr = rule_promql(parse_rules("ici_total_gbps>50")[0])
+    # (tx + rx) or tx or rx — a source exporting only one direction must
+    # not produce an empty vector (normalize treats the missing side as 0)
+    assert expr.count("tpu_ici_tx_bytes_per_second") >= 2
+    assert " or " in expr
+
+
+def test_rules_on_same_column_get_distinct_names():
+    rules = parse_rules("hbm_usage_ratio>80,hbm_usage_ratio>95")
+    doc = yaml.safe_load(prometheus_rules_yaml(rules))
+    names = [r["alert"] for r in doc["groups"][0]["rules"]]
+    assert len(names) == len(set(names)) == 2
+
+
+def test_for_zero_on_single_cycle_rules():
+    # for_cycles=1 fires the banner on the first breaching frame; the
+    # export must not demand the breach survive an extra evaluation
+    doc = yaml.safe_load(
+        prometheus_rules_yaml(parse_rules("tpu_power_watts>400"))
+    )
+    assert doc["groups"][0]["rules"][0]["for"] == "0s"

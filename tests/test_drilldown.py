@@ -1,0 +1,180 @@
+"""Per-chip drill-down: /api/chip + per-chip history ring.
+
+Restores the reference's per-device gauge-row insight (app.py:411-476) at
+256-chip scale — one chip at a time, reached by clicking a heatmap cell.
+"""
+
+import asyncio
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash import schema
+from tpudash.app.server import SESSION_COOKIE, DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _server(source=None, **cfg_kwargs):
+    kwargs = {
+        "source": "fixture",
+        "fixture_path": FIXTURE,
+        "refresh_interval": 0.0,
+        **cfg_kwargs,
+    }
+    cfg = Config(**kwargs)
+    service = DashboardService(cfg, source or FixtureSource(FIXTURE))
+    return DashboardServer(service)
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_chip_detail_endpoint():
+    async def go():
+        client = await _client(_server().build_app())
+        try:
+            await client.get("/api/frame")  # two frames → trends exist
+            await client.get("/api/frame")
+            resp = await client.get("/api/chip?key=slice-0/0")
+            assert resp.status == 200
+            d = await resp.json()
+            assert d["key"] == "slice-0/0"
+            assert d["chip_id"] == 0 and d["slice"] == "slice-0"
+            assert d["model"]  # resolved generation name
+            panels = {f["panel"] for f in d["figures"]}
+            assert schema.TENSORCORE_UTIL in panels
+            assert d["figures"][0]["figure"]["data"][0]["type"] == "indicator"
+            # per-chip sparklines after two history points
+            assert d["trends"], "expected chip trends after two frames"
+            assert d["trends"][0]["figure"]["data"][0]["type"] == "scatter"
+            assert len(d["trends"][0]["figure"]["data"][0]["y"]) == 2
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_chip_detail_unknown_404_and_missing_key_400():
+    async def go():
+        client = await _client(_server().build_app())
+        try:
+            await client.get("/api/frame")
+            assert (await client.get("/api/chip?key=slice-9/99")).status == 404
+            assert (await client.get("/api/chip")).status == 400
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_chip_detail_respects_session_style():
+    async def go():
+        client = await _client(_server().build_app())
+        try:
+            sid = {SESSION_COOKIE: "bar-viewer"}
+            await client.post("/api/style", json={"use_gauge": False}, cookies=sid)
+            d = await (await client.get("/api/chip?key=slice-0/0", cookies=sid)).json()
+            assert d["figures"][0]["figure"]["data"][0]["type"] == "bar"
+            # another session still sees gauges
+            d2 = await (await client.get("/api/chip?key=slice-0/0")).json()
+            assert d2["figures"][0]["figure"]["data"][0]["type"] == "indicator"
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_chip_history_endpoint_and_downsampled_ring():
+    async def go():
+        client = await _client(_server().build_app())
+        try:
+            for _ in range(3):
+                await client.get("/api/frame")
+            resp = await client.get("/api/history?chip=slice-0/1")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["chip"] == "slice-0/1"
+            assert len(data["history"]) == 3
+            point = data["history"][-1]
+            assert "ts" in point
+            assert schema.TENSORCORE_UTIL in point["values"]
+            # unknown chip → 404
+            assert (await client.get("/api/history?chip=nope")).status == 404
+            # fleet-average mode unchanged
+            data = await (await client.get("/api/history")).json()
+            assert "averages" in data["history"][0]
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_chip_ring_resets_when_population_changes():
+    class Growing(SyntheticSource):
+        pass
+
+    src4 = SyntheticSource(num_chips=4)
+    server = _server(source=src4)
+    svc = server.service
+    svc.render_frame()
+    assert len(svc.chip_history) == 1
+    svc.render_frame()
+    assert len(svc.chip_history) == 2
+    # chip population changes → ring resets, realigned to the new keys
+    svc.source = SyntheticSource(num_chips=8)
+    svc.render_frame()
+    assert len(svc.chip_history) == 1
+    assert len(svc._chip_hist_keys) == 8
+
+
+def test_chip_detail_includes_torus_neighbors():
+    server = _server(source=SyntheticSource(num_chips=16, generation="v5e"))
+    svc = server.service
+    svc.render_frame()
+    d = svc.chip_detail("slice-0/5")
+    # 4x4 torus: chip 5 = (x=1, y=1) has 4 distinct neighbors
+    assert d is not None
+    assert len(d["neighbors"]) == 4
+    assert all(n.startswith("slice-0/") for n in d["neighbors"])
+
+
+def test_chip_detail_cached_per_data_refresh():
+    # with a long refresh interval, repeated /api/chip calls (SSE ticks of
+    # an open drill panel) must not rebuild the figures every time
+    calls = {"n": 0}
+
+    async def go():
+        server = _server(refresh_interval=60.0)
+        svc = server.service
+        orig = svc.chip_detail
+
+        def counting(key, use_gauge=True, **kw):
+            calls["n"] += 1
+            return orig(key, use_gauge, **kw)
+
+        svc.chip_detail = counting
+        client = await _client(server.build_app())
+        try:
+            await client.get("/api/frame")
+            for _ in range(5):
+                assert (await client.get("/api/chip?key=slice-0/0")).status == 200
+            assert calls["n"] == 1  # five ticks, one build
+            # style flip is a different cache key
+            await client.post("/api/style", json={"use_gauge": False})
+            await client.get("/api/chip?key=slice-0/0")
+            assert calls["n"] == 2
+        finally:
+            await client.close()
+
+    _run(go())

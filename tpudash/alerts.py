@@ -204,3 +204,131 @@ class AlertEngine:
 
     def firing(self, alerts: list[dict] | None = None) -> list[dict]:
         return [a for a in (alerts or []) if a["state"] == "firing"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus alerting-rule export — the in-app thresholds and the cluster
+# pager must agree (one rule source, two enforcement points).
+# ---------------------------------------------------------------------------
+
+def _series_expr(name: str) -> str:
+    """A canonical series as PromQL that also matches its real-world
+    dialect spellings: the Prometheus evaluating these rules scrapes the
+    RAW exporter (GKE device-plugin series like ``duty_cycle``) — only
+    tpudash renames at its own parse (compat.SERIES_ALIASES).  Dotted
+    libtpu metric ids are excluded (not valid PromQL metric names; their
+    underscore forms are already in the alias table)."""
+    from tpudash import compat
+
+    aliases = sorted(
+        src
+        for src, dst in compat.SERIES_ALIASES.items()
+        if dst == name and "." not in src
+    )
+    if not aliases:
+        return name
+    return "(" + " or ".join([name, *aliases]) + ")"
+
+
+def _sum_expr(a: str, b: str) -> str:
+    """``a + b`` where a missing side counts as 0, mirroring the in-app
+    derive (normalize._derive: ``df.get(..., 0.0)``).  Plain PromQL vector
+    addition drops series with no match on the other side, so a one-sided
+    source would silently produce an empty vector."""
+    ea, eb = _series_expr(a), _series_expr(b)
+    return f"(({ea} + {eb}) or {ea} or {eb})"
+
+
+def _derived_promql(column: str) -> "str | None":
+    """PromQL recomputing a tpudash DERIVED column from raw scraped series
+    (formulas mirror normalize._derive / _batch_to_wide)."""
+    if column == "hbm_usage_ratio":
+        used = _series_expr("tpu_hbm_used_bytes")
+        total = _series_expr("tpu_hbm_total_bytes")
+        return f"{used} / ({total} > 0) * 100"
+    if column == "hbm_used_gib":
+        return f"{_series_expr('tpu_hbm_used_bytes')} / 1073741824"
+    if column == "ici_total_gbps":
+        return (
+            _sum_expr(
+                "tpu_ici_tx_bytes_per_second", "tpu_ici_rx_bytes_per_second"
+            )
+            + " / 1e9"
+        )
+    if column == "dcn_total_gbps":
+        return (
+            _sum_expr(
+                "tpu_dcn_tx_bytes_per_second", "tpu_dcn_rx_bytes_per_second"
+            )
+            + " / 1e9"
+        )
+    return None
+
+
+def rule_promql(rule: AlertRule) -> str:
+    """One rule's PromQL alert expression (alias-aware, derived-column
+    aware)."""
+    derived = _derived_promql(rule.column)
+    base = f"({derived})" if derived else _series_expr(rule.column)
+    return f"{base} {rule.op} {rule.threshold:g}"
+
+
+def prometheus_rules_yaml(
+    rules: "list[AlertRule]", refresh_interval: float = 5.0
+) -> str:
+    """The engine's rules as a Prometheus alerting-rule file (YAML).
+
+    ``for:`` carries the same hysteresis the in-app engine applies:
+    for_cycles consecutive breaching frames ≈ for_cycles × the scrape /
+    refresh interval.  Emitted by hand (sorted keys, quoted strings) so
+    the output is stable and needs no YAML dependency at runtime; the
+    round-trip test parses it back with a real YAML loader.
+    """
+    interval = max(refresh_interval, 1.0)
+    lines = [
+        "# Generated by tpudash — mirror of TPUDASH_ALERT_RULES so the",
+        "# dashboard banner and the cluster pager fire on the same",
+        "# conditions.  Load via prometheus rule_files.",
+        "groups:",
+        "- name: tpudash",
+        f"  interval: {interval:g}s",
+        "  rules:",
+    ]
+    op_words = {">": "Gt", ">=": "Ge", "<": "Lt", "<=": "Le"}
+    for rule in rules:
+        # the in-app engine fires on the Nth consecutive breaching frame;
+        # Prometheus `for: D` fires once a breach has persisted D beyond
+        # its first evaluation, i.e. ~N evaluations for D=(N-1)*interval.
+        # D=N*interval would need N+1 — one cycle stricter than the banner.
+        hold = int(round((rule.for_cycles - 1) * interval))
+        # name carries column+op+threshold so several rules on one column
+        # stay distinct (duplicate alert names collapse in Alertmanager)
+        threshold_part = (
+            f"{rule.threshold:g}".replace(".", "_").replace("-", "Minus")
+        )
+        alert_name = (
+            "Tpudash"
+            + "".join(part.capitalize() for part in rule.column.split("_"))
+            + op_words[rule.op]
+            + threshold_part
+        )
+        lines += [
+            f"  - alert: {alert_name}",
+            f"    expr: {rule_promql(rule)}",
+            f"    for: {hold}s",
+            "    labels:",
+            f"      severity: {rule.severity}",
+            "    annotations:",
+            (
+                "      summary: '{{ $labels.chip_id }} "
+                f"{rule.column} {rule.op} {rule.threshold:g} "
+                "(value {{ $value }})'"
+            ),
+            (
+                f"      description: 'tpudash rule {rule.name}: breach held "
+                f"for {rule.for_cycles} consecutive "
+                f"{'frame' if rule.for_cycles == 1 else 'frames'} "
+                f"({hold}s at a {interval:g}s cadence)'"
+            ),
+        ]
+    return "\n".join(lines) + "\n"

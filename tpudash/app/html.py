@@ -50,6 +50,14 @@ PAGE = r"""<!DOCTYPE html>
   .heat { display: grid; gap: 2px; margin-top: 6px; }
   .heat div { aspect-ratio: 1; border-radius: 2px; min-width: 10px; }
   #debug { color: #6b7a8c; font-size: 12px; margin-top: 18px; }
+  #drill { display: none; background: #fff; border: 2px solid #8fa7c4;
+           border-radius: 8px; padding: 10px 14px; margin: 14px 0; }
+  .drill-head { display: flex; align-items: baseline; gap: 12px; }
+  .drill-head button { margin-left: auto; }
+  .drill-alerts { color: #a8322a; font-size: 13px; margin: 6px 0; }
+  .neighbors { font-size: 13px; color: #44556a; margin-top: 8px; }
+  .neighbors button { margin-left: 4px; }
+  .hint { color: #6b7a8c; font-size: 12px; }
 </style>
 </head>
 <body>
@@ -61,14 +69,17 @@ PAGE = r"""<!DOCTYPE html>
   <div id="error-banner"></div>
   <div id="warning-banner"></div>
   <div id="alert-banner"></div>
+  <div id="gap-note" class="hint" style="display:none; margin-bottom: 8px;"></div>
   <div class="controls">
     <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
     <button id="select-all">Select all</button>
     <button id="select-none">Clear</button>
     <a id="csv-link" href="/api/export.csv" download="tpudash.csv">Export CSV</a>
     <span id="chip-count"></span>
+    <span class="hint">click a heatmap cell for chip detail &middot; shift-click toggles selection</span>
   </div>
   <div id="chip-grid"></div>
+  <div id="drill"></div>
   <div id="panels"></div>
   <div class="row-title">Statistics (selected chips)</div>
   <div id="stats"></div>
@@ -125,7 +136,9 @@ function renderHeatFallback(el, trace, layoutTitle) {
     <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
   el.querySelector('.heat').addEventListener('click', e => {
     const key = e.target.getAttribute && e.target.getAttribute('data-key');
-    if (key) post('/api/select', {toggle: key});
+    if (!key) return;
+    if (e.shiftKey) post('/api/select', {toggle: key});
+    else showChip(key);
   });
 }
 
@@ -154,7 +167,9 @@ function renderFigure(el, fig) {
       el._heatClick = true;  // panel divs are rebuilt per frame
       el.on('plotly_click', ev => {
         const key = ev.points && ev.points[0] && ev.points[0].customdata;
-        if (key) post('/api/select', {toggle: key});
+        if (!key) return;
+        if (ev.event && ev.event.shiftKey) post('/api/select', {toggle: key});
+        else showChip(key);
       });
     }
     return;
@@ -195,6 +210,72 @@ async function post(url, body) {
                     headers: authHeaders({'Content-Type': 'application/json'}),
                     body: JSON.stringify(body)});
   await refresh();
+}
+
+// ---- per-chip drill-down (click a heatmap cell) ---------------------------
+let drillKey = null;
+
+async function showChip(key) {
+  drillKey = key;
+  await refreshDrill();
+  const el = document.getElementById('drill');
+  if (el.style.display !== 'none') el.scrollIntoView({behavior: 'smooth', block: 'nearest'});
+}
+
+function closeDrill() {
+  drillKey = null;
+  const el = document.getElementById('drill');
+  el.style.display = 'none';
+  el.innerHTML = '';
+}
+
+async function refreshDrill() {
+  const key = drillKey;  // snapshot: user may close / switch mid-fetch
+  if (!key) return;
+  let resp;
+  try {
+    resp = await fetch('/api/chip?key=' + encodeURIComponent(key),
+                       {headers: authHeaders()});
+  } catch (e) { return; /* transient: keep the last detail */ }
+  if (drillKey !== key) return;  // closed or moved on — drop the response
+  if (resp.status === 404) { closeDrill(); return; /* chip left the fleet */ }
+  if (!resp.ok) return;  // transient server/auth hiccup: keep last detail
+  const detail = await resp.json();
+  if (drillKey === key) renderDrill(detail);
+}
+
+function renderDrill(d) {
+  const el = document.getElementById('drill');
+  el.style.display = 'block';
+  let html = `<div class="drill-head"><span class="row-title">TPU ${+d.chip_id}` +
+    ` &mdash; ${esc(d.slice)} / ${esc(d.host)} (${esc(d.model)})</span>` +
+    `<button id="drill-close">close</button></div>`;
+  const firing = (d.alerts || []).filter(a => a.state === 'firing');
+  if (firing.length) {
+    html += `<div class="drill-alerts">⚠ ` +
+      firing.map(a => esc(a.rule) + ' (=' + (+a.value) + ')').join(' · ') + '</div>';
+  }
+  html += '<div class="panel-row" id="drill-gauges"></div>';
+  html += '<div class="panel-row" id="drill-trends"></div>';
+  if (d.neighbors && d.neighbors.length) {
+    html += `<div class="neighbors">ICI neighbors:` +
+      d.neighbors.map(n => `<button data-chip="${esc(n)}">${esc(n)}</button>`).join('') +
+      '</div>';
+  }
+  el.innerHTML = html;
+  for (const [rowId, figs] of [['drill-gauges', d.figures], ['drill-trends', d.trends]]) {
+    const row = document.getElementById(rowId);
+    for (const f of figs || []) {
+      const cell = document.createElement('div');
+      cell.className = 'panel';
+      row.appendChild(cell);
+      renderFigure(cell, f.figure);
+    }
+  }
+  document.getElementById('drill-close').addEventListener('click', closeDrill);
+  for (const btn of el.querySelectorAll('.neighbors button')) {
+    btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
+  }
 }
 
 function renderChips(chips) {
@@ -316,6 +397,8 @@ function applyFrame(frame) {
   if (heat.length) panelRow(panels, 'Topology heatmaps', heat);
   renderStats(frame.stats || {});
   renderBreakdown(frame.breakdown, frame.panel_specs);
+  showPanelGaps(frame.unavailable_panels);
+  if (drillKey) refreshDrill();  // keep the open chip detail live
   const t = frame.timings || {};
   document.getElementById('debug').textContent =
     'Debug: frames=' + (t.frames || 0) +
@@ -380,6 +463,17 @@ function showAlerts(list) {
   b.textContent = '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
     .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
     (firing.length > 8 ? ' \u2026' : '');
+}
+
+function showPanelGaps(list) {
+  // a core panel the source can't feed is declared, never silently absent
+  const b = document.getElementById('gap-note');
+  if (list && list.length) {
+    b.style.display = 'block';
+    b.innerHTML = 'Hidden panels: ' + list.map(g =>
+      `<span title="${esc(g.reason)}">${esc(g.title)}</span>`).join(' · ') +
+      ' <small>(hover for why)</small>';
+  } else b.style.display = 'none';
 }
 
 function showWarnings(list) {

@@ -57,6 +57,9 @@ class DashboardServer:
         #: state_version to key the per-session compose caches
         self._data_version = 0
         self._data_at: float = 0.0
+        #: (data_version, {(chip_key, use_gauge): detail}) — drill-down
+        #: responses cached for the life of one data refresh
+        self._chip_cache: tuple = (-1, {})
         self._device_trace_active = False  # jax profiler is a singleton
 
     def _entry(self, request: web.Request) -> SessionEntry:
@@ -376,12 +379,62 @@ class DashboardServer:
         )
 
     async def history(self, request: web.Request) -> web.Response:
-        """Raw rolling history of selected-average values per metric."""
+        """Raw rolling history: fleet-average values per metric, or — with
+        ``?chip=<key>`` — one chip's own series from the per-chip ring."""
+        chip = request.query.get("chip")
         async with self._lock:  # render_frame appends from the worker thread
-            snapshot = list(self.service.history)
+            if chip is None:
+                snapshot = list(self.service.history)
+                return web.json_response(
+                    {
+                        "history": [
+                            {"ts": ts, "averages": avgs}
+                            for ts, avgs in snapshot
+                        ]
+                    }
+                )
+            series = self.service.chip_series(chip)
+        if series is None:
+            raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
         return web.json_response(
-            {"history": [{"ts": ts, "averages": avgs} for ts, avgs in snapshot]}
+            {
+                "chip": chip,
+                "history": [
+                    {"ts": ts, "values": values} for ts, values in series
+                ],
+            }
         )
+
+    async def chip(self, request: web.Request) -> web.Response:
+        """Single-chip drill-down model (identity + gauges + chip trends +
+        alerts + ICI neighbors) — reached by clicking a heatmap cell."""
+        key = request.query.get("key")
+        if not key:
+            raise web.HTTPBadRequest(text="missing ?key=<slice>/<chip>")
+        entry = self._entry(request)
+        if self.service.last_df is None:
+            await self._get_frame(entry=entry)  # prime on first request
+        use_gauge = entry.state.use_gauge
+        async with self._lock:
+            # details change only when the data does: with N open drill
+            # panels each SSE tick would otherwise rebuild ~10 figures per
+            # panel under the frame lock, queueing every compose behind it
+            cache_key = (key, use_gauge)
+            version, cached = self._chip_cache
+            if version == self._data_version and cache_key in cached:
+                detail = cached[cache_key]
+            else:
+                loop = asyncio.get_running_loop()
+                detail = await loop.run_in_executor(
+                    None, self.service.chip_detail, key, use_gauge
+                )
+                if version != self._data_version:
+                    cached = {}
+                cached[cache_key] = detail
+                self._chip_cache = (self._data_version, cached)
+        if detail is None:
+            raise web.HTTPNotFound(text=f"unknown chip {key!r}")
+        return web.json_response(detail)
 
     async def alerts(self, request: web.Request) -> web.Response:
         """Current alert states (firing + pending), critical first."""
@@ -389,16 +442,66 @@ class DashboardServer:
             snapshot = list(self.service.last_alerts)
         return web.json_response({"alerts": snapshot})
 
+    async def alert_rules_yaml(self, request: web.Request) -> web.Response:
+        """The active alert rules as a Prometheus alerting-rule file, so
+        the cluster pager can be configured from the same source of truth
+        as the in-app banner (TPUDASH_ALERT_RULES)."""
+        engine = self.service.alert_engine
+        if engine is None:
+            raise web.HTTPNotFound(
+                text="alerting disabled (TPUDASH_ALERT_RULES=off)"
+            )
+        from tpudash.alerts import prometheus_rules_yaml
+
+        text = prometheus_rules_yaml(
+            engine.rules, self.service.cfg.refresh_interval
+        )
+        return web.Response(
+            text=text,
+            content_type="application/yaml",
+            headers={
+                "Content-Disposition": "attachment; filename=tpudash-alerts.yaml"
+            },
+        )
+
     async def schema(self, request: web.Request) -> web.Response:
         """Self-documenting API: every scraped series (with exporter help
         text), derived columns, panels, and generation registry — what a
         programmatic consumer needs to interpret /api/frame and the CSV."""
         from tpudash import compat
         from tpudash import schema as s
+        from tpudash.app.service import PANEL_GAP_REASONS
         from tpudash.registry import TPU_GENERATIONS
 
+        df = self.service.last_df
+        capabilities = {
+            "source": self.service.source.name,
+            # columns the ACTIVE source actually delivered last scrape
+            # (None until the first successful frame)
+            "available_columns": (
+                sorted(map(str, df.columns)) if df is not None else None
+            ),
+            "panel_gaps": (
+                [
+                    {
+                        "column": spec.column,
+                        "title": spec.title,
+                        "reason": PANEL_GAP_REASONS.get(
+                            spec.column, "no source series in the current scrape"
+                        ),
+                    }
+                    for spec in s.PANELS
+                    if df is not None and spec.column not in df.columns
+                ]
+            ),
+            # standing dialect limitations, independent of the active source
+            "dialect_notes": {
+                col: reason for col, reason in PANEL_GAP_REASONS.items()
+            },
+        }
         return web.json_response(
             {
+                "capabilities": capabilities,
                 "scrape_series": [
                     {"name": name, "help": s.SERIES_HELP.get(name, "")}
                     for name in (
@@ -481,7 +584,9 @@ class DashboardServer:
         app.router.add_get("/api/schema", self.schema)
         app.router.add_post("/api/profile", self.profile)
         app.router.add_get("/api/history", self.history)
+        app.router.add_get("/api/chip", self.chip)
         app.router.add_get("/api/alerts", self.alerts)
+        app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
         return app
 

@@ -57,6 +57,26 @@ from tpudash.viz.figures import (
 )
 
 
+#: Known real-world dialect gaps, shown when a reference-parity panel has
+#: no series in the current scrape: neither the GKE tpu-device-plugin nor
+#: the libtpu runtime-metrics surface carries power or temperature
+#: (tpudash.compat SERIES_ALIASES cover duty-cycle/HBM/MXU/mem-BW only) —
+#: only the in-repo exporter/probe sources provide them.
+PANEL_GAP_REASONS = {
+    schema.POWER: (
+        "no power series in this scrape — the GKE tpu-device-plugin and "
+        "libtpu runtime dialects do not export power; use the tpudash "
+        "exporter/probe source for it"
+    ),
+    schema.TEMPERATURE: (
+        "no temperature series in this scrape — the GKE tpu-device-plugin "
+        "and libtpu runtime dialects do not export temperature; use the "
+        "tpudash exporter/probe source for it"
+    ),
+}
+_GENERIC_GAP = "no source series in the current scrape"
+
+
 @functools.lru_cache(maxsize=256)
 def _model_name(accel: str) -> str:
     gen = resolve_generation(accel)
@@ -92,10 +112,22 @@ class DashboardService:
         self.available: list[str] = []
         if cfg.state_path and self.state.load(cfg.state_path):
             log.info("restored UI state from %s", cfg.state_path)
-        #: rolling (wall_ts, {column: selected-average}) per successful
+        #: rolling (wall_ts, {column: fleet-average}) per successful
         #: frame — trend history the reference never kept.  At the default
         #: 5 s cadence, 720 points ≈ one hour.
         self.history: deque = deque(maxlen=720)
+        #: per-CHIP rolling history for the drill-down view: (wall_ts,
+        #: float32 matrix) aligned to _chip_hist_keys rows and
+        #: _chip_hist_cols columns.  720 × 256 chips × ~10 metrics ≈ 7 MB.
+        #: The ring resets when the chip population or metric set changes
+        #: (slice resize, new exporter) — alignment beats splicing.
+        self.chip_history: deque = deque(maxlen=720)
+        self._chip_hist_keys: list = []
+        self._chip_hist_cols: list = []
+        self._chip_hist_rowmap: dict = {}
+        #: full-table dense block from the last refresh — shared by the
+        #: history appends and select-all composes
+        self._df_block = (None, [])
         if cfg.history_backfill > 0:
             self._backfill_history()
         #: threshold alerting over every chip in the table (not just the
@@ -563,6 +595,130 @@ class DashboardService:
             )
         return out
 
+    def chip_detail(
+        self,
+        key: str,
+        use_gauge: bool = True,
+        max_points: int = 200,
+    ) -> "dict | None":
+        """Single-chip drill-down: identity, current panel gauges, per-chip
+        trend sparklines, its firing alerts, and its ICI neighbors — the
+        per-device insight of the reference's gauge rows (app.py:411-476)
+        restored at 256-chip scale, one chip at a time.  None when the chip
+        is not in the last table (404 upstream)."""
+        df = self.last_df
+        if df is None or key not in df.index:
+            return None
+        row = df.loc[key]
+        accel = row.get(schema.ACCEL_TYPE, "") or ""
+        panels = self._active_panels(df)
+        figures = []
+        for spec in panels:
+            value = row.get(spec.column)
+            if value is None or pd.isna(value):
+                continue
+            figures.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_visualization(
+                        float(value),
+                        spec,
+                        use_gauge=use_gauge,
+                        height=self.cfg.device_panel_height,
+                        accel_types=[accel] if accel else None,
+                    ),
+                }
+            )
+        # per-chip sparklines from the chip ring
+        trends = []
+        hist_row = self._chip_hist_rowmap.get(key)
+        if hist_row is not None and len(self.chip_history) >= 2:
+            pts = list(self.chip_history)
+            stride = max(1, -(-len(pts) // max_points))
+            pts = pts[::-1][::stride][::-1]  # anchored at the newest point
+            fmt = {
+                ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+                for ts, _ in pts
+            }
+            col_pos = {c: i for i, c in enumerate(self._chip_hist_cols)}
+            for spec in panels:
+                ci = col_pos.get(spec.column)
+                if ci is None:
+                    continue
+                series = [
+                    (ts, float(m[hist_row, ci]))
+                    for ts, m in pts
+                    if m[hist_row, ci] == m[hist_row, ci]  # skip NaN
+                ]
+                if len(series) < 2:
+                    continue
+                trends.append(
+                    {
+                        "panel": spec.column,
+                        "figure": create_sparkline(
+                            [fmt[ts] for ts, _ in series],
+                            [v for _, v in series],
+                            title=f"{spec.title} — chip trend",
+                            max_val=panel_max(
+                                spec, [accel] if accel else None
+                            ),
+                            unit=spec.unit,
+                        ),
+                    }
+                )
+        # torus neighbors = the chips it shares ICI links with
+        neighbors: list = []
+        try:
+            slice_id = row["slice_id"]
+            same = df[df["slice_id"] == slice_id]
+            ids = same["chip_id"].to_numpy()
+            sane = ids[(ids >= 0) & (ids < 16384)]
+            if sane.size:
+                topo = topology_for(
+                    accel or self.cfg.generation, int(sane.max()) + 1
+                )
+                cid = int(row["chip_id"])
+                if 0 <= cid < topo.num_chips:
+                    want = set(topo.neighbors(cid))
+                    neighbors = [
+                        k
+                        for k, c in zip(same.index.tolist(), ids.tolist())
+                        if c in want
+                    ]
+        except Exception:  # noqa: BLE001 — neighbors are best-effort context
+            neighbors = []
+        return {
+            "key": key,
+            "chip_id": int(row["chip_id"]),
+            "slice": str(row["slice_id"]),
+            "host": str(row.get("host", "")),
+            "model": _model_name(accel),
+            "accelerator_type": accel,
+            "figures": figures,
+            "trends": trends,
+            "alerts": [a for a in self.last_alerts if a.get("chip") == key],
+            "neighbors": neighbors,
+            "last_updated": self.last_updated,
+        }
+
+    def chip_series(self, key: str) -> "list[tuple[float, dict]] | None":
+        """One chip's raw history from the per-chip ring as
+        [(ts, {column: value-or-None}), ...] — the ring's internal layout
+        (row alignment, float32 matrices, reset-on-population-change) stays
+        encapsulated here; /api/history?chip= serves this verbatim.
+        Returns None for a chip the ring has never seen."""
+        row = self._chip_hist_rowmap.get(key)
+        if row is None:
+            return None
+        cols = list(self._chip_hist_cols)
+        out = []
+        for ts, m in self.chip_history:
+            vals = m[row].tolist()
+            out.append(
+                (ts, {c: (v if v == v else None) for c, v in zip(cols, vals)})
+            )
+        return out
+
     # -- the frame -----------------------------------------------------------
     def refresh_data(self) -> "pd.DataFrame | None":
         """Scrape → normalize → alerts → trend history: the shared half of
@@ -641,16 +797,38 @@ class DashboardService:
         # Averages cover ALL chips in scope — per-browser selections are
         # session-local now and must not steer the shared sparklines; this
         # also matches the backfill scope (_backfill_history).
+        arr, cols = self._df_block = dense_block(df)
         now = time.time()
         if (
             not self.history
             or now - self.history[-1][0] >= self.cfg.refresh_interval
         ):
-            avgs = {
-                p.column: column_average(df, p.column)
-                for p in self._active_panels(df)
-            }
+            if arr is not None:
+                col_pos = {c: i for i, c in enumerate(cols)}
+                avgs = {
+                    p.column: block_average(arr, col_pos[p.column], p.column)
+                    for p in self._active_panels(df)
+                    if p.column in col_pos
+                }
+            else:
+                avgs = {
+                    p.column: column_average(df, p.column)
+                    for p in self._active_panels(df)
+                }
             self.history.append((now, avgs))
+            # per-chip ring (drill-down trends), same cadence
+            if arr is not None:
+                if (
+                    keys != self._chip_hist_keys
+                    or cols != self._chip_hist_cols
+                ):
+                    self.chip_history.clear()
+                    self._chip_hist_keys = keys
+                    self._chip_hist_cols = cols
+                    self._chip_hist_rowmap = {
+                        k: i for i, k in enumerate(keys)
+                    }
+                self.chip_history.append((now, arr.astype(np.float32)))
         return df
 
     def compose_frame(self, state: "SelectionState | None" = None) -> dict:
@@ -705,12 +883,32 @@ class DashboardService:
                 {"column": p.column, "title": p.title, "unit": p.unit}
                 for p in panels
             ]
+            # capability honesty: a reference-parity panel (util/HBM/temp/
+            # power, app.py:352-409) the source cannot feed is declared
+            # with a reason, never silently dropped
+            frame["unavailable_panels"] = [
+                {
+                    "column": s.column,
+                    "title": s.title,
+                    "reason": PANEL_GAP_REASONS.get(s.column, _GENERIC_GAP),
+                }
+                for s in schema.PANELS
+                if s.column not in df.columns
+            ]
 
             if not sel_df.empty:
                 # ONE numeric-matrix extraction shared by averages, stats,
                 # breakdowns, and heatmap values — each pandas column-subset
-                # copy profiled at ~3 ms/frame at 256 chips
-                block = dense_block(sel_df)
+                # copy profiled at ~3 ms/frame at 256 chips.  The select-all
+                # fast path reuses the block refresh_data already extracted.
+                if (
+                    sel_df is df
+                    and self._df_block[0] is not None
+                    and self._df_block[0].shape[0] == len(df)
+                ):
+                    block = self._df_block
+                else:
+                    block = dense_block(sel_df)
                 arr, cols = block
                 col_pos = {c: i for i, c in enumerate(cols)}
                 if arr is not None:
