@@ -40,17 +40,29 @@ def bench_dashboard() -> dict:
     svc.render_frame()  # warm (imports, first pivot)
     svc.state.select_all(svc.available)
     svc.timer.history.clear()  # warm-up frame must not contaminate p50/p95
+    frame = None
     for _ in range(N_FRAMES):
+        prev = frame
         frame = svc.render_frame()
         assert frame["error"] is None
         assert len(frame["selected"]) == N_CHIPS
         assert frame["heatmaps"], "256-chip frame must use heatmap mode"
     p50 = svc.timer.percentile(0.5)
     p95 = svc.timer.percentile(0.95)
-    # wire cost: one full SSE tick for this 256-chip select-all frame —
-    # what every subscriber downloads per refresh interval
-    payload = f"data: {json.dumps(frame)}\n\n".encode()
-    return {"p50_s": p50, "p95_s": p95, "sse_bytes": len(payload)}
+    # wire cost per subscriber per refresh interval: the first tick's full
+    # frame vs the steady-state value-only delta (tpudash/app/delta.py)
+    from tpudash.app.delta import frame_delta
+
+    payload = f"data: {json.dumps(dict(frame, kind='full'))}\n\n".encode()
+    delta = frame_delta(prev, frame)
+    assert delta is not None, "steady-state frames must be delta-patchable"
+    delta_payload = f"data: {json.dumps(delta)}\n\n".encode()
+    return {
+        "p50_s": p50,
+        "p95_s": p95,
+        "sse_bytes": len(payload),
+        "sse_delta_bytes": len(delta_payload),
+    }
 
 
 def bench_3d_torus() -> dict:

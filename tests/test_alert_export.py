@@ -139,3 +139,17 @@ def test_for_zero_on_single_cycle_rules():
         prometheus_rules_yaml(parse_rules("tpu_power_watts>400"))
     )
     assert doc["groups"][0]["rules"][0]["for"] == "0s"
+
+
+def test_huge_and_fractional_values_stay_loadable():
+    # >=1e6 thresholds hit %g exponent notation: the '+' must not leak
+    # into the alert name, and fractional intervals must use integer units
+    rules = parse_rules("tpu_hbm_used_bytes>100000000000")
+    doc = yaml.safe_load(prometheus_rules_yaml(rules, refresh_interval=2.5))
+    import re
+
+    group = doc["groups"][0]
+    assert re.fullmatch(r"[0-9]+(ms|s)", group["interval"])
+    assert group["interval"] == "2500ms"
+    name = group["rules"][0]["alert"]
+    assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name

@@ -1,0 +1,123 @@
+"""Frame-diff transport: apply_delta(prev, frame_delta(prev, cur)) == cur.
+
+The delta protocol's whole correctness story is that the patched frame is
+bit-identical to the frame the server would have sent in full — pinned
+here over real service frames, at gauge scale (device rows) and heatmap
+scale (256 chips), plus the structure-change cases that must force a full
+frame.
+"""
+
+import json
+import os
+
+from tpudash.app.delta import apply_delta, frame_delta
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _svc(source=None, **kw):
+    cfg = Config(**{"refresh_interval": 0.0, **kw})
+    return DashboardService(cfg, source or FixtureSource(FIXTURE))
+
+
+def _strip(frame):
+    return {k: v for k, v in frame.items() if k != "timings"}
+
+
+def test_roundtrip_identity_gauge_scale():
+    svc = _svc()
+    svc.render_frame()  # warm: the 2nd frame grows sparklines (structural)
+    prev = svc.render_frame()
+    cur = svc.render_frame()
+    delta = frame_delta(prev, cur)
+    assert delta is not None and delta["kind"] == "delta"
+    patched = apply_delta(prev, delta)
+    # timings are copied verbatim; everything else must match exactly
+    assert patched == cur
+
+
+def test_roundtrip_identity_heatmap_scale():
+    svc = _svc(SyntheticSource(num_chips=256), synthetic_chips=256)
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    prev = svc.render_frame()
+    cur = svc.render_frame()
+    assert cur["heatmaps"], "select-all at 256 chips must render heatmaps"
+    delta = frame_delta(prev, cur)
+    assert delta is not None
+    assert apply_delta(prev, delta) == cur
+    # and the wire win is real: the delta is a fraction of the full frame
+    full = len(json.dumps(cur))
+    slim = len(json.dumps(delta))
+    assert slim < 0.5 * full, f"delta {slim}B vs full {full}B"
+
+
+def test_prev_not_mutated():
+    svc = _svc()
+    svc.render_frame()
+    prev = svc.render_frame()
+    snapshot = json.dumps(prev, sort_keys=True)
+    cur = svc.render_frame()
+    apply_delta(prev, frame_delta(prev, cur))
+    assert json.dumps(prev, sort_keys=True) == snapshot
+
+
+def test_selection_change_forces_full():
+    svc = _svc()
+    prev = svc.render_frame()
+    svc.state.select_all(svc.available)
+    cur = svc.render_frame()
+    assert frame_delta(prev, cur) is None
+
+
+def test_style_change_forces_full():
+    svc = _svc()
+    prev = svc.render_frame()
+    svc.state.use_gauge = False
+    cur = svc.render_frame()
+    assert frame_delta(prev, cur) is None
+
+
+def test_error_frames_force_full():
+    from tpudash.sources.base import SourceError
+
+    class Flaky(FixtureSource):
+        fail = False
+
+        def fetch(self):
+            if self.fail:
+                raise SourceError("down")
+            return super().fetch()
+
+    src = Flaky(FIXTURE)
+    svc = _svc(src)
+    good = svc.render_frame()
+    src.fail = True
+    bad = svc.render_frame()
+    assert bad["error"] is not None
+    assert frame_delta(good, bad) is None
+    assert frame_delta(bad, good) is None
+
+
+def test_population_change_forces_full():
+    svc = _svc(SyntheticSource(num_chips=4))
+    prev = svc.render_frame()
+    svc.source = SyntheticSource(num_chips=8)
+    cur = svc.render_frame()
+    assert frame_delta(prev, cur) is None
+
+
+def test_trend_appearance_forces_full():
+    # the first frame has no sparklines (one history point); the second
+    # grows them — a structural change, not a patchable one
+    svc = _svc()
+    f1 = svc.render_frame()
+    f2 = svc.render_frame()
+    if f1["trends"] == f2["trends"]:
+        return  # layout did not change in this environment
+    assert frame_delta(f1, f2) is None or apply_delta(
+        f1, frame_delta(f1, f2)
+    ) == f2

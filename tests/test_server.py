@@ -100,16 +100,21 @@ def test_stream_pushes_frames():
         assert resp.status == 200
         assert resp.headers["Content-Type"].startswith("text/event-stream")
         events = []
-        for _ in range(2):  # frames keep flowing, not just one
+        for _ in range(3):  # frames keep flowing, not just one
             raw = await asyncio.wait_for(
                 resp.content.readuntil(b"\n\n"), timeout=10
             )
             events.append(json.loads(raw.decode()[len("data: ") :]))
+        # first event is a full frame; steady-state ticks are value-only
+        # deltas (frame-diff transport, tpudash/app/delta.py).  The 2nd
+        # frame grows sparklines — a structural change, so still full.
+        assert events[0]["kind"] == "full"
         assert events[0]["error"] is None
         assert [c["key"] for c in events[0]["chips"]] == [
             "slice-0/0", "slice-0/1",
         ]
-        assert events[1]["chips"]
+        assert events[2]["kind"] == "delta"
+        assert "stats" in events[2] and "chips" not in events[2]
         resp.close()
 
     _run(_with_client(_client_app(), go))

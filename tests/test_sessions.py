@@ -242,8 +242,11 @@ def test_stream_keeps_session_alive_and_tracks_replacement():
                 raw = await asyncio.wait_for(
                     resp.content.readuntil(b"\n\n"), timeout=10
                 )
+                if raw.startswith(b":"):
+                    continue  # keepalive comment
                 frame = _json.loads(raw.decode()[len("data: "):])
-                if frame["selected"] == ["slice-0/0", "slice-0/1"]:
+                # deltas carry no selection; the post-select tick is full
+                if frame.get("selected") == ["slice-0/0", "slice-0/1"]:
                     break
             else:
                 raise AssertionError("stream never reflected the new entry")

@@ -285,13 +285,19 @@ def prometheus_rules_yaml(
     round-trip test parses it back with a real YAML loader.
     """
     interval = max(refresh_interval, 1.0)
+    # Prometheus durations take integer units only — "2.5s" rejects the
+    # whole rule file; fractional intervals are expressed in ms
+    if interval == int(interval):
+        interval_str = f"{int(interval)}s"
+    else:
+        interval_str = f"{int(round(interval * 1000))}ms"
     lines = [
         "# Generated by tpudash — mirror of TPUDASH_ALERT_RULES so the",
         "# dashboard banner and the cluster pager fire on the same",
         "# conditions.  Load via prometheus rule_files.",
         "groups:",
         "- name: tpudash",
-        f"  interval: {interval:g}s",
+        f"  interval: {interval_str}",
         "  rules:",
     ]
     op_words = {">": "Gt", ">=": "Ge", "<": "Lt", "<=": "Le"}
@@ -303,8 +309,13 @@ def prometheus_rules_yaml(
         hold = int(round((rule.for_cycles - 1) * interval))
         # name carries column+op+threshold so several rules on one column
         # stay distinct (duplicate alert names collapse in Alertmanager)
+        # alert names allow [a-zA-Z0-9_] only: dots → "_", sign chars from
+        # "%g" exponent forms ("1e+11", "-5") → words / dropped
         threshold_part = (
-            f"{rule.threshold:g}".replace(".", "_").replace("-", "Minus")
+            f"{rule.threshold:g}"
+            .replace(".", "_")
+            .replace("-", "Minus")
+            .replace("+", "")
         )
         alert_name = (
             "Tpudash"

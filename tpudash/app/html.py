@@ -408,13 +408,47 @@ function applyFrame(frame) {
 }
 
 // ---- transport: SSE push with polling fallback ----------------------------
+// Steady-state ticks arrive as value-only deltas (kind: "delta") patched
+// into the last full frame — applyDelta mirrors tpudash/app/delta.py
+// apply_delta field for field; change both together.
+let lastFrame = null;
+
+function applyDelta(f, d) {
+  for (const k of ['last_updated', 'timings', 'source_health', 'alerts',
+                   'warnings', 'stats', 'breakdown', 'unavailable_panels']) {
+    if (k in d) f[k] = d[k]; else delete f[k];
+  }
+  const patchFig = (fig, p) => {
+    const t = fig.data[0];
+    if (t.type === 'indicator') { t.value = p.value; t.gauge.bar.color = p.color; }
+    else { t.x = [p.value]; t.marker.color = p.color; }
+  };
+  if (d.average) d.average.forEach((p, i) => patchFig(f.average.figures[i].figure, p));
+  if (d.device_rows) d.device_rows.forEach((patches, i) =>
+    patches.forEach((p, j) => patchFig(f.device_rows[i].figures[j].figure, p)));
+  if (d.heatmaps) d.heatmaps.forEach((z, i) => { f.heatmaps[i].figure.data[0].z = z; });
+  if (d.trends) d.trends.forEach((p, i) => {
+    const t = f.trends[i].figure.data[0];
+    t.x = p.x; t.y = p.y; t.line.color = p.color;
+  });
+  return f;
+}
+
 function startStream() {
   if (!window.EventSource) return;  // old browser → polling stays active
   const es = new EventSource(streamUrl('/api/stream'));
   es.onmessage = e => {
     streaming = true;
     if (timer) { clearInterval(timer); timer = null; }
-    applyFrame(JSON.parse(e.data));
+    const msg = JSON.parse(e.data);
+    if (msg.kind === 'delta') {
+      if (!lastFrame) { refresh(); return; }  // missed the full frame
+      lastFrame = applyDelta(lastFrame, msg);
+      applyFrame(lastFrame);
+    } else {
+      lastFrame = msg;
+      applyFrame(msg);
+    }
   };
   es.onerror = () => {
     // server restart / proxy hiccup: drop to polling; EventSource

@@ -79,10 +79,14 @@ class DashboardServer:
             self._data_version += 1
             self._data_at = time.monotonic()
 
-    async def _compose_locked(self, entry: SessionEntry) -> "tuple[dict, tuple]":
+    async def _compose_locked(
+        self, entry: SessionEntry, keep_prev: bool = False
+    ) -> "tuple[dict, tuple]":
         """Per-session compose with its (data_version, state_version) cache
         key.  Caller holds _lock and has already run _refresh_locked — the
-        single copy of the cache-keying protocol both transports share."""
+        single copy of the cache-keying protocol both transports share.
+        ``keep_prev`` retains the outgoing frame for the delta transport;
+        pure-polling sessions never pay that second frame's memory."""
         key = (self._data_version, entry.state_version)
         if entry.frame is not None and entry.frame_key == key:
             return entry.frame, key
@@ -90,6 +94,9 @@ class DashboardServer:
         frame = await loop.run_in_executor(
             None, self.service.compose_frame, entry.state
         )
+        if keep_prev and entry.frame is not None:
+            entry.prev_frame = entry.frame
+            entry.prev_frame_key = entry.frame_key
         entry.frame = frame
         entry.frame_key = key
         return frame, key
@@ -108,33 +115,61 @@ class DashboardServer:
             frame, _ = await self._compose_locked(entry)
             return frame
 
-    async def _get_sse_payload(self, entry: SessionEntry | None = None) -> bytes:
-        """Current frame as a serialized SSE event.  Serialized ONCE per
-        (data, state) version per session no matter how many stream
-        subscribers tick — frames embed full figure JSON, so per-subscriber
-        json.dumps would stall the event loop at many open tabs.
+    async def _get_sse_event(
+        self, entry: SessionEntry, client_key: "tuple | None"
+    ) -> "tuple[bytes, tuple]":
+        """(payload, key) for one stream tick.  Sends, in order of
+        preference: a keepalive comment when the client already holds the
+        current frame; a value-only delta when the client's frame can be
+        patched to the current one (tpudash.app.delta); otherwise a full
+        frame.  Payloads are serialized once per (from, to) step per
+        session and shared by all of its subscribers.
 
-        Runs refresh → compose → serialize under ONE lock hold so the
-        cached bytes are always stamped with the version they were actually
-        composed from.  A streaming session keeps only the serialized bytes
-        (the frame dict is dropped) — one cached payload per session, not
-        two."""
+        Runs refresh → compose → diff → serialize under ONE lock hold so
+        cached bytes are always stamped with the version they were
+        composed from."""
+        from tpudash.app.delta import frame_delta
+
         entry = entry if entry is not None else self.sessions.entry(None)
         async with self._lock:
             await self._refresh_locked(False)
-            key = (self._data_version, entry.state_version)
-            if entry.sse_bytes is not None and entry.sse_key == key:
-                return entry.sse_bytes
-            frame, key = await self._compose_locked(entry)
+            frame, key = await self._compose_locked(entry, keep_prev=True)
+            if client_key == key:
+                # nothing new: SSE comment (ignored by EventSource)
+                return b": keepalive\n\n", key
             loop = asyncio.get_running_loop()
+            if (
+                client_key is not None
+                and client_key == entry.prev_frame_key
+                and entry.prev_frame is not None
+            ):
+                if (
+                    entry.sse_delta is not None
+                    and entry.sse_delta_keys == (client_key, key)
+                ):
+                    return entry.sse_delta, key
+                prev = entry.prev_frame
+
+                def build_delta():
+                    delta = frame_delta(prev, frame)
+                    if delta is None:
+                        return None
+                    return f"data: {json.dumps(delta)}\n\n".encode()
+
+                payload = await loop.run_in_executor(None, build_delta)
+                if payload is not None:
+                    entry.sse_delta = payload
+                    entry.sse_delta_keys = (client_key, key)
+                    return payload, key
+            if entry.sse_full is not None and entry.sse_full_key == key:
+                return entry.sse_full, key
             payload = await loop.run_in_executor(
-                None, lambda: f"data: {json.dumps(frame)}\n\n".encode()
+                None,
+                lambda: f"data: {json.dumps(dict(frame, kind='full'))}\n\n".encode(),
             )
-            entry.sse_bytes = payload
-            entry.sse_key = key
-            entry.frame = None
-            entry.frame_key = None
-            return payload
+            entry.sse_full = payload
+            entry.sse_full_key = key
+            return payload, key
 
     async def _mutate(self, entry: SessionEntry, fn):
         """Run a state mutation under the frame lock: service renders on
@@ -182,13 +217,17 @@ class DashboardServer:
             }
         )
         await resp.prepare(request)
+        client_key = None  # version pair this subscriber last received
         try:
             while True:
                 # re-resolve every tick: touches last_seen so an actively
                 # streamed session is never TTL-evicted, and picks up the
                 # replacement entry if it somehow was
                 entry = self.sessions.entry(sid)
-                await resp.write(await self._get_sse_payload(entry))
+                payload, client_key = await self._get_sse_event(
+                    entry, client_key
+                )
+                await resp.write(payload)
                 await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
         except (ConnectionResetError, asyncio.CancelledError):
             pass  # client went away — normal termination
@@ -416,6 +455,12 @@ class DashboardServer:
             await self._get_frame(entry=entry)  # prime on first request
         use_gauge = entry.state.use_gauge
         async with self._lock:
+            # cheap membership gate BEFORE the cache and the executor: an
+            # unknown-key probe loop must neither grow the cache nor
+            # serialize figure builds behind the frame lock
+            df = self.service.last_df
+            if df is None or key not in df.index:
+                raise web.HTTPNotFound(text=f"unknown chip {key!r}")
             # details change only when the data does: with N open drill
             # panels each SSE tick would otherwise rebuild ~10 figures per
             # panel under the frame lock, queueing every compose behind it
@@ -428,8 +473,8 @@ class DashboardServer:
                 detail = await loop.run_in_executor(
                     None, self.service.chip_detail, key, use_gauge
                 )
-                if version != self._data_version:
-                    cached = {}
+                if version != self._data_version or len(cached) > 2048:
+                    cached = {}  # bound: ≤ 2 styles × chip count, reset
                 cached[cache_key] = detail
                 self._chip_cache = (self._data_version, cached)
         if detail is None:
@@ -470,7 +515,7 @@ class DashboardServer:
         programmatic consumer needs to interpret /api/frame and the CSV."""
         from tpudash import compat
         from tpudash import schema as s
-        from tpudash.app.service import PANEL_GAP_REASONS
+        from tpudash.app.service import _GENERIC_GAP, PANEL_GAP_REASONS
         from tpudash.registry import TPU_GENERATIONS
 
         df = self.service.last_df
@@ -487,7 +532,7 @@ class DashboardServer:
                         "column": spec.column,
                         "title": spec.title,
                         "reason": PANEL_GAP_REASONS.get(
-                            spec.column, "no source series in the current scrape"
+                            spec.column, _GENERIC_GAP
                         ),
                     }
                     for spec in s.PANELS

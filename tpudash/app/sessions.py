@@ -30,15 +30,24 @@ from tpudash.app.state import SelectionState
 
 
 class SessionEntry:
-    """One viewer session: its selection state plus render caches."""
+    """One viewer session: its selection state plus render caches.
+
+    A streaming session retains the current AND previous composed frames
+    (the frame-diff transport, tpudash.app.delta, patches one into the
+    other) plus the serialized full/delta payloads for the current step —
+    bounded per session, swept by the store's TTL/LRU eviction."""
 
     __slots__ = (
         "state",
         "state_version",
         "frame",
         "frame_key",
-        "sse_bytes",
-        "sse_key",
+        "prev_frame",
+        "prev_frame_key",
+        "sse_full",
+        "sse_full_key",
+        "sse_delta",
+        "sse_delta_keys",
         "last_seen",
     )
 
@@ -49,8 +58,12 @@ class SessionEntry:
         self.state_version = 0
         self.frame: "dict | None" = None
         self.frame_key: "tuple | None" = None
-        self.sse_bytes: "bytes | None" = None
-        self.sse_key: "tuple | None" = None
+        self.prev_frame: "dict | None" = None
+        self.prev_frame_key: "tuple | None" = None
+        self.sse_full: "bytes | None" = None
+        self.sse_full_key: "tuple | None" = None
+        self.sse_delta: "bytes | None" = None
+        self.sse_delta_keys: "tuple | None" = None  # (from_key, to_key)
         self.last_seen = 0.0
 
 

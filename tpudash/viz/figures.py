@@ -23,12 +23,18 @@ from tpudash.topology import Topology, grid_layout, heatmap_grid
 
 
 @functools.lru_cache(maxsize=64)
-def _hover_prefixes(topo: Topology) -> tuple:
-    """Cached per-topology hover-text prefixes ("chip N (x, y)<br>") — the
-    only per-frame part of the hover label is the value suffix."""
-    return tuple(
-        f"chip {cid} {topo.coords(cid)}<br>" for cid in range(topo.num_chips)
-    )
+def _hover_prefix_grid(topo: Topology) -> tuple:
+    """Cached per-topology hover prefixes ("chip N (x, y)") projected onto
+    the rendered grid.  The VALUE part of the hover label comes from a
+    ``hovertemplate`` referencing ``%{z}`` instead of a per-frame text
+    grid — so the hover machinery costs nothing per frame and nothing on
+    the delta wire (tpudash.app.delta ships z-matrices only)."""
+    ny, nx, cells = grid_layout(topo)
+    grid = [[""] * nx for _ in range(ny)]
+    for cid in range(topo.num_chips):
+        y, x = cells[cid]
+        grid[y][x] = f"chip {cid} {topo.coords(cid)}"
+    return tuple(tuple(row) for row in grid)
 
 
 def create_gauge(
@@ -142,6 +148,9 @@ def create_sparkline(
     SURVEY.md §5 'tracing: absent').  Color follows the latest value's
     band."""
     latest = values[-1] if values else 0.0
+    # 2dp: the float32 per-chip ring would otherwise ship values like
+    # 53.33000183105469 — display shows 1dp, the wire pays 3x for noise
+    values = [round(v, 2) for v in values]
     return {
         "data": [
             {
@@ -199,21 +208,16 @@ def create_topology_heatmap(
     clicking its cell — including cells of currently-deselected chips.
     """
     grid = heatmap_grid(topo, values)
-    ny, nx, cells = grid_layout(topo)
-
-    prefixes = _hover_prefixes(topo)
-    hover = [[""] * nx for _ in range(ny)]
-    for cid, v in values.items():
-        y, col = cells[cid]
-        hover[y][col] = f"{prefixes[cid]}{v:.1f}{unit}"
 
     trace = {
         "type": "heatmap",
         "z": grid,
         "zmin": 0,
         "zmax": max_val,
-        "text": hover,
-        "hoverinfo": "text",
+        # static per-topology prefixes + a template pulling the value from
+        # %{z}: hover stays informative with zero per-frame text payload
+        "text": _hover_prefix_grid(topo),
+        "hovertemplate": "%{text}<br>%{z:.1f}" + unit + "<extra></extra>",
         "colorscale": _HEAT_COLORSCALE,
         "xgap": 2,
         "ygap": 2,
