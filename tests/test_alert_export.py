@@ -153,3 +153,12 @@ def test_huge_and_fractional_values_stay_loadable():
     assert group["interval"] == "2500ms"
     name = group["rules"][0]["alert"]
     assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+
+
+def test_fractional_hold_uses_ms_units():
+    rules = parse_rules("tpu_temperature_celsius>85@3")  # hold = 2 * 2.5s
+    doc = yaml.safe_load(prometheus_rules_yaml(rules, refresh_interval=2.5))
+    assert doc["groups"][0]["rules"][0]["for"] == "5s"
+    rules = parse_rules("tpu_temperature_celsius>85@2")  # hold = 1 * 2.5s
+    doc = yaml.safe_load(prometheus_rules_yaml(rules, refresh_interval=2.5))
+    assert doc["groups"][0]["rules"][0]["for"] == "2500ms"

@@ -23,10 +23,6 @@ def _svc(source=None, **kw):
     return DashboardService(cfg, source or FixtureSource(FIXTURE))
 
 
-def _strip(frame):
-    return {k: v for k, v in frame.items() if k != "timings"}
-
-
 def test_roundtrip_identity_gauge_scale():
     svc = _svc()
     svc.render_frame()  # warm: the 2nd frame grows sparklines (structural)
@@ -121,3 +117,19 @@ def test_trend_appearance_forces_full():
     assert frame_delta(f1, f2) is None or apply_delta(
         f1, frame_delta(f1, f2)
     ) == f2
+
+
+def test_unknown_figure_type_forces_full_not_crash():
+    # a future non-gauge panel figure must degrade to full frames, never
+    # crash the stream mid-delta
+    svc = _svc()
+    svc.render_frame()
+    prev = svc.render_frame()
+    cur = svc.render_frame()
+    assert frame_delta(prev, cur) is not None  # sanity: patchable as-is
+    weird = json.loads(json.dumps(cur))
+    weird["average"]["figures"][0]["figure"]["data"][0] = {
+        "type": "scatterpolar", "r": [1.0]
+    }
+    assert frame_delta(prev, weird) is None
+    assert frame_delta(weird, cur) is None

@@ -485,3 +485,83 @@ def test_differential_fuzz_text_parser():
             continue
         df_py = to_wide(py_samples)
         assert_frames_equal(batch, df_py)
+
+
+# --- exposition-text ENCODER parity (the reverse direction) ----------------
+
+def _sample(metric, value, chip=0, slice_id="slice-0", host="h0", accel="v5e"):
+    return schema.Sample(
+        metric=metric,
+        value=value,
+        chip=schema.ChipKey(slice_id=slice_id, host=host, chip_id=chip),
+        accelerator_type=accel,
+    )
+
+
+def test_encode_parity_synthetic_fleet():
+    from tpudash.exporter.textfmt import encode_samples_py
+    from tpudash.sources.fixture import SyntheticSource
+
+    samples = SyntheticSource(num_chips=64, num_slices=2).fetch()
+    if not isinstance(samples, list):
+        samples = samples.to_samples()
+    assert native.encode_samples(samples) == encode_samples_py(samples)
+
+
+def test_encode_parity_escaping_and_empty_accel():
+    from tpudash.exporter.textfmt import encode_samples_py
+
+    samples = [
+        _sample("tpu_power_watts", 42.5, host='we"ird\\host\nname'),
+        _sample("tpu_power_watts", 7.0, chip=1, accel=""),  # label dropped
+        _sample("m2", 1.0, slice_id='s"l\\i\nce'),
+    ]
+    out = native.encode_samples(samples)
+    assert out == encode_samples_py(samples)
+    assert '\\"ird\\\\host\\nname' in out
+
+
+def test_encode_parity_value_formatting_fuzz():
+    from tpudash.exporter.textfmt import encode_samples_py
+
+    rng = np.random.default_rng(7)
+    values = [
+        0.0, -0.0, 1.0, -1.5, 1e-9, 123456789.123456789, 1e20, 3.0000000001,
+        2**53 + 1.0, 0.1 + 0.2,
+        *(float(v) for v in rng.uniform(-1e12, 1e12, size=200)),
+        *(float(v) for v in rng.uniform(-1, 1, size=200)),
+    ]
+    samples = [
+        _sample("tpu_custom_metric", v, chip=i) for i, v in enumerate(values)
+    ]
+    native_out = native.encode_samples(samples)
+    py_out = encode_samples_py(samples)
+    assert native_out == py_out
+
+
+def test_encode_roundtrips_through_both_parsers():
+    from tpudash.exporter.textfmt import parse_text_format
+
+    samples = [
+        _sample("tpu_tensorcore_utilization", 55.5),
+        _sample("tpu_tensorcore_utilization", 44.25, chip=1),
+        _sample("tpu_power_watts", 101.0),
+    ]
+    text = native.encode_samples(samples)
+    batch = native.parse_text(text)
+    df_native = to_wide(batch)
+    df_py = to_wide(parse_text_format(text))
+    assert df_native.equals(df_py)
+    assert float(df_py.loc["slice-0/0", "tpu_tensorcore_utilization"]) == 55.5
+
+
+def test_encode_dispatch_uses_native():
+    # the public encode_samples must route through the kernel when built
+    samples = [_sample("tpu_power_watts", 5.0)]
+    assert encode_samples(samples) == native.encode_samples(samples)
+
+
+def test_encode_empty_parity():
+    from tpudash.exporter.textfmt import encode_samples_py
+
+    assert native.encode_samples([]) == encode_samples_py([])

@@ -306,7 +306,13 @@ def prometheus_rules_yaml(
         # Prometheus `for: D` fires once a breach has persisted D beyond
         # its first evaluation, i.e. ~N evaluations for D=(N-1)*interval.
         # D=N*interval would need N+1 — one cycle stricter than the banner.
-        hold = int(round((rule.for_cycles - 1) * interval))
+        # Same integer-unit rule as the group interval: fractional holds
+        # are expressed in ms, never "2.5s".
+        hold_s = (rule.for_cycles - 1) * interval
+        if hold_s == int(hold_s):
+            hold = f"{int(hold_s)}s"
+        else:
+            hold = f"{int(round(hold_s * 1000))}ms"
         # name carries column+op+threshold so several rules on one column
         # stay distinct (duplicate alert names collapse in Alertmanager)
         # alert names allow [a-zA-Z0-9_] only: dots → "_", sign chars from
@@ -326,7 +332,7 @@ def prometheus_rules_yaml(
         lines += [
             f"  - alert: {alert_name}",
             f"    expr: {rule_promql(rule)}",
-            f"    for: {hold}s",
+            f"    for: {hold}",
             "    labels:",
             f"      severity: {rule.severity}",
             "    annotations:",
@@ -339,7 +345,7 @@ def prometheus_rules_yaml(
                 f"      description: 'tpudash rule {rule.name}: breach held "
                 f"for {rule.for_cycles} consecutive "
                 f"{'frame' if rule.for_cycles == 1 else 'frames'} "
-                f"({hold}s at a {interval:g}s cadence)'"
+                f"(hold {hold} at a {interval_str} cadence)'"
             ),
         ]
     return "\n".join(lines) + "\n"

@@ -34,14 +34,17 @@ SCALAR_FIELDS = (
 )
 
 
-def _gauge_like(figure: dict) -> "tuple | None":
-    """(type, axis_max) for a gauge/bar panel figure; None for others."""
+def _gauge_like(figure: dict) -> tuple:
+    """(type, axis_max) for a gauge/bar panel figure.  Any other trace
+    type raises: _signature's catch turns that into a full-frame fallback
+    instead of letting _fig_value crash the stream on a figure kind the
+    patch protocol doesn't know."""
     trace = figure["data"][0]
     if trace["type"] == "indicator":
         return ("indicator", trace["gauge"]["axis"]["range"][1])
     if trace["type"] == "bar":
         return ("bar", figure["layout"]["xaxis"]["range"][1])
-    return None
+    raise TypeError(f"unpatchable figure type {trace['type']!r}")
 
 
 def _signature(frame: dict) -> "tuple | None":

@@ -130,7 +130,6 @@ class DashboardServer:
         composed from."""
         from tpudash.app.delta import frame_delta
 
-        entry = entry if entry is not None else self.sessions.entry(None)
         async with self._lock:
             await self._refresh_locked(False)
             frame, key = await self._compose_locked(entry, keep_prev=True)

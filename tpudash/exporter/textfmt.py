@@ -12,11 +12,15 @@ from __future__ import annotations
 
 import math
 
-from tpudash import compat
+import logging
+
+from tpudash import compat, native
 from tpudash.schema import ChipKey, Sample
 
 #: HELP strings for known series (unknown series get a generic line).
 from tpudash.schema import SERIES_HELP as _HELP  # single source of truth
+
+log = logging.getLogger(__name__)
 
 
 def _escape_label_value(v: str) -> str:
@@ -24,8 +28,20 @@ def _escape_label_value(v: str) -> str:
 
 
 def encode_samples(samples: list[Sample]) -> str:
-    """Samples → exposition text.  Series are grouped (HELP/TYPE emitted
-    once per metric name, in first-seen order); all series are gauges."""
+    """Samples → exposition text.  Dispatches to the native kernel when
+    built (byte-identical output — differential parity in
+    tests/test_native.py), else the pure-Python encoder below."""
+    if native.is_available():
+        try:
+            return native.encode_samples(samples)
+        except Exception as e:  # noqa: BLE001 — encoding must never fail
+            log.warning("native encoder failed, using python: %s", e)
+    return encode_samples_py(samples)
+
+
+def encode_samples_py(samples: list[Sample]) -> str:
+    """Pure-Python encoder.  Series are grouped (HELP/TYPE emitted once
+    per metric name, in first-seen order); all series are gauges."""
     by_metric: dict[str, list[Sample]] = {}
     for s in samples:
         by_metric.setdefault(s.metric, []).append(s)

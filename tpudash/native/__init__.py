@@ -121,6 +121,21 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(c_i64),
     ]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.td_encode_samples.restype = c_void_p
+    lib.td_encode_samples.argtypes = [
+        c_i64,
+        c_char_p, c_i64, i32p,  # metric uniques + codes
+        c_char_p, c_i64,        # helps (aligned with metric uniques)
+        c_char_p, c_i64, i32p,  # slice uniques + codes
+        c_char_p, c_i64, i32p,  # host uniques + codes
+        c_char_p, c_i64, i32p,  # accel uniques + codes
+        ctypes.POINTER(c_i64),  # chip ids
+        ctypes.POINTER(ctypes.c_double),  # values
+        ctypes.POINTER(c_i64),  # out length
+    ]
+    lib.td_text_free.restype = None
+    lib.td_text_free.argtypes = [c_void_p]
     return lib
 
 
@@ -228,6 +243,78 @@ def parse_promjson(data: "bytes | str", default_slice: str = "slice-0") -> Sampl
     if lib is None:
         raise RuntimeError("native library unavailable")
     return _parse(lib.td_parse_promjson, data, default_slice)
+
+
+def _intern(values: list) -> "tuple[list, np.ndarray]":
+    """(uniques in first-seen order, int32 codes) — the wire form the
+    encoder takes; a 256-chip scrape has ~10 metric names, 1-2 slices and
+    ~64 hosts, so interning shrinks the marshalled strings ~100x."""
+    memo: dict = {}
+    uniq: list = []
+    codes = np.empty(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        c = memo.get(v)
+        if c is None:
+            c = memo[v] = len(uniq)
+            uniq.append(v)
+        codes[i] = c
+    return uniq, codes
+
+
+def _pack(strs: list) -> bytes:
+    parts = bytearray()
+    for s in strs:
+        b = s.encode("utf-8")
+        parts += len(b).to_bytes(4, "little")
+        parts += b
+    return bytes(parts)
+
+
+def encode_samples(samples: list) -> str:
+    """Samples → Prometheus exposition text via the native kernel —
+    byte-identical to exporter/textfmt's pure-Python encoder (differential
+    parity in tests/test_native.py)."""
+    from tpudash.schema import SERIES_HELP
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(samples)
+    metric_u, metric_c = _intern([s.metric for s in samples])
+    helps = [SERIES_HELP.get(m, "tpudash series") for m in metric_u]
+    slice_u, slice_c = _intern([s.chip.slice_id for s in samples])
+    host_u, host_c = _intern([s.chip.host for s in samples])
+    accel_u, accel_c = _intern(
+        [s.accelerator_type or "" for s in samples]
+    )
+    chip_ids = np.fromiter(
+        (s.chip.chip_id for s in samples), dtype=np.int64, count=n
+    )
+    values = np.fromiter((s.value for s in samples), dtype=np.float64, count=n)
+    mb, hb, sb, hob, ab = (
+        _pack(metric_u), _pack(helps), _pack(slice_u), _pack(host_u),
+        _pack(accel_u),
+    )
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    out_len = ctypes.c_int64()
+    ptr = lib.td_encode_samples(
+        n,
+        mb, len(mb), metric_c.ctypes.data_as(i32p),
+        hb, len(hb),
+        sb, len(sb), slice_c.ctypes.data_as(i32p),
+        hob, len(hob), host_c.ctypes.data_as(i32p),
+        ab, len(ab), accel_c.ctypes.data_as(i32p),
+        chip_ids.ctypes.data_as(i64p),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len),
+    )
+    if not ptr or out_len.value < 0:
+        raise RuntimeError("native encode failed")
+    try:
+        return ctypes.string_at(ptr, out_len.value).decode("utf-8")
+    finally:
+        lib.td_text_free(ptr)
 
 
 def column_stats(matrix: np.ndarray, zero_excluded: "np.ndarray | None" = None):

@@ -20,6 +20,7 @@
 #include <cerrno>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <algorithm>
@@ -893,6 +894,40 @@ std::string pack_strings(const std::vector<std::string>& v) {
   return out;
 }
 
+// Inverse of pack_strings: uint32-LE length-prefixed list → strings.
+std::vector<std::string> unpack_strings(const char* blob, int64_t len) {
+  std::vector<std::string> out;
+  int64_t i = 0;
+  while (blob != nullptr && i + 4 <= len) {
+    uint32_t n = static_cast<uint8_t>(blob[i]) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 1])) << 8) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 2])) << 16) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 3])) << 24);
+    i += 4;
+    if (i + static_cast<int64_t>(n) > len) break;
+    out.emplace_back(blob + i, n);
+    i += n;
+  }
+  return out;
+}
+
+// Label-value escaping, exporter/textfmt.py _escape_label_value parity.
+std::string escape_label_value(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\')
+      out += "\\\\";
+    else if (c == '"')
+      out += "\\\"";
+    else if (c == '\n')
+      out += "\\n";
+    else
+      out.push_back(c);
+  }
+  return out;
+}
+
 }  // namespace
 
 extern "C" {
@@ -951,6 +986,96 @@ int64_t td_frame_strings(void* f, int32_t which, char* buf, int64_t cap) {
 }
 
 void td_frame_free(void* f) { delete static_cast<TdFrame*>(f); }
+
+// Exposition-text encoder — byte-for-byte parity with
+// exporter/textfmt.encode_samples (the differential harness in
+// tests/test_native.py pins it): one HELP/TYPE header per metric in
+// first-seen order, then one `name{labels} value` line per sample.
+// Inputs arrive interned: unique-string tables (uint32-LE packed) plus
+// per-sample int32 codes; `help_uniq` is aligned with the metric table.
+// Code order IS first-seen order (the Python interner assigns codes in
+// encounter order).  Returns a malloc'd buffer (free via td_text_free);
+// nullptr + *out_len = -1 on malformed codes.
+char* td_encode_samples(
+    int64_t n, const char* metric_uniq, int64_t metric_uniq_len,
+    const int32_t* metric_codes, const char* help_uniq, int64_t help_uniq_len,
+    const char* slice_uniq, int64_t slice_uniq_len, const int32_t* slice_codes,
+    const char* host_uniq, int64_t host_uniq_len, const int32_t* host_codes,
+    const char* accel_uniq, int64_t accel_uniq_len, const int32_t* accel_codes,
+    const int64_t* chip_ids, const double* values, int64_t* out_len) {
+  std::vector<std::string> metrics = unpack_strings(metric_uniq, metric_uniq_len);
+  std::vector<std::string> helps = unpack_strings(help_uniq, help_uniq_len);
+  std::vector<std::string> slices = unpack_strings(slice_uniq, slice_uniq_len);
+  std::vector<std::string> hosts = unpack_strings(host_uniq, host_uniq_len);
+  std::vector<std::string> accels = unpack_strings(accel_uniq, accel_uniq_len);
+  for (auto& s : slices) s = escape_label_value(s);
+  for (auto& s : hosts) s = escape_label_value(s);
+  for (auto& s : accels) s = escape_label_value(s);
+  std::vector<std::vector<int64_t>> groups(metrics.size());
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t c = metric_codes[i];
+    if (c < 0 || static_cast<size_t>(c) >= groups.size()) {
+      *out_len = -1;
+      return nullptr;
+    }
+    groups[c].push_back(i);
+  }
+  std::string out;
+  out.reserve(static_cast<size_t>(n) * 96 + metrics.size() * 96);
+  char buf[64];
+  for (size_t m = 0; m < metrics.size(); ++m) {
+    if (groups[m].empty()) continue;  // interner never emits these, be safe
+    const std::string& name = metrics[m];
+    out += "# HELP ";
+    out += name;
+    out.push_back(' ');
+    if (m < helps.size())
+      out += helps[m];
+    else
+      out += "tpudash series";
+    out += "\n# TYPE ";
+    out += name;
+    out += " gauge\n";
+    for (int64_t i : groups[m]) {
+      out += name;
+      out += "{chip_id=\"";
+      std::snprintf(buf, sizeof buf, "%lld",
+                    static_cast<long long>(chip_ids[i]));
+      out += buf;
+      out += "\",slice=\"";
+      int32_t sc = slice_codes[i];
+      if (sc >= 0 && static_cast<size_t>(sc) < slices.size()) out += slices[sc];
+      out += "\",host=\"";
+      int32_t hc = host_codes[i];
+      if (hc >= 0 && static_cast<size_t>(hc) < hosts.size()) out += hosts[hc];
+      out.push_back('"');
+      int32_t ac = accel_codes[i];
+      if (ac >= 0 && static_cast<size_t>(ac) < accels.size() &&
+          !accels[ac].empty()) {
+        out += ",accelerator=\"";
+        out += accels[ac];
+        out.push_back('"');
+      }
+      out += "} ";
+      std::snprintf(buf, sizeof buf, "%.10g", values[i]);
+      out += buf;
+      out.push_back('\n');
+    }
+  }
+  // python builds "\n".join(lines) + "\n": every line above already ends
+  // with '\n', so the shapes agree (empty input → a single '\n')
+  if (out.empty()) out.push_back('\n');
+  char* res = static_cast<char*>(std::malloc(out.size() ? out.size() : 1));
+  if (res == nullptr) {
+    *out_len = -1;
+    return nullptr;
+  }
+  std::memcpy(res, out.data(), out.size());
+  *out_len = static_cast<int64_t>(out.size());
+  return res;
+}
+
+void td_text_free(char* p) { std::free(p); }
 
 // One-pass per-column stats over a row-major float64 matrix.  NaNs are
 // skipped.  zero_excluded[c] != 0 additionally computes zmean excluding
