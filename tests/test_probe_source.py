@@ -51,3 +51,78 @@ def test_probe_samples_normalize():
     assert len(df) == jax.local_device_count()
     assert schema.HBM_USAGE_RATIO in df.columns
     assert schema.ICI_TOTAL_GBPS in df.columns
+
+
+def test_stale_cache_refreshes_off_the_scrape_path():
+    # a stale cache must serve the OLD measurements immediately and
+    # refresh in the background — a Prometheus scrape timeout must never
+    # pay for a probe batch (or a recompile)
+    import threading
+
+    src = ProbeSource(_cfg(probe_heavy_interval=0.0))
+    src.fetch()  # first run: blocking (warmup path)
+    gate = threading.Event()
+    ran = threading.Event()
+    orig = src._run_heavy_probes
+
+    def slow_heavy():
+        ran.set()
+        gate.wait(10)
+        return orig()
+
+    src._run_heavy_probes = slow_heavy
+    before = dict(src._cache)
+    samples = src.fetch()  # stale → serves old cache, spawns refresh
+    assert {s.metric for s in samples}  # served without waiting
+    assert dict(src._cache) == before or ran.is_set()
+    gate.set()
+    src.flush_refresh()
+    assert src._refresh_thread is None
+    assert ran.is_set()
+
+
+def test_exporter_app_warms_probe_source():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.exporter.server import make_app
+
+    async def go():
+        app = make_app(_cfg())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            task = client.app.get("warmup_task")
+            assert task is not None
+            await task  # warmup completes without error
+            # and the scrape is served from the warmed cache
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert "tpu_tensorcore_utilization" in await resp.text()
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_failed_probe_batch_never_leaves_partial_cache():
+    # a batch failing partway must leave the cache exactly as it was:
+    # either empty (next scrape raises a clean SourceError again) or the
+    # previous complete measurements (stale-serve) — never a mix that
+    # KeyErrors on the emit path
+    import pytest
+
+    from tpudash.sources.base import SourceError
+
+    src = ProbeSource(_cfg(probe_heavy_interval=3600.0))
+
+    def exploding():
+        raise RuntimeError("probe blew up mid-batch")
+
+    src._run_heavy_probes = exploding
+    with pytest.raises(SourceError):
+        src.fetch()
+    assert src._cache == {}  # nothing half-written
+    with pytest.raises(SourceError):  # still clean on retry
+        src.fetch()

@@ -284,13 +284,15 @@ def prometheus_rules_yaml(
     the output is stable and needs no YAML dependency at runtime; the
     round-trip test parses it back with a real YAML loader.
     """
+    def _duration(seconds: float) -> str:
+        # Prometheus durations take integer units only — "2.5s" rejects
+        # the whole rule file; fractional values are expressed in ms
+        if seconds == int(seconds):
+            return f"{int(seconds)}s"
+        return f"{int(round(seconds * 1000))}ms"
+
     interval = max(refresh_interval, 1.0)
-    # Prometheus durations take integer units only — "2.5s" rejects the
-    # whole rule file; fractional intervals are expressed in ms
-    if interval == int(interval):
-        interval_str = f"{int(interval)}s"
-    else:
-        interval_str = f"{int(round(interval * 1000))}ms"
+    interval_str = _duration(interval)
     lines = [
         "# Generated by tpudash — mirror of TPUDASH_ALERT_RULES so the",
         "# dashboard banner and the cluster pager fire on the same",
@@ -306,13 +308,7 @@ def prometheus_rules_yaml(
         # Prometheus `for: D` fires once a breach has persisted D beyond
         # its first evaluation, i.e. ~N evaluations for D=(N-1)*interval.
         # D=N*interval would need N+1 — one cycle stricter than the banner.
-        # Same integer-unit rule as the group interval: fractional holds
-        # are expressed in ms, never "2.5s".
-        hold_s = (rule.for_cycles - 1) * interval
-        if hold_s == int(hold_s):
-            hold = f"{int(hold_s)}s"
-        else:
-            hold = f"{int(round(hold_s * 1000))}ms"
+        hold = _duration((rule.for_cycles - 1) * interval)
         # name carries column+op+threshold so several rules on one column
         # stay distinct (duplicate alert names collapse in Alertmanager)
         # alert names allow [a-zA-Z0-9_] only: dots → "_", sign chars from

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 
 from aiohttp import web
 
@@ -23,12 +24,43 @@ from tpudash.exporter.textfmt import encode_samples
 from tpudash.sources import make_source
 from tpudash.sources.base import MetricsSource, SourceError
 
+log = logging.getLogger(__name__)
+
 
 class ExporterServer:
     def __init__(self, source: MetricsSource):
         self.source = source
         self._lock = asyncio.Lock()
         self.last_error: str | None = None
+
+    async def warm(self, app: web.Application) -> None:
+        """Startup warmup: run one fetch in the background so the FIRST
+        real scrape doesn't pay the on-chip probes' XLA compile cost
+        (tens of seconds cold — Prometheus' default scrape timeout is
+        10s, so an unwarmed first scrape always failed)."""
+
+        async def _warm() -> None:
+            loop = asyncio.get_running_loop()
+            try:
+                async with self._lock:
+                    await loop.run_in_executor(None, self.source.fetch)
+                log.info("probe warmup complete")
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning("probe warmup failed (first scrape pays): %s", e)
+
+        app["warmup_task"] = asyncio.create_task(_warm())
+
+    async def cool(self, app: web.Application) -> None:
+        """Shutdown cleanup: cancel a still-pending warmup (a wedged chip
+        can block backend init indefinitely) so Ctrl-C exits cleanly
+        instead of leaving a destroyed-but-pending task."""
+        task = app.get("warmup_task")
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
 
     async def metrics(self, request: web.Request) -> web.Response:
         async with self._lock:
@@ -64,7 +96,13 @@ def make_app(cfg: Config | None = None) -> web.Application:
     # host's chips are doing is the whole point
     if cfg.source == "prometheus":
         cfg = dataclasses.replace(cfg, source="probe")
-    return ExporterServer(make_source(cfg)).build_app()
+    server = ExporterServer(make_source(cfg))
+    app = server.build_app()
+    if cfg.source in ("probe", "workload"):
+        # only chip-touching sources need (or benefit from) compile warmup
+        app.on_startup.append(server.warm)
+        app.on_cleanup.append(server.cool)
+    return app
 
 
 def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry

@@ -20,9 +20,13 @@ classic exporter trade-off).
 
 from __future__ import annotations
 
+import logging
+import threading
 import time
 
 import jax
+
+log = logging.getLogger(__name__)
 
 from tpudash.config import Config
 from tpudash.registry import TPU_GENERATIONS, resolve_generation
@@ -65,11 +69,19 @@ class ProbeSource(MetricsSource):
         self.heavy_interval = float(cfg.extra.get("probe_heavy_interval", 30.0))
         self._last_heavy: float = 0.0
         self._cache: dict[str, float] = {}
+        #: serializes heavy probe runs (startup warmup vs first scrape)
+        self._heavy_lock = threading.Lock()
+        self._refresh_thread: "threading.Thread | None" = None
 
     # -- probes --------------------------------------------------------------
-    def _run_heavy_probes(self) -> None:
+    def _run_heavy_probes(self) -> dict:
+        """One full probe batch as a NEW dict — callers swap it in
+        atomically, so a batch that fails partway never leaves a
+        half-populated cache behind (a partial cache would crash the next
+        scrape with a KeyError instead of a clean SourceError)."""
         from tpudash.ops.probes import hbm_bandwidth_probe, matmul_flops_probe
 
+        fresh: dict[str, float] = {}
         # per-device placement: each chip gets its OWN measurement (a shared
         # number would hide per-chip divergence, e.g. one chip saturated by
         # another process)
@@ -77,11 +89,11 @@ class ProbeSource(MetricsSource):
             mm = matmul_flops_probe(
                 self.matmul_size, self.matmul_iters, device=dev
             )
-            self._cache[f"tflops_{i}"] = mm.value
+            fresh[f"tflops_{i}"] = mm.value
             hbm = hbm_bandwidth_probe(
                 self.hbm_mb, k1=self.hbm_k1, k2=self.hbm_k2, device=dev
             )
-            self._cache[f"hbm_gbps_{i}"] = hbm.value
+            fresh[f"hbm_gbps_{i}"] = hbm.value
 
         if jax.local_device_count() > 1:
             from tpudash.parallel.collectives import (
@@ -97,8 +109,28 @@ class ProbeSource(MetricsSource):
             )
             tx = ppermute_ring_bandwidth_probe(mesh, "tp", self.ici_mb)
             rx = all_gather_bandwidth_probe(mesh, "tp", self.ici_mb)
-            self._cache["ici_tx"] = tx.value * 1e9
-            self._cache["ici_rx"] = rx.value * 1e9
+            fresh["ici_tx"] = tx.value * 1e9
+            fresh["ici_rx"] = rx.value * 1e9
+        return fresh
+
+    def _refresh_heavy(self) -> None:
+        """Background heavy-probe refresh; failures keep the last good
+        measurements (and log) rather than failing a scrape that can
+        still serve them."""
+        try:
+            with self._heavy_lock:
+                self._cache = self._run_heavy_probes()
+                self._last_heavy = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — stale beats absent
+            log.warning("background probe refresh failed: %s", e)
+        finally:
+            self._refresh_thread = None
+
+    def flush_refresh(self, timeout: float = 30.0) -> None:
+        """Wait for an in-flight background refresh (tests, shutdown)."""
+        t = self._refresh_thread
+        if t is not None:
+            t.join(timeout)
 
     def fetch(self):
         try:
@@ -109,12 +141,30 @@ class ProbeSource(MetricsSource):
             raise SourceError("no local jax devices")
 
         now = time.monotonic()
-        if now - self._last_heavy >= self.heavy_interval or not self._cache:
-            try:
-                self._run_heavy_probes()
-            except Exception as e:
-                raise SourceError(f"probe failed: {e}") from e
-            self._last_heavy = now
+        if not self._cache:
+            # Nothing to serve yet: the very first run pays the XLA compile
+            # cost in-line (tens of seconds on a cold chip — exporter
+            # startup warms this so a Prometheus scrape normally never
+            # does).  Double-checked under the lock: a scrape racing the
+            # warmup waits for it instead of compiling twice.
+            with self._heavy_lock:
+                if not self._cache:
+                    try:
+                        self._cache = self._run_heavy_probes()
+                    except Exception as e:
+                        raise SourceError(f"probe failed: {e}") from e
+                    self._last_heavy = time.monotonic()
+        elif (
+            now - self._last_heavy >= self.heavy_interval
+            and self._refresh_thread is None
+        ):
+            # Stale cache: refresh OFF the scrape path.  The scrape serves
+            # the previous measurements immediately — a 10s Prometheus
+            # scrape timeout must never lose a cycle to a 100ms+ probe
+            # batch, let alone a recompile after a topology change.
+            t = threading.Thread(target=self._refresh_heavy, daemon=True)
+            self._refresh_thread = t
+            t.start()
 
         from tpudash.ops.probes import hbm_memory_stats
 
