@@ -1,0 +1,176 @@
+"""Refresh watchdog: a wedged source must not freeze the dashboard.
+
+A hung accelerator runtime blocks inside native code without raising —
+no exception path fires, so retry/backoff never helps.  The server-side
+watchdog parks the in-flight fetch, keeps serving the last good data
+with a "stalled" warning, and harvests the fetch when it completes.
+"""
+
+import asyncio
+import threading
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash import schema
+from tpudash.app.server import DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.schema import ChipKey, Sample
+from tpudash.sources.base import MetricsSource
+
+
+class BlockingSource(MetricsSource):
+    """Blocks fetches on an event while ``wedged`` is set."""
+
+    name = "blocking"
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()  # starts healthy
+        self.fetches = 0
+
+    def fetch(self):
+        self.gate.wait(30)
+        self.fetches += 1
+        chip = ChipKey(slice_id="s", host="h", chip_id=0)
+        return [
+            Sample(metric=schema.TENSORCORE_UTIL, value=50.0, chip=chip),
+            Sample(metric=schema.POWER, value=100.0, chip=chip),
+        ]
+
+
+def _server(src, watchdog=0.3):
+    cfg = Config(
+        source="fixture", refresh_interval=0.0, refresh_watchdog=watchdog,
+        fetch_retries=0,
+    )
+    return DashboardServer(DashboardService(cfg, src))
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_stalled_source_serves_last_data_with_warning():
+    async def go():
+        src = BlockingSource()
+        server = _server(src)
+        client = await _client(server.build_app())
+        try:
+            f = await (await client.get("/api/frame")).json()
+            assert f["error"] is None and len(f["chips"]) == 1
+
+            src.gate.clear()  # wedge the source
+            t0 = asyncio.get_event_loop().time()
+            f = await (await client.get("/api/frame")).json()
+            elapsed = asyncio.get_event_loop().time() - t0
+            assert elapsed < 5, "watchdog must bound the route latency"
+            # last good data still served, with the stall declared
+            assert len(f["chips"]) == 1
+            assert any("stalled" in w for w in f.get("warnings", []))
+
+            # while stalled, further requests stay fast and don't stack
+            # extra fetches behind the wedge
+            before = src.fetches
+            for _ in range(3):
+                f = await (await client.get("/api/frame")).json()
+                assert any("stalled" in w for w in f.get("warnings", []))
+            assert src.fetches == before
+
+            src.gate.set()  # wedge clears
+            await asyncio.sleep(0.3)  # parked fetch completes
+            f = await (await client.get("/api/frame")).json()  # harvest
+            f = await (await client.get("/api/frame")).json()  # fresh cycle
+            assert f.get("warnings") is None or not any(
+                "stalled" in w for w in f["warnings"]
+            )
+            assert len(f["chips"]) == 1
+        finally:
+            src.gate.set()
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_wedged_from_birth_reports_instead_of_blank_shell():
+    async def go():
+        src = BlockingSource()
+        src.gate.clear()  # wedged before the first ever fetch
+        server = _server(src)
+        client = await _client(server.build_app())
+        try:
+            f = await (await client.get("/api/frame")).json()
+            assert f["chips"] == []
+            assert f["error"] is not None and "stalled" in f["error"]
+            # healthz still answers (no frame lock involved)
+            assert (await client.get("/healthz")).status == 200
+        finally:
+            src.gate.set()
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_watchdog_zero_disables():
+    async def go():
+        src = BlockingSource()
+        server = _server(src, watchdog=0.0)
+        client = await _client(server.build_app())
+        try:
+            f = await (await client.get("/api/frame")).json()
+            assert f["error"] is None  # plain blocking behavior preserved
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_client_disconnect_does_not_stack_fetches():
+    # a client whose HTTP timeout is shorter than the watchdog cancels the
+    # handler mid-wait; the in-flight fetch must stay parked so impatient
+    # clients can't stack N concurrent fetches behind the wedge
+    async def go():
+        src = BlockingSource()
+        server = _server(src, watchdog=5.0)
+        client = await _client(server.build_app())
+        try:
+            await client.get("/api/frame")  # healthy first cycle
+            src.gate.clear()
+            for _ in range(3):
+                try:
+                    await asyncio.wait_for(client.get("/api/frame"), 0.2)
+                except asyncio.TimeoutError:
+                    pass  # the impatient client gave up
+            # exactly ONE fetch is parked behind the wedge
+            assert server._refresh_task is not None
+            n_started = src.fetches  # completed count (none new finished)
+            src.gate.set()
+            await asyncio.sleep(0.3)
+            await client.get("/api/frame")  # harvest
+            assert src.fetches <= n_started + 2  # parked one + recovery one
+        finally:
+            src.gate.set()
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_csv_export_503s_while_stalled():
+    async def go():
+        src = BlockingSource()
+        server = _server(src)
+        client = await _client(server.build_app())
+        try:
+            assert (await client.get("/api/export.csv")).status == 200
+            src.gate.clear()
+            await client.get("/api/frame")  # trips the watchdog
+            resp = await client.get("/api/export.csv")
+            assert resp.status == 503
+            assert "stalled" in await resp.text()
+        finally:
+            src.gate.set()
+            await client.close()
+
+    asyncio.run(go())

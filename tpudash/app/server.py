@@ -60,6 +60,10 @@ class DashboardServer:
         #: (data_version, {(chip_key, use_gauge): detail}) — drill-down
         #: responses cached for the life of one data refresh
         self._chip_cache: tuple = (-1, {})
+        #: a refresh that outlived the watchdog (or its awaiting handler),
+        #: parked for later harvest, plus when it started
+        self._refresh_task = None
+        self._refresh_started: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
 
     def _entry(self, request: web.Request) -> SessionEntry:
@@ -67,7 +71,40 @@ class DashboardServer:
 
     # -- frame caching -------------------------------------------------------
     async def _refresh_locked(self, force: bool) -> None:
-        """Refresh the shared scrape data when stale.  Caller holds _lock."""
+        """Refresh the shared scrape data when stale.  Caller holds _lock.
+
+        Watchdog (Config.refresh_watchdog): a wedged source — a hung
+        accelerator runtime blocks inside native code without raising, so
+        no exception path fires — must not freeze every route behind this
+        lock.  Past the deadline the in-flight fetch is parked, routes
+        keep serving the last data with a "stalled" warning, and a later
+        tick harvests the fetch when (if) it completes.  At most ONE
+        fetch is ever in flight, so a wedge cannot exhaust the executor."""
+        watchdog = self.service.cfg.refresh_watchdog
+        stall_msg = (
+            f"metrics source stalled (no response in {watchdog:g}s); "
+            "serving the last good data"
+        )
+        if self._refresh_task is not None:
+            if not self._refresh_task.done():
+                # a fetch parked by the watchdog — or orphaned by a client
+                # disconnect mid-wait — is still running; declare the
+                # stall once it is genuinely overdue
+                if (
+                    self.service.refresh_stalled is None
+                    and watchdog
+                    and time.monotonic() - self._refresh_started >= watchdog
+                ):
+                    self.service.refresh_stalled = stall_msg
+                return  # serve what we have
+            task, self._refresh_task = self._refresh_task, None
+            if not task.cancelled():
+                task.exception()  # consume (refresh_data never raises)
+            self._data_version += 1
+            self.service.refresh_stalled = None
+            # deliberately NOT updating _data_at: the harvested data is as
+            # old as the stall — fall through so a genuinely fresh fetch
+            # starts on this same tick instead of an interval later
         age = time.monotonic() - self._data_at
         if (
             force
@@ -75,9 +112,25 @@ class DashboardServer:
             or age >= self.service.cfg.refresh_interval
         ):
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(None, self.service.refresh_data)
+            # parked BEFORE the await: every exit path (timeout, client
+            # disconnect cancelling this handler) leaves the task tracked,
+            # so at most one fetch is ever in flight no matter how many
+            # impatient clients come and go
+            task = loop.run_in_executor(None, self.service.refresh_data)
+            self._refresh_task = task
+            self._refresh_started = time.monotonic()
+            try:
+                if watchdog and watchdog > 0:
+                    await asyncio.wait_for(asyncio.shield(task), watchdog)
+                else:
+                    await task
+            except asyncio.TimeoutError:
+                self.service.refresh_stalled = stall_msg
+                return
+            self._refresh_task = None
             self._data_version += 1
             self._data_at = time.monotonic()
+            self.service.refresh_stalled = None
 
     async def _compose_locked(
         self, entry: SessionEntry, keep_prev: bool = False
@@ -87,7 +140,13 @@ class DashboardServer:
         single copy of the cache-keying protocol both transports share.
         ``keep_prev`` retains the outgoing frame for the delta transport;
         pure-polling sessions never pay that second frame's memory."""
-        key = (self._data_version, entry.state_version)
+        key = (
+            self._data_version,
+            entry.state_version,
+            # stall transitions must invalidate cached frames — the
+            # warning has to appear (and clear) without a data refresh
+            bool(self.service.refresh_stalled),
+        )
         if entry.frame is not None and entry.frame_key == key:
             return entry.frame, key
         loop = asyncio.get_running_loop()
@@ -238,9 +297,11 @@ class DashboardServer:
         the cache-gated frame path so the export is at most one refresh
         interval old, never an hours-stale snapshot."""
         frame = await self._get_frame(entry=self._entry(request))
-        if frame.get("error"):
-            # don't serve pre-outage data as if it were current
-            raise web.HTTPServiceUnavailable(text=frame["error"])
+        stale = frame.get("error") or self.service.refresh_stalled
+        if stale:
+            # don't serve pre-outage (or mid-stall) data as if it were
+            # current — a CSV has no warnings banner to carry the caveat
+            raise web.HTTPServiceUnavailable(text=stale)
         df = self.service.last_df
         if df is None:
             raise web.HTTPServiceUnavailable(text="no frame rendered yet")

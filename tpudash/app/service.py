@@ -26,6 +26,7 @@ import copy
 import datetime as _dt
 import functools
 import logging
+import threading
 import time
 from collections import deque
 
@@ -105,6 +106,14 @@ class DashboardService:
         self._ident_keys = None
         self._ident_accels: list = []
         self.last_error: str | None = None
+        #: set by the server's refresh watchdog while a fetch is stalled
+        #: (frames keep serving the last data with this warning attached)
+        self.refresh_stalled: "str | None" = None
+        #: serializes data publication against frame composition: a fetch
+        #: parked by the watchdog completes on its executor thread while
+        #: composes keep running — without this, a recovering refresh
+        #: could swap last_df/identity caches mid-compose (torn frames)
+        self._publish_lock = threading.RLock()
         #: wide per-chip table from the last successful frame (CSV export)
         self.last_df: "pd.DataFrame | None" = None
         #: chip keys seen in the last successful frame — the "currently
@@ -606,6 +615,15 @@ class DashboardService:
         use_gauge: bool = True,
         max_points: int = 200,
     ) -> "dict | None":
+        with self._publish_lock:
+            return self._chip_detail_locked(key, use_gauge, max_points)
+
+    def _chip_detail_locked(
+        self,
+        key: str,
+        use_gauge: bool = True,
+        max_points: int = 200,
+    ) -> "dict | None":
         """Single-chip drill-down: identity, current panel gauges, per-chip
         trend sparklines, its firing alerts, and its ICI neighbors — the
         per-device insight of the reference's gauge rows (app.py:411-476)
@@ -712,6 +730,10 @@ class DashboardService:
         (row alignment, float32 matrices, reset-on-population-change) stays
         encapsulated here; /api/history?chip= serves this verbatim.
         Returns None for a chip the ring has never seen."""
+        with self._publish_lock:
+            return self._chip_series_locked(key)
+
+    def _chip_series_locked(self, key: str):
         row = self._chip_hist_rowmap.get(key)
         if row is None:
             return None
@@ -742,22 +764,40 @@ class DashboardService:
         # was pulled, not when a session re-rendered it (a selection toggle
         # near the end of a refresh interval must not present interval-old
         # metrics as current)
-        self.last_updated = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        stamp = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
         try:
             with self.timer.stage("scrape"):
                 samples = self.source.fetch()
-            with self.timer.stage("normalize"):
-                df = to_wide(samples)
         except Exception as e:  # noqa: BLE001 — error banner path catches all
-            # Graceful degradation (app.py:225-227, 333): banner + keep state.
-            err = f"Error fetching TPU metrics: {e}"
-            if err != self.last_error:  # log streaks once, not per cycle
-                log.warning("%s", err)
-            self.last_error = err
-            self._frame_open = False
-            self.timer.end_frame()
-            return None
+            with self._publish_lock:
+                self.last_updated = stamp
+                return self._publish_error(e)
+        # everything below mutates published state; the lock keeps a fetch
+        # the watchdog parked (now completing on its own thread) from
+        # swapping tables mid-compose
+        with self._publish_lock:
+            self.last_updated = stamp
+            try:
+                with self.timer.stage("normalize"):
+                    df = to_wide(samples)
+            except Exception as e:  # noqa: BLE001 — same banner path
+                return self._publish_error(e)
+            return self._publish_data(df)
 
+    def _publish_error(self, e: Exception) -> None:
+        """Error-cycle publication (reference banner path, app.py:225-227).
+        Caller holds _publish_lock."""
+        err = f"Error fetching TPU metrics: {e}"
+        if err != self.last_error:  # log streaks once, not per cycle
+            log.warning("%s", err)
+        self.last_error = err
+        self._frame_open = False
+        self.timer.end_frame()
+        return None
+
+    def _publish_data(self, df: "pd.DataFrame") -> "pd.DataFrame":
+        """Success publication: table, identity caches, alerts, history.
+        Caller holds _publish_lock."""
         if self.last_error is not None:
             log.info("metrics source recovered")
         self.last_error = None
@@ -837,6 +877,14 @@ class DashboardService:
         return df
 
     def compose_frame(self, state: "SelectionState | None" = None) -> dict:
+        """Selection-dependent frame assembly under the publish lock — a
+        fetch the watchdog parked must not swap tables mid-compose."""
+        with self._publish_lock:
+            return self._compose_frame_locked(state)
+
+    def _compose_frame_locked(
+        self, state: "SelectionState | None" = None
+    ) -> dict:
         """Selection-dependent frame assembly for ONE viewer session over
         the table :meth:`refresh_data` last pulled — the render half of the
         reference's loop (app.py:320-486), cheap enough to run per session.
@@ -850,7 +898,11 @@ class DashboardService:
             "source_health": self.source_health(),
         }
         df = self.last_df
-        if self.last_error is not None or df is None:
+        if df is None and self.refresh_stalled and frame["error"] is None:
+            # the very first fetch is stalled: nothing to serve yet, and
+            # the page must say why instead of rendering an empty shell
+            frame["error"] = self.refresh_stalled
+        if frame["error"] is not None or df is None:
             frame["chips"] = []
             frame["timings"] = self.timer.summary()
             return frame
@@ -859,10 +911,15 @@ class DashboardService:
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)
-        if partial:
-            frame["warnings"] = [
-                f"endpoint {name}: {err}" for name, err in partial.items()
-            ]
+        warnings = (
+            [f"endpoint {name}: {err}" for name, err in partial.items()]
+            if partial
+            else []
+        )
+        if self.refresh_stalled:
+            warnings.append(self.refresh_stalled)
+        if warnings:
+            frame["warnings"] = warnings
         # only the FIRST compose after a refresh lands in the timer frame:
         # further sessions' composes must not append render-only entries
         # that would skew the scrape→render percentiles

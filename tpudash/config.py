@@ -111,6 +111,13 @@ class Config:
     #: resume from its latest step on restart.  "" disables.
     workload_checkpoint_dir: str = ""
     workload_checkpoint_every: int = 64
+    #: Watchdog for one data refresh, seconds (0 disables).  A wedged
+    #: source — e.g. a hung accelerator runtime whose backend init blocks
+    #: forever without raising — must not freeze every dashboard route
+    #: behind the frame lock: past this deadline the server keeps serving
+    #: the last data with a "source stalled" warning and harvests the
+    #: in-flight fetch when (if) it completes.
+    refresh_watchdog: float = 30.0
     #: Per-browser UI sessions (cookie ``tpudash_sid`` — the reference's
     #: st.session_state scoping, app.py:252-260): bound on the server-side
     #: session map and idle TTL in seconds before eviction.
@@ -149,6 +156,7 @@ _ENV_MAP = {
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
+    "refresh_watchdog": "TPUDASH_REFRESH_WATCHDOG",
     "session_limit": "TPUDASH_SESSION_LIMIT",
     "session_ttl": "TPUDASH_SESSION_TTL",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",

@@ -120,10 +120,12 @@ class ProbeSource(MetricsSource):
         try:
             with self._heavy_lock:
                 self._cache = self._run_heavy_probes()
-                self._last_heavy = time.monotonic()
         except Exception as e:  # noqa: BLE001 — stale beats absent
             log.warning("background probe refresh failed: %s", e)
         finally:
+            # stamped on failure too: retries happen at heavy_interval
+            # cadence, not one new thread + warning per scrape forever
+            self._last_heavy = time.monotonic()
             self._refresh_thread = None
 
     def flush_refresh(self, timeout: float = 30.0) -> None:
