@@ -193,6 +193,7 @@ def main() -> None:
         "frames": N_FRAMES,
         "budget_s": BUDGET_S,
         "sse_full_frame_bytes": dash["sse_bytes"],
+        "sse_delta_bytes": dash["sse_delta_bytes"],
         "multislice_2x256_p50_ms": round(multi["p50_s"] * 1e3, 2),
         "torus3d_v4_4x4x8_p50_ms": round(torus3d["p50_s"] * 1e3, 2),
         "torus3d_grid": torus3d["grid"],

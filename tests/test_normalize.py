@@ -87,10 +87,12 @@ def test_batch_path_matches_reference_construction():
         ref = pd.DataFrame(
             b.matrix, index=pd.Index(b.keys, name="chip"), columns=b.metrics
         )
-        ref.insert(0, schema.ACCEL_TYPE, b.accels)
+        # object dtype matches both production paths (identity columns
+        # deliberately avoid arrow-backed string inference)
+        ref.insert(0, schema.ACCEL_TYPE, pd.Series(b.accels, index=ref.index, dtype=object))
         ref.insert(0, "chip_id", b.chip_ids.astype(np.int64))
-        ref.insert(0, "host", b.hosts)
-        ref.insert(0, "slice_id", b.slices)
+        ref.insert(0, "host", pd.Series(b.hosts, index=ref.index, dtype=object))
+        ref.insert(0, "slice_id", pd.Series(b.slices, index=ref.index, dtype=object))
         ref = _derive(ref)
         pd.testing.assert_frame_equal(got, ref, obj=f"case {kwargs}")
 

@@ -26,11 +26,14 @@ from __future__ import annotations
 import asyncio
 import hmac
 import json
+import logging
 import secrets
 import tempfile
 import time
 
 from aiohttp import web
+
+log = logging.getLogger(__name__)
 
 from tpudash.app.html import PAGE
 from tpudash.app.service import DashboardService
@@ -87,21 +90,39 @@ class DashboardServer:
         )
         if self._refresh_task is not None:
             if not self._refresh_task.done():
-                # a fetch parked by the watchdog — or orphaned by a client
-                # disconnect mid-wait — is still running; declare the
-                # stall once it is genuinely overdue
-                if (
-                    self.service.refresh_stalled is None
-                    and watchdog
-                    and time.monotonic() - self._refresh_started >= watchdog
-                ):
-                    self.service.refresh_stalled = stall_msg
-                return  # serve what we have
+                # A fetch parked by the watchdog — or orphaned by a client
+                # disconnect mid-wait — is still running.  Re-attach for
+                # whatever watchdog budget remains (a disconnect at t=1s
+                # of a healthy 3s fetch must not degrade every other
+                # client to stale-instantly); only past the deadline do
+                # we declare the stall and serve stale.
+                elapsed = time.monotonic() - self._refresh_started
+                if watchdog and watchdog > 0:
+                    remaining = watchdog - elapsed
+                    if remaining > 0:
+                        try:
+                            await asyncio.wait_for(
+                                asyncio.shield(self._refresh_task), remaining
+                            )
+                        except asyncio.TimeoutError:
+                            pass
+                else:
+                    await asyncio.shield(self._refresh_task)
+                if not self._refresh_task.done():
+                    if self.service.refresh_stalled is None:
+                        self.service.refresh_stalled = stall_msg
+                    return  # serve what we have
             task, self._refresh_task = self._refresh_task, None
-            if not task.cancelled():
-                task.exception()  # consume (refresh_data never raises)
-            self._data_version += 1
-            self.service.refresh_stalled = None
+            exc = task.exception() if not task.cancelled() else None
+            if exc is not None:
+                # an unexpected failure outside refresh_data's own guards:
+                # log it and fall through — the staleness check below
+                # starts a fresh fetch instead of stamping bad state good
+                log.warning("parked refresh raised: %s", exc)
+                self.service.refresh_stalled = None
+            else:
+                self._data_version += 1
+                self.service.refresh_stalled = None
             # deliberately NOT updating _data_at: the harvested data is as
             # old as the stall — fall through so a genuinely fresh fetch
             # starts on this same tick instead of an interval later

@@ -758,24 +758,35 @@ class DashboardService:
         :meth:`compose_frame` that renders from this data, so the
         north-star scrape→render number still measures one full cycle.
         """
-        self.timer.start_frame()
-        self._frame_open = True
         # stamped at SCRAPE time: composed frames must report when the data
         # was pulled, not when a session re-rendered it (a selection toggle
         # near the end of a refresh interval must not present interval-old
         # metrics as current)
         stamp = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        # The fetch runs OUTSIDE the publish lock (it can block for the
+        # watchdog's whole lifetime) and ALL timer mutation happens inside
+        # it — a stale compose served mid-stall must never see a
+        # half-open timer frame (it would close a render-only frame and
+        # skew the north-star percentiles).  Scrape time is measured
+        # manually and recorded once the lock is held.
+        t0 = time.perf_counter()
         try:
-            with self.timer.stage("scrape"):
-                samples = self.source.fetch()
+            samples = self.source.fetch()
         except Exception as e:  # noqa: BLE001 — error banner path catches all
+            scrape_s = time.perf_counter() - t0
             with self._publish_lock:
+                self.timer.start_frame()
+                self.timer.current["scrape"] = scrape_s
                 self.last_updated = stamp
                 return self._publish_error(e)
+        scrape_s = time.perf_counter() - t0
         # everything below mutates published state; the lock keeps a fetch
         # the watchdog parked (now completing on its own thread) from
         # swapping tables mid-compose
         with self._publish_lock:
+            self.timer.start_frame()
+            self._frame_open = True
+            self.timer.current["scrape"] = scrape_s
             self.last_updated = stamp
             try:
                 with self.timer.stage("normalize"):

@@ -985,6 +985,57 @@ int64_t td_frame_strings(void* f, int32_t which, char* buf, int64_t cap) {
   return static_cast<int64_t>(packed.size());
 }
 
+// Interned export for the per-row string lists (which: 1 = slices,
+// 2 = hosts, 3 = accels): returns the byte size of the packed UNIQUE
+// strings (first-seen order) and, when non-null, fills `codes` with
+// nrows int32 indices into that table.  A 512-chip scrape has 1-2 slices
+// and ~64 hosts, so the transfer shrinks ~100x vs per-row strings and
+// the Python side rebuilds the list with one vectorized take.
+int64_t td_frame_interned(void* f, int32_t which, char* buf, int64_t cap,
+                          int32_t* codes) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  const std::vector<std::string>* v = nullptr;
+  switch (which) {
+    case 1: v = &fr->slices; break;
+    case 2: v = &fr->hosts; break;
+    case 3: v = &fr->accels; break;
+    default: return -1;
+  }
+  std::unordered_map<std::string, int32_t> memo;
+  std::vector<const std::string*> uniq;
+  for (size_t i = 0; i < v->size(); ++i) {
+    const std::string& s = (*v)[i];
+    auto it = memo.find(s);
+    int32_t c;
+    if (it == memo.end()) {
+      c = static_cast<int32_t>(uniq.size());
+      memo.emplace(s, c);
+      uniq.push_back(&s);
+    } else {
+      c = it->second;
+    }
+    if (codes != nullptr) codes[i] = c;
+  }
+  std::string packed;
+  {
+    size_t total = 0;
+    for (const auto* s : uniq) total += s->size() + 4;
+    packed.reserve(total);
+    for (const auto* s : uniq) {
+      uint32_t n = static_cast<uint32_t>(s->size());
+      char hdr[4] = {static_cast<char>(n & 0xFF),
+                     static_cast<char>((n >> 8) & 0xFF),
+                     static_cast<char>((n >> 16) & 0xFF),
+                     static_cast<char>((n >> 24) & 0xFF)};
+      packed.append(hdr, 4);
+      packed.append(*s);
+    }
+  }
+  if (buf != nullptr && cap >= static_cast<int64_t>(packed.size()))
+    std::memcpy(buf, packed.data(), packed.size());
+  return static_cast<int64_t>(packed.size());
+}
+
 void td_frame_free(void* f) { delete static_cast<TdFrame*>(f); }
 
 // Exposition-text encoder — byte-for-byte parity with

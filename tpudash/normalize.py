@@ -61,6 +61,13 @@ def to_wide(samples: "list[Sample] | SampleBatch") -> pd.DataFrame:
     df = pd.DataFrame.from_dict(rows, orient="index")
     df = df.sort_values(["slice_id", "chip_id"])
     df.index.name = "chip"
+    # identity columns as object dtype, matching the batch path (see
+    # _batch_to_wide): arrow-backed strings pay per-value conversion and
+    # iteration costs on the hot path, and the two paths must produce
+    # frames that compare equal
+    for col in ("slice_id", "host", schema.ACCEL_TYPE):
+        if col in df:
+            df[col] = df[col].astype(object)
     return _derive(df)
 
 
@@ -119,13 +126,19 @@ def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
     metric_df = pd.DataFrame(
         data, index=index, columns=kept + list(derived.keys())
     )
-    # identity columns first, same order the dict pivot produces
+    # identity columns first, same order the dict pivot produces.  Forced
+    # to object dtype: pandas' arrow-backed string inference would pay a
+    # per-value conversion here AND per-value iteration on every later
+    # .tolist()/.to_numpy() of these columns (profiled ~13k arrow
+    # __iter__ calls per 512-chip frame)
     ident = pd.DataFrame(
         {
-            "slice_id": b.slices,
-            "host": b.hosts,
+            "slice_id": pd.Series(b.slices, index=index, dtype=object),
+            "host": pd.Series(b.hosts, index=index, dtype=object),
             "chip_id": b.chip_ids.astype(np.int64),
-            schema.ACCEL_TYPE: b.accels,
+            schema.ACCEL_TYPE: pd.Series(
+                b.accels, index=index, dtype=object
+            ),
         },
         index=index,
     )
