@@ -1,0 +1,74 @@
+"""Deploy artifacts stay consistent with the code they describe.
+
+The Grafana dashboard and K8s manifests are static files — nothing
+recompiles them when schema series or ports change, so these tests pin
+the load-bearing references.
+"""
+
+import json
+import os
+
+import yaml
+
+from tpudash import compat, schema
+
+DEPLOY = os.path.join(os.path.dirname(__file__), os.pardir, "deploy")
+
+
+def _dashboard():
+    with open(os.path.join(DEPLOY, "grafana-dashboard.json")) as f:
+        return json.load(f)
+
+
+def test_grafana_dashboard_parses_and_covers_core_series():
+    d = _dashboard()
+    body = json.dumps(d)
+    # every reference-parity panel series plus the TPU extras
+    for series in (
+        schema.TENSORCORE_UTIL,
+        schema.HBM_USED,
+        schema.TEMPERATURE,
+        schema.POWER,
+        schema.MXU_UTIL,
+        schema.MEMBW_UTIL,
+        schema.HBM_BANDWIDTH,
+        schema.ICI_TX,
+        schema.DCN_TX,
+    ):
+        assert series in body, f"grafana dashboard missing {series}"
+
+
+def test_grafana_series_names_exist_in_schema():
+    # every tpu_* metric referenced by a panel expr must be a real
+    # canonical series (or derived column) — a renamed schema series must
+    # fail here, not silently blank a Grafana panel
+    import re
+
+    known = set(schema.SERIES_HELP) | set(schema.DERIVED_COLUMNS) | {
+        schema.HBM_BANDWIDTH, schema.MXU_UTIL, schema.MEMBW_UTIL,
+    }
+    body = json.dumps(_dashboard())
+    for name in set(re.findall(r"tpu_[a-z0-9_]+", body)):
+        assert name in known, f"unknown series {name!r} in grafana dashboard"
+
+
+def test_grafana_alias_exprs_match_compat_table():
+    # alias-or expressions must use spellings the compat layer actually
+    # recognizes (same contract as the alert-rule export)
+    body = json.dumps(_dashboard())
+    for alias in ("tensorcore_utilization", "memory_bandwidth_utilization"):
+        assert alias in body
+        assert alias in compat.SERIES_ALIASES
+
+
+def test_manifests_parse_and_reference_real_ports():
+    from tpudash.config import Config
+
+    cfg = Config()
+    with open(os.path.join(DEPLOY, "exporter-daemonset.yaml")) as f:
+        exporter = list(yaml.safe_load_all(f))
+    with open(os.path.join(DEPLOY, "dashboard.yaml")) as f:
+        dashboard = list(yaml.safe_load_all(f))
+    text = json.dumps([exporter, dashboard])
+    assert str(cfg.exporter_port) in text
+    assert str(cfg.port) in text
