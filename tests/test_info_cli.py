@@ -60,3 +60,21 @@ def test_main_bad_alert_rules_degrades_to_warning(capsys, monkeypatch):
     captured = capsys.readouterr()
     assert "alerting disabled" in captured.err
     assert "MXU%" in captured.out  # table still renders
+
+
+def test_chip_drilldown_view(capsys):
+    # 4x4 v5e torus: chip 5 = (1,1) has 4 ICI neighbors
+    assert main(["--source", "synthetic", "--chips", "16", "--chip", "slice-0/5"]) == 0
+    out = capsys.readouterr().out
+    assert "chip   slice-0/5" in out
+    assert "fleet mean" in out and "fleet p95" in out
+    assert "MXU%" in out and "HBM%" in out
+    assert "ICI neighbors:" in out
+    neighbors = out.split("ICI neighbors:")[1].splitlines()[0].split()
+    assert len(neighbors) == 4
+
+
+def test_chip_drilldown_unknown_key(capsys):
+    assert main(["--source", "synthetic", "--chips", "4", "--chip", "nope/9"]) == 0
+    out = capsys.readouterr().out
+    assert "unknown chip" in out and "slice-0/0" in out

@@ -65,6 +65,57 @@ def render_table(df, stats) -> str:
     return "\n".join(lines)
 
 
+def render_chip(df, stats, key: str) -> str:
+    """Single-chip drill-down for the terminal — the CLI counterpart of
+    the web view's heatmap-click detail (app/service.chip_detail): chip
+    identity, each metric against the fleet mean/p95, ICI neighbors."""
+    if key not in df.index:
+        known = ", ".join(list(df.index[:6])) + (" …" if len(df) > 6 else "")
+        return f"error: unknown chip {key!r} (chips: {known})"
+    row = df.loc[key]
+    lines = [
+        f"chip   {key}",
+        f"model  {row.get(schema.ACCEL_TYPE) or '?'}",
+        f"host   {row.get('host', '')}",
+        f"slice  {row.get('slice_id', '')}",
+        "",
+        f"{'metric':<10}{'value':>10}{'fleet mean':>12}{'fleet p95':>11}",
+        "-" * 43,
+    ]
+    for c, header, fmt in _COLUMNS:
+        if c not in df.columns:
+            continue
+        v = row.get(c)
+        s = stats.get(c)
+        val = "-" if v is None or v != v else fmt.format(v)
+        mean = fmt.format(s["mean"]) if s else "-"
+        p95 = fmt.format(s["p95"]) if s else "-"
+        lines.append(f"{header:<10}{val:>10}{mean:>12}{p95:>11}")
+    try:
+        from tpudash.topology import topology_for
+
+        same = df[df["slice_id"] == row["slice_id"]]
+        ids = same["chip_id"].to_numpy()
+        sane = ids[(ids >= 0) & (ids < 16384)]
+        if sane.size:
+            topo = topology_for(
+                row.get(schema.ACCEL_TYPE) or None, int(sane.max()) + 1
+            )
+            cid = int(row["chip_id"])
+            if 0 <= cid < topo.num_chips:
+                want = set(topo.neighbors(cid))
+                keys = [
+                    str(k)
+                    for k, c2 in zip(same.index.tolist(), ids.tolist())
+                    if c2 in want
+                ]
+                if keys:
+                    lines += ["", "ICI neighbors: " + "  ".join(keys)]
+    except Exception:  # noqa: BLE001 — neighbors are best-effort context
+        pass
+    return "\n".join(lines)
+
+
 def main(argv: "list[str] | None" = None) -> int:
     from tpudash.parallel.distributed import maybe_initialize
 
@@ -73,6 +124,11 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--source", help="override TPUDASH_SOURCE")
     ap.add_argument("--chips", type=int, help="synthetic chip count")
     ap.add_argument("--watch", action="store_true", help="redraw continuously")
+    ap.add_argument(
+        "--chip",
+        metavar="SLICE/ID",
+        help="single-chip drill-down (e.g. slice-0/17) instead of the table",
+    )
     args = ap.parse_args(argv)
 
     cfg = load_config()
@@ -96,12 +152,18 @@ def main(argv: "list[str] | None" = None) -> int:
             alert_line = ""
             try:
                 df = to_wide(source.fetch())
-                out = render_table(df, compute_stats(df))
+                stats = compute_stats(df)
+                if args.chip:
+                    out = render_chip(df, stats, args.chip)
+                else:
+                    out = render_table(df, stats)
                 if engine is not None:
                     # pending included: a one-shot run evaluates once, so
                     # @N>1 rules can never reach "firing" here — a breach
                     # in progress must still be visible
                     active = engine.evaluate(df)
+                    if args.chip:
+                        active = [a for a in active if a["chip"] == args.chip]
                     if active:
                         alert_line = "ALERTS: " + "  ".join(
                             f"{a['chip']} {a['rule']} (={a['value']}, "
