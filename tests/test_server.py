@@ -428,3 +428,23 @@ def test_profile_preserves_outage_error_state():
         assert "real outage" in health["error"]
 
     _run(_with_client(app, go))
+
+
+def test_frame_etag_revalidation():
+    # polling clients revalidate: unchanged frames cost a 304, any data or
+    # state change flips the ETag
+    cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=60.0)
+
+    async def go(client):
+        resp = await client.get("/api/frame")
+        etag = resp.headers.get("ETag")
+        assert etag
+        resp = await client.get("/api/frame", headers={"If-None-Match": etag})
+        assert resp.status == 304
+        # a selection change invalidates the tag
+        await client.post("/api/select", json={"all": True})
+        resp = await client.get("/api/frame", headers={"If-None-Match": etag})
+        assert resp.status == 200
+        assert resp.headers["ETag"] != etag
+
+    _run(_with_client(_client_app(cfg), go))

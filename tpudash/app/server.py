@@ -279,8 +279,24 @@ class DashboardServer:
         return resp
 
     async def frame(self, request: web.Request) -> web.Response:
-        frame = await self._get_frame(entry=self._entry(request))
-        return web.json_response(frame)
+        """Current frame, with ETag revalidation: the polling fallback
+        re-fetches every interval, and between data refreshes the frame
+        is byte-identical — a conditional GET costs 304 + no body instead
+        of the full ~100KB figure JSON.  Browsers do this automatically
+        for fetch() under Cache-Control: no-cache."""
+        entry = self._entry(request)
+        frame = await self._get_frame(entry=entry)
+        etag = (
+            '"' + "-".join(str(int(p)) for p in entry.frame_key) + '"'
+            if entry.frame_key is not None
+            else None
+        )
+        headers = {"Cache-Control": "no-cache"}
+        if etag is not None:
+            headers["ETag"] = etag
+            if request.headers.get("If-None-Match") == etag:
+                return web.Response(status=304, headers=headers)
+        return web.json_response(frame, headers=headers)
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent events: push a frame every refresh interval.  All
