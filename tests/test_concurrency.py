@@ -102,3 +102,70 @@ def test_sse_subscribers_while_mutating():
             s.close()
 
     _run(_with_client(_app(), go))
+
+
+def test_sessions_stream_and_mutate_concurrently():
+    # three browser sessions stream (delta transport) while each also
+    # mutates its own selection/style concurrently: every SSE event must
+    # be parseable (full, delta, or keepalive), sessions must never see
+    # each other's mutations, and the server must end consistent
+    from tpudash.app.server import SESSION_COOKIE
+
+    async def go(client):
+        events = {"a": [], "b": [], "c": []}
+
+        async def stream(sid, n):
+            resp = await client.get(
+                "/api/stream", cookies={SESSION_COOKIE: sid}
+            )
+            got = 0
+            while got < n:
+                raw = await asyncio.wait_for(
+                    resp.content.readuntil(b"\n\n"), timeout=30
+                )
+                if raw.startswith(b":"):
+                    continue  # keepalive
+                events[sid].append(json.loads(raw.decode()[len("data: "):]))
+                got += 1
+            resp.close()
+
+        async def churn(sid, key_mod):
+            for i in range(8):
+                await client.post(
+                    "/api/select",
+                    json={"toggle": f"slice-0/{(i * 7) % key_mod}"},
+                    cookies={SESSION_COOKIE: sid},
+                )
+                await client.post(
+                    "/api/style",
+                    json={"use_gauge": i % 2 == 0},
+                    cookies={SESSION_COOKIE: sid},
+                )
+                await asyncio.sleep(0)
+
+        await asyncio.gather(
+            stream("a", 6), stream("b", 6), stream("c", 6),
+            churn("a", 32), churn("b", 16), churn("c", 8),
+        )
+        for sid, evs in events.items():
+            assert len(evs) == 6
+            assert evs[0]["kind"] == "full"
+            for ev in evs:
+                assert ev["kind"] in ("full", "delta")
+                if ev["kind"] == "full":
+                    assert ev["error"] is None
+        # sessions stayed independent after the dust settles
+        frames = {}
+        for sid in events:
+            frames[sid] = await (
+                await client.get("/api/frame", cookies={SESSION_COOKIE: sid})
+            ).json()
+        assert all(f["error"] is None for f in frames.values())
+        # each session's final selection is sorted and self-consistent
+        for f in frames.values():
+            sel = f["selected"]
+            assert sel == sorted(sel, key=lambda k: int(k.rsplit("/", 1)[1]))
+            grid_selected = {c["key"] for c in f["chips"] if c["selected"]}
+            assert grid_selected == set(sel)
+
+    _run(_with_client(_app(chips=32), go))

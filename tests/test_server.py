@@ -448,3 +448,21 @@ def test_frame_etag_revalidation():
         assert resp.headers["ETag"] != etag
 
     _run(_with_client(_client_app(cfg), go))
+
+
+def test_frame_gzip_negotiated():
+    # sizable JSON bodies compress when the client accepts encoding (the
+    # 256-chip frame ships ~9x smaller on the wire); tiny bodies skip it
+    async def go(client):
+        resp = await client.get(
+            "/api/frame", headers={"Accept-Encoding": "gzip"}
+        )
+        assert resp.headers.get("Content-Encoding") == "gzip"
+        frame = await resp.json()  # transparently decompressed
+        assert frame["error"] is None
+        small = await client.get(
+            "/healthz", headers={"Accept-Encoding": "gzip"}
+        )
+        assert small.headers.get("Content-Encoding") is None
+
+    _run(_with_client(_client_app(), go))

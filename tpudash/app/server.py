@@ -311,6 +311,10 @@ class DashboardServer:
                 "X-Accel-Buffering": "no",
             }
         )
+        # NOT compressed: aiohttp's StreamResponse deflate buffers across
+        # writes, so events would sit in the zlib window instead of
+        # arriving on time (verified — the stream tests stall).  The
+        # delta transport already cuts steady-state ticks ~5×.
         await resp.prepare(request)
         client_key = None  # version pair this subscriber last received
         try:
@@ -688,6 +692,21 @@ class DashboardServer:
         )
 
     @web.middleware
+    async def _compress(self, request: web.Request, handler):
+        """Negotiated gzip/deflate on sizable bodies: frame JSON is
+        number-heavy and compresses ~6-8×, so a polling client's 100KB
+        frame ships as ~15KB when the browser sends Accept-Encoding.
+        Small bodies skip it (header overhead beats the win)."""
+        resp = await handler(request)
+        if (
+            isinstance(resp, web.Response)
+            and resp.body is not None
+            and len(resp.body) > 1024
+        ):
+            resp.enable_compression()
+        return resp
+
+    @web.middleware
     async def _auth(self, request: web.Request, handler):
         """Bearer-token gate (Config.auth_token); only /api/stream also
         accepts ``?token=`` (EventSource transport).  /healthz stays open
@@ -715,7 +734,7 @@ class DashboardServer:
         return await handler(request)
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth])
+        app = web.Application(middlewares=[self._auth, self._compress])
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/api/stream", self.stream)
