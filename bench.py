@@ -50,7 +50,11 @@ def bench_dashboard() -> dict:
     p50 = svc.timer.percentile(0.5)
     p95 = svc.timer.percentile(0.95)
     # wire cost per subscriber per refresh interval: the first tick's full
-    # frame vs the steady-state value-only delta (tpudash/app/delta.py)
+    # frame vs the steady-state value-only delta (tpudash/app/delta.py),
+    # plus the gzip size a polling client actually downloads (the server
+    # negotiates compression on /api/frame)
+    import gzip
+
     from tpudash.app.delta import frame_delta
 
     payload = f"data: {json.dumps(dict(frame, kind='full'))}\n\n".encode()
@@ -62,6 +66,7 @@ def bench_dashboard() -> dict:
         "p95_s": p95,
         "sse_bytes": len(payload),
         "sse_delta_bytes": len(delta_payload),
+        "frame_gzip_bytes": len(gzip.compress(json.dumps(frame).encode())),
     }
 
 
@@ -146,7 +151,7 @@ except Exception as e:
 """
 
 
-def bench_probes(timeout_s: float = 420.0) -> dict:
+def bench_probes(timeout_s: float = 300.0) -> dict:
     """On-chip probe numbers, isolated in a SUBPROCESS with a hard
     timeout: a wedged accelerator runtime (e.g. a tunneled chip whose
     lease is stuck — jax backend init then blocks forever, it does not
@@ -194,6 +199,7 @@ def main() -> None:
         "budget_s": BUDGET_S,
         "sse_full_frame_bytes": dash["sse_bytes"],
         "sse_delta_bytes": dash["sse_delta_bytes"],
+        "frame_gzip_bytes": dash["frame_gzip_bytes"],
         "multislice_2x256_p50_ms": round(multi["p50_s"] * 1e3, 2),
         "torus3d_v4_4x4x8_p50_ms": round(torus3d["p50_s"] * 1e3, 2),
         "torus3d_grid": torus3d["grid"],
