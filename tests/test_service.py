@@ -337,3 +337,19 @@ def test_3d_torus_frame_renders_z_plane_geometry():
     # every selected chip's value landed somewhere: 128 non-None cells
     filled = sum(1 for row in z for v in row if v is not None)
     assert filled == 128
+
+
+def test_long_run_state_stays_bounded():
+    # a dashboard runs for days: rolling structures must stay bounded and
+    # the frame must stay healthy over many cycles
+    svc = _svc(refresh_interval=0.0)
+    for _ in range(600):
+        frame = svc.render_frame()
+    assert frame["error"] is None
+    assert len(svc.history) <= svc.history.maxlen
+    assert len(svc.chip_history) <= svc.chip_history.maxlen
+    assert len(svc.timer.history) <= svc.timer.history.maxlen
+    # percentile surfaces stay well-formed
+    t = svc.timer.summary()
+    assert t["frames"] == svc.timer.history.maxlen or t["frames"] <= 601
+    assert t["total"]["p50_ms"] > 0

@@ -444,11 +444,12 @@ function startStream() {
     if (msg.kind === 'delta') {
       if (!lastFrame) { refresh(); return; }  // missed the full frame
       lastFrame = applyDelta(lastFrame, msg);
-      applyFrame(lastFrame);
     } else {
       lastFrame = msg;
-      applyFrame(msg);
     }
+    // keep the model current but skip DOM/plot work for hidden tabs —
+    // the visibilitychange handler re-renders on return
+    if (!document.hidden) applyFrame(lastFrame);
   };
   es.onerror = () => {
     // server restart / proxy hiccup: drop to polling; EventSource
@@ -515,6 +516,10 @@ function showWarnings(list) {
   if (list && list.length) { b.style.display = 'block'; b.textContent = 'Degraded: ' + list.join(' · '); }
   else b.style.display = 'none';
 }
+
+document.addEventListener('visibilitychange', () => {
+  if (!document.hidden && lastFrame) applyFrame(lastFrame);
+});
 
 let timer = null;
 let streaming = false;
