@@ -1,0 +1,94 @@
+"""Programmatic API consumption: a one-shot fleet report.
+
+Pulls ``/api/frame``, the CSV table, and the drill-down for the hottest
+chip from a running tpudash and prints a compact report — the kind of
+script an oncall wires into a cron or a chat bot.  Works against any
+source the dashboard is configured with.
+
+    # terminal 1                              # terminal 2
+    TPUDASH_SOURCE=synthetic python -m tpudash
+    python examples/fleet_report.py http://localhost:8050 [token]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import requests
+
+from tpudash import schema
+
+
+def _get(base: str, path: str, token: "str | None"):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    resp = requests.get(f"{base}{path}", headers=headers, timeout=10)
+    resp.raise_for_status()
+    return resp
+
+
+def hottest_chip(base: str, token: "str | None", column: str) -> "str | None":
+    """Chip key with the max value in ``column``, from the CSV table (the
+    frame carries per-chip numbers only inside figures)."""
+    rows = [
+        r.split(",")
+        for r in _get(base, "/api/export.csv", token).text.strip().splitlines()
+    ]
+    header, body = rows[0], rows[1:]
+    if column not in header or not body:
+        return None
+    i = header.index(column)
+
+    def value(row):
+        try:
+            return float(row[i])
+        except (ValueError, IndexError):
+            return float("-inf")
+
+    return max(body, key=value)[0]
+
+
+def report(base: str, token: "str | None" = None) -> str:
+    frame = _get(base, "/api/frame", token).json()
+    if frame.get("error"):
+        return f"DOWN: {frame['error']}"
+    lines: list[str] = []
+    stats = frame.get("stats", {})
+    util = stats.get(schema.TENSORCORE_UTIL, {})
+    lines.append(
+        f"fleet: {len(frame['chips'])} chips, "
+        f"util mean {util.get('mean', '?')}% p95 {util.get('p95', '?')}% "
+        f"(data {frame['last_updated']})"
+    )
+    for warning in frame.get("warnings", []):
+        lines.append(f"warning: {warning}")
+    for gap in frame.get("unavailable_panels", []):
+        lines.append(f"gap: {gap['title']} — {gap['reason']}")
+    for a in [a for a in frame.get("alerts", []) if a["state"] == "firing"][:5]:
+        lines.append(
+            f"ALERT {a['severity']}: {a['chip']} {a['rule']} (={a['value']})"
+        )
+
+    by = (
+        schema.TEMPERATURE
+        if schema.TEMPERATURE in stats
+        else schema.TENSORCORE_UTIL
+    )
+    key = hottest_chip(base, token, by)
+    if key:
+        d = _get(base, f"/api/chip?key={key}", token).json()
+        values = ", ".join(
+            f"{f['panel']}={f['figure']['data'][0].get('value', '?')}"
+            for f in d["figures"][:4]
+        )
+        lines.append(
+            f"hottest ({by}): {d['key']} on {d['host']} ({d['model']}) — {values}"
+        )
+        if d["neighbors"]:
+            lines.append(f"  ICI neighbors: {', '.join(d['neighbors'])}")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    base_url = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:8050"
+    auth = sys.argv[2] if len(sys.argv) > 2 else None
+    print(report(base_url, auth))

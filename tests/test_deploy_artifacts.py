@@ -72,3 +72,67 @@ def test_manifests_parse_and_reference_real_ports():
     text = json.dumps([exporter, dashboard])
     assert str(cfg.exporter_port) in text
     assert str(cfg.port) in text
+
+
+def test_fleet_report_example_runs_against_a_live_server():
+    # the example script is a real API consumer: run it against an
+    # in-process server (requests is patched onto the aiohttp test client)
+    import asyncio
+    import importlib.util
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_report", os.path.join(DEPLOY, os.pardir, "examples", "fleet_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    async def go():
+        cfg = Config(source="synthetic", refresh_interval=0.0, fetch_retries=0)
+        service = DashboardService(cfg, SyntheticSource(num_chips=16))
+        client = TestClient(TestServer(DashboardServer(service).build_app()))
+        await client.start_server()
+        try:
+
+            class _Resp:
+                def __init__(self, status, text):
+                    self.status_code = status
+                    self.text = text
+
+                def raise_for_status(self):
+                    assert self.status_code == 200
+
+                def json(self):
+                    import json as _json
+
+                    return _json.loads(self.text)
+
+            def fake_get(url, headers=None, timeout=None):
+                return _Resp(*pending[url.split("BASE", 1)[1]])
+
+            # pre-fetch every path the script hits through the real server
+            pending = {}
+            for path in ("/api/frame", "/api/export.csv"):
+                r = await client.get(path)
+                pending[path] = (r.status, await r.text())
+            frame = json.loads(pending["/api/frame"][1])
+            # the drill-down path depends on the hottest chip — fetch all
+            for c in frame["chips"]:
+                r = await client.get(f"/api/chip?key={c['key']}")
+                pending[f"/api/chip?key={c['key']}"] = (r.status, await r.text())
+
+            mod.requests = type("R", (), {"get": staticmethod(fake_get)})
+            mod._get.__globals__["requests"] = mod.requests
+            out = mod.report("BASE")
+            assert out.startswith("fleet: 16 chips")
+            assert "hottest (" in out and "ICI neighbors:" in out
+        finally:
+            await client.close()
+
+    asyncio.run(go())
