@@ -157,3 +157,26 @@ def test_backfill_disabled_by_default():
 
 def test_env_knob():
     assert load_config({"TPUDASH_HISTORY_BACKFILL": "900"}).history_backfill == 900.0
+
+
+def test_backfill_seeds_the_per_chip_ring_too():
+    # drill-down sparklines must carry real trend right after a restart,
+    # not start empty until the live loop accumulates points
+    cfg = Config(history_backfill=600, fetch_retries=0)
+    svc = DashboardService(cfg, _HistoryFixtureSource(FIXTURE))
+    assert len(svc.chip_history) == 3
+    svc.render_frame()  # live alignment matches the backfilled keys
+    detail = svc.chip_detail("slice-0/0")
+    assert detail is not None
+    trend = next(
+        t for t in detail["trends"] if t["panel"] == schema.TENSORCORE_UTIL
+    )
+    ys = trend["figure"]["data"][0]["y"]
+    assert len(ys) >= 4  # 3 backfilled points + the live frame
+    assert ys[0] == 50.0  # chip 0's own backfilled value, not the average
+    # POWER has no point at ts=105 (ragged range data): union alignment
+    # keeps its other backfilled points instead of discarding the series
+    power = next(
+        t for t in detail["trends"] if t["panel"] == schema.POWER
+    )
+    assert len(power["figure"]["data"][0]["y"]) >= 3  # 100, 110, live

@@ -296,6 +296,7 @@ class DashboardService:
             return
         columns = [p.column for p in (*schema.PANELS, *schema.EXTRA_PANELS)]
         n = 0
+        ring_frames: list = []
         for ts, samples in points[-(self.history.maxlen or 0) :]:
             try:
                 df = to_wide(samples)
@@ -306,7 +307,52 @@ class DashboardService:
             }
             if avgs:
                 self.history.append((float(ts), avgs))
+                ring_frames.append((float(ts), df))
                 n += 1
+        # Seed the per-chip ring too, so drill-down sparklines carry real
+        # trend right after a restart.  Range data is ragged (a metric or
+        # chip can be absent at some timestamps), so every point aligns to
+        # the UNION of chips/metrics across the window — a series that
+        # happens to miss the final step keeps its earlier trend, and
+        # missing cells become NaN instead of thrashing the alignment.
+        # Best-effort like the rest of backfill: never a startup crash.
+        try:
+            if ring_frames:
+                from tpudash.app.state import _sort_key
+                from tpudash.normalize import numeric_columns
+
+                all_keys: dict = {}
+                all_cols: dict = {}
+                for _, df in ring_frames:
+                    for k in df.index:
+                        all_keys[k] = None
+                    for c in numeric_columns(df):
+                        all_cols[c] = None
+                # same (slice, chip) order to_wide produces, so a live
+                # frame with the same population realigns instead of
+                # resetting the ring
+                keys = sorted(all_keys, key=_sort_key)
+                cols = list(all_cols)
+                if cols:
+                    self.chip_history.clear()
+                    self._chip_hist_keys = keys
+                    self._chip_hist_cols = cols
+                    self._chip_hist_rowmap = {
+                        k: i for i, k in enumerate(keys)
+                    }
+                    for ts, df in ring_frames:
+                        sub = df.reindex(index=keys, columns=cols).apply(
+                            pd.to_numeric, errors="coerce"
+                        )
+                        self.chip_history.append(
+                            (ts, sub.to_numpy(dtype=np.float32))
+                        )
+        except Exception as e:  # noqa: BLE001 — ring seeding is optional
+            log.warning("per-chip history backfill failed: %s", e)
+            self.chip_history.clear()
+            self._chip_hist_keys = []
+            self._chip_hist_cols = []
+            self._chip_hist_rowmap = {}
         if n:
             log.info(
                 "backfilled %d trend points covering %.0f s", n, self.cfg.history_backfill
@@ -878,7 +924,29 @@ class DashboardService:
                     keys != self._chip_hist_keys
                     or cols != self._chip_hist_cols
                 ):
-                    self.chip_history.clear()
+                    if keys == self._chip_hist_keys and self.chip_history:
+                        # same chips, different metric set (a live scrape
+                        # is richer than the Prometheus backfill): project
+                        # stored points onto the new columns instead of
+                        # throwing the history away
+                        old_pos = {
+                            c: i for i, c in enumerate(self._chip_hist_cols)
+                        }
+                        proj = [old_pos.get(c, -1) for c in cols]
+                        realigned = deque(maxlen=self.chip_history.maxlen)
+                        for ts_old, m in self.chip_history:
+                            nm = np.full(
+                                (m.shape[0], len(cols)),
+                                np.nan,
+                                dtype=np.float32,
+                            )
+                            for j, src in enumerate(proj):
+                                if src >= 0:
+                                    nm[:, j] = m[:, src]
+                            realigned.append((ts_old, nm))
+                        self.chip_history = realigned
+                    else:
+                        self.chip_history.clear()
                     self._chip_hist_keys = keys
                     self._chip_hist_cols = cols
                     self._chip_hist_rowmap = {
