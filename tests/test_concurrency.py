@@ -20,6 +20,16 @@ from tpudash.sources.fixture import SyntheticSource
 FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
 
 
+def _sse_json(raw: bytes):
+    """Parse one SSE event's data payload (events may carry an id: line)."""
+    import json as _j
+
+    for line in raw.decode().splitlines():
+        if line.startswith("data: "):
+            return _j.loads(line[len("data: "):])
+    raise AssertionError(f"no data line in SSE event: {raw!r}")
+
+
 def _app(chips=32):
     cfg = Config(source="synthetic", refresh_interval=0.0, fetch_retries=0)
     service = DashboardService(cfg, SyntheticSource(num_chips=chips))
@@ -84,7 +94,7 @@ def test_sse_subscribers_while_mutating():
                 raw = await asyncio.wait_for(
                     resp.content.readuntil(b"\n\n"), timeout=10
                 )
-                out.append(json.loads(raw.decode()[len("data: ") :]))
+                out.append(_sse_json(raw))
             return out
 
         async def mutate():
@@ -125,7 +135,7 @@ def test_sessions_stream_and_mutate_concurrently():
                 )
                 if raw.startswith(b":"):
                     continue  # keepalive
-                events[sid].append(json.loads(raw.decode()[len("data: "):]))
+                events[sid].append(_sse_json(raw))
                 got += 1
             resp.close()
 

@@ -133,3 +133,35 @@ def test_unknown_figure_type_forces_full_not_crash():
     }
     assert frame_delta(prev, weird) is None
     assert frame_delta(weird, cur) is None
+
+
+def test_property_fuzz_roundtrip_over_random_service_states():
+    # property: whenever frame_delta yields a patch, applying it to prev
+    # reproduces cur EXACTLY — across randomized selections, styles, and
+    # fleet sizes (seeded, deterministic)
+    import random
+
+    rng = random.Random(20260730)
+    for chips in (3, 17, 40):
+        svc = _svc(SyntheticSource(num_chips=chips), synthetic_chips=chips)
+        svc.render_frame()
+        prev = svc.render_frame()
+        deltas = fulls = 0
+        for _ in range(12):
+            mutate = rng.random()
+            if mutate < 0.3:
+                svc.state.toggle(
+                    f"slice-0/{rng.randrange(chips)}", svc.available
+                )
+            elif mutate < 0.4:
+                svc.state.use_gauge = not svc.state.use_gauge
+            cur = svc.render_frame()
+            delta = frame_delta(prev, cur)
+            if delta is None:
+                fulls += 1
+            else:
+                deltas += 1
+                assert apply_delta(prev, delta) == cur
+            prev = cur
+        assert deltas > 0  # steady-state ticks must actually patch
+        assert fulls > 0   # mutations must actually force fulls

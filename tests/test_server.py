@@ -14,6 +14,16 @@ from tpudash.sources.fixture import FixtureSource
 FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
 
 
+def _sse_json(raw: bytes):
+    """Parse one SSE event's data payload (events may carry an id: line)."""
+    import json as _j
+
+    for line in raw.decode().splitlines():
+        if line.startswith("data: "):
+            return _j.loads(line[len("data: "):])
+    raise AssertionError(f"no data line in SSE event: {raw!r}")
+
+
 def _run(coro):
     return asyncio.run(coro)
 
@@ -104,7 +114,7 @@ def test_stream_pushes_frames():
             raw = await asyncio.wait_for(
                 resp.content.readuntil(b"\n\n"), timeout=10
             )
-            events.append(json.loads(raw.decode()[len("data: ") :]))
+            events.append(_sse_json(raw))
         # first event is a full frame; steady-state ticks are value-only
         # deltas (frame-diff transport, tpudash/app/delta.py).  The 2nd
         # frame grows sparklines — a structural change, so still full.

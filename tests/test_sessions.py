@@ -20,6 +20,16 @@ from tpudash.sources.fixture import FixtureSource
 FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
 
 
+def _sse_json(raw: bytes):
+    """Parse one SSE event's data payload (events may carry an id: line)."""
+    import json as _j
+
+    for line in raw.decode().splitlines():
+        if line.startswith("data: "):
+            return _j.loads(line[len("data: "):])
+    raise AssertionError(f"no data line in SSE event: {raw!r}")
+
+
 def _run(coro):
     return asyncio.run(coro)
 
@@ -231,7 +241,7 @@ def test_stream_keeps_session_alive_and_tracks_replacement():
             sid = {SESSION_COOKIE: "watcher"}
             resp = await client.get("/api/stream", cookies=sid)
             raw = await asyncio.wait_for(resp.content.readuntil(b"\n\n"), timeout=10)
-            first = _json.loads(raw.decode()[len("data: "):])
+            first = _sse_json(raw)
             assert first["selected"] == ["slice-0/0"]
             watcher = server.sessions.entry("watcher")
             seen_before = watcher.last_seen
@@ -244,7 +254,7 @@ def test_stream_keeps_session_alive_and_tracks_replacement():
                 )
                 if raw.startswith(b":"):
                     continue  # keepalive comment
-                frame = _json.loads(raw.decode()[len("data: "):])
+                frame = _sse_json(raw)
                 # deltas carry no selection; the post-select tick is full
                 if frame.get("selected") == ["slice-0/0", "slice-0/1"]:
                     break
@@ -274,6 +284,54 @@ def test_last_updated_reflects_scrape_time_not_compose_time():
             f2 = await (await client.get("/api/frame")).json()
             assert f2["last_updated"] == "1999-01-01 00:00:00"
             assert f1["error"] is None and f2["error"] is None
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_stream_reconnect_resumes_with_delta():
+    # EventSource echoes the last event id on reconnect: a dropped
+    # connection must resume with a value-only delta (or keepalive), not
+    # re-download the full frame
+    async def go():
+        cfg = Config(source="fixture", fixture_path=FIXTURE, refresh_interval=0.0)
+        server = _server(cfg)
+        client = await _client(server.build_app())
+        try:
+            sid = {SESSION_COOKIE: "reconnector"}
+            resp = await client.get("/api/stream", cookies=sid)
+            ids = []
+            for _ in range(3):  # settle past the sparkline growth
+                raw = await asyncio.wait_for(
+                    resp.content.readuntil(b"\n\n"), timeout=10
+                )
+                for line in raw.decode().splitlines():
+                    if line.startswith("id: "):
+                        ids.append(line[4:])
+            resp.close()
+            assert ids, "events must carry SSE ids"
+            # reconnect with the last id → first event is a delta
+            resp = await client.get(
+                "/api/stream", cookies=sid,
+                headers={"Last-Event-ID": ids[-1]},
+            )
+            raw = await asyncio.wait_for(
+                resp.content.readuntil(b"\n\n"), timeout=10
+            )
+            if not raw.startswith(b":"):  # keepalive also acceptable
+                assert _sse_json(raw)["kind"] == "delta"
+            resp.close()
+            # a garbled id falls back to a full frame
+            resp = await client.get(
+                "/api/stream", cookies=sid,
+                headers={"Last-Event-ID": "garbage"},
+            )
+            raw = await asyncio.wait_for(
+                resp.content.readuntil(b"\n\n"), timeout=10
+            )
+            assert _sse_json(raw)["kind"] == "full"
+            resp.close()
         finally:
             await client.close()
 

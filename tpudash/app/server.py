@@ -47,6 +47,25 @@ from tpudash.sources import make_source
 SESSION_COOKIE = "tpudash_sid"
 
 
+def _key_id(key: tuple) -> str:
+    """Compose-cache key as an SSE event id ("dv-sv-stall")."""
+    return "-".join(str(int(p)) for p in key)
+
+
+def _id_key(raw: "str | None") -> "tuple | None":
+    """Parse a Last-Event-ID back into a compose-cache key (None when
+    absent/garbled — the stream then starts with a full frame)."""
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) != 3:
+        return None
+    try:
+        return (int(parts[0]), int(parts[1]), bool(int(parts[2])))
+    except ValueError:
+        return None
+
+
 class DashboardServer:
     def __init__(self, service: DashboardService):
         self.service = service
@@ -233,7 +252,9 @@ class DashboardServer:
                     delta = frame_delta(prev, frame)
                     if delta is None:
                         return None
-                    return f"data: {json.dumps(delta)}\n\n".encode()
+                    return (
+                        f"id: {_key_id(key)}\ndata: {json.dumps(delta)}\n\n"
+                    ).encode()
 
                 payload = await loop.run_in_executor(None, build_delta)
                 if payload is not None:
@@ -244,7 +265,10 @@ class DashboardServer:
                 return entry.sse_full, key
             payload = await loop.run_in_executor(
                 None,
-                lambda: f"data: {json.dumps(dict(frame, kind='full'))}\n\n".encode(),
+                lambda: (
+                    f"id: {_key_id(key)}\n"
+                    f"data: {json.dumps(dict(frame, kind='full'))}\n\n"
+                ).encode(),
             )
             entry.sse_full = payload
             entry.sse_full_key = key
@@ -316,7 +340,10 @@ class DashboardServer:
         # arriving on time (verified — the stream tests stall).  The
         # delta transport already cuts steady-state ticks ~5×.
         await resp.prepare(request)
-        client_key = None  # version pair this subscriber last received
+        # every event carries its compose key as the SSE id, and
+        # EventSource echoes it back on reconnect — a dropped connection
+        # resumes with a delta (or keepalive) instead of a full frame
+        client_key = _id_key(request.headers.get("Last-Event-ID"))
         try:
             while True:
                 # re-resolve every tick: touches last_seen so an actively
