@@ -374,6 +374,20 @@ struct JParser {
     ws();
     if (p >= end || *p != '"') return fail("expected string");
     ++p;
+    // Fast path: almost every string in a Prometheus payload (metric
+    // names, label keys/values, numeric value strings) is escape-free —
+    // scan to the terminator in one pass and assign once, instead of the
+    // per-character push_back loop below (profiled as the parser's
+    // hottest inner loop at 256 chips).
+    {
+      const char* q = p;
+      while (q < end && *q != '"' && *q != '\\') ++q;
+      if (q < end && *q == '"') {
+        if (out != nullptr) out->assign(p, q - p);
+        p = q + 1;
+        return true;
+      }
+    }
     while (p < end) {
       char c = *p;
       if (c == '"') {
