@@ -7,18 +7,23 @@ blocking (requests / on-chip probes), so frames are built in a worker
 executor and never stall the event loop; a frame cache ensures many browser
 tabs cost one scrape per interval, not one per tab.
 
-Routes:
-  GET  /               dashboard page
-  GET  /api/frame      current frame (cached within the refresh interval)
-  GET  /api/stream     server-sent events: one frame per refresh interval
-                       (push path; the page falls back to polling without
-                       EventSource support)
-  POST /api/select     {"toggle": key} | {"selected": [keys]} | {"all": true} | {"none": true}
-  POST /api/style      {"use_gauge": bool}
-  GET  /api/timings    stage-timing summary (tracing, SURVEY.md §5)
-  GET  /api/schema     series/panels/generations metadata (API consumers)
-  GET  /api/export.csv current wide per-chip table as CSV
-  GET  /healthz        liveness
+Routes (full reference: docs/API.md):
+  GET  /                      dashboard page (issues the session cookie)
+  GET  /api/frame             current frame (per-session; ETag/304, gzip)
+  GET  /api/stream            SSE: full frame, then value-only deltas;
+                              reconnect resumes via Last-Event-ID
+  POST /api/select            {"toggle": key} | {"selected": [keys]} |
+                              {"all": true} | {"none": true}  (per session)
+  POST /api/style             {"use_gauge": bool}  (per session)
+  GET  /api/chip?key=…        single-chip drill-down
+  GET  /api/history[?chip=…]  fleet-average or per-chip raw history
+  GET  /api/alerts            current alert states
+  GET  /api/alert-rules.yaml  rules as a Prometheus alerting-rule file
+  GET  /api/timings           stage-timing summary (tracing, SURVEY.md §5)
+  GET  /api/schema            series/panels/generations/capabilities
+  POST /api/profile           cProfile N frames or a JAX device trace
+  GET  /api/export.csv        current wide per-chip table as CSV
+  GET  /healthz               liveness (open without auth)
 """
 
 from __future__ import annotations
@@ -311,7 +316,7 @@ class DashboardServer:
         entry = self._entry(request)
         frame = await self._get_frame(entry=entry)
         etag = (
-            '"' + "-".join(str(int(p)) for p in entry.frame_key) + '"'
+            f'"{_key_id(entry.frame_key)}"'
             if entry.frame_key is not None
             else None
         )

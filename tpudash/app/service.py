@@ -44,6 +44,7 @@ from tpudash.normalize import (
     dense_block,
     filter_selected,
     to_wide,
+    torus_neighbor_keys,
 )
 from tpudash.app.state import SelectionState
 from tpudash.registry import resolve_generation
@@ -76,6 +77,18 @@ PANEL_GAP_REASONS = {
     ),
 }
 _GENERIC_GAP = "no source series in the current scrape"
+
+
+def _downsample(pts: list, max_points: int) -> "tuple[list, dict]":
+    """(strided points anchored at the newest, {ts: "HH:MM:SS"} labels) —
+    shared by the fleet sparklines and the per-chip drill-down trends."""
+    stride = max(1, -(-len(pts) // max_points))
+    pts = pts[::-1][::stride][::-1]
+    fmt = {
+        ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+        for ts, _ in pts
+    }
+    return pts, fmt
 
 
 @functools.lru_cache(maxsize=256)
@@ -622,15 +635,7 @@ class DashboardService:
         if len(self.history) < 2:
             return []
         accels = accel_types_for(sel_df)
-        pts = list(self.history)
-        stride = max(1, -(-len(pts) // max_points))
-        pts = pts[::-1][::stride][::-1]  # stride anchored at the newest point
-        # timestamps are shared across panels: format each once, not once
-        # per panel (~1k strftime calls per frame otherwise)
-        fmt = {
-            ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
-            for ts, _ in pts
-        }
+        pts, fmt = _downsample(list(self.history), max_points)
         out = []
         for spec in panels:
             series = [
@@ -702,13 +707,7 @@ class DashboardService:
         trends = []
         hist_row = self._chip_hist_rowmap.get(key)
         if hist_row is not None and len(self.chip_history) >= 2:
-            pts = list(self.chip_history)
-            stride = max(1, -(-len(pts) // max_points))
-            pts = pts[::-1][::stride][::-1]  # anchored at the newest point
-            fmt = {
-                ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
-                for ts, _ in pts
-            }
+            pts, fmt = _downsample(list(self.chip_history), max_points)
             col_pos = {c: i for i, c in enumerate(self._chip_hist_cols)}
             for spec in panels:
                 ci = col_pos.get(spec.column)
@@ -736,24 +735,8 @@ class DashboardService:
                     }
                 )
         # torus neighbors = the chips it shares ICI links with
-        neighbors: list = []
         try:
-            slice_id = row["slice_id"]
-            same = df[df["slice_id"] == slice_id]
-            ids = same["chip_id"].to_numpy()
-            sane = ids[(ids >= 0) & (ids < 16384)]
-            if sane.size:
-                topo = topology_for(
-                    accel or self.cfg.generation, int(sane.max()) + 1
-                )
-                cid = int(row["chip_id"])
-                if 0 <= cid < topo.num_chips:
-                    want = set(topo.neighbors(cid))
-                    neighbors = [
-                        k
-                        for k, c in zip(same.index.tolist(), ids.tolist())
-                        if c in want
-                    ]
+            neighbors = torus_neighbor_keys(df, key, self.cfg.generation)
         except Exception:  # noqa: BLE001 — neighbors are best-effort context
             neighbors = []
         return {

@@ -92,25 +92,11 @@ def render_chip(df, stats, key: str) -> str:
         p95 = fmt.format(s["p95"]) if s else "-"
         lines.append(f"{header:<10}{val:>10}{mean:>12}{p95:>11}")
     try:
-        from tpudash.topology import topology_for
+        from tpudash.normalize import torus_neighbor_keys
 
-        same = df[df["slice_id"] == row["slice_id"]]
-        ids = same["chip_id"].to_numpy()
-        sane = ids[(ids >= 0) & (ids < 16384)]
-        if sane.size:
-            topo = topology_for(
-                row.get(schema.ACCEL_TYPE) or None, int(sane.max()) + 1
-            )
-            cid = int(row["chip_id"])
-            if 0 <= cid < topo.num_chips:
-                want = set(topo.neighbors(cid))
-                keys = [
-                    str(k)
-                    for k, c2 in zip(same.index.tolist(), ids.tolist())
-                    if c2 in want
-                ]
-                if keys:
-                    lines += ["", "ICI neighbors: " + "  ".join(keys)]
+        keys = torus_neighbor_keys(df, key)
+        if keys:
+            lines += ["", "ICI neighbors: " + "  ".join(keys)]
     except Exception:  # noqa: BLE001 — neighbors are best-effort context
         pass
     return "\n".join(lines)

@@ -330,6 +330,33 @@ def averages(df: pd.DataFrame) -> dict:
     }
 
 
+def torus_neighbor_keys(
+    df: pd.DataFrame, key: str, fallback_generation: "str | None" = None
+) -> list[str]:
+    """Chip keys sharing ICI links with ``key``'s chip on its slice torus
+    (topology sized to the slice population; bogus chip ids excluded) —
+    shared by the web drill-down and the terminal CLI."""
+    from tpudash.topology import topology_for
+
+    row = df.loc[key]
+    same = df[df["slice_id"] == row["slice_id"]]
+    ids = same["chip_id"].to_numpy()
+    sane = ids[(ids >= 0) & (ids < 16384)]
+    if sane.size == 0:
+        return []
+    accel = row.get(schema.ACCEL_TYPE, "") or fallback_generation
+    topo = topology_for(accel, int(sane.max()) + 1)
+    cid = int(row["chip_id"])
+    if not 0 <= cid < topo.num_chips:
+        return []
+    want = set(topo.neighbors(cid))
+    return [
+        str(k)
+        for k, c in zip(same.index.tolist(), ids.tolist())
+        if c in want
+    ]
+
+
 def filter_selected(df: pd.DataFrame, selected: list[str]) -> pd.DataFrame:
     """Restrict the table to the selected chip keys (reference app.py:335),
     ignoring selections that no longer exist (pruning semantics of
