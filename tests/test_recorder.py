@@ -133,3 +133,35 @@ def test_env_knobs():
     )
     assert cfg.record_path == "/tmp/r.jsonl"
     assert cfg.replay_path == "/tmp/p.jsonl"
+
+
+def test_recording_write_failure_degrades_not_fails(tmp_path, monkeypatch, caplog):
+    # disk-full mid-run: the scrape succeeded, the frame must still render;
+    # the failure logs once per streak, not per cycle
+    import builtins
+    import logging
+
+    from tpudash.sources.fixture import SyntheticSource
+    from tpudash.sources.recorder import RecordingSource
+
+    path = tmp_path / "rec.jsonl"
+    src = RecordingSource(SyntheticSource(num_chips=2), str(path))
+    real_open = builtins.open
+    fail = {"on": False}
+
+    def flaky_open(file, *a, **kw):
+        if fail["on"] and str(file) == str(path):
+            raise OSError(28, "No space left on device")
+        return real_open(file, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    assert src.fetch()  # healthy append
+    fail["on"] = True
+    with caplog.at_level(logging.WARNING):
+        assert src.fetch()  # scrape still served
+        assert src.fetch()
+    warnings = [r for r in caplog.records if "recording write failed" in r.message]
+    assert len(warnings) == 1  # streak logged once
+    fail["on"] = False
+    assert src.fetch()
+    assert path.read_text().count("\n") == 2  # healthy appends resumed

@@ -476,3 +476,28 @@ def test_frame_gzip_negotiated():
         assert small.headers.get("Content-Encoding") is None
 
     _run(_with_client(_client_app(), go))
+
+
+def test_profile_device_trace_mode():
+    # the JAX device-trace window works on the CPU test platform too: the
+    # endpoint must return a trace directory that actually holds a trace
+    import shutil
+
+    async def go(client):
+        resp = await client.post(
+            "/api/profile", json={"device": True, "seconds": 0.2}
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["mode"] == "device"
+        assert body["seconds"] == 0.2
+        trace_dir = body["trace_dir"]
+        try:
+            assert os.path.isdir(trace_dir)
+            # jax.profiler.trace wrote something under the directory
+            contents = [e.name for e in os.scandir(trace_dir)]
+            assert contents, "trace directory is empty"
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+
+    _run(_with_client(_client_app(), go))
