@@ -501,3 +501,49 @@ def test_profile_device_trace_mode():
             shutil.rmtree(trace_dir, ignore_errors=True)
 
     _run(_with_client(_client_app(), go))
+
+
+def test_config_endpoint_redacts_secrets():
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        auth_token="hunter2", alert_webhook="http://pager/hook",
+    )
+
+    async def go(client):
+        resp = await client.get(
+            "/api/config", headers={"Authorization": "Bearer hunter2"}
+        )
+        assert resp.status == 200
+        body = (await resp.json())["config"]
+        assert body["source"] == "fixture"
+        assert body["refresh_interval"] == 0.0
+        assert body["auth_token"] == "<set>"       # never the secret itself
+        assert body["alert_webhook"] == "<set>"
+        text = await (
+            await client.get(
+                "/api/config", headers={"Authorization": "Bearer hunter2"}
+            )
+        ).text()
+        assert "hunter2" not in text and "pager" not in text
+        # and the endpoint is auth-gated like every data route
+        assert (await client.get("/api/config")).status == 401
+
+    _run(_with_client(_client_app(cfg), go))
+
+
+def test_history_csv_export():
+    async def go(client):
+        for _ in range(3):
+            await client.get("/api/frame")
+        resp = await client.get("/api/history.csv")
+        assert resp.status == 200
+        lines = (await resp.text()).strip().splitlines()
+        assert lines[0].startswith("ts,")
+        assert "tpu_tensorcore_utilization" in lines[0]
+        assert len(lines) == 4  # header + 3 points
+        resp = await client.get("/api/history.csv?chip=slice-0/1")
+        lines = (await resp.text()).strip().splitlines()
+        assert len(lines) == 4
+        assert (await client.get("/api/history.csv?chip=nope")).status == 404
+
+    _run(_with_client(_client_app(), go))

@@ -715,6 +715,53 @@ class DashboardServer:
             }
         )
 
+    async def config(self, request: web.Request) -> web.Response:
+        """Effective configuration (secrets redacted) — "which knobs is
+        this dashboard actually running with" without shell access to its
+        pod.  Values come from the live Config, so env parsing and
+        defaults are already applied."""
+        import dataclasses
+
+        cfg = dataclasses.asdict(self.service.cfg)
+        for secret in ("auth_token", "alert_webhook"):
+            if cfg.get(secret):
+                cfg[secret] = "<set>"
+        return web.json_response({"config": cfg})
+
+    async def history_csv(self, request: web.Request) -> web.Response:
+        """The rolling trend history as CSV (one row per point, one column
+        per metric) for offline analysis — fleet averages by default, one
+        chip's own series with ``?chip=``."""
+        chip = request.query.get("chip")
+        async with self._lock:
+            if chip is None:
+                rows = [
+                    (ts, dict(avgs)) for ts, avgs in self.service.history
+                ]
+            else:
+                series = self.service.chip_series(chip)
+                if series is None:
+                    raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
+                rows = series
+        columns: list = []
+        for _, values in rows:
+            for c in values:
+                if c not in columns:
+                    columns.append(c)
+        lines = ["ts," + ",".join(columns)]
+        for ts, values in rows:
+            cells = [f"{ts:.3f}"]
+            for c in columns:
+                v = values.get(c)
+                cells.append("" if v is None else f"{v}")
+            lines.append(",".join(cells))
+        name = f"tpudash-history{'-' + chip.replace('/', '_') if chip else ''}.csv"
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/csv",
+            headers={"Content-Disposition": f"attachment; filename={name}"},
+        )
+
     async def healthz(self, request: web.Request) -> web.Response:
         health = self.service.source_health()
         return web.json_response(
@@ -777,7 +824,9 @@ class DashboardServer:
         app.router.add_get("/api/schema", self.schema)
         app.router.add_post("/api/profile", self.profile)
         app.router.add_get("/api/history", self.history)
+        app.router.add_get("/api/history.csv", self.history_csv)
         app.router.add_get("/api/chip", self.chip)
+        app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/alerts", self.alerts)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
