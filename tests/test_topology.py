@@ -102,3 +102,52 @@ def test_heatmap_grid_rejects_out_of_range_chip_ids():
         heatmap_grid(topo, {-1: 7.0})
     with pytest.raises(ValueError, match="out of range"):
         heatmap_grid(topo, {4: 7.0})
+
+
+def test_topology_endpoint_serves_torus_model():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    async def go():
+        cfg = Config(source="synthetic", refresh_interval=0.0, fetch_retries=0)
+        service = DashboardService(cfg, SyntheticSource(num_chips=16))
+        client = TestClient(TestServer(DashboardServer(service).build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/api/topology")
+            assert resp.status == 200
+            body = await resp.json()
+            (sl,) = body["slices"]
+            assert sl["dims"] == [4, 4] and sl["num_chips"] == 16
+            assert sl["reporting_chips"] == 16
+            chip5 = next(c for c in sl["chips"] if c["chip_id"] == 5)
+            assert chip5["coords"] == [1, 1]
+            assert sorted(chip5["neighbors"]) == [1, 4, 6, 9]
+            assert chip5["key"] == "slice-0/5"
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_topology_model_3d_slice():
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    svc = DashboardService(
+        Config(source="synthetic", generation="v4", fetch_retries=0),
+        SyntheticSource(num_chips=8, generation="v4"),
+    )
+    svc.render_frame()
+    (sl,) = svc.topology_model()["slices"]
+    assert sl["dims"] == [2, 2, 2]
+    chip0 = next(c for c in sl["chips"] if c["chip_id"] == 0)
+    assert chip0["coords"] == [0, 0, 0]
+    assert len(chip0["neighbors"]) == 3  # one per axis at extent 2

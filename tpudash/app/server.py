@@ -715,6 +715,19 @@ class DashboardServer:
             }
         )
 
+    async def topology(self, request: web.Request) -> web.Response:
+        """The fleet's torus model (dims, per-chip coordinates, ICI
+        neighbor graph) for external tooling — the geometry the heatmaps
+        render, as data."""
+        entry = self._entry(request)
+        if self.service.last_df is None:
+            await self._get_frame(entry=entry)  # prime on first request
+        loop = asyncio.get_running_loop()
+        model = await loop.run_in_executor(None, self.service.topology_model)
+        if model is None:
+            raise web.HTTPServiceUnavailable(text="no frame rendered yet")
+        return web.json_response(model)
+
     async def config(self, request: web.Request) -> web.Response:
         """Effective configuration (secrets redacted) — "which knobs is
         this dashboard actually running with" without shell access to its
@@ -827,6 +840,7 @@ class DashboardServer:
         app.router.add_get("/api/history.csv", self.history_csv)
         app.router.add_get("/api/chip", self.chip)
         app.router.add_get("/api/config", self.config)
+        app.router.add_get("/api/topology", self.topology)
         app.router.add_get("/api/alerts", self.alerts)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)

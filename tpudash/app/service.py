@@ -775,6 +775,46 @@ class DashboardService:
             )
         return out
 
+    def topology_model(self) -> "dict | None":
+        """The fleet's torus model — per slice: generation, dims, and per
+        chip: key, torus coordinates, and ICI neighbor ids.  What external
+        tooling (wiring diagrams, placement planners) needs and the
+        heatmap only carries implicitly.  None before the first frame."""
+        with self._publish_lock:
+            df = self.last_df
+            if df is None:
+                return None
+            slices = []
+            for slice_id, same in df.groupby("slice_id", sort=True):
+                ids = same["chip_id"].to_numpy()
+                sane = ids[(ids >= 0) & (ids < 16384)]
+                if sane.size == 0:
+                    continue
+                accels = accel_types_for(same)
+                generation = accels[0] if accels else self.cfg.generation
+                topo = topology_for(generation, int(sane.max()) + 1)
+                chips = [
+                    {
+                        "key": str(k),
+                        "chip_id": int(c),
+                        "coords": list(topo.coords(int(c))),
+                        "neighbors": topo.neighbors(int(c)),
+                    }
+                    for k, c in zip(same.index.tolist(), ids.tolist())
+                    if 0 <= c < topo.num_chips
+                ]
+                slices.append(
+                    {
+                        "slice": str(slice_id),
+                        "generation": topo.generation,
+                        "dims": list(topo.dims),
+                        "num_chips": topo.num_chips,
+                        "reporting_chips": len(chips),
+                        "chips": chips,
+                    }
+                )
+            return {"slices": slices}
+
     # -- the frame -----------------------------------------------------------
     def refresh_data(self) -> "pd.DataFrame | None":
         """Scrape → normalize → alerts → trend history: the shared half of
