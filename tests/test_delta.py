@@ -51,6 +51,23 @@ def test_roundtrip_identity_heatmap_scale():
     assert slim < 0.5 * full, f"delta {slim}B vs full {full}B"
 
 
+def test_stragglers_ride_deltas():
+    # a straggler appearing between two same-shape frames must arrive on
+    # the value-only tick (it's a SCALAR_FIELD, not figure structure)
+    svc = _svc()
+    svc.render_frame()
+    prev = svc.render_frame()
+    cur = svc.render_frame()
+    cur["stragglers"] = [
+        {"column": "tpu_tensorcore_utilization", "chip": "slice-0/1",
+         "value": 40.0, "median": 95.0, "z": -18.4, "direction": "low",
+         "state": "firing", "since": 100.0, "streak": 3}
+    ]
+    delta = frame_delta(prev, cur)
+    assert delta is not None
+    assert apply_delta(prev, delta)["stragglers"] == cur["stragglers"]
+
+
 def test_prev_not_mutated():
     svc = _svc()
     svc.render_frame()

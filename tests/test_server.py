@@ -409,6 +409,55 @@ def test_alerts_endpoint():
     _run(_with_client(_client_app(cfg=cfg), go))
 
 
+def test_stragglers_endpoint():
+    import pandas as pd
+
+    from tpudash import schema
+
+    class SkewedSource(FixtureSource):
+        """Fixture data with a wider fleet where one chip lags badly."""
+
+        def fetch(self):
+            samples = super().fetch()
+            out = list(samples)
+            base = out[0]
+            from tpudash.schema import ChipKey, Sample
+
+            # chips 0/1 re-emitted too: last write wins in the pivot, so
+            # the fixture's own scattered util values don't trip the
+            # bimodality guard
+            for i in range(0, 16):
+                out.append(
+                    Sample(
+                        metric=schema.TENSORCORE_UTIL,
+                        value=95.0 if i < 15 else 40.0,
+                        chip=ChipKey("slice-0", "host-0", i),
+                        accelerator_type="tpu-v5e",
+                    )
+                )
+            return out
+
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        straggler_rules="tpu_tensorcore_utilization@1",
+    )
+
+    async def go(client):
+        await client.get("/api/frame")  # render once to populate
+        resp = await client.get("/api/stragglers")
+        assert resp.status == 200
+        data = await resp.json()
+        assert [s["chip"] for s in data["stragglers"]] == ["slice-0/15"]
+        assert data["stragglers"][0]["column"] == schema.TENSORCORE_UTIL
+        assert data["last_updated"]
+
+    _run(
+        _with_client(
+            _client_app(cfg=cfg, source=SkewedSource(cfg.fixture_path)), go
+        )
+    )
+
+
 def test_profile_preserves_outage_error_state():
     # /healthz serves last_error: a synthetic render that succeeds mid-outage
     # must not clear the real outage banner (and vice versa)

@@ -1,0 +1,279 @@
+"""Fleet straggler detection: scoring, directions, hysteresis, frame/API
+integration (tpudash.stragglers)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.normalize import dense_block
+from tpudash.stragglers import (
+    DEFAULT_RULES_SPEC,
+    StragglerDetector,
+    StragglerRule,
+    parse_rules,
+)
+
+
+def _df(col: str, values: list, keys: "list | None" = None, **extra):
+    keys = keys or [f"s/{i}" for i in range(len(values))]
+    df = pd.DataFrame({col: pd.Series(dict(zip(keys, values))), **extra})
+    df.index.name = "chip"
+    return df
+
+
+def _detector(spec: str, **kw) -> StragglerDetector:
+    kw.setdefault("clock", lambda: 100.0)
+    return StragglerDetector(rules=parse_rules(spec), **kw)
+
+
+# --- parsing ----------------------------------------------------------------
+
+def test_parse_full_grammar():
+    rules = parse_rules("tpu_tensorcore_utilization:low@5, foo_metric:high")
+    assert rules[0] == StragglerRule("tpu_tensorcore_utilization", "low", 5)
+    assert rules[1] == StragglerRule("foo_metric", "high", 3)
+
+
+def test_parse_direction_defaults_from_builtin_table():
+    (util,) = parse_rules("tpu_tensorcore_utilization")
+    assert util.direction == "low"
+    (temp,) = parse_rules("tpu_temperature_celsius")
+    assert temp.direction == "high"
+    (hbm,) = parse_rules("hbm_usage_ratio@2")
+    assert hbm.direction == "both" and hbm.for_cycles == 2
+    (unknown,) = parse_rules("custom_metric")
+    assert unknown.direction == "low"  # fallback
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rules("util !! low")
+    with pytest.raises(ValueError):
+        parse_rules("util:sideways")
+
+
+def test_default_spec_parses():
+    assert len(parse_rules(DEFAULT_RULES_SPEC)) == 4
+
+
+def test_from_config_sentinels():
+    assert StragglerDetector.from_config(
+        Config(straggler_rules="off")
+    ) is None
+    det = StragglerDetector.from_config(Config())
+    assert det is not None and len(det.rules) == 4
+    assert det.zscore == 3.5
+
+
+# --- scoring ----------------------------------------------------------------
+
+def test_low_outlier_flags_on_noisy_fleet():
+    rng = np.random.default_rng(7)
+    vals = list(90.0 + rng.normal(0, 1.0, size=31))
+    vals.append(55.0)  # the straggler
+    det = _detector("tpu_tensorcore_utilization@1")
+    out = det.evaluate(_df(schema.TENSORCORE_UTIL, vals))
+    assert [s["chip"] for s in out] == ["s/31"]
+    s = out[0]
+    assert s["state"] == "firing" and s["direction"] == "low"
+    assert s["value"] == 55.0
+    assert 85.0 <= s["median"] <= 95.0
+    assert s["z"] < -3.5
+
+
+def test_uniform_fleet_mad_zero_still_catches_outlier():
+    # lockstep-typical: 15 identical chips, MAD == 0 → rel_floor scale
+    vals = [95.0] * 15 + [60.0]
+    det = _detector("tpu_tensorcore_utilization@1")
+    out = det.evaluate(_df(schema.TENSORCORE_UTIL, vals))
+    assert [s["chip"] for s in out] == ["s/15"]
+
+
+def test_perfectly_uniform_fleet_flags_nothing():
+    det = _detector("tpu_tensorcore_utilization@1")
+    assert det.evaluate(_df(schema.TENSORCORE_UTIL, [95.0] * 16)) == []
+    assert det.evaluate(_df(schema.TENSORCORE_UTIL, [0.0] * 16)) == []
+
+
+def test_high_direction_temperature():
+    vals = [45.0] * 15 + [88.0]
+    det = _detector("tpu_temperature_celsius@1")
+    out = det.evaluate(_df(schema.TEMPERATURE, vals))
+    assert [s["chip"] for s in out] == ["s/15"]
+    assert out[0]["direction"] == "high" and out[0]["z"] > 3.5
+    # a COLD chip is not a thermal outlier
+    cold = [45.0] * 15 + [20.0]
+    assert det.evaluate(_df(schema.TEMPERATURE, cold)) == []
+
+
+def test_healthy_direction_never_flags():
+    # one chip far ABOVE the fleet on a low-is-bad metric: not a straggler
+    vals = [50.0] * 15 + [99.0]
+    det = _detector("tpu_tensorcore_utilization@1")
+    assert det.evaluate(_df(schema.TENSORCORE_UTIL, vals)) == []
+
+
+def test_min_chips_population_gate():
+    det = _detector("tpu_tensorcore_utilization@1", min_chips=8)
+    vals = [95.0] * 6 + [40.0]  # 7 reporting chips < 8
+    assert det.evaluate(_df(schema.TENSORCORE_UTIL, vals)) == []
+
+
+def test_zero_exclusion_for_power():
+    # parked chips at 0 W are idle, not stragglers, and don't skew the
+    # median (app.py:341-345 policy carried into detection)
+    vals = [0.0] * 6 + [250.0] * 15 + [120.0]
+    det = _detector("tpu_power_watts:both@1")
+    out = det.evaluate(_df(schema.POWER, vals))
+    assert [s["chip"] for s in out] == ["s/21"]
+    assert all(s["value"] != 0.0 for s in out)
+
+
+def test_bimodal_fleet_suppressed_by_max_fraction():
+    # half the fleet idle, half busy: that's two jobs, not 8 stragglers
+    vals = [95.0] * 8 + [5.0] * 8
+    det = _detector("tpu_tensorcore_utilization@1", max_fraction=0.1)
+    assert det.evaluate(_df(schema.TENSORCORE_UTIL, vals)) == []
+
+
+def test_nan_cells_excluded():
+    vals = [95.0] * 12 + [np.nan, np.nan, np.nan, 50.0]
+    det = _detector("tpu_tensorcore_utilization@1")
+    out = det.evaluate(_df(schema.TENSORCORE_UTIL, vals))
+    assert [s["chip"] for s in out] == ["s/15"]
+
+
+def test_dense_block_path_matches_column_path():
+    rng = np.random.default_rng(3)
+    vals = list(80.0 + rng.normal(0, 2.0, size=31)) + [30.0]
+    df = _df(
+        schema.TENSORCORE_UTIL,
+        vals,
+        **{schema.TEMPERATURE: 50.0},
+    )
+    spec = "tpu_tensorcore_utilization@1,tpu_temperature_celsius@1"
+    via_block = _detector(spec).evaluate(df, block=dense_block(df))
+    via_columns = _detector(spec).evaluate(df)
+    assert via_block == via_columns
+    assert [s["chip"] for s in via_block] == ["s/31"]
+
+
+def test_degraded_block_none_arr_falls_back_to_columns():
+    # dense_block degrades to (None, cols) on mixed-dtype frames — the
+    # detector must fall back to per-column coercion, not crash
+    vals = [95.0] * 15 + [60.0]
+    df = _df(schema.TENSORCORE_UTIL, vals)
+    df[schema.TENSORCORE_UTIL] = df[schema.TENSORCORE_UTIL].astype(object)
+    det = _detector("tpu_tensorcore_utilization@1")
+    out = det.evaluate(df, block=(None, [schema.TENSORCORE_UTIL]))
+    assert [s["chip"] for s in out] == ["s/15"]
+
+
+# --- hysteresis -------------------------------------------------------------
+
+def test_pending_then_firing_after_for_cycles():
+    vals = [95.0] * 15 + [60.0]
+    df = _df(schema.TENSORCORE_UTIL, vals)
+    det = _detector("tpu_tensorcore_utilization@3")
+    assert [s["state"] for s in det.evaluate(df)] == ["pending"]
+    assert [s["state"] for s in det.evaluate(df)] == ["pending"]
+    third = det.evaluate(df)
+    assert [s["state"] for s in third] == ["firing"]
+    assert third[0]["since"] == 100.0
+    assert third[0]["streak"] == 3
+
+
+def test_recovery_resets_streak():
+    det = _detector("tpu_tensorcore_utilization@2")
+    bad = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0])
+    good = _df(schema.TENSORCORE_UTIL, [95.0] * 16)
+    det.evaluate(bad)
+    assert det.evaluate(good) == []
+    # streak restarted: first breach after recovery is pending again
+    assert [s["state"] for s in det.evaluate(bad)] == ["pending"]
+
+
+def test_departed_chip_resolves_implicitly():
+    det = _detector("tpu_tensorcore_utilization@1")
+    det.evaluate(_df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0]))
+    assert det._tracks
+    det.evaluate(_df(schema.TENSORCORE_UTIL, [95.0] * 15))
+    assert not det._tracks
+
+
+def test_firing_sorts_before_pending_and_by_severity_of_z():
+    df = _df(
+        schema.TENSORCORE_UTIL,
+        [95.0] * 30 + [60.0, 30.0],
+    )
+    det = _detector("tpu_tensorcore_utilization@1")
+    out = det.evaluate(df)
+    zs = [abs(s["z"]) for s in out]
+    assert zs == sorted(zs, reverse=True)  # worst first
+    assert out[0]["chip"] == "s/31"
+
+
+# --- service / frame integration -------------------------------------------
+
+def _service(vals, **cfg_kwargs):
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(
+        straggler_rules="tpu_tensorcore_utilization@1",
+        synthetic_chips=len(vals),
+        **cfg_kwargs,
+    )
+    svc = DashboardService(cfg, SyntheticSource(num_chips=len(vals)))
+
+    # pin the scraped utilization values deterministically
+    real_refresh = svc.refresh_data
+
+    def refresh_with_pinned_values():
+        df = real_refresh()
+        if df is not None:
+            df[schema.TENSORCORE_UTIL] = vals
+            svc._df_block = dense_block(df)
+            svc.last_stragglers = svc.straggler_detector.evaluate(
+                df, block=svc._df_block
+            )
+        return df
+
+    svc.refresh_data = refresh_with_pinned_values
+    return svc
+
+
+def test_frame_carries_stragglers_and_drilldown_scopes_them():
+    svc = _service([95.0] * 15 + [55.0])
+    frame = svc.render_frame()
+    assert [s["chip"] for s in frame["stragglers"]] == ["slice-0/15"]
+    detail = svc.chip_detail("slice-0/15")
+    assert [s["column"] for s in detail["stragglers"]] == [
+        schema.TENSORCORE_UTIL
+    ]
+    clean = svc.chip_detail("slice-0/3")
+    assert clean["stragglers"] == []
+
+
+def test_disabled_detector_omits_frame_key():
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(straggler_rules="off", synthetic_chips=16)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=16))
+    frame = svc.render_frame()
+    assert "stragglers" not in frame
+
+
+def test_healthy_synthetic_fleet_mostly_quiet():
+    # the synthetic source draws utilization from one distribution — the
+    # detector must not spray false positives over a healthy fleet
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = Config(synthetic_chips=64)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=64))
+    frame = svc.render_frame()
+    assert len(frame.get("stragglers", [])) <= 3

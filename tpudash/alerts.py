@@ -27,6 +27,8 @@ from dataclasses import dataclass, field
 import numpy as np
 import pandas as pd
 
+from tpudash.hysteresis import TrackSet
+
 _OPS = {
     ">": operator.gt,
     ">=": operator.ge,
@@ -101,23 +103,14 @@ def parse_rules(spec: str) -> list[AlertRule]:
 
 
 @dataclass
-class _Track:
-    streak: int = 0
-    firing_since: float | None = None
-    last_value: float = 0.0
-
-
-@dataclass
 class AlertEngine:
-    """Per-frame rule evaluation with consecutive-breach hysteresis.
-
-    State machine per (rule, chip): ok → pending (breaching, streak <
-    for_cycles) → firing; any non-breaching frame resets to ok.
-    """
+    """Per-frame rule evaluation with consecutive-breach hysteresis
+    (state machine in tpudash.hysteresis, shared with the straggler
+    detector)."""
 
     rules: list[AlertRule]
     clock: "object" = time.time
-    _tracks: dict = field(default_factory=dict)
+    _tracks: TrackSet = field(default_factory=TrackSet)
 
     @classmethod
     def from_spec(cls, spec: str | None = None, clock=time.time) -> "AlertEngine":
@@ -168,14 +161,8 @@ class AlertEngine:
                 value = values[i]
                 tkey = (rule.name, chip_key)
                 seen.add(tkey)
-                track = self._tracks.get(tkey)
-                if track is None:
-                    track = self._tracks[tkey] = _Track()
-                track.streak += 1
+                track, firing = self._tracks.hit(tkey, rule.for_cycles, now)
                 track.last_value = float(value)
-                firing = track.streak >= rule.for_cycles
-                if firing and track.firing_since is None:
-                    track.firing_since = now
                 out.append(
                     {
                         "rule": rule.name,
@@ -190,9 +177,7 @@ class AlertEngine:
                     }
                 )
         # implicit resolution for chips/rules not seen this frame
-        for tkey in list(self._tracks):
-            if tkey not in seen:
-                del self._tracks[tkey]
+        self._tracks.resolve_unseen(seen)
         out.sort(
             key=lambda a: (
                 a["state"] != "firing",

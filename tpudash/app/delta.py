@@ -27,6 +27,7 @@ SCALAR_FIELDS = (
     "timings",
     "source_health",
     "alerts",
+    "stragglers",
     "warnings",
     "stats",
     "breakdown",

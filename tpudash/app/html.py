@@ -28,6 +28,9 @@ PAGE = r"""<!DOCTYPE html>
   #alert-banner { display: none; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px;
                   background: #fdeaea; color: #a8322a; border: 1px solid #e74c3c; }
   #alert-banner.warning { background: #fdf6e3; color: #8a6d1a; border-color: #e0b93f; }
+  #straggler-banner { display: none; background: #eef3fb; color: #2a4a78;
+                      border: 1px solid #8fa7c4; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px; }
+  #straggler-banner button { margin-left: 4px; }
   .controls { display: flex; gap: 18px; align-items: center; margin-bottom: 10px; flex-wrap: wrap;}
   .controls label { font-size: 14px; }
   #chip-grid { display: grid; grid-template-columns: repeat(var(--grid-cols, 4), minmax(120px, 1fr));
@@ -69,6 +72,7 @@ PAGE = r"""<!DOCTYPE html>
   <div id="error-banner"></div>
   <div id="warning-banner"></div>
   <div id="alert-banner"></div>
+  <div id="straggler-banner"></div>
   <div id="gap-note" class="hint" style="display:none; margin-bottom: 8px;"></div>
   <div class="controls">
     <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
@@ -255,6 +259,12 @@ function renderDrill(d) {
     html += `<div class="drill-alerts">⚠ ` +
       firing.map(a => esc(a.rule) + ' (=' + (+a.value) + ')').join(' · ') + '</div>';
   }
+  const lagging = (d.stragglers || []).filter(s => s.state === 'firing');
+  if (lagging.length) {
+    html += `<div class="drill-alerts" style="color:#2a4a78">🐢 straggler: ` +
+      lagging.map(s => esc(s.column) + ' ' + (+s.value) + ' vs fleet ' +
+                  (+s.median) + ' (z=' + (+s.z) + ')').join(' · ') + '</div>';
+  }
   html += '<div class="panel-row" id="drill-gauges"></div>';
   html += '<div class="panel-row" id="drill-trends"></div>';
   if (d.neighbors && d.neighbors.length) {
@@ -384,6 +394,7 @@ function applyFrame(frame) {
   showError(frame.error);
   showWarnings(frame.warnings);
   showAlerts(frame.alerts);
+  showStragglers(frame.stragglers);
   if (frame.error) return;  // keep last good panels (reference skips the cycle)
   document.getElementById('use-gauge').checked = frame.use_gauge;
   renderChips(frame.chips);
@@ -415,7 +426,8 @@ let lastFrame = null;
 
 function applyDelta(f, d) {
   for (const k of ['last_updated', 'timings', 'source_health', 'alerts',
-                   'warnings', 'stats', 'breakdown', 'unavailable_panels']) {
+                   'stragglers', 'warnings', 'stats', 'breakdown',
+                   'unavailable_panels']) {
     if (k in d) f[k] = d[k]; else delete f[k];
   }
   const patchFig = (fig, p) => {
@@ -498,6 +510,23 @@ function showAlerts(list) {
   b.textContent = '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
     .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
     (firing.length > 8 ? ' \u2026' : '');
+}
+
+function showStragglers(list) {
+  // fleet outliers gating SPMD lockstep (tpudash.stragglers) — each chip
+  // is a button into its drill-down
+  const b = document.getElementById('straggler-banner');
+  const firing = (list || []).filter(s => s.state === 'firing');
+  if (!firing.length) { b.style.display = 'none'; return; }
+  b.style.display = 'block';
+  b.innerHTML = '🐢 ' + firing.length + ' straggler(s): ' +
+    firing.slice(0, 8).map(s =>
+      `<button data-chip="${esc(s.chip)}">${esc(s.chip)}</button> ` +
+      `${esc(s.column)} ${+s.value} vs fleet ${+s.median} (z=${+s.z})`
+    ).join(' · ') + (firing.length > 8 ? ' …' : '');
+  for (const btn of b.querySelectorAll('button')) {
+    btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
+  }
 }
 
 function showPanelGaps(list) {

@@ -18,6 +18,7 @@ Routes (full reference: docs/API.md):
   GET  /api/chip?key=…        single-chip drill-down
   GET  /api/history[?chip=…]  fleet-average or per-chip raw history
   GET  /api/alerts            current alert states
+  GET  /api/stragglers        fleet outliers (SPMD lockstep stragglers)
   GET  /api/alert-rules.yaml  rules as a Prometheus alerting-rule file
   GET  /api/timings           stage-timing summary (tracing, SURVEY.md §5)
   GET  /api/schema            series/panels/generations/capabilities
@@ -620,6 +621,18 @@ class DashboardServer:
             snapshot = list(self.service.last_alerts)
         return web.json_response({"alerts": snapshot})
 
+    async def stragglers(self, request: web.Request) -> web.Response:
+        """Current fleet outliers (firing + pending), worst first — the
+        chips gating SPMD lockstep, named (tpudash.stragglers)."""
+        async with self._lock:
+            snapshot = list(self.service.last_stragglers)
+        return web.json_response(
+            {
+                "stragglers": snapshot,
+                "last_updated": self.service.last_updated,
+            }
+        )
+
     async def alert_rules_yaml(self, request: web.Request) -> web.Response:
         """The active alert rules as a Prometheus alerting-rule file, so
         the cluster pager can be configured from the same source of truth
@@ -702,6 +715,20 @@ class DashboardServer:
                     }
                     for p in (*s.PANELS, *s.EXTRA_PANELS)
                 ],
+                # fleet outlier scoring (tpudash.stragglers): the active
+                # watch list, or None when disabled
+                "straggler_rules": (
+                    [
+                        {
+                            "column": r.column,
+                            "direction": r.direction,
+                            "for_cycles": r.for_cycles,
+                        }
+                        for r in self.service.straggler_detector.rules
+                    ]
+                    if self.service.straggler_detector is not None
+                    else None
+                ),
                 "generations": {
                     name: {
                         "hbm_gib": g.hbm_gib,
@@ -842,6 +869,7 @@ class DashboardServer:
         app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/topology", self.topology)
         app.router.add_get("/api/alerts", self.alerts)
+        app.router.add_get("/api/stragglers", self.stragglers)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
         return app

@@ -158,6 +158,13 @@ class DashboardService:
 
         self.alert_engine = AlertEngine.from_config(cfg)
         self.last_alerts: list[dict] = []
+        #: fleet outlier scoring every refresh (tpudash.stragglers) — the
+        #: chip gating the slice's lockstep step time, named, not just
+        #: visible on the heatmap
+        from tpudash.stragglers import StragglerDetector
+
+        self.straggler_detector = StragglerDetector.from_config(cfg)
+        self.last_stragglers: list[dict] = []
         #: (rule, chip) pairs firing in the previous frame — webhook
         #: notifications are sent on transitions only, not every cycle
         self._firing_keys: set = set()
@@ -246,6 +253,11 @@ class DashboardService:
         saved_tracks = (
             copy.deepcopy(engine._tracks) if engine is not None else None
         )
+        detector = self.straggler_detector
+        saved_straggler_tracks = (
+            copy.deepcopy(detector._tracks) if detector is not None else None
+        )
+        saved_stragglers = self.last_stragglers
         saved_alerts = self.last_alerts
         saved_firing = set(self._firing_keys)
         saved_history = list(self.history)
@@ -278,9 +290,12 @@ class DashboardService:
                 health.restore(snap)
             if engine is not None:
                 engine._tracks = saved_tracks
+            if detector is not None:
+                detector._tracks = saved_straggler_tracks
             # /api/alerts must not serve the synthetic renders' inflated
             # streaks until the next real frame
             self.last_alerts = saved_alerts
+            self.last_stragglers = saved_stragglers
             self._firing_keys = saved_firing
             self.last_error = saved_error
             self.history.clear()
@@ -749,6 +764,9 @@ class DashboardService:
             "figures": figures,
             "trends": trends,
             "alerts": [a for a in self.last_alerts if a.get("chip") == key],
+            "stragglers": [
+                s for s in self.last_stragglers if s.get("chip") == key
+            ],
             "neighbors": neighbors,
             "last_updated": self.last_updated,
         }
@@ -923,6 +941,11 @@ class DashboardService:
         # session-local now and must not steer the shared sparklines; this
         # also matches the backfill scope (_backfill_history).
         arr, cols = self._df_block = dense_block(df)
+        if self.straggler_detector is not None:
+            with self.timer.stage("analyze"):
+                self.last_stragglers = self.straggler_detector.evaluate(
+                    df, block=self._df_block
+                )
         now = time.time()
         if (
             not self.history
@@ -1010,6 +1033,8 @@ class DashboardService:
             return frame
         if self.alert_engine is not None:
             frame["alerts"] = self.last_alerts
+        if self.straggler_detector is not None:
+            frame["stragglers"] = self.last_stragglers
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)

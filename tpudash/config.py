@@ -123,6 +123,16 @@ class Config:
     #: session map and idle TTL in seconds before eviction.
     session_limit: int = 256
     session_ttl: float = 1800.0
+    #: Straggler-detection watch list (see tpudash.stragglers grammar).
+    #: "" = built-in defaults; "off" disables detection.
+    straggler_rules: str = ""
+    #: Modified-z threshold for flagging (Iglewicz–Hoaglin 3.5).
+    straggler_zscore: float = 3.5
+    #: Minimum reporting chips per metric before outliers are meaningful.
+    straggler_min_chips: int = 8
+    #: Breach-fraction ceiling — above it the fleet is bimodal (two jobs),
+    #: not straggling, and the metric is skipped for the cycle.
+    straggler_max_fraction: float = 0.1
     #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
     #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
     #: URLs ending in /metrics are scraped directly; others are Prometheus
@@ -167,6 +177,10 @@ _ENV_MAP = {
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",
     "alert_webhook": "TPUDASH_ALERT_WEBHOOK",
+    "straggler_rules": "TPUDASH_STRAGGLER_RULES",
+    "straggler_zscore": "TPUDASH_STRAGGLER_ZSCORE",
+    "straggler_min_chips": "TPUDASH_STRAGGLER_MIN_CHIPS",
+    "straggler_max_fraction": "TPUDASH_STRAGGLER_MAX_FRACTION",
 }
 
 

@@ -125,6 +125,7 @@ def main(argv: "list[str] | None" = None) -> int:
     source = make_source(cfg)
 
     from tpudash.alerts import AlertEngine
+    from tpudash.stragglers import StragglerDetector
 
     try:
         engine = AlertEngine.from_config(cfg)
@@ -132,10 +133,16 @@ def main(argv: "list[str] | None" = None) -> int:
         # a bad TPUDASH_ALERT_RULES in the shell must not hide the table
         print(f"warning: alerting disabled ({e})", file=sys.stderr)
         engine = None
+    try:
+        detector = StragglerDetector.from_config(cfg)
+    except ValueError as e:
+        print(f"warning: straggler detection disabled ({e})", file=sys.stderr)
+        detector = None
 
     try:
         while True:
             alert_line = ""
+            straggler_line = ""
             try:
                 df = to_wide(source.fetch())
                 stats = compute_stats(df)
@@ -156,6 +163,19 @@ def main(argv: "list[str] | None" = None) -> int:
                             f"{a['severity']}, {a['state']})"
                             for a in active[:6]
                         ) + (" …" if len(active) > 6 else "")
+                if detector is not None:
+                    # pending included, same one-shot rationale as alerts
+                    lagging = detector.evaluate(df, block=None)
+                    if args.chip:
+                        lagging = [
+                            s for s in lagging if s["chip"] == args.chip
+                        ]
+                    if lagging:
+                        straggler_line = "STRAGGLERS: " + "  ".join(
+                            f"{s['chip']} {s['column']} {s['value']} "
+                            f"vs fleet {s['median']} (z={s['z']})"
+                            for s in lagging[:6]
+                        ) + (" …" if len(lagging) > 6 else "")
             except SourceError as e:
                 out = f"error: {e}"
             if args.watch:
@@ -163,6 +183,8 @@ def main(argv: "list[str] | None" = None) -> int:
             print(out)
             if alert_line:
                 print("\n" + alert_line)
+            if straggler_line:
+                print(("" if alert_line else "\n") + straggler_line)
             health = getattr(source, "health", None)
             status = f"  health={health.status}" if health else ""
             print(
