@@ -1,0 +1,177 @@
+"""Trend-history persistence (TPUDASH_HISTORY_PATH): the fleet sparkline
+ring and the per-chip drill-down ring survive a restart for sources that
+have no Prometheus range query to backfill from."""
+
+import time
+
+import numpy as np
+
+from tpudash import schema
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import SyntheticSource
+
+
+def _svc(tmp_path, chips=8, **kw):
+    cfg = Config(
+        refresh_interval=0.0,
+        synthetic_chips=chips,
+        history_path=str(tmp_path / "trends.npz"),
+        **kw,
+    )
+    return DashboardService(cfg, SyntheticSource(num_chips=chips))
+
+
+def test_roundtrip_restores_both_rings(tmp_path):
+    a = _svc(tmp_path)
+    for _ in range(5):
+        a.render_frame()
+    assert len(a.history) == 5 and len(a.chip_history) == 5
+    a.save_history()
+
+    b = _svc(tmp_path)
+    assert len(b.history) == 5
+    assert len(b.chip_history) == 5
+    assert b._chip_hist_keys == a._chip_hist_keys
+    assert b._chip_hist_cols == a._chip_hist_cols
+    # restored points are value-identical (float32 ring both sides)
+    for (ts_a, m_a), (ts_b, m_b) in zip(a.chip_history, b.chip_history):
+        assert ts_a == ts_b
+        np.testing.assert_array_equal(m_a, m_b)
+    assert [p[0] for p in b.history] == [p[0] for p in a.history]
+    assert b.history[-1][1] == a.history[-1][1]
+    # drill-down serves restored trend immediately
+    key = b._chip_hist_keys[0]
+    series = b.chip_series(key)
+    assert series is not None and len(series) == 5
+
+
+def test_first_frame_after_restore_shows_trends(tmp_path):
+    a = _svc(tmp_path)
+    for _ in range(3):
+        a.render_frame()
+    a.save_history()
+    b = _svc(tmp_path)
+    frame = b.render_frame()
+    # sparklines need >= 2 history points: restored ring provides them on
+    # the very first live frame
+    assert frame["trends"], "expected sparklines from restored history"
+
+
+def test_live_frames_continue_restored_chip_ring(tmp_path):
+    a = _svc(tmp_path)
+    for _ in range(4):
+        a.render_frame()
+    a.save_history()
+    b = _svc(tmp_path)
+    b.render_frame()
+    # same chip population and metric set → the live point appends to the
+    # restored ring instead of resetting it
+    assert len(b.chip_history) == 5
+
+
+def test_stale_snapshot_dropped(tmp_path):
+    a = _svc(tmp_path)
+    for _ in range(3):
+        a.render_frame()
+    # age every point far past the restore cutoff
+    old = [(ts - 10_000_000.0, avgs) for ts, avgs in a.history]
+    a.history.clear()
+    a.history.extend(old)
+    oldc = [(ts - 10_000_000.0, m) for ts, m in a.chip_history]
+    a.chip_history.clear()
+    a.chip_history.extend(oldc)
+    a.save_history()
+    b = _svc(tmp_path)
+    assert len(b.history) == 0
+    assert len(b.chip_history) == 0
+
+
+def test_future_timestamps_dropped_on_restore(tmp_path):
+    # a snapshot written under a clock that then stepped backward must not
+    # freeze new history collection (the cadence gate compares now against
+    # the ring's last timestamp)
+    a = _svc(tmp_path)
+    for _ in range(3):
+        a.render_frame()
+    future = [(ts + 10_000.0, avgs) for ts, avgs in a.history]
+    a.history.clear()
+    a.history.extend(future)
+    futc = [(ts + 10_000.0, m) for ts, m in a.chip_history]
+    a.chip_history.clear()
+    a.chip_history.extend(futc)
+    a.save_history()
+    b = _svc(tmp_path)
+    assert len(b.history) == 0 and len(b.chip_history) == 0
+    b.render_frame()
+    assert len(b.history) == 1  # collection proceeds immediately
+
+
+def test_startup_sweeps_orphaned_tmp_files(tmp_path):
+    (tmp_path / "tmpabc123.npz.tmp").write_bytes(b"orphan")
+    _svc(tmp_path)
+    assert not (tmp_path / "tmpabc123.npz.tmp").exists()
+
+
+def test_corrupt_file_degrades_to_empty(tmp_path):
+    (tmp_path / "trends.npz").write_bytes(b"not an npz file at all")
+    b = _svc(tmp_path)
+    assert len(b.history) == 0
+    frame = b.render_frame()  # and the service still works
+    assert frame["error"] is None
+
+
+def test_empty_service_save_writes_nothing(tmp_path):
+    a = _svc(tmp_path)
+    a.save_history()
+    assert not (tmp_path / "trends.npz").exists()
+
+
+def test_population_change_resets_ring_not_crash(tmp_path):
+    a = _svc(tmp_path, chips=8)
+    for _ in range(3):
+        a.render_frame()
+    a.save_history()
+    b = _svc(tmp_path, chips=16)  # fleet grew while the dashboard was down
+    frame = b.render_frame()
+    assert frame["error"] is None
+    # the restored 8-chip ring reset to the new 16-chip population
+    assert len(b._chip_hist_keys) == 16
+    assert len(b.chip_history) == 1
+
+
+def test_periodic_save_triggered_by_refresh(tmp_path):
+    a = _svc(tmp_path, history_save_interval=0.0)
+    a.render_frame()
+    # the save runs on a daemon thread — poll briefly
+    path = tmp_path / "trends.npz"
+    deadline = time.monotonic() + 5.0
+    while not path.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert path.exists()
+
+
+def test_backfill_wins_over_snapshot(tmp_path):
+    # when a Prometheus backfill seeded the rings, the (older) snapshot
+    # must not be loaded on top of it
+    a = _svc(tmp_path)
+    for _ in range(4):
+        a.render_frame()
+    a.save_history()
+
+    class BackfillingSource(SyntheticSource):
+        def fetch_history(self, duration, step):
+            now = time.time()
+            return [
+                (now - 1.0, list(super().fetch())),
+                (now, list(super().fetch())),
+            ]
+
+    cfg = Config(
+        refresh_interval=0.0,
+        synthetic_chips=8,
+        history_path=str(tmp_path / "trends.npz"),
+        history_backfill=10.0,
+    )
+    b = DashboardService(cfg, BackfillingSource(num_chips=8))
+    assert len(b.history) == 2  # backfill points, not the 4 snapshot ones

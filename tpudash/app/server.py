@@ -872,6 +872,14 @@ class DashboardServer:
         app.router.add_get("/api/stragglers", self.stragglers)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
+        if self.service.cfg.history_path:
+            # final trend snapshot on graceful shutdown (periodic saves
+            # cover crashes up to history_save_interval behind)
+            async def _save_history(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.save_history)
+
+            app.on_cleanup.append(_save_history)
         return app
 
 

@@ -152,6 +152,18 @@ class DashboardService:
         self._df_block = (None, [])
         if cfg.history_backfill > 0:
             self._backfill_history()
+        #: trend persistence (TPUDASH_HISTORY_PATH): restore the rings
+        #: unless a Prometheus backfill already seeded them — live range
+        #: data beats a snapshot from before the restart
+        self._last_history_save = time.time()
+        #: serializes snapshot+write: the shutdown save must not lose the
+        #: os.replace race to a slower in-flight periodic save (older
+        #: snapshot winning the rename)
+        self._history_save_lock = threading.Lock()
+        if cfg.history_path:
+            self._sweep_history_tmp()
+            if not self.history:
+                self._load_history()
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
         from tpudash.alerts import AlertEngine
@@ -385,6 +397,162 @@ class DashboardService:
             log.info(
                 "backfilled %d trend points covering %.0f s", n, self.cfg.history_backfill
             )
+
+    def save_history(self) -> None:
+        """Snapshot both trend rings to ``cfg.history_path`` (compressed
+        npz, atomic replace) — the restart-survival the in-memory deques
+        can't offer sources without a Prometheus range query.  The
+        snapshot is taken under the publish lock (cheap: list() of ring
+        entries); compression runs outside it.  Never raises: trend
+        persistence must not take down a refresh or a shutdown."""
+        path = self.cfg.history_path
+        if not path:
+            return
+        # the save lock covers snapshot AND write: whoever writes last
+        # snapshotted last, so the newest data always wins the rename
+        with self._history_save_lock:
+            self._save_history_locked(path)
+
+    def _save_history_locked(self, path: str) -> None:
+        import json as _json
+        import os
+        import tempfile
+
+        with self._publish_lock:
+            fleet = list(self.history)
+            chip_pts = list(self.chip_history)
+            keys = list(self._chip_hist_keys)
+            cols = list(self._chip_hist_cols)
+        if not fleet and not chip_pts:
+            return  # nothing learned yet — don't clobber a previous file
+        try:
+            fcols: list = []
+            fpos: dict = {}
+            for _, avgs in fleet:
+                for c in avgs:
+                    if c not in fpos:
+                        fpos[c] = len(fcols)
+                        fcols.append(c)
+            fts = np.array([ts for ts, _ in fleet], dtype=np.float64)
+            fdata = np.full((len(fleet), len(fcols)), np.nan, dtype=np.float64)
+            for i, (_, avgs) in enumerate(fleet):
+                for c, v in avgs.items():
+                    fdata[i, fpos[c]] = v
+            cts = np.array([ts for ts, _ in chip_pts], dtype=np.float64)
+            cdata = (
+                np.stack([m for _, m in chip_pts])
+                if chip_pts
+                else np.zeros((0, 0, 0), dtype=np.float32)
+            )
+            meta = _json.dumps(
+                {"fleet_cols": fcols, "chip_keys": keys, "chip_cols": cols}
+            )
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(os.path.abspath(path)) or ".",
+                suffix=".npz.tmp",
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez_compressed(
+                        f,
+                        meta=np.array(meta),
+                        fleet_ts=fts,
+                        fleet_data=fdata,
+                        chip_ts=cts,
+                        chip_data=cdata,
+                    )
+                os.replace(tmp, path)
+            except BaseException:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp)
+                raise
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            log.warning("history save failed: %s", e)
+
+    def _sweep_history_tmp(self) -> None:
+        """Remove orphaned ``tmp*.npz.tmp`` siblings of history_path — a
+        daemon save thread killed mid-write (process exit) never reaches
+        its own unlink, so startup sweeps what shutdown couldn't."""
+        import glob
+        import os
+
+        d = os.path.dirname(os.path.abspath(self.cfg.history_path)) or "."
+        for tmp in glob.glob(os.path.join(d, "tmp*.npz.tmp")):
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+
+    def _load_history(self) -> None:
+        """Restore the trend rings from ``cfg.history_path``.  Points
+        older than twice the ring's time span are dropped (a snapshot
+        from last week must not render as if it were the last hour);
+        any malformed file degrades to empty rings, never a crash."""
+        import json as _json
+        import os
+
+        path = self.cfg.history_path
+        if not os.path.exists(path):
+            return
+        max_age = (
+            (self.history.maxlen or 720)
+            * max(self.cfg.refresh_interval, 1.0)
+            * 2
+        )
+        now = time.time()
+        cutoff = now - max_age
+        # future-timestamped points (snapshot written under a clock that
+        # then stepped backward) are dropped too: the refresh-cadence gate
+        # compares against the ring's LAST timestamp, so one future point
+        # would freeze all new history collection until wall time catches
+        # up
+        horizon = now + max(self.cfg.refresh_interval, 1.0)
+        try:
+            with np.load(path) as z:
+                meta = _json.loads(str(z["meta"]))
+                fleet_ts = z["fleet_ts"]
+                fleet_data = z["fleet_data"]
+                chip_ts = z["chip_ts"]
+                chip_data = z["chip_data"]
+            fcols = list(meta["fleet_cols"])
+            keys = [str(k) for k in meta["chip_keys"]]
+            cols = [str(c) for c in meta["chip_cols"]]
+            n = 0
+            for ts, row in zip(fleet_ts.tolist(), fleet_data):
+                if ts < cutoff or ts > horizon:
+                    continue
+                avgs = {
+                    c: float(v) for c, v in zip(fcols, row.tolist()) if v == v
+                }
+                if avgs:
+                    self.history.append((float(ts), avgs))
+                    n += 1
+            if (
+                keys
+                and cols
+                and chip_data.ndim == 3
+                and chip_data.shape[1:] == (len(keys), len(cols))
+            ):
+                self._chip_hist_keys = keys
+                self._chip_hist_cols = cols
+                self._chip_hist_rowmap = {k: i for i, k in enumerate(keys)}
+                for ts, m in zip(chip_ts.tolist(), chip_data):
+                    if cutoff <= ts <= horizon:
+                        self.chip_history.append(
+                            (float(ts), m.astype(np.float32, copy=False))
+                        )
+            if n or self.chip_history:
+                log.info(
+                    "restored %d fleet / %d per-chip trend points from %s",
+                    n,
+                    len(self.chip_history),
+                    path,
+                )
+        except Exception as e:  # noqa: BLE001 — restore is best-effort
+            log.warning("history restore failed (%s): %s", path, e)
+            self.history.clear()
+            self.chip_history.clear()
+            self._chip_hist_keys = []
+            self._chip_hist_cols = []
+            self._chip_hist_rowmap = {}
 
     def source_health(self) -> "dict | None":
         """Health summary from the ResilientSource wrapper (None when
@@ -999,6 +1167,14 @@ class DashboardService:
                         k: i for i, k in enumerate(keys)
                     }
                 self.chip_history.append((now, arr.astype(np.float32)))
+        # periodic trend persistence, OFF the frame path (compression of
+        # a full 256-chip ring takes ~100 ms)
+        if (
+            self.cfg.history_path
+            and now - self._last_history_save >= self.cfg.history_save_interval
+        ):
+            self._last_history_save = now
+            threading.Thread(target=self.save_history, daemon=True).start()
         return df
 
     def compose_frame(self, state: "SelectionState | None" = None) -> dict:

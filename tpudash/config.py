@@ -106,6 +106,14 @@ class Config:
     #: ``fetch_history`` participate).  Sparklines show a real trend on the
     #: first frame instead of growing from empty.
     history_backfill: float = 0.0
+    #: Persist the trend-history rings (fleet sparklines + per-chip
+    #: drill-down) to this file so restarts don't lose trends for sources
+    #: without a range query (probe/scrape/exporter-direct).  "" disables.
+    #: Saved periodically (history_save_interval) and at shutdown;
+    #: restored at startup unless a Prometheus backfill already seeded
+    #: the rings.
+    history_path: str = ""
+    history_save_interval: float = 300.0
     #: source="workload": checkpoint/resume for the background train loop
     #: (models/checkpoint.py) — save every N steps into this directory and
     #: resume from its latest step on restart.  "" disables.
@@ -173,6 +181,8 @@ _ENV_MAP = {
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",
+    "history_path": "TPUDASH_HISTORY_PATH",
+    "history_save_interval": "TPUDASH_HISTORY_SAVE_INTERVAL",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",
