@@ -108,9 +108,55 @@ def test_future_timestamps_dropped_on_restore(tmp_path):
 
 
 def test_startup_sweeps_orphaned_tmp_files(tmp_path):
-    (tmp_path / "tmpabc123.npz.tmp").write_bytes(b"orphan")
+    (tmp_path / "trends.npz.abc123.tmp").write_bytes(b"orphan")
     _svc(tmp_path)
-    assert not (tmp_path / "tmpabc123.npz.tmp").exists()
+    assert not (tmp_path / "trends.npz.abc123.tmp").exists()
+
+
+def test_sweep_cleans_stale_legacy_tmp_but_spares_fresh(tmp_path):
+    """Transitional: orphans named by the pre-scoping release
+    (tmp*.npz.tmp) are swept once stale; a fresh one (possibly an
+    old-release sibling's in-flight save) survives."""
+    import os
+
+    stale = tmp_path / "tmpold1.npz.tmp"
+    stale.write_bytes(b"orphan from previous release")
+    old = stale.stat().st_mtime - 3600
+    os.utime(stale, (old, old))
+    fresh = tmp_path / "tmpnew2.npz.tmp"
+    fresh.write_bytes(b"in-flight old-release save")
+    _svc(tmp_path)
+    assert not stale.exists()
+    assert fresh.exists()
+
+
+def test_sweep_spares_other_instances_tmp_files(tmp_path):
+    """Two instances sharing a directory with distinct history files must
+    not delete each other's in-flight mkstemp writes (ADVICE r3)."""
+    other = tmp_path / "other.npz.xyz789.tmp"
+    other.write_bytes(b"in-flight save of a sibling instance")
+    _svc(tmp_path)  # history file is trends.npz
+    assert other.exists()
+
+
+def test_save_tmp_name_is_scoped_to_history_file(tmp_path, monkeypatch):
+    """The mkstemp name carries the target basename so the sweep pattern
+    can be scoped (and a crash mid-save leaves a sweepable orphan)."""
+    import tempfile
+
+    seen = {}
+    real = tempfile.mkstemp
+
+    def spy(**kw):
+        seen.update(kw)
+        return real(**kw)
+
+    monkeypatch.setattr(tempfile, "mkstemp", spy)
+    a = _svc(tmp_path)
+    a.render_frame()
+    a.save_history()
+    assert seen["prefix"] == "trends.npz."
+    assert seen["suffix"] == ".tmp"
 
 
 def test_corrupt_file_degrades_to_empty(tmp_path):

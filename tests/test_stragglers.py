@@ -203,6 +203,83 @@ def test_departed_chip_resolves_implicitly():
     assert not det._tracks
 
 
+def test_skipped_metric_freezes_streaks_not_resolves(monkeypatch):
+    """A cycle where the metric is not evaluated (partial scrape dropped
+    the column, or population fell under min_chips) must neither advance
+    nor reset existing streaks (ADVICE r3): one degraded scrape cannot
+    silently resolve a genuinely firing straggler."""
+    det = _detector("tpu_tensorcore_utilization@3")
+    bad = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0])
+    det.evaluate(bad)
+    det.evaluate(bad)  # streak = 2, pending
+
+    # cycle 3a: column missing entirely (partial scrape)
+    missing = _df("some_other_metric", [1.0] * 16)
+    assert det.evaluate(missing) == []
+    assert len(det._tracks) == 1  # frozen, not dropped
+
+    # cycle 3b: population under min_chips
+    tiny = _df(schema.TENSORCORE_UTIL, [95.0] * 3 + [60.0])
+    assert det.evaluate(tiny) == []
+    assert len(det._tracks) == 1
+
+    # next evaluated breaching cycle CONTINUES the streak → firing now
+    out = det.evaluate(bad)
+    assert [s["state"] for s in out] == ["firing"]
+    assert out[0]["streak"] == 3
+
+
+def test_nan_chip_on_evaluated_metric_freezes_its_streak():
+    """Column present and scored, but one tracked chip reports NaN: that
+    chip has no data this cycle (same partial-scrape class as a missing
+    column), so its streak freezes rather than resolving."""
+    det = _detector("tpu_tensorcore_utilization@3")
+    bad = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0])
+    det.evaluate(bad)
+    det.evaluate(bad)  # streak = 2
+    nan_for_chip = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [np.nan])
+    assert det.evaluate(nan_for_chip) == []
+    assert len(det._tracks) == 1  # frozen
+    out = det.evaluate(bad)  # streak continues → firing
+    assert [s["state"] for s in out] == ["firing"]
+
+
+def test_zero_excluded_chip_resolves_as_parked():
+    """0 W on a zero-excluded metric is data ("parked"), not missing data:
+    the track resolves."""
+    det = _detector(f"{schema.POWER}:both@2")
+    vals = [148.0, 152.0, 149.0, 151.0, 150.0, 148.5, 151.5, 150.5] * 2
+    bad = _df(schema.POWER, vals[:-1] + [80.0])
+    det.evaluate(bad)
+    assert det._tracks
+    parked = _df(schema.POWER, vals[:-1] + [0.0])
+    det.evaluate(parked)
+    assert not det._tracks
+
+
+def test_bimodal_skip_freezes_streaks():
+    """The max_fraction (bimodality) guard is a skip, not an all-clear."""
+    det = _detector("tpu_tensorcore_utilization@2", max_fraction=0.1)
+    bad = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0])
+    det.evaluate(bad)  # streak = 1
+    # 3/16 chips breach, over the 10% ceiling → metric skipped this cycle
+    bimodal = _df(schema.TENSORCORE_UTIL, [95.0] * 13 + [60.0] * 3)
+    assert det.evaluate(bimodal) == []
+    assert len(det._tracks) == 1
+    out = det.evaluate(bad)  # streak continues to 2 → firing
+    assert [s["state"] for s in out] == ["firing"]
+
+
+def test_clear_cycle_still_resolves_after_skip_fix():
+    """count == 0 is a genuine evaluation: tracks resolve as before."""
+    det = _detector("tpu_tensorcore_utilization@2")
+    bad = _df(schema.TENSORCORE_UTIL, [95.0] * 15 + [60.0])
+    good = _df(schema.TENSORCORE_UTIL, [95.0] * 16)
+    det.evaluate(bad)
+    det.evaluate(good)
+    assert not det._tracks
+
+
 def test_firing_sorts_before_pending_and_by_severity_of_z():
     df = _df(
         schema.TENSORCORE_UTIL,

@@ -447,9 +447,13 @@ class DashboardService:
             meta = _json.dumps(
                 {"fleet_cols": fcols, "chip_keys": keys, "chip_cols": cols}
             )
+            # temp name scoped to the target file so concurrent tpudash
+            # instances sharing a directory (distinct history files) can
+            # never sweep each other's in-flight save
             fd, tmp = tempfile.mkstemp(
                 dir=os.path.dirname(os.path.abspath(path)) or ".",
-                suffix=".npz.tmp",
+                prefix=os.path.basename(path) + ".",
+                suffix=".tmp",
             )
             try:
                 with os.fdopen(fd, "wb") as f:
@@ -470,16 +474,30 @@ class DashboardService:
             log.warning("history save failed: %s", e)
 
     def _sweep_history_tmp(self) -> None:
-        """Remove orphaned ``tmp*.npz.tmp`` siblings of history_path — a
-        daemon save thread killed mid-write (process exit) never reaches
-        its own unlink, so startup sweeps what shutdown couldn't."""
+        """Remove orphaned ``<history-file>.*.tmp`` siblings of
+        history_path — a daemon save thread killed mid-write (process
+        exit) never reaches its own unlink, so startup sweeps what
+        shutdown couldn't.  The pattern is scoped to THIS instance's
+        history file: two instances sharing a directory with distinct
+        history files must not delete each other's in-flight saves."""
         import glob
         import os
 
-        d = os.path.dirname(os.path.abspath(self.cfg.history_path)) or "."
-        for tmp in glob.glob(os.path.join(d, "tmp*.npz.tmp")):
+        full = os.path.abspath(self.cfg.history_path)
+        d = os.path.dirname(full) or "."
+        base = glob.escape(os.path.basename(full))
+        for tmp in glob.glob(os.path.join(glob.escape(d), base + ".*.tmp")):
             with contextlib.suppress(OSError):
                 os.unlink(tmp)
+        # transitional: pre-scoping releases named temps ``tmp*.npz.tmp``;
+        # sweep those too, but only when stale (an old-release sibling
+        # instance's IN-FLIGHT save is seconds old and must survive)
+        import time as _time
+
+        for tmp in glob.glob(os.path.join(glob.escape(d), "tmp*.npz.tmp")):
+            with contextlib.suppress(OSError):
+                if _time.time() - os.path.getmtime(tmp) > 600.0:
+                    os.unlink(tmp)
 
     def _load_history(self) -> None:
         """Restore the trend rings from ``cfg.history_path``.  Points

@@ -129,7 +129,9 @@ class StragglerDetector:
     (state machine in tpudash.hysteresis, shared with AlertEngine): ok →
     pending (breaching, streak < for_cycles) → firing; any non-breaching
     frame resets to ok, and chips that leave the table resolve
-    implicitly."""
+    implicitly.  Exception: a metric skipped for a cycle (partial scrape,
+    min_chips, bimodality ceiling) freezes its streaks instead of
+    resolving them — "not evaluated" is not "recovered"."""
 
     rules: list[StragglerRule]
     #: modified-z threshold — 3.5 is the classic Iglewicz–Hoaglin cutoff
@@ -175,6 +177,18 @@ class StragglerDetector:
         col_pos = {c: i for i, c in enumerate(cols)}
         keys = None  # materialized lazily: breaches are the rare case
         seen = set()
+        # Metrics NOT evaluated this cycle (column absent after a partial
+        # scrape, population under min_chips, or bimodality ceiling hit).
+        # Their existing streaks are frozen, not resolved: one degraded
+        # scrape must not silently clear a genuinely firing straggler and
+        # force it to re-earn for_cycles from zero.
+        skipped: set[str] = set()
+        #: column -> isnan mask for metrics that WERE evaluated: a tracked
+        #: chip whose cell is NaN this cycle (chip row present, no data —
+        #: same partial-scrape class as a missing column) is frozen too,
+        #: not resolved.  Zero-excluded cells are NOT frozen: 0 W is data
+        #: ("parked"), and a parked chip has genuinely stopped straggling.
+        nan_masks: dict[str, np.ndarray] = {}
         out = []
         for rule in self.rules:
             ci = col_pos.get(rule.column)
@@ -188,14 +202,18 @@ class StragglerDetector:
                     df[rule.column], errors="coerce"
                 ).to_numpy(dtype=float, na_value=np.nan)
             else:
+                skipped.add(rule.column)
                 continue
-            eligible = ~np.isnan(values)
+            isnan = np.isnan(values)
+            nan_masks[rule.column] = isnan
+            eligible = ~isnan
             # zero-exclusion parity (app.py:341-345): a parked chip at 0 W
             # is idle, not a straggler, and must not drag the median
             if rule.column in schema.ZERO_EXCLUDED_METRICS:
                 eligible &= values != 0.0
             n = int(eligible.sum())
             if n < self.min_chips:
+                skipped.add(rule.column)
                 continue
             x = values[eligible]
             med = float(np.median(x))
@@ -209,7 +227,11 @@ class StragglerDetector:
             else:
                 breach = np.abs(z) >= self.zscore
             count = int(np.count_nonzero(breach))
-            if count == 0 or count > max(1, int(self.max_fraction * n)):
+            if count == 0:
+                # genuinely evaluated and clear — tracks may resolve
+                continue
+            if count > max(1, int(self.max_fraction * n)):
+                skipped.add(rule.column)
                 continue
             if keys is None:
                 keys = np.asarray(df.index, dtype=object)
@@ -232,7 +254,29 @@ class StragglerDetector:
                         "streak": track.streak,
                     }
                 )
-        # implicit resolution for (column, chip) pairs not seen this frame
+        # implicit resolution for (column, chip) pairs not seen this frame;
+        # pairs under a skipped metric are frozen (counted as seen) so a
+        # degraded cycle neither advances nor resets their streak
+        if skipped:
+            seen.update(k for k, _ in self._tracks.items() if k[0] in skipped)
+        # per-chip freeze: tracked chip present but NaN on an evaluated
+        # metric — no data for that one chip, so its streak holds too
+        if len(self._tracks):
+            pos = None
+            for key, _ in self._tracks.items():
+                col, chip = key
+                if key in seen:
+                    continue
+                mask = nan_masks.get(col)
+                if mask is None:
+                    continue
+                if pos is None:
+                    if keys is None:
+                        keys = np.asarray(df.index, dtype=object)
+                    pos = {str(k): i for i, k in enumerate(keys)}
+                i = pos.get(chip)
+                if i is not None and mask[i]:
+                    seen.add(key)
         self._tracks.resolve_unseen(seen)
         out.sort(key=lambda s: (s["state"] != "firing", -abs(s["z"]), s["chip"]))
         return out
