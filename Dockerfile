@@ -1,0 +1,57 @@
+# tpudash container image — the image deploy/dashboard.yaml and
+# deploy/exporter-daemonset.yaml deploy (`tpudash:latest`).
+#
+# Reproducible by construction: every Python package installs from the
+# committed requirements.lock (exact pins, see deploy/make_lock.py) with
+# resolution disabled (--no-deps everywhere), so two builds of the same
+# tree produce the same dependency set — the property the reference gets
+# from its uv.lock.  The native C++ frame kernel is compiled INTO the
+# image at build time; at runtime there is no compiler, no PyPI access,
+# and no root.
+#
+#   docker build -t tpudash:latest .
+#   docker run --rm -p 8050:8050 -e TPUDASH_SOURCE=synthetic tpudash:latest
+#
+# Notes:
+# - The lock pins CPU jaxlib: fixture/synthetic/prometheus/scrape sources
+#   and the exporter all work as-is.  For the on-chip probe source on a
+#   real TPU node pool, layer libtpu on top (the TPU node image provides
+#   it; see deploy/README.md).
+# - Healthcheck uses the stdlib, not curl — the runtime stage installs no
+#   extra OS packages at all.
+
+FROM python:3.12-slim AS build
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+# dependency layer first: lockfile changes invalidate from here, source
+# changes don't re-download 45 packages
+COPY requirements.lock ./
+RUN python -m venv /opt/venv \
+    && /opt/venv/bin/pip install --no-cache-dir --no-deps -r requirements.lock
+COPY pyproject.toml README.md ./
+COPY tpudash ./tpudash
+RUN /opt/venv/bin/pip install --no-cache-dir --no-deps . \
+    # compile the native frame kernel into the installed package now so
+    # the runtime stage needs no g++ (loader would otherwise build on
+    # first use, tpudash/native/__init__.py)
+    && /opt/venv/bin/python - <<'EOF'
+from tpudash import native
+lib = native.load()
+assert lib is not None, "native frame kernel failed to compile"
+print("native kernel built:", native.is_available())
+EOF
+
+FROM python:3.12-slim
+COPY --from=build /opt/venv /opt/venv
+ENV PATH="/opt/venv/bin:$PATH" \
+    PYTHONUNBUFFERED=1
+# non-root, no shell profile, no home-directory writes needed
+RUN useradd --uid 10001 --create-home --shell /usr/sbin/nologin tpudash
+USER 10001
+WORKDIR /home/tpudash
+EXPOSE 8050
+HEALTHCHECK --interval=30s --timeout=5s --start-period=20s --retries=3 \
+    CMD ["python", "-c", "import os, urllib.request; urllib.request.urlopen('http://127.0.0.1:%s/healthz' % os.environ.get('TPUDASH_PORT', '8050'), timeout=4)"]
+ENTRYPOINT ["tpudash"]

@@ -74,6 +74,114 @@ def test_manifests_parse_and_reference_real_ports():
     assert str(cfg.port) in text
 
 
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _lock_pins() -> dict:
+    pins = {}
+    with open(os.path.join(REPO, "requirements.lock")) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            name, version = line.split("==")
+            pins[name.strip()] = version.strip()
+    return pins
+
+
+def test_lockfile_pins_all_project_dependencies():
+    """Every [project.dependencies] entry and every probe/checkpoint/test
+    extra must have an exact pin — a dependency added to pyproject without
+    regenerating the lock fails here, not at deploy time."""
+    import re
+    import tomllib
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        project = tomllib.load(f)["project"]
+    specs = list(project["dependencies"])
+    for extra in project.get("optional-dependencies", {}).values():
+        specs.extend(extra)
+    assert specs, "no dependencies parsed from pyproject.toml"
+    pins = _lock_pins()
+    for spec in specs:
+        name = re.match(r"[A-Za-z0-9][A-Za-z0-9._-]*", spec).group(0)
+        canon = re.sub(r"[-_.]+", "-", name).lower()
+        assert canon in pins, f"{name} missing from requirements.lock"
+
+
+def test_lockfile_matches_installed_environment():
+    """Pins are exact and current: any installed distribution named in
+    the lock must be at exactly the pinned version (regenerate with
+    deploy/make_lock.py after an environment upgrade)."""
+    from importlib import metadata
+
+    pins = _lock_pins()
+    assert len(pins) >= 20, "suspiciously small closure"
+    checked = 0
+    for name, version in pins.items():
+        try:
+            installed = metadata.version(name)
+        except metadata.PackageNotFoundError:
+            continue  # lock may pin more than a minimal env installs
+        assert installed == version, (
+            f"{name}: lock pins {version} but {installed} is installed — "
+            "regenerate with: python deploy/make_lock.py"
+        )
+        checked += 1
+    assert checked >= 10, "lock shares almost nothing with this environment"
+
+
+def test_make_lock_regenerates_identically(tmp_path):
+    """The committed lock is exactly what the generator emits for this
+    environment (no hand edits, no drift)."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "requirements.lock"
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "deploy", "make_lock.py"), "-o", str(out)],
+        check=True,
+        capture_output=True,
+    )
+    with open(os.path.join(REPO, "requirements.lock")) as f:
+        committed = f.read()
+    assert out.read_text() == committed
+
+
+def _dockerfile() -> str:
+    with open(os.path.join(REPO, "Dockerfile")) as f:
+        return f.read()
+
+
+def test_dockerfile_builds_the_deployed_image():
+    """deploy/dashboard.yaml deploys `tpudash:latest`; the Dockerfile must
+    actually produce it: install from the lock with resolution disabled,
+    compile the native kernel at build time, drop root, healthcheck, and
+    expose the configured port."""
+    from tpudash.config import Config
+
+    df = _dockerfile()
+    assert "requirements.lock" in df
+    assert "--no-deps" in df, "image must not re-resolve outside the lock"
+    assert "native" in df and "g++" in df
+    assert "USER 10001" in df, "runtime must not be root"
+    assert "HEALTHCHECK" in df and "/healthz" in df
+    assert f"EXPOSE {Config().port}" in df
+    # runtime stage has no compiler: g++ only appears before the second FROM
+    runtime = df.split("\nFROM ", 2)[2]
+    assert "g++" not in runtime
+    # entrypoint is the console script pyproject declares
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        assert 'tpudash = "tpudash.app.server:run"' in f.read()
+    assert 'ENTRYPOINT ["tpudash"]' in df
+
+
+def test_ci_installs_from_lockfile():
+    with open(os.path.join(REPO, ".github", "workflows", "ci.yml")) as f:
+        ci = f.read()
+    assert "requirements.lock" in ci, "CI must install the pinned resolution"
+
+
 def test_fleet_report_example_runs_against_a_live_server():
     # the example script is a real API consumer: run it against an
     # in-process server (requests is patched onto the aiohttp test client)
