@@ -128,24 +128,38 @@ def bench_multislice() -> dict:
 
 _PROBE_SNIPPET = """
 import json
+import statistics
 try:
     from tpudash.ops.probes import (
         device_info, hbm_bandwidth_probe, hbm_copy_probe, matmul_flops_probe,
     )
+    from tpudash.registry import resolve_generation_from_device_kind
     info = device_info()
     if info["platform"] not in ("tpu",):
         print(json.dumps({"platform": info["platform"]}))
     else:
-        mm = matmul_flops_probe(size=4096, iters=32)
-        hbm = hbm_bandwidth_probe(mb=256, k1=10, k2=210)
-        cp = hbm_copy_probe(mb=256, k1=5, k2=105)
-        print(json.dumps({
+        # median-of-3 per probe: single-shot numbers drifted ~2% round to
+        # round with no way to tell signal from tunneled-dispatch jitter
+        med = lambda fn: statistics.median(fn().value for _ in range(3))
+        mm = med(lambda: matmul_flops_probe(size=4096, iters=32))
+        hbm = med(lambda: hbm_bandwidth_probe(mb=256, k1=10, k2=210))
+        cp = med(lambda: hbm_copy_probe(mb=256, k1=5, k2=105))
+        out = {
             "platform": info["platform"],
             "device_kind": info["device_kind"],
-            "matmul_bf16_tflops": round(mm.value, 2),
-            "hbm_stream_gbps": round(hbm.value, 1),
-            "hbm_copy_gbps": round(cp.value, 1),
-        }))
+            "probe_repeats": 3,
+            "matmul_bf16_tflops": round(mm, 2),
+            "hbm_stream_gbps": round(hbm, 1),
+            "hbm_copy_gbps": round(cp, 1),
+        }
+        gen = resolve_generation_from_device_kind(info["device_kind"])
+        if gen is not None:
+            # achieved fraction of the datasheet ceilings the dashboard
+            # itself gauges against (registry.py) — the honest MFU number
+            out["generation"] = gen.name
+            out["matmul_mfu_pct"] = round(100.0 * mm / gen.peak_bf16_tflops, 1)
+            out["hbm_stream_pct_of_peak"] = round(100.0 * hbm / gen.hbm_gbps, 1)
+        print(json.dumps(out))
 except Exception as e:
     print(json.dumps({"probe_error": str(e)}))
 """
@@ -182,6 +196,55 @@ def bench_probes(timeout_s: float = 300.0) -> dict:
         return {"probe_error": str(e)}
 
 
+def find_regressions(
+    result: dict, bench_dir: "str | None" = None
+) -> "tuple[str | None, list[dict]]":
+    """Compare this run against the newest committed ``BENCH_r*.json``.
+
+    A dashboard whose whole purpose is catching silent per-chip
+    degradation should not itself ship silent degradation: probe numbers
+    dropping >5% or the headline p50 inflating >20% vs the previous round
+    are reported in a ``regressions`` field (the driver wraps its record
+    in {"parsed": ...}; bare JSON is accepted too)."""
+    import glob
+    import os
+
+    here = bench_dir or os.path.dirname(os.path.abspath(__file__))
+    files = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not files:
+        return None, []
+    try:
+        with open(files[-1]) as f:
+            prev = json.load(f)
+        prev = prev.get("parsed", prev)
+    except (OSError, ValueError):
+        return os.path.basename(files[-1]), []
+    out = []
+
+    def check(name, now, before, worse_when="lower", tol=0.05):
+        if not isinstance(now, (int, float)) or not isinstance(before, (int, float)):
+            return
+        if before <= 0:
+            return
+        change = (now - before) / before
+        bad = change < -tol if worse_when == "lower" else change > tol
+        if bad:
+            out.append(
+                {
+                    "metric": name,
+                    "prev": before,
+                    "now": now,
+                    "change_pct": round(100.0 * change, 1),
+                }
+            )
+
+    p_now, p_prev = result.get("probes", {}), prev.get("probes", {})
+    for key in ("matmul_bf16_tflops", "hbm_stream_gbps", "hbm_copy_gbps"):
+        check(key, p_now.get(key), p_prev.get(key), "lower", 0.05)
+    check("value", result.get("value"), prev.get("value"), "higher", 0.20)
+    return os.path.basename(files[-1]), out
+
+
 def main() -> None:
     t0 = time.time()
     dash = bench_dashboard()
@@ -206,6 +269,11 @@ def main() -> None:
         "probes": probes,
         "bench_wall_s": round(time.time() - t0, 1),
     }
+    vs_file, regressions = find_regressions(result)
+    if vs_file is not None:
+        result["vs_prev"] = vs_file
+        result["regressions"] = regressions
+    result["bench_wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(result))
 
 

@@ -1,0 +1,88 @@
+"""bench.py regression guard: the bench compares itself against the
+previous round's recorded numbers and reports drops, so a silent probe
+or frame-latency degradation cannot ship unnoticed (VERDICT r3 weak #2).
+"""
+
+import json
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from bench import find_regressions  # noqa: E402
+
+
+def _write_prev(tmp_path, name="BENCH_r07.json", wrap=True, **parsed):
+    record = {"parsed": parsed} if wrap else parsed
+    (tmp_path / name).write_text(json.dumps(record))
+
+
+def _result(value=6.0, mm=190.0, hbm=730.0, cp=350.0):
+    return {
+        "value": value,
+        "probes": {
+            "matmul_bf16_tflops": mm,
+            "hbm_stream_gbps": hbm,
+            "hbm_copy_gbps": cp,
+        },
+    }
+
+
+def test_no_bench_files_is_quiet(tmp_path):
+    vs, regs = find_regressions(_result(), bench_dir=str(tmp_path))
+    assert vs is None and regs == []
+
+
+def test_within_tolerance_is_clean(tmp_path):
+    _write_prev(tmp_path, value=6.1, probes=_result()["probes"])
+    vs, regs = find_regressions(_result(), bench_dir=str(tmp_path))
+    assert vs == "BENCH_r07.json"
+    assert regs == []
+
+
+def test_probe_drop_over_5pct_flags(tmp_path):
+    _write_prev(
+        tmp_path,
+        value=6.0,
+        probes={"matmul_bf16_tflops": 192.7, "hbm_stream_gbps": 735.0},
+    )
+    vs, regs = find_regressions(
+        _result(mm=180.0, hbm=733.0), bench_dir=str(tmp_path)
+    )
+    assert [r["metric"] for r in regs] == ["matmul_bf16_tflops"]
+    assert regs[0]["prev"] == 192.7 and regs[0]["now"] == 180.0
+    assert regs[0]["change_pct"] < -5.0
+
+
+def test_headline_p50_inflation_over_20pct_flags(tmp_path):
+    _write_prev(tmp_path, value=5.86, probes={})
+    _, regs = find_regressions(_result(value=7.5), bench_dir=str(tmp_path))
+    assert [r["metric"] for r in regs] == ["value"]
+    assert regs[0]["change_pct"] > 20.0
+
+
+def test_newest_round_file_wins(tmp_path):
+    _write_prev(tmp_path, name="BENCH_r01.json", value=100.0, probes={})
+    _write_prev(tmp_path, name="BENCH_r03.json", value=6.0, probes={})
+    vs, regs = find_regressions(_result(value=6.0), bench_dir=str(tmp_path))
+    assert vs == "BENCH_r03.json"
+    assert regs == []
+
+
+def test_bare_json_without_parsed_wrapper(tmp_path):
+    _write_prev(tmp_path, wrap=False, value=6.0, probes=_result()["probes"])
+    vs, regs = find_regressions(_result(), bench_dir=str(tmp_path))
+    assert vs == "BENCH_r07.json" and regs == []
+
+
+def test_corrupt_prev_file_degrades_quietly(tmp_path):
+    (tmp_path / "BENCH_r05.json").write_text("{not json")
+    vs, regs = find_regressions(_result(), bench_dir=str(tmp_path))
+    assert vs == "BENCH_r05.json" and regs == []
+
+
+def test_missing_probe_sections_ignored(tmp_path):
+    # previous round ran on CPU (probe_error only): nothing to compare
+    _write_prev(tmp_path, value=6.0, probes={"probe_error": "cpu"})
+    _, regs = find_regressions(_result(), bench_dir=str(tmp_path))
+    assert regs == []
