@@ -126,6 +126,78 @@ def bench_multislice() -> dict:
     return {"p50_s": svc.timer.percentile(0.5)}
 
 
+def _rss_mb() -> float:
+    """Resident set of this process in MB (Linux /proc, no psutil).
+    Collects first so allocator slack doesn't read as growth."""
+    import gc
+
+    gc.collect()
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return round(int(line.split()[1]) / 1024.0, 1)
+    return 0.0
+
+
+def bench_scale(
+    total_chips: int, frames: int = N_FRAMES, ring: int = 30
+) -> dict:
+    """Headroom PAST the 256-chip north star: p50, steady-state SSE delta
+    bytes, and the memory ceiling at ``total_chips`` (4×256-chip slices,
+    then 16×256) — the scaling wall the reference hits at 256 chips
+    (SURVEY §3.2: per-device figures) must stay distant here.
+
+    The boundedness proof is measured, not asserted: the trend rings are
+    shortened to ``ring`` points (cfg.history_points), rendered to
+    COMPLETELY full, and only then is RSS sampled — every further frame
+    evicts as much as it appends, so ``rss_growth_mb`` over the timed run
+    must be ~0.  Growth here means a ring, session map, or cache is not
+    actually bounded at this scale."""
+    from tpudash.app.delta import frame_delta
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    slices = max(1, total_chips // N_CHIPS)
+    per_slice = total_chips // slices
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=per_slice,
+        synthetic_slices=slices,
+        history_points=ring,
+        # history appends are wall-clock-throttled to the refresh cadence;
+        # 0 makes every bench frame append so the ring provably cycles
+        refresh_interval=0.0,
+    )
+    svc = DashboardService(
+        cfg,
+        JsonReplaySource.synthetic(
+            per_slice, generation="v5e", frames=8, num_slices=slices
+        ),
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = None
+    for _ in range(ring + 2):  # fill both rings to their ceiling
+        frame = svc.render_frame()
+    assert len(svc.chip_history) == ring, "ring must be full before sampling"
+    svc.timer.history.clear()
+    rss_full = _rss_mb()
+    for _ in range(frames):
+        prev = frame
+        frame = svc.render_frame()
+        assert frame["error"] is None
+        assert len(frame["selected"]) == total_chips
+    delta = frame_delta(prev, frame)
+    assert delta is not None
+    return {
+        "p50_s": svc.timer.percentile(0.5),
+        "sse_delta_bytes": len(f"data: {json.dumps(delta)}\n\n".encode()),
+        "rss_mb": _rss_mb(),
+        "rss_growth_mb": round(_rss_mb() - rss_full, 1),
+    }
+
+
 _PROBE_SNIPPET = """
 import json
 import statistics
@@ -250,6 +322,8 @@ def main() -> None:
     dash = bench_dashboard()
     multi = bench_multislice()
     torus3d = bench_3d_torus()
+    scale1k = bench_scale(1024)
+    scale4k = bench_scale(4096)
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -266,6 +340,13 @@ def main() -> None:
         "multislice_2x256_p50_ms": round(multi["p50_s"] * 1e3, 2),
         "torus3d_v4_4x4x8_p50_ms": round(torus3d["p50_s"] * 1e3, 2),
         "torus3d_grid": torus3d["grid"],
+        "scale_1024_p50_ms": round(scale1k["p50_s"] * 1e3, 2),
+        "scale_1024_sse_delta_bytes": scale1k["sse_delta_bytes"],
+        "scale_1024_rss_mb": scale1k["rss_mb"],
+        "scale_4096_p50_ms": round(scale4k["p50_s"] * 1e3, 2),
+        "scale_4096_sse_delta_bytes": scale4k["sse_delta_bytes"],
+        "scale_4096_rss_mb": scale4k["rss_mb"],
+        "scale_4096_rss_growth_mb": scale4k["rss_growth_mb"],
         "probes": probes,
         "bench_wall_s": round(time.time() - t0, 1),
     }

@@ -136,14 +136,15 @@ class DashboardService:
             log.info("restored UI state from %s", cfg.state_path)
         #: rolling (wall_ts, {column: fleet-average}) per successful
         #: frame — trend history the reference never kept.  At the default
-        #: 5 s cadence, 720 points ≈ one hour.
-        self.history: deque = deque(maxlen=720)
+        #: 5 s cadence, the default 720 points ≈ one hour.
+        self.history: deque = deque(maxlen=max(2, cfg.history_points))
         #: per-CHIP rolling history for the drill-down view: (wall_ts,
         #: float32 matrix) aligned to _chip_hist_keys rows and
-        #: _chip_hist_cols columns.  720 × 256 chips × ~10 metrics ≈ 7 MB.
-        #: The ring resets when the chip population or metric set changes
-        #: (slice resize, new exporter) — alignment beats splicing.
-        self.chip_history: deque = deque(maxlen=720)
+        #: _chip_hist_cols columns.  720 × 256 chips × ~10 metrics ≈ 7 MB
+        #: (cfg.history_points scales it for larger fleets).  The ring
+        #: resets when the chip population or metric set changes (slice
+        #: resize, new exporter) — alignment beats splicing.
+        self.chip_history: deque = deque(maxlen=max(2, cfg.history_points))
         self._chip_hist_keys: list = []
         self._chip_hist_cols: list = []
         self._chip_hist_rowmap: dict = {}

@@ -106,6 +106,11 @@ class Config:
     #: ``fetch_history`` participate).  Sparklines show a real trend on the
     #: first frame instead of growing from empty.
     history_backfill: float = 0.0
+    #: Trend-ring length in points (fleet sparklines AND the per-chip
+    #: drill-down ring).  720 at the 5 s cadence ≈ one hour; the per-chip
+    #: ring costs points × chips × ~10 metrics × 4 bytes (≈7 MB at 256
+    #: chips, ≈118 MB at 4096) so large fleets may want it shorter.
+    history_points: int = 720
     #: Persist the trend-history rings (fleet sparklines + per-chip
     #: drill-down) to this file so restarts don't lose trends for sources
     #: without a range query (probe/scrape/exporter-direct).  "" disables.
@@ -181,6 +186,7 @@ _ENV_MAP = {
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",
+    "history_points": "TPUDASH_HISTORY_POINTS",
     "history_path": "TPUDASH_HISTORY_PATH",
     "history_save_interval": "TPUDASH_HISTORY_SAVE_INTERVAL",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
