@@ -1,0 +1,268 @@
+"""Direction-resolved per-link ICI detail, end to end (VERDICT r3 #5).
+
+Aggregate tx/rx says "this chip's ICI is slow"; lockstep debugging needs
+"this chip's x− cable is cold".  These tests pin the whole path: schema
+constants → synthetic/probe emission → normalize derivation → straggler
+link-naming → drill-down link table → topology link map.
+"""
+
+import numpy as np
+import pytest
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.normalize import chip_links, to_wide
+from tpudash.sources.base import parse_instant_query
+from tpudash.sources.fixture import SyntheticSource, synthetic_payload
+from tpudash.topology import topology_for
+
+
+# --- schema -----------------------------------------------------------------
+
+def test_schema_link_constants_consistent():
+    assert len(schema.ICI_LINK_DIRS) == 6
+    for d in schema.ICI_LINK_DIRS:
+        assert schema.ICI_LINK_SERIES[d] in schema.SCRAPE_SERIES
+        assert schema.ICI_LINK_SERIES[d] in schema.SERIES_HELP
+        assert schema.ICI_LINK_GBPS[d] in schema.DERIVED_COLUMNS
+        assert schema.ICI_LINK_LABELS[d][0] == d[0]
+    assert schema.ICI_LINK_MIN_GBPS in schema.DERIVED_COLUMNS
+
+
+# --- topology ---------------------------------------------------------------
+
+def test_directed_neighbors_2d_torus():
+    topo = topology_for("v5e", 16)  # 4×4
+    links = dict(topology_for("v5e", 16).directed_neighbors(0))
+    assert set(links) == {"xp", "xn", "yp", "yn"}
+    # chip 0 at (0,0) on 4×4: x+ → (1,0)=1, x− wraps → (3,0)=3
+    assert links["xp"] == 1 and links["xn"] == 3
+    assert links["yp"] == 4 and links["yn"] == 12
+    assert topo.neighbors(0) == [n for _, n in topo.directed_neighbors(0)]
+
+
+def test_directed_neighbors_3d_and_extent_edge_cases():
+    topo = topology_for("v4", 128)  # 4×4×8
+    dirs = [d for d, _ in topo.directed_neighbors(0)]
+    assert dirs == ["xp", "xn", "yp", "yn", "zp", "zn"]
+    # extent-2 axis keeps both directions (distinct cables, same far end)
+    t2 = topology_for("v4", 16)  # 2×2×4
+    links = topo_links = t2.directed_neighbors(0)
+    xs = [n for d, n in topo_links if d in ("xp", "xn")]
+    assert xs[0] == xs[1] == 1
+    # extent-1 axis contributes no links
+    t1 = topology_for("v5e", 1)
+    assert t1.directed_neighbors(0) == []
+
+
+# --- synthetic emission + normalize derivation ------------------------------
+
+def _wide(num_chips=16, generation="v5e", **kw):
+    payload = synthetic_payload(
+        num_chips=num_chips, generation=generation, t=1234.0, **kw
+    )
+    return to_wide(parse_instant_query(payload))
+
+
+def test_synthetic_emits_links_for_torus_rank():
+    df2 = _wide(16, "v5e", emit_links=True)  # 2D torus
+    for d in ("xp", "xn", "yp", "yn"):
+        assert schema.ICI_LINK_SERIES[d] in df2.columns
+        assert schema.ICI_LINK_GBPS[d] in df2.columns
+    assert schema.ICI_LINK_SERIES["zp"] not in df2.columns
+    df3 = _wide(64, "v4", emit_links=True)  # 3D torus
+    assert schema.ICI_LINK_SERIES["zp"] in df3.columns
+    assert schema.ICI_LINK_GBPS["zn"] in df3.columns
+
+
+def test_links_off_by_default():
+    df = _wide(16, "v5e")
+    assert not any(
+        c.startswith("tpu_ici_link") or c.startswith("ici_link")
+        for c in df.columns
+    )
+
+
+def test_link_gbps_derivation_and_min():
+    df = _wide(16, "v5e", emit_links=True)
+    raw = df[schema.ICI_LINK_SERIES["xp"]].to_numpy()
+    gbps = df[schema.ICI_LINK_GBPS["xp"]].to_numpy()
+    np.testing.assert_allclose(gbps, raw / 1e9)
+    stacked = np.column_stack(
+        [df[schema.ICI_LINK_GBPS[d]] for d in ("xp", "xn", "yp", "yn")]
+    )
+    np.testing.assert_allclose(
+        df[schema.ICI_LINK_MIN_GBPS].to_numpy(), stacked.min(axis=1)
+    )
+
+
+def test_batch_and_sample_paths_agree_on_links():
+    """The native-kernel batch pivot and the dict pivot must derive the
+    same per-link columns."""
+    payload = synthetic_payload(num_chips=8, t=99.0, emit_links=True)
+    samples = parse_instant_query(payload)
+    df_dict = to_wide(samples)
+    df_batch = to_wide(schema.SampleBatch.from_samples(samples))
+    for d in ("xp", "xn", "yp", "yn"):
+        col = schema.ICI_LINK_GBPS[d]
+        np.testing.assert_allclose(
+            df_dict[col].to_numpy(), df_batch[col].to_numpy()
+        )
+    np.testing.assert_allclose(
+        df_dict[schema.ICI_LINK_MIN_GBPS].to_numpy(),
+        df_batch[schema.ICI_LINK_MIN_GBPS].to_numpy(),
+    )
+
+
+def test_cold_link_injection():
+    healthy = _wide(16, "v5e", emit_links=True)
+    cold = _wide(16, "v5e", emit_links=True, cold_links=((5, "yn"),))
+    col = schema.ICI_LINK_SERIES["yn"]
+    assert cold[col].iloc[5] == pytest.approx(healthy[col].iloc[5] * 0.08)
+    # only that (chip, dir) is touched
+    assert cold[col].iloc[4] == healthy[col].iloc[4]
+    assert (
+        cold[schema.ICI_LINK_SERIES["yp"]].iloc[5]
+        == healthy[schema.ICI_LINK_SERIES["yp"]].iloc[5]
+    )
+    # the min column now points at the cold link's value
+    assert cold[schema.ICI_LINK_MIN_GBPS].iloc[5] == pytest.approx(
+        cold[col].iloc[5] / 1e9
+    )
+
+
+# --- straggler names the link ----------------------------------------------
+
+def test_straggler_names_the_cold_link():
+    from tpudash.stragglers import StragglerDetector
+
+    det = StragglerDetector.from_config(Config())
+    df = _wide(16, "v5e", emit_links=True, cold_links=((5, "yn"),))
+    out = [s for s in det.evaluate(df) if "link" in s]
+    assert out, "cold link must surface a link-named straggler"
+    cold = [s for s in out if s["link"] == "y-"]
+    assert cold and cold[0]["chip"] == "slice-0/5"
+    assert cold[0]["column"] == schema.ICI_LINK_GBPS["yn"]
+    assert cold[0]["direction"] == "low" and cold[0]["z"] < -3.5
+
+
+# --- drill-down link table --------------------------------------------------
+
+def test_chip_links_table():
+    df = _wide(16, "v5e", emit_links=True)
+    links = chip_links(df, "slice-0/0", "v5e")
+    assert [e["dir"] for e in links] == ["x+", "x-", "y+", "y-"]
+    assert [e["neighbor"] for e in links] == [
+        "slice-0/1", "slice-0/3", "slice-0/4", "slice-0/12",
+    ]
+    for e in links:
+        assert e["gbps"] is not None and e["gbps"] > 0
+
+
+def test_chip_links_empty_without_series():
+    df = _wide(16, "v5e")
+    assert chip_links(df, "slice-0/0", "v5e") == []
+
+
+def test_drilldown_carries_links_and_flags_straggler():
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=16,
+        refresh_interval=0.0,
+        straggler_rules=f"{schema.ICI_LINK_GBPS['yn']}@1",
+    )
+    svc = DashboardService(
+        cfg,
+        SyntheticSource(
+            num_chips=16, emit_links=True, cold_links=((5, "yn"),)
+        ),
+    )
+    svc.render_frame()
+    detail = svc.chip_detail("slice-0/5")
+    assert detail is not None
+    by_dir = {e["dir"]: e for e in detail["links"]}
+    assert set(by_dir) == {"x+", "x-", "y+", "y-"}
+    assert by_dir["y-"]["straggler"] is True
+    assert by_dir["y+"]["straggler"] is False
+    assert any(s.get("link") == "y-" for s in detail["stragglers"])
+    # healthy chip: table present, nothing flagged
+    other = svc.chip_detail("slice-0/0")
+    assert other["links"] and not any(e["straggler"] for e in other["links"])
+
+
+def test_topology_model_names_link_far_ends():
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(source="synthetic", synthetic_chips=16, refresh_interval=0.0)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=16))
+    svc.render_frame()
+    model = svc.topology_model()
+    chip0 = model["slices"][0]["chips"][0]
+    assert chip0["links"] == {"x+": 1, "x-": 3, "y+": 4, "y-": 12}
+    assert sorted(chip0["links"].values()) == sorted(chip0["neighbors"])
+
+
+# --- min-link panel activation ----------------------------------------------
+
+def test_min_link_panel_appears_with_link_series():
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(source="synthetic", synthetic_chips=16, refresh_interval=0.0)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=16, emit_links=True))
+    frame = svc.render_frame()
+    panels = [p["panel"] for p in frame["average"]["figures"]]
+    assert schema.ICI_LINK_MIN_GBPS in panels
+    assert schema.ICI_LINK_MIN_GBPS in [
+        p["column"] for p in frame["panel_specs"]
+    ]
+    # and not when the source has no per-link series
+    svc2 = DashboardService(cfg, SyntheticSource(num_chips=16))
+    frame2 = svc2.render_frame()
+    panels2 = [p["panel"] for p in frame2["average"]["figures"]]
+    assert schema.ICI_LINK_MIN_GBPS not in panels2
+
+
+def test_ici_link_axis_max_policy():
+    from tpudash.viz.dispatch import panel_max
+
+    spec = next(
+        p for p in schema.EXTRA_PANELS
+        if p.column == schema.ICI_LINK_MIN_GBPS
+    )
+    # one link's tx+rx ceiling: 2 × 50 GB/s for v5e
+    assert panel_max(spec, ["tpu-v5-lite-podslice"]) == 100.0
+    assert panel_max(spec, None) == spec.fixed_max
+
+
+# --- config knobs -----------------------------------------------------------
+
+def test_cold_link_spec_parsing():
+    from tpudash.sources import _parse_cold_links
+
+    assert _parse_cold_links("") == ()
+    assert _parse_cold_links("17:xn, 40:zp") == ((17, "xn"), (40, "zp"))
+    with pytest.raises(ValueError):
+        _parse_cold_links("17:sideways")
+
+
+def test_synthetic_links_env_knobs():
+    from tpudash.config import load_config
+    from tpudash.sources import make_source
+
+    cfg = load_config(
+        {
+            "TPUDASH_SOURCE": "synthetic",
+            "TPUDASH_SYNTHETIC_CHIPS": "16",
+            "TPUDASH_SYNTHETIC_LINKS": "1",
+            "TPUDASH_SYNTHETIC_COLD_LINKS": "3:xp",
+            "TPUDASH_FETCH_RETRIES": "0",
+        }
+    )
+    assert cfg.synthetic_links is True
+    src = make_source(cfg)
+    assert src.emit_links is True and src.cold_links == ((3, "xp"),)
+    # bool env accepts false spellings too
+    off = load_config({"TPUDASH_SYNTHETIC_LINKS": "false"})
+    assert off.synthetic_links is False

@@ -27,6 +27,13 @@ def test_probe_source_emits_per_device_samples():
     assert HBM_BANDWIDTH in metrics
     # 8 virtual devices → multi-device host → ICI probes ran
     assert schema.ICI_TX in metrics and schema.ICI_RX in metrics
+    # direction-resolved x-pair links (forward + reverse ppermute rings)
+    assert schema.ICI_LINK_SERIES["xp"] in metrics
+    assert schema.ICI_LINK_SERIES["xn"] in metrics
+    xp = [
+        s.value for s in samples if s.metric == schema.ICI_LINK_SERIES["xp"]
+    ]
+    assert len(xp) == n and all(v > 0 for v in xp)
 
 
 def test_probe_utilization_bounded():

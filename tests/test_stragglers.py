@@ -55,7 +55,8 @@ def test_parse_rejects_garbage():
 
 
 def test_default_spec_parses():
-    assert len(parse_rules(DEFAULT_RULES_SPEC)) == 4
+    # 4 fleet metrics + the 6 direction-resolved ICI link columns
+    assert len(parse_rules(DEFAULT_RULES_SPEC)) == 10
 
 
 def test_from_config_sentinels():
@@ -63,7 +64,7 @@ def test_from_config_sentinels():
         Config(straggler_rules="off")
     ) is None
     det = StragglerDetector.from_config(Config())
-    assert det is not None and len(det.rules) == 4
+    assert det is not None and len(det.rules) == 10
     assert det.zscore == 3.5
 
 

@@ -60,6 +60,11 @@ PAGE = r"""<!DOCTYPE html>
   .drill-alerts { color: #a8322a; font-size: 13px; margin: 6px 0; }
   .neighbors { font-size: 13px; color: #44556a; margin-top: 8px; }
   .neighbors button { margin-left: 4px; }
+  table.links { font-size: 13px; color: #44556a; margin-top: 8px;
+    border-collapse: collapse; }
+  table.links th, table.links td { border: 1px solid #c7d3e0;
+    padding: 2px 8px; text-align: left; }
+  tr.link-cold td { background: #fde8e6; color: #a8322a; }
   .hint { color: #6b7a8c; font-size: 12px; }
 </style>
 </head>
@@ -267,6 +272,17 @@ function renderDrill(d) {
   }
   html += '<div class="panel-row" id="drill-gauges"></div>';
   html += '<div class="panel-row" id="drill-trends"></div>';
+  if (d.links && d.links.length) {
+    // direction-resolved per-link table: the failing CABLE, with the
+    // chip on its far end one click away
+    html += '<table class="links"><tr><th>link</th><th>GB/s</th><th>far end</th></tr>' +
+      d.links.map(l =>
+        `<tr${l.straggler ? ' class="link-cold"' : ''}><td>${esc(l.dir)}` +
+        (l.straggler ? ' 🐢' : '') + '</td><td>' +
+        (l.gbps === null || l.gbps === undefined ? '—' : (+l.gbps)) + '</td><td>' +
+        (l.neighbor ? `<button data-chip="${esc(l.neighbor)}">${esc(l.neighbor)}</button>` : '—') +
+        '</td></tr>').join('') + '</table>';
+  }
   if (d.neighbors && d.neighbors.length) {
     html += `<div class="neighbors">ICI neighbors:` +
       d.neighbors.map(n => `<button data-chip="${esc(n)}">${esc(n)}</button>`).join('') +
@@ -283,7 +299,7 @@ function renderDrill(d) {
     }
   }
   document.getElementById('drill-close').addEventListener('click', closeDrill);
-  for (const btn of el.querySelectorAll('.neighbors button')) {
+  for (const btn of el.querySelectorAll('.neighbors button, table.links button')) {
     btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
   }
 }

@@ -44,6 +44,7 @@ from tpudash.normalize import (
     dense_block,
     filter_selected,
     to_wide,
+    chip_links,
     torus_neighbor_keys,
 )
 from tpudash.app.state import SelectionState
@@ -941,6 +942,21 @@ class DashboardService:
             neighbors = torus_neighbor_keys(df, key, self.cfg.generation)
         except Exception:  # noqa: BLE001 — neighbors are best-effort context
             neighbors = []
+        # direction-resolved link table (sources with per-link series):
+        # each physical cable's measured GB/s + the chip on its far end,
+        # flagged when straggler detection names that link
+        try:
+            links = chip_links(df, key, self.cfg.generation)
+        except Exception:  # noqa: BLE001 — link detail is best-effort too
+            links = []
+        if links:
+            flagged = {
+                s["link"]
+                for s in self.last_stragglers
+                if s.get("chip") == key and "link" in s
+            }
+            for entry in links:
+                entry["straggler"] = entry["dir"] in flagged
         return {
             "key": key,
             "chip_id": int(row["chip_id"]),
@@ -955,6 +971,7 @@ class DashboardService:
                 s for s in self.last_stragglers if s.get("chip") == key
             ],
             "neighbors": neighbors,
+            "links": links,
             "last_updated": self.last_updated,
         }
 
@@ -1004,6 +1021,12 @@ class DashboardService:
                         "chip_id": int(c),
                         "coords": list(topo.coords(int(c))),
                         "neighbors": topo.neighbors(int(c)),
+                        # direction-labeled far ends ("x+" → chip_id):
+                        # which cable reaches which neighbor
+                        "links": {
+                            schema.ICI_LINK_LABELS[d]: nid
+                            for d, nid in topo.directed_neighbors(int(c))
+                        },
                     }
                     for k, c in zip(same.index.tolist(), ids.tolist())
                     if 0 <= c < topo.num_chips

@@ -54,6 +54,14 @@ class Config:
     #: Synthetic-source slice count (>1 emits cross-slice DCN series —
     #: BASELINE.json configs[4] multi-slice shape).
     synthetic_slices: int = 1
+    #: Synthetic source: also emit direction-resolved per-link ICI series
+    #: (schema.ICI_LINK_SERIES) for the generation's torus rank.
+    synthetic_links: bool = False
+    #: Synthetic source: cold-link injection, comma-separated "chip:dir"
+    #: pairs (e.g. "17:xn,40:zp") — those links run at ~8% of nominal, the
+    #: failing-cable drill the straggler detector should name.  Implies
+    #: nothing unless synthetic_links is on.
+    synthetic_cold_links: str = ""
     #: TPU generation hint for the synthetic source / topology fallback.
     generation: str = "v5e"
     #: Target discovery mode: "selector" (default — trust the Prometheus
@@ -169,6 +177,8 @@ _ENV_MAP = {
     "fixture_path": "TPUDASH_FIXTURE_PATH",
     "synthetic_chips": "TPUDASH_SYNTHETIC_CHIPS",
     "synthetic_slices": "TPUDASH_SYNTHETIC_SLICES",
+    "synthetic_links": "TPUDASH_SYNTHETIC_LINKS",
+    "synthetic_cold_links": "TPUDASH_SYNTHETIC_COLD_LINKS",
     "generation": "TPUDASH_GENERATION",
     "discovery": "TPUDASH_DISCOVERY",
     "series_selector": "TPUDASH_SERIES_SELECTOR",
@@ -223,6 +233,8 @@ def load_config(env: dict | None = None) -> Config:
             kwargs[f.name] = int(raw)
         elif f.type in ("float", float):
             kwargs[f.name] = float(raw)
+        elif f.type in ("bool", bool):
+            kwargs[f.name] = raw.strip().lower() in ("1", "true", "yes", "on")
         else:
             kwargs[f.name] = raw
     return Config(**kwargs)

@@ -112,6 +112,16 @@ def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
                 (tx if tx is not None else 0.0)
                 + (rx if rx is not None else 0.0)
             ) / 1e9
+        links = []
+        for d in schema.ICI_LINK_DIRS:
+            raw = col(schema.ICI_LINK_SERIES[d])
+            if raw is not None:
+                gbps = raw / 1e9
+                derived[schema.ICI_LINK_GBPS[d]] = gbps
+                links.append(gbps)
+        if links:
+            # coldest present link per chip; all-NaN rows stay NaN
+            derived[schema.ICI_LINK_MIN_GBPS] = _nanmin_rows(links)
 
     # derived overwrite same-named source series (see _derive)
     kept = [m for m in metrics if m not in derived]
@@ -145,6 +155,13 @@ def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
     return pd.concat([ident, metric_df], axis=1)
 
 
+def _nanmin_rows(cols: "list[np.ndarray]") -> np.ndarray:
+    """Per-row min across columns, ignoring NaN (all-NaN rows → NaN)."""
+    stacked = np.column_stack(cols)
+    with _nanwarn_silenced():
+        return np.nanmin(stacked, axis=1)
+
+
 def _derive(df: pd.DataFrame) -> pd.DataFrame:
     """Add derived display columns (reference app.py:210-212 for the ratio).
 
@@ -166,6 +183,15 @@ def _derive(df: pd.DataFrame) -> pd.DataFrame:
         tx = df.get(schema.DCN_TX, 0.0)
         rx = df.get(schema.DCN_RX, 0.0)
         derived[schema.DCN_TOTAL_GBPS] = (tx + rx) / 1e9
+    links = []
+    for d in schema.ICI_LINK_DIRS:
+        raw = schema.ICI_LINK_SERIES[d]
+        if raw in df:
+            gbps = df[raw].to_numpy(dtype=np.float64) / 1e9
+            derived[schema.ICI_LINK_GBPS[d]] = gbps
+            links.append(gbps)
+    if links:
+        derived[schema.ICI_LINK_MIN_GBPS] = _nanmin_rows(links)
     if not derived:
         return df
     # derived values overwrite same-named source series (the pre-concat
@@ -355,6 +381,53 @@ def torus_neighbor_keys(
         for k, c in zip(same.index.tolist(), ids.tolist())
         if c in want
     ]
+
+
+def chip_links(
+    df: pd.DataFrame, key: str, fallback_generation: "str | None" = None
+) -> list[dict]:
+    """Per-link ICI detail for one chip's drill-down: direction label,
+    measured GB/s (None when the source has no per-link series for that
+    direction), and the chip key on the link's far end.  Empty when the
+    source emits no per-link series at all — capability honesty, the
+    drill-down renders no table rather than an empty one."""
+    from tpudash.topology import topology_for
+
+    present = {
+        d: schema.ICI_LINK_GBPS[d]
+        for d in schema.ICI_LINK_DIRS
+        if schema.ICI_LINK_GBPS[d] in df.columns
+    }
+    if not present:
+        return []
+    row = df.loc[key]
+    same = df[df["slice_id"] == row["slice_id"]]
+    ids = same["chip_id"].to_numpy()
+    sane = ids[(ids >= 0) & (ids < 16384)]
+    if sane.size == 0:
+        return []
+    accel = row.get(schema.ACCEL_TYPE, "") or fallback_generation
+    topo = topology_for(accel, int(sane.max()) + 1)
+    cid = int(row["chip_id"])
+    if not 0 <= cid < topo.num_chips:
+        return []
+    by_id = dict(zip(ids.tolist(), same.index.tolist()))
+    out = []
+    for d, nid in topo.directed_neighbors(cid):
+        col = present.get(d)
+        val = row.get(col) if col else None
+        out.append(
+            {
+                "dir": schema.ICI_LINK_LABELS[d],
+                "gbps": (
+                    round(float(val), 2)
+                    if val is not None and not pd.isna(val)
+                    else None
+                ),
+                "neighbor": str(by_id[nid]) if nid in by_id else None,
+            }
+        )
+    return out
 
 
 def filter_selected(df: pd.DataFrame, selected: list[str]) -> pd.DataFrame:

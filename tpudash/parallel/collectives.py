@@ -36,11 +36,14 @@ def _sharded_ones(mesh: Mesh, axis: str, mb_per_device: int) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=32)
-def _ring_sum_fn(mesh: Mesh, axis: str):
-    """Compiled ring-shift closure, cached per (mesh, axis) so periodic
-    probe cycles hit the jit cache instead of re-tracing every interval."""
+def _ring_sum_fn(mesh: Mesh, axis: str, reverse: bool = False):
+    """Compiled ring-shift closure, cached per (mesh, axis, direction) so
+    periodic probe cycles hit the jit cache instead of re-tracing every
+    interval.  ``reverse`` shifts −1 instead of +1 — the opposite cable of
+    each chip's axis pair, for direction-resolved link probing."""
     n = mesh.shape[axis]
-    perm = tuple((i, (i + 1) % n) for i in range(n))
+    step = -1 if reverse else 1
+    perm = tuple((i, (i + step) % n) for i in range(n))
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def ring_sum(block, k: int):
@@ -59,15 +62,21 @@ def _ring_sum_fn(mesh: Mesh, axis: str):
 
 
 def ppermute_ring_bandwidth_probe(
-    mesh: Mesh, axis: str = "tp", mb_per_device: int = 64, steps: int = 4
+    mesh: Mesh,
+    axis: str = "tp",
+    mb_per_device: int = 64,
+    steps: int = 4,
+    reverse: bool = False,
 ) -> ProbeResult:
-    """Ring shift: every chip sends its whole shard to its +1 neighbor.
-    Delta-timed at ``steps`` vs ``3·steps`` shifts; value is per-chip
-    one-way GB/s (the tpu_ici_tx_bytes_per_second feed)."""
+    """Ring shift: every chip sends its whole shard to its +1 neighbor
+    (−1 with ``reverse`` — the other cable of the axis pair).  Delta-timed
+    at ``steps`` vs ``3·steps`` shifts; value is per-chip one-way GB/s
+    (the tpu_ici_tx_bytes_per_second feed; per-direction for the
+    tpu_ici_link_* series)."""
     n = mesh.shape[axis]
     steps = max(1, steps)
     x = _sharded_ones(mesh, axis, mb_per_device)
-    ring_sum = _ring_sum_fn(mesh, axis)
+    ring_sum = _ring_sum_fn(mesh, axis, reverse)
 
     dt = _delta_time(
         lambda: ring_sum(x, steps), lambda: ring_sum(x, 3 * steps)
@@ -77,7 +86,7 @@ def ppermute_ring_bandwidth_probe(
         value=shard_bytes * (2 * steps) / dt / 1e9,
         elapsed_s=dt,
         detail={"axis": axis, "devices": n, "mb_per_device": mb_per_device,
-                "steps": steps},
+                "steps": steps, "reverse": reverse},
     )
 
 

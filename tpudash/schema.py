@@ -29,6 +29,32 @@ ICI_RX = "tpu_ici_rx_bytes_per_second"
 #: Cross-slice data-center network (multi-slice), bytes/s.
 DCN_TX = "tpu_dcn_tx_bytes_per_second"
 DCN_RX = "tpu_dcn_rx_bytes_per_second"
+
+# --- per-link ICI detail ----------------------------------------------------
+#: Direction-resolved ICI links.  Aggregate tx/rx says "this chip's ICI is
+#: slow"; lockstep debugging needs "this chip's x− link is cold" — the
+#: failing cable/port, which also names the neighbor on its far end.
+#: Directions are torus axes: xp = x+, xn = x− …; 2D tori (v5e) have
+#: x/y only, 3D (v4/v5p) add z.  Each series is the link's combined
+#: tx+rx rate in bytes/s (per-link counters are symmetric at the torus
+#: level; splitting tx/rx per direction would double 6 columns for no
+#: diagnostic gain — the cold-cable signal is the total).
+ICI_LINK_DIRS: tuple[str, ...] = ("xp", "xn", "yp", "yn", "zp", "zn")
+#: Column-safe dir token → human/axis label ("xp" → "x+").
+ICI_LINK_LABELS: dict[str, str] = {
+    "xp": "x+", "xn": "x-", "yp": "y+", "yn": "y-", "zp": "z+", "zn": "z-",
+}
+#: Raw scraped series per direction, bytes/s.
+ICI_LINK_SERIES: dict[str, str] = {
+    d: f"tpu_ici_link_{d}_bytes_per_second" for d in ICI_LINK_DIRS
+}
+#: Derived display columns per direction, GB/s.
+ICI_LINK_GBPS: dict[str, str] = {
+    d: f"ici_link_{d}_gbps" for d in ICI_LINK_DIRS
+}
+#: Derived min across a chip's present links, GB/s — the "coldest link"
+#: column the fleet heatmap and straggler detection watch.
+ICI_LINK_MIN_GBPS = "ici_link_min_gbps"
 #: Package temperature, °C, and board power, W (where the platform exposes
 #: them; the probe/synthetic sources always do).
 TEMPERATURE = "tpu_temperature_celsius"
@@ -48,6 +74,7 @@ SCRAPE_SERIES: tuple[str, ...] = (
     HBM_TOTAL,
     ICI_TX,
     ICI_RX,
+    *ICI_LINK_SERIES.values(),
     DCN_TX,
     DCN_RX,
     TEMPERATURE,
@@ -70,6 +97,8 @@ DERIVED_COLUMNS: tuple[str, ...] = (
     HBM_USED_GIB,
     ICI_TOTAL_GBPS,
     DCN_TOTAL_GBPS,
+    *ICI_LINK_GBPS.values(),
+    ICI_LINK_MIN_GBPS,
 )
 
 #: Pseudo-metric column carrying the device model string through the wide
@@ -326,7 +355,7 @@ class SampleBatch:
 class PanelSpec:
     title: str           # per-chip panel title; avg row prefixes "Avg "
     column: str          # wide-table column to display
-    max_policy: str      # "fixed" | "power" | "hbm" | "ici" | "hbm_bw"
+    max_policy: str      # "fixed" | "power" | "hbm" | "ici" | "ici_link" | "hbm_bw"
     fixed_max: float = 100.0
     unit: str = "%"
 
@@ -357,6 +386,12 @@ SERIES_HELP: dict[str, str] = {
     HBM_BANDWIDTH: "Achieved HBM streaming bandwidth, GB/s",
     MXU_UTIL: "MXU (matrix unit) utilization percent [0,100]",
     MEMBW_UTIL: "HBM bandwidth utilization percent [0,100]",
+    **{
+        ICI_LINK_SERIES[d]: (
+            f"ICI link {ICI_LINK_LABELS[d]} combined tx+rx rate, bytes/s"
+        )
+        for d in ICI_LINK_DIRS
+    },
 }
 
 #: Extra TPU-native panels (beyond the reference's four) shown when the
@@ -364,6 +399,9 @@ SERIES_HELP: dict[str, str] = {
 #: HBM bandwidth.
 EXTRA_PANELS: tuple[PanelSpec, ...] = (
     PanelSpec("ICI Bandwidth (GB/s)", ICI_TOTAL_GBPS, "ici", 200.0, "GB/s"),
+    # coldest of the chip's direction-resolved links: the heatmap cell
+    # that names the chip with a failing cable (drill-down names the link)
+    PanelSpec("ICI Min Link (GB/s)", ICI_LINK_MIN_GBPS, "ici_link", 100.0, "GB/s"),
     PanelSpec("DCN Bandwidth (GB/s)", DCN_TOTAL_GBPS, "fixed", 50.0, "GB/s"),
     PanelSpec("HBM Bandwidth (GB/s)", HBM_BANDWIDTH, "hbm_bw", 1000.0, "GB/s"),
     PanelSpec("MXU Utilization (%)", MXU_UTIL, "fixed", 100.0, "%"),

@@ -12,6 +12,26 @@ from tpudash.sources.fixture import FixtureSource, SyntheticSource  # noqa: F401
 from tpudash.sources.prometheus import PrometheusSource  # noqa: F401
 
 
+def _parse_cold_links(spec: str) -> tuple:
+    """``"17:xn,40:zp"`` → ((17, "xn"), (40, "zp")) for the synthetic
+    source's cold-link injection; bad entries raise (a mistyped drill
+    config should fail loudly, not silently run a healthy fleet)."""
+    from tpudash.schema import ICI_LINK_DIRS
+
+    out = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        chip, _, d = item.partition(":")
+        if d not in ICI_LINK_DIRS:
+            raise ValueError(
+                f"bad cold-link {item!r}: dir must be one of {ICI_LINK_DIRS}"
+            )
+        out.append((int(chip), d))
+    return tuple(out)
+
+
 def make_source(cfg) -> MetricsSource:
     """Source factory driven by Config.source.  Every source is wrapped in
     ResilientSource (per-fetch retry/backoff + health tracking,
@@ -54,6 +74,8 @@ def _make_source(cfg) -> MetricsSource:
             num_chips=cfg.synthetic_chips,
             generation=cfg.generation,
             num_slices=cfg.synthetic_slices,
+            emit_links=cfg.synthetic_links,
+            cold_links=_parse_cold_links(cfg.synthetic_cold_links),
         )
     if kind == "scrape":
         from tpudash.sources.scrape import ScrapeSource

@@ -67,6 +67,8 @@ def synthetic_payload(
     chips_per_host: int = 4,
     idle_chips: tuple = (),
     emit_dcn: bool | None = None,
+    emit_links: bool = False,
+    cold_links: tuple = (),
 ) -> dict:
     """Build a Prometheus-shaped payload for a synthetic pod slice.
 
@@ -77,12 +79,30 @@ def synthetic_payload(
     defaults to (num_slices > 1); pass True to model a single slice of a
     multi-slice deployment whose exporter emits its own DCN counters (the
     MultiSource join shape).
+
+    ``emit_links=True`` adds direction-resolved per-link ICI series
+    (schema.ICI_LINK_SERIES) for the generation's torus rank — x/y for 2D,
+    x/y/z for 3D.  ``cold_links`` is a tuple of ``(chip_id, dir)`` pairs
+    (dir in schema.ICI_LINK_DIRS) whose link runs at ~8% of nominal: the
+    failing-cable story straggler detection must name.
     """
     gen = resolve_generation(generation) or TPU_GENERATIONS["v5e"]
     accel = gen.accelerator_types[0]
     if t is None:
         t = time.time()
     hbm_total = gen.hbm_gib * 1024**3
+    link_dirs: tuple = ()
+    if emit_links:
+        from tpudash.schema import ICI_LINK_DIRS, ICI_LINK_SERIES
+        from tpudash.topology import topology_for
+
+        rank = topology_for(generation, num_chips).rank
+        link_dirs = tuple(
+            (d, ICI_LINK_SERIES[d])
+            for d in ICI_LINK_DIRS
+            if "xyz".index(d[0]) < rank
+        )
+    cold = set(cold_links)
     results = []
 
     def emit(name: str, chip: int, sl: int, value: float) -> None:
@@ -112,6 +132,17 @@ def synthetic_payload(
             emit(HBM_TOTAL, chip, sl, hbm_total)
             emit(ICI_TX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.8)
             emit(ICI_RX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.78)
+            for li, (d, series) in enumerate(link_dirs):
+                # SPMD lockstep moves the SAME bytes on every chip's d-axis
+                # link each step, so link rate is fleet-uniform per
+                # direction (±2% jitter) — exactly why one cold link is an
+                # outlier the straggler detector can name
+                lw = 0.55 + 0.35 * math.sin(t / 30.0 + 0.9 * li)
+                jitter = 1.0 + 0.02 * math.sin(chip * 1.7 + li)
+                rate = lw * jitter * gen.ici_link_gbps * 1e9 * 1.5
+                if (chip, d) in cold:
+                    rate *= 0.08
+                emit(series, chip, sl, rate)
             if emit_dcn or (emit_dcn is None and num_slices > 1):
                 emit(DCN_TX, chip, sl, wave * 12e9)
                 emit(DCN_RX, chip, sl, wave * 11e9)
@@ -148,13 +179,15 @@ class JsonReplaySource(MetricsSource):
         generation: str = "v5e",
         frames: int = 8,
         num_slices: int = 1,
+        emit_links: bool = False,
     ):
         """Pre-serialize `frames` synthetic payloads at distinct times."""
         return cls(
             [
                 json.dumps(
                     synthetic_payload(num_chips=num_chips, generation=generation,
-                                      t=1000.0 + 5.0 * i, num_slices=num_slices)
+                                      t=1000.0 + 5.0 * i, num_slices=num_slices,
+                                      emit_links=emit_links)
                 )
                 for i in range(frames)
             ]
@@ -178,12 +211,16 @@ class SyntheticSource(MetricsSource):
         num_slices: int = 1,
         idle_chips: tuple = (),
         emit_dcn: bool | None = None,
+        emit_links: bool = False,
+        cold_links: tuple = (),
     ):
         self.num_chips = num_chips
         self.generation = generation
         self.num_slices = num_slices
         self.idle_chips = tuple(idle_chips)
         self.emit_dcn = emit_dcn
+        self.emit_links = emit_links
+        self.cold_links = tuple(cold_links)
 
     def fetch(self):
         payload = synthetic_payload(
@@ -192,5 +229,7 @@ class SyntheticSource(MetricsSource):
             num_slices=self.num_slices,
             idle_chips=self.idle_chips,
             emit_dcn=self.emit_dcn,
+            emit_links=self.emit_links,
+            cold_links=self.cold_links,
         )
         return parse_instant_query(payload)

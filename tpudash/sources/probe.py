@@ -11,6 +11,8 @@ metrics → local Prometheus" — here without even the Prometheus hop):
 - tpu_hbm_bandwidth_gbps      ← Pallas streaming probe (extra series)
 - tpu_ici_tx/rx_bytes_per_second ← ring / all-gather collective probes
                                    (multi-device hosts only)
+- tpu_ici_link_xp/xn_bytes_per_second ← forward/reverse ppermute rings over
+                                   the local 1D ring's two x cables
 
 Probe cost is bounded by config (sizes/iters) and heavyweight probes run at
 most once per ``probe_heavy_interval`` seconds — in between, the last
@@ -34,6 +36,7 @@ from tpudash.schema import (
     HBM_BANDWIDTH,
     HBM_TOTAL,
     HBM_USED,
+    ICI_LINK_SERIES,
     ICI_RX,
     ICI_TX,
     TENSORCORE_UTIL,
@@ -111,6 +114,20 @@ class ProbeSource(MetricsSource):
             rx = all_gather_bandwidth_probe(mesh, "tp", self.ici_mb)
             fresh["ici_tx"] = tx.value * 1e9
             fresh["ici_rx"] = rx.value * 1e9
+            # direction-resolved: the local 1D ring is the x axis; the
+            # forward (+1) and reverse (−1) shifts exercise each chip's
+            # two x cables separately.  A link's series is combined tx+rx:
+            # chip i transmits on x+ during the forward ring and receives
+            # on it during the reverse ring.
+            rev = ppermute_ring_bandwidth_probe(
+                mesh, "tp", self.ici_mb, reverse=True
+            )
+            # the probe pair loads both cables symmetrically, so the two
+            # directions measure equal unless one cable is degraded — in
+            # which case BOTH rings slow and the drill-down still points
+            # at this chip's x pair
+            fresh["ici_link_xp"] = (tx.value + rev.value) * 1e9
+            fresh["ici_link_xn"] = (tx.value + rev.value) * 1e9
         return fresh
 
     def _refresh_heavy(self) -> None:
@@ -203,4 +220,7 @@ class ProbeSource(MetricsSource):
                 # bytes, so the per-chip value is genuinely per-chip
                 emit(ICI_TX, i, self._cache["ici_tx"])
                 emit(ICI_RX, i, self._cache["ici_rx"])
+            if "ici_link_xp" in self._cache:
+                emit(ICI_LINK_SERIES["xp"], i, self._cache["ici_link_xp"])
+                emit(ICI_LINK_SERIES["xn"], i, self._cache["ici_link_xn"])
         return samples

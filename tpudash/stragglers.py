@@ -67,16 +67,28 @@ DEFAULT_DIRECTIONS: dict[str, str] = {
     schema.TEMPERATURE: "high",
     schema.POWER: "both",
     schema.HBM_USAGE_RATIO: "both",
+    **{c: "low" for c in schema.ICI_LINK_GBPS.values()},
+    schema.ICI_LINK_MIN_GBPS: "low",
 }
 
-#: Built-in watch list: the lockstep-gating metrics plus thermals.  HBM
-#: usage and power are deliberately NOT watched by default — both skew
+#: Straggler-entry link label per watched per-link column ("x+", …) —
+#: a breach on one of these names the failing CABLE, not just the chip.
+LINK_COLUMNS: dict[str, str] = {
+    schema.ICI_LINK_GBPS[d]: schema.ICI_LINK_LABELS[d]
+    for d in schema.ICI_LINK_DIRS
+}
+
+#: Built-in watch list: the lockstep-gating metrics plus thermals, and
+#: each direction-resolved ICI link (sources without per-link series just
+#: skip those rules — a skipped metric freezes, never flags).  HBM usage
+#: and power are deliberately NOT watched by default — both skew
 #: legitimately under uneven sharding; opt in via the spec.
 DEFAULT_RULES_SPEC = (
     "tpu_tensorcore_utilization@3,"
     "tpu_mxu_utilization@3,"
     "ici_total_gbps@3,"
-    "tpu_temperature_celsius@3"
+    "tpu_temperature_celsius@3,"
+    + ",".join(f"{c}@3" for c in LINK_COLUMNS)
 )
 
 DIRECTIONS = ("low", "high", "both")
@@ -241,19 +253,22 @@ class StragglerDetector:
                 tkey = (rule.column, chip_key)
                 seen.add(tkey)
                 track, firing = self._tracks.hit(tkey, rule.for_cycles, now)
-                out.append(
-                    {
-                        "column": rule.column,
-                        "chip": chip_key,
-                        "value": round(float(x[i]), 2),
-                        "median": round(med, 2),
-                        "z": round(float(z[i]), 1),
-                        "direction": rule.direction,
-                        "state": "firing" if firing else "pending",
-                        "since": track.firing_since,
-                        "streak": track.streak,
-                    }
-                )
+                entry = {
+                    "column": rule.column,
+                    "chip": chip_key,
+                    "value": round(float(x[i]), 2),
+                    "median": round(med, 2),
+                    "z": round(float(z[i]), 1),
+                    "direction": rule.direction,
+                    "state": "firing" if firing else "pending",
+                    "since": track.firing_since,
+                    "streak": track.streak,
+                }
+                link = LINK_COLUMNS.get(rule.column)
+                if link is not None:
+                    # name the cable, not just the chip
+                    entry["link"] = link
+                out.append(entry)
         # implicit resolution for (column, chip) pairs not seen this frame;
         # pairs under a skipped metric are frozen (counted as seen) so a
         # degraded cycle neither advances nor resets their streak

@@ -85,6 +85,25 @@ class Topology:
                     out.append(nid)
         return out
 
+    def directed_neighbors(self, chip_id: int) -> "list[tuple[str, int]]":
+        """Direction-labeled torus neighbors: [("xp", id), ("xn", id), …]
+        using the column-safe tokens of schema.ICI_LINK_DIRS — the far end
+        of each physical ICI link.  Unlike :meth:`neighbors`, extent-2 axes
+        keep BOTH entries (the +1/-1 neighbors coincide but the two
+        directions are distinct cables, and per-link metrics are keyed by
+        direction); extent-1 axes still contribute none."""
+        c = list(self.coords(chip_id))
+        out: list[tuple[str, int]] = []
+        for axis, extent in enumerate(self.dims):
+            if extent <= 1:
+                continue
+            name = "xyz"[axis]
+            for step, sign in ((1, "p"), (-1, "n")):
+                n = list(c)
+                n[axis] = (n[axis] + step) % extent
+                out.append((f"{name}{sign}", self.chip_id(tuple(n))))
+        return out
+
 
 # Published slice shapes (chips) per generation.  v5e slices come in fixed
 # shapes; other counts fall back to the squarest 2D factorization.

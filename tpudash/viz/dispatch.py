@@ -53,6 +53,14 @@ def panel_max(
                 # aggregate tx+rx ceiling across the chip's links
                 limits.append(2 * gen.ici_links_per_chip * gen.ici_link_gbps)
         return max(limits) if limits else spec.fixed_max
+    if spec.max_policy == "ici_link":
+        # ONE link's combined tx+rx ceiling (per-link panels)
+        limits = [
+            2 * gen.ici_link_gbps
+            for a in accel_types
+            if (gen := resolve_generation(a))
+        ]
+        return max(limits) if limits else spec.fixed_max
     if spec.max_policy == "hbm_bw":
         limits = [
             gen.hbm_gbps for a in accel_types if (gen := resolve_generation(a))
