@@ -1,0 +1,242 @@
+"""Alert acknowledge/silence workflow (VERDICT r3 #6): a known-flapping
+chip must be silenceable without editing TPUDASH_ALERT_RULES and
+restarting — flagged on the frame, excluded from webhook paging,
+persisted across restart, TTL-expiring (and paging again on expiry while
+still firing)."""
+
+import asyncio
+import json
+
+import pytest
+
+from tpudash import schema
+from tpudash.alerts import SilenceSet, parse_rules, prometheus_rules_yaml
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.schema import ChipKey, Sample
+from tpudash.sources.base import MetricsSource
+
+
+class _HotSource(MetricsSource):
+    """Chips 0/1 hot (alerting), 2 cool."""
+
+    name = "hot"
+
+    def fetch(self):
+        out = []
+        for cid, temp in ((0, 95.0), (1, 96.0), (2, 50.0)):
+            chip = ChipKey(slice_id="s", host="h", chip_id=cid)
+            out.append(Sample(metric=schema.TEMPERATURE, value=temp, chip=chip))
+        return out
+
+
+def _svc(tmp_path=None, **kw):
+    cfg = Config(
+        alert_rules=f"{schema.TEMPERATURE}>90:critical@1",
+        refresh_interval=0.0,
+        fetch_retries=0,
+        state_path=str(tmp_path / "state.json") if tmp_path else "",
+        **kw,
+    )
+    return DashboardService(cfg, _HotSource())
+
+
+RULE = "tpu_temperature_celsius>90"
+
+
+# --- SilenceSet unit behavior ----------------------------------------------
+
+def test_wildcards_and_exact_matching():
+    s = SilenceSet()
+    s.add(RULE, "s/0", 60.0, now=100.0)
+    assert s.is_silenced(RULE, "s/0", 101.0)
+    assert not s.is_silenced(RULE, "s/1", 101.0)
+    assert not s.is_silenced("other>1", "s/0", 101.0)
+    s.add("*", "s/1", 60.0, now=100.0)
+    assert s.is_silenced("anything>2", "s/1", 101.0)
+    s.add(RULE, "*", 60.0, now=100.0)
+    assert s.is_silenced(RULE, "s/7", 101.0)
+
+
+def test_ttl_expiry_and_duplicate_replacement():
+    s = SilenceSet()
+    s.add(RULE, "s/0", ttl_s=10.0, now=100.0)
+    assert s.is_silenced(RULE, "s/0", 109.0)
+    assert not s.is_silenced(RULE, "s/0", 110.5)  # expired
+    # re-adding the same scope replaces (extends), not stacks
+    s.add(RULE, "s/0", ttl_s=10.0, now=100.0)
+    s.add(RULE, "s/0", ttl_s=100.0, now=100.0)
+    assert len(s.active(101.0)) == 1
+    assert s.is_silenced(RULE, "s/0", 150.0)
+
+
+def test_bad_ttl_rejected():
+    with pytest.raises(ValueError):
+        SilenceSet().add(RULE, "s/0", 0.0, now=1.0)
+
+
+def test_serialization_roundtrip_drops_expired():
+    s = SilenceSet()
+    s.add(RULE, "s/0", 1000.0, now=100.0)
+    s.add(RULE, "s/1", 5.0, now=100.0)
+    restored = SilenceSet.from_dicts(s.to_dicts(), now=200.0)
+    assert [e["chip"] for e in restored.active(200.0)] == ["s/0"]
+    # corrupt section → empty set, never a crash
+    assert SilenceSet.from_dicts([{"bad": 1}], now=0.0).active(0.0) == []
+    assert SilenceSet.from_dicts("garbage", now=0.0).active(0.0) == []
+
+
+# --- service integration ----------------------------------------------------
+
+def test_frame_flags_silenced_and_webhook_skips(monkeypatch):
+    calls = []
+
+    import requests
+
+    class _R:
+        def raise_for_status(self):
+            pass
+
+    monkeypatch.setattr(
+        requests, "post", lambda url, json=None, timeout=None: (
+            calls.append(json), _R())[1]
+    )
+    svc = _svc(alert_webhook="http://pager.example/hook")
+    # silence chip 0 BEFORE the first frame: only chip 1 may page
+    svc.silences.add(RULE, "s/0", 3600.0, now=__import__("time").time())
+    svc.render_frame()
+    svc.flush_webhooks()
+    by_chip = {a["chip"]: a for a in svc.last_alerts}
+    assert by_chip["s/0"]["silenced"] is True
+    assert by_chip["s/1"]["silenced"] is False
+    assert len(calls) == 1
+    assert [a["chip"] for a in calls[0]["fired"]] == ["s/1"]
+
+
+def test_silence_expiry_pages_again(monkeypatch):
+    calls = []
+
+    import requests
+
+    class _R:
+        def raise_for_status(self):
+            pass
+
+    monkeypatch.setattr(
+        requests, "post", lambda url, json=None, timeout=None: (
+            calls.append(json), _R())[1]
+    )
+    import time as _time
+
+    svc = _svc(alert_webhook="http://pager.example/hook")
+    svc.silences.add("*", "*", 0.2, now=_time.time())
+    svc.render_frame()
+    svc.flush_webhooks()
+    assert calls == []  # everything silenced: nobody paged
+    _time.sleep(0.25)
+    svc.render_frame()  # silence expired, alerts still firing → page now
+    svc.flush_webhooks()
+    assert len(calls) == 1
+    assert sorted(a["chip"] for a in calls[0]["fired"]) == ["s/0", "s/1"]
+
+
+def test_silences_persist_across_restart(tmp_path):
+    import time as _time
+
+    a = _svc(tmp_path)
+    a.render_frame()
+    a.silences.add(RULE, "s/0", 3600.0, now=_time.time())
+    a.silences.add(RULE, "s/1", 0.05, now=_time.time())
+    a.save_state()
+    _time.sleep(0.1)
+    b = _svc(tmp_path)  # restart: long silence survives, expired one gone
+    b.render_frame()
+    by_chip = {x["chip"]: x for x in b.last_alerts}
+    assert by_chip["s/0"]["silenced"] is True
+    assert by_chip["s/1"]["silenced"] is False
+    # and the UI-state keys coexist in the same checkpoint document
+    doc = json.loads((tmp_path / "state.json").read_text())
+    assert "selected" in doc and "silences" in doc
+
+
+# --- rules-YAML annotation --------------------------------------------------
+
+def test_rules_yaml_carries_silence_annotations():
+    import yaml
+
+    rules = parse_rules(f"{schema.TEMPERATURE}>90:critical@2")
+    silences = [
+        {"rule": RULE, "chip": "*", "until": 2000.0, "created": 1.0},
+        {"rule": RULE, "chip": "s/3", "until": 3000.0, "created": 1.0},
+    ]
+    text = prometheus_rules_yaml(rules, 5.0, silences=silences)
+    doc = yaml.safe_load(text)  # stays a valid rule file
+    rule = doc["groups"][0]["rules"][0]
+    assert rule["annotations"]["tpudash_silenced"] == "true"
+    assert rule["annotations"]["tpudash_silenced_until"] == "2000"
+    assert "s/3" in text  # chip-scoped silence listed in header comments
+    # no silences → no annotation
+    clean = prometheus_rules_yaml(rules, 5.0)
+    assert "tpudash_silenced" not in clean
+
+
+# --- HTTP API round-trip ----------------------------------------------------
+
+def test_silence_api_roundtrip(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        svc = _svc(tmp_path)
+        client = TestClient(TestServer(DashboardServer(svc).build_app()))
+        await client.start_server()
+        try:
+            await client.get("/api/frame")
+            r = await client.post(
+                "/api/alerts/silence",
+                json={"rule": RULE, "chip": "s/0", "ttl_s": 3600},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["silenced"]["chip"] == "s/0"
+
+            r = await client.get("/api/alerts/silences")
+            active = (await r.json())["silences"]
+            assert len(active) == 1 and active[0]["rule"] == RULE
+
+            # flag live immediately (no new scrape needed) on alerts + frame
+            alerts = (await (await client.get("/api/alerts")).json())["alerts"]
+            assert {a["chip"]: a["silenced"] for a in alerts} == {
+                "s/0": True, "s/1": False,
+            }
+            frame = await (await client.get("/api/frame")).json()
+            assert {a["chip"]: a["silenced"] for a in frame["alerts"]} == {
+                "s/0": True, "s/1": False,
+            }
+
+            # exported rules mention the silence (chip-scoped → comment)
+            text = await (await client.get("/api/alert-rules.yaml")).text()
+            assert "s/0" in text
+
+            # unsilence round-trip
+            r = await client.post(
+                "/api/alerts/unsilence", json={"rule": RULE, "chip": "s/0"}
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/alerts/unsilence", json={"rule": RULE, "chip": "s/0"}
+            )
+            assert r.status == 404  # already gone
+            alerts = (await (await client.get("/api/alerts")).json())["alerts"]
+            assert not any(a["silenced"] for a in alerts)
+
+            # validation
+            r = await client.post(
+                "/api/alerts/silence", json={"ttl_s": -5}
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(go())

@@ -192,6 +192,111 @@ class AlertEngine:
 
 
 # ---------------------------------------------------------------------------
+# Silences — the operator workflow the rules alone lack: a known-flapping
+# chip must be acknowledgeable without editing TPUDASH_ALERT_RULES and
+# restarting.  A silence scopes to (rule, chip) with "*" wildcards and a
+# TTL; silenced alerts stay visible (flagged, dimmed in the banner) but
+# never page the webhook.  When a silence expires while the alert is
+# still firing, the next frame pages — expiry is a firing transition from
+# the pager's point of view.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Silence:
+    rule: str      # rule name (AlertRule.name) or "*"
+    chip: str      # chip key or "*"
+    until: float   # epoch seconds
+    created: float
+
+    def matches(self, rule: str, chip: str) -> bool:
+        return self.rule in ("*", rule) and self.chip in ("*", chip)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "chip": self.chip,
+            "until": self.until,
+            "created": self.created,
+        }
+
+
+@dataclass
+class SilenceSet:
+    """Active alert silences with TTL expiry and wildcard matching.
+
+    Bounded: adding an exact duplicate (rule, chip) replaces the old
+    entry (the common "extend my silence" gesture), and expired entries
+    are pruned on every read."""
+
+    _silences: list = field(default_factory=list)
+    max_entries: int = 1000
+
+    def add(self, rule: str, chip: str, ttl_s: float, now: float) -> dict:
+        if ttl_s <= 0:
+            raise ValueError(f"silence ttl must be positive, got {ttl_s:g}")
+        rule, chip = rule or "*", chip or "*"
+        self._silences = [
+            s for s in self._silences if (s.rule, s.chip) != (rule, chip)
+        ]
+        if len(self._silences) >= self.max_entries:
+            raise ValueError(f"too many active silences (>{self.max_entries})")
+        s = Silence(rule=rule, chip=chip, until=now + ttl_s, created=now)
+        self._silences.append(s)
+        return s.to_dict()
+
+    def remove(self, rule: str, chip: str) -> bool:
+        """Drop the exact (rule, chip) silence; True when one existed."""
+        rule, chip = rule or "*", chip or "*"
+        before = len(self._silences)
+        self._silences = [
+            s for s in self._silences if (s.rule, s.chip) != (rule, chip)
+        ]
+        return len(self._silences) < before
+
+    def prune(self, now: float) -> None:
+        self._silences = [s for s in self._silences if s.until > now]
+
+    def active(self, now: float) -> list[dict]:
+        self.prune(now)
+        return [s.to_dict() for s in self._silences]
+
+    def is_silenced(self, rule: str, chip: str, now: float) -> bool:
+        self.prune(now)
+        return any(s.matches(rule, chip) for s in self._silences)
+
+    def annotate(self, alerts: "list[dict]", now: float) -> "list[dict]":
+        """Stamp ``silenced`` on each alert entry (in place; returned for
+        chaining).  Runs once per frame, after evaluation."""
+        self.prune(now)
+        sil = self._silences
+        for a in alerts:
+            a["silenced"] = any(s.matches(a["rule"], a["chip"]) for s in sil)
+        return alerts
+
+    # -- persistence (rides the TPUDASH_STATE_PATH checkpoint) ---------------
+    def to_dicts(self) -> list[dict]:
+        return [s.to_dict() for s in self._silences]
+
+    @classmethod
+    def from_dicts(cls, items, now: float) -> "SilenceSet":
+        out = cls()
+        try:
+            for item in items or []:
+                s = Silence(
+                    rule=str(item["rule"]),
+                    chip=str(item["chip"]),
+                    until=float(item["until"]),
+                    created=float(item.get("created", now)),
+                )
+                if s.until > now:
+                    out._silences.append(s)
+        except (KeyError, TypeError, ValueError):
+            return cls()  # corrupt checkpoint section → no silences
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Prometheus alerting-rule export — the in-app thresholds and the cluster
 # pager must agree (one rule source, two enforcement points).
 # ---------------------------------------------------------------------------
@@ -259,7 +364,9 @@ def rule_promql(rule: AlertRule) -> str:
 
 
 def prometheus_rules_yaml(
-    rules: "list[AlertRule]", refresh_interval: float = 5.0
+    rules: "list[AlertRule]",
+    refresh_interval: float = 5.0,
+    silences: "list[dict] | None" = None,
 ) -> str:
     """The engine's rules as a Prometheus alerting-rule file (YAML).
 
@@ -268,6 +375,12 @@ def prometheus_rules_yaml(
     refresh interval.  Emitted by hand (sorted keys, quoted strings) so
     the output is stable and needs no YAML dependency at runtime; the
     round-trip test parses it back with a real YAML loader.
+
+    Active in-app ``silences`` are carried as annotations: a rule
+    silenced fleet-wide (chip "*") gets ``tpudash_silenced`` +
+    ``tpudash_silenced_until`` so the Alertmanager side can see the
+    dashboard's acknowledgement; chip-scoped silences are listed in a
+    header comment (Prometheus rule files have no per-chip scope).
     """
     def _duration(seconds: float) -> str:
         # Prometheus durations take integer units only — "2.5s" rejects
@@ -278,15 +391,31 @@ def prometheus_rules_yaml(
 
     interval = max(refresh_interval, 1.0)
     interval_str = _duration(interval)
+    silences = silences or []
     lines = [
         "# Generated by tpudash — mirror of TPUDASH_ALERT_RULES so the",
         "# dashboard banner and the cluster pager fire on the same",
         "# conditions.  Load via prometheus rule_files.",
+    ]
+    chip_scoped = [s for s in silences if s["chip"] != "*"]
+    if chip_scoped:
+        lines.append(
+            "# Active chip-scoped silences in the dashboard (no per-chip"
+        )
+        lines.append("# scope in a Prometheus rule file):")
+        for s in sorted(chip_scoped, key=lambda s: (s["rule"], s["chip"])):
+            lines.append(
+                f"#   {s['rule']} on {s['chip']} until {s['until']:.0f}"
+            )
+    lines += [
         "groups:",
         "- name: tpudash",
         f"  interval: {interval_str}",
         "  rules:",
     ]
+    fleet_silenced = {
+        s["rule"]: s["until"] for s in silences if s["chip"] == "*"
+    }
     op_words = {">": "Gt", ">=": "Ge", "<": "Lt", "<=": "Le"}
     for rule in rules:
         # the in-app engine fires on the Nth consecutive breaching frame;
@@ -329,4 +458,10 @@ def prometheus_rules_yaml(
                 f"(hold {hold} at a {interval_str} cadence)'"
             ),
         ]
+        until = fleet_silenced.get(rule.name, fleet_silenced.get("*"))
+        if until is not None:
+            lines += [
+                "      tpudash_silenced: 'true'",
+                f"      tpudash_silenced_until: '{until:.0f}'",
+            ]
     return "\n".join(lines) + "\n"

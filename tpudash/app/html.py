@@ -262,7 +262,8 @@ function renderDrill(d) {
   const firing = (d.alerts || []).filter(a => a.state === 'firing');
   if (firing.length) {
     html += `<div class="drill-alerts">⚠ ` +
-      firing.map(a => esc(a.rule) + ' (=' + (+a.value) + ')').join(' · ') + '</div>';
+      firing.map(a => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
+                 ' (=' + (+a.value) + ')').join(' · ') + '</div>';
   }
   const lagging = (d.stragglers || []).filter(s => s.state === 'firing');
   if (lagging.length) {
@@ -518,14 +519,20 @@ function showError(msg) {
 
 function showAlerts(list) {
   const b = document.getElementById('alert-banner');
-  const firing = (list || []).filter(a => a.state === 'firing');
-  if (!firing.length) { b.style.display = 'none'; return; }
+  // silenced (acknowledged) alerts never drive the banner; they stay
+  // visible as a count so the acknowledgement itself is visible
+  const firing = (list || []).filter(a => a.state === 'firing' && !a.silenced);
+  const silenced = (list || []).filter(a => a.state === 'firing' && a.silenced);
+  if (!firing.length && !silenced.length) { b.style.display = 'none'; return; }
   const critical = firing.some(a => a.severity === 'critical');
-  b.className = critical ? '' : 'warning';
+  b.className = (firing.length && critical) ? '' : 'warning';
   b.style.display = 'block';
-  b.textContent = '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
-    .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
-    (firing.length > 8 ? ' \u2026' : '');
+  b.textContent = (firing.length
+    ? '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
+      .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
+      (firing.length > 8 ? ' \u2026' : '')
+    : '') +
+    (silenced.length ? ' \ud83d\udd07 ' + silenced.length + ' silenced' : '');
 }
 
 function showStragglers(list) {

@@ -291,7 +291,7 @@ class DashboardServer:
             result = fn()
             entry.state_version += 1
             if entry is self.sessions.default:
-                self.service.state.save(self.service.cfg.state_path)
+                self.service.save_state()
             return result
 
     # -- handlers ------------------------------------------------------------
@@ -621,6 +621,64 @@ class DashboardServer:
             snapshot = list(self.service.last_alerts)
         return web.json_response({"alerts": snapshot})
 
+    def _invalidate_frames(self) -> None:
+        """Global-state change (silences): every session's cached compose
+        is stale — bump all state versions (caller holds the lock)."""
+        self.sessions.invalidate_all()
+
+    async def silence_alert(self, request: web.Request) -> web.Response:
+        """POST {rule?, chip?, ttl_s} — acknowledge: silence matching
+        alerts for ttl_s seconds (rule/chip default "*" wildcards).  The
+        silence is flagged on frame/alert entries, excluded from webhook
+        paging, persisted across restart, and expires on its own — when
+        it does while the alert still fires, the pager fires then."""
+        import time as _time
+
+        try:
+            body = await request.json()
+            ttl = float(body.get("ttl_s", 3600.0))
+            rule = str(body.get("rule", "*") or "*")
+            chip = str(body.get("chip", "*") or "*")
+        except (ValueError, TypeError) as e:
+            raise web.HTTPBadRequest(text=f"bad silence request: {e}")
+        async with self._lock:
+            try:
+                entry = self.service.silences.add(rule, chip, ttl, _time.time())
+            except ValueError as e:
+                raise web.HTTPBadRequest(text=str(e))
+            # re-annotate so the flag is live on the NEXT frame/alerts read,
+            # not only after the next scrape cycle
+            self.service.silences.annotate(self.service.last_alerts, _time.time())
+            self.service.save_state()
+            self._invalidate_frames()
+        return web.json_response({"silenced": entry})
+
+    async def unsilence_alert(self, request: web.Request) -> web.Response:
+        """POST {rule?, chip?} — drop the exact (rule, chip) silence."""
+        import time as _time
+
+        try:
+            body = await request.json()
+            rule = str(body.get("rule", "*") or "*")
+            chip = str(body.get("chip", "*") or "*")
+        except (ValueError, TypeError) as e:
+            raise web.HTTPBadRequest(text=f"bad unsilence request: {e}")
+        async with self._lock:
+            removed = self.service.silences.remove(rule, chip)
+            self.service.silences.annotate(self.service.last_alerts, _time.time())
+            self.service.save_state()
+            self._invalidate_frames()
+        if not removed:
+            raise web.HTTPNotFound(text=f"no silence for {rule!r}/{chip!r}")
+        return web.json_response({"removed": {"rule": rule, "chip": chip}})
+
+    async def list_silences(self, request: web.Request) -> web.Response:
+        import time as _time
+
+        async with self._lock:
+            active = self.service.silences.active(_time.time())
+        return web.json_response({"silences": active})
+
     async def stragglers(self, request: web.Request) -> web.Response:
         """Current fleet outliers (firing + pending), worst first — the
         chips gating SPMD lockstep, named (tpudash.stragglers)."""
@@ -644,8 +702,12 @@ class DashboardServer:
             )
         from tpudash.alerts import prometheus_rules_yaml
 
+        import time as _time
+
         text = prometheus_rules_yaml(
-            engine.rules, self.service.cfg.refresh_interval
+            engine.rules,
+            self.service.cfg.refresh_interval,
+            silences=self.service.silences.active(_time.time()),
         )
         return web.Response(
             text=text,
@@ -869,6 +931,9 @@ class DashboardServer:
         app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/topology", self.topology)
         app.router.add_get("/api/alerts", self.alerts)
+        app.router.add_post("/api/alerts/silence", self.silence_alert)
+        app.router.add_post("/api/alerts/unsilence", self.unsilence_alert)
+        app.router.add_get("/api/alerts/silences", self.list_silences)
         app.router.add_get("/api/stragglers", self.stragglers)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)

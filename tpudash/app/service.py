@@ -26,6 +26,7 @@ import copy
 import datetime as _dt
 import functools
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -168,10 +169,16 @@ class DashboardService:
                 self._load_history()
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
-        from tpudash.alerts import AlertEngine
+        from tpudash.alerts import AlertEngine, SilenceSet
 
         self.alert_engine = AlertEngine.from_config(cfg)
         self.last_alerts: list[dict] = []
+        #: operator acknowledgements: (rule, chip, ttl) silences — flagged
+        #: on the frame, excluded from webhook paging, persisted in the
+        #: state checkpoint (tpudash.alerts.SilenceSet)
+        self.silences = SilenceSet()
+        if cfg.state_path:
+            self._load_silences()
         #: fleet outlier scoring every refresh (tpudash.stragglers) — the
         #: chip gating the slice's lockstep step time, named, not just
         #: visible on the heatmap
@@ -190,15 +197,55 @@ class DashboardService:
         #: flush_webhooks must wait for both
         self._webhook_threads: set = set()
 
+    def _load_silences(self) -> None:
+        """Restore alert silences from the state checkpoint (they share
+        the TPUDASH_STATE_PATH file with UI state; see save_state)."""
+        import json as _json
+
+        from tpudash.alerts import SilenceSet
+
+        try:
+            with open(self.cfg.state_path) as f:
+                data = _json.load(f)
+            items = data.get("silences", []) if isinstance(data, dict) else []
+        except (OSError, ValueError):
+            return
+        self.silences = SilenceSet.from_dicts(items, time.time())
+
+    def save_state(self) -> None:
+        """Persist the composite state checkpoint: the anonymous default
+        session's UI state plus active alert silences, atomically.  One
+        file (cfg.state_path), one writer — SelectionState.save wrote only
+        its own keys and would drop the rest."""
+        path = self.cfg.state_path
+        if not path:
+            return
+        import json as _json
+        import tempfile
+
+        doc = self.state.to_dict()
+        doc["silences"] = self.silences.to_dicts()
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
+            with os.fdopen(fd, "w") as f:
+                _json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("could not persist state to %s: %s", path, e)
+
     def _notify_alert_transitions(self) -> None:
         """POST newly-firing and resolved alerts to Config.alert_webhook
         (the pager integration the reference's error banner couldn't be).
         Transition-edge only — a steadily-firing alert posts once.
+        Silenced alerts never enter the firing set, so an acknowledged
+        chip stops paging immediately — and a silence expiring while the
+        alert still fires IS a firing transition (it pages again).
         Delivery is best-effort: failures log and never fail the frame."""
         firing = {
             (a["rule"], a["chip"]): a
             for a in self.last_alerts
-            if a["state"] == "firing"
+            if a["state"] == "firing" and not a.get("silenced")
         }
         fired = [firing[k] for k in firing.keys() - self._firing_keys]
         resolved = sorted(self._firing_keys - firing.keys())
@@ -1143,7 +1190,9 @@ class DashboardService:
         self.available = keys
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
-                self.last_alerts = self.alert_engine.evaluate(df)
+                self.last_alerts = self.silences.annotate(
+                    self.alert_engine.evaluate(df), time.time()
+                )
             self._notify_alert_transitions()
         # Fleet-wide trend history, one point per refresh interval (burst
         # renders from selection POSTs must not pollute the cadence).

@@ -114,6 +114,13 @@ class SessionStore:
         e.last_seen = now
         return e
 
+    def invalidate_all(self) -> None:
+        """Bump every session's state version — global state (e.g. alert
+        silences) changed, so every cached compose is stale."""
+        self.default.state_version += 1
+        for e in self._entries.values():
+            e.state_version += 1
+
     def _evict(self, now: float) -> None:
         # LRU order == insertion-after-move_to_end order, so TTL-expired
         # entries cluster at the front; stop at the first live one
