@@ -81,6 +81,16 @@ class DashboardServer:
             limit=service.cfg.session_limit,
             ttl=service.cfg.session_ttl,
         )
+        # per-browser sessions ride the TPUDASH_STATE_PATH checkpoint: a
+        # dashboard restart must not log every viewer out of their
+        # selection (the reference's refresh-resets-state flaw, SURVEY §5)
+        service.sessions_snapshot = self.sessions.to_dicts
+        if service.cfg.state_path:
+            restored = self.sessions.restore(
+                self._read_state_section("sessions")
+            )
+            if restored:
+                log.info("restored %d browser sessions", restored)
         #: bumped after every refresh_data(); pairs with each session's
         #: state_version to key the per-session compose caches
         self._data_version = 0
@@ -93,6 +103,14 @@ class DashboardServer:
         self._refresh_task = None
         self._refresh_started: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
+
+    def _read_state_section(self, key: str):
+        try:
+            with open(self.service.cfg.state_path) as f:
+                doc = json.load(f)
+            return doc.get(key, {}) if isinstance(doc, dict) else {}
+        except (OSError, ValueError):
+            return {}
 
     def _entry(self, request: web.Request) -> SessionEntry:
         return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
@@ -284,14 +302,14 @@ class DashboardServer:
         """Run a state mutation under the frame lock: service renders on
         the worker thread only while the lock is held, so mutations are
         serialized against frame builds (no torn selection lists).  Bumps
-        the session's state version (cache invalidation).  Only the
-        anonymous default session persists to disk — per-browser sessions
-        are ephemeral like the reference's (SURVEY §5)."""
+        the session's state version (cache invalidation) and persists the
+        checkpoint — per-browser sessions ride it too, so a restart keeps
+        every viewer's selection (the reference resets on refresh,
+        SURVEY §5)."""
         async with self._lock:
             result = fn()
             entry.state_version += 1
-            if entry is self.sessions.default:
-                self.service.save_state()
+            self.service.save_state()
             return result
 
     # -- handlers ------------------------------------------------------------
@@ -945,6 +963,14 @@ class DashboardServer:
                 await loop.run_in_executor(None, self.service.save_history)
 
             app.on_cleanup.append(_save_history)
+        if self.service.cfg.state_path:
+            # final state snapshot (sessions idle since their last
+            # mutation would otherwise persist stale idle ages)
+            async def _save_state(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.save_state)
+
+            app.on_cleanup.append(_save_state)
         return app
 
 

@@ -177,6 +177,10 @@ class DashboardService:
         #: on the frame, excluded from webhook paging, persisted in the
         #: state checkpoint (tpudash.alerts.SilenceSet)
         self.silences = SilenceSet()
+        #: set by DashboardServer: () -> dict of per-browser session state
+        #: to ride the state checkpoint (the service owns the file, the
+        #: server owns the sessions)
+        self.sessions_snapshot: "object | None" = None
         if cfg.state_path:
             self._load_silences()
         #: fleet outlier scoring every refresh (tpudash.stragglers) — the
@@ -214,9 +218,11 @@ class DashboardService:
 
     def save_state(self) -> None:
         """Persist the composite state checkpoint: the anonymous default
-        session's UI state plus active alert silences, atomically.  One
-        file (cfg.state_path), one writer — SelectionState.save wrote only
-        its own keys and would drop the rest."""
+        session's UI state, active alert silences, and (when the server
+        registered its provider) the per-browser cookie-session map —
+        atomically.  One file (cfg.state_path), one writer —
+        SelectionState.save wrote only its own keys and would drop the
+        rest."""
         path = self.cfg.state_path
         if not path:
             return
@@ -225,6 +231,11 @@ class DashboardService:
 
         doc = self.state.to_dict()
         doc["silences"] = self.silences.to_dicts()
+        if self.sessions_snapshot is not None:
+            try:
+                doc["sessions"] = self.sessions_snapshot()
+            except Exception as e:  # noqa: BLE001 — sessions are best-effort
+                log.warning("session snapshot failed: %s", e)
         try:
             d = os.path.dirname(os.path.abspath(path))
             fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")

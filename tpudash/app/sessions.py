@@ -26,7 +26,7 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 
-from tpudash.app.state import SelectionState
+from tpudash.app.state import SelectionState, _sort_key
 
 
 class SessionEntry:
@@ -120,6 +120,57 @@ class SessionStore:
         self.default.state_version += 1
         for e in self._entries.values():
             e.state_version += 1
+
+    # -- persistence (rides the TPUDASH_STATE_PATH checkpoint) ---------------
+    def to_dicts(self) -> dict:
+        """sid → persisted UI state + idle age.  ``last_seen`` uses a
+        monotonic clock that does not survive restarts, so the AGE is
+        persisted and re-anchored on restore — TTL eviction continues
+        across the restart instead of resetting."""
+        now = self._clock()
+        return {
+            sid: dict(e.state.to_dict(), idle_s=round(now - e.last_seen, 1))
+            for sid, e in self._entries.items()
+        }
+
+    def restore(self, data: dict) -> int:
+        """Recreate sessions from a checkpoint section (bounded by the
+        store's own limit, already-TTL-expired entries skipped, corrupt
+        entries ignored).  Returns the number restored."""
+        if not isinstance(data, dict):
+            return 0
+        now = self._clock()
+        restored = 0
+        # most-recently-seen last, so LRU trimming keeps the freshest
+        items = sorted(
+            (
+                (sid, e)
+                for sid, e in data.items()
+                if isinstance(e, dict)
+            ),
+            key=lambda kv: -float(kv[1].get("idle_s", 0.0)),
+        )
+        for sid, item in items[-self.limit:]:
+            try:
+                idle = float(item.get("idle_s", 0.0))
+                if idle >= self.ttl:
+                    continue
+                state = SelectionState()
+                state.selected = sorted(
+                    (str(k) for k in item.get("selected", [])),
+                    key=_sort_key,
+                )
+                state.use_gauge = bool(item.get("use_gauge", True))
+                state.last_selection = [
+                    str(k) for k in item.get("last_selection", [])
+                ]
+                state._initialized = True
+                e = self._entries[str(sid)] = SessionEntry(state)
+                e.last_seen = now - idle
+                restored += 1
+            except (TypeError, ValueError):
+                continue
+        return restored
 
     def _evict(self, now: float) -> None:
         # LRU order == insertion-after-move_to_end order, so TTL-expired
