@@ -165,3 +165,137 @@ def test_recording_write_failure_degrades_not_fails(tmp_path, monkeypatch, caplo
     fail["on"] = False
     assert src.fetch()
     assert path.read_text().count("\n") == 2  # healthy appends resumed
+
+
+# --- time-travel: seek / pause / scrub API (VERDICT r3 #8) -------------------
+
+SAMPLE = os.path.join(
+    os.path.dirname(__file__), os.pardir, "examples", "sample-recording.jsonl"
+)
+
+
+def test_replay_indexes_timestamps():
+    replay = FileReplaySource(SAMPLE)
+    assert len(replay.timestamps) == len(replay) == 6
+    assert replay.timestamps == sorted(replay.timestamps)
+    assert replay.timestamps[0] == 1753790000.0
+
+
+def test_seek_by_index_and_position():
+    replay = FileReplaySource(SAMPLE)
+    assert replay.position()["index"] is None  # nothing served yet
+    replay.fetch()
+    assert replay.position()["index"] == 0
+    assert replay.seek(index=4) == 4
+    replay.fetch()
+    pos = replay.position()
+    assert pos["index"] == 4 and pos["ts"] == replay.timestamps[4]
+    # clamping
+    assert replay.seek(index=999) == 5
+    assert replay.seek(index=-3) == 0
+
+
+def test_seek_by_timestamp():
+    replay = FileReplaySource(SAMPLE)
+    ts = replay.timestamps
+    # exact hit, mid-gap (latest at-or-before), before-start, past-end
+    assert replay.seek(ts=ts[2]) == 2
+    assert replay.seek(ts=ts[2] + (ts[3] - ts[2]) / 2) == 2
+    assert replay.seek(ts=ts[0] - 100.0) == 0
+    assert replay.seek(ts=ts[-1] + 100.0) == 5
+    with pytest.raises(ValueError):
+        replay.seek()
+
+
+def test_paused_holds_the_current_snapshot():
+    replay = FileReplaySource(SAMPLE)
+    replay.fetch()
+    replay.paused = True
+    a = replay.fetch()
+    b = replay.fetch()
+    assert replay.position()["index"] == 0
+    assert to_wide(a).equals(to_wide(b))
+    # a seek while paused moves the held position
+    replay.seek(index=3)
+    replay.fetch()
+    assert replay.position()["index"] == 3
+    replay.paused = False
+    replay.fetch()
+    assert replay.position()["index"] == 4
+
+
+def test_replay_scrub_api(tmp_path):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        cfg = load_config(
+            {
+                "TPUDASH_SOURCE": "replay",
+                "TPUDASH_REPLAY_PATH": SAMPLE,
+                "TPUDASH_REFRESH_INTERVAL": "0",
+            }
+        )
+        svc = DashboardService(cfg, make_source(cfg))
+        client = TestClient(TestServer(DashboardServer(svc).build_app()))
+        await client.start_server()
+        try:
+            await client.get("/api/frame")
+            pos = await (await client.get("/api/replay")).json()
+            assert pos["total"] == 6 and pos["index"] == 0
+
+            # seek by index, paused: the frame re-renders from snapshot 4
+            r = await client.post(
+                "/api/replay", json={"index": 4, "paused": True}
+            )
+            pos = await r.json()
+            assert pos["index"] == 4 and pos["paused"] is True
+            frame = await (await client.get("/api/frame")).json()
+            assert frame["error"] is None
+            # held: further frames stay on snapshot 4
+            await client.get("/api/frame")
+            pos = await (await client.get("/api/replay")).json()
+            assert pos["index"] == 4
+
+            # seek by recorded timestamp
+            r = await client.post("/api/replay", json={"t": pos["ts_first"]})
+            assert (await r.json())["index"] == 0
+
+            # resume advances again
+            await client.post("/api/replay", json={"paused": False})
+            await client.get("/api/frame")
+
+            # validation
+            assert (
+                await client.post("/api/replay", json={"index": "xyz"})
+            ).status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_replay_api_404_for_live_sources():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        cfg = Config(source="synthetic", synthetic_chips=4, refresh_interval=0.0)
+        svc = DashboardService(cfg, SyntheticSource(num_chips=4))
+        client = TestClient(TestServer(DashboardServer(svc).build_app()))
+        await client.start_server()
+        try:
+            assert (await client.get("/api/replay")).status == 404
+            assert (
+                await client.post("/api/replay", json={"index": 0})
+            ).status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(go())

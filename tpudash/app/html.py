@@ -88,6 +88,7 @@ PAGE = r"""<!DOCTYPE html>
     <span class="hint">click a heatmap cell for chip detail &middot; shift-click toggles selection</span>
   </div>
   <div id="chip-grid"></div>
+  <div id="replay-bar" style="display:none"></div>
   <div id="drill"></div>
   <div id="panels"></div>
   <div class="row-title">Statistics (selected chips)</div>
@@ -427,6 +428,7 @@ function applyFrame(frame) {
   renderBreakdown(frame.breakdown, frame.panel_specs);
   showPanelGaps(frame.unavailable_panels);
   if (drillKey) refreshDrill();  // keep the open chip detail live
+  if (replayActive) pollReplay();  // keep the scrub position current
   const t = frame.timings || {};
   document.getElementById('debug').textContent =
     'Debug: frames=' + (t.frames || 0) +
@@ -510,6 +512,58 @@ document.getElementById('select-all').addEventListener('click',
   () => post('/api/select', {all: true}));
 document.getElementById('select-none').addEventListener('click',
   () => post('/api/select', {none: true}));
+
+// ---- replay time-travel (source=replay only) ------------------------------
+// A recorded incident can be scrubbed back and forth: the bar appears when
+// /api/replay answers, the slider seeks by snapshot index, pause holds the
+// current snapshot instead of auto-advancing.
+let replayActive = false;
+
+function renderReplayPosition(pos) {
+  const bar = document.getElementById('replay-bar');
+  bar.style.display = 'block';
+  if (!bar.dataset.built) {
+    bar.dataset.built = '1';
+    bar.innerHTML = '<span class="row-title">Replay</span> ' +
+      '<button id="replay-pause"></button> ' +
+      '<input id="replay-slider" type="range" min="0" step="1" ' +
+      'style="width: 40%; vertical-align: middle"> ' +
+      '<span id="replay-label" class="hint"></span>';
+    document.getElementById('replay-slider').addEventListener('change',
+      async e => {
+        const r = await fetch('/api/replay', {method: 'POST',
+          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+          body: JSON.stringify({index: +e.target.value, paused: true})});
+        if (r.ok) { renderReplayPosition(await r.json()); refresh(); }
+      });
+    document.getElementById('replay-pause').addEventListener('click',
+      async () => {
+        const r = await fetch('/api/replay', {method: 'POST',
+          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+          body: JSON.stringify({paused: !replayPaused})});
+        if (r.ok) renderReplayPosition(await r.json());
+      });
+  }
+  replayPaused = pos.paused;
+  const slider = document.getElementById('replay-slider');
+  slider.max = pos.total - 1;
+  if (pos.index !== null && document.activeElement !== slider) slider.value = pos.index;
+  document.getElementById('replay-pause').textContent = pos.paused ? '▶ resume' : '⏸ pause';
+  document.getElementById('replay-label').textContent =
+    (pos.index === null ? '—' : (pos.index + 1)) + '/' + pos.total +
+    (pos.ts ? ' · ' + new Date(pos.ts * 1000).toLocaleTimeString() : '');
+}
+let replayPaused = false;
+
+async function pollReplay() {
+  try {
+    const r = await fetch('/api/replay', {headers: authHeaders()});
+    if (!r.ok) { replayActive = false; return; }
+    replayActive = true;
+    renderReplayPosition(await r.json());
+  } catch (e) { /* transient */ }
+}
+pollReplay();
 
 function showError(msg) {
   const b = document.getElementById('error-banner');

@@ -697,6 +697,58 @@ class DashboardServer:
             active = self.service.silences.active(_time.time())
         return web.json_response({"silences": active})
 
+    def _replay_source(self):
+        """The FileReplaySource under the retry/recording wrappers, or
+        None when the dashboard is not replaying a recording."""
+        from tpudash.sources.recorder import FileReplaySource
+
+        src, hops = self.service.source, 0
+        while src is not None and hops < 8:
+            if isinstance(src, FileReplaySource):
+                return src
+            src = getattr(src, "inner", None)
+            hops += 1
+        return None
+
+    async def replay_status(self, request: web.Request) -> web.Response:
+        """Scrub-control state: current index/ts + recording bounds.
+        404 when the active source is not a recording replay."""
+        replay = self._replay_source()
+        if replay is None:
+            raise web.HTTPNotFound(text="not replaying a recording")
+        async with self._lock:
+            return web.json_response(replay.position())
+
+    async def replay_seek(self, request: web.Request) -> web.Response:
+        """POST {index} | {t} | {paused} — time-travel an incident
+        recording: seek to a snapshot (by index or recorded epoch
+        timestamp), optionally pause auto-advance (scrub mode), and
+        re-render immediately from the sought snapshot."""
+        replay = self._replay_source()
+        if replay is None:
+            raise web.HTTPNotFound(text="not replaying a recording")
+        try:
+            body = await request.json()
+            index = body.get("index")
+            t = body.get("t")
+            paused = body.get("paused")
+        except (ValueError, TypeError) as e:
+            raise web.HTTPBadRequest(text=f"bad replay request: {e}")
+        async with self._lock:
+            if paused is not None:
+                replay.paused = bool(paused)
+            if index is not None or t is not None:
+                try:
+                    replay.seek(
+                        index=int(index) if index is not None else None,
+                        ts=float(t) if t is not None else None,
+                    )
+                except (TypeError, ValueError) as e:
+                    raise web.HTTPBadRequest(text=f"bad seek: {e}")
+                # serve the sought snapshot NOW, not an interval later
+                await self._refresh_locked(force=True)
+            return web.json_response(replay.position())
+
     async def stragglers(self, request: web.Request) -> web.Response:
         """Current fleet outliers (firing + pending), worst first — the
         chips gating SPMD lockstep, named (tpudash.stragglers)."""
@@ -953,6 +1005,8 @@ class DashboardServer:
         app.router.add_post("/api/alerts/unsilence", self.unsilence_alert)
         app.router.add_get("/api/alerts/silences", self.list_silences)
         app.router.add_get("/api/stragglers", self.stragglers)
+        app.router.add_get("/api/replay", self.replay_status)
+        app.router.add_post("/api/replay", self.replay_seek)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
         if self.service.cfg.history_path:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import time
 
 from tpudash.schema import SampleBatch
@@ -81,42 +82,94 @@ class RecordingSource(MetricsSource):
 class FileReplaySource(MetricsSource):
     """Replay a RecordingSource JSONL, one snapshot per fetch.
 
-    Only byte offsets are kept resident (a day-long 256-chip recording is
-    gigabytes of exposition text — ~200 KB per snapshot); each fetch seeks
-    and parses ONE line, so memory stays O(1) in recording length."""
+    Only byte offsets and timestamps are kept resident (a day-long
+    256-chip recording is gigabytes of exposition text — ~200 KB per
+    snapshot); each fetch seeks and parses ONE line, so memory stays O(1)
+    in recording length.
+
+    Time travel: :meth:`seek` jumps to an index or a recorded timestamp
+    and :attr:`paused` holds the current snapshot instead of advancing —
+    the ``/api/replay`` scrub API steps an incident recording back and
+    forth, the post-mortem tool a live-only dashboard can never be."""
 
     name = "replay-file"
+
+    #: recorder lines start '{"ts": <float>, ...' (json.dumps key order);
+    #: indexing reads only this prefix, never the ~200 KB text field
+    _TS_RE = re.compile(rb'^\{"ts":\s*([0-9.eE+-]+)')
 
     def __init__(self, path: str, loop: bool = True):
         if not path:
             raise SourceError("replay source requires TPUDASH_REPLAY_PATH")
         self.path = path
         offsets = []
+        timestamps = []
         try:
             with open(path, "rb") as f:
                 pos = 0
                 for line in f:
                     if line.strip():
                         offsets.append(pos)
+                        m = self._TS_RE.match(line[:64])
+                        try:
+                            timestamps.append(float(m.group(1)) if m else 0.0)
+                        except ValueError:
+                            timestamps.append(0.0)
                     pos += len(line)
         except OSError as e:
             raise SourceError(f"cannot open recording {path!r}: {e}") from e
         if not offsets:
             raise SourceError(f"recording {path!r} holds no snapshots")
         self.offsets = offsets
+        self.timestamps = timestamps
         self.loop = loop
         self._i = 0
+        self._last: "int | None" = None
+        #: hold the current snapshot instead of advancing (scrub mode)
+        self.paused = False
 
     def __len__(self) -> int:
         return len(self.offsets)
 
+    def seek(self, index: "int | None" = None, ts: "float | None" = None) -> int:
+        """Jump so the NEXT fetch serves ``index``, or the latest snapshot
+        at-or-before ``ts`` (epoch; before-the-start clamps to 0).  Returns
+        the target index."""
+        if index is None and ts is None:
+            raise ValueError("seek needs index or ts")
+        if index is None:
+            import bisect
+
+            index = max(0, bisect.bisect_right(self.timestamps, float(ts)) - 1)
+        index = max(0, min(int(index), len(self.offsets) - 1))
+        self._i = index
+        self._last = None  # even when paused, serve the seek target next
+        return index
+
+    def position(self) -> dict:
+        """Where the scrub control sits: last-served index/ts + bounds."""
+        cur = self._last
+        return {
+            "index": cur,
+            "ts": self.timestamps[cur] if cur is not None else None,
+            "total": len(self.offsets),
+            "ts_first": self.timestamps[0],
+            "ts_last": self.timestamps[-1],
+            "loop": self.loop,
+            "paused": self.paused,
+        }
+
     def fetch(self):
-        if self._i >= len(self.offsets):
-            if not self.loop:
-                raise SourceError("recording exhausted")
-            self._i = 0
-        idx = self._i
-        self._i += 1
+        if self.paused and self._last is not None:
+            idx = self._last  # hold: re-serve the current snapshot
+        else:
+            if self._i >= len(self.offsets):
+                if not self.loop:
+                    raise SourceError("recording exhausted")
+                self._i = 0
+            idx = self._i
+            self._i = idx + 1
+        self._last = idx
         try:
             with open(self.path, "rb") as f:
                 f.seek(self.offsets[idx])
