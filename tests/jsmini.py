@@ -1,0 +1,495 @@
+"""Mini JavaScript interpreter for the transpiler's OUTPUT grammar.
+
+No JS engine exists in this image, but the generated client JS
+(tpudash/app/pyjs.py) is machine-written in a tiny, fixed shape — so a
+few hundred lines can parse and EXECUTE it with real JS semantics
+(block-scoped let, `k in obj` key test, delete, === identity on
+primitives).  tests/test_client_parity.py runs the fuzz corpus through
+this interpreter over the ACTUAL generated text: a transpiler bug that
+emitted wrong-but-well-formed JS would surface here, not in a browser.
+
+Supported grammar (everything transpile_functions can emit):
+  function NAME(params) { ... }      let a, b;          x = expr;
+  for (i = 0; i < e; i++) { }        for (x of expr) { }
+  if (cond) { } else { }             delete a[b];       return expr;
+  calls, [..] , {..}, ===, !==, <, <=, >, >=, &&, ||, !, + - * /,
+  member access a[b], a.length, string/number/bool/null literals
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class JsError(Exception):
+    pass
+
+
+_TOKEN = re.compile(
+    r"""
+    (?P<ws>\s+|//[^\n]*)
+  | (?P<num>\d+\.\d+|\d+)
+  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<name>[A-Za-z_$][A-Za-z0-9_$]*)
+  | (?P<punct>===|!==|==|!=|<=|>=|&&|\|\||\+\+|[{}()\[\];:,=<>!+\-*/.])
+    """,
+    re.VERBOSE,
+)
+
+
+def tokenize(src: str):
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if not m:
+            raise JsError(f"lex error at {src[pos:pos + 30]!r}")
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        out.append((m.lastgroup, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+class Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self, k=0):
+        return self.toks[self.i + k]
+
+    def next(self):
+        tok = self.toks[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, value):
+        kind, text = self.next()
+        if text != value:
+            raise JsError(f"expected {value!r}, got {text!r}")
+        return text
+
+    # -- program: a sequence of function declarations ------------------------
+    def program(self):
+        fns = {}
+        while self.peek()[0] != "eof":
+            self.expect("function")
+            name = self.next()[1]
+            self.expect("(")
+            params = []
+            while self.peek()[1] != ")":
+                params.append(self.next()[1])
+                if self.peek()[1] == ",":
+                    self.next()
+            self.expect(")")
+            fns[name] = (params, self.block())
+        return fns
+
+    def block(self):
+        self.expect("{")
+        stmts = []
+        while self.peek()[1] != "}":
+            stmts.append(self.statement())
+        self.expect("}")
+        return stmts
+
+    def statement(self):
+        kind, text = self.peek()
+        if text == "let":
+            self.next()
+            names = [self.next()[1]]
+            while self.peek()[1] == ",":
+                self.next()
+                names.append(self.next()[1])
+            self.expect(";")
+            return ("let", names)
+        if text == "return":
+            self.next()
+            if self.peek()[1] == ";":
+                self.next()
+                return ("return", None)
+            e = self.expr()
+            self.expect(";")
+            return ("return", e)
+        if text == "delete":
+            self.next()
+            e = self.expr()
+            self.expect(";")
+            if e[0] != "index":
+                raise JsError("delete target must be a[b]")
+            return ("delete", e)
+        if text == "if":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            body = self.block()
+            orelse = []
+            if self.peek()[1] == "else":
+                self.next()
+                orelse = self.block()
+            return ("if", cond, body, orelse)
+        if text == "for":
+            self.next()
+            self.expect("(")
+            # counted:  i = 0; i < e; i++   |   for-of:  x of expr
+            if self.peek(1)[1] == "of":
+                var = self.next()[1]
+                self.next()  # of
+                it = self.expr()
+                self.expect(")")
+                return ("forof", var, it, self.block())
+            var = self.next()[1]
+            self.expect("=")
+            start = self.expr()
+            self.expect(";")
+            cond = self.expr()
+            self.expect(";")
+            if self.next()[1] != var:
+                raise JsError("counted loop must increment its own var")
+            self.expect("++")
+            self.expect(")")
+            return ("for", var, start, cond, self.block())
+        if text == ";":
+            self.next()
+            return ("nop",)
+        # expression statement: assignment or call
+        e = self.expr()
+        if self.peek()[1] == "=":
+            self.next()
+            value = self.expr()
+            self.expect(";")
+            if e[0] not in ("name", "index"):
+                raise JsError(f"bad assignment target {e[0]}")
+            return ("assign", e, value)
+        self.expect(";")
+        return ("exprstmt", e)
+
+    # -- expressions (precedence: || < && < cmp < add < mul < unary) ---------
+    def expr(self):
+        return self.or_()
+
+    def or_(self):
+        left = self.and_()
+        while self.peek()[1] == "||":
+            self.next()
+            left = ("or", left, self.and_())
+        return left
+
+    def and_(self):
+        left = self.cmp()
+        while self.peek()[1] == "&&":
+            self.next()
+            left = ("and", left, self.cmp())
+        return left
+
+    def cmp(self):
+        left = self.add()
+        while self.peek()[1] in ("===", "!==", "<", "<=", ">", ">=", "in",
+                                 "==", "!="):
+            op = self.next()[1]
+            left = ("cmp", op, left, self.add())
+        return left
+
+    def add(self):
+        left = self.mul()
+        while self.peek()[1] in ("+", "-"):
+            op = self.next()[1]
+            left = ("bin", op, left, self.mul())
+        return left
+
+    def mul(self):
+        left = self.unary()
+        while self.peek()[1] in ("*", "/"):
+            op = self.next()[1]
+            left = ("bin", op, left, self.unary())
+        return left
+
+    def unary(self):
+        if self.peek()[1] == "!":
+            self.next()
+            return ("not", self.unary())
+        if self.peek()[1] == "-":
+            self.next()
+            return ("neg", self.unary())
+        return self.postfix()
+
+    def postfix(self):
+        e = self.primary()
+        while True:
+            kind, text = self.peek()
+            if text == "[":
+                self.next()
+                idx = self.expr()
+                self.expect("]")
+                e = ("index", e, idx)
+            elif text == ".":
+                self.next()
+                prop = self.next()[1]
+                e = ("member", e, prop)
+            elif text == "(":
+                self.next()
+                args = []
+                while self.peek()[1] != ")":
+                    args.append(self.expr())
+                    if self.peek()[1] == ",":
+                        self.next()
+                self.expect(")")
+                e = ("call", e, args)
+            else:
+                return e
+
+    def primary(self):
+        kind, text = self.next()
+        if kind == "num":
+            return ("lit", float(text) if "." in text else int(text))
+        if kind == "str":
+            import json
+
+            return ("lit", json.loads(text))
+        if text == "(":
+            e = self.expr()
+            self.expect(")")
+            return e
+        if text == "[":
+            elts = []
+            while self.peek()[1] != "]":
+                elts.append(self.expr())
+                if self.peek()[1] == ",":
+                    self.next()
+            self.expect("]")
+            return ("array", elts)
+        if text == "{":
+            pairs = []
+            while self.peek()[1] != "}":
+                k = self.next()
+                if k[0] == "str":
+                    import json
+
+                    key = json.loads(k[1])
+                else:
+                    key = k[1]
+                self.expect(":")
+                pairs.append((key, self.expr()))
+                if self.peek()[1] == ",":
+                    self.next()
+            self.expect("}")
+            return ("object", pairs)
+        if kind == "name":
+            if text == "null":
+                return ("lit", None)
+            if text == "true":
+                return ("lit", True)
+            if text == "false":
+                return ("lit", False)
+            return ("name", text)
+        raise JsError(f"unexpected token {text!r}")
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+#: distinct sentinel: JS `undefined` (missing key) vs JSON null
+UNDEFINED = object()
+
+
+class Interp:
+    """Executes the parsed functions over plain Python dict/list data
+    (the JSON domain both languages share)."""
+
+    def __init__(self, fns):
+        self.fns = fns
+
+    def call(self, name, *args):
+        if name not in self.fns:
+            raise JsError(f"unknown function {name}")
+        params, body = self.fns[name]
+        scope = dict(zip(params, args))
+        try:
+            self.run_block(body, scope)
+        except _Return as r:
+            return r.value
+        return UNDEFINED
+
+    def run_block(self, stmts, scope):
+        for s in stmts:
+            self.run(s, scope)
+
+    def run(self, s, scope):
+        op = s[0]
+        if op == "let":
+            for n in s[1]:
+                scope.setdefault(n, UNDEFINED)
+        elif op == "assign":
+            target, value = s[1], self.eval(s[2], scope)
+            if target[0] == "name":
+                scope[target[1]] = value
+            else:
+                obj = self.eval(target[1], scope)
+                idx = self.eval(target[2], scope)
+                if isinstance(obj, list):
+                    obj[int(idx)] = value
+                else:
+                    obj[idx] = value
+        elif op == "delete":
+            obj = self.eval(s[1][1], scope)
+            idx = self.eval(s[1][2], scope)
+            if isinstance(obj, dict):
+                obj.pop(idx, None)
+            else:
+                raise JsError("delete on non-object")
+        elif op == "return":
+            raise _Return(None if s[1] is None else self.eval(s[1], scope))
+        elif op == "if":
+            if self.truthy(self.eval(s[1], scope)):
+                self.run_block(s[2], scope)
+            else:
+                self.run_block(s[3], scope)
+        elif op == "for":
+            _, var, start, cond, body = s
+            scope[var] = self.eval(start, scope)
+            while self.truthy(self.eval(cond, scope)):
+                self.run_block(body, scope)
+                scope[var] = scope[var] + 1
+        elif op == "forof":
+            _, var, it, body = s
+            seq = self.eval(it, scope)
+            if not isinstance(seq, list):
+                raise JsError("for-of over non-array")
+            for v in seq:
+                scope[var] = v
+                self.run_block(body, scope)
+        elif op == "exprstmt":
+            self.eval(s[1], scope)
+        elif op == "nop":
+            pass
+        else:
+            raise JsError(f"unknown statement {op}")
+
+    def truthy(self, v):
+        # JS truthiness over the JSON domain (the generated code only
+        # ever tests booleans, but be faithful anyway)
+        if v is UNDEFINED or v is None or v is False:
+            return False
+        if v is True:
+            return True
+        if isinstance(v, (int, float)):
+            return v != 0
+        if isinstance(v, str):
+            return v != ""
+        return True  # objects and arrays are always truthy in JS
+
+    def eval(self, e, scope):
+        op = e[0]
+        if op == "lit":
+            return e[1]
+        if op == "name":
+            if e[1] in scope:
+                return scope[e[1]]
+            if e[1] in self.fns:
+                return ("__fn__", e[1])
+            raise JsError(f"undefined name {e[1]}")
+        if op == "array":
+            return [self.eval(x, scope) for x in e[1]]
+        if op == "object":
+            return {k: self.eval(v, scope) for k, v in e[1]}
+        if op == "index":
+            obj = self.eval(e[1], scope)
+            idx = self.eval(e[2], scope)
+            if isinstance(obj, list):
+                i = int(idx)
+                return obj[i] if 0 <= i < len(obj) else UNDEFINED
+            if isinstance(obj, dict):
+                return obj.get(idx, UNDEFINED)
+            raise JsError(f"index into {type(obj).__name__}")
+        if op == "member":
+            obj = self.eval(e[1], scope)
+            if e[2] == "length":
+                if isinstance(obj, (list, str)):
+                    return len(obj)
+                raise JsError(".length on non-array")
+            if isinstance(obj, dict):
+                return obj.get(e[2], UNDEFINED)
+            raise JsError(f"member {e[2]} on {type(obj).__name__}")
+        if op == "call":
+            # Array.prototype.push — the one method the transpiler emits
+            if e[1][0] == "member" and e[1][2] == "push":
+                obj = self.eval(e[1][1], scope)
+                if not isinstance(obj, list):
+                    raise JsError(".push on non-array")
+                for a in e[2]:
+                    obj.append(self.eval(a, scope))
+                return len(obj)
+            fn = self.eval(e[1], scope)
+            if not (isinstance(fn, tuple) and fn[0] == "__fn__"):
+                raise JsError("call of non-function")
+            return self.call(fn[1], *(self.eval(a, scope) for a in e[2]))
+        if op == "cmp":
+            _, cop, left_e, right_e = e
+            left, right = self.eval(left_e, scope), self.eval(right_e, scope)
+            if cop == "in":
+                if not isinstance(right, dict):
+                    raise JsError("`in` on non-object")
+                return left in right
+            if cop == "===":
+                return self._strict_eq(left, right)
+            if cop == "!==":
+                return not self._strict_eq(left, right)
+            if cop in ("==", "!="):
+                # loose equality is only ever emitted for null checks
+                # (`x != null`), where null and undefined compare equal
+                if (left in (None, UNDEFINED)) or (right in (None, UNDEFINED)):
+                    eq = left in (None, UNDEFINED) and right in (None, UNDEFINED)
+                else:
+                    eq = self._strict_eq(left, right)
+                return eq if cop == "==" else not eq
+            if left is UNDEFINED or right is UNDEFINED:
+                return False  # NaN-like comparisons
+            return {
+                "<": left < right, "<=": left <= right,
+                ">": left > right, ">=": left >= right,
+            }[cop]
+        if op == "and":
+            left = self.eval(e[1], scope)
+            return self.eval(e[2], scope) if self.truthy(left) else left
+        if op == "or":
+            left = self.eval(e[1], scope)
+            return left if self.truthy(left) else self.eval(e[2], scope)
+        if op == "not":
+            return not self.truthy(self.eval(e[1], scope))
+        if op == "neg":
+            return -self.eval(e[1], scope)
+        if op == "bin":
+            _, bop, left_e, right_e = e
+            left, right = self.eval(left_e, scope), self.eval(right_e, scope)
+            return {
+                "+": lambda: left + right,
+                "-": lambda: left - right,
+                "*": lambda: left * right,
+                "/": lambda: left / right,
+            }[bop]()
+        raise JsError(f"unknown expression {op}")
+
+    def _strict_eq(self, a, b) -> bool:
+        """JS === over the JSON domain: no type coercion, and crucially
+        1 === 1.0 and true !== 1 (Python's == says True == 1)."""
+        if a is UNDEFINED or b is UNDEFINED:
+            return a is b
+        if isinstance(a, bool) or isinstance(b, bool):
+            return a is b
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            return float(a) == float(b)
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, (dict, list)):
+            return a is b  # reference identity, like JS
+        return a == b
+
+
+def run_js(source: str):
+    """Parse a generated-JS block → Interp with its functions loaded."""
+    return Interp(Parser(tokenize(source)).program())

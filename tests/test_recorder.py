@@ -299,3 +299,73 @@ def test_replay_api_404_for_live_sources():
             await client.close()
 
     asyncio.run(go())
+
+
+def test_postprocessed_recording_still_ts_indexes(tmp_path):
+    """A recording rewritten by jq/etc (key order changed) loses the fast
+    ts prefix — indexing falls back to a full JSON parse per line, and
+    ts-seek still works."""
+    lines = []
+    with open(SAMPLE) as f:
+        for line in f:
+            rec = json.loads(line)
+            lines.append(json.dumps({"text": rec["text"], "ts": rec["ts"]}))
+    path = tmp_path / "reordered.jsonl"
+    path.write_text("\n".join(lines) + "\n")
+    replay = FileReplaySource(str(path))
+    original = FileReplaySource(SAMPLE)
+    assert replay.timestamps == original.timestamps
+    assert replay.seek(ts=replay.timestamps[3]) == 3
+
+
+def test_spliced_recording_seeks_monotone(tmp_path):
+    """Two concatenated recordings jump backwards in time; ts-seek must
+    still be well-defined (running-max view) instead of bisecting an
+    unsorted list into arbitrary indices."""
+    with open(SAMPLE) as f:
+        lines = [line for line in f if line.strip()]
+    path = tmp_path / "spliced.jsonl"
+    path.write_text("".join(lines + lines))  # second copy restarts time
+    replay = FileReplaySource(str(path))
+    ts = replay.timestamps
+    assert ts[6] < ts[5]  # genuinely non-monotone input
+    # seeking to the max recorded time lands at/after the first peak,
+    # never at a bisect artifact in the middle of the first segment
+    idx = replay.seek(ts=ts[5])
+    assert idx >= 5
+
+
+def test_rejected_seek_does_not_mutate_pause_state(tmp_path):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        cfg = load_config(
+            {
+                "TPUDASH_SOURCE": "replay",
+                "TPUDASH_REPLAY_PATH": SAMPLE,
+                "TPUDASH_REFRESH_INTERVAL": "0",
+            }
+        )
+        svc = DashboardService(cfg, make_source(cfg))
+        client = TestClient(TestServer(DashboardServer(svc).build_app()))
+        await client.start_server()
+        try:
+            await client.get("/api/frame")
+            # invalid index + paused: the 400 must not silently pause
+            r = await client.post(
+                "/api/replay", json={"index": "xyz", "paused": True}
+            )
+            assert r.status == 400
+            pos = await (await client.get("/api/replay")).json()
+            assert pos["paused"] is False
+            # non-object JSON body → 400, not 500
+            r = await client.post("/api/replay", json=[1, 2])
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(go())

@@ -105,23 +105,19 @@ const esc = s => String(s).replace(/[&<>"']/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 
 // ---- dependency-free fallback renderer over the same figure dicts --------
+// All decisions (band geometry, colorscale selection, cell
+// classification, sparkline scaling) come from the GENERATED client
+// logic below — these functions only assemble DOM strings around it.
 function renderMeter(el, title, value, maxVal, steps, color) {
-  const pct = maxVal > 0 ? Math.min(100, Math.max(0, value / maxVal * 100)) : 0;
+  const g = meter_geometry(value, maxVal, steps || []);
   let bands = '';
-  for (const s of steps || []) {
-    const l = s.range[0] / maxVal * 100, w = (s.range[1] - s.range[0]) / maxVal * 100;
-    bands += `<div class="band" style="left:${l}%;width:${w}%;background:${s.color}"></div>`;
+  for (const b of g.bands) {
+    bands += `<div class="band" style="left:${b.left}%;width:${b.width}%;background:${b.color}"></div>`;
   }
   el.innerHTML = `<div class="fig-title">${esc(title)}</div>
     <div class="fig-value" style="color:${esc(color)}">${(+value).toFixed(1)}</div>
-    <div class="meter">${bands}<div class="fill" style="width:${pct}%;background:${esc(color)}"></div></div>
+    <div class="meter">${bands}<div class="fill" style="width:${g.pct}%;background:${esc(color)}"></div></div>
     <div class="fig-title">max ${+maxVal}</div>`;
-}
-
-function colorFromScale(scale, frac) {
-  let c = scale[0][1];
-  for (const [stop, col] of scale) { if (frac >= stop) c = col; }
-  return c;
 }
 
 function renderHeatFallback(el, trace, layoutTitle) {
@@ -130,17 +126,17 @@ function renderHeatFallback(el, trace, layoutTitle) {
   let cells = '';
   for (let y = 0; y < z.length; y++) for (let x = 0; x < z[y].length; x++) {
     const v = z[y][x];
-    const key = cd && cd[y] && cd[y][x];
-    if (v === null || v === undefined) {
+    const key = (cd && cd[y] && cd[y][x]) || null;
+    const cell = heat_cell(v === undefined ? null : v, key, zmax, trace.colorscale);
+    if (cell.kind === 'blank') {
+      cells += '<div style="background:transparent"></div>';
+    } else if (cell.kind === 'deselected') {
       // deselected chips keep their key so a click re-selects them
-      cells += key
-        ? `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(key)}" title="deselected"></div>`
-        : '<div style="background:transparent"></div>';
-      continue;
+      cells += `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(key)}" title="deselected"></div>`;
+    } else {
+      cells += `<div style="background:${cell.color};cursor:pointer" title="${(+v).toFixed(1)}"` +
+               (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
     }
-    const col = colorFromScale(trace.colorscale, Math.min(1, Math.max(0, v / zmax)));
-    cells += `<div style="background:${col};cursor:pointer" title="${(+v).toFixed(1)}"` +
-             (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
   }
   el.innerHTML = `<div class="fig-title">${esc(layoutTitle)}</div>
     <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
@@ -157,10 +153,8 @@ function renderLineFallback(el, trace, fig, title) {
   const ymax = (fig.layout.yaxis.range && fig.layout.yaxis.range[1]) || Math.max(...ys, 1);
   const W = 240, H = 64;
   let pts = '';
-  for (let i = 0; i < n; i++) {
-    const x = n > 1 ? i / (n - 1) * W : 0;
-    const y = H - Math.min(1, Math.max(0, ys[i] / ymax)) * H;
-    pts += `${x.toFixed(1)},${y.toFixed(1)} `;
+  for (const p of spark_points(ys, ymax, W, H)) {
+    pts += `${p[0].toFixed(1)},${p[1].toFixed(1)} `;
   }
   const col = trace.line.color;
   el.innerHTML = `<div class="fig-title">${esc(title)}</div>
@@ -428,7 +422,7 @@ function applyFrame(frame) {
   renderBreakdown(frame.breakdown, frame.panel_specs);
   showPanelGaps(frame.unavailable_panels);
   if (drillKey) refreshDrill();  // keep the open chip detail live
-  if (replayActive) pollReplay();  // keep the scrub position current
+  if (replayActive !== false) pollReplay();  // keep the scrub position current
   const t = frame.timings || {};
   document.getElementById('debug').textContent =
     'Debug: frames=' + (t.frames || 0) +
@@ -439,31 +433,13 @@ function applyFrame(frame) {
 
 // ---- transport: SSE push with polling fallback ----------------------------
 // Steady-state ticks arrive as value-only deltas (kind: "delta") patched
-// into the last full frame — applyDelta mirrors tpudash/app/delta.py
-// apply_delta field for field; change both together.
+// into the last full frame.  apply_delta / stream_event_plan /
+// stream_error_plan below are GENERATED from the fuzz-tested Python
+// client logic (tpudash/app/clientlogic.py) — edit the Python, never
+// this block; tests/test_client_parity.py pins the embedding.
 let lastFrame = null;
 
-function applyDelta(f, d) {
-  for (const k of ['last_updated', 'timings', 'source_health', 'alerts',
-                   'stragglers', 'warnings', 'stats', 'breakdown',
-                   'unavailable_panels']) {
-    if (k in d) f[k] = d[k]; else delete f[k];
-  }
-  const patchFig = (fig, p) => {
-    const t = fig.data[0];
-    if (t.type === 'indicator') { t.value = p.value; t.gauge.bar.color = p.color; }
-    else { t.x = [p.value]; t.marker.color = p.color; }
-  };
-  if (d.average) d.average.forEach((p, i) => patchFig(f.average.figures[i].figure, p));
-  if (d.device_rows) d.device_rows.forEach((patches, i) =>
-    patches.forEach((p, j) => patchFig(f.device_rows[i].figures[j].figure, p)));
-  if (d.heatmaps) d.heatmaps.forEach((z, i) => { f.heatmaps[i].figure.data[0].z = z; });
-  if (d.trends) d.trends.forEach((p, i) => {
-    const t = f.trends[i].figure.data[0];
-    t.x = p.x; t.y = p.y; t.line.color = p.color;
-  });
-  return f;
-}
+/*__GENERATED_CLIENT__*/
 
 function startStream() {
   if (!window.EventSource) return;  // old browser → polling stays active
@@ -472,25 +448,21 @@ function startStream() {
     streaming = true;
     if (timer) { clearInterval(timer); timer = null; }
     const msg = JSON.parse(e.data);
-    if (msg.kind === 'delta') {
-      if (!lastFrame) { refresh(); return; }  // missed the full frame
-      lastFrame = applyDelta(lastFrame, msg);
-    } else {
-      lastFrame = msg;
-    }
+    const plan = stream_event_plan(msg.kind, lastFrame !== null);
+    if (plan === 'refetch') { refresh(); return; }  // missed the full frame
+    lastFrame = plan === 'delta' ? apply_delta(lastFrame, msg) : msg;
     // keep the model current but skip DOM/plot work for hidden tabs —
     // the visibilitychange handler re-renders on return
     if (!document.hidden) applyFrame(lastFrame);
   };
   es.onerror = () => {
-    // server restart / proxy hiccup: drop to polling; EventSource
-    // auto-reconnects transient errors, but a CLOSED stream (non-200
-    // from a proxy) never retries itself — re-open it on a backoff
+    // server restart / proxy hiccup: the recovery policy is the
+    // generated stream_error_plan (see clientlogic.py for the why)
     streaming = false;
-    if (!timer) timer = setInterval(refresh, 5000);
-    if (es.readyState === EventSource.CLOSED) {
-      setTimeout(startStream, 15000);
-    }
+    const plan = stream_error_plan(
+      es.readyState === EventSource.CLOSED, timer !== null);
+    if (plan.poll_ms > 0) timer = setInterval(refresh, plan.poll_ms);
+    if (plan.reopen_ms > 0) setTimeout(startStream, plan.reopen_ms);
   };
 }
 
@@ -516,8 +488,10 @@ document.getElementById('select-none').addEventListener('click',
 // ---- replay time-travel (source=replay only) ------------------------------
 // A recorded incident can be scrubbed back and forth: the bar appears when
 // /api/replay answers, the slider seeks by snapshot index, pause holds the
-// current snapshot instead of auto-advancing.
-let replayActive = false;
+// current snapshot instead of auto-advancing.  Tri-state: null = unknown
+// (keep probing each frame — a transient error must not permanently hide
+// or freeze the bar), true = replaying, false = definitively not (404).
+let replayActive = null;
 
 function renderReplayPosition(pos) {
   const bar = document.getElementById('replay-bar');
@@ -558,7 +532,8 @@ let replayPaused = false;
 async function pollReplay() {
   try {
     const r = await fetch('/api/replay', {headers: authHeaders()});
-    if (!r.ok) { replayActive = false; return; }
+    if (r.status === 404) { replayActive = false; return; }
+    if (!r.ok) return;  // transient: keep the last state, retry next frame
     replayActive = true;
     renderReplayPosition(await r.json());
   } catch (e) { /* transient */ }
@@ -635,3 +610,12 @@ startStream();
 </body>
 </html>
 """
+
+# The transport-critical client functions are generated from the
+# fuzz-tested Python source of truth (clientlogic.py) at import time —
+# see pyjs.py for why this beats a hand-maintained JS mirror.
+from tpudash.app.clientlogic import CLIENT_FUNCTIONS  # noqa: E402
+from tpudash.app.pyjs import transpile_functions  # noqa: E402
+
+GENERATED_CLIENT_JS = transpile_functions(CLIENT_FUNCTIONS)
+PAGE = PAGE.replace("/*__GENERATED_CLIENT__*/", GENERATED_CLIENT_JS)

@@ -657,7 +657,7 @@ class DashboardServer:
             ttl = float(body.get("ttl_s", 3600.0))
             rule = str(body.get("rule", "*") or "*")
             chip = str(body.get("chip", "*") or "*")
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, AttributeError) as e:
             raise web.HTTPBadRequest(text=f"bad silence request: {e}")
         async with self._lock:
             try:
@@ -679,7 +679,7 @@ class DashboardServer:
             body = await request.json()
             rule = str(body.get("rule", "*") or "*")
             chip = str(body.get("chip", "*") or "*")
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, AttributeError) as e:
             raise web.HTTPBadRequest(text=f"bad unsilence request: {e}")
         async with self._lock:
             removed = self.service.silences.remove(rule, chip)
@@ -700,15 +700,10 @@ class DashboardServer:
     def _replay_source(self):
         """The FileReplaySource under the retry/recording wrappers, or
         None when the dashboard is not replaying a recording."""
+        from tpudash.sources import unwrap_source
         from tpudash.sources.recorder import FileReplaySource
 
-        src, hops = self.service.source, 0
-        while src is not None and hops < 8:
-            if isinstance(src, FileReplaySource):
-                return src
-            src = getattr(src, "inner", None)
-            hops += 1
-        return None
+        return unwrap_source(self.service.source, FileReplaySource)
 
     async def replay_status(self, request: web.Request) -> web.Response:
         """Scrub-control state: current index/ts + recording bounds.
@@ -727,24 +722,22 @@ class DashboardServer:
         replay = self._replay_source()
         if replay is None:
             raise web.HTTPNotFound(text="not replaying a recording")
+        # validate EVERYTHING before mutating anything: a 400 response
+        # must not leave auto-advance silently paused
         try:
             body = await request.json()
             index = body.get("index")
             t = body.get("t")
             paused = body.get("paused")
-        except (ValueError, TypeError) as e:
+            index = int(index) if index is not None else None
+            t = float(t) if t is not None else None
+        except (ValueError, TypeError, AttributeError) as e:
             raise web.HTTPBadRequest(text=f"bad replay request: {e}")
         async with self._lock:
             if paused is not None:
                 replay.paused = bool(paused)
             if index is not None or t is not None:
-                try:
-                    replay.seek(
-                        index=int(index) if index is not None else None,
-                        ts=float(t) if t is not None else None,
-                    )
-                except (TypeError, ValueError) as e:
-                    raise web.HTTPBadRequest(text=f"bad seek: {e}")
+                replay.seek(index=index, ts=t)
                 # serve the sought snapshot NOW, not an interval later
                 await self._refresh_locked(force=True)
             return web.json_response(replay.position())

@@ -12,6 +12,23 @@ from tpudash.sources.fixture import FixtureSource, SyntheticSource  # noqa: F401
 from tpudash.sources.prometheus import PrometheusSource  # noqa: F401
 
 
+def unwrap_source(src, cls):
+    """First instance of ``cls`` in a source wrapper chain, or None.
+
+    Walks instance attributes only (``__dict__['inner']``): the wrappers
+    all define ``__getattr__`` fall-through, so a plain getattr would
+    read through to the inner source and loop.  The id-set guards
+    against cycles.  One shared walk — the profile isolation in
+    app/service.py and the replay scrub API both need it."""
+    seen = set()
+    while src is not None and id(src) not in seen:
+        seen.add(id(src))
+        if isinstance(src, cls):
+            return src
+        src = src.__dict__.get("inner")
+    return None
+
+
 def _parse_cold_links(spec: str) -> tuple:
     """``"17:xn,40:zp"`` → ((17, "xn"), (40, "zp")) for the synthetic
     source's cold-link injection; bad entries raise (a mistyped drill

@@ -104,24 +104,53 @@ class FileReplaySource(MetricsSource):
         self.path = path
         offsets = []
         timestamps = []
+        slow_lines = 0
         try:
             with open(path, "rb") as f:
                 pos = 0
                 for line in f:
                     if line.strip():
                         offsets.append(pos)
-                        m = self._TS_RE.match(line[:64])
-                        try:
-                            timestamps.append(float(m.group(1)) if m else 0.0)
-                        except ValueError:
-                            timestamps.append(0.0)
+                        m = self._TS_RE.match(line.lstrip()[:64])
+                        ts = None
+                        if m:
+                            try:
+                                ts = float(m.group(1))
+                            except ValueError:
+                                ts = None
+                        if ts is None:
+                            # post-processed recording (re-ordered keys,
+                            # reformatted): full JSON parse, slow path
+                            slow_lines += 1
+                            try:
+                                ts = float(json.loads(line).get("ts", 0.0))
+                            except (ValueError, TypeError, KeyError):
+                                ts = None
+                        if ts is None:
+                            # keep the list MONOTONE — ts-seek bisects it;
+                            # an interleaved 0.0 would scramble every seek
+                            ts = timestamps[-1] if timestamps else 0.0
+                        timestamps.append(ts)
                     pos += len(line)
         except OSError as e:
             raise SourceError(f"cannot open recording {path!r}: {e}") from e
+        if slow_lines:
+            log.warning(
+                "%d/%d recording lines lacked the fast ts prefix "
+                "(post-processed file?) — indexed via full JSON parse",
+                slow_lines, len(offsets),
+            )
         if not offsets:
             raise SourceError(f"recording {path!r} holds no snapshots")
         self.offsets = offsets
         self.timestamps = timestamps
+        #: monotone (running-max) view for ts-seek: bisect needs sorted
+        #: input, and a spliced/concatenated recording may jump backwards
+        self._seek_ts = []
+        hi = timestamps[0] if timestamps else 0.0
+        for ts in timestamps:
+            hi = ts if ts > hi else hi
+            self._seek_ts.append(hi)
         self.loop = loop
         self._i = 0
         self._last: "int | None" = None
@@ -140,7 +169,7 @@ class FileReplaySource(MetricsSource):
         if index is None:
             import bisect
 
-            index = max(0, bisect.bisect_right(self.timestamps, float(ts)) - 1)
+            index = max(0, bisect.bisect_right(self._seek_ts, float(ts)) - 1)
         index = max(0, min(int(index), len(self.offsets) - 1))
         self._i = index
         self._last = None  # even when paused, serve the seek target next
