@@ -100,6 +100,34 @@ def bench_3d_torus() -> dict:
     }
 
 
+def bench_link_detail() -> dict:
+    """256 chips with direction-resolved per-link ICI series enabled
+    (4 extra series per chip on the 2D torus): the per-link capability's
+    full cost — bigger payload parse, 6 extra derived columns, the
+    coldest-link heatmap panel, straggler link rules — must stay deep
+    inside the budget too."""
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    cfg = Config(source="synthetic", synthetic_chips=N_CHIPS)
+    svc = DashboardService(
+        cfg,
+        JsonReplaySource.synthetic(
+            N_CHIPS, generation="v5e", frames=8, emit_links=True
+        ),
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    svc.timer.history.clear()
+    for _ in range(N_FRAMES):
+        frame = svc.render_frame()
+        assert frame["error"] is None
+    panels = [h["panel"] for h in frame["heatmaps"]]
+    assert "ici_link_min_gbps" in panels, "min-link heatmap must render"
+    return {"p50_s": svc.timer.percentile(0.5)}
+
+
 def bench_multislice() -> dict:
     """Secondary number: 2 slices × 256 chips (the BASELINE.json configs[4]
     multi-slice shape) with cross-slice DCN series, all 512 chips selected."""
@@ -322,6 +350,7 @@ def main() -> None:
     dash = bench_dashboard()
     multi = bench_multislice()
     torus3d = bench_3d_torus()
+    links = bench_link_detail()
     scale1k = bench_scale(1024)
     scale4k = bench_scale(4096)
     probes = bench_probes()
@@ -340,6 +369,7 @@ def main() -> None:
         "multislice_2x256_p50_ms": round(multi["p50_s"] * 1e3, 2),
         "torus3d_v4_4x4x8_p50_ms": round(torus3d["p50_s"] * 1e3, 2),
         "torus3d_grid": torus3d["grid"],
+        "link_detail_256_p50_ms": round(links["p50_s"] * 1e3, 2),
         "scale_1024_p50_ms": round(scale1k["p50_s"] * 1e3, 2),
         "scale_1024_sse_delta_bytes": scale1k["sse_delta_bytes"],
         "scale_1024_rss_mb": scale1k["rss_mb"],
