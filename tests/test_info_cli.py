@@ -78,3 +78,14 @@ def test_chip_drilldown_unknown_key(capsys):
     assert main(["--source", "synthetic", "--chips", "4", "--chip", "nope/9"]) == 0
     out = capsys.readouterr().out
     assert "unknown chip" in out and "slice-0/0" in out
+
+
+def test_main_straggler_names_the_link(capsys, monkeypatch):
+    # synthetic fleet with one cold x- cable: the CLI line names it
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_LINKS", "1")
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_COLD_LINKS", "3:xn")
+    monkeypatch.setenv("TPUDASH_STRAGGLER_RULES", "ici_link_xn_gbps@1")
+    assert main(["--source", "synthetic", "--chips", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "STRAGGLERS:" in out
+    assert "slice-0/3 link x- ici_link_xn_gbps" in out

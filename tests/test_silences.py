@@ -240,3 +240,14 @@ def test_silence_api_roundtrip(tmp_path):
             await client.close()
 
     asyncio.run(go())
+
+
+def test_page_carries_silence_controls():
+    # the drill-down offers one-click acknowledge/unsilence per firing
+    # alert — the operator workflow is reachable from the page, not
+    # API-only
+    from tpudash.app.html import PAGE
+
+    assert "silence-btn" in PAGE
+    assert "/api/alerts/silence" in PAGE
+    assert "/api/alerts/unsilence" in PAGE

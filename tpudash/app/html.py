@@ -256,9 +256,14 @@ function renderDrill(d) {
     `<button id="drill-close">close</button></div>`;
   const firing = (d.alerts || []).filter(a => a.state === 'firing');
   if (firing.length) {
+    // each firing alert gets a one-click acknowledge (1h silence) /
+    // unsilence toggle — the operator workflow, not just the signal
     html += `<div class="drill-alerts">⚠ ` +
-      firing.map(a => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
-                 ' (=' + (+a.value) + ')').join(' · ') + '</div>';
+      firing.map((a, i) => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
+                 ' (=' + (+a.value) + ') ' +
+                 `<button class="silence-btn" data-i="${i}">` +
+                 (a.silenced ? 'unsilence' : 'silence 1h') + '</button>'
+                ).join(' · ') + '</div>';
   }
   const lagging = (d.stragglers || []).filter(s => s.state === 'firing');
   if (lagging.length) {
@@ -297,6 +302,18 @@ function renderDrill(d) {
   document.getElementById('drill-close').addEventListener('click', closeDrill);
   for (const btn of el.querySelectorAll('.neighbors button, table.links button')) {
     btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
+  }
+  for (const btn of el.querySelectorAll('.silence-btn')) {
+    btn.addEventListener('click', async () => {
+      const a = firing[+btn.getAttribute('data-i')];
+      const path = a.silenced ? '/api/alerts/unsilence' : '/api/alerts/silence';
+      const body = a.silenced ? {rule: a.rule, chip: a.chip}
+                              : {rule: a.rule, chip: a.chip, ttl_s: 3600};
+      await fetch(path, {method: 'POST',
+        headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+        body: JSON.stringify(body)});
+      refreshDrill(); refresh();
+    });
   }
 }
 

@@ -172,7 +172,10 @@ def main(argv: "list[str] | None" = None) -> int:
                         ]
                     if lagging:
                         straggler_line = "STRAGGLERS: " + "  ".join(
-                            f"{s['chip']} {s['column']} {s['value']} "
+                            f"{s['chip']}"
+                            # per-link breach names the cable itself
+                            + (f" link {s['link']}" if "link" in s else "")
+                            + f" {s['column']} {s['value']} "
                             f"vs fleet {s['median']} (z={s['z']})"
                             for s in lagging[:6]
                         ) + (" …" if len(lagging) > 6 else "")
