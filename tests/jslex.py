@@ -1,0 +1,140 @@
+"""String-aware JavaScript delimiter checker for the page script.
+
+A single unbalanced brace anywhere in the inline <script> kills the
+ENTIRE dashboard page (one parse unit), and no browser exists here to
+catch it.  tests/jsmini.py executes the *generated* functions, but the
+hand-written DOM-assembly JS around them needs at least structural
+validation.  This is a small state machine — not a parser — that strips
+comments, string/template literals (including nested ``${}``
+interpolations), and regex literals, then checks (), {}, [] nesting on
+what remains.  It deliberately errs toward strictness: a construct it
+cannot classify is a failure, not a skip.
+"""
+
+from __future__ import annotations
+
+#: characters after which a `/` starts a regex literal, not division
+_REGEX_PREFIX = set("(,=:[!&|?{};+-*%~^<>\n")
+
+
+class JsSyntaxError(ValueError):
+    pass
+
+
+def check_delimiters(src: str) -> None:
+    """Raise JsSyntaxError on unbalanced ()/{}/[] outside strings,
+    comments, templates, and regex literals."""
+    pairs = {")": "(", "}": "{", "]": "["}
+    stack: list[tuple[str, int]] = []
+    #: lexer mode stack; "code" entries carry the bracket-stack depth at
+    #: entry so a template interpolation's closing ``}`` is recognized
+    #: only once its own brackets are balanced (`${ {a: 1} }` nests)
+    modes: list = [("code", None)]
+    i, n = 0, len(src)
+    last_sig = "\n"  # last significant code char (regex heuristic)
+
+    def line(pos: int) -> int:
+        return src.count("\n", 0, pos) + 1
+
+    while i < n:
+        c = src[i]
+        mode, entry_depth = modes[-1]
+        if mode == "code":
+            if c == "/" and i + 1 < n and src[i + 1] == "/":
+                i = src.find("\n", i)
+                if i < 0:
+                    break
+                continue
+            if c == "/" and i + 1 < n and src[i + 1] == "*":
+                end = src.find("*/", i + 2)
+                if end < 0:
+                    raise JsSyntaxError(f"unterminated /* at line {line(i)}")
+                i = end + 2
+                continue
+            if c == "/" and last_sig in _REGEX_PREFIX:
+                # regex literal: scan to the closing unescaped /
+                j = i + 1
+                in_class = False
+                while j < n:
+                    if src[j] == "\\":
+                        j += 2
+                        continue
+                    if src[j] == "[":
+                        in_class = True
+                    elif src[j] == "]":
+                        in_class = False
+                    elif src[j] == "/" and not in_class:
+                        break
+                    elif src[j] == "\n":
+                        raise JsSyntaxError(
+                            f"unterminated regex at line {line(i)}"
+                        )
+                    j += 1
+                else:
+                    raise JsSyntaxError(f"unterminated regex at line {line(i)}")
+                i = j + 1
+                last_sig = "/"
+                continue
+            if c in "'\"":
+                modes.append((c, None))
+                i += 1
+                continue
+            if c == "`":
+                modes.append(("template", None))
+                i += 1
+                continue
+            if c in "([{":
+                stack.append((c, i))
+                last_sig = c
+            elif c in ")]}":
+                if (
+                    c == "}"
+                    and entry_depth is not None
+                    and len(stack) == entry_depth
+                ):
+                    # closes this template ${ interpolation
+                    modes.pop()  # back to template mode
+                    i += 1
+                    continue
+                if not stack or stack[-1][0] != pairs[c]:
+                    raise JsSyntaxError(f"unbalanced {c!r} at line {line(i)}")
+                stack.pop()
+                last_sig = c
+            elif not c.isspace():
+                last_sig = c
+            i += 1
+            continue
+        if mode in ("'", '"'):
+            if c == "\\":
+                i += 2
+                continue
+            if c == mode:
+                modes.pop()
+                last_sig = "s"  # a string ends like an operand
+            elif c == "\n":
+                raise JsSyntaxError(f"unterminated string at line {line(i)}")
+            i += 1
+            continue
+        if mode == "template":
+            if c == "\\":
+                i += 2
+                continue
+            if c == "`":
+                modes.pop()
+                last_sig = "s"
+                i += 1
+                continue
+            if c == "$" and i + 1 < n and src[i + 1] == "{":
+                # interpolation body is real code; its closing } is the
+                # one that returns the bracket stack to this depth
+                modes.append(("code", len(stack)))
+                i += 2
+                continue
+            i += 1
+            continue
+        raise JsSyntaxError(f"bad lexer mode {mode!r}")
+    if len(modes) != 1:
+        raise JsSyntaxError(f"unterminated {modes[-1][0]!r} literal at EOF")
+    if stack:
+        c, pos = stack[-1]
+        raise JsSyntaxError(f"unclosed {c!r} from line {line(pos)}")

@@ -1,0 +1,73 @@
+"""Structural validation of the page's inline JavaScript.
+
+The whole dashboard page is one <script> parse unit; a single stray
+brace anywhere in the hand-written DOM code takes down every panel, and
+there is no browser in this image to notice.  tests/jslex.py strips
+strings/templates/regexes/comments with a real state machine, then
+checks delimiter nesting — both on the served page and on a set of
+tricky fixtures that pin the lexer itself.
+"""
+
+import pytest
+
+from tests.jslex import JsSyntaxError, check_delimiters
+from tpudash.app import html
+
+
+def _page_script() -> str:
+    # the inline script: after the plotly <script src> tag
+    body = html.PAGE.split("<script>", 1)[1]
+    return body.rsplit("</script>", 1)[0]
+
+
+def test_page_script_delimiters_balanced():
+    check_delimiters(_page_script())
+
+
+def test_generated_client_delimiters_balanced():
+    check_delimiters(html.GENERATED_CLIENT_JS)
+
+
+# --- the lexer itself --------------------------------------------------------
+
+GOOD = [
+    "const esc = s => String(s).replace(/[&<>\"']/g, c => m[c]);",  # regex w/ quotes+brackets
+    "const x = `a${ {b: [1, 2]} }c`;",                # nested braces in interpolation
+    "const y = `t${a}${b}`;",                          # adjacent interpolations
+    "const z = a / b / c;",                            # division, not regex
+    "let s = 'it\\'s';  // comment with ) brace }",    # escape + comment noise
+    "/* { [ ( */ f();",                                # block comment noise
+    "html += `<tr${l.straggler ? ' class=\"x\"' : ''}>`;",  # ternary in template
+    "const t = `${fn({k: '}'})}`;",                    # brace inside string inside interp
+]
+
+BAD = [
+    "function f() { if (x) { }",        # unclosed {
+    "f(a, b;",                          # unclosed (
+    "const a = [1, 2;",                 # unclosed [
+    "const s = 'abc;\nnext();",         # unterminated string
+    "const t = `abc${x;",               # unterminated template interp
+    "f());",                            # extra )
+    "} else {}",                        # closer with empty stack
+]
+
+
+@pytest.mark.parametrize("src", GOOD)
+def test_lexer_accepts_tricky_valid_js(src):
+    check_delimiters(src)
+
+
+@pytest.mark.parametrize("src", BAD)
+def test_lexer_rejects_broken_js(src):
+    with pytest.raises(JsSyntaxError):
+        check_delimiters(src)
+
+
+def test_detects_injected_page_breakage():
+    """The real guard: mutate the served page the way an editing slip
+    would, and the check must fail."""
+    script = _page_script()
+    with pytest.raises(JsSyntaxError):
+        check_delimiters(script + "\nfunction broken() {")
+    with pytest.raises(JsSyntaxError):
+        check_delimiters(script.replace("function applyFrame(frame) {", "function applyFrame(frame) {{", 1))
