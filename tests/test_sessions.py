@@ -416,3 +416,34 @@ def test_session_restore_skips_expired_and_bounds(tmp_path):
     # garbage sections never crash
     assert SessionStore(SelectionState()).restore("junk") == 0
     assert SessionStore(SelectionState()).restore({"x": "junk"}) == 0
+
+
+def test_restore_survives_corrupt_idle_values(tmp_path):
+    """A corrupt idle_s (string, null) must skip/deprioritize that entry,
+    never crash restore — a bad checkpoint must not stop server startup."""
+    store = SessionStore(SelectionState(), limit=4, ttl=100.0,
+                         clock=lambda: 1000.0)
+    section = {
+        "ok": {"selected": ["s/1"], "idle_s": 5.0},
+        "junk-str": {"selected": ["s/2"], "idle_s": "abc"},
+        "junk-null": {"selected": ["s/3"], "idle_s": None},
+    }
+    assert store.restore(section) == 1
+    assert set(store.to_dicts()) == {"ok"}
+
+
+def test_server_boots_with_corrupt_sessions_section(tmp_path):
+    import json as _j
+
+    path = tmp_path / "state.json"
+    path.write_text(_j.dumps({
+        "selected": [], "use_gauge": True,
+        "sessions": {"a": {"selected": [], "idle_s": "garbage"}},
+        "silences": "also garbage",
+    }))
+    cfg = Config(
+        source="fixture", fixture_path=FIXTURE,
+        refresh_interval=0.0, state_path=str(path),
+    )
+    server = _server(cfg)  # must not raise
+    assert len(server.sessions) == 0

@@ -251,3 +251,60 @@ def test_page_carries_silence_controls():
     assert "silence-btn" in PAGE
     assert "/api/alerts/silence" in PAGE
     assert "/api/alerts/unsilence" in PAGE
+
+
+def test_silencing_never_sends_spurious_resolved(monkeypatch):
+    """Acknowledging a paged alert must NOT report 'resolved' to the
+    webhook — the chip still breaches; and recovery while silenced stays
+    suppressed (Alertmanager-style silence semantics)."""
+    calls = []
+
+    import requests
+
+    class _R:
+        def raise_for_status(self):
+            pass
+
+    monkeypatch.setattr(
+        requests, "post", lambda url, json=None, timeout=None: (
+            calls.append(json), _R())[1]
+    )
+    import time as _time
+
+    svc = _svc(alert_webhook="http://pager.example/hook")
+    svc.render_frame()  # both hot chips page
+    svc.flush_webhooks()
+    assert len(calls) == 1 and len(calls[0]["fired"]) == 2
+
+    svc.silences.add("*", "*", 3600.0, now=_time.time())
+    svc.render_frame()  # acknowledged: no fired, and crucially no resolved
+    svc.flush_webhooks()
+    assert len(calls) == 1, f"spurious webhook: {calls[1:]}"
+
+
+def test_nan_and_control_char_silences_rejected():
+    s = SilenceSet()
+    with pytest.raises(ValueError):
+        s.add(RULE, "s/0", float("nan"), now=1.0)
+    with pytest.raises(ValueError):
+        s.add(RULE, "s/0", float("inf"), now=1.0)
+    with pytest.raises(ValueError):
+        s.add("x\ngroups: []", "s/0", 60.0, now=1.0)
+    with pytest.raises(ValueError):
+        s.add(RULE, "chip\r0", 60.0, now=1.0)
+    with pytest.raises(ValueError):
+        s.add(RULE, "c" * 300, 60.0, now=1.0)
+    assert s.active(2.0) == []  # nothing slipped in
+
+
+def test_yaml_export_sanitizes_restored_silences():
+    # a hand-edited checkpoint could carry anything; the rule file must
+    # stay one comment line per silence regardless
+    import yaml
+
+    rules = parse_rules(f"{schema.TEMPERATURE}>90:critical@2")
+    dirty = [{"rule": "x\ngroups: []", "chip": "s/0", "until": 99.0,
+              "created": 1.0}]
+    text = prometheus_rules_yaml(rules, 5.0, silences=dirty)
+    doc = yaml.safe_load(text)
+    assert len(doc["groups"]) == 1  # no injected top-level key

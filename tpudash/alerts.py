@@ -233,9 +233,22 @@ class SilenceSet:
     max_entries: int = 1000
 
     def add(self, rule: str, chip: str, ttl_s: float, now: float) -> dict:
-        if ttl_s <= 0:
-            raise ValueError(f"silence ttl must be positive, got {ttl_s:g}")
+        import math
+
+        # `not (> 0)` so NaN is rejected too — a NaN `until` would never
+        # match any is_silenced check while the API reported success
+        if not (ttl_s > 0) or not math.isfinite(ttl_s):
+            raise ValueError(
+                f"silence ttl must be positive and finite, got {ttl_s}"
+            )
         rule, chip = rule or "*", chip or "*"
+        for value, what in ((rule, "rule"), (chip, "chip")):
+            # these strings are embedded in the exported Prometheus rule
+            # file's comments — newlines/control chars would inject lines
+            if any(ord(ch) < 0x20 or ord(ch) == 0x7F for ch in value):
+                raise ValueError(f"control characters in silence {what}")
+            if len(value) > 200:
+                raise ValueError(f"silence {what} too long")
         self._silences = [
             s for s in self._silences if (s.rule, s.chip) != (rule, chip)
         ]
@@ -397,6 +410,11 @@ def prometheus_rules_yaml(
         "# dashboard banner and the cluster pager fire on the same",
         "# conditions.  Load via prometheus rule_files.",
     ]
+    def _clean(v: str) -> str:
+        # defense in depth (add() already rejects control chars): nothing
+        # a silence carries may break out of a YAML comment line
+        return "".join(ch for ch in str(v) if ord(ch) >= 0x20)[:200]
+
     chip_scoped = [s for s in silences if s["chip"] != "*"]
     if chip_scoped:
         lines.append(
@@ -405,7 +423,8 @@ def prometheus_rules_yaml(
         lines.append("# scope in a Prometheus rule file):")
         for s in sorted(chip_scoped, key=lambda s: (s["rule"], s["chip"])):
             lines.append(
-                f"#   {s['rule']} on {s['chip']} until {s['until']:.0f}"
+                f"#   {_clean(s['rule'])} on {_clean(s['chip'])} "
+                f"until {s['until']:.0f}"
             )
     lines += [
         "groups:",

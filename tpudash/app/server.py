@@ -86,9 +86,7 @@ class DashboardServer:
         # selection (the reference's refresh-resets-state flaw, SURVEY §5)
         service.sessions_snapshot = self.sessions.to_dicts
         if service.cfg.state_path:
-            restored = self.sessions.restore(
-                self._read_state_section("sessions")
-            )
+            restored = self.sessions.restore(service.restored_sessions)
             if restored:
                 log.info("restored %d browser sessions", restored)
         #: bumped after every refresh_data(); pairs with each session's
@@ -104,13 +102,11 @@ class DashboardServer:
         self._refresh_started: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
 
-    def _read_state_section(self, key: str):
-        try:
-            with open(self.service.cfg.state_path) as f:
-                doc = json.load(f)
-            return doc.get(key, {}) if isinstance(doc, dict) else {}
-        except (OSError, ValueError):
-            return {}
+    async def _save_state(self) -> None:
+        """Persist the composite checkpoint OFF the event loop — the
+        write is blocking disk I/O and _mutate holds the frame lock."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.service.save_state)
 
     def _entry(self, request: web.Request) -> SessionEntry:
         return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
@@ -309,7 +305,7 @@ class DashboardServer:
         async with self._lock:
             result = fn()
             entry.state_version += 1
-            self.service.save_state()
+            await self._save_state()
             return result
 
     # -- handlers ------------------------------------------------------------
@@ -650,8 +646,6 @@ class DashboardServer:
         silence is flagged on frame/alert entries, excluded from webhook
         paging, persisted across restart, and expires on its own — when
         it does while the alert still fires, the pager fires then."""
-        import time as _time
-
         try:
             body = await request.json()
             ttl = float(body.get("ttl_s", 3600.0))
@@ -661,20 +655,18 @@ class DashboardServer:
             raise web.HTTPBadRequest(text=f"bad silence request: {e}")
         async with self._lock:
             try:
-                entry = self.service.silences.add(rule, chip, ttl, _time.time())
+                entry = self.service.silences.add(rule, chip, ttl, time.time())
             except ValueError as e:
                 raise web.HTTPBadRequest(text=str(e))
             # re-annotate so the flag is live on the NEXT frame/alerts read,
             # not only after the next scrape cycle
-            self.service.silences.annotate(self.service.last_alerts, _time.time())
-            self.service.save_state()
+            self.service.silences.annotate(self.service.last_alerts, time.time())
+            await self._save_state()
             self._invalidate_frames()
         return web.json_response({"silenced": entry})
 
     async def unsilence_alert(self, request: web.Request) -> web.Response:
         """POST {rule?, chip?} — drop the exact (rule, chip) silence."""
-        import time as _time
-
         try:
             body = await request.json()
             rule = str(body.get("rule", "*") or "*")
@@ -683,18 +675,16 @@ class DashboardServer:
             raise web.HTTPBadRequest(text=f"bad unsilence request: {e}")
         async with self._lock:
             removed = self.service.silences.remove(rule, chip)
-            self.service.silences.annotate(self.service.last_alerts, _time.time())
-            self.service.save_state()
+            self.service.silences.annotate(self.service.last_alerts, time.time())
+            await self._save_state()
             self._invalidate_frames()
         if not removed:
             raise web.HTTPNotFound(text=f"no silence for {rule!r}/{chip!r}")
         return web.json_response({"removed": {"rule": rule, "chip": chip}})
 
     async def list_silences(self, request: web.Request) -> web.Response:
-        import time as _time
-
         async with self._lock:
-            active = self.service.silences.active(_time.time())
+            active = self.service.silences.active(time.time())
         return web.json_response({"silences": active})
 
     def _replay_source(self):
@@ -765,12 +755,10 @@ class DashboardServer:
             )
         from tpudash.alerts import prometheus_rules_yaml
 
-        import time as _time
-
         text = prometheus_rules_yaml(
             engine.rules,
             self.service.cfg.refresh_interval,
-            silences=self.service.silences.active(_time.time()),
+            silences=self.service.silences.active(time.time()),
         )
         return web.Response(
             text=text,

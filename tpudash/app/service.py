@@ -134,7 +134,16 @@ class DashboardService:
         #: chip keys seen in the last successful frame — the "currently
         #: available devices" selection ops validate against (app.py:281).
         self.available: list[str] = []
-        if cfg.state_path and self.state.load(cfg.state_path):
+        #: the composite state checkpoint, parsed ONCE: UI state here,
+        #: silences below, per-browser sessions by DashboardServer
+        from tpudash.app.state import read_state_doc
+
+        self._restored_state_doc: dict = (
+            read_state_doc(cfg.state_path) or {} if cfg.state_path else {}
+        )
+        if self._restored_state_doc and self.state.load_dict(
+            self._restored_state_doc
+        ):
             log.info("restored UI state from %s", cfg.state_path)
         #: rolling (wall_ts, {column: fleet-average}) per successful
         #: frame — trend history the reference never kept.  At the default
@@ -181,8 +190,9 @@ class DashboardService:
         #: to ride the state checkpoint (the service owns the file, the
         #: server owns the sessions)
         self.sessions_snapshot: "object | None" = None
-        if cfg.state_path:
-            self._load_silences()
+        items = self._restored_state_doc.get("silences")
+        if items:
+            self.silences = SilenceSet.from_dicts(items, time.time())
         #: fleet outlier scoring every refresh (tpudash.stragglers) — the
         #: chip gating the slice's lockstep step time, named, not just
         #: visible on the heatmap
@@ -201,20 +211,12 @@ class DashboardService:
         #: flush_webhooks must wait for both
         self._webhook_threads: set = set()
 
-    def _load_silences(self) -> None:
-        """Restore alert silences from the state checkpoint (they share
-        the TPUDASH_STATE_PATH file with UI state; see save_state)."""
-        import json as _json
-
-        from tpudash.alerts import SilenceSet
-
-        try:
-            with open(self.cfg.state_path) as f:
-                data = _json.load(f)
-            items = data.get("silences", []) if isinstance(data, dict) else []
-        except (OSError, ValueError):
-            return
-        self.silences = SilenceSet.from_dicts(items, time.time())
+    @property
+    def restored_sessions(self) -> dict:
+        """The checkpoint's per-browser session section (server restores
+        it into its SessionStore at construction)."""
+        sessions = self._restored_state_doc.get("sessions")
+        return sessions if isinstance(sessions, dict) else {}
 
     def save_state(self) -> None:
         """Persist the composite state checkpoint: the anonymous default
@@ -222,12 +224,12 @@ class DashboardService:
         registered its provider) the per-browser cookie-session map —
         atomically.  One file (cfg.state_path), one writer —
         SelectionState.save wrote only its own keys and would drop the
-        rest."""
+        rest.  Blocking disk I/O: the server calls this off the event
+        loop (executor)."""
         path = self.cfg.state_path
         if not path:
             return
-        import json as _json
-        import tempfile
+        from tpudash.app.state import atomic_write_json
 
         doc = self.state.to_dict()
         doc["silences"] = self.silences.to_dicts()
@@ -236,30 +238,33 @@ class DashboardService:
                 doc["sessions"] = self.sessions_snapshot()
             except Exception as e:  # noqa: BLE001 — sessions are best-effort
                 log.warning("session snapshot failed: %s", e)
-        try:
-            d = os.path.dirname(os.path.abspath(path))
-            fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
-            with os.fdopen(fd, "w") as f:
-                _json.dump(doc, f)
-            os.replace(tmp, path)
-        except OSError as e:
-            log.warning("could not persist state to %s: %s", path, e)
+        atomic_write_json(path, doc)
 
     def _notify_alert_transitions(self) -> None:
         """POST newly-firing and resolved alerts to Config.alert_webhook
         (the pager integration the reference's error banner couldn't be).
         Transition-edge only — a steadily-firing alert posts once.
-        Silenced alerts never enter the firing set, so an acknowledged
-        chip stops paging immediately — and a silence expiring while the
-        alert still fires IS a firing transition (it pages again).
-        Delivery is best-effort: failures log and never fail the frame."""
+
+        Silence semantics (Alertmanager-style): a silenced alert is
+        suppressed, not resolved.  Acknowledging a paged alert emits NO
+        webhook at all — 'resolved' would close the downstream incident
+        while the chip still breaches; a silence expiring mid-fire IS a
+        firing transition (it pages again); and an alert that recovers
+        while silenced stays suppressed (no late 'resolved' either)."""
         firing = {
             (a["rule"], a["chip"]): a
             for a in self.last_alerts
             if a["state"] == "firing" and not a.get("silenced")
         }
+        still_firing_silenced = {
+            (a["rule"], a["chip"])
+            for a in self.last_alerts
+            if a["state"] == "firing" and a.get("silenced")
+        }
         fired = [firing[k] for k in firing.keys() - self._firing_keys]
-        resolved = sorted(self._firing_keys - firing.keys())
+        resolved = sorted(
+            self._firing_keys - firing.keys() - still_firing_silenced
+        )
         self._firing_keys = set(firing)
         if (
             not self.cfg.alert_webhook

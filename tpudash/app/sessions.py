@@ -141,6 +141,15 @@ class SessionStore:
             return 0
         now = self._clock()
         restored = 0
+
+        def _idle(entry: dict) -> float:
+            # a corrupt idle_s must skew ONE entry, not crash restore
+            # (and thereby server startup) — treat it as ancient
+            try:
+                return float(entry.get("idle_s", 0.0))
+            except (TypeError, ValueError):
+                return float("inf")
+
         # most-recently-seen last, so LRU trimming keeps the freshest
         items = sorted(
             (
@@ -148,11 +157,11 @@ class SessionStore:
                 for sid, e in data.items()
                 if isinstance(e, dict)
             ),
-            key=lambda kv: -float(kv[1].get("idle_s", 0.0)),
+            key=lambda kv: -_idle(kv[1]),
         )
         for sid, item in items[-self.limit:]:
             try:
-                idle = float(item.get("idle_s", 0.0))
+                idle = _idle(item)
                 if idle >= self.ttl:
                     continue
                 state = SelectionState()

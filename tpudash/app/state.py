@@ -91,20 +91,23 @@ class SelectionState:
     def load(self, path: str) -> bool:
         """Restore state from a JSON checkpoint; missing/corrupt files are
         ignored (fresh state).  Returns True when state was restored."""
-        if not path or not os.path.exists(path):
+        doc = read_state_doc(path)
+        if doc is None:
             return False
+        return self.load_dict(doc)
+
+    def load_dict(self, data: dict) -> bool:
+        """Restore from an already-parsed checkpoint document (the
+        composite TPUDASH_STATE_PATH file is read ONCE at startup and the
+        relevant sections handed to each consumer)."""
         try:
-            with open(path) as f:
-                data = json.load(f)
-            if not isinstance(data, dict):
-                raise TypeError(f"checkpoint is {type(data).__name__}, not object")
             # parse everything before assigning anything: a bad field must
             # not leave the state half-restored
             selected = [str(k) for k in data.get("selected", [])]
             use_gauge = bool(data.get("use_gauge", True))
             last_selection = [str(k) for k in data.get("last_selection", [])]
-        except (OSError, json.JSONDecodeError, TypeError) as e:
-            log.warning("ignoring unreadable state checkpoint %s: %s", path, e)
+        except TypeError as e:
+            log.warning("ignoring unreadable state checkpoint: %s", e)
             return False
         # restore sorted (sync() relies on the mutator-maintained invariant
         # and never re-sorts; a hand-edited checkpoint must not break it)
@@ -117,14 +120,40 @@ class SelectionState:
         return True
 
     def save(self, path: str) -> None:
-        """Atomically persist state (write-temp + rename)."""
-        if not path:
-            return
-        try:
-            d = os.path.dirname(os.path.abspath(path))
-            fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
-            with os.fdopen(fd, "w") as f:
-                json.dump(self.to_dict(), f)
-            os.replace(tmp, path)
-        except OSError as e:
-            log.warning("could not persist state to %s: %s", path, e)
+        """Atomically persist state (write-temp + rename).  NOTE: the
+        dashboard service persists a COMPOSITE document via
+        DashboardService.save_state — this writes only the selection
+        keys and is for standalone SelectionState use."""
+        atomic_write_json(path, self.to_dict())
+
+
+def read_state_doc(path: str) -> "dict | None":
+    """Parse a state checkpoint file; None for missing/corrupt (callers
+    start fresh).  The ONE reader for the composite document."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise TypeError(f"checkpoint is {type(data).__name__}, not object")
+        return data
+    except (OSError, json.JSONDecodeError, TypeError) as e:
+        log.warning("ignoring unreadable state checkpoint %s: %s", path, e)
+        return None
+
+
+def atomic_write_json(path: str, doc: dict) -> None:
+    """Write-temp + rename; failures log, never raise (persistence is
+    best-effort).  The ONE writer both SelectionState.save and the
+    service's composite save_state share."""
+    if not path:
+        return
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        log.warning("could not persist state to %s: %s", path, e)
