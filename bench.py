@@ -23,23 +23,48 @@ N_CHIPS = 256
 N_FRAMES = 30
 
 
-def bench_dashboard() -> dict:
+def _bench_service(
+    per_slice: int,
+    generation: str = "v5e",
+    num_slices: int = 1,
+    emit_links: bool = False,
+    **cfg_kw,
+):
+    """Shared bench harness: a DashboardService over pre-serialized
+    replay payloads (each timed frame pays the real production cost —
+    decode the instant-query JSON off the wire via the native frame
+    kernel, normalize, render — and nothing else; payload fabrication is
+    setup, exactly as Prometheus's own response assembly is not the
+    dashboard's cost in deployment), warmed, select-all, timer cleared."""
     from tpudash.app.service import DashboardService
     from tpudash.config import Config
     from tpudash.sources.fixture import JsonReplaySource
 
-    # Replay pre-serialized Prometheus responses: each timed frame pays the
-    # real production cost — decode the instant-query JSON off the wire
-    # (native frame kernel when built), normalize, render — and nothing
-    # else.  Payload fabrication is setup, exactly as Prometheus's own
-    # response assembly is not the dashboard's cost in deployment.
-    cfg = Config(source="synthetic", synthetic_chips=N_CHIPS)
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=per_slice,
+        synthetic_slices=num_slices,
+        generation=generation,
+        **cfg_kw,
+    )
     svc = DashboardService(
-        cfg, JsonReplaySource.synthetic(N_CHIPS, generation="v5e", frames=8)
+        cfg,
+        JsonReplaySource.synthetic(
+            per_slice,
+            generation=generation,
+            frames=8,
+            num_slices=num_slices,
+            emit_links=emit_links,
+        ),
     )
     svc.render_frame()  # warm (imports, first pivot)
     svc.state.select_all(svc.available)
-    svc.timer.history.clear()  # warm-up frame must not contaminate p50/p95
+    svc.timer.history.clear()  # warm-up frames must not contaminate p50/p95
+    return svc
+
+
+def bench_dashboard() -> dict:
+    svc = _bench_service(N_CHIPS)
     frame = None
     for _ in range(N_FRAMES):
         prev = frame
@@ -74,18 +99,7 @@ def bench_3d_torus() -> dict:
     """3D-torus proof (v4, 4×4×8 = 128 chips): render cost plus a geometry
     assertion that the Z-planes actually unroll side by side (8 planes of
     4×4 with 1-column gaps → 4 rows × 39 columns)."""
-    from tpudash.app.service import DashboardService
-    from tpudash.config import Config
-    from tpudash.sources.fixture import JsonReplaySource
-
-    chips = 128  # v4 4×4×8 (topology._V4_SHAPES)
-    cfg = Config(source="synthetic", synthetic_chips=chips, generation="v4")
-    svc = DashboardService(
-        cfg, JsonReplaySource.synthetic(chips, generation="v4", frames=8)
-    )
-    svc.render_frame()
-    svc.state.select_all(svc.available)
-    svc.timer.history.clear()
+    svc = _bench_service(128, generation="v4")  # 4×4×8 (topology._V4_SHAPES)
     for _ in range(N_FRAMES):
         frame = svc.render_frame()
         assert frame["error"] is None
@@ -106,20 +120,7 @@ def bench_link_detail() -> dict:
     full cost — bigger payload parse, 6 extra derived columns, the
     coldest-link heatmap panel, straggler link rules — must stay deep
     inside the budget too."""
-    from tpudash.app.service import DashboardService
-    from tpudash.config import Config
-    from tpudash.sources.fixture import JsonReplaySource
-
-    cfg = Config(source="synthetic", synthetic_chips=N_CHIPS)
-    svc = DashboardService(
-        cfg,
-        JsonReplaySource.synthetic(
-            N_CHIPS, generation="v5e", frames=8, emit_links=True
-        ),
-    )
-    svc.render_frame()
-    svc.state.select_all(svc.available)
-    svc.timer.history.clear()
+    svc = _bench_service(N_CHIPS, emit_links=True)
     for _ in range(N_FRAMES):
         frame = svc.render_frame()
         assert frame["error"] is None
@@ -131,21 +132,8 @@ def bench_link_detail() -> dict:
 def bench_multislice() -> dict:
     """Secondary number: 2 slices × 256 chips (the BASELINE.json configs[4]
     multi-slice shape) with cross-slice DCN series, all 512 chips selected."""
-    from tpudash.app.service import DashboardService
-    from tpudash.config import Config
-    from tpudash.sources.fixture import JsonReplaySource
-
-    cfg = Config(source="synthetic", synthetic_chips=N_CHIPS, synthetic_slices=2)
-    svc = DashboardService(
-        cfg,
-        # num_chips is per slice: 2 × 256 = 512 chips total, DCN series on
-        JsonReplaySource.synthetic(
-            N_CHIPS, generation="v5p", frames=8, num_slices=2
-        ),
-    )
-    svc.render_frame()
-    svc.state.select_all(svc.available)
-    svc.timer.history.clear()
+    # per-slice chips: 2 × 256 = 512 chips total, DCN series on
+    svc = _bench_service(N_CHIPS, generation="v5p", num_slices=2)
     for _ in range(N_FRAMES):
         frame = svc.render_frame()
         assert frame["error"] is None
@@ -182,29 +170,16 @@ def bench_scale(
     must be ~0.  Growth here means a ring, session map, or cache is not
     actually bounded at this scale."""
     from tpudash.app.delta import frame_delta
-    from tpudash.app.service import DashboardService
-    from tpudash.config import Config
-    from tpudash.sources.fixture import JsonReplaySource
 
     slices = max(1, total_chips // N_CHIPS)
-    per_slice = total_chips // slices
-    cfg = Config(
-        source="synthetic",
-        synthetic_chips=per_slice,
-        synthetic_slices=slices,
+    svc = _bench_service(
+        total_chips // slices,
+        num_slices=slices,
         history_points=ring,
         # history appends are wall-clock-throttled to the refresh cadence;
         # 0 makes every bench frame append so the ring provably cycles
         refresh_interval=0.0,
     )
-    svc = DashboardService(
-        cfg,
-        JsonReplaySource.synthetic(
-            per_slice, generation="v5e", frames=8, num_slices=slices
-        ),
-    )
-    svc.render_frame()
-    svc.state.select_all(svc.available)
     frame = None
     for _ in range(ring + 2):  # fill both rings to their ceiling
         frame = svc.render_frame()

@@ -7,7 +7,6 @@ mirror, the mirror is eliminated.
 """
 
 import ast
-import copy
 import inspect
 import json
 import os
@@ -34,6 +33,31 @@ def _json_round(frame):
     """The client sees frames after JSON serialization — compare in that
     domain (tuples become lists, etc.)."""
     return json.loads(json.dumps(frame))
+
+
+def _fuzz_corpus():
+    """The randomized (prev, delta, cur) corpus from tests/test_delta.py:
+    yields every patchable tick across random selections/styles/fleet
+    sizes (seeded, deterministic) — shared by the Python-execution and
+    interpreted-JS parity tests."""
+    rng = random.Random(20260730)
+    for chips in (3, 17, 40):
+        svc = _svc(SyntheticSource(num_chips=chips), synthetic_chips=chips)
+        svc.render_frame()
+        prev = svc.render_frame()
+        for _ in range(12):
+            mutate = rng.random()
+            if mutate < 0.3:
+                svc.state.toggle(
+                    f"slice-0/{rng.randrange(chips)}", svc.available
+                )
+            elif mutate < 0.4:
+                svc.state.use_gauge = not svc.state.use_gauge
+            cur = svc.render_frame()
+            d = frame_delta(prev, cur)
+            if d is not None:
+                yield prev, d, cur
+            prev = cur
 
 
 # --- the client Python IS the shipped logic: corpus parity ------------------
@@ -64,30 +88,14 @@ def test_client_apply_delta_matches_at_heatmap_scale():
 
 
 def test_client_fuzz_corpus_byte_identical():
-    """The same randomized corpus as tests/test_delta.py, replayed
-    through the CLIENT logic: every patchable tick must reproduce the
-    full frame byte-identically after JSON round-tripping."""
-    rng = random.Random(20260730)
+    """The randomized corpus replayed through the CLIENT logic: every
+    patchable tick must reproduce the full frame byte-identically after
+    JSON round-tripping."""
     checked = 0
-    for chips in (3, 17, 40):
-        svc = _svc(SyntheticSource(num_chips=chips), synthetic_chips=chips)
-        svc.render_frame()
-        prev = svc.render_frame()
-        for _ in range(12):
-            mutate = rng.random()
-            if mutate < 0.3:
-                svc.state.toggle(
-                    f"slice-0/{rng.randrange(chips)}", svc.available
-                )
-            elif mutate < 0.4:
-                svc.state.use_gauge = not svc.state.use_gauge
-            cur = svc.render_frame()
-            d = frame_delta(prev, cur)
-            if d is not None:
-                got = clientlogic.apply_delta(_json_round(prev), _json_round(d))
-                assert got == _json_round(cur)
-                checked += 1
-            prev = cur
+    for prev, d, cur in _fuzz_corpus():
+        got = clientlogic.apply_delta(_json_round(prev), _json_round(d))
+        assert got == _json_round(cur)
+        checked += 1
     assert checked >= 10
 
 
@@ -273,29 +281,13 @@ def test_generated_js_executes_fuzz_corpus_byte_identical():
     reference merge byte-identically over the randomized corpus.  A
     transpiler bug emitting wrong-but-valid JS fails here."""
     interp = _interp()
-    rng = random.Random(20260730)
     checked = 0
-    for chips in (3, 17, 40):
-        svc = _svc(SyntheticSource(num_chips=chips), synthetic_chips=chips)
-        svc.render_frame()
-        prev = svc.render_frame()
-        for _ in range(12):
-            mutate = rng.random()
-            if mutate < 0.3:
-                svc.state.toggle(
-                    f"slice-0/{rng.randrange(chips)}", svc.available
-                )
-            elif mutate < 0.4:
-                svc.state.use_gauge = not svc.state.use_gauge
-            cur = svc.render_frame()
-            d = frame_delta(prev, cur)
-            if d is not None:
-                frame = _json_round(prev)
-                out = interp.call("apply_delta", frame, _json_round(d))
-                assert out is frame  # returns the patched frame itself
-                assert frame == _json_round(cur)
-                checked += 1
-            prev = cur
+    for prev, d, cur in _fuzz_corpus():
+        frame = _json_round(prev)
+        out = interp.call("apply_delta", frame, _json_round(d))
+        assert out is frame  # returns the patched frame itself
+        assert frame == _json_round(cur)
+        checked += 1
     assert checked >= 10
 
 

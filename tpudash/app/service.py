@@ -480,7 +480,6 @@ class DashboardService:
 
     def _save_history_locked(self, path: str) -> None:
         import json as _json
-        import os
         import tempfile
 
         with self._publish_lock:
@@ -546,7 +545,6 @@ class DashboardService:
         history file: two instances sharing a directory with distinct
         history files must not delete each other's in-flight saves."""
         import glob
-        import os
 
         full = os.path.abspath(self.cfg.history_path)
         d = os.path.dirname(full) or "."
@@ -570,7 +568,6 @@ class DashboardService:
         from last week must not render as if it were the last hour);
         any malformed file degrades to empty rings, never a crash."""
         import json as _json
-        import os
 
         path = self.cfg.history_path
         if not os.path.exists(path):
