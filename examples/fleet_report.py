@@ -63,9 +63,20 @@ def report(base: str, token: "str | None" = None) -> str:
         lines.append(f"warning: {warning}")
     for gap in frame.get("unavailable_panels", []):
         lines.append(f"gap: {gap['title']} — {gap['reason']}")
-    for a in [a for a in frame.get("alerts", []) if a["state"] == "firing"][:5]:
+    firing = [a for a in frame.get("alerts", []) if a["state"] == "firing"]
+    for a in [a for a in firing if not a.get("silenced")][:5]:
         lines.append(
             f"ALERT {a['severity']}: {a['chip']} {a['rule']} (={a['value']})"
+        )
+    silenced = sum(1 for a in firing if a.get("silenced"))
+    if silenced:
+        lines.append(f"({silenced} firing alert(s) silenced/acknowledged)")
+    # stragglers gate SPMD lockstep; per-link entries name the cable
+    for s in [s for s in frame.get("stragglers", []) if s["state"] == "firing"][:5]:
+        where = f"{s['chip']} link {s['link']}" if "link" in s else s["chip"]
+        lines.append(
+            f"STRAGGLER: {where} {s['column']} {s['value']} "
+            f"vs fleet {s['median']} (z={s['z']})"
         )
 
     by = (
@@ -85,6 +96,16 @@ def report(base: str, token: "str | None" = None) -> str:
         )
         if d["neighbors"]:
             lines.append(f"  ICI neighbors: {', '.join(d['neighbors'])}")
+        # per-link detail (sources with tpu_ici_link_* series): the
+        # coldest cable and its far end
+        links = [e for e in d.get("links", []) if e.get("gbps") is not None]
+        if links:
+            cold = min(links, key=lambda e: e["gbps"])
+            lines.append(
+                f"  coldest link: {cold['dir']} at {cold['gbps']} GB/s "
+                f"-> {cold['neighbor']}"
+                + (" (STRAGGLER)" if cold.get("straggler") else "")
+            )
     return "\n".join(lines)
 
 

@@ -207,7 +207,9 @@ def test_fleet_report_example_runs_against_a_live_server():
 
     async def go():
         cfg = Config(source="synthetic", refresh_interval=0.0, fetch_retries=0)
-        service = DashboardService(cfg, SyntheticSource(num_chips=16))
+        service = DashboardService(
+            cfg, SyntheticSource(num_chips=16, emit_links=True)
+        )
         client = TestClient(TestServer(DashboardServer(service).build_app()))
         await client.start_server()
         try:
@@ -244,6 +246,7 @@ def test_fleet_report_example_runs_against_a_live_server():
             out = mod.report("BASE")
             assert out.startswith("fleet: 16 chips")
             assert "hottest (" in out and "ICI neighbors:" in out
+            assert "coldest link:" in out  # per-link drill-down consumed
         finally:
             await client.close()
 
