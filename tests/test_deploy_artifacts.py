@@ -251,3 +251,35 @@ def test_fleet_report_example_runs_against_a_live_server():
             await client.close()
 
     asyncio.run(go())
+
+
+def test_every_route_is_documented():
+    """docs/API.md is the API's human contract: a route added without
+    documentation fails here, not in a user's confusion."""
+    import asyncio
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    async def routes():
+        svc = DashboardService(
+            Config(source="synthetic", refresh_interval=0.0),
+            SyntheticSource(num_chips=2),
+        )
+        app = DashboardServer(svc).build_app()
+        return sorted(
+            {
+                r.resource.canonical
+                for r in app.router.routes()
+                if r.resource is not None
+            }
+        )
+
+    with open(os.path.join(REPO, "docs", "API.md")) as f:
+        doc = f.read()
+    for path in asyncio.run(routes()):
+        assert f"`{path}`" in doc or f"`{path}?" in doc or path in doc, (
+            f"route {path} missing from docs/API.md"
+        )
