@@ -149,7 +149,12 @@ def test_client_disconnect_does_not_stack_fetches():
             src.gate.set()
             await asyncio.sleep(0.3)
             await client.get("/api/frame")  # harvest
-            assert src.fetches <= n_started + 2  # parked one + recovery one
+            # parked one + recovery one, +1 slack for the race where a
+            # disconnected handler outlives its client long enough to
+            # harvest and run the recovery fetch itself before the final
+            # GET adds another.  STACKING — the bug this test guards —
+            # would be one fetch per impatient client: n_started + 4+.
+            assert src.fetches <= n_started + 3
         finally:
             src.gate.set()
             await client.close()
