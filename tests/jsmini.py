@@ -144,6 +144,14 @@ class Parser:
             var = self.next()[1]
             self.expect("=")
             start = self.expr()
+            # optional extra init assignments: `i = 0, i__n = e; ...`
+            # (the transpiler captures counted-loop bounds this way)
+            inits = []
+            while self.peek()[1] == ",":
+                self.next()
+                extra_var = self.next()[1]
+                self.expect("=")
+                inits.append((extra_var, self.expr()))
             self.expect(";")
             cond = self.expr()
             self.expect(";")
@@ -151,7 +159,7 @@ class Parser:
                 raise JsError("counted loop must increment its own var")
             self.expect("++")
             self.expect(")")
-            return ("for", var, start, cond, self.block())
+            return ("for", var, start, inits, cond, self.block())
         if text == ";":
             self.next()
             return ("nop",)
@@ -350,8 +358,10 @@ class Interp:
             else:
                 self.run_block(s[3], scope)
         elif op == "for":
-            _, var, start, cond, body = s
+            _, var, start, inits, cond, body = s
             scope[var] = self.eval(start, scope)
+            for extra_var, extra_expr in inits:
+                scope[extra_var] = self.eval(extra_expr, scope)
             while self.truthy(self.eval(cond, scope)):
                 self.run_block(body, scope)
                 scope[var] = scope[var] + 1

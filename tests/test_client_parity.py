@@ -194,9 +194,11 @@ def test_transpiler_counted_and_forof_loops():
         return total
 
     js = transpile_function(fn)
-    assert "for (i = 0; i < xs.length; i++)" in js
+    # the bound is captured once, as Python's range(len(x)) does — a live
+    # `i < xs.length` would loop forever if the body appends to xs
+    assert "for (i = 0, i__n = xs.length; i < i__n; i++)" in js
     assert 'for (k of ["a", "b"])' in js
-    assert "let i, k, total;" in js
+    assert "let i, i__n, k, total;" in js
 
 
 def test_transpiler_rejects_bare_truthiness():

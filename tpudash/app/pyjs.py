@@ -228,7 +228,10 @@ class _Fn:
             raise TranspileError("loop target must be a plain name")
         var = node.target.id
         it = node.iter
-        # for i in range(len(x)):  →  counted loop
+        # for i in range(len(x)):  →  counted loop.  The bound is CAPTURED
+        # once (range() snapshots it in Python); a naive `i < x.length`
+        # would re-read every iteration and loop forever if the body
+        # appends to x — found by the differential fuzz.
         if (
             isinstance(it, ast.Call)
             and isinstance(it.func, ast.Name)
@@ -237,7 +240,10 @@ class _Fn:
             if len(it.args) != 1:
                 raise TranspileError("only range(len(x)) loops supported")
             bound = self.expr(it.args[0])
-            return f"for ({var} = 0; {var} < {bound}; {var}++)"
+            return (
+                f"for ({var} = 0, {var}__n = {bound}; "
+                f"{var} < {var}__n; {var}++)"
+            )
         # for x in <array expr>:  →  for-of (loop var hoisted like any
         # other local: Python loop variables outlive the loop)
         return f"for ({var} of {self.expr(it)})"
@@ -289,6 +295,14 @@ def _collect_locals(body, params: set) -> "set[str]":
         def visit_For(self, node):
             if isinstance(node.target, ast.Name):
                 names.add(node.target.id)
+                it = node.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range"
+                ):
+                    # counted loops capture their bound in <var>__n
+                    names.add(f"{node.target.id}__n")
             self.generic_visit(node)
 
     v = V()
