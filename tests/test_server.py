@@ -325,6 +325,18 @@ def test_auth_token_gates_everything_but_healthz():
         assert (await client.get("/api/frame?token=s3cret")).status == 401
         assert (await client.get("/api/frame?token=wrong")).status == 401
         assert (await client.get("/api/stream?token=wrong")).status == 401
+        # routes added later are covered by the middleware automatically —
+        # pin the mutating operator endpoints explicitly
+        for method, path in (
+            ("POST", "/api/alerts/silence"),
+            ("POST", "/api/alerts/unsilence"),
+            ("GET", "/api/alerts/silences"),
+            ("GET", "/api/replay"),
+            ("POST", "/api/replay"),
+            ("POST", "/api/profile"),
+        ):
+            r = await client.request(method, path, json={})
+            assert r.status == 401, f"{method} {path} not auth-gated"
 
     _run(_with_client(_client_app(cfg), go))
 
