@@ -89,3 +89,23 @@ def test_main_straggler_names_the_link(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "STRAGGLERS:" in out
     assert "slice-0/3 link x- ici_link_xn_gbps" in out
+
+
+def test_chip_drilldown_shows_per_link_table(capsys, monkeypatch):
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_LINKS", "1")
+    assert main(
+        ["--source", "synthetic", "--chips", "16", "--chip", "slice-0/0"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "link" in out and "far end" in out
+    for d in ("x+", "x-", "y+", "y-"):
+        assert d in out
+    assert "slice-0/1" in out  # x+ far end on the 4x4 torus
+
+
+def test_chip_drilldown_neighbors_without_link_series(capsys):
+    assert main(
+        ["--source", "synthetic", "--chips", "16", "--chip", "slice-0/0"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "ICI neighbors:" in out

@@ -92,11 +92,20 @@ def render_chip(df, stats, key: str) -> str:
         p95 = fmt.format(s["p95"]) if s else "-"
         lines.append(f"{header:<10}{val:>10}{mean:>12}{p95:>11}")
     try:
-        from tpudash.normalize import torus_neighbor_keys
+        from tpudash.normalize import chip_links, torus_neighbor_keys
 
-        keys = torus_neighbor_keys(df, key)
-        if keys:
-            lines += ["", "ICI neighbors: " + "  ".join(keys)]
+        links = chip_links(df, key)
+        if links:
+            lines += ["", f"{'link':<6}{'GB/s':>8}  far end"]
+            for e in links:
+                gbps = "-" if e["gbps"] is None else f"{e['gbps']:.2f}"
+                lines.append(
+                    f"{e['dir']:<6}{gbps:>8}  {e['neighbor'] or '-'}"
+                )
+        else:
+            keys = torus_neighbor_keys(df, key)
+            if keys:
+                lines += ["", "ICI neighbors: " + "  ".join(keys)]
     except Exception:  # noqa: BLE001 — neighbors are best-effort context
         pass
     return "\n".join(lines)
