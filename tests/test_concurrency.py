@@ -179,3 +179,83 @@ def test_sessions_stream_and_mutate_concurrently():
             assert grid_selected == set(sel)
 
     _run(_with_client(_app(chips=32), go))
+
+
+def test_operator_endpoints_under_concurrent_load(tmp_path):
+    """Silence/unsilence (state-checkpoint writers) and replay seeks
+    (forced refresh under the frame lock) hammered concurrently with
+    frames and SSE subscribers: every response well-formed, no deadlock,
+    and the final silence set consistent."""
+    import glob
+
+    from tpudash.sources import make_source
+    from tpudash.config import load_config
+
+    sample = os.path.join(
+        os.path.dirname(__file__), os.pardir, "examples",
+        "sample-recording.jsonl",
+    )
+    cfg = load_config(
+        {
+            "TPUDASH_SOURCE": "replay",
+            "TPUDASH_REPLAY_PATH": sample,
+            "TPUDASH_REFRESH_INTERVAL": "0",
+            "TPUDASH_STATE_PATH": str(tmp_path / "state.json"),
+            "TPUDASH_ALERT_RULES": "tpu_tensorcore_utilization>0:warning@1",
+        }
+    )
+    service = DashboardService(cfg, make_source(cfg))
+    app = DashboardServer(service).build_app()
+
+    async def go(client):
+        async def frames(n):
+            for _ in range(n):
+                frame = await (await client.get("/api/frame")).json()
+                assert "alerts" in frame
+
+        async def silencer(n):
+            for i in range(n):
+                r = await client.post(
+                    "/api/alerts/silence",
+                    json={"chip": f"slice-0/{i % 4}", "ttl_s": 60},
+                )
+                assert r.status == 200
+                if i % 3 == 0:
+                    await client.post(
+                        "/api/alerts/unsilence",
+                        json={"chip": f"slice-0/{i % 4}"},
+                    )
+
+        async def scrubber(n):
+            for i in range(n):
+                r = await client.post(
+                    "/api/replay", json={"index": i % 6, "paused": i % 2 == 0}
+                )
+                assert r.status == 200
+
+        async def streamer():
+            resp = await client.get(
+                "/api/stream", headers={"Accept": "text/event-stream"}
+            )
+            raw = b""
+            while b"\n\n" not in raw:
+                raw += await resp.content.read(4096)
+            assert _sse_json(raw.split(b"\n\n")[0])["kind"] == "full"
+            resp.close()
+
+        await asyncio.gather(
+            frames(12), silencer(12), scrubber(12), streamer()
+        )
+        # final state consistent and persisted
+        active = (await (await client.get("/api/alerts/silences")).json())[
+            "silences"
+        ]
+        assert all(s["chip"].startswith("slice-0/") for s in active)
+        doc = json.loads((tmp_path / "state.json").read_text())
+        assert len(doc["silences"]) == len(active)
+        # resume auto-advance so nothing lingers paused
+        await client.post("/api/replay", json={"paused": False})
+        # the atomic state writes left no temp droppings
+        assert glob.glob(str(tmp_path / ".state-*")) == []
+
+    _run(_with_client(app, go))
