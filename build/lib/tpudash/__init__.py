@@ -1,0 +1,39 @@
+"""tpudash — TPU-native Kubernetes metrics dashboard.
+
+A ground-up, TPU-first rebuild of the capabilities of
+``ontheklaud/k8s-rocm-metrics-dashboard`` (reference: ``app.py``, 488 lines):
+a live dashboard over Prometheus-scraped accelerator hardware metrics.
+
+Where the reference polls ``amd_gpu_*`` series from a ROCm node exporter and
+renders per-GPU Plotly gauges in a blocking Streamlit loop
+(reference app.py:153-227, 320-486), tpudash:
+
+- speaks a clean ``MetricsSource`` seam (Prometheus HTTP / static fixture /
+  live on-chip JAX probe) so the whole stack tests without a cluster,
+- models TPU pod-slice topology (v4/v5e/v5p/v6e torus coordinates) and renders
+  a per-chip topology heatmap that scales to 256+ chips, where the
+  reference's one-figure-per-metric-per-device pattern cannot,
+- ships the node-exporter side too: on-chip probes (MXU matmul FLOPs, HBM
+  bandwidth via Pallas, ICI collective bandwidth over a jax Mesh) exported in
+  Prometheus text format — the reference only *consumed* such an exporter,
+- serves an async (aiohttp) dashboard instead of a blocking
+  ``while True: time.sleep`` Streamlit script.
+
+Layer map (mirrors SURVEY.md §1, bottom-up):
+  L1  config / registry / colors / schema / topology
+  L2  sources/ + normalize.py        (data acquisition & normalization)
+  L3  viz/                           (figure builders, pure plotly-JSON dicts)
+  L4  app/                           (dashboard server / UI shell)
+  aux ops/ parallel/ models/         (on-chip probe + demo-workload sources)
+      exporter/                      (Prometheus exposition endpoint)
+"""
+
+__version__ = "0.1.0"
+
+from tpudash.config import Config, load_config  # noqa: F401
+from tpudash.registry import (  # noqa: F401
+    TPU_GENERATIONS,
+    TpuGeneration,
+    resolve_generation,
+)
+from tpudash.colors import COLOR_BANDS, color_for_value  # noqa: F401

@@ -1,0 +1,13 @@
+"""``python -m tpudash`` — run the dashboard server.
+
+The reference launches as ``streamlit run app.py`` (app.py:488-489); this is
+the equivalent entry point.  Configuration comes from the environment (see
+tpudash.config); e.g. a cluster-free demo at 256 synthetic chips:
+
+    TPUDASH_SOURCE=synthetic TPUDASH_SYNTHETIC_CHIPS=256 python -m tpudash
+"""
+
+from tpudash.app.server import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,486 @@
+"""Threshold alert rules over the per-chip wide table.
+
+The reference has no alerting of any kind (SURVEY.md §5 "failure
+detection: limited to the catch-all error banner", app.py:225-227) — the
+operator is expected to stare at gauges.  tpudash evaluates Prometheus
+`alerting rule`-style threshold rules on every frame, with a ``for``-style
+hysteresis (a rule must breach N consecutive frames before it fires, so a
+single noisy scrape doesn't page anyone), and surfaces firing alerts in
+the frame, the ``/api/alerts`` endpoint and the page banner.
+
+Rule spec grammar (``TPUDASH_ALERT_RULES``, comma-separated):
+
+    column OP threshold [: severity] [@ cycles]
+
+e.g. ``tpu_temperature_celsius>85:critical@2, hbm_usage_ratio>90:warning``.
+OP is one of ``>`` ``>=`` ``<`` ``<=``; severity defaults to "warning";
+cycles (the consecutive-breach requirement) defaults to 1.
+"""
+
+from __future__ import annotations
+
+import operator
+import re
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from tpudash.hysteresis import TrackSet
+
+_OPS = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "<": operator.lt,
+    "<=": operator.le,
+}
+
+SEVERITIES = ("warning", "critical")
+
+#: Default rules: conservative hardware-health thresholds.  Temperature and
+#: HBM-pressure limits apply across generations; both require 2 consecutive
+#: breaching frames.
+DEFAULT_RULES_SPEC = (
+    "tpu_temperature_celsius>85:critical@2,"
+    "hbm_usage_ratio>92:warning@2"
+)
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    column: str
+    op: str
+    threshold: float
+    severity: str = "warning"
+    for_cycles: int = 1
+
+    @property
+    def name(self) -> str:
+        return f"{self.column}{self.op}{self.threshold:g}"
+
+    def breaches(self, value: float) -> bool:
+        return bool(_OPS[self.op](value, self.threshold))
+
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<column>[A-Za-z_][A-Za-z0-9_]*)\s*"
+    r"(?P<op>>=|<=|>|<)\s*"
+    r"(?P<threshold>-?[0-9.]+)\s*"
+    r"(?::\s*(?P<severity>[A-Za-z]+))?\s*"
+    r"(?:@\s*(?P<cycles>[0-9]+))?\s*$"
+)
+
+
+def parse_rules(spec: str) -> list[AlertRule]:
+    rules = []
+    for item in spec.split(","):
+        if not item.strip():
+            continue
+        m = _RULE_RE.match(item)
+        if not m:
+            raise ValueError(f"bad alert rule spec: {item!r}")
+        severity = (m.group("severity") or "warning").lower()
+        if severity in ("crit", "critical"):
+            severity = "critical"
+        elif severity in ("warn", "warning"):
+            severity = "warning"
+        else:
+            raise ValueError(
+                f"bad severity {severity!r} in rule {item!r} "
+                f"(expected one of {SEVERITIES})"
+            )
+        rules.append(
+            AlertRule(
+                column=m.group("column"),
+                op=m.group("op"),
+                threshold=float(m.group("threshold")),
+                severity=severity,
+                for_cycles=int(m.group("cycles") or 1),
+            )
+        )
+    return rules
+
+
+@dataclass
+class AlertEngine:
+    """Per-frame rule evaluation with consecutive-breach hysteresis
+    (state machine in tpudash.hysteresis, shared with the straggler
+    detector)."""
+
+    rules: list[AlertRule]
+    clock: "object" = time.time
+    _tracks: TrackSet = field(default_factory=TrackSet)
+
+    @classmethod
+    def from_spec(cls, spec: str | None = None, clock=time.time) -> "AlertEngine":
+        return cls(rules=parse_rules(
+            DEFAULT_RULES_SPEC if spec is None else spec
+        ), clock=clock)
+
+    @classmethod
+    def from_config(cls, cfg, clock=time.time) -> "AlertEngine | None":
+        """The one place Config.alert_rules is interpreted (dashboard
+        service and terminal CLI both call this): disable sentinels →
+        None, "" → built-in defaults, anything else parsed as a spec
+        (ValueError on a malformed one)."""
+        if cfg.alert_rules.strip().lower() in ("off", "none", "disabled"):
+            return None
+        # strip so a stray-whitespace value still means "built-in defaults"
+        return cls.from_spec(cfg.alert_rules.strip() or None, clock=clock)
+
+    def evaluate(self, df: pd.DataFrame) -> list[dict]:
+        """Evaluate all rules against the wide table (index = chip key).
+
+        Returns firing+pending alerts, critical first, then by chip key.
+        Chips that left the table (scrape gap, reconfiguration) are
+        dropped from tracking — their alerts resolve implicitly.
+        """
+        now = float(self.clock())
+        seen = set()
+        out = []
+        for rule in self.rules:
+            if rule.column not in df.columns:
+                continue
+            series = pd.to_numeric(df[rule.column], errors="coerce")
+            # vectorized breach test: on a healthy fleet no chip breaches,
+            # so the per-chip Python loop below runs zero times instead of
+            # chips×rules times (profiled ~10% of a 256-chip frame).
+            # Non-breaching chips never enter `seen`, so their stale
+            # tracks fall to the implicit-resolution sweep — the same
+            # delete the explicit else-branch used to do.
+            values = series.to_numpy(dtype=float, na_value=np.nan)
+            with np.errstate(invalid="ignore"):
+                mask = _OPS[rule.op](values, rule.threshold)
+            mask &= ~np.isnan(values)
+            if not mask.any():
+                continue
+            keys = series.index
+            for i in np.nonzero(mask)[0]:
+                chip_key = keys[i]
+                value = values[i]
+                tkey = (rule.name, chip_key)
+                seen.add(tkey)
+                track, firing = self._tracks.hit(tkey, rule.for_cycles, now)
+                track.last_value = float(value)
+                out.append(
+                    {
+                        "rule": rule.name,
+                        "column": rule.column,
+                        "severity": rule.severity,
+                        "chip": str(chip_key),
+                        "value": round(float(value), 2),
+                        "threshold": rule.threshold,
+                        "state": "firing" if firing else "pending",
+                        "since": track.firing_since,
+                        "streak": track.streak,
+                    }
+                )
+        # implicit resolution for chips/rules not seen this frame
+        self._tracks.resolve_unseen(seen)
+        out.sort(
+            key=lambda a: (
+                a["state"] != "firing",
+                a["severity"] != "critical",
+                a["chip"],
+            )
+        )
+        return out
+
+    def firing(self, alerts: list[dict] | None = None) -> list[dict]:
+        return [a for a in (alerts or []) if a["state"] == "firing"]
+
+
+# ---------------------------------------------------------------------------
+# Silences — the operator workflow the rules alone lack: a known-flapping
+# chip must be acknowledgeable without editing TPUDASH_ALERT_RULES and
+# restarting.  A silence scopes to (rule, chip) with "*" wildcards and a
+# TTL; silenced alerts stay visible (flagged, dimmed in the banner) but
+# never page the webhook.  When a silence expires while the alert is
+# still firing, the next frame pages — expiry is a firing transition from
+# the pager's point of view.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Silence:
+    rule: str      # rule name (AlertRule.name) or "*"
+    chip: str      # chip key or "*"
+    until: float   # epoch seconds
+    created: float
+
+    def matches(self, rule: str, chip: str) -> bool:
+        return self.rule in ("*", rule) and self.chip in ("*", chip)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "chip": self.chip,
+            "until": self.until,
+            "created": self.created,
+        }
+
+
+@dataclass
+class SilenceSet:
+    """Active alert silences with TTL expiry and wildcard matching.
+
+    Bounded: adding an exact duplicate (rule, chip) replaces the old
+    entry (the common "extend my silence" gesture), and expired entries
+    are pruned on every read."""
+
+    _silences: list = field(default_factory=list)
+    max_entries: int = 1000
+
+    def add(self, rule: str, chip: str, ttl_s: float, now: float) -> dict:
+        import math
+
+        # `not (> 0)` so NaN is rejected too — a NaN `until` would never
+        # match any is_silenced check while the API reported success
+        if not (ttl_s > 0) or not math.isfinite(ttl_s):
+            raise ValueError(
+                f"silence ttl must be positive and finite, got {ttl_s}"
+            )
+        rule, chip = rule or "*", chip or "*"
+        for value, what in ((rule, "rule"), (chip, "chip")):
+            # these strings are embedded in the exported Prometheus rule
+            # file's comments — newlines/control chars would inject lines
+            if any(ord(ch) < 0x20 or ord(ch) == 0x7F for ch in value):
+                raise ValueError(f"control characters in silence {what}")
+            if len(value) > 200:
+                raise ValueError(f"silence {what} too long")
+        self._silences = [
+            s for s in self._silences if (s.rule, s.chip) != (rule, chip)
+        ]
+        if len(self._silences) >= self.max_entries:
+            raise ValueError(f"too many active silences (>{self.max_entries})")
+        s = Silence(rule=rule, chip=chip, until=now + ttl_s, created=now)
+        self._silences.append(s)
+        return s.to_dict()
+
+    def remove(self, rule: str, chip: str) -> bool:
+        """Drop the exact (rule, chip) silence; True when one existed."""
+        rule, chip = rule or "*", chip or "*"
+        before = len(self._silences)
+        self._silences = [
+            s for s in self._silences if (s.rule, s.chip) != (rule, chip)
+        ]
+        return len(self._silences) < before
+
+    def prune(self, now: float) -> None:
+        self._silences = [s for s in self._silences if s.until > now]
+
+    def active(self, now: float) -> list[dict]:
+        self.prune(now)
+        return [s.to_dict() for s in self._silences]
+
+    def is_silenced(self, rule: str, chip: str, now: float) -> bool:
+        self.prune(now)
+        return any(s.matches(rule, chip) for s in self._silences)
+
+    def annotate(self, alerts: "list[dict]", now: float) -> "list[dict]":
+        """Stamp ``silenced`` on each alert entry (in place; returned for
+        chaining).  Runs once per frame, after evaluation."""
+        self.prune(now)
+        sil = self._silences
+        for a in alerts:
+            a["silenced"] = any(s.matches(a["rule"], a["chip"]) for s in sil)
+        return alerts
+
+    # -- persistence (rides the TPUDASH_STATE_PATH checkpoint) ---------------
+    def to_dicts(self) -> list[dict]:
+        return [s.to_dict() for s in self._silences]
+
+    @classmethod
+    def from_dicts(cls, items, now: float) -> "SilenceSet":
+        out = cls()
+        try:
+            for item in items or []:
+                s = Silence(
+                    rule=str(item["rule"]),
+                    chip=str(item["chip"]),
+                    until=float(item["until"]),
+                    created=float(item.get("created", now)),
+                )
+                if s.until > now:
+                    out._silences.append(s)
+        except (KeyError, TypeError, ValueError):
+            return cls()  # corrupt checkpoint section → no silences
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus alerting-rule export — the in-app thresholds and the cluster
+# pager must agree (one rule source, two enforcement points).
+# ---------------------------------------------------------------------------
+
+def _series_expr(name: str) -> str:
+    """A canonical series as PromQL that also matches its real-world
+    dialect spellings: the Prometheus evaluating these rules scrapes the
+    RAW exporter (GKE device-plugin series like ``duty_cycle``) — only
+    tpudash renames at its own parse (compat.SERIES_ALIASES).  Dotted
+    libtpu metric ids are excluded (not valid PromQL metric names; their
+    underscore forms are already in the alias table)."""
+    from tpudash import compat
+
+    aliases = sorted(
+        src
+        for src, dst in compat.SERIES_ALIASES.items()
+        if dst == name and "." not in src
+    )
+    if not aliases:
+        return name
+    return "(" + " or ".join([name, *aliases]) + ")"
+
+
+def _sum_expr(a: str, b: str) -> str:
+    """``a + b`` where a missing side counts as 0, mirroring the in-app
+    derive (normalize._derive: ``df.get(..., 0.0)``).  Plain PromQL vector
+    addition drops series with no match on the other side, so a one-sided
+    source would silently produce an empty vector."""
+    ea, eb = _series_expr(a), _series_expr(b)
+    return f"(({ea} + {eb}) or {ea} or {eb})"
+
+
+def _derived_promql(column: str) -> "str | None":
+    """PromQL recomputing a tpudash DERIVED column from raw scraped series
+    (formulas mirror normalize._derive / _batch_to_wide)."""
+    if column == "hbm_usage_ratio":
+        used = _series_expr("tpu_hbm_used_bytes")
+        total = _series_expr("tpu_hbm_total_bytes")
+        return f"{used} / ({total} > 0) * 100"
+    if column == "hbm_used_gib":
+        return f"{_series_expr('tpu_hbm_used_bytes')} / 1073741824"
+    if column == "ici_total_gbps":
+        return (
+            _sum_expr(
+                "tpu_ici_tx_bytes_per_second", "tpu_ici_rx_bytes_per_second"
+            )
+            + " / 1e9"
+        )
+    if column == "dcn_total_gbps":
+        return (
+            _sum_expr(
+                "tpu_dcn_tx_bytes_per_second", "tpu_dcn_rx_bytes_per_second"
+            )
+            + " / 1e9"
+        )
+    return None
+
+
+def rule_promql(rule: AlertRule) -> str:
+    """One rule's PromQL alert expression (alias-aware, derived-column
+    aware)."""
+    derived = _derived_promql(rule.column)
+    base = f"({derived})" if derived else _series_expr(rule.column)
+    return f"{base} {rule.op} {rule.threshold:g}"
+
+
+def prometheus_rules_yaml(
+    rules: "list[AlertRule]",
+    refresh_interval: float = 5.0,
+    silences: "list[dict] | None" = None,
+) -> str:
+    """The engine's rules as a Prometheus alerting-rule file (YAML).
+
+    ``for:`` carries the same hysteresis the in-app engine applies:
+    for_cycles consecutive breaching frames ≈ for_cycles × the scrape /
+    refresh interval.  Emitted by hand (sorted keys, quoted strings) so
+    the output is stable and needs no YAML dependency at runtime; the
+    round-trip test parses it back with a real YAML loader.
+
+    Active in-app ``silences`` are carried as annotations: a rule
+    silenced fleet-wide (chip "*") gets ``tpudash_silenced`` +
+    ``tpudash_silenced_until`` so the Alertmanager side can see the
+    dashboard's acknowledgement; chip-scoped silences are listed in a
+    header comment (Prometheus rule files have no per-chip scope).
+    """
+    def _duration(seconds: float) -> str:
+        # Prometheus durations take integer units only — "2.5s" rejects
+        # the whole rule file; fractional values are expressed in ms
+        if seconds == int(seconds):
+            return f"{int(seconds)}s"
+        return f"{int(round(seconds * 1000))}ms"
+
+    interval = max(refresh_interval, 1.0)
+    interval_str = _duration(interval)
+    silences = silences or []
+    lines = [
+        "# Generated by tpudash — mirror of TPUDASH_ALERT_RULES so the",
+        "# dashboard banner and the cluster pager fire on the same",
+        "# conditions.  Load via prometheus rule_files.",
+    ]
+    def _clean(v: str) -> str:
+        # defense in depth (add() already rejects control chars): nothing
+        # a silence carries may break out of a YAML comment line
+        return "".join(ch for ch in str(v) if ord(ch) >= 0x20)[:200]
+
+    chip_scoped = [s for s in silences if s["chip"] != "*"]
+    if chip_scoped:
+        lines.append(
+            "# Active chip-scoped silences in the dashboard (no per-chip"
+        )
+        lines.append("# scope in a Prometheus rule file):")
+        for s in sorted(chip_scoped, key=lambda s: (s["rule"], s["chip"])):
+            lines.append(
+                f"#   {_clean(s['rule'])} on {_clean(s['chip'])} "
+                f"until {s['until']:.0f}"
+            )
+    lines += [
+        "groups:",
+        "- name: tpudash",
+        f"  interval: {interval_str}",
+        "  rules:",
+    ]
+    fleet_silenced = {
+        s["rule"]: s["until"] for s in silences if s["chip"] == "*"
+    }
+    op_words = {">": "Gt", ">=": "Ge", "<": "Lt", "<=": "Le"}
+    for rule in rules:
+        # the in-app engine fires on the Nth consecutive breaching frame;
+        # Prometheus `for: D` fires once a breach has persisted D beyond
+        # its first evaluation, i.e. ~N evaluations for D=(N-1)*interval.
+        # D=N*interval would need N+1 — one cycle stricter than the banner.
+        hold = _duration((rule.for_cycles - 1) * interval)
+        # name carries column+op+threshold so several rules on one column
+        # stay distinct (duplicate alert names collapse in Alertmanager)
+        # alert names allow [a-zA-Z0-9_] only: dots → "_", sign chars from
+        # "%g" exponent forms ("1e+11", "-5") → words / dropped
+        threshold_part = (
+            f"{rule.threshold:g}"
+            .replace(".", "_")
+            .replace("-", "Minus")
+            .replace("+", "")
+        )
+        alert_name = (
+            "Tpudash"
+            + "".join(part.capitalize() for part in rule.column.split("_"))
+            + op_words[rule.op]
+            + threshold_part
+        )
+        lines += [
+            f"  - alert: {alert_name}",
+            f"    expr: {rule_promql(rule)}",
+            f"    for: {hold}",
+            "    labels:",
+            f"      severity: {rule.severity}",
+            "    annotations:",
+            (
+                "      summary: '{{ $labels.chip_id }} "
+                f"{rule.column} {rule.op} {rule.threshold:g} "
+                "(value {{ $value }})'"
+            ),
+            (
+                f"      description: 'tpudash rule {rule.name}: breach held "
+                f"for {rule.for_cycles} consecutive "
+                f"{'frame' if rule.for_cycles == 1 else 'frames'} "
+                f"(hold {hold} at a {interval_str} cadence)'"
+            ),
+        ]
+        until = fleet_silenced.get(rule.name, fleet_silenced.get("*"))
+        if until is not None:
+            lines += [
+                "      tpudash_silenced: 'true'",
+                f"      tpudash_silenced_until: '{until:.0f}'",
+            ]
+    return "\n".join(lines) + "\n"

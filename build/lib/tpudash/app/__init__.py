@@ -1,0 +1,12 @@
+"""L4 app shell — dashboard service + async web server.
+
+Replaces the reference's Streamlit script (app.py:247-489).  The blocking
+``while True: fetch → render → time.sleep(5)`` loop (app.py:326, 486) that
+fights Streamlit's rerun model becomes an async server: the browser polls
+``/api/frame`` on the refresh interval; selection and style state live
+server-side with the same semantics the reference keeps in
+``st.session_state`` (SURVEY.md §3.4).
+"""
+
+from tpudash.app.state import SelectionState  # noqa: F401
+from tpudash.app.service import DashboardService  # noqa: F401

@@ -1,0 +1,181 @@
+"""Frame-diff transport: steady-state SSE ticks send values, not layout.
+
+A full 256-chip select-all frame serializes to ~125 KB (BENCH_r03
+``sse_full_frame_bytes``) because every tick re-ships figure *structure*:
+axis bands, colorscales, hover prefixes, customdata key grids, titles.
+Between two frames of the same shape, only the *values* move: gauge
+readings (and their band color), heatmap z-matrices, sparkline points,
+stats, breakdowns, alerts, timings.
+
+``frame_delta(prev, cur)`` returns that value-only payload — or None
+whenever the structural signature changed (selection, style, panel set,
+chip population, axis maxima, figure types), in which case the caller
+sends a full frame.  ``apply_delta(prev, delta)`` is the reference merge:
+``apply_delta(prev, frame_delta(prev, cur)) == cur`` exactly (pinned by
+tests/test_delta.py); the page's ``applyDelta`` in app/html.py mirrors it
+field for field — change both together.
+"""
+
+from __future__ import annotations
+
+import copy
+
+#: top-level frame fields copied verbatim into every delta (cheap, and
+#: they change every tick or matter for correctness when they do)
+SCALAR_FIELDS = (
+    "last_updated",
+    "timings",
+    "source_health",
+    "alerts",
+    "stragglers",
+    "warnings",
+    "stats",
+    "breakdown",
+    "unavailable_panels",
+)
+
+
+def _gauge_like(figure: dict) -> tuple:
+    """(type, axis_max) for a gauge/bar panel figure.  Any other trace
+    type raises: _signature's catch turns that into a full-frame fallback
+    instead of letting _fig_value crash the stream on a figure kind the
+    patch protocol doesn't know."""
+    trace = figure["data"][0]
+    if trace["type"] == "indicator":
+        return ("indicator", trace["gauge"]["axis"]["range"][1])
+    if trace["type"] == "bar":
+        return ("bar", figure["layout"]["xaxis"]["range"][1])
+    raise TypeError(f"unpatchable figure type {trace['type']!r}")
+
+
+def _signature(frame: dict) -> "tuple | None":
+    """Structural fingerprint: two frames with equal signatures can be
+    patched into each other with values alone."""
+    if frame.get("error") is not None:
+        return None  # error frames have no figures — always send full
+    avg = frame.get("average")
+    try:
+        return (
+            frame.get("use_gauge"),
+            frame.get("refresh_interval"),
+            tuple(frame.get("selected", ())),
+            tuple(
+                (c["key"], c.get("model"), c.get("host"), c.get("slice"))
+                for c in frame.get("chips", ())
+            ),
+            tuple(p["column"] for p in frame.get("panel_specs", ())),
+            tuple(
+                (f["panel"], _gauge_like(f["figure"]))
+                for f in (avg["figures"] if avg else ())
+            ),
+            tuple(
+                (
+                    r["key"],
+                    tuple(
+                        (f["panel"], _gauge_like(f["figure"]))
+                        for f in r["figures"]
+                    ),
+                )
+                for r in frame.get("device_rows", ())
+            ),
+            tuple(
+                (
+                    h["panel"],
+                    h["slice"],
+                    len(h["figure"]["data"][0]["z"]),
+                    len(h["figure"]["data"][0]["z"][0]),
+                    h["figure"]["data"][0].get("zmax"),
+                )
+                for h in frame.get("heatmaps", ())
+            ),
+            tuple(
+                (
+                    t["panel"],
+                    t["figure"]["layout"]["yaxis"]["range"][1],
+                )
+                for t in frame.get("trends", ())
+            ),
+        )
+    except (KeyError, IndexError, TypeError):
+        return None  # unexpected shape → be safe, send full
+
+
+def _fig_value(figure: dict) -> dict:
+    trace = figure["data"][0]
+    if trace["type"] == "indicator":
+        return {"value": trace["value"], "color": trace["gauge"]["bar"]["color"]}
+    return {"value": trace["x"][0], "color": trace["marker"]["color"]}
+
+
+def frame_delta(prev: "dict | None", cur: dict) -> "dict | None":
+    """Value-only patch taking ``prev`` to ``cur``, or None when the
+    structure changed and only a full frame is faithful."""
+    if prev is None:
+        return None
+    sig = _signature(cur)
+    if sig is None or sig != _signature(prev):
+        return None
+    delta: dict = {"kind": "delta"}
+    for field in SCALAR_FIELDS:
+        if field in cur:
+            delta[field] = cur[field]
+    avg = cur.get("average")
+    if avg:
+        delta["average"] = [_fig_value(f["figure"]) for f in avg["figures"]]
+    if cur.get("device_rows"):
+        delta["device_rows"] = [
+            [_fig_value(f["figure"]) for f in r["figures"]]
+            for r in cur["device_rows"]
+        ]
+    if cur.get("heatmaps"):
+        delta["heatmaps"] = [
+            h["figure"]["data"][0]["z"] for h in cur["heatmaps"]
+        ]
+    if cur.get("trends"):
+        delta["trends"] = [
+            {
+                "x": t["figure"]["data"][0]["x"],
+                "y": t["figure"]["data"][0]["y"],
+                "color": t["figure"]["data"][0]["line"]["color"],
+            }
+            for t in cur["trends"]
+        ]
+    return delta
+
+
+def apply_delta(prev: dict, delta: dict) -> dict:
+    """Reference merge (the page's JS applyDelta mirrors this).  Returns a
+    NEW frame dict; ``prev`` is not mutated."""
+    frame = copy.deepcopy(prev)
+    for field in SCALAR_FIELDS:
+        if field in delta:
+            frame[field] = delta[field]
+        else:
+            frame.pop(field, None)
+
+    def patch_fig(figure: dict, patch: dict) -> None:
+        trace = figure["data"][0]
+        if trace["type"] == "indicator":
+            trace["value"] = patch["value"]
+            trace["gauge"]["bar"]["color"] = patch["color"]
+        else:
+            trace["x"] = [patch["value"]]
+            trace["marker"]["color"] = patch["color"]
+
+    if "average" in delta:
+        for f, patch in zip(frame["average"]["figures"], delta["average"]):
+            patch_fig(f["figure"], patch)
+    if "device_rows" in delta:
+        for row, patches in zip(frame["device_rows"], delta["device_rows"]):
+            for f, patch in zip(row["figures"], patches):
+                patch_fig(f["figure"], patch)
+    if "heatmaps" in delta:
+        for h, z in zip(frame["heatmaps"], delta["heatmaps"]):
+            h["figure"]["data"][0]["z"] = z
+    if "trends" in delta:
+        for t, patch in zip(frame["trends"], delta["trends"]):
+            trace = t["figure"]["data"][0]
+            trace["x"] = patch["x"]
+            trace["y"] = patch["y"]
+            trace["line"]["color"] = patch["color"]
+    return frame

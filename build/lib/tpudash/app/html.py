@@ -1,0 +1,638 @@
+"""Dashboard page — single self-contained HTML document.
+
+Renders the frame JSON from ``/api/frame``.  Uses plotly.js when the page
+can load it (CDN); otherwise a built-in dependency-free renderer draws the
+same figure dicts as HTML/SVG (gauges/bars as banded meters, heatmaps as CSS
+grids), so the dashboard works fully air-gapped — the figure dicts are the
+contract, the renderer is swappable.
+"""
+
+PAGE = r"""<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>TPU Metrics Dashboard</title>
+<script src="https://cdn.plot.ly/plotly-2.32.0.min.js" onerror="window._noPlotly=true"></script>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif; margin: 0;
+         background: #f7f9fb; color: #1c2733; }
+  header { display: flex; align-items: baseline; gap: 16px; padding: 12px 20px;
+           background: #fff; border-bottom: 1px solid #e3e8ee; position: sticky; top: 0; z-index: 5;}
+  h1 { font-size: 20px; margin: 0; }
+  #last-updated { color: #6b7a8c; font-size: 13px; margin-left: auto; }
+  .wrap { padding: 16px 20px; }
+  #error-banner { display: none; background: #fdeaea; color: #a8322a;
+                  border: 1px solid #e74c3c; border-radius: 6px; padding: 10px 14px; margin-bottom: 12px; }
+  #warning-banner { display: none; background: #fdf6e3; color: #8a6d1a;
+                    border: 1px solid #e0b93f; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px; }
+  #alert-banner { display: none; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px;
+                  background: #fdeaea; color: #a8322a; border: 1px solid #e74c3c; }
+  #alert-banner.warning { background: #fdf6e3; color: #8a6d1a; border-color: #e0b93f; }
+  #straggler-banner { display: none; background: #eef3fb; color: #2a4a78;
+                      border: 1px solid #8fa7c4; border-radius: 6px; padding: 8px 14px; margin-bottom: 12px; }
+  #straggler-banner button { margin-left: 4px; }
+  .controls { display: flex; gap: 18px; align-items: center; margin-bottom: 10px; flex-wrap: wrap;}
+  .controls label { font-size: 14px; }
+  #chip-grid { display: grid; grid-template-columns: repeat(var(--grid-cols, 4), minmax(120px, 1fr));
+               gap: 4px 14px; margin: 8px 0 16px; max-height: 180px; overflow-y: auto;
+               border: 1px solid #e3e8ee; border-radius: 6px; padding: 10px; background: #fff;}
+  #chip-grid label { font-size: 13px; white-space: nowrap; }
+  .slice-bar { grid-column: 1 / -1; display: flex; gap: 6px; flex-wrap: wrap; }
+  .row-title { font-size: 16px; font-weight: 600; margin: 14px 0 6px; }
+  .panel-row { display: grid; grid-template-columns: repeat(auto-fit, minmax(230px, 1fr)); gap: 10px; }
+  .panel { background: #fff; border: 1px solid #e3e8ee; border-radius: 6px; padding: 6px; }
+  table { border-collapse: collapse; background: #fff; font-size: 13px; margin-top: 8px;}
+  th, td { border: 1px solid #e3e8ee; padding: 5px 10px; text-align: right; }
+  th:first-child, td:first-child { text-align: left; }
+  .meter { position: relative; height: 26px; border-radius: 4px; overflow: hidden;
+           background: #eef2f6; margin-top: 8px; }
+  .meter .band { position: absolute; top: 0; bottom: 0; }
+  .meter .fill { position: absolute; top: 4px; bottom: 4px; left: 0; border: 1px solid rgba(0,0,0,.55); }
+  .fig-title { font-size: 13px; color: #44556a; }
+  .fig-value { font-size: 26px; font-weight: 700; }
+  .heat { display: grid; gap: 2px; margin-top: 6px; }
+  .heat div { aspect-ratio: 1; border-radius: 2px; min-width: 10px; }
+  #debug { color: #6b7a8c; font-size: 12px; margin-top: 18px; }
+  #drill { display: none; background: #fff; border: 2px solid #8fa7c4;
+           border-radius: 8px; padding: 10px 14px; margin: 14px 0; }
+  .drill-head { display: flex; align-items: baseline; gap: 12px; }
+  .drill-head button { margin-left: auto; }
+  .drill-alerts { color: #a8322a; font-size: 13px; margin: 6px 0; }
+  .neighbors { font-size: 13px; color: #44556a; margin-top: 8px; }
+  .neighbors button { margin-left: 4px; }
+  table.links { font-size: 13px; color: #44556a; margin-top: 8px;
+    border-collapse: collapse; }
+  table.links th, table.links td { border: 1px solid #c7d3e0;
+    padding: 2px 8px; text-align: left; }
+  tr.link-cold td { background: #fde8e6; color: #a8322a; }
+  .hint { color: #6b7a8c; font-size: 12px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>📊 TPU Metrics Dashboard</h1>
+  <span id="last-updated"></span>
+</header>
+<div class="wrap">
+  <div id="error-banner"></div>
+  <div id="warning-banner"></div>
+  <div id="alert-banner"></div>
+  <div id="straggler-banner"></div>
+  <div id="gap-note" class="hint" style="display:none; margin-bottom: 8px;"></div>
+  <div class="controls">
+    <label><input type="checkbox" id="use-gauge" checked> Gauge style (off = bar)</label>
+    <button id="select-all">Select all</button>
+    <button id="select-none">Clear</button>
+    <a id="csv-link" href="/api/export.csv" download="tpudash.csv">Export CSV</a>
+    <span id="chip-count"></span>
+    <span class="hint">click a heatmap cell for chip detail &middot; shift-click toggles selection</span>
+  </div>
+  <div id="chip-grid"></div>
+  <div id="replay-bar" style="display:none"></div>
+  <div id="drill"></div>
+  <div id="panels"></div>
+  <div class="row-title">Statistics (selected chips)</div>
+  <div id="stats"></div>
+  <div id="breakdown"></div>
+  <div id="debug"></div>
+</div>
+<script>
+const usePlotly = () => !window._noPlotly && window.Plotly;
+
+// Scraped label values (chip keys, slice ids, model names, metric names) are
+// untrusted — escape anything interpolated into innerHTML.
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+
+// ---- dependency-free fallback renderer over the same figure dicts --------
+// All decisions (band geometry, colorscale selection, cell
+// classification, sparkline scaling) come from the GENERATED client
+// logic below — these functions only assemble DOM strings around it.
+function renderMeter(el, title, value, maxVal, steps, color) {
+  const g = meter_geometry(value, maxVal, steps || []);
+  let bands = '';
+  for (const b of g.bands) {
+    bands += `<div class="band" style="left:${b.left}%;width:${b.width}%;background:${b.color}"></div>`;
+  }
+  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
+    <div class="fig-value" style="color:${esc(color)}">${(+value).toFixed(1)}</div>
+    <div class="meter">${bands}<div class="fill" style="width:${g.pct}%;background:${esc(color)}"></div></div>
+    <div class="fig-title">max ${+maxVal}</div>`;
+}
+
+function renderHeatFallback(el, trace, layoutTitle) {
+  const z = trace.z, zmax = trace.zmax || 100, cd = trace.customdata;
+  const cols = z.length ? z[0].length : 0;
+  let cells = '';
+  for (let y = 0; y < z.length; y++) for (let x = 0; x < z[y].length; x++) {
+    const v = z[y][x];
+    const key = (cd && cd[y] && cd[y][x]) || null;
+    const cell = heat_cell(v === undefined ? null : v, key, zmax, trace.colorscale);
+    if (cell.kind === 'blank') {
+      cells += '<div style="background:transparent"></div>';
+    } else if (cell.kind === 'deselected') {
+      // deselected chips keep their key so a click re-selects them
+      cells += `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(key)}" title="deselected"></div>`;
+    } else {
+      cells += `<div style="background:${cell.color};cursor:pointer" title="${(+v).toFixed(1)}"` +
+               (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
+    }
+  }
+  el.innerHTML = `<div class="fig-title">${esc(layoutTitle)}</div>
+    <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
+  el.querySelector('.heat').addEventListener('click', e => {
+    const key = e.target.getAttribute && e.target.getAttribute('data-key');
+    if (!key) return;
+    if (e.shiftKey) post('/api/select', {toggle: key});
+    else showChip(key);
+  });
+}
+
+function renderLineFallback(el, trace, fig, title) {
+  const ys = trace.y, n = ys.length;
+  const ymax = (fig.layout.yaxis.range && fig.layout.yaxis.range[1]) || Math.max(...ys, 1);
+  const W = 240, H = 64;
+  let pts = '';
+  for (const p of spark_points(ys, ymax, W, H)) {
+    pts += `${p[0].toFixed(1)},${p[1].toFixed(1)} `;
+  }
+  const col = trace.line.color;
+  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
+    <svg viewBox="0 0 ${W} ${H}" style="width:100%;height:64px;background:#f2f6fa;border-radius:4px">
+      <polyline points="${pts}" fill="none" stroke="${esc(col)}" stroke-width="2"/></svg>
+    <div class="fig-title">now ${(+ys[n-1]).toFixed(1)} · max ${+ymax}</div>`;
+}
+
+function renderFigure(el, fig) {
+  if (usePlotly()) {
+    Plotly.react(el, fig.data, fig.layout, {displayModeBar: false});
+    const tr = fig.data[0];
+    if (tr.type === 'heatmap' && tr.customdata && !el._heatClick) {
+      el._heatClick = true;  // panel divs are rebuilt per frame
+      el.on('plotly_click', ev => {
+        const key = ev.points && ev.points[0] && ev.points[0].customdata;
+        if (!key) return;
+        if (ev.event && ev.event.shiftKey) post('/api/select', {toggle: key});
+        else showChip(key);
+      });
+    }
+    return;
+  }
+  const t = fig.data[0];
+  const title = (t.title && t.title.text) || (fig.layout.title && fig.layout.title.text) || '';
+  if (t.type === 'indicator') {
+    renderMeter(el, title, t.value, t.gauge.axis.range[1], t.gauge.steps, t.gauge.bar.color);
+  } else if (t.type === 'bar') {
+    const steps = (fig.layout.shapes || []).map(s => ({range: [s.x0, s.x1], color: s.fillcolor}));
+    renderMeter(el, title, t.x[0], fig.layout.xaxis.range[1], steps, t.marker.color);
+  } else if (t.type === 'heatmap') {
+    renderHeatFallback(el, t, title);
+  } else if (t.type === 'scatter') {
+    renderLineFallback(el, t, fig, title);
+  }
+}
+
+// ---- state + API ----------------------------------------------------------
+// auth: when the server runs with TPUDASH_AUTH_TOKEN, the operator opens
+// the page as /?token=....  fetch() calls carry it as an Authorization
+// header; ONLY the EventSource stream uses the query param (EventSource
+// cannot set headers, and the server accepts ?token= on /api/stream alone
+// so the secret stays out of access logs for every other route).
+const TOKEN = new URLSearchParams(location.search).get('token');
+function streamUrl(url) {
+  if (!TOKEN) return url;
+  return url + (url.includes('?') ? '&' : '?') + 'token=' + encodeURIComponent(TOKEN);
+}
+function authHeaders(extra) {
+  const h = Object.assign({}, extra || {});
+  if (TOKEN) h['Authorization'] = 'Bearer ' + TOKEN;
+  return h;
+}
+
+async function post(url, body) {
+  await fetch(url, {method: 'POST',
+                    headers: authHeaders({'Content-Type': 'application/json'}),
+                    body: JSON.stringify(body)});
+  await refresh();
+}
+
+// ---- per-chip drill-down (click a heatmap cell) ---------------------------
+let drillKey = null;
+
+async function showChip(key) {
+  drillKey = key;
+  await refreshDrill();
+  const el = document.getElementById('drill');
+  if (el.style.display !== 'none') el.scrollIntoView({behavior: 'smooth', block: 'nearest'});
+}
+
+function closeDrill() {
+  drillKey = null;
+  const el = document.getElementById('drill');
+  el.style.display = 'none';
+  el.innerHTML = '';
+}
+
+async function refreshDrill() {
+  const key = drillKey;  // snapshot: user may close / switch mid-fetch
+  if (!key) return;
+  let resp;
+  try {
+    resp = await fetch('/api/chip?key=' + encodeURIComponent(key),
+                       {headers: authHeaders()});
+  } catch (e) { return; /* transient: keep the last detail */ }
+  if (drillKey !== key) return;  // closed or moved on — drop the response
+  if (resp.status === 404) { closeDrill(); return; /* chip left the fleet */ }
+  if (!resp.ok) return;  // transient server/auth hiccup: keep last detail
+  const detail = await resp.json();
+  if (drillKey === key) renderDrill(detail);
+}
+
+function renderDrill(d) {
+  const el = document.getElementById('drill');
+  el.style.display = 'block';
+  let html = `<div class="drill-head"><span class="row-title">TPU ${+d.chip_id}` +
+    ` &mdash; ${esc(d.slice)} / ${esc(d.host)} (${esc(d.model)})</span>` +
+    `<button id="drill-close">close</button></div>`;
+  const firing = (d.alerts || []).filter(a => a.state === 'firing');
+  if (firing.length) {
+    // each firing alert gets a one-click acknowledge (1h silence) /
+    // unsilence toggle — the operator workflow, not just the signal
+    html += `<div class="drill-alerts">⚠ ` +
+      firing.map((a, i) => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
+                 ' (=' + (+a.value) + ') ' +
+                 `<button class="silence-btn" data-i="${i}">` +
+                 (a.silenced ? 'unsilence' : 'silence 1h') + '</button>'
+                ).join(' · ') + '</div>';
+  }
+  const lagging = (d.stragglers || []).filter(s => s.state === 'firing');
+  if (lagging.length) {
+    html += `<div class="drill-alerts" style="color:#2a4a78">🐢 straggler: ` +
+      lagging.map(s => esc(s.column) + ' ' + (+s.value) + ' vs fleet ' +
+                  (+s.median) + ' (z=' + (+s.z) + ')').join(' · ') + '</div>';
+  }
+  html += '<div class="panel-row" id="drill-gauges"></div>';
+  html += '<div class="panel-row" id="drill-trends"></div>';
+  if (d.links && d.links.length) {
+    // direction-resolved per-link table: the failing CABLE, with the
+    // chip on its far end one click away
+    html += '<table class="links"><tr><th>link</th><th>GB/s</th><th>far end</th></tr>' +
+      d.links.map(l =>
+        `<tr${l.straggler ? ' class="link-cold"' : ''}><td>${esc(l.dir)}` +
+        (l.straggler ? ' 🐢' : '') + '</td><td>' +
+        (l.gbps === null || l.gbps === undefined ? '—' : (+l.gbps)) + '</td><td>' +
+        (l.neighbor ? `<button data-chip="${esc(l.neighbor)}">${esc(l.neighbor)}</button>` : '—') +
+        '</td></tr>').join('') + '</table>';
+  }
+  if (d.neighbors && d.neighbors.length) {
+    html += `<div class="neighbors">ICI neighbors:` +
+      d.neighbors.map(n => `<button data-chip="${esc(n)}">${esc(n)}</button>`).join('') +
+      '</div>';
+  }
+  el.innerHTML = html;
+  for (const [rowId, figs] of [['drill-gauges', d.figures], ['drill-trends', d.trends]]) {
+    const row = document.getElementById(rowId);
+    for (const f of figs || []) {
+      const cell = document.createElement('div');
+      cell.className = 'panel';
+      row.appendChild(cell);
+      renderFigure(cell, f.figure);
+    }
+  }
+  document.getElementById('drill-close').addEventListener('click', closeDrill);
+  for (const btn of el.querySelectorAll('.neighbors button, table.links button')) {
+    btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
+  }
+  for (const btn of el.querySelectorAll('.silence-btn')) {
+    btn.addEventListener('click', async () => {
+      const a = firing[+btn.getAttribute('data-i')];
+      const path = a.silenced ? '/api/alerts/unsilence' : '/api/alerts/silence';
+      const body = a.silenced ? {rule: a.rule, chip: a.chip}
+                              : {rule: a.rule, chip: a.chip, ttl_s: 3600};
+      await fetch(path, {method: 'POST',
+        headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+        body: JSON.stringify(body)});
+      refreshDrill(); refresh();
+    });
+  }
+}
+
+function renderChips(chips) {
+  const grid = document.getElementById('chip-grid');
+  grid.innerHTML = '';
+  // multi-slice fleets: one-click slice selection above the checkbox grid
+  const slices = [...new Set(chips.map(c => c.slice))];
+  if (slices.length > 1) {
+    const bar = document.createElement('div');
+    bar.className = 'slice-bar';
+    for (const s of slices) {
+      const keys = chips.filter(c => c.slice === s).map(c => c.key);
+      const btn = document.createElement('button');
+      btn.textContent = `${s} (${keys.length})`;
+      btn.title = `select only ${s}`;
+      btn.addEventListener('click', () => post('/api/select', {selected: keys}));
+      bar.appendChild(btn);
+    }
+    grid.appendChild(bar);
+  }
+  for (const c of chips) {
+    const id = 'chip_checkbox_' + c.key;
+    const label = document.createElement('label');
+    label.innerHTML = `<input type="checkbox" id="${esc(id)}" ${c.selected ? 'checked' : ''}> ` +
+                      `TPU ${+c.chip_id} <small>(${esc(c.model)}, ${esc(c.slice)})</small>`;
+    label.querySelector('input').addEventListener('change',
+      () => post('/api/select', {toggle: c.key}));
+    grid.appendChild(label);
+  }
+  document.getElementById('chip-count').textContent =
+    chips.filter(c => c.selected).length + ' / ' + chips.length + ' chips selected';
+}
+
+function panelRow(container, rowTitle, figures) {
+  const title = document.createElement('div');
+  title.className = 'row-title'; title.textContent = rowTitle;
+  container.appendChild(title);
+  const row = document.createElement('div');
+  row.className = 'panel-row';
+  for (const f of figures) {
+    const cell = document.createElement('div');
+    cell.className = 'panel';
+    row.appendChild(cell);
+    renderFigure(cell, f.figure);
+  }
+  container.appendChild(row);
+}
+
+function renderBreakdown(bd, panelSpecs) {
+  const el = document.getElementById('breakdown');
+  if (!bd || !Object.keys(bd).length) { el.innerHTML = ''; return; }
+  const titles = {by_slice: 'Per-slice averages', by_host: 'Per-host averages'};
+  let html = '';
+  for (const dim of Object.keys(bd)) {
+    const rows = bd[dim];
+    const keys = Object.keys(rows);
+    const cols = (panelSpecs || []).filter(p => keys.some(k => p.column in rows[k]));
+    html += `<div class="row-title">${esc(titles[dim] || dim)}</div><table><tr><th>${dim === 'by_host' ? 'host' : 'slice'}</th><th>chips</th>`;
+    for (const p of cols) html += `<th>${esc(p.title)}</th>`;
+    html += '</tr>';
+    for (const k of keys) {
+      html += `<tr><td>${esc(k)}</td><td>${+rows[k].chips}</td>`;
+      for (const p of cols) {
+        const v = rows[k][p.column];
+        html += `<td>${v === undefined ? '—' : +v}</td>`;
+      }
+      html += '</tr>';
+    }
+    html += '</table>';
+  }
+  el.innerHTML = html;
+}
+
+function renderStats(stats) {
+  const el = document.getElementById('stats');
+  const metrics = Object.keys(stats);
+  if (!metrics.length) { el.innerHTML = '<em>no data</em>'; return; }
+  // mean/max/min = reference parity; p50/p95 = fleet-scale additions
+  const keys = ['mean', 'p50', 'p95', 'max', 'min']
+    .filter(k => k in (stats[metrics[0]] || {}));
+  let html = '<table><tr><th>metric</th>' +
+    keys.map(k => `<th>${k}</th>`).join('') + '</tr>';
+  for (const m of metrics) {
+    const s = stats[m];
+    html += `<tr><td>${esc(m)}</td>` +
+      keys.map(k => `<td>${k in s ? +s[k] : '—'}</td>`).join('') + '</tr>';
+  }
+  el.innerHTML = html + '</table>';
+}
+
+async function refresh() {
+  let frame;
+  try {
+    frame = await (await fetch('/api/frame', {headers: authHeaders()})).json();
+  } catch (e) {
+    showError('Dashboard server unreachable: ' + e);
+    if (!streaming && !timer) timer = setInterval(refresh, 5000);  // keep retrying
+    return;
+  }
+  applyFrame(frame);
+}
+
+function applyFrame(frame) {
+  document.getElementById('last-updated').textContent = 'Last updated: ' + frame.last_updated;
+  if (!streaming && !timer) timer = setInterval(refresh, (frame.refresh_interval || 5) * 1000);
+  showError(frame.error);
+  showWarnings(frame.warnings);
+  showAlerts(frame.alerts);
+  showStragglers(frame.stragglers);
+  if (frame.error) return;  // keep last good panels (reference skips the cycle)
+  document.getElementById('use-gauge').checked = frame.use_gauge;
+  renderChips(frame.chips);
+  const panels = document.getElementById('panels');
+  panels.innerHTML = '';
+  if (frame.average) panelRow(panels, frame.average.title, frame.average.figures);
+  if (frame.trends && frame.trends.length) panelRow(panels, 'Trends', frame.trends);
+  for (const row of frame.device_rows || []) panelRow(panels, row.title, row.figures);
+  // heatmaps group per panel metric
+  const heat = frame.heatmaps || [];
+  if (heat.length) panelRow(panels, 'Topology heatmaps', heat);
+  renderStats(frame.stats || {});
+  renderBreakdown(frame.breakdown, frame.panel_specs);
+  showPanelGaps(frame.unavailable_panels);
+  if (drillKey) refreshDrill();  // keep the open chip detail live
+  if (replayActive !== false) pollReplay();  // keep the scrub position current
+  const t = frame.timings || {};
+  document.getElementById('debug').textContent =
+    'Debug: frames=' + (t.frames || 0) +
+    (t.total ? (', scrape→render p50=' + t.total.p50_ms.toFixed(1) + ' ms') : '') +
+    (streaming ? ' · live (SSE)' : ' · polling') +
+    (window._noPlotly ? ' · fallback renderer (plotly.js unavailable)' : '');
+}
+
+// ---- transport: SSE push with polling fallback ----------------------------
+// Steady-state ticks arrive as value-only deltas (kind: "delta") patched
+// into the last full frame.  apply_delta / stream_event_plan /
+// stream_error_plan below are GENERATED from the fuzz-tested Python
+// client logic (tpudash/app/clientlogic.py) — edit the Python, never
+// this block; tests/test_client_parity.py pins the embedding.
+let lastFrame = null;
+
+/*__GENERATED_CLIENT__*/
+
+function startStream() {
+  if (!window.EventSource) return;  // old browser → polling stays active
+  const es = new EventSource(streamUrl('/api/stream'));
+  es.onmessage = e => {
+    streaming = true;
+    if (timer) { clearInterval(timer); timer = null; }
+    const msg = JSON.parse(e.data);
+    const plan = stream_event_plan(msg.kind, lastFrame !== null);
+    if (plan === 'refetch') { refresh(); return; }  // missed the full frame
+    lastFrame = plan === 'delta' ? apply_delta(lastFrame, msg) : msg;
+    // keep the model current but skip DOM/plot work for hidden tabs —
+    // the visibilitychange handler re-renders on return
+    if (!document.hidden) applyFrame(lastFrame);
+  };
+  es.onerror = () => {
+    // server restart / proxy hiccup: the recovery policy is the
+    // generated stream_error_plan (see clientlogic.py for the why)
+    streaming = false;
+    const plan = stream_error_plan(
+      es.readyState === EventSource.CLOSED, timer !== null);
+    if (plan.poll_ms > 0) timer = setInterval(refresh, plan.poll_ms);
+    if (plan.reopen_ms > 0) setTimeout(startStream, plan.reopen_ms);
+  };
+}
+
+document.getElementById('use-gauge').addEventListener('change',
+  e => post('/api/style', {use_gauge: e.target.checked}));
+// a plain <a href> navigation cannot send the Authorization header, so the
+// export fetches the CSV and hands the browser a blob download instead
+document.getElementById('csv-link').addEventListener('click', async e => {
+  e.preventDefault();
+  const resp = await fetch('/api/export.csv', {headers: authHeaders()});
+  if (!resp.ok) { showError('CSV export failed: HTTP ' + resp.status); return; }
+  const url = URL.createObjectURL(await resp.blob());
+  const a = document.createElement('a');
+  a.href = url; a.download = 'tpudash.csv';
+  a.click();
+  URL.revokeObjectURL(url);
+});
+document.getElementById('select-all').addEventListener('click',
+  () => post('/api/select', {all: true}));
+document.getElementById('select-none').addEventListener('click',
+  () => post('/api/select', {none: true}));
+
+// ---- replay time-travel (source=replay only) ------------------------------
+// A recorded incident can be scrubbed back and forth: the bar appears when
+// /api/replay answers, the slider seeks by snapshot index, pause holds the
+// current snapshot instead of auto-advancing.  Tri-state: null = unknown
+// (keep probing each frame — a transient error must not permanently hide
+// or freeze the bar), true = replaying, false = definitively not (404).
+let replayActive = null;
+
+function renderReplayPosition(pos) {
+  const bar = document.getElementById('replay-bar');
+  bar.style.display = 'block';
+  if (!bar.dataset.built) {
+    bar.dataset.built = '1';
+    bar.innerHTML = '<span class="row-title">Replay</span> ' +
+      '<button id="replay-pause"></button> ' +
+      '<input id="replay-slider" type="range" min="0" step="1" ' +
+      'style="width: 40%; vertical-align: middle"> ' +
+      '<span id="replay-label" class="hint"></span>';
+    document.getElementById('replay-slider').addEventListener('change',
+      async e => {
+        const r = await fetch('/api/replay', {method: 'POST',
+          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+          body: JSON.stringify({index: +e.target.value, paused: true})});
+        if (r.ok) { renderReplayPosition(await r.json()); refresh(); }
+      });
+    document.getElementById('replay-pause').addEventListener('click',
+      async () => {
+        const r = await fetch('/api/replay', {method: 'POST',
+          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
+          body: JSON.stringify({paused: !replayPaused})});
+        if (r.ok) renderReplayPosition(await r.json());
+      });
+  }
+  replayPaused = pos.paused;
+  const slider = document.getElementById('replay-slider');
+  slider.max = pos.total - 1;
+  if (pos.index !== null && document.activeElement !== slider) slider.value = pos.index;
+  document.getElementById('replay-pause').textContent = pos.paused ? '▶ resume' : '⏸ pause';
+  document.getElementById('replay-label').textContent =
+    (pos.index === null ? '—' : (pos.index + 1)) + '/' + pos.total +
+    (pos.ts ? ' · ' + new Date(pos.ts * 1000).toLocaleTimeString() : '');
+}
+let replayPaused = false;
+
+async function pollReplay() {
+  try {
+    const r = await fetch('/api/replay', {headers: authHeaders()});
+    if (r.status === 404) { replayActive = false; return; }
+    if (!r.ok) return;  // transient: keep the last state, retry next frame
+    replayActive = true;
+    renderReplayPosition(await r.json());
+  } catch (e) { /* transient */ }
+}
+pollReplay();
+
+function showError(msg) {
+  const b = document.getElementById('error-banner');
+  if (msg) { b.style.display = 'block'; b.textContent = msg; }
+  else b.style.display = 'none';
+}
+
+function showAlerts(list) {
+  const b = document.getElementById('alert-banner');
+  // silenced (acknowledged) alerts never drive the banner; they stay
+  // visible as a count so the acknowledgement itself is visible
+  const firing = (list || []).filter(a => a.state === 'firing' && !a.silenced);
+  const silenced = (list || []).filter(a => a.state === 'firing' && a.silenced);
+  if (!firing.length && !silenced.length) { b.style.display = 'none'; return; }
+  const critical = firing.some(a => a.severity === 'critical');
+  b.className = (firing.length && critical) ? '' : 'warning';
+  b.style.display = 'block';
+  b.textContent = (firing.length
+    ? '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
+      .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
+      (firing.length > 8 ? ' \u2026' : '')
+    : '') +
+    (silenced.length ? ' \ud83d\udd07 ' + silenced.length + ' silenced' : '');
+}
+
+function showStragglers(list) {
+  // fleet outliers gating SPMD lockstep (tpudash.stragglers) — each chip
+  // is a button into its drill-down
+  const b = document.getElementById('straggler-banner');
+  const firing = (list || []).filter(s => s.state === 'firing');
+  if (!firing.length) { b.style.display = 'none'; return; }
+  b.style.display = 'block';
+  b.innerHTML = '🐢 ' + firing.length + ' straggler(s): ' +
+    firing.slice(0, 8).map(s =>
+      `<button data-chip="${esc(s.chip)}">${esc(s.chip)}</button> ` +
+      `${esc(s.column)} ${+s.value} vs fleet ${+s.median} (z=${+s.z})`
+    ).join(' · ') + (firing.length > 8 ? ' …' : '');
+  for (const btn of b.querySelectorAll('button')) {
+    btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
+  }
+}
+
+function showPanelGaps(list) {
+  // a core panel the source can't feed is declared, never silently absent
+  const b = document.getElementById('gap-note');
+  if (list && list.length) {
+    b.style.display = 'block';
+    b.innerHTML = 'Hidden panels: ' + list.map(g =>
+      `<span title="${esc(g.reason)}">${esc(g.title)}</span>`).join(' · ') +
+      ' <small>(hover for why)</small>';
+  } else b.style.display = 'none';
+}
+
+function showWarnings(list) {
+  const b = document.getElementById('warning-banner');
+  if (list && list.length) { b.style.display = 'block'; b.textContent = 'Degraded: ' + list.join(' · '); }
+  else b.style.display = 'none';
+}
+
+document.addEventListener('visibilitychange', () => {
+  if (!document.hidden && lastFrame) applyFrame(lastFrame);
+});
+
+let timer = null;
+let streaming = false;
+refresh();
+startStream();
+</script>
+</body>
+</html>
+"""
+
+# The transport-critical client functions are generated from the
+# fuzz-tested Python source of truth (clientlogic.py) at import time —
+# see pyjs.py for why this beats a hand-maintained JS mirror.
+from tpudash.app.clientlogic import CLIENT_FUNCTIONS  # noqa: E402
+from tpudash.app.pyjs import transpile_functions  # noqa: E402
+
+GENERATED_CLIENT_JS = transpile_functions(CLIENT_FUNCTIONS)
+PAGE = PAGE.replace("/*__GENERATED_CLIENT__*/", GENERATED_CLIENT_JS)

@@ -1,0 +1,1027 @@
+"""Async dashboard server.
+
+Replaces the reference's Streamlit shell (app.py:247-489): the browser polls
+``/api/frame`` every refresh interval instead of the server blocking in
+``while True: time.sleep(5)`` (app.py:326, 486).  Source fetches are
+blocking (requests / on-chip probes), so frames are built in a worker
+executor and never stall the event loop; a frame cache ensures many browser
+tabs cost one scrape per interval, not one per tab.
+
+Routes (full reference: docs/API.md):
+  GET  /                      dashboard page (issues the session cookie)
+  GET  /api/frame             current frame (per-session; ETag/304, gzip)
+  GET  /api/stream            SSE: full frame, then value-only deltas;
+                              reconnect resumes via Last-Event-ID
+  POST /api/select            {"toggle": key} | {"selected": [keys]} |
+                              {"all": true} | {"none": true}  (per session)
+  POST /api/style             {"use_gauge": bool}  (per session)
+  GET  /api/chip?key=…        single-chip drill-down
+  GET  /api/history[?chip=…]  fleet-average or per-chip raw history
+  GET  /api/alerts            current alert states
+  GET  /api/stragglers        fleet outliers (SPMD lockstep stragglers)
+  GET  /api/alert-rules.yaml  rules as a Prometheus alerting-rule file
+  GET  /api/timings           stage-timing summary (tracing, SURVEY.md §5)
+  GET  /api/schema            series/panels/generations/capabilities
+  POST /api/profile           cProfile N frames or a JAX device trace
+  GET  /api/export.csv        current wide per-chip table as CSV
+  GET  /healthz               liveness (open without auth)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import json
+import logging
+import secrets
+import tempfile
+import time
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+from tpudash.app.html import PAGE
+from tpudash.app.service import DashboardService
+from tpudash.app.sessions import SessionEntry, SessionStore
+from tpudash.config import Config, load_config
+from tpudash.sources import make_source
+
+#: per-browser session id (the reference's st.session_state scoping,
+#: app.py:252-260).  No Max-Age: it lives for the browser session, exactly
+#: like a Streamlit session.
+SESSION_COOKIE = "tpudash_sid"
+
+
+def _key_id(key: tuple) -> str:
+    """Compose-cache key as an SSE event id ("dv-sv-stall")."""
+    return "-".join(str(int(p)) for p in key)
+
+
+def _id_key(raw: "str | None") -> "tuple | None":
+    """Parse a Last-Event-ID back into a compose-cache key (None when
+    absent/garbled — the stream then starts with a full frame)."""
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) != 3:
+        return None
+    try:
+        return (int(parts[0]), int(parts[1]), bool(int(parts[2])))
+    except ValueError:
+        return None
+
+
+class DashboardServer:
+    def __init__(self, service: DashboardService):
+        self.service = service
+        self._lock = asyncio.Lock()
+        self.sessions = SessionStore(
+            service.state,
+            limit=service.cfg.session_limit,
+            ttl=service.cfg.session_ttl,
+        )
+        # per-browser sessions ride the TPUDASH_STATE_PATH checkpoint: a
+        # dashboard restart must not log every viewer out of their
+        # selection (the reference's refresh-resets-state flaw, SURVEY §5)
+        service.sessions_snapshot = self.sessions.to_dicts
+        if service.cfg.state_path:
+            restored = self.sessions.restore(service.restored_sessions)
+            if restored:
+                log.info("restored %d browser sessions", restored)
+        #: bumped after every refresh_data(); pairs with each session's
+        #: state_version to key the per-session compose caches
+        self._data_version = 0
+        self._data_at: float = 0.0
+        #: (data_version, {(chip_key, use_gauge): detail}) — drill-down
+        #: responses cached for the life of one data refresh
+        self._chip_cache: tuple = (-1, {})
+        #: a refresh that outlived the watchdog (or its awaiting handler),
+        #: parked for later harvest, plus when it started
+        self._refresh_task = None
+        self._refresh_started: float = 0.0
+        self._device_trace_active = False  # jax profiler is a singleton
+
+    async def _save_state(self) -> None:
+        """Persist the composite checkpoint OFF the event loop — the
+        write is blocking disk I/O and _mutate holds the frame lock."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.service.save_state)
+
+    def _entry(self, request: web.Request) -> SessionEntry:
+        return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
+
+    # -- frame caching -------------------------------------------------------
+    async def _refresh_locked(self, force: bool) -> None:
+        """Refresh the shared scrape data when stale.  Caller holds _lock.
+
+        Watchdog (Config.refresh_watchdog): a wedged source — a hung
+        accelerator runtime blocks inside native code without raising, so
+        no exception path fires — must not freeze every route behind this
+        lock.  Past the deadline the in-flight fetch is parked, routes
+        keep serving the last data with a "stalled" warning, and a later
+        tick harvests the fetch when (if) it completes.  At most ONE
+        fetch is ever in flight, so a wedge cannot exhaust the executor."""
+        watchdog = self.service.cfg.refresh_watchdog
+        stall_msg = (
+            f"metrics source stalled (no response in {watchdog:g}s); "
+            "serving the last good data"
+        )
+        if self._refresh_task is not None:
+            if not self._refresh_task.done():
+                # A fetch parked by the watchdog — or orphaned by a client
+                # disconnect mid-wait — is still running.  Re-attach for
+                # whatever watchdog budget remains (a disconnect at t=1s
+                # of a healthy 3s fetch must not degrade every other
+                # client to stale-instantly); only past the deadline do
+                # we declare the stall and serve stale.
+                elapsed = time.monotonic() - self._refresh_started
+                if watchdog and watchdog > 0:
+                    remaining = watchdog - elapsed
+                    if remaining > 0:
+                        try:
+                            await asyncio.wait_for(
+                                asyncio.shield(self._refresh_task), remaining
+                            )
+                        except asyncio.TimeoutError:
+                            pass
+                else:
+                    await asyncio.shield(self._refresh_task)
+                if not self._refresh_task.done():
+                    if self.service.refresh_stalled is None:
+                        self.service.refresh_stalled = stall_msg
+                    return  # serve what we have
+            task, self._refresh_task = self._refresh_task, None
+            exc = task.exception() if not task.cancelled() else None
+            if exc is not None:
+                # an unexpected failure outside refresh_data's own guards:
+                # log it and fall through — the staleness check below
+                # starts a fresh fetch instead of stamping bad state good
+                log.warning("parked refresh raised: %s", exc)
+                self.service.refresh_stalled = None
+            else:
+                self._data_version += 1
+                self.service.refresh_stalled = None
+            # deliberately NOT updating _data_at: the harvested data is as
+            # old as the stall — fall through so a genuinely fresh fetch
+            # starts on this same tick instead of an interval later
+        age = time.monotonic() - self._data_at
+        if (
+            force
+            or self._data_version == 0
+            or age >= self.service.cfg.refresh_interval
+        ):
+            loop = asyncio.get_running_loop()
+            # parked BEFORE the await: every exit path (timeout, client
+            # disconnect cancelling this handler) leaves the task tracked,
+            # so at most one fetch is ever in flight no matter how many
+            # impatient clients come and go
+            task = loop.run_in_executor(None, self.service.refresh_data)
+            self._refresh_task = task
+            self._refresh_started = time.monotonic()
+            try:
+                if watchdog and watchdog > 0:
+                    await asyncio.wait_for(asyncio.shield(task), watchdog)
+                else:
+                    await task
+            except asyncio.TimeoutError:
+                self.service.refresh_stalled = stall_msg
+                return
+            self._refresh_task = None
+            self._data_version += 1
+            self._data_at = time.monotonic()
+            self.service.refresh_stalled = None
+
+    async def _compose_locked(
+        self, entry: SessionEntry, keep_prev: bool = False
+    ) -> "tuple[dict, tuple]":
+        """Per-session compose with its (data_version, state_version) cache
+        key.  Caller holds _lock and has already run _refresh_locked — the
+        single copy of the cache-keying protocol both transports share.
+        ``keep_prev`` retains the outgoing frame for the delta transport;
+        pure-polling sessions never pay that second frame's memory."""
+        key = (
+            self._data_version,
+            entry.state_version,
+            # stall transitions must invalidate cached frames — the
+            # warning has to appear (and clear) without a data refresh
+            bool(self.service.refresh_stalled),
+        )
+        if entry.frame is not None and entry.frame_key == key:
+            return entry.frame, key
+        loop = asyncio.get_running_loop()
+        frame = await loop.run_in_executor(
+            None, self.service.compose_frame, entry.state
+        )
+        if keep_prev and entry.frame is not None:
+            entry.prev_frame = entry.frame
+            entry.prev_frame_key = entry.frame_key
+        entry.frame = frame
+        entry.frame_key = key
+        return frame, key
+
+    async def _get_frame(
+        self, force: bool = False, entry: SessionEntry | None = None
+    ) -> dict:
+        """Frame for one viewer session.  The scrape/normalize half runs at
+        most once per refresh interval across ALL sessions; the per-session
+        compose is cached against (data_version, state_version), so many
+        tabs of one browser cost one render and a selection change on one
+        session never re-scrapes or re-renders the others."""
+        entry = entry if entry is not None else self.sessions.entry(None)
+        async with self._lock:
+            await self._refresh_locked(force)
+            frame, _ = await self._compose_locked(entry)
+            return frame
+
+    async def _get_sse_event(
+        self, entry: SessionEntry, client_key: "tuple | None"
+    ) -> "tuple[bytes, tuple]":
+        """(payload, key) for one stream tick.  Sends, in order of
+        preference: a keepalive comment when the client already holds the
+        current frame; a value-only delta when the client's frame can be
+        patched to the current one (tpudash.app.delta); otherwise a full
+        frame.  Payloads are serialized once per (from, to) step per
+        session and shared by all of its subscribers.
+
+        Runs refresh → compose → diff → serialize under ONE lock hold so
+        cached bytes are always stamped with the version they were
+        composed from."""
+        from tpudash.app.delta import frame_delta
+
+        async with self._lock:
+            await self._refresh_locked(False)
+            frame, key = await self._compose_locked(entry, keep_prev=True)
+            if client_key == key:
+                # nothing new: SSE comment (ignored by EventSource)
+                return b": keepalive\n\n", key
+            loop = asyncio.get_running_loop()
+            if (
+                client_key is not None
+                and client_key == entry.prev_frame_key
+                and entry.prev_frame is not None
+            ):
+                if (
+                    entry.sse_delta is not None
+                    and entry.sse_delta_keys == (client_key, key)
+                ):
+                    return entry.sse_delta, key
+                prev = entry.prev_frame
+
+                def build_delta():
+                    delta = frame_delta(prev, frame)
+                    if delta is None:
+                        return None
+                    return (
+                        f"id: {_key_id(key)}\ndata: {json.dumps(delta)}\n\n"
+                    ).encode()
+
+                payload = await loop.run_in_executor(None, build_delta)
+                if payload is not None:
+                    entry.sse_delta = payload
+                    entry.sse_delta_keys = (client_key, key)
+                    return payload, key
+            if entry.sse_full is not None and entry.sse_full_key == key:
+                return entry.sse_full, key
+            payload = await loop.run_in_executor(
+                None,
+                lambda: (
+                    f"id: {_key_id(key)}\n"
+                    f"data: {json.dumps(dict(frame, kind='full'))}\n\n"
+                ).encode(),
+            )
+            entry.sse_full = payload
+            entry.sse_full_key = key
+            return payload, key
+
+    async def _mutate(self, entry: SessionEntry, fn):
+        """Run a state mutation under the frame lock: service renders on
+        the worker thread only while the lock is held, so mutations are
+        serialized against frame builds (no torn selection lists).  Bumps
+        the session's state version (cache invalidation) and persists the
+        checkpoint — per-browser sessions ride it too, so a restart keeps
+        every viewer's selection (the reference resets on refresh,
+        SURVEY §5)."""
+        async with self._lock:
+            result = fn()
+            entry.state_version += 1
+            await self._save_state()
+            return result
+
+    # -- handlers ------------------------------------------------------------
+    async def index(self, request: web.Request) -> web.Response:
+        resp = web.Response(text=PAGE, content_type="text/html")
+        if not request.cookies.get(SESSION_COOKIE):
+            # first visit: issue the per-browser session id the reference
+            # gets for free from Streamlit (app.py:252-260)
+            resp.set_cookie(
+                SESSION_COOKIE,
+                secrets.token_urlsafe(16),
+                httponly=True,
+                samesite="Lax",
+            )
+        return resp
+
+    async def frame(self, request: web.Request) -> web.Response:
+        """Current frame, with ETag revalidation: the polling fallback
+        re-fetches every interval, and between data refreshes the frame
+        is byte-identical — a conditional GET costs 304 + no body instead
+        of the full ~100KB figure JSON.  Browsers do this automatically
+        for fetch() under Cache-Control: no-cache."""
+        entry = self._entry(request)
+        frame = await self._get_frame(entry=entry)
+        etag = (
+            f'"{_key_id(entry.frame_key)}"'
+            if entry.frame_key is not None
+            else None
+        )
+        headers = {"Cache-Control": "no-cache"}
+        if etag is not None:
+            headers["ETag"] = etag
+            if request.headers.get("If-None-Match") == etag:
+                return web.Response(status=304, headers=headers)
+        return web.json_response(frame, headers=headers)
+
+    async def stream(self, request: web.Request) -> web.StreamResponse:
+        """Server-sent events: push a frame every refresh interval.  All
+        subscribers share the scrape; subscribers of one session share its
+        serialized payload, so N open tabs still cost one scrape per
+        interval and one compose per session."""
+        sid = request.cookies.get(SESSION_COOKIE)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        # NOT compressed: aiohttp's StreamResponse deflate buffers across
+        # writes, so events would sit in the zlib window instead of
+        # arriving on time (verified — the stream tests stall).  The
+        # delta transport already cuts steady-state ticks ~5×.
+        await resp.prepare(request)
+        # every event carries its compose key as the SSE id, and
+        # EventSource echoes it back on reconnect — a dropped connection
+        # resumes with a delta (or keepalive) instead of a full frame
+        client_key = _id_key(request.headers.get("Last-Event-ID"))
+        try:
+            while True:
+                # re-resolve every tick: touches last_seen so an actively
+                # streamed session is never TTL-evicted, and picks up the
+                # replacement entry if it somehow was
+                entry = self.sessions.entry(sid)
+                payload, client_key = await self._get_sse_event(
+                    entry, client_key
+                )
+                await resp.write(payload)
+                await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away — normal termination
+        return resp
+
+    async def export_csv(self, request: web.Request) -> web.Response:
+        """The current wide per-chip table as CSV (one row per chip,
+        identity columns + every metric column).  Always refreshes through
+        the cache-gated frame path so the export is at most one refresh
+        interval old, never an hours-stale snapshot."""
+        frame = await self._get_frame(entry=self._entry(request))
+        stale = frame.get("error") or self.service.refresh_stalled
+        if stale:
+            # don't serve pre-outage (or mid-stall) data as if it were
+            # current — a CSV has no warnings banner to carry the caveat
+            raise web.HTTPServiceUnavailable(text=stale)
+        df = self.service.last_df
+        if df is None:
+            raise web.HTTPServiceUnavailable(text="no frame rendered yet")
+        return web.Response(
+            text=df.to_csv(index_label="chip"),
+            content_type="text/csv",
+            headers={
+                "Content-Disposition": "attachment; filename=tpudash.csv"
+            },
+        )
+
+    async def select(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+        entry = self._entry(request)
+        state = entry.state
+        if not self.service.available:
+            # No successful frame yet — prime one so selection ops
+            # validate against a real chip list.
+            await self._get_frame(force=True, entry=entry)
+        available = self.service.available
+        if body.get("all"):
+            await self._mutate(entry, lambda: state.select_all(available))
+        elif body.get("none"):
+            await self._mutate(entry, state.clear)
+        elif "toggle" in body:
+            await self._mutate(
+                entry, lambda: state.toggle(str(body["toggle"]), available)
+            )
+        elif "selected" in body:
+            if not isinstance(body["selected"], list):
+                raise web.HTTPBadRequest(text="'selected' must be a list")
+            await self._mutate(
+                entry,
+                lambda: state.set_selected(
+                    [str(k) for k in body["selected"]], available
+                ),
+            )
+        else:
+            raise web.HTTPBadRequest(text="no selection operation in body")
+        # recompose this session's frame (data untouched: a selection
+        # change must not trigger a re-scrape, the table didn't change)
+        frame = await self._get_frame(entry=entry)
+        return web.json_response(
+            {"selected": list(state.selected), "frame_ok": frame["error"] is None}
+        )
+
+    async def style(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+        use_gauge = bool(body.get("use_gauge", True))
+        entry = self._entry(request)
+
+        def _set():
+            entry.state.use_gauge = use_gauge
+
+        await self._mutate(entry, _set)
+        await self._get_frame(entry=entry)
+        return web.json_response({"use_gauge": entry.state.use_gauge})
+
+    async def timings(self, request: web.Request) -> web.Response:
+        return web.json_response(self.service.timer.summary())
+
+    async def profile(self, request: web.Request) -> web.Response:
+        """On-demand profiling (tracing, SURVEY.md §5 — the reference has
+        none).  Two modes:
+
+        - ``{"frames": N}`` (default 10, ≤100): cProfile N frame renders
+          through the live service and return the hottest functions by
+          cumulative time — works with every source;
+        - ``{"device": true, "seconds": S}`` (≤30): capture a JAX device
+          trace (TPU: XLA ops, ICI transfers; CPU: host trace) while the
+          in-process probe/workload source keeps running; returns the
+          trace directory for ``tensorboard --logdir`` / xprof.
+        """
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON")
+
+        if body.get("device"):
+            try:
+                seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
+            except (TypeError, ValueError):
+                raise web.HTTPBadRequest(text="'seconds' must be a number")
+            try:
+                import jax  # the probe/workload sources already paid this
+            except ImportError as e:
+                raise web.HTTPBadRequest(text=f"jax unavailable: {e}")
+            if self._device_trace_active:
+                raise web.HTTPConflict(text="a device trace is already running")
+            self._device_trace_active = True
+            trace_dir = tempfile.mkdtemp(prefix="tpudash-trace-")
+
+            def capture():
+                with jax.profiler.trace(trace_dir):
+                    # trace whatever the in-process source keeps the chip
+                    # doing (workload steps / probes) for the window
+                    time.sleep(seconds)
+
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(None, capture)
+            except Exception as e:  # noqa: BLE001 — profiler errors → clean 500
+                import shutil
+
+                shutil.rmtree(trace_dir, ignore_errors=True)
+                raise web.HTTPInternalServerError(
+                    text=f"device trace failed: {e}"
+                )
+            finally:
+                self._device_trace_active = False
+            return web.json_response(
+                {"mode": "device", "seconds": seconds, "trace_dir": trace_dir}
+            )
+
+        try:
+            frames = min(100, max(1, int(body.get("frames", 10))))
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="'frames' must be an integer")
+
+        def run_profile():
+            import cProfile
+            import pstats
+
+            # synthetic_load: profiled renders must not page anyone,
+            # advance alert hysteresis, append to a recording, or inflate
+            # source-health counters (tpudash.app.service.synthetic_load)
+            deadline = time.monotonic() + 10.0  # bound lock-hold wall time
+            done = 0
+            prof = cProfile.Profile()
+            with self.service.synthetic_load():
+                prof.enable()
+                try:
+                    for _ in range(frames):
+                        self.service.render_frame()
+                        done += 1
+                        if time.monotonic() >= deadline:
+                            break
+                finally:
+                    prof.disable()
+            stats = pstats.Stats(prof)
+            top = []
+            for func, (cc, nc, tt, ct, _callers) in stats.stats.items():
+                filename, lineno, name = func
+                top.append(
+                    {
+                        "function": f"{filename}:{lineno}({name})",
+                        "calls": nc,
+                        "tottime_ms": round(tt * 1e3, 3),
+                        "cumtime_ms": round(ct * 1e3, 3),
+                    }
+                )
+            top.sort(key=lambda e: -e["cumtime_ms"])
+            return done, top[:40]
+
+        async with self._lock:  # serialize against normal frame builds
+            loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
+            done, top = await loop.run_in_executor(None, run_profile)
+            wall = time.monotonic() - t0
+        return web.json_response(
+            {
+                "mode": "frames",
+                "frames": done,
+                "requested": frames,
+                "wall_ms": round(wall * 1e3, 2),
+                "top": top,
+            }
+        )
+
+    async def history(self, request: web.Request) -> web.Response:
+        """Raw rolling history: fleet-average values per metric, or — with
+        ``?chip=<key>`` — one chip's own series from the per-chip ring."""
+        chip = request.query.get("chip")
+        async with self._lock:  # render_frame appends from the worker thread
+            if chip is None:
+                snapshot = list(self.service.history)
+                return web.json_response(
+                    {
+                        "history": [
+                            {"ts": ts, "averages": avgs}
+                            for ts, avgs in snapshot
+                        ]
+                    }
+                )
+            series = self.service.chip_series(chip)
+        if series is None:
+            raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
+        return web.json_response(
+            {
+                "chip": chip,
+                "history": [
+                    {"ts": ts, "values": values} for ts, values in series
+                ],
+            }
+        )
+
+    async def chip(self, request: web.Request) -> web.Response:
+        """Single-chip drill-down model (identity + gauges + chip trends +
+        alerts + ICI neighbors) — reached by clicking a heatmap cell."""
+        key = request.query.get("key")
+        if not key:
+            raise web.HTTPBadRequest(text="missing ?key=<slice>/<chip>")
+        entry = self._entry(request)
+        if self.service.last_df is None:
+            await self._get_frame(entry=entry)  # prime on first request
+        use_gauge = entry.state.use_gauge
+        async with self._lock:
+            # cheap membership gate BEFORE the cache and the executor: an
+            # unknown-key probe loop must neither grow the cache nor
+            # serialize figure builds behind the frame lock
+            df = self.service.last_df
+            if df is None or key not in df.index:
+                raise web.HTTPNotFound(text=f"unknown chip {key!r}")
+            # details change only when the data does: with N open drill
+            # panels each SSE tick would otherwise rebuild ~10 figures per
+            # panel under the frame lock, queueing every compose behind it
+            cache_key = (key, use_gauge)
+            version, cached = self._chip_cache
+            if version == self._data_version and cache_key in cached:
+                detail = cached[cache_key]
+            else:
+                loop = asyncio.get_running_loop()
+                detail = await loop.run_in_executor(
+                    None, self.service.chip_detail, key, use_gauge
+                )
+                if version != self._data_version or len(cached) > 2048:
+                    cached = {}  # bound: ≤ 2 styles × chip count, reset
+                cached[cache_key] = detail
+                self._chip_cache = (self._data_version, cached)
+        if detail is None:
+            raise web.HTTPNotFound(text=f"unknown chip {key!r}")
+        return web.json_response(detail)
+
+    async def alerts(self, request: web.Request) -> web.Response:
+        """Current alert states (firing + pending), critical first."""
+        async with self._lock:
+            snapshot = list(self.service.last_alerts)
+        return web.json_response({"alerts": snapshot})
+
+    def _invalidate_frames(self) -> None:
+        """Global-state change (silences): every session's cached compose
+        is stale — bump all state versions (caller holds the lock)."""
+        self.sessions.invalidate_all()
+
+    async def silence_alert(self, request: web.Request) -> web.Response:
+        """POST {rule?, chip?, ttl_s} — acknowledge: silence matching
+        alerts for ttl_s seconds (rule/chip default "*" wildcards).  The
+        silence is flagged on frame/alert entries, excluded from webhook
+        paging, persisted across restart, and expires on its own — when
+        it does while the alert still fires, the pager fires then."""
+        try:
+            body = await request.json()
+            ttl = float(body.get("ttl_s", 3600.0))
+            rule = str(body.get("rule", "*") or "*")
+            chip = str(body.get("chip", "*") or "*")
+        except (ValueError, TypeError, AttributeError) as e:
+            raise web.HTTPBadRequest(text=f"bad silence request: {e}")
+        async with self._lock:
+            try:
+                entry = self.service.silences.add(rule, chip, ttl, time.time())
+            except ValueError as e:
+                raise web.HTTPBadRequest(text=str(e))
+            # re-annotate so the flag is live on the NEXT frame/alerts read,
+            # not only after the next scrape cycle
+            self.service.silences.annotate(self.service.last_alerts, time.time())
+            await self._save_state()
+            self._invalidate_frames()
+        return web.json_response({"silenced": entry})
+
+    async def unsilence_alert(self, request: web.Request) -> web.Response:
+        """POST {rule?, chip?} — drop the exact (rule, chip) silence."""
+        try:
+            body = await request.json()
+            rule = str(body.get("rule", "*") or "*")
+            chip = str(body.get("chip", "*") or "*")
+        except (ValueError, TypeError, AttributeError) as e:
+            raise web.HTTPBadRequest(text=f"bad unsilence request: {e}")
+        async with self._lock:
+            removed = self.service.silences.remove(rule, chip)
+            self.service.silences.annotate(self.service.last_alerts, time.time())
+            await self._save_state()
+            self._invalidate_frames()
+        if not removed:
+            raise web.HTTPNotFound(text=f"no silence for {rule!r}/{chip!r}")
+        return web.json_response({"removed": {"rule": rule, "chip": chip}})
+
+    async def list_silences(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            active = self.service.silences.active(time.time())
+        return web.json_response({"silences": active})
+
+    def _replay_source(self):
+        """The FileReplaySource under the retry/recording wrappers, or
+        None when the dashboard is not replaying a recording."""
+        from tpudash.sources import unwrap_source
+        from tpudash.sources.recorder import FileReplaySource
+
+        return unwrap_source(self.service.source, FileReplaySource)
+
+    async def replay_status(self, request: web.Request) -> web.Response:
+        """Scrub-control state: current index/ts + recording bounds.
+        404 when the active source is not a recording replay."""
+        replay = self._replay_source()
+        if replay is None:
+            raise web.HTTPNotFound(text="not replaying a recording")
+        async with self._lock:
+            return web.json_response(replay.position())
+
+    async def replay_seek(self, request: web.Request) -> web.Response:
+        """POST {index} | {t} | {paused} — time-travel an incident
+        recording: seek to a snapshot (by index or recorded epoch
+        timestamp), optionally pause auto-advance (scrub mode), and
+        re-render immediately from the sought snapshot."""
+        replay = self._replay_source()
+        if replay is None:
+            raise web.HTTPNotFound(text="not replaying a recording")
+        # validate EVERYTHING before mutating anything: a 400 response
+        # must not leave auto-advance silently paused
+        try:
+            body = await request.json()
+            index = body.get("index")
+            t = body.get("t")
+            paused = body.get("paused")
+            index = int(index) if index is not None else None
+            t = float(t) if t is not None else None
+        except (ValueError, TypeError, AttributeError) as e:
+            raise web.HTTPBadRequest(text=f"bad replay request: {e}")
+        async with self._lock:
+            if paused is not None:
+                replay.paused = bool(paused)
+            if index is not None or t is not None:
+                replay.seek(index=index, ts=t)
+                # serve the sought snapshot NOW, not an interval later
+                await self._refresh_locked(force=True)
+            return web.json_response(replay.position())
+
+    async def stragglers(self, request: web.Request) -> web.Response:
+        """Current fleet outliers (firing + pending), worst first — the
+        chips gating SPMD lockstep, named (tpudash.stragglers)."""
+        async with self._lock:
+            snapshot = list(self.service.last_stragglers)
+        return web.json_response(
+            {
+                "stragglers": snapshot,
+                "last_updated": self.service.last_updated,
+            }
+        )
+
+    async def alert_rules_yaml(self, request: web.Request) -> web.Response:
+        """The active alert rules as a Prometheus alerting-rule file, so
+        the cluster pager can be configured from the same source of truth
+        as the in-app banner (TPUDASH_ALERT_RULES)."""
+        engine = self.service.alert_engine
+        if engine is None:
+            raise web.HTTPNotFound(
+                text="alerting disabled (TPUDASH_ALERT_RULES=off)"
+            )
+        from tpudash.alerts import prometheus_rules_yaml
+
+        text = prometheus_rules_yaml(
+            engine.rules,
+            self.service.cfg.refresh_interval,
+            silences=self.service.silences.active(time.time()),
+        )
+        return web.Response(
+            text=text,
+            content_type="application/yaml",
+            headers={
+                "Content-Disposition": "attachment; filename=tpudash-alerts.yaml"
+            },
+        )
+
+    async def schema(self, request: web.Request) -> web.Response:
+        """Self-documenting API: every scraped series (with exporter help
+        text), derived columns, panels, and generation registry — what a
+        programmatic consumer needs to interpret /api/frame and the CSV."""
+        from tpudash import compat
+        from tpudash import schema as s
+        from tpudash.app.service import _GENERIC_GAP, PANEL_GAP_REASONS
+        from tpudash.registry import TPU_GENERATIONS
+
+        df = self.service.last_df
+        capabilities = {
+            "source": self.service.source.name,
+            # columns the ACTIVE source actually delivered last scrape
+            # (None until the first successful frame)
+            "available_columns": (
+                sorted(map(str, df.columns)) if df is not None else None
+            ),
+            "panel_gaps": (
+                [
+                    {
+                        "column": spec.column,
+                        "title": spec.title,
+                        "reason": PANEL_GAP_REASONS.get(
+                            spec.column, _GENERIC_GAP
+                        ),
+                    }
+                    for spec in s.PANELS
+                    if df is not None and spec.column not in df.columns
+                ]
+            ),
+            # standing dialect limitations, independent of the active source
+            "dialect_notes": {
+                col: reason for col, reason in PANEL_GAP_REASONS.items()
+            },
+        }
+        return web.json_response(
+            {
+                "capabilities": capabilities,
+                "scrape_series": [
+                    {"name": name, "help": s.SERIES_HELP.get(name, "")}
+                    for name in (
+                        *s.SCRAPE_SERIES, s.HBM_BANDWIDTH,
+                        s.MXU_UTIL, s.MEMBW_UTIL,
+                    )
+                ],
+                # real-world dialects accepted with zero config: GKE
+                # tpu-device-plugin + libtpu runtime metric names
+                "series_aliases": dict(sorted(compat.SERIES_ALIASES.items())),
+                "derived_columns": list(s.DERIVED_COLUMNS),
+                "identity_columns": list(s.IDENTITY_COLUMNS),
+                "panels": [
+                    {
+                        "column": p.column,
+                        "title": p.title,
+                        "unit": p.unit,
+                        "max_policy": p.max_policy,
+                        "fixed_max": p.fixed_max,
+                    }
+                    for p in (*s.PANELS, *s.EXTRA_PANELS)
+                ],
+                # fleet outlier scoring (tpudash.stragglers): the active
+                # watch list, or None when disabled
+                "straggler_rules": (
+                    [
+                        {
+                            "column": r.column,
+                            "direction": r.direction,
+                            "for_cycles": r.for_cycles,
+                        }
+                        for r in self.service.straggler_detector.rules
+                    ]
+                    if self.service.straggler_detector is not None
+                    else None
+                ),
+                "generations": {
+                    name: {
+                        "hbm_gib": g.hbm_gib,
+                        "nominal_power_w": g.nominal_power_w,
+                        "peak_bf16_tflops": g.peak_bf16_tflops,
+                        "ici_link_gbps": g.ici_link_gbps,
+                        "accelerator_types": list(g.accelerator_types),
+                    }
+                    for name, g in TPU_GENERATIONS.items()
+                },
+            }
+        )
+
+    async def topology(self, request: web.Request) -> web.Response:
+        """The fleet's torus model (dims, per-chip coordinates, ICI
+        neighbor graph) for external tooling — the geometry the heatmaps
+        render, as data."""
+        entry = self._entry(request)
+        if self.service.last_df is None:
+            await self._get_frame(entry=entry)  # prime on first request
+        loop = asyncio.get_running_loop()
+        model = await loop.run_in_executor(None, self.service.topology_model)
+        if model is None:
+            raise web.HTTPServiceUnavailable(text="no frame rendered yet")
+        return web.json_response(model)
+
+    async def config(self, request: web.Request) -> web.Response:
+        """Effective configuration (secrets redacted) — "which knobs is
+        this dashboard actually running with" without shell access to its
+        pod.  Values come from the live Config, so env parsing and
+        defaults are already applied."""
+        import dataclasses
+
+        cfg = dataclasses.asdict(self.service.cfg)
+        for secret in ("auth_token", "alert_webhook"):
+            if cfg.get(secret):
+                cfg[secret] = "<set>"
+        return web.json_response({"config": cfg})
+
+    async def history_csv(self, request: web.Request) -> web.Response:
+        """The rolling trend history as CSV (one row per point, one column
+        per metric) for offline analysis — fleet averages by default, one
+        chip's own series with ``?chip=``."""
+        chip = request.query.get("chip")
+        async with self._lock:
+            if chip is None:
+                rows = [
+                    (ts, dict(avgs)) for ts, avgs in self.service.history
+                ]
+            else:
+                series = self.service.chip_series(chip)
+                if series is None:
+                    raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
+                rows = series
+        columns: list = []
+        for _, values in rows:
+            for c in values:
+                if c not in columns:
+                    columns.append(c)
+        lines = ["ts," + ",".join(columns)]
+        for ts, values in rows:
+            cells = [f"{ts:.3f}"]
+            for c in columns:
+                v = values.get(c)
+                cells.append("" if v is None else f"{v}")
+            lines.append(",".join(cells))
+        name = f"tpudash-history{'-' + chip.replace('/', '_') if chip else ''}.csv"
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/csv",
+            headers={"Content-Disposition": f"attachment; filename={name}"},
+        )
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        health = self.service.source_health()
+        return web.json_response(
+            {"ok": True, "source": self.service.source.name,
+             "error": self.service.last_error,
+             "source_health": health}
+        )
+
+    @web.middleware
+    async def _compress(self, request: web.Request, handler):
+        """Negotiated gzip/deflate on sizable bodies: frame JSON is
+        number-heavy and compresses ~6-8×, so a polling client's 100KB
+        frame ships as ~15KB when the browser sends Accept-Encoding.
+        Small bodies skip it (header overhead beats the win)."""
+        resp = await handler(request)
+        if (
+            isinstance(resp, web.Response)
+            and resp.body is not None
+            and len(resp.body) > 1024
+        ):
+            resp.enable_compression()
+        return resp
+
+    @web.middleware
+    async def _auth(self, request: web.Request, handler):
+        """Bearer-token gate (Config.auth_token); only /api/stream also
+        accepts ``?token=`` (EventSource transport).  /healthz stays open
+        so Kubernetes probes don't need the secret, and the index page —
+        a static shell with no metric data — stays open so a browser
+        navigation (which cannot send headers) can load it; the page's
+        JS then authenticates every data call."""
+        token = self.service.cfg.auth_token
+        if not token or request.path in ("/", "/healthz"):
+            return await handler(request)
+        header = request.headers.get("Authorization", "")
+        supplied = header[7:] if header.startswith("Bearer ") else None
+        if supplied is None and request.path == "/api/stream":
+            # EventSource cannot set headers, so /api/stream alone may pass
+            # the token in the query string; every other route is
+            # header-only (query strings leak into access logs, referrers,
+            # and browser history)
+            supplied = request.query.get("token")
+        # compare as bytes: str compare_digest raises on non-ASCII input,
+        # which would turn a bad token into a 500 instead of a 401
+        if not supplied or not hmac.compare_digest(
+            supplied.encode(), token.encode()
+        ):
+            raise web.HTTPUnauthorized(text="missing or invalid token")
+        return await handler(request)
+
+    def build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._auth, self._compress])
+        app.router.add_get("/", self.index)
+        app.router.add_get("/api/frame", self.frame)
+        app.router.add_get("/api/stream", self.stream)
+        app.router.add_get("/api/export.csv", self.export_csv)
+        app.router.add_post("/api/select", self.select)
+        app.router.add_post("/api/style", self.style)
+        app.router.add_get("/api/timings", self.timings)
+        app.router.add_get("/api/schema", self.schema)
+        app.router.add_post("/api/profile", self.profile)
+        app.router.add_get("/api/history", self.history)
+        app.router.add_get("/api/history.csv", self.history_csv)
+        app.router.add_get("/api/chip", self.chip)
+        app.router.add_get("/api/config", self.config)
+        app.router.add_get("/api/topology", self.topology)
+        app.router.add_get("/api/alerts", self.alerts)
+        app.router.add_post("/api/alerts/silence", self.silence_alert)
+        app.router.add_post("/api/alerts/unsilence", self.unsilence_alert)
+        app.router.add_get("/api/alerts/silences", self.list_silences)
+        app.router.add_get("/api/stragglers", self.stragglers)
+        app.router.add_get("/api/replay", self.replay_status)
+        app.router.add_post("/api/replay", self.replay_seek)
+        app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
+        app.router.add_get("/healthz", self.healthz)
+        if self.service.cfg.history_path:
+            # final trend snapshot on graceful shutdown (periodic saves
+            # cover crashes up to history_save_interval behind)
+            async def _save_history(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.save_history)
+
+            app.on_cleanup.append(_save_history)
+        if self.service.cfg.state_path:
+            # final state snapshot (sessions idle since their last
+            # mutation would otherwise persist stale idle ages)
+            async def _save_state(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.save_state)
+
+            app.on_cleanup.append(_save_state)
+        return app
+
+
+def make_app(cfg: Config | None = None) -> web.Application:
+    cfg = cfg or load_config()
+    service = DashboardService(cfg, make_source(cfg))
+    return DashboardServer(service).build_app()
+
+
+def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    from tpudash.config import configure_logging
+    from tpudash.parallel.distributed import maybe_initialize
+
+    configure_logging()
+    # multi-host rendezvous must precede any device query; also covers
+    # the installed `tpudash` console script, not just `python -m`
+    maybe_initialize()
+    cfg = cfg or load_config()
+    web.run_app(make_app(cfg), host=cfg.host, port=cfg.port)

@@ -1,0 +1,1433 @@
+"""DashboardService — one frame = scrape → normalize → figures.
+
+The testable core of L4 (the reference mixes this into its render loop,
+app.py:320-486).  ``render_frame()`` returns a JSON-able dict with:
+
+- ``chips``: the selection-grid model (key, chip_id, slice, host, model) —
+  the reference's checkbox grid source (app.py:266-313);
+- ``average``: panel row averaged over selected chips, zero-exclusion
+  power policy applied (app.py:341-345), plus chip count;
+- ``device_rows``: per-chip panel rows with model-aware power maxima and
+  headers "TPU {id} ({model})" (app.py:411-476) — only emitted while the
+  selection is small (config.per_chip_panel_limit);
+- ``heatmaps``: one topology heatmap per panel metric across ALL selected
+  chips — the O(1)-figures path that replaces per-chip rows at 256-chip
+  scale (SURVEY.md §3.2 scaling wall);
+- ``stats``: mean/max/min table rounded to 2 dp (app.py:478-481);
+- ``error``: the error-banner string when the source failed this cycle —
+  the app keeps polling (app.py:225-227, 333);
+- ``timings``: scrape/normalize/render stage p50s (SURVEY.md §5 tracing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import datetime as _dt
+import functools
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+import pandas as pd
+
+log = logging.getLogger(__name__)
+
+from tpudash import schema
+from tpudash.config import Config
+from tpudash.normalize import (
+    block_average,
+    column_average,
+    compute_stats,
+    dense_block,
+    filter_selected,
+    to_wide,
+    chip_links,
+    torus_neighbor_keys,
+)
+from tpudash.app.state import SelectionState
+from tpudash.registry import resolve_generation
+from tpudash.sources.base import MetricsSource
+from tpudash.topology import topology_for
+from tpudash.utils.timing import StageTimer
+from tpudash.viz.dispatch import accel_types_for, create_visualization, panel_max
+from tpudash.viz.figures import (
+    create_sparkline,
+    create_topology_heatmap,
+    key_grid,
+)
+
+
+#: Known real-world dialect gaps, shown when a reference-parity panel has
+#: no series in the current scrape: neither the GKE tpu-device-plugin nor
+#: the libtpu runtime-metrics surface carries power or temperature
+#: (tpudash.compat SERIES_ALIASES cover duty-cycle/HBM/MXU/mem-BW only) —
+#: only the in-repo exporter/probe sources provide them.
+PANEL_GAP_REASONS = {
+    schema.POWER: (
+        "no power series in this scrape — the GKE tpu-device-plugin and "
+        "libtpu runtime dialects do not export power; use the tpudash "
+        "exporter/probe source for it"
+    ),
+    schema.TEMPERATURE: (
+        "no temperature series in this scrape — the GKE tpu-device-plugin "
+        "and libtpu runtime dialects do not export temperature; use the "
+        "tpudash exporter/probe source for it"
+    ),
+}
+_GENERIC_GAP = "no source series in the current scrape"
+
+
+def _downsample(pts: list, max_points: int) -> "tuple[list, dict]":
+    """(strided points anchored at the newest, {ts: "HH:MM:SS"} labels) —
+    shared by the fleet sparklines and the per-chip drill-down trends."""
+    stride = max(1, -(-len(pts) // max_points))
+    pts = pts[::-1][::stride][::-1]
+    fmt = {
+        ts: _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+        for ts, _ in pts
+    }
+    return pts, fmt
+
+
+@functools.lru_cache(maxsize=256)
+def _model_name(accel: str) -> str:
+    gen = resolve_generation(accel)
+    # Unknown models render as "unknown", not "None" (reference quirk at
+    # app.py:415 not replicated).
+    return gen.name if gen else (accel or "unknown")
+
+
+class DashboardService:
+    def __init__(self, cfg: Config, source: MetricsSource):
+        self.cfg = cfg
+        self.source = source
+        self.state = SelectionState()
+        self.timer = StageTimer()
+        #: True between refresh_data() and the first compose_frame() that
+        #: records the render stage and closes the timer frame
+        self._frame_open = False
+        #: data-pull wall time shown on every frame composed from it
+        self.last_updated: str = _dt.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        )
+        #: per-refresh identity extraction shared across session composes
+        self._chips_base: list = []
+        self._ident_chips = None
+        self._ident_slices = None
+        self._ident_keys = None
+        self._ident_accels: list = []
+        self.last_error: str | None = None
+        #: set by the server's refresh watchdog while a fetch is stalled
+        #: (frames keep serving the last data with this warning attached)
+        self.refresh_stalled: "str | None" = None
+        #: serializes data publication against frame composition: a fetch
+        #: parked by the watchdog completes on its executor thread while
+        #: composes keep running — without this, a recovering refresh
+        #: could swap last_df/identity caches mid-compose (torn frames)
+        self._publish_lock = threading.RLock()
+        #: wide per-chip table from the last successful frame (CSV export)
+        self.last_df: "pd.DataFrame | None" = None
+        #: chip keys seen in the last successful frame — the "currently
+        #: available devices" selection ops validate against (app.py:281).
+        self.available: list[str] = []
+        #: the composite state checkpoint, parsed ONCE: UI state here,
+        #: silences below, per-browser sessions by DashboardServer
+        from tpudash.app.state import read_state_doc
+
+        self._restored_state_doc: dict = (
+            read_state_doc(cfg.state_path) or {} if cfg.state_path else {}
+        )
+        if self._restored_state_doc and self.state.load_dict(
+            self._restored_state_doc
+        ):
+            log.info("restored UI state from %s", cfg.state_path)
+        #: rolling (wall_ts, {column: fleet-average}) per successful
+        #: frame — trend history the reference never kept.  At the default
+        #: 5 s cadence, the default 720 points ≈ one hour.
+        self.history: deque = deque(maxlen=max(2, cfg.history_points))
+        #: per-CHIP rolling history for the drill-down view: (wall_ts,
+        #: float32 matrix) aligned to _chip_hist_keys rows and
+        #: _chip_hist_cols columns.  720 × 256 chips × ~10 metrics ≈ 7 MB
+        #: (cfg.history_points scales it for larger fleets).  The ring
+        #: resets when the chip population or metric set changes (slice
+        #: resize, new exporter) — alignment beats splicing.
+        self.chip_history: deque = deque(maxlen=max(2, cfg.history_points))
+        self._chip_hist_keys: list = []
+        self._chip_hist_cols: list = []
+        self._chip_hist_rowmap: dict = {}
+        #: full-table dense block from the last refresh — shared by the
+        #: history appends and select-all composes
+        self._df_block = (None, [])
+        if cfg.history_backfill > 0:
+            self._backfill_history()
+        #: trend persistence (TPUDASH_HISTORY_PATH): restore the rings
+        #: unless a Prometheus backfill already seeded them — live range
+        #: data beats a snapshot from before the restart
+        self._last_history_save = time.time()
+        #: serializes snapshot+write: the shutdown save must not lose the
+        #: os.replace race to a slower in-flight periodic save (older
+        #: snapshot winning the rename)
+        self._history_save_lock = threading.Lock()
+        if cfg.history_path:
+            self._sweep_history_tmp()
+            if not self.history:
+                self._load_history()
+        #: threshold alerting over every chip in the table (not just the
+        #: selected ones) — see tpudash.alerts
+        from tpudash.alerts import AlertEngine, SilenceSet
+
+        self.alert_engine = AlertEngine.from_config(cfg)
+        self.last_alerts: list[dict] = []
+        #: operator acknowledgements: (rule, chip, ttl) silences — flagged
+        #: on the frame, excluded from webhook paging, persisted in the
+        #: state checkpoint (tpudash.alerts.SilenceSet)
+        self.silences = SilenceSet()
+        #: set by DashboardServer: () -> dict of per-browser session state
+        #: to ride the state checkpoint (the service owns the file, the
+        #: server owns the sessions)
+        self.sessions_snapshot: "object | None" = None
+        items = self._restored_state_doc.get("silences")
+        if items:
+            self.silences = SilenceSet.from_dicts(items, time.time())
+        #: fleet outlier scoring every refresh (tpudash.stragglers) — the
+        #: chip gating the slice's lockstep step time, named, not just
+        #: visible on the heatmap
+        from tpudash.stragglers import StragglerDetector
+
+        self.straggler_detector = StragglerDetector.from_config(cfg)
+        self.last_stragglers: list[dict] = []
+        #: (rule, chip) pairs firing in the previous frame — webhook
+        #: notifications are sent on transitions only, not every cycle
+        self._firing_keys: set = set()
+        #: set by the profile endpoint while it replays synthetic renders
+        #: (those must never page anyone)
+        self.mute_notifications = False
+        #: every in-flight webhook delivery thread — a set, not "the latest
+        #: one": two back-to-back transitions spawn two deliveries and
+        #: flush_webhooks must wait for both
+        self._webhook_threads: set = set()
+
+    @property
+    def restored_sessions(self) -> dict:
+        """The checkpoint's per-browser session section (server restores
+        it into its SessionStore at construction)."""
+        sessions = self._restored_state_doc.get("sessions")
+        return sessions if isinstance(sessions, dict) else {}
+
+    def save_state(self) -> None:
+        """Persist the composite state checkpoint: the anonymous default
+        session's UI state, active alert silences, and (when the server
+        registered its provider) the per-browser cookie-session map —
+        atomically.  One file (cfg.state_path), one writer —
+        SelectionState.save wrote only its own keys and would drop the
+        rest.  Blocking disk I/O: the server calls this off the event
+        loop (executor)."""
+        path = self.cfg.state_path
+        if not path:
+            return
+        from tpudash.app.state import atomic_write_json
+
+        doc = self.state.to_dict()
+        doc["silences"] = self.silences.to_dicts()
+        if self.sessions_snapshot is not None:
+            try:
+                doc["sessions"] = self.sessions_snapshot()
+            except Exception as e:  # noqa: BLE001 — sessions are best-effort
+                log.warning("session snapshot failed: %s", e)
+        atomic_write_json(path, doc)
+
+    def _notify_alert_transitions(self) -> None:
+        """POST newly-firing and resolved alerts to Config.alert_webhook
+        (the pager integration the reference's error banner couldn't be).
+        Transition-edge only — a steadily-firing alert posts once.
+
+        Silence semantics (Alertmanager-style): a silenced alert is
+        suppressed, not resolved.  Acknowledging a paged alert emits NO
+        webhook at all — 'resolved' would close the downstream incident
+        while the chip still breaches; a silence expiring mid-fire IS a
+        firing transition (it pages again); and an alert that recovers
+        while silenced stays suppressed (no late 'resolved' either)."""
+        firing = {
+            (a["rule"], a["chip"]): a
+            for a in self.last_alerts
+            if a["state"] == "firing" and not a.get("silenced")
+        }
+        still_firing_silenced = {
+            (a["rule"], a["chip"])
+            for a in self.last_alerts
+            if a["state"] == "firing" and a.get("silenced")
+        }
+        fired = [firing[k] for k in firing.keys() - self._firing_keys]
+        resolved = sorted(
+            self._firing_keys - firing.keys() - still_firing_silenced
+        )
+        self._firing_keys = set(firing)
+        if (
+            not self.cfg.alert_webhook
+            or self.mute_notifications
+            or not (fired or resolved)
+        ):
+            return
+        payload = {
+            "source": "tpudash",
+            "fired": sorted(fired, key=lambda a: (a["rule"], a["chip"])),
+            "resolved": [
+                {"rule": rule, "chip": chip} for rule, chip in resolved
+            ],
+        }
+        # deliver OFF the frame path: render_frame runs under the server's
+        # frame lock, so a black-holed pager endpoint must not stall every
+        # /api/* route for http_timeout seconds
+        import threading
+
+        # prune finished deliveries so the set stays bounded over a
+        # long-running server, then track the new one
+        self._webhook_threads = {
+            th for th in self._webhook_threads if th.is_alive()
+        }
+        t = threading.Thread(
+            target=self._deliver_webhook, args=(payload,), daemon=True
+        )
+        self._webhook_threads.add(t)
+        t.start()
+
+    def _deliver_webhook(self, payload: dict) -> None:
+        try:
+            import requests
+
+            requests.post(
+                self.cfg.alert_webhook,
+                json=payload,
+                timeout=self.cfg.http_timeout,
+            ).raise_for_status()
+        except Exception as e:  # noqa: BLE001 — notification is best-effort
+            log.warning("alert webhook delivery failed: %s", e)
+
+    def flush_webhooks(self, timeout: float = 5.0) -> None:
+        """Wait for ALL in-flight webhook deliveries (tests, shutdown),
+        sharing one wall-clock budget across them."""
+        deadline = time.monotonic() + timeout
+        for t in list(self._webhook_threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+            if not t.is_alive():
+                self._webhook_threads.discard(t)
+
+    @contextlib.contextmanager
+    def synthetic_load(self):
+        """Treat renders inside this block as synthetic load (the profile
+        endpoint may burn 100 frames in a second), not monitoring cycles:
+        webhooks are muted, alert hysteresis / last-alerts / trend history
+        are restored on exit, recording wrappers skip their appends, and
+        source-health counters roll back — a replay file, ``/api/alerts``
+        and ``/healthz`` must reflect real cycles only."""
+        from tpudash.sources.recorder import RecordingSource
+
+        engine = self.alert_engine
+        saved_tracks = (
+            copy.deepcopy(engine._tracks) if engine is not None else None
+        )
+        detector = self.straggler_detector
+        saved_straggler_tracks = (
+            copy.deepcopy(detector._tracks) if detector is not None else None
+        )
+        saved_stragglers = self.last_stragglers
+        saved_alerts = self.last_alerts
+        saved_firing = set(self._firing_keys)
+        saved_history = list(self.history)
+        # /healthz and the error banner serve last_error too: a synthetic
+        # render must neither clear a real outage nor leave a fake one
+        saved_error = self.last_error
+        paused_recorders: list = []
+        health_snaps: list = []
+        # walk the wrapper chain via instance attrs only (both wrappers
+        # define __getattr__ fall-through, so plain getattr would read
+        # through to the inner source and loop)
+        src, seen = self.source, set()
+        while src is not None and id(src) not in seen:
+            seen.add(id(src))
+            if isinstance(src, RecordingSource) and not src.paused:
+                src.paused = True
+                paused_recorders.append(src)
+            health = src.__dict__.get("health")
+            if health is not None and hasattr(health, "snapshot"):
+                health_snaps.append((health, health.snapshot()))
+            src = src.__dict__.get("inner")
+        self.mute_notifications = True
+        try:
+            yield
+        finally:
+            self.mute_notifications = False
+            for rec in paused_recorders:
+                rec.paused = False
+            for health, snap in health_snaps:
+                health.restore(snap)
+            if engine is not None:
+                engine._tracks = saved_tracks
+            if detector is not None:
+                detector._tracks = saved_straggler_tracks
+            # /api/alerts must not serve the synthetic renders' inflated
+            # streaks until the next real frame
+            self.last_alerts = saved_alerts
+            self.last_stragglers = saved_stragglers
+            self._firing_keys = saved_firing
+            self.last_error = saved_error
+            self.history.clear()
+            self.history.extend(saved_history)
+
+    def _backfill_history(self) -> None:
+        """Seed the trend history from the source's range query (Prometheus
+        ``query_range``) so sparklines show Config.history_backfill seconds
+        of real trend on the very first frame.  Backfilled averages cover
+        ALL chips in scope (the live loop averages the *selected* chips);
+        failures degrade to an empty history, never a startup crash."""
+        fetch_history = getattr(self.source, "fetch_history", None)
+        if fetch_history is None:
+            return
+        # clamp to what the rolling deque can keep: asking for more points
+        # than maxlen both wastes the transfer and risks Prometheus's
+        # per-series point cap (11k) rejecting the whole range query
+        step = max(self.cfg.refresh_interval, 1.0)
+        duration = min(
+            self.cfg.history_backfill, (self.history.maxlen or 0) * step
+        )
+        try:
+            points = fetch_history(duration, step)
+        except Exception as e:  # noqa: BLE001 — backfill is best-effort
+            log.warning("history backfill failed: %s", e)
+            return
+        columns = [p.column for p in (*schema.PANELS, *schema.EXTRA_PANELS)]
+        n = 0
+        ring_frames: list = []
+        for ts, samples in points[-(self.history.maxlen or 0) :]:
+            try:
+                df = to_wide(samples)
+            except Exception:  # noqa: BLE001 — skip malformed slots
+                continue
+            avgs = {
+                col: column_average(df, col) for col in columns if col in df.columns
+            }
+            if avgs:
+                self.history.append((float(ts), avgs))
+                ring_frames.append((float(ts), df))
+                n += 1
+        # Seed the per-chip ring too, so drill-down sparklines carry real
+        # trend right after a restart.  Range data is ragged (a metric or
+        # chip can be absent at some timestamps), so every point aligns to
+        # the UNION of chips/metrics across the window — a series that
+        # happens to miss the final step keeps its earlier trend, and
+        # missing cells become NaN instead of thrashing the alignment.
+        # Best-effort like the rest of backfill: never a startup crash.
+        try:
+            if ring_frames:
+                from tpudash.app.state import _sort_key
+                from tpudash.normalize import numeric_columns
+
+                all_keys: dict = {}
+                all_cols: dict = {}
+                for _, df in ring_frames:
+                    for k in df.index:
+                        all_keys[k] = None
+                    for c in numeric_columns(df):
+                        all_cols[c] = None
+                # same (slice, chip) order to_wide produces, so a live
+                # frame with the same population realigns instead of
+                # resetting the ring
+                keys = sorted(all_keys, key=_sort_key)
+                cols = list(all_cols)
+                if cols:
+                    self.chip_history.clear()
+                    self._chip_hist_keys = keys
+                    self._chip_hist_cols = cols
+                    self._chip_hist_rowmap = {
+                        k: i for i, k in enumerate(keys)
+                    }
+                    for ts, df in ring_frames:
+                        sub = df.reindex(index=keys, columns=cols).apply(
+                            pd.to_numeric, errors="coerce"
+                        )
+                        self.chip_history.append(
+                            (ts, sub.to_numpy(dtype=np.float32))
+                        )
+        except Exception as e:  # noqa: BLE001 — ring seeding is optional
+            log.warning("per-chip history backfill failed: %s", e)
+            self.chip_history.clear()
+            self._chip_hist_keys = []
+            self._chip_hist_cols = []
+            self._chip_hist_rowmap = {}
+        if n:
+            log.info(
+                "backfilled %d trend points covering %.0f s", n, self.cfg.history_backfill
+            )
+
+    def save_history(self) -> None:
+        """Snapshot both trend rings to ``cfg.history_path`` (compressed
+        npz, atomic replace) — the restart-survival the in-memory deques
+        can't offer sources without a Prometheus range query.  The
+        snapshot is taken under the publish lock (cheap: list() of ring
+        entries); compression runs outside it.  Never raises: trend
+        persistence must not take down a refresh or a shutdown."""
+        path = self.cfg.history_path
+        if not path:
+            return
+        # the save lock covers snapshot AND write: whoever writes last
+        # snapshotted last, so the newest data always wins the rename
+        with self._history_save_lock:
+            self._save_history_locked(path)
+
+    def _save_history_locked(self, path: str) -> None:
+        import json as _json
+        import tempfile
+
+        with self._publish_lock:
+            fleet = list(self.history)
+            chip_pts = list(self.chip_history)
+            keys = list(self._chip_hist_keys)
+            cols = list(self._chip_hist_cols)
+        if not fleet and not chip_pts:
+            return  # nothing learned yet — don't clobber a previous file
+        try:
+            fcols: list = []
+            fpos: dict = {}
+            for _, avgs in fleet:
+                for c in avgs:
+                    if c not in fpos:
+                        fpos[c] = len(fcols)
+                        fcols.append(c)
+            fts = np.array([ts for ts, _ in fleet], dtype=np.float64)
+            fdata = np.full((len(fleet), len(fcols)), np.nan, dtype=np.float64)
+            for i, (_, avgs) in enumerate(fleet):
+                for c, v in avgs.items():
+                    fdata[i, fpos[c]] = v
+            cts = np.array([ts for ts, _ in chip_pts], dtype=np.float64)
+            cdata = (
+                np.stack([m for _, m in chip_pts])
+                if chip_pts
+                else np.zeros((0, 0, 0), dtype=np.float32)
+            )
+            meta = _json.dumps(
+                {"fleet_cols": fcols, "chip_keys": keys, "chip_cols": cols}
+            )
+            # temp name scoped to the target file so concurrent tpudash
+            # instances sharing a directory (distinct history files) can
+            # never sweep each other's in-flight save
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(os.path.abspath(path)) or ".",
+                prefix=os.path.basename(path) + ".",
+                suffix=".tmp",
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez_compressed(
+                        f,
+                        meta=np.array(meta),
+                        fleet_ts=fts,
+                        fleet_data=fdata,
+                        chip_ts=cts,
+                        chip_data=cdata,
+                    )
+                os.replace(tmp, path)
+            except BaseException:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp)
+                raise
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            log.warning("history save failed: %s", e)
+
+    def _sweep_history_tmp(self) -> None:
+        """Remove orphaned ``<history-file>.*.tmp`` siblings of
+        history_path — a daemon save thread killed mid-write (process
+        exit) never reaches its own unlink, so startup sweeps what
+        shutdown couldn't.  The pattern is scoped to THIS instance's
+        history file: two instances sharing a directory with distinct
+        history files must not delete each other's in-flight saves."""
+        import glob
+
+        full = os.path.abspath(self.cfg.history_path)
+        d = os.path.dirname(full) or "."
+        base = glob.escape(os.path.basename(full))
+        for tmp in glob.glob(os.path.join(glob.escape(d), base + ".*.tmp")):
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+        # transitional: pre-scoping releases named temps ``tmp*.npz.tmp``;
+        # sweep those too, but only when stale (an old-release sibling
+        # instance's IN-FLIGHT save is seconds old and must survive)
+        import time as _time
+
+        for tmp in glob.glob(os.path.join(glob.escape(d), "tmp*.npz.tmp")):
+            with contextlib.suppress(OSError):
+                if _time.time() - os.path.getmtime(tmp) > 600.0:
+                    os.unlink(tmp)
+
+    def _load_history(self) -> None:
+        """Restore the trend rings from ``cfg.history_path``.  Points
+        older than twice the ring's time span are dropped (a snapshot
+        from last week must not render as if it were the last hour);
+        any malformed file degrades to empty rings, never a crash."""
+        import json as _json
+
+        path = self.cfg.history_path
+        if not os.path.exists(path):
+            return
+        max_age = (
+            (self.history.maxlen or 720)
+            * max(self.cfg.refresh_interval, 1.0)
+            * 2
+        )
+        now = time.time()
+        cutoff = now - max_age
+        # future-timestamped points (snapshot written under a clock that
+        # then stepped backward) are dropped too: the refresh-cadence gate
+        # compares against the ring's LAST timestamp, so one future point
+        # would freeze all new history collection until wall time catches
+        # up
+        horizon = now + max(self.cfg.refresh_interval, 1.0)
+        try:
+            with np.load(path) as z:
+                meta = _json.loads(str(z["meta"]))
+                fleet_ts = z["fleet_ts"]
+                fleet_data = z["fleet_data"]
+                chip_ts = z["chip_ts"]
+                chip_data = z["chip_data"]
+            fcols = list(meta["fleet_cols"])
+            keys = [str(k) for k in meta["chip_keys"]]
+            cols = [str(c) for c in meta["chip_cols"]]
+            n = 0
+            for ts, row in zip(fleet_ts.tolist(), fleet_data):
+                if ts < cutoff or ts > horizon:
+                    continue
+                avgs = {
+                    c: float(v) for c, v in zip(fcols, row.tolist()) if v == v
+                }
+                if avgs:
+                    self.history.append((float(ts), avgs))
+                    n += 1
+            if (
+                keys
+                and cols
+                and chip_data.ndim == 3
+                and chip_data.shape[1:] == (len(keys), len(cols))
+            ):
+                self._chip_hist_keys = keys
+                self._chip_hist_cols = cols
+                self._chip_hist_rowmap = {k: i for i, k in enumerate(keys)}
+                for ts, m in zip(chip_ts.tolist(), chip_data):
+                    if cutoff <= ts <= horizon:
+                        self.chip_history.append(
+                            (float(ts), m.astype(np.float32, copy=False))
+                        )
+            if n or self.chip_history:
+                log.info(
+                    "restored %d fleet / %d per-chip trend points from %s",
+                    n,
+                    len(self.chip_history),
+                    path,
+                )
+        except Exception as e:  # noqa: BLE001 — restore is best-effort
+            log.warning("history restore failed (%s): %s", path, e)
+            self.history.clear()
+            self.chip_history.clear()
+            self._chip_hist_keys = []
+            self._chip_hist_cols = []
+            self._chip_hist_rowmap = {}
+
+    def source_health(self) -> "dict | None":
+        """Health summary from the ResilientSource wrapper (None when
+        retries are disabled and the wrapper is absent)."""
+        health = getattr(self.source, "health", None)
+        return health.summary() if health is not None else None
+
+    # -- panel helpers -------------------------------------------------------
+    def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
+        """The reference's fixed four panels plus TPU extras whose series
+        the source actually provides."""
+        panels = [p for p in schema.PANELS if p.column in df.columns]
+        panels += [p for p in schema.EXTRA_PANELS if p.column in df.columns]
+        return panels
+
+    def _average_row(
+        self, sel_df: pd.DataFrame, panels, use_gauge: bool, avgs: dict
+    ) -> dict:
+        accels = accel_types_for(sel_df)
+        figures = []
+        for spec in panels:
+            avg = avgs.get(spec.column)
+            value = 0.0 if avg is None else avg  # reference renders 0 on empty
+            figures.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_visualization(
+                        value,
+                        spec,
+                        use_gauge=use_gauge,
+                        height=self.cfg.avg_panel_height,
+                        accel_types=accels,
+                        title=f"Avg {spec.title}",
+                    ),
+                }
+            )
+        return {"title": "Average (selected chips)", "figures": figures}
+
+    def _device_rows(self, sel_df: pd.DataFrame, panels, use_gauge: bool) -> list:
+        rows = []
+        for key, row in sel_df.iterrows():
+            accel = row.get(schema.ACCEL_TYPE, "")
+            figures = []
+            for spec in panels:
+                value = row.get(spec.column)
+                if value is None or pd.isna(value):
+                    continue
+                figures.append(
+                    {
+                        "panel": spec.column,
+                        "figure": create_visualization(
+                            float(value),
+                            spec,
+                            use_gauge=use_gauge,
+                            height=self.cfg.device_panel_height,
+                            accel_types=[accel] if accel else None,
+                        ),
+                    }
+                )
+            rows.append(
+                {
+                    # header parity: "### GPU {id} ({model})" app.py:415
+                    "title": f"TPU {row['chip_id']} ({_model_name(accel)})",
+                    "key": key,
+                    "figures": figures,
+                }
+            )
+        return rows
+
+    def _heatmaps(
+        self, sel_df: pd.DataFrame, df: pd.DataFrame, panels, block=None
+    ) -> list:
+        """One heatmap per panel metric, per slice, over selected chips.
+
+        Pure-numpy grouping: the old groupby/boolean-mask version copied
+        the full mixed-dtype frame twice per slice (~8 ms/frame at 256
+        chips); this touches only the identity arrays and the shared
+        numeric block."""
+        out = []
+        arr, cols = block if block is not None else dense_block(sel_df)
+        col_pos = {c: i for i, c in enumerate(cols)}
+        # identity arrays come from the shared per-refresh extraction; the
+        # select-all fast path (filter_selected returns df itself) reuses
+        # them for the selection side too
+        ident_ok = (
+            self._ident_slices is not None
+            and len(self._ident_slices) == len(df)
+        )
+        if ident_ok:
+            all_slices = self._ident_slices
+            all_chips = self._ident_chips
+            all_keys = self._ident_keys
+        else:  # compose without a matching refresh (direct test calls)
+            all_slices = df["slice_id"].to_numpy()
+            all_chips = df["chip_id"].to_numpy()
+            all_keys = df.index.to_numpy()
+        if sel_df is df and ident_ok:
+            sel_slices, sel_chips = all_slices, all_chips
+            sel_accels = np.asarray(self._ident_accels, dtype=object)
+        else:
+            sel_slices = sel_df["slice_id"].to_numpy()
+            sel_chips = sel_df["chip_id"].to_numpy()
+            sel_accels = (
+                sel_df[schema.ACCEL_TYPE].fillna("").to_numpy()
+                if schema.ACCEL_TYPE in sel_df
+                else None
+            )
+        codes, uniques = pd.factorize(sel_slices, sort=True)
+        everything = len(sel_df) == len(df)  # select-all fast path
+        for g, slice_id in enumerate(uniques):
+            if len(uniques) == 1:
+                sel_idx = np.arange(len(sel_df))
+            else:
+                sel_idx = np.nonzero(codes == g)[0]
+            if everything and len(uniques) == 1:
+                all_ids, a_keys = all_chips, all_keys
+            else:
+                amask = all_slices == slice_id
+                all_ids, a_keys = all_chips[amask], all_keys[amask]
+            if sel_accels is not None:
+                accels = sorted({a for a in sel_accels[sel_idx] if a})
+            else:
+                accels = []
+            generation = accels[0] if accels else self.cfg.generation
+            # topology sized to the FULL slice population (not just the
+            # selection) so partial selections keep real torus coordinates.
+            # Bogus ids (negative, or beyond any real pod size — v5p tops
+            # out near 9k chips) are excluded from sizing AND rendering:
+            # per-series tolerance (sources/base.py), a corrupt series
+            # drops its cell, it must not size a 2e9-cell grid or raise.
+            sane = all_ids[(all_ids >= 0) & (all_ids < 16384)]
+            if sane.size == 0:
+                continue
+            n = int(sane.max()) + 1
+            topo = topology_for(generation, n)
+            chip_ids = sel_chips[sel_idx]
+            in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
+            # clickable cells: keys come from the FULL slice population so
+            # a deselected chip can be clicked back on (symmetric toggle),
+            # built once per slice and shared by every panel's figure
+            ok = (all_ids >= 0) & (all_ids < topo.num_chips)
+            # .tolist() yields native ints/strs in one C pass (a per-cell
+            # int()/str() genexpr profiled at ~1 ms/frame at 256 chips)
+            custom_grid = key_grid(
+                topo, dict(zip(all_ids[ok].tolist(), a_keys[ok].tolist()))
+            )
+            for spec in panels:
+                ci = col_pos.get(spec.column)
+                if ci is None:
+                    if arr is not None or spec.column not in sel_df.columns:
+                        continue
+                if arr is not None:
+                    vals = arr[sel_idx, ci]
+                else:  # legacy mixed-dtype frames
+                    vals = pd.to_numeric(
+                        sel_df[spec.column].iloc[sel_idx], errors="coerce"
+                    ).to_numpy(dtype=float, na_value=np.nan)
+                mask = ~np.isnan(vals) & in_range
+                # 2dp: hover shows 1dp, so nothing visible is lost and the
+                # z-matrix wire cost drops ~3x (17-char doubles → "53.33")
+                values = dict(
+                    zip(
+                        chip_ids[mask].tolist(),
+                        np.round(vals[mask], 2).tolist(),
+                    )
+                )
+                if not values:
+                    continue
+                out.append(
+                    {
+                        "panel": spec.column,
+                        "slice": str(slice_id),
+                        "figure": create_topology_heatmap(
+                            topo,
+                            values,
+                            title=f"{slice_id} — {spec.title}",
+                            max_val=panel_max(spec, accels),
+                            unit=spec.unit,
+                            custom_grid=custom_grid,
+                        ),
+                    }
+                )
+        return out
+
+    def _breakdown(self, sel_df: pd.DataFrame, panels, block=None) -> dict:
+        """Per-slice and per-host averages over the selection — the fleet
+        drill-down the reference's flat per-GPU list couldn't offer.  A
+        dimension appears only when it actually distinguishes rows (>1
+        distinct value).  Averages use the same zero-exclusion policy as
+        the headline row."""
+        cols = [p.column for p in panels if p.column in sel_df.columns]
+        if not cols:
+            return {}
+        # factorize each dimension ONCE (also the degenerate-case gate):
+        # the common single-slice single-host frame skips the matrix prep
+        # entirely.  Rows whose group label is missing (factorize code -1,
+        # e.g. a joined source without the host label) are excluded from
+        # that dimension rather than corrupting a group.
+        dims = []
+        for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
+            if col not in sel_df.columns:
+                continue
+            codes, uniques = pd.factorize(sel_df[col], sort=True)
+            if len(uniques) > 1:
+                dims.append((dim, codes, uniques))
+        if not dims:
+            return {}
+        # pure-numpy group means (factorize + add.at), not groups×columns
+        # column_average calls or pandas groupby machinery — at 256 chips
+        # the host dimension alone has 64+ groups and this runs per frame.
+        # The numeric matrix comes from the shared per-frame block when the
+        # caller already extracted it (copy: zero-exclusion mutates cells).
+        blk_arr, blk_cols = (
+            block if block is not None else (None, [])
+        )
+        if blk_arr is not None and all(c in blk_cols for c in cols):
+            pos = [blk_cols.index(c) for c in cols]
+            arr = blk_arr[:, pos].copy()
+        else:
+            sub = sel_df[cols]
+            if all(dt.kind in "fi" for dt in sub.dtypes):
+                arr = sub.to_numpy(dtype=np.float64, copy=True)
+            else:  # legacy mixed-dtype frames
+                arr = sub.apply(pd.to_numeric, errors="coerce").to_numpy(
+                    dtype=np.float64, copy=True
+                )
+        for i, column in enumerate(cols):
+            # zero-exclusion becomes NaN-exclusion (app.py:341-345 policy)
+            if column in schema.ZERO_EXCLUDED_METRICS:
+                arr[arr[:, i] == 0.0, i] = np.nan
+        valid = ~np.isnan(arr)
+        filled = np.where(valid, arr, 0.0)
+
+        out: dict = {}
+        for dim, codes, uniques in dims:
+            labeled = codes >= 0  # drop rows with a missing group label
+            lcodes = codes[labeled]
+            sums = np.zeros((len(uniques), len(cols)))
+            counts = np.zeros((len(uniques), len(cols)))
+            np.add.at(sums, lcodes, filled[labeled])
+            np.add.at(counts, lcodes, valid[labeled])
+            with np.errstate(invalid="ignore"):
+                means = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+            sizes = np.bincount(lcodes, minlength=len(uniques))
+            rows: dict = {}
+            for g, key in enumerate(uniques):
+                vals = {
+                    c: round(float(means[g, i]), 2)
+                    for i, c in enumerate(cols)
+                    if means[g, i] == means[g, i]  # drop no-eligible-value cols
+                }
+                if vals:
+                    vals["chips"] = int(sizes[g])
+                    rows[str(key)] = vals
+            if rows:
+                out[dim] = rows
+        return out
+
+    def _trends(self, sel_df: pd.DataFrame, panels, max_points: int = 120) -> list:
+        """Sparkline per panel over the rolling average history, downsampled
+        to ≤max_points (strided from the end so the latest point always
+        shows)."""
+        if len(self.history) < 2:
+            return []
+        accels = accel_types_for(sel_df)
+        pts, fmt = _downsample(list(self.history), max_points)
+        out = []
+        for spec in panels:
+            series = [
+                (ts, avgs[spec.column])
+                for ts, avgs in pts
+                if avgs.get(spec.column) is not None
+            ]
+            if len(series) < 2:
+                continue
+            times = [fmt[ts] for ts, _ in series]
+            out.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_sparkline(
+                        times,
+                        [v for _, v in series],
+                        title=f"{spec.title} — trend",
+                        max_val=panel_max(spec, accels),
+                        unit=spec.unit,
+                    ),
+                }
+            )
+        return out
+
+    def chip_detail(
+        self,
+        key: str,
+        use_gauge: bool = True,
+        max_points: int = 200,
+    ) -> "dict | None":
+        with self._publish_lock:
+            return self._chip_detail_locked(key, use_gauge, max_points)
+
+    def _chip_detail_locked(
+        self,
+        key: str,
+        use_gauge: bool = True,
+        max_points: int = 200,
+    ) -> "dict | None":
+        """Single-chip drill-down: identity, current panel gauges, per-chip
+        trend sparklines, its firing alerts, and its ICI neighbors — the
+        per-device insight of the reference's gauge rows (app.py:411-476)
+        restored at 256-chip scale, one chip at a time.  None when the chip
+        is not in the last table (404 upstream)."""
+        df = self.last_df
+        if df is None or key not in df.index:
+            return None
+        row = df.loc[key]
+        accel = row.get(schema.ACCEL_TYPE, "") or ""
+        panels = self._active_panels(df)
+        figures = []
+        for spec in panels:
+            value = row.get(spec.column)
+            if value is None or pd.isna(value):
+                continue
+            figures.append(
+                {
+                    "panel": spec.column,
+                    "figure": create_visualization(
+                        float(value),
+                        spec,
+                        use_gauge=use_gauge,
+                        height=self.cfg.device_panel_height,
+                        accel_types=[accel] if accel else None,
+                    ),
+                }
+            )
+        # per-chip sparklines from the chip ring
+        trends = []
+        hist_row = self._chip_hist_rowmap.get(key)
+        if hist_row is not None and len(self.chip_history) >= 2:
+            pts, fmt = _downsample(list(self.chip_history), max_points)
+            col_pos = {c: i for i, c in enumerate(self._chip_hist_cols)}
+            for spec in panels:
+                ci = col_pos.get(spec.column)
+                if ci is None:
+                    continue
+                series = [
+                    (ts, float(m[hist_row, ci]))
+                    for ts, m in pts
+                    if m[hist_row, ci] == m[hist_row, ci]  # skip NaN
+                ]
+                if len(series) < 2:
+                    continue
+                trends.append(
+                    {
+                        "panel": spec.column,
+                        "figure": create_sparkline(
+                            [fmt[ts] for ts, _ in series],
+                            [v for _, v in series],
+                            title=f"{spec.title} — chip trend",
+                            max_val=panel_max(
+                                spec, [accel] if accel else None
+                            ),
+                            unit=spec.unit,
+                        ),
+                    }
+                )
+        # torus neighbors = the chips it shares ICI links with
+        try:
+            neighbors = torus_neighbor_keys(df, key, self.cfg.generation)
+        except Exception:  # noqa: BLE001 — neighbors are best-effort context
+            neighbors = []
+        # direction-resolved link table (sources with per-link series):
+        # each physical cable's measured GB/s + the chip on its far end,
+        # flagged when straggler detection names that link
+        try:
+            links = chip_links(df, key, self.cfg.generation)
+        except Exception:  # noqa: BLE001 — link detail is best-effort too
+            links = []
+        if links:
+            flagged = {
+                s["link"]
+                for s in self.last_stragglers
+                if s.get("chip") == key and "link" in s
+            }
+            for entry in links:
+                entry["straggler"] = entry["dir"] in flagged
+        return {
+            "key": key,
+            "chip_id": int(row["chip_id"]),
+            "slice": str(row["slice_id"]),
+            "host": str(row.get("host", "")),
+            "model": _model_name(accel),
+            "accelerator_type": accel,
+            "figures": figures,
+            "trends": trends,
+            "alerts": [a for a in self.last_alerts if a.get("chip") == key],
+            "stragglers": [
+                s for s in self.last_stragglers if s.get("chip") == key
+            ],
+            "neighbors": neighbors,
+            "links": links,
+            "last_updated": self.last_updated,
+        }
+
+    def chip_series(self, key: str) -> "list[tuple[float, dict]] | None":
+        """One chip's raw history from the per-chip ring as
+        [(ts, {column: value-or-None}), ...] — the ring's internal layout
+        (row alignment, float32 matrices, reset-on-population-change) stays
+        encapsulated here; /api/history?chip= serves this verbatim.
+        Returns None for a chip the ring has never seen."""
+        with self._publish_lock:
+            return self._chip_series_locked(key)
+
+    def _chip_series_locked(self, key: str):
+        row = self._chip_hist_rowmap.get(key)
+        if row is None:
+            return None
+        cols = list(self._chip_hist_cols)
+        out = []
+        for ts, m in self.chip_history:
+            vals = m[row].tolist()
+            out.append(
+                (ts, {c: (v if v == v else None) for c, v in zip(cols, vals)})
+            )
+        return out
+
+    def topology_model(self) -> "dict | None":
+        """The fleet's torus model — per slice: generation, dims, and per
+        chip: key, torus coordinates, and ICI neighbor ids.  What external
+        tooling (wiring diagrams, placement planners) needs and the
+        heatmap only carries implicitly.  None before the first frame."""
+        with self._publish_lock:
+            df = self.last_df
+            if df is None:
+                return None
+            slices = []
+            for slice_id, same in df.groupby("slice_id", sort=True):
+                ids = same["chip_id"].to_numpy()
+                sane = ids[(ids >= 0) & (ids < 16384)]
+                if sane.size == 0:
+                    continue
+                accels = accel_types_for(same)
+                generation = accels[0] if accels else self.cfg.generation
+                topo = topology_for(generation, int(sane.max()) + 1)
+                chips = [
+                    {
+                        "key": str(k),
+                        "chip_id": int(c),
+                        "coords": list(topo.coords(int(c))),
+                        "neighbors": topo.neighbors(int(c)),
+                        # direction-labeled far ends ("x+" → chip_id):
+                        # which cable reaches which neighbor
+                        "links": {
+                            schema.ICI_LINK_LABELS[d]: nid
+                            for d, nid in topo.directed_neighbors(int(c))
+                        },
+                    }
+                    for k, c in zip(same.index.tolist(), ids.tolist())
+                    if 0 <= c < topo.num_chips
+                ]
+                slices.append(
+                    {
+                        "slice": str(slice_id),
+                        "generation": topo.generation,
+                        "dims": list(topo.dims),
+                        "num_chips": topo.num_chips,
+                        "reporting_chips": len(chips),
+                        "chips": chips,
+                    }
+                )
+            return {"slices": slices}
+
+    # -- the frame -----------------------------------------------------------
+    def refresh_data(self) -> "pd.DataFrame | None":
+        """Scrape → normalize → alerts → trend history: the shared half of
+        a frame, run ONCE per refresh interval no matter how many viewer
+        sessions compose frames from it.  Returns the wide table, or None
+        when the source failed (``last_error`` carries the banner text —
+        the reference's error path, app.py:225-227).
+
+        The timer frame opened here is completed by the first
+        :meth:`compose_frame` that renders from this data, so the
+        north-star scrape→render number still measures one full cycle.
+        """
+        # stamped at SCRAPE time: composed frames must report when the data
+        # was pulled, not when a session re-rendered it (a selection toggle
+        # near the end of a refresh interval must not present interval-old
+        # metrics as current)
+        stamp = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        # The fetch runs OUTSIDE the publish lock (it can block for the
+        # watchdog's whole lifetime) and ALL timer mutation happens inside
+        # it — a stale compose served mid-stall must never see a
+        # half-open timer frame (it would close a render-only frame and
+        # skew the north-star percentiles).  Scrape time is measured
+        # manually and recorded once the lock is held.
+        t0 = time.perf_counter()
+        try:
+            samples = self.source.fetch()
+        except Exception as e:  # noqa: BLE001 — error banner path catches all
+            scrape_s = time.perf_counter() - t0
+            with self._publish_lock:
+                self.timer.start_frame()
+                self.timer.current["scrape"] = scrape_s
+                self.last_updated = stamp
+                return self._publish_error(e)
+        scrape_s = time.perf_counter() - t0
+        # everything below mutates published state; the lock keeps a fetch
+        # the watchdog parked (now completing on its own thread) from
+        # swapping tables mid-compose
+        with self._publish_lock:
+            self.timer.start_frame()
+            self._frame_open = True
+            self.timer.current["scrape"] = scrape_s
+            self.last_updated = stamp
+            try:
+                with self.timer.stage("normalize"):
+                    df = to_wide(samples)
+            except Exception as e:  # noqa: BLE001 — same banner path
+                return self._publish_error(e)
+            return self._publish_data(df)
+
+    def _publish_error(self, e: Exception) -> None:
+        """Error-cycle publication (reference banner path, app.py:225-227).
+        Caller holds _publish_lock."""
+        err = f"Error fetching TPU metrics: {e}"
+        if err != self.last_error:  # log streaks once, not per cycle
+            log.warning("%s", err)
+        self.last_error = err
+        self._frame_open = False
+        self.timer.end_frame()
+        return None
+
+    def _publish_data(self, df: "pd.DataFrame") -> "pd.DataFrame":
+        """Success publication: table, identity caches, alerts, history.
+        Caller holds _publish_lock."""
+        if self.last_error is not None:
+            log.info("metrics source recovered")
+        self.last_error = None
+        self.last_df = df
+        # Identity columns extracted ONCE per refresh and shared by every
+        # session's compose (arrow-backed string columns iterate per value
+        # on .tolist()/.to_numpy() — at 256 chips doing this per compose
+        # profiled at ~2 ms, and the chip-grid model is identical across
+        # sessions except for the per-session "selected" flag).
+        keys = df.index.tolist()
+        chip_id_list = df["chip_id"].tolist()
+        slice_list = df["slice_id"].tolist()
+        host_list = df["host"].tolist()
+        accel_list = (
+            df[schema.ACCEL_TYPE].fillna("").tolist()
+            if schema.ACCEL_TYPE in df
+            else [""] * len(df)
+        )
+        self._ident_chips = np.asarray(chip_id_list, dtype=np.int64)
+        self._ident_slices = np.asarray(slice_list, dtype=object)
+        self._ident_keys = np.asarray(keys, dtype=object)
+        self._ident_accels = accel_list
+        self._chips_base = [
+            {
+                "key": k,
+                "chip_id": int(c),
+                "slice": s,
+                "host": h,
+                "model": _model_name(a),
+            }
+            for k, c, s, h, a in zip(
+                keys, chip_id_list, slice_list, host_list, accel_list
+            )
+        ]
+        self.available = keys
+        if self.alert_engine is not None:
+            with self.timer.stage("alerts"):
+                self.last_alerts = self.silences.annotate(
+                    self.alert_engine.evaluate(df), time.time()
+                )
+            self._notify_alert_transitions()
+        # Fleet-wide trend history, one point per refresh interval (burst
+        # renders from selection POSTs must not pollute the cadence).
+        # Averages cover ALL chips in scope — per-browser selections are
+        # session-local now and must not steer the shared sparklines; this
+        # also matches the backfill scope (_backfill_history).
+        arr, cols = self._df_block = dense_block(df)
+        if self.straggler_detector is not None:
+            with self.timer.stage("analyze"):
+                self.last_stragglers = self.straggler_detector.evaluate(
+                    df, block=self._df_block
+                )
+        now = time.time()
+        if (
+            not self.history
+            or now - self.history[-1][0] >= self.cfg.refresh_interval
+        ):
+            if arr is not None:
+                col_pos = {c: i for i, c in enumerate(cols)}
+                avgs = {
+                    p.column: block_average(arr, col_pos[p.column], p.column)
+                    for p in self._active_panels(df)
+                    if p.column in col_pos
+                }
+            else:
+                avgs = {
+                    p.column: column_average(df, p.column)
+                    for p in self._active_panels(df)
+                }
+            self.history.append((now, avgs))
+            # per-chip ring (drill-down trends), same cadence
+            if arr is not None:
+                if (
+                    keys != self._chip_hist_keys
+                    or cols != self._chip_hist_cols
+                ):
+                    if keys == self._chip_hist_keys and self.chip_history:
+                        # same chips, different metric set (a live scrape
+                        # is richer than the Prometheus backfill): project
+                        # stored points onto the new columns instead of
+                        # throwing the history away
+                        old_pos = {
+                            c: i for i, c in enumerate(self._chip_hist_cols)
+                        }
+                        proj = [old_pos.get(c, -1) for c in cols]
+                        realigned = deque(maxlen=self.chip_history.maxlen)
+                        for ts_old, m in self.chip_history:
+                            nm = np.full(
+                                (m.shape[0], len(cols)),
+                                np.nan,
+                                dtype=np.float32,
+                            )
+                            for j, src in enumerate(proj):
+                                if src >= 0:
+                                    nm[:, j] = m[:, src]
+                            realigned.append((ts_old, nm))
+                        self.chip_history = realigned
+                    else:
+                        self.chip_history.clear()
+                    self._chip_hist_keys = keys
+                    self._chip_hist_cols = cols
+                    self._chip_hist_rowmap = {
+                        k: i for i, k in enumerate(keys)
+                    }
+                self.chip_history.append((now, arr.astype(np.float32)))
+        # periodic trend persistence, OFF the frame path (compression of
+        # a full 256-chip ring takes ~100 ms)
+        if (
+            self.cfg.history_path
+            and now - self._last_history_save >= self.cfg.history_save_interval
+        ):
+            self._last_history_save = now
+            threading.Thread(target=self.save_history, daemon=True).start()
+        return df
+
+    def compose_frame(self, state: "SelectionState | None" = None) -> dict:
+        """Selection-dependent frame assembly under the publish lock — a
+        fetch the watchdog parked must not swap tables mid-compose."""
+        with self._publish_lock:
+            return self._compose_frame_locked(state)
+
+    def _compose_frame_locked(
+        self, state: "SelectionState | None" = None
+    ) -> dict:
+        """Selection-dependent frame assembly for ONE viewer session over
+        the table :meth:`refresh_data` last pulled — the render half of the
+        reference's loop (app.py:320-486), cheap enough to run per session.
+        ``state`` defaults to the anonymous/global session."""
+        state = state if state is not None else self.state
+        frame: dict = {
+            "last_updated": self.last_updated,
+            "refresh_interval": self.cfg.refresh_interval,
+            "use_gauge": state.use_gauge,
+            "error": self.last_error,
+            "source_health": self.source_health(),
+        }
+        df = self.last_df
+        if df is None and self.refresh_stalled and frame["error"] is None:
+            # the very first fetch is stalled: nothing to serve yet, and
+            # the page must say why instead of rendering an empty shell
+            frame["error"] = self.refresh_stalled
+        if frame["error"] is not None or df is None:
+            frame["chips"] = []
+            frame["timings"] = self.timer.summary()
+            return frame
+        if self.alert_engine is not None:
+            frame["alerts"] = self.last_alerts
+        if self.straggler_detector is not None:
+            frame["stragglers"] = self.last_stragglers
+        # partial degradation (MultiSource): healthy slices render, failed
+        # endpoints surface as warnings instead of blanking the page
+        partial = getattr(self.source, "last_errors", None)
+        warnings = (
+            [f"endpoint {name}: {err}" for name, err in partial.items()]
+            if partial
+            else []
+        )
+        if self.refresh_stalled:
+            warnings.append(self.refresh_stalled)
+        if warnings:
+            frame["warnings"] = warnings
+        # only the FIRST compose after a refresh lands in the timer frame:
+        # further sessions' composes must not append render-only entries
+        # that would skew the scrape→render percentiles
+        render_timing = (
+            self.timer.stage("render")
+            if self._frame_open
+            else contextlib.nullcontext()
+        )
+        with render_timing:
+            available = self.available
+            selected = state.sync(available)
+            sel_df = filter_selected(df, selected)
+            panels = self._active_panels(df)
+            use_gauge = state.use_gauge
+
+            sel_set = set(selected)
+            frame["chips"] = [
+                dict(c, selected=c["key"] in sel_set) for c in self._chips_base
+            ]
+            # copy: the cached frame must not alias the live selection list
+            frame["selected"] = list(selected)
+            frame["panel_specs"] = [
+                {"column": p.column, "title": p.title, "unit": p.unit}
+                for p in panels
+            ]
+            # capability honesty: a reference-parity panel (util/HBM/temp/
+            # power, app.py:352-409) the source cannot feed is declared
+            # with a reason, never silently dropped
+            frame["unavailable_panels"] = [
+                {
+                    "column": s.column,
+                    "title": s.title,
+                    "reason": PANEL_GAP_REASONS.get(s.column, _GENERIC_GAP),
+                }
+                for s in schema.PANELS
+                if s.column not in df.columns
+            ]
+
+            if not sel_df.empty:
+                # ONE numeric-matrix extraction shared by averages, stats,
+                # breakdowns, and heatmap values — each pandas column-subset
+                # copy profiled at ~3 ms/frame at 256 chips.  The select-all
+                # fast path reuses the block refresh_data already extracted.
+                if (
+                    sel_df is df
+                    and self._df_block[0] is not None
+                    and self._df_block[0].shape[0] == len(df)
+                ):
+                    block = self._df_block
+                else:
+                    block = dense_block(sel_df)
+                arr, cols = block
+                col_pos = {c: i for i, c in enumerate(cols)}
+                if arr is not None:
+                    avgs = {
+                        spec.column: block_average(
+                            arr, col_pos[spec.column], spec.column
+                        )
+                        for spec in panels
+                        if spec.column in col_pos
+                    }
+                else:  # legacy mixed-dtype frames
+                    avgs = {
+                        spec.column: column_average(sel_df, spec.column)
+                        for spec in panels
+                    }
+                frame["average"] = self._average_row(
+                    sel_df, panels, use_gauge, avgs
+                )
+                frame["trends"] = self._trends(sel_df, panels)
+                if len(sel_df) <= self.cfg.per_chip_panel_limit:
+                    frame["device_rows"] = self._device_rows(sel_df, panels, use_gauge)
+                    frame["heatmaps"] = []
+                else:
+                    frame["device_rows"] = []
+                    frame["heatmaps"] = self._heatmaps(
+                        sel_df, df, panels, block=block
+                    )
+                stats = compute_stats(sel_df, block=block)
+                # display rounding parity (app.py:480-481)
+                frame["stats"] = {
+                    m: {k: round(v, 2) for k, v in s.items()}
+                    for m, s in stats.items()
+                }
+                frame["breakdown"] = self._breakdown(sel_df, panels, block=block)
+            else:
+                frame["average"] = None
+                frame["device_rows"] = []
+                frame["heatmaps"] = []
+                frame["trends"] = []
+                frame["stats"] = {}
+                frame["breakdown"] = {}
+
+        if self._frame_open:
+            self._frame_open = False
+            self.timer.end_frame()
+        frame["timings"] = self.timer.summary()
+        return frame
+
+    def render_frame(self, state: "SelectionState | None" = None) -> dict:
+        """One full cycle — refresh + compose — for a single session (the
+        reference's single-viewer loop; bench.py and the CLI use this)."""
+        self.refresh_data()
+        return self.compose_frame(state)

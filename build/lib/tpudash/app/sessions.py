@@ -1,0 +1,192 @@
+"""Per-browser-session UI state — the reference's ``st.session_state``.
+
+The reference scopes ``selected_gpus`` / ``use_gauge`` / ``last_selection``
+to one browser session (reference app.py:252-260): two people watching the
+same dashboard never fight over each other's checkboxes or gauge style.
+tpudash's aiohttp shell restores those semantics with a cookie-identified,
+bounded, TTL-evicted server-side map of :class:`SelectionState`.
+
+The pre-existing global state remains as the **anonymous default**: requests
+without a session cookie (curl, API consumers, k8s probes) see exactly the
+old single-state behavior, and only the default state participates in
+``TPUDASH_STATE_PATH`` persistence — per-browser sessions are ephemeral,
+like the reference's (a browser restart resets them, SURVEY.md §5
+checkpoint/resume note).
+
+Each entry also carries the per-session composed-frame and SSE-payload
+caches keyed by ``(data_version, state_version)``: the expensive scrape/
+normalize runs once per refresh interval for ALL sessions (the shared half
+lives in ``DashboardService.refresh_data``), while the cheap per-selection
+compose is cached per session so many tabs of one browser still cost one
+render.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from tpudash.app.state import SelectionState, _sort_key
+
+
+class SessionEntry:
+    """One viewer session: its selection state plus render caches.
+
+    A streaming session retains the current AND previous composed frames
+    (the frame-diff transport, tpudash.app.delta, patches one into the
+    other) plus the serialized full/delta payloads for the current step —
+    bounded per session, swept by the store's TTL/LRU eviction."""
+
+    __slots__ = (
+        "state",
+        "state_version",
+        "frame",
+        "frame_key",
+        "prev_frame",
+        "prev_frame_key",
+        "sse_full",
+        "sse_full_key",
+        "sse_delta",
+        "sse_delta_keys",
+        "last_seen",
+    )
+
+    def __init__(self, state: SelectionState):
+        self.state = state
+        #: bumped by the server on every mutation (select/style POSTs);
+        #: part of the compose-cache key
+        self.state_version = 0
+        self.frame: "dict | None" = None
+        self.frame_key: "tuple | None" = None
+        self.prev_frame: "dict | None" = None
+        self.prev_frame_key: "tuple | None" = None
+        self.sse_full: "bytes | None" = None
+        self.sse_full_key: "tuple | None" = None
+        self.sse_delta: "bytes | None" = None
+        self.sse_delta_keys: "tuple | None" = None  # (from_key, to_key)
+        self.last_seen = 0.0
+
+
+class SessionStore:
+    """Bounded, TTL-evicted map of session id → :class:`SessionEntry`.
+
+    ``entry(None)`` / ``entry("")`` returns the default (anonymous) entry,
+    which is never evicted.  Unknown ids lazily create fresh sessions (a
+    stale cookie after a server restart simply becomes a new session — the
+    reference's browser-refresh-resets-state behavior).  Access refreshes
+    recency; eviction removes TTL-expired entries first (they are exactly
+    the least-recently-used ones) and then trims to the size bound.
+    """
+
+    def __init__(
+        self,
+        default_state: SelectionState,
+        limit: int = 256,
+        ttl: float = 1800.0,
+        clock=time.monotonic,
+    ):
+        self.default = SessionEntry(default_state)
+        self.limit = max(1, int(limit))
+        self.ttl = float(ttl)
+        self._clock = clock
+        self._entries: "OrderedDict[str, SessionEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entry(self, sid: "str | None") -> SessionEntry:
+        # TTL-sweep on EVERY access, not just inserts: each retained entry
+        # pins a cached full-figure payload, so expired sessions must not
+        # linger until the next brand-new visitor happens to arrive
+        now = self._clock()
+        self._evict(now)
+        if not sid:
+            return self.default
+        e = self._entries.get(sid)
+        if e is None:
+            # size bound applies only when inserting — never evict a live
+            # LRU entry just because an existing session was accessed
+            while len(self._entries) >= self.limit:
+                self._entries.popitem(last=False)
+            e = self._entries[sid] = SessionEntry(SelectionState())
+        else:
+            self._entries.move_to_end(sid)
+        e.last_seen = now
+        return e
+
+    def invalidate_all(self) -> None:
+        """Bump every session's state version — global state (e.g. alert
+        silences) changed, so every cached compose is stale."""
+        self.default.state_version += 1
+        for e in self._entries.values():
+            e.state_version += 1
+
+    # -- persistence (rides the TPUDASH_STATE_PATH checkpoint) ---------------
+    def to_dicts(self) -> dict:
+        """sid → persisted UI state + idle age.  ``last_seen`` uses a
+        monotonic clock that does not survive restarts, so the AGE is
+        persisted and re-anchored on restore — TTL eviction continues
+        across the restart instead of resetting."""
+        now = self._clock()
+        return {
+            sid: dict(e.state.to_dict(), idle_s=round(now - e.last_seen, 1))
+            for sid, e in self._entries.items()
+        }
+
+    def restore(self, data: dict) -> int:
+        """Recreate sessions from a checkpoint section (bounded by the
+        store's own limit, already-TTL-expired entries skipped, corrupt
+        entries ignored).  Returns the number restored."""
+        if not isinstance(data, dict):
+            return 0
+        now = self._clock()
+        restored = 0
+
+        def _idle(entry: dict) -> float:
+            # a corrupt idle_s must skew ONE entry, not crash restore
+            # (and thereby server startup) — treat it as ancient
+            try:
+                return float(entry.get("idle_s", 0.0))
+            except (TypeError, ValueError):
+                return float("inf")
+
+        # most-recently-seen last, so LRU trimming keeps the freshest
+        items = sorted(
+            (
+                (sid, e)
+                for sid, e in data.items()
+                if isinstance(e, dict)
+            ),
+            key=lambda kv: -_idle(kv[1]),
+        )
+        for sid, item in items[-self.limit:]:
+            try:
+                idle = _idle(item)
+                if idle >= self.ttl:
+                    continue
+                state = SelectionState()
+                state.selected = sorted(
+                    (str(k) for k in item.get("selected", [])),
+                    key=_sort_key,
+                )
+                state.use_gauge = bool(item.get("use_gauge", True))
+                state.last_selection = [
+                    str(k) for k in item.get("last_selection", [])
+                ]
+                state._initialized = True
+                e = self._entries[str(sid)] = SessionEntry(state)
+                e.last_seen = now - idle
+                restored += 1
+            except (TypeError, ValueError):
+                continue
+        return restored
+
+    def _evict(self, now: float) -> None:
+        # LRU order == insertion-after-move_to_end order, so TTL-expired
+        # entries cluster at the front; stop at the first live one
+        while self._entries:
+            sid, e = next(iter(self._entries.items()))
+            if now - e.last_seen >= self.ttl:
+                del self._entries[sid]
+            else:
+                break

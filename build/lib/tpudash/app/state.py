@@ -1,0 +1,159 @@
+"""Selection / style state with the reference's session semantics.
+
+The reference keeps three session keys (SURVEY.md §3.4): ``selected_gpus``
+(pruned against available devices app.py:281, defaulting to the first device
+when empty app.py:284-285, re-sorted after changes app.py:313),
+``use_gauge`` (app.py:254-260) and ``last_selection`` (app.py:274-275, 310).
+SelectionState reproduces exactly those behaviors keyed by chip key strings,
+sorting numerically by (slice, chip) — not lexically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+
+log = logging.getLogger(__name__)
+
+
+def _sort_key(chip_key: str):
+    slice_id, _, chip = chip_key.rpartition("/")
+    try:
+        return (slice_id, int(chip))
+    except ValueError:
+        return (slice_id, -1)
+
+
+class SelectionState:
+    def __init__(self) -> None:
+        self.selected: list[str] = []
+        self.last_selection: list[str] = []
+        self.use_gauge: bool = True
+        self._initialized = False
+
+    def sync(self, available: list[str]) -> list[str]:
+        """Reconcile selections with the currently available chips:
+        prune stale keys (app.py:281), default to the first chip when the
+        selection is empty (app.py:284-285), keep sorted (app.py:313).
+
+        Sorting invariant: every mutator (set_selected/toggle/select_all)
+        and load() keeps ``selected`` sorted, and pruning preserves order —
+        so this per-compose hot path (it ran two full sorts per frame at
+        256 chips, ~3 ms) does no sorting at all; the first-chip default
+        uses an O(n) min."""
+        avail_set = set(available)
+        self.selected = [k for k in self.selected if k in avail_set]
+        if not self.selected and available and not self._initialized:
+            self.selected = [min(available, key=_sort_key)]
+        self._initialized = True
+        return self.selected
+
+    def set_selected(self, keys: list[str], available: list[str]) -> list[str]:
+        """Replace the selection (checkbox-grid change, app.py:292-313)."""
+        self.last_selection = list(self.selected)
+        avail = set(available)
+        self.selected = sorted(
+            {k for k in keys if k in avail}, key=_sort_key
+        )
+        return self.selected
+
+    def toggle(self, chip_key: str, available: list[str]) -> list[str]:
+        """Flip one checkbox (app.py:292-309)."""
+        self.last_selection = list(self.selected)
+        if chip_key in self.selected:
+            self.selected.remove(chip_key)
+        elif chip_key in set(available):
+            self.selected.append(chip_key)
+            self.selected.sort(key=_sort_key)
+        return self.selected
+
+    def select_all(self, available: list[str]) -> list[str]:
+        self.last_selection = list(self.selected)
+        self.selected = sorted(available, key=_sort_key)
+        return self.selected
+
+    def clear(self) -> list[str]:
+        self.last_selection = list(self.selected)
+        self.selected = []
+        return self.selected
+
+    # -- persistence (checkpoint/resume for UI state — the reference resets
+    # -- on any refresh, SURVEY.md §5) ---------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "selected": list(self.selected),
+            "use_gauge": self.use_gauge,
+            "last_selection": list(self.last_selection),
+        }
+
+    def load(self, path: str) -> bool:
+        """Restore state from a JSON checkpoint; missing/corrupt files are
+        ignored (fresh state).  Returns True when state was restored."""
+        doc = read_state_doc(path)
+        if doc is None:
+            return False
+        return self.load_dict(doc)
+
+    def load_dict(self, data: dict) -> bool:
+        """Restore from an already-parsed checkpoint document (the
+        composite TPUDASH_STATE_PATH file is read ONCE at startup and the
+        relevant sections handed to each consumer)."""
+        try:
+            # parse everything before assigning anything: a bad field must
+            # not leave the state half-restored
+            selected = [str(k) for k in data.get("selected", [])]
+            use_gauge = bool(data.get("use_gauge", True))
+            last_selection = [str(k) for k in data.get("last_selection", [])]
+        except TypeError as e:
+            log.warning("ignoring unreadable state checkpoint: %s", e)
+            return False
+        # restore sorted (sync() relies on the mutator-maintained invariant
+        # and never re-sorts; a hand-edited checkpoint must not break it)
+        self.selected = sorted(selected, key=_sort_key)
+        self.use_gauge = use_gauge
+        self.last_selection = last_selection
+        # a restored (possibly empty) selection is deliberate — don't
+        # re-apply the first-chip default over it
+        self._initialized = True
+        return True
+
+    def save(self, path: str) -> None:
+        """Atomically persist state (write-temp + rename).  NOTE: the
+        dashboard service persists a COMPOSITE document via
+        DashboardService.save_state — this writes only the selection
+        keys and is for standalone SelectionState use."""
+        atomic_write_json(path, self.to_dict())
+
+
+def read_state_doc(path: str) -> "dict | None":
+    """Parse a state checkpoint file; None for missing/corrupt (callers
+    start fresh).  The ONE reader for the composite document."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise TypeError(f"checkpoint is {type(data).__name__}, not object")
+        return data
+    except (OSError, json.JSONDecodeError, TypeError) as e:
+        log.warning("ignoring unreadable state checkpoint %s: %s", path, e)
+        return None
+
+
+def atomic_write_json(path: str, doc: dict) -> None:
+    """Write-temp + rename; failures log, never raise (persistence is
+    best-effort).  The ONE writer both SelectionState.save and the
+    service's composite save_state share."""
+    if not path:
+        return
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        log.warning("could not persist state to %s: %s", path, e)

@@ -1,0 +1,72 @@
+"""5-band color policy, shared by every visualization style.
+
+Parity with the reference's `GAUGE_COLORS` + `get_color_for_value`
+(app.py:41-68): values are bucketed into five bands at 20/40/60/80/100 % of
+the axis maximum; each band has a saturated bar color and a matching pastel
+"plate" color used for the background step/band rects.  Band edges are
+half-open on the left — value/max == 0.2 lands in the first band, matching
+the reference's `<=` chain (app.py:58-68).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ColorBand:
+    upper: float  # inclusive upper edge as a fraction of max_val
+    bar: str      # saturated color for the value bar / gauge needle bar
+    plate: str    # pastel background color for the band rect
+
+
+#: Green → yellow-green → yellow → orange → red, matching the reference's
+#: thresholds (app.py:41-54) with a TPU-neutral palette.
+COLOR_BANDS: tuple[ColorBand, ...] = (
+    ColorBand(0.20, "#2ecc71", "#eafaf1"),   # healthy green
+    ColorBand(0.40, "#a3d977", "#f3faea"),   # yellow-green
+    ColorBand(0.60, "#f1c40f", "#fdf6dd"),   # yellow
+    ColorBand(0.80, "#e67e22", "#fdeede"),   # orange
+    ColorBand(1.00, "#e74c3c", "#fdeaea"),   # red
+)
+
+
+def band_for_value(value: float, max_val: float) -> ColorBand:
+    """Pick the band for ``value`` on a [0, max_val] axis.
+
+    Degenerate/out-of-range inputs clamp: max_val <= 0 or value <= 0 → first
+    band; value > max_val → last band (the reference would fall through to
+    red via its final else, app.py:67-68).
+    """
+    if max_val <= 0 or value <= 0:
+        return COLOR_BANDS[0]
+    frac = value / max_val
+    for band in COLOR_BANDS:
+        if frac <= band.upper:
+            return band
+    return COLOR_BANDS[-1]
+
+
+def color_for_value(value: float, max_val: float = 100.0) -> str:
+    """Saturated bar color for a value (reference get_color_for_value,
+    app.py:56-68)."""
+    return band_for_value(value, max_val).bar
+
+
+def plate_color_for_value(value: float, max_val: float = 100.0) -> str:
+    """Pastel plate color for a value (the paired background tone the
+    reference keeps in GAUGE_COLORS, app.py:41-54)."""
+    return band_for_value(value, max_val).plate
+
+
+def band_steps(max_val: float) -> list[dict]:
+    """The five background bands for an axis [0, max_val], as
+    {range: [lo, hi], color} dicts — consumed by both the gauge's `steps`
+    and the bar chart's band rects (reference app.py:88-95, 131-144)."""
+    steps = []
+    lo = 0.0
+    for band in COLOR_BANDS:
+        hi = band.upper * max_val
+        steps.append({"range": [lo, hi], "color": band.plate})
+        lo = hi
+    return steps

@@ -1,0 +1,181 @@
+"""Real-world metric-name compatibility: GKE tpu-device-plugin & libtpu.
+
+The dashboard's canonical ``tpu_*`` schema (tpudash.schema) is what the
+in-repo exporter emits — but a real GKE cluster's scrape surface speaks
+different dialects.  This module is the single source of truth mapping those
+dialects onto the canonical schema, playing the role the reference plays by
+consuming the real ``amd_gpu_*`` series and ``gpu_id``/``card_model`` labels
+of an exporter it does not control (reference app.py:167-201).
+
+Supported dialects (series names AND label sets):
+
+1. **GKE tpu-device-plugin metrics server** (DaemonSet, ``:2112/metrics``;
+   surfaced in Cloud Monitoring as ``kubernetes.io/node/accelerator/*``):
+   series ``duty_cycle``, ``memory_used``, ``memory_total``,
+   ``tensorcore_utilization``, ``memory_bandwidth_utilization`` with labels
+   ``accelerator_id="<board-id>-<chip-index>"``, ``make="cloud-tpu"``,
+   ``model="tpu-v5-lite-podslice"``, ``tpu_topology="2x4"`` — plus the
+   managed-collection target labels (``instance``, ``pod``, ``namespace``,
+   ``node``, ...).  The Cloud-Monitoring-prefixed PromQL forms
+   (``kubernetes_io:node_accelerator_duty_cycle`` ...) are accepted too.
+
+2. **libtpu runtime metrics / tpu-monitoring-library** (the series behind
+   ``tpu-info``): dotted metric ids ``tpu.runtime.tensorcore.dutycycle.percent``,
+   ``tpu.runtime.hbm.memory.usage.bytes``, ``tpu.runtime.hbm.memory.total.bytes``
+   and their Prometheus-sanitized underscore forms, plus the short
+   monitoring-library names ``duty_cycle_pct``, ``tensorcore_util``,
+   ``hbm_capacity_usage``, ``hbm_capacity_total``.
+
+Alias resolution happens at parse time in BOTH the pure-Python parsers
+(sources/base.py, exporter/textfmt.py) and the native C++ kernel — the C++
+table is *generated from this module* (see ``native_alias_table``) so the two
+paths cannot drift; tests/test_compat.py holds differential coverage.
+
+Chip identity for dialect (1): GKE exposes no integer ``chip_id`` label —
+the chip is the ``<index>`` suffix of ``accelerator_id`` and the board/node
+id prefix scopes it.  When no explicit ``slice`` label exists, the prefix
+becomes the slice id, so multi-node scrapes (same chip indices on every
+node) stay collision-free and group by board.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpudash import schema
+
+#: Foreign (real-world) series name → canonical tpudash series.
+SERIES_ALIASES: dict[str, str] = {
+    # --- GKE tpu-device-plugin metrics server (:2112/metrics) ---------------
+    "duty_cycle": schema.TENSORCORE_UTIL,
+    "memory_used": schema.HBM_USED,
+    "memory_total": schema.HBM_TOTAL,
+    "tensorcore_utilization": schema.MXU_UTIL,
+    "memory_bandwidth_utilization": schema.MEMBW_UTIL,
+    # Cloud Monitoring prefixed PromQL forms of the same series
+    "kubernetes_io:node_accelerator_duty_cycle": schema.TENSORCORE_UTIL,
+    "kubernetes_io:node_accelerator_memory_used": schema.HBM_USED,
+    "kubernetes_io:node_accelerator_memory_total": schema.HBM_TOTAL,
+    "kubernetes_io:node_accelerator_tensorcore_utilization": schema.MXU_UTIL,
+    "kubernetes_io:node_accelerator_memory_bandwidth_utilization": schema.MEMBW_UTIL,
+    # --- libtpu runtime metrics (tpu-monitoring-library / tpu-info) ---------
+    "tpu.runtime.tensorcore.dutycycle.percent": schema.TENSORCORE_UTIL,
+    "tpu_runtime_tensorcore_dutycycle_percent": schema.TENSORCORE_UTIL,
+    "tpu.runtime.hbm.memory.usage.bytes": schema.HBM_USED,
+    "tpu_runtime_hbm_memory_usage_bytes": schema.HBM_USED,
+    "tpu.runtime.hbm.memory.total.bytes": schema.HBM_TOTAL,
+    "tpu_runtime_hbm_memory_total_bytes": schema.HBM_TOTAL,
+    # short monitoring-library metric ids
+    "duty_cycle_pct": schema.TENSORCORE_UTIL,
+    "tensorcore_util": schema.MXU_UTIL,
+    "hbm_capacity_usage": schema.HBM_USED,
+    "hbm_capacity_total": schema.HBM_TOTAL,
+}
+
+
+def canonical_series(name: str) -> str:
+    """Canonical schema name for a scraped series (identity for unknowns)."""
+    return SERIES_ALIASES.get(name, name)
+
+
+# strtoll-equivalent integer token: optional sign, digits, space/tab padding
+# (mirrors the native kernel's parse_full_int so both parsers accept/reject
+# identical accelerator_id suffixes — incl. rejecting "1_5", "0x3", "").
+_INT_RE = re.compile(r"^[ \t]*[+-]?[0-9]+[ \t]*$")
+_I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
+
+
+def _strict_int(s: str) -> "int | None":
+    if not _INT_RE.match(s):
+        return None
+    v = int(s)
+    if not (_I64_MIN <= v <= _I64_MAX):  # strtoll ERANGE → skip series
+        return None
+    return v
+
+
+def split_accelerator_id(value: str) -> "tuple[str, int] | None":
+    """``"<board-id>-<chip-index>"`` → (board prefix, chip index).
+
+    GKE accelerator ids put the per-node chip index after the final ``-``;
+    the prefix identifies the board/node.  A bare integer (no ``-``) maps to
+    ("", chip).  Returns None when no integer chip index can be extracted.
+    """
+    pos = value.rfind("-")
+    if pos < 0:
+        chip = _strict_int(value)
+        return ("", chip) if chip is not None else None
+    chip = _strict_int(value[pos + 1 :])
+    if chip is None:
+        return None
+    return (value[:pos], chip)
+
+
+def resolve_identity(labels, default_slice: str):
+    """Shared label rules: labels mapping → (slice, host, chip_id, accel),
+    or None when the series carries no parseable chip identity.
+
+    Fallback chains (canonical label first, reference-exporter analogues and
+    GKE device-plugin labels after):
+
+    - chip:  ``chip_id`` → ``gpu_id`` → ``accelerator_id`` suffix
+    - slice: ``slice`` → ``accelerator_id`` board prefix → default
+    - host:  ``host`` → ``node`` → ``instance``
+    - accel: ``accelerator`` → ``card_model`` → ``model``
+
+    The native kernel implements the identical rules in C++
+    (frame_kernel.cc emit paths); change both together.
+    """
+    chip_label = labels.get("chip_id")
+    if chip_label is None:
+        chip_label = labels.get("gpu_id")
+    slice_hint = None
+    if chip_label is not None:
+        try:
+            chip_id = int(chip_label)
+        except (TypeError, ValueError):
+            return None
+    else:
+        accel_id = labels.get("accelerator_id")
+        if not isinstance(accel_id, str):
+            # JSON integer label values keep their exact text form in the
+            # native parser; mirror that (floats/bools never round-trip
+            # identically, so both parsers skip them)
+            if isinstance(accel_id, bool) or not isinstance(accel_id, int):
+                return None
+            accel_id = str(accel_id)
+        parsed = split_accelerator_id(accel_id)
+        if parsed is None:
+            return None
+        prefix, chip_id = parsed
+        if prefix:
+            slice_hint = prefix
+    slice_id = labels.get("slice")
+    if slice_id is None:
+        slice_id = slice_hint if slice_hint is not None else default_slice
+    host = labels.get("host")
+    if host is None:
+        host = labels.get("node")
+        if host is None:
+            host = labels.get("instance", "")
+    accel = labels.get("accelerator")
+    if accel is None:
+        accel = labels.get("card_model")
+        if accel is None:
+            accel = labels.get("model", "")
+    return slice_id, host, chip_id, accel
+
+
+def native_alias_table() -> str:
+    """C++ source for the generated ``series_aliases.inc`` header the native
+    kernel compiles in — keeps the C++ alias table in lock-step with
+    SERIES_ALIASES (tpudash/native rebuilds when this content changes)."""
+    lines = [
+        "// Generated by tpudash.compat.native_alias_table() — do not edit.",
+        "static const struct { const char* from; const char* to; }",
+        "    kSeriesAliases[] = {",
+    ]
+    for src, dst in sorted(SERIES_ALIASES.items()):
+        lines.append(f'    {{"{src}", "{dst}"}},')
+    lines.append("};")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,240 @@
+"""Configuration.
+
+The reference configures exactly two env vars with localhost defaults and
+hardcodes every other knob (reference app.py:22-24: PROMETHEUS_METRICS_ENDPOINT,
+PROMETHEUS_METRICS_PODNAME, REFRESH_INTERVAL = 5).  tpudash keeps the same
+env-var names/defaults for drop-in parity and promotes the hardcoded knobs
+(refresh interval, panel heights, grid width, color thresholds are in
+colors.py) to first-class config, per SURVEY.md §7.2.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- parity with the reference (app.py:22-24) ---------------------------
+    #: Prometheus instant-query endpoint.
+    prometheus_endpoint: str = "http://localhost:9090/api/v1/query"
+    #: Substring used to locate the Prometheus pod via kube_pod_info
+    #: (reference app.py:157-164 discovery quirk; kept as a fallback).
+    prometheus_podname: str = "prometheus"
+    #: Dashboard refresh cadence, seconds (reference app.py:24).
+    refresh_interval: float = 5.0
+
+    # --- promoted knobs (hardcoded in the reference) ------------------------
+    #: Device-selection grid width (reference app.py:268 `num_columns = 4`).
+    selection_grid_columns: int = 4
+    #: Panel heights, px (reference app.py:323-324: avg 300, per-device 200).
+    avg_panel_height: int = 300
+    device_panel_height: int = 200
+    #: HTTP timeout for Prometheus queries, seconds.
+    http_timeout: float = 4.0
+    #: Extra fetch attempts after a failure, within one frame (exponential
+    #: backoff + jitter; see sources/retry.py).  0 = reference behavior
+    #: (one shot per cycle, app.py:225-227).
+    fetch_retries: int = 2
+    #: First retry backoff, seconds (attempt k waits ≤ backoff·2^k, capped).
+    retry_backoff: float = 0.25
+
+    # --- TPU-native additions ----------------------------------------------
+    #: Metrics source: "prometheus" | "fixture" | "probe" | "synthetic".
+    source: str = "prometheus"
+    #: Path to a fixture JSON (Prometheus response shape) for source=fixture.
+    fixture_path: str = ""
+    #: Synthetic-source chip count (scale testing; 256 = v5e pod slice).
+    synthetic_chips: int = 256
+    #: Synthetic-source slice count (>1 emits cross-slice DCN series —
+    #: BASELINE.json configs[4] multi-slice shape).
+    synthetic_slices: int = 1
+    #: Synthetic source: also emit direction-resolved per-link ICI series
+    #: (schema.ICI_LINK_SERIES) for the generation's torus rank.
+    synthetic_links: bool = False
+    #: Synthetic source: cold-link injection, comma-separated "chip:dir"
+    #: pairs (e.g. "17:xn,40:zp") — those links run at ~8% of nominal, the
+    #: failing-cable drill the straggler detector should name.  Implies
+    #: nothing unless synthetic_links is on.
+    synthetic_cold_links: str = ""
+    #: TPU generation hint for the synthetic source / topology fallback.
+    generation: str = "v5e"
+    #: Target discovery mode: "selector" (default — trust the Prometheus
+    #: scrape config / series labels; slice-wide scope, single query) or
+    #: "podname" (reference-parity fallback: scope to the node hosting the
+    #: Prometheus pod via kube_pod_info, app.py:157-164).
+    discovery: str = "selector"
+    #: Extra PromQL label matchers appended verbatim to the metrics query's
+    #: selector, e.g. 'cluster="tpu-a", slice=~"slice-[01]"' — the
+    #: slice-scoped narrowing the reference could not express.
+    series_selector: str = ""
+    #: Dashboard server bind.
+    host: str = "0.0.0.0"
+    port: int = 8050
+    #: Shared-secret auth for every data route ("" = open, the reference's
+    #: posture).  Clients send ``Authorization: Bearer <token>``; ONLY
+    #: /api/stream also accepts ``?token=`` (EventSource cannot set
+    #: headers).  The index page and /healthz stay open (static shell /
+    #: k8s probes); opening ``/?token=...`` hands the page JS the secret,
+    #: which it forwards on both transports automatically.
+    auth_token: str = ""
+    #: Node-exporter bind port (python -m tpudash.exporter).
+    exporter_port: int = 9100
+    #: /metrics URL for source="scrape" (direct exporter consumption,
+    #: no Prometheus server in between).
+    scrape_url: str = "http://localhost:9100/metrics"
+    #: Above this many selected chips the per-chip gauge rows collapse into
+    #: the topology heatmap (the reference's O(N) figure wall, SURVEY §3.2).
+    per_chip_panel_limit: int = 16
+    #: Path for persisted UI state (selection, style) so it survives server
+    #: restarts — the reference loses state on any refresh (SURVEY §5
+    #: checkpoint/resume: "none").  Empty string disables persistence.
+    state_path: str = ""
+    #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
+    #: defaults; "off" disables alerting.
+    alert_rules: str = ""
+    #: POST firing/resolved alert transitions to this URL as JSON ("" =
+    #: off).  Fire-and-forget with the frame's HTTP timeout; delivery
+    #: failures are logged, never fail the frame.
+    alert_webhook: str = ""
+    #: Append every successful scrape (any source) to this JSONL file for
+    #: later replay ("" disables).  Snapshots are exposition-text — the
+    #: exporter's own wire format.
+    record_path: str = ""
+    #: source="replay": play a recorded JSONL back through the normal
+    #: normalize→render path, looping.
+    replay_path: str = ""
+    #: Seed the trend history from a Prometheus range query covering this
+    #: many seconds at startup (0 disables; only sources with
+    #: ``fetch_history`` participate).  Sparklines show a real trend on the
+    #: first frame instead of growing from empty.
+    history_backfill: float = 0.0
+    #: Trend-ring length in points (fleet sparklines AND the per-chip
+    #: drill-down ring).  720 at the 5 s cadence ≈ one hour; the per-chip
+    #: ring costs points × chips × ~10 metrics × 4 bytes (≈7 MB at 256
+    #: chips, ≈118 MB at 4096) so large fleets may want it shorter.
+    history_points: int = 720
+    #: Persist the trend-history rings (fleet sparklines + per-chip
+    #: drill-down) to this file so restarts don't lose trends for sources
+    #: without a range query (probe/scrape/exporter-direct).  "" disables.
+    #: Saved periodically (history_save_interval) and at shutdown;
+    #: restored at startup unless a Prometheus backfill already seeded
+    #: the rings.
+    history_path: str = ""
+    history_save_interval: float = 300.0
+    #: source="workload": checkpoint/resume for the background train loop
+    #: (models/checkpoint.py) — save every N steps into this directory and
+    #: resume from its latest step on restart.  "" disables.
+    workload_checkpoint_dir: str = ""
+    workload_checkpoint_every: int = 64
+    #: Watchdog for one data refresh, seconds (0 disables).  A wedged
+    #: source — e.g. a hung accelerator runtime whose backend init blocks
+    #: forever without raising — must not freeze every dashboard route
+    #: behind the frame lock: past this deadline the server keeps serving
+    #: the last data with a "source stalled" warning and harvests the
+    #: in-flight fetch when (if) it completes.
+    refresh_watchdog: float = 30.0
+    #: Per-browser UI sessions (cookie ``tpudash_sid`` — the reference's
+    #: st.session_state scoping, app.py:252-260): bound on the server-side
+    #: session map and idle TTL in seconds before eviction.
+    session_limit: int = 256
+    session_ttl: float = 1800.0
+    #: Straggler-detection watch list (see tpudash.stragglers grammar).
+    #: "" = built-in defaults; "off" disables detection.
+    straggler_rules: str = ""
+    #: Modified-z threshold for flagging (Iglewicz–Hoaglin 3.5).
+    straggler_zscore: float = 3.5
+    #: Minimum reporting chips per metric before outliers are meaningful.
+    straggler_min_chips: int = 8
+    #: Breach-fraction ceiling — above it the fleet is bimodal (two jobs),
+    #: not straggling, and the metric is skipped for the cycle.
+    straggler_max_fraction: float = 0.1
+    #: source="multi": comma-separated ``[slice_name=]url`` endpoint specs
+    #: joined into one frame (multi-slice DCN view, BASELINE configs[4]).
+    #: URLs ending in /metrics are scraped directly; others are Prometheus
+    #: instant-query endpoints.
+    multi_endpoints: str = ""
+
+    extra: dict = field(default_factory=dict)
+
+
+_ENV_MAP = {
+    "prometheus_endpoint": "PROMETHEUS_METRICS_ENDPOINT",
+    "prometheus_podname": "PROMETHEUS_METRICS_PODNAME",
+    "refresh_interval": "TPUDASH_REFRESH_INTERVAL",
+    "selection_grid_columns": "TPUDASH_GRID_COLUMNS",
+    "avg_panel_height": "TPUDASH_AVG_PANEL_HEIGHT",
+    "device_panel_height": "TPUDASH_DEVICE_PANEL_HEIGHT",
+    "http_timeout": "TPUDASH_HTTP_TIMEOUT",
+    "fetch_retries": "TPUDASH_FETCH_RETRIES",
+    "retry_backoff": "TPUDASH_RETRY_BACKOFF",
+    "source": "TPUDASH_SOURCE",
+    "fixture_path": "TPUDASH_FIXTURE_PATH",
+    "synthetic_chips": "TPUDASH_SYNTHETIC_CHIPS",
+    "synthetic_slices": "TPUDASH_SYNTHETIC_SLICES",
+    "synthetic_links": "TPUDASH_SYNTHETIC_LINKS",
+    "synthetic_cold_links": "TPUDASH_SYNTHETIC_COLD_LINKS",
+    "generation": "TPUDASH_GENERATION",
+    "discovery": "TPUDASH_DISCOVERY",
+    "series_selector": "TPUDASH_SERIES_SELECTOR",
+    "host": "TPUDASH_HOST",
+    "port": "TPUDASH_PORT",
+    "auth_token": "TPUDASH_AUTH_TOKEN",
+    "exporter_port": "TPUDASH_EXPORTER_PORT",
+    "scrape_url": "TPUDASH_SCRAPE_URL",
+    "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
+    "state_path": "TPUDASH_STATE_PATH",
+    "refresh_watchdog": "TPUDASH_REFRESH_WATCHDOG",
+    "session_limit": "TPUDASH_SESSION_LIMIT",
+    "session_ttl": "TPUDASH_SESSION_TTL",
+    "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
+    "record_path": "TPUDASH_RECORD_PATH",
+    "replay_path": "TPUDASH_REPLAY_PATH",
+    "history_backfill": "TPUDASH_HISTORY_BACKFILL",
+    "history_points": "TPUDASH_HISTORY_POINTS",
+    "history_path": "TPUDASH_HISTORY_PATH",
+    "history_save_interval": "TPUDASH_HISTORY_SAVE_INTERVAL",
+    "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
+    "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
+    "alert_rules": "TPUDASH_ALERT_RULES",
+    "alert_webhook": "TPUDASH_ALERT_WEBHOOK",
+    "straggler_rules": "TPUDASH_STRAGGLER_RULES",
+    "straggler_zscore": "TPUDASH_STRAGGLER_ZSCORE",
+    "straggler_min_chips": "TPUDASH_STRAGGLER_MIN_CHIPS",
+    "straggler_max_fraction": "TPUDASH_STRAGGLER_MAX_FRACTION",
+}
+
+
+def configure_logging(level: str = "INFO") -> None:
+    """Shared logging setup for the CLI entry points."""
+    import logging
+
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def load_config(env: dict | None = None) -> Config:
+    """Build a Config from the environment (or a dict standing in for it)."""
+    src = os.environ if env is None else env
+    kwargs = {}
+    for f in fields(Config):
+        var = _ENV_MAP.get(f.name)
+        if var is None or var not in src:
+            continue
+        raw = src[var]
+        if f.type in ("int", int):
+            kwargs[f.name] = int(raw)
+        elif f.type in ("float", float):
+            kwargs[f.name] = float(raw)
+        elif f.type in ("bool", bool):
+            kwargs[f.name] = raw.strip().lower() in ("1", "true", "yes", "on")
+        else:
+            kwargs[f.name] = raw
+    return Config(**kwargs)

@@ -1,0 +1,114 @@
+"""``python -m tpudash.demo`` — the whole stack in one process.
+
+Zero-to-aha entry point: starts the node exporter (on-chip probe source
+when a chip is present, synthetic otherwise) on ``:9100`` and the
+dashboard scraping it on ``:8050``, in one asyncio loop.  What the
+reference needed a cluster, a Prometheus server, and an out-of-repo
+exporter to show, this shows with one command on a TPU VM — or on a
+laptop with ``TPUDASH_DEMO_SOURCE=synthetic``.
+
+    python -m tpudash.demo            # probe the local chip(s)
+    TPUDASH_DEMO_SOURCE=synthetic python -m tpudash.demo
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import os
+
+from aiohttp import web
+
+from tpudash.config import Config, configure_logging, load_config
+
+log = logging.getLogger(__name__)
+
+
+def demo_configs(cfg: Config | None = None) -> tuple[Config, Config]:
+    """(exporter_cfg, dashboard_cfg) for the single-process demo."""
+    cfg = cfg or load_config()
+    exporter_source = os.environ.get("TPUDASH_DEMO_SOURCE", "")
+    if not exporter_source:
+        try:
+            import jax
+
+            exporter_source = (
+                "probe" if jax.devices()[0].platform == "tpu" else "synthetic"
+            )
+        except Exception:  # noqa: BLE001 — no jax → synthetic demo
+            exporter_source = "synthetic"
+    exporter_cfg = dataclasses.replace(cfg, source=exporter_source)
+    # scrape address must match the exporter's bind: loopback works for
+    # the wildcard bind, a specific TPUDASH_HOST needs that address
+    scrape_host = "127.0.0.1" if cfg.host in ("0.0.0.0", "::") else cfg.host
+    dash_cfg = dataclasses.replace(
+        cfg,
+        source="scrape",
+        scrape_url=f"http://{scrape_host}:{cfg.exporter_port}/metrics",
+    )
+    return exporter_cfg, dash_cfg
+
+
+async def start_demo(cfg: Config | None = None) -> "tuple[web.AppRunner, web.AppRunner]":
+    """Start both servers; returns their runners (caller cleans up)."""
+    from tpudash.app.server import make_app as make_dash_app
+    from tpudash.exporter.server import make_app as make_exporter_app
+
+    exporter_cfg, dash_cfg = demo_configs(cfg)
+
+    exporter_runner = web.AppRunner(make_exporter_app(exporter_cfg))
+    await exporter_runner.setup()
+    try:
+        await web.TCPSite(
+            exporter_runner, exporter_cfg.host, exporter_cfg.exporter_port
+        ).start()
+    except Exception:
+        await exporter_runner.cleanup()  # setup() ran on_startup hooks
+        raise
+    log.info(
+        "exporter (%s source) on :%d/metrics",
+        exporter_cfg.source,
+        exporter_cfg.exporter_port,
+    )
+
+    # don't leak sockets when the dashboard can't start (e.g. its port is
+    # taken) — the caller never gets handles, so everything already live
+    # (the exporter, and the dash runner once set up) is cleaned here.
+    # cleanup failures are suppressed so the ORIGINAL error (which port,
+    # what failed) propagates, and one failed cleanup can't skip the next
+    try:
+        dash_runner = web.AppRunner(make_dash_app(dash_cfg))
+        await dash_runner.setup()
+    except Exception:
+        with contextlib.suppress(Exception):
+            await exporter_runner.cleanup()
+        raise
+    try:
+        await web.TCPSite(dash_runner, dash_cfg.host, dash_cfg.port).start()
+    except Exception:
+        with contextlib.suppress(Exception):
+            await dash_runner.cleanup()
+        with contextlib.suppress(Exception):
+            await exporter_runner.cleanup()
+        raise
+    log.info("dashboard on :%d (scraping the exporter)", dash_cfg.port)
+    return exporter_runner, dash_runner
+
+
+async def _main() -> None:  # pragma: no cover - blocking entry
+    runners = await start_demo()
+    try:
+        await asyncio.Event().wait()  # serve until interrupted
+    finally:
+        for r in runners:
+            await r.cleanup()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    from tpudash.parallel.distributed import maybe_initialize
+
+    configure_logging()  # first, so the rendezvous outcome is visible
+    maybe_initialize()  # before demo_configs queries jax.devices()
+    asyncio.run(_main())

@@ -1,0 +1,12 @@
+"""TPU node exporter.
+
+The reference *consumes* a ROCm node exporter that lives outside its repo
+(SURVEY.md §2: the amd_gpu_* series are produced elsewhere and scraped by
+Prometheus, reference app.py:167-176).  tpudash ships that missing half for
+TPU hosts: an HTTP ``/metrics`` endpoint in Prometheus text exposition
+format, fed by the on-chip probe source (tpudash.sources.probe), suitable
+as a scrape target for a cluster Prometheus — the same deployment shape as
+the GKE tpu-device-plugin metrics endpoint (BASELINE.json configs[1-2]).
+"""
+
+from tpudash.exporter.textfmt import encode_samples, parse_text_format  # noqa: F401

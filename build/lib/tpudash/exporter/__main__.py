@@ -1,0 +1,6 @@
+"""``python -m tpudash.exporter`` — run the TPU node exporter."""
+
+from tpudash.exporter.server import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,117 @@
+"""Exporter HTTP server — ``/metrics`` in Prometheus exposition format.
+
+Deployment shape matches the node exporter the reference scrapes
+(reference app.py:167-176 consumes amd_gpu_* from such an endpoint): run
+one exporter per TPU host, point a Prometheus scrape config (or a tpudash
+``scrape`` source directly) at it.
+
+    python -m tpudash.exporter         # serves :9100/metrics from probes
+
+The underlying source is shared, so concurrent scrapes serialize on one
+probe run; heavy probes are already interval-cached inside ProbeSource.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+
+from aiohttp import web
+
+from tpudash.config import Config, load_config
+from tpudash.exporter.textfmt import encode_samples
+from tpudash.sources import make_source
+from tpudash.sources.base import MetricsSource, SourceError
+
+log = logging.getLogger(__name__)
+
+
+class ExporterServer:
+    def __init__(self, source: MetricsSource):
+        self.source = source
+        self._lock = asyncio.Lock()
+        self.last_error: str | None = None
+
+    async def warm(self, app: web.Application) -> None:
+        """Startup warmup: run one fetch in the background so the FIRST
+        real scrape doesn't pay the on-chip probes' XLA compile cost
+        (tens of seconds cold — Prometheus' default scrape timeout is
+        10s, so an unwarmed first scrape always failed)."""
+
+        async def _warm() -> None:
+            loop = asyncio.get_running_loop()
+            try:
+                async with self._lock:
+                    await loop.run_in_executor(None, self.source.fetch)
+                log.info("probe warmup complete")
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning("probe warmup failed (first scrape pays): %s", e)
+
+        app["warmup_task"] = asyncio.create_task(_warm())
+
+    async def cool(self, app: web.Application) -> None:
+        """Shutdown cleanup: cancel a still-pending warmup (a wedged chip
+        can block backend init indefinitely) so Ctrl-C exits cleanly
+        instead of leaving a destroyed-but-pending task."""
+        task = app.get("warmup_task")
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            loop = asyncio.get_running_loop()
+            try:
+                samples = await loop.run_in_executor(None, self.source.fetch)
+            except SourceError as e:
+                self.last_error = str(e)
+                # 503 keeps Prometheus' `up` metric honest for this target
+                raise web.HTTPServiceUnavailable(text=f"probe failed: {e}")
+        self.last_error = None
+        return web.Response(
+            text=encode_samples(samples),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "source": self.source.name, "error": self.last_error}
+        )
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/healthz", self.healthz)
+        return app
+
+
+def make_app(cfg: Config | None = None) -> web.Application:
+    cfg = cfg or load_config()
+    # exporters default to the on-chip probe source — exporting what this
+    # host's chips are doing is the whole point
+    if cfg.source == "prometheus":
+        cfg = dataclasses.replace(cfg, source="probe")
+    server = ExporterServer(make_source(cfg))
+    app = server.build_app()
+    if cfg.source in ("probe", "workload"):
+        # only chip-touching sources need (or benefit from) compile warmup
+        app.on_startup.append(server.warm)
+        app.on_cleanup.append(server.cool)
+    return app
+
+
+def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
+    from tpudash.config import configure_logging
+    from tpudash.parallel.distributed import maybe_initialize
+
+    configure_logging()
+    # multi-host rendezvous must precede any device query; also covers
+    # the installed `tpudash-exporter` console script
+    maybe_initialize()
+    cfg = cfg or load_config()
+    web.run_app(make_app(cfg), host=cfg.host, port=cfg.exporter_port)

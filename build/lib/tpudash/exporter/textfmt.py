@@ -1,0 +1,145 @@
+"""Prometheus text exposition format: encode and parse.
+
+Encoding emits the standard ``# HELP`` / ``# TYPE`` headers and one
+``name{labels} value`` line per sample, with the TPU label model
+(chip_id/slice/host/accelerator — the labels parse_instant_query expects on
+the query side, tpudash.sources.base).  The parser accepts the same format
+back, so exporter and dashboard round-trip without a Prometheus server in
+between (the "scrape" source).
+"""
+
+from __future__ import annotations
+
+import math
+
+import logging
+
+from tpudash import compat, native
+from tpudash.schema import ChipKey, Sample
+
+#: HELP strings for known series (unknown series get a generic line).
+from tpudash.schema import SERIES_HELP as _HELP  # single source of truth
+
+log = logging.getLogger(__name__)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def encode_samples(samples: list[Sample]) -> str:
+    """Samples → exposition text.  Dispatches to the native kernel when
+    built (byte-identical output — differential parity in
+    tests/test_native.py), else the pure-Python encoder below."""
+    if native.is_available():
+        try:
+            return native.encode_samples(samples)
+        except Exception as e:  # noqa: BLE001 — encoding must never fail
+            log.warning("native encoder failed, using python: %s", e)
+    return encode_samples_py(samples)
+
+
+def encode_samples_py(samples: list[Sample]) -> str:
+    """Pure-Python encoder.  Series are grouped (HELP/TYPE emitted once
+    per metric name, in first-seen order); all series are gauges."""
+    by_metric: dict[str, list[Sample]] = {}
+    for s in samples:
+        by_metric.setdefault(s.metric, []).append(s)
+
+    lines: list[str] = []
+    for metric, group in by_metric.items():
+        lines.append(f"# HELP {metric} {_HELP.get(metric, 'tpudash series')}")
+        lines.append(f"# TYPE {metric} gauge")
+        for s in group:
+            labels = {
+                "chip_id": str(s.chip.chip_id),
+                "slice": s.chip.slice_id,
+                "host": s.chip.host,
+            }
+            if s.accelerator_type:
+                labels["accelerator"] = s.accelerator_type
+            label_str = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in labels.items()
+            )
+            lines.append(f"{metric}{{{label_str}}} {s.value:.10g}")
+    return "\n".join(lines) + "\n"
+
+
+class TextFormatError(ValueError):
+    pass
+
+
+def _parse_labels(body: str) -> dict:
+    """Parse the inside of {...}: k="v" pairs with escape handling."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        while i < n and body[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = body.find("=", i)
+        if eq < 0:
+            raise TextFormatError(f"malformed labels: {body!r}")
+        key = body[i:eq].strip()
+        if eq + 1 >= n or body[eq + 1] != '"':
+            raise TextFormatError(f"unquoted label value in {body!r}")
+        j = eq + 2
+        out: list[str] = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            j += 1
+        if j >= n:
+            raise TextFormatError(f"unterminated label value in {body!r}")
+        labels[key] = "".join(out)
+        i = j + 1
+    return labels
+
+
+def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]:
+    """Exposition text → Samples.  Lines without a parseable chip_id (or
+    gpu_id) label are skipped, mirroring parse_instant_query's tolerance."""
+    samples: list[Sample] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace < 0:
+            continue  # unlabeled series carry no chip identity — skip
+        close = line.rfind("}")
+        if close < brace:
+            raise TextFormatError(f"malformed series line: {line!r}")
+        name = line[:brace].strip()
+        labels = _parse_labels(line[brace + 1 : close])
+        rest = line[close + 1 :].split()
+        if not name or not rest:
+            continue
+        try:
+            value = float(rest[0])
+        except ValueError:
+            continue
+        if not math.isfinite(value):
+            continue
+        ident = compat.resolve_identity(labels, default_slice)
+        if ident is None:
+            continue
+        slice_id, host, chip_id, accel = ident
+        samples.append(
+            Sample(
+                metric=compat.canonical_series(name),
+                value=value,
+                chip=ChipKey(slice_id=slice_id, host=host, chip_id=chip_id),
+                accelerator_type=accel,
+                labels=labels,
+            )
+        )
+    return samples

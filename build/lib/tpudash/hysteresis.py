@@ -1,0 +1,53 @@
+"""Consecutive-breach hysteresis shared by the alert engine and the
+straggler detector.
+
+Both subsystems run the same per-(rule, chip) state machine on every
+frame: ok → pending (breaching, streak < for_cycles) → firing; any
+non-breaching frame resets to ok, and keys not seen this frame resolve
+implicitly (the chip left the table or recovered).  One implementation
+here so the semantics cannot silently diverge.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Track:
+    streak: int = 0
+    firing_since: float | None = None
+    last_value: float = 0.0
+
+
+@dataclass
+class TrackSet:
+    """Streak bookkeeping over (rule, chip)-style keys."""
+
+    _tracks: dict = field(default_factory=dict)
+
+    def hit(self, key, for_cycles: int, now: float) -> "tuple[Track, bool]":
+        """Record one breaching frame for ``key``; returns the track and
+        whether it has reached the firing state (stamping firing_since on
+        the transition)."""
+        track = self._tracks.get(key)
+        if track is None:
+            track = self._tracks[key] = Track()
+        track.streak += 1
+        firing = track.streak >= for_cycles
+        if firing and track.firing_since is None:
+            track.firing_since = now
+        return track, firing
+
+    def resolve_unseen(self, seen: set) -> None:
+        """Drop every key not breaching this frame — its streak restarts
+        from zero on the next breach."""
+        for key in list(self._tracks):
+            if key not in seen:
+                del self._tracks[key]
+
+    def items(self):
+        return self._tracks.items()
+
+    def __len__(self) -> int:
+        return len(self._tracks)

@@ -1,0 +1,214 @@
+"""``python -m tpudash.info`` — terminal metrics table (tpu-info style).
+
+The terminal counterpart of the web dashboard, for SSH sessions on TPU VMs
+(the role ``tpu-info`` / ``rocm-smi`` play next to the reference): one
+aligned table of per-chip metrics + the stats row, from any configured
+source.  ``--watch`` redraws every refresh interval.
+
+    TPUDASH_SOURCE=probe python -m tpudash.info
+    python -m tpudash.info --source synthetic --chips 16 --watch
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+
+from tpudash import schema
+from tpudash.config import load_config
+from tpudash.normalize import compute_stats, to_wide
+from tpudash.sources import make_source
+from tpudash.sources.base import SourceError
+
+#: column → (header, format) for display, in order.
+_COLUMNS: tuple = (
+    (schema.TENSORCORE_UTIL, "MXU%", "{:.1f}"),
+    (schema.HBM_USAGE_RATIO, "HBM%", "{:.1f}"),
+    (schema.HBM_USED_GIB, "HBM GiB", "{:.2f}"),
+    (schema.TEMPERATURE, "Temp°C", "{:.0f}"),
+    (schema.POWER, "Power W", "{:.1f}"),
+    (schema.ICI_TOTAL_GBPS, "ICI GB/s", "{:.1f}"),
+    (schema.DCN_TOTAL_GBPS, "DCN GB/s", "{:.1f}"),
+    (schema.HBM_BANDWIDTH, "HBM GB/s", "{:.0f}"),
+)
+
+
+def render_table(df, stats) -> str:
+    cols = [(c, h, f) for c, h, f in _COLUMNS if c in df.columns]
+    headers = ["chip", "model"] + [h for _, h, _ in cols]
+    rows: list[list[str]] = []
+    for key, row in df.iterrows():
+        cells = [str(key), str(row.get(schema.ACCEL_TYPE, "") or "?")]
+        for c, _, fmt in cols:
+            v = row.get(c)
+            cells.append("-" if v is None or v != v else fmt.format(v))
+        rows.append(cells)
+    for stat in ("mean", "p50", "p95", "max", "min"):
+        cells = [stat, ""]
+        for c, _, fmt in cols:
+            s = stats.get(c)
+            cells.append(fmt.format(s[stat]) if s else "-")
+        rows.append(cells)
+
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in rows)) for i in range(len(headers))
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    body = ["  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    # separator between per-chip rows and the stats block
+    lines += body[: len(df)] + ["  ".join("-" * w for w in widths)] + body[len(df):]
+    return "\n".join(lines)
+
+
+def render_chip(df, stats, key: str) -> str:
+    """Single-chip drill-down for the terminal — the CLI counterpart of
+    the web view's heatmap-click detail (app/service.chip_detail): chip
+    identity, each metric against the fleet mean/p95, ICI neighbors."""
+    if key not in df.index:
+        known = ", ".join(list(df.index[:6])) + (" …" if len(df) > 6 else "")
+        return f"error: unknown chip {key!r} (chips: {known})"
+    row = df.loc[key]
+    lines = [
+        f"chip   {key}",
+        f"model  {row.get(schema.ACCEL_TYPE) or '?'}",
+        f"host   {row.get('host', '')}",
+        f"slice  {row.get('slice_id', '')}",
+        "",
+        f"{'metric':<10}{'value':>10}{'fleet mean':>12}{'fleet p95':>11}",
+        "-" * 43,
+    ]
+    for c, header, fmt in _COLUMNS:
+        if c not in df.columns:
+            continue
+        v = row.get(c)
+        s = stats.get(c)
+        val = "-" if v is None or v != v else fmt.format(v)
+        mean = fmt.format(s["mean"]) if s else "-"
+        p95 = fmt.format(s["p95"]) if s else "-"
+        lines.append(f"{header:<10}{val:>10}{mean:>12}{p95:>11}")
+    try:
+        from tpudash.normalize import chip_links, torus_neighbor_keys
+
+        links = chip_links(df, key)
+        if links:
+            lines += ["", f"{'link':<6}{'GB/s':>8}  far end"]
+            for e in links:
+                gbps = "-" if e["gbps"] is None else f"{e['gbps']:.2f}"
+                lines.append(
+                    f"{e['dir']:<6}{gbps:>8}  {e['neighbor'] or '-'}"
+                )
+        else:
+            keys = torus_neighbor_keys(df, key)
+            if keys:
+                lines += ["", "ICI neighbors: " + "  ".join(keys)]
+    except Exception:  # noqa: BLE001 — neighbors are best-effort context
+        pass
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    from tpudash.parallel.distributed import maybe_initialize
+
+    maybe_initialize()  # multi-host rendezvous before any device query
+    ap = argparse.ArgumentParser(description="TPU metrics table")
+    ap.add_argument("--source", help="override TPUDASH_SOURCE")
+    ap.add_argument("--chips", type=int, help="synthetic chip count")
+    ap.add_argument("--watch", action="store_true", help="redraw continuously")
+    ap.add_argument(
+        "--chip",
+        metavar="SLICE/ID",
+        help="single-chip drill-down (e.g. slice-0/17) instead of the table",
+    )
+    args = ap.parse_args(argv)
+
+    cfg = load_config()
+    if args.source:
+        cfg = dataclasses.replace(cfg, source=args.source)
+    if args.chips:
+        cfg = dataclasses.replace(cfg, synthetic_chips=args.chips)
+    source = make_source(cfg)
+
+    from tpudash.alerts import AlertEngine
+    from tpudash.stragglers import StragglerDetector
+
+    try:
+        engine = AlertEngine.from_config(cfg)
+    except ValueError as e:
+        # a bad TPUDASH_ALERT_RULES in the shell must not hide the table
+        print(f"warning: alerting disabled ({e})", file=sys.stderr)
+        engine = None
+    try:
+        detector = StragglerDetector.from_config(cfg)
+    except ValueError as e:
+        print(f"warning: straggler detection disabled ({e})", file=sys.stderr)
+        detector = None
+
+    try:
+        while True:
+            alert_line = ""
+            straggler_line = ""
+            try:
+                df = to_wide(source.fetch())
+                stats = compute_stats(df)
+                if args.chip:
+                    out = render_chip(df, stats, args.chip)
+                else:
+                    out = render_table(df, stats)
+                if engine is not None:
+                    # pending included: a one-shot run evaluates once, so
+                    # @N>1 rules can never reach "firing" here — a breach
+                    # in progress must still be visible
+                    active = engine.evaluate(df)
+                    if args.chip:
+                        active = [a for a in active if a["chip"] == args.chip]
+                    if active:
+                        alert_line = "ALERTS: " + "  ".join(
+                            f"{a['chip']} {a['rule']} (={a['value']}, "
+                            f"{a['severity']}, {a['state']})"
+                            for a in active[:6]
+                        ) + (" …" if len(active) > 6 else "")
+                if detector is not None:
+                    # pending included, same one-shot rationale as alerts
+                    lagging = detector.evaluate(df, block=None)
+                    if args.chip:
+                        lagging = [
+                            s for s in lagging if s["chip"] == args.chip
+                        ]
+                    if lagging:
+                        straggler_line = "STRAGGLERS: " + "  ".join(
+                            f"{s['chip']}"
+                            # per-link breach names the cable itself
+                            + (f" link {s['link']}" if "link" in s else "")
+                            + f" {s['column']} {s['value']} "
+                            f"vs fleet {s['median']} (z={s['z']})"
+                            for s in lagging[:6]
+                        ) + (" …" if len(lagging) > 6 else "")
+            except SourceError as e:
+                out = f"error: {e}"
+            if args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(out)
+            if alert_line:
+                print("\n" + alert_line)
+            if straggler_line:
+                print(("" if alert_line else "\n") + straggler_line)
+            health = getattr(source, "health", None)
+            status = f"  health={health.status}" if health else ""
+            print(
+                f"\nsource={source.name}{status}  "
+                f"{time.strftime('%Y-%m-%d %H:%M:%S')}"
+            )
+            if not args.watch:
+                return 0
+            time.sleep(cfg.refresh_interval)
+    except KeyboardInterrupt:  # Ctrl-C during fetch or sleep exits cleanly
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+"""Demo workload models.
+
+A monitoring stack needs something to monitor: this package provides a
+small, honest TPU workload — a decoder-only transformer LM trained with a
+dp×tp-sharded train step over a jax Mesh — used to (a) generate real
+TensorCore/HBM/ICI activity for live-dashboard demos and probe calibration,
+and (b) back the driver's compile/dry-run entry points.  The reference has
+no model code at all (SURVEY.md §5 "long-context: not applicable"); this is
+the TPU-native analogue of the GPU burn-in jobs its users would monitor.
+"""
+
+from tpudash.models.workload import (  # noqa: F401
+    WorkloadConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_sharded_train_step,
+    make_train_state,
+    param_shardings,
+)

@@ -1,0 +1,284 @@
+"""Expert parallelism: switch-style top-1 MoE over an ``ep`` mesh axis.
+
+Completes the parallelism set (dp/tp in workload.py, sp in
+ring_attention.py, pp in pipeline.py): experts are sharded across the
+``ep`` axis and tokens travel to their expert and back with two
+``lax.all_to_all`` collectives — the all-to-all traffic pattern the
+dashboard's ICI panels are built to surface.  The reference has no model
+code at all (SURVEY.md §5), so like its siblings this is workload-side
+machinery the rebuild adds.
+
+TPU-first construction:
+- dispatch is the dense einsum formulation (tokens → one-hot dispatch
+  tensor → ``[experts, capacity, d_model]`` buffers): every shape is
+  static, routing is matmuls the MXU executes, and there is no gather /
+  scatter with data-dependent shapes that would defeat XLA;
+- the ``ep`` axis doubles as the token-group axis (each device routes its
+  own S tokens), so the exchange is one all_to_all out and one back, both
+  riding ICI on a real slice;
+- top-1 (switch) routing with a static capacity ``C = ceil(S/E · cf)``;
+  overflowed tokens are dropped from the expert path (standard switch
+  behavior) and the auxiliary load-balancing loss pushes the router
+  toward uniform expert load;
+- everything differentiates: the straight-through gate multiplies the
+  combine weights, and all_to_all's transpose is all_to_all.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudash.models.ring_attention import _SHARD_MAP_KW, shard_map
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 128
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 8
+    seq: int = 16
+    batch: int = 8
+    #: experts per token: 1 = switch routing, 2 = Mixtral-style top-2
+    #: (gates renormalized over the chosen experts).
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    lr: float = 3e-4
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Expert-stacked params: FFN weights carry a leading n_experts dim
+    (sharded over ep); embed/router/unembed are replicated."""
+    k_embed, k_router, k_up, k_down, k_out = jax.random.split(key, 5)
+    d, f, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            jnp.bfloat16
+        )
+
+    return {
+        "embed": norm(k_embed, (cfg.vocab, d), 0.02),
+        "router": (jax.random.normal(k_router, (d, E), jnp.float32) * d**-0.5),
+        "w_up": norm(k_up, (E, d, f), d**-0.5),
+        "w_down": norm(k_down, (E, f, d), f**-0.5),
+        "ln": jnp.ones((d,), jnp.float32),
+        "unembed": norm(k_out, (d, cfg.vocab), d**-0.5),
+    }
+
+
+def moe_param_specs() -> dict:
+    return {
+        "embed": P(),
+        "router": P(),
+        "w_up": P("ep"),
+        "w_down": P("ep"),
+        "ln": P(),
+        "unembed": P(),
+    }
+
+
+def _capacity(tokens_per_group: int, cfg: MoEConfig) -> int:
+    # K·S assignments spread over E experts (GShard convention): without
+    # the top_k factor, top-2 at cf=1.25 would drop ~37% of assignments
+    # even under perfectly balanced load
+    return max(
+        1,
+        math.ceil(
+            cfg.top_k * tokens_per_group / cfg.n_experts * cfg.capacity_factor
+        ),
+    )
+
+
+def _route(x: jax.Array, router: jax.Array, cfg: MoEConfig, capacity: int):
+    """Top-k routing for local tokens x (S, d) → (dispatch (S,E,C),
+    combine (S,E,C), aux-loss scalar).
+
+    k=1 is switch routing; k=2 is Mixtral-style with gates renormalized
+    over the chosen experts.  Capacity positions are assigned choice-rank
+    first (all primary assignments, then secondary), the standard
+    mesh-tensorflow ordering, so a full expert drops secondary traffic
+    before primary."""
+    E, K = cfg.n_experts, cfg.top_k
+    logits = jnp.einsum("sd,de->se", x.astype(jnp.float32), router)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_gates, top_idx = jax.lax.top_k(probs, K)  # (S, K)
+    if K > 1:  # Mixtral renormalizes over chosen experts; switch (K=1)
+        # keeps the raw top-1 probability as the gate
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((x.shape[0], E, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    usage = jnp.zeros((E,), jnp.float32)  # slots taken per expert so far
+    frac = jnp.zeros((E,), jnp.float32)
+    for j in range(K):  # static, tiny (K ≤ 2)
+        mask = jax.nn.one_hot(top_idx[:, j], E, dtype=jnp.float32)  # (S, E)
+        pos = jnp.cumsum(mask, axis=0) * mask - mask + usage[None, :] * mask
+        keep = mask * (pos < capacity)
+        d_j = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )
+        dispatch = dispatch + d_j
+        combine = combine + d_j * top_gates[:, j, None, None]
+        usage = usage + jnp.sum(keep, axis=0)
+        frac = frac + jnp.mean(mask, axis=0)
+    # load-balance aux: E · Σ_e (assigned fraction_e / K · mean prob_e)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac / K * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn_local(x: jax.Array, params: dict, cfg: MoEConfig, n_groups: int):
+    """Per-shard switch FFN (runs inside shard_map over ``ep``).
+
+    x: (S, d) local tokens; params["w_up"/"w_down"] hold this shard's
+    E/n_groups experts.  Returns ((S, d) output, aux loss).
+    """
+    S, d = x.shape
+    E, G = cfg.n_experts, n_groups
+    EL = E // G
+    C = _capacity(S, cfg)
+    dispatch, combine, aux = _route(x, params["router"], cfg, C)
+
+    # (S,E,C) × (S,d) → expert-major send buffer, dim0 = owning shard
+    sent = jnp.einsum(
+        "sec,sd->ecd", dispatch, x.astype(jnp.float32)
+    ).reshape(G, EL, C, d)
+    # exchange: recv[src, el] = source src's tokens for local expert el
+    recv = lax.all_to_all(sent, "ep", split_axis=0, concat_axis=0, tiled=False)
+    h = jnp.einsum(
+        "gecd,edf->gecf",
+        recv.astype(jnp.bfloat16),
+        params["w_up"],
+        preferred_element_type=jnp.bfloat16,
+    )
+    h = jax.nn.gelu(h)
+    # f32 operands for the down-projection: bf16×bf16→f32 dots hit an
+    # unimplemented CPU thunk for this batched layout (TPU is fine either
+    # way — XLA re-fuses), and f32 accumulation is what we want anyway
+    out = jnp.einsum(
+        "gecf,efd->gecd",
+        h.astype(jnp.float32),
+        params["w_down"].astype(jnp.float32),
+    )
+    # return trip: back[e_global, :, :] = this shard's tokens, all experts
+    back = lax.all_to_all(out, "ep", split_axis=0, concat_axis=0, tiled=False)
+    y = jnp.einsum("sec,ecd->sd", combine, back.reshape(E, C, d))
+    return y.astype(x.dtype), aux
+
+
+def _moe_forward_local(params: dict, tokens: jax.Array, cfg: MoEConfig, G: int):
+    """Embed → residual MoE block → unembed, on one ep shard's tokens."""
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(jnp.bfloat16).reshape(B * T, cfg.d_model)
+    x32 = x.astype(jnp.float32)
+    normed = (
+        x32
+        * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        * params["ln"]
+    ).astype(jnp.bfloat16)
+    y, aux = moe_ffn_local(normed, params, cfg, G)
+    h = x + y.astype(jnp.bfloat16)
+    logits = jnp.einsum(
+        "sd,dv->sv", h, params["unembed"], preferred_element_type=jnp.float32
+    )
+    return logits.reshape(B, T, cfg.vocab), aux
+
+
+def make_moe_loss(mesh: Mesh, cfg: MoEConfig):
+    """loss(params, tokens) with tokens sharded over ``ep`` (each shard is
+    one routing group) and experts sharded over ``ep``."""
+    G = mesh.shape["ep"]
+    if cfg.n_experts % G:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={G}")
+
+    def body(params, tokens):
+        logits, aux = _moe_forward_local(params, tokens[:, :-1], cfg, G)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll) + cfg.router_aux_weight * aux
+        return lax.pmean(loss, "ep")
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(moe_param_specs(), P("ep", None)),
+        out_specs=P(),
+        **_SHARD_MAP_KW,
+    )
+
+
+def make_moe_train_step(mesh: Mesh, cfg: MoEConfig):
+    """jit the expert-parallel train step; returns (step_fn, shard_inputs)
+    like the tp/ring/pipeline siblings."""
+    loss_fn = make_moe_loss(mesh, cfg)
+    p_shard = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        moe_param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    token_shard = NamedSharding(mesh, P("ep", None))
+    opt = optax.adamw(cfg.lr, weight_decay=0.01)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(p_shard, None, token_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+    def shard_inputs(params, opt_state, tokens):
+        params = jax.device_put(params, p_shard)
+        tokens = jax.device_put(tokens, token_shard)
+        return params, opt_state, tokens
+
+    return step, shard_inputs
+
+
+def make_moe_train_state(key: jax.Array, cfg: MoEConfig):
+    params = init_moe_params(key, cfg)
+    opt_state = optax.adamw(cfg.lr, weight_decay=0.01).init(params)
+    return params, opt_state
+
+
+# --- correctness oracle ------------------------------------------------------
+
+def dense_moe_reference(x: jax.Array, params: dict, cfg: MoEConfig) -> jax.Array:
+    """Per-token oracle: y[s] = Σ_j gate_j[s] · FFN_{expert_j(s)}(x[s]),
+    no capacity drops.  Matches moe_ffn_local exactly when capacity ≥ the
+    largest per-expert token count (tests use capacity_factor=n_experts)."""
+    logits = jnp.einsum("sd,de->se", x.astype(jnp.float32), params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_gates, top_idx = jax.lax.top_k(probs, cfg.top_k)
+    if cfg.top_k > 1:
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
+    y = jnp.zeros((x.shape[0], cfg.d_model), jnp.float32)
+    for j in range(cfg.top_k):
+        expert = top_idx[:, j]
+        w_up = params["w_up"][expert]  # (S, d, f)
+        w_down = params["w_down"][expert]
+        h = jnp.einsum(
+            "sd,sdf->sf", x.astype(jnp.bfloat16), w_up,
+            preferred_element_type=jnp.bfloat16,
+        )
+        h = jax.nn.gelu(h)
+        yj = jnp.einsum(
+            "sf,sfd->sd", h, w_down, preferred_element_type=jnp.float32
+        )
+        y = y + top_gates[:, j, None] * yj
+    return y.astype(x.dtype)

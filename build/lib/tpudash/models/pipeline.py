@@ -1,0 +1,344 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh axis.
+
+Like ring_attention, this is workload-side machinery the reference (a
+dashboard with no model code, SURVEY.md §5) never had: a demo training
+path whose stage-to-stage activation transfers ride ICI neighbor links,
+completing the dp/tp/sp/pp/ep parallelism set.
+
+TPU-first construction:
+- the layer stack (leading dim L) is sharded over ``pp`` via shard_map
+  in_specs, so stage s holds layers [s·L/P, (s+1)·L/P) — the same stacked
+  pytree the dp×tp and ring workloads use, no per-stage param surgery;
+- the schedule is a single ``lax.scan`` over M + P - 1 ticks (M
+  microbatches, P stages): each tick every stage runs its layer block on
+  its current microbatch and hands the activation to stage s+1 with
+  ``lax.ppermute`` — neighbor traffic only, no all-gathers;
+- the scan body is static (microbatch selection via ``jnp.where`` on
+  ``lax.axis_index``), so XLA compiles ONE tick regardless of M and P and
+  reverse-mode AD works through the whole schedule (the transpose of
+  ppermute is the reverse ppermute — backward pipeline flows stage P-1 → 0
+  automatically);
+- the pipeline bubble is the standard (P-1)/(M+P-1) fraction; raising the
+  microbatch count M amortizes it exactly as in GPipe.
+
+Numerically the pipeline computes the SAME function as the serial
+workload.forward — layers in stack order, identical kernels — which the
+tests pin (pipeline loss == serial loss to f32 tolerance).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudash.models import workload as w
+from tpudash.models.ring_attention import _SHARD_MAP_KW, shard_map
+
+
+def _stage_param_specs() -> dict:
+    """PartitionSpecs for shard_map: layer stack sharded over pp, the rest
+    replicated (embed/unembed run redundantly on every stage — cheap at
+    demo scale and keeps every rank's program identical)."""
+    blk = P("pp")  # shard dim 0 (the L layer-stack dim); rest replicated
+    return {
+        "embed": P(),
+        "blocks": {k: blk for k in ("ln1", "wqkv", "wo", "ln2", "w_up", "w_down")},
+        "ln_f": P(),
+        "unembed": P(),
+    }
+
+
+def _stage_shardings(mesh: Mesh) -> dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        _stage_param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_pipeline_loss(mesh: Mesh, cfg, num_microbatches: int):
+    """Return loss(params, tokens) running the demo transformer as a
+    P-stage pipeline over mesh axis ``pp`` with batch over ``dp``."""
+    P_axis = mesh.shape["pp"]
+    M = num_microbatches
+    if cfg.n_layers % P_axis:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={P_axis}")
+
+    def local_layers(x, blocks):
+        def block(h, layer):
+            h = h + w._attention(
+                w._rmsnorm(h, layer["ln1"]), layer["wqkv"], layer["wo"], cfg
+            )
+            h = h + w._mlp(
+                w._rmsnorm(h, layer["ln2"]), layer["w_up"], layer["w_down"]
+            )
+            return h, None
+
+        x, _ = lax.scan(jax.checkpoint(block), x, blocks)
+        return x
+
+    def pipeline_body(params, tokens):
+        # tokens: (B_local, T) — this dp shard's batch
+        stage = lax.axis_index("pp")
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        B, Tm = inputs.shape
+        if B % M:
+            raise ValueError(f"local batch {B} not divisible by microbatches {M}")
+        mb = B // M
+
+        x = params["embed"][inputs].astype(jnp.bfloat16)  # every stage embeds
+        x_mb = x.reshape(M, mb, Tm, cfg.d_model)
+        # M real microbatches + P-1 drain ticks of zeros
+        xs = jnp.concatenate(
+            [x_mb, jnp.zeros((P_axis - 1, mb, Tm, cfg.d_model), x.dtype)]
+        )
+
+        def tick(recv, xt):
+            # stage 0 pulls the next microbatch; later stages consume what
+            # stage s-1 sent last tick (= microbatch t - s, the GPipe skew)
+            inp = jnp.where(stage == 0, xt, recv)
+            out = local_layers(inp, params["blocks"])
+            send = lax.ppermute(
+                out, "pp", [(j, (j + 1) % P_axis) for j in range(P_axis)]
+            )
+            return send, out
+
+        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]), xs)
+        # on the LAST stage, tick t ≥ P-1 emits fully-processed microbatch
+        # t-(P-1); earlier stages' outs are intermediate and unused here
+        ys = outs[P_axis - 1 :]  # (M, mb, Tm, d)
+
+        h = w._rmsnorm(ys, params["ln_f"])
+        logits = jnp.einsum(
+            "mbtd,dv->mbtv", h, params["unembed"],
+            preferred_element_type=jnp.float32,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        t_mb = targets.reshape(M, mb, Tm)
+        ll = jnp.take_along_axis(logp, t_mb[..., None], axis=-1)[..., 0]
+        local_loss = -jnp.mean(ll)
+        # only the last stage computed real logits; everyone else masks to 0
+        # and the psum replicates the value across the pp ring
+        loss = lax.psum(
+            jnp.where(stage == P_axis - 1, local_loss, 0.0), "pp"
+        )
+        return lax.pmean(loss, "dp")  # mean over dp shards
+
+    fn = shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(_stage_param_specs(), P("dp", None)),
+        out_specs=P(),
+        **_SHARD_MAP_KW,
+    )
+    return fn
+
+
+# --- 3D parallelism: dp × pp × tp -------------------------------------------
+#
+# GPipe stages over ``pp`` with Megatron tensor parallelism inside each
+# stage over ``tp`` (column-parallel q/k/v/up projections, row-parallel
+# o/down projections, one psum per sublayer riding ICI), batch over ``dp``.
+# Inside shard_map the tp collectives are written explicitly — the same
+# math XLA's sharding propagation inserts for the jit-based dp×tp workload
+# (workload.make_sharded_train_step), here composed with the pipeline's
+# ppermute schedule in one program.
+#
+# The qkv projection is stored as separate wq/wk/wv (L, d, d) so the tp
+# shard boundary falls on whole heads (a tp-split of the fused (d, 3d)
+# wqkv would cut across the q|k|v concatenation); convert_params_3d maps
+# the serial workload tree onto this layout for oracle comparisons.
+
+
+def convert_params_3d(params: dict) -> dict:
+    """Serial workload tree → 3D layout (fused wqkv split into wq/wk/wv)."""
+    blocks = dict(params["blocks"])
+    wqkv = blocks.pop("wqkv")
+    d = wqkv.shape[1]
+    blocks["wq"] = wqkv[:, :, :d]
+    blocks["wk"] = wqkv[:, :, d : 2 * d]
+    blocks["wv"] = wqkv[:, :, 2 * d :]
+    return {**params, "blocks": blocks}
+
+
+def _stage_param_specs_3d() -> dict:
+    col = P("pp", None, "tp")  # column-parallel: output dim sharded
+    row = P("pp", "tp", None)  # row-parallel: input dim sharded
+    return {
+        "embed": P(),
+        "blocks": {
+            "ln1": P("pp"),
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "wo": row,
+            "ln2": P("pp"),
+            "w_up": col,
+            "w_down": row,
+        },
+        "ln_f": P(),
+        "unembed": P(),
+    }
+
+
+def make_pipeline3d_loss(mesh: Mesh, cfg, num_microbatches: int):
+    """loss(params3d, tokens) over mesh axes ("dp", "pp", "tp")."""
+    P_axis, T_axis = mesh.shape["pp"], mesh.shape["tp"]
+    M = num_microbatches
+    if cfg.n_layers % P_axis:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={P_axis}")
+    if cfg.n_heads % T_axis:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={T_axis}")
+
+    def block_3d(h, layer):
+        B, Tm, d = h.shape
+        H_local = cfg.n_heads // T_axis
+        hd = cfg.head_dim
+
+        x1 = w._rmsnorm(h, layer["ln1"])
+        # column-parallel qkv: this tp rank computes H/tp whole heads
+        q = jnp.einsum("btd,de->bte", x1, layer["wq"],
+                       preferred_element_type=jnp.bfloat16)
+        k = jnp.einsum("btd,de->bte", x1, layer["wk"],
+                       preferred_element_type=jnp.bfloat16)
+        v = jnp.einsum("btd,de->bte", x1, layer["wv"],
+                       preferred_element_type=jnp.bfloat16)
+        q = q.reshape(B, Tm, H_local, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, Tm, H_local, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, Tm, H_local, hd).transpose(0, 2, 1, 3)
+        o = w._sdpa(q, k, v)  # shared causal-attention core
+        o = o.transpose(0, 2, 1, 3).reshape(B, Tm, H_local * hd)
+        # row-parallel o-projection: partial sums → one psum over tp
+        o_part = jnp.einsum("bte,ed->btd", o, layer["wo"],
+                            preferred_element_type=jnp.float32)
+        h = h + lax.psum(o_part, "tp").astype(jnp.bfloat16)
+
+        x2 = w._rmsnorm(h, layer["ln2"])
+        up = jnp.einsum("btd,df->btf", x2, layer["w_up"],
+                        preferred_element_type=jnp.bfloat16)
+        act = jax.nn.gelu(up)
+        down_part = jnp.einsum("btf,fd->btd", act, layer["w_down"],
+                               preferred_element_type=jnp.float32)
+        h = h + lax.psum(down_part, "tp").astype(jnp.bfloat16)
+        return h
+
+    def local_layers(x, blocks):
+        def body(h, layer):
+            return block_3d(h, layer), None
+
+        x, _ = lax.scan(jax.checkpoint(body), x, blocks)
+        return x
+
+    def pipeline_body(params, tokens):
+        stage = lax.axis_index("pp")
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        B, Tm = inputs.shape
+        if B % M:
+            raise ValueError(f"local batch {B} not divisible by microbatches {M}")
+        mb = B // M
+
+        x = params["embed"][inputs].astype(jnp.bfloat16)
+        x_mb = x.reshape(M, mb, Tm, cfg.d_model)
+        xs = jnp.concatenate(
+            [x_mb, jnp.zeros((P_axis - 1, mb, Tm, cfg.d_model), x.dtype)]
+        )
+
+        def tick(recv, xt):
+            inp = jnp.where(stage == 0, xt, recv)
+            out = local_layers(inp, params["blocks"])
+            send = lax.ppermute(
+                out, "pp", [(j, (j + 1) % P_axis) for j in range(P_axis)]
+            )
+            return send, out
+
+        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]), xs)
+        ys = outs[P_axis - 1 :]
+
+        h = w._rmsnorm(ys, params["ln_f"])
+        logits = jnp.einsum(
+            "mbtd,dv->mbtv", h, params["unembed"],
+            preferred_element_type=jnp.float32,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        t_mb = targets.reshape(M, mb, Tm)
+        ll = jnp.take_along_axis(logp, t_mb[..., None], axis=-1)[..., 0]
+        local_loss = -jnp.mean(ll)
+        loss = lax.psum(
+            jnp.where(stage == P_axis - 1, local_loss, 0.0), "pp"
+        )
+        # activations are tp-replicated after each psum, so the loss is
+        # already identical across tp; average over dp shards only
+        return lax.pmean(loss, "dp")
+
+    return shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(_stage_param_specs_3d(), P("dp", None)),
+        out_specs=P(),
+        **_SHARD_MAP_KW,
+    )
+
+
+def make_pipeline3d_train_step(mesh: Mesh, cfg, num_microbatches: int = 2):
+    """jit the dp×pp×tp train step; returns (step_fn, shard_inputs)."""
+    loss_fn = make_pipeline3d_loss(mesh, cfg, num_microbatches)
+    p_shard = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        _stage_param_specs_3d(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    token_shard = NamedSharding(mesh, P("dp", None))
+    opt = w.make_optimizer(cfg)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(p_shard, None, token_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+    def shard_inputs(params, opt_state, tokens):
+        params = jax.device_put(params, p_shard)
+        tokens = jax.device_put(tokens, token_shard)
+        return params, opt_state, tokens
+
+    return step, shard_inputs
+
+
+def make_pipeline_train_step(mesh: Mesh, cfg, num_microbatches: int = 4):
+    """jit the full pipelined train step: layer stack pp-sharded, batch
+    dp-sharded, adamw update propagated through the same shardings.
+    Returns (step_fn, shard_inputs) like the tp and ring siblings."""
+    loss_fn = make_pipeline_loss(mesh, cfg, num_microbatches)
+    p_shard = _stage_shardings(mesh)
+    token_shard = NamedSharding(mesh, P("dp", None))
+    opt = w.make_optimizer(cfg)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(p_shard, None, token_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+    def shard_inputs(params, opt_state, tokens):
+        params = jax.device_put(params, p_shard)
+        tokens = jax.device_put(tokens, token_shard)
+        return params, opt_state, tokens
+
+    return step, shard_inputs

@@ -1,0 +1,241 @@
+"""Ring attention: sequence/context parallelism over an ``sp`` mesh axis.
+
+The reference has no model code at all (SURVEY.md §5 "long-context /
+sequence parallelism: not applicable" — it is a dashboard), so this module
+is part of tpudash's *workload* side: a long-context demo workload whose
+ICI traffic (the rotating K/V blocks) lights up the dashboard's
+``tpu_ici_*`` series, and a reusable TPU-native ring-attention primitive.
+
+TPU-first construction:
+- activations are sequence-sharded ``P(dp, sp)``; each device holds a
+  contiguous (B, T/sp) block of Q, K and V;
+- K/V blocks rotate around the ``sp`` ring with ``lax.ppermute`` — a
+  neighbor-to-neighbor transfer that maps onto ICI links (no all-gather of
+  the full sequence, so HBM stays O(T/sp) per chip);
+- softmax is streamed flash-style (running max / running sum / f32
+  accumulator), so no device ever materializes a T×T score matrix;
+- the ring is a ``lax.scan`` with a static trip count (the mesh axis
+  size), so the whole loop is one compiled body and reverse-mode
+  differentiation works (the transpose of ppermute is the reverse
+  ppermute);
+- causal masking is by *global* positions, reconstructed from
+  ``lax.axis_index`` and the rotation step — block (i) arriving at device
+  (d) came from device (d - i) mod sp.
+
+Simplification kept deliberately: causally dead blocks are still computed
+and masked rather than skipped (skipping needs a data-dependent ring
+schedule; at demo scale masking costs <2× and keeps the loop body static).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# check_vma/check_rep off: the ring body mixes ppermute-varying and locally
+# created arrays in one scan carry, which the replication/vma checker rejects
+try:
+    from jax import shard_map  # jax >= 0.8
+
+    _SHARD_MAP_KW: dict = {"check_vma": False}
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
+_NEG_BIG = -1e30  # finite "-inf": keeps exp() well-defined before the first
+                  # unmasked key (the own-block step) establishes a real max
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+) -> jax.Array:
+    """Per-shard body (runs inside shard_map).
+
+    q/k/v: (B, T_local, H, hd) — this device's sequence block.
+    Returns (B, T_local, H, hd) attention output for the local queries.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, hd = q.shape
+    scale = hd**-0.5
+
+    # head-major layout for the MXU-friendly (Tq, Tk) score matmuls
+    qh = q.transpose(0, 2, 1, 3).astype(jnp.float32) * scale  # B,H,Tq,hd
+
+    m0 = jnp.full((B, H, T), _NEG_BIG, jnp.float32)      # running max
+    l0 = jnp.zeros((B, H, T), jnp.float32)               # running denom
+    acc0 = jnp.zeros((B, H, T, hd), jnp.float32)         # running numerator
+
+    q_pos = my_idx * T + lax.broadcasted_iota(jnp.int32, (T, T), 0)
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, acc = carry
+        kh = k_blk.transpose(0, 2, 1, 3).astype(jnp.float32)
+        vh = v_blk.transpose(0, 2, 1, 3).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh)  # f32 scores
+        if causal:
+            src = (my_idx - i) % axis_size  # origin shard of this K/V block
+            k_pos = src * T + lax.broadcasted_iota(jnp.int32, (T, T), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        # rotate K/V one hop around the ring (device j's block → j+1)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    (_, _, _, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(axis_size)
+    )
+    out = acc / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Ring attention over sequence-sharded q/k/v of shape (B, T, H, hd).
+
+    B is sharded over ``dp_axis``, T over ``sp_axis``; heads/head_dim stay
+    local.  Callable under jit; XLA lowers the internal ppermutes onto ICI
+    neighbor links on a real slice.
+    """
+    spec = P(dp_axis, sp_axis, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=sp_axis, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **_SHARD_MAP_KW,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Unsharded softmax attention — the correctness oracle for tests."""
+    B, T, H, hd = q.shape
+    qh = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kh = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vh = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * (hd**-0.5)
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        s = jnp.where(cols <= rows, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# --- long-context demo workload: sequence-parallel transformer --------------
+
+def make_ring_train_step(mesh: Mesh, cfg):
+    """Training step for the demo transformer with ring attention over
+    ``sp`` and batch over ``dp`` (long-context shape: T split across the
+    mesh, so per-chip activation memory is O(T/sp)).
+
+    Params are replicated (this workload exercises the sequence axis; see
+    workload.make_sharded_train_step for the tp-sharded variant).  Returns
+    (step_fn, shard_inputs) like its tp sibling.
+    """
+    import optax
+
+    from tpudash.models import workload as w
+
+    token_shard = NamedSharding(mesh, P("dp", None))
+    replicated = NamedSharding(mesh, P())
+
+    def attention_ring(x, wqkv, wo):
+        B, T, d = x.shape
+        H, hd = cfg.n_heads, cfg.head_dim
+        qkv = jnp.einsum(
+            "btd,de->bte", x, wqkv, preferred_element_type=jnp.bfloat16
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, H, hd)
+        v = v.reshape(B, T, H, hd)
+        out = ring_attention(q, k, v, mesh).reshape(B, T, d)
+        return jnp.einsum(
+            "btd,de->bte", out, wo, preferred_element_type=jnp.bfloat16
+        )
+
+    def forward(params, tokens):
+        x = params["embed"][tokens].astype(jnp.bfloat16)
+        # keep activations sequence-sharded between layers; XLA keeps the
+        # per-token matmuls local and only the ring communicates
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None))
+        )
+
+        def block(h, layer):
+            h = h + attention_ring(
+                w._rmsnorm(h, layer["ln1"]), layer["wqkv"], layer["wo"]
+            )
+            h = h + w._mlp(
+                w._rmsnorm(h, layer["ln2"]), layer["w_up"], layer["w_down"]
+            )
+            return h, None
+
+        x, _ = lax.scan(jax.checkpoint(block), x, params["blocks"])
+        x = w._rmsnorm(x, params["ln_f"])
+        return jnp.einsum(
+            "btd,dv->btv", x, params["unembed"],
+            preferred_element_type=jnp.float32,
+        )
+
+    def loss_fn(params, tokens):
+        # run the forward on the FULL sequence (T must stay divisible by the
+        # sp axis for the P(dp, sp) activation sharding) and drop the final
+        # position from the logits instead of from the input
+        logits = forward(params, tokens)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    opt = w.make_optimizer(cfg)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(replicated, None, token_shard),
+        out_shardings=(replicated, None, None),
+        donate_argnums=(0, 1),
+    )
+
+    def shard_inputs(params, opt_state, tokens):
+        params = jax.device_put(params, replicated)
+        tokens = jax.device_put(tokens, token_shard)
+        return params, opt_state, tokens
+
+    return step, shard_inputs

@@ -1,0 +1,172 @@
+"""Background workload runner — real chip activity for the dashboard.
+
+Runs the demo transformer's train step in a daemon thread (sharded dp×tp
+over the local devices when there are several) and tracks achieved
+throughput: steps/s, achieved TFLOP/s (analytic FLOPs ÷ measured step
+time), and current loss.  The probe source measures what the chip *can*
+do; the workload runner shows what it *is* doing — together they mirror
+the busy-cluster picture the reference dashboard was built to watch.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import jax
+
+_log = logging.getLogger(__name__)
+
+from tpudash.models.workload import (
+    WorkloadConfig,
+    flops_per_step,
+    make_sharded_train_step,
+    make_train_state,
+    train_step,
+)
+
+
+class WorkloadRunner:
+    def __init__(
+        self,
+        cfg: WorkloadConfig | None = None,
+        steps_per_sync: int = 8,
+        checkpoint_dir: str = "",
+        checkpoint_every: int = 0,
+    ):
+        self.cfg = cfg or WorkloadConfig()
+        #: dispatch this many steps back-to-back before one host readback —
+        #: a per-step readback would serialize on the host↔device round
+        #: trip (~80 ms on tunneled platforms) and idle the chip
+        self.steps_per_sync = max(1, steps_per_sync)
+        #: checkpoint/resume (models/checkpoint.py): save every N steps into
+        #: checkpoint_dir and resume from its latest step on start.  Empty
+        #: dir or N=0 disables.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = max(0, checkpoint_every)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # telemetry (read under lock)
+        self.steps = 0
+        self.loss = float("nan")
+        self.step_time_ema = float("nan")  # seconds
+        self.error: str | None = None
+        self.resumed_from: int | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "WorkloadRunner":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudash-workload", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- training loop -------------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            cfg = self.cfg
+            key = jax.random.PRNGKey(0)
+            params, opt_state = make_train_state(key, cfg)
+
+            # checkpointing is best-effort: a missing orbax install, an
+            # unwritable dir, or a corrupt checkpoint must degrade to
+            # "train without checkpoints", never kill the workload
+            ckptr = None
+            if self.checkpoint_dir and self.checkpoint_every:
+                try:
+                    from tpudash.models.checkpoint import WorkloadCheckpointer
+
+                    ckptr = WorkloadCheckpointer(self.checkpoint_dir)
+                    restored = ckptr.restore_latest(params, opt_state)
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("checkpointing disabled: %s", e)
+                    ckptr, restored = None, None
+                if restored is not None:
+                    params, opt_state, step0 = restored
+                    with self._lock:
+                        self.steps = step0
+                        self.resumed_from = step0
+
+            n = jax.local_device_count()
+            if n > 1:
+                from tpudash.parallel.mesh import build_mesh, mesh_axes_for
+
+                mesh = build_mesh(mesh_axes_for(n), devices=jax.local_devices())
+                step, shard_inputs = make_sharded_train_step(mesh, cfg)
+            else:
+                step = jax.jit(lambda p, o, t: train_step(p, o, t, cfg))
+                shard_inputs = lambda p, o, t: (p, o, t)  # noqa: E731
+
+            data_key = jax.random.PRNGKey(1)
+            tokens = jax.random.randint(
+                data_key, (cfg.batch, cfg.seq), 0, cfg.vocab
+            )
+            params, opt_state, tokens = shard_inputs(params, opt_state, tokens)
+
+            k = self.steps_per_sync
+            last_saved = self.steps
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                loss = None
+                for _ in range(k):  # dispatch k steps, sync once
+                    data_key, sub = jax.random.split(data_key)
+                    tokens = jax.random.randint(
+                        sub, (cfg.batch, cfg.seq), 0, cfg.vocab
+                    )
+                    params, opt_state, loss = step(params, opt_state, tokens)
+                loss_val = float(loss)  # readback = true batch boundary
+                dt = (time.perf_counter() - t0) / k
+                with self._lock:
+                    self.steps += k
+                    self.loss = loss_val
+                    self.step_time_ema = (
+                        dt
+                        if self.step_time_ema != self.step_time_ema  # NaN
+                        else 0.7 * self.step_time_ema + 0.3 * dt
+                    )
+                if ckptr and self.steps - last_saved >= self.checkpoint_every:
+                    try:
+                        ckptr.save(self.steps, params, opt_state)
+                        last_saved = self.steps
+                    except Exception as e:  # noqa: BLE001 — disk full etc.
+                        _log.warning("checkpoint save failed, disabling: %s", e)
+                        ckptr = None
+            if ckptr and self.steps > last_saved:
+                try:
+                    ckptr.save(self.steps, params, opt_state)  # final save
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("final checkpoint save failed: %s", e)
+        except Exception as e:  # surface crashes to the source, don't die mute
+            with self._lock:
+                self.error = f"workload crashed: {e}"
+
+    # -- telemetry -----------------------------------------------------------
+    def metrics(self) -> dict:
+        with self._lock:
+            if self.error:
+                raise RuntimeError(self.error)
+            st = self.step_time_ema
+            ok = st == st and st > 0
+            return {
+                "steps": self.steps,
+                "resumed_from": self.resumed_from,
+                "loss": self.loss,
+                "steps_per_second": (1.0 / st) if ok else 0.0,
+                "achieved_tflops": (
+                    flops_per_step(self.cfg) / st / 1e12 if ok else 0.0
+                ),
+            }

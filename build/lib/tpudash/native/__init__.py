@@ -1,0 +1,374 @@
+"""Native C++ data plane — loader and ctypes bindings.
+
+``frame_kernel.cc`` implements the scrape→frame hot path (payload bytes →
+dense columnar SampleBatch) and a one-pass column-stats kernel.  This module
+builds it on first use (plain ``g++ -O3 -shared``, no toolchain beyond the
+system compiler), loads it via ctypes, and exposes typed wrappers.  When the
+compiler or library is unavailable — or ``TPUDASH_NATIVE=0`` — every caller
+falls back to the pure-Python implementations transparently; the native
+path is a performance tier, never a requirement.
+
+Parity contract: outputs are bit-identical to the Python parsers
+(tests/test_native.py asserts frame equality on shared fixtures).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from tpudash.schema import SampleBatch
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "frame_kernel.cc")
+_INC = os.path.join(_DIR, "series_aliases.inc")
+_LIB = os.path.join(_DIR, "libtpudash_native.so")
+
+_lib: "ctypes.CDLL | None" = None
+_tried = False
+
+
+class NativeParseError(ValueError):
+    """Parse failure reported by the native kernel (message mirrors the
+    Python parsers' error strings so callers can map it 1:1)."""
+
+
+def _ensure_inc() -> None:
+    """(Re)generate series_aliases.inc from tpudash.compat — the C++ alias
+    table stays in lock-step with the Python one; a content change bumps the
+    file's mtime, which triggers a rebuild in load()."""
+    from tpudash import compat
+
+    content = compat.native_alias_table()
+    try:
+        with open(_INC) as f:
+            if f.read() == content:
+                return
+    except OSError:
+        pass
+    try:
+        with open(_INC, "w") as f:
+            f.write(content)
+    except OSError as e:  # pragma: no cover - read-only install
+        log.warning("cannot write %s: %s", _INC, e)
+
+
+def _build() -> bool:
+    """Compile the kernel next to its source.  Atomic: compile to a temp
+    name, then os.replace, so concurrent importers never load a half-written
+    library."""
+    if not os.path.exists(_SRC):
+        return False
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        proc = subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+             f"-I{_DIR}", "-o", tmp, _SRC],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            log.warning("native build failed: %s", proc.stderr[-2000:])
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native build unavailable: %s", e)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    c_i64 = ctypes.c_int64
+    c_void_p = ctypes.c_void_p
+    lib.td_parse_text.restype = c_void_p
+    lib.td_parse_text.argtypes = [c_char_p, c_i64, c_char_p, c_char_p, c_i64]
+    lib.td_parse_promjson.restype = c_void_p
+    lib.td_parse_promjson.argtypes = [c_char_p, c_i64, c_char_p, c_char_p, c_i64]
+    lib.td_frame_nrows.restype = c_i64
+    lib.td_frame_nrows.argtypes = [c_void_p]
+    lib.td_frame_ncols.restype = c_i64
+    lib.td_frame_ncols.argtypes = [c_void_p]
+    lib.td_frame_matrix.restype = None
+    lib.td_frame_matrix.argtypes = [c_void_p, ctypes.POINTER(ctypes.c_double)]
+    lib.td_frame_chip_ids.restype = None
+    lib.td_frame_chip_ids.argtypes = [c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.td_frame_nsamples.restype = c_i64
+    lib.td_frame_nsamples.argtypes = [c_void_p]
+    lib.td_frame_strings.restype = c_i64
+    lib.td_frame_strings.argtypes = [c_void_p, ctypes.c_int32, c_char_p, c_i64]
+    lib.td_frame_interned.restype = c_i64
+    lib.td_frame_interned.argtypes = [
+        c_void_p, ctypes.c_int32, c_char_p, c_i64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.td_frame_free.restype = None
+    lib.td_frame_free.argtypes = [c_void_p]
+    lib.td_column_stats.restype = None
+    lib.td_column_stats.argtypes = [
+        ctypes.POINTER(ctypes.c_double), c_i64, c_i64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(c_i64),
+    ]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.td_encode_samples.restype = c_void_p
+    lib.td_encode_samples.argtypes = [
+        c_i64,
+        c_char_p, c_i64, i32p,  # metric uniques + codes
+        c_char_p, c_i64,        # helps (aligned with metric uniques)
+        c_char_p, c_i64, i32p,  # slice uniques + codes
+        c_char_p, c_i64, i32p,  # host uniques + codes
+        c_char_p, c_i64, i32p,  # accel uniques + codes
+        ctypes.POINTER(c_i64),  # chip ids
+        ctypes.POINTER(ctypes.c_double),  # values
+        ctypes.POINTER(c_i64),  # out length
+    ]
+    lib.td_text_free.restype = None
+    lib.td_text_free.argtypes = [c_void_p]
+    return lib
+
+
+def load() -> "ctypes.CDLL | None":
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    _tried = True
+    if os.environ.get("TPUDASH_NATIVE", "").strip() == "0":
+        return None
+    _ensure_inc()
+    needs_build = not os.path.exists(_LIB) or any(
+        os.path.exists(p) and os.path.getmtime(p) > os.path.getmtime(_LIB)
+        for p in (_SRC, _INC)
+    )
+    if needs_build and not _build():
+        return None
+    try:
+        _lib = _configure(ctypes.CDLL(_LIB))
+    except OSError as e:
+        log.warning("cannot load %s: %s", _LIB, e)
+        return None
+    return _lib
+
+
+def is_available() -> bool:
+    return load() is not None
+
+
+def _unpack_strings(raw: bytes, size: int) -> list[str]:
+    """Decode the kernel's uint32-LE length-prefixed string packing
+    (label values may contain any byte, so no separator is safe)."""
+    out: list[str] = []
+    i = 0
+    while i + 4 <= size:
+        n = int.from_bytes(raw[i : i + 4], "little")
+        i += 4
+        out.append(raw[i : i + n].decode("utf-8", errors="replace"))
+        i += n
+    return out
+
+
+def _strings(lib, handle, which: int, expect: int) -> list[str]:
+    """Per-row string list via the plain (non-interned) export."""
+    size = lib.td_frame_strings(handle, which, None, 0)
+    if size <= 0:
+        return [""] * expect if expect else []
+    buf = ctypes.create_string_buffer(size)
+    lib.td_frame_strings(handle, which, buf, size)
+    return _unpack_strings(buf.raw[:size], size)
+
+
+def _interned_list(lib, handle, which: int, nrows: int) -> list[str]:
+    """Rebuild a per-row string list from the kernel's interned export:
+    one small uniques blob + int32 codes, expanded with a single numpy
+    take — ~100x less transfer and decode work than per-row strings (a
+    512-chip scrape has 1-2 slices and ~64 hosts)."""
+    if nrows == 0:
+        return []
+    codes = np.empty(nrows, dtype=np.int32)
+    size = lib.td_frame_interned(
+        handle, which, None, 0,
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if size <= 0:
+        return [""] * nrows
+    buf = ctypes.create_string_buffer(size)
+    lib.td_frame_interned(handle, which, buf, size, None)
+    uniq = _unpack_strings(buf.raw[:size], size)
+    return np.array(uniq, dtype=object)[codes].tolist()
+
+
+def _frame_to_batch(lib, handle) -> SampleBatch:
+    try:
+        nrows = lib.td_frame_nrows(handle)
+        ncols = lib.td_frame_ncols(handle)
+        matrix = np.empty((nrows, ncols), dtype=np.float64)
+        chip_ids = np.empty(nrows, dtype=np.int64)
+        if nrows and ncols:
+            lib.td_frame_matrix(
+                handle, matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            )
+        if nrows:
+            lib.td_frame_chip_ids(
+                handle, chip_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+            )
+        return SampleBatch(
+            metrics=_strings(lib, handle, 0, ncols),
+            slices=_interned_list(lib, handle, 1, nrows),
+            hosts=_interned_list(lib, handle, 2, nrows),
+            chip_ids=chip_ids,
+            accels=_interned_list(lib, handle, 3, nrows),
+            matrix=matrix,
+            _n_samples=int(lib.td_frame_nsamples(handle)),
+        )
+    finally:
+        lib.td_frame_free(handle)
+
+
+def _parse(fn, data: "bytes | str", default_slice: str) -> SampleBatch:
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    err = ctypes.create_string_buffer(512)
+    handle = fn(data, len(data), default_slice.encode("utf-8"), err, len(err))
+    if not handle:
+        raise NativeParseError(err.value.decode("utf-8", errors="replace"))
+    lib = load()
+    assert lib is not None
+    return _frame_to_batch(lib, handle)
+
+
+def parse_text(data: "bytes | str", default_slice: str = "slice-0") -> SampleBatch:
+    """Prometheus exposition text → SampleBatch (native counterpart of
+    exporter/textfmt.parse_text_format)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return _parse(lib.td_parse_text, data, default_slice)
+
+
+def parse_promjson(data: "bytes | str", default_slice: str = "slice-0") -> SampleBatch:
+    """Prometheus instant-query JSON bytes → SampleBatch (native
+    counterpart of sources/base.parse_instant_query, fused with the JSON
+    decode itself)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return _parse(lib.td_parse_promjson, data, default_slice)
+
+
+def _intern(values: list) -> "tuple[list, np.ndarray]":
+    """(uniques in first-seen order, int32 codes) — the wire form the
+    encoder takes; a 256-chip scrape has ~10 metric names, 1-2 slices and
+    ~64 hosts, so interning shrinks the marshalled strings ~100x."""
+    memo: dict = {}
+    uniq: list = []
+    codes = np.empty(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        c = memo.get(v)
+        if c is None:
+            c = memo[v] = len(uniq)
+            uniq.append(v)
+        codes[i] = c
+    return uniq, codes
+
+
+def _pack(strs: list) -> bytes:
+    parts = bytearray()
+    for s in strs:
+        b = s.encode("utf-8")
+        parts += len(b).to_bytes(4, "little")
+        parts += b
+    return bytes(parts)
+
+
+def encode_samples(samples: list) -> str:
+    """Samples → Prometheus exposition text via the native kernel —
+    byte-identical to exporter/textfmt's pure-Python encoder (differential
+    parity in tests/test_native.py)."""
+    from tpudash.schema import SERIES_HELP
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(samples)
+    metric_u, metric_c = _intern([s.metric for s in samples])
+    helps = [SERIES_HELP.get(m, "tpudash series") for m in metric_u]
+    slice_u, slice_c = _intern([s.chip.slice_id for s in samples])
+    host_u, host_c = _intern([s.chip.host for s in samples])
+    accel_u, accel_c = _intern(
+        [s.accelerator_type or "" for s in samples]
+    )
+    chip_ids = np.fromiter(
+        (s.chip.chip_id for s in samples), dtype=np.int64, count=n
+    )
+    values = np.fromiter((s.value for s in samples), dtype=np.float64, count=n)
+    mb, hb, sb, hob, ab = (
+        _pack(metric_u), _pack(helps), _pack(slice_u), _pack(host_u),
+        _pack(accel_u),
+    )
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    out_len = ctypes.c_int64()
+    ptr = lib.td_encode_samples(
+        n,
+        mb, len(mb), metric_c.ctypes.data_as(i32p),
+        hb, len(hb),
+        sb, len(sb), slice_c.ctypes.data_as(i32p),
+        hob, len(hob), host_c.ctypes.data_as(i32p),
+        ab, len(ab), accel_c.ctypes.data_as(i32p),
+        chip_ids.ctypes.data_as(i64p),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len),
+    )
+    if not ptr or out_len.value < 0:
+        raise RuntimeError("native encode failed")
+    try:
+        return ctypes.string_at(ptr, out_len.value).decode("utf-8")
+    finally:
+        lib.td_text_free(ptr)
+
+
+def column_stats(matrix: np.ndarray, zero_excluded: "np.ndarray | None" = None):
+    """One-pass per-column (mean, max, min, zmean, count) over a row-major
+    float64 matrix.  NaN cells are skipped; zmean additionally excludes
+    exact zeros for flagged columns (else zmean == mean)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = np.ascontiguousarray(matrix, dtype=np.float64)
+    nrows, ncols = m.shape
+    mean = np.empty(ncols)
+    mx = np.empty(ncols)
+    mn = np.empty(ncols)
+    zmean = np.empty(ncols)
+    count = np.empty(ncols, dtype=np.int64)
+    ze_ptr = None
+    if zero_excluded is not None:
+        ze = np.ascontiguousarray(zero_excluded, dtype=np.uint8)
+        ze_ptr = ze.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    dp = ctypes.POINTER(ctypes.c_double)
+    lib.td_column_stats(
+        m.ctypes.data_as(dp), nrows, ncols, ze_ptr,
+        mean.ctypes.data_as(dp), mx.ctypes.data_as(dp),
+        mn.ctypes.data_as(dp), zmean.ctypes.data_as(dp),
+        count.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return mean, mx, mn, zmean, count

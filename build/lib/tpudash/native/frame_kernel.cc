@@ -1,0 +1,1184 @@
+// tpudash native frame kernel — the C++ data plane.
+//
+// Parses metric payloads (Prometheus exposition text and instant-query
+// JSON) directly into a dense columnar frame: a row per chip, a column per
+// metric, float64 matrix with NaN for missing cells, plus per-row identity
+// (slice, host, chip_id, accelerator).  This replaces the Python hot path
+// (sources/base.py parse_instant_query + normalize.to_wide's dict pivot,
+// the two hottest stages of a 256-chip frame) with a single pass over the
+// raw bytes.  Semantics mirror the Python implementations exactly — the
+// test suite asserts byte-for-byte frame parity (tests/test_native.py).
+//
+// Also provides td_column_stats: one-pass per-column mean/max/min with
+// NaN-skipping and zero-exclusion means (reference app.py:341-345 policy,
+// generalized per normalize.column_average).
+//
+// ABI: plain C, consumed via ctypes (tpudash/native/__init__.py).  The
+// parse functions return an opaque TdFrame*; accessors copy results into
+// caller-allocated buffers; td_frame_free releases it.
+
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <algorithm>
+#include <limits>
+#include <numeric>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr double kNaN = std::numeric_limits<double>::quiet_NaN();
+
+struct TdFrame {
+  std::vector<std::string> metrics;  // column names, first-seen order
+  // per-row identity, sorted by (slice, chip_id), stable
+  std::vector<std::string> slices, hosts, accels;
+  std::vector<int64_t> chip_ids;
+  std::vector<double> matrix;   // row-major nrows * ncols
+  int64_t n_samples = 0;        // emitted samples, incl. duplicates/NaN —
+                                // parity with len(list[Sample])
+};
+
+// Accumulates samples as (row, col, value) triplets, then materializes a
+// sorted dense frame.  Duplicate (row, col) samples: last write wins, same
+// as the Python dict-pivot.
+struct Builder {
+  std::vector<std::string> metrics;
+  std::unordered_map<std::string, int32_t> metric_idx;
+  struct ChipRow {
+    std::string slice, host, accel;
+    int64_t chip_id;
+  };
+  std::vector<ChipRow> chips;
+  std::unordered_map<std::string, int32_t> chip_idx;
+  struct Trip {
+    int32_t row, col;
+    double val;
+  };
+  std::vector<Trip> trips;
+
+  int32_t metric(const std::string& name) {
+    auto it = metric_idx.find(name);
+    if (it != metric_idx.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(metrics.size());
+    metrics.push_back(name);
+    metric_idx.emplace(name, idx);
+    return idx;
+  }
+
+  // Row identity is (slice, chip_id) — NOT host — matching the Python
+  // pivot (ChipKey.key = "slice/chip", normalize.to_wide): series that
+  // disagree on host/instance labels merge into one row, first-seen host
+  // kept, exactly like the dict pivot's first-sample row init.
+  int32_t chip(const std::string& slice, const std::string& host,
+               int64_t chip_id) {
+    std::string key;
+    key.reserve(slice.size() + 14);
+    key.append(slice).push_back('\x1f');
+    key.append(std::to_string(chip_id));
+    auto it = chip_idx.find(key);
+    if (it != chip_idx.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(chips.size());
+    chips.push_back(ChipRow{slice, host, std::string(), chip_id});
+    chip_idx.emplace(std::move(key), idx);
+    return idx;
+  }
+
+  // First non-empty accelerator label wins (normalize.to_wide semantics).
+  void set_accel(int32_t row, const std::string& accel) {
+    if (!accel.empty() && chips[row].accel.empty()) chips[row].accel = accel;
+  }
+
+  void add(int32_t row, int32_t col, double val) {
+    trips.push_back(Trip{row, col, val});
+  }
+
+  TdFrame* finish() {
+    const size_t nrows = chips.size(), ncols = metrics.size();
+    std::vector<int32_t> order(nrows);
+    std::iota(order.begin(), order.end(), 0);
+    std::stable_sort(order.begin(), order.end(),
+                     [this](int32_t a, int32_t b) {
+                       int c = chips[a].slice.compare(chips[b].slice);
+                       if (c != 0) return c < 0;
+                       return chips[a].chip_id < chips[b].chip_id;
+                     });
+    std::vector<int32_t> inverse(nrows);
+    for (size_t i = 0; i < nrows; ++i) inverse[order[i]] = static_cast<int32_t>(i);
+
+    auto* f = new TdFrame();
+    f->metrics = std::move(metrics);
+    f->slices.reserve(nrows);
+    f->hosts.reserve(nrows);
+    f->accels.reserve(nrows);
+    f->chip_ids.reserve(nrows);
+    for (size_t i = 0; i < nrows; ++i) {
+      ChipRow& c = chips[order[i]];
+      f->slices.push_back(std::move(c.slice));
+      f->hosts.push_back(std::move(c.host));
+      f->accels.push_back(std::move(c.accel));
+      f->chip_ids.push_back(c.chip_id);
+    }
+    f->matrix.assign(nrows * ncols, kNaN);
+    for (const Trip& t : trips)
+      f->matrix[static_cast<size_t>(inverse[t.row]) * ncols + t.col] = t.val;
+    f->n_samples = static_cast<int64_t>(trips.size());
+    return f;
+  }
+};
+
+void set_err(char* err, int64_t errcap, const std::string& msg) {
+  if (err == nullptr || errcap <= 0) return;
+  size_t n = std::min(msg.size(), static_cast<size_t>(errcap - 1));
+  std::memcpy(err, msg.data(), n);
+  err[n] = '\0';
+}
+
+// Full-token numeric parse (Python float()/int() reject trailing garbage).
+bool parse_full_double(const char* s, size_t len, double* out) {
+  std::string buf(s, len);
+  // strtod accepts C extensions Python float() rejects — hex floats
+  // ("0x1") and nan payloads ("nan(123)"); both paths must skip the same
+  // series (found by the differential fuzz tests)
+  for (char c : buf)
+    if (c == 'x' || c == 'X' || c == '(') return false;
+  const char* b = buf.c_str();
+  char* endp = nullptr;
+  double v = std::strtod(b, &endp);
+  if (endp == b) return false;
+  while (*endp == ' ' || *endp == '\t') ++endp;
+  if (*endp != '\0') return false;
+  *out = v;
+  return true;
+}
+
+bool parse_full_int(const std::string& s, int64_t* out) {
+  const char* b = s.c_str();
+  while (*b == ' ' || *b == '\t') ++b;
+  char* endp = nullptr;
+  errno = 0;
+  long long v = std::strtoll(b, &endp, 10);
+  if (endp == b || errno == ERANGE) return false;  // overflow → skip series
+  while (*endp == ' ' || *endp == '\t') ++endp;
+  if (*endp != '\0') return false;
+  *out = static_cast<int64_t>(v);
+  return true;
+}
+
+// Real-world series-name aliases (GKE tpu-device-plugin, libtpu runtime
+// metrics) — the table is generated from tpudash.compat.SERIES_ALIASES at
+// build time so the C++ and Python parsers cannot drift.
+#include "series_aliases.inc"
+
+const std::string* canonical_series(const std::string& name) {
+  static const std::unordered_map<std::string, std::string>* kMap = [] {
+    auto* m = new std::unordered_map<std::string, std::string>();
+    for (const auto& a : kSeriesAliases) (*m)[a.from] = a.to;
+    return m;
+  }();
+  auto it = kMap->find(name);
+  return it == kMap->end() ? nullptr : &it->second;
+}
+
+// "<board-id>-<chip-index>" → (board prefix, chip index); bare integers map
+// to ("", chip).  Exact mirror of tpudash.compat.split_accelerator_id.
+bool split_accelerator_id(const std::string& v, std::string* prefix,
+                          int64_t* chip) {
+  size_t pos = v.rfind('-');
+  if (pos == std::string::npos) {
+    if (!parse_full_int(v, chip)) return false;
+    prefix->clear();
+    return true;
+  }
+  if (!parse_full_int(v.substr(pos + 1), chip)) return false;
+  *prefix = v.substr(0, pos);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Prometheus exposition text (exporter/textfmt.py parse_text_format parity)
+// ---------------------------------------------------------------------------
+
+// Parse the inside of {...}: k="v" pairs; escapes \n \\ \" pass through,
+// unknown escapes keep the escaped character (textfmt.py:_parse_labels).
+bool parse_labels(const char* body, size_t n,
+                  std::vector<std::pair<std::string, std::string>>* labels) {
+  size_t i = 0;
+  while (i < n) {
+    while (i < n && (body[i] == ',' || body[i] == ' ')) ++i;
+    if (i >= n) break;
+    size_t eq = i;
+    while (eq < n && body[eq] != '=') ++eq;
+    if (eq >= n) return false;  // malformed labels
+    size_t ks = i, ke = eq;
+    while (ks < ke && (body[ks] == ' ' || body[ks] == '\t')) ++ks;
+    while (ke > ks && (body[ke - 1] == ' ' || body[ke - 1] == '\t')) --ke;
+    std::string key(body + ks, ke - ks);
+    if (eq + 1 >= n || body[eq + 1] != '"') return false;  // unquoted value
+    size_t j = eq + 2;
+    std::string val;
+    while (j < n) {
+      char c = body[j];
+      if (c == '\\' && j + 1 < n) {
+        char nxt = body[j + 1];
+        if (nxt == 'n')
+          val.push_back('\n');
+        else
+          val.push_back(nxt);
+        j += 2;
+        continue;
+      }
+      if (c == '"') break;
+      val.push_back(c);
+      ++j;
+    }
+    if (j >= n) return false;  // unterminated value
+    labels->emplace_back(std::move(key), std::move(val));
+    i = j + 1;
+  }
+  return true;
+}
+
+const std::string* find_label(
+    const std::vector<std::pair<std::string, std::string>>& labels,
+    const char* key) {
+  // last-wins on duplicate label names — Python builds a dict, so a later
+  // duplicate overwrites (textfmt._parse_labels); the JSON path already
+  // keys last-wins the same way
+  for (auto it = labels.rbegin(); it != labels.rend(); ++it)
+    if (it->first == key) return &it->second;
+  return nullptr;
+}
+
+TdFrame* parse_text_impl(const char* text, int64_t len,
+                         const std::string& default_slice, char* err,
+                         int64_t errcap) {
+  Builder b;
+  const char* p = text;
+  const char* end = text + len;
+  std::vector<std::pair<std::string, std::string>> labels;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* ls = p;
+    p = nl ? nl + 1 : end;
+    // strip
+    while (ls < line_end && (*ls == ' ' || *ls == '\t' || *ls == '\r')) ++ls;
+    const char* le = line_end;
+    while (le > ls && (le[-1] == ' ' || le[-1] == '\t' || le[-1] == '\r')) --le;
+    if (ls >= le || *ls == '#') continue;
+    const char* brace =
+        static_cast<const char*>(memchr(ls, '{', le - ls));
+    if (brace == nullptr) continue;  // unlabeled series: no chip identity
+    // last '}' on the line (textfmt.py uses rfind)
+    const char* close = nullptr;
+    for (const char* q = le - 1; q > brace; --q)
+      if (*q == '}') {
+        close = q;
+        break;
+      }
+    if (close == nullptr) {
+      set_err(err, errcap, "malformed series line");
+      return nullptr;
+    }
+    // metric name, stripped
+    const char* ne = brace;
+    while (ne > ls && (ne[-1] == ' ' || ne[-1] == '\t')) --ne;
+    std::string name(ls, ne - ls);
+    labels.clear();
+    if (!parse_labels(brace + 1, close - brace - 1, &labels)) {
+      set_err(err, errcap, "malformed labels");
+      return nullptr;
+    }
+    // first whitespace-separated token after '}'
+    const char* vs = close + 1;
+    while (vs < le && (*vs == ' ' || *vs == '\t')) ++vs;
+    const char* ve = vs;
+    while (ve < le && *ve != ' ' && *ve != '\t') ++ve;
+    if (name.empty() || vs >= ve) continue;
+    double value;
+    if (!parse_full_double(vs, ve - vs, &value)) continue;
+    if (!std::isfinite(value)) continue;
+    const std::string* chip_label = find_label(labels, "chip_id");
+    if (chip_label == nullptr) chip_label = find_label(labels, "gpu_id");
+    int64_t chip_id;
+    std::string slice_hint;
+    bool have_hint = false;
+    if (chip_label != nullptr) {
+      if (!parse_full_int(*chip_label, &chip_id)) continue;
+    } else {
+      const std::string* accel_id = find_label(labels, "accelerator_id");
+      if (accel_id == nullptr) continue;
+      if (!split_accelerator_id(*accel_id, &slice_hint, &chip_id)) continue;
+      have_hint = !slice_hint.empty();
+    }
+    const std::string* slice = find_label(labels, "slice");
+    const std::string* host = find_label(labels, "host");
+    if (host == nullptr) host = find_label(labels, "node");
+    if (host == nullptr) host = find_label(labels, "instance");
+    const std::string* accel = find_label(labels, "accelerator");
+    if (accel == nullptr) accel = find_label(labels, "card_model");
+    if (accel == nullptr) accel = find_label(labels, "model");
+    static const std::string kEmpty;
+    int32_t row =
+        b.chip(slice ? *slice : (have_hint ? slice_hint : default_slice),
+               host ? *host : kEmpty, chip_id);
+    if (accel != nullptr) b.set_accel(row, *accel);
+    const std::string* canon = canonical_series(name);
+    b.add(row, b.metric(canon ? *canon : name), value);
+  }
+  return b.finish();
+}
+
+// ---------------------------------------------------------------------------
+// Prometheus instant-query JSON (sources/base.py parse_instant_query parity)
+// ---------------------------------------------------------------------------
+
+struct JParser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit JParser(const char* text, int64_t len) : p(text), end(text + len) {}
+
+  void ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool fail(const char* msg) {
+    err = msg;
+    return false;
+  }
+
+  bool expect(char c) {
+    ws();
+    if (p >= end || *p != c) return fail("unexpected token");
+    ++p;
+    return true;
+  }
+
+  bool peek(char c) {
+    ws();
+    return p < end && *p == c;
+  }
+
+  // JSON string; out==nullptr skips without building.
+  bool parse_string(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    // Fast path: almost every string in a Prometheus payload (metric
+    // names, label keys/values, numeric value strings) is escape-free —
+    // scan to the terminator in one pass and assign once, instead of the
+    // per-character push_back loop below (profiled as the parser's
+    // hottest inner loop at 256 chips).
+    {
+      const char* q = p;
+      while (q < end && *q != '"' && *q != '\\') ++q;
+      if (q < end && *q == '"') {
+        if (out != nullptr) out->assign(p, q - p);
+        p = q + 1;
+        return true;
+      }
+    }
+    while (p < end) {
+      char c = *p;
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return fail("bad escape");
+        char e = *p++;
+        if (out == nullptr) {
+          if (e == 'u') {
+            if (end - p < 4) return fail("bad \\u escape");
+            p += 4;
+          }
+          continue;
+        }
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 4) return fail("bad \\u escape");
+            unsigned cp = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = p[i];
+              cp <<= 4;
+              if (h >= '0' && h <= '9')
+                cp |= h - '0';
+              else if (h >= 'a' && h <= 'f')
+                cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F')
+                cp |= h - 'A' + 10;
+              else
+                return fail("bad \\u escape");
+            }
+            p += 4;
+            // surrogate pair
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {
+              unsigned lo = 0;
+              bool ok = true;
+              for (int i = 0; i < 4; ++i) {
+                char h = p[2 + i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9')
+                  lo |= h - '0';
+                else if (h >= 'a' && h <= 'f')
+                  lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F')
+                  lo |= h - 'A' + 10;
+                else {
+                  ok = false;
+                  break;
+                }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            // UTF-8 encode
+            if (cp < 0x80) {
+              out->push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return fail("bad escape");
+        }
+        continue;
+      }
+      if (out != nullptr) out->push_back(c);
+      ++p;
+    }
+    return fail("unterminated string");
+  }
+
+  bool skip_number() {
+    ws();
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '-' || *p == '+'))
+      ++p;
+    return p > start;
+  }
+
+  bool parse_number(double* out) {
+    ws();
+    char* endp = nullptr;
+    std::string buf(p, std::min<size_t>(end - p, 64));
+    double v = std::strtod(buf.c_str(), &endp);
+    if (endp == buf.c_str()) return fail("bad number");
+    *out = v;
+    p += endp - buf.c_str();
+    return true;
+  }
+
+  bool skip_literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (static_cast<size_t>(end - p) < n || std::strncmp(p, lit, n) != 0)
+      return fail("bad literal");
+    p += n;
+    return true;
+  }
+
+  // bounded recursion: a hostile/broken payload of 100k nested brackets
+  // must surface as a parse error (→ SourceError banner, like the Python
+  // json.loads RecursionError path), not a C-stack overflow
+  static constexpr int kMaxSkipDepth = 256;
+
+  bool skip_value(int depth = 0) {
+    if (depth > kMaxSkipDepth) return fail("value nesting too deep");
+    ws();
+    if (p >= end) return fail("truncated value");
+    switch (*p) {
+      case '{': {
+        ++p;
+        if (peek('}')) {
+          ++p;
+          return true;
+        }
+        while (true) {
+          if (!parse_string(nullptr)) return false;
+          if (!expect(':')) return false;
+          if (!skip_value(depth + 1)) return false;
+          ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          return expect('}');
+        }
+      }
+      case '[': {
+        ++p;
+        if (peek(']')) {
+          ++p;
+          return true;
+        }
+        while (true) {
+          if (!skip_value(depth + 1)) return false;
+          ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          return expect(']');
+        }
+      }
+      case '"':
+        return parse_string(nullptr);
+      case 't':
+        return skip_literal("true");
+      case 'f':
+        return skip_literal("false");
+      case 'n':
+        return skip_literal("null");
+      default:
+        return skip_number();
+    }
+  }
+};
+
+// Labels parse_instant_query reads from each result's "metric" object.
+struct MetricLabels {
+  std::string name, chip_id, gpu_id, slice, host, instance, accel, card_model;
+  std::string accelerator_id, node, model;
+  bool has_chip_id = false, has_gpu_id = false, has_slice = false,
+       has_host = false, has_instance = false, has_accel = false,
+       has_card_model = false, has_accelerator_id = false, has_node = false,
+       has_model = false;
+};
+
+bool parse_metric_obj(JParser& jp, MetricLabels* m) {
+  if (!jp.expect('{')) return false;
+  if (jp.peek('}')) {
+    ++jp.p;
+    return true;
+  }
+  std::string key;
+  while (true) {
+    key.clear();
+    if (!jp.parse_string(&key)) return false;
+    if (!jp.expect(':')) return false;
+    std::string* dst = nullptr;
+    bool* flag = nullptr;
+    if (key == "__name__") {
+      dst = &m->name;
+    } else if (key == "chip_id") {
+      dst = &m->chip_id;
+      flag = &m->has_chip_id;
+    } else if (key == "gpu_id") {
+      dst = &m->gpu_id;
+      flag = &m->has_gpu_id;
+    } else if (key == "slice") {
+      dst = &m->slice;
+      flag = &m->has_slice;
+    } else if (key == "host") {
+      dst = &m->host;
+      flag = &m->has_host;
+    } else if (key == "instance") {
+      dst = &m->instance;
+      flag = &m->has_instance;
+    } else if (key == "accelerator") {
+      dst = &m->accel;
+      flag = &m->has_accel;
+    } else if (key == "card_model") {
+      dst = &m->card_model;
+      flag = &m->has_card_model;
+    } else if (key == "accelerator_id") {
+      dst = &m->accelerator_id;
+      flag = &m->has_accelerator_id;
+    } else if (key == "node") {
+      dst = &m->node;
+      flag = &m->has_node;
+    } else if (key == "model") {
+      dst = &m->model;
+      flag = &m->has_model;
+    }
+    if (dst != nullptr) {
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == '"') {
+        dst->clear();  // duplicate JSON keys: last one wins (json.loads)
+        if (!jp.parse_string(dst)) return false;
+        if (flag != nullptr) *flag = true;
+      } else if (jp.p < jp.end &&
+                 (*jp.p == '-' || (*jp.p >= '0' && *jp.p <= '9'))) {
+        // numeric label value (illegal in Prometheus exposition but legal
+        // JSON; Python's json.loads would hand int/float through) —
+        // capture its raw text so integer chip ids still resolve
+        const char* start = jp.p;
+        if (!jp.skip_number()) return false;
+        dst->assign(start, jp.p - start);
+        if (flag != nullptr) *flag = true;
+      } else {
+        // other non-string label value (bool/null/object): skip it
+        if (!jp.skip_value()) return false;
+      }
+    } else {
+      if (!jp.skip_value()) return false;
+    }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      ++jp.p;
+      continue;
+    }
+    return jp.expect('}');
+  }
+}
+
+// "value": [ts, "1.23"] — returns true with *ok=false to skip the series
+// (malformed shape), mirrors Python's per-series tolerance.
+bool parse_value_arr(JParser& jp, double* out, bool* ok) {
+  *ok = false;
+  if (!jp.expect('[')) return false;
+  if (jp.peek(']')) {
+    ++jp.p;
+    return true;  // wrong arity → skip series
+  }
+  int count = 0;
+  std::string sval;
+  bool have_str = false, have_num = false;
+  double num = 0.0;
+  while (true) {
+    jp.ws();
+    ++count;
+    if (jp.p < jp.end && *jp.p == '"') {
+      sval.clear();
+      if (!jp.parse_string(&sval)) return false;
+      if (count == 2) have_str = true;
+    } else if (jp.p < jp.end &&
+               (*jp.p == '{' || *jp.p == '[' || *jp.p == 't' || *jp.p == 'f' ||
+                *jp.p == 'n')) {
+      if (!jp.skip_value()) return false;
+    } else {
+      double v;
+      if (!jp.parse_number(&v)) return false;
+      if (count == 2) {
+        num = v;
+        have_num = true;
+      }
+    }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      ++jp.p;
+      continue;
+    }
+    if (!jp.expect(']')) return false;
+    break;
+  }
+  if (count != 2) return true;  // skip: Python requires len == 2
+  if (have_str) {
+    // Python float(str): accepts inf/nan/whitespace, rejects garbage
+    const char* s = sval.c_str();
+    while (*s == ' ' || *s == '\t') ++s;
+    if (!parse_full_double(s, std::strlen(s), out)) return true;  // skip
+    *ok = true;
+  } else if (have_num) {
+    *out = num;
+    *ok = true;
+  }
+  return true;
+}
+
+TdFrame* parse_promjson_impl(const char* text, int64_t len,
+                             const std::string& default_slice, char* err,
+                             int64_t errcap) {
+  JParser jp(text, len);
+  Builder b;
+  std::string status;
+  bool saw_result = false;
+
+  auto bad = [&](const std::string& msg) -> TdFrame* {
+    set_err(err, errcap, msg);
+    return nullptr;
+  };
+
+  if (!jp.expect('{')) return bad("malformed prometheus payload: not an object");
+  if (!jp.peek('}')) {
+    std::string key;
+    while (true) {
+      key.clear();
+      if (!jp.parse_string(&key)) return bad("malformed prometheus payload");
+      if (!jp.expect(':')) return bad("malformed prometheus payload");
+      if (key == "status") {
+        jp.ws();
+        if (jp.p < jp.end && *jp.p == '"') {
+          if (!jp.parse_string(&status)) return bad("malformed prometheus payload");
+        } else {
+          if (!jp.skip_value()) return bad("malformed prometheus payload");
+        }
+      } else if (key == "data") {
+        // object containing "result"
+        if (!jp.expect('{')) return bad("malformed prometheus payload: 'data'");
+        if (!jp.peek('}')) {
+          std::string dkey;
+          while (true) {
+            dkey.clear();
+            if (!jp.parse_string(&dkey)) return bad("malformed prometheus payload");
+            if (!jp.expect(':')) return bad("malformed prometheus payload");
+            if (dkey == "result") {
+              saw_result = true;
+              if (!jp.expect('['))
+                return bad("malformed prometheus payload: 'result'");
+              if (jp.peek(']')) {
+                ++jp.p;
+              } else {
+                while (true) {
+                  // one result item
+                  if (!jp.expect('{'))
+                    return bad("malformed prometheus payload: result item");
+                  MetricLabels m;
+                  double val = 0.0;
+                  bool have_val = false;
+                  if (!jp.peek('}')) {
+                    std::string ikey;
+                    while (true) {
+                      ikey.clear();
+                      if (!jp.parse_string(&ikey))
+                        return bad("malformed prometheus payload");
+                      if (!jp.expect(':'))
+                        return bad("malformed prometheus payload");
+                      if (ikey == "metric") {
+                        jp.ws();
+                        if (jp.p < jp.end && *jp.p == '{') {
+                          if (!parse_metric_obj(jp, &m))
+                            return bad("malformed prometheus payload: metric");
+                        } else {
+                          if (!jp.skip_value())
+                            return bad("malformed prometheus payload");
+                        }
+                      } else if (ikey == "value") {
+                        jp.ws();
+                        if (jp.p < jp.end && *jp.p == '[') {
+                          bool ok = false;
+                          if (!parse_value_arr(jp, &val, &ok))
+                            return bad("malformed prometheus payload: value");
+                          have_val = ok;
+                        } else {
+                          if (!jp.skip_value())
+                            return bad("malformed prometheus payload");
+                        }
+                      } else {
+                        if (!jp.skip_value())
+                          return bad("malformed prometheus payload");
+                      }
+                      jp.ws();
+                      if (jp.p < jp.end && *jp.p == ',') {
+                        ++jp.p;
+                        continue;
+                      }
+                      if (!jp.expect('}'))
+                        return bad("malformed prometheus payload");
+                      break;
+                    }
+                  } else {
+                    ++jp.p;  // empty item object
+                  }
+                  // emit sample (tolerant per-series skipping)
+                  do {
+                    if (m.name.empty() || !have_val) break;
+                    int64_t chip_id;
+                    std::string slice_hint;
+                    bool have_hint = false;
+                    if (m.has_chip_id || m.has_gpu_id) {
+                      const std::string& chip_label =
+                          m.has_chip_id ? m.chip_id : m.gpu_id;
+                      if (!parse_full_int(chip_label, &chip_id)) break;
+                    } else if (m.has_accelerator_id) {
+                      if (!split_accelerator_id(m.accelerator_id, &slice_hint,
+                                                &chip_id))
+                        break;
+                      have_hint = !slice_hint.empty();
+                    } else {
+                      break;
+                    }
+                    const std::string& slice =
+                        m.has_slice ? m.slice
+                                    : (have_hint ? slice_hint : default_slice);
+                    static const std::string kEmpty;
+                    const std::string& host =
+                        m.has_host
+                            ? m.host
+                            : (m.has_node
+                                   ? m.node
+                                   : (m.has_instance ? m.instance : kEmpty));
+                    int32_t row = b.chip(slice, host, chip_id);
+                    const std::string& accel =
+                        m.has_accel
+                            ? m.accel
+                            : (m.has_card_model
+                                   ? m.card_model
+                                   : (m.has_model ? m.model : kEmpty));
+                    b.set_accel(row, accel);
+                    const std::string* canon = canonical_series(m.name);
+                    b.add(row, b.metric(canon ? *canon : m.name), val);
+                  } while (false);
+                  jp.ws();
+                  if (jp.p < jp.end && *jp.p == ',') {
+                    ++jp.p;
+                    continue;
+                  }
+                  if (!jp.expect(']'))
+                    return bad("malformed prometheus payload");
+                  break;
+                }
+              }
+            } else {
+              if (!jp.skip_value()) return bad("malformed prometheus payload");
+            }
+            jp.ws();
+            if (jp.p < jp.end && *jp.p == ',') {
+              ++jp.p;
+              continue;
+            }
+            if (!jp.expect('}')) return bad("malformed prometheus payload");
+            break;
+          }
+        } else {
+          ++jp.p;  // empty data object
+        }
+      } else {
+        if (!jp.skip_value()) return bad("malformed prometheus payload");
+      }
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ',') {
+        ++jp.p;
+        continue;
+      }
+      if (!jp.expect('}')) return bad("malformed prometheus payload");
+      break;
+    }
+  } else {
+    ++jp.p;
+  }
+
+  if (status != "success")
+    return bad("prometheus status='" + status + "'");
+  if (!saw_result)
+    return bad("malformed prometheus payload: 'result'");
+  return b.finish();
+}
+
+// Length-prefixed packing (uint32 LE + bytes per string) — label values may
+// legally contain newlines, so a separator-joined transfer is not safe.
+std::string pack_strings(const std::vector<std::string>& v) {
+  std::string out;
+  size_t total = 0;
+  for (const auto& s : v) total += s.size() + 4;
+  out.reserve(total);
+  for (const auto& s : v) {
+    uint32_t n = static_cast<uint32_t>(s.size());
+    char hdr[4] = {static_cast<char>(n & 0xFF), static_cast<char>((n >> 8) & 0xFF),
+                   static_cast<char>((n >> 16) & 0xFF),
+                   static_cast<char>((n >> 24) & 0xFF)};
+    out.append(hdr, 4);
+    out.append(s);
+  }
+  return out;
+}
+
+// Inverse of pack_strings: uint32-LE length-prefixed list → strings.
+std::vector<std::string> unpack_strings(const char* blob, int64_t len) {
+  std::vector<std::string> out;
+  int64_t i = 0;
+  while (blob != nullptr && i + 4 <= len) {
+    uint32_t n = static_cast<uint8_t>(blob[i]) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 1])) << 8) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 2])) << 16) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[i + 3])) << 24);
+    i += 4;
+    if (i + static_cast<int64_t>(n) > len) break;
+    out.emplace_back(blob + i, n);
+    i += n;
+  }
+  return out;
+}
+
+// Label-value escaping, exporter/textfmt.py _escape_label_value parity.
+std::string escape_label_value(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\')
+      out += "\\\\";
+    else if (c == '"')
+      out += "\\\"";
+    else if (c == '\n')
+      out += "\\n";
+    else
+      out.push_back(c);
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* td_parse_text(const char* text, int64_t len, const char* default_slice,
+                    char* err, int64_t errcap) {
+  return parse_text_impl(text, len, default_slice ? default_slice : "slice-0",
+                         err, errcap);
+}
+
+void* td_parse_promjson(const char* text, int64_t len,
+                        const char* default_slice, char* err, int64_t errcap) {
+  return parse_promjson_impl(text, len,
+                             default_slice ? default_slice : "slice-0", err,
+                             errcap);
+}
+
+int64_t td_frame_nrows(void* f) {
+  return static_cast<TdFrame*>(f)->chip_ids.size();
+}
+
+int64_t td_frame_ncols(void* f) {
+  return static_cast<TdFrame*>(f)->metrics.size();
+}
+
+void td_frame_matrix(void* f, double* out) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  std::memcpy(out, fr->matrix.data(), fr->matrix.size() * sizeof(double));
+}
+
+void td_frame_chip_ids(void* f, int64_t* out) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  std::memcpy(out, fr->chip_ids.data(), fr->chip_ids.size() * sizeof(int64_t));
+}
+
+int64_t td_frame_nsamples(void* f) {
+  return static_cast<TdFrame*>(f)->n_samples;
+}
+
+// which: 0 = metric names (ncols lines), 1 = slices, 2 = hosts, 3 = accels
+// (nrows lines each).  Returns bytes needed; fills buf if cap suffices.
+int64_t td_frame_strings(void* f, int32_t which, char* buf, int64_t cap) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  const std::vector<std::string>* v = nullptr;
+  switch (which) {
+    case 0: v = &fr->metrics; break;
+    case 1: v = &fr->slices; break;
+    case 2: v = &fr->hosts; break;
+    case 3: v = &fr->accels; break;
+    default: return -1;
+  }
+  std::string packed = pack_strings(*v);
+  if (buf != nullptr && cap >= static_cast<int64_t>(packed.size()))
+    std::memcpy(buf, packed.data(), packed.size());
+  return static_cast<int64_t>(packed.size());
+}
+
+// Interned export for the per-row string lists (which: 1 = slices,
+// 2 = hosts, 3 = accels): returns the byte size of the packed UNIQUE
+// strings (first-seen order) and, when non-null, fills `codes` with
+// nrows int32 indices into that table.  A 512-chip scrape has 1-2 slices
+// and ~64 hosts, so the transfer shrinks ~100x vs per-row strings and
+// the Python side rebuilds the list with one vectorized take.
+int64_t td_frame_interned(void* f, int32_t which, char* buf, int64_t cap,
+                          int32_t* codes) {
+  TdFrame* fr = static_cast<TdFrame*>(f);
+  const std::vector<std::string>* v = nullptr;
+  switch (which) {
+    case 1: v = &fr->slices; break;
+    case 2: v = &fr->hosts; break;
+    case 3: v = &fr->accels; break;
+    default: return -1;
+  }
+  std::unordered_map<std::string, int32_t> memo;
+  std::vector<const std::string*> uniq;
+  for (size_t i = 0; i < v->size(); ++i) {
+    const std::string& s = (*v)[i];
+    auto it = memo.find(s);
+    int32_t c;
+    if (it == memo.end()) {
+      c = static_cast<int32_t>(uniq.size());
+      memo.emplace(s, c);
+      uniq.push_back(&s);
+    } else {
+      c = it->second;
+    }
+    if (codes != nullptr) codes[i] = c;
+  }
+  std::string packed;
+  {
+    size_t total = 0;
+    for (const auto* s : uniq) total += s->size() + 4;
+    packed.reserve(total);
+    for (const auto* s : uniq) {
+      uint32_t n = static_cast<uint32_t>(s->size());
+      char hdr[4] = {static_cast<char>(n & 0xFF),
+                     static_cast<char>((n >> 8) & 0xFF),
+                     static_cast<char>((n >> 16) & 0xFF),
+                     static_cast<char>((n >> 24) & 0xFF)};
+      packed.append(hdr, 4);
+      packed.append(*s);
+    }
+  }
+  if (buf != nullptr && cap >= static_cast<int64_t>(packed.size()))
+    std::memcpy(buf, packed.data(), packed.size());
+  return static_cast<int64_t>(packed.size());
+}
+
+void td_frame_free(void* f) { delete static_cast<TdFrame*>(f); }
+
+// Exposition-text encoder — byte-for-byte parity with
+// exporter/textfmt.encode_samples (the differential harness in
+// tests/test_native.py pins it): one HELP/TYPE header per metric in
+// first-seen order, then one `name{labels} value` line per sample.
+// Inputs arrive interned: unique-string tables (uint32-LE packed) plus
+// per-sample int32 codes; `help_uniq` is aligned with the metric table.
+// Code order IS first-seen order (the Python interner assigns codes in
+// encounter order).  Returns a malloc'd buffer (free via td_text_free);
+// nullptr + *out_len = -1 on malformed codes.
+char* td_encode_samples(
+    int64_t n, const char* metric_uniq, int64_t metric_uniq_len,
+    const int32_t* metric_codes, const char* help_uniq, int64_t help_uniq_len,
+    const char* slice_uniq, int64_t slice_uniq_len, const int32_t* slice_codes,
+    const char* host_uniq, int64_t host_uniq_len, const int32_t* host_codes,
+    const char* accel_uniq, int64_t accel_uniq_len, const int32_t* accel_codes,
+    const int64_t* chip_ids, const double* values, int64_t* out_len) {
+  std::vector<std::string> metrics = unpack_strings(metric_uniq, metric_uniq_len);
+  std::vector<std::string> helps = unpack_strings(help_uniq, help_uniq_len);
+  std::vector<std::string> slices = unpack_strings(slice_uniq, slice_uniq_len);
+  std::vector<std::string> hosts = unpack_strings(host_uniq, host_uniq_len);
+  std::vector<std::string> accels = unpack_strings(accel_uniq, accel_uniq_len);
+  for (auto& s : slices) s = escape_label_value(s);
+  for (auto& s : hosts) s = escape_label_value(s);
+  for (auto& s : accels) s = escape_label_value(s);
+  std::vector<std::vector<int64_t>> groups(metrics.size());
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t c = metric_codes[i];
+    if (c < 0 || static_cast<size_t>(c) >= groups.size()) {
+      *out_len = -1;
+      return nullptr;
+    }
+    groups[c].push_back(i);
+  }
+  std::string out;
+  out.reserve(static_cast<size_t>(n) * 96 + metrics.size() * 96);
+  char buf[64];
+  for (size_t m = 0; m < metrics.size(); ++m) {
+    if (groups[m].empty()) continue;  // interner never emits these, be safe
+    const std::string& name = metrics[m];
+    out += "# HELP ";
+    out += name;
+    out.push_back(' ');
+    if (m < helps.size())
+      out += helps[m];
+    else
+      out += "tpudash series";
+    out += "\n# TYPE ";
+    out += name;
+    out += " gauge\n";
+    for (int64_t i : groups[m]) {
+      out += name;
+      out += "{chip_id=\"";
+      std::snprintf(buf, sizeof buf, "%lld",
+                    static_cast<long long>(chip_ids[i]));
+      out += buf;
+      out += "\",slice=\"";
+      int32_t sc = slice_codes[i];
+      if (sc >= 0 && static_cast<size_t>(sc) < slices.size()) out += slices[sc];
+      out += "\",host=\"";
+      int32_t hc = host_codes[i];
+      if (hc >= 0 && static_cast<size_t>(hc) < hosts.size()) out += hosts[hc];
+      out.push_back('"');
+      int32_t ac = accel_codes[i];
+      if (ac >= 0 && static_cast<size_t>(ac) < accels.size() &&
+          !accels[ac].empty()) {
+        out += ",accelerator=\"";
+        out += accels[ac];
+        out.push_back('"');
+      }
+      out += "} ";
+      std::snprintf(buf, sizeof buf, "%.10g", values[i]);
+      out += buf;
+      out.push_back('\n');
+    }
+  }
+  // python builds "\n".join(lines) + "\n": every line above already ends
+  // with '\n', so the shapes agree (empty input → a single '\n')
+  if (out.empty()) out.push_back('\n');
+  char* res = static_cast<char*>(std::malloc(out.size() ? out.size() : 1));
+  if (res == nullptr) {
+    *out_len = -1;
+    return nullptr;
+  }
+  std::memcpy(res, out.data(), out.size());
+  *out_len = static_cast<int64_t>(out.size());
+  return res;
+}
+
+void td_text_free(char* p) { std::free(p); }
+
+// One-pass per-column stats over a row-major float64 matrix.  NaNs are
+// skipped.  zero_excluded[c] != 0 additionally computes zmean excluding
+// exact zeros (normalize.column_average policy).  Outputs per column:
+// mean/mx/mn (NaN when no finite values), zmean (NaN when no nonzero
+// values), count of non-NaN values.
+void td_column_stats(const double* m, int64_t nrows, int64_t ncols,
+                     const uint8_t* zero_excluded, double* mean, double* mx,
+                     double* mn, double* zmean, int64_t* count) {
+  std::vector<double> sum(ncols, 0.0), zsum(ncols, 0.0);
+  std::vector<int64_t> cnt(ncols, 0), zcnt(ncols, 0);
+  std::vector<double> vmax(ncols, -std::numeric_limits<double>::infinity());
+  std::vector<double> vmin(ncols, std::numeric_limits<double>::infinity());
+  for (int64_t r = 0; r < nrows; ++r) {
+    const double* row = m + r * ncols;
+    for (int64_t c = 0; c < ncols; ++c) {
+      double v = row[c];
+      if (std::isnan(v)) continue;
+      sum[c] += v;
+      ++cnt[c];
+      if (v > vmax[c]) vmax[c] = v;
+      if (v < vmin[c]) vmin[c] = v;
+      if (v != 0.0) {
+        zsum[c] += v;
+        ++zcnt[c];
+      }
+    }
+  }
+  for (int64_t c = 0; c < ncols; ++c) {
+    count[c] = cnt[c];
+    mean[c] = cnt[c] > 0 ? sum[c] / cnt[c] : kNaN;
+    mx[c] = cnt[c] > 0 ? vmax[c] : kNaN;
+    mn[c] = cnt[c] > 0 ? vmin[c] : kNaN;
+    if (zero_excluded != nullptr && zero_excluded[c])
+      zmean[c] = zcnt[c] > 0 ? zsum[c] / zcnt[c] : kNaN;
+    else
+      zmean[c] = mean[c];
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,445 @@
+"""Normalization: long samples → wide per-chip table + stats.
+
+Parity with the reference's fetch/normalize stage (app.py:182-223): long-form
+rows pivot to a wide ``device × metric`` table, a derived memory-usage ratio
+is added, and mean/max/min stats are computed over numeric columns.  Beyond
+the reference: rows are keyed by (slice, host, chip) instead of a flat
+gpu_id, extra derived columns convert byte counts to display units, and
+zero-exclusion averaging (reference app.py:341-345, power only) is a general
+policy applied per metric via schema.ZERO_EXCLUDED_METRICS.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+import numpy as np
+import pandas as pd
+
+from tpudash import native, schema
+from tpudash.schema import Sample, SampleBatch
+
+
+class NormalizeError(RuntimeError):
+    pass
+
+
+def to_wide(samples: "list[Sample] | SampleBatch") -> pd.DataFrame:
+    """Pivot long samples into a wide table indexed by chip key.
+
+    Index: "slice/chip" string (sorted by (slice_id, chip_id)).
+    Columns: raw metric columns (float), derived columns, plus identity
+    columns ``slice_id``, ``host``, ``chip_id`` and the accelerator-type
+    pseudo-metric (the reference's card_model column, app.py:191-201).
+
+    Accepts either the Sample-list (pure-Python sources) or the columnar
+    SampleBatch the native frame kernel produces — the batch path skips the
+    dict pivot entirely (rows arrive pre-sorted with a dense float matrix).
+    """
+    if isinstance(samples, SampleBatch):
+        return _batch_to_wide(samples)
+    if not samples:
+        raise NormalizeError("no samples to normalize")
+
+    rows = {}
+    for s in samples:
+        key = s.chip.key
+        row = rows.get(key)
+        if row is None:
+            row = {
+                "slice_id": s.chip.slice_id,
+                "host": s.chip.host,
+                "chip_id": s.chip.chip_id,
+                schema.ACCEL_TYPE: s.accelerator_type,
+            }
+            rows[key] = row
+        row[s.metric] = s.value
+        if s.accelerator_type and not row[schema.ACCEL_TYPE]:
+            row[schema.ACCEL_TYPE] = s.accelerator_type
+
+    df = pd.DataFrame.from_dict(rows, orient="index")
+    df = df.sort_values(["slice_id", "chip_id"])
+    df.index.name = "chip"
+    # identity columns as object dtype, matching the batch path (see
+    # _batch_to_wide): arrow-backed strings pay per-value conversion and
+    # iteration costs on the hot path, and the two paths must produce
+    # frames that compare equal
+    for col in ("slice_id", "host", schema.ACCEL_TYPE):
+        if col in df:
+            df[col] = df[col].astype(object)
+    return _derive(df)
+
+
+def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
+    """Columnar batch → the same wide table shape as the dict pivot.
+
+    Rows arrive sorted by (slice_id, chip_id) and the metric block is one
+    contiguous float64 matrix, so this is a constant number of numpy-level
+    ops regardless of chip count: derived columns are computed straight
+    from matrix slices and the frame is assembled with ONE concat (four
+    identity inserts + per-column derivation profiled as ~20% of the
+    256-chip frame)."""
+    if len(b) == 0:
+        raise NormalizeError("no samples to normalize")
+    metrics = list(b.metrics)
+    mat = b.matrix
+    col_idx = {m: i for i, m in enumerate(metrics)}
+
+    def col(name, default=None):
+        i = col_idx.get(name)
+        if i is None:
+            return default
+        return mat[:, i]
+
+    # same formulas (and NaN semantics) as _derive, in plain numpy
+    derived: dict = {}
+    with np.errstate(invalid="ignore", divide="ignore"):
+        used, total = col(schema.HBM_USED), col(schema.HBM_TOTAL)
+        if used is not None and total is not None:
+            safe_total = np.where(total > 0, total, np.nan)
+            derived[schema.HBM_USAGE_RATIO] = used / safe_total * 100.0
+            derived[schema.HBM_USED_GIB] = used / 1024**3
+        tx, rx = col(schema.ICI_TX), col(schema.ICI_RX)
+        if tx is not None or rx is not None:
+            derived[schema.ICI_TOTAL_GBPS] = (
+                (tx if tx is not None else 0.0)
+                + (rx if rx is not None else 0.0)
+            ) / 1e9
+        tx, rx = col(schema.DCN_TX), col(schema.DCN_RX)
+        if tx is not None or rx is not None:
+            derived[schema.DCN_TOTAL_GBPS] = (
+                (tx if tx is not None else 0.0)
+                + (rx if rx is not None else 0.0)
+            ) / 1e9
+        links = []
+        for d in schema.ICI_LINK_DIRS:
+            raw = col(schema.ICI_LINK_SERIES[d])
+            if raw is not None:
+                gbps = raw / 1e9
+                derived[schema.ICI_LINK_GBPS[d]] = gbps
+                links.append(gbps)
+        if links:
+            # coldest present link per chip; all-NaN rows stay NaN
+            derived[schema.ICI_LINK_MIN_GBPS] = _nanmin_rows(links)
+
+    # derived overwrite same-named source series (see _derive)
+    kept = [m for m in metrics if m not in derived]
+    kept_mat = mat[:, [col_idx[m] for m in kept]] if len(kept) < len(metrics) else mat
+    if derived:
+        data = np.concatenate(
+            [kept_mat, np.column_stack(list(derived.values()))], axis=1
+        )
+    else:
+        data = kept_mat
+    index = pd.Index(b.keys, name="chip")
+    metric_df = pd.DataFrame(
+        data, index=index, columns=kept + list(derived.keys())
+    )
+    # identity columns first, same order the dict pivot produces.  Forced
+    # to object dtype: pandas' arrow-backed string inference would pay a
+    # per-value conversion here AND per-value iteration on every later
+    # .tolist()/.to_numpy() of these columns (profiled ~13k arrow
+    # __iter__ calls per 512-chip frame)
+    ident = pd.DataFrame(
+        {
+            "slice_id": pd.Series(b.slices, index=index, dtype=object),
+            "host": pd.Series(b.hosts, index=index, dtype=object),
+            "chip_id": b.chip_ids.astype(np.int64),
+            schema.ACCEL_TYPE: pd.Series(
+                b.accels, index=index, dtype=object
+            ),
+        },
+        index=index,
+    )
+    return pd.concat([ident, metric_df], axis=1)
+
+
+def _nanmin_rows(cols: "list[np.ndarray]") -> np.ndarray:
+    """Per-row min across columns, ignoring NaN (all-NaN rows → NaN)."""
+    stacked = np.column_stack(cols)
+    with _nanwarn_silenced():
+        return np.nanmin(stacked, axis=1)
+
+
+def _derive(df: pd.DataFrame) -> pd.DataFrame:
+    """Add derived display columns (reference app.py:210-212 for the ratio).
+
+    Derived columns are collected and attached with ONE concat: per-column
+    ``df[new] = ...`` inserts each trigger a block-manager copy, which
+    profiled as ~10% of the 256-chip frame."""
+    derived: dict = {}
+    if schema.HBM_USED in df and schema.HBM_TOTAL in df:
+        total = df[schema.HBM_TOTAL]
+        derived[schema.HBM_USAGE_RATIO] = (
+            df[schema.HBM_USED] / total.where(total > 0) * 100.0
+        )
+        derived[schema.HBM_USED_GIB] = df[schema.HBM_USED] / 1024**3
+    if schema.ICI_TX in df or schema.ICI_RX in df:
+        tx = df.get(schema.ICI_TX, 0.0)
+        rx = df.get(schema.ICI_RX, 0.0)
+        derived[schema.ICI_TOTAL_GBPS] = (tx + rx) / 1e9
+    if schema.DCN_TX in df or schema.DCN_RX in df:
+        tx = df.get(schema.DCN_TX, 0.0)
+        rx = df.get(schema.DCN_RX, 0.0)
+        derived[schema.DCN_TOTAL_GBPS] = (tx + rx) / 1e9
+    links = []
+    for d in schema.ICI_LINK_DIRS:
+        raw = schema.ICI_LINK_SERIES[d]
+        if raw in df:
+            gbps = df[raw].to_numpy(dtype=np.float64) / 1e9
+            derived[schema.ICI_LINK_GBPS[d]] = gbps
+            links.append(gbps)
+    if links:
+        derived[schema.ICI_LINK_MIN_GBPS] = _nanmin_rows(links)
+    if not derived:
+        return df
+    # derived values overwrite same-named source series (the pre-concat
+    # in-place assignment semantics); without the drop, concat would emit
+    # duplicate column labels and crash column_average downstream
+    clash = [c for c in derived if c in df.columns]
+    if clash:
+        df = df.drop(columns=clash)
+    return pd.concat([df, pd.DataFrame(derived, index=df.index)], axis=1)
+
+
+def numeric_columns(df: pd.DataFrame) -> list[str]:
+    """Metric columns eligible for stats — excludes identity and
+    pseudo-metric columns (the reference excludes card_model,
+    app.py:216-221)."""
+    skip = set(schema.NON_NUMERIC_COLUMNS) | set(schema.IDENTITY_COLUMNS)
+    return [c for c in df.columns if c not in skip]
+
+
+def _dense_block(df: pd.DataFrame, cols: list[str]) -> "np.ndarray | None":
+    """The numeric columns as one contiguous float64 matrix, or None when
+    any column needs coercion (legacy mixed-dtype frames)."""
+    if not cols:
+        return None
+    sub = df[cols]
+    if not all(dt.kind in "fi" for dt in sub.dtypes):
+        return None
+    return sub.to_numpy(dtype=np.float64)
+
+
+def dense_block(df: pd.DataFrame) -> "tuple[np.ndarray | None, list[str]]":
+    """(float64 matrix, column names) for the numeric metric columns — the
+    shared per-frame extraction: stats, breakdowns, averages, and heatmap
+    values all read from ONE copy instead of each paying their own pandas
+    column-subset + to_numpy (~3 ms each at 256 chips).  The matrix is None
+    for legacy mixed-dtype frames (callers fall back to per-column
+    coercion)."""
+    cols = numeric_columns(df)
+    return _dense_block(df, cols), cols
+
+
+def block_average(arr: np.ndarray, col_idx: int, column: str) -> "float | None":
+    """column_average over one column of a dense block (same zero-exclusion
+    policy), without touching the DataFrame."""
+    vals = arr[:, col_idx]
+    mask = ~np.isnan(vals)
+    if column in schema.ZERO_EXCLUDED_METRICS:
+        mask &= vals != 0
+    if not mask.any():
+        return None
+    return float(vals[mask].mean())
+
+
+def compute_stats(df: pd.DataFrame, block=None) -> dict:
+    """{metric: {"mean", "max", "min", "p50", "p95"}} over numeric columns
+    (mean/max/min are reference parity, app.py:216-221; the percentiles
+    are the fleet-scale addition — at 256 chips a max hides whether one
+    chip or forty are hot.  Display rounds to 2 dp at app.py:480-481 —
+    rounding is presentation, so it lives in the app layer).  ``block``
+    optionally passes a precomputed :func:`dense_block` result."""
+    arr, cols = block if block is not None else dense_block(df)
+    if arr is not None:
+        if native.is_available():
+            mean, mx, mn, _, count = native.column_stats(arr)
+        else:
+            count = (~np.isnan(arr)).sum(axis=0)
+            with np.errstate(invalid="ignore"), _nanwarn_silenced():
+                mean = np.nanmean(arr, axis=0)
+                mx = np.nanmax(arr, axis=0)
+                mn = np.nanmin(arr, axis=0)
+        pcts = _nan_percentiles(arr, count, (0.5, 0.95))
+        return {
+            c: {
+                "mean": float(mean[i]),
+                "max": float(mx[i]),
+                "min": float(mn[i]),
+                "p50": float(pcts[0, i]),
+                "p95": float(pcts[1, i]),
+            }
+            for i, c in enumerate(cols)
+            if count[i] > 0
+        }
+    stats: dict = {}
+    for col in cols:
+        series = pd.to_numeric(df[col], errors="coerce").dropna()
+        if series.empty:
+            continue
+        stats[col] = {
+            "mean": float(series.mean()),
+            "max": float(series.max()),
+            "min": float(series.min()),
+            "p50": float(series.quantile(0.5)),
+            "p95": float(series.quantile(0.95)),
+        }
+    return stats
+
+
+def _nan_percentiles(
+    arr: np.ndarray, count: np.ndarray, qs: tuple
+) -> np.ndarray:
+    """NaN-aware per-column percentiles, fully vectorized: one C-level
+    sort (NaNs sort last) + take_along_axis interpolation.  numpy's own
+    nanpercentile falls back to a per-column apply_along_axis Python loop
+    whenever any NaN is present — which a mixed-source fleet frame always
+    has — and that would negate the native stats kernel on the hot path.
+    Returns (len(qs), ncols); columns with count==0 yield NaN."""
+    order = np.sort(arr, axis=0)  # NaNs last → first `count` are valid
+    n = np.maximum(count, 1).astype(np.float64)
+    out = np.empty((len(qs), arr.shape[1]))
+    for qi, q in enumerate(qs):
+        pos = (n - 1.0) * q
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.ceil(pos).astype(np.int64)
+        frac = pos - lo
+        v_lo = np.take_along_axis(order, lo[None, :], axis=0)[0]
+        v_hi = np.take_along_axis(order, hi[None, :], axis=0)[0]
+        out[qi] = np.where(count > 0, v_lo * (1.0 - frac) + v_hi * frac, np.nan)
+    return out
+
+
+@contextlib.contextmanager
+def _nanwarn_silenced():
+    """Suppress numpy's all-NaN-slice RuntimeWarning (empty columns are a
+    legal frame state — the stats dict simply omits them)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        yield
+
+
+def column_average(df: pd.DataFrame, column: str) -> float | None:
+    """Average of a column over the (already filtered) table, honoring
+    zero-exclusion policy: for metrics in ZERO_EXCLUDED_METRICS, chips
+    reporting exactly 0 are treated as idle/parked and excluded so they
+    don't drag the mean down (reference app.py:341-345).  Returns None when
+    the column is absent or has no eligible values (the reference renders 0
+    in that case; the app layer makes that call)."""
+    if column not in df:
+        return None
+    col = df[column]
+    if col.dtype.kind in "fi":
+        arr = col.to_numpy(dtype=np.float64)
+        mask = ~np.isnan(arr)
+        if column in schema.ZERO_EXCLUDED_METRICS:
+            mask &= arr != 0
+        if not mask.any():
+            return None
+        return float(arr[mask].mean())
+    series = pd.to_numeric(col, errors="coerce").dropna()
+    if column in schema.ZERO_EXCLUDED_METRICS:
+        series = series[series != 0]
+    if series.empty:
+        return None
+    return float(series.mean())
+
+
+def averages(df: pd.DataFrame) -> dict:
+    """Per-column averages with zero-exclusion policy applied."""
+    return {
+        col: avg
+        for col in numeric_columns(df)
+        if (avg := column_average(df, col)) is not None
+    }
+
+
+def torus_neighbor_keys(
+    df: pd.DataFrame, key: str, fallback_generation: "str | None" = None
+) -> list[str]:
+    """Chip keys sharing ICI links with ``key``'s chip on its slice torus
+    (topology sized to the slice population; bogus chip ids excluded) —
+    shared by the web drill-down and the terminal CLI."""
+    from tpudash.topology import topology_for
+
+    row = df.loc[key]
+    same = df[df["slice_id"] == row["slice_id"]]
+    ids = same["chip_id"].to_numpy()
+    sane = ids[(ids >= 0) & (ids < 16384)]
+    if sane.size == 0:
+        return []
+    accel = row.get(schema.ACCEL_TYPE, "") or fallback_generation
+    topo = topology_for(accel, int(sane.max()) + 1)
+    cid = int(row["chip_id"])
+    if not 0 <= cid < topo.num_chips:
+        return []
+    want = set(topo.neighbors(cid))
+    return [
+        str(k)
+        for k, c in zip(same.index.tolist(), ids.tolist())
+        if c in want
+    ]
+
+
+def chip_links(
+    df: pd.DataFrame, key: str, fallback_generation: "str | None" = None
+) -> list[dict]:
+    """Per-link ICI detail for one chip's drill-down: direction label,
+    measured GB/s (None when the source has no per-link series for that
+    direction), and the chip key on the link's far end.  Empty when the
+    source emits no per-link series at all — capability honesty, the
+    drill-down renders no table rather than an empty one."""
+    from tpudash.topology import topology_for
+
+    present = {
+        d: schema.ICI_LINK_GBPS[d]
+        for d in schema.ICI_LINK_DIRS
+        if schema.ICI_LINK_GBPS[d] in df.columns
+    }
+    if not present:
+        return []
+    row = df.loc[key]
+    same = df[df["slice_id"] == row["slice_id"]]
+    ids = same["chip_id"].to_numpy()
+    sane = ids[(ids >= 0) & (ids < 16384)]
+    if sane.size == 0:
+        return []
+    accel = row.get(schema.ACCEL_TYPE, "") or fallback_generation
+    topo = topology_for(accel, int(sane.max()) + 1)
+    cid = int(row["chip_id"])
+    if not 0 <= cid < topo.num_chips:
+        return []
+    by_id = dict(zip(ids.tolist(), same.index.tolist()))
+    out = []
+    for d, nid in topo.directed_neighbors(cid):
+        col = present.get(d)
+        val = row.get(col) if col else None
+        out.append(
+            {
+                "dir": schema.ICI_LINK_LABELS[d],
+                "gbps": (
+                    round(float(val), 2)
+                    if val is not None and not pd.isna(val)
+                    else None
+                ),
+                "neighbor": str(by_id[nid]) if nid in by_id else None,
+            }
+        )
+    return out
+
+
+def filter_selected(df: pd.DataFrame, selected: list[str]) -> pd.DataFrame:
+    """Restrict the table to the selected chip keys (reference app.py:335),
+    ignoring selections that no longer exist (pruning semantics of
+    app.py:281)."""
+    # select-all fast path FIRST: sync prunes against the index and keeps
+    # the index's own (slice, chip) order, so equal lengths almost always
+    # mean "all chips" — check it before paying 256 hash lookups
+    if len(selected) == len(df.index) and selected == list(df.index):
+        return df
+    present = [k for k in selected if k in df.index]
+    if len(present) == len(df.index) and present == list(df.index):
+        return df
+    return df.loc[present]

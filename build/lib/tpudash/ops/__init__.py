@@ -1,0 +1,18 @@
+"""On-chip probe kernels (JAX/Pallas).
+
+The reference only *consumes* hardware metrics produced by an out-of-repo
+ROCm node exporter (SURVEY.md §2: the amd_gpu_* series are implemented
+elsewhere).  tpudash ships the measurement side too: small, bounded-cost
+probe workloads that measure what the chip can actually do right now —
+MXU throughput (achieved bf16 TFLOP/s → TensorCore-utilization series),
+HBM read-streaming bandwidth (Pallas reduction kernel; a read+write copy
+variant is a secondary probe), and HBM occupancy (allocator stats).
+"""
+
+from tpudash.ops.probes import (  # noqa: F401
+    device_info,
+    hbm_bandwidth_probe,
+    hbm_copy_probe,
+    hbm_memory_stats,
+    matmul_flops_probe,
+)

@@ -1,0 +1,30 @@
+"""Device-mesh utilities and ICI collective probes.
+
+The reference has no distributed backend at all (SURVEY.md §2: its only IPC
+is HTTP GET to Prometheus).  The TPU-native equivalent of its "inter-device"
+story is observational (ICI/DCN bandwidth series) — but to *measure* those
+we need real collectives over a jax Mesh, and the demo workload
+(tpudash.models) trains sharded over the same mesh.  Everything here works
+identically on a virtual 8-device CPU mesh (tests) and a real slice.
+"""
+
+# Lazy re-exports: mesh/collectives import jax at module level, but this
+# package is also on the CLI startup path via parallel.distributed (whose
+# jax use is deliberately lazy) — a jax-free install must still run the
+# dashboard with non-chip sources.
+_LAZY = {
+    "build_mesh": "tpudash.parallel.mesh",
+    "mesh_axes_for": "tpudash.parallel.mesh",
+    "all_gather_bandwidth_probe": "tpudash.parallel.collectives",
+    "ppermute_ring_bandwidth_probe": "tpudash.parallel.collectives",
+    "psum_latency_probe": "tpudash.parallel.collectives",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

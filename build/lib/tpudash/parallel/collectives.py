@@ -1,0 +1,153 @@
+"""ICI collective probes — measure inter-chip bandwidth with XLA collectives.
+
+These produce the tpu_ici_* series when the probe source runs on a
+multi-chip host: a ppermute ring (each chip sends its shard to its +1
+neighbor — pure point-to-point, the per-link number), an all_gather (each
+chip receives (n-1) shards — the bisection-ish number), and a tiny psum
+(latency ceiling).  All are shard_map'd over a named mesh axis so XLA
+lowers them to ICI collectives, and all run unchanged on the virtual CPU
+mesh in tests (bandwidth numbers are then meaningless but the machinery is
+identical).
+
+Timing follows tpudash.ops.probes: scalar host readback as the completion
+barrier, two work multiples, rate on the delta (cancels the fixed
+host↔device round-trip that tunneled platforms add to every call).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudash.ops.probes import ProbeResult, _delta_time, _timed_scalar
+
+shard_map = jax.shard_map
+
+
+def _sharded_ones(mesh: Mesh, axis: str, mb_per_device: int) -> jax.Array:
+    n = mesh.shape[axis]
+    rows_per_dev = max(8, (mb_per_device * 1024 * 1024) // (1024 * 4))
+    x = jnp.ones((n * rows_per_dev, 1024), jnp.float32)
+    return jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_sum_fn(mesh: Mesh, axis: str, reverse: bool = False):
+    """Compiled ring-shift closure, cached per (mesh, axis, direction) so
+    periodic probe cycles hit the jit cache instead of re-tracing every
+    interval.  ``reverse`` shifts −1 instead of +1 — the opposite cable of
+    each chip's axis pair, for direction-resolved link probing."""
+    n = mesh.shape[axis]
+    step = -1 if reverse else 1
+    perm = tuple((i, (i + step) % n) for i in range(n))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def ring_sum(block, k: int):
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+        )
+        def ring(b):
+            def body(_, acc):
+                return lax.ppermute(acc, axis_name=axis, perm=perm)
+
+            return lax.fori_loop(0, k, body, b)
+
+        return jnp.sum(ring(block)[0, :8])
+
+    return ring_sum
+
+
+def ppermute_ring_bandwidth_probe(
+    mesh: Mesh,
+    axis: str = "tp",
+    mb_per_device: int = 64,
+    steps: int = 4,
+    reverse: bool = False,
+) -> ProbeResult:
+    """Ring shift: every chip sends its whole shard to its +1 neighbor
+    (−1 with ``reverse`` — the other cable of the axis pair).  Delta-timed
+    at ``steps`` vs ``3·steps`` shifts; value is per-chip one-way GB/s
+    (the tpu_ici_tx_bytes_per_second feed; per-direction for the
+    tpu_ici_link_* series)."""
+    n = mesh.shape[axis]
+    steps = max(1, steps)
+    x = _sharded_ones(mesh, axis, mb_per_device)
+    ring_sum = _ring_sum_fn(mesh, axis, reverse)
+
+    dt = _delta_time(
+        lambda: ring_sum(x, steps), lambda: ring_sum(x, 3 * steps)
+    )
+    shard_bytes = x.nbytes // n
+    return ProbeResult(
+        value=shard_bytes * (2 * steps) / dt / 1e9,
+        elapsed_s=dt,
+        detail={"axis": axis, "devices": n, "mb_per_device": mb_per_device,
+                "steps": steps, "reverse": reverse},
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _gather_sum_fn(mesh: Mesh, axis: str):
+    """Compiled all-gather closure, cached per (mesh, axis); the two shard
+    sizes the probe uses each get one jit specialization."""
+
+    # check_vma off: the output is replicated along `axis` by construction
+    # (it's an all_gather), which the static checker can't always infer.
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None),
+        out_specs=P(None, None), check_vma=False,
+    )
+    def gather(b):
+        return lax.all_gather(b, axis_name=axis, tiled=True)
+
+    return jax.jit(lambda b: jnp.sum(gather(b)[0, :8]))
+
+
+@functools.lru_cache(maxsize=32)
+def _psum_fn(mesh: Mesh, axis: str):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+    )
+    def inner(b):
+        return b + lax.psum(jnp.sum(b), axis_name=axis)
+
+    return jax.jit(lambda b: jnp.sum(inner(b)[0, :8]))
+
+
+def all_gather_bandwidth_probe(
+    mesh: Mesh, axis: str = "tp", mb_per_device: int = 32
+) -> ProbeResult:
+    """All-gather along the axis: each chip receives (n-1) shards.
+    Delta-timed at shard sizes S vs 3S (fixed overhead is size-independent);
+    value is per-chip rx GB/s (the tpu_ici_rx_bytes_per_second feed)."""
+    n = mesh.shape[axis]
+    fn = _gather_sum_fn(mesh, axis)
+    x1 = _sharded_ones(mesh, axis, mb_per_device)
+    x3 = _sharded_ones(mesh, axis, 3 * mb_per_device)
+    dt = _delta_time(lambda: fn(x1), lambda: fn(x3))
+    extra_bytes = (x3.nbytes - x1.nbytes) // n * (n - 1)
+    return ProbeResult(
+        value=extra_bytes / dt / 1e9,
+        elapsed_s=dt,
+        detail={"axis": axis, "devices": n, "mb_per_device": mb_per_device},
+    )
+
+
+def psum_latency_probe(mesh: Mesh, axis: str = "tp") -> ProbeResult:
+    """Latency ceiling: one psum of a tiny array across the axis, scalar
+    readback included (µs) — an upper bound that contains the host
+    round-trip; trend, not absolute, is the signal."""
+    n = mesh.shape[axis]
+    x = jax.device_put(
+        jnp.ones((n, 128), jnp.float32), NamedSharding(mesh, P(axis, None))
+    )
+    dt = _timed_scalar(_psum_fn(mesh, axis), x)
+    return ProbeResult(
+        value=dt * 1e6,
+        elapsed_s=dt,
+        detail={"axis": axis, "devices": n, "unit": "us"},
+    )

@@ -1,0 +1,92 @@
+"""Multi-host initialization for the workload side.
+
+On a multi-host slice (v5e-256 = 64 hosts), JAX processes must rendezvous
+before any collective program: ``jax.distributed.initialize()`` wires the
+coordination service, after which ``jax.devices()`` spans the whole slice
+and the mesh builders in ``parallel/mesh.py`` shard over every chip —
+collectives ride ICI within the slice exactly as on one host.
+
+On TPU pods the runtime discovers coordinator/process-id/process-count
+automatically (GKE sets the metadata), so ``initialize()`` needs no
+arguments; for manual runs the standard env vars
+(``JAX_COORDINATOR_ADDRESS``, ``JAX_PROCESS_ID``, ``JAX_NUM_PROCESSES``)
+work.  ``maybe_initialize`` is called at PROCESS ENTRY by every CLI
+(``python -m tpudash``, ``tpudash.exporter``, ``tpudash.demo``,
+``tpudash.info``) — it must run before anything queries devices, because
+``jax.distributed.initialize`` refuses to run once the backend is up.
+Single-process runs skip it entirely.
+
+Reference parity note: the reference has no distributed backend at all —
+its only IPC is HTTP to Prometheus (SURVEY.md §5).  This is the TPU-native
+equivalent of the exporter fleet the reference *assumed*: every host runs
+the same exporter; the *metrics* plane needs no collective backend, only
+the *workload* plane does.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def should_initialize(env: "dict | None" = None) -> bool:
+    """True when this looks like one process of a multi-process job."""
+    src = os.environ if env is None else env
+    if src.get("TPUDASH_DISTRIBUTED", "").strip().lower() in ("0", "off", "false"):
+        return False
+    # explicit JAX coordination env (manual launches)
+    if src.get("JAX_COORDINATOR_ADDRESS") or src.get("COORDINATOR_ADDRESS"):
+        return True
+    # TPU pod runtime metadata: single-host VMs also set
+    # TPU_WORKER_HOSTNAMES (e.g. "localhost"), so only a MULTI-entry list
+    # means a multi-process job
+    hostnames = src.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    if src.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    return False
+
+
+def maybe_initialize() -> bool:
+    """Idempotently initialize jax.distributed when ``os.environ`` says
+    this process is part of a multi-host job.  MUST run at process entry,
+    before anything queries devices — ``jax.distributed.initialize``
+    refuses to run once the backend is up (the CLI entry points all call
+    this first).  Returns True when the distributed runtime is (now)
+    initialized, including when a launcher already initialized it.
+    Never raises: a failed rendezvous logs and falls back to
+    single-process behavior so the metrics plane keeps working even when
+    the workload plane cannot."""
+    global _initialized
+    if _initialized:
+        return True
+    # pure-env check first: the kill switch and the common single-process
+    # path stay jax-free (jax is an optional dependency)
+    if not should_initialize():
+        return False
+    try:
+        import jax
+
+        # a SLURM/GKE wrapper may have initialized before us — that's
+        # success, not a failure to re-report every call
+        is_init = getattr(jax.distributed, "is_initialized", None)
+        if callable(is_init) and is_init():
+            _initialized = True
+            return True
+        jax.distributed.initialize()
+        _initialized = True
+        log.info(
+            "jax.distributed initialized: process %d/%d, %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.device_count(),
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — metrics plane must survive
+        log.warning("jax.distributed.initialize failed: %s", e)
+        return False

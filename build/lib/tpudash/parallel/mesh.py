@@ -1,0 +1,44 @@
+"""Mesh construction for probes and the demo workload.
+
+Axis conventions follow the scaling-book recipe: ``dp`` (data), ``tp``
+(tensor/model), optionally ``sp`` (sequence/context).  Collectives along
+``tp``/``sp`` ride ICI within a slice; ``dp`` is the outermost axis so its
+(rarer, gradient-sized) collectives tolerate DCN across slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_axes_for(n_devices: int) -> dict[str, int]:
+    """Default (dp, tp) factorization for n devices: tp gets the largest
+    power-of-two factor ≤ 8 (tensor parallelism wants the fast, small
+    axis), dp the rest."""
+    tp = 1
+    for cand in (8, 4, 2):
+        if n_devices % cand == 0:
+            tp = cand
+            break
+    return {"dp": n_devices // tp, "tp": tp}
+
+
+def build_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a Mesh over the local devices with the given axis sizes.
+
+    axes=None picks mesh_axes_for(len(devices)).  Axis sizes must multiply
+    to the device count (jax requirement — we check early for a clear
+    error).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = mesh_axes_for(len(devices))
+    n = int(np.prod(list(axes.values())))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} require {n} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))

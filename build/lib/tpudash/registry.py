@@ -1,0 +1,157 @@
+"""TPU device-model registry.
+
+TPU-native analogue of the reference's board-ID→model and model→TDP maps
+(`GPU_NAME_RESOLVE` / `GPU_POWER_LIMITS`, reference app.py:26-38) used there
+to resolve gauge axis maxima (reference app.py:234-245).  Here each TPU
+generation carries everything the dashboard needs to scale axes and draw
+topology: HBM capacity (HBM-usage gauge max), nominal board power (power
+gauge max — configurable nominal values, same role as the reference's
+hardcoded 560/750/650 W table), peak bf16 TFLOP/s (for MXU-utilization
+derivation by the probe source), HBM bandwidth, and torus topology shape.
+
+Accelerator-type strings follow the GKE node label
+``cloud.google.com/gke-tpu-accelerator`` (e.g. ``tpu-v5-lite-podslice``),
+playing the role the reference's PCI board IDs (``102-D65209-00`` …) play.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    name: str                 # marketing name, e.g. "v5e"
+    accelerator_types: tuple  # GKE gke-tpu-accelerator label values
+    hbm_gib: float            # per-chip HBM capacity (GiB) → memory gauge max
+    hbm_gbps: float           # per-chip HBM bandwidth (GB/s) → bandwidth gauge max
+    peak_bf16_tflops: float   # per-chip peak bf16 TFLOP/s → MXU util derivation
+    nominal_power_w: float    # per-chip nominal power cap (W) → power gauge max
+    torus_rank: int           # 2 for v5e/v6e (2D torus), 3 for v4/v5p (3D torus)
+    max_chips: int            # max chips in a single slice
+    ici_links_per_chip: int   # ICI link count → per-link bandwidth panels
+    ici_link_gbps: float      # per-link one-way bandwidth (GB/s) → ICI gauge max
+
+
+#: Registry keyed by short generation name.  Capacity/bandwidth/FLOPs figures
+#: follow Google's public TPU system documentation; nominal power is a gauge
+#: ceiling (same role as the reference's GPU_POWER_LIMITS, app.py:33-38), not
+#: a measured TDP, and can be overridden via panel config.
+TPU_GENERATIONS: dict[str, TpuGeneration] = {
+    "v4": TpuGeneration(
+        name="v4",
+        accelerator_types=("tpu-v4-podslice",),
+        hbm_gib=32.0,
+        hbm_gbps=1228.0,
+        peak_bf16_tflops=275.0,
+        nominal_power_w=192.0,
+        torus_rank=3,
+        max_chips=4096,
+        ici_links_per_chip=6,
+        ici_link_gbps=50.0,
+    ),
+    "v5e": TpuGeneration(
+        name="v5e",
+        accelerator_types=("tpu-v5-lite-podslice", "tpu-v5-lite-device"),
+        hbm_gib=16.0,
+        hbm_gbps=819.0,
+        peak_bf16_tflops=197.0,
+        nominal_power_w=150.0,
+        torus_rank=2,
+        max_chips=256,
+        ici_links_per_chip=4,
+        ici_link_gbps=50.0,
+    ),
+    "v5p": TpuGeneration(
+        name="v5p",
+        accelerator_types=("tpu-v5p-slice",),
+        hbm_gib=95.0,
+        hbm_gbps=2765.0,
+        peak_bf16_tflops=459.0,
+        nominal_power_w=280.0,
+        torus_rank=3,
+        max_chips=8960,
+        ici_links_per_chip=6,
+        ici_link_gbps=100.0,
+    ),
+    "v6e": TpuGeneration(
+        name="v6e",
+        accelerator_types=("tpu-v6e-slice",),
+        hbm_gib=32.0,
+        hbm_gbps=1640.0,
+        peak_bf16_tflops=918.0,
+        nominal_power_w=200.0,
+        torus_rank=2,
+        max_chips=256,
+        ici_links_per_chip=4,
+        ici_link_gbps=100.0,
+    ),
+}
+
+#: Fallback power gauge max when the generation is unknown — same role as the
+#: reference's `GPU_POWER_LIMITS.get(..., 300)` default (app.py:38, 240).
+DEFAULT_POWER_W = 300.0
+#: Fallback HBM gauge max (GiB) for unknown generations.
+DEFAULT_HBM_GIB = 16.0
+
+#: accelerator-type label value → generation (the reference's
+#: GPU_NAME_RESOLVE board-ID→name map, app.py:26-30, retargeted).
+_ACCEL_TO_GEN: dict[str, str] = {
+    accel: gen.name
+    for gen in TPU_GENERATIONS.values()
+    for accel in gen.accelerator_types
+}
+
+
+def resolve_generation(label: str | None) -> TpuGeneration | None:
+    """Resolve a generation from a short name ("v5e") or a GKE accelerator
+    label ("tpu-v5-lite-podslice").  Returns None when unmapped — callers fall
+    back to DEFAULT_* ceilings rather than printing "None" in headers (a
+    reference quirk we do not replicate, app.py:415)."""
+    if not label:
+        return None
+    if label in TPU_GENERATIONS:
+        return TPU_GENERATIONS[label]
+    gen_name = _ACCEL_TO_GEN.get(label)
+    if gen_name is not None:
+        return TPU_GENERATIONS[gen_name]
+    # Tolerate e.g. "v5litepod-16" / "v5e-256" style topology strings.
+    low = label.lower()
+    for key in ("v6e", "v5p", "v5e", "v4"):
+        if low.startswith(key) or f"-{key}" in low:
+            return TPU_GENERATIONS[key]
+    if "v5-lite" in low or "v5lite" in low:
+        return TPU_GENERATIONS["v5e"]
+    return None
+
+
+def resolve_generation_from_device_kind(kind: str | None) -> TpuGeneration | None:
+    """Resolve a generation from a jax device_kind string (e.g. "TPU v5
+    lite") — the on-host analogue of the board-ID lookup, used by the
+    probe/workload sources."""
+    low = (kind or "").lower().replace(" ", "")
+    if not low:
+        return None
+    if "v5lite" in low or "v5e" in low:
+        return TPU_GENERATIONS["v5e"]
+    if "v5p" in low or low.endswith("v5"):
+        return TPU_GENERATIONS["v5p"]
+    if "v6" in low:
+        return TPU_GENERATIONS["v6e"]
+    if "v4" in low:
+        return TPU_GENERATIONS["v4"]
+    return None
+
+
+def power_limit_for(label: str | None) -> float:
+    """Power gauge ceiling for a generation/accelerator label (reference
+    `get_power_limit`, app.py:229-232 — there dead code duplicated inline at
+    app.py:238-240; here the single authority used by the viz dispatcher)."""
+    gen = resolve_generation(label)
+    return gen.nominal_power_w if gen else DEFAULT_POWER_W
+
+
+def hbm_limit_for(label: str | None) -> float:
+    """HBM-capacity gauge ceiling (GiB) for a generation/accelerator label."""
+    gen = resolve_generation(label)
+    return gen.hbm_gib if gen else DEFAULT_HBM_GIB

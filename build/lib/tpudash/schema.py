@@ -1,0 +1,409 @@
+"""TPU metric schema.
+
+Replaces the reference's five hardcoded ``amd_gpu_*`` series and their regex
+query (reference app.py:167-176) with the TPU-native series exposed by the
+GKE tpu-device-plugin / ``tpu-info`` / libtpu runtime metrics, plus the
+derived columns the dashboard computes.
+
+Label model: where the reference keys rows by a flat ``gpu_id`` label
+(app.py:183-189), TPU series are keyed by (slice, host, chip) with torus
+topology coordinates — the unit of scale is a pod slice, not a node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+# --- raw series (scraped) ---------------------------------------------------
+#: TensorCore duty cycle, percent [0, 100].
+TENSORCORE_UTIL = "tpu_tensorcore_utilization"
+#: High-bandwidth memory, bytes.
+HBM_USED = "tpu_hbm_used_bytes"
+HBM_TOTAL = "tpu_hbm_total_bytes"
+#: Inter-chip interconnect, aggregate across the chip's links, bytes/s.
+ICI_TX = "tpu_ici_tx_bytes_per_second"
+ICI_RX = "tpu_ici_rx_bytes_per_second"
+#: Cross-slice data-center network (multi-slice), bytes/s.
+DCN_TX = "tpu_dcn_tx_bytes_per_second"
+DCN_RX = "tpu_dcn_rx_bytes_per_second"
+
+# --- per-link ICI detail ----------------------------------------------------
+#: Direction-resolved ICI links.  Aggregate tx/rx says "this chip's ICI is
+#: slow"; lockstep debugging needs "this chip's x− link is cold" — the
+#: failing cable/port, which also names the neighbor on its far end.
+#: Directions are torus axes: xp = x+, xn = x− …; 2D tori (v5e) have
+#: x/y only, 3D (v4/v5p) add z.  Each series is the link's combined
+#: tx+rx rate in bytes/s (per-link counters are symmetric at the torus
+#: level; splitting tx/rx per direction would double 6 columns for no
+#: diagnostic gain — the cold-cable signal is the total).
+ICI_LINK_DIRS: tuple[str, ...] = ("xp", "xn", "yp", "yn", "zp", "zn")
+#: Column-safe dir token → human/axis label ("xp" → "x+").
+ICI_LINK_LABELS: dict[str, str] = {
+    "xp": "x+", "xn": "x-", "yp": "y+", "yn": "y-", "zp": "z+", "zn": "z-",
+}
+#: Raw scraped series per direction, bytes/s.
+ICI_LINK_SERIES: dict[str, str] = {
+    d: f"tpu_ici_link_{d}_bytes_per_second" for d in ICI_LINK_DIRS
+}
+#: Derived display columns per direction, GB/s.
+ICI_LINK_GBPS: dict[str, str] = {
+    d: f"ici_link_{d}_gbps" for d in ICI_LINK_DIRS
+}
+#: Derived min across a chip's present links, GB/s — the "coldest link"
+#: column the fleet heatmap and straggler detection watch.
+ICI_LINK_MIN_GBPS = "ici_link_min_gbps"
+#: Package temperature, °C, and board power, W (where the platform exposes
+#: them; the probe/synthetic sources always do).
+TEMPERATURE = "tpu_temperature_celsius"
+POWER = "tpu_power_watts"
+#: MXU (matrix-unit) utilization percent — the GKE device-plugin's
+#: ``tensorcore_utilization`` series (distinct from the duty cycle: FLOPs
+#: achieved vs time-busy).  Arrives via the compat alias map only.
+MXU_UTIL = "tpu_mxu_utilization"
+#: HBM bandwidth utilization percent — the GKE device-plugin's
+#: ``memory_bandwidth_utilization`` series, via the compat alias map.
+MEMBW_UTIL = "tpu_membw_utilization"
+
+#: The scrape set — role of the reference's 5-series regex (app.py:169-170).
+SCRAPE_SERIES: tuple[str, ...] = (
+    TENSORCORE_UTIL,
+    HBM_USED,
+    HBM_TOTAL,
+    ICI_TX,
+    ICI_RX,
+    *ICI_LINK_SERIES.values(),
+    DCN_TX,
+    DCN_RX,
+    TEMPERATURE,
+    POWER,
+)
+
+# --- derived columns (normalize.py) ----------------------------------------
+#: used/total × 100 — reference's vram_usage_ratio (app.py:210-212).
+HBM_USAGE_RATIO = "hbm_usage_ratio"
+#: HBM used expressed in GiB for display.
+HBM_USED_GIB = "hbm_used_gib"
+#: ICI tx+rx in GB/s for display.
+ICI_TOTAL_GBPS = "ici_total_gbps"
+DCN_TOTAL_GBPS = "dcn_total_gbps"
+
+#: Every derived column normalize.py can add — the canonical list the
+#: /api/schema endpoint publishes (add new derivations HERE too).
+DERIVED_COLUMNS: tuple[str, ...] = (
+    HBM_USAGE_RATIO,
+    HBM_USED_GIB,
+    ICI_TOTAL_GBPS,
+    DCN_TOTAL_GBPS,
+    *ICI_LINK_GBPS.values(),
+    ICI_LINK_MIN_GBPS,
+)
+
+#: Pseudo-metric column carrying the device model string through the wide
+#: table — the reference smuggles ``card_model`` the same way (app.py:191-201).
+ACCEL_TYPE = "accelerator_type"
+
+#: Non-numeric columns excluded from stats (reference app.py:216-221 excludes
+#: card_model).
+NON_NUMERIC_COLUMNS: tuple[str, ...] = (ACCEL_TYPE,)
+
+#: Row-identity columns of the wide table — the canonical list shared by
+#: stats exclusion (normalize.numeric_columns) and /api/schema.
+IDENTITY_COLUMNS: tuple[str, ...] = ("slice_id", "host", "chip_id", ACCEL_TYPE)
+
+#: Metrics whose zero values mean "idle/parked" and are excluded from
+#: averages (reference's zero-exclusion power averaging, app.py:341-345).
+ZERO_EXCLUDED_METRICS: tuple[str, ...] = (POWER,)
+
+
+@dataclass(frozen=True, slots=True)
+class ChipKey:
+    """Identity of one chip: (slice, host, chip) + global dashboard id.
+
+    ``chip_id`` is the flat per-slice index used for topology coordinates and
+    selection state — the role the reference's ``gpu_id`` label plays
+    (app.py:183-189), extended with slice/host scoping for multi-host and
+    multi-slice configs.
+    """
+
+    slice_id: str
+    host: str
+    chip_id: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.slice_id}/{self.chip_id}"
+
+
+@dataclass(frozen=True, slots=True)
+class Sample:
+    """One Prometheus-style instant sample, already label-parsed.
+
+    Mirrors the fields the reference pulls out of
+    ``data.result[].metric{__name__, gpu_id, card_model, instance}`` +
+    ``.value[1]`` (app.py:164, 183-192).
+    """
+
+    metric: str
+    value: float
+    chip: ChipKey
+    accelerator_type: str = ""
+    labels: dict | None = None
+
+
+@dataclass(slots=True)
+class SampleBatch:
+    """Columnar scrape result: one row per chip, one column per metric.
+
+    The native frame kernel (tpudash/native) parses raw payload bytes
+    straight into this shape, skipping per-sample Python objects — the role
+    ``list[Sample]`` plays on the pure-Python path.  Rows are sorted by
+    (slice_id, chip_id); ``matrix`` is float64 with NaN for missing cells.
+    Sources may return either representation; normalize.to_wide accepts both.
+    """
+
+    metrics: list[str]
+    slices: list[str]
+    hosts: list[str]
+    chip_ids: np.ndarray  # int32, shape (nrows,)
+    accels: list[str]
+    matrix: np.ndarray  # float64, shape (nrows, len(metrics))
+    #: per-endpoint errors etc. may be attached by joining sources
+    meta: dict = field(default_factory=dict)
+    _n_samples: "int | None" = None
+
+    def __len__(self) -> int:
+        """Number of samples — parity with len(list[Sample]) so
+        `if not samples` and sample-count assertions behave identically
+        whichever representation a source returns.  Producers (the native
+        parsers, from_samples, concat) record the exact emitted-sample
+        count (including duplicates and NaN-valued samples); for manually
+        constructed batches the non-NaN cell count is the fallback."""
+        if self._n_samples is None:
+            self._n_samples = int(np.count_nonzero(~np.isnan(self.matrix)))
+        return self._n_samples
+
+    @property
+    def nrows(self) -> int:
+        return len(self.slices)
+
+    def __iter__(self):
+        """Iterate as Sample objects — the batch is a drop-in for
+        list[Sample] anywhere sample-level access is needed (slow path;
+        frame rendering never materializes these)."""
+        return iter(self.to_samples())
+
+    @property
+    def keys(self) -> list[str]:
+        return [f"{s}/{c}" for s, c in zip(self.slices, self.chip_ids)]
+
+    def relabel_slice(self, name: str) -> "SampleBatch":
+        """All rows re-labeled to one slice name (multi-source join)."""
+        out = SampleBatch(
+            metrics=list(self.metrics),
+            slices=[name] * len(self.slices),
+            hosts=list(self.hosts),
+            chip_ids=self.chip_ids.copy(),
+            accels=list(self.accels),
+            matrix=self.matrix.copy(),
+            _n_samples=self._n_samples,
+        )
+        return out._sorted()
+
+    def _sorted(self) -> "SampleBatch":
+        order = sorted(
+            range(len(self.slices)),
+            key=lambda i: (self.slices[i], int(self.chip_ids[i])),
+        )
+        if order == list(range(len(order))):
+            return self
+        self.slices = [self.slices[i] for i in order]
+        self.hosts = [self.hosts[i] for i in order]
+        self.accels = [self.accels[i] for i in order]
+        self.chip_ids = self.chip_ids[order]
+        self.matrix = self.matrix[order]
+        return self
+
+    @classmethod
+    def from_samples(cls, samples: "list[Sample]") -> "SampleBatch":
+        """Pivot a Sample list into the columnar shape (same dedup/overwrite
+        semantics as normalize.to_wide's dict pivot)."""
+        metrics: list[str] = []
+        mcol: dict[str, int] = {}
+        rows: dict[tuple, int] = {}
+        slices: list[str] = []
+        hosts: list[str] = []
+        accels: list[str] = []
+        chip_ids: list[int] = []
+        trips: list[tuple] = []
+        for s in samples:
+            ck = (s.chip.slice_id, s.chip.host, s.chip.chip_id)
+            r = rows.get(ck)
+            if r is None:
+                r = rows[ck] = len(slices)
+                slices.append(s.chip.slice_id)
+                hosts.append(s.chip.host)
+                accels.append(s.accelerator_type or "")
+                chip_ids.append(s.chip.chip_id)
+            elif s.accelerator_type and not accels[r]:
+                accels[r] = s.accelerator_type
+            c = mcol.get(s.metric)
+            if c is None:
+                c = mcol[s.metric] = len(metrics)
+                metrics.append(s.metric)
+            trips.append((r, c, s.value))
+        matrix = np.full((len(slices), len(metrics)), np.nan)
+        for r, c, v in trips:
+            matrix[r, c] = v
+        batch = cls(
+            metrics=metrics,
+            slices=slices,
+            hosts=hosts,
+            chip_ids=np.asarray(chip_ids, dtype=np.int64),
+            accels=accels,
+            matrix=matrix,
+            _n_samples=len(samples),
+        )
+        return batch._sorted()
+
+    def to_samples(self) -> "list[Sample]":
+        """Materialize Sample objects (fallback interop path)."""
+        out: list[Sample] = []
+        for r in range(len(self.slices)):
+            chip = ChipKey(
+                slice_id=self.slices[r],
+                host=self.hosts[r],
+                chip_id=int(self.chip_ids[r]),
+            )
+            row = self.matrix[r]
+            for c, metric in enumerate(self.metrics):
+                v = row[c]
+                if np.isnan(v):
+                    continue
+                out.append(
+                    Sample(
+                        metric=metric,
+                        value=float(v),
+                        chip=chip,
+                        accelerator_type=self.accels[r],
+                    )
+                )
+        return out
+
+    @classmethod
+    def concat(cls, batches: "list[SampleBatch]") -> "SampleBatch":
+        """Union of several batches (multi-endpoint join).  Duplicate
+        (slice, host, chip) rows merge; a later batch's non-NaN cells win —
+        the same last-write semantics as the Sample-list pivot."""
+        metrics: list[str] = []
+        mcol: dict[str, int] = {}
+        rows: dict[tuple, int] = {}
+        slices: list[str] = []
+        hosts: list[str] = []
+        accels: list[str] = []
+        chip_ids: list[int] = []
+        chunks: list[tuple] = []  # (row_idx array, col_idx array, matrix)
+        for b in batches:
+            col_idx = np.empty(len(b.metrics), dtype=np.int64)
+            for j, m in enumerate(b.metrics):
+                c = mcol.get(m)
+                if c is None:
+                    c = mcol[m] = len(metrics)
+                    metrics.append(m)
+                col_idx[j] = c
+            row_idx = np.empty(len(b.slices), dtype=np.int64)
+            for i in range(len(b.slices)):
+                ck = (b.slices[i], b.hosts[i], int(b.chip_ids[i]))
+                r = rows.get(ck)
+                if r is None:
+                    r = rows[ck] = len(slices)
+                    slices.append(b.slices[i])
+                    hosts.append(b.hosts[i])
+                    accels.append(b.accels[i])
+                    chip_ids.append(int(b.chip_ids[i]))
+                elif b.accels[i] and not accels[r]:
+                    accels[r] = b.accels[i]
+                row_idx[i] = r
+            chunks.append((row_idx, col_idx, b.matrix))
+        matrix = np.full((len(slices), len(metrics)), np.nan)
+        for row_idx, col_idx, m in chunks:
+            mask = ~np.isnan(m)
+            if mask.all():
+                matrix[np.ix_(row_idx, col_idx)] = m
+            else:
+                sub = matrix[np.ix_(row_idx, col_idx)]
+                sub[mask] = m[mask]
+                matrix[np.ix_(row_idx, col_idx)] = sub
+        batch = cls(
+            metrics=metrics,
+            slices=slices,
+            hosts=hosts,
+            chip_ids=np.asarray(chip_ids, dtype=np.int64),
+            accels=accels,
+            matrix=matrix,
+            _n_samples=sum(len(b) for b in batches),
+        )
+        return batch._sorted()
+
+
+# The four panels every row displays, with their value column and axis-max
+# policy — parity with the reference's panel table (SURVEY.md §2 end;
+# app.py:347-476) retargeted to TPU series.
+@dataclass(frozen=True)
+class PanelSpec:
+    title: str           # per-chip panel title; avg row prefixes "Avg "
+    column: str          # wide-table column to display
+    max_policy: str      # "fixed" | "power" | "hbm" | "ici" | "ici_link" | "hbm_bw"
+    fixed_max: float = 100.0
+    unit: str = "%"
+
+
+PANELS: tuple[PanelSpec, ...] = (
+    PanelSpec("TensorCore Utilization (%)", TENSORCORE_UTIL, "fixed", 100.0, "%"),
+    PanelSpec("HBM Usage (%)", HBM_USAGE_RATIO, "fixed", 100.0, "%"),
+    PanelSpec("Temperature (°C)", TEMPERATURE, "fixed", 100.0, "°C"),
+    PanelSpec("Power Usage (W)", POWER, "power", 300.0, "W"),
+)
+
+#: Achieved HBM streaming bandwidth, GB/s — emitted by the on-chip probe
+#: source (tpudash.sources.probe), not by cluster exporters.
+HBM_BANDWIDTH = "tpu_hbm_bandwidth_gbps"
+
+#: Human help text per series — exporter HELP lines and /api/schema both
+#: read this (single source of truth).
+SERIES_HELP: dict[str, str] = {
+    TENSORCORE_UTIL: "TensorCore duty cycle percent [0,100]",
+    HBM_USED: "High-bandwidth memory used, bytes",
+    HBM_TOTAL: "High-bandwidth memory capacity, bytes",
+    ICI_TX: "Inter-chip interconnect transmit rate",
+    ICI_RX: "Inter-chip interconnect receive rate",
+    DCN_TX: "Cross-slice network transmit rate",
+    DCN_RX: "Cross-slice network receive rate",
+    TEMPERATURE: "Package temperature, degrees Celsius",
+    POWER: "Board power draw, watts",
+    HBM_BANDWIDTH: "Achieved HBM streaming bandwidth, GB/s",
+    MXU_UTIL: "MXU (matrix unit) utilization percent [0,100]",
+    MEMBW_UTIL: "HBM bandwidth utilization percent [0,100]",
+    **{
+        ICI_LINK_SERIES[d]: (
+            f"ICI link {ICI_LINK_LABELS[d]} combined tx+rx rate, bytes/s"
+        )
+        for d in ICI_LINK_DIRS
+    },
+}
+
+#: Extra TPU-native panels (beyond the reference's four) shown when the
+#: source provides the series: aggregate ICI/DCN bandwidth and probe-mode
+#: HBM bandwidth.
+EXTRA_PANELS: tuple[PanelSpec, ...] = (
+    PanelSpec("ICI Bandwidth (GB/s)", ICI_TOTAL_GBPS, "ici", 200.0, "GB/s"),
+    # coldest of the chip's direction-resolved links: the heatmap cell
+    # that names the chip with a failing cable (drill-down names the link)
+    PanelSpec("ICI Min Link (GB/s)", ICI_LINK_MIN_GBPS, "ici_link", 100.0, "GB/s"),
+    PanelSpec("DCN Bandwidth (GB/s)", DCN_TOTAL_GBPS, "fixed", 50.0, "GB/s"),
+    PanelSpec("HBM Bandwidth (GB/s)", HBM_BANDWIDTH, "hbm_bw", 1000.0, "GB/s"),
+    PanelSpec("MXU Utilization (%)", MXU_UTIL, "fixed", 100.0, "%"),
+    PanelSpec("HBM BW Utilization (%)", MEMBW_UTIL, "fixed", 100.0, "%"),
+)

@@ -1,0 +1,121 @@
+"""Metrics sources — the seam the reference never had (SURVEY.md §4, §7.1).
+
+Every source speaks the same protocol (``MetricsSource.fetch() ->
+list[Sample]``), so L2 normalization, L3 figures, and the L4 app are
+identical whether samples come from a live Prometheus in a GKE cluster, a
+static JSON fixture, a synthetic N-chip generator, or live on-chip JAX
+probes.
+"""
+
+from tpudash.sources.base import MetricsSource, SourceError  # noqa: F401
+from tpudash.sources.fixture import FixtureSource, SyntheticSource  # noqa: F401
+from tpudash.sources.prometheus import PrometheusSource  # noqa: F401
+
+
+def unwrap_source(src, cls):
+    """First instance of ``cls`` in a source wrapper chain, or None.
+
+    Walks instance attributes only (``__dict__['inner']``): the wrappers
+    all define ``__getattr__`` fall-through, so a plain getattr would
+    read through to the inner source and loop.  The id-set guards
+    against cycles.  One shared walk — the profile isolation in
+    app/service.py and the replay scrub API both need it."""
+    seen = set()
+    while src is not None and id(src) not in seen:
+        seen.add(id(src))
+        if isinstance(src, cls):
+            return src
+        src = src.__dict__.get("inner")
+    return None
+
+
+def _parse_cold_links(spec: str) -> tuple:
+    """``"17:xn,40:zp"`` → ((17, "xn"), (40, "zp")) for the synthetic
+    source's cold-link injection; bad entries raise (a mistyped drill
+    config should fail loudly, not silently run a healthy fleet)."""
+    from tpudash.schema import ICI_LINK_DIRS
+
+    out = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        chip, _, d = item.partition(":")
+        if d not in ICI_LINK_DIRS:
+            raise ValueError(
+                f"bad cold-link {item!r}: dir must be one of {ICI_LINK_DIRS}"
+            )
+        out.append((int(chip), d))
+    return tuple(out)
+
+
+def make_source(cfg) -> MetricsSource:
+    """Source factory driven by Config.source.  Every source is wrapped in
+    ResilientSource (per-fetch retry/backoff + health tracking,
+    sources/retry.py) unless Config.fetch_retries == 0."""
+    src = _make_source(cfg)
+    record_path = getattr(cfg, "record_path", "")
+    if record_path and cfg.source != "replay":
+        # record inside the retry wrapper: only successful fetches land in
+        # the file, and retried attempts aren't double-recorded.  Never
+        # record a replay — with a stale TPUDASH_RECORD_PATH that would
+        # append the recording onto itself forever.
+        from tpudash.sources.recorder import RecordingSource
+
+        src = RecordingSource(src, record_path)
+    retries = getattr(cfg, "fetch_retries", 0)
+    if retries > 0:
+        from tpudash.sources.retry import ResilientSource, RetryPolicy
+
+        src = ResilientSource(
+            src,
+            RetryPolicy(
+                retries=retries,
+                base_backoff=getattr(cfg, "retry_backoff", 0.25),
+                # a down endpoint must not stall the frame lock past its
+                # slot: stop retrying once the refresh interval is spent
+                frame_budget=getattr(cfg, "refresh_interval", None) or None,
+            ),
+        )
+    return src
+
+
+def _make_source(cfg) -> MetricsSource:
+    kind = cfg.source
+    if kind == "prometheus":
+        return PrometheusSource(cfg)
+    if kind == "fixture":
+        return FixtureSource(cfg.fixture_path)
+    if kind == "synthetic":
+        return SyntheticSource(
+            num_chips=cfg.synthetic_chips,
+            generation=cfg.generation,
+            num_slices=cfg.synthetic_slices,
+            emit_links=cfg.synthetic_links,
+            cold_links=_parse_cold_links(cfg.synthetic_cold_links),
+        )
+    if kind == "scrape":
+        from tpudash.sources.scrape import ScrapeSource
+
+        return ScrapeSource(cfg)
+    if kind == "multi":
+        from tpudash.sources.multi import MultiSource
+
+        return MultiSource(cfg)
+    if kind == "replay":
+        from tpudash.sources.recorder import FileReplaySource
+
+        return FileReplaySource(cfg.replay_path)
+    if kind == "workload":
+        from tpudash.sources.workload import WorkloadSource  # imports jax
+
+        return WorkloadSource(cfg)
+    if kind == "probe":
+        try:
+            from tpudash.sources.probe import ProbeSource  # deferred: imports jax
+        except ImportError as e:
+            raise SourceError(
+                f"probe source unavailable (jax import failed: {e})"
+            ) from e
+        return ProbeSource(cfg)
+    raise ValueError(f"unknown source kind: {kind!r}")

@@ -1,0 +1,212 @@
+"""Source protocol and the Prometheus instant-query JSON parser.
+
+The parser implements exactly the response contract the reference consumes
+(app.py:164, 183-192): ``data.result[].metric{__name__, ...labels}`` +
+``.value == [ts, "str"]`` — retargeted to TPU label names.
+
+Label mapping (TPU series → reference analogue):
+  chip_id       ← gpu_id            (app.py:183-189)
+  accelerator   ← card_model        (app.py:191-201)
+  slice / host  ← (new) multi-host, multi-slice scoping
+  instance      ← instance          (app.py:173-176 node scoping)
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+
+from tpudash import compat, native
+from tpudash.schema import ChipKey, Sample, SampleBatch
+
+
+class SourceError(RuntimeError):
+    """Raised by sources on fetch/parse failure.  The app catches this and
+    renders an error banner while continuing to poll — the reference's
+    `except Exception → st.error → (None, None)` path (app.py:225-227)."""
+
+
+class MetricsSource(abc.ABC):
+    """A provider of instant metric samples for the dashboard."""
+
+    name: str = "source"
+
+    @abc.abstractmethod
+    def fetch(self) -> list[Sample]:
+        """Return the current samples for every chip in scope.
+
+        Raises SourceError on failure.  Never returns partial garbage: a
+        source either yields a parseable sample list or raises.
+        """
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+def parse_json_bytes(data: "bytes | str") -> "SampleBatch | list[Sample]":
+    """Instant-query JSON bytes → samples.
+
+    The single dispatch point between the native frame kernel (fused JSON
+    decode + pivot, tpudash/native) and the pure-Python json.loads →
+    parse_instant_query path.  Raises SourceError on any parse failure.
+    """
+    if native.is_available():
+        try:
+            return native.parse_promjson(data)
+        except native.NativeParseError as e:
+            raise SourceError(str(e)) from e
+    try:
+        payload = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise SourceError(f"invalid JSON: {e}") from e
+    return parse_instant_query(payload)
+
+
+def parse_text_bytes(text: "str | bytes") -> "SampleBatch | list[Sample]":
+    """Prometheus exposition text → samples (native kernel when built,
+    exporter/textfmt fallback).  Raises SourceError on malformed text."""
+    if native.is_available():
+        try:
+            return native.parse_text(text)
+        except native.NativeParseError as e:
+            raise SourceError(
+                f"exporter returned malformed text format: {e}"
+            ) from e
+    from tpudash.exporter.textfmt import TextFormatError, parse_text_format
+
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", errors="replace")
+    try:
+        return parse_text_format(text)
+    except TextFormatError as e:
+        raise SourceError(f"exporter returned malformed text format: {e}") from e
+
+
+def _series_identity(
+    metric: dict, chip_cache: dict, default_slice: str
+) -> "tuple[str, ChipKey, str] | None":
+    """Shared label rules for instant and range parsers: metric-labels dict
+    → (series name, interned ChipKey, accelerator type), or None when the
+    series lacks a name or parseable chip id (skip it, don't fail the
+    scrape).  TPU-native labels win; the reference exporter's gpu_id /
+    card_model / instance shapes (app.py:183-201) and the real GKE
+    tpu-device-plugin / libtpu shapes (tpudash.compat) are accepted as
+    fallbacks, with foreign series names alias-resolved to the canonical
+    schema."""
+    name = metric.get("__name__")
+    if not name:
+        return None
+    ident = compat.resolve_identity(metric, default_slice)
+    if ident is None:
+        return None
+    slice_id, host, chip_id, accel = ident
+    ckey = (slice_id, host, chip_id)
+    chip = chip_cache.get(ckey)
+    if chip is None:
+        chip = chip_cache[ckey] = ChipKey(
+            slice_id=slice_id, host=host, chip_id=chip_id
+        )
+    return compat.canonical_series(name), chip, accel
+
+
+def parse_range_query(
+    payload: dict, default_slice: str = "slice-0"
+) -> list[tuple[float, list[Sample]]]:
+    """Parse a Prometheus ``/api/v1/query_range`` payload into per-timestamp
+    sample lists, sorted by timestamp.
+
+    The range shape differs from the instant shape only in
+    ``result[].values == [[ts, "str"], ...]`` replacing ``.value`` —
+    each (series, ts) pair is parsed with the same label rules as
+    :func:`parse_instant_query`.  Used to backfill the trend history on
+    dashboard startup (the reference keeps no history at all).
+    """
+    if payload.get("status") != "success":
+        raise SourceError(f"prometheus status={payload.get('status')!r}")
+    try:
+        results = payload["data"]["result"]
+    except (KeyError, TypeError) as e:
+        raise SourceError(f"malformed prometheus payload: {e}") from e
+
+    by_ts: dict[float, list[Sample]] = {}
+    chip_cache: dict[tuple, ChipKey] = {}
+    for item in results:
+        values = item.get("values")
+        metric = item.get("metric", {})
+        if not isinstance(values, (list, tuple)):
+            continue
+        # labels are constant per series: parse once, reuse for every point
+        ident = _series_identity(metric, chip_cache, default_slice)
+        if ident is None:
+            continue
+        name, chip, accel = ident
+        for point in values:
+            if not isinstance(point, (list, tuple)) or len(point) != 2:
+                continue
+            try:
+                ts, val = float(point[0]), float(point[1])
+            except (TypeError, ValueError):
+                continue
+            by_ts.setdefault(ts, []).append(
+                Sample(
+                    metric=name,
+                    value=val,
+                    chip=chip,
+                    accelerator_type=accel,
+                    labels=metric,
+                )
+            )
+    return sorted(by_ts.items())
+
+
+def parse_instant_query(payload: dict, default_slice: str = "slice-0") -> list[Sample]:
+    """Parse a Prometheus ``/api/v1/query`` JSON payload into Samples.
+
+    Tolerates both TPU-native labels (chip_id/accelerator/slice/host) and
+    generic exporter labels; skips series without a parseable chip id or
+    value rather than failing the whole scrape (more forgiving than the
+    reference, whose single try/except drops the entire cycle on one bad
+    series, app.py:225-227).
+    """
+    if payload.get("status") != "success":
+        raise SourceError(f"prometheus status={payload.get('status')!r}")
+    try:
+        results = payload["data"]["result"]
+    except (KeyError, TypeError) as e:
+        raise SourceError(f"malformed prometheus payload: {e}") from e
+
+    samples: list[Sample] = []
+    # chips repeat across the ~9 series each emits — intern the ChipKey per
+    # (slice, host, chip) so a 256-chip scrape builds 256 keys, not 2300
+    # (this parse is the hottest stage of the frame at 256 chips)
+    chip_cache: dict[tuple, ChipKey] = {}
+    append = samples.append
+    for item in results:
+        metric = item.get("metric", {})
+        value = item.get("value")
+        if not isinstance(value, (list, tuple)) or len(value) != 2:
+            continue
+        raw_val = value[1]
+        # Python float() accepts underscore-grouped literals ("1_5" → 15)
+        # that Prometheus never emits and the native kernel rejects — skip
+        # them so both parsers drop the same series (differential fuzz)
+        if isinstance(raw_val, str) and "_" in raw_val:
+            continue
+        try:
+            val = float(raw_val)
+        except (TypeError, ValueError):
+            continue
+        ident = _series_identity(metric, chip_cache, default_slice)
+        if ident is None:
+            continue
+        name, chip, accel = ident
+        append(
+            Sample(
+                metric=name,
+                value=val,
+                chip=chip,
+                accelerator_type=accel,
+                labels=metric,
+            )
+        )
+    return samples

@@ -1,0 +1,235 @@
+"""Fixture and synthetic sources — cluster-free operation and testing.
+
+FixtureSource replays a canned ``/api/v1/query`` JSON response from disk
+(BASELINE.json configs[0]: "static Prometheus JSON fixture → panels,
+CPU-only, no cluster").  SyntheticSource fabricates a live-looking N-chip
+slice *in the same payload shape*, so both sources exercise the exact parser
+the real Prometheus source uses (tpudash.sources.base.parse_instant_query —
+the contract from reference app.py:164, 183-192).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+from tpudash.registry import TPU_GENERATIONS, resolve_generation
+from tpudash.schema import (
+    DCN_RX,
+    DCN_TX,
+    HBM_TOTAL,
+    HBM_USED,
+    ICI_RX,
+    ICI_TX,
+    POWER,
+    TEMPERATURE,
+    TENSORCORE_UTIL,
+)
+from tpudash.sources.base import (
+    MetricsSource,
+    SourceError,
+    parse_instant_query,
+    parse_json_bytes,
+)
+
+
+class FixtureSource(MetricsSource):
+    """Replay a Prometheus instant-query JSON file."""
+
+    name = "fixture"
+
+    def __init__(self, path: str):
+        if not path:
+            raise SourceError("fixture source requires a fixture_path")
+        self.path = path
+
+    def fetch(self):
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise SourceError(f"cannot load fixture {self.path!r}: {e}") from e
+        try:
+            samples = parse_json_bytes(data)
+        except SourceError as e:
+            raise SourceError(f"cannot load fixture {self.path!r}: {e}") from e
+        if not samples:
+            raise SourceError(f"fixture {self.path!r} contains no parseable samples")
+        return samples
+
+
+def synthetic_payload(
+    num_chips: int = 256,
+    generation: str = "v5e",
+    t: float | None = None,
+    num_slices: int = 1,
+    chips_per_host: int = 4,
+    idle_chips: tuple = (),
+    emit_dcn: bool | None = None,
+    emit_links: bool = False,
+    cold_links: tuple = (),
+) -> dict:
+    """Build a Prometheus-shaped payload for a synthetic pod slice.
+
+    Values vary smoothly with ``t`` (seconds) so the dashboard looks alive;
+    they are deterministic functions of (chip, t) so tests can pin t.
+    ``idle_chips`` report 0 W power (exercising the zero-exclusion averaging
+    path, reference app.py:341-345) and 0% utilization.  ``emit_dcn``
+    defaults to (num_slices > 1); pass True to model a single slice of a
+    multi-slice deployment whose exporter emits its own DCN counters (the
+    MultiSource join shape).
+
+    ``emit_links=True`` adds direction-resolved per-link ICI series
+    (schema.ICI_LINK_SERIES) for the generation's torus rank — x/y for 2D,
+    x/y/z for 3D.  ``cold_links`` is a tuple of ``(chip_id, dir)`` pairs
+    (dir in schema.ICI_LINK_DIRS) whose link runs at ~8% of nominal: the
+    failing-cable story straggler detection must name.
+    """
+    gen = resolve_generation(generation) or TPU_GENERATIONS["v5e"]
+    accel = gen.accelerator_types[0]
+    if t is None:
+        t = time.time()
+    hbm_total = gen.hbm_gib * 1024**3
+    link_dirs: tuple = ()
+    if emit_links:
+        from tpudash.schema import ICI_LINK_DIRS, ICI_LINK_SERIES
+        from tpudash.topology import topology_for
+
+        rank = topology_for(generation, num_chips).rank
+        link_dirs = tuple(
+            (d, ICI_LINK_SERIES[d])
+            for d in ICI_LINK_DIRS
+            if "xyz".index(d[0]) < rank
+        )
+    cold = set(cold_links)
+    results = []
+
+    def emit(name: str, chip: int, sl: int, value: float) -> None:
+        host = f"host-{sl}-{chip // chips_per_host}"
+        results.append(
+            {
+                "metric": {
+                    "__name__": name,
+                    "chip_id": str(chip),
+                    "slice": f"slice-{sl}",
+                    "host": host,
+                    "instance": f"10.0.{sl}.{chip // chips_per_host}:8431",
+                    "accelerator": accel,
+                },
+                "value": [t, f"{value:.6g}"],
+            }
+        )
+
+    for sl in range(num_slices):
+        for chip in range(num_chips):
+            phase = (chip * 0.7 + sl * 1.3)
+            wave = 0.5 + 0.5 * math.sin(t / 30.0 + phase)
+            idle = chip in idle_chips
+            util = 0.0 if idle else 35.0 + 60.0 * wave
+            emit(TENSORCORE_UTIL, chip, sl, util)
+            emit(HBM_USED, chip, sl, (0.15 + 0.75 * wave) * hbm_total)
+            emit(HBM_TOTAL, chip, sl, hbm_total)
+            emit(ICI_TX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.8)
+            emit(ICI_RX, chip, sl, wave * gen.ici_link_gbps * 1e9 * 0.78)
+            for li, (d, series) in enumerate(link_dirs):
+                # SPMD lockstep moves the SAME bytes on every chip's d-axis
+                # link each step, so link rate is fleet-uniform per
+                # direction (±2% jitter) — exactly why one cold link is an
+                # outlier the straggler detector can name
+                lw = 0.55 + 0.35 * math.sin(t / 30.0 + 0.9 * li)
+                jitter = 1.0 + 0.02 * math.sin(chip * 1.7 + li)
+                rate = lw * jitter * gen.ici_link_gbps * 1e9 * 1.5
+                if (chip, d) in cold:
+                    rate *= 0.08
+                emit(series, chip, sl, rate)
+            if emit_dcn or (emit_dcn is None and num_slices > 1):
+                emit(DCN_TX, chip, sl, wave * 12e9)
+                emit(DCN_RX, chip, sl, wave * 11e9)
+            emit(TEMPERATURE, chip, sl, 35.0 + 45.0 * wave)
+            emit(POWER, chip, sl, 0.0 if idle else gen.nominal_power_w * (0.35 + 0.6 * wave))
+
+    return {"status": "success", "data": {"resultType": "vector", "result": results}}
+
+
+class JsonReplaySource(MetricsSource):
+    """Cycle through pre-serialized instant-query payload *bytes*.
+
+    Models exactly what a production dashboard does each refresh — parse a
+    Prometheus response off the wire — so a frame benchmark over this source
+    charges the real decode cost (native frame kernel when available) and
+    nothing else.  Unlike SyntheticSource, payload fabrication happens once
+    at construction, not per fetch.
+    """
+
+    name = "replay"
+
+    def __init__(self, payloads: list):
+        if not payloads:
+            raise SourceError("replay source needs at least one payload")
+        self.payloads = [
+            p.encode("utf-8") if isinstance(p, str) else p for p in payloads
+        ]
+        self._i = 0
+
+    @classmethod
+    def synthetic(
+        cls,
+        num_chips: int,
+        generation: str = "v5e",
+        frames: int = 8,
+        num_slices: int = 1,
+        emit_links: bool = False,
+    ):
+        """Pre-serialize `frames` synthetic payloads at distinct times."""
+        return cls(
+            [
+                json.dumps(
+                    synthetic_payload(num_chips=num_chips, generation=generation,
+                                      t=1000.0 + 5.0 * i, num_slices=num_slices,
+                                      emit_links=emit_links)
+                )
+                for i in range(frames)
+            ]
+        )
+
+    def fetch(self):
+        data = self.payloads[self._i % len(self.payloads)]
+        self._i += 1
+        return parse_json_bytes(data)
+
+
+class SyntheticSource(MetricsSource):
+    """Live-looking synthetic slice (scale testing without hardware)."""
+
+    name = "synthetic"
+
+    def __init__(
+        self,
+        num_chips: int = 256,
+        generation: str = "v5e",
+        num_slices: int = 1,
+        idle_chips: tuple = (),
+        emit_dcn: bool | None = None,
+        emit_links: bool = False,
+        cold_links: tuple = (),
+    ):
+        self.num_chips = num_chips
+        self.generation = generation
+        self.num_slices = num_slices
+        self.idle_chips = tuple(idle_chips)
+        self.emit_dcn = emit_dcn
+        self.emit_links = emit_links
+        self.cold_links = tuple(cold_links)
+
+    def fetch(self):
+        payload = synthetic_payload(
+            num_chips=self.num_chips,
+            generation=self.generation,
+            num_slices=self.num_slices,
+            idle_chips=self.idle_chips,
+            emit_dcn=self.emit_dcn,
+            emit_links=self.emit_links,
+            cold_links=self.cold_links,
+        )
+        return parse_instant_query(payload)

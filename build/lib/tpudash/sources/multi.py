@@ -1,0 +1,137 @@
+"""Joined multi-endpoint source — the multi-slice (DCN) scrape join.
+
+BASELINE.json configs[4] (multi-slice v5p 2×256) needs series from more
+than one scrape domain: each slice's metrics typically land in its own
+Prometheus (or its own exporter), and the dashboard must render the union
+with unambiguous slice labels.  The reference is single-endpoint by
+construction (one PROMETHEUS_METRICS_ENDPOINT, app.py:22, and a discovery
+trick that scopes it to a single node, app.py:157-164) — this join is the
+capability it could not express (SURVEY.md §7 hard part d).
+
+Endpoint spec syntax (``TPUDASH_MULTI_ENDPOINTS``, comma-separated):
+
+    [slice_name=]url
+
+- ``url`` ending in ``/metrics`` → direct exporter scrape (ScrapeSource);
+  anything else → Prometheus instant-query endpoint (PrometheusSource).
+- ``slice_name=`` relabels every sample's slice id from that child, so two
+  Prometheus servers that both call their local slice ``slice-0`` join
+  without colliding.
+
+Partial-failure policy: one slice's scrape failing must not blank the
+other slices (the reference blanks the whole page on any fetch error,
+app.py:225-227).  fetch() returns the union of the healthy children and
+records per-child errors in ``last_errors``; it raises only when every
+child fails.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from tpudash.config import Config
+from tpudash.schema import SampleBatch
+from tpudash.sources.base import MetricsSource, SourceError
+
+log = logging.getLogger("tpudash.sources.multi")
+
+
+@dataclasses.dataclass(frozen=True)
+class EndpointSpec:
+    url: str
+    slice_name: str | None  # None = keep the child's own slice labels
+
+    @classmethod
+    def parse(cls, item: str) -> "EndpointSpec":
+        item = item.strip()
+        if not item:
+            raise ValueError("empty endpoint spec")
+        slice_name = None
+        if "=" in item.split("://", 1)[0]:  # '=' before the scheme → prefix
+            slice_name, item = item.split("=", 1)
+            slice_name = slice_name.strip()
+        return cls(url=item.strip(), slice_name=slice_name)
+
+
+def parse_endpoints(spec: str) -> list[EndpointSpec]:
+    eps = [EndpointSpec.parse(s) for s in spec.split(",") if s.strip()]
+    if not eps:
+        raise ValueError(
+            "multi source needs TPUDASH_MULTI_ENDPOINTS "
+            "(comma-separated [slice_name=]url)"
+        )
+    return eps
+
+
+def _child_for(ep: EndpointSpec, cfg: Config) -> MetricsSource:
+    if ep.url.rstrip("/").endswith("/metrics"):
+        from tpudash.sources.scrape import ScrapeSource
+
+        return ScrapeSource(dataclasses.replace(cfg, scrape_url=ep.url))
+    from tpudash.sources.prometheus import PrometheusSource
+
+    return PrometheusSource(dataclasses.replace(cfg, prometheus_endpoint=ep.url))
+
+
+class MultiSource(MetricsSource):
+    name = "multi"
+
+    def __init__(self, cfg: Config, children: list | None = None):
+        """children: optional pre-built [(EndpointSpec, MetricsSource)] —
+        tests inject fakes here; production builds from cfg.multi_endpoints."""
+        self.cfg = cfg
+        if children is None:
+            children = [
+                (ep, _child_for(ep, cfg))
+                for ep in parse_endpoints(cfg.multi_endpoints)
+            ]
+        self.children: list = children
+        self.last_errors: dict[str, str] = {}
+
+    def fetch(self):
+        results = []  # per healthy child: list[Sample] or SampleBatch
+        errors: dict[str, str] = {}
+        for ep, child in self.children:
+            label = ep.slice_name or ep.url
+            try:
+                got = child.fetch()
+            except SourceError as e:
+                errors[label] = str(e)
+                log.warning("multi: child %s failed: %s", label, e)
+                continue
+            is_batch = isinstance(got, SampleBatch)
+            if ep.slice_name is not None:
+                child_slices = (
+                    set(got.slices) if is_batch else {s.chip.slice_id for s in got}
+                )
+                if len(child_slices) > 1:
+                    # relabeling a multi-slice child collapses distinct
+                    # (slice, chip) keys onto one name → duplicate rows
+                    log.warning(
+                        "multi: relabeling child %s which emits %d slices "
+                        "%s — chip keys may collide",
+                        label, len(child_slices), sorted(child_slices),
+                    )
+                if is_batch:
+                    got = got.relabel_slice(ep.slice_name)
+                else:
+                    got = [
+                        dataclasses.replace(
+                            s, chip=dataclasses.replace(s.chip, slice_id=ep.slice_name)
+                        )
+                        for s in got
+                    ]
+            results.append(got)
+        self.last_errors = errors
+        if not any(len(r) for r in results):
+            detail = "; ".join(f"{k}: {v}" for k, v in errors.items())
+            raise SourceError(f"all {len(self.children)} endpoints failed: {detail}")
+        if all(isinstance(r, SampleBatch) for r in results):
+            return SampleBatch.concat(results)
+        # mixed representations (e.g. a synthetic child among scrapes):
+        # flatten to the Sample-list path
+        samples: list = []
+        for r in results:
+            samples.extend(r.to_samples() if isinstance(r, SampleBatch) else r)
+        return samples

@@ -1,0 +1,151 @@
+"""Live Prometheus source.
+
+Reproduces the reference's two-query data hot path (app.py:153-227):
+
+  Query A (discovery)  — which targets are in scope.  The reference asks
+    ``kube_pod_info{pod=~".*prometheus.*"}`` and scopes to the single node
+    hosting the Prometheus pod itself (app.py:157-164 — a design quirk that
+    limits the dashboard to one node).  tpudash's primary discovery is a GKE
+    TPU node-pool label selector over ``kube_node_labels`` so one scrape
+    covers an entire pod slice; the reference's pod-colocation trick is kept
+    as an explicit fallback mode for drop-in parity.
+
+  Query B (metrics pull) — one instant query matching all TPU series via
+    ``__name__=~"..."`` (the reference's amd_gpu_* regex, app.py:167-176),
+    optionally instance-scoped to the discovered nodes.
+"""
+
+from __future__ import annotations
+
+import requests
+
+import time
+
+from tpudash import native
+from tpudash.config import Config
+from tpudash.schema import SCRAPE_SERIES
+from tpudash.sources.base import (
+    MetricsSource,
+    SourceError,
+    parse_instant_query,
+    parse_json_bytes,
+    parse_range_query,
+)
+
+
+class PrometheusSource(MetricsSource):
+    name = "prometheus"
+
+    def __init__(self, cfg: Config, session: "requests.Session | None" = None):
+        self.cfg = cfg
+        self.session = session or requests.Session()
+
+    # -- discovery -----------------------------------------------------------
+    def discover_instances(self) -> list[str]:
+        """Return the instance host IPs in scope, [] meaning "no instance
+        filter" (slice-wide scrape configs need no narrowing)."""
+        cfg = self.cfg
+        if cfg.discovery != "podname":
+            # "selector" mode: trust the scrape config; narrowing, if any,
+            # comes from cfg.series_selector matchers on the metrics query.
+            return []
+        # Parity fallback: the reference's prometheus-pod-colocated-node
+        # trick (app.py:157-164).
+        payload = self._get(
+            {"query": f'kube_pod_info{{pod=~".*{cfg.prometheus_podname}.*"}}'}
+        )
+        try:
+            result = payload["data"]["result"]
+            host_ip = result[0]["metric"]["host_ip"]
+        except (KeyError, IndexError, TypeError) as e:
+            raise SourceError(f"discovery query returned no usable host_ip: {e}")
+        return [host_ip]
+
+    # -- metrics pull --------------------------------------------------------
+    def build_query(self, instances: list[str]) -> str:
+        name_re = "|".join(SCRAPE_SERIES)
+        selector = f'__name__=~"{name_re}"'
+        if instances:
+            inst_re = "|".join(f"{ip}:.+" for ip in instances)
+            selector += f', instance=~"{inst_re}"'
+        if self.cfg.series_selector:
+            selector += f", {self.cfg.series_selector}"
+        return f"{{{selector}}}"
+
+    def fetch(self):
+        instances = self.discover_instances()
+        params = {"query": self.build_query(instances)}
+        if native.is_available():
+            # native fast path: JSON decode + label parse + pivot fused in
+            # one pass over the raw response bytes (tpudash/native)
+            samples = parse_json_bytes(self._get_raw(params))
+        else:
+            samples = parse_instant_query(self._get(params))
+        if not samples:
+            raise SourceError(
+                "prometheus returned no parseable TPU series "
+                "(is the tpu exporter scraped?)"
+            )
+        return samples
+
+    # -- history backfill ----------------------------------------------------
+    def range_endpoint(self) -> str:
+        """``/api/v1/query`` → ``/api/v1/query_range`` (same base URL)."""
+        ep = self.cfg.prometheus_endpoint
+        if ep.rstrip("/").endswith("/query"):
+            return ep.rstrip("/") + "_range"
+        return ep.rstrip("/") + "/query_range"
+
+    def fetch_history(self, duration_s: float, step_s: float):
+        """Range-query the last ``duration_s`` seconds at ``step_s``
+        resolution → sorted [(ts, samples)] for trend backfill.  Same
+        series selector as the live fetch, so the trend seed matches what
+        the dashboard will keep appending."""
+        instances = self.discover_instances()
+        end = time.time()
+        params = {
+            "query": self.build_query(instances),
+            "start": f"{end - duration_s:.3f}",
+            "end": f"{end:.3f}",
+            "step": f"{max(1.0, step_s):g}",
+        }
+        try:
+            resp = self.session.get(
+                self.range_endpoint(), params=params, timeout=self.cfg.http_timeout
+            )
+            resp.raise_for_status()
+            payload = resp.json()
+        except requests.RequestException as e:
+            raise SourceError(f"prometheus range query failed: {e}") from e
+        except ValueError as e:
+            raise SourceError(f"prometheus returned invalid JSON: {e}") from e
+        return parse_range_query(payload)
+
+    def _get(self, params: dict) -> dict:
+        try:
+            resp = self.session.get(
+                self.cfg.prometheus_endpoint,
+                params=params,
+                timeout=self.cfg.http_timeout,
+            )
+            resp.raise_for_status()
+            return resp.json()
+        except requests.RequestException as e:
+            raise SourceError(f"prometheus query failed: {e}") from e
+        except ValueError as e:  # json decode
+            raise SourceError(f"prometheus returned invalid JSON: {e}") from e
+
+    def _get_raw(self, params: dict) -> bytes:
+        try:
+            resp = self.session.get(
+                self.cfg.prometheus_endpoint,
+                params=params,
+                timeout=self.cfg.http_timeout,
+            )
+            resp.raise_for_status()
+            return resp.content
+        except requests.RequestException as e:
+            raise SourceError(f"prometheus query failed: {e}") from e
+
+    def close(self) -> None:
+        self.session.close()

@@ -1,0 +1,215 @@
+"""Record/replay: capture live scrapes to disk, play them back later.
+
+Ops tooling the reference never had: debugging a production incident or
+demoing the dashboard should not require the cluster that produced the
+data.  ``TPUDASH_RECORD_PATH`` wraps ANY configured source and appends
+every successful fetch to a JSONL file; ``TPUDASH_SOURCE=replay`` +
+``TPUDASH_REPLAY_PATH`` plays a recording back through the identical
+normalize→render path (looping by default, so the page keeps refreshing).
+
+Snapshots are stored as Prometheus exposition text (exporter/textfmt) —
+the same wire format the exporter emits — so recordings are portable,
+diffable, and parse through the native frame kernel on replay exactly
+like a live scrape would.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+
+from tpudash.schema import SampleBatch
+from tpudash.sources.base import MetricsSource, SourceError, parse_text_bytes
+
+log = logging.getLogger(__name__)
+
+
+class RecordingSource(MetricsSource):
+    """Transparent wrapper: fetch from the inner source, append the
+    snapshot to ``path``, return the samples unchanged.  Failed fetches
+    are not recorded (a replay reproduces the data, not the outages).
+
+    The path is validated at construction (fail fast on a bad
+    TPUDASH_RECORD_PATH); a write failure mid-run (disk full) degrades to
+    a logged warning — the scrape succeeded, the frame must still render."""
+
+    def __init__(self, inner: MetricsSource, path: str):
+        self.inner = inner
+        self.path = path
+        self.name = f"{inner.name}+record"
+        self._write_failed = False
+        #: while True, fetches pass through without appending — the profile
+        #: endpoint's synthetic renders must not land in the recording (a
+        #: replay reproduces monitoring cycles, not profiling bursts)
+        self.paused = False
+        try:
+            with open(path, "a", encoding="utf-8"):
+                pass
+        except OSError as e:
+            raise SourceError(f"cannot record to {path!r}: {e}") from e
+
+    def fetch(self):
+        samples = self.inner.fetch()
+        if self.paused:
+            return samples
+        as_list = (
+            samples.to_samples()
+            if isinstance(samples, SampleBatch)
+            else samples
+        )
+        from tpudash.exporter.textfmt import encode_samples
+
+        rec = {"ts": time.time(), "text": encode_samples(as_list)}
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+            self._write_failed = False
+        except OSError as e:
+            if not self._write_failed:  # log streaks once, not per cycle
+                log.warning("recording write failed (frame unaffected): %s", e)
+            self._write_failed = True
+        return samples
+
+    def __getattr__(self, item):  # health/fetch_history etc. fall through
+        return getattr(self.inner, item)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class FileReplaySource(MetricsSource):
+    """Replay a RecordingSource JSONL, one snapshot per fetch.
+
+    Only byte offsets and timestamps are kept resident (a day-long
+    256-chip recording is gigabytes of exposition text — ~200 KB per
+    snapshot); each fetch seeks and parses ONE line, so memory stays O(1)
+    in recording length.
+
+    Time travel: :meth:`seek` jumps to an index or a recorded timestamp
+    and :attr:`paused` holds the current snapshot instead of advancing —
+    the ``/api/replay`` scrub API steps an incident recording back and
+    forth, the post-mortem tool a live-only dashboard can never be."""
+
+    name = "replay-file"
+
+    #: recorder lines start '{"ts": <float>, ...' (json.dumps key order);
+    #: indexing reads only this prefix, never the ~200 KB text field
+    _TS_RE = re.compile(rb'^\{"ts":\s*([0-9.eE+-]+)')
+
+    def __init__(self, path: str, loop: bool = True):
+        if not path:
+            raise SourceError("replay source requires TPUDASH_REPLAY_PATH")
+        self.path = path
+        offsets = []
+        timestamps = []
+        slow_lines = 0
+        try:
+            with open(path, "rb") as f:
+                pos = 0
+                for line in f:
+                    if line.strip():
+                        offsets.append(pos)
+                        m = self._TS_RE.match(line.lstrip()[:64])
+                        ts = None
+                        if m:
+                            try:
+                                ts = float(m.group(1))
+                            except ValueError:
+                                ts = None
+                        if ts is None:
+                            # post-processed recording (re-ordered keys,
+                            # reformatted): full JSON parse, slow path
+                            slow_lines += 1
+                            try:
+                                ts = float(json.loads(line).get("ts", 0.0))
+                            except (ValueError, TypeError, KeyError):
+                                ts = None
+                        if ts is None:
+                            # keep the list MONOTONE — ts-seek bisects it;
+                            # an interleaved 0.0 would scramble every seek
+                            ts = timestamps[-1] if timestamps else 0.0
+                        timestamps.append(ts)
+                    pos += len(line)
+        except OSError as e:
+            raise SourceError(f"cannot open recording {path!r}: {e}") from e
+        if slow_lines:
+            log.warning(
+                "%d/%d recording lines lacked the fast ts prefix "
+                "(post-processed file?) — indexed via full JSON parse",
+                slow_lines, len(offsets),
+            )
+        if not offsets:
+            raise SourceError(f"recording {path!r} holds no snapshots")
+        self.offsets = offsets
+        self.timestamps = timestamps
+        #: monotone (running-max) view for ts-seek: bisect needs sorted
+        #: input, and a spliced/concatenated recording may jump backwards
+        self._seek_ts = []
+        hi = timestamps[0] if timestamps else 0.0
+        for ts in timestamps:
+            hi = ts if ts > hi else hi
+            self._seek_ts.append(hi)
+        self.loop = loop
+        self._i = 0
+        self._last: "int | None" = None
+        #: hold the current snapshot instead of advancing (scrub mode)
+        self.paused = False
+
+    def __len__(self) -> int:
+        return len(self.offsets)
+
+    def seek(self, index: "int | None" = None, ts: "float | None" = None) -> int:
+        """Jump so the NEXT fetch serves ``index``, or the latest snapshot
+        at-or-before ``ts`` (epoch; before-the-start clamps to 0).  Returns
+        the target index."""
+        if index is None and ts is None:
+            raise ValueError("seek needs index or ts")
+        if index is None:
+            import bisect
+
+            index = max(0, bisect.bisect_right(self._seek_ts, float(ts)) - 1)
+        index = max(0, min(int(index), len(self.offsets) - 1))
+        self._i = index
+        self._last = None  # even when paused, serve the seek target next
+        return index
+
+    def position(self) -> dict:
+        """Where the scrub control sits: last-served index/ts + bounds."""
+        cur = self._last
+        return {
+            "index": cur,
+            "ts": self.timestamps[cur] if cur is not None else None,
+            "total": len(self.offsets),
+            "ts_first": self.timestamps[0],
+            "ts_last": self.timestamps[-1],
+            "loop": self.loop,
+            "paused": self.paused,
+        }
+
+    def fetch(self):
+        if self.paused and self._last is not None:
+            idx = self._last  # hold: re-serve the current snapshot
+        else:
+            if self._i >= len(self.offsets):
+                if not self.loop:
+                    raise SourceError("recording exhausted")
+                self._i = 0
+            idx = self._i
+            self._i = idx + 1
+        self._last = idx
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offsets[idx])
+                line = f.readline()
+        except OSError as e:
+            raise SourceError(f"cannot read recording {self.path!r}: {e}") from e
+        try:
+            rec = json.loads(line)
+            text = rec["text"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            raise SourceError(
+                f"malformed recording line {idx + 1} in {self.path!r}: {e}"
+            ) from e
+        return parse_text_bytes(text)

@@ -1,0 +1,168 @@
+"""Retry, backoff, and source-health tracking.
+
+The reference has no failure handling beyond a catch-all error banner: a
+failed cycle simply waits out the refresh interval and tries again
+(reference app.py:225-227, 333 — no retry, no backoff, no liveness state;
+SURVEY.md §5 "failure detection: limited to the catch-all").  tpudash
+wraps every source in a :class:`ResilientSource` that
+
+- retries transient fetch failures within the same frame (exponential
+  backoff + full jitter, bounded), so a single dropped scrape doesn't
+  blank a 5 s cycle;
+- tracks health (consecutive failures, totals, last success/failure
+  timestamps) and classifies the source ``healthy`` / ``degraded`` /
+  ``down``, surfaced on the frame and ``/healthz`` so an operator — or a
+  Kubernetes liveness probe — can tell a blip from an outage.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+from tpudash.sources.base import MetricsSource, SourceError
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter, bounded per frame."""
+
+    #: extra attempts after the first failure (0 = reference behavior).
+    retries: int = 2
+    #: first backoff, seconds; attempt k sleeps ≤ base * 2**k.
+    base_backoff: float = 0.25
+    #: per-sleep cap, seconds.
+    max_backoff: float = 2.0
+    #: wall-clock budget for the WHOLE fetch (attempts + sleeps), seconds.
+    #: Retries stop once the budget is spent, so a down endpoint with a
+    #: slow HTTP timeout can't stall the frame lock for attempts×timeout
+    #: (make_source sets this to the refresh interval).  None = unbounded.
+    frame_budget: "float | None" = None
+
+    def backoff(self, attempt: int, rng: random.Random | None = None) -> float:
+        cap = min(self.max_backoff, self.base_backoff * (2.0**attempt))
+        return (rng or random).uniform(0.0, cap)
+
+
+class SourceHealth:
+    """Rolling failure counters with a three-state classification."""
+
+    #: consecutive failed fetches before the source is declared down.
+    DOWN_AFTER = 3
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self.total_fetches = 0
+        self.total_failures = 0
+        self.retried_fetches = 0
+        self.consecutive_failures = 0
+        self.last_success_ts: float | None = None
+        self.last_failure_ts: float | None = None
+
+    def record_success(self, retried: bool) -> None:
+        self.total_fetches += 1
+        if retried:
+            self.retried_fetches += 1
+        self.consecutive_failures = 0
+        self.last_success_ts = self._clock()
+
+    def record_failure(self) -> None:
+        self.total_fetches += 1
+        self.total_failures += 1
+        self.consecutive_failures += 1
+        self.last_failure_ts = self._clock()
+
+    def snapshot(self) -> dict:
+        """Counter state for rollback — profiling renders are synthetic
+        load and must not advance the health ledger (app/server.py)."""
+        d = dict(self.__dict__)
+        d.pop("_clock")
+        return d
+
+    def restore(self, snap: dict) -> None:
+        self.__dict__.update(snap)
+
+    @property
+    def status(self) -> str:
+        if self.consecutive_failures >= self.DOWN_AFTER:
+            return "down"
+        if self.consecutive_failures > 0:
+            return "degraded"
+        return "healthy"
+
+    def summary(self) -> dict:
+        return {
+            "status": self.status,
+            "consecutive_failures": self.consecutive_failures,
+            "total_fetches": self.total_fetches,
+            "total_failures": self.total_failures,
+            "retried_fetches": self.retried_fetches,
+            "last_success_ts": self.last_success_ts,
+            "last_failure_ts": self.last_failure_ts,
+        }
+
+
+class ResilientSource(MetricsSource):
+    """Wrap any source with per-fetch retries and health accounting.
+
+    Transparent to the rest of the stack: same ``fetch()`` protocol, same
+    ``SourceError`` on (final) failure, and attribute reads fall through to
+    the inner source so MultiSource's ``last_errors`` partial-degradation
+    channel keeps working.
+    """
+
+    def __init__(
+        self,
+        inner: MetricsSource,
+        policy: RetryPolicy | None = None,
+        sleep=time.sleep,
+        rng: random.Random | None = None,
+    ):
+        self.inner = inner
+        self.policy = policy or RetryPolicy()
+        self.health = SourceHealth()
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self.name = f"{inner.name}+retry"
+
+    def fetch(self):
+        attempts = self.policy.retries + 1
+        budget = self.policy.frame_budget
+        start = time.monotonic()
+        last_exc: Exception | None = None
+        made = 0
+        for attempt in range(attempts):
+            try:
+                samples = self.inner.fetch()
+            except SourceError as e:  # noqa: PERF203 — transient, retryable
+                last_exc = e
+                made = attempt + 1
+                out_of_time = (
+                    budget is not None
+                    and time.monotonic() - start >= budget
+                )
+                if made < attempts and not out_of_time:
+                    self._sleep(self.policy.backoff(attempt, self._rng))
+                    continue
+                break
+            except Exception:
+                # a bug (parser, wrapper) is not a transient scrape failure:
+                # don't retry it, but the health ledger MUST see it — a
+                # crashing source otherwise reports "healthy" forever while
+                # every frame shows the error banner
+                self.health.record_failure()
+                raise
+            self.health.record_success(retried=attempt > 0)
+            return samples
+        self.health.record_failure()
+        raise SourceError(
+            f"{last_exc} (after {made} attempt{'s' if made != 1 else ''})"
+        ) from last_exc
+
+    def __getattr__(self, item):
+        # fall through for inner-source extras (e.g. MultiSource.last_errors)
+        return getattr(self.inner, item)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -1,0 +1,40 @@
+"""Scrape source — consume an exporter's /metrics endpoint directly.
+
+The minimal two-process deployment: ``python -m tpudash.exporter`` on a TPU
+host, dashboard pointed straight at it (TPUDASH_SOURCE=scrape,
+TPUDASH_SCRAPE_URL=http://host:9100/metrics) — the reference's
+exporter→Prometheus→dashboard pipeline (app.py:153-227) minus the
+Prometheus middleman, for single-host setups (BASELINE.json configs[1]).
+"""
+
+from __future__ import annotations
+
+import requests
+
+from tpudash.config import Config
+from tpudash.sources.base import MetricsSource, SourceError, parse_text_bytes
+
+
+class ScrapeSource(MetricsSource):
+    name = "scrape"
+
+    def __init__(self, cfg: Config, session: "requests.Session | None" = None):
+        self.cfg = cfg
+        self.session = session or requests.Session()
+
+    def fetch(self):
+        try:
+            resp = self.session.get(self.cfg.scrape_url, timeout=self.cfg.http_timeout)
+            resp.raise_for_status()
+            text = resp.text
+        except requests.RequestException as e:
+            raise SourceError(f"scrape of {self.cfg.scrape_url} failed: {e}") from e
+        samples = parse_text_bytes(text)
+        if not samples:
+            raise SourceError(
+                f"{self.cfg.scrape_url} exposed no chip-labeled TPU series"
+            )
+        return samples
+
+    def close(self) -> None:
+        self.session.close()

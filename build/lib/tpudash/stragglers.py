@@ -1,0 +1,297 @@
+"""Fleet straggler / outlier detection over the per-chip wide table.
+
+TPU-native rationale: SPMD workloads run every chip in lockstep — each
+collective waits for the slowest participant, so ONE chip with a sagging
+TensorCore duty cycle, a cold ICI link, or a thermal problem gates the
+step time of the whole slice.  At 256 chips nobody spots that one gauge
+by eye (the reference renders a flat gauge row per device and expects the
+operator to stare, app.py:411-476); the heatmap makes it *visible*, this
+module makes it *named*: every frame, each watched metric is scored
+across the fleet and chips that deviate in the bad direction are surfaced
+on the frame, the drill-down, ``/api/stragglers`` and the terminal CLI.
+
+Method: robust modified z-score (Iglewicz–Hoaglin).  For a metric vector
+``x`` over the fleet::
+
+    z_i = (x_i - median(x)) / max(1.4826 * MAD(x), rel_floor * |median|)
+
+MAD (median absolute deviation) is immune to the outliers being hunted —
+a mean/std score would let one very bad chip inflate std and hide itself.
+The ``rel_floor`` term handles the lockstep-typical case MAD == 0 (255
+chips at an identical duty cycle): deviation is then measured relative to
+the median itself, so the 256th chip at 60% against a uniform 95% fleet
+still scores.  Direction matters: low TensorCore/ICI/bandwidth is a
+straggler, high temperature is a thermal outlier; deviation in the
+healthy direction never flags.
+
+Hysteresis mirrors tpudash.alerts: a chip must breach ``for_cycles``
+consecutive frames before it reaches the ``firing`` state, so a single
+noisy scrape names nobody.  Detection presumes outliers are *rare*: when
+more than ``max_fraction`` of the fleet breaches on one metric the fleet
+is bimodal (two jobs, half idle), not straggling, and that metric is
+skipped for the cycle (the situation is visible on the heatmap; flagging
+128 "stragglers" would be noise).
+
+Spec grammar (``TPUDASH_STRAGGLER_RULES``, comma-separated)::
+
+    column [: low|high|both] [@ cycles]
+
+e.g. ``tpu_tensorcore_utilization:low@3, tpu_temperature_celsius:high``.
+Direction defaults from the built-in table (low for throughput-like
+metrics, high for temperature); cycles defaults to 3.  "" = built-in
+watch list; "off" disables detection.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from tpudash import schema
+from tpudash.hysteresis import TrackSet
+
+#: Bad-deviation direction per metric: "low" = below the fleet is bad
+#: (throughput-like: a lagging chip), "high" = above is bad (thermals),
+#: "both" = any skew matters (memory imbalance precedes a one-chip OOM).
+DEFAULT_DIRECTIONS: dict[str, str] = {
+    schema.TENSORCORE_UTIL: "low",
+    schema.MXU_UTIL: "low",
+    schema.MEMBW_UTIL: "low",
+    schema.HBM_BANDWIDTH: "low",
+    schema.ICI_TOTAL_GBPS: "low",
+    schema.DCN_TOTAL_GBPS: "low",
+    schema.TEMPERATURE: "high",
+    schema.POWER: "both",
+    schema.HBM_USAGE_RATIO: "both",
+    **{c: "low" for c in schema.ICI_LINK_GBPS.values()},
+    schema.ICI_LINK_MIN_GBPS: "low",
+}
+
+#: Straggler-entry link label per watched per-link column ("x+", …) —
+#: a breach on one of these names the failing CABLE, not just the chip.
+LINK_COLUMNS: dict[str, str] = {
+    schema.ICI_LINK_GBPS[d]: schema.ICI_LINK_LABELS[d]
+    for d in schema.ICI_LINK_DIRS
+}
+
+#: Built-in watch list: the lockstep-gating metrics plus thermals, and
+#: each direction-resolved ICI link (sources without per-link series just
+#: skip those rules — a skipped metric freezes, never flags).  HBM usage
+#: and power are deliberately NOT watched by default — both skew
+#: legitimately under uneven sharding; opt in via the spec.
+DEFAULT_RULES_SPEC = (
+    "tpu_tensorcore_utilization@3,"
+    "tpu_mxu_utilization@3,"
+    "ici_total_gbps@3,"
+    "tpu_temperature_celsius@3,"
+    + ",".join(f"{c}@3" for c in LINK_COLUMNS)
+)
+
+DIRECTIONS = ("low", "high", "both")
+
+
+@dataclass(frozen=True)
+class StragglerRule:
+    column: str
+    direction: str = "low"
+    for_cycles: int = 3
+
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<column>[A-Za-z_][A-Za-z0-9_]*)\s*"
+    r"(?::\s*(?P<direction>[A-Za-z]+))?\s*"
+    r"(?:@\s*(?P<cycles>[0-9]+))?\s*$"
+)
+
+
+def parse_rules(spec: str) -> list[StragglerRule]:
+    rules = []
+    for item in spec.split(","):
+        if not item.strip():
+            continue
+        m = _RULE_RE.match(item)
+        if not m:
+            raise ValueError(f"bad straggler rule spec: {item!r}")
+        column = m.group("column")
+        direction = (
+            m.group("direction") or DEFAULT_DIRECTIONS.get(column, "low")
+        ).lower()
+        if direction not in DIRECTIONS:
+            raise ValueError(
+                f"bad direction {direction!r} in rule {item!r} "
+                f"(expected one of {DIRECTIONS})"
+            )
+        rules.append(
+            StragglerRule(
+                column=column,
+                direction=direction,
+                for_cycles=int(m.group("cycles") or 3),
+            )
+        )
+    return rules
+
+
+@dataclass
+class StragglerDetector:
+    """Per-frame robust outlier scoring with consecutive-frame hysteresis
+    (state machine in tpudash.hysteresis, shared with AlertEngine): ok →
+    pending (breaching, streak < for_cycles) → firing; any non-breaching
+    frame resets to ok, and chips that leave the table resolve
+    implicitly.  Exception: a metric skipped for a cycle (partial scrape,
+    min_chips, bimodality ceiling) freezes its streaks instead of
+    resolving them — "not evaluated" is not "recovered"."""
+
+    rules: list[StragglerRule]
+    #: modified-z threshold — 3.5 is the classic Iglewicz–Hoaglin cutoff
+    zscore: float = 3.5
+    #: minimum reporting population per metric; below this "the fleet"
+    #: has no meaningful center to deviate from
+    min_chips: int = 8
+    #: breach-fraction ceiling per metric — above it the fleet is bimodal,
+    #: not straggling, and the metric is skipped this cycle
+    max_fraction: float = 0.1
+    #: MAD floor as a fraction of |median| (the lockstep MAD==0 case)
+    rel_floor: float = 0.02
+    clock: "object" = time.time
+    _tracks: TrackSet = field(default_factory=TrackSet)
+
+    @classmethod
+    def from_config(cls, cfg, clock=time.time) -> "StragglerDetector | None":
+        """The one place Config's straggler knobs are interpreted
+        (dashboard service and terminal CLI both call this)."""
+        spec = cfg.straggler_rules.strip()
+        if spec.lower() in ("off", "none", "disabled"):
+            return None
+        return cls(
+            rules=parse_rules(spec or DEFAULT_RULES_SPEC),
+            zscore=cfg.straggler_zscore,
+            min_chips=cfg.straggler_min_chips,
+            max_fraction=cfg.straggler_max_fraction,
+            clock=clock,
+        )
+
+    def evaluate(
+        self, df: pd.DataFrame, block: "tuple | None" = None
+    ) -> list[dict]:
+        """Score all watched metrics across the table (index = chip key).
+
+        ``block`` is the service's shared dense numeric extraction
+        ``(array, columns)`` — pass it to skip per-column pandas casts on
+        the hot path.  Returns firing+pending entries, firing first, then
+        by |z| descending.
+        """
+        now = float(self.clock())
+        arr, cols = block if block is not None else (None, [])
+        col_pos = {c: i for i, c in enumerate(cols)}
+        keys = None  # materialized lazily: breaches are the rare case
+        seen = set()
+        # Metrics NOT evaluated this cycle (column absent after a partial
+        # scrape, population under min_chips, or bimodality ceiling hit).
+        # Their existing streaks are frozen, not resolved: one degraded
+        # scrape must not silently clear a genuinely firing straggler and
+        # force it to re-earn for_cycles from zero.
+        skipped: set[str] = set()
+        #: column -> isnan mask for metrics that WERE evaluated: a tracked
+        #: chip whose cell is NaN this cycle (chip row present, no data —
+        #: same partial-scrape class as a missing column) is frozen too,
+        #: not resolved.  Zero-excluded cells are NOT frozen: 0 W is data
+        #: ("parked"), and a parked chip has genuinely stopped straggling.
+        nan_masks: dict[str, np.ndarray] = {}
+        out = []
+        for rule in self.rules:
+            ci = col_pos.get(rule.column)
+            if ci is not None and arr is not None:
+                values = arr[:, ci]
+            elif rule.column in df.columns and arr is None:
+                # no dense block (direct CLI calls, or mixed-dtype frames
+                # where dense_block degrades to (None, cols)): per-column
+                # coercion fallback, same as compute_stats
+                values = pd.to_numeric(
+                    df[rule.column], errors="coerce"
+                ).to_numpy(dtype=float, na_value=np.nan)
+            else:
+                skipped.add(rule.column)
+                continue
+            isnan = np.isnan(values)
+            nan_masks[rule.column] = isnan
+            eligible = ~isnan
+            # zero-exclusion parity (app.py:341-345): a parked chip at 0 W
+            # is idle, not a straggler, and must not drag the median
+            if rule.column in schema.ZERO_EXCLUDED_METRICS:
+                eligible &= values != 0.0
+            n = int(eligible.sum())
+            if n < self.min_chips:
+                skipped.add(rule.column)
+                continue
+            x = values[eligible]
+            med = float(np.median(x))
+            mad = float(np.median(np.abs(x - med)))
+            scale = max(1.4826 * mad, self.rel_floor * abs(med), 1e-9)
+            z = (x - med) / scale
+            if rule.direction == "low":
+                breach = z <= -self.zscore
+            elif rule.direction == "high":
+                breach = z >= self.zscore
+            else:
+                breach = np.abs(z) >= self.zscore
+            count = int(np.count_nonzero(breach))
+            if count == 0:
+                # genuinely evaluated and clear — tracks may resolve
+                continue
+            if count > max(1, int(self.max_fraction * n)):
+                skipped.add(rule.column)
+                continue
+            if keys is None:
+                keys = np.asarray(df.index, dtype=object)
+            ekeys = keys[eligible]
+            for i in np.nonzero(breach)[0]:
+                chip_key = str(ekeys[i])
+                tkey = (rule.column, chip_key)
+                seen.add(tkey)
+                track, firing = self._tracks.hit(tkey, rule.for_cycles, now)
+                entry = {
+                    "column": rule.column,
+                    "chip": chip_key,
+                    "value": round(float(x[i]), 2),
+                    "median": round(med, 2),
+                    "z": round(float(z[i]), 1),
+                    "direction": rule.direction,
+                    "state": "firing" if firing else "pending",
+                    "since": track.firing_since,
+                    "streak": track.streak,
+                }
+                link = LINK_COLUMNS.get(rule.column)
+                if link is not None:
+                    # name the cable, not just the chip
+                    entry["link"] = link
+                out.append(entry)
+        # implicit resolution for (column, chip) pairs not seen this frame;
+        # pairs under a skipped metric are frozen (counted as seen) so a
+        # degraded cycle neither advances nor resets their streak
+        if skipped:
+            seen.update(k for k, _ in self._tracks.items() if k[0] in skipped)
+        # per-chip freeze: tracked chip present but NaN on an evaluated
+        # metric — no data for that one chip, so its streak holds too
+        if len(self._tracks):
+            pos = None
+            for key, _ in self._tracks.items():
+                col, chip = key
+                if key in seen:
+                    continue
+                mask = nan_masks.get(col)
+                if mask is None:
+                    continue
+                if pos is None:
+                    if keys is None:
+                        keys = np.asarray(df.index, dtype=object)
+                    pos = {str(k): i for i, k in enumerate(keys)}
+                i = pos.get(chip)
+                if i is not None and mask[i]:
+                    seen.add(key)
+        self._tracks.resolve_unseen(seen)
+        out.sort(key=lambda s: (s["state"] != "firing", -abs(s["z"]), s["chip"]))
+        return out

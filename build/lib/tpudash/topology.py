@@ -1,0 +1,201 @@
+"""TPU pod-slice topology model.
+
+New relative to the reference (SURVEY.md §7.4): maps flat chip ids to torus
+coordinates for v4/v5e/v5p/v6e so the UI can render a pod-topology heatmap
+instead of one figure row per device (the reference's per-GPU rows,
+app.py:411-476, are O(N) Plotly figures per refresh and cannot scale to a
+256-chip slice — SURVEY.md §3.2).
+
+Conventions:
+- v5e / v6e slices are 2D toruses up to 16×16 = 256 chips.
+- v4 / v5p slices are 3D toruses (4k-chip scale); the heatmap renders them
+  as a grid of Z-planes, each plane a 2D heatmap.
+- Chip ids are row-major within the slice: id = (z * ny + y) * nx + x.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+from tpudash.registry import TpuGeneration, resolve_generation
+
+
+@dataclass(frozen=True)
+class Topology:
+    generation: str
+    dims: tuple  # (nx, ny) for 2D torus, (nx, ny, nz) for 3D
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def coords(self, chip_id: int) -> tuple:
+        """Row-major chip id → torus coordinates (x, y[, z])."""
+        if not 0 <= chip_id < self.num_chips:
+            raise ValueError(
+                f"chip_id {chip_id} out of range for {self.dims} topology"
+            )
+        nx = self.dims[0]
+        if self.rank == 2:
+            return (chip_id % nx, chip_id // nx)
+        ny = self.dims[1]
+        plane = nx * ny
+        z, rem = divmod(chip_id, plane)
+        return (rem % nx, rem // nx, z)
+
+    def chip_id(self, coords: tuple) -> int:
+        """Torus coordinates → row-major chip id (inverse of coords)."""
+        if len(coords) != self.rank:
+            raise ValueError(f"expected {self.rank} coords, got {coords}")
+        for c, d in zip(coords, self.dims):
+            if not 0 <= c < d:
+                raise ValueError(f"coords {coords} out of range for {self.dims}")
+        nx = self.dims[0]
+        if self.rank == 2:
+            x, y = coords
+            return y * nx + x
+        x, y, z = coords
+        return (z * self.dims[1] + y) * nx + x
+
+    def neighbors(self, chip_id: int) -> list[int]:
+        """Torus neighbors of a chip (±1 with wraparound along each axis) —
+        the chips it shares ICI links with.  Axes of extent 1 contribute no
+        links; extent 2 contributes one (the +1/-1 neighbors coincide)."""
+        c = list(self.coords(chip_id))
+        out: list[int] = []
+        seen = set()
+        for axis, extent in enumerate(self.dims):
+            if extent <= 1:
+                continue
+            for step in (1, -1):
+                n = list(c)
+                n[axis] = (n[axis] + step) % extent
+                nid = self.chip_id(tuple(n))
+                if nid != chip_id and nid not in seen:
+                    seen.add(nid)
+                    out.append(nid)
+        return out
+
+    def directed_neighbors(self, chip_id: int) -> "list[tuple[str, int]]":
+        """Direction-labeled torus neighbors: [("xp", id), ("xn", id), …]
+        using the column-safe tokens of schema.ICI_LINK_DIRS — the far end
+        of each physical ICI link.  Unlike :meth:`neighbors`, extent-2 axes
+        keep BOTH entries (the +1/-1 neighbors coincide but the two
+        directions are distinct cables, and per-link metrics are keyed by
+        direction); extent-1 axes still contribute none."""
+        c = list(self.coords(chip_id))
+        out: list[tuple[str, int]] = []
+        for axis, extent in enumerate(self.dims):
+            if extent <= 1:
+                continue
+            name = "xyz"[axis]
+            for step, sign in ((1, "p"), (-1, "n")):
+                n = list(c)
+                n[axis] = (n[axis] + step) % extent
+                out.append((f"{name}{sign}", self.chip_id(tuple(n))))
+        return out
+
+
+# Published slice shapes (chips) per generation.  v5e slices come in fixed
+# shapes; other counts fall back to the squarest 2D factorization.
+_V5E_SHAPES: dict[int, tuple] = {
+    1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4),
+    32: (4, 8), 64: (8, 8), 128: (8, 16), 256: (16, 16),
+}
+_V4_SHAPES: dict[int, tuple] = {
+    4: (2, 2, 1), 8: (2, 2, 2), 16: (2, 2, 4), 32: (2, 4, 4),
+    64: (4, 4, 4), 128: (4, 4, 8), 256: (4, 8, 8), 512: (8, 8, 8),
+}
+
+
+def _squarest_2d(n: int) -> tuple:
+    best = (1, n)
+    for a in range(1, int(math.isqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return best
+
+
+def _boxiest_3d(n: int) -> tuple:
+    best, best_score = (1, 1, n), n
+    for a in range(1, round(n ** (1 / 3)) + 2):
+        if n % a:
+            continue
+        rem = n // a
+        for b in range(a, int(math.isqrt(rem)) + 1):
+            if rem % b:
+                continue
+            c = rem // b
+            score = c - a  # flatter boxes score worse
+            if score < best_score:
+                best, best_score = (a, b, c), score
+    return best
+
+
+def topology_for(generation: str | TpuGeneration | None, num_chips: int) -> Topology:
+    """Topology for a slice of ``num_chips`` chips of a given generation.
+
+    Unknown generations get a 2D layout (heatmap-friendly).  The exact
+    published slice shapes are used when the count matches; otherwise the
+    squarest factorization, so arbitrary fixture sizes still render.
+    """
+    if num_chips < 1:
+        raise ValueError("num_chips must be >= 1")
+    gen = generation if isinstance(generation, TpuGeneration) else resolve_generation(generation)
+    rank = gen.torus_rank if gen else 2
+    name = gen.name if gen else (generation or "unknown")
+    if rank == 2:
+        dims = _V5E_SHAPES.get(num_chips) or _squarest_2d(num_chips)
+    else:
+        dims = _V4_SHAPES.get(num_chips) or _boxiest_3d(num_chips)
+    return Topology(generation=str(name), dims=tuple(dims))
+
+
+@functools.lru_cache(maxsize=64)
+def grid_layout(topo: Topology) -> tuple:
+    """Cached per-topology grid geometry: (ny, width, cells) where
+    ``cells[chip_id] == (row, col)`` in the rendered 2D grid.  3D toruses
+    are unrolled into Z-planes laid out side by side with a one-column gap
+    between planes.  Heatmaps rebuild every frame; the geometry never
+    changes for a given topology, so it is computed once."""
+    nx = topo.dims[0]
+    ny = topo.dims[1] if topo.rank >= 2 else 1
+    if topo.rank == 2:
+        width = nx
+        cells = tuple(
+            (cid // nx, cid % nx) for cid in range(topo.num_chips)
+        )
+    else:
+        nz = topo.dims[2]
+        width = nz * nx + (nz - 1)  # planes side by side, 1-col gaps
+        plane = nx * ny
+        cells = tuple(
+            ((cid % plane) // nx, (cid // plane) * (nx + 1) + cid % nx)
+            for cid in range(topo.num_chips)
+        )
+    return ny, width, cells
+
+
+def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:
+    """Project per-chip values onto the torus as a 2D grid (list of rows) for
+    the heatmap figure; missing chips and inter-plane gap columns are None
+    (rendered as gaps)."""
+    ny, width, cells = grid_layout(topo)
+    grid = [[None] * width for _ in range(ny)]
+    for cid, v in values.items():
+        if not 0 <= cid < len(cells):
+            raise ValueError(
+                f"chip_id {cid} out of range for {topo.num_chips}-chip topology"
+            )
+        y, x = cells[cid]
+        grid[y][x] = v
+    return grid

@@ -1,0 +1,61 @@
+"""Stage timing — the tracing the reference lacks (SURVEY.md §5).
+
+The north-star metric is scrape→render p50 at 256 chips (BASELINE.json), so
+every frame records per-stage wall times (scrape, normalize, render) and the
+service keeps a rolling window for percentile reporting — surfaced in the
+dashboard's debug sidebar and by bench.py.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from contextlib import contextmanager
+
+
+class StageTimer:
+    """Records named stage durations for the current frame and a rolling
+    history of total frame times."""
+
+    def __init__(self, window: int = 256):
+        self.current: dict[str, float] = {}
+        self.history: deque = deque(maxlen=window)
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.current[name] = self.current.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def start_frame(self) -> None:
+        self.current = {}
+
+    def end_frame(self) -> dict[str, float]:
+        total = sum(self.current.values())
+        frame = dict(self.current, total=total)
+        self.history.append(frame)
+        return frame
+
+    def percentile(self, q: float, key: str = "total") -> float | None:
+        """q in [0,1]; nearest-rank percentile over the rolling window."""
+        vals = sorted(f[key] for f in self.history if key in f)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+        return vals[idx]
+
+    def summary(self) -> dict:
+        out: dict = {"frames": len(self.history)}
+        if self.history:
+            keys = set().union(*(f.keys() for f in self.history))
+            for key in sorted(keys):
+                p50 = self.percentile(0.5, key)
+                p95 = self.percentile(0.95, key)
+                if p50 is not None:
+                    out[key] = {"p50_ms": p50 * 1e3, "p95_ms": p95 * 1e3}
+        return out

@@ -1,0 +1,15 @@
+"""Visualization layer — figure builders emitting plotly.js-compatible JSON.
+
+Figures are plain dicts ``{"data": [...], "layout": {...}}`` that plotly.js
+(or plotly.py, if installed) renders directly.  Building dicts instead of
+``plotly.graph_objects`` keeps L3 a pure function of its inputs — directly
+unit-testable with no plotting dependency, the property SURVEY.md §4 calls
+out as the reference's natural test seam.
+"""
+
+from tpudash.viz.figures import (  # noqa: F401
+    create_gauge,
+    create_horizontal_bar,
+    create_topology_heatmap,
+)
+from tpudash.viz.dispatch import create_visualization, panel_max  # noqa: F401

@@ -1,0 +1,100 @@
+"""Viz dispatcher — style choice + model-aware axis maxima.
+
+Reference behavior (create_visualization, app.py:234-245): pick gauge vs bar
+from session state; for power panels, override max_val with the device
+model's TDP resolved through the board-ID→model→TDP tables.  Differences
+here, per SURVEY.md §7.5 and the documented reference quirks:
+
+- axis-max resolution is a declared per-panel policy (schema.PanelSpec:
+  "fixed" | "power" | "hbm" | "ici" | "hbm_bw") instead of string-matching the panel
+  title on ``"Power Usage (W)"`` (app.py:237);
+- the lookup goes through registry.power_limit_for — the reference's
+  get_power_limit was dead code re-implemented inline (app.py:229-232 vs
+  238-240), a quirk we do not replicate;
+- for averages over mixed selections, the ceiling is the max over selected
+  chips' generations — the reference scales the average-power gauge to the
+  *first selected* device's TDP (app.py:359, 404), which misleads on mixed
+  fleets.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from tpudash import schema
+from tpudash.registry import (
+    DEFAULT_POWER_W,
+    hbm_limit_for,
+    power_limit_for,
+    resolve_generation,
+)
+from tpudash.viz.figures import create_gauge, create_horizontal_bar
+
+
+def panel_max(
+    spec: schema.PanelSpec,
+    accel_types: "list[str] | None" = None,
+) -> float:
+    """Axis maximum for a panel over the given accelerator types (one entry
+    for a per-chip panel; all selected chips' types for an average panel)."""
+    if spec.max_policy == "fixed" or not accel_types:
+        if spec.max_policy == "power" and not accel_types:
+            return DEFAULT_POWER_W
+        return spec.fixed_max
+    if spec.max_policy == "power":
+        return max(power_limit_for(a) for a in accel_types)
+    if spec.max_policy == "hbm":
+        return max(hbm_limit_for(a) for a in accel_types)
+    if spec.max_policy == "ici":
+        limits = []
+        for a in accel_types:
+            gen = resolve_generation(a)
+            if gen:
+                # aggregate tx+rx ceiling across the chip's links
+                limits.append(2 * gen.ici_links_per_chip * gen.ici_link_gbps)
+        return max(limits) if limits else spec.fixed_max
+    if spec.max_policy == "ici_link":
+        # ONE link's combined tx+rx ceiling (per-link panels)
+        limits = [
+            2 * gen.ici_link_gbps
+            for a in accel_types
+            if (gen := resolve_generation(a))
+        ]
+        return max(limits) if limits else spec.fixed_max
+    if spec.max_policy == "hbm_bw":
+        limits = [
+            gen.hbm_gbps for a in accel_types if (gen := resolve_generation(a))
+        ]
+        return max(limits) if limits else spec.fixed_max
+    return spec.fixed_max
+
+
+def create_visualization(
+    value: float,
+    spec: schema.PanelSpec,
+    use_gauge: bool = True,
+    height: int = 400,
+    accel_types: "list[str] | None" = None,
+    title: "str | None" = None,
+) -> dict:
+    """Build the figure for one panel (reference create_visualization,
+    app.py:234-245; the unused ``key`` parameter there is dropped)."""
+    max_val = panel_max(spec, accel_types)
+    builder = create_gauge if use_gauge else create_horizontal_bar
+    return builder(
+        value=value,
+        title=title or spec.title,
+        min_val=0.0,
+        max_val=max_val,
+        height=height,
+    )
+
+
+def accel_types_for(df: pd.DataFrame, keys: "list[str] | None" = None) -> list[str]:
+    """Distinct accelerator types over the given chip keys (or all rows)."""
+    if schema.ACCEL_TYPE not in df:
+        return []
+    col = df[schema.ACCEL_TYPE] if keys is None else df.loc[
+        [k for k in keys if k in df.index], schema.ACCEL_TYPE
+    ]
+    return sorted({a for a in col.tolist() if a})

@@ -1,0 +1,238 @@
+"""Figure builders (pure functions → plotly-JSON dicts).
+
+Gauge and bar reproduce the reference's two visualization styles with the
+shared 5-band color policy:
+- gauge: ``go.Indicator`` mode "gauge+number", linear ticks dtick=max/5,
+  colored value bar with 1-px black outline, 5 pastel background step bands,
+  tight margins (reference create_gauge, app.py:70-103);
+- bar: horizontal ``go.Bar`` width 0.5 with gray 2-px outline, x-range
+  clamped to [min,max], hidden y ticks, 5 translucent band rects layered
+  below (reference create_horizontal_bar, app.py:105-151).
+
+The topology heatmap is the TPU-native addition (SURVEY.md §7.4) that
+carries per-chip detail at 256-chip scale where one-figure-per-chip cannot
+(SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from tpudash.colors import band_steps, color_for_value
+from tpudash.topology import Topology, grid_layout, heatmap_grid
+
+
+@functools.lru_cache(maxsize=64)
+def _hover_prefix_grid(topo: Topology) -> tuple:
+    """Cached per-topology hover prefixes ("chip N (x, y)") projected onto
+    the rendered grid.  The VALUE part of the hover label comes from a
+    ``hovertemplate`` referencing ``%{z}`` instead of a per-frame text
+    grid — so the hover machinery costs nothing per frame and nothing on
+    the delta wire (tpudash.app.delta ships z-matrices only)."""
+    ny, nx, cells = grid_layout(topo)
+    grid = [[""] * nx for _ in range(ny)]
+    for cid in range(topo.num_chips):
+        y, x = cells[cid]
+        grid[y][x] = f"chip {cid} {topo.coords(cid)}"
+    return tuple(tuple(row) for row in grid)
+
+
+def create_gauge(
+    value: float,
+    title: str,
+    min_val: float = 0.0,
+    max_val: float = 100.0,
+    height: int = 400,
+) -> dict:
+    bar_color = color_for_value(value, max_val)
+    return {
+        "data": [
+            {
+                "type": "indicator",
+                "mode": "gauge+number",
+                "value": value,
+                "title": {"text": title, "font": {"size": 16}},
+                "gauge": {
+                    "axis": {
+                        "range": [min_val, max_val],
+                        "dtick": (max_val - min_val) / 5 if max_val > min_val else 1,
+                        "tickwidth": 1,
+                    },
+                    "bar": {
+                        "color": bar_color,
+                        "line": {"color": "black", "width": 1},
+                    },
+                    "steps": band_steps(max_val),
+                },
+            }
+        ],
+        "layout": {
+            "height": height,
+            "margin": {"l": 30, "r": 30, "t": 0, "b": 0},
+        },
+    }
+
+
+def create_horizontal_bar(
+    value: float,
+    title: str,
+    min_val: float = 0.0,
+    max_val: float = 100.0,
+    height: int = 400,
+) -> dict:
+    bar_color = color_for_value(value, max_val)
+    shapes = [
+        {
+            "type": "rect",
+            "x0": step["range"][0],
+            "x1": step["range"][1],
+            "y0": -0.5,
+            "y1": 0.5,
+            "fillcolor": step["color"],
+            "opacity": 0.3,
+            "layer": "below",
+            "line": {"width": 0},
+        }
+        for step in band_steps(max_val)
+    ]
+    return {
+        "data": [
+            {
+                "type": "bar",
+                "orientation": "h",
+                "x": [value],
+                "y": [title],
+                "width": 0.5,
+                "marker": {
+                    "color": bar_color,
+                    "line": {"color": "gray", "width": 2},
+                },
+            }
+        ],
+        "layout": {
+            "title": {"text": title, "font": {"size": 16}},
+            "height": height,
+            "margin": {"l": 30, "r": 30, "t": 40, "b": 20},
+            "xaxis": {"range": [min_val, max_val]},
+            "yaxis": {"showticklabels": False},
+            "shapes": shapes,
+        },
+    }
+
+
+#: Colorscale for heatmaps, matching the 5-band policy's green→red ramp.
+_HEAT_COLORSCALE = [
+    [0.0, "#2ecc71"],
+    [0.2, "#2ecc71"],
+    [0.2, "#a3d977"],
+    [0.4, "#a3d977"],
+    [0.4, "#f1c40f"],
+    [0.6, "#f1c40f"],
+    [0.6, "#e67e22"],
+    [0.8, "#e67e22"],
+    [0.8, "#e74c3c"],
+    [1.0, "#e74c3c"],
+]
+
+
+def create_sparkline(
+    times: list,
+    values: list,
+    title: str,
+    max_val: float = 100.0,
+    height: int = 120,
+    unit: str = "",
+) -> dict:
+    """Compact trend line for one metric's rolling average — history the
+    reference never kept (its panels show only the instant value,
+    SURVEY.md §5 'tracing: absent').  Color follows the latest value's
+    band."""
+    latest = values[-1] if values else 0.0
+    # 2dp: the float32 per-chip ring would otherwise ship values like
+    # 53.33000183105469 — display shows 1dp, the wire pays 3x for noise
+    values = [round(v, 2) for v in values]
+    return {
+        "data": [
+            {
+                "type": "scatter",
+                "mode": "lines",
+                "x": times,
+                "y": values,
+                "line": {"color": color_for_value(latest, max_val), "width": 2},
+                "hoverinfo": "x+y",
+            }
+        ],
+        "layout": {
+            "title": {"text": title, "font": {"size": 12}},
+            "height": height,
+            "margin": {"l": 30, "r": 10, "t": 24, "b": 18},
+            "xaxis": {"showgrid": False, "tickfont": {"size": 9}},
+            "yaxis": {
+                "range": [0, max_val],
+                "tickfont": {"size": 9},
+                "title": {"text": unit, "font": {"size": 9}},
+            },
+        },
+    }
+
+
+def key_grid(topo: Topology, cell_keys: "dict[int, str]") -> list:
+    """chip id → selection key, projected onto the torus grid (the
+    customdata for clickable heatmap cells).  Build ONCE per slice and
+    share across that slice's panel figures."""
+    ny, nx, cells = grid_layout(topo)
+    grid = [[None] * nx for _ in range(ny)]
+    for cid, key in cell_keys.items():
+        if 0 <= cid < len(cells):
+            y, col = cells[cid]
+            grid[y][col] = key
+    return grid
+
+
+def create_topology_heatmap(
+    topo: Topology,
+    values: dict[int, float],
+    title: str,
+    max_val: float = 100.0,
+    height: int = 480,
+    unit: str = "",
+    custom_grid: "list | None" = None,
+) -> dict:
+    """Per-chip values on the slice's torus as one figure.
+
+    One heatmap replaces N gauges: a v5e-256 slice is a single 16×16 grid
+    (3D toruses unroll into Z-planes side by side).  Cell (x, y) is chip
+    (x, y) in torus coordinates; hover text carries chip id and value.
+    ``custom_grid`` (built once per slice via :func:`key_grid`) rides
+    along as customdata so the page can toggle a chip's selection by
+    clicking its cell — including cells of currently-deselected chips.
+    """
+    grid = heatmap_grid(topo, values)
+
+    trace = {
+        "type": "heatmap",
+        "z": grid,
+        "zmin": 0,
+        "zmax": max_val,
+        # static per-topology prefixes + a template pulling the value from
+        # %{z}: hover stays informative with zero per-frame text payload
+        "text": _hover_prefix_grid(topo),
+        "hovertemplate": "%{text}<br>%{z:.1f}" + unit + "<extra></extra>",
+        "colorscale": _HEAT_COLORSCALE,
+        "xgap": 2,
+        "ygap": 2,
+        "colorbar": {"title": {"text": unit}, "thickness": 12},
+    }
+    if custom_grid is not None:
+        trace["customdata"] = custom_grid
+
+    return {
+        "data": [trace],
+        "layout": {
+            "title": {"text": title, "font": {"size": 16}},
+            "height": height,
+            "margin": {"l": 40, "r": 20, "t": 40, "b": 30},
+            "xaxis": {"scaleanchor": "y", "constrain": "domain", "showgrid": False},
+            "yaxis": {"autorange": "reversed", "showgrid": False},
+        },
+    }

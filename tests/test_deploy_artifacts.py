@@ -283,3 +283,29 @@ def test_every_route_is_documented():
         assert f"`{path}`" in doc or f"`{path}?" in doc or path in doc, (
             f"route {path} missing from docs/API.md"
         )
+
+
+def test_wheel_ships_the_native_kernel_source(tmp_path):
+    """The Dockerfile pip-installs the package and THEN compiles the
+    native kernel from the installed tree — so the wheel must carry
+    frame_kernel.cc (setuptools drops non-Python files unless
+    package-data says otherwise; this regressed silently once)."""
+    import glob
+    import subprocess
+    import sys
+    import zipfile
+
+    subprocess.run(
+        [
+            sys.executable, "-m", "pip", "wheel", "--no-deps",
+            "--no-build-isolation", "-w", str(tmp_path), REPO,
+        ],
+        check=True,
+        capture_output=True,
+    )
+    (wheel,) = glob.glob(str(tmp_path / "tpudash-*.whl"))
+    names = zipfile.ZipFile(wheel).namelist()
+    assert any(n.endswith("native/frame_kernel.cc") for n in names), (
+        "wheel lost the native kernel source — check "
+        "[tool.setuptools.package-data] in pyproject.toml"
+    )
