@@ -71,3 +71,20 @@ def test_detects_injected_page_breakage():
         check_delimiters(script + "\nfunction broken() {")
     with pytest.raises(JsSyntaxError):
         check_delimiters(script.replace("function applyFrame(frame) {", "function applyFrame(frame) {{", 1))
+
+
+def test_xss_escape_function_is_pinned():
+    """esc() guards every label interpolated into innerHTML (scraped
+    chip keys, model strings, rule names are untrusted).  It stays
+    hand-written JS (regex replace — a per-char transpiled call would
+    slow every render), so its exact text is pinned: weakening the
+    character class or the entity map must be a conscious, visible diff."""
+    script = _page_script()
+    assert (
+        "const esc = s => String(s).replace(/[&<>\"']/g,\n"
+        "  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','\"':'&quot;',"
+        "\"'\":'&#39;'}[c]));"
+    ) in script
+    # and the sinks that matter actually use it
+    for needle in ("esc(n)", "esc(l.neighbor)", "esc(a.rule)", "esc(key)"):
+        assert needle in script, f"expected {needle} in page JS"
