@@ -141,25 +141,27 @@ class Parser:
                 it = self.expr()
                 self.expect(")")
                 return ("forof", var, it, self.block())
-            var = self.next()[1]
-            self.expect("=")
-            start = self.expr()
-            # optional extra init assignments: `i = 0, i__n = e; ...`
-            # (the transpiler captures counted-loop bounds this way)
+            # comma-separated init assignments in order, e.g.
+            # `i__n = e, i = 0; i < i__n; i++` (the transpiler captures
+            # counted-loop bounds BEFORE zeroing the counter, matching
+            # Python's range()-argument evaluation order)
             inits = []
-            while self.peek()[1] == ",":
-                self.next()
-                extra_var = self.next()[1]
+            while True:
+                name = self.next()[1]
                 self.expect("=")
-                inits.append((extra_var, self.expr()))
+                inits.append((name, self.expr()))
+                if self.peek()[1] != ",":
+                    break
+                self.next()
             self.expect(";")
             cond = self.expr()
             self.expect(";")
-            if self.next()[1] != var:
-                raise JsError("counted loop must increment its own var")
+            var = self.next()[1]
+            if var not in [n for n, _ in inits]:
+                raise JsError("counted loop must increment an init var")
             self.expect("++")
             self.expect(")")
-            return ("for", var, start, inits, cond, self.block())
+            return ("for", var, inits, cond, self.block())
         if text == ";":
             self.next()
             return ("nop",)
@@ -358,10 +360,9 @@ class Interp:
             else:
                 self.run_block(s[3], scope)
         elif op == "for":
-            _, var, start, inits, cond, body = s
-            scope[var] = self.eval(start, scope)
-            for extra_var, extra_expr in inits:
-                scope[extra_var] = self.eval(extra_expr, scope)
+            _, var, inits, cond, body = s
+            for init_var, init_expr in inits:
+                scope[init_var] = self.eval(init_expr, scope)
             while self.truthy(self.eval(cond, scope)):
                 self.run_block(body, scope)
                 scope[var] = scope[var] + 1

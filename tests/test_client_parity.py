@@ -194,11 +194,29 @@ def test_transpiler_counted_and_forof_loops():
         return total
 
     js = transpile_function(fn)
-    # the bound is captured once, as Python's range(len(x)) does — a live
-    # `i < xs.length` would loop forever if the body appends to xs
-    assert "for (i = 0, i__n = xs.length; i < i__n; i++)" in js
+    # the bound is captured once and FIRST, as Python's range(len(x))
+    # does — a live `i < xs.length` would loop forever if the body
+    # appends to xs, and zeroing i before the bound would diverge for
+    # bounds that read i
+    assert "for (i__n = xs.length, i = 0; i < i__n; i++)" in js
     assert 'for (k of ["a", "b"])' in js
     assert "let i, i__n, k, total;" in js
+
+
+def test_transpiler_counted_loop_bound_reads_old_loop_var():
+    """Python evaluates range()'s argument before binding the loop
+    variable; `for i in range(i)` must count to the OLD i."""
+    from tests.jsmini import run_js
+
+    def fn(n):
+        total = 0
+        i = n
+        for i in range(i):
+            total = total + 1
+        return total
+
+    js = transpile_function(fn)
+    assert run_js(js).call("fn", 3) == fn(3) == 3
 
 
 def test_transpiler_rejects_bare_truthiness():

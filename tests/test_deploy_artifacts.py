@@ -291,14 +291,26 @@ def test_wheel_ships_the_native_kernel_source(tmp_path):
     frame_kernel.cc (setuptools drops non-Python files unless
     package-data says otherwise; this regressed silently once)."""
     import glob
+    import shutil
     import subprocess
     import sys
     import zipfile
 
+    # build from a COPY: setuptools drops build/ and *.egg-info/ into the
+    # source tree, which must never dirty the repo from a test run
+    src = tmp_path / "src"
+    src.mkdir()
+    for name in ("pyproject.toml", "README.md", "LICENSE"):
+        shutil.copy(os.path.join(REPO, name), src / name)
+    shutil.copytree(
+        os.path.join(REPO, "tpudash"),
+        src / "tpudash",
+        ignore=shutil.ignore_patterns("__pycache__", "*.so", "*.inc"),
+    )
     subprocess.run(
         [
             sys.executable, "-m", "pip", "wheel", "--no-deps",
-            "--no-build-isolation", "-w", str(tmp_path), REPO,
+            "--no-build-isolation", "-w", str(tmp_path), str(src),
         ],
         check=True,
         capture_output=True,

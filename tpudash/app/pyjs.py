@@ -240,8 +240,11 @@ class _Fn:
             if len(it.args) != 1:
                 raise TranspileError("only range(len(x)) loops supported")
             bound = self.expr(it.args[0])
+            # bound FIRST: Python evaluates range()'s argument before
+            # binding the loop variable, so `for i in range(f(i))` must
+            # read the OLD i — `i = 0` before the bound would diverge
             return (
-                f"for ({var} = 0, {var}__n = {bound}; "
+                f"for ({var}__n = {bound}, {var} = 0; "
                 f"{var} < {var}__n; {var}++)"
             )
         # for x in <array expr>:  →  for-of (loop var hoisted like any

@@ -104,9 +104,13 @@ class DashboardServer:
 
     async def _save_state(self) -> None:
         """Persist the composite checkpoint OFF the event loop — the
-        write is blocking disk I/O and _mutate holds the frame lock."""
+        write is blocking disk I/O and _mutate holds the frame lock.
+        The session snapshot is taken HERE, on the loop: request
+        handlers mutate the SessionStore from the loop, so the executor
+        thread must never iterate it."""
+        snapshot = self.sessions.to_dicts()
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, self.service.save_state)
+        await loop.run_in_executor(None, self.service.save_state, snapshot)
 
     def _entry(self, request: web.Request) -> SessionEntry:
         return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
@@ -1001,11 +1005,10 @@ class DashboardServer:
         if self.service.cfg.state_path:
             # final state snapshot (sessions idle since their last
             # mutation would otherwise persist stale idle ages)
-            async def _save_state(app):
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(None, self.service.save_state)
+            async def _save_state_on_exit(app):
+                await self._save_state()
 
-            app.on_cleanup.append(_save_state)
+            app.on_cleanup.append(_save_state_on_exit)
         return app
 
 

@@ -218,14 +218,17 @@ class DashboardService:
         sessions = self._restored_state_doc.get("sessions")
         return sessions if isinstance(sessions, dict) else {}
 
-    def save_state(self) -> None:
+    def save_state(self, sessions: "dict | None" = None) -> None:
         """Persist the composite state checkpoint: the anonymous default
-        session's UI state, active alert silences, and (when the server
-        registered its provider) the per-browser cookie-session map —
-        atomically.  One file (cfg.state_path), one writer —
-        SelectionState.save wrote only its own keys and would drop the
-        rest.  Blocking disk I/O: the server calls this off the event
-        loop (executor)."""
+        session's UI state, active alert silences, and the per-browser
+        cookie-session map — atomically.  One file (cfg.state_path), one
+        writer — SelectionState.save wrote only its own keys and would
+        drop the rest.
+
+        Blocking disk I/O: the server calls this off the event loop.
+        ``sessions`` must then be the snapshot taken ON the loop before
+        dispatch — calling the provider from the executor thread would
+        iterate the SessionStore while request handlers mutate it."""
         path = self.cfg.state_path
         if not path:
             return
@@ -233,11 +236,13 @@ class DashboardService:
 
         doc = self.state.to_dict()
         doc["silences"] = self.silences.to_dicts()
-        if self.sessions_snapshot is not None:
+        if sessions is None and self.sessions_snapshot is not None:
             try:
-                doc["sessions"] = self.sessions_snapshot()
+                sessions = self.sessions_snapshot()
             except Exception as e:  # noqa: BLE001 — sessions are best-effort
                 log.warning("session snapshot failed: %s", e)
+        if sessions is not None:
+            doc["sessions"] = sessions
         atomic_write_json(path, doc)
 
     def _notify_alert_transitions(self) -> None:
