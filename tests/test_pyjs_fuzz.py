@@ -22,7 +22,7 @@ KEYS = ("a", "b", "c", "x")
 
 def _gen_stmt(rng: random.Random, depth: int, lines: list, indent: str):
     """Append one random statement (possibly nested) to ``lines``."""
-    choice = rng.randrange(8 if depth < 2 else 6)
+    choice = rng.randrange(9 if depth < 2 else 6)
     k = rng.choice(KEYS)
     k2 = rng.choice(KEYS)
     c = rng.randrange(-3, 10)
@@ -58,9 +58,19 @@ def _gen_stmt(rng: random.Random, depth: int, lines: list, indent: str):
         # nested block
         lines.append(f'{indent}if "{k}" in d and "{k2}" in d:')
         _gen_stmt(rng, depth + 1, lines, indent + "    ")
-    else:
+    elif choice == 7:
+        # append to the list being counted over: Python's range(len(acc))
+        # snapshots the bound, so this terminates — a transpiler that
+        # re-reads the length loops forever (caught a real bug)
         lines.append(f"{indent}for j in range(len(acc)):")
-        _gen_stmt(rng, depth + 1, lines, indent + "    ")
+        lines.append(f"{indent}    acc.append(acc[j] + {c})")
+    else:
+        # bound reads the loop variable itself: range()'s argument is
+        # evaluated BEFORE the loop var is rebound (caught a real bug in
+        # the fix for the case above)
+        lines.append(f"{indent}i2 = {rng.randrange(0, 4)}")
+        lines.append(f"{indent}for i2 in range(i2):")
+        lines.append(f"{indent}    total = total + i2")
 
 
 def _gen_program(rng: random.Random, name: str) -> str:
