@@ -565,3 +565,71 @@ def test_encode_empty_parity():
     from tpudash.exporter.textfmt import encode_samples_py
 
     assert native.encode_samples([]) == encode_samples_py([])
+
+
+def test_fuzz_truncated_and_mutated_payload_bytes():
+    """Byte-level adversarial input: random truncations and single-byte
+    corruptions of valid payloads.  The C++ parser must never over-read
+    (a segfault kills the test run), and must stay in agreement with the
+    Python path: clean NativeParseError where Python raises/yields
+    nothing, identical frames where Python still parses."""
+    import random
+
+    rng = random.Random(0xBADF00D)
+    base = json.dumps(_fuzz_payload(random.Random(7))).encode()
+    cases = []
+    for _ in range(150):
+        cases.append(base[: rng.randrange(0, len(base) + 1)])  # truncation
+    for _ in range(150):
+        b = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            b[rng.randrange(len(b))] = rng.randrange(256)  # corruption
+        cases.append(bytes(b))
+    survived = 0
+    for case_i, raw in enumerate(cases):
+        try:
+            py_samples = parse_instant_query(json.loads(raw))
+        except Exception:
+            py_samples = None  # python rejects: native may too
+        try:
+            batch = native.parse_promjson(raw)
+        except native.NativeParseError:
+            assert not py_samples, (
+                f"case {case_i}: native rejected bytes python parsed"
+            )
+            continue
+        except UnicodeDecodeError:
+            continue  # ctypes marshalling of undecodable bytes
+        if py_samples:
+            assert_frames_equal(batch, to_wide(py_samples))
+            survived += 1
+    assert survived > 0  # some corruptions must still parse (coverage)
+
+
+def test_fuzz_unicode_labels_roundtrip():
+    """Multibyte UTF-8 and escape-heavy labels through the native JSON
+    parser: chip keys and hosts are untrusted strings."""
+    payload = {
+        "status": "success",
+        "data": {
+            "result": [
+                {
+                    "metric": {
+                        "__name__": "tpu_power_watts",
+                        "chip_id": "0",
+                        "slice": "slice-ü中文",
+                        "host": "h-\U0001f525\"quoted\"",
+                        "accelerator": "tpu-v5-lite-podslice",
+                    },
+                    "value": [1000, "42.5"],
+                }
+            ]
+        },
+    }
+    raw = json.dumps(payload)  # \uXXXX escapes
+    raw_utf8 = json.dumps(payload, ensure_ascii=False)  # raw multibyte
+    py = to_wide(parse_instant_query(payload))
+    for encoded in (raw, raw_utf8):
+        batch = native.parse_promjson(encoded)
+        assert_frames_equal(batch, py)
+        assert batch.hosts[0] == 'h-\U0001f525"quoted"'
