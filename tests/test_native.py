@@ -633,3 +633,41 @@ def test_fuzz_unicode_labels_roundtrip():
         batch = native.parse_promjson(encoded)
         assert_frames_equal(batch, py)
         assert batch.hosts[0] == 'h-\U0001f525"quoted"'
+
+
+def test_fuzz_truncated_and_mutated_text_bytes():
+    """Byte-level adversarial exposition text (the scrape/recorder wire
+    format): truncations and corruptions must parse to the same frame as
+    the Python parser or fail cleanly on both sides — never crash."""
+    import random
+
+    from tpudash.sources.base import parse_text_bytes
+
+    rng = random.Random(0xFEEDFACE)
+    samples = parse_instant_query(_fuzz_payload(random.Random(11)))
+    base = encode_samples(samples).encode()
+    cases = [base[: rng.randrange(0, len(base) + 1)] for _ in range(150)]
+    for _ in range(150):
+        b = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            b[rng.randrange(len(b))] = rng.randrange(256)
+        cases.append(bytes(b))
+    agreements = 0
+    for case_i, raw in enumerate(cases):
+        try:
+            py_out = parse_text_format(raw.decode("utf-8", "replace"))
+        except Exception:
+            py_out = None
+        try:
+            batch = native.parse_text(raw.decode("utf-8", "replace"))
+        except native.NativeParseError:
+            assert not py_out, (
+                f"case {case_i}: native rejected text python parsed"
+            )
+            continue
+        if py_out:
+            assert_frames_equal(batch, to_wide(py_out))
+            agreements += 1
+        else:
+            assert len(batch) == 0, f"case {case_i}"
+    assert agreements > 0

@@ -106,9 +106,14 @@ def _parse_labels(body: str) -> dict:
 
 def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]:
     """Exposition text → Samples.  Lines without a parseable chip_id (or
-    gpu_id) label are skipped, mirroring parse_instant_query's tolerance."""
+    gpu_id) label are skipped, mirroring parse_instant_query's tolerance.
+
+    Split on '\\n' exactly, per the Prometheus exposition format (and the
+    native kernel): str.splitlines() would also split on \\v/\\f/\\x85/
+    U+2028…, silently tearing a label value that contains one of those
+    into a bogus line pair — found by the byte-mutation fuzz."""
     samples: list[Sample] = []
-    for raw in text.splitlines():
+    for raw in text.split("\n"):
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
