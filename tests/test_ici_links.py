@@ -266,3 +266,34 @@ def test_synthetic_links_env_knobs():
     # bool env accepts false spellings too
     off = load_config({"TPUDASH_SYNTHETIC_LINKS": "false"})
     assert off.synthetic_links is False
+
+
+def test_links_join_across_multi_source_slices():
+    """Per-link columns survive the multi-endpoint join: two slices'
+    sources each emitting link series produce one frame with per-link
+    data for every chip, and a cold link on one slice still flags."""
+    from tpudash.sources.multi import EndpointSpec, MultiSource
+
+    a = SyntheticSource(num_chips=8, emit_links=True, emit_dcn=True)
+    b = SyntheticSource(
+        num_chips=8, emit_links=True, emit_dcn=True,
+        cold_links=((3, "xp"),),
+    )
+    src = MultiSource(
+        Config(source="multi"),
+        children=[
+            (EndpointSpec(url="a", slice_name="slice-0"), a),
+            (EndpointSpec(url="b", slice_name="slice-1"), b),
+        ],
+    )
+    df = to_wide(src.fetch())
+    assert len(df) == 16
+    col = schema.ICI_LINK_GBPS["xp"]
+    assert not df[col].isna().any()
+    links = chip_links(df, "slice-1/3")
+    assert [e["dir"] for e in links] == ["x+", "x-", "y+", "y-"]
+    assert links[0]["neighbor"].startswith("slice-1/")
+    # the injected cold x+ cable is the chip's coldest link
+    assert df.loc["slice-1/3", schema.ICI_LINK_MIN_GBPS] == pytest.approx(
+        df.loc["slice-1/3", col]
+    )
