@@ -77,6 +77,11 @@ PANEL_GAP_REASONS = {
         "and libtpu runtime dialects do not export temperature; use the "
         "tpudash exporter/probe source for it"
     ),
+    schema.ICI_LINK_MIN_GBPS: (
+        "no per-link ICI series (tpu_ici_link_*) in this scrape — the "
+        "probe source emits the local x pair; the synthetic source emits "
+        "all directions with TPUDASH_SYNTHETIC_LINKS=1"
+    ),
 }
 _GENERIC_GAP = "no source series in the current scrape"
 
