@@ -591,6 +591,10 @@ def test_fuzz_truncated_and_mutated_payload_bytes():
             py_samples = parse_instant_query(json.loads(raw))
         except Exception:
             py_samples = None  # python rejects: native may too
+        # RAW bytes, as production feeds the kernel — any exception class
+        # other than NativeParseError (e.g. UnicodeDecodeError) would
+        # escape parse_json_bytes' SourceError wrapping, so it must fail
+        # this test, not be skipped
         try:
             batch = native.parse_promjson(raw)
         except native.NativeParseError:
@@ -598,8 +602,6 @@ def test_fuzz_truncated_and_mutated_payload_bytes():
                 f"case {case_i}: native rejected bytes python parsed"
             )
             continue
-        except UnicodeDecodeError:
-            continue  # ctypes marshalling of undecodable bytes
         if py_samples:
             assert_frames_equal(batch, to_wide(py_samples))
             survived += 1
@@ -641,7 +643,6 @@ def test_fuzz_truncated_and_mutated_text_bytes():
     the Python parser or fail cleanly on both sides — never crash."""
     import random
 
-    from tpudash.sources.base import parse_text_bytes
 
     rng = random.Random(0xFEEDFACE)
     samples = parse_instant_query(_fuzz_payload(random.Random(11)))
@@ -654,12 +655,16 @@ def test_fuzz_truncated_and_mutated_text_bytes():
         cases.append(bytes(b))
     agreements = 0
     for case_i, raw in enumerate(cases):
+        # mirror production's parse_text_bytes exactly: the PYTHON
+        # fallback sees a replace-decoded str, the NATIVE kernel sees the
+        # RAW bytes — the two deployment modes must agree even on
+        # invalid-UTF-8 corruption
         try:
             py_out = parse_text_format(raw.decode("utf-8", "replace"))
         except Exception:
             py_out = None
         try:
-            batch = native.parse_text(raw.decode("utf-8", "replace"))
+            batch = native.parse_text(raw)
         except native.NativeParseError:
             assert not py_out, (
                 f"case {case_i}: native rejected text python parsed"
