@@ -14,6 +14,7 @@ When real accelerator hardware is present, on-chip probe numbers
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -82,16 +83,18 @@ def bench_dashboard() -> dict:
 
     from tpudash.app.delta import frame_delta
 
-    payload = f"data: {json.dumps(dict(frame, kind='full'))}\n\n".encode()
+    # compact separators, exactly as the server serializes the wire
+    dumps = functools.partial(json.dumps, separators=(",", ":"))
+    payload = f"data: {dumps(dict(frame, kind='full'))}\n\n".encode()
     delta = frame_delta(prev, frame)
     assert delta is not None, "steady-state frames must be delta-patchable"
-    delta_payload = f"data: {json.dumps(delta)}\n\n".encode()
+    delta_payload = f"data: {dumps(delta)}\n\n".encode()
     return {
         "p50_s": p50,
         "p95_s": p95,
         "sse_bytes": len(payload),
         "sse_delta_bytes": len(delta_payload),
-        "frame_gzip_bytes": len(gzip.compress(json.dumps(frame).encode())),
+        "frame_gzip_bytes": len(gzip.compress(dumps(frame).encode())),
     }
 
 
@@ -195,7 +198,9 @@ def bench_scale(
     assert delta is not None
     return {
         "p50_s": svc.timer.percentile(0.5),
-        "sse_delta_bytes": len(f"data: {json.dumps(delta)}\n\n".encode()),
+        "sse_delta_bytes": len(
+            f'data: {json.dumps(delta, separators=(",", ":"))}\n\n'.encode()
+        ),
         "rss_mb": _rss_mb(),
         "rss_growth_mb": round(_rss_mb() - rss_full, 1),
     }

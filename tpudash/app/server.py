@@ -53,6 +53,17 @@ from tpudash.sources import make_source
 SESSION_COOKIE = "tpudash_sid"
 
 
+def _dumps(obj) -> str:
+    """Compact JSON for everything that goes on the wire: the default
+    separators' spaces cost ~8% of a 256-chip frame (and SSE streams
+    don't gzip), for zero readability benefit to a machine consumer."""
+    return json.dumps(obj, separators=(",", ":"))
+
+
+def _json_response(data, **kw) -> web.Response:
+    return web.json_response(data, dumps=_dumps, **kw)
+
+
 def _key_id(key: tuple) -> str:
     """Compose-cache key as an SSE event id ("dv-sv-stall")."""
     return "-".join(str(int(p)) for p in key)
@@ -277,7 +288,7 @@ class DashboardServer:
                     if delta is None:
                         return None
                     return (
-                        f"id: {_key_id(key)}\ndata: {json.dumps(delta)}\n\n"
+                        f"id: {_key_id(key)}\ndata: {_dumps(delta)}\n\n"
                     ).encode()
 
                 payload = await loop.run_in_executor(None, build_delta)
@@ -291,7 +302,7 @@ class DashboardServer:
                 None,
                 lambda: (
                     f"id: {_key_id(key)}\n"
-                    f"data: {json.dumps(dict(frame, kind='full'))}\n\n"
+                    f"data: {_dumps(dict(frame, kind='full'))}\n\n"
                 ).encode(),
             )
             entry.sse_full = payload
@@ -344,7 +355,7 @@ class DashboardServer:
             headers["ETag"] = etag
             if request.headers.get("If-None-Match") == etag:
                 return web.Response(status=304, headers=headers)
-        return web.json_response(frame, headers=headers)
+        return _json_response(frame, headers=headers)
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent events: push a frame every refresh interval.  All
@@ -439,7 +450,7 @@ class DashboardServer:
         # recompose this session's frame (data untouched: a selection
         # change must not trigger a re-scrape, the table didn't change)
         frame = await self._get_frame(entry=entry)
-        return web.json_response(
+        return _json_response(
             {"selected": list(state.selected), "frame_ok": frame["error"] is None}
         )
 
@@ -456,10 +467,10 @@ class DashboardServer:
 
         await self._mutate(entry, _set)
         await self._get_frame(entry=entry)
-        return web.json_response({"use_gauge": entry.state.use_gauge})
+        return _json_response({"use_gauge": entry.state.use_gauge})
 
     async def timings(self, request: web.Request) -> web.Response:
-        return web.json_response(self.service.timer.summary())
+        return _json_response(self.service.timer.summary())
 
     async def profile(self, request: web.Request) -> web.Response:
         """On-demand profiling (tracing, SURVEY.md §5 — the reference has
@@ -510,7 +521,7 @@ class DashboardServer:
                 )
             finally:
                 self._device_trace_active = False
-            return web.json_response(
+            return _json_response(
                 {"mode": "device", "seconds": seconds, "trace_dir": trace_dir}
             )
 
@@ -559,7 +570,7 @@ class DashboardServer:
             t0 = time.monotonic()
             done, top = await loop.run_in_executor(None, run_profile)
             wall = time.monotonic() - t0
-        return web.json_response(
+        return _json_response(
             {
                 "mode": "frames",
                 "frames": done,
@@ -576,7 +587,7 @@ class DashboardServer:
         async with self._lock:  # render_frame appends from the worker thread
             if chip is None:
                 snapshot = list(self.service.history)
-                return web.json_response(
+                return _json_response(
                     {
                         "history": [
                             {"ts": ts, "averages": avgs}
@@ -587,7 +598,7 @@ class DashboardServer:
             series = self.service.chip_series(chip)
         if series is None:
             raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
-        return web.json_response(
+        return _json_response(
             {
                 "chip": chip,
                 "history": [
@@ -631,13 +642,13 @@ class DashboardServer:
                 self._chip_cache = (self._data_version, cached)
         if detail is None:
             raise web.HTTPNotFound(text=f"unknown chip {key!r}")
-        return web.json_response(detail)
+        return _json_response(detail)
 
     async def alerts(self, request: web.Request) -> web.Response:
         """Current alert states (firing + pending), critical first."""
         async with self._lock:
             snapshot = list(self.service.last_alerts)
-        return web.json_response({"alerts": snapshot})
+        return _json_response({"alerts": snapshot})
 
     def _invalidate_frames(self) -> None:
         """Global-state change (silences): every session's cached compose
@@ -667,7 +678,7 @@ class DashboardServer:
             self.service.silences.annotate(self.service.last_alerts, time.time())
             await self._save_state()
             self._invalidate_frames()
-        return web.json_response({"silenced": entry})
+        return _json_response({"silenced": entry})
 
     async def unsilence_alert(self, request: web.Request) -> web.Response:
         """POST {rule?, chip?} — drop the exact (rule, chip) silence."""
@@ -684,12 +695,12 @@ class DashboardServer:
             self._invalidate_frames()
         if not removed:
             raise web.HTTPNotFound(text=f"no silence for {rule!r}/{chip!r}")
-        return web.json_response({"removed": {"rule": rule, "chip": chip}})
+        return _json_response({"removed": {"rule": rule, "chip": chip}})
 
     async def list_silences(self, request: web.Request) -> web.Response:
         async with self._lock:
             active = self.service.silences.active(time.time())
-        return web.json_response({"silences": active})
+        return _json_response({"silences": active})
 
     def _replay_source(self):
         """The FileReplaySource under the retry/recording wrappers, or
@@ -706,7 +717,7 @@ class DashboardServer:
         if replay is None:
             raise web.HTTPNotFound(text="not replaying a recording")
         async with self._lock:
-            return web.json_response(replay.position())
+            return _json_response(replay.position())
 
     async def replay_seek(self, request: web.Request) -> web.Response:
         """POST {index} | {t} | {paused} — time-travel an incident
@@ -734,14 +745,14 @@ class DashboardServer:
                 replay.seek(index=index, ts=t)
                 # serve the sought snapshot NOW, not an interval later
                 await self._refresh_locked(force=True)
-            return web.json_response(replay.position())
+            return _json_response(replay.position())
 
     async def stragglers(self, request: web.Request) -> web.Response:
         """Current fleet outliers (firing + pending), worst first — the
         chips gating SPMD lockstep, named (tpudash.stragglers)."""
         async with self._lock:
             snapshot = list(self.service.last_stragglers)
-        return web.json_response(
+        return _json_response(
             {
                 "stragglers": snapshot,
                 "last_updated": self.service.last_updated,
@@ -807,7 +818,7 @@ class DashboardServer:
                 col: reason for col, reason in PANEL_GAP_REASONS.items()
             },
         }
-        return web.json_response(
+        return _json_response(
             {
                 "capabilities": capabilities,
                 "scrape_series": [
@@ -870,7 +881,7 @@ class DashboardServer:
         model = await loop.run_in_executor(None, self.service.topology_model)
         if model is None:
             raise web.HTTPServiceUnavailable(text="no frame rendered yet")
-        return web.json_response(model)
+        return _json_response(model)
 
     async def config(self, request: web.Request) -> web.Response:
         """Effective configuration (secrets redacted) — "which knobs is
@@ -883,7 +894,7 @@ class DashboardServer:
         for secret in ("auth_token", "alert_webhook"):
             if cfg.get(secret):
                 cfg[secret] = "<set>"
-        return web.json_response({"config": cfg})
+        return _json_response({"config": cfg})
 
     async def history_csv(self, request: web.Request) -> web.Response:
         """The rolling trend history as CSV (one row per point, one column
@@ -921,7 +932,7 @@ class DashboardServer:
 
     async def healthz(self, request: web.Request) -> web.Response:
         health = self.service.source_health()
-        return web.json_response(
+        return _json_response(
             {"ok": True, "source": self.service.source.name,
              "error": self.service.last_error,
              "source_health": health}
