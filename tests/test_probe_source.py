@@ -100,7 +100,9 @@ def test_exporter_app_warms_probe_source():
         client = TestClient(TestServer(app))
         await client.start_server()
         try:
-            task = client.app.get("warmup_task")
+            from tpudash.exporter.server import WARMUP_TASK
+
+            task = client.app.get(WARMUP_TASK)
             assert task is not None
             await task  # warmup completes without error
             # and the scrape is served from the warmed cache

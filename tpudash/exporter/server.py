@@ -27,6 +27,10 @@ from tpudash.sources.base import MetricsSource, SourceError
 log = logging.getLogger(__name__)
 
 
+#: typed app-storage key (aiohttp deprecates bare string keys)
+WARMUP_TASK = web.AppKey("warmup_task", asyncio.Task)
+
+
 class ExporterServer:
     def __init__(self, source: MetricsSource):
         self.source = source
@@ -48,13 +52,13 @@ class ExporterServer:
             except Exception as e:  # noqa: BLE001 — warmup is best-effort
                 log.warning("probe warmup failed (first scrape pays): %s", e)
 
-        app["warmup_task"] = asyncio.create_task(_warm())
+        app[WARMUP_TASK] = asyncio.create_task(_warm())
 
     async def cool(self, app: web.Application) -> None:
         """Shutdown cleanup: cancel a still-pending warmup (a wedged chip
         can block backend init indefinitely) so Ctrl-C exits cleanly
         instead of leaving a destroyed-but-pending task."""
-        task = app.get("warmup_task")
+        task = app.get(WARMUP_TASK)
         if task is not None and not task.done():
             task.cancel()
             try:
