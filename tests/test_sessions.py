@@ -447,3 +447,52 @@ def test_server_boots_with_corrupt_sessions_section(tmp_path):
     )
     server = _server(cfg)  # must not raise
     assert len(server.sessions) == 0
+
+
+def test_sse_stream_gzips_per_event():
+    """The SSE stream compresses with per-event sync flushes when the
+    client accepts gzip: the first event must arrive PROMPTLY (not parked
+    in the zlib window) and the wire bytes must be a fraction of the
+    JSON.  Clients that don't accept gzip get identity."""
+    import zlib
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        server = _server()
+        app = server.build_app()
+        client = TestClient(TestServer(app), auto_decompress=False)
+        await client.start_server()
+        try:
+            # identity: explicit no-gzip accept
+            resp = await client.get(
+                "/api/stream", headers={"Accept-Encoding": "identity"}
+            )
+            assert "Content-Encoding" not in resp.headers
+            raw = b""
+            while b"\n\n" not in raw:
+                raw += await resp.content.read(4096)
+            plain_size = len(raw)
+            assert _sse_json(raw.split(b"\n\n")[0])["kind"] == "full"
+            resp.close()
+
+            # gzip: header present, event decodes after sync flush
+            resp = await client.get(
+                "/api/stream", headers={"Accept-Encoding": "gzip"}
+            )
+            assert resp.headers.get("Content-Encoding") == "gzip"
+            d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+            wire = b""
+            decoded = b""
+            while b"\n\n" not in decoded:
+                chunk = await resp.content.read(4096)
+                assert chunk, "stream ended before first event"
+                wire += chunk
+                decoded += d.decompress(chunk)
+            assert _sse_json(decoded.split(b"\n\n")[0])["kind"] == "full"
+            # the win is real: a full frame compresses several-fold
+            assert len(wire) < plain_size / 3
+        finally:
+            await client.close()
+
+    _run(go())

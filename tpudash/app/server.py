@@ -363,18 +363,42 @@ class DashboardServer:
         serialized payload, so N open tabs still cost one scrape per
         interval and one compose per session."""
         sid = request.cookies.get(SESSION_COOKIE)
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "X-Accel-Buffering": "no",
-            }
-        )
-        # NOT compressed: aiohttp's StreamResponse deflate buffers across
-        # writes, so events would sit in the zlib window instead of
-        # arriving on time (verified — the stream tests stall).  The
-        # delta transport already cuts steady-state ticks ~5×.
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        }
+        # Compressed with a HAND-DRIVEN gzip stream, one Z_SYNC_FLUSH per
+        # event: aiohttp's built-in StreamResponse deflate buffers across
+        # writes (events would sit in the zlib window instead of arriving
+        # on time — verified, the stream tests stall), but flushing at
+        # event boundaries keeps delivery immediate while the shared
+        # window compresses the repetitive frame JSON ~8×.  EventSource
+        # decodes Content-Encoding transparently in every browser.
+        import zlib
+
+        accepts_gzip = "gzip" in request.headers.get(
+            "Accept-Encoding", ""
+        ).lower()
+        if accepts_gzip:
+            headers["Content-Encoding"] = "gzip"
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
+        compressor = (
+            zlib.compressobj(6, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
+            if accepts_gzip
+            else None
+        )
+
+        async def write_event(raw: bytes) -> None:
+            if compressor is None:
+                await resp.write(raw)
+                return
+            data = compressor.compress(raw) + compressor.flush(
+                zlib.Z_SYNC_FLUSH
+            )
+            if data:
+                await resp.write(data)
         # every event carries its compose key as the SSE id, and
         # EventSource echoes it back on reconnect — a dropped connection
         # resumes with a delta (or keepalive) instead of a full frame
@@ -388,7 +412,7 @@ class DashboardServer:
                 payload, client_key = await self._get_sse_event(
                     entry, client_key
                 )
-                await resp.write(payload)
+                await write_event(payload)
                 await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
         except (ConnectionResetError, asyncio.CancelledError):
             pass  # client went away — normal termination
