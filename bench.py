@@ -155,6 +155,28 @@ def bench_multislice() -> dict:
     return {"p50_s": svc.timer.percentile(0.5)}
 
 
+def cpu_reference_ms() -> float:
+    """Fixed CPU workload (numpy matmul, median of 5) as a machine-speed
+    reference.  The frame pipeline is pure CPU work, and this host's
+    effective clock drifts ±30% with neighbors — recording the reference
+    lets the regression guard compare p50s in machine-relative terms
+    instead of flagging an environmental level shift as a regression."""
+    import statistics
+    import time as _t
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    a = rng.random((1024, 1024))
+    a @ a  # warm
+    times = []
+    for _ in range(5):
+        t0 = _t.perf_counter()
+        a @ a
+        times.append((_t.perf_counter() - t0) * 1e3)
+    return round(statistics.median(times), 2)
+
+
 def _rss_mb() -> float:
     """Resident set of this process in MB (Linux /proc, no psutil).
     Collects first so allocator slack doesn't read as growth."""
@@ -331,7 +353,28 @@ def find_regressions(
     p_now, p_prev = result.get("probes", {}), prev.get("probes", {})
     for key in ("matmul_bf16_tflops", "hbm_stream_gbps", "hbm_copy_gbps"):
         check(key, p_now.get(key), p_prev.get(key), "lower", 0.05)
-    check("value", result.get("value"), prev.get("value"), "higher", 0.20)
+    # headline p50: compare in MACHINE-RELATIVE terms when both records
+    # carry the CPU reference — this host's effective clock swings ±30%
+    # with neighbors, and a level shift is not a code regression
+    now_p50, prev_p50 = result.get("value"), prev.get("value")
+    now_ref, prev_ref = result.get("cpu_ref_ms"), prev.get("cpu_ref_ms")
+    if (
+        isinstance(now_p50, (int, float))
+        and isinstance(prev_p50, (int, float))
+        and isinstance(now_ref, (int, float))
+        and isinstance(prev_ref, (int, float))
+        and now_ref > 0
+        and prev_ref > 0
+    ):
+        check(
+            "value_per_cpu_ref",
+            now_p50 / now_ref,
+            prev_p50 / prev_ref,
+            "higher",
+            0.20,
+        )
+    else:
+        check("value", now_p50, prev_p50, "higher", 0.20)
     return os.path.basename(files[-1]), out
 
 
@@ -369,6 +412,7 @@ def main() -> None:
         "scale_4096_rss_mb": scale4k["rss_mb"],
         "scale_4096_rss_growth_mb": scale4k["rss_growth_mb"],
         "probes": probes,
+        "cpu_ref_ms": cpu_reference_ms(),
         "bench_wall_s": round(time.time() - t0, 1),
     }
     vs_file, regressions = find_regressions(result)

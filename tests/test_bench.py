@@ -86,3 +86,21 @@ def test_missing_probe_sections_ignored(tmp_path):
     _write_prev(tmp_path, value=6.0, probes={"probe_error": "cpu"})
     _, regs = find_regressions(_result(), bench_dir=str(tmp_path))
     assert regs == []
+
+
+def test_regression_guard_normalizes_by_cpu_reference(tmp_path):
+    """A machine 40% slower inflates both the p50 and the CPU reference:
+    machine-relative comparison stays clean, raw-only records still
+    compare raw."""
+    _write_prev(tmp_path, value=6.0, cpu_ref_ms=50.0, probes={})
+    slow_machine = dict(_result(value=8.4), cpu_ref_ms=70.0)  # same ratio
+    _, regs = find_regressions(slow_machine, bench_dir=str(tmp_path))
+    assert regs == []
+    # genuinely slower code on the same machine still flags
+    really_slower = dict(_result(value=8.4), cpu_ref_ms=50.0)
+    _, regs = find_regressions(really_slower, bench_dir=str(tmp_path))
+    assert [r["metric"] for r in regs] == ["value_per_cpu_ref"]
+    # prev without cpu_ref → raw comparison (back-compat with r01-r03)
+    _write_prev(tmp_path, name="BENCH_r08.json", value=6.0, probes={})
+    _, regs = find_regressions(slow_machine, bench_dir=str(tmp_path))
+    assert [r["metric"] for r in regs] == ["value"]
