@@ -85,7 +85,11 @@ def test_batch_path_matches_reference_construction():
         got = _batch_to_wide(b)
 
         ref = pd.DataFrame(
-            b.matrix, index=pd.Index(b.keys, name="chip"), columns=b.metrics
+            b.matrix,
+            # object index/columns match both production paths (arrow
+            # string inference deliberately avoided on hot-path frames)
+            index=pd.Index(b.keys, name="chip", dtype=object),
+            columns=pd.Index(b.metrics, dtype=object),
         )
         # object dtype matches both production paths (identity columns
         # deliberately avoid arrow-backed string inference)

@@ -60,11 +60,13 @@ def to_wide(samples: "list[Sample] | SampleBatch") -> pd.DataFrame:
 
     df = pd.DataFrame.from_dict(rows, orient="index")
     df = df.sort_values(["slice_id", "chip_id"])
+    # identity columns, the index, and the column labels as object dtype,
+    # matching the batch path (see _batch_to_wide): arrow-backed strings
+    # pay per-value conversion and iteration costs on the hot path, and
+    # the two paths must produce frames that compare equal
+    df.index = df.index.astype(object)
     df.index.name = "chip"
-    # identity columns as object dtype, matching the batch path (see
-    # _batch_to_wide): arrow-backed strings pay per-value conversion and
-    # iteration costs on the hot path, and the two paths must produce
-    # frames that compare equal
+    df.columns = df.columns.astype(object)
     for col in ("slice_id", "host", schema.ACCEL_TYPE):
         if col in df:
             df[col] = df[col].astype(object)
@@ -132,9 +134,15 @@ def _batch_to_wide(b: SampleBatch) -> pd.DataFrame:
         )
     else:
         data = kept_mat
-    index = pd.Index(b.keys, name="chip")
+    # object dtype for the index AND columns, same rationale as the
+    # identity columns below: arrow-backed string indexes pay per-value
+    # conversion on every list()/iteration — filter_selected's fast-path
+    # equality check alone iterated all 256 keys per frame
+    index = pd.Index(b.keys, name="chip", dtype=object)
     metric_df = pd.DataFrame(
-        data, index=index, columns=kept + list(derived.keys())
+        data,
+        index=index,
+        columns=pd.Index(kept + list(derived.keys()), dtype=object),
     )
     # identity columns first, same order the dict pivot produces.  Forced
     # to object dtype: pandas' arrow-backed string inference would pay a
