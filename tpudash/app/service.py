@@ -845,7 +845,11 @@ class DashboardService:
         for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
             if col not in sel_df.columns:
                 continue
-            codes, uniques = pd.factorize(sel_df[col], sort=True)
+            # factorize the raw object ndarray: the Series path detours
+            # through arrow string conversion on this pandas build
+            codes, uniques = pd.factorize(
+                sel_df[col].to_numpy(dtype=object), sort=True
+            )
             if len(uniques) > 1:
                 dims.append((dim, codes, uniques))
         if not dims:
