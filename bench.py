@@ -20,6 +20,11 @@ import time
 
 
 BUDGET_S = 5.0  # the reference's refresh cadence == our frame budget
+
+#: compact separators, exactly as the server serializes the wire
+#: (tpudash/app/server.py _dumps) — wire-size numbers must measure what
+#: a subscriber actually receives
+_dumps = functools.partial(json.dumps, separators=(",", ":"))
 N_CHIPS = 256
 N_FRAMES = 30
 
@@ -83,12 +88,10 @@ def bench_dashboard() -> dict:
 
     from tpudash.app.delta import frame_delta
 
-    # compact separators, exactly as the server serializes the wire
-    dumps = functools.partial(json.dumps, separators=(",", ":"))
-    payload = f"data: {dumps(dict(frame, kind='full'))}\n\n".encode()
+    payload = f"data: {_dumps(dict(frame, kind='full'))}\n\n".encode()
     delta = frame_delta(prev, frame)
     assert delta is not None, "steady-state frames must be delta-patchable"
-    delta_payload = f"data: {dumps(delta)}\n\n".encode()
+    delta_payload = f"data: {_dumps(delta)}\n\n".encode()
     # the SSE transport gzips with per-event sync flushes over ONE shared
     # window (server.stream): measure a steady-state tick's wire bytes
     # with the full frame already in the window, as a subscriber sees it
@@ -104,7 +107,7 @@ def bench_dashboard() -> dict:
         "sse_bytes": len(payload),
         "sse_delta_bytes": len(delta_payload),
         "sse_delta_gzip_bytes": tick_wire,
-        "frame_gzip_bytes": len(gzip.compress(dumps(frame).encode())),
+        "frame_gzip_bytes": len(gzip.compress(_dumps(frame).encode())),
     }
 
 
@@ -230,9 +233,7 @@ def bench_scale(
     assert delta is not None
     return {
         "p50_s": svc.timer.percentile(0.5),
-        "sse_delta_bytes": len(
-            f'data: {json.dumps(delta, separators=(",", ":"))}\n\n'.encode()
-        ),
+        "sse_delta_bytes": len(f"data: {_dumps(delta)}\n\n".encode()),
         "rss_mb": _rss_mb(),
         "rss_growth_mb": round(_rss_mb() - rss_full, 1),
     }

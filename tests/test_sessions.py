@@ -496,3 +496,17 @@ def test_sse_stream_gzips_per_event():
             await client.close()
 
     _run(go())
+
+
+def test_sse_gzip_negotiation_respects_qvalues():
+    from tpudash.app.server import _accepts_gzip
+
+    assert _accepts_gzip("gzip")
+    assert _accepts_gzip("gzip, deflate")
+    assert _accepts_gzip("GZIP;q=0.5")
+    assert _accepts_gzip("*")
+    assert not _accepts_gzip("")
+    assert not _accepts_gzip("identity")
+    assert not _accepts_gzip("gzip;q=0, identity")  # explicit refusal
+    assert not _accepts_gzip("*;q=0")
+    assert not _accepts_gzip("gzip;q=garbage")

@@ -55,9 +55,32 @@ SESSION_COOKIE = "tpudash_sid"
 
 def _dumps(obj) -> str:
     """Compact JSON for everything that goes on the wire: the default
-    separators' spaces cost ~8% of a 256-chip frame (and SSE streams
-    don't gzip), for zero readability benefit to a machine consumer."""
+    separators' spaces cost ~8% of a 256-chip frame pre-compression, for
+    zero readability benefit to a machine consumer."""
     return json.dumps(obj, separators=(",", ":"))
+
+
+def _accepts_gzip(header: str) -> bool:
+    """RFC 9110 Accept-Encoding check for the SSE stream: a listed
+    ``gzip`` (or ``*``) counts only with a non-zero qvalue — naive
+    substring matching would serve gzip to a client that explicitly
+    refused it with ``gzip;q=0``."""
+    for item in header.split(","):
+        parts = item.strip().lower().split(";")
+        coding = parts[0].strip()
+        if coding not in ("gzip", "*"):
+            continue
+        q = 1.0
+        for p in parts[1:]:
+            p = p.strip()
+            if p.startswith("q="):
+                try:
+                    q = float(p[2:])
+                except ValueError:
+                    q = 0.0
+        if q > 0:
+            return True
+    return False
 
 
 def _json_response(data, **kw) -> web.Response:
@@ -377,9 +400,7 @@ class DashboardServer:
         # decodes Content-Encoding transparently in every browser.
         import zlib
 
-        accepts_gzip = "gzip" in request.headers.get(
-            "Accept-Encoding", ""
-        ).lower()
+        accepts_gzip = _accepts_gzip(request.headers.get("Accept-Encoding", ""))
         if accepts_gzip:
             headers["Content-Encoding"] = "gzip"
         resp = web.StreamResponse(headers=headers)
