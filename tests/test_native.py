@@ -587,8 +587,13 @@ def test_fuzz_truncated_and_mutated_payload_bytes():
         cases.append(bytes(b))
     survived = 0
     for case_i, raw in enumerate(cases):
+        # mirror parse_json_bytes' python fallback exactly: replace-decode
+        # (the native kernel is byte-tolerant; both install modes must
+        # degrade identically on invalid UTF-8)
         try:
-            py_samples = parse_instant_query(json.loads(raw))
+            py_samples = parse_instant_query(
+                json.loads(raw.decode("utf-8", "replace"), strict=False)
+            )
         except Exception:
             py_samples = None  # python rejects: native may too
         # RAW bytes, as production feeds the kernel — any exception class
@@ -605,6 +610,8 @@ def test_fuzz_truncated_and_mutated_payload_bytes():
         if py_samples:
             assert_frames_equal(batch, to_wide(py_samples))
             survived += 1
+        else:
+            assert len(batch) == 0, f"case {case_i}: python empty, native not"
     assert survived > 0  # some corruptions must still parse (coverage)
 
 

@@ -131,9 +131,21 @@ def resolve_identity(labels, default_slice: str):
         chip_label = labels.get("gpu_id")
     slice_hint = None
     if chip_label is not None:
-        try:
-            chip_id = int(chip_label)
-        except (TypeError, ValueError):
+        if isinstance(chip_label, bool):
+            # JSON true/false: the native parser sees the literal text,
+            # which never parses as an integer — skip on both sides
+            return None
+        if isinstance(chip_label, int):
+            chip_id = chip_label
+        elif isinstance(chip_label, str):
+            # strict [ \t]-bounded parse mirroring the native strtoll
+            # wrapper — a bare int() accepts exotic whitespace ("\x0c5")
+            # and underscores the native side rejects
+            parsed_id = _strict_int(chip_label)
+            if parsed_id is None:
+                return None
+            chip_id = parsed_id
+        else:
             return None
     else:
         accel_id = labels.get("accelerator_id")

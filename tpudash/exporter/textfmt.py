@@ -69,6 +69,28 @@ class TextFormatError(ValueError):
     pass
 
 
+def _parse_value_token(tok: str) -> "float | None":
+    """Value token → float with C strtod-equivalent semantics, so the
+    Python and native parsers accept/skip identical series (differential
+    fuzz contract; the strtod mirror lives in frame_kernel.cc
+    parse_full_double):
+
+    - leading C whitespace is skipped (strtod does);
+    - trailing non-space/tab junk rejects — Python's float() would strip
+      exotic/unicode whitespace ("10\\x0c", "10\\x85") that strtod treats
+      as trailing garbage;
+    - underscore literals ("1_5") reject: a Python-only extension;
+    - hex floats and nan payloads reject on both sides already.
+    """
+    tok = tok.lstrip("\t\n\x0b\x0c\r ")
+    if "_" in tok or tok != tok.strip():
+        return None
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
 def _parse_labels(body: str) -> dict:
     """Parse the inside of {...}: k="v" pairs with escape handling."""
     labels: dict[str, str] = {}
@@ -114,7 +136,11 @@ def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]
     into a bogus line pair — found by the byte-mutation fuzz."""
     samples: list[Sample] = []
     for raw in text.split("\n"):
-        line = raw.strip()
+        # strip space/tab/\r ONLY — Python's universal strip() would eat
+        # form feeds etc. that the spec (and the native kernel) treat as
+        # ordinary in-line bytes, silently changing which lines are
+        # comments and which tokens parse (byte-mutation fuzz findings)
+        line = raw.strip(" \t\r")
         if not line or line.startswith("#"):
             continue
         brace = line.find("{")
@@ -123,14 +149,14 @@ def parse_text_format(text: str, default_slice: str = "slice-0") -> list[Sample]
         close = line.rfind("}")
         if close < brace:
             raise TextFormatError(f"malformed series line: {line!r}")
-        name = line[:brace].strip()
+        name = line[:brace].strip(" \t")
         labels = _parse_labels(line[brace + 1 : close])
-        rest = line[close + 1 :].split()
+        # tokens separate on space/tab only, per the exposition format
+        rest = [t for t in line[close + 1 :].replace("\t", " ").split(" ") if t]
         if not name or not rest:
             continue
-        try:
-            value = float(rest[0])
-        except ValueError:
+        value = _parse_value_token(rest[0])
+        if value is None:
             continue
         if not math.isfinite(value):
             continue

@@ -143,10 +143,11 @@ void set_err(char* err, int64_t errcap, const std::string& msg) {
 bool parse_full_double(const char* s, size_t len, double* out) {
   std::string buf(s, len);
   // strtod accepts C extensions Python float() rejects — hex floats
-  // ("0x1") and nan payloads ("nan(123)"); both paths must skip the same
-  // series (found by the differential fuzz tests)
+  // ("0x1") and nan payloads ("nan(123)"); and an EMBEDDED NUL would
+  // truncate strtod's c_str() view so "10\0junk" read as a clean 10.
+  // Both paths must skip the same series (differential fuzz contract).
   for (char c : buf)
-    if (c == 'x' || c == 'X' || c == '(') return false;
+    if (c == 'x' || c == 'X' || c == '(' || c == '\0') return false;
   const char* b = buf.c_str();
   char* endp = nullptr;
   double v = std::strtod(b, &endp);
@@ -158,6 +159,8 @@ bool parse_full_double(const char* s, size_t len, double* out) {
 }
 
 bool parse_full_int(const std::string& s, int64_t* out) {
+  // embedded NUL would truncate strtoll's view (see parse_full_double)
+  if (s.find('\0') != std::string::npos) return false;
   const char* b = s.c_str();
   while (*b == ' ' || *b == '\t') ++b;
   char* endp = nullptr;

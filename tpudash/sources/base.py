@@ -55,8 +55,18 @@ def parse_json_bytes(data: "bytes | str") -> "SampleBatch | list[Sample]":
             return native.parse_promjson(data)
         except native.NativeParseError as e:
             raise SourceError(str(e)) from e
+    # replace-decode before json.loads: the native kernel is byte-tolerant
+    # (an invalid UTF-8 byte inside one label becomes U+FFFD at string
+    # unpack, the rest of the scrape survives), and json.loads(bytes)
+    # would instead hard-fail the whole scrape — the two install modes
+    # must degrade identically (differential fuzz contract)
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", errors="replace")
     try:
-        payload = json.loads(data)
+        # strict=False tolerates raw control characters inside strings,
+        # as the native parser does — one corrupted label byte should
+        # drop at identity resolution, not fail the whole scrape
+        payload = json.loads(data, strict=False)
     except json.JSONDecodeError as e:
         raise SourceError(f"invalid JSON: {e}") from e
     return parse_instant_query(payload)
