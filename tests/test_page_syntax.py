@@ -88,3 +88,30 @@ def test_xss_escape_function_is_pinned():
     # and the sinks that matter actually use it
     for needle in ("esc(n)", "esc(l.neighbor)", "esc(a.rule)", "esc(key)"):
         assert needle in script, f"expected {needle} in page JS"
+
+
+def test_lexer_never_crashes_on_mutated_scripts():
+    """Property: on arbitrary mutations of the real page script the
+    checker either accepts or raises JsSyntaxError — never hangs, never
+    raises anything else (it gates every served page in CI)."""
+    import random
+
+    src = _page_script()
+    rng = random.Random(0xACE0FBA5E)
+    outcomes = {"ok": 0, "rejected": 0}
+    for _ in range(150):
+        b = list(src)
+        for _ in range(rng.randrange(1, 5)):
+            i = rng.randrange(len(b))
+            b[i] = chr(rng.randrange(32, 127)) if rng.random() < 0.8 else (
+                rng.choice("{}()[]`'\"\\\n")
+            )
+        mutated = "".join(b)[: rng.randrange(100, len(src) + 1)]
+        try:
+            check_delimiters(mutated)
+            outcomes["ok"] += 1
+        except JsSyntaxError:
+            outcomes["rejected"] += 1
+    # both outcomes occur: the checker discriminates rather than
+    # blanket-accepting or blanket-rejecting
+    assert outcomes["ok"] > 0 and outcomes["rejected"] > 0
