@@ -103,7 +103,10 @@ def _parse_labels(body: str) -> dict:
         eq = body.find("=", i)
         if eq < 0:
             raise TextFormatError(f"malformed labels: {body!r}")
-        key = body[i:eq].strip()
+        # space/tab only — a universal strip() would launder junk bytes
+        # off a key ("\x0bslice" → "slice") that the native parser keeps,
+        # resolving identity labels differently across install modes
+        key = body[i:eq].strip(" \t")
         if eq + 1 >= n or body[eq + 1] != '"':
             raise TextFormatError(f"unquoted label value in {body!r}")
         j = eq + 2
