@@ -684,3 +684,27 @@ def test_fuzz_truncated_and_mutated_text_bytes(seed):
         else:
             assert len(batch) == 0, f"case {case_i}"
     assert agreements > 0
+
+
+def test_promjson_strict_document_grammar_like_json_loads():
+    """json.loads-grade strictness (splice-fuzz findings): trailing data
+    after the root object and leading-zero numbers reject on both
+    sides; json.loads' NaN/Infinity extensions still parse."""
+    good = (
+        b'{"status":"success","data":{"result":['
+        b'{"metric":{"__name__":"m","chip_id":"0"},"value":[NaN,"5"]}]}}'
+    )
+    batch = native.parse_promjson(good)  # NaN timestamp = loads extension
+    assert batch.nrows == 1
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(good + b'{"extra": 1}')  # trailing data
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(
+            b'{"status":"success","data":{"result":['
+            b'{"metric":{"__name__":"m","chip_id":"0"},"value":[0123,"5"]}]}}'
+        )  # leading zero
+    with pytest.raises(native.NativeParseError):
+        native.parse_promjson(
+            b'{"status":"success","data":{"result":['
+            b'{"metric":{"__name__":"m","chip_id":"0"},"value":[.5,"5"]}]}}'
+        )  # bare fraction

@@ -486,24 +486,63 @@ struct JParser {
     return fail("unterminated string");
   }
 
+  // Length of a STRICT JSON number (RFC 8259 grammar) at p, or 0.
+  // json.loads enforces this — no leading zeros ("056"), no bare "+",
+  // no ".5"/"5.", no dangling exponent — and the differential fuzz
+  // caught the permissive strtod-charset scanner accepting documents
+  // Python rejects.  json.loads' NaN/Infinity extensions are mirrored.
+  size_t json_number_len() const {
+    const char* q = p;
+    auto lit = [&](const char* s) -> size_t {
+      size_t n = std::strlen(s);
+      if (static_cast<size_t>(end - q) >= n && std::strncmp(q, s, n) == 0)
+        return (q - p) + n;
+      return 0;
+    };
+    if (size_t n = lit("NaN")) return n;
+    if (size_t n = lit("Infinity")) return n;
+    if (q < end && *q == '-') ++q;
+    if (size_t n = lit("Infinity")) return n;
+    if (q >= end) return 0;
+    if (*q == '0') {
+      ++q;
+    } else if (*q >= '1' && *q <= '9') {
+      while (q < end && *q >= '0' && *q <= '9') ++q;
+    } else {
+      return 0;
+    }
+    if (q < end && *q == '.') {
+      ++q;
+      if (q >= end || *q < '0' || *q > '9') return 0;
+      while (q < end && *q >= '0' && *q <= '9') ++q;
+    }
+    if (q < end && (*q == 'e' || *q == 'E')) {
+      ++q;
+      if (q < end && (*q == '+' || *q == '-')) ++q;
+      if (q >= end || *q < '0' || *q > '9') return 0;
+      while (q < end && *q >= '0' && *q <= '9') ++q;
+    }
+    return q - p;
+  }
+
   bool skip_number() {
     ws();
-    const char* start = p;
-    if (p < end && (*p == '-' || *p == '+')) ++p;
-    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
-                       *p == 'E' || *p == '-' || *p == '+'))
-      ++p;
-    return p > start;
+    size_t n = json_number_len();
+    if (n == 0) return fail("bad number");
+    p += n;
+    return true;
   }
 
   bool parse_number(double* out) {
     ws();
+    size_t n = json_number_len();
+    if (n == 0) return fail("bad number");
+    std::string buf(p, n);
     char* endp = nullptr;
-    std::string buf(p, std::min<size_t>(end - p, 64));
     double v = std::strtod(buf.c_str(), &endp);
-    if (endp == buf.c_str()) return fail("bad number");
+    if (endp != buf.c_str() + n) return fail("bad number");
     *out = v;
-    p += endp - buf.c_str();
+    p += n;
     return true;
   }
 
@@ -886,6 +925,13 @@ TdFrame* parse_promjson_impl(const char* text, int64_t len,
     ++jp.p;
   }
 
+  // trailing garbage after the root object is a malformed document —
+  // json.loads rejects it ("Extra data"), so must we (found by the
+  // splice-mutation differential fuzz: a duplicated tail chunk parsed
+  // as a clean document on this side only)
+  jp.ws();
+  if (jp.p < jp.end)
+    return bad("malformed prometheus payload: trailing data");
   if (status != "success")
     return bad("prometheus status='" + status + "'");
   if (!saw_result)
