@@ -140,3 +140,24 @@ def _source_of(module_src: str, name: str) -> str:
         if keep:
             out.append(line)
     return "\n".join(out)
+
+
+def test_negative_subscripts_rejected(tmp_path):
+    # x[-1] is last-element in Python but undefined in JS — the
+    # transpiler must refuse the construct, not silently diverge
+    # (ADVICE r4, pyjs.py Subscript handling)
+    import pytest
+    from tpudash.app.pyjs import TranspileError
+
+    bodies = ["return xs[-1]", "i = 2\nreturn xs[-i]", "return xs[0:2]"]
+    for i, body in enumerate(bodies):
+        src = f"def neg{i}(d, xs):\n" + "".join(
+            f"    {line}\n" for line in body.splitlines()
+        )
+        mod_path = tmp_path / f"neg_{i}.py"
+        mod_path.write_text(src)
+        spec = importlib.util.spec_from_file_location(f"neg_{i}", mod_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        with pytest.raises(TranspileError):
+            transpile_function(getattr(mod, f"neg{i}"))

@@ -510,3 +510,22 @@ def test_sse_gzip_negotiation_respects_qvalues():
     assert not _accepts_gzip("gzip;q=0, identity")  # explicit refusal
     assert not _accepts_gzip("*;q=0")
     assert not _accepts_gzip("gzip;q=garbage")
+    # most-specific entry wins (RFC 9110 §12.5.3): an explicit gzip
+    # refusal is NOT overridden by a permissive wildcard, and an
+    # explicit gzip acceptance survives a refused wildcard
+    assert not _accepts_gzip("gzip;q=0, *")
+    assert not _accepts_gzip("*, gzip;q=0")
+    assert _accepts_gzip("gzip;q=0.1, *;q=0")
+
+
+def test_restore_with_zero_limit_restores_nothing():
+    # items[-0:] slices to the WHOLE list — limit=0 must mean "no
+    # sessions", not "every checkpointed session"
+    store = SessionStore(SelectionState(), limit=0, ttl=1e9)
+    assert store.limit == 1  # constructor clamps
+    store.limit = 0  # defense-in-depth if a future config path skips it
+    restored = store.restore(
+        {"sid1": {"selected": ["s/0"], "idle_s": 0.0}}
+    )
+    assert restored == 0
+    assert not store._entries

@@ -236,6 +236,31 @@ def test_silence_api_roundtrip(tmp_path):
                 "/api/alerts/silence", json={"ttl_s": -5}
             )
             assert r.status == 400
+            # an empty body must NOT default to a fleet-wide mute
+            # (ADVICE r4): rule/chip required, or explicit {"all": true}
+            r = await client.post("/api/alerts/silence", json={})
+            assert r.status == 400
+            r = await client.post(
+                "/api/alerts/silence", json={"ttl_s": 60}
+            )
+            assert r.status == 400
+            # falsy scope values collapse to "*" — still not scoped
+            r = await client.post(
+                "/api/alerts/silence", json={"rule": "", "ttl_s": 60}
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/api/alerts/silence", json={"rule": None, "chip": ""}
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/api/alerts/silence", json={"all": True, "ttl_s": 60}
+            )
+            assert r.status == 200
+            assert (await r.json())["silenced"]["rule"] == "*"
+            await client.post(
+                "/api/alerts/unsilence", json={"rule": "*", "chip": "*"}
+            )
         finally:
             await client.close()
 

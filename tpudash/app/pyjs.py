@@ -84,7 +84,18 @@ class _Fn:
         if isinstance(node, ast.Name):
             return node.id
         if isinstance(node, ast.Subscript):
-            return f"{self.expr(node.value)}[{self.expr(node.slice)}]"
+            # negative indexes silently diverge (Python last-element vs
+            # JS undefined) — reject them like the other known traps
+            idx = node.slice
+            if isinstance(idx, ast.Constant) and isinstance(
+                idx.value, (int, float)
+            ) and idx.value < 0:
+                raise TranspileError("negative subscript diverges in JS")
+            if isinstance(idx, ast.UnaryOp) and isinstance(idx.op, ast.USub):
+                raise TranspileError("negative subscript diverges in JS")
+            if isinstance(idx, ast.Slice):
+                raise TranspileError("slice subscript unsupported")
+            return f"{self.expr(node.value)}[{self.expr(idx)}]"
         if isinstance(node, (ast.List, ast.Tuple)):
             return "[" + ", ".join(self.expr(e) for e in node.elts) + "]"
         if isinstance(node, ast.Dict):

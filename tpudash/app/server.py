@@ -61,10 +61,13 @@ def _dumps(obj) -> str:
 
 
 def _accepts_gzip(header: str) -> bool:
-    """RFC 9110 Accept-Encoding check for the SSE stream: a listed
-    ``gzip`` (or ``*``) counts only with a non-zero qvalue — naive
-    substring matching would serve gzip to a client that explicitly
-    refused it with ``gzip;q=0``."""
+    """RFC 9110 Accept-Encoding check for the SSE stream.  An explicit
+    ``gzip`` entry takes precedence over ``*`` (most-specific wins), so
+    ``gzip;q=0, *`` is a refusal even though the wildcard would allow
+    it; naive substring matching would serve gzip to a client that
+    explicitly refused it with ``gzip;q=0``."""
+    gzip_q = None
+    star_q = None
     for item in header.split(","):
         parts = item.strip().lower().split(";")
         coding = parts[0].strip()
@@ -78,9 +81,13 @@ def _accepts_gzip(header: str) -> bool:
                     q = float(p[2:])
                 except ValueError:
                     q = 0.0
-        if q > 0:
-            return True
-    return False
+        if coding == "gzip":
+            gzip_q = q if gzip_q is None else max(gzip_q, q)
+        else:
+            star_q = q if star_q is None else max(star_q, q)
+    if gzip_q is not None:
+        return gzip_q > 0
+    return star_q is not None and star_q > 0
 
 
 def _json_response(data, **kw) -> web.Response:
@@ -705,12 +712,26 @@ class DashboardServer:
         alerts for ttl_s seconds (rule/chip default "*" wildcards).  The
         silence is flagged on frame/alert entries, excluded from webhook
         paging, persisted across restart, and expires on its own — when
-        it does while the alert still fires, the pager fires then."""
+        it does while the alert still fires, the pager fires then.
+
+        A fleet-wide silence (both rule and chip wildcarded) mutes the
+        entire pager, so it never happens by accident: at least one of
+        rule/chip must be present in the body, or ``{"all": true}`` must
+        opt in explicitly — an empty/malformed body is a 400, not a
+        fleet-wide mute."""
         try:
             body = await request.json()
             ttl = float(body.get("ttl_s", 3600.0))
             rule = str(body.get("rule", "*") or "*")
             chip = str(body.get("chip", "*") or "*")
+            # scope is judged on the EFFECTIVE values: {"rule": ""} or
+            # {"rule": null} collapses to "*" and must not count as scoped
+            if rule == "*" and chip == "*" and body.get("all") is not True:
+                raise web.HTTPBadRequest(
+                    text="refusing implicit fleet-wide silence: pass "
+                    '"rule" and/or "chip", or {"all": true} to mute '
+                    "everything on purpose"
+                )
         except (ValueError, TypeError, AttributeError) as e:
             raise web.HTTPBadRequest(text=f"bad silence request: {e}")
         async with self._lock:

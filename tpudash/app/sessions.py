@@ -137,7 +137,8 @@ class SessionStore:
         """Recreate sessions from a checkpoint section (bounded by the
         store's own limit, already-TTL-expired entries skipped, corrupt
         entries ignored).  Returns the number restored."""
-        if not isinstance(data, dict):
+        if not isinstance(data, dict) or self.limit <= 0:
+            # limit=0 must restore nothing: items[-0:] is the WHOLE list
             return 0
         now = self._clock()
         restored = 0
