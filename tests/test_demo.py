@@ -9,14 +9,32 @@ from tpudash.demo import demo_configs, start_demo
 
 def test_demo_configs_wire_dashboard_to_exporter(monkeypatch):
     monkeypatch.setenv("TPUDASH_DEMO_SOURCE", "synthetic")
+    monkeypatch.delenv("TPUDASH_SYNTHETIC_COLD_LINKS", raising=False)
     exporter_cfg, dash_cfg = demo_configs(Config(exporter_port=19311))
     assert exporter_cfg.source == "synthetic"
     assert dash_cfg.source == "scrape"
     assert dash_cfg.scrape_url == "http://127.0.0.1:19311/metrics"
+    # zero-to-aha: the synthetic demo injects one cold link so the
+    # failing-cable surfaces are visible out of the box...
+    assert exporter_cfg.synthetic_links is True
+    assert exporter_cfg.synthetic_cold_links == "17:xn"
+    # ...but never overrides an operator's explicit choice
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_COLD_LINKS", "")
+    exporter_cfg, _ = demo_configs(Config(exporter_port=19311))
+    assert exporter_cfg.synthetic_cold_links == ""
+    # and respects the links kill-switch (clear the sentinel again so
+    # the guard's synthetic_links condition is what's exercised)
+    monkeypatch.delenv("TPUDASH_SYNTHETIC_COLD_LINKS", raising=False)
+    exporter_cfg, _ = demo_configs(
+        Config(exporter_port=19311, synthetic_links=False)
+    )
+    assert exporter_cfg.synthetic_links is False
+    assert exporter_cfg.synthetic_cold_links == ""
 
 
 def test_demo_end_to_end(monkeypatch):
     monkeypatch.setenv("TPUDASH_DEMO_SOURCE", "synthetic")
+    monkeypatch.delenv("TPUDASH_SYNTHETIC_COLD_LINKS", raising=False)
     cfg = Config(
         host="127.0.0.1", port=19413, exporter_port=19412,
         synthetic_chips=8, refresh_interval=0.0,
@@ -35,6 +53,25 @@ def test_demo_end_to_end(monkeypatch):
                     frame = json.loads(await r.text())
                     assert frame["error"] is None
                     assert len(frame["chips"]) == 8  # scraped via the exporter
+                # per-link ICI rides the default demo end to end: the
+                # exporter emits link series, scrape parses them back,
+                # and the drill-down shows 2·ndim direction-resolved rows
+                async with s.get(
+                    "http://127.0.0.1:19413/api/chip?key=slice-0/0"
+                ) as r:
+                    chip = json.loads(await r.text())
+                    links = chip["links"]
+                    assert links, "default demo must expose per-link detail"
+                    assert len(links) % 2 == 0 and len(links) in (4, 6)
+                # the injected cold link (chip 7 at 8 chips) is visibly cold
+                async with s.get(
+                    "http://127.0.0.1:19413/api/chip?key=slice-0/7"
+                ) as r:
+                    chip = json.loads(await r.text())
+                    xn = [l for l in chip["links"] if l["dir"] == "x-"]
+                    assert xn and xn[0]["gbps"] < 0.2 * max(
+                        l["gbps"] for l in chip["links"]
+                    )
         finally:
             for runner in runners:
                 await runner.cleanup()

@@ -62,8 +62,10 @@ def test_main_bad_alert_rules_degrades_to_warning(capsys, monkeypatch):
     assert "MXU%" in captured.out  # table still renders
 
 
-def test_chip_drilldown_view(capsys):
-    # 4x4 v5e torus: chip 5 = (1,1) has 4 ICI neighbors
+def test_chip_drilldown_view(capsys, monkeypatch):
+    # 4x4 v5e torus: chip 5 = (1,1) has 4 ICI neighbors.  Kill-switch the
+    # (default-on) link series to exercise the neighbors-only view.
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_LINKS", "0")
     assert main(["--source", "synthetic", "--chips", "16", "--chip", "slice-0/5"]) == 0
     out = capsys.readouterr().out
     assert "chip   slice-0/5" in out
@@ -103,7 +105,10 @@ def test_chip_drilldown_shows_per_link_table(capsys, monkeypatch):
     assert "slice-0/1" in out  # x+ far end on the 4x4 torus
 
 
-def test_chip_drilldown_neighbors_without_link_series(capsys):
+def test_chip_drilldown_neighbors_without_link_series(capsys, monkeypatch):
+    # sources without per-link series (kill-switch stands in for them)
+    # still show torus neighbors — capability honesty, no empty table
+    monkeypatch.setenv("TPUDASH_SYNTHETIC_LINKS", "0")
     assert main(
         ["--source", "synthetic", "--chips", "16", "--chip", "slice-0/0"]
     ) == 0

@@ -55,8 +55,11 @@ class Config:
     #: BASELINE.json configs[4] multi-slice shape).
     synthetic_slices: int = 1
     #: Synthetic source: also emit direction-resolved per-link ICI series
-    #: (schema.ICI_LINK_SERIES) for the generation's torus rank.
-    synthetic_links: bool = False
+    #: (schema.ICI_LINK_SERIES) for the generation's torus rank.  On by
+    #: default so the coldest-link panel, link stragglers, and drill-down
+    #: link tables are visible out of the box; TPUDASH_SYNTHETIC_LINKS=0
+    #: is the kill-switch (e.g. to model an exporter without link series).
+    synthetic_links: bool = True
     #: Synthetic source: cold-link injection, comma-separated "chip:dir"
     #: pairs (e.g. "17:xn,40:zp") — those links run at ~8% of nominal, the
     #: failing-cable drill the straggler detector should name.  Implies

@@ -40,6 +40,19 @@ def demo_configs(cfg: Config | None = None) -> tuple[Config, Config]:
         except Exception:  # noqa: BLE001 — no jax → synthetic demo
             exporter_source = "synthetic"
     exporter_cfg = dataclasses.replace(cfg, source=exporter_source)
+    if (
+        exporter_source == "synthetic"
+        and exporter_cfg.synthetic_links
+        and not exporter_cfg.synthetic_cold_links
+        and "TPUDASH_SYNTHETIC_COLD_LINKS" not in os.environ
+    ):
+        # zero-to-aha includes the failing-cable story: one injected cold
+        # link so the coldest-link panel, the link-straggler banner, and
+        # the drill-down link table all show something on first run
+        chip = min(17, max(0, exporter_cfg.synthetic_chips - 1))
+        exporter_cfg = dataclasses.replace(
+            exporter_cfg, synthetic_cold_links=f"{chip}:xn"
+        )
     # scrape address must match the exporter's bind: loopback works for
     # the wildcard bind, a specific TPUDASH_HOST needs that address
     scrape_host = "127.0.0.1" if cfg.host in ("0.0.0.0", "::") else cfg.host
