@@ -80,7 +80,7 @@ PANEL_GAP_REASONS = {
     schema.ICI_LINK_MIN_GBPS: (
         "no per-link ICI series (tpu_ici_link_*) in this scrape — the "
         "probe source emits the local x pair; the synthetic source emits "
-        "all directions with TPUDASH_SYNTHETIC_LINKS=1"
+        "all directions by default (TPUDASH_SYNTHETIC_LINKS=0 disables)"
     ),
 }
 _GENERIC_GAP = "no source series in the current scrape"
