@@ -32,15 +32,33 @@ RUN python -m venv /opt/venv \
     && /opt/venv/bin/pip install --no-cache-dir --no-deps -r requirements.lock
 COPY pyproject.toml README.md ./
 COPY tpudash ./tpudash
+COPY deploy/fetch_plotly.py ./deploy/fetch_plotly.py
+# vendor the plotly bundle (pinned like the reference's uv.lock) into the
+# package BEFORE install, so the runtime image serves the rich UI itself
+# with zero egress — no CDN dependency in an air-gapped cluster
+RUN /opt/venv/bin/python deploy/fetch_plotly.py --dest tpudash/app/assets
 RUN /opt/venv/bin/pip install --no-cache-dir --no-deps . \
     # compile the native frame kernel into the installed package now so
     # the runtime stage needs no g++ (loader would otherwise build on
-    # first use, tpudash/native/__init__.py)
-    && /opt/venv/bin/python - <<'EOF'
+    # first use, tpudash/native/__init__.py).  -P keeps /src off
+    # sys.path: with cwd importable, `import tpudash` would resolve the
+    # SOURCE tree — the kernel would compile into /src (lost at the
+    # stage boundary) and the asset assert would vacuously pass
+    && /opt/venv/bin/python -P - <<'EOF'
+import tpudash
+assert "site-packages" in tpudash.__file__, (
+    "checks must run against the venv install, got %r" % tpudash.__file__
+)
 from tpudash import native
 lib = native.load()
 assert lib is not None, "native frame kernel failed to compile"
 print("native kernel built:", native.is_available())
+from tpudash.app.assets import find_plotly_asset
+asset = find_plotly_asset()
+assert asset and "site-packages" in asset, (
+    "vendored plotly bundle missing from the installed package: %r" % asset
+)
+print("plotly vendored at:", asset)
 EOF
 
 FROM python:3.12-slim

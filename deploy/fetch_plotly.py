@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Vendor plotly.min.js into tpudash/app/assets/ from the pinned wheel.
+
+The reference gets offline charting for free: plotly is a pinned Python
+dependency (reference uv.lock pins plotly 6.0.1) and Streamlit serves
+every browser asset itself.  tpudash vendors only what the browser needs
+— the single minified bundle the plotly wheel carries at
+``plotly/package_data/plotly.min.js`` — and serves it from the dashboard
+at ``/static/plotly.min.js``, so an air-gapped deployment renders the
+full interactive UI with zero egress.
+
+Three ways in, tried in order when no flag forces one:
+
+1. ``--wheel PATH`` — extract from a plotly wheel file (fully offline).
+2. An already-importable ``plotly`` package (its installed package_data).
+3. ``pip download`` of the pinned version (needs network — this is a
+   BUILD-time step; the Dockerfile runs it in the build stage, never at
+   runtime).
+
+Usage:
+    python deploy/fetch_plotly.py                      # auto (2 then 3)
+    python deploy/fetch_plotly.py --wheel plotly-*.whl # offline
+    python deploy/fetch_plotly.py --dest some/dir      # custom drop point
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import zipfile
+
+#: The plotly PYTHON wheel whose bundled plotly.js exactly matches the
+#: page contract (html.PLOTLY_VERSION = 2.32.0): plotly.py 5.22.0 ships
+#: plotly.js 2.32.0 in package_data.  The reference pins plotly 6.0.1
+#: (which bundles plotly.js 3.x); tpudash pins by the JS version instead
+#: so the vendored bundle and the page's CDN fallback are the SAME
+#: plotly.js — figure dicts render identically on either load path.
+PLOTLY_PIN = "5.22.0"
+PLOTLY_JS_VERSION = "2.32.0"
+ASSET_IN_WHEEL = "plotly/package_data/plotly.min.js"
+DEFAULT_DEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tpudash",
+    "app",
+    "assets",
+)
+
+
+def _write_atomic(data: bytes, dest: str) -> str:
+    out = os.path.join(dest, "plotly.min.js")
+    tmp = out + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, out)  # atomic: a killed build can't leave half a bundle
+    return out
+
+
+def from_wheel(wheel_path: str, dest: str) -> str:
+    with zipfile.ZipFile(wheel_path) as zf:
+        try:
+            data = zf.read(ASSET_IN_WHEEL)
+        except KeyError:
+            raise SystemExit(
+                f"{wheel_path} has no {ASSET_IN_WHEEL} — not a plotly wheel?"
+            )
+    return _write_atomic(data, dest)
+
+
+def from_installed(dest: str) -> "str | None":
+    try:
+        import plotly
+    except ImportError:
+        return None
+    if getattr(plotly, "__version__", None) != PLOTLY_PIN:
+        # whatever happens to be installed is NOT the pinned bundle —
+        # fall through to pip download rather than silently vendoring a
+        # different plotly.js than the page contract names
+        print(
+            f"installed plotly {getattr(plotly, '__version__', '?')} "
+            f"!= pin {PLOTLY_PIN}; ignoring it",
+            file=sys.stderr,
+        )
+        return None
+    src = os.path.join(
+        os.path.dirname(plotly.__file__), "package_data", "plotly.min.js"
+    )
+    if not os.path.isfile(src):
+        return None
+    with open(src, "rb") as f:
+        return _write_atomic(f.read(), dest)
+
+
+def from_pip_download(dest: str) -> str:
+    with tempfile.TemporaryDirectory() as tmp:
+        subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pip",
+                "download",
+                "--no-deps",
+                f"plotly=={PLOTLY_PIN}",
+                "-d",
+                tmp,
+            ],
+            check=True,
+        )
+        wheels = [f for f in os.listdir(tmp) if f.endswith(".whl")]
+        if not wheels:
+            raise SystemExit("pip download produced no wheel")
+        return from_wheel(os.path.join(tmp, wheels[0]), dest)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--wheel", help="extract from this plotly wheel file")
+    ap.add_argument("--dest", default=DEFAULT_DEST, help="drop directory")
+    args = ap.parse_args(argv)
+    os.makedirs(args.dest, exist_ok=True)
+    if args.wheel:
+        out = from_wheel(args.wheel, args.dest)
+    else:
+        out = from_installed(args.dest) or from_pip_download(args.dest)
+    size_kb = os.path.getsize(out) // 1024
+    print(f"vendored {out} ({size_kb} KB)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,70 @@
+"""Locally-served browser assets — the zero-egress rich-rendering path.
+
+The reference gets offline charting for free: plotly ships as a pinned
+Python dependency (reference uv.lock pins plotly 6.0.1; pyproject.toml:7-12)
+and Streamlit serves every browser asset itself, so an air-gapped cluster
+still renders the full interactive UI.  tpudash matches that by serving a
+vendored ``plotly.min.js`` from the dashboard process when one is
+available, falling back to the CDN (and then to the built-in
+dependency-free renderer) only when it is not.
+
+Resolution order for the vendored file:
+
+1. ``TPUDASH_ASSETS_DIR`` (Config.assets_dir) — an operator-provided
+   directory containing ``plotly.min.js``.
+2. The packaged assets directory (``tpudash/app/assets/``) — where the
+   Docker build drops the file extracted from the pinned plotly wheel
+   (``deploy/fetch_plotly.py``).
+3. An importable ``plotly`` Python package — its wheel carries the exact
+   bundle at ``plotly/package_data/plotly.min.js`` (how the reference's
+   own chart stack ships the JS).
+
+The file is resolved once at server construction: asset presence is a
+deploy-time property, and a per-request stat would put a syscall on the
+index path for nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+PLOTLY_ASSET_NAME = "plotly.min.js"
+
+#: Packaged drop point for the vendored bundle (kept in-tree as a
+#: directory so the wheel/package_data machinery has a stable home for it).
+PACKAGED_ASSETS_DIR = os.path.join(os.path.dirname(__file__), "assets")
+
+
+def find_plotly_asset(assets_dir: str = "") -> "str | None":
+    """Absolute path of the vendored plotly bundle, or None.
+
+    A configured ``assets_dir`` that exists but lacks the file is
+    reported (log) rather than silently skipped — the operator pointed at
+    the wrong directory and would otherwise debug a degraded page.
+    """
+    if assets_dir:
+        path = os.path.join(assets_dir, PLOTLY_ASSET_NAME)
+        if os.path.isfile(path):
+            return os.path.abspath(path)
+        log.warning(
+            "TPUDASH_ASSETS_DIR=%s has no %s — falling back",
+            assets_dir,
+            PLOTLY_ASSET_NAME,
+        )
+    packaged = os.path.join(PACKAGED_ASSETS_DIR, PLOTLY_ASSET_NAME)
+    if os.path.isfile(packaged):
+        return packaged
+    try:
+        import plotly  # noqa: F401 — presence probe only
+
+        bundled = os.path.join(
+            os.path.dirname(plotly.__file__), "package_data", PLOTLY_ASSET_NAME
+        )
+        if os.path.isfile(bundled):
+            return bundled
+    except ImportError:
+        pass
+    return None

@@ -1,11 +1,50 @@
 """Dashboard page — single self-contained HTML document.
 
 Renders the frame JSON from ``/api/frame``.  Uses plotly.js when the page
-can load it (CDN); otherwise a built-in dependency-free renderer draws the
-same figure dicts as HTML/SVG (gauges/bars as banded meters, heatmaps as CSS
-grids), so the dashboard works fully air-gapped — the figure dicts are the
-contract, the renderer is swappable.
+can load it — vendored and served by the dashboard itself at
+``/static/plotly.min.js`` when the asset is present (zero-egress rich UI,
+matching the reference's offline story where plotly is a pinned Python
+dependency), with the CDN as last resort; otherwise a built-in
+dependency-free renderer draws the same figure dicts as HTML/SVG
+(gauges/bars as banded meters, heatmaps as CSS grids), so the dashboard
+works fully air-gapped — the figure dicts are the contract, the renderer
+is swappable.
 """
+
+PLOTLY_VERSION = "2.32.0"
+PLOTLY_CDN_URL = f"https://cdn.plot.ly/plotly-{PLOTLY_VERSION}.min.js"
+#: Version-pinned URL: a redeploy that bumps PLOTLY_VERSION changes the
+#: URL, so a browser's cached old bundle can never shadow the new one
+#: (the asset is served with a long max-age).  The local path and the
+#: CDN fallback name the SAME plotly.js version — deploy/fetch_plotly.py
+#: pins the wheel whose bundled plotly.js matches, so both load paths
+#: render figure dicts identically.
+PLOTLY_LOCAL_URL = f"/static/plotly-{PLOTLY_VERSION}.min.js"
+#: Tag when no vendored asset exists: CDN or bust (air-gapped → fallback
+#: renderer, flagged in the debug strip).
+PLOTLY_CDN_TAG = (
+    f'<script src="{PLOTLY_CDN_URL}" onerror="window._noPlotly=true"></script>'
+)
+#: Tag when the dashboard serves the asset itself: local first; if the
+#: asset vanished after server start, chain to the CDN and only then give
+#: up.  usePlotly() re-checks window.Plotly per render, so a late async CDN
+#: arrival upgrades the page on the next frame.
+PLOTLY_LOCAL_TAG = (
+    f'<script src="{PLOTLY_LOCAL_URL}" onerror="'
+    "(function(){var s=document.createElement('script');"
+    f"s.src='{PLOTLY_CDN_URL}';"
+    "s.onerror=function(){window._noPlotly=true;};"
+    'document.head.appendChild(s);})()"></script>'
+)
+
+
+def page_html(local_plotly: bool) -> str:
+    """The served page: swap the plotly script tag for the local-first
+    variant when the server has a vendored bundle to back it."""
+    if local_plotly:
+        return PAGE.replace(PLOTLY_CDN_TAG, PLOTLY_LOCAL_TAG, 1)
+    return PAGE
+
 
 PAGE = r"""<!DOCTYPE html>
 <html>

@@ -41,7 +41,8 @@ from aiohttp import web
 
 log = logging.getLogger(__name__)
 
-from tpudash.app.html import PAGE
+from tpudash.app.assets import find_plotly_asset
+from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
 from tpudash.app.service import DashboardService
 from tpudash.app.sessions import SessionEntry, SessionStore
 from tpudash.config import Config, load_config
@@ -142,6 +143,13 @@ class DashboardServer:
         self._refresh_task = None
         self._refresh_started: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
+        #: vendored plotly bundle (deploy-time property, resolved once);
+        #: None → the page uses the CDN tag and /static 404s
+        self._plotly_asset = find_plotly_asset(service.cfg.assets_dir)
+        if self._plotly_asset:
+            log.info("serving vendored plotly from %s", self._plotly_asset)
+        #: rendered once — asset presence is fixed for the process life
+        self._page = page_html(local_plotly=self._plotly_asset is not None)
 
     async def _save_state(self) -> None:
         """Persist the composite checkpoint OFF the event loop — the
@@ -355,7 +363,7 @@ class DashboardServer:
 
     # -- handlers ------------------------------------------------------------
     async def index(self, request: web.Request) -> web.Response:
-        resp = web.Response(text=PAGE, content_type="text/html")
+        resp = web.Response(text=self._page, content_type="text/html")
         if not request.cookies.get(SESSION_COOKIE):
             # first visit: issue the per-browser session id the reference
             # gets for free from Streamlit (app.py:252-260)
@@ -366,6 +374,23 @@ class DashboardServer:
                 samesite="Lax",
             )
         return resp
+
+    async def plotly_asset(self, request: web.Request) -> web.StreamResponse:
+        """The vendored plotly bundle (zero-egress rich rendering).  404
+        when no bundle was resolved at startup — the page then carries
+        the CDN tag instead, so nothing ever requests this in vain.
+        Long-lived caching is safe: PLOTLY_LOCAL_URL carries the plotly
+        version, so a deploy that bumps it changes the URL, and
+        FileResponse still serves Last-Modified for revalidation."""
+        if self._plotly_asset is None:
+            raise web.HTTPNotFound(text="no vendored plotly bundle")
+        return web.FileResponse(
+            self._plotly_asset,
+            headers={
+                "Content-Type": "application/javascript",
+                "Cache-Control": "public, max-age=86400",
+            },
+        )
 
     async def frame(self, request: web.Request) -> web.Response:
         """Current frame, with ETag revalidation: the polling fallback
@@ -1026,9 +1051,11 @@ class DashboardServer:
         so Kubernetes probes don't need the secret, and the index page —
         a static shell with no metric data — stays open so a browser
         navigation (which cannot send headers) can load it; the page's
-        JS then authenticates every data call."""
+        JS then authenticates every data call.  The vendored plotly
+        bundle is likewise public: a ``<script src>`` load cannot carry
+        a header either, and the asset is a vendor library, not data."""
         token = self.service.cfg.auth_token
-        if not token or request.path in ("/", "/healthz"):
+        if not token or request.path in ("/", "/healthz", PLOTLY_LOCAL_URL):
             return await handler(request)
         header = request.headers.get("Authorization", "")
         supplied = header[7:] if header.startswith("Bearer ") else None
@@ -1071,6 +1098,7 @@ class DashboardServer:
         app.router.add_post("/api/replay", self.replay_seek)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
+        app.router.add_get(PLOTLY_LOCAL_URL, self.plotly_asset)
         if self.service.cfg.history_path:
             # final trend snapshot on graceful shutdown (periodic saves
             # cover crashes up to history_save_interval behind)

@@ -98,6 +98,12 @@ class Config:
     #: restarts — the reference loses state on any refresh (SURVEY §5
     #: checkpoint/resume: "none").  Empty string disables persistence.
     state_path: str = ""
+    #: Directory holding vendored browser assets (plotly.min.js) served at
+    #: /static/ for zero-egress rich rendering.  "" = auto-resolve: the
+    #: packaged tpudash/app/assets/ drop point (Docker bakes the bundle
+    #: there), then an importable plotly package's own copy; when nothing
+    #: resolves the page uses the CDN and past that the built-in renderer.
+    assets_dir: str = ""
     #: Alert rule specs (see tpudash.alerts grammar).  "" = built-in
     #: defaults; "off" disables alerting.
     alert_rules: str = ""
@@ -192,6 +198,7 @@ _ENV_MAP = {
     "scrape_url": "TPUDASH_SCRAPE_URL",
     "per_chip_panel_limit": "TPUDASH_PER_CHIP_PANEL_LIMIT",
     "state_path": "TPUDASH_STATE_PATH",
+    "assets_dir": "TPUDASH_ASSETS_DIR",
     "refresh_watchdog": "TPUDASH_REFRESH_WATCHDOG",
     "session_limit": "TPUDASH_SESSION_LIMIT",
     "session_ttl": "TPUDASH_SESSION_TTL",
