@@ -59,6 +59,18 @@ def _write_atomic(data: bytes, dest: str) -> str:
 
 
 def from_wheel(wheel_path: str, dest: str) -> str:
+    # the served URL is stamped with the plotly.js version the PIN's
+    # wheel bundles — extracting any other wheel (e.g. the reference's
+    # 6.0.1, which carries plotly.js 3.x) would serve the wrong major
+    # version under that URL.  Wheel filenames are PEP 427
+    # (name-version-...), so the check is cheap and offline.
+    base = os.path.basename(wheel_path)
+    parts = base.split("-")
+    if len(parts) >= 2 and parts[0] == "plotly" and parts[1] != PLOTLY_PIN:
+        raise SystemExit(
+            f"{base} is plotly {parts[1]}, but the page contract needs "
+            f"{PLOTLY_PIN} (bundles plotly.js {PLOTLY_JS_VERSION})"
+        )
     with zipfile.ZipFile(wheel_path) as zf:
         try:
             data = zf.read(ASSET_IN_WHEEL)

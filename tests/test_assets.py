@@ -77,13 +77,53 @@ def test_find_asset_falls_back_past_wrong_dir(tmp_path, monkeypatch):
     assert find_plotly_asset(str(empty)) == str(packaged / "plotly.min.js")
 
 
-def test_find_asset_none_in_this_image(monkeypatch):
-    # this build image has neither a packaged bundle nor a plotly package
-    # (zero egress) — resolution must come up empty, not crash
+def test_find_asset_none_without_any_source(monkeypatch):
+    # no packaged bundle, no importable plotly (stubbed — some dev
+    # machines have one): resolution must come up empty, not crash
+    import sys
+
     import tpudash.app.assets as assets_mod
 
     monkeypatch.setattr(assets_mod, "PACKAGED_ASSETS_DIR", "/nonexistent")
+    monkeypatch.setitem(sys.modules, "plotly", None)  # import → ImportError
     assert find_plotly_asset("") is None
+
+
+def test_find_asset_refuses_mismatched_installed_plotly(
+    tmp_path, monkeypatch
+):
+    # an installed plotly of the WRONG version must not have its bundle
+    # served under the version-stamped URL (page contract = plotly.js
+    # 2.32.0, the pin's bundle)
+    import sys
+    import types
+
+    import tpudash.app.assets as assets_mod
+
+    monkeypatch.setattr(assets_mod, "PACKAGED_ASSETS_DIR", "/nonexistent")
+    pkg = tmp_path / "plotly"
+    (pkg / "package_data").mkdir(parents=True)
+    (pkg / "package_data" / "plotly.min.js").write_bytes(STUB_JS)
+    fake = types.ModuleType("plotly")
+    fake.__file__ = str(pkg / "__init__.py")
+    fake.__version__ = "6.0.1"  # the reference's pin — bundles plotly.js 3.x
+    monkeypatch.setitem(sys.modules, "plotly", fake)
+    assert find_plotly_asset("") is None
+    fake.__version__ = assets_mod.PLOTLY_WHEEL_PIN
+    assert find_plotly_asset("") == str(
+        pkg / "package_data" / "plotly.min.js"
+    )
+
+
+def test_wheel_pin_constants_agree():
+    # the runtime resolver and the build-time extractor must name the
+    # same wheel, or Docker vendors one version and bare-metal another
+    from deploy.fetch_plotly import PLOTLY_JS_VERSION, PLOTLY_PIN
+    from tpudash.app.assets import PLOTLY_WHEEL_PIN
+    from tpudash.app.html import PLOTLY_VERSION
+
+    assert PLOTLY_PIN == PLOTLY_WHEEL_PIN
+    assert PLOTLY_JS_VERSION == PLOTLY_VERSION
 
 
 # -- page tag swap ---------------------------------------------------------
@@ -123,9 +163,12 @@ def test_vendored_asset_served_with_caching(tmp_path):
 
 
 def test_no_asset_serves_cdn_page_and_404(tmp_path, monkeypatch):
+    import sys
+
     import tpudash.app.assets as assets_mod
 
     monkeypatch.setattr(assets_mod, "PACKAGED_ASSETS_DIR", "/nonexistent")
+    monkeypatch.setitem(sys.modules, "plotly", None)
     server = _server(tmp_path, assets=False)
 
     async def go(client):
@@ -170,7 +213,9 @@ def test_fetch_plotly_extracts_from_wheel(tmp_path):
 
     from deploy.fetch_plotly import ASSET_IN_WHEEL, from_wheel
 
-    wheel = tmp_path / "plotly-0.0-py3-none-any.whl"
+    from deploy.fetch_plotly import PLOTLY_PIN
+
+    wheel = tmp_path / f"plotly-{PLOTLY_PIN}-py3-none-any.whl"
     with zipfile.ZipFile(wheel, "w") as zf:
         zf.writestr(ASSET_IN_WHEEL, STUB_JS)
     dest = tmp_path / "assets"
@@ -178,6 +223,22 @@ def test_fetch_plotly_extracts_from_wheel(tmp_path):
     out = from_wheel(str(wheel), str(dest))
     assert out == str(dest / "plotly.min.js")
     assert (dest / "plotly.min.js").read_bytes() == STUB_JS
+
+
+def test_fetch_plotly_rejects_wrong_version_wheel(tmp_path):
+    # the reference pins plotly 6.0.1 (plotly.js 3.x) — extracting it
+    # would serve the wrong major version under the 2.32.0-stamped URL
+    import zipfile
+
+    import pytest
+
+    from deploy.fetch_plotly import ASSET_IN_WHEEL, from_wheel
+
+    wheel = tmp_path / "plotly-6.0.1-py3-none-any.whl"
+    with zipfile.ZipFile(wheel, "w") as zf:
+        zf.writestr(ASSET_IN_WHEEL, STUB_JS)
+    with pytest.raises(SystemExit, match="6.0.1"):
+        from_wheel(str(wheel), str(tmp_path))
 
 
 def test_fetch_plotly_rejects_non_plotly_wheel(tmp_path):

@@ -104,3 +104,29 @@ def test_regression_guard_normalizes_by_cpu_reference(tmp_path):
     _write_prev(tmp_path, name="BENCH_r08.json", value=6.0, probes={})
     _, regs = find_regressions(slow_machine, bench_dir=str(tmp_path))
     assert [r["metric"] for r in regs] == ["value"]
+
+
+def test_regression_guard_prefers_frame_shaped_reference(tmp_path):
+    """When both rounds carry cpu_ref_json_ms, normalization uses it —
+    the matmul reference proved blind to the contention that actually
+    slows the frame path (r04: p50 +33% while matmul ref stayed flat)."""
+    _write_prev(
+        tmp_path, value=6.0, cpu_ref_ms=38.0, cpu_ref_json_ms=4.0, probes={}
+    )
+    # frame path and json ref slowed together (environment): clean
+    env_slow = dict(
+        _result(value=9.0), cpu_ref_ms=38.0, cpu_ref_json_ms=6.0
+    )
+    _, regs = find_regressions(env_slow, bench_dir=str(tmp_path))
+    assert regs == []
+    # frame path slowed, json ref flat → code regression, flags even
+    # though the matmul ref ALSO inflated (it must not mask this)
+    code_slow = dict(
+        _result(value=9.0), cpu_ref_ms=57.0, cpu_ref_json_ms=4.0
+    )
+    _, regs = find_regressions(code_slow, bench_dir=str(tmp_path))
+    assert [r["metric"] for r in regs] == ["value_per_cpu_ref"]
+    # one side missing the json ref → matmul ref comparison still works
+    matmul_only = dict(_result(value=8.4), cpu_ref_ms=53.2)
+    _, regs = find_regressions(matmul_only, bench_dir=str(tmp_path))
+    assert regs == []

@@ -33,6 +33,12 @@ log = logging.getLogger(__name__)
 
 PLOTLY_ASSET_NAME = "plotly.min.js"
 
+#: The plotly PYTHON package version whose bundled plotly.js matches the
+#: page contract (html.PLOTLY_VERSION = 2.32.0): plotly.py 5.22.0 ships
+#: exactly plotly.js 2.32.0.  Kept in lockstep with
+#: deploy/fetch_plotly.PLOTLY_PIN (pinned equal by tests/test_assets.py).
+PLOTLY_WHEEL_PIN = "5.22.0"
+
 #: Packaged drop point for the vendored bundle (kept in-tree as a
 #: directory so the wheel/package_data machinery has a stable home for it).
 PACKAGED_ASSETS_DIR = os.path.join(os.path.dirname(__file__), "assets")
@@ -58,13 +64,27 @@ def find_plotly_asset(assets_dir: str = "") -> "str | None":
     if os.path.isfile(packaged):
         return packaged
     try:
-        import plotly  # noqa: F401 — presence probe only
+        import plotly
 
-        bundled = os.path.join(
-            os.path.dirname(plotly.__file__), "package_data", PLOTLY_ASSET_NAME
-        )
-        if os.path.isfile(bundled):
-            return bundled
+        # the URL is version-stamped (html.PLOTLY_LOCAL_URL) and served
+        # with a long max-age: serving whatever plotly.js an arbitrary
+        # installed plotly happens to bundle would break both the page
+        # contract and the cache-busting guarantee — only the pinned
+        # package qualifies
+        if getattr(plotly, "__version__", None) == PLOTLY_WHEEL_PIN:
+            bundled = os.path.join(
+                os.path.dirname(plotly.__file__),
+                "package_data",
+                PLOTLY_ASSET_NAME,
+            )
+            if os.path.isfile(bundled):
+                return bundled
+        else:
+            log.info(
+                "installed plotly %s != pinned %s: not serving its bundle",
+                getattr(plotly, "__version__", "?"),
+                PLOTLY_WHEEL_PIN,
+            )
     except ImportError:
         pass
     return None

@@ -1,8 +1,8 @@
 """Dashboard page — single self-contained HTML document.
 
 Renders the frame JSON from ``/api/frame``.  Uses plotly.js when the page
-can load it — vendored and served by the dashboard itself at
-``/static/plotly.min.js`` when the asset is present (zero-egress rich UI,
+can load it — vendored and served by the dashboard itself at the
+version-stamped ``PLOTLY_LOCAL_URL`` when the asset is present (zero-egress rich UI,
 matching the reference's offline story where plotly is a pinned Python
 dependency), with the CDN as last resort; otherwise a built-in
 dependency-free renderer draws the same figure dicts as HTML/SVG
