@@ -308,7 +308,11 @@ def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
 
     out = {}
     for n in counts:
-        svc = _bench_service(N_CHIPS, refresh_interval=0.05)
+        # refresh_interval matches the stream loop's 0.25 s sleep floor
+        # (server.stream pacing): a smaller value would re-scrape inside
+        # one tick cluster whenever subscriber wakeups smear past it,
+        # billing phantom scrapes to the fan-out being measured
+        svc = _bench_service(N_CHIPS, refresh_interval=0.25)
         server = DashboardServer(svc)
         steady_bytes = [0]
 
@@ -317,40 +321,46 @@ def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
             await ts.start_server()
             url = ts.make_url("/api/stream")
             warm = [asyncio.Event() for _ in range(n)]
+            steady = asyncio.Event()
             marks = {}
 
             async def subscribe(session, i):
                 d = zlib.decompressobj(16 + zlib.MAX_WBITS)
-                events = 0
+                steady_events = 0
                 async with session.get(
                     url, headers={"Accept-Encoding": "gzip"}
                 ) as r:
                     assert r.headers.get("Content-Encoding") == "gzip"
                     buf = b""
                     async for chunk in r.content.iter_any():
-                        if events >= 1:
-                            # steady state only: the one-off full frame
-                            # is priced by sse_full_frame_bytes already
+                        if steady.is_set():
+                            # window-based wire accounting: everything a
+                            # subscriber receives in steady state counts
+                            # (keepalive comments included — they ARE a
+                            # tick's wire cost), the one-off full frame
+                            # does not (priced by sse_full_frame_bytes)
                             steady_bytes[0] += len(chunk)
                         buf += d.decompress(chunk)
                         while b"\n\n" in buf:
                             evt, buf = buf.split(b"\n\n", 1)
-                            if evt.startswith(b":"):
-                                continue  # keepalive comment
-                            events += 1
-                            if events == 1:
-                                warm[i].set()
-                        if events > ticks:
+                            if not warm[i].is_set():
+                                if not evt.startswith(b":"):
+                                    warm[i].set()  # baseline full frame
+                                continue
+                            if steady.is_set():
+                                steady_events += 1
+                        if steady_events >= ticks:
                             return
 
             async def mark_when_warm():
                 # barrier: the N full-frame serializations are setup,
-                # not tick cost — start the clock once every subscriber
-                # holds its baseline frame
+                # not tick cost — start the clocks (and the byte window)
+                # once every subscriber holds its baseline frame
                 for e in warm:
                     await e.wait()
                 marks["cpu0"] = _t.process_time()
                 marks["t0"] = _t.perf_counter()
+                steady.set()
 
             # auto_decompress off: we count the gzip bytes on the wire
             async with ClientSession(auto_decompress=False) as session:

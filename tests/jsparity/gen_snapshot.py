@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Generate the committed JS-parity snapshot (VERDICT r4 #3).
+
+``tests/jsmini.py`` executes the shipped generated JS in-repo, but it was
+written against the same grammar the in-repo transpiler emits — it cannot
+catch a place where jsmini and a real engine agree with each other and
+disagree with browsers.  No JS engine exists in this build image, so the
+escape hatch is a COMMITTED snapshot: the exact generated client JS text
+plus a corpus of (function, args, expected-output) cases whose expected
+values come from executing the fuzz-tested PYTHON source of truth
+(``tpudash/app/clientlogic.py``).  ``node_parity.mjs`` replays the corpus
+through the snapshot's JS on any machine with Node (CI's ubuntu runner
+has one), diffing against the committed expectations — real-engine
+verification without putting a JS engine in the image.
+
+Determinism: frames come from ``JsonReplaySource.synthetic`` (payloads
+pre-serialized at pinned timestamps) and the wall-clock-derived fields
+(``timings``, ``source_health``) are scrubbed to fixed per-tick values
+BEFORE the delta is computed — ``apply_delta`` treats them opaquely, so
+engine parity is unaffected and regeneration is byte-stable.  The pytest
+guard (tests/test_jsparity_snapshot.py) regenerates and diffs, so the
+snapshot cannot drift from the shipped client logic.
+
+Regenerate after changing clientlogic.py / pyjs.py:
+
+    python tests/jsparity/gen_snapshot.py
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "snapshot.json")
+
+
+def _jr(x):
+    """Into the JSON domain (tuples→lists etc.), as the browser sees it."""
+    return json.loads(json.dumps(x))
+
+
+def _scrub(frame: dict, tick: int) -> dict:
+    """Pin the wall-clock-derived scalar fields to deterministic values.
+    apply_delta copies these wholesale (delta.SCALAR_FIELDS), so any
+    value exercises the merge identically."""
+    frame = copy.deepcopy(frame)
+    frame["last_updated"] = f"2026-01-01 00:00:{tick:02d}"
+    frame["timings"] = {"total": {"p50_ms": 1.0 + tick, "p95_ms": 2.0 + tick}}
+    frame["source_health"] = {"status": "healthy", "tick": tick}
+    # trend x labels are wall-clock HH:MM:SS (history-ring append times);
+    # apply_delta copies them opaquely, so deterministic stand-ins
+    # exercise the same merge
+    for trend in frame.get("trends", []):
+        t = trend["figure"]["data"][0]
+        t["x"] = [f"t{tick}.{i}" for i in range(len(t["x"]))]
+    return frame
+
+
+def _frame_cases() -> list:
+    """(prev, delta) → merged frame over deterministic synthetic fleets,
+    with seeded selection/style churn so deltas cover device-row,
+    heatmap, trend, and average patches."""
+    from tpudash.app import clientlogic
+    from tpudash.app.delta import frame_delta
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    rng = random.Random(20260731)
+    cases = []
+    for chips in (3, 17):
+        svc = DashboardService(
+            Config(refresh_interval=0.0, synthetic_chips=chips),
+            JsonReplaySource.synthetic(chips, frames=8),
+        )
+        svc.render_frame()  # warm
+        prev, tick = _scrub(svc.render_frame(), 0), 1
+        made = 0
+        while made < 4:
+            mutate = rng.random()
+            if mutate < 0.3:
+                svc.state.toggle(
+                    f"slice-0/{rng.randrange(chips)}", svc.available
+                )
+            elif mutate < 0.4:
+                svc.state.use_gauge = not svc.state.use_gauge
+            cur = _scrub(svc.render_frame(), tick)
+            tick += 1
+            d = frame_delta(prev, cur)
+            if d is not None:
+                f, dd = _jr(prev), _jr(d)
+                expect = _jr(
+                    clientlogic.apply_delta(copy.deepcopy(f), copy.deepcopy(dd))
+                )
+                cases.append(
+                    {
+                        "fn": "apply_delta",
+                        "args": [f, dd],
+                        "result": "return",
+                        "expect": expect,
+                    }
+                )
+                made += 1
+            prev = cur
+    return cases
+
+
+def _scalar_cases() -> list:
+    """Fuzz grids for every non-frame client function, expectations from
+    the Python source of truth."""
+    from tpudash.app import clientlogic
+    from tpudash.colors import band_steps
+
+    rng = random.Random(20260801)
+    cases = []
+
+    def add(fn_name, args, result="return"):
+        fn = getattr(clientlogic, fn_name)
+        args_j = _jr(args)
+        call_args = copy.deepcopy(args_j)
+        out = fn(*call_args)
+        expect = _jr(call_args[0] if result == "arg0" else out)
+        cases.append(
+            {"fn": fn_name, "args": args_j, "result": result, "expect": expect}
+        )
+
+    # plan tables: the full truth table
+    for kind in ("delta", "full", "refetch", "weird"):
+        for has in (True, False):
+            add("stream_event_plan", [kind, has])
+    for closed in (True, False):
+        for timer in (True, False):
+            add("stream_error_plan", [closed, timer])
+
+    steps = _jr(band_steps(100.0))
+    scale = [[s["range"][0] / 100.0, s["color"]] for s in steps]
+    for _ in range(60):
+        v = round(rng.uniform(-40.0, 180.0), 3)
+        vmax = rng.choice([0.0, -5.0, 100.0, 150.0, 96.0, 1e9])
+        add("clamp_frac", [v, vmax])
+        add("color_from_scale", [scale, round(rng.random(), 4)])
+        add("meter_geometry", [v, vmax, steps])
+        key = rng.choice([None, "slice-0/3"])
+        val = rng.choice([None, v])
+        add("heat_cell", [val, key, vmax, scale])
+    for n in (0, 1, 2, 7, 30):
+        ys = [round(rng.uniform(0, 120), 2) for _ in range(n)]
+        add("spark_points", [ys, rng.choice([0.0, 100.0]), 160, 40])
+    # patch_fig mutates its figure argument in place
+    gauge_fig = {
+        "data": [
+            {
+                "type": "indicator",
+                "value": 10.0,
+                "gauge": {"bar": {"color": "#2ecc71"}, "axis": {}},
+            }
+        ]
+    }
+    bar_fig = {
+        "data": [
+            {"type": "bar", "x": [10.0], "marker": {"color": "#2ecc71"}}
+        ]
+    }
+    for fig in (gauge_fig, bar_fig):
+        add(
+            "patch_fig",
+            [fig, {"value": 73.25, "color": "#e74c3c"}],
+            result="arg0",
+        )
+    return cases
+
+
+def build_snapshot() -> dict:
+    from tpudash.app import clientlogic, html
+
+    return {
+        "comment": (
+            "GENERATED by tests/jsparity/gen_snapshot.py — do not edit. "
+            "client_js is the exact generated block served in the page "
+            "(pinned byte-identical by tests/test_client_parity.py); "
+            "expectations come from executing tpudash/app/clientlogic.py."
+        ),
+        "functions": [f.__name__ for f in clientlogic.CLIENT_FUNCTIONS],
+        "client_js": html.GENERATED_CLIENT_JS,
+        "cases": _frame_cases() + _scalar_cases(),
+    }
+
+
+def snapshot_text() -> str:
+    return json.dumps(build_snapshot(), indent=1, sort_keys=False) + "\n"
+
+
+def main() -> int:
+    text = snapshot_text()
+    with open(SNAPSHOT_PATH, "w") as f:
+        f.write(text)
+    n_cases = len(build_snapshot()["cases"])
+    print(f"wrote {SNAPSHOT_PATH}: {len(text)} bytes, {n_cases} cases")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+// Real-engine JS parity harness (VERDICT r4 #3).
+//
+// The build image that produces tpudash has NO JavaScript engine: the
+// generated client JS is verified there by an in-repo interpreter
+// (tests/jsmini.py), which cannot catch a spot where interpreter and
+// transpiler agree with each other and disagree with real engines.  This
+// script closes that gap on any machine with Node (CI's ubuntu runner):
+// it evaluates the EXACT generated client block served in the page
+// (snapshot.client_js — pinned byte-identical to the page by
+// tests/test_client_parity.py) and replays the committed corpus of
+// (function, args, expected) cases, where every expectation came from
+// executing the fuzz-tested Python source of truth
+// (tpudash/app/clientlogic.py via tests/jsparity/gen_snapshot.py).
+//
+//   node tests/jsparity/node_parity.mjs [snapshot.json]
+//
+// Exit 0 = every case byte-identical (canonical JSON, compared in the
+// JS value domain); exit 1 = divergence, with the first few diffs shown.
+
+import { readFileSync } from "node:fs";
+import { dirname, join } from "node:path";
+import { fileURLToPath } from "node:url";
+
+const here = dirname(fileURLToPath(import.meta.url));
+const snapPath = process.argv[2] || join(here, "snapshot.json");
+const snap = JSON.parse(readFileSync(snapPath, "utf8"));
+
+// Evaluate the generated block and capture the client functions.  The
+// block defines plain top-level functions (no DOM, no imports) — the
+// same text a browser executes inside the page's <script>.
+const factory = new Function(
+  `"use strict";\n${snap.client_js}\nreturn { ${snap.functions.join(", ")} };`
+);
+const fns = factory();
+
+// Canonical JSON: object keys sorted recursively, so Python-side and
+// JS-side serialization order cannot manufacture a diff.  Comparison
+// happens after JSON.parse, i.e. in the JS value domain (1.0 === 1),
+// matching what a browser holds after parsing a frame off the wire.
+function canon(x) {
+  if (Array.isArray(x)) return `[${x.map(canon).join(",")}]`;
+  if (x !== null && typeof x === "object") {
+    const keys = Object.keys(x).sort();
+    return `{${keys.map((k) => `${JSON.stringify(k)}:${canon(x[k])}`).join(",")}}`;
+  }
+  return JSON.stringify(x);
+}
+
+let failures = 0;
+const counts = {};
+for (let i = 0; i < snap.cases.length; i++) {
+  const c = snap.cases[i];
+  const fn = fns[c.fn];
+  if (typeof fn !== "function") {
+    console.error(`case ${i}: ${c.fn} is not a function in the generated block`);
+    failures++;
+    continue;
+  }
+  // deep-copy args: mutating functions (apply_delta, patch_fig) write
+  // into them, and the snapshot object must stay pristine for later cases
+  const args = structuredClone(c.args);
+  let got;
+  try {
+    const ret = fn(...args);
+    got = c.result === "arg0" ? args[0] : ret;
+  } catch (err) {
+    console.error(`case ${i}: ${c.fn} threw: ${err}`);
+    failures++;
+    continue;
+  }
+  const gotC = canon(got === undefined ? null : got);
+  const expC = canon(c.expect === undefined ? null : c.expect);
+  if (gotC !== expC) {
+    failures++;
+    if (failures <= 5) {
+      let at = 0;
+      while (at < gotC.length && gotC[at] === expC[at]) at++;
+      console.error(
+        `case ${i}: ${c.fn} diverged at char ${at}:\n` +
+          `  got    …${gotC.slice(Math.max(0, at - 60), at + 60)}…\n` +
+          `  expect …${expC.slice(Math.max(0, at - 60), at + 60)}…`
+      );
+    }
+  }
+  counts[c.fn] = (counts[c.fn] || 0) + 1;
+}
+
+const total = snap.cases.length;
+if (failures > 0) {
+  console.error(`JS parity: ${failures}/${total} cases diverged`);
+  process.exit(1);
+}
+console.log(
+  `JS parity OK: ${total} cases byte-identical on ${process.version} (` +
+    Object.entries(counts)
+      .map(([k, v]) => `${k}:${v}`)
+      .join(" ") +
+    ")"
+);

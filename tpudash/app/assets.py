@@ -80,7 +80,10 @@ def find_plotly_asset(assets_dir: str = "") -> "str | None":
             if os.path.isfile(bundled):
                 return bundled
         else:
-            log.info(
+            # warning, not info: an air-gapped deploy relying on this
+            # path degrades to the built-in renderer, and the operator
+            # debugging that needs the reason at default log level
+            log.warning(
                 "installed plotly %s != pinned %s: not serving its bundle",
                 getattr(plotly, "__version__", "?"),
                 PLOTLY_WHEEL_PIN,
