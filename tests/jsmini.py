@@ -427,6 +427,37 @@ class Interp:
                 return obj.get(e[2], UNDEFINED)
             raise JsError(f"member {e[2]} on {type(obj).__name__}")
         if op == "call":
+            # Object.keys — REAL engine ordering (OrdinaryOwnPropertyKeys):
+            # integer-like keys ascend numerically first, then the rest
+            # in insertion order — matching clientlogic.keys exactly
+            if e[1] == ("member", ("name", "Object"), "keys"):
+                (arg,) = e[2]
+                obj = self.eval(arg, scope)
+                if not isinstance(obj, dict):
+                    raise JsError("Object.keys on non-object")
+                def _idx(k):
+                    return (
+                        isinstance(k, str) and k.isdigit()
+                        and str(int(k)) == k and int(k) < 4294967295
+                    )
+                numeric = sorted((k for k in obj if _idx(k)), key=int)
+                return numeric + [k for k in obj if not _idx(k)]
+            # Object.prototype.hasOwnProperty.call(obj, k) — the OWN-
+            # membership test the transpiler emits for Python `in`
+            if e[1] == (
+                "member",
+                (
+                    "member",
+                    ("member", ("name", "Object"), "prototype"),
+                    "hasOwnProperty",
+                ),
+                "call",
+            ):
+                obj_e, key_e = e[2]
+                obj = self.eval(obj_e, scope)
+                if not isinstance(obj, dict):
+                    raise JsError("hasOwnProperty.call on non-object")
+                return self.eval(key_e, scope) in obj
             # Array.prototype.push — the one method the transpiler emits
             if e[1][0] == "member" and e[1][2] == "push":
                 obj = self.eval(e[1][1], scope)

@@ -62,10 +62,12 @@ def _scrub(frame: dict, tick: int) -> dict:
     return frame
 
 
-def _frame_cases() -> list:
+def _frame_cases() -> "tuple[list, list]":
     """(prev, delta) → merged frame over deterministic synthetic fleets,
     with seeded selection/style churn so deltas cover device-row,
-    heatmap, trend, and average patches."""
+    heatmap, trend, and average patches.  Also returns the JSON-domain
+    frames themselves so the view-model cases run over REAL frame data,
+    not hand-built approximations."""
     from tpudash.app import clientlogic
     from tpudash.app.delta import frame_delta
     from tpudash.app.service import DashboardService
@@ -74,10 +76,15 @@ def _frame_cases() -> list:
 
     rng = random.Random(20260731)
     cases = []
-    for chips in (3, 17):
+    frames = []
+    for chips, slices in ((3, 1), (17, 1), (8, 2)):
         svc = DashboardService(
-            Config(refresh_interval=0.0, synthetic_chips=chips),
-            JsonReplaySource.synthetic(chips, frames=8),
+            Config(
+                refresh_interval=0.0,
+                synthetic_chips=chips,
+                synthetic_slices=slices,
+            ),
+            JsonReplaySource.synthetic(chips, frames=8, num_slices=slices),
         )
         svc.render_frame()  # warm
         prev, tick = _scrub(svc.render_frame(), 0), 1
@@ -108,6 +115,130 @@ def _frame_cases() -> list:
                 )
                 made += 1
             prev = cur
+        frames.append(_jr(prev))
+    return cases, frames
+
+
+def _model_cases(frames: list) -> list:
+    """View-model functions (VERDICT r4 #4 migration) over the REAL
+    frames: renderer dispatch for every figure a frame carries, table
+    models over real stats/breakdown, grid model over real chip lists,
+    banner models over real + synthesized alert lists."""
+    from tpudash.app import clientlogic
+
+    cases = []
+
+    def add(fn_name, args, result="return"):
+        fn = getattr(clientlogic, fn_name)
+        args_j = _jr(args)
+        call_args = copy.deepcopy(args_j)
+        out = fn(*call_args)
+        expect = _jr(call_args[0] if result == "arg0" else out)
+        cases.append(
+            {"fn": fn_name, "args": args_j, "result": result, "expect": expect}
+        )
+
+    for frame in frames:
+        figures = []
+        if frame.get("average"):
+            figures += [f["figure"] for f in frame["average"]["figures"]]
+        figures += [t["figure"] for t in frame.get("trends", [])]
+        for row in frame.get("device_rows", [])[:2]:
+            figures += [f["figure"] for f in row["figures"]]
+        figures += [h["figure"] for h in frame.get("heatmaps", [])[:3]]
+        for fig in figures:
+            add("figure_render_plan", [fig])
+            add("figure_title", [fig])
+        add("chip_grid_model", [frame["chips"]])
+        add("stats_table_model", [frame.get("stats", {})])
+        add(
+            "breakdown_table_model",
+            [frame.get("breakdown", None), frame.get("panel_specs", None)],
+        )
+        add("alert_banner_model", [frame.get("alerts", [])])
+        add("straggler_banner_model", [frame.get("stragglers", [])])
+        add("firing_entries", [frame.get("alerts", [])])
+    # banner models over a synthesized spread: silenced/critical/missing
+    # fields, >8 truncation — states the deterministic fleets may not hit
+    alerts = []
+    for i in range(12):
+        a = {
+            "state": "firing" if i % 3 != 2 else "pending",
+            "chip": f"slice-0/{i}",
+            "rule": "util<5",
+            "value": i * 1.5,
+        }
+        if i % 4 == 0:
+            a["silenced"] = i % 8 == 0
+        if i % 5 == 0:
+            a["severity"] = "critical"
+        alerts.append(a)
+    add("alert_banner_model", [alerts])
+    add("alert_banner_model", [None])
+    add("straggler_banner_model", [None])
+    stragglers = [
+        {"state": "firing" if i % 2 == 0 else "pending", "chip": f"s/{i}",
+         "column": "util", "value": i, "median": 50, "z": -3.5}
+        for i in range(20)
+    ]
+    add("straggler_banner_model", [stragglers])
+    add("firing_entries", [stragglers])
+    add("firing_entries", [None])
+    # drill-down response policy: the full truth table
+    for failed in (True, False):
+        for current in (None, "s/1", "s/2"):
+            for status in (0, 200, 204, 404, 500, 302):
+                add("drill_response_plan", ["s/1", current, status, failed])
+    # acknowledge-button contract
+    add("silence_toggle_request", ["util<5", "s/3", True])
+    add("silence_toggle_request", ["util<5", "s/3", False])
+    # replay scrub mapping
+    add("replay_seek_request", [7])
+    add("replay_toggle_request", [True])
+    add("replay_toggle_request", [False])
+    for pos in (
+        {"index": None, "total": 10, "paused": False},
+        {"index": 0, "total": 10, "paused": True, "ts": 1700000000.5},
+        {"index": 9, "total": 10, "paused": False, "ts": None},
+    ):
+        for active in (True, False):
+            add("replay_bar_model", [pos, active])
+    # title/band edge cases the real figures may not exercise
+    add("figure_title", [{"data": [{"title": {"text": ""}}],
+                          "layout": {"title": {"text": "fallback"}}}])
+    add("figure_title", [{"data": [{}], "layout": {}}])
+    add("bar_band_steps", [{"shapes": None}])
+    add("bar_band_steps", [{}])
+    # adversarial keys a real engine treats specially: integer-like keys
+    # reorder under Object.keys (numeric ascending first), and
+    # prototype-property names ("toString", "__proto__", "constructor")
+    # poison naive `in` membership — these cases exist precisely so the
+    # Node job exercises both divergence classes on a real engine
+    tricky_rows = {
+        "10": {"chips": 4, "util": 50.0},
+        "2": {"chips": 4, "util": 60.0},
+        "toString": {"chips": 2, "util": 70.0},
+        "host-a": {"chips": 1, "util": 80.0},
+    }
+    add(
+        "breakdown_table_model",
+        [
+            {"by_host": tricky_rows},
+            [{"column": "util", "title": "MXU%", "unit": "%"}],
+        ],
+    )
+    add(
+        "stats_table_model",
+        [{"10": {"mean": 1.0}, "2": {"mean": 2.0}, "z": {"mean": 3.0}}],
+    )
+    tricky_chips = [
+        {"slice": "toString", "key": "toString/0", "selected": True},
+        {"slice": "constructor", "key": "constructor/1", "selected": False},
+        {"slice": "toString", "key": "toString/2", "selected": True},
+        {"slice": "__proto__", "key": "__proto__/7", "selected": True},
+        {"slice": "slice-0", "key": "slice-0/0", "selected": False},
+    ]
+    add("chip_grid_model", [tricky_chips])
     return cases
 
 
@@ -179,6 +310,7 @@ def _scalar_cases() -> list:
 def build_snapshot() -> dict:
     from tpudash.app import clientlogic, html
 
+    frame_cases, frames = _frame_cases()
     return {
         "comment": (
             "GENERATED by tests/jsparity/gen_snapshot.py — do not edit. "
@@ -188,7 +320,7 @@ def build_snapshot() -> dict:
         ),
         "functions": [f.__name__ for f in clientlogic.CLIENT_FUNCTIONS],
         "client_js": html.GENERATED_CLIENT_JS,
-        "cases": _frame_cases() + _scalar_cases(),
+        "cases": frame_cases + _model_cases(frames) + _scalar_cases(),
     }
 
 
@@ -197,11 +329,11 @@ def snapshot_text() -> str:
 
 
 def main() -> int:
-    text = snapshot_text()
+    snap = build_snapshot()
+    text = json.dumps(snap, indent=1, sort_keys=False) + "\n"
     with open(SNAPSHOT_PATH, "w") as f:
         f.write(text)
-    n_cases = len(build_snapshot()["cases"])
-    print(f"wrote {SNAPSHOT_PATH}: {len(text)} bytes, {n_cases} cases")
+    print(f"wrote {SNAPSHOT_PATH}: {len(text)} bytes, {len(snap['cases'])} cases")
     return 0
 
 

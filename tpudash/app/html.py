@@ -127,7 +127,12 @@ PAGE = r"""<!DOCTYPE html>
     <span class="hint">click a heatmap cell for chip detail &middot; shift-click toggles selection</span>
   </div>
   <div id="chip-grid"></div>
-  <div id="replay-bar" style="display:none"></div>
+  <div id="replay-bar" style="display:none">
+    <span class="row-title">Replay</span>
+    <button id="replay-pause"></button>
+    <input id="replay-slider" type="range" min="0" step="1" style="width: 40%; vertical-align: middle">
+    <span id="replay-label" class="hint"></span>
+  </div>
   <div id="drill"></div>
   <div id="panels"></div>
   <div class="row-title">Statistics (selected chips)</div>
@@ -144,29 +149,30 @@ const esc = s => String(s).replace(/[&<>"']/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 
 // ---- dependency-free fallback renderer over the same figure dicts --------
-// All decisions (band geometry, colorscale selection, cell
-// classification, sparkline scaling) come from the GENERATED client
-// logic below — these functions only assemble DOM strings around it.
-function renderMeter(el, title, value, maxVal, steps, color) {
-  const g = meter_geometry(value, maxVal, steps || []);
+// All decisions — dispatch, parameter extraction, band geometry,
+// colorscale selection, cell classification, sparkline scaling — come
+// from the GENERATED figure_render_plan / meter_geometry / heat_cell /
+// spark_points below; these functions only assemble DOM strings around
+// fully-decided plans.
+function renderMeter(el, plan) {
+  const g = meter_geometry(plan.value, plan.max, plan.steps || []);
   let bands = '';
   for (const b of g.bands) {
     bands += `<div class="band" style="left:${b.left}%;width:${b.width}%;background:${b.color}"></div>`;
   }
-  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
-    <div class="fig-value" style="color:${esc(color)}">${(+value).toFixed(1)}</div>
-    <div class="meter">${bands}<div class="fill" style="width:${g.pct}%;background:${esc(color)}"></div></div>
-    <div class="fig-title">max ${+maxVal}</div>`;
+  el.innerHTML = `<div class="fig-title">${esc(plan.title)}</div>
+    <div class="fig-value" style="color:${esc(plan.color)}">${(+plan.value).toFixed(1)}</div>
+    <div class="meter">${bands}<div class="fill" style="width:${g.pct}%;background:${esc(plan.color)}"></div></div>
+    <div class="fig-title">max ${+plan.max}</div>`;
 }
 
-function renderHeatFallback(el, trace, layoutTitle) {
-  const z = trace.z, zmax = trace.zmax || 100, cd = trace.customdata;
-  const cols = z.length ? z[0].length : 0;
+function renderHeatFallback(el, plan) {
+  const z = plan.z, cd = plan.customdata;
   let cells = '';
   for (let y = 0; y < z.length; y++) for (let x = 0; x < z[y].length; x++) {
     const v = z[y][x];
     const key = (cd && cd[y] && cd[y][x]) || null;
-    const cell = heat_cell(v === undefined ? null : v, key, zmax, trace.colorscale);
+    const cell = heat_cell(v === undefined ? null : v, key, plan.zmax, plan.colorscale);
     if (cell.kind === 'blank') {
       cells += '<div style="background:transparent"></div>';
     } else if (cell.kind === 'deselected') {
@@ -177,8 +183,8 @@ function renderHeatFallback(el, trace, layoutTitle) {
                (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
     }
   }
-  el.innerHTML = `<div class="fig-title">${esc(layoutTitle)}</div>
-    <div class="heat" style="grid-template-columns:repeat(${+cols},1fr)">${cells}</div>`;
+  el.innerHTML = `<div class="fig-title">${esc(plan.title)}</div>
+    <div class="heat" style="grid-template-columns:repeat(${+plan.cols},1fr)">${cells}</div>`;
   el.querySelector('.heat').addEventListener('click', e => {
     const key = e.target.getAttribute && e.target.getAttribute('data-key');
     if (!key) return;
@@ -187,19 +193,16 @@ function renderHeatFallback(el, trace, layoutTitle) {
   });
 }
 
-function renderLineFallback(el, trace, fig, title) {
-  const ys = trace.y, n = ys.length;
-  const ymax = (fig.layout.yaxis.range && fig.layout.yaxis.range[1]) || Math.max(...ys, 1);
+function renderLineFallback(el, plan) {
   const W = 240, H = 64;
   let pts = '';
-  for (const p of spark_points(ys, ymax, W, H)) {
+  for (const p of spark_points(plan.ys, plan.ymax, W, H)) {
     pts += `${p[0].toFixed(1)},${p[1].toFixed(1)} `;
   }
-  const col = trace.line.color;
-  el.innerHTML = `<div class="fig-title">${esc(title)}</div>
+  el.innerHTML = `<div class="fig-title">${esc(plan.title)}</div>
     <svg viewBox="0 0 ${W} ${H}" style="width:100%;height:64px;background:#f2f6fa;border-radius:4px">
-      <polyline points="${pts}" fill="none" stroke="${esc(col)}" stroke-width="2"/></svg>
-    <div class="fig-title">now ${(+ys[n-1]).toFixed(1)} · max ${+ymax}</div>`;
+      <polyline points="${pts}" fill="none" stroke="${esc(plan.color)}" stroke-width="2"/></svg>
+    <div class="fig-title">now ${(+plan.last).toFixed(1)} · max ${+plan.ymax}</div>`;
 }
 
 function renderFigure(el, fig) {
@@ -217,18 +220,10 @@ function renderFigure(el, fig) {
     }
     return;
   }
-  const t = fig.data[0];
-  const title = (t.title && t.title.text) || (fig.layout.title && fig.layout.title.text) || '';
-  if (t.type === 'indicator') {
-    renderMeter(el, title, t.value, t.gauge.axis.range[1], t.gauge.steps, t.gauge.bar.color);
-  } else if (t.type === 'bar') {
-    const steps = (fig.layout.shapes || []).map(s => ({range: [s.x0, s.x1], color: s.fillcolor}));
-    renderMeter(el, title, t.x[0], fig.layout.xaxis.range[1], steps, t.marker.color);
-  } else if (t.type === 'heatmap') {
-    renderHeatFallback(el, t, title);
-  } else if (t.type === 'scatter') {
-    renderLineFallback(el, t, fig, title);
-  }
+  const plan = figure_render_plan(fig);
+  if (plan.kind === 'meter') renderMeter(el, plan);
+  else if (plan.kind === 'heat') renderHeatFallback(el, plan);
+  else if (plan.kind === 'spark') renderLineFallback(el, plan);
 }
 
 // ---- state + API ----------------------------------------------------------
@@ -248,10 +243,13 @@ function authHeaders(extra) {
   return h;
 }
 
+function postJson(url, body) {
+  return fetch(url, {method: 'POST',
+                     headers: authHeaders({'Content-Type': 'application/json'}),
+                     body: JSON.stringify(body)});
+}
 async function post(url, body) {
-  await fetch(url, {method: 'POST',
-                    headers: authHeaders({'Content-Type': 'application/json'}),
-                    body: JSON.stringify(body)});
+  await postJson(url, body);
   await refresh();
 }
 
@@ -275,14 +273,15 @@ function closeDrill() {
 async function refreshDrill() {
   const key = drillKey;  // snapshot: user may close / switch mid-fetch
   if (!key) return;
-  let resp;
+  let resp = null;
   try {
     resp = await fetch('/api/chip?key=' + encodeURIComponent(key),
                        {headers: authHeaders()});
-  } catch (e) { return; /* transient: keep the last detail */ }
-  if (drillKey !== key) return;  // closed or moved on — drop the response
-  if (resp.status === 404) { closeDrill(); return; /* chip left the fleet */ }
-  if (!resp.ok) return;  // transient server/auth hiccup: keep last detail
+  } catch (e) { /* transient */ }
+  // the stale/404/transient policy is the GENERATED drill_response_plan
+  const plan = drill_response_plan(key, drillKey, resp ? resp.status : 0, !resp);
+  if (plan === 'drop' || plan === 'keep') return;
+  if (plan === 'close') { closeDrill(); return; /* chip left the fleet */ }
   const detail = await resp.json();
   if (drillKey === key) renderDrill(detail);
 }
@@ -293,7 +292,7 @@ function renderDrill(d) {
   let html = `<div class="drill-head"><span class="row-title">TPU ${+d.chip_id}` +
     ` &mdash; ${esc(d.slice)} / ${esc(d.host)} (${esc(d.model)})</span>` +
     `<button id="drill-close">close</button></div>`;
-  const firing = (d.alerts || []).filter(a => a.state === 'firing');
+  const firing = firing_entries(d.alerts || []);
   if (firing.length) {
     // each firing alert gets a one-click acknowledge (1h silence) /
     // unsilence toggle — the operator workflow, not just the signal
@@ -304,7 +303,7 @@ function renderDrill(d) {
                  (a.silenced ? 'unsilence' : 'silence 1h') + '</button>'
                 ).join(' · ') + '</div>';
   }
-  const lagging = (d.stragglers || []).filter(s => s.state === 'firing');
+  const lagging = firing_entries(d.stragglers || []);
   if (lagging.length) {
     html += `<div class="drill-alerts" style="color:#2a4a78">🐢 straggler: ` +
       lagging.map(s => esc(s.column) + ' ' + (+s.value) + ' vs fleet ' +
@@ -329,15 +328,8 @@ function renderDrill(d) {
       '</div>';
   }
   el.innerHTML = html;
-  for (const [rowId, figs] of [['drill-gauges', d.figures], ['drill-trends', d.trends]]) {
-    const row = document.getElementById(rowId);
-    for (const f of figs || []) {
-      const cell = document.createElement('div');
-      cell.className = 'panel';
-      row.appendChild(cell);
-      renderFigure(cell, f.figure);
-    }
-  }
+  figureCells(document.getElementById('drill-gauges'), d.figures);
+  figureCells(document.getElementById('drill-trends'), d.trends);
   document.getElementById('drill-close').addEventListener('click', closeDrill);
   for (const btn of el.querySelectorAll('.neighbors button, table.links button')) {
     btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
@@ -345,12 +337,8 @@ function renderDrill(d) {
   for (const btn of el.querySelectorAll('.silence-btn')) {
     btn.addEventListener('click', async () => {
       const a = firing[+btn.getAttribute('data-i')];
-      const path = a.silenced ? '/api/alerts/unsilence' : '/api/alerts/silence';
-      const body = a.silenced ? {rule: a.rule, chip: a.chip}
-                              : {rule: a.rule, chip: a.chip, ttl_s: 3600};
-      await fetch(path, {method: 'POST',
-        headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
-        body: JSON.stringify(body)});
+      const req = silence_toggle_request(a.rule, a.chip, a.silenced === true);
+      await postJson(req.path, req.body);
       refreshDrill(); refresh();
     });
   }
@@ -359,17 +347,17 @@ function renderDrill(d) {
 function renderChips(chips) {
   const grid = document.getElementById('chip-grid');
   grid.innerHTML = '';
-  // multi-slice fleets: one-click slice selection above the checkbox grid
-  const slices = [...new Set(chips.map(c => c.slice))];
-  if (slices.length > 1) {
+  // grouping/count decisions are the GENERATED chip_grid_model
+  const model = chip_grid_model(chips);
+  if (model.show_bar) {
+    // multi-slice fleets: one-click slice selection above the grid
     const bar = document.createElement('div');
     bar.className = 'slice-bar';
-    for (const s of slices) {
-      const keys = chips.filter(c => c.slice === s).map(c => c.key);
+    for (const s of model.slices) {
       const btn = document.createElement('button');
-      btn.textContent = `${s} (${keys.length})`;
-      btn.title = `select only ${s}`;
-      btn.addEventListener('click', () => post('/api/select', {selected: keys}));
+      btn.textContent = `${s.slice} (${s.keys.length})`;
+      btn.title = `select only ${s.slice}`;
+      btn.addEventListener('click', () => post('/api/select', {selected: s.keys}));
       bar.appendChild(btn);
     }
     grid.appendChild(bar);
@@ -384,7 +372,16 @@ function renderChips(chips) {
     grid.appendChild(label);
   }
   document.getElementById('chip-count').textContent =
-    chips.filter(c => c.selected).length + ' / ' + chips.length + ' chips selected';
+    model.selected + ' / ' + model.total + ' chips selected';
+}
+
+function figureCells(row, figs) {
+  for (const f of figs || []) {
+    const cell = document.createElement('div');
+    cell.className = 'panel';
+    row.appendChild(cell);
+    renderFigure(cell, f.figure);
+  }
 }
 
 function panelRow(container, rowTitle, figures) {
@@ -393,32 +390,23 @@ function panelRow(container, rowTitle, figures) {
   container.appendChild(title);
   const row = document.createElement('div');
   row.className = 'panel-row';
-  for (const f of figures) {
-    const cell = document.createElement('div');
-    cell.className = 'panel';
-    row.appendChild(cell);
-    renderFigure(cell, f.figure);
-  }
+  figureCells(row, figures);
   container.appendChild(row);
 }
 
 function renderBreakdown(bd, panelSpecs) {
+  // column selection / titles / row cells are the GENERATED
+  // breakdown_table_model; this only prints the table
   const el = document.getElementById('breakdown');
-  if (!bd || !Object.keys(bd).length) { el.innerHTML = ''; return; }
-  const titles = {by_slice: 'Per-slice averages', by_host: 'Per-host averages'};
   let html = '';
-  for (const dim of Object.keys(bd)) {
-    const rows = bd[dim];
-    const keys = Object.keys(rows);
-    const cols = (panelSpecs || []).filter(p => keys.some(k => p.column in rows[k]));
-    html += `<div class="row-title">${esc(titles[dim] || dim)}</div><table><tr><th>${dim === 'by_host' ? 'host' : 'slice'}</th><th>chips</th>`;
-    for (const p of cols) html += `<th>${esc(p.title)}</th>`;
+  for (const tbl of breakdown_table_model(bd || null, panelSpecs || null)) {
+    html += `<div class="row-title">${esc(tbl.title)}</div><table><tr><th>${esc(tbl.head)}</th><th>chips</th>`;
+    for (const p of tbl.cols) html += `<th>${esc(p.title)}</th>`;
     html += '</tr>';
-    for (const k of keys) {
-      html += `<tr><td>${esc(k)}</td><td>${+rows[k].chips}</td>`;
-      for (const p of cols) {
-        const v = rows[k][p.column];
-        html += `<td>${v === undefined ? '—' : +v}</td>`;
+    for (const row of tbl.rows) {
+      html += `<tr><td>${esc(row[0])}</td>`;
+      for (let i = 1; i < row.length; i++) {
+        html += `<td>${row[i] === null ? '—' : +row[i]}</td>`;
       }
       html += '</tr>';
     }
@@ -429,17 +417,13 @@ function renderBreakdown(bd, panelSpecs) {
 
 function renderStats(stats) {
   const el = document.getElementById('stats');
-  const metrics = Object.keys(stats);
-  if (!metrics.length) { el.innerHTML = '<em>no data</em>'; return; }
-  // mean/max/min = reference parity; p50/p95 = fleet-scale additions
-  const keys = ['mean', 'p50', 'p95', 'max', 'min']
-    .filter(k => k in (stats[metrics[0]] || {}));
+  const model = stats_table_model(stats);
+  if (!model.metrics.length) { el.innerHTML = '<em>no data</em>'; return; }
   let html = '<table><tr><th>metric</th>' +
-    keys.map(k => `<th>${k}</th>`).join('') + '</tr>';
-  for (const m of metrics) {
-    const s = stats[m];
-    html += `<tr><td>${esc(m)}</td>` +
-      keys.map(k => `<td>${k in s ? +s[k] : '—'}</td>`).join('') + '</tr>';
+    model.cols.map(k => `<th>${k}</th>`).join('') + '</tr>';
+  for (let i = 0; i < model.metrics.length; i++) {
+    html += `<tr><td>${esc(model.metrics[i])}</td>` +
+      model.rows[i].map(v => `<td>${v === null ? '—' : +v}</td>`).join('') + '</tr>';
   }
   el.innerHTML = html + '</table>';
 }
@@ -549,39 +533,29 @@ document.getElementById('select-none').addEventListener('click',
 // or freeze the bar), true = replaying, false = definitively not (404).
 let replayActive = null;
 
+// scrub/pause → request bodies are the GENERATED replay_*_request
+document.getElementById('replay-slider').addEventListener('change',
+  async e => {
+    const r = await postJson('/api/replay', replay_seek_request(+e.target.value));
+    if (r.ok) { renderReplayPosition(await r.json()); refresh(); }
+  });
+document.getElementById('replay-pause').addEventListener('click',
+  async () => {
+    const r = await postJson('/api/replay', replay_toggle_request(replayPaused));
+    if (r.ok) renderReplayPosition(await r.json());
+  });
+
 function renderReplayPosition(pos) {
-  const bar = document.getElementById('replay-bar');
-  bar.style.display = 'block';
-  if (!bar.dataset.built) {
-    bar.dataset.built = '1';
-    bar.innerHTML = '<span class="row-title">Replay</span> ' +
-      '<button id="replay-pause"></button> ' +
-      '<input id="replay-slider" type="range" min="0" step="1" ' +
-      'style="width: 40%; vertical-align: middle"> ' +
-      '<span id="replay-label" class="hint"></span>';
-    document.getElementById('replay-slider').addEventListener('change',
-      async e => {
-        const r = await fetch('/api/replay', {method: 'POST',
-          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
-          body: JSON.stringify({index: +e.target.value, paused: true})});
-        if (r.ok) { renderReplayPosition(await r.json()); refresh(); }
-      });
-    document.getElementById('replay-pause').addEventListener('click',
-      async () => {
-        const r = await fetch('/api/replay', {method: 'POST',
-          headers: Object.assign({'Content-Type': 'application/json'}, authHeaders()),
-          body: JSON.stringify({paused: !replayPaused})});
-        if (r.ok) renderReplayPosition(await r.json());
-      });
-  }
-  replayPaused = pos.paused;
+  banner('replay-bar', true);
   const slider = document.getElementById('replay-slider');
-  slider.max = pos.total - 1;
-  if (pos.index !== null && document.activeElement !== slider) slider.value = pos.index;
-  document.getElementById('replay-pause').textContent = pos.paused ? '▶ resume' : '⏸ pause';
+  const m = replay_bar_model(pos, document.activeElement === slider);
+  replayPaused = m.paused;
+  slider.max = m.max;
+  if (m.set_value !== null) slider.value = m.set_value;
+  document.getElementById('replay-pause').textContent = m.paused ? '▶ resume' : '⏸ pause';
   document.getElementById('replay-label').textContent =
-    (pos.index === null ? '—' : (pos.index + 1)) + '/' + pos.total +
-    (pos.ts ? ' · ' + new Date(pos.ts * 1000).toLocaleTimeString() : '');
+    (m.pos === null ? '—' : m.pos) + '/' + m.total +
+    (m.ts !== null ? ' · ' + new Date(m.ts * 1000).toLocaleTimeString() : '');
 }
 let replayPaused = false;
 
@@ -596,42 +570,44 @@ async function pollReplay() {
 }
 pollReplay();
 
+function banner(id, show) {
+  const b = document.getElementById(id);
+  b.style.display = show ? 'block' : 'none';
+  return b;
+}
+
 function showError(msg) {
-  const b = document.getElementById('error-banner');
-  if (msg) { b.style.display = 'block'; b.textContent = msg; }
-  else b.style.display = 'none';
+  banner('error-banner', !!msg).textContent = msg || '';
 }
 
 function showAlerts(list) {
-  const b = document.getElementById('alert-banner');
-  // silenced (acknowledged) alerts never drive the banner; they stay
-  // visible as a count so the acknowledgement itself is visible
-  const firing = (list || []).filter(a => a.state === 'firing' && !a.silenced);
-  const silenced = (list || []).filter(a => a.state === 'firing' && a.silenced);
-  if (!firing.length && !silenced.length) { b.style.display = 'none'; return; }
-  const critical = firing.some(a => a.severity === 'critical');
-  b.className = (firing.length && critical) ? '' : 'warning';
-  b.style.display = 'block';
-  b.textContent = (firing.length
-    ? '\u26a0 ' + firing.length + ' alert(s): ' + firing.slice(0, 8)
+  // silenced (acknowledged) alerts never drive the banner; membership,
+  // severity class, truncation, and the silenced count all come from
+  // the GENERATED alert_banner_model
+  const m = alert_banner_model(list || null);
+  const b = banner('alert-banner', m.show);
+  if (!m.show) return;
+  b.className = m.warning ? 'warning' : '';
+  b.textContent = (m.firing_total
+    ? '\u26a0 ' + m.firing_total + ' alert(s): ' + m.firing
       .map(a => a.chip + ' ' + a.rule + ' (=' + a.value + ')').join(' \u00b7 ') +
-      (firing.length > 8 ? ' \u2026' : '')
+      (m.truncated ? ' \u2026' : '')
     : '') +
-    (silenced.length ? ' \ud83d\udd07 ' + silenced.length + ' silenced' : '');
+    (m.silenced ? ' \ud83d\udd07 ' + m.silenced + ' silenced' : '');
 }
 
 function showStragglers(list) {
   // fleet outliers gating SPMD lockstep (tpudash.stragglers) — each chip
-  // is a button into its drill-down
-  const b = document.getElementById('straggler-banner');
-  const firing = (list || []).filter(s => s.state === 'firing');
-  if (!firing.length) { b.style.display = 'none'; return; }
-  b.style.display = 'block';
-  b.innerHTML = '🐢 ' + firing.length + ' straggler(s): ' +
-    firing.slice(0, 8).map(s =>
+  // is a button into its drill-down; membership and truncation are the
+  // GENERATED straggler_banner_model
+  const m = straggler_banner_model(list || null);
+  const b = banner('straggler-banner', m.show);
+  if (!m.show) return;
+  b.innerHTML = '🐢 ' + m.total + ' straggler(s): ' +
+    m.entries.map(s =>
       `<button data-chip="${esc(s.chip)}">${esc(s.chip)}</button> ` +
       `${esc(s.column)} ${+s.value} vs fleet ${+s.median} (z=${+s.z})`
-    ).join(' · ') + (firing.length > 8 ? ' …' : '');
+    ).join(' · ') + (m.truncated ? ' …' : '');
   for (const btn of b.querySelectorAll('button')) {
     btn.addEventListener('click', () => showChip(btn.getAttribute('data-chip')));
   }
@@ -639,19 +615,17 @@ function showStragglers(list) {
 
 function showPanelGaps(list) {
   // a core panel the source can't feed is declared, never silently absent
-  const b = document.getElementById('gap-note');
+  const b = banner('gap-note', !!(list && list.length));
   if (list && list.length) {
-    b.style.display = 'block';
     b.innerHTML = 'Hidden panels: ' + list.map(g =>
       `<span title="${esc(g.reason)}">${esc(g.title)}</span>`).join(' · ') +
       ' <small>(hover for why)</small>';
-  } else b.style.display = 'none';
+  }
 }
 
 function showWarnings(list) {
-  const b = document.getElementById('warning-banner');
-  if (list && list.length) { b.style.display = 'block'; b.textContent = 'Degraded: ' + list.join(' · '); }
-  else b.style.display = 'none';
+  const b = banner('warning-banner', !!(list && list.length));
+  if (list && list.length) b.textContent = 'Degraded: ' + list.join(' · ');
 }
 
 document.addEventListener('visibilitychange', () => {

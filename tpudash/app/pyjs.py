@@ -110,10 +110,23 @@ class _Fn:
                 raise TranspileError("chained comparisons unsupported")
             op, right = node.ops[0], node.comparators[0]
             left = self.expr(node.left)
+            # OWN-property membership, not JS `in`: a data-controlled key
+            # named "toString"/"constructor"/"__proto__" would be found
+            # on Object.prototype by `in`, silently diverging from
+            # Python dict membership (and crashing whatever indexes with
+            # the inherited value next)
             if isinstance(op, ast.In):
-                return f"({self.expr(right)} != null && {left} in {self.expr(right)})"
+                return (
+                    f"({self.expr(right)} != null && "
+                    f"Object.prototype.hasOwnProperty.call("
+                    f"{self.expr(right)}, {left}))"
+                )
             if isinstance(op, ast.NotIn):
-                return f"!({self.expr(right)} != null && {left} in {self.expr(right)})"
+                return (
+                    f"!({self.expr(right)} != null && "
+                    f"Object.prototype.hasOwnProperty.call("
+                    f"{self.expr(right)}, {left}))"
+                )
             if isinstance(op, (ast.Is, ast.IsNot)):
                 # only `is [not] None`, mapped to LOOSE null equality: JS
                 # has both null and undefined where Python has None, and
@@ -171,6 +184,15 @@ class _Fn:
             if node.func.id == "len":
                 (arg,) = node.args
                 return f"{self.expr(arg)}.length"
+            if node.func.id == "keys":
+                # Object.keys follows JS OrdinaryOwnPropertyKeys order:
+                # integer-like keys ascend numerically FIRST, then the
+                # rest in insertion order — NOT plain document order.
+                # The Python helper (clientlogic.keys) and the jsmini
+                # interpreter both replicate that exact ordering, so a
+                # host named "10" sorts the same in tests and browsers.
+                (arg,) = node.args
+                return f"Object.keys({self.expr(arg)})"
             # calls to sibling transpiled functions pass through
             return (
                 f"{node.func.id}("
