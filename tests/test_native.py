@@ -708,3 +708,38 @@ def test_promjson_strict_document_grammar_like_json_loads():
             b'{"status":"success","data":{"result":['
             b'{"metric":{"__name__":"m","chip_id":"0"},"value":[.5,"5"]}]}}'
         )  # bare fraction
+
+
+def test_embedded_nul_in_value_string_skipped_like_python():
+    """A value string with an embedded NUL ("1.5\\u0000junk"): Python's
+    float() raises so the series is skipped -- the native parser must
+    skip it too, not let strlen() truncate its view to a clean "1.5"
+    (caught by review in round 5; both parsers now agree)."""
+    def result(chip, val):
+        return {
+            "metric": {
+                "__name__": "tpu_tensorcore_utilization",
+                "chip_id": str(chip),
+                "slice": "s",
+            },
+            "value": [1000.0, val],
+        }
+
+    payload = json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "vector",
+                "result": [result(0, "1.5\u0000junk"), result(1, "2.5")],
+            },
+        }
+    ).encode()
+    py = parse_instant_query(json.loads(payload))
+    assert [(s.chip.chip_id, s.value) for s in py] == [(1, 2.5)]
+    batch = native.parse_promjson(payload)
+    got = [
+        (int(c), v)
+        for c, v in zip(batch.chip_ids, batch.matrix[:, 0])
+        if v == v
+    ]
+    assert got == [(1, 2.5)]

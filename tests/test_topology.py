@@ -151,3 +151,41 @@ def test_topology_model_3d_slice():
     chip0 = next(c for c in sl["chips"] if c["chip_id"] == 0)
     assert chip0["coords"] == [0, 0, 0]
     assert len(chip0["neighbors"]) == 3  # one per axis at extent 2
+
+
+def test_heatmap_grid_arrays_matches_dict_path():
+    """The vectorized grid fill (the service's production path) must be
+    cell-identical to heatmap_grid on 2D and 3D topologies: sparse
+    values, gap columns, duplicate last-write-wins, out-of-range raises,
+    and native-float elements (np.float64 would break json.dumps)."""
+    import json
+    import random
+
+    import pytest
+
+    from tpudash.topology import (
+        heatmap_grid,
+        heatmap_grid_arrays,
+        topology_for,
+    )
+
+    rng = random.Random(7)
+    for gen, chips in (("v5e", 16), ("v5e", 256), ("v4", 128)):
+        topo = topology_for(gen, chips)
+        ids, vals = [], []
+        for cid in rng.sample(range(chips), chips // 2):
+            ids.append(cid)
+            vals.append(round(rng.uniform(0, 100), 2))
+        # a duplicate id: both paths keep the LAST write
+        ids.append(ids[0])
+        vals.append(99.99)
+        expect = heatmap_grid(topo, dict(zip(ids, vals)))
+        got = heatmap_grid_arrays(topo, ids, vals)
+        assert got == expect
+        assert json.dumps(got)  # elements are json-able native floats
+    topo = topology_for("v5e", 16)
+    with pytest.raises(ValueError):
+        heatmap_grid_arrays(topo, [99], [1.0])
+    with pytest.raises(ValueError):
+        heatmap_grid_arrays(topo, [-1], [1.0])
+    assert heatmap_grid_arrays(topo, [], []) == heatmap_grid(topo, {})

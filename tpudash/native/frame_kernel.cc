@@ -44,6 +44,8 @@ struct TdFrame {
                                 // parity with len(list[Sample])
 };
 
+const std::string* canonical_series(const std::string& name);
+
 // Accumulates samples as (row, col, value) triplets, then materializes a
 // sorted dense frame.  Duplicate (row, col) samples: last write wins, same
 // as the Python dict-pivot.
@@ -71,21 +73,53 @@ struct Builder {
     return idx;
   }
 
+  // raw series name → column, memoizing the alias translation: one hash
+  // lookup per sample instead of two (canonical_series + metric), with
+  // identical results — the memo key is the RAW name, the stored column
+  // is the canonical one
+  std::unordered_map<std::string, int32_t> col_memo;
+  int32_t col_for(const std::string& name) {
+    auto it = col_memo.find(name);
+    if (it != col_memo.end()) return it->second;
+    const std::string* canon = canonical_series(name);
+    int32_t c = metric(canon != nullptr ? *canon : name);
+    col_memo.emplace(name, c);
+    return c;
+  }
+
+  // one-entry row cache: scrape payloads emit a chip's series
+  // consecutively (metric inner loop, chip outer), so ~(k-1)/k of the
+  // lookups hit the immediately previous (slice, chip) — skipping the
+  // key build + hash entirely.  Pure cache: misses fall through to the
+  // exact map path, so dedup/ordering semantics are untouched.
+  std::string last_slice;
+  int64_t last_chip_id = -1;
+  int32_t last_row = -1;
+
   // Row identity is (slice, chip_id) — NOT host — matching the Python
   // pivot (ChipKey.key = "slice/chip", normalize.to_wide): series that
   // disagree on host/instance labels merge into one row, first-seen host
   // kept, exactly like the dict pivot's first-sample row init.
   int32_t chip(const std::string& slice, const std::string& host,
                int64_t chip_id) {
+    if (last_row >= 0 && chip_id == last_chip_id && slice == last_slice)
+      return last_row;
     std::string key;
     key.reserve(slice.size() + 14);
     key.append(slice).push_back('\x1f');
     key.append(std::to_string(chip_id));
     auto it = chip_idx.find(key);
-    if (it != chip_idx.end()) return it->second;
-    int32_t idx = static_cast<int32_t>(chips.size());
-    chips.push_back(ChipRow{slice, host, std::string(), chip_id});
-    chip_idx.emplace(std::move(key), idx);
+    int32_t idx;
+    if (it != chip_idx.end()) {
+      idx = it->second;
+    } else {
+      idx = static_cast<int32_t>(chips.size());
+      chips.push_back(ChipRow{slice, host, std::string(), chip_id});
+      chip_idx.emplace(std::move(key), idx);
+    }
+    last_slice = slice;
+    last_chip_id = chip_id;
+    last_row = idx;
     return idx;
   }
 
@@ -141,13 +175,18 @@ void set_err(char* err, int64_t errcap, const std::string& msg) {
 
 // Full-token numeric parse (Python float()/int() reject trailing garbage).
 bool parse_full_double(const char* s, size_t len, double* out) {
-  std::string buf(s, len);
   // strtod accepts C extensions Python float() rejects — hex floats
   // ("0x1") and nan payloads ("nan(123)"); and an EMBEDDED NUL would
   // truncate strtod's c_str() view so "10\0junk" read as a clean 10.
   // Both paths must skip the same series (differential fuzz contract).
-  for (char c : buf)
+  for (size_t i = 0; i < len; ++i) {
+    char c = s[i];
     if (c == 'x' || c == 'X' || c == '(' || c == '\0') return false;
+  }
+  // reused NUL-terminated scratch: this runs once per sample (40k+ per
+  // large payload) and a fresh std::string here profiled as real time
+  static thread_local std::string buf;
+  buf.assign(s, len);
   const char* b = buf.c_str();
   char* endp = nullptr;
   double v = std::strtod(b, &endp);
@@ -332,8 +371,7 @@ TdFrame* parse_text_impl(const char* text, int64_t len,
         b.chip(slice ? *slice : (have_hint ? slice_hint : default_slice),
                host ? *host : kEmpty, chip_id);
     if (accel != nullptr) b.set_accel(row, *accel);
-    const std::string* canon = canonical_series(name);
-    b.add(row, b.metric(canon ? *canon : name), value);
+    b.add(row, b.col_for(name), value);
   }
   return b.finish();
 }
@@ -370,6 +408,30 @@ struct JParser {
   bool peek(char c) {
     ws();
     return p < end && *p == c;
+  }
+
+  // Zero-copy read of an escape-free JSON string: returns 1 with the
+  // span set (p advanced past the closing quote), 0 when the string
+  // contains escapes (p left AT the opening quote so parse_string can
+  // redo it — content-identical, just slower), or fails on non-strings.
+  // Object KEYS are compared against known literals, so the span is all
+  // a caller needs in the overwhelmingly common escape-free case —
+  // avoiding a std::string build per key (~280k per large payload).
+  int try_string_span(const char** s, size_t* n) {
+    ws();
+    if (p >= end || *p != '"') {
+      fail("expected string");
+      return -1;
+    }
+    const char* q = p + 1;
+    while (q < end && *q != '"' && *q != '\\') ++q;
+    if (q < end && *q == '"') {
+      *s = p + 1;
+      *n = static_cast<size_t>(q - (p + 1));
+      p = q + 1;
+      return 1;
+    }
+    return 0;  // escapes (or unterminated: parse_string reports it)
   }
 
   // JSON string; out==nullptr skips without building.
@@ -620,7 +682,33 @@ struct MetricLabels {
        has_host = false, has_instance = false, has_accel = false,
        has_card_model = false, has_accelerator_id = false, has_node = false,
        has_model = false;
+
+  // reused across result items (40k+ per large payload): clear() keeps
+  // every string's capacity, so steady-state label parsing allocates
+  // nothing — constructing a fresh MetricLabels per item was ~11
+  // string ctor/dtor pairs per sample
+  void clear() {
+    name.clear();
+    chip_id.clear();
+    gpu_id.clear();
+    slice.clear();
+    host.clear();
+    instance.clear();
+    accel.clear();
+    card_model.clear();
+    accelerator_id.clear();
+    node.clear();
+    model.clear();
+    has_chip_id = has_gpu_id = has_slice = has_host = has_instance =
+        has_accel = has_card_model = has_accelerator_id = has_node =
+            has_model = false;
+  }
 };
+
+inline bool span_is(const char* s, size_t n, const char* lit, size_t ln) {
+  return n == ln && std::memcmp(s, lit, ln) == 0;
+}
+#define SPAN_IS(s, n, lit) span_is((s), (n), lit, sizeof(lit) - 1)
 
 bool parse_metric_obj(JParser& jp, MetricLabels* m) {
   if (!jp.expect('{')) return false;
@@ -630,41 +718,52 @@ bool parse_metric_obj(JParser& jp, MetricLabels* m) {
   }
   std::string key;
   while (true) {
-    key.clear();
-    if (!jp.parse_string(&key)) return false;
+    // span fast path: label keys are escape-free in any real payload;
+    // an escaped key decodes through parse_string and compares equal by
+    // CONTENT either way, so behavior is identical
+    const char* kp;
+    size_t kn;
+    int r = jp.try_string_span(&kp, &kn);
+    if (r < 0) return false;
+    if (r == 0) {
+      key.clear();
+      if (!jp.parse_string(&key)) return false;
+      kp = key.data();
+      kn = key.size();
+    }
     if (!jp.expect(':')) return false;
     std::string* dst = nullptr;
     bool* flag = nullptr;
-    if (key == "__name__") {
+    if (SPAN_IS(kp, kn, "__name__")) {
       dst = &m->name;
-    } else if (key == "chip_id") {
+    } else if (SPAN_IS(kp, kn, "chip_id")) {
       dst = &m->chip_id;
       flag = &m->has_chip_id;
-    } else if (key == "gpu_id") {
+    } else if (SPAN_IS(kp, kn, "gpu_id")) {
       dst = &m->gpu_id;
       flag = &m->has_gpu_id;
-    } else if (key == "slice") {
+    } else if (SPAN_IS(kp, kn, "slice")) {
       dst = &m->slice;
       flag = &m->has_slice;
-    } else if (key == "host") {
+    } else if (SPAN_IS(kp, kn, "host")) {
       dst = &m->host;
       flag = &m->has_host;
-    } else if (key == "instance") {
+    } else if (SPAN_IS(kp, kn, "instance")) {
       dst = &m->instance;
       flag = &m->has_instance;
-    } else if (key == "accelerator") {
+    } else if (SPAN_IS(kp, kn, "accelerator")) {
       dst = &m->accel;
       flag = &m->has_accel;
-    } else if (key == "card_model") {
+    } else if (SPAN_IS(kp, kn, "card_model")) {
       dst = &m->card_model;
       flag = &m->has_card_model;
-    } else if (key == "accelerator_id") {
+    } else if (SPAN_IS(kp, kn, "accelerator_id")) {
       dst = &m->accelerator_id;
       flag = &m->has_accelerator_id;
-    } else if (key == "node") {
+    } else if (SPAN_IS(kp, kn, "node")) {
       dst = &m->node;
       flag = &m->has_node;
-    } else if (key == "model") {
+    } else if (SPAN_IS(kp, kn, "model")) {
       dst = &m->model;
       flag = &m->has_model;
     }
@@ -709,7 +808,10 @@ bool parse_value_arr(JParser& jp, double* out, bool* ok) {
     return true;  // wrong arity → skip series
   }
   int count = 0;
-  std::string sval;
+  // reused across the 40k+ value arrays of a large payload; the parser
+  // runs under the Python GIL, so thread_local is belt-and-braces
+  static thread_local std::string sval;
+  sval.clear();
   bool have_str = false, have_num = false;
   double num = 0.0;
   while (true) {
@@ -741,10 +843,15 @@ bool parse_value_arr(JParser& jp, double* out, bool* ok) {
   }
   if (count != 2) return true;  // skip: Python requires len == 2
   if (have_str) {
-    // Python float(str): accepts inf/nan/whitespace, rejects garbage
+    // Python float(str): accepts inf/nan/whitespace, rejects garbage.
+    // The TRUE remaining length goes along — strlen would stop at an
+    // embedded NUL in the value string, defeating
+    // parse_full_double's NUL rejection and keeping a series Python
+    // skips (float() raises on it)
     const char* s = sval.c_str();
     while (*s == ' ' || *s == '\t') ++s;
-    if (!parse_full_double(s, std::strlen(s), out)) return true;  // skip
+    size_t n = sval.size() - static_cast<size_t>(s - sval.c_str());
+    if (!parse_full_double(s, n, out)) return true;  // skip
     *ok = true;
   } else if (have_num) {
     *out = num;
@@ -796,11 +903,12 @@ TdFrame* parse_promjson_impl(const char* text, int64_t len,
               if (jp.peek(']')) {
                 ++jp.p;
               } else {
+                MetricLabels m;  // reused: clear() keeps string capacity
                 while (true) {
                   // one result item
                   if (!jp.expect('{'))
                     return bad("malformed prometheus payload: result item");
-                  MetricLabels m;
+                  m.clear();
                   double val = 0.0;
                   bool have_val = false;
                   if (!jp.peek('}')) {
@@ -883,8 +991,7 @@ TdFrame* parse_promjson_impl(const char* text, int64_t len,
                                    ? m.card_model
                                    : (m.has_model ? m.model : kEmpty));
                     b.set_accel(row, accel);
-                    const std::string* canon = canonical_series(m.name);
-                    b.add(row, b.metric(canon ? *canon : m.name), val);
+                    b.add(row, b.col_for(m.name), val);
                   } while (false);
                   jp.ws();
                   if (jp.p < jp.end && *jp.p == ',') {

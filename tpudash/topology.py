@@ -185,6 +185,44 @@ def grid_layout(topo: Topology) -> tuple:
     return ny, width, cells
 
 
+@functools.lru_cache(maxsize=64)
+def _flat_positions(topo: Topology):
+    """cells[chip_id] → flattened (row*width + col) index, as one cached
+    int array — the vectorized grid fill's gather table."""
+    import numpy as np
+
+    ny, width, cells = grid_layout(topo)
+    pos = np.empty(len(cells), dtype=np.int64)
+    for cid, (y, x) in enumerate(cells):
+        pos[cid] = y * width + x
+    return pos
+
+
+def heatmap_grid_arrays(topo: Topology, chip_ids, values) -> list:
+    """Vectorized :func:`heatmap_grid`: ``chip_ids`` (int array) and
+    ``values`` (list of native floats, same length) land on the grid in
+    two numpy ops instead of a per-cell Python loop — the per-frame cost
+    at 4,096 chips was ~12 ms of loop overhead across 96 panel grids.
+    Semantics match heatmap_grid exactly: missing chips/gap columns are
+    None, duplicate ids last-write-win, out-of-range ids raise."""
+    import numpy as np
+
+    ny, width, cells = grid_layout(topo)
+    flat = np.full(ny * width, None, dtype=object)
+    if len(chip_ids):
+        ids = np.asarray(chip_ids)
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= len(cells):
+            bad = lo if lo < 0 else hi
+            raise ValueError(
+                f"chip_id {bad} out of range for {topo.num_chips}-chip topology"
+            )
+        # assigning a LIST keeps elements native floats (an ndarray
+        # source would leave np.float64 objects that break json.dumps)
+        flat[_flat_positions(topo)[ids]] = values
+    return flat.reshape(ny, width).tolist()
+
+
 def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:
     """Project per-chip values onto the torus as a 2D grid (list of rows) for
     the heatmap figure; missing chips and inter-plane gap columns are None

@@ -197,6 +197,7 @@ def create_topology_heatmap(
     height: int = 480,
     unit: str = "",
     custom_grid: "list | None" = None,
+    grid: "list | None" = None,
 ) -> dict:
     """Per-chip values on the slice's torus as one figure.
 
@@ -206,8 +207,11 @@ def create_topology_heatmap(
     ``custom_grid`` (built once per slice via :func:`key_grid`) rides
     along as customdata so the page can toggle a chip's selection by
     clicking its cell — including cells of currently-deselected chips.
+    ``grid`` short-circuits the dict projection when the caller already
+    built the z-matrix (the service's vectorized array path).
     """
-    grid = heatmap_grid(topo, values)
+    if grid is None:
+        grid = heatmap_grid(topo, values)
 
     trace = {
         "type": "heatmap",
