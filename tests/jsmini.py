@@ -436,8 +436,10 @@ class Interp:
                 if not isinstance(obj, dict):
                     raise JsError("Object.keys on non-object")
                 def _idx(k):
+                    # ASCII guard: Unicode digits are plain string keys
+                    # to a real engine (and int() rejects some of them)
                     return (
-                        isinstance(k, str) and k.isdigit()
+                        isinstance(k, str) and k.isascii() and k.isdigit()
                         and str(int(k)) == k and int(k) < 4294967295
                     )
                 numeric = sorted((k for k in obj if _idx(k)), key=int)

@@ -119,24 +119,28 @@ def _frame_cases() -> "tuple[list, list]":
     return cases, frames
 
 
+def _make_case(fn_name: str, args, result: str = "return") -> dict:
+    """One corpus case: expectation computed by executing the Python
+    source of truth (clientlogic) on a JSON-domain copy of the args."""
+    from tpudash.app import clientlogic
+
+    fn = getattr(clientlogic, fn_name)
+    args_j = _jr(args)
+    call_args = copy.deepcopy(args_j)
+    out = fn(*call_args)
+    expect = _jr(call_args[0] if result == "arg0" else out)
+    return {"fn": fn_name, "args": args_j, "result": result, "expect": expect}
+
+
 def _model_cases(frames: list) -> list:
     """View-model functions (VERDICT r4 #4 migration) over the REAL
     frames: renderer dispatch for every figure a frame carries, table
     models over real stats/breakdown, grid model over real chip lists,
     banner models over real + synthesized alert lists."""
-    from tpudash.app import clientlogic
-
     cases = []
 
     def add(fn_name, args, result="return"):
-        fn = getattr(clientlogic, fn_name)
-        args_j = _jr(args)
-        call_args = copy.deepcopy(args_j)
-        out = fn(*call_args)
-        expect = _jr(call_args[0] if result == "arg0" else out)
-        cases.append(
-            {"fn": fn_name, "args": args_j, "result": result, "expect": expect}
-        )
+        cases.append(_make_case(fn_name, args, result))
 
     for frame in frames:
         figures = []
@@ -231,6 +235,13 @@ def _model_cases(frames: list) -> list:
         "stats_table_model",
         [{"10": {"mean": 1.0}, "2": {"mean": 2.0}, "z": {"mean": 3.0}}],
     )
+    # Unicode digits ("²" superscript-two) are PLAIN string keys to
+    # a JS engine — str.isdigit() alone would send them into int() and
+    # crash the Python side instead of verifying it
+    add(
+        "stats_table_model",
+        [{"²": {"mean": 1.0}, "3": {"mean": 2.0}}],
+    )
     tricky_chips = [
         {"slice": "toString", "key": "toString/0", "selected": True},
         {"slice": "constructor", "key": "constructor/1", "selected": False},
@@ -245,21 +256,13 @@ def _model_cases(frames: list) -> list:
 def _scalar_cases() -> list:
     """Fuzz grids for every non-frame client function, expectations from
     the Python source of truth."""
-    from tpudash.app import clientlogic
     from tpudash.colors import band_steps
 
     rng = random.Random(20260801)
     cases = []
 
     def add(fn_name, args, result="return"):
-        fn = getattr(clientlogic, fn_name)
-        args_j = _jr(args)
-        call_args = copy.deepcopy(args_j)
-        out = fn(*call_args)
-        expect = _jr(call_args[0] if result == "arg0" else out)
-        cases.append(
-            {"fn": fn_name, "args": args_j, "result": result, "expect": expect}
-        )
+        cases.append(_make_case(fn_name, args, result))
 
     # plan tables: the full truth table
     for kind in ("delta", "full", "refetch", "weird"):

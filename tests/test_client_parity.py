@@ -573,6 +573,9 @@ def test_keys_helper_replicates_real_js_ordering():
     assert clientlogic.keys({"02": 1, "1": 2, "4294967295": 3}) == [
         "1", "02", "4294967295",
     ]
+    # Unicode digits are plain string keys to a JS engine — and int()
+    # rejects some of them, so they must never reach it
+    assert clientlogic.keys({"²": 1, "١": 2, "3": 3}) == ["3", "²", "١"]
     from tests.jsmini import run_js
     js = transpile_functions([clientlogic.stats_table_model])
     got = run_js(js).call(

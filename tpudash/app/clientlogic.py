@@ -185,8 +185,11 @@ def spark_points(ys, ymax, w, h):
 
 
 def _is_js_array_index(k: str) -> bool:
-    """Canonical JS array index: digits only, no leading zeros, < 2^32-1."""
-    if not k.isdigit():
+    """Canonical JS array index: ASCII digits only, no leading zeros,
+    < 2^32-1.  The ASCII guard matters: str.isdigit() accepts Unicode
+    digits ("²", Arabic-Indic numerals) that a JS engine treats as plain
+    string keys — and int() even rejects some of them."""
+    if not (k.isascii() and k.isdigit()):
         return False
     n = int(k)
     return str(n) == k and n < 4294967295
