@@ -137,6 +137,8 @@ def _model_cases(frames: list) -> list:
     frames: renderer dispatch for every figure a frame carries, table
     models over real stats/breakdown, grid model over real chip lists,
     banner models over real + synthesized alert lists."""
+    from tpudash.app import clientlogic
+
     cases = []
 
     def add(fn_name, args, result="return"):
@@ -153,6 +155,11 @@ def _model_cases(frames: list) -> list:
         for fig in figures:
             add("figure_render_plan", [fig])
             add("figure_title", [fig])
+            plan = clientlogic.figure_render_plan(_jr(fig))
+            if plan["kind"] == "heat":
+                # the full cell walk over a REAL torus heatmap (gap
+                # columns, deselected cells, customdata keys)
+                add("heat_cells", [plan])
         add("chip_grid_model", [frame["chips"]])
         add("stats_table_model", [frame.get("stats", {})])
         add(
@@ -188,6 +195,54 @@ def _model_cases(frames: list) -> list:
     add("straggler_banner_model", [stragglers])
     add("firing_entries", [stragglers])
     add("firing_entries", [None])
+    # drill view model: every section-presence / placeholder / label path
+    add(
+        "drill_view_model",
+        [
+            {
+                "chip_id": 3,
+                "alerts": [
+                    {"state": "firing", "rule": "r1", "chip": "s/3",
+                     "value": 9.5, "silenced": True},
+                    {"state": "firing", "rule": "r2", "chip": "s/3",
+                     "value": 1.0},
+                    {"state": "pending", "rule": "r3", "chip": "s/3",
+                     "value": 2.0},
+                ],
+                "stragglers": [
+                    {"state": "firing", "column": "util", "value": 3.0,
+                     "median": 50.0, "z": -4.2},
+                ],
+                "links": [
+                    {"dir": "x+", "gbps": 48.5, "neighbor": "s/4",
+                     "straggler": False},
+                    {"dir": "x-", "gbps": None, "neighbor": "",
+                     "straggler": True},
+                    {"dir": "y+"},
+                ],
+                "neighbors": ["s/2", "s/4"],
+            }
+        ],
+    )
+    add("drill_view_model", [{"chip_id": 0}])  # bare chip: all hidden
+    # heat cell walk: ragged/missing customdata alignment
+    add(
+        "heat_cells",
+        [
+            {
+                "z": [[50.0, None, 12.25], [None, 80.0, None]],
+                "customdata": [["s/0", None, ""], None],
+                "zmax": 100,
+                "colorscale": [[0.0, "#aaa"], [0.6, "#bbb"]],
+                "cols": 3,
+            }
+        ],
+    )
+    add(
+        "heat_cells",
+        [{"z": [], "customdata": None, "zmax": 100, "colorscale": [[0, "#a"]],
+          "cols": 0}],
+    )
     # drill-down response policy: the full truth table
     for failed in (True, False):
         for current in (None, "s/1", "s/2"):

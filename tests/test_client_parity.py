@@ -602,3 +602,60 @@ def test_membership_is_own_property_safe():
     assert got == expect
     assert [e["slice"] for e in expect["slices"]] == ["toString", "__proto__"]
     assert expect["slices"][0]["keys"] == ["toString/0", "toString/2"]
+
+
+def test_drill_view_model_against_real_drilldown():
+    # real /api/chip payload shape with links on (default) — the model's
+    # decisions must match what the server emits
+    svc = _svc(
+        SyntheticSource(num_chips=16, emit_links=True,
+                        cold_links=((5, "yn"),)),
+        synthetic_chips=16,
+        straggler_rules="ici_link_yn_gbps@1",
+    )
+    for _ in range(4):
+        svc.render_frame()
+    d = _json_round(svc.chip_detail("slice-0/5"))
+    m = clientlogic.drill_view_model(d)
+    assert m["show_links"] and len(m["links"]) == 4
+    cold = [l for l in m["links"] if l["dir"] == "y-"]
+    assert cold and cold[0]["cold"] is True
+    for link in m["links"]:
+        assert link["neighbor"] is not None  # full torus: every far end known
+    assert m["show_neighbors"] and len(m["neighbors"]) == 4
+    # bare detail (no links/alerts/stragglers) hides every section
+    bare = clientlogic.drill_view_model({"chip_id": 0})
+    assert not bare["show_alerts"] and not bare["show_links"]
+    assert not bare["show_stragglers"] and not bare["show_neighbors"]
+    # acknowledge-button labels flip on the silenced flag
+    m = clientlogic.drill_view_model(
+        {"alerts": [
+            {"state": "firing", "rule": "r", "chip": "c", "value": 1,
+             "silenced": True},
+            {"state": "firing", "rule": "r2", "chip": "c", "value": 2},
+        ]}
+    )
+    assert [a["button_label"] for a in m["alerts"]] == [
+        "unsilence", "silence 1h",
+    ]
+
+
+def test_heat_cells_over_real_torus_heatmap():
+    svc = _svc(SyntheticSource(num_chips=128, generation="v4"),
+               synthetic_chips=128, generation="v4")
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = _json_round(svc.render_frame())
+    fig = frame["heatmaps"][0]["figure"]
+    plan = clientlogic.figure_render_plan(fig)
+    cells = clientlogic.heat_cells(plan)
+    # 3D v4 unroll: 4 rows x 39 cols incl. gap columns
+    assert len(cells) == 4 * 39
+    kinds = {c["kind"] for c in cells}
+    assert kinds == {"cell", "blank"}  # all selected: no deselected cells
+    # gap columns carry no key and no value
+    blanks = [c for c in cells if c["kind"] == "blank"]
+    assert all(c["key"] is None and c["v"] is None for c in blanks)
+    # every real cell is clickable (key from customdata)
+    real = [c for c in cells if c["kind"] == "cell"]
+    assert len(real) == 128 and all(c["key"] for c in real)

@@ -170,6 +170,32 @@ def heat_cell(v, key, zmax, scale):
     }
 
 
+def heat_cells(plan):
+    """Row-major cell models for the fallback heatmap: the customdata
+    alignment guards and value-vs-key classification (via heat_cell) all
+    happen here, so a renderer bug can't silently mis-key a cell.  The
+    flat list wraps into rows by the CSS grid's ``plan["cols"]``."""
+    out = []
+    z = plan["z"]
+    cd = plan["customdata"]
+    for y in range(len(z)):
+        row = z[y]
+        for x in range(len(row)):
+            key = None
+            if cd is not None:
+                if y < len(cd):
+                    if cd[y] is not None:
+                        if x < len(cd[y]):
+                            if cd[y][x] is not None:
+                                if cd[y][x] != "":
+                                    key = cd[y][x]
+            cell = heat_cell(row[x], key, plan["zmax"], plan["colorscale"])
+            cell["key"] = key
+            cell["v"] = row[x]
+            out.append(cell)
+    return out
+
+
 def spark_points(ys, ymax, w, h):
     """Sparkline polyline points in a w×h viewBox: x spreads evenly,
     y scales by ymax (clamped), origin at the top like SVG."""
@@ -348,6 +374,83 @@ def firing_entries(entries):
     return out
 
 
+def alert_is_silenced(a):
+    """True only for an explicit silenced=true flag — a missing field is
+    not an acknowledgement (shared by the banner and drill models)."""
+    if "silenced" in a:
+        if a["silenced"] == True:  # noqa: E712 — transpiled comparison
+            return True
+    return False
+
+
+def drill_view_model(d):
+    """Drill-down view model: every per-row decision the panel makes —
+    firing filters, the acknowledge-button label, missing-measurement
+    and missing-neighbor placeholders, the cold-link flag — decided
+    here; the hand JS only prints fields."""
+    alerts = []
+    raw_alerts = None
+    if "alerts" in d:
+        raw_alerts = d["alerts"]
+    firing = firing_entries(raw_alerts)
+    for a in firing:
+        sil = alert_is_silenced(a)
+        label = "silence 1h"
+        if sil == True:  # noqa: E712 — transpiled comparison
+            label = "unsilence"
+        alerts.append(
+            {
+                "rule": a["rule"],
+                "chip": a["chip"],
+                "value": a["value"],
+                "silenced": sil,
+                "button_label": label,
+            }
+        )
+    raw_stragglers = None
+    if "stragglers" in d:
+        raw_stragglers = d["stragglers"]
+    lagging = firing_entries(raw_stragglers)
+    links = []
+    if "links" in d:
+        if d["links"] is not None:
+            for link in d["links"]:
+                cold = False
+                if "straggler" in link:
+                    if link["straggler"] == True:  # noqa: E712
+                        cold = True
+                gbps = None
+                if "gbps" in link:
+                    gbps = link["gbps"]
+                neighbor = None
+                if "neighbor" in link:
+                    if link["neighbor"] is not None:
+                        if link["neighbor"] != "":
+                            neighbor = link["neighbor"]
+                links.append(
+                    {
+                        "dir": link["dir"],
+                        "cold": cold,
+                        "gbps": gbps,
+                        "neighbor": neighbor,
+                    }
+                )
+    neighbors = []
+    if "neighbors" in d:
+        if d["neighbors"] is not None:
+            neighbors = d["neighbors"]
+    return {
+        "alerts": alerts,
+        "show_alerts": len(alerts) > 0,
+        "stragglers": lagging,
+        "show_stragglers": len(lagging) > 0,
+        "links": links,
+        "show_links": len(links) > 0,
+        "neighbors": neighbors,
+        "show_neighbors": len(neighbors) > 0,
+    }
+
+
 def silence_toggle_request(rule, chip, silenced):
     """The acknowledge-button contract: silenced alerts unsilence,
     firing ones get a 1h silence scoped to (rule, chip)."""
@@ -504,10 +607,7 @@ def alert_banner_model(alerts):
     if alerts is not None:
         for a in alerts:
             if a["state"] == "firing":
-                sil = False
-                if "silenced" in a:
-                    if a["silenced"] == True:  # noqa: E712
-                        sil = True
+                sil = alert_is_silenced(a)
                 if sil == True:  # noqa: E712 — transpiled comparison
                     silenced = silenced + 1
                 else:
@@ -565,12 +665,15 @@ CLIENT_FUNCTIONS = (
     color_from_scale,
     meter_geometry,
     heat_cell,
+    heat_cells,
     spark_points,
     figure_title,
     bar_band_steps,
     figure_render_plan,
     drill_response_plan,
     firing_entries,
+    alert_is_silenced,
+    drill_view_model,
     silence_toggle_request,
     replay_seek_request,
     replay_toggle_request,

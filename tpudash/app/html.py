@@ -167,20 +167,18 @@ function renderMeter(el, plan) {
 }
 
 function renderHeatFallback(el, plan) {
-  const z = plan.z, cd = plan.customdata;
   let cells = '';
-  for (let y = 0; y < z.length; y++) for (let x = 0; x < z[y].length; x++) {
-    const v = z[y][x];
-    const key = (cd && cd[y] && cd[y][x]) || null;
-    const cell = heat_cell(v === undefined ? null : v, key, plan.zmax, plan.colorscale);
+  // cell classification, key alignment, and grid walking are the
+  // GENERATED heat_cells; the flat list wraps into rows via the grid
+  for (const cell of heat_cells(plan)) {
     if (cell.kind === 'blank') {
       cells += '<div style="background:transparent"></div>';
     } else if (cell.kind === 'deselected') {
       // deselected chips keep their key so a click re-selects them
-      cells += `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(key)}" title="deselected"></div>`;
+      cells += `<div style="background:#e3e9f0;cursor:pointer" data-key="${esc(cell.key)}" title="deselected"></div>`;
     } else {
-      cells += `<div style="background:${cell.color};cursor:pointer" title="${(+v).toFixed(1)}"` +
-               (key ? ` data-key="${esc(key)}"` : '') + `></div>`;
+      cells += `<div style="background:${cell.color};cursor:pointer" title="${(+cell.v).toFixed(1)}"` +
+               (cell.key ? ` data-key="${esc(cell.key)}"` : '') + `></div>`;
     }
   }
   el.innerHTML = `<div class="fig-title">${esc(plan.title)}</div>
@@ -289,42 +287,43 @@ async function refreshDrill() {
 function renderDrill(d) {
   const el = document.getElementById('drill');
   el.style.display = 'block';
+  // firing filters, acknowledge-button labels, cold-link flags, and
+  // placeholder decisions are the GENERATED drill_view_model
+  const m = drill_view_model(d);
   let html = `<div class="drill-head"><span class="row-title">TPU ${+d.chip_id}` +
     ` &mdash; ${esc(d.slice)} / ${esc(d.host)} (${esc(d.model)})</span>` +
     `<button id="drill-close">close</button></div>`;
-  const firing = firing_entries(d.alerts || []);
-  if (firing.length) {
+  if (m.show_alerts) {
     // each firing alert gets a one-click acknowledge (1h silence) /
     // unsilence toggle — the operator workflow, not just the signal
     html += `<div class="drill-alerts">⚠ ` +
-      firing.map((a, i) => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
+      m.alerts.map((a, i) => esc(a.rule) + (a.silenced ? ' 🔇' : '') +
                  ' (=' + (+a.value) + ') ' +
                  `<button class="silence-btn" data-i="${i}">` +
-                 (a.silenced ? 'unsilence' : 'silence 1h') + '</button>'
+                 a.button_label + '</button>'
                 ).join(' · ') + '</div>';
   }
-  const lagging = firing_entries(d.stragglers || []);
-  if (lagging.length) {
+  if (m.show_stragglers) {
     html += `<div class="drill-alerts" style="color:#2a4a78">🐢 straggler: ` +
-      lagging.map(s => esc(s.column) + ' ' + (+s.value) + ' vs fleet ' +
+      m.stragglers.map(s => esc(s.column) + ' ' + (+s.value) + ' vs fleet ' +
                   (+s.median) + ' (z=' + (+s.z) + ')').join(' · ') + '</div>';
   }
   html += '<div class="panel-row" id="drill-gauges"></div>';
   html += '<div class="panel-row" id="drill-trends"></div>';
-  if (d.links && d.links.length) {
+  if (m.show_links) {
     // direction-resolved per-link table: the failing CABLE, with the
     // chip on its far end one click away
     html += '<table class="links"><tr><th>link</th><th>GB/s</th><th>far end</th></tr>' +
-      d.links.map(l =>
-        `<tr${l.straggler ? ' class="link-cold"' : ''}><td>${esc(l.dir)}` +
-        (l.straggler ? ' 🐢' : '') + '</td><td>' +
-        (l.gbps === null || l.gbps === undefined ? '—' : (+l.gbps)) + '</td><td>' +
-        (l.neighbor ? `<button data-chip="${esc(l.neighbor)}">${esc(l.neighbor)}</button>` : '—') +
+      m.links.map(l =>
+        `<tr${l.cold ? ' class="link-cold"' : ''}><td>${esc(l.dir)}` +
+        (l.cold ? ' 🐢' : '') + '</td><td>' +
+        (l.gbps === null ? '—' : (+l.gbps)) + '</td><td>' +
+        (l.neighbor !== null ? `<button data-chip="${esc(l.neighbor)}">${esc(l.neighbor)}</button>` : '—') +
         '</td></tr>').join('') + '</table>';
   }
-  if (d.neighbors && d.neighbors.length) {
+  if (m.show_neighbors) {
     html += `<div class="neighbors">ICI neighbors:` +
-      d.neighbors.map(n => `<button data-chip="${esc(n)}">${esc(n)}</button>`).join('') +
+      m.neighbors.map(n => `<button data-chip="${esc(n)}">${esc(n)}</button>`).join('') +
       '</div>';
   }
   el.innerHTML = html;
@@ -336,8 +335,8 @@ function renderDrill(d) {
   }
   for (const btn of el.querySelectorAll('.silence-btn')) {
     btn.addEventListener('click', async () => {
-      const a = firing[+btn.getAttribute('data-i')];
-      const req = silence_toggle_request(a.rule, a.chip, a.silenced === true);
+      const a = m.alerts[+btn.getAttribute('data-i')];
+      const req = silence_toggle_request(a.rule, a.chip, a.silenced);
       await postJson(req.path, req.body);
       refreshDrill(); refresh();
     });
