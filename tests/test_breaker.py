@@ -1,0 +1,497 @@
+"""Circuit breaker + concurrent MultiSource endpoint isolation.
+
+The contract under test: one down endpoint must cost the frame at most
+one per-child deadline (not its place in a serial walk), open its
+breaker after Config.breaker_failures consecutive failures, be skipped
+at zero cost while open, and reclose through a half-open probe after
+recovery.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpudash.config import Config
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.breaker import BreakerPolicy, CircuitBreaker
+from tpudash.sources.fixture import SyntheticSource
+from tpudash.sources.multi import EndpointSpec, MultiSource
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Failing(MetricsSource):
+    name = "failing"
+
+    def __init__(self):
+        self.calls = 0
+
+    def fetch(self):
+        self.calls += 1
+        raise SourceError("boom")
+
+
+class _Counting(MetricsSource):
+    name = "counting"
+
+    def __init__(self, chips=4):
+        self.calls = 0
+        self.inner = SyntheticSource(num_chips=chips)
+
+    def fetch(self):
+        self.calls += 1
+        return self.inner.fetch()
+
+
+class _Sleepy(MetricsSource):
+    """Blocks on an event (releasable hang) before delegating/failing."""
+
+    name = "sleepy"
+
+    def __init__(self, hold_s=5.0):
+        self.release = threading.Event()
+        self.hold_s = hold_s
+        self.calls = 0
+
+    def fetch(self):
+        self.calls += 1
+        self.release.wait(self.hold_s)
+        raise SourceError("woke up too late")
+
+
+# -- CircuitBreaker unit ------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_recloses():
+    clock = _Clock()
+    br = CircuitBreaker(BreakerPolicy(failures=3, cooldown=10.0), clock=clock)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()  # streak below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # cooling down
+    assert br.cooldown_remaining == pytest.approx(10.0)
+    clock.t = 10.0
+    assert br.allow()  # cooldown over → half-open probe permitted
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed"
+    assert br.consecutive_failures == 0
+    assert br.summary()["total_opens"] == 1
+
+
+def test_half_open_failure_reopens_with_fresh_cooldown():
+    clock = _Clock()
+    br = CircuitBreaker(BreakerPolicy(failures=1, cooldown=5.0), clock=clock)
+    br.record_failure()
+    assert br.state == "open"
+    clock.t = 5.0
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == "open"
+    assert br.total_opens == 2
+    clock.t = 9.9
+    assert not br.allow()  # fresh cooldown from the probe failure
+    clock.t = 10.0
+    assert br.allow()
+
+
+def test_breaker_summary_is_jsonable():
+    import json
+
+    br = CircuitBreaker(BreakerPolicy(failures=2, cooldown=1.0))
+    br.record_failure()
+    s = br.summary()
+    json.dumps(s)
+    assert s["state"] == "closed"
+    assert s["consecutive_failures"] == 1
+    assert s["failure_threshold"] == 2
+
+
+# -- MultiSource integration --------------------------------------------------
+
+def _multi(children, clock=None, **cfg_kw):
+    cfg = Config(source="multi", **cfg_kw)
+    kw = {"clock": clock} if clock is not None else {}
+    return MultiSource(cfg, children=children, **kw)
+
+
+def test_open_endpoint_is_skipped_at_zero_cost():
+    bad = _Failing()
+    good = _Counting()
+    clock = _Clock()
+    src = _multi(
+        [
+            (EndpointSpec("u0", "slice-a"), good),
+            (EndpointSpec("u1", "slice-b"), bad),
+        ],
+        clock=clock,
+        breaker_failures=2,
+        breaker_cooldown=30.0,
+    )
+    src.fetch()
+    src.fetch()
+    assert bad.calls == 2
+    assert src.breakers["slice-b"].state == "open"
+    # open: the child is never touched, the error names the breaker
+    src.fetch()
+    assert bad.calls == 2
+    assert "circuit open" in src.last_errors["slice-b"]
+    assert good.calls == 3  # healthy child unaffected throughout
+    src.close()
+
+
+def test_half_open_probe_recloses_after_recovery():
+    clock = _Clock()
+    flaky = _Counting()
+    fail_first = [True, True]
+
+    class _Recovering(MetricsSource):
+        name = "recovering"
+
+        def fetch(self):
+            if fail_first:
+                fail_first.pop()
+                raise SourceError("still down")
+            return flaky.fetch()
+
+    src = _multi(
+        [(EndpointSpec("u0", "slice-a"), _Recovering())],
+        clock=clock,
+        breaker_failures=2,
+        breaker_cooldown=10.0,
+    )
+    for _ in range(2):
+        with pytest.raises(SourceError):
+            src.fetch()
+    assert src.breakers["slice-a"].state == "open"
+    # still cooling: the all-failed raise carries the breaker state
+    with pytest.raises(SourceError, match="breaker open"):
+        src.fetch()
+    clock.t = 10.0
+    samples = src.fetch()  # half-open probe → success → closed
+    assert len(samples)
+    assert src.breakers["slice-a"].state == "closed"
+    assert src.last_errors == {}
+    src.close()
+
+
+def test_children_fetch_concurrently_not_serially():
+    class _Slow(MetricsSource):
+        name = "slow"
+
+        def __init__(self):
+            self.inner = SyntheticSource(num_chips=2)
+
+        def fetch(self):
+            time.sleep(0.2)
+            return self.inner.fetch()
+
+    src = _multi(
+        [(EndpointSpec(f"u{i}", f"slice-{i}"), _Slow()) for i in range(3)],
+        multi_deadline=5.0,
+    )
+    t0 = time.monotonic()
+    samples = src.fetch()
+    wall = time.monotonic() - t0
+    assert len(samples)
+    assert wall < 0.45  # 3 × 0.2s serial would be ≥ 0.6s
+    src.close()
+
+
+def test_hung_child_costs_one_deadline_and_opens_breaker():
+    hung = _Sleepy(hold_s=10.0)
+    src = _multi(
+        [
+            (EndpointSpec("u0", "slice-a"), _Counting()),
+            (EndpointSpec("u1", "slice-b"), hung),
+        ],
+        multi_deadline=0.2,
+        breaker_failures=2,
+        breaker_cooldown=60.0,
+    )
+    try:
+        t0 = time.monotonic()
+        samples = src.fetch()
+        wall = time.monotonic() - t0
+        assert len(samples)  # healthy child renders
+        assert wall < 1.0  # ONE deadline (plus slack), not the hang
+        assert "deadline" in src.last_errors["slice-b"]
+        # the hung fetch is parked, not re-dispatched: next frame counts
+        # a failure without stacking a second call on the child
+        src.fetch()
+        assert hung.calls == 1
+        assert "in flight" in src.last_errors["slice-b"]
+        assert src.breakers["slice-b"].state == "open"
+    finally:
+        hung.release.set()
+        src.close()
+
+
+def test_all_failed_detail_and_last_errors_survive():
+    src = _multi(
+        [
+            (EndpointSpec("u0", "a"), _Failing()),
+            (EndpointSpec("u1", "b"), _Failing()),
+        ]
+    )
+    with pytest.raises(SourceError) as ei:
+        src.fetch()
+    msg = str(ei.value)
+    assert "all 2 endpoints failed" in msg
+    assert "breaker closed" in msg  # breaker state rides the detail
+    # last_errors stays populated on the all-failed path too
+    assert set(src.last_errors) == {"a", "b"}
+    assert src.last_errors["a"] == "boom"
+    src.close()
+
+
+def test_bug_raise_is_deferred_until_siblings_are_accounted():
+    # a non-SourceError (code bug) in one child propagates, but only
+    # AFTER every sibling's completed fetch reached its own breaker
+    # ledger — a bug in child A must not erase child B's success
+    class _Buggy(MetricsSource):
+        name = "buggy"
+
+        def fetch(self):
+            raise TypeError("labels must be a mapping")
+
+    good = _Counting()
+    src = _multi(
+        [
+            (EndpointSpec("u0", "a"), _Buggy()),
+            (EndpointSpec("u1", "b"), good),
+        ],
+        breaker_failures=3,
+    )
+    src.breakers["b"].record_failure()
+    src.breakers["b"].record_failure()  # b is mid-streak at 2
+    with pytest.raises(TypeError):
+        src.fetch()
+    assert src.breakers["a"].consecutive_failures == 1
+    assert src.breakers["b"].consecutive_failures == 0  # success recorded
+    assert src._inflight == {}  # b's done future was harvested, not parked
+    src.close()
+
+
+def test_endpoint_health_summary():
+    src = _multi(
+        [
+            (EndpointSpec("http://x", "slice-a"), _Counting()),
+            (EndpointSpec("http://y", "slice-b"), _Failing()),
+        ]
+    )
+    src.fetch()
+    health = src.endpoint_health()
+    assert health["slice-a"]["state"] == "closed"
+    assert health["slice-a"]["url"] == "http://x"
+    assert "last_error" not in health["slice-a"]
+    assert health["slice-b"]["consecutive_failures"] == 1
+    assert health["slice-b"]["last_error"] == "boom"
+    src.close()
+
+
+def test_synthetic_load_rolls_back_breaker_state():
+    # a profiling burst (POST /api/profile) must not advance breaker
+    # streaks the real monitoring cadence owns
+    from tpudash.app.service import DashboardService
+
+    bad = _Failing()
+    cfg = Config(
+        source="multi", breaker_failures=3, refresh_interval=0.0
+    )
+    src = MultiSource(
+        cfg,
+        children=[
+            (EndpointSpec("u0", "a"), _Counting()),
+            (EndpointSpec("u1", "b"), bad),
+        ],
+    )
+    svc = DashboardService(cfg, src)
+    svc.render_frame()
+    before = src.breakers["b"].summary()
+    assert before["consecutive_failures"] == 1
+    with svc.synthetic_load():
+        svc.render_frame()
+        svc.render_frame()  # would open the breaker (3 failures)...
+    # ...but the drill rolls back: still one real failure, still closed
+    assert src.breakers["b"].summary() == before
+    src.close()
+
+
+def test_duplicate_endpoint_labels_rejected():
+    # labels key breakers + the inflight map: a duplicate would share one
+    # breaker between two endpoints and re-dispatch a hung child
+    with pytest.raises(ValueError, match="duplicate endpoint label"):
+        _multi(
+            [
+                (EndpointSpec("http://p1", "a"), _Counting()),
+                (EndpointSpec("http://p2", "a"), _Counting()),
+            ]
+        )
+
+
+def test_retry_wrapped_status_reports_quarantined_endpoint():
+    # the retry wrapper sees a partial MultiSource fetch as a SUCCESS —
+    # its "healthy" must not mask an open breaker on /healthz ("status"
+    # is the field the runbook tells operators to alert on)
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.retry import ResilientSource, RetryPolicy
+
+    cfg = Config(
+        source="multi", breaker_failures=1, refresh_interval=0.0
+    )
+    src = ResilientSource(
+        _multi(
+            [
+                (EndpointSpec("u0", "a"), _Counting()),
+                (EndpointSpec("u1", "b"), _Failing()),
+            ],
+            breaker_failures=1,
+        ),
+        RetryPolicy(retries=0),
+        sleep=lambda s: None,
+    )
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    assert frame["error"] is None  # the partial fetch did succeed...
+    health = frame["source_health"]
+    assert health["total_fetches"] == 1  # ...and the wrapper counted it
+    assert health["endpoints"]["b"]["state"] == "open"
+    assert health["status"] == "degraded"  # but the verdict tells the truth
+    src.close()
+
+
+def test_hung_fetch_threads_are_daemons():
+    # a wedged endpoint must never block interpreter exit: the parked
+    # fetch runs on a daemon thread, not a joined pool worker
+    hung = _Sleepy(hold_s=5.0)
+    src = _multi(
+        [(EndpointSpec("u0", "a"), hung)], multi_deadline=0.05
+    )
+    try:
+        with pytest.raises(SourceError):
+            src.fetch()
+        t = [
+            th
+            for th in threading.enumerate()
+            if th.name == "tpudash-multi-fetch"
+        ]
+        assert t and all(th.daemon for th in t)
+    finally:
+        hung.release.set()
+        src.close()
+
+
+def test_quarantine_keeps_root_cause_in_last_errors():
+    # "circuit open" names the consequence; /healthz must still carry
+    # WHY the endpoint was quarantined for the whole cooldown
+    bad = _Failing()
+    src = _multi(
+        [
+            (EndpointSpec("u0", "a"), _Counting()),
+            (EndpointSpec("u1", "b"), bad),
+        ],
+        breaker_failures=1,
+    )
+    src.fetch()  # failure opens the breaker
+    src.fetch()  # quarantined frame
+    assert "circuit open" in src.last_errors["b"]
+    assert "boom" in src.last_errors["b"]  # the root cause rides along
+    # recovery clears the remembered fault
+    src.breakers["b"].record_success()
+    src._last_fault.pop("b", None)
+    src.close()
+
+
+def test_synthetic_load_rolls_back_last_errors():
+    # a fault that only happens during a profiling burst must not leak
+    # into /healthz's live partial-degradation state afterwards
+    from tpudash.app.service import DashboardService
+
+    class _Toggle(MetricsSource):
+        name = "toggle"
+
+        def __init__(self):
+            self.fail = False
+            self.inner = SyntheticSource(num_chips=2)
+
+        def fetch(self):
+            if self.fail:
+                raise SourceError("synthetic-era fault")
+            return self.inner.fetch()
+
+    tog = _Toggle()
+    cfg = Config(source="multi", refresh_interval=0.0)
+    src = _multi(
+        [
+            (EndpointSpec("u0", "a"), _Counting()),
+            (EndpointSpec("u1", "b"), tog),
+        ]
+    )
+    svc = DashboardService(cfg, src)
+    svc.render_frame()
+    assert src.last_errors == {}
+    tog.fail = True
+    with svc.synthetic_load():
+        svc.render_frame()
+        assert "b" in src.last_errors  # visible inside the burst...
+    assert src.last_errors == {}  # ...rolled back after it
+    assert src._last_fault == {}
+    src.close()
+
+
+def test_factory_multi_wrapper_is_health_only():
+    # within-frame retries around the WHOLE join would multiply every
+    # endpoint's breaker failures by the attempt count (one blip →
+    # fleet-wide quarantine); the factory keeps the wrapper only for
+    # its health ledger
+    from tpudash.sources import make_source
+    from tpudash.sources.retry import ResilientSource
+
+    cfg = Config(
+        source="multi",
+        multi_endpoints="a=http://prom/api/v1/query",
+        fetch_retries=2,
+    )
+    src = make_source(cfg)
+    assert isinstance(src, ResilientSource)
+    assert src.policy.retries == 0  # breakers own multi retry policy
+    # non-multi sources keep the configured within-frame retries
+    plain = make_source(Config(source="synthetic", synthetic_chips=2))
+    assert plain.policy.retries == 2
+
+
+def test_breaker_config_knobs():
+    from tpudash.config import load_config
+
+    cfg = load_config(
+        {
+            "TPUDASH_BREAKER_FAILURES": "5",
+            "TPUDASH_BREAKER_COOLDOWN": "7.5",
+            "TPUDASH_MULTI_DEADLINE": "1.5",
+        }
+    )
+    assert cfg.breaker_failures == 5
+    assert cfg.breaker_cooldown == 7.5
+    assert cfg.multi_deadline == 1.5
+    src = MultiSource(
+        cfg, children=[(EndpointSpec("u", "a"), _Counting())]
+    )
+    assert src.breakers["a"].policy.failures == 5
+    assert src.breakers["a"].policy.cooldown == 7.5
+    assert src.deadline == 1.5
+    # deadline falls back to http_timeout when unset
+    src2 = MultiSource(
+        Config(http_timeout=2.5),
+        children=[(EndpointSpec("u", "a"), _Counting())],
+    )
+    assert src2.deadline == 2.5

@@ -1,0 +1,342 @@
+"""ChaosSource fault injection + the chaos soak over the full service.
+
+The soak is the acceptance contract for the robustness layer: with one
+endpoint hard-hung under chaos, a 3-endpoint MultiSource frame completes
+within one per-child deadline, the hung endpoint's breaker opens within
+N failures and recloses after scripted recovery, and the frame payload +
+/healthz report per-endpoint breaker state throughout.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.base import SourceError
+from tpudash.sources.chaos import ChaosScenario, ChaosSource
+from tpudash.sources.fixture import SyntheticSource
+from tpudash.sources.multi import EndpointSpec, MultiSource
+
+
+# -- scenario grammar ---------------------------------------------------------
+
+def test_parse_full_scenario():
+    sc = ChaosScenario.parse(
+        "latency:p=0.3,ms=800;drop_chip:slice=v5e-a,chip=3;"
+        "flap:period=6;error:p=0.5;hang:p=0.1,ms=2000;"
+        "partial:p=0.2,frac=0.4;malformed:p=0.1;seed=42"
+    )
+    assert sc.latency_p == 0.3 and sc.latency_ms == 800
+    assert sc.drop_chips == (("v5e-a", 3),)
+    assert sc.flap_period == 6
+    assert sc.error_p == 0.5
+    assert sc.hang_p == 0.1 and sc.hang_ms == 2000
+    assert sc.partial_p == 0.2 and sc.partial_frac == 0.4
+    assert sc.malformed_p == 0.1
+    assert sc.seed == 42
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown chaos directive"):
+        ChaosScenario.parse("explode:p=1")
+    with pytest.raises(ValueError, match="missing arg"):
+        ChaosScenario.parse("latency:p=0.5")  # no ms
+    with pytest.raises(ValueError, match="outside"):
+        ChaosScenario.parse("error:p=1.5")
+    with pytest.raises(ValueError, match="period"):
+        ChaosScenario.parse("flap:period=1")
+    assert ChaosScenario.parse("") == ChaosScenario()
+    assert ChaosScenario.parse("  ;  ") == ChaosScenario()
+
+
+def test_seed_accepts_both_spellings():
+    # every other directive is name:args — seed:42 must work too
+    assert ChaosScenario.parse("seed=42").seed == 42
+    assert ChaosScenario.parse("seed:42").seed == 42
+    assert ChaosScenario.parse("flap:period=6;seed:7").seed == 7
+
+
+def test_seeded_faults_are_deterministic():
+    def run():
+        src = ChaosSource(
+            SyntheticSource(num_chips=2),
+            "error:p=0.5;seed=7",
+            sleep=lambda s: None,
+        )
+        outcomes = []
+        for _ in range(20):
+            try:
+                src.fetch()
+                outcomes.append("ok")
+            except SourceError:
+                outcomes.append("err")
+        return outcomes
+
+    a, b = run(), run()
+    assert a == b
+    assert "err" in a and "ok" in a  # p=0.5 actually flips both ways
+
+
+def test_flap_schedule_is_scripted():
+    src = ChaosSource(SyntheticSource(num_chips=2), "flap:period=4")
+    outcomes = []
+    for _ in range(8):
+        try:
+            src.fetch()
+            outcomes.append("up")
+        except SourceError as e:
+            assert "flap" in str(e)
+            outcomes.append("down")
+    assert outcomes == ["up", "up", "down", "down"] * 2
+
+
+def test_latency_and_hang_use_injected_sleep_and_are_bounded():
+    sleeps = []
+    src = ChaosSource(
+        SyntheticSource(num_chips=2),
+        "latency:p=1,ms=800",
+        sleep=sleeps.append,
+    )
+    src.fetch()
+    assert sleeps == [0.8]
+    hang = ChaosSource(
+        SyntheticSource(num_chips=2),
+        "hang:p=1,ms=999999999",
+        sleep=sleeps.append,
+    )
+    with pytest.raises(SourceError, match="hung"):
+        hang.fetch()
+    assert sleeps[-1] == 120.0  # MAX_HANG_S cap — chaos is always bounded
+
+
+def test_drop_chip_removes_only_that_chip():
+    src = ChaosSource(
+        SyntheticSource(num_chips=4), "drop_chip:slice=slice-0,chip=3"
+    )
+    samples = src.fetch()
+    chips = {s.chip.chip_id for s in samples}
+    assert chips == {0, 1, 2}
+    assert src.injected["drop_chip"] == 1
+    # slice-less drop matches every slice
+    src2 = ChaosSource(
+        SyntheticSource(num_chips=4, num_slices=2), "drop_chip:chip=0"
+    )
+    assert {s.chip.chip_id for s in src2.fetch()} == {1, 2, 3}
+
+
+def test_partial_and_malformed_payloads_degrade_not_crash():
+    from tpudash.normalize import to_wide
+
+    src = ChaosSource(
+        SyntheticSource(num_chips=8),
+        "partial:p=1,frac=0.5;malformed:p=1;seed=3",
+    )
+    samples = src.fetch()
+    full = len(SyntheticSource(num_chips=8).fetch())
+    assert 0 < len(samples) < full  # partial actually dropped some
+    df = to_wide(samples)  # malformed cells must not fail the pivot
+    assert len(df)
+    # the corrupted bogus-id rows must not blow up a frame either
+    cfg = Config()
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    assert frame["error"] is None
+
+
+def test_chaos_wraps_via_config_factory():
+    from tpudash.sources import make_source
+    from tpudash.sources.retry import ResilientSource
+
+    cfg = Config(
+        source="synthetic", synthetic_chips=2, chaos="flap:period=4"
+    )
+    src = make_source(cfg)
+    assert isinstance(src, ResilientSource)  # retry stays outermost
+    assert isinstance(src.inner, ChaosSource)
+    assert src.name == "synthetic+chaos+retry"
+    assert len(src.fetch())
+
+
+def test_chaos_demo_app_builds():
+    from tpudash.chaos import chaos_demo_source, make_chaos_app
+
+    cfg = Config(synthetic_chips=8)
+    src = chaos_demo_source(cfg)
+    assert [label for label in src._labels] == [
+        "chaos-a", "chaos-b", "chaos-c"
+    ]
+    samples = src.fetch()  # first flap cycle: everything up
+    assert {s.chip.slice_id for s in samples} == {
+        "chaos-a", "chaos-b", "chaos-c"
+    }
+    src.close()
+    app, app_cfg = make_chaos_app(cfg)
+    assert app is not None
+    assert app_cfg.multi_deadline == 1.0
+
+
+# -- the chaos soak -----------------------------------------------------------
+
+class _Hold:
+    """Injectable sleep that blocks on an event — a real (thread-parking)
+    hang the test can release instantly at teardown."""
+
+    def __init__(self):
+        self.ev = threading.Event()
+
+    def __call__(self, s):
+        self.ev.wait(min(s, 30.0))
+
+
+def _ep_state(frame, label):
+    return frame["source_health"]["endpoints"][label]["state"]
+
+
+def test_chaos_soak_hung_endpoint_lifecycle():
+    """One endpoint hard-hung: bounded frames, breaker opens, quarantine
+    is visible everywhere, recovery recloses — the acceptance scenario."""
+    hold = _Hold()
+    hung = ChaosSource(
+        SyntheticSource(num_chips=4), "hang:p=1,ms=20000", sleep=hold
+    )
+    cfg = Config(
+        source="multi",
+        multi_deadline=0.25,
+        breaker_failures=2,
+        breaker_cooldown=0.3,
+        fetch_retries=0,
+        refresh_interval=0.0,
+    )
+    children = [
+        (EndpointSpec("u0", "slice-a"), SyntheticSource(num_chips=4)),
+        (EndpointSpec("u1", "slice-b"), SyntheticSource(num_chips=4)),
+        (EndpointSpec("u2", "slice-c"), hung),
+    ]
+    src = MultiSource(cfg, children=children)
+    svc = DashboardService(cfg, src)
+    try:
+        # frame 1: the hang costs ONE deadline, not 3× the child timeout
+        t0 = time.monotonic()
+        frame = svc.render_frame()
+        wall = time.monotonic() - t0
+        assert frame["error"] is None
+        assert wall < 0.25 * 3  # one deadline + compose slack
+        assert {c["slice"] for c in frame["chips"]} == {"slice-a", "slice-b"}
+        assert any("slice-c" in w for w in frame["warnings"])
+        assert _ep_state(frame, "slice-c") == "closed"  # 1 failure so far
+        assert frame["source_health"]["endpoints"]["slice-c"][
+            "consecutive_failures"
+        ] == 1
+        # endpoint mid-streak → pending endpoint_down alert
+        pend = [a for a in frame["alerts"] if a["rule"] == "endpoint_down"]
+        assert pend and pend[0]["state"] == "pending"
+
+        # frame 2: still in flight → second failure → breaker opens
+        frame = svc.render_frame()
+        assert _ep_state(frame, "slice-c") == "open"
+        down = [a for a in frame["alerts"] if a["rule"] == "endpoint_down"]
+        assert down and down[0]["state"] == "firing"
+        assert down[0]["chip"] == "slice-c"
+        assert down[0]["severity"] == "critical"
+
+        # frame 3: quarantined — skipped at zero cost, healthy slices serve
+        t0 = time.monotonic()
+        frame = svc.render_frame()
+        assert time.monotonic() - t0 < 0.25  # no deadline paid
+        assert frame["error"] is None
+        assert "circuit open" in src.last_errors["slice-c"]
+        assert _ep_state(frame, "slice-c") == "open"
+
+        # scripted recovery: release the hang, heal the scenario, wait
+        # out the cooldown — the half-open probe must reclose the breaker
+        hold.ev.set()
+        time.sleep(0.05)  # parked worker finishes, future harvestable
+        hung.scenario = ChaosScenario.parse("")  # endpoint healthy again
+        time.sleep(0.3)
+        frame = svc.render_frame()
+        assert frame["error"] is None
+        assert _ep_state(frame, "slice-c") == "closed"
+        assert {c["slice"] for c in frame["chips"]} == {
+            "slice-a", "slice-b", "slice-c"
+        }
+        assert "warnings" not in frame
+        assert not [
+            a for a in frame["alerts"] if a["rule"] == "endpoint_down"
+        ]
+    finally:
+        hold.ev.set()
+        src.close()
+
+
+def test_chaos_soak_flap_transitions_and_stale_serve():
+    """Scripted flap through the retry-wrapped single-source path: health
+    walks healthy → degraded → down → healthy, frames never crash, and
+    the last good table survives the outage (stale-serve policy)."""
+    from tpudash.sources.retry import ResilientSource, RetryPolicy
+
+    src = ResilientSource(
+        ChaosSource(SyntheticSource(num_chips=4), "flap:period=8"),
+        RetryPolicy(retries=0),
+        sleep=lambda s: None,
+    )
+    cfg = Config(refresh_interval=0.0)
+    svc = DashboardService(cfg, src)
+    statuses = []
+    for _ in range(16):  # two full flap periods
+        frame = svc.render_frame()
+        statuses.append(frame["source_health"]["status"])
+        if frame["error"] is not None:
+            # outage frames keep the pre-outage table for export/guards
+            assert svc.last_df is not None
+    # up-window healthy, down-window degrading to down, then recovery
+    assert statuses[:4] == ["healthy"] * 4
+    assert statuses[4:8] == ["degraded", "degraded", "down", "down"]
+    assert statuses[8:12] == ["healthy"] * 4
+
+
+def test_healthz_reports_endpoint_breakers():
+    """/healthz carries per-endpoint breaker state + a degraded status
+    while one endpoint is quarantined."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    class _Failing(SyntheticSource):
+        def fetch(self):
+            raise SourceError("down hard")
+
+    cfg = Config(
+        source="multi",
+        refresh_interval=0.0,
+        breaker_failures=1,
+        fetch_retries=0,
+    )
+    children = [
+        (EndpointSpec("u0", "slice-a"), SyntheticSource(num_chips=4)),
+        (EndpointSpec("u1", "slice-b"), _Failing(num_chips=4)),
+    ]
+    service = DashboardService(cfg, MultiSource(cfg, children=children))
+    app = DashboardServer(service).build_app()
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/api/frame")
+            assert resp.status == 200
+            resp = await client.get("/healthz")
+            body = await resp.json()
+            assert body["ok"] is True
+            assert body["status"] == "degraded"
+            eps = body["source_health"]["endpoints"]
+            assert eps["slice-a"]["state"] == "closed"
+            assert eps["slice-b"]["state"] == "open"
+            assert "down hard" in eps["slice-b"]["last_error"]
+        finally:
+            await client.close()
+
+    asyncio.run(go())

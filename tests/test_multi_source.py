@@ -117,6 +117,94 @@ def test_multi_slice_frame_renders_dcn_panel():
     assert heatmap_slices == {"slice-a", "slice-b"}
 
 
+def test_relabel_collision_warns_and_collapses(caplog):
+    import logging
+
+    # a child that itself emits TWO slices, relabeled onto one name —
+    # distinct (slice, chip) keys collapse, and the join must say so
+    child = SyntheticSource(num_chips=2, num_slices=2)
+    src = MultiSource(
+        Config(), children=[(EndpointSpec("u", "joined"), child)]
+    )
+    with caplog.at_level(logging.WARNING, logger="tpudash.sources.multi"):
+        samples = src.fetch()
+    assert any(
+        "chip keys may collide" in r.message for r in caplog.records
+    )
+    assert {s.chip.slice_id for s in samples} == {"joined"}
+
+
+class _BatchChild(MetricsSource):
+    """Returns the columnar SampleBatch representation (the native
+    parser's shape) instead of a Sample list."""
+
+    name = "batch"
+
+    def __init__(self, chips=2):
+        self.chips = chips
+
+    def fetch(self):
+        from tpudash.schema import SampleBatch
+
+        return SampleBatch.from_samples(
+            SyntheticSource(num_chips=self.chips).fetch()
+        )
+
+
+def test_mixed_batch_and_list_children_flatten_to_samples():
+    from tpudash.schema import Sample
+
+    children = [
+        (EndpointSpec("u0", "batch-slice"), _BatchChild()),
+        (EndpointSpec("u1", "list-slice"), SyntheticSource(num_chips=2)),
+    ]
+    src = MultiSource(Config(), children=children)
+    samples = src.fetch()
+    # mixed representations degrade to the flat Sample-list path
+    assert isinstance(samples, list)
+    assert all(isinstance(s, Sample) for s in samples)
+    assert {s.chip.slice_id for s in samples} == {
+        "batch-slice", "list-slice"
+    }
+
+
+def test_all_batch_children_stay_columnar():
+    from tpudash.schema import SampleBatch
+
+    children = [
+        (EndpointSpec("u0", "a"), _BatchChild()),
+        (EndpointSpec("u1", "b"), _BatchChild()),
+    ]
+    src = MultiSource(Config(), children=children)
+    got = src.fetch()
+    assert isinstance(got, SampleBatch)  # no flatten when nobody needs it
+    assert set(got.slices) == {"a", "b"}
+
+
+def test_batch_relabel_collision_also_warns(caplog):
+    import logging
+
+    from tpudash.schema import SampleBatch
+
+    class _TwoSliceBatch(MetricsSource):
+        name = "twoslice"
+
+        def fetch(self):
+            return SampleBatch.from_samples(
+                SyntheticSource(num_chips=2, num_slices=2).fetch()
+            )
+
+    src = MultiSource(
+        Config(), children=[(EndpointSpec("u", "joined"), _TwoSliceBatch())]
+    )
+    with caplog.at_level(logging.WARNING, logger="tpudash.sources.multi"):
+        got = src.fetch()
+    assert any(
+        "chip keys may collide" in r.message for r in caplog.records
+    )
+    assert set(got.slices) == {"joined"}
+
+
 def test_partial_failure_surfaces_frame_warnings():
     from tpudash.app.service import DashboardService
 

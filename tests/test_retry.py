@@ -100,6 +100,30 @@ def test_frame_budget_stops_retries():
     assert flaky.calls == 1
 
 
+def test_backoff_sleep_clamped_to_remaining_budget():
+    # the drawn backoff can exceed what's LEFT of the frame budget — the
+    # sleep must be clamped to the remainder, not stall the frame for a
+    # full max_backoff with milliseconds of budget left
+    import pytest
+
+    sleeps = []
+    src = ResilientSource(
+        FlakySource(fail_times=10),
+        RetryPolicy(
+            retries=3,
+            base_backoff=10.0,
+            max_backoff=10.0,
+            frame_budget=0.05,
+        ),
+        sleep=sleeps.append,
+        rng=random.Random(1),
+    )
+    with pytest.raises(SourceError):
+        src.fetch()
+    assert sleeps  # it did retry (budget not yet spent at first failure)
+    assert max(sleeps) <= 0.05  # every sleep fits the remaining budget
+
+
 def test_health_transitions_down_and_back():
     h = SourceHealth(clock=lambda: 123.0)
     assert h.status == "healthy"

@@ -38,6 +38,21 @@ _OPS = {
 
 SEVERITIES = ("warning", "critical")
 
+
+def sort_alerts(alerts: "list[dict]") -> "list[dict]":
+    """Canonical alert ordering — firing first, critical first, then by
+    chip — in place (returned for chaining).  One definition shared by
+    the engine and the service's endpoint-alert merge, so the banner
+    order never depends on which code path produced the list."""
+    alerts.sort(
+        key=lambda a: (
+            a["state"] != "firing",
+            a["severity"] != "critical",
+            a["chip"],
+        )
+    )
+    return alerts
+
 #: Default rules: conservative hardware-health thresholds.  Temperature and
 #: HBM-pressure limits apply across generations; both require 2 consecutive
 #: breaching frames.
@@ -178,14 +193,7 @@ class AlertEngine:
                 )
         # implicit resolution for chips/rules not seen this frame
         self._tracks.resolve_unseen(seen)
-        out.sort(
-            key=lambda a: (
-                a["state"] != "firing",
-                a["severity"] != "critical",
-                a["chip"],
-            )
-        )
-        return out
+        return sort_alerts(out)
 
     def firing(self, alerts: list[dict] | None = None) -> list[dict]:
         return [a for a in (alerts or []) if a["state"] == "firing"]

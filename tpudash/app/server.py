@@ -1022,9 +1022,19 @@ class DashboardServer:
         )
 
     async def healthz(self, request: web.Request) -> web.Response:
+        """Liveness + source health.  ``status`` distinguishes "one slice
+        quarantined" (degraded — source_health.endpoints names the open
+        breaker) from "all sources down" (down) without the probe having
+        to dig; ``ok`` stays True throughout — the PROCESS is alive and
+        serving, which is what a k8s liveness probe must measure (a
+        restart does not fix a down Prometheus)."""
         health = self.service.source_health()
+        status = health.get("status") if health else None
+        if status is None:
+            status = "down" if self.service.last_error else "healthy"
         return _json_response(
-            {"ok": True, "source": self.service.source.name,
+            {"ok": True, "status": status,
+             "source": self.service.source.name,
              "error": self.service.last_error,
              "source_health": health}
         )

@@ -106,6 +106,21 @@ def _model_name(accel: str) -> str:
     return gen.name if gen else (accel or "unknown")
 
 
+class _AttrRestore:
+    """Adapter putting a plain dict attribute (MultiSource.last_errors)
+    on the same (obj, snapshot) rollback list SourceHealth/CircuitBreaker
+    use in synthetic_load.  Restores by REBINDING the attribute — fetch()
+    assigns a fresh dict each cycle, so mutating the snapshotted object
+    would silently miss."""
+
+    def __init__(self, obj, attr: str):
+        self._obj = obj
+        self._attr = attr
+
+    def restore(self, snap: dict) -> None:
+        setattr(self._obj, self._attr, dict(snap))
+
+
 class DashboardService:
     def __init__(self, cfg: Config, source: MetricsSource):
         self.cfg = cfg
@@ -365,6 +380,26 @@ class DashboardService:
             health = src.__dict__.get("health")
             if health is not None and hasattr(health, "snapshot"):
                 health_snaps.append((health, health.snapshot()))
+            # per-endpoint circuit breakers (MultiSource) roll back too:
+            # a burst of profiled frames must not open — or reclose — a
+            # breaker the real monitoring cadence owns.  last_errors /
+            # _last_fault ride the same rollback so /healthz never
+            # serves a synthetic burst's failures as the live state.
+            # (_inflight deliberately does NOT roll back: a fetch
+            # dispatched under profile is a REAL call against the real
+            # endpoint, and forgetting it would re-dispatch a child
+            # mid-flight.)
+            breakers = src.__dict__.get("breakers")
+            if isinstance(breakers, dict):
+                for br in breakers.values():
+                    if hasattr(br, "snapshot"):
+                        health_snaps.append((br, br.snapshot()))
+                for attr in ("last_errors", "_last_fault"):
+                    d = src.__dict__.get(attr)
+                    if isinstance(d, dict):
+                        health_snaps.append(
+                            (_AttrRestore(src, attr), dict(d))
+                        )
             src = src.__dict__.get("inner")
         self.mute_notifications = True
         try:
@@ -645,10 +680,76 @@ class DashboardService:
             self._chip_hist_rowmap = {}
 
     def source_health(self) -> "dict | None":
-        """Health summary from the ResilientSource wrapper (None when
-        retries are disabled and the wrapper is absent)."""
+        """Health summary: the ResilientSource wrapper's rolling counters
+        plus — for the multi-endpoint join — per-endpoint circuit-breaker
+        state (``endpoints``), so /healthz and the frame payload can
+        distinguish "one slice quarantined" from "all sources down".
+        None when neither wrapper is present."""
         health = getattr(self.source, "health", None)
-        return health.summary() if health is not None else None
+        summary = health.summary() if health is not None else None
+        ep_fn = getattr(self.source, "endpoint_health", None)
+        endpoints = ep_fn() if callable(ep_fn) else None
+        if not endpoints:
+            return summary
+        # status derived from the breakers alone (all open → down, any
+        # non-closed or mid-streak → degraded)
+        states = [e["state"] for e in endpoints.values()]
+        if all(s == "open" for s in states):
+            ep_status = "down"
+        elif any(s != "closed" for s in states) or any(
+            e["consecutive_failures"] > 0 for e in endpoints.values()
+        ):
+            ep_status = "degraded"
+        else:
+            ep_status = "healthy"
+        if summary is None:
+            summary = {"status": ep_status}
+        else:
+            # the retry wrapper only sees whole-fetch outcomes, and a
+            # partial MultiSource fetch SUCCEEDS — its "healthy" must not
+            # mask a quarantined endpoint: the worse verdict wins
+            rank = {"healthy": 0, "degraded": 1, "down": 2}
+            summary = dict(summary)
+            if rank.get(ep_status, 0) > rank.get(summary.get("status"), 0):
+                summary["status"] = ep_status
+        summary["endpoints"] = endpoints
+        return summary
+
+    def _endpoint_alerts(self, now: float) -> list[dict]:
+        """Synthesized ``endpoint_down`` alert entries from the breaker
+        states — one per unhealthy endpoint, shaped like AlertEngine
+        output so silences, the webhook pager, and the banner treat a
+        quarantined slice exactly like a breaching chip.  Open/half-open
+        breakers fire; a closed breaker mid-streak is pending."""
+        ep_fn = getattr(self.source, "endpoint_health", None)
+        if not callable(ep_fn):
+            return []
+        out = []
+        for label, s in ep_fn().items():
+            if s["state"] == "closed" and s["consecutive_failures"] == 0:
+                continue
+            firing = s["state"] in ("open", "half_open")
+            open_for = s.get("open_for_s")
+            out.append(
+                {
+                    "rule": "endpoint_down",
+                    "column": "endpoint",
+                    "severity": "critical",
+                    "chip": label,
+                    "value": float(s["consecutive_failures"]),
+                    "threshold": float(s["failure_threshold"]),
+                    "state": "firing" if firing else "pending",
+                    "since": (
+                        round(now - open_for, 3)
+                        if firing and open_for is not None
+                        else None
+                    ),
+                    "streak": s["consecutive_failures"],
+                    "breaker": s["state"],
+                    "detail": s.get("last_error"),
+                }
+            )
+        return out
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -1179,6 +1280,26 @@ class DashboardService:
         if err != self.last_error:  # log streaks once, not per cycle
             log.warning("%s", err)
         self.last_error = err
+        if self.alert_engine is not None:
+            # a partial outage that turns total must keep the endpoint
+            # alerts current even though no table was published; chip
+            # alerts from the last good frame stay (their chips didn't
+            # recover — we just can't see them)
+            ep = self._endpoint_alerts(time.time())
+            if ep or any(
+                a.get("rule") == "endpoint_down" for a in self.last_alerts
+            ):
+                from tpudash.alerts import sort_alerts
+
+                kept = [
+                    a
+                    for a in self.last_alerts
+                    if a.get("rule") != "endpoint_down"
+                ]
+                self.last_alerts = self.silences.annotate(
+                    sort_alerts(kept + ep), time.time()
+                )
+                self._notify_alert_transitions()
         self._frame_open = False
         self.timer.end_frame()
         return None
@@ -1223,8 +1344,13 @@ class DashboardService:
         self.available = keys
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
+                from tpudash.alerts import sort_alerts
+
+                now_w = time.time()
+                alerts = self.alert_engine.evaluate(df)
+                alerts += self._endpoint_alerts(now_w)
                 self.last_alerts = self.silences.annotate(
-                    self.alert_engine.evaluate(df), time.time()
+                    sort_alerts(alerts), now_w
                 )
             self._notify_alert_transitions()
         # Fleet-wide trend history, one point per refresh interval (burst

@@ -1,0 +1,100 @@
+"""``python -m tpudash.chaos`` — a one-command chaos drill.
+
+Serves the full dashboard over a 3-endpoint MultiSource of synthetic
+slices, each wrapped in ChaosSource, so every resilience layer is
+visible live on one laptop: per-endpoint circuit breakers opening and
+reclosing (watch ``/healthz`` → ``source_health.endpoints``), the
+``endpoint_down`` alert on the banner, partial-degradation warnings
+while the healthy slices keep rendering, and concurrent child fetches
+keeping the frame fast while one endpoint misbehaves.
+
+    python -m tpudash.chaos                      # the default drill
+    TPUDASH_CHAOS='flap:period=4' python -m tpudash.chaos   # your scenario
+
+The default drill: endpoint ``chaos-a`` healthy, ``chaos-b`` flapping
+(period 6 — watch its breaker open and reclose), ``chaos-c`` slow and
+lossy (latency + transient errors + one dropped chip).  A custom
+``TPUDASH_CHAOS`` scenario replaces the per-endpoint defaults and is
+applied to endpoints ``chaos-b`` and ``chaos-c`` (``chaos-a`` stays
+healthy as the control, so the page always renders something).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tpudash.config import Config, configure_logging, load_config
+
+log = logging.getLogger(__name__)
+
+#: per-endpoint default scenarios (label → TPUDASH_CHAOS grammar)
+DEFAULT_DRILL = {
+    "chaos-a": "",
+    "chaos-b": "flap:period=6;seed=1",
+    "chaos-c": (
+        "latency:p=0.5,ms=300;error:p=0.25;"
+        "drop_chip:slice=chaos-c,chip=3;seed=2"
+    ),
+}
+
+
+def chaos_demo_source(cfg: Config):
+    """The drill's MultiSource: three synthetic slices behind chaos."""
+    from tpudash.sources.chaos import ChaosSource
+    from tpudash.sources.fixture import SyntheticSource
+    from tpudash.sources.multi import EndpointSpec, MultiSource
+
+    override = os.environ.get("TPUDASH_CHAOS", "")
+    children = []
+    for label, default_spec in DEFAULT_DRILL.items():
+        spec = default_spec
+        if override and label != "chaos-a":
+            spec = override
+        inner = SyntheticSource(
+            num_chips=min(cfg.synthetic_chips, 64),
+            generation=cfg.generation,
+        )
+        src = ChaosSource(inner, spec) if spec else inner
+        children.append(
+            (EndpointSpec(url=f"synthetic://{label}", slice_name=label), src)
+        )
+    return MultiSource(cfg, children=children)
+
+
+def make_chaos_app(cfg: Config | None = None):
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+
+    cfg = cfg or load_config()
+    # short breaker cooldown + tight deadline so the drill's state
+    # transitions are watchable within a coffee's attention span (env
+    # overrides still win — load_config already applied them)
+    if "TPUDASH_BREAKER_COOLDOWN" not in os.environ:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, breaker_cooldown=10.0)
+    if "TPUDASH_MULTI_DEADLINE" not in os.environ:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, multi_deadline=1.0)
+    service = DashboardService(cfg, chaos_demo_source(cfg))
+    return DashboardServer(service).build_app(), cfg
+
+
+def main() -> None:  # pragma: no cover - blocking entry
+    from aiohttp import web
+
+    configure_logging()
+    app, cfg = make_chaos_app()
+    log.info(
+        "chaos drill on :%d — endpoints %s; watch /healthz "
+        "source_health.endpoints for breaker transitions",
+        cfg.port,
+        ", ".join(DEFAULT_DRILL),
+    )
+    web.run_app(app, host=cfg.host, port=cfg.port)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -168,6 +168,21 @@ class Config:
     #: URLs ending in /metrics are scraped directly; others are Prometheus
     #: instant-query endpoints.
     multi_endpoints: str = ""
+    #: source="multi": per-child fetch deadline, seconds (children run
+    #: concurrently, so one frame pays ONE deadline for its slowest
+    #: child, not the sum of timeouts).  0 = use http_timeout.
+    multi_deadline: float = 0.0
+    #: Consecutive child-fetch failures before an endpoint's circuit
+    #: breaker opens (open endpoints are skipped at zero cost; see
+    #: sources/breaker.py).
+    breaker_failures: int = 3
+    #: Seconds an open circuit waits before a half-open probe fetch.
+    breaker_cooldown: float = 30.0
+    #: Fault-injection scenario for chaos drills ("" = off) — wraps the
+    #: configured source in ChaosSource (grammar: sources/chaos.py, e.g.
+    #: ``latency:p=0.3,ms=800;flap:period=6;seed=42``).  Drill tool;
+    #: never set it on the production dashboard by accident.
+    chaos: str = ""
 
     extra: dict = field(default_factory=dict)
 
@@ -203,6 +218,10 @@ _ENV_MAP = {
     "session_limit": "TPUDASH_SESSION_LIMIT",
     "session_ttl": "TPUDASH_SESSION_TTL",
     "multi_endpoints": "TPUDASH_MULTI_ENDPOINTS",
+    "multi_deadline": "TPUDASH_MULTI_DEADLINE",
+    "breaker_failures": "TPUDASH_BREAKER_FAILURES",
+    "breaker_cooldown": "TPUDASH_BREAKER_COOLDOWN",
+    "chaos": "TPUDASH_CHAOS",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",

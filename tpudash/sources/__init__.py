@@ -54,6 +54,14 @@ def make_source(cfg) -> MetricsSource:
     ResilientSource (per-fetch retry/backoff + health tracking,
     sources/retry.py) unless Config.fetch_retries == 0."""
     src = _make_source(cfg)
+    chaos = getattr(cfg, "chaos", "")
+    if chaos:
+        # innermost wrap: retry/recording/breakers must react to injected
+        # faults exactly as they would to a real flaky endpoint (and a
+        # recorded drill captures what the dashboard actually saw)
+        from tpudash.sources.chaos import ChaosSource
+
+        src = ChaosSource(src, chaos)
     record_path = getattr(cfg, "record_path", "")
     if record_path and cfg.source != "replay":
         # record inside the retry wrapper: only successful fetches land in
@@ -67,16 +75,24 @@ def make_source(cfg) -> MetricsSource:
     if retries > 0:
         from tpudash.sources.retry import ResilientSource, RetryPolicy
 
-        src = ResilientSource(
-            src,
-            RetryPolicy(
+        if cfg.source == "multi":
+            # the multi join is already resilient per endpoint (circuit
+            # breakers, concurrent deadline, partial degradation), and
+            # re-invoking the WHOLE join on an all-failed frame would
+            # multiply every endpoint's breaker failures by the attempt
+            # count — one transient fleet-wide blip would quarantine all
+            # endpoints for a full cooldown.  Keep the wrapper for its
+            # health ledger; the breakers own the retry policy.
+            policy = RetryPolicy(retries=0)
+        else:
+            policy = RetryPolicy(
                 retries=retries,
                 base_backoff=getattr(cfg, "retry_backoff", 0.25),
                 # a down endpoint must not stall the frame lock past its
                 # slot: stop retrying once the refresh interval is spent
                 frame_budget=getattr(cfg, "refresh_interval", None) or None,
-            ),
-        )
+            )
+        src = ResilientSource(src, policy)
     return src
 
 

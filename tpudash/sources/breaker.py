@@ -1,0 +1,123 @@
+"""Per-endpoint circuit breakers — quarantine a down endpoint, cheaply.
+
+Retry/backoff (sources/retry.py) makes one *fetch* resilient; it does
+nothing about the NEXT frame, which walks straight back into the same
+dead endpoint and pays its full HTTP timeout again, every cycle.  At
+multi-slice scale (MultiSource) that cost multiplies: one down v5e slice
+taxes every 5 s frame for its whole timeout while the healthy slices
+wait.  The breaker is the standing memory the retry wrapper lacks:
+
+- ``closed``   — normal operation; failures increment a streak;
+- ``open``     — the streak hit ``BreakerPolicy.failures``: every fetch
+  is skipped at zero cost until ``cooldown`` elapses;
+- ``half_open``— cooldown over: ONE probe fetch is allowed through; its
+  success recloses the breaker, its failure reopens it (fresh cooldown).
+
+The breaker never decides *what* a failure is — the caller (MultiSource)
+records outcomes; the breaker only answers ``allow()`` and keeps the
+state machine honest.  Clock-injectable for tests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
+
+@dataclass(frozen=True)
+class BreakerPolicy:
+    #: consecutive failures before the circuit opens.
+    failures: int = 3
+    #: seconds an open circuit waits before allowing a half-open probe.
+    cooldown: float = 30.0
+
+
+class CircuitBreaker:
+    """closed → open → half_open state machine for one endpoint."""
+
+    def __init__(self, policy: BreakerPolicy | None = None, clock=time.monotonic):
+        self.policy = policy or BreakerPolicy()
+        self._clock = clock
+        self.state = STATE_CLOSED
+        self.consecutive_failures = 0
+        self.total_failures = 0
+        self.total_opens = 0
+        self._opened_at: "float | None" = None
+
+    def allow(self) -> bool:
+        """May the caller fetch this endpoint now?  Transitions an open
+        circuit to half-open once the cooldown has elapsed (the probe
+        this call just permitted MUST be followed by record_success or
+        record_failure before the next allow() — MultiSource's one
+        fetch-per-frame cadence guarantees that)."""
+        if self.state == STATE_OPEN:
+            if self._clock() - self._opened_at >= self.policy.cooldown:
+                self.state = STATE_HALF_OPEN
+                return True
+            return False
+        return True  # closed, or half_open (the probe itself)
+
+    def record_success(self) -> None:
+        self.state = STATE_CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = None
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        self.total_failures += 1
+        if self.state == STATE_HALF_OPEN or (
+            self.state == STATE_CLOSED
+            and self.consecutive_failures >= self.policy.failures
+        ):
+            # a failed half-open probe reopens with a FRESH cooldown —
+            # a flapping endpoint costs one probe per cooldown, not one
+            # timeout per frame
+            self.state = STATE_OPEN
+            self.total_opens += 1
+            self._opened_at = self._clock()
+
+    def snapshot(self) -> dict:
+        """State for rollback — profiling renders are synthetic load and
+        must not advance breaker streaks (app/service.synthetic_load)."""
+        d = dict(self.__dict__)
+        d.pop("policy")
+        d.pop("_clock")
+        return d
+
+    def restore(self, snap: dict) -> None:
+        self.__dict__.update(snap)
+
+    @property
+    def cooldown_remaining(self) -> float:
+        if self.state != STATE_OPEN:
+            return 0.0
+        return max(
+            0.0, self.policy.cooldown - (self._clock() - self._opened_at)
+        )
+
+    @property
+    def open_for_s(self) -> "float | None":
+        """Seconds since the circuit (last) opened; None when closed."""
+        if self._opened_at is None:
+            return None
+        return max(0.0, self._clock() - self._opened_at)
+
+    def summary(self) -> dict:
+        """JSON-able state for /healthz, the frame payload, and alerts."""
+        return {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "total_failures": self.total_failures,
+            "total_opens": self.total_opens,
+            "failure_threshold": self.policy.failures,
+            "cooldown_remaining_s": round(self.cooldown_remaining, 3),
+            "open_for_s": (
+                round(self.open_for_s, 3)
+                if self.open_for_s is not None
+                else None
+            ),
+        }

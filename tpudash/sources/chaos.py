@@ -1,0 +1,240 @@
+"""ChaosSource — deterministic, seeded fault injection for any source.
+
+The failure paths are the least-exercised code in a dashboard: the
+reference's only failure handling (a catch-all banner) was, by
+construction, the only path its operators ever saw tested.  tpudash has
+retries, breakers, watchdogs, partial-degradation joins — all of which
+rot unless something continuously drives them.  ChaosSource wraps any
+:class:`MetricsSource` and injects faults on a seeded schedule, so a
+drill (or the CI soak) replays the SAME failure sequence every run.
+
+Scenario grammar (``TPUDASH_CHAOS``): semicolon-separated directives,
+each ``name:key=value,key=value``:
+
+    latency:p=0.3,ms=800        # with prob p, delay the fetch by ms
+    error:p=0.5                 # with prob p, raise a transient SourceError
+    hang:p=0.1,ms=3000          # with prob p, block ms (bounded), then fail
+    flap:period=6               # scripted up/down: the 2nd half of every
+                                # period-fetch window fails deterministically
+    drop_chip:slice=slice-a,chip=3   # chip dropout (slice= optional)
+    partial:p=0.2,frac=0.5      # with prob p, drop ~frac of the samples
+    malformed:p=0.1             # with prob p, corrupt ~10% of samples
+                                # (bogus chip ids, NaN values)
+    seed=42                     # RNG seed (determinism across runs)
+
+e.g. ``latency:p=0.3,ms=800;drop_chip:slice=v5e-a,chip=3;flap:period=6``.
+Hangs are capped (120 s) so a drill can never wedge a process forever —
+the real unbounded-hang case is the refresh watchdog's job, not chaos's.
+
+Composable around any source: set ``TPUDASH_CHAOS`` to wrap the
+configured source (sources/__init__.make_source), or construct directly
+around one MultiSource child to chaos a single endpoint.  A one-command
+drill lives at ``python -m tpudash.chaos`` (tpudash/chaos.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import random
+import time
+
+from tpudash.schema import SampleBatch
+from tpudash.sources.base import MetricsSource, SourceError
+
+log = logging.getLogger("tpudash.sources.chaos")
+
+#: hard ceiling on one injected hang, seconds — chaos must be bounded
+#: (a drill that wedges the process forever is an outage, not a drill)
+MAX_HANG_S = 120.0
+
+#: fraction of samples corrupted by one ``malformed`` injection
+_MALFORMED_FRAC = 0.1
+#: chip id far past any real pod size (heatmap sizing excludes >= 16384)
+_BOGUS_CHIP_ID = 10**9
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosScenario:
+    """Parsed ``TPUDASH_CHAOS`` scenario (empty scenario = no faults)."""
+
+    seed: int = 0
+    latency_p: float = 0.0
+    latency_ms: float = 0.0
+    error_p: float = 0.0
+    hang_p: float = 0.0
+    hang_ms: float = 0.0
+    flap_period: int = 0
+    partial_p: float = 0.0
+    partial_frac: float = 0.5
+    malformed_p: float = 0.0
+    #: (slice_id_or_None, chip_id) pairs — None slice matches every slice
+    drop_chips: tuple = ()
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosScenario":
+        """Parse the scenario grammar; a mistyped drill must fail loudly
+        at startup, never silently run a healthy fleet."""
+        kwargs: dict = {}
+        drop: list = []
+        for item in (spec or "").split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            name, _, argstr = item.partition(":")
+            name = name.strip()
+            # seed has no k=v args — accept both spellings (seed=42 and
+            # seed:42) BEFORE the generic arg loop would reject the bare
+            # value
+            if name.startswith("seed="):
+                kwargs["seed"] = int(name[len("seed="):])
+                continue
+            if name == "seed":
+                kwargs["seed"] = int(argstr)
+                continue
+            args: dict = {}
+            for pair in argstr.split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                k, sep, v = pair.partition("=")
+                if not sep:
+                    raise ValueError(f"bad chaos arg {pair!r} in {item!r}")
+                args[k.strip()] = v.strip()
+            try:
+                if name == "latency":
+                    kwargs["latency_p"] = float(args.get("p", 1.0))
+                    kwargs["latency_ms"] = float(args["ms"])
+                elif name == "error":
+                    kwargs["error_p"] = float(args.get("p", 1.0))
+                elif name == "hang":
+                    kwargs["hang_p"] = float(args.get("p", 1.0))
+                    kwargs["hang_ms"] = float(args["ms"])
+                elif name == "flap":
+                    kwargs["flap_period"] = int(args["period"])
+                    if kwargs["flap_period"] < 2:
+                        raise ValueError("flap period must be >= 2")
+                elif name == "partial":
+                    kwargs["partial_p"] = float(args.get("p", 1.0))
+                    kwargs["partial_frac"] = float(args.get("frac", 0.5))
+                elif name == "drop_chip":
+                    drop.append((args.get("slice"), int(args["chip"])))
+                elif name == "malformed":
+                    kwargs["malformed_p"] = float(args.get("p", 1.0))
+                else:
+                    raise ValueError(f"unknown chaos directive {name!r}")
+            except KeyError as e:
+                raise ValueError(
+                    f"chaos directive {item!r} missing arg {e}"
+                ) from None
+        for k in ("latency_p", "error_p", "hang_p", "partial_p",
+                  "malformed_p", "partial_frac"):
+            p = kwargs.get(k, 0.0)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos {k}={p} outside [0, 1]")
+        if drop:
+            kwargs["drop_chips"] = tuple(drop)
+        return cls(**kwargs)
+
+
+class ChaosSource(MetricsSource):
+    """Wrap any source with scheduled fault injection.
+
+    Transparent to the rest of the stack, like ResilientSource: same
+    ``fetch()`` protocol, ``SourceError`` for every injected failure
+    (chaos models scrape faults, not code bugs), attribute fall-through
+    to the inner source.  The RNG is seeded from the scenario, so the
+    fault sequence is a pure function of (scenario, fetch index) —
+    replayable in CI and across drill runs.
+    """
+
+    def __init__(
+        self,
+        inner: MetricsSource,
+        scenario: "ChaosScenario | str",
+        sleep=time.sleep,
+        rng: "random.Random | None" = None,
+    ):
+        if isinstance(scenario, str):
+            scenario = ChaosScenario.parse(scenario)
+        self.inner = inner
+        self.scenario = scenario
+        self._sleep = sleep
+        self._rng = rng or random.Random(scenario.seed)
+        self.fetch_count = 0
+        #: injected-fault tally by directive name (drill observability)
+        self.injected: collections.Counter = collections.Counter()
+        self.name = f"{inner.name}+chaos"
+
+    def fetch(self):
+        sc = self.scenario
+        n = self.fetch_count
+        self.fetch_count += 1
+        rng = self._rng
+        if sc.flap_period and (n % sc.flap_period) >= (sc.flap_period + 1) // 2:
+            self.injected["flap"] += 1
+            raise SourceError(
+                f"chaos: flap down-window (cycle {n} of period {sc.flap_period})"
+            )
+        if sc.hang_p and rng.random() < sc.hang_p:
+            self.injected["hang"] += 1
+            hang_s = min(sc.hang_ms / 1000.0, MAX_HANG_S)
+            self._sleep(hang_s)
+            # a hung endpoint that finally answers is still a failed
+            # cycle — by now the frame has long moved on
+            raise SourceError(f"chaos: endpoint hung {hang_s:g}s (bounded)")
+        if sc.latency_p and rng.random() < sc.latency_p:
+            self.injected["latency"] += 1
+            self._sleep(sc.latency_ms / 1000.0)
+        if sc.error_p and rng.random() < sc.error_p:
+            self.injected["error"] += 1
+            raise SourceError("chaos: injected transient error")
+        got = self.inner.fetch()
+        if not (sc.drop_chips or sc.partial_p or sc.malformed_p):
+            return got
+        # payload mutations work on the Sample-list representation; a
+        # columnar batch is materialized (chaos is a drill path, not the
+        # hot path — clarity beats the copy)
+        samples = got.to_samples() if isinstance(got, SampleBatch) else list(got)
+        if sc.drop_chips:
+            drop = set(sc.drop_chips)
+            kept = [
+                s
+                for s in samples
+                if (s.chip.slice_id, s.chip.chip_id) not in drop
+                and (None, s.chip.chip_id) not in drop
+            ]
+            if len(kept) != len(samples):
+                self.injected["drop_chip"] += 1
+            samples = kept
+        if sc.partial_p and rng.random() < sc.partial_p:
+            self.injected["partial"] += 1
+            samples = [
+                s for s in samples if rng.random() >= sc.partial_frac
+            ]
+        if sc.malformed_p and rng.random() < sc.malformed_p:
+            self.injected["malformed"] += 1
+            out = []
+            for s in samples:
+                if rng.random() < _MALFORMED_FRAC:
+                    # the corruption a half-written scrape produces: a
+                    # garbage chip id and a non-numeric value — downstream
+                    # must drop the cell, not the frame
+                    s = dataclasses.replace(
+                        s,
+                        value=float("nan"),
+                        chip=dataclasses.replace(
+                            s.chip, chip_id=_BOGUS_CHIP_ID
+                        ),
+                    )
+                out.append(s)
+            samples = out
+        return samples
+
+    def __getattr__(self, item):
+        # fall through for inner-source extras (endpoint_health, last_errors)
+        return getattr(self.inner, item)
+
+    def close(self) -> None:
+        self.inner.close()

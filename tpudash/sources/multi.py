@@ -22,17 +22,32 @@ Partial-failure policy: one slice's scrape failing must not blank the
 other slices (the reference blanks the whole page on any fetch error,
 app.py:225-227).  fetch() returns the union of the healthy children and
 records per-child errors in ``last_errors``; it raises only when every
-child fails.
+child fails — and even then ``last_errors`` keeps the final cycle's
+per-endpoint detail for partial-degradation consumers.
+
+Endpoint isolation: children are fetched CONCURRENTLY with a shared
+per-child deadline (Config.multi_deadline, default http_timeout), so
+frame latency is bounded by the slowest *healthy* child, not the sum of
+timeouts.  Each endpoint carries a :class:`CircuitBreaker`: after
+``Config.breaker_failures`` consecutive failures the endpoint is skipped
+at zero cost until ``Config.breaker_cooldown`` elapses, then a single
+half-open probe decides whether it recloses.  A child that blows its
+deadline stays parked on its worker thread and is never re-dispatched
+while still in flight (sources may not be re-entrant); its eventual
+completion is harvested and discarded.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
+import time
 
 from tpudash.config import Config
 from tpudash.schema import SampleBatch
 from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.breaker import BreakerPolicy, CircuitBreaker
 
 log = logging.getLogger("tpudash.sources.multi")
 
@@ -74,12 +89,60 @@ def _child_for(ep: EndpointSpec, cfg: Config) -> MetricsSource:
     return PrometheusSource(dataclasses.replace(cfg, prometheus_endpoint=ep.url))
 
 
+class _FetchTask:
+    """One child fetch on its own DAEMON thread.
+
+    Not a ThreadPoolExecutor: concurrent.futures joins its (non-daemon)
+    workers at interpreter exit, so one wedged endpoint would hold
+    process shutdown hostage for the length of its hang — a chaos drill
+    must die on Ctrl-C, not after a 120 s injected hang drains.  Daemon
+    threads die with the process.  The inflight guard in fetch() bounds
+    live threads to one per child, so per-frame thread creation costs
+    nothing that matters at a 5 s cadence."""
+
+    def __init__(self, fn):
+        self._done = threading.Event()
+        self._result = None
+        self._exc: "BaseException | None" = None
+        threading.Thread(
+            target=self._run,
+            args=(fn,),
+            name="tpudash-multi-fetch",
+            daemon=True,
+        ).start()
+
+    def _run(self, fn) -> None:
+        try:
+            self._result = fn()
+        except BaseException as e:  # noqa: BLE001 — delivered via result()
+            self._exc = e
+        finally:
+            self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self):
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self) -> "BaseException | None":
+        return self._exc
+
+
 class MultiSource(MetricsSource):
     name = "multi"
 
-    def __init__(self, cfg: Config, children: list | None = None):
+    def __init__(
+        self, cfg: Config, children: list | None = None, clock=time.monotonic
+    ):
         """children: optional pre-built [(EndpointSpec, MetricsSource)] —
-        tests inject fakes here; production builds from cfg.multi_endpoints."""
+        tests inject fakes here; production builds from cfg.multi_endpoints.
+        ``clock`` feeds the breakers (tests drive cooldowns manually)."""
         self.cfg = cfg
         if children is None:
             children = [
@@ -88,45 +151,180 @@ class MultiSource(MetricsSource):
             ]
         self.children: list = children
         self.last_errors: dict[str, str] = {}
+        policy = BreakerPolicy(
+            failures=getattr(cfg, "breaker_failures", 3),
+            cooldown=getattr(cfg, "breaker_cooldown", 30.0),
+        )
+        self._labels = [ep.slice_name or ep.url for ep, _ in children]
+        # labels key the breakers, the inflight map, and last_errors: a
+        # duplicate would share one breaker between two endpoints and let
+        # an overwritten inflight entry re-dispatch a hung child — refuse
+        # the misconfiguration at startup, not mid-outage
+        seen: set = set()
+        for label in self._labels:
+            if label in seen:
+                raise ValueError(
+                    f"duplicate endpoint label {label!r} in multi source "
+                    "(give each endpoint a distinct slice_name= prefix)"
+                )
+            seen.add(label)
+        self.breakers: dict[str, CircuitBreaker] = {
+            label: CircuitBreaker(policy, clock=clock)
+            for label in self._labels
+        }
+        #: label → _FetchTask for a fetch that outlived its deadline; the
+        #: child is never re-dispatched while this is pending
+        self._inflight: dict = {}
+        #: label → most recent REAL failure message — kept across the
+        #: quarantine so /healthz can still say WHY an endpoint's breaker
+        #: opened ("circuit open" alone names the consequence, not the
+        #: cause); cleared on success
+        self._last_fault: dict[str, str] = {}
+
+    @property
+    def deadline(self) -> float:
+        """Per-child fetch deadline, seconds."""
+        return (
+            getattr(self.cfg, "multi_deadline", 0.0)
+            or getattr(self.cfg, "http_timeout", 4.0)
+            or 4.0
+        )
+
+    def endpoint_health(self) -> dict:
+        """Per-endpoint breaker/health state (label → summary + url +
+        last cycle's error) — surfaced on the frame, /healthz, and the
+        ``endpoint_down`` alert."""
+        out = {}
+        for (ep, _), label in zip(self.children, self._labels):
+            s = self.breakers[label].summary()
+            s["url"] = ep.url
+            err = self.last_errors.get(label)
+            if err:
+                s["last_error"] = err
+            out[label] = s
+        return out
+
+    def _relabel(self, ep: EndpointSpec, label: str, got):
+        """Apply the slice_name relabel to one child's result."""
+        if ep.slice_name is None:
+            return got
+        is_batch = isinstance(got, SampleBatch)
+        child_slices = (
+            set(got.slices) if is_batch else {s.chip.slice_id for s in got}
+        )
+        if len(child_slices) > 1:
+            # relabeling a multi-slice child collapses distinct
+            # (slice, chip) keys onto one name → duplicate rows
+            log.warning(
+                "multi: relabeling child %s which emits %d slices "
+                "%s — chip keys may collide",
+                label, len(child_slices), sorted(child_slices),
+            )
+        if is_batch:
+            return got.relabel_slice(ep.slice_name)
+        return [
+            dataclasses.replace(
+                s, chip=dataclasses.replace(s.chip, slice_id=ep.slice_name)
+            )
+            for s in got
+        ]
 
     def fetch(self):
-        results = []  # per healthy child: list[Sample] or SampleBatch
         errors: dict[str, str] = {}
-        for ep, child in self.children:
-            label = ep.slice_name or ep.url
-            try:
-                got = child.fetch()
-            except SourceError as e:
-                errors[label] = str(e)
-                log.warning("multi: child %s failed: %s", label, e)
-                continue
-            is_batch = isinstance(got, SampleBatch)
-            if ep.slice_name is not None:
-                child_slices = (
-                    set(got.slices) if is_batch else {s.chip.slice_id for s in got}
+        deadline = self.deadline
+        pending: list = []  # (label, ep, future) in child order
+        for (ep, child), label in zip(self.children, self._labels):
+            breaker = self.breakers[label]
+            old = self._inflight.get(label)
+            if old is not None and old.done():
+                # harvest a fetch a previous frame gave up on: its data
+                # is a frame stale either way — drop it, and let the
+                # breaker judge only the fetches it dispatched
+                self._inflight.pop(label)
+                old.exception()  # consume, never propagate stale
+                old = None
+            if not breaker.allow():
+                # quarantined: zero cost, and no extra streak inflation
+                # while the circuit is already open.  The root-cause
+                # fault rides along — "circuit open" alone would hide
+                # WHY from /healthz for the whole cooldown.
+                fault = self._last_fault.get(label)
+                errors[label] = (
+                    f"circuit open ({breaker.cooldown_remaining:.1f}s "
+                    "until half-open probe)"
+                    + (f"; last failure: {fault}" if fault else "")
                 )
-                if len(child_slices) > 1:
-                    # relabeling a multi-slice child collapses distinct
-                    # (slice, chip) keys onto one name → duplicate rows
-                    log.warning(
-                        "multi: relabeling child %s which emits %d slices "
-                        "%s — chip keys may collide",
-                        label, len(child_slices), sorted(child_slices),
+                continue
+            if old is not None:
+                # still wedged: never stack a second call on a child
+                # (sources are not re-entrant) — each frame it stays
+                # wedged extends the streak toward the breaker opening
+                errors[label] = self._last_fault[label] = (
+                    "previous fetch still in flight (endpoint hung)"
+                )
+                breaker.record_failure()
+                continue
+            fut = _FetchTask(child.fetch)
+            self._inflight[label] = fut
+            pending.append((label, ep, fut))
+
+        results = []  # per healthy child: list[Sample] or SampleBatch
+        bug: "Exception | None" = None
+        if pending:
+            # one SHARED deadline: children run concurrently, so the
+            # frame pays ONE deadline for the slowest child, not the sum
+            end = time.monotonic() + deadline
+            for _, _, fut in pending:
+                fut.wait(max(0.0, end - time.monotonic()))
+            for label, ep, fut in pending:
+                breaker = self.breakers[label]
+                if not fut.done():
+                    # parked — stays in _inflight for a later harvest
+                    errors[label] = self._last_fault[label] = (
+                        f"no response within the {deadline:g}s deadline"
                     )
-                if is_batch:
-                    got = got.relabel_slice(ep.slice_name)
-                else:
-                    got = [
-                        dataclasses.replace(
-                            s, chip=dataclasses.replace(s.chip, slice_id=ep.slice_name)
-                        )
-                        for s in got
-                    ]
-            results.append(got)
+                    breaker.record_failure()
+                    log.warning(
+                        "multi: child %s blew the %gs deadline",
+                        label, deadline,
+                    )
+                    continue
+                self._inflight.pop(label, None)
+                try:
+                    got = fut.result()
+                except SourceError as e:
+                    errors[label] = self._last_fault[label] = str(e)
+                    breaker.record_failure()
+                    log.warning("multi: child %s failed: %s", label, e)
+                    continue
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    # a bug (parser, wrapper), not a scrape fault: the
+                    # breaker ledger sees it, and it propagates — same
+                    # policy as ResilientSource.  Raising is DEFERRED so
+                    # every sibling's completed fetch still lands in its
+                    # own breaker ledger and leaves the inflight map.
+                    breaker.record_failure()
+                    self._last_fault[label] = f"{type(e).__name__}: {e}"
+                    bug = e
+                    continue
+                breaker.record_success()
+                self._last_fault.pop(label, None)
+                results.append(self._relabel(ep, label, got))
+
+        # populated on EVERY path (including the raises below):
+        # partial-degradation consumers read the final cycle's detail
         self.last_errors = errors
+        if bug is not None:
+            raise bug
         if not any(len(r) for r in results):
-            detail = "; ".join(f"{k}: {v}" for k, v in errors.items())
-            raise SourceError(f"all {len(self.children)} endpoints failed: {detail}")
+            detail = "; ".join(
+                f"{k}: {v} [breaker {self.breakers[k].state}, "
+                f"{self.breakers[k].consecutive_failures} consecutive]"
+                for k, v in errors.items()
+            )
+            raise SourceError(
+                f"all {len(self.children)} endpoints failed: {detail}"
+            )
         if all(isinstance(r, SampleBatch) for r in results):
             return SampleBatch.concat(results)
         # mixed representations (e.g. a synthetic child among scrapes):
@@ -135,3 +333,9 @@ class MultiSource(MetricsSource):
         for r in results:
             samples.extend(r.to_samples() if isinstance(r, SampleBatch) else r)
         return samples
+
+    def close(self) -> None:
+        # fetch threads are daemons — nothing to shut down; a still-hung
+        # fetch dies with the process instead of blocking exit
+        for _, child in self.children:
+            child.close()

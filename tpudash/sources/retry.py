@@ -143,7 +143,18 @@ class ResilientSource(MetricsSource):
                     and time.monotonic() - start >= budget
                 )
                 if made < attempts and not out_of_time:
-                    self._sleep(self.policy.backoff(attempt, self._rng))
+                    delay = self.policy.backoff(attempt, self._rng)
+                    if budget is not None:
+                        # clamp to what's LEFT of the frame budget: a
+                        # max_backoff sleep must not start with only
+                        # milliseconds of budget remaining (the next
+                        # attempt would be skipped as out-of-time anyway,
+                        # after stalling the frame for the whole sleep)
+                        delay = min(
+                            delay,
+                            max(0.0, budget - (time.monotonic() - start)),
+                        )
+                    self._sleep(delay)
                     continue
                 break
             except Exception:
