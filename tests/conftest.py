@@ -22,3 +22,28 @@ import jax  # noqa: E402  (must come after the env setup above)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# -- runtime lock/race sanitizer (TPUDASH_RACECHECK=1) ------------------------
+# Every test runs inside a RaceCheck patch window: threading locks
+# allocated during the test are traced, and the test FAILS on any
+# lock-order inversion observed across the breaker/multi/service/session
+# layers.  CI's static-analysis job runs the concurrency-heavy files in
+# this mode; locally: TPUDASH_RACECHECK=1 python -m pytest tests/ ...
+# Tests that PLANT inversions on purpose opt out with
+# @pytest.mark.racecheck_exempt.
+if os.environ.get("TPUDASH_RACECHECK", "").strip() not in ("", "0"):
+    import pytest  # noqa: E402
+
+    @pytest.fixture(autouse=True)
+    def _racecheck(request):
+        if request.node.get_closest_marker("racecheck_exempt"):
+            yield
+            return
+        from tpudash.analysis.racecheck import RaceCheck
+
+        rc = RaceCheck().install()
+        try:
+            yield
+        finally:
+            rc.uninstall()
+        rc.assert_clean()

@@ -1,0 +1,451 @@
+"""The analyzer analyzed: every tpulint rule proven on known-bad and
+known-good fixtures, the allow mechanism exercised, and the racecheck
+harness shown to catch a planted lock-order inversion and a planted
+unguarded shared-attribute write — then shown clean over the real
+service stack under concurrent load.
+
+Acceptance contract (ISSUE 2): introducing any known-bad fixture below
+into the package would make ``python -m tpudash.analysis.lint`` exit
+non-zero naming the rule and file:line; the shipped tree lints clean.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpudash.analysis.lint import (
+    RULE_BLOCKING,
+    RULE_BROAD_EXCEPT,
+    RULE_ENV_DECLARED,
+    RULE_ENV_READ,
+    RULE_WALL_CLOCK,
+    lint_paths,
+    lint_source,
+    main as lint_main,
+)
+from tpudash.analysis.racecheck import RaceCheck
+
+DECLARED = frozenset({"TPUDASH_SOURCE", "TPUDASH_DOCUMENTED"})
+DOCS = "... TPUDASH_DOCUMENTED is documented here ..."
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def check(source, path="pkg/tpudash/mod.py"):
+    return lint_source(source, path, declared_env=DECLARED, doc_text=DOCS)
+
+
+# -- rule: wall-clock ---------------------------------------------------------
+
+def test_wall_clock_flags_time_time():
+    findings = check("import time\ndeadline = time.time() + 5\n")
+    assert rules_of(findings) == [RULE_WALL_CLOCK]
+    assert findings[0].line == 2
+
+
+def test_wall_clock_flags_from_import_and_alias():
+    assert rules_of(check("from time import time\nt = time()\n")) == [
+        RULE_WALL_CLOCK
+    ]
+    assert rules_of(check("import time as _t\nx = _t.time()\n")) == [
+        RULE_WALL_CLOCK
+    ]
+
+
+def test_wall_clock_passes_monotonic():
+    assert check("import time\nstart = time.monotonic()\n") == []
+
+
+def test_wall_clock_allow_marker_inline_and_preceding_line():
+    assert check(
+        "import time\n"
+        "ts = time.time()  # tpulint: allow[wall-clock] epoch stamp\n"
+    ) == []
+    assert check(
+        "import time\n"
+        "# tpulint: allow[wall-clock] epoch stamp for the recorder\n"
+        "ts = time.time()\n"
+    ) == []
+
+
+# -- rule: env-read -----------------------------------------------------------
+
+def test_env_read_flags_environ_get_getenv_subscript_membership():
+    bad = [
+        "import os\nv = os.environ.get('TPUDASH_SOURCE', '')\n",
+        "import os\nv = os.getenv('TPUDASH_SOURCE')\n",
+        "from os import getenv\nv = getenv('TPUDASH_SOURCE')\n",
+        "import os\nv = os.environ['TPUDASH_SOURCE']\n",
+        "import os\nok = 'TPUDASH_SOURCE' in os.environ\n",
+        # an env mapping passed around under another name is still an
+        # env read — the generic .get(literal) pattern catches it
+        "def f(src):\n    return src.get('TPUDASH_SOURCE', '')\n",
+    ]
+    for source in bad:
+        assert RULE_ENV_READ in rules_of(check(source)), source
+
+
+def test_env_read_allowed_inside_config_py():
+    source = "import os\nv = os.environ.get('TPUDASH_SOURCE', '')\n"
+    assert (
+        RULE_ENV_READ
+        not in rules_of(
+            lint_source(
+                source,
+                "pkg/tpudash/config.py",
+                declared_env=DECLARED,
+                doc_text=DOCS,
+            )
+        )
+    )
+
+
+def test_env_read_ignores_non_tpudash_names():
+    assert check("import os\nv = os.environ.get('JAX_PLATFORMS', '')\n") == []
+
+
+# -- rule: blocking-under-lock ------------------------------------------------
+
+def test_blocking_flags_sleep_requests_open_under_with_lock():
+    bad = [
+        "import time\ndef f(lock):\n    with lock:\n        time.sleep(1)\n",
+        (
+            "import requests\n"
+            "def f(self):\n"
+            "    with self._publish_lock:\n"
+            "        requests.post('http://x', json={})\n"
+        ),
+        "def f(lock):\n    with lock:\n        data = open('f').read()\n",
+        (
+            "import os\n"
+            "def f(lock, a, b):\n"
+            "    with lock:\n"
+            "        os.replace(a, b)\n"
+        ),
+    ]
+    for source in bad:
+        assert RULE_BLOCKING in rules_of(check(source)), source
+
+
+def test_blocking_applies_inside_locked_convention_functions():
+    source = (
+        "import time\n"
+        "def _save_locked(self):\n"
+        "    time.sleep(0.1)\n"
+    )
+    assert rules_of(check(source)) == [RULE_BLOCKING]
+
+
+def test_blocking_passes_outside_lock_and_in_nested_function():
+    assert check("import time\ndef f():\n    time.sleep(1)\n") == []
+    # a closure defined under the lock does not RUN under the lock
+    source = (
+        "import time\n"
+        "def f(lock):\n"
+        "    with lock:\n"
+        "        def later():\n"
+        "            time.sleep(1)\n"
+        "    return later\n"
+    )
+    assert check(source) == []
+
+
+def test_blocking_scoped_allow_on_function_header():
+    source = (
+        "import os\n"
+        "# tpulint: allow[blocking-under-lock] dedicated I/O lock\n"
+        "def _save_locked(self, a, b):\n"
+        "    os.replace(a, b)\n"
+        "    os.unlink(a)\n"
+    )
+    assert check(source) == []
+
+
+# -- rule: broad-except -------------------------------------------------------
+
+def test_broad_except_flags_bare_and_swallowed_baseexception():
+    assert rules_of(
+        check("try:\n    x = 1\nexcept:\n    pass\n")
+    ) == [RULE_BROAD_EXCEPT]
+    assert rules_of(
+        check("try:\n    x = 1\nexcept BaseException:\n    x = 2\n")
+    ) == [RULE_BROAD_EXCEPT]
+
+
+def test_broad_except_passes_reraise_and_narrow_handlers():
+    assert check(
+        "try:\n    x = 1\nexcept BaseException:\n    raise\n"
+    ) == []
+    assert check(
+        "try:\n    x = 1\nexcept ValueError:\n    x = 2\n"
+    ) == []
+
+
+# -- rule: env-declared -------------------------------------------------------
+
+def test_env_declared_flags_unknown_and_undocumented_names():
+    findings = check("NAME = 'TPUDASH_NOT_A_REAL_KNOB'\n")
+    assert rules_of(findings) == [RULE_ENV_DECLARED]
+    assert "not declared" in findings[0].message
+    findings = check("NAME = 'TPUDASH_SOURCE'\n")  # declared, not in DOCS
+    assert rules_of(findings) == [RULE_ENV_DECLARED]
+    assert "not documented" in findings[0].message
+
+
+def test_env_declared_passes_documented_names():
+    assert check("NAME = 'TPUDASH_DOCUMENTED'\n") == []
+
+
+# -- the shipped tree is clean ------------------------------------------------
+
+def test_package_lints_clean():
+    """The acceptance gate: the real package, the real registry, the real
+    docs — zero findings.  Identical to CI's
+    ``python -m tpudash.analysis.lint tpudash/`` (resolved via the
+    package so the test doesn't depend on pytest's working directory)."""
+    import os
+
+    import tpudash
+
+    pkg = os.path.dirname(os.path.abspath(tpudash.__file__))
+    assert lint_main([pkg]) == 0
+
+
+def test_known_bad_file_fails_the_cli(tmp_path):
+    bad = tmp_path / "tpudash_frag.py"
+    bad.write_text("import time\ndeadline = time.time() + 5\n")
+    assert lint_main([str(tmp_path)]) == 1
+    findings = lint_paths([str(tmp_path)])
+    assert findings and findings[0].rule == RULE_WALL_CLOCK
+    assert findings[0].path == str(bad) and findings[0].line == 2
+
+
+def test_cli_refuses_paths_that_scan_nothing(tmp_path):
+    """A typo'd CI path must fail loudly (exit 2), never 'pass' by
+    linting zero files."""
+    assert lint_main([str(tmp_path / "no_such_dir")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert lint_main([str(empty)]) == 2
+
+
+# -- racecheck: lock-order inversions -----------------------------------------
+
+@pytest.mark.racecheck_exempt
+def test_racecheck_detects_planted_inversion():
+    """The classic AB/BA deadlock shape, executed sequentially (so the
+    test can never actually deadlock) — the site graph still shows the
+    cycle."""
+    with RaceCheck() as rc:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+    inversions = rc.inversions()
+    assert len(inversions) == 1
+    (inv,) = inversions
+    assert len(inv["cycle"]) == 2
+    assert len(inv["edges"]) == 2  # both directions observed
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        rc.assert_clean()
+
+
+@pytest.mark.racecheck_exempt
+def test_racecheck_detects_inversion_between_same_site_locks():
+    """Two locks born on the SAME source line (two instances of one
+    class) locked AB/BA must still report an inversion — the graph is
+    keyed by lock instance, not allocation site."""
+    with RaceCheck() as rc:
+        pair = [threading.Lock() for _ in range(2)]  # one allocation site
+        lock_a, lock_b = pair
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    inversions = rc.inversions()
+    assert len(inversions) == 1
+    (inv,) = inversions
+    assert len(inv["cycle"]) == 2  # two instances, one shared site string
+    assert len(set(inv["cycle"])) == 1
+
+
+def test_racecheck_consistent_order_is_clean():
+    with RaceCheck() as rc:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+    assert rc.inversions() == []
+    rc.assert_clean()
+
+
+def test_racecheck_rlock_reentry_not_an_edge():
+    with RaceCheck() as rc:
+        rlock = threading.RLock()
+        with rlock:
+            with rlock:  # re-entry, not a second lock
+                pass
+    assert rc.inversions() == []
+    assert rc.edges == {}
+
+
+# -- racecheck: guarded shared attributes -------------------------------------
+
+def test_racecheck_guard_flags_unguarded_write():
+    class Holder:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.shared = 0
+
+    with RaceCheck() as rc:
+        holder = Holder()
+        rc.guard(holder, holder.lock, "shared")
+        with holder.lock:
+            holder.shared = 1  # guarded write: clean
+        holder.shared = 2  # naked write: violation
+        holder.unrelated = True  # unregistered attr: clean
+    assert [v["attr"] for v in rc.violations] == ["shared"]
+    assert isinstance(holder, Holder)  # class swap is isinstance-invisible
+    with pytest.raises(AssertionError, match="unguarded write"):
+        rc.assert_clean()
+
+
+def test_racecheck_wait_on_reentrant_rlock_keeps_recursion_count():
+    """Condition.wait fully releases a reentrantly-held RLock and
+    restores its recursion depth in one native call; the harness must
+    mirror that — a guarded write under the still-held (count 2) lock
+    after the wait is NOT a violation, and the re-entry after the wait
+    must not read as a fresh edge-producing acquisition."""
+
+    class Holder:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.cond = threading.Condition(self.lock)
+            self.shared = 0
+
+    with RaceCheck() as rc:
+        holder = Holder()
+        rc.guard(holder, holder.lock, "shared")
+
+        def signal():
+            with holder.lock:
+                holder.cond.notify_all()
+
+        with holder.lock:
+            with holder.lock:  # depth 2
+                t = threading.Timer(0.05, signal)
+                t.start()
+                assert holder.cond.wait(2)
+                holder.shared = 1  # still held (depth 2): clean
+            holder.shared = 2  # still held (depth 1): clean
+    assert rc.violations == []
+    rc.assert_clean()
+
+
+def test_racecheck_guard_from_worker_thread():
+    class Holder:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.shared = 0
+
+    with RaceCheck() as rc:
+        holder = Holder()
+        rc.guard(holder, holder.lock, "shared")
+
+        def good():
+            with holder.lock:
+                holder.shared = 1
+
+        def bad():
+            holder.shared = 2
+
+        for fn in (good, bad):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    assert len(rc.violations) == 1
+
+
+# -- racecheck over the real stack --------------------------------------------
+
+def test_real_service_stack_is_racecheck_clean():
+    """DashboardService + MultiSource-style concurrency under the
+    sanitizer: refresh/compose/save from racing threads produce zero
+    inversions and zero guarded-write violations — the publish-lock
+    discipline PR 1 promised, now mechanically checked."""
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+
+    with RaceCheck() as rc:
+        cfg = Config(source="synthetic", refresh_interval=0.0)
+        service = DashboardService(cfg, SyntheticSource(num_chips=16))
+        rc.guard(
+            service,
+            service._publish_lock,
+            "last_df",
+            "last_error",
+            "last_alerts",
+            "last_stragglers",
+            "available",
+            "_chips_base",
+            "_df_block",
+        )
+        errors = []
+
+        def refresher():
+            try:
+                for _ in range(4):
+                    service.refresh_data()
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        def composer():
+            try:
+                for _ in range(8):
+                    service.compose_frame()
+                    time.sleep(0.001)
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=refresher),
+            threading.Thread(target=composer),
+            threading.Thread(target=composer),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert errors == []
+    rc.assert_clean()

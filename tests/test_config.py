@@ -39,3 +39,59 @@ def test_defaults_match_reference_hardcoded_knobs():
     assert cfg.selection_grid_columns == 4   # app.py:268
     assert cfg.avg_panel_height == 300       # app.py:323
     assert cfg.device_panel_height == 200    # app.py:324
+
+
+def test_every_env_var_the_package_reads_is_declared():
+    """ISSUE 2 rule (5): every TPUDASH_* name referenced anywhere in the
+    package — code, error messages, docstrings — must be declared in the
+    config registry.  Uses the linter's own collector so the test and
+    the CI gate can never disagree."""
+    import os
+
+    import tpudash
+    from tpudash.analysis.lint import RULE_ENV_DECLARED, lint_paths
+    from tpudash.config import DECLARED_ENV
+
+    pkg = os.path.dirname(os.path.abspath(tpudash.__file__))
+    undeclared = [
+        f
+        for f in lint_paths([pkg], declared_env=DECLARED_ENV)
+        if f.rule == RULE_ENV_DECLARED
+    ]
+    assert undeclared == []
+
+
+def test_every_declared_env_var_is_documented():
+    """Rule (5)'s other half: the OPERATIONS.md reference table covers
+    every declared variable (skipped for installed-without-docs trees)."""
+    import os
+
+    import tpudash
+    from tpudash.config import DECLARED_ENV
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(tpudash.__file__))
+    )
+    doc = os.path.join(root, "docs", "OPERATIONS.md")
+    if not os.path.exists(doc):
+        import pytest
+
+        pytest.skip("docs tree not present")
+    text = open(doc, encoding="utf-8").read()
+    missing = sorted(v for v in DECLARED_ENV if v not in text)
+    assert missing == []
+
+
+def test_env_read_refuses_undeclared_names():
+    import pytest
+
+    from tpudash.config import env_is_set, env_read
+
+    assert env_read("TPUDASH_NATIVE", env={"TPUDASH_NATIVE": "0"}) == "0"
+    assert env_read("TPUDASH_NATIVE", env={}) == ""
+    assert env_is_set("TPUDASH_DEMO_SOURCE", env={"TPUDASH_DEMO_SOURCE": ""})
+    assert not env_is_set("TPUDASH_DEMO_SOURCE", env={})
+    with pytest.raises(KeyError):
+        env_read("TPUDASH_NOT_A_KNOB", env={})
+    with pytest.raises(KeyError):
+        env_is_set("TPUDASH_NOT_A_KNOB", env={})

@@ -1,0 +1,24 @@
+"""Project-invariant static analysis and runtime concurrency sanitizing.
+
+PR 1 made tpudash genuinely concurrent: per-endpoint circuit breakers,
+in-flight child tracking, shared service state mutated from fetch threads
+behind a publish lock.  That regime has invariants the interpreter cannot
+enforce and review alone will not keep enforced — so this package does:
+
+- :mod:`tpudash.analysis.lint` — ``python -m tpudash.analysis.lint`` — an
+  AST linter that walks the package and enforces named, testable project
+  rules (monotonic clocks in deadline arithmetic, env reads only through
+  the config registry, no blocking calls under a held ``threading.Lock``,
+  no swallowed ``BaseException`` handlers, every ``TPUDASH_*`` variable
+  declared and documented).  Exits non-zero naming rule and ``file:line``.
+
+- :mod:`tpudash.analysis.racecheck` — a test-time sanitizer that
+  monkeypatches ``threading.Lock``/``RLock`` to record acquisition order
+  per thread, detects lock-order inversions (potential deadlocks) across
+  the breaker/multi-source/service/session layers, and flags writes to
+  registered shared attributes performed without their guarding lock.
+
+Both ship with zero suppressions in-tree beyond explicit, reasoned
+``# tpulint: allow[rule]`` markers; the CI ``static-analysis`` job fails
+the build on any new finding.
+"""

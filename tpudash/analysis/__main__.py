@@ -1,0 +1,8 @@
+"""``python -m tpudash.analysis`` → the lint pass (racecheck is a test
+harness, wired through pytest — see docs/DEVELOPMENT.md)."""
+
+import sys
+
+from tpudash.analysis.lint import main
+
+sys.exit(main())

@@ -1,0 +1,589 @@
+"""tpulint — AST enforcement of tpudash's project invariants.
+
+Generic linters catch generic bugs; these rules encode decisions THIS
+project made and then nearly lost to drift (each rule names the incident
+class that motivated it):
+
+``wall-clock``
+    No ``time.time()`` calls without an explicit allow marker.  Deadline,
+    backoff, cadence, and breaker arithmetic must use ``time.monotonic()``
+    — an NTP step during an outage must not instantly expire (or freeze)
+    every breaker cooldown and retry budget.  Wall-clock is legitimate
+    exactly where the value *is* a timestamp (Prometheus range bounds,
+    recorder ``ts``, silence expiries shown to operators); those sites
+    carry ``# tpulint: allow[wall-clock] <reason>`` so the intent is
+    auditable in-tree.
+
+``env-read``
+    No reads of ``TPUDASH_*`` environment variables outside
+    ``tpudash/config.py``.  All configuration flows through the registry
+    (``Config`` / ``_ENV_MAP`` / ``_EXTRA_ENV``) so one file answers
+    "what knobs exist" and the docs check below can hold.
+
+``blocking-under-lock``
+    No blocking calls — ``requests.*``, ``time.sleep``, file I/O,
+    sockets, subprocesses — while a ``threading.Lock``/``RLock`` is held
+    (lexically inside ``with <...lock...>:``, or inside a ``*_locked``
+    function, the project's naming convention for "caller holds the
+    lock").  A webhook POST under the publish lock stalls every
+    dashboard route for ``http_timeout`` seconds.
+
+``broad-except``
+    No bare ``except:`` and no ``except BaseException:`` that fails to
+    re-raise.  Source fetch paths swallowing ``BaseException`` eat
+    ``KeyboardInterrupt``/``SystemExit`` and turn Ctrl-C into a hang;
+    the one legitimate pattern (a worker thread delivering the exception
+    through a result channel) is allow-marked.
+
+``env-declared``
+    Every ``TPUDASH_*`` name referenced anywhere in the package must be
+    declared in the config registry AND documented in
+    ``docs/OPERATIONS.md``.  A knob that exists only in the code that
+    reads it is invisible to operators.
+
+Allow mechanism
+---------------
+``# tpulint: allow[rule]`` or ``# tpulint: allow[rule-a,rule-b] reason``
+suppresses those rules on that line, on the line below the marker when it
+stands alone, or — when placed on a ``def``/``with`` header — throughout
+that block (for ``blocking-under-lock``, whose findings are scoped, not
+pointwise).  There is no file-level or global suppression on purpose:
+every exception is a visible, reasoned, line-anchored decision.
+
+Usage::
+
+    python -m tpudash.analysis.lint              # lint the package
+    python -m tpudash.analysis.lint path/ f.py   # lint specific trees
+    python -m tpudash.analysis.lint --rules      # list the rules
+
+Exit status 0 = clean; 1 = findings (printed as ``file:line: rule:
+message``); 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+
+RULE_WALL_CLOCK = "wall-clock"
+RULE_ENV_READ = "env-read"
+RULE_BLOCKING = "blocking-under-lock"
+RULE_BROAD_EXCEPT = "broad-except"
+RULE_ENV_DECLARED = "env-declared"
+
+ALL_RULES = (
+    RULE_WALL_CLOCK,
+    RULE_ENV_READ,
+    RULE_BLOCKING,
+    RULE_BROAD_EXCEPT,
+    RULE_ENV_DECLARED,
+)
+
+RULE_DOCS = {
+    RULE_WALL_CLOCK: (
+        "time.time() requires an explicit allow marker; deadline/backoff/"
+        "breaker/cadence arithmetic must use time.monotonic()"
+    ),
+    RULE_ENV_READ: (
+        "TPUDASH_* environment reads are allowed only in tpudash/config.py "
+        "(route through the Config registry / env_read helper)"
+    ),
+    RULE_BLOCKING: (
+        "no blocking calls (requests.*, time.sleep, file I/O, sockets, "
+        "subprocesses) while a threading lock is held"
+    ),
+    RULE_BROAD_EXCEPT: (
+        "no bare except:, and except BaseException must re-raise "
+        "(or carry an allow marker explaining the delivery channel)"
+    ),
+    RULE_ENV_DECLARED: (
+        "every referenced TPUDASH_* var must be declared in the config "
+        "registry and documented in docs/OPERATIONS.md"
+    ),
+}
+
+_ENV_TOKEN = re.compile(r"TPUDASH_[A-Z0-9_]+")
+_ALLOW = re.compile(r"#\s*tpulint:\s*allow\[([a-z\-,\s]+)\]")
+
+#: call roots (module aliases resolved per file) whose invocation blocks:
+#: HTTP, sockets, subprocesses, filesystem mutation, archive/np disk I/O
+_BLOCKING_ROOTS = {
+    "requests",
+    "urllib",
+    "socket",
+    "subprocess",
+    "shutil",
+}
+#: os.<attr> calls that hit the filesystem
+_BLOCKING_OS_ATTRS = {
+    "fdopen",
+    "replace",
+    "rename",
+    "remove",
+    "unlink",
+    "makedirs",
+    "mkdir",
+    "rmdir",
+}
+#: numpy disk round-trips (np.save/np.load under a lock is a real hazard
+#: here: history snapshots compress for ~100ms)
+_BLOCKING_NP_ATTRS = {"save", "savez", "savez_compressed", "load"}
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def _parse_allows(source: str) -> dict[int, set[str]]:
+    """line number (1-based) → set of rule names allowed on that line."""
+    allows: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _ALLOW.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        allows.setdefault(i, set()).update(rules)
+        # a marker on its own line covers the line below it
+        if text.lstrip().startswith("#"):
+            allows.setdefault(i + 1, set()).update(rules)
+    return allows
+
+
+def _dotted(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` → ["a", "b", "c"]; None for anything non-name-rooted."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _FileChecker(ast.NodeVisitor):
+    """One file's worth of rule evaluation (imports resolved per file)."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        is_config: bool,
+        declared_env: "frozenset[str] | None",
+    ):
+        self.path = path
+        self.is_config = is_config
+        self.declared_env = declared_env
+        self.allows = _parse_allows(source)
+        self.findings: list[Finding] = []
+        self.env_tokens: set[tuple[str, int]] = set()  # (name, line)
+        # module alias tables, filled by import visitors (function-local
+        # imports included: the visitor walks the whole tree)
+        self.time_aliases: set[str] = set()
+        self.time_time_names: set[str] = set()
+        self.time_sleep_names: set[str] = set()
+        self.os_aliases: set[str] = set()
+        self.environ_names: set[str] = set()
+        self.getenv_names: set[str] = set()
+        self.np_aliases: set[str] = set()
+        self.blocking_roots: set[str] = set()
+        #: stack of (kind, header_line) lock scopes currently open;
+        #: non-empty means "a threading lock is (lexically) held here"
+        self._lock_scopes: list[int] = []
+
+    # -- plumbing ------------------------------------------------------------
+    def _allowed(self, rule: str, line: int) -> bool:
+        if rule in self.allows.get(line, ()):
+            return True
+        # scoped allow: a marker on an enclosing with/def header
+        return any(
+            rule in self.allows.get(scope_line, ())
+            for scope_line in self._lock_scopes
+        )
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if not self._allowed(rule, line):
+            self.findings.append(Finding(self.path, line, rule, message))
+
+    # -- imports -------------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            bound = alias.asname or top
+            if alias.name == "time" or (
+                alias.asname and top == "time"
+            ):
+                self.time_aliases.add(bound)
+            if top == "os":
+                self.os_aliases.add(bound)
+            if top in ("numpy",):
+                self.np_aliases.add(bound)
+            if top in _BLOCKING_ROOTS:
+                self.blocking_roots.add(bound)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "time":
+                    self.time_time_names.add(bound)
+                if alias.name == "sleep":
+                    self.time_sleep_names.add(bound)
+        if node.module == "os":
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "environ":
+                    self.environ_names.add(bound)
+                if alias.name == "getenv":
+                    self.getenv_names.add(bound)
+        self.generic_visit(node)
+
+    # -- scope tracking ------------------------------------------------------
+    def _is_lockish(self, expr: ast.AST) -> bool:
+        """Heuristic: the with-item looks like acquiring a threading lock
+        (final name segment contains "lock": ``self._publish_lock``,
+        ``with lock:``, ``self._history_save_lock``)."""
+        parts = _dotted(expr)
+        if parts is None:
+            return False
+        return "lock" in parts[-1].lower()
+
+    def _visit_with(self, node) -> None:
+        lockish = any(self._is_lockish(item.context_expr) for item in node.items)
+        if lockish:
+            self._lock_scopes.append(node.lineno)
+        self.generic_visit(node)
+        if lockish:
+            self._lock_scopes.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _visit_funcdef(self, node) -> None:
+        # a nested function's body does not run under the enclosing lock;
+        # conversely, *_locked functions run under their caller's lock by
+        # project convention
+        saved = self._lock_scopes
+        self._lock_scopes = [node.lineno] if node.name.endswith("_locked") else []
+        self.generic_visit(node)
+        self._lock_scopes = saved
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self._lock_scopes
+        self._lock_scopes = []
+        self.generic_visit(node)
+        self._lock_scopes = saved
+
+    # -- rule: broad-except --------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._flag(
+                RULE_BROAD_EXCEPT,
+                node,
+                "bare 'except:' catches BaseException (KeyboardInterrupt, "
+                "SystemExit); name the exception or re-raise",
+            )
+        else:
+            parts = _dotted(node.type)
+            if parts and parts[-1] == "BaseException":
+                reraises = any(
+                    isinstance(n, ast.Raise) for n in ast.walk(node)
+                )
+                if not reraises:
+                    self._flag(
+                        RULE_BROAD_EXCEPT,
+                        node,
+                        "'except BaseException' without re-raise swallows "
+                        "KeyboardInterrupt/SystemExit",
+                    )
+        self.generic_visit(node)
+
+    # -- rule: env tokens (collection for env-declared) ----------------------
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str):
+            for name in _ENV_TOKEN.findall(node.value):
+                self.env_tokens.add((name, node.lineno))
+
+    # -- calls / subscripts / membership -------------------------------------
+    def _env_literal(self, node: ast.AST) -> str | None:
+        s = _str_const(node)
+        if s is not None and _ENV_TOKEN.fullmatch(s):
+            return s
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        parts = _dotted(node.func)
+
+        # wall-clock: time.time() / time() (from-import)
+        if parts is not None:
+            if (
+                len(parts) == 2
+                and parts[0] in self.time_aliases
+                and parts[1] == "time"
+            ) or (len(parts) == 1 and parts[0] in self.time_time_names):
+                self._flag(
+                    RULE_WALL_CLOCK,
+                    node,
+                    "time.time() in code: use time.monotonic() for "
+                    "deadline/backoff/cadence arithmetic, or mark the site "
+                    "# tpulint: allow[wall-clock] <why wall-clock semantics "
+                    "are intended>",
+                )
+
+        # env-read: os.environ.get("TPUDASH_*"), os.getenv("TPUDASH_*"),
+        # and any mapping.get("TPUDASH_*") — an env dict passed around
+        # under another name is still an env read
+        if not self.is_config and node.args:
+            lit = self._env_literal(node.args[0])
+            if lit is not None and parts is not None:
+                is_get_method = parts[-1] == "get"
+                is_getenv = (
+                    len(parts) == 2
+                    and parts[0] in self.os_aliases
+                    and parts[1] == "getenv"
+                ) or (len(parts) == 1 and parts[0] in self.getenv_names)
+                if is_get_method or is_getenv:
+                    self._flag(
+                        RULE_ENV_READ,
+                        node,
+                        f"direct read of {lit} outside tpudash/config.py — "
+                        "declare it in the registry and use "
+                        "tpudash.config.env_read/env_is_set",
+                    )
+
+        # blocking-under-lock
+        if self._lock_scopes and parts is not None:
+            blocked: str | None = None
+            if len(parts) == 1 and parts[0] == "open":
+                blocked = "open() file I/O"
+            elif len(parts) == 1 and parts[0] in self.time_sleep_names:
+                blocked = "time.sleep"
+            elif len(parts) == 2 and parts[0] in self.time_aliases and parts[1] == "sleep":
+                blocked = "time.sleep"
+            elif parts[0] in self.blocking_roots:
+                blocked = f"{'.'.join(parts)} (network/subprocess/file API)"
+            elif (
+                len(parts) == 2
+                and parts[0] in self.os_aliases
+                and parts[1] in _BLOCKING_OS_ATTRS
+            ):
+                blocked = f"os.{parts[1]} filesystem call"
+            elif (
+                len(parts) == 2
+                and parts[0] in self.np_aliases
+                and parts[1] in _BLOCKING_NP_ATTRS
+            ):
+                blocked = f"numpy {parts[1]} disk I/O"
+            if blocked is not None:
+                self._flag(
+                    RULE_BLOCKING,
+                    node,
+                    f"{blocked} while a threading lock is held (scope opened "
+                    f"at line {self._lock_scopes[-1]}) stalls every waiter; "
+                    "move it outside the lock or mark the dedicated-I/O-lock "
+                    "scope with # tpulint: allow[blocking-under-lock]",
+                )
+
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if not self.is_config:
+            lit = self._env_literal(node.slice)
+            if lit is not None:
+                self._flag(
+                    RULE_ENV_READ,
+                    node,
+                    f"subscript read of {lit} outside tpudash/config.py — "
+                    "route through the config registry",
+                )
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if not self.is_config and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            lit = self._env_literal(node.left)
+            if lit is not None:
+                self._flag(
+                    RULE_ENV_READ,
+                    node,
+                    f"membership test for {lit} outside tpudash/config.py — "
+                    "use tpudash.config.env_is_set",
+                )
+        self.generic_visit(node)
+
+
+def _declared_env() -> frozenset[str]:
+    from tpudash.config import DECLARED_ENV
+
+    return DECLARED_ENV
+
+
+def _operations_doc_text() -> str | None:
+    """docs/OPERATIONS.md relative to the repo checkout, or None when the
+    package runs installed without its docs tree (doc check skipped)."""
+    import tpudash
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(tpudash.__file__)))
+    path = os.path.join(root, "docs", "OPERATIONS.md")
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def iter_py_files(paths: "list[str]") -> "list[str]":
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(
+                os.path.join(dirpath, f)
+                for f in sorted(filenames)
+                if f.endswith(".py")
+            )
+    return sorted(set(out))
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    declared_env: "frozenset[str] | None" = None,
+    doc_text: "str | None" = None,
+) -> list[Finding]:
+    """Lint one file's source text (the unit tests' entry point)."""
+    is_config = path.replace(os.sep, "/").endswith("tpudash/config.py")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(path, e.lineno or 1, "syntax", f"cannot parse: {e.msg}")
+        ]
+    checker = _FileChecker(path, source, is_config, declared_env)
+    checker.visit(tree)
+    findings = checker.findings
+    if declared_env is not None:
+        for name, line in sorted(checker.env_tokens):
+            if name not in declared_env:
+                if not checker._allowed(RULE_ENV_DECLARED, line):
+                    findings.append(
+                        Finding(
+                            path,
+                            line,
+                            RULE_ENV_DECLARED,
+                            f"{name} is not declared in the config registry "
+                            "(tpudash/config.py _ENV_MAP/_EXTRA_ENV)",
+                        )
+                    )
+            elif doc_text is not None and name not in doc_text:
+                if not checker._allowed(RULE_ENV_DECLARED, line):
+                    findings.append(
+                        Finding(
+                            path,
+                            line,
+                            RULE_ENV_DECLARED,
+                            f"{name} is declared but not documented in "
+                            "docs/OPERATIONS.md",
+                        )
+                    )
+    return sorted(findings)
+
+
+def lint_paths(
+    paths: "list[str]",
+    declared_env: "frozenset[str] | None" = None,
+    doc_text: "str | None" = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding(path, 1, "io", f"cannot read: {e}"))
+            continue
+        findings.extend(
+            lint_source(source, path, declared_env=declared_env, doc_text=doc_text)
+        )
+    return sorted(findings)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--rules" in argv:
+        for rule in ALL_RULES:
+            print(f"{rule}: {RULE_DOCS[rule]}")
+        return 0
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        import tpudash
+
+        paths = [os.path.dirname(os.path.abspath(tpudash.__file__))]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"tpulint: no such path: {', '.join(missing)}", file=sys.stderr
+        )
+        return 2
+    if not iter_py_files(paths):
+        # a gate that scans zero files "passes" forever — fail loudly on
+        # a typo'd CI path instead
+        print(
+            f"tpulint: no Python files under: {', '.join(paths)}",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        declared = _declared_env()
+    except Exception as e:  # pragma: no cover - registry import failure
+        print(f"tpulint: cannot load config registry: {e}", file=sys.stderr)
+        return 2
+    doc_text = _operations_doc_text()
+    findings = lint_paths(paths, declared_env=declared, doc_text=doc_text)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(
+            f"tpulint: {len(findings)} finding"
+            f"{'s' if len(findings) != 1 else ''} "
+            f"across {len(set(f.path for f in findings))} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    if doc_text is None:
+        print(
+            "tpulint: clean (docs/OPERATIONS.md not found — "
+            "documentation check skipped)"
+        )
+    else:
+        print("tpulint: clean")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,434 @@
+"""Runtime lock/race sanitizer — test-time concurrency checking.
+
+The static rules in :mod:`tpudash.analysis.lint` see lexical structure;
+they cannot see *ordering*.  Two layers each correct in isolation can
+still deadlock when layer A takes lock-1 then lock-2 while layer B takes
+lock-2 then lock-1 — the breaker/multi/service/session stack is exactly
+deep enough for that to happen by accident in a future PR.  This module
+is the dynamic half of the analyzer:
+
+- :class:`RaceCheck` monkeypatches ``threading.Lock``/``threading.RLock``
+  so every lock *allocated during the patch window* is wrapped in a
+  :class:`TracedLock` that records, per thread, which locks were held at
+  every acquisition.  Edges (held → acquired) feed a directed graph over
+  lock instances (reported by allocation site); any cycle is a potential
+  deadlock, reported with the example threads and code sites that
+  produced each edge — including inversions between two locks allocated
+  on the same source line (two instances of one class).
+
+- ``guard(obj, lock, *attrs)`` registers shared attributes (e.g.
+  ``service.last_alerts``, ``service.last_df``) with the lock that must
+  be held to write them.  Attribute REBINDS without the lock held by the
+  writing thread are recorded as violations.  (In-place mutation of a
+  guarded container is invisible to ``__setattr__`` — the publish-lock
+  discipline in tpudash rebinds, so rebind tracking is the honest check.)
+
+Usage (tests)::
+
+    rc = RaceCheck()
+    with rc:                      # or rc.install() / rc.uninstall()
+        service = DashboardService(cfg, source)   # locks now traced
+        rc.guard(service, service._publish_lock, "last_df", "last_alerts")
+        ... run threads ...
+    rc.assert_clean()             # raises on inversions or violations
+
+The pytest suite wires this up behind ``TPUDASH_RACECHECK=1`` (see
+``tests/conftest.py``): every test runs inside a patch window and fails
+on any detected inversion.  The CI ``static-analysis`` job runs the
+concurrency-heavy test files in that mode.
+
+Only locks allocated inside the window are traced; locks created at
+import time (module-level) keep their native type.  Tracing is
+process-global while installed, deliberately: cross-layer inversions are
+the whole point.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _call_site(skip_files: tuple) -> str:
+    """file:line of the nearest frame outside racecheck/threading."""
+    import sys
+
+    frame = sys._getframe(1)
+    while frame is not None:
+        fn = frame.f_code.co_filename
+        if not fn.endswith(skip_files):
+            return f"{fn}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+_SKIP_FILES = ("racecheck.py", "threading.py")
+
+
+class TracedLock:
+    """Duck-typed stand-in for ``threading.Lock``/``RLock`` that reports
+    acquisitions/releases to its :class:`RaceCheck`.
+
+    Implements the full protocol ``threading.Condition`` probes for
+    (``_release_save``/``_acquire_restore``/``_is_owned``) so traced
+    RLocks keep working inside Conditions and Events, with the held-set
+    bookkeeping staying truthful across a ``Condition.wait`` release."""
+
+    def __init__(self, inner, rc: "RaceCheck", site: str):
+        self._inner = inner
+        self._rc = rc
+        self.site = site
+
+    # -- core lock protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._rc._note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._rc._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        if locked is not None:
+            return locked()
+        # RLock pre-3.12 has no locked(): probe non-blocking
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    # -- Condition integration (RLock-backed) --------------------------------
+    # threading.Condition probes the lock for _release_save /
+    # _acquire_restore / _is_owned with try/except AttributeError and
+    # falls back to plain acquire/release when absent.  These must
+    # therefore live in __getattr__: defining them as methods would make
+    # a TracedLock around a plain Lock claim capabilities its inner lock
+    # does not have (and crash the first Condition.wait).  When the inner
+    # lock IS an RLock, the returned closures keep the held-set truthful
+    # across a wait()'s full release/restore cycle.
+    def __getattr__(self, name: str):
+        if name == "_release_save":
+            inner_release_save = self._inner._release_save
+
+            def _release_save():
+                state = inner_release_save()
+                # carry OUR recursion count through the opaque state so a
+                # wait() on a reentrantly-held RLock restores it exactly
+                count = self._rc._note_release_all(self)
+                return (state, count)
+
+            return _release_save
+        if name == "_acquire_restore":
+            inner_acquire_restore = self._inner._acquire_restore
+
+            def _acquire_restore(state):
+                inner_state, count = state
+                inner_acquire_restore(inner_state)
+                self._rc._note_acquire(self, restore_count=count)
+
+            return _acquire_restore
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self.site} wrapping {self._inner!r}>"
+
+
+class RaceCheck:
+    """Lock-order and guarded-attribute sanitizer (see module docstring)."""
+
+    def __init__(self):
+        #: (id(held), id(acquired)) → {"sites": (held_site, acq_site),
+        #: "thread": name, "at": site} — keyed by lock INSTANCE, not
+        #: allocation site: two locks born on the same source line (two
+        #: service instances) must still produce an inversion when locked
+        #: AB by one thread and BA by another
+        self.edges: dict = {}
+        #: guarded-attribute write violations, in observation order
+        self.violations: list = []
+        self._guards: dict = {}  # id(obj) → (lockref, set of attrs)
+        self._guard_classes: dict = {}  # original class → guarded subclass
+        self._tls = threading.local()
+        self._active = False
+        self._installed = False
+        self._orig: "tuple | None" = None
+        self._graph_lock = threading.Lock()  # native: never self-traced
+
+    # -- install / uninstall -------------------------------------------------
+    def install(self) -> "RaceCheck":
+        if self._installed:
+            return self
+        self._orig = (threading.Lock, threading.RLock)
+        rc = self
+
+        def _traced(factory):
+            def allocate(*args, **kwargs):
+                return TracedLock(
+                    factory(*args, **kwargs), rc, _call_site(_SKIP_FILES)
+                )
+
+            return allocate
+
+        threading.Lock = _traced(self._orig[0])
+        threading.RLock = _traced(self._orig[1])
+        self._installed = True
+        self._active = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock, threading.RLock = self._orig
+        self._installed = False
+        # stop recording, but existing TracedLocks keep delegating so
+        # threads that outlive the window (SSE streams, webhook sends)
+        # never break
+        self._active = False
+
+    def __enter__(self) -> "RaceCheck":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- per-thread held stack -----------------------------------------------
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _holds(self, lock) -> bool:
+        return any(entry[0] is lock for entry in self._held())
+
+    def _note_acquire(self, lock: TracedLock, restore_count: int = 0) -> None:
+        if not self._active:
+            return
+        stack = self._held()
+        for entry in stack:
+            if entry[0] is lock:  # RLock re-entry: no new edges
+                entry[1] += 1
+                return
+        if stack:
+            thread = threading.current_thread().name
+            at = _call_site(_SKIP_FILES)
+            with self._graph_lock:
+                for entry in stack:
+                    held = entry[0]
+                    if held is lock:
+                        continue
+                    self.edges.setdefault(
+                        (id(held), id(lock)),
+                        {
+                            "sites": (held.site, lock.site),
+                            "thread": thread,
+                            "at": at,
+                        },
+                    )
+        # a Condition.wait reacquisition restores the pre-wait recursion
+        # depth in one native call — mirror it, else guarded writes under
+        # the still-held lock read as violations
+        stack.append([lock, max(1, restore_count)])
+
+    def _note_release(self, lock: TracedLock) -> None:
+        stack = self._held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                stack[i][1] -= 1
+                if stack[i][1] <= 0:
+                    del stack[i]
+                return
+        # release of a lock acquired outside the window/thread: ignore
+
+    def _note_release_all(self, lock: TracedLock) -> int:
+        """Drop the lock's whole entry (a Condition.wait full release);
+        returns the recursion count so _acquire_restore can put it back."""
+        stack = self._held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                count = stack[i][1]
+                del stack[i]
+                return count
+        return 0
+
+    # -- lock-order inversion detection --------------------------------------
+    def inversions(self) -> list:
+        """Cycles in the held→acquired graph (nodes are lock INSTANCES;
+        reporting maps them to allocation sites).  Each entry:
+        {"cycle": [site, ...], "edges": [((a_site, b_site), detail), ...]}
+        — a cycle of length 2 is the classic AB/BA inversion.  Same-site
+        cycles (two locks from one source line, e.g. two instances of the
+        same class) are reported too; the cycle then repeats the site."""
+        with self._graph_lock:
+            edges = dict(self.edges)
+        site_of: dict = {}
+        for (a, b), d in edges.items():
+            site_of[a], site_of[b] = d["sites"]
+        graph: dict = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+        # Tarjan SCC — any component with >1 node (or a self-edge, which
+        # site-dedup already precludes) contains at least one cycle
+        index: dict = {}
+        low: dict = {}
+        on_stack: set = set()
+        stack: list = []
+        sccs: list = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # iterative DFS: the graph is tiny but recursion limits are
+            # not worth risking inside a test harness
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+
+        out = []
+        for comp in sccs:
+            comp_set = set(comp)
+            detail = sorted(
+                (
+                    (d["sites"], {"thread": d["thread"], "at": d["at"]})
+                    for pair, d in edges.items()
+                    if pair[0] in comp_set and pair[1] in comp_set
+                ),
+                key=lambda e: e[0],
+            )
+            out.append(
+                {
+                    "cycle": sorted(site_of[n] for n in comp),
+                    "edges": detail,
+                }
+            )
+        return out
+
+    # -- guarded shared attributes -------------------------------------------
+    def guard(self, obj, lock, *attrs: str):
+        """Require ``lock`` to be held by the writing thread whenever any
+        of ``attrs`` is REBOUND on ``obj``.  Returns ``obj`` (its class is
+        swapped for an instrumented subclass; ``isinstance`` unaffected)."""
+        if not attrs:
+            raise ValueError("guard() needs at least one attribute name")
+        self._guards[id(obj)] = (lock, frozenset(attrs))
+        cls = type(obj)
+        sub = self._guard_classes.get(cls)
+        if sub is None:
+            rc = self
+
+            def __setattr__(inner_self, name, value):  # noqa: N807
+                g = rc._guards.get(id(inner_self))
+                if (
+                    g is not None
+                    and rc._active
+                    and name in g[1]
+                    and not rc._lock_held_by_current(g[0])
+                ):
+                    rc.violations.append(
+                        {
+                            "attr": name,
+                            "at": _call_site(_SKIP_FILES),
+                            "thread": threading.current_thread().name,
+                        }
+                    )
+                cls.__setattr__(inner_self, name, value)
+
+            sub = self._guard_classes[cls] = type(
+                cls.__name__ + "·guarded",
+                (cls,),
+                {"__setattr__": __setattr__, "__slots__": ()},
+            )
+        obj.__class__ = sub
+        return obj
+
+    def unguard(self, obj) -> None:
+        """Stop watching ``obj`` (its instrumented class stays — inert
+        without a registry entry)."""
+        self._guards.pop(id(obj), None)
+
+    def _lock_held_by_current(self, lock) -> bool:
+        if isinstance(lock, TracedLock):
+            return self._holds(lock)
+        is_owned = getattr(lock, "_is_owned", None)
+        if is_owned is not None:  # native RLock
+            return is_owned()
+        # native Lock: ownerless — "someone holds it" is the best signal
+        locked = getattr(lock, "locked", None)
+        return bool(locked()) if locked is not None else False
+
+    # -- reporting -----------------------------------------------------------
+    def report(self) -> dict:
+        return {
+            "edges": len(self.edges),
+            "inversions": self.inversions(),
+            "violations": list(self.violations),
+        }
+
+    def assert_clean(self) -> None:
+        """Raise AssertionError with a readable report when any lock-order
+        inversion or guarded-write violation was observed."""
+        problems = []
+        for inv in self.inversions():
+            lines = [f"lock-order inversion across sites: {inv['cycle']}"]
+            for (a, b), d in inv["edges"]:
+                lines.append(
+                    f"  held {a} → acquired {b} "
+                    f"(thread {d['thread']}, at {d['at']})"
+                )
+            problems.append("\n".join(lines))
+        for v in self.violations:
+            problems.append(
+                f"unguarded write to .{v['attr']} at {v['at']} "
+                f"(thread {v['thread']}) without its guarding lock"
+            )
+        if problems:
+            raise AssertionError(
+                "racecheck found {} problem(s):\n{}".format(
+                    len(problems), "\n".join(problems)
+                )
+            )

@@ -496,8 +496,8 @@ class DashboardServer:
     async def select(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
-        except json.JSONDecodeError:
-            raise web.HTTPBadRequest(text="invalid JSON")
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text="invalid JSON") from e
         entry = self._entry(request)
         state = entry.state
         if not self.service.available:
@@ -534,8 +534,8 @@ class DashboardServer:
     async def style(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
-        except json.JSONDecodeError:
-            raise web.HTTPBadRequest(text="invalid JSON")
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text="invalid JSON") from e
         use_gauge = bool(body.get("use_gauge", True))
         entry = self._entry(request)
 
@@ -563,18 +563,20 @@ class DashboardServer:
         """
         try:
             body = await request.json() if request.can_read_body else {}
-        except json.JSONDecodeError:
-            raise web.HTTPBadRequest(text="invalid JSON")
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text="invalid JSON") from e
 
         if body.get("device"):
             try:
                 seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
-            except (TypeError, ValueError):
-                raise web.HTTPBadRequest(text="'seconds' must be a number")
+            except (TypeError, ValueError) as e:
+                raise web.HTTPBadRequest(
+                    text="'seconds' must be a number"
+                ) from e
             try:
                 import jax  # the probe/workload sources already paid this
             except ImportError as e:
-                raise web.HTTPBadRequest(text=f"jax unavailable: {e}")
+                raise web.HTTPBadRequest(text=f"jax unavailable: {e}") from e
             if self._device_trace_active:
                 raise web.HTTPConflict(text="a device trace is already running")
             self._device_trace_active = True
@@ -595,7 +597,7 @@ class DashboardServer:
                 shutil.rmtree(trace_dir, ignore_errors=True)
                 raise web.HTTPInternalServerError(
                     text=f"device trace failed: {e}"
-                )
+                ) from e
             finally:
                 self._device_trace_active = False
             return _json_response(
@@ -604,8 +606,10 @@ class DashboardServer:
 
         try:
             frames = min(100, max(1, int(body.get("frames", 10))))
-        except (TypeError, ValueError):
-            raise web.HTTPBadRequest(text="'frames' must be an integer")
+        except (TypeError, ValueError) as e:
+            raise web.HTTPBadRequest(
+                text="'frames' must be an integer"
+            ) from e
 
         def run_profile():
             import cProfile
@@ -758,15 +762,15 @@ class DashboardServer:
                     "everything on purpose"
                 )
         except (ValueError, TypeError, AttributeError) as e:
-            raise web.HTTPBadRequest(text=f"bad silence request: {e}")
+            raise web.HTTPBadRequest(text=f"bad silence request: {e}") from e
         async with self._lock:
             try:
-                entry = self.service.silences.add(rule, chip, ttl, time.time())
+                entry = self.service.silences.add(rule, chip, ttl, time.time())  # tpulint: allow[wall-clock] silence expiries are epoch stamps
             except ValueError as e:
-                raise web.HTTPBadRequest(text=str(e))
+                raise web.HTTPBadRequest(text=str(e)) from e
             # re-annotate so the flag is live on the NEXT frame/alerts read,
             # not only after the next scrape cycle
-            self.service.silences.annotate(self.service.last_alerts, time.time())
+            self.service.silences.annotate(self.service.last_alerts, time.time())  # tpulint: allow[wall-clock] silence expiries are epoch stamps
             await self._save_state()
             self._invalidate_frames()
         return _json_response({"silenced": entry})
@@ -778,10 +782,10 @@ class DashboardServer:
             rule = str(body.get("rule", "*") or "*")
             chip = str(body.get("chip", "*") or "*")
         except (ValueError, TypeError, AttributeError) as e:
-            raise web.HTTPBadRequest(text=f"bad unsilence request: {e}")
+            raise web.HTTPBadRequest(text=f"bad unsilence request: {e}") from e
         async with self._lock:
             removed = self.service.silences.remove(rule, chip)
-            self.service.silences.annotate(self.service.last_alerts, time.time())
+            self.service.silences.annotate(self.service.last_alerts, time.time())  # tpulint: allow[wall-clock] silence expiries are epoch stamps
             await self._save_state()
             self._invalidate_frames()
         if not removed:
@@ -790,7 +794,7 @@ class DashboardServer:
 
     async def list_silences(self, request: web.Request) -> web.Response:
         async with self._lock:
-            active = self.service.silences.active(time.time())
+            active = self.service.silences.active(time.time())  # tpulint: allow[wall-clock] silence expiries are epoch stamps
         return _json_response({"silences": active})
 
     def _replay_source(self):
@@ -828,7 +832,7 @@ class DashboardServer:
             index = int(index) if index is not None else None
             t = float(t) if t is not None else None
         except (ValueError, TypeError, AttributeError) as e:
-            raise web.HTTPBadRequest(text=f"bad replay request: {e}")
+            raise web.HTTPBadRequest(text=f"bad replay request: {e}") from e
         async with self._lock:
             if paused is not None:
                 replay.paused = bool(paused)
@@ -864,7 +868,7 @@ class DashboardServer:
         text = prometheus_rules_yaml(
             engine.rules,
             self.service.cfg.refresh_interval,
-            silences=self.service.silences.active(time.time()),
+            silences=self.service.silences.active(time.time()),  # tpulint: allow[wall-clock] silence expiries are epoch stamps
         )
         return web.Response(
             text=text,

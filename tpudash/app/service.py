@@ -187,7 +187,9 @@ class DashboardService:
         #: trend persistence (TPUDASH_HISTORY_PATH): restore the rings
         #: unless a Prometheus backfill already seeded them — live range
         #: data beats a snapshot from before the restart
-        self._last_history_save = time.time()
+        # cadence arithmetic, not a timestamp: monotonic, so an NTP step
+        # can neither force an immediate save nor starve saves for hours
+        self._last_history_save = time.monotonic()
         #: serializes snapshot+write: the shutdown save must not lose the
         #: os.replace race to a slower in-flight periodic save (older
         #: snapshot winning the rename)
@@ -212,6 +214,7 @@ class DashboardService:
         self.sessions_snapshot: "object | None" = None
         items = self._restored_state_doc.get("silences")
         if items:
+            # tpulint: allow[wall-clock] silence expiries are epoch stamps
             self.silences = SilenceSet.from_dicts(items, time.time())
         #: fleet outlier scoring every refresh (tpudash.stragglers) — the
         #: chip gating the slice's lockstep step time, named, not just
@@ -523,6 +526,10 @@ class DashboardService:
         with self._history_save_lock:
             self._save_history_locked(path)
 
+    # _history_save_lock is a DEDICATED I/O-serialization lock (save vs
+    # shutdown-save rename ordering); the hot publish lock is held only
+    # for the cheap ring snapshot inside.
+    # tpulint: allow[blocking-under-lock] dedicated I/O lock, not the publish lock
     def _save_history_locked(self, path: str) -> None:
         import json as _json
         import tempfile
@@ -604,6 +611,7 @@ class DashboardService:
 
         for tmp in glob.glob(os.path.join(glob.escape(d), "tmp*.npz.tmp")):
             with contextlib.suppress(OSError):
+                # tpulint: allow[wall-clock] compared against file mtime
                 if _time.time() - os.path.getmtime(tmp) > 600.0:
                     os.unlink(tmp)
 
@@ -622,6 +630,7 @@ class DashboardService:
             * max(self.cfg.refresh_interval, 1.0)
             * 2
         )
+        # tpulint: allow[wall-clock] ring points carry persisted epoch ts
         now = time.time()
         cutoff = now - max_age
         # future-timestamped points (snapshot written under a clock that
@@ -1285,6 +1294,7 @@ class DashboardService:
             # alerts current even though no table was published; chip
             # alerts from the last good frame stay (their chips didn't
             # recover — we just can't see them)
+            # tpulint: allow[wall-clock] alert "since" stamps are epochs
             ep = self._endpoint_alerts(time.time())
             if ep or any(
                 a.get("rule") == "endpoint_down" for a in self.last_alerts
@@ -1297,6 +1307,7 @@ class DashboardService:
                     if a.get("rule") != "endpoint_down"
                 ]
                 self.last_alerts = self.silences.annotate(
+                    # tpulint: allow[wall-clock] silence expiry comparison
                     sort_alerts(kept + ep), time.time()
                 )
                 self._notify_alert_transitions()
@@ -1346,6 +1357,7 @@ class DashboardService:
             with self.timer.stage("alerts"):
                 from tpudash.alerts import sort_alerts
 
+                # tpulint: allow[wall-clock] alert/silence epoch stamps
                 now_w = time.time()
                 alerts = self.alert_engine.evaluate(df)
                 alerts += self._endpoint_alerts(now_w)
@@ -1364,6 +1376,9 @@ class DashboardService:
                 self.last_stragglers = self.straggler_detector.evaluate(
                     df, block=self._df_block
                 )
+        # ring points are persisted epoch timestamps; the cadence gate
+        # compares against restored wall stamps.
+        # tpulint: allow[wall-clock] trend ring carries epoch timestamps
         now = time.time()
         if (
             not self.history
@@ -1418,12 +1433,15 @@ class DashboardService:
                     }
                 self.chip_history.append((now, arr.astype(np.float32)))
         # periodic trend persistence, OFF the frame path (compression of
-        # a full 256-chip ring takes ~100 ms)
+        # a full 256-chip ring takes ~100 ms).  Monotonic cadence: the
+        # ring timestamps above are wall-clock (persisted, compared to
+        # restored points), but WHEN to save is pure interval arithmetic
+        now_m = time.monotonic()
         if (
             self.cfg.history_path
-            and now - self._last_history_save >= self.cfg.history_save_interval
+            and now_m - self._last_history_save >= self.cfg.history_save_interval
         ):
-            self._last_history_save = now
+            self._last_history_save = now_m
             threading.Thread(target=self.save_history, daemon=True).start()
         return df
 

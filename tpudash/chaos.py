@@ -22,9 +22,8 @@ healthy as the control, so the page always renders something).
 from __future__ import annotations
 
 import logging
-import os
 
-from tpudash.config import Config, configure_logging, load_config
+from tpudash.config import Config, configure_logging, env_is_set, load_config
 
 log = logging.getLogger(__name__)
 
@@ -45,7 +44,9 @@ def chaos_demo_source(cfg: Config):
     from tpudash.sources.fixture import SyntheticSource
     from tpudash.sources.multi import EndpointSpec, MultiSource
 
-    override = os.environ.get("TPUDASH_CHAOS", "")
+    # the registry already mapped TPUDASH_CHAOS → cfg.chaos (load_config);
+    # the drill reuses it as the per-endpoint scenario override
+    override = cfg.chaos
     children = []
     for label, default_spec in DEFAULT_DRILL.items():
         spec = default_spec
@@ -70,11 +71,11 @@ def make_chaos_app(cfg: Config | None = None):
     # short breaker cooldown + tight deadline so the drill's state
     # transitions are watchable within a coffee's attention span (env
     # overrides still win — load_config already applied them)
-    if "TPUDASH_BREAKER_COOLDOWN" not in os.environ:
+    if not env_is_set("TPUDASH_BREAKER_COOLDOWN"):
         import dataclasses
 
         cfg = dataclasses.replace(cfg, breaker_cooldown=10.0)
-    if "TPUDASH_MULTI_DEADLINE" not in os.environ:
+    if not env_is_set("TPUDASH_MULTI_DEADLINE"):
         import dataclasses
 
         cfg = dataclasses.replace(cfg, multi_deadline=1.0)

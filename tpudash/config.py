@@ -239,6 +239,59 @@ _ENV_MAP = {
 }
 
 
+#: Env vars the package reads OUTSIDE Config (process-lifecycle switches
+#: that must work before/without a Config instance).  Declared here so the
+#: registry stays the single answer to "what TPUDASH_* knobs exist" — the
+#: tpulint ``env-read``/``env-declared`` rules hold every module to it.
+_EXTRA_ENV = {
+    # kill-switch for the native C++ frame kernel (checked at first load,
+    # potentially before any Config exists)
+    "TPUDASH_NATIVE",
+    # demo entry point: force the exporter side's source kind
+    "TPUDASH_DEMO_SOURCE",
+    # multi-host rendezvous kill-switch (checked at process entry, before
+    # jax imports)
+    "TPUDASH_DISTRIBUTED",
+    # test harness: enable the runtime lock/race sanitizer
+    # (tpudash/analysis/racecheck.py via tests/conftest.py)
+    "TPUDASH_RACECHECK",
+}
+
+#: every declared environment variable name (Config-mapped + extras);
+#: tpulint's ``env-declared`` rule checks all referenced TPUDASH_* tokens
+#: against this set, and test_config.py pins it against the docs.
+DECLARED_ENV = frozenset(_ENV_MAP.values()) | frozenset(_EXTRA_ENV)
+
+
+def env_read(name: str, default: str = "", env: "dict | None" = None) -> str:
+    """The one sanctioned raw env read for declared non-Config switches.
+
+    Modules outside this file must not touch ``os.environ`` for
+    ``TPUDASH_*`` names (tpulint rule ``env-read``); they call this, which
+    refuses undeclared names so a typo'd knob fails loudly in tests
+    instead of silently reading nothing forever."""
+    if name not in DECLARED_ENV:
+        raise KeyError(
+            f"{name} is not declared in the tpudash config registry "
+            "(add it to _ENV_MAP or _EXTRA_ENV in tpudash/config.py)"
+        )
+    src = os.environ if env is None else env
+    return src.get(name, default)
+
+
+def env_is_set(name: str, env: "dict | None" = None) -> bool:
+    """Was the declared variable explicitly set (even to "")?  Used by
+    entry points that apply softer defaults only when the operator did
+    not state a preference (e.g. the chaos drill's short cooldown)."""
+    if name not in DECLARED_ENV:
+        raise KeyError(
+            f"{name} is not declared in the tpudash config registry "
+            "(add it to _ENV_MAP or _EXTRA_ENV in tpudash/config.py)"
+        )
+    src = os.environ if env is None else env
+    return name in src
+
+
 def configure_logging(level: str = "INFO") -> None:
     """Shared logging setup for the CLI entry points."""
     import logging

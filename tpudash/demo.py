@@ -17,11 +17,16 @@ import asyncio
 import contextlib
 import dataclasses
 import logging
-import os
 
 from aiohttp import web
 
-from tpudash.config import Config, configure_logging, load_config
+from tpudash.config import (
+    Config,
+    configure_logging,
+    env_is_set,
+    env_read,
+    load_config,
+)
 
 log = logging.getLogger(__name__)
 
@@ -29,7 +34,7 @@ log = logging.getLogger(__name__)
 def demo_configs(cfg: Config | None = None) -> tuple[Config, Config]:
     """(exporter_cfg, dashboard_cfg) for the single-process demo."""
     cfg = cfg or load_config()
-    exporter_source = os.environ.get("TPUDASH_DEMO_SOURCE", "")
+    exporter_source = env_read("TPUDASH_DEMO_SOURCE")
     if not exporter_source:
         try:
             import jax
@@ -44,7 +49,7 @@ def demo_configs(cfg: Config | None = None) -> tuple[Config, Config]:
         exporter_source == "synthetic"
         and exporter_cfg.synthetic_links
         and not exporter_cfg.synthetic_cold_links
-        and "TPUDASH_SYNTHETIC_COLD_LINKS" not in os.environ
+        and not env_is_set("TPUDASH_SYNTHETIC_COLD_LINKS")
     ):
         # zero-to-aha includes the failing-cable story: one injected cold
         # link so the coldest-link panel, the link-straggler banner, and

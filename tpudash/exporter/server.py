@@ -74,7 +74,9 @@ class ExporterServer:
             except SourceError as e:
                 self.last_error = str(e)
                 # 503 keeps Prometheus' `up` metric honest for this target
-                raise web.HTTPServiceUnavailable(text=f"probe failed: {e}")
+                raise web.HTTPServiceUnavailable(
+                    text=f"probe failed: {e}"
+                ) from e
         self.last_error = None
         return web.Response(
             text=encode_samples(samples),

@@ -152,7 +152,9 @@ def load() -> "ctypes.CDLL | None":
     if _tried:
         return None
     _tried = True
-    if os.environ.get("TPUDASH_NATIVE", "").strip() == "0":
+    from tpudash.config import env_read
+
+    if env_read("TPUDASH_NATIVE").strip() == "0":
         return None
     _ensure_inc()
     needs_build = not os.path.exists(_LIB) or any(

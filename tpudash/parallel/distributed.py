@@ -35,8 +35,10 @@ _initialized = False
 
 def should_initialize(env: "dict | None" = None) -> bool:
     """True when this looks like one process of a multi-process job."""
+    from tpudash.config import env_read
+
     src = os.environ if env is None else env
-    if src.get("TPUDASH_DISTRIBUTED", "").strip().lower() in ("0", "off", "false"):
+    if env_read("TPUDASH_DISTRIBUTED", env=src).strip().lower() in ("0", "off", "false"):
         return False
     # explicit JAX coordination env (manual launches)
     if src.get("JAX_COORDINATOR_ADDRESS") or src.get("COORDINATOR_ADDRESS"):

@@ -89,6 +89,9 @@ def synthetic_payload(
     gen = resolve_generation(generation) or TPU_GENERATIONS["v5e"]
     accel = gen.accelerator_types[0]
     if t is None:
+        # t is the Prometheus sample timestamp ("value": [epoch, v]) —
+        # the payload contract, not a deadline.
+        # tpulint: allow[wall-clock] Prometheus sample timestamps are epochs
         t = time.time()
     hbm_total = gen.hbm_gib * 1024**3
     link_dirs: tuple = ()

@@ -114,7 +114,10 @@ class _FetchTask:
     def _run(self, fn) -> None:
         try:
             self._result = fn()
-        except BaseException as e:  # noqa: BLE001 — delivered via result()
+        # the exception is DELIVERED, not swallowed: result() re-raises it
+        # on the dispatching thread (same contract as Future.result).
+        # tpulint: allow[broad-except] delivered via result(), not swallowed
+        except BaseException as e:  # noqa: BLE001
             self._exc = e
         finally:
             self._done.set()

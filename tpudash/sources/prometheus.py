@@ -58,7 +58,9 @@ class PrometheusSource(MetricsSource):
             result = payload["data"]["result"]
             host_ip = result[0]["metric"]["host_ip"]
         except (KeyError, IndexError, TypeError) as e:
-            raise SourceError(f"discovery query returned no usable host_ip: {e}")
+            raise SourceError(
+                f"discovery query returned no usable host_ip: {e}"
+            ) from e
         return [host_ip]
 
     # -- metrics pull --------------------------------------------------------
@@ -102,6 +104,7 @@ class PrometheusSource(MetricsSource):
         series selector as the live fetch, so the trend seed matches what
         the dashboard will keep appending."""
         instances = self.discover_instances()
+        # tpulint: allow[wall-clock] query_range start/end are epoch stamps
         end = time.time()
         params = {
             "query": self.build_query(instances),

@@ -61,6 +61,7 @@ class RecordingSource(MetricsSource):
         )
         from tpudash.exporter.textfmt import encode_samples
 
+        # tpulint: allow[wall-clock] recorder ts is a replay epoch stamp
         rec = {"ts": time.time(), "text": encode_samples(as_list)}
         try:
             with open(self.path, "a", encoding="utf-8") as f:
