@@ -394,6 +394,67 @@ def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
     return out
 
 
+def bench_shed_latency(samples: int = 40) -> dict:
+    """The overload fast paths, priced (ISSUE 3): time-to-503 for a shed
+    request under a saturated concurrency gate, and the stale-frame serve
+    time for a shed ``GET /api/frame``.  Both paths exist so the server
+    stays cheap at any request rate — if a future change drags locks or
+    executor hops into them, these numbers move and the regression guard
+    sees it.  Saturation is imposed directly on the admission guard
+    (inflight pinned at the gate) so the measurement is of the shed path
+    itself, not of a racing load generator."""
+    import asyncio
+    import statistics
+
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    svc = _bench_service(N_CHIPS, refresh_interval=60.0, max_concurrency=4)
+    server = DashboardServer(svc)
+
+    async def run():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            async with ClientSession() as session:
+                # one admitted frame so the degraded path has data
+                async with session.get(ts.make_url("/api/frame")) as r:
+                    assert r.status == 200
+                server.overload.inflight = server.overload.max_concurrency
+                shed_ms, stale_ms = [], []
+                for _ in range(samples):
+                    t0 = time.perf_counter()
+                    async with session.get(ts.make_url("/api/timings")) as r:
+                        assert r.status == 503
+                        assert r.headers.get("Retry-After")
+                        await r.read()
+                    shed_ms.append((time.perf_counter() - t0) * 1e3)
+                    t0 = time.perf_counter()
+                    async with session.get(ts.make_url("/api/frame")) as r:
+                        assert r.status == 200
+                        body = await r.json()
+                        assert body["stale"] is True
+                    stale_ms.append((time.perf_counter() - t0) * 1e3)
+                server.overload.inflight = 0
+                return (
+                    statistics.median(shed_ms), statistics.median(stale_ms)
+                )
+        finally:
+            await ts.close()
+
+    shed_p50, stale_p50 = asyncio.run(run())
+    # boundedness: shedding must stay far cheaper than serving — a shed
+    # path that grew a lock wait or an executor hop defeats its purpose
+    assert shed_p50 < 250.0, f"time-to-503 p50 {shed_p50:.1f}ms"
+    assert stale_p50 < 1000.0, f"stale-frame serve p50 {stale_p50:.1f}ms"
+    return {
+        "shed_503_p50_ms": round(shed_p50, 2),
+        "stale_frame_p50_ms": round(stale_p50, 2),
+    }
+
+
 _PROBE_SNIPPET = """
 import json
 import statistics
@@ -511,6 +572,11 @@ def find_regressions(
     p_now, p_prev = result.get("probes", {}), prev.get("probes", {})
     for key in ("matmul_bf16_tflops", "hbm_stream_gbps", "hbm_copy_gbps"):
         check(key, p_now.get(key), p_prev.get(key), "lower", 0.05)
+    # the overload fast paths (ISSUE 3): single-digit-ms numbers on a
+    # noisy shared host, so only a 2x inflation flags — that's the size
+    # of accidentally dragging a lock wait or executor hop into a shed
+    for key in ("shed_503_p50_ms", "stale_frame_p50_ms"):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
     # headline p50: compare in MACHINE-RELATIVE terms when both records
     # carry the CPU reference — this host's effective clock swings ±30%
     # with neighbors, and a level shift is not a code regression
@@ -555,6 +621,7 @@ def main() -> None:
     scale1k = bench_scale(1024)
     scale4k = bench_scale(4096)
     sse_subs = bench_sse_subscribers()
+    shed = bench_shed_latency()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -581,6 +648,7 @@ def main() -> None:
         "scale_4096_rss_mb": scale4k["rss_mb"],
         "scale_4096_rss_growth_mb": scale4k["rss_growth_mb"],
         **sse_subs,
+        **shed,
         "probes": probes,
         "cpu_ref_ms": cpu_reference_ms(),
         "cpu_ref_json_ms": cpu_reference_json_ms(),

@@ -106,6 +106,33 @@ def test_regression_guard_normalizes_by_cpu_reference(tmp_path):
     assert [r["metric"] for r in regs] == ["value"]
 
 
+def test_shed_path_inflation_flags(tmp_path):
+    """ISSUE 3: the shedding fast path is guarded — a 2x slower
+    time-to-503 or stale-frame serve flags; noise-level drift doesn't."""
+    _write_prev(
+        tmp_path, value=6.0, probes={},
+        shed_503_p50_ms=2.0, stale_frame_p50_ms=7.0,
+    )
+    noisy = dict(_result(), shed_503_p50_ms=3.5, stale_frame_p50_ms=10.0)
+    _, regs = find_regressions(noisy, bench_dir=str(tmp_path))
+    assert regs == []
+    slow = dict(_result(), shed_503_p50_ms=4.5, stale_frame_p50_ms=30.0)
+    _, regs = find_regressions(slow, bench_dir=str(tmp_path))
+    assert sorted(r["metric"] for r in regs) == [
+        "shed_503_p50_ms", "stale_frame_p50_ms",
+    ]
+
+
+def test_shed_latency_probe_measures_fast_paths():
+    """The probe itself: both medians come back small and positive (the
+    hard asserts inside bench_shed_latency enforce the ceilings)."""
+    from bench import bench_shed_latency
+
+    out = bench_shed_latency(samples=8)
+    assert 0 < out["shed_503_p50_ms"] < 250.0
+    assert 0 < out["stale_frame_p50_ms"] < 1000.0
+
+
 def test_regression_guard_prefers_frame_shaped_reference(tmp_path):
     """When both rounds carry cpu_ref_json_ms, normalization uses it —
     the matmul reference proved blind to the contention that actually

@@ -1,0 +1,603 @@
+"""Overload protection: admission control, load shedding, SSE eviction.
+
+The serving side's failure discipline (ISSUE 3): excess requests shed
+with 503 + Retry-After, /api/frame degrades to a stale frame instead of
+erroring, /healthz is never shed (but reports the overload state), SSE
+fan-out is capped, slow consumers are evicted by the write deadline and
+resume via Last-Event-ID, and every client-gone error spelling
+terminates a stream silently."""
+
+import asyncio
+import json
+import os
+import re
+import socket as socketmod
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.app.overload import OverloadGuard, TokenBucket
+from tpudash.app.server import _CLIENT_GONE, DashboardServer, SESSION_COOKIE
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import FixtureSource, SyntheticSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "small_slice.json")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _server(cfg=None, source=None, **cfg_kw):
+    cfg = cfg or Config(
+        source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+        **cfg_kw,
+    )
+    service = DashboardService(cfg, source or FixtureSource(cfg.fixture_path))
+    return DashboardServer(service)
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+# -- token bucket / guard units ---------------------------------------------
+
+
+def test_token_bucket_burst_and_refill():
+    now = [100.0]
+    b = TokenBucket(burst=3.0, now=now[0])
+    admitted = sum(b.admit(1.0, 3.0, now[0]) for _ in range(5))
+    assert admitted == 3  # burst exhausted
+    now[0] += 2.0  # 2 tokens refill at 1/s
+    assert b.admit(1.0, 3.0, now[0])
+    assert b.admit(1.0, 3.0, now[0])
+    assert not b.admit(1.0, 3.0, now[0])
+
+
+def test_guard_state_machine_and_snapshot():
+    clock = [0.0]
+    cfg = Config(max_concurrency=2, rate_limit=1.0, rate_burst=1.0)
+    g = OverloadGuard(cfg, clock=lambda: clock[0])
+    assert g.state() == "normal"
+    assert g.admit("sid:a") is None
+    assert g.admit("sid:a") == "rate_limited"  # burst 1 spent
+    assert g.state() == "shedding"
+    # fill the gate → saturated while sheds are recent
+    assert g.admit("sid:b") is None
+    reason = g.admit("sid:c")
+    assert reason == "concurrency"
+    assert g.state() == "saturated"
+    snap = g.snapshot()
+    assert snap["state"] == "saturated"
+    assert snap["counters"]["shed_rate_limited"] == 1
+    assert snap["counters"]["shed_concurrency"] == 1
+    assert snap["total_shed"] == 2
+    g.release()
+    g.release()
+    # sheds age out of the window → back to normal without any event
+    clock[0] += 60.0
+    assert g.snapshot()["state"] == "normal"
+    assert g.state() == "normal"
+
+
+def test_guard_bucket_map_is_bounded():
+    from tpudash.app.overload import MAX_CLIENT_BUCKETS
+
+    g = OverloadGuard(Config(rate_limit=100.0, max_concurrency=0))
+    for i in range(MAX_CLIENT_BUCKETS + 50):
+        g.admit(f"sid:{i}")
+        g.release()
+    assert len(g._buckets) <= MAX_CLIENT_BUCKETS
+
+
+# -- admission middleware ----------------------------------------------------
+
+
+def test_rate_limit_sheds_with_retry_after():
+    server = _server(rate_limit=1.0, rate_burst=2.0, shed_retry_after=7.0)
+
+    async def go(client):
+        assert (await client.get("/api/timings")).status == 200
+        assert (await client.get("/api/timings")).status == 200
+        shed = await client.get("/api/timings")
+        assert shed.status == 503
+        assert shed.headers["Retry-After"] == "7"
+        body = await shed.json()
+        assert "overloaded" in body["error"]
+        # /healthz is never shed, and reports the shedding state with
+        # ok still true (liveness must not flap under load)
+        health = await (await client.get("/healthz")).json()
+        assert health["ok"] is True
+        assert health["status"] == "shedding"
+        assert health["overload"]["counters"]["shed_rate_limited"] >= 1
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_frame_degrades_to_stale_not_503():
+    server = _server(rate_limit=1.0, rate_burst=2.0)
+
+    async def go(client):
+        # prime a frame (admitted), then exhaust the bucket
+        first = await (await client.get("/api/frame")).json()
+        assert first["error"] is None and "stale" not in first
+        await client.get("/api/timings")
+        stale = await client.get("/api/frame")
+        assert stale.status == 200
+        assert stale.headers.get("Retry-After")
+        body = await stale.json()
+        assert body["stale"] is True
+        assert body["chips"]  # real (old) data, not an empty shell
+        snap = server.overload.snapshot()
+        assert snap["counters"]["stale_frames_served"] >= 1
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_frame_shed_before_any_frame_is_503():
+    server = _server(rate_limit=1.0, rate_burst=1.0)
+
+    async def go(client):
+        await client.get("/api/timings")  # spend the only token
+        shed = await client.get("/api/frame")  # nothing published yet
+        assert shed.status == 503
+        assert shed.headers["Retry-After"]
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_rate_limit_keys_by_session_cookie():
+    server = _server(rate_limit=1.0, rate_burst=1.0)
+
+    async def go(client):
+        # distinct cookies = distinct budgets: each session's single
+        # burst token admits, a repeat from the same session sheds
+        for sid in ("a", "b", "c"):
+            ok = await client.get(
+                "/api/timings", cookies={SESSION_COOKIE: sid}
+            )
+            assert ok.status == 200, sid
+        assert (
+            await client.get("/api/timings", cookies={SESSION_COOKIE: "a"})
+        ).status == 503
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_shed_path_does_not_grow_session_store():
+    server = _server(rate_limit=0.0, max_concurrency=2)
+
+    async def go(client):
+        await client.get("/api/frame")  # publish one frame
+        before = len(server.sessions)
+        server.overload.inflight = 2  # gate full: everyone below is shed
+        for i in range(20):
+            r = await client.get(
+                "/api/frame", cookies={SESSION_COOKIE: f"swarm-{i}"}
+            )
+            # shed but degraded: stale frame served from _last_frame
+            assert r.status == 200
+            assert (await r.json())["stale"] is True
+        server.overload.inflight = 0
+        # shed requests peeked, never created entries
+        assert len(server.sessions) == before
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_concurrency_gate_sheds_and_releases():
+    server = _server(max_concurrency=2, rate_limit=0.0)
+    g = server.overload
+
+    async def go(client):
+        # saturate the gate directly (requests through TestClient would
+        # finish too fast to overlap deterministically)
+        g.inflight = 2
+        shed = await client.get("/api/timings")
+        assert shed.status == 503
+        assert g.snapshot()["counters"]["shed_concurrency"] == 1
+        g.inflight = 0
+        ok = await client.get("/api/timings")
+        assert ok.status == 200
+        # the admitted request released its slot on the way out
+        assert g.inflight == 0
+
+    _run(_with_client(server.build_app(), go))
+
+
+# -- SSE: stream cap, slow-consumer eviction, reconnect, client-gone --------
+
+
+def test_max_streams_cap_sheds_new_streams():
+    server = _server(max_streams=2, rate_limit=0.0)
+
+    async def go(client):
+        s1 = await client.get("/api/stream")
+        s2 = await client.get("/api/stream")
+        assert s1.status == 200 and s2.status == 200
+        shed = await client.get("/api/stream")
+        assert shed.status == 503
+        assert shed.headers["Retry-After"]
+        assert server.overload.snapshot()["counters"]["shed_streams"] == 1
+        s1.close()
+        # the slot frees once the server notices the close; a new stream
+        # is admitted again
+        for _ in range(100):
+            if server.overload.streams < 2:
+                break
+            await asyncio.sleep(0.05)
+        s3 = await client.get("/api/stream")
+        assert s3.status == 200
+        s2.close()
+        s3.close()
+
+    _run(_with_client(server.build_app(), go))
+
+
+def _tiny_buffer_app(server):
+    """The drill's buffer-shrinking trick for deterministic backpressure
+    on localhost: without it the kernel absorbs hundreds of KB and a
+    'stalled' test consumer never actually blocks a write."""
+    app = server.build_app()
+
+    async def tiny(request, response):
+        if request.path != "/api/stream" or request.transport is None:
+            return
+        sock = request.transport.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_SNDBUF, 4096)
+        request.transport.set_write_buffer_limits(high=2048)
+
+    app.on_response_prepare.append(tiny)
+    return app
+
+
+async def _raw_stalling_stream(host, port, sid):
+    """Open /api/stream as a raw HTTP/1.0 client with tiny buffers, drain
+    exactly the FIRST complete SSE event, then stop draining entirely —
+    so a later event's write blocks in backpressure and the write
+    deadline evicts this consumer.  Returns (reader, writer, bytes so
+    far)."""
+    sock = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+    sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_RCVBUF, 4096)
+    sock.setblocking(False)
+    loop = asyncio.get_running_loop()
+    await loop.sock_connect(sock, (host, port))
+    # limit=2048: asyncio's StreamReader otherwise buffers ~128KB in user
+    # space before pausing the transport — the consumer must truly stall
+    reader, writer = await asyncio.open_connection(sock=sock, limit=2048)
+    writer.write(
+        (
+            f"GET /api/stream HTTP/1.0\r\nHost: {host}\r\n"
+            f"Cookie: {SESSION_COOKIE}={sid}\r\n\r\n"
+        ).encode()
+    )
+    await writer.drain()
+    buf = b""
+    deadline = time.monotonic() + 15
+    # headers use CRLF so b"\n\n" can only terminate the SSE event
+    while b"data: " not in buf or b"\n\n" not in buf.split(b"data: ", 1)[1]:
+        assert time.monotonic() < deadline, f"no first event: {buf[:200]!r}"
+        chunk = await asyncio.wait_for(reader.read(2048), timeout=15)
+        assert chunk, "stream closed before the first event"
+        buf += chunk
+    m = re.search(rb"id: ([0-9\-]+)", buf)
+    assert m, f"no SSE id in first event: {buf[:200]!r}"
+    return reader, writer, buf
+
+
+
+
+def test_slow_consumer_evicted_then_resumes_with_delta():
+    """ISSUE 3 satellites: a consumer that blocks a write past
+    TPUDASH_SSE_WRITE_DEADLINE is evicted; its session entry survives
+    (not TTL-starved), and a reconnect with Last-Event-ID receives a
+    value-only delta, not a full frame."""
+    # refresh_interval 5.0 gives the reconnect a wide window in which NO
+    # further data version lands (under racecheck, lock tracing can add
+    # ~1s of skew — the delta contract must not hang on tight timing)
+    cfg = Config(
+        source="synthetic", synthetic_chips=256, refresh_interval=5.0,
+        sse_write_deadline=0.4, rate_limit=0.0, session_ttl=300.0,
+    )
+    service = DashboardService(cfg, SyntheticSource(num_chips=256))
+    server = DashboardServer(service)
+    # warm the trend history past the []→sparkline structural transition
+    # so the K1→K2 step is value-only (delta-able)
+    service.refresh_data()
+    ts0, avgs = service.history[-1]
+    service.history.appendleft((ts0 - 30.0, dict(avgs)))
+
+    async def go():
+        ts = TestServer(_tiny_buffer_app(server))
+        await ts.start_server()
+        client = TestClient(ts)
+        sid = "evictee"
+        try:
+            # big frames: select everything for this session
+            r = await client.post(
+                "/api/select", json={"all": True},
+                cookies={SESSION_COOKIE: sid},
+            )
+            assert r.status == 200
+            reader, writer, first_buf = await _raw_stalling_stream(
+                ts.host, ts.port, sid
+            )
+            # ...the consumer now never drains; a later tick's write
+            # blocks and the deadline evicts it
+            deadline = time.monotonic() + 25
+            while (
+                server.overload.snapshot()["counters"][
+                    "evicted_slow_consumers"
+                ]
+                == 0
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            writer.close()
+            snap = server.overload.snapshot()
+            assert snap["counters"]["evicted_slow_consumers"] == 1
+            assert snap["streams"] == 0  # the slot was released
+            # the evicted session survived eviction with its delta caches
+            entry = server.sessions.peek(sid)
+            assert entry is not None
+            assert entry.prev_frame is not None
+            # the client state to pin: an evicted consumer whose last
+            # FULLY-received event was the one before the blocked write
+            # (the blocked write itself died with the connection).  Its
+            # EventSource reconnects acking the previous event's id.
+            from tpudash.app.server import _key_id
+
+            last_id = _key_id(entry.prev_frame_key)
+            # pin the refresh window before reconnecting: the contract
+            # under test is delta RESUME, not refresh cadence — a slow
+            # CI host must not sneak an extra data version in between
+            server._data_at = time.monotonic()
+            # reconnect with the last id we actually got: the first
+            # event must be a DELTA (value patch), not a full frame —
+            # the eviction cost the client nothing but the gap
+            resp = await client.get(
+                "/api/stream",
+                headers={"Last-Event-ID": last_id},
+                cookies={SESSION_COOKIE: sid},
+            )
+            assert resp.status == 200
+            raw = await asyncio.wait_for(
+                resp.content.readuntil(b"\n\n"), timeout=15
+            )
+            for line in raw.decode().splitlines():
+                if line.startswith("data: "):
+                    event = json.loads(line[len("data: "):])
+                    break
+            else:
+                raise AssertionError(f"no data in {raw[:100]!r}")
+            assert event["kind"] == "delta", event.get("kind")
+            resp.close()
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_client_gone_spellings_all_normalized():
+    # the one-place tuple covers every disconnect error the stack throws
+    import aiohttp
+
+    assert ConnectionResetError in _CLIENT_GONE
+    assert BrokenPipeError in _CLIENT_GONE
+    assert ConnectionAbortedError in _CLIENT_GONE
+    if hasattr(aiohttp, "ClientConnectionResetError"):
+        assert aiohttp.ClientConnectionResetError in _CLIENT_GONE
+
+
+def test_abrupt_client_reset_terminates_stream_silently(caplog):
+    """A client that RSTs mid-stream must terminate the SSE loop as a
+    normal disconnect: stream slot released, no traceback logged."""
+    import logging
+
+    server = _server(
+        cfg=Config(
+            source="fixture", fixture_path=FIXTURE, refresh_interval=0.0,
+            rate_limit=0.0,
+        )
+    )
+
+    async def go():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            sock = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+            sock.setblocking(False)
+            loop = asyncio.get_running_loop()
+            await loop.sock_connect(sock, (ts.host, ts.port))
+            reader, writer = await asyncio.open_connection(sock=sock)
+            writer.write(
+                (
+                    f"GET /api/stream HTTP/1.0\r\nHost: {ts.host}\r\n\r\n"
+                ).encode()
+            )
+            await writer.drain()
+            await asyncio.wait_for(reader.read(2048), timeout=15)
+            # RST, not FIN: SO_LINGER(on, 0) makes close() send a reset,
+            # so the server's next write dies with a reset error
+            raw = writer.transport.get_extra_info("socket")
+            raw.setsockopt(
+                socketmod.SOL_SOCKET,
+                socketmod.SO_LINGER,
+                __import__("struct").pack("ii", 1, 0),
+            )
+            writer.transport.abort()
+            # the server notices on its next tick(s)
+            deadline = time.monotonic() + 15
+            while (
+                server.overload.streams > 0
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert server.overload.streams == 0
+        finally:
+            await ts.close()
+
+    with caplog.at_level(logging.ERROR):
+        _run(go())
+    errors = [r for r in caplog.records if r.levelno >= logging.ERROR]
+    assert errors == [], [r.getMessage() for r in errors]
+
+
+# -- deadline propagation ----------------------------------------------------
+
+
+def test_expired_budget_serves_cached_frame_without_recompose():
+    server = _server(rate_limit=0.0)
+    service = server.service
+
+    async def go(client):
+        frame = await (await client.get("/api/frame")).json()
+        assert frame["error"] is None
+        entry = server.sessions.entry(None)
+        composes = {"n": 0}
+        orig = service.compose_frame
+
+        def counting(state=None):
+            composes["n"] += 1
+            return orig(state)
+
+        service.compose_frame = counting
+        # budget already expired → the cached frame comes back with zero
+        # executor compose work
+        async with server._lock:
+            cached, key = await server._compose_locked(
+                entry, deadline=time.monotonic() - 1.0
+            )
+        assert composes["n"] == 0
+        assert cached is entry.frame
+        # with budget remaining, a new version composes normally
+        server._data_version += 1
+        async with server._lock:
+            await server._compose_locked(
+                entry, deadline=time.monotonic() + 30.0
+            )
+        assert composes["n"] == 1
+
+    _run(_with_client(server.build_app(), go))
+
+
+# -- observability: healthz fold, alerts, timings ---------------------------
+
+
+def test_healthz_status_composes_source_and_overload():
+    from tpudash.sources.base import MetricsSource, SourceError
+
+    class Boom(MetricsSource):
+        name = "boom"
+
+        def fetch(self):
+            raise SourceError("down")
+
+    server = _server(
+        cfg=Config(source="fixture", refresh_interval=0.0, rate_limit=1.0,
+                   rate_burst=1.0),
+        source=Boom(),
+    )
+
+    async def go(client):
+        await client.get("/api/frame")  # error path (also spends the token)
+        health = await (await client.get("/healthz")).json()
+        assert health["status"] == "down"
+        await client.get("/api/timings")  # shed (bucket empty)
+        health = await (await client.get("/healthz")).json()
+        # both dimensions visible: source down AND server shedding
+        assert health["status"] == "down+shedding"
+        assert health["ok"] is True
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_overload_alert_synthesized_and_pageable():
+    server = _server(rate_limit=0.0, shed_retry_after=1.0)
+    service = server.service
+    # drive the guard into shedding, then refresh: the overload alert
+    # must ride the normal alert pipeline (sortable, silencable, paged)
+    server.overload._shed("rate_limited", server.overload._clock())
+
+    async def go(client):
+        frame = await (await client.get("/api/frame")).json()
+        overload = [
+            a for a in frame.get("alerts", []) if a["rule"] == "overload"
+        ]
+        assert overload, frame.get("alerts")
+        a = overload[0]
+        assert a["state"] == "firing"
+        assert a["severity"] == "warning"
+        assert a["chip"] == "server"
+        assert "shed" in a["detail"]
+        # saturated escalates to critical
+        service.overload_provider = lambda: {
+            "state": "saturated", "since_s": 1.0, "recent_sheds": 9,
+            "inflight": 4, "streams": 0, "total_shed": 9,
+        }
+        frame = await (await client.get("/api/frame")).json()
+        a = [x for x in frame["alerts"] if x["rule"] == "overload"][0]
+        assert a["severity"] == "critical"
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_timings_exposes_shed_and_evict_counters():
+    server = _server(rate_limit=1.0, rate_burst=1.0)
+
+    async def go(client):
+        assert (await client.get("/api/timings")).status == 200
+        assert (await client.get("/api/frame")).status == 503  # no frame yet
+        t = await (await client.get("/healthz")).json()
+        assert t["overload"]["counters"]["shed_rate_limited"] >= 1
+        # spend wall time so the bucket refills and timings admits
+        await asyncio.sleep(1.1)
+        body = await (await client.get("/api/timings")).json()
+        ov = body["overload"]
+        assert ov["counters"]["shed_rate_limited"] >= 1
+        assert set(ov["counters"]) >= {
+            "admitted", "shed_rate_limited", "shed_concurrency",
+            "shed_streams", "evicted_slow_consumers", "stale_frames_served",
+        }
+        assert "state" in ov and "limits" in ov
+
+    _run(_with_client(server.build_app(), go))
+
+
+def test_new_overload_knobs_load_from_env():
+    from tpudash.config import load_config
+
+    cfg = load_config(env={
+        "TPUDASH_MAX_CONCURRENCY": "8",
+        "TPUDASH_RATE_LIMIT": "2.5",
+        "TPUDASH_RATE_BURST": "5",
+        "TPUDASH_MAX_STREAMS": "3",
+        "TPUDASH_SSE_WRITE_DEADLINE": "0.5",
+        "TPUDASH_SHED_RETRY_AFTER": "4",
+    })
+    assert cfg.max_concurrency == 8
+    assert cfg.rate_limit == 2.5
+    assert cfg.rate_burst == 5.0
+    assert cfg.max_streams == 3
+    assert cfg.sse_write_deadline == 0.5
+    assert cfg.shed_retry_after == 4.0
+
+
+def test_overload_drill_smoke():
+    """The chaos overload drill end to end at small scale: sheds, stale
+    frames, evictions, healthz responsive, zero unhandled exceptions."""
+    from tpudash.chaos import run_overload_drill
+
+    summary = _run(run_overload_drill(clients=24, seconds=6.0))
+    assert summary["ok"], summary["failures"]
+    assert summary["overload"]["counters"]["evicted_slow_consumers"] >= 1
+    assert summary["requests"]["shed_503"] > 0
+    assert summary["requests"]["stale_frames"] > 0

@@ -53,6 +53,48 @@ def sort_alerts(alerts: "list[dict]") -> "list[dict]":
     )
     return alerts
 
+#: Rule names synthesized OUTSIDE the engine — service-level conditions
+#: (a quarantined endpoint, the server shedding load) shaped like engine
+#: output so silences, the webhook pager, and the banner treat them
+#: exactly like a breaching chip.  The service strips and re-synthesizes
+#: these on every publish; engine rules never collide with them.
+SYNTHESIZED_RULES = ("endpoint_down", "overload")
+
+
+def synthesized_alert(
+    *,
+    rule: str,
+    column: str,
+    severity: str,
+    chip: str,
+    value: float,
+    threshold: float,
+    firing: bool,
+    since: "float | None" = None,
+    streak: int = 0,
+    detail: "str | None" = None,
+    **extra,
+) -> dict:
+    """One synthesized alert entry in the engine's exact output shape
+    (see :meth:`AlertEngine.evaluate`) — the single constructor both
+    ``endpoint_down`` and ``overload`` use, so the pager/banner contract
+    cannot drift between synthesis sites."""
+    out = {
+        "rule": rule,
+        "column": column,
+        "severity": severity,
+        "chip": chip,
+        "value": round(float(value), 2),
+        "threshold": float(threshold),
+        "state": "firing" if firing else "pending",
+        "since": since,
+        "streak": streak,
+        "detail": detail,
+    }
+    out.update(extra)
+    return out
+
+
 #: Default rules: conservative hardware-health thresholds.  Temperature and
 #: HBM-pressure limits apply across generations; both require 2 consecutive
 #: breaching frames.

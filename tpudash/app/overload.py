@@ -1,0 +1,246 @@
+"""Overload protection — admission control, load shedding, SSE accounting.
+
+PR 1 hardened the *source* side (breakers, watchdog, concurrent multi
+fetch); this is the *serving* side's equivalent: the dashboard must
+degrade gracefully under a client swarm instead of falling over with the
+fleet it monitors.  Three mechanisms, all owned by :class:`OverloadGuard`
+and driven from the server's admission middleware:
+
+- a **global concurrency gate** (``Config.max_concurrency``) bounding
+  simultaneously-served requests, so a request flood queues in the
+  kernel's accept backlog instead of starving the event loop that the
+  refresh watchdog and webhook delivery share;
+- **per-client token buckets** (``Config.rate_limit`` / ``rate_burst``)
+  keyed by session cookie (peer address fallback), so one misbehaving
+  dashboard tab cannot crowd out every other viewer;
+- **bounded SSE fan-out**: a stream cap (``Config.max_streams``) and
+  per-event write-deadline eviction accounting (the deadline itself is
+  enforced in the server's stream loop — the guard only counts).
+
+Shed requests get ``503`` + ``Retry-After``; ``GET /api/frame`` degrades
+to the last published frame with a ``stale: true`` marker instead, and
+``/healthz`` is never shed (liveness must not flap under load).
+
+The guard also runs the **overload state machine** the rest of the stack
+observes (``/healthz``, the synthesized ``overload`` alert, the
+``/api/timings`` counters):
+
+    normal ──(any shed in the window)──▶ shedding
+    shedding ──(a gate/cap is full *right now*)──▶ saturated
+    saturated/shedding ──(no shed for SHED_WINDOW_S)──▶ normal
+
+Threading: every *mutation* happens on the aiohttp event loop (no locks
+needed).  :meth:`snapshot` is read-only and safe from worker threads —
+the service's alert synthesis calls it from ``refresh_data`` on the
+executor.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+
+#: a shed inside this window keeps the state machine out of "normal"
+SHED_WINDOW_S = 10.0
+
+#: bound on the per-client bucket map (LRU evicted) — a spoofed-cookie
+#: swarm must not grow server memory without bound
+MAX_CLIENT_BUCKETS = 4096
+
+#: shed-reason keys (also the counter names, prefixed ``shed_``)
+SHED_RATE = "rate_limited"
+SHED_CONCURRENCY = "concurrency"
+SHED_STREAMS = "streams"
+
+
+class TokenBucket:
+    """Classic token bucket on a monotonic clock: ``rate`` tokens/s up to
+    ``burst``; one token per admitted request."""
+
+    __slots__ = ("tokens", "stamp")
+
+    def __init__(self, burst: float, now: float):
+        self.tokens = burst
+        self.stamp = now
+
+    def admit(self, rate: float, burst: float, now: float) -> bool:
+        self.tokens = min(burst, self.tokens + (now - self.stamp) * rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class OverloadGuard:
+    """Admission state for one :class:`DashboardServer` (see module doc)."""
+
+    def __init__(self, cfg, clock=time.monotonic):
+        self.max_concurrency = max(0, int(cfg.max_concurrency))
+        self.rate = max(0.0, float(cfg.rate_limit))
+        burst = float(cfg.rate_burst) if cfg.rate_burst else 2.0 * self.rate
+        self.burst = max(1.0, burst) if self.rate else 0.0
+        self.max_streams = max(0, int(cfg.max_streams))
+        self.write_deadline = max(0.0, float(cfg.sse_write_deadline))
+        retry = float(cfg.shed_retry_after)
+        if retry <= 0:
+            retry = max(1.0, float(cfg.refresh_interval))
+        self.retry_after = retry
+        self._clock = clock
+        self.inflight = 0
+        self.streams = 0
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        self.counters = {
+            "admitted": 0,
+            f"shed_{SHED_RATE}": 0,
+            f"shed_{SHED_CONCURRENCY}": 0,
+            f"shed_{SHED_STREAMS}": 0,
+            "evicted_slow_consumers": 0,
+            "stale_frames_served": 0,
+        }
+        #: monotonic stamps of recent sheds (state-machine input); bounded
+        #: — the window sum saturates long before the bound matters
+        self._recent_sheds: deque = deque(maxlen=1024)
+        self._state = "normal"
+        self._state_since = clock()
+
+    # -- admission -----------------------------------------------------------
+    @staticmethod
+    def client_key(request) -> str:
+        """Rate-limit key: the session cookie when present (one browser =
+        one budget, however many tabs), else the peer address (curl, API
+        consumers, proxies without cookies)."""
+        from tpudash.app.server import SESSION_COOKIE
+
+        sid = request.cookies.get(SESSION_COOKIE)
+        if sid:
+            return f"sid:{sid}"
+        peer = request.remote or ""
+        return f"peer:{peer}"
+
+    def admit(self, key: str, gate: bool = True) -> "str | None":
+        """Try to admit one request.  Returns None on admission (the
+        caller MUST pair it with :meth:`release` when ``gate`` was True)
+        or the shed reason.  ``gate=False`` skips the concurrency gate
+        (SSE streams: held open for minutes, governed by the stream cap
+        instead — they must not consume the request gate forever)."""
+        now = self._clock()
+        # gate BEFORE the rate debit: a gate-shed request must not also
+        # burn the client's token, or a polite client retrying per
+        # Retry-After through a gate-full episode drains its bucket and
+        # keeps being shed (as rate_limited) after capacity frees
+        if gate and self.max_concurrency and self.inflight >= self.max_concurrency:
+            self._shed(SHED_CONCURRENCY, now)
+            return SHED_CONCURRENCY
+        if self.rate > 0:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                while len(self._buckets) >= MAX_CLIENT_BUCKETS:
+                    self._buckets.popitem(last=False)
+                bucket = self._buckets[key] = TokenBucket(self.burst, now)
+            else:
+                self._buckets.move_to_end(key)
+            if not bucket.admit(self.rate, self.burst, now):
+                self._shed(SHED_RATE, now)
+                return SHED_RATE
+        if gate:
+            self.inflight += 1
+            # gate=False (SSE) requests are counted admitted by
+            # acquire_stream() instead — the stream cap can still shed
+            # them after this point, and one request must never show up
+            # as both admitted and shed in the runbook's counters
+            self.counters["admitted"] += 1
+        self._transition(now)
+        return None
+
+    def release(self) -> None:
+        self.inflight = max(0, self.inflight - 1)
+
+    # -- SSE stream accounting -----------------------------------------------
+    def acquire_stream(self) -> bool:
+        now = self._clock()
+        if self.max_streams and self.streams >= self.max_streams:
+            self._shed(SHED_STREAMS, now)
+            return False
+        self.streams += 1
+        self.counters["admitted"] += 1
+        self._transition(now)
+        return True
+
+    def release_stream(self) -> None:
+        self.streams = max(0, self.streams - 1)
+
+    def note_eviction(self) -> None:
+        self.counters["evicted_slow_consumers"] += 1
+
+    def note_stale_frame(self) -> None:
+        self.counters["stale_frames_served"] += 1
+
+    def retry_after_header(self) -> str:
+        """Integer seconds for the ``Retry-After`` header (RFC 9110
+        allows only whole seconds; round up so we never invite an
+        earlier retry than configured)."""
+        return str(max(1, int(-(-self.retry_after // 1))))
+
+    # -- state machine -------------------------------------------------------
+    def _shed(self, reason: str, now: float) -> None:
+        self.counters[f"shed_{reason}"] += 1
+        self._recent_sheds.append(now)
+        self._transition(now)
+
+    def _recent(self, now: float) -> int:
+        # tuple(): snapshot() may race an append from the event loop —
+        # iterating a copy keeps the worker-thread read safe
+        return sum(1 for t in tuple(self._recent_sheds) if now - t < SHED_WINDOW_S)
+
+    def _compute_state(self, now: float) -> str:
+        if self._recent(now) == 0:
+            return "normal"
+        gate_full = bool(
+            self.max_concurrency and self.inflight >= self.max_concurrency
+        )
+        streams_full = bool(
+            self.max_streams and self.streams >= self.max_streams
+        )
+        return "saturated" if gate_full or streams_full else "shedding"
+
+    def _transition(self, now: float) -> None:
+        """Advance the state machine (event-loop callers only)."""
+        state = self._compute_state(now)
+        if state != self._state:
+            self._state = state
+            self._state_since = now
+
+    def state(self) -> str:
+        now = self._clock()
+        self._transition(now)
+        return self._state
+
+    def snapshot(self) -> dict:
+        """Read-only summary (safe from any thread): state, since, live
+        gauges, limits, and the monotonically-growing counters that
+        ``/api/timings`` and the runbook read."""
+        now = self._clock()
+        state = self._compute_state(now)
+        # a decayed/advanced state the loop hasn't stamped yet reports
+        # "since now" rather than a stale transition time
+        since = self._state_since if state == self._state else now
+        total_shed = sum(
+            v for k, v in self.counters.items() if k.startswith("shed_")
+        )
+        return {
+            "state": state,
+            "since_s": round(now - since, 3),
+            "recent_sheds": self._recent(now),
+            "inflight": self.inflight,
+            "streams": self.streams,
+            "total_shed": total_shed,
+            "limits": {
+                "max_concurrency": self.max_concurrency,
+                "rate_limit": self.rate,
+                "rate_burst": self.burst,
+                "max_streams": self.max_streams,
+                "sse_write_deadline_s": self.write_deadline,
+            },
+            "counters": dict(self.counters),
+        }

@@ -43,6 +43,7 @@ log = logging.getLogger(__name__)
 
 from tpudash.app.assets import find_plotly_asset
 from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
+from tpudash.app.overload import OverloadGuard
 from tpudash.app.service import DashboardService
 from tpudash.app.sessions import SessionEntry, SessionStore
 from tpudash.config import Config, load_config
@@ -52,6 +53,28 @@ from tpudash.sources import make_source
 #: app.py:252-260).  No Max-Age: it lives for the browser session, exactly
 #: like a Streamlit session.
 SESSION_COOKIE = "tpudash_sid"
+
+#: "the client went away" in every spelling the asyncio/aiohttp stack
+#: produces: plain socket resets, aborted/broken pipes, and (aiohttp ≥
+#: 3.10) the ClientConnectionResetError StreamResponse.write raises on a
+#: closing transport.  One tuple, caught in one place — a disconnecting
+#: browser must terminate its SSE loop silently, never as a traceback.
+_CLIENT_GONE: tuple = (
+    ConnectionResetError,
+    ConnectionAbortedError,
+    BrokenPipeError,
+)
+try:
+    from aiohttp import ClientConnectionResetError as _CCRE
+
+    _CLIENT_GONE = (*_CLIENT_GONE, _CCRE)
+except ImportError:  # older aiohttp raises ConnectionResetError directly
+    pass
+
+#: routes exempt from admission control: liveness must never flap under
+#: load, and the static shell / vendored bundle are cheap one-time loads
+#: a browser needs before it can even hold a session
+_NEVER_SHED = ("/healthz",)
 
 
 def _dumps(obj) -> str:
@@ -143,6 +166,18 @@ class DashboardServer:
         self._refresh_task = None
         self._refresh_started: float = 0.0
         self._device_trace_active = False  # jax profiler is a singleton
+        #: admission control / load shedding (tpudash.app.overload); the
+        #: service's alert synthesis reads the guard through the provider
+        self.overload = OverloadGuard(service.cfg)
+        service.overload_provider = self.overload.snapshot
+        #: most recent frame composed for ANY session — what a shed
+        #: GET /api/frame degrades to (marked ``stale: true``) instead
+        #: of erroring.  A plain reference swap: never mutated in place.
+        self._last_frame: "dict | None" = None
+        self._last_frame_key: "tuple | None" = None
+        #: (key, raw body, gzip body) for the degraded response — built
+        #: at most once per published frame, however many sheds serve it
+        self._stale_body: "tuple | None" = None
         #: vendored plotly bundle (deploy-time property, resolved once);
         #: None → the page uses the CDN tag and /static 404s
         self._plotly_asset = find_plotly_asset(service.cfg.assets_dir)
@@ -165,7 +200,9 @@ class DashboardServer:
         return self.sessions.entry(request.cookies.get(SESSION_COOKIE))
 
     # -- frame caching -------------------------------------------------------
-    async def _refresh_locked(self, force: bool) -> None:
+    async def _refresh_locked(
+        self, force: bool, deadline: "float | None" = None
+    ) -> None:
         """Refresh the shared scrape data when stale.  Caller holds _lock.
 
         Watchdog (Config.refresh_watchdog): a wedged source — a hung
@@ -174,12 +211,22 @@ class DashboardServer:
         lock.  Past the deadline the in-flight fetch is parked, routes
         keep serving the last data with a "stalled" warning, and a later
         tick harvests the fetch when (if) it completes.  At most ONE
-        fetch is ever in flight, so a wedge cannot exhaust the executor."""
+        fetch is ever in flight, so a wedge cannot exhaust the executor.
+
+        ``deadline`` is the REQUEST's budget (monotonic stamp from the
+        admission middleware): a request whose budget runs out stops
+        waiting and serves what's cached — WITHOUT declaring a source
+        stall (the source may be fine; this request just ran out of
+        road).  The fetch itself keeps running for the next caller."""
         watchdog = self.service.cfg.refresh_watchdog
         stall_msg = (
             f"metrics source stalled (no response in {watchdog:g}s); "
             "serving the last good data"
         )
+
+        def _budget() -> "float | None":
+            return None if deadline is None else deadline - time.monotonic()
+
         if self._refresh_task is not None:
             if not self._refresh_task.done():
                 # A fetch parked by the watchdog — or orphaned by a client
@@ -189,19 +236,32 @@ class DashboardServer:
                 # client to stale-instantly); only past the deadline do
                 # we declare the stall and serve stale.
                 elapsed = time.monotonic() - self._refresh_started
+                waits = []
                 if watchdog and watchdog > 0:
-                    remaining = watchdog - elapsed
-                    if remaining > 0:
-                        try:
-                            await asyncio.wait_for(
-                                asyncio.shield(self._refresh_task), remaining
-                            )
-                        except asyncio.TimeoutError:
-                            pass
-                else:
+                    waits.append(watchdog - elapsed)
+                budget = _budget()
+                if budget is not None:
+                    waits.append(budget)
+                if not waits:
                     await asyncio.shield(self._refresh_task)
+                elif min(waits) > 0:
+                    try:
+                        await asyncio.wait_for(
+                            asyncio.shield(self._refresh_task), min(waits)
+                        )
+                    except asyncio.TimeoutError:
+                        pass
                 if not self._refresh_task.done():
-                    if self.service.refresh_stalled is None:
+                    # only a WATCHDOG expiry is a stall; a request-budget
+                    # expiry serves stale silently and leaves the verdict
+                    # to callers with time left
+                    if (
+                        watchdog
+                        and watchdog > 0
+                        and time.monotonic() - self._refresh_started
+                        >= watchdog
+                        and self.service.refresh_stalled is None
+                    ):
                         self.service.refresh_stalled = stall_msg
                     return  # serve what we have
             task, self._refresh_task = self._refresh_task, None
@@ -232,13 +292,27 @@ class DashboardServer:
             task = loop.run_in_executor(None, self.service.refresh_data)
             self._refresh_task = task
             self._refresh_started = time.monotonic()
+            waits = []
+            if watchdog and watchdog > 0:
+                waits.append(watchdog)
+            budget = _budget()
+            if budget is not None:
+                waits.append(max(0.0, budget))
             try:
-                if watchdog and watchdog > 0:
-                    await asyncio.wait_for(asyncio.shield(task), watchdog)
+                if waits:
+                    await asyncio.wait_for(asyncio.shield(task), min(waits))
                 else:
                     await task
             except asyncio.TimeoutError:
-                self.service.refresh_stalled = stall_msg
+                # watchdog expiry → stall; request-budget expiry → the
+                # fetch stays parked for the next caller to harvest, and
+                # THIS request serves whatever is cached
+                if (
+                    watchdog
+                    and watchdog > 0
+                    and time.monotonic() - self._refresh_started >= watchdog
+                ):
+                    self.service.refresh_stalled = stall_msg
                 return
             self._refresh_task = None
             self._data_version += 1
@@ -246,13 +320,22 @@ class DashboardServer:
             self.service.refresh_stalled = None
 
     async def _compose_locked(
-        self, entry: SessionEntry, keep_prev: bool = False
+        self,
+        entry: SessionEntry,
+        keep_prev: bool = False,
+        deadline: "float | None" = None,
     ) -> "tuple[dict, tuple]":
         """Per-session compose with its (data_version, state_version) cache
         key.  Caller holds _lock and has already run _refresh_locked — the
         single copy of the cache-keying protocol both transports share.
         ``keep_prev`` retains the outgoing frame for the delta transport;
-        pure-polling sessions never pay that second frame's memory."""
+        pure-polling sessions never pay that second frame's memory.
+
+        A request whose budget (``deadline``) has already expired — it
+        queued behind the lock longer than its client will wait — serves
+        its cached frame instead of burning executor time on a compose
+        nobody may read; with nothing cached it composes anyway (serving
+        NOTHING helps no one)."""
         key = (
             self._data_version,
             entry.state_version,
@@ -262,6 +345,12 @@ class DashboardServer:
         )
         if entry.frame is not None and entry.frame_key == key:
             return entry.frame, key
+        if (
+            deadline is not None
+            and entry.frame is not None
+            and time.monotonic() >= deadline
+        ):
+            return entry.frame, entry.frame_key
         loop = asyncio.get_running_loop()
         frame = await loop.run_in_executor(
             None, self.service.compose_frame, entry.state
@@ -271,20 +360,26 @@ class DashboardServer:
             entry.prev_frame_key = entry.frame_key
         entry.frame = frame
         entry.frame_key = key
+        self._last_frame = frame
+        self._last_frame_key = key
         return frame, key
 
     async def _get_frame(
-        self, force: bool = False, entry: SessionEntry | None = None
+        self,
+        force: bool = False,
+        entry: SessionEntry | None = None,
+        deadline: "float | None" = None,
     ) -> dict:
         """Frame for one viewer session.  The scrape/normalize half runs at
         most once per refresh interval across ALL sessions; the per-session
         compose is cached against (data_version, state_version), so many
         tabs of one browser cost one render and a selection change on one
-        session never re-scrapes or re-renders the others."""
+        session never re-scrapes or re-renders the others.  ``deadline``
+        is the request budget (see _refresh_locked/_compose_locked)."""
         entry = entry if entry is not None else self.sessions.entry(None)
         async with self._lock:
-            await self._refresh_locked(force)
-            frame, _ = await self._compose_locked(entry)
+            await self._refresh_locked(force, deadline=deadline)
+            frame, _ = await self._compose_locked(entry, deadline=deadline)
             return frame
 
     async def _get_sse_event(
@@ -399,7 +494,9 @@ class DashboardServer:
         of the full ~100KB figure JSON.  Browsers do this automatically
         for fetch() under Cache-Control: no-cache."""
         entry = self._entry(request)
-        frame = await self._get_frame(entry=entry)
+        frame = await self._get_frame(
+            entry=entry, deadline=request.get("tpudash_deadline")
+        )
         etag = (
             f'"{_key_id(entry.frame_key)}"'
             if entry.frame_key is not None
@@ -416,7 +513,28 @@ class DashboardServer:
         """Server-sent events: push a frame every refresh interval.  All
         subscribers share the scrape; subscribers of one session share its
         serialized payload, so N open tabs still cost one scrape per
-        interval and one compose per session."""
+        interval and one compose per session.
+
+        Bounded fan-out: at Config.max_streams concurrent subscribers new
+        streams are shed (503 + Retry-After), and a consumer that blocks
+        one event write past Config.sse_write_deadline is evicted — a
+        stalled ``resp.write`` must not pin a compressor and a session
+        entry forever.  Both ends of that contract are cheap for the
+        client: EventSource auto-reconnects with Last-Event-ID, so an
+        evicted consumer that recovers resumes on its delta path."""
+        if not self.overload.acquire_stream():
+            raise web.HTTPServiceUnavailable(
+                text="stream capacity reached; retry shortly",
+                headers={"Retry-After": self.overload.retry_after_header()},
+            )
+        try:
+            return await self._stream_admitted(request)
+        finally:
+            self.overload.release_stream()
+
+    async def _stream_admitted(
+        self, request: web.Request
+    ) -> web.StreamResponse:
         sid = request.cookies.get(SESSION_COOKIE)
         headers = {
             "Content-Type": "text/event-stream",
@@ -443,19 +561,31 @@ class DashboardServer:
             else None
         )
 
+        # Per-event drain: aiohttp's StreamWriter awaits a real transport
+        # drain only every 64KB of cumulative writes, so a stalled
+        # consumer would silently absorb several events of buffering
+        # before the write deadline could ever engage.  Draining at event
+        # boundaries makes backpressure — and therefore the slow-consumer
+        # deadline — event-granular.  (No public API: _payload_writer is
+        # the writer prepare() installed; drain() is its contract.)
+        payload_writer = getattr(resp, "_payload_writer", None)
+
         async def write_event(raw: bytes) -> None:
             if compressor is None:
-                await resp.write(raw)
-                return
-            data = compressor.compress(raw) + compressor.flush(
-                zlib.Z_SYNC_FLUSH
-            )
+                data = raw
+            else:
+                data = compressor.compress(raw) + compressor.flush(
+                    zlib.Z_SYNC_FLUSH
+                )
             if data:
                 await resp.write(data)
+                if payload_writer is not None:
+                    await payload_writer.drain()
         # every event carries its compose key as the SSE id, and
         # EventSource echoes it back on reconnect — a dropped connection
         # resumes with a delta (or keepalive) instead of a full frame
         client_key = _id_key(request.headers.get("Last-Event-ID"))
+        write_deadline = self.overload.write_deadline
         try:
             while True:
                 # re-resolve every tick: touches last_seen so an actively
@@ -465,9 +595,40 @@ class DashboardServer:
                 payload, client_key = await self._get_sse_event(
                     entry, client_key
                 )
-                await write_event(payload)
+                if write_deadline and write_deadline > 0:
+                    try:
+                        await asyncio.wait_for(
+                            write_event(payload), write_deadline
+                        )
+                    except asyncio.TimeoutError:
+                        # Slow-consumer eviction: the peer stopped
+                        # draining and this write sat in backpressure
+                        # past the deadline.  Drop the stream — the
+                        # session entry (and its delta caches) stays in
+                        # the store, so a reconnect with Last-Event-ID
+                        # resumes with a delta, not a full frame.
+                        self.overload.note_eviction()
+                        log.info(
+                            "evicted slow SSE consumer (write blocked "
+                            "> %gs); session %s kept for reconnect",
+                            write_deadline,
+                            "anonymous" if not sid else sid[:8],
+                        )
+                        # abort, don't just return: aiohttp's
+                        # finish_response awaits write_eof → drain,
+                        # which waits on the SAME peer's backpressure
+                        # with no timeout — without the abort the
+                        # evicted socket, its buffered events, and this
+                        # handler task would stay pinned until TCP
+                        # teardown, re-creating the leak eviction exists
+                        # to prevent
+                        if request.transport is not None:
+                            request.transport.abort()
+                        break
+                else:
+                    await write_event(payload)
                 await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
-        except (ConnectionResetError, asyncio.CancelledError):
+        except (*_CLIENT_GONE, asyncio.CancelledError):
             pass  # client went away — normal termination
         return resp
 
@@ -476,7 +637,10 @@ class DashboardServer:
         identity columns + every metric column).  Always refreshes through
         the cache-gated frame path so the export is at most one refresh
         interval old, never an hours-stale snapshot."""
-        frame = await self._get_frame(entry=self._entry(request))
+        frame = await self._get_frame(
+            entry=self._entry(request),
+            deadline=request.get("tpudash_deadline"),
+        )
         stale = frame.get("error") or self.service.refresh_stalled
         if stale:
             # don't serve pre-outage (or mid-stall) data as if it were
@@ -526,7 +690,9 @@ class DashboardServer:
             raise web.HTTPBadRequest(text="no selection operation in body")
         # recompose this session's frame (data untouched: a selection
         # change must not trigger a re-scrape, the table didn't change)
-        frame = await self._get_frame(entry=entry)
+        frame = await self._get_frame(
+            entry=entry, deadline=request.get("tpudash_deadline")
+        )
         return _json_response(
             {"selected": list(state.selected), "frame_ok": frame["error"] is None}
         )
@@ -543,11 +709,17 @@ class DashboardServer:
             entry.state.use_gauge = use_gauge
 
         await self._mutate(entry, _set)
-        await self._get_frame(entry=entry)
+        await self._get_frame(
+            entry=entry, deadline=request.get("tpudash_deadline")
+        )
         return _json_response({"use_gauge": entry.state.use_gauge})
 
     async def timings(self, request: web.Request) -> web.Response:
-        return _json_response(self.service.timer.summary())
+        """Stage-timing percentiles plus the overload layer's shed/evict
+        counters — one stop for "is the serving side keeping up"."""
+        summary = self.service.timer.summary()
+        summary["overload"] = self.overload.snapshot()
+        return _json_response(summary)
 
     async def profile(self, request: web.Request) -> web.Response:
         """On-demand profiling (tracing, SURVEY.md §5 — the reference has
@@ -1026,22 +1198,116 @@ class DashboardServer:
         )
 
     async def healthz(self, request: web.Request) -> web.Response:
-        """Liveness + source health.  ``status`` distinguishes "one slice
-        quarantined" (degraded — source_health.endpoints names the open
-        breaker) from "all sources down" (down) without the probe having
-        to dig; ``ok`` stays True throughout — the PROCESS is alive and
-        serving, which is what a k8s liveness probe must measure (a
-        restart does not fix a down Prometheus)."""
+        """Liveness + source health + overload state.  ``status``
+        distinguishes "one slice quarantined" (degraded —
+        source_health.endpoints names the open breaker) from "all sources
+        down" (down) from "the SERVER is shedding load" (shedding/
+        saturated — the source may be perfectly healthy; the serving side
+        is protecting itself).  ``ok`` stays True throughout — the
+        PROCESS is alive and serving, which is what a k8s liveness probe
+        must measure (a restart fixes neither a down Prometheus nor a
+        client swarm), and this route is exempt from admission control so
+        liveness never flaps under load."""
         health = self.service.source_health()
         status = health.get("status") if health else None
         if status is None:
             status = "down" if self.service.last_error else "healthy"
+        overload = self.overload.snapshot()
+        if overload["state"] != "normal":
+            # compose, don't replace: "degraded+shedding" tells the 3am
+            # responder it's BOTH a source and a serving problem
+            status = (
+                overload["state"]
+                if status == "healthy"
+                else f"{status}+{overload['state']}"
+            )
         return _json_response(
             {"ok": True, "status": status,
              "source": self.service.source.name,
              "error": self.service.last_error,
+             "overload": overload,
              "source_health": health}
         )
+
+    def _shed_response(self, request: web.Request, reason: str) -> web.Response:
+        """One shed request's response.  ``GET /api/frame`` degrades to
+        the last published frame with a ``stale: true`` marker — a
+        monitoring dashboard that answers "here is slightly-old data"
+        beats one that answers 503 while the fleet burns.  Everything
+        else sheds hard: 503 + Retry-After, constant-time, no locks, no
+        executor — the whole point is that this path stays cheap at any
+        request rate."""
+        headers = {"Retry-After": self.overload.retry_after_header()}
+        if request.method == "GET" and request.path == "/api/frame":
+            frame, key = self._last_frame, self._last_frame_key
+            if frame is not None:
+                # serialized (and gzipped) ONCE per published frame and
+                # revalidated by ETag: a polling swarm being shed must
+                # cost neither a fresh ~100KB _dumps() on the event loop
+                # per request nor 100KB of uncompressed egress — the
+                # shed path short-circuits the _compress middleware, so
+                # it carries its own cached encoding
+                etag = f'"{_key_id(key)}-stale"' if key is not None else None
+                self.overload.note_stale_frame()
+                if etag is not None:
+                    headers["ETag"] = etag
+                    if request.headers.get("If-None-Match") == etag:
+                        return web.Response(
+                            status=304,
+                            headers={**headers, "Cache-Control": "no-cache"},
+                        )
+                if self._stale_body is None or self._stale_body[0] != key:
+                    import gzip as _gzip
+
+                    raw = _dumps(dict(frame, stale=True)).encode()
+                    self._stale_body = (key, raw, _gzip.compress(raw, 6))
+                if _accepts_gzip(request.headers.get("Accept-Encoding", "")):
+                    body = self._stale_body[2]
+                    headers["Content-Encoding"] = "gzip"
+                else:
+                    body = self._stale_body[1]
+                return web.Response(
+                    body=body,
+                    content_type="application/json",
+                    headers={**headers, "Cache-Control": "no-cache"},
+                )
+        return _json_response(
+            {"error": f"overloaded: shed ({reason})", "retry_after_s": self.overload.retry_after},
+            status=503,
+            headers=headers,
+        )
+
+    @web.middleware
+    async def _admission(self, request: web.Request, handler):
+        """Admission control (tpudash.app.overload): a global concurrency
+        gate plus per-client token buckets, applied AFTER auth (shedding
+        serves cached frame data on /api/frame — that must stay behind
+        the bearer gate) and BEFORE any handler work.  /healthz, the
+        static shell, and the vendored bundle are never shed.  Admitted
+        requests carry a compute budget (``tpudash_deadline``) derived
+        from the refresh watchdog, so a request that queues past its
+        budget stops consuming refresh/compose time downstream."""
+        path = request.path
+        if path in _NEVER_SHED or path == "/" or path == PLOTLY_LOCAL_URL:
+            return await handler(request)
+        guard = self.overload
+        is_stream = path == "/api/stream"
+        # streams hold their slot for minutes: they pass the rate bucket
+        # here but are governed by max_streams, not the request gate
+        reason = guard.admit(guard.client_key(request), gate=not is_stream)
+        if reason is not None:
+            return self._shed_response(request, reason)
+        watchdog = self.service.cfg.refresh_watchdog
+        if watchdog and watchdog > 0:
+            # 2×: the budget must outlive one full watchdog window that
+            # STARTS mid-request (lock queueing first), or the stall
+            # verdict would always lose the race to the request budget
+            request["tpudash_deadline"] = time.monotonic() + 2.0 * watchdog
+        try:
+            return await handler(request)
+        finally:
+            if not is_stream:
+                guard.release()
 
     @web.middleware
     async def _compress(self, request: web.Request, handler):
@@ -1088,7 +1354,9 @@ class DashboardServer:
         return await handler(request)
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth, self._compress])
+        app = web.Application(
+            middlewares=[self._auth, self._admission, self._compress]
+        )
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/api/stream", self.stream)

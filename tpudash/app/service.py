@@ -212,6 +212,11 @@ class DashboardService:
         #: to ride the state checkpoint (the service owns the file, the
         #: server owns the sessions)
         self.sessions_snapshot: "object | None" = None
+        #: set by DashboardServer: () -> OverloadGuard.snapshot() — the
+        #: serving side's shed/evict state, folded into alert synthesis
+        #: (tpudash.app.overload).  None when no server owns this service
+        #: (CLI, bench, tests driving the service directly).
+        self.overload_provider: "object | None" = None
         items = self._restored_state_doc.get("silences")
         if items:
             # tpulint: allow[wall-clock] silence expiries are epoch stamps
@@ -730,6 +735,8 @@ class DashboardService:
         output so silences, the webhook pager, and the banner treat a
         quarantined slice exactly like a breaching chip.  Open/half-open
         breakers fire; a closed breaker mid-streak is pending."""
+        from tpudash.alerts import synthesized_alert
+
         ep_fn = getattr(self.source, "endpoint_health", None)
         if not callable(ep_fn):
             return []
@@ -740,25 +747,68 @@ class DashboardService:
             firing = s["state"] in ("open", "half_open")
             open_for = s.get("open_for_s")
             out.append(
-                {
-                    "rule": "endpoint_down",
-                    "column": "endpoint",
-                    "severity": "critical",
-                    "chip": label,
-                    "value": float(s["consecutive_failures"]),
-                    "threshold": float(s["failure_threshold"]),
-                    "state": "firing" if firing else "pending",
-                    "since": (
+                synthesized_alert(
+                    rule="endpoint_down",
+                    column="endpoint",
+                    severity="critical",
+                    chip=label,
+                    value=float(s["consecutive_failures"]),
+                    threshold=float(s["failure_threshold"]),
+                    firing=firing,
+                    since=(
                         round(now - open_for, 3)
                         if firing and open_for is not None
                         else None
                     ),
-                    "streak": s["consecutive_failures"],
-                    "breaker": s["state"],
-                    "detail": s.get("last_error"),
-                }
+                    streak=s["consecutive_failures"],
+                    detail=s.get("last_error"),
+                    breaker=s["state"],
+                )
             )
         return out
+
+    def _overload_alerts(self, now: float) -> list[dict]:
+        """Synthesized ``overload`` alert from the server's admission
+        guard — shaped like AlertEngine output (same contract as
+        ``endpoint_down``), so a dashboard shedding load pages the
+        webhook and shows on the banner like any other incident.
+        Shedding is a warning; a gate running full (saturated) is
+        critical.  Runs on the refresh executor thread: the guard's
+        snapshot() is read-only and thread-safe by design."""
+        provider = self.overload_provider
+        if provider is None:
+            return []
+        from tpudash.alerts import synthesized_alert
+
+        try:
+            snap = provider()
+        except Exception as e:  # noqa: BLE001 — observability is best-effort
+            log.warning("overload snapshot failed: %s", e)
+            return []
+        state = snap.get("state")
+        if state in (None, "normal"):
+            return []
+        recent = int(snap.get("recent_sheds", 0))
+        return [
+            synthesized_alert(
+                rule="overload",
+                column="server",
+                severity="critical" if state == "saturated" else "warning",
+                chip="server",
+                value=float(recent),
+                threshold=0.0,
+                firing=True,
+                since=round(now - float(snap.get("since_s", 0.0)), 3),
+                streak=recent,
+                detail=(
+                    f"server {state}: {recent} requests shed in the "
+                    f"shed window (inflight {snap.get('inflight')}, "
+                    f"streams {snap.get('streams')}, "
+                    f"total shed {snap.get('total_shed')})"
+                ),
+                overload=state,
+            )
+        ]
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -1290,25 +1340,28 @@ class DashboardService:
             log.warning("%s", err)
         self.last_error = err
         if self.alert_engine is not None:
-            # a partial outage that turns total must keep the endpoint
-            # alerts current even though no table was published; chip
-            # alerts from the last good frame stay (their chips didn't
-            # recover — we just can't see them)
+            # a partial outage that turns total must keep the synthesized
+            # (endpoint_down / overload) alerts current even though no
+            # table was published; chip alerts from the last good frame
+            # stay (their chips didn't recover — we just can't see them)
+            from tpudash.alerts import SYNTHESIZED_RULES
+
             # tpulint: allow[wall-clock] alert "since" stamps are epochs
-            ep = self._endpoint_alerts(time.time())
-            if ep or any(
-                a.get("rule") == "endpoint_down" for a in self.last_alerts
+            now_w = time.time()
+            synth = self._endpoint_alerts(now_w)
+            synth += self._overload_alerts(now_w)
+            if synth or any(
+                a.get("rule") in SYNTHESIZED_RULES for a in self.last_alerts
             ):
                 from tpudash.alerts import sort_alerts
 
                 kept = [
                     a
                     for a in self.last_alerts
-                    if a.get("rule") != "endpoint_down"
+                    if a.get("rule") not in SYNTHESIZED_RULES
                 ]
                 self.last_alerts = self.silences.annotate(
-                    # tpulint: allow[wall-clock] silence expiry comparison
-                    sort_alerts(kept + ep), time.time()
+                    sort_alerts(kept + synth), now_w
                 )
                 self._notify_alert_transitions()
         self._frame_open = False
@@ -1361,6 +1414,7 @@ class DashboardService:
                 now_w = time.time()
                 alerts = self.alert_engine.evaluate(df)
                 alerts += self._endpoint_alerts(now_w)
+                alerts += self._overload_alerts(now_w)
                 self.last_alerts = self.silences.annotate(
                     sort_alerts(alerts), now_w
                 )

@@ -114,6 +114,17 @@ class SessionStore:
         e.last_seen = now
         return e
 
+    def peek(self, sid: "str | None") -> "SessionEntry | None":
+        """Read-only lookup: the entry if it exists, else None — no
+        creation, no recency touch, no TTL sweep.  For observers that
+        must not perturb the store (tests asserting an evicted stream's
+        entry survived eviction).  Note the shed path's swarm-safety
+        does NOT come from here: ``_shed_response`` never touches the
+        store at all."""
+        if not sid:
+            return self.default
+        return self._entries.get(sid)
+
     def invalidate_all(self) -> None:
         """Bump every session's state version — global state (e.g. alert
         silences) changed, so every cached compose is stale."""

@@ -1,12 +1,15 @@
-"""``python -m tpudash.chaos`` — a one-command chaos drill.
+"""``python -m tpudash.chaos`` — one-command chaos drills.
 
-Serves the full dashboard over a 3-endpoint MultiSource of synthetic
-slices, each wrapped in ChaosSource, so every resilience layer is
-visible live on one laptop: per-endpoint circuit breakers opening and
-reclosing (watch ``/healthz`` → ``source_health.endpoints``), the
-``endpoint_down`` alert on the banner, partial-degradation warnings
-while the healthy slices keep rendering, and concurrent child fetches
-keeping the frame fast while one endpoint misbehaves.
+Two drills live here:
+
+**The breaker drill** (default, no arguments): serves the full dashboard
+over a 3-endpoint MultiSource of synthetic slices, each wrapped in
+ChaosSource, so every resilience layer is visible live on one laptop:
+per-endpoint circuit breakers opening and reclosing (watch ``/healthz``
+→ ``source_health.endpoints``), the ``endpoint_down`` alert on the
+banner, partial-degradation warnings while the healthy slices keep
+rendering, and concurrent child fetches keeping the frame fast while one
+endpoint misbehaves.
 
     python -m tpudash.chaos                      # the default drill
     TPUDASH_CHAOS='flap:period=4' python -m tpudash.chaos   # your scenario
@@ -17,11 +20,38 @@ lossy (latency + transient errors + one dropped chip).  A custom
 ``TPUDASH_CHAOS`` scenario replaces the per-endpoint defaults and is
 applied to endpoints ``chaos-b`` and ``chaos-c`` (``chaos-a`` stays
 healthy as the control, so the page always renders something).
+
+**The overload drill** (``python -m tpudash.chaos overload``): a
+client-swarm soak against the SERVING side's overload protection
+(tpudash.app.overload).  It boots the dashboard in-process over a
+chaos-latency synthetic source with aggressive shedding knobs, then
+drives N concurrent synthetic clients over ``/api/frame``,
+``/api/stream``, and ``/api/select`` — including deliberately-stalled
+SSE consumers — and asserts the overload contract end to end:
+
+- excess requests shed with ``503`` + ``Retry-After``;
+- ``GET /api/frame`` degrades to the last published frame with
+  ``stale: true`` instead of erroring;
+- slow consumers blocking an SSE write past
+  ``TPUDASH_SSE_WRITE_DEADLINE`` are evicted;
+- ``/healthz`` keeps answering in under a second throughout;
+- zero unhandled exceptions in the server logs;
+- shed/evict counters visible in ``/api/timings``.
+
+    python -m tpudash.chaos overload --clients 100 --seconds 10
+
+Exit status 0 = every invariant held; 1 = the printed JSON names what
+didn't.  CI runs this on every PR (chaos-soak job).
 """
 
 from __future__ import annotations
 
+import asyncio
+import dataclasses
+import json
 import logging
+import sys
+import time
 
 from tpudash.config import Config, configure_logging, env_is_set, load_config
 
@@ -35,6 +65,25 @@ DEFAULT_DRILL = {
         "latency:p=0.5,ms=300;error:p=0.25;"
         "drop_chip:slice=chaos-c,chip=3;seed=2"
     ),
+}
+
+#: the overload drill's source scenario: every fetch pays dispersed
+#: latency, so refreshes are slow and requests genuinely pile up behind
+#: the frame lock (jittered so the pileup isn't metronomic)
+OVERLOAD_SCENARIO = "latency:p=0.8,ms=200,jitter=150;seed=7"
+
+#: drill knobs applied unless the operator set the env var — aggressive
+#: enough that a 100-client swarm visibly sheds within seconds
+_OVERLOAD_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.5),
+    "TPUDASH_REFRESH_WATCHDOG": ("refresh_watchdog", 2.0),
+    "TPUDASH_MAX_CONCURRENCY": ("max_concurrency", 16),
+    "TPUDASH_RATE_LIMIT": ("rate_limit", 2.0),
+    "TPUDASH_RATE_BURST": ("rate_burst", 4.0),
+    "TPUDASH_MAX_STREAMS": ("max_streams", 24),
+    "TPUDASH_SSE_WRITE_DEADLINE": ("sse_write_deadline", 1.0),
+    "TPUDASH_SHED_RETRY_AFTER": ("shed_retry_after", 1.0),
+    "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 128),
 }
 
 
@@ -72,21 +121,339 @@ def make_chaos_app(cfg: Config | None = None):
     # transitions are watchable within a coffee's attention span (env
     # overrides still win — load_config already applied them)
     if not env_is_set("TPUDASH_BREAKER_COOLDOWN"):
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, breaker_cooldown=10.0)
     if not env_is_set("TPUDASH_MULTI_DEADLINE"):
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, multi_deadline=1.0)
     service = DashboardService(cfg, chaos_demo_source(cfg))
     return DashboardServer(service).build_app(), cfg
 
 
-def main() -> None:  # pragma: no cover - blocking entry
-    from aiohttp import web
+# ---------------------------------------------------------------------------
+# Overload drill — a client swarm against the admission/shedding layer.
+# ---------------------------------------------------------------------------
+
+
+def make_overload_server(cfg: Config | None = None):
+    """(DashboardServer, cfg) under drill knobs: a chaos-latency synthetic
+    source plus shedding limits a 100-client swarm will actually hit.
+    Explicit env settings win over every drill default."""
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.chaos import ChaosSource
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = cfg or load_config()
+    for env_name, (field, value) in _OVERLOAD_KNOBS.items():
+        if not env_is_set(env_name):
+            cfg = dataclasses.replace(cfg, **{field: value})
+    inner = SyntheticSource(
+        num_chips=min(cfg.synthetic_chips, 128), generation=cfg.generation
+    )
+    source = ChaosSource(inner, cfg.chaos or OVERLOAD_SCENARIO)
+    return DashboardServer(DashboardService(cfg, source)), cfg
+
+
+class _ErrorTrap(logging.Handler):
+    """Collects ERROR+ records — the drill's "zero unhandled exceptions
+    in server logs" check reads these (aiohttp logs every handler
+    traceback as ERROR on 'aiohttp.server')."""
+
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.records: list = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.records.append(self.format(record))
+
+
+async def _stalled_stream(host: str, port: int, sid: str, stop: asyncio.Event):
+    """A deliberately-slow SSE consumer: tiny receive buffer, reads a few
+    KB of the first event, then stops draining entirely — the shape of a
+    wedged dashboard tab the write deadline must evict."""
+    import socket as socketmod
+
+    sock = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+    sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_RCVBUF, 4096)
+    sock.setblocking(False)
+    loop = asyncio.get_running_loop()
+    writer = None
+    try:
+        await loop.sock_connect(sock, (host, port))
+        # limit=2048: asyncio's default StreamReader otherwise buffers
+        # ~128KB in user space before pausing the transport — the "slow"
+        # consumer would silently absorb many events instead of stalling
+        reader, writer = await asyncio.open_connection(sock=sock, limit=2048)
+        writer.write(
+            (
+                f"GET /api/stream HTTP/1.0\r\nHost: {host}\r\n"
+                f"Cookie: tpudash_sid={sid}\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        await asyncio.wait_for(reader.read(2048), timeout=10)  # first bytes
+        await stop.wait()  # ...then never drain again
+    except (OSError, asyncio.TimeoutError):
+        pass  # the server evicting us closes the pipe — expected
+    finally:
+        if writer is not None:
+            writer.close()
+        else:
+            sock.close()
+
+
+async def run_overload_drill(
+    clients: int = 100, seconds: float = 10.0, cfg: Config | None = None
+) -> dict:
+    """Drive the swarm; return a JSON-able summary with ``ok`` and the
+    list of violated invariants (empty when the drill passes)."""
+    from aiohttp import ClientSession, web
+
+    server, cfg = make_overload_server(cfg)
+    app = server.build_app()
+
+    # Small per-connection output buffers on the stream route ONLY inside
+    # the drill: localhost sockets otherwise absorb megabytes, and the
+    # point is to prove eviction, not to wait out kernel buffers.
+    import socket as socketmod
+
+    async def _tiny_stream_buffers(request, response):
+        if request.path != "/api/stream" or request.transport is None:
+            return
+        sock = request.transport.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_SNDBUF, 8192)
+        request.transport.set_write_buffer_limits(high=8192)
+
+    app.on_response_prepare.append(_tiny_stream_buffers)
+
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    host, port = runner.addresses[0][:2]
+    base = f"http://{host}:{port}"
+
+    stop = asyncio.Event()
+    stats = {
+        "ok_200": 0,
+        "not_modified_304": 0,
+        "shed_503": 0,
+        "shed_with_retry_after": 0,
+        "stale_frames": 0,
+        "select_ok": 0,
+        "stream_events": 0,
+        "healthz_probes": 0,
+        "healthz_failures": 0,
+        "healthz_max_ms": 0.0,
+    }
+
+    from aiohttp import ClientError
+
+    async def hammer(session: ClientSession, sid: str):
+        cookies = {"tpudash_sid": sid}
+        while not stop.is_set():
+            try:
+                async with session.get(
+                    f"{base}/api/frame", cookies=cookies
+                ) as r:
+                    if r.status == 200:
+                        body = await r.json()
+                        if body.get("stale"):
+                            stats["stale_frames"] += 1
+                        else:
+                            stats["ok_200"] += 1
+                    elif r.status == 304:
+                        stats["not_modified_304"] += 1
+                    elif r.status == 503:
+                        stats["shed_503"] += 1
+                        if r.headers.get("Retry-After"):
+                            stats["shed_with_retry_after"] += 1
+                async with session.post(
+                    f"{base}/api/select",
+                    json={"toggle": "slice-0/1"},
+                    cookies=cookies,
+                ) as r:
+                    if r.status == 200:
+                        stats["select_ok"] += 1
+                    elif r.status == 503:
+                        stats["shed_503"] += 1
+                        if r.headers.get("Retry-After"):
+                            stats["shed_with_retry_after"] += 1
+            except (OSError, ClientError):
+                # a shed/reset/server-closed connection is the drill
+                # working — the hammer client must keep hammering, not
+                # die and silently thin the swarm (ClientError covers
+                # aiohttp spellings like ServerDisconnectedError that
+                # are NOT OSError subclasses)
+                pass
+            await asyncio.sleep(0)
+
+    async def stream_reader(session: ClientSession, sid: str):
+        try:
+            async with session.get(
+                f"{base}/api/stream", cookies={"tpudash_sid": sid}
+            ) as r:
+                if r.status == 503:
+                    stats["shed_503"] += 1
+                    if r.headers.get("Retry-After"):
+                        stats["shed_with_retry_after"] += 1
+                    return
+                async for _line in r.content:
+                    stats["stream_events"] += 1
+                    if stop.is_set():
+                        return
+        except (OSError, ClientError, asyncio.TimeoutError):
+            pass
+
+    async def healthz_probe(session: ClientSession):
+        # every probe is bounded and every failure is RECORDED: a hung
+        # /healthz must fail the drill's <1s invariant, not block this
+        # coroutine until teardown with healthz_max_ms frozen at its
+        # last good value
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                async def probe():
+                    async with session.get(f"{base}/healthz") as r:
+                        await r.json()
+                        return r.status
+
+                status = await asyncio.wait_for(probe(), timeout=1.0)
+                if status != 200:
+                    stats["healthz_failures"] += 1
+                ms = (time.monotonic() - t0) * 1e3
+                stats["healthz_max_ms"] = max(stats["healthz_max_ms"], ms)
+            except asyncio.TimeoutError:
+                stats["healthz_failures"] += 1
+                stats["healthz_max_ms"] = max(
+                    stats["healthz_max_ms"], 1000.0
+                )
+            except (OSError, ClientError):
+                stats["healthz_failures"] += 1
+            stats["healthz_probes"] += 1
+            await asyncio.sleep(0.25)
+
+    # role split that stays sane at any --clients value: stalled and
+    # stream roles never eat the whole budget, and at least one hammer
+    # client always exists (without hammerers nothing sheds and the
+    # drill would fail with a misleading "no sheds observed")
+    clients = max(4, clients)
+    n_stalled = min(max(2, clients // 20), clients // 4)
+    n_streams = min(max(4, clients // 5), clients // 2)
+    n_hammer = max(1, clients - n_stalled - n_streams)
+    async with ClientSession() as session:
+        # stalled consumers pre-select everything so their frames are big
+        # enough to fill the (shrunken) buffers within a tick or two
+        for i in range(n_stalled):
+            try:
+                await session.post(
+                    f"{base}/api/select",
+                    json={"all": True},
+                    cookies={"tpudash_sid": f"stall-{i}"},
+                )
+            except OSError:
+                pass
+        # Phase A — attach the streams (including the stalled consumers)
+        # and let them receive their first event BEFORE the hammer storm:
+        # a slow consumer in the wild is a tab that attached while things
+        # were calm and then wedged, and the warmup keeps the eviction
+        # proof from racing 100 hammer clients for the frame lock.
+        tasks = [
+            asyncio.ensure_future(healthz_probe(session)),
+            *(
+                asyncio.ensure_future(
+                    _stalled_stream(host, port, f"stall-{i}", stop)
+                )
+                for i in range(n_stalled)
+            ),
+            *(
+                asyncio.ensure_future(
+                    stream_reader(session, f"swarm-{i}")
+                )
+                for i in range(n_streams)
+            ),
+        ]
+        await asyncio.sleep(min(3.0, max(1.0, seconds / 3.0)))
+        # Phase B — the swarm
+        tasks += [
+            asyncio.ensure_future(hammer(session, f"swarm-{i}"))
+            for i in range(n_hammer)
+        ]
+        await asyncio.sleep(seconds)
+        stop.set()
+        await asyncio.wait(tasks, timeout=10)
+        for t in tasks:
+            t.cancel()
+        # /healthz and /api/timings still answer after the storm, and the
+        # counters the runbook points at are actually there
+        async with session.get(f"{base}/healthz") as r:
+            health = await r.json()
+        async with session.get(f"{base}/api/timings") as r:
+            timings = await r.json()
+    await runner.cleanup()
+    logging.getLogger().removeHandler(trap)
+
+    snap = server.overload.snapshot()
+    failures = []
+    if stats["shed_503"] == 0 or stats["shed_with_retry_after"] == 0:
+        failures.append("no 503+Retry-After sheds observed")
+    if stats["stale_frames"] == 0:
+        failures.append("no stale:true degraded frames served")
+    if snap["counters"]["evicted_slow_consumers"] == 0:
+        failures.append("no slow consumers evicted by the write deadline")
+    if stats["healthz_max_ms"] >= 1000.0 or stats["healthz_failures"] > 0:
+        failures.append(
+            f"healthz degraded: max {stats['healthz_max_ms']:.0f}ms, "
+            f"{stats['healthz_failures']} failed/hung probe(s)"
+        )
+    if "overload" not in timings or "counters" not in timings["overload"]:
+        failures.append("/api/timings lost the overload counters")
+    if health.get("ok") is not True:
+        failures.append("healthz ok flapped under load")
+    if trap.records:
+        failures.append(
+            f"{len(trap.records)} unhandled server exception(s): "
+            + trap.records[0][:500]
+        )
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "clients": clients,
+        "seconds": seconds,
+        "requests": stats,
+        "overload": snap,
+        "healthz_status": health.get("status"),
+        "limits": snap["limits"],
+    }
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudash.chaos",
+        description="chaos drills (default: live breaker drill server)",
+    )
+    sub = parser.add_subparsers(dest="mode")
+    ov = sub.add_parser(
+        "overload", help="client-swarm overload/load-shedding soak"
+    )
+    ov.add_argument("--clients", type=int, default=100)
+    ov.add_argument("--seconds", type=float, default=10.0)
+    args = parser.parse_args(argv)
 
     configure_logging()
+    if args.mode == "overload":
+        summary = asyncio.run(
+            run_overload_drill(clients=args.clients, seconds=args.seconds)
+        )
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+
+    from aiohttp import web
+
     app, cfg = make_chaos_app()
     log.info(
         "chaos drill on :%d — endpoints %s; watch /healthz "
@@ -94,7 +461,7 @@ def main() -> None:  # pragma: no cover - blocking entry
         cfg.port,
         ", ".join(DEFAULT_DRILL),
     )
-    web.run_app(app, host=cfg.host, port=cfg.port)
+    web.run_app(app, host=cfg.host, port=cfg.port)  # pragma: no cover
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -184,6 +184,33 @@ class Config:
     #: never set it on the production dashboard by accident.
     chaos: str = ""
 
+    # --- overload protection (admission control & load shedding) ------------
+    #: Global cap on concurrently-served HTTP requests (long-lived SSE
+    #: streams are governed separately by ``max_streams``).  Excess
+    #: requests are shed with ``503`` + ``Retry-After`` — except
+    #: ``GET /api/frame``, which degrades to the last published frame
+    #: with a ``stale: true`` marker, and ``/healthz``, which is never
+    #: shed.  0 disables the gate.
+    max_concurrency: int = 64
+    #: Per-client steady-state admission rate, requests/second, keyed by
+    #: the session cookie (falling back to peer address).  0 disables
+    #: rate limiting; the concurrency gate and stream cap still apply.
+    rate_limit: float = 0.0
+    #: Token-bucket burst capacity per client (0 → 2 × rate_limit).
+    rate_burst: float = 0.0
+    #: Cap on concurrently-open SSE streams (``/api/stream``).  At the
+    #: cap new streams are shed with ``503`` + ``Retry-After``; existing
+    #: streams are untouched.  0 disables the cap.
+    max_streams: int = 64
+    #: Per-event SSE write deadline, seconds: a consumer that blocks one
+    #: ``write`` past this (stalled TCP peer pinning a compressor and a
+    #: session entry) is evicted — a reconnect resumes via its
+    #: ``Last-Event-ID`` delta path.  0 disables eviction.
+    sse_write_deadline: float = 15.0
+    #: ``Retry-After`` seconds advertised on shed (503) responses.
+    #: 0 → derived from refresh_interval (minimum 1 s).
+    shed_retry_after: float = 0.0
+
     extra: dict = field(default_factory=dict)
 
 
@@ -222,6 +249,12 @@ _ENV_MAP = {
     "breaker_failures": "TPUDASH_BREAKER_FAILURES",
     "breaker_cooldown": "TPUDASH_BREAKER_COOLDOWN",
     "chaos": "TPUDASH_CHAOS",
+    "max_concurrency": "TPUDASH_MAX_CONCURRENCY",
+    "rate_limit": "TPUDASH_RATE_LIMIT",
+    "rate_burst": "TPUDASH_RATE_BURST",
+    "max_streams": "TPUDASH_MAX_STREAMS",
+    "sse_write_deadline": "TPUDASH_SSE_WRITE_DEADLINE",
+    "shed_retry_after": "TPUDASH_SHED_RETRY_AFTER",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",

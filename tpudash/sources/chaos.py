@@ -12,6 +12,9 @@ Scenario grammar (``TPUDASH_CHAOS``): semicolon-separated directives,
 each ``name:key=value,key=value``:
 
     latency:p=0.3,ms=800        # with prob p, delay the fetch by ms
+    latency:p=1,ms=200,jitter=150   # + uniform extra delay in [0, jitter]
+                                # ms (dispersed latencies — overload
+                                # drills need non-metronomic pileups)
     error:p=0.5                 # with prob p, raise a transient SourceError
     hang:p=0.1,ms=3000          # with prob p, block ms (bounded), then fail
     flap:period=6               # scripted up/down: the 2nd half of every
@@ -62,6 +65,9 @@ class ChaosScenario:
     seed: int = 0
     latency_p: float = 0.0
     latency_ms: float = 0.0
+    #: extra uniform delay in [0, jitter_ms] on top of latency_ms — the
+    #: seeded RNG keeps the sequence replayable
+    latency_jitter_ms: float = 0.0
     error_p: float = 0.0
     hang_p: float = 0.0
     hang_ms: float = 0.0
@@ -106,6 +112,9 @@ class ChaosScenario:
                 if name == "latency":
                     kwargs["latency_p"] = float(args.get("p", 1.0))
                     kwargs["latency_ms"] = float(args["ms"])
+                    kwargs["latency_jitter_ms"] = float(
+                        args.get("jitter", 0.0)
+                    )
                 elif name == "error":
                     kwargs["error_p"] = float(args.get("p", 1.0))
                 elif name == "hang":
@@ -186,7 +195,10 @@ class ChaosSource(MetricsSource):
             raise SourceError(f"chaos: endpoint hung {hang_s:g}s (bounded)")
         if sc.latency_p and rng.random() < sc.latency_p:
             self.injected["latency"] += 1
-            self._sleep(sc.latency_ms / 1000.0)
+            delay_ms = sc.latency_ms
+            if sc.latency_jitter_ms:
+                delay_ms += rng.random() * sc.latency_jitter_ms
+            self._sleep(delay_ms / 1000.0)
         if sc.error_p and rng.random() < sc.error_p:
             self.injected["error"] += 1
             raise SourceError("chaos: injected transient error")
