@@ -47,3 +47,30 @@ if os.environ.get("TPUDASH_RACECHECK", "").strip() not in ("", "0"):
         finally:
             rc.uninstall()
         rc.assert_clean()
+
+
+# -- runtime event-loop lag sanitizer (TPUDASH_LOOPCHECK=1) -------------------
+# Every test runs inside a LoopLagMonitor window: every asyncio callback
+# in any loop the test drives is timed, and the test FAILS if one exceeds
+# the TPUDASH_LOOP_LAG_BUDGET (ms, default 250) — with the stack that was
+# executing while it blocked.  CI's static-analysis and chaos-soak jobs
+# run the concurrency/overload suites in this mode; locally:
+# TPUDASH_LOOPCHECK=1 python -m pytest tests/test_overload.py ...
+# Tests that PLANT blocking callbacks on purpose opt out with
+# @pytest.mark.loopcheck_exempt.
+if os.environ.get("TPUDASH_LOOPCHECK", "").strip() not in ("", "0"):
+    import pytest  # noqa: E402, F811
+
+    @pytest.fixture(autouse=True)
+    def _loopcheck(request):
+        if request.node.get_closest_marker("loopcheck_exempt"):
+            yield
+            return
+        from tpudash.analysis.asynccheck import LoopLagMonitor
+
+        mon = LoopLagMonitor.from_env().install()
+        try:
+            yield
+        finally:
+            mon.uninstall()
+        mon.assert_flat()

@@ -1,0 +1,631 @@
+"""The event-loop analyzer analyzed: every asynccheck static rule proven
+on known-bad and known-good fixtures (including interprocedural
+resolution through helpers, methods, and cross-boundary executor
+dispatch), the allow mechanism exercised, a planted blocking handler
+caught end-to-end through the CLI, the runtime loop-lag monitor shown to
+fire on a planted ``time.sleep`` on the loop — with stack attribution —
+and shown quiet over the real dashboard stack, whose ``loop_lag_ms``
+counters surface on ``/api/timings`` and ``/healthz``.
+
+Acceptance contract (ISSUE 4): introducing any known-bad fixture below
+into the package would make ``python -m tpudash.analysis.asynccheck``
+exit non-zero naming the rule and file:line; the shipped tree checks
+clean; ``python -m tpudash.analysis`` runs both analyzers with distinct
+exit codes and a ``--json`` report.
+"""
+
+import asyncio
+import json
+import textwrap
+import time
+
+import pytest
+
+from tpudash.analysis.asynccheck import (
+    RULE_ASYNC_BLOCKING,
+    RULE_AWAIT_LOCK,
+    RULE_UNRETAINED,
+    LoopLagMonitor,
+    check_paths,
+    check_source,
+    main as asynccheck_main,
+)
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def check(source, path="pkg/tpudash/mod.py"):
+    return check_source(textwrap.dedent(source), path)
+
+
+# -- rule: async-blocking (direct) --------------------------------------------
+
+def test_blocking_flags_direct_sleep_in_async_def():
+    findings = check(
+        """
+        import time
+        async def handler(request):
+            time.sleep(1)
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+    assert findings[0].line == 4
+    assert "time.sleep" in findings[0].message
+
+
+def test_blocking_flags_file_io_compression_subprocess_and_locks():
+    bad = [
+        "async def f():\n    data = open('x').read()\n",
+        "import gzip\nasync def f(raw):\n    return gzip.compress(raw)\n",
+        "import zlib\nasync def f(raw):\n    return zlib.decompress(raw)\n",
+        "import requests\nasync def f():\n    requests.get('http://x')\n",
+        "import subprocess\nasync def f():\n    subprocess.run(['ls'])\n",
+        "import shutil\nasync def f(d):\n    shutil.rmtree(d)\n",
+        "import tempfile\nasync def f():\n    return tempfile.mkdtemp()\n",
+        "import os\nasync def f(a, b):\n    os.replace(a, b)\n",
+        "async def f(self):\n    self._publish_lock.acquire()\n",
+        (
+            "import socket\n"
+            "async def f():\n"
+            "    socket.create_connection(('h', 80))\n"
+        ),
+    ]
+    for source in bad:
+        assert RULE_ASYNC_BLOCKING in rules_of(check(source)), source
+
+
+def test_blocking_passes_cheap_and_async_apis():
+    good = [
+        # monotonic/asyncio/json are loop-safe
+        "import time, asyncio\nasync def f():\n    t = time.monotonic()\n    await asyncio.sleep(0)\n",
+        # socket CONSTRUCTOR is instant; only the blocking calls flag
+        "import socket\nasync def f():\n    s = socket.socket()\n    s.setblocking(False)\n",
+        # sync function: sleep off the loop is fine
+        "import time\ndef worker():\n    time.sleep(1)\n",
+        # zlib.compressobj is a constructor, not a compression pass
+        "import zlib\nasync def f():\n    c = zlib.compressobj(6)\n",
+    ]
+    for source in good:
+        assert check(source) == [], source
+
+
+# -- rule: async-blocking (interprocedural) -----------------------------------
+
+def test_blocking_reachable_through_sync_helper():
+    findings = check(
+        """
+        import time
+        def helper():
+            time.sleep(1)
+        async def handler(request):
+            helper()
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+    assert findings[0].line == 4  # reported AT the blocking site
+    assert "via helper" in findings[0].message
+
+
+def test_blocking_reachable_through_self_method_and_nested_def():
+    findings = check(
+        """
+        import time
+        class Server:
+            def _save(self):
+                time.sleep(1)
+            async def handler(self, request):
+                self._save()
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+    assert "Server._save" in findings[0].message
+    findings = check(
+        """
+        import time
+        async def handler(request):
+            def inner():
+                time.sleep(1)
+            inner()
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+
+
+def test_blocking_excluded_behind_executor_boundaries():
+    good = [
+        # the canonical offload: args of run_in_executor run on a thread
+        (
+            "import time, asyncio\n"
+            "def fetch():\n"
+            "    time.sleep(1)\n"
+            "async def handler(request):\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    await loop.run_in_executor(None, fetch)\n"
+        ),
+        (
+            "import time, asyncio\n"
+            "async def handler(request):\n"
+            "    await asyncio.to_thread(time.sleep, 1)\n"
+        ),
+        # a lambda payload is executor-side too
+        (
+            "import asyncio\n"
+            "async def handler(request):\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    await loop.run_in_executor(None, lambda: open('x').read())\n"
+        ),
+        # Thread targets run off the loop
+        (
+            "import threading, time\n"
+            "def job():\n"
+            "    time.sleep(1)\n"
+            "async def handler(request):\n"
+            "    threading.Thread(target=job, daemon=True).start()\n"
+        ),
+        # a nested def that is only ever PASSED to the executor
+        (
+            "import time, asyncio\n"
+            "async def handler(request):\n"
+            "    def capture():\n"
+            "        time.sleep(1)\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    await loop.run_in_executor(None, capture)\n"
+        ),
+    ]
+    for source in good:
+        assert check(source) == [], source
+
+
+def test_blocking_allow_marker_inline_and_on_def_header():
+    assert check(
+        """
+        import time
+        async def handler(request):
+            time.sleep(0.01)  # tpulint: allow[async-blocking] drill pacing
+        """
+    ) == []
+    assert check(
+        """
+        import time
+        # tpulint: allow[async-blocking] startup-only path, loop not serving yet
+        def helper():
+            time.sleep(1)
+        async def handler(request):
+            helper()
+        """
+    ) == []
+
+
+def test_blocking_flags_sync_with_lock_reachable_from_async():
+    # directly in the async def
+    findings = check(
+        """
+        async def handler(self):
+            with self._publish_lock:
+                self.count += 1
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+    assert "with self._publish_lock" in findings[0].message
+    # through a sync helper method
+    findings = check(
+        """
+        class Server:
+            def _bump(self):
+                with self._state_lock:
+                    self.count += 1
+            async def handler(self, request):
+                self._bump()
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]
+    # the same helper UNREACHABLE from async context is fine
+    assert check(
+        """
+        class Service:
+            def _bump(self):
+                with self._state_lock:
+                    self.count += 1
+            def refresh(self):
+                self._bump()
+        """
+    ) == []
+
+
+def test_blocking_deduped_across_multiple_async_roots():
+    findings = check(
+        """
+        import time
+        def helper():
+            time.sleep(1)
+        async def a():
+            helper()
+        async def b():
+            helper()
+        """
+    )
+    assert rules_of(findings) == [RULE_ASYNC_BLOCKING]  # one site, one finding
+
+
+# -- rule: await-under-lock ---------------------------------------------------
+
+def test_await_under_sync_lock_flagged():
+    findings = check(
+        """
+        import asyncio
+        async def handler(self):
+            with self._publish_lock:
+                await asyncio.sleep(1)
+        """
+    )
+    assert RULE_AWAIT_LOCK in rules_of(findings)
+    assert findings[0].line == 4  # anchored at the with header
+    assert "suspension point at line 5" in findings[0].message
+
+
+def test_async_with_and_async_for_count_as_suspension_points():
+    """`async with` suspends at __aenter__ and `async for` at __anext__
+    — holding a sync threading lock across either is the same deadlock
+    as an explicit await."""
+    findings = check(
+        """
+        async def handler(self, session, url):
+            with self._publish_lock:
+                async with session.get(url) as r:
+                    return await r.json()
+        """
+    )
+    assert RULE_AWAIT_LOCK in rules_of(findings)
+    findings = check(
+        """
+        async def handler(self, stream):
+            with self._publish_lock:
+                async for item in stream:
+                    self.items.append(item)
+        """
+    )
+    assert RULE_AWAIT_LOCK in rules_of(findings)
+
+
+def test_await_under_lock_good_shapes_pass():
+    good = [
+        # async with an asyncio lock is the correct pattern
+        "async def f(self):\n    async with self._lock:\n        await g()\nasync def g():\n    pass\n",
+        # sync with, no await inside: brief lexical hold (async-blocking
+        # governs the acquire itself only when the name resolves)
+        "async def f(self, items):\n    with self.ctx():\n        items.append(1)\n",
+        # the await lives in a nested def that does NOT run under the lock
+        (
+            "async def f(self):\n"
+            "    with self._publish_lock:\n"
+            "        async def later():\n"
+            "            await g()\n"
+            "    return later\n"
+            "async def g():\n"
+            "    pass\n"
+        ),
+    ]
+    for source in good:
+        findings = check(source)
+        assert RULE_AWAIT_LOCK not in rules_of(findings), source
+
+
+def test_await_under_lock_allow_marker():
+    assert check(
+        """
+        import asyncio
+        async def handler(self):
+            with self._init_lock:  # tpulint: allow[await-under-lock] held only before serving starts
+                await asyncio.sleep(0)
+        """
+    ) == []
+
+
+# -- rule: unretained-task ----------------------------------------------------
+
+def test_unretained_task_flagged_for_bare_spawns():
+    for spawn in (
+        "asyncio.create_task(job())",
+        "asyncio.ensure_future(job())",
+        "loop.create_task(job())",
+    ):
+        findings = check(
+            f"""
+            import asyncio
+            async def job():
+                pass
+            async def main(loop):
+                {spawn}
+            """
+        )
+        assert RULE_UNRETAINED in rules_of(findings), spawn
+        assert findings[0].line == 6
+
+
+def test_unretained_task_retained_shapes_pass():
+    good = [
+        # assigned
+        "import asyncio\nasync def job():\n    pass\nasync def main():\n    t = asyncio.create_task(job())\n    await t\n",
+        # collected into a structure (the chaos drill's shape)
+        (
+            "import asyncio\n"
+            "async def job(i):\n"
+            "    pass\n"
+            "async def main():\n"
+            "    tasks = [asyncio.ensure_future(job(i)) for i in range(3)]\n"
+            "    await asyncio.wait(tasks)\n"
+        ),
+        # stored in app state (the exporter warmup's shape)
+        "import asyncio\nasync def job():\n    pass\nasync def main(app, key):\n    app[key] = asyncio.create_task(job())\n",
+        # done-callback chained: exceptions have somewhere to go
+        "import asyncio\nasync def job():\n    pass\nasync def main(cb):\n    asyncio.create_task(job()).add_done_callback(cb)\n",
+    ]
+    for source in good:
+        findings = check(source)
+        assert RULE_UNRETAINED not in rules_of(findings), source
+
+
+def test_unretained_task_allow_marker():
+    assert check(
+        """
+        import asyncio
+        async def job():
+            pass
+        async def main():
+            asyncio.create_task(job())  # tpulint: allow[unretained-task] process-lifetime daemon
+        """
+    ) == []
+
+
+# -- the shipped tree is clean / planted bugs are caught ----------------------
+
+def test_package_checks_clean():
+    """The acceptance gate: the real package — zero findings.  Identical
+    to CI's ``python -m tpudash.analysis.asynccheck tpudash/``."""
+    import os
+
+    import tpudash
+
+    pkg = os.path.dirname(os.path.abspath(tpudash.__file__))
+    assert asynccheck_main([pkg]) == 0
+
+
+def test_planted_blocking_handler_caught_end_to_end(tmp_path):
+    """A blocking call smuggled into an async handler through a sync
+    helper fails the CLI, naming rule and file:line."""
+    bad = tmp_path / "srv.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            import time
+            def _helper():
+                time.sleep(1)
+            async def handler(request):
+                _helper()
+            """
+        )
+    )
+    assert asynccheck_main([str(tmp_path)]) == 1
+    findings = check_paths([str(tmp_path)])
+    assert findings and findings[0].rule == RULE_ASYNC_BLOCKING
+    assert findings[0].path == str(bad) and findings[0].line == 4
+
+
+def test_cli_refuses_paths_that_scan_nothing(tmp_path):
+    assert asynccheck_main([str(tmp_path / "no_such_dir")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert asynccheck_main([str(empty)]) == 2
+
+
+# -- unified CLI: python -m tpudash.analysis ----------------------------------
+
+def test_unified_cli_distinct_exit_codes(tmp_path):
+    from tpudash.analysis.cli import main as analysis_main
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("import time\nt = time.monotonic()\n")
+    assert analysis_main([str(clean)]) == 0
+
+    lint_only = tmp_path / "lint_only"
+    lint_only.mkdir()
+    (lint_only / "bad.py").write_text("import time\nd = time.time() + 5\n")
+    assert analysis_main([str(lint_only)]) == 1
+
+    async_only = tmp_path / "async_only"
+    async_only.mkdir()
+    (async_only / "bad.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n"
+    )
+    assert analysis_main([str(async_only)]) == 2
+
+    both = tmp_path / "both"
+    both.mkdir()
+    (both / "bad.py").write_text(
+        "import time\nd = time.time() + 5\n"
+        "async def f():\n    time.sleep(1)\n"
+    )
+    assert analysis_main([str(both)]) == 3
+
+    assert analysis_main([str(tmp_path / "no_such_dir")]) == 4
+
+
+def test_unified_cli_json_report(tmp_path, capsys):
+    from tpudash.analysis.cli import main as analysis_main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\nd = time.time() + 5\n"
+        "async def f():\n    time.sleep(1)\n"
+    )
+    code = analysis_main([str(tmp_path), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert code == 3
+    assert report["version"] == 1 and report["clean"] is False
+    assert report["counts"]["tpulint"] >= 1
+    assert report["counts"]["asynccheck"] == 1
+    for f in report["findings"]:
+        assert set(f) == {"analyzer", "rule", "file", "line", "message"}
+    rules = {(f["analyzer"], f["rule"]) for f in report["findings"]}
+    assert ("tpulint", "wall-clock") in rules
+    assert ("asynccheck", RULE_ASYNC_BLOCKING) in rules
+
+
+def test_unified_cli_clean_on_the_package(capsys):
+    """CI's artifact step: the shipped tree produces a clean report."""
+    import os
+
+    import tpudash
+    from tpudash.analysis.cli import main as analysis_main
+
+    pkg = os.path.dirname(os.path.abspath(tpudash.__file__))
+    code = analysis_main([pkg, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert report["clean"] is True and report["findings"] == []
+
+
+# -- runtime: the loop-lag monitor --------------------------------------------
+
+@pytest.mark.loopcheck_exempt
+def test_monitor_fires_on_planted_blocking_callback():
+    """A coroutine that calls time.sleep ON the loop must be recorded
+    over budget, with the in-flight stack naming the blocking line, and
+    the heartbeat must observe the lag."""
+    mon = LoopLagMonitor(budget_ms=50, tick=0.02, sample_every=0.005)
+
+    async def main():
+        hb = asyncio.create_task(mon.run())
+        await asyncio.sleep(0.06)  # a clean heartbeat or two first
+        time.sleep(0.3)  # the planted block — the whole loop stalls
+        await asyncio.sleep(0.06)
+        hb.cancel()
+
+    with mon:
+        asyncio.run(main())
+    assert mon.slow_total >= 1
+    summary = mon.summary()
+    assert summary["slow_callbacks"] == mon.slow_total
+    assert summary["max"] is not None and summary["max"] > 50
+    # the watchdog sampled the stack WHILE the callback was blocked
+    stacks = "".join(e["stack"] or "" for e in mon.slow)
+    assert "time.sleep(0.3)" in stacks
+    with pytest.raises(AssertionError, match="exceeded the 50ms budget"):
+        mon.assert_flat()
+
+
+@pytest.mark.loopcheck_exempt
+def test_monitor_fires_on_planted_blocking_http_handler():
+    """End-to-end shape from the issue: a time.sleep planted in an
+    aiohttp handler trips the monitor while the request is served."""
+    from aiohttp import ClientSession, web
+
+    mon = LoopLagMonitor(budget_ms=50, tick=0.02, sample_every=0.005)
+
+    async def bad_handler(request):
+        time.sleep(0.2)  # blocking ON the loop — the planted bug
+        return web.json_response({"ok": True})
+
+    async def main():
+        app = web.Application()
+        app.router.add_get("/bad", bad_handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        host, port = runner.addresses[0][:2]
+        async with ClientSession() as session:
+            async with session.get(f"http://{host}:{port}/bad") as r:
+                assert r.status == 200
+        await runner.cleanup()
+
+    with mon:
+        asyncio.run(main())
+    assert mon.slow_total >= 1
+    with pytest.raises(AssertionError, match="loopcheck"):
+        mon.assert_flat()
+
+
+def test_monitor_quiet_on_real_stack_and_counters_surface():
+    """The real dashboard server under its own (auto-installed) monitor:
+    frame + timings + healthz requests stay under budget, and the
+    loop_lag_ms counters surface on both routes."""
+    from aiohttp import ClientSession
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources import make_source
+
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=8,
+        refresh_interval=0.0,
+        loop_lag_budget=2000.0,  # CI machines stall; quiet ≠ tight here
+    )
+    server = DashboardServer(DashboardService(cfg, make_source(cfg)))
+
+    async def main():
+        from aiohttp import web
+
+        runner = web.AppRunner(server.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        host, port = runner.addresses[0][:2]
+        base = f"http://{host}:{port}"
+        async with ClientSession() as session:
+            async with session.get(f"{base}/api/frame") as r:
+                assert r.status == 200
+                frame = await r.json()
+                assert frame["error"] is None
+            await asyncio.sleep(0.3)  # a few heartbeat ticks
+            async with session.get(f"{base}/api/timings") as r:
+                timings = await r.json()
+            async with session.get(f"{base}/healthz") as r:
+                health = await r.json()
+        await runner.cleanup()
+        return timings, health
+
+    timings, health = asyncio.run(main())
+    for payload in (timings, health):
+        lag = payload["loop_lag_ms"]
+        assert lag["budget_ms"] == 2000.0
+        assert lag["samples"] >= 1 and lag["p50"] is not None
+    assert timings["loop_lag_ms"]["slow_callbacks"] == 0
+    server.loop_monitor.assert_flat()  # the real stack is quiet
+    # the app's cleanup hook uninstalled the server's monitor (the
+    # process-wide patch itself is refcounted — the TPUDASH_LOOPCHECK
+    # autouse fixture may still legitimately hold it)
+    assert server.loop_monitor._installed is False
+
+
+@pytest.mark.loopcheck_exempt
+def test_monitor_budget_zero_disables_recording():
+    mon = LoopLagMonitor(budget_ms=0, tick=0.02)
+
+    async def main():
+        time.sleep(0.05)
+
+    with mon:
+        asyncio.run(main())
+    assert mon.slow_total == 0
+    mon.assert_flat()
+
+
+@pytest.mark.loopcheck_exempt
+def test_monitor_install_is_refcounted_across_instances():
+    import asyncio.events as events
+
+    orig = events.Handle._run
+    a = LoopLagMonitor(budget_ms=1000)
+    b = LoopLagMonitor(budget_ms=1000)
+    a.install()
+    b.install()
+    assert events.Handle._run is not orig
+    a.uninstall()
+    assert events.Handle._run is not orig  # b still active
+    b.uninstall()
+    assert events.Handle._run is orig

@@ -18,7 +18,19 @@ enforce and review alone will not keep enforced — so this package does:
   the breaker/multi-source/service/session layers, and flags writes to
   registered shared attributes performed without their guarding lock.
 
-Both ship with zero suppressions in-tree beyond explicit, reasoned
-``# tpulint: allow[rule]`` markers; the CI ``static-analysis`` job fails
-the build on any new finding.
+- :mod:`tpudash.analysis.asynccheck` — ``python -m
+  tpudash.analysis.asynccheck`` — event-loop hygiene, both halves: an
+  interprocedural static pass (blocking calls reachable from ``async
+  def`` without an executor boundary, ``await`` under a held sync lock,
+  fire-and-forget task spawns) and a runtime loop-lag sanitizer
+  (:class:`~tpudash.analysis.asynccheck.LoopLagMonitor`) whose
+  ``loop_lag_ms`` counters surface on ``/api/timings`` and ``/healthz``
+  and run in pytest behind ``TPUDASH_LOOPCHECK=1``.
+
+``python -m tpudash.analysis`` runs every static analyzer as one gate
+(``--json`` for the machine-readable report; distinct exit codes per
+analyzer — see :mod:`tpudash.analysis.cli`).  All of them ship with zero
+suppressions in-tree beyond explicit, reasoned ``# tpulint: allow[rule]``
+markers; the CI ``static-analysis`` job fails the build on any new
+finding.
 """

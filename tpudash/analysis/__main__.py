@@ -1,8 +1,10 @@
-"""``python -m tpudash.analysis`` → the lint pass (racecheck is a test
-harness, wired through pytest — see docs/DEVELOPMENT.md)."""
+"""``python -m tpudash.analysis`` → the unified static pass (tpulint +
+asynccheck; ``--json`` for the machine-readable report).  racecheck and
+the loop-lag monitor are runtime sanitizers, wired through pytest — see
+docs/DEVELOPMENT.md."""
 
 import sys
 
-from tpudash.analysis.lint import main
+from tpudash.analysis.cli import main
 
 sys.exit(main())

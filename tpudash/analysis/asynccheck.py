@@ -1,0 +1,843 @@
+"""asynccheck — interprocedural event-loop hygiene, static and runtime.
+
+PR 3 made serving overload-safe, but every guarantee it added rides on
+one unenforced invariant: *nothing blocking ever runs on the aiohttp
+event loop* that the watchdog, the SSE fan-out, and the admission
+middleware share.  One sync ``gzip.compress``, file read, or
+``lock.acquire()`` slipped into a handler silently re-creates the
+starvation PR 3 was built to kill — and review alone will not keep that
+from happening.  This module enforces it mechanically, the way
+:mod:`tpudash.analysis.lint` enforces lock discipline:
+
+Static rules (``python -m tpudash.analysis.asynccheck``)
+--------------------------------------------------------
+An interprocedural call graph is built over every scanned module, rooted
+at every ``async def``.  Calls are resolved through module-level
+functions and classes (same module and cross-module via ``import`` /
+``from ... import`` of scanned modules), nested ``def``\\ s, and
+``self.method()`` within the enclosing class.  Anything passed to an
+executor boundary — ``loop.run_in_executor``, ``asyncio.to_thread``,
+``Executor.submit``, ``threading.Thread``/``Timer`` — runs OFF the loop
+and is excluded from the graph.
+
+``async-blocking``
+    A blocking call — ``time.sleep``, sync HTTP/socket APIs
+    (``requests``/``urllib``/``socket.create_connection``), file I/O
+    (``open``, ``os.replace``/``unlink``/…, ``tempfile.mkdtemp``,
+    ``np.save``/``load``), ``subprocess``/``shutil``, ``zlib``/``gzip``
+    compression, or a sync ``threading`` lock acquisition — is reachable
+    from an ``async def`` without an intervening executor boundary.
+    Reported at the blocking site with the async root and call path.
+
+``await-under-lock``
+    An ``await`` occurs lexically inside a sync ``with <...lock...>:``
+    block of an ``async def``.  While the coroutine is suspended the
+    thread's lock stays held; any other coroutine (or executor thread)
+    that needs that lock wedges the loop — the event-loop deadlock class
+    racecheck's thread-ordering graph cannot see.
+
+``unretained-task``
+    ``asyncio.create_task(...)`` / ``ensure_future(...)`` as a bare
+    expression statement: the only reference to the task is the loop's
+    weak set, so it can be garbage-collected mid-flight and its
+    exception is swallowed silently.  Retain the handle (assign, gather,
+    collect) or chain ``.add_done_callback(...)``.
+
+Allow mechanism: identical to tpulint — ``# tpulint: allow[rule] reason``
+on the finding line, the line above, or a ``def``/``with`` header for
+scope coverage.  Exit status 0 = clean; 1 = findings (``file:line: rule:
+message``); 2 = usage/internal error.
+
+Runtime sanitizer (:class:`LoopLagMonitor`)
+-------------------------------------------
+Static rules cannot see attribute-resolved calls (``df.to_csv``,
+``compressor.compress``) or data-dependent cost.  The monitor instruments
+the *running* loop:
+
+- every scheduled callback is timed (a process-wide, refcounted patch of
+  ``asyncio.events.Handle._run``, mirroring racecheck's install model);
+  callbacks exceeding the budget are recorded with attribution;
+- a sampling watchdog thread captures the *actual stack* of the loop
+  thread while an over-budget callback is still running — naming the
+  blocking line, not just the handle;
+- a heartbeat coroutine (:meth:`LoopLagMonitor.run`) measures scheduling
+  lag; p50/max surface as ``loop_lag_ms`` on ``/api/timings`` and
+  ``/healthz`` and are asserted flat by the CI chaos overload drill.
+
+The pytest suite enables it behind ``TPUDASH_LOOPCHECK=1`` (autouse
+fixture in ``tests/conftest.py``; tests that plant blocking callbacks on
+purpose opt out with ``@pytest.mark.loopcheck_exempt``).  The budget is
+``TPUDASH_LOOP_LAG_BUDGET`` milliseconds (Config: ``loop_lag_budget``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from tpudash.analysis.lint import (
+    _BLOCKING_NP_ATTRS,
+    _BLOCKING_OS_ATTRS,
+    Finding,
+    _dotted,
+    _parse_allows,
+    iter_py_files,
+    resolve_cli_paths,
+)
+
+RULE_ASYNC_BLOCKING = "async-blocking"
+RULE_AWAIT_LOCK = "await-under-lock"
+RULE_UNRETAINED = "unretained-task"
+
+ALL_RULES = (RULE_ASYNC_BLOCKING, RULE_AWAIT_LOCK, RULE_UNRETAINED)
+
+RULE_DOCS = {
+    RULE_ASYNC_BLOCKING: (
+        "no blocking call (sleep, sync HTTP/sockets, file I/O, subprocess, "
+        "zlib/gzip compression, sync lock acquisition) reachable from an "
+        "async def without an executor boundary "
+        "(run_in_executor / asyncio.to_thread)"
+    ),
+    RULE_AWAIT_LOCK: (
+        "no await inside a sync `with <lock>:` block of an async def — the "
+        "held threading lock wedges every other coroutine/thread that "
+        "needs it while this one is suspended"
+    ),
+    RULE_UNRETAINED: (
+        "asyncio.create_task/ensure_future results must be retained "
+        "(assigned, gathered) or given a done-callback — a bare spawn can "
+        "be GC'd mid-flight and swallows its exception"
+    ),
+}
+
+#: module roots whose every call blocks (network, subprocess, file trees)
+_ANY_CALL_ROOTS = {"requests", "urllib", "shutil", "subprocess"}
+
+#: module → attribute names whose call blocks (restricted: these modules
+#: also export cheap constructors/constants that must not be flagged)
+_RESTRICTED_ATTRS = {
+    "socket": {"create_connection", "getaddrinfo", "gethostbyname"},
+    "tempfile": {
+        "mkdtemp",
+        "mkstemp",
+        "mktemp",
+        "NamedTemporaryFile",
+        "TemporaryDirectory",
+        "TemporaryFile",
+    },
+    "gzip": {"compress", "decompress", "open"},
+    "zlib": {"compress", "decompress"},
+    "time": {"sleep"},
+}
+
+#: call tails that hand their arguments to a worker thread — anything
+#: inside those arguments runs OFF the event loop and must not feed the
+#: async-context call graph
+_OFFLOAD_TAILS = {
+    "run_in_executor",
+    "to_thread",
+    "submit",
+    "Thread",
+    "Timer",
+}
+
+_TASK_SPAWN_TAILS = {"create_task", "ensure_future"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Final name segment contains "lock" (same heuristic tpulint's
+    blocking-under-lock rule uses for ``with`` items)."""
+    parts = _dotted(expr)
+    return parts is not None and "lock" in parts[-1].lower()
+
+
+# ---------------------------------------------------------------------------
+# Per-module indexing
+# ---------------------------------------------------------------------------
+
+
+class _FuncInfo:
+    __slots__ = (
+        "module",
+        "qual",
+        "path",
+        "lineno",
+        "is_async",
+        "class_name",
+        "parent",
+        "locals",
+        "calls",
+        "blocking",
+        "scope_lines",
+    )
+
+    def __init__(self, module, qual, path, lineno, is_async, class_name, parent):
+        self.module = module
+        self.qual = qual
+        self.path = path
+        self.lineno = lineno
+        self.is_async = is_async
+        self.class_name = class_name
+        self.parent = parent
+        self.locals: dict = {}  # nested def name → _FuncInfo
+        self.calls: list = []  # (lineno, kind, payload)
+        self.blocking: list = []  # (lineno, desc, scope_lines)
+        self.scope_lines: list = []  # enclosing def header lines (allow scope)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<func {self.module}:{self.qual}>"
+
+
+class _ClassInfo:
+    __slots__ = ("name", "methods")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: dict = {}  # method name → _FuncInfo
+
+
+class _ModuleInfo:
+    def __init__(self, name: str, path: str, source: str):
+        self.name = name
+        self.path = path
+        self.allows = _parse_allows(source)
+        self.top: dict = {}  # module-level name → _FuncInfo | _ClassInfo
+        self.funcs: list = []  # every _FuncInfo (any nesting)
+        self.classes: dict = {}  # class name → _ClassInfo
+        self.import_modules: dict = {}  # alias → dotted module name
+        self.import_names: dict = {}  # name → (module name, original name)
+        self.findings: list = []  # module-local findings (unretained, await-lock)
+
+    def allowed(self, rule: str, line: int, scope_lines=()) -> bool:
+        if rule in self.allows.get(line, ()):
+            return True
+        return any(rule in self.allows.get(s, ()) for s in scope_lines)
+
+
+def _module_name(path: str) -> str:
+    norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+    parts = norm.split("/")
+    if "tpudash" in parts:
+        i = len(parts) - 1 - parts[::-1].index("tpudash")
+        parts = parts[i:]
+    else:
+        parts = parts[-1:]
+    name = ".".join(parts)
+    if name.endswith(".py"):
+        name = name[:-3]
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+class _Indexer(ast.NodeVisitor):
+    """One module's function table, call refs, and direct blocking sites."""
+
+    def __init__(self, mod: _ModuleInfo):
+        self.mod = mod
+        self.func_stack: list = []  # _FuncInfo chain
+        self.class_stack: list = []  # class name chain
+        # alias tables (whole-file, function-local imports included)
+        self.time_aliases: set = set()
+        self.os_aliases: set = set()
+        self.np_aliases: set = set()
+        self.module_aliases: dict = {}  # alias → top module name (blocking tables)
+        self.from_names: dict = {}  # bound name → (module, original)
+
+    # -- imports -------------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            bound = alias.asname or top
+            if top == "time":
+                self.time_aliases.add(bound)
+            if top == "os":
+                self.os_aliases.add(bound)
+            if top == "numpy":
+                self.np_aliases.add(bound)
+            if top in _ANY_CALL_ROOTS or top in _RESTRICTED_ATTRS:
+                self.module_aliases[bound] = top
+            # cross-module resolution (scanned modules only)
+            self.mod.import_modules[bound] = alias.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.level == 0:
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                # one record serves both: _blocking_desc classifies bound
+                # names from blocking modules, _resolve follows bound
+                # names into scanned modules
+                self.from_names[bound] = (node.module, alias.name)
+                self.mod.import_names[bound] = (node.module, alias.name)
+        self.generic_visit(node)
+
+    # -- definitions ---------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        if node.name not in self.mod.classes:
+            self.mod.classes[node.name] = _ClassInfo(node.name)
+        if not self.func_stack and len(self.class_stack) == 1:
+            self.mod.top.setdefault(node.name, self.mod.classes[node.name])
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node, is_async: bool) -> None:
+        parent = self.func_stack[-1] if self.func_stack else None
+        qual_parts = [f.qual for f in self.func_stack[-1:]] or self.class_stack[:]
+        qual = ".".join((*qual_parts, node.name)) if qual_parts else node.name
+        class_name = self.class_stack[-1] if self.class_stack else None
+        fi = _FuncInfo(
+            self.mod.name,
+            qual,
+            self.mod.path,
+            node.lineno,
+            is_async,
+            class_name,
+            parent,
+        )
+        fi.scope_lines = [f.lineno for f in self.func_stack] + [node.lineno]
+        self.mod.funcs.append(fi)
+        if parent is not None:
+            parent.locals[node.name] = fi
+        elif self.class_stack:
+            cls = self.mod.classes.get(self.class_stack[-1])
+            if cls is not None:
+                cls.methods.setdefault(node.name, fi)
+        else:
+            self.mod.top.setdefault(node.name, fi)
+        self.func_stack.append(fi)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_func(node, is_async=True)
+
+    # -- with: await-under-lock / sync acquisition ---------------------------
+    def visit_With(self, node: ast.With) -> None:
+        fi = self.func_stack[-1] if self.func_stack else None
+        if fi is not None and any(
+            _is_lockish(item.context_expr) for item in node.items
+        ):
+            aw = _first_await(node.body) if fi.is_async else None
+            if aw is not None:
+                if not self.mod.allowed(
+                    RULE_AWAIT_LOCK, node.lineno, fi.scope_lines
+                ):
+                    self.mod.findings.append(
+                        Finding(
+                            self.mod.path,
+                            node.lineno,
+                            RULE_AWAIT_LOCK,
+                            f"suspension point at line {aw.lineno} "
+                            "(await / async with / async for) inside sync "
+                            f"`with {_with_label(node)}:` of async "
+                            f"{fi.qual} — the thread's lock stays held "
+                            "across the suspension and wedges every "
+                            "coroutine/thread that needs it; use "
+                            "asyncio.Lock, or release before awaiting",
+                        )
+                    )
+            else:
+                # no await: still a sync lock acquisition — if this code
+                # runs in async context, a contended lock stalls the loop
+                # for the holder's whole critical section
+                fi.blocking.append(
+                    (
+                        node.lineno,
+                        f"sync `with {_with_label(node)}:` lock acquisition",
+                        tuple(fi.scope_lines),
+                    )
+                )
+        self.generic_visit(node)
+
+    # -- expression statements: unretained tasks ------------------------------
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            parts = _dotted(call.func)
+            if parts is not None and parts[-1] in _TASK_SPAWN_TAILS:
+                scope = (
+                    self.func_stack[-1].scope_lines if self.func_stack else ()
+                )
+                if not self.mod.allowed(RULE_UNRETAINED, call.lineno, scope):
+                    self.mod.findings.append(
+                        Finding(
+                            self.mod.path,
+                            call.lineno,
+                            RULE_UNRETAINED,
+                            f"{'.'.join(parts)}(...) result is discarded: the "
+                            "task can be garbage-collected mid-flight and its "
+                            "exception is swallowed — retain the handle or "
+                            "chain .add_done_callback(...)",
+                        )
+                    )
+        self.generic_visit(node)
+
+    # -- calls ----------------------------------------------------------------
+    def _blocking_desc(self, parts: list) -> "str | None":
+        if len(parts) == 1:
+            name = parts[0]
+            if name == "open":
+                return "open() file I/O"
+            ref = self.from_names.get(name)
+            if ref is not None:
+                module, orig = ref
+                top = module.split(".")[0]
+                if top in _ANY_CALL_ROOTS:
+                    return f"{top}.{orig} (network/subprocess/file API)"
+                if orig in _RESTRICTED_ATTRS.get(top, ()):
+                    return f"{top}.{orig}"
+                if top == "os" and orig in _BLOCKING_OS_ATTRS:
+                    return f"os.{orig} filesystem call"
+            return None
+        root, tail = parts[0], parts[-1]
+        if root in self.module_aliases:
+            top = self.module_aliases[root]
+            if top in _ANY_CALL_ROOTS:
+                return f"{'.'.join(parts)} (network/subprocess/file API)"
+            if tail in _RESTRICTED_ATTRS.get(top, ()):
+                return f"{top}.{tail}"
+        # urllib.request.urlopen style (root tracked via import_modules too)
+        imported = self.mod.import_modules.get(root)
+        if imported is not None and imported.split(".")[0] in _ANY_CALL_ROOTS:
+            return f"{'.'.join(parts)} (network/subprocess/file API)"
+        if root in self.time_aliases and tail == "sleep":
+            return "time.sleep"
+        if root in self.os_aliases and len(parts) == 2 and tail in _BLOCKING_OS_ATTRS:
+            return f"os.{tail} filesystem call"
+        if root in self.np_aliases and len(parts) == 2 and tail in _BLOCKING_NP_ATTRS:
+            return f"numpy {tail} disk I/O"
+        if tail == "acquire" and "lock" in parts[-2].lower():
+            return f"sync {'.'.join(parts)} (threading lock)"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        parts = _dotted(node.func)
+        if parts is not None and parts[-1] in _OFFLOAD_TAILS:
+            # run_in_executor / to_thread / submit / Thread: the payload
+            # runs on a worker thread — do not traverse the arguments
+            self.visit(node.func)
+            return
+        fi = self.func_stack[-1] if self.func_stack else None
+        if fi is not None and parts is not None:
+            desc = self._blocking_desc(parts)
+            if desc is not None:
+                fi.blocking.append((node.lineno, desc, tuple(fi.scope_lines)))
+            elif len(parts) == 1:
+                fi.calls.append((node.lineno, "bare", parts[0]))
+            elif parts[0] == "self" and len(parts) == 2:
+                fi.calls.append((node.lineno, "self", parts[1]))
+            elif len(parts) == 2:
+                fi.calls.append((node.lineno, "attr", (parts[0], parts[1])))
+        self.generic_visit(node)
+
+
+def _first_await(body) -> "ast.AST | None":
+    """First suspension point in a statement list — ``await``, but also
+    ``async with`` (suspends at ``__aenter__``) and ``async for``
+    (suspends at ``__anext__``) — not descending into nested function
+    definitions (their bodies do not run under this lock)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+            return node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+def _with_label(node: ast.With) -> str:
+    for item in node.items:
+        if _is_lockish(item.context_expr):
+            parts = _dotted(item.context_expr)
+            if parts:
+                return ".".join(parts)
+    return "lock"
+
+
+def index_source(source: str, path: str) -> "_ModuleInfo | Finding":
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(path, e.lineno or 1, "syntax", f"cannot parse: {e.msg}")
+    mod = _ModuleInfo(_module_name(path), path, source)
+    _Indexer(mod).visit(tree)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural analysis
+# ---------------------------------------------------------------------------
+
+
+def _as_func(target) -> "_FuncInfo | None":
+    """A resolution target as a callable body: functions pass through,
+    classes resolve to their ``__init__``."""
+    if isinstance(target, _FuncInfo):
+        return target
+    if isinstance(target, _ClassInfo):
+        return target.methods.get("__init__")
+    return None
+
+
+def _resolve(
+    index: dict, mod: _ModuleInfo, fi: _FuncInfo, kind: str, payload
+) -> "_FuncInfo | None":
+    if kind == "bare":
+        scope = fi
+        while scope is not None:  # nested defs shadow module level
+            if payload in scope.locals:
+                return _as_func(scope.locals[payload])
+            scope = scope.parent
+        if payload in mod.top:
+            return _as_func(mod.top[payload])
+        ref = mod.import_names.get(payload)
+        if ref is not None:
+            target_mod = index.get(ref[0])
+            if target_mod is not None:
+                return _as_func(target_mod.top.get(ref[1]))
+        return None
+    if kind == "self":
+        if fi.class_name is None:
+            return None
+        cls = mod.classes.get(fi.class_name)
+        return cls.methods.get(payload) if cls is not None else None
+    if kind == "attr":
+        alias, name = payload
+        dotted = mod.import_modules.get(alias)
+        if dotted is not None:
+            target_mod = index.get(dotted)
+            if target_mod is not None:
+                return _as_func(target_mod.top.get(name))
+    return None
+
+
+def analyze_modules(modules: "list[_ModuleInfo]") -> "list[Finding]":
+    index = {m.name: m for m in modules}
+    by_path = {m.path: m for m in modules}
+    findings: list = []
+    for m in modules:
+        findings.extend(m.findings)
+    reported: set = set()  # (path, line, desc) — one finding per site
+    for m in modules:
+        for root in m.funcs:
+            if not root.is_async:
+                continue
+            # DFS with an explicit path so the finding can name the route
+            stack = [(root, (root.qual,))]
+            seen = {id(root)}
+            while stack:
+                fi, trail = stack.pop()
+                fi_mod = index.get(fi.module, m)
+                for line, desc, scope_lines in fi.blocking:
+                    site = (fi.path, line, desc)
+                    if site in reported:
+                        continue
+                    reported.add(site)
+                    owner = by_path.get(fi.path, fi_mod)
+                    if owner.allowed(RULE_ASYNC_BLOCKING, line, scope_lines):
+                        continue
+                    via = (
+                        ""
+                        if len(trail) == 1
+                        else " via " + " -> ".join(trail[1:])
+                    )
+                    findings.append(
+                        Finding(
+                            fi.path,
+                            line,
+                            RULE_ASYNC_BLOCKING,
+                            f"{desc} runs on the event loop (reachable from "
+                            f"async {root.module}.{root.qual}{via}); move it "
+                            "behind await loop.run_in_executor(...) / "
+                            "asyncio.to_thread(...), or mark the site "
+                            "# tpulint: allow[async-blocking] <reason>",
+                        )
+                    )
+                for _line, kind, payload in fi.calls:
+                    callee = _resolve(index, index.get(fi.module, m), fi, kind, payload)
+                    if callee is not None and id(callee) not in seen:
+                        seen.add(id(callee))
+                        stack.append((callee, (*trail, callee.qual)))
+    return sorted(findings)
+
+
+def check_source(source: str, path: str = "<string>") -> "list[Finding]":
+    """Single-file entry point (unit tests): index + analyze one module."""
+    mod = index_source(source, path)
+    if isinstance(mod, Finding):
+        return [mod]
+    return analyze_modules([mod])
+
+
+def check_paths(paths: "list[str]") -> "list[Finding]":
+    modules: list = []
+    findings: list = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding(path, 1, "io", f"cannot read: {e}"))
+            continue
+        mod = index_source(source, path)
+        if isinstance(mod, Finding):
+            findings.append(mod)
+        else:
+            modules.append(mod)
+    findings.extend(analyze_modules(modules))
+    return sorted(findings)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--rules" in argv:
+        for rule in ALL_RULES:
+            print(f"{rule}: {RULE_DOCS[rule]}")
+        return 0
+    paths, err = resolve_cli_paths(argv, "asynccheck")
+    if paths is None:
+        return err
+    findings = check_paths(paths)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(
+            f"asynccheck: {len(findings)} finding"
+            f"{'s' if len(findings) != 1 else ''} "
+            f"across {len(set(f.path for f in findings))} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("asynccheck: clean")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Runtime loop-lag sanitizer
+# ---------------------------------------------------------------------------
+
+#: default over-budget threshold, ms (TPUDASH_LOOP_LAG_BUDGET overrides)
+DEFAULT_BUDGET_MS = 250.0
+
+_PATCH_LOCK = threading.Lock()
+#: immutable snapshot, REPLACED (never mutated) under _PATCH_LOCK so
+#: _patched_run can read it lock-free from any loop thread — iterating a
+#: shared set while install()/uninstall() mutates it would raise
+#: "set changed size during iteration" inside an arbitrary callback
+_ACTIVE: "tuple[LoopLagMonitor, ...]" = ()
+_ORIG_RUN = None
+
+
+def _patched_run(handle):
+    monitors = _ACTIVE
+    if not monitors:
+        return _ORIG_RUN(handle)
+    # cell = [handle, t0, thread id, captured-stack-or-None] — shared with
+    # the watchdog thread, which fills index 3 while the callback runs
+    cell = [handle, time.perf_counter(), threading.get_ident(), None]
+    for m in monitors:
+        m._begin(cell)
+    try:
+        return _ORIG_RUN(handle)
+    finally:
+        dt = time.perf_counter() - cell[1]
+        for m in monitors:
+            m._end(cell, dt)
+
+
+def _describe_handle(handle) -> str:
+    try:
+        return repr(handle)
+    except Exception:  # noqa: BLE001 — attribution must never raise
+        return "<handle>"
+
+
+class LoopLagMonitor:
+    """Event-loop lag sanitizer: callback timing + stack attribution +
+    heartbeat lag percentiles (see module docstring).
+
+    Install/uninstall mirror :class:`~tpudash.analysis.racecheck.RaceCheck`
+    (refcounted process-wide patch; safe to nest across servers/tests).
+    The heartbeat (:meth:`run`) is optional — a caller with a live loop
+    spawns it as a retained task to get ``loop_lag_ms`` percentiles."""
+
+    def __init__(
+        self,
+        budget_ms: float = DEFAULT_BUDGET_MS,
+        tick: float = 0.25,
+        window: int = 512,
+        sample_every: float = 0.02,
+        keep_slow: int = 100,
+    ):
+        self.budget_ms = float(budget_ms)
+        self.tick = tick
+        self.sample_every = sample_every
+        self.keep_slow = keep_slow
+        #: heartbeat scheduling lag samples, ms (deque append is atomic)
+        self.samples: deque = deque(maxlen=window)
+        #: first ``keep_slow`` over-budget callbacks, with attribution
+        self.slow: list = []
+        #: total over-budget callbacks observed (never truncated)
+        self.slow_total = 0
+        self._running: dict = {}  # thread id → [cell, ...] (nested loops)
+        self._installed = False
+        self._stop = threading.Event()
+        self._watchdog: "threading.Thread | None" = None
+
+    @classmethod
+    def from_env(cls, **kwargs) -> "LoopLagMonitor":
+        from tpudash.config import env_read
+
+        raw = env_read("TPUDASH_LOOP_LAG_BUDGET")
+        try:
+            budget = float(raw) if raw else DEFAULT_BUDGET_MS
+        except ValueError:
+            budget = DEFAULT_BUDGET_MS
+        return cls(budget_ms=budget, **kwargs)
+
+    # -- install / uninstall -------------------------------------------------
+    def install(self) -> "LoopLagMonitor":
+        global _ACTIVE, _ORIG_RUN
+        if self._installed:
+            return self
+        import asyncio.events as events
+
+        with _PATCH_LOCK:
+            if not _ACTIVE:
+                _ORIG_RUN = events.Handle._run
+                events.Handle._run = _patched_run
+            _ACTIVE = (*_ACTIVE, self)
+        self._installed = True
+        self._stop.clear()
+        self._watchdog = threading.Thread(
+            target=self._watch, name="loopcheck-watchdog", daemon=True
+        )
+        self._watchdog.start()
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if not self._installed:
+            return
+        import asyncio.events as events
+
+        with _PATCH_LOCK:
+            _ACTIVE = tuple(m for m in _ACTIVE if m is not self)
+            if not _ACTIVE and _ORIG_RUN is not None:
+                events.Handle._run = _ORIG_RUN
+        self._installed = False
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    def __enter__(self) -> "LoopLagMonitor":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- callback bookkeeping (loop thread) ----------------------------------
+    def _begin(self, cell) -> None:
+        self._running.setdefault(cell[2], []).append(cell)
+
+    def _end(self, cell, dt: float) -> None:
+        cells = self._running.get(cell[2])
+        if cells is not None:
+            try:
+                cells.remove(cell)
+            except ValueError:  # pragma: no cover - install raced mid-callback
+                pass
+            if not cells:
+                self._running.pop(cell[2], None)
+        if self.budget_ms > 0 and dt * 1e3 >= self.budget_ms:
+            self.slow_total += 1
+            if len(self.slow) < self.keep_slow:
+                self.slow.append(
+                    {
+                        "ms": round(dt * 1e3, 2),
+                        "callback": _describe_handle(cell[0]),
+                        "stack": cell[3],
+                    }
+                )
+
+    # -- watchdog thread: in-flight stack capture ----------------------------
+    def _watch(self) -> None:
+        budget_s = self.budget_ms / 1e3 if self.budget_ms > 0 else None
+        while not self._stop.wait(self.sample_every):
+            if budget_s is None:
+                continue
+            now = time.perf_counter()
+            for tid, cells in list(self._running.items()):
+                if not cells:
+                    continue
+                cell = cells[-1]
+                if cell[3] is None and now - cell[1] >= budget_s:
+                    # best-effort: the callback may finish between the
+                    # check and the capture — the stack then names the
+                    # successor, which _end simply won't use
+                    frame = sys._current_frames().get(tid)
+                    if frame is not None:
+                        cell[3] = "".join(
+                            traceback.format_stack(frame, limit=20)
+                        )
+
+    # -- heartbeat ------------------------------------------------------------
+    async def run(self) -> None:
+        """Heartbeat: measure scheduling lag every ``tick`` seconds.  The
+        caller keeps the returned task referenced (unretained-task rule
+        applies to us too) and cancels it at shutdown."""
+        import asyncio
+
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(self.tick)
+            lag_ms = max(0.0, (time.monotonic() - t0 - self.tick) * 1e3)
+            self.samples.append(lag_ms)
+
+    # -- reporting ------------------------------------------------------------
+    def summary(self) -> dict:
+        vals = sorted(self.samples)
+        return {
+            "budget_ms": self.budget_ms,
+            "samples": len(vals),
+            "p50": round(vals[len(vals) // 2], 2) if vals else None,
+            "max": round(vals[-1], 2) if vals else None,
+            "slow_callbacks": self.slow_total,
+        }
+
+    def assert_flat(self) -> None:
+        """Raise AssertionError naming every over-budget callback (with
+        its captured stack when the watchdog got one)."""
+        if not self.slow_total:
+            return
+        lines = [
+            f"loopcheck: {self.slow_total} event-loop callback(s) exceeded "
+            f"the {self.budget_ms:g}ms budget:"
+        ]
+        for entry in self.slow[:10]:
+            lines.append(f"  {entry['ms']}ms in {entry['callback']}")
+            if entry.get("stack"):
+                lines.append(
+                    "    stack while blocked:\n      "
+                    + entry["stack"].strip().replace("\n", "\n      ")
+                )
+        raise AssertionError("\n".join(lines))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""``python -m tpudash.analysis`` — every static analyzer, one entry point.
+
+Runs tpulint (:mod:`tpudash.analysis.lint`) and asynccheck
+(:mod:`tpudash.analysis.asynccheck`) over the same tree so CI and editors
+consume one command instead of tracking the analyzer roster:
+
+    python -m tpudash.analysis                 # analyze the package
+    python -m tpudash.analysis path/ f.py      # analyze specific trees
+    python -m tpudash.analysis --json          # machine-readable report
+    python -m tpudash.analysis --rules         # list every rule
+
+Exit codes are distinct so a consumer can tell WHICH gate failed without
+parsing output:
+
+    0   clean
+    1   tpulint findings only
+    2   asynccheck findings only
+    3   findings from both analyzers
+    4   usage/internal error (bad path, nothing to scan, registry import)
+
+``--json`` prints one object::
+
+    {"version": 1, "clean": false,
+     "counts": {"tpulint": 1, "asynccheck": 0},
+     "findings": [{"analyzer": "tpulint", "rule": "wall-clock",
+                   "file": "...", "line": 12, "message": "..."}]}
+
+(racecheck and the loop-lag monitor are runtime sanitizers wired through
+pytest — ``TPUDASH_RACECHECK=1`` / ``TPUDASH_LOOPCHECK=1`` — not part of
+this static pass; see docs/DEVELOPMENT.md.)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from tpudash.analysis import asynccheck, lint
+
+EXIT_CLEAN = 0
+EXIT_LINT = 1
+EXIT_ASYNC = 2
+EXIT_USAGE = 4
+
+
+def run_all(paths: "list[str]") -> dict:
+    """Both analyzers over ``paths``; returns the ``--json`` report shape
+    (the CLI and tests share it so they can never disagree)."""
+    declared = lint._declared_env()
+    doc_text = lint._operations_doc_text()
+    lint_findings = lint.lint_paths(
+        paths, declared_env=declared, doc_text=doc_text
+    )
+    async_findings = asynccheck.check_paths(paths)
+    findings = [
+        {
+            "analyzer": analyzer,
+            "rule": f.rule,
+            "file": f.path,
+            "line": f.line,
+            "message": f.message,
+        }
+        for analyzer, batch in (
+            ("tpulint", lint_findings),
+            ("asynccheck", async_findings),
+        )
+        for f in sorted(batch)
+    ]
+    return {
+        "version": 1,
+        "clean": not findings,
+        "counts": {
+            "tpulint": len(lint_findings),
+            "asynccheck": len(async_findings),
+        },
+        "findings": findings,
+    }
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = "--json" in argv
+    if "--rules" in argv:
+        for name, mod in (("tpulint", lint), ("asynccheck", asynccheck)):
+            for rule in mod.ALL_RULES:
+                print(f"{name}: {rule}: {mod.RULE_DOCS[rule]}")
+        return EXIT_CLEAN
+    paths, _err = lint.resolve_cli_paths(argv, "analysis")
+    if paths is None:
+        return EXIT_USAGE
+    try:
+        report = run_all(paths)
+    except Exception as e:  # pragma: no cover - registry/import failure
+        print(f"analysis: internal error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        for f in report["findings"]:
+            print(
+                f"{f['file']}:{f['line']}: [{f['analyzer']}] {f['rule']}: "
+                f"{f['message']}"
+            )
+        counts = report["counts"]
+        if report["clean"]:
+            print("analysis: clean (tpulint + asynccheck)")
+        else:
+            print(
+                f"analysis: {counts['tpulint']} tpulint / "
+                f"{counts['asynccheck']} asynccheck finding(s)",
+                file=sys.stderr,
+            )
+    code = EXIT_CLEAN
+    if report["counts"]["tpulint"]:
+        code |= EXIT_LINT
+    if report["counts"]["asynccheck"]:
+        code |= EXIT_ASYNC
+    return code

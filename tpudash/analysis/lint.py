@@ -454,6 +454,34 @@ def _operations_doc_text() -> str | None:
         return f.read()
 
 
+def resolve_cli_paths(
+    argv: "list[str]", prog: str
+) -> "tuple[list[str] | None, int]":
+    """Shared CLI path handling for every analyzer entry point (lint,
+    asynccheck, the unified gate): positional args, defaulting to the
+    installed tpudash package; loud failure (exit-worthy code in slot 2)
+    for a missing path or a path tree with zero Python files — a gate
+    that scans nothing "passes" forever, so a typo'd CI path must fail.
+    Returns (paths, 0) on success, (None, nonzero-hint) on error; callers
+    map the hint onto their own exit-code scheme."""
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        import tpudash
+
+        paths = [os.path.dirname(os.path.abspath(tpudash.__file__))]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"{prog}: no such path: {', '.join(missing)}", file=sys.stderr)
+        return None, 2
+    if not iter_py_files(paths):
+        print(
+            f"{prog}: no Python files under: {', '.join(paths)}",
+            file=sys.stderr,
+        )
+        return None, 2
+    return paths, 0
+
+
 def iter_py_files(paths: "list[str]") -> "list[str]":
     out: list[str] = []
     for p in paths:
@@ -539,25 +567,9 @@ def main(argv: "list[str] | None" = None) -> int:
         for rule in ALL_RULES:
             print(f"{rule}: {RULE_DOCS[rule]}")
         return 0
-    paths = [a for a in argv if not a.startswith("-")]
-    if not paths:
-        import tpudash
-
-        paths = [os.path.dirname(os.path.abspath(tpudash.__file__))]
-    missing = [p for p in paths if not os.path.exists(p)]
-    if missing:
-        print(
-            f"tpulint: no such path: {', '.join(missing)}", file=sys.stderr
-        )
-        return 2
-    if not iter_py_files(paths):
-        # a gate that scans zero files "passes" forever — fail loudly on
-        # a typo'd CI path instead
-        print(
-            f"tpulint: no Python files under: {', '.join(paths)}",
-            file=sys.stderr,
-        )
-        return 2
+    paths, err = resolve_cli_paths(argv, "tpulint")
+    if paths is None:
+        return err
     try:
         declared = _declared_env()
     except Exception as e:  # pragma: no cover - registry import failure

@@ -30,6 +30,7 @@ Routes (full reference: docs/API.md):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hmac
 import json
 import logging
@@ -41,6 +42,7 @@ from aiohttp import web
 
 log = logging.getLogger(__name__)
 
+from tpudash.analysis.asynccheck import LoopLagMonitor
 from tpudash.app.assets import find_plotly_asset
 from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
 from tpudash.app.overload import OverloadGuard
@@ -75,6 +77,11 @@ except ImportError:  # older aiohttp raises ConnectionResetError directly
 #: load, and the static shell / vendored bundle are cheap one-time loads
 #: a browser needs before it can even hold a session
 _NEVER_SHED = ("/healthz",)
+
+#: typed app-storage key for the loop-lag heartbeat task — retained here
+#: (not fire-and-forget) so it can't be GC'd mid-flight and shutdown can
+#: cancel it (asynccheck rule ``unretained-task``)
+LOOPMON_TASK = web.AppKey("loopmon_task", asyncio.Task)
 
 
 def _dumps(obj) -> str:
@@ -116,6 +123,15 @@ def _accepts_gzip(header: str) -> bool:
 
 def _json_response(data, **kw) -> web.Response:
     return web.json_response(data, dumps=_dumps, **kw)
+
+
+def _build_stale_body(key: "tuple | None", frame: dict) -> tuple:
+    """(key, raw, gzip) for the degraded /api/frame body — executor-side
+    (the dump + compress of a ~100KB frame must not run on the loop)."""
+    import gzip
+
+    raw = _dumps(dict(frame, stale=True)).encode()
+    return (key, raw, gzip.compress(raw, 6))
 
 
 def _key_id(key: tuple) -> str:
@@ -178,6 +194,16 @@ class DashboardServer:
         #: (key, raw body, gzip body) for the degraded response — built
         #: at most once per published frame, however many sheds serve it
         self._stale_body: "tuple | None" = None
+        #: single-flight gate for that build: a shed swarm arriving on a
+        #: fresh frame dispatches ONE executor build, not one per request
+        self._stale_build_lock = asyncio.Lock()
+        #: runtime event-loop lag sanitizer (asynccheck): callback timing
+        #: with stack attribution + heartbeat lag percentiles, surfaced
+        #: as ``loop_lag_ms`` on /api/timings and /healthz.  Installed by
+        #: build_app's on_startup hook; budget 0 disables it.
+        self.loop_monitor = LoopLagMonitor(
+            budget_ms=service.cfg.loop_lag_budget
+        )
         #: vendored plotly bundle (deploy-time property, resolved once);
         #: None → the page uses the CDN tag and /static 404s
         self._plotly_asset = find_plotly_asset(service.cfg.assets_dir)
@@ -574,9 +600,22 @@ class DashboardServer:
             if compressor is None:
                 data = raw
             else:
-                data = compressor.compress(raw) + compressor.flush(
-                    zlib.Z_SYNC_FLUSH
-                )
+                # the compressobj is stateful but serial — only this
+                # coroutine ever touches it — so the compression itself
+                # can run off the loop: a full 256-chip frame compresses
+                # for single-digit ms, and N streams × that per tick is
+                # real loop time.  Tiny payloads (keepalives, deltas)
+                # stay inline: the executor hop costs more than they do.
+                def _compress() -> bytes:
+                    return compressor.compress(raw) + compressor.flush(
+                        zlib.Z_SYNC_FLUSH
+                    )
+
+                if len(raw) >= 4096:
+                    loop = asyncio.get_running_loop()
+                    data = await loop.run_in_executor(None, _compress)
+                else:
+                    data = _compress()
             if data:
                 await resp.write(data)
                 if payload_writer is not None:
@@ -649,8 +688,14 @@ class DashboardServer:
         df = self.service.last_df
         if df is None:
             raise web.HTTPServiceUnavailable(text="no frame rendered yet")
+        # a 4096-chip table serializes for ~10ms — off the loop with the
+        # rest of the frame machinery
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(
+            None, lambda: df.to_csv(index_label="chip")
+        )
         return web.Response(
-            text=df.to_csv(index_label="chip"),
+            text=text,
             content_type="text/csv",
             headers={
                 "Content-Disposition": "attachment; filename=tpudash.csv"
@@ -719,6 +764,7 @@ class DashboardServer:
         counters — one stop for "is the serving side keeping up"."""
         summary = self.service.timer.summary()
         summary["overload"] = self.overload.snapshot()
+        summary["loop_lag_ms"] = self.loop_monitor.summary()
         return _json_response(summary)
 
     async def profile(self, request: web.Request) -> web.Response:
@@ -752,7 +798,14 @@ class DashboardServer:
             if self._device_trace_active:
                 raise web.HTTPConflict(text="a device trace is already running")
             self._device_trace_active = True
-            trace_dir = tempfile.mkdtemp(prefix="tpudash-trace-")
+            loop = asyncio.get_running_loop()
+            try:
+                trace_dir = await loop.run_in_executor(
+                    None, lambda: tempfile.mkdtemp(prefix="tpudash-trace-")
+                )
+            except BaseException:  # incl. a cancelled handler
+                self._device_trace_active = False
+                raise
 
             def capture():
                 with jax.profiler.trace(trace_dir):
@@ -760,13 +813,14 @@ class DashboardServer:
                     # doing (workload steps / probes) for the window
                     time.sleep(seconds)
 
-            loop = asyncio.get_running_loop()
             try:
                 await loop.run_in_executor(None, capture)
             except Exception as e:  # noqa: BLE001 — profiler errors → clean 500
                 import shutil
 
-                shutil.rmtree(trace_dir, ignore_errors=True)
+                await loop.run_in_executor(
+                    None, lambda: shutil.rmtree(trace_dir, ignore_errors=True)
+                )
                 raise web.HTTPInternalServerError(
                     text=f"device trace failed: {e}"
                 ) from e
@@ -1226,17 +1280,25 @@ class DashboardServer:
              "source": self.service.source.name,
              "error": self.service.last_error,
              "overload": overload,
+             "loop_lag_ms": self.loop_monitor.summary(),
              "source_health": health}
         )
 
-    def _shed_response(self, request: web.Request, reason: str) -> web.Response:
+    async def _shed_response(
+        self, request: web.Request, reason: str
+    ) -> web.Response:
         """One shed request's response.  ``GET /api/frame`` degrades to
         the last published frame with a ``stale: true`` marker — a
         monitoring dashboard that answers "here is slightly-old data"
         beats one that answers 503 while the fleet burns.  Everything
-        else sheds hard: 503 + Retry-After, constant-time, no locks, no
-        executor — the whole point is that this path stays cheap at any
-        request rate."""
+        else sheds hard: 503 + Retry-After, constant-time, no executor —
+        the whole point is that this path stays cheap at any request
+        rate.  The one exception is the once-per-published-frame stale
+        body build: serializing + gzipping ~100KB is loop-blocking work
+        (asynccheck rule ``async-blocking``), so it runs in the executor
+        behind a single-flight gate — a shed swarm arriving on a fresh
+        frame dispatches one build and every later shed serves cached
+        bytes with zero awaits."""
         headers = {"Retry-After": self.overload.retry_after_header()}
         if request.method == "GET" and request.path == "/api/frame":
             frame, key = self._last_frame, self._last_frame_key
@@ -1257,15 +1319,34 @@ class DashboardServer:
                             headers={**headers, "Cache-Control": "no-cache"},
                         )
                 if self._stale_body is None or self._stale_body[0] != key:
-                    import gzip as _gzip
-
-                    raw = _dumps(dict(frame, stale=True)).encode()
-                    self._stale_body = (key, raw, _gzip.compress(raw, 6))
+                    async with self._stale_build_lock:
+                        # re-read under the gate: the frame may have
+                        # advanced while this request queued — the build
+                        # must target the NEWEST published frame, or a
+                        # request holding a stale local key would
+                        # overwrite a fresh cache and the next shed would
+                        # rebuild it right back (ping-pong under the very
+                        # swarm the single-flight gate exists for)
+                        frame, key = self._last_frame, self._last_frame_key
+                        if (
+                            self._stale_body is None
+                            or self._stale_body[0] != key
+                        ):
+                            loop = asyncio.get_running_loop()
+                            self._stale_body = await loop.run_in_executor(
+                                None, _build_stale_body, key, frame
+                            )
+                # serve whatever the cache holds, with a MATCHING ETag —
+                # the pre-lock etag may describe an older frame than the
+                # body we are about to send
+                body_key, raw, gz = self._stale_body
+                if body_key is not None:
+                    headers["ETag"] = f'"{_key_id(body_key)}-stale"'
                 if _accepts_gzip(request.headers.get("Accept-Encoding", "")):
-                    body = self._stale_body[2]
+                    body = gz
                     headers["Content-Encoding"] = "gzip"
                 else:
-                    body = self._stale_body[1]
+                    body = raw
                 return web.Response(
                     body=body,
                     content_type="application/json",
@@ -1296,7 +1377,7 @@ class DashboardServer:
         # here but are governed by max_streams, not the request gate
         reason = guard.admit(guard.client_key(request), gate=not is_stream)
         if reason is not None:
-            return self._shed_response(request, reason)
+            return await self._shed_response(request, reason)
         watchdog = self.service.cfg.refresh_watchdog
         if watchdog and watchdog > 0:
             # 2×: the budget must outlive one full watchdog window that
@@ -1357,6 +1438,27 @@ class DashboardServer:
         app = web.Application(
             middlewares=[self._auth, self._admission, self._compress]
         )
+        if self.service.cfg.loop_lag_budget > 0:
+            # loop-lag sanitizer for the app's lifetime: callback timing
+            # + stack attribution (install) and the heartbeat that feeds
+            # the loop_lag_ms percentiles.  The task handle lives in app
+            # storage — retained, cancellable at shutdown.
+            async def _start_loopmon(app):
+                self.loop_monitor.install()
+                app[LOOPMON_TASK] = asyncio.create_task(
+                    self.loop_monitor.run()
+                )
+
+            async def _stop_loopmon(app):
+                task = app.get(LOOPMON_TASK)
+                if task is not None:
+                    task.cancel()
+                    with contextlib.suppress(asyncio.CancelledError):
+                        await task
+                self.loop_monitor.uninstall()
+
+            app.on_startup.append(_start_loopmon)
+            app.on_cleanup.append(_stop_loopmon)
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/api/stream", self.stream)

@@ -208,7 +208,12 @@ async def run_overload_drill(
     list of violated invariants (empty when the drill passes)."""
     from aiohttp import ClientSession, web
 
-    server, cfg = make_overload_server(cfg)
+    # constructed in the executor: DashboardService.__init__ does real
+    # file I/O (state checkpoint, history restore/sweep) and sources own
+    # HTTP sessions — none of it belongs on the loop the drill is about
+    # to measure (asynccheck rule ``async-blocking``)
+    loop = asyncio.get_running_loop()
+    server, cfg = await loop.run_in_executor(None, make_overload_server, cfg)
     app = server.build_app()
 
     # Small per-connection output buffers on the stream route ONLY inside
@@ -360,6 +365,9 @@ async def run_overload_drill(
         # a slow consumer in the wild is a tab that attached while things
         # were calm and then wedged, and the warmup keeps the eviction
         # proof from racing 100 hammer clients for the frame lock.
+        # Every spawn below is RETAINED in `tasks` (awaited, then
+        # cancelled at teardown) — the asynccheck ``unretained-task``
+        # rule holds this file to that.
         tasks = [
             asyncio.ensure_future(healthz_probe(session)),
             *(
@@ -410,6 +418,19 @@ async def run_overload_drill(
         )
     if "overload" not in timings or "counters" not in timings["overload"]:
         failures.append("/api/timings lost the overload counters")
+    # the loop-lag sanitizer must be live AND flat: overload protection
+    # that holds while the event loop starves is no protection at all.
+    # p50 (not max) is the assertion — a single GC pause or laggy CI tick
+    # must not flake the drill, a *sustained* stall must fail it.
+    lag = timings.get("loop_lag_ms") or {}
+    if not lag.get("samples"):
+        failures.append("loop-lag monitor recorded no heartbeat samples")
+    elif lag.get("p50") is not None and lag["p50"] >= cfg.loop_lag_budget:
+        failures.append(
+            f"event-loop lag not flat: p50 {lag['p50']}ms >= "
+            f"{cfg.loop_lag_budget:g}ms budget "
+            f"({lag.get('slow_callbacks', 0)} slow callback(s))"
+        )
     if health.get("ok") is not True:
         failures.append("healthz ok flapped under load")
     if trap.records:
@@ -424,6 +445,7 @@ async def run_overload_drill(
         "seconds": seconds,
         "requests": stats,
         "overload": snap,
+        "loop_lag_ms": lag,
         "healthz_status": health.get("status"),
         "limits": snap["limits"],
     }

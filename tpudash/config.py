@@ -210,6 +210,12 @@ class Config:
     #: ``Retry-After`` seconds advertised on shed (503) responses.
     #: 0 → derived from refresh_interval (minimum 1 s).
     shed_retry_after: float = 0.0
+    #: Event-loop lag budget, milliseconds: the serving loop's lag
+    #: sanitizer (tpudash.analysis.asynccheck.LoopLagMonitor) records any
+    #: loop callback that runs longer than this, with stack attribution,
+    #: and surfaces heartbeat-lag p50/max as ``loop_lag_ms`` on
+    #: ``/api/timings`` and ``/healthz``.  0 disables the monitor.
+    loop_lag_budget: float = 250.0
 
     extra: dict = field(default_factory=dict)
 
@@ -255,6 +261,7 @@ _ENV_MAP = {
     "max_streams": "TPUDASH_MAX_STREAMS",
     "sse_write_deadline": "TPUDASH_SSE_WRITE_DEADLINE",
     "shed_retry_after": "TPUDASH_SHED_RETRY_AFTER",
+    "loop_lag_budget": "TPUDASH_LOOP_LAG_BUDGET",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",
@@ -288,6 +295,9 @@ _EXTRA_ENV = {
     # test harness: enable the runtime lock/race sanitizer
     # (tpudash/analysis/racecheck.py via tests/conftest.py)
     "TPUDASH_RACECHECK",
+    # test harness: enable the runtime event-loop lag sanitizer
+    # (tpudash/analysis/asynccheck.py via tests/conftest.py)
+    "TPUDASH_LOOPCHECK",
 }
 
 #: every declared environment variable name (Config-mapped + extras);

@@ -76,7 +76,15 @@ async def start_demo(cfg: Config | None = None) -> "tuple[web.AppRunner, web.App
 
     exporter_cfg, dash_cfg = demo_configs(cfg)
 
-    exporter_runner = web.AppRunner(make_exporter_app(exporter_cfg))
+    # app construction runs in the executor: DashboardService.__init__
+    # restores checkpoints/history from disk and sources open HTTP
+    # sessions — startup I/O that must not run on the serving loop
+    # (asynccheck rule ``async-blocking``)
+    loop = asyncio.get_running_loop()
+    exporter_app = await loop.run_in_executor(
+        None, make_exporter_app, exporter_cfg
+    )
+    exporter_runner = web.AppRunner(exporter_app)
     await exporter_runner.setup()
     try:
         await web.TCPSite(
@@ -97,7 +105,8 @@ async def start_demo(cfg: Config | None = None) -> "tuple[web.AppRunner, web.App
     # cleanup failures are suppressed so the ORIGINAL error (which port,
     # what failed) propagates, and one failed cleanup can't skip the next
     try:
-        dash_runner = web.AppRunner(make_dash_app(dash_cfg))
+        dash_app = await loop.run_in_executor(None, make_dash_app, dash_cfg)
+        dash_runner = web.AppRunner(dash_app)
         await dash_runner.setup()
     except Exception:
         with contextlib.suppress(Exception):

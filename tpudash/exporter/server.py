@@ -27,7 +27,10 @@ from tpudash.sources.base import MetricsSource, SourceError
 log = logging.getLogger(__name__)
 
 
-#: typed app-storage key (aiohttp deprecates bare string keys)
+#: typed app-storage key (aiohttp deprecates bare string keys).  The
+#: warmup task is RETAINED here — not fire-and-forget — so it cannot be
+#: garbage-collected mid-warm and ``cool`` can cancel it at shutdown
+#: (asynccheck rule ``unretained-task``).
 WARMUP_TASK = web.AppKey("warmup_task", asyncio.Task)
 
 
@@ -70,7 +73,12 @@ class ExporterServer:
         async with self._lock:
             loop = asyncio.get_running_loop()
             try:
-                samples = await loop.run_in_executor(None, self.source.fetch)
+                # fetch AND encode in one executor hop: exposition-text
+                # serialization is sync string work that scales with chip
+                # count and has no business on the serving loop
+                text = await loop.run_in_executor(
+                    None, lambda: encode_samples(self.source.fetch())
+                )
             except SourceError as e:
                 self.last_error = str(e)
                 # 503 keeps Prometheus' `up` metric honest for this target
@@ -79,7 +87,7 @@ class ExporterServer:
                 ) from e
         self.last_error = None
         return web.Response(
-            text=encode_samples(samples),
+            text=text,
             content_type="text/plain",
             charset="utf-8",
         )
