@@ -17,6 +17,13 @@ Three ways in, tried in order when no flag forces one:
    BUILD-time step; the Dockerfile runs it in the build stage, never at
    runtime).
 
+Paths 1 and 3 verify the wheel's sha256 against ``PLOTLY_WHEEL_SHA256``
+before extracting — the served bundle runs in every dashboard browser,
+so a version-only pin would trust whatever the index hands the build.
+Path 2 trusts the environment's own install integrity (the wheel is
+gone by then); ``--sha256 HEX`` overrides the pin for a deliberately
+different wheel.
+
 Usage:
     python deploy/fetch_plotly.py                      # auto (2 then 3)
     python deploy/fetch_plotly.py --wheel plotly-*.whl # offline
@@ -40,6 +47,16 @@ import zipfile
 #: plotly.js — figure dicts render identically on either load path.
 PLOTLY_PIN = "5.22.0"
 PLOTLY_JS_VERSION = "2.32.0"
+#: sha256 of ``plotly-5.22.0-py3-none-any.whl`` as published on PyPI —
+#: the pip-download path used to trust the index/mirror at image-build
+#: time (ADVICE r5): a compromised index could ship attacker JS to every
+#: dashboard browser.  Now the wheel bytes must hash to this before the
+#: bundle is extracted.  Recompute when bumping PLOTLY_PIN:
+#:   pip download --no-deps plotly==<pin> -d /tmp/w && sha256sum /tmp/w/*.whl
+#: (or read it off pypi.org/project/plotly/<pin>/#files).
+PLOTLY_WHEEL_SHA256 = (
+    "68fc1901f098daeb233cc3dd44ec9dc31fb3ca4f4e53189344199c43496ed006"
+)
 ASSET_IN_WHEEL = "plotly/package_data/plotly.min.js"
 DEFAULT_DEST = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -58,7 +75,9 @@ def _write_atomic(data: bytes, dest: str) -> str:
     return out
 
 
-def from_wheel(wheel_path: str, dest: str) -> str:
+def from_wheel(
+    wheel_path: str, dest: str, sha256: "str | None" = PLOTLY_WHEEL_SHA256
+) -> str:
     # the served URL is stamped with the plotly.js version the PIN's
     # wheel bundles — extracting any other wheel (e.g. the reference's
     # 6.0.1, which carries plotly.js 3.x) would serve the wrong major
@@ -71,6 +90,21 @@ def from_wheel(wheel_path: str, dest: str) -> str:
             f"{base} is plotly {parts[1]}, but the page contract needs "
             f"{PLOTLY_PIN} (bundles plotly.js {PLOTLY_JS_VERSION})"
         )
+    if sha256:
+        import hashlib
+
+        h = hashlib.sha256()
+        with open(wheel_path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        got = h.hexdigest()
+        if got != sha256:
+            raise SystemExit(
+                f"{base} sha256 mismatch:\n  expected {sha256}\n  got      "
+                f"{got}\nRefusing to vendor a bundle the pin does not vouch "
+                "for (compromised index/mirror, or a stale PLOTLY_WHEEL_SHA256"
+                " after a pin bump — see deploy/fetch_plotly.py)."
+            )
     with zipfile.ZipFile(wheel_path) as zf:
         try:
             data = zf.read(ASSET_IN_WHEEL)
@@ -105,7 +139,7 @@ def from_installed(dest: str) -> "str | None":
         return _write_atomic(f.read(), dest)
 
 
-def from_pip_download(dest: str) -> str:
+def from_pip_download(dest: str, sha256: "str | None" = PLOTLY_WHEEL_SHA256) -> str:
     with tempfile.TemporaryDirectory() as tmp:
         subprocess.run(
             [
@@ -123,19 +157,26 @@ def from_pip_download(dest: str) -> str:
         wheels = [f for f in os.listdir(tmp) if f.endswith(".whl")]
         if not wheels:
             raise SystemExit("pip download produced no wheel")
-        return from_wheel(os.path.join(tmp, wheels[0]), dest)
+        return from_wheel(os.path.join(tmp, wheels[0]), dest, sha256=sha256)
 
 
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--wheel", help="extract from this plotly wheel file")
     ap.add_argument("--dest", default=DEFAULT_DEST, help="drop directory")
+    ap.add_argument(
+        "--sha256",
+        default=PLOTLY_WHEEL_SHA256,
+        help="expected wheel sha256 (defaults to the pinned hash)",
+    )
     args = ap.parse_args(argv)
     os.makedirs(args.dest, exist_ok=True)
     if args.wheel:
-        out = from_wheel(args.wheel, args.dest)
+        out = from_wheel(args.wheel, args.dest, sha256=args.sha256)
     else:
-        out = from_installed(args.dest) or from_pip_download(args.dest)
+        out = from_installed(args.dest) or from_pip_download(
+            args.dest, sha256=args.sha256
+        )
     size_kb = os.path.getsize(out) // 1024
     print(f"vendored {out} ({size_kb} KB)")
     return 0

@@ -262,6 +262,95 @@ def _model_cases(frames: list) -> list:
     ):
         for active in (True, False):
             add("replay_bar_model", [pos, active])
+    # missing optional figure fields (ISSUE 5 satellite): gauge without
+    # steps/axis-range, scatter without line.color, heatmap without a
+    # colorscale — Python's explicit `in` guards and the generated JS
+    # must agree on the defaulted plan, not diverge via KeyError vs
+    # undefined
+    add(
+        "figure_render_plan",
+        [
+            {
+                "data": [
+                    {
+                        "type": "indicator",
+                        "value": 42.5,
+                        "gauge": {"bar": {"color": "#2ecc71"}},
+                    }
+                ],
+                "layout": {},
+            }
+        ],
+    )
+    add(
+        "figure_render_plan",
+        [{"data": [{"type": "indicator", "value": 7.0}], "layout": {}}],
+    )
+    add(
+        "figure_render_plan",
+        [{"data": [{"type": "scatter", "y": [1.0, 3.0, 2.0]}], "layout": {}}],
+    )
+    add(
+        "figure_render_plan",
+        [{"data": [{"type": "heatmap", "z": [[50.0, 80.0]]}], "layout": {}}],
+    )
+    # null (not merely missing) intermediates: plotly serializes an unset
+    # sub-object as null, where Python's `in` raises TypeError but the
+    # transpiled `in` (null-guarded hasOwnProperty) falls through — both
+    # sides must take the explicit is-not-None guard's default path
+    add(
+        "figure_render_plan",
+        [
+            {
+                "data": [
+                    {
+                        "type": "indicator",
+                        "value": 3.0,
+                        "gauge": {"axis": None, "bar": None, "steps": None},
+                    }
+                ],
+                "layout": {},
+            }
+        ],
+    )
+    add(
+        "figure_render_plan",
+        [
+            {
+                "data": [
+                    {
+                        "type": "indicator",
+                        "value": 8.0,
+                        "gauge": {"axis": {"range": []}},
+                    }
+                ],
+                "layout": {},
+            }
+        ],
+    )
+    add(
+        "figure_render_plan",
+        [{"data": [{"type": "indicator", "value": 1.0, "gauge": None}],
+          "layout": {}}],
+    )
+    add(
+        "figure_render_plan",
+        [
+            {
+                "data": [{"type": "scatter", "y": [2.0, 4.0], "line": None}],
+                "layout": {"yaxis": None},
+            }
+        ],
+    )
+    add(
+        "figure_render_plan",
+        [
+            {
+                "data": [{"type": "scatter", "y": [2.0, 4.0]}],
+                "layout": {"yaxis": {"range": []}},
+            }
+        ],
+    )
     # title/band edge cases the real figures may not exercise
     add("figure_title", [{"data": [{"title": {"text": ""}}],
                           "layout": {"title": {"text": "fallback"}}}])

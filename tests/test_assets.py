@@ -220,9 +220,28 @@ def test_fetch_plotly_extracts_from_wheel(tmp_path):
         zf.writestr(ASSET_IN_WHEEL, STUB_JS)
     dest = tmp_path / "assets"
     dest.mkdir()
-    out = from_wheel(str(wheel), str(dest))
+    import hashlib
+
+    stub_sha = hashlib.sha256(wheel.read_bytes()).hexdigest()
+    out = from_wheel(str(wheel), str(dest), sha256=stub_sha)
     assert out == str(dest / "plotly.min.js")
     assert (dest / "plotly.min.js").read_bytes() == STUB_JS
+
+
+def test_fetch_plotly_rejects_sha256_mismatch(tmp_path):
+    """The supply-chain gate (ADVICE r5): right version string, wrong
+    bytes — the default pinned hash must refuse to vendor the bundle."""
+    import zipfile
+
+    import pytest
+
+    from deploy.fetch_plotly import ASSET_IN_WHEEL, PLOTLY_PIN, from_wheel
+
+    wheel = tmp_path / f"plotly-{PLOTLY_PIN}-py3-none-any.whl"
+    with zipfile.ZipFile(wheel, "w") as zf:
+        zf.writestr(ASSET_IN_WHEEL, b"alert('not the pinned bundle')")
+    with pytest.raises(SystemExit, match="sha256 mismatch"):
+        from_wheel(str(wheel), str(tmp_path))  # default = pinned hash
 
 
 def test_fetch_plotly_rejects_wrong_version_wheel(tmp_path):

@@ -157,3 +157,46 @@ def test_regression_guard_prefers_frame_shaped_reference(tmp_path):
     matmul_only = dict(_result(value=8.4), cpu_ref_ms=53.2)
     _, regs = find_regressions(matmul_only, bench_dir=str(tmp_path))
     assert regs == []
+
+
+def test_tsdb_bench_measures_all_three_numbers():
+    """The probe itself at a small scale: throughput/ratio/p50 all come
+    back positive, and the ≥5x compression floor holds (the hard assert
+    inside bench_tsdb enforces it at full scale too)."""
+    from bench import bench_tsdb
+
+    out = bench_tsdb(n_frames=60, n_chips=8, n_cols=3)
+    assert out["tsdb_ingest_points_per_s"] > 0
+    assert out["tsdb_compression_ratio"] >= 5.0
+    assert 0 < out["tsdb_range_p50_ms"] < 1000.0
+
+
+def test_tsdb_regressions_flag(tmp_path):
+    _write_prev(
+        tmp_path,
+        value=6.0,
+        probes={},
+        tsdb_compression_ratio=12.0,
+        tsdb_ingest_points_per_s=300000,
+        tsdb_range_p50_ms=5.0,
+    )
+    # compression is deterministic: a 20% drop flags
+    worse = dict(
+        _result(),
+        tsdb_compression_ratio=9.0,
+        tsdb_ingest_points_per_s=290000,
+        tsdb_range_p50_ms=5.5,
+    )
+    _, regs = find_regressions(worse, bench_dir=str(tmp_path))
+    assert [r["metric"] for r in regs] == ["tsdb_compression_ratio"]
+    # time-domain numbers only flag on a 2x swing (noisy-host policy)
+    slow = dict(
+        _result(),
+        tsdb_compression_ratio=12.0,
+        tsdb_ingest_points_per_s=100000,
+        tsdb_range_p50_ms=12.0,
+    )
+    _, regs = find_regressions(slow, bench_dir=str(tmp_path))
+    assert sorted(r["metric"] for r in regs) == [
+        "tsdb_ingest_points_per_s", "tsdb_range_p50_ms",
+    ]

@@ -1,0 +1,803 @@
+"""tpudash.tsdb — codec, store, rollups, queries, service wiring, HTTP.
+
+Layer map (mirrors the package):
+
+- Gorilla codec: exact round-trips (bit patterns included), the ≥ 5×
+  compression-vs-JSON acceptance bar on a realistic fixture corpus;
+- store: seal pipeline visibility, segment persistence, torn-tail
+  recovery (byte-level AND a real kill -9 mid-append), series churn,
+  retention, disk-full degradation;
+- rollups: min/max/mean exactness against the raw points, partial-
+  bucket merging across block boundaries;
+- query: tier selection, step alignment, point budget, empty store,
+  error mapping;
+- service: ingest cadence, the ≥ 10× history_points horizon, legacy
+  npz-ring → segment migration (idempotent), churn-surviving
+  chip_series, synthetic-load pause;
+- HTTP: GET /api/range (shape, aggregates, 400/404, budget, overload
+  admission), tsdb counters on /api/timings.
+"""
+
+import asyncio
+import json
+import math
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from tpudash.tsdb import FLEET_SERIES, TSDB
+from tpudash.tsdb import gorilla
+from tpudash.tsdb.query import range_query
+from tpudash.tsdb.rollup import (
+    TIER_1M_MS,
+    merge_quads,
+    rollup_points,
+)
+
+# -- codec --------------------------------------------------------------------
+
+
+def _rt_ts(ts):
+    return gorilla.decode_timestamps(gorilla.encode_timestamps(ts), len(ts))
+
+
+def _rt_vals(vals):
+    return gorilla.decode_values(gorilla.encode_values(vals), len(vals))
+
+
+def test_timestamp_roundtrip_shapes():
+    cases = [
+        [],
+        [0],
+        [1_700_000_000_000],
+        [1_700_000_000_000 + 5000 * i for i in range(500)],  # perfect cadence
+        [1_700_000_000_000 + 5000 * i + (i % 7) * 3 for i in range(500)],
+        # clock steps backward, repeats, huge jumps — any int64 sequence
+        [100, 50, 50, -3_000_000, 2**62, -(2**62), 0],
+    ]
+    for ts in cases:
+        assert _rt_ts(ts) == ts
+
+
+def test_value_roundtrip_bit_patterns():
+    vals = [
+        0.0, -0.0, 1.0, -1.0, math.pi, 1e-308, 1.7e308,
+        float("inf"), float("-inf"), 73.25, 73.25, 73.25,
+    ]
+    out = _rt_vals(vals)
+    assert len(out) == len(vals)
+    for a, b in zip(vals, out):
+        assert struct.pack("<d", a) == struct.pack("<d", b)
+    # NaN round-trips as NaN (it spells "no sample at this timestamp")
+    nan_out = _rt_vals([1.0, float("nan"), 2.0, float("nan")])
+    assert nan_out[0] == 1.0 and nan_out[2] == 2.0
+    assert math.isnan(nan_out[1]) and math.isnan(nan_out[3])
+
+
+def test_value_roundtrip_random_float_fuzz():
+    rng = np.random.default_rng(42)
+    # adversarial: raw bit patterns reinterpreted as floats (NaN payloads,
+    # denormals, every exponent) must survive the XOR windows exactly
+    bits = rng.integers(0, 2**64, size=400, dtype=np.uint64)
+    vals = [struct.unpack("<d", struct.pack("<Q", int(b)))[0] for b in bits]
+    out = _rt_vals(vals)
+    for a, b in zip(vals, out):
+        assert struct.pack("<d", a) == struct.pack("<d", b)
+
+
+def _fixture_corpus():
+    """A realistic monitoring window: 720 points (1 h at 5 s cadence) of
+    near-periodic timestamps and typical dashboard series — exactly the
+    data the legacy JSON history tier shipped."""
+    rng = np.random.default_rng(7)
+    n = 720
+    ts = [1_700_000_000_000 + 5000 * i + int(rng.integers(-20, 20)) for i in range(n)]
+    series = {
+        # slowly-drifting utilization, rounded the way normalize emits it
+        "tensorcore_utilization": [
+            round(62.0 + 8.0 * math.sin(i / 40.0) + float(rng.normal(0, 0.5)), 2)
+            for i in range(n)
+        ],
+        # near-constant ratio
+        "hbm_usage_ratio": [round(0.71 + 0.001 * (i % 5), 4) for i in range(n)],
+        # stepwise power draw
+        "power_watts": [float(170 + 5 * ((i // 60) % 3)) for i in range(n)],
+    }
+    return ts, series
+
+
+def test_compression_ratio_vs_json_history():
+    ts, series = _fixture_corpus()
+    # the representation this store replaces: the /api/history JSON shape
+    json_bytes = len(
+        json.dumps(
+            [
+                {"ts": t / 1000.0, "averages": {c: series[c][i] for c in series}}
+                for i, t in enumerate(ts)
+            ],
+            separators=(",", ":"),
+        ).encode()
+    )
+    enc_bytes = len(gorilla.encode_timestamps(ts)) + sum(
+        len(gorilla.encode_values(v)) for v in series.values()
+    )
+    ratio = json_bytes / enc_bytes
+    assert ratio >= 5.0, f"compression ratio {ratio:.1f}x < 5x ({enc_bytes}B vs {json_bytes}B JSON)"
+    # round-trip on the same corpus is lossless
+    assert _rt_ts(ts) == ts
+    for col, vals in series.items():
+        assert _rt_vals(vals) == vals, col
+
+
+# -- rollups ------------------------------------------------------------------
+
+
+def test_rollup_exact_min_max_mean():
+    ts = [1_700_000_000_000 + 5000 * i for i in range(30)]  # spans 3 buckets
+    vals = [10.0 + i * 1.5 for i in range(30)]
+    stacked = np.array(vals, dtype=np.float64).reshape(30, 1, 1)
+    r = rollup_points(TIER_1M_MS, ts, ["k"], ["c"], stacked)
+    quads = r.series_quads("k", "c")
+    assert len(quads) >= 2
+    total_cnt = 0
+    for bucket, mn, mx, sm, cnt in quads:
+        in_bucket = [v for t, v in zip(ts, vals) if t // TIER_1M_MS * TIER_1M_MS == bucket]
+        assert mn == pytest.approx(min(in_bucket))
+        assert mx == pytest.approx(max(in_bucket))
+        assert sm / cnt == pytest.approx(sum(in_bucket) / len(in_bucket))
+        total_cnt += cnt
+    assert total_cnt == 30
+
+
+def test_rollup_nan_cells_keep_count_honest():
+    ts = [1_700_000_000_000 + 5000 * i for i in range(4)]
+    stacked = np.array(
+        [[[1.0]], [[float("nan")]], [[3.0]], [[float("nan")]]], dtype=np.float64
+    )
+    r = rollup_points(TIER_1M_MS, ts, ["k"], ["c"], stacked)
+    assert len({q[0] for q in r.series_quads("k", "c")}) == 1
+    _, mn, mx, sm, cnt = r.series_quads("k", "c")[0]
+    assert (mn, mx, sm, cnt) == (1.0, 3.0, 4.0, 2)
+    # all-NaN series drops out entirely instead of emitting count-0 junk
+    stacked_nan = np.full((4, 1, 1), np.nan)
+    r2 = rollup_points(TIER_1M_MS, ts, ["k"], ["c"], stacked_nan)
+    assert r2.series_quads("k", "c") == []
+
+
+def test_merge_quads_partial_buckets_are_exact():
+    # one wall-clock bucket split across two blocks: merged quad equals
+    # the quad of the union
+    b = 1_700_000_040_000 // TIER_1M_MS * TIER_1M_MS
+    part1 = (b, 1.0, 5.0, 9.0, 3)
+    part2 = (b, 0.5, 4.0, 8.5, 2)
+    (merged,) = merge_quads([part1, part2])
+    assert merged == (b, 0.5, 5.0, 17.5, 5)
+
+
+# -- store --------------------------------------------------------------------
+
+KEYS = ["slice-0/0", "slice-0/1", FLEET_SERIES]
+COLS = ["tensorcore_utilization", "power_watts"]
+
+
+def _fill(store, n, base=None, step_s=5.0, keys=KEYS, cols=COLS, value=None):
+    base = time.time() - 3000.0 if base is None else base
+    for i in range(n):
+        v = float(i) if value is None else value
+        mat = np.full((len(keys), len(cols)), v, dtype=np.float32)
+        store.append_frame(base + i * step_s, keys, cols, mat)
+    return base
+
+
+def test_store_points_visible_through_seal_pipeline():
+    store = TSDB(chunk_points=10)
+    base = _fill(store, 25)
+    # head (5 pts) + pending/sealed (20 pts): all 25 visible
+    lo, hi = gorilla.ts_to_ms(base), gorilla.ts_to_ms(base + 3600)
+    pts = store.raw_window("slice-0/0", "tensorcore_utilization", lo, hi)
+    assert len(pts) == 25
+    assert [v for _, v in pts] == [float(i) for i in range(25)]
+    store.flush(seal_partial=True)
+    assert len(store.raw_window("slice-0/0", "tensorcore_utilization", lo, hi)) == 25
+    assert store.stats()["raw_points"] == 25
+
+
+def test_store_nan_inf_round_trip_through_seal():
+    store = TSDB(chunk_points=4)
+    base = time.time() - 3000.0
+    specials = [1.0, float("nan"), float("inf"), float("-inf")]
+    for i, v in enumerate(specials):
+        store.append_frame(
+            base + i * 5.0, ["k"], ["c"], np.array([[v]], dtype=np.float32)
+        )
+    store.flush(seal_partial=True)
+    pts = store.raw_window(
+        "k", "c", gorilla.ts_to_ms(base) - 1, gorilla.ts_to_ms(base + 60)
+    )
+    assert len(pts) == 4
+    vals = [v for _, v in pts]
+    assert vals[0] == 1.0
+    assert math.isnan(vals[1])
+    assert vals[2] == float("inf") and vals[3] == float("-inf")
+    # NaN/inf never leak into aggregates: mean over the window is exact
+    res = range_query(store, "k", cols=["c"], start_s=base - 1, end_s=base + 60)
+    finite = [v for _, v in res["series"]["c"] if -1e308 < v < 1e308]
+    assert finite  # inf buckets may remain, but the 1.0 sample survives
+
+
+def test_store_non_monotonic_timestamps():
+    store = TSDB(chunk_points=4)
+    base = time.time() - 3000.0
+    stamps = [base + 20.0, base + 10.0, base + 30.0, base + 25.0]
+    for i, t in enumerate(stamps):
+        store.append_frame(t, ["k"], ["c"], np.array([[float(i)]], dtype=np.float32))
+    store.flush(seal_partial=True)
+    pts = store.raw_window(
+        "k", "c", gorilla.ts_to_ms(base), gorilla.ts_to_ms(base + 60)
+    )
+    # ts-sorted out, every point kept (clock steps must not lose data)
+    assert [t for t, _ in pts] == sorted(gorilla.ts_to_ms(t) for t in stamps)
+    assert len(pts) == 4
+
+
+def test_store_series_churn_old_blocks_keep_serving():
+    store = TSDB(chunk_points=4)
+    base = time.time() - 3000.0
+    both, solo = ["a", "b"], ["a"]
+    _fill(store, 6, base=base, keys=both, cols=["c"])
+    _fill(store, 6, base=base + 100, keys=solo, cols=["c"])  # b departs
+    _fill(store, 6, base=base + 200, keys=both, cols=["c"])  # b returns
+    store.flush(seal_partial=True)
+    lo, hi = gorilla.ts_to_ms(base - 1), gorilla.ts_to_ms(base + 400)
+    assert store.series_keys() == {"a", "b"}
+    a_pts = store.raw_window("a", "c", lo, hi)
+    b_pts = store.raw_window("b", "c", lo, hi)
+    assert len(a_pts) == 18
+    assert len(b_pts) == 12  # both eras, not the middle
+    # the departed era leaves a hole, not interpolated junk
+    b_ts = [t for t, _ in b_pts]
+    assert gorilla.ts_to_ms(base + 100) not in b_ts
+
+
+def test_store_persistence_round_trip(tmp_path):
+    d = str(tmp_path / "tsdb")
+    store = TSDB(path=d, chunk_points=5)
+    base = _fill(store, 23)
+    store.close()  # graceful: seals the partial head too
+    re = TSDB(path=d)
+    assert re.stats()["raw_points"] == 23
+    lo, hi = gorilla.ts_to_ms(base) - 1, gorilla.ts_to_ms(base + 3600)
+    pts = re.raw_window("slice-0/0", "power_watts", lo, hi)
+    assert [v for _, v in pts] == [float(i) for i in range(23)]
+    # rollup shadows persisted alongside
+    assert sum(re.stats()["rollup_blocks"].values()) > 0
+
+
+def test_store_torn_tail_truncated_not_fatal(tmp_path):
+    d = str(tmp_path / "tsdb")
+    store = TSDB(path=d, chunk_points=5)
+    _fill(store, 10)  # two sealed chunks
+    store.flush()
+    segs = [f for f in os.listdir(d) if f.startswith("raw-")]
+    assert segs
+    seg = os.path.join(d, sorted(segs)[-1])
+    good = os.path.getsize(seg)
+    # crash mid-append: half a frame header + garbage lands at the tail
+    with open(seg, "ab") as f:
+        f.write(b"TSB1\x01garbage-torn-mid-write")
+    re = TSDB(path=d)
+    assert re.stats()["raw_points"] == 10  # sealed data all intact
+    assert os.path.getsize(seg) == good  # tail truncated back
+
+
+def test_store_corrupt_crc_mid_file_stops_trust(tmp_path):
+    d = str(tmp_path / "tsdb")
+    store = TSDB(path=d, chunk_points=5)
+    _fill(store, 15)  # three sealed records
+    store.flush()
+    seg = os.path.join(
+        d, sorted(f for f in os.listdir(d) if f.startswith("raw-"))[0]
+    )
+    data = bytearray(open(seg, "rb").read())
+    # flip one payload byte in the SECOND record: its CRC now lies
+    hdr = struct.Struct("<4sBII")
+    _, _, plen, _ = hdr.unpack_from(data, 0)
+    second = hdr.size + plen
+    data[second + hdr.size + 3] ^= 0xFF
+    open(seg, "wb").write(bytes(data))
+    re = TSDB(path=d)
+    # first record loads; corruption ends that file's replay
+    assert 0 < re.stats()["raw_points"] < 15
+
+
+def test_store_disk_full_degrades_to_memory(tmp_path, monkeypatch):
+    d = str(tmp_path / "tsdb")
+    store = TSDB(path=d, chunk_points=3)
+    real_open = open
+
+    def failing_open(path, mode="r", *a, **k):
+        if isinstance(path, str) and path.endswith(".seg") and "a" in mode:
+            raise OSError(28, "No space left on device")
+        return real_open(path, mode, *a, **k)
+
+    import builtins
+
+    monkeypatch.setattr(builtins, "open", failing_open)
+    base = _fill(store, 7)
+    store.flush()
+    assert store.last_disk_error is not None
+    # ingest and queries kept working in memory
+    assert store.stats()["raw_points"] + store.stats()["head_points"] == 7
+    monkeypatch.setattr(builtins, "open", real_open)
+    _fill(store, 3, base=base + 1000)
+    store.flush(seal_partial=True)
+    assert store.last_disk_error is None  # recovered and logged
+
+
+def test_store_retention_drops_expired_blocks_and_segments(tmp_path):
+    d = str(tmp_path / "tsdb")
+    # raw retention 1 h; write blocks 2 h old and fresh ones
+    store = TSDB(path=d, chunk_points=4, retention_raw_s=3600.0)
+    _fill(store, 8, base=time.time() - 7200.0)
+    store.flush()
+    # seal-time retention already dropped the 2 h-old raw blocks …
+    assert store.stats()["raw_points"] == 0
+    # … but their rollup shadows outlive raw (longer retention)
+    assert sum(store.stats()["rollup_blocks"].values()) > 0
+    _fill(store, 8, base=time.time() - 60.0)
+    store.flush()
+    # only the fresh points remain in the raw tier
+    assert store.stats()["raw_points"] == 8
+
+
+def test_store_kill9_mid_append_loses_at_most_the_head(tmp_path):
+    """The acceptance drill, compressed: a writer child is SIGKILLed
+    mid-segment-append; reopen must load cleanly and keep every sealed
+    record.  (CI's chaos-soak job runs the longer multi-round
+    ``python -m tpudash.tsdb drill``.)"""
+    d = str(tmp_path / "tsdb")
+    child = (
+        "import sys, time, numpy as np\n"
+        "from tpudash.tsdb import TSDB\n"
+        "store = TSDB(path=sys.argv[1], chunk_points=4)\n"
+        "base = time.time() - 1800.0\n"
+        "i = 0\n"
+        "while True:\n"
+        "    mat = np.full((4, 3), float(i), dtype=np.float32)\n"
+        "    store.append_frame(base + i * 5.0, ['a','b','c','d'], ['x','y','z'], mat)\n"
+        "    store.flush()\n"
+        "    i += 1\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child, d],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            raw_segs = (
+                [f for f in os.listdir(d) if f.startswith("raw-")]
+                if os.path.isdir(d)
+                else []
+            )
+            if raw_segs and os.path.getsize(os.path.join(d, raw_segs[0])) > 0:
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"writer died early: {proc.stderr.read().decode()}"
+                )
+            time.sleep(0.05)
+        time.sleep(0.3)  # let a few more appends land, then kill mid-flight
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    re = TSDB(path=d)  # must not raise: torn tail truncates
+    assert re.stats()["raw_points"] > 0, "no sealed data survived the kill"
+    # a reopened store appends cleanly after recovery
+    _fill(re, 5, base=time.time() - 60.0, keys=["a"], cols=["x"])
+    re.flush(seal_partial=True)
+    re2 = TSDB(path=d)
+    assert re2.stats()["raw_points"] >= re.stats()["raw_points"]
+
+
+def test_segment_frame_crc_layout():
+    """The on-disk frame is exactly magic|type|len|crc32|payload — the
+    recovery walk depends on this layout staying fixed."""
+    from tpudash.tsdb.store import _FRAME_HDR, _MAGIC
+
+    assert _FRAME_HDR.size == 13
+    payload = b"hello"
+    frame = _FRAME_HDR.pack(_MAGIC, 1, len(payload), zlib.crc32(payload)) + payload
+    magic, typ, plen, crc = _FRAME_HDR.unpack_from(frame, 0)
+    assert (magic, typ, plen) == (_MAGIC, 1, 5)
+    assert crc == zlib.crc32(payload)
+
+
+# -- query layer --------------------------------------------------------------
+
+
+def test_range_query_empty_store_is_well_formed():
+    store = TSDB()
+    res = range_query(store, "anything", cols=["c"])
+    assert res["series"] == {"c": []}
+    res2 = range_query(store, FLEET_SERIES)
+    assert res2["series"] == {}
+
+
+def test_range_query_point_budget_is_a_ceiling():
+    store = TSDB(chunk_points=50)
+    _fill(store, 400, step_s=1.0)
+    store.flush(seal_partial=True)
+    res = range_query(
+        store, "slice-0/0", cols=["power_watts"], max_points=40
+    )
+    assert 0 < len(res["series"]["power_watts"]) <= 40
+
+
+def test_range_query_aggregates_are_exact():
+    store = TSDB(chunk_points=10)
+    base = time.time() - 3000.0
+    vals = [5.0, 1.0, 9.0, 3.0, 7.0, 2.0]
+    for i, v in enumerate(vals):
+        store.append_frame(
+            base + i * 5.0, ["k"], ["c"], np.array([[v]], dtype=np.float32)
+        )
+    store.flush(seal_partial=True)
+    window = dict(start_s=base - 1, end_s=base + 60, cols=["c"])
+    # one wide step bucket: min/max/mean over every point
+    for agg, want in (("min", 1.0), ("max", 9.0), ("mean", sum(vals) / len(vals))):
+        res = range_query(store, "k", agg=agg, step_s=120.0, **window)
+        (pt,) = res["series"]["c"]
+        assert pt[1] == pytest.approx(want), agg
+
+
+def test_range_query_wide_step_prefers_rollup_tier():
+    store = TSDB(chunk_points=30)
+    _fill(store, 120, step_s=5.0)
+    store.flush(seal_partial=True)
+    res = range_query(
+        store, "slice-0/0", cols=["power_watts"], step_s=600.0
+    )
+    assert res["resolution"] in ("1m", "10m")
+    raw = range_query(store, "slice-0/0", cols=["power_watts"], step_s=600.0, agg="max")
+    # rollup answer equals the raw-point answer (rollups are exact)
+    res_fine = range_query(
+        store, "slice-0/0", cols=["power_watts"], agg="max", max_points=5000
+    )
+    assert max(v for _, v in raw["series"]["power_watts"]) == pytest.approx(
+        max(v for _, v in res_fine["series"]["power_watts"])
+    )
+
+
+def test_range_query_error_mapping():
+    store = TSDB()
+    with pytest.raises(ValueError):
+        range_query(store, "k", agg="p99")
+    _fill(store, 3)
+    with pytest.raises(ValueError):
+        range_query(store, "k", start_s=2000.0, end_s=1000.0)
+
+
+def test_rollups_answer_past_raw_retention():
+    """The whole point of tiering: min/max/mean survive raw expiry."""
+    store = TSDB(chunk_points=4, retention_raw_s=600.0)  # raw: 10 min
+    base = time.time() - 5400.0  # 90 min ago: raw expired, 1m lives
+    _fill(store, 8, base=base, keys=["k"], cols=["c"])
+    store.flush(seal_partial=True)
+    store._enforce_retention()
+    assert store.stats()["raw_points"] == 0
+    res = range_query(store, "k", cols=["c"], start_s=base - 1, end_s=base + 600)
+    assert res["resolution"] in ("1m", "10m")
+    assert res["series"]["c"], "rollups must keep answering after raw expiry"
+
+
+# -- service wiring -----------------------------------------------------------
+
+
+def _service(tmp_path=None, chips=4, frames=40, **cfg_kw):
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    kw = dict(refresh_interval=0.0, synthetic_chips=chips)
+    if tmp_path is not None:
+        kw["tsdb_path"] = str(tmp_path / "tsdb")
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    return DashboardService(cfg, JsonReplaySource.synthetic(chips, frames=frames))
+
+
+def test_publish_mirrors_into_tsdb():
+    svc = _service()
+    for _ in range(12):
+        svc.render_frame()
+    assert svc.tsdb is not None
+    assert svc.tsdb.point_count("slice-0/0") == 12
+    assert svc.tsdb.point_count(FLEET_SERIES) == 12
+    cols = svc.tsdb.series_cols("slice-0/0")
+    assert "tpu_tensorcore_utilization" in cols
+
+
+def test_range_horizon_exceeds_ten_x_history_points():
+    """Acceptance: the store serves per-chip min/max/mean across a
+    horizon ≥ 10× the in-memory ring (history_points)."""
+    svc = _service(history_points=10, frames=60)
+    for _ in range(110):
+        svc.render_frame()
+    assert len(svc.chip_history) == 10  # ring capped
+    assert svc.tsdb.point_count("slice-0/0") >= 100  # ≥ 10× the ring
+    for agg in ("min", "max", "mean"):
+        res = range_query(
+            svc.tsdb,
+            "slice-0/0",
+            cols=["tpu_tensorcore_utilization"],
+            start_s=time.time() - 3600.0,
+            agg=agg,
+            max_points=5000,
+        )
+        assert len(res["series"]["tpu_tensorcore_utilization"]) >= 100
+    # chip_series serves the long record too (the ring alone caps at 10)
+    series = svc.chip_series("slice-0/0")
+    assert len(series) >= 100
+
+
+def test_trends_serve_from_store_past_the_ring():
+    svc = _service(history_points=10, frames=60)
+    for _ in range(40):
+        svc.render_frame()
+    frame = svc.render_frame()
+    trends = frame.get("trends", [])
+    assert trends
+    # sparkline carries more points than the ring could ever hold
+    ys = trends[0]["figure"]["data"][0]["y"]
+    assert len(ys) > 10
+
+
+def test_chip_series_survives_ring_population_reset():
+    """Chip churn resets the in-memory ring; the store keeps serving the
+    departed-and-returned chip's full record."""
+    svc = _service(chips=4)
+    for _ in range(6):
+        svc.render_frame()
+    # simulate churn: the ring resets as if the population changed
+    svc.chip_history.clear()
+    svc._chip_hist_keys = []
+    svc._chip_hist_cols = []
+    svc._chip_hist_rowmap = {}
+    series = svc.chip_series("slice-0/0")
+    assert series is not None and len(series) == 6
+    # a chip NO tier has seen is still a 404 upstream
+    assert svc.chip_series("slice-9/99") is None
+
+
+def test_chip_series_budget_and_rollup_fallback():
+    """chip_series reads through range_query: the point budget is a
+    hard ceiling however many raw points the store holds, and a chip
+    whose RAW points expired still serves its rollup record (the old
+    raw-only read silently truncated to raw retention)."""
+    svc = _service()
+    # 1200 direct appends (> the ~500-point default budget), 5 s apart
+    base = time.time() - 1200 * 5.0
+    keys = ["slice-0/0"]
+    for i in range(1200):
+        mat = np.full((1, 2), float(i), dtype=np.float32)
+        svc.tsdb.append_frame(
+            base + i * 5.0, keys, ["c1", "c2"], mat
+        )
+    pts = svc._tsdb_chip_points("slice-0/0")
+    assert pts is not None
+    budget = max(svc.cfg.history_points, 500)
+    assert len(pts) <= budget < 1200  # budget ceiling, not 1200 raw rows
+    # full horizon survives the budget: first and last samples covered
+    assert pts[0][0] <= base + 5.0 * 500
+    assert pts[-1][0] >= base + 5.0 * 1100
+    # raw expiry: the store keeps serving the chip from rollups
+    svc2 = _service(tsdb_chunk_points=4, tsdb_retention_raw=600.0)
+    old = time.time() - 5400.0  # raw (10 min) long expired, 1m lives
+    for i in range(8):
+        mat = np.full((1, 1), float(i), dtype=np.float32)
+        svc2.tsdb.append_frame(old + i * 5.0, keys, ["c"], mat)
+    svc2.tsdb.flush(seal_partial=True)
+    svc2.tsdb._enforce_retention()
+    assert svc2.tsdb.stats()["raw_points"] == 0
+    pts = svc2._tsdb_chip_points("slice-0/0")
+    assert pts, "rollup tiers must keep serving chip history"
+
+
+def test_synthetic_load_pauses_tsdb_ingest():
+    svc = _service()
+    for _ in range(3):
+        svc.render_frame()
+    before = svc.tsdb.point_count(FLEET_SERIES)
+    with svc.synthetic_load():
+        for _ in range(5):
+            svc.render_frame()
+    assert svc.tsdb.point_count(FLEET_SERIES) == before
+    svc.render_frame()
+    assert svc.tsdb.point_count(FLEET_SERIES) == before + 1
+
+
+def test_legacy_npz_history_migrates_into_segments(tmp_path):
+    """The one-time migration: a legacy npz ring snapshot seeds the tsdb
+    (durably, when a path is set) and never double-seeds."""
+    hist = str(tmp_path / "trend.npz")
+    svc1 = _service(history_path=hist)
+    for _ in range(9):
+        svc1.render_frame()
+    svc1.save_history()
+    assert os.path.exists(hist)
+    # restart with BOTH the legacy snapshot and a tsdb path: rings load
+    # from npz, then seed the store, sealed straight into segments
+    svc2 = _service(tmp_path, history_path=hist, frames=40)
+    pts2 = svc2.tsdb.stats()["raw_points"]
+    assert pts2 >= 9
+    assert any(f.endswith(".seg") for f in os.listdir(tmp_path / "tsdb"))
+    # second restart: segments already carry the history — seeding skips,
+    # no duplication
+    svc3 = _service(tmp_path, history_path=hist, frames=40)
+    assert svc3.tsdb.stats()["raw_points"] == pts2
+
+
+def test_tsdb_unavailable_never_breaks_the_dashboard(monkeypatch):
+    from tpudash.tsdb import TSDB as _TSDB
+
+    monkeypatch.setattr(
+        _TSDB, "from_config", classmethod(lambda cls, cfg: (_ for _ in ()).throw(OSError("boom")))
+    )
+    svc = _service()
+    assert svc.tsdb is None
+    frame = svc.render_frame()  # frames keep working without history tier
+    assert frame["error"] is None
+    assert svc.chip_series("slice-0/0") is not None  # ring still serves
+
+
+def test_close_tsdb_seals_partial_head(tmp_path):
+    svc = _service(tmp_path)
+    for _ in range(5):
+        svc.render_frame()
+    assert svc.tsdb.stats()["head_points"] == 5  # nothing sealed yet
+    svc.close_tsdb()
+    re = TSDB(path=str(tmp_path / "tsdb"))
+    assert re.stats()["raw_points"] == 5  # graceful shutdown lost nothing
+
+
+# -- HTTP ---------------------------------------------------------------------
+
+
+def _server(svc):
+    from tpudash.app.server import DashboardServer
+
+    return DashboardServer(svc)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _with_client(app, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_api_range_endpoint_shapes_and_errors():
+    svc = _service(history_points=10, frames=60)
+    for _ in range(30):
+        svc.render_frame()
+    srv = _server(svc)
+
+    async def go(client):
+        # fleet default
+        resp = await client.get("/api/range")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["chip"] == "fleet"
+        assert body["agg"] == "mean"
+        assert body["series"]["tpu_tensorcore_utilization"]
+        # per-chip, explicit cols + agg + budget
+        resp = await client.get(
+            "/api/range",
+            params={
+                "chip": "slice-0/1",
+                "cols": "tpu_tensorcore_utilization",
+                "agg": "max",
+                "points": "7",
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert list(body["series"]) == ["tpu_tensorcore_utilization"]
+        assert 0 < len(body["series"]["tpu_tensorcore_utilization"]) <= 7
+        for ts, v in body["series"]["tpu_tensorcore_utilization"]:
+            assert isinstance(ts, float) and (v is None or isinstance(v, float))
+        # 404: a series no tier ever carried
+        resp = await client.get("/api/range", params={"chip": "slice-9/99"})
+        assert resp.status == 404
+        # 400s: malformed number, bad agg, inverted window
+        for params in (
+            {"start": "abc"},
+            {"agg": "p99"},
+            {"start": "2000", "end": "1000"},
+        ):
+            resp = await client.get("/api/range", params=params)
+            assert resp.status == 400, params
+
+    _run(_with_client(srv.build_app(), go))
+
+
+def test_api_range_is_admitted_under_the_overload_guard():
+    svc = _service()
+    svc.render_frame()
+    srv = _server(svc)
+    srv.overload.admit = lambda *a, **k: "saturated"  # force a shed
+
+    async def go(client):
+        resp = await client.get("/api/range")
+        assert resp.status == 503
+        assert "Retry-After" in resp.headers
+
+    _run(_with_client(srv.build_app(), go))
+
+
+def test_api_timings_carries_tsdb_counters():
+    svc = _service()
+    for _ in range(3):
+        svc.render_frame()
+    srv = _server(svc)
+
+    async def go(client):
+        resp = await client.get("/api/timings")
+        body = await resp.json()
+        assert "tsdb" in body
+        assert body["tsdb"]["raw_points"] + body["tsdb"]["head_points"] == 3
+        assert body["tsdb"]["last_disk_error"] is None
+
+    _run(_with_client(srv.build_app(), go))
+
+
+def test_graceful_shutdown_seals_via_cleanup(tmp_path):
+    svc = _service(tmp_path)
+    for _ in range(4):
+        svc.render_frame()
+    srv = _server(svc)
+
+    async def go(client):
+        resp = await client.get("/api/frame")
+        assert resp.status == 200
+
+    _run(_with_client(srv.build_app(), go))  # close() runs on_cleanup
+    re = TSDB(path=str(tmp_path / "tsdb"))
+    # ≥ 4 pre-request frames (the GET /api/frame above refreshed once
+    # more): the point is that the UNSEALED head survived the shutdown
+    assert re.stats()["raw_points"] >= 4
+
+
+def test_tsdb_drill_cli_stats(tmp_path):
+    """``python -m tpudash.tsdb stats`` dumps a store's counters."""
+    d = str(tmp_path / "tsdb")
+    store = TSDB(path=d, chunk_points=4)
+    _fill(store, 9)
+    store.close()
+    out = subprocess.run(
+        [sys.executable, "-m", "tpudash.tsdb", "stats", "--dir", d],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        check=True,
+    )
+    stats = json.loads(out.stdout)
+    assert stats["raw_points"] == 9

@@ -282,13 +282,34 @@ def figure_render_plan(fig):
     t = fig["data"][0]
     title = figure_title(fig)
     if t["type"] == "indicator":
+        # every gauge sub-field is optional on the wire: a figure built
+        # without steps / axis range / bar color must take the SAME
+        # guarded path here and in the generated JS (missing-key access
+        # raises KeyError in Python but yields undefined in JS — an
+        # explicit `in` check is the only shape both sides agree on)
+        mx = 100
+        steps = []
+        color = None
+        if "gauge" in t and t["gauge"] is not None:
+            g = t["gauge"]
+            if "axis" in g and g["axis"] is not None:
+                if "range" in g["axis"]:
+                    r = g["axis"]["range"]
+                    if r is not None and len(r) > 1:
+                        mx = r[1]
+            if "steps" in g:
+                if g["steps"] is not None:
+                    steps = g["steps"]
+            if "bar" in g and g["bar"] is not None:
+                if "color" in g["bar"]:
+                    color = g["bar"]["color"]
         return {
             "kind": "meter",
             "title": title,
             "value": t["value"],
-            "max": t["gauge"]["axis"]["range"][1],
-            "steps": t["gauge"]["steps"],
-            "color": t["gauge"]["bar"]["color"],
+            "max": mx,
+            "steps": steps,
+            "color": color,
         }
     if t["type"] == "bar":
         return {
@@ -310,6 +331,9 @@ def figure_render_plan(fig):
         cd = None
         if "customdata" in t:
             cd = t["customdata"]
+        cs = None
+        if "colorscale" in t:
+            cs = t["colorscale"]
         return {
             "kind": "heat",
             "title": title,
@@ -317,16 +341,17 @@ def figure_render_plan(fig):
             "zmax": zmax,
             "cols": cols,
             "customdata": cd,
-            "colorscale": t["colorscale"],
+            "colorscale": cs,
         }
     if t["type"] == "scatter":
         ys = t["y"]
         ymax = None
         lay = fig["layout"]
-        if "yaxis" in lay:
+        if "yaxis" in lay and lay["yaxis"] is not None:
             if "range" in lay["yaxis"]:
-                if lay["yaxis"]["range"] is not None:
-                    ymax = lay["yaxis"]["range"][1]
+                yr = lay["yaxis"]["range"]
+                if yr is not None and len(yr) > 1:
+                    ymax = yr[1]
         if ymax is None or ymax == 0:
             ymax = 1
             for i in range(len(ys)):
@@ -335,12 +360,16 @@ def figure_render_plan(fig):
         last = None
         if len(ys) > 0:
             last = ys[len(ys) - 1]
+        color = None
+        if "line" in t and t["line"] is not None:
+            if "color" in t["line"]:
+                color = t["line"]["color"]
         return {
             "kind": "spark",
             "title": title,
             "ys": ys,
             "ymax": ymax,
-            "color": t["line"]["color"],
+            "color": color,
             "last": last,
         }
     return {"kind": "none"}

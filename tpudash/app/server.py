@@ -17,6 +17,8 @@ Routes (full reference: docs/API.md):
   POST /api/style             {"use_gauge": bool}  (per session)
   GET  /api/chip?key=…        single-chip drill-down
   GET  /api/history[?chip=…]  fleet-average or per-chip raw history
+  GET  /api/range             long-horizon min/max/mean series from the
+                              compressed trend store (tpudash.tsdb)
   GET  /api/alerts            current alert states
   GET  /api/stragglers        fleet outliers (SPMD lockstep stragglers)
   GET  /api/alert-rules.yaml  rules as a Prometheus alerting-rule file
@@ -765,6 +767,13 @@ class DashboardServer:
         summary = self.service.timer.summary()
         summary["overload"] = self.overload.snapshot()
         summary["loop_lag_ms"] = self.loop_monitor.summary()
+        if self.service.tsdb is not None:
+            # store counters (blocks/points/bytes/disk state); stats()
+            # takes the store's sync lock, so it rides the executor
+            loop = asyncio.get_running_loop()
+            summary["tsdb"] = await loop.run_in_executor(
+                None, self.service.tsdb.stats
+            )
         return _json_response(summary)
 
     async def profile(self, request: web.Request) -> web.Response:
@@ -891,18 +900,24 @@ class DashboardServer:
         """Raw rolling history: fleet-average values per metric, or — with
         ``?chip=<key>`` — one chip's own series from the per-chip ring."""
         chip = request.query.get("chip")
-        async with self._lock:  # render_frame appends from the worker thread
-            if chip is None:
+        if chip is None:
+            async with self._lock:  # render_frame appends from a worker
                 snapshot = list(self.service.history)
-                return _json_response(
-                    {
-                        "history": [
-                            {"ts": ts, "averages": avgs}
-                            for ts, avgs in snapshot
-                        ]
-                    }
-                )
-            series = self.service.chip_series(chip)
+            return _json_response(
+                {
+                    "history": [
+                        {"ts": ts, "averages": avgs}
+                        for ts, avgs in snapshot
+                    ]
+                }
+            )
+        # the chip path may decode compressed tsdb chunks (chip_series
+        # takes the service's own lock internally) — executor, never
+        # the event loop
+        loop = asyncio.get_running_loop()
+        series = await loop.run_in_executor(
+            None, self.service.chip_series, chip
+        )
         if series is None:
             raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
         return _json_response(
@@ -913,6 +928,87 @@ class DashboardServer:
                 ],
             }
         )
+
+    async def range_api(self, request: web.Request) -> web.Response:
+        """Long-horizon range query over the compressed trend store
+        (``tpudash.tsdb``) — the diagnosis surface the rolling rings
+        cannot offer.  Query params, all optional except none:
+
+        - ``chip=<slice>/<id>`` — one chip's series; omitted = the
+          fleet-average pseudo-series
+        - ``cols=a,b`` — column subset (default: every column the series
+          carries)
+        - ``start=<epoch_s>`` / ``end=<epoch_s>`` — window (default:
+          newest sample back one hour)
+        - ``step=<seconds>`` — alignment step; widened server-side when
+          the point budget demands it
+        - ``agg=mean|min|max`` — bucket aggregate (default mean)
+        - ``points=<n>`` — point budget per column (ceiling 5000)
+
+        Admitted under the OverloadGuard like every data route; the
+        store read (chunk decode) runs in the executor, never on the
+        event loop.  400 on malformed params, 404 for a series no tier
+        has ever carried."""
+        svc = self.service
+        if svc.tsdb is None:
+            raise web.HTTPServiceUnavailable(text="trend store unavailable")
+        q = request.query
+
+        def _num(name: str) -> "float | None":
+            raw = q.get(name)
+            if raw is None or raw == "":
+                return None
+            try:
+                return float(raw)
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=f"{name} must be a number, not {raw!r}"
+                ) from None
+
+        start_s, end_s, step_s = _num("start"), _num("end"), _num("step")
+        points = _num("points")
+        chip = q.get("chip")
+        cols_q = q.get("cols")
+        cols = (
+            [c for c in cols_q.split(",") if c] if cols_q is not None else None
+        )
+        from tpudash.tsdb import FLEET_SERIES
+        from tpudash.tsdb.query import DEFAULT_POINTS, range_query
+
+        key = chip if chip else FLEET_SERIES
+
+        def run():
+            tsdb = svc.tsdb
+            if key != FLEET_SERIES and not tsdb.series_cols(key):
+                return None  # no tier ever carried this series → 404
+            return range_query(
+                tsdb,
+                key,
+                cols=cols,
+                start_s=start_s,
+                end_s=end_s,
+                step_s=step_s,
+                agg=q.get("agg", "mean"),
+                max_points=int(points) if points else DEFAULT_POINTS,
+            )
+
+        loop = asyncio.get_running_loop()
+        try:
+            res = await loop.run_in_executor(None, run)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from e
+        if res is None:
+            raise web.HTTPNotFound(text=f"unknown series {chip!r}")
+        # strict-JSON hygiene: a stored ±inf must not emit bare Infinity
+        res["series"] = {
+            c: [
+                [ts, (v if -1e308 < v < 1e308 else None)]
+                for ts, v in pts
+            ]
+            for c, pts in res["series"].items()
+        }
+        res["chip"] = chip or "fleet"
+        return _json_response(res)
 
     async def chip(self, request: web.Request) -> web.Response:
         """Single-chip drill-down model (identity + gauges + chip trends +
@@ -1222,16 +1318,20 @@ class DashboardServer:
         per metric) for offline analysis — fleet averages by default, one
         chip's own series with ``?chip=``."""
         chip = request.query.get("chip")
-        async with self._lock:
-            if chip is None:
+        if chip is None:
+            async with self._lock:
                 rows = [
                     (ts, dict(avgs)) for ts, avgs in self.service.history
                 ]
-            else:
-                series = self.service.chip_series(chip)
-                if series is None:
-                    raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
-                rows = series
+        else:
+            # chunk decode off the loop, same as the JSON history route
+            loop = asyncio.get_running_loop()
+            series = await loop.run_in_executor(
+                None, self.service.chip_series, chip
+            )
+            if series is None:
+                raise web.HTTPNotFound(text=f"unknown chip {chip!r}")
+            rows = series
         columns: list = []
         for _, values in rows:
             for c in values:
@@ -1470,6 +1570,7 @@ class DashboardServer:
         app.router.add_post("/api/profile", self.profile)
         app.router.add_get("/api/history", self.history)
         app.router.add_get("/api/history.csv", self.history_csv)
+        app.router.add_get("/api/range", self.range_api)
         app.router.add_get("/api/chip", self.chip)
         app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/topology", self.topology)
@@ -1498,6 +1599,15 @@ class DashboardServer:
                 await self._save_state()
 
             app.on_cleanup.append(_save_state_on_exit)
+        if self.service.tsdb is not None:
+            # graceful shutdown seals the tsdb's partial head chunk (a
+            # crash loses only that head — the drill asserts it); the
+            # seal encodes + fsyncs, so it rides the executor
+            async def _close_tsdb(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.close_tsdb)
+
+            app.on_cleanup.append(_close_tsdb)
         return app
 
 

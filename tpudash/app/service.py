@@ -182,6 +182,21 @@ class DashboardService:
         #: full-table dense block from the last refresh — shared by the
         #: history appends and select-all composes
         self._df_block = (None, [])
+        #: the long-horizon compressed trend store (tpudash.tsdb): every
+        #: ring append mirrors into it, sparklines/drill-downs serve
+        #: from it once it holds more than the rings, and /api/range is
+        #: its query surface.  Always on (in-memory when TPUDASH_TSDB_PATH
+        #: is unset); never a startup crash — the dashboard must run
+        #: even when the store's volume is gone.
+        from tpudash.tsdb import TSDB
+
+        try:
+            self.tsdb: "TSDB | None" = TSDB.from_config(cfg)
+        except Exception as e:  # noqa: BLE001 — history tier is best-effort
+            log.warning("tsdb unavailable: %s", e)
+            self.tsdb = None
+        #: (cache key, {col: [(ts, v), ...]}) for the fleet sparkline query
+        self._tsdb_trend_cache: tuple = (None, None)
         if cfg.history_backfill > 0:
             self._backfill_history()
         #: trend persistence (TPUDASH_HISTORY_PATH): restore the rings
@@ -198,6 +213,13 @@ class DashboardService:
             self._sweep_history_tmp()
             if not self.history:
                 self._load_history()
+        # one-time legacy migration: whatever primed the rings (the
+        # Prometheus backfill or the legacy whole-snapshot history file)
+        # seeds the tsdb too, so /api/range and the long sparklines
+        # carry that trend from the very first frame — and, with
+        # TPUDASH_TSDB_PATH set, it lands in real segments (the old
+        # snapshot format stops being the source of truth)
+        self._seed_tsdb_from_rings()
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
         from tpudash.alerts import AlertEngine, SilenceSet
@@ -409,11 +431,20 @@ class DashboardService:
                             (_AttrRestore(src, attr), dict(d))
                         )
             src = src.__dict__.get("inner")
+        # the tsdb pauses outright (not save/restore): synthetic frames
+        # must not land in PERSISTENT segments, and append_frame itself
+        # honors the flag so there is nothing to roll back
+        tsdb_was_paused = None
+        if self.tsdb is not None:
+            tsdb_was_paused = self.tsdb.paused
+            self.tsdb.paused = True
         self.mute_notifications = True
         try:
             yield
         finally:
             self.mute_notifications = False
+            if tsdb_was_paused is not None:
+                self.tsdb.paused = tsdb_was_paused
             for rec in paused_recorders:
                 rec.paused = False
             for health, snap in health_snaps:
@@ -692,6 +723,192 @@ class DashboardService:
             self._chip_hist_keys = []
             self._chip_hist_cols = []
             self._chip_hist_rowmap = {}
+
+    # -- tsdb (long-horizon compressed trend store) --------------------------
+    def _seed_tsdb_from_rings(self) -> None:
+        """One-time migration of legacy ring history into the tsdb.
+        Runs at startup after the rings were primed (backfill or the
+        legacy npz snapshot); skipped when the store already restored
+        segments — segment data is newer truth than any snapshot, and
+        double-seeding would duplicate points.  Best-effort, never a
+        startup crash."""
+        tsdb = self.tsdb
+        if tsdb is None or (not self.history and not self.chip_history):
+            return
+        try:
+            if tsdb.stats()["raw_points"]:
+                return  # segments already carry history
+            from tpudash.tsdb import FLEET_SERIES
+
+            fleet_by_ts = {
+                # the store is ms-resolution; key the join the same way
+                round(ts, 3): avgs for ts, avgs in self.history
+            }
+            keys = list(self._chip_hist_keys)
+            cols = list(self._chip_hist_cols)
+            n = 0
+            seen_ts = set()
+            for ts, m in self.chip_history:
+                avgs = fleet_by_ts.get(round(ts, 3), {})
+                fleet_row = np.full((1, len(cols)), np.nan, dtype=np.float32)
+                for c, v in avgs.items():
+                    if v is None or c not in cols:
+                        continue
+                    fleet_row[0, cols.index(c)] = v
+                tsdb.append_frame(
+                    ts, [*keys, FLEET_SERIES], cols, np.vstack([m, fleet_row])
+                )
+                seen_ts.add(round(ts, 3))
+                n += 1
+            # fleet-only points (ring reset dropped the chip side)
+            for ts, avgs in self.history:
+                if round(ts, 3) in seen_ts:
+                    continue
+                fcols = [c for c, v in avgs.items() if v is not None]
+                if not fcols:
+                    continue
+                row = np.array(
+                    [[avgs[c] for c in fcols]], dtype=np.float32
+                )
+                tsdb.append_frame(ts, [FLEET_SERIES], fcols, row)
+                n += 1
+            if n and self.cfg.tsdb_path:
+                # make the migrated history durable NOW — the legacy
+                # snapshot may be gone by the next periodic save
+                tsdb.flush(seal_partial=True)
+            if n:
+                log.info("migrated %d legacy history points into the tsdb", n)
+        except Exception as e:  # noqa: BLE001 — migration is best-effort
+            log.warning("legacy history migration into tsdb failed: %s", e)
+
+    def _tsdb_ingest(self, now: float, keys, cols, arr32, avgs) -> None:
+        """Mirror one ring append into the store: per-chip rows plus the
+        FLEET_SERIES pseudo-row carrying the zero-exclusion averages.
+        Never fails a frame."""
+        tsdb = self.tsdb
+        if tsdb is None:
+            return
+        try:
+            from tpudash.tsdb import FLEET_SERIES
+
+            if arr32 is not None:
+                fleet_row = np.full((1, len(cols)), np.nan, dtype=np.float32)
+                pos = {c: i for i, c in enumerate(cols)}
+                for c, v in avgs.items():
+                    i = pos.get(c)
+                    if i is not None and v is not None:
+                        fleet_row[0, i] = v
+                tsdb.append_frame(
+                    now,
+                    [*keys, FLEET_SERIES],
+                    cols,
+                    np.vstack([arr32, fleet_row]),
+                )
+            else:  # legacy mixed-dtype frames: fleet averages only
+                fcols = [c for c, v in avgs.items() if v is not None]
+                if fcols:
+                    row = np.array(
+                        [[avgs[c] for c in fcols]], dtype=np.float32
+                    )
+                    tsdb.append_frame(now, [FLEET_SERIES], fcols, row)
+        except Exception as e:  # noqa: BLE001 — history must not fail frames
+            log.warning("tsdb ingest failed: %s", e)
+
+    def _tsdb_trend_series(self, max_points: int) -> "dict | None":
+        """Fleet sparkline series from the store — {col: [(ts, v)]} over
+        the store's FULL horizon — or None while the in-memory ring is
+        the longer record (fresh start, or tests steering the deque
+        directly).  Cached per store version: many composes per refresh
+        must not re-decode chunks."""
+        tsdb = self.tsdb
+        if tsdb is None:
+            return None
+        try:
+            from tpudash.tsdb import FLEET_SERIES
+            from tpudash.tsdb.query import range_query
+            from tpudash.tsdb.rollup import TIERS_MS
+
+            if tsdb.point_count(FLEET_SERIES) <= max(len(self.history), 1):
+                return None
+            cache_key = (tsdb.version, max_points)
+            if self._tsdb_trend_cache[0] == cache_key:
+                return self._tsdb_trend_cache[1]
+            starts = [tsdb.earliest_ms(t) for t in (0, *TIERS_MS)]
+            starts = [s for s in starts if s is not None]
+            if not starts:
+                return None
+            res = range_query(
+                tsdb,
+                FLEET_SERIES,
+                start_s=min(starts) / 1000.0,
+                max_points=max_points,
+            )
+            self._tsdb_trend_cache = (cache_key, res["series"])
+            return res["series"]
+        except Exception as e:  # noqa: BLE001 — sparklines degrade to the ring
+            log.warning("tsdb trend query failed: %s", e)
+            return None
+
+    def _tsdb_chip_points(
+        self, key: str, max_points: "int | None" = None
+    ) -> "list | None":
+        """One chip's history from the store as [(ts, {col: v|None})]
+        — the long-horizon (and churn-surviving) twin of the per-chip
+        ring.  Served through range_query (the one read surface), so the
+        window spans EVERY tier (a chip whose raw points expired still
+        serves its rollup months), the point budget is a hard ceiling,
+        and a wide enough effective step reads the cheap rollup tiers
+        instead of decoding the whole raw horizon.  None when the store
+        has nothing for the chip."""
+        tsdb = self.tsdb
+        if tsdb is None:
+            return None
+        try:
+            from tpudash.tsdb.query import DEFAULT_POINTS, range_query
+            from tpudash.tsdb.rollup import TIERS_MS
+
+            if not tsdb.series_cols(key):
+                return None
+            starts = [tsdb.earliest_ms(t) for t in (0, *TIERS_MS)]
+            starts = [s for s in starts if s is not None]
+            if not starts:
+                return None
+            budget = (
+                max_points
+                if max_points is not None
+                else max(self.cfg.history_points, DEFAULT_POINTS)
+            )
+            res = range_query(
+                tsdb,
+                key,
+                start_s=min(starts) / 1000.0,
+                max_points=budget,
+            )
+            cols = list(res["series"])
+            by_ts: dict = {}
+            for col, pts in res["series"].items():
+                for t, v in pts:
+                    by_ts.setdefault(t, {})[col] = v if v == v else None
+            if not by_ts:
+                return None
+            return [
+                (t, {c: vals.get(c) for c in cols})
+                for t, vals in sorted(by_ts.items())
+            ]
+        except Exception as e:  # noqa: BLE001 — degrade to the ring
+            log.warning("tsdb chip query failed for %r: %s", key, e)
+            return None
+
+    def close_tsdb(self) -> None:
+        """Graceful-shutdown seal: the not-yet-full head chunk compresses
+        and (with a path) persists, so a clean restart loses nothing.  A
+        crash still loses only the head — by design.  Never raises."""
+        if self.tsdb is None:
+            return
+        try:
+            self.tsdb.close()
+        except Exception as e:  # noqa: BLE001 — shutdown must not fail
+            log.warning("tsdb close failed: %s", e)
 
     def source_health(self) -> "dict | None":
         """Health summary: the ResilientSource wrapper's rolling counters
@@ -1072,23 +1289,46 @@ class DashboardService:
         return out
 
     def _trends(self, sel_df: pd.DataFrame, panels, max_points: int = 120) -> list:
-        """Sparkline per panel over the rolling average history, downsampled
-        to ≤max_points (strided from the end so the latest point always
-        shows)."""
-        if len(self.history) < 2:
+        """Sparkline per panel over the fleet-average trend, ≤max_points.
+
+        Two sources, one contract: once the tsdb holds a longer fleet
+        record than the in-memory ring (restart with segments, or simply
+        outliving the ring's maxlen) the series comes from the STORE via
+        the range-query layer — full horizon, step-aligned means; until
+        then the ring serves, downsampled with the stride anchored at
+        the newest point."""
+        store_series = self._tsdb_trend_series(max_points)
+        if store_series is None and len(self.history) < 2:
             return []
         accels = accel_types_for(sel_df)
-        pts, fmt = _downsample(list(self.history), max_points)
+        if store_series is not None:
+            fmt = None
+
+            def col_series(col):
+                return store_series.get(col, [])
+
+        else:
+            pts, fmt = _downsample(list(self.history), max_points)
+
+            def col_series(col):
+                return [
+                    (ts, avgs[col])
+                    for ts, avgs in pts
+                    if avgs.get(col) is not None
+                ]
+
         out = []
         for spec in panels:
-            series = [
-                (ts, avgs[spec.column])
-                for ts, avgs in pts
-                if avgs.get(spec.column) is not None
-            ]
+            series = col_series(spec.column)
             if len(series) < 2:
                 continue
-            times = [fmt[ts] for ts, _ in series]
+            if fmt is None:
+                times = [
+                    _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+                    for ts, _ in series
+                ]
+            else:
+                times = [fmt[ts] for ts, _ in series]
             out.append(
                 {
                     "panel": spec.column,
@@ -1146,28 +1386,59 @@ class DashboardService:
                     ),
                 }
             )
-        # per-chip sparklines from the chip ring
+        # per-chip sparklines: the tsdb serves once it holds a longer
+        # record for this chip than the ring (same contract as _trends);
+        # the ring covers fresh starts and store-less configs
         trends = []
         hist_row = self._chip_hist_rowmap.get(key)
-        if hist_row is not None and len(self.chip_history) >= 2:
-            pts, fmt = _downsample(list(self.chip_history), max_points)
+        ring_len = len(self.chip_history) if hist_row is not None else 0
+        store_pts = None
+        if self.tsdb is not None:
+            try:
+                if self.tsdb.point_count(key) > max(ring_len, 1):
+                    store_pts = self._tsdb_chip_points(key, max_points)
+            except Exception:  # noqa: BLE001 — degrade to the ring
+                store_pts = None
+        if store_pts:
+
+            def spec_series(column):
+                return [
+                    (ts, vals[column])
+                    for ts, vals in store_pts
+                    if vals.get(column) is not None
+                ]
+
+        elif hist_row is not None and len(self.chip_history) >= 2:
+            pts, _fmt = _downsample(list(self.chip_history), max_points)
             col_pos = {c: i for i, c in enumerate(self._chip_hist_cols)}
-            for spec in panels:
-                ci = col_pos.get(spec.column)
+
+            def spec_series(column):
+                ci = col_pos.get(column)
                 if ci is None:
-                    continue
-                series = [
+                    return []
+                return [
                     (ts, float(m[hist_row, ci]))
                     for ts, m in pts
                     if m[hist_row, ci] == m[hist_row, ci]  # skip NaN
                 ]
+
+        else:
+            spec_series = None
+        if spec_series is not None:
+            for spec in panels:
+                series = spec_series(spec.column)
                 if len(series) < 2:
                     continue
                 trends.append(
                     {
                         "panel": spec.column,
                         "figure": create_sparkline(
-                            [fmt[ts] for ts, _ in series],
+                            [
+                                _dt.datetime.fromtimestamp(ts).strftime(
+                                    "%H:%M:%S"
+                                )
+                                for ts, _ in series
+                            ],
                             [v for _, v in series],
                             title=f"{spec.title} — chip trend",
                             max_val=panel_max(
@@ -1216,16 +1487,29 @@ class DashboardService:
         }
 
     def chip_series(self, key: str) -> "list[tuple[float, dict]] | None":
-        """One chip's raw history from the per-chip ring as
-        [(ts, {column: value-or-None}), ...] — the ring's internal layout
-        (row alignment, float32 matrices, reset-on-population-change) stays
-        encapsulated here; /api/history?chip= serves this verbatim.
-        Returns None for a chip the ring has never seen."""
+        """One chip's raw history as [(ts, {column: value-or-None}), ...]
+        — /api/history?chip= serves this verbatim.  Served from the tsdb
+        once it holds a longer record than the per-chip ring (restart
+        with segments, outliving the ring's maxlen, or a chip that
+        churned OUT of the ring's population — the store keeps serving
+        departed chips); the ring covers the rest.  Returns None for a
+        chip neither tier has seen."""
         with self._publish_lock:
             return self._chip_series_locked(key)
 
     def _chip_series_locked(self, key: str):
         row = self._chip_hist_rowmap.get(key)
+        ring_len = len(self.chip_history) if row is not None else 0
+        tsdb = self.tsdb
+        if tsdb is not None:
+            try:
+                longer = tsdb.point_count(key) > ring_len
+            except Exception:  # noqa: BLE001 — degrade to the ring
+                longer = False
+            if longer:
+                pts = self._tsdb_chip_points(key)
+                if pts:
+                    return pts
         if row is None:
             return None
         cols = list(self._chip_hist_cols)
@@ -1486,6 +1770,10 @@ class DashboardService:
                         k: i for i, k in enumerate(keys)
                     }
                 self.chip_history.append((now, arr.astype(np.float32)))
+            # the same cadence-gated frame mirrors into the compressed
+            # long-horizon store (per-chip rows + the fleet pseudo-row);
+            # head appends are pointer work, sealing runs on its thread
+            self._tsdb_ingest(now, keys, cols, arr, avgs)
         # periodic trend persistence, OFF the frame path (compression of
         # a full 256-chip ring takes ~100 ms).  Monotonic cadence: the
         # ring timestamps above are wall-clock (persisted, compared to

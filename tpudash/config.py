@@ -136,6 +136,28 @@ class Config:
     #: the rings.
     history_path: str = ""
     history_save_interval: float = 300.0
+
+    # --- tsdb: embedded compressed time-series store (tpudash.tsdb) ---------
+    #: Segment directory for the long-horizon trend store.  "" keeps the
+    #: store in-memory only (still serving /api/range and long
+    #: sparklines for the process lifetime); a path makes sealed chunks
+    #: durable — crash recovery loses at most the unsealed head chunk.
+    tsdb_path: str = ""
+    #: Frames per chunk: the head seals into an immutable compressed
+    #: block (and hits disk) every this many refreshes.  120 at the 5 s
+    #: cadence = one seal (and one crash-loss window) per 10 minutes.
+    tsdb_chunk_points: int = 120
+    #: Seal the head after this many seconds even if it isn't full —
+    #: bounds the crash-loss window in wall time on slow cadences.
+    #: 0 = seal on chunk boundaries only.
+    tsdb_flush_interval: float = 0.0
+    #: Per-tier retention, seconds: raw points, 1-minute rollups,
+    #: 10-minute rollups.  Expired blocks drop from memory; an
+    #: append-only segment file is deleted whole once everything in it
+    #: expired.  Defaults: 1 day raw, 7 days 1m, 30 days 10m.
+    tsdb_retention_raw: float = 86400.0
+    tsdb_retention_1m: float = 604800.0
+    tsdb_retention_10m: float = 2592000.0
     #: source="workload": checkpoint/resume for the background train loop
     #: (models/checkpoint.py) — save every N steps into this directory and
     #: resume from its latest step on restart.  "" disables.
@@ -268,6 +290,12 @@ _ENV_MAP = {
     "history_points": "TPUDASH_HISTORY_POINTS",
     "history_path": "TPUDASH_HISTORY_PATH",
     "history_save_interval": "TPUDASH_HISTORY_SAVE_INTERVAL",
+    "tsdb_path": "TPUDASH_TSDB_PATH",
+    "tsdb_chunk_points": "TPUDASH_TSDB_CHUNK_POINTS",
+    "tsdb_flush_interval": "TPUDASH_TSDB_FLUSH_INTERVAL",
+    "tsdb_retention_raw": "TPUDASH_TSDB_RETENTION_RAW",
+    "tsdb_retention_1m": "TPUDASH_TSDB_RETENTION_1M",
+    "tsdb_retention_10m": "TPUDASH_TSDB_RETENTION_10M",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",

@@ -1,0 +1,20 @@
+"""tpudash.tsdb — embedded compressed time-series store.
+
+Layers (each its own module, each independently tested):
+
+- :mod:`tpudash.tsdb.gorilla` — delta-of-delta + XOR bit codec;
+- :mod:`tpudash.tsdb.store` — head chunks → sealed blocks → CRC-framed
+  append-only segment files with torn-tail recovery;
+- :mod:`tpudash.tsdb.rollup` — tiered downsampling (raw → 1m → 10m,
+  min/max/sum/count) with per-tier retention;
+- :mod:`tpudash.tsdb.query` — the range-query layer (series select,
+  step alignment, aggregate choice, point budget) that the sparklines,
+  drill-downs, and ``GET /api/range`` consume.
+
+``python -m tpudash.tsdb drill`` is the crash chaos drill (kill -9 mid
+segment-append, assert sealed data survives); CI runs it every PR.
+"""
+
+from tpudash.tsdb.store import FLEET_SERIES, TSDB
+
+__all__ = ["TSDB", "FLEET_SERIES"]

@@ -1,0 +1,206 @@
+"""Range queries over the tsdb: series select, step alignment,
+aggregate choice, point budget.
+
+The one read surface everything shares — the sparkline builder
+(service._trends), the drill-down chip trends, and ``GET /api/range``
+all call :func:`range_query`, so resolution selection and step
+alignment have exactly one implementation to test.
+
+Resolution selection: the finest tier still *covering the window's
+start* wins — raw first, then 1m, then 10m — except that a step wide
+enough for a rollup tier (≥ its bucket width) prefers the rollup: the
+answer is identical (rollups are exact min/max/sum/count) and the read
+decodes 60–600× fewer points.  When nothing covers the start (asked
+for more history than exists), the tier reaching furthest back serves
+what it has — a shorter graph, never an error.
+
+The point budget is a hard ceiling: a query whose natural resolution
+would return more than ``max_points`` is step-widened until it fits,
+so one ``/api/range`` call can never ship (or force the server to
+decode) an unbounded payload.
+"""
+
+from __future__ import annotations
+
+from tpudash.tsdb import gorilla
+from tpudash.tsdb.rollup import TIER_1M_MS, TIER_10M_MS
+
+AGGREGATES = ("mean", "min", "max")
+
+#: default / ceiling for one query's returned points per column
+DEFAULT_POINTS = 500
+MAX_POINTS = 5000
+
+_TIER_NAME = {0: "raw", TIER_1M_MS: "1m", TIER_10M_MS: "10m"}
+
+
+def _choose_tier(store, start_ms: int, step_ms: int) -> int:
+    """The tier this query reads (0 = raw).  Reach-back is judged on
+    each tier's *source*-time earliest sample; a tier that merely
+    reaches as far back as raw never beats raw (ties prefer finer)."""
+    earliest = {t: store.earliest_ms(t) for t in (0, TIER_1M_MS, TIER_10M_MS)}
+    e_raw = earliest[0]
+    # a step at least one bucket wide prefers the exact-but-cheaper
+    # rollup read — provided the rollup reaches back as far as raw does
+    for tier in (TIER_10M_MS, TIER_1M_MS):
+        e = earliest[tier]
+        if (
+            step_ms >= tier
+            and e is not None
+            and (e_raw is None or e <= max(start_ms, e_raw))
+        ):
+            return tier
+    if e_raw is not None and e_raw <= start_ms:
+        return 0
+    # raw doesn't cover the start (expired, or asked before history
+    # began): the tier reaching furthest back wins; ties prefer finer
+    candidates = [(e, t) for t, e in earliest.items() if e is not None]
+    if not candidates:
+        return 0
+    return min(candidates)[1]
+
+
+def _aggregate_raw(points, start_ms, end_ms, step_ms, agg):
+    """Step-align raw (ts, value) points; NaN samples are skipped."""
+    if step_ms <= 0:
+        return [
+            (gorilla.ms_to_ts(t), v) for t, v in points if v == v
+        ]
+    buckets: dict = {}
+    for t, v in points:
+        if v != v:
+            continue
+        b = start_ms + (t - start_ms) // step_ms * step_ms
+        cur = buckets.get(b)
+        if cur is None:
+            buckets[b] = [v, v, v, 1]
+        else:
+            if v < cur[0]:
+                cur[0] = v
+            if v > cur[1]:
+                cur[1] = v
+            cur[2] += v
+            cur[3] += 1
+    return _emit(buckets, agg)
+
+
+def _aggregate_quads(quads, start_ms, step_ms, agg):
+    """Step-align rollup quads — exact: min of mins, max of maxes,
+    sum/count for the mean.  A source bucket that STARTED before the
+    window (but reaches into it) clamps to the first step bucket, so
+    emitted timestamps always lie inside [start, end]."""
+    buckets: dict = {}
+    for bt, mn, mx, sm, cnt in quads:
+        off = bt - start_ms
+        b = start_ms if off < 0 else start_ms + off // step_ms * step_ms
+        cur = buckets.get(b)
+        if cur is None:
+            buckets[b] = [mn, mx, sm, cnt]
+        else:
+            if mn < cur[0]:
+                cur[0] = mn
+            if mx > cur[1]:
+                cur[1] = mx
+            cur[2] += sm
+            cur[3] += cnt
+    return _emit(buckets, agg)
+
+
+def _emit(buckets: dict, agg: str):
+    out = []
+    for b in sorted(buckets):
+        mn, mx, sm, cnt = buckets[b]
+        if cnt <= 0:
+            continue
+        if agg == "min":
+            v = mn
+        elif agg == "max":
+            v = mx
+        else:
+            v = sm / cnt
+        out.append((gorilla.ms_to_ts(b), v))
+    return out
+
+
+def range_query(
+    store,
+    key: str,
+    cols: "list[str] | None" = None,
+    start_s: "float | None" = None,
+    end_s: "float | None" = None,
+    step_s: "float | None" = None,
+    agg: str = "mean",
+    max_points: int = DEFAULT_POINTS,
+) -> dict:
+    """Aligned series for one key over [start, end].
+
+    Returns ``{"series": {col: [(ts_s, value), ...]}, "resolution",
+    "start_s", "end_s", "step_s", "agg"}``.  Defaults: ``end`` = the
+    store's newest sample, ``start`` = end − 1h, ``cols`` = every
+    column the series carries, ``step`` = whatever fits the budget.
+    Raises ValueError on a bad aggregate/window (the HTTP layer maps
+    it to 400)."""
+    if agg not in AGGREGATES:
+        raise ValueError(f"agg must be one of {AGGREGATES}, not {agg!r}")
+    max_points = max(1, min(int(max_points), MAX_POINTS))
+    latest = store.latest_ms()
+    end_ms = gorilla.ts_to_ms(end_s) if end_s is not None else latest
+    if end_ms is None:
+        # empty store: a well-formed empty answer, not an error
+        return {
+            "series": {c: [] for c in (cols or [])},
+            "resolution": "raw",
+            "start_s": start_s or 0.0,
+            "end_s": end_s or 0.0,
+            "step_s": step_s or 0.0,
+            "agg": agg,
+        }
+    start_ms = (
+        gorilla.ts_to_ms(start_s)
+        if start_s is not None
+        else end_ms - 3_600_000
+    )
+    if end_ms < start_ms:
+        raise ValueError("end precedes start")
+    window = max(1, end_ms - start_ms)
+    step_ms = int(step_s * 1000) if step_s else 0
+    if step_ms < 0:
+        raise ValueError("step must be positive")
+    # the budget is a ceiling, whatever step the caller asked for
+    min_step = -(-window // max_points)  # ceil
+    if step_ms and step_ms < min_step:
+        step_ms = min_step
+    tier = _choose_tier(store, start_ms, step_ms)
+    if tier != 0:
+        if step_ms < tier:
+            step_ms = tier  # a rollup can't answer finer than its bucket
+        if step_ms < min_step:
+            # the budget is a ceiling on EVERY tier: a 30-day stepless
+            # query must not ship window/tier (~4300) bucket points just
+            # because the rollup resolution happens to be fine
+            step_ms = min_step
+    if cols is None:
+        cols = store.series_cols(key)
+    series: dict = {}
+    for col in cols:
+        if tier == 0:
+            pts = store.raw_window(key, col, start_ms, end_ms)
+            eff_step = step_ms
+            if not eff_step and len(pts) > max_points:
+                eff_step = min_step
+            series[col] = _aggregate_raw(
+                pts, start_ms, end_ms, eff_step, agg
+            )
+        else:
+            quads = store.rollup_window(tier, key, col, start_ms, end_ms)
+            series[col] = _aggregate_quads(
+                quads, start_ms, step_ms or tier, agg
+            )
+    return {
+        "series": series,
+        "resolution": _TIER_NAME[tier],
+        "start_s": start_ms / 1000.0,
+        "end_s": end_ms / 1000.0,
+        "step_s": (step_ms or 0) / 1000.0,
+        "agg": agg,
+    }

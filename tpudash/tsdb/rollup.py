@@ -1,0 +1,151 @@
+"""Tiered downsampling for the tsdb: raw → 1m → 10m.
+
+A rollup block is the aggregate shadow of one sealed raw block: per
+time bucket (60 s / 600 s), per series, the (min, max, sum, count)
+quadruple — everything ``mean`` needs without keeping the points.
+Rollups are written at seal time alongside the raw block and carry
+their own (longer) retention, so ``/api/range`` keeps answering with
+min/max/mean long after the raw points expired (the whole reason a
+live gauge page becomes a diagnosis tool — PAPERS.md fleet-telemetry
+thread).
+
+Bucket edges are epoch-aligned (``ts // tier_ms``), so two blocks that
+split one wall-clock bucket between them each contribute a *partial*
+quadruple; merging partials is exact for min/max/sum/count (and hence
+mean) — the query layer folds them (``merge_quads``).  Nothing here is
+approximate: a rollup bucket's mean equals the mean of the raw points
+it covered, NaN cells excluded.
+
+Arrays are float32/int32 (the raw matrices are float32 already): one
+bucket costs 16 bytes per series per tier, ~160 KB per 10 minutes at
+256 chips × 10 metrics — and the 10m tier is 10× smaller again.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: tier bucket widths, ms
+TIER_1M_MS = 60_000
+TIER_10M_MS = 600_000
+TIERS_MS = (TIER_1M_MS, TIER_10M_MS)
+
+
+class RollupBlock:
+    """Aggregates of one raw block for one tier: ``buckets`` (int64
+    epoch-ms bucket starts, ascending) × ``keys`` × ``cols`` arrays of
+    min/max/sum/count.  Immutable once built."""
+
+    __slots__ = ("tier_ms", "buckets", "keys", "cols", "mn", "mx", "sm",
+                 "cnt", "src_t0", "src_t1")
+
+    def __init__(self, tier_ms, buckets, keys, cols, mn, mx, sm, cnt,
+                 src_t0, src_t1):
+        self.tier_ms = int(tier_ms)
+        self.buckets = buckets
+        self.keys = list(keys)
+        self.cols = list(cols)
+        self.mn = mn
+        self.mx = mx
+        self.sm = sm
+        self.cnt = cnt
+        #: raw-time bounds of the points that fed this block — window
+        #: filtering and "how far back does this tier reach" use these,
+        #: never the bucket edges (a bucket EDGE can sit well outside
+        #: the data that landed in it)
+        self.src_t0 = int(src_t0)
+        self.src_t1 = int(src_t1)
+
+    @property
+    def t0(self) -> int:
+        return int(self.buckets[0]) if len(self.buckets) else 0
+
+    @property
+    def t1(self) -> int:
+        """Last covered instant: the end of the final bucket (retention
+        uses this — conservative, keeps a bucket until it fully ages)."""
+        if not len(self.buckets):
+            return 0
+        return int(self.buckets[-1]) + self.tier_ms - 1
+
+    def series_quads(self, key: str, col: str):
+        """[(bucket_ms, mn, mx, sm, cnt)] for one series; [] when the
+        block does not carry it (series churn: the chip was absent)."""
+        try:
+            ki = self.keys.index(key)
+            ci = self.cols.index(col)
+        except ValueError:
+            return []
+        out = []
+        for b in range(len(self.buckets)):
+            c = int(self.cnt[b, ki, ci])
+            if c <= 0:
+                continue
+            out.append(
+                (
+                    int(self.buckets[b]),
+                    float(self.mn[b, ki, ci]),
+                    float(self.mx[b, ki, ci]),
+                    float(self.sm[b, ki, ci]),
+                    c,
+                )
+            )
+        return out
+
+
+def rollup_points(tier_ms, ts_ms, keys, cols, stacked) -> "RollupBlock | None":
+    """Aggregate a (n, K, C) float matrix stack at timestamps ``ts_ms``
+    into one RollupBlock.  NaN cells contribute nothing (count stays
+    honest); an all-NaN bucket keeps count 0 and is skipped at query
+    time.  Vectorized: one fmin/fmax/nansum pass per bucket."""
+    n = len(ts_ms)
+    if n == 0:
+        return None
+    ts = np.asarray(ts_ms, dtype=np.int64)
+    bucket_ids = ts // tier_ms
+    uniq = np.unique(bucket_ids)
+    K, C = stacked.shape[1], stacked.shape[2]
+    mn = np.full((len(uniq), K, C), np.nan, dtype=np.float32)
+    mx = np.full((len(uniq), K, C), np.nan, dtype=np.float32)
+    sm = np.zeros((len(uniq), K, C), dtype=np.float64)
+    cnt = np.zeros((len(uniq), K, C), dtype=np.int32)
+    for i, b in enumerate(uniq):
+        rows = stacked[bucket_ids == b]
+        with np.errstate(invalid="ignore"):  # ±inf cells: inf-inf is NaN, fine
+            mn[i] = np.fmin.reduce(rows, axis=0)
+            mx[i] = np.fmax.reduce(rows, axis=0)
+            sm[i] = np.nansum(rows, axis=0, dtype=np.float64)
+        cnt[i] = np.sum(~np.isnan(rows), axis=0, dtype=np.int32)
+    return RollupBlock(
+        tier_ms,
+        (uniq * tier_ms).astype(np.int64),
+        keys,
+        cols,
+        mn,
+        mx,
+        sm.astype(np.float64),
+        cnt,
+        int(ts.min()),
+        int(ts.max()),
+    )
+
+
+def merge_quads(quads) -> "list[tuple]":
+    """Merge per-block partial quadruples for ONE series into whole
+    buckets: [(bucket_ms, mn, mx, sm, cnt)] sorted by bucket.  Exact —
+    min of mins, max of maxes, sum of sums, sum of counts."""
+    merged: dict = {}
+    for b, mn, mx, sm, cnt in quads:
+        cur = merged.get(b)
+        if cur is None:
+            merged[b] = [mn, mx, sm, cnt]
+        else:
+            if mn < cur[0]:
+                cur[0] = mn
+            if mx > cur[1]:
+                cur[1] = mx
+            cur[2] += sm
+            cur[3] += cnt
+    return [
+        (b, q[0], q[1], q[2], q[3]) for b, q in sorted(merged.items())
+    ]

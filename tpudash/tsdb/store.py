@@ -1,0 +1,745 @@
+"""The embedded time-series store: head → sealed chunks → segment files.
+
+Replaces the deque+whole-snapshot history tier with a real storage
+engine, dependency-free:
+
+- **Ingest** is frame-shaped, matching how the dashboard actually
+  produces data: one ``append_frame(ts, keys, cols, matrix)`` per
+  refresh (per-chip rows plus the ``FLEET_SERIES`` pseudo-row).  The
+  mutable *head* keeps the raw (ts, matrix) pairs.
+- **Sealing**: every ``chunk_points`` frames the head's oldest chunk is
+  compressed into an immutable :class:`SealedBlock` — ONE Gorilla
+  timestamp stream shared by every series of the frame, one XOR value
+  stream per series (tpudash/tsdb/gorilla.py) — plus its 1m/10m rollup
+  shadows (tpudash/tsdb/rollup.py).  Encoding runs on a daemon thread,
+  never on the publish path; the chunk stays query-visible throughout
+  (head → pending → sealed, no gap).
+- **Persistence** (``path`` set): sealed blocks append to per-tier
+  segment files as CRC-framed records.  A crash mid-append can tear at
+  most the record being written: load verifies frame magic + CRC
+  sequentially and truncates the torn tail, so *sealed* data already on
+  disk is never lost — the drill (``python -m tpudash.tsdb drill``) and
+  tests/test_tsdb.py kill -9 mid-write and assert exactly that.  The
+  in-memory head is the only loss window (≤ ``chunk_points`` frames;
+  ``close()`` seals it on a graceful shutdown).
+- **Retention** is tiered (raw < 1m < 10m): expired blocks drop from
+  memory per tier, and a segment file is deleted once every record in
+  it expired — append-only files, whole-file reclaim, no rewrite.
+
+Thread contract: ``_lock`` guards the in-memory structures and is held
+only for pointer swaps (never I/O, never encoding); ``_io_lock`` is a
+dedicated segment-file lock (the ``save_history`` pattern).  Callers on
+the event loop must use an executor; everything here is sync on purpose.
+
+Failure posture: disk trouble (full volume, yanked mount, corrupt
+segment) degrades the store to memory-only with ``last_disk_error``
+surfaced via :meth:`stats` — ingest and queries keep working, the
+dashboard never crashes over its history tier (runbook:
+docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from tpudash.tsdb import gorilla
+from tpudash.tsdb.rollup import (
+    TIER_1M_MS,
+    TIER_10M_MS,
+    TIERS_MS,
+    RollupBlock,
+    rollup_points,
+)
+
+log = logging.getLogger(__name__)
+
+#: pseudo chip key carrying the fleet-average row in every frame matrix
+#: ("/" makes it impossible as a real ``slice/chip`` key's collision —
+#: real keys never start with "__")
+FLEET_SERIES = "__fleet__"
+
+_MAGIC = b"TSB1"
+_REC_BLOCK = 1
+_REC_ROLLUP = 2
+_FRAME_HDR = struct.Struct("<4sBII")  # magic, type, payload len, crc32
+
+#: segment rotation threshold — whole files are the retention unit, so
+#: they must stay small enough that deleting one reclaims promptly
+_SEG_MAX_BYTES = 4 << 20
+
+_TIER_NAMES = {0: "raw", TIER_1M_MS: "1m", TIER_10M_MS: "10m"}
+
+
+class SealedBlock:
+    """One immutable compressed chunk: ``count`` frames over ``keys`` ×
+    ``cols``.  ``ts_enc`` is the shared timestamp stream; ``val_enc[i]``
+    is the value stream for series ``i = ki * len(cols) + ci``."""
+
+    __slots__ = ("keys", "cols", "t0", "t1", "count", "ts_enc", "val_enc",
+                 "_key_pos", "_ts_cache")
+
+    def __init__(self, keys, cols, t0, t1, count, ts_enc, val_enc):
+        self.keys = list(keys)
+        self.cols = list(cols)
+        self.t0 = int(t0)
+        self.t1 = int(t1)
+        self.count = int(count)
+        self.ts_enc = ts_enc
+        self.val_enc = val_enc
+        self._key_pos = None
+        self._ts_cache = None
+
+    def nbytes(self) -> int:
+        return len(self.ts_enc) + sum(len(v) for v in self.val_enc)
+
+    def timestamps(self) -> "list[int]":
+        if self._ts_cache is None:
+            self._ts_cache = gorilla.decode_timestamps(self.ts_enc, self.count)
+        return self._ts_cache
+
+    def series_points(self, key: str, col: str):
+        """(ts_ms list, float list) for one series, or None when this
+        block never carried it (the chip was absent in this window)."""
+        if self._key_pos is None:
+            self._key_pos = {k: i for i, k in enumerate(self.keys)}
+        ki = self._key_pos.get(key)
+        if ki is None or col not in self.cols:
+            return None
+        ci = self.cols.index(col)
+        vals = gorilla.decode_values(
+            self.val_enc[ki * len(self.cols) + ci], self.count
+        )
+        return self.timestamps(), vals
+
+
+def _encode_block(keys, cols, ts_ms, stacked) -> SealedBlock:
+    """Compress one head chunk (encoding only — no locks, no I/O).
+    ``stacked`` is the (n, K, C) float64 stack of the chunk's matrices."""
+    n, K, C = stacked.shape
+    flat = np.ascontiguousarray(stacked.reshape(n, K * C))
+    ts_enc = gorilla.encode_timestamps(ts_ms)
+    val_enc = [
+        gorilla.encode_values(flat[:, i].tolist()) for i in range(K * C)
+    ]
+    return SealedBlock(
+        keys, cols, min(ts_ms), max(ts_ms), n, ts_enc, val_enc
+    )
+
+
+def _block_payload(b: SealedBlock) -> bytes:
+    header = json.dumps(
+        {
+            "k": b.keys,
+            "c": b.cols,
+            "t0": b.t0,
+            "t1": b.t1,
+            "n": b.count,
+            "tl": len(b.ts_enc),
+            "vl": [len(v) for v in b.val_enc],
+        },
+        separators=(",", ":"),
+    ).encode()
+    return (
+        struct.pack("<I", len(header))
+        + header
+        + b.ts_enc
+        + b"".join(b.val_enc)
+    )
+
+
+def _parse_block(payload: bytes) -> SealedBlock:
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    header = json.loads(payload[4 : 4 + hlen])
+    off = 4 + hlen
+    ts_enc = payload[off : off + header["tl"]]
+    off += header["tl"]
+    val_enc = []
+    for vl in header["vl"]:
+        val_enc.append(payload[off : off + vl])
+        off += vl
+    return SealedBlock(
+        header["k"], header["c"], header["t0"], header["t1"], header["n"],
+        ts_enc, val_enc,
+    )
+
+
+def _rollup_payload(r: RollupBlock) -> bytes:
+    header = json.dumps(
+        {
+            "tier": r.tier_ms,
+            "k": r.keys,
+            "c": r.cols,
+            "nb": int(len(r.buckets)),
+            "s0": r.src_t0,
+            "s1": r.src_t1,
+        },
+        separators=(",", ":"),
+    ).encode()
+    return (
+        struct.pack("<I", len(header))
+        + header
+        + np.ascontiguousarray(r.buckets, dtype=np.int64).tobytes()
+        + np.ascontiguousarray(r.mn, dtype=np.float32).tobytes()
+        + np.ascontiguousarray(r.mx, dtype=np.float32).tobytes()
+        + np.ascontiguousarray(r.sm, dtype=np.float64).tobytes()
+        + np.ascontiguousarray(r.cnt, dtype=np.int32).tobytes()
+    )
+
+
+def _parse_rollup(payload: bytes) -> RollupBlock:
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    header = json.loads(payload[4 : 4 + hlen])
+    off = 4 + hlen
+    nb = header["nb"]
+    K, C = len(header["k"]), len(header["c"])
+    shape = (nb, K, C)
+
+    def take(dtype, count):
+        nonlocal off
+        raw = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
+        off += raw.nbytes
+        return raw
+
+    buckets = take(np.int64, nb)
+    mn = take(np.float32, nb * K * C).reshape(shape)
+    mx = take(np.float32, nb * K * C).reshape(shape)
+    sm = take(np.float64, nb * K * C).reshape(shape)
+    cnt = take(np.int32, nb * K * C).reshape(shape)
+    return RollupBlock(
+        header["tier"], buckets, header["k"], header["c"], mn, mx, sm, cnt,
+        header["s0"], header["s1"],
+    )
+
+
+class TSDB:
+    def __init__(
+        self,
+        path: str = "",
+        chunk_points: int = 120,
+        retention_raw_s: float = 86400.0,
+        retention_1m_s: float = 7 * 86400.0,
+        retention_10m_s: float = 30 * 86400.0,
+        flush_interval_s: float = 0.0,
+    ) -> None:
+        self.path = path
+        self.chunk_points = max(2, int(chunk_points))
+        #: seal a partial head after this long anyway (0 = off) — bounds
+        #: the crash-loss window in wall time on slow cadences
+        self.flush_interval_ms = int(max(0.0, flush_interval_s) * 1000)
+        self.retention_ms = {
+            0: int(retention_raw_s * 1000),
+            TIER_1M_MS: int(retention_1m_s * 1000),
+            TIER_10M_MS: int(retention_10m_s * 1000),
+        }
+        #: set under synthetic load (profile replays must not pollute
+        #: the persistent history)
+        self.paused = False
+        #: bumped on every visible mutation — query-result cache key
+        self.version = 0
+        self.last_disk_error: "str | None" = None
+        self._lock = threading.RLock()
+        #: dedicated segment-file lock (save_history pattern): disk I/O
+        #: serializes here, never under the in-memory lock
+        self._io_lock = threading.Lock()
+        #: serializes the drain loop itself: flush() racing the seal
+        #: thread must not encode (and double-commit) the same chunk
+        self._seal_gate = threading.Lock()
+        # head: mutable, query-visible, lost on crash (by contract)
+        self._head_keys: list = []
+        self._head_cols: list = []
+        self._head_ts: "list[int]" = []
+        self._head_mats: list = []
+        #: chunks cut from the head, awaiting the encode thread — still
+        #: query-visible in raw form
+        self._pending: list = []  # [(keys, cols, ts_list, mats)]
+        self._seal_thread: "threading.Thread | None" = None
+        self._raw: "list[SealedBlock]" = []
+        self._rollups = {t: [] for t in TIERS_MS}
+        # per-tier segment registries: [(seq, path, newest_t1_ms)]
+        self._segs = {name: [] for name in _TIER_NAMES.values()}
+        self._closed = False
+        if path:
+            self._load()
+
+    @classmethod
+    def from_config(cls, cfg) -> "TSDB":
+        return cls(
+            path=cfg.tsdb_path,
+            chunk_points=cfg.tsdb_chunk_points,
+            retention_raw_s=cfg.tsdb_retention_raw,
+            retention_1m_s=cfg.tsdb_retention_1m,
+            retention_10m_s=cfg.tsdb_retention_10m,
+            flush_interval_s=cfg.tsdb_flush_interval,
+        )
+
+    # -- ingest --------------------------------------------------------------
+    def append_frame(self, ts_s: float, keys, cols, matrix) -> None:
+        """One refresh's worth of samples: ``matrix[k, c]`` is the value
+        of series (keys[k], cols[c]) at ``ts_s`` (NaN = no sample).  A
+        population change (chip churn, new metric) seals the current
+        head with ITS alignment and starts a fresh one — old blocks keep
+        serving the departed chip's history."""
+        if self.paused or self._closed:
+            return
+        ts_ms = gorilla.ts_to_ms(ts_s)
+        mat = np.asarray(matrix, dtype=np.float32)
+        keys = list(keys)
+        cols = list(cols)
+        kick = False
+        with self._lock:
+            if self._head_ts and (
+                keys != self._head_keys or cols != self._head_cols
+            ):
+                self._cut_head_locked()
+                kick = True
+            self._head_keys = keys
+            self._head_cols = cols
+            self._head_ts.append(ts_ms)
+            self._head_mats.append(mat)
+            if len(self._head_ts) >= self.chunk_points or (
+                self.flush_interval_ms
+                and ts_ms - self._head_ts[0] >= self.flush_interval_ms
+            ):
+                self._cut_head_locked()
+                kick = True
+            self.version += 1
+        if kick:
+            self._kick_seal()
+
+    def _cut_head_locked(self) -> None:
+        if not self._head_ts:
+            return
+        self._pending.append(
+            (self._head_keys, self._head_cols, self._head_ts, self._head_mats)
+        )
+        self._head_ts = []
+        self._head_mats = []
+
+    def _kick_seal(self) -> None:
+        with self._lock:
+            if self._seal_thread is not None and self._seal_thread.is_alive():
+                return
+            t = threading.Thread(
+                target=self._seal_pending, name="tsdb-seal", daemon=True
+            )
+            self._seal_thread = t
+        t.start()
+
+    def _seal_pending(self) -> None:
+        """Drain pending chunks: encode (no locks), commit (in-memory
+        lock), persist (I/O lock), retain.  Runs on the seal thread, or
+        inline via flush(); the gate keeps the two from double-sealing
+        one chunk.  Encoding and disk writes happen through method
+        calls, so nothing blocking sits lexically under the gate."""
+        with self._seal_gate:
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        # deregister BEFORE returning (under the lock):
+                        # a _kick_seal racing this thread's death would
+                        # otherwise see is_alive() == True, spawn
+                        # nothing, and strand a freshly cut chunk in
+                        # _pending until the NEXT cut — a crash in that
+                        # window would lose sealed-cut data the
+                        # durability contract promises to keep
+                        if self._seal_thread is threading.current_thread():
+                            self._seal_thread = None
+                        return
+                    keys, cols, ts_list, mats = self._pending[0]
+                stacked = np.stack(mats).astype(np.float64)
+                block = _encode_block(keys, cols, ts_list, stacked)
+                rolls = []
+                for tier in TIERS_MS:
+                    r = rollup_points(tier, ts_list, keys, cols, stacked)
+                    if r is not None:
+                        rolls.append(r)
+                with self._lock:
+                    self._pending.pop(0)
+                    self._raw.append(block)
+                    for r in rolls:
+                        self._rollups[r.tier_ms].append(r)
+                    self.version += 1
+                if self.path:
+                    self._persist(block, rolls)
+                self._enforce_retention()
+
+    def flush(self, seal_partial: bool = False) -> None:
+        """Synchronously seal everything pending (and, with
+        ``seal_partial``, the not-yet-full head) — tests, migration,
+        shutdown.  Joins any in-flight seal thread first."""
+        t = self._seal_thread
+        if t is not None and t.is_alive():
+            t.join()
+        if seal_partial:
+            with self._lock:
+                self._cut_head_locked()
+        self._seal_pending()
+
+    def close(self) -> None:
+        """Graceful shutdown: seal the partial head so a clean restart
+        loses nothing (a crash still loses only the head, by design)."""
+        if self._closed:
+            return
+        self.flush(seal_partial=True)
+        self._closed = True
+
+    # -- persistence ---------------------------------------------------------
+    def _tier_name(self, tier_ms: int) -> str:
+        return _TIER_NAMES[tier_ms]
+
+    # tpulint: allow[blocking-under-lock] dedicated segment-I/O lock (save_history pattern), never the in-memory lock
+    def _persist(self, block: SealedBlock, rolls) -> None:
+        with self._io_lock:
+            try:
+                self._write_record("raw", _REC_BLOCK, _block_payload(block),
+                                   block.t1)
+                for r in rolls:
+                    self._write_record(
+                        self._tier_name(r.tier_ms),
+                        _REC_ROLLUP,
+                        _rollup_payload(r),
+                        r.t1,
+                    )
+                if self.last_disk_error is not None:
+                    log.info("tsdb disk writes recovered")
+                    self.last_disk_error = None
+            except OSError as e:
+                # disk full / yanked volume: degrade to memory-only,
+                # surface on stats(), never take the dashboard down
+                if str(e) != self.last_disk_error:
+                    log.warning("tsdb segment write failed: %s", e)
+                self.last_disk_error = str(e)
+
+    def _write_record(
+        self, tier: str, rec_type: int, payload: bytes, newest_t1: int
+    ) -> None:
+        """Append one CRC-framed record to the tier's current segment
+        (caller holds _io_lock).  The whole frame goes down in one
+        buffered write + flush; a crash can tear only this record — the
+        loader's CRC walk drops the torn tail."""
+        segs = self._segs[tier]
+        if not segs or self._seg_size(segs[-1][1]) > _SEG_MAX_BYTES:
+            seq = (segs[-1][0] + 1) if segs else 1
+            segs.append(
+                [seq, os.path.join(self.path, f"{tier}-{seq:06d}.seg"), 0]
+            )
+        entry = segs[-1]
+        frame = _FRAME_HDR.pack(
+            _MAGIC, rec_type, len(payload), zlib.crc32(payload)
+        ) + payload
+        os.makedirs(self.path, exist_ok=True)
+        with open(entry[1], "ab") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        entry[2] = max(entry[2], newest_t1)
+
+    @staticmethod
+    def _seg_size(path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
+    def _load(self) -> None:
+        """Replay every segment record into memory.  Sequential CRC
+        walk; the first bad frame in a file ends that file's content —
+        in the newest file of a tier it is a torn tail from a crash
+        mid-append, and the file is truncated back to the last good
+        record so future appends stay parseable."""
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            names = sorted(os.listdir(self.path))
+        except OSError as e:
+            log.warning("tsdb open failed (%s): %s", self.path, e)
+            self.last_disk_error = str(e)
+            return
+        for tier in self._segs:
+            tier_files = [
+                n
+                for n in names
+                if n.startswith(f"{tier}-") and n.endswith(".seg")
+            ]
+            for i, name in enumerate(tier_files):
+                full = os.path.join(self.path, name)
+                try:
+                    seq = int(name[len(tier) + 1 : -4])
+                except ValueError:
+                    continue
+                newest = self._load_segment(
+                    full, truncate_tail=(i == len(tier_files) - 1)
+                )
+                self._segs[tier].append([seq, full, newest])
+        self._enforce_retention()
+        n_raw = len(self._raw)
+        if n_raw:
+            log.info(
+                "tsdb restored %d raw blocks (%d points) + %d rollup blocks "
+                "from %s",
+                n_raw,
+                sum(b.count for b in self._raw),
+                sum(len(v) for v in self._rollups.values()),
+                self.path,
+            )
+
+    def _load_segment(self, path: str, truncate_tail: bool) -> int:
+        newest = 0
+        good_end = 0
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            log.warning("tsdb segment unreadable (%s): %s", path, e)
+            return 0
+        off = 0
+        while off + _FRAME_HDR.size <= len(data):
+            magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, off)
+            payload = data[off + _FRAME_HDR.size : off + _FRAME_HDR.size + plen]
+            if (
+                magic != _MAGIC
+                or len(payload) != plen
+                or zlib.crc32(payload) != crc
+            ):
+                break  # torn tail (crash mid-append) or corruption
+            try:
+                if rec_type == _REC_BLOCK:
+                    b = _parse_block(payload)
+                    self._raw.append(b)
+                    newest = max(newest, b.t1)
+                elif rec_type == _REC_ROLLUP:
+                    r = _parse_rollup(payload)
+                    if r.tier_ms in self._rollups:
+                        self._rollups[r.tier_ms].append(r)
+                        newest = max(newest, r.t1)
+            except (ValueError, KeyError, json.JSONDecodeError, struct.error):
+                break  # CRC passed but the payload lies: stop trusting
+            off += _FRAME_HDR.size + plen
+            good_end = off
+        if good_end < len(data):
+            log.warning(
+                "tsdb segment %s: torn/corrupt tail at byte %d of %d "
+                "(sealed records before it are intact)",
+                path,
+                good_end,
+                len(data),
+            )
+            if truncate_tail:
+                with contextlib.suppress(OSError):
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+        return newest
+
+    # -- retention -----------------------------------------------------------
+    def _now_ms(self) -> int:
+        # tpulint: allow[wall-clock] retention compares persisted epoch stamps
+        return int(time.time() * 1000)
+
+    def _enforce_retention(self) -> None:
+        now = self._now_ms()
+        with self._lock:
+            cut_raw = now - self.retention_ms[0]
+            self._raw = [b for b in self._raw if b.t1 >= cut_raw]
+            for tier in TIERS_MS:
+                cut = now - self.retention_ms[tier]
+                self._rollups[tier] = [
+                    r for r in self._rollups[tier] if r.t1 >= cut
+                ]
+            self.version += 1
+        self._reclaim_segments(now)
+
+    # whole-file reclaim: a segment goes once its newest record expired
+    # for its tier (the current append target is kept)
+    def _reclaim_segments(self, now: int) -> None:
+        with self._io_lock:  # tpulint: allow[blocking-under-lock] dedicated segment-I/O lock (save_history pattern), never the in-memory lock
+            for tier, tier_ms in (("raw", 0), ("1m", TIER_1M_MS),
+                                  ("10m", TIER_10M_MS)):
+                cut = now - self.retention_ms[tier_ms]
+                segs = self._segs[tier]
+                keep = []
+                for entry in segs:
+                    expired = entry[2] > 0 and entry[2] < cut
+                    if expired and entry is not segs[-1]:
+                        with contextlib.suppress(OSError):
+                            os.remove(entry[1])
+                        continue
+                    keep.append(entry)
+                self._segs[tier] = keep
+
+    # -- queries -------------------------------------------------------------
+    def raw_window(self, key: str, col: str, start_ms: int, end_ms: int):
+        """All raw points of one series in [start_ms, end_ms], ts-sorted
+        (sealed + pending + head — a chunk mid-seal is never invisible)."""
+        pts: "list[tuple[int, float]]" = []
+        with self._lock:
+            blocks = [
+                b for b in self._raw
+                if b.t1 >= start_ms and b.t0 <= end_ms
+            ]
+            pending = list(self._pending)
+            if self._head_ts:
+                pending.append(
+                    (self._head_keys, self._head_cols,
+                     list(self._head_ts), list(self._head_mats))
+                )
+        for b in blocks:
+            got = b.series_points(key, col)
+            if got is None:
+                continue
+            ts_list, vals = got
+            pts.extend(
+                (t, v)
+                for t, v in zip(ts_list, vals)
+                if start_ms <= t <= end_ms
+            )
+        for keys, cols, ts_list, mats in pending:
+            if key not in keys or col not in cols:
+                continue
+            ki = keys.index(key)
+            ci = cols.index(col)
+            pts.extend(
+                (t, float(m[ki, ci]))
+                for t, m in zip(ts_list, mats)
+                if start_ms <= t <= end_ms
+            )
+        pts.sort(key=lambda p: p[0])
+        return pts
+
+    def rollup_window(self, tier_ms: int, key: str, col: str,
+                      start_ms: int, end_ms: int):
+        """Merged (bucket_ms, mn, mx, sm, cnt) quads for one series in
+        the window, including an on-the-fly fold of raw points newer
+        than the sealed rollups (head/pending must not leave a visible
+        gap at the right edge of a downsampled graph)."""
+        from tpudash.tsdb.rollup import merge_quads
+
+        quads = []
+        with self._lock:
+            blocks = [
+                r for r in self._rollups.get(tier_ms, [])
+                if r.src_t1 >= start_ms and r.src_t0 <= end_ms
+            ]
+        sealed_hi = 0
+        for r in blocks:
+            # a bucket belongs to the window when it INTERSECTS it —
+            # data late in a bucket must not vanish because the bucket's
+            # aligned start precedes the window
+            quads.extend(
+                q for q in r.series_quads(key, col)
+                if q[0] + tier_ms - 1 >= start_ms and q[0] <= end_ms
+            )
+            sealed_hi = max(sealed_hi, r.src_t1)
+        live_from = max(start_ms, sealed_hi + 1)
+        if live_from <= end_ms:
+            for t, v in self.raw_window(key, col, live_from, end_ms):
+                if v == v:  # NaN contributes nothing
+                    quads.append((t // tier_ms * tier_ms, v, v, v, 1))
+        return merge_quads(quads)
+
+    def series_keys(self) -> "set[str]":
+        """Every series key the store currently knows (any tier)."""
+        out: set = set()
+        with self._lock:
+            for b in self._raw:
+                out.update(b.keys)
+            for blocks in self._rollups.values():
+                for r in blocks:
+                    out.update(r.keys)
+            out.update(self._head_keys)
+            for keys, _cols, _ts, _m in self._pending:
+                out.update(keys)
+        out.discard(FLEET_SERIES)
+        return out
+
+    def series_cols(self, key: str) -> "list[str]":
+        cols: dict = {}
+        with self._lock:
+            sources: list = [(b.keys, b.cols) for b in self._raw]
+            sources += [(k, c) for k, c, _t, _m in self._pending]
+            if self._head_ts:
+                sources.append((self._head_keys, self._head_cols))
+            for blocks in self._rollups.values():
+                sources += [(r.keys, r.cols) for r in blocks]
+        for keys, block_cols in sources:
+            if key in keys:
+                for c in block_cols:
+                    cols[c] = None
+        return list(cols)
+
+    def point_count(self, key: str) -> int:
+        """Raw-tier point count for one series (horizon comparisons)."""
+        n = 0
+        with self._lock:
+            for b in self._raw:
+                if key in b.keys:
+                    n += b.count
+            for keys, _c, ts_list, _m in self._pending:
+                if key in keys:
+                    n += len(ts_list)
+            if key in self._head_keys:
+                n += len(self._head_ts)
+        return n
+
+    def earliest_ms(self, tier_ms: int = 0) -> "int | None":
+        with self._lock:
+            if tier_ms == 0:
+                t0s = [b.t0 for b in self._raw]
+                t0s += [ts[0] for _k, _c, ts, _m in self._pending if ts]
+                if self._head_ts:
+                    t0s.append(self._head_ts[0])
+            else:
+                t0s = [r.src_t0 for r in self._rollups.get(tier_ms, [])]
+        return min(t0s) if t0s else None
+
+    def latest_ms(self) -> "int | None":
+        with self._lock:
+            t1s = [b.t1 for b in self._raw]
+            t1s += [ts[-1] for _k, _c, ts, _m in self._pending if ts]
+            if self._head_ts:
+                t1s.append(self._head_ts[-1])
+            for blocks in self._rollups.values():
+                t1s += [r.t1 for r in blocks]
+        return max(t1s) if t1s else None
+
+    def stats(self) -> dict:
+        """Observability snapshot (rides /api/timings)."""
+        with self._lock:
+            raw_pts = sum(b.count for b in self._raw)
+            pend_pts = sum(len(ts) for _k, _c, ts, _m in self._pending)
+            comp_bytes = sum(b.nbytes() for b in self._raw)
+            out = {
+                "raw_blocks": len(self._raw),
+                "raw_points": raw_pts,
+                "head_points": len(self._head_ts) + pend_pts,
+                "series": (
+                    len(self._head_keys) * len(self._head_cols)
+                    if self._head_ts
+                    else (
+                        len(self._raw[-1].keys) * len(self._raw[-1].cols)
+                        if self._raw
+                        else 0
+                    )
+                ),
+                "compressed_bytes": comp_bytes,
+                "rollup_blocks": {
+                    _TIER_NAMES[t]: len(v) for t, v in self._rollups.items()
+                },
+                "persisted": bool(self.path),
+                "last_disk_error": self.last_disk_error,
+            }
+        lo = self.earliest_ms(0)
+        hi = self.latest_ms()
+        out["span_s"] = (
+            round((hi - lo) / 1000.0, 1)
+            if lo is not None and hi is not None
+            else 0.0
+        )
+        return out
