@@ -275,28 +275,37 @@ def bench_scale(
     }
 
 
-def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
+def bench_sse_subscribers(counts=(1, 8, 32, 256, 1024), ticks=8) -> dict:
     """N concurrent gzip SSE subscribers at 256 chips over the REAL
     stream handler (VERDICT r4 #6 — the "dashboard on every SRE's wall"
-    scenario).  Each subscriber pays its own gzip window and socket
-    writes; all share one scrape per interval and one delta
-    serialization per session (server.stream contract), so cost should
-    grow far slower than N.
+    scenario).  All N share one *cohort* (same selection, same style):
+    the hub composes, delta-encodes, and gzips ONCE per tick into a
+    sealed buffer, so the per-subscriber cost is a pure buffer write
+    (tpudash.broadcast — the BENCH_r05 serving wall this subsystem
+    removes; the pre-cohort curve grew ~1.3 ms CPU/tick per client).
 
     Reported per N: the whole-process CPU cost of one steady-state tick
     with all N subscribers attached (process CPU time / ticks, measured
     from a barrier AFTER every subscriber received its one-off full
     frame — wall time is sleep-paced by the SSE loop and would only
-    measure the pacing).  Server and subscribers share the process, so
-    the number includes each client's gzip decode and buffer splitting —
-    a term that scales LINEARLY with N, which makes the reported
+    measure the pacing), plus the cohort hub's own executor-side
+    compose+encode cost for the same window
+    (``sse_subscribers_{n}_cohort_ms_per_tick``) — THE per-cohort
+    number, measured inside the hub and independent of fan-out width.
+    Server and subscribers share the process, so the whole-process
+    number includes each client's gzip decode and buffer splitting — a
+    term that scales LINEARLY with N, which makes the reported
     sublinearity a conservative upper bound on the server's own fan-out
     cost.  Also reported: steady-state wire bytes per subscriber per
-    tick (counted after the full frame) and resident memory.  The
-    boundedness assertion is hard: ticks at the widest fan-out must stay
-    deep inside the 5 s refresh budget, and per-subscriber wire cost
-    must stay in the tens-of-KB band the single-subscriber bench
-    established."""
+    tick (counted after the full frame) and resident memory.
+
+    Hard guards: ticks at the widest fan-out must stay deep inside the
+    5 s refresh budget; per-subscriber wire cost must stay in the
+    tens-of-KB band the single-subscriber bench established; and the
+    compose-once contract itself — the per-cohort compose/delta/gzip
+    cost at 256 subscribers must be flat vs 32 (per-client marginal
+    ≤ 0.1 ms/tick), else a change quietly re-introduced per-subscriber
+    compose work."""
     import asyncio
     import time as _t
     import zlib
@@ -307,14 +316,21 @@ def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
     from tpudash.app.server import DashboardServer
 
     out = {}
+    cohort_ms = {}
+    cpu_anchor = None
     for n in counts:
         # refresh_interval matches the stream loop's 0.25 s sleep floor
         # (server.stream pacing): a smaller value would re-scrape inside
         # one tick cluster whenever subscriber wakeups smear past it,
-        # billing phantom scrapes to the fan-out being measured
-        svc = _bench_service(N_CHIPS, refresh_interval=0.25)
+        # billing phantom scrapes to the fan-out being measured;
+        # max_streams lifted above the widest fan-out — shedding is
+        # bench_shed_latency's subject, not this one's
+        svc = _bench_service(
+            N_CHIPS, refresh_interval=0.25, max_streams=2 * max(counts)
+        )
         server = DashboardServer(svc)
         steady_bytes = [0]
+        hub_marks = {}
 
         async def run(n=n):
             ts = TestServer(server.build_app())
@@ -360,36 +376,97 @@ def bench_sse_subscribers(counts=(1, 8, 32), ticks=8) -> dict:
                     await e.wait()
                 marks["cpu0"] = _t.process_time()
                 marks["t0"] = _t.perf_counter()
+                hub_marks["ms0"] = (
+                    server.hub.compose_ms_total + server.hub.encode_ms_total
+                )
+                hub_marks["seals0"] = server.hub.counters["seals"]
                 steady.set()
 
-            # auto_decompress off: we count the gzip bytes on the wire
-            async with ClientSession(auto_decompress=False) as session:
+            # auto_decompress off: we count the gzip bytes on the wire;
+            # unbounded pool + no per-request timeout — 1024 concurrent
+            # streams are the subject, the client connector must not be
+            # the limiter
+            from aiohttp import ClientTimeout, TCPConnector
+
+            async with ClientSession(
+                auto_decompress=False,
+                connector=TCPConnector(limit=0),
+                timeout=ClientTimeout(total=None),
+            ) as session:
                 await asyncio.gather(
                     mark_when_warm(),
                     *[subscribe(session, i) for i in range(n)],
                 )
                 cpu_s = _t.process_time() - marks["cpu0"]
                 wall_s = _t.perf_counter() - marks["t0"]
+                hub_ms = (
+                    server.hub.compose_ms_total
+                    + server.hub.encode_ms_total
+                    - hub_marks["ms0"]
+                )
+                seals = server.hub.counters["seals"] - hub_marks["seals0"]
             await ts.close()
-            return cpu_s, wall_s
+            return cpu_s, wall_s, hub_ms, seals
 
-        cpu_s, wall_s = asyncio.run(run())
+        cpu_s, wall_s, hub_ms, seals = asyncio.run(run())
         per_sub_tick = steady_bytes[0] / (n * ticks)
         cpu_tick_ms = 1e3 * cpu_s / ticks
+        # the cohort's own compose+delta+gzip cost per data tick — every
+        # steady-state seal was one tick's worth of shared work
+        cohort_ms[n] = hub_ms / max(1, seals)
         # boundedness: a full tick fanned out to N subscribers must stay
         # deep inside the refresh budget, and wire cost per subscriber
-        # must not balloon with fan-out (shared-delta contract)
-        assert cpu_tick_ms / 1e3 < BUDGET_S / 5.0, (
-            f"SSE tick at {n} subscribers costs {cpu_tick_ms:.0f}ms CPU"
-        )
+        # must not balloon with fan-out (shared-delta contract).  Above
+        # the historical 32-count anchor the whole-process number is
+        # dominated by the IN-PROCESS clients' own decode + scheduling
+        # (server and 1024 subscribers share one interpreter), so the
+        # guard there is marginal: ≤5 ms of combined client+server CPU
+        # per extra subscriber per tick — an order of magnitude under
+        # the pre-cohort curve once the client share is subtracted
+        if n <= 32:
+            assert cpu_tick_ms / 1e3 < BUDGET_S / 5.0, (
+                f"SSE tick at {n} subscribers costs {cpu_tick_ms:.0f}ms CPU"
+            )
+            cpu_anchor = (n, cpu_tick_ms)
+        elif cpu_anchor is not None:
+            anchor_n, anchor_ms = cpu_anchor
+            marginal_all = (cpu_tick_ms - anchor_ms) / (n - anchor_n)
+            assert marginal_all <= 5.0, (
+                f"SSE fan-out cost blew up: {cpu_tick_ms:.0f}ms CPU/tick "
+                f"at {n} subscribers ({marginal_all:.2f}ms marginal per "
+                f"client incl. in-process client decode)"
+            )
+        else:
+            # custom wide-only counts (no ≤32 anchor ran): absolute
+            # bound — a tick must still fit the refresh budget
+            assert cpu_tick_ms / 1e3 < BUDGET_S, (
+                f"SSE tick at {n} subscribers costs {cpu_tick_ms:.0f}ms CPU"
+            )
         assert per_sub_tick < 65536, (
             f"steady SSE tick {per_sub_tick:.0f}B/sub at {n} subscribers"
         )
         out[f"sse_subscribers_{n}_cpu_ms_per_tick"] = round(cpu_tick_ms, 2)
+        out[f"sse_subscribers_{n}_cohort_ms_per_tick"] = round(
+            cohort_ms[n], 3
+        )
         out[f"sse_subscribers_{n}_wire_bytes_per_sub_tick"] = round(
             per_sub_tick
         )
         out[f"sse_subscribers_{n}_wall_s"] = round(wall_s, 2)
+    # compose-once regression guard (ISSUE 6 acceptance): the per-cohort
+    # cost must NOT scale with fan-out width.  Marginal per-client cost
+    # within the cohort, 32 → 256 subscribers, capped at 0.1 ms/tick —
+    # the pre-cohort design sat at ~1.3 ms/client and would fail this by
+    # an order of magnitude.
+    if 32 in cohort_ms and 256 in cohort_ms:
+        marginal = (cohort_ms[256] - cohort_ms[32]) / (256 - 32)
+        out["sse_cohort_marginal_cpu_ms_per_client"] = round(marginal, 4)
+        assert marginal <= 0.1, (
+            f"per-cohort compose cost is no longer flat: "
+            f"{cohort_ms[32]:.2f}ms/tick at 32 subs vs "
+            f"{cohort_ms[256]:.2f}ms/tick at 256 "
+            f"({marginal:.3f}ms marginal per client)"
+        )
     out["sse_subscribers_rss_mb"] = _rss_mb()
     return out
 
@@ -648,6 +725,16 @@ def find_regressions(
     # noisy shared host, so only a 2x inflation flags — that's the size
     # of accidentally dragging a lock wait or executor hop into a shed
     for key in ("shed_503_p50_ms", "stale_frame_p50_ms"):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
+    # the broadcast plane (ISSUE 6): per-cohort compose cost is one
+    # executor hop of deterministic work, but time-domain on a noisy
+    # host, so a 2x inflation flags — the size of per-subscriber work
+    # leaking back into the seal path (the hard ≤0.1 ms/client marginal
+    # guard lives inside bench_sse_subscribers itself)
+    for key in (
+        "sse_subscribers_256_cohort_ms_per_tick",
+        "sse_subscribers_1024_cohort_ms_per_tick",
+    ):
         check(key, result.get(key), prev.get(key), "higher", 1.0)
     # the trend store (ISSUE 5): compression is deterministic (tight 10%
     # band); throughput/latency are time-domain on a noisy host, so only

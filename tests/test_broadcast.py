@@ -1,0 +1,693 @@
+"""Broadcast plane (ISSUE 6): cohort compose-once fan-out + worker tier.
+
+Layer 1 units: cohort keying, the full-flush gzip segment contract, the
+seal window's Last-Event-ID resume protocol, and the hub's compose-once /
+bounded-cohorts guarantees.  Layer 2: bus wire framing, publisher→mirror
+replication over a real unix socket (snapshot, live seals, bindings,
+backlog overflow), preflight fail-fast, and the two contracts that only
+exist multi-process — a client reconnecting to a DIFFERENT worker with
+``Last-Event-ID`` resumes with a delta, and a worker crash costs its
+clients one reconnect, not their delta state.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket as socketmod
+import zlib
+
+import pytest
+
+from tpudash.app.state import SelectionState
+from tpudash.broadcast.bus import (
+    BusMirror,
+    BusProtocolError,
+    BusPublisher,
+    PROTO,
+    decode_seal,
+    encode_message,
+    encode_seal,
+    read_message,
+)
+from tpudash.broadcast.cohort import (
+    GZIP_HEADER,
+    CohortHub,
+    Seal,
+    SealWindow,
+    cohort_key,
+    compress_segment,
+    parse_event_id,
+)
+from tpudash.broadcast.supervisor import BroadcastSetupError, preflight
+from tpudash.config import Config
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _state(selected=("chip-0",), gauge=True, initialized=True):
+    s = SelectionState()
+    s.selected = list(selected)
+    s.use_gauge = gauge
+    s._initialized = initialized
+    return s
+
+
+def _seal(cid=7, seq=1, delta=True, pad=b""):
+    full = b"id: %d-%d\ndata: {\"kind\":\"full\"}\n\n" % (cid, seq) + pad
+    d = (
+        b"id: %d-%d\ndata: {\"kind\":\"delta\"}\n\n" % (cid, seq) + pad
+        if delta
+        else None
+    )
+    frame = b"{}" + pad
+    return Seal(
+        cid,
+        seq,
+        (seq, False),
+        full,
+        compress_segment(full),
+        d,
+        compress_segment(d) if d is not None else None,
+        frame,
+        compress_segment(frame),
+    )
+
+
+# -- cohort keying / event ids ----------------------------------------------
+
+
+def test_cohort_key_groups_identical_ui_state():
+    assert cohort_key(_state()) == cohort_key(_state())
+    assert cohort_key(_state(("a", "b"))) != cohort_key(_state(("a",)))
+    assert cohort_key(_state(gauge=False)) != cohort_key(_state(gauge=True))
+    assert cohort_key(_state(initialized=False)) != cohort_key(_state())
+
+
+def test_parse_event_id_shapes():
+    assert parse_event_id("123-45") == (123, 45)
+    assert parse_event_id(None) is None
+    assert parse_event_id("") is None
+    assert parse_event_id("garbage") is None
+    assert parse_event_id("1-2-3") is None
+    assert parse_event_id("x-y") is None
+
+
+def test_compressed_segments_concatenate_into_one_gzip_stream():
+    # the whole compose-once-gzip-once design rests on this property:
+    # independently-compressed segments, written after one shared gzip
+    # header, decode as a single stream by one decompressor
+    a, b, c = b"first event\n\n", b"x" * 4096, b"tail"
+    stream = (
+        GZIP_HEADER
+        + compress_segment(a)
+        + compress_segment(b)
+        + compress_segment(c)
+    )
+    d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+    assert d.decompress(stream) == a + b + c
+
+
+# -- seal window: the Last-Event-ID resume protocol --------------------------
+
+
+def test_window_resume_semantics():
+    w = SealWindow(limit=4)
+    assert w.since(3) is None  # empty window: only a full is faithful
+    for seq in (1, 2, 3):
+        w.append(_seal(seq=seq))
+    assert [s.seq for s in w.since(1)] == [2, 3]
+    assert w.since(3) == []  # caught up: keepalive
+    assert w.since(9) is None  # future epoch (publisher restart)
+    assert w.since(None) is None
+
+
+def test_window_gap_and_structural_break_force_full():
+    w = SealWindow(limit=2)
+    for seq in (1, 2, 3, 4):
+        w.append(_seal(seq=seq))
+    assert len(w.seals) == 2  # bounded
+    assert w.since(1) is None  # seq 2 fell out of the window
+    w2 = SealWindow(limit=4)
+    w2.append(_seal(seq=1))
+    w2.append(_seal(seq=2, delta=False))  # structural step
+    assert w2.since(1) is None
+
+
+# -- hub: compose once, bounded cohorts --------------------------------------
+
+
+def _hub(calls, monkeypatch, **kw):
+    import tpudash.broadcast.cohort as cohort_mod
+
+    monkeypatch.setattr(
+        cohort_mod,
+        "frame_delta",
+        lambda prev, cur: None if prev is None else {"kind": "delta"},
+    )
+
+    def compose(state):
+        calls.append(tuple(state.selected))
+        return {"error": None, "n": len(calls)}
+
+    return CohortHub(compose, json.dumps, **kw)
+
+
+def test_hub_composes_once_per_cohort_per_tick(monkeypatch):
+    calls = []
+    hub = _hub(calls, monkeypatch)
+
+    async def go():
+        c = hub.resolve(_state())
+        s1 = await hub.seal_cohort(c, (1, False))
+        s1b = await hub.seal_cohort(c, (1, False))  # same tick: cached
+        assert s1 is s1b
+        s2 = await hub.seal_cohort(c, (2, False))
+        assert s2.seq == s1.seq + 1
+        return s1, s2
+
+    s1, s2 = _run(go())
+    assert len(calls) == 2  # one compose per tick, any number of callers
+    assert s1.sse_delta_raw is None  # first seal: nothing to delta from
+    assert s2.sse_delta_raw is not None
+    assert s2.event_id.endswith("-2")
+
+
+def test_hub_epoch_invalidation_reseals_without_new_data(monkeypatch):
+    calls = []
+    hub = _hub(calls, monkeypatch)
+
+    async def go():
+        c = hub.resolve(_state())
+        tick = (1, False, hub.epoch)
+        await hub.seal_cohort(c, tick)
+        hub.invalidate()  # e.g. a silence changed
+        await hub.seal_cohort(c, (1, False, hub.epoch))
+
+    _run(go())
+    assert len(calls) == 2
+
+
+def test_hub_bounds_cohorts_with_lru_eviction(monkeypatch):
+    evicted = []
+    hub = _hub([], monkeypatch, max_cohorts=2, on_evict=evicted.extend)
+    a = hub.resolve(_state(("a",)))
+    b = hub.resolve(_state(("b",)))
+    hub.resolve(_state(("a",)))  # refresh a
+    hub.resolve(_state(("c",)))  # evicts b
+    assert len(hub) == 2
+    assert hub.get(a.key) is not None
+    assert hub.counters["cohorts_evicted"] == 1
+    # LRU eviction reaches the bus mirrors, same as idle eviction
+    assert evicted == [b.cid]
+
+
+def test_hub_recreated_cohort_continues_seq_numbering(monkeypatch):
+    """An LRU-evicted cohort recreated under the same content key (same
+    crc32 cid) must CONTINUE its seq numbering: mirrors keep a
+    monotonic-seq window per cid, and a client reconnecting with an ack
+    from the old incarnation must hit a window gap (full frame), never a
+    delta chain diffed against a base frame it does not hold."""
+    hub = _hub([], monkeypatch, max_cohorts=1)
+
+    async def go():
+        a = hub.resolve(_state(("a",)))
+        for tick in range(1, 4):
+            last = await hub.seal_cohort(a, (tick, False))
+        hub.resolve(_state(("b",)))  # evicts a at seq 3
+        a2 = hub.resolve(_state(("a",)))  # evicts b, recreates a's cid
+        assert a2.cid == a.cid and a2 is not a
+        s = await hub.seal_cohort(a2, (4, False))
+        assert s.seq == 4  # continued, not restarted at 1
+        # the old incarnation's ack can only resume as a full frame
+        chain, _ = hub.payloads_for(a2, (a.cid, 2))
+        assert chain is None
+
+    _run(go())
+
+
+def test_hub_idle_eviction_spares_touched_cohorts(monkeypatch):
+    clock = [0.0]
+    hub = _hub([], monkeypatch, clock=lambda: clock[0])
+    a = hub.resolve(_state(("a",)))
+    b = hub.resolve(_state(("b",)))
+    clock[0] = 100.0
+    hub.touch([b.cid])  # a worker reported live subscribers on b
+    assert hub.evict_idle(60.0) == [a.cid]
+    assert hub.get(b.key) is not None
+
+
+def test_hub_payloads_for_resume_and_fallback(monkeypatch):
+    hub = _hub([], monkeypatch)
+
+    async def go():
+        c = hub.resolve(_state())
+        await hub.seal_cohort(c, (1, False))
+        s2 = await hub.seal_cohort(c, (2, False))
+        # caught up → keepalive; stale-but-in-window → delta chain;
+        # unknown/foreign/absent ack → full frame
+        assert hub.payloads_for(c, (c.cid, s2.seq)) == ([], s2.seq)
+        chain, ack = hub.payloads_for(c, (c.cid, 1))
+        assert [s.seq for s in chain] == [2] and ack == 2
+        assert hub.payloads_for(c, None)[0] is None
+        assert hub.payloads_for(c, (999, 1))[0] is None
+
+    _run(go())
+
+
+# -- bus wire format ----------------------------------------------------------
+
+
+def test_seal_wire_round_trip_including_structural_none():
+    for delta in (True, False):
+        seal = _seal(cid=42, seq=9, delta=delta, pad=b"P" * 1000)
+        buf = encode_seal(seal, n=3)
+
+        async def go():
+            reader = asyncio.StreamReader()
+            reader.feed_data(buf)
+            reader.feed_eof()
+            return await read_message(reader)
+
+        header, body = _run(go())
+        got = decode_seal(header, body)
+        for name in (
+            "cid",
+            "seq",
+            "event_id",
+            "tick_key",
+            "sse_full_raw",
+            "sse_full_gz",
+            "sse_delta_raw",
+            "sse_delta_gz",
+            "frame_raw",
+            "frame_gz",
+        ):
+            assert getattr(got, name) == getattr(seal, name), name
+
+
+def test_bus_rejects_garbage_framing():
+    async def feed(buf):
+        reader = asyncio.StreamReader()
+        reader.feed_data(buf)
+        reader.feed_eof()
+        return await read_message(reader)
+
+    with pytest.raises(BusProtocolError):
+        _run(feed(b"\xff\xff\xff\xff" + b"x" * 8))  # absurd length
+    import struct
+
+    no_newline = b"header without terminator"
+    with pytest.raises(BusProtocolError):
+        _run(feed(struct.pack("<I", len(no_newline)) + no_newline))
+    bad_json = b"{not json}\n"
+    with pytest.raises(BusProtocolError):
+        _run(feed(struct.pack("<I", len(bad_json)) + bad_json))
+    seal = _seal()
+    buf = encode_seal(seal, 1)
+
+    async def bad_lens():
+        reader = asyncio.StreamReader()
+        reader.feed_data(buf)
+        reader.feed_eof()
+        header, body = await read_message(reader)
+        header["lens"][0] += 7  # blob lengths disagree with body
+        decode_seal(header, body)
+
+    with pytest.raises(BusProtocolError):
+        _run(bad_lens())
+
+
+def test_mirror_apply_protocol():
+    m = BusMirror("/nonexistent")
+    m._apply({"t": "hello", "proto": PROTO, "window": 4}, b"")
+    assert m.connected and m.window_limit == 4
+    seal = _seal(cid=5, seq=1)
+    header, body = _roundtrip(encode_seal(seal, 1))
+    m._apply(header, body)
+    # duplicates (snapshot racing a live publish) apply at most once
+    m._apply(header, body)
+    assert m.counters["seals_applied"] == 1
+    assert m.window(5).latest().seq == 1
+    m._apply({"t": "binding", "sid": "s1", "cid": 5}, b"")
+    assert m.bindings["s1"] == 5
+    m._apply({"t": "evict", "cids": [5]}, b"")
+    assert m.window(5) is None
+    with pytest.raises(BusProtocolError):
+        m._apply({"t": "hello", "proto": PROTO + 1}, b"")
+
+
+def _roundtrip(buf):
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(buf)
+        reader.feed_eof()
+        return await read_message(reader)
+
+    return _run(go())
+
+
+# -- publisher ↔ mirror over a real unix socket ------------------------------
+
+
+def test_publisher_snapshots_and_replicates_to_mirror(tmp_path):
+    path = str(tmp_path / "bus.sock")
+
+    async def go():
+        hub = CohortHub(lambda s: {}, json.dumps, window=4)
+        # pre-seed a cohort window the way the compose loop would
+        cohort = hub.resolve(_state(("a",)))
+        pre = _seal(cid=cohort.cid, seq=1)
+        cohort.window.append(pre)
+        pub = BusPublisher(path, hub, backlog=64)
+        await pub.start()
+        pub.bindings["sid-1"] = cohort.cid
+        mirror = BusMirror(path, pid=123, index=0)
+        stop = asyncio.Event()
+        task = asyncio.ensure_future(mirror.run(stop))
+        try:
+            # snapshot: hello + retained seals + bindings
+            for _ in range(100):
+                if mirror.connected and mirror.window(cohort.cid):
+                    break
+                await asyncio.sleep(0.05)
+            assert mirror.connected
+            assert mirror.window(cohort.cid).latest().seq == 1
+            assert mirror.bindings["sid-1"] == cohort.cid
+            # live publishes replicate in order
+            pub.publish_seal(_seal(cid=cohort.cid, seq=2))
+            pub.publish_binding("sid-2", cohort.cid)
+            for _ in range(100):
+                if "sid-2" in mirror.bindings:
+                    break
+                await asyncio.sleep(0.05)
+            assert mirror.window(cohort.cid).latest().seq == 2
+            # worker → publisher: active-cohort pings reach on_active
+            mirror.retain(cohort.cid)
+            await mirror.send_active()
+            await asyncio.sleep(0.2)
+            assert pub.workers() and pub.workers()[0]["pid"] == 123
+            # eviction propagates
+            pub.publish_evict([cohort.cid])
+            for _ in range(100):
+                if mirror.window(cohort.cid) is None:
+                    break
+                await asyncio.sleep(0.05)
+            assert mirror.window(cohort.cid) is None
+        finally:
+            stop.set()
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await pub.close()
+
+    _run(go())
+
+
+def test_publisher_disconnects_wedged_worker_at_backlog_bound(tmp_path):
+    path = str(tmp_path / "bus.sock")
+
+    async def go():
+        hub = CohortHub(lambda s: {}, json.dumps)
+        pub = BusPublisher(path, hub, backlog=8)
+        await pub.start()
+        # a "worker" that connects and never reads: its queue must hit
+        # the bound and be cut loose instead of growing publisher memory
+        reader, writer = await asyncio.open_unix_connection(path)
+        await asyncio.sleep(0.1)
+        big = _seal(pad=b"B" * 262144)  # outsized: fills socket buffers
+        for seq in range(1, 40):
+            pub.publish_seal(_seal(cid=1, seq=seq, pad=b"B" * 262144))
+            await asyncio.sleep(0)
+        for _ in range(100):
+            if pub.counters["worker_overflows"] >= 1:
+                break
+            pub.publish_seal(big)
+            await asyncio.sleep(0.05)
+        assert pub.counters["worker_overflows"] >= 1
+        assert pub.workers() == []  # dropped, not retained
+        writer.close()
+        await pub.close()
+
+    _run(go())
+
+
+# -- preflight: fail fast, never fall back -----------------------------------
+
+
+class _NoReuseportSocketMod:
+    """socket module lookalike without SO_REUSEPORT (macOS-pre-10.9 /
+    exotic platforms shape)."""
+
+    AF_INET = socketmod.AF_INET
+    SOCK_STREAM = socketmod.SOCK_STREAM
+    SOL_SOCKET = socketmod.SOL_SOCKET
+    socket = socketmod.socket
+
+
+class _RefusingSocketMod(_NoReuseportSocketMod):
+    """SO_REUSEPORT exposed but the kernel refuses the double bind."""
+
+    SO_REUSEPORT = 15
+
+    class socket:  # noqa: N801 - mimics socket.socket
+        def __init__(self, *a):
+            pass
+
+        def setsockopt(self, *a):
+            raise OSError(92, "protocol not available")
+
+        def bind(self, *a):
+            pass
+
+        def getsockname(self):
+            return ("127.0.0.1", 1)
+
+        def close(self):
+            pass
+
+
+def test_preflight_fails_fast_without_reuseport():
+    cfg = Config(workers=4)
+    with pytest.raises(BroadcastSetupError) as e:
+        preflight(cfg, socket_mod=_NoReuseportSocketMod)
+    assert "SO_REUSEPORT" in str(e.value)
+    assert "TPUDASH_WORKERS=0" in str(e.value)  # actionable way out
+
+
+def test_preflight_fails_fast_when_kernel_refuses_double_bind():
+    cfg = Config(workers=2)
+    with pytest.raises(BroadcastSetupError) as e:
+        preflight(cfg, socket_mod=_RefusingSocketMod)
+    assert "refused" in str(e.value)
+
+
+def test_preflight_rejects_unusable_bus_paths(tmp_path):
+    plain_file = tmp_path / "not-a-dir"
+    plain_file.write_text("x")
+    cfg = Config(workers=2, broadcast_bus=str(plain_file / "bus"))
+    with pytest.raises(BroadcastSetupError) as e:
+        preflight(cfg)
+    assert "TPUDASH_BROADCAST_BUS" in str(e.value)
+    too_long = str(tmp_path / ("d" * 120))
+    with pytest.raises(BroadcastSetupError) as e:
+        preflight(Config(workers=2, broadcast_bus=too_long))
+    assert "unix socket path" in str(e.value)
+
+
+def test_preflight_passes_on_this_platform(tmp_path):
+    # CI runs on Linux: the real kernel must pass its own probe
+    bus = preflight(Config(workers=2, broadcast_bus=str(tmp_path / "bus")))
+    assert os.path.isdir(bus)
+
+
+# -- the worker tier, live: cross-worker resume + crash recovery -------------
+
+
+async def _read_event(resp, deadline=30.0):
+    """Next real SSE event from an identity-encoded stream:
+    (event_id, payload dict)."""
+
+    async def go():
+        buf = b""
+        async for chunk in resp.content.iter_any():
+            buf += chunk
+            while b"\n\n" in buf:
+                evt, buf = buf.split(b"\n\n", 1)
+                if evt.startswith(b":"):
+                    continue  # keepalive
+                eid, payload = None, None
+                for line in evt.split(b"\n"):
+                    if line.startswith(b"id: "):
+                        eid = line[4:].decode()
+                    elif line.startswith(b"data: "):
+                        payload = json.loads(line[6:])
+                if payload is not None:
+                    return eid, payload
+        raise AssertionError("stream ended without an event")
+
+    return await asyncio.wait_for(go(), deadline)
+
+
+async def _stream_once(session, base, cookies, last_id=None, want_pid=None):
+    """Open /api/stream (optionally resuming), read one event, return
+    (worker_pid, event_id, payload).  With ``want_pid`` set, retries
+    fresh connections until SO_REUSEPORT lands the stream on a worker
+    whose pid differs — the cross-worker reconnect scenario."""
+    headers = {"Accept-Encoding": "identity"}
+    if last_id is not None:
+        headers["Last-Event-ID"] = last_id
+    for _ in range(80):
+        try:
+            resp = await session.get(
+                f"{base}/api/stream", headers=headers, cookies=cookies
+            )
+        except OSError:
+            await asyncio.sleep(0.25)  # a crashed worker's socket draining
+            continue
+        pid = resp.headers.get("X-TPUDash-Worker")
+        if resp.status != 200 or (
+            want_pid is not None and pid == want_pid
+        ):
+            resp.close()
+            await asyncio.sleep(0.1)
+            continue
+        try:
+            eid, payload = await _read_event(resp)
+        finally:
+            resp.close()
+        return pid, eid, payload
+    raise AssertionError(
+        f"could not land a stream (want_pid != {want_pid})"
+    )
+
+
+@pytest.fixture(scope="module")
+def worker_tier_facts():
+    """One supervised 2-worker tier, exercised through both multi-process
+    scenarios; tests assert on the collected facts.  Module-scoped: the
+    tier costs seconds to spawn, the scenarios share it."""
+    from aiohttp import ClientSession, ClientTimeout, TCPConnector
+
+    from tpudash.broadcast.supervisor import Supervisor
+    from tpudash.chaos import make_storm_server
+
+    facts = {}
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        server, cfg, bus_dir = await loop.run_in_executor(
+            None, make_storm_server, None, 2
+        )
+        sup = Supervisor(cfg, server, bus_dir, log_dir=bus_dir)
+        await sup.start()
+        base = f"http://{cfg.host}:{cfg.port}"
+        cookies = {"tpudash_sid": "xworker-test"}
+        try:
+            async with ClientSession(
+                connector=TCPConnector(force_close=True),
+                timeout=ClientTimeout(total=None, connect=5, sock_read=30),
+            ) as session:
+                # wait for both workers to join the bus
+                for _ in range(240):
+                    if len(sup.publisher.workers()) >= 2:
+                        break
+                    await asyncio.sleep(0.25)
+                facts["workers_connected"] = len(sup.publisher.workers())
+
+                # -- scenario 0: proxied route, client offered NO
+                # encoding — the internal hop must not let aiohttp's
+                # default Accept-Encoding leak a compressed body through
+                # to a client that can't decode it (skip_auto_headers
+                # keeps aiohttp from adding ITS default on this probe)
+                async with session.get(
+                    f"{base}/api/timings",
+                    cookies=cookies,
+                    skip_auto_headers=("Accept-Encoding",),
+                ) as r:
+                    facts["proxy_encoding"] = (
+                        r.status,
+                        r.headers.get("Content-Encoding"),
+                        "broadcast" in await r.json(),
+                    )
+                # /internal/ is the compose process's worker-only
+                # surface: the public catch-all proxy must refuse it
+                # (compose's auth/admission middlewares wave /internal/
+                # through on the assumption it came from a worker)
+                async with session.get(
+                    f"{base}/internal/cohort", params={"sid": "evil"}
+                ) as r:
+                    facts["internal_status"] = r.status
+
+                # -- scenario 1: reconnect to a DIFFERENT worker ---------
+                pid_a, eid_a, first = await _stream_once(
+                    session, base, cookies
+                )
+                facts["first_kind"] = first.get("kind")
+                # let at least one more tick seal so the resume has a
+                # delta to ride
+                await asyncio.sleep(2 * cfg.refresh_interval)
+                pid_b, eid_b, resumed = await _stream_once(
+                    session, base, cookies, last_id=eid_a, want_pid=pid_a
+                )
+                facts["cross_worker"] = (pid_a, pid_b)
+                facts["resumed_kind"] = resumed.get("kind")
+                facts["resumed_id"] = (eid_a, eid_b)
+
+                # -- scenario 2: worker crash → reconnect → resume -------
+                os.kill(int(pid_b), signal.SIGKILL)
+                pid_c, eid_c, after_crash = await _stream_once(
+                    session, base, cookies, last_id=eid_b, want_pid=pid_b
+                )
+                facts["crash"] = (pid_b, pid_c)
+                facts["after_crash_kind"] = after_crash.get("kind")
+                # the supervisor restarts the dead slot
+                for _ in range(240):
+                    if sup.restarts >= 1 and len(sup.publisher.workers()) >= 2:
+                        break
+                    await asyncio.sleep(0.25)
+                facts["restarts"] = sup.restarts
+                facts["workers_after_crash"] = len(sup.publisher.workers())
+        finally:
+            await sup.stop()
+
+    _run(go())
+    return facts
+
+
+def test_proxied_route_honors_clients_missing_accept_encoding(
+    worker_tier_facts,
+):
+    status, encoding, parsed = worker_tier_facts["proxy_encoding"]
+    assert status == 200
+    assert encoding in (None, "identity")  # nothing the client can't decode
+    assert parsed  # and the body is the route's actual JSON
+
+
+def test_internal_routes_unreachable_through_worker_proxy(worker_tier_facts):
+    assert worker_tier_facts["internal_status"] == 404
+
+
+def test_cross_worker_reconnect_resumes_with_delta(worker_tier_facts):
+    f = worker_tier_facts
+    assert f["workers_connected"] >= 2
+    assert f["first_kind"] == "full"  # fresh stream: baseline frame
+    pid_a, pid_b = f["cross_worker"]
+    assert pid_a != pid_b  # genuinely a different worker process
+    # the whole point of content-addressed event ids: the OTHER worker's
+    # mirror resumed the delta chain, no full-frame re-send
+    assert f["resumed_kind"] == "delta"
+    eid_a, eid_b = f["resumed_id"]
+    assert eid_a.split("-")[0] == eid_b.split("-")[0]  # same cohort
+    assert int(eid_b.split("-")[1]) > int(eid_a.split("-")[1])
+
+
+def test_worker_crash_then_reconnect_resumes(worker_tier_facts):
+    f = worker_tier_facts
+    dead, survivor = f["crash"]
+    assert survivor != dead
+    # the client's delta state outlived the process that was serving it
+    assert f["after_crash_kind"] == "delta"
+    assert f["restarts"] >= 1  # supervisor respawned the dead slot
+    assert f["workers_after_crash"] >= 2

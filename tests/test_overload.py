@@ -141,6 +141,30 @@ def test_frame_degrades_to_stale_not_503():
     _run(_with_client(server.build_app(), go))
 
 
+def test_frame_shed_degrades_from_cohort_seal_when_never_polled():
+    """A pure-SSE deployment never populates the polling cache
+    (_last_frame); the shed path must degrade to the newest cohort seal
+    instead of erroring — the hub composed real frames for streamers."""
+    server = _server(rate_limit=1.0, rate_burst=2.0)
+
+    async def go(client):
+        # prime via the stream only: the cohort hub seals a frame, the
+        # polling cache stays empty
+        resp = await client.get("/api/stream")
+        await resp.content.readany()
+        resp.close()
+        assert server._last_frame is None
+        assert server.hub.last_frame is not None
+        await client.get("/api/timings")  # burn the bucket
+        stale = await client.get("/api/frame")
+        assert stale.status == 200
+        body = await stale.json()
+        assert body["stale"] is True
+        assert body["chips"]  # the seal's real data, not an empty shell
+
+    _run(_with_client(server.build_app(), go))
+
+
 def test_frame_shed_before_any_frame_is_503():
     server = _server(rate_limit=1.0, rate_burst=1.0)
 
@@ -345,17 +369,20 @@ def test_slow_consumer_evicted_then_resumes_with_delta():
             snap = server.overload.snapshot()
             assert snap["counters"]["evicted_slow_consumers"] == 1
             assert snap["streams"] == 0  # the slot was released
-            # the evicted session survived eviction with its delta caches
+            # the evicted session survived eviction, and its cohort's
+            # seal window retains the delta chain past the acked event
             entry = server.sessions.peek(sid)
             assert entry is not None
-            assert entry.prev_frame is not None
+            cohort = server.hub.resolve(entry.state)
+            assert cohort.window.latest() is not None
             # the client state to pin: an evicted consumer whose last
             # FULLY-received event was the one before the blocked write
             # (the blocked write itself died with the connection).  Its
-            # EventSource reconnects acking the previous event's id.
-            from tpudash.app.server import _key_id
-
-            last_id = _key_id(entry.prev_frame_key)
+            # EventSource reconnects acking that event's id — the id on
+            # the wire, exactly as a real EventSource would echo it.
+            m = re.search(rb"id: ([0-9\-]+)", first_buf)
+            assert m, f"no SSE id in first event: {first_buf[:200]!r}"
+            last_id = m.group(1).decode()
             # pin the refresh window before reconnecting: the contract
             # under test is delta RESUME, not refresh cadence — a slow
             # CI host must not sneak an extra data version in between

@@ -53,6 +53,30 @@ SHED_CONCURRENCY = "concurrency"
 SHED_STREAMS = "streams"
 
 
+def bound_stream_buffers(request, sndbuf: int) -> None:
+    """Clamp one SSE connection's outbound buffering to ``sndbuf`` bytes
+    (``Config.sse_sndbuf``): both the kernel socket send buffer and
+    aiohttp's transport write buffer.  Unbounded auto-tuned buffers cost
+    real memory per wedged consumer at thousands of streams AND absorb a
+    stall silently — the write deadline can only evict a slow consumer
+    whose writes actually block.  No-op when ``sndbuf`` is 0 or the
+    transport is already gone."""
+    if sndbuf <= 0:
+        return
+    import socket as socketmod
+
+    transport = request.transport
+    if transport is None:
+        return
+    sock = transport.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_SNDBUF, sndbuf)
+        except OSError:
+            return  # already disconnecting — nothing to bound
+    transport.set_write_buffer_limits(high=sndbuf)
+
+
 class TokenBucket:
     """Classic token bucket on a monotonic clock: ``rate`` tokens/s up to
     ``burst``; one token per admitted request."""

@@ -47,9 +47,16 @@ log = logging.getLogger(__name__)
 from tpudash.analysis.asynccheck import LoopLagMonitor
 from tpudash.app.assets import find_plotly_asset
 from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
-from tpudash.app.overload import OverloadGuard
+from tpudash.app.overload import OverloadGuard, bound_stream_buffers
 from tpudash.app.service import DashboardService
 from tpudash.app.sessions import SessionEntry, SessionStore
+from tpudash.broadcast.cohort import (
+    GZIP_HEADER,
+    KEEPALIVE_GZ,
+    KEEPALIVE_RAW,
+    CohortHub,
+    parse_event_id,
+)
 from tpudash.config import Config, load_config
 from tpudash.sources import make_source
 
@@ -206,6 +213,29 @@ class DashboardServer:
         self.loop_monitor = LoopLagMonitor(
             budget_ms=service.cfg.loop_lag_budget
         )
+        #: cohort broadcast hub (tpudash.broadcast): sessions sharing a
+        #: (selection, style) state compose/delta/gzip ONCE per tick into
+        #: immutable sealed buffers; the per-client SSE loop below is a
+        #: pure buffer write.  In TPUDASH_WORKERS mode the supervisor
+        #: publishes these same seals onto the frame bus.
+        self.hub = CohortHub(
+            service.compose_frame,
+            _dumps,
+            window=service.cfg.broadcast_window,
+            max_cohorts=service.cfg.broadcast_max_cohorts,
+            on_evict=self._on_cohort_evict,
+        )
+        #: worker-tier stats provider (set by the broadcast supervisor);
+        #: None → single-process mode, /api/workers reports just this one
+        self.workers_provider = None
+        #: frame-bus publisher (tpudash.broadcast.bus.BusPublisher, set by
+        #: the supervisor in TPUDASH_WORKERS mode); None → single-process.
+        #: Newly-created seals and session→cohort bindings are pushed to
+        #: it so worker mirrors stay current.
+        self.bus_publisher = None
+        #: (cid → seq) of the newest seal already handed to the bus — a
+        #: tick that served a cached seal must not re-publish it
+        self._published_seqs: dict = {}
         #: vendored plotly bundle (deploy-time property, resolved once);
         #: None → the page uses the CDN tag and /static 404s
         self._plotly_asset = find_plotly_asset(service.cfg.assets_dir)
@@ -350,14 +380,12 @@ class DashboardServer:
     async def _compose_locked(
         self,
         entry: SessionEntry,
-        keep_prev: bool = False,
         deadline: "float | None" = None,
     ) -> "tuple[dict, tuple]":
         """Per-session compose with its (data_version, state_version) cache
         key.  Caller holds _lock and has already run _refresh_locked — the
-        single copy of the cache-keying protocol both transports share.
-        ``keep_prev`` retains the outgoing frame for the delta transport;
-        pure-polling sessions never pay that second frame's memory.
+        polling transport's cache-keying protocol (the SSE transport now
+        rides the cohort hub instead, see :meth:`_stream_admitted`).
 
         A request whose budget (``deadline``) has already expired — it
         queued behind the lock longer than its client will wait — serves
@@ -383,9 +411,6 @@ class DashboardServer:
         frame = await loop.run_in_executor(
             None, self.service.compose_frame, entry.state
         )
-        if keep_prev and entry.frame is not None:
-            entry.prev_frame = entry.frame
-            entry.prev_frame_key = entry.frame_key
         entry.frame = frame
         entry.frame_key = key
         self._last_frame = frame
@@ -410,65 +435,37 @@ class DashboardServer:
             frame, _ = await self._compose_locked(entry, deadline=deadline)
             return frame
 
-    async def _get_sse_event(
-        self, entry: SessionEntry, client_key: "tuple | None"
-    ) -> "tuple[bytes, tuple]":
-        """(payload, key) for one stream tick.  Sends, in order of
-        preference: a keepalive comment when the client already holds the
-        current frame; a value-only delta when the client's frame can be
-        patched to the current one (tpudash.app.delta); otherwise a full
-        frame.  Payloads are serialized once per (from, to) step per
-        session and shared by all of its subscribers.
+    def _tick_key(self) -> tuple:
+        """What one broadcast tick composes from: the shared data version,
+        the hub's global-invalidation epoch (silences), and whether the
+        source is currently stalled (the warning must appear — and clear —
+        without a data refresh)."""
+        return (
+            self._data_version,
+            self.hub.epoch,
+            bool(self.service.refresh_stalled),
+        )
 
-        Runs refresh → compose → diff → serialize under ONE lock hold so
-        cached bytes are always stamped with the version they were
-        composed from."""
-        from tpudash.app.delta import frame_delta
-
+    async def _cohort_tick(
+        self, entry: SessionEntry, ack: "tuple[int, int] | None"
+    ) -> "tuple[list, tuple[int, int]]":
+        """One stream tick through the cohort hub: refresh the shared data
+        when stale, resolve the session's cohort, seal it (compose + delta
+        + serialize + gzip ONCE for every subscriber of the cohort, cached
+        across callers racing on the same tick), and pick the seals this
+        subscriber still needs.  Returns ``(seals, new_ack)`` where
+        ``seals`` is the delta chain to send, ``[latest]`` full-frame
+        fallback, or ``[]`` keepalive — encoded as (seal, use_delta)
+        pairs so the writer stays trivial."""
         async with self._lock:
             await self._refresh_locked(False)
-            frame, key = await self._compose_locked(entry, keep_prev=True)
-            if client_key == key:
-                # nothing new: SSE comment (ignored by EventSource)
-                return b": keepalive\n\n", key
-            loop = asyncio.get_running_loop()
-            if (
-                client_key is not None
-                and client_key == entry.prev_frame_key
-                and entry.prev_frame is not None
-            ):
-                if (
-                    entry.sse_delta is not None
-                    and entry.sse_delta_keys == (client_key, key)
-                ):
-                    return entry.sse_delta, key
-                prev = entry.prev_frame
-
-                def build_delta():
-                    delta = frame_delta(prev, frame)
-                    if delta is None:
-                        return None
-                    return (
-                        f"id: {_key_id(key)}\ndata: {_dumps(delta)}\n\n"
-                    ).encode()
-
-                payload = await loop.run_in_executor(None, build_delta)
-                if payload is not None:
-                    entry.sse_delta = payload
-                    entry.sse_delta_keys = (client_key, key)
-                    return payload, key
-            if entry.sse_full is not None and entry.sse_full_key == key:
-                return entry.sse_full, key
-            payload = await loop.run_in_executor(
-                None,
-                lambda: (
-                    f"id: {_key_id(key)}\n"
-                    f"data: {_dumps(dict(frame, kind='full'))}\n\n"
-                ).encode(),
-            )
-            entry.sse_full = payload
-            entry.sse_full_key = key
-            return payload, key
+            cohort = self.hub.resolve(entry.state)
+            seal = await self.hub.seal_cohort(cohort, self._tick_key())
+            self._publish_seal(seal)
+            chain, ack_seq = self.hub.payloads_for(cohort, ack)
+        if chain is None:
+            return [(seal, False)], (cohort.cid, ack_seq)
+        return [(s, True) for s in chain], (cohort.cid, ack_seq)
 
     async def _mutate(self, entry: SessionEntry, fn):
         """Run a state mutation under the frame lock: service renders on
@@ -563,31 +560,35 @@ class DashboardServer:
     async def _stream_admitted(
         self, request: web.Request
     ) -> web.StreamResponse:
+        """The per-client SSE loop — a pure pre-encoded buffer write.
+
+        All composing, delta-diffing, serializing, and compressing
+        happens ONCE per cohort per tick in the hub (tpudash.broadcast):
+        every subscriber of a cohort writes the exact same immutable
+        seal buffers, so per-client marginal cost is socket I/O, not
+        CPU.  Gzip subscribers get ``GZIP_HEADER`` once, then the
+        cohort's shared full-flushed deflate segments — any sequence of
+        such segments concatenates into one valid gzip stream, which is
+        what makes per-cohort (instead of per-client) compression
+        possible.
+
+        Event ids are ``<cohort>-<seq>``; EventSource echoes the last id
+        on reconnect and the cohort's retained seal window resumes the
+        exact delta chain the client missed — from this process or any
+        bus-mirroring worker (TPUDASH_WORKERS mode serves this same loop
+        from worker processes; see tpudash.broadcast.worker)."""
         sid = request.cookies.get(SESSION_COOKIE)
         headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
         }
-        # Compressed with a HAND-DRIVEN gzip stream, one Z_SYNC_FLUSH per
-        # event: aiohttp's built-in StreamResponse deflate buffers across
-        # writes (events would sit in the zlib window instead of arriving
-        # on time — verified, the stream tests stall), but flushing at
-        # event boundaries keeps delivery immediate while the shared
-        # window compresses the repetitive frame JSON ~8×.  EventSource
-        # decodes Content-Encoding transparently in every browser.
-        import zlib
-
         accepts_gzip = _accepts_gzip(request.headers.get("Accept-Encoding", ""))
         if accepts_gzip:
             headers["Content-Encoding"] = "gzip"
         resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
-        compressor = (
-            zlib.compressobj(6, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
-            if accepts_gzip
-            else None
-        )
+        bound_stream_buffers(request, self.service.cfg.sse_sndbuf)
 
         # Per-event drain: aiohttp's StreamWriter awaits a real transport
         # drain only every 64KB of cumulative writes, so a stalled
@@ -598,76 +599,72 @@ class DashboardServer:
         # the writer prepare() installed; drain() is its contract.)
         payload_writer = getattr(resp, "_payload_writer", None)
 
-        async def write_event(raw: bytes) -> None:
-            if compressor is None:
-                data = raw
-            else:
-                # the compressobj is stateful but serial — only this
-                # coroutine ever touches it — so the compression itself
-                # can run off the loop: a full 256-chip frame compresses
-                # for single-digit ms, and N streams × that per tick is
-                # real loop time.  Tiny payloads (keepalives, deltas)
-                # stay inline: the executor hop costs more than they do.
-                def _compress() -> bytes:
-                    return compressor.compress(raw) + compressor.flush(
-                        zlib.Z_SYNC_FLUSH
-                    )
+        async def write_buf(data: bytes) -> None:
+            await resp.write(data)
+            if payload_writer is not None:
+                await payload_writer.drain()
 
-                if len(raw) >= 4096:
-                    loop = asyncio.get_running_loop()
-                    data = await loop.run_in_executor(None, _compress)
-                else:
-                    data = _compress()
-            if data:
-                await resp.write(data)
-                if payload_writer is not None:
-                    await payload_writer.drain()
-        # every event carries its compose key as the SSE id, and
-        # EventSource echoes it back on reconnect — a dropped connection
-        # resumes with a delta (or keepalive) instead of a full frame
-        client_key = _id_key(request.headers.get("Last-Event-ID"))
+        ack = parse_event_id(request.headers.get("Last-Event-ID"))
         write_deadline = self.overload.write_deadline
         try:
+            if accepts_gzip:
+                await write_buf(GZIP_HEADER)
             while True:
                 # re-resolve every tick: touches last_seen so an actively
-                # streamed session is never TTL-evicted, and picks up the
-                # replacement entry if it somehow was
+                # streamed session is never TTL-evicted, picks up the
+                # replacement entry if it somehow was, and follows the
+                # session into a NEW cohort after a selection change
                 entry = self.sessions.entry(sid)
-                payload, client_key = await self._get_sse_event(
-                    entry, client_key
-                )
-                if write_deadline and write_deadline > 0:
-                    try:
-                        await asyncio.wait_for(
-                            write_event(payload), write_deadline
-                        )
-                    except asyncio.TimeoutError:
-                        # Slow-consumer eviction: the peer stopped
-                        # draining and this write sat in backpressure
-                        # past the deadline.  Drop the stream — the
-                        # session entry (and its delta caches) stays in
-                        # the store, so a reconnect with Last-Event-ID
-                        # resumes with a delta, not a full frame.
-                        self.overload.note_eviction()
-                        log.info(
-                            "evicted slow SSE consumer (write blocked "
-                            "> %gs); session %s kept for reconnect",
-                            write_deadline,
-                            "anonymous" if not sid else sid[:8],
-                        )
-                        # abort, don't just return: aiohttp's
-                        # finish_response awaits write_eof → drain,
-                        # which waits on the SAME peer's backpressure
-                        # with no timeout — without the abort the
-                        # evicted socket, its buffered events, and this
-                        # handler task would stay pinned until TCP
-                        # teardown, re-creating the leak eviction exists
-                        # to prevent
-                        if request.transport is not None:
-                            request.transport.abort()
-                        break
+                seals, ack = await self._cohort_tick(entry, ack)
+                if not seals:
+                    payloads = [KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW]
+                elif accepts_gzip:
+                    payloads = [
+                        (s.sse_delta_gz if use_delta else s.sse_full_gz)
+                        for s, use_delta in seals
+                    ]
                 else:
-                    await write_event(payload)
+                    payloads = [
+                        (s.sse_delta_raw if use_delta else s.sse_full_raw)
+                        for s, use_delta in seals
+                    ]
+                evicted = False
+                for payload in payloads:
+                    if write_deadline and write_deadline > 0:
+                        try:
+                            await asyncio.wait_for(
+                                write_buf(payload), write_deadline
+                            )
+                        except asyncio.TimeoutError:
+                            # Slow-consumer eviction: the peer stopped
+                            # draining and this write sat in backpressure
+                            # past the deadline.  Drop the stream — the
+                            # cohort's seal window is shared state, so a
+                            # reconnect with Last-Event-ID resumes with
+                            # the delta chain it missed, on ANY process.
+                            self.overload.note_eviction()
+                            log.info(
+                                "evicted slow SSE consumer (write blocked "
+                                "> %gs); session %s resumes by event id",
+                                write_deadline,
+                                "anonymous" if not sid else sid[:8],
+                            )
+                            # abort, don't just return: aiohttp's
+                            # finish_response awaits write_eof → drain,
+                            # which waits on the SAME peer's backpressure
+                            # with no timeout — without the abort the
+                            # evicted socket, its buffered events, and
+                            # this handler task would stay pinned until
+                            # TCP teardown, re-creating the leak eviction
+                            # exists to prevent
+                            if request.transport is not None:
+                                request.transport.abort()
+                            evicted = True
+                            break
+                    else:
+                        await write_buf(payload)
+                if evicted:
+                    break
                 await asyncio.sleep(max(0.25, self.service.cfg.refresh_interval))
         except (*_CLIENT_GONE, asyncio.CancelledError):
             pass  # client went away — normal termination
@@ -740,6 +737,7 @@ class DashboardServer:
         frame = await self._get_frame(
             entry=entry, deadline=request.get("tpudash_deadline")
         )
+        self._publish_binding(request.cookies.get(SESSION_COOKIE), entry)
         return _json_response(
             {"selected": list(state.selected), "frame_ok": frame["error"] is None}
         )
@@ -759,6 +757,7 @@ class DashboardServer:
         await self._get_frame(
             entry=entry, deadline=request.get("tpudash_deadline")
         )
+        self._publish_binding(request.cookies.get(SESSION_COOKIE), entry)
         return _json_response({"use_gauge": entry.state.use_gauge})
 
     async def timings(self, request: web.Request) -> web.Response:
@@ -767,6 +766,9 @@ class DashboardServer:
         summary = self.service.timer.summary()
         summary["overload"] = self.overload.snapshot()
         summary["loop_lag_ms"] = self.loop_monitor.summary()
+        summary["broadcast"] = self.hub.stats()
+        if self.bus_publisher is not None:
+            summary["broadcast"]["bus"] = self.bus_publisher.stats()
         if self.service.tsdb is not None:
             # store counters (blocks/points/bytes/disk state); stats()
             # takes the store's sync lock, so it rides the executor
@@ -1055,8 +1057,43 @@ class DashboardServer:
 
     def _invalidate_frames(self) -> None:
         """Global-state change (silences): every session's cached compose
-        is stale — bump all state versions (caller holds the lock)."""
+        is stale — bump all state versions (caller holds the lock), and
+        bump the hub epoch so every cohort re-seals on its next tick."""
         self.sessions.invalidate_all()
+        self.hub.invalidate()
+
+    def _on_cohort_evict(self, cids) -> None:
+        """Hub dropped cohorts (LRU or idle TTL): forget their publish
+        cursors — the map must not outgrow the bounded cohort universe —
+        and tell every bus mirror to drop the windows too."""
+        for cid in cids:
+            self._published_seqs.pop(cid, None)
+        pub = self.bus_publisher
+        if pub is not None:
+            pub.publish_evict(list(cids))
+
+    def _publish_seal(self, seal) -> None:
+        """Hand a newly-created seal to the frame bus (worker mode); a
+        tick that served a cached seal publishes nothing."""
+        pub = self.bus_publisher
+        if pub is None:
+            return
+        if self._published_seqs.get(seal.cid) == seal.seq:
+            return
+        self._published_seqs[seal.cid] = seal.seq
+        pub.publish_seal(seal)
+
+    def _publish_binding(self, sid: "str | None", entry: SessionEntry) -> None:
+        """After a session mutation, tell the workers which cohort the
+        session now composes into, so mid-stream selection changes take
+        effect on the next worker tick.  Cookieless viewers share the
+        default entry under the "" key — their selection changes must
+        propagate too (the worker loop reads the same "" binding)."""
+        pub = self.bus_publisher
+        if pub is None:
+            return
+        cohort = self.hub.resolve(entry.state)
+        pub.publish_binding(sid or "", cohort.cid)
 
     async def silence_alert(self, request: web.Request) -> web.Response:
         """POST {rule?, chip?, ttl_s} — acknowledge: silence matching
@@ -1384,6 +1421,53 @@ class DashboardServer:
              "source_health": health}
         )
 
+    async def workers_api(self, request: web.Request) -> web.Response:
+        """The broadcast plane's worker tier, observable: per-worker pids,
+        bus backlog, and cohort-hub stats.  Single-process mode reports
+        ``mode: "single"`` with just the hub."""
+        import os
+
+        doc = {
+            "mode": "single",
+            "compose_pid": os.getpid(),
+            "broadcast": self.hub.stats(),
+        }
+        if self.workers_provider is not None:
+            doc.update(self.workers_provider())
+        return _json_response(doc)
+
+    async def internal_cohort(self, request: web.Request) -> web.Response:
+        """Worker-tier internal route (reachable only over the compose
+        process's private unix socket): resolve a session id to its
+        cohort, sealing the cohort's current frame so the worker's
+        mirror has bytes to serve by the client's first event.  404 in
+        single-process mode — the route has no business being public."""
+        if self.bus_publisher is None:
+            raise web.HTTPNotFound(text="no worker tier attached")
+        sid = request.query.get("sid", "")
+        entry = self.sessions.entry(sid or None)
+        async with self._lock:
+            await self._refresh_locked(False)
+            cohort = self.hub.resolve(entry.state)
+            seal = await self.hub.seal_cohort(cohort, self._tick_key())
+            self._publish_seal(seal)
+        self._publish_binding(sid, entry)
+        return _json_response(
+            {"sid": sid, "cid": cohort.cid, "seq": seal.seq}
+        )
+
+    def _sheddable_frame(self) -> "tuple[dict | None, tuple | None]":
+        """The newest frame the shed path may degrade to, with its cache
+        key.  Prefers the polling transport's last compose; a pure-SSE
+        deployment (nothing ever hit ``/api/frame``) falls back to the
+        newest cohort seal — keyed on (data_version, hub epoch), a
+        2-part key distinguishable from the compose path's 3-part one,
+        so the cached stale body still refreshes as data advances."""
+        frame, key = self._last_frame, self._last_frame_key
+        if frame is None and self.hub.last_frame is not None:
+            return self.hub.last_frame, (self._data_version, self.hub.epoch)
+        return frame, key
+
     async def _shed_response(
         self, request: web.Request, reason: str
     ) -> web.Response:
@@ -1401,7 +1485,7 @@ class DashboardServer:
         bytes with zero awaits."""
         headers = {"Retry-After": self.overload.retry_after_header()}
         if request.method == "GET" and request.path == "/api/frame":
-            frame, key = self._last_frame, self._last_frame_key
+            frame, key = self._sheddable_frame()
             if frame is not None:
                 # serialized (and gzipped) ONCE per published frame and
                 # revalidated by ETag: a polling swarm being shed must
@@ -1427,7 +1511,7 @@ class DashboardServer:
                         # overwrite a fresh cache and the next shed would
                         # rebuild it right back (ping-pong under the very
                         # swarm the single-flight gate exists for)
-                        frame, key = self._last_frame, self._last_frame_key
+                        frame, key = self._sheddable_frame()
                         if (
                             self._stale_body is None
                             or self._stale_body[0] != key
@@ -1469,7 +1553,15 @@ class DashboardServer:
         from the refresh watchdog, so a request that queues past its
         budget stops consuming refresh/compose time downstream."""
         path = request.path
-        if path in _NEVER_SHED or path == "/" or path == PLOTLY_LOCAL_URL:
+        if (
+            path in _NEVER_SHED
+            or path == "/"
+            or path == PLOTLY_LOCAL_URL
+            or path.startswith("/internal/")
+        ):
+            # /internal/: worker-tier calls over the private unix socket —
+            # the worker already admitted the client under ITS stream cap;
+            # shedding here would double-count one client against two gates
             return await handler(request)
         guard = self.overload
         is_stream = path == "/api/stream"
@@ -1517,6 +1609,12 @@ class DashboardServer:
         a header either, and the asset is a vendor library, not data."""
         token = self.service.cfg.auth_token
         if not token or request.path in ("/", "/healthz", PLOTLY_LOCAL_URL):
+            return await handler(request)
+        if request.path.startswith("/internal/") and self.bus_publisher is not None:
+            # worker-tier internal calls arrive over the compose process's
+            # private unix socket (never bound on TCP in worker mode) —
+            # the WORKER enforces the bearer token for its local routes,
+            # and proxied client requests still carry (and need) theirs
             return await handler(request)
         header = request.headers.get("Authorization", "")
         supplied = header[7:] if header.startswith("Bearer ") else None
@@ -1579,6 +1677,8 @@ class DashboardServer:
         app.router.add_post("/api/alerts/unsilence", self.unsilence_alert)
         app.router.add_get("/api/alerts/silences", self.list_silences)
         app.router.add_get("/api/stragglers", self.stragglers)
+        app.router.add_get("/api/workers", self.workers_api)
+        app.router.add_get("/internal/cohort", self.internal_cohort)
         app.router.add_get("/api/replay", self.replay_status)
         app.router.add_post("/api/replay", self.replay_seek)
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
@@ -1626,4 +1726,13 @@ def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry
     # the installed `tpudash` console script, not just `python -m`
     maybe_initialize()
     cfg = cfg or load_config()
+    if cfg.workers > 0:
+        # TPUDASH_WORKERS mode: one compose process publishing sealed
+        # cohort buffers on a frame bus + N SO_REUSEPORT worker processes
+        # serving clients from bus mirrors.  Preflights fail fast (no
+        # silent single-process fallback).
+        from tpudash.broadcast.supervisor import run_supervised
+
+        run_supervised(cfg)
+        return
     web.run_app(make_app(cfg), host=cfg.host, port=cfg.port)

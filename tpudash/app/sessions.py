@@ -13,12 +13,15 @@ old single-state behavior, and only the default state participates in
 like the reference's (a browser restart resets them, SURVEY.md §5
 checkpoint/resume note).
 
-Each entry also carries the per-session composed-frame and SSE-payload
-caches keyed by ``(data_version, state_version)``: the expensive scrape/
-normalize runs once per refresh interval for ALL sessions (the shared half
-lives in ``DashboardService.refresh_data``), while the cheap per-selection
-compose is cached per session so many tabs of one browser still cost one
-render.
+Each entry also carries the per-session composed-frame cache keyed by
+``(data_version, state_version)`` for the POLLING transport: the expensive
+scrape/normalize runs once per refresh interval for ALL sessions (the
+shared half lives in ``DashboardService.refresh_data``), while the cheap
+per-selection compose is cached per session so many tabs of one browser
+still cost one render.  The SSE transport no longer caches anything here:
+sessions sharing a (selection, style) state compose through one *cohort*
+(tpudash.broadcast.cohort), whose sealed buffers are shared by every
+subscriber — and by every worker process in ``TPUDASH_WORKERS`` mode.
 """
 
 from __future__ import annotations
@@ -30,24 +33,15 @@ from tpudash.app.state import SelectionState, _sort_key
 
 
 class SessionEntry:
-    """One viewer session: its selection state plus render caches.
-
-    A streaming session retains the current AND previous composed frames
-    (the frame-diff transport, tpudash.app.delta, patches one into the
-    other) plus the serialized full/delta payloads for the current step —
-    bounded per session, swept by the store's TTL/LRU eviction."""
+    """One viewer session: its selection state plus the polling
+    transport's composed-frame cache (the SSE transport serves shared
+    cohort seals instead — nothing per-session to retain)."""
 
     __slots__ = (
         "state",
         "state_version",
         "frame",
         "frame_key",
-        "prev_frame",
-        "prev_frame_key",
-        "sse_full",
-        "sse_full_key",
-        "sse_delta",
-        "sse_delta_keys",
         "last_seen",
     )
 
@@ -58,12 +52,6 @@ class SessionEntry:
         self.state_version = 0
         self.frame: "dict | None" = None
         self.frame_key: "tuple | None" = None
-        self.prev_frame: "dict | None" = None
-        self.prev_frame_key: "tuple | None" = None
-        self.sse_full: "bytes | None" = None
-        self.sse_full_key: "tuple | None" = None
-        self.sse_delta: "bytes | None" = None
-        self.sse_delta_keys: "tuple | None" = None  # (from_key, to_key)
         self.last_seen = 0.0
 
 

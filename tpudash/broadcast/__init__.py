@@ -1,0 +1,39 @@
+"""Broadcast plane — serve many viewers from one compose (ROADMAP #1).
+
+BENCH_r05 named the serving wall: every SSE subscriber paid its own
+compose + delta + gzip (~1.3 ms CPU/tick each), capping one event loop in
+the low hundreds of viewers.  This package is the fix, in two layers:
+
+**Layer 1 — cohort broadcast** (:mod:`tpudash.broadcast.cohort`).  Live
+viewer sessions are grouped by the *content* of their UI state —
+(selection, style, init) — into cohorts.  Per data tick each cohort
+composes, delta-encodes, serializes, and compresses **once** into an
+immutable :class:`~tpudash.broadcast.cohort.Seal`; every subscriber's SSE
+loop is then a pure pre-encoded buffer write under the PR-3 write-deadline
+/ slow-consumer-eviction machinery.  A bounded per-cohort window of
+recent seals makes ``Last-Event-ID`` reconnect delta-preserving — against
+*any* process that holds the window, not just the one that composed it.
+
+**Layer 2 — fan-out worker tier** (:mod:`tpudash.broadcast.bus`,
+:mod:`tpudash.broadcast.worker`, :mod:`tpudash.broadcast.supervisor`).
+With ``TPUDASH_WORKERS=N`` the single scraping/compose process publishes
+sealed cohort buffers onto a local frame bus (Unix-socket, sequence
+numbers, bounded per-worker backlog) and N stateless ``SO_REUSEPORT``
+worker processes accept SSE / ``/api/frame`` clients and serve purely
+from their bus mirror — client capacity scales with cores instead of one
+event loop.  Workers proxy every other route to the compose process over
+the same Unix socket, so the public port keeps the full API.
+
+The cohort split is deliberately transport-agnostic: the sealed buffers
+are exactly what a federation tier (ROADMAP #2) or a binary wire format
+(ROADMAP #3) would ship, which is why this lands as one subsystem.
+"""
+
+from tpudash.broadcast.cohort import (
+    CohortHub,
+    Seal,
+    cohort_key,
+    parse_event_id,
+)
+
+__all__ = ["CohortHub", "Seal", "cohort_key", "parse_event_id"]

@@ -1,0 +1,516 @@
+"""Layer 2 transport: the local frame bus.
+
+One compose process (the only process that scrapes, normalizes, and
+seals cohorts) publishes immutable :class:`~tpudash.broadcast.cohort.Seal`
+buffers over a unix-domain socket to N worker processes, each of which
+keeps a :class:`BusMirror` — per-cohort seal windows plus the live
+session→cohort binding map — and serves SSE / ``/api/frame`` clients
+purely from it.
+
+Wire format (both directions): ``<u32 LE total-length>`` then a one-line
+compact-JSON header terminated by ``\\n``, then the header-declared
+binary blobs concatenated.  Every publisher→worker message carries a
+per-connection sequence number ``n`` that must increase by exactly 1; a
+gap means bytes were lost or reordered and the mirror drops the
+connection and re-snapshots — corruption is a reconnect, never a
+silently wrong frame.
+
+Backlog bound: the publisher tracks a bounded per-worker queue
+(``Config.broadcast_backlog`` messages).  A worker that stops draining —
+wedged process, livelocked loop — is disconnected once its queue fills;
+on reconnect it receives a fresh snapshot (hello + every retained seal +
+the binding map), so falling behind costs a worker one snapshot, never
+publisher memory.
+
+Messages
+--------
+publisher → worker:
+  ``hello``    {proto, pid, window}  — mirror resets all state
+  ``seal``     {cid, seq, tick, lens[6]} + blobs — one cohort tick
+  ``binding``  {sid, cid}            — a session moved cohorts
+  ``bindings`` {map}                 — full binding snapshot
+  ``evict``    {cids}                — cohorts dropped (idle/LRU)
+worker → publisher:
+  ``hello``    {pid, index}
+  ``active``   {cids}                — cohorts with live subscribers
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+import time
+
+from tpudash.broadcast.cohort import Seal, SealWindow
+
+log = logging.getLogger(__name__)
+
+#: bump on any incompatible wire change — a version-skewed worker must
+#: fail its handshake loudly, not misparse seals quietly
+PROTO = 1
+
+#: hard sanity bound on one message (a 4096-chip full frame gzips well
+#: under this; anything larger is a corrupt length prefix)
+MAX_MESSAGE = 256 * 1024 * 1024
+
+#: Seal blob order on the wire (None encodes as length -1)
+_SEAL_BLOBS = (
+    "sse_full_raw",
+    "sse_full_gz",
+    "sse_delta_raw",
+    "sse_delta_gz",
+    "frame_raw",
+    "frame_gz",
+)
+
+
+class BusProtocolError(Exception):
+    """Framing/sequencing violation — the connection must be dropped."""
+
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def encode_message(header: dict, blobs: "tuple[bytes, ...]" = ()) -> bytes:
+    body = _dumps(header) + b"\n" + b"".join(blobs)
+    return struct.pack("<I", len(body)) + body
+
+
+def encode_seal(seal: Seal, n: int) -> bytes:
+    blobs = []
+    lens = []
+    for name in _SEAL_BLOBS:
+        blob = getattr(seal, name)
+        if blob is None:
+            lens.append(-1)
+        else:
+            lens.append(len(blob))
+            blobs.append(blob)
+    header = {
+        "t": "seal",
+        "n": n,
+        "cid": seal.cid,
+        "seq": seal.seq,
+        "tick": list(seal.tick_key),
+        "lens": lens,
+    }
+    return encode_message(header, tuple(blobs))
+
+
+def decode_seal(header: dict, body: bytes) -> Seal:
+    lens = header["lens"]
+    blobs: list = []
+    off = 0
+    for ln in lens:
+        if ln < 0:
+            blobs.append(None)
+            continue
+        blobs.append(body[off : off + ln])
+        off += ln
+    if off != len(body):
+        raise BusProtocolError(
+            f"seal blob lengths {lens} disagree with body size {len(body)}"
+        )
+    return Seal(
+        int(header["cid"]),
+        int(header["seq"]),
+        tuple(header["tick"]),
+        *blobs,
+    )
+
+
+async def read_message(reader: asyncio.StreamReader) -> "tuple[dict, bytes]":
+    """(header, remaining body bytes) for one framed message; raises
+    IncompleteReadError on clean EOF, BusProtocolError on garbage."""
+    prefix = await reader.readexactly(4)
+    (length,) = struct.unpack("<I", prefix)
+    if not 0 < length <= MAX_MESSAGE:
+        raise BusProtocolError(f"message length {length} out of bounds")
+    body = await reader.readexactly(length)
+    nl = body.find(b"\n")
+    if nl < 0:
+        raise BusProtocolError("message missing header line")
+    try:
+        header = json.loads(body[:nl])
+    except json.JSONDecodeError as e:
+        raise BusProtocolError(f"bad header JSON: {e}") from e
+    if not isinstance(header, dict) or "t" not in header:
+        raise BusProtocolError("header is not a typed object")
+    return header, body[nl + 1 :]
+
+
+class _WorkerConn:
+    """Publisher-side state for one connected worker."""
+
+    def __init__(self, writer: asyncio.StreamWriter, clock=time.monotonic):
+        self.writer = writer
+        self.queue: "asyncio.Queue[bytes | None]" = asyncio.Queue()
+        self.pid: "int | None" = None
+        self.index: "int | None" = None
+        self.n = 0  # per-connection message sequence
+        self.sent = 0
+        self.connected_at = clock()
+        self.closing = False
+
+    def next_n(self) -> int:
+        self.n += 1
+        return self.n
+
+
+class BusPublisher:
+    """Compose-process side: accepts worker connections, snapshots them,
+    and fans newly-sealed buffers out under a per-worker backlog bound.
+
+    Event-loop affinity: every method is called on the compose process's
+    event loop (the server publishes from handlers/ticker, readers are
+    loop tasks) — no locking.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        hub,
+        backlog: int = 256,
+        on_active=None,
+        clock=time.monotonic,
+    ):
+        self.path = path
+        self.hub = hub
+        self.backlog = max(8, int(backlog))
+        #: callback(cids) — worker liveness pings keep cohorts warm
+        self.on_active = on_active
+        self._clock = clock
+        self._server: "asyncio.AbstractServer | None" = None
+        self._conns: "list[_WorkerConn]" = []
+        #: sid → cid, the compose process's authoritative copy of the
+        #: session→cohort map (snapshots seed reconnecting mirrors)
+        self.bindings: "dict[str, int]" = {}
+        self._tasks: "set[asyncio.Task]" = set()
+        self.counters = {
+            "seals_published": 0,
+            "bindings_published": 0,
+            "worker_connects": 0,
+            "worker_overflows": 0,
+            "worker_disconnects": 0,
+        }
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._on_connect, path=self.path
+        )
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns):
+            self._drop(conn)
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    # -- connection lifecycle ------------------------------------------------
+    def _track(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _on_connect(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = _WorkerConn(writer, self._clock)
+        self._conns.append(conn)
+        self.counters["worker_connects"] += 1
+        # snapshot FIRST into the queue, then register for live publishes:
+        # the mirror dedups on (cid, seq), so a seal published while the
+        # snapshot drains is applied at most once
+        conn.queue.put_nowait(
+            encode_message(
+                {
+                    "t": "hello",
+                    "n": conn.next_n(),
+                    "proto": PROTO,
+                    "window": self.hub.window,
+                }
+            )
+        )
+        for cohort in self.hub.cohorts():
+            for seal in cohort.window.seals:
+                conn.queue.put_nowait(encode_seal(seal, conn.next_n()))
+        if self.bindings:
+            conn.queue.put_nowait(
+                encode_message(
+                    {"t": "bindings", "n": conn.next_n(), "map": self.bindings}
+                )
+            )
+        self._track(self._drain(conn))
+        self._track(self._read(conn, reader))
+
+    async def _drain(self, conn: _WorkerConn) -> None:
+        try:
+            while True:
+                buf = await conn.queue.get()
+                if buf is None:
+                    break
+                conn.writer.write(buf)
+                await conn.writer.drain()
+                conn.sent += 1
+        except (OSError, asyncio.CancelledError):
+            pass
+        finally:
+            self._drop(conn)
+
+    async def _read(self, conn: _WorkerConn, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                header, _body = await read_message(reader)
+                kind = header.get("t")
+                if kind == "hello":
+                    conn.pid = header.get("pid")
+                    conn.index = header.get("index")
+                elif kind == "active":
+                    cids = header.get("cids") or []
+                    if self.on_active is not None:
+                        self.on_active(cids)
+        except (
+            OSError,
+            asyncio.IncompleteReadError,
+            asyncio.CancelledError,
+            BusProtocolError,
+        ):
+            pass
+        finally:
+            self._drop(conn)
+
+    def _drop(self, conn: _WorkerConn) -> None:
+        if conn.closing:
+            return
+        conn.closing = True
+        if conn in self._conns:
+            self._conns.remove(conn)
+            self.counters["worker_disconnects"] += 1
+        conn.queue.put_nowait(None)  # unblock the drain task
+        transport = conn.writer.transport
+        if transport is not None:
+            transport.abort()
+
+    # -- publishing ----------------------------------------------------------
+    def _offer(self, conn: _WorkerConn, encode) -> None:
+        if conn.queue.qsize() >= self.backlog:
+            # the worker stopped draining: cut it loose — it reconnects
+            # and re-snapshots, instead of growing this queue forever
+            self.counters["worker_overflows"] += 1
+            log.warning(
+                "bus worker pid=%s fell %d messages behind; disconnecting",
+                conn.pid,
+                conn.queue.qsize(),
+            )
+            self._drop(conn)
+            return
+        conn.queue.put_nowait(encode(conn.next_n()))
+
+    def publish_seal(self, seal: Seal) -> None:
+        self.counters["seals_published"] += 1
+        for conn in list(self._conns):
+            self._offer(conn, lambda n: encode_seal(seal, n))
+
+    def publish_binding(self, sid: str, cid: int) -> None:
+        self.counters["bindings_published"] += 1
+        self.bindings[sid] = cid
+        # bounded: bindings mirror the session store's own LRU universe
+        if len(self.bindings) > 8192:
+            self.bindings.pop(next(iter(self.bindings)))
+        for conn in list(self._conns):
+            self._offer(
+                conn,
+                lambda n: encode_message(
+                    {"t": "binding", "n": n, "sid": sid, "cid": cid}
+                ),
+            )
+
+    def publish_evict(self, cids: "list[int]") -> None:
+        if not cids:
+            return
+        for conn in list(self._conns):
+            self._offer(
+                conn,
+                lambda n: encode_message({"t": "evict", "n": n, "cids": cids}),
+            )
+
+    # -- observability -------------------------------------------------------
+    def workers(self) -> "list[dict]":
+        now = self._clock()
+        return [
+            {
+                "pid": c.pid,
+                "index": c.index,
+                "queued": c.queue.qsize(),
+                "sent": c.sent,
+                "connected_s": round(now - c.connected_at, 1),
+            }
+            for c in self._conns
+        ]
+
+    def stats(self) -> dict:
+        return {
+            "path": self.path,
+            "backlog": self.backlog,
+            "workers": self.workers(),
+            "counters": dict(self.counters),
+        }
+
+
+class BusMirror:
+    """Worker-process side: a live replica of the publisher's cohort seal
+    windows and session bindings, maintained by a reconnect loop.
+
+    The serving half (worker SSE loops, ``/api/frame``) reads `windows`,
+    `bindings`, and `wait_update`; `retain`/`release` keep the refcounts
+    behind the periodic ``active`` ping that stops the publisher from
+    idle-evicting cohorts people are actually watching.
+    """
+
+    def __init__(self, path: str, pid: int = 0, index: int = 0):
+        self.path = path
+        self.pid = pid
+        self.index = index
+        self.window_limit = 8
+        self.windows: "dict[int, SealWindow]" = {}
+        self.bindings: "dict[str, int]" = {}
+        self.connected = False
+        self._refs: "dict[int, int]" = {}
+        self._update = asyncio.Event()
+        self.counters = {
+            "seals_applied": 0,
+            "reconnects": 0,
+            "protocol_errors": 0,
+        }
+        self._writer: "asyncio.StreamWriter | None" = None
+
+    # -- subscriber accounting (worker handlers) -----------------------------
+    def retain(self, cid: int) -> None:
+        self._refs[cid] = self._refs.get(cid, 0) + 1
+
+    def release(self, cid: int) -> None:
+        n = self._refs.get(cid, 0) - 1
+        if n <= 0:
+            self._refs.pop(cid, None)
+        else:
+            self._refs[cid] = n
+
+    def active_cids(self) -> "list[int]":
+        return list(self._refs)
+
+    def window(self, cid: int) -> "SealWindow | None":
+        return self.windows.get(cid)
+
+    async def wait_update(self, timeout: float) -> bool:
+        """True when the mirror applied anything new within ``timeout``
+        seconds (SSE loops wake on fresh seals instead of polling)."""
+        try:
+            await asyncio.wait_for(self._update.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def _notify(self) -> None:
+        self._update.set()
+        self._update = asyncio.Event()
+
+    # -- replication loop ----------------------------------------------------
+    async def run(self, stop: "asyncio.Event | None" = None) -> None:
+        """Reconnect-forever replication; returns when ``stop`` is set."""
+        while stop is None or not stop.is_set():
+            try:
+                await self._session(stop)
+            except (OSError, asyncio.IncompleteReadError):
+                pass
+            except BusProtocolError as e:
+                self.counters["protocol_errors"] += 1
+                log.warning("bus protocol error, resyncing: %s", e)
+            self.connected = False
+            self.counters["reconnects"] += 1
+            await asyncio.sleep(0.5)
+
+    async def _session(self, stop: "asyncio.Event | None") -> None:
+        reader, writer = await asyncio.open_unix_connection(self.path)
+        self._writer = writer
+        try:
+            writer.write(
+                encode_message(
+                    {"t": "hello", "pid": self.pid, "index": self.index}
+                )
+            )
+            await writer.drain()
+            expect_n = 0
+            while stop is None or not stop.is_set():
+                header, body = await read_message(reader)
+                n = int(header.get("n", 0))
+                expect_n += 1
+                if n != expect_n:
+                    raise BusProtocolError(
+                        f"sequence gap: expected {expect_n}, got {n}"
+                    )
+                self._apply(header, body)
+        finally:
+            self._writer = None
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
+
+    def _apply(self, header: dict, body: bytes) -> None:
+        kind = header["t"]
+        if kind == "hello":
+            if header.get("proto") != PROTO:
+                raise BusProtocolError(
+                    f"publisher speaks proto {header.get('proto')}, "
+                    f"this worker speaks {PROTO}"
+                )
+            # a (re)connected publisher defines the universe afresh
+            self.window_limit = int(header.get("window", 8))
+            self.windows.clear()
+            self.bindings.clear()
+            self.connected = True
+        elif kind == "seal":
+            seal = decode_seal(header, body)
+            win = self.windows.get(seal.cid)
+            if win is None:
+                win = self.windows[seal.cid] = SealWindow(self.window_limit)
+            latest = win.latest()
+            if latest is None or seal.seq > latest.seq:
+                win.append(seal)
+                self.counters["seals_applied"] += 1
+        elif kind == "binding":
+            self.bindings[str(header["sid"])] = int(header["cid"])
+        elif kind == "bindings":
+            self.bindings.update(
+                {str(k): int(v) for k, v in (header.get("map") or {}).items()}
+            )
+        elif kind == "evict":
+            for cid in header.get("cids") or []:
+                self.windows.pop(int(cid), None)
+        self._notify()
+
+    async def send_active(self) -> None:
+        """Push the current active-cohort set to the publisher (keeps
+        watched cohorts out of idle eviction)."""
+        writer = self._writer
+        if writer is None:
+            return
+        writer.write(
+            encode_message({"t": "active", "cids": self.active_cids()})
+        )
+        await writer.drain()
+
+    def stats(self) -> dict:
+        return {
+            "connected": self.connected,
+            "cohorts": len(self.windows),
+            "bindings": len(self.bindings),
+            "active": len(self._refs),
+            "counters": dict(self.counters),
+        }

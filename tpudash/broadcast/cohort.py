@@ -1,0 +1,438 @@
+"""Layer 1 of the broadcast plane: cohort compose-once fan-out.
+
+A *cohort* is the set of viewer sessions whose composed frame is
+byte-identical: same selection, same gauge/bar style, same init state.
+The hub composes each cohort's frame ONCE per data tick and seals the
+result into an immutable :class:`Seal` carrying every encoding a
+subscriber could need — the SSE event bytes (full and value-only delta,
+raw and gzip) plus the bare frame JSON the ``/api/frame`` route serves —
+so the per-client hot path never serializes, diffs, or compresses
+anything.
+
+Compression contract (the part that makes per-cohort gzip possible):
+every payload is deflated from a *fresh* dictionary and terminated with
+``Z_FULL_FLUSH``.  A full flush ends on a byte boundary with no history
+carried forward, so any sequence of such segments concatenates into one
+valid raw-deflate stream.  A subscriber's response is then
+``GZIP_HEADER + segment + segment + …`` — a well-formed (never-finalized)
+gzip stream that browsers, aiohttp, and a single ``zlib.decompressobj``
+all decode incrementally — while the segments themselves are shared by
+every subscriber of the cohort and by every worker process on the bus.
+
+Event ids are ``"<cohort-id>-<seq>"``.  The per-cohort window retains the
+last ``Config.broadcast_window`` seals, so a reconnecting client
+(``Last-Event-ID``) whose acked seq is still in the window resumes with
+the exact delta chain it missed — from this process or any bus mirror.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import OrderedDict
+
+from tpudash.app.delta import frame_delta
+from tpudash.app.state import SelectionState
+
+#: static gzip member header (deflate method, no name/mtime, OS=unix) —
+#: written once per subscriber connection ahead of the shared segments
+GZIP_HEADER = b"\x1f\x8b\x08\x00\x00\x00\x00\x00\x00\x03"
+
+
+def compress_segment(raw: bytes, level: int = 6) -> bytes:
+    """Deflate ``raw`` from a fresh dictionary, full-flushed: the returned
+    segment is decodable at any position of a subscriber's stream and
+    shared verbatim across subscribers (see module doc)."""
+    c = zlib.compressobj(level, zlib.DEFLATED, -zlib.MAX_WBITS)
+    return c.compress(raw) + c.flush(zlib.Z_FULL_FLUSH)
+
+
+#: the shared keepalive tick (SSE comment, ignored by EventSource) —
+#: precompressed once for every gzip subscriber of every cohort
+KEEPALIVE_RAW = b": keepalive\n\n"
+KEEPALIVE_GZ = compress_segment(KEEPALIVE_RAW)
+
+
+def cohort_key(state: SelectionState) -> tuple:
+    """Content key: sessions with equal keys compose identical frames.
+    ``_initialized`` participates because an uninitialized selection
+    composes with the first-chip default applied fresh."""
+    return (
+        tuple(state.selected),
+        bool(state.use_gauge),
+        bool(getattr(state, "_initialized", True)),
+    )
+
+
+def cohort_id(key: tuple) -> int:
+    """Stable numeric id for a cohort key (crc32 of its repr) — carried
+    inside SSE event ids, so it must be compact and digit-only."""
+    return zlib.crc32(repr(key).encode())
+
+
+def parse_event_id(raw: "str | None") -> "tuple[int, int] | None":
+    """``Last-Event-ID`` → (cohort_id, seq), or None when absent/garbled/
+    legacy-format (the stream then starts with a full frame)."""
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) != 2:
+        return None
+    try:
+        return (int(parts[0]), int(parts[1]))
+    except ValueError:
+        return None
+
+
+class Seal:
+    """One cohort tick, sealed: immutable pre-encoded buffers.  ``delta_*``
+    are None when the step from the previous seal was structural (the
+    subscriber must take the full frame instead)."""
+
+    __slots__ = (
+        "cid",
+        "seq",
+        "event_id",
+        "tick_key",
+        "etag",
+        "sse_full_raw",
+        "sse_full_gz",
+        "sse_delta_raw",
+        "sse_delta_gz",
+        "frame_raw",
+        "frame_gz",
+    )
+
+    def __init__(
+        self,
+        cid: int,
+        seq: int,
+        tick_key: tuple,
+        sse_full_raw: bytes,
+        sse_full_gz: bytes,
+        sse_delta_raw: "bytes | None",
+        sse_delta_gz: "bytes | None",
+        frame_raw: bytes,
+        frame_gz: bytes,
+    ):
+        self.cid = cid
+        self.seq = seq
+        self.event_id = f"{cid}-{seq}"
+        self.tick_key = tick_key
+        self.etag = f'"{cid}-{seq}"'
+        self.sse_full_raw = sse_full_raw
+        self.sse_full_gz = sse_full_gz
+        self.sse_delta_raw = sse_delta_raw
+        self.sse_delta_gz = sse_delta_gz
+        self.frame_raw = frame_raw
+        self.frame_gz = frame_gz
+
+
+class SealWindow:
+    """Bounded deque of recent seals + the resume protocol both the hub
+    and the worker-side bus mirror share."""
+
+    def __init__(self, limit: int):
+        self.limit = max(1, int(limit))
+        self.seals: "list[Seal]" = []
+
+    def append(self, seal: Seal) -> None:
+        self.seals.append(seal)
+        if len(self.seals) > self.limit:
+            del self.seals[: len(self.seals) - self.limit]
+
+    def latest(self) -> "Seal | None":
+        return self.seals[-1] if self.seals else None
+
+    def since(self, ack_seq: "int | None") -> "list[Seal] | None":
+        """Seals a subscriber that acked ``ack_seq`` still needs, on its
+        delta path: ``[]`` when it already holds the latest (keepalive),
+        the contiguous delta chain when the window covers the gap, or
+        None when only a full frame is faithful (ack too old, unknown,
+        or a structural step broke the chain)."""
+        latest = self.latest()
+        if latest is None or ack_seq is None:
+            return None
+        if ack_seq == latest.seq:
+            return []
+        if ack_seq > latest.seq:
+            return None  # a different epoch (publisher restart)
+        chain = [s for s in self.seals if s.seq > ack_seq]
+        if not chain or chain[0].seq != ack_seq + 1:
+            return None  # gap fell out of the window
+        if any(s.sse_delta_raw is None for s in chain):
+            return None  # structural step inside the gap
+        return chain
+
+
+class Cohort:
+    """One cohort's live state on the composing side."""
+
+    __slots__ = (
+        "key",
+        "cid",
+        "seq",
+        "tick_key",
+        "window",
+        "prev_frame",
+        "last_used",
+    )
+
+    def __init__(self, key: tuple, window: int):
+        self.key = key
+        self.cid = cohort_id(key)
+        self.seq = 0
+        #: (data_version, stalled) the latest seal composed from
+        self.tick_key: "tuple | None" = None
+        self.window = SealWindow(window)
+        #: the composed frame behind the latest seal (delta input)
+        self.prev_frame: "dict | None" = None
+        self.last_used = 0.0
+
+
+class CohortHub:
+    """Compose-once fan-out hub for one composing process.
+
+    Owned by the DashboardServer; every mutation happens on the event
+    loop under the server's frame lock, so no locking of its own.  The
+    actual compose/encode work runs in the executor (one hop per cohort
+    per tick, shared by all subscribers).
+    """
+
+    def __init__(
+        self,
+        compose,
+        dumps,
+        window: int = 8,
+        max_cohorts: int = 64,
+        clock=time.monotonic,
+        on_evict=None,
+    ):
+        self._compose = compose  # SelectionState -> frame dict (blocking)
+        self._dumps = dumps
+        self.window = max(1, int(window))
+        self.max_cohorts = max(1, int(max_cohorts))
+        self._clock = clock
+        #: callback(list[cid]) fired whenever cohorts are dropped (LRU in
+        #: :meth:`resolve` or TTL in :meth:`evict_idle`) — worker mode
+        #: forwards it to the bus so every mirror drops the window too
+        self.on_evict = on_evict
+        self._cohorts: "OrderedDict[tuple, Cohort]" = OrderedDict()
+        #: cid → last sealed seq of a cohort that was evicted: a
+        #: recreated cohort (same content, same crc32 cid) CONTINUES the
+        #: numbering instead of restarting at 1 — mirrors hold a
+        #: monotonic-seq window per cid, and a stale reconnect ack must
+        #: hit a window gap (→ full frame), never a wrong-base delta
+        #: chain.  Bounded FIFO: one int per distinct selection ever
+        #: evicted, oldest dropped past the cap.
+        self._retired_seqs: "OrderedDict[int, int]" = OrderedDict()
+        #: global-state invalidation epoch: bumped when something OUTSIDE
+        #: the (data_version, cohort content) key changes every composed
+        #: frame (alert silences).  Callers fold it into tick_key so the
+        #: next tick re-seals without waiting for a data refresh.
+        self.epoch = 0
+        #: the newest frame sealed for ANY cohort (the shed path's
+        #: degraded /api/frame body rides it)
+        self.last_frame: "dict | None" = None
+        self.counters = {
+            "cohorts_created": 0,
+            "cohorts_evicted": 0,
+            "seals": 0,
+            "composes": 0,
+        }
+        #: executor-side time actually spent composing/encoding — the
+        #: bench's per-cohort cost signal, independent of fan-out width
+        self.compose_ms_total = 0.0
+        self.encode_ms_total = 0.0
+
+    def __len__(self) -> int:
+        return len(self._cohorts)
+
+    def invalidate(self) -> None:
+        """Global state changed (silences): every cohort's cached seal is
+        stale — the next tick_key differs, so each cohort re-seals."""
+        self.epoch += 1
+
+    def evict_idle(self, ttl: float) -> "list[int]":
+        """Drop cohorts not resolved/touched within ``ttl`` seconds
+        (worker mode's ticker composes every live cohort per tick, so
+        abandoned cohorts must age out).  Returns evicted cohort ids."""
+        if ttl <= 0:
+            return []
+        now = self._clock()
+        dead = [
+            key
+            for key, c in self._cohorts.items()
+            if now - c.last_used >= ttl
+        ]
+        evicted = []
+        for key in dead:
+            evicted.append(self._retire(self._cohorts.pop(key)))
+        if evicted and self.on_evict is not None:
+            self.on_evict(evicted)
+        return evicted
+
+    def _retire(self, cohort: Cohort) -> int:
+        """Bookkeeping for a dropped cohort: remember its last seq so a
+        recreation continues the numbering (see ``_retired_seqs``)."""
+        self.counters["cohorts_evicted"] += 1
+        if cohort.seq:
+            self._retired_seqs[cohort.cid] = cohort.seq
+            while len(self._retired_seqs) > 4096:
+                self._retired_seqs.popitem(last=False)
+        return cohort.cid
+
+    def touch(self, cids) -> None:
+        """Refresh ``last_used`` for the given cohort ids (worker "these
+        cohorts have live subscribers" pings ride the bus)."""
+        now = self._clock()
+        wanted = set(cids)
+        for c in self._cohorts.values():
+            if c.cid in wanted:
+                c.last_used = now
+
+    def resolve(self, state: SelectionState) -> Cohort:
+        """Cohort for a session's current UI state (content-keyed:
+        sessions sharing a selection share the cohort).  Creating past
+        ``max_cohorts`` evicts the least-recently-resolved cohort — its
+        subscribers transparently fall back to a full frame on their
+        next tick, so a selection-diverse swarm degrades to bounded
+        memory instead of unbounded cohort state."""
+        key = cohort_key(state)
+        cohort = self._cohorts.get(key)
+        if cohort is None:
+            lru_evicted = []
+            while len(self._cohorts) >= self.max_cohorts:
+                _, dropped = self._cohorts.popitem(last=False)
+                lru_evicted.append(self._retire(dropped))
+            if lru_evicted and self.on_evict is not None:
+                self.on_evict(lru_evicted)
+            cohort = self._cohorts[key] = Cohort(key, self.window)
+            cohort.seq = self._retired_seqs.pop(cohort.cid, 0)
+            self.counters["cohorts_created"] += 1
+        else:
+            self._cohorts.move_to_end(key)
+        cohort.last_used = self._clock()
+        return cohort
+
+    def get(self, key: tuple) -> "Cohort | None":
+        return self._cohorts.get(key)
+
+    def cohorts(self) -> "list[Cohort]":
+        return list(self._cohorts.values())
+
+    def _synth_state(self, key: tuple) -> SelectionState:
+        """A throwaway SelectionState reproducing the cohort key's
+        content — composes never touch (or initialize) a live session's
+        state object, which request handlers mutate on the loop."""
+        selected, use_gauge, initialized = key
+        state = SelectionState()
+        state.selected = list(selected)
+        state.use_gauge = use_gauge
+        state._initialized = initialized
+        return state
+
+    async def seal_cohort(self, cohort: Cohort, tick_key: tuple) -> Seal:
+        """The cohort's seal for this data tick, composing at most once:
+        callers racing on the same (cohort, tick) after the first get the
+        cached seal.  Caller holds the server frame lock (compose order
+        is serialized against mutations exactly like the per-session
+        path it replaces)."""
+        latest = cohort.window.latest()
+        if cohort.tick_key == tick_key and latest is not None:
+            return latest
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        state = self._synth_state(cohort.key)
+        seal = await loop.run_in_executor(
+            None, self._build_seal, cohort, tick_key, state
+        )
+        cohort.window.append(seal)
+        cohort.seq = seal.seq
+        cohort.tick_key = tick_key
+        self.counters["seals"] += 1
+        return seal
+
+    def _build_seal(
+        self, cohort: Cohort, tick_key: tuple, state: SelectionState
+    ) -> Seal:
+        """Executor-side: compose → delta → serialize → compress, once
+        per cohort per tick.  The ONLY writer of ``cohort.prev_frame``,
+        and seals for one cohort are serialized by the frame lock, so
+        the read-modify-write is single-threaded."""
+        t0 = time.perf_counter()
+        frame = self._compose(state)
+        self.counters["composes"] += 1
+        t1 = time.perf_counter()
+        delta = frame_delta(cohort.prev_frame, frame)
+        seq = cohort.seq + 1
+        cid = cohort.cid
+        event_id = f"{cid}-{seq}"
+        frame_raw = self._dumps(frame).encode()
+        sse_full_raw = (
+            f"id: {event_id}\ndata: ".encode()
+            + self._dumps(dict(frame, kind="full")).encode()
+            + b"\n\n"
+        )
+        sse_delta_raw = None
+        sse_delta_gz = None
+        if delta is not None:
+            sse_delta_raw = (
+                f"id: {event_id}\ndata: ".encode()
+                + self._dumps(delta).encode()
+                + b"\n\n"
+            )
+            sse_delta_gz = compress_segment(sse_delta_raw)
+        seal = Seal(
+            cid,
+            seq,
+            tick_key,
+            sse_full_raw,
+            compress_segment(sse_full_raw),
+            sse_delta_raw,
+            sse_delta_gz,
+            frame_raw,
+            compress_segment(frame_raw),
+        )
+        cohort.prev_frame = frame
+        self.last_frame = frame
+        t2 = time.perf_counter()
+        self.compose_ms_total += (t1 - t0) * 1e3
+        self.encode_ms_total += (t2 - t1) * 1e3
+        return seal
+
+    def payloads_for(
+        self, cohort: Cohort, ack: "tuple[int, int] | None"
+    ) -> "tuple[list[Seal] | None, int]":
+        """(seals to send, new ack seq) for a subscriber of ``cohort``
+        that acked event ``ack``.  None → send the latest full frame;
+        [] → keepalive; otherwise the delta chain in order."""
+        latest = cohort.window.latest()
+        if latest is None:
+            return None, cohort.seq
+        if ack is None or ack[0] != cohort.cid:
+            return None, latest.seq
+        chain = cohort.window.since(ack[1])
+        if chain is None:
+            return None, latest.seq
+        return chain, latest.seq
+
+    def stats(self) -> dict:
+        seals = self.counters["seals"]
+        per_seal = (
+            (self.compose_ms_total + self.encode_ms_total) / seals
+            if seals
+            else None
+        )
+        return {
+            "cohorts": len(self._cohorts),
+            "window": self.window,
+            "max_cohorts": self.max_cohorts,
+            "compose_ms_total": round(self.compose_ms_total, 2),
+            "encode_ms_total": round(self.encode_ms_total, 2),
+            "cost_ms_per_seal": (
+                round(per_seal, 3) if per_seal is not None else None
+            ),
+            "counters": dict(self.counters),
+        }

@@ -1,0 +1,322 @@
+"""Layer 2 control: the worker-tier supervisor.
+
+``TPUDASH_WORKERS=N`` turns ``python -m tpudash`` into a supervised
+process tree:
+
+- **compose process** (this one): the full :class:`DashboardServer` —
+  scraping, normalizing, alerting, tsdb — bound to a PRIVATE unix
+  socket (``api.sock``) instead of TCP, plus the
+  :class:`~tpudash.broadcast.bus.BusPublisher` (``bus.sock``) and a
+  ticker that refreshes data and seals every live cohort once per
+  refresh interval;
+- **N fan-out workers** (``tpudash.broadcast.worker``): stateless
+  SO_REUSEPORT processes on the public port, serving SSE/``/api/frame``
+  from bus mirrors and proxying everything else here.
+
+Crashed workers are restarted with a small backoff (their clients'
+EventSources reconnect to a surviving worker and resume by event id —
+the seal window lives in every mirror, not in the process that died).
+
+**Fail fast, never fall back**: a platform without ``SO_REUSEPORT`` or
+an unusable bus path aborts startup with an actionable error.  A silent
+single-worker fallback would look healthy while quietly losing the
+capacity the operator sized the deployment for.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import os
+import signal
+import socket as socketmod
+import sys
+import tempfile
+
+from tpudash.config import Config, _ENV_MAP, configure_logging
+
+from tpudash.broadcast.worker import API_SOCK, BUS_SOCK
+
+log = logging.getLogger(__name__)
+
+#: seconds between a worker's death and its replacement (first restart;
+#: doubles per consecutive crash up to _RESTART_MAX)
+_RESTART_BACKOFF = 0.5
+_RESTART_MAX = 10.0
+
+
+class BroadcastSetupError(Exception):
+    """The worker tier cannot start here — message says why and what to do."""
+
+
+def preflight(cfg: Config, socket_mod=socketmod) -> str:
+    """Validate the platform/config for ``TPUDASH_WORKERS`` mode and
+    return the resolved bus directory.  Raises
+    :class:`BroadcastSetupError` with an actionable message on ANY
+    problem — the contract is fail-fast, never a silent single-worker
+    fallback."""
+    if cfg.workers > 1:
+        if not hasattr(socket_mod, "SO_REUSEPORT"):
+            raise BroadcastSetupError(
+                f"TPUDASH_WORKERS={cfg.workers} needs SO_REUSEPORT to share "
+                "the public port across worker processes, and this platform's "
+                "socket module does not expose it.  Run with "
+                "TPUDASH_WORKERS=0 (single process) or deploy on "
+                "Linux >= 3.9 / a platform with SO_REUSEPORT."
+            )
+        # the attr existing is not the same as the kernel honoring it:
+        # prove two sockets can actually share one port
+        s1 = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        s2 = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        try:
+            s1.setsockopt(
+                socket_mod.SOL_SOCKET, socket_mod.SO_REUSEPORT, 1
+            )
+            s1.bind((cfg.host, 0))
+            probe_port = s1.getsockname()[1]
+            s2.setsockopt(
+                socket_mod.SOL_SOCKET, socket_mod.SO_REUSEPORT, 1
+            )
+            s2.bind((cfg.host, probe_port))
+        except OSError as e:
+            raise BroadcastSetupError(
+                f"TPUDASH_WORKERS={cfg.workers}: the kernel refused two "
+                f"SO_REUSEPORT binds on one port ({e}).  Run with "
+                "TPUDASH_WORKERS=0 or fix the platform."
+            ) from e
+        finally:
+            s1.close()
+            s2.close()
+    bus_dir = cfg.broadcast_bus or tempfile.mkdtemp(prefix="tpudash-bus-")
+    try:
+        os.makedirs(bus_dir, mode=0o700, exist_ok=True)
+    except OSError as e:
+        raise BroadcastSetupError(
+            f"TPUDASH_BROADCAST_BUS={bus_dir!r} is not a usable directory "
+            f"({e}).  Point it at a writable local path."
+        ) from e
+    if not os.access(bus_dir, os.W_OK):
+        raise BroadcastSetupError(
+            f"TPUDASH_BROADCAST_BUS={bus_dir!r} is not writable by this "
+            "process.  Fix its permissions or point it elsewhere."
+        )
+    # sun_path is ~108 bytes on Linux (104 on BSDs); refuse paths that
+    # would truncate instead of producing an inscrutable bind error
+    longest = os.path.join(bus_dir, BUS_SOCK)
+    if len(longest.encode()) > 100:
+        raise BroadcastSetupError(
+            f"TPUDASH_BROADCAST_BUS={bus_dir!r} is too long for a unix "
+            f"socket path ({len(longest.encode())} bytes; the platform "
+            "limit is ~108).  Use a shorter path, e.g. under /tmp or "
+            "/run."
+        )
+    return bus_dir
+
+
+def worker_env(cfg: Config, bus_dir: str, index: int) -> dict:
+    """The exact environment a worker needs to reconstruct ``cfg`` with
+    ``load_config()`` — every registry-mapped field serialized back to
+    its env var, so a cfg built programmatically (tests, drills) still
+    reaches the child intact."""
+    env = dict(os.environ)
+    for field in dataclasses.fields(Config):
+        var = _ENV_MAP.get(field.name)
+        if var is None:
+            continue
+        value = getattr(cfg, field.name)
+        if isinstance(value, bool):
+            env[var] = "1" if value else "0"
+        else:
+            env[var] = str(value)
+    env["TPUDASH_BROADCAST_BUS"] = bus_dir  # tpulint: allow[env-read] write into the spawned worker's env dict, not a read
+    env["TPUDASH_WORKER_INDEX"] = str(index)  # tpulint: allow[env-read] write into the spawned worker's env dict, not a read
+    return env
+
+
+class Supervisor:
+    def __init__(
+        self, cfg: Config, server, bus_dir: str, log_dir: "str | None" = None
+    ):
+        self.cfg = cfg
+        self.server = server  # DashboardServer (compose side)
+        self.bus_dir = bus_dir
+        #: when set, each worker's stdout/stderr appends to
+        #: ``<log_dir>/worker-<index>.log`` instead of inheriting this
+        #: process's — the storm drill scans these for unhandled
+        #: exceptions in EVERY process, not just the compose one
+        self.log_dir = log_dir
+        self.publisher = None
+        self._workers: "dict[int, asyncio.subprocess.Process]" = {}
+        self._tasks: "list[asyncio.Task]" = []
+        self._stopping = asyncio.Event()
+        self.restarts = 0
+
+    # -- compose-side plumbing ----------------------------------------------
+    async def start(self) -> None:
+        from aiohttp import web
+
+        from tpudash.broadcast.bus import BusPublisher
+
+        server = self.server
+        self.publisher = BusPublisher(
+            os.path.join(self.bus_dir, BUS_SOCK),
+            server.hub,
+            backlog=self.cfg.broadcast_backlog,
+            on_active=server.hub.touch,
+        )
+        server.bus_publisher = self.publisher
+        server.workers_provider = self.workers_doc
+        app = server.build_app()
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.UnixSite(self._runner, os.path.join(self.bus_dir, API_SOCK))
+        await site.start()
+        await self.publisher.start()
+        self._tasks.append(asyncio.ensure_future(self._ticker()))
+        for i in range(self.cfg.workers):
+            self._tasks.append(asyncio.ensure_future(self._keep_worker(i)))
+        log.info(
+            "broadcast supervisor up: compose pid %d on %s, %d worker(s) "
+            "on %s:%d",
+            os.getpid(),
+            os.path.join(self.bus_dir, API_SOCK),
+            self.cfg.workers,
+            self.cfg.host,
+            self.cfg.port,
+        )
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+        for proc in self._workers.values():
+            with contextlib.suppress(ProcessLookupError):
+                proc.terminate()
+        for proc in self._workers.values():
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(proc.wait(), 5.0)
+        if self.publisher is not None:
+            await self.publisher.close()
+        await self._runner.cleanup()
+
+    async def _ticker(self) -> None:
+        """The worker tier's heartbeat: in single-process mode SSE loops
+        drive sealing on demand; here no subscriber lives in this
+        process, so the ticker refreshes the shared data and seals every
+        live cohort once per refresh interval, publishing fresh seals to
+        the bus.  Cohorts nobody reported watching for
+        ``broadcast_idle_ttl`` seconds stop being composed."""
+        server = self.server
+        interval = max(0.25, self.cfg.refresh_interval)
+        while not self._stopping.is_set():
+            try:
+                async with server._lock:
+                    await server._refresh_locked(False)
+                    tick_key = server._tick_key()
+                    for cohort in server.hub.cohorts():
+                        seal = await server.hub.seal_cohort(cohort, tick_key)
+                        server._publish_seal(seal)
+                    # eviction fans out to the mirrors via the hub's
+                    # on_evict → server._on_cohort_evict → publish_evict
+                    server.hub.evict_idle(self.cfg.broadcast_idle_ttl)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the ticker must survive one bad tick  # tpulint: allow[broad-except] heartbeat loop: one failed tick logs, the next retries
+                log.exception("broadcast ticker tick failed")
+            await asyncio.sleep(interval)
+
+    # -- worker lifecycle ----------------------------------------------------
+    async def _keep_worker(self, index: int) -> None:
+        """Spawn worker ``index`` and keep it alive: crash → log +
+        exponential-backoff restart.  Clients of the dead worker
+        reconnect (EventSource auto-retry) to any surviving worker and
+        resume by event id."""
+        backoff = _RESTART_BACKOFF
+        while not self._stopping.is_set():
+            log_fd = None
+            spawn_kwargs = {}
+            if self.log_dir is not None:
+                log_fd = open(  # tpulint: allow[async-blocking] one tiny local append-open per worker spawn, not worth an executor hop
+                    os.path.join(self.log_dir, f"worker-{index}.log"), "ab"
+                )
+                spawn_kwargs = {"stdout": log_fd, "stderr": log_fd}
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable,
+                    "-m",
+                    "tpudash.broadcast.worker",
+                    env=worker_env(self.cfg, self.bus_dir, index),
+                    **spawn_kwargs,
+                )
+            finally:
+                if log_fd is not None:
+                    log_fd.close()  # the child holds its own duplicate
+            self._workers[index] = proc
+            rc = await proc.wait()
+            if self._stopping.is_set():
+                return
+            self.restarts += 1
+            log.warning(
+                "fan-out worker %d (pid %s) exited rc=%s; restarting in %.1fs",
+                index,
+                proc.pid,
+                rc,
+                backoff,
+            )
+            await asyncio.sleep(backoff)
+            backoff = min(_RESTART_MAX, backoff * 2)
+
+    def workers_doc(self) -> dict:
+        """The ``/api/workers`` payload in worker mode: supervisor view
+        (spawned pids, restarts) joined with the bus view (connected
+        mirrors, queue depths)."""
+        return {
+            "mode": "workers",
+            "configured": self.cfg.workers,
+            "restarts": self.restarts,
+            "spawned": {
+                str(i): p.pid
+                for i, p in self._workers.items()
+                if p.returncode is None
+            },
+            "bus": self.publisher.stats() if self.publisher else None,
+        }
+
+
+async def _supervise(cfg: Config, server, bus_dir: str) -> None:
+    sup = Supervisor(cfg, server, bus_dir)
+    await sup.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    try:
+        await stop.wait()
+    finally:
+        await sup.stop()
+
+
+def run_supervised(cfg: Config) -> None:  # pragma: no cover - blocking entry
+    """Entry point behind ``TPUDASH_WORKERS>0`` (see server.run)."""
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.sources import make_source
+
+    configure_logging()
+    try:
+        bus_dir = preflight(cfg)  # fail BEFORE paying service construction
+    except BroadcastSetupError as e:
+        log.error("%s", e)
+        raise SystemExit(2) from e
+    # blocking construction (state restore, history load) happens here,
+    # before any event loop exists — the loop only ever sees ready objects
+    service = DashboardService(cfg, make_source(cfg))
+    server = DashboardServer(service)
+    with contextlib.suppress(KeyboardInterrupt):
+        asyncio.run(_supervise(cfg, server, bus_dir))

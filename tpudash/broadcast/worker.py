@@ -1,0 +1,501 @@
+"""Layer 2 serving: the stateless SO_REUSEPORT fan-out worker.
+
+``python -m tpudash.broadcast.worker`` — spawned by the supervisor, one
+per ``TPUDASH_WORKERS`` slot.  Each worker:
+
+- binds the PUBLIC TCP port with ``SO_REUSEPORT`` (the kernel spreads
+  accepted connections across workers, so client capacity scales with
+  cores instead of one event loop);
+- serves ``/api/stream`` and ``/api/frame`` purely from its
+  :class:`~tpudash.broadcast.bus.BusMirror` — pre-sealed cohort buffers,
+  zero composing, zero compressing, zero shared-state locks;
+- proxies every other route to the compose process over its private
+  unix API socket, so the public port keeps the full HTTP API;
+- keeps the PR-3 overload contract locally: per-worker stream cap,
+  write-deadline slow-consumer eviction, rate buckets — and its own
+  :class:`LoopLagMonitor`, surfaced under ``worker`` on ``/healthz``.
+
+Workers hold NO session state: a client's ``Last-Event-ID`` names a
+(cohort, seq) that every mirror can resume, which is what makes
+reconnecting to a *different* worker — or to the replacement of a
+crashed one — delta-preserving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import socket
+import sys
+import time
+
+from aiohttp import ClientSession, ClientTimeout, UnixConnector, web
+
+from tpudash.analysis.asynccheck import LoopLagMonitor
+from tpudash.app.overload import OverloadGuard, bound_stream_buffers
+from tpudash.app.server import (
+    _CLIENT_GONE,
+    SESSION_COOKIE,
+    _accepts_gzip,
+)
+from tpudash.broadcast.bus import BusMirror
+from tpudash.broadcast.cohort import (
+    GZIP_HEADER,
+    KEEPALIVE_GZ,
+    KEEPALIVE_RAW,
+    parse_event_id,
+)
+from tpudash.config import Config, configure_logging, env_read, load_config
+
+log = logging.getLogger(__name__)
+
+#: unix-socket filenames inside the bus directory (shared contract with
+#: the supervisor)
+BUS_SOCK = "bus.sock"
+API_SOCK = "api.sock"
+
+#: hop-by-hop headers a proxy must not forward (RFC 9110 §7.6.1)
+_HOP_HEADERS = frozenset(
+    {
+        "connection",
+        "keep-alive",
+        "proxy-authenticate",
+        "proxy-authorization",
+        "te",
+        "trailer",
+        "transfer-encoding",
+        "upgrade",
+        "host",
+    }
+)
+
+#: every locally-served response names its worker — the storm drill and
+#: the cross-worker reconnect tests identify processes by this header
+WORKER_HEADER = "X-TPUDash-Worker"
+
+
+class FanoutWorker:
+    def __init__(self, cfg: Config, index: int, bus_dir: str):
+        self.cfg = cfg
+        self.index = index
+        self.bus_dir = bus_dir
+        self.pid = os.getpid()
+        self.mirror = BusMirror(
+            os.path.join(bus_dir, BUS_SOCK), pid=self.pid, index=index
+        )
+        self.overload = OverloadGuard(cfg)
+        self.loop_monitor = LoopLagMonitor(budget_ms=cfg.loop_lag_budget)
+        self._stop = asyncio.Event()
+        self._api: "ClientSession | None" = None
+        self._tasks: "list[asyncio.Task]" = []
+
+    # -- internal API client -------------------------------------------------
+    def api_session(self) -> ClientSession:
+        if self._api is None:
+            self._api = ClientSession(
+                connector=UnixConnector(
+                    path=os.path.join(self.bus_dir, API_SOCK)
+                ),
+                timeout=ClientTimeout(total=30),
+                auto_decompress=False,  # pass compose bodies through verbatim
+            )
+        return self._api
+
+    async def _resolve_cid(self, sid: str) -> "int | None":
+        """Session → cohort id: the mirror's binding map when it already
+        knows, else one internal call to the compose process (which also
+        seals the cohort so the mirror has bytes by first event)."""
+        cid = self.mirror.bindings.get(sid or "")
+        if cid is not None:
+            return cid
+        try:
+            async with self.api_session().get(
+                "http://compose/internal/cohort",
+                params={"sid": sid or ""},
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                if r.status != 200:
+                    return None
+                doc = await r.json(content_type=None)
+                cid = int(doc["cid"])
+                self.mirror.bindings[sid or ""] = cid
+                return cid
+        except (OSError, asyncio.TimeoutError, ValueError, KeyError):
+            return None
+
+    def _check_auth(self, request: web.Request, allow_query: bool) -> None:
+        """The worker-local copy of the bearer gate for routes it serves
+        without the compose process (proxied routes carry the client's
+        header through and are enforced there)."""
+        import hmac
+
+        token = self.cfg.auth_token
+        if not token:
+            return
+        header = request.headers.get("Authorization", "")
+        supplied = header[7:] if header.startswith("Bearer ") else None
+        if supplied is None and allow_query:
+            supplied = request.query.get("token")
+        if not supplied or not hmac.compare_digest(
+            supplied.encode(), token.encode()
+        ):
+            raise web.HTTPUnauthorized(text="missing or invalid token")
+
+    # -- handlers ------------------------------------------------------------
+    async def stream(self, request: web.Request) -> web.StreamResponse:
+        self._check_auth(request, allow_query=True)
+        if not self.overload.acquire_stream():
+            raise web.HTTPServiceUnavailable(
+                text="stream capacity reached; retry shortly",
+                headers={
+                    "Retry-After": self.overload.retry_after_header(),
+                    WORKER_HEADER: str(self.pid),
+                },
+            )
+        try:
+            return await self._stream_admitted(request)
+        finally:
+            self.overload.release_stream()
+
+    async def _stream_admitted(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        """The same pure-buffer-write loop as the single-process server,
+        fed by the bus mirror instead of the in-process hub."""
+        sid = request.cookies.get(SESSION_COOKIE) or ""
+        interval = max(0.25, self.cfg.refresh_interval)
+        cid = await self._resolve_cid(sid)
+        if cid is None:
+            raise web.HTTPServiceUnavailable(
+                text="compose process unreachable; retry shortly",
+                headers={
+                    "Retry-After": self.overload.retry_after_header(),
+                    WORKER_HEADER: str(self.pid),
+                },
+            )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+            WORKER_HEADER: str(self.pid),
+        }
+        accepts_gzip = _accepts_gzip(request.headers.get("Accept-Encoding", ""))
+        if accepts_gzip:
+            headers["Content-Encoding"] = "gzip"
+        resp = web.StreamResponse(headers=headers)
+        await resp.prepare(request)
+        bound_stream_buffers(request, self.cfg.sse_sndbuf)
+        payload_writer = getattr(resp, "_payload_writer", None)
+
+        async def write_buf(data: bytes) -> None:
+            await resp.write(data)
+            if payload_writer is not None:
+                await payload_writer.drain()
+
+        ack = parse_event_id(request.headers.get("Last-Event-ID"))
+        write_deadline = self.overload.write_deadline
+        self.mirror.retain(cid)
+        # keepalive pacing: the mirror wakes this loop on EVERY bus
+        # message (any cohort's seal, any binding), so without pacing
+        # each spurious wake would write a keepalive — multiplying
+        # per-client writes by total bus traffic instead of ticking at
+        # the refresh cadence like the single-process loop
+        next_keepalive = time.monotonic() + interval
+        try:
+            if accepts_gzip:
+                await write_buf(GZIP_HEADER)
+            while True:
+                # follow the session into a new cohort after a (proxied)
+                # selection change — the binding update rides the bus
+                new_cid = self.mirror.bindings.get(sid or "", cid)
+                if new_cid != cid:
+                    self.mirror.release(cid)
+                    self.mirror.retain(new_cid)
+                    cid, ack = new_cid, None
+                win = self.mirror.window(cid)
+                latest = win.latest() if win is not None else None
+                if latest is None:
+                    # cold mirror (fresh connect or bus resync): wait for
+                    # the seal instead of burning ticks on keepalives
+                    await self.mirror.wait_update(interval)
+                    win = self.mirror.window(cid)
+                    latest = win.latest() if win is not None else None
+                    if latest is None:
+                        if time.monotonic() >= next_keepalive:
+                            await write_buf(
+                                KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW
+                            )
+                            next_keepalive = time.monotonic() + interval
+                        continue
+                chain = (
+                    win.since(ack[1])
+                    if ack is not None and ack[0] == cid
+                    else None
+                )
+                if chain is None:
+                    payloads = [
+                        latest.sse_full_gz if accepts_gzip else latest.sse_full_raw
+                    ]
+                elif not chain:
+                    # nothing new for THIS cohort: keepalive only when
+                    # one is due, not on every bus wake
+                    if time.monotonic() >= next_keepalive:
+                        payloads = [
+                            KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW
+                        ]
+                    else:
+                        payloads = []
+                else:
+                    payloads = [
+                        (s.sse_delta_gz if accepts_gzip else s.sse_delta_raw)
+                        for s in chain
+                    ]
+                ack = (cid, latest.seq)
+                evicted = False
+                for payload in payloads:
+                    if write_deadline and write_deadline > 0:
+                        try:
+                            await asyncio.wait_for(
+                                write_buf(payload), write_deadline
+                            )
+                        except asyncio.TimeoutError:
+                            # slow-consumer eviction, same contract as the
+                            # single-process loop: abort the transport so
+                            # backpressure can't pin the handler, and let
+                            # Last-Event-ID resume on any worker
+                            self.overload.note_eviction()
+                            log.info(
+                                "worker %d evicted slow SSE consumer "
+                                "(write blocked > %gs)",
+                                self.pid,
+                                write_deadline,
+                            )
+                            if request.transport is not None:
+                                request.transport.abort()
+                            evicted = True
+                            break
+                    else:
+                        await write_buf(payload)
+                if payloads:
+                    next_keepalive = time.monotonic() + interval
+                if evicted:
+                    break
+                # wake early on a fresh seal; tick at the refresh cadence
+                # otherwise (keepalive pacing)
+                await self.mirror.wait_update(interval)
+        except (*_CLIENT_GONE, asyncio.CancelledError):
+            pass  # client went away — normal termination
+        finally:
+            self.mirror.release(cid)
+        return resp
+
+    async def frame(self, request: web.Request) -> web.Response:
+        """``/api/frame`` from the mirror: the latest sealed frame for the
+        session's cohort, ETag-revalidated, zero compose work.  Falls
+        back to proxying when the mirror has nothing for the cohort yet
+        (first request of a fresh session on a cold worker)."""
+        self._check_auth(request, allow_query=False)
+        reason = self.overload.admit(self.overload.client_key(request))
+        if reason is not None:
+            raise web.HTTPServiceUnavailable(
+                text=f"overloaded: shed ({reason})",
+                headers={
+                    "Retry-After": self.overload.retry_after_header(),
+                    WORKER_HEADER: str(self.pid),
+                },
+            )
+        try:
+            sid = request.cookies.get(SESSION_COOKIE) or ""
+            cid = await self._resolve_cid(sid)
+            win = self.mirror.window(cid) if cid is not None else None
+            latest = win.latest() if win is not None else None
+            if latest is None:
+                return await self.proxy(request)
+            headers = {
+                "Cache-Control": "no-cache",
+                "ETag": latest.etag,
+                WORKER_HEADER: str(self.pid),
+            }
+            if request.headers.get("If-None-Match") == latest.etag:
+                return web.Response(status=304, headers=headers)
+            if _accepts_gzip(request.headers.get("Accept-Encoding", "")):
+                body = latest.frame_gz
+                headers["Content-Encoding"] = "gzip"
+            else:
+                body = latest.frame_raw
+            return web.Response(
+                body=body, content_type="application/json", headers=headers
+            )
+        finally:
+            self.overload.release()
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        """Compose-process health with this worker's own vitals folded in
+        — the storm drill asserts loop-lag flatness per PID from here."""
+        try:
+            # identity: this session passes bodies through undecoded
+            # (auto_decompress=False), so a compressed /healthz would be
+            # unparseable here once it outgrows the compose middleware's
+            # size threshold
+            async with self.api_session().get(
+                "http://compose/healthz",
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                doc = await r.json(content_type=None)
+        except (OSError, asyncio.TimeoutError, ValueError):
+            doc = {"ok": False, "status": "compose-unreachable"}
+        doc["worker"] = self.worker_doc()
+        return web.json_response(
+            doc, headers={WORKER_HEADER: str(self.pid)}
+        )
+
+    def worker_doc(self) -> dict:
+        return {
+            "pid": self.pid,
+            "index": self.index,
+            "streams": self.overload.streams,
+            "loop_lag_ms": self.loop_monitor.summary(),
+            "bus": self.mirror.stats(),
+            "counters": dict(self.overload.counters),
+        }
+
+    async def proxy(self, request: web.Request) -> web.Response:
+        """Everything the mirror can't answer goes to the compose process
+        over the private unix API socket, headers and auth intact."""
+        if request.path.startswith("/internal/"):
+            # the compose process trusts /internal/ routes to arrive only
+            # over its private unix socket FROM A WORKER (its auth and
+            # admission middlewares wave them through on that basis) — a
+            # public client must not reach them via this catch-all
+            raise web.HTTPNotFound()
+        headers = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        if not any(k.lower() == "accept-encoding" for k in headers):
+            # the compose process negotiates compression against THIS
+            # hop's Accept-Encoding; without an explicit value aiohttp's
+            # client injects its own "gzip, deflate" and the pass-through
+            # body would reach a client that never offered an encoding
+            headers["Accept-Encoding"] = "identity"
+        body = await request.read() if request.can_read_body else None
+        try:
+            async with self.api_session().request(
+                request.method,
+                f"http://compose{request.rel_url}",
+                headers=headers,
+                data=body,
+            ) as r:
+                payload = await r.read()
+                out = {
+                    k: v
+                    for k, v in r.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                    and k.lower() != "content-length"
+                }
+                out[WORKER_HEADER] = str(self.pid)
+                return web.Response(
+                    status=r.status, body=payload, headers=out
+                )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise web.HTTPServiceUnavailable(
+                text=f"compose process unreachable: {e}",
+                headers={WORKER_HEADER: str(self.pid)},
+            ) from e
+
+    # -- lifecycle -----------------------------------------------------------
+    async def _active_pings(self) -> None:
+        """Tell the publisher which cohorts this worker's subscribers are
+        watching, every refresh interval — watched cohorts never idle out."""
+        interval = max(0.25, self.cfg.refresh_interval)
+        while not self._stop.is_set():
+            with contextlib.suppress(OSError):
+                await self.mirror.send_active()
+            await asyncio.sleep(interval)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+
+        async def _start(app):
+            if self.cfg.loop_lag_budget > 0:
+                self.loop_monitor.install()
+                self._tasks.append(
+                    asyncio.ensure_future(self.loop_monitor.run())
+                )
+            self._tasks.append(asyncio.ensure_future(self.mirror.run(self._stop)))
+            self._tasks.append(asyncio.ensure_future(self._active_pings()))
+
+        async def _stop(app):
+            self._stop.set()
+            for task in self._tasks:
+                task.cancel()
+            for task in self._tasks:
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+            if self.cfg.loop_lag_budget > 0:
+                self.loop_monitor.uninstall()
+            if self._api is not None:
+                await self._api.close()
+
+        app.on_startup.append(_start)
+        app.on_cleanup.append(_stop)
+        app.router.add_get("/api/stream", self.stream)
+        app.router.add_get("/api/frame", self.frame)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_route("*", "/{tail:.*}", self.proxy)
+        return app
+
+
+def reuseport_socket(host: str, port: int) -> socket.socket:
+    """The worker tier's listening socket: SO_REUSEPORT so N processes
+    share one public port and the kernel load-balances accepts."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    sock.bind((host, port))
+    return sock
+
+
+async def serve(cfg: Config, index: int, bus_dir: str) -> None:
+    worker = FanoutWorker(cfg, index, bus_dir)
+    runner = web.AppRunner(worker.build_app())
+    await runner.setup()
+    sock = reuseport_socket(cfg.host, cfg.port)
+    # a reconnect storm after a worker crash lands as one SYN burst — the
+    # default 128 backlog would make clients ride kernel retransmit timers
+    site = web.SockSite(runner, sock, backlog=1024)
+    await site.start()
+    log.info(
+        "fan-out worker %d (pid %d) serving :%d from bus %s",
+        index,
+        worker.pid,
+        cfg.port,
+        bus_dir,
+    )
+    try:
+        await asyncio.Event().wait()  # until cancelled / killed
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    configure_logging()
+    cfg = load_config()
+    index = int(env_read("TPUDASH_WORKER_INDEX", "0") or "0")
+    bus_dir = cfg.broadcast_bus
+    if not bus_dir:
+        print(
+            "tpudash worker: TPUDASH_BROADCAST_BUS must point at the "
+            "supervisor's bus directory",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    with contextlib.suppress(KeyboardInterrupt):
+        asyncio.run(serve(cfg, index, bus_dir))
+
+
+if __name__ == "__main__":  # pragma: no cover - process entry
+    main()

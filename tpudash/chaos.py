@@ -40,8 +40,28 @@ SSE consumers — and asserts the overload contract end to end:
 
     python -m tpudash.chaos overload --clients 100 --seconds 10
 
+**The storm drill** (``python -m tpudash.chaos storm``): the broadcast
+plane's soak (tpudash.broadcast).  It boots the REAL supervised tier —
+one compose process publishing sealed cohort buffers on the frame bus
+plus N SO_REUSEPORT fan-out worker processes — then drives a 1000-client
+SSE storm (including deliberately-stalled consumers) at the shared public
+port and asserts the overload contract holds in every process:
+
+- the storm spreads across >= 2 distinct worker pids;
+- per-worker stream caps shed overflow with ``503`` + ``Retry-After``;
+- stalled consumers are evicted by each worker's write deadline;
+- ``loop_lag_ms`` p50 stays under budget in the compose process AND
+  every worker (each reports its own monitor on ``/healthz``);
+- zero unhandled exceptions in any process's captured logs;
+- ``/healthz`` keeps answering throughout (zero failed probes, p50
+  under a second — probed from a dedicated thread so the drill's own
+  1000-task client loop can't pollute the measurement).
+
+    python -m tpudash.chaos storm --clients 1000 --workers 2 --seconds 30
+
 Exit status 0 = every invariant held; 1 = the printed JSON names what
-didn't.  CI runs this on every PR (chaos-soak job).
+didn't.  CI runs the overload and storm drills on every PR (chaos-soak
+job).
 """
 
 from __future__ import annotations
@@ -84,6 +104,25 @@ _OVERLOAD_KNOBS = {
     "TPUDASH_SSE_WRITE_DEADLINE": ("sse_write_deadline", 1.0),
     "TPUDASH_SHED_RETRY_AFTER": ("shed_retry_after", 1.0),
     "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 128),
+    # small per-stream output buffers: localhost sockets otherwise absorb
+    # megabytes and the drill is here to prove eviction, not to wait out
+    # kernel buffers (this is the production knob, not a test hook)
+    "TPUDASH_SSE_SNDBUF": ("sse_sndbuf", 8192),
+}
+
+#: storm-drill knobs (the multi-worker SSE storm): per-WORKER stream caps
+#: sized so a 1000-client storm over 2 workers genuinely sheds, the same
+#: tight write deadline + tiny stream buffers as the overload drill, and
+#: a seal window deep enough that evicted clients resume with deltas
+_STORM_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.5),
+    "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 64),
+    "TPUDASH_MAX_STREAMS": ("max_streams", 400),
+    "TPUDASH_MAX_CONCURRENCY": ("max_concurrency", 64),
+    "TPUDASH_SSE_WRITE_DEADLINE": ("sse_write_deadline", 1.0),
+    "TPUDASH_SHED_RETRY_AFTER": ("shed_retry_after", 1.0),
+    "TPUDASH_SSE_SNDBUF": ("sse_sndbuf", 8192),
+    "TPUDASH_BROADCAST_WINDOW": ("broadcast_window", 16),
 }
 
 
@@ -215,21 +254,6 @@ async def run_overload_drill(
     loop = asyncio.get_running_loop()
     server, cfg = await loop.run_in_executor(None, make_overload_server, cfg)
     app = server.build_app()
-
-    # Small per-connection output buffers on the stream route ONLY inside
-    # the drill: localhost sockets otherwise absorb megabytes, and the
-    # point is to prove eviction, not to wait out kernel buffers.
-    import socket as socketmod
-
-    async def _tiny_stream_buffers(request, response):
-        if request.path != "/api/stream" or request.transport is None:
-            return
-        sock = request.transport.get_extra_info("socket")
-        if sock is not None:
-            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_SNDBUF, 8192)
-        request.transport.set_write_buffer_limits(high=8192)
-
-    app.on_response_prepare.append(_tiny_stream_buffers)
 
     trap = _ErrorTrap()
     logging.getLogger().addHandler(trap)
@@ -451,6 +475,396 @@ async def run_overload_drill(
     }
 
 
+# ---------------------------------------------------------------------------
+# Storm drill — a 1000-client SSE storm across the multi-process worker
+# tier (tpudash.broadcast): the broadcast plane's overload contract.
+# ---------------------------------------------------------------------------
+
+
+def _raise_fd_limit(want: int = 65536) -> None:
+    """A 1000-connection storm (plus worker processes inheriting this
+    limit) needs more file descriptors than the usual soft 1024."""
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    target = min(hard, want) if hard > 0 else want
+    if soft < target:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
+
+
+#: the storm drill's ``/healthz`` prober, run as a SEPARATE PROCESS
+#: (``python -c``): the drill process itself runs ~1000 client tasks, so
+#: any in-process probe — coroutine or thread (GIL) — measures the
+#: harness's own starvation, not the server's availability.  Fresh
+#: connection per probe (SO_REUSEPORT hashes each to some worker), hard
+#: socket timeout, one JSON summary on stdout at the end.
+_HEALTHZ_PROBE_SRC = """
+import http.client, json, sys, time
+host, port = sys.argv[1], int(sys.argv[2])
+settle, seconds = float(sys.argv[3]), float(sys.argv[4])
+time.sleep(settle)
+end = time.monotonic() + seconds
+out = {"probes": 0, "failures": 0, "latencies_ms": []}
+while time.monotonic() < end:
+    t0 = time.monotonic()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5.0)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            out["latencies_ms"].append(round((time.monotonic() - t0) * 1e3, 2))
+            if resp.status != 200:
+                out["failures"] += 1
+        finally:
+            conn.close()
+    except OSError:
+        out["failures"] += 1
+    out["probes"] += 1
+    time.sleep(0.25)
+print(json.dumps(out))
+"""
+
+
+def make_storm_server(cfg: "Config | None", workers: int):
+    """(DashboardServer, cfg, bus_dir) for the storm: a plain synthetic
+    source (the storm stresses FAN-OUT, not compose) under storm knobs,
+    preflighted for worker mode.  Raises BroadcastSetupError where worker
+    mode cannot run — the drill fails loudly, mirroring production's
+    fail-fast contract."""
+    import socket as socketmod
+    import tempfile
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.broadcast.supervisor import preflight
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = cfg or load_config()
+    for env_name, (field, value) in _STORM_KNOBS.items():
+        if not env_is_set(env_name):
+            cfg = dataclasses.replace(cfg, **{field: value})
+    # an ephemeral public port for the SO_REUSEPORT worker sockets (bind
+    # 0 to learn a free one; the tiny close-to-rebind race is acceptable
+    # in a drill) and a private short-path bus dir
+    probe = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    cfg = dataclasses.replace(
+        cfg,
+        workers=workers,
+        host="127.0.0.1",
+        port=port,
+        broadcast_bus=cfg.broadcast_bus
+        or tempfile.mkdtemp(prefix="tpudash-storm-"),
+    )
+    bus_dir = preflight(cfg)
+    source = SyntheticSource(
+        num_chips=min(cfg.synthetic_chips, 128), generation=cfg.generation
+    )
+    return DashboardServer(DashboardService(cfg, source)), cfg, bus_dir
+
+
+async def run_storm_drill(
+    clients: int = 1000,
+    workers: int = 2,
+    seconds: float = 30.0,
+    cfg: "Config | None" = None,
+) -> dict:
+    """The broadcast plane's soak: a ``clients``-strong SSE storm against
+    ``workers`` real fan-out worker processes (SO_REUSEPORT + frame bus),
+    asserting the overload contract holds in EVERY process:
+
+    - the storm spreads across >= 2 distinct worker pids;
+    - per-worker stream caps shed the overflow with 503 + Retry-After;
+    - deliberately-stalled consumers are evicted by the write deadline;
+    - ``loop_lag_ms`` p50 stays under budget in the compose process and
+      every observed worker;
+    - zero unhandled exceptions in any process's logs;
+    - ``/healthz`` keeps answering throughout — probed from a SEPARATE
+      process (in-process probes, coroutine or thread, measure the
+      drill's own 1000-task starvation, not the server), asserting zero
+      failed probes and p50 under a second.
+    """
+    from aiohttp import (
+        ClientError,
+        ClientSession,
+        ClientTimeout,
+        TCPConnector,
+    )
+
+    from tpudash.broadcast.supervisor import BroadcastSetupError, Supervisor
+
+    _raise_fd_limit()
+    loop = asyncio.get_running_loop()
+    try:
+        server, cfg, bus_dir = await loop.run_in_executor(
+            None, make_storm_server, cfg, workers
+        )
+    except BroadcastSetupError as e:
+        return {"ok": False, "failures": [f"preflight: {e}"]}
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    sup = Supervisor(cfg, server, bus_dir, log_dir=bus_dir)
+    await sup.start()
+    base = f"http://{cfg.host}:{cfg.port}"
+
+    stats = {
+        "stream_events": 0,
+        "streams_served": 0,
+        "shed_503": 0,
+        "shed_with_retry_after": 0,
+        "healthz_probes": 0,
+        "healthz_failures": 0,
+        "healthz_max_ms": 0.0,
+    }
+    hz_lat: "list[float]" = []
+    stream_pids: set = set()
+    stop = asyncio.Event()
+
+    async def wait_for_workers() -> bool:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if len(sup.publisher.workers()) >= workers:
+                return True
+            await asyncio.sleep(0.25)
+        return False
+
+    async def stream_client(session: ClientSession, i: int, ramp: float):
+        """One storm viewer: stream events until told to stop; a shed 503
+        backs off Retry-After and retries — shed clients in the wild
+        don't vanish, they come back.  Arrivals are staggered over
+        ``ramp`` seconds: a thousand simultaneous connects measures the
+        drill process's own accept loop, not the worker tier."""
+        cookies = {"tpudash_sid": f"storm-{i}"}
+        await asyncio.sleep(ramp)
+        while not stop.is_set():
+            try:
+                async with session.get(
+                    f"{base}/api/stream", cookies=cookies
+                ) as r:
+                    pid = r.headers.get("X-TPUDash-Worker")
+                    if r.status == 503:
+                        stats["shed_503"] += 1
+                        if r.headers.get("Retry-After"):
+                            stats["shed_with_retry_after"] += 1
+                        await asyncio.sleep(
+                            float(r.headers.get("Retry-After") or 1.0)
+                        )
+                        continue
+                    if pid:
+                        stream_pids.add(pid)
+                    stats["streams_served"] += 1
+                    async for line in r.content:
+                        if line.startswith(b"data:"):
+                            stats["stream_events"] += 1
+                        if stop.is_set():
+                            return
+            except (OSError, ClientError, asyncio.TimeoutError):
+                await asyncio.sleep(0.2)
+
+    failures = []
+    worker_docs: dict = {}
+    try:
+        if not await wait_for_workers():
+            failures.append(
+                f"only {len(sup.publisher.workers())}/{workers} workers "
+                "connected to the bus within 60s"
+            )
+        else:
+            clients = max(8, clients)
+            n_stalled = min(max(4, clients // 50), 32)
+            n_streams = clients - n_stalled
+            # arrivals staggered over the first part of the run: a
+            # thousand simultaneous connects measures this drill
+            # process's own client loop, not the worker tier
+            ramp = min(max(1.0, seconds / 3.0), 6.0)
+            # probe only AFTER the connect surge settles: the invariant
+            # is steady-state availability.  Measured on a 2-core box,
+            # 1000 clients arriving over the ramp keep the workers'
+            # accept/handshake path saturated for a few seconds past the
+            # last arrival; probes inside that window time the surge
+            # being drained, not the serving plane the drill asserts on.
+            settle = ramp + max(3.0, seconds / 3.0)
+            hz_proc = await asyncio.create_subprocess_exec(
+                sys.executable,
+                "-c",
+                _HEALTHZ_PROBE_SRC,
+                cfg.host,
+                str(cfg.port),
+                str(settle),
+                str(max(1.0, seconds - settle)),
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+            )
+            # one session, unbounded pool: 1000 storm connections are the
+            # point, the client-side connector must not be the limiter
+            async with ClientSession(
+                connector=TCPConnector(limit=0)
+            ) as session:
+                tasks = [
+                    *(
+                        asyncio.ensure_future(
+                            _stalled_stream(
+                                cfg.host, cfg.port, f"storm-stall-{i}", stop
+                            )
+                        )
+                        for i in range(n_stalled)
+                    ),
+                    *(
+                        asyncio.ensure_future(
+                            stream_client(
+                                session, i, ramp * i / max(1, n_streams)
+                            )
+                        )
+                        for i in range(n_streams)
+                    ),
+                ]
+                await asyncio.sleep(seconds)
+                stop.set()
+                await asyncio.wait(tasks, timeout=15)
+                for t in tasks:
+                    t.cancel()
+                try:
+                    hz_out, _ = await asyncio.wait_for(
+                        hz_proc.communicate(), timeout=15
+                    )
+                    hz_doc = json.loads(hz_out or b"{}")
+                except (asyncio.TimeoutError, ValueError):
+                    try:
+                        hz_proc.kill()
+                    except ProcessLookupError:
+                        pass
+                    hz_doc = {}
+                stats["healthz_probes"] = hz_doc.get("probes", 0)
+                stats["healthz_failures"] = hz_doc.get("failures", 0)
+                hz_lat.extend(hz_doc.get("latencies_ms") or [])
+                stats["healthz_max_ms"] = max(hz_lat, default=0.0)
+                # collect every worker's vitals: force a fresh connection
+                # per probe so SO_REUSEPORT hashes us across pids
+                async with ClientSession(
+                    connector=TCPConnector(force_close=True),
+                    timeout=ClientTimeout(total=2.0),
+                ) as probeses:
+                    for _ in range(80):
+                        if len(worker_docs) >= workers:
+                            break
+                        try:
+                            async with probeses.get(f"{base}/healthz") as r:
+                                doc = await r.json()
+                        except (OSError, ClientError, asyncio.TimeoutError):
+                            continue
+                        wdoc = doc.get("worker") or {}
+                        if wdoc.get("pid") is not None:
+                            worker_docs[str(wdoc["pid"])] = wdoc
+    finally:
+        await sup.stop()
+        logging.getLogger().removeHandler(trap)
+
+    # -- invariants ----------------------------------------------------------
+    budget = cfg.loop_lag_budget
+    lat = sorted(hz_lat)
+    hz_p50 = lat[len(lat) // 2] if lat else None
+    stats["healthz_p50_ms"] = hz_p50
+    if not failures:
+        if len(stream_pids) < min(2, workers):
+            failures.append(
+                f"storm never spread across workers: pids {sorted(stream_pids)}"
+            )
+        if stats["shed_503"] == 0 or stats["shed_with_retry_after"] == 0:
+            failures.append(
+                "no 503+Retry-After sheds observed (per-worker stream cap)"
+            )
+        evicted = sum(
+            (d.get("counters") or {}).get("evicted_slow_consumers", 0)
+            for d in worker_docs.values()
+        )
+        if evicted == 0:
+            failures.append(
+                "no slow consumers evicted by any worker's write deadline"
+            )
+        if stats["stream_events"] < clients:
+            failures.append(
+                f"storm barely streamed: {stats['stream_events']} events "
+                f"for {clients} clients"
+            )
+        if stats["healthz_failures"] > 0 or not lat:
+            failures.append(
+                f"healthz availability: {stats['healthz_failures']} "
+                f"failed probe(s) of {stats['healthz_probes']}"
+            )
+        elif hz_p50 >= 1000.0:
+            failures.append(
+                f"healthz degraded: p50 {hz_p50:.0f}ms >= 1000ms "
+                f"(max {stats['healthz_max_ms']:.0f}ms)"
+            )
+        if len(worker_docs) < workers:
+            failures.append(
+                f"vitals collected from only {len(worker_docs)}/{workers} "
+                "workers"
+            )
+        # loop-lag flatness in EVERY process: the compose process's own
+        # monitor plus each worker's, as reported on its /healthz
+        compose_lag = server.loop_monitor.summary()
+        lags = {"compose": compose_lag}
+        for pid, d in worker_docs.items():
+            lags[f"worker-{pid}"] = d.get("loop_lag_ms") or {}
+        for name, lag in lags.items():
+            if not lag.get("samples"):
+                failures.append(f"{name}: loop-lag monitor has no samples")
+            elif lag.get("p50") is not None and lag["p50"] >= budget:
+                failures.append(
+                    f"{name}: loop lag p50 {lag['p50']}ms >= {budget:g}ms"
+                )
+        # zero unhandled exceptions — compose trap + every worker log
+        if trap.records:
+            failures.append(
+                f"{len(trap.records)} unhandled compose-process "
+                "exception(s): " + trap.records[0][:500]
+            )
+        worker_log_errors = await loop.run_in_executor(
+            None, _scan_worker_logs, bus_dir
+        )
+        if worker_log_errors:
+            failures.append(
+                f"worker logs show unhandled exceptions: "
+                f"{worker_log_errors[0][:500]}"
+            )
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "clients": clients,
+        "workers": workers,
+        "seconds": seconds,
+        "requests": stats,
+        "stream_worker_pids": sorted(stream_pids),
+        "worker_vitals": worker_docs,
+        "compose_loop_lag_ms": server.loop_monitor.summary(),
+        "supervisor_restarts": sup.restarts,
+    }
+
+
+def _scan_worker_logs(bus_dir: str) -> "list[str]":
+    """Unhandled-exception lines from the worker processes' captured
+    stderr (the supervisor appends each worker's output to
+    ``worker-<index>.log`` when log capture is on)."""
+    import glob
+    import os
+
+    out = []
+    for path in sorted(glob.glob(os.path.join(bus_dir, "worker-*.log"))):
+        try:
+            with open(path, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            if "Traceback (most recent call last)" in line or " ERROR " in line:
+                out.append(f"{os.path.basename(path)}: {line.strip()}")
+    return out
+
+
 def main(argv: "list[str] | None" = None) -> None:
     import argparse
 
@@ -464,12 +878,30 @@ def main(argv: "list[str] | None" = None) -> None:
     )
     ov.add_argument("--clients", type=int, default=100)
     ov.add_argument("--seconds", type=float, default=10.0)
+    st = sub.add_parser(
+        "storm",
+        help="multi-worker SSE storm over the broadcast plane "
+        "(SO_REUSEPORT worker tier + frame bus)",
+    )
+    st.add_argument("--clients", type=int, default=1000)
+    st.add_argument("--workers", type=int, default=2)
+    st.add_argument("--seconds", type=float, default=30.0)
     args = parser.parse_args(argv)
 
     configure_logging()
     if args.mode == "overload":
         summary = asyncio.run(
             run_overload_drill(clients=args.clients, seconds=args.seconds)
+        )
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "storm":
+        summary = asyncio.run(
+            run_storm_drill(
+                clients=args.clients,
+                workers=args.workers,
+                seconds=args.seconds,
+            )
         )
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)

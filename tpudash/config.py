@@ -239,6 +239,45 @@ class Config:
     #: ``/api/timings`` and ``/healthz``.  0 disables the monitor.
     loop_lag_budget: float = 250.0
 
+    # --- broadcast plane (tpudash.broadcast): cohort fan-out + workers ------
+    #: Fan-out worker processes.  0 = classic single-process serving.
+    #: N >= 1 starts the supervised tier: the compose process publishes
+    #: sealed cohort buffers on a local frame bus and N stateless
+    #: SO_REUSEPORT worker processes serve SSE / ``/api/frame`` clients
+    #: purely from their bus mirror (other routes are proxied to the
+    #: compose process).  Startup FAILS FAST when the platform lacks
+    #: SO_REUSEPORT or the bus path is unusable — never a silent
+    #: single-worker fallback.
+    workers: int = 0
+    #: Per-cohort retained-seal window (Last-Event-ID reconnects whose
+    #: acked seq is still inside the window resume with the exact delta
+    #: chain they missed — against any process holding the window).
+    broadcast_window: int = 8
+    #: Bound on live cohorts; creating past it evicts the least-recently
+    #: resolved cohort (its subscribers fall back to a full frame on
+    #: their next tick).  A selection-diverse swarm degrades to bounded
+    #: memory instead of unbounded cohort state.
+    broadcast_max_cohorts: int = 64
+    #: Directory for the worker tier's unix sockets (frame bus + internal
+    #: API).  "" = a per-run private temp directory.  Paths must fit the
+    #: platform's sun_path limit (~108 bytes) — checked at startup.
+    broadcast_bus: str = ""
+    #: Per-worker bus backlog, messages.  A worker that falls this far
+    #: behind the publisher is disconnected (it reconnects and
+    #: re-snapshots) — a wedged worker must not grow publisher memory.
+    broadcast_backlog: int = 256
+    #: Seconds a cohort keeps being composed/published with no worker
+    #: reporting a live subscriber for it (worker mode only; the
+    #: single-process hub composes strictly on demand).
+    broadcast_idle_ttl: float = 60.0
+    #: Per-stream SSE socket send-buffer bound, bytes (``SO_SNDBUF`` +
+    #: transport write-buffer high-water).  0 = kernel defaults.  At
+    #: thousands of streams the kernel's auto-tuned buffers cost real
+    #: memory per wedged consumer and let stalls hide from the write
+    #: deadline; bounding them caps both.  The overload drills set it so
+    #: slow-consumer eviction is provable on loopback.
+    sse_sndbuf: int = 0
+
     extra: dict = field(default_factory=dict)
 
 
@@ -284,6 +323,13 @@ _ENV_MAP = {
     "sse_write_deadline": "TPUDASH_SSE_WRITE_DEADLINE",
     "shed_retry_after": "TPUDASH_SHED_RETRY_AFTER",
     "loop_lag_budget": "TPUDASH_LOOP_LAG_BUDGET",
+    "workers": "TPUDASH_WORKERS",
+    "broadcast_window": "TPUDASH_BROADCAST_WINDOW",
+    "broadcast_max_cohorts": "TPUDASH_BROADCAST_MAX_COHORTS",
+    "broadcast_bus": "TPUDASH_BROADCAST_BUS",
+    "broadcast_backlog": "TPUDASH_BROADCAST_BACKLOG",
+    "broadcast_idle_ttl": "TPUDASH_BROADCAST_IDLE_TTL",
+    "sse_sndbuf": "TPUDASH_SSE_SNDBUF",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",
@@ -326,6 +372,9 @@ _EXTRA_ENV = {
     # test harness: enable the runtime event-loop lag sanitizer
     # (tpudash/analysis/asynccheck.py via tests/conftest.py)
     "TPUDASH_LOOPCHECK",
+    # worker-tier slot index, set by the broadcast supervisor for each
+    # spawned fan-out worker process (tpudash/broadcast/worker.py)
+    "TPUDASH_WORKER_INDEX",
 }
 
 #: every declared environment variable name (Config-mapped + extras);
