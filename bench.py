@@ -645,6 +645,101 @@ def bench_tsdb(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> dict:
     }
 
 
+def bench_snapshot(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> dict:
+    """Online snapshots (tpudash.tsdb.snapshot): snapshot duration vs
+    store size, and — the contract that makes them "online" — the
+    ingest stall while one runs.  An appender thread hammers
+    ``append_frame`` the whole time a snapshot is taken; the longest
+    inter-append gap is the stall.  The head cut is a pointer swap and
+    the link/CRC work happens off the ingest path, so the guard is a
+    hard sub-250 ms ceiling (generous for a noisy CI host; the typical
+    number is single-digit ms), and a follower catch-up case measures
+    the standby's replay rate over the same segment set."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from tpudash.tsdb import FLEET_SERIES, TSDB
+    from tpudash.tsdb.follower import FollowerTSDB
+    from tpudash.tsdb.snapshot import take_snapshot
+
+    work = tempfile.mkdtemp(prefix="tpudash-bench-snap-")
+    try:
+        store_dir = os.path.join(work, "store")
+        store = TSDB(path=store_dir, chunk_points=240)
+        rng = np.random.default_rng(9)
+        keys = [f"slice-0/{i}" for i in range(n_chips)] + [FLEET_SERIES]
+        cols = [f"metric_{i}" for i in range(n_cols)]
+        base = time.time() - n_frames * 5.0
+        mats = [
+            np.round(
+                rng.uniform(20.0, 90.0, size=(len(keys), n_cols)), 1
+            ).astype(np.float32)
+            for _ in range(8)
+        ]
+        for i in range(n_frames):
+            store.append_frame(base + 5.0 * i, keys, cols, mats[i % 8])
+        store.flush(seal_partial=True)
+        snapped_bytes = store.stats()["compressed_bytes"]
+
+        stop = threading.Event()
+        gaps: "list[float]" = []
+
+        def appender():
+            # ~500 appends/s: far hotter than any real refresh cadence,
+            # but throttled enough that head cuts stay rarer than the
+            # seal drain (an unthrottled spin would just starve the
+            # inline flush and measure its own backlog, not the stall)
+            i = n_frames
+            last = time.perf_counter()
+            while not stop.is_set():
+                store.append_frame(
+                    base + 5.0 * i, keys, cols, mats[i % 8]
+                )
+                now = time.perf_counter()
+                gaps.append(now - last)
+                last = now
+                i += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=appender, daemon=True)
+        t.start()
+        time.sleep(0.05)  # let the appender reach steady state
+        t0 = time.perf_counter()
+        snap = take_snapshot(store, os.path.join(work, "snaps"))
+        snap_s = time.perf_counter() - t0
+        time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5.0)
+        stall_ms = max(gaps) * 1e3 if gaps else 0.0
+        assert stall_ms < 250.0, (
+            f"snapshot stalled ingest {stall_ms:.1f}ms — the head cut "
+            "must stay a pointer swap"
+        )
+        # follower catch-up: replay the sealed segment set cold
+        t0 = time.perf_counter()
+        follower = FollowerTSDB(store_dir, poll_interval_s=60.0)
+        catchup_s = time.perf_counter() - t0
+        pts = follower.stats()["raw_points"]
+        follower.close()
+        assert pts > 0, "follower applied nothing from the bench store"
+        return {
+            "snapshot_ms": round(snap_s * 1e3, 2),
+            "snapshot_bytes": snap["bytes"],
+            "snapshot_files": snap["files"],
+            "snapshot_store_compressed_bytes": snapped_bytes,
+            "snapshot_ingest_stall_ms": round(stall_ms, 3),
+            "follower_catchup_points_per_s": int(
+                pts * len(keys) * n_cols / max(1e-9, catchup_s)
+            ),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_probes(timeout_s: float = 300.0) -> dict:
     """On-chip probe numbers, isolated in a SUBPROCESS with a hard
     timeout: a wedged accelerator runtime (e.g. a tunneled chip whose
@@ -760,6 +855,23 @@ def find_regressions(
         "higher",
         1.0,
     )
+    # durability tier (ISSUE 8): snapshot duration and follower replay
+    # are time-domain on a noisy host — 2x swings flag (the hard
+    # near-zero ingest-stall guard lives inside bench_snapshot itself)
+    check(
+        "snapshot_ms",
+        result.get("snapshot_ms"),
+        prev.get("snapshot_ms"),
+        "higher",
+        1.0,
+    )
+    check(
+        "follower_catchup_points_per_s",
+        result.get("follower_catchup_points_per_s"),
+        prev.get("follower_catchup_points_per_s"),
+        "lower",
+        0.50,
+    )
     # headline p50: compare in MACHINE-RELATIVE terms when both records
     # carry the CPU reference — this host's effective clock swings ±30%
     # with neighbors, and a level shift is not a code regression
@@ -806,6 +918,7 @@ def main() -> None:
     sse_subs = bench_sse_subscribers()
     shed = bench_shed_latency()
     tsdb = bench_tsdb()
+    snapshot = bench_snapshot()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -834,6 +947,7 @@ def main() -> None:
         **sse_subs,
         **shed,
         **tsdb,
+        **snapshot,
         "probes": probes,
         "cpu_ref_ms": cpu_reference_ms(),
         "cpu_ref_json_ms": cpu_reference_json_ms(),

@@ -1,0 +1,321 @@
+"""Crash-anything failover units (ISSUE 8): restart-backoff policy +
+journaling, compose-outage worker degrade (stale frames, compose_down
+alert, truthful healthz), and seal-seq epoch continuity."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import gzip
+import json
+import os
+import signal
+import zlib
+
+import pytest
+
+from tpudash.broadcast.cohort import (
+    CohortHub,
+    Seal,
+    SealWindow,
+    cohort_id,
+    cohort_key,
+    compress_segment,
+    parse_event_id,
+)
+from tpudash.broadcast.supervisor import (
+    _RESTART_BACKOFF,
+    TierSupervisor,
+    reset_backoff,
+)
+from tpudash.broadcast.worker import FanoutWorker, degraded_frame_body
+from tpudash.config import Config
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- restart backoff ---------------------------------------------------------
+
+
+def test_reset_backoff_policy():
+    # a child that survived >= 30s restarts at the base backoff again
+    assert reset_backoff(8.0, 31.0) == _RESTART_BACKOFF
+    assert reset_backoff(10.0, 3600.0) == _RESTART_BACKOFF
+    # a boot-looper keeps its current (doubling) penalty
+    assert reset_backoff(8.0, 5.0) == 8.0
+    assert reset_backoff(_RESTART_BACKOFF, 0.1) == _RESTART_BACKOFF
+
+
+def test_tier_supervisor_restart_bookkeeping_and_journal(tmp_path):
+    """SIGKILL a supervised child: it restarts, and pid / restarts /
+    last_exit_rc / last_restart_ts land in both the in-memory info and
+    the supervisor.json journal the compose child serves."""
+
+    async def go():
+        sup = TierSupervisor(Config(), str(tmp_path))
+        task = asyncio.ensure_future(
+            sup._keep_child(
+                "fake", ["-c", "import time; time.sleep(60)"], index=0
+            )
+        )
+        try:
+            for _ in range(200):
+                if sup.child_pid("fake") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            pid = sup.child_pid("fake")
+            assert pid is not None
+            os.kill(pid, signal.SIGKILL)
+            for _ in range(200):
+                new_pid = sup.child_pid("fake")
+                if (
+                    sup._info["fake"].restarts >= 1
+                    and new_pid is not None
+                    and new_pid != pid
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            info = sup._info["fake"]
+            assert info.restarts >= 1
+            assert info.last_exit_rc == -signal.SIGKILL
+            assert info.last_restart_ts is not None
+            with open(tmp_path / "supervisor.json", encoding="utf-8") as f:
+                status = json.load(f)
+            assert status["restarts_total"] >= 1
+            child = status["children"]["fake"]
+            assert child["restarts"] >= 1
+            assert child["last_exit_rc"] == -signal.SIGKILL
+        finally:
+            sup._stopping.set()
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+            last = sup.child_pid("fake")
+            if last is not None:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(last, signal.SIGKILL)
+
+    _run(go())
+
+
+# -- seal-seq epoch continuity ----------------------------------------------
+
+
+def _state(selected=("chip-0",)):
+    from tpudash.app.state import SelectionState
+
+    state = SelectionState()
+    state.selected = list(selected)
+    state.use_gauge = True
+    state._initialized = True
+    return state
+
+
+def test_hub_seq_base_floors_new_cohorts():
+    hub = CohortHub(lambda state: {"error": None}, json.dumps)
+    hub.seq_base = 2_000_000_000
+
+    async def go():
+        cohort = hub.resolve(_state())
+        seal = await hub.seal_cohort(cohort, (1, 0, False))
+        assert seal.seq == 2_000_000_001
+        assert seal.event_id.endswith("-2000000001")
+
+    _run(go())
+
+
+def test_hub_seq_base_beats_stale_retired_seq():
+    hub = CohortHub(lambda state: {"error": None}, json.dumps)
+    key = cohort_key(_state())
+    hub._retired_seqs[cohort_id(key)] = 17  # an old-epoch leftover
+    hub.seq_base = 1_000_000_000
+    cohort = hub.resolve(_state())
+    assert cohort.seq == 1_000_000_000
+
+
+def test_parse_event_id_epoch_scale_seqs():
+    assert parse_event_id("3417017682-2000000005") == (
+        3417017682,
+        2000000005,
+    )
+
+
+def test_window_treats_old_epoch_ack_as_full_frame():
+    """A client acked in epoch N reconnecting into epoch N+1: the ack is
+    either above the new window (predecessor sealed more) or below its
+    floor (gap) — both resolve to a full-frame re-init, never a
+    wrong-base delta chain."""
+    win = SealWindow(8)
+    frame_raw = b'{"error": null}'
+    win.append(
+        Seal(7, 2_000_000_001, (1, 0), b"e", compress_segment(b"e"),
+             None, None, frame_raw, gzip.compress(frame_raw))
+    )
+    assert win.since(1_000_000_005) is None  # below the floor: gap
+    assert win.since(3_000_000_001) is None  # above: different epoch
+
+
+# -- compose-outage worker degrade ------------------------------------------
+
+
+def test_degraded_frame_body_marks_stale_and_alerts():
+    frame = {
+        "error": None,
+        "alerts": [{"rule": "hbm>92", "state": "firing"}],
+        "warnings": ["existing"],
+    }
+    raw, gz = degraded_frame_body(
+        json.dumps(frame).encode(), down_s=12.3
+    )
+    doc = json.loads(raw)
+    assert doc["stale"] is True
+    assert doc["alerts"][0]["rule"] == "compose_down"
+    assert doc["alerts"][0]["severity"] == "critical"
+    assert doc["alerts"][0]["state"] == "firing"
+    assert doc["alerts"][1]["rule"] == "hbm>92"  # real alerts survive
+    assert any("compose process down" in w for w in doc["warnings"])
+    assert "existing" in doc["warnings"]
+    assert json.loads(gzip.decompress(gz)) == doc
+
+
+def _mk_seal(cid=99, seq=5):
+    frame = {
+        "error": None,
+        "alerts": [],
+        "warnings": [],
+        "stats": {"chips": 0},
+    }
+    frame_raw = json.dumps(frame).encode()
+    sse_full = f"id: {cid}-{seq}\ndata: ".encode() + frame_raw + b"\n\n"
+    return Seal(
+        cid,
+        seq,
+        (1, 0, False),
+        sse_full,
+        compress_segment(sse_full),
+        None,
+        None,
+        frame_raw,
+        gzip.compress(frame_raw),
+    )
+
+
+@pytest.fixture()
+def outage_worker_facts(tmp_path):
+    """One in-process FanoutWorker with a seeded mirror and NO compose
+    process anywhere — the pure outage serving path, probed over real
+    HTTP."""
+    from aiohttp import ClientSession, web
+
+    cfg = Config(loop_lag_budget=0.0, workers=1)
+    facts = {}
+
+    async def go():
+        worker = FanoutWorker(cfg, 0, str(tmp_path))
+        seal = _mk_seal()
+        win = SealWindow(8)
+        win.append(seal)
+        worker.mirror.windows[seal.cid] = win
+        worker.mirror.bindings[""] = seal.cid
+        assert worker.compose_down  # never connected: outage from birth
+        runner = web.AppRunner(worker.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        host, port = runner.addresses[0][:2]
+        base = f"http://{host}:{port}"
+        async with ClientSession() as session:
+            async with session.get(
+                f"{base}/api/frame", headers={"Accept-Encoding": "identity"}
+            ) as r:
+                facts["frame_status"] = r.status
+                facts["frame"] = await r.json(content_type=None)
+                facts["frame_etag"] = r.headers.get("ETag")
+            async with session.get(
+                f"{base}/api/frame",
+                headers={
+                    "Accept-Encoding": "identity",
+                    "If-None-Match": facts["frame_etag"],
+                },
+            ) as r:
+                facts["revalidate_status"] = r.status
+            # gzip negotiation must ship a COMPLETE, decodable stream
+            async with session.get(
+                f"{base}/api/frame", headers={"Accept-Encoding": "gzip"}
+            ) as r:
+                facts["gzip_encoding"] = r.headers.get("Content-Encoding")
+                facts["gzip_frame"] = await r.json(content_type=None)
+            async with session.get(f"{base}/healthz") as r:
+                facts["healthz"] = await r.json(content_type=None)
+        await runner.cleanup()
+
+    _run(go())
+    return facts
+
+
+def test_outage_frame_serves_stale_with_compose_down_alert(
+    outage_worker_facts,
+):
+    f = outage_worker_facts
+    assert f["frame_status"] == 200
+    assert f["frame"]["stale"] is True
+    assert f["frame"]["alerts"][0]["rule"] == "compose_down"
+    assert f["frame_etag"].endswith('-stale"')
+    assert f["revalidate_status"] == 304
+
+
+def test_outage_frame_gzip_is_a_complete_stream(outage_worker_facts):
+    f = outage_worker_facts
+    assert f["gzip_encoding"] == "gzip"  # aiohttp auto-decompressed it
+    assert f["gzip_frame"]["stale"] is True
+
+
+def test_outage_healthz_tells_the_truth_from_the_worker(
+    outage_worker_facts,
+):
+    hz = outage_worker_facts["healthz"]
+    # ok=True: the WORKER process is alive and serving (restarting it
+    # fixes nothing); status names the actual incident
+    assert hz["ok"] is True
+    assert hz["status"] == "compose_down"
+    assert hz["worker"]["compose_down"] is True
+    assert hz["worker"]["bus"]["connected"] is False
+    assert hz["worker"]["bus"]["disconnected_s"] is not None
+
+
+def test_live_worker_frame_gzip_body_is_valid():
+    """Regression for the frame_gz encoding fix: the sealed /api/frame
+    gzip body must decode with a standard gzip decoder (a bare deflate
+    segment labeled gzip is undecodable by every real client)."""
+    seal = _mk_seal()
+    d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+    assert json.loads(d.decompress(seal.frame_gz))["error"] is None
+
+
+def test_compose_epoch_bump_is_monotonic(tmp_path):
+    from tpudash.broadcast.compose import bump_epoch
+
+    assert bump_epoch(str(tmp_path)) == 1
+    assert bump_epoch(str(tmp_path)) == 2
+    # corruption restarts the counter without crashing the compose child
+    (tmp_path / "epoch").write_text("garbage")
+    assert bump_epoch(str(tmp_path)) == 1
+
+
+def test_worker_env_round_trips_new_knobs(tmp_path):
+    from tpudash.broadcast.supervisor import worker_env
+    from tpudash.config import load_config
+
+    cfg = Config(
+        tsdb_snapshot_dir=str(tmp_path / "snaps"),
+        tsdb_snapshot_interval=30.0,
+        tsdb_follow_interval=1.5,
+    )
+    env = worker_env(cfg, str(tmp_path), 0)
+    child_cfg = load_config(env)
+    assert child_cfg.tsdb_snapshot_dir == str(tmp_path / "snaps")
+    assert child_cfg.tsdb_snapshot_interval == 30.0
+    assert child_cfg.tsdb_follow_interval == 1.5
+    assert child_cfg.broadcast_bus == str(tmp_path)

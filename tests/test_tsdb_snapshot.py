@@ -1,0 +1,416 @@
+"""Snapshot + follower tests (ISSUE 8): online snapshot consistency,
+restore-or-refuse validation, retention-aware GC, read-only mode, and
+the follower's tail-through-reclaim replication contract."""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpudash.tsdb import FLEET_SERIES, TSDB
+from tpudash.tsdb.follower import FollowerTSDB
+from tpudash.tsdb.snapshot import (
+    MANIFEST_NAME,
+    SnapshotError,
+    gc_snapshots,
+    list_snapshots,
+    read_manifest,
+    restore_snapshot,
+    take_snapshot,
+    verify_snapshot,
+    write_manifest,
+)
+
+KEYS = [f"slice-0/{i}" for i in range(4)] + [FLEET_SERIES]
+COLS = ["tensorcore_utilization", "hbm_usage_ratio"]
+
+
+def _fill(store: TSDB, n: int = 40, t0: "float | None" = None) -> float:
+    base = time.time() - 600.0 if t0 is None else t0
+    for i in range(n):
+        mat = np.full((len(KEYS), len(COLS)), float(i % 50), dtype=np.float32)
+        store.append_frame(base + 5.0 * i, KEYS, COLS, mat)
+    store.flush(seal_partial=True)
+    return base
+
+
+@pytest.fixture()
+def leader(tmp_path):
+    store = TSDB(path=str(tmp_path / "store"), chunk_points=8)
+    _fill(store)
+    return store
+
+
+# -- snapshot + restore ------------------------------------------------------
+
+
+def test_snapshot_restore_round_trip(leader, tmp_path):
+    snap = take_snapshot(leader, str(tmp_path / "snaps"))
+    assert snap["files"] >= 1 and snap["bytes"] > 0
+    dest = str(tmp_path / "restored")
+    restore_snapshot(snap["dir"], dest)
+    restored = TSDB(path=dest, read_only=True)
+    assert restored.stats()["raw_points"] == leader.stats()["raw_points"]
+    # the restored store answers the same question identically
+    lo, hi = leader.earliest_ms(0), leader.latest_ms()
+    for col in COLS:
+        assert restored.raw_window(KEYS[0], col, lo, hi) == (
+            leader.raw_window(KEYS[0], col, lo, hi)
+        )
+
+
+def test_snapshot_refuses_memory_only_store():
+    with pytest.raises(SnapshotError, match="memory-only"):
+        take_snapshot(TSDB(), "/tmp/nowhere")
+
+
+def test_restore_refuses_nonempty_destination(leader, tmp_path):
+    snap = take_snapshot(leader, str(tmp_path / "snaps"))
+    dest = tmp_path / "restored"
+    dest.mkdir()
+    (dest / "existing.seg").write_bytes(b"data")
+    with pytest.raises(SnapshotError, match="not empty"):
+        restore_snapshot(snap["dir"], str(dest))
+
+
+def test_restore_refuses_torn_segment(leader, tmp_path):
+    snap = take_snapshot(leader, str(tmp_path / "snaps"))
+    seg = next(
+        n for n in os.listdir(snap["dir"]) if n.endswith(".seg")
+    )
+    path = os.path.join(snap["dir"], seg)
+    data = open(path, "rb").read()
+    # break the hardlink first: a truncate through the link would
+    # corrupt the source store, which is not the scenario under test
+    os.unlink(path)
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(SnapshotError, match="torn"):
+        restore_snapshot(snap["dir"], str(tmp_path / "restored"))
+    assert not os.path.exists(tmp_path / "restored" / seg)
+
+
+def test_restore_refuses_crc_mismatch(leader, tmp_path):
+    snap = take_snapshot(leader, str(tmp_path / "snaps"))
+    seg = next(n for n in os.listdir(snap["dir"]) if n.endswith(".seg"))
+    path = os.path.join(snap["dir"], seg)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    os.unlink(path)  # break the hardlink, keep the source store intact
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(SnapshotError, match="CRC mismatch"):
+        verify_snapshot(snap["dir"])
+
+
+def test_restore_refuses_bad_manifest(leader, tmp_path):
+    snap = take_snapshot(leader, str(tmp_path / "snaps"))
+    path = os.path.join(snap["dir"], MANIFEST_NAME)
+    data = bytearray(open(path, "rb").read())
+    data[6] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(SnapshotError, match="magic/CRC"):
+        read_manifest(snap["dir"])
+    # a manifest-less dir (a kill mid-snapshot's staging leftover) is
+    # not a snapshot at all
+    os.unlink(path)
+    with pytest.raises(SnapshotError, match="no readable manifest"):
+        restore_snapshot(snap["dir"], str(tmp_path / "r2"))
+
+
+def test_disk_full_mid_snapshot_degrades_cleanly(
+    leader, tmp_path, monkeypatch
+):
+    """ENOSPC while hardlinking: SnapshotError, and NO husk left behind
+    that restore (or GC's keep-count) could mistake for a snapshot."""
+    root = str(tmp_path / "snaps")
+
+    def full_link(src, dst):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(os, "link", full_link)
+    with pytest.raises(SnapshotError, match="No space left"):
+        take_snapshot(leader, root)
+    monkeypatch.undo()
+    assert list_snapshots(root) == []
+    assert [n for n in os.listdir(root) if not n.startswith(".")] == []
+    # the store itself is unharmed and snapshots again once space returns
+    snap = take_snapshot(leader, root)
+    assert verify_snapshot(snap["dir"])["files"]
+
+
+def test_snapshot_during_active_sealing_is_point_in_time(tmp_path):
+    """A snapshot taken while an appender hammers the store restores a
+    consistent prefix: every restored segment CRC-walks cleanly (no
+    torn record — sizes captured under the segment-I/O lock land on
+    record boundaries)."""
+    store = TSDB(path=str(tmp_path / "store"), chunk_points=4)
+    base = _fill(store, 12)
+    stop = threading.Event()
+
+    def hammer():
+        i = 12
+        while not stop.is_set():
+            mat = np.full((len(KEYS), len(COLS)), float(i), dtype=np.float32)
+            store.append_frame(base + 5.0 * i, KEYS, COLS, mat)
+            store.flush()
+            i += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        snaps = [
+            take_snapshot(store, str(tmp_path / "snaps")) for _ in range(3)
+        ]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    for i, snap in enumerate(snaps):
+        dest = str(tmp_path / f"restored-{i}")
+        restore_snapshot(snap["dir"], dest)
+        sizes = {
+            n: os.path.getsize(os.path.join(dest, n))
+            for n in os.listdir(dest)
+            if n.endswith(".seg")
+        }
+        restored = TSDB(path=dest)  # would TRUNCATE any torn tail...
+        after = {
+            n: os.path.getsize(os.path.join(dest, n)) for n in sizes
+        }
+        assert sizes == after, "snapshot captured a mid-record tear"
+        assert restored.stats()["raw_points"] > 0
+
+
+def test_snapshot_gc_keep_and_retention(leader, tmp_path):
+    root = str(tmp_path / "snaps")
+    for _ in range(4):
+        take_snapshot(leader, root)
+        time.sleep(0.01)
+    snaps = list_snapshots(root)
+    assert len(snaps) == 4
+    gc_snapshots(root, keep=2)
+    assert list_snapshots(root) == snaps[-2:]
+    # age-based retention: backdate the older survivor's manifest —
+    # the newest always survives, however old
+    old, newest = list_snapshots(root)
+    doc = read_manifest(old)
+    doc["created_ms"] = int((time.time() - 7200) * 1000)
+    write_manifest(os.path.join(old, MANIFEST_NAME), doc)
+    doc2 = read_manifest(newest)
+    doc2["created_ms"] = int((time.time() - 7200) * 1000)
+    write_manifest(os.path.join(newest, MANIFEST_NAME), doc2)
+    gc_snapshots(root, keep=10, retention_s=3600.0)
+    assert list_snapshots(root) == [newest]
+
+
+def test_autosnapshot_from_seal_thread(tmp_path):
+    store = TSDB(
+        path=str(tmp_path / "store"),
+        chunk_points=4,
+        snapshot_dir=str(tmp_path / "snaps"),
+        snapshot_interval_s=0.01,
+    )
+    _fill(store, 12)
+    time.sleep(0.05)
+    _fill(store, 12, t0=time.time() - 300.0)
+    assert store.snapshots_taken >= 1
+    assert store.last_snapshot_error is None
+    assert list_snapshots(str(tmp_path / "snaps"))
+    snaps = store.stats()["snapshots"]
+    assert snaps["taken"] == store.snapshots_taken
+    assert snaps["last"]["files"] >= 1
+
+
+# -- read-only mode ----------------------------------------------------------
+
+
+def test_read_only_store_never_truncates_or_appends(tmp_path):
+    store = TSDB(path=str(tmp_path / "store"), chunk_points=8)
+    _fill(store)
+    seg = sorted(
+        n for n in os.listdir(tmp_path / "store") if n.startswith("raw-")
+    )[-1]
+    path = str(tmp_path / "store" / seg)
+    with open(path, "ab") as f:
+        f.write(b"TORNTAILGARBAGE")
+    size_with_tear = os.path.getsize(path)
+    ro = TSDB(path=str(tmp_path / "store"), read_only=True)
+    assert os.path.getsize(path) == size_with_tear  # untouched
+    points = ro.stats()["raw_points"]
+    assert points > 0
+    ro.append_frame(time.time(), KEYS, COLS, np.zeros((len(KEYS), len(COLS))))
+    assert ro.stats()["raw_points"] == points  # appends are inert
+    assert ro.stats()["read_only"] is True
+    # a WRITABLE open is the one that truncates the torn tail
+    TSDB(path=str(tmp_path / "store"))
+    assert os.path.getsize(path) < size_with_tear
+
+
+# -- follower ----------------------------------------------------------------
+
+
+def test_follower_tails_live_growth(tmp_path):
+    leader = TSDB(path=str(tmp_path / "l"), chunk_points=8)
+    _fill(leader, 24)
+    follower = FollowerTSDB(str(tmp_path / "l"), poll_interval_s=30.0)
+    assert follower.stats()["raw_points"] == leader.stats()["raw_points"]
+    assert follower.replication["connected"] is True
+    assert follower.replication["caught_up"] is True
+    assert follower.replication["lag_s"] is not None
+    # leader grows; one poll picks up exactly the increment
+    _fill(leader, 16, t0=time.time() - 200.0)
+    follower.poll()
+    assert follower.stats()["raw_points"] == leader.stats()["raw_points"]
+    rep = follower.stats()["replication"]
+    assert rep["records_applied"] > 0 and rep["data_age_s"] is not None
+    follower.close()
+
+
+def test_follower_survives_leader_segment_reclaim(tmp_path, monkeypatch):
+    """The leader's retention deletes whole segment files out from under
+    the tail; the follower keeps everything it already applied and keeps
+    tailing what remains."""
+    import tpudash.tsdb.store as storemod
+
+    monkeypatch.setattr(storemod, "_SEG_MAX_BYTES", 2000)
+    leader = TSDB(
+        path=str(tmp_path / "l"),
+        chunk_points=4,
+        retention_raw_s=30.0,
+        retention_1m_s=30.0,
+        retention_10m_s=30.0,
+    )
+    # old data: already past retention, lands in soon-reclaimed files
+    _fill(leader, 24, t0=time.time() - 3000.0)
+    follower = FollowerTSDB(
+        str(tmp_path / "l"),
+        poll_interval_s=30.0,
+        # follower retention intentionally LONGER: applied data outlives
+        # the leader's reclaim
+        retention_raw_s=86400.0,
+    )
+    applied = follower.stats()["raw_points"]
+    assert applied == 24
+    # fresh appends trigger the leader's retention sweep → whole-file
+    # reclaim of the expired segments
+    _fill(leader, 12, t0=time.time() - 120.0)
+    follower.poll()
+    rep = follower.replication
+    assert rep["files_reclaimed"] > 0
+    assert rep["stuck_files"] == []
+    # nothing applied was lost, the fresh tail arrived
+    assert follower.stats()["raw_points"] == 36
+
+
+def test_follower_waits_out_incomplete_frames(tmp_path):
+    leader = TSDB(path=str(tmp_path / "l"), chunk_points=8)
+    _fill(leader, 16)
+    seg = sorted(
+        n for n in os.listdir(tmp_path / "l") if n.startswith("raw-")
+    )[-1]
+    path = str(tmp_path / "l" / seg)
+    whole = open(path, "rb").read()
+    # simulate the leader mid-write: chop the final record in half
+    with open(path, "wb") as f:
+        f.write(whole[: len(whole) - 40])
+    follower = FollowerTSDB(str(tmp_path / "l"), poll_interval_s=30.0)
+    before = follower.stats()["raw_points"]
+    assert follower.replication["stuck_files"] == []
+    # the "write" completes; the next poll applies the finished record
+    with open(path, "wb") as f:
+        f.write(whole)
+    follower.poll()
+    assert follower.stats()["raw_points"] > before
+    assert follower.replication["stuck_files"] == []
+
+
+def test_follower_poisons_corrupt_record_without_spinning(tmp_path):
+    leader = TSDB(path=str(tmp_path / "l"), chunk_points=8)
+    _fill(leader, 16)
+    seg = sorted(
+        n for n in os.listdir(tmp_path / "l") if n.startswith("raw-")
+    )[0]
+    path = str(tmp_path / "l" / seg)
+    data = bytearray(open(path, "rb").read())
+    data[20] ^= 0xFF  # corrupt INSIDE the first record's payload
+    open(path, "wb").write(bytes(data))
+    follower = FollowerTSDB(str(tmp_path / "l"), poll_interval_s=30.0)
+    assert seg in follower.replication["stuck_files"]
+    assert follower.replication["caught_up"] is False
+    # polls don't reattempt the poisoned offset forever
+    off_before = follower._tails[seg][0]
+    follower.poll()
+    assert follower._tails[seg][0] == off_before
+
+
+def test_follower_serves_service_range_queries(tmp_path):
+    """TPUDASH_TSDB_FOLLOW end to end at the service layer: the
+    dashboard serves /api/range from the standby and never ingests."""
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import SyntheticSource
+    from tpudash.tsdb.query import range_query
+
+    leader = TSDB(path=str(tmp_path / "l"), chunk_points=8)
+    base = _fill(leader, 24)
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=8,
+        tsdb_follow=str(tmp_path / "l"),
+        tsdb_follow_interval=30.0,
+    )
+    svc = DashboardService(cfg, SyntheticSource(num_chips=8))
+    try:
+        assert svc.tsdb is not None and svc.tsdb.read_only
+        points_before = svc.tsdb.stats()["raw_points"]
+        svc.refresh_data()
+        svc.render_frame()
+        # the frame pipeline ran its ingest mirror — inert on a follower
+        assert svc.tsdb.stats()["raw_points"] == points_before
+        res = range_query(svc.tsdb, KEYS[0], cols=[COLS[0]], start_s=base)
+        assert res["series"][COLS[0]]
+    finally:
+        svc.close_tsdb()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_snapshot_restore_follow(tmp_path, capsys):
+    from tpudash.tsdb.__main__ import main
+
+    store = TSDB(path=str(tmp_path / "store"), chunk_points=8)
+    _fill(store)
+    rc = main(
+        ["snapshot", "--dir", str(tmp_path / "store"), "--out",
+         str(tmp_path / "snaps")]
+    )
+    assert rc == 0
+    snap_doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rc = main(
+        ["restore", "--snapshot", snap_doc["dir"], "--dir",
+         str(tmp_path / "restored")]
+    )
+    assert rc == 0
+    restored_doc = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1]
+    )
+    assert restored_doc["stats"]["raw_points"] == store.stats()["raw_points"]
+    # restore into the now-NON-empty dir refuses with a nonzero exit
+    rc = main(
+        ["restore", "--snapshot", snap_doc["dir"], "--dir",
+         str(tmp_path / "restored")]
+    )
+    assert rc == 1
+    assert "refused" in capsys.readouterr().err
+    rc = main(["follow", "--leader", str(tmp_path / "store")])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    stats = json.loads(lines[-1])
+    assert stats["replication"]["connected"] is True
+    assert stats["raw_points"] == store.stats()["raw_points"]

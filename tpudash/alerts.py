@@ -54,11 +54,15 @@ def sort_alerts(alerts: "list[dict]") -> "list[dict]":
     return alerts
 
 #: Rule names synthesized OUTSIDE the engine — service-level conditions
-#: (a quarantined endpoint, the server shedding load) shaped like engine
-#: output so silences, the webhook pager, and the banner treat them
-#: exactly like a breaching chip.  The service strips and re-synthesizes
-#: these on every publish; engine rules never collide with them.
-SYNTHESIZED_RULES = ("endpoint_down", "overload")
+#: (a quarantined endpoint, the server shedding load, the worker tier's
+#: compose process being down) shaped like engine output so silences,
+#: the webhook pager, and the banner treat them exactly like a breaching
+#: chip.  The service strips and re-synthesizes ``endpoint_down`` and
+#: ``overload`` on every publish; ``compose_down`` is synthesized by the
+#: fan-out workers while they serve stale mirrors through a compose
+#: outage (tpudash/broadcast/worker.py) — it can never originate from
+#: the compose process, which is the thing that is down.
+SYNTHESIZED_RULES = ("endpoint_down", "overload", "compose_down")
 
 
 def synthesized_alert(

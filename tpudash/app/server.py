@@ -776,7 +776,34 @@ class DashboardServer:
             summary["tsdb"] = await loop.run_in_executor(
                 None, self.service.tsdb.stats
             )
+        summary["tier"] = self._tier_doc(summary.get("tsdb"))
         return _json_response(summary)
+
+    def _tier_doc(self, tsdb_stats: "dict | None" = None) -> dict:
+        """The process tier, observable in one key: supervised-child
+        restart bookkeeping (worker mode) and the standby's replication
+        lag (follower mode) — the numbers the crash-anything runbook
+        alerts on."""
+        tier: dict = {
+            "mode": "single" if self.workers_provider is None else "workers",
+            "restarts": 0,
+        }
+        if self.workers_provider is not None:
+            wd = self.workers_provider()
+            tier["restarts"] = wd.get("restarts", 0)
+            tier["configured"] = wd.get("configured")
+            bus = wd.get("bus") or {}
+            tier["workers_connected"] = len(bus.get("workers") or [])
+            children = wd.get("children")
+            if children is None and isinstance(wd.get("supervisor"), dict):
+                children = wd["supervisor"].get("children")
+            if children:
+                tier["children"] = children
+        if tsdb_stats and tsdb_stats.get("replication"):
+            rep = tsdb_stats["replication"]
+            tier["replication_lag_s"] = rep.get("lag_s")
+            tier["replication_caught_up"] = rep.get("caught_up")
+        return tier
 
     async def profile(self, request: web.Request) -> web.Response:
         """On-demand profiling (tracing, SURVEY.md §5 — the reference has
@@ -1412,14 +1439,38 @@ class DashboardServer:
                 if status == "healthy"
                 else f"{status}+{overload['state']}"
             )
-        return _json_response(
-            {"ok": True, "status": status,
-             "source": self.service.source.name,
-             "error": self.service.last_error,
-             "overload": overload,
-             "loop_lag_ms": self.loop_monitor.summary(),
-             "source_health": health}
-        )
+        doc = {"ok": True, "status": status,
+               "source": self.service.source.name,
+               "error": self.service.last_error,
+               "overload": overload,
+               "loop_lag_ms": self.loop_monitor.summary(),
+               "source_health": health}
+        if self.workers_provider is not None:
+            # worker-tier liveness folds in the same way overload does:
+            # a mirror-less tier is serving NOBODY even though this
+            # compose process is perfectly healthy
+            wd = self.workers_provider()
+            bus = wd.get("bus") or {}
+            connected = len(bus.get("workers") or [])
+            configured = int(wd.get("configured") or 0)
+            doc["tier"] = {
+                "mode": wd.get("mode", "workers"),
+                "configured": configured,
+                "workers_connected": connected,
+                "restarts": wd.get("restarts", 0),
+            }
+            if configured and connected < configured:
+                doc["status"] = status = (
+                    "workers_down"
+                    if status == "healthy"
+                    else f"{status}+workers_down"
+                )
+        # follower (hot-standby) mode: replication state is a plain
+        # attribute read — /healthz stays lock-free and never-shed
+        rep = getattr(self.service.tsdb, "replication", None)
+        if rep is not None:
+            doc["replication"] = rep
+        return _json_response(doc)
 
     async def workers_api(self, request: web.Request) -> web.Response:
         """The broadcast plane's worker tier, observable: per-worker pids,

@@ -191,7 +191,23 @@ class DashboardService:
         from tpudash.tsdb import TSDB
 
         try:
-            self.tsdb: "TSDB | None" = TSDB.from_config(cfg)
+            if cfg.tsdb_follow:
+                # follower (hot-standby) mode: tail another instance's
+                # segment directory read-only — /api/range, sparklines,
+                # and drill-downs serve from the standby with a measured
+                # replication lag; local ingest is inert by contract
+                from tpudash.tsdb.follower import FollowerTSDB
+
+                if cfg.tsdb_path:
+                    log.warning(
+                        "TPUDASH_TSDB_FOLLOW set: ignoring TPUDASH_TSDB_PATH"
+                        " — a follower never writes segments of its own"
+                    )
+                follower = FollowerTSDB.from_config(cfg)
+                follower.start()
+                self.tsdb: "TSDB | None" = follower
+            else:
+                self.tsdb = TSDB.from_config(cfg)
         except Exception as e:  # noqa: BLE001 — history tier is best-effort
             log.warning("tsdb unavailable: %s", e)
             self.tsdb = None
@@ -735,6 +751,8 @@ class DashboardService:
         tsdb = self.tsdb
         if tsdb is None or (not self.history and not self.chip_history):
             return
+        if getattr(tsdb, "read_only", False):
+            return  # a follower's truth is the leader's segments
         try:
             if tsdb.stats()["raw_points"]:
                 return  # segments already carry history
@@ -786,8 +804,8 @@ class DashboardService:
         FLEET_SERIES pseudo-row carrying the zero-exclusion averages.
         Never fails a frame."""
         tsdb = self.tsdb
-        if tsdb is None:
-            return
+        if tsdb is None or getattr(tsdb, "read_only", False):
+            return  # a follower never originates data
         try:
             from tpudash.tsdb import FLEET_SERIES
 

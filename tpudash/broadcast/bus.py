@@ -382,6 +382,19 @@ class BusMirror:
         self.windows: "dict[int, SealWindow]" = {}
         self.bindings: "dict[str, int]" = {}
         self.connected = False
+        #: monotonic stamp of the moment the publisher link was lost
+        #: (None while connected; set once per outage).  The worker's
+        #: compose-outage degrade reads this to report how long it has
+        #: been serving from its last mirrors.
+        self.disconnected_since: "float | None" = time.monotonic()
+        #: bumped on every publisher hello (fresh snapshot universe).  A
+        #: RESTARTED compose starts with an empty cohort hub and an
+        #: empty binding map — long-lived worker SSE loops watch this
+        #: counter and re-resolve their session once per hello, which is
+        #: what re-creates (and re-seals) their cohort compose-side;
+        #: without it a stream that never reconnects would idle on
+        #: keepalives forever after a compose crash.
+        self.hello_count = 0
         self._refs: "dict[int, int]" = {}
         self._update = asyncio.Event()
         self.counters = {
@@ -432,6 +445,8 @@ class BusMirror:
             except BusProtocolError as e:
                 self.counters["protocol_errors"] += 1
                 log.warning("bus protocol error, resyncing: %s", e)
+            if self.connected or self.disconnected_since is None:
+                self.disconnected_since = time.monotonic()
             self.connected = False
             self.counters["reconnects"] += 1
             await asyncio.sleep(0.5)
@@ -475,6 +490,8 @@ class BusMirror:
             self.windows.clear()
             self.bindings.clear()
             self.connected = True
+            self.disconnected_since = None
+            self.hello_count += 1
         elif kind == "seal":
             seal = decode_seal(header, body)
             win = self.windows.get(seal.cid)
@@ -509,6 +526,11 @@ class BusMirror:
     def stats(self) -> dict:
         return {
             "connected": self.connected,
+            "disconnected_s": (
+                round(time.monotonic() - self.disconnected_since, 1)
+                if self.disconnected_since is not None
+                else None
+            ),
             "cohorts": len(self.windows),
             "bindings": len(self.bindings),
             "active": len(self._refs),

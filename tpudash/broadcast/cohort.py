@@ -27,6 +27,7 @@ the exact delta chain it missed — from this process or any bus mirror.
 
 from __future__ import annotations
 
+import gzip
 import time
 import zlib
 from collections import OrderedDict
@@ -234,6 +235,15 @@ class CohortHub:
         #: the newest frame sealed for ANY cohort (the shed path's
         #: degraded /api/frame body rides it)
         self.last_frame: "dict | None" = None
+        #: seq floor for newly-created cohorts: a RESTARTED compose
+        #: process (crash-anything mode: the supervisor respawns it)
+        #: must hand out seqs above everything its predecessor ever
+        #: sealed — mirrors and clients hold (cid, seq) acks across the
+        #: outage, and a recycled seq would let a stale ack alias a
+        #: wrong-base delta chain.  The compose entry point sets this
+        #: from a persisted per-bus epoch counter; 0 in single-process
+        #: mode (a full-process restart resets clients too).
+        self.seq_base = 0
         self.counters = {
             "cohorts_created": 0,
             "cohorts_evicted": 0,
@@ -308,7 +318,9 @@ class CohortHub:
             if lru_evicted and self.on_evict is not None:
                 self.on_evict(lru_evicted)
             cohort = self._cohorts[key] = Cohort(key, self.window)
-            cohort.seq = self._retired_seqs.pop(cohort.cid, 0)
+            cohort.seq = max(
+                self._retired_seqs.pop(cohort.cid, 0), self.seq_base
+            )
             self.counters["cohorts_created"] += 1
         else:
             self._cohorts.move_to_end(key)
@@ -393,7 +405,11 @@ class CohortHub:
             sse_delta_raw,
             sse_delta_gz,
             frame_raw,
-            compress_segment(frame_raw),
+            # a COMPLETE gzip stream, not a shared segment: frame_gz is
+            # only ever a standalone /api/frame response body, and a
+            # bare full-flushed deflate segment labeled Content-Encoding
+            # gzip is undecodable by every real client (no header)
+            gzip.compress(frame_raw, 6),
         )
         cohort.prev_frame = frame
         self.last_frame = frame

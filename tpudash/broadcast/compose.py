@@ -1,0 +1,134 @@
+"""The compose child: the one scraping/sealing process, run as a
+SUPERVISED, restartable member of the worker tier.
+
+``python -m tpudash.broadcast.compose`` — spawned by the
+:class:`~tpudash.broadcast.supervisor.TierSupervisor`, never by hand.
+It reconstructs its :class:`~tpudash.config.Config` from the registry
+round-tripped environment (the same contract fan-out workers use),
+builds the full :class:`DashboardServer` — which is the crash-recovery
+path working as designed: ``DashboardService.__init__`` reloads the
+tsdb segment set (torn tails truncated), the persisted UI state,
+browser sessions, and silences from disk — and then runs the
+:class:`~tpudash.broadcast.supervisor.ComposePlane` (private unix API
+site + frame-bus publisher + seal ticker).
+
+Two restart-specific duties beyond what the embedded supervisor did:
+
+- **Epoch bump**: every compose start increments ``<bus>/epoch`` and
+  floors all seal seq numbering at ``epoch * 10^9``
+  (:attr:`CohortHub.seq_base`).  Workers and clients hold ``(cid,
+  seq)`` acks ACROSS a compose outage; if the replacement re-issued low
+  seqs for the same content-addressed cohort ids, a stale ack could
+  alias a wrong-base delta chain — with the floor, every stale ack
+  lands outside the new window and resolves to a clean full-frame
+  re-init, while the mirrors' retained windows keep serving delta
+  resumes DURING the outage.
+- **Stale-socket recovery**: a SIGKILLed predecessor leaves its
+  ``bus.sock``/``api.sock`` inodes behind; the plane unlinks them
+  before binding, so the replacement always comes up.
+
+The bus publisher then re-snapshots every worker the moment its mirror
+reconnects (hello + retained seals + binding map) — no worker restart,
+no client disconnect required.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import signal
+import sys
+
+from tpudash.config import Config, configure_logging, load_config
+
+from tpudash.broadcast.supervisor import EPOCH_FILE, ComposePlane
+
+log = logging.getLogger(__name__)
+
+#: seq room per compose incarnation: ~8 years of 4 Hz seals before two
+#: epochs could touch — far beyond any single process lifetime
+_EPOCH_SPAN = 1_000_000_000
+
+
+def bump_epoch(bus_dir: str) -> int:
+    """Read-increment-write the bus-scoped compose epoch (atomic rename;
+    an unreadable/corrupt counter restarts at 1 — losing the count is
+    fine as long as THIS write lands before any seal is published,
+    because workers cleared their windows on the new hello anyway)."""
+    path = os.path.join(bus_dir, EPOCH_FILE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            current = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        current = 0
+    nxt = current + 1
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(str(nxt))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # fsync the DIRECTORY too: without it a power loss can undo the
+    # rename, roll the epoch back, and let the next compose re-issue a
+    # predecessor's seal-seq range — the aliasing this counter exists
+    # to prevent
+    with contextlib.suppress(OSError):
+        fd = os.open(bus_dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    return nxt
+
+
+async def _serve(cfg: Config, server, bus_dir: str) -> None:
+    plane = ComposePlane(cfg, server, bus_dir)
+    server.workers_provider = plane.workers_doc
+    await plane.start()
+    log.info(
+        "compose child up (pid %d, hub seq base %d) on %s",
+        os.getpid(),
+        server.hub.seq_base,
+        bus_dir,
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    try:
+        await stop.wait()
+    finally:
+        await plane.stop()
+
+
+def main() -> None:
+    configure_logging()
+    cfg = load_config()
+    bus_dir = cfg.broadcast_bus
+    if not bus_dir:
+        print(
+            "tpudash compose child: TPUDASH_BROADCAST_BUS must point at "
+            "the supervisor's bus directory",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.sources import make_source
+
+    # blocking construction (tsdb segment replay, state/session restore,
+    # history load) happens here, before any event loop exists — and on
+    # EVERY restart, which is the "reload the store and session state"
+    # half of the crash contract
+    service = DashboardService(cfg, make_source(cfg))
+    server = DashboardServer(service)
+    server.hub.seq_base = bump_epoch(bus_dir) * _EPOCH_SPAN
+    with contextlib.suppress(KeyboardInterrupt):
+        asyncio.run(_serve(cfg, server, bus_dir))
+
+
+if __name__ == "__main__":  # pragma: no cover - process entry
+    main()

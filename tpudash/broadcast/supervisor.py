@@ -1,21 +1,39 @@
-"""Layer 2 control: the worker-tier supervisor.
+"""Layer 2 control: the worker-tier supervisors.
 
 ``TPUDASH_WORKERS=N`` turns ``python -m tpudash`` into a supervised
-process tree:
+process TREE in which every stateful process — including the compose
+process — is a restartable child:
 
-- **compose process** (this one): the full :class:`DashboardServer` —
-  scraping, normalizing, alerting, tsdb — bound to a PRIVATE unix
-  socket (``api.sock``) instead of TCP, plus the
+- **supervisor** (this process, :class:`TierSupervisor`): a thin parent
+  that owns the bus directory and nothing else.  It spawns the compose
+  child and N fan-out workers, restarts whichever dies (exponential
+  backoff that RESETS once a child survives 30 s), and journals every
+  spawn/exit into ``<bus>/supervisor.json`` — the status the compose
+  child surfaces on ``GET /api/workers`` and ``/api/timings``;
+- **compose child** (``tpudash.broadcast.compose``): the full
+  :class:`DashboardServer` — scraping, normalizing, alerting, tsdb —
+  bound to a PRIVATE unix socket (``api.sock``), plus the
   :class:`~tpudash.broadcast.bus.BusPublisher` (``bus.sock``) and a
   ticker that refreshes data and seals every live cohort once per
-  refresh interval;
+  refresh interval (the :class:`ComposePlane` bundle);
 - **N fan-out workers** (``tpudash.broadcast.worker``): stateless
   SO_REUSEPORT processes on the public port, serving SSE/``/api/frame``
-  from bus mirrors and proxying everything else here.
+  from bus mirrors and proxying everything else to the compose child.
 
-Crashed workers are restarted with a small backoff (their clients'
-EventSources reconnect to a surviving worker and resume by event id —
-the seal window lives in every mirror, not in the process that died).
+Crash-anything contract: a crashed WORKER loses nothing — its clients'
+EventSources reconnect to a surviving worker and resume by event id
+(the seal window lives in every mirror).  A crashed COMPOSE degrades,
+never darkens: workers keep serving ``/api/frame`` (marked
+``stale: true`` with a synthesized ``compose_down`` alert) and
+``/api/stream`` (retained mirrors + keepalives) through the outage; the
+restarted compose reloads the tsdb and session state from disk, bumps
+the bus epoch so its seal seqs can never alias its predecessor's, and
+re-snapshots every worker over the bus.  ``python -m tpudash.chaos
+killall`` SIGKILLs both mid-storm and asserts all of it.
+
+:class:`Supervisor` (compose embedded in the supervising process) is
+retained for in-process drills and tests that need direct access to the
+server object; production (``run_supervised``) uses the process tree.
 
 **Fail fast, never fall back**: a platform without ``SO_REUSEPORT`` or
 an unusable bus path aborts startup with an actionable error.  A silent
@@ -28,12 +46,14 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import dataclasses
+import json
 import logging
 import os
 import signal
 import socket as socketmod
 import sys
 import tempfile
+import time
 
 from tpudash.config import Config, _ENV_MAP, configure_logging
 
@@ -41,14 +61,33 @@ from tpudash.broadcast.worker import API_SOCK, BUS_SOCK
 
 log = logging.getLogger(__name__)
 
-#: seconds between a worker's death and its replacement (first restart;
+#: seconds between a child's death and its replacement (first restart;
 #: doubles per consecutive crash up to _RESTART_MAX)
 _RESTART_BACKOFF = 0.5
 _RESTART_MAX = 10.0
+#: a child that survived this long before dying crashed for a NEW
+#: reason, not the same boot loop — its backoff resets to the base
+#: instead of whatever ceiling an incident hours ago left behind
+_BACKOFF_RESET_S = 30.0
+
+#: the supervisor's spawn/exit journal inside the bus directory — the
+#: compose child reads it for /api/workers and the /api/timings tier key
+STATUS_FILE = "supervisor.json"
+#: compose-restart epoch counter inside the bus directory — bumped by
+#: every compose start so seal seq numbering can never reuse a
+#: predecessor's range (tpudash/broadcast/compose.py)
+EPOCH_FILE = "epoch"
 
 
 class BroadcastSetupError(Exception):
     """The worker tier cannot start here — message says why and what to do."""
+
+
+def reset_backoff(backoff: float, alive_s: float) -> float:
+    """The restart-backoff policy, shared by both supervisors: a child
+    that proved itself (alive >= 30 s) starts over at the base backoff;
+    a boot-looping one keeps its current (doubling) penalty."""
+    return _RESTART_BACKOFF if alive_s >= _BACKOFF_RESET_S else backoff
 
 
 def preflight(cfg: Config, socket_mod=socketmod) -> str:
@@ -116,7 +155,7 @@ def preflight(cfg: Config, socket_mod=socketmod) -> str:
 
 
 def worker_env(cfg: Config, bus_dir: str, index: int) -> dict:
-    """The exact environment a worker needs to reconstruct ``cfg`` with
+    """The exact environment a child needs to reconstruct ``cfg`` with
     ``load_config()`` — every registry-mapped field serialized back to
     its env var, so a cfg built programmatically (tests, drills) still
     reaches the child intact."""
@@ -135,31 +174,57 @@ def worker_env(cfg: Config, bus_dir: str, index: int) -> dict:
     return env
 
 
-class Supervisor:
-    def __init__(
-        self, cfg: Config, server, bus_dir: str, log_dir: "str | None" = None
-    ):
+class ChildInfo:
+    """Restart bookkeeping for one supervised slot (embedded worker or
+    process-tree child) — what ``/api/workers`` surfaces per child."""
+
+    __slots__ = ("name", "pid", "restarts", "last_exit_rc", "last_restart_ts",
+                 "backoff")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pid: "int | None" = None
+        self.restarts = 0
+        self.last_exit_rc: "int | None" = None
+        self.last_restart_ts: "float | None" = None
+        self.backoff = _RESTART_BACKOFF
+
+    def doc(self) -> dict:
+        return {
+            "pid": self.pid,
+            "restarts": self.restarts,
+            "last_exit_rc": self.last_exit_rc,
+            "last_restart_ts": self.last_restart_ts,
+        }
+
+
+class ComposePlane:
+    """The compose process's worker-tier plumbing, one bundle: the
+    private unix API site, the frame-bus publisher, and the seal ticker.
+    Used by BOTH the embedded :class:`Supervisor` and the process-tree
+    compose child (``tpudash.broadcast.compose``)."""
+
+    def __init__(self, cfg: Config, server, bus_dir: str):
         self.cfg = cfg
-        self.server = server  # DashboardServer (compose side)
+        self.server = server
         self.bus_dir = bus_dir
-        #: when set, each worker's stdout/stderr appends to
-        #: ``<log_dir>/worker-<index>.log`` instead of inheriting this
-        #: process's — the storm drill scans these for unhandled
-        #: exceptions in EVERY process, not just the compose one
-        self.log_dir = log_dir
         self.publisher = None
-        self._workers: "dict[int, asyncio.subprocess.Process]" = {}
+        self._runner = None
         self._tasks: "list[asyncio.Task]" = []
         self._stopping = asyncio.Event()
-        self.restarts = 0
 
-    # -- compose-side plumbing ----------------------------------------------
     async def start(self) -> None:
         from aiohttp import web
 
         from tpudash.broadcast.bus import BusPublisher
 
         server = self.server
+        # a SIGKILLed predecessor leaves its socket files behind; a bind
+        # on an existing path fails, and the replacement compose MUST
+        # come up — stale paths are unlinked, never fatal
+        for sock in (BUS_SOCK, API_SOCK):
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(self.bus_dir, sock))  # tpulint: allow[async-blocking] two tiny unlinks once per compose start, not worth an executor hop
         self.publisher = BusPublisher(
             os.path.join(self.bus_dir, BUS_SOCK),
             server.hub,
@@ -167,7 +232,8 @@ class Supervisor:
             on_active=server.hub.touch,
         )
         server.bus_publisher = self.publisher
-        server.workers_provider = self.workers_doc
+        if server.workers_provider is None:
+            server.workers_provider = self.workers_doc
         app = server.build_app()
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -175,17 +241,6 @@ class Supervisor:
         await site.start()
         await self.publisher.start()
         self._tasks.append(asyncio.ensure_future(self._ticker()))
-        for i in range(self.cfg.workers):
-            self._tasks.append(asyncio.ensure_future(self._keep_worker(i)))
-        log.info(
-            "broadcast supervisor up: compose pid %d on %s, %d worker(s) "
-            "on %s:%d",
-            os.getpid(),
-            os.path.join(self.bus_dir, API_SOCK),
-            self.cfg.workers,
-            self.cfg.host,
-            self.cfg.port,
-        )
 
     async def stop(self) -> None:
         self._stopping.set()
@@ -194,15 +249,10 @@ class Supervisor:
         for task in self._tasks:
             with contextlib.suppress(asyncio.CancelledError):
                 await task
-        for proc in self._workers.values():
-            with contextlib.suppress(ProcessLookupError):
-                proc.terminate()
-        for proc in self._workers.values():
-            with contextlib.suppress(asyncio.TimeoutError):
-                await asyncio.wait_for(proc.wait(), 5.0)
         if self.publisher is not None:
             await self.publisher.close()
-        await self._runner.cleanup()
+        if self._runner is not None:
+            await self._runner.cleanup()
 
     async def _ticker(self) -> None:
         """The worker tier's heartbeat: in single-process mode SSE loops
@@ -230,13 +280,100 @@ class Supervisor:
                 log.exception("broadcast ticker tick failed")
             await asyncio.sleep(interval)
 
+    def supervisor_status(self) -> "dict | None":
+        """The parent supervisor's spawn/exit journal, if one exists
+        (process-tree mode writes it next to the bus sockets)."""
+        path = os.path.join(self.bus_dir, STATUS_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:  # tpulint: allow[async-blocking] one tiny local JSON read per status request, not worth an executor hop
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def workers_doc(self) -> dict:
+        """The ``/api/workers`` payload for a process-tree compose child:
+        the bus view (connected mirrors, queue depths) joined with the
+        parent supervisor's journal (spawned pids, restarts, exit codes)."""
+        doc = {
+            "mode": "workers",
+            "configured": self.cfg.workers,
+            "compose_pid": os.getpid(),
+            "bus": self.publisher.stats() if self.publisher else None,
+        }
+        status = self.supervisor_status()
+        if status is not None:
+            doc["supervisor"] = status
+            doc["restarts"] = status.get("restarts_total", 0)
+        return doc
+
+
+class Supervisor:
+    """Embedded-compose supervisor: the compose plane runs in THIS
+    process (direct server access for drills/tests) while the N fan-out
+    workers are supervised children."""
+
+    def __init__(
+        self, cfg: Config, server, bus_dir: str, log_dir: "str | None" = None
+    ):
+        self.cfg = cfg
+        self.server = server  # DashboardServer (compose side)
+        self.bus_dir = bus_dir
+        #: when set, each worker's stdout/stderr appends to
+        #: ``<log_dir>/worker-<index>.log`` instead of inheriting this
+        #: process's — the storm drill scans these for unhandled
+        #: exceptions in EVERY process, not just the compose one
+        self.log_dir = log_dir
+        self.plane = ComposePlane(cfg, server, bus_dir)
+        self._workers: "dict[int, asyncio.subprocess.Process]" = {}
+        self._info: "dict[int, ChildInfo]" = {}
+        self._tasks: "list[asyncio.Task]" = []
+        self._stopping = asyncio.Event()
+        self.restarts = 0
+
+    @property
+    def publisher(self):
+        return self.plane.publisher
+
+    # -- compose-side plumbing ----------------------------------------------
+    async def start(self) -> None:
+        self.server.workers_provider = self.workers_doc
+        await self.plane.start()
+        for i in range(self.cfg.workers):
+            self._tasks.append(asyncio.ensure_future(self._keep_worker(i)))
+        log.info(
+            "broadcast supervisor up: compose pid %d on %s, %d worker(s) "
+            "on %s:%d",
+            os.getpid(),
+            os.path.join(self.bus_dir, API_SOCK),
+            self.cfg.workers,
+            self.cfg.host,
+            self.cfg.port,
+        )
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+        for proc in self._workers.values():
+            with contextlib.suppress(ProcessLookupError):
+                proc.terminate()
+        for proc in self._workers.values():
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(proc.wait(), 5.0)
+        await self.plane.stop()
+
     # -- worker lifecycle ----------------------------------------------------
     async def _keep_worker(self, index: int) -> None:
         """Spawn worker ``index`` and keep it alive: crash → log +
-        exponential-backoff restart.  Clients of the dead worker
-        reconnect (EventSource auto-retry) to any surviving worker and
-        resume by event id."""
-        backoff = _RESTART_BACKOFF
+        exponential-backoff restart (reset after 30 s of health — one
+        bad deploy hours ago must not leave a now-healthy worker on
+        max-backoff forever).  Clients of the dead worker reconnect
+        (EventSource auto-retry) to any surviving worker and resume by
+        event id."""
+        info = self._info.setdefault(index, ChildInfo(f"worker-{index}"))
         while not self._stopping.is_set():
             log_fd = None
             spawn_kwargs = {}
@@ -257,24 +394,33 @@ class Supervisor:
                 if log_fd is not None:
                     log_fd.close()  # the child holds its own duplicate
             self._workers[index] = proc
+            info.pid = proc.pid
+            started = time.monotonic()
             rc = await proc.wait()
             if self._stopping.is_set():
                 return
+            alive_s = time.monotonic() - started
             self.restarts += 1
+            info.restarts += 1
+            info.last_exit_rc = rc
+            info.last_restart_ts = time.time()  # tpulint: allow[wall-clock] restart stamps are operator-facing epoch times
+            info.backoff = reset_backoff(info.backoff, alive_s)
             log.warning(
-                "fan-out worker %d (pid %s) exited rc=%s; restarting in %.1fs",
+                "fan-out worker %d (pid %s) exited rc=%s after %.1fs; "
+                "restarting in %.1fs",
                 index,
                 proc.pid,
                 rc,
-                backoff,
+                alive_s,
+                info.backoff,
             )
-            await asyncio.sleep(backoff)
-            backoff = min(_RESTART_MAX, backoff * 2)
+            await asyncio.sleep(info.backoff)
+            info.backoff = min(_RESTART_MAX, info.backoff * 2)
 
     def workers_doc(self) -> dict:
-        """The ``/api/workers`` payload in worker mode: supervisor view
-        (spawned pids, restarts) joined with the bus view (connected
-        mirrors, queue depths)."""
+        """The ``/api/workers`` payload in embedded worker mode:
+        supervisor view (spawned pids, restarts, exit codes) joined with
+        the bus view (connected mirrors, queue depths)."""
         return {
             "mode": "workers",
             "configured": self.cfg.workers,
@@ -284,12 +430,174 @@ class Supervisor:
                 for i, p in self._workers.items()
                 if p.returncode is None
             },
+            "children": {
+                info.name: info.doc() for info in self._info.values()
+            },
             "bus": self.publisher.stats() if self.publisher else None,
         }
 
 
-async def _supervise(cfg: Config, server, bus_dir: str) -> None:
-    sup = Supervisor(cfg, server, bus_dir)
+class TierSupervisor:
+    """Process-tree supervisor: EVERY stateful process is a restartable
+    child — the compose process included.  The parent holds no frames,
+    no sessions, no store: killing any single process in the tree leaves
+    a tier that degrades (compose down → stale mirrors) or heals (worker
+    down → restart + event-id resume) but never darkens.
+
+    ``compose_backoff`` widens the compose child's FIRST restart delay —
+    production keeps the default (come back fast); the killall drill
+    stretches it so the degraded window is long enough to assert on."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        bus_dir: str,
+        log_dir: "str | None" = None,
+        compose_backoff: "float | None" = None,
+    ):
+        self.cfg = cfg
+        self.bus_dir = bus_dir
+        self.log_dir = log_dir
+        self.compose_backoff = compose_backoff
+        self._children: "dict[str, asyncio.subprocess.Process]" = {}
+        self._info: "dict[str, ChildInfo]" = {}
+        self._tasks: "list[asyncio.Task]" = []
+        self._stopping = asyncio.Event()
+        self.restarts = 0
+
+    # -- observability -------------------------------------------------------
+    def child_pid(self, name: str) -> "int | None":
+        proc = self._children.get(name)
+        return proc.pid if proc is not None and proc.returncode is None else None
+
+    def status_doc(self) -> dict:
+        return {
+            "supervisor_pid": os.getpid(),
+            "updated_ts": time.time(),  # tpulint: allow[wall-clock] journal stamps are operator-facing epoch times
+            "restarts_total": self.restarts,
+            "children": {
+                info.name: info.doc() for info in self._info.values()
+            },
+        }
+
+    def _write_status(self) -> None:
+        """Journal the tree state atomically into the bus dir — the
+        compose child serves it on /api/workers; a crashed supervisor
+        leaves the last consistent journal, never a torn one."""
+        path = os.path.join(self.bus_dir, STATUS_FILE)
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:  # tpulint: allow[async-blocking] one tiny local JSON write per child spawn/exit, not worth an executor hop
+                json.dump(self.status_doc(), f)
+            os.replace(tmp, path)  # tpulint: allow[async-blocking] atomic rename of the tiny journal, same spawn/exit cadence
+        except OSError as e:
+            log.warning("supervisor status write failed: %s", e)
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> None:
+        self._tasks.append(
+            asyncio.ensure_future(
+                self._keep_child(
+                    "compose",
+                    ["-m", "tpudash.broadcast.compose"],
+                    index=-1,
+                    first_backoff=self.compose_backoff,
+                )
+            )
+        )
+        for i in range(self.cfg.workers):
+            self._tasks.append(
+                asyncio.ensure_future(
+                    self._keep_child(
+                        f"worker-{i}",
+                        ["-m", "tpudash.broadcast.worker"],
+                        index=i,
+                    )
+                )
+            )
+        log.info(
+            "tier supervisor up (pid %d): compose child + %d worker(s) on "
+            "%s:%d, bus %s",
+            os.getpid(),
+            self.cfg.workers,
+            self.cfg.host,
+            self.cfg.port,
+            self.bus_dir,
+        )
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+        for proc in self._children.values():
+            with contextlib.suppress(ProcessLookupError):
+                proc.terminate()
+        for proc in self._children.values():
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(proc.wait(), 5.0)
+        self._write_status()
+
+    async def _keep_child(
+        self,
+        name: str,
+        argv: "list[str]",
+        index: int,
+        first_backoff: "float | None" = None,
+    ) -> None:
+        """Spawn + restart one child slot forever (same policy as the
+        embedded supervisor: exponential backoff, reset after 30 s of
+        demonstrated health), journaling every transition."""
+        info = self._info.setdefault(name, ChildInfo(name))
+        if first_backoff is not None:
+            info.backoff = max(_RESTART_BACKOFF, float(first_backoff))
+        while not self._stopping.is_set():
+            log_fd = None
+            spawn_kwargs = {}
+            if self.log_dir is not None:
+                log_fd = open(  # tpulint: allow[async-blocking] one tiny local append-open per child spawn, not worth an executor hop
+                    os.path.join(self.log_dir, f"{name}.log"), "ab"
+                )
+                spawn_kwargs = {"stdout": log_fd, "stderr": log_fd}
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable,
+                    *argv,
+                    env=worker_env(self.cfg, self.bus_dir, index),
+                    **spawn_kwargs,
+                )
+            finally:
+                if log_fd is not None:
+                    log_fd.close()  # the child holds its own duplicate
+            self._children[name] = proc
+            info.pid = proc.pid
+            self._write_status()
+            started = time.monotonic()
+            rc = await proc.wait()
+            if self._stopping.is_set():
+                return
+            alive_s = time.monotonic() - started
+            self.restarts += 1
+            info.restarts += 1
+            info.last_exit_rc = rc
+            info.last_restart_ts = time.time()  # tpulint: allow[wall-clock] restart stamps are operator-facing epoch times
+            info.backoff = reset_backoff(info.backoff, alive_s)
+            self._write_status()
+            log.warning(
+                "%s (pid %s) exited rc=%s after %.1fs; restarting in %.1fs",
+                name,
+                proc.pid,
+                rc,
+                alive_s,
+                info.backoff,
+            )
+            await asyncio.sleep(info.backoff)
+            info.backoff = min(_RESTART_MAX, info.backoff * 2)
+
+
+async def _supervise_tier(sup: TierSupervisor) -> None:
     await sup.start()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -303,20 +611,16 @@ async def _supervise(cfg: Config, server, bus_dir: str) -> None:
 
 
 def run_supervised(cfg: Config) -> None:  # pragma: no cover - blocking entry
-    """Entry point behind ``TPUDASH_WORKERS>0`` (see server.run)."""
-    from tpudash.app.server import DashboardServer
-    from tpudash.app.service import DashboardService
-    from tpudash.sources import make_source
-
+    """Entry point behind ``TPUDASH_WORKERS>0`` (see server.run): the
+    process-tree supervisor — the parent constructs NO service; the
+    compose child does all blocking setup itself (and redoes it on every
+    restart, which is exactly the crash-recovery path)."""
     configure_logging()
     try:
-        bus_dir = preflight(cfg)  # fail BEFORE paying service construction
+        bus_dir = preflight(cfg)  # fail BEFORE spawning anything
     except BroadcastSetupError as e:
         log.error("%s", e)
         raise SystemExit(2) from e
-    # blocking construction (state restore, history load) happens here,
-    # before any event loop exists — the loop only ever sees ready objects
-    service = DashboardService(cfg, make_source(cfg))
-    server = DashboardServer(service)
+    sup = TierSupervisor(cfg, bus_dir)
     with contextlib.suppress(KeyboardInterrupt):
-        asyncio.run(_supervise(cfg, server, bus_dir))
+        asyncio.run(_supervise_tier(sup))

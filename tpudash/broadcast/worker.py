@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import gzip
+import json
 import logging
 import os
 import socket
@@ -76,6 +78,49 @@ _HOP_HEADERS = frozenset(
 WORKER_HEADER = "X-TPUDash-Worker"
 
 
+def degraded_frame_body(
+    frame_raw: bytes, down_s: float
+) -> "tuple[bytes, bytes]":
+    """(raw, gzip) of one sealed frame re-marked for a compose outage:
+    ``stale: true``, a synthesized ``compose_down`` alert riding the
+    normal alerts channel (banner + any poller sees it like a breaching
+    chip), and a human warning line.  Blocking JSON+gzip work — callers
+    run it in the executor, once per (seal, outage), never per request."""
+    from tpudash.alerts import synthesized_alert
+
+    frame = json.loads(frame_raw)
+    frame["stale"] = True
+    alerts = [
+        a
+        for a in (frame.get("alerts") or [])
+        if a.get("rule") != "compose_down"
+    ]
+    alerts.insert(
+        0,
+        synthesized_alert(
+            rule="compose_down",
+            column="server",
+            severity="critical",
+            chip="server",
+            value=round(down_s, 1),
+            threshold=0.0,
+            firing=True,
+            detail=(
+                f"compose process unreachable for {down_s:.0f}s; serving "
+                "the last sealed frame from this worker's bus mirror"
+            ),
+        ),
+    )
+    frame["alerts"] = alerts
+    warnings = list(frame.get("warnings") or [])
+    warnings.append(
+        "compose process down: this is the last sealed frame, not live data"
+    )
+    frame["warnings"] = warnings
+    raw = json.dumps(frame, separators=(",", ":")).encode()
+    return raw, gzip.compress(raw, 6)
+
+
 class FanoutWorker:
     def __init__(self, cfg: Config, index: int, bus_dir: str):
         self.cfg = cfg
@@ -90,6 +135,35 @@ class FanoutWorker:
         self._stop = asyncio.Event()
         self._api: "ClientSession | None" = None
         self._tasks: "list[asyncio.Task]" = []
+        #: stale-etag → (raw, gz) degraded compose-outage bodies — one
+        #: slot per cohort's latest seal, built at most once per (seal,
+        #: outage) however many requests serve it.  Bounded by the
+        #: mirror's cohort universe; cleared wholesale past a sanity cap
+        #: and left to expire with the next hello's window reset.
+        self._stale_bodies: "dict[str, tuple]" = {}
+        self._stale_build_lock = asyncio.Lock()
+
+    @property
+    def compose_down(self) -> bool:
+        """The worker's compose-outage verdict: the frame-bus link is
+        the compose process's heartbeat (mirrors reconnect every 0.5 s,
+        so a live compose is never 'disconnected' for long)."""
+        return not self.mirror.connected
+
+    def _fallback_cid(self) -> "int | None":
+        """A cohort to serve a session the mirror has no binding for
+        while compose is unreachable: the default (cookieless) cohort
+        when known, else the cohort with the freshest seal — slightly
+        wrong selection state beats a 503 during an outage."""
+        cid = self.mirror.bindings.get("")
+        if cid is not None and self.mirror.window(cid) is not None:
+            return cid
+        best, best_seq = None, -1
+        for wcid, win in self.mirror.windows.items():
+            latest = win.latest()
+            if latest is not None and latest.seq > best_seq:
+                best, best_seq = wcid, latest.seq
+        return best
 
     # -- internal API client -------------------------------------------------
     def api_session(self) -> ClientSession:
@@ -123,6 +197,15 @@ class FanoutWorker:
                 self.mirror.bindings[sid or ""] = cid
                 return cid
         except (OSError, asyncio.TimeoutError, ValueError, KeyError):
+            if self.compose_down:
+                # compose outage: degrade to a mirror-known cohort
+                # instead of shedding — outage mode serves stale, not
+                # 503s
+                return self._fallback_cid()
+            # compose is up (the bus link is live) but THIS call failed
+            # (transient timeout/reset): binding to a guessed cohort
+            # would silently serve the wrong selection as live data —
+            # shed and let the client retry
             return None
 
     def _check_auth(self, request: web.Request, allow_query: bool) -> None:
@@ -197,6 +280,7 @@ class FanoutWorker:
         ack = parse_event_id(request.headers.get("Last-Event-ID"))
         write_deadline = self.overload.write_deadline
         self.mirror.retain(cid)
+        seen_hello = self.mirror.hello_count
         # keepalive pacing: the mirror wakes this loop on EVERY bus
         # message (any cohort's seal, any binding), so without pacing
         # each spurious wake would write a keepalive — multiplying
@@ -207,6 +291,19 @@ class FanoutWorker:
             if accepts_gzip:
                 await write_buf(GZIP_HEADER)
             while True:
+                if self.mirror.hello_count != seen_hello:
+                    # the publisher re-snapshotted (a RESTARTED compose
+                    # starts with an empty hub): re-resolve once so the
+                    # compose side re-creates + re-seals this session's
+                    # cohort — otherwise a stream that never reconnects
+                    # would idle on keepalives until some other request
+                    # happened to revive the cohort
+                    seen_hello = self.mirror.hello_count
+                    resolved = await self._resolve_cid(sid)
+                    if resolved is not None and resolved != cid:
+                        self.mirror.release(cid)
+                        self.mirror.retain(resolved)
+                        cid = resolved
                 # follow the session into a new cohort after a (proxied)
                 # selection change — the binding update rides the bus
                 new_cid = self.mirror.bindings.get(sid or "", cid)
@@ -313,6 +410,13 @@ class FanoutWorker:
             latest = win.latest() if win is not None else None
             if latest is None:
                 return await self.proxy(request)
+            if self.compose_down:
+                # compose outage: the mirror's last seal still serves,
+                # re-marked stale:true + a compose_down alert — a
+                # dashboard that answers "here is the last sealed data,
+                # and here is WHY it's old" beats one that goes dark
+                # with the fleet (the killall drill asserts this path)
+                return await self._stale_frame_response(request, latest)
             headers = {
                 "Cache-Control": "no-cache",
                 "ETag": latest.etag,
@@ -331,9 +435,56 @@ class FanoutWorker:
         finally:
             self.overload.release()
 
+    async def _stale_frame_response(
+        self, request: web.Request, latest
+    ) -> web.Response:
+        """The compose-outage ``/api/frame`` body: the seal's frame with
+        ``stale: true`` + the synthesized ``compose_down`` alert, built
+        in the executor ONCE per (seal, outage) behind a single-flight
+        gate and ETag-revalidated like the live path."""
+        etag = f'"{latest.cid}-{latest.seq}-stale"'
+        headers = {
+            "Cache-Control": "no-cache",
+            "ETag": etag,
+            WORKER_HEADER: str(self.pid),
+        }
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers=headers)
+        if etag not in self._stale_bodies:
+            async with self._stale_build_lock:
+                if etag not in self._stale_bodies:
+                    down = self.mirror.disconnected_since
+                    down_s = (
+                        time.monotonic() - down if down is not None else 0.0
+                    )
+                    loop = asyncio.get_running_loop()
+                    raw, gz = await loop.run_in_executor(
+                        None, degraded_frame_body, latest.frame_raw, down_s
+                    )
+                    if len(self._stale_bodies) > 2 * max(
+                        1, len(self.mirror.windows)
+                    ):
+                        self._stale_bodies.clear()
+                    self._stale_bodies[etag] = (raw, gz)
+        raw, gz = self._stale_bodies[etag]
+        if _accepts_gzip(request.headers.get("Accept-Encoding", "")):
+            body = gz
+            headers["Content-Encoding"] = "gzip"
+        else:
+            body = raw
+        return web.Response(
+            body=body, content_type="application/json", headers=headers
+        )
+
     async def healthz(self, request: web.Request) -> web.Response:
         """Compose-process health with this worker's own vitals folded in
-        — the storm drill asserts loop-lag flatness per PID from here."""
+        — the storm drill asserts loop-lag flatness per PID from here.
+
+        During a compose outage this route must tell the truth FROM THE
+        WORKER: ``ok`` stays true (this process is alive and serving
+        stale mirrors — restarting it fixes nothing, which is what a
+        liveness probe must measure) while ``status: compose_down``
+        names the real incident for the 3am responder."""
         try:
             # identity: this session passes bodies through undecoded
             # (auto_decompress=False), so a compressed /healthz would be
@@ -345,7 +496,20 @@ class FanoutWorker:
             ) as r:
                 doc = await r.json(content_type=None)
         except (OSError, asyncio.TimeoutError, ValueError):
-            doc = {"ok": False, "status": "compose-unreachable"}
+            down = self.mirror.disconnected_since
+            doc = {
+                "ok": True,
+                "status": "compose_down",
+                "error": (
+                    "compose process unreachable; this worker is serving "
+                    "/api/frame and /api/stream from its last bus mirrors"
+                ),
+                "compose_down_s": (
+                    round(time.monotonic() - down, 1)
+                    if down is not None
+                    else 0.0
+                ),
+            }
         doc["worker"] = self.worker_doc()
         return web.json_response(
             doc, headers={WORKER_HEADER: str(self.pid)}
@@ -356,6 +520,7 @@ class FanoutWorker:
             "pid": self.pid,
             "index": self.index,
             "streams": self.overload.streams,
+            "compose_down": self.compose_down,
             "loop_lag_ms": self.loop_monitor.summary(),
             "bus": self.mirror.stats(),
             "counters": dict(self.overload.counters),

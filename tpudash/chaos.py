@@ -59,9 +59,33 @@ port and asserts the overload contract holds in every process:
 
     python -m tpudash.chaos storm --clients 1000 --workers 2 --seconds 30
 
+**The killall drill** (``python -m tpudash.chaos killall``): the
+crash-anything soak.  It boots the PROCESS-TREE supervised tier
+(TierSupervisor: compose child + N workers, persistent tsdb + state)
+and then kills things, in sequence, mid-storm:
+
+- SIGKILL the COMPOSE process: workers keep serving ``/api/frame``
+  (``stale: true`` + a synthesized ``compose_down`` alert) and
+  ``/api/stream`` from their bus mirrors, ``/healthz`` reports
+  ``compose_down`` from any worker, NO worker exits, and a mid-outage
+  ``Last-Event-ID`` reconnect resumes with a DELTA from the retained
+  seal windows; the restarted compose reloads the tsdb + state, bumps
+  the seal-seq epoch, and re-snapshots every worker over the bus;
+- SIGKILL a WORKER: the supervisor restarts it (exit code + restart
+  stamp journaled, visible on ``/api/workers``) while the public port
+  keeps answering;
+- SIGKILL a store process MID-SNAPSHOT (twice): every snapshot dir then
+  either restores completely or is REFUSED by manifest/CRC validation —
+  never a silently partial store;
+- follower catch-up: a read-only standby tails a live leader whose tiny
+  retention reclaims segments under it, converges with everything the
+  leader still holds, and reports bounded replication lag.
+
+    python -m tpudash.chaos killall --clients 24 --workers 2
+
 Exit status 0 = every invariant held; 1 = the printed JSON names what
-didn't.  CI runs the overload and storm drills on every PR (chaos-soak
-job).
+didn't.  CI runs the overload, storm, and killall drills on every PR
+(chaos-soak job).
 """
 
 from __future__ import annotations
@@ -70,6 +94,8 @@ import asyncio
 import dataclasses
 import json
 import logging
+import os
+import signal
 import sys
 import time
 
@@ -845,6 +871,716 @@ async def run_storm_drill(
     }
 
 
+# ---------------------------------------------------------------------------
+# Killall drill — crash-anything: SIGKILL the compose process mid-storm,
+# SIGKILL a worker, SIGKILL a snapshotting store process mid-snapshot, and
+# verify follower catch-up through leader-side segment reclaim.
+# ---------------------------------------------------------------------------
+
+#: killall-drill knobs: a live tier small enough to boot fast, with a
+#: persistent tsdb sealing constantly (the compose SIGKILL lands mid
+#: seal-thread activity by construction) and a seal window deep enough
+#: that mid-outage reconnects resume with deltas
+_KILLALL_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.5),
+    "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 32),
+    "TPUDASH_MAX_STREAMS": ("max_streams", 200),
+    "TPUDASH_MAX_CONCURRENCY": ("max_concurrency", 64),
+    "TPUDASH_SSE_WRITE_DEADLINE": ("sse_write_deadline", 2.0),
+    "TPUDASH_BROADCAST_WINDOW": ("broadcast_window", 16),
+    "TPUDASH_TSDB_CHUNK_POINTS": ("tsdb_chunk_points", 8),
+    "TPUDASH_TSDB_FLUSH_INTERVAL": ("tsdb_flush_interval", 1.0),
+}
+
+#: how long the drill stretches the compose child's first restart —
+#: long enough to assert the degraded window, short enough for CI
+_KILLALL_COMPOSE_BACKOFF = 4.0
+
+#: the snapshot-phase child: appends near-now frames and snapshots
+#: continuously so the parent's SIGKILL lands mid-append/mid-snapshot
+#: with high probability (the "seal thread" kill of the sequence)
+_SNAPSHOT_CHILD = """
+import sys, time, numpy as np
+from tpudash.tsdb import TSDB, FLEET_SERIES
+from tpudash.tsdb.snapshot import SnapshotError, take_snapshot
+store = TSDB(path=sys.argv[1], chunk_points=4)
+snap_root = sys.argv[2]
+keys = [f"slice-0/{i}" for i in range(8)] + [FLEET_SERIES]
+cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+i = 0
+while True:
+    mat = np.full((len(keys), len(cols)), float(i % 97), dtype=np.float32)
+    store.append_frame(time.time() - 60.0 + i * 0.05, keys, cols, mat)
+    store.flush()
+    if i and i % 20 == 0:
+        try:
+            take_snapshot(store, snap_root)
+        except SnapshotError as e:
+            print(f"snapshot failed: {e}", file=sys.stderr)
+    i += 1
+"""
+
+#: the follower-phase leader: tiny segments + tiny retention so files
+#: rotate and get reclaimed WHILE the follower tails them
+_LEADER_CHILD = """
+import sys, time, numpy as np
+import tpudash.tsdb.store as storemod
+storemod._SEG_MAX_BYTES = 6000  # rotate constantly: reclaim needs closed files
+from tpudash.tsdb import TSDB, FLEET_SERIES
+store = TSDB(path=sys.argv[1], chunk_points=4,
+             retention_raw_s=6.0, retention_1m_s=6.0, retention_10m_s=6.0)
+keys = [f"slice-0/{i}" for i in range(8)] + [FLEET_SERIES]
+cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+i = 0
+while True:
+    mat = np.full((len(keys), len(cols)), float(i % 97), dtype=np.float32)
+    store.append_frame(time.time(), keys, cols, mat)
+    store.flush()
+    i += 1
+    time.sleep(0.02)
+"""
+
+
+def make_killall_tier(cfg: "Config | None", workers: int):
+    """(cfg, bus_dir, work_dir) for the killall drill: a supervised tier
+    over a synthetic source with a PERSISTENT tsdb and state checkpoint
+    (the compose child must have something to reload), preflighted for
+    worker mode — fails loudly where worker mode cannot run."""
+    import socket as socketmod
+    import tempfile
+
+    from tpudash.broadcast.supervisor import preflight
+
+    cfg = cfg or load_config()
+    for env_name, (field, value) in _KILLALL_KNOBS.items():
+        if not env_is_set(env_name):
+            cfg = dataclasses.replace(cfg, **{field: value})
+    work_dir = tempfile.mkdtemp(prefix="tpudash-killall-")
+    probe = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    cfg = dataclasses.replace(
+        cfg,
+        source="synthetic",
+        workers=workers,
+        host="127.0.0.1",
+        port=port,
+        broadcast_bus=os.path.join(work_dir, "bus"),
+        tsdb_path=os.path.join(work_dir, "store"),
+        state_path=os.path.join(work_dir, "state.json"),
+    )
+    bus_dir = preflight(cfg)
+    return cfg, bus_dir, work_dir
+
+
+async def _killall_read_event(resp, deadline: float = 30.0):
+    """(event_id, payload dict) of the next real SSE event on an
+    identity-encoded stream."""
+
+    async def go():
+        buf = b""
+        async for chunk in resp.content.iter_any():
+            buf += chunk
+            while b"\n\n" in buf:
+                evt, buf = buf.split(b"\n\n", 1)
+                if evt.startswith(b":"):
+                    continue  # keepalive
+                eid, payload = None, None
+                for line in evt.split(b"\n"):
+                    if line.startswith(b"id: "):
+                        eid = line[4:].decode()
+                    elif line.startswith(b"data: "):
+                        payload = json.loads(line[6:])
+                if payload is not None:
+                    return eid, payload
+        raise AssertionError("stream ended without an event")
+
+    return await asyncio.wait_for(go(), deadline)
+
+
+async def _killall_stream_once(session, base, sid, last_id=None):
+    """Open /api/stream once, read one event, close.  Returns
+    (event_id, payload) or (None, None) after exhausting retries."""
+    from aiohttp import ClientError
+
+    headers = {"Accept-Encoding": "identity"}
+    if last_id is not None:
+        headers["Last-Event-ID"] = last_id
+    for _ in range(40):
+        try:
+            resp = await session.get(
+                f"{base}/api/stream",
+                headers=headers,
+                cookies={"tpudash_sid": sid},
+            )
+        except (OSError, ClientError):
+            await asyncio.sleep(0.25)
+            continue
+        if resp.status != 200:
+            resp.close()
+            await asyncio.sleep(0.25)
+            continue
+        try:
+            eid, payload = await _killall_read_event(resp)
+        except (OSError, ClientError, asyncio.TimeoutError):
+            resp.close()
+            await asyncio.sleep(0.25)
+            continue
+        resp.close()
+        return eid, payload
+    return None, None
+
+
+def _snapshot_kill_phase(work_dir: str) -> dict:
+    """SIGKILL a store process mid-append/mid-snapshot, twice, then
+    prove every snapshot directory either restores COMPLETELY or is
+    refused — never a silently partial store — and time one clean
+    snapshot for the job summary."""
+    import random
+    import subprocess
+
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.snapshot import (
+        SnapshotError,
+        restore_snapshot,
+        take_snapshot,
+    )
+
+    store_dir = os.path.join(work_dir, "snapstore")
+    snap_root = os.path.join(work_dir, "snaps")
+    os.makedirs(snap_root, exist_ok=True)
+    rng = random.Random(11)
+    stderr_tail = b""
+    for _ in range(2):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SNAPSHOT_CHILD, store_dir, snap_root],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        time.sleep(2.0 + rng.random())
+        proc.send_signal(signal.SIGKILL)
+        _, err = proc.communicate()
+        stderr_tail += err or b""
+    results = {"complete": 0, "refused": 0, "silently_partial": 0}
+    entries = sorted(os.listdir(snap_root))
+    for i, name in enumerate(entries):
+        snap = os.path.join(snap_root, name)
+        dest = os.path.join(work_dir, f"restore-{i}")
+        try:
+            restore_snapshot(snap, dest)
+        except SnapshotError:
+            results["refused"] += 1
+            continue
+        # a restore that "succeeded" must load cleanly AND completely:
+        # the CRC walk truncates torn tails, so any size change after
+        # load means the restore let partial data through
+        sizes = {
+            n: os.path.getsize(os.path.join(dest, n))
+            for n in os.listdir(dest)
+            if n.endswith(".seg")
+        }
+        restored = TSDB(path=dest, read_only=True)
+        after = {
+            n: os.path.getsize(os.path.join(dest, n)) for n in sizes
+        }
+        if restored.stats()["raw_points"] > 0 and sizes == after:
+            results["complete"] += 1
+        else:
+            results["silently_partial"] += 1
+    # one clean snapshot, timed, of whatever survived the kills
+    store = TSDB(path=store_dir, chunk_points=4)
+    snap = take_snapshot(store, snap_root)
+    failures = []
+    if results["complete"] == 0:
+        failures.append("no snapshot survived the SIGKILLs complete")
+    if results["silently_partial"]:
+        failures.append(
+            f"{results['silently_partial']} snapshot(s) restored PARTIAL "
+            "data without refusing"
+        )
+    if b"Traceback" in stderr_tail:
+        failures.append(
+            "snapshot child crashed with a traceback before the kill: "
+            + stderr_tail.decode(errors="replace")[:300]
+        )
+    return {
+        "failures": failures,
+        "snapshots_seen": len(entries),
+        **results,
+        "snapshot_duration_ms": snap["duration_ms"],
+        "snapshot_bytes": snap["bytes"],
+        "snapshot_files": snap["files"],
+    }
+
+
+def _follower_phase(work_dir: str) -> dict:
+    """A follower tails a live leader whose tiny retention reclaims
+    segments mid-tail; after the leader is SIGKILLed the follower must
+    have converged with everything the leader's store still holds —
+    replication lag measured and bounded throughout."""
+    import subprocess
+
+    from tpudash.tsdb import FLEET_SERIES, TSDB
+    from tpudash.tsdb.follower import FollowerTSDB
+
+    leader_dir = os.path.join(work_dir, "leader")
+    os.makedirs(leader_dir, exist_ok=True)
+    failures = []
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _LEADER_CHILD, leader_dir],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    applied_t0 = time.monotonic()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not os.listdir(leader_dir):
+            time.sleep(0.1)
+        follower = FollowerTSDB(leader_dir, poll_interval_s=0.25)
+        follower.start()
+        # run long enough for the leader's 6 s retention to reclaim
+        # whole segment files out from under the tail
+        deadline = time.monotonic() + 14.0
+        max_lag = 0.0
+        while time.monotonic() < deadline:
+            rep = follower.replication
+            if rep["lag_s"] is not None:
+                max_lag = max(max_lag, rep["lag_s"])
+            if rep["files_reclaimed"] > 0 and time.monotonic() > applied_t0 + 9:
+                break
+            time.sleep(0.25)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        _, err = proc.communicate()
+    # final catch-up after the leader died mid-write
+    follower.poll()
+    time.sleep(0.3)
+    follower.poll()
+    follower.close()
+    rep = dict(follower.replication)
+    # the leader is dead: loading its directory is now safe (the torn
+    # tail its kill left gets truncated, exactly like a restart would)
+    leader = TSDB(path=leader_dir, chunk_points=4)
+    lp, fp = leader.stats()["raw_points"], follower.stats()["raw_points"]
+    if rep["files_reclaimed"] == 0:
+        failures.append(
+            "leader never reclaimed a segment under the follower "
+            "(drill too short or retention broke)"
+        )
+    if rep["stuck_files"]:
+        failures.append(f"follower poisoned files: {rep['stuck_files']}")
+    if fp < lp:
+        failures.append(
+            f"follower lost data: {fp} points vs leader's surviving {lp}"
+        )
+    if rep["lag_s"] is None or max_lag > 5.0:
+        failures.append(
+            f"replication lag unmeasured or unbounded (max {max_lag:.2f}s)"
+        )
+    # range-query convergence over the leader's surviving window: every
+    # point the leader still serves, the follower serves identically
+    lo, hi = leader.earliest_ms(0), leader.latest_ms()
+    converged = None
+    if lo is not None and hi is not None:
+        l_pts = leader.raw_window(FLEET_SERIES, "hbm_usage_ratio", lo, hi)
+        f_pts = follower.raw_window(FLEET_SERIES, "hbm_usage_ratio", lo, hi)
+        f_map = dict(f_pts)
+        missing = [t for t, v in l_pts if f_map.get(t) != v]
+        converged = not missing
+        if missing:
+            failures.append(
+                f"follower range diverges from leader on {len(missing)} "
+                f"of {len(l_pts)} surviving points"
+            )
+    elapsed = time.monotonic() - applied_t0
+    if b"Traceback" in (err or b""):
+        failures.append(
+            "leader child crashed before the kill: "
+            + (err or b"").decode(errors="replace")[:300]
+        )
+    return {
+        "failures": failures,
+        "replication_lag_s": rep.get("lag_s"),
+        "replication_max_lag_s": round(max_lag, 3),
+        "files_reclaimed_under_tail": rep["files_reclaimed"],
+        "records_applied": rep["records_applied"],
+        "follower_points": fp,
+        "leader_surviving_points": lp,
+        "converged": converged,
+        "follower_catchup_points_per_s": (
+            int(rep["records_applied"] / elapsed) if elapsed > 0 else None
+        ),
+    }
+
+
+async def run_killall_drill(
+    clients: int = 24, workers: int = 2, cfg: "Config | None" = None
+) -> dict:
+    """Crash-anything, asserted end to end: SIGKILL the compose process
+    mid-storm (workers serve stale ``/api/frame`` with ``stale: true``
+    and a ``compose_down`` alert, ``/healthz`` tells the truth, NO
+    worker exits, and a mid-outage ``Last-Event-ID`` reconnect resumes
+    with a DELTA from the retained mirrors); the restarted compose
+    reloads the tsdb + state, re-snapshots every worker over the bus,
+    and fresh frames resume with seal seqs that can never alias the old
+    epoch's.  Then SIGKILL a worker (supervisor restarts it, serving
+    never stops), SIGKILL a snapshotting store mid-snapshot (restore
+    loads complete sets and REFUSES torn ones), and verify follower
+    catch-up through leader-side segment reclaim with bounded,
+    measured replication lag."""
+    from aiohttp import ClientError, ClientSession, TCPConnector
+
+    from tpudash.broadcast.supervisor import (
+        BroadcastSetupError,
+        TierSupervisor,
+    )
+
+    _raise_fd_limit()
+    loop = asyncio.get_running_loop()
+    try:
+        cfg, bus_dir, work_dir = await loop.run_in_executor(
+            None, make_killall_tier, cfg, workers
+        )
+    except BroadcastSetupError as e:
+        return {"ok": False, "failures": [f"preflight: {e}"]}
+    sup = TierSupervisor(
+        cfg,
+        bus_dir,
+        log_dir=bus_dir,
+        compose_backoff=_KILLALL_COMPOSE_BACKOFF,
+    )
+    await sup.start()
+    base = f"http://{cfg.host}:{cfg.port}"
+    failures: "list[str]" = []
+    numbers: dict = {"clients": clients, "workers": workers}
+    stop = asyncio.Event()
+    stream_events = {"n": 0}
+
+    async def storm_client(session, i):
+        """Background viewer: stream events, reconnect on any drop with
+        the last event id — the population that must survive every kill."""
+        last_id = None
+        cookies = {"tpudash_sid": f"killall-{i}"}
+        headers = {"Accept-Encoding": "identity"}
+        while not stop.is_set():
+            try:
+                hdrs = dict(headers)
+                if last_id:
+                    hdrs["Last-Event-ID"] = last_id
+                async with session.get(
+                    f"{base}/api/stream", headers=hdrs, cookies=cookies
+                ) as r:
+                    if r.status != 200:
+                        await asyncio.sleep(0.5)
+                        continue
+                    buf = b""
+                    async for chunk in r.content.iter_any():
+                        if stop.is_set():
+                            return
+                        buf += chunk
+                        while b"\n\n" in buf:
+                            evt, buf = buf.split(b"\n\n", 1)
+                            for line in evt.split(b"\n"):
+                                if line.startswith(b"id: "):
+                                    last_id = line[4:].decode()
+                                    stream_events["n"] += 1
+            except (OSError, ClientError, asyncio.TimeoutError):
+                await asyncio.sleep(0.3)
+
+    async def fetch_frame(session, sid="killall-probe"):
+        try:
+            async with session.get(
+                f"{base}/api/frame",
+                cookies={"tpudash_sid": sid},
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                if r.status != 200:
+                    return r.status, None
+                return 200, await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError):
+            return None, None
+
+    async def fetch_json(session, path):
+        try:
+            async with session.get(
+                f"{base}{path}", headers={"Accept-Encoding": "identity"}
+            ) as r:
+                return await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+            return None
+
+    tasks: "list[asyncio.Task]" = []
+    try:
+        async with ClientSession(connector=TCPConnector(limit=0)) as session:
+            # -- phase 0: tier ready -----------------------------------------
+            deadline = time.monotonic() + 90.0
+            ready = False
+            while time.monotonic() < deadline:
+                status, frame = await fetch_frame(session)
+                wdoc = await fetch_json(session, "/api/workers")
+                bus_workers = (
+                    len(((wdoc or {}).get("bus") or {}).get("workers") or [])
+                )
+                if status == 200 and frame is not None and bus_workers >= workers:
+                    ready = True
+                    break
+                await asyncio.sleep(0.5)
+            if not ready:
+                failures.append("tier never became ready (90s)")
+                raise _DrillAbort()
+
+            # -- phase 1: storm + resume probe --------------------------------
+            tasks = [
+                asyncio.ensure_future(storm_client(session, i))
+                for i in range(max(4, clients))
+            ]
+            probe_sid = "killall-resume"
+            #: the live (event_id, kind) tape of one dedicated viewer —
+            #: the mid-outage resume picks an ack from it whose
+            #: successors are all deltas (an occasional seal is
+            #: structural — axis maxima drift — and a full-only seal in
+            #: the gap legitimately forces a full frame; the invariant
+            #: under test is that the RETAINED WINDOW serves the delta
+            #: chain through the outage, so the probe must ack a
+            #: delta-resumable position)
+            probe_events: "list[tuple[str, str]]" = []
+
+            async def resume_probe():
+                try:
+                    async with session.get(
+                        f"{base}/api/stream",
+                        headers={"Accept-Encoding": "identity"},
+                        cookies={"tpudash_sid": probe_sid},
+                    ) as r:
+                        buf = b""
+                        async for chunk in r.content.iter_any():
+                            buf += chunk
+                            while b"\n\n" in buf:
+                                evt, buf = buf.split(b"\n\n", 1)
+                                eid = kind = None
+                                for line in evt.split(b"\n"):
+                                    if line.startswith(b"id: "):
+                                        eid = line[4:].decode()
+                                    elif line.startswith(b"data: "):
+                                        kind = json.loads(line[6:]).get(
+                                            "kind"
+                                        )
+                                if eid is not None:
+                                    probe_events.append((eid, kind))
+                except (OSError, ClientError, asyncio.CancelledError):
+                    pass
+
+            probe_task = asyncio.ensure_future(resume_probe())
+            tasks.append(probe_task)
+            deadline = time.monotonic() + 30.0
+            # enough tape that some suffix is a pure delta run
+            while time.monotonic() < deadline and len(probe_events) < 6:
+                await asyncio.sleep(0.25)
+            if len(probe_events) < 2:
+                failures.append("resume probe never accumulated events")
+                raise _DrillAbort()
+            if probe_events[0][1] != "full":
+                failures.append("fresh stream did not start with a full frame")
+            pre_kill_seq = int(probe_events[-1][0].split("-")[-1])
+
+            def delta_resumable_ack() -> "str | None":
+                """Newest event id whose entire suffix is deltas (>=1)."""
+                for i in range(len(probe_events) - 2, -1, -1):
+                    tail = probe_events[i + 1 :]
+                    if tail and all(k == "delta" for _e, k in tail):
+                        return probe_events[i][0]
+                return None
+
+            # -- phase 2: SIGKILL compose mid-storm ---------------------------
+            compose_pid = sup.child_pid("compose")
+            if compose_pid is None:
+                failures.append("no compose child pid to kill")
+                raise _DrillAbort()
+            worker_pids_before = {
+                n: sup.child_pid(n)
+                for n in sup._info
+                if n.startswith("worker-")
+            }
+            os.kill(compose_pid, signal.SIGKILL)
+            t_kill = time.monotonic()
+            stale_seen = None
+            alert_seen = False
+            while time.monotonic() - t_kill < 6.0:
+                status, frame = await fetch_frame(session, sid=probe_sid)
+                if status == 200 and frame is not None and frame.get("stale"):
+                    stale_seen = time.monotonic() - t_kill
+                    alert_seen = any(
+                        a.get("rule") == "compose_down"
+                        for a in frame.get("alerts") or []
+                    )
+                    break
+                await asyncio.sleep(0.2)
+            if stale_seen is None:
+                failures.append(
+                    "no stale:true /api/frame served during the compose outage"
+                )
+            else:
+                numbers["outage_stale_after_s"] = round(stale_seen, 2)
+                if not alert_seen:
+                    failures.append(
+                        "stale frame carried no compose_down alert"
+                    )
+            hz = await fetch_json(session, "/healthz")
+            if not hz or hz.get("status") != "compose_down":
+                failures.append(
+                    f"/healthz hid the outage: {hz and hz.get('status')}"
+                )
+            elif hz.get("ok") is not True:
+                failures.append(
+                    "worker /healthz ok flapped during the outage (the "
+                    "worker process is alive and serving)"
+                )
+            # mid-outage Last-Event-ID reconnect: a DELTA, not a re-init
+            # (the probe's live connection is cut first — the scenario
+            # is a viewer dropping and coming back DURING the outage)
+            probe_task.cancel()
+            ack_id = delta_resumable_ack()
+            if ack_id is None:
+                failures.append(
+                    "probe tape held no delta-resumable ack "
+                    f"(tape: {[k for _e, k in probe_events]})"
+                )
+            else:
+                resumed_id, resumed = await _killall_stream_once(
+                    session, base, probe_sid, last_id=ack_id
+                )
+                if resumed is None:
+                    failures.append(
+                        "mid-outage reconnect got no event at all"
+                    )
+                elif resumed.get("kind") != "delta":
+                    failures.append(
+                        "mid-outage Last-Event-ID reconnect re-inited with "
+                        f"kind={resumed.get('kind')!r} instead of a delta"
+                    )
+            # no worker died with the compose process
+            for name, pid in worker_pids_before.items():
+                if sup._info[name].restarts != 0 or sup.child_pid(name) != pid:
+                    failures.append(
+                        f"{name} exited during the compose outage"
+                    )
+
+            # -- phase 3: compose returns -------------------------------------
+            fresh_at = None
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                status, frame = await fetch_frame(session, sid=probe_sid)
+                if status == 200 and frame is not None and not frame.get("stale"):
+                    fresh_at = time.monotonic() - t_kill
+                    break
+                await asyncio.sleep(0.3)
+            if fresh_at is None:
+                failures.append("compose never came back with fresh frames")
+                raise _DrillAbort()
+            numbers["compose_restart_s"] = round(fresh_at, 2)
+            post_id, post_payload = await _killall_stream_once(
+                session, base, probe_sid
+            )
+            if post_id is None:
+                failures.append("no stream event after compose restart")
+            else:
+                post_seq = int(post_id.split("-")[-1])
+                if post_seq <= pre_kill_seq:
+                    failures.append(
+                        f"restarted compose re-issued old seq range "
+                        f"({post_seq} <= {pre_kill_seq}) — stale acks could "
+                        "alias wrong-base delta chains"
+                    )
+            timings = await fetch_json(session, "/api/timings")
+            tsdb_stats = (timings or {}).get("tsdb") or {}
+            if not tsdb_stats.get("raw_points"):
+                failures.append(
+                    "restarted compose did not reload the tsdb segment set"
+                )
+            tier = (timings or {}).get("tier") or {}
+            if tier.get("restarts", 0) < 1:
+                failures.append(
+                    "/api/timings tier key lost the supervisor restarts"
+                )
+
+            # -- phase 4: SIGKILL a worker ------------------------------------
+            victim = "worker-0"
+            victim_pid = sup.child_pid(victim)
+            if victim_pid is None:
+                failures.append("no worker pid to kill")
+                raise _DrillAbort()
+            os.kill(victim_pid, signal.SIGKILL)
+            served_through = 0
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                status, _frame = await fetch_frame(session, sid=probe_sid)
+                if status == 200:
+                    served_through += 1
+                new_pid = sup.child_pid(victim)
+                if (
+                    sup._info[victim].restarts >= 1
+                    and new_pid is not None
+                    and new_pid != victim_pid
+                ):
+                    break
+                await asyncio.sleep(0.3)
+            info = sup._info[victim]
+            if info.restarts < 1:
+                failures.append("supervisor never restarted the killed worker")
+            if info.last_exit_rc != -signal.SIGKILL:
+                failures.append(
+                    f"worker bookkeeping lost the exit code: "
+                    f"{info.last_exit_rc!r}"
+                )
+            if served_through == 0:
+                failures.append(
+                    "/api/frame went dark while the worker restarted"
+                )
+            numbers["frames_served_through_worker_kill"] = served_through
+            numbers["stream_events_total"] = stream_events["n"]
+            if stream_events["n"] < clients:
+                failures.append(
+                    f"storm barely streamed: {stream_events['n']} events"
+                )
+    except _DrillAbort:
+        pass
+    finally:
+        stop.set()
+        if tasks:
+            await asyncio.wait(tasks, timeout=10)
+            for t in tasks:
+                t.cancel()
+        await sup.stop()
+
+    # -- phase 5+6: snapshot kill + follower catch-up (separate stores) ------
+    snap = await loop.run_in_executor(None, _snapshot_kill_phase, work_dir)
+    failures += snap.pop("failures")
+    follower = await loop.run_in_executor(None, _follower_phase, work_dir)
+    failures += follower.pop("failures")
+
+    # -- zero unhandled exceptions in ANY process's captured logs ------------
+    log_errors = await loop.run_in_executor(None, _scan_worker_logs, bus_dir)
+    # the compose SIGKILL cannot produce a traceback, so anything here is
+    # a genuine unhandled failure in compose/worker code under the kills
+    if log_errors:
+        failures.append(
+            f"process logs show unhandled errors: {log_errors[0][:400]}"
+        )
+    return {
+        "ok": not failures,
+        "failures": failures,
+        **numbers,
+        "snapshot": snap,
+        "follower": follower,
+        "supervisor_restarts": sup.restarts,
+    }
+
+
+class _DrillAbort(Exception):
+    """Internal: a phase failed in a way later phases depend on."""
+
+
 def _scan_worker_logs(bus_dir: str) -> "list[str]":
     """Unhandled-exception lines from the worker processes' captured
     stderr (the supervisor appends each worker's output to
@@ -886,6 +1622,14 @@ def main(argv: "list[str] | None" = None) -> None:
     st.add_argument("--clients", type=int, default=1000)
     st.add_argument("--workers", type=int, default=2)
     st.add_argument("--seconds", type=float, default=30.0)
+    ka = sub.add_parser(
+        "killall",
+        help="crash-anything drill: SIGKILL compose mid-storm, a worker, "
+        "and a snapshotting store; verify stale degrade, restart "
+        "recovery, snapshot restore-or-refuse, follower catch-up",
+    )
+    ka.add_argument("--clients", type=int, default=24)
+    ka.add_argument("--workers", type=int, default=2)
     args = parser.parse_args(argv)
 
     configure_logging()
@@ -902,6 +1646,12 @@ def main(argv: "list[str] | None" = None) -> None:
                 workers=args.workers,
                 seconds=args.seconds,
             )
+        )
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "killall":
+        summary = asyncio.run(
+            run_killall_drill(clients=args.clients, workers=args.workers)
         )
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)

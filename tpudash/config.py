@@ -158,6 +158,29 @@ class Config:
     tsdb_retention_raw: float = 86400.0
     tsdb_retention_1m: float = 604800.0
     tsdb_retention_10m: float = 2592000.0
+    #: Online-snapshot root directory ("" disables snapshots).  Each
+    #: snapshot is a timestamped subdirectory of hardlinked segment
+    #: files plus a CRC-framed manifest — see ``python -m tpudash.tsdb
+    #: snapshot`` and docs/OPERATIONS.md (backup & disaster recovery).
+    tsdb_snapshot_dir: str = ""
+    #: Automatic snapshot cadence, seconds (0 = manual/cron only).  Runs
+    #: on the seal thread right after a chunk lands on disk, so the
+    #: ingest path never pauses beyond the head cut.
+    tsdb_snapshot_interval: float = 0.0
+    #: Snapshot GC: keep at most this many complete snapshots (the
+    #: newest always survives).
+    tsdb_snapshot_keep: int = 5
+    #: Snapshot GC: additionally drop complete snapshots older than this
+    #: many seconds (0 = count-based GC only; the newest always survives).
+    tsdb_snapshot_retention: float = 0.0
+    #: Follower (hot-standby) mode: tail another instance's segment
+    #: directory (or a snapshot directory) read-only, serving
+    #: ``/api/range``/trends from it with a measured replication lag.
+    #: Mutually exclusive with local ingest — a follower never appends.
+    tsdb_follow: str = ""
+    #: Follower poll cadence, seconds (how often sealed segment growth
+    #: is tailed; bounds replication lag when the leader is live).
+    tsdb_follow_interval: float = 2.0
     #: source="workload": checkpoint/resume for the background train loop
     #: (models/checkpoint.py) — save every N steps into this directory and
     #: resume from its latest step on restart.  "" disables.
@@ -342,6 +365,12 @@ _ENV_MAP = {
     "tsdb_retention_raw": "TPUDASH_TSDB_RETENTION_RAW",
     "tsdb_retention_1m": "TPUDASH_TSDB_RETENTION_1M",
     "tsdb_retention_10m": "TPUDASH_TSDB_RETENTION_10M",
+    "tsdb_snapshot_dir": "TPUDASH_TSDB_SNAPSHOT_DIR",
+    "tsdb_snapshot_interval": "TPUDASH_TSDB_SNAPSHOT_INTERVAL",
+    "tsdb_snapshot_keep": "TPUDASH_TSDB_SNAPSHOT_KEEP",
+    "tsdb_snapshot_retention": "TPUDASH_TSDB_SNAPSHOT_RETENTION",
+    "tsdb_follow": "TPUDASH_TSDB_FOLLOW",
+    "tsdb_follow_interval": "TPUDASH_TSDB_FOLLOW_INTERVAL",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",

@@ -9,10 +9,15 @@ Layers (each its own module, each independently tested):
   min/max/sum/count) with per-tier retention;
 - :mod:`tpudash.tsdb.query` — the range-query layer (series select,
   step alignment, aggregate choice, point budget) that the sparklines,
-  drill-downs, and ``GET /api/range`` consume.
+  drill-downs, and ``GET /api/range`` consume;
+- :mod:`tpudash.tsdb.snapshot` — online snapshots (hardlinked segment
+  sets + CRC-framed manifest), verified restore, retention-aware GC;
+- :mod:`tpudash.tsdb.follower` — read-only hot-standby mode tailing
+  another instance's segment directory with measured replication lag.
 
 ``python -m tpudash.tsdb drill`` is the crash chaos drill (kill -9 mid
-segment-append, assert sealed data survives); CI runs it every PR.
+segment-append, assert sealed data survives); ``snapshot``/``restore``
+are the backup surface; CI runs the drills every PR.
 """
 
 from tpudash.tsdb.store import FLEET_SERIES, TSDB

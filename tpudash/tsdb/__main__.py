@@ -1,4 +1,4 @@
-"""tsdb CLI: the crash drill and a stats dump.
+"""tsdb CLI: the crash drill, backup/restore, follower tailing, stats.
 
 ``python -m tpudash.tsdb drill --dir D [--kills N]``
     The durability claim, exercised for real: a child process appends
@@ -11,8 +11,29 @@
     every recovery held; nonzero prints what was lost.  CI's chaos-soak
     job runs this on every PR.
 
+``python -m tpudash.tsdb snapshot --dir D [--out ROOT]``
+    One online snapshot of the store at ``D``: seals the head, hardlinks
+    a consistent segment set + CRC-framed manifest into a timestamped
+    directory under ROOT (default ``<D>/snapshots``), then runs
+    retention-aware GC (``--keep``/``--retention``).  Safe against a
+    live writer — sizes are captured under the store's segment-I/O
+    lock, so every captured file ends on a record boundary.
+
+``python -m tpudash.tsdb restore --snapshot S --dir DEST``
+    Validate snapshot ``S`` (manifest frame CRC, every listed segment
+    present/complete/CRC-matching) and copy it into the EMPTY directory
+    ``DEST``.  Refuses torn or mismatched sets outright — exit 1 names
+    the first mismatch; there is no partial-restore state.
+
+``python -m tpudash.tsdb follow --leader L [--seconds N]``
+    Tail ``L`` read-only as a hot standby for N seconds (0 = one poll),
+    printing replication stats per poll — the smoke surface for
+    follower mode (``TPUDASH_TSDB_FOLLOW`` serves a whole dashboard
+    from the same machinery).
+
 ``python -m tpudash.tsdb stats --dir D``
-    One JSON line of :meth:`TSDB.stats` for a store directory.
+    One JSON line of :meth:`TSDB.stats` for a store directory
+    (read-only: never truncates another process's torn tail).
 """
 
 from __future__ import annotations
@@ -96,6 +117,53 @@ def run_drill(dirpath: str, kills: int, seed: int) -> int:
     return 0
 
 
+def run_snapshot(dirpath: str, out: str, keep: int, retention: float) -> int:
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.snapshot import SnapshotError, take_snapshot
+
+    store = TSDB(
+        path=dirpath,
+        read_only=False,
+        snapshot_keep=keep,
+        snapshot_retention_s=retention,
+    )
+    try:
+        result = take_snapshot(store, out or os.path.join(dirpath, "snapshots"))
+    except SnapshotError as e:
+        print(f"snapshot failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+def run_restore(snap: str, dest: str) -> int:
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.snapshot import SnapshotError, restore_snapshot
+
+    try:
+        result = restore_snapshot(snap, dest)
+    except SnapshotError as e:
+        print(f"restore refused: {e}", file=sys.stderr)
+        return 1
+    # prove the restored set actually loads before declaring victory
+    result["stats"] = TSDB(path=dest, read_only=True).stats()
+    print(json.dumps(result))
+    return 0
+
+
+def run_follow(leader: str, seconds: float, interval: float) -> int:
+    from tpudash.tsdb.follower import FollowerTSDB
+
+    follower = FollowerTSDB(leader, poll_interval_s=interval)
+    print(json.dumps(follower.replication))
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        time.sleep(interval)
+        print(json.dumps(follower.poll()))
+    print(json.dumps(follower.stats()))
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tpudash.tsdb")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -103,14 +171,35 @@ def main(argv: "list[str] | None" = None) -> int:
     d.add_argument("--dir", required=True)
     d.add_argument("--kills", type=int, default=3)
     d.add_argument("--seed", type=int, default=42)
+    sn = sub.add_parser("snapshot", help="online snapshot of a live store")
+    sn.add_argument("--dir", required=True)
+    sn.add_argument("--out", default="", help="snapshot root "
+                    "(default <dir>/snapshots)")
+    sn.add_argument("--keep", type=int, default=5)
+    sn.add_argument("--retention", type=float, default=0.0,
+                    help="drop complete snapshots older than this many "
+                    "seconds (0 = count-based GC only)")
+    rs = sub.add_parser("restore", help="validated restore into an empty dir")
+    rs.add_argument("--snapshot", required=True)
+    rs.add_argument("--dir", required=True)
+    fo = sub.add_parser("follow", help="tail a leader dir as a hot standby")
+    fo.add_argument("--leader", required=True)
+    fo.add_argument("--seconds", type=float, default=0.0)
+    fo.add_argument("--interval", type=float, default=1.0)
     s = sub.add_parser("stats", help="dump a store's stats as JSON")
     s.add_argument("--dir", required=True)
     args = ap.parse_args(argv)
     if args.cmd == "drill":
         return run_drill(args.dir, args.kills, args.seed)
+    if args.cmd == "snapshot":
+        return run_snapshot(args.dir, args.out, args.keep, args.retention)
+    if args.cmd == "restore":
+        return run_restore(args.snapshot, args.dir)
+    if args.cmd == "follow":
+        return run_follow(args.leader, args.seconds, args.interval)
     from tpudash.tsdb import TSDB
 
-    print(json.dumps(TSDB(path=args.dir).stats()))
+    print(json.dumps(TSDB(path=args.dir, read_only=True).stats()))
     return 0
 
 

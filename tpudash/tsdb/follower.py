@@ -1,0 +1,284 @@
+"""Follower (hot-standby) mode: a read-only TSDB that tails another
+instance's segment directory.
+
+The leader's segment files are append-only CRC-framed records (store.py
+``_write_record``), which makes replication a byte-offset tail: the
+follower remembers, per file, how many bytes it has applied, and each
+:meth:`poll` reads whatever grew past that offset, parses the COMPLETE
+frames in it, and commits the blocks/rollups into its own in-memory
+tiers.  A frame whose declared length outruns the bytes on disk is the
+leader mid-write — the follower simply stops before it and picks the
+frame up whole on the next poll.  Nothing the follower does ever mutates
+the leader's files (``read_only`` prevents truncation and reclaim), so
+it is safe to point at a LIVE leader — or at a snapshot directory, which
+is just a smaller segment set with a manifest it ignores.
+
+Leader-side retention is survivable by construction: when the leader
+reclaims an expired segment the follower merely drops its tail cursor
+for the vanished file — every record it already applied stays queryable
+until the follower's OWN retention expires it.  A segment reclaimed
+before the follower ever tailed it is history the leader no longer
+serves either; the follower converges on the leader's remaining horizon
+(the killall drill asserts exactly this).
+
+Replication lag is measured, not guessed: ``lag_s`` is the age of the
+newest record at the moment it was applied (write→apply delay ≈ the
+leader's seal cadence + one poll interval) and ``caught_up`` says every
+known file was consumed to its end on the last poll.  Both surface via
+:meth:`stats` → ``/api/timings`` (``tier.replication_lag_s``) — the
+number federation's hot-standby reads will alert on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+
+from tpudash.tsdb.store import (
+    _FRAME_HDR,
+    _MAGIC,
+    _REC_BLOCK,
+    _REC_ROLLUP,
+    TSDB,
+    _parse_block,
+    _parse_rollup,
+)
+
+log = logging.getLogger(__name__)
+
+_TIERS = ("raw", "1m", "10m")
+
+
+class FollowerTSDB(TSDB):
+    """Read-only standby over ``follow_path``; every query surface of
+    :class:`TSDB` (range_query, series listings, stats) works unchanged.
+    ``append_frame`` is inert — a follower never originates data."""
+
+    def __init__(
+        self,
+        follow_path: str,
+        poll_interval_s: float = 2.0,
+        retention_raw_s: float = 86400.0,
+        retention_1m_s: float = 7 * 86400.0,
+        retention_10m_s: float = 30 * 86400.0,
+    ) -> None:
+        super().__init__(
+            path="",  # no segments of its own — in-memory tiers only
+            retention_raw_s=retention_raw_s,
+            retention_1m_s=retention_1m_s,
+            retention_10m_s=retention_10m_s,
+            read_only=True,
+        )
+        self.follow_path = follow_path
+        self.poll_interval_s = max(0.05, float(poll_interval_s))
+        #: file name → [applied_offset, stuck_reason|None]
+        self._tails: "dict[str, list]" = {}
+        #: newest RAW sample stamp applied (rollup t1s are bucket-aligned
+        #: ends that can postdate real samples — useless for lag/age)
+        self._newest_raw_ms = 0
+        #: one poll at a time (the background thread and ad-hoc callers)
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.replication = {
+            "leader": follow_path,
+            "connected": False,
+            "caught_up": False,
+            "lag_s": None,
+            "last_poll_ts": None,
+            "files_tailed": 0,
+            "files_reclaimed": 0,
+            "records_applied": 0,
+            "stuck_files": [],
+            "last_error": None,
+        }
+        self.poll()  # initial catch-up before anyone queries
+
+    @classmethod
+    def from_config(cls, cfg) -> "FollowerTSDB":
+        return cls(
+            cfg.tsdb_follow,
+            poll_interval_s=cfg.tsdb_follow_interval,
+            retention_raw_s=cfg.tsdb_retention_raw,
+            retention_1m_s=cfg.tsdb_retention_1m,
+            retention_10m_s=cfg.tsdb_retention_10m,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Begin tailing on a daemon thread at ``poll_interval_s``."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tsdb-follower", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll()
+            except Exception as e:  # noqa: BLE001 — the tail loop must survive one bad poll  # tpulint: allow[broad-except] replication heartbeat: one failed poll logs, the next retries
+                log.warning("tsdb follower poll failed: %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._closed = True
+
+    # -- replication ---------------------------------------------------------
+    def poll(self) -> dict:
+        """One tail pass over the leader directory.  Returns (and stores
+        on ``replication``) the pass summary."""
+        with self._poll_lock:  # tpulint: allow[blocking-under-lock] dedicated tail-poll lock: serializes pollers only; queries ride _lock, never this
+            return self._poll_locked()
+
+    def _poll_locked(self) -> dict:
+        rep = dict(self.replication)
+        rep["last_poll_ts"] = time.time()  # tpulint: allow[wall-clock] replication lag compares persisted epoch stamps
+        applied = 0
+        newest_applied = 0
+        try:
+            names = sorted(os.listdir(self.follow_path))
+        except OSError as e:
+            rep["connected"] = False
+            rep["caught_up"] = False
+            rep["last_error"] = str(e)
+            self.replication = rep
+            return rep
+        rep["connected"] = True
+        rep["last_error"] = None
+        seg_names = [
+            n
+            for n in names
+            if n.endswith(".seg") and n.split("-", 1)[0] in _TIERS
+        ]
+        #: files this pass could not read to their end — transient
+        #: (reclaim race, EACCES): they make the pass NOT caught up
+        skipped = 0
+        for name in seg_names:
+            tail = self._tails.setdefault(name, [0, None])
+            if tail[1] is not None:
+                continue  # poisoned file: corruption, not a torn tail
+            full = os.path.join(self.follow_path, name)
+            try:
+                size = os.path.getsize(full)
+                if size <= tail[0]:
+                    continue
+                with open(full, "rb") as f:  # tpulint: allow[blocking-under-lock] the poll lock IS the dedicated tail-I/O lock; queries ride _lock, never this
+                    f.seek(tail[0])
+                    data = f.read(size - tail[0])
+            except OSError:
+                skipped += 1
+                continue  # raced a leader-side reclaim; next poll drops it
+            consumed, records, newest, stuck = self._apply_frames(data)
+            tail[0] += consumed
+            tail[1] = stuck
+            applied += records
+            newest_applied = max(newest_applied, newest)
+            self._newest_raw_ms = max(self._newest_raw_ms, newest)
+            if stuck is not None:
+                log.warning(
+                    "tsdb follower: %s poisoned at offset %d (%s); "
+                    "holding applied data, ignoring the rest of the file",
+                    name, tail[0], stuck,
+                )
+        # leader-side reclaim: files gone from the directory lose their
+        # cursor; everything already applied stays until OUR retention
+        for name in list(self._tails):
+            if name not in seg_names:
+                del self._tails[name]
+                rep["files_reclaimed"] += 1
+        if applied:
+            with self._lock:
+                self.version += 1
+            self._enforce_retention()
+            if newest_applied:
+                # write→apply delay of the newest record, measured at
+                # apply time — THE replication-lag number
+                rep["lag_s"] = round(
+                    max(0.0, rep["last_poll_ts"] - newest_applied / 1000.0),
+                    3,
+                )
+        rep["records_applied"] += applied
+        rep["files_tailed"] = len(self._tails)
+        rep["stuck_files"] = sorted(
+            n for n, t in self._tails.items() if t[1] is not None
+        )
+        # caught up = this pass consumed every readable file to its end
+        # AND nothing is poisoned or unreadable — a promotion decision
+        # reads this, so "behind but quiet" must never report True
+        # (incomplete trailing frames don't count: that's the leader
+        # mid-write, fully consumed next poll)
+        rep["caught_up"] = not rep["stuck_files"] and skipped == 0
+        self.replication = rep
+        return rep
+
+    def _apply_frames(self, data: bytes):
+        """Parse + commit every complete frame in ``data``.  Returns
+        (bytes consumed, records applied, newest t1 applied,
+        stuck_reason|None).  An incomplete trailing frame (leader
+        mid-write) is simply not consumed; a frame that is fully present
+        but fails magic/CRC is corruption — the file is poisoned rather
+        than spun on."""
+        off = 0
+        records = 0
+        newest = 0
+        stuck = None
+        while off + _FRAME_HDR.size <= len(data):
+            try:
+                magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, off)
+            except struct.error:
+                break
+            end = off + _FRAME_HDR.size + plen
+            if magic != _MAGIC:
+                stuck = "bad frame magic"
+                break
+            if end > len(data):
+                break  # incomplete: the leader is mid-write, retry later
+            payload = data[off + _FRAME_HDR.size : end]
+            if zlib.crc32(payload) != crc:
+                stuck = "record CRC mismatch"
+                break
+            try:
+                if rec_type == _REC_BLOCK:
+                    b = _parse_block(payload)
+                    with self._lock:
+                        self._raw.append(b)
+                    newest = max(newest, b.t1)
+                    records += 1
+                elif rec_type == _REC_ROLLUP:
+                    r = _parse_rollup(payload)
+                    if r.tier_ms in self._rollups:
+                        with self._lock:
+                            self._rollups[r.tier_ms].append(r)
+                        # NOT folded into ``newest``: a rollup's t1 is its
+                        # bucket-aligned end, which can postdate the newest
+                        # real sample by up to a bucket — lag is measured
+                        # against raw block stamps only
+                        records += 1
+            except (ValueError, KeyError, struct.error) as e:
+                stuck = f"unparseable payload: {e}"
+                break
+            off = end
+        return off, records, newest, stuck
+
+    def stats(self) -> dict:
+        out = super().stats()
+        rep = dict(self.replication)
+        # data age complements lag: how old the newest standby sample is
+        # right now (grows while the leader is idle; lag_s does not)
+        rep["data_age_s"] = (
+            round(max(0.0, time.time() - self._newest_raw_ms / 1000.0), 3)  # tpulint: allow[wall-clock] replication lag compares persisted epoch stamps
+            if self._newest_raw_ms
+            else None
+        )
+        out["replication"] = rep
+        return out

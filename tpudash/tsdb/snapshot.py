@@ -1,0 +1,368 @@
+"""Online snapshots of a live TSDB: hardlinked segment sets + a
+CRC-framed manifest, restorable only when provably complete.
+
+Why hardlinks work here: segment files are append-only (store.py writes
+whole CRC-framed records under the dedicated ``_io_lock`` and never
+rewrites), so a hardlink shares the inode with the live file and the
+byte range ``[0, size-at-snapshot)`` is immutable forever.  The manifest
+records that size (captured *under* ``_io_lock``, so it always lands on
+a record boundary) plus a CRC32 of exactly those bytes; restore copies
+and verifies exactly that range, ignoring whatever the live store
+appended after the cut.  The only ingest-visible cost of a snapshot is
+the head cut (one pointer swap under the in-memory lock) — appends never
+wait on the link/CRC/copy work, which the bench's ingest-stall guard
+pins (``bench_snapshot``).
+
+Torn-snapshot posture: a snapshot is assembled in a ``.snap-*.tmp``
+staging directory and renamed into place only after every hardlink
+landed and the manifest (written last) fsynced.  A crash — or ``kill
+-9`` — at ANY point leaves either a complete, manifest-sealed snapshot
+or an ignorable staging dir that GC sweeps; there is no state from
+which :func:`restore_snapshot` would silently load a partial store
+(the killall drill SIGKILLs a snapshotting process mid-flight and
+asserts exactly this).
+
+Restore refuses, never guesses: a manifest whose frame CRC fails, a
+listed segment that is missing/short/CRC-mismatched, or a non-empty
+destination all raise :class:`SnapshotError` before a single byte is
+copied.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import shutil
+import time
+import zlib
+
+from tpudash.tsdb.store import _FRAME_HDR, _MAGIC
+
+log = logging.getLogger(__name__)
+
+#: manifest record type inside the shared TSB1 framing (segments use
+#: 1 = block, 2 = rollup)
+_REC_MANIFEST = 3
+MANIFEST_NAME = "MANIFEST"
+#: staging dirs older than this are dead snapshot attempts → GC fodder
+_STAGING_GRACE_S = 3600.0
+
+
+class SnapshotError(Exception):
+    """Snapshot could not be taken, or a snapshot set failed validation
+    — the message names the file and the mismatch."""
+
+
+def _crc_file(path: str, nbytes: int) -> int:
+    """CRC32 over exactly the first ``nbytes`` of ``path`` (the
+    immutable prefix a hardlinked live segment shares with the
+    snapshot)."""
+    crc = 0
+    remaining = nbytes
+    with open(path, "rb") as f:
+        while remaining > 0:
+            chunk = f.read(min(1 << 20, remaining))
+            if not chunk:
+                raise SnapshotError(
+                    f"{path}: wanted {nbytes} bytes, file ended "
+                    f"{remaining} short"
+                )
+            crc = zlib.crc32(chunk, crc)
+            remaining -= len(chunk)
+    return crc
+
+
+def _snapshot_name(now_ms: int) -> str:
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime(now_ms / 1000.0))
+    return f"snap-{stamp}-{now_ms % 1000:03d}-{os.getpid()}"
+
+
+def _fsync_dir(path: str) -> None:
+    with contextlib.suppress(OSError):
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def write_manifest(path: str, doc: dict) -> None:
+    payload = json.dumps(doc, separators=(",", ":")).encode()
+    frame = _FRAME_HDR.pack(
+        _MAGIC, _REC_MANIFEST, len(payload), zlib.crc32(payload)
+    ) + payload
+    with open(path, "wb") as f:
+        f.write(frame)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_manifest(snap_dir: str) -> dict:
+    """Parse + validate a snapshot's manifest; raises SnapshotError on a
+    missing/torn/corrupt one (a dir without a valid manifest is not a
+    snapshot, whatever else it contains)."""
+    path = os.path.join(snap_dir, MANIFEST_NAME)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise SnapshotError(f"{snap_dir}: no readable manifest ({e})") from e
+    if len(data) < _FRAME_HDR.size:
+        raise SnapshotError(f"{path}: manifest shorter than its frame header")
+    magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, 0)
+    payload = data[_FRAME_HDR.size : _FRAME_HDR.size + plen]
+    if (
+        magic != _MAGIC
+        or rec_type != _REC_MANIFEST
+        or len(payload) != plen
+        or zlib.crc32(payload) != crc
+    ):
+        raise SnapshotError(
+            f"{path}: manifest frame failed magic/CRC validation "
+            "(torn or corrupt — refusing the whole snapshot)"
+        )
+    try:
+        doc = json.loads(payload)
+    except ValueError as e:
+        raise SnapshotError(f"{path}: manifest payload is not JSON") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("files"), list):
+        raise SnapshotError(f"{path}: manifest missing its file list")
+    return doc
+
+
+def take_snapshot(store, root: str, cut_head: bool = True) -> dict:
+    """One online snapshot of ``store`` into a new timestamped directory
+    under ``root``.  Returns ``{dir, files, bytes, duration_ms}``.
+
+    ``cut_head=True`` (the CLI path) seals the not-yet-full head first so
+    the snapshot carries everything up to "now"; the store's automatic
+    cadence passes False — it runs at the tail of a seal drain, where
+    re-entering the seal gate would deadlock and the head was just cut
+    anyway."""
+    t0 = time.perf_counter()
+    if not store.path:
+        raise SnapshotError(
+            "store is memory-only — snapshots need TPUDASH_TSDB_PATH"
+        )
+    if cut_head:
+        store.flush(seal_partial=True)
+    if store.last_disk_error:
+        raise SnapshotError(
+            f"segment writes are degraded ({store.last_disk_error}); "
+            "a snapshot now would miss sealed data"
+        )
+    now_ms = int(time.time() * 1000)  # tpulint: allow[wall-clock] snapshot names/manifest carry epoch stamps
+    name = _snapshot_name(now_ms)
+    staging = os.path.join(root, f".{name}.tmp")
+    entries: "list[dict]" = []
+    try:
+        # inside the try: an unmountable/read-only root must surface as
+        # SnapshotError (the auto-snapshot path catches exactly that —
+        # a bad snapshot volume must not kill the seal thread)
+        os.makedirs(root, exist_ok=True)
+        os.makedirs(staging)
+        # sizes + links under the segment-I/O lock: writes append whole
+        # CRC-framed records under this lock, so every captured size
+        # lands on a record boundary (point-in-time consistency even
+        # mid-seal), and reclaim cannot unlink a file out from under us
+        with store._io_lock:  # tpulint: allow[blocking-under-lock] dedicated segment-I/O lock (save_history pattern): link() is a metadata op, sizes must be record-aligned
+            for tier, segs in store._segs.items():
+                for _seq, path, newest in segs:
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue  # never materialized (no record yet)
+                    if size <= 0:
+                        continue
+                    base = os.path.basename(path)
+                    os.link(path, os.path.join(staging, base))
+                    entries.append(
+                        {
+                            "name": base,
+                            "tier": tier,
+                            "bytes": int(size),
+                            "newest_ms": int(newest),
+                        }
+                    )
+        # CRC outside every lock: the linked prefix [0, bytes) is
+        # immutable, so this races nothing
+        for e in entries:
+            e["crc32"] = _crc_file(
+                os.path.join(staging, e["name"]), e["bytes"]
+            )
+        write_manifest(
+            os.path.join(staging, MANIFEST_NAME),
+            {
+                "version": 1,
+                "created_ms": now_ms,
+                "store_path": os.path.abspath(store.path),
+                "files": entries,
+            },
+        )
+        final = os.path.join(root, name)
+        os.rename(staging, final)
+        _fsync_dir(root)
+    except OSError as e:
+        # disk full / dead volume mid-snapshot: degrade cleanly — remove
+        # the staging dir so no manifest-less husk masquerades as a
+        # snapshot, and surface the error to the caller
+        shutil.rmtree(staging, ignore_errors=True)
+        raise SnapshotError(f"snapshot into {root} failed: {e}") from e
+    gc_snapshots(
+        root,
+        keep=getattr(store, "snapshot_keep", 5),
+        retention_s=getattr(store, "snapshot_retention_s", 0.0),
+    )
+    out = {
+        "dir": final,
+        "files": len(entries),
+        "bytes": sum(e["bytes"] for e in entries),
+        "duration_ms": round((time.perf_counter() - t0) * 1e3, 2),
+    }
+    log.info(
+        "tsdb snapshot %s: %d segment file(s), %d bytes in %.1f ms",
+        final, out["files"], out["bytes"], out["duration_ms"],
+    )
+    return out
+
+
+def verify_snapshot(snap_dir: str) -> dict:
+    """Validate a snapshot set end to end WITHOUT copying anything:
+    manifest framing, then every listed segment present with at least
+    its recorded bytes and a matching CRC over exactly that prefix.
+    Returns the manifest.  Raises SnapshotError naming the first
+    mismatch — a torn set must be refused, never partially trusted."""
+    doc = read_manifest(snap_dir)
+    for e in doc["files"]:
+        path = os.path.join(snap_dir, str(e["name"]))
+        want = int(e["bytes"])
+        try:
+            size = os.path.getsize(path)
+        except OSError as err:
+            raise SnapshotError(
+                f"{snap_dir}: manifest lists {e['name']} but it is "
+                f"missing ({err})"
+            ) from err
+        if size < want:
+            raise SnapshotError(
+                f"{snap_dir}/{e['name']}: torn — {size} bytes on disk, "
+                f"manifest recorded {want}"
+            )
+        got = _crc_file(path, want)
+        if got != int(e["crc32"]):
+            raise SnapshotError(
+                f"{snap_dir}/{e['name']}: CRC mismatch over its "
+                f"{want}-byte snapshot prefix (manifest "
+                f"{e['crc32']:#010x}, file {got:#010x})"
+            )
+    return doc
+
+
+def restore_snapshot(snap_dir: str, dest_dir: str) -> dict:
+    """Restore a verified snapshot into an EMPTY directory.  All-or-
+    nothing: validation runs first (see :func:`verify_snapshot`); a copy
+    failure mid-restore cleans the destination back out before raising,
+    so there is never a silently partial store to open."""
+    doc = verify_snapshot(snap_dir)
+    os.makedirs(dest_dir, exist_ok=True)
+    leftover = [n for n in os.listdir(dest_dir) if not n.startswith(".")]
+    if leftover:
+        raise SnapshotError(
+            f"restore destination {dest_dir} is not empty "
+            f"(found {leftover[:3]}…); restore into a fresh directory "
+            "and swap it in"
+        )
+    copied: "list[str]" = []
+    try:
+        for e in doc["files"]:
+            src = os.path.join(snap_dir, str(e["name"]))
+            dst = os.path.join(dest_dir, str(e["name"]))
+            want = int(e["bytes"])
+            with open(src, "rb") as fin, open(dst, "wb") as fout:
+                remaining = want
+                while remaining > 0:
+                    chunk = fin.read(min(1 << 20, remaining))
+                    if not chunk:
+                        raise SnapshotError(
+                            f"{src} shrank mid-restore"
+                        )
+                    fout.write(chunk)
+                    remaining -= len(chunk)
+                fout.flush()
+                os.fsync(fout.fileno())
+            copied.append(dst)
+        _fsync_dir(dest_dir)
+    except (OSError, SnapshotError) as e:
+        for path in copied:
+            with contextlib.suppress(OSError):
+                os.remove(path)
+        if isinstance(e, SnapshotError):
+            raise
+        raise SnapshotError(f"restore into {dest_dir} failed: {e}") from e
+    return {
+        "dir": dest_dir,
+        "files": len(doc["files"]),
+        "bytes": sum(int(e["bytes"]) for e in doc["files"]),
+        "created_ms": doc.get("created_ms"),
+    }
+
+
+def list_snapshots(root: str) -> "list[str]":
+    """Complete snapshot dirs under ``root``, oldest first (names embed
+    their UTC timestamp, so lexical order is temporal order)."""
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        full = os.path.join(root, n)
+        if n.startswith("snap-") and os.path.isdir(full) and os.path.exists(
+            os.path.join(full, MANIFEST_NAME)
+        ):
+            out.append(full)
+    return out
+
+
+def gc_snapshots(
+    root: str, keep: int = 5, retention_s: float = 0.0
+) -> "list[str]":
+    """Retention-aware snapshot GC: keep the newest ``keep`` complete
+    snapshots, additionally dropping ones older than ``retention_s``
+    (0 = no age limit) — but the newest complete snapshot ALWAYS
+    survives (never delete the only backup).  Dead ``.snap-*.tmp``
+    staging dirs past a grace period are swept too.  Returns what was
+    removed."""
+    removed: "list[str]" = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return removed
+    now = time.time()  # tpulint: allow[wall-clock] GC compares snapshot epoch ages
+    complete = list_snapshots(root)
+    victims = set(complete[: max(0, len(complete) - max(1, int(keep)))])
+    if retention_s and retention_s > 0:
+        cutoff_ms = (now - retention_s) * 1000.0
+        for full in complete[:-1]:  # the newest always survives
+            try:
+                created = read_manifest(full).get("created_ms", 0)
+            except SnapshotError:
+                continue
+            if created < cutoff_ms:
+                victims.add(full)
+    for full in sorted(victims):
+        shutil.rmtree(full, ignore_errors=True)
+        removed.append(full)
+    for n in names:
+        if not (n.startswith(".snap-") and n.endswith(".tmp")):
+            continue
+        full = os.path.join(root, n)
+        with contextlib.suppress(OSError):
+            if now - os.path.getmtime(full) > _STAGING_GRACE_S:
+                shutil.rmtree(full, ignore_errors=True)
+                removed.append(full)
+    if removed:
+        log.info("tsdb snapshot GC removed %d dir(s)", len(removed))
+    return removed

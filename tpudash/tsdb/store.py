@@ -229,9 +229,31 @@ class TSDB:
         retention_1m_s: float = 7 * 86400.0,
         retention_10m_s: float = 30 * 86400.0,
         flush_interval_s: float = 0.0,
+        read_only: bool = False,
+        snapshot_dir: str = "",
+        snapshot_interval_s: float = 0.0,
+        snapshot_keep: int = 5,
+        snapshot_retention_s: float = 0.0,
     ) -> None:
         self.path = path
+        #: read-only mode: serve queries over an existing segment set
+        #: (another instance's directory, or a snapshot) without ever
+        #: appending, persisting, truncating, or reclaiming — the
+        #: follower (tpudash/tsdb/follower.py) and the inspection CLI
+        #: ride this; a live leader's files are never mutated
+        self.read_only = bool(read_only)
         self.chunk_points = max(2, int(chunk_points))
+        #: online-snapshot knobs (tpudash/tsdb/snapshot.py): with a dir
+        #: and an interval set, the seal thread snapshots right after a
+        #: chunk lands on disk — the ingest path never pauses for it
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_interval_ms = int(max(0.0, snapshot_interval_s) * 1000)
+        self.snapshot_keep = max(1, int(snapshot_keep))
+        self.snapshot_retention_s = max(0.0, float(snapshot_retention_s))
+        self._last_snapshot_mono: "float | None" = None
+        self.last_snapshot: "dict | None" = None
+        self.last_snapshot_error: "str | None" = None
+        self.snapshots_taken = 0
         #: seal a partial head after this long anyway (0 = off) — bounds
         #: the crash-loss window in wall time on slow cadences
         self.flush_interval_ms = int(max(0.0, flush_interval_s) * 1000)
@@ -279,6 +301,10 @@ class TSDB:
             retention_1m_s=cfg.tsdb_retention_1m,
             retention_10m_s=cfg.tsdb_retention_10m,
             flush_interval_s=cfg.tsdb_flush_interval,
+            snapshot_dir=cfg.tsdb_snapshot_dir,
+            snapshot_interval_s=cfg.tsdb_snapshot_interval,
+            snapshot_keep=cfg.tsdb_snapshot_keep,
+            snapshot_retention_s=cfg.tsdb_snapshot_retention,
         )
 
     # -- ingest --------------------------------------------------------------
@@ -288,7 +314,7 @@ class TSDB:
         population change (chip churn, new metric) seals the current
         head with ITS alignment and starts a fresh one — old blocks keep
         serving the departed chip's history."""
-        if self.paused or self._closed:
+        if self.paused or self._closed or self.read_only:
             return
         ts_ms = gorilla.ts_to_ms(ts_s)
         mat = np.asarray(matrix, dtype=np.float32)
@@ -368,9 +394,10 @@ class TSDB:
                     for r in rolls:
                         self._rollups[r.tier_ms].append(r)
                     self.version += 1
-                if self.path:
+                if self.path and not self.read_only:
                     self._persist(block, rolls)
                 self._enforce_retention()
+                self._maybe_autosnapshot()
 
     def flush(self, seal_partial: bool = False) -> None:
         """Synchronously seal everything pending (and, with
@@ -391,6 +418,40 @@ class TSDB:
             return
         self.flush(seal_partial=True)
         self._closed = True
+
+    def _maybe_autosnapshot(self) -> None:
+        """Interval-gated online snapshot, run at the tail of a seal
+        drain (the snapshot module's ``cut_head=False`` path: the head
+        was just cut, and re-entering the seal gate from here would
+        deadlock).  Failures degrade to ``last_snapshot_error`` on
+        stats() — a full snapshot volume must not take sealing down."""
+        if (
+            not self.snapshot_dir
+            or not self.snapshot_interval_ms
+            or not self.path
+            or self.read_only
+        ):
+            return
+        now = time.monotonic()
+        if (
+            self._last_snapshot_mono is not None
+            and (now - self._last_snapshot_mono) * 1000
+            < self.snapshot_interval_ms
+        ):
+            return
+        self._last_snapshot_mono = now
+        from tpudash.tsdb import snapshot as snapmod
+
+        try:
+            self.last_snapshot = snapmod.take_snapshot(
+                self, self.snapshot_dir, cut_head=False
+            )
+            self.snapshots_taken += 1
+            self.last_snapshot_error = None
+        except snapmod.SnapshotError as e:
+            if str(e) != self.last_snapshot_error:
+                log.warning("tsdb auto-snapshot failed: %s", e)
+            self.last_snapshot_error = str(e)
 
     # -- persistence ---------------------------------------------------------
     def _tier_name(self, tier_ms: int) -> str:
@@ -476,7 +537,10 @@ class TSDB:
                 except ValueError:
                     continue
                 newest = self._load_segment(
-                    full, truncate_tail=(i == len(tier_files) - 1)
+                    full,
+                    truncate_tail=(
+                        i == len(tier_files) - 1 and not self.read_only
+                    ),
                 )
                 self._segs[tier].append([seq, full, newest])
         self._enforce_retention()
@@ -559,6 +623,8 @@ class TSDB:
     # whole-file reclaim: a segment goes once its newest record expired
     # for its tier (the current append target is kept)
     def _reclaim_segments(self, now: int) -> None:
+        if self.read_only:
+            return  # never delete another instance's files
         with self._io_lock:  # tpulint: allow[blocking-under-lock] dedicated segment-I/O lock (save_history pattern), never the in-memory lock
             for tier, tier_ms in (("raw", 0), ("1m", TIER_1M_MS),
                                   ("10m", TIER_10M_MS)):
@@ -733,7 +799,15 @@ class TSDB:
                     _TIER_NAMES[t]: len(v) for t, v in self._rollups.items()
                 },
                 "persisted": bool(self.path),
+                "read_only": self.read_only,
                 "last_disk_error": self.last_disk_error,
+            }
+        if self.snapshot_dir:
+            out["snapshots"] = {
+                "dir": self.snapshot_dir,
+                "taken": self.snapshots_taken,
+                "last": self.last_snapshot,
+                "last_error": self.last_snapshot_error,
             }
         lo = self.earliest_ms(0)
         hi = self.latest_ms()
