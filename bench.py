@@ -740,6 +740,69 @@ def bench_snapshot(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> d
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_federation(
+    child_counts=(2, 8, 16), frames: int = 12, chips_per_child: int = 256
+) -> dict:
+    """The federation parent's fan-in cost: scrape→render p50 of a fleet
+    frame vs child count (ISSUE 9 — the path that turns the 4096-chip
+    single-process wall into an N×child aggregation problem).
+
+    Children are in-memory summary clients replaying ONE real child's
+    serialized ``/api/summary`` document (produced by a live 256-chip
+    service, so the wire shape is exactly production's), each poll
+    returning a freshly-decoded copy under a new ETag — the worst case:
+    every child changed every tick, no 304s.  The measured number is
+    therefore the PARENT's whole pipeline — summary JSON decode × N,
+    batch union, normalize, alerts, compose — with child HTTP and child
+    compose excluded, exactly as Prometheus's own response assembly is
+    excluded from the frame benches.  16 × 256 = the 4,096-chip shape
+    the single-process wall was measured at."""
+    import json as _json
+
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.federation.client import SummaryResult
+    from tpudash.federation.source import ChildSpec, FederatedSource
+
+    child = _bench_service(chips_per_child)
+    child.render_frame()
+    blob = _dumps(child.summary_doc())
+
+    class _ReplayClient:
+        def __init__(self):
+            self.v = 0
+
+        def fetch(self, etag, timeout):
+            self.v += 1
+            return SummaryResult(doc=_json.loads(blob), etag=f"e{self.v}")
+
+    out = {}
+    for n in child_counts:
+        specs = [ChildSpec(f"c{i}", f"http://c{i}") for i in range(n)]
+        cfg = Config(
+            federate=",".join(f"{s.name}={s.url}" for s in specs),
+            federate_hedge=0.0,  # in-memory children never need hedging
+            refresh_interval=0.0,
+        )
+        src = FederatedSource(cfg, children=[(s, _ReplayClient()) for s in specs])
+        svc = DashboardService(cfg, src)
+        svc.render_frame()  # warm
+        svc.state.select_all(svc.available)
+        svc.timer.history.clear()
+        for _ in range(frames):
+            frame = svc.render_frame()
+            assert frame["error"] is None
+            assert len(frame["selected"]) == n * chips_per_child
+            assert not frame.get("partial"), "healthy fan-in marked partial"
+        p50 = svc.timer.percentile(0.5)
+        # the whole point of the tier: a fleet frame must fit the budget
+        assert p50 < BUDGET_S, (
+            f"federated fan-in at {n} children blew the budget: {p50:.2f}s"
+        )
+        out[f"federation_fanin_{n}_p50_ms"] = round(p50 * 1e3, 2)
+    return out
+
+
 def bench_probes(timeout_s: float = 300.0) -> dict:
     """On-chip probe numbers, isolated in a SUBPROCESS with a hard
     timeout: a wedged accelerator runtime (e.g. a tunneled chip whose
@@ -855,6 +918,14 @@ def find_regressions(
         "higher",
         1.0,
     )
+    # federation fan-in (ISSUE 9): time-domain whole-pipeline numbers on
+    # a noisy host — 2x swings flag (the size of a lost batch-union or
+    # summary-decode fast path, not scheduler jitter)
+    for key in (
+        "federation_fanin_8_p50_ms",
+        "federation_fanin_16_p50_ms",
+    ):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
     # durability tier (ISSUE 8): snapshot duration and follower replay
     # are time-domain on a noisy host — 2x swings flag (the hard
     # near-zero ingest-stall guard lives inside bench_snapshot itself)
@@ -919,6 +990,7 @@ def main() -> None:
     shed = bench_shed_latency()
     tsdb = bench_tsdb()
     snapshot = bench_snapshot()
+    federation = bench_federation()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -948,6 +1020,7 @@ def main() -> None:
         **shed,
         **tsdb,
         **snapshot,
+        **federation,
         "probes": probes,
         "cpu_ref_ms": cpu_reference_ms(),
         "cpu_ref_json_ms": cpu_reference_json_ms(),

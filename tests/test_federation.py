@@ -1,0 +1,767 @@
+"""Fleet federation tests (tpudash.federation, ISSUE 9).
+
+The degrade-per-child contract, unit-level: child lifecycle (join →
+dark → stale → dark → recovered), breaker open/half-open with
+decorrelated probe jitter, hedged retry, ETag/304 steady state over real
+HTTP, summary codec round trip, hierarchical alert re-namespacing with
+the anti-flap dwell, and the drill-down proxy's 502 mapping.  The live
+multi-process storm lives in ``python -m tpudash.chaos partition``
+(CI chaos-soak); these tests pin the semantics it drills.
+"""
+
+import asyncio
+import copy
+import json
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.app.server import DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config, load_config
+from tpudash.federation.client import SummaryResult
+from tpudash.federation.source import (
+    ChildSpec,
+    FederatedSource,
+    parse_children,
+)
+from tpudash.federation.summary import (
+    build_summary,
+    digest_alerts,
+    summary_to_batch,
+)
+from tpudash.hysteresis import DwellSet
+from tpudash.sources import make_source
+from tpudash.sources.base import SourceError
+from tpudash.sources.fixture import SyntheticSource
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- fixtures ----------------------------------------------------------------
+
+def _child_summary(chips: int = 8) -> dict:
+    """One real child's summary document (live service → build_summary)."""
+    cfg = Config(source="synthetic", synthetic_chips=chips)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=chips))
+    svc.render_frame()
+    return svc.summary_doc()
+
+
+class FakeClient:
+    """Scriptable summary client: failure injection, ETag rotation."""
+
+    def __init__(self, doc):
+        self.doc = doc
+        self.fail = False
+        self.v = 0
+        self.calls = 0
+
+    def bump(self, doc=None):
+        """New document version → next poll is a 200, not a 304."""
+        if doc is not None:
+            self.doc = doc
+        self.v += 1
+
+    def fetch(self, etag, timeout):
+        self.calls += 1
+        if self.fail:
+            raise SourceError("injected: connection refused")
+        tag = f"e{self.v}"
+        if etag == tag:
+            return SummaryResult(doc=None, etag=etag, not_modified=True)
+        return SummaryResult(doc=json.loads(json.dumps(self.doc)), etag=tag)
+
+
+def _federated(doc, names=("a", "b"), clock=None, **cfg_kw):
+    kw = dict(
+        federate=",".join(f"{n}=http://{n}" for n in names),
+        federate_hedge=0.0,
+        federate_stale_budget=10.0,
+        breaker_failures=2,
+        breaker_cooldown=5.0,
+    )
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    clients = {n: FakeClient(copy.deepcopy(doc)) for n in names}
+    src = FederatedSource(
+        cfg,
+        children=[(ChildSpec(n, f"http://{n}"), clients[n]) for n in names],
+        **({"clock": clock} if clock is not None else {}),
+    )
+    return src, clients, cfg
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- spec parsing ------------------------------------------------------------
+
+def test_parse_children_names_and_defaults():
+    kids = parse_children("east=http://e:8050,http://west.example:8051/")
+    assert [c.name for c in kids] == ["east", "west.example-8051"]
+    assert kids[1].url == "http://west.example:8051"  # trailing / stripped
+    with pytest.raises(ValueError):
+        parse_children("")
+    with pytest.raises(ValueError):
+        parse_children("a=http://x,a=http://y")  # duplicate name
+    with pytest.raises(ValueError):
+        ChildSpec("a/b", "http://x")  # '/' collides with the key separator
+
+
+def test_env_knobs():
+    cfg = load_config(
+        {
+            "TPUDASH_FEDERATE": "a=http://x",
+            "TPUDASH_FEDERATE_DEADLINE": "2.5",
+            "TPUDASH_FEDERATE_STALE_BUDGET": "12",
+            "TPUDASH_FEDERATE_HEDGE": "0.1",
+            "TPUDASH_ALERT_DWELL": "7",
+            "TPUDASH_BREAKER_JITTER": "0.25",
+        }
+    )
+    assert cfg.federate == "a=http://x"
+    assert cfg.federate_deadline == 2.5
+    assert cfg.federate_stale_budget == 12.0
+    assert cfg.federate_hedge == 0.1
+    assert cfg.alert_dwell == 7.0
+    assert cfg.breaker_jitter == 0.25
+
+
+def test_make_source_prefers_federation():
+    src = make_source(Config(federate="a=http://localhost:1", source="synthetic"))
+    # wrapped for the health ledger, retries owned by the breakers
+    assert src.name == "federated+retry"
+    assert src.policy.retries == 0
+
+
+# -- summary codec -----------------------------------------------------------
+
+def test_summary_round_trips_the_child_table():
+    doc = _child_summary(chips=8)
+    assert doc["v"] == 1 and doc["chips"] == 8
+    assert doc["error"] is None and not doc["partial"]
+    assert len(doc["keys"]) == 8 == len(doc["matrix"])
+    assert doc["fleet"]  # zero-exclusion averages present
+    json.dumps(doc)  # JSON-able whole
+    batch = summary_to_batch("east", doc)
+    assert batch.nrows == 8
+    assert all(s.startswith("east/") for s in batch.slices)
+    # values survive the null round trip
+    from tpudash.normalize import to_wide
+
+    df = to_wide(batch)
+    assert len(df) == 8
+    assert df.index[0].startswith("east/")
+    col = doc["cols"][0]
+    assert col in df.columns
+
+
+def test_summary_refuses_malformed():
+    doc = _child_summary()
+    with pytest.raises(ValueError):
+        summary_to_batch("x", {"v": 99})  # version skew
+    broken = copy.deepcopy(doc)
+    broken["identity"]["chip_id"] = broken["identity"]["chip_id"][:-1]
+    with pytest.raises(ValueError):
+        summary_to_batch("x", broken)  # length disagreement
+    with pytest.raises(ValueError):
+        summary_to_batch("x", "not a dict")
+    # an empty child (no table yet) is valid, not malformed
+    assert summary_to_batch("x", {"v": 1, "ts": 0.0}) is None
+
+
+def test_malformed_doc_of_any_shape_refuses_per_child_not_fleet_wide():
+    """A half-shaped doc (KeyError/TypeError territory, not just the
+    explicit ValueError checks) must fail THAT child's poll — siblings
+    keep serving, the fleet frame never errors."""
+    doc = _child_summary()
+    src, clients, _cfg = _federated(doc)
+    # v:1 with keys/cols present but identity missing its arrays →
+    # KeyError inside the codec; matrix of garbage → TypeError
+    clients["b"].doc = {
+        "v": 1, "ts": 0.0, "keys": ["k"], "cols": ["c"],
+        "identity": {}, "matrix": [[0.0]],
+    }
+    clients["b"].bump()
+    batch = src.fetch()  # must NOT raise
+    assert batch.nrows == 8  # a alone (b had no prior good table)
+    assert "malformed summary" in src.last_errors["b"]
+    assert src.breakers["b"].consecutive_failures == 1
+    clients["a"].doc = {"v": 1, "keys": ["k"], "cols": ["c"],
+                        "identity": None, "matrix": None}
+    clients["a"].bump()
+    # a's doc goes malformed too (TypeError shape): the poll fails per
+    # child while a's RETAINED last-good rows keep the frame serving
+    batch = src.fetch()
+    assert batch.nrows == 8
+    assert "malformed summary" in src.last_errors["a"]
+    assert src.federation_summary()["children"]["a"]["status"] == "stale"
+    assert src.federation_summary()["partial"] is True
+
+
+def test_tableless_child_fades_stale_not_silently_vanishing():
+    """A child that ANSWERS but carries no table (restarting against a
+    dead upstream: 200, error set, no rows) must keep serving its
+    retained rows as ``stale`` — with fleet_partial signaling — and
+    fade to dark on the stale budget, never vanish as a 'live' child."""
+    doc = _child_summary()
+    clock = _Clock()
+    src, clients, cfg = _federated(doc, clock=clock)
+    assert src.fetch().nrows == 16
+    # b restarts: valid doc, no table, its own error carried
+    clients["b"].bump({"v": 1, "ts": 1.0, "chips": 0,
+                       "error": "Error fetching TPU metrics: down",
+                       "alerts": [], "partial": False, "health": None,
+                       "stalled": None})
+    clock.t = 1.0
+    assert src.fetch().nrows == 16  # retained rows still serve
+    fs = src.federation_summary()
+    assert fs["children"]["b"]["status"] == "stale"
+    assert fs["partial"] is True
+    # the service-side rollup names the child-side cause
+    svc = DashboardService(cfg, src)
+    alerts = svc._federation_alerts(0.0)
+    fp = [a for a in alerts if a["rule"] == "fleet_partial"]
+    assert fp and fp[0]["state"] == "firing"
+    # past the budget the retained rows drop — dark, not live-and-empty
+    clock.t = 12.0
+    assert src.fetch().nrows == 8
+    assert src.federation_summary()["children"]["b"]["status"] == "dark"
+    # recovery: the table comes back → live with all rows
+    clients["b"].bump(doc)
+    clock.t = 13.0
+    assert src.fetch().nrows == 16
+    assert src.federation_summary()["children"]["b"]["status"] == "live"
+
+
+def test_digest_alerts_renames_and_drops_silenced():
+    doc = {
+        "alerts": [
+            {"rule": "t>85", "chip": "slice-0/3", "state": "firing"},
+            {"rule": "t>85", "chip": "slice-0/4", "state": "firing",
+             "silenced": True},
+            {"rule": "overload", "chip": "server", "state": "firing"},
+            "garbage",
+        ]
+    }
+    out = digest_alerts("east", doc)
+    assert [(a["rule"], a["chip"]) for a in out] == [
+        ("t>85", "east/slice-0/3"),
+        ("overload", "east/server"),
+    ]
+    assert all(a["child"] == "east" for a in out)
+
+
+# -- child lifecycle ---------------------------------------------------------
+
+def test_child_lifecycle_join_stale_dark_recover():
+    doc = _child_summary()
+    clock = _Clock()
+    src, clients, _cfg = _federated(doc, clock=clock)
+    # join: b is dark at startup (never answered)
+    clients["b"].fail = True
+    batch = src.fetch()
+    assert batch.nrows == 8  # a alone
+    fs = src.federation_summary()
+    assert fs["children"]["b"]["status"] == "dark"
+    assert fs["children"]["b"]["staleness_s"] is None  # never contacted
+    assert fs["partial"] is True
+    # b joins
+    clients["b"].fail = False
+    clock.t = 1.0
+    assert src.fetch().nrows == 16
+    fs = src.federation_summary()
+    assert fs["children"]["b"]["status"] == "live" and not fs["partial"]
+    # b partitions: last-good serves, marked stale with measured staleness
+    clients["b"].fail = True
+    clock.t = 2.0
+    assert src.fetch().nrows == 16
+    fs = src.federation_summary()
+    assert fs["children"]["b"]["status"] == "stale"
+    assert fs["children"]["b"]["staleness_s"] == pytest.approx(1.0)
+    assert fs["partial"] is True and fs["children_stale"] == 1
+    # second failure opens the breaker (failures=2)
+    clock.t = 3.0
+    src.fetch()
+    assert src.breakers["b"].state == "open"
+    assert "circuit open" not in (src.last_errors.get("b") or "")
+    # breaker-open cycles skip b at zero cost but keep serving last-good
+    clock.t = 4.0
+    assert src.fetch().nrows == 16
+    assert "circuit open" in src.last_errors["b"]
+    assert clients["b"].calls == 4  # 2 ok + 2 failed; quarantine = no call
+    # past the stale budget: dark, rows drop, frame still serves
+    clock.t = 12.0
+    assert src.fetch().nrows == 8
+    assert src.federation_summary()["children"]["b"]["status"] == "dark"
+    # heal: past cooldown(+jitter) the half-open probe recloses
+    clients["b"].fail = False
+    clock.t = 30.0
+    assert src.fetch().nrows == 16
+    fs = src.federation_summary()
+    assert fs["children"]["b"]["status"] == "live"
+    assert src.breakers["b"].state == "closed"
+    assert not fs["partial"]
+
+
+def test_all_dark_raises_with_detail():
+    doc = _child_summary()
+    src, clients, _cfg = _federated(doc)
+    for c in clients.values():
+        c.fail = True
+    with pytest.raises(SourceError) as ei:
+        src.fetch()
+    msg = str(ei.value)
+    assert "2 federated children dark" in msg
+    assert "connection refused" in msg
+
+
+def test_304_keeps_last_good_and_counts():
+    doc = _child_summary()
+    clock = _Clock()
+    src, clients, _cfg = _federated(doc, names=("a",), clock=clock)
+    assert src.fetch().nrows == 8
+    clock.t = 1.0
+    assert src.fetch().nrows == 8  # revalidated, same table
+    st = src._children[0]
+    assert st.counters["etag_304s"] == 1
+    # a 304 is CONTACT: staleness resets even though data stood still
+    fs = src.federation_summary()
+    assert fs["children"]["a"]["status"] == "live"
+    assert fs["children"]["a"]["staleness_s"] == pytest.approx(0.0)
+
+
+def test_hedged_retry_second_request_wins():
+    doc = _child_summary()
+
+    class SlowFirst:
+        def __init__(self):
+            self.calls = 0
+            self.gate = threading.Event()
+
+        def fetch(self, etag, timeout):
+            self.calls += 1
+            if self.calls == 1:
+                # the primary wedges until teardown — only the hedge
+                # can answer inside the deadline
+                self.gate.wait(5.0)
+                raise SourceError("primary wedged")
+            return SummaryResult(
+                doc=json.loads(json.dumps(doc)), etag="e1"
+            )
+
+    client = SlowFirst()
+    cfg = Config(
+        federate="a=http://a",
+        federate_hedge=0.05,
+        federate_deadline=2.0,
+    )
+    src = FederatedSource(cfg, children=[(ChildSpec("a", "http://a"), client)])
+    batch = src.fetch()
+    assert batch.nrows == 8
+    st = src._children[0]
+    assert st.counters["hedges"] == 1
+    assert st.counters["hedge_wins"] == 1
+    client.gate.set()  # release the parked primary thread
+
+
+# -- parent service integration ----------------------------------------------
+
+def test_parent_frame_partial_alerts_and_health():
+    doc = _child_summary()
+    src, clients, cfg = _federated(doc, breaker_cooldown=500.0)
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    assert frame["error"] is None and len(frame["chips"]) == 16
+    assert "partial" not in frame
+    assert frame["federation"]["children_live"] == 2
+    # partition b
+    clients["b"].fail = True
+    svc.render_frame()
+    frame = svc.render_frame()  # second failure → breaker open → firing
+    assert frame["partial"] is True
+    assert len(frame["chips"]) == 16  # last-good still rendering
+    rules = {(a["rule"], a["chip"], a["state"]) for a in frame["alerts"]}
+    assert ("child_down", "b", "firing") in rules
+    assert any(r == "fleet_partial" and s == "firing" for r, _c, s in rules)
+    assert frame["source_health"]["status"] == "degraded"
+    assert frame["source_health"]["federation"]["children"]["b"]["status"] == "stale"
+    assert any("fleet view partial" in w for w in frame["warnings"])
+
+
+def test_child_alerts_renamespaced_through_parent():
+    doc = _child_summary()
+    doc["alerts"] = [
+        {
+            "rule": "tpu_temperature_celsius>85",
+            "column": "tpu_temperature_celsius",
+            "severity": "critical",
+            "chip": "slice-0/3",
+            "value": 99.0,
+            "threshold": 85.0,
+            "state": "firing",
+            "since": 1.0,
+            "streak": 3,
+        }
+    ]
+    src, _clients, cfg = _federated(doc, names=("east",))
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    hits = [
+        a for a in frame["alerts"] if a["chip"] == "east/slice-0/3"
+    ]
+    assert hits and hits[0]["child"] == "east"
+    assert hits[0]["state"] == "firing"
+
+
+def test_dwell_holds_child_alert_through_recovery():
+    doc = _child_summary()
+    doc_alert = copy.deepcopy(doc)
+    doc_alert["alerts"] = [
+        {"rule": "t>85", "column": "t", "severity": "critical",
+         "chip": "slice-0/3", "value": 99.0, "threshold": 85.0,
+         "state": "firing", "since": 1.0, "streak": 3}
+    ]
+    src, clients, cfg = _federated(
+        doc_alert, names=("a",), alert_dwell=3600.0
+    )
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    assert any(a["chip"] == "a/slice-0/3" for a in frame["alerts"])
+    # the child's alert resolves; the dwell holds it firing, flagged
+    clients["a"].bump(doc)
+    frame = svc.render_frame()
+    held = [a for a in frame["alerts"] if a["chip"] == "a/slice-0/3"]
+    assert held and held[0]["state"] == "firing"
+    assert held[0]["dwell"] is True
+    assert "dwell" in held[0]["detail"]
+    # no resolved webhook while held: the firing-key set never shrank
+    assert ("t>85", "a/slice-0/3") in svc._firing_keys
+
+
+def test_flap_fault_does_not_flap_endpoint_down_under_dwell():
+    """Satellite: the chaos ``flap`` fault against a multi-source child
+    must not resolve-flap the synthesized endpoint_down alert when the
+    anti-flap dwell is on (and must flap without it — the contrast that
+    proves the dwell is doing the work)."""
+    from tpudash.sources.chaos import ChaosSource
+    from tpudash.sources.multi import EndpointSpec, MultiSource
+
+    def build(dwell):
+        cfg = Config(
+            alert_dwell=dwell,
+            breaker_failures=1,
+            breaker_cooldown=0.0,  # probe every frame → fast reclose
+            refresh_interval=0.0,
+        )
+        healthy = SyntheticSource(num_chips=4)
+        flappy = ChaosSource(
+            SyntheticSource(num_chips=4), "flap:period=2;seed=1"
+        )
+        src = MultiSource(
+            cfg,
+            children=[
+                (EndpointSpec(url="s://a", slice_name="a"), healthy),
+                (EndpointSpec(url="s://b", slice_name="b"), flappy),
+            ],
+        )
+        return DashboardService(cfg, src)
+
+    def firing_series(svc, frames=6):
+        out = []
+        for _ in range(frames):
+            frame = svc.render_frame()
+            out.append(
+                any(
+                    a["rule"] == "endpoint_down"
+                    and a["chip"] == "b"
+                    and a["state"] == "firing"
+                    for a in frame.get("alerts") or []
+                )
+            )
+        return out
+
+    # without dwell the alert resolve-flaps with the endpoint
+    bare = firing_series(build(dwell=0.0))
+    assert True in bare and False in bare[bare.index(True):], bare
+    # with dwell: once fired, firing in EVERY later frame
+    held = firing_series(build(dwell=3600.0))
+    first = held.index(True)
+    assert all(held[first:]), held
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+def _child_server(chips=8):
+    cfg = Config(
+        source="synthetic", synthetic_chips=chips, refresh_interval=60.0
+    )
+    return DashboardServer(
+        DashboardService(cfg, SyntheticSource(num_chips=chips))
+    )
+
+
+def test_summary_endpoint_etag_304_steady_state():
+    async def go():
+        server = _child_server()
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/api/summary")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["v"] == 1 and doc["chips"] == 8
+            etag = r.headers["ETag"]
+            # steady state: the data didn't advance (60 s interval), so
+            # the revalidation poll is a bodyless 304
+            r2 = await client.get(
+                "/api/summary", headers={"If-None-Match": etag}
+            )
+            assert r2.status == 304
+            assert await r2.read() == b""
+            assert r2.headers["ETag"] == etag
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_parent_federates_real_http_child_and_hits_304():
+    async def go():
+        child = _child_server()
+        cs = TestServer(child.build_app())
+        await cs.start_server()
+        pcfg = Config(
+            federate=f"east=http://127.0.0.1:{cs.port}",
+            refresh_interval=60.0,
+            federate_hedge=0.0,
+        )
+        parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
+        pc = TestClient(TestServer(parent.build_app()))
+        await pc.start_server()
+        try:
+            r = await pc.get("/api/frame")
+            frame = await r.json()
+            assert frame["error"] is None
+            assert len(frame["chips"]) == 8
+            assert frame["chips"][0]["key"].startswith("east/")
+            # second poll revalidates (child data stood still) — the
+            # acceptance bar: steady-state child polls hit the 304 path
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, parent.service.source.fetch)
+            hz = await (await pc.get("/healthz")).json()
+            east = hz["federation"]["children"]["east"]
+            assert east["counters"]["etag_304s"] >= 1
+            assert east["status"] == "live"
+            assert hz["ok"] is True
+        finally:
+            await pc.close()
+            await cs.close()
+
+    _run(go())
+
+
+def test_child_proxy_drilldown_and_502_mapping():
+    async def go():
+        child = _child_server()
+        cs = TestServer(child.build_app())
+        await cs.start_server()
+        pcfg = Config(
+            federate=f"east=http://127.0.0.1:{cs.port}",
+            refresh_interval=60.0,
+            federate_hedge=0.0,
+        )
+        parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
+        pc = TestClient(TestServer(parent.build_app()))
+        await pc.start_server()
+        try:
+            frame = await (await pc.get("/api/frame")).json()
+            child_key = frame["chips"][0]["key"].split("/", 1)[1]
+            r = await pc.get(f"/api/child/east/api/chip?key={child_key}")
+            assert r.status == 200
+            detail = await r.json()
+            assert detail["key"] == child_key
+            # hygiene: the hop never forwards Connection et al.
+            r = await pc.get(
+                f"/api/child/east/api/chip?key={child_key}",
+                headers={"Connection": "keep-alive", "TE": "trailers"},
+            )
+            assert r.status == 200
+            # unknown child / non-API tail → 404 here, not a hop
+            assert (await pc.get("/api/child/nope/api/frame")).status == 404
+            assert (await pc.get("/api/child/east/index.html")).status == 404
+            # dot segments must not smuggle a non-API route past the
+            # prefix check (yarl would normalize api/../x → /x)
+            for sneaky in (
+                "/api/child/east/api/../internal/cohort",
+                "/api/child/east/api/%2e%2e/internal/cohort",
+                "/api/child/east/api/./../healthz/../internal/cohort",
+                "/api/child/east/api//internal",
+            ):
+                from yarl import URL
+
+                r = await pc.get(URL(sneaky, encoded=True))
+                assert r.status == 404, (sneaky, r.status)
+            # dead child → 502 (the child is the broken upstream)
+            await cs.close()
+            r = await pc.get(f"/api/child/east/api/chip?key={child_key}")
+            assert r.status == 502
+            assert "unreachable" in await r.text()
+        finally:
+            await pc.close()
+
+    _run(go())
+
+
+def test_non_federated_server_404s_summary_consumers():
+    async def go():
+        server = _child_server()
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            # a leaf still SERVES /api/summary (that's how it federates
+            # upward) but has no children to proxy into
+            assert (await client.get("/api/summary")).status == 200
+            assert (await client.get("/api/child/x/api/frame")).status == 404
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+# -- dwell + jitter units ----------------------------------------------------
+
+def test_dwellset_semantics():
+    t = _Clock()
+    ds = DwellSet(dwell_s=5.0, clock=t)
+    e = {"rule": "child_down", "chip": "c0", "state": "firing", "detail": "x"}
+    assert ds.apply([e]) == [e]
+    t.t = 2.0
+    held = ds.apply([])
+    assert len(held) == 1 and held[0]["dwell"] is True
+    assert held[0]["state"] == "firing"
+    # a pending demotion is upgraded back to firing, not duplicated
+    t.t = 3.0
+    pend = dict(e, state="pending")
+    out = ds.apply([pend])
+    assert len(out) == 1 and out[0]["state"] == "firing"
+    # clear past the dwell
+    t.t = 20.0
+    assert ds.apply([]) == []
+    assert len(ds) == 0
+    # dwell_s=0 is a transparent pass-through
+    ds0 = DwellSet(dwell_s=0.0, clock=t)
+    assert ds0.apply([e]) == [e] and ds0.apply([]) == []
+
+
+def test_worker_outage_age_anchored_across_flaps(monkeypatch):
+    """Satellite: the worker's compose_down alert keeps ONE outage
+    identity (monotonically growing age) across bus-link flaps shorter
+    than the dwell — a forwarder sees one incident, not one per flap."""
+    from tpudash.broadcast.worker import FanoutWorker
+
+    class _Mirror:
+        disconnected_since = None
+
+    worker = FanoutWorker.__new__(FanoutWorker)
+    worker.cfg = Config(alert_dwell=5.0)
+    worker.mirror = _Mirror()
+    worker._outage_anchor = None
+    worker._outage_seen = 0.0
+    now = {"t": 100.0}
+    monkeypatch.setattr(
+        "tpudash.broadcast.worker.time",
+        type("T", (), {"monotonic": staticmethod(lambda: now["t"])}),
+    )
+    worker.mirror.disconnected_since = 100.0
+    assert worker._outage_age() == pytest.approx(0.0)
+    now["t"] = 102.0
+    assert worker._outage_age() == pytest.approx(2.0)
+    # flap: link back briefly, then down again WITHIN the dwell — the
+    # age keeps growing from the original anchor, not from the re-drop
+    now["t"] = 103.0
+    worker.mirror.disconnected_since = 103.0
+    assert worker._outage_age() == pytest.approx(3.0)
+    # a NEW outage past the dwell window gets a fresh anchor
+    now["t"] = 120.0
+    worker.mirror.disconnected_since = 119.5
+    assert worker._outage_age() == pytest.approx(0.5)
+
+
+def test_breaker_probe_jitter_decorrelates_reopens():
+    """Satellite: N breakers opened by one shared partition must not
+    all probe at the same instant — the jittered reopen spread."""
+    import random
+
+    from tpudash.sources.breaker import BreakerPolicy, CircuitBreaker
+
+    brs = [
+        CircuitBreaker(
+            BreakerPolicy(failures=1, cooldown=10.0, probe_jitter=0.5),
+            clock=lambda: 0.0,
+            rng=random.Random(i),
+        )
+        for i in range(64)
+    ]
+    for b in brs:
+        b.record_failure()
+    waits = sorted(b.effective_cooldown for b in brs)
+    assert waits[0] >= 10.0 and waits[-1] <= 15.0
+    assert waits[-1] - waits[0] > 2.0, "no spread — probes synchronized"
+    assert len({round(w, 6) for w in waits}) > 32, "waits collapsed"
+    # a fresh open draws fresh jitter (decorrelated across opens too)
+    b = brs[0]
+    first = b.effective_cooldown
+    drawn = set()
+    for _ in range(8):
+        b.record_success()
+        b.record_failure()
+        drawn.add(round(b.effective_cooldown, 6))
+    assert len(drawn | {round(first, 6)}) > 4
+    # probe_jitter=0 keeps the exact-cooldown contract
+    t = _Clock()
+    b0 = CircuitBreaker(BreakerPolicy(failures=1, cooldown=5.0), clock=t)
+    b0.record_failure()
+    t.t = 5.0
+    assert b0.allow()
+
+
+def test_chaos_partition_fault_three_shapes():
+    """Satellite: the chaos ``partition`` fault distinguishes the three
+    network failure modes — refuse (instant), hang (one silent block),
+    drip (progress that never completes)."""
+    from tpudash.sources.chaos import ChaosScenario, ChaosSource
+
+    inner = SyntheticSource(num_chips=2)
+
+    def run(spec):
+        sleeps = []
+        src = ChaosSource(inner, spec, sleep=sleeps.append)
+        with pytest.raises(SourceError) as ei:
+            src.fetch()
+        return sleeps, str(ei.value), src.injected
+
+    sleeps, msg, injected = run("partition:mode=refuse")
+    assert sleeps == [] and "refused" in msg
+    assert injected["partition_refuse"] == 1
+    sleeps, msg, injected = run("partition:mode=hang,ms=2000")
+    assert sleeps == [2.0] and "hung" in msg  # ONE silent block
+    assert injected["partition_hang"] == 1
+    sleeps, msg, injected = run("partition:mode=drip,ms=2000")
+    assert len(sleeps) == 10 and sum(sleeps) == pytest.approx(2.0)
+    assert "drip" in msg
+    assert injected["partition_drip"] == 1
+    # grammar: bad mode / missing ms fail loudly at parse time
+    with pytest.raises(ValueError):
+        ChaosScenario.parse("partition:mode=bogus")
+    with pytest.raises(ValueError):
+        ChaosScenario.parse("partition:mode=drip,ms=0")

@@ -70,17 +70,59 @@ def test_exhausted_retries_raise_and_count_one_failure():
     assert src.health.status == "degraded"
 
 
-def test_backoff_grows_and_is_capped():
+def test_backoff_bounded_and_widening():
     src, sleeps = _resilient(fail_times=10, retries=4)
     try:
         src.fetch()
     except SourceError:
         pass
-    # full jitter: each sleep is within [0, min(max, base*2^k)]
-    caps = [0.25, 0.5, 1.0, 2.0]
+    # decorrelated jitter: every sleep lands in [base, max_backoff], and
+    # each draw's window chains on the previous sleep ([base, 3×prev])
     assert len(sleeps) == 4
-    for s, cap in zip(sleeps, caps):
-        assert 0.0 <= s <= cap
+    for i, s in enumerate(sleeps):
+        assert 0.25 <= s <= 2.0
+        if i:
+            assert s <= max(0.25, 3.0 * sleeps[i - 1]) + 1e-9
+
+
+def test_backoff_decorrelates_across_clients():
+    """Satellite (ISSUE 9): N sources failing at the same instant — a
+    shared partition cutting every federated child at once — must not
+    produce synchronized retry waves.  With plain exponential-full-jitter
+    every client's attempt-k window is identical; decorrelated jitter
+    chains each client on its OWN previous sleep, so per-attempt spread
+    must be wide relative to the window."""
+    import statistics
+
+    policy = RetryPolicy(retries=4, base_backoff=0.25, max_backoff=2.0)
+    clients = []
+    for seed in range(64):
+        rng, prev, seq = random.Random(seed), None, []
+        for attempt in range(4):
+            prev = policy.backoff(attempt, rng, prev=prev)
+            seq.append(prev)
+        clients.append(seq)
+    for attempt in range(4):
+        draws = [seq[attempt] for seq in clients]
+        assert all(0.25 <= d <= 2.0 for d in draws)
+        assert len({round(d, 9) for d in draws}) > 48, "draws collapsed"
+        # spread: the fleet's attempt-k sleeps cover a wide band, not a
+        # point — stdev well above zero against a ≤1.75 s window
+        assert statistics.pstdev(draws) > 0.05, (attempt, draws[:5])
+    # total-schedule divergence: no two clients retry in lockstep
+    totals = sorted(sum(seq) for seq in clients)
+    assert totals[-1] - totals[0] > 1.0
+
+
+def test_stateless_backoff_still_spreads():
+    # callers without a chain (prev=None) seed the window at base·2^k —
+    # attempt-k draws still spread instead of collapsing onto the base
+    policy = RetryPolicy(base_backoff=0.25, max_backoff=2.0)
+    draws = [
+        policy.backoff(2, random.Random(seed)) for seed in range(32)
+    ]
+    assert all(0.25 <= d <= 2.0 for d in draws)
+    assert max(draws) - min(draws) > 0.3
 
 
 def test_frame_budget_stops_retries():

@@ -55,14 +55,22 @@ def sort_alerts(alerts: "list[dict]") -> "list[dict]":
 
 #: Rule names synthesized OUTSIDE the engine — service-level conditions
 #: (a quarantined endpoint, the server shedding load, the worker tier's
-#: compose process being down) shaped like engine output so silences,
-#: the webhook pager, and the banner treat them exactly like a breaching
-#: chip.  The service strips and re-synthesizes ``endpoint_down`` and
-#: ``overload`` on every publish; ``compose_down`` is synthesized by the
-#: fan-out workers while they serve stale mirrors through a compose
-#: outage (tpudash/broadcast/worker.py) — it can never originate from
-#: the compose process, which is the thing that is down.
-SYNTHESIZED_RULES = ("endpoint_down", "overload", "compose_down")
+#: compose process being down, a federated child dark or the fleet pane
+#: partial) shaped like engine output so silences, the webhook pager,
+#: and the banner treat them exactly like a breaching chip.  The service
+#: strips and re-synthesizes ``endpoint_down``, ``overload``,
+#: ``child_down``, and ``fleet_partial`` on every publish;
+#: ``compose_down`` is synthesized by the fan-out workers while they
+#: serve stale mirrors through a compose outage
+#: (tpudash/broadcast/worker.py) — it can never originate from the
+#: compose process, which is the thing that is down.
+SYNTHESIZED_RULES = (
+    "endpoint_down",
+    "overload",
+    "compose_down",
+    "child_down",
+    "fleet_partial",
+)
 
 
 def synthesized_alert(

@@ -143,6 +143,12 @@ def _build_stale_body(key: "tuple | None", frame: dict) -> tuple:
     return (key, raw, gzip.compress(raw, 6))
 
 
+def _build_summary_body(service: DashboardService) -> bytes:
+    """Serialized /api/summary document — executor-side (a 4096-chip
+    matrix dump must not run on the loop)."""
+    return _dumps(service.summary_doc()).encode()
+
+
 def _key_id(key: tuple) -> str:
     """Compose-cache key as an SSE event id ("dv-sv-stall")."""
     return "-".join(str(int(p)) for p in key)
@@ -236,6 +242,17 @@ class DashboardServer:
         #: (cid → seq) of the newest seal already handed to the bus — a
         #: tick that served a cached seal must not re-publish it
         self._published_seqs: dict = {}
+        #: (key, raw body) of the /api/summary document — built at most
+        #: once per (data_version, hub epoch, stalled) however many
+        #: federation parents poll, behind a single-flight gate; the
+        #: ETag derives from the key so steady-state polls answer 304
+        #: with no body and no executor work
+        self._summary_cache: "tuple[tuple | None, bytes | None]" = (None, None)
+        self._summary_build_lock = asyncio.Lock()
+        #: lazy HTTP session for the federation child drill-down proxy
+        #: (/api/child/...); None until the first proxied request, closed
+        #: on cleanup
+        self._child_session = None
         #: vendored plotly bundle (deploy-time property, resolved once);
         #: None → the page uses the CDN tag and /static 404s
         self._plotly_asset = find_plotly_asset(service.cfg.assets_dir)
@@ -533,6 +550,138 @@ class DashboardServer:
             if request.headers.get("If-None-Match") == etag:
                 return web.Response(status=304, headers=headers)
         return _json_response(frame, headers=headers)
+
+    def _summary_key(self) -> tuple:
+        """What one summary body is composed from — data version, the
+        hub's global-invalidation epoch (silences re-annotate the alert
+        digest), and the stall flag."""
+        return (
+            self._data_version,
+            self.hub.epoch,
+            bool(self.service.refresh_stalled),
+        )
+
+    async def summary(self, request: web.Request) -> web.Response:
+        """``GET /api/summary`` — the compact fleet-rollup document a
+        federation parent polls (tpudash.federation): per-chip latest
+        numeric columns, fleet averages, alert digest, source health.
+
+        Steady state is near-free: the ETag derives from (data_version,
+        hub epoch, stalled), so a parent whose ``If-None-Match`` still
+        matches gets ``304`` with no body, no executor hop, and no
+        serialization.  The body itself is built at most once per key
+        behind a single-flight gate, however many parents federate this
+        child.  Refreshes the shared scrape data like ``/api/frame``
+        does — a child serving ONLY federation traffic must still scrape
+        on its own cadence."""
+        async with self._lock:
+            await self._refresh_locked(
+                False, deadline=request.get("tpudash_deadline")
+            )
+        key = self._summary_key()
+        etag = f'"s-{_key_id(key)}"'
+        headers = {"Cache-Control": "no-cache", "ETag": etag}
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers=headers)
+        cached_key, raw = self._summary_cache
+        if cached_key != key:
+            async with self._summary_build_lock:
+                cached_key, raw = self._summary_cache
+                if cached_key != key:
+                    loop = asyncio.get_running_loop()
+                    raw = await loop.run_in_executor(
+                        None, _build_summary_body, self.service
+                    )
+                    self._summary_cache = (key, raw)
+                    cached_key = key
+        # serve the ETag of the body actually cached (the data may have
+        # advanced while this request queued behind the build gate)
+        headers["ETag"] = f'"s-{_key_id(cached_key)}"'
+        return web.Response(
+            body=raw, content_type="application/json", headers=headers
+        )
+
+    def _child_http(self):
+        """Lazy client session for the child drill-down proxy.
+        ``auto_decompress=False``: child bodies pass through verbatim
+        against the Accept-Encoding this hop actually forwarded."""
+        if self._child_session is None:
+            from aiohttp import ClientSession, ClientTimeout
+
+            self._child_session = ClientSession(
+                timeout=ClientTimeout(
+                    total=max(self.service.cfg.http_timeout, 1.0)
+                ),
+                auto_decompress=False,
+            )
+        return self._child_session
+
+    async def child_proxy(self, request: web.Request) -> web.Response:
+        """``GET /api/child/{child}/{tail}`` — drill INTO a federated
+        child through the fleet parent: the fleet pane's chip drill-down
+        (``/api/chip``, ``/api/history``, ``/api/range``, topology…)
+        answers from the child that owns the chip, one hop away, with
+        the same hop-header hygiene as the worker→compose proxy.  An
+        unreachable child maps to **502** (the child is the broken
+        upstream — 503 would blame this parent, and the parent is fine);
+        an unknown child or a non-API tail is 404 here."""
+        urls_fn = getattr(self.service.source, "child_urls", None)
+        if not callable(urls_fn):
+            raise web.HTTPNotFound(
+                text="not a federation parent (TPUDASH_FEDERATE unset)"
+            )
+        child = request.match_info["child"]
+        url = urls_fn().get(child)
+        if url is None:
+            raise web.HTTPNotFound(text=f"unknown federated child {child!r}")
+        tail = request.match_info["tail"]
+        # dot segments would let "api/../internal/cohort" pass the
+        # prefix check and NORMALIZE to a non-API child route inside the
+        # client URL — reject them (aiohttp has already percent-decoded
+        # the match, so encoded spellings land here too)
+        segments = tail.split("/")
+        if (
+            ".." in segments
+            or "." in segments
+            or "" in segments
+            or not (tail.startswith("api/") or tail == "healthz")
+        ):
+            raise web.HTTPNotFound(
+                text="only /api/* and /healthz proxy to children"
+            )
+        from aiohttp import ClientError
+
+        from tpudash.federation.proxy import forward_headers
+
+        # the parent's own bearer gate already admitted this request;
+        # toward the child the PARENT authenticates (one fleet, one
+        # token) — the client's header must not leak through as-is
+        headers = forward_headers(request.headers, drop={"authorization"})
+        if self.service.cfg.auth_token:
+            headers["Authorization"] = (
+                f"Bearer {self.service.cfg.auth_token}"
+            )
+        if not any(k.lower() == "accept-encoding" for k in headers):
+            # same trap as the worker proxy: aiohttp's client would
+            # inject "gzip, deflate" and hand an encoded body to a
+            # client that never offered an encoding
+            headers["Accept-Encoding"] = "identity"
+        target = f"{url}/{tail}"
+        if request.query_string:
+            target = f"{target}?{request.query_string}"
+        try:
+            async with self._child_http().get(
+                target, headers=headers
+            ) as r:
+                payload = await r.read()
+                out = forward_headers(r.headers, drop={"content-length"})
+                return web.Response(
+                    status=r.status, body=payload, headers=out
+                )
+        except (OSError, asyncio.TimeoutError, ClientError) as e:
+            raise web.HTTPBadGateway(
+                text=f"federated child {child!r} unreachable: {e}"
+            ) from e
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent events: push a frame every refresh interval.  All
@@ -1445,6 +1594,11 @@ class DashboardServer:
                "overload": overload,
                "loop_lag_ms": self.loop_monitor.summary(),
                "source_health": health}
+        if isinstance(health, dict) and health.get("federation"):
+            # fleet parents surface per-child liveness top-level too —
+            # the partition drill (and a paging runbook) reads child
+            # status/staleness here without digging through source_health
+            doc["federation"] = health["federation"]
         if self.workers_provider is not None:
             # worker-tier liveness folds in the same way overload does:
             # a mirror-less tier is serving NOBODY even though this
@@ -1535,6 +1689,27 @@ class DashboardServer:
         frame dispatches one build and every later shed serves cached
         bytes with zero awaits."""
         headers = {"Retry-After": self.overload.retry_after_header()}
+        if request.method == "GET" and request.path == "/api/summary":
+            # a shed federation poll degrades to the cached summary the
+            # same way /api/frame degrades: the parent marks staleness
+            # from its own clock, so a slightly-old 200 (or a free 304 —
+            # the common steady-state case) beats a 503 that would count
+            # against this child's breaker while the fleet burns.
+            # Served raw: the shed path short-circuits the _compress
+            # middleware by design (constant-time, no executor).
+            key, raw = self._summary_cache
+            if raw is not None:
+                etag = f'"s-{_key_id(key)}"'
+                self.overload.note_stale_frame()
+                headers["ETag"] = etag
+                headers["Cache-Control"] = "no-cache"
+                if request.headers.get("If-None-Match") == etag:
+                    return web.Response(status=304, headers=headers)
+                return web.Response(
+                    body=raw,
+                    content_type="application/json",
+                    headers=headers,
+                )
         if request.method == "GET" and request.path == "/api/frame":
             frame, key = self._sheddable_frame()
             if frame is not None:
@@ -1710,6 +1885,8 @@ class DashboardServer:
             app.on_cleanup.append(_stop_loopmon)
         app.router.add_get("/", self.index)
         app.router.add_get("/api/frame", self.frame)
+        app.router.add_get("/api/summary", self.summary)
+        app.router.add_get("/api/child/{child}/{tail:.+}", self.child_proxy)
         app.router.add_get("/api/stream", self.stream)
         app.router.add_get("/api/export.csv", self.export_csv)
         app.router.add_post("/api/select", self.select)
@@ -1735,6 +1912,12 @@ class DashboardServer:
         app.router.add_get("/api/alert-rules.yaml", self.alert_rules_yaml)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get(PLOTLY_LOCAL_URL, self.plotly_asset)
+        async def _close_child_session(app):
+            if self._child_session is not None:
+                await self._child_session.close()
+                self._child_session = None
+
+        app.on_cleanup.append(_close_child_session)
         if self.service.cfg.history_path:
             # final trend snapshot on graceful shutdown (periodic saves
             # cover crashes up to history_save_interval behind)

@@ -86,6 +86,19 @@ PANEL_GAP_REASONS = {
 _GENERIC_GAP = "no source series in the current scrape"
 
 
+def _merge_alerts(primary: "list[dict]", secondary: "list[dict]") -> "list[dict]":
+    """Union keyed (rule, chip), ``primary`` winning duplicates: the
+    parent engine's own evaluation of a federated table beats a child's
+    passthrough copy of the same (rule, chip) — both describe the same
+    breach, and the engine's entry carries the parent's hysteresis —
+    and on error cycles a freshly-rolled-up child alert beats the
+    previous frame's kept copy."""
+    seen = {(a.get("rule"), a.get("chip")) for a in primary}
+    return primary + [
+        a for a in secondary if (a.get("rule"), a.get("chip")) not in seen
+    ]
+
+
 def _downsample(pts: list, max_points: int) -> "tuple[list, dict]":
     """(strided points anchored at the newest, {ts: "HH:MM:SS"} labels) —
     shared by the fleet sparklines and the per-chip drill-down trends."""
@@ -134,6 +147,11 @@ class DashboardService:
         self.last_updated: str = _dt.datetime.now().strftime(
             "%Y-%m-%d %H:%M:%S"
         )
+        #: the same stamp as an epoch float — the machine-readable twin
+        #: /api/summary publishes so a federation parent can measure
+        #: data age without parsing the display string.
+        # tpulint: allow[wall-clock] scrape stamps are epoch timestamps
+        self.last_updated_ts: float = time.time()
         #: per-refresh identity extraction shared across session composes
         self._chips_base: list = []
         self._ident_chips = None
@@ -239,9 +257,17 @@ class DashboardService:
         #: threshold alerting over every chip in the table (not just the
         #: selected ones) — see tpudash.alerts
         from tpudash.alerts import AlertEngine, SilenceSet
+        from tpudash.hysteresis import DwellSet
 
         self.alert_engine = AlertEngine.from_config(cfg)
         self.last_alerts: list[dict] = []
+        #: anti-flap resolve dwell over the SYNTHESIZED alerts
+        #: (endpoint_down / overload / child_down / fleet_partial and the
+        #: re-namespaced child digests): once fired, an alert keeps
+        #: firing until its condition stays clear for cfg.alert_dwell
+        #: seconds — a child flapping at sub-poll period pages once, not
+        #: once per flap (TPUDASH_ALERT_DWELL, 0 = off).
+        self._synth_dwell = DwellSet(dwell_s=cfg.alert_dwell)
         #: operator acknowledgements: (rule, chip, ttl) silences — flagged
         #: on the frame, excluded from webhook paging, persisted in the
         #: state checkpoint (tpudash.alerts.SilenceSet)
@@ -408,6 +434,7 @@ class DashboardService:
         saved_stragglers = self.last_stragglers
         saved_alerts = self.last_alerts
         saved_firing = set(self._firing_keys)
+        saved_dwell = copy.deepcopy(self._synth_dwell._held)
         saved_history = list(self.history)
         # /healthz and the error banner serve last_error too: a synthetic
         # render must neither clear a real outage nor leave a fake one
@@ -474,6 +501,7 @@ class DashboardService:
             self.last_alerts = saved_alerts
             self.last_stragglers = saved_stragglers
             self._firing_keys = saved_firing
+            self._synth_dwell._held = saved_dwell
             self.last_error = saved_error
             self.history.clear()
             self.history.extend(saved_history)
@@ -931,38 +959,162 @@ class DashboardService:
     def source_health(self) -> "dict | None":
         """Health summary: the ResilientSource wrapper's rolling counters
         plus — for the multi-endpoint join — per-endpoint circuit-breaker
-        state (``endpoints``), so /healthz and the frame payload can
-        distinguish "one slice quarantined" from "all sources down".
-        None when neither wrapper is present."""
+        state (``endpoints``), and — for a federation parent — the
+        per-child liveness block (``federation``), so /healthz and the
+        frame payload can distinguish "one slice quarantined" / "one
+        child dark" from "all sources down".  None when no wrapper or
+        join is present."""
         health = getattr(self.source, "health", None)
         summary = health.summary() if health is not None else None
         ep_fn = getattr(self.source, "endpoint_health", None)
         endpoints = ep_fn() if callable(ep_fn) else None
-        if not endpoints:
-            return summary
-        # status derived from the breakers alone (all open → down, any
-        # non-closed or mid-streak → degraded)
-        states = [e["state"] for e in endpoints.values()]
-        if all(s == "open" for s in states):
-            ep_status = "down"
-        elif any(s != "closed" for s in states) or any(
-            e["consecutive_failures"] > 0 for e in endpoints.values()
-        ):
-            ep_status = "degraded"
-        else:
-            ep_status = "healthy"
-        if summary is None:
-            summary = {"status": ep_status}
-        else:
-            # the retry wrapper only sees whole-fetch outcomes, and a
-            # partial MultiSource fetch SUCCEEDS — its "healthy" must not
-            # mask a quarantined endpoint: the worse verdict wins
-            rank = {"healthy": 0, "degraded": 1, "down": 2}
-            summary = dict(summary)
-            if rank.get(ep_status, 0) > rank.get(summary.get("status"), 0):
-                summary["status"] = ep_status
-        summary["endpoints"] = endpoints
+        if endpoints:
+            # status derived from the breakers alone (all open → down,
+            # any non-closed or mid-streak → degraded)
+            states = [e["state"] for e in endpoints.values()]
+            if all(s == "open" for s in states):
+                ep_status = "down"
+            elif any(s != "closed" for s in states) or any(
+                e["consecutive_failures"] > 0 for e in endpoints.values()
+            ):
+                ep_status = "degraded"
+            else:
+                ep_status = "healthy"
+            summary = self._fold_health(summary, ep_status)
+            summary["endpoints"] = endpoints
+        fs = self._federation_summary()
+        if fs and fs["children_total"]:
+            # child liveness folds exactly like endpoint breakers: every
+            # child dark = down (nothing left to serve), any child not
+            # live = degraded — while ``ok`` upstream stays true (the
+            # PARENT process is alive and serving last-good data)
+            if fs["children_dark"] == fs["children_total"]:
+                fed_status = "down"
+            elif fs["partial"]:
+                fed_status = "degraded"
+            else:
+                fed_status = "healthy"
+            summary = self._fold_health(summary, fed_status)
+            summary["federation"] = fs
         return summary
+
+    @staticmethod
+    def _fold_health(summary: "dict | None", status: str) -> dict:
+        """Merge a join-level verdict into the wrapper's summary: the
+        retry wrapper only sees whole-fetch outcomes, and a partial
+        multi/federated fetch SUCCEEDS — its "healthy" must not mask a
+        quarantined endpoint or a dark child; the worse verdict wins."""
+        if summary is None:
+            return {"status": status}
+        rank = {"healthy": 0, "degraded": 1, "down": 2}
+        summary = dict(summary)
+        if rank.get(status, 0) > rank.get(summary.get("status"), 0):
+            summary["status"] = status
+        return summary
+
+    def _federation_summary(self) -> "dict | None":
+        """The source's federation block, or None off the federation
+        path.  Read-through (the source snapshots under its own lock);
+        failures degrade to None — observability must not fail frames."""
+        fed_fn = getattr(self.source, "federation_summary", None)
+        if not callable(fed_fn):
+            return None
+        try:
+            return fed_fn()
+        except Exception as e:  # noqa: BLE001 — observability is best-effort
+            log.warning("federation summary failed: %s", e)
+            return None
+
+    def summary_doc(self) -> dict:
+        """The compact ``/api/summary`` document a federation parent
+        polls (tpudash.federation.summary.build_summary) — per-chip
+        latest columns, fleet rollup, alert digest, health.  Blocking
+        (matrix serialization): the server builds it in the executor."""
+        from tpudash.federation.summary import build_summary
+
+        with self._publish_lock:
+            return build_summary(self)
+
+    def _federation_alerts(self, now: float) -> "list[dict]":
+        """The hierarchical alert rollup: synthesized ``child_down`` per
+        degraded child and ``fleet_partial`` for the pane, plus every
+        reachable child's own alerts re-namespaced into the parent's
+        alert space — all shaped like AlertEngine output so silences,
+        the webhook pager, and the banner treat a dark cluster exactly
+        like a breaching chip."""
+        fs = self._federation_summary()
+        if not fs:
+            return []
+        from tpudash.alerts import synthesized_alert
+
+        out: "list[dict]" = []
+        degraded: "list[str]" = []
+        for name, c in sorted(fs["children"].items()):
+            br = c.get("breaker") or {}
+            status = c.get("status")
+            if status != "live":
+                degraded.append(name)
+            firing = status == "dark" or br.get("state") in (
+                "open",
+                "half_open",
+            )
+            if (
+                not firing
+                and status == "live"
+                and not br.get("consecutive_failures")
+            ):
+                continue
+            open_for = br.get("open_for_s")
+            out.append(
+                synthesized_alert(
+                    rule="child_down",
+                    column="federation",
+                    severity="critical",
+                    chip=name,
+                    value=float(br.get("consecutive_failures") or 0),
+                    threshold=float(br.get("failure_threshold") or 0),
+                    firing=firing,
+                    since=(
+                        round(now - open_for, 3)
+                        if firing and open_for is not None
+                        else None
+                    ),
+                    streak=int(br.get("consecutive_failures") or 0),
+                    # the parent-side fault when there is one, else the
+                    # child's own error (an answering-but-empty child
+                    # fails with a child-side cause, not a network one)
+                    detail=c.get("last_error") or c.get("child_error"),
+                    breaker=br.get("state"),
+                    child_status=status,
+                    staleness_s=c.get("staleness_s"),
+                )
+            )
+        if degraded:
+            k, n = len(degraded), fs["children_total"]
+            out.append(
+                synthesized_alert(
+                    rule="fleet_partial",
+                    column="federation",
+                    severity="warning",
+                    chip="fleet",
+                    value=float(k),
+                    threshold=0.0,
+                    firing=True,
+                    streak=k,
+                    detail=(
+                        f"{k}/{n} federated children degraded "
+                        f"({', '.join(degraded)}) — the fleet frame is "
+                        "partial: last-good data serving where available"
+                    ),
+                )
+            )
+        alerts_fn = getattr(self.source, "federated_alerts", None)
+        if callable(alerts_fn):
+            try:
+                out += alerts_fn()
+            except Exception as e:  # noqa: BLE001 — rollup is best-effort
+                log.warning("federated alert rollup failed: %s", e)
+        return out
 
     def _endpoint_alerts(self, now: float) -> list[dict]:
         """Synthesized ``endpoint_down`` alert entries from the breaker
@@ -1602,6 +1754,8 @@ class DashboardService:
         # near the end of a refresh interval must not present interval-old
         # metrics as current)
         stamp = _dt.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        # tpulint: allow[wall-clock] scrape stamps are epoch timestamps
+        stamp_ts = time.time()
         # The fetch runs OUTSIDE the publish lock (it can block for the
         # watchdog's whole lifetime) and ALL timer mutation happens inside
         # it — a stale compose served mid-stall must never see a
@@ -1617,6 +1771,7 @@ class DashboardService:
                 self.timer.start_frame()
                 self.timer.current["scrape"] = scrape_s
                 self.last_updated = stamp
+                self.last_updated_ts = stamp_ts
                 return self._publish_error(e)
         scrape_s = time.perf_counter() - t0
         # everything below mutates published state; the lock keeps a fetch
@@ -1627,6 +1782,7 @@ class DashboardService:
             self._frame_open = True
             self.timer.current["scrape"] = scrape_s
             self.last_updated = stamp
+            self.last_updated_ts = stamp_ts
             try:
                 with self.timer.stage("normalize"):
                     df = to_wide(samples)
@@ -1652,6 +1808,8 @@ class DashboardService:
             now_w = time.time()
             synth = self._endpoint_alerts(now_w)
             synth += self._overload_alerts(now_w)
+            synth += self._federation_alerts(now_w)
+            synth = self._synth_dwell.apply(synth)
             if synth or any(
                 a.get("rule") in SYNTHESIZED_RULES for a in self.last_alerts
             ):
@@ -1662,8 +1820,10 @@ class DashboardService:
                     for a in self.last_alerts
                     if a.get("rule") not in SYNTHESIZED_RULES
                 ]
+                # fresh rollup first: a re-namespaced child alert from a
+                # still-reachable child beats the stale kept copy
                 self.last_alerts = self.silences.annotate(
-                    sort_alerts(kept + synth), now_w
+                    sort_alerts(_merge_alerts(synth, kept)), now_w
                 )
                 self._notify_alert_transitions()
         self._frame_open = False
@@ -1715,10 +1875,12 @@ class DashboardService:
                 # tpulint: allow[wall-clock] alert/silence epoch stamps
                 now_w = time.time()
                 alerts = self.alert_engine.evaluate(df)
-                alerts += self._endpoint_alerts(now_w)
-                alerts += self._overload_alerts(now_w)
+                synth = self._endpoint_alerts(now_w)
+                synth += self._overload_alerts(now_w)
+                synth += self._federation_alerts(now_w)
+                synth = self._synth_dwell.apply(synth)
                 self.last_alerts = self.silences.annotate(
-                    sort_alerts(alerts), now_w
+                    sort_alerts(_merge_alerts(alerts, synth)), now_w
                 )
             self._notify_alert_transitions()
         # Fleet-wide trend history, one point per refresh interval (burst
@@ -1826,6 +1988,15 @@ class DashboardService:
             "error": self.last_error,
             "source_health": self.source_health(),
         }
+        fs = self._federation_summary()
+        if fs:
+            # the fleet pane's truth channel: per-child staleness_s /
+            # breaker state / status, and the partial marker — present on
+            # ERROR frames too (an all-dark fleet must still say which
+            # children went dark, not just show a banner)
+            frame["federation"] = fs
+            if fs["partial"]:
+                frame["partial"] = True
         df = self.last_df
         if df is None and self.refresh_stalled and frame["error"] is None:
             # the very first fetch is stalled: nothing to serve yet, and
@@ -1849,6 +2020,13 @@ class DashboardService:
         )
         if self.refresh_stalled:
             warnings.append(self.refresh_stalled)
+        if fs and fs["partial"]:
+            k = fs["children_total"] - fs["children_live"]
+            warnings.append(
+                f"fleet view partial: {k}/{fs['children_total']} federated "
+                "children degraded — their panels show last-good data "
+                "(see the federation block for per-child staleness)"
+            )
         if warnings:
             frame["warnings"] = warnings
         # only the FIRST compose after a refresh lands in the timer frame:

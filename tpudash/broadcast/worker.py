@@ -50,6 +50,7 @@ from tpudash.broadcast.cohort import (
     parse_event_id,
 )
 from tpudash.config import Config, configure_logging, env_read, load_config
+from tpudash.federation.proxy import HOP_HEADERS as _HOP_HEADERS
 
 log = logging.getLogger(__name__)
 
@@ -58,20 +59,8 @@ log = logging.getLogger(__name__)
 BUS_SOCK = "bus.sock"
 API_SOCK = "api.sock"
 
-#: hop-by-hop headers a proxy must not forward (RFC 9110 §7.6.1)
-_HOP_HEADERS = frozenset(
-    {
-        "connection",
-        "keep-alive",
-        "proxy-authenticate",
-        "proxy-authorization",
-        "te",
-        "trailer",
-        "transfer-encoding",
-        "upgrade",
-        "host",
-    }
-)
+# hop-by-hop hygiene shared with the federation child drill-down proxy
+# — one set (tpudash/federation/proxy.py), so the two hops cannot drift
 
 #: every locally-served response names its worker — the storm drill and
 #: the cross-worker reconnect tests identify processes by this header
@@ -142,6 +131,15 @@ class FanoutWorker:
         #: and left to expire with the next hello's window reset.
         self._stale_bodies: "dict[str, tuple]" = {}
         self._stale_build_lock = asyncio.Lock()
+        #: compose-outage anchor: monotonic stamp of the outage's FIRST
+        #: detection, held across reconnect flaps shorter than the
+        #: anti-flap dwell (cfg.alert_dwell) so the synthesized
+        #: compose_down alert keeps ONE identity with a monotonically
+        #: growing age — a bus link bouncing at sub-dwell period must
+        #: not reset `down_s` (and re-page any alert forwarder, e.g. a
+        #: federation parent rolling this worker's alerts up) per flap
+        self._outage_anchor: "float | None" = None
+        self._outage_seen: float = 0.0
 
     @property
     def compose_down(self) -> bool:
@@ -453,10 +451,7 @@ class FanoutWorker:
         if etag not in self._stale_bodies:
             async with self._stale_build_lock:
                 if etag not in self._stale_bodies:
-                    down = self.mirror.disconnected_since
-                    down_s = (
-                        time.monotonic() - down if down is not None else 0.0
-                    )
+                    down_s = self._outage_age()
                     loop = asyncio.get_running_loop()
                     raw, gz = await loop.run_in_executor(
                         None, degraded_frame_body, latest.frame_raw, down_s
@@ -475,6 +470,28 @@ class FanoutWorker:
         return web.Response(
             body=body, content_type="application/json", headers=headers
         )
+
+    def _outage_age(self) -> float:
+        """Seconds this compose outage has been going, anchored at its
+        FIRST detection: consecutive degraded builds within the
+        anti-flap dwell window (cfg.alert_dwell, +1 s of slack so a 0
+        dwell still coalesces one build burst) share one anchor, so a
+        flapping bus link yields one growing outage age instead of a
+        fresh zero per flap — the dwell semantics hysteresis.DwellSet
+        gives service-side synthesized alerts, applied to the one alert
+        this worker synthesizes."""
+        now = time.monotonic()
+        down = self.mirror.disconnected_since
+        start = down if down is not None else now
+        dwell = max(self.cfg.alert_dwell, 0.0) + 1.0
+        if (
+            self._outage_anchor is not None
+            and now - self._outage_seen <= dwell
+        ):
+            start = min(start, self._outage_anchor)
+        self._outage_anchor = start
+        self._outage_seen = now
+        return max(0.0, now - start)
 
     async def healthz(self, request: web.Request) -> web.Response:
         """Compose-process health with this worker's own vitals folded in

@@ -83,9 +83,31 @@ and then kills things, in sequence, mid-storm:
 
     python -m tpudash.chaos killall --clients 24 --workers 2
 
+**The partition drill** (``python -m tpudash.chaos partition``): fleet
+federation (tpudash.federation) under network partitions.  It boots N
+child dashboards plus a federated parent, then partitions K of them
+mid-storm — one connect-refused, one accept-then-hang, one
+slow-drip — and later flaps one at sub-dwell period, asserting the
+degrade-per-child contract:
+
+- the parent's ``/api/frame`` keeps serving with EXACTLY the affected
+  children marked stale (measured ``staleness_s``), their last-good
+  chips still on the pane, and ``partial: true``;
+- past the stale budget the affected children go dark and their chips
+  drop — the frame still serves the healthy remainder;
+- ``child_down`` fires per affected child, ``fleet_partial`` beside it,
+  and the anti-flap dwell keeps a flapping child from resolve-flapping
+  the pager;
+- ``/healthz`` stays ``ok: true`` with truthful per-child status, the
+  fleet SSE stream keeps ticking, steady-state summary polls hit the
+  ETag/304 path, and recovery lands within one poll of heal;
+- zero unhandled exceptions throughout.
+
+    python -m tpudash.chaos partition --children 4
+
 Exit status 0 = every invariant held; 1 = the printed JSON names what
-didn't.  CI runs the overload, storm, and killall drills on every PR
-(chaos-soak job).
+didn't.  CI runs the overload, storm, killall, and partition drills on
+every PR (chaos-soak job).
 """
 
 from __future__ import annotations
@@ -1581,6 +1603,552 @@ class _DrillAbort(Exception):
     """Internal: a phase failed in a way later phases depend on."""
 
 
+# ---------------------------------------------------------------------------
+# Partition drill — fleet federation under network partitions: kill /
+# wedge / slow-drip / flap children mid-storm; the parent's fleet frame
+# must degrade per child and never go dark (tpudash.federation).
+# ---------------------------------------------------------------------------
+
+#: partition-drill knobs: a small fast fleet.  Children refresh SLOWER
+#: than the parent polls, so steady-state polls provably hit the
+#: /api/summary 304 path; breaker/dwell windows sized so every state
+#: transition lands inside a CI-friendly minute.
+_PARTITION_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.5),
+    "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 16),
+    "TPUDASH_FEDERATE_DEADLINE": ("federate_deadline", 1.0),
+    "TPUDASH_FEDERATE_STALE_BUDGET": ("federate_stale_budget", 8.0),
+    "TPUDASH_FEDERATE_HEDGE": ("federate_hedge", 0.3),
+    "TPUDASH_BREAKER_FAILURES": ("breaker_failures", 2),
+    "TPUDASH_BREAKER_COOLDOWN": ("breaker_cooldown", 2.0),
+    "TPUDASH_ALERT_DWELL": ("alert_dwell", 2.0),
+}
+
+#: how much slower each child scrapes than the parent polls — the gap
+#: that makes steady-state 304s deterministic rather than a timing fluke
+_PARTITION_CHILD_REFRESH = 2.0
+
+
+class _ChildHarness:
+    """One in-process child dashboard on a FIXED local port, stoppable
+    and restartable, with raw-socket stand-ins for the two partition
+    shapes a stopped server can't express: ``accept-then-hang`` (the far
+    process is wedged) and ``slow-drip`` (bytes trickle below any useful
+    rate).  Stopping the site outright is the third shape — connection
+    refused."""
+
+    def __init__(self, name: str, port: int, cfg: Config):
+        self.name = name
+        self.port = port
+        self.cfg = dataclasses.replace(
+            cfg,
+            port=port,
+            refresh_interval=_PARTITION_CHILD_REFRESH,
+            federate="",  # children are leaves, never parents here
+        )
+        self._runner = None
+        self._raw_server = None
+
+    def _build_server(self):
+        from tpudash.app.server import DashboardServer
+        from tpudash.app.service import DashboardService
+        from tpudash.sources.fixture import SyntheticSource
+
+        source = SyntheticSource(
+            num_chips=min(self.cfg.synthetic_chips, 64),
+            generation=self.cfg.generation,
+        )
+        return DashboardServer(DashboardService(self.cfg, source))
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        # service construction does real file I/O — executor, like every
+        # other drill (asynccheck rule ``async-blocking``)
+        server = await loop.run_in_executor(None, self._build_server)
+        self._runner = web.AppRunner(server.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(
+            self._runner, "127.0.0.1", self.port, reuse_address=True
+        )
+        await site.start()
+
+    async def stop(self) -> None:
+        """Partition shape 1: connection refused (port closed)."""
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def start_hang(self) -> None:
+        """Partition shape 2: accept-then-hang — SYN-ACK, then silence."""
+
+        async def handler(reader, writer):
+            try:
+                while await reader.read(4096):
+                    pass  # swallow the request; never answer
+            except (OSError, asyncio.CancelledError):
+                pass
+            finally:
+                writer.close()
+
+        self._raw_server = await asyncio.start_server(
+            handler, "127.0.0.1", self.port, reuse_address=True
+        )
+
+    async def start_drip(self) -> None:
+        """Partition shape 3: slow drip — one header byte at a time,
+        far below any rate that beats the parent's deadline."""
+        header = b"HTTP/1.1 200 OK\r\nContent-Length: 100000\r\n\r\n"
+
+        async def handler(reader, writer):
+            try:
+                await reader.read(4096)
+                for ch in header:
+                    writer.write(bytes([ch]))
+                    await writer.drain()
+                    await asyncio.sleep(0.1)
+            except (OSError, asyncio.CancelledError):
+                pass
+            finally:
+                writer.close()
+
+        self._raw_server = await asyncio.start_server(
+            handler, "127.0.0.1", self.port, reuse_address=True
+        )
+
+    async def stop_raw(self) -> None:
+        if self._raw_server is not None:
+            self._raw_server.close()
+            await self._raw_server.wait_closed()
+            self._raw_server = None
+
+    async def heal(self) -> None:
+        """Back to a live dashboard on the same port."""
+        await self.stop_raw()
+        await self.stop()
+        await self.start()
+
+
+def _free_ports(n: int) -> "list[int]":
+    """n distinct ephemeral ports (bind-0 probe; the tiny close-to-bind
+    race is acceptable in a drill, same as the storm drill)."""
+    import socket as socketmod
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def run_partition_drill(
+    children: int = 4, cfg: "Config | None" = None
+) -> dict:
+    """Fleet federation's crash-anything: K of N children are
+    partitioned mid-storm — one connect-refused, one accept-then-hang,
+    one slow-drip — and the drill asserts the degrade-per-child
+    contract end to end:
+
+    - the parent's ``/api/frame`` keeps answering 200 with EXACTLY the
+      affected children marked stale (measured ``staleness_s``), the
+      healthy child live, and ``partial: true``;
+    - stale children keep serving their last-good chips until the stale
+      budget expires, then go dark and their chips leave the table —
+      the frame STILL serves (the healthy remainder);
+    - ``child_down`` fires per affected child and ``fleet_partial``
+      rides beside it; ``/healthz`` stays ``ok: true`` with truthful
+      per-child status; an SSE stream keeps ticking throughout;
+    - steady-state summary polls hit the ETag/304 path;
+    - a child flapping with up-windows shorter than the anti-flap dwell
+      pages ONCE — ``child_down`` never resolve-flaps mid-storm;
+    - after heal, the fleet recovers within one poll interval (+ the
+      child deadline for scheduling slack);
+    - zero unhandled exceptions in the process throughout.
+    """
+    from aiohttp import ClientError, ClientSession, web
+
+    children = max(4, children)
+    loop = asyncio.get_running_loop()
+    base_cfg = cfg or load_config()
+    for env_name, (field, value) in _PARTITION_KNOBS.items():
+        if not env_is_set(env_name):
+            base_cfg = dataclasses.replace(base_cfg, **{field: value})
+    ports = _free_ports(children + 1)
+    child_ports, parent_port = ports[:children], ports[children]
+    names = [f"c{i}" for i in range(children)]
+    kids = [
+        _ChildHarness(name, port, dataclasses.replace(base_cfg, source="synthetic"))
+        for name, port in zip(names, child_ports)
+    ]
+
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    failures: "list[str]" = []
+    numbers: dict = {"children": children}
+    stream_events = {"n": 0}
+    stop = asyncio.Event()
+    parent_runner = None
+    tasks: "list[asyncio.Task]" = []
+
+    parent_cfg = dataclasses.replace(
+        base_cfg,
+        source="synthetic",  # ignored: federate wins (asserted below)
+        federate=",".join(
+            f"{n}=http://127.0.0.1:{p}" for n, p in zip(names, child_ports)
+        ),
+        host="127.0.0.1",
+        port=parent_port,
+    )
+
+    def _build_parent():
+        from tpudash.app.server import DashboardServer
+        from tpudash.app.service import DashboardService
+        from tpudash.sources import make_source
+
+        return DashboardServer(
+            DashboardService(parent_cfg, make_source(parent_cfg))
+        )
+
+    interval = parent_cfg.refresh_interval
+    chips_per_child = min(base_cfg.synthetic_chips, 64)
+
+    async def fetch_json(session, path):
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{parent_port}{path}",
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                return r.status, await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+            return None, None
+
+    def fed_statuses(doc) -> dict:
+        return {
+            n: c["status"]
+            for n, c in ((doc or {}).get("federation") or {})
+            .get("children", {})
+            .items()
+        }
+
+    async def sse_ticker(session):
+        """One long-lived fleet viewer — must keep receiving events
+        through every partition (reconnect allowed; going quiet is the
+        failure)."""
+        while not stop.is_set():
+            try:
+                async with session.get(
+                    f"http://127.0.0.1:{parent_port}/api/stream",
+                    headers={"Accept-Encoding": "identity"},
+                ) as r:
+                    async for line in r.content:
+                        if line.startswith(b"data:"):
+                            stream_events["n"] += 1
+                        if stop.is_set():
+                            return
+            except (OSError, ClientError, asyncio.TimeoutError):
+                await asyncio.sleep(0.2)
+
+    session = None
+    try:
+        for kid in kids:
+            await kid.start()
+        parent = await loop.run_in_executor(None, _build_parent)
+        parent_runner = web.AppRunner(parent.build_app())
+        await parent_runner.setup()
+        await web.TCPSite(
+            parent_runner, "127.0.0.1", parent_port, reuse_address=True
+        ).start()
+
+        # closed in the inner finally AFTER the client tasks are
+        # cancelled — an SSE ticker outliving its session would die with
+        # an unhandled "Session is closed" the zero-exception check counts
+        session = ClientSession()
+        try:
+            # -- phase 0: fleet ready ---------------------------------------
+            total = children * chips_per_child
+            deadline = time.monotonic() + 60.0
+            ready = False
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if (
+                    status == 200
+                    and frame
+                    and frame.get("error") is None
+                    and len(frame.get("chips") or []) == total
+                    and not (frame.get("federation") or {}).get("partial")
+                ):
+                    ready = True
+                    break
+                await asyncio.sleep(0.5)
+            if not ready:
+                failures.append(
+                    f"fleet never became ready: {status} "
+                    f"{len((frame or {}).get('chips') or [])}/{total} chips"
+                )
+                raise _DrillAbort()
+            tasks.append(asyncio.ensure_future(sse_ticker(session)))
+
+            # -- phase 1: steady state hits the 304 path --------------------
+            # children refresh every 2 s, the parent polls every 0.5 s:
+            # most polls revalidate.  Wait a few intervals and read the
+            # per-child counters off /healthz.
+            await asyncio.sleep(6 * interval)
+            _, hz = await fetch_json(session, "/healthz")
+            fed = (hz or {}).get("federation") or {}
+            counters = {
+                n: (c.get("counters") or {})
+                for n, c in (fed.get("children") or {}).items()
+            }
+            total_304 = sum(c.get("etag_304s", 0) for c in counters.values())
+            total_fetches = sum(c.get("fetches", 0) for c in counters.values())
+            numbers["steady_304s"] = total_304
+            numbers["steady_fetches"] = total_fetches
+            if total_304 == 0:
+                failures.append(
+                    "steady-state summary polls never hit the 304 path"
+                )
+            if not hz or hz.get("ok") is not True:
+                failures.append("healthz ok flapped while healthy")
+
+            # -- phase 2: partition 3 of N children, three shapes -----------
+            refuse, hang, drip, healthy = kids[0], kids[1], kids[2], kids[3]
+            await refuse.stop()          # connect refused
+            await hang.stop()
+            await hang.start_hang()      # accept, then silence
+            await drip.stop()
+            await drip.start_drip()      # bytes below any useful rate
+            t_partition = time.monotonic()
+            affected = {refuse.name, hang.name, drip.name}
+
+            stale_ok = alert_ok = None
+            deadline = time.monotonic() + base_cfg.federate_stale_budget - 1.0
+            peak_staleness: dict = {}
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if status != 200 or not frame or frame.get("error"):
+                    await asyncio.sleep(0.3)
+                    continue
+                st = fed_statuses(frame)
+                degraded = {n for n, s in st.items() if s != "live"}
+                for n, c in (frame.get("federation") or {}).get(
+                    "children", {}
+                ).items():
+                    if c.get("staleness_s"):
+                        peak_staleness[n] = max(
+                            peak_staleness.get(n, 0.0), c["staleness_s"]
+                        )
+                if degraded and not degraded <= affected:
+                    failures.append(
+                        f"healthy child marked degraded: {degraded - affected}"
+                    )
+                    break
+                rules = {
+                    (a.get("rule"), a.get("chip"), a.get("state"))
+                    for a in frame.get("alerts") or []
+                }
+                child_down_firing = {
+                    chip
+                    for r, chip, s in rules
+                    if r == "child_down" and s == "firing"
+                }
+                if (
+                    degraded == affected
+                    and frame.get("partial") is True
+                    and len(frame.get("chips") or []) == total
+                ):
+                    stale_ok = time.monotonic() - t_partition
+                    if child_down_firing == affected and any(
+                        r == "fleet_partial" for r, _c, _s in rules
+                    ):
+                        alert_ok = True
+                        break
+                await asyncio.sleep(0.3)
+            if stale_ok is None:
+                failures.append(
+                    "frame never marked exactly the 3 partitioned children "
+                    "stale while serving their last-good chips"
+                )
+            else:
+                numbers["stale_marked_after_s"] = round(stale_ok, 2)
+            if alert_ok is None and stale_ok is not None:
+                failures.append(
+                    "child_down×3 + fleet_partial never fired together"
+                )
+            _, hz = await fetch_json(session, "/healthz")
+            if not hz or hz.get("ok") is not True:
+                failures.append("healthz ok flapped during the partition")
+            elif "degraded" not in str(hz.get("status")):
+                failures.append(
+                    f"healthz hid the partition: status={hz.get('status')!r}"
+                )
+
+            # -- phase 3: past the stale budget → dark, chips drop ----------
+            deadline = time.monotonic() + base_cfg.federate_stale_budget + 8.0
+            dark_ok = None
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if status == 200 and frame and frame.get("error") is None:
+                    st = fed_statuses(frame)
+                    if (
+                        all(st.get(n) == "dark" for n in affected)
+                        and st.get(healthy.name) == "live"
+                        and len(frame.get("chips") or [])
+                        == chips_per_child
+                    ):
+                        dark_ok = True
+                        break
+                await asyncio.sleep(0.4)
+            if not dark_ok:
+                failures.append(
+                    "dark children past the stale budget never dropped to "
+                    "the healthy remainder (frame must keep serving it)"
+                )
+            numbers["peak_staleness_s"] = {
+                n: round(v, 2) for n, v in sorted(peak_staleness.items())
+            }
+
+            # -- phase 4: heal → recovery within one poll -------------------
+            for kid in (refuse, hang, drip):
+                await kid.heal()
+            t_heal = time.monotonic()
+            recovered = None
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if (
+                    status == 200
+                    and frame
+                    and frame.get("error") is None
+                    and not (frame.get("federation") or {}).get("partial")
+                    and len(frame.get("chips") or []) == total
+                ):
+                    recovered = time.monotonic() - t_heal
+                    break
+                await asyncio.sleep(0.1)
+            if recovered is None:
+                failures.append("fleet never recovered after heal")
+                raise _DrillAbort()
+            numbers["recovered_after_s"] = round(recovered, 2)
+            # "within one poll of heal", where "pollable" accounts for
+            # the breaker: the last failed half-open probe re-opened
+            # with a FRESH cooldown (+ up to 50% decorrelation jitter),
+            # so worst case the child only becomes pollable
+            # cooldown×1.5 after heal — then one poll (+ the deadline a
+            # mid-flight poll may still burn, + scheduling slack)
+            budget = (
+                interval
+                + base_cfg.federate_deadline
+                + base_cfg.breaker_cooldown * 1.5
+                + 1.5
+            )
+            if recovered > budget:
+                failures.append(
+                    f"recovery took {recovered:.2f}s "
+                    f"(> {budget:.2f}s = poll + deadline + slack)"
+                )
+
+            # -- phase 5: flap vs the anti-flap dwell -----------------------
+            # down-windows long enough to open the breaker (2 failed
+            # polls), up-windows SHORTER than the dwell: child_down must
+            # fire once and never resolve-flap until the storm ends.
+            flap = kids[0]
+            fired_seen = False
+            resolve_flaps = 0
+            flap_deadline = time.monotonic() + 3 * (1.4 + 0.6)
+
+            async def sample_child_down() -> bool:
+                _, doc = await fetch_json(session, "/api/alerts")
+                return any(
+                    a.get("rule") == "child_down"
+                    and a.get("chip") == flap.name
+                    and a.get("state") == "firing"
+                    for a in (doc or {}).get("alerts") or []
+                )
+
+            async def flapper():
+                for _ in range(3):
+                    await flap.stop()
+                    await asyncio.sleep(1.4)  # ≥2 failed polls → fires
+                    await flap.heal()
+                    await asyncio.sleep(0.6)  # up-window < 2 s dwell
+
+            flap_task = asyncio.ensure_future(flapper())
+            tasks.append(flap_task)
+            while time.monotonic() < flap_deadline or not flap_task.done():
+                firing = await sample_child_down()
+                if firing:
+                    fired_seen = True
+                elif fired_seen:
+                    resolve_flaps += 1
+                    fired_seen = False
+                if flap_task.done() and time.monotonic() > flap_deadline:
+                    break
+                await asyncio.sleep(0.15)
+            await flap_task
+            if not fired_seen and resolve_flaps == 0:
+                failures.append("flap storm never fired child_down at all")
+            if resolve_flaps > 1:
+                failures.append(
+                    f"child_down resolve-flapped {resolve_flaps}× through "
+                    "the flap storm — the anti-flap dwell is not holding"
+                )
+            numbers["flap_resolve_transitions"] = resolve_flaps
+            # after the storm + dwell, the alert must actually clear
+            cleared = False
+            deadline = time.monotonic() + base_cfg.alert_dwell + 6.0
+            while time.monotonic() < deadline:
+                if not await sample_child_down():
+                    cleared = True
+                    break
+                await asyncio.sleep(0.3)
+            if not cleared:
+                failures.append(
+                    "child_down never cleared after the flap storm + dwell"
+                )
+
+            # hedged-retry + SSE liveness bookkeeping
+            _, hz = await fetch_json(session, "/healthz")
+            fed = (hz or {}).get("federation") or {}
+            numbers["hedges"] = sum(
+                (c.get("counters") or {}).get("hedges", 0)
+                for c in (fed.get("children") or {}).values()
+            )
+            numbers["stream_events"] = stream_events["n"]
+            if stream_events["n"] < 10:
+                failures.append(
+                    f"fleet SSE stream barely ticked: {stream_events['n']} "
+                    "events through the whole drill"
+                )
+        finally:
+            stop.set()
+            if tasks:
+                await asyncio.wait(tasks, timeout=10)
+                for t in tasks:
+                    t.cancel()
+            await session.close()
+    except _DrillAbort:
+        pass
+    finally:
+        if parent_runner is not None:
+            await parent_runner.cleanup()
+        for kid in kids:
+            await kid.stop_raw()
+            await kid.stop()
+        logging.getLogger().removeHandler(trap)
+
+    if trap.records:
+        failures.append(
+            f"{len(trap.records)} unhandled exception(s): "
+            + trap.records[0][:500]
+        )
+    return {"ok": not failures, "failures": failures, **numbers}
+
+
 def _scan_worker_logs(bus_dir: str) -> "list[str]":
     """Unhandled-exception lines from the worker processes' captured
     stderr (the supervisor appends each worker's output to
@@ -1630,6 +2198,14 @@ def main(argv: "list[str] | None" = None) -> None:
     )
     ka.add_argument("--clients", type=int, default=24)
     ka.add_argument("--workers", type=int, default=2)
+    pa = sub.add_parser(
+        "partition",
+        help="fleet-federation drill: kill/wedge/slow-drip/flap children "
+        "mid-storm; the parent frame must degrade per child (exact "
+        "stale set, last-good serving, child_down + fleet_partial, "
+        "anti-flap dwell) and recover within one poll of heal",
+    )
+    pa.add_argument("--children", type=int, default=4)
     args = parser.parse_args(argv)
 
     configure_logging()
@@ -1653,6 +2229,10 @@ def main(argv: "list[str] | None" = None) -> None:
         summary = asyncio.run(
             run_killall_drill(clients=args.clients, workers=args.workers)
         )
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "partition":
+        summary = asyncio.run(run_partition_drill(children=args.children))
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)
 

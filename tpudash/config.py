@@ -223,6 +223,39 @@ class Config:
     breaker_failures: int = 3
     #: Seconds an open circuit waits before a half-open probe fetch.
     breaker_cooldown: float = 30.0
+    #: Reopen-probe jitter as a fraction of the cooldown: each open draws
+    #: a fresh extra wait in [0, jitter × cooldown] so N breakers opened
+    #: by one shared partition don't all probe the healed endpoint in the
+    #: same instant.  0 keeps the exact-cooldown behavior; the federated
+    #: fan-in defaults to 0.5 unless this is set explicitly.
+    breaker_jitter: float = 0.0
+
+    # --- federation: tpudash-scrapes-tpudash fleet aggregation ---------------
+    #: Comma-separated ``[name=]url`` list of CHILD tpudash instances to
+    #: federate (each is polled at ``GET <url>/api/summary``); non-empty
+    #: turns this instance into a fleet parent — the configured
+    #: TPUDASH_SOURCE is ignored.  Child slices are re-labeled
+    #: ``<name>/<slice>`` so fleets join without colliding.
+    federate: str = ""
+    #: Per-child summary-fetch deadline, seconds (children are polled
+    #: concurrently, so a frame pays ONE deadline for its slowest child).
+    #: 0 = use http_timeout.
+    federate_deadline: float = 0.0
+    #: Seconds a dark child's last-good summary keeps serving (marked
+    #: stale, per-child ``staleness_s`` on the frame) before its chips
+    #: drop from the fleet table entirely.
+    federate_stale_budget: float = 30.0
+    #: Hedged retry: if a child hasn't answered after this many seconds,
+    #: a second concurrent request is fired and the first success wins —
+    #: one slow TCP handshake must not cost the frame the whole
+    #: deadline.  0 disables hedging.
+    federate_hedge: float = 0.5
+    #: Anti-flap dwell for synthesized alerts (endpoint_down, child_down,
+    #: fleet_partial, and re-namespaced child alerts), seconds: once
+    #: fired, an alert keeps firing (flagged ``dwell: true``) until its
+    #: condition has stayed clear this long — a child flapping at
+    #: sub-poll period pages once, not once per flap.  0 disables.
+    alert_dwell: float = 0.0
     #: Fault-injection scenario for chaos drills ("" = off) — wraps the
     #: configured source in ChaosSource (grammar: sources/chaos.py, e.g.
     #: ``latency:p=0.3,ms=800;flap:period=6;seed=42``).  Drill tool;
@@ -338,6 +371,12 @@ _ENV_MAP = {
     "multi_deadline": "TPUDASH_MULTI_DEADLINE",
     "breaker_failures": "TPUDASH_BREAKER_FAILURES",
     "breaker_cooldown": "TPUDASH_BREAKER_COOLDOWN",
+    "breaker_jitter": "TPUDASH_BREAKER_JITTER",
+    "federate": "TPUDASH_FEDERATE",
+    "federate_deadline": "TPUDASH_FEDERATE_DEADLINE",
+    "federate_stale_budget": "TPUDASH_FEDERATE_STALE_BUDGET",
+    "federate_hedge": "TPUDASH_FEDERATE_HEDGE",
+    "alert_dwell": "TPUDASH_ALERT_DWELL",
     "chaos": "TPUDASH_CHAOS",
     "max_concurrency": "TPUDASH_MAX_CONCURRENCY",
     "rate_limit": "TPUDASH_RATE_LIMIT",

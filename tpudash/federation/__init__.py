@@ -1,0 +1,36 @@
+"""Fleet federation — a tpudash that scrapes *other tpudash instances*.
+
+ROADMAP #2: the single-process model tops out around 4096 chips
+(BENCH_r05: 59.9 ms frame p50), so whole-fleet views are built
+hierarchically — each cluster/slice-set runs its own dashboard, and a
+FLEET PARENT (``TPUDASH_FEDERATE=<name=url,...>``) polls every child's
+compact ``GET /api/summary`` and composes one pane: fleet → child/slice
+→ chip drill-down (proxied to the owning child).
+
+The tier is above all a *robustness* layer: children flap, partition,
+lag, and restart, and the fleet pane must stay truthful and live through
+all of it.  The contract — drilled by ``python -m tpudash.chaos
+partition`` — is **degrade per child, never go dark**:
+
+- children are polled CONCURRENTLY under per-child deadlines, circuit
+  breakers (with decorrelated reopen-probe jitter), and hedged retry;
+- a dark child's last-good summary keeps serving — marked stale with a
+  measured ``staleness_s`` — until ``TPUDASH_FEDERATE_STALE_BUDGET``
+  expires, then its chips drop and the frame carries ``partial: true``;
+- child-local alerts are re-namespaced (chip ``east/slice-0/3``) and
+  ride the parent's silences/webhook path; ``child_down`` and
+  ``fleet_partial`` are synthesized beside them, debounced by the
+  anti-flap dwell (``TPUDASH_ALERT_DWELL``, tpudash.hysteresis.DwellSet);
+- ``/healthz`` folds per-child liveness the same way the worker/compose
+  tiers fold theirs: ``ok`` stays true (the parent process is alive and
+  serving), ``status`` and ``federation.children`` tell the truth.
+
+Steady state is near-free: ``/api/summary`` is ETag-revalidated, so a
+child whose data hasn't advanced answers ``304`` with no body.
+"""
+
+from tpudash.federation.source import (  # noqa: F401
+    ChildSpec,
+    FederatedSource,
+    parse_children,
+)

@@ -1,0 +1,60 @@
+"""One child's summary poller — blocking HTTP, ETag-revalidated.
+
+Runs on the federation source's dispatch threads (never the event
+loop).  Each call is one independent ``requests`` round trip so the
+hedged second attempt can run concurrently with the first on its own
+thread — a shared Session's connection pool would serialize exactly the
+two requests hedging needs in parallel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpudash.sources.base import SourceError
+
+
+@dataclass(frozen=True)
+class SummaryResult:
+    """One poll's outcome: ``not_modified`` means the child answered 304
+    against ``etag`` (doc is None — the caller's cached summary stands);
+    otherwise ``doc`` is the fresh summary and ``etag`` its validator."""
+
+    doc: "dict | None"
+    etag: "str | None"
+    not_modified: bool = False
+
+
+class HttpSummaryClient:
+    """``GET <url>/api/summary`` with If-None-Match and the parent's
+    bearer token (a fleet shares one TPUDASH_AUTH_TOKEN; per-child
+    credentials would live here if ever needed)."""
+
+    def __init__(self, url: str, auth_token: str = ""):
+        self.base = url.rstrip("/")
+        self.auth_token = auth_token
+
+    def fetch(self, etag: "str | None", timeout: float) -> SummaryResult:
+        import requests
+
+        headers = {"Accept-Encoding": "gzip"}
+        if etag:
+            headers["If-None-Match"] = etag
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        try:
+            resp = requests.get(
+                f"{self.base}/api/summary", headers=headers, timeout=timeout
+            )
+        except requests.RequestException as e:
+            raise SourceError(f"summary fetch failed: {e}") from e
+        if resp.status_code == 304:
+            return SummaryResult(doc=None, etag=etag, not_modified=True)
+        try:
+            resp.raise_for_status()
+            doc = resp.json()
+        except (requests.RequestException, ValueError) as e:
+            raise SourceError(
+                f"summary fetch failed: HTTP {resp.status_code}: {e}"
+            ) from e
+        return SummaryResult(doc=doc, etag=resp.headers.get("ETag"))

@@ -1,0 +1,37 @@
+"""Shared proxy-hygiene bits for every hop tpudash makes.
+
+Two proxies live in the tree — the fan-out worker's catch-all to the
+compose process (tpudash/broadcast/worker.py) and the federation
+parent's child drill-down hop (``/api/child/...``, tpudash/app/server.py)
+— and both must strip the same hop-by-hop header set.  One definition
+here so the hygiene cannot drift between them.
+"""
+
+from __future__ import annotations
+
+#: hop-by-hop headers a proxy must not forward (RFC 9110 §7.6.1), plus
+#: Host (the upstream's authority differs from the client-facing one)
+HOP_HEADERS = frozenset(
+    {
+        "connection",
+        "keep-alive",
+        "proxy-authenticate",
+        "proxy-authorization",
+        "te",
+        "trailer",
+        "transfer-encoding",
+        "upgrade",
+        "host",
+    }
+)
+
+
+def forward_headers(headers, drop: "frozenset[str] | set | None" = None) -> dict:
+    """The end-to-end subset of ``headers``: hop-by-hop names (plus any
+    caller-specific ``drop`` set, lowercase) removed."""
+    extra = drop or frozenset()
+    return {
+        k: v
+        for k, v in headers.items()
+        if k.lower() not in HOP_HEADERS and k.lower() not in extra
+    }

@@ -1,0 +1,513 @@
+"""FederatedSource — the fleet parent's child-polling fan-in.
+
+Speaks the ordinary ``MetricsSource`` protocol, so everything downstream
+— normalize, compose, alerts, the cohort broadcast plane, SSE workers —
+works on the fleet view unchanged.  ``fetch()`` polls every child's
+``/api/summary`` concurrently and returns the union of their per-chip
+tables with slices re-labeled ``<child>/<slice>``.
+
+The robustness contract (the reason this tier exists):
+
+- per-child deadline: one frame pays ONE deadline for its slowest
+  child, never the sum (same shape as MultiSource);
+- per-child circuit breaker with decorrelated reopen-probe jitter
+  (``TPUDASH_BREAKER_JITTER``, defaulting to 0.5 here): a quarantined
+  child costs nothing, and N children healing from one shared partition
+  don't get probed in the same instant;
+- hedged retry (``TPUDASH_FEDERATE_HEDGE``): a child that hasn't
+  answered after the hedge delay gets a second concurrent request, and
+  the first success wins — one slow handshake doesn't cost the deadline;
+- last-good retention: a failing child's most recent summary keeps
+  serving — marked stale, with measured ``staleness_s`` — until
+  ``TPUDASH_FEDERATE_STALE_BUDGET`` expires, then the child goes dark
+  and its chips leave the table.  ``fetch()`` raises only when EVERY
+  child is dark: degrade per child, never go dark whole.
+
+A child poll parked past its deadline stays on its daemon thread and is
+never re-dispatched while in flight (clients are one-shot per call, but
+the per-child streak accounting must stay honest — same policy as
+MultiSource's inflight guard).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+
+from tpudash.config import Config
+from tpudash.federation.client import HttpSummaryClient, SummaryResult
+from tpudash.federation.summary import digest_alerts, summary_to_batch
+from tpudash.schema import SampleBatch
+from tpudash.sources.base import MetricsSource, SourceError
+from tpudash.sources.breaker import BreakerPolicy, CircuitBreaker
+from tpudash.sources.multi import _FetchTask
+
+log = logging.getLogger("tpudash.federation")
+
+#: reopen-probe jitter the fan-in applies when TPUDASH_BREAKER_JITTER is
+#: not set explicitly: half a cooldown of decorrelation is what keeps a
+#: fleet of breakers opened by one shared partition from probing the
+#: healed network in a single synchronized wave
+DEFAULT_PROBE_JITTER = 0.5
+
+#: children statuses (federation_summary / the frame's federation block)
+STATUS_LIVE = "live"
+STATUS_STALE = "stale"
+STATUS_DARK = "dark"
+
+
+class ChildSpec:
+    """``[name=]url`` — one federated child.  The name prefixes every
+    slice the child contributes (keys become ``<name>/<slice>/<chip>``),
+    so it must not contain the key separator."""
+
+    def __init__(self, name: str, url: str):
+        if not name or "/" in name or "," in name:
+            raise ValueError(
+                f"bad child name {name!r} (non-empty, no '/' or ',')"
+            )
+        self.name = name
+        self.url = url.rstrip("/")
+
+    @classmethod
+    def parse(cls, item: str) -> "ChildSpec":
+        item = item.strip()
+        if not item:
+            raise ValueError("empty federation child spec")
+        name = None
+        if "=" in item.split("://", 1)[0]:  # '=' before the scheme → name
+            name, item = item.split("=", 1)
+            name = name.strip()
+        url = item.strip()
+        if name is None:
+            # default name from the authority, key-separator-safe
+            tail = url.split("://", 1)[-1].split("/", 1)[0]
+            name = tail.replace(":", "-") or "child"
+        return cls(name=name, url=url)
+
+
+def parse_children(spec: str) -> "list[ChildSpec]":
+    out = [ChildSpec.parse(s) for s in spec.split(",") if s.strip()]
+    if not out:
+        raise ValueError(
+            "federation needs TPUDASH_FEDERATE (comma-separated [name=]url "
+            "child dashboards)"
+        )
+    seen: set = set()
+    for c in out:
+        if c.name in seen:
+            raise ValueError(
+                f"duplicate federation child name {c.name!r} "
+                "(give each child a distinct name= prefix)"
+            )
+        seen.add(c.name)
+    return out
+
+
+class _ChildState:
+    """Everything the parent remembers about one child between polls."""
+
+    __slots__ = (
+        "spec",
+        "client",
+        "etag",
+        "last_batch",
+        "last_doc",
+        "last_contact_m",
+        "last_table_m",
+        "last_data_ts",
+        "last_ok",
+        "has_table",
+        "counters",
+    )
+
+    def __init__(self, spec: ChildSpec, client):
+        self.spec = spec
+        self.client = client
+        self.etag: "str | None" = None
+        #: last successfully-parsed table (slices already re-labeled) —
+        #: RETAINED across polls whose doc carries no table (a child
+        #: restarting against a dead upstream answers 200 with an error
+        #: and no rows; its cluster must fade through stale, not vanish)
+        self.last_batch: "SampleBatch | None" = None
+        self.last_doc: "dict | None" = None
+        #: monotonic stamp of the last successful contact (200 or 304)
+        self.last_contact_m: "float | None" = None
+        #: monotonic stamp of the last doc that actually CARRIED a table
+        #: — the stale-budget anchor while the child answers table-less
+        self.last_table_m: "float | None" = None
+        #: the child's own scrape stamp (epoch) — data age, not liveness
+        self.last_data_ts: "float | None" = None
+        self.last_ok = False
+        #: did the latest doc carry a table?  False = serving retained
+        #: rows (or nothing) for an answering-but-empty child
+        self.has_table = False
+        self.counters = {
+            "fetches": 0,
+            "errors": 0,
+            "etag_304s": 0,
+            "hedges": 0,
+            "hedge_wins": 0,
+        }
+
+
+class FederatedSource(MetricsSource):
+    name = "federated"
+
+    def __init__(
+        self,
+        cfg: Config,
+        children: "list[tuple[ChildSpec, object]] | None" = None,
+        clock=time.monotonic,
+        probe_jitter: "float | None" = None,
+    ):
+        """``children``: optional pre-built [(ChildSpec, client)] — tests
+        and the bench inject fakes; production builds HttpSummaryClients
+        from cfg.federate.  A client is any object with
+        ``fetch(etag, timeout) -> SummaryResult`` raising SourceError."""
+        self.cfg = cfg
+        if children is None:
+            children = [
+                (spec, HttpSummaryClient(spec.url, cfg.auth_token))
+                for spec in parse_children(cfg.federate)
+            ]
+        if probe_jitter is None:
+            probe_jitter = (
+                getattr(cfg, "breaker_jitter", 0.0) or DEFAULT_PROBE_JITTER
+            )
+        policy = BreakerPolicy(
+            failures=getattr(cfg, "breaker_failures", 3),
+            cooldown=getattr(cfg, "breaker_cooldown", 30.0),
+            probe_jitter=probe_jitter,
+        )
+        self._clock = clock
+        self._children: "list[_ChildState]" = [
+            _ChildState(spec, client) for spec, client in children
+        ]
+        # `breakers` / `last_errors` / `_last_fault` use MultiSource's
+        # exact attribute names ON PURPOSE: synthetic_load's rollback
+        # walk (app/service.py) discovers them by name, so a profiling
+        # burst can't open — or reclose — a breaker the real poll
+        # cadence owns
+        self.breakers: "dict[str, CircuitBreaker]" = {
+            st.spec.name: CircuitBreaker(policy, clock=clock)
+            for st in self._children
+        }
+        self.last_errors: "dict[str, str]" = {}
+        self._last_fault: "dict[str, str]" = {}
+        self._inflight: dict = {}
+        #: guards cross-thread snapshot reads (federation_summary from
+        #: compose/healthz) against the refresh thread's state swaps;
+        #: critical sections are pure pointer/dict work, never I/O
+        self._lock = threading.Lock()
+
+    # -- knobs ---------------------------------------------------------------
+    @property
+    def deadline(self) -> float:
+        return (
+            getattr(self.cfg, "federate_deadline", 0.0)
+            or getattr(self.cfg, "http_timeout", 4.0)
+            or 4.0
+        )
+
+    @property
+    def hedge(self) -> float:
+        h = getattr(self.cfg, "federate_hedge", 0.0)
+        # a hedge at/after the deadline never fires — clamp inside it
+        return min(h, self.deadline * 0.75) if h > 0 else 0.0
+
+    @property
+    def stale_budget(self) -> float:
+        return max(0.0, getattr(self.cfg, "federate_stale_budget", 30.0))
+
+    # -- one child's poll (dispatch-thread side) -----------------------------
+    def _poll_child(self, st: _ChildState) -> SummaryResult:
+        """One bounded poll: primary request, hedged second request after
+        the hedge delay, first success wins.  Runs on the dispatch
+        thread; every request is itself deadline-bounded."""
+        deadline, hedge = self.deadline, self.hedge
+        end = time.monotonic() + deadline
+        call = functools.partial(st.client.fetch, st.etag, deadline)
+        primary = _FetchTask(call)
+        tasks = [primary]
+        backup = None
+        if hedge > 0 and not primary.wait(hedge):
+            st.counters["hedges"] += 1
+            backup = _FetchTask(call)
+            tasks.append(backup)
+        errors: "list[str]" = []
+        while tasks:
+            for t in list(tasks):
+                if not t.done():
+                    continue
+                tasks.remove(t)
+                try:
+                    res = t.result()
+                except SourceError as e:  # noqa: PERF203 — per-attempt verdict
+                    errors.append(str(e))
+                    continue
+                if t is backup:
+                    st.counters["hedge_wins"] += 1
+                return res
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                break
+            if tasks:
+                tasks[0].wait(min(0.05, remaining))
+        if errors:
+            raise SourceError("; ".join(errors))
+        raise SourceError(
+            f"no response within the {deadline:g}s deadline"
+        )
+
+    # -- the fan-in ----------------------------------------------------------
+    def fetch(self):
+        errors: "dict[str, str]" = {}
+        pending: "list[tuple[_ChildState, _FetchTask]]" = []
+        for st in self._children:
+            name = st.spec.name
+            breaker = self.breakers[name]
+            old = self._inflight.get(name)
+            if old is not None and old.done():
+                self._inflight.pop(name)
+                old.exception()  # harvest, never propagate stale
+                old = None
+            if not breaker.allow():
+                fault = self._last_fault.get(name)
+                errors[name] = (
+                    f"circuit open ({breaker.cooldown_remaining:.1f}s "
+                    "until half-open probe)"
+                    + (f"; last failure: {fault}" if fault else "")
+                )
+                continue
+            if old is not None:
+                errors[name] = self._last_fault[name] = (
+                    "previous poll still in flight (child hung)"
+                )
+                breaker.record_failure()
+                st.last_ok = False
+                continue
+            fut = _FetchTask(functools.partial(self._poll_child, st))
+            self._inflight[name] = fut
+            pending.append((st, fut))
+
+        bug: "Exception | None" = None
+        if pending:
+            # one SHARED wait: children poll concurrently, the frame pays
+            # one deadline (+ scheduling slack) for its slowest child
+            end = time.monotonic() + self.deadline + 0.25
+            for _, fut in pending:
+                fut.wait(max(0.0, end - time.monotonic()))
+            for st, fut in pending:
+                name = st.spec.name
+                breaker = self.breakers[name]
+                if not fut.done():
+                    errors[name] = self._last_fault[name] = (
+                        f"no response within the {self.deadline:g}s deadline"
+                    )
+                    breaker.record_failure()
+                    st.counters["errors"] += 1
+                    st.last_ok = False
+                    continue
+                self._inflight.pop(name, None)
+                try:
+                    res = fut.result()
+                except SourceError as e:
+                    errors[name] = self._last_fault[name] = str(e)
+                    breaker.record_failure()
+                    st.counters["errors"] += 1
+                    st.last_ok = False
+                    log.warning("federation: child %s failed: %s", name, e)
+                    continue
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    # a parent-side bug, not a child fault — deferred so
+                    # every sibling still lands in its own ledger
+                    breaker.record_failure()
+                    self._last_fault[name] = f"{type(e).__name__}: {e}"
+                    st.last_ok = False
+                    bug = e
+                    continue
+                err = self._record_result(st, res)
+                if err is not None:
+                    errors[name] = self._last_fault[name] = err
+                    breaker.record_failure()
+                    st.counters["errors"] += 1
+                    continue
+                breaker.record_success()
+                self._last_fault.pop(name, None)
+
+        self.last_errors = errors
+        if bug is not None:
+            raise bug
+        return self._assemble(errors)
+
+    def _record_result(self, st: _ChildState, res: SummaryResult) -> "str | None":
+        """Fold one successful poll into the child's state; returns an
+        error string when the document is malformed (a failure for the
+        breaker ledger).  Parsing runs OUTSIDE the snapshot lock."""
+        now_m = self._clock()
+        if res.not_modified:
+            with self._lock:
+                st.counters["fetches"] += 1
+                st.counters["etag_304s"] += 1
+                st.last_contact_m = now_m
+                st.last_ok = True
+            return None
+        try:
+            batch = summary_to_batch(st.spec.name, res.doc)
+        # the doc is UNTRUSTED wire input from another (possibly
+        # version-skewed, possibly buggy) process: ANY parse failure —
+        # ValueError from the explicit checks, KeyError/TypeError from a
+        # half-shaped doc — refuses this child, never the fleet frame
+        # tpulint: allow[broad-except] untrusted child doc; refuse per child
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                st.last_ok = False
+            return f"malformed summary: {type(e).__name__}: {e}"
+        with self._lock:
+            st.counters["fetches"] += 1
+            st.etag = res.etag
+            st.last_doc = res.doc
+            st.last_contact_m = now_m
+            ts = res.doc.get("ts")
+            st.last_data_ts = float(ts) if isinstance(ts, (int, float)) else None
+            st.last_ok = True
+            if batch is not None:
+                st.last_batch = batch
+                st.last_table_m = now_m
+                st.has_table = True
+            else:
+                # valid-but-empty doc: keep the retained rows (they fade
+                # through stale → dark on the last_table_m anchor), and
+                # remember the child currently has nothing of its own
+                st.has_table = False
+        return None
+
+    def _child_status(self, st: _ChildState, now_m: float) -> "tuple[str, float]":
+        """(status, staleness_s) for one child.  Staleness measures
+        CONTACT (when did a poll last succeed), not data age — a child
+        answering 304s is perfectly live even though its data stood
+        still.  Status derives from poll OUTCOMES, not poll recency:
+        the whole serving stack is demand-driven (no viewers → no
+        refresh → no child polls), and an idle parent must not age its
+        healthy children into stale/dark — it serves its cache with
+        ``last_updated``/``staleness_s`` carrying the honest age, and
+        the next viewer's poll re-measures everything."""
+        if st.last_contact_m is None:
+            return STATUS_DARK, float("inf")
+        staleness = max(0.0, now_m - st.last_contact_m)
+        if st.last_ok:
+            # last_ok flips false on the first failed/parked poll, so
+            # "the most recent completed poll succeeded" is the honest
+            # live verdict whatever wall time did in between — PROVIDED
+            # the poll brought a table.  An answering-but-empty child
+            # (restarting against a dead upstream: 200, error set, no
+            # rows) serves its RETAINED rows and fades stale → dark on
+            # the last-table anchor instead of silently vanishing live.
+            if st.has_table:
+                return STATUS_LIVE, staleness
+            if st.last_table_m is None:
+                return STATUS_DARK, staleness  # never had rows to show
+            staleness = max(0.0, now_m - st.last_table_m)
+        if staleness <= self.stale_budget:
+            return STATUS_STALE, staleness
+        return STATUS_DARK, staleness
+
+    def _assemble(self, errors: "dict[str, str]"):
+        """The frame's union: live + stale children contribute their
+        last-good rows; dark children contribute nothing.  Raises only
+        when the WHOLE fleet is dark."""
+        now_m = self._clock()
+        batches: "list[SampleBatch]" = []
+        with self._lock:
+            for st in self._children:
+                status, _ = self._child_status(st, now_m)
+                if status == STATUS_DARK or st.last_batch is None:
+                    continue
+                batches.append(st.last_batch)
+        if not any(b.nrows for b in batches):
+            detail = "; ".join(
+                f"{k}: {v} [breaker {self.breakers[k].state}]"
+                for k, v in errors.items()
+            ) or "no child has ever answered"
+            raise SourceError(
+                f"all {len(self._children)} federated children dark: {detail}"
+            )
+        if len(batches) == 1:
+            return batches[0]
+        return SampleBatch.concat(batches)
+
+    # -- observability (compose / healthz / alerts read these) ---------------
+    def federation_summary(self) -> dict:
+        """The per-child truth the frame, /healthz, and the drill assert
+        on: status, measured staleness, breaker state, data age, counters
+        — and the fleet-level ``partial`` verdict."""
+        now_m = self._clock()
+        # tpulint: allow[wall-clock] child data ages are epoch-stamp math
+        now_w = time.time()
+        children: dict = {}
+        with self._lock:
+            for st in self._children:
+                name = st.spec.name
+                status, staleness = self._child_status(st, now_m)
+                doc = st.last_doc or {}
+                entry = {
+                    "url": st.spec.url,
+                    "status": status,
+                    "staleness_s": (
+                        round(staleness, 3)
+                        if staleness != float("inf")
+                        else None
+                    ),
+                    "data_age_s": (
+                        round(max(0.0, now_w - st.last_data_ts), 3)
+                        if st.last_data_ts
+                        else None
+                    ),
+                    "chips": doc.get("chips", 0) if status != STATUS_DARK else 0,
+                    "child_partial": bool(doc.get("partial")),
+                    "child_error": doc.get("error"),
+                    "breaker": self.breakers[name].summary(),
+                    "counters": dict(st.counters),
+                }
+                err = self.last_errors.get(name) or self._last_fault.get(name)
+                if err:
+                    entry["last_error"] = err
+                children[name] = entry
+        statuses = [c["status"] for c in children.values()]
+        return {
+            "children": children,
+            "children_total": len(children),
+            "children_live": statuses.count(STATUS_LIVE),
+            "children_stale": statuses.count(STATUS_STALE),
+            "children_dark": statuses.count(STATUS_DARK),
+            # partial = ANY child not fresh: the pane is still serving,
+            # but someone reading it must know part of the fleet is
+            # last-good or missing data
+            "partial": any(s != STATUS_LIVE for s in statuses),
+        }
+
+    def federated_alerts(self) -> "list[dict]":
+        """Every reachable child's alert digest, re-namespaced into the
+        parent's alert space (chip ``<child>/<chip>``, origin in
+        ``child``).  Dark children contribute nothing — ``child_down``
+        speaks for them."""
+        now_m = self._clock()
+        out: "list[dict]" = []
+        with self._lock:
+            for st in self._children:
+                status, _ = self._child_status(st, now_m)
+                if status == STATUS_DARK or st.last_doc is None:
+                    continue
+                out.extend(digest_alerts(st.spec.name, st.last_doc))
+        return out
+
+    def child_urls(self) -> "dict[str, str]":
+        """name → base URL, for the parent's drill-down proxy."""
+        return {st.spec.name: st.spec.url for st in self._children}
+
+    def close(self) -> None:
+        # poll threads are daemons; clients hold no persistent sockets
+        pass

@@ -1,0 +1,161 @@
+"""The child-summary codec: build on the child, decode on the parent.
+
+One module owns both directions so the wire contract cannot drift: the
+child's ``/api/summary`` body is built by :func:`build_summary` (from a
+live DashboardService, under its publish lock) and the parent turns it
+back into scrape-shaped data with :func:`summary_to_batch` and
+:func:`digest_alerts`.  The document is versioned (``v``) and the parent
+refuses shapes it doesn't understand — a half-upgraded fleet must fail
+loudly per child, never render garbage fleet-wide.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudash import schema
+from tpudash.schema import SampleBatch
+
+#: wire-format version of the summary document
+SUMMARY_V = 1
+
+
+def build_summary(service) -> dict:
+    """The compact fleet-rollup document one child publishes.
+
+    Caller holds the service's publish lock (the server builds this in
+    the executor through :meth:`DashboardService.summary_doc`).  Carries
+    everything a federation parent needs in one poll: per-chip latest
+    numeric columns (identity split out, NaN → null), the fleet
+    averages, the alert digest, source health, and the child's own
+    partial/stale markers.
+    """
+    df = service.last_df
+    doc: dict = {
+        "v": SUMMARY_V,
+        "ts": service.last_updated_ts,
+        "generation": service.cfg.generation,
+        "error": service.last_error,
+        "stalled": service.refresh_stalled,
+        "chips": 0 if df is None else int(len(df)),
+        # a child that is ITSELF degraded (one of its multi-source
+        # endpoints down, or its own federation partial) says so — the
+        # parent surfaces nested partiality instead of flattening it away
+        "partial": bool(getattr(service.source, "last_errors", None)),
+        "health": service.source_health(),
+        "alerts": [dict(a) for a in service.last_alerts],
+    }
+    if df is None:
+        return doc
+    from tpudash.normalize import dense_block
+
+    arr, cols = service._df_block
+    if arr is None or arr.shape[0] != len(df):
+        arr, cols = dense_block(df)
+    keys = df.index.tolist()
+    doc["identity"] = {
+        "slice": df["slice_id"].tolist(),
+        "chip_id": [int(c) for c in df["chip_id"].tolist()],
+        "host": df["host"].tolist(),
+        "accel": (
+            df[schema.ACCEL_TYPE].fillna("").tolist()
+            if schema.ACCEL_TYPE in df
+            else [""] * len(df)
+        ),
+    }
+    doc["keys"] = keys
+    if arr is not None:
+        doc["cols"] = list(cols)
+        # NaN has no JSON spelling — null round-trips
+        doc["matrix"] = [
+            [None if v != v else v for v in row] for row in arr.tolist()
+        ]
+        col_pos = {c: i for i, c in enumerate(cols)}
+        from tpudash.normalize import block_average
+
+        doc["fleet"] = {
+            p.column: block_average(arr, col_pos[p.column], p.column)
+            for p in service._active_panels(df)
+            if p.column in col_pos
+        }
+    else:  # legacy mixed-dtype frames
+        from tpudash.normalize import column_average, numeric_columns
+
+        ncols = list(numeric_columns(df))
+        doc["cols"] = ncols
+        sub = df[ncols].to_numpy(dtype=float, na_value=np.nan)
+        doc["matrix"] = [
+            [None if v != v else v for v in row] for row in sub.tolist()
+        ]
+        doc["fleet"] = {
+            p.column: column_average(df, p.column)
+            for p in service._active_panels(df)
+            if p.column in ncols
+        }
+    return doc
+
+
+def _require(doc: dict, key: str):
+    if key not in doc:
+        raise ValueError(f"child summary missing {key!r}")
+    return doc[key]
+
+
+def summary_to_batch(name: str, doc: dict) -> "SampleBatch | None":
+    """One child's summary → a columnar batch with its slices re-labeled
+    ``<name>/<slice>`` (fleet join without collisions — the federated
+    twin of MultiSource's slice_name relabel).  None when the child has
+    no table yet (fresh start / error cycle).  Raises ``ValueError`` on
+    a malformed or version-incompatible document.
+    """
+    if not isinstance(doc, dict):
+        raise ValueError("child summary is not a JSON object")
+    v = doc.get("v")
+    if v != SUMMARY_V:
+        raise ValueError(f"child summary version {v!r} != {SUMMARY_V}")
+    if "keys" not in doc or not doc.get("cols"):
+        return None  # no table yet — a valid empty child
+    ident = _require(doc, "identity")
+    cols = [str(c) for c in _require(doc, "cols")]
+    matrix = _require(doc, "matrix")
+    slices = [f"{name}/{s}" for s in ident["slice"]]
+    n = len(slices)
+    if not (
+        len(ident["chip_id"]) == len(ident["host"]) == len(matrix) == n
+    ):
+        raise ValueError("child summary identity/matrix lengths disagree")
+    mat = np.array(
+        [[np.nan if v is None else float(v) for v in row] for row in matrix],
+        dtype=np.float64,
+    ).reshape(n, len(cols))
+    return SampleBatch(
+        metrics=cols,
+        slices=slices,
+        hosts=[str(h) for h in ident["host"]],
+        chip_ids=np.asarray([int(c) for c in ident["chip_id"]], dtype=np.int64),
+        accels=[str(a) for a in ident.get("accel") or [""] * n],
+        matrix=mat,
+    )._sorted()
+
+
+def digest_alerts(name: str, doc: dict) -> "list[dict]":
+    """A child's alert digest re-namespaced into the parent's alert
+    space: chip ``slice-0/3`` → ``<name>/slice-0/3``, a ``child`` key
+    naming the origin.  Child-SILENCED alerts are dropped — the child's
+    operator already acknowledged them, and the parent's own silence
+    annotation would otherwise un-acknowledge them fleet-side and page
+    twice for one incident."""
+    out = []
+    for a in doc.get("alerts") or []:
+        if not isinstance(a, dict) or "rule" not in a or "chip" not in a:
+            continue  # tolerate a partial digest; the frame must not die
+        if a.get("silenced"):
+            continue
+        e = dict(a)
+        chip = str(e["chip"])
+        # service-scoped chips ("server") namespace too: two children
+        # both shedding must not collapse onto one (rule, chip) key
+        e["chip"] = f"{name}/{chip}"
+        e["child"] = name
+        out.append(e)
+    return out

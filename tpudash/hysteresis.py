@@ -6,10 +6,22 @@ frame: ok → pending (breaching, streak < for_cycles) → firing; any
 non-breaching frame resets to ok, and keys not seen this frame resolve
 implicitly (the chip left the table or recovered).  One implementation
 here so the semantics cannot silently diverge.
+
+:class:`DwellSet` is the resolve-side twin: ``for_cycles`` debounces the
+FIRING edge, the dwell debounces the RESOLVE edge.  Synthesized alerts
+(``endpoint_down``, ``child_down``, ``compose_down``, ``fleet_partial``)
+fire from binary conditions — a breaker state, a bus link — that can
+flap at sub-poll period, and the webhook pager fires on every
+transition: without a dwell, one flapping federated child pages the
+on-call once per flap.  With it, a fired alert keeps reporting
+``firing`` (flagged ``dwell: true``) until the condition has stayed
+clear for ``dwell_s`` seconds, collapsing a flap storm into one page and
+one resolve.
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 
@@ -51,3 +63,75 @@ class TrackSet:
 
     def __len__(self) -> int:
         return len(self._tracks)
+
+
+@dataclass
+class _Dwell:
+    entry: dict          # the last FIRING alert entry for this key
+    last_firing: float   # monotonic stamp of the last firing update
+
+
+@dataclass
+class DwellSet:
+    """Anti-flap resolve dwell over synthesized-alert entries.
+
+    ``apply(entries, now)`` takes the alert entries a synthesis site just
+    built (AlertEngine output shape, keyed by ``(rule, chip)``) and
+    returns them with held entries appended: a key that was firing
+    recently but produced no firing entry this cycle is re-emitted as a
+    copy of its last firing entry, flagged ``dwell: true``, until the
+    condition has stayed clear for ``dwell_s`` seconds.  ``dwell_s <= 0``
+    is a transparent pass-through (the shipped default — operators opt
+    in; the federation drill and runbook set it).
+
+    Timing is monotonic (the clock is injectable for tests): a wall-clock
+    step must neither instantly expire a dwell nor pin one forever.
+    """
+
+    dwell_s: float = 0.0
+    clock: "object" = time.monotonic
+    _held: dict = field(default_factory=dict)
+
+    def apply(self, entries: "list[dict]", now: "float | None" = None) -> "list[dict]":
+        if self.dwell_s <= 0:
+            return entries
+        now = float(self.clock()) if now is None else float(now)
+        firing_keys = set()
+        for e in entries:
+            key = (e.get("rule"), e.get("chip"))
+            if e.get("state") == "firing":
+                firing_keys.add(key)
+                # keep a copy: the held re-emission must not alias an
+                # entry later cycles mutate (silence annotation stamps
+                # entries in place)
+                self._held[key] = _Dwell(entry=dict(e), last_firing=now)
+        out = list(entries)
+        present = {(e.get("rule"), e.get("chip")) for e in entries}
+        for key in list(self._held):
+            if key in firing_keys:
+                continue
+            dw = self._held[key]
+            if now - dw.last_firing >= self.dwell_s:
+                del self._held[key]
+                continue
+            if key in present:
+                # demoted to pending this cycle (e.g. breaker half-open
+                # mid-recovery): the dwell upgrades it back to firing so
+                # the pager sees no resolve yet — replace, don't duplicate
+                out = [
+                    e
+                    for e in out
+                    if (e.get("rule"), e.get("chip")) != key
+                ]
+            held = dict(dw.entry)
+            held["state"] = "firing"
+            held["dwell"] = True
+            held["detail"] = (
+                (held.get("detail") or "")
+                + f" [recovering: held by {self.dwell_s:g}s anti-flap dwell]"
+            ).strip()
+            out.append(held)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._held)

@@ -75,14 +75,15 @@ def make_source(cfg) -> MetricsSource:
     if retries > 0:
         from tpudash.sources.retry import ResilientSource, RetryPolicy
 
-        if cfg.source == "multi":
-            # the multi join is already resilient per endpoint (circuit
-            # breakers, concurrent deadline, partial degradation), and
-            # re-invoking the WHOLE join on an all-failed frame would
-            # multiply every endpoint's breaker failures by the attempt
-            # count — one transient fleet-wide blip would quarantine all
-            # endpoints for a full cooldown.  Keep the wrapper for its
-            # health ledger; the breakers own the retry policy.
+        if cfg.source == "multi" or getattr(cfg, "federate", ""):
+            # the multi join and the federated fan-in are already
+            # resilient per endpoint/child (circuit breakers, concurrent
+            # deadline, partial degradation), and re-invoking the WHOLE
+            # join on an all-failed frame would multiply every breaker's
+            # failures by the attempt count — one transient fleet-wide
+            # blip would quarantine everything for a full cooldown.
+            # Keep the wrapper for its health ledger; the breakers own
+            # the retry policy.
             policy = RetryPolicy(retries=0)
         else:
             policy = RetryPolicy(
@@ -98,6 +99,14 @@ def make_source(cfg) -> MetricsSource:
 
 def _make_source(cfg) -> MetricsSource:
     kind = cfg.source
+    if getattr(cfg, "federate", ""):
+        # TPUDASH_FEDERATE turns this instance into a fleet parent: the
+        # children ARE the source (their /api/summary rollups), whatever
+        # TPUDASH_SOURCE says — a parent that also scraped its own
+        # Prometheus would double-count chips its children already carry
+        from tpudash.federation.source import FederatedSource
+
+        return FederatedSource(cfg)
     if kind == "prometheus":
         return PrometheusSource(cfg)
     if kind == "fixture":

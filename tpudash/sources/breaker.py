@@ -20,6 +20,7 @@ state machine honest.  Clock-injectable for tests.
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass
 
@@ -34,19 +35,34 @@ class BreakerPolicy:
     failures: int = 3
     #: seconds an open circuit waits before allowing a half-open probe.
     cooldown: float = 30.0
+    #: reopen-probe jitter as a fraction of ``cooldown``: each time the
+    #: circuit opens it draws a FRESH extra wait in [0, probe_jitter ×
+    #: cooldown], so N breakers opened by one shared partition don't all
+    #: send their half-open probes in the same instant when it heals
+    #: (the federated fan-in sets this; 0 keeps the exact-cooldown
+    #: behavior deadline-sensitive callers and tests rely on).
+    probe_jitter: float = 0.0
 
 
 class CircuitBreaker:
     """closed → open → half_open state machine for one endpoint."""
 
-    def __init__(self, policy: BreakerPolicy | None = None, clock=time.monotonic):
+    def __init__(
+        self,
+        policy: BreakerPolicy | None = None,
+        clock=time.monotonic,
+        rng: "random.Random | None" = None,
+    ):
         self.policy = policy or BreakerPolicy()
         self._clock = clock
+        self._rng = rng or random.Random()
         self.state = STATE_CLOSED
         self.consecutive_failures = 0
         self.total_failures = 0
         self.total_opens = 0
         self._opened_at: "float | None" = None
+        #: extra reopen wait drawn at open time (decorrelated probes)
+        self._probe_jitter_s = 0.0
 
     def allow(self) -> bool:
         """May the caller fetch this endpoint now?  Transitions an open
@@ -55,11 +71,17 @@ class CircuitBreaker:
         record_failure before the next allow() — MultiSource's one
         fetch-per-frame cadence guarantees that)."""
         if self.state == STATE_OPEN:
-            if self._clock() - self._opened_at >= self.policy.cooldown:
+            if self._clock() - self._opened_at >= self.effective_cooldown:
                 self.state = STATE_HALF_OPEN
                 return True
             return False
         return True  # closed, or half_open (the probe itself)
+
+    @property
+    def effective_cooldown(self) -> float:
+        """This open's actual wait: cooldown + the jitter drawn when it
+        opened (fresh per open — decorrelated across opens too)."""
+        return self.policy.cooldown + self._probe_jitter_s
 
     def record_success(self) -> None:
         self.state = STATE_CLOSED
@@ -79,6 +101,12 @@ class CircuitBreaker:
             self.state = STATE_OPEN
             self.total_opens += 1
             self._opened_at = self._clock()
+            jit = self.policy.probe_jitter
+            self._probe_jitter_s = (
+                self._rng.uniform(0.0, jit * self.policy.cooldown)
+                if jit > 0
+                else 0.0
+            )
 
     def snapshot(self) -> dict:
         """State for rollback — profiling renders are synthetic load and
@@ -86,6 +114,7 @@ class CircuitBreaker:
         d = dict(self.__dict__)
         d.pop("policy")
         d.pop("_clock")
+        d.pop("_rng")
         return d
 
     def restore(self, snap: dict) -> None:
@@ -96,7 +125,7 @@ class CircuitBreaker:
         if self.state != STATE_OPEN:
             return 0.0
         return max(
-            0.0, self.policy.cooldown - (self._clock() - self._opened_at)
+            0.0, self.effective_cooldown - (self._clock() - self._opened_at)
         )
 
     @property

@@ -19,6 +19,15 @@ each ``name:key=value,key=value``:
     hang:p=0.1,ms=3000          # with prob p, block ms (bounded), then fail
     flap:period=6               # scripted up/down: the 2nd half of every
                                 # period-fetch window fails deterministically
+    partition:mode=refuse       # network partition, three distinguishable
+    partition:mode=hang,ms=2000 # shapes: ``refuse`` fails instantly
+    partition:mode=drip,ms=2000 # (connect refused — the peer's port is
+                                # closed), ``hang`` accepts then blocks ms
+                                # before failing (SYN-ACK'd but the far
+                                # process is wedged), ``drip`` trickles
+                                # for ms in small slices before failing
+                                # (bytes arrive too slowly to beat the
+                                # deadline).  p= optional (default 1).
     drop_chip:slice=slice-a,chip=3   # chip dropout (slice= optional)
     partial:p=0.2,frac=0.5      # with prob p, drop ~frac of the samples
     malformed:p=0.1             # with prob p, corrupt ~10% of samples
@@ -72,6 +81,11 @@ class ChaosScenario:
     hang_p: float = 0.0
     hang_ms: float = 0.0
     flap_period: int = 0
+    #: network-partition shape: "" (off) | "refuse" | "hang" | "drip" —
+    #: the three ways a partitioned peer actually fails (see module doc)
+    partition_mode: str = ""
+    partition_p: float = 0.0
+    partition_ms: float = 0.0
     partial_p: float = 0.0
     partial_frac: float = 0.5
     malformed_p: float = 0.0
@@ -124,6 +138,20 @@ class ChaosScenario:
                     kwargs["flap_period"] = int(args["period"])
                     if kwargs["flap_period"] < 2:
                         raise ValueError("flap period must be >= 2")
+                elif name == "partition":
+                    mode = args["mode"].strip().lower()
+                    if mode not in ("refuse", "hang", "drip"):
+                        raise ValueError(
+                            f"partition mode {mode!r} not one of "
+                            "refuse/hang/drip"
+                        )
+                    kwargs["partition_mode"] = mode
+                    kwargs["partition_p"] = float(args.get("p", 1.0))
+                    kwargs["partition_ms"] = float(args.get("ms", 2000.0))
+                    if mode != "refuse" and kwargs["partition_ms"] <= 0:
+                        raise ValueError(
+                            f"partition mode {mode!r} needs ms > 0"
+                        )
                 elif name == "partial":
                     kwargs["partial_p"] = float(args.get("p", 1.0))
                     kwargs["partial_frac"] = float(args.get("frac", 0.5))
@@ -138,7 +166,7 @@ class ChaosScenario:
                     f"chaos directive {item!r} missing arg {e}"
                 ) from None
         for k in ("latency_p", "error_p", "hang_p", "partial_p",
-                  "malformed_p", "partial_frac"):
+                  "malformed_p", "partial_frac", "partition_p"):
             p = kwargs.get(k, 0.0)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"chaos {k}={p} outside [0, 1]")
@@ -185,6 +213,33 @@ class ChaosSource(MetricsSource):
             self.injected["flap"] += 1
             raise SourceError(
                 f"chaos: flap down-window (cycle {n} of period {sc.flap_period})"
+            )
+        if sc.partition_mode and (
+            sc.partition_p >= 1.0 or rng.random() < sc.partition_p
+        ):
+            self.injected[f"partition_{sc.partition_mode}"] += 1
+            if sc.partition_mode == "refuse":
+                # the peer's port is closed: the kernel answers RST, the
+                # caller fails INSTANTLY — zero latency is this mode's
+                # signature (a breaker opens fast and cheap)
+                raise SourceError("chaos: partition (connection refused)")
+            wait_s = min(sc.partition_ms / 1000.0, MAX_HANG_S)
+            if sc.partition_mode == "hang":
+                # SYN-ACK'd but the far process is wedged: the caller
+                # pays its full deadline in ONE silent block
+                self._sleep(wait_s)
+                raise SourceError(
+                    f"chaos: partition (accepted, then hung {wait_s:g}s)"
+                )
+            # drip: bytes trickle in below any useful rate — the caller
+            # sees PROGRESS (so naive byte-activity watchdogs don't trip)
+            # yet still blows its deadline; slept in slices so an
+            # injectable sleep can observe the shape
+            for _ in range(10):
+                self._sleep(wait_s / 10.0)
+            raise SourceError(
+                f"chaos: partition (slow drip: trickled for {wait_s:g}s, "
+                "response never completed)"
             )
         if sc.hang_p and rng.random() < sc.hang_p:
             self.injected["hang"] += 1

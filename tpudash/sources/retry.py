@@ -26,11 +26,22 @@ from tpudash.sources.base import MetricsSource, SourceError
 
 @dataclass(frozen=True)
 class RetryPolicy:
-    """Exponential backoff with full jitter, bounded per frame."""
+    """Decorrelated-jitter backoff, bounded per frame.
+
+    Decorrelated jitter (each sleep drawn from ``[base, 3 × previous
+    sleep]``, capped) instead of plain exponential-with-full-jitter: when
+    N sources fail at the same instant — a shared partition cutting every
+    federated child at once — exponential schedules keep the retry WAVES
+    aligned (every client's attempt-k window starts together), while
+    chaining each draw on the client's own previous sleep decorrelates
+    the sequences after the first retry, so recovery doesn't land as N
+    synchronized retry storms on a just-healed endpoint.
+    """
 
     #: extra attempts after the first failure (0 = reference behavior).
     retries: int = 2
-    #: first backoff, seconds; attempt k sleeps ≤ base * 2**k.
+    #: backoff floor, seconds: every sleep is drawn from
+    #: [base, 3 × previous] (first sleep from [base, 3 × base]).
     base_backoff: float = 0.25
     #: per-sleep cap, seconds.
     max_backoff: float = 2.0
@@ -40,9 +51,22 @@ class RetryPolicy:
     #: (make_source sets this to the refresh interval).  None = unbounded.
     frame_budget: "float | None" = None
 
-    def backoff(self, attempt: int, rng: random.Random | None = None) -> float:
-        cap = min(self.max_backoff, self.base_backoff * (2.0**attempt))
-        return (rng or random).uniform(0.0, cap)
+    def backoff(
+        self,
+        attempt: int,
+        rng: random.Random | None = None,
+        prev: "float | None" = None,
+    ) -> float:
+        """One sleep: decorrelated jitter chained on ``prev`` (the
+        previous sleep this fetch actually drew).  ``attempt`` is kept
+        for callers without a chain — it seeds the window at base·2^k so
+        a stateless call still spreads."""
+        r = rng or random
+        if prev is None and attempt > 0:
+            prev = min(self.max_backoff, self.base_backoff * (2.0**attempt))
+        lo = min(self.base_backoff, self.max_backoff)
+        hi = max(lo, min(self.max_backoff, 3.0 * (prev if prev else lo)))
+        return r.uniform(lo, hi)
 
 
 class SourceHealth:
@@ -132,6 +156,7 @@ class ResilientSource(MetricsSource):
         start = time.monotonic()
         last_exc: Exception | None = None
         made = 0
+        prev_delay: "float | None" = None
         for attempt in range(attempts):
             try:
                 samples = self.inner.fetch()
@@ -143,7 +168,12 @@ class ResilientSource(MetricsSource):
                     and time.monotonic() - start >= budget
                 )
                 if made < attempts and not out_of_time:
-                    delay = self.policy.backoff(attempt, self._rng)
+                    # chain on the DRAWN delay, not the budget-clamped
+                    # one: the decorrelation must keep widening even
+                    # when the frame budget truncates actual sleeps
+                    delay = prev_delay = self.policy.backoff(
+                        attempt, self._rng, prev=prev_delay
+                    )
                     if budget is not None:
                         # clamp to what's LEFT of the frame budget: a
                         # max_backoff sleep must not start with only
