@@ -229,8 +229,19 @@ def _rss_mb() -> float:
     return 0.0
 
 
+#: BENCH_r05's measured 4096-chip JSON SSE delta — the fixed baseline the
+#: binary wire format is graded against (ISSUE 10 acceptance: ≥3x smaller)
+R05_JSON_DELTA_BYTES = 344627
+#: ISSUE 10 hard ceiling for the 4096-chip scrape→render p50
+SCALE_4096_P50_BUDGET_MS = 20.0
+
+
 def bench_scale(
-    total_chips: int, frames: int = N_FRAMES, ring: int = 30
+    total_chips: int,
+    frames: int = N_FRAMES,
+    ring: int = 30,
+    p50_budget_ms: "float | None" = None,
+    binary_floor_bytes: "int | None" = None,
 ) -> dict:
     """Headroom PAST the 256-chip north star: p50, steady-state SSE delta
     bytes, and the memory ceiling at ``total_chips`` (4×256-chip slices,
@@ -267,9 +278,43 @@ def bench_scale(
         assert len(frame["selected"]) == total_chips
     delta = frame_delta(prev, frame)
     assert delta is not None
+    # the binary twin of the steady-state delta (tpudash/app/wire.py):
+    # measured as the complete framed stream event — exactly the bytes a
+    # ?format=bin subscriber receives per tick — plus the seal-side cost
+    # of producing it (frame_delta + encode, the marginal work the
+    # binary tier adds to one cohort seal)
+    import statistics
+
+    from tpudash.app import wire
+
+    bin_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        d = frame_delta(prev, frame)
+        buf = wire.encode_delta(prev, d)
+        bin_ms.append((time.perf_counter() - t0) * 1e3)
+    bin_event = wire.bin_event(wire.EVT_DELTA, "1-1", buf)
+    assert wire.decode_delta(buf, prev) == delta, (
+        "binary delta must round-trip to the JSON delta exactly"
+    )
+    p50 = svc.timer.percentile(0.5)
+    if p50_budget_ms is not None:
+        # ISSUE 10 acceptance: the columnar hot path must hold the frame
+        # budget at this scale — a hard gate, not a trend check
+        assert p50 * 1e3 <= p50_budget_ms, (
+            f"scale_{total_chips} p50 {p50 * 1e3:.1f}ms blew the "
+            f"{p50_budget_ms:g}ms budget"
+        )
+    if binary_floor_bytes is not None:
+        assert len(bin_event) <= binary_floor_bytes, (
+            f"binary delta {len(bin_event)}B at {total_chips} chips — "
+            f"not ≥3x smaller than the {R05_JSON_DELTA_BYTES}B r05 JSON delta"
+        )
     return {
-        "p50_s": svc.timer.percentile(0.5),
+        "p50_s": p50,
         "sse_delta_bytes": len(f"data: {_dumps(delta)}\n\n".encode()),
+        "binary_delta_bytes": len(bin_event),
+        "bin_seal_ms": round(statistics.median(bin_ms), 2),
         "rss_mb": _rss_mb(),
         "rss_growth_mb": round(_rss_mb() - rss_full, 1),
     }
@@ -612,6 +657,25 @@ def bench_tsdb(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> dict:
     ingest_s = time.perf_counter() - t0
     stats = store.stats()
     n_points = n_frames * len(keys) * n_cols
+    # native-vs-Python codec throughput, side by side (ISSUE 10): same
+    # frames through a store whose Gorilla encode is pinned to the pure-
+    # Python path — the two columns quantify what the native hot loop
+    # buys, and the ratio regressing means the native path quietly
+    # stopped engaging
+    from tpudash.tsdb import gorilla as _g
+
+    native_encoders = (_g.encode_timestamps, _g.encode_values)
+    try:
+        _g.encode_timestamps = _g.encode_timestamps_py
+        _g.encode_values = _g.encode_values_py
+        store_py = TSDB(chunk_points=120)
+        t0 = time.perf_counter()
+        for ts, mat in zip(stamps, mats):
+            store_py.append_frame(ts, keys, cols, mat)
+        store_py.flush(seal_partial=True)
+        ingest_py_s = time.perf_counter() - t0
+    finally:
+        _g.encode_timestamps, _g.encode_values = native_encoders
     assert stats["raw_points"] == n_frames, "bench store lost frames"
     # baseline: the same horizon in the legacy /api/history JSON shape
     json_bytes = len(
@@ -638,6 +702,10 @@ def bench_tsdb(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> dict:
     q_times.sort()
     return {
         "tsdb_ingest_points_per_s": int(n_points / ingest_s),
+        "tsdb_ingest_mpoints_per_s": round(n_points / ingest_s / 1e6, 3),
+        "tsdb_ingest_mpoints_per_s_py": round(
+            n_points / ingest_py_s / 1e6, 3
+        ),
         "tsdb_ingest_frames_per_s": round(n_frames / ingest_s, 1),
         "tsdb_compression_ratio": round(ratio, 1),
         "tsdb_compressed_bytes": stats["compressed_bytes"],
@@ -764,9 +832,12 @@ def bench_federation(
     from tpudash.federation.client import SummaryResult
     from tpudash.federation.source import ChildSpec, FederatedSource
 
+    from tpudash.app import wire
+
     child = _bench_service(chips_per_child)
     child.render_frame()
     blob = _dumps(child.summary_doc())
+    bin_blob = wire.encode_summary(child.summary_doc(binary=True))
 
     class _ReplayClient:
         def __init__(self):
@@ -775,6 +846,21 @@ def bench_federation(
         def fetch(self, etag, timeout):
             self.v += 1
             return SummaryResult(doc=_json.loads(blob), etag=f"e{self.v}")
+
+    class _ReplayClientBin:
+        """The binary summary path a real HttpSummaryClient negotiates:
+        each poll pays the TDB1 decode (one frombuffer for the matrix)
+        instead of the JSON cell parse — the fan-in term ISSUE 10's
+        federation ride-along shaves."""
+
+        def __init__(self):
+            self.v = 0
+
+        def fetch(self, etag, timeout):
+            self.v += 1
+            return SummaryResult(
+                doc=wire.decode_summary(bin_blob), etag=f"e{self.v}"
+            )
 
     out = {}
     for n in child_counts:
@@ -800,6 +886,29 @@ def bench_federation(
             f"federated fan-in at {n} children blew the budget: {p50:.2f}s"
         )
         out[f"federation_fanin_{n}_p50_ms"] = round(p50 * 1e3, 2)
+    # the binary summary fan-in at the widest shape (16 × 256 = the
+    # 4,096-chip wall): same parent pipeline, TDB1 decode per child
+    n = max(child_counts)
+    specs = [ChildSpec(f"b{i}", f"http://b{i}") for i in range(n)]
+    cfg = Config(
+        federate=",".join(f"{s.name}={s.url}" for s in specs),
+        federate_hedge=0.0,
+        refresh_interval=0.0,
+    )
+    src = FederatedSource(
+        cfg, children=[(s, _ReplayClientBin()) for s in specs]
+    )
+    svc = DashboardService(cfg, src)
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    svc.timer.history.clear()
+    for _ in range(frames):
+        frame = svc.render_frame()
+        assert frame["error"] is None
+        assert len(frame["selected"]) == n * chips_per_child
+    out[f"federation_fanin_{n}_bin_p50_ms"] = round(
+        svc.timer.percentile(0.5) * 1e3, 2
+    )
     return out
 
 
@@ -911,6 +1020,31 @@ def find_regressions(
         "lower",
         0.50,
     )
+    # the native-columnar wire tier (ISSUE 10): binary delta size is
+    # deterministic (10% band — growth means the quantized encoding
+    # degraded); the seal-side encode cost and the native ingest rate
+    # are time-domain on a noisy host, so 2x swings flag
+    check(
+        "scale_4096_binary_delta_bytes",
+        result.get("scale_4096_binary_delta_bytes"),
+        prev.get("scale_4096_binary_delta_bytes"),
+        "higher",
+        0.10,
+    )
+    check(
+        "scale_4096_bin_seal_ms",
+        result.get("scale_4096_bin_seal_ms"),
+        prev.get("scale_4096_bin_seal_ms"),
+        "higher",
+        1.0,
+    )
+    check(
+        "tsdb_ingest_mpoints_per_s",
+        result.get("tsdb_ingest_mpoints_per_s"),
+        prev.get("tsdb_ingest_mpoints_per_s"),
+        "lower",
+        0.50,
+    )
     check(
         "tsdb_range_p50_ms",
         result.get("tsdb_range_p50_ms"),
@@ -985,7 +1119,21 @@ def main() -> None:
     torus3d = bench_3d_torus()
     links = bench_link_detail()
     scale1k = bench_scale(1024)
-    scale4k = bench_scale(4096)
+    try:
+        scale4k = bench_scale(
+            4096,
+            p50_budget_ms=SCALE_4096_P50_BUDGET_MS,
+            binary_floor_bytes=R05_JSON_DELTA_BYTES // 3,
+        )
+    except AssertionError:
+        # the 20ms gate is a hard bar, but one scheduler burst on a
+        # shared host must not cost the whole bench record — a single
+        # retry re-measures; a genuine regression fails both runs
+        scale4k = bench_scale(
+            4096,
+            p50_budget_ms=SCALE_4096_P50_BUDGET_MS,
+            binary_floor_bytes=R05_JSON_DELTA_BYTES // 3,
+        )
     sse_subs = bench_sse_subscribers()
     shed = bench_shed_latency()
     tsdb = bench_tsdb()
@@ -1011,9 +1159,12 @@ def main() -> None:
         "link_detail_256_p50_ms": round(links["p50_s"] * 1e3, 2),
         "scale_1024_p50_ms": round(scale1k["p50_s"] * 1e3, 2),
         "scale_1024_sse_delta_bytes": scale1k["sse_delta_bytes"],
+        "scale_1024_binary_delta_bytes": scale1k["binary_delta_bytes"],
         "scale_1024_rss_mb": scale1k["rss_mb"],
         "scale_4096_p50_ms": round(scale4k["p50_s"] * 1e3, 2),
         "scale_4096_sse_delta_bytes": scale4k["sse_delta_bytes"],
+        "scale_4096_binary_delta_bytes": scale4k["binary_delta_bytes"],
+        "scale_4096_bin_seal_ms": scale4k["bin_seal_ms"],
         "scale_4096_rss_mb": scale4k["rss_mb"],
         "scale_4096_rss_growth_mb": scale4k["rss_growth_mb"],
         **sse_subs,

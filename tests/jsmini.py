@@ -12,8 +12,10 @@ Supported grammar (everything transpile_functions can emit):
   function NAME(params) { ... }      let a, b;          x = expr;
   for (i = 0; i < e; i++) { }        for (x of expr) { }
   if (cond) { } else { }             delete a[b];       return expr;
-  calls, [..] , {..}, ===, !==, <, <=, >, >=, &&, ||, !, + - * /,
-  member access a[b], a.length, string/number/bool/null literals
+  while (cond) { }                   break;
+  calls, [..] , {..}, ===, !==, <, <=, >, >=, &&, ||, !, + - * / %,
+  Math.floor(x), member access a[b], a.length, string/number/bool/
+  null literals (incl. exponent forms like 1e+308)
 """
 
 from __future__ import annotations
@@ -28,10 +30,10 @@ class JsError(Exception):
 _TOKEN = re.compile(
     r"""
     (?P<ws>\s+|//[^\n]*)
-  | (?P<num>\d+\.\d+|\d+)
+  | (?P<num>(?:\d+\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<str>"(?:[^"\\]|\\.)*")
   | (?P<name>[A-Za-z_$][A-Za-z0-9_$]*)
-  | (?P<punct>===|!==|==|!=|<=|>=|&&|\|\||\+\+|[{}()\[\];:,=<>!+\-*/.])
+  | (?P<punct>===|!==|==|!=|<=|>=|&&|\|\||\+\+|[{}()\[\];:,=<>!+\-*/.%])
     """,
     re.VERBOSE,
 )
@@ -131,6 +133,16 @@ class Parser:
                 self.next()
                 orelse = self.block()
             return ("if", cond, body, orelse)
+        if text == "while":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            return ("while", cond, self.block())
+        if text == "break":
+            self.next()
+            self.expect(";")
+            return ("break",)
         if text == "for":
             self.next()
             self.expect("(")
@@ -212,7 +224,7 @@ class Parser:
 
     def mul(self):
         left = self.unary()
-        while self.peek()[1] in ("*", "/"):
+        while self.peek()[1] in ("*", "/", "%"):
             op = self.next()[1]
             left = ("bin", op, left, self.unary())
         return left
@@ -254,7 +266,8 @@ class Parser:
     def primary(self):
         kind, text = self.next()
         if kind == "num":
-            return ("lit", float(text) if "." in text else int(text))
+            is_float = "." in text or "e" in text or "E" in text
+            return ("lit", float(text) if is_float else int(text))
         if kind == "str":
             import json
 
@@ -301,6 +314,10 @@ class Parser:
 class _Return(Exception):
     def __init__(self, value):
         self.value = value
+
+
+class _Break(Exception):
+    pass
 
 
 #: distinct sentinel: JS `undefined` (missing key) vs JSON null
@@ -364,8 +381,20 @@ class Interp:
             for init_var, init_expr in inits:
                 scope[init_var] = self.eval(init_expr, scope)
             while self.truthy(self.eval(cond, scope)):
-                self.run_block(body, scope)
+                try:
+                    self.run_block(body, scope)
+                except _Break:
+                    break
                 scope[var] = scope[var] + 1
+        elif op == "while":
+            _, cond, body = s
+            while self.truthy(self.eval(cond, scope)):
+                try:
+                    self.run_block(body, scope)
+                except _Break:
+                    break
+        elif op == "break":
+            raise _Break()
         elif op == "forof":
             _, var, it, body = s
             seq = self.eval(it, scope)
@@ -373,7 +402,10 @@ class Interp:
                 raise JsError("for-of over non-array")
             for v in seq:
                 scope[var] = v
-                self.run_block(body, scope)
+                try:
+                    self.run_block(body, scope)
+                except _Break:
+                    break
         elif op == "exprstmt":
             self.eval(s[1], scope)
         elif op == "nop":
@@ -444,6 +476,19 @@ class Interp:
                     )
                 numeric = sorted((k for k in obj if _idx(k)), key=int)
                 return numeric + [k for k in obj if not _idx(k)]
+            # Math.floor — what the transpiler emits for Python `//`
+            if e[1] == ("member", ("name", "Math"), "floor"):
+                import math
+
+                (arg,) = e[2]
+                v = self.eval(arg, scope)
+                if not isinstance(v, (int, float)):
+                    raise JsError("Math.floor on non-number")
+                if isinstance(v, float) and (v != v or v in (
+                    float("inf"), float("-inf")
+                )):
+                    return v  # JS Math.floor passes NaN/±Infinity through
+                return math.floor(v)
             # Object.prototype.hasOwnProperty.call(obj, k) — the OWN-
             # membership test the transpiler emits for Python `in`
             if e[1] == (
@@ -510,6 +555,21 @@ class Interp:
         if op == "bin":
             _, bop, left_e, right_e = e
             left, right = self.eval(left_e, scope), self.eval(right_e, scope)
+            if bop == "%":
+                # JS %: sign of the dividend (C fmod), unlike Python's %
+                import math
+
+                return math.fmod(left, right)
+            if (
+                bop == "/"
+                and isinstance(right, (int, float))
+                and not isinstance(right, bool)
+                and right == 0
+            ):
+                # JS division by zero yields ±Infinity / NaN, not a throw
+                if left == 0:
+                    return float("nan")
+                return float("inf") if left > 0 else float("-inf")
             return {
                 "+": lambda: left + right,
                 "-": lambda: left - right,

@@ -743,3 +743,161 @@ def test_embedded_nul_in_value_string_skipped_like_python():
         if v == v
     ]
     assert got == [(1, 2.5)]
+
+
+# --- ISSUE 10: gorilla encode, changed-rows, qv block, split parse ----------
+
+
+def test_gorilla_native_python_differential_fuzz():
+    """The native Gorilla encoders must emit the EXACT bytes the pure-
+    Python codec emits — the tsdb's on-disk format cannot depend on
+    which tier encoded it."""
+    import random
+    import struct
+
+    from tpudash.tsdb import gorilla
+
+    rng = random.Random(20260804)
+    for _ in range(120):
+        n = rng.randrange(0, 60)
+        ts = [
+            rng.randrange(-(2**63), 2**63 - 1)
+            if rng.random() < 0.08
+            else 1_000_000 + 5000 * i + rng.randrange(-4, 5)
+            for i in range(n)
+        ]
+        assert native.gorilla_encode_timestamps(ts) == (
+            gorilla.encode_timestamps_py(ts)
+        )
+        assert gorilla.decode_timestamps(
+            gorilla.encode_timestamps(ts), n
+        ) == [int(t) for t in ts]
+        vals = []
+        for _i in range(n):
+            r = rng.random()
+            if r < 0.1:
+                vals.append(float("nan"))
+            elif r < 0.15:
+                vals.append(rng.choice([float("inf"), float("-inf"), -0.0]))
+            elif r < 0.3:
+                vals.append(
+                    struct.unpack(
+                        "<d", struct.pack("<Q", rng.randrange(2**64))
+                    )[0]
+                )
+            else:
+                vals.append(round(rng.uniform(0, 100), 1))
+        assert native.gorilla_encode_values(vals) == (
+            gorilla.encode_values_py(vals)
+        )
+        dec = gorilla.decode_values(gorilla.encode_values(vals), n)
+        assert all(
+            struct.pack("<d", a) == struct.pack("<d", float(b))
+            for a, b in zip(dec, vals)
+        )
+
+
+def test_changed_rows_bit_semantics():
+    prev = np.random.rand(12, 5)
+    cur = prev.copy()
+    cur[2, 3] = 7.0
+    prev[5, 0] = float("nan")
+    cur[5, 0] = float("nan")  # NaN == NaN bitwise → unchanged
+    cur[8, 1] = -0.0 if prev[8, 1] == 0.0 else cur[8, 1]
+    cur[9, :] = prev[9, :]
+    mask = native.changed_rows(prev, cur)
+    assert mask[2] == 1 and mask[5] == 0 and mask[9] == 0
+    assert mask.sum() == int(
+        sum(
+            1
+            for r in range(12)
+            if prev[r].tobytes() != cur[r].tobytes()
+        )
+    )
+
+
+def test_split_parse_parity_on_large_payload():
+    """Payloads above the split threshold parse as concurrent validated
+    segments — the result must stay bit-identical to the Python parser
+    (and to itself across repeat parses, when the memo is fully warm)."""
+    payload = json.dumps(
+        synthetic_payload(num_chips=64, t=1000.0, num_slices=24)
+    ).encode()
+    assert len(payload) > (1 << 20), "payload must cross the split threshold"
+    from tpudash.schema import SampleBatch
+
+    for _ in range(3):  # cold, warming, fully-warm memo paths
+        batch = native.parse_promjson(payload)
+        samples = parse_instant_query(json.loads(payload))
+        ref = SampleBatch.from_samples(samples)._sorted()
+        assert batch.metrics == ref.metrics
+        assert batch.slices == ref.slices
+        assert batch.hosts == ref.hosts
+        assert batch.accels == ref.accels
+        assert np.array_equal(batch.chip_ids, ref.chip_ids)
+        assert np.array_equal(
+            np.isnan(batch.matrix), np.isnan(ref.matrix)
+        )
+        m = ~np.isnan(batch.matrix)
+        assert (batch.matrix[m] == ref.matrix[m]).all()
+        assert batch._n_samples == len(samples)
+
+
+def test_parse_memo_warms_and_reports():
+    payload = json.dumps(synthetic_payload(num_chips=16, t=1.0)).encode()
+    before = native.parse_memo_stats()
+    native.parse_promjson(payload)
+    native.parse_promjson(payload)
+    after = native.parse_memo_stats()
+    assert after["entries"] >= 1
+    assert after["hits"] > before["hits"], (
+        "repeat parses of a stable population must hit the label memo"
+    )
+
+
+def test_status_reports_available_with_memo():
+    st = native.status()
+    assert st["available"] is True
+    assert "parse_memo" in st and "reason" not in st
+
+
+def test_status_fail_soft_reason(monkeypatch):
+    """A disabled/failed native tier reports WHY on status() — the
+    /api/timings `native` block serves exactly this dict."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    monkeypatch.setattr(native, "_reason", "dlopen failed: boom")
+    st = native.status()
+    assert st == {"available": False, "reason": "dlopen failed: boom"}
+
+
+def test_loader_rebuilds_on_stale_library(monkeypatch):
+    """The satellite contract, exercised through load() itself: a .so
+    older than frame_kernel.cc must trigger a rebuild attempt, and a
+    FAILED rebuild must fail soft with the reason on status() — never
+    load the stale library."""
+    import os
+
+    so = native._LIB
+    assert os.path.exists(so) and os.path.exists(native._SRC)
+    old = os.path.getmtime(so)
+    calls = []
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_reason", "not loaded yet")
+    monkeypatch.setattr(
+        native, "_build", lambda: calls.append(1) is None and False
+    )
+    os.utime(so, (old - 10_000, old - 10_000))  # .so older than source
+    try:
+        assert native.load() is None, "a stale library must never load"
+        assert calls, "load() must attempt a rebuild on staleness"
+        assert "build failed" in native.status()["reason"]
+    finally:
+        os.utime(so, (old, old))
+    # fresh again: load() must come back WITHOUT another build attempt
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    calls.clear()
+    assert native.load() is not None
+    assert not calls, "an up-to-date library must load without rebuilding"

@@ -1,0 +1,636 @@
+"""TDB1 binary wire format (tpudash/app/wire.py + the transpiled
+decoder in clientlogic): codec fuzz, native/Python differential pins,
+jsmini execution of the generated JS decoder, and the negotiated
+transport end to end."""
+
+import asyncio
+import copy
+import json
+import math
+import random
+import struct
+import sys
+import zlib
+
+import pytest
+
+from tpudash.app import clientlogic, wire
+from tpudash.app.delta import apply_delta, frame_delta
+from tpudash.app.server import DashboardServer
+from tpudash.app.service import DashboardService
+from tpudash.config import Config
+from tpudash.sources.fixture import JsonReplaySource
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from jsmini import run_js  # noqa: E402
+
+
+def _jr(x):
+    return json.loads(json.dumps(x))
+
+
+def _service(chips=8, slices=2, frames=6):
+    cfg = Config(
+        source="synthetic", synthetic_chips=chips, synthetic_slices=slices,
+        refresh_interval=0.0, history_points=8,
+    )
+    return DashboardService(
+        cfg,
+        JsonReplaySource.synthetic(chips, frames=frames, num_slices=slices),
+    )
+
+
+def _frame_pair(svc):
+    frames = [_jr(svc.render_frame()) for _ in range(3)]
+    return frames[-2], frames[-1]
+
+
+def _bits(v):
+    return struct.pack("<d", v)
+
+
+# --- qv cell codec -----------------------------------------------------------
+
+
+def test_qv_special_values_bit_exact():
+    cases = [
+        0.0, -0.0, 1.5, -27.13, float("inf"), float("-inf"),
+        1e-310, 5e-324, -5e-324, 1.7976931348623157e308,
+        -1.7976931348623157e308, 2.2250738585072014e-308,
+        3.141592653589793, 8086.99, 2.0 ** 53,
+    ]
+    out = bytearray()
+    for v in cases:
+        wire._qv(out, v, 0)
+    pos = [0]
+    for v in cases:
+        got = clientlogic.qv_read(bytes(out), pos, 0)
+        assert _bits(got) == _bits(v), f"{v!r} decoded as {got!r}"
+
+
+def test_qv_nan_and_null():
+    out = bytearray()
+    wire._qv(out, float("nan"), 0)
+    wire._qv(out, None, 0)
+    pos = [0]
+    assert math.isnan(clientlogic.qv_read(bytes(out), pos, 0))
+    assert clientlogic.qv_read(bytes(out), pos, 0) is None
+
+
+def test_qv_fuzz_lossless_and_base_invariant():
+    rng = random.Random(20260804)
+    vals, bases = [], []
+    out = bytearray()
+    for _ in range(4000):
+        r = rng.random()
+        if r < 0.55:
+            v = round(rng.uniform(-300, 300), 2)
+        elif r < 0.75:
+            v = round(rng.uniform(-1e11, 1e11), 2)
+        elif r < 0.85:
+            v = rng.uniform(-1, 1)  # sub-centi precision → escapes
+        elif r < 0.9:
+            v = None
+        else:
+            v = struct.unpack("<d", struct.pack("<Q", rng.randrange(2**64)))[0]
+        base = clientlogic.qd_base(
+            round(rng.uniform(-300, 300), 2) if rng.random() < 0.7 else None
+        )
+        vals.append(v)
+        bases.append(base)
+        wire._qv(out, v, int(base))
+    pos = [0]
+    for v, base in zip(vals, bases):
+        got = clientlogic.qv_read(bytes(out), pos, base)
+        if v is None:
+            assert got is None
+        elif isinstance(v, float) and math.isnan(v):
+            assert math.isnan(got)
+        else:
+            assert _bits(got) == _bits(float(v))
+    assert pos[0] == len(out)
+
+
+def test_native_qv_block_byte_identical_to_python():
+    native = pytest.importorskip("tpudash.native")
+    if not native.is_available():
+        pytest.skip("native tier unavailable")
+    import numpy as np
+
+    rng = random.Random(7)
+    vals, prevs = [], []
+    for _ in range(6000):
+        r = rng.random()
+        if r < 0.6:
+            vals.append(round(rng.uniform(-500, 500), 2))
+        elif r < 0.75:
+            vals.append(rng.uniform(-1, 1))
+        elif r < 0.85:
+            vals.append(
+                rng.choice(
+                    [float("nan"), float("inf"), float("-inf"), -0.0, 0.0]
+                )
+            )
+        else:
+            vals.append(
+                struct.unpack("<d", struct.pack("<Q", rng.randrange(2**64)))[0]
+            )
+        prevs.append(
+            rng.choice(
+                [float("nan"), round(rng.uniform(-500, 500), 2), 0.0,
+                 rng.uniform(-1, 1)]
+            )
+        )
+    nat = native.qv_encode_block(np.array(vals), np.array(prevs))
+    py = bytearray()
+    for v, p in zip(vals, prevs):
+        wire._qv(py, v, wire._cell_base(p))
+    assert nat == bytes(py)
+
+
+# --- delta container ---------------------------------------------------------
+
+
+def test_binary_delta_roundtrip_equals_frame_delta():
+    svc = _service()
+    prev, cur = _frame_pair(svc)
+    delta = frame_delta(prev, cur)
+    assert delta is not None
+    buf = wire.encode_delta(prev, delta)
+    assert buf[:4] == wire.MAGIC
+    decoded = wire.decode_delta(buf, prev)
+    assert decoded == delta
+    # and the merge itself reproduces the composed frame
+    assert apply_delta(prev, decoded) == apply_delta(prev, delta)
+
+
+def test_empty_delta_encodes_none():
+    svc = _service()
+    prev, _ = _frame_pair(svc)
+    assert frame_delta(None, prev) is None
+    assert wire.encode_delta(None, None) is None
+    assert wire.binary_delta_roundtrip_equal(prev, prev)
+
+
+def test_chip_churn_is_structural():
+    """Population change mid-stream → frame_delta None → no binary delta
+    (the subscriber takes a full frame), exactly the JSON contract."""
+    small = _service(chips=4, slices=1)
+    big = _service(chips=8, slices=1)
+    f_small = _jr(small.render_frame())
+    f_big = _jr(big.render_frame())
+    assert frame_delta(f_small, f_big) is None
+    assert wire.encode_delta(f_small, frame_delta(f_small, f_big)) is None
+
+
+def test_delta_chain_decodes_against_evolving_prev():
+    """Multi-tick chain: each decode uses the client's CURRENT frame, and
+    the reconstruction stays byte-exact across the whole chain."""
+    svc = _service(chips=6, slices=2, frames=8)
+    client = None
+    for _ in range(6):
+        cur = _jr(svc.render_frame())
+        delta = frame_delta(client, cur)
+        if delta is None:
+            client = cur
+            continue
+        buf = wire.encode_delta(client, delta)
+        client = apply_delta(client, wire.decode_delta(buf, client))
+        assert json.dumps(client, sort_keys=True) == json.dumps(
+            cur, sort_keys=True
+        )
+
+
+def test_unchanged_heatmaps_are_masked_out():
+    cfg = Config(
+        source="synthetic", synthetic_chips=8, synthetic_slices=2,
+        refresh_interval=0.0, history_points=8, per_chip_panel_limit=1,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(8, frames=6, num_slices=2)
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    prev, cur = _frame_pair(svc)
+    assert cur.get("heatmaps"), "select-all past the panel limit → heatmaps"
+    cur2 = copy.deepcopy(cur)
+    cur2["heatmaps"][0]["figure"]["data"][0]["z"] = copy.deepcopy(
+        prev["heatmaps"][0]["figure"]["data"][0]["z"]
+    )
+    delta = frame_delta(prev, cur2)
+    assert delta is not None
+    buf = wire.encode_delta(prev, delta)
+    _, head, _ = wire.split_container(buf)
+    assert head["_b"]["hm"]["changed"][0] == 0
+    assert wire.decode_delta(buf, prev) == delta
+
+
+def test_decode_rejects_garbage_and_skew():
+    with pytest.raises(wire.WireError):
+        wire.split_container(b"not a container at all")
+    svc = _service()
+    prev, cur = _frame_pair(svc)
+    buf = bytearray(wire.encode_delta(prev, frame_delta(prev, cur)))
+    buf[4] = 99  # future version
+    with pytest.raises(wire.WireError):
+        wire.split_container(bytes(buf))
+
+
+# --- full-frame container ----------------------------------------------------
+
+
+def test_full_frame_roundtrip():
+    svc = _service(chips=8, slices=2)
+    frame = _jr(svc.render_frame())
+    buf = wire.encode_frame(frame)
+    assert wire.decode_frame(buf) == frame
+
+
+# --- generated-JS decoder parity (jsmini executes the shipped JS) -----------
+
+
+def test_jsmini_decodes_binary_delta_identically():
+    from tpudash.app.pyjs import transpile_functions
+
+    interp = run_js(transpile_functions(clientlogic.CLIENT_FUNCTIONS))
+    svc = _service(chips=6, slices=2)
+    prev, cur = _frame_pair(svc)
+    delta = frame_delta(prev, cur)
+    buf = wire.encode_delta(prev, delta)
+    _, head, payload = wire.split_container(buf)
+    got = interp.call(
+        "decode_bin_sections",
+        copy.deepcopy(head),
+        list(payload),
+        copy.deepcopy(prev),
+    )
+    ref = clientlogic.decode_bin_sections(head, payload, prev)
+    assert got == ref == delta
+
+
+def test_jsmini_ieee_reconstruction_matches_python():
+    from tpudash.app.pyjs import transpile_functions
+
+    interp = run_js(transpile_functions(clientlogic.CLIENT_FUNCTIONS))
+    rng = random.Random(5)
+    raw = [
+        struct.unpack("<d", struct.pack("<Q", rng.randrange(2**64)))[0]
+        for _ in range(200)
+    ] + [0.0, -0.0, 5e-324, -5e-324, float("inf"), float("-inf")]
+    for v in raw:
+        buf = list(struct.pack("<d", v))
+        a = clientlogic.ieee_read(buf, [0])
+        b = interp.call("ieee_read", list(buf), [0])
+        if math.isnan(v):
+            assert math.isnan(a) and math.isnan(b)
+        else:
+            assert _bits(a) == _bits(b) == _bits(v)
+
+
+# --- summary container -------------------------------------------------------
+
+
+def test_summary_binary_roundtrip_feeds_batch():
+    import numpy as np
+
+    from tpudash.federation.summary import summary_to_batch
+
+    svc = _service(chips=8, slices=2)
+    svc.render_frame()
+    doc_json = svc.summary_doc()
+    buf = wire.encode_summary(svc.summary_doc(binary=True))
+    doc_bin = wire.decode_summary(buf)
+    assert doc_bin["keys"] == doc_json["keys"]
+    b1 = summary_to_batch("c", doc_json)
+    b2 = summary_to_batch("c", doc_bin)
+    assert b1.slices == b2.slices and b1.hosts == b2.hosts
+    assert np.array_equal(
+        np.isnan(b1.matrix), np.isnan(b2.matrix)
+    )
+    m = ~np.isnan(b1.matrix)
+    assert (b1.matrix[m] == b2.matrix[m]).all()
+
+
+def test_summary_binary_tableless_marker():
+    doc = {"v": 1, "ts": 0.0, "alerts": []}
+    assert "keys" not in wire.decode_summary(wire.encode_summary(doc))
+
+
+# --- stream framing + negotiated transport ----------------------------------
+
+
+def test_bin_event_split_roundtrip():
+    evts = [
+        wire.bin_event(wire.EVT_FULL, "123-4", b'{"kind":"full"}'),
+        wire.bin_event(wire.EVT_DELTA, "123-5", b"\x01\x02\x03"),
+        wire.bin_event(wire.EVT_KEEPALIVE, "", b""),
+    ]
+    blob = b"".join(evts)
+    # whole + every partial prefix parses cleanly
+    out, rest = wire.split_bin_events(blob)
+    assert rest == b""
+    assert [(t, i) for t, i, _ in out] == [
+        (wire.EVT_FULL, "123-4"),
+        (wire.EVT_DELTA, "123-5"),
+        (wire.EVT_KEEPALIVE, ""),
+    ]
+    for cut in range(len(blob)):
+        got, rest = wire.split_bin_events(blob[:cut])
+        assert b"".join(
+            wire.bin_event(t, i, bytes(b)) for t, i, b in got
+        ) + bytes(rest) == blob[:cut]
+
+
+def _server(chips=8, **cfg_kw):
+    cfg = Config(
+        source="synthetic", synthetic_chips=chips, refresh_interval=0.25,
+        history_points=8, **cfg_kw,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(chips, frames=6)
+    )
+    return DashboardServer(svc)
+
+
+def test_binary_stream_end_to_end():
+    from aiohttp import ClientSession, ClientTimeout
+    from aiohttp.test_utils import TestServer
+
+    server = _server()
+
+    async def run():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            async with ClientSession(
+                timeout=ClientTimeout(total=30), auto_decompress=False
+            ) as s:
+                async with s.get(
+                    ts.make_url("/api/stream"),
+                    params={"format": "bin"},
+                    headers={"Accept-Encoding": "gzip"},
+                ) as r:
+                    assert r.status == 200
+                    assert (
+                        r.headers["Content-Type"]
+                        == wire.STREAM_CONTENT_TYPE
+                    )
+                    d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+                    buf = b""
+                    last = None
+                    deltas = 0
+                    last_id = None
+                    async for chunk in r.content.iter_any():
+                        buf += d.decompress(chunk)
+                        evts, buf = wire.split_bin_events(buf)
+                        for etype, eid, body in evts:
+                            if eid:
+                                last_id = eid
+                            if etype == wire.EVT_FULL:
+                                last = json.loads(body)
+                                assert last["kind"] == "full"
+                            elif etype == wire.EVT_DELTA:
+                                delta = wire.decode_delta(bytes(body), last)
+                                last = apply_delta(last, delta)
+                                deltas += 1
+                        if deltas >= 2:
+                            break
+                    assert last is not None and last.get("error") is None
+                # resume from the acked id: first event is a DELTA (the
+                # seal window covers the gap), not a full frame
+                async with s.get(
+                    ts.make_url("/api/stream"),
+                    params={"format": "bin", "last_id": last_id},
+                    headers={"Accept-Encoding": "identity"},
+                ) as r:
+                    buf = b""
+                    got = None
+                    async for chunk in r.content.iter_any():
+                        buf += chunk
+                        evts, buf = wire.split_bin_events(buf)
+                        real = [
+                            e for e in evts if e[0] != wire.EVT_KEEPALIVE
+                        ]
+                        if real:
+                            got = real[0]
+                            break
+                    assert got is not None and got[0] == wire.EVT_DELTA
+        finally:
+            await ts.close()
+
+    asyncio.run(run())
+
+
+def test_binary_stream_refused_when_json_pinned():
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    server = _server(wire_format="json")
+
+    async def run():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            async with ClientSession() as s:
+                async with s.get(
+                    ts.make_url("/api/stream"), params={"format": "bin"}
+                ) as r:
+                    assert r.status == 406
+                # frame negotiation silently falls back to JSON
+                async with s.get(
+                    ts.make_url("/api/frame"),
+                    headers={"Accept": wire.CONTENT_TYPE},
+                ) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "application/json"
+                    )
+        finally:
+            await ts.close()
+
+    asyncio.run(run())
+
+
+def test_frame_and_summary_binary_negotiation():
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    server = _server()
+
+    async def run():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            async with ClientSession() as s:
+                hdrs = {
+                    "Accept": wire.CONTENT_TYPE,
+                    "Accept-Encoding": "identity",
+                }
+                async with s.get(
+                    ts.make_url("/api/frame"), headers=hdrs
+                ) as r:
+                    assert r.headers["Content-Type"] == wire.CONTENT_TYPE
+                    frame = wire.decode_frame(await r.read())
+                    etag = r.headers["ETag"]
+                assert frame["error"] is None and frame["chips"]
+                async with s.get(
+                    ts.make_url("/api/frame"),
+                    headers=dict(hdrs, **{"If-None-Match": etag}),
+                ) as r:
+                    assert r.status == 304
+                async with s.get(
+                    ts.make_url("/api/summary"), headers=hdrs
+                ) as r:
+                    assert r.headers["Content-Type"].startswith(
+                        wire.CONTENT_TYPE
+                    )
+                    doc = wire.decode_summary(await r.read())
+                    setag = r.headers["ETag"]
+                assert doc["chips"] == len(frame["chips"])
+                async with s.get(
+                    ts.make_url("/api/summary"),
+                    headers=dict(hdrs, **{"If-None-Match": setag}),
+                ) as r:
+                    assert r.status == 304
+                # default requests stay JSON
+                async with s.get(ts.make_url("/api/frame")) as r:
+                    assert r.headers["Content-Type"].startswith(
+                        "application/json"
+                    )
+        finally:
+            await ts.close()
+
+    asyncio.run(run())
+
+
+def test_seal_carries_binary_encodings():
+    """The hub builds binary encodings into every seal (compose-once),
+    and they survive the bus seal codec."""
+    from tpudash.broadcast import bus
+    from tpudash.broadcast.cohort import CohortHub
+    from tpudash.app.state import SelectionState
+
+    svc = _service(chips=6)
+    for _ in range(3):  # trends need ≥2 ring points; warm the structure
+        svc.render_frame()
+    hub = CohortHub(svc.compose_frame, lambda o: json.dumps(o), binary=True)
+    state = SelectionState()
+    state.sync(svc.available)
+    cohort = hub.resolve(state)
+
+    async def seal_two():
+        s1 = await hub.seal_cohort(cohort, (1,))
+        svc.render_frame()
+        s2 = await hub.seal_cohort(cohort, (2,))
+        return s1, s2
+
+    s1, s2 = asyncio.run(seal_two())
+    assert s1.bin_full_raw is not None and s1.bin_delta_raw is None
+    assert s2.bin_delta_raw is not None
+    evts, rest = wire.split_bin_events(s2.bin_delta_raw)
+    assert rest == b"" and evts[0][0] == wire.EVT_DELTA
+    assert evts[0][1] == s2.event_id
+    # bus round trip keeps all ten blobs
+    msg = bus.encode_seal(s2, 1)
+    header = json.loads(msg[4:].split(b"\n", 1)[0])
+    body = msg[4:].split(b"\n", 1)[1]
+    back = bus.decode_seal(header, body)
+    for name in (
+        "sse_full_raw", "sse_delta_raw", "frame_raw",
+        "bin_full_raw", "bin_full_gz", "bin_delta_raw", "bin_delta_gz",
+    ):
+        assert getattr(back, name) == getattr(s2, name)
+
+
+def test_hub_binary_disabled_builds_no_bin_encodings():
+    from tpudash.app.state import SelectionState
+    from tpudash.broadcast.cohort import CohortHub
+
+    svc = _service(chips=4)
+    svc.render_frame()
+    hub = CohortHub(svc.compose_frame, lambda o: json.dumps(o), binary=False)
+    state = SelectionState()
+    state.sync(svc.available)
+    cohort = hub.resolve(state)
+    seal = asyncio.run(hub.seal_cohort(cohort, (1,)))
+    assert seal.bin_full_raw is None and seal.bin_delta_raw is None
+
+
+def test_gapped_heatmap_nulls_survive_native_stream():
+    """None z-cells (torus gaps / partial selections) must encode as
+    null through BOTH encoder tiers — numpy would silently coerce None
+    to NaN, so the native bulk path is gated on an explicit scan (a
+    review finding: NaN-for-null broke round-trips on any gapped grid)."""
+    rows = 8
+    z_prev = [[round(10.0 + r + c, 2) for c in range(8)] for r in range(rows)]
+    z_cur = [[round(11.0 + r + c, 2) for c in range(8)] for r in range(rows)]
+    for r in range(rows):
+        z_prev[r][3] = None
+        z_cur[r][3] = None
+    z_cur[0][5] = None  # a chip that just went dark
+    vals = [v for zr in z_cur for v in zr]
+    bases = [v for zr in z_prev for v in zr]
+    out = bytearray()
+    wire._qv_stream(out, vals, bases)
+    pos = [0]
+    for v, b in zip(vals, bases):
+        got = clientlogic.qv_read(bytes(out), pos, clientlogic.qd_base(b))
+        assert got == v, (v, got)
+    assert pos[0] == len(out)
+
+
+def test_parse_memo_stats_aggregate_across_threads():
+    """/api/timings reads the memo stats from the event-loop thread,
+    which never parses — the export must aggregate every thread's
+    context (a review finding: it reported zeros in the server)."""
+    native = pytest.importorskip("tpudash.native")
+    if not native.is_available():
+        pytest.skip("native tier unavailable")
+    import threading
+
+    from tpudash.sources.fixture import synthetic_payload
+
+    payload = json.dumps(synthetic_payload(num_chips=8, t=5.0)).encode()
+    before = native.parse_memo_stats()
+
+    def work():
+        native.parse_promjson(payload)
+        native.parse_promjson(payload)
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+    after = native.parse_memo_stats()  # read from THIS thread
+    assert after["hits"] > before["hits"]
+
+
+def test_seal_survives_unencodable_breakdown():
+    """A frame shape the binary codec refuses (>52 breakdown value
+    columns) must cost only the BINARY encodings of the seal — JSON
+    subscribers keep streaming (a review finding: the WireError used to
+    propagate out of _build_seal and kill every subscriber's tick)."""
+    from tpudash.app.state import SelectionState
+    from tpudash.broadcast.cohort import CohortHub
+
+    wide_cols = {f"metric_{i}": 1.0 for i in range(60)}
+
+    def compose(state):
+        return {
+            "error": None,
+            "last_updated": "now",
+            "chips": [],
+            "selected": [],
+            "panel_specs": [],
+            "breakdown": {
+                "by_host": {"h0": dict(wide_cols, chips=1)},
+            },
+        }
+
+    hub = CohortHub(compose, lambda o: json.dumps(o), binary=True)
+    state = SelectionState()
+    cohort = hub.resolve(state)
+    s1 = asyncio.run(hub.seal_cohort(cohort, (1,)))
+    s2 = asyncio.run(hub.seal_cohort(cohort, (2,)))
+    # JSON encodings intact, binary slots empty — never an exception
+    assert s1.sse_full_raw and s2.sse_delta_raw
+    assert s2.bin_delta_raw is None and s2.bin_full_raw is None

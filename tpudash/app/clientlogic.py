@@ -684,6 +684,203 @@ def straggler_banner_model(stragglers):
     }
 
 
+# --- binary delta wire decode (TDB1) -----------------------------------------
+# The compact binary transport (tpudash/app/wire.py is the encoder and
+# the byte-layout reference).  These functions run in the SAME two
+# places as apply_delta: directly under the Python test suite, and
+# transpiled into the page.  They use the transpiler's extended-but-
+# still-value-safe subset (while/break, % and // on NON-NEGATIVE
+# operands) and receive bytes as an indexable array of 0..255 integers
+# (Python bytes and a JS Uint8Array both read that way).
+
+
+def rv_read(buf, pos):
+    """LEB128 varint at pos[0], advancing pos in place.  The encoder
+    keeps every varint below 2^53, so plain float arithmetic is exact
+    in both languages."""
+    v = 0
+    mult = 1
+    i = pos[0]
+    while True:
+        b = buf[i]
+        i = i + 1
+        v = v + (b % 128) * mult
+        if b < 128:
+            break
+        mult = mult * 128
+    pos[0] = i
+    return v
+
+
+def qd_base(p):
+    """Scaled-centi base of a previous cell: prev values that are exact
+    2-decimal numbers anchor the temporal delta; anything else (null,
+    NaN, ±inf, sub-centi precision, outside the exact-integer range)
+    anchors at 0.  The ENCODER uses this very function, so both ends
+    derive identical bases by construction."""
+    if p is None:
+        return 0
+    b = (p * 100 + 0.5) // 1
+    if b / 100 == p:
+        if b < 4503599627370496:
+            if b > -4503599627370496:
+                return b
+    return 0
+
+
+def ieee_read(buf, pos):
+    """IEEE-754 binary64 from 8 little-endian bytes, assembled with
+    exact float arithmetic (the subset has no DataView): every step is
+    a multiply/divide by a power of two or an exact integer sum, so the
+    reconstruction is bit-faithful for normals, subnormals and ±0.0;
+    any NaN payload decodes to the canonical quiet NaN (JS engines
+    canonicalize NaN bits anyway)."""
+    i = pos[0]
+    lo = buf[i] + buf[i + 1] * 256 + buf[i + 2] * 65536 + buf[i + 3] * 16777216
+    hi = (
+        buf[i + 4]
+        + buf[i + 5] * 256
+        + buf[i + 6] * 65536
+        + buf[i + 7] * 16777216
+    )
+    pos[0] = i + 8
+    sign = hi // 2147483648
+    e = (hi // 1048576) % 2048
+    m = (hi % 1048576) * 4294967296 + lo
+    v = 0
+    if e == 2047:
+        if m == 0:
+            v = 1e308 * 10
+        else:
+            v = 1e308 * 10 - 1e308 * 10
+    else:
+        if e == 0:
+            v = m / 4503599627370496.0 * 2.2250738585072014e-308
+        else:
+            v = 1 + m / 4503599627370496.0
+            k = e - 1023
+            while k > 0:
+                v = v * 2
+                k = k - 1
+            while k < 0:
+                v = v / 2
+                k = k + 1
+    if sign == 1:
+        v = -v
+    return v
+
+
+def qv_read(buf, pos, base100):
+    """One quantized cell: code 0 = null, 1 = raw float64 escape,
+    2/3 = ±Infinity, 4 = NaN, ≥5 = zigzag centi-delta against base100."""
+    n = rv_read(buf, pos)
+    if n == 0:
+        return None
+    if n == 1:
+        return ieee_read(buf, pos)
+    if n == 2:
+        return 1e308 * 10
+    if n == 3:
+        return -(1e308 * 10)
+    if n == 4:
+        return 1e308 * 10 - 1e308 * 10
+    d = n - 5
+    if d % 2 == 1:
+        d = -((d + 1) // 2)
+    else:
+        d = d // 2
+    return (base100 + d) / 100.0
+
+
+def decode_bin_sections(head, buf, prev):
+    """Reassemble a value-only delta from one TDB1 binary event: `head`
+    (parsed JSON) carries every scalar field verbatim plus the ``_b``
+    descriptor; ``buf`` carries heatmap z cells and breakdown values as
+    temporal-delta varints against ``prev`` — the client's current
+    frame, which both ends hold by the delta contract.  Returns the
+    same dict shape as the server's frame_delta, ready for
+    apply_delta."""
+    d = {}
+    hkeys = keys(head)
+    for i in range(len(hkeys)):
+        if hkeys[i] != "_b":
+            d[hkeys[i]] = head[hkeys[i]]
+    b = head["_b"]
+    pos = [0]
+    if "hm" in b:
+        shapes = b["hm"]["shapes"]
+        changed = b["hm"]["changed"]
+        zs = []
+        for i in range(len(shapes)):
+            prev_z = None
+            if "heatmaps" in prev:
+                if prev["heatmaps"] is not None:
+                    if i < len(prev["heatmaps"]):
+                        prev_z = prev["heatmaps"][i]["figure"]["data"][0]["z"]
+            if changed[i] == 0:
+                zs.append(prev_z)
+            else:
+                z = []
+                r = 0
+                while r < shapes[i][0]:
+                    prow = None
+                    if prev_z is not None:
+                        if r < len(prev_z):
+                            prow = prev_z[r]
+                    row = []
+                    c = 0
+                    while c < shapes[i][1]:
+                        pv = None
+                        if prow is not None:
+                            if c < len(prow):
+                                pv = prow[c]
+                        row.append(qv_read(buf, pos, qd_base(pv)))
+                        c = c + 1
+                    z.append(row)
+                    r = r + 1
+                zs.append(z)
+        d["heatmaps"] = zs
+    if "bd" in b:
+        bd = {}
+        dims = b["bd"]
+        for i in range(len(dims)):
+            dim = dims[i][0]
+            names = dims[i][1]
+            cols = dims[i][2]
+            pdim = None
+            if "breakdown" in prev:
+                if prev["breakdown"] is not None:
+                    if dim in prev["breakdown"]:
+                        pdim = prev["breakdown"][dim]
+            masks = []
+            for j in range(len(names)):
+                masks.append(rv_read(buf, pos))
+            counts = []
+            for j in range(len(names)):
+                counts.append(rv_read(buf, pos))
+            rows = {}
+            for j in range(len(names)):
+                prow = None
+                if pdim is not None:
+                    if names[j] in pdim:
+                        prow = pdim[names[j]]
+                row = {}
+                bit = 1
+                for k in range(len(cols)):
+                    if (masks[j] // bit) % 2 == 1:
+                        pv = None
+                        if prow is not None:
+                            if cols[k] in prow:
+                                pv = prow[cols[k]]
+                        row[cols[k]] = qv_read(buf, pos, qd_base(pv))
+                    bit = bit * 2
+                row["chips"] = counts[j]
+                rows[names[j]] = row
+            bd[dim] = rows
+        d["breakdown"] = bd
+    return d
+
+
 #: everything the page embeds, in dependency order
 CLIENT_FUNCTIONS = (
     patch_fig,
@@ -712,4 +909,9 @@ CLIENT_FUNCTIONS = (
     chip_grid_model,
     alert_banner_model,
     straggler_banner_model,
+    rv_read,
+    qd_base,
+    ieee_read,
+    qv_read,
+    decode_bin_sections,
 )

@@ -38,12 +38,18 @@ PLOTLY_LOCAL_TAG = (
 )
 
 
-def page_html(local_plotly: bool) -> str:
+def page_html(local_plotly: bool, wire_format: str = "auto") -> str:
     """The served page: swap the plotly script tag for the local-first
-    variant when the server has a vendored bundle to back it."""
+    variant when the server has a vendored bundle to back it, and tell
+    the transport layer whether the binary stream is worth attempting
+    (TPUDASH_WIRE_FORMAT=json servers refuse it with 406 anyway — the
+    flag just skips the doomed probe)."""
+    out = PAGE
     if local_plotly:
-        return PAGE.replace(PLOTLY_CDN_TAG, PLOTLY_LOCAL_TAG, 1)
-    return PAGE
+        out = out.replace(PLOTLY_CDN_TAG, PLOTLY_LOCAL_TAG, 1)
+    if wire_format == "json":
+        out = out.replace("window._binWire = true;", "window._binWire = false;", 1)
+    return out
 
 
 PAGE = r"""<!DOCTYPE html>
@@ -480,7 +486,83 @@ let lastFrame = null;
 
 /*__GENERATED_CLIENT__*/
 
+// ---- binary transport (TDB1, tpudash/app/wire.py) -------------------------
+// The steady-state delta stream in the compact binary encoding:
+// ~3-5x fewer wire bytes at fleet scale.  DECODING is the generated
+// decode_bin_sections above (single source with the server and the test
+// suite); this block is only framing glue — fetch-streaming, event
+// splitting, container parsing.  Any failure before the first event
+// falls back permanently to the JSON EventSource path below; failures
+// after that reconnect with ?last_id= resume.
+window._binWire = true;
+let binFailed = false;
+let binAckId = null;
+
+function startBinStream() {
+  let gotEvent = false;
+  const base = streamUrl('/api/stream');
+  const url = base + (base.indexOf('?') >= 0 ? '&' : '?') + 'format=bin' +
+    (binAckId ? '&last_id=' + encodeURIComponent(binAckId) : '');
+  (async () => {
+    const resp = await fetch(url, {headers: authHeaders()});
+    if (!resp.ok || !resp.body) throw new Error('HTTP ' + resp.status);
+    const reader = resp.body.getReader();
+    const td = new TextDecoder('utf-8');
+    let buf = new Uint8Array(0);
+    for (;;) {
+      const chunk = await reader.read();
+      if (chunk.done) throw new Error('stream ended');
+      if (buf.length === 0) { buf = chunk.value; }
+      else {
+        const nb = new Uint8Array(buf.length + chunk.value.length);
+        nb.set(buf); nb.set(chunk.value, buf.length); buf = nb;
+      }
+      for (;;) {
+        if (buf.length < 8) break;
+        if (buf[0] !== 84 || buf[1] !== 69) throw new Error('bad framing');
+        const etype = buf[2], idlen = buf[3];
+        if (buf.length < 8 + idlen) break;
+        const dv = new DataView(buf.buffer, buf.byteOffset);
+        const blen = dv.getUint32(4 + idlen, true);
+        if (buf.length < 8 + idlen + blen) break;
+        const id = td.decode(buf.subarray(4, 4 + idlen));
+        const body = buf.subarray(8 + idlen, 8 + idlen + blen);
+        buf = buf.slice(8 + idlen + blen);
+        gotEvent = true;
+        streaming = true;
+        if (timer) { clearInterval(timer); timer = null; }
+        if (id) binAckId = id;
+        if (etype === 1) {              // full frame, JSON body
+          lastFrame = JSON.parse(td.decode(body));
+        } else if (etype === 2) {       // binary delta (TDB1 container)
+          if (lastFrame === null) { refresh(); continue; }
+          if (body.length < 12 || td.decode(body.subarray(0, 4)) !== 'TDB1')
+            throw new Error('bad TDB1 container');
+          const bdv = new DataView(body.buffer, body.byteOffset);
+          const hlen = bdv.getUint32(8, true);
+          const head = JSON.parse(td.decode(body.subarray(12, 12 + hlen)));
+          const payload = body.subarray(16 + hlen);
+          const delta = decode_bin_sections(head, payload, lastFrame);
+          lastFrame = apply_delta(lastFrame, delta);
+        } else {
+          continue;                     // keepalive
+        }
+        if (!document.hidden) applyFrame(lastFrame);
+      }
+    }
+  })().catch(() => {
+    streaming = false;
+    if (!gotEvent) binFailed = true;    // binary refused/broken → JSON path
+    if (!timer) timer = setInterval(refresh, 5000);
+    setTimeout(startStream, binFailed ? 0 : 5000);
+  });
+}
+
 function startStream() {
+  if (window._binWire && !binFailed && window.fetch && window.TextDecoder) {
+    startBinStream();
+    return;
+  }
   if (!window.EventSource) return;  // old browser → polling stays active
   const es = new EventSource(streamUrl('/api/stream'));
   es.onmessage = e => {

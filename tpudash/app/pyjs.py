@@ -25,9 +25,13 @@ REJECTED, not translated:
 - equality uses ``===``; ``in`` maps to JS ``in`` and is restricted to
   dict-like operands by convention (arrays would test indices)
 - ``for x in expr`` → ``for (const x of expr)`` (arrays only);
-  ``for i in range(len(x))`` → a classic counted loop
-- integer division, string repetition, slicing, comprehensions,
-  try/except: unsupported, use explicit loops
+  ``for i in range(len(x))`` → a classic counted loop; ``while``/
+  ``break`` transpile directly
+- ``%`` and ``//`` are allowed for the binary-wire decoder but agree
+  between the languages only on NON-NEGATIVE operands (``//`` emits
+  ``Math.floor(a / b)``) — the decoder's only use
+- string repetition, slicing, comprehensions, try/except: unsupported,
+  use explicit loops
 """
 
 from __future__ import annotations
@@ -50,6 +54,12 @@ _CMP = {
     ast.GtE: ">=",
 }
 _BINOP = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/"}
+#: value-semantics caveats, enforced by convention in clientlogic (the
+#: binary-wire decoder is the only user): `%` matches JS only for
+#: NON-NEGATIVE operands (Python -1 % 3 == 2, JS -1 % 3 == -1), and
+#: `//` transpiles to Math.floor(a / b), which matches Python float
+#: floor-division — both are used exclusively on non-negative integers
+#: and floats inside the decoder.
 
 
 class _Fn:
@@ -154,6 +164,18 @@ class _Fn:
                 f"({self.expr(node.left)} {_BINOP[type(node.op)]} "
                 f"{self.expr(node.right)})"
             )
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            # % agrees between the languages only for non-negative
+            # operands — the binary-wire decoder's only use (see the
+            # module-note by _BINOP)
+            return f"({self.expr(node.left)} % {self.expr(node.right)})"
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.FloorDiv):
+            # Python float floor-division === Math.floor(a / b) for the
+            # finite operands the decoder feeds it
+            return (
+                f"Math.floor({self.expr(node.left)} / "
+                f"{self.expr(node.right)})"
+            )
         if isinstance(node, ast.Call):
             return self.call(node)
         raise TranspileError(f"unsupported expression {ast.dump(node)[:80]}")
@@ -247,6 +269,16 @@ class _Fn:
             out += [self.stmt(s, indent + "  ") for s in node.body]
             out.append(f"{indent}}}")
             return "\n".join(out)
+        if isinstance(node, ast.While):
+            if node.orelse:
+                raise TranspileError("while-else unsupported")
+            return "\n".join(
+                [f"{indent}while ({self._test(node.test)}) {{"]
+                + [self.stmt(s, indent + "  ") for s in node.body]
+                + [f"{indent}}}"]
+            )
+        if isinstance(node, ast.Break):
+            return f"{indent}break;"
         if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
             return f"{indent}{self.call(node.value)};"
         if isinstance(node, ast.Pass):

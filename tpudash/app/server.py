@@ -50,11 +50,12 @@ from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
 from tpudash.app.overload import OverloadGuard, bound_stream_buffers
 from tpudash.app.service import DashboardService
 from tpudash.app.sessions import SessionEntry, SessionStore
+from tpudash.app import wire
 from tpudash.broadcast.cohort import (
     GZIP_HEADER,
-    KEEPALIVE_GZ,
-    KEEPALIVE_RAW,
     CohortHub,
+    event_buffers,
+    keepalive_buffer,
     parse_event_id,
 )
 from tpudash.config import Config, load_config
@@ -149,6 +150,12 @@ def _build_summary_body(service: DashboardService) -> bytes:
     return _dumps(service.summary_doc()).encode()
 
 
+def _build_summary_body_bin(service: DashboardService) -> bytes:
+    """TDB1 summary encoding (Accept-negotiated): JSON head + the raw
+    float64 matrix block — executor-side like the JSON twin."""
+    return wire.encode_summary(service.summary_doc(binary=True))
+
+
 def _key_id(key: tuple) -> str:
     """Compose-cache key as an SSE event id ("dv-sv-stall")."""
     return "-".join(str(int(p)) for p in key)
@@ -230,6 +237,7 @@ class DashboardServer:
             window=service.cfg.broadcast_window,
             max_cohorts=service.cfg.broadcast_max_cohorts,
             on_evict=self._on_cohort_evict,
+            binary=service.cfg.wire_format != "json",
         )
         #: worker-tier stats provider (set by the broadcast supervisor);
         #: None → single-process mode, /api/workers reports just this one
@@ -248,6 +256,10 @@ class DashboardServer:
         #: ETag derives from the key so steady-state polls answer 304
         #: with no body and no executor work
         self._summary_cache: "tuple[tuple | None, bytes | None]" = (None, None)
+        self._summary_cache_bin: "tuple[tuple | None, bytes | None]" = (
+            None,
+            None,
+        )
         self._summary_build_lock = asyncio.Lock()
         #: lazy HTTP session for the federation child drill-down proxy
         #: (/api/child/...); None until the first proxied request, closed
@@ -259,7 +271,10 @@ class DashboardServer:
         if self._plotly_asset:
             log.info("serving vendored plotly from %s", self._plotly_asset)
         #: rendered once — asset presence is fixed for the process life
-        self._page = page_html(local_plotly=self._plotly_asset is not None)
+        self._page = page_html(
+            local_plotly=self._plotly_asset is not None,
+            wire_format=service.cfg.wire_format,
+        )
 
     async def _save_state(self) -> None:
         """Persist the composite checkpoint OFF the event loop — the
@@ -539,8 +554,17 @@ class DashboardServer:
         frame = await self._get_frame(
             entry=entry, deadline=request.get("tpudash_deadline")
         )
+        # binary negotiation (Accept: application/x-tpudash-bin): the
+        # TDB1 full-frame container — columnar chip table + quantized z
+        # grids — behind the very same ETag/304 revalidation.  JSON
+        # stays the default for every client that doesn't ask, and the
+        # knob (TPUDASH_WIRE_FORMAT=json) turns negotiation off.
+        binary = (
+            wire.CONTENT_TYPE in request.headers.get("Accept", "")
+            and self.hub.binary
+        )
         etag = (
-            f'"{_key_id(entry.frame_key)}"'
+            f'"{_key_id(entry.frame_key)}{"-b" if binary else ""}"'
             if entry.frame_key is not None
             else None
         )
@@ -549,6 +573,12 @@ class DashboardServer:
             headers["ETag"] = etag
             if request.headers.get("If-None-Match") == etag:
                 return web.Response(status=304, headers=headers)
+        if binary:
+            loop = asyncio.get_running_loop()
+            body = await loop.run_in_executor(None, wire.encode_frame, frame)
+            return web.Response(
+                body=body, content_type=wire.CONTENT_TYPE, headers=headers
+            )
         return _json_response(frame, headers=headers)
 
     def _summary_key(self) -> tuple:
@@ -578,27 +608,44 @@ class DashboardServer:
             await self._refresh_locked(
                 False, deadline=request.get("tpudash_deadline")
             )
+        # binary negotiation behind the SAME ETag/304 machinery: the
+        # TDB1 summary ships the float64 matrix raw (the parent decodes
+        # with one frombuffer instead of a JSON cell parse) — the
+        # worst-case 16-child fan-in cost is summary decode × N
+        binary = (
+            wire.CONTENT_TYPE in request.headers.get("Accept", "")
+            and self.hub.binary
+        )
         key = self._summary_key()
-        etag = f'"s-{_key_id(key)}"'
+        etag = f'"s-{_key_id(key)}{"-b" if binary else ""}"'
         headers = {"Cache-Control": "no-cache", "ETag": etag}
         if request.headers.get("If-None-Match") == etag:
             return web.Response(status=304, headers=headers)
-        cached_key, raw = self._summary_cache
+        cache_slot = "_summary_cache_bin" if binary else "_summary_cache"
+        cached_key, raw = getattr(self, cache_slot)
         if cached_key != key:
             async with self._summary_build_lock:
-                cached_key, raw = self._summary_cache
+                cached_key, raw = getattr(self, cache_slot)
                 if cached_key != key:
                     loop = asyncio.get_running_loop()
                     raw = await loop.run_in_executor(
-                        None, _build_summary_body, self.service
+                        None,
+                        (
+                            _build_summary_body_bin
+                            if binary
+                            else _build_summary_body
+                        ),
+                        self.service,
                     )
-                    self._summary_cache = (key, raw)
+                    setattr(self, cache_slot, (key, raw))
                     cached_key = key
         # serve the ETag of the body actually cached (the data may have
         # advanced while this request queued behind the build gate)
-        headers["ETag"] = f'"s-{_key_id(cached_key)}"'
+        headers["ETag"] = f'"s-{_key_id(cached_key)}{"-b" if binary else ""}"'
         return web.Response(
-            body=raw, content_type="application/json", headers=headers
+            body=raw,
+            content_type=wire.CONTENT_TYPE if binary else "application/json",
+            headers=headers,
         )
 
     def _child_http(self):
@@ -727,8 +774,20 @@ class DashboardServer:
         bus-mirroring worker (TPUDASH_WORKERS mode serves this same loop
         from worker processes; see tpudash.broadcast.worker)."""
         sid = request.cookies.get(SESSION_COOKIE)
+        # binary negotiation: ?format=bin switches the stream to TDB1
+        # event framing (full frames stay JSON inside type-1 events; the
+        # steady-state deltas are the compact binary encoding).  When
+        # the binary tier is disabled the request is refused up front —
+        # the page's glue then falls back to the JSON EventSource path.
+        binary = request.query.get("format") == "bin"
+        if binary and not self.hub.binary:
+            raise web.HTTPNotAcceptable(
+                text="binary wire format disabled (TPUDASH_WIRE_FORMAT=json)"
+            )
         headers = {
-            "Content-Type": "text/event-stream",
+            "Content-Type": (
+                wire.STREAM_CONTENT_TYPE if binary else "text/event-stream"
+            ),
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
         }
@@ -753,7 +812,12 @@ class DashboardServer:
             if payload_writer is not None:
                 await payload_writer.drain()
 
-        ack = parse_event_id(request.headers.get("Last-Event-ID"))
+        # binary clients use fetch-streaming (no EventSource), so the
+        # resume ack can also arrive as a query parameter
+        ack = parse_event_id(
+            request.headers.get("Last-Event-ID")
+            or request.query.get("last_id")
+        )
         write_deadline = self.overload.write_deadline
         try:
             if accepts_gzip:
@@ -766,17 +830,11 @@ class DashboardServer:
                 entry = self.sessions.entry(sid)
                 seals, ack = await self._cohort_tick(entry, ack)
                 if not seals:
-                    payloads = [KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW]
-                elif accepts_gzip:
-                    payloads = [
-                        (s.sse_delta_gz if use_delta else s.sse_full_gz)
-                        for s, use_delta in seals
-                    ]
+                    payloads = [keepalive_buffer(accepts_gzip, binary)]
                 else:
-                    payloads = [
-                        (s.sse_delta_raw if use_delta else s.sse_full_raw)
-                        for s, use_delta in seals
-                    ]
+                    payloads = event_buffers(seals, accepts_gzip, binary)
+                    if any(p is None for p in payloads):
+                        break  # seal lacks the negotiated encoding
                 evicted = False
                 for payload in payloads:
                     if write_deadline and write_deadline > 0:
@@ -915,6 +973,11 @@ class DashboardServer:
         summary = self.service.timer.summary()
         summary["overload"] = self.overload.snapshot()
         summary["loop_lag_ms"] = self.loop_monitor.summary()
+        # native-tier honesty: a deployment silently parsing in Python
+        # (failed build/dlopen) must say so here, with the reason
+        from tpudash import native as _native
+
+        summary["native"] = _native.status()
         summary["broadcast"] = self.hub.stats()
         if self.bus_publisher is not None:
             summary["broadcast"]["bus"] = self.bus_publisher.stats()

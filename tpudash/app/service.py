@@ -158,6 +158,21 @@ class DashboardService:
         self._ident_slices = None
         self._ident_keys = None
         self._ident_accels: list = []
+        #: columnar-arena bookkeeping: the pandas Index object of the
+        #: frame the identity caches were extracted from.  normalize's
+        #: wide arena reuses the Index object while the population holds
+        #: still, so `df.index is self._ident_index` proves the whole
+        #: identity block (keys, chips grid, group codes) is current —
+        #: steady-state publishes skip every per-chip Python loop.
+        self._ident_index = None
+        self._keys_list: list = []
+        #: population-keyed compose caches (chips grid with selection
+        #: flags, per-dimension group codes, per-slice heatmap geometry)
+        self._chips_sel_cache: "tuple | None" = None
+        self._group_cache: "dict | None" = None
+        self._heatmap_geo: "dict | None" = None
+        self._trend_cache: "tuple | None" = None
+        self._strftime_cache: dict = {}
         self.last_error: str | None = None
         #: set by the server's refresh watchdog while a fetch is stalled
         #: (frames keep serving the last data with this warning attached)
@@ -1025,15 +1040,17 @@ class DashboardService:
             log.warning("federation summary failed: %s", e)
             return None
 
-    def summary_doc(self) -> dict:
+    def summary_doc(self, binary: bool = False) -> dict:
         """The compact ``/api/summary`` document a federation parent
         polls (tpudash.federation.summary.build_summary) — per-chip
         latest columns, fleet rollup, alert digest, health.  Blocking
-        (matrix serialization): the server builds it in the executor."""
+        (matrix serialization): the server builds it in the executor.
+        ``binary`` keeps the matrix as the float64 block for the TDB1
+        encoding instead of materializing JSON cells."""
         from tpudash.federation.summary import build_summary
 
         with self._publish_lock:
-            return build_summary(self)
+            return build_summary(self, binary=binary)
 
     def _federation_alerts(self, now: float) -> "list[dict]":
         """The hierarchical alert rollup: synthesized ``child_down`` per
@@ -1297,65 +1314,122 @@ class DashboardService:
                 if schema.ACCEL_TYPE in sel_df
                 else None
             )
-        codes, uniques = pd.factorize(sel_slices, sort=True)
-        everything = len(sel_df) == len(df)  # select-all fast path
-        for g, slice_id in enumerate(uniques):
-            if len(uniques) == 1:
-                sel_idx = np.arange(len(sel_df))
-            else:
-                sel_idx = np.nonzero(codes == g)[0]
-            if everything and len(uniques) == 1:
-                all_ids, a_keys = all_chips, all_keys
-            else:
-                amask = all_slices == slice_id
-                all_ids, a_keys = all_chips[amask], all_keys[amask]
-            if sel_accels is not None:
-                accels = sorted({a for a in sel_accels[sel_idx] if a})
-            else:
-                accels = []
-            generation = accels[0] if accels else self.cfg.generation
-            # topology sized to the FULL slice population (not just the
-            # selection) so partial selections keep real torus coordinates.
-            # Bogus ids (negative, or beyond any real pod size — v5p tops
-            # out near 9k chips) are excluded from sizing AND rendering:
-            # per-series tolerance (sources/base.py), a corrupt series
-            # drops its cell, it must not size a 2e9-cell grid or raise.
-            sane = all_ids[(all_ids >= 0) & (all_ids < 16384)]
-            if sane.size == 0:
-                continue
-            n = int(sane.max()) + 1
-            topo = topology_for(generation, n)
-            chip_ids = sel_chips[sel_idx]
-            in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
-            # clickable cells: keys come from the FULL slice population so
-            # a deselected chip can be clicked back on (symmetric toggle),
-            # built once per slice and shared by every panel's figure
-            ok = (all_ids >= 0) & (all_ids < topo.num_chips)
-            # .tolist() yields native ints/strs in one C pass (a per-cell
-            # int()/str() genexpr profiled at ~1 ms/frame at 256 chips)
-            custom_grid = key_grid(
-                topo, dict(zip(all_ids[ok].tolist(), a_keys[ok].tolist()))
-            )
+        # per-slice GEOMETRY (group indices, topology, clickable key
+        # grids, range masks) is a pure population/selection function —
+        # cached across ticks for the select-all frame; only the z-value
+        # scatter runs per tick.  Partial selections build fresh.
+        cacheable = (
+            sel_df is df and ident_ok and df.index is self._ident_index
+        )
+        geo = self._heatmap_geo if cacheable else None
+        if geo is None:
+            geo = []
+            codes, uniques = pd.factorize(sel_slices, sort=True)
+            everything = len(sel_df) == len(df)  # select-all fast path
+            for g, slice_id in enumerate(uniques):
+                if len(uniques) == 1:
+                    sel_idx = np.arange(len(sel_df))
+                else:
+                    sel_idx = np.nonzero(codes == g)[0]
+                if everything and len(uniques) == 1:
+                    all_ids, a_keys = all_chips, all_keys
+                else:
+                    amask = all_slices == slice_id
+                    all_ids, a_keys = all_chips[amask], all_keys[amask]
+                if sel_accels is not None:
+                    accels = sorted({a for a in sel_accels[sel_idx] if a})
+                else:
+                    accels = []
+                generation = accels[0] if accels else self.cfg.generation
+                # topology sized to the FULL slice population (not just
+                # the selection) so partial selections keep real torus
+                # coordinates.  Bogus ids (negative, or beyond any real
+                # pod size — v5p tops out near 9k chips) are excluded
+                # from sizing AND rendering: per-series tolerance
+                # (sources/base.py), a corrupt series drops its cell, it
+                # must not size a 2e9-cell grid or raise.
+                sane = all_ids[(all_ids >= 0) & (all_ids < 16384)]
+                if sane.size == 0:
+                    continue
+                n = int(sane.max()) + 1
+                topo = topology_for(generation, n)
+                chip_ids = sel_chips[sel_idx]
+                in_range = (chip_ids >= 0) & (chip_ids < topo.num_chips)
+                # clickable cells: keys come from the FULL slice
+                # population so a deselected chip can be clicked back on
+                # (symmetric toggle), built once per slice and shared by
+                # every panel's figure
+                ok = (all_ids >= 0) & (all_ids < topo.num_chips)
+                # .tolist() yields native ints/strs in one C pass (a
+                # per-cell int()/str() genexpr was ~1 ms/frame @256)
+                custom_grid = key_grid(
+                    topo,
+                    dict(zip(all_ids[ok].tolist(), a_keys[ok].tolist())),
+                )
+                # batched-scatter geometry: grid positions for the
+                # selection's in-range chips, plus whether they densely
+                # cover the grid (no gap cells → pure float z rows)
+                from tpudash.topology import _flat_positions, grid_layout
+
+                gny, gwidth, _cells = grid_layout(topo)
+                pos = _flat_positions(topo)[chip_ids[in_range]]
+                covered = np.zeros(gny * gwidth, dtype=bool)
+                covered[pos] = True
+                dense = bool(covered.all()) and bool(in_range.all())
+                geo.append(
+                    (slice_id, sel_idx, chip_ids, in_range, accels,
+                     topo, custom_grid, pos, dense, (gny, gwidth))
+                )
+            if cacheable:
+                self._heatmap_geo = geo
+        for (slice_id, sel_idx, chip_ids, in_range, accels, topo,
+             custom_grid, pos, dense, (gny, gwidth)) in geo:
+            rounded_sub = nan_sub = None
+            zall = None
+            if arr is not None:
+                # one slice-sized extraction + round + isnan for ALL
+                # panels (per-panel column ops were ~2 ms/frame at 96
+                # slice×panel grids).  2dp: hover shows 1dp, so nothing
+                # visible is lost and the z-matrix wire cost drops ~3x
+                # (17-char doubles → "53.33")
+                pcols = [
+                    col_pos[s.column] for s in panels if s.column in col_pos
+                ]
+                rounded_sub = np.round(arr[sel_idx][:, pcols], 2)
+                nan_sub = np.isnan(rounded_sub)
+                sub_j = {c: j for j, c in enumerate(pcols)}
+                if dense and not nan_sub.any():
+                    # fully-populated slice (the scale-dominant shape):
+                    # ONE scatter and ONE tolist materialize every
+                    # panel's z grid — 6 numpy round-trips per slice
+                    # collapse to 1
+                    grids = np.empty((len(pcols), gny * gwidth))
+                    grids[:, pos] = rounded_sub.T  # in_range is all-True here
+                    zall = grids.reshape(len(pcols), gny, gwidth).tolist()
             for spec in panels:
                 ci = col_pos.get(spec.column)
                 if ci is None:
                     if arr is not None or spec.column not in sel_df.columns:
                         continue
-                if arr is not None:
-                    vals = arr[sel_idx, ci]
+                if zall is not None:
+                    grid = zall[sub_j[ci]]
+                elif arr is not None:
+                    vals = rounded_sub[:, sub_j[ci]]
+                    mask = ~nan_sub[:, sub_j[ci]] & in_range
+                    ids_on = chip_ids[mask]
+                    if ids_on.size == 0:
+                        continue
+                    grid = heatmap_grid_arrays(topo, ids_on, vals[mask])
                 else:  # legacy mixed-dtype frames
                     vals = pd.to_numeric(
                         sel_df[spec.column].iloc[sel_idx], errors="coerce"
                     ).to_numpy(dtype=float, na_value=np.nan)
-                mask = ~np.isnan(vals) & in_range
-                ids_on = chip_ids[mask]
-                if ids_on.size == 0:
-                    continue
-                # 2dp: hover shows 1dp, so nothing visible is lost and the
-                # z-matrix wire cost drops ~3x (17-char doubles → "53.33")
-                grid = heatmap_grid_arrays(
-                    topo, ids_on, np.round(vals[mask], 2).tolist()
-                )
+                    vals = np.round(vals, 2)
+                    mask = ~np.isnan(vals) & in_range
+                    ids_on = chip_ids[mask]
+                    if ids_on.size == 0:
+                        continue
+                    grid = heatmap_grid_arrays(topo, ids_on, vals[mask])
                 out.append(
                     {
                         "panel": spec.column,
@@ -1387,17 +1461,25 @@ class DashboardService:
         # entirely.  Rows whose group label is missing (factorize code -1,
         # e.g. a joined source without the host label) are excluded from
         # that dimension rather than corrupting a group.
-        dims = []
-        for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
-            if col not in sel_df.columns:
-                continue
-            # factorize the raw object ndarray: the Series path detours
-            # through arrow string conversion on this pandas build
-            codes, uniques = pd.factorize(
-                sel_df[col].to_numpy(dtype=object), sort=True
-            )
-            if len(uniques) > 1:
-                dims.append((dim, codes, uniques))
+        # group codes are pure population functions — cached across ticks
+        # for the select-all frame (invalidated by publish on population
+        # change); partial selections factorize fresh
+        cacheable = sel_df.index is self._ident_index
+        dims = self._group_cache if cacheable else None
+        if dims is None:
+            dims = []
+            for dim, col in (("by_slice", "slice_id"), ("by_host", "host")):
+                if col not in sel_df.columns:
+                    continue
+                # factorize the raw object ndarray: the Series path
+                # detours through arrow string conversion on this build
+                codes, uniques = pd.factorize(
+                    sel_df[col].to_numpy(dtype=object), sort=True
+                )
+                if len(uniques) > 1:
+                    dims.append((dim, codes, uniques))
+            if cacheable:
+                self._group_cache = dims
         if not dims:
             return {}
         # pure-numpy group means (factorize + add.at), not groups×columns
@@ -1429,28 +1511,48 @@ class DashboardService:
         out: dict = {}
         for dim, codes, uniques in dims:
             labeled = codes >= 0  # drop rows with a missing group label
-            lcodes = codes[labeled]
-            sums = np.zeros((len(uniques), len(cols)))
-            counts = np.zeros((len(uniques), len(cols)))
-            np.add.at(sums, lcodes, filled[labeled])
-            np.add.at(counts, lcodes, valid[labeled])
+            if labeled.all():
+                lcodes, lfilled, lvalid = codes, filled, valid
+            else:
+                lcodes = codes[labeled]
+                lfilled = filled[labeled]
+                lvalid = valid[labeled]
+            G = len(uniques)
+            # per-column bincount: same accumulation (input order) as the
+            # old np.add.at scatter but ~20x faster — add.at alone was
+            # ~4 ms/frame at 1,024 host groups
+            sums = np.empty((G, len(cols)))
+            counts = np.empty((G, len(cols)))
+            for i in range(len(cols)):
+                sums[:, i] = np.bincount(
+                    lcodes, weights=lfilled[:, i], minlength=G
+                )
+                counts[:, i] = np.bincount(
+                    lcodes, weights=lvalid[:, i], minlength=G
+                )
             with np.errstate(invalid="ignore"):
                 means = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
-            sizes = np.bincount(lcodes, minlength=len(uniques))
+            sizes = np.bincount(lcodes, minlength=G)
             # one vectorized round + one C-pass .tolist(): the per-cell
             # round(float(...)) genexpr was ~10k Python-level calls per
             # frame at 1,024 host groups (the 4,096-chip profile's
             # second-largest Python cost after the native parse)
             rounded = np.round(means, 2).tolist()
+            # row dicts: dict(zip) C-path when the row is fully valid
+            # (the overwhelmingly common shape), per-cell only with NaNs
+            full_row = (~np.isnan(means)).all(axis=1).tolist()
             sizes_l = sizes.tolist()
             rows: dict = {}
             for g, key in enumerate(uniques):
                 rv = rounded[g]
-                vals = {
-                    c: rv[i]
-                    for i, c in enumerate(cols)
-                    if rv[i] == rv[i]  # drop no-eligible-value cols (NaN)
-                }
+                if full_row[g]:
+                    vals = dict(zip(cols, rv))
+                else:
+                    vals = {
+                        c: rv[i]
+                        for i, c in enumerate(cols)
+                        if rv[i] == rv[i]  # drop no-eligible-value cols
+                    }
                 if vals:
                     vals["chips"] = sizes_l[g]
                     rows[str(key)] = vals
@@ -1467,10 +1569,23 @@ class DashboardService:
         the range-query layer — full horizon, step-aligned means; until
         then the ring serves, downsampled with the stride anchored at
         the newest point."""
+        accels = accel_types_for(sel_df)
+        # trends are selection-independent (fleet averages) and the
+        # underlying series advance only on refresh: every compose of the
+        # same data tick (N cohorts per tick) reuses one build — at 4,096
+        # chips the store read + strftime of 6 panels was ~4 ms/compose
+        cache_key = (
+            self.last_updated_ts,
+            len(self.history),
+            max_points,
+            tuple(p.column for p in panels),
+            tuple(accels),
+        )
+        if self._trend_cache is not None and self._trend_cache[0] == cache_key:
+            return self._trend_cache[1]
         store_series = self._tsdb_trend_series(max_points)
         if store_series is None and len(self.history) < 2:
             return []
-        accels = accel_types_for(sel_df)
         if store_series is not None:
             fmt = None
 
@@ -1488,15 +1603,24 @@ class DashboardService:
                 ]
 
         out = []
+        strf_memo = self._strftime_cache
+        if len(strf_memo) > 8192:
+            strf_memo.clear()
         for spec in panels:
             series = col_series(spec.column)
             if len(series) < 2:
                 continue
             if fmt is None:
-                times = [
-                    _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
-                    for ts, _ in series
-                ]
+                # memoized per timestamp: the panels share one time grid,
+                # and consecutive ticks share most of it
+                times = []
+                for ts, _ in series:
+                    t = strf_memo.get(ts)
+                    if t is None:
+                        t = strf_memo[ts] = _dt.datetime.fromtimestamp(
+                            ts
+                        ).strftime("%H:%M:%S")
+                    times.append(t)
             else:
                 times = [fmt[ts] for ts, _ in series]
             out.append(
@@ -1511,6 +1635,7 @@ class DashboardService:
                     ),
                 }
             )
+        self._trend_cache = (cache_key, out)
         return out
 
     def chip_detail(
@@ -1841,32 +1966,46 @@ class DashboardService:
         # session's compose (arrow-backed string columns iterate per value
         # on .tolist()/.to_numpy() — at 256 chips doing this per compose
         # profiled at ~2 ms, and the chip-grid model is identical across
-        # sessions except for the per-session "selected" flag).
-        keys = df.index.tolist()
-        chip_id_list = df["chip_id"].tolist()
-        slice_list = df["slice_id"].tolist()
-        host_list = df["host"].tolist()
-        accel_list = (
-            df[schema.ACCEL_TYPE].fillna("").tolist()
-            if schema.ACCEL_TYPE in df
-            else [""] * len(df)
-        )
-        self._ident_chips = np.asarray(chip_id_list, dtype=np.int64)
-        self._ident_slices = np.asarray(slice_list, dtype=object)
-        self._ident_keys = np.asarray(keys, dtype=object)
-        self._ident_accels = accel_list
-        self._chips_base = [
-            {
-                "key": k,
-                "chip_id": int(c),
-                "slice": s,
-                "host": h,
-                "model": _model_name(a),
-            }
-            for k, c, s, h, a in zip(
-                keys, chip_id_list, slice_list, host_list, accel_list
+        # sessions except for the per-session "selected" flag).  The
+        # columnar arena makes the steady state free: normalize reuses
+        # the Index OBJECT while the population is unchanged, so one `is`
+        # check proves every identity cache (and the compose-side caches
+        # keyed on it) is still current.
+        if df.index is self._ident_index and self._chips_base:
+            keys = self._keys_list
+        else:
+            keys = df.index.tolist()
+            chip_id_list = df["chip_id"].tolist()
+            slice_list = df["slice_id"].tolist()
+            host_list = df["host"].tolist()
+            accel_list = (
+                df[schema.ACCEL_TYPE].fillna("").tolist()
+                if schema.ACCEL_TYPE in df
+                else [""] * len(df)
             )
-        ]
+            self._ident_chips = np.asarray(chip_id_list, dtype=np.int64)
+            self._ident_slices = np.asarray(slice_list, dtype=object)
+            self._ident_keys = np.asarray(keys, dtype=object)
+            self._ident_accels = accel_list
+            self._chips_base = [
+                {
+                    "key": k,
+                    "chip_id": int(c),
+                    "slice": s,
+                    "host": h,
+                    "model": _model_name(a),
+                }
+                for k, c, s, h, a in zip(
+                    keys, chip_id_list, slice_list, host_list, accel_list
+                )
+            ]
+            self._ident_index = df.index
+            self._keys_list = keys
+            # population changed: every population-keyed compose cache
+            # (chips grid, group codes, heatmap geometry) is stale
+            self._chips_sel_cache = None
+            self._group_cache = None
+            self._heatmap_geo = None
         self.available = keys
         if self.alert_engine is not None:
             with self.timer.stage("alerts"):
@@ -2044,10 +2183,22 @@ class DashboardService:
             panels = self._active_panels(df)
             use_gauge = state.use_gauge
 
-            sel_set = set(selected)
-            frame["chips"] = [
-                dict(c, selected=c["key"] in sel_set) for c in self._chips_base
-            ]
+            # chips grid with per-session selection flags: population- and
+            # selection-keyed cache (population invalidates via publish;
+            # bounded by cohort diversity).  The cached list is shared
+            # across frames — consumers treat frames as immutable.
+            sel_t = tuple(selected)
+            cached = self._chips_sel_cache
+            if cached is not None and cached[0] == sel_t:
+                frame["chips"] = cached[1]
+            else:
+                sel_set = set(selected)
+                chips_sel = [
+                    dict(c, selected=c["key"] in sel_set)
+                    for c in self._chips_base
+                ]
+                self._chips_sel_cache = (sel_t, chips_sel)
+                frame["chips"] = chips_sel
             # copy: the cached frame must not alias the live selection list
             frame["selected"] = list(selected)
             frame["panel_specs"] = [

@@ -1,0 +1,566 @@
+"""TDB1 — the compact binary delta/frame wire format.
+
+BENCH_r05's two scale walls are JSON-shaped: a steady-state SSE delta at
+4,096 chips is ~344 KB, almost all of it heatmap z-matrices and the
+per-host breakdown re-shipping full metric names and decimal text every
+tick.  This module encodes those bulk numerics in a versioned
+little-endian binary layout; everything small (timings, alerts, stats,
+figure-value patches, trends) rides verbatim in a compact JSON "head",
+so the format never re-implements frame semantics — it is a CONTAINER
+around the existing delta contract (tpudash/app/delta.py).
+
+The decoder is ``tpudash/app/clientlogic.py`` (``decode_bin_sections``
+and friends): ONE implementation executed by the Python test suite and
+transpiled into the served page, so the browser's binary path can never
+drift from the server's (same single-source scheme as apply_delta).
+This module is the encoder plus the container framing, and it derives
+temporal-delta bases through the very same ``qd_base`` the decoder uses.
+
+Byte layout (all integers little-endian)::
+
+    0   4   magic  b"TDB1"
+    4   1   version (1)
+    5   1   kind: 1 = delta, 2 = full frame
+    6   2   reserved (0)
+    8   4   head_len (u32)
+    12  N   head: compact JSON (UTF-8)
+    .   4   payload_len (u32)
+    .   M   payload: the binary sections, in head-descriptor order
+
+The head is the frame/delta dict with the bulk fields removed and a
+``_b`` descriptor added::
+
+    _b.hm  = {"shapes": [[rows, cols], ...], "changed": [0|1, ...]}
+    _b.bd  = [[dim, [row names...], [value columns...]], ...]
+    _b.ch  = {"n": chips, "slices": [...], "hosts": [...],
+              "models": [...]}                      (kind=full only)
+
+Sections follow in a fixed order: changed heatmap grids (row-major
+cells), breakdown dims (per row: presence bitmask varint, chip-count
+varint, one value per present column), and for full frames the columnar
+chip table (interned slice/host/model codes, delta-coded chip ids, and
+a selected bitmap).
+
+Every cell value is one *quantized* varint (``qv``): code 0 = null,
+1 = raw float64 escape (8 bytes), 2/3 = ±inf, 4 = NaN, and ≥5 a zigzag
+scaled-centi delta against the same cell of the PREVIOUS frame (both
+ends hold it — that is the delta contract).  Frame values are already
+display-rounded to 2 decimals by compose, so the common cell is 1-2
+bytes; any value outside the exact centi-integer envelope escapes to
+raw float64, keeping the codec lossless (−0.0 included; NaN decodes to
+the canonical quiet NaN on both ends, which is as bit-exact as a JS
+Number can represent one).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+
+from tpudash.app import clientlogic
+from tpudash.app.delta import frame_delta
+
+MAGIC = b"TDB1"
+VERSION = 1
+KIND_DELTA = 1
+KIND_FULL = 2
+KIND_SUMMARY = 3
+
+#: negotiated content type for binary frames/deltas
+CONTENT_TYPE = "application/x-tpudash-bin"
+#: the binary stream's content type (``/api/stream?format=bin``)
+STREAM_CONTENT_TYPE = "application/x-tpudash-stream"
+
+#: binary stream event types (the SSE analog: full / delta / keepalive)
+EVT_FULL = 1
+EVT_DELTA = 2
+EVT_KEEPALIVE = 3
+
+
+def bin_event(etype: int, event_id: str, body: bytes) -> bytes:
+    """One framed binary stream event: ``b"TE" | u8 type | u8 id_len |
+    id (ASCII) | u32 body_len | body``.  Event ids are the same
+    ``<cohort>-<seq>`` strings the SSE path uses, so ``?last_id=``
+    resume rides the existing seal-window machinery unchanged."""
+    ib = event_id.encode("ascii")
+    if len(ib) > 255:
+        raise WireError("event id too long")
+    return (
+        b"TE" + bytes((etype, len(ib))) + ib
+        + struct.pack("<I", len(body)) + body
+    )
+
+
+def split_bin_events(buf: bytes):
+    """(events, remainder): parse complete framed events off the front
+    of ``buf`` — the client-side splitter (tests and tooling; the page's
+    hand-JS splitter mirrors this layout)."""
+    out = []
+    pos = 0
+    while True:
+        if len(buf) - pos < 8:
+            break
+        if buf[pos : pos + 2] != b"TE":
+            raise WireError("bad stream framing")
+        etype = buf[pos + 2]
+        idlen = buf[pos + 3]
+        hdr_end = pos + 4 + idlen
+        if hdr_end + 4 > len(buf):
+            break
+        event_id = buf[pos + 4 : hdr_end].decode("ascii")
+        (blen,) = struct.unpack_from("<I", buf, hdr_end)
+        end = hdr_end + 4 + blen
+        if end > len(buf):
+            break
+        out.append((etype, event_id, buf[hdr_end + 4 : end]))
+        pos = end
+    return out, buf[pos:]
+
+_dumps = json.dumps
+
+
+class WireError(ValueError):
+    """Malformed/unsupported TDB1 document — callers fall back to JSON."""
+
+
+def _wv(out: bytearray, v: int) -> None:
+    """LEB128 varint append (values < 2^53 by construction)."""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _qv(out: bytearray, v, base100) -> None:
+    """One quantized cell (see module doc).  ``base100`` comes from
+    clientlogic.qd_base over the previous frame's cell, so encoder and
+    decoder anchor on identical integers by construction."""
+    if v is None:
+        out.append(0)
+        return
+    v = float(v)
+    if math.isnan(v):
+        out.append(4)
+        return
+    if math.isinf(v):
+        out.append(2 if v > 0 else 3)
+        return
+    if v == 0.0 and math.copysign(1.0, v) < 0:
+        # -0.0 must survive bit-exactly: the scaled path would decode +0.0
+        out.append(1)
+        out += struct.pack("<d", v)
+        return
+    small = abs(v) < (1 << 52) / 100.0  # round(v*100) must not overflow
+    v100 = round(v * 100) if small else 0
+    if small and -(1 << 52) < v100 < (1 << 52) and v100 / 100.0 == v:
+        d = v100 - int(base100)
+        if -(1 << 51) < d < (1 << 51):
+            z = (d << 1) ^ (d >> 63)  # zigzag
+            _wv(out, z + 5)
+            return
+    out.append(1)
+    out += struct.pack("<d", v)
+
+
+def _cell_base(prev_cell) -> int:
+    b = clientlogic.qd_base(prev_cell)
+    # qd_base returns a float in the exact-integer range (or 0)
+    return int(b)
+
+
+def _prev_z(prev: "dict | None", i: int):
+    if not prev:
+        return None
+    hms = prev.get("heatmaps")
+    if not hms or i >= len(hms):
+        return None
+    return hms[i]["figure"]["data"][0]["z"]
+
+
+def _encode_heatmaps(delta: dict, prev: "dict | None", head_b: dict,
+                     out: bytearray) -> None:
+    zs = delta["heatmaps"]
+    shapes = []
+    changed = []
+    for i, z in enumerate(zs):
+        rows = len(z)
+        cols = len(z[0]) if rows else 0
+        shapes.append([rows, cols])
+        pz = _prev_z(prev, i)
+        if pz == z:
+            changed.append(0)
+            continue
+        changed.append(1)
+        vals = [v for zr in z for v in zr]
+        if pz is not None and len(pz) == rows and all(
+            len(pr) == cols for pr in pz
+        ):
+            bases = [v for pr in pz for v in pr]
+        else:
+            bases = [float("nan")] * (rows * cols)  # NaN prev → base 0
+        _qv_stream(out, vals, bases)
+    head_b["hm"] = {"shapes": shapes, "changed": changed}
+
+
+def _encode_breakdown(delta: dict, prev: "dict | None", head_b: dict,
+                      out: bytearray) -> None:
+    """Stream-separated per-dim layout (masks, then chip counts, then
+    the value cells) so the value cells form ONE contiguous qv stream
+    the native bulk encoder can emit in a single call."""
+    bd = delta["breakdown"]
+    pbd = (prev or {}).get("breakdown") or {}
+    dims_desc = []
+    nan = float("nan")
+    for dim, rows in bd.items():
+        names = list(rows.keys())
+        cols: list = []
+        seen = set()
+        for row in rows.values():
+            for c in row:
+                if c != "chips" and c not in seen:
+                    seen.add(c)
+                    cols.append(c)
+        if len(cols) > 52:  # presence bitmask must stay an exact float
+            raise WireError(f"breakdown dim {dim!r} has {len(cols)} columns")
+        dims_desc.append([dim, names, cols])
+        pdim = pbd.get(dim) or {}
+        vals: list = []
+        bases: list = []
+        for name in names:
+            row = rows[name]
+            prow = pdim.get(name) or {}
+            mask = 0
+            for k, c in enumerate(cols):
+                if c in row:
+                    mask |= 1 << k
+                    vals.append(row[c])
+                    bases.append(prow.get(c, nan))
+            _wv(out, mask)
+        for name in names:
+            _wv(out, int(rows[name].get("chips", 0)))
+        _qv_stream(out, vals, bases)
+    head_b["bd"] = dims_desc
+
+
+def _qv_stream(out: bytearray, vals: list, prev_vals) -> None:
+    """Append one qv cell per value, anchored on the matching previous
+    value (None/NaN prev → base 0).  Routed through the native bulk
+    encoder when available and the values are all numeric; the Python
+    loop below is the always-correct fallback (and the None-carrying
+    path — nulls only occur in object-shaped heatmap rows)."""
+    import numpy as np
+
+    from tpudash import native
+
+    # None cells (heatmap gaps) must encode as code-0 null, and numpy
+    # would silently coerce them to NaN (np.asarray(None, float) → nan,
+    # no exception) — so the native path is gated on an explicit scan
+    if (
+        native.is_available()
+        and len(vals) >= 32
+        and None not in vals
+        and None not in prev_vals
+    ):
+        try:
+            v = np.asarray(vals, dtype=np.float64)
+            p = np.asarray(prev_vals, dtype=np.float64)
+        except (TypeError, ValueError):
+            v = None
+        if v is not None and v.shape == p.shape:
+            out += native.qv_encode_block(v, p)
+            return
+    for val, pv in zip(vals, prev_vals):
+        _qv(out, val, _cell_base(None if pv is None else pv))
+
+
+def _pack_str_table(values) -> "tuple[list, list]":
+    """(uniques, codes) — first-seen-order interning for the columnar
+    chip table."""
+    memo: dict = {}
+    uniq: list = []
+    codes: list = []
+    for v in values:
+        c = memo.get(v)
+        if c is None:
+            c = memo[v] = len(uniq)
+            uniq.append(v)
+        codes.append(c)
+    return uniq, codes
+
+
+def _encode_chips(frame: dict, head_b: dict, out: bytearray) -> None:
+    """Columnar chip table for FULL frames: interned identity columns,
+    delta-coded chip ids, selected bitmap.  Keys are derived
+    ("<slice>/<chip_id>"), so they never ride the wire."""
+    chips = frame["chips"]
+    slice_u, slice_c = _pack_str_table(c["slice"] for c in chips)
+    host_u, host_c = _pack_str_table(c["host"] for c in chips)
+    model_u, model_c = _pack_str_table(c["model"] for c in chips)
+    head_b["ch"] = {
+        "n": len(chips),
+        "slices": slice_u,
+        "hosts": host_u,
+        "models": model_u,
+    }
+    prev_id = 0
+    for i, c in enumerate(chips):
+        _wv(out, slice_c[i])
+        _wv(out, host_c[i])
+        _wv(out, model_c[i])
+        d = int(c["chip_id"]) - prev_id
+        prev_id = int(c["chip_id"])
+        _wv(out, ((d << 1) ^ (d >> 63)))  # zigzag: ids ascend per slice
+    # selected bitmap, 8 chips per byte, LSB first
+    acc = 0
+    nbits = 0
+    for c in chips:
+        acc |= (1 if c.get("selected") else 0) << nbits
+        nbits += 1
+        if nbits == 8:
+            out.append(acc)
+            acc = 0
+            nbits = 0
+    if nbits:
+        out.append(acc)
+
+
+def _decode_chips(head_b: dict, buf: bytes, pos: list) -> list:
+    ch = head_b["ch"]
+    n = ch["n"]
+    slices, hosts, models = ch["slices"], ch["hosts"], ch["models"]
+    chips = []
+    prev_id = 0
+    rv = clientlogic.rv_read
+    for _ in range(n):
+        s = slices[rv(buf, pos)]
+        h = hosts[rv(buf, pos)]
+        m = models[rv(buf, pos)]
+        z = rv(buf, pos)
+        d = -((z + 1) // 2) if z % 2 else z // 2
+        prev_id += int(d)
+        chips.append(
+            {
+                "key": f"{s}/{prev_id}",
+                "chip_id": prev_id,
+                "slice": s,
+                "host": h,
+                "model": m,
+            }
+        )
+    base = pos[0]
+    for i, c in enumerate(chips):
+        c["selected"] = bool((buf[base + (i >> 3)] >> (i & 7)) & 1)
+    pos[0] = base + (n + 7) // 8
+    return chips
+
+
+def _container(kind: int, head: dict, payload: bytes) -> bytes:
+    hb = _dumps(head, separators=(",", ":")).encode()
+    return (
+        MAGIC
+        + bytes((VERSION, kind, 0, 0))
+        + struct.pack("<I", len(hb))
+        + hb
+        + struct.pack("<I", len(payload))
+        + payload
+    )
+
+
+def split_container(buf: bytes) -> "tuple[int, dict, bytes]":
+    """(kind, head, payload) of a TDB1 document, or WireError."""
+    if len(buf) < 12 or buf[:4] != MAGIC:
+        raise WireError("not a TDB1 document")
+    if buf[4] != VERSION:
+        raise WireError(f"unsupported TDB1 version {buf[4]}")
+    kind = buf[5]
+    (head_len,) = struct.unpack_from("<I", buf, 8)
+    head_end = 12 + head_len
+    if head_end + 4 > len(buf):
+        raise WireError("truncated TDB1 head")
+    try:
+        head = json.loads(buf[12:head_end])
+    except ValueError as e:
+        raise WireError(f"bad TDB1 head: {e}") from e
+    (pay_len,) = struct.unpack_from("<I", buf, head_end)
+    payload = buf[head_end + 4 : head_end + 4 + pay_len]
+    if len(payload) != pay_len:
+        raise WireError("truncated TDB1 payload")
+    return kind, head, payload
+
+
+#: delta fields that carry bulk numerics into binary sections; every
+#: other field rides the JSON head verbatim
+_BULK_DELTA_FIELDS = ("heatmaps", "breakdown")
+
+
+def encode_delta(prev: "dict | None", delta: "dict | None") -> "bytes | None":
+    """The binary twin of one JSON delta (None in → None out, mirroring
+    frame_delta's structural-change contract)."""
+    if delta is None:
+        return None
+    head = {k: v for k, v in delta.items() if k not in _BULK_DELTA_FIELDS}
+    head_b: dict = {}
+    out = bytearray()
+    if "heatmaps" in delta:
+        _encode_heatmaps(delta, prev, head_b, out)
+    if "breakdown" in delta:
+        _encode_breakdown(delta, prev, head_b, out)
+    head["_b"] = head_b
+    return _container(KIND_DELTA, head, bytes(out))
+
+
+def decode_delta(buf: bytes, prev: "dict | None") -> dict:
+    """Python-side decode — a thin wrapper over the clientlogic decoder
+    (the SAME code the page runs), so tests and server-side consumers
+    share one implementation with the browser."""
+    kind, head, payload = split_container(buf)
+    if kind != KIND_DELTA:
+        raise WireError(f"expected a delta container, got kind {kind}")
+    return clientlogic.decode_bin_sections(head, payload, prev or {})
+
+
+def encode_frame(frame: dict) -> bytes:
+    """Binary FULL frame (kind=2): the chip table and heatmap z grids —
+    the two scale-dominant bulk fields — go columnar/quantized; all
+    figure structure stays in the JSON head.  Self-contained: bases are
+    0 (no prev), so any consumer can decode it stand-alone."""
+    head = {
+        k: v for k, v in frame.items() if k not in ("chips", "heatmaps")
+    }
+    head_b: dict = {}
+    out = bytearray()
+    hms = frame.get("heatmaps")
+    if hms is not None:
+        shapes = []
+        for hm in hms:
+            z = hm["figure"]["data"][0]["z"]
+            rows = len(z)
+            cols = len(z[0]) if rows else 0
+            shapes.append([rows, cols])
+            _qv_stream(
+                out,
+                [v for zr in z for v in zr],
+                [float("nan")] * (rows * cols),
+            )
+        # figures minus their z (restored at decode): the figure dicts
+        # are structure, the z matrices are the bulk
+        head_b["hm"] = {"shapes": shapes}
+        head["heatmaps"] = [
+            {
+                **hm,
+                "figure": {
+                    **hm["figure"],
+                    "data": [
+                        {**hm["figure"]["data"][0], "z": None},
+                        *hm["figure"]["data"][1:],
+                    ],
+                },
+            }
+            for hm in hms
+        ]
+    if frame.get("chips") is not None:
+        _encode_chips(frame, head_b, out)
+    head["_b"] = head_b
+    return _container(KIND_FULL, head, bytes(out))
+
+
+def decode_frame(buf: bytes) -> dict:
+    """Inverse of encode_frame."""
+    kind, head, payload = split_container(buf)
+    if kind != KIND_FULL:
+        raise WireError(f"expected a full-frame container, got kind {kind}")
+    head_b = head.pop("_b", {})
+    pos = [0]
+    if "hm" in head_b:
+        qv = clientlogic.qv_read
+        for i, (rows, cols) in enumerate(head_b["hm"]["shapes"]):
+            z = [
+                [qv(payload, pos, 0) for _ in range(cols)]
+                for _ in range(rows)
+            ]
+            head["heatmaps"][i]["figure"]["data"][0]["z"] = z
+    if "ch" in head_b:
+        head["chips"] = _decode_chips(head_b, payload, pos)
+    return head
+
+
+def encode_summary(doc: dict) -> bytes:
+    """Binary ``/api/summary`` (kind=3): the per-chip numeric matrix —
+    the document's bulk — rides as raw little-endian float64 (NaN for
+    null; full precision, the parent re-aggregates these), and the
+    derivable ``keys`` list is dropped; identity/alerts/health stay in
+    the JSON head.  ``doc["matrix"]`` may be the numpy block itself
+    (the service's zero-copy path) or the JSON-shaped nested lists."""
+    import numpy as np
+
+    head = {k: v for k, v in doc.items() if k not in ("matrix", "keys")}
+    payload = b""
+    matrix = doc.get("matrix")
+    if matrix is not None:
+        if isinstance(matrix, np.ndarray):
+            arr = np.ascontiguousarray(matrix, dtype=np.float64)
+        else:
+            arr = np.array(
+                [
+                    [np.nan if v is None else float(v) for v in row]
+                    for row in matrix
+                ],
+                dtype=np.float64,
+            )
+        n = int(arr.shape[0])
+        c = int(arr.shape[1]) if arr.ndim == 2 else 0
+        head["_b"] = {"mx": {"n": n, "c": c}}
+        payload = arr.tobytes()
+    elif "keys" in doc:
+        # table-less marker must survive the keys drop
+        head["_b"] = {"mx": None}
+    else:
+        head["_b"] = {}
+    return _container(KIND_SUMMARY, head, payload)
+
+
+def decode_summary(buf: bytes) -> dict:
+    """Inverse of encode_summary: returns the JSON-shaped doc with
+    ``matrix`` as a float64 ndarray (consumers' fast path) and ``keys``
+    re-derived from identity."""
+    import numpy as np
+
+    kind, head, payload = split_container(buf)
+    if kind != KIND_SUMMARY:
+        raise WireError(f"expected a summary container, got kind {kind}")
+    head_b = head.pop("_b", {})
+    mx = head_b.get("mx") if isinstance(head_b, dict) else None
+    if mx is not None:
+        n, c = int(mx["n"]), int(mx["c"])
+        if len(payload) != n * c * 8:
+            raise WireError("summary matrix size disagrees with descriptor")
+        # copy: frombuffer views are read-only, downstream batch math
+        # assumes ordinary writable arrays
+        head["matrix"] = (
+            np.frombuffer(payload, dtype="<f8").reshape(n, c).copy()
+        )
+        ident = head.get("identity") or {}
+        head["keys"] = [
+            f"{s}/{int(cid)}"
+            for s, cid in zip(
+                ident.get("slice") or [], ident.get("chip_id") or []
+            )
+        ]
+    elif "mx" in (head_b or {}):
+        head["keys"] = []  # table-less but valid (the no-table marker)
+    return head
+
+
+def binary_delta_roundtrip_equal(prev: dict, cur: dict) -> bool:
+    """Test helper: does decode(encode(prev, frame_delta(prev, cur)))
+    reproduce frame_delta(prev, cur) exactly?"""
+    delta = frame_delta(prev, cur)
+    if delta is None:
+        return encode_delta(prev, delta) is None
+    buf = encode_delta(prev, delta)
+    return decode_delta(buf, prev) == delta

@@ -49,7 +49,8 @@ log = logging.getLogger(__name__)
 
 #: bump on any incompatible wire change — a version-skewed worker must
 #: fail its handshake loudly, not misparse seals quietly
-PROTO = 1
+#: (2: seals carry the TDB1 binary encodings)
+PROTO = 2
 
 #: hard sanity bound on one message (a 4096-chip full frame gzips well
 #: under this; anything larger is a corrupt length prefix)
@@ -63,6 +64,10 @@ _SEAL_BLOBS = (
     "sse_delta_gz",
     "frame_raw",
     "frame_gz",
+    "bin_full_raw",
+    "bin_full_gz",
+    "bin_delta_raw",
+    "bin_delta_gz",
 )
 
 

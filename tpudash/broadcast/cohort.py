@@ -28,12 +28,15 @@ the exact delta chain it missed — from this process or any bus mirror.
 from __future__ import annotations
 
 import gzip
+import logging
 import time
 import zlib
 from collections import OrderedDict
 
 from tpudash.app.delta import frame_delta
 from tpudash.app.state import SelectionState
+
+log = logging.getLogger(__name__)
 
 #: static gzip member header (deflate method, no name/mtime, OS=unix) —
 #: written once per subscriber connection ahead of the shared segments
@@ -52,6 +55,43 @@ def compress_segment(raw: bytes, level: int = 6) -> bytes:
 #: precompressed once for every gzip subscriber of every cohort
 KEEPALIVE_RAW = b": keepalive\n\n"
 KEEPALIVE_GZ = compress_segment(KEEPALIVE_RAW)
+
+#: binary-stream keepalive (TDB1 event framing, type 3) — same sharing
+from tpudash.app.wire import bin_event  # noqa: E402  (tiny, no cycles)
+
+BIN_KEEPALIVE_RAW = bin_event(3, "", b"")
+BIN_KEEPALIVE_GZ = compress_segment(BIN_KEEPALIVE_RAW)
+
+
+def keepalive_buffer(gz: bool, binary: bool) -> bytes:
+    """The shared keepalive tick in the subscriber's negotiated framing."""
+    if binary:
+        return BIN_KEEPALIVE_GZ if gz else BIN_KEEPALIVE_RAW
+    return KEEPALIVE_GZ if gz else KEEPALIVE_RAW
+
+
+def event_buffers(pairs, gz: bool, binary: bool) -> "list[bytes | None]":
+    """Pre-encoded event buffers for ``(seal, use_delta)`` pairs in the
+    subscriber's negotiated framing (SSE text vs TDB1 binary events,
+    raw vs shared-gzip segments).  A None entry means the seal lacks
+    the requested encoding (binary tier disabled on the composing
+    side) — the caller closes the stream and the client falls back."""
+    out = []
+    for s, use_delta in pairs:
+        if binary:
+            buf = (
+                (s.bin_delta_gz if gz else s.bin_delta_raw)
+                if use_delta
+                else (s.bin_full_gz if gz else s.bin_full_raw)
+            )
+        else:
+            buf = (
+                (s.sse_delta_gz if gz else s.sse_delta_raw)
+                if use_delta
+                else (s.sse_full_gz if gz else s.sse_full_raw)
+            )
+        out.append(buf)
+    return out
 
 
 def cohort_key(state: SelectionState) -> tuple:
@@ -102,6 +142,10 @@ class Seal:
         "sse_delta_gz",
         "frame_raw",
         "frame_gz",
+        "bin_full_raw",
+        "bin_full_gz",
+        "bin_delta_raw",
+        "bin_delta_gz",
     )
 
     def __init__(
@@ -115,6 +159,10 @@ class Seal:
         sse_delta_gz: "bytes | None",
         frame_raw: bytes,
         frame_gz: bytes,
+        bin_full_raw: "bytes | None" = None,
+        bin_full_gz: "bytes | None" = None,
+        bin_delta_raw: "bytes | None" = None,
+        bin_delta_gz: "bytes | None" = None,
     ):
         self.cid = cid
         self.seq = seq
@@ -127,6 +175,15 @@ class Seal:
         self.sse_delta_gz = sse_delta_gz
         self.frame_raw = frame_raw
         self.frame_gz = frame_gz
+        #: TDB1 binary stream events (tpudash/app/wire.py): the full
+        #: event wraps the SAME frame JSON (structure is one-off), the
+        #: delta event carries the compact binary delta.  None when the
+        #: binary tier is disabled (wire_format=json) or, for the delta
+        #: pair, when the step was structural.
+        self.bin_full_raw = bin_full_raw
+        self.bin_full_gz = bin_full_gz
+        self.bin_delta_raw = bin_delta_raw
+        self.bin_delta_gz = bin_delta_gz
 
 
 class SealWindow:
@@ -208,9 +265,14 @@ class CohortHub:
         max_cohorts: int = 64,
         clock=time.monotonic,
         on_evict=None,
+        binary: bool = True,
     ):
         self._compose = compose  # SelectionState -> frame dict (blocking)
         self._dumps = dumps
+        #: build the TDB1 binary encodings into every seal (compose-once
+        #: applies to them exactly like the JSON pairs); wire_format=json
+        #: turns this off and binary negotiation falls back to JSON
+        self.binary = bool(binary)
         self.window = max(1, int(window))
         self.max_cohorts = max(1, int(max_cohorts))
         self._clock = clock
@@ -382,20 +444,44 @@ class CohortHub:
         cid = cohort.cid
         event_id = f"{cid}-{seq}"
         frame_raw = self._dumps(frame).encode()
-        sse_full_raw = (
-            f"id: {event_id}\ndata: ".encode()
-            + self._dumps(dict(frame, kind="full")).encode()
-            + b"\n\n"
-        )
+        sse_prefix = f"id: {event_id}\ndata: ".encode()
+        full_json = self._dumps(dict(frame, kind="full")).encode()
+        sse_full_raw = sse_prefix + full_json + b"\n\n"
         sse_delta_raw = None
         sse_delta_gz = None
         if delta is not None:
             sse_delta_raw = (
-                f"id: {event_id}\ndata: ".encode()
-                + self._dumps(delta).encode()
-                + b"\n\n"
+                sse_prefix + self._dumps(delta).encode() + b"\n\n"
             )
             sse_delta_gz = compress_segment(sse_delta_raw)
+        bin_full_raw = bin_full_gz = None
+        bin_delta_raw = bin_delta_gz = None
+        if self.binary:
+            from tpudash.app import wire
+
+            try:
+                # full events reuse the already-serialized frame JSON —
+                # figure structure is one-off; only deltas go binary
+                bin_full_raw = wire.bin_event(
+                    wire.EVT_FULL, event_id, full_json
+                )
+                bin_full_gz = compress_segment(bin_full_raw)
+                if delta is not None:
+                    bin_delta_raw = wire.bin_event(
+                        wire.EVT_DELTA,
+                        event_id,
+                        wire.encode_delta(cohort.prev_frame, delta),
+                    )
+                    bin_delta_gz = compress_segment(bin_delta_raw)
+            except wire.WireError as e:
+                # an unencodable frame shape (e.g. >52 breakdown value
+                # columns) must cost the BINARY tier of this seal, never
+                # the seal itself — JSON subscribers keep streaming and
+                # binary subscribers fall back to JSON when their stream
+                # closes on the missing encoding
+                log.warning("binary seal encoding skipped: %s", e)
+                bin_full_raw = bin_full_gz = None
+                bin_delta_raw = bin_delta_gz = None
         seal = Seal(
             cid,
             seq,
@@ -410,6 +496,10 @@ class CohortHub:
             # bare full-flushed deflate segment labeled Content-Encoding
             # gzip is undecodable by every real client (no header)
             gzip.compress(frame_raw, 6),
+            bin_full_raw,
+            bin_full_gz,
+            bin_delta_raw,
+            bin_delta_gz,
         )
         cohort.prev_frame = frame
         self.last_frame = frame

@@ -43,10 +43,11 @@ from tpudash.app.server import (
     _accepts_gzip,
 )
 from tpudash.broadcast.bus import BusMirror
+from tpudash.app import wire
 from tpudash.broadcast.cohort import (
     GZIP_HEADER,
-    KEEPALIVE_GZ,
-    KEEPALIVE_RAW,
+    event_buffers,
+    keepalive_buffer,
     parse_event_id,
 )
 from tpudash.config import Config, configure_logging, env_read, load_config
@@ -256,8 +257,16 @@ class FanoutWorker:
                     WORKER_HEADER: str(self.pid),
                 },
             )
+        # binary negotiation, same contract as the single-process server
+        binary = request.query.get("format") == "bin"
+        if binary and self.cfg.wire_format == "json":
+            raise web.HTTPNotAcceptable(
+                text="binary wire format disabled (TPUDASH_WIRE_FORMAT=json)"
+            )
         headers = {
-            "Content-Type": "text/event-stream",
+            "Content-Type": (
+                wire.STREAM_CONTENT_TYPE if binary else "text/event-stream"
+            ),
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
             WORKER_HEADER: str(self.pid),
@@ -275,7 +284,10 @@ class FanoutWorker:
             if payload_writer is not None:
                 await payload_writer.drain()
 
-        ack = parse_event_id(request.headers.get("Last-Event-ID"))
+        ack = parse_event_id(
+            request.headers.get("Last-Event-ID")
+            or request.query.get("last_id")
+        )
         write_deadline = self.overload.write_deadline
         self.mirror.retain(cid)
         seen_hello = self.mirror.hello_count
@@ -320,7 +332,7 @@ class FanoutWorker:
                     if latest is None:
                         if time.monotonic() >= next_keepalive:
                             await write_buf(
-                                KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW
+                                keepalive_buffer(accepts_gzip, binary)
                             )
                             next_keepalive = time.monotonic() + interval
                         continue
@@ -330,23 +342,22 @@ class FanoutWorker:
                     else None
                 )
                 if chain is None:
-                    payloads = [
-                        latest.sse_full_gz if accepts_gzip else latest.sse_full_raw
-                    ]
+                    payloads = event_buffers(
+                        [(latest, False)], accepts_gzip, binary
+                    )
                 elif not chain:
                     # nothing new for THIS cohort: keepalive only when
                     # one is due, not on every bus wake
                     if time.monotonic() >= next_keepalive:
-                        payloads = [
-                            KEEPALIVE_GZ if accepts_gzip else KEEPALIVE_RAW
-                        ]
+                        payloads = [keepalive_buffer(accepts_gzip, binary)]
                     else:
                         payloads = []
                 else:
-                    payloads = [
-                        (s.sse_delta_gz if accepts_gzip else s.sse_delta_raw)
-                        for s in chain
-                    ]
+                    payloads = event_buffers(
+                        [(s, True) for s in chain], accepts_gzip, binary
+                    )
+                if any(p is None for p in payloads):
+                    break  # seal lacks the negotiated encoding
                 ack = (cid, latest.seq)
                 evicted = False
                 for payload in payloads:

@@ -333,6 +333,14 @@ class Config:
     #: deadline; bounding them caps both.  The overload drills set it so
     #: slow-consumer eviction is provable on loopback.
     sse_sndbuf: int = 0
+    #: Binary wire-format policy (TDB1, tpudash/app/wire.py): "auto"
+    #: builds the binary seal encodings and serves them to clients that
+    #: negotiate (``/api/stream?format=bin``, ``Accept:
+    #: application/x-tpudash-bin`` on ``/api/frame`` and
+    #: ``/api/summary``); "json" disables the binary path entirely
+    #: (negotiating clients fall back to JSON).  JSON is always the
+    #: default for clients that don't ask.
+    wire_format: str = "auto"
 
     extra: dict = field(default_factory=dict)
 
@@ -392,6 +400,7 @@ _ENV_MAP = {
     "broadcast_backlog": "TPUDASH_BROADCAST_BACKLOG",
     "broadcast_idle_ttl": "TPUDASH_BROADCAST_IDLE_TTL",
     "sse_sndbuf": "TPUDASH_SSE_SNDBUF",
+    "wire_format": "TPUDASH_WIRE_FORMAT",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
     "history_backfill": "TPUDASH_HISTORY_BACKFILL",

@@ -28,16 +28,29 @@ class SummaryResult:
 class HttpSummaryClient:
     """``GET <url>/api/summary`` with If-None-Match and the parent's
     bearer token (a fleet shares one TPUDASH_AUTH_TOKEN; per-child
-    credentials would live here if ever needed)."""
+    credentials would live here if ever needed).
 
-    def __init__(self, url: str, auth_token: str = ""):
+    Opts into the TDB1 binary summary (``Accept:
+    application/x-tpudash-bin``): a child that supports it answers with
+    the raw float64 matrix (one frombuffer instead of a JSON cell parse
+    on the parent's fan-in path); a version-skewed or json-mode child
+    simply answers JSON — the Accept header also lists
+    ``application/json``, so the fallback is the child's choice, not an
+    extra round trip.  ``binary=False`` pins JSON (escape hatch)."""
+
+    def __init__(self, url: str, auth_token: str = "", binary: bool = True):
         self.base = url.rstrip("/")
         self.auth_token = auth_token
+        self.binary = binary
 
     def fetch(self, etag: "str | None", timeout: float) -> SummaryResult:
         import requests
 
+        from tpudash.app import wire
+
         headers = {"Accept-Encoding": "gzip"}
+        if self.binary:
+            headers["Accept"] = f"{wire.CONTENT_TYPE}, application/json"
         if etag:
             headers["If-None-Match"] = etag
         if self.auth_token:
@@ -52,8 +65,14 @@ class HttpSummaryClient:
             return SummaryResult(doc=None, etag=etag, not_modified=True)
         try:
             resp.raise_for_status()
-            doc = resp.json()
+            ctype = resp.headers.get("Content-Type", "")
+            if ctype.startswith(wire.CONTENT_TYPE):
+                doc = wire.decode_summary(resp.content)
+            else:
+                doc = resp.json()
         except (requests.RequestException, ValueError) as e:
+            # wire.WireError subclasses ValueError: a malformed binary
+            # doc refuses this child exactly like malformed JSON would
             raise SourceError(
                 f"summary fetch failed: HTTP {resp.status_code}: {e}"
             ) from e

@@ -20,7 +20,7 @@ from tpudash.schema import SampleBatch
 SUMMARY_V = 1
 
 
-def build_summary(service) -> dict:
+def build_summary(service, binary: bool = False) -> dict:
     """The compact fleet-rollup document one child publishes.
 
     Caller holds the service's publish lock (the server builds this in
@@ -66,10 +66,15 @@ def build_summary(service) -> dict:
     doc["keys"] = keys
     if arr is not None:
         doc["cols"] = list(cols)
-        # NaN has no JSON spelling — null round-trips
-        doc["matrix"] = [
-            [None if v != v else v for v in row] for row in arr.tolist()
-        ]
+        if binary:
+            # the TDB1 summary path ships the float64 block itself
+            # (wire.encode_summary) — no per-cell JSON materialization
+            doc["matrix"] = arr
+        else:
+            # NaN has no JSON spelling — null round-trips
+            doc["matrix"] = [
+                [None if v != v else v for v in row] for row in arr.tolist()
+            ]
         col_pos = {c: i for i, c in enumerate(cols)}
         from tpudash.normalize import block_average
 
@@ -124,10 +129,18 @@ def summary_to_batch(name: str, doc: dict) -> "SampleBatch | None":
         len(ident["chip_id"]) == len(ident["host"]) == len(matrix) == n
     ):
         raise ValueError("child summary identity/matrix lengths disagree")
-    mat = np.array(
-        [[np.nan if v is None else float(v) for v in row] for row in matrix],
-        dtype=np.float64,
-    ).reshape(n, len(cols))
+    if isinstance(matrix, np.ndarray):
+        # binary summary path (wire.decode_summary): the matrix arrives
+        # as the float64 block itself — no per-cell conversion at all
+        mat = np.asarray(matrix, dtype=np.float64).reshape(n, len(cols))
+    else:
+        mat = np.array(
+            [
+                [np.nan if v is None else float(v) for v in row]
+                for row in matrix
+            ],
+            dtype=np.float64,
+        ).reshape(n, len(cols))
     return SampleBatch(
         metrics=cols,
         slices=slices,

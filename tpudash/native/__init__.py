@@ -33,6 +33,9 @@ _LIB = os.path.join(_DIR, "libtpudash_native.so")
 
 _lib: "ctypes.CDLL | None" = None
 _tried = False
+#: why the native path is unavailable ("" while it is) — surfaced on
+#: /api/timings so a silently-Python deployment is visible, not guessed
+_reason = "not loaded yet"
 
 
 class NativeParseError(ValueError):
@@ -71,7 +74,7 @@ def _build() -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
         proc = subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
              f"-I{_DIR}", "-o", tmp, _SRC],
             capture_output=True, text=True, timeout=120,
         )
@@ -141,12 +144,38 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.td_text_free.restype = None
     lib.td_text_free.argtypes = [c_void_p]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(c_i64)
+    lib.td_gorilla_encode_ts.restype = c_i64
+    lib.td_gorilla_encode_ts.argtypes = [i64p, c_i64, u8p, c_i64]
+    lib.td_gorilla_encode_vals.restype = c_i64
+    lib.td_gorilla_encode_vals.argtypes = [
+        ctypes.POINTER(ctypes.c_double), c_i64, u8p, c_i64,
+    ]
+    lib.td_changed_rows.restype = c_i64
+    lib.td_changed_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        c_i64, c_i64, u8p,
+    ]
+    lib.td_qv_encode_block.restype = c_i64
+    lib.td_qv_encode_block.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        c_i64, u8p, c_i64,
+    ]
+    lib.td_parse_memo_stats.restype = None
+    lib.td_parse_memo_stats.argtypes = [i64p, i64p, i64p, i64p]
     return lib
 
 
 def load() -> "ctypes.CDLL | None":
-    """Load (building if needed) the native library, or None."""
-    global _lib, _tried
+    """Load (building if needed) the native library, or None.
+
+    Staleness contract: a ``frame_kernel.cc``/``series_aliases.inc``
+    newer than the cached ``libtpudash_native.so`` forces a rebuild — a
+    stale library could disagree with the Python alias table.  Every
+    failure (disabled, no compiler, failed build, failed dlopen) fails
+    SOFT to the pure-Python path and records why in :func:`status`."""
+    global _lib, _tried, _reason
     if _lib is not None:
         return _lib
     if _tried:
@@ -155,6 +184,7 @@ def load() -> "ctypes.CDLL | None":
     from tpudash.config import env_read
 
     if env_read("TPUDASH_NATIVE").strip() == "0":
+        _reason = "disabled by TPUDASH_NATIVE=0"
         return None
     _ensure_inc()
     needs_build = not os.path.exists(_LIB) or any(
@@ -162,17 +192,44 @@ def load() -> "ctypes.CDLL | None":
         for p in (_SRC, _INC)
     )
     if needs_build and not _build():
+        _reason = (
+            "build failed (source newer than library)"
+            if os.path.exists(_LIB)
+            else "build failed (no cached library)"
+        )
         return None
     try:
         _lib = _configure(ctypes.CDLL(_LIB))
     except OSError as e:
         log.warning("cannot load %s: %s", _LIB, e)
+        _reason = f"dlopen failed: {e}"
         return None
+    except AttributeError as e:
+        # a stale/foreign library missing symbols must not crash callers
+        log.warning("library %s rejected: %s", _LIB, e)
+        _lib = None
+        _reason = f"symbol mismatch: {e}"
+        return None
+    _reason = ""
     return _lib
 
 
 def is_available() -> bool:
     return load() is not None
+
+
+def status() -> dict:
+    """{available, reason} — the native tier's health, cheap enough for
+    every /api/timings response.  ``reason`` is "" when available."""
+    lib = load()
+    out: dict = {"available": lib is not None}
+    if lib is None:
+        out["reason"] = _reason
+    else:
+        stats = parse_memo_stats()
+        if stats is not None:
+            out["parse_memo"] = stats
+    return out
 
 
 def _unpack_strings(raw: bytes, size: int) -> list[str]:
@@ -201,21 +258,61 @@ def _strings(lib, handle, which: int, expect: int) -> list[str]:
 def _interned_list(lib, handle, which: int, nrows: int) -> list[str]:
     """Rebuild a per-row string list from the kernel's interned export:
     one small uniques blob + int32 codes, expanded with a single numpy
-    take — ~100x less transfer and decode work than per-row strings (a
+    take — ~100x less transfer and decode work than a per-row strings (a
     512-chip scrape has 1-2 slices and ~64 hosts)."""
+    lst, _sig = _interned_list_sig(lib, handle, which, nrows)
+    return lst
+
+
+def _interned_list_sig(lib, handle, which: int, nrows: int):
+    """(list, (codes, blob)) — the signature lets the identity arena
+    below prove two parses produced the same column without comparing
+    4k Python strings."""
     if nrows == 0:
-        return []
+        return [], (None, b"")
     codes = np.empty(nrows, dtype=np.int32)
     size = lib.td_frame_interned(
         handle, which, None, 0,
         codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     if size <= 0:
-        return [""] * nrows
+        return [""] * nrows, (codes, b"")
     buf = ctypes.create_string_buffer(size)
     lib.td_frame_interned(handle, which, buf, size, None)
-    uniq = _unpack_strings(buf.raw[:size], size)
-    return np.array(uniq, dtype=object)[codes].tolist()
+    blob = buf.raw[:size]
+    uniq = _unpack_strings(blob, size)
+    return np.array(uniq, dtype=object)[codes].tolist(), (codes, blob)
+
+
+#: identity arena: chip populations are stable across scrapes, so the
+#: per-row identity lists (slices/hosts/accels/chip_ids) of consecutive
+#: parses are almost always equal.  When the kernel's interned export
+#: proves equality (codes + uniques blob — a few numpy/bytes compares),
+#: the PREVIOUS parse's list objects are reused, which (a) skips the
+#: list rebuild and (b) lets every downstream layer (normalize's wide
+#: arena, the service's chips-grid cache) detect "population unchanged"
+#: with plain `is` checks.  Single slot; any mismatch just rebuilds.
+_IDENT_ARENA: dict = {}
+
+
+def _ident_column(lib, handle, which: int, nrows: int) -> list:
+    arena = _IDENT_ARENA
+    lst, sig = _interned_list_sig(lib, handle, which, nrows)
+    codes, blob = sig
+    prev = arena.get(which)
+    if prev is not None:
+        pcodes, pblob, plst = prev
+        if (
+            len(plst) == len(lst)
+            and pblob == blob
+            and (
+                codes is None
+                or (pcodes is not None and np.array_equal(pcodes, codes))
+            )
+        ):
+            return plst
+    arena[which] = (codes, blob, lst)
+    return lst
 
 
 def _frame_to_batch(lib, handle) -> SampleBatch:
@@ -232,12 +329,18 @@ def _frame_to_batch(lib, handle) -> SampleBatch:
             lib.td_frame_chip_ids(
                 handle, chip_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
             )
+        arena = _IDENT_ARENA
+        prev_ids = arena.get("chip_ids")
+        if prev_ids is not None and np.array_equal(prev_ids, chip_ids):
+            chip_ids = prev_ids  # reuse the object → `is` checks downstream
+        else:
+            arena["chip_ids"] = chip_ids
         return SampleBatch(
             metrics=_strings(lib, handle, 0, ncols),
-            slices=_interned_list(lib, handle, 1, nrows),
-            hosts=_interned_list(lib, handle, 2, nrows),
+            slices=_ident_column(lib, handle, 1, nrows),
+            hosts=_ident_column(lib, handle, 2, nrows),
             chip_ids=chip_ids,
-            accels=_interned_list(lib, handle, 3, nrows),
+            accels=_ident_column(lib, handle, 3, nrows),
             matrix=matrix,
             _n_samples=int(lib.td_frame_nsamples(handle)),
         )
@@ -346,6 +449,124 @@ def encode_samples(samples: list) -> str:
         return ctypes.string_at(ptr, out_len.value).decode("utf-8")
     finally:
         lib.td_text_free(ptr)
+
+
+def parse_memo_stats() -> "dict | None":
+    """Cross-parse label-set memo counters for THIS thread's parser
+    context (the steady-state parse cost signal), or None unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    e = ctypes.c_int64()
+    h = ctypes.c_int64()
+    m = ctypes.c_int64()
+    c = ctypes.c_int64()
+    lib.td_parse_memo_stats(
+        ctypes.byref(e), ctypes.byref(h), ctypes.byref(m), ctypes.byref(c)
+    )
+    return {
+        "entries": e.value,
+        "hits": h.value,
+        "misses": m.value,
+        "clears": c.value,
+    }
+
+
+def gorilla_encode_timestamps(ts_ms) -> bytes:
+    """Native delta-of-delta timestamp encode — byte-identical to
+    tsdb.gorilla.encode_timestamps (pinned by the differential fuzz)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    arr = np.ascontiguousarray(ts_ms, dtype=np.int64)
+    n = len(arr)
+    if n == 0:
+        return b""
+    cap = 16 + 10 * n  # worst case: 4-bit escape prefix + 64-bit payload
+    out = np.empty(cap, dtype=np.uint8)
+    got = lib.td_gorilla_encode_ts(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if got < 0:  # pragma: no cover - cap math above prevents this
+        raise RuntimeError("native gorilla ts encode overflow")
+    return out[:got].tobytes()
+
+
+def gorilla_encode_values(values) -> bytes:
+    """Native XOR float64 value encode — byte-identical to
+    tsdb.gorilla.encode_values (pinned by the differential fuzz)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    arr = np.ascontiguousarray(values, dtype=np.float64)
+    n = len(arr)
+    if n == 0:
+        return b""
+    cap = 16 + 10 * n  # worst case: 2+5+6 control bits + 64-bit payload
+    out = np.empty(cap, dtype=np.uint8)
+    got = lib.td_gorilla_encode_vals(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if got < 0:  # pragma: no cover - cap math above prevents this
+        raise RuntimeError("native gorilla value encode overflow")
+    return out[:got].tobytes()
+
+
+def qv_encode_block(vals: np.ndarray, prevs: np.ndarray) -> bytes:
+    """Bulk TDB1 qv-cell encode (wire-format hot loop) — byte-identical
+    to the pure-Python wire._qv cell loop over the same inputs."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    v = np.ascontiguousarray(vals, dtype=np.float64).ravel()
+    p = np.ascontiguousarray(prevs, dtype=np.float64).ravel()
+    if v.shape != p.shape:
+        raise ValueError("qv_encode_block needs equal-length arrays")
+    n = len(v)
+    if n == 0:
+        return b""
+    cap = 16 + 10 * n
+    out = np.empty(cap, dtype=np.uint8)
+    dp = ctypes.POINTER(ctypes.c_double)
+    got = lib.td_qv_encode_block(
+        v.ctypes.data_as(dp),
+        p.ctypes.data_as(dp),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if got < 0:  # pragma: no cover - cap math above prevents this
+        raise RuntimeError("native qv encode overflow")
+    return out[:got].tobytes()
+
+
+def changed_rows(prev: np.ndarray, cur: np.ndarray) -> "np.ndarray":
+    """uint8 mask of rows whose BIT PATTERN changed between two equal-
+    shape row-major float64 matrices (NaN == NaN; -0.0 != 0.0)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    p = np.ascontiguousarray(prev, dtype=np.float64)
+    c = np.ascontiguousarray(cur, dtype=np.float64)
+    if p.shape != c.shape or p.ndim != 2:
+        raise ValueError("changed_rows needs two equal-shape 2D matrices")
+    nrows, ncols = p.shape
+    mask = np.empty(nrows, dtype=np.uint8)
+    dp = ctypes.POINTER(ctypes.c_double)
+    lib.td_changed_rows(
+        p.ctypes.data_as(dp),
+        c.ctypes.data_as(dp),
+        nrows,
+        ncols,
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return mask
 
 
 def column_stats(matrix: np.ndarray, zero_excluded: "np.ndarray | None" = None):

@@ -26,9 +26,15 @@
 #include <algorithm>
 #include <limits>
 #include <numeric>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include <unistd.h>
 
 namespace {
 
@@ -132,6 +138,28 @@ struct Builder {
     trips.push_back(Trip{row, col, val});
   }
 
+
+  // Fold another builder's accumulated state in, preserving stream
+  // order (the other builder covered a LATER byte range): first-seen
+  // metric/row creation, first-host/first-accel retention, and
+  // last-write-wins duplicate cells all behave exactly as if one
+  // builder had consumed both ranges sequentially.
+  void merge_from(Builder& o) {
+    std::vector<int32_t> colmap2(o.metrics.size());
+    for (size_t i = 0; i < o.metrics.size(); ++i)
+      colmap2[i] = metric(o.metrics[i]);
+    std::vector<int32_t> rowmap(o.chips.size());
+    for (size_t r = 0; r < o.chips.size(); ++r) {
+      ChipRow& c = o.chips[r];
+      int32_t row = chip(c.slice, c.host, c.chip_id);
+      rowmap[r] = row;
+      set_accel(row, c.accel);
+    }
+    trips.reserve(trips.size() + o.trips.size());
+    for (const Trip& t : o.trips)
+      trips.push_back(Trip{rowmap[t.row], colmap2[t.col], t.val});
+  }
+
   TdFrame* finish() {
     const size_t nrows = chips.size(), ncols = metrics.size();
     std::vector<int32_t> order(nrows);
@@ -173,8 +201,99 @@ void set_err(char* err, int64_t errcap, const std::string& msg) {
   err[n] = '\0';
 }
 
+// Exact powers of ten representable without error in a double (10^0..10^22).
+const double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Fast exact decimal→double for the overwhelmingly common payload shape
+// ("93.2159", "1.50787e+10", "1000.0"): mantissa ≤ 15 digits and a net
+// decimal exponent within ±22 make one correctly-rounded multiply or
+// divide of two EXACT doubles — bit-identical to strtod — so the hot
+// path skips strtod's locale machinery and scratch-string build.  Any
+// token outside that envelope (inf/nan words, long mantissas, huge
+// exponents, hex, underscores) returns false and takes the slow path,
+// which preserves the existing Python-parity semantics untouched.
+bool fast_decimal_double(const char* s, size_t len, double* out) {
+  const char* p = s;
+  const char* end = s + len;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  uint64_t mant = 0;
+  int digits = 0;       // significant digits consumed into mant
+  int frac = 0;         // digits after the decimal point
+  bool any = false;
+  for (; p < end && *p >= '0' && *p <= '9'; ++p) {
+    any = true;
+    if (digits < 15) {
+      mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+      if (mant != 0 || digits > 0) ++digits;
+      if (mant == 0) continue;  // leading zeros are free
+    } else {
+      return false;  // too many digits for the exact envelope
+    }
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    for (; p < end && *p >= '0' && *p <= '9'; ++p) {
+      any = true;
+      if (digits < 15) {
+        mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+        if (mant != 0 || digits > 0) ++digits;
+        ++frac;
+      } else {
+        return false;
+      }
+    }
+  }
+  if (!any) return false;
+  int exp10 = 0;
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+      eneg = (*p == '-');
+      ++p;
+    }
+    if (p >= end) return false;
+    int ev = 0;
+    for (; p < end && *p >= '0' && *p <= '9'; ++p) {
+      ev = ev * 10 + (*p - '0');
+      if (ev > 400) return false;
+    }
+    exp10 = eneg ? -ev : ev;
+  }
+  if (p != end) return false;  // trailing garbage → slow path decides
+  int e = exp10 - frac;
+  double v;
+  if (e == 0) {
+    v = static_cast<double>(mant);
+  } else if (e > 0 && e <= 22) {
+    v = static_cast<double>(mant) * kPow10[e];
+    if (!std::isfinite(v)) return false;  // overflow → strtod's call
+  } else if (e < 0 && e >= -22) {
+    v = static_cast<double>(mant) / kPow10[-e];
+  } else {
+    return false;
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
 // Full-token numeric parse (Python float()/int() reject trailing garbage).
 bool parse_full_double(const char* s, size_t len, double* out) {
+  {
+    // strip the surrounding whitespace Python float() tolerates, then
+    // try the exact fast path on the bare token
+    const char* b = s;
+    const char* e = s + len;
+    while (b < e && (*b == ' ' || *b == '\t')) ++b;
+    while (e > b && (e[-1] == ' ' || e[-1] == '\t')) --e;
+    if (b < e && fast_decimal_double(b, e - b, out)) return true;
+  }
   // strtod accepts C extensions Python float() rejects — hex floats
   // ("0x1") and nan payloads ("nan(123)"); and an EMBEDDED NUL would
   // truncate strtod's c_str() view so "10\0junk" read as a clean 10.
@@ -599,6 +718,10 @@ struct JParser {
     ws();
     size_t n = json_number_len();
     if (n == 0) return fail("bad number");
+    if (fast_decimal_double(p, n, out)) {
+      p += n;
+      return true;
+    }
     std::string buf(p, n);
     char* endp = nullptr;
     double v = std::strtod(buf.c_str(), &endp);
@@ -860,6 +983,766 @@ bool parse_value_arr(JParser& jp, double* out, bool* ok) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Cross-parse label-set memo
+//
+// Chip identity labels are stable across scrapes: at a 5 s cadence the
+// SAME ~200 bytes of {"__name__": ..., "chip_id": ..., ...} arrive every
+// tick for every (chip, series) — only the value array moves.  Interning
+// parsed label sets keyed by the metric object's RAW BYTES (the design
+// Prometheus itself uses for label sets) turns the steady-state parse
+// into: scan the object's extent, hash it, memcmp-verify, emit — no
+// per-label string work at all.  Purely content-addressed: identical
+// bytes always parse identically (the parser is a pure function), so a
+// hit is exactly equivalent to re-parsing; entries are only created
+// from byte ranges that parsed successfully.  The memo is thread_local
+// (parses run GIL-released; executor threads each keep their own) and
+// self-bounded: past the byte budget it clears and rebuilds, so a
+// pathological high-churn source degrades to cold-parse speed, never
+// unbounded memory.
+// ---------------------------------------------------------------------------
+
+// Extent of one JSON value starting at '{': pointer past the matching
+// '}', or nullptr when the buffer ends first.  Tracks strings and
+// escapes exactly, so for well-formed JSON the extent equals what
+// parse_metric_obj consumes; for malformed JSON the caller falls back
+// to the real parser, which reports the error with unchanged text.
+const char* scan_json_object(const char* p, const char* end) {
+  if (p >= end || *p != '{') return nullptr;
+  int depth = 0;
+  bool in_str = false;
+  for (const char* q = p; q < end; ++q) {
+    char c = *q;
+    if (in_str) {
+      if (c == '\\') {
+        ++q;  // skip the escaped byte (may skip past end → loop exits)
+      } else if (c == '"') {
+        in_str = false;
+      }
+      continue;
+    }
+    if (c == '"') {
+      in_str = true;
+    } else if (c == '{') {
+      ++depth;
+    } else if (c == '}') {
+      if (--depth == 0) return q + 1;
+    }
+  }
+  return nullptr;
+}
+
+uint64_t span_hash(const char* p, size_t n) {
+  // fx-style word-at-a-time mix; quality is modest but every probe is
+  // memcmp-verified, so collisions cost a miss, never a wrong entry
+  const uint64_t k = 0x9E3779B97F4A7C15ull;
+  uint64_t h = 0x8422D5AB0D9A4C5Full ^ (static_cast<uint64_t>(n) * k);
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    h = (h ^ w) * k;
+    h ^= h >> 29;
+    p += 8;
+    n -= 8;
+  }
+  uint64_t tail = 0;
+  if (n) {
+    std::memcpy(&tail, p, n);
+    h = (h ^ tail) * k;
+    h ^= h >> 29;
+  }
+  return h;
+}
+
+struct ParseCtx {
+  struct Entry {
+    std::string bytes;   // the exact metric-object span, memcmp-verified
+    uint64_t hash;
+    uint8_t kind;        // 0 = skip (no name / unresolvable chip), 1 = emit
+    uint8_t slice_kind;  // 0 = explicit, 1 = accelerator_id hint, 2 = default
+    int32_t name_idx = -1;   // → names (canonical column)
+    int32_t slice_idx = -1;  // → strs
+    int32_t host_idx = -1;   // → strs (-1 = empty host)
+    int32_t accel_idx = -1;  // → strs (-1 = none)
+    int32_t next = -1;       // successor prediction (see below)
+    int64_t chip_id = 0;
+  };
+  std::vector<Entry> entries;
+  std::vector<int32_t> table;  // open addressing over entries, -1 = empty
+  size_t bytes_total = 0;
+  std::vector<std::string> names;  // canonical column names, stable indices
+  std::vector<std::string> strs;   // interned label values, stable indices
+  std::unordered_map<std::string, int32_t> name_map, str_map;
+  //: successor-chain prediction: Prometheus emits result items in a
+  //: stable order across scrapes, so the metric object FOLLOWING entry
+  //: X this parse is almost always the one that followed X last parse
+  //: (Entry.next; `first` seeds the chain).  A single memcmp against
+  //: the predicted entry's bytes verifies BOTH identity and extent at
+  //: SIMD speed — no structural scan, no hash.  Successor (rather than
+  //: positional) prediction is offset-invariant, so it keeps hitting
+  //: when items shift (chip churn, or a split-parse segment starting
+  //: mid-array).  Any mismatch falls back to scan+hash+probe and
+  //: repairs the chain.
+  int32_t first = -1;
+  int64_t hits = 0, misses = 0, clears = 0;
+
+  static constexpr size_t kByteBudget = 64u << 20;  // 64 MB of key bytes
+
+  int32_t intern_str(const std::string& s) {
+    auto it = str_map.find(s);
+    if (it != str_map.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(strs.size());
+    strs.push_back(s);
+    str_map.emplace(s, idx);
+    return idx;
+  }
+
+  int32_t intern_name(const std::string& s) {
+    auto it = name_map.find(s);
+    if (it != name_map.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(names.size());
+    names.push_back(s);
+    name_map.emplace(s, idx);
+    return idx;
+  }
+
+  void rehash(size_t want) {
+    size_t cap = 16;
+    while (cap < want * 2) cap <<= 1;
+    table.assign(cap, -1);
+    for (size_t i = 0; i < entries.size(); ++i) {
+      size_t at = entries[i].hash & (cap - 1);
+      while (table[at] >= 0) at = (at + 1) & (cap - 1);
+      table[at] = static_cast<int32_t>(i);
+    }
+  }
+
+  int32_t find(const char* p, size_t n, uint64_t h) const {
+    if (table.empty()) return -1;
+    size_t mask = table.size() - 1;
+    size_t at = h & mask;
+    while (true) {
+      int32_t idx = table[at];
+      if (idx < 0) return -1;
+      const Entry& e = entries[idx];
+      if (e.hash == h && e.bytes.size() == n &&
+          std::memcmp(e.bytes.data(), p, n) == 0)
+        return idx;
+      at = (at + 1) & mask;
+    }
+  }
+
+  int32_t insert(Entry&& e) {
+    if (bytes_total + e.bytes.size() > kByteBudget) {
+      // reset: identity churn outgrew the budget — rebuild from scratch
+      entries.clear();
+      table.clear();
+      first = -1;
+      bytes_total = 0;
+      ++clears;
+    }
+    bytes_total += e.bytes.size();
+    entries.push_back(std::move(e));
+    if (table.empty() || entries.size() * 2 > table.size())
+      rehash(entries.size() + 1);
+    size_t mask = table.size() - 1;
+    size_t at = entries.back().hash & mask;
+    while (table[at] >= 0) at = (at + 1) & mask;
+    int32_t idx = static_cast<int32_t>(entries.size() - 1);
+    table[at] = idx;
+    return idx;
+  }
+};
+
+// registry of every live thread's parser context so the memo stats
+// exported to /api/timings aggregate across executor/worker threads
+// (the event-loop thread never parses; its own context is empty)
+std::mutex& ctx_registry_mu() {
+  static std::mutex mu;
+  return mu;
+}
+
+std::vector<ParseCtx*>& ctx_registry() {
+  static std::vector<ParseCtx*> v;
+  return v;
+}
+
+//: counters of contexts whose threads already exited — folded in at
+//: unregister time so short-lived threads' parses stay visible
+struct RetiredCtxStats {
+  int64_t hits = 0, misses = 0, clears = 0;
+};
+
+RetiredCtxStats& retired_ctx_stats() {
+  static RetiredCtxStats s;
+  return s;
+}
+
+struct RegisteredCtx {
+  ParseCtx ctx;
+  RegisteredCtx() {
+    std::lock_guard<std::mutex> lk(ctx_registry_mu());
+    ctx_registry().push_back(&ctx);
+  }
+  ~RegisteredCtx() {
+    std::lock_guard<std::mutex> lk(ctx_registry_mu());
+    RetiredCtxStats& r = retired_ctx_stats();
+    r.hits += ctx.hits;
+    r.misses += ctx.misses;
+    r.clears += ctx.clears;
+    auto& v = ctx_registry();
+    v.erase(std::remove(v.begin(), v.end(), &ctx), v.end());
+  }
+};
+
+ParseCtx& parse_ctx() {
+  static thread_local RegisteredCtx holder;
+  return holder.ctx;
+}
+
+// MetricLabels → memo entry: the one place the label-selection rules
+// (chip_id/gpu_id → accelerator_id fallback, host/node/instance chain,
+// accelerator/card_model/model chain, alias canonicalization) run for a
+// given byte pattern; emission replays the stored decision.
+ParseCtx::Entry make_entry(ParseCtx& ctx, const MetricLabels& m,
+                           const char* span, size_t span_len) {
+  ParseCtx::Entry e;
+  e.bytes.assign(span, span_len);
+  e.hash = span_hash(span, span_len);
+  e.kind = 0;
+  if (m.name.empty()) return e;
+  int64_t chip_id;
+  std::string slice_hint;
+  bool have_hint = false;
+  if (m.has_chip_id || m.has_gpu_id) {
+    const std::string& chip_label = m.has_chip_id ? m.chip_id : m.gpu_id;
+    if (!parse_full_int(chip_label, &chip_id)) return e;
+  } else if (m.has_accelerator_id) {
+    if (!split_accelerator_id(m.accelerator_id, &slice_hint, &chip_id))
+      return e;
+    have_hint = !slice_hint.empty();
+  } else {
+    return e;
+  }
+  e.kind = 1;
+  e.chip_id = chip_id;
+  const std::string* canon = canonical_series(m.name);
+  e.name_idx = ctx.intern_name(canon != nullptr ? *canon : m.name);
+  if (m.has_slice) {
+    e.slice_kind = 0;
+    e.slice_idx = ctx.intern_str(m.slice);
+  } else if (have_hint) {
+    e.slice_kind = 1;
+    e.slice_idx = ctx.intern_str(slice_hint);
+  } else {
+    e.slice_kind = 2;
+  }
+  const std::string* host = nullptr;
+  if (m.has_host)
+    host = &m.host;
+  else if (m.has_node)
+    host = &m.node;
+  else if (m.has_instance)
+    host = &m.instance;
+  if (host != nullptr && !host->empty()) e.host_idx = ctx.intern_str(*host);
+  const std::string* accel = nullptr;
+  if (m.has_accel)
+    accel = &m.accel;
+  else if (m.has_card_model)
+    accel = &m.card_model;
+  else if (m.has_model)
+    accel = &m.model;
+  if (accel != nullptr && !accel->empty())
+    e.accel_idx = ctx.intern_str(*accel);
+  return e;
+}
+
+inline bool skip_ws_p(const char*& q, const char* end) {
+  while (q < end &&
+         (*q == ' ' || *q == '\t' || *q == '\n' || *q == '\r'))
+    ++q;
+  return q < end;
+}
+
+// One canonical result item, fully via the sequence-predicted memo:
+//   {"metric": <entry bytes>, "value": [<ts>, "<val>"]}
+// No per-label work, no std::string traffic — two short literal memcmps,
+// ONE memcmp over the predicted metric object (verifying identity and
+// extent at once), a strict number-token skip for the timestamp, and a
+// memchr for the value string.  Returns 1 with the sample emitted and
+// jp.p past the item's '}', or 0 with jp untouched — any deviation from
+// the canonical shape (escapes, extra keys, reordered keys, literal
+// timestamps, misprediction) falls back to the generic parser, so this
+// path can only ever COMMIT byte patterns the generic path parses
+// identically.
+int32_t try_fast_item(JParser& jp, ParseCtx& ctx, int32_t guess, Builder& b,
+                      std::vector<int32_t>& colmap,
+                      const std::string& default_slice,
+                      const std::string& kEmpty) {
+  if (guess < 0 || static_cast<size_t>(guess) >= ctx.entries.size()) return -1;
+  const char* q = jp.p;
+  const char* end = jp.end;
+  if (!skip_ws_p(q, end) || *q != '{') return -1;
+  ++q;
+  if (!skip_ws_p(q, end)) return -1;
+  if (end - q < 8 || std::memcmp(q, "\"metric\"", 8) != 0) return -1;
+  q += 8;
+  if (!skip_ws_p(q, end) || *q != ':') return -1;
+  ++q;
+  if (!skip_ws_p(q, end) || *q != '{') return -1;
+  const ParseCtx::Entry& e = ctx.entries[guess];
+  size_t glen = e.bytes.size();
+  if (glen > static_cast<size_t>(end - q) ||
+      std::memcmp(e.bytes.data(), q, glen) != 0)
+    return -1;
+  q += glen;
+  if (!skip_ws_p(q, end) || *q != ',') return -1;
+  ++q;
+  if (!skip_ws_p(q, end)) return -1;
+  if (end - q < 7 || std::memcmp(q, "\"value\"", 7) != 0) return -1;
+  q += 7;
+  if (!skip_ws_p(q, end) || *q != ':') return -1;
+  ++q;
+  if (!skip_ws_p(q, end) || *q != '[') return -1;
+  ++q;
+  if (!skip_ws_p(q, end)) return -1;
+  {
+    // strict RFC-8259 number token (the timestamp; value unused)
+    const char* t = q;
+    if (*t == '-') ++t;
+    if (t >= end) return -1;
+    if (*t == '0') {
+      ++t;
+    } else if (*t >= '1' && *t <= '9') {
+      while (t < end && *t >= '0' && *t <= '9') ++t;
+    } else {
+      return -1;
+    }
+    if (t < end && *t == '.') {
+      ++t;
+      if (t >= end || *t < '0' || *t > '9') return -1;
+      while (t < end && *t >= '0' && *t <= '9') ++t;
+    }
+    if (t < end && (*t == 'e' || *t == 'E')) {
+      ++t;
+      if (t < end && (*t == '+' || *t == '-')) ++t;
+      if (t >= end || *t < '0' || *t > '9') return -1;
+      while (t < end && *t >= '0' && *t <= '9') ++t;
+    }
+    q = t;
+  }
+  if (!skip_ws_p(q, end) || *q != ',') return -1;
+  ++q;
+  if (!skip_ws_p(q, end) || *q != '"') return -1;
+  ++q;
+  const char* vstart = q;
+  const char* vq =
+      static_cast<const char*>(memchr(q, '"', end - q));
+  if (vq == nullptr) return -1;
+  if (memchr(vstart, '\\', vq - vstart) != nullptr) return -1;  // escapes
+  q = vq + 1;
+  if (!skip_ws_p(q, end) || *q != ']') return -1;
+  ++q;
+  if (!skip_ws_p(q, end) || *q != '}') return -1;
+  ++q;
+  // commit: consume the item and emit via the entry
+  jp.p = q;
+  if (e.kind != 0) {
+    const char* s = vstart;
+    size_t n = static_cast<size_t>(vq - vstart);
+    double val;
+    if (parse_full_double(s, n, &val)) {
+      const std::string& slice =
+          e.slice_kind == 2 ? default_slice : ctx.strs[e.slice_idx];
+      const std::string& host =
+          e.host_idx >= 0 ? ctx.strs[e.host_idx] : kEmpty;
+      int32_t row = b.chip(slice, host, e.chip_id);
+      if (e.accel_idx >= 0) b.set_accel(row, ctx.strs[e.accel_idx]);
+      if (e.name_idx >= static_cast<int32_t>(colmap.size()))
+        colmap.resize(ctx.names.size(), -1);
+      int32_t col = colmap[e.name_idx];
+      if (col < 0)
+        col = colmap[e.name_idx] = b.metric(ctx.names[e.name_idx]);
+      b.add(row, col, val);
+    }
+  }
+  ++ctx.hits;
+  return guess;
+}
+
+
+// Link the successor chain: `cur` followed `prev` in this parse, so
+// predict the same order next parse (ctx.first seeds a segment).
+inline void chain_link(ParseCtx& ctx, int32_t prev, int32_t cur,
+                       bool at_start) {
+  if (prev >= 0)
+    ctx.entries[prev].next = cur;
+  else if (at_start)
+    ctx.first = cur;  // seed/repair the chain head for the next parse
+}
+
+// The result-array item loop, shared by the sequential path and both
+// halves of the split parse.  Consumes items and separators; stops
+// BEFORE the closing ']' (rc 0, caller consumes it), at an error (rc 1,
+// *errmsg set, messages identical to the sequential parser's), or —
+// when `split_point` is set — exactly AFTER consuming the separator
+// whose next item starts at split_point (rc 2, the split-validation
+// handshake: landing there proves split_point is a genuine top-level
+// item boundary, so the second half parsed concurrently from that very
+// byte is authoritative).
+int parse_result_items(JParser& jp, Builder& b,
+                       const std::string& default_slice,
+                       const char* split_point, std::string* errmsg) {
+  MetricLabels m;  // reused: clear() keeps string capacity
+  ParseCtx& ctx = parse_ctx();
+  // per-parse column cache over ctx.names indices (grown lazily: cold
+  // entries intern new names mid-parse)
+  std::vector<int32_t> colmap(ctx.names.size(), -1);
+  int32_t prev_item = -1;  // successor-chain cursor
+  bool at_start = true;    // only the parse's first item may reseed first
+  static const std::string kEmpty;
+  auto fail = [&](const char* msg) {
+    *errmsg = msg;
+    return 1;
+  };
+  while (true) {
+    // one result item — canonical items resolve entirely through the
+    // successor-predicted memo
+    int32_t pred =
+        prev_item >= 0 ? ctx.entries[prev_item].next : ctx.first;
+    int32_t hit =
+        try_fast_item(jp, ctx, pred, b, colmap, default_slice, kEmpty);
+    if (hit >= 0) {
+      chain_link(ctx, prev_item, hit, at_start);
+      at_start = false;
+      prev_item = hit;
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ',') {
+        ++jp.p;
+        if (split_point != nullptr) {
+          const char* t = jp.p;
+          while (t < jp.end &&
+                 (*t == ' ' || *t == '\t' || *t == '\n' || *t == '\r'))
+            ++t;
+          if (t == split_point) {
+            jp.p = t;
+            return 2;
+          }
+          if (t > split_point) split_point = nullptr;  // overshot: invalid
+        }
+        continue;
+      }
+      return 0;
+    }
+    if (!jp.expect('{')) return fail("malformed prometheus payload: result item");
+    double val = 0.0;
+    bool have_val = false;
+    // metric-object resolution for this item: a memo entry index, or m
+    // (m_filled) on the cold/irregular path.  -2 = duplicate "metric"
+    // keys seen → m holds the sequential parser's merge result.
+    int32_t metric_entry = -1;
+    bool m_filled = false;
+    const char* mspan = nullptr;
+    size_t mspan_len = 0;
+    if (!jp.peek('}')) {
+      std::string ikey;
+      while (true) {
+        ikey.clear();
+        if (!jp.parse_string(&ikey))
+          return fail("malformed prometheus payload");
+        if (!jp.expect(':')) return fail("malformed prometheus payload");
+        if (ikey == "metric") {
+          jp.ws();
+          if (jp.p < jp.end && *jp.p == '{') {
+            if (metric_entry == -1 && !m_filled) {
+              const char* mstart = jp.p;
+              // chain prediction first: one memcmp verifies identity
+              // AND extent (see ParseCtx)
+              if (pred >= 0 &&
+                  static_cast<size_t>(pred) < ctx.entries.size()) {
+                const ParseCtx::Entry& ge = ctx.entries[pred];
+                size_t glen = ge.bytes.size();
+                if (glen <= static_cast<size_t>(jp.end - mstart) &&
+                    std::memcmp(ge.bytes.data(), mstart, glen) == 0) {
+                  metric_entry = pred;
+                  mspan = mstart;
+                  mspan_len = glen;
+                  jp.p = mstart + glen;
+                  ++ctx.hits;
+                }
+              }
+              if (metric_entry == -1) {
+                const char* mend = scan_json_object(mstart, jp.end);
+                if (mend != nullptr) {
+                  size_t n = static_cast<size_t>(mend - mstart);
+                  uint64_t h = span_hash(mstart, n);
+                  int32_t idx = ctx.find(mstart, n, h);
+                  if (idx >= 0) {
+                    metric_entry = idx;
+                    mspan = mstart;
+                    mspan_len = n;
+                    jp.p = mend;
+                    ++ctx.hits;
+                  } else {
+                    m.clear();
+                    if (!parse_metric_obj(jp, &m))
+                      return fail("malformed prometheus payload: metric");
+                    m_filled = true;
+                    ++ctx.misses;
+                    if (jp.p == mend)
+                      metric_entry =
+                          ctx.insert(make_entry(ctx, m, mstart, n));
+                  }
+                } else {
+                  m.clear();
+                  if (!parse_metric_obj(jp, &m))
+                    return fail("malformed prometheus payload: metric");
+                  m_filled = true;
+                }
+              }
+            } else {
+              // duplicate "metric" key: reproduce the sequential
+              // parser's merge-into-m semantics; re-hydrate m from the
+              // first span if the memo consumed it (bytes previously
+              // parsed clean)
+              if (!m_filled && mspan != nullptr) {
+                JParser sub(mspan, static_cast<int64_t>(mspan_len));
+                m.clear();
+                if (!parse_metric_obj(sub, &m))
+                  return fail("malformed prometheus payload: metric");
+                m_filled = true;
+              }
+              metric_entry = -2;
+              if (!parse_metric_obj(jp, &m))
+                return fail("malformed prometheus payload: metric");
+              m_filled = true;
+            }
+          } else {
+            if (!jp.skip_value())
+              return fail("malformed prometheus payload");
+          }
+        } else if (ikey == "value") {
+          jp.ws();
+          if (jp.p < jp.end && *jp.p == '[') {
+            bool ok = false;
+            if (!parse_value_arr(jp, &val, &ok))
+              return fail("malformed prometheus payload: value");
+            have_val = ok;
+          } else {
+            if (!jp.skip_value())
+              return fail("malformed prometheus payload");
+          }
+        } else {
+          if (!jp.skip_value()) return fail("malformed prometheus payload");
+        }
+        jp.ws();
+        if (jp.p < jp.end && *jp.p == ',') {
+          ++jp.p;
+          continue;
+        }
+        if (!jp.expect('}')) return fail("malformed prometheus payload");
+        break;
+      }
+    } else {
+      ++jp.p;  // empty item object
+    }
+    // chain bookkeeping for the cold path
+    if (metric_entry >= 0) {
+      chain_link(ctx, prev_item, metric_entry, at_start);
+      prev_item = metric_entry;
+    } else {
+      prev_item = -1;  // irregular item: restart the chain
+    }
+    at_start = false;
+    // emit sample (tolerant per-series skipping)
+    do {
+      if (!have_val) break;
+      if (metric_entry >= 0) {
+        // memo path: replay the stored label decision
+        const ParseCtx::Entry& e = ctx.entries[metric_entry];
+        if (e.kind == 0) break;
+        const std::string& slice =
+            e.slice_kind == 2 ? default_slice : ctx.strs[e.slice_idx];
+        const std::string& host =
+            e.host_idx >= 0 ? ctx.strs[e.host_idx] : kEmpty;
+        int32_t row = b.chip(slice, host, e.chip_id);
+        if (e.accel_idx >= 0) b.set_accel(row, ctx.strs[e.accel_idx]);
+        if (e.name_idx >= static_cast<int32_t>(colmap.size()))
+          colmap.resize(ctx.names.size(), -1);
+        int32_t col = colmap[e.name_idx];
+        if (col < 0)
+          col = colmap[e.name_idx] = b.metric(ctx.names[e.name_idx]);
+        b.add(row, col, val);
+        break;
+      }
+      if (!m_filled || m.name.empty()) break;
+      int64_t chip_id;
+      std::string slice_hint;
+      bool have_hint = false;
+      if (m.has_chip_id || m.has_gpu_id) {
+        const std::string& chip_label = m.has_chip_id ? m.chip_id : m.gpu_id;
+        if (!parse_full_int(chip_label, &chip_id)) break;
+      } else if (m.has_accelerator_id) {
+        if (!split_accelerator_id(m.accelerator_id, &slice_hint, &chip_id))
+          break;
+        have_hint = !slice_hint.empty();
+      } else {
+        break;
+      }
+      const std::string& slice =
+          m.has_slice ? m.slice : (have_hint ? slice_hint : default_slice);
+      const std::string& host =
+          m.has_host
+              ? m.host
+              : (m.has_node ? m.node
+                            : (m.has_instance ? m.instance : kEmpty));
+      int32_t row = b.chip(slice, host, chip_id);
+      const std::string& accel =
+          m.has_accel
+              ? m.accel
+              : (m.has_card_model ? m.card_model
+                                  : (m.has_model ? m.model : kEmpty));
+      b.set_accel(row, accel);
+      b.add(row, b.col_for(m.name), val);
+    } while (false);
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      ++jp.p;
+      if (split_point != nullptr) {
+        const char* t = jp.p;
+        while (t < jp.end &&
+               (*t == ' ' || *t == '\t' || *t == '\n' || *t == '\r'))
+          ++t;
+        if (t == split_point) {
+          jp.p = t;
+          return 2;
+        }
+        if (t > split_point) split_point = nullptr;  // overshot: invalid
+      }
+      continue;
+    }
+    return 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Split parse: one persistent worker thread halves the wall-clock of
+// large payloads (the 4096-chip scrape is ~8 MB).
+//
+// Split-point DISCOVERY is a heuristic (a `},{` byte pattern near the
+// midpoint could sit inside a string); split-point VALIDATION is exact:
+// the worker's half counts only if the main thread's authoritative
+// sequential parse lands exactly on the candidate byte after consuming
+// a top-level item separator.  Any mismatch discards the worker's
+// output and the sequential result stands, so the parallel path can
+// never change WHAT is parsed — only how fast.  The worker thread is
+// persistent (its thread-local label-set memo must stay warm) and
+// lazily (re)created after fork.
+// ---------------------------------------------------------------------------
+
+const char* find_item_split(const char* begin, const char* end,
+                            const char* from) {
+  const char* mid = from;
+  const char* limit = end - 4;
+  if (mid + (1 << 20) < limit) limit = mid + (1 << 20);
+  for (const char* q = mid; q < limit;) {
+    q = static_cast<const char*>(memchr(q, '}', limit - q));
+    if (q == nullptr) return nullptr;
+    if (q[1] == ',') {
+      const char* s = q + 2;
+      while (s < end &&
+             (*s == ' ' || *s == '\t' || *s == '\n' || *s == '\r'))
+        ++s;
+      if (s < end && *s == '{') return s;
+    }
+    ++q;
+  }
+  return nullptr;
+}
+
+struct ParseWorker {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool has_job = false, done = false;
+  const char* start = nullptr;
+  const char* end = nullptr;
+  const char* split_point = nullptr;  // expected stop (next segment start)
+  const std::string* dslice = nullptr;
+  std::unique_ptr<Builder> builder;
+  int rc = 0;
+  std::string errmsg;
+  const char* stop_pos = nullptr;
+
+  void loop() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv.wait(lk, [&] { return has_job; });
+      const char* s = start;
+      const char* e = end;
+      const char* sp = split_point;
+      const std::string* d = dslice;
+      lk.unlock();
+      auto bld = std::unique_ptr<Builder>(new Builder());
+      std::string emsg;
+      JParser wjp(s, e - s);
+      int r = parse_result_items(wjp, *bld, *d, sp, &emsg);
+      lk.lock();
+      builder = std::move(bld);
+      rc = r;
+      errmsg = std::move(emsg);
+      stop_pos = wjp.p;
+      has_job = false;
+      done = true;
+      cv.notify_all();
+    }
+  }
+
+  void submit(const char* s, const char* e, const char* sp,
+              const std::string* d) {
+    std::lock_guard<std::mutex> lk(mu);
+    start = s;
+    end = e;
+    split_point = sp;
+    dslice = d;
+    rc = -1;
+    done = false;
+    has_job = true;
+    cv.notify_all();
+  }
+
+  void join_job() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+};
+
+//: persistent worker pool (thread-local memos must stay warm), lazily
+//: (re)created after fork; size scales with the host's cores, capped —
+//: the parse is memory-bandwidth-shaped well before 4 segments
+constexpr int kMaxParseWorkers = 3;
+
+std::vector<ParseWorker*>& split_workers(int want) {
+  static std::vector<ParseWorker*>* pool = nullptr;
+  static pid_t owner = 0;
+  pid_t me = getpid();
+  if (pool == nullptr || owner != me) {
+    // after fork the old worker threads do not exist in this process;
+    // leak the (tiny) stale state and start fresh
+    pool = new std::vector<ParseWorker*>();
+    owner = me;
+  }
+  while (static_cast<int>(pool->size()) < want &&
+         static_cast<int>(pool->size()) < kMaxParseWorkers) {
+    auto* w = new ParseWorker();
+    std::thread([w] { w->loop(); }).detach();
+    pool->push_back(w);
+  }
+  return *pool;
+}
+
+//: below this size a split costs more in coordination than it saves
+constexpr int64_t kSplitThreshold = 1 << 20;
+
 TdFrame* parse_promjson_impl(const char* text, int64_t len,
                              const std::string& default_slice, char* err,
                              int64_t errcap) {
@@ -903,107 +1786,85 @@ TdFrame* parse_promjson_impl(const char* text, int64_t len,
               if (jp.peek(']')) {
                 ++jp.p;
               } else {
-                MetricLabels m;  // reused: clear() keeps string capacity
-                while (true) {
-                  // one result item
-                  if (!jp.expect('{'))
-                    return bad("malformed prometheus payload: result item");
-                  m.clear();
-                  double val = 0.0;
-                  bool have_val = false;
-                  if (!jp.peek('}')) {
-                    std::string ikey;
-                    while (true) {
-                      ikey.clear();
-                      if (!jp.parse_string(&ikey))
-                        return bad("malformed prometheus payload");
-                      if (!jp.expect(':'))
-                        return bad("malformed prometheus payload");
-                      if (ikey == "metric") {
-                        jp.ws();
-                        if (jp.p < jp.end && *jp.p == '{') {
-                          if (!parse_metric_obj(jp, &m))
-                            return bad("malformed prometheus payload: metric");
-                        } else {
-                          if (!jp.skip_value())
-                            return bad("malformed prometheus payload");
-                        }
-                      } else if (ikey == "value") {
-                        jp.ws();
-                        if (jp.p < jp.end && *jp.p == '[') {
-                          bool ok = false;
-                          if (!parse_value_arr(jp, &val, &ok))
-                            return bad("malformed prometheus payload: value");
-                          have_val = ok;
-                        } else {
-                          if (!jp.skip_value())
-                            return bad("malformed prometheus payload");
-                        }
-                      } else {
-                        if (!jp.skip_value())
-                          return bad("malformed prometheus payload");
-                      }
-                      jp.ws();
-                      if (jp.p < jp.end && *jp.p == ',') {
-                        ++jp.p;
-                        continue;
-                      }
-                      if (!jp.expect('}'))
-                        return bad("malformed prometheus payload");
+                // large payloads parse as N concurrent segments; each
+                // candidate boundary is validated by the AUTHORITATIVE
+                // parse of the segment before it landing exactly there
+                // (see the split-parse block above), so the fallback on
+                // any irregularity is exact: discard from the first
+                // unconfirmed boundary and continue sequentially
+                std::vector<const char*> splits;
+                std::vector<ParseWorker*> jobs;
+                // ONE parse may drive the shared worker pool at a time:
+                // a second concurrent large parse (MultiSource children
+                // on executor threads) must not race submit/join or the
+                // pool itself — it simply parses sequentially
+                static std::mutex split_mu;
+                std::unique_lock<std::mutex> split_lk(
+                    split_mu, std::try_to_lock);
+                if (split_lk.owns_lock() &&
+                    jp.end - jp.p > kSplitThreshold) {
+                  unsigned hc = std::thread::hardware_concurrency();
+                  int nseg = hc >= 8 ? 4 : (hc >= 4 ? 3 : (hc >= 2 ? 2 : 1));
+                  int64_t span = jp.end - jp.p;
+                  for (int i = 1; i < nseg; ++i) {
+                    const char* cand = find_item_split(
+                        jp.p, jp.end, jp.p + span * i / nseg);
+                    if (cand == nullptr ||
+                        (!splits.empty() && cand <= splits.back()))
                       break;
-                    }
-                  } else {
-                    ++jp.p;  // empty item object
+                    splits.push_back(cand);
                   }
-                  // emit sample (tolerant per-series skipping)
-                  do {
-                    if (m.name.empty() || !have_val) break;
-                    int64_t chip_id;
-                    std::string slice_hint;
-                    bool have_hint = false;
-                    if (m.has_chip_id || m.has_gpu_id) {
-                      const std::string& chip_label =
-                          m.has_chip_id ? m.chip_id : m.gpu_id;
-                      if (!parse_full_int(chip_label, &chip_id)) break;
-                    } else if (m.has_accelerator_id) {
-                      if (!split_accelerator_id(m.accelerator_id, &slice_hint,
-                                                &chip_id))
-                        break;
-                      have_hint = !slice_hint.empty();
-                    } else {
-                      break;
+                  if (!splits.empty()) {
+                    std::vector<ParseWorker*>& pool =
+                        split_workers(static_cast<int>(splits.size()));
+                    size_t usable =
+                        std::min(pool.size(), splits.size());
+                    splits.resize(usable);
+                    for (size_t i = 0; i < usable; ++i) {
+                      const char* nxt =
+                          i + 1 < usable ? splits[i + 1] : nullptr;
+                      pool[i]->submit(splits[i], jp.end, nxt,
+                                      &default_slice);
+                      jobs.push_back(pool[i]);
                     }
-                    const std::string& slice =
-                        m.has_slice ? m.slice
-                                    : (have_hint ? slice_hint : default_slice);
-                    static const std::string kEmpty;
-                    const std::string& host =
-                        m.has_host
-                            ? m.host
-                            : (m.has_node
-                                   ? m.node
-                                   : (m.has_instance ? m.instance : kEmpty));
-                    int32_t row = b.chip(slice, host, chip_id);
-                    const std::string& accel =
-                        m.has_accel
-                            ? m.accel
-                            : (m.has_card_model
-                                   ? m.card_model
-                                   : (m.has_model ? m.model : kEmpty));
-                    b.set_accel(row, accel);
-                    b.add(row, b.col_for(m.name), val);
-                  } while (false);
-                  jp.ws();
-                  if (jp.p < jp.end && *jp.p == ',') {
-                    ++jp.p;
-                    continue;
                   }
-                  if (!jp.expect(']'))
-                    return bad("malformed prometheus payload");
-                  break;
                 }
-              }
-            } else {
+                std::string emsg;
+                int rc = parse_result_items(
+                    jp, b, default_slice,
+                    splits.empty() ? nullptr : splits[0], &emsg);
+                if (!jobs.empty()) {
+                  for (ParseWorker* w : jobs) w->join_job();
+                  size_t i = 0;
+                  while (rc == 2 && i < jobs.size()) {
+                    // jp stands exactly on segment i's start: that
+                    // segment's outcome is authoritative — adopt it,
+                    // error included (the sequential parse would fail
+                    // at the same position with the same message)
+                    ParseWorker* w = jobs[i];
+                    if (w->rc == 1) {
+                      for (ParseWorker* o : jobs) o->builder.reset();
+                      return bad(w->errmsg);
+                    }
+                    if (w->rc != 0 && w->rc != 2) break;  // unvalidated
+                    b.merge_from(*w->builder);
+                    jp.p = w->stop_pos;
+                    rc = w->rc == 0 ? 0 : 2;
+                    ++i;
+                  }
+                  for (ParseWorker* o : jobs) o->builder.reset();
+                  if (rc == 2) {
+                    // ran out of confirmed segments mid-array (a later
+                    // candidate was not a real boundary): continue the
+                    // sequential parse from the confirmed position
+                    rc = parse_result_items(jp, b, default_slice,
+                                            nullptr, &emsg);
+                  }
+                }
+                if (rc == 1) return bad(emsg);
+                if (!jp.expect(']'))
+                  return bad("malformed prometheus payload");
+              }            } else {
               if (!jp.skip_value()) return bad("malformed prometheus payload");
             }
             jp.ws();
@@ -1335,6 +2196,247 @@ void td_column_stats(const double* m, int64_t nrows, int64_t ncols,
     else
       zmean[c] = mean[c];
   }
+}
+
+// Cross-parse label-set memo counters (this thread's parser context) —
+// observability for /api/timings and the tests proving steady-state
+// parses actually hit the memo.
+void td_parse_memo_stats(int64_t* entries, int64_t* hits, int64_t* misses,
+                         int64_t* clears) {
+  // aggregate over EVERY thread's context: parses run on executor and
+  // split-worker threads, while this export is typically called from
+  // the event loop, whose own thread-local context never parses.
+  // Counter reads are racy-by-design (monotone stats, not control flow).
+  int64_t e = 0, h = 0, m = 0, c = 0;
+  {
+    std::lock_guard<std::mutex> lk(ctx_registry_mu());
+    for (const ParseCtx* ctx : ctx_registry()) {
+      e += static_cast<int64_t>(ctx->entries.size());
+      h += ctx->hits;
+      m += ctx->misses;
+      c += ctx->clears;
+    }
+    const RetiredCtxStats& r = retired_ctx_stats();
+    h += r.hits;
+    m += r.misses;
+    c += r.clears;
+  }
+  if (entries != nullptr) *entries = e;
+  if (hits != nullptr) *hits = h;
+  if (misses != nullptr) *misses = m;
+  if (clears != nullptr) *clears = c;
+}
+
+// ---------------------------------------------------------------------------
+// Gorilla codec — native encode hot loop (tpudash/tsdb/gorilla.py parity)
+//
+// Byte-identical to the pure-Python encoders (the differential fuzz in
+// tests/test_tsdb.py pins every output byte): delta-of-delta int64-ms
+// timestamps with mod-2^64 wrap, XOR float64 bit patterns with
+// leading/trailing-zero windows.  Decode stays in Python — it runs on
+// the query path, far off the ingest hot loop.
+// ---------------------------------------------------------------------------
+
+struct BitWriter {
+  uint8_t* out;
+  int64_t cap;
+  int64_t len = 0;   // complete bytes written
+  uint64_t acc = 0;  // pending bits (LSB-aligned, MSB-first semantics)
+  int nbits = 0;
+  bool overflow = false;
+
+  BitWriter(uint8_t* o, int64_t c) : out(o), cap(c) {}
+
+  void write(uint64_t value, int bits) {
+    // mirrors gorilla.py _BitWriter.write (MSB-first): shift in at most
+    // 56 bits at a time so acc never exceeds 64 bits, drain whole bytes
+    while (bits > 0) {
+      int take = bits > 56 ? 56 : bits;
+      uint64_t chunk = (value >> (bits - take)) & ((1ull << take) - 1);
+      acc = (acc << take) | chunk;
+      nbits += take;
+      bits -= take;
+      while (nbits >= 8) {
+        nbits -= 8;
+        if (len >= cap) {
+          overflow = true;
+          return;
+        }
+        out[len++] = static_cast<uint8_t>((acc >> nbits) & 0xFF);
+      }
+      acc &= (1ull << nbits) - 1;
+    }
+  }
+
+  int64_t finish() {
+    if (nbits > 0) {
+      if (len >= cap) {
+        overflow = true;
+        return -1;
+      }
+      out[len++] = static_cast<uint8_t>((acc << (8 - nbits)) & 0xFF);
+    }
+    return overflow ? -1 : len;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Delta-of-delta encode int64 millisecond timestamps; returns encoded
+// byte length, or -1 when `cap` is insufficient.
+int64_t td_gorilla_encode_ts(const int64_t* ts, int64_t n, uint8_t* out,
+                             int64_t cap) {
+  if (n <= 0) return 0;
+  BitWriter w(out, cap);
+  uint64_t prev = static_cast<uint64_t>(ts[0]);
+  w.write(prev, 64);
+  uint64_t prev_delta = 0;
+  for (int64_t i = 1; i < n; ++i) {
+    uint64_t t = static_cast<uint64_t>(ts[i]);
+    uint64_t delta = t - prev;  // mod 2^64, same ring as the Python codec
+    int64_t dod = static_cast<int64_t>(delta - prev_delta);  // signed fold
+    prev = t;
+    prev_delta = delta;
+    if (dod == 0) {
+      w.write(0, 1);
+      continue;
+    }
+    if (dod >= -(1ll << 13) && dod < (1ll << 13)) {
+      w.write(0b10, 2);
+      w.write(static_cast<uint64_t>(dod), 14);
+    } else if (dod >= -(1ll << 16) && dod < (1ll << 16)) {
+      w.write(0b110, 3);
+      w.write(static_cast<uint64_t>(dod), 17);
+    } else if (dod >= -(1ll << 19) && dod < (1ll << 19)) {
+      w.write(0b1110, 4);
+      w.write(static_cast<uint64_t>(dod), 20);
+    } else {
+      w.write(0b1111, 4);
+      w.write(static_cast<uint64_t>(dod), 64);
+    }
+    if (w.overflow) return -1;
+  }
+  return w.finish();
+}
+
+// XOR-encode float64 bit patterns (Gorilla §4.1.2); returns encoded byte
+// length, or -1 when `cap` is insufficient.
+int64_t td_gorilla_encode_vals(const double* values, int64_t n, uint8_t* out,
+                               int64_t cap) {
+  if (n <= 0) return 0;
+  BitWriter w(out, cap);
+  uint64_t prev_bits;
+  std::memcpy(&prev_bits, &values[0], 8);
+  w.write(prev_bits, 64);
+  int lead = -1, trail = -1;
+  for (int64_t i = 1; i < n; ++i) {
+    uint64_t bits;
+    std::memcpy(&bits, &values[i], 8);
+    uint64_t x = bits ^ prev_bits;
+    prev_bits = bits;
+    if (x == 0) {
+      w.write(0, 1);
+      continue;
+    }
+    int cur_lead = __builtin_clzll(x);
+    if (cur_lead > 31) cur_lead = 31;  // 5-bit field cap, as in Python
+    int cur_trail = __builtin_ctzll(x);
+    if (lead >= 0 && cur_lead >= lead && cur_trail >= trail) {
+      w.write(0b10, 2);
+      w.write(x >> trail, 64 - lead - trail);
+    } else {
+      lead = cur_lead;
+      trail = cur_trail;
+      int sig = 64 - lead - trail;
+      w.write(0b11, 2);
+      w.write(static_cast<uint64_t>(lead), 5);
+      w.write(static_cast<uint64_t>(sig & 0x3F), 6);
+      w.write(x >> trail, sig);
+    }
+    if (w.overflow) return -1;
+  }
+  return w.finish();
+}
+
+// Bulk "qv" cell encoder for the TDB1 binary wire format — the native
+// twin of tpudash/app/wire.py::_qv + clientlogic.qd_base, byte-exact
+// (pinned by the wire fuzz in tests/test_wire.py).  One call encodes a
+// whole heatmap grid / breakdown value stream; the pure-Python loop
+// remains the fallback when the native tier is unavailable.
+int64_t td_qv_encode_block(const double* vals, const double* prevs,
+                           int64_t n, uint8_t* out, int64_t cap) {
+  int64_t len = 0;
+  auto put = [&](uint64_t v) -> bool {  // LEB128
+    while (true) {
+      if (len >= cap) return false;
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) {
+        out[len++] = b | 0x80;
+      } else {
+        out[len++] = b;
+        return true;
+      }
+    }
+  };
+  constexpr double kLim = 4503599627370496.0;  // 2^52
+  for (int64_t i = 0; i < n; ++i) {
+    double v = vals[i];
+    if (std::isnan(v)) {
+      if (!put(4)) return -1;
+      continue;
+    }
+    if (std::isinf(v)) {
+      if (!put(v > 0 ? 2 : 3)) return -1;
+      continue;
+    }
+    bool escape = true;
+    if (v == 0.0 && std::signbit(v)) {
+      // -0.0 must survive bit-exactly; the scaled path decodes +0.0
+    } else if (std::fabs(v) < kLim / 100.0) {
+      double r = std::nearbyint(v * 100.0);  // half-even, like Python round
+      if (r > -kLim && r < kLim && r / 100.0 == v) {
+        // base: clientlogic.qd_base over the previous cell
+        double p = prevs[i];
+        int64_t base = 0;
+        double pb = std::floor(p * 100.0 + 0.5);
+        if (pb / 100.0 == p && pb < kLim && pb > -kLim)
+          base = static_cast<int64_t>(pb);
+        int64_t d = static_cast<int64_t>(r) - base;
+        if (d > -(1ll << 51) && d < (1ll << 51)) {
+          uint64_t z = (static_cast<uint64_t>(d) << 1) ^
+                       static_cast<uint64_t>(d >> 63);
+          if (!put(z + 5)) return -1;
+          escape = false;
+        }
+      }
+    }
+    if (escape) {
+      if (len + 9 > cap) return -1;
+      out[len++] = 1;
+      std::memcpy(out + len, &v, 8);
+      len += 8;
+    }
+  }
+  return len;
+}
+
+// Changed-row mask between two row-major float64 matrices of identical
+// shape: mask[r] = 1 when any cell's BIT PATTERN differs (NaN == NaN,
+// -0.0 != 0.0 — conservative, exactly what a delta encoder wants).
+// Returns the number of changed rows.
+int64_t td_changed_rows(const double* prev, const double* cur, int64_t nrows,
+                        int64_t ncols, uint8_t* mask) {
+  int64_t changed = 0;
+  size_t rowbytes = static_cast<size_t>(ncols) * sizeof(double);
+  for (int64_t r = 0; r < nrows; ++r) {
+    uint8_t c = std::memcmp(prev + r * ncols, cur + r * ncols, rowbytes) != 0;
+    mask[r] = c;
+    changed += c;
+  }
+  return changed;
 }
 
 }  // extern "C"

@@ -200,15 +200,16 @@ def _flat_positions(topo: Topology):
 
 def heatmap_grid_arrays(topo: Topology, chip_ids, values) -> list:
     """Vectorized :func:`heatmap_grid`: ``chip_ids`` (int array) and
-    ``values`` (list of native floats, same length) land on the grid in
-    two numpy ops instead of a per-cell Python loop — the per-frame cost
-    at 4,096 chips was ~12 ms of loop overhead across 96 panel grids.
-    Semantics match heatmap_grid exactly: missing chips/gap columns are
-    None, duplicate ids last-write-win, out-of-range ids raise."""
+    ``values`` (list of native floats, or a float ndarray) land on the
+    grid in two numpy ops instead of a per-cell Python loop — the
+    per-frame cost at 4,096 chips was ~12 ms of loop overhead across 96
+    panel grids.  Semantics match heatmap_grid exactly: missing chips/
+    gap columns are None, duplicate ids last-write-win, out-of-range ids
+    raise."""
     import numpy as np
 
     ny, width, cells = grid_layout(topo)
-    flat = np.full(ny * width, None, dtype=object)
+    n = ny * width
     if len(chip_ids):
         ids = np.asarray(chip_ids)
         lo, hi = int(ids.min()), int(ids.max())
@@ -217,10 +218,26 @@ def heatmap_grid_arrays(topo: Topology, chip_ids, values) -> list:
             raise ValueError(
                 f"chip_id {bad} out of range for {topo.num_chips}-chip topology"
             )
+        pos = _flat_positions(topo)[ids]
+        if len(ids) >= n:
+            # dense fast path: when the scatter provably covers EVERY
+            # cell there are no None gaps, so the grid stays a float
+            # array end to end — ndarray.tolist() of floats is ~5x the
+            # object-array path (which pays a per-cell box)
+            hit = np.zeros(n, dtype=bool)
+            hit[pos] = True
+            if hit.all():
+                flatf = np.empty(n, dtype=np.float64)
+                flatf[pos] = values
+                return flatf.reshape(ny, width).tolist()
+        flat = np.full(n, None, dtype=object)
         # assigning a LIST keeps elements native floats (an ndarray
         # source would leave np.float64 objects that break json.dumps)
-        flat[_flat_positions(topo)[ids]] = values
-    return flat.reshape(ny, width).tolist()
+        flat[pos] = (
+            values if isinstance(values, list) else np.asarray(values).tolist()
+        )
+        return flat.reshape(ny, width).tolist()
+    return np.full(n, None, dtype=object).reshape(ny, width).tolist()
 
 
 def heatmap_grid(topo: Topology, values: dict[int, float]) -> list:

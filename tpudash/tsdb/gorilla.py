@@ -99,8 +99,31 @@ def _signed(value: int, bits: int) -> int:
     return value
 
 
-def encode_timestamps(ts_ms: "list[int]") -> bytes:
-    """Delta-of-delta encode integer-millisecond timestamps.
+def encode_timestamps(ts_ms) -> bytes:
+    """Delta-of-delta encode integer-millisecond timestamps — through
+    the native kernel when available (the tsdb seal hot loop), else the
+    pure-Python encoder below.  Both emit identical bytes (differential
+    fuzz in tests/test_tsdb.py); the Python codec remains the always-
+    tested fallback and the only decoder."""
+    from tpudash import native
+
+    if native.is_available():
+        return native.gorilla_encode_timestamps(ts_ms)
+    return encode_timestamps_py(ts_ms)
+
+
+def encode_values(values) -> bytes:
+    """XOR-encode float64 values — native kernel when available, same
+    byte-exact contract as :func:`encode_timestamps`."""
+    from tpudash import native
+
+    if native.is_available():
+        return native.gorilla_encode_values(values)
+    return encode_values_py(values)
+
+
+def encode_timestamps_py(ts_ms) -> bytes:
+    """Pure-Python delta-of-delta encode (reference implementation).
 
     All delta arithmetic is mod 2^64: a delta (or delta-of-delta)
     between two extreme int64 stamps needs 65 bits as a plain integer,
@@ -157,8 +180,8 @@ def decode_timestamps(data: bytes, count: int) -> "list[int]":
     return out
 
 
-def encode_values(values) -> bytes:
-    """XOR-encode float64 values (Gorilla §4.1.2).  Accepts any iterable
+def encode_values_py(values) -> bytes:
+    """Pure-Python XOR encode (Gorilla §4.1.2).  Accepts any iterable
     of floats (numpy scalars included); bit patterns are preserved."""
     w = _BitWriter()
     pack = struct.pack

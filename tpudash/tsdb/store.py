@@ -127,8 +127,11 @@ def _encode_block(keys, cols, ts_ms, stacked) -> SealedBlock:
     n, K, C = stacked.shape
     flat = np.ascontiguousarray(stacked.reshape(n, K * C))
     ts_enc = gorilla.encode_timestamps(ts_ms)
+    # Fortran order: each series' column becomes contiguous ONCE, so the
+    # native encoder reads flat[:, i] without a per-series copy/tolist
+    series_major = np.asfortranarray(flat)
     val_enc = [
-        gorilla.encode_values(flat[:, i].tolist()) for i in range(K * C)
+        gorilla.encode_values(series_major[:, i]) for i in range(K * C)
     ]
     return SealedBlock(
         keys, cols, min(ts_ms), max(ts_ms), n, ts_enc, val_enc
