@@ -476,6 +476,17 @@ class Interp:
                     )
                 numeric = sorted((k for k in obj if _idx(k)), key=int)
                 return numeric + [k for k in obj if not _idx(k)]
+            # String — what the transpiler emits for numstr(): integers
+            # print without a decimal point (5 → "5"), matching Python's
+            # str(int(n)); the generated code only feeds it exact ints
+            if e[1] == ("name", "String"):
+                (arg,) = e[2]
+                v = self.eval(arg, scope)
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise JsError("String() on non-number")
+                if isinstance(v, float) and v.is_integer():
+                    return str(int(v))
+                return str(v)
             # Math.floor — what the transpiler emits for Python `//`
             if e[1] == ("member", ("name", "Math"), "floor"):
                 import math

@@ -185,6 +185,45 @@ def _binary_cases(frames: list) -> list:
             )
             pairs += 1
     assert pairs >= 4, "binary corpus needs real delta pairs"
+    # columnar full frames (ISSUE 11): figure-structure template decode
+    # + cfull reassembly over real frames in BOTH panel modes, so the
+    # Node job proves a real engine rebuilds chip keys (String()),
+    # interned grids, selection lists, and the full apply chain exactly
+    # — plus the garbage-refusal path (stale template → null)
+    tpl_cases = 0
+    for chips, slices, limit in ((6, 1, 16), (8, 2, 1)):
+        cfg = Config(
+            source="synthetic", synthetic_chips=chips,
+            synthetic_slices=slices, refresh_interval=0.0,
+            history_points=8, per_chip_panel_limit=limit,
+        )
+        svc = DashboardService(
+            cfg,
+            JsonReplaySource.synthetic(chips, frames=6, num_slices=slices),
+        )
+        svc.render_frame()
+        svc.state.select_all(svc.available)
+        frame = _scrub(_jr(svc.render_frame()), 7)
+        tid = f"snap-{chips}-{slices}-{limit}"
+        tpl_buf = wire.encode_template(frame, tid)
+        cf_buf = wire.encode_cfull(frame, tid)
+        _, thead, tpay = wire.split_container(tpl_buf)
+        cases.append(
+            _make_case("decode_bin_template", [thead, list(tpay)])
+        )
+        from tpudash.app import clientlogic as _cl
+
+        tpl = _jr(_cl.decode_bin_template(_jr(thead), tpay))
+        _, chead, cpay = wire.split_container(cf_buf)
+        cases.append(
+            _make_case("decode_bin_cfull", [chead, list(cpay), tpl])
+        )
+        stale = dict(tpl, _tid="a-stale-epoch")
+        cases.append(
+            _make_case("decode_bin_cfull", [chead, list(cpay), stale])
+        )
+        tpl_cases += 1
+    assert tpl_cases >= 2, "columnar corpus needs both panel modes"
     # scalar decoders over adversarial bit patterns (NaN excluded from
     # the JSON-carried expectations; it is covered by the pytest fuzz)
     rng = random.Random(20260810)

@@ -236,7 +236,7 @@ def test_decode_rejects_garbage_and_skew():
         wire.split_container(bytes(buf))
 
 
-# --- full-frame container ----------------------------------------------------
+# --- columnar full frame: template + cfull + envelope ------------------------
 
 
 def test_full_frame_roundtrip():
@@ -244,6 +244,140 @@ def test_full_frame_roundtrip():
     frame = _jr(svc.render_frame())
     buf = wire.encode_frame(frame)
     assert wire.decode_frame(buf) == frame
+
+
+def test_full_frame_roundtrip_heatmap_mode():
+    """Select-all past the panel limit → heatmaps + breakdown: the
+    interned-grid template path, reassembled exactly."""
+    cfg = Config(
+        source="synthetic", synthetic_chips=8, synthetic_slices=2,
+        refresh_interval=0.0, history_points=8, per_chip_panel_limit=1,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(8, frames=6, num_slices=2)
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = _jr(svc.render_frame())
+    assert frame["heatmaps"], "heatmap mode expected"
+    buf = wire.encode_frame(frame)
+    assert wire.decode_frame(buf) == frame
+    # the envelope must be smaller than the JSON frame once grids repeat
+    assert len(buf) < len(json.dumps(frame, separators=(",", ":")).encode())
+
+
+def test_template_cfull_roundtrip_and_reuse():
+    """One template serves every delta-chained tick after it: cfulls of
+    later frames (same structural signature) reassemble exactly against
+    the FIRST tick's template."""
+    cfg = Config(
+        source="synthetic", synthetic_chips=8, synthetic_slices=2,
+        refresh_interval=0.0, history_points=8, per_chip_panel_limit=1,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(8, frames=8, num_slices=2)
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    first = _jr(svc.render_frame())
+    tpl = wire.decode_template(wire.encode_template(first, "c-1"))
+    assert tpl["_tid"] == "c-1"
+    for _ in range(3):
+        cur = _jr(svc.render_frame())
+        assert frame_delta(first, cur) is not None, "same signature"
+        got = wire.decode_cfull(wire.encode_cfull(cur, "c-1"), tpl)
+        assert got == cur
+        # the template object itself must survive reuse (decode_cfull
+        # deep-copies): a second decode against it still works
+        assert "_tid" in tpl
+
+
+def test_cfull_refuses_wrong_template():
+    """Garbage refusal: numeric sections are never reassembled onto a
+    template with a different id — a stale template across a cohort
+    epoch must yield a loud error, not silently wrong figures."""
+    svc = _service(chips=8, slices=2)
+    frame = _jr(svc.render_frame())
+    tpl = wire.decode_template(wire.encode_template(frame, "epoch-1"))
+    buf = wire.encode_cfull(frame, "epoch-2")
+    with pytest.raises(wire.WireError):
+        wire.decode_cfull(buf, tpl)
+    # and a non-template dict refuses too
+    with pytest.raises(wire.WireError):
+        wire.decode_cfull(wire.encode_cfull(frame, "epoch-1"), {"not": "a tpl"})
+
+
+def test_template_refuses_untemplatable_frames():
+    with pytest.raises(wire.WireError):
+        wire.encode_template({"error": "source down"}, "t")
+    with pytest.raises(wire.WireError):
+        wire.encode_frame({"error": "source down"})
+
+
+def test_cfull_carries_nonstructural_extras():
+    """Fields outside the patch protocol (federation block, stale
+    marker) must ride the cfull head and land on the reconstruction —
+    a template-stale copy would freeze per-tick federation staleness."""
+    svc = _service(chips=6, slices=1)
+    frame = _jr(svc.render_frame())
+    frame["federation"] = {"children_live": 3, "staleness_s": 1.25}
+    frame["partial"] = True
+    tpl = wire.decode_template(wire.encode_template(frame, "t"))
+    got = wire.decode_cfull(wire.encode_cfull(frame, "t"), tpl)
+    assert got == frame
+    # now the extras change tick to tick while the template stays
+    frame2 = dict(frame, federation={"children_live": 2, "staleness_s": 9.0})
+    got2 = wire.decode_cfull(wire.encode_cfull(frame2, "t"), tpl)
+    assert got2["federation"] == {"children_live": 2, "staleness_s": 9.0}
+    # and an extra that DISAPPEARS must disappear from the
+    # reconstruction too (a review finding: extras baked into the
+    # template persisted stale for the whole epoch — a recovered fleet
+    # kept showing partial:true to every columnar viewer)
+    frame3 = {
+        k: v for k, v in frame.items() if k not in ("federation", "partial")
+    }
+    got3 = wire.decode_cfull(wire.encode_cfull(frame3, "t"), tpl)
+    assert got3 == frame3
+    assert "federation" not in got3 and "partial" not in got3
+
+
+def test_jsmini_decodes_template_and_cfull_identically():
+    from tpudash.app.pyjs import transpile_functions
+
+    interp = run_js(transpile_functions(clientlogic.CLIENT_FUNCTIONS))
+    cfg = Config(
+        source="synthetic", synthetic_chips=8, synthetic_slices=2,
+        refresh_interval=0.0, history_points=8, per_chip_panel_limit=1,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(8, frames=6, num_slices=2)
+    )
+    svc.render_frame()
+    svc.state.select_all(svc.available)
+    frame = _jr(svc.render_frame())
+    tpl_buf = wire.encode_template(frame, "t-9")
+    cf_buf = wire.encode_cfull(frame, "t-9")
+    _, thead, tpay = wire.split_container(tpl_buf)
+    js_tpl = interp.call(
+        "decode_bin_template", copy.deepcopy(thead), list(tpay)
+    )
+    py_tpl = clientlogic.decode_bin_template(_jr(thead), tpay)
+    assert js_tpl == py_tpl
+    _, chead, cpay = wire.split_container(cf_buf)
+    js_frame = interp.call(
+        "decode_bin_cfull", copy.deepcopy(chead), list(cpay),
+        copy.deepcopy(js_tpl),
+    )
+    assert js_frame == frame
+    # mismatched template → null, the page's refetch path
+    stale = copy.deepcopy(js_tpl)
+    stale["_tid"] = "other-epoch"
+    assert (
+        interp.call(
+            "decode_bin_cfull", copy.deepcopy(chead), list(cpay), stale
+        )
+        is None
+    )
 
 
 # --- generated-JS decoder parity (jsmini executes the shipped JS) -----------
@@ -352,7 +486,58 @@ def _server(chips=8, **cfg_kw):
     return DashboardServer(svc)
 
 
+class _BinClient:
+    """The page's binary-stream state machine, in test form: template
+    cache, cfull reassembly, delta application — exactly what the
+    generated decoders + html glue do."""
+
+    def __init__(self):
+        self.template_buf = None
+        self.tpl_id = None
+        self.frame = None
+        self.last_id = None
+        self.events = []  # (etype, eid) log in arrival order
+
+    def feed(self, etype, eid, body):
+        self.events.append((etype, eid))
+        if eid:
+            self.last_id = eid
+        body = bytes(body)
+        if etype == wire.EVT_TEMPLATE:
+            self.template_buf = body
+            _, head, _ = wire.split_container(body)
+            self.tpl_id = head["tid"]
+        elif etype == wire.EVT_FULL:
+            if body[:4] == wire.MAGIC:
+                assert self.template_buf is not None, (
+                    "columnar full arrived before its template"
+                )
+                tpl = wire.decode_template(self.template_buf)
+                self.frame = wire.decode_cfull(body, tpl)
+            else:
+                self.frame = json.loads(body)
+        elif etype == wire.EVT_DELTA:
+            delta = wire.decode_delta(body, self.frame)
+            self.frame = apply_delta(self.frame, delta)
+
+
+async def _read_bin_events(resp, client, *, gz, until):
+    d = zlib.decompressobj(16 + zlib.MAX_WBITS) if gz else None
+    buf = b""
+    async for chunk in resp.content.iter_any():
+        buf += d.decompress(chunk) if gz else chunk
+        evts, buf = wire.split_bin_events(buf)
+        for etype, eid, body in evts:
+            client.feed(etype, eid, body)
+        if until(client):
+            return
+
+
 def test_binary_stream_end_to_end():
+    """The columnar stream contract over real HTTP: template event
+    BEFORE the first full, cfull reassembly, binary deltas, then a
+    resume whose in-window ack gets a DELTA (no template, no full) and
+    a resume with a matching ?tpl= claim that skips the template."""
     from aiohttp import ClientSession, ClientTimeout
     from aiohttp.test_utils import TestServer
 
@@ -365,6 +550,7 @@ def test_binary_stream_end_to_end():
             async with ClientSession(
                 timeout=ClientTimeout(total=30), auto_decompress=False
             ) as s:
+                c = _BinClient()
                 async with s.get(
                     ts.make_url("/api/stream"),
                     params={"format": "bin"},
@@ -375,46 +561,123 @@ def test_binary_stream_end_to_end():
                         r.headers["Content-Type"]
                         == wire.STREAM_CONTENT_TYPE
                     )
-                    d = zlib.decompressobj(16 + zlib.MAX_WBITS)
-                    buf = b""
-                    last = None
-                    deltas = 0
-                    last_id = None
-                    async for chunk in r.content.iter_any():
-                        buf += d.decompress(chunk)
-                        evts, buf = wire.split_bin_events(buf)
-                        for etype, eid, body in evts:
-                            if eid:
-                                last_id = eid
-                            if etype == wire.EVT_FULL:
-                                last = json.loads(body)
-                                assert last["kind"] == "full"
-                            elif etype == wire.EVT_DELTA:
-                                delta = wire.decode_delta(bytes(body), last)
-                                last = apply_delta(last, delta)
-                                deltas += 1
-                        if deltas >= 2:
-                            break
-                    assert last is not None and last.get("error") is None
+                    await _read_bin_events(
+                        r, c, gz=True,
+                        until=lambda c: sum(
+                            1 for t, _ in c.events if t == wire.EVT_DELTA
+                        ) >= 2,
+                    )
+                types = [t for t, _ in c.events]
+                assert types[0] == wire.EVT_TEMPLATE, types
+                assert types[1] == wire.EVT_FULL
+                assert c.frame is not None and c.frame.get("error") is None
+                assert c.frame.get("chips"), "reassembled frame has chips"
                 # resume from the acked id: first event is a DELTA (the
-                # seal window covers the gap), not a full frame
+                # seal window covers the gap) — no template re-send
+                c2 = _BinClient()
+                c2.template_buf = c.template_buf
+                c2.tpl_id = c.tpl_id
+                c2.frame = c.frame
                 async with s.get(
                     ts.make_url("/api/stream"),
-                    params={"format": "bin", "last_id": last_id},
+                    params={
+                        "format": "bin",
+                        "last_id": c.last_id,
+                        "tpl": c.tpl_id,
+                    },
                     headers={"Accept-Encoding": "identity"},
                 ) as r:
-                    buf = b""
-                    got = None
-                    async for chunk in r.content.iter_any():
-                        buf += chunk
-                        evts, buf = wire.split_bin_events(buf)
-                        real = [
-                            e for e in evts if e[0] != wire.EVT_KEEPALIVE
-                        ]
-                        if real:
-                            got = real[0]
-                            break
-                    assert got is not None and got[0] == wire.EVT_DELTA
+                    await _read_bin_events(
+                        r, c2, gz=False,
+                        until=lambda c: any(
+                            t != wire.EVT_KEEPALIVE for t, _ in c.events
+                        ),
+                    )
+                first_real = next(
+                    t for t, _ in c2.events if t != wire.EVT_KEEPALIVE
+                )
+                assert first_real == wire.EVT_DELTA
+        finally:
+            await ts.close()
+
+    asyncio.run(run())
+
+
+def test_binary_stream_template_across_epochs():
+    """ISSUE 11 satellite: a client reconnecting ACROSS a cohort
+    template epoch with a stale ``?tpl=`` claim must receive a fresh
+    template before any numeric section; a matching claim skips the
+    template bytes entirely."""
+    from aiohttp import ClientSession, ClientTimeout
+    from aiohttp.test_utils import TestServer
+
+    server = _server()
+
+    async def run():
+        ts = TestServer(server.build_app())
+        await ts.start_server()
+        try:
+            async with ClientSession(
+                timeout=ClientTimeout(total=30), auto_decompress=False
+            ) as s:
+                c = _BinClient()
+                async with s.get(
+                    ts.make_url("/api/stream"),
+                    params={"format": "bin"},
+                    headers={"Accept-Encoding": "identity"},
+                ) as r:
+                    await _read_bin_events(
+                        r, c, gz=False,
+                        until=lambda c: c.frame is not None,
+                    )
+                assert c.tpl_id is not None
+                # 1) resume-with-template: stale ack (out of window) but
+                # CURRENT template claim → full frame, NO template event
+                c2 = _BinClient()
+                c2.template_buf = c.template_buf
+                c2.tpl_id = c.tpl_id
+                async with s.get(
+                    ts.make_url("/api/stream"),
+                    params={
+                        "format": "bin",
+                        "last_id": "999999-1",  # foreign cohort: full
+                        "tpl": c.tpl_id,
+                    },
+                    headers={"Accept-Encoding": "identity"},
+                ) as r:
+                    await _read_bin_events(
+                        r, c2, gz=False,
+                        until=lambda c: c.frame is not None,
+                    )
+                types2 = [
+                    t for t, _ in c2.events if t != wire.EVT_KEEPALIVE
+                ]
+                assert types2[0] == wire.EVT_FULL, types2
+                assert wire.EVT_TEMPLATE not in types2
+                assert c2.frame.get("chips")
+                # 2) stale-template reconnect (cohort epoch changed —
+                # compose restart / LRU evict-recreate shape): the claim
+                # no longer matches, so the template comes FIRST
+                c3 = _BinClient()
+                async with s.get(
+                    ts.make_url("/api/stream"),
+                    params={
+                        "format": "bin",
+                        "last_id": "999999-1",
+                        "tpl": "stale-epoch-template",
+                    },
+                    headers={"Accept-Encoding": "identity"},
+                ) as r:
+                    await _read_bin_events(
+                        r, c3, gz=False,
+                        until=lambda c: c.frame is not None,
+                    )
+                types3 = [
+                    t for t, _ in c3.events if t != wire.EVT_KEEPALIVE
+                ]
+                assert types3[0] == wire.EVT_TEMPLATE, types3
+                assert types3[1] == wire.EVT_FULL
+                assert c3.frame == c2.frame
         finally:
             await ts.close()
 

@@ -881,6 +881,178 @@ def decode_bin_sections(head, buf, prev):
     return d
 
 
+def numstr(n):
+    """Integer → its decimal string.  NOT transpiled: the transpiler
+    maps calls to ``numstr(x)`` directly onto JS ``String(x)`` (pyjs),
+    and every caller feeds it exact integers (varint/zigzag decodes),
+    where ``str(int)`` and ``String(integralNumber)`` print identically.
+    The ``int()`` guards the Python side against an integral float
+    sneaking in (str(5.0) would print "5.0"; String(5.0) prints "5")."""
+    return str(int(n))
+
+
+def zz_read(buf, pos):
+    """Zigzag varint: the signed twin of rv_read (chip-id deltas)."""
+    z = rv_read(buf, pos)
+    if z % 2 == 1:
+        return -((z + 1) // 2)
+    return z // 2
+
+
+def decode_bin_template(head, buf):
+    """Reassemble a figure-structure TEMPLATE (TDB1 kind 4) — the
+    structural half of a columnar full frame, sent once per cohort
+    template epoch.  ``head`` is the parsed container head (mutated in
+    place; callers pass a fresh parse), ``buf`` the binary sections.
+
+    The template is the frame minus everything that changes tick to
+    tick: scalar fields, z matrices, and figure values are absent and
+    arrive in each cfull/delta; the chip table, the selection, and the
+    per-slice hover-text / clickable-key / colorscale grids — interned
+    in the head so 96 panel figures share 16 slices' grids — are
+    rebuilt here.  The returned dict carries the template id under
+    ``_tid``; decode_bin_cfull refuses to reassemble against the wrong
+    template and strips the marker from the finished frame."""
+    b = head["_b"]
+    f = {}
+    hkeys = keys(head)
+    for i in range(len(hkeys)):
+        if hkeys[i] != "_b" and hkeys[i] != "tid":
+            f[hkeys[i]] = head[hkeys[i]]
+    pos = [0]
+    chips = []
+    if "ch" in b:
+        ch = b["ch"]
+        slices = ch["slices"]
+        hosts = ch["hosts"]
+        models = ch["models"]
+        prev_id = 0
+        i = 0
+        while i < ch["n"]:
+            s = slices[rv_read(buf, pos)]
+            h = hosts[rv_read(buf, pos)]
+            m = models[rv_read(buf, pos)]
+            prev_id = prev_id + zz_read(buf, pos)
+            chips.append(
+                {
+                    "key": s + "/" + numstr(prev_id),
+                    "chip_id": prev_id,
+                    "slice": s,
+                    "host": h,
+                    "model": m,
+                }
+            )
+            i = i + 1
+        # selected bitmap, 8 chips per byte, LSB first
+        base = pos[0]
+        byte = 0
+        mask = 1
+        i = 0
+        while i < len(chips):
+            if i % 8 == 0:
+                byte = buf[base + i // 8]
+                mask = 1
+            chips[i]["selected"] = (byte // mask) % 2 == 1
+            mask = mask * 2
+            i = i + 1
+        pos[0] = base + (len(chips) + 7) // 8
+        f["chips"] = chips
+        if "sel" in b:
+            # the selection list: zigzag delta-coded chip indices (a
+            # sorted selection deltas to one byte per chip; any order
+            # still round-trips exactly)
+            selected = []
+            prev = 0
+            i = 0
+            while i < b["sel"]:
+                prev = prev + zz_read(buf, pos)
+                selected.append(chips[prev]["key"])
+                i = i + 1
+            f["selected"] = selected
+    if "cg" in b:
+        # clickable-key customdata grids, interned per slice, cells
+        # indexing the chip table (0 = torus padding, k = chips[k-1])
+        grids = []
+        shapes = b["cg"]
+        g = 0
+        while g < len(shapes):
+            rows = []
+            r = 0
+            while r < shapes[g][0]:
+                row = []
+                c = 0
+                while c < shapes[g][1]:
+                    v = rv_read(buf, pos)
+                    if v == 0:
+                        row.append(None)
+                    else:
+                        row.append(chips[v - 1]["key"])
+                    c = c + 1
+                rows.append(row)
+                r = r + 1
+            grids.append(rows)
+            g = g + 1
+        b["cg_grids"] = grids
+    if "heatmaps" in f:
+        if f["heatmaps"] is not None:
+            hms = f["heatmaps"]
+            i = 0
+            while i < len(hms):
+                t = hms[i]["figure"]["data"][0]
+                if "customdata" in t:
+                    t["customdata"] = b["cg_grids"][t["customdata"]]
+                if "text" in t:
+                    t["text"] = b["tg"][t["text"]]
+                if "colorscale" in t:
+                    t["colorscale"] = b["cs"][t["colorscale"]]
+                i = i + 1
+    f["_tid"] = head["tid"]
+    return f
+
+
+def decode_bin_cfull(head, buf, tpl):
+    """One columnar FULL frame (TDB1 kind 5) reassembled onto a FRESH
+    copy of its template: the head carries every scalar field plus the
+    gauge/trend value patches verbatim, the sections carry z matrices
+    and breakdown cells (self-contained, bases 0), and ``tpl`` — which
+    the caller re-materializes per call (the page re-parses its cached
+    template text; Python deep-copies) — is mutated into the full
+    frame.  Returns None when ``tpl`` is not the template this frame
+    was encoded against (stale across a cohort epoch): reassembling
+    numeric sections onto the wrong structure would render garbage, so
+    the caller must fetch a fresh template instead."""
+    if "_tid" not in tpl:
+        return None
+    if tpl["_tid"] != head["tid"]:
+        return None
+    d = decode_bin_sections(head, buf, {})
+    del d["tid"]
+    # fields apply_delta doesn't know (federation block, stale marker,
+    # future additions) ride the cfull head verbatim and land directly
+    handled = {
+        "last_updated": 1,
+        "timings": 1,
+        "source_health": 1,
+        "alerts": 1,
+        "stragglers": 1,
+        "warnings": 1,
+        "stats": 1,
+        "breakdown": 1,
+        "unavailable_panels": 1,
+        "average": 1,
+        "device_rows": 1,
+        "heatmaps": 1,
+        "trends": 1,
+    }
+    dk = keys(d)
+    for i in range(len(dk)):
+        if dk[i] not in handled:
+            tpl[dk[i]] = d[dk[i]]
+    apply_delta(tpl, d)
+    del tpl["_tid"]
+    return tpl
+
+
 #: everything the page embeds, in dependency order
 CLIENT_FUNCTIONS = (
     patch_fig,
@@ -914,4 +1086,7 @@ CLIENT_FUNCTIONS = (
     ieee_read,
     qv_read,
     decode_bin_sections,
+    zz_read,
+    decode_bin_template,
+    decode_bin_cfull,
 )

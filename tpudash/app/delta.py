@@ -107,6 +107,41 @@ def _fig_value(figure: dict) -> dict:
     return {"value": trace["x"][0], "color": trace["marker"]["color"]}
 
 
+def frame_patch(cur: dict) -> dict:
+    """The value-only payload of ``cur``, extracted unconditionally:
+    every scalar field plus the gauge/heatmap/trend value patches.
+    ONE extraction shared by the two transports that claim the same
+    patch contract — frame_delta (anchored on prev) and the columnar
+    cfull (tpudash/app/wire.py, anchored on a figure template) — so
+    they can never silently disagree about frame content."""
+    patch: dict = {}
+    for field in SCALAR_FIELDS:
+        if field in cur:
+            patch[field] = cur[field]
+    avg = cur.get("average")
+    if avg:
+        patch["average"] = [_fig_value(f["figure"]) for f in avg["figures"]]
+    if cur.get("device_rows"):
+        patch["device_rows"] = [
+            [_fig_value(f["figure"]) for f in r["figures"]]
+            for r in cur["device_rows"]
+        ]
+    if cur.get("heatmaps"):
+        patch["heatmaps"] = [
+            h["figure"]["data"][0]["z"] for h in cur["heatmaps"]
+        ]
+    if cur.get("trends"):
+        patch["trends"] = [
+            {
+                "x": t["figure"]["data"][0]["x"],
+                "y": t["figure"]["data"][0]["y"],
+                "color": t["figure"]["data"][0]["line"]["color"],
+            }
+            for t in cur["trends"]
+        ]
+    return patch
+
+
 def frame_delta(prev: "dict | None", cur: dict) -> "dict | None":
     """Value-only patch taking ``prev`` to ``cur``, or None when the
     structure changed and only a full frame is faithful."""
@@ -115,32 +150,7 @@ def frame_delta(prev: "dict | None", cur: dict) -> "dict | None":
     sig = _signature(cur)
     if sig is None or sig != _signature(prev):
         return None
-    delta: dict = {"kind": "delta"}
-    for field in SCALAR_FIELDS:
-        if field in cur:
-            delta[field] = cur[field]
-    avg = cur.get("average")
-    if avg:
-        delta["average"] = [_fig_value(f["figure"]) for f in avg["figures"]]
-    if cur.get("device_rows"):
-        delta["device_rows"] = [
-            [_fig_value(f["figure"]) for f in r["figures"]]
-            for r in cur["device_rows"]
-        ]
-    if cur.get("heatmaps"):
-        delta["heatmaps"] = [
-            h["figure"]["data"][0]["z"] for h in cur["heatmaps"]
-        ]
-    if cur.get("trends"):
-        delta["trends"] = [
-            {
-                "x": t["figure"]["data"][0]["x"],
-                "y": t["figure"]["data"][0]["y"],
-                "color": t["figure"]["data"][0]["line"]["color"],
-            }
-            for t in cur["trends"]
-        ]
-    return delta
+    return {"kind": "delta", **frame_patch(cur)}
 
 
 def apply_delta(prev: dict, delta: dict) -> dict:

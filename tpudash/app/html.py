@@ -497,12 +497,29 @@ let lastFrame = null;
 window._binWire = true;
 let binFailed = false;
 let binAckId = null;
+// the cached figure-structure template (TDB1 kind 4): head JSON text +
+// section bytes, re-materialized FRESH per columnar full frame (each
+// cfull mutates its copy into the frame).  binTplId rides reconnect
+// URLs so a resume whose template is still current skips the bytes; a
+// stale id just means the server sends a fresh template first.
+let binTplHead = null, binTplPayload = null, binTplId = null;
+
+function parseTDB1(body, td) {
+  if (body.length < 12 || td.decode(body.subarray(0, 4)) !== 'TDB1')
+    throw new Error('bad TDB1 container');
+  const dv = new DataView(body.buffer, body.byteOffset);
+  const hlen = dv.getUint32(8, true);
+  return {kind: body[5],
+          headText: td.decode(body.subarray(12, 12 + hlen)),
+          payload: body.subarray(16 + hlen)};
+}
 
 function startBinStream() {
   let gotEvent = false;
   const base = streamUrl('/api/stream');
   const url = base + (base.indexOf('?') >= 0 ? '&' : '?') + 'format=bin' +
-    (binAckId ? '&last_id=' + encodeURIComponent(binAckId) : '');
+    (binAckId ? '&last_id=' + encodeURIComponent(binAckId) : '') +
+    (binTplId ? '&tpl=' + encodeURIComponent(binTplId) : '');
   (async () => {
     const resp = await fetch(url, {headers: authHeaders()});
     if (!resp.ok || !resp.body) throw new Error('HTTP ' + resp.status);
@@ -532,17 +549,39 @@ function startBinStream() {
         streaming = true;
         if (timer) { clearInterval(timer); timer = null; }
         if (id) binAckId = id;
-        if (etype === 1) {              // full frame, JSON body
-          lastFrame = JSON.parse(td.decode(body));
+        if (etype === 4) {              // figure template (TDB1 kind 4)
+          const t = parseTDB1(body, td);
+          binTplHead = t.headText;
+          binTplPayload = t.payload.slice();
+          binTplId = JSON.parse(t.headText).tid;
+          continue;
+        } else if (etype === 1) {       // full frame
+          if (body.length >= 4 && td.decode(body.subarray(0, 4)) === 'TDB1') {
+            // columnar cfull: numeric sections onto a FRESH copy of the
+            // cached template (decode refuses a template mismatch —
+            // never garbage — and the server always sends the matching
+            // template first, so a null here means a broken stream)
+            const c = parseTDB1(body, td);
+            let frame = null;
+            if (binTplHead !== null) {
+              const tpl = decode_bin_template(
+                JSON.parse(binTplHead), binTplPayload);
+              frame = decode_bin_cfull(
+                JSON.parse(c.headText), c.payload, tpl);
+            }
+            if (frame === null) {
+              binTplHead = binTplPayload = binTplId = null;
+              throw new Error('columnar frame without its template');
+            }
+            lastFrame = frame;
+          } else {
+            lastFrame = JSON.parse(td.decode(body));  // JSON fallback body
+          }
         } else if (etype === 2) {       // binary delta (TDB1 container)
           if (lastFrame === null) { refresh(); continue; }
-          if (body.length < 12 || td.decode(body.subarray(0, 4)) !== 'TDB1')
-            throw new Error('bad TDB1 container');
-          const bdv = new DataView(body.buffer, body.byteOffset);
-          const hlen = bdv.getUint32(8, true);
-          const head = JSON.parse(td.decode(body.subarray(12, 12 + hlen)));
-          const payload = body.subarray(16 + hlen);
-          const delta = decode_bin_sections(head, payload, lastFrame);
+          const d = parseTDB1(body, td);
+          const delta = decode_bin_sections(
+            JSON.parse(d.headText), d.payload, lastFrame);
           lastFrame = apply_delta(lastFrame, delta);
         } else {
           continue;                     // keepalive

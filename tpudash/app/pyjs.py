@@ -215,6 +215,12 @@ class _Fn:
                 # host named "10" sorts the same in tests and browsers.
                 (arg,) = node.args
                 return f"Object.keys({self.expr(arg)})"
+            if node.func.id == "numstr":
+                # integer → decimal string: String(n) on an integral JS
+                # number prints exactly what Python str(int(n)) prints
+                # (clientlogic.numstr is the Python twin, not transpiled)
+                (arg,) = node.args
+                return f"String({self.expr(arg)})"
             # calls to sibling transpiled functions pass through
             return (
                 f"{node.func.id}("

@@ -575,7 +575,16 @@ class DashboardServer:
                 return web.Response(status=304, headers=headers)
         if binary:
             loop = asyncio.get_running_loop()
-            body = await loop.run_in_executor(None, wire.encode_frame, frame)
+            try:
+                body = await loop.run_in_executor(
+                    None, wire.encode_frame, frame
+                )
+            except wire.WireError:
+                # not template-encodable (error frame): serve JSON, and
+                # without the binary validator — the representations
+                # must never share an ETag
+                headers.pop("ETag", None)
+                return _json_response(frame, headers=headers)
             return web.Response(
                 body=body, content_type=wire.CONTENT_TYPE, headers=headers
             )
@@ -795,7 +804,12 @@ class DashboardServer:
         if accepts_gzip:
             headers["Content-Encoding"] = "gzip"
         resp = web.StreamResponse(headers=headers)
-        await resp.prepare(request)
+        try:
+            await resp.prepare(request)
+        except _CLIENT_GONE:
+            # client vanished between connect and headers — a premature
+            # disconnect (constant under connect storms), never an error
+            return resp
         bound_stream_buffers(request, self.service.cfg.sse_sndbuf)
 
         # Per-event drain: aiohttp's StreamWriter awaits a real transport
@@ -818,6 +832,13 @@ class DashboardServer:
             request.headers.get("Last-Event-ID")
             or request.query.get("last_id")
         )
+        # the figure template the client CLAIMS to hold (?tpl= on
+        # reconnect).  The claim is only ever compared against the
+        # seal's current template id: a stale claim — reconnect across
+        # a cohort epoch (compose restart, LRU evict/recreate) — simply
+        # fails the comparison and the fresh template is sent BEFORE
+        # any numeric section; a matching claim skips the bytes.
+        tid_held = request.query.get("tpl") if binary else None
         write_deadline = self.overload.write_deadline
         try:
             if accepts_gzip:
@@ -832,7 +853,9 @@ class DashboardServer:
                 if not seals:
                     payloads = [keepalive_buffer(accepts_gzip, binary)]
                 else:
-                    payloads = event_buffers(seals, accepts_gzip, binary)
+                    payloads, tid_held = event_buffers(
+                        seals, accepts_gzip, binary, tid_held
+                    )
                     if any(p is None for p in payloads):
                         break  # seal lacks the negotiated encoding
                 evicted = False

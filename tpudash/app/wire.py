@@ -20,7 +20,8 @@ Byte layout (all integers little-endian)::
 
     0   4   magic  b"TDB1"
     4   1   version (1)
-    5   1   kind: 1 = delta, 2 = full frame
+    5   1   kind: 1 = delta, 3 = summary, 4 = figure template,
+              5 = cfull (columnar full), 6 = full-frame envelope
     6   2   reserved (0)
     8   4   head_len (u32)
     12  N   head: compact JSON (UTF-8)
@@ -33,13 +34,28 @@ The head is the frame/delta dict with the bulk fields removed and a
     _b.hm  = {"shapes": [[rows, cols], ...], "changed": [0|1, ...]}
     _b.bd  = [[dim, [row names...], [value columns...]], ...]
     _b.ch  = {"n": chips, "slices": [...], "hosts": [...],
-              "models": [...]}                      (kind=full only)
+              "models": [...]}                  (kind=template only)
+    _b.sel = selected count                     (kind=template only)
+    _b.tg / _b.cs = interned hover-text / colorscale grids (JSON)
+    _b.cg  = [[rows, cols], ...] customdata grid shapes (sections)
 
 Sections follow in a fixed order: changed heatmap grids (row-major
 cells), breakdown dims (per row: presence bitmask varint, chip-count
-varint, one value per present column), and for full frames the columnar
-chip table (interned slice/host/model codes, delta-coded chip ids, and
-a selected bitmap).
+varint, one value per present column); a TEMPLATE's sections are the
+columnar chip table (interned slice/host/model codes, delta-coded chip
+ids, a selected bitmap), the selection as zigzag delta-coded chip
+indices, and the customdata grids as varint chip-table references.
+
+Columnar full frames (PR 11) split a frame into the figure-structure
+TEMPLATE — everything a delta cannot change, (re)built exactly when
+``frame_delta`` returns None and sent once per cohort template epoch —
+and a per-tick CFULL carrying scalar fields verbatim plus
+self-contained z/breakdown sections, referencing its template by id.
+A cfull against the wrong template REFUSES (both ends), so numeric
+sections are never reassembled onto stale structure.  Kind 6 is the
+self-contained envelope (template + cfull concatenated) that binary
+``/api/frame`` serves.  The old kind 2 (inline-figure full frame) is
+retired; a kind-2 document refuses loudly.
 
 Every cell value is one *quantized* varint (``qv``): code 0 = null,
 1 = raw float64 escape (8 bytes), 2/3 = ±inf, 4 = NaN, and ≥5 a zigzag
@@ -59,23 +75,41 @@ import math
 import struct
 
 from tpudash.app import clientlogic
-from tpudash.app.delta import frame_delta
+from tpudash.app.delta import (
+    SCALAR_FIELDS,
+    _signature,
+    frame_delta,
+    frame_patch,
+)
 
 MAGIC = b"TDB1"
 VERSION = 1
 KIND_DELTA = 1
-KIND_FULL = 2
 KIND_SUMMARY = 3
+#: columnar full-frame trio (PR 11): the figure STRUCTURE — figure
+#: dicts, interned hover-text/customdata/colorscale grids, the columnar
+#: chip table, the selection — is a TEMPLATE sent once per cohort
+#: template epoch (kind 4); each tick's numeric sections ride a CFULL
+#: (kind 5) that references its template by id; kind 6 is the
+#: self-contained envelope (template + cfull concatenated) that
+#: ``/api/frame`` serves.  The old kind 2 (full frame with inline
+#: figure JSON) is retired — a kind-2 document now refuses loudly.
+KIND_TEMPLATE = 4
+KIND_CFULL = 5
+KIND_FULLC = 6
 
 #: negotiated content type for binary frames/deltas
 CONTENT_TYPE = "application/x-tpudash-bin"
 #: the binary stream's content type (``/api/stream?format=bin``)
 STREAM_CONTENT_TYPE = "application/x-tpudash-stream"
 
-#: binary stream event types (the SSE analog: full / delta / keepalive)
+#: binary stream event types (the SSE analog: full / delta / keepalive,
+#: plus the figure-structure template that must precede any columnar
+#: full event whose template the client does not already hold)
 EVT_FULL = 1
 EVT_DELTA = 2
 EVT_KEEPALIVE = 3
+EVT_TEMPLATE = 4
 
 
 def bin_event(etype: int, event_id: str, body: bytes) -> bytes:
@@ -329,36 +363,6 @@ def _encode_chips(frame: dict, head_b: dict, out: bytearray) -> None:
         out.append(acc)
 
 
-def _decode_chips(head_b: dict, buf: bytes, pos: list) -> list:
-    ch = head_b["ch"]
-    n = ch["n"]
-    slices, hosts, models = ch["slices"], ch["hosts"], ch["models"]
-    chips = []
-    prev_id = 0
-    rv = clientlogic.rv_read
-    for _ in range(n):
-        s = slices[rv(buf, pos)]
-        h = hosts[rv(buf, pos)]
-        m = models[rv(buf, pos)]
-        z = rv(buf, pos)
-        d = -((z + 1) // 2) if z % 2 else z // 2
-        prev_id += int(d)
-        chips.append(
-            {
-                "key": f"{s}/{prev_id}",
-                "chip_id": prev_id,
-                "slice": s,
-                "host": h,
-                "model": m,
-            }
-        )
-    base = pos[0]
-    for i, c in enumerate(chips):
-        c["selected"] = bool((buf[base + (i >> 3)] >> (i & 7)) & 1)
-    pos[0] = base + (n + 7) // 8
-    return chips
-
-
 def _container(kind: int, head: dict, payload: bytes) -> bytes:
     hb = _dumps(head, separators=(",", ":")).encode()
     return (
@@ -424,69 +428,240 @@ def decode_delta(buf: bytes, prev: "dict | None") -> dict:
     return clientlogic.decode_bin_sections(head, payload, prev or {})
 
 
-def encode_frame(frame: dict) -> bytes:
-    """Binary FULL frame (kind=2): the chip table and heatmap z grids —
-    the two scale-dominant bulk fields — go columnar/quantized; all
-    figure structure stays in the JSON head.  Self-contained: bases are
-    0 (no prev), so any consumer can decode it stand-alone."""
+#: the structural half of a frame — everything the TEMPLATE carries and
+#: the cfull must NOT re-ship (figure value patches replace the last
+#: four at apply time; every field outside this set and SCALAR_FIELDS
+#: rides the cfull head verbatim, so per-tick additions like the
+#: federation block stay current on the columnar path)
+_TEMPLATE_FIELDS = (
+    "error",
+    "use_gauge",
+    "refresh_interval",
+    "panel_specs",
+    "selected",
+    "chips",
+    "average",
+    "device_rows",
+    "heatmaps",
+    "trends",
+)
+
+
+def _intern(value, memo: dict, uniq: list) -> int:
+    """Grid interning for the template head: heatmap figures of one
+    slice share their hover-text/customdata/colorscale grids, so 96
+    panel figures reference ~16 entries instead of re-shipping ~520 KB
+    of repeated JSON.  Keyed by serialized content (live frames share
+    grid OBJECTS per slice, but JSON-domain copies do not)."""
+    key = _dumps(value)
+    idx = memo.get(key)
+    if idx is None:
+        idx = memo[key] = len(uniq)
+        uniq.append(value)
+    return idx
+
+
+def encode_template(frame: dict, tid: str) -> bytes:
+    """The figure-structure TEMPLATE (kind 4) of one frame: the exact
+    structural half a delta cannot change — sent once per cohort
+    template epoch (the template is (re)built precisely when
+    ``frame_delta`` returns None, so it is valid along every delta
+    chain that follows it).  Raises WireError on any frame shape the
+    patch protocol cannot reconstruct (error frames, unknown figure
+    types) — callers fall back to the JSON full frame."""
+    if _signature(frame) is None:
+        raise WireError("frame shape is not template-encodable")
+    # WHITELIST copy: only the structural fields the signature pins may
+    # live in the template.  Copying "everything non-scalar" would bake
+    # per-tick extras (federation block, partial/stale markers) into
+    # the epoch — and since a cfull can only add fields, an extra that
+    # later DISAPPEARS from the frame would persist stale in every
+    # reconstruction until the next structural break.  Whitelisted
+    # fields are exactly the ones apply_delta patches or the signature
+    # freezes; everything else rides each cfull verbatim.
     head = {
-        k: v for k, v in frame.items() if k not in ("chips", "heatmaps")
+        k: frame[k]
+        for k in _TEMPLATE_FIELDS
+        if k in frame and k not in ("chips", "selected", "heatmaps")
     }
+    head["tid"] = tid
     head_b: dict = {}
     out = bytearray()
-    hms = frame.get("heatmaps")
-    if hms is not None:
-        shapes = []
-        for hm in hms:
-            z = hm["figure"]["data"][0]["z"]
-            rows = len(z)
-            cols = len(z[0]) if rows else 0
-            shapes.append([rows, cols])
-            _qv_stream(
-                out,
-                [v for zr in z for v in zr],
-                [float("nan")] * (rows * cols),
-            )
-        # figures minus their z (restored at decode): the figure dicts
-        # are structure, the z matrices are the bulk
-        head_b["hm"] = {"shapes": shapes}
-        head["heatmaps"] = [
-            {
-                **hm,
-                "figure": {
-                    **hm["figure"],
-                    "data": [
-                        {**hm["figure"]["data"][0], "z": None},
-                        *hm["figure"]["data"][1:],
-                    ],
-                },
-            }
-            for hm in hms
-        ]
-    if frame.get("chips") is not None:
+    chips = frame.get("chips")
+    chip_index: dict = {}
+    if chips is None:
+        if "chips" in frame:
+            head["chips"] = None
+    else:
         _encode_chips(frame, head_b, out)
+        chip_index = {c["key"]: i for i, c in enumerate(chips)}
+        sel = frame.get("selected")
+        if sel is None:
+            if "selected" in frame:
+                head["selected"] = None
+        else:
+            # selection as zigzag delta-coded chip indices (sorted
+            # selections delta to 1 byte per chip; any order round-trips)
+            head_b["sel"] = len(sel)
+            prev = 0
+            for key in sel:
+                i = chip_index.get(key)
+                if i is None:
+                    raise WireError(f"selected key {key!r} not in chip table")
+                d = i - prev
+                _wv(out, ((d << 1) ^ (d >> 63)))
+                prev = i
+    hms = frame.get("heatmaps")
+    if hms is None:
+        if "heatmaps" in frame:
+            head["heatmaps"] = None
+    else:
+        tg: list = []
+        tg_memo: dict = {}
+        cs: list = []
+        cs_memo: dict = {}
+        cg_grids: list = []
+        cg_memo: dict = {}
+        out_hm = []
+        for hm in hms:
+            fig = hm["figure"]
+            trace = dict(fig["data"][0])
+            trace.pop("z", None)
+            if "text" in trace:
+                trace["text"] = _intern(trace["text"], tg_memo, tg)
+            if "colorscale" in trace:
+                trace["colorscale"] = _intern(
+                    trace["colorscale"], cs_memo, cs
+                )
+            if "customdata" in trace:
+                trace["customdata"] = _intern(
+                    trace["customdata"], cg_memo, cg_grids
+                )
+            out_hm.append(
+                {**hm, "figure": {**fig, "data": [trace, *fig["data"][1:]]}}
+            )
+        head["heatmaps"] = out_hm
+        head_b["tg"] = tg
+        head_b["cs"] = cs
+        if cg_grids:
+            # customdata cells are chip keys: encode each grid as varint
+            # chip-table references (0 = torus padding) — the decoder
+            # rebuilds the key strings from the columnar chip table
+            shapes = []
+            for grid in cg_grids:
+                rows = len(grid)
+                cols = len(grid[0]) if rows else 0
+                if any(len(row) != cols for row in grid):
+                    raise WireError("ragged customdata grid")
+                shapes.append([rows, cols])
+                for row in grid:
+                    for cell in row:
+                        if cell is None:
+                            _wv(out, 0)
+                            continue
+                        i = chip_index.get(cell)
+                        if i is None:
+                            raise WireError(
+                                f"customdata key {cell!r} not in chip table"
+                            )
+                        _wv(out, i + 1)
+            head_b["cg"] = shapes
     head["_b"] = head_b
-    return _container(KIND_FULL, head, bytes(out))
+    return _container(KIND_TEMPLATE, head, bytes(out))
+
+
+def encode_cfull(frame: dict, tid: str) -> bytes:
+    """The per-tick numeric half (kind 5): every scalar field and any
+    non-structural extra (federation block, stale marker) verbatim in
+    the head, gauge/trend value patches, and the z/breakdown bulk as
+    self-contained qv sections — reassembled client-side onto a fresh
+    copy of template ``tid``."""
+    if _signature(frame) is None:
+        raise WireError("frame shape is not template-encodable")
+    head = {
+        k: v
+        for k, v in frame.items()
+        if k not in _TEMPLATE_FIELDS and k != "breakdown"
+    }
+    patch = frame_patch(frame)
+    for field in ("average", "device_rows", "trends"):
+        if field in patch:
+            head[field] = patch[field]
+    head["tid"] = tid
+    head_b: dict = {}
+    out = bytearray()
+    if "heatmaps" in patch:
+        _encode_heatmaps(patch, None, head_b, out)
+    if "breakdown" in patch:
+        _encode_breakdown(patch, None, head_b, out)
+    head["_b"] = head_b
+    return _container(KIND_CFULL, head, bytes(out))
+
+
+def decode_template(buf: bytes) -> dict:
+    """Python-side template decode — a thin wrapper over the clientlogic
+    decoder (the SAME code the page runs).  The returned dict carries
+    its template id under ``_tid``."""
+    kind, head, payload = split_container(buf)
+    if kind != KIND_TEMPLATE:
+        raise WireError(f"expected a template container, got kind {kind}")
+    return clientlogic.decode_bin_template(head, payload)
+
+
+def decode_cfull(buf: bytes, template: dict) -> dict:
+    """Reassemble one columnar full frame onto a deep copy of
+    ``template`` (from decode_template).  WireError when the document
+    references a template this consumer does not hold — the garbage-
+    refusal path: numeric sections are never applied to the wrong
+    structure."""
+    import copy
+
+    kind, head, payload = split_container(buf)
+    if kind != KIND_CFULL:
+        raise WireError(f"expected a cfull container, got kind {kind}")
+    out = clientlogic.decode_bin_cfull(head, payload, copy.deepcopy(template))
+    if out is None:
+        raise WireError("cfull references a template this consumer lacks")
+    return out
+
+
+def fullc_envelope(tpl_buf: bytes, cfull_buf: bytes) -> bytes:
+    """The self-contained columnar full frame (kind 6): template and
+    cfull containers concatenated — what binary ``/api/frame`` serves
+    (workers assemble it from the seal's two halves without re-encoding
+    anything)."""
+    return _container(
+        KIND_FULLC, {"_b": {"t": len(tpl_buf)}}, tpl_buf + cfull_buf
+    )
+
+
+def encode_frame(frame: dict) -> bytes:
+    """Binary FULL frame: the self-contained columnar envelope.  The
+    figure structure, hover-text/customdata grids, chip table, and
+    selection go columnar/interned (kind 4 half); z matrices, breakdown
+    and every scalar ride the kind-5 half — at 4,096 chips the document
+    is ~6x smaller than the JSON frame.  Raises WireError on shapes the
+    patch protocol cannot reconstruct (callers fall back to JSON)."""
+    tpl = encode_template(frame, "f")
+    return fullc_envelope(tpl, encode_cfull(frame, "f"))
 
 
 def decode_frame(buf: bytes) -> dict:
     """Inverse of encode_frame."""
     kind, head, payload = split_container(buf)
-    if kind != KIND_FULL:
-        raise WireError(f"expected a full-frame container, got kind {kind}")
-    head_b = head.pop("_b", {})
-    pos = [0]
-    if "hm" in head_b:
-        qv = clientlogic.qv_read
-        for i, (rows, cols) in enumerate(head_b["hm"]["shapes"]):
-            z = [
-                [qv(payload, pos, 0) for _ in range(cols)]
-                for _ in range(rows)
-            ]
-            head["heatmaps"][i]["figure"]["data"][0]["z"] = z
-    if "ch" in head_b:
-        head["chips"] = _decode_chips(head_b, payload, pos)
-    return head
+    if kind != KIND_FULLC:
+        raise WireError(f"expected a full-frame envelope, got kind {kind}")
+    tlen = int(head["_b"]["t"])
+    template = decode_template(bytes(payload[:tlen]))
+    return decode_cfull(bytes(payload[tlen:]), template)
+
+
+def event_body(evt: bytes) -> bytes:
+    """The body slice of ONE complete framed stream event — how a
+    worker lifts the cfull/template container back out of a seal's
+    pre-framed event bytes to assemble the /api/frame envelope."""
+    idlen = evt[3]
+    return evt[8 + idlen :]
 
 
 def encode_summary(doc: dict) -> bytes:

@@ -7,6 +7,21 @@ keeps a :class:`BusMirror` — per-cohort seal windows plus the live
 session→cohort binding map — and serves SSE / ``/api/frame`` clients
 purely from it.
 
+**Zero-copy seal transport (PROTO 3):** when the platform allows it the
+publisher mmaps a :class:`SealRing` (memfd, or an unlinked file in the
+bus directory) and passes its file descriptor to every connecting
+worker in a one-shot PREAMBLE on the just-accepted socket (SCM_RIGHTS,
+before any framed message).  Seal blobs are then written ONCE into the
+ring and the per-worker messages carry 3-integer descriptors instead of
+blob bytes — publish cost stops scaling with blob size × worker count.
+Ring slots are seqlock-stamped: the writer marks a slot in-progress
+(seq 0) before the payload and stamps the allocation seq after, and a
+reader validates the stamp before AND after copying — a slot the ring
+head lapped decodes as a protocol error (reconnect + fresh snapshot),
+never as a silently torn frame.  When the ring cannot be created the
+bus runs in the original copying mode; the choice is probed at startup,
+logged, and surfaced on stats — never a silent wrong mode.
+
 Wire format (both directions): ``<u32 LE total-length>`` then a one-line
 compact-JSON header terminated by ``\\n``, then the header-declared
 binary blobs concatenated.  Every publisher→worker message carries a
@@ -26,7 +41,9 @@ Messages
 --------
 publisher → worker:
   ``hello``    {proto, pid, window}  — mirror resets all state
-  ``seal``     {cid, seq, tick, lens[6]} + blobs — one cohort tick
+  ``seal``     {cid, seq, tick, tpl, lens[12], ring?} + blobs — one
+               cohort tick; the figure-template blob pair rides along
+               exactly once per (worker, template epoch)
   ``binding``  {sid, cid}            — a session moved cohorts
   ``bindings`` {map}                 — full binding snapshot
   ``evict``    {cids}                — cohorts dropped (idle/LRU)
@@ -38,9 +55,14 @@ worker → publisher:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
+import mmap
+import os
+import socket as socketmod
 import struct
+import tempfile
 import time
 
 from tpudash.broadcast.cohort import Seal, SealWindow
@@ -49,14 +71,18 @@ log = logging.getLogger(__name__)
 
 #: bump on any incompatible wire change — a version-skewed worker must
 #: fail its handshake loudly, not misparse seals quietly
-#: (2: seals carry the TDB1 binary encodings)
-PROTO = 2
+#: (2: seals carry the TDB1 binary encodings; 3: fd-passing preamble,
+#: ring descriptors, per-seal figure-template delivery)
+PROTO = 3
 
 #: hard sanity bound on one message (a 4096-chip full frame gzips well
 #: under this; anything larger is a corrupt length prefix)
 MAX_MESSAGE = 256 * 1024 * 1024
 
-#: Seal blob order on the wire (None encodes as length -1)
+#: Seal blob order on the wire (None encodes as length -1, a ring
+#: descriptor as -2).  The template pair is LAST and conditional: sent
+#: inline/ring exactly once per (connection, template epoch), absent
+#: (-1) otherwise — the mirror re-attaches its stored copy by id.
 _SEAL_BLOBS = (
     "sse_full_raw",
     "sse_full_gz",
@@ -68,11 +94,219 @@ _SEAL_BLOBS = (
     "bin_full_gz",
     "bin_delta_raw",
     "bin_delta_gz",
+    "bin_tpl_raw",
+    "bin_tpl_gz",
 )
+
+#: blobs smaller than this stay inline even in ring mode — a 3-integer
+#: descriptor plus a seqlock round trip buys nothing on a keepalive-
+#: sized payload
+RING_MIN_BLOB = 512
+
+#: the one-shot connection preamble: magic, mode (1 = ring fd follows
+#: as SCM_RIGHTS ancillary data, 0 = copying bus), ring byte size
+_PREAMBLE = struct.Struct("<4sBQ")
+_PREAMBLE_MAGIC = b"TDRP"
 
 
 class BusProtocolError(Exception):
     """Framing/sequencing violation — the connection must be dropped."""
+
+
+class RingUnavailable(Exception):
+    """The shm seal ring cannot be created/attached here — the bus runs
+    in copying mode, with this reason on its stats."""
+
+
+class SealRing:
+    """Single-writer mmap'd blob ring shared compose → workers.
+
+    Slot layout (8-aligned): ``u64 alloc_seq | u32 size | u32 magic``
+    then the payload.  Seqlock discipline — the writer stamps seq 0
+    before touching the payload and the real allocation seq after; a
+    reader validates (seq, size, magic) before copying and re-validates
+    seq after, so an overwritten slot is a detected miss, never a torn
+    blob.  The allocator is a bump pointer that wraps to 0 when the
+    tail can't fit a slot; sizing the ring (TPUDASH_SHM_RING_MB) to a
+    few seconds of seal traffic keeps laps away from live readers, and
+    a lapped reader resyncs via the normal reconnect-snapshot path."""
+
+    HEADER = 16
+    SLOT_MAGIC = 0x31524454  # "TDR1" little-endian
+
+    def __init__(self, size: int, fd: int, mm, writable: bool):
+        self.size = size
+        self.fd = fd
+        self._mm = mm
+        self.writable = writable
+        self.head = 0
+        self.alloc_seq = 0
+        self.counters = {
+            "allocs": 0,
+            "wraps": 0,
+            "bytes_written": 0,
+            "reads": 0,
+            "read_misses": 0,
+            "oversize": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def create(cls, size_mb: int, dir_hint: "str | None" = None) -> "SealRing":
+        """Writer-side ring: a memfd when the platform has one, else an
+        unlinked temp file near the bus sockets.  Probes a write/read
+        round trip before declaring the ring usable; ANY failure raises
+        RingUnavailable with the reason (the bus then copies)."""
+        size = int(size_mb) << 20
+        if size <= cls.HEADER + 8:
+            raise RingUnavailable(f"ring size {size_mb}MB too small")
+        fd = -1
+        try:
+            if hasattr(os, "memfd_create"):
+                fd = os.memfd_create("tpudash-seal-ring")
+            else:
+                tmp = tempfile.TemporaryFile(dir=dir_hint or None)
+                fd = os.dup(tmp.fileno())
+                tmp.close()
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+        except (OSError, ValueError) as e:
+            if fd >= 0:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
+            raise RingUnavailable(f"cannot create shm ring: {e}") from e
+        ring = cls(size, fd, mm, writable=True)
+        probe = b"tpudash-ring-probe"
+        ref = ring.write(probe)
+        if ref is None or ring.read(*ref) != probe:
+            ring.close()
+            raise RingUnavailable("ring write/read probe failed")
+        return ring
+
+    @classmethod
+    def attach(cls, fd: int, size: int) -> "SealRing":
+        """Reader-side ring from a preamble-passed fd (read-only map;
+        the fd is closed once mapped — the mapping keeps it alive)."""
+        try:
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        except (OSError, ValueError) as e:
+            raise RingUnavailable(f"cannot map ring fd: {e}") from e
+        finally:
+            with contextlib.suppress(OSError):
+                os.close(fd)
+        return cls(size, -1, mm, writable=False)
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError, ValueError):
+            self._mm.close()
+        if self.fd >= 0:
+            with contextlib.suppress(OSError):
+                os.close(self.fd)
+            self.fd = -1
+
+    # -- writer --------------------------------------------------------------
+    def write(self, blob: bytes) -> "tuple[int, int, int] | None":
+        """Append one blob; returns its ``(offset, length, seq)``
+        descriptor, or None when the blob can never fit (caller sends
+        it inline).  Writer-side only; called from one event loop."""
+        need = (self.HEADER + len(blob) + 7) & ~7
+        if need > self.size:
+            self.counters["oversize"] += 1
+            return None
+        if self.head + need > self.size:
+            self.head = 0
+            self.counters["wraps"] += 1
+        off = self.head
+        self.alloc_seq += 1
+        seq = self.alloc_seq
+        mm = self._mm
+        # seqlock: mark in-progress, write payload, stamp the real seq
+        struct.pack_into("<QII", mm, off, 0, len(blob), self.SLOT_MAGIC)
+        mm[off + self.HEADER : off + self.HEADER + len(blob)] = blob
+        struct.pack_into("<Q", mm, off, seq)
+        self.head = off + need
+        self.counters["allocs"] += 1
+        self.counters["bytes_written"] += len(blob)
+        return (off, len(blob), seq)
+
+    # -- reader --------------------------------------------------------------
+    def read(self, off: int, length: int, seq: int) -> "bytes | None":
+        """Copy one descriptor's blob out of the ring, seqlock-checked:
+        None when the slot was lapped/overwritten (the caller treats it
+        as a protocol error and resyncs)."""
+        self.counters["reads"] += 1
+        if off < 0 or length < 0 or off + self.HEADER + length > self.size:
+            self.counters["read_misses"] += 1
+            return None
+        mm = self._mm
+        seq1, size, magic = struct.unpack_from("<QII", mm, off)
+        if seq1 != seq or size != length or magic != self.SLOT_MAGIC:
+            self.counters["read_misses"] += 1
+            return None
+        data = bytes(mm[off + self.HEADER : off + self.HEADER + length])
+        (seq2,) = struct.unpack_from("<Q", mm, off)
+        if seq2 != seq:
+            self.counters["read_misses"] += 1
+            return None
+        return data
+
+    def stats(self) -> dict:
+        return {
+            "size": self.size,
+            "head": self.head,
+            "occupancy": round(self.head / self.size, 3) if self.size else 0,
+            "counters": dict(self.counters),
+        }
+
+
+def send_preamble(sock, ring: "SealRing | None") -> None:
+    """Publisher side of the connection preamble (blocking — run in an
+    executor): the mode byte plus, in ring mode, the ring fd as
+    SCM_RIGHTS ancillary data riding the preamble bytes themselves, so
+    it is on the wire before any framed message."""
+    payload = _PREAMBLE.pack(
+        _PREAMBLE_MAGIC,
+        1 if ring is not None else 0,
+        ring.size if ring is not None else 0,
+    )
+    sock.setblocking(True)
+    try:
+        sock.settimeout(10.0)
+        if ring is not None:
+            socketmod.send_fds(sock, [payload], [ring.fd])
+        else:
+            sock.sendall(payload)
+    finally:
+        sock.setblocking(False)
+
+
+def recv_preamble(sock) -> "tuple[int, int, int | None]":
+    """Worker side: ``(mode, ring_size, fd | None)`` (blocking — run in
+    an executor).  Raises BusProtocolError on garbage."""
+    sock.setblocking(True)
+    try:
+        sock.settimeout(10.0)
+        data, fds, _flags, _addr = socketmod.recv_fds(
+            sock, _PREAMBLE.size, 4
+        )
+        while len(data) < _PREAMBLE.size:
+            more = sock.recv(_PREAMBLE.size - len(data))
+            if not more:
+                raise BusProtocolError("EOF inside bus preamble")
+            data += more
+        magic, mode, size = _PREAMBLE.unpack(data)
+        if magic != _PREAMBLE_MAGIC:
+            for fd in fds:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
+            raise BusProtocolError("bad bus preamble magic")
+        fd = fds[0] if fds else None
+        for extra in fds[1:]:
+            with contextlib.suppress(OSError):
+                os.close(extra)
+        return int(mode), int(size), fd
+    finally:
+        sock.setblocking(False)
 
 
 def _dumps(obj) -> bytes:
@@ -84,13 +318,30 @@ def encode_message(header: dict, blobs: "tuple[bytes, ...]" = ()) -> bytes:
     return struct.pack("<I", len(body)) + body
 
 
-def encode_seal(seal: Seal, n: int) -> bytes:
+def encode_seal(
+    seal: Seal,
+    n: int,
+    include_tpl: bool = False,
+    refs: "dict[int, tuple] | None" = None,
+) -> bytes:
+    """One seal message.  ``refs`` maps blob index → ring descriptor
+    (the publisher pre-writes each blob to the ring ONCE per publish
+    and shares the descriptors across every worker's message);
+    ``include_tpl`` ships the figure-template blob pair to connections
+    that have not seen this (cid, template) yet."""
     blobs = []
     lens = []
-    for name in _SEAL_BLOBS:
+    ring_refs: dict = {}
+    for i, name in enumerate(_SEAL_BLOBS):
+        if name.startswith("bin_tpl") and not include_tpl:
+            lens.append(-1)
+            continue
         blob = getattr(seal, name)
         if blob is None:
             lens.append(-1)
+        elif refs is not None and i in refs:
+            lens.append(-2)
+            ring_refs[str(i)] = list(refs[i])
         else:
             lens.append(len(blob))
             blobs.append(blob)
@@ -100,30 +351,58 @@ def encode_seal(seal: Seal, n: int) -> bytes:
         "cid": seal.cid,
         "seq": seal.seq,
         "tick": list(seal.tick_key),
+        "tpl": seal.tpl_id,
         "lens": lens,
     }
+    if ring_refs:
+        header["ring"] = ring_refs
     return encode_message(header, tuple(blobs))
 
 
-def decode_seal(header: dict, body: bytes) -> Seal:
+def decode_seal(
+    header: dict, body: bytes, ring: "SealRing | None" = None
+) -> Seal:
     lens = header["lens"]
+    ring_refs = header.get("ring") or {}
     blobs: list = []
     off = 0
-    for ln in lens:
-        if ln < 0:
+    for i, ln in enumerate(lens):
+        if ln == -1:
             blobs.append(None)
             continue
+        if ln == -2:
+            if ring is None:
+                raise BusProtocolError(
+                    "ring descriptor on a connection without a ring"
+                )
+            ref = ring_refs.get(str(i))
+            if not isinstance(ref, list) or len(ref) != 3:
+                raise BusProtocolError(f"malformed ring descriptor for {i}")
+            data = ring.read(int(ref[0]), int(ref[1]), int(ref[2]))
+            if data is None:
+                raise BusProtocolError(
+                    f"ring slot for blob {i} was overwritten (reader lapped)"
+                )
+            blobs.append(data)
+            continue
+        if ln < 0:
+            raise BusProtocolError(f"bad blob length {ln}")
         blobs.append(body[off : off + ln])
         off += ln
     if off != len(body):
         raise BusProtocolError(
             f"seal blob lengths {lens} disagree with body size {len(body)}"
         )
+    while len(blobs) < len(_SEAL_BLOBS):
+        blobs.append(None)
     return Seal(
         int(header["cid"]),
         int(header["seq"]),
         tuple(header["tick"]),
-        *blobs,
+        *blobs[:10],
+        tpl_id=header.get("tpl"),
+        bin_tpl_raw=blobs[10],
+        bin_tpl_gz=blobs[11],
     )
 
 
@@ -159,10 +438,33 @@ class _WorkerConn:
         self.sent = 0
         self.connected_at = clock()
         self.closing = False
+        #: (cid, template id) pairs this connection already received —
+        #: the figure-template blob pair ships once per epoch per
+        #: worker, not once per seal.  Bounded: cleared past the cap
+        #: (a re-send is a few hundred KB of waste, never corruption).
+        self.sent_tpls: set = set()
 
     def next_n(self) -> int:
         self.n += 1
         return self.n
+
+    def tpl_pending(self, seal: Seal) -> bool:
+        """Does this connection still lack the seal's template?  A pure
+        peek — publish_seal uses it to decide whether the template
+        blobs need a ring slot at all this tick."""
+        return (
+            seal.tpl_id is not None
+            and seal.bin_tpl_raw is not None
+            and (seal.cid, seal.tpl_id) not in self.sent_tpls
+        )
+
+    def tpl_needed(self, seal: Seal) -> bool:
+        if not self.tpl_pending(seal):
+            return False
+        if len(self.sent_tpls) > 4096:
+            self.sent_tpls.clear()
+        self.sent_tpls.add((seal.cid, seal.tpl_id))
+        return True
 
 
 class BusPublisher:
@@ -181,6 +483,7 @@ class BusPublisher:
         backlog: int = 256,
         on_active=None,
         clock=time.monotonic,
+        ring_mb: int = 0,
     ):
         self.path = path
         self.hub = hub
@@ -188,35 +491,71 @@ class BusPublisher:
         #: callback(cids) — worker liveness pings keep cohorts warm
         self.on_active = on_active
         self._clock = clock
-        self._server: "asyncio.AbstractServer | None" = None
+        self._sock: "socketmod.socket | None" = None
         self._conns: "list[_WorkerConn]" = []
         #: sid → cid, the compose process's authoritative copy of the
         #: session→cohort map (snapshots seed reconnecting mirrors)
         self.bindings: "dict[str, int]" = {}
         self._tasks: "set[asyncio.Task]" = set()
+        #: requested shm ring size (MB); 0 = copying bus by operator
+        #: choice.  The PROBED outcome lands in .ring/.ring_reason.
+        self.ring_mb = int(ring_mb)
+        self.ring: "SealRing | None" = None
+        self.ring_reason: "str | None" = None
         self.counters = {
             "seals_published": 0,
             "bindings_published": 0,
             "worker_connects": 0,
             "worker_overflows": 0,
             "worker_disconnects": 0,
+            "fds_passed": 0,
+            "blob_bytes_published": 0,
+            "desc_bytes_published": 0,
+            "templates_published": 0,
         }
 
     async def start(self) -> None:
-        self._server = await asyncio.start_unix_server(
-            self._on_connect, path=self.path
-        )
+        if self.ring_mb > 0:
+            # preflight the ring HERE, before any worker connects: the
+            # mode every connection will run in is decided once, probed
+            # with a real write/read round trip, and recorded — a
+            # platform without memfd/mmap degrades to the copying bus
+            # loudly (stats + log), never silently to a wrong mode
+            try:
+                self.ring = SealRing.create(
+                    self.ring_mb, os.path.dirname(self.path) or None
+                )
+            except RingUnavailable as e:
+                self.ring = None
+                self.ring_reason = str(e)
+                log.warning(
+                    "shm seal ring unavailable (%s); bus runs in copying "
+                    "mode",
+                    e,
+                )
+        else:
+            self.ring_reason = "disabled (TPUDASH_SHM_RING_MB=0)"
+        sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        sock.bind(self.path)
+        sock.listen(128)
+        sock.setblocking(False)
+        self._sock = sock
+        self._track(self._accept_loop())
 
     async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
         for conn in list(self._conns):
             self._drop(conn)
         for task in list(self._tasks):
             task.cancel()
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._sock is not None:
+            with contextlib.suppress(OSError):
+                self._sock.close()
+            self._sock = None
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
 
     # -- connection lifecycle ------------------------------------------------
     def _track(self, coro) -> None:
@@ -224,7 +563,42 @@ class BusPublisher:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
-    async def _on_connect(
+    async def _accept_loop(self) -> None:
+        """Raw accept loop (instead of start_unix_server) so the ring-fd
+        preamble goes out on the naked socket BEFORE asyncio stream
+        framing owns it — SCM_RIGHTS must ride a plain sendmsg.  A
+        transient accept failure (EMFILE under an fd storm) pauses and
+        RESUMES — start_unix_server did the same, and a silently-dead
+        accept loop would strand every worker until a compose restart."""
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                sock, _addr = await loop.sock_accept(self._sock)
+            except asyncio.CancelledError:
+                return
+            except OSError as e:
+                if self._sock is None:
+                    return  # close() tore the socket down
+                log.warning("bus accept failed (%s); retrying in 1s", e)
+                await asyncio.sleep(1.0)
+                continue
+            self._track(self._handshake(sock))
+
+    async def _handshake(self, sock) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, send_preamble, sock, self.ring)
+        except OSError as e:
+            log.warning("bus preamble send failed: %s", e)
+            with contextlib.suppress(OSError):
+                sock.close()
+            return
+        if self.ring is not None:
+            self.counters["fds_passed"] += 1
+        reader, writer = await asyncio.open_unix_connection(sock=sock)
+        self._on_connect(reader, writer)
+
+    def _on_connect(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn = _WorkerConn(writer, self._clock)
@@ -243,9 +617,19 @@ class BusPublisher:
                 }
             )
         )
+        # snapshot seals go INLINE, never through the ring: the whole
+        # window is enqueued before the drain task sends a byte, so a
+        # window larger than the ring would lap its own earliest
+        # descriptors before the worker could read them — a permanent
+        # connect livelock — and even a fitting snapshot would advance
+        # the ring head, lapping descriptors still queued to slower
+        # LIVE workers.  A connect-time copy is the old bus's cost paid
+        # once per connect; the per-tick hot path stays descriptors.
         for cohort in self.hub.cohorts():
             for seal in cohort.window.seals:
-                conn.queue.put_nowait(encode_seal(seal, conn.next_n()))
+                conn.queue.put_nowait(
+                    self._encode_seal_for(conn, seal, None, conn.next_n())
+                )
         if self.bindings:
             conn.queue.put_nowait(
                 encode_message(
@@ -318,10 +702,60 @@ class BusPublisher:
             return
         conn.queue.put_nowait(encode(conn.next_n()))
 
+    def _seal_refs(
+        self, seal: Seal, include_tpl: bool = False
+    ) -> "dict[int, tuple] | None":
+        """Write one seal's blobs into the ring ONCE, returning
+        blob-index → descriptor.  Every worker's message then shares
+        the descriptors — publish cost is one ring write plus N tiny
+        sends, O(1) in blob bytes per worker.  The template pair (the
+        largest blobs, constant per epoch) gets a slot only when some
+        connection actually lacks it this publish — steady state would
+        otherwise burn ring capacity re-writing bytes nobody reads,
+        lapping live descriptors sooner."""
+        if self.ring is None:
+            return None
+        refs: dict = {}
+        for i, name in enumerate(_SEAL_BLOBS):
+            if i >= 10 and not include_tpl:
+                continue
+            blob = getattr(seal, name)
+            if blob is None or len(blob) < RING_MIN_BLOB:
+                continue
+            ref = self.ring.write(blob)
+            if ref is not None:
+                refs[i] = ref
+        return refs or None
+
+    def _encode_seal_for(
+        self, conn: _WorkerConn, seal: Seal, refs: "dict | None", n: int
+    ) -> bytes:
+        include_tpl = conn.tpl_needed(seal)
+        if include_tpl:
+            self.counters["templates_published"] += 1
+        use_refs = refs
+        if not include_tpl and refs is not None:
+            # descriptor hygiene: never point a connection at template
+            # slots it isn't being handed this message
+            use_refs = {i: r for i, r in refs.items() if i < 10} or None
+        msg = encode_seal(seal, n, include_tpl=include_tpl, refs=use_refs)
+        if use_refs:
+            self.counters["desc_bytes_published"] += len(msg)
+        else:
+            self.counters["blob_bytes_published"] += len(msg)
+        return msg
+
     def publish_seal(self, seal: Seal) -> None:
         self.counters["seals_published"] += 1
+        refs = self._seal_refs(
+            seal,
+            include_tpl=any(c.tpl_pending(seal) for c in self._conns),
+        )
         for conn in list(self._conns):
-            self._offer(conn, lambda n: encode_seal(seal, n))
+            self._offer(
+                conn,
+                lambda n, c=conn: self._encode_seal_for(c, seal, refs, n),
+            )
 
     def publish_binding(self, sid: str, cid: int) -> None:
         self.counters["bindings_published"] += 1
@@ -366,6 +800,13 @@ class BusPublisher:
             "backlog": self.backlog,
             "workers": self.workers(),
             "counters": dict(self.counters),
+            # the transport-mode truth for operators: shm + descriptor
+            # publishing, or the copying fallback and WHY
+            "ring": (
+                dict(self.ring.stats(), mode="shm")
+                if self.ring is not None
+                else {"mode": "copy", "reason": self.ring_reason}
+            ),
         }
 
 
@@ -386,6 +827,14 @@ class BusMirror:
         self.window_limit = 8
         self.windows: "dict[int, SealWindow]" = {}
         self.bindings: "dict[str, int]" = {}
+        #: cid → (template id, raw event bytes, gz segment): the figure
+        #: template each cohort's columnar fulls reference — delivered
+        #: once per epoch on the first seal carrying it, re-attached to
+        #: every later seal of that epoch at apply time
+        self.templates: "dict[int, tuple]" = {}
+        #: attached shm ring (read-only map of the publisher's memfd,
+        #: received in the connection preamble); None in copying mode
+        self.ring: "SealRing | None" = None
         self.connected = False
         #: monotonic stamp of the moment the publisher link was lost
         #: (None while connected; set once per outage).  The worker's
@@ -404,6 +853,7 @@ class BusMirror:
         self._update = asyncio.Event()
         self.counters = {
             "seals_applied": 0,
+            "templates_applied": 0,
             "reconnects": 0,
             "protocol_errors": 0,
         }
@@ -457,7 +907,41 @@ class BusMirror:
             await asyncio.sleep(0.5)
 
     async def _session(self, stop: "asyncio.Event | None") -> None:
-        reader, writer = await asyncio.open_unix_connection(self.path)
+        loop = asyncio.get_running_loop()
+        sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            await loop.sock_connect(sock, self.path)
+            # the preamble rides the naked socket before stream framing:
+            # mode byte + (in shm mode) the ring fd as SCM_RIGHTS
+            mode, size, fd = await loop.run_in_executor(
+                None, recv_preamble, sock
+            )
+        except (OSError, BusProtocolError, asyncio.CancelledError):
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
+        if mode == 1:
+            if fd is None:
+                raise BusProtocolError(
+                    "ring-mode preamble arrived without a descriptor "
+                    "(SCM_RIGHTS lost)"
+                )
+            try:
+                self.ring = SealRing.attach(fd, size)
+            except RingUnavailable as e:
+                # same-host mmap of a passed fd failing is not a mode
+                # this worker can silently downgrade out of — the
+                # publisher will send descriptors it cannot resolve.
+                # Fail the session loudly; the reconnect loop retries.
+                raise BusProtocolError(f"cannot attach seal ring: {e}") from e
+        elif fd is not None:
+            with contextlib.suppress(OSError):
+                os.close(fd)
+        reader, writer = await asyncio.open_unix_connection(sock=sock)
         self._writer = writer
         try:
             writer.write(
@@ -494,11 +978,31 @@ class BusMirror:
             self.window_limit = int(header.get("window", 8))
             self.windows.clear()
             self.bindings.clear()
+            self.templates.clear()
             self.connected = True
             self.disconnected_since = None
             self.hello_count += 1
         elif kind == "seal":
-            seal = decode_seal(header, body)
+            seal = decode_seal(header, body, self.ring)
+            if seal.tpl_id is not None:
+                if seal.bin_tpl_raw is not None:
+                    # first seal of this template epoch on this link:
+                    # retain the blob pair for every later seal of it
+                    self.templates[seal.cid] = (
+                        seal.tpl_id,
+                        seal.bin_tpl_raw,
+                        seal.bin_tpl_gz,
+                    )
+                    self.counters["templates_applied"] += 1
+                else:
+                    stored = self.templates.get(seal.cid)
+                    if stored is not None and stored[0] == seal.tpl_id:
+                        seal.bin_tpl_raw = stored[1]
+                        seal.bin_tpl_gz = stored[2]
+                    # no stored match → the seal keeps tpl blobs None;
+                    # binary serving for it degrades to JSON fallback
+                    # (never wrong bytes), and the next template-
+                    # carrying seal heals the store
             win = self.windows.get(seal.cid)
             if win is None:
                 win = self.windows[seal.cid] = SealWindow(self.window_limit)
@@ -515,6 +1019,7 @@ class BusMirror:
         elif kind == "evict":
             for cid in header.get("cids") or []:
                 self.windows.pop(int(cid), None)
+                self.templates.pop(int(cid), None)
         self._notify()
 
     async def send_active(self) -> None:
@@ -538,6 +1043,12 @@ class BusMirror:
             ),
             "cohorts": len(self.windows),
             "bindings": len(self.bindings),
+            "templates": len(self.templates),
             "active": len(self._refs),
             "counters": dict(self.counters),
+            "ring": (
+                dict(self.ring.stats(), mode="shm")
+                if self.ring is not None
+                else {"mode": "copy"}
+            ),
         }

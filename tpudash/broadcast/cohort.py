@@ -70,20 +70,35 @@ def keepalive_buffer(gz: bool, binary: bool) -> bytes:
     return KEEPALIVE_GZ if gz else KEEPALIVE_RAW
 
 
-def event_buffers(pairs, gz: bool, binary: bool) -> "list[bytes | None]":
+def event_buffers(
+    pairs, gz: bool, binary: bool, tid_held: "str | None" = None
+) -> "tuple[list[bytes | None], str | None]":
     """Pre-encoded event buffers for ``(seal, use_delta)`` pairs in the
     subscriber's negotiated framing (SSE text vs TDB1 binary events,
-    raw vs shared-gzip segments).  A None entry means the seal lacks
-    the requested encoding (binary tier disabled on the composing
-    side) — the caller closes the stream and the client falls back."""
+    raw vs shared-gzip segments), plus the figure-template id the
+    subscriber holds after these writes.
+
+    Binary full events are COLUMNAR (kind-5 cfull referencing a figure
+    template): whenever the seal's template differs from ``tid_held`` —
+    fresh connect, reconnect across a cohort epoch (compose restart,
+    LRU evict/recreate), structural break — the template event is
+    injected BEFORE the full event, so a client can never be handed
+    numeric sections it lacks the structure for.  A reconnect whose
+    ``?tpl=`` claim matches skips the template bytes entirely.
+
+    A None entry means the seal lacks the requested encoding (binary
+    tier disabled or unencodable frame shape) — the caller closes the
+    stream and the client falls back to JSON."""
     out = []
     for s, use_delta in pairs:
         if binary:
-            buf = (
-                (s.bin_delta_gz if gz else s.bin_delta_raw)
-                if use_delta
-                else (s.bin_full_gz if gz else s.bin_full_raw)
-            )
+            if use_delta:
+                buf = s.bin_delta_gz if gz else s.bin_delta_raw
+            else:
+                if s.tpl_id is not None and s.tpl_id != tid_held:
+                    out.append(s.bin_tpl_gz if gz else s.bin_tpl_raw)
+                    tid_held = s.tpl_id
+                buf = s.bin_full_gz if gz else s.bin_full_raw
         else:
             buf = (
                 (s.sse_delta_gz if gz else s.sse_delta_raw)
@@ -91,7 +106,7 @@ def event_buffers(pairs, gz: bool, binary: bool) -> "list[bytes | None]":
                 else (s.sse_full_gz if gz else s.sse_full_raw)
             )
         out.append(buf)
-    return out
+    return out, tid_held
 
 
 def cohort_key(state: SelectionState) -> tuple:
@@ -146,6 +161,9 @@ class Seal:
         "bin_full_gz",
         "bin_delta_raw",
         "bin_delta_gz",
+        "tpl_id",
+        "bin_tpl_raw",
+        "bin_tpl_gz",
     )
 
     def __init__(
@@ -163,6 +181,9 @@ class Seal:
         bin_full_gz: "bytes | None" = None,
         bin_delta_raw: "bytes | None" = None,
         bin_delta_gz: "bytes | None" = None,
+        tpl_id: "str | None" = None,
+        bin_tpl_raw: "bytes | None" = None,
+        bin_tpl_gz: "bytes | None" = None,
     ):
         self.cid = cid
         self.seq = seq
@@ -176,14 +197,25 @@ class Seal:
         self.frame_raw = frame_raw
         self.frame_gz = frame_gz
         #: TDB1 binary stream events (tpudash/app/wire.py): the full
-        #: event wraps the SAME frame JSON (structure is one-off), the
-        #: delta event carries the compact binary delta.  None when the
-        #: binary tier is disabled (wire_format=json) or, for the delta
-        #: pair, when the step was structural.
+        #: event carries the COLUMNAR cfull container (numeric sections
+        #: referencing the figure template ``tpl_id``), the delta event
+        #: the compact binary delta.  None when the binary tier is
+        #: disabled (wire_format=json) or, for the delta pair, when the
+        #: step was structural.  When the frame shape is not
+        #: template-encodable the full event degrades to the JSON body
+        #: (tpl_id None) — clients tell the two apart by the TDB1 magic.
         self.bin_full_raw = bin_full_raw
         self.bin_full_gz = bin_full_gz
         self.bin_delta_raw = bin_delta_raw
         self.bin_delta_gz = bin_delta_gz
+        #: the figure-structure template this seal's cfull references:
+        #: shared immutable event bytes, rebuilt only on structural
+        #: breaks — every seal of a template epoch carries the same
+        #: objects, so holding them per seal costs references, not
+        #: copies (the bus ships them once per worker per epoch)
+        self.tpl_id = tpl_id
+        self.bin_tpl_raw = bin_tpl_raw
+        self.bin_tpl_gz = bin_tpl_gz
 
 
 class SealWindow:
@@ -234,6 +266,9 @@ class Cohort:
         "window",
         "prev_frame",
         "last_used",
+        "tpl_id",
+        "bin_tpl_raw",
+        "bin_tpl_gz",
     )
 
     def __init__(self, key: tuple, window: int):
@@ -246,6 +281,12 @@ class Cohort:
         #: the composed frame behind the latest seal (delta input)
         self.prev_frame: "dict | None" = None
         self.last_used = 0.0
+        #: current figure-structure template (rebuilt whenever the seal
+        #: step is structural — exactly when frame_delta returns None,
+        #: so the template is valid for every delta-chained seal after)
+        self.tpl_id: "str | None" = None
+        self.bin_tpl_raw: "bytes | None" = None
+        self.bin_tpl_gz: "bytes | None" = None
 
 
 class CohortHub:
@@ -456,14 +497,48 @@ class CohortHub:
             sse_delta_gz = compress_segment(sse_delta_raw)
         bin_full_raw = bin_full_gz = None
         bin_delta_raw = bin_delta_gz = None
+        seal_tpl_id = seal_tpl_raw = seal_tpl_gz = None
         if self.binary:
             from tpudash.app import wire
 
             try:
-                # full events reuse the already-serialized frame JSON —
-                # figure structure is one-off; only deltas go binary
+                if delta is None:
+                    # structural break (or first seal): rebuild the
+                    # figure-structure template.  Its id is this seal's
+                    # event id — seqs are floored monotonic across LRU
+                    # recreation and compose restarts, so a stale
+                    # client-held template id can never alias a new one.
+                    try:
+                        tpl_container = wire.encode_template(
+                            frame, event_id
+                        )
+                    except wire.WireError as e:
+                        # not template-encodable (error frame, unknown
+                        # figure type): fall back to JSON full bodies
+                        # until the next structural break
+                        log.warning("columnar template skipped: %s", e)
+                        cohort.tpl_id = None
+                        cohort.bin_tpl_raw = cohort.bin_tpl_gz = None
+                    else:
+                        cohort.tpl_id = event_id
+                        cohort.bin_tpl_raw = wire.bin_event(
+                            wire.EVT_TEMPLATE, "", tpl_container
+                        )
+                        cohort.bin_tpl_gz = compress_segment(
+                            cohort.bin_tpl_raw
+                        )
+                if cohort.tpl_id is not None:
+                    # columnar full: numeric sections against the
+                    # cohort's current template (~6x smaller than the
+                    # JSON body at 4,096 chips)
+                    full_body = wire.encode_cfull(frame, cohort.tpl_id)
+                    seal_tpl_id = cohort.tpl_id
+                    seal_tpl_raw = cohort.bin_tpl_raw
+                    seal_tpl_gz = cohort.bin_tpl_gz
+                else:
+                    full_body = full_json
                 bin_full_raw = wire.bin_event(
-                    wire.EVT_FULL, event_id, full_json
+                    wire.EVT_FULL, event_id, full_body
                 )
                 bin_full_gz = compress_segment(bin_full_raw)
                 if delta is not None:
@@ -482,6 +557,7 @@ class CohortHub:
                 log.warning("binary seal encoding skipped: %s", e)
                 bin_full_raw = bin_full_gz = None
                 bin_delta_raw = bin_delta_gz = None
+                seal_tpl_id = seal_tpl_raw = seal_tpl_gz = None
         seal = Seal(
             cid,
             seq,
@@ -500,6 +576,9 @@ class CohortHub:
             bin_full_gz,
             bin_delta_raw,
             bin_delta_gz,
+            seal_tpl_id,
+            seal_tpl_raw,
+            seal_tpl_gz,
         )
         cohort.prev_frame = frame
         self.last_frame = frame

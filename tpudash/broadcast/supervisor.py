@@ -230,6 +230,11 @@ class ComposePlane:
             server.hub,
             backlog=self.cfg.broadcast_backlog,
             on_active=server.hub.touch,
+            # the zero-copy seal transport: blobs go into an mmap'd
+            # ring passed to workers by fd, messages carry descriptors.
+            # Probed inside start() — unavailable shm degrades to the
+            # copying bus loudly (log + ring stats), never silently.
+            ring_mb=self.cfg.shm_ring_mb,
         )
         server.bus_publisher = self.publisher
         if server.workers_provider is None:

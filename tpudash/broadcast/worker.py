@@ -132,6 +132,12 @@ class FanoutWorker:
         #: and left to expire with the next hello's window reset.
         self._stale_bodies: "dict[str, tuple]" = {}
         self._stale_build_lock = asyncio.Lock()
+        #: etag → (raw, gz) TDB1 /api/frame envelopes, one slot per
+        #: cohort's latest seal — assembled (concatenation, no encode)
+        #: and gzip'd at most once per seal however many binary pollers
+        #: revalidate it; bounded exactly like the stale bodies
+        self._bin_bodies: "dict[str, tuple]" = {}
+        self._bin_build_lock = asyncio.Lock()
         #: compose-outage anchor: monotonic stamp of the outage's FIRST
         #: detection, held across reconnect flaps shorter than the
         #: anti-flap dwell (cfg.alert_dwell) so the synthesized
@@ -275,7 +281,14 @@ class FanoutWorker:
         if accepts_gzip:
             headers["Content-Encoding"] = "gzip"
         resp = web.StreamResponse(headers=headers)
-        await resp.prepare(request)
+        try:
+            await resp.prepare(request)
+        except _CLIENT_GONE:
+            # the client vanished between connect and headers (connect
+            # storms abandon requests mid-handshake constantly) — a
+            # premature disconnect, not a server error; aiohttp's
+            # finish_response handles the half-prepared response
+            return resp
         bound_stream_buffers(request, self.cfg.sse_sndbuf)
         payload_writer = getattr(resp, "_payload_writer", None)
 
@@ -288,6 +301,12 @@ class FanoutWorker:
             request.headers.get("Last-Event-ID")
             or request.query.get("last_id")
         )
+        # figure-template claim, same contract as the compose-side
+        # stream: only a claim matching the seal's current template id
+        # skips the template event — a stale claim (reconnect across a
+        # cohort epoch) gets the fresh template before any numeric
+        # section, from THIS worker's mirror
+        tid_held = request.query.get("tpl") if binary else None
         write_deadline = self.overload.write_deadline
         self.mirror.retain(cid)
         seen_hello = self.mirror.hello_count
@@ -342,8 +361,8 @@ class FanoutWorker:
                     else None
                 )
                 if chain is None:
-                    payloads = event_buffers(
-                        [(latest, False)], accepts_gzip, binary
+                    payloads, tid_held = event_buffers(
+                        [(latest, False)], accepts_gzip, binary, tid_held
                     )
                 elif not chain:
                     # nothing new for THIS cohort: keepalive only when
@@ -353,8 +372,11 @@ class FanoutWorker:
                     else:
                         payloads = []
                 else:
-                    payloads = event_buffers(
-                        [(s, True) for s in chain], accepts_gzip, binary
+                    payloads, tid_held = event_buffers(
+                        [(s, True) for s in chain],
+                        accepts_gzip,
+                        binary,
+                        tid_held,
                     )
                 if any(p is None for p in payloads):
                     break  # seal lacks the negotiated encoding
@@ -401,7 +423,17 @@ class FanoutWorker:
         """``/api/frame`` from the mirror: the latest sealed frame for the
         session's cohort, ETag-revalidated, zero compose work.  Falls
         back to proxying when the mirror has nothing for the cohort yet
-        (first request of a fresh session on a cold worker)."""
+        (first request of a fresh session on a cold worker).
+
+        Binary negotiation (``Accept: application/x-tpudash-bin``) is
+        answered PURELY from the mirror too: the seal already holds the
+        template and cfull halves as pre-framed event bytes, so the
+        columnar envelope is assembled by concatenation — no re-encode,
+        no compose hop — behind its own ``-b`` validator (a JSON 304
+        must never satisfy a binary request or vice versa).  JSON stays
+        the default, and the fallback whenever the seal lacks the
+        columnar encoding (wire_format=json, unencodable frame shape,
+        compose outage)."""
         self._check_auth(request, allow_query=False)
         reason = self.overload.admit(self.overload.client_key(request))
         if reason is not None:
@@ -426,6 +458,14 @@ class FanoutWorker:
                 # and here is WHY it's old" beats one that goes dark
                 # with the fleet (the killall drill asserts this path)
                 return await self._stale_frame_response(request, latest)
+            binary = (
+                wire.CONTENT_TYPE in request.headers.get("Accept", "")
+                and self.cfg.wire_format != "json"
+                and latest.tpl_id is not None
+                and latest.bin_tpl_raw is not None
+            )
+            if binary:
+                return await self._binary_frame_response(request, latest)
             headers = {
                 "Cache-Control": "no-cache",
                 "ETag": latest.etag,
@@ -443,6 +483,50 @@ class FanoutWorker:
             )
         finally:
             self.overload.release()
+
+    async def _binary_frame_response(
+        self, request: web.Request, latest
+    ) -> web.Response:
+        """The TDB1 ``/api/frame`` body from one seal: envelope = the
+        seal's template + cfull containers concatenated (lifted back
+        out of the pre-framed event bytes), gzip'd once per seal in the
+        executor behind a single-flight gate however many pollers
+        revalidate it."""
+        etag = f'"{latest.cid}-{latest.seq}-b"'
+        headers = {
+            "Cache-Control": "no-cache",
+            "ETag": etag,
+            WORKER_HEADER: str(self.pid),
+        }
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers=headers)
+        if etag not in self._bin_bodies:
+            async with self._bin_build_lock:
+                if etag not in self._bin_bodies:
+                    loop = asyncio.get_running_loop()
+
+                    def build():
+                        body = wire.fullc_envelope(
+                            wire.event_body(latest.bin_tpl_raw),
+                            wire.event_body(latest.bin_full_raw),
+                        )
+                        return body, gzip.compress(body, 6)
+
+                    raw, gz = await loop.run_in_executor(None, build)
+                    if len(self._bin_bodies) > 2 * max(
+                        1, len(self.mirror.windows)
+                    ):
+                        self._bin_bodies.clear()
+                    self._bin_bodies[etag] = (raw, gz)
+        raw, gz = self._bin_bodies[etag]
+        if _accepts_gzip(request.headers.get("Accept-Encoding", "")):
+            body = gz
+            headers["Content-Encoding"] = "gzip"
+        else:
+            body = raw
+        return web.Response(
+            body=body, content_type=wire.CONTENT_TYPE, headers=headers
+        )
 
     async def _stale_frame_response(
         self, request: web.Request, latest

@@ -113,6 +113,7 @@ every PR (chaos-soak job).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import json
 import logging
@@ -614,11 +615,165 @@ def make_storm_server(cfg: "Config | None", workers: int):
     return DashboardServer(DashboardService(cfg, source)), cfg, bus_dir
 
 
+def _storm_bin_idx(total: int, binary_share: float) -> set:
+    """Global stream indices that negotiate the binary framing — spread
+    evenly through the arrival ramp (arriving the binary cohort last
+    would hand every one of them a shed 503 once the stream caps fill).
+    Shared by the parent drill and every client-shard subprocess, so
+    shards agree on roles without coordination."""
+    n_bin = int(total * max(0.0, min(1.0, binary_share)))
+    if not n_bin:
+        return set()
+    return {int(j * total / n_bin) for j in range(n_bin)}
+
+
+async def run_storm_client_pool(
+    host: str,
+    port: int,
+    start: int,
+    count: int,
+    total: int,
+    ramp: float,
+    seconds: float,
+    binary_share: float,
+) -> dict:
+    """One shard of the storm's streaming population: global client
+    indices ``[start, start+count)`` out of ``total``, each arriving at
+    its ramp offset.  Run in SUBPROCESSES by the drill (``python -m
+    tpudash.chaos storm-clients``): a single Python process cannot
+    drive 2500 concurrent streams without measuring its own event-loop
+    starvation instead of the tier — sharding puts the load generator
+    on its own cores."""
+    from aiohttp import ClientError, ClientSession, TCPConnector
+
+    from tpudash.app import wire
+
+    base = f"http://{host}:{port}"
+    stop = asyncio.Event()
+    pids: set = set()
+    stats = {
+        "stream_events": 0,
+        "streams_served": 0,
+        "shed_503": 0,
+        "shed_with_retry_after": 0,
+        "bin_streams_served": 0,
+        "bin_template_events": 0,
+        "bin_full_events": 0,
+        "bin_delta_events": 0,
+        "bin_framing_errors": 0,
+    }
+
+    async def stream_client(session: ClientSession, i: int, delay: float):
+        """One JSON viewer: stream events until told to stop; a shed
+        503 backs off Retry-After and retries — shed clients in the
+        wild don't vanish, they come back."""
+        cookies = {"tpudash_sid": f"storm-{i}"}
+        await asyncio.sleep(delay)
+        while not stop.is_set():
+            try:
+                async with session.get(
+                    f"{base}/api/stream", cookies=cookies
+                ) as r:
+                    pid = r.headers.get("X-TPUDash-Worker")
+                    if r.status == 503:
+                        stats["shed_503"] += 1
+                        if r.headers.get("Retry-After"):
+                            stats["shed_with_retry_after"] += 1
+                        await asyncio.sleep(
+                            float(r.headers.get("Retry-After") or 1.0)
+                        )
+                        continue
+                    if pid:
+                        pids.add(pid)
+                    stats["streams_served"] += 1
+                    # chunk-level token counting instead of per-line
+                    # Python iteration (a 4-byte carry makes the count
+                    # boundary-safe; JSON bodies can't contain a bare
+                    # "data:" — the key is always quoted)
+                    carry = b""
+                    async for chunk in r.content.iter_any():
+                        data = carry + chunk
+                        stats["stream_events"] += data.count(b"data:")
+                        carry = data[-4:]
+                        if stop.is_set():
+                            return
+            except (OSError, ClientError, asyncio.TimeoutError):
+                await asyncio.sleep(0.2)
+
+    async def bin_stream_client(session: ClientSession, i: int, delay: float):
+        """One BINARY viewer (``?format=bin``): splits the TDB1 event
+        framing incrementally and counts template/full/delta events —
+        the mixed-population half of the storm.  Any framing violation
+        is counted and fails the drill."""
+        cookies = {"tpudash_sid": f"storm-{i}"}
+        headers = {"Accept-Encoding": "identity"}
+        await asyncio.sleep(delay)
+        while not stop.is_set():
+            try:
+                async with session.get(
+                    f"{base}/api/stream",
+                    params={"format": "bin"},
+                    cookies=cookies,
+                    headers=headers,
+                ) as r:
+                    pid = r.headers.get("X-TPUDash-Worker")
+                    if r.status == 503:
+                        stats["shed_503"] += 1
+                        if r.headers.get("Retry-After"):
+                            stats["shed_with_retry_after"] += 1
+                        await asyncio.sleep(
+                            float(r.headers.get("Retry-After") or 1.0)
+                        )
+                        continue
+                    if pid:
+                        pids.add(pid)
+                    stats["bin_streams_served"] += 1
+                    buf = b""
+                    async for chunk in r.content.iter_any():
+                        buf += chunk
+                        try:
+                            evts, buf = wire.split_bin_events(buf)
+                        except wire.WireError:
+                            stats["bin_framing_errors"] += 1
+                            return
+                        for etype, _eid, _body in evts:
+                            if etype == wire.EVT_TEMPLATE:
+                                stats["bin_template_events"] += 1
+                            elif etype == wire.EVT_FULL:
+                                stats["bin_full_events"] += 1
+                            elif etype == wire.EVT_DELTA:
+                                stats["bin_delta_events"] += 1
+                            stats["stream_events"] += 1
+                        if stop.is_set():
+                            return
+            except (OSError, ClientError, asyncio.TimeoutError):
+                await asyncio.sleep(0.2)
+
+    bin_idx = _storm_bin_idx(total, binary_share)
+    async with ClientSession(connector=TCPConnector(limit=0)) as session:
+        tasks = [
+            asyncio.ensure_future(
+                (bin_stream_client if i in bin_idx else stream_client)(
+                    session, i, ramp * i / max(1, total)
+                )
+            )
+            for i in range(start, start + count)
+        ]
+        await asyncio.sleep(seconds)
+        stop.set()
+        await asyncio.wait(tasks, timeout=10)
+        for t in tasks:
+            t.cancel()
+    stats["pids"] = sorted(pids)
+    return stats
+
+
 async def run_storm_drill(
     clients: int = 1000,
     workers: int = 2,
     seconds: float = 30.0,
     cfg: "Config | None" = None,
+    binary_share: float = 0.25,
 ) -> dict:
     """The broadcast plane's soak: a ``clients``-strong SSE storm against
     ``workers`` real fan-out worker processes (SO_REUSEPORT + frame bus),
@@ -634,6 +789,14 @@ async def run_storm_drill(
       process (in-process probes, coroutine or thread, measure the
       drill's own 1000-task starvation, not the server), asserting zero
       failed probes and p50 under a second.
+
+    ISSUE 11 additions: ``binary_share`` of the streaming population
+    negotiates ``?format=bin`` (TDB1 framing: template → columnar full →
+    binary deltas, counted per event type with framing validated), and
+    the frame-bus transport is asserted on — in shm mode every seal
+    must fan out as ring DESCRIPTORS (per-worker bus bytes O(1) in blob
+    bytes) with the figure template shipped once per worker per epoch,
+    never per seal.
     """
     from aiohttp import (
         ClientError,
@@ -666,6 +829,11 @@ async def run_storm_drill(
         "healthz_probes": 0,
         "healthz_failures": 0,
         "healthz_max_ms": 0.0,
+        "bin_streams_served": 0,
+        "bin_template_events": 0,
+        "bin_full_events": 0,
+        "bin_delta_events": 0,
+        "bin_framing_errors": 0,
     }
     hz_lat: "list[float]" = []
     stream_pids: set = set()
@@ -679,41 +847,9 @@ async def run_storm_drill(
             await asyncio.sleep(0.25)
         return False
 
-    async def stream_client(session: ClientSession, i: int, ramp: float):
-        """One storm viewer: stream events until told to stop; a shed 503
-        backs off Retry-After and retries — shed clients in the wild
-        don't vanish, they come back.  Arrivals are staggered over
-        ``ramp`` seconds: a thousand simultaneous connects measures the
-        drill process's own accept loop, not the worker tier."""
-        cookies = {"tpudash_sid": f"storm-{i}"}
-        await asyncio.sleep(ramp)
-        while not stop.is_set():
-            try:
-                async with session.get(
-                    f"{base}/api/stream", cookies=cookies
-                ) as r:
-                    pid = r.headers.get("X-TPUDash-Worker")
-                    if r.status == 503:
-                        stats["shed_503"] += 1
-                        if r.headers.get("Retry-After"):
-                            stats["shed_with_retry_after"] += 1
-                        await asyncio.sleep(
-                            float(r.headers.get("Retry-After") or 1.0)
-                        )
-                        continue
-                    if pid:
-                        stream_pids.add(pid)
-                    stats["streams_served"] += 1
-                    async for line in r.content:
-                        if line.startswith(b"data:"):
-                            stats["stream_events"] += 1
-                        if stop.is_set():
-                            return
-            except (OSError, ClientError, asyncio.TimeoutError):
-                await asyncio.sleep(0.2)
-
     failures = []
     worker_docs: dict = {}
+    shard_procs: list = []
     try:
         if not await wait_for_workers():
             failures.append(
@@ -725,9 +861,14 @@ async def run_storm_drill(
             n_stalled = min(max(4, clients // 50), 32)
             n_streams = clients - n_stalled
             # arrivals staggered over the first part of the run: a
-            # thousand simultaneous connects measures this drill
-            # process's own client loop, not the worker tier
-            ramp = min(max(1.0, seconds / 3.0), 6.0)
+            # thousand simultaneous connects measures the load
+            # generator's own accept loop, not the worker tier.  The
+            # ramp scales with the population (≥ clients/250 s) so the
+            # 2500-client shape arrives as a staged wave, capped at 40%
+            # of the run
+            ramp = min(
+                max(1.0, seconds / 3.0, clients / 250.0), seconds * 0.4
+            )
             # probe only AFTER the connect surge settles: the invariant
             # is steady-state availability.  Measured on a 2-core box,
             # 1000 clients arriving over the ramp keep the workers'
@@ -746,67 +887,101 @@ async def run_storm_drill(
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.DEVNULL,
             )
-            # one session, unbounded pool: 1000 storm connections are the
-            # point, the client-side connector must not be the limiter
-            async with ClientSession(
-                connector=TCPConnector(limit=0)
-            ) as session:
-                tasks = [
-                    *(
-                        asyncio.ensure_future(
-                            _stalled_stream(
-                                cfg.host, cfg.port, f"storm-stall-{i}", stop
-                            )
-                        )
-                        for i in range(n_stalled)
-                    ),
-                    *(
-                        asyncio.ensure_future(
-                            stream_client(
-                                session, i, ramp * i / max(1, n_streams)
-                            )
-                        )
-                        for i in range(n_streams)
-                    ),
-                ]
-                await asyncio.sleep(seconds)
-                stop.set()
-                await asyncio.wait(tasks, timeout=15)
-                for t in tasks:
-                    t.cancel()
-                try:
-                    hz_out, _ = await asyncio.wait_for(
-                        hz_proc.communicate(), timeout=15
+            # the streaming population runs in SHARD SUBPROCESSES
+            # (``storm-clients``): one Python process cannot drive 2500
+            # concurrent streams without measuring its own event-loop
+            # starvation instead of the tier.  Only the stalled
+            # consumers (few, near-zero CPU) stay in this process.
+            n_shards = max(1, min(os.cpu_count() or 2, n_streams // 400))
+            per = (n_streams + n_shards - 1) // n_shards
+            start_i = 0
+            while start_i < n_streams:
+                count = min(per, n_streams - start_i)
+                shard_procs.append(
+                    await asyncio.create_subprocess_exec(
+                        sys.executable,
+                        "-m",
+                        "tpudash.chaos",
+                        "storm-clients",
+                        "--host", cfg.host,
+                        "--port", str(cfg.port),
+                        "--start", str(start_i),
+                        "--count", str(count),
+                        "--total", str(n_streams),
+                        "--ramp", str(ramp),
+                        "--seconds", str(seconds),
+                        "--binary-share", str(binary_share),
+                        stdout=asyncio.subprocess.PIPE,
+                        stderr=asyncio.subprocess.DEVNULL,
                     )
-                    hz_doc = json.loads(hz_out or b"{}")
+                )
+                start_i += count
+            tasks = [
+                asyncio.ensure_future(
+                    _stalled_stream(
+                        cfg.host, cfg.port, f"storm-stall-{i}", stop
+                    )
+                )
+                for i in range(n_stalled)
+            ]
+            shard_docs = []
+            for proc in shard_procs:
+                try:
+                    out, _ = await asyncio.wait_for(
+                        proc.communicate(), timeout=seconds + 45
+                    )
+                    shard_docs.append(json.loads(out or b"{}"))
                 except (asyncio.TimeoutError, ValueError):
+                    with contextlib.suppress(ProcessLookupError):
+                        proc.kill()
+                    failures.append("a storm client shard hung or died")
+            stop.set()
+            await asyncio.wait(tasks, timeout=15)
+            for t in tasks:
+                t.cancel()
+            for doc in shard_docs:
+                for key in (
+                    "stream_events", "streams_served", "shed_503",
+                    "shed_with_retry_after", "bin_streams_served",
+                    "bin_template_events", "bin_full_events",
+                    "bin_delta_events", "bin_framing_errors",
+                ):
+                    stats[key] += doc.get(key, 0)
+                stream_pids.update(doc.get("pids") or [])
+            try:
+                hz_out, _ = await asyncio.wait_for(
+                    hz_proc.communicate(), timeout=15
+                )
+                hz_doc = json.loads(hz_out or b"{}")
+            except (asyncio.TimeoutError, ValueError):
+                try:
+                    hz_proc.kill()
+                except ProcessLookupError:
+                    pass
+                hz_doc = {}
+            stats["healthz_probes"] = hz_doc.get("probes", 0)
+            stats["healthz_failures"] = hz_doc.get("failures", 0)
+            hz_lat.extend(hz_doc.get("latencies_ms") or [])
+            stats["healthz_max_ms"] = max(hz_lat, default=0.0)
+            # collect every worker's vitals: force a fresh connection
+            # per probe so SO_REUSEPORT hashes us across pids
+            async with ClientSession(
+                connector=TCPConnector(force_close=True),
+                timeout=ClientTimeout(total=2.0),
+            ) as probeses:
+                for _ in range(80):
+                    if len(worker_docs) >= workers:
+                        break
                     try:
-                        hz_proc.kill()
-                    except ProcessLookupError:
-                        pass
-                    hz_doc = {}
-                stats["healthz_probes"] = hz_doc.get("probes", 0)
-                stats["healthz_failures"] = hz_doc.get("failures", 0)
-                hz_lat.extend(hz_doc.get("latencies_ms") or [])
-                stats["healthz_max_ms"] = max(hz_lat, default=0.0)
-                # collect every worker's vitals: force a fresh connection
-                # per probe so SO_REUSEPORT hashes us across pids
-                async with ClientSession(
-                    connector=TCPConnector(force_close=True),
-                    timeout=ClientTimeout(total=2.0),
-                ) as probeses:
-                    for _ in range(80):
-                        if len(worker_docs) >= workers:
-                            break
-                        try:
-                            async with probeses.get(f"{base}/healthz") as r:
-                                doc = await r.json()
-                        except (OSError, ClientError, asyncio.TimeoutError):
-                            continue
-                        wdoc = doc.get("worker") or {}
-                        if wdoc.get("pid") is not None:
-                            worker_docs[str(wdoc["pid"])] = wdoc
+                        async with probeses.get(f"{base}/healthz") as r:
+                            doc = await r.json()
+                    except (OSError, ClientError, asyncio.TimeoutError):
+                        continue
+                    wdoc = doc.get("worker") or {}
+                    if wdoc.get("pid") is not None:
+                        worker_docs[str(wdoc["pid"])] = wdoc
     finally:
+        bus_stats = sup.publisher.stats() if sup.publisher else {}
         await sup.stop()
         logging.getLogger().removeHandler(trap)
 
@@ -879,6 +1054,54 @@ async def run_storm_drill(
                 f"worker logs show unhandled exceptions: "
                 f"{worker_log_errors[0][:500]}"
             )
+        # the mixed binary population actually streamed the TDB1 plane:
+        # template before fulls, columnar fulls, steady-state deltas,
+        # and not one framing violation across the whole storm
+        if binary_share > 0:
+            if stats["bin_streams_served"] == 0:
+                failures.append("no binary (?format=bin) streams served")
+            if stats["bin_template_events"] == 0:
+                failures.append("binary streams never received a template")
+            if stats["bin_full_events"] == 0:
+                failures.append(
+                    "binary streams never received a columnar full"
+                )
+            if stats["bin_delta_events"] == 0:
+                failures.append("binary streams never received a delta")
+            if stats["bin_framing_errors"]:
+                failures.append(
+                    f"{stats['bin_framing_errors']} TDB1 framing "
+                    "violation(s) on binary streams"
+                )
+        # seal-ring transport: in shm mode every seal fans out as ring
+        # descriptors — per-worker bus bytes O(1) in blob bytes — and
+        # the figure template ships once per worker per epoch, never
+        # per seal (that is what keeps bus publish CPU flat in worker
+        # count; the 1/2/4-worker guard itself lives in
+        # bench.bench_bus_fanout)
+        bc = bus_stats.get("counters") or {}
+        ring_info = bus_stats.get("ring") or {}
+        if ring_info.get("mode") == "shm":
+            seals_pub = bc.get("seals_published", 0)
+            if seals_pub and not bc.get("desc_bytes_published"):
+                failures.append(
+                    "shm ring active but no descriptor messages published"
+                )
+            if seals_pub > workers and bc.get("templates_published", 0) >= (
+                seals_pub * max(1, workers)
+            ):
+                failures.append(
+                    "figure templates re-shipped per seal instead of per "
+                    "(worker, epoch)"
+                )
+            per_msg = bc.get("desc_bytes_published", 0) / max(
+                1, seals_pub * max(1, workers)
+            )
+            if per_msg > 8192:
+                failures.append(
+                    f"ring-mode seal messages average {per_msg:.0f}B — "
+                    "descriptor fan-out is carrying blob-scale bytes"
+                )
     return {
         "ok": not failures,
         "failures": failures,
@@ -890,6 +1113,7 @@ async def run_storm_drill(
         "worker_vitals": worker_docs,
         "compose_loop_lag_ms": server.loop_monitor.summary(),
         "supervisor_restarts": sup.restarts,
+        "bus": bus_stats,
     }
 
 
@@ -2190,6 +2414,13 @@ def main(argv: "list[str] | None" = None) -> None:
     st.add_argument("--clients", type=int, default=1000)
     st.add_argument("--workers", type=int, default=2)
     st.add_argument("--seconds", type=float, default=30.0)
+    st.add_argument(
+        "--binary-share",
+        type=float,
+        default=0.25,
+        help="fraction of streaming clients negotiating ?format=bin "
+        "(mixed JSON/binary population; 0 disables)",
+    )
     ka = sub.add_parser(
         "killall",
         help="crash-anything drill: SIGKILL compose mid-storm, a worker, "
@@ -2206,9 +2437,36 @@ def main(argv: "list[str] | None" = None) -> None:
         "anti-flap dwell) and recover within one poll of heal",
     )
     pa.add_argument("--children", type=int, default=4)
+    # internal: one shard of the storm's streaming population, spawned
+    # by the storm drill itself (the load generator runs on its own
+    # cores so a 2500-client storm measures the tier, not the driver)
+    sc = sub.add_parser("storm-clients")
+    sc.add_argument("--host", required=True)
+    sc.add_argument("--port", type=int, required=True)
+    sc.add_argument("--start", type=int, required=True)
+    sc.add_argument("--count", type=int, required=True)
+    sc.add_argument("--total", type=int, required=True)
+    sc.add_argument("--ramp", type=float, required=True)
+    sc.add_argument("--seconds", type=float, required=True)
+    sc.add_argument("--binary-share", type=float, required=True)
     args = parser.parse_args(argv)
 
     configure_logging()
+    if args.mode == "storm-clients":
+        stats = asyncio.run(
+            run_storm_client_pool(
+                args.host,
+                args.port,
+                args.start,
+                args.count,
+                args.total,
+                args.ramp,
+                args.seconds,
+                args.binary_share,
+            )
+        )
+        print(json.dumps(stats))
+        sys.exit(0)
     if args.mode == "overload":
         summary = asyncio.run(
             run_overload_drill(clients=args.clients, seconds=args.seconds)
@@ -2221,6 +2479,7 @@ def main(argv: "list[str] | None" = None) -> None:
                 clients=args.clients,
                 workers=args.workers,
                 seconds=args.seconds,
+                binary_share=args.binary_share,
             )
         )
         print(json.dumps(summary, indent=2))

@@ -326,6 +326,18 @@ class Config:
     #: reporting a live subscriber for it (worker mode only; the
     #: single-process hub composes strictly on demand).
     broadcast_idle_ttl: float = 60.0
+    #: Shared-memory seal ring size, MB (worker mode).  The compose
+    #: process writes every seal blob into an mmap'd ring ONCE and the
+    #: frame bus carries 3-integer descriptors, so publish cost stops
+    #: scaling with blob bytes × worker count; the ring fd reaches each
+    #: worker via SCM_RIGHTS in the bus connection preamble.  0 = the
+    #: copying bus.  On platforms where the ring cannot be created the
+    #: bus degrades to copying LOUDLY (log + ``ring.mode``/``reason``
+    #: on /api/timings and /api/workers) — never a silent wrong mode.
+    #: Size it to a few seconds of seal traffic: a reader lapped by the
+    #: writer detects the overwrite (seqlock) and resyncs via a
+    #: reconnect snapshot.
+    shm_ring_mb: int = 64
     #: Per-stream SSE socket send-buffer bound, bytes (``SO_SNDBUF`` +
     #: transport write-buffer high-water).  0 = kernel defaults.  At
     #: thousands of streams the kernel's auto-tuned buffers cost real
@@ -399,6 +411,7 @@ _ENV_MAP = {
     "broadcast_bus": "TPUDASH_BROADCAST_BUS",
     "broadcast_backlog": "TPUDASH_BROADCAST_BACKLOG",
     "broadcast_idle_ttl": "TPUDASH_BROADCAST_IDLE_TTL",
+    "shm_ring_mb": "TPUDASH_SHM_RING_MB",
     "sse_sndbuf": "TPUDASH_SSE_SNDBUF",
     "wire_format": "TPUDASH_WIRE_FORMAT",
     "record_path": "TPUDASH_RECORD_PATH",
