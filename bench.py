@@ -1017,6 +1017,64 @@ def bench_snapshot(n_frames: int = 600, n_chips: int = 64, n_cols: int = 6) -> d
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_anomaly_scoring(counts=(1024, 4096), ticks: int = 30) -> dict:
+    """The anomaly engine's per-tick scoring-hook cost at fleet scale
+    (ISSUE 12): full ``AnomalyEngine.observe`` — baseline ingest +
+    batch z-scoring + fabric correlation — over a real parsed frame at
+    1024/4096 chips, numpy and jax paths side by side.
+
+    The hook rides the hard-gated publish path, so it carries its own
+    hard bar: the 4096-chip numpy p50 must stay under 10% of
+    ``SCALE_4096_P50_BUDGET_MS`` — detection must never buy back the
+    frame budget PR 9 earned.  The jax number is reported for the
+    fleet-scale (100k+ federated chips) story; on small hosts numpy
+    wins and that is expected."""
+    import statistics
+
+    from tpudash.anomaly.detect import AnomalyEngine
+    from tpudash.config import Config
+    from tpudash.normalize import dense_block, to_wide
+    from tpudash.sources.base import parse_instant_query
+    from tpudash.sources.fixture import synthetic_payload
+    from tpudash.stragglers import DEFAULT_DIRECTIONS
+
+    out: dict = {}
+    for n in counts:
+        payload = synthetic_payload(num_chips=n, emit_links=True, t=1000.0)
+        df = to_wide(parse_instant_query(payload))
+        block = dense_block(df)
+        keys = df.index.tolist()
+        for suffix, use_jax in (("", False), ("_jax", True)):
+            key_name = f"anomaly_score_{n}{suffix}_p50_ms"
+            eng = AnomalyEngine.from_config(
+                Config(anomaly=True, anomaly_jax=use_jax)
+            )
+            if use_jax and eng.backend != "jax":
+                out[key_name] = None  # jax unavailable — reported, not hidden
+                continue
+            # warm the seasonal baselines (MIN_COUNT folds) so scoring
+            # runs the real warm path, not the all-NaN cold path
+            wcols, x = eng._values(df, block, sorted(DEFAULT_DIRECTIONS))
+            for m in range(7):
+                eng.baselines.ingest(580.0 + 60.0 * m, keys, wcols, x)
+            times = []
+            for t in range(ticks):
+                t0 = time.perf_counter()
+                eng.observe(
+                    1000.0 + 5.0 * t, df, block=block, stragglers=[], keys=keys
+                )
+                times.append(time.perf_counter() - t0)
+            out[key_name] = round(statistics.median(times) * 1e3, 3)
+    budget_ms = 0.10 * SCALE_4096_P50_BUDGET_MS
+    measured = out.get("anomaly_score_4096_p50_ms")
+    assert measured is not None and measured <= budget_ms, (
+        f"anomaly scoring hook costs {measured} ms at 4096 chips — over "
+        f"10% of the hard-gated {SCALE_4096_P50_BUDGET_MS} ms frame "
+        f"budget ({budget_ms:.1f} ms)"
+    )
+    return out
+
+
 def bench_federation(
     child_counts=(2, 8, 16), frames: int = 12, chips_per_child: int = 256
 ) -> dict:
@@ -1287,6 +1345,15 @@ def find_regressions(
         "higher",
         1.0,
     )
+    # anomaly scoring hook (ISSUE 12): time-domain per-tick numbers on a
+    # noisy host — 2x swings flag, the size of a lost vectorized path
+    # (the hard <10%-of-frame-budget bar lives inside
+    # bench_anomaly_scoring itself)
+    for key in (
+        "anomaly_score_1024_p50_ms",
+        "anomaly_score_4096_p50_ms",
+    ):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
     # federation fan-in (ISSUE 9): time-domain whole-pipeline numbers on
     # a noisy host — 2x swings flag (the size of a lost batch-union or
     # summary-decode fast path, not scheduler jitter)
@@ -1377,6 +1444,7 @@ def main() -> None:
     tsdb = bench_tsdb()
     snapshot = bench_snapshot()
     federation = bench_federation()
+    anomaly_scoring = bench_anomaly_scoring()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -1420,6 +1488,7 @@ def main() -> None:
         **tsdb,
         **snapshot,
         **federation,
+        **anomaly_scoring,
         "probes": probes,
         "cpu_ref_ms": cpu_reference_ms(),
         "cpu_ref_json_ms": cpu_reference_json_ms(),

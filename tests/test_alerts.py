@@ -112,7 +112,8 @@ def test_alerts_disabled():
     from tpudash.config import Config
     from tpudash.sources.fixture import SyntheticSource
 
-    cfg = Config(source="synthetic", alert_rules="off")
+    # anomaly off too: the alert plane exists when EITHER engine is on
+    cfg = Config(source="synthetic", alert_rules="off", anomaly=False)
     svc = DashboardService(cfg, SyntheticSource(num_chips=4))
     frame = svc.render_frame()
     assert "alerts" not in frame

@@ -1,0 +1,926 @@
+"""The anomaly layer (tpudash.anomaly): seasonal baselines, online
+detection, incident timelines, what-if replay — plus the stragglers
+scoring-core factor-out and its small-N dispersion guard (ISSUE 12)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpudash import schema
+from tpudash.anomaly.baselines import (
+    CLAMP_K,
+    MIN_COUNT,
+    REL_FLOOR,
+    WARM_COUNT,
+    BaselineStore,
+    make_scorer,
+)
+from tpudash.anomaly.detect import FABRIC_MIN_GROUP, AnomalyEngine
+from tpudash.anomaly.replay import (
+    ReplayClock,
+    diff_timelines,
+    run_capture,
+)
+from tpudash.anomaly.timeline import IncidentTimeline
+from tpudash.config import DECLARED_ENV, Config
+from tpudash.normalize import dense_block, to_wide
+from tpudash.sources.base import parse_instant_query
+from tpudash.sources.fixture import SyntheticSource, synthetic_payload
+from tpudash.stragglers import (
+    MIN_POPULATION,
+    StragglerDetector,
+    parse_rules,
+    robust_scores,
+)
+
+UTIL = schema.TENSORCORE_UTIL
+
+
+# --- baselines: fold exactness against hand-computed rollups ----------------
+
+def test_baseline_fold_matches_hand_computed_minute_means():
+    bs = BaselineStore(bucket_s=3600.0)
+    keys, cols = ["s/0"], [UTIL]
+    # five minutes, six ticks each; per-minute means are hand-knowable
+    minute_means = [10.0, 12.0, 14.0, 16.0, 18.0]
+    for m, mean in enumerate(minute_means):
+        for k in range(6):
+            # ticks symmetric around the mean → minute mean == `mean`
+            v = mean + (k - 2.5)
+            bs.ingest(60.0 * m + 10.0 * k, keys, cols, np.array([[v]]))
+    bs.flush_pending()
+    assert bs.folds == len(minute_means)
+    loc, scale = bs.matrices(keys, cols, ts_s=100.0)
+    # plain Welford below WARM_COUNT: loc = mean of minute means,
+    # scale = population std of minute means (floored)
+    exp_loc = float(np.mean(minute_means))
+    exp_std = float(np.sqrt(np.mean((np.array(minute_means) - exp_loc) ** 2)))
+    assert loc[0, 0] == pytest.approx(exp_loc, rel=1e-12)
+    assert scale[0, 0] == pytest.approx(
+        max(exp_std, REL_FLOOR * abs(exp_loc)), rel=1e-12
+    )
+
+
+def test_baseline_cold_bucket_scores_nan_until_min_count():
+    bs = BaselineStore(bucket_s=3600.0)
+    keys, cols = ["s/0"], [UTIL]
+    for m in range(MIN_COUNT - 1):
+        bs.ingest(60.0 * m, keys, cols, np.array([[50.0]]))
+    bs.flush_pending()  # MIN_COUNT-1 folds: still cold
+    loc, scale = bs.matrices(keys, cols, ts_s=10.0)
+    assert math.isnan(loc[0, 0]) and math.isnan(scale[0, 0])
+    bs.ingest(60.0 * MIN_COUNT, keys, cols, np.array([[50.0]]))
+    bs.flush_pending()
+    loc, _ = bs.matrices(keys, cols, ts_s=10.0)
+    assert loc[0, 0] == pytest.approx(50.0)
+
+
+def test_baseline_buckets_separate_time_of_day():
+    bs = BaselineStore(bucket_s=3600.0)
+    keys, cols = ["s/0"], [UTIL]
+    # hour 0 runs at 20, hour 13 at 80 — two seasons, two baselines
+    for day in range(MIN_COUNT):
+        bs.ingest(day * 86400.0 + 60.0, keys, cols, np.array([[20.0]]))
+        bs.ingest(day * 86400.0 + 13 * 3600.0, keys, cols, np.array([[80.0]]))
+    bs.flush_pending()
+    loc0, _ = bs.matrices(keys, cols, ts_s=120.0)
+    loc13, _ = bs.matrices(keys, cols, ts_s=13 * 3600.0 + 300.0)
+    assert loc0[0, 0] == pytest.approx(20.0)
+    assert loc13[0, 0] == pytest.approx(80.0)
+
+
+def test_baseline_winsorized_update_clamps_outlier_minute():
+    bs = BaselineStore(bucket_s=3600.0)
+    keys, cols = ["s/0"], [UTIL]
+    warm = [10.0, 12.0, 14.0, 16.0, 18.0, 10.0, 12.0, 16.0]
+    assert len(warm) == WARM_COUNT
+    for m, v in enumerate(warm):
+        bs.ingest(60.0 * m, keys, cols, np.array([[v]]))
+    # the anomalous minute: without winsorization this would drag the
+    # mean by ~123; the clamp caps the pull at CLAMP_K stds' worth
+    bs.ingest(60.0 * WARM_COUNT, keys, cols, np.array([[1000.0]]))
+    bs.flush_pending()
+    # hand-compute: Welford over `warm`, then one clamped update
+    n = float(len(warm))
+    mean = float(np.mean(warm))
+    m2 = float(np.sum((np.array(warm) - mean) ** 2))
+    std = math.sqrt(m2 / n)
+    clamped = min(1000.0, mean + CLAMP_K * std)
+    n1 = n + 1.0
+    delta = clamped - mean
+    exp_mean = mean + delta / n1
+    loc, _ = bs.matrices(keys, cols, ts_s=30.0)
+    assert clamped < 1000.0
+    assert loc[0, 0] == pytest.approx(exp_mean, rel=1e-12)
+    assert loc[0, 0] < 30.0  # nowhere near the un-winsorized ~123
+
+
+def test_baseline_seed_from_store_matches_rollup_means(tmp_path):
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.rollup import TIER_1M_MS
+
+    store = TSDB(path="", chunk_points=4)
+    key = "s/0"
+    # NOW-anchored, minute-aligned base (retention is wall-clock: old
+    # stamps age out of the store before the seed can read them), kept
+    # clear of an hour-bucket edge so both minutes share one tod bucket;
+    # minute 0: raw points 10,20 (mean 15); minute 1: 30,50 (mean 40)
+    import time as _time
+
+    base = float((int(_time.time()) // 60) * 60 - 600)
+    if base % 3600.0 > 3000.0:
+        base -= 900.0
+    assert base % 60 == 0
+    # five 1m buckets; hand-computed means: 15, 40, 20, 30, 25
+    points = (
+        (0.0, 10.0), (30.0, 20.0),      # minute 0 → mean 15
+        (60.0, 30.0), (90.0, 50.0),     # minute 1 → mean 40
+        (120.0, 20.0),                  # minute 2 → mean 20
+        (180.0, 30.0),                  # minute 3 → mean 30
+        (240.0, 25.0),                  # minute 4 → mean 25
+    )
+    for off, v in points:
+        store.append_frame(base + off, [key], [UTIL], np.array([[v]]))
+    store.flush(seal_partial=True)
+    quads = store.rollup_window(
+        TIER_1M_MS, key, UTIL, int(base * 1000), int((base + 600) * 1000)
+    )
+    assert quads  # rollups really exist — the seed has a source
+    bs = BaselineStore(bucket_s=3600.0)
+    folds = bs.seed_from_store(store, [UTIL])
+    assert folds == 5
+    loc, scale = bs.matrices([key], [UTIL], ts_s=base + 500.0)
+    # hand-computed over the five 1m means [15, 40, 20, 30, 25]:
+    # Welford mean 26, population std sqrt(74) ≈ 8.602
+    assert loc[0, 0] == pytest.approx(26.0)
+    assert scale[0, 0] == pytest.approx(math.sqrt(74.0))
+
+
+def test_baseline_persistence_roundtrip(tmp_path):
+    path = str(tmp_path / "baselines.npz")
+    bs = BaselineStore(bucket_s=3600.0)
+    for m in range(6):
+        bs.ingest(60.0 * m, ["s/0", "s/1"], [UTIL], np.array([[50.0], [70.0]]))
+    bs.flush_pending()
+    bs.save(path)
+    fresh = BaselineStore(bucket_s=3600.0)
+    assert fresh.load(path)
+    assert fresh.folds == bs.folds
+    loc_a, sc_a = bs.matrices(["s/0", "s/1"], [UTIL], 100.0)
+    loc_b, sc_b = fresh.matrices(["s/0", "s/1"], [UTIL], 100.0)
+    np.testing.assert_allclose(loc_a, loc_b)
+    np.testing.assert_allclose(sc_a, sc_b)
+    # a geometry change refuses the checkpoint instead of misaligning
+    other = BaselineStore(bucket_s=1800.0)
+    assert not other.load(path)
+    assert other.folds == 0
+
+
+# --- scoring: numpy vs jax parity -------------------------------------------
+
+def _random_score_inputs(k=64, c=8, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(50.0, 20.0, (k, c))
+    loc = rng.normal(50.0, 5.0, (k, c))
+    scale = np.abs(rng.normal(3.0, 1.0, (k, c))) + 0.1
+    x[0, 0] = np.nan
+    loc[1, 1] = np.nan  # cold cell → NaN score
+    return x, loc, scale
+
+
+def test_scorer_numpy_nan_contract():
+    score, backend = make_scorer(False)
+    assert backend == "numpy"
+    x, loc, scale = _random_score_inputs()
+    z = score(x, loc, scale)
+    assert math.isnan(z[0, 0]) and math.isnan(z[1, 1])
+    assert z[2, 2] == pytest.approx(
+        (x[2, 2] - loc[2, 2]) / scale[2, 2], rel=1e-5
+    )
+
+
+def test_scorer_jax_parity_with_numpy():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    jax_score, backend = make_scorer(True)
+    if backend != "jax":
+        pytest.skip("jax present but scorer fell back (no usable device)")
+    np_score, _ = make_scorer(False)
+    x, loc, scale = _random_score_inputs(k=256, c=12)
+    zj = jax_score(x, loc, scale)
+    zn = np_score(x, loc, scale)
+    # documented tolerance: both paths compute in float32; elementwise
+    # subtract/divide agree to float32 ulps
+    np.testing.assert_allclose(zj, zn, rtol=1e-6, atol=1e-6, equal_nan=True)
+
+
+# --- stragglers: factored core + the small-N dispersion guard ---------------
+
+def test_robust_scores_small_population_returns_none():
+    assert robust_scores(np.array([])) is None
+    assert robust_scores(np.array([5.0])) is None
+    assert robust_scores(np.array([5.0, 500.0])) is None  # symmetric z ≈ .67
+    assert MIN_POPULATION == 3
+
+
+def test_robust_scores_three_chips_is_the_floor():
+    scored = robust_scores(
+        np.array([100.0, 100.0, 1.0]), direction="low", zscore=3.5
+    )
+    assert scored is not None
+    _z, breach, med, _scale = scored
+    assert med == 100.0
+    assert list(breach) == [False, False, True]
+
+
+def test_detector_with_tiny_min_chips_skips_degenerate_population():
+    import pandas as pd
+
+    det = StragglerDetector(
+        rules=parse_rules("m:low@1"), min_chips=1, clock=lambda: 0.0
+    )
+    df = pd.DataFrame({"m": {"s/0": 100.0, "s/1": 1.0}})
+    # before the guard this produced symmetric ±0.67 scores (and a
+    # `both` rule with a low threshold could flag BOTH chips); now the
+    # metric is skipped — "not evaluated", never "scored"
+    assert det.evaluate(df) == []
+    # frozen, not resolved: a tracked streak survives the skipped cycle
+    det._tracks.hit(("m", "s/1"), 1, 0.0)
+    det.evaluate(df)
+    assert ("m", "s/1") in dict(det._tracks.items())
+
+
+# --- detection: planted faults, quiet fleet ---------------------------------
+
+def _frame(num_chips=64, cold_links=(), t=1000.0):
+    payload = synthetic_payload(
+        num_chips=num_chips, t=t, emit_links=True, cold_links=tuple(cold_links)
+    )
+    df = to_wide(parse_instant_query(payload))
+    return df, dense_block(df)
+
+
+def test_engine_fires_on_planted_straggler_and_stays_quiet_without():
+    eng = AnomalyEngine.from_config(Config(anomaly=True))
+    det = StragglerDetector.from_config(Config())
+    # healthy fleet: several ticks, zero findings
+    for i in range(4):
+        df, block = _frame(t=1000.0 + 5 * i)
+        stragglers = det.evaluate(df, block=block)
+        eng.observe(1000.0 + 5 * i, df, block=block, stragglers=stragglers)
+        assert eng.alert_entries == []
+    # plant a cold cable; straggler hysteresis (3) + engine (2) cycles
+    fired = []
+    for i in range(6):
+        df, block = _frame(cold_links=[(17, "xp")], t=1100.0 + 5 * i)
+        stragglers = det.evaluate(df, block=block)
+        eng.observe(1100.0 + 5 * i, df, block=block, stragglers=stragglers)
+        fired = [e for e in eng.alert_entries if e["state"] == "firing"]
+        if fired:
+            break
+    assert fired, "planted cold link never fired an anomaly"
+    e = fired[0]
+    assert e["rule"] == "anomaly" and e["chip"] == "slice-0/17"
+    assert e["kind"] == "straggler" and e["score"] >= 4.0
+    assert e["evidence"]["range"]["chip"] == "slice-0/17"
+    assert e["column"] in e["evidence"]["range"]["cols"]
+
+
+def test_engine_groups_ici_neighborhood_into_one_fabric_finding():
+    # chips 17, 18 (x+1) and 25 (y+1) on an 8×8 torus: a torus-adjacent
+    # degraded neighborhood — ONE fabric incident, not three chip pages
+    cold = [(17, "xp"), (18, "xn"), (25, "yp")]
+    eng = AnomalyEngine.from_config(Config(anomaly=True))
+    df, block = _frame(num_chips=64, cold_links=cold)
+    # stragglers=None → the engine's own link scan (no detector ran)
+    findings = eng.observe(1000.0, df, block=block, stragglers=None)
+    fabric = [f for f in findings if f["kind"] == "fabric"]
+    assert len(fabric) == 1
+    grp = fabric[0]
+    assert sorted(grp["chips"]) == ["slice-0/17", "slice-0/18", "slice-0/25"]
+    assert grp["chip"] == "slice-0/fabric"
+    assert len(grp["chips"]) >= FABRIC_MIN_GROUP
+    # the members do NOT also page individually on their link columns
+    member_pages = [
+        f
+        for f in findings
+        if f["kind"] != "fabric" and f["chip"] in grp["chips"]
+        and f["column"] in schema.ICI_LINK_GBPS.values()
+    ]
+    assert member_pages == []
+    # severity: a fabric incident is critical by construction
+    entry = eng.alert_entries[0]
+    assert entry["severity"] == "critical" and entry["kind"] == "fabric"
+    assert entry["chips"] == grp["chips"]
+    # evidence anchors on a MEMBER chip's series (the fleet
+    # pseudo-series never carries per-direction link columns, so a
+    # fleet-anchored URL would resolve to zero points)
+    assert entry["evidence"]["range"]["chip"] in grp["chips"]
+
+
+def test_fabric_group_survives_straggler_bimodality_ceiling():
+    # 8 torus-adjacent chips of 64 (12.5% — OVER the detector's 10%
+    # max_fraction ceiling) lose a tray together: the detector skips
+    # the link columns as "bimodal", but the engine's screen-gated
+    # uncapped scan must still group them into ONE fabric incident
+    blob = (17, 18, 19, 25, 26, 27, 33, 34)
+    cold = [(c, "xp") for c in blob]
+    det = StragglerDetector.from_config(Config())
+    eng = AnomalyEngine.from_config(Config(anomaly=True))
+    df, block = _frame(num_chips=64, cold_links=cold)
+    stragglers = det.evaluate(df, block=block)
+    # precondition: the ceiling really suppressed the straggler path
+    assert not any(
+        s["column"] in schema.ICI_LINK_GBPS.values() for s in stragglers
+    )
+    findings = eng.observe(1000.0, df, block=block, stragglers=stragglers)
+    fabric = [f for f in findings if f["kind"] == "fabric"]
+    assert len(fabric) == 1
+    assert sorted(fabric[0]["chips"]) == sorted(f"slice-0/{c}" for c in blob)
+
+
+def test_fabric_detection_with_straggler_detector_disabled():
+    # TPUDASH_STRAGGLER_RULES=off must not silently kill fabric
+    # detection: the service passes stragglers=None and the engine's
+    # own scan takes over (screen-gated, so healthy fleets stay free)
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(
+        source="synthetic", synthetic_chips=64, straggler_rules="off",
+        refresh_interval=0.0,
+    )
+    src = SyntheticSource(
+        num_chips=64,
+        emit_links=True,
+        cold_links=((17, "xp"), (18, "xn"), (25, "yp")),
+    )
+    svc = DashboardService(cfg, src)
+    assert svc.straggler_detector is None
+    for _ in range(3):
+        svc.refresh_data()
+    fabric = [f for f in svc.last_anomalies if f["kind"] == "fabric"]
+    assert fabric and sorted(fabric[0]["chips"]) == [
+        "slice-0/17", "slice-0/18", "slice-0/25",
+    ]
+
+
+def test_link_screen_quiet_on_healthy_fleet():
+    from tpudash.anomaly.detect import AnomalyEngine as _E
+
+    df, block = _frame(num_chips=64)
+    eng = AnomalyEngine.from_config(Config(anomaly=True))
+    present, x = eng._values(
+        df, block, sorted(schema.ICI_LINK_GBPS.values())
+    )
+    assert present and not _E._link_screen_fires(x)
+    df2, block2 = _frame(num_chips=64, cold_links=[(17, "xp")])
+    _p, x2 = eng._values(
+        df2, block2, sorted(schema.ICI_LINK_GBPS.values())
+    )
+    assert _E._link_screen_fires(x2)
+
+
+def test_baseline_seed_from_10m_only_store():
+    import time as _time
+
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.rollup import TIER_1M_MS
+
+    # 1m tier aged out under its (short) retention; 10m survives — the
+    # seed must fold the coarser quads instead of relearning from zero
+    store = TSDB(
+        path="", chunk_points=8, retention_raw_s=600.0,
+        retention_1m_s=600.0, retention_10m_s=30 * 86400.0,
+    )
+    now = _time.time()
+    base = float((int(now - 7200) // 600) * 600)
+    for i in range(60):  # an hour of minute points, all > 1m retention old
+        store.append_frame(
+            base + 60.0 * i, ["s/0"], [UTIL], np.array([[50.0]])
+        )
+    store.flush(seal_partial=True)
+    assert store.earliest_ms(TIER_1M_MS) is None  # precondition: 1m gone
+    bs = BaselineStore(bucket_s=86400.0)  # one bucket: every fold counts
+    folds = bs.seed_from_store(store, [UTIL])
+    assert folds >= MIN_COUNT
+    loc, _ = bs.matrices(["s/0"], [UTIL], ts_s=now)
+    assert loc[0, 0] == pytest.approx(50.0)
+
+
+def test_engine_baseline_deviation_fires_on_self_drift():
+    eng = AnomalyEngine.from_config(
+        Config(anomaly=True, anomaly_score_threshold=4.0)
+    )
+    keys = [f"s/{i}" for i in range(8)]
+    import pandas as pd
+
+    def mkdf(vals):
+        df = pd.DataFrame({UTIL: dict(zip(keys, vals))})
+        df["slice_id"] = "s"
+        df["chip_id"] = range(len(keys))
+        df["host"] = ""
+        return df
+
+    # warm every chip's baseline at ~90 with a little spread
+    base = np.array([90.0, 89.0, 91.0, 90.5, 89.5, 90.0, 91.0, 89.0])
+    t = 0.0
+    for m in range(MIN_COUNT + 1):
+        for k in range(3):
+            df = mkdf(base + 0.1 * k)
+            eng.observe(t, df, block=dense_block(df), stragglers=[])
+            t += 20.0
+    assert eng.alert_entries == []
+    # chip 3 sags to 40 while the FLEET median stays 90 — the fleet
+    # cross-section barely moves, but the chip's own baseline screams
+    sick = base.copy()
+    sick[3] = 40.0
+    for _ in range(3):
+        df = mkdf(sick)
+        eng.observe(t, df, block=dense_block(df), stragglers=[])
+        t += 20.0
+    fired = [e for e in eng.alert_entries if e["state"] == "firing"]
+    assert any(
+        e["chip"] == "s/3" and e["kind"] == "baseline" for e in fired
+    ), f"baseline deviation never fired: {eng.alert_entries}"
+
+
+def test_engine_disabled_by_config():
+    assert AnomalyEngine.from_config(Config(anomaly=False)) is None
+    for var in (
+        "TPUDASH_ANOMALY",
+        "TPUDASH_ANOMALY_BASELINE_WINDOW",
+        "TPUDASH_ANOMALY_SCORE_THRESHOLD",
+        "TPUDASH_ANOMALY_DWELL",
+        "TPUDASH_ANOMALY_JAX",
+    ):
+        assert var in DECLARED_ENV
+
+
+# --- timeline ---------------------------------------------------------------
+
+def _alert(rule="anomaly", chip="s/3", state="firing", **extra):
+    return dict(
+        rule=rule,
+        chip=chip,
+        state=state,
+        severity="warning",
+        column=UTIL,
+        value=9.0,
+        **extra,
+    )
+
+
+def test_timeline_opens_and_resolves_incident_with_stable_id():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+    tl.observe(100.0, [_alert(state="pending")], None)
+    assert tl.snapshot()["total"] == 0  # pending alone opens nothing
+    tl.observe(105.0, [_alert()], None)
+    tl.observe(110.0, [_alert()], None)  # steady: no duplicate events
+    snap = tl.snapshot()
+    assert snap["total"] == 1 and snap["open"] == 1
+    inc = snap["incidents"][0]
+    assert inc["rule"] == "anomaly" and inc["start"] == 105.0
+    assert [e["kind"] for e in inc["events"]] == ["fired"]
+    iid = inc["id"]
+    # same (rule, chip, start) → same id, every time
+    import hashlib
+
+    assert iid == hashlib.sha1(b"anomaly|s/3|105000").hexdigest()[:12]
+    tl.observe(130.0, [], None)
+    snap = tl.snapshot()
+    inc = snap["incidents"][0]
+    assert inc["state"] == "resolved" and inc["end"] == 130.0
+    assert inc["id"] == iid
+    assert [e["kind"] for e in inc["events"]] == ["fired", "resolved"]
+    assert inc["duration_s"] == pytest.approx(25.0)
+
+
+def test_timeline_stitches_child_flap_into_child_down_incident():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+
+    def fed(status):
+        return {"children": {"west": {"status": status, "staleness_s": 1.0}}}
+
+    # breaker-backed child_down incident opens…
+    tl.observe(10.0, [_alert(rule="child_down", chip="west")], fed("live"))
+    # …then the child flaps live→stale→dark→live: flips attach as events
+    tl.observe(12.0, [_alert(rule="child_down", chip="west")], fed("stale"))
+    tl.observe(14.0, [_alert(rule="child_down", chip="west")], fed("dark"))
+    tl.observe(16.0, [_alert(rule="child_down", chip="west")], fed("live"))
+    tl.observe(18.0, [], fed("live"))  # alert clears → incident resolves
+    snap = tl.snapshot()
+    assert snap["total"] == 1
+    inc = snap["incidents"][0]
+    kinds = [e["kind"] for e in inc["events"]]
+    assert kinds == [
+        "fired",
+        "child_status",
+        "child_status",
+        "child_status",
+        "resolved",
+    ]
+    flips = [
+        (e["from"], e["to"])
+        for e in inc["events"]
+        if e["kind"] == "child_status"
+    ]
+    assert flips == [("live", "stale"), ("stale", "dark"), ("dark", "live")]
+    assert inc["state"] == "resolved"
+
+
+def test_timeline_child_status_closed_when_child_down_takes_over():
+    # a sub-breaker flap opens a standalone child_status incident; when
+    # the breaker-backed child_down incident opens for the same child,
+    # the standalone one must close (open incidents are never GC'd — a
+    # dangling one would inflate the open count forever)
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+
+    def fed(status):
+        return {"children": {"west": {"status": status}}}
+
+    tl.observe(10.0, [], fed("live"))
+    tl.observe(12.0, [], fed("stale"))  # below breaker: standalone opens
+    assert tl.snapshot()["open"] == 1
+    tl.observe(14.0, [_alert(rule="child_down", chip="west")], fed("dark"))
+    by_rule = {i["rule"]: i for i in tl.snapshot()["incidents"]}
+    assert by_rule["child_status"]["state"] == "resolved"
+    assert by_rule["child_down"]["state"] == "open"
+    tl.observe(16.0, [], fed("live"))
+    snap = tl.snapshot()
+    assert snap["open"] == 0
+    assert all(i["state"] == "resolved" for i in snap["incidents"])
+
+
+def test_timeline_sub_breaker_flap_gets_standalone_incident():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+
+    def fed(status):
+        return {"children": {"east": {"status": status}}}
+
+    tl.observe(10.0, [], fed("live"))
+    tl.observe(12.0, [], fed("stale"))  # flap WITHOUT a child_down alert
+    tl.observe(14.0, [], fed("live"))
+    snap = tl.snapshot()
+    assert snap["total"] == 1
+    inc = snap["incidents"][0]
+    assert inc["rule"] == "child_status" and inc["chip"] == "east"
+    assert inc["state"] == "resolved"
+
+
+def test_timeline_version_drives_etag_and_silence_events():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+    v0 = tl.version
+    tl.observe(10.0, [_alert()], None)
+    assert tl.version > v0
+    v1 = tl.version
+    tl.observe(11.0, [_alert()], None)  # steady state: no version churn
+    assert tl.version == v1
+    tl.observe(12.0, [_alert(silenced=True)], None)
+    (inc,) = tl.snapshot()["incidents"]
+    assert [e["kind"] for e in inc["events"]] == ["fired", "silenced"]
+
+
+def test_timeline_evidence_urls():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+    tl.observe(
+        100.0,
+        [
+            _alert(
+                evidence={
+                    "range": {
+                        "chip": "s/3",
+                        "cols": [UTIL],
+                        "start": 50.0,
+                        "end": 150.0,
+                    }
+                }
+            ),
+            dict(
+                _alert(rule="overload", chip="server"), column="server"
+            ),
+        ],
+        None,
+    )
+    by_rule = {i["rule"]: i for i in tl.snapshot()["incidents"]}
+    ev = by_rule["anomaly"]["evidence"]
+    assert ev["url"].startswith("/api/range?chip=s/3&start=50.000&end=150.000")
+    # synthesized plumbing rules fall back to the fleet pseudo-series
+    ev2 = by_rule["overload"]["evidence"]
+    assert ev2["chip"] is None and "chip=" not in ev2["url"]
+
+
+def test_timeline_bounds_and_paused():
+    tl = IncidentTimeline(clock=lambda: 1000.0, max_incidents=4, max_events=2)
+    tl.paused = True
+    tl.observe(1.0, [_alert()], None)
+    assert tl.snapshot()["total"] == 0  # profile bursts tell no stories
+    tl.paused = False
+    for i in range(8):
+        tl.observe(float(i), [_alert(chip=f"s/{i}")], None)
+        tl.observe(float(i) + 0.5, [], None)
+    snap = tl.snapshot(limit=100)
+    assert snap["total"] <= 4  # resolved incidents aged out oldest-first
+
+
+# --- replay: the what-if twin -----------------------------------------------
+
+def _write_capture(path, frames):
+    """A recorder-shaped JSONL from (ts, cold_links) specs."""
+    from tpudash.exporter.textfmt import encode_samples
+
+    with open(path, "w", encoding="utf-8") as f:
+        for ts, cold in frames:
+            samples = parse_instant_query(
+                synthetic_payload(
+                    num_chips=32, t=ts, emit_links=True, cold_links=cold
+                )
+            )
+            f.write(
+                json.dumps({"ts": ts, "text": encode_samples(samples)}) + "\n"
+            )
+
+
+@pytest.fixture()
+def capture_path(tmp_path):
+    path = str(tmp_path / "capture.jsonl")
+    cold = [(17, "xp")]
+    frames = [(1000.0 + i, ()) for i in range(3)]
+    frames += [(1003.0 + i, cold) for i in range(8)]
+    frames += [(1011.0 + i, ()) for i in range(3)]
+    _write_capture(path, frames)
+    return path
+
+
+def test_replay_capture_detects_and_resolves_on_recorded_time(capture_path):
+    snap = run_capture(capture_path, Config(anomaly=True))
+    incs = [
+        i
+        for i in snap["incidents"]
+        if i["rule"] == "anomaly" and i["chip"] == "slice-0/17"
+    ]
+    assert len(incs) == 1
+    inc = incs[0]
+    # recorded time, not wall time: the capture lives at epoch ~1000
+    assert 1003.0 <= inc["start"] <= 1011.0
+    assert inc["state"] == "resolved" and inc["end"] <= 1014.0
+    assert snap["frames"] == 14
+
+
+def test_replay_changed_threshold_is_a_counterfactual(capture_path):
+    control = run_capture(capture_path, Config(anomaly=True))
+    variant = run_capture(
+        capture_path, Config(anomaly=True, anomaly_score_threshold=999.0)
+    )
+    diff = diff_timelines(control, variant)
+    assert diff["summary"]["removed"] == 1
+    assert diff["removed"][0]["chip"] == "slice-0/17"
+    assert diff["summary"]["added"] == 0
+    # determinism: the same capture + config reproduce identical ids
+    again = run_capture(capture_path, Config(anomaly=True))
+    assert [i["id"] for i in again["incidents"]] == [
+        i["id"] for i in control["incidents"]
+    ]
+    assert diff_timelines(control, again)["summary"] == {
+        "added": 0,
+        "removed": 0,
+        "matched": 1,
+        "shifted": 0,
+    }
+
+
+def test_replay_longer_straggler_cycles_shift_fire_latency(capture_path):
+    control = run_capture(capture_path, Config(anomaly=True))
+    slower = run_capture(
+        capture_path,
+        Config(
+            anomaly=True,
+            straggler_rules=",".join(
+                f"{c}:low@6" for c in schema.ICI_LINK_GBPS.values()
+            ),
+        ),
+    )
+    diff = diff_timelines(control, slower, tolerance_s=0.5)
+    assert diff["summary"]["matched"] == 1
+    m = diff["matched"][0]
+    # 3 extra consecutive-breach cycles at the 1 s capture cadence
+    assert m["latency_delta_s"] == pytest.approx(3.0, abs=0.6)
+    assert m["shifted"] is True
+
+
+def test_replay_cli_json_and_diff(capture_path, tmp_path, capsys, monkeypatch):
+    from tpudash.anomaly.__main__ import main
+
+    for var in list(os.environ):
+        if var.startswith("TPUDASH_"):
+            monkeypatch.delenv(var, raising=False)
+    out_path = str(tmp_path / "timeline.json")
+    with pytest.raises(SystemExit) as exc:
+        main(
+            [
+                "replay",
+                "--capture",
+                capture_path,
+                "--threshold",
+                "999",
+                "--save",
+                out_path,
+                "--json",
+            ]
+        )
+    assert exc.value.code == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["diff"]["summary"]["removed"] == 1
+    assert doc["variant"]["incidents"] == []
+    assert json.load(open(out_path)) == doc["variant"]
+
+
+def test_replay_clock_is_injectable():
+    clk = ReplayClock(5.0)
+    assert clk() == 5.0
+    clk.now = 9.0
+    assert clk() == 9.0
+
+
+# --- service + server integration -------------------------------------------
+
+def _cold_link_service(**cfg_kwargs):
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(
+        source="synthetic",
+        synthetic_chips=32,
+        synthetic_links=True,
+        refresh_interval=0.0,
+        **cfg_kwargs,
+    )
+    src = SyntheticSource(
+        num_chips=32, emit_links=True, cold_links=((17, "xp"),)
+    )
+    return DashboardService(cfg, src)
+
+
+def test_service_publishes_anomalies_and_alerts():
+    svc = _cold_link_service()
+    for _ in range(5):
+        svc.refresh_data()
+    frame = svc.compose_frame()
+    assert any(a["chip"] == "slice-0/17" for a in frame["anomalies"])
+    entries = [a for a in svc.last_alerts if a["rule"] == "anomaly"]
+    assert entries and entries[0]["state"] == "firing"
+    assert entries[0]["score"] > 0 and entries[0]["evidence"]
+    # and the timeline opened the incident
+    snap = svc.timeline.snapshot()
+    assert any(
+        i["rule"] == "anomaly" and i["state"] == "open"
+        for i in snap["incidents"]
+    )
+
+
+def test_service_anomaly_rides_silences():
+    svc = _cold_link_service()
+    for _ in range(5):
+        svc.refresh_data()
+    import time as _time
+
+    svc.silences.add("anomaly", "slice-0/17", 600.0, _time.time())
+    svc.refresh_data()
+    entry = next(a for a in svc.last_alerts if a["rule"] == "anomaly")
+    assert entry["silenced"] is True
+
+
+def test_synthetic_load_pauses_engine_and_timeline():
+    svc = _cold_link_service()
+    for _ in range(5):
+        svc.refresh_data()
+    before_findings = svc.last_anomalies
+    before_version = svc.timeline.version
+    with svc.synthetic_load():
+        assert svc.anomaly_engine.paused and svc.timeline.paused
+        svc.refresh_data()
+    assert not svc.anomaly_engine.paused and not svc.timeline.paused
+    assert svc.last_anomalies is before_findings
+    assert svc.timeline.version == before_version
+
+
+def test_anomaly_pages_with_threshold_alerting_disabled():
+    # TPUDASH_ALERT_RULES=off must not silently drop anomaly paging:
+    # the alert plane exists when EITHER engine is on, and the replay
+    # twin (which merges unconditionally) agrees with live
+    svc = _cold_link_service(alert_rules="off")
+    assert svc.alert_engine is None and svc.anomaly_engine is not None
+    for _ in range(5):
+        svc.refresh_data()
+    entries = [a for a in svc.last_alerts if a["rule"] == "anomaly"]
+    assert entries and entries[0]["state"] == "firing"
+    frame = svc.compose_frame()
+    assert any(a["rule"] == "anomaly" for a in frame["alerts"])
+    assert any(
+        i["rule"] == "anomaly" for i in svc.timeline.snapshot()["incidents"]
+    )
+
+
+def test_timeline_filtered_snapshot_keeps_global_counts():
+    tl = IncidentTimeline(clock=lambda: 1000.0)
+    tl.observe(1.0, [_alert(chip="s/1")], None)          # stays open
+    tl.observe(2.0, [_alert(chip="s/1"), _alert(chip="s/2")], None)
+    tl.observe(3.0, [_alert(chip="s/1")], None)          # s/2 resolves
+    snap = tl.snapshot(state="resolved")
+    assert [i["chip"] for i in snap["incidents"]] == ["s/2"]
+    # global truth, not the filtered view's
+    assert snap["open"] == 1 and snap["total"] == 2
+
+
+def test_anomaly_disabled_service_still_has_timeline():
+    from tpudash.app.service import DashboardService
+
+    cfg = Config(source="synthetic", synthetic_chips=16, anomaly=False,
+                 refresh_interval=0.0)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=16))
+    svc.refresh_data()
+    frame = svc.compose_frame()
+    assert svc.anomaly_engine is None
+    assert "anomalies" not in frame
+    assert svc.timeline is not None  # transitions still stitch
+
+
+def test_baseline_persists_via_close_analysis(tmp_path):
+    from tpudash.app.service import DashboardService
+
+    tsdb_dir = str(tmp_path / "tsdb")
+    os.makedirs(tsdb_dir)
+    cfg = Config(
+        source="synthetic", synthetic_chips=8, tsdb_path=tsdb_dir,
+        refresh_interval=0.0,
+    )
+    svc = DashboardService(cfg, SyntheticSource(num_chips=8))
+    svc.refresh_data()
+    svc.close_analysis()
+    assert os.path.exists(os.path.join(tsdb_dir, "baselines.npz"))
+
+
+def test_incidents_endpoint_etag_filters_and_evidence():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        svc = _cold_link_service()
+        for _ in range(5):
+            svc.refresh_data()
+        app = DashboardServer(svc).build_app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/api/incidents")
+            assert r.status == 200
+            etag = r.headers["ETag"]
+            doc = await r.json()
+            assert doc["total"] >= 1 and doc["open"] >= 1
+            inc = next(i for i in doc["incidents"] if i["rule"] == "anomaly")
+            assert inc["id"] and inc["events"][0]["kind"] == "fired"
+            # steady state: 304, no body
+            r304 = await client.get(
+                "/api/incidents", headers={"If-None-Match": etag}
+            )
+            assert r304.status == 304
+            # filters + validation
+            r_open = await client.get("/api/incidents?state=open&limit=1")
+            assert len((await r_open.json())["incidents"]) == 1
+            r_bad = await client.get("/api/incidents?state=bogus")
+            assert r_bad.status == 400
+            # the evidence link resolves to a REAL range window
+            r_ev = await client.get(inc["evidence"]["url"])
+            assert r_ev.status == 200
+            series = (await r_ev.json())["series"]
+            assert sum(len(v) for v in series.values()) > 0
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_timings_reports_anomaly_backend():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+
+    async def go():
+        svc = _cold_link_service()
+        svc.refresh_data()
+        app = DashboardServer(svc).build_app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            doc = await (await client.get("/api/timings")).json()
+            assert doc["anomaly"]["backend"] in ("numpy", "jax")
+            assert doc["anomaly"]["ticks"] >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(go())

@@ -63,13 +63,17 @@ def sort_alerts(alerts: "list[dict]") -> "list[dict]":
 #: ``compose_down`` is synthesized by the fan-out workers while they
 #: serve stale mirrors through a compose outage
 #: (tpudash/broadcast/worker.py) — it can never originate from the
-#: compose process, which is the thing that is down.
+#: compose process, which is the thing that is down.  ``anomaly`` is the
+#: detection layer's rule (tpudash/anomaly/detect.py): baseline
+#: deviation, promoted stragglers, and torus-correlated ICI fabric
+#: degradation, carrying ``kind``/``score``/``evidence`` extras.
 SYNTHESIZED_RULES = (
     "endpoint_down",
     "overload",
     "compose_down",
     "child_down",
     "fleet_partial",
+    "anomaly",
 )
 
 

@@ -1,0 +1,41 @@
+"""tpudash.anomaly — online anomaly detection, incident timelines, and
+what-if replay over the tsdb (ROADMAP #1, the layer that turns the
+dashboard from "renders metrics" into "detects, explains, and replays
+incidents").
+
+Four pieces, each its own module, each independently tested:
+
+- :mod:`tpudash.anomaly.baselines` — per-chip seasonal baselines
+  (winsorized location/scale per metric per time-of-interval bucket)
+  folded incrementally from 1-minute rollup aggregates, persisted beside
+  the tsdb, with a batch scoring path that runs as one vectorized call
+  per tick (numpy always; an optional jax-jitted kernel sharded over the
+  chip axis for fleet-scale scoring — ``TPUDASH_ANOMALY_JAX``);
+- :mod:`tpudash.anomaly.detect` — the online engine on the refresh
+  path: baseline-deviation outliers, the straggler scoring core
+  (tpudash.stragglers.robust_scores) over the fleet cross-section, and
+  ICI-link degradation correlated across torus neighbors (a chip whose
+  neighbors' link counters degrade together is ONE fabric incident, not
+  N chip incidents), synthesized as the ``anomaly`` rule riding the
+  existing dwell/silences/webhook machinery with scores and evidence in
+  the alert detail;
+- :mod:`tpudash.anomaly.timeline` — the incident timeline behind
+  ``GET /api/incidents``: alert state transitions, federation
+  child-status flips, and ``/api/range`` evidence windows stitched into
+  ordered incident objects with stable ids;
+- :mod:`tpudash.anomaly.replay` — the what-if twin: feed a recorder
+  capture (or a tsdb time range) through a modified
+  rule/threshold/dwell/baseline config and diff the resulting timeline
+  against what actually fired (``python -m tpudash.anomaly replay``).
+
+Grounding: "TX-Digital Twin" (replay recorded telemetry through changed
+analysis, diff outcomes) and "Host-Side Telemetry for Performance
+Diagnosis" (automated per-device baselining + cross-signal correlation)
+— see PAPERS.md.
+"""
+
+from tpudash.anomaly.baselines import BaselineStore
+from tpudash.anomaly.detect import AnomalyEngine
+from tpudash.anomaly.timeline import IncidentTimeline
+
+__all__ = ["AnomalyEngine", "BaselineStore", "IncidentTimeline"]

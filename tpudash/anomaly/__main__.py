@@ -1,0 +1,154 @@
+"""CLI for the anomaly layer — the what-if replay twin.
+
+``python -m tpudash.anomaly replay --capture incident.jsonl`` replays a
+recorder capture (or, with ``--tsdb DIR``, a tsdb time range) through
+the full analysis pipeline on RECORDED time and prints the incident
+timeline.  Passing any analysis override (``--threshold``, ``--dwell``,
+``--rules``, ``--straggler-rules``, ``--baseline-window``,
+``--anomaly``) runs the capture twice — once under the unmodified
+environment config ("what actually fired") and once under the overrides
+— and prints the counterfactual diff: incidents added / removed /
+shifted, with per-incident fire-latency deltas.  ``--against`` replaces
+the control run with an exported ``/api/incidents`` document (diff
+against what the LIVE dashboard recorded).
+
+See docs/OPERATIONS.md (anomaly & incident runbook) for the workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpudash.config import configure_logging, load_config
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudash.anomaly",
+        description="anomaly-layer tools (what-if incident replay)",
+    )
+    sub = parser.add_subparsers(dest="mode")
+    rp = sub.add_parser(
+        "replay",
+        help="replay a capture / tsdb range through a modified analysis "
+        "config and diff the incident timelines",
+    )
+    src = rp.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--capture", help="recorder JSONL (TPUDASH_RECORD_PATH output)"
+    )
+    src.add_argument("--tsdb", help="tsdb segment directory (read-only)")
+    rp.add_argument("--start", type=float, help="tsdb mode: window start, epoch s")
+    rp.add_argument("--end", type=float, help="tsdb mode: window end, epoch s")
+    rp.add_argument(
+        "--step", type=float, default=60.0, help="tsdb mode: frame step, s"
+    )
+    rp.add_argument("--rules", help="override TPUDASH_ALERT_RULES")
+    rp.add_argument(
+        "--straggler-rules", help="override TPUDASH_STRAGGLER_RULES"
+    )
+    rp.add_argument(
+        "--threshold",
+        type=float,
+        help="override TPUDASH_ANOMALY_SCORE_THRESHOLD",
+    )
+    rp.add_argument(
+        "--dwell", type=float, help="override TPUDASH_ANOMALY_DWELL"
+    )
+    rp.add_argument(
+        "--baseline-window",
+        type=float,
+        help="override TPUDASH_ANOMALY_BASELINE_WINDOW",
+    )
+    rp.add_argument(
+        "--anomaly",
+        choices=("0", "1"),
+        help="override TPUDASH_ANOMALY (0 disables the engine)",
+    )
+    rp.add_argument(
+        "--against",
+        help="diff against this exported /api/incidents JSON instead of "
+        "a second (unmodified-config) replay run",
+    )
+    rp.add_argument("--save", help="write the variant timeline JSON here")
+    rp.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    return parser
+
+
+def _run(args, cfg) -> dict:
+    from tpudash.anomaly.replay import run_capture, run_tsdb
+
+    if args.capture:
+        return run_capture(args.capture, cfg)
+    return run_tsdb(
+        args.tsdb, cfg, start_s=args.start, end_s=args.end, step_s=args.step
+    )
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if args.mode != "replay":
+        parser.print_help()
+        sys.exit(2)
+    configure_logging()
+    from tpudash.anomaly.replay import apply_overrides, diff_timelines
+
+    base_cfg = load_config()
+    overrides = {
+        "alert_rules": args.rules,
+        "straggler_rules": args.straggler_rules,
+        "anomaly_score_threshold": args.threshold,
+        "anomaly_dwell": args.dwell,
+        "anomaly_baseline_window": args.baseline_window,
+        "anomaly": (args.anomaly == "1") if args.anomaly is not None else None,
+    }
+    has_overrides = any(v is not None for v in overrides.values())
+    variant = _run(args, apply_overrides(base_cfg, overrides))
+    if args.save:
+        with open(args.save, "w", encoding="utf-8") as f:
+            json.dump(variant, f, indent=2)
+    control = None
+    if args.against:
+        with open(args.against, encoding="utf-8") as f:
+            control = json.load(f)
+    elif has_overrides:
+        control = _run(args, base_cfg)
+    out: dict = {"variant": variant}
+    if control is not None:
+        out["control"] = control
+        out["diff"] = diff_timelines(control, variant)
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for inc in variant["incidents"]:
+            line = (
+                f"[{inc['state']:>8}] {inc['rule']} on {inc['chip']} "
+                f"start={inc['start']:.1f} dur={inc['duration_s']:.1f}s "
+                f"events={len(inc['events'])} id={inc['id']}"
+            )
+            print(line)
+        print(
+            f"-- {variant['total']} incidents ({variant['open']} open) "
+            f"over {variant['frames']} frames"
+        )
+        if control is not None:
+            d = out["diff"]["summary"]
+            print(
+                f"-- vs control: +{d['added']} added, -{d['removed']} "
+                f"removed, {d['shifted']}/{d['matched']} matched shifted"
+            )
+            for m in out["diff"]["shifted"]:
+                print(
+                    f"   shifted {m['rule']} on {m['chip']}: "
+                    f"latency {m['latency_delta_s']:+.1f}s"
+                )
+    sys.exit(0)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

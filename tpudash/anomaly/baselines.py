@@ -1,0 +1,539 @@
+"""Per-chip seasonal baselines from tsdb rollup aggregates.
+
+What "normal" means for one chip depends on when you ask: a training
+fleet's duty cycle at 03:00 (checkpoint window) is not its duty cycle at
+14:00 (steady step time).  A single global mean would page on the diurnal
+pattern itself.  The baseline store therefore keeps, for every
+``(series key, metric column, time-of-interval bucket)``, a robust
+location/scale pair — and scores a live value against the bucket the
+current wall clock falls in.
+
+Incremental, rollup-shaped ingest
+---------------------------------
+The store never keeps raw points.  The live refresh path accumulates
+each tick's ``[chips × metrics]`` matrix into a current-minute
+``sum/count`` accumulator — exactly the aggregate the tsdb's 1m rollup
+quads carry — and when the minute rolls over, folds that minute's MEANS
+into the matching time-of-interval bucket.  :meth:`seed_from_store`
+replays the same fold over the tsdb's persisted 1m (and, for the range
+before 1m reaches, 10m) rollup quads at startup, so a restarted
+dashboard scores against the seasonality it already recorded instead of
+relearning from zero.  One fold path, two feeders — the exactness test
+pins the fold against hand-computed rollups.
+
+Robust location/scale, incrementally
+------------------------------------
+True medians need the points; a streaming baseline cannot keep them.
+The store runs *winsorized* Welford moments instead: once a bucket has
+``warm_count`` samples, each new minute-mean is clamped to
+``mean ± clamp_k·std`` **before** the standard ``(count, mean, M2)``
+update.  A genuinely anomalous minute therefore nudges the baseline by
+at most ``clamp_k`` standard deviations' worth instead of dragging it
+toward the anomaly — the incremental analogue of the median/MAD trick in
+tpudash.stragglers, deterministic and exactly reproducible (the test
+suite hand-computes it).  ``scale`` is floored at ``rel_floor·|loc|``
+(the lockstep all-chips-identical case) and at ``eps``.
+
+Batch scoring — numpy always, jax when asked
+--------------------------------------------
+Scoring is one vectorized ``z = (x − loc) / scale`` over the aligned
+``[chips × metrics]`` matrices per tick — no per-chip Python.  With
+``TPUDASH_ANOMALY_JAX=1`` the kernel is jax-jitted and, on multi-device
+hosts, sharded over the chip axis with ``NamedSharding`` (the scoring
+then rides the same accelerators the dashboard monitors — fleet-scale
+federated parents score 100k+ chips in one batched call).  The numpy
+fallback is always available and ``JAX_PLATFORMS=cpu``-safe; both paths
+compute in float32 and agree within documented tolerance (see
+``scorer_parity`` in tests/test_anomaly.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: seconds per day — the seasonal period the buckets tile
+DAY_S = 86400.0
+
+#: winsorization starts once a bucket has this many folded minutes
+#: (before that the std estimate is too noisy to clamp against)
+WARM_COUNT = 8
+
+#: clamp radius for the winsorized update, in standard deviations
+CLAMP_K = 4.0
+
+#: a bucket scores values only after this many folded minutes — a
+#: colder bucket answers NaN (no score, never a wild one)
+MIN_COUNT = 5
+
+#: scale floor relative to |location| (the lockstep MAD==0 analogue)
+REL_FLOOR = 0.02
+
+_EPS = 1e-9
+
+
+def make_scorer(use_jax: bool):
+    """Build the batch scoring callable ``(x, loc, scale) -> z`` (all
+    ``[K, C]`` float arrays; NaN in, NaN out) plus the backend name.
+
+    ``use_jax=True`` tries the jitted kernel (sharded over the chip axis
+    when the host exposes multiple devices and the population divides
+    evenly); any import/device failure falls back to numpy LOUDLY (the
+    backend name says which path actually runs — surfaced on
+    ``/api/timings``)."""
+    if use_jax:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def _kernel(x, loc, scale):
+                return (x - loc) / scale
+
+            devices = jax.devices()
+
+            def _jax_score(x, loc, scale):
+                arrs = [
+                    jnp.asarray(np.asarray(a, dtype=np.float32))
+                    for a in (x, loc, scale)
+                ]
+                if len(devices) > 1 and arrs[0].shape[0] % len(devices) == 0:
+                    # SNIPPETS.md sharding pattern: mesh over the chip
+                    # axis, device_put each operand, jit runs sharded
+                    from jax.sharding import (
+                        NamedSharding,
+                        PartitionSpec as P,
+                    )
+
+                    mesh = jax.sharding.Mesh(np.array(devices), ("chips",))
+                    sh = NamedSharding(mesh, P("chips"))
+                    arrs = [jax.device_put(a, sh) for a in arrs]
+                return np.asarray(_kernel(*arrs))
+
+            return _jax_score, "jax"
+        except Exception as e:  # noqa: BLE001 — jax is strictly optional
+            log.warning("jax scoring unavailable, using numpy: %s", e)
+
+    def _np_score(x, loc, scale):
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return (
+                np.asarray(x, dtype=np.float32)
+                - np.asarray(loc, dtype=np.float32)
+            ) / np.asarray(scale, dtype=np.float32)
+
+    return _np_score, "numpy"
+
+
+class _ColStats:
+    """One metric column's (count, mean, M2) planes, ``[keys × buckets]``."""
+
+    __slots__ = ("n", "mean", "m2")
+
+    def __init__(self, k: int, b: int):
+        self.n = np.zeros((k, b), dtype=np.float64)
+        self.mean = np.zeros((k, b), dtype=np.float64)
+        self.m2 = np.zeros((k, b), dtype=np.float64)
+
+    def grow(self, k: int) -> None:
+        add = k - self.n.shape[0]
+        if add <= 0:
+            return
+        b = self.n.shape[1]
+        for name in ("n", "mean", "m2"):
+            setattr(
+                self,
+                name,
+                np.vstack(
+                    [getattr(self, name), np.zeros((add, b), dtype=np.float64)]
+                ),
+            )
+
+
+class BaselineStore:
+    """Seasonal per-(key, column) baselines with minute-fold ingest,
+    bucket-aligned batch scoring matrices, and npz persistence.
+
+    Thread-safety: all mutating entry points take the internal lock; the
+    service calls ``ingest``/``matrices`` under its publish lock anyway,
+    but the replay CLI and tests drive stores directly."""
+
+    def __init__(self, bucket_s: float = 3600.0):
+        if not bucket_s > 0:
+            raise ValueError("bucket_s must be positive")
+        # buckets tile the day; a width over a day degrades to ONE
+        # bucket (no seasonality, one global baseline per series)
+        self.bucket_s = float(bucket_s)
+        self.buckets = max(1, int(round(DAY_S / self.bucket_s)))
+        self._lock = threading.Lock()
+        self._keys: list[str] = []
+        self._key_pos: dict[str, int] = {}
+        self._cols: dict[str, _ColStats] = {}
+        #: bumps on every fold/growth/load — matrices-cache invalidation
+        self.version = 0
+        #: folded minutes (stat for /api/timings + tests)
+        self.folds = 0
+        # current-minute pending accumulator (live ingest path)
+        self._pend_minute: "int | None" = None
+        self._pend_keys: "tuple | None" = None
+        self._pend_keys_ref: "object | None" = None
+        self._pend_cols: "tuple | None" = None
+        self._pend_sum: "np.ndarray | None" = None
+        self._pend_cnt: "np.ndarray | None" = None
+        # matrices cache: one assembly per (version, bucket, population)
+        self._mat_cache: "tuple | None" = None
+
+    # -- geometry ------------------------------------------------------------
+    def bucket_of(self, ts_s: float) -> int:
+        """Time-of-interval bucket index for an epoch timestamp."""
+        return int((float(ts_s) % DAY_S) // self.bucket_s) % self.buckets
+
+    def _rows(self, keys) -> np.ndarray:
+        pos = self._key_pos
+        missing = [k for k in keys if k not in pos]
+        if missing:
+            start = len(self._keys)
+            for i, k in enumerate(missing):
+                pos[k] = start + i
+            self._keys.extend(missing)
+            for st in self._cols.values():
+                st.grow(len(self._keys))
+            self.version += 1
+        return np.fromiter(
+            (pos[k] for k in keys), dtype=np.int64, count=len(keys)
+        )
+
+    def _col(self, col: str) -> _ColStats:
+        st = self._cols.get(col)
+        if st is None:
+            st = self._cols[col] = _ColStats(len(self._keys), self.buckets)
+            self.version += 1
+        return st
+
+    # -- the fold (ONE implementation, live + seed both call it) -------------
+    def _fold_matrix(self, ts_s: float, keys, cols, means, valid) -> None:
+        """Fold one minute's per-series means into the bucket ``ts_s``
+        falls in.  ``means``/``valid`` are ``[len(keys), len(cols)]``;
+        invalid cells contribute nothing.  Caller holds the lock."""
+        b = self.bucket_of(ts_s)
+        rows = self._rows(keys)
+        for j, col in enumerate(cols):
+            ok = valid[:, j]
+            if not ok.any():
+                continue
+            st = self._col(col)
+            rr = rows[ok]
+            v = np.asarray(means[ok, j], dtype=np.float64)
+            n = st.n[rr, b]
+            mean = st.mean[rr, b]
+            m2 = st.m2[rr, b]
+            # winsorize against the CURRENT estimate once warm: the
+            # anomalous minute being scored must not drag its own
+            # baseline toward itself
+            with np.errstate(invalid="ignore"):
+                std = np.sqrt(np.where(n > 0, m2 / np.maximum(n, 1), 0.0))
+            warm = (n >= WARM_COUNT) & (std > 0)
+            lo = mean - CLAMP_K * std
+            hi = mean + CLAMP_K * std
+            v = np.where(warm, np.clip(v, lo, hi), v)
+            n1 = n + 1.0
+            delta = v - mean
+            mean1 = mean + delta / n1
+            st.n[rr, b] = n1
+            st.mean[rr, b] = mean1
+            st.m2[rr, b] = m2 + delta * (v - mean1)
+        self.folds += 1
+        self.version += 1
+
+    # -- live ingest ---------------------------------------------------------
+    def ingest(self, ts_s: float, keys, cols, matrix) -> None:
+        """Accumulate one refresh tick's aligned ``[keys × cols]`` value
+        matrix; when the wall minute rolls over, fold the completed
+        minute's means.  NaN cells contribute nothing.
+
+        Hot path (runs every refresh at fleet scale): the population
+        check rides object identity first — the service passes the same
+        keys list while the population is unchanged — so the steady
+        state is three vectorized array ops, no tuple builds."""
+        minute = int(float(ts_s) // 60.0)
+        arr = np.asarray(matrix, dtype=np.float64)
+        with self._lock:
+            if self._pend_minute is not None:
+                same_pop = self._pend_keys_ref is keys or tuple(
+                    keys
+                ) == self._pend_keys
+                if (
+                    minute != self._pend_minute
+                    or not same_pop
+                    or tuple(cols) != self._pend_cols
+                ):
+                    self.flush_pending()
+            if self._pend_minute is None:
+                self._pend_minute = minute
+                self._pend_keys, self._pend_cols = tuple(keys), tuple(cols)
+                self._pend_keys_ref = keys
+                self._pend_sum = np.zeros(arr.shape, dtype=np.float64)
+                self._pend_cnt = np.zeros(arr.shape, dtype=np.int64)
+            ok = np.isfinite(arr)
+            # masked in-place add: no np.where temporary on the hot path
+            np.add(self._pend_sum, arr, out=self._pend_sum, where=ok)
+            np.add(self._pend_cnt, 1, out=self._pend_cnt, where=ok)
+
+    def flush_pending(self) -> None:
+        """Fold whatever the pending minute holds (population change,
+        shutdown, or the minute rolling over).  Caller holds the lock —
+        or owns the store exclusively (replay, tests)."""
+        if self._pend_minute is None or self._pend_cnt is None:
+            return
+        cnt = self._pend_cnt
+        valid = cnt > 0
+        if valid.any():
+            with np.errstate(invalid="ignore", divide="ignore"):
+                means = np.where(valid, self._pend_sum / np.maximum(cnt, 1), np.nan)
+            self._fold_matrix(
+                self._pend_minute * 60.0,
+                list(self._pend_keys),
+                list(self._pend_cols),
+                means,
+                valid,
+            )
+        self._pend_minute = None
+        self._pend_keys = self._pend_cols = self._pend_keys_ref = None
+        self._pend_sum = self._pend_cnt = None
+
+    # -- seeding from the tsdb ----------------------------------------------
+    def seed_from_store(
+        self,
+        store,
+        cols,
+        window_s: "float | None" = None,
+        key_chunk: int = 32,
+    ) -> int:
+        """Replay the tsdb's persisted rollup quads through the SAME
+        fold the live path uses: 1m quads where the 1m tier reaches, 10m
+        quads for the older range (or the whole window when the 1m tier
+        aged out entirely — each 10m quad folds once, a coarser sample
+        of the same seasonality).  Time-ascending per series, so the
+        winsorized moments match what the live path would have computed.
+        Returns the number of minute-folds applied.
+
+        Runs synchronously at startup, so it must stay bounded: series
+        are processed in ``key_chunk``-sized groups (memory is one
+        chunk's quads, never the whole fleet × window flat — series are
+        independent, so chunked fold order is exactly equivalent), and
+        callers bound ``window_s`` (the engine seeds 2 days — each
+        time-of-day bucket collects ~60 minute-folds per day, far past
+        WARM_COUNT, so older quads add nothing)."""
+        from tpudash.tsdb.rollup import TIER_1M_MS
+
+        latest = store.latest_ms()
+        if latest is None:
+            return 0
+        start_ms = 0
+        if window_s:
+            start_ms = latest - int(window_s * 1000)
+        e1 = store.earliest_ms(TIER_1M_MS)
+        total = 0
+        keys_all = sorted(store.series_keys())
+        for i in range(0, len(keys_all), max(1, int(key_chunk))):
+            total += self._seed_chunk(
+                store, keys_all[i : i + key_chunk], cols, start_ms,
+                latest, e1,
+            )
+        return total
+
+    def _seed_chunk(self, store, chunk_keys, cols, start_ms, latest, e1) -> int:
+        """Gather (t_ms, key, col, mean) for one key chunk, group by
+        minute, fold vectorized.  Caller iterates chunks ascending —
+        per-series time order (all that winsorization depends on) holds
+        regardless of chunking."""
+        from tpudash.tsdb.rollup import TIER_1M_MS, TIER_10M_MS, merge_quads
+
+        entries: list = []
+        for key in chunk_keys:
+            for col in cols:
+                if col not in store.series_cols(key):
+                    continue
+                quads = []
+                if e1 is None:
+                    # the 1m tier aged out entirely (long downtime, old
+                    # snapshot): the 10m tier alone still carries the
+                    # seasonality — coarser folds beat relearning a day
+                    quads += store.rollup_window(
+                        TIER_10M_MS, key, col, start_ms, latest
+                    )
+                else:
+                    if e1 > start_ms:
+                        quads += store.rollup_window(
+                            TIER_10M_MS, key, col, start_ms, e1 - 1
+                        )
+                    quads += store.rollup_window(
+                        TIER_1M_MS, key, col, max(start_ms, e1), latest
+                    )
+                for bt, _mn, _mx, sm, cnt in merge_quads(quads):
+                    if cnt > 0:
+                        entries.append((bt, key, col, sm / cnt))
+        if not entries:
+            return 0
+        entries.sort(key=lambda e: e[0])
+        folds = 0
+        with self._lock:
+            i = 0
+            while i < len(entries):
+                t0 = entries[i][0]
+                group = []
+                while i < len(entries) and entries[i][0] == t0:
+                    group.append(entries[i])
+                    i += 1
+                keys = sorted({g[1] for g in group})
+                gcols = sorted({g[2] for g in group})
+                kp = {k: r for r, k in enumerate(keys)}
+                cp = {c: j for j, c in enumerate(gcols)}
+                means = np.full((len(keys), len(gcols)), np.nan)
+                for _t, k, c, m in group:
+                    means[kp[k], cp[c]] = m
+                self._fold_matrix(
+                    t0 / 1000.0, keys, gcols, means, np.isfinite(means)
+                )
+                folds += 1
+        return folds
+
+    # -- scoring matrices ----------------------------------------------------
+    def matrices(self, keys, cols, ts_s: float):
+        """``(loc, scale)`` float64 ``[len(keys), len(cols)]`` aligned to
+        the caller's population for the bucket ``ts_s`` falls in.  Cells
+        with no (or too-cold, < MIN_COUNT folds) baseline are NaN — the
+        scorer's NaN-in/NaN-out contract turns them into "no score".
+
+        Cached per (store version, bucket, population identity): the
+        service passes the same keys list object while the population is
+        unchanged, so steady-state assembly is one cache hit per fold.
+        """
+        b = self.bucket_of(ts_s)
+        with self._lock:
+            cache = self._mat_cache
+            if (
+                cache is not None
+                and cache[0] == (self.version, b)
+                and cache[1] is keys
+                and cache[2] == tuple(cols)
+            ):
+                return cache[3]
+            k = len(keys)
+            loc = np.full((k, len(cols)), np.nan)
+            scale = np.full((k, len(cols)), np.nan)
+            pos = self._key_pos
+            rows = np.fromiter(
+                (pos.get(key, -1) for key in keys), dtype=np.int64, count=k
+            )
+            known = rows >= 0
+            rr = rows[known]
+            for j, col in enumerate(cols):
+                st = self._cols.get(col)
+                if st is None or not known.any():
+                    continue
+                n = st.n[rr, b]
+                warm = n >= MIN_COUNT
+                if not warm.any():
+                    continue
+                mean = st.mean[rr, b]
+                with np.errstate(invalid="ignore"):
+                    std = np.sqrt(st.m2[rr, b] / np.maximum(n, 1))
+                sc = np.maximum(
+                    np.maximum(std, REL_FLOOR * np.abs(mean)), _EPS
+                )
+                lcol = np.full(k, np.nan)
+                scol = np.full(k, np.nan)
+                lcol[known] = np.where(warm, mean, np.nan)
+                scol[known] = np.where(warm, sc, np.nan)
+                loc[:, j] = lcol
+                scale[:, j] = scol
+            out = (loc, scale)
+            self._mat_cache = ((self.version, b), keys, tuple(cols), out)
+            return out
+
+    # -- persistence (beside the tsdb segments) ------------------------------
+    def save(self, path: str) -> None:
+        """Atomic npz checkpoint (``<path>.tmp`` → rename)."""
+        import os
+
+        with self._lock:
+            self.flush_pending()
+            cols = sorted(self._cols)
+            k = len(self._keys)
+            stack = lambda name: (  # noqa: E731 — local assembly helper
+                np.stack(
+                    [getattr(self._cols[c], name) for c in cols], axis=1
+                )
+                if cols
+                else np.zeros((k, 0, self.buckets))
+            )
+            payload = {
+                "bucket_s": np.float64(self.bucket_s),
+                "folds": np.int64(self.folds),
+                "keys": np.asarray(self._keys, dtype=str),
+                "cols": np.asarray(cols, dtype=str),
+                "n": stack("n"),
+                "mean": stack("mean"),
+                "m2": stack("m2"),
+            }
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> bool:
+        """Restore a checkpoint; ``False`` (and an untouched store) when
+        the file is missing, unreadable, or was built with a different
+        bucket width — a geometry change restarts learning rather than
+        scoring against misaligned buckets."""
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if abs(float(z["bucket_s"]) - self.bucket_s) > 1e-9:
+                    log.warning(
+                        "baseline checkpoint %s has bucket_s=%s (configured "
+                        "%s) — ignored, baselines restart from zero",
+                        path, float(z["bucket_s"]), self.bucket_s,
+                    )
+                    return False
+                keys = [str(k) for k in z["keys"]]
+                cols = [str(c) for c in z["cols"]]
+                n, mean, m2 = z["n"], z["mean"], z["m2"]
+                folds = int(z["folds"]) if "folds" in z else 0
+        except FileNotFoundError:
+            return False
+        except Exception as e:  # noqa: BLE001 — a bad checkpoint never kills startup
+            log.warning("baseline checkpoint %s unreadable: %s", path, e)
+            return False
+        if n.shape != (len(keys), len(cols), self.buckets):
+            log.warning("baseline checkpoint %s shape mismatch — ignored", path)
+            return False
+        with self._lock:
+            self._keys = keys
+            self._key_pos = {k: i for i, k in enumerate(keys)}
+            self._cols = {}
+            for j, c in enumerate(cols):
+                st = _ColStats(len(keys), self.buckets)
+                st.n = np.ascontiguousarray(n[:, j, :], dtype=np.float64)
+                st.mean = np.ascontiguousarray(mean[:, j, :], dtype=np.float64)
+                st.m2 = np.ascontiguousarray(m2[:, j, :], dtype=np.float64)
+                self._cols[c] = st
+            self.folds = folds
+            self.version += 1
+            self._mat_cache = None
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "keys": len(self._keys),
+                "cols": len(self._cols),
+                "buckets": self.buckets,
+                "bucket_s": self.bucket_s,
+                "folds": self.folds,
+            }

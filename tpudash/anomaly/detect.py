@@ -1,0 +1,578 @@
+"""The online anomaly engine: baseline deviation, fleet outliers, and
+torus-correlated ICI fabric degradation, synthesized as ``anomaly``
+alerts.
+
+Three detectors, one engine, one alert rule:
+
+- **baseline** — each chip scored against its OWN seasonal baseline
+  (tpudash.anomaly.baselines) for the current time-of-interval bucket,
+  one vectorized batch call per tick.  Catches the chip that is normal
+  relative to the fleet but abnormal relative to itself (slow thermal
+  drift, a job silently pinned at half duty).
+- **straggler** — the fleet cross-section: firing entries from the
+  existing StragglerDetector (whose scoring core,
+  tpudash.stragglers.robust_scores, this package shares) are promoted
+  into the alert plane.  Before this layer a named straggler was a frame
+  field nobody paged on; now it rides dwell/silences/webhook like a
+  breaching threshold.
+- **fabric** — ICI-link degradation correlated across torus neighbors: a
+  chip whose own links sag is a chip problem, but when its NEIGHBORS'
+  link counters degrade *together* the failure domain is the fabric
+  (cable bundle, switch, tray).  Degraded chips are grouped into
+  connected components over the slice's torus adjacency
+  (tpudash.topology); a component of ``fabric_min_group``+ chips emits
+  ONE grouped finding — one page for one incident, not N.
+
+Findings pass a consecutive-tick hysteresis (``for_cycles``, the same
+TrackSet the alert engine uses) and an anti-flap resolve dwell
+(``TPUDASH_ANOMALY_DWELL``), then surface as synthesized ``anomaly``
+alert entries — AlertEngine output shape plus ``kind``/``score``/
+``evidence`` (and ``chips`` for fabric groups), so the banner, silences,
+the webhook pager, the federation digest, and ``/api/incidents`` treat a
+detected anomaly exactly like a breaching threshold rule.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpudash import schema
+from tpudash.anomaly.baselines import BaselineStore, make_scorer
+from tpudash.hysteresis import DwellSet, TrackSet
+from tpudash.stragglers import DEFAULT_DIRECTIONS, robust_scores
+
+log = logging.getLogger(__name__)
+
+#: findings ranked by score; at most this many alert entries per tick
+#: (a melting fleet must page "the fleet is melting", not 4096 pages)
+MAX_ENTRIES = 32
+
+#: evidence window the alert links to: incident tail long enough to see
+#: the deviation develop at 1m rollup resolution
+EVIDENCE_WINDOW_S = 1800.0
+
+#: minimum connected-component size for a fabric (vs chip) incident:
+#: the anchor chip plus at least two torus neighbors degrading together
+FABRIC_MIN_GROUP = 3
+
+#: modified-z cutoff for "this link is degraded" in the fabric
+#: correlation pass (Iglewicz–Hoaglin, same as the straggler default)
+FABRIC_LINK_Z = 3.5
+
+#: wake-up screen for the engine's own (uncapped) link scan: any link
+#: column whose fleet MINIMUM sags below this fraction of its fleet
+#: mean triggers the scan — never true on a healthy lockstep fleet, so
+#: the scan's median cost is only ever paid mid-incident
+_SCAN_SCREEN = 0.75
+
+
+def _direction_badness(z: np.ndarray, direction: str) -> np.ndarray:
+    """Signed score → badness (bigger = worse) per the metric's bad
+    direction; deviation in the healthy direction never flags."""
+    if direction == "low":
+        return -z
+    if direction == "high":
+        return z
+    return np.abs(z)
+
+
+@dataclass
+class AnomalyEngine:
+    """Per-refresh anomaly evaluation with hysteresis and dwell.
+
+    Built by :meth:`from_config`; driven by the service's publish path
+    (``observe`` under the publish lock) and by the replay twin
+    (tpudash.anomaly.replay) with an injected clock.
+    """
+
+    baselines: BaselineStore
+    threshold: float = 4.0
+    for_cycles: int = 2
+    dwell_s: float = 0.0
+    generation: str = "v5e"
+    use_jax: bool = False
+    baseline_path: str = ""
+    clock: "object" = time.time
+    #: monotonic-ish clock for the dwell (injectable; replay passes the
+    #: recorded-epoch clock so held entries expire in record time)
+    dwell_clock: "object | None" = None
+
+    def __post_init__(self):
+        self._scorer, self.backend = make_scorer(self.use_jax)
+        self._tracks = TrackSet()
+        self._dwell = DwellSet(
+            dwell_s=self.dwell_s,
+            **({"clock": self.dwell_clock} if self.dwell_clock else {}),
+        )
+        #: public state the service/frame/API read
+        self.last_findings: list[dict] = []
+        self.alert_entries: list[dict] = []
+        self.last_score_ms: float = 0.0
+        self.ticks = 0
+        #: synthetic_load sets this: observe() becomes a no-op (profile
+        #: bursts must neither pollute baselines nor flap alerts)
+        self.paused = False
+        self._topo_cache: dict = {}
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_config(
+        cls, cfg, clock=time.time, dwell_clock=None
+    ) -> "AnomalyEngine | None":
+        """The one place the anomaly knobs are interpreted (service,
+        chaos drill, and replay CLI all call this).  ``None`` when
+        TPUDASH_ANOMALY=0.  ``dwell_clock`` lets the replay twin run the
+        anti-flap dwell on recorded time instead of monotonic."""
+        if not getattr(cfg, "anomaly", True):
+            return None
+        import os
+
+        dwell = getattr(cfg, "anomaly_dwell", 0.0) or getattr(
+            cfg, "alert_dwell", 0.0
+        )
+        eng = cls(
+            baselines=BaselineStore(
+                getattr(cfg, "anomaly_baseline_window", 3600.0)
+            ),
+            threshold=getattr(cfg, "anomaly_score_threshold", 4.0),
+            dwell_s=dwell,
+            generation=getattr(cfg, "generation", "v5e"),
+            use_jax=getattr(cfg, "anomaly_jax", False),
+            clock=clock,
+            dwell_clock=dwell_clock,
+        )
+        tsdb_path = getattr(cfg, "tsdb_path", "")
+        if tsdb_path:
+            eng.baseline_path = os.path.join(tsdb_path, "baselines.npz")
+            eng.baselines.load(eng.baseline_path)
+        return eng
+
+    #: how far back the startup seed reads rollups: two seasonal
+    #: periods — each time-of-day bucket collects ~60 minute-folds per
+    #: day, far past the warm-up counts, so older quads add nothing but
+    #: startup time (the seed runs synchronously in service __init__)
+    SEED_WINDOW_S = 2 * 86400.0
+
+    def seed_from_tsdb(self, store) -> int:
+        """Backfill the seasonal baselines from the store's 1m/10m
+        rollup quads (startup, after the checkpoint load came up empty)
+        — a restart scores against recorded seasonality immediately."""
+        if store is None:
+            return 0
+        try:
+            return self.baselines.seed_from_store(
+                store, sorted(DEFAULT_DIRECTIONS), window_s=self.SEED_WINDOW_S
+            )
+        except Exception as e:  # noqa: BLE001 — seeding is best-effort
+            log.warning("baseline seed from tsdb failed: %s", e)
+            return 0
+
+    def save_baselines(self) -> None:
+        """Persist beside the tsdb segments (graceful shutdown)."""
+        if not self.baseline_path:
+            return
+        try:
+            self.baselines.save(self.baseline_path)
+        except OSError as e:
+            log.warning("baseline save failed: %s", e)
+
+    # -- helpers -------------------------------------------------------------
+    def _values(self, df, block, cols_wanted):
+        """Aligned ``[rows × cols_wanted]`` float matrix from the shared
+        dense block (fast path) or per-column coercion (CLI/legacy)."""
+        arr, cols = block if block is not None else (None, [])
+        present = [c for c in cols_wanted if (
+            c in cols if arr is not None else c in df.columns
+        )]
+        if not present:
+            return present, None
+        if arr is not None:
+            pos = {c: i for i, c in enumerate(cols)}
+            idx = [pos[c] for c in present]
+            return present, np.asarray(arr[:, idx], dtype=np.float64)
+        import pandas as pd
+
+        out = np.column_stack([
+            pd.to_numeric(df[c], errors="coerce").to_numpy(
+                dtype=float, na_value=np.nan
+            )
+            for c in present
+        ])
+        return present, out
+
+    def _topology(self, n_chips: int):
+        topo = self._topo_cache.get(n_chips)
+        if topo is None and n_chips >= 1:
+            from tpudash.topology import topology_for
+
+            try:
+                topo = topology_for(self.generation, n_chips)
+            except ValueError:
+                topo = None
+            self._topo_cache[n_chips] = topo
+        return topo
+
+    # -- detectors -----------------------------------------------------------
+    def _baseline_findings(self, now, keys, wcols, x) -> list[dict]:
+        self.baselines.ingest(now, keys, wcols, x)
+        loc, scale = self.baselines.matrices(keys, wcols, now)
+        z = self._scorer(x, loc, scale)
+        out = []
+        for j, col in enumerate(wcols):
+            zz = np.asarray(z[:, j], dtype=np.float64)
+            bad = _direction_badness(zz, DEFAULT_DIRECTIONS.get(col, "both"))
+            with np.errstate(invalid="ignore"):
+                mask = bad >= self.threshold
+            for i in np.nonzero(mask)[0]:
+                out.append(
+                    {
+                        "kind": "baseline",
+                        "chip": str(keys[i]),
+                        "column": col,
+                        "score": round(float(bad[i]), 1),
+                        "value": round(float(x[i, j]), 2),
+                        "baseline": round(float(loc[i, j]), 2),
+                        "direction": DEFAULT_DIRECTIONS.get(col, "both"),
+                    }
+                )
+        return out
+
+    def _straggler_findings(self, stragglers) -> list[dict]:
+        out = []
+        for s in stragglers or []:
+            if s.get("state") != "firing":
+                continue
+            # the detector's own 3.5 z names a straggler on the frame;
+            # PROMOTION to the alert plane requires the anomaly
+            # threshold — one knob (TPUDASH_ANOMALY_SCORE_THRESHOLD)
+            # gates every chip-level anomaly page, and the replay twin
+            # can counterfactual it
+            if abs(float(s.get("z", 0.0))) < self.threshold:
+                continue
+            f = {
+                "kind": "straggler",
+                "chip": s["chip"],
+                "column": s["column"],
+                "score": abs(float(s.get("z", 0.0))),
+                "value": s.get("value"),
+                "median": s.get("median"),
+                "direction": s.get("direction"),
+            }
+            if "link" in s:
+                f["link"] = s["link"]
+            out.append(f)
+        return out
+
+    def _fabric_findings(
+        self, df, block, stragglers=None, wblock=None
+    ) -> list[dict]:
+        """Group link-degraded chips into torus-connected components.
+
+        The per-link scores come FREE when the straggler detector ran
+        this tick (it watches every link column by default — any entry,
+        pending or firing, is a breaching cable candidate).  But the
+        detector's bimodality ceiling (``max_fraction``) SKIPS a column
+        when too many chips breach at once — which is exactly what a
+        lost cable tray looks like — and an operator may have narrowed
+        or disabled the detector entirely.  So a cheap vectorized
+        screen (any link column whose fleet minimum sags below
+        ``_SCAN_SCREEN`` of its fleet mean — never true on a healthy
+        ±2% lockstep fleet) additionally triggers the engine's OWN
+        uncapped link scan, and the candidate sets merge.  A healthy
+        fleet therefore still pays ~zero here — the bench's
+        <10%-of-frame-budget bar depends on it — while a big correlated
+        group cannot be silently suppressed.  The screen's floor: a
+        sag must exceed ~25% of nominal to wake the scan, so sub-25%
+        fabric drifts are only caught via the detector path."""
+        link_cols = sorted(schema.ICI_LINK_GBPS.values())
+        # (key, col, |z|) candidates: chips whose own link counters sag
+        cand: list = []
+        if stragglers is not None:
+            lset = set(link_cols)
+            cand = [
+                (s["chip"], s["column"], abs(float(s.get("z", 0.0))))
+                for s in stragglers
+                if s.get("column") in lset
+            ]
+        # reuse the baseline pass's watched-column matrix when offered
+        # (link cols ⊂ the watched set) — no second block extraction
+        if wblock is not None:
+            wcols, wx = wblock
+            wpos = {c: j for j, c in enumerate(wcols)}
+            present = [c for c in link_cols if c in wpos]
+            x = (
+                wx[:, [wpos[c] for c in present]] if present else None
+            )
+        else:
+            present, x = self._values(df, block, link_cols)
+        if x is not None and len(present) and self._link_screen_fires(x):
+            best: dict = {(k, c): z for k, c, z in cand}
+            for k, c, z in self._scan_link_outliers(df, present, x):
+                if z > best.get((k, c), 0.0):
+                    best[(k, c)] = z
+            cand = [(k, c, z) for (k, c), z in best.items()]
+        if len(cand) < FABRIC_MIN_GROUP:
+            return []
+        pos = {str(k): i for i, k in enumerate(df.index)}
+        slices = np.asarray(df["slice_id"], dtype=object)
+        chip_ids = np.asarray(df["chip_id"], dtype=np.int64)
+        # per slice: degraded chip id -> (key, worst |z|, columns hit)
+        by_slice: dict = {}
+        for key, col, z in cand:
+            i = pos.get(key)
+            if i is None:
+                continue
+            sl = str(slices[i])
+            cid = int(chip_ids[i])
+            info = by_slice.setdefault(sl, {}).setdefault(
+                cid, [key, 0.0, set()]
+            )
+            info[1] = max(info[1], z)
+            info[2].add(col)
+        out = []
+        for sl, degraded in sorted(by_slice.items()):
+            n_chips = int(chip_ids[slices == sl].max()) + 1
+            topo = self._topology(n_chips)
+            if topo is None:
+                continue
+            # connected components over the torus adjacency, degraded
+            # chips only: neighbors degrading TOGETHER are one incident
+            seen: set = set()
+            for cid in sorted(degraded):
+                if cid in seen:
+                    continue
+                comp, stack = [], [cid]
+                seen.add(cid)
+                while stack:
+                    c = stack.pop()
+                    comp.append(c)
+                    try:
+                        neigh = topo.neighbors(c)
+                    except ValueError:
+                        neigh = []
+                    for nb in neigh:
+                        if nb in degraded and nb not in seen:
+                            seen.add(nb)
+                            stack.append(nb)
+                if len(comp) < FABRIC_MIN_GROUP:
+                    continue
+                comp.sort()
+                cols_hit = sorted(
+                    set().union(*(degraded[c][2] for c in comp))
+                )
+                worst = max(comp, key=lambda c: degraded[c][1])
+                out.append(
+                    {
+                        "kind": "fabric",
+                        "chip": f"{sl}/fabric",
+                        "slice": sl,
+                        "column": cols_hit[0],
+                        "columns": cols_hit,
+                        "chips": [degraded[c][0] for c in comp],
+                        # evidence anchor: the worst member's CHIP series
+                        # (the fleet pseudo-series never carries
+                        # per-direction link columns — an evidence URL
+                        # against it would resolve to zero points)
+                        "anchor": degraded[worst][0],
+                        "score": round(degraded[worst][1], 1),
+                        "direction": "low",
+                    }
+                )
+        return out
+
+    @staticmethod
+    def _link_screen_fires(x) -> bool:
+        """Cheap wake-up test for the uncapped link scan: does ANY link
+        column's fleet minimum sag below _SCAN_SCREEN of its fleet
+        mean?  O(K×L) vectorized, no sorts; false on every healthy
+        lockstep fleet (links are fleet-uniform ±2%)."""
+        with np.errstate(invalid="ignore"):
+            mean = np.nanmean(x, axis=0)
+            mn = np.nanmin(x, axis=0)
+            hit = (mean > 0) & (mn < _SCAN_SCREEN * mean)
+        return bool(np.any(hit))
+
+    def _scan_link_outliers(self, df, present, x) -> list:
+        """Uncapped per-link robust scan over the aligned link matrix
+        ``x`` (columns ``present``): ``[(key, col, |z|), ...]`` for
+        chips breaching FABRIC_LINK_Z low on any link column, scored
+        per slice.  No bimodality ceiling — a big correlated group is
+        the POINT here, not noise — but the modified z still needs the
+        degraded set to be a MINORITY (the median must land on healthy
+        chips), so a slice under 2×FABRIC_MIN_GROUP rows cannot support
+        a group and is skipped."""
+        slices = np.asarray(df["slice_id"], dtype=object)
+        keys = np.asarray(df.index, dtype=object)
+        out = []
+        for sl in sorted(set(slices.tolist())):
+            rows = np.nonzero(slices == sl)[0]
+            if len(rows) < 2 * FABRIC_MIN_GROUP:
+                continue
+            for j, col in enumerate(present):
+                v = x[rows, j]
+                ok = np.isfinite(v)
+                scored = robust_scores(
+                    v[ok], direction="low", zscore=FABRIC_LINK_Z
+                )
+                if scored is None:
+                    continue
+                z, breach, _med, _scale = scored
+                okrows = rows[ok]
+                for i in np.nonzero(breach)[0]:
+                    out.append(
+                        (str(keys[okrows[i]]), col, abs(float(z[i])))
+                    )
+        return out
+
+    # -- the per-refresh entry point -----------------------------------------
+    def observe(
+        self, now=None, df=None, block=None, stragglers=None, keys=None
+    ) -> list[dict]:
+        """Run all three detectors over one published table; updates
+        ``last_findings`` / ``alert_entries`` and returns the findings.
+        ``now`` defaults to the engine's injected clock (wall time live,
+        recorded time under replay).  Caller holds the publish lock (or
+        owns the engine — replay)."""
+        if self.paused:
+            return self.last_findings
+        if now is None:
+            now = float(self.clock())
+        t0 = time.perf_counter()
+        keys = keys if keys is not None else df.index.tolist()
+        wcols, x = self._values(df, block, sorted(DEFAULT_DIRECTIONS))
+        findings: list[dict] = []
+        if x is not None:
+            findings += self._baseline_findings(now, keys, wcols, x)
+        fabric = self._fabric_findings(
+            df,
+            block,
+            stragglers=stragglers,
+            wblock=(wcols, x) if x is not None else None,
+        )
+        findings += fabric
+        # members of a fabric group are ONE incident: their individual
+        # straggler/baseline findings on the same degradation dedupe away
+        fabric_members = {
+            c for f in fabric for c in f["chips"]
+        }
+        findings = [
+            f
+            for f in findings
+            if not (
+                f["kind"] == "baseline"
+                and f["chip"] in fabric_members
+                and f["column"] in schema.ICI_LINK_GBPS.values()
+            )
+        ]
+        findings += [
+            f
+            for f in self._straggler_findings(stragglers)
+            if not (
+                f["chip"] in fabric_members
+                and f["column"] in schema.ICI_LINK_GBPS.values()
+            )
+        ]
+        self.ticks += 1
+        self.last_score_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self._publish(now, findings)
+        return self.last_findings
+
+    def _publish(self, now, findings) -> None:
+        """Hysteresis + dwell + alert-entry synthesis from one tick's
+        raw findings."""
+        from tpudash.alerts import synthesized_alert
+
+        findings.sort(key=lambda f: -float(f.get("score", 0.0)))
+        findings = findings[:MAX_ENTRIES]
+        seen = set()
+        entries = []
+        stamped = []
+        now_f = float(now)
+        for f in findings:
+            tkey = (f["kind"], f["column"], f["chip"])
+            seen.add(tkey)
+            track, firing = self._tracks.hit(tkey, self.for_cycles, now_f)
+            f = dict(f, state="firing" if firing else "pending")
+            kind = f["kind"]
+            severity = (
+                "critical"
+                if kind == "fabric"
+                or float(f.get("score", 0.0)) >= 2 * self.threshold
+                else "warning"
+            )
+            if kind == "fabric":
+                detail = (
+                    f"ICI fabric degradation: {len(f['chips'])} torus-"
+                    f"adjacent chips ({', '.join(f['chips'][:6])}"
+                    + ("…" if len(f["chips"]) > 6 else "")
+                    + f") low together on {', '.join(f['columns'])} — one "
+                    "fabric incident, not per-chip stragglers"
+                )
+            elif kind == "baseline":
+                detail = (
+                    f"{f['column']} {f['value']} vs seasonal baseline "
+                    f"{f['baseline']} (score {f['score']}, this chip, "
+                    "this time-of-day)"
+                )
+            else:
+                detail = (
+                    f"fleet straggler on {f['column']}: {f.get('value')} vs "
+                    f"fleet median {f.get('median')} (|z| {f['score']:g})"
+                    + (f" — link {f['link']}" if f.get("link") else "")
+                )
+            extra = {
+                "kind": kind,
+                "score": float(f.get("score", 0.0)),
+                "evidence": {
+                    "range": {
+                        # fabric groups anchor on the worst member's
+                        # chip series — its row carries the link
+                        # columns the incident cites
+                        "chip": (
+                            f.get("anchor")
+                            if kind == "fabric"
+                            else f["chip"]
+                        ),
+                        "cols": f.get("columns") or [f["column"]],
+                        "start": round(now_f - EVIDENCE_WINDOW_S, 3),
+                        "end": round(now_f + 60.0, 3),
+                    }
+                },
+            }
+            if kind == "fabric":
+                extra["chips"] = f["chips"]
+            entries.append(
+                synthesized_alert(
+                    rule="anomaly",
+                    column=f["column"],
+                    severity=severity,
+                    chip=f["chip"],
+                    value=float(f.get("score", 0.0)),
+                    threshold=self.threshold,
+                    firing=f["state"] == "firing",
+                    since=track.firing_since,
+                    streak=track.streak,
+                    detail=detail,
+                    **extra,
+                )
+            )
+            f["since"] = track.firing_since
+            f["streak"] = track.streak
+            stamped.append(f)
+        self._tracks.resolve_unseen(seen)
+        self.alert_entries = self._dwell.apply(entries)
+        self.last_findings = stamped
+
+    def stats(self) -> dict:
+        """Counters for /api/timings."""
+        return {
+            "backend": self.backend,
+            "score_ms": self.last_score_ms,
+            "ticks": self.ticks,
+            "findings": len(self.last_findings),
+            "baseline": self.baselines.stats(),
+        }

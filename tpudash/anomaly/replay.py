@@ -1,0 +1,285 @@
+"""The what-if twin: replay recorded telemetry through a MODIFIED
+analysis config and diff the resulting incident timeline against what
+actually fired.
+
+"Would the pager have caught this two minutes earlier at threshold 3.0?
+Would dwell 30 have collapsed the flap storm into one page?"  A live
+dashboard cannot answer counterfactuals; the twin can, because every
+ingredient is already deterministic: recordings
+(``TPUDASH_RECORD_PATH`` JSONL, or the tsdb's rollup history) carry the
+data with its original timestamps, and every engine in the pipeline
+(AlertEngine, StragglerDetector, AnomalyEngine, IncidentTimeline) takes
+an injectable clock — the replay drives them all on *recorded* time, so
+hysteresis streaks, dwell holds, and incident ids come out exactly as
+they would have live (grounding: "TX-Digital Twin", PAPERS.md).
+
+Two feeders, one pipeline:
+
+- ``run_capture(path, cfg)`` — a recorder JSONL, each snapshot parsed
+  through the identical normalize path a live scrape takes;
+- ``run_tsdb(path, cfg, start, end)`` — a tsdb segment directory
+  (opened read-only, a live leader's files untouched), reconstructing
+  one frame per aligned step from ``range_query`` — coarser than a
+  capture (rollup means, no sub-minute texture) but reaching as far
+  back as 10m retention does.
+
+``diff_timelines(base, variant)`` matches incidents by (rule, chip) in
+start order and reports added / removed / shifted (with per-incident
+fire-latency and duration deltas) — the CLI
+(``python -m tpudash.anomaly replay``) prints it or emits JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: start shifts under this many seconds count as "same incident, same
+#: time" (recorder stamps jitter by a tick)
+DEFAULT_TOLERANCE_S = 2.0
+
+
+class ReplayClock:
+    """The injectable clock: every engine reads recorded time from here."""
+
+    def __init__(self, now: float = 0.0):
+        self.now = float(now)
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _merge(primary: "list[dict]", secondary: "list[dict]") -> "list[dict]":
+    """(rule, chip)-deduped union, primary wins — the service's
+    _merge_alerts contract, restated here so replay does not import the
+    whole app layer."""
+    seen = {(a.get("rule"), a.get("chip")) for a in primary}
+    return primary + [
+        a for a in secondary if (a.get("rule"), a.get("chip")) not in seen
+    ]
+
+
+class ReplayPipeline:
+    """One analysis pipeline over recorded frames: engines built from
+    ``cfg`` on a shared replay clock, feeding an IncidentTimeline."""
+
+    def __init__(self, cfg):
+        from tpudash.alerts import AlertEngine
+        from tpudash.anomaly.detect import AnomalyEngine
+        from tpudash.anomaly.timeline import IncidentTimeline
+        from tpudash.stragglers import StragglerDetector
+
+        self.cfg = cfg
+        self.clock = ReplayClock()
+        self.alert_engine = AlertEngine.from_config(cfg, clock=self.clock)
+        self.straggler_detector = StragglerDetector.from_config(
+            cfg, clock=self.clock
+        )
+        self.anomaly_engine = AnomalyEngine.from_config(
+            cfg, clock=self.clock, dwell_clock=self.clock
+        )
+        self.timeline = IncidentTimeline(clock=self.clock)
+        self.frames = 0
+        self.errors = 0
+
+    def step(self, ts: float, df) -> None:
+        """One recorded frame through the full analysis stack, on
+        recorded time."""
+        from tpudash.alerts import sort_alerts
+        from tpudash.normalize import dense_block
+
+        self.clock.now = float(ts)
+        block = dense_block(df)
+        stragglers = []
+        if self.straggler_detector is not None:
+            stragglers = self.straggler_detector.evaluate(df, block=block)
+        anomaly_entries: list = []
+        if self.anomaly_engine is not None:
+            self.anomaly_engine.observe(
+                ts, df, block=block, stragglers=stragglers
+            )
+            anomaly_entries = self.anomaly_engine.alert_entries
+        alerts = (
+            self.alert_engine.evaluate(df)
+            if self.alert_engine is not None
+            else []
+        )
+        merged = sort_alerts(_merge(alerts, list(anomaly_entries)))
+        self.timeline.observe(ts, merged, None)
+        self.frames += 1
+
+    def result(self) -> dict:
+        snap = self.timeline.snapshot(limit=self.timeline.max_incidents)
+        snap["frames"] = self.frames
+        snap["parse_errors"] = self.errors
+        return snap
+
+
+def run_capture(path: str, cfg) -> dict:
+    """Replay a recorder JSONL capture (see sources/recorder.py) through
+    the pipeline; returns the timeline snapshot."""
+    from tpudash.normalize import to_wide
+    from tpudash.sources.recorder import FileReplaySource
+
+    src = FileReplaySource(path, loop=False)
+    pipe = ReplayPipeline(cfg)
+    for i in range(len(src)):
+        try:
+            samples = src.fetch()
+            df = to_wide(samples)
+        except Exception as e:  # noqa: BLE001 — one bad snapshot, not the run
+            pipe.errors += 1
+            log.warning("capture snapshot %d skipped: %s", i, e)
+            continue
+        pipe.step(src.timestamps[i], df)
+    return pipe.result()
+
+
+def frames_from_store(path: str, start_s=None, end_s=None, step_s: float = 60.0):
+    """Reconstruct per-step wide frames from a tsdb segment directory
+    (read-only — safe against a live leader).  Yields ``(ts_s, df)``
+    ascending; identity columns derived from the series keys."""
+    import pandas as pd
+
+    from tpudash.tsdb import FLEET_SERIES, TSDB
+    from tpudash.tsdb.query import range_query
+
+    store = TSDB(path=path, read_only=True)
+    keys = sorted(k for k in store.series_keys() if k != FLEET_SERIES)
+    if not keys:
+        return
+    step_s = max(1.0, float(step_s))
+    # per (key, col): ONE {ts: value} dict, built once — the stamps loop
+    # below must stay O(stamps × cols × keys), not re-convert point
+    # lists per timestamp (a day of 256-chip history is ~2M lookups)
+    per_key: dict = {}
+    cols_union: list = []
+    for key in keys:
+        res = range_query(
+            store,
+            key,
+            start_s=start_s,
+            end_s=end_s,
+            step_s=step_s,
+            max_points=5000,
+        )
+        per_key[key] = {c: dict(pts) for c, pts in res["series"].items()}
+        for c in res["series"]:
+            if c not in cols_union:
+                cols_union.append(c)
+    empty: dict = {}
+    stamps = sorted(
+        {ts for series in per_key.values() for pts in series.values() for ts in pts}
+    )
+    for ts in stamps:
+        data = {}
+        for c in cols_union:
+            data[c] = [
+                per_key[key].get(c, empty).get(ts, np.nan) for key in keys
+            ]
+        df = pd.DataFrame(data, index=pd.Index(keys, name="chip"))
+        slice_ids, chip_ids = [], []
+        for key in keys:
+            sl, _, cid = key.rpartition("/")
+            slice_ids.append(sl or key)
+            try:
+                chip_ids.append(int(cid))
+            except ValueError:
+                chip_ids.append(-1)
+        df["slice_id"] = slice_ids
+        df["chip_id"] = chip_ids
+        df["host"] = ""
+        yield float(ts), df
+
+
+def run_tsdb(path: str, cfg, start_s=None, end_s=None, step_s: float = 60.0) -> dict:
+    """Replay a tsdb time range through the pipeline."""
+    pipe = ReplayPipeline(cfg)
+    for ts, df in frames_from_store(path, start_s, end_s, step_s):
+        pipe.step(ts, df)
+    return pipe.result()
+
+
+def apply_overrides(cfg, overrides: dict):
+    """A modified Config for the variant run (frozen dataclass →
+    replace); unknown keys raise so a typo'd flag fails loudly."""
+    clean = {k: v for k, v in overrides.items() if v is not None}
+    return dataclasses.replace(cfg, **clean) if clean else cfg
+
+
+def diff_timelines(
+    base: "dict | list",
+    variant: "dict | list",
+    tolerance_s: float = DEFAULT_TOLERANCE_S,
+) -> dict:
+    """Counterfactual diff of two timelines (snapshot docs or bare
+    incident lists): incidents added / removed under the variant config,
+    and for matched incidents the fire-latency and duration deltas."""
+
+    def _incidents(doc):
+        if isinstance(doc, dict):
+            return doc.get("incidents", [])
+        return list(doc)
+
+    def _index(incs):
+        by_key: dict = {}
+        for inc in sorted(_incidents(incs), key=lambda i: i["start"]):
+            by_key.setdefault((inc["rule"], inc["chip"]), []).append(inc)
+        return by_key
+
+    b, v = _index(base), _index(variant)
+    added, removed, matched = [], [], []
+
+    def _brief(inc):
+        return {
+            "id": inc["id"],
+            "rule": inc["rule"],
+            "chip": inc["chip"],
+            "start": inc["start"],
+            "state": inc["state"],
+            "severity": inc.get("severity"),
+        }
+
+    for key in sorted(set(b) | set(v), key=str):
+        bl, vl = b.get(key, []), v.get(key, [])
+        for i in range(max(len(bl), len(vl))):
+            bi = bl[i] if i < len(bl) else None
+            vi = vl[i] if i < len(vl) else None
+            if bi is None:
+                added.append(_brief(vi))
+                continue
+            if vi is None:
+                removed.append(_brief(bi))
+                continue
+            start_delta = vi["start"] - bi["start"]
+            dur_delta = None
+            if bi.get("duration_s") is not None and vi.get("duration_s") is not None:
+                dur_delta = round(vi["duration_s"] - bi["duration_s"], 3)
+            matched.append(
+                {
+                    "rule": key[0],
+                    "chip": key[1],
+                    "id_base": bi["id"],
+                    "id_variant": vi["id"],
+                    # negative = the variant config fires EARLIER
+                    "latency_delta_s": round(start_delta, 3),
+                    "duration_delta_s": dur_delta,
+                    "shifted": abs(start_delta) > tolerance_s,
+                }
+            )
+    return {
+        "added": added,
+        "removed": removed,
+        "matched": matched,
+        "shifted": [m for m in matched if m["shifted"]],
+        "summary": {
+            "added": len(added),
+            "removed": len(removed),
+            "matched": len(matched),
+            "shifted": sum(1 for m in matched if m["shifted"]),
+        },
+    }

@@ -1,0 +1,361 @@
+"""Incident timelines: alert transitions + federation child-status flips
+stitched into ordered incident objects (``GET /api/incidents``).
+
+The alert list answers "what is firing NOW"; an operator walking into an
+outage needs "what HAPPENED, in what order".  The timeline observes
+every published alert set (threshold rules, stragglers-turned-anomaly,
+and the synthesized service rules alike) plus the federation block's
+per-child status, and turns state transitions into events grouped under
+incidents:
+
+- an incident OPENS when an alert key ``(rule, chip)`` first reaches
+  ``firing`` and CLOSES when the key leaves the alert set (or returns to
+  a clean state) — flaps inside one incident append events, they do not
+  mint new incidents;
+- federation child-status flips (``live → stale → dark → live``) attach
+  to the open ``child_down`` incident for that child when one exists,
+  else to a standalone ``child_status`` incident — the "child flapped
+  but never breached its breaker" case stays visible;
+- silence/unsilence transitions are events too: the operator's
+  acknowledgement is part of the incident's story;
+- every incident carries an **evidence** link: the ``/api/range``
+  window (chip series when the chip names one, fleet otherwise;
+  the alert's metric columns) covering the incident ± padding, so the
+  UI jumps straight from "what fired" to "what the telemetry did".
+
+Ids are stable: ``sha1(rule | chip | start_ms)`` — the same recording
+replayed through the same config reproduces the same ids, which is what
+lets the replay twin (tpudash.anomaly.replay) diff timelines at all.
+
+Bounded: resolved incidents beyond ``max_incidents`` age out oldest
+first (open incidents are never dropped); per-incident events cap at
+``max_events`` with a drop counter, so a flap storm cannot grow memory.
+Thread-safe behind one lock — the service observes under its publish
+lock, the API snapshots from the executor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from urllib.parse import quote
+
+#: columns that never name a tsdb series (synthesized-rule plumbing)
+_NON_METRIC_COLUMNS = frozenset(
+    {"endpoint", "server", "federation", "ici_fabric"}
+)
+
+#: evidence window padding around the incident, seconds
+_EVIDENCE_PAD_S = 300.0
+
+
+def _incident_id(rule: str, chip: str, start: float) -> str:
+    raw = f"{rule}|{chip}|{int(start * 1000)}".encode()
+    return hashlib.sha1(raw).hexdigest()[:12]
+
+
+class IncidentTimeline:
+    """Transition observer + incident store (see module doc)."""
+
+    def __init__(
+        self,
+        max_incidents: int = 256,
+        max_events: int = 64,
+        clock=time.time,
+    ):
+        self._lock = threading.Lock()
+        self.max_incidents = max_incidents
+        self.max_events = max_events
+        self.clock = clock
+        #: id -> incident dict (insertion-ordered by open time)
+        self._incidents: dict[str, dict] = {}
+        #: (rule, chip) -> open incident id
+        self._open: dict[tuple, str] = {}
+        self._prev_state: dict[tuple, str] = {}
+        self._prev_silenced: dict[tuple, bool] = {}
+        self._prev_child: dict[str, str] = {}
+        #: bumps on every mutation — the endpoint's ETag
+        self.version = 0
+        #: synthetic_load sets this: profile bursts tell no stories
+        self.paused = False
+
+    # -- event plumbing ------------------------------------------------------
+    def _event(self, inc: dict, ev: dict) -> None:
+        if len(inc["events"]) >= self.max_events:
+            inc["events_dropped"] = inc.get("events_dropped", 0) + 1
+            return
+        inc["events"].append(ev)
+
+    def _open_incident(self, now: float, key: tuple, alert: dict) -> dict:
+        rule, chip = key
+        iid = _incident_id(rule, chip, now)
+        inc = {
+            "id": iid,
+            "rule": rule,
+            "chip": chip,
+            "column": alert.get("column"),
+            "severity": alert.get("severity", "warning"),
+            "state": "open",
+            "start": now,
+            "end": None,
+            "events": [],
+            "events_dropped": 0,
+        }
+        for extra in ("kind", "score", "chips", "evidence"):
+            if alert.get(extra) is not None:
+                inc[extra] = alert[extra]
+        self._incidents[iid] = inc
+        self._open[key] = iid
+        self._gc()
+        return inc
+
+    def _close(self, now: float, key: tuple, why: str) -> None:
+        iid = self._open.pop(key, None)
+        if iid is None:
+            return
+        inc = self._incidents.get(iid)
+        if inc is None:
+            return
+        inc["state"] = "resolved"
+        inc["end"] = now
+        self._event(inc, {"ts": now, "kind": "resolved", "detail": why})
+
+    def _gc(self) -> None:
+        over = len(self._incidents) - self.max_incidents
+        if over <= 0:
+            return
+        for iid in list(self._incidents):
+            if over <= 0:
+                break
+            if self._incidents[iid]["state"] == "resolved":
+                del self._incidents[iid]
+                over -= 1
+
+    # -- the observer --------------------------------------------------------
+    def observe(
+        self,
+        now: float,
+        alerts: "list[dict] | None",
+        federation: "dict | None" = None,
+    ) -> None:
+        """Fold one published alert set (+ federation block) into the
+        timeline.  Called once per publish (success AND error cycles),
+        under the service's publish lock."""
+        if self.paused:
+            return
+        now = float(now)
+        with self._lock:
+            mutated = self._observe_alerts(now, alerts or [])
+            if federation:
+                mutated |= self._observe_children(now, federation)
+            if mutated:
+                self.version += 1
+
+    def _observe_alerts(self, now: float, alerts: "list[dict]") -> bool:
+        mutated = False
+        cur: dict[tuple, dict] = {}
+        for a in alerts:
+            key = (a.get("rule"), a.get("chip"))
+            # engine-first dedupe parity (_merge_alerts): first wins
+            cur.setdefault(key, a)
+        for key, a in cur.items():
+            state = a.get("state")
+            prev = self._prev_state.get(key)
+            silenced = bool(a.get("silenced"))
+            if state == "firing" and key not in self._open:
+                inc = self._open_incident(now, key, a)
+                self._event(
+                    inc,
+                    {
+                        "ts": now,
+                        "kind": "fired",
+                        "severity": a.get("severity"),
+                        "value": a.get("value"),
+                        "score": a.get("score"),
+                        "detail": a.get("detail"),
+                    },
+                )
+                mutated = True
+            elif key in self._open and prev != state:
+                inc = self._incidents[self._open[key]]
+                self._event(
+                    inc,
+                    {
+                        "ts": now,
+                        "kind": (
+                            "refired" if state == "firing" else "demoted"
+                        ),
+                        "detail": a.get("detail"),
+                        "dwell": bool(a.get("dwell")),
+                    },
+                )
+                mutated = True
+            if key in self._open and silenced != self._prev_silenced.get(
+                key, False
+            ):
+                self._event(
+                    self._incidents[self._open[key]],
+                    {
+                        "ts": now,
+                        "kind": "silenced" if silenced else "unsilenced",
+                    },
+                )
+                mutated = True
+            self._prev_state[key] = state
+            self._prev_silenced[key] = silenced
+        for key in list(self._prev_state):
+            if key in cur:
+                continue
+            del self._prev_state[key]
+            self._prev_silenced.pop(key, None)
+            if key in self._open:
+                self._close(now, key, "alert cleared")
+                mutated = True
+        return mutated
+
+    def _observe_children(self, now: float, federation: dict) -> bool:
+        mutated = False
+        children = federation.get("children") or {}
+        for name, c in children.items():
+            status = c.get("status")
+            prev = self._prev_child.get(name)
+            self._prev_child[name] = status
+            if prev is None or prev == status:
+                continue
+            ev = {
+                "ts": now,
+                "kind": "child_status",
+                "child": name,
+                "from": prev,
+                "to": status,
+                "staleness_s": c.get("staleness_s"),
+            }
+            open_key = ("child_down", name)
+            skey = ("child_status", name)
+            if open_key in self._open:
+                self._event(self._incidents[self._open[open_key]], ev)
+                # the breaker-backed incident owns this child's story
+                # now: close any standalone flap incident, or it would
+                # dangle open forever (open incidents are never GC'd)
+                if skey in self._open:
+                    self._close(
+                        now, skey, "superseded by the child_down incident"
+                    )
+                mutated = True
+                continue
+            # no breaker-backed incident (sub-breaker flap): a
+            # standalone child_status incident keeps the flip visible
+            if status != "live" and skey not in self._open:
+                inc = self._open_incident(
+                    now,
+                    skey,
+                    {
+                        "column": "federation",
+                        "severity": "warning",
+                        "detail": f"child {name} left live: {prev} → {status}",
+                    },
+                )
+                self._event(inc, ev)
+                mutated = True
+            elif skey in self._open:
+                self._event(self._incidents[self._open[skey]], ev)
+                if status == "live":
+                    self._close(now, skey, "child back to live")
+                mutated = True
+        for name in list(self._prev_child):
+            if name not in children:
+                del self._prev_child[name]
+                skey = ("child_status", name)
+                if skey in self._open:
+                    self._close(now, skey, "child removed from federation")
+                    mutated = True
+        return mutated
+
+    # -- the read side -------------------------------------------------------
+    def _evidence(self, inc: dict, now: float) -> dict:
+        """The /api/range window backing this incident — from the alert
+        entry's own evidence block when the engine attached one, else
+        derived from the incident's identity."""
+        ev = inc.get("evidence")
+        if isinstance(ev, dict) and isinstance(ev.get("range"), dict):
+            rng = dict(ev["range"])
+        else:
+            chip = inc.get("chip") or ""
+            col = inc.get("column")
+            rng = {
+                "chip": chip if "/" in chip else None,
+                "cols": (
+                    [col]
+                    if col and col not in _NON_METRIC_COLUMNS
+                    else None
+                ),
+                "start": None,
+                "end": None,
+            }
+        start = rng.get("start")
+        end = rng.get("end")
+        if start is None:
+            start = inc["start"] - _EVIDENCE_PAD_S
+        if end is None:
+            end = (inc["end"] or now) + _EVIDENCE_PAD_S
+        rng["start"] = round(float(start), 3)
+        rng["end"] = round(float(end), 3)
+        params = [f"start={rng['start']:.3f}", f"end={rng['end']:.3f}"]
+        if rng.get("chip"):
+            params.insert(0, f"chip={quote(str(rng['chip']), safe='/')}")
+        if rng.get("cols"):
+            params.append(
+                "cols=" + ",".join(quote(str(c)) for c in rng["cols"])
+            )
+        rng["url"] = "/api/range?" + "&".join(params)
+        return rng
+
+    def snapshot(
+        self,
+        limit: int = 50,
+        state: "str | None" = None,
+        since: "float | None" = None,
+    ) -> dict:
+        """Ordered incident list, newest first (plus the version the
+        ETag rode) — the /api/incidents body.  Runs off the event loop
+        (takes the lock, builds copies)."""
+        now = float(self.clock())
+        with self._lock:
+            # copy under the lock: observe() mutates incident dicts in
+            # place and the API snapshots from another thread
+            incs = [
+                dict(i, events=list(i["events"]))
+                for i in self._incidents.values()
+            ]
+            version = self.version
+        # global counts come from the UNFILTERED set: a poller watching
+        # ?state=resolved must still see how many incidents are open
+        n_open = sum(1 for i in incs if i["state"] == "open")
+        n_total = len(incs)
+        if state in ("open", "resolved"):
+            incs = [i for i in incs if i["state"] == state]
+        if since is not None:
+            incs = [
+                i
+                for i in incs
+                if (i["end"] or now) >= since or i["start"] >= since
+            ]
+        incs.sort(key=lambda i: (-i["start"], i["id"]))
+        out = []
+        for inc in incs[: max(0, int(limit))]:
+            doc = {
+                k: v
+                for k, v in inc.items()
+                if k not in ("events", "evidence")
+            }
+            doc["events"] = list(inc["events"])
+            doc["evidence"] = self._evidence(inc, now)
+            doc["duration_s"] = round((inc["end"] or now) - inc["start"], 3)
+            out.append(doc)
+        return {
+            "incidents": out,
+            "open": n_open,
+            "total": n_total,
+            "version": version,
+        }

@@ -1011,6 +1011,14 @@ class DashboardServer:
             summary["tsdb"] = await loop.run_in_executor(
                 None, self.service.tsdb.stats
             )
+        if self.service.anomaly_engine is not None:
+            # detection honesty: which scoring backend actually runs
+            # (jax vs numpy fallback), per-tick score cost, baseline
+            # coverage — stats() takes the baseline lock → executor
+            loop = asyncio.get_running_loop()
+            summary["anomaly"] = await loop.run_in_executor(
+                None, self.service.anomaly_engine.stats
+            )
         summary["tier"] = self._tier_doc(summary.get("tsdb"))
         return _json_response(summary)
 
@@ -1316,6 +1324,42 @@ class DashboardServer:
         async with self._lock:
             snapshot = list(self.service.last_alerts)
         return _json_response({"alerts": snapshot})
+
+    async def incidents(self, request: web.Request) -> web.Response:
+        """``GET /api/incidents`` — the incident timeline
+        (tpudash.anomaly.timeline): alert state transitions and
+        federation child-status flips stitched into ordered incident
+        objects with stable ids and ``/api/range`` evidence links.
+
+        Query params: ``limit`` (default 50), ``state=open|resolved``,
+        ``since=<epoch_s>``.  Steady state is near-free: the ETag is the
+        timeline's version counter, so a poller whose ``If-None-Match``
+        still matches gets 304 with no body and no executor hop.
+        Admitted under the OverloadGuard like every data route."""
+        tl = self.service.timeline
+        etag = f'"inc-{tl.version}"'
+        headers = {"Cache-Control": "no-cache", "ETag": etag}
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers=headers)
+        q = request.query
+        state = q.get("state")
+        if state is not None and state not in ("open", "resolved"):
+            raise web.HTTPBadRequest(
+                text="state must be 'open' or 'resolved'"
+            )
+        try:
+            limit = int(q.get("limit", "50"))
+            since = float(q["since"]) if "since" in q else None
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from None
+        # snapshot takes the timeline's sync lock and builds copies —
+        # executor, never the event loop
+        loop = asyncio.get_running_loop()
+        doc = await loop.run_in_executor(
+            None, lambda: tl.snapshot(limit=limit, state=state, since=since)
+        )
+        headers["ETag"] = f'"inc-{doc["version"]}"'
+        return _json_response(doc, headers=headers)
 
     def _invalidate_frames(self) -> None:
         """Global-state change (silences): every session's cached compose
@@ -1987,6 +2031,7 @@ class DashboardServer:
         app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/topology", self.topology)
         app.router.add_get("/api/alerts", self.alerts)
+        app.router.add_get("/api/incidents", self.incidents)
         app.router.add_post("/api/alerts/silence", self.silence_alert)
         app.router.add_post("/api/alerts/unsilence", self.unsilence_alert)
         app.router.add_get("/api/alerts/silences", self.list_silences)
@@ -2028,6 +2073,14 @@ class DashboardServer:
                 await loop.run_in_executor(None, self.service.close_tsdb)
 
             app.on_cleanup.append(_close_tsdb)
+        if self.service.anomaly_engine is not None:
+            # graceful shutdown persists the seasonal baselines beside
+            # the tsdb segments (npz write → executor, never the loop)
+            async def _close_analysis(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.service.close_analysis)
+
+            app.on_cleanup.append(_close_analysis)
         return app
 
 

@@ -307,6 +307,30 @@ class DashboardService:
 
         self.straggler_detector = StragglerDetector.from_config(cfg)
         self.last_stragglers: list[dict] = []
+        #: online anomaly detection (tpudash.anomaly): seasonal baseline
+        #: deviation + promoted stragglers + torus-correlated ICI fabric
+        #: degradation, synthesized as the ``anomaly`` alert rule.  The
+        #: incident timeline stitches every alert transition (and
+        #: federation child-status flip) into ``GET /api/incidents``.
+        from tpudash.anomaly import AnomalyEngine, IncidentTimeline
+
+        self.anomaly_engine = AnomalyEngine.from_config(cfg)
+        self.last_anomalies: list[dict] = []
+        if (
+            self.anomaly_engine is not None
+            and self.tsdb is not None
+            and self.anomaly_engine.baselines.folds == 0
+        ):
+            # no persisted baselines: backfill seasonality from the
+            # store's 1m/10m rollup quads so a restart scores from the
+            # first frame instead of relearning a day of buckets
+            seeded = self.anomaly_engine.seed_from_tsdb(self.tsdb)
+            if seeded:
+                log.info(
+                    "seeded anomaly baselines from tsdb rollups "
+                    "(%d minute-folds)", seeded,
+                )
+        self.timeline = IncidentTimeline()
         #: (rule, chip) pairs firing in the previous frame — webhook
         #: notifications are sent on transitions only, not every cycle
         self._firing_keys: set = set()
@@ -447,9 +471,20 @@ class DashboardService:
             copy.deepcopy(detector._tracks) if detector is not None else None
         )
         saved_stragglers = self.last_stragglers
+        saved_anomalies = self.last_anomalies
         saved_alerts = self.last_alerts
         saved_firing = set(self._firing_keys)
         saved_dwell = copy.deepcopy(self._synth_dwell._held)
+        # the anomaly engine pauses outright (observe() becomes a no-op:
+        # synthetic frames must neither pollute the seasonal baselines
+        # nor flap findings) and the incident timeline tells no stories
+        # about profile bursts
+        anomaly_was_paused = timeline_was_paused = None
+        if self.anomaly_engine is not None:
+            anomaly_was_paused = self.anomaly_engine.paused
+            self.anomaly_engine.paused = True
+        timeline_was_paused = self.timeline.paused
+        self.timeline.paused = True
         saved_history = list(self.history)
         # /healthz and the error banner serve last_error too: a synthetic
         # render must neither clear a real outage nor leave a fake one
@@ -515,6 +550,10 @@ class DashboardService:
             # streaks until the next real frame
             self.last_alerts = saved_alerts
             self.last_stragglers = saved_stragglers
+            self.last_anomalies = saved_anomalies
+            if anomaly_was_paused is not None:
+                self.anomaly_engine.paused = anomaly_was_paused
+            self.timeline.paused = timeline_was_paused
             self._firing_keys = saved_firing
             self._synth_dwell._held = saved_dwell
             self.last_error = saved_error
@@ -960,6 +999,12 @@ class DashboardService:
             log.warning("tsdb chip query failed for %r: %s", key, e)
             return None
 
+    def close_analysis(self) -> None:
+        """Persist the anomaly baselines beside the tsdb segments
+        (graceful shutdown; crash loss = at most the unflushed folds)."""
+        if self.anomaly_engine is not None:
+            self.anomaly_engine.save_baselines()
+
     def close_tsdb(self) -> None:
         """Graceful-shutdown seal: the not-yet-full head chunk compresses
         and (with a path) persists, so a clean restart loses nothing.  A
@@ -1213,6 +1258,15 @@ class DashboardService:
                 overload=state,
             )
         ]
+
+    def _anomaly_alerts(self) -> "list[dict]":
+        """The anomaly engine's current synthesized entries (rule
+        ``anomaly``, AlertEngine output shape plus kind/score/evidence).
+        The engine rebuilds them each observe(); error cycles serve the
+        last computed set — "not evaluated" is not "recovered"."""
+        if self.anomaly_engine is None:
+            return []
+        return list(self.anomaly_engine.alert_entries)
 
     # -- panel helpers -------------------------------------------------------
     def _active_panels(self, df: pd.DataFrame) -> list[schema.PanelSpec]:
@@ -1922,7 +1976,7 @@ class DashboardService:
         if err != self.last_error:  # log streaks once, not per cycle
             log.warning("%s", err)
         self.last_error = err
-        if self.alert_engine is not None:
+        if self.alert_engine is not None or self.anomaly_engine is not None:
             # a partial outage that turns total must keep the synthesized
             # (endpoint_down / overload) alerts current even though no
             # table was published; chip alerts from the last good frame
@@ -1935,6 +1989,9 @@ class DashboardService:
             synth += self._overload_alerts(now_w)
             synth += self._federation_alerts(now_w)
             synth = self._synth_dwell.apply(synth)
+            # anomaly state freezes across an error cycle (no table to
+            # evaluate) — the last computed entries keep serving
+            synth = self._anomaly_alerts() + synth
             if synth or any(
                 a.get("rule") in SYNTHESIZED_RULES for a in self.last_alerts
             ):
@@ -1951,6 +2008,12 @@ class DashboardService:
                     sort_alerts(_merge_alerts(synth, kept)), now_w
                 )
                 self._notify_alert_transitions()
+        # error cycles are timeline observations too: a total outage is
+        # exactly when child flips / synthesized transitions matter most
+        self.timeline.observe(
+            # tpulint: allow[wall-clock] timeline events carry epoch stamps
+            time.time(), self.last_alerts, self._federation_summary()
+        )
         self._frame_open = False
         self.timer.end_frame()
         return None
@@ -2007,32 +2070,68 @@ class DashboardService:
             self._group_cache = None
             self._heatmap_geo = None
         self.available = keys
-        if self.alert_engine is not None:
-            with self.timer.stage("alerts"):
-                from tpudash.alerts import sort_alerts
-
-                # tpulint: allow[wall-clock] alert/silence epoch stamps
-                now_w = time.time()
-                alerts = self.alert_engine.evaluate(df)
-                synth = self._endpoint_alerts(now_w)
-                synth += self._overload_alerts(now_w)
-                synth += self._federation_alerts(now_w)
-                synth = self._synth_dwell.apply(synth)
-                self.last_alerts = self.silences.annotate(
-                    sort_alerts(_merge_alerts(alerts, synth)), now_w
-                )
-            self._notify_alert_transitions()
-        # Fleet-wide trend history, one point per refresh interval (burst
-        # renders from selection POSTs must not pollute the cadence).
-        # Averages cover ALL chips in scope — per-browser selections are
-        # session-local now and must not steer the shared sparklines; this
-        # also matches the backfill scope (_backfill_history).
+        # dense extraction + outlier analysis run BEFORE the alert stage
+        # now: the anomaly engine consumes the straggler detector's
+        # firing entries and its entries join the synthesized set below
         arr, cols = self._df_block = dense_block(df)
         if self.straggler_detector is not None:
             with self.timer.stage("analyze"):
                 self.last_stragglers = self.straggler_detector.evaluate(
                     df, block=self._df_block
                 )
+        # tpulint: allow[wall-clock] alert/anomaly epoch stamps
+        now_w = time.time()
+        if self.anomaly_engine is not None:
+            with self.timer.stage("anomaly"):
+                self.last_anomalies = self.anomaly_engine.observe(
+                    now_w,
+                    df,
+                    block=self._df_block,
+                    # None (not []) when the detector is off — the
+                    # honest "no detector ran" signal (the fabric scan
+                    # itself is screen-gated either way)
+                    stragglers=(
+                        self.last_stragglers
+                        if self.straggler_detector is not None
+                        else None
+                    ),
+                    keys=keys,
+                )
+        # the alert plane exists when EITHER engine is on: with
+        # TPUDASH_ALERT_RULES=off the anomaly entries (and the
+        # synthesized service rules) must still page/surface — the
+        # replay twin merges them unconditionally and live must agree
+        if self.alert_engine is not None or self.anomaly_engine is not None:
+            with self.timer.stage("alerts"):
+                from tpudash.alerts import sort_alerts
+
+                alerts = (
+                    self.alert_engine.evaluate(df)
+                    if self.alert_engine is not None
+                    else []
+                )
+                synth = self._endpoint_alerts(now_w)
+                synth += self._overload_alerts(now_w)
+                synth += self._federation_alerts(now_w)
+                synth = self._synth_dwell.apply(synth)
+                # anomaly entries carry their OWN dwell (the engine
+                # applies TPUDASH_ANOMALY_DWELL) — joined after the
+                # service-side dwell so holds never double-apply
+                synth = self._anomaly_alerts() + synth
+                self.last_alerts = self.silences.annotate(
+                    sort_alerts(_merge_alerts(alerts, synth)), now_w
+                )
+            self._notify_alert_transitions()
+        # every publish is a timeline observation: alert transitions and
+        # federation child flips become incident events (/api/incidents)
+        self.timeline.observe(
+            now_w, self.last_alerts, self._federation_summary()
+        )
+        # Fleet-wide trend history, one point per refresh interval (burst
+        # renders from selection POSTs must not pollute the cadence).
+        # Averages cover ALL chips in scope — per-browser selections are
+        # session-local now and must not steer the shared sparklines; this
+        # also matches the backfill scope (_backfill_history).
         # ring points are persisted epoch timestamps; the cadence gate
         # compares against restored wall stamps.
         # tpulint: allow[wall-clock] trend ring carries epoch timestamps
@@ -2145,10 +2244,12 @@ class DashboardService:
             frame["chips"] = []
             frame["timings"] = self.timer.summary()
             return frame
-        if self.alert_engine is not None:
+        if self.alert_engine is not None or self.anomaly_engine is not None:
             frame["alerts"] = self.last_alerts
         if self.straggler_detector is not None:
             frame["stragglers"] = self.last_stragglers
+        if self.anomaly_engine is not None:
+            frame["anomalies"] = self.last_anomalies
         # partial degradation (MultiSource): healthy slices render, failed
         # endpoints surface as warnings instead of blanking the page
         partial = getattr(self.source, "last_errors", None)

@@ -122,6 +122,7 @@ import signal
 import sys
 import time
 
+from tpudash import schema
 from tpudash.config import Config, configure_logging, env_is_set, load_config
 
 log = logging.getLogger(__name__)
@@ -2393,6 +2394,393 @@ def _scan_worker_logs(bus_dir: str) -> "list[str]":
     return out
 
 
+class DegradingChipSource:
+    """Drill source: a synthetic fleet whose one chip's throughput
+    metrics collapse to ``factor`` while :attr:`degraded` is set — the
+    slow-chip incident the anomaly engine exists to name.  Speaks the
+    ordinary MetricsSource protocol (list[Sample] passthrough)."""
+
+    name = "degrading-synthetic"
+
+    #: the lockstep-gating metrics a sick chip sags on.  The per-link
+    #: ICI series are the reliable detection signal: SPMD lockstep makes
+    #: them fleet-uniform (±2% in the synthetic model), so one sagging
+    #: chip is a huge modified-z outlier — whereas utilization legit
+    #: spreads across the fleet and a factor-4 sag hides in the spread
+    DEGRADE_METRICS = frozenset(
+        {
+            schema.TENSORCORE_UTIL,
+            schema.MXU_UTIL,
+            schema.ICI_TX,
+            schema.ICI_RX,
+            *schema.ICI_LINK_SERIES.values(),
+        }
+    )
+
+    def __init__(self, num_chips: int = 64, chip: int = 17, factor: float = 0.25):
+        from tpudash.sources.fixture import SyntheticSource
+
+        self.inner = SyntheticSource(num_chips=num_chips, emit_links=True)
+        self.chip = int(chip)
+        self.factor = float(factor)
+        self.degraded = False
+
+    def fetch(self):
+        samples = self.inner.fetch()
+        if not self.degraded:
+            return samples
+        # Sample is frozen — rebuild the sick chip's entries
+        return [
+            (
+                dataclasses.replace(s, value=s.value * self.factor)
+                if s.chip.chip_id == self.chip
+                and s.metric in self.DEGRADE_METRICS
+                else s
+            )
+            for s in samples
+        ]
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+def make_incident_server(
+    capture_path: str, chips: int = 64, cfg: "Config | None" = None
+):
+    """(DashboardServer, DegradingChipSource, cfg) for the incident
+    drill: anomaly engine on, fast refresh, recorder capturing every
+    scrape for the replay phase.  Explicit env settings win."""
+    import dataclasses as _dc
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.recorder import RecordingSource
+
+    cfg = cfg or load_config()
+    knobs = {
+        "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.2),
+        "TPUDASH_ANOMALY": ("anomaly", True),
+        "TPUDASH_ANOMALY_DWELL": ("anomaly_dwell", 1.0),
+        "TPUDASH_ANOMALY_SCORE_THRESHOLD": ("anomaly_score_threshold", 4.0),
+        "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", max(16, chips)),
+    }
+    for env_name, (fieldname, value) in knobs.items():
+        if not env_is_set(env_name):
+            cfg = _dc.replace(cfg, **{fieldname: value})
+    cfg = _dc.replace(cfg, record_path=capture_path, source="synthetic")
+    # the target must exist at any --chips value (ids are 0..n-1)
+    fault = DegradingChipSource(
+        num_chips=cfg.synthetic_chips,
+        chip=min(17, cfg.synthetic_chips - 1),
+    )
+    source = RecordingSource(fault, capture_path)
+    return DashboardServer(DashboardService(cfg, source)), fault, cfg
+
+
+async def run_incident_drill(chips: int = 64) -> dict:
+    """The anomaly-layer end-to-end drill: plant a degrading chip
+    mid-run, assert the ``anomaly`` alert fires within its dwell budget
+    (through the webhook pager and the silences workflow), appears in
+    ``/api/incidents`` with evidence resolving to a real ``/api/range``
+    window, resolves after heal — then replay the recorder capture
+    through the REAL CLI and assert (a) the unmodified config reproduces
+    the live timeline and (b) a raised threshold counterfactually
+    removes the incident from the diff."""
+    import shutil
+    import tempfile
+
+    from aiohttp import ClientSession, web
+
+    violations: list[str] = []
+    loop = asyncio.get_running_loop()
+    tmpdir = await loop.run_in_executor(
+        None, lambda: tempfile.mkdtemp(prefix="tpudash-incident-")
+    )
+    capture = os.path.join(tmpdir, "capture.jsonl")
+
+    # local webhook pager: every transition POST lands here
+    webhook_hits: list[dict] = []
+
+    async def webhook_handler(request):
+        try:
+            webhook_hits.append(await request.json())
+        except Exception:  # noqa: BLE001 — a broken POST is a drill failure later
+            webhook_hits.append({"malformed": True})
+        return web.Response(text="ok")
+
+    hook_app = web.Application()
+    hook_app.router.add_post("/", webhook_handler)
+    hook_runner = web.AppRunner(hook_app)
+    await hook_runner.setup()
+    hook_site = web.TCPSite(hook_runner, "127.0.0.1", 0)
+    await hook_site.start()
+    hook_port = hook_runner.addresses[0][1]
+
+    loop = asyncio.get_running_loop()
+    server, fault, cfg = await loop.run_in_executor(
+        None, make_incident_server, capture, chips
+    )
+    import dataclasses as _dc
+
+    server.service.cfg = cfg = _dc.replace(
+        cfg, alert_webhook=f"http://127.0.0.1:{hook_port}/"
+    )
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    app = server.build_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    host, port = runner.addresses[0][:2]
+    base = f"http://{host}:{port}"
+    target_chip = f"slice-0/{fault.chip}"
+    summary: dict = {"chips": cfg.synthetic_chips, "target": target_chip}
+
+    async def poll(session, seconds, predicate=None):
+        """Drive refreshes at the drill cadence until ``predicate``
+        (reading the latest /api/alerts doc) holds or time runs out.
+        Returns (matched, last_alerts)."""
+        deadline = time.monotonic() + seconds
+        alerts: list = []
+        while time.monotonic() < deadline:
+            async with session.get(f"{base}/api/frame") as r:
+                await r.read()
+            async with session.get(f"{base}/api/alerts") as r:
+                alerts = (await r.json())["alerts"]
+            if predicate is not None and predicate(alerts):
+                return True, alerts
+            await asyncio.sleep(cfg.refresh_interval / 2)
+        return predicate is None, alerts
+
+    def anomaly_firing(alerts):
+        return any(
+            a["rule"] == "anomaly"
+            and a["chip"] == target_chip
+            and a["state"] == "firing"
+            for a in alerts
+        )
+
+    live_inc = None
+    try:
+        async with ClientSession() as session:
+            # phase 1 — healthy fleet: the engine must stay QUIET
+            _, alerts = await poll(session, seconds=2.0)
+            noisy = [a for a in alerts if a["rule"] == "anomaly"]
+            if noisy:
+                violations.append(
+                    f"anomaly fired on a healthy demo fleet: {noisy[:3]}"
+                )
+            # phase 2 — inject the degrading chip, measure detection
+            fault.degraded = True
+            t_inject = time.monotonic()
+            fired, alerts = await poll(session, 15.0, anomaly_firing)
+            detection_s = time.monotonic() - t_inject
+            summary["detection_latency_s"] = round(detection_s, 2)
+            if not fired:
+                violations.append(
+                    "anomaly alert did not fire within 15s of the fault"
+                )
+            # hysteresis (straggler 3 + engine 2 cycles) + dwell budget
+            budget = 8 * cfg.refresh_interval + cfg.anomaly_dwell + 2.0
+            if fired and detection_s > budget:
+                violations.append(
+                    f"detection took {detection_s:.1f}s (budget {budget:.1f}s)"
+                )
+            entry = next(
+                (
+                    a
+                    for a in alerts
+                    if a["rule"] == "anomaly" and a["chip"] == target_chip
+                ),
+                None,
+            )
+            if entry is not None and not entry.get("evidence"):
+                violations.append("anomaly alert carries no evidence block")
+            if entry is not None and entry.get("score", 0) <= 0:
+                violations.append("anomaly alert carries no score")
+            # phase 3 — the incident timeline + range-window evidence
+            async with session.get(f"{base}/api/incidents") as r:
+                incidents = (await r.json())["incidents"]
+            inc = next(
+                (
+                    i
+                    for i in incidents
+                    if i["rule"] == "anomaly" and i["chip"] == target_chip
+                ),
+                None,
+            )
+            if inc is None or inc["state"] != "open":
+                violations.append(
+                    f"no open anomaly incident in /api/incidents "
+                    f"(got {[ (i['rule'], i['chip']) for i in incidents ]})"
+                )
+            else:
+                summary["incident_id"] = inc["id"]
+                url = inc["evidence"]["url"]
+                async with session.get(f"{base}{url}") as r:
+                    ok = r.status == 200
+                    pts = 0
+                    if ok:
+                        doc = await r.json()
+                        pts = sum(
+                            len(v) for v in doc.get("series", {}).values()
+                        )
+                if not ok or pts == 0:
+                    violations.append(
+                        f"evidence url {url} did not resolve to range data "
+                        f"(status={r.status}, points={pts})"
+                    )
+                summary["evidence_points"] = pts
+            # phase 4 — the silences workflow: acknowledge, verify the
+            # flag, verify the pager never saw the silenced window
+            async with session.post(
+                f"{base}/api/alerts/silence",
+                json={"rule": "anomaly", "chip": target_chip, "ttl_s": 60},
+            ) as r:
+                if r.status != 200:
+                    violations.append(f"silence POST failed: {r.status}")
+            _, alerts = await poll(session, 1.0)
+            sil = next(
+                (
+                    a
+                    for a in alerts
+                    if a["rule"] == "anomaly" and a["chip"] == target_chip
+                ),
+                None,
+            )
+            if sil is None or not sil.get("silenced"):
+                violations.append("silenced anomaly lost its silenced flag")
+            async with session.post(
+                f"{base}/api/alerts/unsilence",
+                json={"rule": "anomaly", "chip": target_chip},
+            ) as r:
+                await r.read()
+            # phase 5 — heal; the alert must resolve (dwell included)
+            fault.degraded = False
+            t_heal = time.monotonic()
+            resolved, alerts = await poll(
+                session, 15.0, lambda al: not anomaly_firing(al)
+            )
+            summary["resolve_latency_s"] = round(
+                time.monotonic() - t_heal, 2
+            )
+            if not resolved:
+                violations.append("anomaly alert did not resolve after heal")
+            async with session.get(
+                f"{base}/api/incidents?state=resolved"
+            ) as r:
+                resolved_incs = (await r.json())["incidents"]
+            live_inc = next(
+                (
+                    i
+                    for i in resolved_incs
+                    if i["rule"] == "anomaly" and i["chip"] == target_chip
+                ),
+                None,
+            )
+            if live_inc is None:
+                violations.append(
+                    "healed anomaly incident missing from "
+                    "/api/incidents?state=resolved"
+                )
+            webhook_rules = {
+                a["rule"]
+                for hit in webhook_hits
+                for a in hit.get("fired", [])
+            }
+            if "anomaly" not in webhook_rules:
+                violations.append(
+                    f"webhook pager never saw an anomaly fired transition "
+                    f"(saw rules: {sorted(webhook_rules)})"
+                )
+    finally:
+        await runner.cleanup()
+        await hook_runner.cleanup()
+        logging.getLogger().removeHandler(trap)
+
+    # phase 6 — the replay twin, through the REAL CLI: the unmodified
+    # config must reproduce the live timeline from the capture, and a
+    # raised threshold must counterfactually remove the incident
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("TPUDASH_")
+    }
+    env["TPUDASH_ANOMALY"] = "1"  # tpulint: allow[env-read] child-CLI env build, not a read
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable,
+        "-m",
+        "tpudash.anomaly",
+        "replay",
+        "--capture",
+        capture,
+        "--threshold",
+        "999",
+        "--json",
+        env=env,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+    )
+    out, err = await proc.communicate()
+    replay_ok = proc.returncode == 0
+    if not replay_ok:
+        violations.append(
+            f"replay CLI failed rc={proc.returncode}: {err.decode()[-400:]}"
+        )
+    else:
+        try:
+            doc = json.loads(out.decode())
+        except ValueError:
+            doc = {}
+            violations.append("replay CLI emitted unparseable JSON")
+        control = doc.get("control", {}).get("incidents", [])
+        ctl_inc = next(
+            (
+                i
+                for i in control
+                if i["rule"] == "anomaly" and i["chip"] == target_chip
+            ),
+            None,
+        )
+        if ctl_inc is None:
+            violations.append(
+                "replay (unmodified config) did not reproduce the "
+                "anomaly incident from the capture"
+            )
+        elif live_inc is not None:
+            drift = abs(ctl_inc["start"] - live_inc["start"])
+            summary["replay_start_drift_s"] = round(drift, 2)
+            if drift > 3.0:
+                violations.append(
+                    f"replayed incident start drifted {drift:.1f}s from "
+                    "the live timeline"
+                )
+        diff = doc.get("diff", {})
+        removed = [
+            r
+            for r in diff.get("removed", [])
+            if r["rule"] == "anomaly" and r["chip"] == target_chip
+        ]
+        if not removed:
+            violations.append(
+                "threshold-999 counterfactual did not remove the anomaly "
+                f"incident (diff summary: {diff.get('summary')})"
+            )
+        summary["counterfactual_removed"] = len(removed)
+    if trap.records:
+        violations.append(
+            f"{len(trap.records)} unhandled server error(s): "
+            f"{trap.records[:3]}"
+        )
+    await loop.run_in_executor(
+        None, lambda: shutil.rmtree(tmpdir, ignore_errors=True)
+    )
+    summary["webhook_posts"] = len(webhook_hits)
+    summary["violations"] = violations
+    summary["ok"] = not violations
+    return summary
+
+
 def main(argv: "list[str] | None" = None) -> None:
     import argparse
 
@@ -2437,6 +2825,14 @@ def main(argv: "list[str] | None" = None) -> None:
         "anti-flap dwell) and recover within one poll of heal",
     )
     pa.add_argument("--children", type=int, default=4)
+    inc = sub.add_parser(
+        "incident",
+        help="anomaly-layer drill: degrading-chip fault mid-storm → "
+        "anomaly alert (dwell/silences/webhook) → /api/incidents "
+        "timeline with range evidence → heal → replay-CLI "
+        "counterfactual under a raised threshold",
+    )
+    inc.add_argument("--chips", type=int, default=64)
     # internal: one shard of the storm's streaming population, spawned
     # by the storm drill itself (the load generator runs on its own
     # cores so a 2500-client storm measures the tier, not the driver)
@@ -2492,6 +2888,10 @@ def main(argv: "list[str] | None" = None) -> None:
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "partition":
         summary = asyncio.run(run_partition_drill(children=args.children))
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "incident":
+        summary = asyncio.run(run_incident_drill(chips=args.chips))
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)
 

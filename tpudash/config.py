@@ -256,6 +256,34 @@ class Config:
     #: condition has stayed clear this long — a child flapping at
     #: sub-poll period pages once, not once per flap.  0 disables.
     alert_dwell: float = 0.0
+    # --- anomaly engine (tpudash.anomaly): baselines, detection, replay ------
+    #: Online anomaly detection on the refresh path (tpudash.anomaly):
+    #: per-chip seasonal baseline deviation, fleet-straggler promotion,
+    #: and torus-correlated ICI fabric degradation, synthesized as the
+    #: ``anomaly`` alert rule (rides dwell/silences/webhook) and stitched
+    #: into ``GET /api/incidents``.  On by default; TPUDASH_ANOMALY=0 is
+    #: the kill switch.
+    anomaly: bool = True
+    #: Seasonal time-of-interval bucket width, seconds: each chip keeps
+    #: a separate baseline per bucket of the day (3600 → 24 buckets —
+    #: "what is normal for THIS chip at THIS hour").  Values above a day
+    #: degrade to one global bucket.  Memory is
+    #: chips × watched metrics × (86400/window) × 24 B.
+    anomaly_baseline_window: float = 3600.0
+    #: Deviation score a chip must reach before a finding is tracked
+    #: (baseline path: winsorized z against the chip's own seasonal
+    #: location/scale; fabric grouping uses the straggler core's 3.5).
+    anomaly_score_threshold: float = 4.0
+    #: Anti-flap resolve dwell for ``anomaly`` alerts, seconds: once
+    #: fired, an anomaly keeps firing until its condition stays clear
+    #: this long.  0 = inherit TPUDASH_ALERT_DWELL.
+    anomaly_dwell: float = 0.0
+    #: Run the batch scoring kernel under jax (jitted; sharded over the
+    #: chip axis on multi-device hosts) instead of numpy.  Falls back to
+    #: numpy loudly when jax is unavailable; both paths agree within
+    #: float32 tolerance (see docs/OPERATIONS.md).  Off by default —
+    #: numpy is faster below ~10k chips.
+    anomaly_jax: bool = False
     #: Fault-injection scenario for chaos drills ("" = off) — wraps the
     #: configured source in ChaosSource (grammar: sources/chaos.py, e.g.
     #: ``latency:p=0.3,ms=800;flap:period=6;seed=42``).  Drill tool;
@@ -397,6 +425,11 @@ _ENV_MAP = {
     "federate_stale_budget": "TPUDASH_FEDERATE_STALE_BUDGET",
     "federate_hedge": "TPUDASH_FEDERATE_HEDGE",
     "alert_dwell": "TPUDASH_ALERT_DWELL",
+    "anomaly": "TPUDASH_ANOMALY",
+    "anomaly_baseline_window": "TPUDASH_ANOMALY_BASELINE_WINDOW",
+    "anomaly_score_threshold": "TPUDASH_ANOMALY_SCORE_THRESHOLD",
+    "anomaly_dwell": "TPUDASH_ANOMALY_DWELL",
+    "anomaly_jax": "TPUDASH_ANOMALY_JAX",
     "chaos": "TPUDASH_CHAOS",
     "max_concurrency": "TPUDASH_MAX_CONCURRENCY",
     "rate_limit": "TPUDASH_RATE_LIMIT",

@@ -93,6 +93,56 @@ DEFAULT_RULES_SPEC = (
 
 DIRECTIONS = ("low", "high", "both")
 
+#: Hard dispersion floor for the scoring core, independent of the
+#: configurable ``min_chips``: below 3 reporting chips the modified
+#: z-score is degenerate — with n == 1 every value IS the median (z is
+#: identically 0), and with n == 2 the two deviations are symmetric by
+#: construction (|z| == 1/1.4826 ≈ 0.67 whatever the gap), so the score
+#: carries no outlier information yet LOOKS like a real number.  Before
+#: this guard a detector configured with min_chips <= 2 silently
+#: produced those meaningless scores (and a ``both``-direction rule with
+#: a low threshold could flag BOTH chips of a 2-chip population); now
+#: any population under MIN_POPULATION is skipped — "not evaluated",
+#: never "scored".
+MIN_POPULATION = 3
+
+
+def robust_scores(
+    values,
+    *,
+    direction: str = "low",
+    zscore: float = 3.5,
+    rel_floor: float = 0.02,
+):
+    """The straggler/anomaly scoring core: robust modified z-scores
+    (Iglewicz–Hoaglin) over ONE metric vector, shared by
+    :class:`StragglerDetector` and the anomaly engine
+    (tpudash.anomaly.detect) so fleet-outlier semantics cannot drift
+    between the two.
+
+    ``values`` must already be the eligible population (no NaN, zero
+    exclusion applied).  Returns ``(z, breach, median, scale)`` where
+    ``z`` is the signed score vector, ``breach`` the direction-resolved
+    boolean mask at ``zscore``, or ``None`` when the population is
+    degenerate (fewer than :data:`MIN_POPULATION` values — see its note;
+    callers must treat that as "metric not evaluated", not "no
+    stragglers").
+    """
+    x = np.asarray(values, dtype=float)
+    if x.size < MIN_POPULATION:
+        return None
+    med = float(np.median(x))
+    mad = float(np.median(np.abs(x - med)))
+    scale = max(1.4826 * mad, rel_floor * abs(med), 1e-9)
+    z = (x - med) / scale
+    if direction == "low":
+        breach = z <= -zscore
+    elif direction == "high":
+        breach = z >= zscore
+    else:
+        breach = np.abs(z) >= zscore
+    return z, breach, med, scale
+
 
 @dataclass(frozen=True)
 class StragglerRule:
@@ -228,16 +278,18 @@ class StragglerDetector:
                 skipped.add(rule.column)
                 continue
             x = values[eligible]
-            med = float(np.median(x))
-            mad = float(np.median(np.abs(x - med)))
-            scale = max(1.4826 * mad, self.rel_floor * abs(med), 1e-9)
-            z = (x - med) / scale
-            if rule.direction == "low":
-                breach = z <= -self.zscore
-            elif rule.direction == "high":
-                breach = z >= self.zscore
-            else:
-                breach = np.abs(z) >= self.zscore
+            scored = robust_scores(
+                x,
+                direction=rule.direction,
+                zscore=self.zscore,
+                rel_floor=self.rel_floor,
+            )
+            if scored is None:
+                # dispersion guard (MIN_POPULATION): an operator-set
+                # min_chips of 1 or 2 must not let degenerate scores out
+                skipped.add(rule.column)
+                continue
+            z, breach, med, _scale = scored
             count = int(np.count_nonzero(breach))
             if count == 0:
                 # genuinely evaluated and clear — tracks may resolve
