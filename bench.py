@@ -1179,6 +1179,166 @@ def bench_federation(
     return out
 
 
+def bench_range_quantiles(
+    n_frames: int = 2880, n_chips: int = 128, n_cols: int = 4
+) -> dict:
+    """The analytics plane's headline gate (ISSUE 13): a fleet-wide p99
+    range query answered from the sealed quantile sketches must be
+    ≥10× faster than the raw-decode exact answer over the same window,
+    and land inside the sketch's documented accuracy bound
+    (RANK_ERROR_BOUND — the reported p99 sits between the exact values
+    at ranks 0.99 ± 0.01).  Both are HARD bars: losing either means the
+    sketch tier quietly stopped being the read path."""
+    import numpy as np
+
+    from tpudash.analytics.sketch import RANK_ERROR_BOUND
+    from tpudash.tsdb import FLEET_SERIES, TSDB
+    from tpudash.tsdb.query import range_query
+
+    rng = np.random.default_rng(13)
+    keys = [f"slice-{i // 64}/{i}" for i in range(n_chips)]
+    cols = [f"metric_{i}" for i in range(n_cols)]
+    base = time.time() - n_frames * 5.0
+    level = rng.uniform(40.0, 90.0, size=(n_chips, n_cols))
+    walk = np.cumsum(rng.normal(0, 0.3, size=(n_frames, n_chips, n_cols)), axis=0)
+    mats = np.round(level + walk, 1).astype(np.float32)
+    stamps = base + 5.0 * np.arange(n_frames)
+    store = TSDB(chunk_points=120)
+    for i in range(n_frames):
+        store.append_frame(float(stamps[i]), keys, cols, mats[i])
+    store.flush(seal_partial=True)
+    step = 600.0
+    col = cols[0]
+
+    # sketch path: fleet-distribution p99 per 10m bucket
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        res = range_query(
+            store, FLEET_SERIES, cols=[col], start_s=base, step_s=step,
+            agg="p99",
+        )
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    sketch_p50 = times[len(times) // 2]
+    pts = res["series"][col]
+    assert pts, "sketch p99 query returned no points"
+    assert res["resolution"] in ("1m", "10m"), res["resolution"]
+
+    # raw-decode exact: every chip's raw points per bucket, full sort
+    def exact():
+        out = {}
+        for k in keys:
+            for t, v in store.raw_window(
+                k, col, int(base * 1000), int(stamps[-1] * 1000) + 1
+            ):
+                out.setdefault(int(t // (step * 1000)), []).append(v)
+        return {
+            b: np.sort(np.asarray(vals, dtype=np.float64))
+            for b, vals in out.items()
+        }
+
+    t0 = time.perf_counter()
+    exact_buckets = exact()
+    raw_ms = (time.perf_counter() - t0)
+    speedup = raw_ms / max(sketch_p50, 1e-9)
+    assert speedup >= 10.0, (
+        f"sketch p99 only {speedup:.1f}x faster than raw decode (<10x): "
+        f"{sketch_p50 * 1e3:.2f}ms vs {raw_ms * 1e3:.2f}ms"
+    )
+    # accuracy: every reported bucket inside the documented rank window
+    worst = 0.0
+    for ts, v in pts:
+        b = int(ts // step)
+        sv = exact_buckets.get(b)
+        if sv is None or sv.size < 100:
+            continue
+        lo = sv[max(0, int((0.99 - RANK_ERROR_BOUND) * sv.size) - 1)]
+        hi = sv[min(sv.size - 1, int((0.99 + RANK_ERROR_BOUND) * sv.size))]
+        assert lo <= v <= hi, (
+            f"sketch p99 {v:.3f} outside documented bound "
+            f"[{lo:.3f}, {hi:.3f}] for bucket {b}"
+        )
+        exact_v = float(np.quantile(sv, 0.99))
+        worst = max(worst, abs(v - exact_v))
+    return {
+        "range_quantile_sketch_p50_ms": round(sketch_p50 * 1e3, 2),
+        "range_quantile_raw_decode_ms": round(raw_ms * 1e3, 2),
+        "range_quantile_speedup": round(speedup, 1),
+        "range_quantile_worst_abs_err": round(worst, 3),
+        "range_quantile_points": len(pts),
+    }
+
+
+def bench_federated_range(children: int = 16, rounds: int = 20) -> dict:
+    """Scatter-gather fan-in cost at 16 children (ISSUE 13): one child's
+    REAL serialized range-state document (built from a real store,
+    JSON-round-tripped like the wire would) served by fake clients, so
+    the number isolates the dispatch + validate + merge machinery the
+    parent actually pays per fleet range query — worst case, no child
+    failures."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from tpudash.analytics.executor import parse_state_doc, range_state
+    from tpudash.config import load_config
+    from tpudash.federation.source import ChildSpec, FederatedSource
+    from tpudash.tsdb import TSDB
+
+    rng = np.random.default_rng(7)
+    keys = [f"slice-0/{i}" for i in range(256)]
+    cols = [f"metric_{i}" for i in range(4)]
+    base = time.time() - 3600.0
+    store = TSDB(chunk_points=120)
+    level = rng.uniform(40.0, 90.0, size=(256, 4))
+    for i in range(720):
+        store.append_frame(
+            base + 5.0 * i, keys, cols,
+            np.round(level + rng.normal(0, 0.5, size=(256, 4)), 1).astype(
+                np.float32
+            ),
+        )
+    store.flush(seal_partial=True)
+    doc_bytes = _dumps(
+        range_state(store, None, None, base, None, 600.0, "p99", 500)
+    )
+
+    class FakeRangeClient:
+        def fetch(self, params, timeout):
+            return parse_state_doc(json.loads(doc_bytes))
+
+    cfg = _dc.replace(load_config({}), federate="unused")
+    specs = [
+        (ChildSpec(f"c{i}", f"http://child-{i}:8050"), object())
+        for i in range(children)
+    ]
+    src = FederatedSource(cfg, children=specs)
+    for name in list(src._range_clients):
+        src._range_clients[name] = FakeRangeClient()
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        gathered = src.scatter_range({"agg": "p99", "start": base})
+        times.append(time.perf_counter() - t0)
+        assert len(gathered["states"]) == children
+        assert not gathered["partial"]
+    times.sort()
+    from tpudash.analytics.executor import merge_states
+
+    t0 = time.perf_counter()
+    merged = merge_states(gathered["states"], "p99")
+    merge_ms = (time.perf_counter() - t0) * 1e3
+    assert merged["series"], "federated merge produced no series"
+    return {
+        f"federated_range_fanin_{children}_p50_ms": round(
+            times[len(times) // 2] * 1e3, 2
+        ),
+        "federated_range_merge_ms": round(merge_ms, 2),
+        "federated_range_state_bytes": len(doc_bytes),
+    }
+
+
 def bench_probes(timeout_s: float = 300.0) -> dict:
     """On-chip probe numbers, isolated in a SUBPROCESS with a hard
     timeout: a wedged accelerator runtime (e.g. a tunneled chip whose
@@ -1362,6 +1522,22 @@ def find_regressions(
         "federation_fanin_16_p50_ms",
     ):
         check(key, result.get(key), prev.get(key), "higher", 1.0)
+    # the analytics query plane (ISSUE 13): sketch-vs-raw speedup is
+    # ratio-domain (halving means the sketch read path degraded); the
+    # p50s are time-domain on a noisy host — 2x swings flag (the hard
+    # ≥10x and accuracy-bound bars live inside bench_range_quantiles)
+    check(
+        "range_quantile_speedup",
+        result.get("range_quantile_speedup"),
+        prev.get("range_quantile_speedup"),
+        "lower",
+        0.50,
+    )
+    for key in (
+        "range_quantile_sketch_p50_ms",
+        "federated_range_fanin_16_p50_ms",
+    ):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
     # durability tier (ISSUE 8): snapshot duration and follower replay
     # are time-domain on a noisy host — 2x swings flag (the hard
     # near-zero ingest-stall guard lives inside bench_snapshot itself)
@@ -1445,6 +1621,8 @@ def main() -> None:
     snapshot = bench_snapshot()
     federation = bench_federation()
     anomaly_scoring = bench_anomaly_scoring()
+    range_quantiles = bench_range_quantiles()
+    federated_range = bench_federated_range()
     probes = bench_probes()
     p50 = dash["p50_s"]
     result = {
@@ -1489,6 +1667,8 @@ def main() -> None:
         **snapshot,
         **federation,
         **anomaly_scoring,
+        **range_quantiles,
+        **federated_range,
         "probes": probes,
         "cpu_ref_ms": cpu_reference_ms(),
         "cpu_ref_json_ms": cpu_reference_json_ms(),

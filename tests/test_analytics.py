@@ -1,0 +1,882 @@
+"""The analytics query plane (ISSUE 13): mergeable quantile sketches,
+recording rules, and federated scatter-gather range queries.
+
+Coverage map (the ISSUE's test satellite, plus the regression pins):
+
+- sketch: documented accuracy bound, merge-order/chunking determinism
+  fuzz, serialization round-trip + malformed refusals, quad fallback;
+- tsdb: sketch records persist/reload, mixed-version segment dir
+  (pre-sketch + new segments in one store, backfill on seal), quantile
+  range queries from sketches, recording rules sealed as first-class
+  ``__rule__/`` series, byte-stable across restart, follower
+  replication;
+- query: the step-alignment fix (first bucket clamped, no pre-start
+  fold) pinned;
+- federation: scatter with one dark + one stale child degrades
+  partial-not-error; replica serves a failed child;
+- server: /api/range agg=p99 + ETag/304 + stale-degrade shed path +
+  /api/range.csv.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpudash.analytics.executor import (
+    merge_states,
+    parse_state_doc,
+    range_state,
+    range_to_csv,
+)
+from tpudash.analytics.rules import (
+    RULE_PREFIX,
+    RuleEngine,
+    parse_rules,
+)
+from tpudash.analytics.sketch import (
+    RANK_ERROR_BOUND,
+    QuantileSketch,
+    SketchError,
+)
+from tpudash.config import load_config
+from tpudash.tsdb import FLEET_SERIES, TSDB
+from tpudash.tsdb.query import range_query
+from tpudash.tsdb.rollup import ALL_KEY, TIER_10M_MS, TIER_1M_MS
+
+
+def _rank_window(sorted_vals: np.ndarray, q: float, eps: float):
+    n = sorted_vals.size
+    lo = sorted_vals[max(0, int((q - eps) * n) - 1)]
+    hi = sorted_vals[min(n - 1, int((q + eps) * n))]
+    return lo, hi
+
+
+# -- sketch -------------------------------------------------------------------
+def test_sketch_quantiles_within_documented_bound():
+    rng = np.random.default_rng(0)
+    for dist in (
+        rng.normal(50, 10, 20000),
+        rng.exponential(5.0, 20000),
+        rng.uniform(0, 100, 20000),
+        np.repeat([1.0, 2.0, 3.0], 5000),
+    ):
+        sk = QuantileSketch.from_values(dist)
+        sv = np.sort(dist)
+        for q in (0.95, 0.99):
+            lo, hi = _rank_window(sv, q, RANK_ERROR_BOUND)
+            got = sk.quantile(q)
+            assert lo <= got <= hi, (q, got, lo, hi)
+        # mid-quantile: looser documented bound
+        lo, hi = _rank_window(sv, 0.5, 0.025)
+        assert lo <= sk.quantile(0.5) <= hi
+
+
+def test_sketch_merge_determinism_fuzz():
+    """Merge order / chunking never changes reported quantiles beyond
+    the accuracy bound — and one flat merge of a fixed multiset is
+    bit-deterministic regardless of input order."""
+    rng = np.random.default_rng(1)
+    vals = rng.normal(100, 25, 24000)
+    parts = [
+        QuantileSketch.from_values(vals[i::12]) for i in range(12)
+    ]
+    flat = QuantileSketch.merged(parts)
+    assert (
+        QuantileSketch.merged(list(reversed(parts))).to_bytes()
+        == flat.to_bytes()
+    ), "flat merge must not depend on input order"
+    sv = np.sort(vals)
+    for trial in range(10):
+        order = rng.permutation(12)
+        # random binary chunking: merge random sub-groups, then merge
+        # the intermediates — the federated tree shape
+        cut = int(rng.integers(1, 11))
+        a = QuantileSketch.merged([parts[i] for i in order[:cut]])
+        b = QuantileSketch.merged([parts[i] for i in order[cut:]])
+        tree = QuantileSketch.merged([a, b])
+        assert tree.count == flat.count
+        for q in (0.5, 0.95, 0.99):
+            eps = RANK_ERROR_BOUND if q >= 0.95 else 0.025
+            lo, hi = _rank_window(sv, q, 2 * eps)
+            assert lo <= tree.quantile(q) <= hi, (trial, q)
+
+
+def test_sketch_wire_round_trip_and_refusals():
+    sk = QuantileSketch.from_values(np.arange(1000.0))
+    rt = QuantileSketch.from_bytes(sk.to_bytes())
+    assert rt.to_bytes() == sk.to_bytes()
+    assert rt.quantile(0.99) == sk.quantile(0.99)
+    # empty digest round-trips
+    empty = QuantileSketch.from_values([])
+    assert QuantileSketch.from_bytes(empty.to_bytes()).count == 0
+    assert empty.quantile(0.5) != empty.quantile(0.5)  # NaN
+    raw = sk.to_bytes()
+    for bad in (
+        b"",
+        raw[:-3],  # truncated
+        b"\xff" + raw[1:],  # version
+        raw[: len(raw) - 8] + b"\xff" * 8,  # unsorted/garbage tail
+    ):
+        with pytest.raises(SketchError):
+            QuantileSketch.from_bytes(bad)
+
+
+def test_sketch_nonfinite_dropped_and_quad_fallback():
+    sk = QuantileSketch.from_values([1.0, np.nan, 2.0, np.inf, 3.0])
+    assert sk.count == 3
+    q = QuantileSketch.from_quad(0.0, 100.0, 5000.0, 100)
+    assert q.count == 100
+    assert 0.0 <= q.quantile(0.99) <= 100.0
+    assert QuantileSketch.from_quad(np.nan, 1, 1, 5).count == 0
+
+
+# -- store: sketches + rules --------------------------------------------------
+def _fill_store(store, n_frames=240, n_chips=8, base=None, seed=3,
+                cols=("util", "power")):
+    rng = np.random.default_rng(seed)
+    keys = [f"s0/{i}" for i in range(n_chips)]
+    if base is None:
+        base = time.time() - n_frames * 5.0
+    base = float(int(base) // 600 * 600)
+    level = rng.uniform(40, 90, size=(n_chips, len(cols)))
+    for i in range(n_frames):
+        mat = np.round(
+            level + rng.normal(0, 2.0, size=(n_chips, len(cols))), 1
+        ).astype(np.float32)
+        store.append_frame(base + 5.0 * i, keys, list(cols), mat)
+    return keys, list(cols), base
+
+
+def test_store_seals_and_reloads_sketch_records(tmp_path):
+    d = str(tmp_path / "t")
+    store = TSDB(path=d, chunk_points=60, sketch_series="all")
+    _keys, cols, base = _fill_store(store)
+    store.flush(seal_partial=True)
+    stats = store.stats()
+    assert stats["sketch_blocks"]["1m"] > 0
+    assert stats["sketch_blocks"]["10m"] > 0
+    res = range_query(store, FLEET_SERIES, cols=[cols[0]], start_s=base,
+                      agg="p99")
+    assert res["series"][cols[0]]
+    # reload: sketch records come back from disk, answers identical
+    re = TSDB(path=d, sketch_series="all")
+    assert re.stats()["sketch_blocks"] == stats["sketch_blocks"]
+    res2 = range_query(re, FLEET_SERIES, cols=[cols[0]], start_s=base,
+                       agg="p99")
+    assert res2["series"][cols[0]] == res["series"][cols[0]]
+
+
+def test_quantile_query_matches_exact_within_bound():
+    store = TSDB(chunk_points=120, sketch_series="all")
+    keys, cols, base = _fill_store(store, n_frames=360, n_chips=16)
+    store.flush(seal_partial=True)
+    res = range_query(
+        store, FLEET_SERIES, cols=["util"], start_s=base, step_s=600,
+        agg="p99",
+    )
+    pts = res["series"]["util"]
+    assert pts
+    # exact per-bucket check from raw
+    raw = {}
+    for k in keys:
+        for t, v in store.raw_window(
+            k, "util", int(base * 1000), int((base + 3600) * 1000)
+        ):
+            raw.setdefault(t // 600_000 * 600_000, []).append(v)
+    for ts, got in pts:
+        sv = np.sort(np.asarray(raw[int(ts * 1000) // 600_000 * 600_000]))
+        lo, hi = _rank_window(sv, 0.99, RANK_ERROR_BOUND)
+        assert lo <= got <= hi
+
+
+def test_quantile_sees_unsealed_live_tail_in_covered_bucket():
+    """Regression (review round 2): head samples landing in a bucket a
+    sealed sketch already partially covers must still fold into the
+    quantile — the current bucket's p99 must not hide a spike for a
+    whole chunk interval while the mean shows it."""
+    store = TSDB(chunk_points=6, sketch_series="all")
+    base = float(int(time.time() - 1200) // 600 * 600)
+    keys = ["s/0"]
+    # first 6 frames (one sealed chunk): quiet values in minute 0
+    for i in range(6):
+        store.append_frame(base + 5.0 * i, keys, ["m"],
+                           np.array([[10.0]], dtype=np.float32))
+    store.flush()  # seals the chunk; its sketch covers minute 0 partially
+    # head: a spike in the SAME minute bucket, unsealed
+    for i in range(6, 11):
+        store.append_frame(base + 5.0 * i, keys, ["m"],
+                           np.array([[1000.0]], dtype=np.float32))
+    res = range_query(store, "s/0", cols=["m"], start_s=base,
+                      end_s=base + 60, step_s=60, agg="p99")
+    (ts, v), = res["series"]["m"]
+    assert v > 500.0, f"live-tail spike invisible to p99: {v}"
+    # and the fleet-distribution path sees it too
+    resf = range_query(store, FLEET_SERIES, cols=["m"], start_s=base,
+                       end_s=base + 60, step_s=60, agg="p99")
+    assert resf["series"]["m"][0][1] > 500.0
+
+
+def test_chip_scope_quantile_uses_per_series_sketches():
+    store = TSDB(chunk_points=120)  # default: per-series at 10m
+    keys, _cols, base = _fill_store(store, n_frames=360)
+    store.flush(seal_partial=True)
+    res = range_query(store, keys[0], cols=["util"], start_s=base,
+                      step_s=600, agg="p95")
+    assert res["series"]["util"]
+    for _ts, v in res["series"]["util"]:
+        assert 20 <= v <= 110
+
+
+def test_mixed_version_segment_dir_backfills_on_seal(tmp_path):
+    """Pre-sketch segments + new ones in one store: the pre-13 half is
+    served (never refused) and backfilled to real sketch records on the
+    first seal."""
+    d = str(tmp_path / "t")
+    old = TSDB(path=d, chunk_points=60, sketch_budget=0)  # "pre-13"
+    _keys, cols, base = _fill_store(old, n_frames=120)
+    old.flush(seal_partial=True)
+    assert sum(old.stats()["sketch_blocks"].values()) == 0
+
+    store = TSDB(path=d, chunk_points=60)
+    assert store._sketch_backfill  # pre-13 raw detected
+    # quantile queries answer BEFORE any backfill (raw-fold fallback)
+    res = range_query(store, FLEET_SERIES, cols=[cols[0]], start_s=base,
+                      agg="p99")
+    assert res["series"][cols[0]]
+    # appending + sealing new data triggers the backfill
+    _fill_store(store, n_frames=60, base=base + 120 * 5.0)
+    store.flush(seal_partial=True)
+    assert not store._sketch_backfill
+    assert sum(store.stats()["sketch_blocks"].values()) > 0
+    # and the sketch records for the OLD window are now on disk
+    re = TSDB(path=d)
+    spans = [
+        (s.src_t0, s.src_t1) for s in re._sketches[TIER_10M_MS]
+    ]
+    assert any(lo <= int(base * 1000) + 1 <= hi for lo, hi in spans), spans
+    res2 = range_query(re, FLEET_SERIES, cols=[cols[0]], start_s=base,
+                       agg="p99")
+    assert res2["series"][cols[0]]
+
+
+def test_step_alignment_first_bucket_clamped_regression():
+    """ISSUE 13 satellite fix: an unaligned ``start`` used to fold a
+    whole out-of-window rollup bucket into the first in-window step
+    bucket (and could stamp data windows preceding ``start``).  Now the
+    grid is epoch-anchored, the pre-start bucket keeps its own slot,
+    and only its TIMESTAMP clamps to ``start``."""
+    store = TSDB(chunk_points=60)
+    keys = ["s/0"]
+    base = float(int(time.time() - 3600) // 600 * 600)
+    for i in range(120):
+        store.append_frame(
+            base + 5.0 * i, keys, ["m"],
+            np.array([[float(i)]], dtype=np.float32),
+        )
+    store.flush(seal_partial=True)
+    start = base + 7.3  # mid first 1m bucket
+    res = range_query(store, "s/0", cols=["m"], start_s=start, step_s=60,
+                      agg="mean")
+    pts = res["series"]["m"]
+    assert res["resolution"] == "1m"
+    # no emitted bucket precedes the window
+    assert all(ts >= start for ts, _v in pts)
+    # first bucket = ONLY the partial tier bucket (values 0..11, mean
+    # 5.5), clamped to start; the old bug merged buckets 0 AND 1 into
+    # it (mean 11.5)
+    assert pts[0][0] == pytest.approx(start)
+    assert pts[0][1] == pytest.approx(5.5)
+    # second bucket sits on the epoch grid with its own minute
+    assert pts[1][0] == pytest.approx(base + 60.0)
+    assert pts[1][1] == pytest.approx(np.mean(np.arange(12, 24)))
+
+
+# -- recording rules ----------------------------------------------------------
+def test_rule_grammar_parses_and_refuses():
+    rules = parse_rules("a=mean(x); b=p99(y) by slice; c=anomaly()")
+    assert [r.name for r in rules] == ["a", "b", "c"]
+    assert rules[1].by == "slice"
+    for bad in (
+        "a=mean(x); a=max(x)",  # duplicate
+        "a=stdev(x)",  # unknown fn
+        "a=mean()",  # missing col
+        "a=anomaly(x)",  # anomaly takes no col
+        "a=anomaly() by slice",  # anomaly is fleet-scoped
+        "nonsense",
+    ):
+        with pytest.raises(ValueError):
+            parse_rules(bad)
+
+
+def test_rules_seal_as_first_class_series():
+    eng = RuleEngine(parse_rules(
+        "fleet_util=mean(util);slice_util=mean(util) by slice;"
+        "host_power=sum(power) by host;fleet_p99=p99(util)"
+    ))
+    eng.set_host_map(
+        [f"s0/{i}" for i in range(8)],
+        [f"host-{i // 4}" for i in range(8)],
+    )
+    store = TSDB(chunk_points=60)
+    store.rule_engine = eng
+    keys, _cols, base = _fill_store(store, n_frames=120)
+    store.flush(seal_partial=True)
+    assert eng.evaluations > 0
+    keyset = store.series_keys()
+    assert RULE_PREFIX + "fleet_util" in keyset
+    assert RULE_PREFIX + "slice_util/s0" in keyset
+    assert RULE_PREFIX + "host_power/host-0" in keyset
+    res = range_query(store, RULE_PREFIX + "fleet_util", start_s=base)
+    assert res["series"]["util"]
+    # the rule value IS the population mean of the sealed frames: check
+    # the first sealed point against the raw matrix mean
+    first_ts, first_v = res["series"]["util"][0]
+    raw_vals = [
+        v
+        for k in keys
+        for t, v in store.raw_window(
+            k, "util", int(first_ts * 1000), int(first_ts * 1000)
+        )
+    ]
+    assert first_v == pytest.approx(np.mean(raw_vals), abs=0.01)
+    # quantile over the RULE series works too (per-series sketches)
+    resq = range_query(store, RULE_PREFIX + "fleet_util", cols=["util"],
+                       start_s=base, step_s=600, agg="p95")
+    assert resq["series"]["util"]
+
+
+def test_rules_never_break_sealing():
+    class Boom:
+        rules = ()
+
+        def evaluate(self, *a):
+            raise RuntimeError("boom")
+
+    eng = RuleEngine(parse_rules("x=mean(util)"))
+    eng._evaluate = None  # force the guard path
+
+    store = TSDB(chunk_points=30)
+    store.rule_engine = eng
+    _fill_store(store, n_frames=60)
+    store.flush(seal_partial=True)
+    assert store.stats()["raw_points"] == 60  # data sealed regardless
+    assert eng.last_error is not None
+
+
+def test_rule_output_byte_stable_across_restart(tmp_path):
+    """Identical input frames → identical rule-series segment bytes —
+    and a reload serves the rule series byte-identically (snapshot /
+    follower replication inherit this, they copy the same records)."""
+    base = float(int(time.time() - 7200) // 600 * 600)
+
+    def build(d):
+        eng = RuleEngine(parse_rules("fleet_util=mean(util)"))
+        store = TSDB(path=d, chunk_points=60)
+        store.rule_engine = eng
+        _fill_store(store, n_frames=120, base=base, seed=11)
+        store.flush(seal_partial=True)
+        store.close()
+        return store
+
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    build(d1)
+    build(d2)
+    for name in ("raw-000001.seg", "1m-000001.seg", "10m-000001.seg"):
+        b1 = (tmp_path / "a" / name).read_bytes()
+        b2 = (tmp_path / "b" / name).read_bytes()
+        assert b1 == b2, f"{name} differs between identical runs"
+    # restart: the reloaded store answers the rule series identically
+    re = TSDB(path=d1)
+    fresh = TSDB(path=d2)
+    q1 = range_query(re, RULE_PREFIX + "fleet_util", start_s=base)
+    q2 = range_query(fresh, RULE_PREFIX + "fleet_util", start_s=base)
+    assert q1["series"] == q2["series"]
+
+
+def test_follower_replicates_rules_and_sketches(tmp_path):
+    from tpudash.tsdb.follower import FollowerTSDB
+
+    d = str(tmp_path / "leader")
+    eng = RuleEngine(parse_rules("fleet_util=mean(util)"))
+    leader = TSDB(path=d, chunk_points=60)
+    leader.rule_engine = eng
+    _keys, _cols, base = _fill_store(leader, n_frames=120)
+    leader.flush(seal_partial=True)
+    follower = FollowerTSDB(d, poll_interval_s=0.05)
+    follower.poll()
+    assert RULE_PREFIX + "fleet_util" in follower.series_keys()
+    assert sum(follower.stats()["sketch_blocks"].values()) > 0
+    lead_q = range_query(leader, RULE_PREFIX + "fleet_util", start_s=base)
+    foll_q = range_query(follower, RULE_PREFIX + "fleet_util", start_s=base)
+    assert lead_q["series"] == foll_q["series"]
+    lead_p = range_query(leader, FLEET_SERIES, cols=["util"],
+                         start_s=base, step_s=600, agg="p99")
+    foll_p = range_query(follower, FLEET_SERIES, cols=["util"],
+                         start_s=base, step_s=600, agg="p99")
+    assert lead_p["series"] == foll_p["series"]
+
+
+# -- executor: state build + merge -------------------------------------------
+def test_range_state_and_merge_round_trip():
+    store = TSDB(chunk_points=120, sketch_series="all")
+    keys, _cols, base = _fill_store(store, n_frames=360, n_chips=16)
+    store.flush(seal_partial=True)
+    doc = parse_state_doc(json.loads(json.dumps(
+        range_state(store, None, ["util"], base, None, 600.0, "p99", 500)
+    )))
+    assert doc["rv"] == 1
+    rows = doc["state"]["util"]
+    assert rows and all(len(r) == 6 for r in rows)
+    assert all(r[5] for r in rows), "fleet quantile state must carry digests"
+    # merging one state == finalizing it; merging it twice doubles
+    # weight but not the quantile (idempotent value-wise)
+    one = merge_states([doc], "p99")
+    two = merge_states([doc, json.loads(json.dumps(doc))], "p99")
+    assert [ts for ts, _ in one["series"]["util"]] == [
+        ts for ts, _ in two["series"]["util"]
+    ]
+    for (_, v1), (_, v2) in zip(one["series"]["util"], two["series"]["util"]):
+        assert v1 == pytest.approx(v2, abs=1.0)
+    # exact aggregates re-aggregate exactly
+    mdoc = parse_state_doc(json.loads(json.dumps(
+        range_state(store, None, ["util"], base, None, 600.0, "mean", 500)
+    )))
+    m_one = merge_states([mdoc], "mean")
+    m_two = merge_states([mdoc, mdoc], "mean")
+    for (_, v1), (_, v2) in zip(
+        m_one["series"]["util"], m_two["series"]["util"]
+    ):
+        assert v1 == pytest.approx(v2)
+
+
+def test_parse_state_doc_refuses_malformed():
+    for bad in (
+        "x",
+        {},
+        {"rv": 99, "state": {}},
+        {"rv": 1, "state": "nope"},
+        {"rv": 1, "state": {"c": [[1, 2]]}},
+    ):
+        with pytest.raises(ValueError):
+            parse_state_doc(bad)
+
+
+def test_range_to_csv_shape():
+    doc = {"series": {"a": [(1.0, 2.0), (2.0, 3.0)], "b": [(1.0, 9.0)]}}
+    text = range_to_csv(doc)
+    lines = text.strip().splitlines()
+    assert lines[0] == "ts,a,b"
+    assert lines[1] == "1.000,2.0,9.0"
+    assert lines[2] == "2.000,3.0,"
+
+
+# -- federated scatter --------------------------------------------------------
+def _scatter_source(clients: dict, **cfg_kw):
+    from tpudash.federation.source import ChildSpec, FederatedSource
+
+    cfg = dataclasses.replace(
+        load_config({}),
+        federate="unused",
+        federate_deadline=0.5,
+        federate_hedge=0.0,
+        breaker_failures=2,
+        breaker_cooldown=30.0,
+        **cfg_kw,
+    )
+    specs = [
+        (ChildSpec(n, f"http://{n}:1"), object()) for n in clients
+    ]
+    src = FederatedSource(cfg, children=specs)
+    for name, client in clients.items():
+        src._range_clients[name] = client
+    return src
+
+
+class _GoodRange:
+    def __init__(self, store, base):
+        self.store, self.base = store, base
+        self.calls = 0
+
+    def fetch(self, params, timeout):
+        self.calls += 1
+        return parse_state_doc(json.loads(json.dumps(range_state(
+            self.store, None, ["util"], self.base, None, 600.0,
+            params.get("agg", "mean"), 500,
+        ))))
+
+
+class _DarkRange:
+    def fetch(self, params, timeout):
+        from tpudash.sources.base import SourceError
+
+        raise SourceError("connection refused")
+
+
+def test_scatter_one_dark_one_stale_child_degrades_partial():
+    """The acceptance shape: a 3-child fleet with one dark child (range
+    fetch fails) and one STALE child (summary plane long out of
+    contact) still answers — partial, exact accounting, merged series
+    from the survivors + the stale child's state."""
+    store = TSDB(chunk_points=120, sketch_series="all")
+    _keys, _cols, base = _fill_store(store, n_frames=360, n_chips=16)
+    store.flush(seal_partial=True)
+    clock = [1000.0]
+    clients = {
+        "a": _GoodRange(store, base),
+        "b": _GoodRange(store, base),  # will be summary-stale
+        "c": _DarkRange(),
+    }
+    src = _scatter_source(clients)
+    src._clock = lambda: clock[0]
+    for st in src._children:
+        st.last_contact_m = 990.0
+        st.last_table_m = 990.0
+        st.last_ok = True
+        st.has_table = True
+    # child b: its last summary poll FAILED 20s ago (status derives
+    # from poll outcomes) → stale on the summary plane, inside the
+    # 30s stale budget
+    src._children[1].last_ok = False
+    src._children[1].last_contact_m = 980.0
+    src._children[1].last_table_m = 980.0
+    clock[0] = 1000.0
+    gathered = src.scatter_range({"agg": "p99"})
+    assert len(gathered["states"]) == 2
+    assert gathered["partial"] is True
+    acc = gathered["children"]
+    assert acc["a"]["status"] == "ok"
+    assert acc["b"]["status"] == "ok"
+    assert acc["b"]["summary_status"] == "stale"
+    assert acc["b"]["staleness_s"] == pytest.approx(20.0)
+    assert acc["c"]["status"] == "dark"
+    assert "refused" in acc["c"]["error"]
+    merged = merge_states(gathered["states"], "p99")
+    assert merged["series"]["util"]
+
+
+def test_scatter_replica_serves_failed_child():
+    store = TSDB(chunk_points=120, sketch_series="all")
+    _keys, _cols, base = _fill_store(store, n_frames=240, n_chips=8)
+    store.flush(seal_partial=True)
+    clients = {"a": _DarkRange()}
+    src = _scatter_source(clients)
+    src._replica_clients["a"] = _GoodRange(store, base)
+    gathered = src.scatter_range({"agg": "p95"})
+    assert len(gathered["states"]) == 1
+    assert gathered["children"]["a"]["status"] == "replica"
+    assert gathered["partial"] is True  # replica-served ≠ fresh primary
+    assert src.range_counters["replica_serves"] == 1
+
+
+def test_scatter_range_breaker_quarantines_without_touching_summary():
+    clients = {"a": _DarkRange()}
+    src = _scatter_source(clients)
+    for _ in range(3):
+        src.scatter_range({"agg": "mean"})
+    assert not src.range_breakers["a"].allow()
+    # the SUMMARY breaker is untouched: range failures must not darken
+    # the fleet frame
+    assert src.breakers["a"].allow()
+
+
+# -- server routes ------------------------------------------------------------
+def _service(tmp_path=None):
+    from tpudash.app.service import DashboardService
+    from tpudash.sources.fixture import SyntheticSource
+
+    cfg = load_config({})
+    if tmp_path is not None:
+        cfg = dataclasses.replace(cfg, tsdb_path=str(tmp_path / "tsdb"))
+    cfg = dataclasses.replace(cfg, synthetic_chips=8)
+    svc = DashboardService(
+        cfg, SyntheticSource(num_chips=8, generation="v5e")
+    )
+    for _ in range(20):
+        svc.render_frame()
+    svc.tsdb.flush(seal_partial=True)
+    return svc
+
+
+async def _with_client(app, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_api_range_quantiles_etag_csv_and_shed():
+    svc = _service()
+    from tpudash.app.server import DashboardServer
+
+    srv = DashboardServer(svc)
+
+    async def go(client):
+        # quantile aggregate over the live store
+        resp = await client.get(
+            "/api/range",
+            params={"agg": "p99", "cols": "tpu_tensorcore_utilization"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["agg"] == "p99"
+        assert body["series"]["tpu_tensorcore_utilization"]
+        etag = resp.headers.get("ETag")
+        assert etag and etag.startswith('"rq-')
+        # revalidation: same params, same store version → 304, no body
+        resp = await client.get(
+            "/api/range",
+            params={"agg": "p99", "cols": "tpu_tensorcore_utilization"},
+            headers={"If-None-Match": etag},
+        )
+        assert resp.status == 304
+        # a store mutation invalidates the validator
+        svc.tsdb.version += 1
+        resp = await client.get(
+            "/api/range",
+            params={"agg": "p99", "cols": "tpu_tensorcore_utilization"},
+            headers={"If-None-Match": etag},
+        )
+        assert resp.status == 200
+        # merge=state answers the wire protocol
+        resp = await client.get(
+            "/api/range", params={"merge": "state", "agg": "p95"}
+        )
+        assert resp.status == 200
+        doc = await resp.json()
+        parse_state_doc(doc)
+        # csv export
+        resp = await client.get(
+            "/api/range.csv",
+            params={"agg": "p95", "cols": "tpu_tensorcore_utilization"},
+        )
+        assert resp.status == 200
+        text = await resp.text()
+        assert text.splitlines()[0] == "ts,tpu_tensorcore_utilization"
+        assert len(text.splitlines()) > 1
+        resp = await client.get(
+            "/api/range.csv", params={"merge": "state"}
+        )
+        assert resp.status == 400
+        # recording-rule series are queryable over HTTP
+        resp = await client.get(
+            "/api/range", params={"chip": "__rule__/fleet_mfu"}
+        )
+        assert resp.status in (200, 404)  # present once a chunk sealed
+        # unknown series stays 404
+        resp = await client.get(
+            "/api/range", params={"chip": "slice-9/99"}
+        )
+        assert resp.status == 404
+
+        # shed path: the cached body serves with the stale marker
+        from aiohttp.test_utils import make_mocked_request
+
+        req = make_mocked_request(
+            "GET",
+            "/api/range?agg=p99&cols=tpu_tensorcore_utilization",
+        )
+        shed = await srv._shed_response(req, "rate")
+        assert shed.status == 200
+        assert shed.headers.get("X-Tpudash-Stale") == "1"
+        assert shed.headers["ETag"].endswith('-stale"')
+        # merge=state and the finalized body must NOT share a cache
+        # entry: the shed body for the plain query is the finalized
+        # series even after a state-mode query with identical params
+        resp = await client.get(
+            "/api/range",
+            params={
+                "merge": "state",
+                "agg": "p99",
+                "cols": "tpu_tensorcore_utilization",
+            },
+        )
+        assert resp.status == 200
+        req = make_mocked_request(
+            "GET",
+            "/api/range?agg=p99&cols=tpu_tensorcore_utilization",
+        )
+        shed = await srv._shed_response(req, "rate")
+        assert shed.status == 200
+        doc = json.loads(shed.body)
+        assert "series" in doc and "rv" not in doc
+        # a param set never cached sheds hard (503 + Retry-After)
+        req = make_mocked_request("GET", "/api/range?agg=min&step=7")
+        shed = await srv._shed_response(req, "rate")
+        assert shed.status == 503
+        assert "Retry-After" in shed.headers
+
+    asyncio.run(_with_client(srv.build_app(), go))
+
+
+def test_recording_rules_flow_through_service(tmp_path):
+    """The service wires the default rule set into the store; sealed
+    chunks produce queryable __rule__/ series, and the anomaly scorer
+    is bound when the engine is on."""
+    svc = _service(tmp_path)
+    assert svc.rule_engine is not None
+    assert svc.rule_engine.scorer is not None  # anomaly() bound
+    # seal enough frames for one chunk: chunk_points default 120 is
+    # bigger than our 20 frames — flush(seal_partial) sealed them
+    keyset = svc.tsdb.series_keys()
+    rule_keys = {k for k in keyset if k.startswith(RULE_PREFIX)}
+    slice_key = next(k for k in sorted(rule_keys) if "slice_util" in k)
+    res = range_query(svc.tsdb, slice_key)
+    assert any(res["series"].values())
+    # a persisted store with rule blocks (no 1m sketches by design at
+    # the default sketch_series="10m") must NOT re-trigger the
+    # "one-shot" pre-13 backfill on every restart
+    svc.close_tsdb()
+    re = TSDB(path=str(tmp_path / "tsdb"))
+    assert not re._sketch_backfill
+
+
+def test_fleet_distribution_vs_series_quantile_semantics():
+    """No chip → the fleet DISTRIBUTION (cross-chip); the distribution
+    p99 must sit at/above every per-chip p50."""
+    store = TSDB(chunk_points=120, sketch_series="all")
+    rng = np.random.default_rng(5)
+    keys = [f"s0/{i}" for i in range(8)]
+    base = float(int(time.time() - 3600) // 600 * 600)
+    # chip i centered at 10·i: the fleet p99 must land near the top
+    # chip's range, far above the low chips
+    for f in range(240):
+        mat = (
+            np.arange(8, dtype=np.float32)[:, None] * 10.0
+            + rng.normal(0, 0.5, size=(8, 1)).astype(np.float32)
+        )
+        store.append_frame(base + 5.0 * f, keys, ["m"], mat)
+    store.flush(seal_partial=True)
+    fleet = range_query(store, FLEET_SERIES, cols=["m"], start_s=base,
+                        step_s=1200, agg="p99")
+    low_chip = range_query(store, keys[0], cols=["m"], start_s=base,
+                           step_s=1200, agg="p99")
+    assert fleet["series"]["m"][0][1] > 60.0  # near the top chip
+    assert low_chip["series"]["m"][0][1] < 5.0  # the chip's own values
+
+
+def test_federated_range_over_real_http_children():
+    """The acceptance path end to end over real sockets: a parent
+    scatters ``/api/range?agg=p99`` to two live child dashboards
+    (blocking HttpRangeClient → aiohttp TestServer ports), merges their
+    sketch states, and degrades to partial when one closes."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudash.app.server import DashboardServer
+    from tpudash.sources import make_source
+
+    async def go():
+        from tpudash.app.service import DashboardService
+        from tpudash.sources.fixture import SyntheticSource
+
+        loop = asyncio.get_running_loop()
+        cfg = dataclasses.replace(load_config({}), synthetic_chips=8)
+
+        def build_child():
+            svc = DashboardService(
+                cfg, SyntheticSource(num_chips=8, generation="v5e")
+            )
+            for _ in range(15):
+                svc.render_frame()
+            svc.tsdb.flush(seal_partial=True)
+            return DashboardServer(svc)
+
+        clients = []
+        urls = []
+        for _ in range(2):
+            srv = await loop.run_in_executor(None, build_child)
+            c = TestClient(TestServer(srv.build_app()))
+            await c.start_server()
+            clients.append(c)
+            urls.append(
+                f"http://127.0.0.1:{c.server.port}"
+            )
+        pcfg = dataclasses.replace(
+            cfg,
+            federate=",".join(
+                f"c{i}={u}" for i, u in enumerate(urls)
+            ),
+            federate_deadline=3.0,
+        )
+        psvc = await loop.run_in_executor(
+            None, lambda: DashboardService(pcfg, make_source(pcfg))
+        )
+        pc = TestClient(TestServer(DashboardServer(psvc).build_app()))
+        await pc.start_server()
+        try:
+            resp = await pc.get(
+                "/api/range",
+                params={
+                    "agg": "p99",
+                    "cols": "tpu_tensorcore_utilization",
+                },
+            )
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["partial"] is False
+            fed = doc["federation"]["children"]
+            assert {n: c["status"] for n, c in fed.items()} == {
+                "c0": "ok", "c1": "ok",
+            }
+            assert doc["series"]["tpu_tensorcore_utilization"]
+            # no ETag on federated answers (children advance freely)
+            assert not resp.headers.get("ETag", "").startswith('"rq-')
+
+            # chip-scoped: routed to the owning child only
+            resp = await pc.get(
+                "/api/range",
+                params={"chip": "c1/slice-0/3", "agg": "mean"},
+            )
+            assert resp.status == 200
+            doc = await resp.json()
+            assert list(doc["federation"]["children"]) == ["c1"]
+
+            # one child darkens: partial, never 5xx
+            await clients[1].close()
+            resp = await pc.get(
+                "/api/range",
+                params={
+                    "agg": "p99",
+                    "cols": "tpu_tensorcore_utilization",
+                },
+            )
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["partial"] is True
+            assert doc["federation"]["children"]["c1"]["status"] == "dark"
+            assert doc["federation"]["children"]["c1"]["error"]
+            assert doc["series"]["tpu_tensorcore_utilization"]
+        finally:
+            await pc.close()
+            await clients[0].close()
+
+    asyncio.run(go())
+
+
+def test_all_key_excludes_pseudo_and_rule_series():
+    """The fleet-distribution digest must not fold the __fleet__ row or
+    rule outputs back in (double counting)."""
+    from tpudash.tsdb.rollup import sketch_points
+
+    ts = [1000 * 60 * i for i in range(3)]
+    keys = ["s/0", "__fleet__", "__rule__/x"]
+    stacked = np.array([
+        [[1.0], [100.0], [100.0]],
+        [[2.0], [100.0], [100.0]],
+        [[3.0], [100.0], [100.0]],
+    ])
+    blk = sketch_points(TIER_1M_MS, ts, keys, ["m"], stacked, 64, False)
+    assert blk.keys == [ALL_KEY]
+    for _b, raw in blk.series(ALL_KEY, "m"):
+        sk = QuantileSketch.from_bytes(raw)
+        assert sk.mx <= 3.0  # the pseudo rows' 100s never entered

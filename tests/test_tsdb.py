@@ -445,7 +445,9 @@ def test_range_query_point_budget_is_a_ceiling():
 
 def test_range_query_aggregates_are_exact():
     store = TSDB(chunk_points=10)
-    base = time.time() - 3000.0
+    # rollup-tier step grids are epoch-anchored (PR 13): align the base
+    # so the whole sample set lands in ONE wide step bucket
+    base = (time.time() - 3000.0) // 120.0 * 120.0
     vals = [5.0, 1.0, 9.0, 3.0, 7.0, 2.0]
     for i, v in enumerate(vals):
         store.append_frame(
@@ -480,8 +482,9 @@ def test_range_query_wide_step_prefers_rollup_tier():
 
 def test_range_query_error_mapping():
     store = TSDB()
+    # p95/p99 became real aggregates in PR 13 — "stdev" stays unknown
     with pytest.raises(ValueError):
-        range_query(store, "k", agg="p99")
+        range_query(store, "k", agg="stdev")
     _fill(store, 3)
     with pytest.raises(ValueError):
         range_query(store, "k", start_s=2000.0, end_s=1000.0)
@@ -730,7 +733,7 @@ def test_api_range_endpoint_shapes_and_errors():
         # 400s: malformed number, bad agg, inverted window
         for params in (
             {"start": "abc"},
-            {"agg": "p99"},
+            {"agg": "stdev"},  # p95/p99 are real aggregates since PR 13
             {"start": "2000", "end": "1000"},
         ):
             resp = await client.get("/api/range", params=params)

@@ -1,0 +1,35 @@
+"""tpudash.analytics — the read-side query plane over the tsdb.
+
+Three pieces, built in PR 13 (ROADMAP #4):
+
+- :mod:`tpudash.analytics.sketch` — dependency-free mergeable quantile
+  sketch (t-digest-style, fixed centroid budget, deterministic merge):
+  the state that makes p95/p99 range queries a rollup read instead of a
+  raw decode, and fleet-wide percentiles a per-child fold instead of a
+  sample shuffle.
+- :mod:`tpudash.analytics.rules` — declarative recording rules
+  evaluated once per sealed chunk on the tsdb seal thread; outputs are
+  first-class ``__rule__/<name>`` series (persisted, retained,
+  replicated, snapshot-ed, queryable via ``/api/range``).
+- :mod:`tpudash.analytics.executor` — the mergeable range-state
+  documents the federated scatter-gather ``/api/range`` exchanges:
+  children answer per-bucket ``(count, sum, min, max, digest)`` state,
+  the parent folds them exactly and serves the fleet answer with
+  per-child partial/staleness accounting.
+
+Not to be confused with :mod:`tpudash.analysis` (the static-analysis /
+sanitizer toolkit) — this package is about the DATA.
+"""
+
+from tpudash.analytics.sketch import (  # noqa: F401 — the package surface
+    DEFAULT_BUDGET,
+    RANK_ERROR_BOUND,
+    QuantileSketch,
+    SketchError,
+)
+from tpudash.analytics.rules import (  # noqa: F401
+    DEFAULT_RULES,
+    RULE_PREFIX,
+    RuleEngine,
+    parse_rules,
+)

@@ -1,0 +1,349 @@
+"""Range-query execution state: the mergeable half of ``/api/range``.
+
+The scatter-gather read path needs something no finalized series can
+give: per-bucket aggregation STATE that merges exactly across
+processes.  A finalized mean can't merge (no weights); a finalized p99
+can't merge at all.  So a child asked with ``merge=state`` answers with
+per-step-bucket ``(count, sum, min, max[, digest])`` tuples — count/
+sum/min/max re-aggregate exactly, digests merge within the sketch's
+documented bound — and the parent folds any number of such documents
+into one fleet answer (:func:`merge_states`).
+
+Scope semantics: ``chip=None`` is the FLEET DISTRIBUTION — every real
+chip's samples in the bucket (pseudo/rule series excluded), which is
+what "fleet p99 duty cycle" means; a specific ``chip`` is that one
+series over time.  (The local JSON view keeps serving the ``__fleet__``
+zero-exclusion average row for no-chip mean queries — that's a
+per-tick average of reporting chips; the scatter plane re-aggregates
+every sample instead, see docs/API.md.)
+
+Bucket grids here are EPOCH-anchored (``ts // step * step``): two
+children bucketing independently land on the same grid, so the
+parent's re-bucketing fold is exact, and the emitted first bucket is
+clamped to the request window (the PR-13 alignment contract
+query.py also follows).
+
+The document is versioned (``rv``) and the parent refuses shapes it
+does not understand per child — same posture as the summary codec.
+"""
+
+from __future__ import annotations
+
+import base64
+import math
+
+from tpudash.analytics.sketch import DEFAULT_BUDGET, QuantileSketch, SketchError
+from tpudash.tsdb.query import QUANTILE_AGGS
+
+#: wire version of the mergeable range-state document
+RANGE_STATE_V = 1
+
+
+def quantile_of(agg: str) -> "float | None":
+    return QUANTILE_AGGS.get(agg)
+
+
+# -- child side: build one state document ------------------------------------
+def range_state(
+    store,
+    chip: "str | None",
+    cols: "list[str] | None",
+    start_s: "float | None",
+    end_s: "float | None",
+    step_s: "float | None",
+    agg: str,
+    max_points: int,
+) -> dict:
+    """One store's mergeable answer.  Raises ValueError on bad params
+    (the HTTP layer maps to 400); an empty store yields a well-formed
+    empty document."""
+    from tpudash.tsdb.query import MAX_POINTS, resolve_window
+
+    q = quantile_of(agg)
+    if q is None and agg not in ("mean", "min", "max"):
+        raise ValueError(f"unknown aggregate {agg!r}")
+    max_points = max(1, min(int(max_points), MAX_POINTS))
+    win = resolve_window(store, start_s, end_s, step_s, max_points, agg)
+    doc: dict = {
+        "rv": RANGE_STATE_V,
+        "agg": agg,
+        "chip": chip,
+        "resolution": win["resolution"],
+        "start_s": win["start_ms"] / 1000.0,
+        "end_s": win["end_ms"] / 1000.0,
+        "step_s": win["step_ms"] / 1000.0,
+        "state": {},
+    }
+    if win["empty"]:
+        doc["cols"] = list(cols or [])
+        doc["state"] = {c: [] for c in (cols or [])}
+        return doc
+    start_ms, end_ms = win["start_ms"], win["end_ms"]
+    step_ms = max(win["step_ms"], 1)
+    if cols is None:
+        if chip is not None:
+            cols = store.series_cols(chip)
+        else:
+            cols = _fleet_cols(store)
+    doc["cols"] = list(cols)
+    for col in cols:
+        doc["state"][col] = _col_state(
+            store, chip, col, start_ms, end_ms, step_ms,
+            win["tier"], q is not None,
+        )
+    return doc
+
+
+def _fleet_cols(store) -> "list[str]":
+    """Union of real-chip columns (the fleet distribution's columns)."""
+    cols: dict = {}
+    for key in sorted(store.series_keys()):
+        if key.startswith("__"):
+            continue
+        for c in store.series_cols(key):
+            cols.setdefault(c, None)
+    return list(cols)
+
+
+def _col_state(
+    store, chip, col, start_ms, end_ms, step_ms, tier, want_sketch
+) -> list:
+    """Per-step-bucket [ts_ms, cnt, sum, mn, mx, digest_b64|None] for
+    one column, epoch-anchored grid, first bucket clamped into the
+    window."""
+    from tpudash.tsdb.rollup import ALL_KEY
+
+    quad_tier = tier if tier else 0
+    buckets: dict = {}
+
+    def fold_quads(quads):
+        for bt, mn, mx, sm, cnt in quads:
+            if cnt <= 0:
+                continue
+            b = bt // step_ms * step_ms
+            cur = buckets.get(b)
+            if cur is None:
+                buckets[b] = [mn, mx, sm, float(cnt), None]
+            else:
+                cur[0] = min(cur[0], mn)
+                cur[1] = max(cur[1], mx)
+                cur[2] += sm
+                cur[3] += float(cnt)
+
+    if chip is not None:
+        keys = [chip]
+    else:
+        keys = [
+            k for k in sorted(store.series_keys()) if not k.startswith("__")
+        ]
+    quads_by_key: "dict | None" = {} if quad_tier else None
+    raw_vals: "dict[int, list] | None" = (
+        {} if (quad_tier == 0 and want_sketch) else None
+    )
+    for key in keys:
+        if quad_tier == 0:
+            # inline accumulator — this is the hot inner loop of a
+            # raw-tier scatter leaf (chips × points), no per-sample
+            # list/tuple/call; when a quantile needs digests they fold
+            # from THESE points too, not a second (or third) decode
+            for t, v in store.raw_window(key, col, start_ms, end_ms):
+                if v != v:
+                    continue
+                b = t // step_ms * step_ms
+                cur = buckets.get(b)
+                if cur is None:
+                    buckets[b] = [v, v, v, 1.0, None]
+                else:
+                    if v < cur[0]:
+                        cur[0] = v
+                    if v > cur[1]:
+                        cur[1] = v
+                    cur[2] += v
+                    cur[3] += 1.0
+                if raw_vals is not None:
+                    raw_vals.setdefault(b, []).append(v)
+        else:
+            quads = store.rollup_window(
+                quad_tier, key, col, start_ms, end_ms
+            )
+            quads_by_key[key] = quads
+            fold_quads(quads)
+    if raw_vals is not None:
+        budget = getattr(store, "sketch_budget", 0) or DEFAULT_BUDGET
+        for b, vals in raw_vals.items():
+            buckets[b][4] = QuantileSketch.from_values(vals, budget)
+    elif want_sketch:
+        sk_key = chip if chip is not None else ALL_KEY
+        for bt, sk in store.sketch_series_window(
+            # one rollup pass per key: the fold above doubles as the
+            # sketch layer's bucket oracle
+            tier or 0, sk_key, col, start_ms, end_ms,
+            quads_by_key=quads_by_key,
+        ):
+            b = bt // step_ms * step_ms
+            cur = buckets.get(b)
+            merged = sk
+            if cur is None:
+                buckets[b] = [sk.mn, sk.mx, math.nan, sk.count, merged]
+            else:
+                prev = cur[4]
+                cur[4] = (
+                    QuantileSketch.merged([prev, sk])
+                    if prev is not None
+                    else sk
+                )
+    out = []
+    for b in sorted(buckets):
+        mn, mx, sm, cnt, sk = buckets[b]
+        ts = max(b, start_ms)  # clamp the first bucket into the window
+        out.append([
+            int(ts),
+            cnt,
+            # strict-JSON hygiene like every other wire surface: a
+            # stored ±inf (or NaN) must not emit a bare Infinity token
+            # — a strict parser on the gather side would refuse the
+            # whole child over one blown-up sample
+            sm if math.isfinite(sm) else None,
+            mn if math.isfinite(mn) else None,
+            mx if math.isfinite(mx) else None,
+            base64.b64encode(sk.to_bytes()).decode() if sk is not None else None,
+        ])
+    return out
+
+
+# -- parent side: merge N state documents ------------------------------------
+def parse_state_doc(doc) -> dict:
+    """Validate one child's state document (untrusted wire input).
+    Raises ValueError on anything malformed or version-skewed — the
+    caller refuses that child, never the fleet answer."""
+    if not isinstance(doc, dict):
+        raise ValueError("range state is not a JSON object")
+    if doc.get("rv") != RANGE_STATE_V:
+        raise ValueError(
+            f"range state version {doc.get('rv')!r} != {RANGE_STATE_V}"
+        )
+    state = doc.get("state")
+    if not isinstance(state, dict):
+        raise ValueError("range state missing 'state'")
+    for col, rows in state.items():
+        if not isinstance(rows, list):
+            raise ValueError(f"range state column {col!r} is not a list")
+        for row in rows:
+            if not isinstance(row, list) or len(row) < 5:
+                raise ValueError(f"range state row malformed in {col!r}")
+    return doc
+
+
+def merge_states(
+    states: "list[dict]",
+    agg: str,
+    max_points: int = 5000,
+    budget: int = DEFAULT_BUDGET,
+) -> dict:
+    """Fold validated state documents into one finalized series doc:
+    ``{"series": {col: [(ts_s, value), ...]}, "resolution", "start_s",
+    "end_s", "step_s", "agg"}``.  Count/sum/min/max re-aggregate
+    exactly; quantiles merge digests (a row whose digest is missing —
+    a version-skewed or sketchless child — degrades to its quad's
+    3-centroid pseudo-digest rather than dropping the child's weight).
+    Raises ValueError when ``states`` is empty."""
+    if not states:
+        raise ValueError("no range states to merge")
+    q = quantile_of(agg)
+    step_ms = max(int(round(max(d.get("step_s") or 0.0 for d in states) * 1000)), 1)
+    start_ms = min(int(round((d.get("start_s") or 0.0) * 1000)) for d in states)
+    end_ms = max(int(round((d.get("end_s") or 0.0) * 1000)) for d in states)
+    # the merged grid honours the budget: coarsest child step, widened
+    # if N children's unioned window would overflow it
+    window = max(1, end_ms - start_ms)
+    min_step = -(-window // max(1, int(max_points)))
+    if step_ms < min_step:
+        step_ms = min_step
+    merged: "dict[str, dict[int, list]]" = {}
+    for doc in states:
+        for col, rows in doc["state"].items():
+            buckets = merged.setdefault(col, {})
+            for row in rows:
+                ts, cnt, sm, mn, mx = row[0], row[1], row[2], row[3], row[4]
+                enc = row[5] if len(row) > 5 else None
+                b = int(ts) // step_ms * step_ms
+                cur = buckets.get(b)
+                if cur is None:
+                    cur = buckets[b] = [math.inf, -math.inf, 0.0, 0.0, []]
+                if mn is not None:
+                    cur[0] = min(cur[0], float(mn))
+                if mx is not None:
+                    cur[1] = max(cur[1], float(mx))
+                if sm is not None:
+                    cur[2] += float(sm)
+                cur[3] += float(cnt or 0)
+                if q is not None:
+                    sk = None
+                    if enc:
+                        try:
+                            sk = QuantileSketch.from_bytes(
+                                base64.b64decode(enc), budget
+                            )
+                        except (SketchError, ValueError):
+                            sk = None
+                    if sk is None and cnt and mn is not None and mx is not None:
+                        sm_q = sm if sm is not None else (
+                            (float(mn) + float(mx)) / 2.0 * float(cnt)
+                        )
+                        sk = QuantileSketch.from_quad(
+                            float(mn), float(mx), float(sm_q), int(cnt), budget
+                        )
+                    if sk is not None:
+                        cur[4].append(sk)
+    series: dict = {}
+    resolutions = {d.get("resolution") for d in states}
+    for col, buckets in merged.items():
+        pts = []
+        for b in sorted(buckets):
+            mn, mx, sm, cnt, sks = buckets[b]
+            if cnt <= 0:
+                continue
+            if q is not None:
+                v = QuantileSketch.merged(sks, budget).quantile(q)
+                if v != v:
+                    continue
+            elif agg == "min":
+                v = mn
+            elif agg == "max":
+                v = mx
+            else:
+                v = sm / cnt
+            ts = max(b, start_ms)
+            pts.append((ts / 1000.0, v))
+        series[col] = pts
+    return {
+        "series": series,
+        "resolution": "/".join(sorted(r for r in resolutions if r)) or "raw",
+        "start_s": start_ms / 1000.0,
+        "end_s": end_ms / 1000.0,
+        "step_s": step_ms / 1000.0,
+        "agg": agg,
+    }
+
+
+# -- csv export ---------------------------------------------------------------
+def range_to_csv(doc: dict) -> str:
+    """A finalized range document as CSV — one row per timestamp, one
+    column per metric (the ``/api/history.csv`` shape, so incident
+    evidence drops straight into a spreadsheet)."""
+    cols = list(doc.get("series", {}))
+    by_ts: "dict[float, dict]" = {}
+    for col, pts in doc["series"].items():
+        for ts, v in pts:
+            by_ts.setdefault(ts, {})[col] = v
+    lines = ["ts," + ",".join(cols)]
+    for ts in sorted(by_ts):
+        vals = by_ts[ts]
+        cells = [f"{ts:.3f}"]
+        for c in cols:
+            v = vals.get(c)
+            cells.append(
+                "" if v is None or v != v or not math.isfinite(v) else f"{v}"
+            )
+        lines.append(",".join(cells))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,288 @@
+"""Recording rules: declarative derived series, evaluated at seal time.
+
+Every derived series the dashboard used to recompute per viewer per
+tick (fleet MFU, per-slice means, a fleet anomaly score) becomes a
+first-class tsdb series instead: the rule engine runs ONCE per sealed
+chunk on the store's seal thread (tpudash/tsdb/store.py calls
+:meth:`RuleEngine.evaluate` right after a data chunk seals), and the
+outputs are appended, rolled up, sketched, and persisted exactly like
+scraped data — queryable via ``GET /api/range?chip=__rule__/<name>``,
+chartable, retained per tier, replicated to followers and snapshots
+byte-identically (they are ordinary segment records).
+
+Grammar (``TPUDASH_RULES``; "" = built-in defaults, "off" disables)::
+
+    name = fn(column) [by slice|host] [; more rules...]
+
+``fn``: mean | min | max | sum | count | p50 | p95 | p99, computed per
+frame ACROSS the population (the distribution over chips, not over
+time — time aggregation is the query layer's job), NaN cells excluded.
+``by slice`` / ``by host`` evaluates one series per group; ungrouped
+rules yield one fleet-wide series.  One extra spelling, ``anomaly()``,
+binds the rule to the anomaly engine's batch scorer when one is wired
+(tpudash/app/service.py) — the fleet's max baseline-deviation score per
+frame, persisted so incident forensics can chart "how anomalous was the
+fleet" without replaying raw history.
+
+Output keys are namespaced ``__rule__/<name>`` (grouped:
+``__rule__/<name>/<group>``); the ``__``-prefix keeps them out of the
+fleet cross-section sketches and the chip-facing surfaces, and real
+chip keys can never collide with them (slice names never start with
+``__``).  Determinism: evaluation is pure numpy over the chunk with a
+total output order (declaration order, groups sorted), so re-running a
+rule over the same chunk produces byte-identical blocks — the property
+the restart test pins.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: key prefix for every rule output series
+RULE_PREFIX = "__rule__/"
+
+#: built-in rule set ("" env): the derived series the panels and the
+#: anomaly layer actually read.  Columns missing from a deployment's
+#: scrape simply produce nothing — a probe-source dashboard with no MXU
+#: counter runs the same default set.
+DEFAULT_RULES = (
+    "fleet_mfu=mean(tpu_mxu_utilization);"
+    "fleet_util_p99=p99(tpu_tensorcore_utilization);"
+    "slice_util=mean(tpu_tensorcore_utilization) by slice;"
+    "host_power=sum(tpu_power_watts) by host;"
+    "anomaly_score=anomaly()"
+)
+
+_FNS = ("mean", "min", "max", "sum", "count", "p50", "p95", "p99")
+_RULE_RE = re.compile(
+    r"^(?P<name>[A-Za-z0-9_.-]+)\s*=\s*(?P<fn>[a-z0-9]+)\s*\(\s*"
+    r"(?P<col>[A-Za-z0-9_.:-]*)\s*\)\s*(?:by\s+(?P<by>slice|host))?$"
+)
+
+
+class RuleSpec:
+    """One parsed rule."""
+
+    __slots__ = ("name", "fn", "col", "by")
+
+    def __init__(self, name: str, fn: str, col: str, by: "str | None"):
+        self.name = name
+        self.fn = fn
+        self.col = col
+        self.by = by
+
+    @classmethod
+    def parse(cls, text: str) -> "RuleSpec":
+        m = _RULE_RE.match(text.strip())
+        if not m:
+            raise ValueError(
+                f"bad recording rule {text!r} (grammar: "
+                "name=fn(column) [by slice|host])"
+            )
+        name, fn, col, by = (
+            m.group("name"), m.group("fn"), m.group("col"), m.group("by")
+        )
+        if fn == "anomaly":
+            if col:
+                raise ValueError(
+                    f"rule {name!r}: anomaly() takes no column (it binds "
+                    "to the engine's watched set)"
+                )
+            if by:
+                raise ValueError(
+                    f"rule {name!r}: anomaly() is fleet-scoped, no 'by'"
+                )
+        elif fn not in _FNS:
+            raise ValueError(
+                f"rule {name!r}: unknown fn {fn!r} (one of "
+                f"{', '.join(_FNS)}, anomaly)"
+            )
+        elif not col:
+            raise ValueError(f"rule {name!r}: missing column")
+        return cls(name, fn, col, by)
+
+
+def parse_rules(spec: str) -> "list[RuleSpec]":
+    """Parse a ``;``-separated rule list; "" yields the defaults.
+    Raises ValueError (config-time loud) on bad grammar or duplicate
+    names."""
+    text = spec.strip() or DEFAULT_RULES
+    out = [RuleSpec.parse(s) for s in text.split(";") if s.strip()]
+    seen: set = set()
+    for r in out:
+        if r.name in seen:
+            raise ValueError(f"duplicate recording rule name {r.name!r}")
+        seen.add(r.name)
+    return out
+
+
+def _slice_of(key: str) -> str:
+    """Group label for ``by slice``: everything before the chip id —
+    ``slice-0/3`` → ``slice-0``, federated ``east/slice-0/3`` →
+    ``east/slice-0``."""
+    i = key.rfind("/")
+    return key[:i] if i > 0 else key
+
+
+class RuleEngine:
+    """Evaluates the parsed rule set over one sealed chunk.
+
+    Thread contract: ``evaluate`` runs on the tsdb seal thread;
+    ``set_host_map`` runs on the refresh thread.  The host map is
+    swapped atomically (one dict assignment) and read once per
+    evaluation — a torn read can only mean one chunk groups hosts by
+    the neighbouring tick's identity, which is the same data.
+    """
+
+    def __init__(self, rules: "list[RuleSpec]", max_groups: int = 64):
+        self.rules = list(rules)
+        #: per-rule cap on ``by`` group fan-out (groups are sorted, the
+        #: first ``max_groups`` win deterministically); a pathological
+        #: label explosion must not turn the seal thread into a series
+        #: factory.  Truncations are counted, never silent.
+        self.max_groups = max(1, int(max_groups))
+        self.truncated_groups = 0
+        self.evaluations = 0
+        self.last_error: "str | None" = None
+        #: key -> host, refreshed by the service per ingest population
+        self._host_map: "dict[str, str]" = {}
+        #: optional anomaly scorer: callable(ts_list, keys, cols,
+        #: stacked) -> (n,) float array (or None) — wired by the service
+        #: when the anomaly engine is enabled
+        self.scorer = None
+
+    @classmethod
+    def from_config(cls, cfg) -> "RuleEngine | None":
+        spec = getattr(cfg, "rules", "")
+        if spec.strip().lower() == "off":
+            return None
+        return cls(
+            parse_rules(spec),
+            max_groups=getattr(cfg, "rules_max_groups", 64),
+        )
+
+    def set_host_map(self, keys, hosts) -> None:
+        self._host_map = dict(zip(keys, hosts))
+
+    # -- evaluation (seal thread) --------------------------------------------
+    def evaluate(self, ts_list, keys, cols, stacked):
+        """Derived frames for one sealed chunk: returns
+        ``(out_keys, out_cols, out_stack)`` — a (n, K', C') float64
+        stack aligned with ``ts_list`` — or None when no rule produced
+        anything.  Never raises: a broken rule degrades to
+        ``last_error`` (the seal thread must keep sealing data)."""
+        try:
+            return self._evaluate(ts_list, keys, cols, stacked)
+        except Exception as e:  # noqa: BLE001 — rules must not stop seals
+            self.last_error = f"{type(e).__name__}: {e}"
+            log.warning("recording-rule evaluation failed: %s", e)
+            return None
+
+    def _evaluate(self, ts_list, keys, cols, stacked):
+        n = len(ts_list)
+        if n == 0:
+            return None
+        # rules read the SCRAPED population only: derived series must
+        # never feed back into rules (no recursion), and the __fleet__
+        # mean row would double-count every chip
+        rows = [i for i, k in enumerate(keys) if not k.startswith("__")]
+        col_pos = {c: i for i, c in enumerate(cols)}
+        # out[key] = (col, (n,) values)
+        out: "dict[str, tuple[str, np.ndarray]]" = {}
+        for rule in self.rules:
+            if rule.fn == "anomaly":
+                scorer = self.scorer
+                if scorer is None:
+                    continue
+                scores = scorer(ts_list, keys, cols, stacked)
+                if scores is None:
+                    continue
+                out[RULE_PREFIX + rule.name] = (
+                    "anomaly_score",
+                    np.asarray(scores, dtype=np.float64).reshape(n),
+                )
+                continue
+            ci = col_pos.get(rule.col)
+            if ci is None or not rows:
+                continue
+            vals = stacked[:, rows, ci]  # (n, K_real)
+            if rule.by is None:
+                out[RULE_PREFIX + rule.name] = (
+                    rule.col, _fold(rule.fn, vals)
+                )
+                continue
+            groups: "dict[str, list[int]]" = {}
+            for j, i in enumerate(rows):
+                key = keys[i]
+                if rule.by == "slice":
+                    g = _slice_of(key)
+                else:
+                    g = self._host_map.get(key, "")
+                    if not g:
+                        continue  # no identity known for this key yet
+                groups.setdefault(g, []).append(j)
+            names = sorted(groups)
+            if len(names) > self.max_groups:
+                self.truncated_groups += len(names) - self.max_groups
+                log.warning(
+                    "rule %s: %d %s groups exceed the %d cap — keeping "
+                    "the first %d (sorted)",
+                    rule.name, len(names), rule.by, self.max_groups,
+                    self.max_groups,
+                )
+                names = names[: self.max_groups]
+            for g in names:
+                out[f"{RULE_PREFIX}{rule.name}/{g}"] = (
+                    rule.col, _fold(rule.fn, vals[:, groups[g]])
+                )
+        if not out:
+            return None
+        self.evaluations += 1
+        out_keys = list(out)  # insertion order: declaration, groups sorted
+        out_cols: "list[str]" = []
+        for col, _v in out.values():
+            if col not in out_cols:
+                out_cols.append(col)
+        cpos = {c: i for i, c in enumerate(out_cols)}
+        stack = np.full((n, len(out_keys), len(out_cols)), np.nan)
+        for ki, (col, v) in enumerate(out.values()):
+            stack[:, ki, cpos[col]] = v
+        return out_keys, out_cols, stack
+
+    def stats(self) -> dict:
+        return {
+            "rules": len(self.rules),
+            "evaluations": self.evaluations,
+            "truncated_groups": self.truncated_groups,
+            "last_error": self.last_error,
+        }
+
+
+def _fold(fn: str, vals: "np.ndarray") -> "np.ndarray":
+    """One per-frame aggregate across the population axis; all-NaN
+    frames yield NaN (no sample), matching the rollup contract."""
+    with np.errstate(invalid="ignore", divide="ignore"):
+        finite = np.isfinite(vals)
+        any_ok = finite.any(axis=1)
+        if fn == "count":
+            return finite.sum(axis=1).astype(np.float64)
+        if fn == "sum":
+            return np.where(any_ok, np.nansum(vals, axis=1), np.nan)
+        if fn == "mean":
+            return np.where(any_ok, np.nanmean(vals, axis=1), np.nan)
+        if fn == "min":
+            return np.where(any_ok, np.nanmin(vals, axis=1, initial=np.inf,
+                                              where=finite), np.nan)
+        if fn == "max":
+            return np.where(any_ok, np.nanmax(vals, axis=1, initial=-np.inf,
+                                              where=finite), np.nan)
+        q = {"p50": 50.0, "p95": 95.0, "p99": 99.0}[fn]
+        out = np.full(vals.shape[0], np.nan)
+        if any_ok.any():
+            out[any_ok] = np.nanpercentile(vals[any_ok], q, axis=1)
+        return out

@@ -1,0 +1,277 @@
+"""Mergeable quantile sketch — the t-digest the analytics plane rides.
+
+Dependency-free (numpy only, like the rest of the tsdb) and built for
+exactly three call sites:
+
+- **seal**: ``QuantileSketch.from_values`` folds one rollup bucket's raw
+  samples into a fixed-budget digest beside the min/max/sum/count quad
+  (tpudash/tsdb/rollup.py);
+- **query**: ``merged`` + ``quantile`` answer ``agg=p95|p99`` range
+  queries from the 1m/10m tiers without decoding raw points
+  (tpudash/tsdb/query.py);
+- **federation**: the scatter-gather parent merges each child's
+  serialized per-bucket digests (``to_bytes``/``from_bytes``) into one
+  fleet distribution — merging digests loses nothing beyond each
+  digest's own resolution, which is what makes a fleet-wide p99 a
+  per-child fold instead of a raw-sample shuffle.
+
+Design constraints, in contract order:
+
+- **Fixed centroid budget**: compression keeps at most ~``budget``
+  centroids using the classic arcsine scale function, so tail quantiles
+  (the ones operators page on) get the fine centroids and the middle
+  gets the coarse ones.  Size is bounded whatever the input count.
+- **Deterministic**: same inputs (values, or digests in the same
+  order) produce byte-identical output — sorting is total (mean, then
+  weight) and the merge sweep is a single left-to-right pass.  Merging
+  the same digests in a DIFFERENT order may compress differently, but
+  every order's reported quantiles agree within :data:`RANK_ERROR_BOUND`
+  (fuzz-pinned in tests/test_analytics.py).
+- **Documented accuracy**: at the default budget (64) a reported TAIL
+  quantile (p95/p99 — the ones the plane exists for) lands between the
+  exact values at ranks ``q ±`` :data:`RANK_ERROR_BOUND` (0.01 — one
+  percentile point), including after federated merges; mid-quantiles
+  (p50) are within ±0.025 (centroids there are π·sqrt(q(1−q))/δ of
+  rank wide).  The bench gate holds the sketch to exactly the tail
+  bound against a raw-decode exact p99.
+
+Non-finite samples contribute nothing (NaN cells are "no sample" per
+the rollup contract; ±inf would poison centroid means) — ``count``
+tracks finite samples only, mirroring the quad's NaN exclusion.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+
+#: default centroid budget (TPUDASH_SKETCH_BUDGET); 0 disables sketch
+#: rollups entirely
+DEFAULT_BUDGET = 64
+
+#: documented accuracy for TAIL quantiles (q ≤ 0.05 or q ≥ 0.95): the
+#: reported value lies between the exact values at ranks q ± this, at
+#: DEFAULT_BUDGET, merges included (a tail centroid spans
+#: ~π·sqrt(q(1−q))/δ ≈ 0.005 of rank at q=0.99; the bound carries 2x
+#: merge headroom).  Mid-quantiles (p50) are within ±0.025.
+RANK_ERROR_BOUND = 0.01
+
+_HDR = struct.Struct("<BHddd")  # version, n_centroids, count, min, max
+_CENTROID = struct.Struct("<ff")  # mean, weight (float32 pairs)
+_VERSION = 1
+
+
+class SketchError(ValueError):
+    """Malformed serialized digest (wire input is untrusted)."""
+
+
+class QuantileSketch:
+    """One mergeable digest: sorted centroids (mean, weight) plus exact
+    count/min/max.  Immutable in spirit — every operation returns or
+    rebuilds compressed state; nothing mutates a digest another thread
+    may be reading."""
+
+    __slots__ = ("budget", "count", "mn", "mx", "means", "weights")
+
+    def __init__(self, budget: int = DEFAULT_BUDGET):
+        self.budget = max(8, int(budget))
+        self.count = 0.0
+        self.mn = math.inf
+        self.mx = -math.inf
+        self.means: "list[float]" = []
+        self.weights: "list[float]" = []
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_values(cls, values, budget: int = DEFAULT_BUDGET) -> "QuantileSketch":
+        """Digest one batch of samples.  Non-finite samples are dropped
+        (see module docstring); an all-dropped batch yields an empty
+        digest (``quantile`` returns NaN)."""
+        sk = cls(budget)
+        arr = np.asarray(values, dtype=np.float64).ravel()
+        arr = arr[np.isfinite(arr)]
+        if arr.size == 0:
+            return sk
+        arr = np.sort(arr)
+        sk.count = float(arr.size)
+        sk.mn = float(arr[0])
+        sk.mx = float(arr[-1])
+        sk._compress(arr.tolist(), [1.0] * arr.size)
+        return sk
+
+    @classmethod
+    def from_quad(
+        cls, mn: float, mx: float, sm: float, cnt: int,
+        budget: int = DEFAULT_BUDGET,
+    ) -> "QuantileSketch":
+        """Degraded digest from a min/max/sum/count quad — the pre-sketch
+        (PR <13) fallback for rollup buckets whose raw points already
+        expired: three centroids (min, interior mean, max).  Coarse by
+        construction; the query layer only reaches for it when no real
+        sketch and no raw data exist, so an old segment directory keeps
+        answering instead of refusing."""
+        sk = cls(budget)
+        cnt = int(cnt)
+        if cnt <= 0 or not (
+            math.isfinite(mn) and math.isfinite(mx) and math.isfinite(sm)
+        ):
+            return sk
+        sk.count = float(cnt)
+        sk.mn, sk.mx = float(mn), float(mx)
+        if cnt == 1:
+            sk.means, sk.weights = [float(sm)], [1.0]
+            return sk
+        if cnt == 2:
+            sk.means, sk.weights = [float(mn), float(mx)], [1.0, 1.0]
+            return sk
+        interior = (sm - mn - mx) / (cnt - 2)
+        # clamp: float drift must not put the interior centroid outside
+        # the digest's own [min, max] envelope
+        interior = min(max(interior, mn), mx)
+        sk.means = [float(mn), float(interior), float(mx)]
+        sk.weights = [1.0, float(cnt - 2), 1.0]
+        return sk
+
+    @classmethod
+    def merged(
+        cls, sketches, budget: "int | None" = None
+    ) -> "QuantileSketch":
+        """Merge any number of digests into one.  Deterministic for a
+        given input sequence; different groupings agree within
+        :data:`RANK_ERROR_BOUND` (the property federated scatter-gather
+        depends on — each child compresses independently, the parent
+        merges whatever arrived)."""
+        sketches = [s for s in sketches if s is not None and s.count > 0]
+        if budget is None:
+            budget = max((s.budget for s in sketches), default=DEFAULT_BUDGET)
+        out = cls(budget)
+        if not sketches:
+            return out
+        pairs: "list[tuple[float, float]]" = []
+        for s in sketches:
+            pairs.extend(zip(s.means, s.weights))
+            out.count += s.count
+            out.mn = min(out.mn, s.mn)
+            out.mx = max(out.mx, s.mx)
+        # total order (mean, weight): concatenation order cannot leak
+        # into the compressed result for a fixed multiset of centroids
+        pairs.sort()
+        out._compress([p[0] for p in pairs], [p[1] for p in pairs])
+        return out
+
+    def _compress(self, means: "list[float]", weights: "list[float]") -> None:
+        """One left-to-right merge sweep under the arcsine scale's
+        weight limit ``w ≤ 2π·total·sqrt(q(1−q))/budget`` (one k-unit of
+        ``k(q) = δ/2π·asin(2q−1)``): at most ~budget/2 centroids
+        whatever the input size, singletons at the tails.  ``means``
+        must be sorted ascending; runs in O(n)."""
+        total = self.count
+        if total <= 0 or not means:
+            self.means, self.weights = [], []
+            return
+        coeff = 2.0 * math.pi / float(self.budget)
+        out_m: "list[float]" = []
+        out_w: "list[float]" = []
+        cm, cw = means[0], weights[0]
+        done = 0.0  # weight fully emitted before the open centroid
+        for m, w in zip(means[1:], weights[1:]):
+            q = (done + (cw + w) * 0.5) / total
+            lim = coeff * total * math.sqrt(max(q * (1.0 - q), 0.0))
+            if cw + w <= (lim if lim > 1.0 else 1.0):
+                cw += w
+                cm += (m - cm) * (w / cw)
+            else:
+                out_m.append(cm)
+                out_w.append(cw)
+                done += cw
+                cm, cw = m, w
+        out_m.append(cm)
+        out_w.append(cw)
+        self.means, self.weights = out_m, out_w
+
+    # -- queries -------------------------------------------------------------
+    def quantile(self, q: float) -> float:
+        """Estimated value at rank ``q`` in [0, 1]; NaN when empty.
+        Standard t-digest interpolation: centroid midpoints in
+        cumulative-weight space, anchored at the exact min/max."""
+        if self.count <= 0 or not self.means:
+            return math.nan
+        q = min(1.0, max(0.0, float(q)))
+        target = q * self.count
+        means, weights = self.means, self.weights
+        if len(means) == 1:
+            return means[0]
+        # cumulative midpoint of each centroid
+        cum = 0.0
+        mids = []
+        for w in weights:
+            mids.append(cum + w / 2.0)
+            cum += w
+        if target <= mids[0]:
+            # below the first midpoint: lerp from the exact minimum
+            span = mids[0]
+            f = target / span if span > 0 else 1.0
+            return self.mn + (means[0] - self.mn) * f
+        if target >= mids[-1]:
+            span = self.count - mids[-1]
+            f = (target - mids[-1]) / span if span > 0 else 0.0
+            return means[-1] + (self.mx - means[-1]) * min(1.0, f)
+        for i in range(1, len(means)):
+            if target <= mids[i]:
+                span = mids[i] - mids[i - 1]
+                f = (target - mids[i - 1]) / span if span > 0 else 0.0
+                return means[i - 1] + (means[i] - means[i - 1]) * f
+        return means[-1]  # pragma: no cover — loop always brackets
+
+    # -- wire ---------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """Compact serialized form (segment records and the federated
+        range-state wire): fixed header + float32 centroid pairs.
+        Deterministic — same digest, same bytes."""
+        n = len(self.means)
+        mn = self.mn if self.count > 0 else 0.0
+        mx = self.mx if self.count > 0 else 0.0
+        parts = [_HDR.pack(_VERSION, n, self.count, mn, mx)]
+        parts.extend(
+            _CENTROID.pack(m, w) for m, w in zip(self.means, self.weights)
+        )
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes, budget: int = DEFAULT_BUDGET) -> "QuantileSketch":
+        """Parse a serialized digest; raises :class:`SketchError` on any
+        malformed input (wire bytes come from other processes)."""
+        if len(raw) < _HDR.size:
+            raise SketchError("digest truncated")
+        ver, n, count, mn, mx = _HDR.unpack_from(raw, 0)
+        if ver != _VERSION:
+            raise SketchError(f"digest version {ver} != {_VERSION}")
+        if len(raw) != _HDR.size + n * _CENTROID.size:
+            raise SketchError("digest length disagrees with centroid count")
+        if not math.isfinite(count) or count < 0:
+            raise SketchError("digest count not a finite non-negative number")
+        sk = cls(budget)
+        if n == 0 or count == 0:
+            return sk
+        sk.count = float(count)
+        sk.mn, sk.mx = float(mn), float(mx)
+        # one vectorized parse+validate pass — the federated merge path
+        # decodes hundreds of digests per query
+        arr = np.frombuffer(
+            raw, dtype="<f4", count=n * 2, offset=_HDR.size
+        ).reshape(n, 2).astype(np.float64)
+        means, weights = arr[:, 0], arr[:, 1]
+        if not np.isfinite(arr).all() or (weights <= 0).any():
+            raise SketchError("digest centroid not finite/positive")
+        if n > 1 and (np.diff(means) < 0).any():
+            raise SketchError("digest centroids not sorted")
+        sk.means, sk.weights = means.tolist(), weights.tolist()
+        return sk
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return (
+            f"QuantileSketch(n={len(self.means)}, count={self.count:g}, "
+            f"range=[{self.mn:g}, {self.mx:g}])"
+        )

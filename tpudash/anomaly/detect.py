@@ -171,6 +171,45 @@ class AnomalyEngine:
             log.warning("baseline seed from tsdb failed: %s", e)
             return 0
 
+    def score_series(self, ts_list, keys, cols, stacked):
+        """Recording-rule hook (tpudash.analytics.rules ``anomaly()``):
+        the fleet's worst baseline-deviation badness per frame of one
+        sealed tsdb chunk — ``(n,)`` float array, NaN where the
+        baselines are still cold.  Runs on the tsdb seal thread, so it
+        is plain numpy against a single seasonal-bucket snapshot (a
+        chunk spans ≤ one flush interval, well inside one bucket); it
+        deliberately does NOT ingest — the live observe() path owns
+        baseline updates, this is a read."""
+        wcols = [c for c in sorted(DEFAULT_DIRECTIONS) if c in cols]
+        if not wcols:
+            return None
+        n = len(ts_list)
+        pos = {c: i for i, c in enumerate(cols)}
+        rows = [i for i, k in enumerate(keys) if not str(k).startswith("__")]
+        if not rows:
+            return None
+        try:
+            loc, scale = self.baselines.matrices(
+                [keys[i] for i in rows], wcols, float(ts_list[0]) / 1000.0
+            )
+        except Exception:  # noqa: BLE001 — a cold store scores nothing
+            return None
+        x = stacked[:, rows, :][:, :, [pos[c] for c in wcols]]  # (n, K, W)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            z = (x - loc[None, :, :]) / scale[None, :, :]
+        bad = np.full_like(z, np.nan)
+        for j, col in enumerate(wcols):
+            bad[:, :, j] = _direction_badness(
+                z[:, :, j], DEFAULT_DIRECTIONS.get(col, "both")
+            )
+        out = np.full(n, np.nan)
+        finite = np.isfinite(bad)
+        any_ok = finite.any(axis=(1, 2))
+        if any_ok.any():
+            with np.errstate(invalid="ignore"):
+                out[any_ok] = np.nanmax(bad[any_ok], axis=(1, 2))
+        return out
+
     def save_baselines(self) -> None:
         """Persist beside the tsdb segments (graceful shutdown)."""
         if not self.baseline_path:

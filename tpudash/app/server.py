@@ -33,12 +33,14 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import hashlib
 import hmac
 import json
 import logging
 import secrets
 import tempfile
 import time
+from collections import OrderedDict
 
 from aiohttp import web
 
@@ -261,6 +263,12 @@ class DashboardServer:
             None,
         )
         self._summary_build_lock = asyncio.Lock()
+        #: bounded LRU of finalized ``/api/range`` response bodies keyed
+        #: by canonical query params: serves the ETag/304 revalidation
+        #: path AND the OverloadGuard's stale-degrade contract (a shed
+        #: range poll answers slightly-old data + a stale marker instead
+        #: of 503, like /api/frame).  Entries: key → (etag|None, bytes)
+        self._range_cache: "OrderedDict[str, tuple]" = OrderedDict()
         #: lazy HTTP session for the federation child drill-down proxy
         #: (/api/child/...); None until the first proxied request, closed
         #: on cleanup
@@ -1019,6 +1027,14 @@ class DashboardServer:
             summary["anomaly"] = await loop.run_in_executor(
                 None, self.service.anomaly_engine.stats
             )
+        scatter_counters = getattr(
+            self.service.source, "range_counters", None
+        )
+        if scatter_counters is not None:
+            # the federated range plane's fan-in honesty: scatters,
+            # per-child failures, replica serves, hedge wins
+            summary["range_scatter"] = dict(scatter_counters)
+        summary["range_cache_entries"] = len(self._range_cache)
         summary["tier"] = self._tier_doc(summary.get("tsdb"))
         return _json_response(summary)
 
@@ -1201,29 +1217,9 @@ class DashboardServer:
             }
         )
 
-    async def range_api(self, request: web.Request) -> web.Response:
-        """Long-horizon range query over the compressed trend store
-        (``tpudash.tsdb``) — the diagnosis surface the rolling rings
-        cannot offer.  Query params, all optional except none:
-
-        - ``chip=<slice>/<id>`` — one chip's series; omitted = the
-          fleet-average pseudo-series
-        - ``cols=a,b`` — column subset (default: every column the series
-          carries)
-        - ``start=<epoch_s>`` / ``end=<epoch_s>`` — window (default:
-          newest sample back one hour)
-        - ``step=<seconds>`` — alignment step; widened server-side when
-          the point budget demands it
-        - ``agg=mean|min|max`` — bucket aggregate (default mean)
-        - ``points=<n>`` — point budget per column (ceiling 5000)
-
-        Admitted under the OverloadGuard like every data route; the
-        store read (chunk decode) runs in the executor, never on the
-        event loop.  400 on malformed params, 404 for a series no tier
-        has ever carried."""
-        svc = self.service
-        if svc.tsdb is None:
-            raise web.HTTPServiceUnavailable(text="trend store unavailable")
+    def _range_params(self, request: web.Request) -> dict:
+        """Parse/validate the shared ``/api/range`` param set (400 on
+        malformed numbers)."""
         q = request.query
 
         def _num(name: str) -> "float | None":
@@ -1237,50 +1233,308 @@ class DashboardServer:
                     text=f"{name} must be a number, not {raw!r}"
                 ) from None
 
-        start_s, end_s, step_s = _num("start"), _num("end"), _num("step")
-        points = _num("points")
-        chip = q.get("chip")
         cols_q = q.get("cols")
-        cols = (
-            [c for c in cols_q.split(",") if c] if cols_q is not None else None
+        return {
+            "chip": q.get("chip") or None,
+            "cols": (
+                [c for c in cols_q.split(",") if c]
+                if cols_q is not None
+                else None
+            ),
+            "start": _num("start"),
+            "end": _num("end"),
+            "step": _num("step"),
+            "agg": q.get("agg", "mean"),
+            "points": _num("points"),
+        }
+
+    @staticmethod
+    def _range_cache_key(query) -> str:
+        """Canonical cache key for one range request: the known params
+        only, sorted — cheap enough for the shed path (no parsing).
+        ``merge`` is part of the key: a state-mode document and the
+        finalized series for the same window are different bodies and
+        must never share a cache entry or an ETag."""
+        return "&".join(
+            f"{k}={query[k]}"
+            for k in (
+                "chip", "cols", "start", "end", "step", "agg", "points",
+                "merge",
+            )
+            if k in query and query[k] != ""
         )
+
+    def _range_cache_put(
+        self, key: str, etag: "str | None", body: bytes
+    ) -> None:
+        bound = getattr(self.service.cfg, "range_cache", 32)
+        if bound <= 0:
+            return
+        cache = self._range_cache
+        cache[key] = (etag, body)
+        cache.move_to_end(key)
+        while len(cache) > bound:
+            cache.popitem(last=False)
+
+    def _range_wire_params(self, p: dict) -> dict:
+        """The param set forwarded to children on a scatter (the parent
+        resolves nothing — each child picks its own tier and the state
+        docs merge whatever comes back)."""
+        return {
+            "chip": p["chip"],
+            "cols": ",".join(p["cols"]) if p["cols"] else None,
+            "start": p["start"],
+            "end": p["end"],
+            "step": p["step"],
+            "agg": p["agg"],
+            "points": int(p["points"]) if p["points"] else None,
+        }
+
+    def _range_route(self, p: dict, state_mode: bool):
+        """(scatter_fn, target_child, federated) for one query — the
+        ONE routing decision (the ETag choice and the execution path
+        both key off it).  On a fleet parent, fleet-scope queries and
+        chip keys namespaced under a known child scatter (the child
+        holds the real history; the parent's store only mirrors
+        scraped latest values); ``__``-prefixed keys (the parent's own
+        recording rules) and unknown keys stay local.  ``merge=state``
+        always answers locally: it is the leaf protocol of the
+        scatter, and a parent re-scattering it would make federation
+        recursive (ROADMAP #3, not here)."""
+        scatter = getattr(self.service.source, "scatter_range", None)
+        if not callable(scatter) or state_mode:
+            return None, None, False
+        chip = p["chip"]
+        if chip is None:
+            return scatter, None, True
+        if chip.startswith("__"):
+            return scatter, None, False
+        head = chip.split("/", 1)[0]
+        if "/" in chip and head in self.service.source.child_urls():
+            return scatter, head, True
+        return scatter, None, False
+
+    async def _range_result(
+        self, request: web.Request, p: dict, route: tuple
+    ) -> dict:
+        """One finalized range answer (shared by the JSON and CSV
+        routes): the local store for ordinary queries, the federated
+        scatter-gather for fleet parents.  ``route`` is the
+        _range_route triple the caller already resolved (the same one
+        its ETag decision used).  Raises HTTP errors for the route to
+        propagate."""
+        svc = self.service
+        loop = asyncio.get_running_loop()
+        from tpudash.tsdb.query import DEFAULT_POINTS, MAX_POINTS
+        max_points = (
+            max(1, min(int(p["points"]), MAX_POINTS))
+            if p["points"]
+            else DEFAULT_POINTS
+        )
+
+        scatter, target_child, federated = route
+        chip = p["chip"]
+        fed_block = None
+        if federated:
+            wire_p = self._range_wire_params(p)
+            if target_child is not None:
+                wire_p["chip"] = chip.split("/", 1)[1]
+            gathered = await loop.run_in_executor(
+                None, lambda: scatter(wire_p, target_child)
+            )
+            from tpudash.analytics.executor import merge_states
+
+            if gathered["states"]:
+                try:
+                    res = merge_states(
+                        gathered["states"], p["agg"], max_points=max_points
+                    )
+                except ValueError as e:
+                    raise web.HTTPBadRequest(text=str(e)) from e
+                res["federation"] = {
+                    "children": gathered["children"],
+                    "partial": gathered["partial"],
+                }
+                res["partial"] = gathered["partial"]
+                res["chip"] = chip or "fleet"
+                return res
+            # EMPTY gather (every child dark/version-skewed, e.g. a
+            # rolling upgrade over pre-13 children): fall through to
+            # the parent's OWN store — it mirrors the scraped fleet at
+            # poll cadence, and a degraded local answer marked partial
+            # beats the 503 the pre-13 parent never returned.  Only
+            # when the local store has nothing either does this 503.
+            fed_block = {
+                "children": gathered["children"],
+                "partial": True,
+                "degraded": "local-mirror",
+            }
+            if svc.tsdb is None:
+                detail = "; ".join(
+                    f"{n}: {c.get('error', c['status'])}"
+                    for n, c in gathered["children"].items()
+                )
+                raise web.HTTPServiceUnavailable(
+                    text=f"no federated child answered the range query: "
+                    f"{detail or 'no children configured'}"
+                )
+
+        if svc.tsdb is None:
+            raise web.HTTPServiceUnavailable(text="trend store unavailable")
         from tpudash.tsdb import FLEET_SERIES
-        from tpudash.tsdb.query import DEFAULT_POINTS, range_query
+        from tpudash.tsdb.query import range_query
 
         key = chip if chip else FLEET_SERIES
+        state_mode = request.query.get("merge") == "state"
 
         def run():
             tsdb = svc.tsdb
             if key != FLEET_SERIES and not tsdb.series_cols(key):
                 return None  # no tier ever carried this series → 404
+            if state_mode:
+                from tpudash.analytics.executor import range_state
+
+                return range_state(
+                    tsdb,
+                    chip,
+                    p["cols"],
+                    p["start"],
+                    p["end"],
+                    p["step"],
+                    p["agg"],
+                    max_points,
+                )
             return range_query(
                 tsdb,
                 key,
-                cols=cols,
-                start_s=start_s,
-                end_s=end_s,
-                step_s=step_s,
-                agg=q.get("agg", "mean"),
-                max_points=int(points) if points else DEFAULT_POINTS,
+                cols=p["cols"],
+                start_s=p["start"],
+                end_s=p["end"],
+                step_s=p["step"],
+                agg=p["agg"],
+                max_points=max_points,
             )
 
-        loop = asyncio.get_running_loop()
         try:
             res = await loop.run_in_executor(None, run)
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e)) from e
         if res is None:
+            if fed_block is not None:
+                detail = "; ".join(
+                    f"{n}: {c.get('error', c['status'])}"
+                    for n, c in fed_block["children"].items()
+                )
+                raise web.HTTPServiceUnavailable(
+                    text="no federated child answered the range query "
+                    f"and the local mirror has no such series: {detail}"
+                )
             raise web.HTTPNotFound(text=f"unknown series {chip!r}")
-        # strict-JSON hygiene: a stored ±inf must not emit bare Infinity
-        res["series"] = {
-            c: [
-                [ts, (v if -1e308 < v < 1e308 else None)]
-                for ts, v in pts
-            ]
-            for c, pts in res["series"].items()
-        }
-        res["chip"] = chip or "fleet"
-        return _json_response(res)
+        if not state_mode:
+            res["chip"] = chip or "fleet"
+        if fed_block is not None:
+            res["federation"] = fed_block
+            res["partial"] = True
+        return res
+
+    async def range_api(self, request: web.Request) -> web.Response:
+        """Long-horizon range query over the analytics plane
+        (``tpudash.tsdb`` + ``tpudash.analytics``).  Query params, all
+        optional:
+
+        - ``chip=<slice>/<id>`` — one chip's series; omitted = the
+          fleet scope (average row for mean/min/max, the fleet
+          DISTRIBUTION for quantiles); ``__rule__/<name>`` = a
+          recording-rule series
+        - ``cols=a,b`` — column subset (default: every column the series
+          carries)
+        - ``start=<epoch_s>`` / ``end=<epoch_s>`` — window (default:
+          newest sample back one hour)
+        - ``step=<seconds>`` — alignment step; widened server-side when
+          the point budget demands it
+        - ``agg=mean|min|max|p50|p95|p99`` — bucket aggregate (default
+          mean; quantiles answer from the sketch rollups)
+        - ``points=<n>`` — point budget per column (ceiling 5000)
+        - ``merge=state`` — the mergeable per-bucket aggregation state
+          instead of finalized values (what a federation parent's
+          scatter asks children for)
+
+        On a federation parent, fleet-scope and child-namespaced
+        queries scatter to the children under the per-child breaker/
+        hedge/deadline machinery and merge exactly; the response then
+        carries a ``federation`` block with per-child status/staleness
+        and ``partial: true`` whenever any child didn't contribute
+        fresh state — a dark child degrades the answer, never errors
+        it.
+
+        Revalidation: local answers carry an ETag keyed on (store
+        version, params) — steady-state pollers pay 304, no executor
+        hop.  Under overload the route degrades to its last cached
+        body (``X-Tpudash-Stale: 1``) like ``/api/frame``.  400 on
+        malformed params, 404 for a series no tier has ever carried."""
+        svc = self.service
+        p = self._range_params(request)
+        cache_key = self._range_cache_key(request.query)
+        state_mode = request.query.get("merge") == "state"
+        route = self._range_route(p, state_mode)
+        federated = route[2]
+        etag = None
+        if not federated and svc.tsdb is not None:
+            digest = hashlib.sha1(
+                f"{svc.tsdb.version}|{cache_key}".encode()
+            ).hexdigest()[:16]
+            etag = f'"rq-{digest}"'
+            if request.headers.get("If-None-Match") == etag:
+                return web.Response(
+                    status=304,
+                    headers={"Cache-Control": "no-cache", "ETag": etag},
+                )
+        res = await self._range_result(request, p, route)
+        if not state_mode:
+            # strict-JSON hygiene: a stored ±inf must not emit bare
+            # Infinity
+            res["series"] = {
+                c: [
+                    [ts, (v if -1e308 < v < 1e308 else None)]
+                    for ts, v in pts
+                ]
+                for c, pts in res["series"].items()
+            }
+        body = _dumps(res).encode()
+        self._range_cache_put(cache_key, etag, body)
+        headers = {"Cache-Control": "no-cache"}
+        if etag is not None:
+            headers["ETag"] = etag
+        return web.Response(
+            body=body, content_type="application/json", headers=headers
+        )
+
+    async def range_csv(self, request: web.Request) -> web.Response:
+        """``GET /api/range.csv`` — the same query surface, streamed as
+        CSV (one row per timestamp, one column per metric; the
+        ``/api/history.csv`` shape) for operators pulling incident
+        evidence into a spreadsheet.  Federated queries export the
+        merged fleet answer."""
+        p = self._range_params(request)
+        if request.query.get("merge") == "state":
+            raise web.HTTPBadRequest(text="merge=state has no CSV form")
+        res = await self._range_result(
+            request, p, self._range_route(p, False)
+        )
+        from tpudash.analytics.executor import range_to_csv
+
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, range_to_csv, res)
+        name = "tpudash-range"
+        if p["chip"]:
+            name += "-" + p["chip"].replace("/", "_")
+        return web.Response(
+            text=text,
+            content_type="text/csv",
+            headers={
+                "Content-Disposition": f"attachment; filename={name}.csv"
+            },
+        )
 
     async def chip(self, request: web.Request) -> web.Response:
         """Single-chip drill-down model (identity + gauges + chip trends +
@@ -1840,6 +2094,29 @@ class DashboardServer:
                     content_type="application/json",
                     headers=headers,
                 )
+        if request.method == "GET" and request.path == "/api/range":
+            # the analytics twin of the /api/frame degrade: a shed range
+            # poll whose exact param set was answered recently serves
+            # the cached body marked stale (header — the body bytes are
+            # reused verbatim, serialization is exactly what the shed
+            # path must not pay) instead of 503ing while the fleet
+            # burns.  Cache key = canonical params; bounded LRU.
+            hit = self._range_cache.get(self._range_cache_key(request.query))
+            if hit is not None:
+                etag, body = hit
+                self.overload.note_stale_frame()
+                headers["Cache-Control"] = "no-cache"
+                headers["X-Tpudash-Stale"] = "1"
+                if etag is not None:
+                    stale_etag = f'{etag[:-1]}-stale"'
+                    headers["ETag"] = stale_etag
+                    if request.headers.get("If-None-Match") == stale_etag:
+                        return web.Response(status=304, headers=headers)
+                return web.Response(
+                    body=body,
+                    content_type="application/json",
+                    headers=headers,
+                )
         if request.method == "GET" and request.path == "/api/frame":
             frame, key = self._sheddable_frame()
             if frame is not None:
@@ -2027,6 +2304,7 @@ class DashboardServer:
         app.router.add_get("/api/history", self.history)
         app.router.add_get("/api/history.csv", self.history_csv)
         app.router.add_get("/api/range", self.range_api)
+        app.router.add_get("/api/range.csv", self.range_csv)
         app.router.add_get("/api/chip", self.chip)
         app.router.add_get("/api/config", self.config)
         app.router.add_get("/api/topology", self.topology)

@@ -244,6 +244,26 @@ class DashboardService:
         except Exception as e:  # noqa: BLE001 — history tier is best-effort
             log.warning("tsdb unavailable: %s", e)
             self.tsdb = None
+        #: recording rules (tpudash.analytics.rules): derived series —
+        #: fleet MFU, per-slice/per-host aggregates, the anomaly score —
+        #: evaluated once per sealed chunk ON THE SEAL THREAD and
+        #: persisted as first-class ``__rule__/<name>`` series, so every
+        #: viewer (and the anomaly layer) queries precomputed series
+        #: instead of re-deriving them per tick.  Leaders only — a
+        #: follower receives rule blocks through replication.
+        self.rule_engine = None
+        if self.tsdb is not None and not getattr(self.tsdb, "read_only", False):
+            from tpudash.analytics.rules import RuleEngine
+
+            try:
+                self.rule_engine = RuleEngine.from_config(cfg)
+            except ValueError as e:
+                log.warning("recording rules disabled (bad TPUDASH_RULES): %s", e)
+            if self.rule_engine is not None:
+                self.tsdb.rule_engine = self.rule_engine
+        #: identity of the keys list the rule engine's host map was last
+        #: built from (population-keyed cache, one dict build per churn)
+        self._rule_host_ref: "object | None" = None
         #: (cache key, {col: [(ts, v), ...]}) for the fleet sparkline query
         self._tsdb_trend_cache: tuple = (None, None)
         if cfg.history_backfill > 0:
@@ -330,6 +350,13 @@ class DashboardService:
                     "seeded anomaly baselines from tsdb rollups "
                     "(%d minute-folds)", seeded,
                 )
+        if self.rule_engine is not None and self.anomaly_engine is not None:
+            # the ``anomaly()`` recording rule: the engine's baseline
+            # scorer runs once per sealed chunk and the fleet's worst
+            # deviation becomes a persisted __rule__/ series — incident
+            # forensics chart it from /api/range instead of replaying
+            # raw history through the detector
+            self.rule_engine.scorer = self.anomaly_engine.score_series
         self.timeline = IncidentTimeline()
         #: (rule, chip) pairs firing in the previous frame — webhook
         #: notifications are sent on transitions only, not every cycle
@@ -890,6 +917,19 @@ class DashboardService:
             return  # a follower never originates data
         try:
             from tpudash.tsdb import FLEET_SERIES
+
+            eng = self.rule_engine
+            if eng is not None and self._rule_host_ref is not keys:
+                # ``by host`` recording rules need key → host identity;
+                # refreshed only on population change (the publish path
+                # passes the same keys list object between churns; keys
+                # the map misses are simply skipped by the engine)
+                df = self.last_df
+                if df is not None and "host" in df.columns and len(df):
+                    eng.set_host_map(
+                        df.index.tolist(), df["host"].tolist()
+                    )
+                self._rule_host_ref = keys
 
             if arr32 is not None:
                 fleet_row = np.full((1, len(cols)), np.nan, dtype=np.float32)

@@ -1873,6 +1873,10 @@ class _ChildHarness:
         )
         self._runner = None
         self._raw_server = None
+        #: the live DashboardServer while running — drills that need to
+        #: drive the child's service directly (e.g. priming its tsdb for
+        #: the rangescatter drill) reach it here
+        self.server = None
 
     def _build_server(self):
         from tpudash.app.server import DashboardServer
@@ -1892,6 +1896,7 @@ class _ChildHarness:
         # service construction does real file I/O — executor, like every
         # other drill (asynccheck rule ``async-blocking``)
         server = await loop.run_in_executor(None, self._build_server)
+        self.server = server
         self._runner = web.AppRunner(server.build_app())
         await self._runner.setup()
         site = web.TCPSite(
@@ -2374,6 +2379,223 @@ async def run_partition_drill(
     return {"ok": not failures, "failures": failures, **numbers}
 
 
+async def run_rangescatter_drill(
+    children: int = 3, cfg: "Config | None" = None
+) -> dict:
+    """Federated range-query drill (ISSUE 13): a parent scatters
+    ``/api/range?agg=p99`` to live children, then one child is
+    partitioned (accept-then-hang — the connection dies MID-QUERY) and
+    the drill asserts the analytics plane's degrade contract:
+
+    - healthy fleet: 200, ``partial: false``, every child ``ok``,
+      non-empty merged series, per-child accounting present;
+    - partitioned: STILL 200 within one range deadline (+ slack),
+      ``partial: true``, exactly the dead child ``dark`` with an error
+      and staleness accounting, the survivors ``ok``, the series still
+      answering — a dark child degrades the answer, never errors it;
+    - child-side ``/api/range`` revalidation: an unchanged store
+      answers ``304`` to ``If-None-Match``;
+    - heal: the next scatter is whole again (``partial: false``);
+    - zero unhandled exceptions in any process's logs throughout.
+    """
+    from aiohttp import ClientSession, ClientTimeout
+
+    children = max(2, children)
+    loop = asyncio.get_running_loop()
+    base_cfg = cfg or load_config()
+    for env_name, (field, value) in _PARTITION_KNOBS.items():
+        if not env_is_set(env_name):
+            base_cfg = dataclasses.replace(base_cfg, **{field: value})
+    ports = _free_ports(children + 1)
+    names = [f"c{i}" for i in range(children)]
+    kids = [
+        _ChildHarness(
+            name, port, dataclasses.replace(base_cfg, source="synthetic")
+        )
+        for name, port in zip(names, ports[:children])
+    ]
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    failures: "list[str]" = []
+    numbers: dict = {"children": children}
+    parent_runner = None
+    parent_port = ports[children]
+    deadline = base_cfg.range_deadline or base_cfg.federate_deadline or 1.0
+    try:
+        for kid in kids:
+            await kid.start()
+        # prime every child's tsdb: a few refresh ticks of real data so
+        # the scatter has history to answer from
+        for kid in kids:
+            svc = kid.server.service
+
+            def prime(s=svc):
+                for _ in range(12):
+                    s.render_frame()
+                s.tsdb.flush(seal_partial=True)
+
+            await loop.run_in_executor(None, prime)
+        from aiohttp import web
+
+        from tpudash.app.server import DashboardServer
+        from tpudash.app.service import DashboardService
+        from tpudash.sources import make_source
+
+        parent_cfg = dataclasses.replace(
+            base_cfg,
+            source="synthetic",
+            federate=",".join(
+                f"{n}=http://127.0.0.1:{k.port}" for n, k in zip(names, kids)
+            ),
+            host="127.0.0.1",
+            port=parent_port,
+        )
+        parent = await loop.run_in_executor(
+            None,
+            lambda: DashboardServer(
+                DashboardService(parent_cfg, make_source(parent_cfg))
+            ),
+        )
+        parent_runner = web.AppRunner(parent.build_app())
+        await parent_runner.setup()
+        site = web.TCPSite(
+            parent_runner, "127.0.0.1", parent_port, reuse_address=True
+        )
+        await site.start()
+
+        base = f"http://127.0.0.1:{parent_port}"
+        params = {
+            "agg": "p99",
+            "cols": "tpu_tensorcore_utilization",
+            "step": "60",
+        }
+        async with ClientSession(
+            timeout=ClientTimeout(total=deadline * 6 + 10)
+        ) as session:
+            # phase 1: whole fleet
+            t0 = time.monotonic()
+            async with session.get(f"{base}/api/range", params=params) as r:
+                doc = await r.json(content_type=None)
+                numbers["healthy_status"] = r.status
+            numbers["healthy_ms"] = round((time.monotonic() - t0) * 1e3, 1)
+            fed = (doc.get("federation") or {}).get("children", {})
+            if r.status != 200:
+                failures.append(f"healthy scatter status {r.status}")
+            if doc.get("partial"):
+                failures.append("healthy fleet reported partial")
+            if sorted(fed) != sorted(names):
+                failures.append(f"accounting missing children: {sorted(fed)}")
+            if any(c.get("status") != "ok" for c in fed.values()):
+                failures.append(f"healthy child not ok: {fed}")
+            if not doc.get("series", {}).get("tpu_tensorcore_utilization"):
+                failures.append("healthy scatter returned no points")
+
+            # child-side revalidation: unchanged store → 304
+            child_base = f"http://127.0.0.1:{kids[0].port}"
+            async with session.get(
+                f"{child_base}/api/range", params=params
+            ) as r1:
+                etag = r1.headers.get("ETag")
+                await r1.read()
+            if not etag:
+                failures.append("child /api/range carried no ETag")
+            else:
+                async with session.get(
+                    f"{child_base}/api/range",
+                    params=params,
+                    headers={"If-None-Match": etag},
+                ) as r2:
+                    numbers["child_revalidate_status"] = r2.status
+                    if r2.status != 304:
+                        failures.append(
+                            f"child revalidation answered {r2.status}, not 304"
+                        )
+
+            # phase 2: partition one child mid-query (accept-then-hang:
+            # the scatter's request connects, then the bytes never come)
+            victim = kids[-1]
+            await victim.stop()
+            await victim.start_hang()
+            t0 = time.monotonic()
+            async with session.get(f"{base}/api/range", params=params) as r:
+                doc = await r.json(content_type=None)
+                numbers["partition_status"] = r.status
+            part_ms = (time.monotonic() - t0) * 1e3
+            numbers["partition_ms"] = round(part_ms, 1)
+            fed = (doc.get("federation") or {}).get("children", {})
+            if r.status != 200:
+                failures.append(f"partitioned scatter status {r.status}")
+            if not doc.get("partial"):
+                failures.append("partitioned fleet did not report partial")
+            dark = {n for n, c in fed.items() if c.get("status") == "dark"}
+            if dark != {victim.name}:
+                failures.append(
+                    f"dark set {sorted(dark)} != [{victim.name}]"
+                )
+            vc = fed.get(victim.name, {})
+            if not vc.get("error"):
+                failures.append("dark child carried no error detail")
+            if "staleness_s" not in vc and "summary_status" not in vc:
+                failures.append("dark child carried no staleness accounting")
+            if any(
+                c.get("status") != "ok"
+                for n, c in fed.items()
+                if n != victim.name
+            ):
+                failures.append(f"survivor not ok under partition: {fed}")
+            if not doc.get("series", {}).get("tpu_tensorcore_utilization"):
+                failures.append("partitioned scatter returned no points")
+            # the hung child must cost ONE deadline (+ hedge + slack),
+            # not wedge the query
+            budget_ms = (deadline * 2 + 2.0) * 1e3
+            if part_ms > budget_ms:
+                failures.append(
+                    f"partitioned scatter took {part_ms:.0f}ms "
+                    f"(> {budget_ms:.0f}ms budget)"
+                )
+
+            # phase 3: heal → whole again
+            await victim.heal()
+            svc = victim.server.service
+
+            def reprime(s=svc):
+                for _ in range(6):
+                    s.render_frame()
+                s.tsdb.flush(seal_partial=True)
+
+            await loop.run_in_executor(None, reprime)
+            async with session.get(f"{base}/api/range", params=params) as r:
+                doc = await r.json(content_type=None)
+                numbers["healed_status"] = r.status
+            fed = (doc.get("federation") or {}).get("children", {})
+            if r.status != 200 or doc.get("partial"):
+                failures.append(
+                    f"healed fleet still degraded: status {r.status}, "
+                    f"partial {doc.get('partial')}, {fed}"
+                )
+    finally:
+        logging.getLogger().removeHandler(trap)
+        for kid in kids:
+            with contextlib.suppress(Exception):
+                await kid.stop_raw()
+            with contextlib.suppress(Exception):
+                await kid.stop()
+        if parent_runner is not None:
+            with contextlib.suppress(Exception):
+                await parent_runner.cleanup()
+    unhandled = [
+        rec for rec in trap.records
+        if "Error handling request" in rec or "Traceback" in rec
+    ]
+    if unhandled:
+        failures.append(f"unhandled exceptions in logs: {unhandled[:3]}")
+    return {
+        "ok": not failures,
+        "failures": failures,
+        **numbers,
+    }
+
+
 def _scan_worker_logs(bus_dir: str) -> "list[str]":
     """Unhandled-exception lines from the worker processes' captured
     stderr (the supervisor appends each worker's output to
@@ -2825,6 +3047,14 @@ def main(argv: "list[str] | None" = None) -> None:
         "anti-flap dwell) and recover within one poll of heal",
     )
     pa.add_argument("--children", type=int, default=4)
+    rs = sub.add_parser(
+        "rangescatter",
+        help="analytics-plane drill: federated /api/range?agg=p99 "
+        "scatter-gather; partition one child mid-query and assert "
+        "partial-not-error with staleness accounting, child-side "
+        "ETag/304, recovery after heal",
+    )
+    rs.add_argument("--children", type=int, default=3)
     inc = sub.add_parser(
         "incident",
         help="anomaly-layer drill: degrading-chip fault mid-storm → "
@@ -2888,6 +3118,12 @@ def main(argv: "list[str] | None" = None) -> None:
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "partition":
         summary = asyncio.run(run_partition_drill(children=args.children))
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "rangescatter":
+        summary = asyncio.run(
+            run_rangescatter_drill(children=args.children)
+        )
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "incident":

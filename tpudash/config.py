@@ -284,6 +284,41 @@ class Config:
     #: float32 tolerance (see docs/OPERATIONS.md).  Off by default —
     #: numpy is faster below ~10k chips.
     anomaly_jax: bool = False
+    # --- analytics query plane (tpudash.analytics) ---------------------------
+    #: Recording rules (tpudash.analytics.rules grammar:
+    #: ``name=fn(column) [by slice|host]``, ``;``-separated): derived
+    #: series evaluated once per sealed tsdb chunk on the seal thread
+    #: and persisted as first-class ``__rule__/<name>`` series.  "" =
+    #: built-in defaults (fleet MFU, fleet util p99, per-slice util,
+    #: per-host power, anomaly score); "off" disables.
+    rules: str = ""
+    #: Per-rule cap on ``by slice|host`` group fan-out (groups sorted,
+    #: first N win; truncation counted on /api/timings, never silent).
+    rules_max_groups: int = 64
+    #: Quantile-sketch centroid budget per rollup bucket (the t-digest
+    #: size/accuracy dial: rank error ≤ ~1 percentile point at 64).
+    #: 0 disables sketch rollups — agg=p95/p99 then degrades to raw
+    #: folds and quad pseudo-digests.
+    sketch_budget: int = 64
+    #: Which tiers keep PER-SERIES sketches beside the fleet-
+    #: distribution digest: "10m" (default — per-chip quantiles at the
+    #: cheap tier), "all" (1m too; ~raw-sized disk cost), "fleet"
+    #: (cross-chip digests only).
+    sketch_series: str = "10m"
+    #: Per-child deadline for federated scatter-gather range queries,
+    #: seconds (children are queried concurrently).  0 = inherit
+    #: federate_deadline (and transitively http_timeout).
+    range_deadline: float = 0.0
+    #: Bound on cached ``/api/range`` responses (ETag revalidation +
+    #: the OverloadGuard's stale-degrade path both serve from it).
+    #: 0 disables caching — shed range queries then 503.
+    range_cache: int = 32
+    #: Follower read replicas for the range scatter, comma-separated
+    #: ``child=url`` pairs: when a child fails its range query (or its
+    #: range breaker is open) the parent retries against the child's
+    #: replica — the PR-7 follower tier serving as the read path's
+    #: standby.  "" = no replicas.
+    range_replicas: str = ""
     #: Fault-injection scenario for chaos drills ("" = off) — wraps the
     #: configured source in ChaosSource (grammar: sources/chaos.py, e.g.
     #: ``latency:p=0.3,ms=800;flap:period=6;seed=42``).  Drill tool;
@@ -425,6 +460,13 @@ _ENV_MAP = {
     "federate_stale_budget": "TPUDASH_FEDERATE_STALE_BUDGET",
     "federate_hedge": "TPUDASH_FEDERATE_HEDGE",
     "alert_dwell": "TPUDASH_ALERT_DWELL",
+    "rules": "TPUDASH_RULES",
+    "rules_max_groups": "TPUDASH_RULES_MAX_GROUPS",
+    "sketch_budget": "TPUDASH_SKETCH_BUDGET",
+    "sketch_series": "TPUDASH_SKETCH_SERIES",
+    "range_deadline": "TPUDASH_RANGE_DEADLINE",
+    "range_cache": "TPUDASH_RANGE_CACHE",
+    "range_replicas": "TPUDASH_RANGE_REPLICAS",
     "anomaly": "TPUDASH_ANOMALY",
     "anomaly_baseline_window": "TPUDASH_ANOMALY_BASELINE_WINDOW",
     "anomaly_score_threshold": "TPUDASH_ANOMALY_SCORE_THRESHOLD",

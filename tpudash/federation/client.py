@@ -77,3 +77,44 @@ class HttpSummaryClient:
                 f"summary fetch failed: HTTP {resp.status_code}: {e}"
             ) from e
         return SummaryResult(doc=doc, etag=resp.headers.get("ETag"))
+
+
+class HttpRangeClient:
+    """One scatter-gather range poll: ``GET <url>/api/range?merge=state``
+    returning the child's mergeable per-bucket aggregation state
+    (tpudash.analytics.executor).  Same posture as the summary client —
+    blocking ``requests`` per call (hedged attempts run truly
+    concurrent on their own dispatch threads), the parent's bearer
+    token, SourceError on anything that isn't a parseable 200."""
+
+    def __init__(self, url: str, auth_token: str = ""):
+        self.base = url.rstrip("/")
+        self.auth_token = auth_token
+
+    def fetch(self, params: dict, timeout: float) -> dict:
+        import requests
+
+        headers = {"Accept-Encoding": "gzip"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        q = {"merge": "state"}
+        q.update({k: str(v) for k, v in params.items() if v is not None})
+        try:
+            resp = requests.get(
+                f"{self.base}/api/range",
+                params=q,
+                headers=headers,
+                timeout=timeout,
+            )
+            resp.raise_for_status()
+            doc = resp.json()
+        except requests.RequestException as e:
+            raise SourceError(f"range fetch failed: {e}") from e
+        except ValueError as e:
+            raise SourceError(f"range fetch returned non-JSON: {e}") from e
+        from tpudash.analytics.executor import parse_state_doc
+
+        try:
+            return parse_state_doc(doc)
+        except ValueError as e:
+            raise SourceError(f"malformed range state: {e}") from e

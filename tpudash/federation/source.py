@@ -37,7 +37,11 @@ import threading
 import time
 
 from tpudash.config import Config
-from tpudash.federation.client import HttpSummaryClient, SummaryResult
+from tpudash.federation.client import (
+    HttpRangeClient,
+    HttpSummaryClient,
+    SummaryResult,
+)
 from tpudash.federation.summary import digest_alerts, summary_to_batch
 from tpudash.schema import SampleBatch
 from tpudash.sources.base import MetricsSource, SourceError
@@ -86,6 +90,27 @@ class ChildSpec:
             tail = url.split("://", 1)[-1].split("/", 1)[0]
             name = tail.replace(":", "-") or "child"
         return cls(name=name, url=url)
+
+
+def parse_replicas(spec: str) -> "dict[str, str]":
+    """``child=url,...`` — follower read replicas for the range scatter
+    (TPUDASH_RANGE_REPLICAS).  Unknown child names are validated by the
+    caller (the source knows its children)."""
+    out: "dict[str, str]" = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(
+                f"bad range replica {item!r} (grammar: child=url,...)"
+            )
+        name, url = item.split("=", 1)
+        name, url = name.strip(), url.strip().rstrip("/")
+        if not name or not url:
+            raise ValueError(f"bad range replica {item!r}")
+        out[name] = url
+    return out
 
 
 def parse_children(spec: str) -> "list[ChildSpec]":
@@ -194,6 +219,42 @@ class FederatedSource(MetricsSource):
         self.breakers: "dict[str, CircuitBreaker]" = {
             st.spec.name: CircuitBreaker(policy, clock=clock)
             for st in self._children
+        }
+        # the range scatter (PR 13) runs under the SAME breaker policy
+        # but its own instances: an expensive analytical query timing
+        # out must quarantine the child's RANGE plane, not darken its
+        # perfectly healthy summary feed in the fleet frame
+        self.range_breakers: "dict[str, CircuitBreaker]" = {
+            st.spec.name: CircuitBreaker(policy, clock=clock)
+            for st in self._children
+        }
+        self._range_clients = {
+            st.spec.name: HttpRangeClient(st.spec.url, cfg.auth_token)
+            for st in self._children
+        }
+        #: follower read replicas (TPUDASH_RANGE_REPLICAS): tried when a
+        #: child's range query fails or its range breaker is open
+        self._replica_clients: "dict[str, object]" = {}
+        try:
+            for name, url in parse_replicas(
+                getattr(cfg, "range_replicas", "") or ""
+            ).items():
+                if name in self._range_clients:
+                    self._replica_clients[name] = HttpRangeClient(
+                        url, cfg.auth_token
+                    )
+                else:
+                    log.warning(
+                        "range replica for unknown child %r ignored", name
+                    )
+        except ValueError as e:
+            log.warning("bad TPUDASH_RANGE_REPLICAS: %s", e)
+        self.range_counters = {
+            "scatters": 0,
+            "child_errors": 0,
+            "replica_serves": 0,
+            "hedges": 0,
+            "hedge_wins": 0,
         }
         self.last_errors: "dict[str, str]" = {}
         self._last_fault: "dict[str, str]" = {}
@@ -438,6 +499,211 @@ class FederatedSource(MetricsSource):
         if len(batches) == 1:
             return batches[0]
         return SampleBatch.concat(batches)
+
+    # -- federated scatter-gather range queries (PR 13) ----------------------
+    @property
+    def range_deadline(self) -> float:
+        return getattr(self.cfg, "range_deadline", 0.0) or self.deadline
+
+    def _hedged_fetch(self, call, deadline: float, hedge: float):
+        """Generic twin of :meth:`_poll_child`: primary attempt, hedged
+        second attempt after the hedge delay, first success wins.  Runs
+        on the dispatch thread."""
+        end = time.monotonic() + deadline
+        primary = _FetchTask(call)
+        tasks = [primary]
+        backup = None
+        if hedge > 0 and not primary.wait(hedge):
+            with self._lock:
+                self.range_counters["hedges"] += 1
+            backup = _FetchTask(call)
+            tasks.append(backup)
+        errors: "list[str]" = []
+        while tasks:
+            for t in list(tasks):
+                if not t.done():
+                    continue
+                tasks.remove(t)
+                try:
+                    res = t.result()
+                except SourceError as e:  # noqa: PERF203 — per-attempt verdict
+                    errors.append(str(e))
+                    continue
+                if t is backup:
+                    with self._lock:
+                        self.range_counters["hedge_wins"] += 1
+                return res
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                break
+            if tasks:
+                tasks[0].wait(min(0.05, remaining))
+        if errors:
+            raise SourceError("; ".join(errors))
+        raise SourceError(f"no response within the {deadline:g}s deadline")
+
+    def scatter_range(
+        self, params: dict, child: "str | None" = None
+    ) -> dict:
+        """Scatter one range query to the children (or one named child)
+        and gather their mergeable state documents.  Blocking — the
+        server calls this in the executor.
+
+        The degrade contract mirrors the summary fan-in: per-child
+        deadline paid once (children run concurrently), per-child RANGE
+        breakers (an open one skips the child at zero cost and tries
+        its replica), hedged second requests, and a follower replica
+        retry for children that fail outright.  Returns::
+
+            {"states": [state_doc, ...],
+             "children": {name: {"status": "ok"|"replica"|"dark",
+                                  "staleness_s": ..., "error": ...}},
+             "partial": bool}
+
+        Raises nothing for child failures — a dark child degrades the
+        answer (``partial`` + its entry), never errors it; the caller
+        decides what an EMPTY gather means (the server still serves
+        its local store, and only then 503s)."""
+        deadline = self.range_deadline
+        hedge = min(self.hedge, deadline * 0.75) if self.hedge > 0 else 0.0
+        now_m = self._clock()
+        with self._lock:
+            self.range_counters["scatters"] += 1
+        targets = [
+            st for st in self._children
+            if child is None or st.spec.name == child
+        ]
+        accounting: "dict[str, dict]" = {}
+        with self._lock:
+            staleness = {
+                st.spec.name: self._child_status(st, now_m)
+                for st in targets
+            }
+        pending: "list[tuple[str, _FetchTask]]" = []
+        need_replica: "list[tuple[str, str]]" = []  # (name, reason)
+        for st in targets:
+            name = st.spec.name
+            breaker = self.range_breakers[name]
+            if not breaker.allow():
+                need_replica.append(
+                    (
+                        name,
+                        f"range circuit open "
+                        f"({breaker.cooldown_remaining:.1f}s until probe)",
+                    )
+                )
+                continue
+            client = self._range_clients[name]
+            per_child = dict(params)
+            pending.append(
+                (
+                    name,
+                    _FetchTask(
+                        functools.partial(
+                            self._hedged_fetch,
+                            functools.partial(client.fetch, per_child, deadline),
+                            deadline,
+                            hedge,
+                        )
+                    ),
+                )
+            )
+        states: "list[dict]" = []
+        end = time.monotonic() + deadline + 0.25
+        for _, fut in pending:
+            fut.wait(max(0.0, end - time.monotonic()))
+        for name, fut in pending:
+            breaker = self.range_breakers[name]
+            if not fut.done():
+                # parked past the deadline: the thread is a daemon and
+                # its eventual result is discarded (one-shot task)
+                err = f"no response within the {deadline:g}s deadline"
+                breaker.record_failure()
+                need_replica.append((name, err))
+                continue
+            try:
+                doc = fut.result()
+            except SourceError as e:
+                breaker.record_failure()
+                need_replica.append((name, str(e)))
+                continue
+            breaker.record_success()
+            states.append(doc)
+            accounting[name] = self._range_entry(
+                "ok", staleness.get(name), None, doc
+            )
+        # one replica round for everything that failed or was
+        # quarantined — the follower tier as the read path's standby
+        replica_pending: "list[tuple[str, str, _FetchTask]]" = []
+        for name, reason in need_replica:
+            with self._lock:
+                self.range_counters["child_errors"] += 1
+            rc = self._replica_clients.get(name)
+            if rc is None:
+                accounting[name] = self._range_entry(
+                    "dark", staleness.get(name), reason, None
+                )
+                continue
+            replica_pending.append(
+                (
+                    name,
+                    reason,
+                    _FetchTask(
+                        functools.partial(rc.fetch, dict(params), deadline)
+                    ),
+                )
+            )
+        if replica_pending:
+            end = time.monotonic() + deadline + 0.25
+            for _, _, fut in replica_pending:
+                fut.wait(max(0.0, end - time.monotonic()))
+            for name, reason, fut in replica_pending:
+                err = reason
+                doc = None
+                if fut.done():
+                    try:
+                        doc = fut.result()
+                    except SourceError as e:
+                        err = f"{reason}; replica: {e}"
+                else:
+                    err = f"{reason}; replica: deadline"
+                if doc is not None:
+                    with self._lock:
+                        self.range_counters["replica_serves"] += 1
+                    states.append(doc)
+                    accounting[name] = self._range_entry(
+                        "replica", staleness.get(name), reason, doc
+                    )
+                else:
+                    accounting[name] = self._range_entry(
+                        "dark", staleness.get(name), err, None
+                    )
+        return {
+            "states": states,
+            "children": accounting,
+            "partial": any(
+                c["status"] != "ok" for c in accounting.values()
+            ),
+        }
+
+    @staticmethod
+    def _range_entry(status, staleness, error, doc) -> dict:
+        entry: dict = {"status": status}
+        if staleness is not None:
+            st, s = staleness
+            if st == STATUS_DARK and s == float("inf"):
+                # the summary plane simply hasn't polled yet (idle
+                # parent, demand-driven stack) — that is not a verdict
+                st = "unknown"
+            entry["summary_status"] = st
+            entry["staleness_s"] = (
+                round(s, 3) if s != float("inf") else None
+            )
+        if error:
+            entry["error"] = error
+        if doc is not None:
+            entry["resolution"] = doc.get("resolution")
+        return entry
 
     # -- observability (compose / healthz / alerts read these) ---------------
     def federation_summary(self) -> dict:

@@ -43,9 +43,11 @@ from tpudash.tsdb.store import (
     _MAGIC,
     _REC_BLOCK,
     _REC_ROLLUP,
+    _REC_SKETCH,
     TSDB,
     _parse_block,
     _parse_rollup,
+    _parse_sketch,
 )
 
 log = logging.getLogger(__name__)
@@ -264,6 +266,15 @@ class FollowerTSDB(TSDB):
                         # real sample by up to a bucket — lag is measured
                         # against raw block stamps only
                         records += 1
+                elif rec_type == _REC_SKETCH:
+                    s = _parse_sketch(payload)
+                    if s.tier_ms in self._sketches:
+                        with self._lock:
+                            self._sketches[s.tier_ms].append(s)
+                        records += 1
+                # unknown record types (newer leader): skip the framed
+                # payload, keep tailing — version skew must not poison
+                # the file
             except (ValueError, KeyError, struct.error) as e:
                 stuck = f"unparseable payload: {e}"
                 break

@@ -2,9 +2,11 @@
 aggregate choice, point budget.
 
 The one read surface everything shares — the sparkline builder
-(service._trends), the drill-down chip trends, and ``GET /api/range``
-all call :func:`range_query`, so resolution selection and step
-alignment have exactly one implementation to test.
+(service._trends), the drill-down chip trends, ``GET /api/range``, and
+the analytics plane's mergeable state builder
+(tpudash/analytics/executor.py) all resolve their window through
+:func:`resolve_window`, so resolution selection and step alignment have
+exactly one implementation to test.
 
 Resolution selection: the finest tier still *covering the window's
 start* wins — raw first, then 1m, then 10m — except that a step wide
@@ -13,6 +15,21 @@ answer is identical (rollups are exact min/max/sum/count) and the read
 decodes 60–600× fewer points.  When nothing covers the start (asked
 for more history than exists), the tier reaching furthest back serves
 what it has — a shorter graph, never an error.
+
+Quantile aggregates (``p50``/``p95``/``p99``) answer from the sealed
+quantile sketches (tpudash/analytics/sketch.py) via
+``store.sketch_series_window`` — per-bucket digests merged per step,
+never a raw-tier decode on sketch-covered windows — at 1m resolution or
+coarser (a digest cannot be split finer than its bucket).  A query with
+no ``chip`` is the FLEET DISTRIBUTION: every real chip's samples,
+which is what "fleet p99 duty cycle" means.
+
+Step grids on rollup tiers are EPOCH-anchored (``bt // step * step``)
+and the first emitted bucket clamps its timestamp into the request
+window: an unaligned ``start`` must neither emit a bucket stamped
+before ``start`` nor silently fold a whole out-of-window rollup bucket
+into the first in-window one (the PR-13 alignment fix, regression-
+pinned in tests/test_analytics.py).
 
 The point budget is a hard ceiling: a query whose natural resolution
 would return more than ``max_points`` is step-widened until it fits,
@@ -23,9 +40,13 @@ decode) an unbounded payload.
 from __future__ import annotations
 
 from tpudash.tsdb import gorilla
-from tpudash.tsdb.rollup import TIER_1M_MS, TIER_10M_MS
+from tpudash.tsdb.rollup import ALL_KEY, TIER_1M_MS, TIER_10M_MS
 
-AGGREGATES = ("mean", "min", "max")
+#: ``agg=`` values ``/api/range`` accepts
+AGGREGATES = ("mean", "min", "max", "p50", "p95", "p99")
+
+#: quantile aggregates → rank; answered from sketches, not quads
+QUANTILE_AGGS = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
 
 #: default / ceiling for one query's returned points per column
 DEFAULT_POINTS = 500
@@ -60,8 +81,80 @@ def _choose_tier(store, start_ms: int, step_ms: int) -> int:
     return min(candidates)[1]
 
 
+def resolve_window(
+    store,
+    start_s: "float | None",
+    end_s: "float | None",
+    step_s: "float | None",
+    max_points: int,
+    agg: str = "mean",
+) -> dict:
+    """Resolve one query's effective window, step, and tier — shared by
+    :func:`range_query` and the analytics state builder so the two
+    paths can never disagree about alignment.  Returns ``{"start_ms",
+    "end_ms", "step_ms", "tier", "resolution", "empty"}``; raises
+    ValueError on a bad window."""
+    if agg not in AGGREGATES:
+        raise ValueError(f"agg must be one of {AGGREGATES}, not {agg!r}")
+    max_points = max(1, min(int(max_points), MAX_POINTS))
+    latest = store.latest_ms()
+    end_ms = gorilla.ts_to_ms(end_s) if end_s is not None else latest
+    if end_ms is None:
+        return {
+            "start_ms": int((start_s or 0.0) * 1000),
+            "end_ms": int((end_s or 0.0) * 1000),
+            "step_ms": int((step_s or 0.0) * 1000),
+            "tier": 0,
+            "resolution": "raw",
+            "empty": True,
+        }
+    start_ms = (
+        gorilla.ts_to_ms(start_s)
+        if start_s is not None
+        else end_ms - 3_600_000
+    )
+    if end_ms < start_ms:
+        raise ValueError("end precedes start")
+    window = max(1, end_ms - start_ms)
+    step_ms = int(step_s * 1000) if step_s else 0
+    if step_ms < 0:
+        raise ValueError("step must be positive")
+    # the budget is a ceiling, whatever step the caller asked for.
+    # Epoch-anchored grids can emit one extra boundary bucket (a
+    # partial at each window edge), so the divisor is max_points − 1
+    min_step = -(-window // max(1, max_points - 1))  # ceil
+    if step_ms and step_ms < min_step:
+        step_ms = min_step
+    if agg in QUANTILE_AGGS:
+        # a digest cannot be split finer than its bucket: quantile
+        # queries are 1m-resolution at finest, whatever the step asked
+        step_ms = max(step_ms or 0, TIER_1M_MS, min_step)
+    tier = _choose_tier(store, start_ms, step_ms)
+    if tier != 0:
+        if step_ms < tier:
+            step_ms = tier  # a rollup can't answer finer than its bucket
+        if step_ms < min_step:
+            # the budget is a ceiling on EVERY tier: a 30-day stepless
+            # query must not ship window/tier (~4300) bucket points just
+            # because the rollup resolution happens to be fine
+            step_ms = min_step
+    return {
+        "start_ms": start_ms,
+        "end_ms": end_ms,
+        "step_ms": step_ms,
+        "tier": tier,
+        "resolution": _TIER_NAME[tier],
+        "empty": False,
+    }
+
+
 def _aggregate_raw(points, start_ms, end_ms, step_ms, agg):
-    """Step-align raw (ts, value) points; NaN samples are skipped."""
+    """Step-align raw (ts, value) points; NaN samples are skipped.
+    Same EPOCH-anchored grid as the rollup and sketch paths (and the
+    analytics state executor), so a child answering directly and a
+    parent merging that child's state can never disagree about bucket
+    timestamps.  Raw points are window-filtered individually, so only
+    the first bucket's STAMP needs the clamp."""
     if step_ms <= 0:
         return [
             (gorilla.ms_to_ts(t), v) for t, v in points if v == v
@@ -70,7 +163,7 @@ def _aggregate_raw(points, start_ms, end_ms, step_ms, agg):
     for t, v in points:
         if v != v:
             continue
-        b = start_ms + (t - start_ms) // step_ms * step_ms
+        b = t // step_ms * step_ms
         cur = buckets.get(b)
         if cur is None:
             buckets[b] = [v, v, v, 1]
@@ -81,18 +174,21 @@ def _aggregate_raw(points, start_ms, end_ms, step_ms, agg):
                 cur[1] = v
             cur[2] += v
             cur[3] += 1
-    return _emit(buckets, agg)
+    return _emit(buckets, agg, start_ms)
 
 
 def _aggregate_quads(quads, start_ms, step_ms, agg):
     """Step-align rollup quads — exact: min of mins, max of maxes,
-    sum/count for the mean.  A source bucket that STARTED before the
-    window (but reaches into it) clamps to the first step bucket, so
-    emitted timestamps always lie inside [start, end]."""
+    sum/count for the mean — on an EPOCH-anchored grid, each source
+    bucket assigned by its own start.  The pre-fix behavior clamped a
+    source bucket that STARTED before the window into the same step
+    bucket as the first in-window one, so an unaligned ``start`` got a
+    first value whose data window preceded the request; now the
+    pre-start bucket keeps its own grid slot and only its emitted
+    TIMESTAMP clamps to ``start`` (via :func:`_emit`)."""
     buckets: dict = {}
     for bt, mn, mx, sm, cnt in quads:
-        off = bt - start_ms
-        b = start_ms if off < 0 else start_ms + off // step_ms * step_ms
+        b = bt // step_ms * step_ms
         cur = buckets.get(b)
         if cur is None:
             buckets[b] = [mn, mx, sm, cnt]
@@ -103,10 +199,30 @@ def _aggregate_quads(quads, start_ms, step_ms, agg):
                 cur[1] = mx
             cur[2] += sm
             cur[3] += cnt
-    return _emit(buckets, agg)
+    return _emit(buckets, agg, start_ms)
 
 
-def _emit(buckets: dict, agg: str):
+def _aggregate_sketches(digests, start_ms, step_ms, q):
+    """Step-align per-tier-bucket digests: merge every digest landing
+    in one epoch-anchored step bucket, emit its quantile.  Same grid
+    and first-bucket clamp as the quads path."""
+    from tpudash.analytics.sketch import QuantileSketch
+
+    buckets: dict = {}
+    for bt, sk in digests:
+        buckets.setdefault(bt // step_ms * step_ms, []).append(sk)
+    out = []
+    for b in sorted(buckets):
+        sks = buckets[b]
+        sk = sks[0] if len(sks) == 1 else QuantileSketch.merged(sks)
+        v = sk.quantile(q)
+        if v != v:
+            continue
+        out.append((gorilla.ms_to_ts(max(b, start_ms)), v))
+    return out
+
+
+def _emit(buckets: dict, agg: str, start_ms: int = 0):
     out = []
     for b in sorted(buckets):
         mn, mx, sm, cnt = buckets[b]
@@ -118,7 +234,10 @@ def _emit(buckets: dict, agg: str):
             v = mx
         else:
             v = sm / cnt
-        out.append((gorilla.ms_to_ts(b), v))
+        # the epoch-anchored grid may slot data into a bucket starting
+        # before the window (its tail reaches in): report it AT the
+        # window edge, never before it
+        out.append((gorilla.ms_to_ts(max(b, start_ms)), v))
     return out
 
 
@@ -138,14 +257,14 @@ def range_query(
     "start_s", "end_s", "step_s", "agg"}``.  Defaults: ``end`` = the
     store's newest sample, ``start`` = end − 1h, ``cols`` = every
     column the series carries, ``step`` = whatever fits the budget.
-    Raises ValueError on a bad aggregate/window (the HTTP layer maps
-    it to 400)."""
-    if agg not in AGGREGATES:
-        raise ValueError(f"agg must be one of {AGGREGATES}, not {agg!r}")
-    max_points = max(1, min(int(max_points), MAX_POINTS))
-    latest = store.latest_ms()
-    end_ms = gorilla.ts_to_ms(end_s) if end_s is not None else latest
-    if end_ms is None:
+    ``agg=p50|p95|p99`` serves quantiles from the sketch rollups; with
+    ``key = FLEET_SERIES`` that is the fleet DISTRIBUTION (all chips'
+    samples), not the fleet-average row.  Raises ValueError on a bad
+    aggregate/window (the HTTP layer maps it to 400)."""
+    from tpudash.tsdb.store import FLEET_SERIES
+
+    win = resolve_window(store, start_s, end_s, step_s, max_points, agg)
+    if win["empty"]:
         # empty store: a well-formed empty answer, not an error
         return {
             "series": {c: [] for c in (cols or [])},
@@ -155,35 +274,25 @@ def range_query(
             "step_s": step_s or 0.0,
             "agg": agg,
         }
-    start_ms = (
-        gorilla.ts_to_ms(start_s)
-        if start_s is not None
-        else end_ms - 3_600_000
-    )
-    if end_ms < start_ms:
-        raise ValueError("end precedes start")
+    start_ms, end_ms = win["start_ms"], win["end_ms"]
+    step_ms, tier = win["step_ms"], win["tier"]
+    max_points = max(1, min(int(max_points), MAX_POINTS))
     window = max(1, end_ms - start_ms)
-    step_ms = int(step_s * 1000) if step_s else 0
-    if step_ms < 0:
-        raise ValueError("step must be positive")
-    # the budget is a ceiling, whatever step the caller asked for
-    min_step = -(-window // max_points)  # ceil
-    if step_ms and step_ms < min_step:
-        step_ms = min_step
-    tier = _choose_tier(store, start_ms, step_ms)
-    if tier != 0:
-        if step_ms < tier:
-            step_ms = tier  # a rollup can't answer finer than its bucket
-        if step_ms < min_step:
-            # the budget is a ceiling on EVERY tier: a 30-day stepless
-            # query must not ship window/tier (~4300) bucket points just
-            # because the rollup resolution happens to be fine
-            step_ms = min_step
+    min_step = -(-window // max(1, max_points - 1))
+    q = QUANTILE_AGGS.get(agg)
     if cols is None:
         cols = store.series_cols(key)
     series: dict = {}
     for col in cols:
-        if tier == 0:
+        if q is not None:
+            sk_key = ALL_KEY if key == FLEET_SERIES else key
+            digests = store.sketch_series_window(
+                tier, sk_key, col, start_ms, end_ms
+            )
+            series[col] = _aggregate_sketches(
+                digests, start_ms, max(step_ms, TIER_1M_MS), q
+            )
+        elif tier == 0:
             pts = store.raw_window(key, col, start_ms, end_ms)
             eff_step = step_ms
             if not eff_step and len(pts) > max_points:
@@ -198,7 +307,7 @@ def range_query(
             )
     return {
         "series": series,
-        "resolution": _TIER_NAME[tier],
+        "resolution": win["resolution"],
         "start_s": start_ms / 1000.0,
         "end_s": end_ms / 1000.0,
         "step_s": (step_ms or 0) / 1000.0,

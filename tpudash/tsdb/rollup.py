@@ -30,6 +30,14 @@ TIER_1M_MS = 60_000
 TIER_10M_MS = 600_000
 TIERS_MS = (TIER_1M_MS, TIER_10M_MS)
 
+#: pseudo series key for the CROSS-SERIES (fleet-distribution) sketch:
+#: every real chip's samples of one bucket folded into one digest.
+#: ``__``-prefixed like the other pseudo keys, so it can never collide
+#: with a real ``slice/chip`` key — and ``__``-prefixed series (the
+#: fleet-average row, recording-rule outputs) are excluded FROM it,
+#: or derived series would double-count the chips they summarize.
+ALL_KEY = "__all__"
+
 
 class RollupBlock:
     """Aggregates of one raw block for one tier: ``buckets`` (int64
@@ -128,6 +136,113 @@ def rollup_points(tier_ms, ts_ms, keys, cols, stacked) -> "RollupBlock | None":
         int(ts.min()),
         int(ts.max()),
     )
+
+
+class SketchBlock:
+    """Quantile-sketch shadow of one sealed raw block for one tier: per
+    ``buckets[b]`` × ``keys[k]`` × ``cols[c]`` a serialized
+    :class:`tpudash.analytics.sketch.QuantileSketch` (or None when the
+    bucket carried no finite sample for that series).  ``keys`` always
+    ends with :data:`ALL_KEY` — the fleet-distribution digest — and
+    carries the real per-series keys only on tiers configured for them
+    (``TPUDASH_SKETCH_SERIES``).  Immutable once built; digests stay
+    serialized until a query touches them (a block's worth of parsed
+    sketches would cost far more memory than the bytes do)."""
+
+    __slots__ = ("tier_ms", "buckets", "keys", "cols", "enc",
+                 "src_t0", "src_t1", "_key_pos")
+
+    def __init__(self, tier_ms, buckets, keys, cols, enc, src_t0, src_t1):
+        self.tier_ms = int(tier_ms)
+        self.buckets = buckets
+        self.keys = list(keys)
+        self.cols = list(cols)
+        #: enc[b][k][c] -> bytes | None
+        self.enc = enc
+        self.src_t0 = int(src_t0)
+        self.src_t1 = int(src_t1)
+        self._key_pos = None
+
+    @property
+    def t1(self) -> int:
+        if not len(self.buckets):
+            return 0
+        return int(self.buckets[-1]) + self.tier_ms - 1
+
+    def nbytes(self) -> int:
+        return sum(
+            len(e) for row in self.enc for cells in row for e in cells if e
+        )
+
+    def series(self, key: str, col: str):
+        """[(bucket_ms, serialized_digest)] for one series; [] when the
+        block does not carry it (per-series sketches off for this tier,
+        or series churn)."""
+        if self._key_pos is None:
+            self._key_pos = {k: i for i, k in enumerate(self.keys)}
+        ki = self._key_pos.get(key)
+        if ki is None or col not in self.cols:
+            return []
+        ci = self.cols.index(col)
+        out = []
+        for b in range(len(self.buckets)):
+            raw = self.enc[b][ki][ci]
+            if raw:
+                out.append((int(self.buckets[b]), raw))
+        return out
+
+
+def sketch_points(
+    tier_ms, ts_ms, keys, cols, stacked, budget: int,
+    per_series: bool,
+) -> "SketchBlock | None":
+    """Digest a (n, K, C) float stack into one SketchBlock: per bucket
+    per column the fleet-distribution digest (:data:`ALL_KEY`, real
+    chips only) plus — when ``per_series`` — each series' own temporal
+    digest.  NaN cells contribute nothing, mirroring the quads."""
+    n = len(ts_ms)
+    if n == 0 or budget <= 0:
+        return None
+    ts = np.asarray(ts_ms, dtype=np.int64)
+    bucket_ids = ts // tier_ms
+    uniq = np.unique(bucket_ids)
+    K, C = stacked.shape[1], stacked.shape[2]
+    real = [k for k in range(K) if not str(keys[k]).startswith("__")]
+    out_keys = (list(keys) if per_series else []) + [ALL_KEY]
+    enc: list = []
+    for b in uniq:
+        rows = stacked[bucket_ids == b]  # (nb, K, C)
+        per_bucket: list = []
+        if per_series:
+            for k in range(K):
+                per_bucket.append([
+                    _enc_or_none(rows[:, k, c], budget) for c in range(C)
+                ])
+        if real:
+            per_bucket.append([
+                _enc_or_none(rows[:, real, c], budget) for c in range(C)
+            ])
+        else:
+            per_bucket.append([None] * C)
+        enc.append(per_bucket)
+    if not per_series and not real:
+        return None  # nothing but pseudo series: no digest to keep
+    return SketchBlock(
+        tier_ms,
+        (uniq * tier_ms).astype(np.int64),
+        out_keys,
+        cols,
+        enc,
+        int(ts.min()),
+        int(ts.max()),
+    )
+
+
+def _enc_or_none(values, budget: int) -> "bytes | None":
+    from tpudash.analytics.sketch import QuantileSketch
+
+    sk = QuantileSketch.from_values(values, budget)
+    return sk.to_bytes() if sk.count > 0 else None
 
 
 def merge_quads(quads) -> "list[tuple]":

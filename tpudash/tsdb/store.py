@@ -53,11 +53,14 @@ import numpy as np
 
 from tpudash.tsdb import gorilla
 from tpudash.tsdb.rollup import (
+    ALL_KEY,
     TIER_1M_MS,
     TIER_10M_MS,
     TIERS_MS,
     RollupBlock,
+    SketchBlock,
     rollup_points,
+    sketch_points,
 )
 
 log = logging.getLogger(__name__)
@@ -70,6 +73,16 @@ FLEET_SERIES = "__fleet__"
 _MAGIC = b"TSB1"
 _REC_BLOCK = 1
 _REC_ROLLUP = 2
+#: PR-13 record type: quantile-sketch shadows beside the rollup quads.
+#: Pre-13 readers walk past unknown record types (their loader only
+#: dispatches on 1/2 and advances by the framed length), so a segment
+#: directory stays readable in BOTH directions across the upgrade; a
+#: new reader meeting a pre-13 directory backfills sketches from raw on
+#: its first seal instead of refusing (see _maybe_backfill_sketches).
+#: 4, not 3: snapshot.py already spent 3 on its MANIFEST record inside
+#: the shared TSB1 framing — record types stay globally unique so any
+#: tool can dispatch on type alone, whichever file it is reading.
+_REC_SKETCH = 4
 _FRAME_HDR = struct.Struct("<4sBII")  # magic, type, payload len, crc32
 
 #: segment rotation threshold — whole files are the retention unit, so
@@ -223,6 +236,75 @@ def _parse_rollup(payload: bytes) -> RollupBlock:
     )
 
 
+def _sketch_payload(s: SketchBlock) -> bytes:
+    """Serialize one SketchBlock: JSON header (tier/keys/cols/bucket
+    count/src bounds + the per-cell digest lengths, 0 = no digest) then
+    the digests concatenated bucket-major.  Deterministic — the same
+    block always produces the same bytes (the byte-stability the
+    restart/replication tests pin rides on this)."""
+    lens: "list[int]" = []
+    blobs: "list[bytes]" = []
+    for per_bucket in s.enc:
+        for cells in per_bucket:
+            for e in cells:
+                if e:
+                    lens.append(len(e))
+                    blobs.append(e)
+                else:
+                    lens.append(0)
+    header = json.dumps(
+        {
+            "tier": s.tier_ms,
+            "k": s.keys,
+            "c": s.cols,
+            "nb": int(len(s.buckets)),
+            "s0": s.src_t0,
+            "s1": s.src_t1,
+            "sl": lens,
+        },
+        separators=(",", ":"),
+    ).encode()
+    return (
+        struct.pack("<I", len(header))
+        + header
+        + np.ascontiguousarray(s.buckets, dtype=np.int64).tobytes()
+        + b"".join(blobs)
+    )
+
+
+def _parse_sketch(payload: bytes) -> SketchBlock:
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    header = json.loads(payload[4 : 4 + hlen])
+    off = 4 + hlen
+    nb = int(header["nb"])
+    keys, cols = header["k"], header["c"]
+    K, C = len(keys), len(cols)
+    buckets = np.frombuffer(payload, dtype=np.int64, count=nb, offset=off)
+    off += buckets.nbytes
+    lens = header["sl"]
+    if len(lens) != nb * K * C:
+        raise ValueError("sketch record cell count disagrees with header")
+    enc: list = []
+    i = 0
+    for _b in range(nb):
+        per_bucket: list = []
+        for _k in range(K):
+            cells: list = []
+            for _c in range(C):
+                ln = int(lens[i])
+                i += 1
+                if ln <= 0:
+                    cells.append(None)
+                else:
+                    cells.append(payload[off : off + ln])
+                    off += ln
+            per_bucket.append(cells)
+        enc.append(per_bucket)
+    return SketchBlock(
+        header["tier"], buckets, keys, cols, enc, header["s0"], header["s1"]
+    )
+
+
 class TSDB:
     def __init__(
         self,
@@ -237,8 +319,28 @@ class TSDB:
         snapshot_interval_s: float = 0.0,
         snapshot_keep: int = 5,
         snapshot_retention_s: float = 0.0,
+        sketch_budget: int = 64,
+        sketch_series: str = "10m",
     ) -> None:
         self.path = path
+        #: quantile-sketch rollups (tpudash.analytics.sketch): centroid
+        #: budget per digest (0 disables sketching — quantile queries
+        #: then degrade to raw folds / quad pseudo-digests), and which
+        #: tiers keep PER-SERIES digests beside the fleet-distribution
+        #: one: "10m" (default — the cheap tier), "all", or "fleet"
+        #: (cross-series digests only)
+        self.sketch_budget = max(0, int(sketch_budget))
+        self.sketch_series = (
+            sketch_series if sketch_series in ("10m", "all", "fleet") else "10m"
+        )
+        #: recording-rule engine (tpudash.analytics.rules), set by the
+        #: service after construction; evaluated on the seal thread per
+        #: sealed data chunk, outputs appended as first-class
+        #: ``__rule__/`` series blocks
+        self.rule_engine = None
+        #: set when _load met raw blocks the sketch shadow doesn't cover
+        #: (a pre-13 directory): the first seal drain backfills them
+        self._sketch_backfill = False
         #: read-only mode: serve queries over an existing segment set
         #: (another instance's directory, or a snapshot) without ever
         #: appending, persisting, truncating, or reclaiming — the
@@ -289,6 +391,7 @@ class TSDB:
         self._seal_thread: "threading.Thread | None" = None
         self._raw: "list[SealedBlock]" = []
         self._rollups = {t: [] for t in TIERS_MS}
+        self._sketches = {t: [] for t in TIERS_MS}
         # per-tier segment registries: [(seq, path, newest_t1_ms)]
         self._segs = {name: [] for name in _TIER_NAMES.values()}
         self._closed = False
@@ -308,6 +411,8 @@ class TSDB:
             snapshot_interval_s=cfg.tsdb_snapshot_interval,
             snapshot_keep=cfg.tsdb_snapshot_keep,
             snapshot_retention_s=cfg.tsdb_snapshot_retention,
+            sketch_budget=getattr(cfg, "sketch_budget", 64),
+            sketch_series=getattr(cfg, "sketch_series", "10m"),
         )
 
     # -- ingest --------------------------------------------------------------
@@ -370,6 +475,7 @@ class TSDB:
         one chunk.  Encoding and disk writes happen through method
         calls, so nothing blocking sits lexically under the gate."""
         with self._seal_gate:
+            self._maybe_backfill_sketches()
             while True:
                 with self._lock:
                     if not self._pending:
@@ -386,21 +492,156 @@ class TSDB:
                     keys, cols, ts_list, mats = self._pending[0]
                 stacked = np.stack(mats).astype(np.float64)
                 block = _encode_block(keys, cols, ts_list, stacked)
-                rolls = []
-                for tier in TIERS_MS:
-                    r = rollup_points(tier, ts_list, keys, cols, stacked)
-                    if r is not None:
-                        rolls.append(r)
+                rolls, sketches = self._shadow_blocks(
+                    ts_list, keys, cols, stacked
+                )
+                # recording rules (tpudash.analytics.rules): derived
+                # series for this chunk, sealed as first-class blocks of
+                # their own — encoded, rolled up, sketched, persisted,
+                # retained, replicated exactly like scraped data.  The
+                # engine never raises (it degrades to last_error); the
+                # float32 round-trip matches the append path so a rule
+                # evaluated here and a rule value ever re-derived agree
+                # byte-for-byte.
+                derived = None
+                eng = self.rule_engine
+                if eng is not None:
+                    derived = eng.evaluate(ts_list, keys, cols, stacked)
                 with self._lock:
                     self._pending.pop(0)
                     self._raw.append(block)
                     for r in rolls:
                         self._rollups[r.tier_ms].append(r)
+                    for s in sketches:
+                        self._sketches[s.tier_ms].append(s)
                     self.version += 1
                 if self.path and not self.read_only:
-                    self._persist(block, rolls)
+                    self._persist(block, rolls, sketches)
+                if derived is not None:
+                    self._seal_derived(ts_list, derived)
                 self._enforce_retention()
                 self._maybe_autosnapshot()
+
+    def _per_series_tier(self, tier: int) -> bool:
+        """Does this tier keep PER-SERIES sketches beside the fleet
+        digest?  The one predicate seal, backfill, and the coverage
+        check all share — desynchronizing them would re-trigger the
+        one-shot backfill on every restart."""
+        return self.sketch_series == "all" or (
+            self.sketch_series == "10m" and tier == TIER_10M_MS
+        )
+
+    def _shadow_blocks(self, ts_list, keys, cols, stacked):
+        """Rollup + sketch shadows for one chunk (encoding only — no
+        locks, no I/O)."""
+        rolls, sketches = [], []
+        for tier in TIERS_MS:
+            r = rollup_points(tier, ts_list, keys, cols, stacked)
+            if r is not None:
+                rolls.append(r)
+            if self.sketch_budget > 0:
+                s = sketch_points(
+                    tier, ts_list, keys, cols, stacked,
+                    self.sketch_budget, self._per_series_tier(tier),
+                )
+                if s is not None:
+                    sketches.append(s)
+        return rolls, sketches
+
+    def _seal_derived(self, ts_list, derived) -> None:
+        """Commit one chunk's recording-rule output as its own sealed
+        block set.  Rule keys are ``__``-prefixed, so sketch_points
+        keeps them out of the fleet-distribution digest; per-series
+        digests still cover them on the configured tiers (a rule series
+        is range-queryable with agg=p99 like any chip)."""
+        dkeys, dcols, dstack = derived
+        # float32 round-trip: the exact dtype path scraped frames take
+        # through append_frame, so re-deriving a rule value can never
+        # disagree with the sealed bytes over float64 tail digits
+        dstack = np.asarray(dstack, dtype=np.float32).astype(np.float64)
+        dblock = _encode_block(dkeys, dcols, ts_list, dstack)
+        drolls, dsketches = self._shadow_blocks(ts_list, dkeys, dcols, dstack)
+        with self._lock:
+            self._raw.append(dblock)
+            for r in drolls:
+                self._rollups[r.tier_ms].append(r)
+            for s in dsketches:
+                self._sketches[s.tier_ms].append(s)
+            self.version += 1
+        if self.path and not self.read_only:
+            self._persist(dblock, drolls, dsketches)
+
+    def _sketch_possible(self, block_keys, tier: int) -> bool:
+        """Can sketch_points produce ANY output for a block of these
+        keys at this tier?  False for an all-pseudo-series block (e.g.
+        a ``__rule__/``-only derived block) on a tier without
+        per-series digests — such blocks must not count as "uncovered"
+        or the one-shot backfill would re-trigger (and decode them for
+        nothing) on every restart."""
+        if self._per_series_tier(tier):
+            return True
+        return any(not str(k).startswith("__") for k in block_keys)
+
+    def _maybe_backfill_sketches(self) -> None:
+        """PR-13 upgrade path: a directory written before sketches
+        existed loads with raw blocks the sketch shadow doesn't cover.
+        Backfill them HERE — on the seal thread, once, from the raw
+        points (exact digests, not quad approximations) — so quantile
+        queries answer from sketches a drain later, and a pre-13
+        directory is never refused and never permanently second-class.
+        Raw that already expired can't be backfilled; those windows keep
+        answering through the quad pseudo-digest fallback."""
+        if not self._sketch_backfill or self.sketch_budget <= 0:
+            return
+        self._sketch_backfill = False
+        with self._lock:
+            blocks = list(self._raw)
+            covered = {
+                t: [(s.src_t0, s.src_t1) for s in self._sketches[t]]
+                for t in TIERS_MS
+            }
+        made = 0
+        for b in blocks:
+            missing = [
+                t for t in TIERS_MS
+                if self._sketch_possible(b.keys, t)
+                and not any(
+                    lo <= b.t0 and b.t1 <= hi for lo, hi in covered[t]
+                )
+            ]
+            if not missing:
+                continue
+            ts_list = b.timestamps()
+            stacked = np.empty(
+                (b.count, len(b.keys), len(b.cols)), dtype=np.float64
+            )
+            for ki in range(len(b.keys)):
+                for ci in range(len(b.cols)):
+                    stacked[:, ki, ci] = gorilla.decode_values(
+                        b.val_enc[ki * len(b.cols) + ci], b.count
+                    )
+            news = []
+            for tier in missing:
+                s = sketch_points(
+                    tier, ts_list, b.keys, b.cols, stacked,
+                    self.sketch_budget, self._per_series_tier(tier),
+                )
+                if s is not None:
+                    news.append(s)
+            if not news:
+                continue
+            made += len(news)
+            with self._lock:
+                for s in news:
+                    self._sketches[s.tier_ms].append(s)
+                self.version += 1
+            if self.path and not self.read_only:
+                self._persist(None, [], news)
+        if made:
+            log.info(
+                "tsdb backfilled %d sketch blocks from pre-sketch raw "
+                "segments", made,
+            )
 
     def flush(self, seal_partial: bool = False) -> None:
         """Synchronously seal everything pending (and, with
@@ -461,17 +702,26 @@ class TSDB:
         return _TIER_NAMES[tier_ms]
 
     # tpulint: allow[blocking-under-lock] dedicated segment-I/O lock (save_history pattern), never the in-memory lock
-    def _persist(self, block: SealedBlock, rolls) -> None:
+    def _persist(self, block: "SealedBlock | None", rolls, sketches=()) -> None:
         with self._io_lock:
             try:
-                self._write_record("raw", _REC_BLOCK, _block_payload(block),
-                                   block.t1)
+                if block is not None:
+                    self._write_record(
+                        "raw", _REC_BLOCK, _block_payload(block), block.t1
+                    )
                 for r in rolls:
                     self._write_record(
                         self._tier_name(r.tier_ms),
                         _REC_ROLLUP,
                         _rollup_payload(r),
                         r.t1,
+                    )
+                for s in sketches:
+                    self._write_record(
+                        self._tier_name(s.tier_ms),
+                        _REC_SKETCH,
+                        _sketch_payload(s),
+                        s.t1,
                     )
                 if self.last_disk_error is not None:
                     log.info("tsdb disk writes recovered")
@@ -549,12 +799,29 @@ class TSDB:
         self._enforce_retention()
         n_raw = len(self._raw)
         if n_raw:
+            # pre-13 directory (or one written with sketches disabled):
+            # raw survives that no sketch shadow covers — schedule the
+            # one-shot backfill for the first seal drain
+            if self.sketch_budget > 0 and not self.read_only:
+                spans = {
+                    t: [(s.src_t0, s.src_t1) for s in self._sketches[t]]
+                    for t in TIERS_MS
+                }
+                self._sketch_backfill = any(
+                    self._sketch_possible(b.keys, t)
+                    and not any(
+                        lo <= b.t0 and b.t1 <= hi for lo, hi in spans[t]
+                    )
+                    for b in self._raw
+                    for t in TIERS_MS
+                )
             log.info(
                 "tsdb restored %d raw blocks (%d points) + %d rollup blocks "
-                "from %s",
+                "+ %d sketch blocks from %s",
                 n_raw,
                 sum(b.count for b in self._raw),
                 sum(len(v) for v in self._rollups.values()),
+                sum(len(v) for v in self._sketches.values()),
                 self.path,
             )
 
@@ -587,6 +854,13 @@ class TSDB:
                     if r.tier_ms in self._rollups:
                         self._rollups[r.tier_ms].append(r)
                         newest = max(newest, r.t1)
+                elif rec_type == _REC_SKETCH:
+                    s = _parse_sketch(payload)
+                    if s.tier_ms in self._sketches:
+                        self._sketches[s.tier_ms].append(s)
+                        newest = max(newest, s.t1)
+                # unknown record types from a NEWER writer: skip the
+                # framed payload — same grace pre-13 readers extend us
             except (ValueError, KeyError, json.JSONDecodeError, struct.error):
                 break  # CRC passed but the payload lies: stop trusting
             off += _FRAME_HDR.size + plen
@@ -619,6 +893,9 @@ class TSDB:
                 cut = now - self.retention_ms[tier]
                 self._rollups[tier] = [
                     r for r in self._rollups[tier] if r.t1 >= cut
+                ]
+                self._sketches[tier] = [
+                    s for s in self._sketches[tier] if s.t1 >= cut
                 ]
             self.version += 1
         self._reclaim_segments(now)
@@ -713,6 +990,133 @@ class TSDB:
                     quads.append((t // tier_ms * tier_ms, v, v, v, 1))
         return merge_quads(quads)
 
+    def sketch_series_window(
+        self,
+        tier_ms: int,
+        key: str,
+        col: str,
+        start_ms: int,
+        end_ms: int,
+        quads_by_key: "dict | None" = None,
+    ):
+        """Merged per-tier-bucket quantile digests for one series in
+        the window: ``[(bucket_ms, QuantileSketch)]``, ascending.  The
+        series may be a real chip, a ``__rule__/`` output, the
+        ``__fleet__`` row, or :data:`ALL_KEY` (the fleet distribution).
+
+        Coverage composes three layers, best first:
+
+        1. sealed sketch records of the tier;
+        2. buckets the sketches miss but raw still holds (a tier
+           without per-series digests, a pre-13 directory awaiting
+           backfill): EXACT digests folded from the raw points;
+        3. buckets where raw expired too (old pre-13 rollups): the
+           quad's 3-centroid pseudo-digest — coarse, but an answer,
+           which is the "never refuse a pre-13 dir" contract;
+
+        plus the live tail: raw samples NEWER than the sealed sketch
+        coverage (head/pending, or a chunk sealed after the sketches'
+        span) fold into their buckets even when a sealed digest already
+        partially covers the bucket — the current bucket's p99 must see
+        the newest samples exactly like rollup_window's mean does.
+
+        ``tier_ms`` 0 folds raw at 1m granularity (fine-step queries).
+        ``quads_by_key`` lets a caller that already ran
+        ``rollup_window`` per key (the state executor's hot path) share
+        that pass instead of paying it twice."""
+        from tpudash.analytics.sketch import QuantileSketch, SketchError
+
+        budget = self.sketch_budget or 64
+        tier = tier_ms if tier_ms > 0 else TIER_1M_MS
+        out: "dict[int, list]" = {}
+        covered: set = set()
+        sealed_hi = 0
+        if tier_ms > 0:
+            with self._lock:
+                blocks = [
+                    s for s in self._sketches.get(tier, [])
+                    if s.src_t1 >= start_ms and s.src_t0 <= end_ms
+                ]
+            for blk in blocks:
+                contributed = False
+                for b, raw in blk.series(key, col):
+                    if b + tier - 1 < start_ms or b > end_ms:
+                        continue
+                    try:
+                        sk = QuantileSketch.from_bytes(raw, budget)
+                    except SketchError:
+                        continue  # one bad cell, not a dead query
+                    out.setdefault(b, []).append(sk)
+                    covered.add(b)
+                    contributed = True
+                if contributed:
+                    sealed_hi = max(sealed_hi, blk.src_t1)
+        # rollup_window already folds the live raw tail into quads, so
+        # it doubles as the "which buckets exist at all" oracle
+        if key == ALL_KEY:
+            keys = [
+                k for k in sorted(self.series_keys())
+                if not k.startswith("__")
+            ]
+        else:
+            keys = [key]
+        gaps: "dict[int, list]" = {}
+        for k in keys:
+            quads = (
+                quads_by_key.get(k, ())
+                if quads_by_key is not None
+                else self.rollup_window(tier, k, col, start_ms, end_ms)
+            )
+            for bt, mn, mx, sm, cnt in quads:
+                if cnt > 0 and bt not in covered:
+                    gaps.setdefault(bt, []).append((mn, mx, sm, cnt))
+        # live tail for COVERED buckets: samples newer than the sealed
+        # sketch span merge in as an exact partial digest (no overlap —
+        # the sealed digests end at sealed_hi by construction)
+        tail_from = max(start_ms, sealed_hi + 1)
+        tail_vals: "dict[int, list]" = {}
+        if covered and tail_from <= end_ms:
+            for k in keys:
+                for t, v in self.raw_window(k, col, tail_from, end_ms):
+                    if v == v:
+                        b = t // tier * tier
+                        if b in covered:
+                            tail_vals.setdefault(b, []).append(v)
+        if gaps:
+            lo = max(min(gaps), start_ms)
+            hi = min(max(gaps) + tier - 1, end_ms)
+            vals: "dict[int, list]" = {}
+            for k in keys:
+                for t, v in self.raw_window(k, col, lo, hi):
+                    if v == v:
+                        b = t // tier * tier
+                        if b in gaps:
+                            vals.setdefault(b, []).append(v)
+            for b, quads in gaps.items():
+                got = vals.get(b)
+                if got:
+                    out.setdefault(b, []).append(
+                        QuantileSketch.from_values(got, budget)
+                    )
+                else:
+                    out.setdefault(b, []).extend(
+                        QuantileSketch.from_quad(mn, mx, sm, cnt, budget)
+                        for mn, mx, sm, cnt in quads
+                    )
+        for b, got in tail_vals.items():
+            out.setdefault(b, []).append(
+                QuantileSketch.from_values(got, budget)
+            )
+        return [
+            (
+                b,
+                sks[0]
+                if len(sks) == 1
+                else QuantileSketch.merged(sks, budget),
+            )
+            for b, sks in sorted(out.items())
+        ]
+
     def series_keys(self) -> "set[str]":
         """Every series key the store currently knows (any tier)."""
         out: set = set()
@@ -781,12 +1185,27 @@ class TSDB:
     def stats(self) -> dict:
         """Observability snapshot (rides /api/timings)."""
         with self._lock:
-            raw_pts = sum(b.count for b in self._raw)
+            # recording-rule outputs are first-class blocks, but the
+            # point counters keep their pre-13 meaning (scraped data):
+            # migrations and tests reason about "did my frames survive",
+            # and derived series would double-count them.  One pass —
+            # this runs under the ingest lock on every /api/timings poll
+            derived = []
+            raw_pts = 0
+            for b in self._raw:
+                if b.keys and all(
+                    k.startswith("__rule__/") for k in b.keys
+                ):
+                    derived.append(b)
+                else:
+                    raw_pts += b.count
             pend_pts = sum(len(ts) for _k, _c, ts, _m in self._pending)
             comp_bytes = sum(b.nbytes() for b in self._raw)
             out = {
                 "raw_blocks": len(self._raw),
                 "raw_points": raw_pts,
+                "derived_blocks": len(derived),
+                "derived_points": sum(b.count for b in derived),
                 "head_points": len(self._head_ts) + pend_pts,
                 "series": (
                     len(self._head_keys) * len(self._head_cols)
@@ -801,10 +1220,18 @@ class TSDB:
                 "rollup_blocks": {
                     _TIER_NAMES[t]: len(v) for t, v in self._rollups.items()
                 },
+                "sketch_blocks": {
+                    _TIER_NAMES[t]: len(v) for t, v in self._sketches.items()
+                },
+                "sketch_bytes": sum(
+                    s.nbytes() for v in self._sketches.values() for s in v
+                ),
                 "persisted": bool(self.path),
                 "read_only": self.read_only,
                 "last_disk_error": self.last_disk_error,
             }
+        if self.rule_engine is not None:
+            out["rules"] = self.rule_engine.stats()
         if self.snapshot_dir:
             out["snapshots"] = {
                 "dir": self.snapshot_dir,
