@@ -1136,6 +1136,7 @@ def bench_federation(
             federate=",".join(f"{s.name}={s.url}" for s in specs),
             federate_hedge=0.0,  # in-memory children never need hedging
             refresh_interval=0.0,
+            node_id="bench-parent",
         )
         src = FederatedSource(cfg, children=[(s, _ReplayClient()) for s in specs])
         svc = DashboardService(cfg, src)
@@ -1161,6 +1162,7 @@ def bench_federation(
         federate=",".join(f"{s.name}={s.url}" for s in specs),
         federate_hedge=0.0,
         refresh_interval=0.0,
+        node_id="bench-parent",
     )
     src = FederatedSource(
         cfg, children=[(s, _ReplayClientBin()) for s in specs]
@@ -1175,6 +1177,151 @@ def bench_federation(
         assert len(frame["selected"]) == n * chips_per_child
     out[f"federation_fanin_{n}_bin_p50_ms"] = round(
         svc.timer.percentile(0.5) * 1e3, 2
+    )
+    return out
+
+
+def bench_federation_tree(
+    shapes=((16, 4), (64, 1)), leaf_chips: int = 1024, frames: int = 4
+) -> dict:
+    """Fleets-of-fleets fan-in (ISSUE 15): a 3-level tree at ≥64k
+    aggregate chips, measured at the ROOT.
+
+    Two shapes carry the same 65,536 chips and the SAME downstream
+    compose work (64 × 1024-chip slices at the root): 16 children of
+    4,096 vs 64 children of 1,024.  The only thing that differs is
+    per-child fan-in overhead, so the hard guard — the 64-child p50 must
+    stay within 2× of the 16-child p50 — is exactly "fan-in cost is
+    sub-linear in child count" with the chip-bound work held constant.
+
+    The incremental-summary gate rides along: one mid-tier tick's TDB1
+    delta (changed-cell bitmap + qv cells) must be ≥3× smaller than the
+    full JSON summary document — HARD, plus the binary-full ratio and
+    the parent-side delta decode cost for the record."""
+    import copy as _copy
+
+    from tpudash.app import wire
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.federation.client import SummaryResult
+    from tpudash.federation.source import ChildSpec, FederatedSource
+
+    leaf = _bench_service(leaf_chips, node_id="bench-leaf")
+    leaf.render_frame()
+    leaf_doc0 = leaf.summary_doc(binary=True)
+    leaf.render_frame()  # the replay source advances one tick
+    leaf_doc1 = leaf.summary_doc(binary=True)
+
+    class _DocClient:
+        """Replays a decoded doc under a fresh ETag per poll (worst
+        case: every child changed every tick, no 304s)."""
+
+        def __init__(self, doc):
+            self.doc = doc
+            self.v = 0
+
+        def fetch(self, etag, timeout):
+            self.v += 1
+            return SummaryResult(
+                doc=_copy.deepcopy(self.doc), etag=f"e{self.v}"
+            )
+
+    def make_mid(n_leaves: int):
+        specs = [ChildSpec(f"l{j}", f"http://l{j}") for j in range(n_leaves)]
+        cfg = Config(
+            federate=",".join(f"{s.name}={s.url}" for s in specs),
+            federate_hedge=0.0,
+            refresh_interval=0.0,
+            node_id="bench-mid",
+        )
+        clients = [_DocClient(leaf_doc0) for _ in specs]
+        src = FederatedSource(
+            cfg, children=list(zip(specs, clients))
+        )
+        svc = DashboardService(cfg, src)
+        svc.render_frame()
+        return svc, clients
+
+    out: dict = {}
+    # -- the incremental-summary bytes gate (one mid-tier tick) --------------
+    mid, mid_clients = make_mid(4)  # 4,096-chip mid-tier parent
+    mid_doc0 = mid.summary_doc(binary=True)
+    for c in mid_clients:
+        c.doc = leaf_doc1
+    mid.render_frame()
+    mid_doc1 = mid.summary_doc(binary=True)
+    full_json = len(_dumps(mid.summary_doc()).encode())
+    full_bin = len(wire.encode_summary(mid_doc1))
+    delta = wire.encode_summary_delta(mid_doc1, mid_doc0, '"e0"')
+    t0 = time.perf_counter()
+    for _ in range(3):
+        wire.decode_summary_delta(delta, mid_doc0, '"e0"')
+    decode_ms = (time.perf_counter() - t0) / 3 * 1e3
+    out["summary_full_json_bytes"] = full_json
+    out["summary_full_bin_bytes"] = full_bin
+    out["summary_delta_bytes"] = len(delta)
+    out["summary_delta_shrink"] = round(full_json / len(delta), 2)
+    out["summary_delta_shrink_bin"] = round(full_bin / len(delta), 2)
+    out["summary_delta_decode_ms"] = round(decode_ms, 2)
+    # the acceptance bar: steady-state fan-in bytes ≥3× below the full doc
+    assert out["summary_delta_shrink"] >= 3.0, (
+        f"incremental summary only {out['summary_delta_shrink']}x smaller "
+        f"than the full doc ({len(delta)}B vs {full_json}B) — the qv delta "
+        "path degraded"
+    )
+
+    # -- root fan-in p50 at both 65,536-chip shapes --------------------------
+    p50s: dict = {}
+    class _BinClient:
+        """Replays one encoded TDB1 summary; each poll pays the real
+        decode (one frombuffer) under a fresh ETag."""
+
+        def __init__(self, blob):
+            self.blob = blob
+            self.v = 0
+
+        def fetch(self, etag, timeout):
+            self.v += 1
+            return SummaryResult(
+                doc=wire.decode_summary(self.blob), etag=f"e{self.v}"
+            )
+
+    for n_children, leaves_per in shapes:
+        svc, _clients = make_mid(leaves_per)
+        blob = wire.encode_summary(svc.summary_doc(binary=True))
+        specs = [
+            ChildSpec(f"m{i}", f"http://m{i}") for i in range(n_children)
+        ]
+        cfg = Config(
+            federate=",".join(f"{s.name}={s.url}" for s in specs),
+            federate_hedge=0.0,
+            refresh_interval=0.0,
+            node_id="bench-root",
+        )
+        src = FederatedSource(
+            cfg, children=[(s, _BinClient(blob)) for s in specs]
+        )
+        root = DashboardService(cfg, src)
+        root.render_frame()  # warm
+        root.state.select_all(root.available)
+        root.timer.history.clear()
+        chips = n_children * leaves_per * leaf_chips
+        for _ in range(frames):
+            frame = root.render_frame()
+            assert frame["error"] is None
+            assert len(frame["chips"]) == chips
+            assert not frame.get("partial")
+        p50 = root.timer.percentile(0.5)
+        p50s[n_children] = p50
+        out[
+            f"federation_tree_{n_children}x{leaves_per * leaf_chips}_p50_ms"
+        ] = round(p50 * 1e3, 2)
+    # sub-linear-in-child-count, chips held constant: 4× the children
+    # must cost < 2× the frame
+    lo, hi = min(p50s), max(p50s)
+    assert p50s[hi] <= 2.0 * p50s[lo] + 0.010, (
+        f"fan-in p50 scaled with child count: {p50s[lo] * 1e3:.1f}ms at "
+        f"{lo} children → {p50s[hi] * 1e3:.1f}ms at {hi} (same 64k chips)"
     )
     return out
 
@@ -1412,6 +1559,24 @@ def find_regressions(
                 }
             )
 
+    # fleets-of-fleets (ISSUE 15): the incremental-summary shrink is
+    # deterministic (10% band — a drop means the qv delta path degraded;
+    # the hard ≥3× floor lives inside bench_federation_tree itself); the
+    # 3-level fan-in p50s are time-domain on a noisy host, so 2x swings
+    # flag (the hard sub-linear guard also lives in the bench)
+    check(
+        "summary_delta_shrink",
+        result.get("summary_delta_shrink"),
+        prev.get("summary_delta_shrink"),
+        "lower",
+        0.10,
+    )
+    for key in (
+        "federation_tree_16x4096_p50_ms",
+        "federation_tree_64x1024_p50_ms",
+        "summary_delta_decode_ms",
+    ):
+        check(key, result.get(key), prev.get(key), "higher", 1.0)
     p_now, p_prev = result.get("probes", {}), prev.get("probes", {})
     for key in ("matmul_bf16_tflops", "hbm_stream_gbps", "hbm_copy_gbps"):
         check(key, p_now.get(key), p_prev.get(key), "lower", 0.05)
@@ -1620,6 +1785,7 @@ def main() -> None:
     tsdb = bench_tsdb()
     snapshot = bench_snapshot()
     federation = bench_federation()
+    federation_tree = bench_federation_tree()
     anomaly_scoring = bench_anomaly_scoring()
     range_quantiles = bench_range_quantiles()
     federated_range = bench_federated_range()
@@ -1666,6 +1832,7 @@ def main() -> None:
         **tsdb,
         **snapshot,
         **federation,
+        **federation_tree,
         **anomaly_scoring,
         **range_quantiles,
         **federated_range,

@@ -807,6 +807,9 @@ def test_federated_range_over_real_http_children():
                 f"c{i}={u}" for i, u in enumerate(urls)
             ),
             federate_deadline=3.0,
+            # children share cfg's default <hostname>-<port> identity;
+            # the parent must not look like a self-scrape cycle
+            node_id="parent-under-test",
         )
         psvc = await loop.run_in_executor(
             None, lambda: DashboardService(pcfg, make_source(pcfg))

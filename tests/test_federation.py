@@ -43,9 +43,12 @@ def _run(coro):
 
 # -- fixtures ----------------------------------------------------------------
 
-def _child_summary(chips: int = 8) -> dict:
-    """One real child's summary document (live service → build_summary)."""
-    cfg = Config(source="synthetic", synthetic_chips=chips)
+def _child_summary(chips: int = 8, node_id: str = "leaf") -> dict:
+    """One real child's summary document (live service → build_summary).
+    ``node_id`` is explicit: parent and children in one test process
+    would otherwise derive the SAME ``<hostname>-<port>`` default and
+    every poll would be refused as a self-scrape cycle."""
+    cfg = Config(source="synthetic", synthetic_chips=chips, node_id=node_id)
     svc = DashboardService(cfg, SyntheticSource(num_chips=chips))
     svc.render_frame()
     return svc.summary_doc()
@@ -83,6 +86,7 @@ def _federated(doc, names=("a", "b"), clock=None, **cfg_kw):
         federate_stale_budget=10.0,
         breaker_failures=2,
         breaker_cooldown=5.0,
+        node_id="parent-under-test",
     )
     kw.update(cfg_kw)
     cfg = Config(**kw)
@@ -364,6 +368,7 @@ def test_hedged_retry_second_request_wins():
         federate="a=http://a",
         federate_hedge=0.05,
         federate_deadline=2.0,
+        node_id="parent-under-test",
     )
     src = FederatedSource(cfg, children=[(ChildSpec("a", "http://a"), client)])
     batch = src.fetch()
@@ -501,9 +506,13 @@ def test_flap_fault_does_not_flap_endpoint_down_under_dwell():
 
 # -- HTTP surface ------------------------------------------------------------
 
-def _child_server(chips=8):
+def _child_server(chips=8, node_id="leaf", **cfg_kw):
     cfg = Config(
-        source="synthetic", synthetic_chips=chips, refresh_interval=60.0
+        source="synthetic",
+        synthetic_chips=chips,
+        refresh_interval=60.0,
+        node_id=node_id,
+        **cfg_kw,
     )
     return DashboardServer(
         DashboardService(cfg, SyntheticSource(num_chips=chips))
@@ -544,6 +553,7 @@ def test_parent_federates_real_http_child_and_hits_304():
             federate=f"east=http://127.0.0.1:{cs.port}",
             refresh_interval=60.0,
             federate_hedge=0.0,
+            node_id="parent-under-test",
         )
         parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
         pc = TestClient(TestServer(parent.build_app()))
@@ -579,6 +589,7 @@ def test_child_proxy_drilldown_and_502_mapping():
             federate=f"east=http://127.0.0.1:{cs.port}",
             refresh_interval=60.0,
             federate_hedge=0.0,
+            node_id="parent-under-test",
         )
         parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
         pc = TestClient(TestServer(parent.build_app()))
@@ -765,3 +776,1037 @@ def test_chaos_partition_fault_three_shapes():
         ChaosScenario.parse("partition:mode=bogus")
     with pytest.raises(ValueError):
         ChaosScenario.parse("partition:mode=drip,ms=0")
+
+
+# -- fleets-of-fleets: recursion, cycles, depth (PR 15) -----------------------
+
+def _bin_summary(chips: int = 8, node_id: str = "leaf") -> dict:
+    """A binary-path summary doc (matrix as the float64 ndarray)."""
+    cfg = Config(source="synthetic", synthetic_chips=chips, node_id=node_id)
+    svc = DashboardService(cfg, SyntheticSource(num_chips=chips))
+    svc.render_frame()
+    return svc.summary_doc(binary=True)
+
+
+def test_summary_doc_carries_recursion_stamps():
+    doc = _child_summary(node_id="leaf-a")
+    assert doc["node"] == "leaf-a"
+    assert doc["depth"] == 0
+    assert doc["path"] == ["leaf-a"]
+    # wire values are display-grade: every cell is centi-exact (what
+    # makes the incremental delta codec 1-2 bytes per changed cell)
+    for row in doc["matrix"]:
+        for v in row:
+            if v is not None:
+                assert round(v * 100) / 100.0 == v
+
+
+def test_parent_summary_propagates_depth_path_and_levels():
+    doc = _child_summary(node_id="leaf-a")
+    src, clients, cfg = _federated(doc)
+    svc = DashboardService(cfg, src)
+    svc.render_frame()
+    pdoc = svc.summary_doc()
+    assert pdoc["node"] == "parent-under-test"
+    assert pdoc["depth"] == 1
+    assert set(pdoc["path"]) == {"parent-under-test", "leaf-a"}
+    assert pdoc["levels"][0]["live"] == 2
+    assert pdoc["levels"][0]["stale"] == []
+
+
+def test_cycle_refused_per_child_self_scrape():
+    """A child whose path already contains this parent is refused —
+    per child, with the distinct federation_cycle page — while siblings
+    keep serving."""
+    doc = _child_summary()
+    cycle_doc = copy.deepcopy(doc)
+    cycle_doc["node"] = "other"
+    cycle_doc["path"] = ["other", "parent-under-test"]
+    # cooldown 0: the breaker re-probes every poll, so the heal at the
+    # end of the test is observable without waiting out a cooldown
+    src, clients, cfg = _federated(doc, breaker_cooldown=0.0)
+    assert src.fetch().nrows == 16  # both healthy first
+    clients["b"].bump(cycle_doc)
+    batch = src.fetch()  # must NOT raise, must NOT loop
+    assert batch.nrows == 16  # b's retained pre-cycle rows serve (stale)
+    assert "cycle refused" in src.last_errors["b"]
+    fs = src.federation_summary()
+    assert "cycle refused" in fs["children"]["b"]["cycle"]
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    rules = {(a["rule"], a["chip"], a["state"]) for a in frame["alerts"]}
+    assert ("federation_cycle", "b", "firing") in rules
+    assert not any(r == "child_down" and c == "b" for r, c, _s in rules)
+    # the cycle clears when the child's path no longer contains us
+    clients["b"].bump(doc)
+    src.fetch()
+    assert src.federation_summary()["children"]["b"].get("cycle") is None
+
+
+def test_cycle_a_scrapes_b_scrapes_a_converges_to_dag():
+    """A→B→A built from REAL build_summary docs: once A has aggregated
+    B, B's poll of A sees itself in A's path and refuses — one edge of
+    the cycle survives, the other is refused; never a scrape loop."""
+    leaf = _child_summary(node_id="leaf-x")
+    # A aggregates B's (initially cycle-free) doc
+    a_src, a_clients, a_cfg = _federated(
+        leaf, names=("b",), node_id="node-a"
+    )
+    a_svc = DashboardService(a_cfg, a_src)
+    a_svc.render_frame()
+    a_doc = a_svc.summary_doc()
+    assert set(a_doc["path"]) == {"node-a", "leaf-x"}
+    # B federates A (the back edge): A's doc does not (yet) contain B,
+    # so the FIRST poll is accepted…
+    b_src, b_clients, b_cfg = _federated(
+        a_doc, names=("a",), node_id="node-b"
+    )
+    assert b_src.fetch().nrows == 8
+    b_svc = DashboardService(b_cfg, b_src)
+    b_svc.render_frame()
+    # …and once B has aggregated A, B's doc carries node-a in its path:
+    # A's next poll of B sees ITSELF and refuses.  Exactly one edge of
+    # the cycle survives (B→A), the other is refused (A→B) — a DAG.
+    b_doc = b_svc.summary_doc()
+    assert "node-a" in b_doc["path"]
+    a_clients["b"].bump(b_doc)
+    a_svc.render_frame()
+    assert "cycle refused" in a_src.last_errors["b"]
+    # the surviving edge keeps working: A's doc never gains node-b, so
+    # B's polls of A stay clean forever
+    a_doc2 = a_svc.summary_doc()
+    assert "node-b" not in a_doc2["path"]
+    b_clients["a"].bump(a_doc2)
+    b_src.fetch()
+    assert "cycle" not in (b_src.last_errors.get("a") or "")
+
+
+def test_diamond_is_not_a_cycle():
+    """R → {B, C} → D: D appears in both children's paths, but R is in
+    neither — no refusal (a diamond is a DAG, and each arm's rows are
+    namespaced apart)."""
+    leaf = _child_summary(node_id="node-d")
+    b_doc = copy.deepcopy(leaf)
+    b_doc.update(node="node-b", depth=1, path=["node-b", "node-d"])
+    c_doc = copy.deepcopy(leaf)
+    c_doc.update(node="node-c", depth=1, path=["node-c", "node-d"])
+    src, clients, _cfg = _federated(b_doc, names=("b", "c"), node_id="node-r")
+    clients["c"].bump(c_doc)
+    batch = src.fetch()
+    assert batch.nrows == 16
+    assert src.last_errors == {}
+    fs = src.federation_summary()
+    assert fs["depth"] == 2
+    assert set(fs["children"]) == {"b", "c"}
+    assert not fs["partial"]
+
+
+def test_depth_cap_refuses_loudly():
+    doc = _child_summary()
+    deep = copy.deepcopy(doc)
+    deep["depth"] = 3  # this parent would be level 4
+    src, clients, _cfg = _federated(
+        doc, names=("a",), federate_max_depth=3
+    )
+    assert src.fetch().nrows == 8
+    clients["a"].bump(deep)
+    src.fetch()
+    assert "depth refused" in src.last_errors["a"]
+    assert "TPUDASH_FEDERATE_MAX_DEPTH=3" in src.last_errors["a"]
+    # at the cap boundary the chain is accepted
+    ok = copy.deepcopy(doc)
+    ok["depth"] = 2
+    clients["a"].bump(ok)
+    src.fetch()
+    assert "depth" not in (src.last_errors.get("a") or "")
+
+
+def test_levels_fold_names_the_exact_subtree():
+    """A live mid-tier child whose OWN doc reports a degraded grandchild
+    must surface at this parent as level-1 accounting with the subtree
+    path named — and flip the fleet partial despite every direct child
+    being live."""
+    doc = _child_summary()
+    mid = copy.deepcopy(doc)
+    mid.update(
+        node="node-m",
+        depth=1,
+        path=["node-m", "leaf"],
+        partial=True,
+        levels=[
+            {"live": 3, "stale": ["g1"], "dark": [], "max_staleness_s": 4.2}
+        ],
+    )
+    src, clients, cfg = _federated(doc, names=("a", "m"))
+    clients["m"].bump(mid)
+    src.fetch()
+    fs = src.federation_summary()
+    assert fs["children"]["a"]["status"] == "live"
+    assert fs["children"]["m"]["status"] == "live"
+    assert fs["partial"] is True  # nested degradation surfaces here
+    assert fs["levels"][1]["stale"] == ["m/g1"]
+    assert fs["levels"][1]["live"] == 3
+    assert fs["levels"][1]["max_staleness_s"] == 4.2
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    fp = [a for a in frame["alerts"] if a["rule"] == "fleet_partial"]
+    assert fp and "m/g1" in fp[0]["detail"]
+    assert frame["partial"] is True
+
+
+def test_mixed_version_fleet_pre15_child():
+    """A pre-15 child's doc (no node/depth/path/levels) reads as a
+    depth-0 leaf — mixed-version fleets keep federating."""
+    doc = _child_summary()
+    for k in ("node", "depth", "path", "levels"):
+        doc.pop(k, None)
+    src, _clients, _cfg = _federated(doc, names=("old",))
+    assert src.fetch().nrows == 8
+    assert src.last_errors == {}
+    fs = src.federation_summary()
+    assert fs["children"]["old"]["status"] == "live"
+    assert fs["depth"] == 1  # an unknown subtree counts as a leaf
+
+
+# -- incremental summaries (TDB1 kind 7) --------------------------------------
+
+def test_summary_delta_codec_round_trip():
+    import numpy as np
+
+    from tpudash.app import wire
+
+    base = _bin_summary(node_id="leaf-d")
+    cur = copy.deepcopy(base)
+    m = cur["matrix"]
+    m[0, 0] += 0.01          # centi delta (1-2 bytes)
+    m[1, 0] = float("nan")   # value → NaN
+    m[2, 0] = float("inf")   # +inf code
+    m[3, 0] = -0.0           # raw escape (sign must survive)
+    m[0, 1] = 1e300          # out-of-envelope escape
+    cur["ts"] = base["ts"] + 5.0
+    buf = wire.encode_summary_delta(cur, base, '"e1"')
+    assert buf[5] == wire.KIND_SUMMARY_DELTA
+    out = wire.decode_summary_delta(buf, base, '"e1"')
+    a, b = out["matrix"], cur["matrix"]
+    assert a.shape == b.shape
+    eq = (a == b) | (np.isnan(a) & np.isnan(b))
+    assert eq.all()
+    assert np.signbit(out["matrix"][3, 0])
+    assert out["keys"] == base["keys"]
+    assert out["identity"] is base["identity"]
+    assert out["ts"] == cur["ts"]
+    # steady-state size: a handful of changed cells ≪ the full doc
+    assert len(buf) < len(wire.encode_summary(cur)) / 3
+    # wrong base → refusal, never a silently wrong matrix
+    with pytest.raises(wire.WireError):
+        wire.decode_summary_delta(buf, base, '"other"')
+    # identity change → the encoder itself refuses (full-doc fallback)
+    moved = copy.deepcopy(cur)
+    moved["identity"] = {
+        k: list(reversed(v)) for k, v in moved["identity"].items()
+    }
+    moved["keys"] = list(reversed(moved["keys"]))
+    with pytest.raises(wire.WireError):
+        wire.encode_summary_delta(moved, base, '"e1"')
+
+
+def test_summary_delta_http_negotiation_and_base_mismatch_fallback():
+    """The child serves kind-7 against an advertised base it still
+    holds, and the FULL doc on any mismatch — unconditionally."""
+    async def go():
+        from tpudash.app import wire
+
+        server = _child_server(node_id="leaf-h")
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        bin_hdr = {"Accept": wire.CONTENT_TYPE}
+        try:
+            r1 = await client.get("/api/summary", headers=bin_hdr)
+            assert r1.status == 200
+            e1 = r1.headers["ETag"]
+            doc1 = wire.decode_summary(await r1.read())
+            # advance the summary key (a fresh data version) so the
+            # same base can be asked for incrementally
+            server._data_version += 1
+            r2 = await client.get(
+                "/api/summary",
+                headers={**bin_hdr, "X-Tpudash-Summary-Base": e1},
+            )
+            assert r2.status == 200
+            body = await r2.read()
+            assert body[5] == wire.KIND_SUMMARY_DELTA
+            doc2 = wire.decode_summary_delta(body, doc1, e1)
+            assert doc2["keys"] == doc1["keys"]
+            # a base the child no longer holds → full doc, not an error
+            server._data_version += 1
+            r3 = await client.get(
+                "/api/summary",
+                headers={**bin_hdr, "X-Tpudash-Summary-Base": '"s-gone"'},
+            )
+            assert (await r3.read())[5] == wire.KIND_SUMMARY
+        finally:
+            await client.close()
+        # the knob pins full docs even against a perfect base
+        pinned = _child_server(
+            node_id="leaf-h2", federate_summary_delta=False
+        )
+        client = TestClient(TestServer(pinned.build_app()))
+        await client.start_server()
+        try:
+            r1 = await client.get("/api/summary", headers=bin_hdr)
+            e1 = r1.headers["ETag"]
+            pinned._data_version += 1
+            r2 = await client.get(
+                "/api/summary",
+                headers={**bin_hdr, "X-Tpudash-Summary-Base": e1},
+            )
+            assert (await r2.read())[5] == wire.KIND_SUMMARY
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_source_applies_delta_and_falls_back(monkeypatch):
+    """End to end through FederatedSource with a scripted delta-capable
+    client: deltas reconstruct the doc, a bad delta refuses the poll
+    per child and the NEXT poll recovers with a full doc."""
+    from tpudash.app import wire
+
+    base = _bin_summary(node_id="leaf-s")
+
+    class DeltaClient:
+        supports_delta = True
+
+        def __init__(self):
+            self.v = 0
+            self.doc = base
+            self.served = []
+
+        def bump(self):
+            self.v += 1
+            self.doc = copy.deepcopy(self.doc)
+            self.doc["matrix"][0, 0] += 0.25
+            self.doc["ts"] += 5.0
+
+        def fetch(self, etag, timeout, base=None):
+            tag = f"e{self.v}"
+            if etag == tag:
+                self.served.append("304")
+                return SummaryResult(doc=None, etag=etag, not_modified=True)
+            if base is not None and base.get("etag"):
+                buf = wire.encode_summary_delta(
+                    self.doc, base["doc"], base["etag"]
+                )
+                self.served.append("delta")
+                return SummaryResult(
+                    doc=wire.decode_summary_delta(
+                        buf, base["doc"], base["etag"]
+                    ),
+                    etag=tag,
+                    delta=True,
+                    wire_bytes=len(buf),
+                )
+            self.served.append("full")
+            return SummaryResult(
+                doc=copy.deepcopy(self.doc),
+                etag=tag,
+                wire_bytes=len(wire.encode_summary(self.doc)),
+            )
+
+    client = DeltaClient()
+    cfg = Config(
+        federate="d=http://d",
+        federate_hedge=0.0,
+        node_id="parent-under-test",
+    )
+    src = FederatedSource(
+        cfg, children=[(ChildSpec("d", "http://d"), client)]
+    )
+    assert src.fetch().nrows == 8          # full
+    client.bump()
+    assert src.fetch().nrows == 8          # delta against e0
+    client.bump()
+    assert src.fetch().nrows == 8          # delta against e1
+    assert client.served == ["full", "delta", "delta"]
+    st = src._children[0]
+    assert st.counters["deltas"] == 2
+    assert 0 < st.counters["delta_bytes"] < st.counters["full_bytes"]
+    # the reconstructed matrix tracked both bumps exactly
+    import numpy as np
+
+    assert np.isclose(
+        st.last_doc["matrix"][0, 0], base["matrix"][0, 0] + 0.5
+    )
+
+
+# -- auth-rejected vs unreachable ---------------------------------------------
+
+def test_auth_rejected_child_is_distinct_from_partition():
+    """A token-skewed child shows ``last_error: auth …`` and never
+    counts toward the breaker — it is alive, just refusing us."""
+    async def go():
+        from tpudash.federation.client import AuthError, HttpSummaryClient
+
+        child = _child_server(node_id="leaf-auth", auth_token="right")
+        cs = TestServer(child.build_app())
+        await cs.start_server()
+        loop = asyncio.get_running_loop()
+        url = f"http://127.0.0.1:{cs.port}"
+        try:
+            bad = HttpSummaryClient(url, auth_token="wrong")
+            with pytest.raises(AuthError):
+                await loop.run_in_executor(None, bad.fetch, None, 4.0)
+            cfg = Config(
+                federate=f"east={url}",
+                federate_hedge=0.0,
+                auth_token="wrong",
+                node_id="parent-under-test",
+                breaker_failures=2,
+            )
+            src = FederatedSource(
+                cfg, children=[(ChildSpec("east", url), bad)]
+            )
+            for _ in range(4):
+                with pytest.raises(SourceError):
+                    await loop.run_in_executor(None, src.fetch)
+            assert src.last_errors["east"].startswith("auth rejected")
+            # four rejections, zero breaker failures: the child is NOT
+            # quarantined like a partition would be
+            assert src.breakers["east"].consecutive_failures == 0
+            assert src.breakers["east"].state == "closed"
+            st = src._children[0]
+            assert st.counters["auth_errors"] == 4
+            fs = src.federation_summary()
+            assert "auth" in fs["children"]["east"]["last_error"]
+        finally:
+            await cs.close()
+
+    _run(go())
+
+
+# -- auto-discovery: roster, churn, dwell, persistence ------------------------
+
+def test_roster_persistence_across_restart(tmp_path):
+    from tpudash.federation.roster import Roster
+
+    path = str(tmp_path / "roster.json")
+    r1 = Roster(path=path, ttl=30.0)
+    r1.upsert("c1", "http://c1")
+    r1.upsert("c2", "http://c2")
+    # a restart grants each registered child ONE fresh TTL
+    r2 = Roster(path=path, ttl=30.0)
+    assert r2.membership() == {"c1": "http://c1", "c2": "http://c2"}
+    r2.remove("c1")
+    assert Roster(path=path, ttl=30.0).membership() == {"c2": "http://c2"}
+
+
+def test_parse_discovery_grammar():
+    from tpudash.federation.discovery import parse_discovery
+
+    reg, watchers = parse_discovery("register")
+    assert reg and watchers == []
+    reg, watchers = parse_discovery("register,dns:slices.tpu:9999")
+    assert reg and watchers[0].kind == "dns"
+    assert (watchers[0].host, watchers[0].port) == ("slices.tpu", 9999)
+    _reg, watchers = parse_discovery("k8s:tpu/slice-dash:8050")
+    assert watchers[0].kind == "k8s"
+    assert watchers[0].namespace == "tpu"
+    with pytest.raises(ValueError):
+        parse_discovery("zeroconf")  # unknown mode fails LOUDLY
+    with pytest.raises(ValueError):
+        parse_discovery("k8s:noslash")
+
+
+def test_discovery_register_expire_flap_churn():
+    """The full membership state machine: nothing-discovered error →
+    register → joined within one poll → heartbeat keeps alive → a
+    sub-dwell TTL flap never churns membership → a real expiry retires
+    (stale, retained rows) → dark → pruned."""
+    doc = _child_summary()
+    clock = _Clock()
+    cfg = Config(
+        federate="",
+        federate_discovery="register",
+        federate_register_ttl=10.0,
+        federate_leave_dwell=5.0,
+        federate_stale_budget=20.0,
+        federate_hedge=0.0,
+        node_id="parent-under-test",
+        breaker_failures=2,
+        breaker_cooldown=5.0,
+    )
+    src = FederatedSource(cfg, children=[], clock=clock)
+    with pytest.raises(SourceError) as ei:
+        src.fetch()
+    assert "discovered" in str(ei.value)
+    client = FakeClient(copy.deepcopy(doc))
+    src._injected["r1"] = (ChildSpec("r1", "http://r1"), client)
+    ttl = src.register_child("r1", "http://r1")
+    assert ttl == 10.0
+    assert src.fetch().nrows == 8  # joined within ONE poll
+    assert src.federation_summary()["children"]["r1"]["status"] == "live"
+    # heartbeat at t=8 keeps the entry fresh past the original TTL
+    clock.t = 8.0
+    src.register_child("r1", "http://r1")
+    clock.t = 16.0
+    assert src.fetch().nrows == 8
+    # TTL flap: the heartbeat lapsed at t=18, but the leave dwell holds
+    # membership at t=19 — no retirement, no churn
+    clock.t = 19.0
+    assert src.fetch().nrows == 8
+    assert src._children[0].retired_m is None
+    clock.t = 20.0
+    src.register_child("r1", "http://r1")  # re-registered within dwell
+    clock.t = 24.0
+    assert src.fetch().nrows == 8
+    assert src._children[0].retired_m is None
+    # real expiry: last heartbeat t=20, TTL out at 30, dwell out at 29+…
+    clock.t = 36.0
+    assert src.fetch().nrows == 8  # retained rows STILL serve — stale
+    fs = src.federation_summary()
+    assert fs["children"]["r1"]["status"] == "stale"
+    assert fs["children"]["r1"]["retired"] is True
+    assert fs["partial"] is True
+    # past the stale budget: dark, then pruned from the fleet entirely
+    clock.t = 50.0
+    with pytest.raises(SourceError):
+        src.fetch()  # sole child dark → nothing to serve
+    clock.t = 51.0
+    with pytest.raises(SourceError) as ei:
+        src.fetch()  # pruned → back to the nothing-discovered error
+    assert "discovered" in str(ei.value)
+    assert src.federation_summary()["children_total"] == 0
+
+
+def test_discovery_join_dwell_debounces_admission():
+    doc = _child_summary()
+    clock = _Clock()
+    cfg = Config(
+        federate="",
+        federate_discovery="register",
+        federate_register_ttl=100.0,
+        federate_join_dwell=5.0,
+        federate_hedge=0.0,
+        node_id="parent-under-test",
+    )
+    src = FederatedSource(cfg, children=[], clock=clock)
+    src._injected["r1"] = (ChildSpec("r1", "http://r1"), FakeClient(doc))
+    src.register_child("r1", "http://r1")
+    clock.t = 1.0
+    with pytest.raises(SourceError):
+        src.fetch()  # present 1s < join dwell 5s — not admitted yet
+    clock.t = 6.0
+    assert src.fetch().nrows == 8  # admitted after dwelling
+
+
+def test_dns_watcher_discovers_and_degrades(monkeypatch):
+    from tpudash.federation import discovery as disco
+
+    answers = {"v": [("10.0.0.1",), ("10.0.0.2",)]}
+
+    def fake_getaddrinfo(host, port, type=None):
+        import socket as s
+
+        if answers["v"] is None:
+            raise OSError("resolver down")
+        return [
+            (s.AF_INET, s.SOCK_STREAM, 6, "", (ip, port))
+            for (ip,) in answers["v"]
+        ]
+
+    monkeypatch.setattr(
+        "socket.getaddrinfo", fake_getaddrinfo
+    )
+    w = disco.DnsWatcher("slices.tpu:8051")
+    got = w.poll()
+    assert got == {
+        "10.0.0.1-8051": "http://10.0.0.1:8051",
+        "10.0.0.2-8051": "http://10.0.0.2:8051",
+    }
+    # resolver failure degrades to the PREVIOUS answer, never empties
+    answers["v"] = None
+    assert w.poll() == got
+    assert w.last_error is not None
+    answers["v"] = [("10.0.0.2",)]
+    assert w.poll() == {"10.0.0.2-8051": "http://10.0.0.2:8051"}
+    assert w.last_error is None
+
+
+def test_k8s_watcher_parses_endpoints_with_injected_fetcher():
+    from tpudash.federation.discovery import K8sEndpointsWatcher
+
+    doc = {
+        "subsets": [
+            {
+                "ports": [{"port": 8050}],
+                "addresses": [
+                    {"ip": "10.1.0.4", "targetRef": {"name": "slice-a-0"}},
+                    {"ip": "10.1.0.5"},
+                ],
+            }
+        ]
+    }
+    w = K8sEndpointsWatcher("tpu/slices", fetcher=lambda: doc)
+    assert w.poll() == {
+        "slice-a-0": "http://10.1.0.4:8050",
+        "10.1.0.5-8050": "http://10.1.0.5:8050",
+    }
+    # a broken fetch degrades to the previous answer
+    w._fetch = lambda: (_ for _ in ()).throw(RuntimeError("api down"))
+    assert w.poll()["slice-a-0"] == "http://10.1.0.4:8050"
+    assert "api down" in w.last_error
+
+
+def test_register_endpoint_http_lifecycle():
+    """POST /api/federation/register end to end: a leaf registers with
+    a discovery parent, appears within one poll, deregisters, and fades
+    stale instead of vanishing.  Register on a non-discovery parent is
+    403; on a non-parent 404."""
+    async def go():
+        leaf = _child_server(node_id="leaf-reg")
+        ls = TestServer(leaf.build_app())
+        await ls.start_server()
+        leaf_url = f"http://127.0.0.1:{ls.port}"
+        pcfg = Config(
+            federate="",
+            federate_discovery="register",
+            federate_register_ttl=60.0,
+            federate_stale_budget=60.0,
+            refresh_interval=0.0,
+            federate_hedge=0.0,
+            node_id="parent-reg",
+        )
+        parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
+        pc = TestClient(TestServer(parent.build_app()))
+        await pc.start_server()
+        try:
+            # nothing registered yet: the frame says so, stays 200
+            r = await pc.get("/api/frame")
+            assert r.status == 200
+            assert "discovered" in (await r.json())["error"]
+            r = await pc.post(
+                "/api/federation/register",
+                json={"name": "s0", "url": leaf_url},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["ok"] is True and body["ttl"] == 60.0
+            assert body["parent"] == "parent-reg"
+            frame = await (await pc.get("/api/frame")).json()
+            assert frame["error"] is None
+            assert len(frame["chips"]) == 8
+            assert frame["chips"][0]["key"].startswith("s0/")
+            # the roster is observable
+            tm = await (await pc.get("/api/timings")).json()
+            assert tm["federation_roster"][0]["name"] == "s0"
+            assert tm["federation_roster"][0]["source"] == "register"
+            # bad bodies refuse loudly
+            assert (
+                await pc.post(
+                    "/api/federation/register", json={"name": "x"}
+                )
+            ).status == 400
+            assert (
+                await pc.post(
+                    "/api/federation/register",
+                    json={"name": "a/b", "url": "http://x"},
+                )
+            ).status == 400
+            # deregister → the child fades stale (rows retained)
+            r = await pc.post(
+                "/api/federation/register",
+                json={"name": "s0", "leave": True},
+            )
+            assert (await r.json())["removed"] is True
+            frame = await (await pc.get("/api/frame")).json()
+            assert len(frame["chips"]) == 8  # retained, marked stale
+            assert frame["federation"]["children"]["s0"]["status"] == "stale"
+            assert frame["partial"] is True
+        finally:
+            await pc.close()
+            await ls.close()
+        # a static-only parent refuses registration with 403
+        scfg = Config(
+            federate="x=http://127.0.0.1:1",
+            refresh_interval=60.0,
+            node_id="parent-static",
+        )
+        sparent = DashboardServer(DashboardService(scfg, make_source(scfg)))
+        sc = TestClient(TestServer(sparent.build_app()))
+        await sc.start_server()
+        try:
+            r = await sc.post(
+                "/api/federation/register",
+                json={"name": "s0", "url": "http://y"},
+            )
+            assert r.status == 403
+        finally:
+            await sc.close()
+        # a leaf (no federation at all) has no such endpoint
+        plain = _child_server(node_id="leaf-plain")
+        cc = TestClient(TestServer(plain.build_app()))
+        await cc.start_server()
+        try:
+            r = await cc.post(
+                "/api/federation/register",
+                json={"name": "s0", "url": "http://y"},
+            )
+            assert r.status == 404
+        finally:
+            await cc.close()
+
+    _run(go())
+
+
+# -- real-HTTP 3-level fleet --------------------------------------------------
+
+def test_three_level_fleet_end_to_end():
+    """leaf ← mid ← root over real HTTP: keys compose, depth/path/levels
+    propagate, drill-downs reach the grandchild through the intermediate
+    parent (both the composed and the explicit spelling), and the
+    incremental summary rides the mid→root hop."""
+    async def go():
+        from tpudash.app import wire
+
+        leaf = _child_server(node_id="leaf-3l")
+        ls = TestServer(leaf.build_app())
+        await ls.start_server()
+        mcfg = Config(
+            federate=f"leaf=http://127.0.0.1:{ls.port}",
+            refresh_interval=60.0,
+            federate_hedge=0.0,
+            node_id="mid-3l",
+        )
+        mid = DashboardServer(DashboardService(mcfg, make_source(mcfg)))
+        ms = TestServer(mid.build_app())
+        await ms.start_server()
+        rcfg = Config(
+            federate=f"mid=http://127.0.0.1:{ms.port}",
+            refresh_interval=60.0,
+            federate_hedge=0.0,
+            node_id="root-3l",
+        )
+        root = DashboardServer(DashboardService(rcfg, make_source(rcfg)))
+        rc = TestClient(TestServer(root.build_app()))
+        await rc.start_server()
+        try:
+            frame = await (await rc.get("/api/frame")).json()
+            assert frame["error"] is None
+            assert len(frame["chips"]) == 8
+            key = frame["chips"][0]["key"]
+            assert key.startswith("mid/leaf/")
+            fed = frame["federation"]
+            assert fed["node"] == "root-3l"
+            assert fed["depth"] == 2
+            assert fed["children"]["mid"]["depth"] == 1
+            assert len(fed["levels"]) >= 2
+            assert fed["levels"][0]["live"] == 1
+            assert fed["levels"][1]["live"] == 1
+            # the root's own summary is itself scrapeable one level up
+            doc = await (await rc.get("/api/summary")).json()
+            assert doc["depth"] == 2
+            assert set(doc["path"]) == {"root-3l", "mid-3l", "leaf-3l"}
+            # drill-down through the intermediate parent: composed form…
+            leaf_key = key.split("/", 2)[2]
+            r = await rc.get(f"/api/child/mid/leaf/api/chip?key={leaf_key}")
+            assert r.status == 200
+            assert (await r.json())["key"] == leaf_key
+            # …and the explicit nested spelling
+            r = await rc.get(
+                f"/api/child/mid/api/child/leaf/api/chip?key={leaf_key}"
+            )
+            assert r.status == 200
+            # hygiene holds at every level
+            for sneaky in (
+                "/api/child/mid/leaf/api/../internal/cohort",
+                "/api/child/mid/api/child/leaf/api/../internal/cohort",
+                "/api/child/mid/leaf/index.html",
+            ):
+                from yarl import URL
+
+                assert (
+                    await rc.get(URL(sneaky, encoded=True))
+                ).status == 404, sneaky
+            # unknown grandchild 404s one hop down, mapped through
+            r = await rc.get("/api/child/mid/nope/api/frame")
+            assert r.status == 404
+            # the mid→root hop negotiated the binary summary; drive a
+            # second poll after a data change to exercise the delta
+            root.service.source.fetch  # (sanity: attr exists)
+            loop = asyncio.get_running_loop()
+            mid._data_version += 1  # new summary key at the mid
+            await loop.run_in_executor(None, root.service.source.fetch)
+            hz = await (await rc.get("/healthz")).json()
+            counters = hz["federation"]["children"]["mid"]["counters"]
+            assert counters["deltas"] >= 1
+            assert counters["delta_bytes"] > 0
+        finally:
+            await rc.close()
+            await ms.close()
+            await ls.close()
+
+    _run(go())
+
+
+# -- review-hardening pins ----------------------------------------------------
+
+def test_auth_rejection_is_contact_never_dark():
+    """An auth-rejected poll IS contact: the child must sit at stale
+    (retained rows serving, breaker closed) forever — never age into
+    dark and page child_down for a token skew."""
+    from tpudash.federation.client import AuthError
+
+    doc = _child_summary()
+    clock = _Clock()
+
+    class RejectingClient:
+        def __init__(self):
+            self.reject = False
+            self.doc = doc
+            self.v = 0
+
+        def fetch(self, etag, timeout):
+            if self.reject:
+                raise AuthError("auth rejected (HTTP 401): token skew")
+            self.v += 1
+            return SummaryResult(
+                doc=copy.deepcopy(self.doc), etag=f"e{self.v}"
+            )
+
+    client = RejectingClient()
+    cfg = Config(
+        federate="a=http://a",
+        federate_hedge=0.0,
+        federate_stale_budget=10.0,
+        node_id="parent-under-test",
+        breaker_failures=2,
+    )
+    src = FederatedSource(
+        cfg, children=[(ChildSpec("a", "http://a"), client)], clock=clock
+    )
+    assert src.fetch().nrows == 8
+    client.reject = True
+    # WAY past the stale budget in wall time, but every poll is a fresh
+    # (rejected) contact — the child holds at stale, never dark
+    for t in (5.0, 15.0, 40.0, 100.0):
+        clock.t = t
+        assert src.fetch().nrows == 8  # retained rows keep serving
+        fs = src.federation_summary()
+        assert fs["children"]["a"]["status"] == "stale", t
+        assert src.breakers["a"].state == "closed"
+    svc = DashboardService(cfg, src)
+    frame = svc.render_frame()
+    assert not any(
+        a["rule"] == "child_down" and a["state"] == "firing"
+        for a in frame["alerts"]
+    )
+    # heal: token fixed → live again next poll
+    client.reject = False
+    clock.t = 101.0
+    src.fetch()
+    assert src.federation_summary()["children"]["a"]["status"] == "live"
+
+
+def test_roster_static_entries_cannot_be_retagged():
+    """A register POST (or watch answer) colliding with a static
+    child's name must not convert it into TTL-expirable provenance."""
+    from tpudash.federation.roster import SRC_STATIC, Roster
+
+    clock = _Clock()
+    r = Roster(ttl=10.0, clock=clock)
+    r.upsert("east", "http://east", source=SRC_STATIC)
+    with pytest.raises(ValueError):  # register collision is LOUD
+        r.upsert("east", "http://evil")
+    r.sync_watch({"east": "http://elsewhere", "new": "http://new"})
+    clock.t = 100.0  # far past any TTL
+    member = r.membership()
+    assert member["east"] == "http://east"  # url and provenance intact
+    assert member["new"] == "http://new"
+    assert {
+        e["source"] for e in r.snapshot() if e["name"] == "east"
+    } == {SRC_STATIC}
+
+
+def test_summary_delta_refuses_identity_drift():
+    """Same keys, different host (a chip re-scheduled onto another
+    machine) must break the delta chain — the base's identity would
+    otherwise persist forever."""
+    from tpudash.app import wire
+
+    base = _bin_summary(node_id="leaf-i")
+    cur = copy.deepcopy(base)
+    cur["identity"]["host"] = list(cur["identity"]["host"])
+    cur["identity"]["host"][0] = "rescheduled-host"
+    with pytest.raises(wire.WireError):
+        wire.encode_summary_delta(cur, base, '"e1"')
+
+
+def test_proxy_hop_cap_admits_the_deepest_level():
+    """The drill-down must reach every level the fan-in admits: with
+    the default cap a 2-hop (3-level) chain works, and the cap refuses
+    only chains EXCEEDING it."""
+    async def go():
+        leaf = _child_server(node_id="leaf-hop")
+        ls = TestServer(leaf.build_app())
+        await ls.start_server()
+        pcfg = Config(
+            federate=f"leaf=http://127.0.0.1:{ls.port}",
+            refresh_interval=60.0,
+            federate_hedge=0.0,
+            federate_max_depth=1,
+            node_id="parent-hop",
+        )
+        parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
+        pc = TestClient(TestServer(parent.build_app()))
+        await pc.start_server()
+        try:
+            # max_depth=1 still allows the ONE hop a parent-of-leaves
+            # topology needs (the data plane admits depth-0 children)
+            r = await pc.get("/api/child/leaf/api/frame")
+            assert r.status == 200
+            # …but a request arriving with the cap already burned is 508
+            r = await pc.get(
+                "/api/child/leaf/api/frame",
+                headers={"X-Tpudash-Proxy-Hops": "1"},
+            )
+            assert r.status == 508
+        finally:
+            await pc.close()
+            await ls.close()
+
+    _run(go())
+
+
+def test_roster_remove_refuses_static_and_k8s_port_resolution():
+    """Second review round: (a) a leave POST cannot deregister a
+    config-declared child; (b) a port-less k8s spec uses the Endpoints
+    object's OWN declared port, not the parent's bind port."""
+    from tpudash.federation.discovery import K8sEndpointsWatcher
+    from tpudash.federation.roster import SRC_STATIC, Roster
+
+    r = Roster(ttl=10.0)
+    r.upsert("east", "http://east", source=SRC_STATIC)
+    r.upsert("dyn", "http://dyn")
+    assert r.remove("east") is False          # static: config owns it
+    assert "east" in r.membership()
+    assert r.remove("dyn") is True
+    doc = {
+        "subsets": [
+            {
+                "ports": [{"port": 8050}],
+                "addresses": [{"ip": "10.9.0.7"}],
+            }
+        ]
+    }
+    # the parent binds 9000; its leaves serve 8050 — the declared
+    # subset port must win when the spec names none
+    w = K8sEndpointsWatcher("prod/tpudash", default_port=9000,
+                            fetcher=lambda: doc)
+    assert w.poll() == {"10.9.0.7-8050": "http://10.9.0.7:8050"}
+    # an explicit spec port overrides the subset's
+    w2 = K8sEndpointsWatcher("prod/tpudash:7777", default_port=9000,
+                             fetcher=lambda: doc)
+    assert w2.poll() == {"10.9.0.7-7777": "http://10.9.0.7:7777"}
+
+
+def test_summary_delta_cache_holds_multiple_bases():
+    """Diamond topologies: two parents at different bases must each
+    keep their cached delta — one slot thrashing a re-encode per poll
+    defeats the built-once-per-transition design."""
+    async def go():
+        from tpudash.app import wire
+
+        server = _child_server(node_id="leaf-dc")
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        bin_hdr = {"Accept": wire.CONTENT_TYPE}
+        try:
+            r1 = await client.get("/api/summary", headers=bin_hdr)
+            e1 = r1.headers["ETag"]
+            server._data_version += 1
+            r2 = await client.get("/api/summary", headers=bin_hdr)
+            e2 = r2.headers["ETag"]
+            server._data_version += 1
+            # parent A (base e1) and parent B (base e2) poll alternately
+            for _ in range(3):
+                for base in (e1, e2):
+                    r = await client.get(
+                        "/api/summary",
+                        headers={
+                            **bin_hdr,
+                            "X-Tpudash-Summary-Base": base,
+                        },
+                    )
+                    assert (await r.read())[5] == wire.KIND_SUMMARY_DELTA
+            # both transitions stayed cached — no per-poll re-encode
+            assert len(server._summary_delta_cache) == 2
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_truncated_summary_delta_refuses_not_crashes():
+    """Third review round: an internally-truncated kind-7 body (bitmap
+    claims more cells than the qv stream carries) must WireError — a
+    refusal per child — never IndexError through the fan-in as a
+    frame-erroring parent bug."""
+    from tpudash.app import wire
+
+    base = _bin_summary(node_id="leaf-t")
+    cur = copy.deepcopy(base)
+    cur["matrix"][:] = cur["matrix"] + 0.01  # every cell changed
+    buf = wire.encode_summary_delta(cur, base, '"e1"')
+    kind, head, payload = wire.split_container(buf)
+    truncated = wire._container(
+        wire.KIND_SUMMARY_DELTA, head, payload[: len(payload) // 2]
+    )
+    with pytest.raises(wire.WireError):
+        wire.decode_summary_delta(truncated, base, '"e1"')
+
+
+def test_announcer_adopts_parent_interval_and_static_collision_400():
+    """Third review round: (a) the announcer adopts the PARENT's
+    advertised heartbeat cadence (a shorter parent TTL must not
+    expire-and-rejoin the child forever); (b) registering a name that
+    collides with a config-declared child is a LOUD 400, not a silent
+    ok that leaves the new instance invisible."""
+    async def go():
+        from tpudash.federation.discovery import Announcer
+
+        pcfg = Config(
+            federate="fixed=http://127.0.0.1:1",
+            federate_discovery="register",
+            federate_register_ttl=30.0,
+            refresh_interval=60.0,
+            node_id="parent-ann",
+        )
+        parent = DashboardServer(DashboardService(pcfg, make_source(pcfg)))
+        pc = TestClient(TestServer(parent.build_app()))
+        await pc.start_server()
+        loop = asyncio.get_running_loop()
+        try:
+            url = f"http://127.0.0.1:{pc.server.port}"
+            ann = Announcer([url], "newbie", "http://newbie:8050", ttl=600.0)
+            assert ann.interval == 200.0  # the child's own default
+            ok = await loop.run_in_executor(None, ann.announce_once)
+            assert ok == 1
+            assert ann.interval == 10.0  # adopted: parent ttl 30 / 3
+            # a collision with the static child is refused loudly
+            r = await pc.post(
+                "/api/federation/register",
+                json={"name": "fixed", "url": "http://elsewhere"},
+            )
+            assert r.status == 400
+            assert "config-declared" in await r.text()
+            # …and leave cannot deregister it either
+            r = await pc.post(
+                "/api/federation/register",
+                json={"name": "fixed", "leave": True},
+            )
+            assert (await r.json())["removed"] is False
+        finally:
+            await pc.close()
+
+    _run(go())

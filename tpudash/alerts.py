@@ -73,6 +73,11 @@ SYNTHESIZED_RULES = (
     "compose_down",
     "child_down",
     "fleet_partial",
+    # a federated child whose own aggregation path already contains
+    # this parent — refused per child (tpudash/federation/source.py);
+    # the page is distinct from child_down because the fix is a
+    # topology change, not a network chase
+    "federation_cycle",
     "anomaly",
 )
 

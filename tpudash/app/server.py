@@ -152,10 +152,13 @@ def _build_summary_body(service: DashboardService) -> bytes:
     return _dumps(service.summary_doc()).encode()
 
 
-def _build_summary_body_bin(service: DashboardService) -> bytes:
-    """TDB1 summary encoding (Accept-negotiated): JSON head + the raw
-    float64 matrix block — executor-side like the JSON twin."""
-    return wire.encode_summary(service.summary_doc(binary=True))
+def _build_summary_body_bin(service: DashboardService) -> tuple:
+    """(encoded TDB1 summary, the doc itself) — executor-side like the
+    JSON twin.  The doc (matrix still the float64 block) is retained as
+    a DELTA BASE: a parent that advertises this body's ETag on its next
+    poll gets a kind-7 incremental body against it."""
+    doc = service.summary_doc(binary=True)
+    return wire.encode_summary(doc), doc
 
 
 def _key_id(key: tuple) -> str:
@@ -263,6 +266,18 @@ class DashboardServer:
             None,
         )
         self._summary_build_lock = asyncio.Lock()
+        #: recent binary summary docs keyed by their ETag — the DELTA
+        #: BASES (TPUDASH_FEDERATE_SUMMARY_DELTA): a parent advertising
+        #: one of these gets a kind-7 incremental body; anything older
+        #: has aged out and falls back to the full doc unconditionally
+        self._summary_hist: "OrderedDict[str, dict]" = OrderedDict()
+        #: (base_etag, cur_etag) → body, LRU-bounded like the hist: one
+        #: delta built per TRANSITION however many parents poll it — and
+        #: parents at DIFFERENT bases (diamond topologies) each keep
+        #: their own entry instead of thrashing one slot per poll
+        self._summary_delta_cache: "OrderedDict[tuple, bytes]" = (
+            OrderedDict()
+        )
         #: bounded LRU of finalized ``/api/range`` response bodies keyed
         #: by canonical query params: serves the ETag/304 revalidation
         #: path AND the OverloadGuard's stale-degrade contract (a shed
@@ -635,7 +650,16 @@ class DashboardServer:
         )
         key = self._summary_key()
         etag = f'"s-{_key_id(key)}{"-b" if binary else ""}"'
-        headers = {"Cache-Control": "no-cache", "ETag": etag}
+        headers = {
+            "Cache-Control": "no-cache",
+            "ETag": etag,
+            # the body depends on BOTH negotiation inputs: a shared
+            # cache between a child and several parents must never hand
+            # one parent's kind-7 delta (anchored on ITS base) to a
+            # parent holding a different one, nor a binary doc to a
+            # JSON consumer
+            "Vary": "Accept, X-Tpudash-Summary-Base",
+        }
         if request.headers.get("If-None-Match") == etag:
             return web.Response(status=304, headers=headers)
         cache_slot = "_summary_cache_bin" if binary else "_summary_cache"
@@ -645,25 +669,74 @@ class DashboardServer:
                 cached_key, raw = getattr(self, cache_slot)
                 if cached_key != key:
                     loop = asyncio.get_running_loop()
-                    raw = await loop.run_in_executor(
-                        None,
-                        (
-                            _build_summary_body_bin
-                            if binary
-                            else _build_summary_body
-                        ),
-                        self.service,
-                    )
+                    if binary:
+                        raw, doc = await loop.run_in_executor(
+                            None, _build_summary_body_bin, self.service
+                        )
+                        self._summary_hist[f'"s-{_key_id(key)}-b"'] = doc
+                        while len(self._summary_hist) > 4:
+                            self._summary_hist.popitem(last=False)
+                    else:
+                        raw = await loop.run_in_executor(
+                            None, _build_summary_body, self.service
+                        )
                     setattr(self, cache_slot, (key, raw))
                     cached_key = key
         # serve the ETag of the body actually cached (the data may have
         # advanced while this request queued behind the build gate)
-        headers["ETag"] = f'"s-{_key_id(cached_key)}{"-b" if binary else ""}"'
+        etag_cur = f'"s-{_key_id(cached_key)}{"-b" if binary else ""}"'
+        headers["ETag"] = etag_cur
+        body = raw
+        if binary:
+            body = await self._summary_delta_body(request, etag_cur, raw)
         return web.Response(
-            body=raw,
+            body=body,
             content_type=wire.CONTENT_TYPE if binary else "application/json",
             headers=headers,
         )
+
+    async def _summary_delta_body(
+        self, request: web.Request, etag_cur: str, raw: bytes
+    ) -> bytes:
+        """The incremental-summary negotiation (PR 15): a parent that
+        advertised a base ETag this child still holds gets a kind-7
+        delta body — changed-cell bitmap + qv cells, steady-state fan-in
+        bytes ≥3× smaller; ANY mismatch (unknown base, identity change,
+        knob off) serves the full doc ``raw`` unconditionally.  The
+        delta is built once per (base, current) transition however many
+        parents share the base."""
+        if not self.service.cfg.federate_summary_delta:
+            return raw
+        from tpudash.federation.client import SUMMARY_BASE_HEADER
+
+        base_etag = request.headers.get(SUMMARY_BASE_HEADER)
+        if not base_etag or base_etag == etag_cur:
+            return raw
+        base = self._summary_hist.get(base_etag)
+        cur = self._summary_hist.get(etag_cur)
+        if base is None or cur is None:
+            return raw
+        dk = (base_etag, etag_cur)
+        body = self._summary_delta_cache.get(dk)
+        if body is not None:
+            return body
+        async with self._summary_build_lock:
+            body = self._summary_delta_cache.get(dk)
+            if body is not None:
+                return body
+            loop = asyncio.get_running_loop()
+            try:
+                body = await loop.run_in_executor(
+                    None, wire.encode_summary_delta, cur, base, base_etag
+                )
+            except wire.WireError:
+                # identity/shape changed across the transition — the
+                # unconditional full-doc fallback
+                return raw
+            self._summary_delta_cache[dk] = body
+            while len(self._summary_delta_cache) > 4:
+                self._summary_delta_cache.popitem(last=False)
+        return body
 
     def _child_http(self):
         """Lazy client session for the child drill-down proxy.
@@ -684,11 +757,17 @@ class DashboardServer:
         """``GET /api/child/{child}/{tail}`` — drill INTO a federated
         child through the fleet parent: the fleet pane's chip drill-down
         (``/api/chip``, ``/api/history``, ``/api/range``, topology…)
-        answers from the child that owns the chip, one hop away, with
-        the same hop-header hygiene as the worker→compose proxy.  An
-        unreachable child maps to **502** (the child is the broken
-        upstream — 503 would blame this parent, and the parent is fine);
-        an unknown child or a non-API tail is 404 here."""
+        answers from the child that owns the chip, with the same
+        hop-header hygiene as the worker→compose proxy.  Multi-level
+        fleets COMPOSE: ``/api/child/{a}/{b}/api/chip`` hops to ``a``,
+        which resolves ``b`` one level down (each level re-validates
+        path hygiene and re-authenticates with its own fleet token), so
+        a root drill-down reaches any grandchild without the root
+        knowing the grandchild's address.  An unreachable child maps to
+        **502** (the child is the broken upstream — 503 would blame this
+        parent, and the parent is fine); an unknown child or a non-API
+        tail is 404 here; a hop chain deeper than the depth cap is 508
+        (a proxy loop must burn hops, never sockets)."""
         urls_fn = getattr(self.service.source, "child_urls", None)
         if not callable(urls_fn):
             raise web.HTTPNotFound(
@@ -702,16 +781,44 @@ class DashboardServer:
         # dot segments would let "api/../internal/cohort" pass the
         # prefix check and NORMALIZE to a non-API child route inside the
         # client URL — reject them (aiohttp has already percent-decoded
-        # the match, so encoded spellings land here too)
+        # the match, so encoded spellings land here too).  The hygiene
+        # runs at EVERY level of a composed drill-down.
         segments = tail.split("/")
-        if (
-            ".." in segments
-            or "." in segments
-            or "" in segments
-            or not (tail.startswith("api/") or tail == "healthz")
-        ):
+        if ".." in segments or "." in segments or "" in segments:
             raise web.HTTPNotFound(
                 text="only /api/* and /healthz proxy to children"
+            )
+        if not (tail.startswith("api/") or tail == "healthz"):
+            # multi-level drill-down: the leading segment(s) name
+            # children of `child` — recompose the hop as the child's
+            # own /api/child/... route.  Only when an API tail actually
+            # follows; bare garbage 404s here, not one hop down.
+            if "/api/" not in f"/{tail}" and not tail.endswith("/healthz"):
+                raise web.HTTPNotFound(
+                    text="only /api/* and /healthz proxy to children"
+                )
+            tail = f"api/child/{tail}"
+        hops = 0
+        raw_hops = request.headers.get("X-Tpudash-Proxy-Hops")
+        if raw_hops:
+            try:
+                hops = int(raw_hops)
+            except ValueError:
+                hops = 0
+        # refuse only when the chain would EXCEED the depth cap: a
+        # max_depth chain needs exactly max_depth forwards, and the
+        # data plane admits topologies that deep — the proxy must reach
+        # every level the fan-in aggregates (hops is how many forwards
+        # already happened; this one makes hops + 1)
+        if hops >= max(1, self.service.cfg.federate_max_depth):
+            # 508 Loop Detected (aiohttp has no named class for it)
+            return web.Response(
+                status=508,
+                text=(
+                    f"drill-down exceeded {hops} hops "
+                    "(TPUDASH_FEDERATE_MAX_DEPTH) — a federation cycle "
+                    "would otherwise proxy forever"
+                ),
             )
         from aiohttp import ClientError
 
@@ -721,6 +828,7 @@ class DashboardServer:
         # toward the child the PARENT authenticates (one fleet, one
         # token) — the client's header must not leak through as-is
         headers = forward_headers(request.headers, drop={"authorization"})
+        headers["X-Tpudash-Proxy-Hops"] = str(hops + 1)
         if self.service.cfg.auth_token:
             headers["Authorization"] = (
                 f"Bearer {self.service.cfg.auth_token}"
@@ -746,6 +854,63 @@ class DashboardServer:
             raise web.HTTPBadGateway(
                 text=f"federated child {child!r} unreachable: {e}"
             ) from e
+
+    async def federation_register(self, request: web.Request) -> web.Response:
+        """``POST /api/federation/register`` — the child-discovery
+        handshake (TPUDASH_FEDERATE_DISCOVERY=register).  Body:
+        ``{"name": ..., "url": ..., "leave": bool?}``.  Rides the
+        ordinary bearer gate (one fleet, one token); a registered child
+        re-POSTs within the returned ``ttl`` or fades live → stale →
+        dark.  ``leave: true`` deregisters (the same fade — an explicit
+        goodbye is never an instant vanish)."""
+        src = self.service.source
+        reg = getattr(src, "register_child", None)
+        if not callable(reg):
+            raise web.HTTPNotFound(
+                text="not a federation parent (TPUDASH_FEDERATE / "
+                "TPUDASH_FEDERATE_DISCOVERY unset)"
+            )
+        try:
+            body = await request.json()
+        except ValueError as e:
+            raise web.HTTPBadRequest(
+                text="register body must be a JSON object"
+            ) from e
+        if not isinstance(body, dict):
+            raise web.HTTPBadRequest(
+                text="register body must be a JSON object"
+            )
+        name = str(body.get("name") or "").strip()
+        loop = asyncio.get_running_loop()
+        if body.get("leave"):
+            try:
+                # roster persistence is file I/O — executor, never the loop
+                removed = await loop.run_in_executor(
+                    None, src.deregister_child, name
+                )
+            except PermissionError as e:
+                raise web.HTTPForbidden(text=str(e)) from e
+            return _json_response({"ok": True, "removed": bool(removed)})
+        url = str(body.get("url") or "").strip()
+        if not name or not url:
+            raise web.HTTPBadRequest(
+                text="register body needs non-empty name and url"
+            )
+        try:
+            ttl = await loop.run_in_executor(None, reg, name, url)
+        except PermissionError as e:
+            raise web.HTTPForbidden(text=str(e)) from e
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from e
+        return _json_response(
+            {
+                "ok": True,
+                "ttl": ttl,
+                # the heartbeat cadence the child should adopt
+                "interval": round(max(1.0, ttl / 3.0), 3),
+                "parent": getattr(src, "node_id", None),
+            }
+        )
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent events: push a frame every refresh interval.  All
@@ -1035,6 +1200,11 @@ class DashboardServer:
             # per-child failures, replica serves, hedge wins
             summary["range_scatter"] = dict(scatter_counters)
         summary["range_cache_entries"] = len(self._range_cache)
+        roster = getattr(self.service.source, "roster", None)
+        if roster is not None:
+            # fleet-membership truth (discovery/registration, PR 15):
+            # raw pre-dwell entries with provenance and heartbeat age
+            summary["federation_roster"] = roster.snapshot()
         summary["tier"] = self._tier_doc(summary.get("tsdb"))
         return _json_response(summary)
 
@@ -2294,6 +2464,9 @@ class DashboardServer:
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/api/summary", self.summary)
         app.router.add_get("/api/child/{child}/{tail:.+}", self.child_proxy)
+        app.router.add_post(
+            "/api/federation/register", self.federation_register
+        )
         app.router.add_get("/api/stream", self.stream)
         app.router.add_get("/api/export.csv", self.export_csv)
         app.router.add_post("/api/select", self.select)
@@ -2327,6 +2500,16 @@ class DashboardServer:
                 self._child_session = None
 
         app.on_cleanup.append(_close_child_session)
+        if self.service.announcer is not None:
+            # stop the announce heartbeat (the join may block on a
+            # parked POST for its timeout — executor, never the loop)
+            async def _close_announcer(app):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, self.service.close_announcer
+                )
+
+            app.on_cleanup.append(_close_announcer)
         if self.service.cfg.history_path:
             # final trend snapshot on graceful shutdown (periodic saves
             # cover crashes up to history_save_interval behind)

@@ -358,6 +358,28 @@ class DashboardService:
             # raw history through the detector
             self.rule_engine.scorer = self.anomaly_engine.score_series
         self.timeline = IncidentTimeline()
+        #: the child side of the registration handshake (PR 15): when
+        #: TPUDASH_FEDERATE_ANNOUNCE names parent URLs, a daemon thread
+        #: POSTs this node's (id, advertised URL) every ttl/3 so joining
+        #: a fleet needs no parent-side config push
+        self.announcer = None
+        if getattr(cfg, "federate_announce", ""):
+            from tpudash.federation.discovery import Announcer
+            from tpudash.federation.summary import node_identity
+
+            advertise = getattr(cfg, "federate_advertise", "") or ""
+            if not advertise:
+                import socket as _socket
+
+                advertise = f"http://{_socket.gethostname()}:{cfg.port}"
+            self.announcer = Announcer(
+                parents=cfg.federate_announce.split(","),
+                name=node_identity(cfg),
+                url=advertise,
+                auth_token=cfg.auth_token,
+                ttl=getattr(cfg, "federate_register_ttl", 60.0) or 60.0,
+            )
+            self.announcer.start()
         #: (rule, chip) pairs firing in the previous frame — webhook
         #: notifications are sent on transitions only, not every cycle
         self._firing_keys: set = set()
@@ -1045,6 +1067,12 @@ class DashboardService:
         if self.anomaly_engine is not None:
             self.anomaly_engine.save_baselines()
 
+    def close_announcer(self) -> None:
+        """Stop the federation announce heartbeat (graceful shutdown;
+        the parent's TTL ages a crashed child out on its own)."""
+        if self.announcer is not None:
+            self.announcer.stop()
+
     def close_tsdb(self) -> None:
         """Graceful-shutdown seal: the not-yet-full head chunk compresses
         and (with a path) persists, so a clean restart loses nothing.  A
@@ -1156,6 +1184,26 @@ class DashboardService:
             status = c.get("status")
             if status != "live":
                 degraded.append(name)
+            if c.get("cycle"):
+                # a child whose summary already aggregates THIS parent:
+                # the distinct LOUD page — a cycle is an operator
+                # topology error, not a partition, and the runbook
+                # actions differ (break the loop vs chase the network)
+                out.append(
+                    synthesized_alert(
+                        rule="federation_cycle",
+                        column="federation",
+                        severity="critical",
+                        chip=name,
+                        value=1.0,
+                        threshold=0.0,
+                        firing=True,
+                        streak=int(br.get("consecutive_failures") or 1),
+                        detail=c["cycle"],
+                        child_status=status,
+                    )
+                )
+                continue  # child_down would double-page the same cause
             firing = status == "dark" or br.get("state") in (
                 "open",
                 "half_open",
@@ -1191,21 +1239,39 @@ class DashboardService:
                     staleness_s=c.get("staleness_s"),
                 )
             )
-        if degraded:
+        # nested degradation (PR 15): a grandchild partition two levels
+        # down surfaces HERE with its exact subtree path — the per-level
+        # stale/dark sets the recursive fan-in folded upward
+        subtrees: "list[str]" = []
+        for i, lvl in enumerate(fs.get("levels") or []):
+            if i == 0:
+                continue  # direct children already named above
+            subtrees.extend(lvl.get("stale") or [])
+            subtrees.extend(lvl.get("dark") or [])
+        if degraded or subtrees:
             k, n = len(degraded), fs["children_total"]
+            parts = []
+            if degraded:
+                parts.append(
+                    f"{k}/{n} federated children degraded "
+                    f"({', '.join(degraded)})"
+                )
+            if subtrees:
+                parts.append(
+                    "degraded subtrees: " + ", ".join(sorted(subtrees))
+                )
             out.append(
                 synthesized_alert(
                     rule="fleet_partial",
                     column="federation",
                     severity="warning",
                     chip="fleet",
-                    value=float(k),
+                    value=float(k + len(subtrees)),
                     threshold=0.0,
                     firing=True,
-                    streak=k,
+                    streak=max(1, k),
                     detail=(
-                        f"{k}/{n} federated children degraded "
-                        f"({', '.join(degraded)}) — the fleet frame is "
+                        "; ".join(parts) + " — the fleet frame is "
                         "partial: last-good data serving where available"
                     ),
                 )

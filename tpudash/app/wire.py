@@ -97,6 +97,13 @@ KIND_SUMMARY = 3
 KIND_TEMPLATE = 4
 KIND_CFULL = 5
 KIND_FULLC = 6
+#: incremental summary (PR 15): the per-chip matrix as a changed-cell
+#: bitmap + qv cells against the PARENT'S LAST-ACKED summary (named by
+#: its ETag in the head descriptor); identity/keys/cols are elided —
+#: the base document carries them, and a child falls back to the full
+#: kind-3 document unconditionally whenever identity changed or the
+#: advertised base is one it no longer holds
+KIND_SUMMARY_DELTA = 7
 
 #: negotiated content type for binary frames/deltas
 CONTENT_TYPE = "application/x-tpudash-bin"
@@ -729,6 +736,153 @@ def decode_summary(buf: bytes) -> dict:
     elif "mx" in (head_b or {}):
         head["keys"] = []  # table-less but valid (the no-table marker)
     return head
+
+
+def _summary_matrix(doc: dict):
+    """The doc's matrix as a float64 ndarray, or None (table-less /
+    JSON-shaped docs are not delta material)."""
+    import numpy as np
+
+    m = doc.get("matrix")
+    return m if isinstance(m, np.ndarray) and m.ndim == 2 else None
+
+
+def encode_summary_delta(doc: dict, base_doc: dict, base_key: str) -> bytes:
+    """Incremental ``/api/summary`` (kind 7): everything small rides the
+    JSON head verbatim (minus identity/keys/cols — the base carries
+    them); the matrix rides as a changed-cell bitmap plus one qv cell
+    per changed position, anchored on the base matrix's cells.  Raises
+    WireError whenever a delta cannot represent the transition (shape or
+    identity changed, non-binary docs) — the caller serves the full doc
+    unconditionally."""
+    import numpy as np
+
+    cur, base = _summary_matrix(doc), _summary_matrix(base_doc)
+    if cur is None or base is None or cur.shape != base.shape:
+        raise WireError("summary shapes differ — full doc required")
+    # the WHOLE identity must match — not just the derived keys: a chip
+    # keeping its slice/id but moving host (or an accel relabel) would
+    # otherwise inherit the base's identity forever, since each
+    # reconstructed doc becomes the next base and no full-doc resync
+    # ever happens while shapes stay stable
+    if doc.get("identity") != base_doc.get("identity") or list(
+        doc.get("cols") or ()
+    ) != list(base_doc.get("cols") or ()):
+        raise WireError("summary identity changed — full doc required")
+    head = {
+        k: v
+        for k, v in doc.items()
+        if k not in ("matrix", "keys", "identity", "cols")
+    }
+    n, c = int(cur.shape[0]), int(cur.shape[1])
+    head["_b"] = {"sd": {"n": n, "c": c, "base": base_key}}
+    newf, oldf = cur.ravel(), base.ravel()
+    changed = ~((newf == oldf) | (np.isnan(newf) & np.isnan(oldf)))
+    bitmap = np.packbits(changed, bitorder="little").tobytes()
+    out = bytearray(bitmap)
+    idx = np.flatnonzero(changed)
+    if len(idx):
+        _qv_stream(out, newf[idx].tolist(), oldf[idx].tolist())
+    return _container(KIND_SUMMARY_DELTA, head, bytes(out))
+
+
+def _qv_decode_cells(payload: bytes, pos: int, bases, out) -> int:
+    """Decode ``len(bases)`` qv cells off ``payload`` at ``pos`` into
+    ``out`` (bases are the base100 anchors, matching the encoder's
+    _cell_base derivation).  A tight scalar loop — the parent pays it
+    only on changed-data polls, and changed cells are the minority in
+    steady state; returns the final position."""
+    nan, inf = float("nan"), float("inf")
+    unpack_from = struct.unpack_from
+    for j in range(len(bases)):
+        n = payload[pos]
+        pos += 1
+        if n >= 0x80:
+            n &= 0x7F
+            shift = 7
+            while True:
+                b = payload[pos]
+                pos += 1
+                n |= (b & 0x7F) << shift
+                if b < 0x80:
+                    break
+                shift += 7
+        if n >= 5:
+            d = n - 5
+            d = -((d + 1) >> 1) if d & 1 else d >> 1
+            out[j] = (bases[j] + d) / 100.0
+        elif n == 4:
+            out[j] = nan
+        elif n == 1:
+            out[j] = unpack_from("<d", payload, pos)[0]
+            pos += 8
+        elif n == 2:
+            out[j] = inf
+        elif n == 3:
+            out[j] = -inf
+        else:
+            out[j] = nan  # code 0 (null) has no matrix spelling — NaN
+    return pos
+
+
+def decode_summary_delta(buf: bytes, base_doc: dict, base_key: str) -> dict:
+    """Inverse of encode_summary_delta: reassembles the FULL summary doc
+    onto ``base_doc`` (the parent's cached decode of the advertised
+    base).  WireError when the document anchors on a different base than
+    the caller holds — numeric deltas are never applied to the wrong
+    matrix."""
+    import numpy as np
+
+    kind, head, payload = split_container(buf)
+    if kind != KIND_SUMMARY_DELTA:
+        raise WireError(f"expected a summary delta, got kind {kind}")
+    head_b = head.pop("_b", None) or {}
+    sd = head_b.get("sd") or {}
+    if sd.get("base") != base_key:
+        raise WireError(
+            f"summary delta anchors on base {sd.get('base')!r}, "
+            f"caller holds {base_key!r}"
+        )
+    base = _summary_matrix(base_doc)
+    n, c = int(sd.get("n", -1)), int(sd.get("c", -1))
+    if base is None or base.shape != (n, c):
+        raise WireError("summary delta shape disagrees with held base")
+    nbytes = (n * c + 7) // 8
+    if len(payload) < nbytes:
+        raise WireError("truncated summary-delta bitmap")
+    changed = np.unpackbits(
+        np.frombuffer(payload[:nbytes], dtype=np.uint8), bitorder="little"
+    )[: n * c].astype(bool)
+    matrix = base.copy().ravel()
+    idx = np.flatnonzero(changed)
+    if len(idx):
+        oldf = matrix[idx]
+        # the encoder's anchors via qd_base: exact-centi doubles anchor
+        # at v*100, everything else (NaN, ±inf, sub-centi) at 0
+        b100 = np.round(oldf * 100.0)
+        ok = np.isfinite(oldf) & (b100 / 100.0 == oldf)
+        ok &= np.abs(b100) < float(1 << 52)
+        bases = np.where(ok, b100, 0.0)
+        cells = np.empty(len(idx), dtype=np.float64)
+        try:
+            end = _qv_decode_cells(payload, nbytes, bases, cells)
+        except (IndexError, struct.error) as e:
+            # an internally-truncated payload (bitmap claims more cells
+            # than the qv stream carries) is UNTRUSTED wire input: it
+            # must refuse as a WireError → SourceError per child, never
+            # escape as a parent-side bug that errors the whole frame
+            raise WireError(f"truncated summary-delta cells: {e}") from e
+        if end != len(payload):
+            raise WireError("summary-delta payload length disagrees")
+        matrix[idx] = cells
+    elif len(payload) != nbytes:
+        raise WireError("summary-delta payload length disagrees")
+    doc = dict(head)
+    doc["matrix"] = matrix.reshape(n, c)
+    for k in ("identity", "cols", "keys"):
+        if k in base_doc:
+            doc[k] = base_doc[k]
+    return doc
 
 
 def binary_delta_roundtrip_equal(prev: dict, cur: dict) -> bool:

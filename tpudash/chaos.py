@@ -115,11 +115,14 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import dataclasses
+import functools
 import json
 import logging
 import os
 import signal
+import subprocess
 import sys
+import tempfile
 import time
 
 from tpudash import schema
@@ -2379,6 +2382,478 @@ async def run_partition_drill(
     return {"ok": not failures, "failures": failures, **numbers}
 
 
+# ---------------------------------------------------------------------------
+# Cascade drill — fleets-of-fleets (PR 15): a REAL 3-level tree (root →
+# mid-tier parent subprocesses → leaf dashboards); SIGKILL one mid-tier
+# parent and partition one grandchild mid-storm.  The root must stay 200
+# with exact per-level stale/dark sets, subtree-named alerts, and
+# recover within one poll of heal.
+# ---------------------------------------------------------------------------
+
+#: cascade-drill knobs: small fast tree, breaker/dwell windows sized so
+#: every transition lands inside a CI-friendly two minutes
+#: the root's deadline is DELIBERATELY wider than the mids' (see
+#: ``_CASCADE_MID_DEADLINE``): a mid whose own child-poll hangs answers
+#: its parent only after burning its fan-in deadline, so equal deadlines
+#: at every tier amplify one grandchild's tail latency into a
+#: false-degraded verdict on the (healthy) mid — deadlines must shrink
+#: going DOWN the tree (docs/OPERATIONS.md, topology runbook)
+_CASCADE_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.5),
+    "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 8),
+    "TPUDASH_FEDERATE_DEADLINE": ("federate_deadline", 2.0),
+    "TPUDASH_FEDERATE_STALE_BUDGET": ("federate_stale_budget", 10.0),
+    "TPUDASH_FEDERATE_HEDGE": ("federate_hedge", 0.3),
+    "TPUDASH_BREAKER_FAILURES": ("breaker_failures", 2),
+    "TPUDASH_BREAKER_COOLDOWN": ("breaker_cooldown", 2.0),
+    "TPUDASH_ALERT_DWELL": ("alert_dwell", 2.0),
+}
+
+#: mid-tier per-leaf deadline — one tier down, a fraction of the root's
+_CASCADE_MID_DEADLINE = 0.6
+
+
+class _MidTier:
+    """One mid-tier federation parent as a REAL subprocess (``python -m
+    tpudash``): the only honest way to drill a mid-tier SIGKILL.  Its
+    stderr is captured for the zero-unhandled-exception verdict."""
+
+    def __init__(self, name: str, port: int, leaf_spec: str, env: dict,
+                 log_dir: str):
+        self.name = name
+        self.port = port
+        self.leaf_spec = leaf_spec
+        self.env = env
+        self.log_path = os.path.join(log_dir, f"{name}.log")
+        self.proc = None
+
+    def spawn(self) -> None:
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update(
+            {
+                "TPUDASH_FEDERATE": self.leaf_spec,
+                "TPUDASH_HOST": "127.0.0.1",
+                "TPUDASH_PORT": str(self.port),
+                "TPUDASH_NODE_ID": self.name,
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        out = open(self.log_path, "ab")  # noqa: SIM115 — lives with the proc
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tpudash"],
+            env=env,
+            stdout=out,
+            stderr=out,
+        )
+
+    def sigkill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+    def tracebacks(self) -> int:
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().count(b"Traceback (most recent call last)")
+        except OSError:
+            return 0
+
+
+async def run_cascade_drill(
+    mids: int = 4, leaves: int = 4, cfg: "Config | None" = None
+) -> dict:
+    """Fleets-of-fleets crash drill: a 3-level tree (1 root × ``mids``
+    mid-tier parents × ``leaves`` leaf dashboards each), then — mid
+    steady-state — SIGKILL one mid-tier parent AND partition one
+    grandchild (accept-then-hang) under a surviving mid.  Asserted:
+
+    - the root's ``/api/frame`` stays 200 with ``federation.depth == 2``
+      and EXACT per-level accounting: the killed mid named at level 0,
+      the partitioned grandchild named ``<mid>/<leaf>`` at level 1;
+    - ``child_down`` fires for the killed mid and ``fleet_partial``
+      names the degraded subtree; ``/healthz`` stays ``ok: true``;
+    - steady-state mid→root polls ride the incremental-summary path
+      (delta counters advance) and the ETag/304 path;
+    - after respawn + heal the fleet is whole within one poll (+ breaker
+      reopen slack) of the mid serving again;
+    - zero unhandled exceptions in the root AND every mid's captured
+      stderr.
+    """
+    from aiohttp import ClientError, ClientSession, web
+
+    mids = max(2, mids)
+    leaves = max(2, leaves)
+    loop = asyncio.get_running_loop()
+    base_cfg = cfg or load_config()
+    for env_name, (field, value) in _CASCADE_KNOBS.items():
+        if not env_is_set(env_name):
+            base_cfg = dataclasses.replace(base_cfg, **{field: value})
+    chips_per_leaf = min(base_cfg.synthetic_chips, 64)
+    total = mids * leaves * chips_per_leaf
+
+    ports = _free_ports(mids * leaves + mids + 1)
+    leaf_ports = ports[: mids * leaves]
+    mid_ports = ports[mids * leaves : mids * leaves + mids]
+    root_port = ports[-1]
+
+    # leaves live in THIS process (cheap, partitionable via raw-socket
+    # shapes); mids are real subprocesses (SIGKILL-able)
+    kids: "list[list[_ChildHarness]]" = []
+    for i in range(mids):
+        row = []
+        for j in range(leaves):
+            port = leaf_ports[i * leaves + j]
+            row.append(
+                _ChildHarness(
+                    f"l{j}",
+                    port,
+                    dataclasses.replace(base_cfg, source="synthetic"),
+                )
+            )
+        kids.append(row)
+
+    mid_env = {
+        env_name: str(value)
+        for env_name, (_f, value) in _CASCADE_KNOBS.items()
+        if env_name != "TPUDASH_REFRESH_INTERVAL"
+    }
+    # mids refresh faster than leaves scrape and the root polls faster
+    # than mids refresh — the cadence stack that makes 304s/deltas
+    # deterministic at every level; the mid deadline shrinks one tier
+    # down so a hung LEAF can never burn the ROOT's deadline for a
+    # healthy mid (tail-latency amplification, see _CASCADE_KNOBS)
+    # tpulint: allow[env-read] writes into a CHILD process's env, no read
+    mid_env["TPUDASH_REFRESH_INTERVAL"] = "1.0"
+    # tpulint: allow[env-read] writes into a CHILD process's env, no read
+    mid_env["TPUDASH_FEDERATE_DEADLINE"] = str(_CASCADE_MID_DEADLINE)
+
+    log_dir = await loop.run_in_executor(
+        None, functools.partial(tempfile.mkdtemp, prefix="tpudash-cascade-")
+    )
+    tiers = [
+        _MidTier(
+            f"m{i}",
+            mid_ports[i],
+            ",".join(
+                f"l{j}=http://127.0.0.1:{kids[i][j].port}"
+                for j in range(leaves)
+            ),
+            mid_env,
+            log_dir,
+        )
+        for i in range(mids)
+    ]
+
+    root_cfg = dataclasses.replace(
+        base_cfg,
+        source="synthetic",  # ignored: federate wins
+        federate=",".join(
+            f"m{i}=http://127.0.0.1:{mid_ports[i]}" for i in range(mids)
+        ),
+        node_id="cascade-root",
+        host="127.0.0.1",
+        port=root_port,
+    )
+
+    def _build_root():
+        from tpudash.app.server import DashboardServer
+        from tpudash.app.service import DashboardService
+        from tpudash.sources import make_source
+
+        return DashboardServer(
+            DashboardService(root_cfg, make_source(root_cfg))
+        )
+
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    failures: "list[str]" = []
+    numbers: dict = {"mids": mids, "leaves_per_mid": leaves, "chips": total}
+    root_runner = None
+    session = None
+    interval = root_cfg.refresh_interval
+
+    async def fetch_json(session, path):
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{root_port}{path}",
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                return r.status, await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+            return None, None
+
+    async def mid_healthy(i) -> bool:
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{mid_ports[i]}/healthz"
+            ) as r:
+                return r.status == 200
+        except (OSError, ClientError, asyncio.TimeoutError):
+            return False
+
+    def level_sets(frame) -> list:
+        out = []
+        for lvl in ((frame or {}).get("federation") or {}).get(
+            "levels"
+        ) or []:
+            out.append(
+                {
+                    "stale": set(lvl.get("stale") or []),
+                    "dark": set(lvl.get("dark") or []),
+                    "live": lvl.get("live", 0),
+                    "max_staleness_s": lvl.get("max_staleness_s") or 0.0,
+                }
+            )
+        return out
+
+    try:
+        for row in kids:
+            for kid in row:
+                await kid.start()
+        for tier in tiers:
+            await loop.run_in_executor(None, tier.spawn)
+        root = await loop.run_in_executor(None, _build_root)
+        root_runner = web.AppRunner(root.build_app())
+        await root_runner.setup()
+        await web.TCPSite(
+            root_runner, "127.0.0.1", root_port, reuse_address=True
+        ).start()
+        session = ClientSession()
+        try:
+            # -- phase 0: the whole tree converges --------------------------
+            deadline = time.monotonic() + 120.0
+            ready = False
+            status = frame = None
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if (
+                    status == 200
+                    and frame
+                    and frame.get("error") is None
+                    and len(frame.get("chips") or []) == total
+                    and not (frame.get("federation") or {}).get("partial")
+                ):
+                    ready = True
+                    break
+                await asyncio.sleep(0.5)
+            if not ready:
+                failures.append(
+                    f"3-level fleet never converged: {status} "
+                    f"{len((frame or {}).get('chips') or [])}/{total} chips "
+                    f"partial={(frame or {}).get('federation', {}).get('partial')}"
+                )
+                raise _DrillAbort()
+            fed = frame["federation"]
+            if fed.get("depth") != 2:
+                failures.append(f"root depth {fed.get('depth')} != 2")
+            lv = level_sets(frame)
+            if len(lv) < 2 or lv[0]["live"] != mids or lv[1]["live"] != mids * leaves:
+                failures.append(f"level accounting wrong at steady state: {lv}")
+            if not frame["chips"][0]["key"].count("/") >= 2:
+                failures.append(
+                    f"keys did not compose 3 levels: {frame['chips'][0]['key']}"
+                )
+
+            # -- phase 1: steady state = 304s + incremental deltas ----------
+            # the stack is demand-driven: a viewer must poll the root for
+            # the root to poll the mids — so the steady-state window IS a
+            # polling viewer, not a sleep
+            t_end = time.monotonic() + 10 * interval
+            while time.monotonic() < t_end:
+                await fetch_json(session, "/api/frame")
+                await asyncio.sleep(interval * 0.8)
+            _, hz = await fetch_json(session, "/healthz")
+            counters = {
+                n: (c.get("counters") or {})
+                for n, c in ((hz or {}).get("federation") or {})
+                .get("children", {})
+                .items()
+            }
+            numbers["steady_304s"] = sum(
+                c.get("etag_304s", 0) for c in counters.values()
+            )
+            numbers["delta_polls"] = sum(
+                c.get("deltas", 0) for c in counters.values()
+            )
+            numbers["delta_bytes"] = sum(
+                c.get("delta_bytes", 0) for c in counters.values()
+            )
+            numbers["full_bytes"] = sum(
+                c.get("full_bytes", 0) for c in counters.values()
+            )
+            if numbers["steady_304s"] == 0:
+                failures.append("root polls never hit the 304 path")
+            if numbers["delta_polls"] == 0:
+                failures.append(
+                    "steady-state summaries never rode the incremental "
+                    "delta path"
+                )
+
+            # -- phase 2: SIGKILL a mid-tier parent + partition a grandchild
+            victim_mid = tiers[0]
+            await loop.run_in_executor(None, victim_mid.sigkill)
+            gkid = kids[1][-1]  # a grandchild under a SURVIVING mid
+            await gkid.stop()
+            await gkid.start_hang()
+            t_fault = time.monotonic()
+            subtree = f"m1/{gkid.name}"
+
+            marked = None
+            peak_levels: "dict[int, float]" = {}
+            deadline = (
+                time.monotonic() + base_cfg.federate_stale_budget + 14.0
+            )
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if status != 200 or not frame or frame.get("error"):
+                    await asyncio.sleep(0.3)
+                    continue
+                lv = level_sets(frame)
+                for i, entry in enumerate(lv):
+                    peak_levels[i] = max(
+                        peak_levels.get(i, 0.0), entry["max_staleness_s"]
+                    )
+                if len(lv) < 2:
+                    await asyncio.sleep(0.3)
+                    continue
+                l0_degraded = lv[0]["stale"] | lv[0]["dark"]
+                l1_degraded = lv[1]["stale"] | lv[1]["dark"]
+                if l0_degraded - {"m0"}:
+                    failures.append(
+                        f"healthy mid marked degraded: {l0_degraded}"
+                    )
+                    break
+                if l1_degraded - {subtree} - {
+                    f"m0/l{j}" for j in range(leaves)
+                }:
+                    # (m0's last-reported subtree may linger at level 1
+                    # while m0 itself fades — that is last-known data,
+                    # scoped by m0's own level-0 verdict)
+                    failures.append(
+                        f"wrong level-1 degraded set: {l1_degraded}"
+                    )
+                    break
+                rules = {
+                    (a.get("rule"), a.get("chip"), a.get("state"))
+                    for a in frame.get("alerts") or []
+                }
+                fp_detail = next(
+                    (
+                        a.get("detail") or ""
+                        for a in frame.get("alerts") or []
+                        if a.get("rule") == "fleet_partial"
+                    ),
+                    "",
+                )
+                if (
+                    l0_degraded == {"m0"}
+                    and subtree in l1_degraded
+                    and frame.get("partial") is True
+                    and ("child_down", "m0", "firing") in rules
+                    and subtree in fp_detail
+                ):
+                    marked = time.monotonic() - t_fault
+                    break
+                await asyncio.sleep(0.3)
+            if marked is None:
+                failures.append(
+                    "root never marked exactly {m0} at level 0 and "
+                    f"{subtree} at level 1 with child_down + subtree-named "
+                    "fleet_partial"
+                )
+            else:
+                numbers["marked_after_s"] = round(marked, 2)
+            _, hz = await fetch_json(session, "/healthz")
+            if not hz or hz.get("ok") is not True:
+                failures.append("root healthz ok flapped during the cascade")
+            elif "degraded" not in str(hz.get("status")):
+                failures.append(
+                    f"root healthz hid the cascade: {hz.get('status')!r}"
+                )
+
+            # -- phase 3: respawn + heal → whole within one poll ------------
+            await loop.run_in_executor(None, victim_mid.spawn)
+            await gkid.heal()
+            serving_deadline = time.monotonic() + 90.0
+            while time.monotonic() < serving_deadline:
+                if await mid_healthy(0):
+                    break
+                await asyncio.sleep(0.5)
+            t_heal = time.monotonic()
+            recovered = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                status, frame = await fetch_json(session, "/api/frame")
+                if (
+                    status == 200
+                    and frame
+                    and frame.get("error") is None
+                    and not (frame.get("federation") or {}).get("partial")
+                    and len(frame.get("chips") or []) == total
+                ):
+                    recovered = time.monotonic() - t_heal
+                    break
+                await asyncio.sleep(0.2)
+            if recovered is None:
+                failures.append("fleet never became whole after heal")
+            else:
+                numbers["recovered_after_s"] = round(recovered, 2)
+                # one poll + deadline, plus the breaker's worst-case
+                # jittered reopen and the MID's own convergence on its
+                # healed leaf (same budget shape, one level down)
+                budget = 2 * (
+                    interval
+                    + base_cfg.federate_deadline
+                    + base_cfg.breaker_cooldown * 1.5
+                ) + 3.0
+                if recovered > budget:
+                    failures.append(
+                        f"recovery took {recovered:.2f}s (> {budget:.2f}s)"
+                    )
+            numbers["peak_level_staleness_s"] = {
+                f"level{i}": round(v, 2)
+                for i, v in sorted(peak_levels.items())
+            }
+        finally:
+            await session.close()
+    except _DrillAbort:
+        pass
+    finally:
+        if root_runner is not None:
+            await root_runner.cleanup()
+        for tier in tiers:
+            tier.stop()
+        for row in kids:
+            for kid in row:
+                await kid.stop_raw()
+                await kid.stop()
+        logging.getLogger().removeHandler(trap)
+
+    if trap.records:
+        failures.append(
+            f"{len(trap.records)} unhandled exception(s) in the root: "
+            + trap.records[0][:500]
+        )
+    mid_tracebacks = {t.name: t.tracebacks() for t in tiers}
+    if any(mid_tracebacks.values()):
+        failures.append(
+            f"unhandled exceptions in mid-tier logs: {mid_tracebacks} "
+            f"(logs under {log_dir})"
+        )
+    numbers["mid_log_dir"] = log_dir
+    return {"ok": not failures, "failures": failures, **numbers}
+
+
 async def run_rangescatter_drill(
     children: int = 3, cfg: "Config | None" = None
 ) -> dict:
@@ -3047,6 +3522,17 @@ def main(argv: "list[str] | None" = None) -> None:
         "anti-flap dwell) and recover within one poll of heal",
     )
     pa.add_argument("--children", type=int, default=4)
+    ca = sub.add_parser(
+        "cascade",
+        help="fleets-of-fleets drill: a real 3-level tree (root × mid "
+        "subprocesses × leaf dashboards); SIGKILL a mid-tier parent and "
+        "partition a grandchild mid-storm — the root must stay 200 "
+        "with exact per-level stale/dark accounting, subtree-named "
+        "alerts, incremental-summary steady state, and recover within "
+        "one poll of heal",
+    )
+    ca.add_argument("--mids", type=int, default=4)
+    ca.add_argument("--leaves", type=int, default=4)
     rs = sub.add_parser(
         "rangescatter",
         help="analytics-plane drill: federated /api/range?agg=p99 "
@@ -3118,6 +3604,12 @@ def main(argv: "list[str] | None" = None) -> None:
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "partition":
         summary = asyncio.run(run_partition_drill(children=args.children))
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "cascade":
+        summary = asyncio.run(
+            run_cascade_drill(mids=args.mids, leaves=args.leaves)
+        )
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "rangescatter":

@@ -250,6 +250,69 @@ class Config:
     #: one slow TCP handshake must not cost the frame the whole
     #: deadline.  0 disables hedging.
     federate_hedge: float = 0.5
+    #: Stable identity of THIS node in a federated fleet ("" = derived
+    #: ``<hostname>-<port>``).  Every ``/api/summary`` document stamps it
+    #: into its aggregation ``path`` so a parent can refuse a child whose
+    #: subtree already contains the parent (cycle detection: A scraping B
+    #: scraping A is refused per child, never an infinite scrape loop).
+    #: Must be unique per instance and free of '/' and ','.
+    node_id: str = ""
+    #: Maximum federation depth a parent accepts (its own level
+    #: included): a child whose summary already aggregates ``max_depth``
+    #: levels is refused loudly — the parent's own depth never exceeds
+    #: ``max_depth`` — the backstop against pathological chains the
+    #: per-node cycle check cannot see (e.g. an ever-growing re-export
+    #: pipeline).  3 levels (root depth 2 → mid → leaf) fit the default
+    #: with room to spare.
+    federate_max_depth: int = 4
+    #: Child auto-discovery ("" = the static TPUDASH_FEDERATE list only):
+    #: ``register`` accepts POST /api/federation/register handshakes
+    #: (bearer-authenticated, heartbeat TTL below);
+    #: ``dns:<host>[:port]`` re-resolves the name every poll (headless
+    #: k8s Services publish one A record per ready pod);
+    #: ``k8s:<namespace>/<endpoints>[:port]`` watches an Endpoints object
+    #: through the in-cluster API (serviceaccount token).  Modes combine
+    #: with the static list; ``register`` combines with a watch source
+    #: (comma-separated, e.g. ``register,dns:slices.tpu:8050``).
+    federate_discovery: str = ""
+    #: Heartbeat TTL for registered children, seconds: a child that
+    #: hasn't re-registered within the TTL leaves the roster and fades
+    #: live → stale → dark through the ordinary staleness machinery
+    #: (never a silent vanish).  Registering children should re-POST
+    #: every ttl/3.
+    federate_register_ttl: float = 60.0
+    #: Join dwell, seconds: a discovered/registered child must stay
+    #: continuously present this long before it is admitted to the fleet
+    #: (0 = admitted on the next poll).  Damps membership churn from a
+    #: crash-looping slice.
+    federate_join_dwell: float = 0.0
+    #: Leave dwell, seconds: a child that disappears from discovery
+    #: (TTL expiry, DNS flap, deregistration) is retained in the roster
+    #: this long before retirement begins (0 = retire on the next poll).
+    #: A sub-dwell flap never churns fleet membership.
+    federate_leave_dwell: float = 0.0
+    #: Path for the persisted discovery roster ("" = derived from
+    #: TPUDASH_STATE_PATH + ".roster.json" when state is persisted,
+    #: else memory-only).  Registered children survive a parent restart:
+    #: they are granted one fresh TTL at load and must heartbeat within
+    #: it.
+    federate_roster: str = ""
+    #: Incremental summaries: a parent's poll advertises the ETag of the
+    #: last summary it decoded, and the child answers with a TDB1 delta
+    #: (changed-cell bitmap + qv cells against that base) instead of the
+    #: full document — steady-state fan-in bytes drop ≥3×.  Any base
+    #: mismatch falls back to the full doc unconditionally.  1 = on
+    #: (default); 0 pins full documents (escape hatch).
+    federate_summary_delta: bool = True
+    #: Child side of the registration handshake: comma-separated parent
+    #: base URLs this instance announces itself to (POST
+    #: /api/federation/register with the shared bearer token, re-posted
+    #: every ttl/3).  "" = no announcements.
+    federate_announce: str = ""
+    #: The URL this instance advertises when announcing ("" = derived
+    #: ``http://<hostname>:<port>``) — set it when the reachable address
+    #: differs from the bind (NAT, service VIP).
+    federate_advertise: str = ""
     #: Anti-flap dwell for synthesized alerts (endpoint_down, child_down,
     #: fleet_partial, and re-namespaced child alerts), seconds: once
     #: fired, an alert keeps firing (flagged ``dwell: true``) until its
@@ -459,6 +522,16 @@ _ENV_MAP = {
     "federate_deadline": "TPUDASH_FEDERATE_DEADLINE",
     "federate_stale_budget": "TPUDASH_FEDERATE_STALE_BUDGET",
     "federate_hedge": "TPUDASH_FEDERATE_HEDGE",
+    "node_id": "TPUDASH_NODE_ID",
+    "federate_max_depth": "TPUDASH_FEDERATE_MAX_DEPTH",
+    "federate_discovery": "TPUDASH_FEDERATE_DISCOVERY",
+    "federate_register_ttl": "TPUDASH_FEDERATE_REGISTER_TTL",
+    "federate_join_dwell": "TPUDASH_FEDERATE_JOIN_DWELL",
+    "federate_leave_dwell": "TPUDASH_FEDERATE_LEAVE_DWELL",
+    "federate_roster": "TPUDASH_FEDERATE_ROSTER",
+    "federate_summary_delta": "TPUDASH_FEDERATE_SUMMARY_DELTA",
+    "federate_announce": "TPUDASH_FEDERATE_ANNOUNCE",
+    "federate_advertise": "TPUDASH_FEDERATE_ADVERTISE",
     "alert_dwell": "TPUDASH_ALERT_DWELL",
     "rules": "TPUDASH_RULES",
     "rules_max_groups": "TPUDASH_RULES_MAX_GROUPS",

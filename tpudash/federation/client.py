@@ -13,16 +13,37 @@ from dataclasses import dataclass
 
 from tpudash.sources.base import SourceError
 
+#: request header a parent sets to the ETag of the last summary it
+#: DECODED — the child may answer with a TDB1 delta against that base
+#: instead of the full document (wire.KIND_SUMMARY_DELTA)
+SUMMARY_BASE_HEADER = "X-Tpudash-Summary-Base"
+
+
+class AuthError(SourceError):
+    """The child REJECTED this parent's credentials (HTTP 401/403).
+
+    Distinct from unreachable/malformed on purpose: a token-skewed child
+    is alive and healthy — counting the rejection toward its circuit
+    breaker would quarantine it like a partition and page ``child_down``
+    for what is an operator config error.  The fan-in surfaces it as
+    ``last_error: auth ...`` instead and keeps probing at the ordinary
+    poll cadence."""
+
 
 @dataclass(frozen=True)
 class SummaryResult:
     """One poll's outcome: ``not_modified`` means the child answered 304
     against ``etag`` (doc is None — the caller's cached summary stands);
-    otherwise ``doc`` is the fresh summary and ``etag`` its validator."""
+    otherwise ``doc`` is the fresh summary and ``etag`` its validator.
+    ``delta`` marks a doc reconstructed from an incremental body;
+    ``wire_bytes`` is what actually crossed the wire (fan-in cost
+    accounting — a delta's savings must be observable)."""
 
     doc: "dict | None"
     etag: "str | None"
     not_modified: bool = False
+    delta: bool = False
+    wire_bytes: int = 0
 
 
 class HttpSummaryClient:
@@ -38,12 +59,31 @@ class HttpSummaryClient:
     ``application/json``, so the fallback is the child's choice, not an
     extra round trip.  ``binary=False`` pins JSON (escape hatch)."""
 
-    def __init__(self, url: str, auth_token: str = "", binary: bool = True):
+    def __init__(
+        self,
+        url: str,
+        auth_token: str = "",
+        binary: bool = True,
+        delta: bool = True,
+    ):
         self.base = url.rstrip("/")
         self.auth_token = auth_token
         self.binary = binary
+        self.delta = bool(delta and binary)
 
-    def fetch(self, etag: "str | None", timeout: float) -> SummaryResult:
+    #: the fan-in passes a ``base`` kwarg (the last decoded doc + its
+    #: ETag) only to clients that declare support — fakes and pre-15
+    #: client shims keep the two-argument fetch signature
+    @property
+    def supports_delta(self) -> bool:
+        return self.delta
+
+    def fetch(
+        self,
+        etag: "str | None",
+        timeout: float,
+        base: "dict | None" = None,
+    ) -> SummaryResult:
         import requests
 
         from tpudash.app import wire
@@ -53,6 +93,16 @@ class HttpSummaryClient:
             headers["Accept"] = f"{wire.CONTENT_TYPE}, application/json"
         if etag:
             headers["If-None-Match"] = etag
+        if (
+            self.delta
+            and base is not None
+            and base.get("etag")
+            and wire._summary_matrix(base.get("doc") or {}) is not None
+        ):
+            # advertise the base we can reconstruct against; the child
+            # answers kind-7 when it still holds that document, the full
+            # doc otherwise (unconditional fallback on ANY mismatch)
+            headers[SUMMARY_BASE_HEADER] = base["etag"]
         if self.auth_token:
             headers["Authorization"] = f"Bearer {self.auth_token}"
         try:
@@ -63,11 +113,33 @@ class HttpSummaryClient:
             raise SourceError(f"summary fetch failed: {e}") from e
         if resp.status_code == 304:
             return SummaryResult(doc=None, etag=etag, not_modified=True)
+        if resp.status_code in (401, 403):
+            raise AuthError(
+                f"auth rejected (HTTP {resp.status_code}): the child "
+                "refused this parent's bearer token — fix the token skew; "
+                "the child is not down"
+            )
+        is_delta = False
         try:
             resp.raise_for_status()
             ctype = resp.headers.get("Content-Type", "")
             if ctype.startswith(wire.CONTENT_TYPE):
-                doc = wire.decode_summary(resp.content)
+                body = resp.content
+                if (
+                    len(body) >= 6
+                    and body[:4] == wire.MAGIC
+                    and body[5] == wire.KIND_SUMMARY_DELTA
+                ):
+                    if base is None:
+                        raise wire.WireError(
+                            "unsolicited summary delta (no base held)"
+                        )
+                    doc = wire.decode_summary_delta(
+                        body, base["doc"], base["etag"]
+                    )
+                    is_delta = True
+                else:
+                    doc = wire.decode_summary(body)
             else:
                 doc = resp.json()
         except (requests.RequestException, ValueError) as e:
@@ -76,7 +148,12 @@ class HttpSummaryClient:
             raise SourceError(
                 f"summary fetch failed: HTTP {resp.status_code}: {e}"
             ) from e
-        return SummaryResult(doc=doc, etag=resp.headers.get("ETag"))
+        return SummaryResult(
+            doc=doc,
+            etag=resp.headers.get("ETag"),
+            delta=is_delta,
+            wire_bytes=len(resp.content),
+        )
 
 
 class HttpRangeClient:

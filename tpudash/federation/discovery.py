@@ -1,0 +1,294 @@
+"""Child auto-discovery sources + the child-side announce handshake.
+
+``TPUDASH_FEDERATE_DISCOVERY`` grammar (comma-separated modes)::
+
+    register                      accept POST /api/federation/register
+    dns:<host>[:port]             re-resolve every poll (headless k8s
+                                  Services publish one A record per pod)
+    k8s:<namespace>/<name>[:port] watch an Endpoints object through the
+                                  in-cluster API (serviceaccount token)
+
+Watchers are polled at the START of every fan-in cycle — a slice joining
+the fleet appears within one poll, without a config push.  Failures
+degrade to the previous answer (logged once per error transition): a
+flaky resolver must not retire a healthy fleet.
+
+The :class:`Announcer` is the other half of the register handshake: a
+child configured with ``TPUDASH_FEDERATE_ANNOUNCE=<parent-url,...>``
+POSTs its (node id, advertised URL) to each parent every ttl/3 on a
+daemon thread, riding the shared bearer token.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+log = logging.getLogger("tpudash.federation")
+
+#: in-cluster serviceaccount credentials (the K8s watcher's defaults)
+K8S_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105 — a well-known mount path, not a secret
+K8S_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+K8S_API = "https://kubernetes.default.svc"
+
+
+def _addr_name(host: str, port: int) -> str:
+    """A discovered address as a key-separator-safe child name."""
+    return f"{host}:{port}".replace(":", "-").replace("/", "-")
+
+
+class DnsWatcher:
+    """``dns:<host>[:port]`` — every poll resolves the name and returns
+    one child per distinct A/AAAA answer.  Resolution runs on the
+    fan-in's dispatch thread (already blocking-I/O territory)."""
+
+    kind = "dns"
+
+    def __init__(self, spec: str, default_port: int = 8050):
+        host, _, port = spec.partition(":")
+        if not host:
+            raise ValueError(f"bad dns discovery spec {spec!r}")
+        self.host = host
+        self.port = int(port) if port else default_port
+        self.last_error: "str | None" = None
+        self._last: "dict[str, str]" = {}
+
+    def poll(self) -> "dict[str, str]":
+        import socket
+
+        try:
+            infos = socket.getaddrinfo(
+                self.host, self.port, type=socket.SOCK_STREAM
+            )
+        except OSError as e:
+            if self.last_error is None:
+                log.warning(
+                    "federation dns discovery %s failed: %s", self.host, e
+                )
+            self.last_error = str(e)
+            return self._last  # degrade to the previous answer
+        if self.last_error is not None:
+            log.info("federation dns discovery %s recovered", self.host)
+            self.last_error = None
+        out: "dict[str, str]" = {}
+        for family, _t, _p, _c, sockaddr in infos:
+            ip = sockaddr[0]
+            host = f"[{ip}]" if ":" in ip else ip
+            out[_addr_name(ip, self.port)] = f"http://{host}:{self.port}"
+        self._last = out
+        return out
+
+
+class K8sEndpointsWatcher:
+    """``k8s:<namespace>/<name>[:port]`` — polls the Endpoints object
+    through the in-cluster API with the serviceaccount token.  Missing
+    credentials (not running in a pod) degrade loudly to an empty
+    answer; a transient API error degrades to the previous one.  The
+    fetcher is injectable so tests never need a cluster."""
+
+    kind = "k8s"
+
+    def __init__(self, spec: str, default_port: int = 8050, fetcher=None):
+        body, _, port = spec.partition(":")
+        ns, _, name = body.partition("/")
+        if not ns or not name:
+            raise ValueError(
+                f"bad k8s discovery spec {spec!r} "
+                "(grammar: k8s:<namespace>/<endpoints-name>[:port])"
+            )
+        self.namespace = ns
+        self.name = name
+        #: 0 = no explicit port in the spec: the Endpoints object's OWN
+        #: declared port wins (children rarely serve on the parent's
+        #: bind port), with ``default_port`` as the last resort
+        self.port = int(port) if port else 0
+        self.default_port = default_port
+        self.last_error: "str | None" = None
+        self._last: "dict[str, str]" = {}
+        self._fetch = fetcher or self._http_fetch
+
+    def _http_fetch(self) -> dict:
+        import requests
+
+        try:
+            with open(K8S_TOKEN_PATH, encoding="ascii") as f:
+                token = f.read().strip()
+        except OSError as e:
+            raise RuntimeError(
+                f"no serviceaccount token ({e}) — k8s discovery needs an "
+                "in-cluster pod (or use dns:/register discovery)"
+            ) from e
+        import os
+
+        verify = K8S_CA_PATH if os.path.exists(K8S_CA_PATH) else True
+        resp = requests.get(
+            f"{K8S_API}/api/v1/namespaces/{self.namespace}"
+            f"/endpoints/{self.name}",
+            headers={"Authorization": f"Bearer {token}"},
+            timeout=4.0,
+            verify=verify,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def poll(self) -> "dict[str, str]":
+        try:
+            doc = self._fetch()
+        # the API surface spans requests/OS/JSON errors; ANY of them
+        # degrades discovery to the last answer, never the fan-in
+        # tpulint: allow[broad-except] degrade discovery, not the fleet
+        except Exception as e:  # noqa: BLE001
+            if self.last_error is None:
+                log.warning(
+                    "federation k8s discovery %s/%s failed: %s",
+                    self.namespace,
+                    self.name,
+                    e,
+                )
+            self.last_error = str(e)
+            return self._last
+        if self.last_error is not None:
+            log.info(
+                "federation k8s discovery %s/%s recovered",
+                self.namespace,
+                self.name,
+            )
+            self.last_error = None
+        out: "dict[str, str]" = {}
+        for subset in (doc.get("subsets") or []):
+            ports = [
+                p.get("port")
+                for p in (subset.get("ports") or [])
+                if p.get("port")
+            ]
+            port = self.port or (
+                ports[0] if ports else self.default_port
+            )
+            for addr in (subset.get("addresses") or []):
+                ip = addr.get("ip")
+                if not ip:
+                    continue
+                host = f"[{ip}]" if ":" in ip else ip
+                name = (
+                    (addr.get("targetRef") or {}).get("name")
+                    or _addr_name(ip, port)
+                ).replace("/", "-").replace(",", "-")
+                out[name] = f"http://{host}:{port}"
+        self._last = out
+        return out
+
+
+def parse_discovery(spec: str, default_port: int = 8050):
+    """(register_enabled, [watchers]) from the discovery grammar; raises
+    ValueError on an unknown mode — a typo'd knob must fail loudly at
+    startup, not silently discover nothing forever."""
+    register = False
+    watchers: list = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if item == "register":
+            register = True
+        elif item.startswith("dns:"):
+            watchers.append(DnsWatcher(item[4:], default_port))
+        elif item.startswith("k8s:"):
+            watchers.append(K8sEndpointsWatcher(item[4:], default_port))
+        else:
+            raise ValueError(
+                f"bad TPUDASH_FEDERATE_DISCOVERY mode {item!r} "
+                "(register | dns:<host>[:port] | k8s:<ns>/<name>[:port])"
+            )
+    return register, watchers
+
+
+class Announcer:
+    """The child side of the register handshake: POST this node's
+    (name, url) to every configured parent, re-posted each ttl/3 so the
+    parent's heartbeat TTL never expires while the child lives.  Runs on
+    a daemon thread; failures log once per state change and never touch
+    the serving path."""
+
+    def __init__(
+        self,
+        parents: "list[str]",
+        name: str,
+        url: str,
+        auth_token: str = "",
+        ttl: float = 60.0,
+        interval: "float | None" = None,
+    ):
+        self.parents = [p.rstrip("/") for p in parents if p.strip()]
+        self.name = name
+        self.url = url
+        self.auth_token = auth_token
+        self.ttl = ttl
+        self.interval = interval if interval is not None else max(
+            1.0, ttl / 3.0
+        )
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._failing: "set[str]" = set()
+        self.announced = 0
+
+    def announce_once(self) -> int:
+        """One round of POSTs; returns how many parents accepted."""
+        import requests
+
+        ok = 0
+        headers = {}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        body = {"name": self.name, "url": self.url, "ttl": self.ttl}
+        intervals: "list[float]" = []
+        for parent in self.parents:
+            try:
+                resp = requests.post(
+                    f"{parent}/api/federation/register",
+                    json=body,
+                    headers=headers,
+                    timeout=4.0,
+                )
+                resp.raise_for_status()
+            except requests.RequestException as e:
+                if parent not in self._failing:
+                    log.warning(
+                        "federation announce to %s failed: %s", parent, e
+                    )
+                    self._failing.add(parent)
+                continue
+            if parent in self._failing:
+                log.info("federation announce to %s recovered", parent)
+                self._failing.discard(parent)
+            ok += 1
+            # adopt the PARENT's advertised cadence: a parent whose TTL
+            # is shorter than this child's default would otherwise
+            # expire-and-rejoin the child on every heartbeat forever
+            try:
+                iv = (resp.json() or {}).get("interval")
+                if isinstance(iv, (int, float)) and iv > 0:
+                    intervals.append(float(iv))
+            except ValueError:
+                pass  # a pre-15 parent answered something else; keep ours
+        if intervals:
+            self.interval = max(1.0, min(intervals))
+        self.announced += ok
+        return ok
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.announce_once()
+            self._stop.wait(self.interval)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tpudash-announce", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

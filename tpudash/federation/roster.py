@@ -1,0 +1,276 @@
+"""The federation parent's dynamic membership ledger.
+
+Static children (TPUDASH_FEDERATE) never leave; everything else —
+registration handshakes, DNS answers, K8s Endpoints — flows through this
+roster, which owns the three membership behaviors the fan-in must not
+re-implement per source:
+
+- **TTL expiry** (``register`` entries): a child that stops
+  heart-beating leaves the roster after ``TPUDASH_FEDERATE_REGISTER_TTL``
+  seconds and fades live → stale → dark through the fan-in's ordinary
+  staleness machinery — never a silent vanish.
+- **join/leave dwell** (anti-flap): a discovered child must stay
+  continuously present ``join_dwell`` seconds before admission, and a
+  child that disappears is retained ``leave_dwell`` seconds before
+  retirement begins.  The leave edge reuses :class:`tpudash.hysteresis.
+  DwellSet` — membership presence is exactly a firing condition whose
+  resolve needs debouncing, and one implementation must not fork.
+- **persistence**: registered children survive a parent restart
+  (atomic JSON beside the state checkpoint); each is granted ONE fresh
+  TTL at load and must heartbeat within it.
+
+Thread-safe: the register endpoint mutates from the event loop's
+executor while the fan-in reads on its refresh thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from tpudash.hysteresis import DwellSet
+
+log = logging.getLogger("tpudash.federation")
+
+#: entry provenance — static entries are owned by config, watch entries
+#: by their watcher's latest answer, register entries by the TTL clock
+SRC_STATIC = "static"
+SRC_REGISTER = "register"
+SRC_WATCH = "watch"
+
+
+class Roster:
+    def __init__(
+        self,
+        path: str = "",
+        ttl: float = 60.0,
+        join_dwell: float = 0.0,
+        leave_dwell: float = 0.0,
+        clock=time.monotonic,
+    ):
+        self.path = path
+        self.ttl = max(1.0, float(ttl))
+        self.join_dwell = max(0.0, float(join_dwell))
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: name → {"url", "source", "last_seen_m", "first_seen_m",
+        #:         "registered_ts"}
+        self._entries: "dict[str, dict]" = {}
+        #: resolve-side debounce over membership (see module doc): a
+        #: departed entry keeps "firing" — staying a member — until it
+        #: has been absent leave_dwell seconds
+        self._leave = DwellSet(dwell_s=max(0.0, float(leave_dwell)), clock=clock)
+        #: last URL each name served under — what a dwell-held member
+        #: keeps resolving to after its entry is gone
+        self._urls: "dict[str, str]" = {}
+        self._load()
+
+    # -- mutation (register endpoint / watchers) -----------------------------
+    def upsert(self, name: str, url: str, source: str = SRC_REGISTER) -> bool:
+        """Add or refresh one member; returns True when membership or
+        its URL changed (callers persist on change, not per heartbeat).
+        Raises ValueError when a non-static source collides with a
+        config-declared name — silently accepting would leave the new
+        instance invisible while it heartbeats forever believing it
+        joined (the register endpoint surfaces this as a 400; watchers
+        skip the name)."""
+        now = self._clock()
+        with self._lock:
+            e = self._entries.get(name)
+            if (
+                e is not None
+                and e["source"] == SRC_STATIC
+                and source != SRC_STATIC
+            ):
+                # config-declared members are owned by config: a register
+                # POST (or a DNS answer) colliding with a static child's
+                # name must not re-tag it into TTL-expirable provenance —
+                # that would let a heartbeat lapse prune a child the
+                # operator explicitly listed
+                raise ValueError(
+                    f"child name {name!r} is config-declared "
+                    "(TPUDASH_FEDERATE) — static members cannot be "
+                    "re-registered; pick a different TPUDASH_NODE_ID"
+                )
+            changed = e is None or e["url"] != url or e["source"] != source
+            if e is None:
+                e = self._entries[name] = {
+                    "url": url,
+                    "source": source,
+                    "first_seen_m": now,
+                    # tpulint: allow[wall-clock] roster stamps survive restarts
+                    "registered_ts": time.time(),
+                }
+            e["url"] = url
+            e["source"] = source
+            e["last_seen_m"] = now
+            self._urls[name] = url
+        if changed and source == SRC_REGISTER:
+            self._save()
+        return changed
+
+    def remove(self, name: str) -> bool:
+        """Explicit deregistration: the entry leaves now; the leave
+        dwell still applies (a register/deregister flap never churns
+        membership faster than the dwell).  Static entries refuse —
+        config-declared members leave by config change, not by any
+        bearer-holding client POSTing ``leave``."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None and e["source"] == SRC_STATIC:
+                return False
+            e = self._entries.pop(name, None)
+        if e is not None and e.get("source") == SRC_REGISTER:
+            self._save()
+        return e is not None
+
+    def sync_watch(self, current: "dict[str, str]") -> None:
+        """One watcher answer: upsert every discovered (name, url);
+        watch entries absent from ``current`` are dropped (the leave
+        dwell holds them as members for its window)."""
+        with self._lock:
+            stale = [
+                n
+                for n, e in self._entries.items()
+                if e["source"] == SRC_WATCH and n not in current
+            ]
+            for n in stale:
+                del self._entries[n]
+        for name, url in current.items():
+            try:
+                self.upsert(name, url, source=SRC_WATCH)
+            except ValueError:
+                # the name is config-declared — the static entry wins;
+                # the watcher's answer for it is ignored
+                continue
+
+    # -- the membership view the fan-in polls --------------------------------
+    def membership(self) -> "dict[str, str]":
+        """name → url of every ADMITTED member right now: TTL-expired
+        register entries dropped, the join dwell applied to fresh
+        entries, the leave dwell holding recent departures."""
+        now = self._clock()
+        with self._lock:
+            expired = [
+                n
+                for n, e in self._entries.items()
+                if e["source"] == SRC_REGISTER
+                and now - e["last_seen_m"] > self.ttl
+            ]
+            for n in expired:
+                log.warning(
+                    "federation roster: child %r heartbeat expired "
+                    "(> %gs) — retiring (fades stale → dark)",
+                    n,
+                    self.ttl,
+                )
+                del self._entries[n]
+            present = [
+                n
+                for n, e in self._entries.items()
+                if e["source"] == SRC_STATIC
+                or now - e["first_seen_m"] >= self.join_dwell
+            ]
+        if expired:
+            # a restart must not resurrect an already-expired child
+            self._save()
+        held = self._leave.apply(
+            [
+                {"rule": "member", "chip": n, "state": "firing"}
+                for n in present
+            ],
+            now,
+        )
+        out = {
+            e["chip"]: self._urls.get(e["chip"], "")
+            for e in held
+            if self._urls.get(e["chip"])
+        }
+        with self._lock:
+            # prune the URL memory once a departure's dwell has fully
+            # expired — dns: discovery names members per pod IP, and a
+            # long-lived parent over months of pod churn must not hoard
+            # one dead string per address ever seen
+            keep = set(self._entries) | set(out)
+            if len(self._urls) > len(keep):
+                self._urls = {
+                    n: u for n, u in self._urls.items() if n in keep
+                }
+        return out
+
+    def snapshot(self) -> "list[dict]":
+        """Observability: every raw entry (pre-dwell) for /api/timings
+        and the register endpoint's response."""
+        now = self._clock()
+        with self._lock:
+            return [
+                {
+                    "name": n,
+                    "url": e["url"],
+                    "source": e["source"],
+                    "age_s": round(max(0.0, now - e["last_seen_m"]), 3),
+                    "registered_ts": e.get("registered_ts"),
+                }
+                for n, e in sorted(self._entries.items())
+            ]
+
+    # -- persistence ---------------------------------------------------------
+    def _save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            doc = {
+                n: {
+                    "url": e["url"],
+                    "registered_ts": e.get("registered_ts"),
+                }
+                for n, e in self._entries.items()
+                if e["source"] == SRC_REGISTER
+            }
+        # per-writer tmp name: two concurrent registrations (separate
+        # executor threads) each write their OWN staging file and the
+        # atomic replace is last-writer-wins with VALID json either way
+        # — a shared tmp path would interleave the dumps
+        tmp = f"{self.path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            log.warning("federation roster save failed: %s", e)
+
+    def _load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("federation roster load failed: %s", e)
+            return
+        now = self._clock()
+        if not isinstance(doc, dict):
+            return
+        with self._lock:
+            for name, e in doc.items():
+                if not isinstance(e, dict) or not e.get("url"):
+                    continue
+                # one fresh TTL: the child heartbeats within it or fades.
+                # first_seen backdated past the join dwell — a restart
+                # must not re-apply the join debounce to a known member
+                self._entries[str(name)] = {
+                    "url": str(e["url"]),
+                    "source": SRC_REGISTER,
+                    "first_seen_m": now - self.join_dwell,
+                    "last_seen_m": now,
+                    "registered_ts": e.get("registered_ts"),
+                }
+                self._urls[str(name)] = str(e["url"])
+        if self._entries:
+            log.info(
+                "federation roster: restored %d registered children",
+                len(self._entries),
+            )

@@ -38,11 +38,18 @@ import time
 
 from tpudash.config import Config
 from tpudash.federation.client import (
+    AuthError,
     HttpRangeClient,
     HttpSummaryClient,
     SummaryResult,
 )
-from tpudash.federation.summary import digest_alerts, summary_to_batch
+from tpudash.federation.discovery import parse_discovery
+from tpudash.federation.roster import SRC_STATIC, Roster
+from tpudash.federation.summary import (
+    digest_alerts,
+    node_identity,
+    summary_to_batch,
+)
 from tpudash.schema import SampleBatch
 from tpudash.sources.base import MetricsSource, SourceError
 from tpudash.sources.breaker import BreakerPolicy, CircuitBreaker
@@ -113,12 +120,12 @@ def parse_replicas(spec: str) -> "dict[str, str]":
     return out
 
 
-def parse_children(spec: str) -> "list[ChildSpec]":
+def parse_children(spec: str, allow_empty: bool = False) -> "list[ChildSpec]":
     out = [ChildSpec.parse(s) for s in spec.split(",") if s.strip()]
-    if not out:
+    if not out and not allow_empty:
         raise ValueError(
             "federation needs TPUDASH_FEDERATE (comma-separated [name=]url "
-            "child dashboards)"
+            "child dashboards) or TPUDASH_FEDERATE_DISCOVERY"
         )
     seen: set = set()
     for c in out:
@@ -146,12 +153,23 @@ class _ChildState:
         "last_ok",
         "has_table",
         "counters",
+        "retired_m",
+        "cycle",
     )
 
     def __init__(self, spec: ChildSpec, client):
         self.spec = spec
         self.client = client
         self.etag: "str | None" = None
+        #: monotonic stamp of this child leaving the roster (discovery
+        #: expiry / deregistration).  A retired child is no longer
+        #: polled; its retained rows fade live → stale → dark on the
+        #: ordinary staleness machinery, then the entry is pruned.
+        self.retired_m: "float | None" = None
+        #: cycle-refusal message when this child's summary contains THIS
+        #: parent in its aggregation path — the distinct loud alert
+        #: (``federation_cycle``) reads it
+        self.cycle: "str | None" = None
         #: last successfully-parsed table (slices already re-labeled) —
         #: RETAINED across polls whose doc carries no table (a child
         #: restarting against a dead upstream answers 200 with an error
@@ -175,6 +193,10 @@ class _ChildState:
             "etag_304s": 0,
             "hedges": 0,
             "hedge_wins": 0,
+            "deltas": 0,
+            "delta_bytes": 0,
+            "full_bytes": 0,
+            "auth_errors": 0,
         }
 
 
@@ -193,45 +215,68 @@ class FederatedSource(MetricsSource):
         from cfg.federate.  A client is any object with
         ``fetch(etag, timeout) -> SummaryResult`` raising SourceError."""
         self.cfg = cfg
-        if children is None:
-            children = [
-                (spec, HttpSummaryClient(spec.url, cfg.auth_token))
-                for spec in parse_children(cfg.federate)
-            ]
+        #: this parent's own id — a child whose summary ``path`` already
+        #: contains it is a CYCLE and is refused per child (the A→B→A
+        #: edge that would otherwise scrape-loop forever)
+        self.node_id = node_identity(cfg)
+        self.max_depth = max(
+            1, int(getattr(cfg, "federate_max_depth", 4) or 4)
+        )
         if probe_jitter is None:
             probe_jitter = (
                 getattr(cfg, "breaker_jitter", 0.0) or DEFAULT_PROBE_JITTER
             )
-        policy = BreakerPolicy(
+        self._policy = BreakerPolicy(
             failures=getattr(cfg, "breaker_failures", 3),
             cooldown=getattr(cfg, "breaker_cooldown", 30.0),
             probe_jitter=probe_jitter,
         )
         self._clock = clock
-        self._children: "list[_ChildState]" = [
-            _ChildState(spec, client) for spec, client in children
-        ]
+        # discovery (PR 15): a loud parse at startup — a typo'd mode
+        # must not silently discover nothing forever
+        self.register_enabled, self._watchers = parse_discovery(
+            getattr(cfg, "federate_discovery", "") or "",
+            default_port=getattr(cfg, "port", 8050) or 8050,
+        )
+        dynamic = self.register_enabled or bool(self._watchers)
+        roster_path = getattr(cfg, "federate_roster", "") or ""
+        if not roster_path and dynamic and getattr(cfg, "state_path", ""):
+            roster_path = f"{cfg.state_path}.roster.json"
+        self.roster = Roster(
+            path=roster_path if dynamic else "",
+            ttl=getattr(cfg, "federate_register_ttl", 60.0) or 60.0,
+            join_dwell=getattr(cfg, "federate_join_dwell", 0.0) or 0.0,
+            leave_dwell=getattr(cfg, "federate_leave_dwell", 0.0) or 0.0,
+            clock=clock,
+        )
+        #: injected (spec, client) pairs — tests and the bench; dynamic
+        #: admission builds real HttpSummaryClients for everything else
+        self._injected: "dict[str, tuple]" = {}
+        if children is not None:
+            specs = [spec for spec, _ in children]
+            self._injected = {
+                spec.name: (spec, client) for spec, client in children
+            }
+        else:
+            specs = parse_children(
+                cfg.federate, allow_empty=dynamic
+            )
+        self._children: "list[_ChildState]" = []
         # `breakers` / `last_errors` / `_last_fault` use MultiSource's
         # exact attribute names ON PURPOSE: synthetic_load's rollback
         # walk (app/service.py) discovers them by name, so a profiling
         # burst can't open — or reclose — a breaker the real poll
         # cadence owns
-        self.breakers: "dict[str, CircuitBreaker]" = {
-            st.spec.name: CircuitBreaker(policy, clock=clock)
-            for st in self._children
-        }
+        self.breakers: "dict[str, CircuitBreaker]" = {}
         # the range scatter (PR 13) runs under the SAME breaker policy
         # but its own instances: an expensive analytical query timing
         # out must quarantine the child's RANGE plane, not darken its
         # perfectly healthy summary feed in the fleet frame
-        self.range_breakers: "dict[str, CircuitBreaker]" = {
-            st.spec.name: CircuitBreaker(policy, clock=clock)
-            for st in self._children
-        }
-        self._range_clients = {
-            st.spec.name: HttpRangeClient(st.spec.url, cfg.auth_token)
-            for st in self._children
-        }
+        self.range_breakers: "dict[str, CircuitBreaker]" = {}
+        self._range_clients: "dict[str, HttpRangeClient]" = {}
+        for spec in specs:
+            self.roster.upsert(spec.name, spec.url, source=SRC_STATIC)
+            self._ensure_child(spec.name, spec.url)
         #: follower read replicas (TPUDASH_RANGE_REPLICAS): tried when a
         #: child's range query fails or its range breaker is open
         self._replica_clients: "dict[str, object]" = {}
@@ -239,7 +284,9 @@ class FederatedSource(MetricsSource):
             for name, url in parse_replicas(
                 getattr(cfg, "range_replicas", "") or ""
             ).items():
-                if name in self._range_clients:
+                if name in self._range_clients or dynamic:
+                    # under discovery the child may simply not have
+                    # joined yet — keep the replica for when it does
                     self._replica_clients[name] = HttpRangeClient(
                         url, cfg.auth_token
                     )
@@ -263,6 +310,124 @@ class FederatedSource(MetricsSource):
         #: compose/healthz) against the refresh thread's state swaps;
         #: critical sections are pure pointer/dict work, never I/O
         self._lock = threading.Lock()
+
+    # -- dynamic membership (discovery / registration, PR 15) ----------------
+    def _ensure_child(self, name: str, url: str) -> _ChildState:
+        """Materialize one member: child state + both breakers + range
+        client.  Called at init (no lock needed) and from _sync_children
+        (caller holds ``self._lock``)."""
+        inj = self._injected.get(name)
+        if inj is not None and inj[0].url == url.rstrip("/"):
+            spec, client = inj
+        else:
+            spec = ChildSpec(name, url)
+            client = HttpSummaryClient(
+                spec.url,
+                self.cfg.auth_token,
+                delta=bool(
+                    getattr(self.cfg, "federate_summary_delta", True)
+                ),
+            )
+        st = _ChildState(spec, client)
+        self._children.append(st)
+        self.breakers[name] = CircuitBreaker(self._policy, clock=self._clock)
+        self.range_breakers[name] = CircuitBreaker(
+            self._policy, clock=self._clock
+        )
+        self._range_clients[name] = HttpRangeClient(
+            spec.url, self.cfg.auth_token
+        )
+        return st
+
+    def _prune_child(self, name: str) -> None:
+        """Drop every trace of a retired-and-dark member.  Caller holds
+        ``self._lock``."""
+        self._children = [
+            st for st in self._children if st.spec.name != name
+        ]
+        self.breakers.pop(name, None)
+        self.range_breakers.pop(name, None)
+        self._range_clients.pop(name, None)
+        self._inflight.pop(name, None)
+        self._last_fault.pop(name, None)
+
+    def _sync_children(self) -> None:
+        """Reconcile the live child set against the roster — the first
+        step of every fan-in, so a slice that registered (or appeared in
+        DNS) since the last poll joins THIS poll.  Departures retire
+        (stop polling, fade stale → dark on retained rows) rather than
+        vanish; a retired member that re-appears before fading out
+        resumes in place."""
+        discovered: "dict[str, str]" = {}
+        for w in self._watchers:
+            discovered.update(w.poll())
+        if self._watchers:
+            self.roster.sync_watch(discovered)
+        member = self.roster.membership()
+        now_m = self._clock()
+        with self._lock:
+            have = {st.spec.name: st for st in self._children}
+            for name, url in member.items():
+                st = have.get(name)
+                if st is None:
+                    log.info("federation: child %s joined (%s)", name, url)
+                    try:
+                        self._ensure_child(name, url)
+                    except ValueError as e:
+                        log.warning(
+                            "federation: discovered child %r refused: %s",
+                            name,
+                            e,
+                        )
+                elif st.retired_m is not None:
+                    log.info("federation: child %s re-joined", name)
+                    st.retired_m = None
+                elif st.spec.url != url.rstrip("/"):
+                    # the member moved address: a clean rebuild (the old
+                    # retained rows describe a process that is gone)
+                    log.info(
+                        "federation: child %s moved %s → %s",
+                        name,
+                        st.spec.url,
+                        url,
+                    )
+                    self._prune_child(name)
+                    self._ensure_child(name, url)
+            for name, st in have.items():
+                if name in member:
+                    continue
+                if st.retired_m is None:
+                    st.retired_m = now_m
+                    log.warning(
+                        "federation: child %s left the roster — its "
+                        "last-good rows fade stale → dark, then drop",
+                        name,
+                    )
+                elif self._child_status(st, now_m)[0] == STATUS_DARK:
+                    self._prune_child(name)
+
+    def register_child(self, name: str, url: str) -> float:
+        """The POST /api/federation/register handler's entry point:
+        validate the (name, url) pair under ChildSpec's grammar, admit
+        it to the roster, return the heartbeat TTL the child must beat.
+        Raises PermissionError when register discovery is off and
+        ValueError on a bad name/url."""
+        if not self.register_enabled:
+            raise PermissionError(
+                "registration discovery is off "
+                "(set TPUDASH_FEDERATE_DISCOVERY=register)"
+            )
+        spec = ChildSpec(name, url)  # validates both
+        self.roster.upsert(spec.name, spec.url)
+        return self.roster.ttl
+
+    def deregister_child(self, name: str) -> bool:
+        if not self.register_enabled:
+            raise PermissionError(
+                "registration discovery is off "
+                "(set TPUDASH_FEDERATE_DISCOVERY=register)"
+            )
+        return self.roster.remove(name)
 
     # -- knobs ---------------------------------------------------------------
     @property
@@ -290,7 +455,20 @@ class FederatedSource(MetricsSource):
         thread; every request is itself deadline-bounded."""
         deadline, hedge = self.deadline, self.hedge
         end = time.monotonic() + deadline
-        call = functools.partial(st.client.fetch, st.etag, deadline)
+        if getattr(st.client, "supports_delta", False):
+            # advertise the last decoded doc as an incremental base; the
+            # child falls back to the full doc on ANY mismatch.  Fakes
+            # and pre-15 clients keep the two-argument signature.
+            base = (
+                {"etag": st.etag, "doc": st.last_doc}
+                if st.etag and st.last_doc is not None
+                else None
+            )
+            call = functools.partial(
+                st.client.fetch, st.etag, deadline, base=base
+            )
+        else:
+            call = functools.partial(st.client.fetch, st.etag, deadline)
         primary = _FetchTask(call)
         tasks = [primary]
         backup = None
@@ -306,6 +484,10 @@ class FederatedSource(MetricsSource):
                 tasks.remove(t)
                 try:
                     res = t.result()
+                except AuthError:
+                    # credential rejection is deterministic — hedging or
+                    # waiting out the deadline cannot change the verdict
+                    raise
                 except SourceError as e:  # noqa: PERF203 — per-attempt verdict
                     errors.append(str(e))
                     continue
@@ -325,9 +507,20 @@ class FederatedSource(MetricsSource):
 
     # -- the fan-in ----------------------------------------------------------
     def fetch(self):
+        try:
+            self._sync_children()
+        # discovery is additive machinery: a watcher/roster bug must
+        # degrade to the previous membership, never error the frame
+        # tpulint: allow[broad-except] membership sync is best-effort
+        except Exception as e:  # noqa: BLE001
+            log.warning("federation: membership sync failed: %s", e)
         errors: "dict[str, str]" = {}
         pending: "list[tuple[_ChildState, _FetchTask]]" = []
-        for st in self._children:
+        with self._lock:
+            children = list(self._children)
+        for st in children:
+            if st.retired_m is not None:
+                continue  # fading out — retained rows serve, no polls
             name = st.spec.name
             breaker = self.breakers[name]
             old = self._inflight.get(name)
@@ -375,6 +568,25 @@ class FederatedSource(MetricsSource):
                 self._inflight.pop(name, None)
                 try:
                     res = fut.result()
+                except AuthError as e:
+                    # the child is ALIVE and rejecting this parent's
+                    # token — a config skew, not a partition.  Surfaced
+                    # as last_error without a breaker failure: the
+                    # breaker ledger must not page child_down (and then
+                    # quarantine probes) for an operator error the child
+                    # cannot heal on its own.  The rejection IS contact
+                    # (an HTTP answer arrived), so the contact stamp
+                    # advances — without it the child would age through
+                    # the stale budget into dark and page child_down,
+                    # defeating the whole distinction.
+                    errors[name] = self._last_fault[name] = str(e)
+                    st.counters["auth_errors"] += 1
+                    st.last_ok = False
+                    st.last_contact_m = self._clock()
+                    log.warning(
+                        "federation: child %s rejected auth: %s", name, e
+                    )
+                    continue
                 except SourceError as e:
                     errors[name] = self._last_fault[name] = str(e)
                     breaker.record_failure()
@@ -416,6 +628,38 @@ class FederatedSource(MetricsSource):
                 st.last_contact_m = now_m
                 st.last_ok = True
             return None
+        doc = res.doc
+        if isinstance(doc, dict):
+            # recursive-aggregation guards (PR 15), BEFORE any parse
+            # work: a child whose subtree already contains THIS parent
+            # is a cycle — refused per child, with a distinct marker the
+            # ``federation_cycle`` alert reads; a chain deeper than the
+            # cap is refused just as loudly (the backstop against
+            # pathological re-export pipelines).
+            path = doc.get("path")
+            if isinstance(path, (list, tuple)) and self.node_id in path:
+                msg = (
+                    f"cycle refused: this parent ({self.node_id}) is "
+                    f"already in child {st.spec.name!r}'s aggregation "
+                    "path — break the loop (A scraping B scraping A "
+                    "double-counts every chip and never converges)"
+                )
+                with self._lock:
+                    st.last_ok = False
+                    st.cycle = msg
+                return msg
+            depth = doc.get("depth")
+            if (
+                isinstance(depth, (int, float))
+                and int(depth) + 1 > self.max_depth
+            ):
+                with self._lock:
+                    st.last_ok = False
+                return (
+                    f"depth refused: child aggregates {int(depth)} "
+                    f"level(s), making this parent level {int(depth) + 1} "
+                    f"> TPUDASH_FEDERATE_MAX_DEPTH={self.max_depth}"
+                )
         try:
             batch = summary_to_batch(st.spec.name, res.doc)
         # the doc is UNTRUSTED wire input from another (possibly
@@ -429,6 +673,12 @@ class FederatedSource(MetricsSource):
             return f"malformed summary: {type(e).__name__}: {e}"
         with self._lock:
             st.counters["fetches"] += 1
+            if res.delta:
+                st.counters["deltas"] += 1
+                st.counters["delta_bytes"] += res.wire_bytes
+            else:
+                st.counters["full_bytes"] += res.wire_bytes
+            st.cycle = None
             st.etag = res.etag
             st.last_doc = res.doc
             st.last_contact_m = now_m
@@ -456,6 +706,17 @@ class FederatedSource(MetricsSource):
         healthy children into stale/dark — it serves its cache with
         ``last_updated``/``staleness_s`` carrying the honest age, and
         the next viewer's poll re-measures everything."""
+        if st.retired_m is not None:
+            # roster departure (TTL expiry / deregistration / discovery
+            # drop): polling stopped, so contact age freezes at the
+            # retirement edge and the member fades stale → dark on the
+            # SAME stale budget a partition would — never a vanish
+            if st.last_contact_m is None or st.last_table_m is None:
+                return STATUS_DARK, max(0.0, now_m - st.retired_m)
+            staleness = max(0.0, now_m - st.last_contact_m)
+            if staleness <= self.stale_budget:
+                return STATUS_STALE, staleness
+            return STATUS_DARK, staleness
         if st.last_contact_m is None:
             return STATUS_DARK, float("inf")
         staleness = max(0.0, now_m - st.last_contact_m)
@@ -489,9 +750,18 @@ class FederatedSource(MetricsSource):
                     continue
                 batches.append(st.last_batch)
         if not any(b.nrows for b in batches):
+            if not self._children and (
+                self.register_enabled or self._watchers
+            ):
+                raise SourceError(
+                    "no federated children discovered yet (discovery: "
+                    f"{getattr(self.cfg, 'federate_discovery', '')!r}) — "
+                    "waiting for registrations/endpoints"
+                )
             detail = "; ".join(
-                f"{k}: {v} [breaker {self.breakers[k].state}]"
+                f"{k}: {v} [breaker {b.state}]"
                 for k, v in errors.items()
+                if (b := self.breakers.get(k)) is not None
             ) or "no child has ever answered"
             raise SourceError(
                 f"all {len(self._children)} federated children dark: {detail}"
@@ -569,10 +839,12 @@ class FederatedSource(MetricsSource):
         now_m = self._clock()
         with self._lock:
             self.range_counters["scatters"] += 1
-        targets = [
-            st for st in self._children
-            if child is None or st.spec.name == child
-        ]
+        with self._lock:
+            targets = [
+                st
+                for st in self._children
+                if child is None or st.spec.name == child
+            ]
         accounting: "dict[str, dict]" = {}
         with self._lock:
             staleness = {
@@ -583,7 +855,12 @@ class FederatedSource(MetricsSource):
         need_replica: "list[tuple[str, str]]" = []  # (name, reason)
         for st in targets:
             name = st.spec.name
-            breaker = self.range_breakers[name]
+            # .get(): a concurrently-retiring member may have been
+            # pruned between the snapshot above and here
+            breaker = self.range_breakers.get(name)
+            client = self._range_clients.get(name)
+            if breaker is None or client is None:
+                continue
             if not breaker.allow():
                 need_replica.append(
                     (
@@ -593,7 +870,6 @@ class FederatedSource(MetricsSource):
                     )
                 )
                 continue
-            client = self._range_clients[name]
             per_child = dict(params)
             pending.append(
                 (
@@ -613,7 +889,9 @@ class FederatedSource(MetricsSource):
         for _, fut in pending:
             fut.wait(max(0.0, end - time.monotonic()))
         for name, fut in pending:
-            breaker = self.range_breakers[name]
+            breaker = self.range_breakers.get(name)
+            if breaker is None:
+                continue
             if not fut.done():
                 # parked past the deadline: the thread is a daemon and
                 # its eventual result is discarded (one-shot task)
@@ -705,6 +983,82 @@ class FederatedSource(MetricsSource):
             entry["resolution"] = doc.get("resolution")
         return entry
 
+    # -- recursive aggregation (PR 15) ---------------------------------------
+    def _subtree_locked(self, now_m: float) -> dict:
+        """depth / node-id path / per-level stale-dark accounting of the
+        whole subtree below this parent.  Level 0 describes the direct
+        children; deeper levels fold each child's own ``levels`` upward
+        with subtree paths prefixed ``<child>/``.  Deeper levels carry
+        each subtree's LAST-RECEIVED accounting — a dark level-0 entry
+        supersedes whatever its subtree last reported.  Caller holds
+        ``self._lock``."""
+
+        def _lvl() -> dict:
+            return {"live": 0, "stale": [], "dark": [], "max_staleness_s": 0.0}
+
+        levels = [_lvl()]
+        depth = 0
+        path = {self.node_id}
+        partial = False
+        for st in self._children:
+            status, stale_s = self._child_status(st, now_m)
+            lvl = levels[0]
+            if status == STATUS_LIVE:
+                lvl["live"] += 1
+            elif status == STATUS_STALE:
+                lvl["stale"].append(st.spec.name)
+                partial = True
+            else:
+                lvl["dark"].append(st.spec.name)
+                partial = True
+            if stale_s != float("inf"):
+                lvl["max_staleness_s"] = max(
+                    lvl["max_staleness_s"], round(stale_s, 3)
+                )
+            doc = st.last_doc if isinstance(st.last_doc, dict) else {}
+            d = doc.get("depth")
+            if isinstance(d, (int, float)):
+                depth = max(depth, int(d))
+            p = doc.get("path")
+            if isinstance(p, (list, tuple)):
+                path.update(str(x) for x in p)
+            if doc.get("partial"):
+                partial = True
+            subs = doc.get("levels")
+            if not isinstance(subs, list):
+                continue
+            for i, sub in enumerate(subs):
+                if not isinstance(sub, dict):
+                    continue
+                while len(levels) <= i + 1:
+                    levels.append(_lvl())
+                tgt = levels[i + 1]
+                tgt["live"] += int(sub.get("live") or 0)
+                tgt["stale"].extend(
+                    f"{st.spec.name}/{x}" for x in (sub.get("stale") or [])
+                )
+                tgt["dark"].extend(
+                    f"{st.spec.name}/{x}" for x in (sub.get("dark") or [])
+                )
+                ms = sub.get("max_staleness_s")
+                if isinstance(ms, (int, float)):
+                    tgt["max_staleness_s"] = max(
+                        tgt["max_staleness_s"], float(ms)
+                    )
+        return {
+            "depth": depth + 1,
+            "path": sorted(path),
+            "levels": levels,
+            "partial": partial,
+        }
+
+    def subtree_summary(self) -> dict:
+        """What this parent's OWN ``/api/summary`` stamps into its doc
+        (build_summary calls this): making the parent itself scrapeable
+        is the whole fleets-of-fleets move."""
+        with self._lock:
+            return self._subtree_locked(self._clock())
+
     # -- observability (compose / healthz / alerts read these) ---------------
     def federation_summary(self) -> dict:
         """The per-child truth the frame, /healthz, and the drill assert
@@ -738,10 +1092,20 @@ class FederatedSource(MetricsSource):
                     "breaker": self.breakers[name].summary(),
                     "counters": dict(st.counters),
                 }
+                cdepth = doc.get("depth")
+                if isinstance(cdepth, (int, float)) and cdepth:
+                    # a child that is itself a parent — drill-downs
+                    # compose through it (/api/child/<name>/<grandchild>/…)
+                    entry["depth"] = int(cdepth)
+                if st.retired_m is not None:
+                    entry["retired"] = True
+                if st.cycle:
+                    entry["cycle"] = st.cycle
                 err = self.last_errors.get(name) or self._last_fault.get(name)
                 if err:
                     entry["last_error"] = err
                 children[name] = entry
+            sub = self._subtree_locked(now_m)
         statuses = [c["status"] for c in children.values()]
         return {
             "children": children,
@@ -749,10 +1113,20 @@ class FederatedSource(MetricsSource):
             "children_live": statuses.count(STATUS_LIVE),
             "children_stale": statuses.count(STATUS_STALE),
             "children_dark": statuses.count(STATUS_DARK),
-            # partial = ANY child not fresh: the pane is still serving,
-            # but someone reading it must know part of the fleet is
+            # recursive-aggregation view (PR 15): this node's identity,
+            # how many levels it aggregates, and the per-level stale/
+            # dark sets with subtree-path names — what the cascade drill
+            # (and a 3 am operator) reads at the root
+            "node": self.node_id,
+            "depth": sub["depth"],
+            "levels": sub["levels"],
+            # partial = ANY subtree not fresh — direct children AND
+            # nested levels (a grandchild partition two hops down must
+            # surface at the root): the pane is still serving, but
+            # someone reading it must know part of the fleet is
             # last-good or missing data
-            "partial": any(s != STATUS_LIVE for s in statuses),
+            "partial": any(s != STATUS_LIVE for s in statuses)
+            or sub["partial"],
         }
 
     def federated_alerts(self) -> "list[dict]":
@@ -772,7 +1146,8 @@ class FederatedSource(MetricsSource):
 
     def child_urls(self) -> "dict[str, str]":
         """name → base URL, for the parent's drill-down proxy."""
-        return {st.spec.name: st.spec.url for st in self._children}
+        with self._lock:
+            return {st.spec.name: st.spec.url for st in self._children}
 
     def close(self) -> None:
         # poll threads are daemons; clients hold no persistent sockets

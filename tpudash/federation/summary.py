@@ -16,8 +16,26 @@ import numpy as np
 from tpudash import schema
 from tpudash.schema import SampleBatch
 
-#: wire-format version of the summary document
+#: wire-format version of the summary document.  PR 15 ADDS fields
+#: (``node``/``depth``/``path``/``levels``) without bumping it: a pre-15
+#: parent ignores them, and a pre-15 child's doc (missing them) reads as
+#: a depth-0 leaf with an empty path — mixed-version fleets keep
+#: federating (MIGRATION.md records the contract).
 SUMMARY_V = 1
+
+
+def node_identity(cfg) -> str:
+    """This instance's stable node id (TPUDASH_NODE_ID, defaulting to
+    ``<hostname>-<port>``): what summary docs stamp into their
+    aggregation ``path`` so a parent can refuse a child whose subtree
+    already contains it (cycle detection).  Key-separator-safe — the id
+    also names the child in registration handshakes."""
+    nid = getattr(cfg, "node_id", "") or ""
+    if not nid:
+        import socket
+
+        nid = f"{socket.gethostname()}-{getattr(cfg, 'port', 0)}"
+    return nid.replace("/", "-").replace(",", "-")
 
 
 def build_summary(service, binary: bool = False) -> dict:
@@ -31,6 +49,7 @@ def build_summary(service, binary: bool = False) -> dict:
     partial/stale markers.
     """
     df = service.last_df
+    nid = node_identity(service.cfg)
     doc: dict = {
         "v": SUMMARY_V,
         "ts": service.last_updated_ts,
@@ -44,7 +63,26 @@ def build_summary(service, binary: bool = False) -> dict:
         "partial": bool(getattr(service.source, "last_errors", None)),
         "health": service.source_health(),
         "alerts": [dict(a) for a in service.last_alerts],
+        # recursive-aggregation stamps (PR 15): who this node is, how
+        # many levels it already aggregates, and every node id in its
+        # subtree — the parent-side cycle check reads ``path``
+        "node": nid,
+        "depth": 0,
+        "path": [nid],
     }
+    sub_fn = getattr(service.source, "subtree_summary", None)
+    if callable(sub_fn):
+        # this child is itself a federation parent: propagate its depth,
+        # its subtree's node-id set, and the per-level stale/dark
+        # accounting a grandparent folds upward (the "grandchild
+        # partition surfaces at the root, subtree named" contract)
+        sub = sub_fn()
+        doc["depth"] = int(sub.get("depth") or 0)
+        doc["path"] = sorted({nid, *sub.get("path", ())})
+        if sub.get("levels"):
+            doc["levels"] = sub["levels"]
+        if sub.get("partial"):
+            doc["partial"] = True
     if df is None:
         return doc
     from tpudash.normalize import dense_block
@@ -65,6 +103,14 @@ def build_summary(service, binary: bool = False) -> dict:
     }
     doc["keys"] = keys
     if arr is not None:
+        # display-grade wire values: the dashboard already rounds every
+        # rendered cell to 2 decimals (viz/figures.py), and centi-exact
+        # cells are what makes the incremental summary's qv delta codec
+        # 1-2 bytes per changed cell instead of a raw-float escape.
+        # Aggregation error is bounded by ±0.005 per cell — below sensor
+        # noise for every shipped metric (MIGRATION.md records the
+        # change).
+        arr = np.round(arr, 2)
         doc["cols"] = list(cols)
         if binary:
             # the TDB1 summary path ships the float64 block itself
@@ -88,7 +134,9 @@ def build_summary(service, binary: bool = False) -> dict:
 
         ncols = list(numeric_columns(df))
         doc["cols"] = ncols
-        sub = df[ncols].to_numpy(dtype=float, na_value=np.nan)
+        sub = np.round(
+            df[ncols].to_numpy(dtype=float, na_value=np.nan), 2
+        )
         doc["matrix"] = [
             [None if v != v else v for v in row] for row in sub.tolist()
         ]

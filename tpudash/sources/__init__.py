@@ -75,7 +75,11 @@ def make_source(cfg) -> MetricsSource:
     if retries > 0:
         from tpudash.sources.retry import ResilientSource, RetryPolicy
 
-        if cfg.source == "multi" or getattr(cfg, "federate", ""):
+        if (
+            cfg.source == "multi"
+            or getattr(cfg, "federate", "")
+            or getattr(cfg, "federate_discovery", "")
+        ):
             # the multi join and the federated fan-in are already
             # resilient per endpoint/child (circuit breakers, concurrent
             # deadline, partial degradation), and re-invoking the WHOLE
@@ -99,11 +103,12 @@ def make_source(cfg) -> MetricsSource:
 
 def _make_source(cfg) -> MetricsSource:
     kind = cfg.source
-    if getattr(cfg, "federate", ""):
-        # TPUDASH_FEDERATE turns this instance into a fleet parent: the
-        # children ARE the source (their /api/summary rollups), whatever
-        # TPUDASH_SOURCE says — a parent that also scraped its own
-        # Prometheus would double-count chips its children already carry
+    if getattr(cfg, "federate", "") or getattr(cfg, "federate_discovery", ""):
+        # TPUDASH_FEDERATE (or a discovery mode, PR 15) turns this
+        # instance into a fleet parent: the children ARE the source
+        # (their /api/summary rollups), whatever TPUDASH_SOURCE says —
+        # a parent that also scraped its own Prometheus would
+        # double-count chips its children already carry
         from tpudash.federation.source import FederatedSource
 
         return FederatedSource(cfg)
