@@ -1,0 +1,347 @@
+"""Edge delivery tier (ISSUE 16): the network-mirror worker role.
+
+Integration-level: a REAL network BusPublisher + a stub origin compose
+(aiohttp test server standing in for the single-process compose's HTTP
+API) + a real :class:`EdgeNode` app.  Asserts the serving contract:
+frames and streams come from the edge's mirror, ``/api/range`` rides
+the ETag cache with stale-serve on origin loss, a severed bus degrades
+to ``stale:true`` + ``compose_down`` (never an outage), and the
+``/internal/`` hop authenticates with the bus token.
+"""
+
+import asyncio
+import contextlib
+import json
+import socket
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpudash.broadcast.bus import (
+    BUS_TOKEN_HEADER,
+    BusPublisher,
+)
+from tpudash.broadcast.cohort import CohortHub, Seal, compress_segment
+from tpudash.broadcast.edge import EdgeNode
+from tpudash.broadcast.worker import WORKER_HEADER
+from tpudash.config import Config
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _seal(cid, seq, pad=b""):
+    full = b"id: %d-%d\ndata: {\"kind\":\"full\"}\n\n" % (cid, seq) + pad
+    delta = b"id: %d-%d\ndata: {\"kind\":\"delta\"}\n\n" % (cid, seq) + pad
+    frame = json.dumps({"seq": seq, "alerts": [], "warnings": []}).encode()
+    return Seal(
+        cid,
+        seq,
+        (seq, False),
+        full,
+        compress_segment(full),
+        delta,
+        compress_segment(delta),
+        frame,
+        compress_segment(frame),
+    )
+
+
+def _hub_with_seal():
+    from tpudash.app.state import SelectionState
+
+    s = SelectionState()
+    s.selected = ["chip-0"]
+    s._initialized = True
+    hub = CohortHub(lambda st: {}, json.dumps, window=4)
+    cohort = hub.resolve(s)
+    cohort.window.append(_seal(cohort.cid, 1))
+    return hub, cohort
+
+
+def _origin_app(state):
+    """A stub compose origin: counts calls, enforces the bus token on
+    /internal/, answers /api/range with a version-keyed ETag."""
+
+    async def cohort(request):
+        state["cohort_calls"] += 1
+        if request.headers.get(BUS_TOKEN_HEADER) != state["token"]:
+            return web.Response(status=401, text="missing bus token")
+        return web.json_response({"cid": state["cid"]})
+
+    async def healthz(request):
+        return web.json_response({"ok": True, "status": "ok"})
+
+    async def range_api(request):
+        state["range_calls"] += 1
+        etag = f'"rq-{state["range_version"]}"'
+        if request.headers.get("If-None-Match") == etag:
+            state["range_304"] += 1
+            return web.Response(
+                status=304, headers={"Cache-Control": "no-cache", "ETag": etag}
+            )
+        return web.json_response(
+            {"series": {}, "v": state["range_version"]},
+            headers={"ETag": etag},
+        )
+
+    app = web.Application()
+    app.router.add_get("/internal/cohort", cohort)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/api/range", range_api)
+    return app
+
+
+async def _wait(predicate, timeout=8.0):
+    for _ in range(int(timeout / 0.05)):
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return predicate()
+
+
+@contextlib.asynccontextmanager
+async def _edge_stack(state, refresh_interval=5.0, heartbeat=0.0):
+    """publisher + origin + edge client, torn down in order."""
+    bus_port = _free_port()
+    hub, cohort = _hub_with_seal()
+    state["cid"] = cohort.cid
+    pub = BusPublisher(
+        None,
+        hub,
+        backlog=64,
+        listen=f"127.0.0.1:{bus_port}",
+        token=state["token"],
+        heartbeat=heartbeat,
+    )
+    await pub.start()
+    origin = TestServer(_origin_app(state))
+    await origin.start_server()
+    cfg = Config(
+        bus_connect=f"127.0.0.1:{bus_port}",
+        bus_token=state["token"],
+        edge_origin=f"http://127.0.0.1:{origin.port}",
+        refresh_interval=refresh_interval,
+        loop_lag_budget=0.0,
+    )
+    edge = EdgeNode(cfg, 0)
+    client = TestClient(TestServer(edge.build_app()))
+    await client.start_server()
+    try:
+        assert await _wait(lambda: edge.mirror.connected)
+        yield pub, origin, edge, client, cohort
+    finally:
+        await client.close()
+        await origin.close()
+        await pub.close()
+
+
+def _state():
+    return {
+        "token": "edge-tok",
+        "cid": None,
+        "cohort_calls": 0,
+        "range_calls": 0,
+        "range_304": 0,
+        "range_version": 1,
+    }
+
+
+def test_edge_serves_frame_from_mirror_and_authenticates_internal_hop():
+    state = _state()
+
+    async def go():
+        async with _edge_stack(state) as (pub, origin, edge, client, cohort):
+            r = await client.get(
+                "/api/frame", headers={"Accept-Encoding": "identity"}
+            )
+            assert r.status == 200
+            assert r.headers[WORKER_HEADER] == str(edge.pid)
+            doc = await r.json()
+            assert doc["seq"] == 1
+            # the session→cohort hop went to the origin WITH the bus token
+            assert state["cohort_calls"] == 1
+            # live seal propagates; ETag revalidation answers 304 locally
+            pub.publish_seal(_seal(cohort.cid, 2))
+            assert await _wait(
+                lambda: edge.mirror.window(cohort.cid).latest().seq == 2
+            )
+            r2 = await client.get(
+                "/api/frame", headers={"Accept-Encoding": "identity"}
+            )
+            doc2 = await r2.json()
+            assert doc2["seq"] == 2
+            etag = r2.headers["ETag"]
+            r3 = await client.get(
+                "/api/frame",
+                headers={
+                    "Accept-Encoding": "identity",
+                    "If-None-Match": etag,
+                },
+            )
+            assert r3.status == 304
+
+    _run(go())
+
+
+def test_edge_stream_resumes_with_delta_from_mirror():
+    state = _state()
+
+    async def go():
+        async with _edge_stack(state) as (pub, origin, edge, client, cohort):
+            pub.publish_binding("", cohort.cid)
+            assert await _wait(lambda: "" in edge.mirror.bindings)
+            # resume from seq 1: the mirror window holds 1, so the next
+            # event out is the seq-2 DELTA, not a full re-init
+            pub.publish_seal(_seal(cohort.cid, 2))
+            assert await _wait(
+                lambda: edge.mirror.window(cohort.cid).latest().seq == 2
+            )
+            r = await client.get(
+                "/api/stream",
+                headers={
+                    "Accept-Encoding": "identity",
+                    "Last-Event-ID": f"{cohort.cid}-1",
+                },
+            )
+            assert r.status == 200
+            buf = b""
+            while b"\n\n" not in buf:
+                chunk = await asyncio.wait_for(r.content.read(256), 5.0)
+                if not chunk:
+                    break
+                buf += chunk
+            assert b'"kind":"delta"' in buf
+            assert f"id: {cohort.cid}-2".encode() in buf
+            r.close()
+
+    _run(go())
+
+
+def test_edge_degrades_to_stale_frames_when_bus_severed():
+    state = _state()
+
+    async def go():
+        async with _edge_stack(state) as (pub, origin, edge, client, cohort):
+            # prime the binding so no /internal/ hop is needed mid-outage
+            pub.publish_binding("", cohort.cid)
+            assert await _wait(lambda: "" in edge.mirror.bindings)
+            await pub.close()  # sever the bus, origin stays up
+            assert await _wait(lambda: not edge.mirror.connected)
+            r = await client.get(
+                "/api/frame", headers={"Accept-Encoding": "identity"}
+            )
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["stale"] is True
+            rules = [a["rule"] for a in doc["alerts"]]
+            assert "compose_down" in rules
+            # healthz keeps telling the truth: this edge is healthy
+            h = await client.get(
+                "/healthz", headers={"Accept-Encoding": "identity"}
+            )
+            hdoc = await h.json()
+            assert hdoc["ok"] is True
+            assert hdoc["worker"]["role"] == "edge"
+            assert hdoc["worker"]["compose_down"] is True
+
+    _run(go())
+
+
+def test_edge_range_cache_revalidates_and_serves_stale_on_origin_loss():
+    state = _state()
+
+    async def go():
+        async with _edge_stack(state, refresh_interval=0.0) as (
+            pub,
+            origin,
+            edge,
+            client,
+            cohort,
+        ):
+            r1 = await client.get(
+                "/api/range",
+                params={"metric": "temp"},
+                headers={"Accept-Encoding": "identity"},
+            )
+            assert r1.status == 200
+            assert state["range_calls"] == 1
+            # within the freshness window: served from the edge cache,
+            # origin untouched
+            r2 = await client.get(
+                "/api/range",
+                params={"metric": "temp"},
+                headers={"Accept-Encoding": "identity"},
+            )
+            assert r2.status == 200
+            assert state["range_calls"] == 1
+            # past the window: one conditional fetch, answered 304
+            await asyncio.sleep(0.6)
+            r3 = await client.get(
+                "/api/range",
+                params={"metric": "temp"},
+                headers={"Accept-Encoding": "identity"},
+            )
+            assert r3.status == 200
+            assert state["range_calls"] == 2
+            assert state["range_304"] == 1
+            # client-side revalidation answers 304 from the edge
+            etag = r3.headers["ETag"]
+            r4 = await client.get(
+                "/api/range",
+                params={"metric": "temp"},
+                headers={
+                    "Accept-Encoding": "identity",
+                    "If-None-Match": etag,
+                },
+            )
+            assert r4.status == 304
+            # origin gone: the cached body serves, honestly stale-marked
+            await origin.close()
+            await asyncio.sleep(0.6)
+            r5 = await client.get(
+                "/api/range",
+                params={"metric": "temp"},
+                headers={"Accept-Encoding": "identity"},
+            )
+            assert r5.status == 200
+            assert r5.headers.get("X-Tpudash-Stale") == "1"
+            assert (await r5.json())["v"] == 1
+
+    _run(go())
+
+
+def test_edge_worker_doc_carries_link_health():
+    state = _state()
+
+    async def go():
+        async with _edge_stack(state) as (pub, origin, edge, client, cohort):
+            doc = edge.worker_doc()
+            assert doc["role"] == "edge"
+            assert doc["bus"]["transport"] == "tcp"
+            assert doc["bus"]["counters"]["sequence_gaps"] == 0
+            assert doc["bus"]["last_gap"] is None
+            assert doc["origin"].startswith("http://127.0.0.1:")
+
+    _run(go())
+
+
+def test_edge_main_requires_connect_and_origin(monkeypatch, capsys):
+    from tpudash.broadcast import edge as edge_mod
+
+    monkeypatch.delenv("TPUDASH_BUS_CONNECT", raising=False)
+    monkeypatch.delenv("TPUDASH_EDGE_ORIGIN", raising=False)
+    with pytest.raises(SystemExit) as ei:
+        edge_mod.main()
+    assert ei.value.code == 2
+    assert "TPUDASH_BUS_CONNECT" in capsys.readouterr().err

@@ -53,6 +53,7 @@ from tpudash.app.overload import OverloadGuard, bound_stream_buffers
 from tpudash.app.service import DashboardService
 from tpudash.app.sessions import SessionEntry, SessionStore
 from tpudash.app import wire
+from tpudash.broadcast.bus import BUS_TOKEN_HEADER
 from tpudash.broadcast.cohort import (
     GZIP_HEADER,
     CohortHub,
@@ -252,6 +253,13 @@ class DashboardServer:
         #: Newly-created seals and session→cohort bindings are pushed to
         #: it so worker mirrors stay current.
         self.bus_publisher = None
+        #: True when the bus publisher listens on a NETWORK address
+        #: (edge tier fronting this compose): /internal/ routes are then
+        #: reachable from off-host and must present the bus bearer token
+        #: (``X-TPUDash-Bus-Token``) instead of being waved through on
+        #: unix-transport trust
+        self.bus_public = False
+        self.bus_token = ""
         #: (cid → seq) of the newest seal already handed to the bus — a
         #: tick that served a cached seal must not re-publish it
         self._published_seqs: dict = {}
@@ -2411,13 +2419,33 @@ class DashboardServer:
         bundle is likewise public: a ``<script src>`` load cannot carry
         a header either, and the asset is a vendor library, not data."""
         token = self.service.cfg.auth_token
-        if not token or request.path in ("/", "/healthz", PLOTLY_LOCAL_URL):
-            return await handler(request)
-        if request.path.startswith("/internal/") and self.bus_publisher is not None:
+        if (
+            request.path.startswith("/internal/")
+            and self.bus_publisher is not None
+        ):
+            if self.bus_public and self.bus_token:
+                # edge-tier mode: this compose is network-reachable, so
+                # /internal/ trust cannot ride the transport — edges
+                # (and hybrid-mode unix workers) authenticate with the
+                # same bearer token their bus hello carries, checked
+                # BEFORE the no-auth-token early return so an open
+                # dashboard still has a closed internal plane.  An
+                # empty bus token mirrors the publisher's own hello
+                # policy: unauthenticated, for localhost-only setups.
+                supplied = request.headers.get(BUS_TOKEN_HEADER, "")
+                if not hmac.compare_digest(
+                    supplied.encode(), self.bus_token.encode()
+                ):
+                    raise web.HTTPUnauthorized(
+                        text="missing or invalid bus token"
+                    )
+                return await handler(request)
             # worker-tier internal calls arrive over the compose process's
             # private unix socket (never bound on TCP in worker mode) —
             # the WORKER enforces the bearer token for its local routes,
             # and proxied client requests still carry (and need) theirs
+            return await handler(request)
+        if not token or request.path in ("/", "/healthz", PLOTLY_LOCAL_URL):
             return await handler(request)
         header = request.headers.get("Authorization", "")
         supplied = header[7:] if header.startswith("Bearer ") else None
@@ -2548,7 +2576,15 @@ class DashboardServer:
 def make_app(cfg: Config | None = None) -> web.Application:
     cfg = cfg or load_config()
     service = DashboardService(cfg, make_source(cfg))
-    return DashboardServer(service).build_app()
+    server = DashboardServer(service)
+    app = server.build_app()
+    if cfg.workers == 0 and cfg.bus_listen:
+        # single-process compose fronted by an edge tier: publish the
+        # frame bus over TCP/TLS beside the normal local serving
+        from tpudash.broadcast.supervisor import attach_network_bus
+
+        attach_network_bus(cfg, server, app)
+    return app
 
 
 def run(cfg: Config | None = None) -> None:  # pragma: no cover - blocking entry

@@ -1,4 +1,5 @@
-"""Layer 2 transport: the local frame bus.
+"""Layer 2 transport: the frame bus (local unix transport + network
+TCP/TLS transport).
 
 One compose process (the only process that scrapes, normalizes, and
 seals cohorts) publishes immutable :class:`~tpudash.broadcast.cohort.Seal`
@@ -6,6 +7,33 @@ buffers over a unix-domain socket to N worker processes, each of which
 keeps a :class:`BusMirror` — per-cohort seal windows plus the live
 session→cohort binding map — and serves SSE / ``/api/frame`` clients
 purely from it.
+
+**Network transport (PROTO 4):** when ``TPUDASH_BUS_LISTEN`` is set the
+publisher ALSO accepts mirrors over TCP (optionally TLS, optionally
+mutual TLS) so stateless EDGE nodes on other hosts can replicate seal
+windows — same framing, same snapshot-then-stream semantics, same
+strict +1 per-connection sequencing.  The differences are exactly the
+ones a machine boundary forces:
+
+- **auth before bytes**: a network mirror must open with a ``hello``
+  carrying the shared bearer token (``TPUDASH_BUS_TOKEN``); a missing or
+  wrong token is refused with a terse ``error`` message and a close —
+  it never sees a snapshot.  (The unix transport keeps its
+  filesystem-permission trust: the bus directory is 0700.)
+- **no shm ring**: SCM_RIGHTS fd passing stops at the machine boundary,
+  so network connections always run in copying mode.  The copying cost
+  is amortized: each seal's blob body is encoded ONCE per publish and
+  the shared bytes are written to every network subscriber — per-edge
+  marginal cost is one tiny header plus kernel sends, not a re-encode.
+- **heartbeats**: both directions ping every ``TPUDASH_BUS_HEARTBEAT``
+  seconds and treat ~3 silent intervals as a dead link, so a TCP
+  blackhole (half-open socket, dropped route) is a detected reconnect,
+  not an indefinitely "idle bus".
+- **torn reads are protocol errors**: EOF mid-frame (a peer killed
+  between the length prefix and the body) raises
+  :class:`BusProtocolError` with the byte counts, never a silent
+  truncation — and the mirror counts framing violations separately
+  from transport resets.
 
 **Zero-copy seal transport (PROTO 3):** when the platform allows it the
 publisher mmaps a :class:`SealRing` (memfd, or an unlinked file in the
@@ -39,28 +67,34 @@ publisher memory.
 
 Messages
 --------
-publisher → worker:
-  ``hello``    {proto, pid, window}  — mirror resets all state
+publisher → worker/edge:
+  ``hello``    {proto, pid, window, hb}  — mirror resets all state
   ``seal``     {cid, seq, tick, tpl, lens[12], ring?} + blobs — one
                cohort tick; the figure-template blob pair rides along
                exactly once per (worker, template epoch)
   ``binding``  {sid, cid}            — a session moved cohorts
   ``bindings`` {map}                 — full binding snapshot
   ``evict``    {cids}                — cohorts dropped (idle/LRU)
-worker → publisher:
-  ``hello``    {pid, index}
+  ``ping``     {}                    — heartbeat (sequenced no-op)
+  ``error``    {error}               — refusal before close (bad token)
+worker/edge → publisher:
+  ``hello``    {pid, index, role, proto, token?, health?}
   ``active``   {cids}                — cohorts with live subscribers
+  ``ping``     {}                    — heartbeat (network links only)
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
+import hmac
 import json
 import logging
 import mmap
 import os
+import random
 import socket as socketmod
+import ssl
 import struct
 import tempfile
 import time
@@ -72,8 +106,31 @@ log = logging.getLogger(__name__)
 #: bump on any incompatible wire change — a version-skewed worker must
 #: fail its handshake loudly, not misparse seals quietly
 #: (2: seals carry the TDB1 binary encodings; 3: fd-passing preamble,
-#: ring descriptors, per-seal figure-template delivery)
-PROTO = 3
+#: ring descriptors, per-seal figure-template delivery; 4: network
+#: TCP/TLS transport — authenticated hellos, heartbeat pings, edge role)
+PROTO = 4
+
+#: protocols a mirror accepts from a publisher: 4 is additive over 3
+#: (ping/error message kinds, hello ``hb`` field) so a PROTO 3 unix
+#: publisher still snapshots an upgraded worker during a rolling deploy
+PROTO_COMPAT = frozenset({3, PROTO})
+
+#: reconnect backoff for NETWORK mirrors: decorrelated jitter between
+#: the base and 3× the previous sleep, capped — a fleet of edges losing
+#: one compose must not reconnect in lockstep.  Unix mirrors keep the
+#: fixed 0.5 s cadence (same-host, no thundering herd, and the worker
+#: tier's compose-outage heuristics assume the tight reconnect loop).
+NET_BACKOFF_BASE = 0.5
+NET_BACKOFF_CAP = 10.0
+
+#: how many silent heartbeat intervals make a network link dead (plus a
+#: second of slack so one delayed ping is never a false positive)
+HEARTBEAT_MISSES = 3
+
+#: HTTP header an edge presents on /internal/ calls to a NETWORK-bound
+#: compose — same bearer secret as the bus hello, different plane (the
+#: compose's ``_auth`` middleware checks it when ``bus_public`` is set)
+BUS_TOKEN_HEADER = "X-TPUDash-Bus-Token"
 
 #: hard sanity bound on one message (a 4096-chip full frame gzips well
 #: under this; anything larger is a corrupt length prefix)
@@ -309,6 +366,61 @@ def recv_preamble(sock) -> "tuple[int, int, int | None]":
         sock.setblocking(False)
 
 
+def parse_hostport(spec: str, default_port: int = 0) -> "tuple[str, int]":
+    """``host:port`` / ``[v6::addr]:port`` → (host, port); raises
+    ValueError on garbage so a typo'd TPUDASH_BUS_LISTEN fails at
+    startup, not as an unreachable listener."""
+    spec = spec.strip()
+    if not spec:
+        raise ValueError("empty host:port")
+    if spec.startswith("["):
+        host, _, rest = spec[1:].partition("]")
+        port_s = rest.lstrip(":")
+    elif ":" in spec:
+        host, _, port_s = spec.rpartition(":")
+    else:
+        host, port_s = spec, ""
+    port = int(port_s) if port_s else default_port
+    if not host or not 0 < port < 65536:
+        raise ValueError(f"bad host:port {spec!r}")
+    return host, port
+
+
+def server_ssl_context(
+    cert: str, key: str, ca: str = ""
+) -> "ssl.SSLContext | None":
+    """The bus listener's TLS context: cert+key enable TLS, a CA bundle
+    additionally requires CLIENT certificates (mutual TLS).  None when
+    TLS is not configured — the caller serves plaintext TCP."""
+    if not cert or not key:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    if ca:
+        ctx.load_verify_locations(ca)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(
+    ca: str, cert: str = "", key: str = ""
+) -> "ssl.SSLContext | None":
+    """The edge side's TLS context: a CA bundle turns on verification of
+    the compose listener (pinned CA, no hostname check — edges dial the
+    address the operator configured, and the CA is the trust root);
+    cert+key present a client certificate for mutual TLS."""
+    if not ca and not (cert and key):
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED if ca else ssl.CERT_NONE
+    if ca:
+        ctx.load_verify_locations(ca)
+    if cert and key:
+        ctx.load_cert_chain(cert, key)
+    return ctx
+
+
 def _dumps(obj) -> bytes:
     return json.dumps(obj, separators=(",", ":")).encode()
 
@@ -318,17 +430,17 @@ def encode_message(header: dict, blobs: "tuple[bytes, ...]" = ()) -> bytes:
     return struct.pack("<I", len(body)) + body
 
 
-def encode_seal(
+def seal_wire_variant(
     seal: Seal,
-    n: int,
     include_tpl: bool = False,
     refs: "dict[int, tuple] | None" = None,
-) -> bytes:
-    """One seal message.  ``refs`` maps blob index → ring descriptor
-    (the publisher pre-writes each blob to the ring ONCE per publish
-    and shares the descriptors across every worker's message);
-    ``include_tpl`` ships the figure-template blob pair to connections
-    that have not seen this (cid, template) yet."""
+) -> "tuple[list, dict | None, bytes]":
+    """``(lens, ring_refs, body)`` for one (seal, include_tpl, refs)
+    combination.  The body join is the expensive part of a copying-mode
+    publish, so the publisher computes each variant ONCE per publish and
+    shares the bytes object across every connection receiving it — per
+    connection cost drops to a tiny header encode plus writes, which is
+    what keeps compose CPU ~flat in edge count (bench_edge_fanout)."""
     blobs = []
     lens = []
     ring_refs: dict = {}
@@ -345,6 +457,19 @@ def encode_seal(
         else:
             lens.append(len(blob))
             blobs.append(blob)
+    return lens, (ring_refs or None), b"".join(blobs)
+
+
+def seal_message_parts(
+    seal: Seal,
+    n: int,
+    lens: list,
+    ring_refs: "dict | None",
+    body: bytes,
+) -> "tuple[bytes, bytes]":
+    """The two wire buffers of one seal message: ``(prefix+header,
+    shared body)``.  Writing them separately lets N connections share
+    one body bytes object instead of concatenating N copies."""
     header = {
         "t": "seal",
         "n": n,
@@ -356,7 +481,24 @@ def encode_seal(
     }
     if ring_refs:
         header["ring"] = ring_refs
-    return encode_message(header, tuple(blobs))
+    head = _dumps(header) + b"\n"
+    return struct.pack("<I", len(head) + len(body)) + head, body
+
+
+def encode_seal(
+    seal: Seal,
+    n: int,
+    include_tpl: bool = False,
+    refs: "dict[int, tuple] | None" = None,
+) -> bytes:
+    """One seal message as a single buffer.  ``refs`` maps blob index →
+    ring descriptor (the publisher pre-writes each blob to the ring ONCE
+    per publish and shares the descriptors across every worker's
+    message); ``include_tpl`` ships the figure-template blob pair to
+    connections that have not seen this (cid, template) yet."""
+    lens, ring_refs, body = seal_wire_variant(seal, include_tpl, refs)
+    head, body = seal_message_parts(seal, n, lens, ring_refs, body)
+    return head + body
 
 
 def decode_seal(
@@ -408,12 +550,28 @@ def decode_seal(
 
 async def read_message(reader: asyncio.StreamReader) -> "tuple[dict, bytes]":
     """(header, remaining body bytes) for one framed message; raises
-    IncompleteReadError on clean EOF, BusProtocolError on garbage."""
-    prefix = await reader.readexactly(4)
+    IncompleteReadError on clean EOF (stream ends BETWEEN frames),
+    BusProtocolError on garbage — including a torn frame, i.e. EOF
+    after a partial length prefix or mid-body: bytes were lost, and
+    that must surface as a framing violation, never mistaken for an
+    orderly shutdown."""
+    try:
+        prefix = await reader.readexactly(4)
+    except asyncio.IncompleteReadError as e:
+        if e.partial:
+            raise BusProtocolError(
+                f"torn frame: EOF after {len(e.partial)} of 4 prefix bytes"
+            ) from e
+        raise
     (length,) = struct.unpack("<I", prefix)
     if not 0 < length <= MAX_MESSAGE:
         raise BusProtocolError(f"message length {length} out of bounds")
-    body = await reader.readexactly(length)
+    try:
+        body = await reader.readexactly(length)
+    except asyncio.IncompleteReadError as e:
+        raise BusProtocolError(
+            f"torn frame: EOF after {len(e.partial)} of {length} body bytes"
+        ) from e
     nl = body.find(b"\n")
     if nl < 0:
         raise BusProtocolError("message missing header line")
@@ -427,13 +585,36 @@ async def read_message(reader: asyncio.StreamReader) -> "tuple[dict, bytes]":
 
 
 class _WorkerConn:
-    """Publisher-side state for one connected worker."""
+    """Publisher-side state for one connected worker or edge."""
 
-    def __init__(self, writer: asyncio.StreamWriter, clock=time.monotonic):
+    def __init__(
+        self,
+        writer: asyncio.StreamWriter,
+        clock=time.monotonic,
+        net: bool = False,
+        peer: str = "unix",
+        backlog: int = 256,
+    ):
         self.writer = writer
-        self.queue: "asyncio.Queue[bytes | None]" = asyncio.Queue()
+        #: queue entries: one buffer, a (header, shared-body) buffer
+        #: tuple, or None (drain-task shutdown sentinel)
+        self.queue: "asyncio.Queue[bytes | tuple | None]" = asyncio.Queue()
         self.pid: "int | None" = None
         self.index: "int | None" = None
+        self.role = "worker"
+        #: transport identity for logs/stats: "unix" or "host:port"
+        self.peer = peer
+        #: True for TCP/TLS connections — never ring descriptors, idle
+        #: detection applies
+        self.net = net
+        #: per-connection backlog bound (edges may be bounded separately
+        #: from same-host workers — a WAN-stalled edge is cut sooner)
+        self.backlog = backlog
+        #: mirror-side health the peer reported in its hello
+        #: (reconnects/resyncs/last gap) — surfaced on /api/workers
+        self.health: "dict | None" = None
+        self.backlog_hw = 0
+        self.last_recv = clock()
         self.n = 0  # per-connection message sequence
         self.sent = 0
         self.connected_at = clock()
@@ -443,6 +624,12 @@ class _WorkerConn:
         #: worker, not once per seal.  Bounded: cleared past the cap
         #: (a re-send is a few hundred KB of waste, never corruption).
         self.sent_tpls: set = set()
+
+    def label(self) -> str:
+        return (
+            f"{self.role} pid={self.pid} index={self.index} "
+            f"peer={self.peer}"
+        )
 
     def next_n(self) -> int:
         self.n += 1
@@ -478,20 +665,37 @@ class BusPublisher:
 
     def __init__(
         self,
-        path: str,
+        path: "str | None",
         hub,
         backlog: int = 256,
         on_active=None,
         clock=time.monotonic,
         ring_mb: int = 0,
+        listen: str = "",
+        token: str = "",
+        tls: "ssl.SSLContext | None" = None,
+        heartbeat: float = 0.0,
+        edge_backlog: int = 0,
     ):
+        #: unix socket path (None = network listener only)
         self.path = path
         self.hub = hub
         self.backlog = max(8, int(backlog))
+        #: network listener ``host:port`` ("" = unix transport only)
+        self.listen = listen
+        #: shared bearer token network hellos must present ("" = open)
+        self.token = token
+        self.tls = tls
+        #: ping cadence advertised to mirrors; silent NETWORK peers are
+        #: dropped past HEARTBEAT_MISSES intervals (0 = disabled)
+        self.heartbeat = max(0.0, float(heartbeat))
+        #: per-EDGE backlog bound (0 = inherit the worker backlog)
+        self.edge_backlog = max(0, int(edge_backlog)) or self.backlog
         #: callback(cids) — worker liveness pings keep cohorts warm
         self.on_active = on_active
         self._clock = clock
         self._sock: "socketmod.socket | None" = None
+        self._server: "asyncio.AbstractServer | None" = None
         self._conns: "list[_WorkerConn]" = []
         #: sid → cid, the compose process's authoritative copy of the
         #: session→cohort map (snapshots seed reconnecting mirrors)
@@ -502,12 +706,19 @@ class BusPublisher:
         self.ring_mb = int(ring_mb)
         self.ring: "SealRing | None" = None
         self.ring_reason: "str | None" = None
+        #: backlog cuts per stable peer slot ("<role>-<index>") — the
+        #: per-link cut count /api/workers surfaces; survives the
+        #: connection churn that _conns rows do not
+        self.peer_cuts: "dict[str, int]" = {}
         self.counters = {
             "seals_published": 0,
             "bindings_published": 0,
             "worker_connects": 0,
+            "edge_connects": 0,
             "worker_overflows": 0,
             "worker_disconnects": 0,
+            "auth_rejects": 0,
+            "heartbeat_drops": 0,
             "fds_passed": 0,
             "blob_bytes_published": 0,
             "desc_bytes_published": 0,
@@ -515,7 +726,7 @@ class BusPublisher:
         }
 
     async def start(self) -> None:
-        if self.ring_mb > 0:
+        if self.ring_mb > 0 and self.path is not None:
             # preflight the ring HERE, before any worker connects: the
             # mode every connection will run in is decided once, probed
             # with a real write/read round trip, and recorded — a
@@ -533,14 +744,33 @@ class BusPublisher:
                     "mode",
                     e,
                 )
+        elif self.path is None:
+            self.ring_reason = "network-only publisher (no shm transport)"
         else:
             self.ring_reason = "disabled (TPUDASH_SHM_RING_MB=0)"
-        sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
-        sock.bind(self.path)
-        sock.listen(128)
-        sock.setblocking(False)
-        self._sock = sock
-        self._track(self._accept_loop())
+        if self.path is not None:
+            sock = socketmod.socket(
+                socketmod.AF_UNIX, socketmod.SOCK_STREAM
+            )
+            sock.bind(self.path)
+            sock.listen(128)
+            sock.setblocking(False)
+            self._sock = sock
+            self._track(self._accept_loop())
+        if self.listen:
+            host, port = parse_hostport(self.listen)
+            self._server = await asyncio.start_server(
+                self._on_net_connect, host, port, ssl=self.tls, backlog=128
+            )
+            log.info(
+                "frame bus listening on %s:%d (%s%s)",
+                host,
+                port,
+                "TLS" if self.tls is not None else "plaintext",
+                ", token-gated" if self.token else "",
+            )
+        if self.heartbeat > 0:
+            self._track(self._heartbeat_loop())
 
     async def close(self) -> None:
         for conn in list(self._conns):
@@ -549,6 +779,11 @@ class BusPublisher:
             task.cancel()
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._server is not None:
+            self._server.close()
+            with contextlib.suppress(OSError):
+                await self._server.wait_closed()
+            self._server = None
         if self._sock is not None:
             with contextlib.suppress(OSError):
                 self._sock.close()
@@ -601,9 +836,89 @@ class BusPublisher:
     def _on_connect(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        conn = _WorkerConn(writer, self._clock)
-        self._conns.append(conn)
+        conn = _WorkerConn(
+            writer, self._clock, net=False, peer="unix", backlog=self.backlog
+        )
         self.counters["worker_connects"] += 1
+        self._register(conn, reader)
+
+    async def _on_net_connect(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """One TCP/TLS mirror connection.  Unlike the unix transport
+        (trusted by filesystem permission, snapshotted on accept), a
+        network peer must open with an authenticated hello — a missing
+        or wrong token is counted, logged with the peer address, and
+        refused BEFORE any snapshot byte leaves this process."""
+        peername = writer.get_extra_info("peername")
+        peer = (
+            f"{peername[0]}:{peername[1]}"
+            if isinstance(peername, tuple) and len(peername) >= 2
+            else str(peername)
+        )
+        try:
+            header, _body = await asyncio.wait_for(read_message(reader), 10.0)
+        except (
+            OSError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            BusProtocolError,
+        ) as e:
+            log.warning("bus hello from %s failed: %s", peer, e)
+            self._abort_writer(writer)
+            return
+        ok = header.get("t") == "hello"
+        if ok and self.token:
+            supplied = str(header.get("token") or "")
+            ok = hmac.compare_digest(supplied.encode(), self.token.encode())
+        if not ok:
+            self.counters["auth_rejects"] += 1
+            log.warning(
+                "bus connection from %s refused (%s)",
+                peer,
+                "bad or missing token"
+                if header.get("t") == "hello"
+                else f"first message was {header.get('t')!r}, not hello",
+            )
+            with contextlib.suppress(OSError):
+                writer.write(
+                    encode_message({"t": "error", "error": "refused: bad hello"})
+                )
+                await writer.drain()
+            self._abort_writer(writer)
+            return
+        role = str(header.get("role") or "edge")
+        conn = _WorkerConn(
+            writer,
+            self._clock,
+            net=True,
+            peer=peer,
+            backlog=self.edge_backlog if role == "edge" else self.backlog,
+        )
+        self._apply_peer_hello(conn, header)
+        self.counters[
+            "edge_connects" if role == "edge" else "worker_connects"
+        ] += 1
+        self._register(conn, reader)
+
+    @staticmethod
+    def _abort_writer(writer: asyncio.StreamWriter) -> None:
+        transport = writer.transport
+        if transport is not None:
+            transport.abort()
+
+    @staticmethod
+    def _apply_peer_hello(conn: _WorkerConn, header: dict) -> None:
+        conn.pid = header.get("pid")
+        conn.index = header.get("index")
+        conn.role = str(header.get("role") or conn.role)
+        health = header.get("health")
+        conn.health = health if isinstance(health, dict) else None
+
+    def _register(
+        self, conn: _WorkerConn, reader: asyncio.StreamReader
+    ) -> None:
+        self._conns.append(conn)
         # snapshot FIRST into the queue, then register for live publishes:
         # the mirror dedups on (cid, seq), so a seal published while the
         # snapshot drains is applied at most once
@@ -614,6 +929,10 @@ class BusPublisher:
                     "n": conn.next_n(),
                     "proto": PROTO,
                     "window": self.hub.window,
+                    # advertised ping cadence: a mirror with no local
+                    # heartbeat config adopts the publisher's, so one
+                    # operator knob arms blackhole detection fleet-wide
+                    "hb": self.heartbeat,
                 }
             )
         )
@@ -645,7 +964,14 @@ class BusPublisher:
                 buf = await conn.queue.get()
                 if buf is None:
                     break
-                conn.writer.write(buf)
+                if isinstance(buf, tuple):
+                    # a seal's (header, shared-body) parts: the body
+                    # bytes object is shared across every connection
+                    # this publish — two writes, zero re-concatenation
+                    for part in buf:
+                        conn.writer.write(part)
+                else:
+                    conn.writer.write(buf)
                 await conn.writer.drain()
                 conn.sent += 1
         except (OSError, asyncio.CancelledError):
@@ -657,14 +983,15 @@ class BusPublisher:
         try:
             while True:
                 header, _body = await read_message(reader)
+                conn.last_recv = self._clock()
                 kind = header.get("t")
                 if kind == "hello":
-                    conn.pid = header.get("pid")
-                    conn.index = header.get("index")
+                    self._apply_peer_hello(conn, header)
                 elif kind == "active":
                     cids = header.get("cids") or []
                     if self.on_active is not None:
                         self.on_active(cids)
+                # "ping" needs no handling beyond the last_recv stamp
         except (
             OSError,
             asyncio.IncompleteReadError,
@@ -687,20 +1014,55 @@ class BusPublisher:
         if transport is not None:
             transport.abort()
 
+    # -- heartbeats ----------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        """Ping every connection each interval (a sequenced no-op the
+        mirror uses to tell an idle bus from a dead link) and CUT
+        network peers silent past the miss budget — a TCP blackhole
+        must not hold a connection slot and a backlog queue forever.
+        Unix peers are exempt: a dead same-host process is a clean EOF
+        the read task already sees."""
+        budget = HEARTBEAT_MISSES * self.heartbeat + 1.0
+        while True:
+            await asyncio.sleep(self.heartbeat)
+            now = self._clock()
+            for conn in list(self._conns):
+                if conn.net and now - conn.last_recv > budget:
+                    self.counters["heartbeat_drops"] += 1
+                    log.warning(
+                        "bus peer %s silent %.1fs (> %.1fs budget); "
+                        "dropping half-open link",
+                        conn.label(),
+                        now - conn.last_recv,
+                        budget,
+                    )
+                    self._drop(conn)
+                    continue
+                self._offer(
+                    conn,
+                    lambda n: encode_message({"t": "ping", "n": n}),
+                )
+
     # -- publishing ----------------------------------------------------------
     def _offer(self, conn: _WorkerConn, encode) -> None:
-        if conn.queue.qsize() >= self.backlog:
-            # the worker stopped draining: cut it loose — it reconnects
+        if conn.queue.qsize() >= conn.backlog:
+            # the peer stopped draining: cut it loose — it reconnects
             # and re-snapshots, instead of growing this queue forever
+            # or head-of-line blocking anyone else
             self.counters["worker_overflows"] += 1
+            slot = f"{conn.role}-{conn.index}"
+            self.peer_cuts[slot] = self.peer_cuts.get(slot, 0) + 1
             log.warning(
-                "bus worker pid=%s fell %d messages behind; disconnecting",
-                conn.pid,
+                "bus peer %s fell %d messages behind; disconnecting",
+                conn.label(),
                 conn.queue.qsize(),
             )
             self._drop(conn)
             return
         conn.queue.put_nowait(encode(conn.next_n()))
+        depth = conn.queue.qsize()
+        if depth > conn.backlog_hw:
+            conn.backlog_hw = depth
 
     def _seal_refs(
         self, seal: Seal, include_tpl: bool = False
@@ -745,16 +1107,62 @@ class BusPublisher:
             self.counters["blob_bytes_published"] += len(msg)
         return msg
 
+    def _seal_parts_for(
+        self,
+        conn: _WorkerConn,
+        seal: Seal,
+        refs: "dict | None",
+        refs_no_tpl: "dict | None",
+        variants: dict,
+        n: int,
+    ) -> "tuple[bytes, bytes]":
+        """One live seal message as (header, body) parts.  The body —
+        the expensive join of every blob this connection needs inline —
+        is computed once per (include_tpl, ring?) VARIANT per publish
+        and shared across all connections in it: with N copying-mode
+        edges, publish cost is N tiny headers + N kernel sends over ONE
+        shared body, not N full encodes."""
+        include_tpl = conn.tpl_needed(seal)
+        if include_tpl:
+            self.counters["templates_published"] += 1
+        use_refs = None
+        if not conn.net and refs is not None:
+            # descriptor hygiene, network edition: ring descriptors are
+            # meaningless off-host, so network connections always take
+            # the inline-copy variant; unix connections share template
+            # slots only when this message actually hands them over
+            use_refs = refs if include_tpl else refs_no_tpl
+        key = (include_tpl, use_refs is not None)
+        variant = variants.get(key)
+        if variant is None:
+            variant = variants[key] = seal_wire_variant(
+                seal, include_tpl, use_refs
+            )
+        lens, ring_refs, body = variant
+        head, body = seal_message_parts(seal, n, lens, ring_refs, body)
+        size = len(head) + len(body)
+        if use_refs:
+            self.counters["desc_bytes_published"] += size
+        else:
+            self.counters["blob_bytes_published"] += size
+        return head, body
+
     def publish_seal(self, seal: Seal) -> None:
         self.counters["seals_published"] += 1
         refs = self._seal_refs(
             seal,
             include_tpl=any(c.tpl_pending(seal) for c in self._conns),
         )
+        refs_no_tpl = None
+        if refs is not None:
+            refs_no_tpl = {i: r for i, r in refs.items() if i < 10} or None
+        variants: dict = {}
         for conn in list(self._conns):
             self._offer(
                 conn,
-                lambda n, c=conn: self._encode_seal_for(c, seal, refs, n),
+                lambda n, c=conn: self._seal_parts_for(
+                    c, seal, refs, refs_no_tpl, variants, n
+                ),
             )
 
     def publish_binding(self, sid: str, cid: int) -> None:
@@ -787,9 +1195,18 @@ class BusPublisher:
             {
                 "pid": c.pid,
                 "index": c.index,
+                "role": c.role,
+                "peer": c.peer,
                 "queued": c.queue.qsize(),
+                "backlog_hw": c.backlog_hw,
+                "cuts": self.peer_cuts.get(f"{c.role}-{c.index}", 0),
                 "sent": c.sent,
                 "connected_s": round(now - c.connected_at, 1),
+                # the mirror side's own link health, self-reported in
+                # its hello: reconnects, resyncs, last-gap detail —
+                # what /api/workers needs to answer "is this link
+                # healthy" without shelling into the edge host
+                "health": c.health,
             }
             for c in self._conns
         ]
@@ -797,8 +1214,14 @@ class BusPublisher:
     def stats(self) -> dict:
         return {
             "path": self.path,
+            "listen": self.listen or None,
+            "tls": self.tls is not None,
+            "token": bool(self.token),
+            "heartbeat": self.heartbeat,
             "backlog": self.backlog,
+            "edge_backlog": self.edge_backlog,
             "workers": self.workers(),
+            "cuts": dict(self.peer_cuts),
             "counters": dict(self.counters),
             # the transport-mode truth for operators: shm + descriptor
             # publishing, or the copying fallback and WHY
@@ -820,8 +1243,29 @@ class BusMirror:
     idle-evicting cohorts people are actually watching.
     """
 
-    def __init__(self, path: str, pid: int = 0, index: int = 0):
+    def __init__(
+        self,
+        path: str,
+        pid: int = 0,
+        index: int = 0,
+        *,
+        connect: str = "",
+        token: str = "",
+        tls: "ssl.SSLContext | None" = None,
+        heartbeat: float = 0.0,
+        role: str = "worker",
+    ):
         self.path = path
+        #: ``host:port`` of a network publisher; when set the mirror
+        #: speaks TCP/TLS instead of the unix socket (``path`` ignored)
+        self.connect = connect
+        self.token = token
+        self.tls = tls
+        #: local heartbeat preference; 0 adopts whatever interval the
+        #: publisher advertises in its hello (``hb``), so one knob on
+        #: the compose host configures the whole link
+        self.heartbeat = heartbeat
+        self.role = role
         self.pid = pid
         self.index = index
         self.window_limit = 8
@@ -855,8 +1299,22 @@ class BusMirror:
             "seals_applied": 0,
             "templates_applied": 0,
             "reconnects": 0,
+            "resyncs": 0,
             "protocol_errors": 0,
+            "transport_resets": 0,
+            "heartbeat_timeouts": 0,
+            "sequence_gaps": 0,
         }
+        #: detail of the most recent sequence gap (``{"expected", "got",
+        #: "at"}``), surfaced on /api/workers — a gap is always followed
+        #: by a drop+resync, so this is the forensic record of WHY the
+        #: last resync happened
+        self.last_gap: "dict | None" = None
+        #: effective heartbeat interval of the current session (local
+        #: preference, else publisher-advertised); drives the dead-link
+        #: read timeout and the upstream ping cadence on network links
+        self._hb = heartbeat
+        self._backoff = NET_BACKOFF_BASE
         self._writer: "asyncio.StreamWriter | None" = None
 
     # -- subscriber accounting (worker handlers) -----------------------------
@@ -889,24 +1347,119 @@ class BusMirror:
         self._update.set()
         self._update = asyncio.Event()
 
+    def _peer(self) -> str:
+        return self.connect if self.connect else self.path
+
     # -- replication loop ----------------------------------------------------
     async def run(self, stop: "asyncio.Event | None" = None) -> None:
-        """Reconnect-forever replication; returns when ``stop`` is set."""
+        """Reconnect-forever replication; returns when ``stop`` is set.
+
+        Every way a session can die is counted separately, because they
+        mean different things to an operator: a transport reset is the
+        network or a publisher restart; a heartbeat timeout is a silent
+        blackhole (traffic stopped but the socket never errored); a
+        protocol error is a peer speaking wrong bytes — the only class
+        that indicates a bug rather than weather.
+        """
         while stop is None or not stop.is_set():
+            was_up = False
             try:
                 await self._session(stop)
-            except (OSError, asyncio.IncompleteReadError):
-                pass
+            except asyncio.TimeoutError:
+                self.counters["heartbeat_timeouts"] += 1
+                log.warning(
+                    "bus heartbeat lost (peer=%s, no frame in %.1fs): "
+                    "dropping dead link",
+                    self._peer(),
+                    HEARTBEAT_MISSES * self._hb + 1.0,
+                )
+            except (OSError, asyncio.IncompleteReadError) as e:
+                self.counters["transport_resets"] += 1
+                log.debug("bus transport reset (peer=%s): %s", self._peer(), e)
             except BusProtocolError as e:
+                # malformed header, oversized length, torn frame, bad
+                # proto: never a clean EOF — log structured with the
+                # peer identity so a misbehaving publisher (or a
+                # middlebox mangling the stream) is attributable
                 self.counters["protocol_errors"] += 1
-                log.warning("bus protocol error, resyncing: %s", e)
+                log.warning(
+                    "bus_protocol error peer=%s role=%s index=%d: %s "
+                    "(dropping mirror state, resyncing)",
+                    self._peer(),
+                    self.role,
+                    self.index,
+                    e,
+                )
             if self.connected or self.disconnected_since is None:
+                was_up = True
                 self.disconnected_since = time.monotonic()
             self.connected = False
             self.counters["reconnects"] += 1
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(self._next_backoff(was_up))
+
+    def _next_backoff(self, was_up: bool) -> float:
+        """Unix mirrors retry on a fixed short cadence (same host, no
+        thundering herd, and the worker's compose-outage heuristics are
+        calibrated to it).  Network mirrors use decorrelated jitter so a
+        fleet of edges re-converging on a restarted compose spreads its
+        connection storm, resetting to the base after any session that
+        actually established."""
+        if not self.connect:
+            return 0.5
+        if was_up:
+            self._backoff = NET_BACKOFF_BASE
+            return self._backoff
+        self._backoff = min(
+            NET_BACKOFF_CAP,
+            random.uniform(NET_BACKOFF_BASE, self._backoff * 3),
+        )
+        return self._backoff
 
     async def _session(self, stop: "asyncio.Event | None") -> None:
+        self._hb = self.heartbeat
+        if self.connect:
+            reader, writer = await self._open_net()
+        else:
+            reader, writer = await self._open_unix()
+        self._writer = writer
+        ping_task: "asyncio.Task | None" = None
+        try:
+            writer.write(encode_message(self._hello()))
+            await writer.drain()
+            if self.connect:
+                ping_task = asyncio.ensure_future(self._ping_loop())
+            expect_n = 0
+            while stop is None or not stop.is_set():
+                header, body = await self._read_next(reader)
+                if header.get("t") == "error":
+                    # the publisher's pre-snapshot refusal (bad token,
+                    # bad proto): unsequenced, terminal for this session
+                    raise BusProtocolError(
+                        f"publisher refused: "
+                        f"{header.get('error', 'unspecified')}"
+                    )
+                n = int(header.get("n", 0))
+                expect_n += 1
+                if n != expect_n:
+                    self.counters["sequence_gaps"] += 1
+                    self.last_gap = {"expected": expect_n, "got": n}
+                    raise BusProtocolError(
+                        f"sequence gap: expected {expect_n}, got {n}"
+                    )
+                self._apply(header, body)
+        finally:
+            if ping_task is not None:
+                ping_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await ping_task
+            self._writer = None
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
+
+    async def _open_unix(
+        self,
+    ) -> "tuple[asyncio.StreamReader, asyncio.StreamWriter]":
         loop = asyncio.get_running_loop()
         sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
         sock.setblocking(False)
@@ -941,39 +1494,97 @@ class BusMirror:
         elif fd is not None:
             with contextlib.suppress(OSError):
                 os.close(fd)
-        reader, writer = await asyncio.open_unix_connection(sock=sock)
-        self._writer = writer
+        return await asyncio.open_unix_connection(sock=sock)
+
+    async def _open_net(
+        self,
+    ) -> "tuple[asyncio.StreamReader, asyncio.StreamWriter]":
+        """TCP/TLS session open: no preamble, no ring descriptor — the
+        publisher's shm is another machine's memory, so network mirrors
+        always run in copying mode and say so by never attaching."""
+        host, port = parse_hostport(self.connect)
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
         try:
-            writer.write(
-                encode_message(
-                    {"t": "hello", "pid": self.pid, "index": self.index}
-                )
+            return await asyncio.wait_for(
+                asyncio.open_connection(host, port, ssl=self.tls),
+                10.0,
             )
+        except asyncio.TimeoutError as e:
+            # a connect that never completes is transport weather, not
+            # a heartbeat event — reclassify before run() counts it
+            raise OSError(f"connect timeout to {self.connect}") from e
+
+    def _hello(self) -> dict:
+        """The mirror's opening message.  Unix links keep the PROTO-3
+        two-field form (filesystem permissions ARE the auth there);
+        network links authenticate and self-describe: bearer token,
+        role, proto, and a health snapshot the publisher republishes on
+        /api/workers so link quality is visible from the compose host.
+        """
+        msg: dict = {"t": "hello", "pid": self.pid, "index": self.index}
+        if self.connect:
+            msg["role"] = self.role
+            msg["proto"] = PROTO
+            msg["token"] = self.token
+            msg["health"] = {
+                "reconnects": self.counters["reconnects"],
+                "resyncs": self.counters["resyncs"],
+                "transport_resets": self.counters["transport_resets"],
+                "heartbeat_timeouts": self.counters["heartbeat_timeouts"],
+                "protocol_errors": self.counters["protocol_errors"],
+                "sequence_gaps": self.counters["sequence_gaps"],
+                "last_gap": self.last_gap,
+            }
+        return msg
+
+    async def _read_next(self, reader) -> "tuple[dict, bytes]":
+        """One framed message, bounded by the dead-link budget on
+        network transports: the publisher pings every ``hb`` seconds,
+        so HEARTBEAT_MISSES missed intervals (+1s scheduling slack)
+        with NOTHING arriving is a blackholed TCP connection, not an
+        idle bus — time out and let run() reconnect."""
+        if self.connect and self._hb > 0:
+            return await asyncio.wait_for(
+                read_message(reader), HEARTBEAT_MISSES * self._hb + 1.0
+            )
+        return await read_message(reader)
+
+    async def _ping_loop(self) -> None:
+        """Upstream keepalive for network sessions (the publisher cuts
+        peers silent past its own miss budget; `active` refresh alone is
+        too sparse).  Polls until a heartbeat interval is known — the
+        publisher advertises its interval in the hello when the mirror
+        has no local preference."""
+        while True:
+            await asyncio.sleep(self._hb if self._hb > 0 else 1.0)
+            if self._hb <= 0:
+                continue
+            writer = self._writer
+            if writer is None:
+                return
+            writer.write(encode_message({"t": "ping"}))
             await writer.drain()
-            expect_n = 0
-            while stop is None or not stop.is_set():
-                header, body = await read_message(reader)
-                n = int(header.get("n", 0))
-                expect_n += 1
-                if n != expect_n:
-                    raise BusProtocolError(
-                        f"sequence gap: expected {expect_n}, got {n}"
-                    )
-                self._apply(header, body)
-        finally:
-            self._writer = None
-            transport = writer.transport
-            if transport is not None:
-                transport.abort()
 
     def _apply(self, header: dict, body: bytes) -> None:
         kind = header["t"]
+        if kind == "ping":
+            # sequenced liveness no-op: the read already refreshed the
+            # dead-link timer; waking SSE loops for it would turn every
+            # heartbeat into a fleet-wide spurious wakeup
+            return
         if kind == "hello":
-            if header.get("proto") != PROTO:
+            if header.get("proto") not in PROTO_COMPAT:
                 raise BusProtocolError(
                     f"publisher speaks proto {header.get('proto')}, "
-                    f"this worker speaks {PROTO}"
+                    f"this worker speaks {sorted(PROTO_COMPAT)}"
                 )
+            hb = float(header.get("hb") or 0)
+            if self.heartbeat <= 0 and hb > 0:
+                # adopt the publisher's advertised cadence: the edge
+                # needs no local knob to get blackhole detection
+                self._hb = hb
             # a (re)connected publisher defines the universe afresh
             self.window_limit = int(header.get("window", 8))
             self.windows.clear()
@@ -981,6 +1592,11 @@ class BusMirror:
             self.templates.clear()
             self.connected = True
             self.disconnected_since = None
+            if self.hello_count > 0:
+                # every hello after the first rebuilds the mirror from
+                # snapshot — the "resync" an operator counts against
+                # reconnects to spot a flapping link re-shipping windows
+                self.counters["resyncs"] += 1
             self.hello_count += 1
         elif kind == "seal":
             seal = decode_seal(header, body, self.ring)
@@ -1036,6 +1652,10 @@ class BusMirror:
     def stats(self) -> dict:
         return {
             "connected": self.connected,
+            "peer": self._peer(),
+            "transport": "tcp" if self.connect else "unix",
+            "role": self.role,
+            "heartbeat": self._hb,
             "disconnected_s": (
                 round(time.monotonic() - self.disconnected_since, 1)
                 if self.disconnected_since is not None
@@ -1046,6 +1666,7 @@ class BusMirror:
             "templates": len(self.templates),
             "active": len(self._refs),
             "counters": dict(self.counters),
+            "last_gap": self.last_gap,
             "ring": (
                 dict(self.ring.stats(), mode="shm")
                 if self.ring is not None

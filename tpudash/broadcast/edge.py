@@ -1,0 +1,282 @@
+"""Layer 2 across the network: the stateless EDGE node.
+
+``python -m tpudash.broadcast.edge`` — a fan-out worker re-pointed at a
+REMOTE compose host.  Where the same-host worker mirrors the frame bus
+over a unix socket and proxies over ``api.sock``, the edge:
+
+- dials ``TPUDASH_BUS_CONNECT`` (TCP, optionally TLS via the bus trust
+  material: CA bundle + optional client cert/key) with the
+  ``TPUDASH_BUS_TOKEN`` bearer on its hello — the publisher refuses
+  unauthenticated edges before a single snapshot byte;
+- serves ``/api/stream`` and ``/api/frame`` from its mirror exactly like
+  a worker, including the full overload contract and the compose-outage
+  degrade (bus link down ⇒ last seal re-marked ``stale:true`` + a
+  synthesized ``compose_down`` alert, healthz stays ``ok:true`` because
+  restarting the edge fixes nothing);
+- answers ``/api/range`` and ``/api/summary`` from a local ETag-keyed
+  response cache, revalidating against the origin with
+  ``If-None-Match`` once per refresh interval and serving the cached
+  body STALE (``X-Tpudash-Stale: 1``) when the origin is unreachable —
+  dashboards keep their history panes through a partition;
+- proxies everything else to ``TPUDASH_EDGE_ORIGIN`` over plain HTTP(S).
+
+Edges hold no session state: seal event ids are ``<cid>-<seq>`` floored
+by the compose epoch, so a client that loses its edge reconnects to ANY
+other edge and ``Last-Event-ID`` resumes with a delta against that
+edge's mirror window (full-frame resync only on a real window miss) —
+which is what the ``edgestorm`` chaos drill kills processes to prove.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import sys
+import time
+from collections import OrderedDict
+
+from aiohttp import TCPConnector, web
+
+from tpudash.app.server import _accepts_gzip
+from tpudash.broadcast.bus import BusMirror, client_ssl_context
+from tpudash.broadcast.worker import (
+    WORKER_HEADER,
+    FanoutWorker,
+    reuseport_socket,
+)
+from tpudash.config import Config, configure_logging, env_read, load_config
+
+log = logging.getLogger(__name__)
+
+#: response headers worth replaying from the edge cache (everything
+#: else — hop-by-hop, Content-Length, Date — is per-response)
+_CACHE_HEADERS = ("Content-Type", "Content-Encoding", "ETag", "Vary")
+
+
+class EdgeNode(FanoutWorker):
+    """A fan-out worker whose compose lives on another machine."""
+
+    def __init__(self, cfg: Config, index: int):
+        super().__init__(cfg, index, bus_dir="")
+        self._api_base = cfg.edge_origin.rstrip("/")
+        #: (path, query, negotiation) → cached upstream response for the
+        #: read-mostly query routes; bounded LRU, revalidated by ETag
+        self._query_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._query_locks: "dict[tuple, asyncio.Lock]" = {}
+
+    # -- wiring overrides ----------------------------------------------------
+    def _make_mirror(self) -> BusMirror:
+        cfg = self.cfg
+        return BusMirror(
+            "",
+            pid=self.pid,
+            index=self.index,
+            connect=cfg.bus_connect,
+            token=cfg.bus_token,
+            tls=client_ssl_context(
+                cfg.bus_tls_ca, cfg.bus_tls_cert, cfg.bus_tls_key
+            ),
+            heartbeat=cfg.bus_heartbeat,
+            role="edge",
+        )
+
+    def _make_connector(self):
+        ctx = None
+        if self.cfg.edge_origin.startswith("https"):
+            ctx = client_ssl_context(
+                self.cfg.bus_tls_ca, self.cfg.bus_tls_cert, self.cfg.bus_tls_key
+            )
+        if ctx is not None:
+            return TCPConnector(ssl=ctx)
+        return TCPConnector()
+
+    def worker_doc(self) -> dict:
+        doc = super().worker_doc()
+        doc["role"] = "edge"
+        doc["origin"] = self._api_base
+        doc["query_cache_entries"] = len(self._query_cache)
+        return doc
+
+    # -- cached query routes -------------------------------------------------
+    def _extra_routes(self, app: web.Application) -> None:
+        app.router.add_get("/api/range", self.cached_query)
+        app.router.add_get("/api/summary", self.cached_query)
+
+    def _cache_bound(self) -> int:
+        return max(8, int(getattr(self.cfg, "range_cache", 32)))
+
+    async def cached_query(self, request: web.Request) -> web.Response:
+        """``/api/range`` and ``/api/summary`` through the edge's
+        ETag-keyed response cache.
+
+        Within one refresh interval the cached body serves directly; a
+        stale entry revalidates upstream with ``If-None-Match`` (a 304
+        costs the origin no executor hop and this link no body bytes);
+        an unreachable origin serves the last good body re-marked
+        ``X-Tpudash-Stale: 1`` — the outage contract the frame path
+        already keeps, extended to the history panes.  Federation delta
+        negotiation (``X-Tpudash-Summary-Base``) bypasses the cache
+        entirely: those bodies are anchored on the REQUESTER's base and
+        must never be replayed to anyone else."""
+        self._check_auth(request, allow_query=False)
+        if request.headers.get("X-Tpudash-Summary-Base"):
+            return await self.proxy(request)
+        reason = self.overload.admit(self.overload.client_key(request))
+        if reason is not None:
+            raise web.HTTPServiceUnavailable(
+                text=f"overloaded: shed ({reason})",
+                headers={
+                    "Retry-After": self.overload.retry_after_header(),
+                    WORKER_HEADER: str(self.pid),
+                },
+            )
+        try:
+            return await self._cached_query_admitted(request)
+        finally:
+            self.overload.release()
+
+    async def _cached_query_admitted(
+        self, request: web.Request
+    ) -> web.Response:
+        gz = _accepts_gzip(request.headers.get("Accept-Encoding", ""))
+        key = (
+            request.path,
+            tuple(sorted(request.query.items())),
+            gz,
+            request.headers.get("Accept", ""),
+        )
+        lock = self._query_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            entry = self._query_cache.get(key)
+            fresh_for = max(0.5, self.cfg.refresh_interval)
+            now = time.monotonic()
+            if entry is None or now - entry["at"] >= fresh_for:
+                entry = await self._revalidate(request, key, gz, entry)
+            if entry is None:
+                # nothing cached and the origin is unreachable
+                raise web.HTTPServiceUnavailable(
+                    text="origin unreachable and no cached body",
+                    headers={WORKER_HEADER: str(self.pid)},
+                )
+        self._query_locks.pop(key, None)
+        headers = dict(entry["headers"])
+        headers["Cache-Control"] = "no-cache"
+        headers[WORKER_HEADER] = str(self.pid)
+        if entry.get("stale"):
+            headers["X-Tpudash-Stale"] = "1"
+        etag = headers.get("ETag")
+        if etag and request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers=headers)
+        return web.Response(
+            status=entry["status"], body=entry["body"], headers=headers
+        )
+
+    async def _revalidate(
+        self, request: web.Request, key: tuple, gz: bool, entry: "dict | None"
+    ) -> "dict | None":
+        """One conditional fetch against the origin; updates the LRU.
+        Returns the entry to serve, stale-marked when the origin is
+        down, or None when there is nothing at all to serve."""
+        headers = {
+            "Accept-Encoding": "gzip" if gz else "identity",
+            **self._internal_headers(),
+        }
+        accept = request.headers.get("Accept")
+        if accept:
+            headers["Accept"] = accept
+        auth = request.headers.get("Authorization")
+        if auth:
+            headers["Authorization"] = auth
+        prior_etag = entry["headers"].get("ETag") if entry else None
+        if prior_etag:
+            headers["If-None-Match"] = prior_etag
+        try:
+            async with self.api_session().get(
+                f"{self._api_base}{request.path}",
+                params=dict(request.query),
+                headers=headers,
+            ) as r:
+                if r.status == 304 and entry is not None:
+                    entry["at"] = time.monotonic()
+                    entry["stale"] = False
+                    self._query_cache.move_to_end(key)
+                    return entry
+                body = await r.read()
+                if r.status != 200:
+                    # pass origin verdicts (400/404/503…) through
+                    # UNCACHED — an error body must not shadow a later
+                    # good one, nor evict the last good one we hold
+                    return {
+                        "status": r.status,
+                        "body": body,
+                        "headers": {
+                            k: r.headers[k]
+                            for k in _CACHE_HEADERS
+                            if k in r.headers
+                        },
+                        "at": time.monotonic(),
+                        "stale": False,
+                    }
+                entry = {
+                    "status": 200,
+                    "body": body,
+                    "headers": {
+                        k: r.headers[k]
+                        for k in _CACHE_HEADERS
+                        if k in r.headers
+                    },
+                    "at": time.monotonic(),
+                    "stale": False,
+                }
+                self._query_cache[key] = entry
+                self._query_cache.move_to_end(key)
+                while len(self._query_cache) > self._cache_bound():
+                    self._query_cache.popitem(last=False)
+                return entry
+        except (OSError, asyncio.TimeoutError):
+            if entry is not None:
+                # origin unreachable: the last good body, honestly marked
+                entry["stale"] = True
+                return entry
+            return None
+
+
+async def serve(cfg: Config, index: int) -> None:
+    edge = EdgeNode(cfg, index)
+    runner = web.AppRunner(edge.build_app())
+    await runner.setup()
+    sock = reuseport_socket(cfg.host, cfg.port)
+    site = web.SockSite(runner, sock, backlog=1024)
+    await site.start()
+    log.info(
+        "edge %d (pid %d) serving :%d, bus %s, origin %s",
+        index,
+        edge.pid,
+        cfg.port,
+        cfg.bus_connect,
+        cfg.edge_origin,
+    )
+    try:
+        await asyncio.Event().wait()  # until cancelled / killed
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    configure_logging()
+    cfg = load_config()
+    index = int(env_read("TPUDASH_WORKER_INDEX", "0") or "0")
+    if not cfg.bus_connect or not cfg.edge_origin:
+        print(
+            "tpudash edge: TPUDASH_BUS_CONNECT (compose bus host:port) and "
+            "TPUDASH_EDGE_ORIGIN (compose API base URL) are both required",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    with contextlib.suppress(KeyboardInterrupt):
+        asyncio.run(serve(cfg, index))
+
+
+if __name__ == "__main__":  # pragma: no cover - process entry
+    main()

@@ -198,6 +198,106 @@ class ChildInfo:
         }
 
 
+async def seal_ticker(cfg: Config, server, stopping: asyncio.Event) -> None:
+    """The serving tier's heartbeat: in plain single-process mode SSE
+    loops drive sealing on demand; when the subscribers live in OTHER
+    processes (fan-out workers over the unix bus, edges over TCP) no
+    loop in this process wakes, so the ticker refreshes the shared data
+    and seals every live cohort once per refresh interval, publishing
+    fresh seals to the bus.  Cohorts nobody reported watching for
+    ``broadcast_idle_ttl`` seconds stop being composed."""
+    interval = max(0.25, cfg.refresh_interval)
+    while not stopping.is_set():
+        try:
+            async with server._lock:
+                await server._refresh_locked(False)
+                tick_key = server._tick_key()
+                for cohort in server.hub.cohorts():
+                    seal = await server.hub.seal_cohort(cohort, tick_key)
+                    server._publish_seal(seal)
+                # eviction fans out to the mirrors via the hub's
+                # on_evict → server._on_cohort_evict → publish_evict
+                server.hub.evict_idle(cfg.broadcast_idle_ttl)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the ticker must survive one bad tick  # tpulint: allow[broad-except] heartbeat loop: one failed tick logs, the next retries
+            log.exception("broadcast ticker tick failed")
+        await asyncio.sleep(interval)
+
+
+def attach_network_bus(cfg: Config, server, app) -> None:
+    """Wire a NETWORK-ONLY frame bus into a single-process server:
+    ``TPUDASH_WORKERS=0`` + ``TPUDASH_BUS_LISTEN`` — the topology an
+    edge tier fronts.  The compose keeps serving its own port as usual;
+    additionally it publishes seals over TCP/TLS, marks its /internal/
+    plane bus-token-gated (``bus_public`` — this process is reachable
+    off-host, so transport trust is gone), and runs the seal ticker so
+    cohorts keep composing with zero local subscribers.
+
+    Epoch flooring still applies: edges and their clients hold
+    ``(cid, seq)`` acks across a compose restart, so every start bumps
+    the epoch counter (under ``TPUDASH_BROADCAST_BUS`` when set — point
+    it at persistent disk for restart-safe flooring — else a fresh
+    tempdir, epoch 1) and floors seal seq numbering exactly like the
+    process-tree compose child does."""
+    from tpudash.broadcast.bus import BusPublisher, server_ssl_context
+    from tpudash.broadcast.compose import _EPOCH_SPAN, bump_epoch
+
+    bus_dir = cfg.broadcast_bus or tempfile.mkdtemp(prefix="tpudash-bus-")
+    os.makedirs(bus_dir, mode=0o700, exist_ok=True)
+    server.hub.seq_base = bump_epoch(bus_dir) * _EPOCH_SPAN
+    publisher = BusPublisher(
+        None,  # no unix transport: edges are the only subscribers
+        server.hub,
+        backlog=cfg.broadcast_backlog,
+        on_active=server.hub.touch,
+        listen=cfg.bus_listen,
+        token=cfg.bus_token,
+        tls=server_ssl_context(
+            cfg.bus_tls_cert, cfg.bus_tls_key, cfg.bus_tls_ca
+        ),
+        heartbeat=cfg.bus_heartbeat,
+        edge_backlog=cfg.edge_backlog,
+    )
+    server.bus_publisher = publisher
+    server.bus_public = True
+    server.bus_token = cfg.bus_token
+    if server.workers_provider is None:
+        server.workers_provider = lambda: {
+            "mode": "edge-feed",
+            "configured": 0,
+            "compose_pid": os.getpid(),
+            "bus": publisher.stats(),
+        }
+    stopping = asyncio.Event()
+    tasks: "list[asyncio.Task]" = []
+
+    async def _start(_app) -> None:
+        await publisher.start()
+        tasks.append(
+            asyncio.ensure_future(seal_ticker(cfg, server, stopping))
+        )
+        log.info(
+            "network frame bus up on %s (tls=%s, token=%s), epoch dir %s",
+            cfg.bus_listen,
+            bool(publisher.tls),
+            bool(cfg.bus_token),
+            bus_dir,
+        )
+
+    async def _stop(_app) -> None:
+        stopping.set()
+        for task in tasks:
+            task.cancel()
+        for task in tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+        await publisher.close()
+
+    app.on_startup.append(_start)
+    app.on_cleanup.append(_stop)
+
+
 class ComposePlane:
     """The compose process's worker-tier plumbing, one bundle: the
     private unix API site, the frame-bus publisher, and the seal ticker.
@@ -216,7 +316,7 @@ class ComposePlane:
     async def start(self) -> None:
         from aiohttp import web
 
-        from tpudash.broadcast.bus import BusPublisher
+        from tpudash.broadcast.bus import BusPublisher, server_ssl_context
 
         server = self.server
         # a SIGKILLed predecessor leaves its socket files behind; a bind
@@ -235,8 +335,26 @@ class ComposePlane:
             # Probed inside start() — unavailable shm degrades to the
             # copying bus loudly (log + ring stats), never silently.
             ring_mb=self.cfg.shm_ring_mb,
+            # hybrid transport: TPUDASH_BUS_LISTEN additionally accepts
+            # authenticated TCP/TLS edges beside the same-host workers
+            listen=self.cfg.bus_listen,
+            token=self.cfg.bus_token,
+            tls=server_ssl_context(
+                self.cfg.bus_tls_cert,
+                self.cfg.bus_tls_key,
+                self.cfg.bus_tls_ca,
+            ),
+            heartbeat=self.cfg.bus_heartbeat,
+            edge_backlog=self.cfg.edge_backlog,
         )
         server.bus_publisher = self.publisher
+        if self.cfg.bus_listen:
+            # a network bus makes this compose reachable off-host even
+            # though its API site stays on the private unix socket —
+            # edges proxy /internal/ calls in, so that plane needs the
+            # bus bearer gate
+            server.bus_public = True
+            server.bus_token = self.cfg.bus_token
         if server.workers_provider is None:
             server.workers_provider = self.workers_doc
         app = server.build_app()
@@ -260,30 +378,7 @@ class ComposePlane:
             await self._runner.cleanup()
 
     async def _ticker(self) -> None:
-        """The worker tier's heartbeat: in single-process mode SSE loops
-        drive sealing on demand; here no subscriber lives in this
-        process, so the ticker refreshes the shared data and seals every
-        live cohort once per refresh interval, publishing fresh seals to
-        the bus.  Cohorts nobody reported watching for
-        ``broadcast_idle_ttl`` seconds stop being composed."""
-        server = self.server
-        interval = max(0.25, self.cfg.refresh_interval)
-        while not self._stopping.is_set():
-            try:
-                async with server._lock:
-                    await server._refresh_locked(False)
-                    tick_key = server._tick_key()
-                    for cohort in server.hub.cohorts():
-                        seal = await server.hub.seal_cohort(cohort, tick_key)
-                        server._publish_seal(seal)
-                    # eviction fans out to the mirrors via the hub's
-                    # on_evict → server._on_cohort_evict → publish_evict
-                    server.hub.evict_idle(self.cfg.broadcast_idle_ttl)
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001 — the ticker must survive one bad tick  # tpulint: allow[broad-except] heartbeat loop: one failed tick logs, the next retries
-                log.exception("broadcast ticker tick failed")
-            await asyncio.sleep(interval)
+        await seal_ticker(self.cfg, self.server, self._stopping)
 
     def supervisor_status(self) -> "dict | None":
         """The parent supervisor's spawn/exit journal, if one exists

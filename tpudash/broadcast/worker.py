@@ -117,9 +117,11 @@ class FanoutWorker:
         self.index = index
         self.bus_dir = bus_dir
         self.pid = os.getpid()
-        self.mirror = BusMirror(
-            os.path.join(bus_dir, BUS_SOCK), pid=self.pid, index=index
-        )
+        self.mirror = self._make_mirror()
+        #: base URL the internal ClientSession resolves against; the
+        #: edge subclass re-points it (and the connector) at the remote
+        #: compose's public origin
+        self._api_base = "http://compose"
         self.overload = OverloadGuard(cfg)
         self.loop_monitor = LoopLagMonitor(budget_ms=cfg.loop_lag_budget)
         self._stop = asyncio.Event()
@@ -148,6 +150,15 @@ class FanoutWorker:
         self._outage_anchor: "float | None" = None
         self._outage_seen: float = 0.0
 
+    def _make_mirror(self) -> BusMirror:
+        """Mirror factory (overridden by the edge role to dial a
+        TCP/TLS publisher instead of the bus directory's unix socket)."""
+        return BusMirror(
+            os.path.join(self.bus_dir, BUS_SOCK),
+            pid=self.pid,
+            index=self.index,
+        )
+
     @property
     def compose_down(self) -> bool:
         """The worker's compose-outage verdict: the frame-bus link is
@@ -171,12 +182,28 @@ class FanoutWorker:
         return best
 
     # -- internal API client -------------------------------------------------
+    def _make_connector(self):
+        """Connector factory for the internal API session (unix socket
+        to the same-host compose; the edge subclass returns a TCP
+        connector for the remote origin)."""
+        return UnixConnector(path=os.path.join(self.bus_dir, API_SOCK))
+
+    def _internal_headers(self) -> dict:
+        """Extra headers for worker→compose internal calls.  Same-host
+        unix calls are trusted by transport — UNLESS the compose also
+        listens for network edges (hybrid mode), which flips its
+        /internal/ plane to bus-token auth for every caller; sending
+        the token whenever one is configured keeps both modes working."""
+        from tpudash.broadcast.bus import BUS_TOKEN_HEADER
+
+        if self.cfg.bus_token:
+            return {BUS_TOKEN_HEADER: self.cfg.bus_token}
+        return {}
+
     def api_session(self) -> ClientSession:
         if self._api is None:
             self._api = ClientSession(
-                connector=UnixConnector(
-                    path=os.path.join(self.bus_dir, API_SOCK)
-                ),
+                connector=self._make_connector(),
                 timeout=ClientTimeout(total=30),
                 auto_decompress=False,  # pass compose bodies through verbatim
             )
@@ -191,9 +218,12 @@ class FanoutWorker:
             return cid
         try:
             async with self.api_session().get(
-                "http://compose/internal/cohort",
+                f"{self._api_base}/internal/cohort",
                 params={"sid": sid or ""},
-                headers={"Accept-Encoding": "identity"},
+                headers={
+                    "Accept-Encoding": "identity",
+                    **self._internal_headers(),
+                },
             ) as r:
                 if r.status != 200:
                     return None
@@ -603,7 +633,7 @@ class FanoutWorker:
             # unparseable here once it outgrows the compose middleware's
             # size threshold
             async with self.api_session().get(
-                "http://compose/healthz",
+                f"{self._api_base}/healthz",
                 headers={"Accept-Encoding": "identity"},
             ) as r:
                 doc = await r.json(content_type=None)
@@ -662,7 +692,7 @@ class FanoutWorker:
         try:
             async with self.api_session().request(
                 request.method,
-                f"http://compose{request.rel_url}",
+                f"{self._api_base}{request.rel_url}",
                 headers=headers,
                 data=body,
             ) as r:
@@ -722,8 +752,14 @@ class FanoutWorker:
         app.router.add_get("/api/stream", self.stream)
         app.router.add_get("/api/frame", self.frame)
         app.router.add_get("/healthz", self.healthz)
+        self._extra_routes(app)
         app.router.add_route("*", "/{tail:.*}", self.proxy)
         return app
+
+    def _extra_routes(self, app: web.Application) -> None:
+        """Routes a subclass serves locally instead of proxying —
+        registered before the catch-all (the edge adds mirror-cached
+        /api/range and /api/summary here)."""
 
 
 def reuseport_socket(host: str, port: int) -> socket.socket:

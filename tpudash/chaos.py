@@ -105,9 +105,35 @@ degrade-per-child contract:
 
     python -m tpudash.chaos partition --children 4
 
+**The edgestorm drill** (``python -m tpudash.chaos edgestorm``): the
+edge delivery tier (tpudash.broadcast.edge) under kills and
+partitions.  It boots a REAL single-process compose publishing the
+frame bus over TCP (token-authenticated) plus N real edge
+subprocesses — each dialing the bus through a drill-owned TCP
+forwarder, the partition switch — and a streaming client population
+spread across the edges, then breaks things mid-storm:
+
+- SIGKILL an EDGE: its clients fail over to another edge and
+  ``Last-Event-ID`` resumes with a DELTA — seal event ids are global
+  (``<cid>-<seq>``, epoch-floored), so any edge's mirror window can
+  continue any other edge's chain;
+- PARTITION an edge's bus link (blackhole, then connect-refused): the
+  edge detects the silent link by heartbeat budget, serves stale
+  frames with a ``compose_down`` alert while ``/healthz`` stays
+  ``ok: true``, and heals within ONE reconnect of the forwarder
+  returning;
+- SIGKILL the COMPOSE process: every edge degrades in lockstep
+  (stale + alert, none dark); the restarted compose bumps the seal
+  epoch so resumed seqs can never alias, and every edge resyncs via
+  snapshot-then-stream;
+- throughout: ZERO sequence-gap resyncs on healthy links and zero
+  unhandled exceptions in any process's captured logs.
+
+    python -m tpudash.chaos edgestorm --edges 16 --clients 256
+
 Exit status 0 = every invariant held; 1 = the printed JSON names what
-didn't.  CI runs the overload, storm, killall, and partition drills on
-every PR (chaos-soak job).
+didn't.  CI runs the overload, storm, killall, partition, and
+edgestorm drills on every PR (chaos-soak job).
 """
 
 from __future__ import annotations
@@ -3478,6 +3504,619 @@ async def run_incident_drill(chips: int = 64) -> dict:
     return summary
 
 
+# ---------------------------------------------------------------------------
+# Edgestorm drill — the edge delivery tier under kills and partitions:
+# a real single-process compose publishing the TCP frame bus + N real
+# edge subprocesses + a failover-streaming client population
+# (tpudash.broadcast.edge).
+# ---------------------------------------------------------------------------
+
+#: edgestorm tunables, overridable from the environment.  heartbeat 1.0
+#: makes the blackhole-detection budget (HEARTBEAT_MISSES * hb + 1 = 4s)
+#: short enough that every partition transition lands inside a
+#: CI-friendly minute; the 16-deep window at a 0.5s refresh gives every
+#: failover ~8s of delta-resumable history on EVERY edge's mirror.
+_EDGESTORM_KNOBS = {
+    "TPUDASH_REFRESH_INTERVAL": "0.5",
+    "TPUDASH_SYNTHETIC_CHIPS": "32",
+    "TPUDASH_BROADCAST_WINDOW": "16",
+    "TPUDASH_BUS_HEARTBEAT": "1.0",
+    "TPUDASH_MAX_CONCURRENCY": "64",
+    "TPUDASH_SSE_WRITE_DEADLINE": "2.0",
+}
+
+#: how long after a heal the link must be fresh again: one reconnect at
+#: the worst decorrelated backoff (NET_BACKOFF_CAP=10s) + snapshot +
+#: one refresh tick of slack
+_EDGESTORM_HEAL_BUDGET = 15.0
+
+
+class _EdgeStormProc:
+    """One drill subprocess (the compose or an edge) with captured
+    stdout+stderr for the zero-unhandled-exception verdict."""
+
+    def __init__(self, name: str, module: str, env: dict, log_dir: str):
+        self.name = name
+        self.module = module
+        self.env = env
+        self.log_path = os.path.join(log_dir, f"{name}.log")
+        self.proc = None
+
+    def spawn(self) -> None:
+        env = dict(os.environ)
+        env.update(self.env)
+        env["JAX_PLATFORMS"] = "cpu"
+        out = open(self.log_path, "ab")  # noqa: SIM115 — lives with the proc
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", self.module],
+            env=env,
+            stdout=out,
+            stderr=out,
+        )
+
+    def sigkill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+    def tracebacks(self) -> "list[str]":
+        try:
+            with open(self.log_path, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            return []
+        return [
+            f"{self.name}: {line.strip()}"
+            for line in text.splitlines()
+            if "Traceback (most recent call last)" in line or " ERROR " in line
+        ]
+
+
+class _BusForwarder:
+    """A drill-owned TCP forwarder between one edge and the compose bus
+    — the partition switch.  ``partition()`` freezes the live pipes
+    WITHOUT closing them (a blackhole: the edge must notice via its
+    heartbeat budget, not a friendly RST) and stops the listener so
+    reconnects get connection-refused; ``heal()`` brings the listener
+    back and the edge's next retry goes through."""
+
+    def __init__(self, listen_port: int, target_port: int):
+        self.listen_port = listen_port
+        self.target_port = target_port
+        self._server = None
+        self._pumps: "set[asyncio.Task]" = set()
+        self._writers: "list" = []
+        self._frozen = False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.listen_port
+        )
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            up_r, up_w = await asyncio.open_connection(
+                "127.0.0.1", self.target_port
+            )
+        except OSError:
+            writer.close()
+            return
+        self._writers += [writer, up_w]
+
+        async def pump(r, w):
+            try:
+                while True:
+                    data = await r.read(65536)
+                    if not data:
+                        break
+                    w.write(data)
+                    await w.drain()
+            except (OSError, asyncio.CancelledError):
+                pass
+            finally:
+                # a frozen pump must NOT close its sockets — a closed
+                # socket is a friendly RST, and the partition under
+                # test is the silent kind only a heartbeat can see
+                if not self._frozen:
+                    with contextlib.suppress(OSError):
+                        w.close()
+
+        for t in (
+            asyncio.ensure_future(pump(reader, up_w)),
+            asyncio.ensure_future(pump(up_r, writer)),
+        ):
+            self._pumps.add(t)
+            t.add_done_callback(self._pumps.discard)
+
+    async def partition(self) -> None:
+        self._frozen = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        # cancel the pumps but leave the sockets open: bytes stop
+        # flowing while TCP stays established — the silent link
+        for t in list(self._pumps):
+            t.cancel()
+        await asyncio.sleep(0)
+
+    async def heal(self) -> None:
+        # drop the frozen carcasses; the edge has long since timed out
+        for w in self._writers:
+            with contextlib.suppress(OSError):
+                w.close()
+        self._writers = []
+        self._frozen = False
+        await self.start()
+
+    async def close(self) -> None:
+        await self.partition()
+        for w in self._writers:
+            with contextlib.suppress(OSError):
+                w.close()
+
+
+async def run_edgestorm_drill(
+    edges: int = 16, clients: int = 256
+) -> dict:
+    """The edge tier's failure contract, asserted end to end — see the
+    module docstring's edgestorm section for the scenario list."""
+    from aiohttp import ClientError, ClientSession, TCPConnector
+
+    edges = max(3, edges)
+    clients = max(edges * 2, clients)
+    _raise_fd_limit()
+    loop = asyncio.get_running_loop()
+    log_dir = await loop.run_in_executor(
+        None, functools.partial(tempfile.mkdtemp, prefix="tpudash-edgestorm-")
+    )
+    ports = _free_ports(2 * edges + 2)
+    compose_port, bus_port = ports[0], ports[1]
+    edge_ports = ports[2 : 2 + edges]
+    fwd_ports = ports[2 + edges :]
+    token = "edgestorm-secret"
+    knobs = {
+        name: value
+        for name, value in _EDGESTORM_KNOBS.items()
+        if not env_is_set(name)
+    }
+
+    compose_env = dict(
+        knobs,
+        TPUDASH_SOURCE="synthetic",
+        TPUDASH_WORKERS="0",
+        TPUDASH_HOST="127.0.0.1",
+        TPUDASH_PORT=str(compose_port),
+        TPUDASH_BUS_LISTEN=f"127.0.0.1:{bus_port}",
+        TPUDASH_BUS_TOKEN=token,
+        # a PERSISTENT bus dir: the restarted compose must find the
+        # epoch file and floor its seal seqs above every old event id
+        TPUDASH_BROADCAST_BUS=os.path.join(log_dir, "bus"),
+    )
+
+    def edge_env(i: int) -> dict:
+        return dict(
+            knobs,
+            TPUDASH_HOST="127.0.0.1",
+            TPUDASH_PORT=str(edge_ports[i]),
+            TPUDASH_WORKER_INDEX=str(i),
+            TPUDASH_BUS_CONNECT=f"127.0.0.1:{fwd_ports[i]}",
+            TPUDASH_BUS_TOKEN=token,
+            TPUDASH_EDGE_ORIGIN=f"http://127.0.0.1:{compose_port}",
+            TPUDASH_MAX_STREAMS=str(max(64, 4 * clients // edges)),
+        )
+
+    compose = _EdgeStormProc("compose", "tpudash", compose_env, log_dir)
+    edge_procs = [
+        _EdgeStormProc(f"edge-{i}", "tpudash.broadcast.edge", edge_env(i), log_dir)
+        for i in range(edges)
+    ]
+    forwarders = [
+        _BusForwarder(fwd_ports[i], bus_port) for i in range(edges)
+    ]
+
+    failures: "list[str]" = []
+    numbers: dict = {"edges": edges, "clients": clients}
+    stop = asyncio.Event()
+    stats = {
+        "events": 0,
+        "per_edge": {p: 0 for p in edge_ports},
+        "cross_resumes": 0,
+        "cross_delta_resumes": 0,
+        "cross_full_resumes": 0,
+    }
+
+    async def fetch_json(session, port, path):
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{port}{path}",
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                if r.status != 200:
+                    return None
+                return await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+            return None
+
+    async def fetch_frame(session, port, sid="edgestorm-probe"):
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{port}/api/frame",
+                cookies={"tpudash_sid": sid},
+                headers={"Accept-Encoding": "identity"},
+            ) as r:
+                if r.status != 200:
+                    return r.status, None
+                return 200, await r.json(content_type=None)
+        except (OSError, ClientError, asyncio.TimeoutError):
+            return None, None
+
+    async def edge_bus(session, port) -> dict:
+        doc = await fetch_json(session, port, "/healthz")
+        return ((doc or {}).get("worker") or {}).get("bus") or {}
+
+    async def storm_client(session, i):
+        """One viewer pinned to an edge, failing over to the NEXT edge
+        on any connection loss with its last event id — the population
+        whose delta chain every kill must not break."""
+        pos = i % edges
+        last_id = None
+        cur_port = None
+        while not stop.is_set():
+            port = edge_ports[pos % edges]
+            try:
+                hdrs = {"Accept-Encoding": "identity"}
+                if last_id:
+                    hdrs["Last-Event-ID"] = last_id
+                async with session.get(
+                    f"http://127.0.0.1:{port}/api/stream",
+                    headers=hdrs,
+                    cookies={"tpudash_sid": f"edgestorm-{i}"},
+                ) as r:
+                    if r.status != 200:
+                        pos += 1
+                        await asyncio.sleep(0.5)
+                        continue
+                    crossed = (
+                        last_id is not None
+                        and cur_port is not None
+                        and port != cur_port
+                    )
+                    cur_port = port
+                    buf = b""
+                    async for chunk in r.content.iter_any():
+                        if stop.is_set():
+                            return
+                        buf += chunk
+                        while b"\n\n" in buf:
+                            evt, buf = buf.split(b"\n\n", 1)
+                            eid = kind = None
+                            for line in evt.split(b"\n"):
+                                if line.startswith(b"id: "):
+                                    eid = line[4:].decode()
+                                elif line.startswith(b"data: "):
+                                    with contextlib.suppress(ValueError):
+                                        kind = json.loads(line[6:]).get(
+                                            "kind"
+                                        )
+                            if eid is None:
+                                continue
+                            last_id = eid
+                            stats["events"] += 1
+                            stats["per_edge"][port] += 1
+                            if crossed and kind in ("full", "delta"):
+                                # first real event after a cross-edge
+                                # Last-Event-ID resume: the continuity
+                                # verdict
+                                stats["cross_resumes"] += 1
+                                stats[f"cross_{kind}_resumes"] += 1
+                                crossed = False
+            except (OSError, ClientError, asyncio.TimeoutError):
+                pos += 1  # fail over to the next edge
+                await asyncio.sleep(0.2)
+
+    tasks: "list[asyncio.Task]" = []
+    try:
+        await loop.run_in_executor(None, compose.spawn)
+        for f in forwarders:
+            await f.start()
+        async with ClientSession(connector=TCPConnector(limit=0)) as session:
+            # -- phase 0: compose + every edge ready -------------------------
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline:
+                if await fetch_json(session, compose_port, "/healthz"):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                failures.append("compose never became ready (90s)")
+                raise _DrillAbort()
+            for i in range(edges):
+                await loop.run_in_executor(None, edge_procs[i].spawn)
+            deadline = time.monotonic() + 90.0
+            pending = set(range(edges))
+            while time.monotonic() < deadline and pending:
+                for i in list(pending):
+                    bus = await edge_bus(session, edge_ports[i])
+                    status, frame = await fetch_frame(session, edge_ports[i])
+                    if (
+                        bus.get("connected")
+                        and status == 200
+                        and frame is not None
+                        and not frame.get("stale")
+                    ):
+                        pending.discard(i)
+                await asyncio.sleep(0.5)
+            if pending:
+                failures.append(
+                    f"edges never became ready (90s): {sorted(pending)}"
+                )
+                raise _DrillAbort()
+            wdoc = await fetch_json(session, compose_port, "/api/workers")
+            rows = ((wdoc or {}).get("bus") or {}).get("workers") or []
+            edge_rows = [r for r in rows if r.get("role") == "edge"]
+            if len(edge_rows) != edges:
+                failures.append(
+                    f"/api/workers shows {len(edge_rows)} edge links, "
+                    f"expected {edges}"
+                )
+            numbers["boot_s"] = round(time.monotonic() - (deadline - 90.0), 1)
+
+            # -- phase 1: the storm ------------------------------------------
+            tasks = [
+                asyncio.ensure_future(storm_client(session, i))
+                for i in range(clients)
+            ]
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and stats["events"] < clients:
+                await asyncio.sleep(0.5)
+            if stats["events"] < clients:
+                failures.append(
+                    f"storm barely streamed: {stats['events']} events "
+                    f"across {clients} clients"
+                )
+                raise _DrillAbort()
+
+            # -- phase 2: SIGKILL an edge — resume elsewhere with deltas -----
+            victims = clients // edges  # clients pinned to edge 0
+            base_resumes = stats["cross_resumes"]
+            await loop.run_in_executor(None, edge_procs[0].sigkill)
+            deadline = time.monotonic() + 30.0
+            want = base_resumes + max(1, victims // 2)
+            while time.monotonic() < deadline and (
+                stats["cross_resumes"] < want
+            ):
+                await asyncio.sleep(0.25)
+            numbers["edge_kill_cross_resumes"] = (
+                stats["cross_resumes"] - base_resumes
+            )
+            if stats["cross_resumes"] <= base_resumes:
+                failures.append(
+                    "no client resumed on another edge after the edge kill"
+                )
+            if stats["cross_delta_resumes"] == 0:
+                failures.append(
+                    "edge-kill failover broke delta continuity: every "
+                    "cross-edge resume re-inited with a full frame"
+                )
+            await loop.run_in_executor(None, edge_procs[0].spawn)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                bus = await edge_bus(session, edge_ports[0])
+                if bus.get("connected"):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                failures.append("respawned edge never rejoined the bus")
+
+            # -- phase 3: partition one edge's bus link, then heal -----------
+            part = 1
+            await forwarders[part].partition()
+            t_cut = time.monotonic()
+            stale_after = None
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                status, frame = await fetch_frame(session, edge_ports[part])
+                if status == 200 and frame is not None and frame.get("stale"):
+                    if any(
+                        a.get("rule") == "compose_down"
+                        for a in frame.get("alerts") or []
+                    ):
+                        stale_after = time.monotonic() - t_cut
+                        break
+                await asyncio.sleep(0.25)
+            if stale_after is None:
+                failures.append(
+                    "partitioned edge never served stale + compose_down"
+                )
+            else:
+                numbers["partition_stale_after_s"] = round(stale_after, 2)
+            hz = await fetch_json(session, edge_ports[part], "/healthz")
+            worker = (hz or {}).get("worker") or {}
+            if not hz or hz.get("ok") is not True:
+                failures.append(
+                    "partitioned edge /healthz flapped ok (the edge "
+                    "process is alive and serving)"
+                )
+            if worker.get("compose_down") is not True:
+                failures.append(
+                    "partitioned edge /healthz hid the dead bus link"
+                )
+            bus = worker.get("bus") or {}
+            if not (bus.get("counters") or {}).get("heartbeat_timeouts"):
+                failures.append(
+                    "blackholed link was not detected by heartbeat budget "
+                    f"(counters: {bus.get('counters')})"
+                )
+            await forwarders[part].heal()
+            t_heal = time.monotonic()
+            healed_after = None
+            deadline = time.monotonic() + _EDGESTORM_HEAL_BUDGET + 5.0
+            while time.monotonic() < deadline:
+                status, frame = await fetch_frame(session, edge_ports[part])
+                if (
+                    status == 200
+                    and frame is not None
+                    and not frame.get("stale")
+                ):
+                    healed_after = time.monotonic() - t_heal
+                    break
+                await asyncio.sleep(0.25)
+            if healed_after is None:
+                failures.append("partitioned edge never healed")
+            else:
+                numbers["partition_heal_s"] = round(healed_after, 2)
+                if healed_after > _EDGESTORM_HEAL_BUDGET:
+                    failures.append(
+                        f"heal took {healed_after:.1f}s — more than one "
+                        "reconnect at worst-case backoff "
+                        f"({_EDGESTORM_HEAL_BUDGET}s)"
+                    )
+
+            # -- phase 4: SIGKILL the compose — lockstep degrade, epoch ------
+            probe_port = edge_ports[2]
+            pre_id, _pre = await _killall_stream_once(
+                session, f"http://127.0.0.1:{probe_port}", "edgestorm-epoch"
+            )
+            if pre_id is None:
+                failures.append("no stream event before the compose kill")
+                raise _DrillAbort()
+            pre_seq = int(pre_id.split("-")[-1])
+            await loop.run_in_executor(None, compose.sigkill)
+            t_kill = time.monotonic()
+            degraded: "set[int]" = set()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and len(degraded) < edges:
+                for i in range(edges):
+                    if i in degraded:
+                        continue
+                    status, frame = await fetch_frame(session, edge_ports[i])
+                    if (
+                        status == 200
+                        and frame is not None
+                        and frame.get("stale")
+                        and any(
+                            a.get("rule") == "compose_down"
+                            for a in frame.get("alerts") or []
+                        )
+                    ):
+                        degraded.add(i)
+                await asyncio.sleep(0.25)
+            numbers["compose_kill_degraded_edges"] = len(degraded)
+            numbers["compose_kill_lockstep_s"] = round(
+                time.monotonic() - t_kill, 2
+            )
+            if len(degraded) < edges:
+                failures.append(
+                    f"only {len(degraded)}/{edges} edges degraded to "
+                    "stale + compose_down during the compose outage"
+                )
+            await loop.run_in_executor(None, compose.spawn)
+            fresh: "set[int]" = set()
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline and len(fresh) < edges:
+                for i in range(edges):
+                    if i in fresh:
+                        continue
+                    status, frame = await fetch_frame(session, edge_ports[i])
+                    if (
+                        status == 200
+                        and frame is not None
+                        and not frame.get("stale")
+                    ):
+                        fresh.add(i)
+                await asyncio.sleep(0.5)
+            if len(fresh) < edges:
+                failures.append(
+                    f"only {len(fresh)}/{edges} edges recovered after the "
+                    "compose restart"
+                )
+                raise _DrillAbort()
+            numbers["compose_restart_s"] = round(
+                time.monotonic() - t_kill, 2
+            )
+            post_id, _post = await _killall_stream_once(
+                session, f"http://127.0.0.1:{probe_port}", "edgestorm-epoch2"
+            )
+            if post_id is None:
+                failures.append("no stream event after the compose restart")
+            else:
+                post_seq = int(post_id.split("-")[-1])
+                if post_seq <= pre_seq:
+                    failures.append(
+                        f"restarted compose re-issued old seq range "
+                        f"({post_seq} <= {pre_seq}) — resumed acks could "
+                        "alias wrong-base delta chains across the restart"
+                    )
+
+            # -- phase 5: healthy links never resynced on a gap --------------
+            per_edge = []
+            for i in range(edges):
+                bus = await edge_bus(session, edge_ports[i])
+                counters = bus.get("counters") or {}
+                per_edge.append(
+                    {
+                        "edge": i,
+                        "reconnects": counters.get("reconnects", 0),
+                        "resyncs": counters.get("resyncs", 0),
+                        "sequence_gaps": counters.get("sequence_gaps", 0),
+                        "heartbeat_timeouts": counters.get(
+                            "heartbeat_timeouts", 0
+                        ),
+                    }
+                )
+                if counters.get("sequence_gaps", 0):
+                    failures.append(
+                        f"edge {i} hit a sequence gap on a healthy link "
+                        f"(last_gap: {bus.get('last_gap')})"
+                    )
+                if not counters.get("resyncs", 0):
+                    failures.append(
+                        f"edge {i} never resynced after the compose restart"
+                    )
+            numbers["per_edge"] = per_edge
+            numbers["stream_events_total"] = stats["events"]
+            numbers["cross_resumes"] = stats["cross_resumes"]
+            numbers["cross_delta_resumes"] = stats["cross_delta_resumes"]
+            numbers["cross_full_resumes"] = stats["cross_full_resumes"]
+            numbers["events_per_edge"] = {
+                f"edge-{i}": stats["per_edge"][edge_ports[i]]
+                for i in range(edges)
+            }
+    except _DrillAbort:
+        pass
+    finally:
+        stop.set()
+        if tasks:
+            await asyncio.wait(tasks, timeout=10)
+            for t in tasks:
+                t.cancel()
+        for f in forwarders:
+            with contextlib.suppress(OSError):
+                await f.close()
+        await loop.run_in_executor(None, compose.stop)
+        for p in edge_procs:
+            await loop.run_in_executor(None, p.stop)
+
+    # -- zero unhandled exceptions in ANY process's captured logs ------------
+    for p in [compose] + edge_procs:
+        errors = await loop.run_in_executor(None, p.tracebacks)
+        if errors:
+            failures.append(
+                f"process logs show unhandled errors: {errors[0][:400]}"
+            )
+            break
+    return {"ok": not failures, "failures": failures, **numbers}
+
+
 def main(argv: "list[str] | None" = None) -> None:
     import argparse
 
@@ -3533,6 +4172,17 @@ def main(argv: "list[str] | None" = None) -> None:
     )
     ca.add_argument("--mids", type=int, default=4)
     ca.add_argument("--leaves", type=int, default=4)
+    es = sub.add_parser(
+        "edgestorm",
+        help="edge-tier drill: real compose publishing the TCP frame "
+        "bus + N edge subprocesses behind partitionable forwarders; "
+        "SIGKILL an edge (clients resume elsewhere with delta "
+        "continuity), blackhole-partition a bus link (stale + "
+        "compose_down, heals in one reconnect), SIGKILL the compose "
+        "(lockstep degrade, epoch-floored resync)",
+    )
+    es.add_argument("--edges", type=int, default=16)
+    es.add_argument("--clients", type=int, default=256)
     rs = sub.add_parser(
         "rangescatter",
         help="analytics-plane drill: federated /api/range?agg=p99 "
@@ -3609,6 +4259,12 @@ def main(argv: "list[str] | None" = None) -> None:
     if args.mode == "cascade":
         summary = asyncio.run(
             run_cascade_drill(mids=args.mids, leaves=args.leaves)
+        )
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "edgestorm":
+        summary = asyncio.run(
+            run_edgestorm_drill(edges=args.edges, clients=args.clients)
         )
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)

@@ -471,6 +471,55 @@ class Config:
     #: deadline; bounding them caps both.  The overload drills set it so
     #: slow-consumer eviction is provable on loopback.
     sse_sndbuf: int = 0
+    # --- edge delivery tier (network frame bus + edge nodes) ----------------
+    #: Network frame-bus listener, ``host:port`` ("" = unix-socket bus
+    #: only).  When set, the compose process accepts BusMirror
+    #: connections over TCP/TLS beside the unix transport — the same
+    #: framed protocol, snapshot-then-stream semantics, and strict
+    #: per-connection sequencing — so stateless edge nodes on OTHER
+    #: hosts can mirror seal windows.  TCP connections never receive
+    #: the shm ring (fd passing is unix-only); they run in copying mode
+    #: with the blob bytes encoded once per seal and shared across
+    #: every network subscriber's message.
+    bus_listen: str = ""
+    #: Edge side: the compose bus address to mirror, ``host:port``
+    #: (``python -m tpudash.broadcast.edge`` refuses to start without
+    #: it).  The edge reconnects forever with decorrelated backoff;
+    #: while the link is down it serves its last mirrors re-marked
+    #: ``stale: true`` with a synthesized ``compose_down`` alert.
+    bus_connect: str = ""
+    #: Shared bearer token for the network bus ("" = open, matching the
+    #: unix bus's filesystem-permission posture).  An edge presents it
+    #: in its hello; the publisher refuses the connection BEFORE any
+    #: snapshot bytes on a missing/wrong token.  Also gates the
+    #: ``/internal/`` routes when the compose API is publicly bound.
+    bus_token: str = ""
+    #: TLS for the network bus: server certificate + key (compose side;
+    #: both required to enable TLS on the listener) and the CA bundle
+    #: peers verify against.  On the edge side ``bus_tls_ca`` alone
+    #: turns on TLS verification of the compose listener; when the
+    #: compose side sets ``bus_tls_ca`` it additionally requires client
+    #: certificates (mutual TLS).
+    bus_tls_cert: str = ""
+    bus_tls_key: str = ""
+    bus_tls_ca: str = ""
+    #: Network-bus heartbeat cadence, seconds: both sides send a ping
+    #: at this interval and treat a link silent for ~3 intervals as
+    #: dead — a silent TCP blackhole (half-open socket, dropped route)
+    #: is detected and reconnected instead of mistaken for an idle bus.
+    #: 0 disables heartbeats (unix transports never need them: a dead
+    #: peer is a clean EOF there).
+    bus_heartbeat: float = 5.0
+    #: Per-EDGE bus backlog, messages (0 = inherit broadcast_backlog).
+    #: A wedged edge — WAN stall, livelocked process — is cut once its
+    #: queue fills and re-snapshots on reconnect; it never head-of-line
+    #: blocks other edges or grows publisher memory.
+    edge_backlog: int = 0
+    #: Edge side: the compose tier's public HTTP base URL (e.g.
+    #: ``http://compose.tpu:8050``) for the routes an edge cannot
+    #: answer from its mirror — cohort resolution, proxied API calls,
+    #: and revalidation of its /api/range//api/summary cache.
+    edge_origin: str = ""
     #: Binary wire-format policy (TDB1, tpudash/app/wire.py): "auto"
     #: builds the binary seal encodings and serves them to clients that
     #: negotiate (``/api/stream?format=bin``, ``Accept:
@@ -561,6 +610,15 @@ _ENV_MAP = {
     "broadcast_idle_ttl": "TPUDASH_BROADCAST_IDLE_TTL",
     "shm_ring_mb": "TPUDASH_SHM_RING_MB",
     "sse_sndbuf": "TPUDASH_SSE_SNDBUF",
+    "bus_listen": "TPUDASH_BUS_LISTEN",
+    "bus_connect": "TPUDASH_BUS_CONNECT",
+    "bus_token": "TPUDASH_BUS_TOKEN",
+    "bus_tls_cert": "TPUDASH_BUS_TLS_CERT",
+    "bus_tls_key": "TPUDASH_BUS_TLS_KEY",
+    "bus_tls_ca": "TPUDASH_BUS_TLS_CA",
+    "bus_heartbeat": "TPUDASH_BUS_HEARTBEAT",
+    "edge_backlog": "TPUDASH_EDGE_BACKLOG",
+    "edge_origin": "TPUDASH_EDGE_ORIGIN",
     "wire_format": "TPUDASH_WIRE_FORMAT",
     "record_path": "TPUDASH_RECORD_PATH",
     "replay_path": "TPUDASH_REPLAY_PATH",
