@@ -74,3 +74,32 @@ if os.environ.get("TPUDASH_LOOPCHECK", "").strip() not in ("", "0"):
         finally:
             mon.uninstall()
         mon.assert_flat()
+
+
+# -- runtime FD/thread/task leak sanitizer (TPUDASH_FDCHECK=1) ----------------
+# Every test runs inside a ResourceCensus window: socket/open/Thread/
+# create_task creations are attributed to their creation sites, and the
+# test FAILS if it ends with tracked resources still alive — the leak
+# report names each one's creation stack.  CI's static-analysis and
+# chaos-soak jobs run in this mode; locally:
+# TPUDASH_FDCHECK=1 python -m pytest tests/ ...
+# Tests that PLANT leaks on purpose (or hold resources across tests by
+# design, e.g. session-scoped servers) opt out with
+# @pytest.mark.fdcheck_exempt.  Defined LAST so it installs innermost —
+# the loopcheck watchdog's daemon thread stays outside the census window.
+if os.environ.get("TPUDASH_FDCHECK", "").strip() not in ("", "0"):
+    import pytest  # noqa: E402, F811
+
+    @pytest.fixture(autouse=True)
+    def _fdcheck(request):
+        if request.node.get_closest_marker("fdcheck_exempt"):
+            yield
+            return
+        from tpudash.analysis.leakcheck import ResourceCensus
+
+        census = ResourceCensus().install()
+        try:
+            yield
+        finally:
+            census.uninstall()
+        census.assert_clean()

@@ -27,6 +27,19 @@ enforce and review alone will not keep enforced — so this package does:
   ``loop_lag_ms`` counters surface on ``/api/timings`` and ``/healthz``
   and run in pytest behind ``TPUDASH_LOOPCHECK=1``.
 
+- :mod:`tpudash.analysis.leakcheck` — ``python -m
+  tpudash.analysis.leakcheck`` — resource lifetimes, both halves: an
+  interprocedural static pass (sockets/files/memfds/executors/client
+  sessions that escape their creating scope un-closed on some path —
+  including connect/handshake error paths — non-daemon threads without
+  a join handle, long-lived tasks/timers without a cancellation owner,
+  ``finally:`` cleanup that can mask the in-flight exception) and a
+  runtime FD/thread/task census
+  (:class:`~tpudash.analysis.leakcheck.ResourceCensus`) that attributes
+  growth to creation sites, surfaces ``{fds, threads, tasks,
+  high_water}`` on ``/api/timings`` and ``/healthz`` in every role, and
+  runs in pytest behind ``TPUDASH_FDCHECK=1``.
+
 ``python -m tpudash.analysis`` runs every static analyzer as one gate
 (``--json`` for the machine-readable report; distinct exit codes per
 analyzer — see :mod:`tpudash.analysis.cli`).  All of them ship with zero

@@ -1,7 +1,8 @@
 """``python -m tpudash.analysis`` — every static analyzer, one entry point.
 
-Runs tpulint (:mod:`tpudash.analysis.lint`) and asynccheck
-(:mod:`tpudash.analysis.asynccheck`) over the same tree so CI and editors
+Runs tpulint (:mod:`tpudash.analysis.lint`), asynccheck
+(:mod:`tpudash.analysis.asynccheck`) and leakcheck
+(:mod:`tpudash.analysis.leakcheck`) over the same tree so CI and editors
 consume one command instead of tracking the analyzer roster:
 
     python -m tpudash.analysis                 # analyze the package
@@ -9,25 +10,29 @@ consume one command instead of tracking the analyzer roster:
     python -m tpudash.analysis --json          # machine-readable report
     python -m tpudash.analysis --rules         # list every rule
 
-Exit codes are distinct so a consumer can tell WHICH gate failed without
-parsing output:
+Exit codes are distinct bits so a consumer can tell WHICH gate failed
+without parsing output:
 
     0   clean
-    1   tpulint findings only
-    2   asynccheck findings only
-    3   findings from both analyzers
+    1   tpulint findings (bit)
+    2   asynccheck findings (bit)
+    8   leakcheck findings (bit)
     4   usage/internal error (bad path, nothing to scan, registry import)
+
+(a run with findings from several analyzers ORs the bits: tpulint +
+leakcheck = 9, all three = 11)
 
 ``--json`` prints one object::
 
     {"version": 1, "clean": false,
-     "counts": {"tpulint": 1, "asynccheck": 0},
+     "counts": {"tpulint": 1, "asynccheck": 0, "leakcheck": 0},
      "findings": [{"analyzer": "tpulint", "rule": "wall-clock",
                    "file": "...", "line": 12, "message": "..."}]}
 
-(racecheck and the loop-lag monitor are runtime sanitizers wired through
-pytest — ``TPUDASH_RACECHECK=1`` / ``TPUDASH_LOOPCHECK=1`` — not part of
-this static pass; see docs/DEVELOPMENT.md.)
+(racecheck, the loop-lag monitor and the resource census are runtime
+sanitizers wired through pytest — ``TPUDASH_RACECHECK=1`` /
+``TPUDASH_LOOPCHECK=1`` / ``TPUDASH_FDCHECK=1`` — not part of this
+static pass; see docs/DEVELOPMENT.md.)
 """
 
 from __future__ import annotations
@@ -35,16 +40,17 @@ from __future__ import annotations
 import json
 import sys
 
-from tpudash.analysis import asynccheck, lint
+from tpudash.analysis import asynccheck, leakcheck, lint
 
 EXIT_CLEAN = 0
 EXIT_LINT = 1
 EXIT_ASYNC = 2
 EXIT_USAGE = 4
+EXIT_LEAK = 8
 
 
 def run_all(paths: "list[str]") -> dict:
-    """Both analyzers over ``paths``; returns the ``--json`` report shape
+    """All analyzers over ``paths``; returns the ``--json`` report shape
     (the CLI and tests share it so they can never disagree)."""
     declared = lint._declared_env()
     doc_text = lint._operations_doc_text()
@@ -52,6 +58,7 @@ def run_all(paths: "list[str]") -> dict:
         paths, declared_env=declared, doc_text=doc_text
     )
     async_findings = asynccheck.check_paths(paths)
+    leak_findings = leakcheck.check_paths(paths)
     findings = [
         {
             "analyzer": analyzer,
@@ -63,6 +70,7 @@ def run_all(paths: "list[str]") -> dict:
         for analyzer, batch in (
             ("tpulint", lint_findings),
             ("asynccheck", async_findings),
+            ("leakcheck", leak_findings),
         )
         for f in sorted(batch)
     ]
@@ -72,6 +80,7 @@ def run_all(paths: "list[str]") -> dict:
         "counts": {
             "tpulint": len(lint_findings),
             "asynccheck": len(async_findings),
+            "leakcheck": len(leak_findings),
         },
         "findings": findings,
     }
@@ -81,7 +90,11 @@ def main(argv: "list[str] | None" = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     as_json = "--json" in argv
     if "--rules" in argv:
-        for name, mod in (("tpulint", lint), ("asynccheck", asynccheck)):
+        for name, mod in (
+            ("tpulint", lint),
+            ("asynccheck", asynccheck),
+            ("leakcheck", leakcheck),
+        ):
             for rule in mod.ALL_RULES:
                 print(f"{name}: {rule}: {mod.RULE_DOCS[rule]}")
         return EXIT_CLEAN
@@ -103,11 +116,12 @@ def main(argv: "list[str] | None" = None) -> int:
             )
         counts = report["counts"]
         if report["clean"]:
-            print("analysis: clean (tpulint + asynccheck)")
+            print("analysis: clean (tpulint + asynccheck + leakcheck)")
         else:
             print(
                 f"analysis: {counts['tpulint']} tpulint / "
-                f"{counts['asynccheck']} asynccheck finding(s)",
+                f"{counts['asynccheck']} asynccheck / "
+                f"{counts['leakcheck']} leakcheck finding(s)",
                 file=sys.stderr,
             )
     code = EXIT_CLEAN
@@ -115,4 +129,6 @@ def main(argv: "list[str] | None" = None) -> int:
         code |= EXIT_LINT
     if report["counts"]["asynccheck"]:
         code |= EXIT_ASYNC
+    if report["counts"]["leakcheck"]:
+        code |= EXIT_LEAK
     return code

@@ -1,0 +1,1521 @@
+"""leakcheck — interprocedural resource-lifetime analysis, static and runtime.
+
+PR 16 made the stack a long-lived, multi-process, multi-host tree:
+unix+TCP/TLS bus links with reconnect-forever loops, memfd seal rings,
+follower tailers, executor hops, per-edge backlogs.  That is exactly the
+shape where a single unclosed socket or orphaned thread *per reconnect*
+compounds into an outage — a fleet monitor that leaks FDs under churn is
+less reliable than what it watches, silently.  tpulint polices locks and
+asynccheck polices the event loop; nothing audits resource *lifetimes*.
+This module does, with the established two-half design:
+
+Static rules (``python -m tpudash.analysis.leakcheck``)
+-------------------------------------------------------
+Built on asynccheck's module index and call-graph resolver: resource
+*factories* (functions whose return value is a fresh resource) and
+resource *closers* (functions that close a parameter) propagate across
+call sites, so ``sock = self._handshake(...)`` is tracked like
+``sock = socket.socket(...)`` and ``self._discard(sock)`` counts as a
+close when ``_discard`` really closes its argument.
+
+``unclosed-resource``
+    A socket / ``open()`` file / memfd / ``SharedMemory`` / executor /
+    ``aiohttp.ClientSession`` / ``mmap`` / TLS-wrapped socket is created
+    outside ``with`` / ``try-finally`` / a registered cleanup
+    (``contextlib.closing``, ``ExitStack.enter_context/callback``) and
+    can escape the creating scope on some path — including the *error*
+    paths of ``connect`` / handshake calls between creation and the
+    close or ownership transfer.
+
+``thread-no-join``
+    A non-daemon ``threading.Thread`` is started without a ``join()``
+    (or stop handle) reachable from shutdown: locally in the creating
+    function, or — when retained on ``self`` — in some method of the
+    owning class.  Non-daemon threads without a join owner turn every
+    clean shutdown into a hang.
+
+``task-no-cancel``
+    A retained ``asyncio.create_task`` / ``ensure_future`` handle, or a
+    ``call_later`` / ``call_at`` / ``threading.Timer`` timer, with no
+    cancellation owner: the local handle is never awaited / cancelled /
+    handed off, or the ``self``-retained handle has no method that both
+    references it and cancels.  Extends asynccheck's ``unretained-task``
+    (bare-expression spawns) to *lifecycle* — a retained-but-immortal
+    task still outlives every shutdown path.
+
+``finally-can-raise``
+    A cleanup call (``close`` / ``shutdown`` / ``flush`` / ``unlink`` /
+    ``terminate`` / ``wait_closed``) sits unguarded in a ``finally:``
+    block: if it raises (closing a broken socket commonly does), it
+    *replaces* the in-flight exception that triggered the cleanup.
+    Wrap it in ``contextlib.suppress(OSError)`` or a local try/except.
+
+Allow mechanism: identical to tpulint — ``# tpulint: allow[rule]
+reason`` on the finding line, the line above, or a ``def`` header for
+scope coverage.  Exit status 0 = clean; 1 = findings (``file:line:
+rule: message``); 2 = usage error.
+
+Runtime sanitizer (:class:`ResourceCensus`)
+-------------------------------------------
+Static rules cannot see dynamically-dispatched creation or refcount
+keep-alives.  The census instruments the running process (refcounted
+process-wide patches, mirroring racecheck's install model): ``socket``
+construction, ``open()``, ``Thread.start()`` and ``loop.create_task()``
+record a creation site; :func:`process_census` snapshots
+``/proc/self/fd`` + ``threading.enumerate()`` + ``asyncio.all_tasks()``
+and every server role (compose, worker, edge, follower) surfaces the
+result as ``census`` — ``{fds, threads, tasks, high_water}`` — on
+``/api/timings`` and ``/healthz``; the chaos drills assert zero net
+growth between pre- and post-storm steady states.  The pytest suite
+enables the census behind ``TPUDASH_FDCHECK=1`` (autouse fixture in
+``tests/conftest.py``; tests that leak on purpose opt out with
+``@pytest.mark.fdcheck_exempt``): any resource created during a test
+and still alive at its end fails the test *with the creation site*.
+"""
+
+from __future__ import annotations
+
+import ast
+import gc
+import os
+import sys
+import threading
+import time
+import weakref
+
+from tpudash.analysis.asynccheck import (
+    _FuncInfo,
+    _ModuleInfo,
+    _resolve,
+    index_source,
+)
+from tpudash.analysis.lint import (
+    Finding,
+    _dotted,
+    iter_py_files,
+    resolve_cli_paths,
+)
+
+RULE_UNCLOSED = "unclosed-resource"
+RULE_THREAD_JOIN = "thread-no-join"
+RULE_TASK_CANCEL = "task-no-cancel"
+RULE_FINALLY_RAISE = "finally-can-raise"
+
+ALL_RULES = (
+    RULE_UNCLOSED,
+    RULE_THREAD_JOIN,
+    RULE_TASK_CANCEL,
+    RULE_FINALLY_RAISE,
+)
+
+RULE_DOCS = {
+    RULE_UNCLOSED: (
+        "sockets/files/memfds/SharedMemory/executors/client sessions must "
+        "be created under with/try-finally/a registered cleanup, or every "
+        "path from creation to close/ownership-transfer (including "
+        "connect/handshake error paths) must be covered by a close"
+    ),
+    RULE_THREAD_JOIN: (
+        "a non-daemon Thread that is start()ed needs a join()/stop handle "
+        "reachable from shutdown (locally, or in a method of the class "
+        "that retains it)"
+    ),
+    RULE_TASK_CANCEL: (
+        "retained create_task/ensure_future handles and call_later/"
+        "call_at/Timer timers need a cancellation owner — a method (or "
+        "local path) that cancels/awaits them at shutdown"
+    ),
+    RULE_FINALLY_RAISE: (
+        "cleanup calls in finally: blocks must not be able to raise over "
+        "the in-flight exception — wrap close()/shutdown()/flush() in "
+        "contextlib.suppress(...) or a local try/except"
+    ),
+}
+
+#: call tails that create a resource needing an explicit close, → label
+_RESOURCE_TAILS = {
+    "socket": "socket",
+    "socketpair": "socket pair",
+    "create_connection": "socket",
+    "wrap_socket": "TLS socket",
+    "memfd_create": "memfd",
+    "SharedMemory": "shared memory segment",
+    "ThreadPoolExecutor": "executor",
+    "ProcessPoolExecutor": "executor",
+    "ClientSession": "client session",
+}
+
+#: <module>.open(...) roots that return a closeable handle (a bare
+#: ``open(...)`` always does)
+_OPEN_ROOTS = {"os", "io", "gzip", "bz2", "lzma", "mmap"}
+
+#: method tails that end a resource's lifetime when called on its name
+_CLEANUP_TAILS = {
+    "close",
+    "aclose",
+    "shutdown",
+    "terminate",
+    "cancel",
+    "detach",
+    "release",
+    "unlink",
+}
+
+#: call tails that register the resource with a managed-cleanup owner
+_REGISTER_TAILS = {
+    "closing",
+    "aclosing",
+    "enter_context",
+    "enter_async_context",
+    "push",
+    "push_async_callback",
+    "callback",
+}
+
+#: tails that cannot meaningfully fail between creation and close — they
+#: do not count as "a call on the error path" (keeps the success-path
+#: close rule about real hazards: connect, handshake, I/O, user calls)
+_BENIGN_TAILS = {
+    "setsockopt",
+    "settimeout",
+    "setblocking",
+    "set_inheritable",
+    "fileno",
+    "getsockname",
+    "getpeername",
+    "debug",
+    "info",
+    "warning",
+    "append",
+    "get",
+    "monotonic",
+    "perf_counter",
+}
+
+#: cleanup tails in a ``finally:`` that can raise over the in-flight
+#: exception (closing broken sockets/files raises OSError routinely)
+_RAISING_CLEANUP_TAILS = {
+    "close",
+    "shutdown",
+    "flush",
+    "unlink",
+    "remove",
+    "terminate",
+    "wait_closed",
+}
+
+_TASK_TAILS = {"create_task", "ensure_future"}
+_TIMER_TAILS = {"call_later", "call_at", "Timer"}
+
+
+def _is_cleanup_of(call: ast.Call, name: str) -> bool:
+    """Does ``call`` end ``name``'s lifetime?  Two spellings: a cleanup
+    method on the name (``name.close()``) and the raw-fd form
+    (``os.close(name)`` / ``os.closerange(name, …)``)."""
+    parts = _dotted(call.func)
+    if parts is None:
+        return False
+    if len(parts) >= 2 and parts[0] == name and parts[-1] in _CLEANUP_TAILS:
+        return True
+    if (
+        len(parts) == 2
+        and parts[0] == "os"
+        and parts[1] in ("close", "closerange")
+        and call.args
+        and isinstance(call.args[0], ast.Name)
+        and call.args[0].id == name
+    ):
+        return True
+    return False
+
+
+def _call_ref(parts: "list[str]"):
+    """Dotted call → asynccheck ``_resolve`` (kind, payload), or None."""
+    if len(parts) == 1:
+        return ("bare", parts[0])
+    if parts[0] == "self" and len(parts) == 2:
+        return ("self", parts[1])
+    if len(parts) == 2:
+        return ("attr", (parts[0], parts[1]))
+    return None
+
+
+def _syntactic_kind(parts: "list[str]") -> "str | None":
+    """Resource label for a creation call spelled directly, else None."""
+    tail = parts[-1]
+    if tail in _RESOURCE_TAILS:
+        return _RESOURCE_TAILS[tail]
+    if tail == "open":
+        if len(parts) == 1 or parts[0] in _OPEN_ROOTS:
+            return "file handle"
+        return None
+    if tail == "mmap" and (len(parts) == 1 or parts[0] == "mmap"):
+        return "mmap"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-function fact collection (feeds the interprocedural fixpoint)
+# ---------------------------------------------------------------------------
+
+
+class _FnFacts:
+    __slots__ = (
+        "node",
+        "mod",
+        "fi",
+        "class_name",
+        "scope_lines",
+        "params",
+        "factory",
+        "factory_kind",
+        "closes",
+        "return_calls",
+        "returned_names",
+        "name_calls",
+    )
+
+    def __init__(self, node, mod, fi, class_name, scope_lines):
+        self.node = node
+        self.mod = mod
+        self.fi = fi
+        self.class_name = class_name
+        self.scope_lines = scope_lines
+        self.params = [a.arg for a in _all_args(node.args)]
+        self.factory = False
+        self.factory_kind: "str | None" = None
+        self.closes: set = set()  # param names this function closes
+        self.return_calls: list = []  # (kind, payload) returned directly
+        self.returned_names: set = set()
+        self.name_calls: dict = {}  # local name → [(kind, payload)]
+
+
+class _ClassFacts:
+    """Per-class ownership evidence for self-retained threads/tasks:
+    for each method, which ``self.<attr>`` names it touches and which
+    method tails it calls — ``for t in self._tasks: t.cancel()`` makes
+    the method an owner of ``_tasks`` for tail ``cancel``."""
+
+    __slots__ = ("methods",)
+
+    def __init__(self):
+        self.methods: list = []  # (set of self attrs, set of call tails)
+
+    def owns(self, attr: str, tails: "set[str]") -> bool:
+        return any(
+            attr in attrs and (call_tails & tails)
+            for attrs, call_tails in self.methods
+        )
+
+
+def _all_args(args: ast.arguments):
+    return [*args.posonlyargs, *args.args, *args.kwonlyargs]
+
+
+def _func_body_nodes(fn_node):
+    """Every AST node of the function body, nested defs excluded (they
+    run on their own schedule and are analyzed as their own functions)."""
+    out: list = []
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+    return out
+
+
+def _nested_def_names(fn_node) -> "set[str]":
+    """Names referenced inside nested defs/lambdas of ``fn_node`` — a
+    resource captured by a closure escapes the creating scope on the
+    closure's schedule, so lifetime analysis gives it up (safe)."""
+    names: set = set()
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+            continue
+        if isinstance(node, ast.Lambda):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def _collect_fn_facts(fn: _FnFacts) -> None:
+    """Phase-A facts for the fixpoint: which calls this function returns,
+    which locals those returns came from, which params it closes."""
+    for node in _func_body_nodes(fn.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            value = node.value
+            if isinstance(value, ast.Await):
+                value = value.value
+            if isinstance(value, ast.Call):
+                parts = _dotted(value.func)
+                if parts is not None:
+                    fn.return_calls.append((parts, value))
+            elif isinstance(value, ast.Name):
+                fn.returned_names.add(value.id)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = node.value
+            if isinstance(value, ast.Await):
+                value = value.value
+            if isinstance(value, ast.Call):
+                parts = _dotted(value.func)
+                if parts is not None:
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            fn.name_calls.setdefault(t.id, []).append(
+                                (parts, value)
+                            )
+        elif isinstance(node, ast.Call):
+            parts = _dotted(node.func)
+            if (
+                parts is not None
+                and len(parts) == 2
+                and parts[1] in _CLEANUP_TAILS
+                and parts[0] in fn.params
+            ):
+                fn.closes.add(parts[0])
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Name) and ctx.id in fn.params:
+                    fn.closes.add(ctx.id)
+
+
+class _Facts:
+    """Whole-tree view: asynccheck's module index for resolution plus
+    leakcheck's per-function/per-class facts keyed by definition site."""
+
+    def __init__(self):
+        self.index: dict = {}  # module name → _ModuleInfo
+        self.fns: dict = {}  # (path, lineno) → _FnFacts
+        self.classes: dict = {}  # (path, class name) → _ClassFacts
+
+    def facts_for(self, fi: "_FuncInfo | None") -> "_FnFacts | None":
+        if fi is None:
+            return None
+        return self.fns.get((fi.path, fi.lineno))
+
+    def resolve_call(
+        self, fn: _FnFacts, parts: "list[str]"
+    ) -> "_FnFacts | None":
+        ref = _call_ref(parts)
+        if ref is None or fn.fi is None:
+            return None
+        mod = self.index.get(fn.mod.name, fn.mod)
+        target = _resolve(self.index, mod, fn.fi, ref[0], ref[1])
+        return self.facts_for(target)
+
+    def creation_kind(
+        self, fn: _FnFacts, parts: "list[str]"
+    ) -> "str | None":
+        """Resource label for a call: spelled directly, or resolving to
+        a factory function (the interprocedural half)."""
+        kind = _syntactic_kind(parts)
+        if kind is not None:
+            return kind
+        callee = self.resolve_call(fn, parts)
+        if callee is not None and callee.factory:
+            return callee.factory_kind or "resource"
+        return None
+
+    def call_closes_arg(
+        self, fn: _FnFacts, call: ast.Call, arg_node
+    ) -> bool:
+        """True when ``call`` resolves to a function that closes the
+        parameter ``arg_node`` is bound to."""
+        parts = _dotted(call.func)
+        if parts is None:
+            return False
+        callee = self.resolve_call(fn, parts)
+        if callee is None or not callee.closes:
+            return False
+        # positional binding; methods resolved via self drop the self slot
+        params = callee.params
+        if params and params[0] == "self":
+            params = params[1:]
+        for i, a in enumerate(call.args):
+            if a is arg_node:
+                if i < len(params) and params[i] in callee.closes:
+                    return True
+        for kw in call.keywords:
+            if kw.value is arg_node and kw.arg in callee.closes:
+                return True
+        return False
+
+
+def _fixpoint(facts: _Facts) -> None:
+    """Propagate factory-ness (returns a fresh resource) and closer-ness
+    (closes a parameter) through resolved calls until stable."""
+    changed = True
+    while changed:
+        changed = False
+        for fn in facts.fns.values():
+            if fn.factory:
+                continue
+            kind = None
+            for parts, _call in fn.return_calls:
+                kind = facts.creation_kind(fn, parts)
+                if kind is not None:
+                    break
+            if kind is None:
+                for name in fn.returned_names:
+                    for parts, _call in fn.name_calls.get(name, ()):
+                        kind = facts.creation_kind(fn, parts)
+                        if kind is not None:
+                            break
+                    if kind is not None:
+                        break
+            if kind is not None:
+                fn.factory = True
+                fn.factory_kind = kind
+                changed = True
+
+
+# ---------------------------------------------------------------------------
+# Rule analysis proper
+# ---------------------------------------------------------------------------
+
+
+class _FnAnalysis:
+    """One function's lifetime verdicts.  Findings append to ``out``."""
+
+    def __init__(self, fn: _FnFacts, facts: _Facts, out: "list[Finding]"):
+        self.fn = fn
+        self.facts = facts
+        self.out = out
+        self.mod = fn.mod
+        self.body = _func_body_nodes(fn.node)
+        self.closure_names = _nested_def_names(fn.node)
+        # parent links inside this function (nested defs excluded)
+        self.parents: dict = {}
+        for node in self.body:
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        for child in ast.iter_child_nodes(self.fn.node):
+            self.parents.setdefault(child, self.fn.node)
+
+    # -- shared helpers ------------------------------------------------------
+    def _flag(self, rule: str, line: int, message: str) -> None:
+        if self.mod.allowed(rule, line, self.fn.scope_lines):
+            return
+        self.out.append(Finding(self.mod.path, line, rule, message))
+
+    def _ancestors(self, node):
+        seen = set()
+        while node is not None and id(node) not in seen:
+            seen.add(id(node))
+            yield node
+            node = self.parents.get(node)
+
+    def _cleans_name(self, stmts, name: str) -> bool:
+        """Does this statement list close/cancel ``name``?"""
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and _is_cleanup_of(node, name):
+                    return True
+        return False
+
+    def _guarded(self, node, name: str) -> bool:
+        """Is ``node`` inside a try whose finally/handlers close ``name``
+        (so an exception at ``node`` cannot leak it)?"""
+        for anc in self._ancestors(node):
+            if isinstance(anc, ast.Try):
+                if self._cleans_name(anc.finalbody, name):
+                    return True
+                for handler in anc.handlers:
+                    if self._cleans_name(handler.body, name):
+                        return True
+        return False
+
+    # -- unclosed-resource ---------------------------------------------------
+    def check_resources(self) -> None:
+        for node in self.body:
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            if parts is None:
+                continue
+            kind = self.facts.creation_kind(self.fn, parts)
+            if kind is None:
+                continue
+            self._check_one_creation(node, parts, kind)
+
+    def _check_one_creation(self, call, parts, kind) -> None:
+        label = ".".join(parts)
+        parent = self.parents.get(call)
+        if isinstance(parent, ast.Await):
+            call = parent
+            parent = self.parents.get(parent)
+        if isinstance(parent, ast.withitem):
+            return  # with socket.socket(...) as s:
+        if isinstance(parent, ast.Call):
+            wrapper = _dotted(parent.func)
+            if wrapper is not None and wrapper[-1] in _REGISTER_TAILS:
+                return  # closing(...) / stack.enter_context(...)
+            if wrapper is not None and _syntactic_kind(wrapper) is not None:
+                return  # wrap_socket(socket(...)): outer creation owns it
+            # handed straight to a callee: close there, or ownership moved
+            return
+        if isinstance(parent, ast.Return):
+            return  # factory: caller owns it (tracked at the call site)
+        if isinstance(parent, ast.Attribute):
+            # chained call on the fresh resource with the handle dropped:
+            # open(p).read() leaks the file on CPython refcount grace only
+            grand = self.parents.get(parent)
+            if isinstance(grand, ast.Call):
+                tail = parent.attr
+                if tail in _CLEANUP_TAILS:
+                    return
+                self._flag(
+                    RULE_UNCLOSED,
+                    call.lineno,
+                    f"{kind} from {label}(...) is used and dropped in one "
+                    "expression — nothing can ever close it; bind it under "
+                    "`with` or close it explicitly",
+                )
+            return
+        if isinstance(parent, ast.Expr):
+            self._flag(
+                RULE_UNCLOSED,
+                call.lineno,
+                f"{kind} from {label}(...) is created and discarded — the "
+                "handle is unreachable and stays open until interpreter "
+                "exit; bind it under `with` or close it",
+            )
+            return
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+            targets = (
+                parent.targets
+                if isinstance(parent, ast.Assign)
+                else [parent.target]
+            )
+            if len(targets) == 1 and isinstance(targets[0], ast.Name):
+                self._check_named_resource(
+                    targets[0].id, call, parts, kind, parent
+                )
+            # self.attr / subscript / tuple targets: object-lifetime
+            # ownership — the retaining object's close discipline owns it
+            return
+        # collection element, yield, comparison, … : ownership escapes to
+        # a structure we cannot see; give the benefit of the doubt
+
+    def _check_named_resource(self, name, call, parts, kind, assign):
+        if name in self.closure_names:
+            return  # captured by a nested def: closure owns the lifetime
+        label = ".".join(parts)
+        created = call.lineno
+        cleanup_sites: list = []  # (node, in_finally, in_except)
+        registered = False
+        transfer_line: "int | None" = None  # return/yield/re-home
+        arg_transfer_line: "int | None" = None  # passed to a callee
+        with_managed = False
+        for node in self.body:
+            line = getattr(node, "lineno", 0)
+            if line < created:
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name) and ctx.id == name:
+                        with_managed = True
+            elif isinstance(node, ast.Call):
+                cparts = _dotted(node.func)
+                if cparts is None:
+                    # dynamically-computed callee taking the name: treat
+                    # as ownership transfer below via the generic scan
+                    pass
+                elif _is_cleanup_of(node, name):
+                    in_finally = in_except = False
+                    for anc in self._ancestors(node):
+                        p = self.parents.get(anc)
+                        if isinstance(p, ast.Try) and anc in getattr(
+                            p, "finalbody", ()
+                        ):
+                            in_finally = True
+                        if isinstance(anc, ast.ExceptHandler):
+                            in_except = True
+                    cleanup_sites.append((node, in_finally, in_except))
+                elif cparts[-1] in _REGISTER_TAILS and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in node.args
+                ):
+                    registered = True
+                elif any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in (*node.args, *(kw.value for kw in node.keywords))
+                ):
+                    arg = next(
+                        a
+                        for a in (
+                            *node.args,
+                            *(kw.value for kw in node.keywords),
+                        )
+                        if isinstance(a, ast.Name) and a.id == name
+                    )
+                    if self.facts.call_closes_arg(self.fn, node, arg):
+                        in_finally = any(
+                            isinstance(self.parents.get(anc), ast.Try)
+                            and anc
+                            in getattr(self.parents.get(anc), "finalbody", ())
+                            for anc in self._ancestors(node)
+                        )
+                        cleanup_sites.append((node, in_finally, False))
+                    elif arg_transfer_line is None:
+                        arg_transfer_line = line
+            elif isinstance(node, ast.Return) and node.value is not None:
+                if any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for sub in ast.walk(node.value)
+                ):
+                    if transfer_line is None:
+                        transfer_line = line
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)) and node is not assign:
+                value = node.value
+                if isinstance(value, ast.Call) or (
+                    isinstance(value, ast.Await)
+                    and isinstance(value.value, ast.Call)
+                ):
+                    # `x = f(name)` re-homes nothing by itself — the Call
+                    # node scan decides (close / register / arg transfer)
+                    continue
+                if value is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for sub in ast.walk(value)
+                ):
+                    # aliased / stored on self / into a structure
+                    if transfer_line is None:
+                        transfer_line = line
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)):
+                if node.value is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for sub in ast.walk(node.value)
+                ):
+                    if transfer_line is None:
+                        transfer_line = line
+        if with_managed or registered:
+            return
+        if any(in_finally for _n, in_finally, _e in cleanup_sites):
+            return
+        if not cleanup_sites and transfer_line is None:
+            # only a plain call ever sees the name: assume the callee
+            # takes ownership (closes or retains it)
+            transfer_line = arg_transfer_line
+        risky = self._risky_between(
+            created,
+            min(
+                [s[0].lineno for s in cleanup_sites]
+                + ([transfer_line] if transfer_line is not None else []),
+                default=None,
+            ),
+            name,
+            skip={id(call)} | {id(s[0]) for s in cleanup_sites},
+            creation=call,
+        )
+        if cleanup_sites:
+            if all(in_except for _n, _f, in_except in cleanup_sites) and (
+                transfer_line is None and arg_transfer_line is None
+            ):
+                self._flag(
+                    RULE_UNCLOSED,
+                    created,
+                    f"{kind} `{name}` from {label}(...) is closed only in "
+                    "an except handler — the success path never closes it "
+                    "and it escapes the scope open",
+                )
+                return
+            if risky is not None:
+                self._flag(
+                    RULE_UNCLOSED,
+                    created,
+                    f"{kind} `{name}` from {label}(...) is closed only on "
+                    f"the success path — if line {risky} raises first, "
+                    "the handle escapes open; close it in a finally: or "
+                    "use `with`",
+                )
+            return
+        if transfer_line is not None:
+            if risky is not None:
+                self._flag(
+                    RULE_UNCLOSED,
+                    created,
+                    f"{kind} `{name}` from {label}(...) leaks on the error "
+                    f"path: line {risky} can raise before ownership moves "
+                    f"at line {transfer_line}; close `{name}` in an "
+                    "except/finally covering that window",
+                )
+            return
+        self._flag(
+            RULE_UNCLOSED,
+            created,
+            f"{kind} `{name}` from {label}(...) is never closed and never "
+            "escapes this scope — it leaks on every path; use `with` or "
+            "close it in a finally:",
+        )
+
+    def _risky_between(self, start, end, name, skip, creation) -> "int | None":
+        """First line in (start, end) whose call/await can raise before
+        the resource is safe — the error-path escape window.  ``end`` of
+        None means "to the end of the function"."""
+        creation_handlers = {
+            id(anc)
+            for anc in self._ancestors(creation)
+            if isinstance(anc, ast.ExceptHandler)
+        }
+        for node in self.body:
+            line = getattr(node, "lineno", 0)
+            if line <= start:
+                continue
+            if end is not None and line >= end:
+                continue
+            if id(node) in skip:
+                continue
+            if not isinstance(node, (ast.Await, ast.Call)):
+                continue
+            # a statement inside an except handler that does NOT contain
+            # the creation runs only when the creation's own try body
+            # raised — it is not on the creation's success path
+            if any(
+                isinstance(anc, ast.ExceptHandler)
+                and id(anc) not in creation_handlers
+                for anc in self._ancestors(node)
+            ):
+                continue
+            if isinstance(node, ast.Await):
+                if not self._guarded(node, name):
+                    return line
+                continue
+            parts = _dotted(node.func)
+            if parts is not None and (
+                parts[-1] in _BENIGN_TAILS
+                or parts[-1] in _CLEANUP_TAILS
+                or parts[-1] in _REGISTER_TAILS
+                or parts[-1] == "suppress"
+            ):
+                continue
+            if _is_cleanup_of(node, name):
+                continue
+            if not self._guarded(node, name):
+                return line
+        return None
+
+    # -- thread-no-join ------------------------------------------------------
+    def check_threads(self) -> None:
+        for node in self.body:
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            if parts is None or parts[-1] != "Thread":
+                continue
+            if _kw_is_true(node, "daemon"):
+                continue
+            self._check_one_thread(node, parts)
+
+    def _check_one_thread(self, call, parts) -> None:
+        label = ".".join(parts)
+        parent = self.parents.get(call)
+        if isinstance(parent, ast.Attribute) and parent.attr == "start":
+            # Thread(...).start(): no handle exists to ever join
+            self._flag(
+                RULE_THREAD_JOIN,
+                call.lineno,
+                f"non-daemon {label}(...).start() drops the only handle — "
+                "nothing can join it at shutdown; retain it (and join) or "
+                "pass daemon=True",
+            )
+            return
+        name = attr = None
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                parent.targets
+                if isinstance(parent, ast.Assign)
+                else [parent.target]
+            )
+            if len(targets) == 1 and isinstance(targets[0], ast.Name):
+                name = targets[0].id
+            elif (
+                len(targets) == 1
+                and isinstance(targets[0], ast.Attribute)
+                and isinstance(targets[0].value, ast.Name)
+                and targets[0].value.id == "self"
+            ):
+                attr = targets[0].attr
+        else:
+            return  # returned / collected: caller owns the join
+        if name is not None:
+            started = joined = daemonized = transferred = False
+            for node in self.body:
+                if isinstance(node, ast.Call):
+                    cparts = _dotted(node.func)
+                    if cparts is not None and len(cparts) >= 2 and cparts[0] == name:
+                        if cparts[-1] == "start":
+                            started = True
+                        if cparts[-1] == "join":
+                            joined = True
+                    elif cparts is not None and any(
+                        isinstance(a, ast.Name) and a.id == name
+                        for a in (
+                            *node.args,
+                            *(kw.value for kw in node.keywords),
+                        )
+                    ):
+                        transferred = True
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and t.attr == "daemon"
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == name
+                        ):
+                            daemonized = _const_true(node.value)
+                        if isinstance(t, ast.Attribute) and any(
+                            isinstance(sub, ast.Name) and sub.id == name
+                            for sub in ast.walk(node.value)
+                        ):
+                            transferred = True
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    if any(
+                        isinstance(sub, ast.Name) and sub.id == name
+                        for sub in ast.walk(node.value)
+                    ):
+                        transferred = True
+            if name in self.closure_names:
+                transferred = True
+            if started and not (joined or daemonized or transferred):
+                self._flag(
+                    RULE_THREAD_JOIN,
+                    call.lineno,
+                    f"non-daemon thread `{name}` is started but never "
+                    "joined and never handed off — shutdown cannot reach "
+                    "it; join it, hand it to an owner, or pass daemon=True",
+                )
+            return
+        if attr is not None:
+            cls = self.facts.classes.get((self.mod.path, self.fn.class_name))
+            if cls is None or not cls.owns(attr, {"join"}):
+                self._flag(
+                    RULE_THREAD_JOIN,
+                    call.lineno,
+                    f"non-daemon thread on self.{attr} has no join owner — "
+                    f"no method of {self.fn.class_name or 'this class'} "
+                    f"references self.{attr} and calls join(); add one to "
+                    "the shutdown path or pass daemon=True",
+                )
+
+    # -- task-no-cancel ------------------------------------------------------
+    def check_tasks(self) -> None:
+        for node in self.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if isinstance(value, ast.Await):
+                continue  # await create_task(...) completes inline
+            if not isinstance(value, ast.Call):
+                continue
+            parts = _dotted(value.func)
+            if parts is None:
+                continue
+            if parts[-1] in _TASK_TAILS:
+                what, verb = "task", "cancels"
+            elif parts[-1] in _TIMER_TAILS:
+                what, verb = "timer", "cancels"
+            else:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if len(targets) != 1:
+                continue
+            target = targets[0]
+            if isinstance(target, ast.Name):
+                self._check_local_task(target.id, value, parts, what)
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                self._check_attr_task(target.attr, value, parts, what, verb)
+
+    def _check_local_task(self, name, call, parts, what) -> None:
+        if name in self.closure_names:
+            return
+        label = ".".join(parts)
+        for node in self.body:
+            if isinstance(node, ast.Call):
+                cparts = _dotted(node.func)
+                if cparts is not None and len(cparts) >= 2 and cparts[0] == name:
+                    if cparts[-1] in ("cancel", "add_done_callback", "result"):
+                        return
+                if cparts is not None and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in (*node.args, *(kw.value for kw in node.keywords))
+                ):
+                    return  # gathered / waited / handed to an owner
+            elif isinstance(node, ast.Await):
+                if any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for sub in ast.walk(node.value)
+                ):
+                    return
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                if (
+                    value is not None
+                    and not (isinstance(value, ast.Call) and value is call)
+                    and any(
+                        isinstance(sub, ast.Name) and sub.id == name
+                        for sub in ast.walk(value)
+                    )
+                ):
+                    return  # re-homed (self.x = t, dict[k] = t, …)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                if any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for sub in ast.walk(node.value)
+                ):
+                    return
+        self._flag(
+            RULE_TASK_CANCEL,
+            call.lineno,
+            f"{what} `{name}` from {label}(...) is retained here but "
+            "never awaited, cancelled, or handed to an owner — at scope "
+            "exit it runs unsupervised exactly like an unretained spawn",
+        )
+
+    def _check_attr_task(self, attr, call, parts, what, verb) -> None:
+        label = ".".join(parts)
+        cls = self.facts.classes.get((self.mod.path, self.fn.class_name))
+        if cls is not None and cls.owns(attr, {"cancel", "join"}):
+            return
+        self._flag(
+            RULE_TASK_CANCEL,
+            call.lineno,
+            f"long-lived {what} on self.{attr} ({label}) has no "
+            f"cancellation owner — no method of "
+            f"{self.fn.class_name or 'this class'} references "
+            f"self.{attr} and {verb}; wire it into the shutdown path",
+        )
+
+
+# -- finally-can-raise (module-wide, no function context needed) -------------
+
+
+def _check_finally(tree, mod: _ModuleInfo, out: "list[Finding]") -> None:
+    # scope lines for allow markers: enclosing def headers per node
+    def walk(node, scopes, suppressed):
+        if isinstance(node, ast.Try) and node.finalbody and not suppressed:
+            for stmt in node.finalbody:
+                _scan_final_stmt(stmt, mod, scopes, out)
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+            isinstance(item.context_expr, ast.Call)
+            and (_dotted(item.context_expr.func) or [""])[-1] == "suppress"
+            for item in node.items
+        ):
+            # everything under `with contextlib.suppress(...)` already
+            # swallows what its cleanup calls could raise
+            suppressed = True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, scopes + [child.lineno], suppressed)
+            else:
+                walk(child, scopes, suppressed)
+
+    walk(tree, [], False)
+
+
+def _scan_final_stmt(stmt, mod, scopes, out, guarded=False) -> None:
+    """Flag unguarded raising-cleanup calls in a finally statement.
+    Guards: a nested try with handlers, or `with contextlib.suppress`."""
+    if isinstance(stmt, ast.Try) and stmt.handlers:
+        for sub in (*stmt.body, *stmt.orelse, *stmt.finalbody):
+            _scan_final_stmt(sub, mod, scopes, out, guarded=True)
+        for handler in stmt.handlers:
+            for sub in handler.body:
+                _scan_final_stmt(sub, mod, scopes, out, guarded=guarded)
+        return
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        suppressed = guarded or any(
+            isinstance(item.context_expr, ast.Call)
+            and (_dotted(item.context_expr.func) or [""])[-1] == "suppress"
+            for item in stmt.items
+        )
+        for sub in stmt.body:
+            _scan_final_stmt(sub, mod, scopes, out, guarded=suppressed)
+        return
+    if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+        for sub in (*stmt.body, *stmt.orelse):
+            _scan_final_stmt(sub, mod, scopes, out, guarded=guarded)
+        return
+    if guarded:
+        return
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            parts = _dotted(node.func)
+            if parts is None or len(parts) < 2:
+                continue
+            if parts[-1] not in _RAISING_CLEANUP_TAILS:
+                continue
+            if mod.allowed(RULE_FINALLY_RAISE, node.lineno, scopes):
+                continue
+            out.append(
+                Finding(
+                    mod.path,
+                    node.lineno,
+                    RULE_FINALLY_RAISE,
+                    f"{'.'.join(parts)}(...) in a finally: block can raise "
+                    "(closing broken handles raises OSError) and would "
+                    "REPLACE the in-flight exception that triggered this "
+                    "cleanup — wrap it in contextlib.suppress(OSError) or "
+                    "a local try/except",
+                )
+            )
+
+
+def _kw_is_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return _const_true(kw.value)
+    return False
+
+
+def _const_true(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def _build_facts(sources: "list[tuple[str, str]]") -> "tuple[_Facts, list]":
+    """Parse + index every (source, path); returns facts and parse
+    findings.  Each module is indexed twice: asynccheck's table for call
+    resolution, leakcheck's own AST walk for lifetime structure."""
+    facts = _Facts()
+    findings: list = []
+    trees: list = []
+    for source, path in sources:
+        mod = index_source(source, path)
+        if isinstance(mod, Finding):
+            findings.append(mod)
+            continue
+        facts.index[mod.name] = mod
+        tree = ast.parse(source, filename=path)
+        trees.append((tree, mod))
+        fi_by_site = {(f.path, f.lineno): f for f in mod.funcs}
+
+        def collect(node, class_name, scopes, mod=mod, fi_by_site=fi_by_site):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    key = (mod.path, child.name)
+                    facts.classes.setdefault(key, _ClassFacts())
+                    collect(child, child.name, scopes)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fn = _FnFacts(
+                        child,
+                        mod,
+                        fi_by_site.get((mod.path, child.lineno)),
+                        class_name,
+                        scopes + [child.lineno],
+                    )
+                    _collect_fn_facts(fn)
+                    facts.fns[(mod.path, child.lineno)] = fn
+                    if class_name is not None and not scopes:
+                        cls = facts.classes.setdefault(
+                            (mod.path, class_name), _ClassFacts()
+                        )
+                        attrs: set = set()
+                        tails: set = set()
+                        for sub in ast.walk(child):
+                            if (
+                                isinstance(sub, ast.Attribute)
+                                and isinstance(sub.value, ast.Name)
+                                and sub.value.id == "self"
+                            ):
+                                attrs.add(sub.attr)
+                            if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Attribute
+                            ):
+                                tails.add(sub.func.attr)
+                        cls.methods.append((attrs, tails))
+                    collect(child, class_name, scopes + [child.lineno])
+                else:
+                    collect(child, class_name, scopes)
+
+        collect(tree, None, [])
+    _fixpoint(facts)
+    # rule passes need the fixpoint done first
+    for tree, mod in trees:
+        _check_finally(tree, mod, findings)
+    for fn in facts.fns.values():
+        analysis = _FnAnalysis(fn, facts, findings)
+        analysis.check_resources()
+        analysis.check_threads()
+        analysis.check_tasks()
+    return facts, findings
+
+
+def check_source(source: str, path: str = "<string>") -> "list[Finding]":
+    """Single-source entry point (unit tests)."""
+    _facts, findings = _build_facts([(source, path)])
+    return sorted(findings)
+
+
+def check_paths(paths: "list[str]") -> "list[Finding]":
+    sources: list = []
+    findings: list = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources.append((f.read(), path))
+        except OSError as e:
+            findings.append(Finding(path, 1, "io", f"cannot read: {e}"))
+    _facts, batch = _build_facts(sources)
+    findings.extend(batch)
+    return sorted(findings)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--rules" in argv:
+        for rule in ALL_RULES:
+            print(f"{rule}: {RULE_DOCS[rule]}")
+        return 0
+    paths, err = resolve_cli_paths(argv, "leakcheck")
+    if paths is None:
+        return err
+    findings = check_paths(paths)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(
+            f"leakcheck: {len(findings)} finding"
+            f"{'s' if len(findings) != 1 else ''} "
+            f"across {len(set(f.path for f in findings))} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("leakcheck: clean")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Runtime resource census
+# ---------------------------------------------------------------------------
+
+_PATCH_LOCK = threading.Lock()
+#: immutable snapshot, REPLACED (never mutated) under _PATCH_LOCK so the
+#: creation wrappers can read it lock-free from any thread (racecheck's
+#: install model)
+_ACTIVE: "tuple[ResourceCensus, ...]" = ()
+_ORIG: dict = {}
+
+#: process-lifetime maxima behind the ``high_water`` census key — every
+#: role's /healthz and /api/timings read the same counters, so the chaos
+#: drills can compare pre/post-storm steady states per process
+_HIGH_WATER = {"fds": 0, "threads": 0, "tasks": 0}
+
+#: frames from these files are machinery, not the creation site
+_INTERNAL_FILES = (
+    "leakcheck.py",
+    "socket.py",
+    "ssl.py",
+    "threading.py",
+    "tasks.py",
+    "base_events.py",
+    "selector_events.py",
+    "unix_events.py",
+    "streams.py",
+)
+
+#: worker-pool threads are reclaimed by their executor's atexit join —
+#: an idle pool worker outliving a test window is by design, not a leak
+_POOL_THREAD_PREFIXES = ("ThreadPoolExecutor", "asyncio_")
+
+
+def raw_counts() -> dict:
+    """Point-in-time ``{fds, threads, tasks}`` for THIS process."""
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:  # non-procfs platform: census still counts the rest
+        fds = -1
+    threads = threading.active_count()
+    try:
+        import asyncio
+
+        tasks = len(asyncio.all_tasks())
+    except RuntimeError:  # no running loop on this thread
+        tasks = 0
+    return {"fds": fds, "threads": threads, "tasks": tasks}
+
+
+def process_census() -> dict:
+    """The census document every role surfaces on /api/timings and
+    /healthz: current counts plus process-lifetime high-water marks."""
+    counts = raw_counts()
+    for key, value in counts.items():
+        if value > _HIGH_WATER[key]:
+            _HIGH_WATER[key] = value
+    counts["high_water"] = dict(_HIGH_WATER)
+    return counts
+
+
+async def warm_default_executor() -> None:
+    """Spawn the running loop's default executor to its full thread
+    complement.  Executor threads are created lazily and never exit, so
+    a process that takes its first census before its first burst of
+    executor work reports the burst's warmup as thread growth forever
+    after.  Serving processes call this at startup: the thread footprint
+    becomes deterministic, and census comparisons (chaos drills, the
+    fd-growth runbook in docs/OPERATIONS.md) compare steady state
+    against steady state instead of cold start against warm."""
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, lambda: None)  # create the pool
+    pool = getattr(loop, "_default_executor", None)
+    n = getattr(pool, "_max_workers", 0) or 0
+    if not n:
+        return
+    gate = threading.Event()
+    # every task blocks, so each submit finds no idle worker and
+    # ThreadPoolExecutor spawns a new thread, up to max_workers
+    futures = [loop.run_in_executor(None, gate.wait, 10.0) for _ in range(n)]
+    gate.set()
+    await asyncio.gather(*futures)
+
+
+def _creation_site(limit: int = 5) -> str:
+    """Nearest non-internal frames at a creation, cheap (no source
+    reads): ``file:line in func <- caller:line in func …``."""
+    frame = sys._getframe(2)
+    parts: list = []
+    while frame is not None and len(parts) < limit:
+        fn = frame.f_code.co_filename
+        # exact-basename match: a suffix test would also hide user files
+        # that merely END with an internal name (tests/test_leakcheck.py
+        # ends with leakcheck.py) and misattribute their creations
+        if os.path.basename(fn) not in _INTERNAL_FILES:
+            parts.append(
+                f"{fn}:{frame.f_lineno} in {frame.f_code.co_name}"
+            )
+        frame = frame.f_back
+    return " <- ".join(parts) if parts else "<unknown>"
+
+
+def _note(kind: str, obj) -> None:
+    active = _ACTIVE
+    if not active:
+        return
+    try:
+        ref = weakref.ref(obj)
+    except TypeError:
+        return
+    site = _creation_site()
+    for census in active:
+        census._record(kind, ref, site)
+
+
+def _patched_socket_init(self, *args, **kwargs):
+    _ORIG["socket_init"](self, *args, **kwargs)
+    _note("socket", self)
+
+
+def _patched_open(*args, **kwargs):
+    handle = _ORIG["open"](*args, **kwargs)
+    _note("file", handle)
+    return handle
+
+
+def _patched_thread_start(self):
+    _note("thread", self)
+    return _ORIG["thread_start"](self)
+
+
+def _patched_create_task(self, coro, **kwargs):
+    task = _ORIG["create_task"](self, coro, **kwargs)
+    _note("task", task)
+    return task
+
+
+def _patch() -> None:
+    import builtins
+    import socket as socket_mod
+    from asyncio import base_events
+
+    _ORIG["socket_init"] = socket_mod.socket.__init__
+    _ORIG["open"] = builtins.open
+    _ORIG["thread_start"] = threading.Thread.start
+    _ORIG["create_task"] = base_events.BaseEventLoop.create_task
+    socket_mod.socket.__init__ = _patched_socket_init
+    builtins.open = _patched_open
+    threading.Thread.start = _patched_thread_start
+    base_events.BaseEventLoop.create_task = _patched_create_task
+
+
+def _unpatch() -> None:
+    import builtins
+    import socket as socket_mod
+    from asyncio import base_events
+
+    socket_mod.socket.__init__ = _ORIG["socket_init"]
+    builtins.open = _ORIG["open"]
+    threading.Thread.start = _ORIG["thread_start"]
+    base_events.BaseEventLoop.create_task = _ORIG["create_task"]
+
+
+class ResourceCensus:
+    """Runtime FD/thread/task leak sanitizer (see module docstring).
+
+    Install/uninstall mirror :class:`~tpudash.analysis.racecheck.RaceCheck`:
+    a refcounted process-wide patch window; every socket/file/thread/task
+    created inside the window is recorded with its creation site, and
+    :meth:`assert_clean` fails if any of them is still alive once the
+    window's work should have wound down — naming the site, which is the
+    difference between "fds grew" and a fixable bug report."""
+
+    def __init__(self, grace: float = 2.0):
+        #: seconds assert_clean waits for in-flight teardown (loop
+        #: close, thread joins, GC of just-dropped handles) to finish
+        self.grace = grace
+        self.baseline: "dict | None" = None
+        self._entries: list = []  # (kind, weakref, site)
+        self._lock = threading.Lock()
+        self._installed = False
+
+    # -- install / uninstall -------------------------------------------------
+    def install(self) -> "ResourceCensus":
+        global _ACTIVE
+        if self._installed:
+            return self
+        with _PATCH_LOCK:
+            if not _ACTIVE:
+                _patch()
+            _ACTIVE = (*_ACTIVE, self)
+        self._installed = True
+        self.baseline = raw_counts()
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if not self._installed:
+            return
+        with _PATCH_LOCK:
+            _ACTIVE = tuple(c for c in _ACTIVE if c is not self)
+            if not _ACTIVE:
+                _unpatch()
+        self._installed = False
+
+    def __enter__(self) -> "ResourceCensus":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- recording (creation wrappers, any thread) ---------------------------
+    def _record(self, kind: str, ref, site: str) -> None:
+        with self._lock:
+            self._entries.append((kind, ref, site))
+
+    # -- reporting ------------------------------------------------------------
+    def _alive(self, kind: str, obj) -> bool:
+        if obj is None:
+            return False
+        if kind == "socket":
+            try:
+                return obj.fileno() >= 0
+            except OSError:
+                return False
+        if kind == "file":
+            return not getattr(obj, "closed", True)
+        if kind == "thread":
+            if obj.name.startswith(_POOL_THREAD_PREFIXES):
+                return False
+            return obj.is_alive()
+        if kind == "task":
+            if obj.done():
+                return False
+            try:
+                return not obj.get_loop().is_closed()
+            except RuntimeError:
+                return False
+        return False
+
+    def leaked(self) -> "list[dict]":
+        """Tracked resources created in the window and still alive —
+        each with the creation site that made it."""
+        out: list = []
+        with self._lock:
+            entries = list(self._entries)
+        for kind, ref, site in entries:
+            obj = ref()
+            if self._alive(kind, obj):
+                out.append({"kind": kind, "site": site, "obj": repr(obj)})
+        return out
+
+    def snapshot(self) -> dict:
+        """Census + growth vs the install-time baseline + live tracked
+        counts, for drills and debugging."""
+        counts = process_census()
+        base = self.baseline or counts
+        counts["delta"] = {
+            k: counts[k] - base[k] for k in ("fds", "threads", "tasks")
+        }
+        tracked: dict = {}
+        for entry in self.leaked():
+            tracked[entry["kind"]] = tracked.get(entry["kind"], 0) + 1
+        counts["tracked_live"] = tracked
+        return counts
+
+    def assert_clean(self) -> None:
+        """Raise AssertionError naming every leaked resource and its
+        creation site.  Retries under ``grace`` first: loop shutdown,
+        thread joins, and GC of just-dropped handles are legitimate
+        in-flight teardown, not leaks."""
+        deadline = time.monotonic() + max(self.grace, 0.0)
+        while True:
+            gc.collect()
+            bad = self.leaked()
+            if not bad:
+                return
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        counts = self.snapshot()
+        lines = [
+            f"fdcheck: {len(bad)} resource(s) created in this window are "
+            f"still alive (census {counts['fds']} fds / "
+            f"{counts['threads']} threads / {counts['tasks']} tasks, "
+            f"delta {counts['delta']}):"
+        ]
+        for entry in bad[:10]:
+            lines.append(f"  leaked {entry['kind']}: {entry['obj']}")
+            lines.append(f"    created at {entry['site']}")
+        if len(bad) > 10:
+            lines.append(f"  … and {len(bad) - 10} more")
+        raise AssertionError("\n".join(lines))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -47,6 +47,7 @@ from aiohttp import web
 log = logging.getLogger(__name__)
 
 from tpudash.analysis.asynccheck import LoopLagMonitor
+from tpudash.analysis.leakcheck import process_census, warm_default_executor
 from tpudash.app.assets import find_plotly_asset
 from tpudash.app.html import PLOTLY_LOCAL_URL, page_html
 from tpudash.app.overload import OverloadGuard, bound_stream_buffers
@@ -1177,6 +1178,7 @@ class DashboardServer:
         summary = self.service.timer.summary()
         summary["overload"] = self.overload.snapshot()
         summary["loop_lag_ms"] = self.loop_monitor.summary()
+        summary["census"] = process_census()
         # native-tier honesty: a deployment silently parsing in Python
         # (failed build/dlopen) must say so here, with the reason
         from tpudash import native as _native
@@ -2155,6 +2157,7 @@ class DashboardServer:
                "error": self.service.last_error,
                "overload": overload,
                "loop_lag_ms": self.loop_monitor.summary(),
+               "census": process_census(),
                "source_health": health}
         if isinstance(health, dict) and health.get("federation"):
             # fleet parents surface per-child liveness top-level too —
@@ -2467,6 +2470,14 @@ class DashboardServer:
         app = web.Application(
             middlewares=[self._auth, self._admission, self._compress]
         )
+
+        # deterministic thread footprint from the first request on: the
+        # default executor's threads otherwise spawn lazily under load
+        # and surface as census "growth" that is really cold start
+        async def _warm_executor(app):
+            await warm_default_executor()
+
+        app.on_startup.append(_warm_executor)
         if self.service.cfg.loop_lag_budget > 0:
             # loop-lag sanitizer for the app's lifetime: callback timing
             # + stack attribution (install) and the heartbeat that feeds

@@ -752,9 +752,13 @@ class BusPublisher:
             sock = socketmod.socket(
                 socketmod.AF_UNIX, socketmod.SOCK_STREAM
             )
-            sock.bind(self.path)
-            sock.listen(128)
-            sock.setblocking(False)
+            try:
+                sock.bind(self.path)
+                sock.listen(128)
+                sock.setblocking(False)
+            except OSError:
+                sock.close()
+                raise
             self._sock = sock
             self._track(self._accept_loop())
         if self.listen:
@@ -1009,6 +1013,18 @@ class BusPublisher:
         if conn in self._conns:
             self._conns.remove(conn)
             self.counters["worker_disconnects"] += 1
+        # release the backlog NOW, not when the drain task gets around
+        # to failing: a cut edge's queue can hold a full window
+        # snapshot plus its live backlog, and the reconnect that
+        # follows enqueues a fresh snapshot immediately — holding both
+        # doubles peak memory per cut/reconnect cycle.  Sync method on
+        # the loop: the drain task cannot interleave with this sweep.
+        while True:
+            try:
+                conn.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        conn.sent_tpls.clear()
         conn.queue.put_nowait(None)  # unblock the drain task
         transport = conn.writer.transport
         if transport is not None:
@@ -1474,27 +1490,36 @@ class BusMirror:
             with contextlib.suppress(OSError):
                 sock.close()
             raise
-        if self.ring is not None:
-            self.ring.close()
-            self.ring = None
-        if mode == 1:
-            if fd is None:
-                raise BusProtocolError(
-                    "ring-mode preamble arrived without a descriptor "
-                    "(SCM_RIGHTS lost)"
-                )
-            try:
-                self.ring = SealRing.attach(fd, size)
-            except RingUnavailable as e:
-                # same-host mmap of a passed fd failing is not a mode
-                # this worker can silently downgrade out of — the
-                # publisher will send descriptors it cannot resolve.
-                # Fail the session loudly; the reconnect loop retries.
-                raise BusProtocolError(f"cannot attach seal ring: {e}") from e
-        elif fd is not None:
+        try:
+            if self.ring is not None:
+                self.ring.close()
+                self.ring = None
+            if mode == 1:
+                if fd is None:
+                    raise BusProtocolError(
+                        "ring-mode preamble arrived without a descriptor "
+                        "(SCM_RIGHTS lost)"
+                    )
+                try:
+                    self.ring = SealRing.attach(fd, size)
+                except RingUnavailable as e:
+                    # same-host mmap of a passed fd failing is not a mode
+                    # this worker can silently downgrade out of — the
+                    # publisher will send descriptors it cannot resolve.
+                    # Fail the session loudly; the reconnect loop retries.
+                    raise BusProtocolError(
+                        f"cannot attach seal ring: {e}"
+                    ) from e
+            elif fd is not None:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
+            return await asyncio.open_unix_connection(sock=sock)
+        except (OSError, BusProtocolError, asyncio.CancelledError):
+            # attach/open failure after the preamble: the session never
+            # starts, so nothing downstream will close this socket
             with contextlib.suppress(OSError):
-                os.close(fd)
-        return await asyncio.open_unix_connection(sock=sock)
+                sock.close()
+            raise
 
     async def _open_net(
         self,

@@ -82,14 +82,20 @@ class EdgeNode(FanoutWorker):
         )
 
     def _make_connector(self):
+        # force_close for the same reason as the worker's unix
+        # connector: rotation under steady probe/proxy traffic keeps a
+        # burst's whole connection high-water alive forever.  The edge's
+        # hot path (frames, streams) rides the bus mirror, not this
+        # session — a TCP/TLS reconnect per proxied request is the slow
+        # path paying for a leak-free steady state.
         ctx = None
         if self.cfg.edge_origin.startswith("https"):
             ctx = client_ssl_context(
                 self.cfg.bus_tls_ca, self.cfg.bus_tls_cert, self.cfg.bus_tls_key
             )
         if ctx is not None:
-            return TCPConnector(ssl=ctx)
-        return TCPConnector()
+            return TCPConnector(ssl=ctx, force_close=True)
+        return TCPConnector(force_close=True)
 
     def worker_doc(self) -> dict:
         doc = super().worker_doc()

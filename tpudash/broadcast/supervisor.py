@@ -126,8 +126,10 @@ def preflight(cfg: Config, socket_mod=socketmod) -> str:
                 "TPUDASH_WORKERS=0 or fix the platform."
             ) from e
         finally:
-            s1.close()
-            s2.close()
+            with contextlib.suppress(OSError):
+                s1.close()
+            with contextlib.suppress(OSError):
+                s2.close()
     bus_dir = cfg.broadcast_bus or tempfile.mkdtemp(prefix="tpudash-bus-")
     try:
         os.makedirs(bus_dir, mode=0o700, exist_ok=True)
@@ -492,7 +494,8 @@ class Supervisor:
                 )
             finally:
                 if log_fd is not None:
-                    log_fd.close()  # the child holds its own duplicate
+                    with contextlib.suppress(OSError):
+                        log_fd.close()  # the child holds its own duplicate
             self._workers[index] = proc
             info.pid = proc.pid
             started = time.monotonic()
@@ -670,7 +673,8 @@ class TierSupervisor:
                 )
             finally:
                 if log_fd is not None:
-                    log_fd.close()  # the child holds its own duplicate
+                    with contextlib.suppress(OSError):
+                        log_fd.close()  # the child holds its own duplicate
             self._children[name] = proc
             info.pid = proc.pid
             self._write_status()

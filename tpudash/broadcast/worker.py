@@ -36,6 +36,7 @@ import time
 from aiohttp import ClientSession, ClientTimeout, UnixConnector, web
 
 from tpudash.analysis.asynccheck import LoopLagMonitor
+from tpudash.analysis.leakcheck import process_census, warm_default_executor
 from tpudash.app.overload import OverloadGuard, bound_stream_buffers
 from tpudash.app.server import (
     _CLIENT_GONE,
@@ -185,8 +186,18 @@ class FanoutWorker:
     def _make_connector(self):
         """Connector factory for the internal API session (unix socket
         to the same-host compose; the edge subclass returns a TCP
-        connector for the remote origin)."""
-        return UnixConnector(path=os.path.join(self.bus_dir, API_SOCK))
+        connector for the remote origin).
+
+        force_close: the pool must hold ZERO idle connections.  aiohttp
+        rotates pooled connections under steady traffic (healthz probes,
+        proxied requests), so no pooled connection ever sits idle long
+        enough for keepalive_timeout to reap it — a client-storm's
+        concurrency high-water would stay open as live fds forever.  A
+        same-host unix connect costs microseconds; the retained-fd class
+        costs the census its zero-growth invariant."""
+        return UnixConnector(
+            path=os.path.join(self.bus_dir, API_SOCK), force_close=True
+        )
 
     def _internal_headers(self) -> dict:
         """Extra headers for worker→compose internal calls.  Same-host
@@ -664,6 +675,7 @@ class FanoutWorker:
             "streams": self.overload.streams,
             "compose_down": self.compose_down,
             "loop_lag_ms": self.loop_monitor.summary(),
+            "census": process_census(),
             "bus": self.mirror.stats(),
             "counters": dict(self.overload.counters),
         }
@@ -727,6 +739,10 @@ class FanoutWorker:
         app = web.Application()
 
         async def _start(app):
+            # deterministic thread footprint before the first census
+            # probe — lazy executor spawn under storm traffic would
+            # otherwise read as thread growth
+            await warm_default_executor()
             if self.cfg.loop_lag_budget > 0:
                 self.loop_monitor.install()
                 self._tasks.append(
@@ -766,9 +782,13 @@ def reuseport_socket(host: str, port: int) -> socket.socket:
     """The worker tier's listening socket: SO_REUSEPORT so N processes
     share one public port and the kernel load-balances accepts."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-    sock.bind((host, port))
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        sock.bind((host, port))
+    except OSError:
+        sock.close()
+        raise
     return sock
 
 

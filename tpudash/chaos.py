@@ -152,6 +152,7 @@ import tempfile
 import time
 
 from tpudash import schema
+from tpudash.analysis.leakcheck import process_census, warm_default_executor
 from tpudash.config import Config, configure_logging, env_is_set, load_config
 
 log = logging.getLogger(__name__)
@@ -313,10 +314,11 @@ async def _stalled_stream(host: str, port: int, sid: str, stop: asyncio.Event):
     except (OSError, asyncio.TimeoutError):
         pass  # the server evicting us closes the pipe — expected
     finally:
-        if writer is not None:
-            writer.close()
-        else:
-            sock.close()
+        with contextlib.suppress(OSError):
+            if writer is not None:
+                writer.close()
+            else:
+                sock.close()
 
 
 async def run_overload_drill(
@@ -571,6 +573,87 @@ def _raise_fd_limit(want: int = 65536) -> None:
         resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
 
 
+# ---------------------------------------------------------------------------
+# Resource-census assertions (leakcheck's runtime half): every drill
+# captures {fds, threads} per process at a pre-storm steady state and
+# asserts the post-storm steady state is back at (or under) it in every
+# SURVIVING process — a tier that gains descriptors per storm is a slow
+# outage at fleet scale.  Processes killed by the drill (new pid, or
+# gone) have no pre baseline and are excluded by construction.
+# ---------------------------------------------------------------------------
+
+
+def _census_fingerprint(census) -> "dict | None":
+    """{'fds','threads'} from a /healthz or worker-doc ``census`` entry
+    (see tpudash.analysis.leakcheck.process_census); None if absent or
+    the fd count was unreadable (-1)."""
+    if not isinstance(census, dict):
+        return None
+    fds, threads = census.get("fds"), census.get("threads")
+    if not isinstance(fds, int) or not isinstance(threads, int) or fds < 0:
+        return None
+    return {"fds": fds, "threads": threads}
+
+
+def _census_growth(pre: dict, post: dict) -> dict:
+    """Positive fd/thread growth between two fingerprints ({} = clean)."""
+    return {
+        k: post[k] - pre[k]
+        for k in ("fds", "threads")
+        if post.get(k, 0) > pre.get(k, 0)
+    }
+
+
+async def _assert_no_census_growth(
+    pre: "dict[str, dict]",
+    probe,
+    failures: "list[str]",
+    numbers: dict,
+    deadline_s: float = 25.0,
+) -> None:
+    """Settle-poll ``probe()`` (async → {name: census doc}) until every
+    process observed in BOTH steady states shows zero net fd/thread
+    growth, or the deadline passes — then record the verdict.  The poll
+    matters: evicted consumers, executor threads, and half-closed
+    sockets drain over a few seconds after the load stops; the
+    invariant is the *steady state*, not the instant the storm ends."""
+    end = time.monotonic() + deadline_s
+    post: "dict[str, dict]" = {}
+    growth: "dict[str, dict]" = {}
+    while True:
+        latest = await probe()
+        for name, census in (latest or {}).items():
+            fp = _census_fingerprint(census)
+            if fp is not None:
+                post[name] = fp
+        growth = {}
+        for name, fp in pre.items():
+            if name in post:
+                g = _census_growth(fp, post[name])
+                if g:
+                    growth[name] = g
+        if not growth or time.monotonic() >= end:
+            break
+        await asyncio.sleep(0.5)
+    survivors = sorted(set(pre) & set(post))
+    numbers["census"] = {
+        "pre": pre,
+        "post": post,
+        "growth": growth,
+        "survivors_checked": survivors,
+    }
+    if not survivors:
+        failures.append(
+            "census: no surviving process observed in both pre- and "
+            "post-storm steady states"
+        )
+    for name, g in sorted(growth.items()):
+        failures.append(
+            f"census: {name} grew {g} between pre- and post-storm "
+            "steady states (fd/thread leak)"
+        )
+
+
 #: the storm drill's ``/healthz`` prober, run as a SEPARATE PROCESS
 #: (``python -c``): the drill process itself runs ~1000 client tasks, so
 #: any in-process probe — coroutine or thread (GIL) — measures the
@@ -626,10 +709,11 @@ def make_storm_server(cfg: "Config | None", workers: int):
     # an ephemeral public port for the SO_REUSEPORT worker sockets (bind
     # 0 to learn a free one; the tiny close-to-rebind race is acceptable
     # in a drill) and a private short-path bus dir
-    probe = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
-    probe.bind(("127.0.0.1", 0))
-    port = probe.getsockname()[1]
-    probe.close()
+    with socketmod.socket(
+        socketmod.AF_INET, socketmod.SOCK_STREAM
+    ) as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
     cfg = dataclasses.replace(
         cfg,
         workers=workers,
@@ -877,7 +961,30 @@ async def run_storm_drill(
             await asyncio.sleep(0.25)
         return False
 
+    async def tier_censuses() -> dict:
+        """{name: census} for the compose process (in-process) and every
+        worker pid reachable through the shared port (fresh connection
+        per probe so SO_REUSEPORT hashes across pids)."""
+        out: dict = {"compose": process_census()}
+        async with ClientSession(
+            connector=TCPConnector(force_close=True),
+            timeout=ClientTimeout(total=2.0),
+        ) as s:
+            for _ in range(20 * workers):
+                if len(out) >= workers + 1:
+                    break
+                try:
+                    async with s.get(f"{base}/healthz") as r:
+                        doc = await r.json(content_type=None)
+                except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+                    continue
+                wdoc = (doc or {}).get("worker") or {}
+                if wdoc.get("pid") is not None:
+                    out[f"worker-{wdoc['pid']}"] = wdoc.get("census")
+        return out
+
     failures = []
+    census_numbers: dict = {}
     worker_docs: dict = {}
     shard_procs: list = []
     try:
@@ -887,6 +994,15 @@ async def run_storm_drill(
                 "connected to the bus within 60s"
             )
         else:
+            # pre-storm steady state: the census every surviving process
+            # must be back at once the storm drains (leakcheck runtime)
+            await warm_default_executor()
+            pre_census = {
+                name: fp
+                for name, c in (await tier_censuses()).items()
+                for fp in (_census_fingerprint(c),)
+                if fp is not None
+            }
             clients = max(8, clients)
             n_stalled = min(max(4, clients // 50), 32)
             n_streams = clients - n_stalled
@@ -1010,6 +1126,11 @@ async def run_storm_drill(
                     wdoc = doc.get("worker") or {}
                     if wdoc.get("pid") is not None:
                         worker_docs[str(wdoc["pid"])] = wdoc
+            # post-storm steady state: zero net fd/thread growth in the
+            # compose process and every surviving worker (settle-polled)
+            await _assert_no_census_growth(
+                pre_census, tier_censuses, failures, census_numbers
+            )
     finally:
         bus_stats = sup.publisher.stats() if sup.publisher else {}
         await sup.stop()
@@ -1144,6 +1265,7 @@ async def run_storm_drill(
         "compose_loop_lag_ms": server.loop_monitor.summary(),
         "supervisor_restarts": sup.restarts,
         "bus": bus_stats,
+        "census": census_numbers.get("census"),
     }
 
 
@@ -1232,10 +1354,11 @@ def make_killall_tier(cfg: "Config | None", workers: int):
         if not env_is_set(env_name):
             cfg = dataclasses.replace(cfg, **{field: value})
     work_dir = tempfile.mkdtemp(prefix="tpudash-killall-")
-    probe = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
-    probe.bind(("127.0.0.1", 0))
-    port = probe.getsockname()[1]
-    probe.close()
+    with socketmod.socket(
+        socketmod.AF_INET, socketmod.SOCK_STREAM
+    ) as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
     cfg = dataclasses.replace(
         cfg,
         source="synthetic",
@@ -1505,7 +1628,12 @@ async def run_killall_drill(
     loads complete sets and REFUSES torn ones), and verify follower
     catch-up through leader-side segment reclaim with bounded,
     measured replication lag."""
-    from aiohttp import ClientError, ClientSession, TCPConnector
+    from aiohttp import (
+        ClientError,
+        ClientSession,
+        ClientTimeout,
+        TCPConnector,
+    )
 
     from tpudash.broadcast.supervisor import (
         BroadcastSetupError,
@@ -1586,6 +1714,30 @@ async def run_killall_drill(
         except (OSError, ClientError, asyncio.TimeoutError, ValueError):
             return None
 
+    async def tier_censuses() -> dict:
+        """{name: census} per worker pid reachable through the shared
+        port (fresh connection per probe → SO_REUSEPORT scatters).  The
+        compose process is killed by design mid-drill, so only workers
+        — matched by pid — carry a pre/post baseline here."""
+        out: dict = {}
+        async with ClientSession(
+            connector=TCPConnector(force_close=True),
+            timeout=ClientTimeout(total=2.0),
+        ) as s:
+            for _ in range(20 * workers):
+                if len(out) >= workers:
+                    break
+                try:
+                    async with s.get(f"{base}/healthz") as r:
+                        doc = await r.json(content_type=None)
+                except (OSError, ClientError, asyncio.TimeoutError, ValueError):
+                    continue
+                wdoc = (doc or {}).get("worker") or {}
+                if wdoc.get("pid") is not None:
+                    out[f"worker-{wdoc['pid']}"] = wdoc.get("census")
+        return out
+
+    pre_census: "dict[str, dict]" = {}
     tasks: "list[asyncio.Task]" = []
     try:
         async with ClientSession(connector=TCPConnector(limit=0)) as session:
@@ -1605,6 +1757,16 @@ async def run_killall_drill(
             if not ready:
                 failures.append("tier never became ready (90s)")
                 raise _DrillAbort()
+            # pre-storm steady state: the census every worker that
+            # survives the kill sequence must be back at afterwards
+            pre_census.update(
+                {
+                    name: fp
+                    for name, c in (await tier_censuses()).items()
+                    for fp in (_census_fingerprint(c),)
+                    if fp is not None
+                }
+            )
 
             # -- phase 1: storm + resume probe --------------------------------
             tasks = [
@@ -1827,6 +1989,13 @@ async def run_killall_drill(
             await asyncio.wait(tasks, timeout=10)
             for t in tasks:
                 t.cancel()
+        if pre_census:
+            # post-storm steady state, with the client storm drained but
+            # the tier still up: zero net fd/thread growth in every
+            # worker that kept its pid through the kill sequence
+            await _assert_no_census_growth(
+                pre_census, tier_censuses, failures, numbers
+            )
         await sup.stop()
 
     # -- phase 5+6: snapshot kill + follower catch-up (separate stores) ------
@@ -1949,7 +2118,8 @@ class _ChildHarness:
             except (OSError, asyncio.CancelledError):
                 pass
             finally:
-                writer.close()
+                with contextlib.suppress(OSError):
+                    writer.close()
 
         self._raw_server = await asyncio.start_server(
             handler, "127.0.0.1", self.port, reuse_address=True
@@ -1970,7 +2140,8 @@ class _ChildHarness:
             except (OSError, asyncio.CancelledError):
                 pass
             finally:
-                writer.close()
+                with contextlib.suppress(OSError):
+                    writer.close()
 
         self._raw_server = await asyncio.start_server(
             handler, "127.0.0.1", self.port, reuse_address=True
@@ -1995,13 +2166,16 @@ def _free_ports(n: int) -> "list[int]":
     import socket as socketmod
 
     socks, ports = [], []
-    for _ in range(n):
-        s = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
+    try:
+        for _ in range(n):
+            s = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+            socks.append(s)
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            with contextlib.suppress(OSError):
+                s.close()
     return ports
 
 
@@ -2173,6 +2347,17 @@ async def run_partition_drill(
                 )
             if not hz or hz.get("ok") is not True:
                 failures.append("healthz ok flapped while healthy")
+            # pre-storm steady state: the whole fleet runs in THIS
+            # process (parent + child harnesses), so the census is the
+            # drill process's own — the partition/heal/flap sequence
+            # must hand every fd and thread back
+            await warm_default_executor()
+            pre_census = {
+                name: fp
+                for name, c in {"drill": process_census()}.items()
+                for fp in (_census_fingerprint(c),)
+                if fp is not None
+            }
 
             # -- phase 2: partition 3 of N children, three shapes -----------
             refuse, hang, drip, healthy = kids[0], kids[1], kids[2], kids[3]
@@ -2383,13 +2568,24 @@ async def run_partition_drill(
                     f"fleet SSE stream barely ticked: {stream_events['n']} "
                     "events through the whole drill"
                 )
+
+            # post-storm steady state: same topology as the phase-1
+            # baseline (everything healed, SSE ticker still live) —
+            # zero net fd/thread growth across partition/heal/flap
+            async def local_census() -> dict:
+                return {"drill": process_census()}
+
+            await _assert_no_census_growth(
+                pre_census, local_census, failures, numbers
+            )
         finally:
             stop.set()
             if tasks:
                 await asyncio.wait(tasks, timeout=10)
                 for t in tasks:
                     t.cancel()
-            await session.close()
+            with contextlib.suppress(OSError):
+                await session.close()
     except _DrillAbort:
         pass
     finally:
@@ -2851,7 +3047,8 @@ async def run_cascade_drill(
                 for i, v in sorted(peak_levels.items())
             }
         finally:
-            await session.close()
+            with contextlib.suppress(OSError):
+                await session.close()
     except _DrillAbort:
         pass
     finally:
@@ -3764,6 +3961,22 @@ async def run_edgestorm_drill(
         doc = await fetch_json(session, port, "/healthz")
         return ((doc or {}).get("worker") or {}).get("bus") or {}
 
+    async def edge_censuses(session) -> dict:
+        """{edge-i-pid: census} for every edge still answering /healthz.
+        Keyed by (index, pid) so an edge the drill SIGKILLs drops out of
+        the pre/post intersection instead of being compared against its
+        replacement; the compose process is killed by design too and
+        carries no baseline here."""
+        out: dict = {}
+        for i in range(edges):
+            doc = await fetch_json(session, edge_ports[i], "/healthz")
+            wdoc = (doc or {}).get("worker") or {}
+            if wdoc.get("pid") is not None:
+                out[f"edge-{i}-pid{wdoc['pid']}"] = wdoc.get("census")
+        return out
+
+    pre_census: "dict[str, dict]" = {}
+
     async def storm_client(session, i):
         """One viewer pinned to an edge, failing over to the NEXT edge
         on any connection loss with its last event id — the population
@@ -3869,6 +4082,16 @@ async def run_edgestorm_drill(
                     f"expected {edges}"
                 )
             numbers["boot_s"] = round(time.monotonic() - (deadline - 90.0), 1)
+            # pre-storm steady state: every edge's census, captured
+            # before the first client connects
+            pre_census.update(
+                {
+                    name: fp
+                    for name, c in (await edge_censuses(session)).items()
+                    for fp in (_census_fingerprint(c),)
+                    if fp is not None
+                }
+            )
 
             # -- phase 1: the storm ------------------------------------------
             tasks = [
@@ -4099,6 +4322,16 @@ async def run_edgestorm_drill(
             await asyncio.wait(tasks, timeout=10)
             for t in tasks:
                 t.cancel()
+        if pre_census:
+            # post-storm steady state, storm drained but edges still up:
+            # zero net fd/thread growth in every surviving edge
+            async with ClientSession() as census_session:
+                await _assert_no_census_growth(
+                    pre_census,
+                    functools.partial(edge_censuses, census_session),
+                    failures,
+                    numbers,
+                )
         for f in forwarders:
             with contextlib.suppress(OSError):
                 await f.close()

@@ -668,6 +668,9 @@ _EXTRA_ENV = {
     # test harness: enable the runtime event-loop lag sanitizer
     # (tpudash/analysis/asynccheck.py via tests/conftest.py)
     "TPUDASH_LOOPCHECK",
+    # test harness: enable the runtime FD/thread/task leak sanitizer
+    # (tpudash/analysis/leakcheck.py via tests/conftest.py)
+    "TPUDASH_FDCHECK",
     # worker-tier slot index, set by the broadcast supervisor for each
     # spawned fan-out worker process (tpudash/broadcast/worker.py)
     "TPUDASH_WORKER_INDEX",
