@@ -79,6 +79,13 @@ SYNTHESIZED_RULES = (
     # topology change, not a network chase
     "federation_cycle",
     "anomaly",
+    # cold archive tier (tpudash/tsdb/cold.py): a dark object store
+    # degrades range answers to the hot horizon (partial:true) and
+    # pauses segment reclaim; a quarantined (corrupt/digest-mismatched)
+    # bundle means archived history is missing until re-compaction
+    # heals it — the latter pages critical
+    "cold_unreachable",
+    "cold_corrupt",
 )
 
 

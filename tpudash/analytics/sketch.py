@@ -257,17 +257,24 @@ class QuantileSketch:
             return sk
         sk.count = float(count)
         sk.mn, sk.mx = float(mn), float(mx)
-        # one vectorized parse+validate pass — the federated merge path
-        # decodes hundreds of digests per query
-        arr = np.frombuffer(
-            raw, dtype="<f4", count=n * 2, offset=_HDR.size
-        ).reshape(n, 2).astype(np.float64)
-        means, weights = arr[:, 0], arr[:, 1]
-        if not np.isfinite(arr).all() or (weights <= 0).any():
-            raise SketchError("digest centroid not finite/positive")
-        if n > 1 and (np.diff(means) < 0).any():
-            raise SketchError("digest centroids not sorted")
-        sk.means, sk.weights = means.tolist(), weights.tolist()
+        # a digest holds at most ~budget/2 centroids (tens), where one
+        # struct unpack + python sweep beats four vectorized numpy
+        # passes — the 90-day cold path decodes ~13k digests per query
+        vals = struct.unpack_from(f"<{2 * n}f", raw, _HDR.size)
+        means = [0.0] * n
+        weights = [0.0] * n
+        prev = -math.inf
+        isfinite = math.isfinite
+        for i in range(n):
+            m, w = vals[2 * i], vals[2 * i + 1]
+            if not (isfinite(m) and isfinite(w)) or w <= 0.0:
+                raise SketchError("digest centroid not finite/positive")
+            if m < prev:
+                raise SketchError("digest centroids not sorted")
+            prev = m
+            means[i] = m
+            weights[i] = w
+        sk.means, sk.weights = means, weights
         return sk
 
     def __repr__(self) -> str:  # pragma: no cover — debugging aid

@@ -31,8 +31,10 @@ fire-latency and duration deltas) — the CLI
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
+import os
 
 import numpy as np
 
@@ -139,16 +141,50 @@ def run_capture(path: str, cfg) -> dict:
     return pipe.result()
 
 
-def frames_from_store(path: str, start_s=None, end_s=None, step_s: float = 60.0):
+def frames_from_store(path: str, start_s=None, end_s=None,
+                      step_s: float = 60.0, cfg=None):
     """Reconstruct per-step wide frames from a tsdb segment directory
     (read-only — safe against a live leader).  Yields ``(ts_s, df)``
-    ascending; identity columns derived from the series keys."""
+    ascending; identity columns derived from the series keys.
+
+    With ``cfg.cold_store`` set, the archive tier attaches read-only:
+    the replay transparently spans hot→cold, so an incident whose raw
+    AND rollup tiers fully expired locally still reproduces from
+    bundles (the whole point of keeping archives)."""
     import pandas as pd
 
     from tpudash.tsdb import FLEET_SERIES, TSDB
     from tpudash.tsdb.query import range_query
 
     store = TSDB(path=path, read_only=True)
+    cold = None
+    if cfg is not None and getattr(cfg, "cold_store", ""):
+        from tpudash.tsdb.cold import ColdTier
+        from tpudash.tsdb.objstore import open_store
+
+        cache_dir = cfg.cold_cache_dir or os.path.join(path, "cold-cache")
+        cold = ColdTier(
+            open_store(cfg.cold_store),
+            cache_dir=cache_dir,
+            cache_max_bytes=cfg.cold_cache_mb << 20,
+        )
+        store.attach_cold(cold)
+    try:
+        yield from _frames_from_open_store(
+            store, FLEET_SERIES, range_query, pd, start_s, end_s, step_s
+        )
+    finally:
+        # suppress: close() on a broken handle must not REPLACE the
+        # in-flight exception that got us here
+        with contextlib.suppress(OSError):
+            store.close()
+        if cold is not None:
+            with contextlib.suppress(OSError):
+                cold.close()
+
+
+def _frames_from_open_store(store, FLEET_SERIES, range_query, pd,
+                            start_s, end_s, step_s):
     keys = sorted(k for k in store.series_keys() if k != FLEET_SERIES)
     if not keys:
         return
@@ -197,9 +233,11 @@ def frames_from_store(path: str, start_s=None, end_s=None, step_s: float = 60.0)
 
 
 def run_tsdb(path: str, cfg, start_s=None, end_s=None, step_s: float = 60.0) -> dict:
-    """Replay a tsdb time range through the pipeline."""
+    """Replay a tsdb time range through the pipeline (hot + cold: the
+    cfg carries the archive-store spec, so fully-expired incidents
+    replay from bundles)."""
     pipe = ReplayPipeline(cfg)
-    for ts, df in frames_from_store(path, start_s, end_s, step_s):
+    for ts, df in frames_from_store(path, start_s, end_s, step_s, cfg=cfg):
         pipe.step(ts, df)
     return pipe.result()
 

@@ -1242,6 +1242,11 @@ class DashboardServer:
             rep = tsdb_stats["replication"]
             tier["replication_lag_s"] = rep.get("lag_s")
             tier["replication_caught_up"] = rep.get("caught_up")
+        if tsdb_stats and tsdb_stats.get("cold"):
+            c = tsdb_stats["cold"]
+            tier["cold_bundles"] = c.get("bundles")
+            tier["cold_unreachable"] = c.get("unreachable")
+            tier["cold_quarantined"] = c.get("quarantined")
         return tier
 
     async def profile(self, request: web.Request) -> web.Response:
@@ -2189,6 +2194,23 @@ class DashboardServer:
         rep = getattr(self.service.tsdb, "replication", None)
         if rep is not None:
             doc["replication"] = rep
+        # cold archive tier: plain attribute reads only (same lock-free
+        # contract).  A dark store degrades STATUS — range answers are
+        # partial — but ``ok`` stays True: the process is alive and a
+        # restart fixes nothing about an unreachable object store
+        cold = getattr(self.service, "cold", None)
+        if cold is not None:
+            doc["cold"] = {
+                "unreachable": cold.unreachable,
+                "last_error": cold.last_error,
+                "quarantined": cold.quarantined_count,
+            }
+            if cold.unreachable:
+                doc["status"] = status = (
+                    "cold_unreachable"
+                    if status == "healthy"
+                    else f"{status}+cold_unreachable"
+                )
         return _json_response(doc)
 
     async def workers_api(self, request: web.Request) -> web.Response:

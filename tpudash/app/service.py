@@ -223,6 +223,41 @@ class DashboardService:
         #: even when the store's volume is gone.
         from tpudash.tsdb import TSDB
 
+        #: cold archive tier (tpudash.tsdb.cold): sealed segments fold
+        #: into digest-verified object-store bundles off the seal thread;
+        #: /api/range, sketch quantiles, and anomaly replay span hot→cold
+        #: transparently, a dark store degrades answers to the hot
+        #: horizon with ``partial: true`` + a cold_unreachable alert, and
+        #: segment reclaim refuses to retire anything unverified.  Built
+        #: BEFORE the store so the load-time retention pass already sees
+        #: the reclaim gate: segments that expired while the process was
+        #: down must not be retired before the catalog can vouch for them.
+        self.cold = None
+        self.compactor = None
+        if cfg.cold_store:
+            try:
+                from tpudash.tsdb.cold import ColdTier
+                from tpudash.tsdb.objstore import open_store
+
+                source_dir = cfg.tsdb_follow or cfg.tsdb_path
+                cache_dir = cfg.cold_cache_dir or (
+                    os.path.join(source_dir, "cold-cache")
+                    if source_dir
+                    else ""
+                )
+                if not cache_dir:
+                    raise ValueError(
+                        "cold tier needs TPUDASH_COLD_CACHE_DIR when "
+                        "the tsdb is memory-only"
+                    )
+                self.cold = ColdTier(
+                    open_store(cfg.cold_store),
+                    cache_dir=cache_dir,
+                    cache_max_bytes=cfg.cold_cache_mb << 20,
+                )
+            except Exception as e:  # noqa: BLE001 — archive tier is best-effort
+                log.warning("cold tier unavailable: %s", e)
+                self.cold = None
         try:
             if cfg.tsdb_follow:
                 # follower (hot-standby) mode: tail another instance's
@@ -237,13 +272,44 @@ class DashboardService:
                         " — a follower never writes segments of its own"
                     )
                 follower = FollowerTSDB.from_config(cfg)
+                if self.cold is not None:
+                    # a follower never reclaims (read-only by contract),
+                    # so post-construction attach carries no race
+                    follower.attach_cold(self.cold)
                 follower.start()
                 self.tsdb: "TSDB | None" = follower
             else:
-                self.tsdb = TSDB.from_config(cfg)
+                self.tsdb = TSDB.from_config(cfg, cold=self.cold)
         except Exception as e:  # noqa: BLE001 — history tier is best-effort
             log.warning("tsdb unavailable: %s", e)
             self.tsdb = None
+        if self.cold is not None and self.tsdb is None:
+            self.cold.close()
+            self.cold = None
+        if self.cold is not None:
+            try:
+                from tpudash.tsdb.compact import Compactor
+
+                source_dir = cfg.tsdb_follow or cfg.tsdb_path
+                # the compactor runs on leaders AND followers (reading
+                # sealed segment files is role-agnostic; deterministic
+                # bundle names + digest verify make concurrent sweeps
+                # idempotent) — TPUDASH_COLD_COMPACT=false pins an
+                # instance read-only for running compaction off the
+                # serving leader
+                if cfg.cold_compact and cfg.cold_interval > 0 and source_dir:
+                    self.compactor = Compactor(
+                        source_dir=source_dir,
+                        cold=self.cold,
+                        interval_s=cfg.cold_interval,
+                        min_age_s=cfg.cold_min_age,
+                        max_bundle_bytes=cfg.cold_bundle_mb << 20,
+                        upload_deadline_s=cfg.cold_upload_deadline,
+                    )
+                    self.compactor.start()
+            except Exception as e:  # noqa: BLE001 — archive tier is best-effort
+                log.warning("cold compactor unavailable: %s", e)
+                self.compactor = None
         #: recording rules (tpudash.analytics.rules): derived series —
         #: fleet MFU, per-slice/per-host aggregates, the anomaly score —
         #: evaluated once per sealed chunk ON THE SEAL THREAD and
@@ -1076,7 +1142,20 @@ class DashboardService:
     def close_tsdb(self) -> None:
         """Graceful-shutdown seal: the not-yet-full head chunk compresses
         and (with a path) persists, so a clean restart loses nothing.  A
-        crash still loses only the head — by design.  Never raises."""
+        crash still loses only the head — by design.  Never raises.
+        Cold-tier shutdown rides along: the compactor thread joins (an
+        in-flight upload either completes its verify or becomes an
+        ignorable husk) and the store handle closes."""
+        if self.compactor is not None:
+            try:
+                self.compactor.close()
+            except Exception as e:  # noqa: BLE001 — shutdown must not fail
+                log.warning("compactor close failed: %s", e)
+        if self.cold is not None:
+            try:
+                self.cold.close()
+            except Exception as e:  # noqa: BLE001 — shutdown must not fail
+                log.warning("cold tier close failed: %s", e)
         if self.tsdb is None:
             return
         try:
@@ -1364,6 +1443,64 @@ class DashboardService:
                 overload=state,
             )
         ]
+
+    def _cold_alerts(self, now: float) -> "list[dict]":
+        """Synthesized cold-tier alerts (AlertEngine output shape, same
+        contract as ``endpoint_down``): ``cold_unreachable`` (warning)
+        while the object store is dark — range answers degrade to the
+        hot horizon flagged ``partial: true`` and segment reclaim is
+        paused, the dashboard itself is healthy — and ``cold_corrupt``
+        (critical) while quarantined bundles exist, because archived
+        history is silently missing until re-compaction heals them.
+        Runs on the refresh executor thread; status() is lock-cheap."""
+        cold = self.cold
+        if cold is None:
+            return []
+        from tpudash.alerts import synthesized_alert
+
+        try:
+            st = cold.status()
+        except Exception as e:  # noqa: BLE001 — observability is best-effort
+            log.warning("cold status failed: %s", e)
+            return []
+        out = []
+        if st["unreachable"]:
+            out.append(
+                synthesized_alert(
+                    rule="cold_unreachable",
+                    column="tsdb",
+                    severity="warning",
+                    chip="cold-store",
+                    value=1.0,
+                    threshold=0.0,
+                    firing=True,
+                    detail=(
+                        f"object store unreachable ({st['store']}): "
+                        f"{st['last_error']} — range answers degrade to "
+                        "the hot horizon (partial:true), segment reclaim "
+                        "paused until the store heals"
+                    ),
+                )
+            )
+        if st["quarantined"]:
+            out.append(
+                synthesized_alert(
+                    rule="cold_corrupt",
+                    column="tsdb",
+                    severity="critical",
+                    chip="cold-store",
+                    value=float(st["quarantined"]),
+                    threshold=0.0,
+                    firing=True,
+                    detail=(
+                        "quarantined archive bundle(s), never served: "
+                        + ", ".join(st["quarantined_keys"])
+                        + " — re-compaction heals them while sources "
+                        "exist (runbook: docs/OPERATIONS.md, cold tier)"
+                    ),
+                )
+            )
+        return out
 
     def _anomaly_alerts(self) -> "list[dict]":
         """The anomaly engine's current synthesized entries (rule
@@ -2094,6 +2231,7 @@ class DashboardService:
             synth = self._endpoint_alerts(now_w)
             synth += self._overload_alerts(now_w)
             synth += self._federation_alerts(now_w)
+            synth += self._cold_alerts(now_w)
             synth = self._synth_dwell.apply(synth)
             # anomaly state freezes across an error cycle (no table to
             # evaluate) — the last computed entries keep serving
@@ -2219,6 +2357,7 @@ class DashboardService:
                 synth = self._endpoint_alerts(now_w)
                 synth += self._overload_alerts(now_w)
                 synth += self._federation_alerts(now_w)
+                synth += self._cold_alerts(now_w)
                 synth = self._synth_dwell.apply(synth)
                 # anomaly entries carry their OWN dwell (the engine
                 # applies TPUDASH_ANOMALY_DWELL) — joined after the

@@ -131,9 +131,36 @@ spread across the edges, then breaks things mid-storm:
 
     python -m tpudash.chaos edgestorm --edges 16 --clients 256
 
+**The coldstorm drill** (``python -m tpudash.chaos coldstorm``): the
+cold archive tier (tpudash.tsdb.cold/compact/objstore) under every
+failure the object store can throw:
+
+- SIGKILL a store+compactor process mid-upload (twice): every object
+  left behind is a complete digest-verified bundle or an ignorable
+  husk, NO segment was reclaimed without a verified bundle naming it
+  as a source, and a cold reopen serves one contiguous hot→cold
+  timeline — zero duplicates, zero gaps;
+- torn uploads (injected fault): read-back verification catches the
+  tear, the compactor retries under its deadline and deletes what it
+  refused — the store converges to verified bundles with no husks;
+- a bit-rotted bundle (bytes flipped AFTER its upload verified): the
+  serving tier catches it at download, quarantines it with a
+  persistent marker and a ``cold_corrupt`` page naming the bundle,
+  and keeps serving the intact bundles — corrupt data is never served;
+- a DARK object store, through a real HTTP dashboard: ``/api/range``
+  degrades to the hot horizon with ``partial: true``, the
+  ``cold_unreachable`` alert pages, ``/healthz`` stays ``ok: true``
+  with a truthful status (a restart fixes nothing) — and restoring
+  the store heals all of it with no operator action;
+- a 90-day-old incident whose raw AND rollup tiers fully expired,
+  replayed through the real ``anomaly replay --tsdb`` CLI from the
+  archives alone.
+
+    python -m tpudash.chaos coldstorm --kills 2
+
 Exit status 0 = every invariant held; 1 = the printed JSON names what
-didn't.  CI runs the overload, storm, killall, partition, and
-edgestorm drills on every PR (chaos-soak job).
+didn't.  CI runs the overload, storm, killall, partition, edgestorm,
+and coldstorm drills on every PR (chaos-soak job).
 """
 
 from __future__ import annotations
@@ -3702,6 +3729,771 @@ async def run_incident_drill(chips: int = 64) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Coldstorm drill — the cold archive tier (tpudash.tsdb.cold / compact /
+# objstore) under SIGKILLs mid-compaction, torn uploads, a dark object
+# store, post-verify bit rot, and a 90-day replay whose hot tiers have
+# fully expired.
+# ---------------------------------------------------------------------------
+
+#: the coldstorm child: a live store with tiny segments, tiny retention,
+#: and an in-process compactor folding sealed segments into archive
+#: bundles at full speed.  Stamps are staged HOURS in the past so every
+#: frame is expired on arrival — segment reclaim is under pressure from
+#: frame 1 and must hold the verified-coverage gate while the parent's
+#: SIGKILL lands mid-upload (slow object-store ops make that likely).
+_COLDSTORM_CHILD = """
+import sys, time, numpy as np
+import tpudash.tsdb.store as storemod
+storemod._SEG_MAX_BYTES = 4000  # rotate constantly: compaction folds closed files
+from tpudash.tsdb import TSDB, FLEET_SERIES
+from tpudash.tsdb.cold import ColdTier
+from tpudash.tsdb.compact import Compactor
+from tpudash.tsdb.objstore import FaultPlan, FilesystemStore
+hot, obj, cache, t0 = sys.argv[1], sys.argv[2], sys.argv[3], float(sys.argv[4])
+faults = FaultPlan()
+faults.latency_s = 0.05  # slow object-store ops: the kill lands mid-transfer
+cold = ColdTier(FilesystemStore(obj, faults=faults), cache_dir=cache,
+                refresh_interval_s=0.2)
+# cold is passed INTO the constructor: the load-time retention pass must
+# already see the reclaim gate (expired-on-arrival segments, PR 18)
+store = TSDB(path=hot, chunk_points=8, retention_raw_s=45.0,
+             retention_1m_s=45.0, retention_10m_s=45.0, cold=cold)
+comp = Compactor(source_dir=hot, cold=cold, interval_s=0.3)
+comp.start()
+keys = [f"slice-0/{i}" for i in range(8)] + [FLEET_SERIES]
+cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+i = 0
+while True:
+    mat = np.full((len(keys), len(cols)), float(i % 97), dtype=np.float32)
+    store.append_frame(t0 + i * 1.0, keys, cols, mat)
+    store.flush()
+    i += 1
+"""
+
+_COLDSTORM_LONG_S = 90 * 86400.0
+
+
+def _coldstorm_verify_store(hot_dir: str, obj_dir: str) -> dict:
+    """Classify every uploaded object and prove the reclaim gate held:
+    each object is either a complete digest-verified bundle or an
+    ignorable husk, and a segment file missing from the hot dir MUST be
+    named as a source by some verified bundle — anything else is sealed
+    data retired unverified (the drill's cardinal sin)."""
+    import re
+
+    from tpudash.tsdb.cold import BUNDLE_SUFFIX, BundleError, parse_bundle
+
+    res: dict = {"bundles_verified": 0, "husks": 0, "unverified_reclaimed": []}
+    verified_sources: "set[str]" = set()
+    bundles_dir = os.path.join(obj_dir, "bundles")
+    try:
+        names = sorted(os.listdir(bundles_dir))
+    except OSError:
+        names = []
+    for name in names:
+        path = os.path.join(bundles_dir, name)
+        if not name.endswith(BUNDLE_SUFFIX) or not os.path.isfile(path):
+            res["husks"] += 1  # .put- temp from a killed upload
+            continue
+        with open(path, "rb") as fh:
+            data = fh.read()
+        try:
+            man = parse_bundle(data)
+        except BundleError:
+            res["husks"] += 1  # torn upload: never registrable, never served
+            continue
+        res["bundles_verified"] += 1
+        verified_sources.update(s["name"] for s in man.get("sources", []))
+    try:
+        present = {n for n in os.listdir(hot_dir) if n.endswith(".seg")}
+    except OSError:
+        present = set()
+    # segment seqs are strictly sequential per tier: any seq below the
+    # max that is absent from the hot dir was reclaimed
+    by_tier: "dict[str, int]" = {}
+    for n in present | verified_sources:
+        m = re.match(r"(raw|1m|10m)-(\d{6})\.seg$", n)
+        if m:
+            by_tier[m.group(1)] = max(
+                by_tier.get(m.group(1), 0), int(m.group(2))
+            )
+    for tier, hi in sorted(by_tier.items()):
+        for seq in range(1, hi + 1):
+            n = f"{tier}-{seq:06d}.seg"
+            if n not in present and n not in verified_sources:
+                res["unverified_reclaimed"].append(n)
+    return res
+
+
+def _coldstorm_next_t0(hot: str, fallback_t0: float) -> float:
+    """The next append stamp (whole seconds): one past the newest raw
+    record on disk, so kill rounds never duplicate stamps and the
+    recovered timeline must be gap-free by construction.  The newest
+    raw stamp always lives in the hot dir — the compactor never folds
+    the append target."""
+    from tpudash.tsdb import TSDB
+
+    if not os.path.isdir(hot):
+        return fallback_t0
+    probe = TSDB(
+        path=hot,
+        read_only=True,
+        retention_raw_s=_COLDSTORM_LONG_S,
+        retention_1m_s=_COLDSTORM_LONG_S,
+        retention_10m_s=_COLDSTORM_LONG_S,
+    )
+    pts = probe.raw_window(
+        "slice-0/0",
+        "tensorcore_utilization",
+        int(fallback_t0 * 1000),
+        int((fallback_t0 + 10 * 86400) * 1000),
+    )
+    probe.close()
+    if not pts:
+        return fallback_t0
+    return pts[-1][0] // 1000 + 1.0
+
+
+def _coldstorm_kill_phase(work_dir: str, kills: int = 2) -> dict:
+    """SIGKILL a store+compactor process mid-upload, ``kills`` times,
+    then prove (a) every object in the store is a complete verified
+    bundle or an ignorable husk, (b) no segment was reclaimed without a
+    verified bundle naming it as a source, and (c) a cold reopen serves
+    the whole hot→cold timeline with zero duplicates and zero gaps."""
+    import random
+
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.cold import ColdTier
+    from tpudash.tsdb.objstore import FilesystemStore
+
+    hot = os.path.join(work_dir, "killstore")
+    obj = os.path.join(work_dir, "killobj")
+    cache = os.path.join(work_dir, "killcache")
+    rng = random.Random(23)
+    failures: "list[str]" = []
+    stderr_tail = b""
+    # staged two hours in the past: every frame is already past the
+    # child's 45s retention, so reclaim pressure is constant
+    first_t0 = float(int(time.time() - 7200.0))  # tpulint: allow[wall-clock] stamps staged in the expired past
+    res: dict = {"bundles_verified": 0, "husks": 0, "unverified_reclaimed": []}
+    for round_no in range(1, kills + 1):
+        t0 = _coldstorm_next_t0(hot, first_t0)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _COLDSTORM_CHILD, hot, obj, cache,
+             repr(t0)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        time.sleep(2.5 + rng.random() * 1.5)
+        proc.send_signal(signal.SIGKILL)
+        _, err = proc.communicate()
+        stderr_tail += err or b""
+        res = _coldstorm_verify_store(hot, obj)
+        if res["unverified_reclaimed"]:
+            failures.append(
+                f"round {round_no}: segment(s) reclaimed without a "
+                f"verified bundle: {res['unverified_reclaimed']}"
+            )
+    if b"Traceback" in stderr_tail:
+        failures.append(
+            "coldstorm child crashed before the kill: "
+            + stderr_tail.decode(errors="replace")[:300]
+        )
+    if res["bundles_verified"] == 0:
+        failures.append(
+            "no kill round ever produced a verified bundle — drill too "
+            "short?"
+        )
+    # recovery: a fresh read-only store + a fresh cold tier over the
+    # survivors must serve one contiguous second-spaced timeline
+    store = TSDB(
+        path=hot,
+        read_only=True,
+        retention_raw_s=_COLDSTORM_LONG_S,
+        retention_1m_s=_COLDSTORM_LONG_S,
+        retention_10m_s=_COLDSTORM_LONG_S,
+    )
+    cold = ColdTier(
+        FilesystemStore(obj),
+        cache_dir=os.path.join(work_dir, "killcache-verify"),
+    )
+    store.attach_cold(cold)
+    pts = store.raw_window(
+        "slice-0/0",
+        "tensorcore_utilization",
+        int(first_t0 * 1000),
+        int((first_t0 + 10 * 86400) * 1000),
+    )
+    stamps = [p[0] for p in pts]
+    dupes = len(stamps) - len(set(stamps))
+    gaps = sum(1 for a, b in zip(stamps, stamps[1:]) if b - a != 1000)
+    if not stamps:
+        failures.append("recovered store served no raw points at all")
+    if dupes:
+        failures.append(
+            f"{dupes} duplicate stamp(s) in the recovered hot→cold "
+            "timeline (hot must win at the overlap, exactly once)"
+        )
+    if gaps:
+        failures.append(
+            f"{gaps} gap(s) in the recovered hot→cold timeline — "
+            "sealed data went missing across kill + reclaim"
+        )
+    quarantined = cold.status()["quarantined"]
+    if quarantined:
+        failures.append(
+            f"{quarantined} bundle(s) quarantined after clean kills — "
+            "a verified upload should never rot on its own"
+        )
+    store.close()
+    with contextlib.suppress(OSError):
+        cold.close()
+    return {
+        "failures": failures,
+        "kills": kills,
+        "recovered_points": len(stamps),
+        **res,
+        "unverified_reclaimed": len(res["unverified_reclaimed"]),
+    }
+
+
+def _coldstorm_torn_phase(work_dir: str) -> dict:
+    """Two torn uploads injected mid-sweep: the compactor must retry
+    under its deadline, delete the torn objects, and converge to
+    verified bundles — a fresh tier then serves the archive with zero
+    quarantine and zero husks left behind."""
+    import numpy as np
+
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.cold import ColdTier
+    from tpudash.tsdb.compact import Compactor
+    from tpudash.tsdb.objstore import FaultPlan, FilesystemStore
+
+    hot = os.path.join(work_dir, "tornstore")
+    obj = os.path.join(work_dir, "tornobj")
+    cache = os.path.join(work_dir, "torncache")
+    failures: "list[str]" = []
+    keys = [f"slice-0/{i}" for i in range(8)]
+    cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+    store = TSDB(
+        path=hot,
+        chunk_points=32,
+        retention_raw_s=_COLDSTORM_LONG_S,
+        retention_1m_s=_COLDSTORM_LONG_S,
+        retention_10m_s=_COLDSTORM_LONG_S,
+    )
+    t0 = float(int(time.time() - 2 * 86400.0) // 60 * 60)  # tpulint: allow[wall-clock] stamps staged 2 days back
+    for i in range(120):
+        mat = np.full((len(keys), len(cols)), 50.0 + i % 7, dtype=np.float32)
+        store.append_frame(t0 + i * 60.0, keys, cols, mat)
+    store.flush(seal_partial=True)
+    store.close()
+    faults = FaultPlan()
+    faults.torn_puts = 2
+    cold = ColdTier(FilesystemStore(obj, faults=faults), cache_dir=cache)
+    comp = Compactor(
+        source_dir=hot, cold=cold, include_tail=True, upload_deadline_s=30.0
+    )
+    summary = comp.run_once()
+    with contextlib.suppress(OSError):
+        comp.close()
+    with contextlib.suppress(OSError):
+        cold.close()
+    if faults.puts_torn != 2:
+        failures.append(
+            f"fault hook fired {faults.puts_torn} torn put(s), wanted 2"
+        )
+    if summary["upload_retries"] < 2:
+        failures.append(
+            f"compactor retried {summary['upload_retries']} time(s) for "
+            "2 torn uploads — read-back verification missed a tear"
+        )
+    if summary["gave_up"] or not summary["bundles_written"]:
+        failures.append(
+            f"sweep did not converge past the torn uploads: {summary}"
+        )
+    res = _coldstorm_verify_store(hot, obj)
+    if res["husks"]:
+        failures.append(
+            f"{res['husks']} torn object(s) left in the store — the "
+            "compactor must delete what read-back refused"
+        )
+    # a fresh tier over the healed store serves the full archive
+    empty = os.path.join(work_dir, "tornempty")
+    ro = TSDB(
+        path=empty,
+        retention_raw_s=_COLDSTORM_LONG_S,
+        retention_1m_s=_COLDSTORM_LONG_S,
+        retention_10m_s=_COLDSTORM_LONG_S,
+    )
+    cold2 = ColdTier(FilesystemStore(obj), cache_dir=cache + "-verify")
+    ro.attach_cold(cold2)
+    pts = ro.raw_window(
+        "slice-0/0",
+        "tensorcore_utilization",
+        int(t0 * 1000),
+        int((t0 + 120 * 60) * 1000),
+    )
+    if len(pts) != 120:
+        failures.append(
+            f"archive served {len(pts)}/120 points after the torn-upload "
+            "recovery"
+        )
+    quarantined = cold2.status()["quarantined"]
+    if quarantined:
+        failures.append(
+            f"{quarantined} bundle(s) quarantined after a clean recovery"
+        )
+    ro.close()
+    with contextlib.suppress(OSError):
+        cold2.close()
+    return {
+        "failures": failures,
+        "puts_torn": faults.puts_torn,
+        "upload_retries": summary["upload_retries"],
+        "bundles_written": summary["bundles_written"],
+        "husks": res["husks"],
+        "archive_points": len(pts),
+    }
+
+
+def _coldstorm_dashboard_prep(work_dir: str) -> dict:
+    """Stage the dashboard phase: a store of 40-day-old data (older
+    than every hot retention tier, so only the archives can answer),
+    compacted into bundles, then every bundle covering the first half
+    of the span — across all tiers, so any tier the range query picks
+    is hit — bit-flipped in the object store AFTER its upload was
+    digest-verified: the post-verify bit-rot case the serving tier
+    must catch at download."""
+    import numpy as np
+
+    import tpudash.tsdb.store as storemod
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.cold import ColdTier, parse_bundle
+    from tpudash.tsdb.compact import Compactor
+    from tpudash.tsdb.objstore import FilesystemStore
+
+    hot = os.path.join(work_dir, "dashstore")
+    obj = os.path.join(work_dir, "dashobj")
+    keys = [f"slice-0/{i}" for i in range(8)]
+    cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+    orig_seg = storemod._SEG_MAX_BYTES
+    storemod._SEG_MAX_BYTES = 4000  # several raw segments -> >= 2 bundles
+    try:
+        store = TSDB(
+            path=hot,
+            chunk_points=32,
+            retention_raw_s=_COLDSTORM_LONG_S,
+            retention_1m_s=_COLDSTORM_LONG_S,
+            retention_10m_s=_COLDSTORM_LONG_S,
+        )
+        t0 = float(int(time.time() - 40 * 86400.0) // 60 * 60)  # tpulint: allow[wall-clock] stamps staged 40 days back
+        for i in range(240):
+            mat = np.full(
+                (len(keys), len(cols)), 50.0 + i % 9, dtype=np.float32
+            )
+            store.append_frame(t0 + i * 60.0, keys, cols, mat)
+        store.flush(seal_partial=True)
+        store.close()
+        cold = ColdTier(
+            FilesystemStore(obj), cache_dir=os.path.join(work_dir, "dashcache-prep")
+        )
+        comp = Compactor(
+            source_dir=hot, cold=cold, include_tail=True,
+            upload_deadline_s=30.0,
+        )
+        comp.max_bundle_bytes = 4000  # below the ctor clamp: force small bundles
+        summary = comp.run_once()
+        with contextlib.suppress(OSError):
+            comp.close()
+        with contextlib.suppress(OSError):
+            cold.close()
+    finally:
+        storemod._SEG_MAX_BYTES = orig_seg
+    if summary["gave_up"] or summary["bundles_written"] < 2:
+        return {"error": f"dashboard prep did not stage bundles: {summary}"}
+    # rot the FIRST HALF of the archive across every tier (whichever
+    # tier the range query picks must hit a rotted bundle there), and
+    # leave the second half intact — the serving contract under rot is
+    # "quarantine + page + keep serving what still verifies"
+    bundles_dir = os.path.join(obj, "bundles")
+    mid_ms = int(t0 * 1000) + 120 * 60 * 1000
+    flipped, clean = [], []
+    for name in sorted(os.listdir(bundles_dir)):
+        path = os.path.join(bundles_dir, name)
+        with open(path, "rb") as fh:
+            man = parse_bundle(fh.read())
+        if man["t0"] >= mid_ms:
+            clean.append(name)
+            continue
+        with open(path, "r+b") as fh:
+            fh.seek(64)  # inside the first section's payload: digest must break
+            byte = fh.read(1)
+            fh.seek(64)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        flipped.append("bundles/" + name)
+    if not flipped or not clean:
+        return {
+            "error": f"prep staged {len(flipped)} rotted / {len(clean)} "
+            "clean bundle(s); the drill needs both"
+        }
+    return {
+        "hot": hot,
+        "obj": obj,
+        "cache_live": os.path.join(work_dir, "dashcache-live"),
+        "t0_ms": int(t0 * 1000),
+        "t1_ms": int((t0 + 239 * 60) * 1000),
+        "flipped": flipped,
+        "clean_bundles": len(clean),
+    }
+
+
+async def _coldstorm_dashboard_phase(work_dir: str) -> dict:
+    """The cold tier's operator surface, through a REAL dashboard over
+    HTTP: a bit-rotted bundle is quarantined and paged (``cold_corrupt``)
+    while the intact bundles keep serving; a dark object store degrades
+    ``/api/range`` to ``partial: true`` with a ``cold_unreachable``
+    alert and a truthful still-``ok`` ``/healthz``; restoring the store
+    heals everything without operator action."""
+    from aiohttp import ClientSession, web
+
+    failures: "list[str]" = []
+    info: dict = {}
+    loop = asyncio.get_running_loop()
+    prep = await loop.run_in_executor(
+        None, _coldstorm_dashboard_prep, work_dir
+    )
+    if prep.get("error"):
+        return {"failures": [prep["error"]]}
+    cfg = load_config()
+    knobs = {
+        "TPUDASH_REFRESH_INTERVAL": ("refresh_interval", 0.2),
+        "TPUDASH_SYNTHETIC_CHIPS": ("synthetic_chips", 8),
+    }
+    for env_name, (fieldname, value) in knobs.items():
+        if not env_is_set(env_name):
+            cfg = dataclasses.replace(cfg, **{fieldname: value})
+    cfg = dataclasses.replace(
+        cfg,
+        source="synthetic",
+        anomaly=False,
+        tsdb_path=prep["hot"],
+        # no seals during the drill: the retention pass must not race
+        # the HTTP assertions (reclaim gating has its own phase + tests)
+        tsdb_chunk_points=100000,
+        cold_store=prep["obj"],
+        cold_cache_dir=prep["cache_live"],
+        cold_compact=False,
+    )
+
+    def build():
+        from tpudash.app.server import DashboardServer
+        from tpudash.app.service import DashboardService
+        from tpudash.sources import make_source
+
+        return DashboardServer(DashboardService(cfg, make_source(cfg)))
+
+    server = await loop.run_in_executor(None, build)
+    if server.service.cold is None:
+        await loop.run_in_executor(None, server.service.close_tsdb)
+        return {"failures": ["service came up without a cold tier"]}
+    server.service.cold.refresh_interval_s = 0.3
+    trap = _ErrorTrap()
+    logging.getLogger().addHandler(trap)
+    app = server.build_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    host, port = runner.addresses[0][:2]
+    base = f"http://{host}:{port}"
+    rng_url = (
+        f"{base}/api/range?chip=slice-0/0&cols=tensorcore_utilization"
+        f"&start={prep['t0_ms'] / 1000.0}"
+        f"&end={prep['t1_ms'] / 1000.0 + 60.0}&step=60"
+    )
+
+    def pts_of(doc):
+        return sum(len(v) for v in (doc.get("series") or {}).values())
+
+    def has_rule(alerts, rule):
+        return any(a["rule"] == rule for a in alerts)
+
+    async def poll(session, seconds, predicate):
+        deadline = time.monotonic() + seconds
+        last: dict = {}
+        while time.monotonic() < deadline:
+            async with session.get(f"{base}/api/frame") as r:
+                await r.read()
+            async with session.get(rng_url) as r:
+                rng = (await r.json()) if r.status == 200 else {}
+                rng["_status"] = r.status
+            async with session.get(f"{base}/api/alerts") as r:
+                alerts = (await r.json())["alerts"]
+            async with session.get(f"{base}/healthz") as r:
+                hz = await r.json()
+            last = {"range": rng, "alerts": alerts, "healthz": hz}
+            if predicate(last):
+                return True, last
+            await asyncio.sleep(0.25)
+        return False, last
+
+    try:
+        async with ClientSession() as session:
+            # phase 1 — the rotted bundle is caught at download and
+            # quarantined + paged, while the intact bundles keep the
+            # archive span serving (non-partial: the STORE is healthy)
+            ok, snap = await poll(
+                session,
+                20.0,
+                lambda s: s["range"].get("_status") == 200
+                and pts_of(s["range"]) > 0
+                and not s["range"].get("partial")
+                and has_rule(s["alerts"], "cold_corrupt")
+                and (s["healthz"].get("cold") or {}).get("quarantined", 0)
+                >= 1,
+            )
+            if not ok:
+                failures.append(
+                    "rotted bundle was not quarantined+paged while the "
+                    f"clean bundles served (last: range_status="
+                    f"{snap.get('range', {}).get('_status')}, points="
+                    f"{pts_of(snap.get('range', {}))}, healthz_cold="
+                    f"{snap.get('healthz', {}).get('cold')})"
+                )
+            else:
+                info["archive_points"] = pts_of(snap["range"])
+                info["quarantined"] = snap["healthz"]["cold"]["quarantined"]
+                detail = next(
+                    (
+                        a.get("detail", "")
+                        for a in snap["alerts"]
+                        if a["rule"] == "cold_corrupt"
+                    ),
+                    "",
+                )
+                if not any(k in detail for k in prep["flipped"]):
+                    failures.append(
+                        f"cold_corrupt page names none of the rotted "
+                        f"bundles {prep['flipped']}: {detail!r}"
+                    )
+            marker_dir = os.path.join(prep["obj"], "quarantine")
+            markers = (
+                os.listdir(marker_dir) if os.path.isdir(marker_dir) else []
+            )
+            if not markers:
+                failures.append(
+                    "no quarantine marker persisted to the object store "
+                    "— a restart would trust the rotted bundle again"
+                )
+            # phase 2 — dark store: range degrades to partial, the
+            # pager fires, /healthz stays ok (a restart fixes nothing)
+            await loop.run_in_executor(
+                None, os.rename, prep["obj"], prep["obj"] + ".dark"
+            )
+            ok, snap = await poll(
+                session,
+                20.0,
+                lambda s: s["range"].get("partial") is True
+                and (s["range"].get("cold") or {}).get("cold_unreachable")
+                and has_rule(s["alerts"], "cold_unreachable")
+                and s["healthz"].get("ok") is True
+                and "cold_unreachable" in str(s["healthz"].get("status")),
+            )
+            if not ok:
+                failures.append(
+                    "dark store did not degrade honestly (want "
+                    "partial:true + cold_unreachable alert + ok:true "
+                    f"healthz; last: partial="
+                    f"{snap.get('range', {}).get('partial')}, healthz="
+                    f"{snap.get('healthz', {}).get('status')})"
+                )
+            # phase 3 — heal: restore the store, assert everything
+            # clears with NO operator action
+            await loop.run_in_executor(
+                None, os.rename, prep["obj"] + ".dark", prep["obj"]
+            )
+            ok, snap = await poll(
+                session,
+                20.0,
+                lambda s: not s["range"].get("partial")
+                and pts_of(s["range"]) > 0
+                and not has_rule(s["alerts"], "cold_unreachable")
+                and "cold_unreachable"
+                not in str(s["healthz"].get("status")),
+            )
+            if not ok:
+                failures.append(
+                    "store heal did not clear the degrade without "
+                    f"operator action (last: partial="
+                    f"{snap.get('range', {}).get('partial')}, healthz="
+                    f"{snap.get('healthz', {}).get('status')})"
+                )
+    finally:
+        await runner.cleanup()  # app on_cleanup seals + closes the tsdb/cold
+        logging.getLogger().removeHandler(trap)
+    if trap.records:
+        failures.append(
+            f"{len(trap.records)} unhandled server error(s): "
+            f"{trap.records[:3]}"
+        )
+    return {"failures": failures, **info}
+
+
+def _coldstorm_replay_phase(work_dir: str) -> dict:
+    """A 90-day-old incident, replayed through the REAL CLI after every
+    hot tier expired AND the raw segments were deleted: the archives
+    are the only copy left, and ``anomaly replay --tsdb`` must still
+    reproduce the breach."""
+    import shutil
+
+    import numpy as np
+
+    from tpudash.tsdb import TSDB
+    from tpudash.tsdb.cold import ColdTier
+    from tpudash.tsdb.compact import Compactor
+    from tpudash.tsdb.objstore import FilesystemStore
+
+    hot = os.path.join(work_dir, "replaystore")
+    obj = os.path.join(work_dir, "replayobj")
+    cache = os.path.join(work_dir, "replaycache")
+    failures: "list[str]" = []
+    keys = [f"slice-0/{i}" for i in range(8)]
+    cols = ["tensorcore_utilization", "hbm_usage_ratio"]
+    store = TSDB(
+        path=hot,
+        chunk_points=32,
+        retention_raw_s=_COLDSTORM_LONG_S,
+        retention_1m_s=_COLDSTORM_LONG_S,
+        retention_10m_s=_COLDSTORM_LONG_S,
+    )
+    t0 = float(int(time.time() - 89 * 86400.0) // 60 * 60)  # tpulint: allow[wall-clock] incident staged 89 days back
+    for i in range(180):
+        mat = np.full((len(keys), len(cols)), 50.0, dtype=np.float32)
+        if 60 <= i < 140:
+            mat[3, 1] = 97.0  # slice-0/3 breaches hbm_usage_ratio>92
+        store.append_frame(t0 + i * 60.0, keys, cols, mat)
+    store.flush(seal_partial=True)
+    store.close()
+    cold = ColdTier(FilesystemStore(obj), cache_dir=cache)
+    comp = Compactor(
+        source_dir=hot, cold=cold, include_tail=True, upload_deadline_s=30.0
+    )
+    summary = comp.run_once()
+    with contextlib.suppress(OSError):
+        comp.close()
+    with contextlib.suppress(OSError):
+        cold.close()
+    if summary["gave_up"] or not summary["bundles_written"]:
+        return {"failures": [f"replay prep compaction failed: {summary}"]}
+    # the point of the phase: the raw+rollup tiers are GONE — archives
+    # are the only copy of the incident
+    shutil.rmtree(hot)
+    empty = os.path.join(work_dir, "replayempty")
+    os.makedirs(empty, exist_ok=True)
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("TPUDASH_")
+    }  # tpulint: allow[env-read] child-CLI env build, not a read
+    env["TPUDASH_COLD_STORE"] = obj  # tpulint: allow[env-read] child-CLI env build, not a read
+    env["TPUDASH_COLD_CACHE_DIR"] = cache + "-replay"  # tpulint: allow[env-read] child-CLI env build, not a read
+    env["TPUDASH_ANOMALY"] = "0"  # tpulint: allow[env-read] child-CLI env build, not a read
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpudash.anomaly", "replay",
+            "--tsdb", empty,
+            "--start", repr(t0),
+            "--end", repr(t0 + 180 * 60.0),
+            "--step", "60",
+            "--json",
+        ],
+        env=env,
+        capture_output=True,
+        timeout=120,
+    )
+    if proc.returncode != 0:
+        failures.append(
+            f"replay CLI failed rc={proc.returncode}: "
+            f"{proc.stderr.decode(errors='replace')[-400:]}"
+        )
+        return {"failures": failures}
+    try:
+        doc = json.loads(proc.stdout.decode())
+    except ValueError:
+        return {"failures": ["replay CLI emitted unparseable JSON"]}
+    incidents = doc.get("variant", {}).get("incidents", [])
+    hit = next(
+        (
+            i
+            for i in incidents
+            if i.get("chip") == "slice-0/3" and "hbm" in str(i.get("rule"))
+        ),
+        None,
+    )
+    if hit is None:
+        failures.append(
+            "replay-from-archives lost the incident (chips seen: "
+            f"{sorted({str(i.get('chip')) for i in incidents})})"
+        )
+    return {
+        "failures": failures,
+        "incidents": len(incidents),
+        "bundles_written": summary["bundles_written"],
+    }
+
+
+async def run_coldstorm_drill(kills: int = 2) -> dict:
+    """The cold-tier soak: kill -9 mid-compaction (twice), a torn
+    upload, a dark object store through a real HTTP dashboard, a
+    digest flip, and a 90-day replay through the archives.  Exit 0 =
+    every invariant held."""
+    import shutil
+    import tempfile
+
+    loop = asyncio.get_running_loop()
+    work_dir = await loop.run_in_executor(
+        None, lambda: tempfile.mkdtemp(prefix="tpudash-coldstorm-")
+    )
+    failures: "list[str]" = []
+    summary: dict = {"kills": kills}
+    try:
+        kill = await loop.run_in_executor(
+            None, _coldstorm_kill_phase, work_dir, kills
+        )
+        failures += [f"kill: {f}" for f in kill.pop("failures")]
+        summary["kill"] = kill
+        torn = await loop.run_in_executor(
+            None, _coldstorm_torn_phase, work_dir
+        )
+        failures += [f"torn: {f}" for f in torn.pop("failures")]
+        summary["torn"] = torn
+        dash = await _coldstorm_dashboard_phase(work_dir)
+        failures += [f"dashboard: {f}" for f in dash.pop("failures")]
+        summary["dashboard"] = dash
+        replay = await loop.run_in_executor(
+            None, _coldstorm_replay_phase, work_dir
+        )
+        failures += [f"replay: {f}" for f in replay.pop("failures")]
+        summary["replay"] = replay
+    finally:
+        await loop.run_in_executor(
+            None, lambda: shutil.rmtree(work_dir, ignore_errors=True)
+        )
+    summary["bundles_verified"] = summary.get("kill", {}).get(
+        "bundles_verified", 0
+    )
+    summary["unverified_reclaimed"] = summary.get("kill", {}).get(
+        "unverified_reclaimed", 0
+    )
+    summary["recovered_points"] = summary.get("kill", {}).get(
+        "recovered_points", 0
+    )
+    summary["failures"] = failures
+    summary["ok"] = not failures
+    return summary
+
+
+# ---------------------------------------------------------------------------
 # Edgestorm drill — the edge delivery tier under kills and partitions:
 # a real single-process compose publishing the TCP frame bus + N real
 # edge subprocesses + a failover-streaming client population
@@ -4432,6 +5224,17 @@ def main(argv: "list[str] | None" = None) -> None:
         "counterfactual under a raised threshold",
     )
     inc.add_argument("--chips", type=int, default=64)
+    cs = sub.add_parser(
+        "coldstorm",
+        help="cold-tier drill: SIGKILL a store+compactor mid-upload "
+        "(x2; zero unverified-but-reclaimed segments, zero served "
+        "corrupt bundles), torn-upload retry convergence, dark object "
+        "store through a real dashboard (partial:true + "
+        "cold_unreachable + truthful healthz, heals without operator "
+        "action), digest-flip quarantine, and a 90-day incident "
+        "replayed from archives alone",
+    )
+    cs.add_argument("--kills", type=int, default=2)
     # internal: one shard of the storm's streaming population, spawned
     # by the storm drill itself (the load generator runs on its own
     # cores so a 2500-client storm measures the tier, not the driver)
@@ -4509,6 +5312,10 @@ def main(argv: "list[str] | None" = None) -> None:
         sys.exit(0 if summary["ok"] else 1)
     if args.mode == "incident":
         summary = asyncio.run(run_incident_drill(chips=args.chips))
+        print(json.dumps(summary, indent=2))
+        sys.exit(0 if summary["ok"] else 1)
+    if args.mode == "coldstorm":
+        summary = asyncio.run(run_coldstorm_drill(kills=args.kills))
         print(json.dumps(summary, indent=2))
         sys.exit(0 if summary["ok"] else 1)
 

@@ -181,6 +181,36 @@ class Config:
     #: Follower poll cadence, seconds (how often sealed segment growth
     #: is tailed; bounds replication lag when the leader is live).
     tsdb_follow_interval: float = 2.0
+    # --- tsdb cold tier: compaction to verified object-store archives -------
+    #: Object-store spec for the archive tier ("" disables cold storage).
+    #: A bare directory path or ``file:///path`` uses the built-in
+    #: filesystem backend; other schemes plug in via
+    #: ``tpudash.tsdb.objstore.register_backend``.  Sealed segments are
+    #: folded into immutable, digest-verified bundles; queries and
+    #: ``anomaly replay`` span hot→cold transparently (runbook:
+    #: docs/OPERATIONS.md, cold tier).
+    cold_store: str = ""
+    #: Compaction sweep cadence, seconds (0 = no background compactor —
+    #: read-only cold access; archives still serve queries).
+    cold_interval: float = 300.0
+    #: Only compact segment files at least this old, seconds — a settle
+    #: window so a segment being actively rotated isn't bundled twice.
+    cold_min_age: float = 0.0
+    #: Local bundle-cache directory ("" = <tsdb dir>/cold-cache).  Every
+    #: download is digest-verified before it enters the cache.
+    cold_cache_dir: str = ""
+    #: Bundle-cache size ceiling, MiB (LRU eviction above it).
+    cold_cache_mb: int = 256
+    #: Per-bundle upload deadline, seconds: decorrelated-backoff retries
+    #: stop when it expires and the bundle is retried next sweep.
+    cold_upload_deadline: float = 120.0
+    #: Target bundle size, MiB: a compaction sweep groups segment files
+    #: greedily up to this many bytes per bundle.
+    cold_bundle_mb: int = 64
+    #: Run the compactor on this instance (on: leaders and followers
+    #: alike; off: this instance only READS archives — the roles split
+    #: for running compaction off the serving leader).
+    cold_compact: bool = True
     #: source="workload": checkpoint/resume for the background train loop
     #: (models/checkpoint.py) — save every N steps into this directory and
     #: resume from its latest step on restart.  "" disables.
@@ -638,6 +668,14 @@ _ENV_MAP = {
     "tsdb_snapshot_retention": "TPUDASH_TSDB_SNAPSHOT_RETENTION",
     "tsdb_follow": "TPUDASH_TSDB_FOLLOW",
     "tsdb_follow_interval": "TPUDASH_TSDB_FOLLOW_INTERVAL",
+    "cold_store": "TPUDASH_COLD_STORE",
+    "cold_interval": "TPUDASH_COLD_INTERVAL",
+    "cold_min_age": "TPUDASH_COLD_MIN_AGE",
+    "cold_cache_dir": "TPUDASH_COLD_CACHE_DIR",
+    "cold_cache_mb": "TPUDASH_COLD_CACHE_MB",
+    "cold_upload_deadline": "TPUDASH_COLD_UPLOAD_DEADLINE",
+    "cold_bundle_mb": "TPUDASH_COLD_BUNDLE_MB",
+    "cold_compact": "TPUDASH_COLD_COMPACT",
     "workload_checkpoint_dir": "TPUDASH_WORKLOAD_CKPT_DIR",
     "workload_checkpoint_every": "TPUDASH_WORKLOAD_CKPT_EVERY",
     "alert_rules": "TPUDASH_ALERT_RULES",

@@ -13,11 +13,23 @@ Layers (each its own module, each independently tested):
 - :mod:`tpudash.tsdb.snapshot` — online snapshots (hardlinked segment
   sets + CRC-framed manifest), verified restore, retention-aware GC;
 - :mod:`tpudash.tsdb.follower` — read-only hot-standby mode tailing
-  another instance's segment directory with measured replication lag.
+  another instance's segment directory with measured replication lag;
+- :mod:`tpudash.tsdb.objstore` — pluggable object-store interface for
+  the cold tier (filesystem backend built in, fault hooks for chaos);
+- :mod:`tpudash.tsdb.cold` — immutable, self-verifying archive bundles
+  (per-section CRCs + whole-bundle digest) and the read-through tier
+  that folds them behind hot coverage with a bounded, digest-checked
+  local cache; corrupt bundles are quarantined, never served;
+- :mod:`tpudash.tsdb.compact` — the compactor folding sealed segment
+  files into bundles off the seal thread: staged locally, uploaded
+  with decorrelated backoff, verified by digest read-back BEFORE the
+  local segments become reclaim-eligible.
 
 ``python -m tpudash.tsdb drill`` is the crash chaos drill (kill -9 mid
 segment-append, assert sealed data survives); ``snapshot``/``restore``
-are the backup surface; CI runs the drills every PR.
+are the backup surface; ``compact`` is the cold tier's one-shot sweep;
+CI runs the drills (including ``python -m tpudash.chaos coldstorm``)
+every PR.
 """
 
 from tpudash.tsdb.store import FLEET_SERIES, TSDB

@@ -34,11 +34,22 @@
 ``python -m tpudash.tsdb stats --dir D``
     One JSON line of :meth:`TSDB.stats` for a store directory
     (read-only: never truncates another process's torn tail).
+
+``python -m tpudash.tsdb compact --dir D --store SPEC [--cache C]``
+    One compaction sweep: fold sealed segment files from ``D`` into
+    digest-verified archive bundles at the object store ``SPEC`` (a
+    directory path or ``file://`` URL), upload-then-verify-then-register
+    (tpudash/tsdb/compact.py), and print the sweep summary as JSON.
+    Safe against a live writer (reads sealed files only; the append
+    target is skipped) and idempotent — deterministic bundle names make
+    a re-run after a crash a no-op.  ``--include-tail`` also folds the
+    current append target (final drain of a decommissioned store).
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import random
@@ -164,6 +175,37 @@ def run_follow(leader: str, seconds: float, interval: float) -> int:
     return 0
 
 
+def run_compact(dirpath: str, spec: str, cache: str, min_age: float,
+                bundle_mb: int, deadline: float, include_tail: bool) -> int:
+    from tpudash.tsdb.cold import ColdTier
+    from tpudash.tsdb.compact import Compactor
+    from tpudash.tsdb.objstore import open_store
+
+    try:
+        store = open_store(spec)
+    except ValueError as e:
+        print(f"compact refused: {e}", file=sys.stderr)
+        return 1
+    cold = ColdTier(store, cache_dir=cache or os.path.join(dirpath, "cold-cache"))
+    comp = Compactor(
+        source_dir=dirpath,
+        cold=cold,
+        min_age_s=min_age,
+        max_bundle_bytes=bundle_mb << 20,
+        upload_deadline_s=deadline,
+        include_tail=include_tail,
+    )
+    try:
+        summary = comp.run_once()
+    finally:
+        with contextlib.suppress(OSError):
+            comp.close()
+        with contextlib.suppress(OSError):
+            cold.close()
+    print(json.dumps(summary))
+    return 0 if not summary.get("gave_up") else 1
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tpudash.tsdb")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -188,6 +230,18 @@ def main(argv: "list[str] | None" = None) -> int:
     fo.add_argument("--interval", type=float, default=1.0)
     s = sub.add_parser("stats", help="dump a store's stats as JSON")
     s.add_argument("--dir", required=True)
+    co = sub.add_parser("compact", help="one cold-tier compaction sweep")
+    co.add_argument("--dir", required=True, help="segment directory to fold")
+    co.add_argument("--store", required=True,
+                    help="object-store spec (path or file:// URL)")
+    co.add_argument("--cache", default="",
+                    help="bundle cache dir (default <dir>/cold-cache)")
+    co.add_argument("--min-age", type=float, default=0.0)
+    co.add_argument("--bundle-mb", type=int, default=64)
+    co.add_argument("--deadline", type=float, default=120.0)
+    co.add_argument("--include-tail", action="store_true",
+                    help="also fold the current append target (final "
+                    "drain of a decommissioned store)")
     args = ap.parse_args(argv)
     if args.cmd == "drill":
         return run_drill(args.dir, args.kills, args.seed)
@@ -197,6 +251,9 @@ def main(argv: "list[str] | None" = None) -> int:
         return run_restore(args.snapshot, args.dir)
     if args.cmd == "follow":
         return run_follow(args.leader, args.seconds, args.interval)
+    if args.cmd == "compact":
+        return run_compact(args.dir, args.store, args.cache, args.min_age,
+                           args.bundle_mb, args.deadline, args.include_tail)
     from tpudash.tsdb import TSDB
 
     print(json.dumps(TSDB(path=args.dir, read_only=True).stats()))

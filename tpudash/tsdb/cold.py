@@ -1,0 +1,743 @@
+"""The cold tier: immutable, self-verifying archive bundles in an
+object store, read transparently behind the hot store.
+
+An **archive bundle** is one object folding a set of sealed segment
+files (tpudash/tsdb/compact.py decides which).  Layout::
+
+    [TSB1 frames — the segment records VERBATIM: type 1 raw block,
+     2 rollup, 4 sketch — same codecs, same per-record CRC framing]
+    [TSB1 frame type 5: the bundle manifest (JSON)]
+    [footer: manifest offset (u64) + b"TDBF"]
+
+The manifest is the bundle's sparse index: one entry per section
+(frame offset/length/type/tier/time-bounds/CRC), the source segment
+files it folds (name + byte count — segment reclaim keys on these),
+the series key/column unions, and a whole-bundle SHA-256 over every
+byte before the manifest frame.  A reader locates the sketch sections
+for a window from the manifest alone — a 90-day quantile query never
+touches (or decodes) a raw section.
+
+Trust model — verify, never assume:
+
+- the manifest frame carries the TSB1 CRC; a torn upload fails here;
+- the whole-bundle digest is checked on every download into the local
+  bundle cache (and by the compactor's read-back before any local
+  segment becomes reclaim-eligible);
+- every section re-checks its frame CRC at parse time (bit-rot in the
+  cache re-downloads once; bit-rot in the store quarantines).
+
+A bundle failing any check is **quarantined**: dropped from the
+catalog, never served, remembered via a ``quarantine/`` marker object,
+and surfaced as the ``cold_corrupt`` synthesized alert.  Its source
+segments count as uncovered again, so — while they still exist — the
+next compaction run rebuilds and replaces the bad object (the self-heal
+the coldstorm drill pins).
+
+An unreachable store never raises into a query: :class:`ColdTier`
+marks itself ``unreachable``, serves what the local cache still holds,
+and the hot store's answer degrades to ``partial:true`` (the federation
+degrade contract; see query.py / server.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+
+from tpudash.tsdb.objstore import ObjectStoreError
+from tpudash.tsdb.store import (
+    _FRAME_HDR,
+    _MAGIC,
+    _REC_BLOCK,
+    _REC_ROLLUP,
+    _REC_SKETCH,
+    _parse_block,
+    _parse_rollup,
+    _parse_sketch,
+)
+
+log = logging.getLogger(__name__)
+
+#: bundle-manifest record type inside the shared TSB1 framing — 5, the
+#: next free type (1/2/4 = segment records reused verbatim as bundle
+#: sections, 3 = snapshot.py's MANIFEST); record types stay globally
+#: unique so any tool dispatches on type alone, whichever file it reads
+_REC_BUNDLE_MANIFEST = 5
+#: bundle footer: manifest frame offset + magic, fixed at EOF so a
+#: reader finds the manifest with two ranged reads (tail, then frame)
+_FOOTER = struct.Struct("<Q4s")
+_FOOTER_MAGIC = b"TDBF"
+
+BUNDLE_PREFIX = "bundles/"
+QUARANTINE_PREFIX = "quarantine/"
+BUNDLE_SUFFIX = ".tdb"
+
+_SECTION_PARSERS = {
+    _REC_BLOCK: _parse_block,
+    _REC_ROLLUP: _parse_rollup,
+    _REC_SKETCH: _parse_sketch,
+}
+_SECTION_NAMES = {_REC_BLOCK: "raw", _REC_ROLLUP: "rollup", _REC_SKETCH: "sketch"}
+
+
+class BundleError(Exception):
+    """A bundle failed validation — the message names the check."""
+
+
+def build_bundle(sections, sources, created_ms, keys, cols):
+    """Serialize one archive bundle.  ``sections`` is a list of
+    ``(rec_type, tier_ms, t0, t1, payload_bytes)`` — the payloads are
+    segment-record payloads verbatim; ``sources`` is
+    ``[{"name", "bytes"}]`` for the segment files folded in.  Returns
+    ``(bundle_bytes, manifest_doc)``."""
+    parts: "list[bytes]" = []
+    index: "list[dict]" = []
+    off = 0
+    t0 = None
+    t1 = 0
+    counts = {"raw": 0, "rollup": 0, "sketch": 0}
+    for rec_type, tier_ms, s_t0, s_t1, payload in sections:
+        frame = _FRAME_HDR.pack(
+            _MAGIC, rec_type, len(payload), zlib.crc32(payload)
+        ) + payload
+        parts.append(frame)
+        index.append(
+            {
+                "off": off,
+                "len": len(frame),
+                "type": int(rec_type),
+                "tier": int(tier_ms),
+                "t0": int(s_t0),
+                "t1": int(s_t1),
+                "crc": zlib.crc32(payload),
+            }
+        )
+        counts[_SECTION_NAMES[rec_type]] += 1
+        off += len(frame)
+        t0 = s_t0 if t0 is None else min(t0, s_t0)
+        t1 = max(t1, s_t1)
+    body = b"".join(parts)
+    manifest = {
+        "version": 1,
+        "created_ms": int(created_ms),
+        "t0": int(t0 or 0),
+        "t1": int(t1),
+        "sections": index,
+        "sources": [
+            {"name": s["name"], "bytes": int(s["bytes"])} for s in sources
+        ],
+        "keys": sorted(keys),
+        "cols": sorted(cols),
+        "counts": counts,
+        "digest": hashlib.sha256(body).hexdigest(),
+    }
+    payload = json.dumps(manifest, separators=(",", ":")).encode()
+    mframe = _FRAME_HDR.pack(
+        _MAGIC, _REC_BUNDLE_MANIFEST, len(payload), zlib.crc32(payload)
+    ) + payload
+    footer = _FOOTER.pack(len(body), _FOOTER_MAGIC)
+    return body + mframe + footer, manifest
+
+
+def _parse_manifest_frame(frame: bytes) -> dict:
+    if len(frame) < _FRAME_HDR.size:
+        raise BundleError("manifest frame shorter than its header")
+    magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(frame, 0)
+    payload = frame[_FRAME_HDR.size : _FRAME_HDR.size + plen]
+    if (
+        magic != _MAGIC
+        or rec_type != _REC_BUNDLE_MANIFEST
+        or len(payload) != plen
+        or zlib.crc32(payload) != crc
+    ):
+        raise BundleError("manifest frame failed magic/CRC validation")
+    try:
+        doc = json.loads(payload)
+    except ValueError as e:
+        raise BundleError(f"manifest payload is not JSON: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("sections"), list):
+        raise BundleError("manifest missing its section index")
+    return doc
+
+
+def parse_bundle(data: bytes, verify_digest: bool = True) -> dict:
+    """Validate a whole bundle image and return its manifest.  Checks
+    footer magic, manifest frame CRC, and (by default) the whole-bundle
+    SHA-256 over the section bytes.  Raises :class:`BundleError` on the
+    first mismatch — a bundle is trusted whole or not at all."""
+    if len(data) < _FOOTER.size + _FRAME_HDR.size:
+        raise BundleError("bundle shorter than footer + manifest frame")
+    moff, magic = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
+    if magic != _FOOTER_MAGIC or moff > len(data) - _FOOTER.size:
+        raise BundleError("bundle footer failed magic/offset validation")
+    doc = _parse_manifest_frame(data[moff : len(data) - _FOOTER.size])
+    if verify_digest:
+        got = hashlib.sha256(data[:moff]).hexdigest()
+        if got != doc.get("digest"):
+            raise BundleError(
+                f"whole-bundle digest mismatch (manifest "
+                f"{str(doc.get('digest'))[:12]}…, bytes {got[:12]}…)"
+            )
+    return doc
+
+
+def read_remote_manifest(store, key: str) -> dict:
+    """Fetch ONLY a bundle's manifest from the store (two ranged reads
+    — footer, then manifest frame).  CRC-validated; the whole-bundle
+    digest is deferred to download time."""
+    size = store.size(key)
+    if size < _FOOTER.size + _FRAME_HDR.size:
+        raise BundleError(f"{key}: object shorter than a bundle footer")
+    tail = store.get(key, start=size - _FOOTER.size, length=_FOOTER.size)
+    if len(tail) != _FOOTER.size:
+        raise BundleError(f"{key}: short footer read")
+    moff, magic = _FOOTER.unpack(tail)
+    if magic != _FOOTER_MAGIC or moff > size - _FOOTER.size:
+        raise BundleError(f"{key}: footer failed magic/offset validation")
+    frame = store.get(key, start=moff, length=size - _FOOTER.size - moff)
+    return _parse_manifest_frame(frame)
+
+
+class ColdTier:
+    """Read surface over the archive catalog + the bounded local bundle
+    cache.  Attached to a :class:`~tpudash.tsdb.store.TSDB` via
+    ``store.attach_cold``; every query fold happens behind the hot
+    store's own windows (store.py clamps cold reads to strictly before
+    hot coverage, so nothing double-counts).
+
+    Thread contract: ``_lock`` guards catalog/counters (pointer swaps
+    only, never I/O); ``_io_lock`` serializes cache downloads.  Query
+    callers are executor/seal/compactor threads — never the event loop.
+    """
+
+    def __init__(
+        self,
+        store,
+        cache_dir: str,
+        cache_max_bytes: int = 256 << 20,
+        refresh_interval_s: float = 15.0,
+    ) -> None:
+        self.store = store
+        self.cache_dir = cache_dir
+        self.cache_max_bytes = max(1 << 20, int(cache_max_bytes))
+        self.refresh_interval_s = max(0.5, float(refresh_interval_s))
+        self._lock = threading.RLock()
+        self._io_lock = threading.Lock()
+        #: bundle key → manifest (verified-shape, digest checked on
+        #: download); quarantined keys live in _quarantine instead
+        self._catalog: "dict[str, dict]" = {}
+        self._quarantine: "dict[str, str]" = {}
+        self._last_refresh_mono: "float | None" = None
+        self._catalog_version = 0
+        self._section_memo: "dict[tuple, list]" = {}
+        #: parsed-section cache: (key, off) → decoded block (FIFO-bounded)
+        self._parsed: "dict[tuple, object]" = {}
+        self._parsed_max = 512
+        self.unreachable = False
+        self.last_error: "str | None" = None
+        #: compactor registers itself here so one status() tells the whole
+        #: cold story (reads + writes) on /api/timings
+        self.compactor = None
+        #: invoked on every catalog change; the hot store wires its
+        #: version bump here so range-result ETags see new archives
+        self.on_change = None
+        self.counters = {
+            "refreshes": 0,
+            "bundle_fetches": 0,
+            "cache_hits": 0,
+            "cache_evictions": 0,
+            "sections_parsed_raw": 0,
+            "sections_parsed_rollup": 0,
+            "sections_parsed_sketch": 0,
+            "quarantined_total": 0,
+        }
+
+    # -- catalog -------------------------------------------------------------
+    def refresh(self, force: bool = False) -> None:
+        """Interval-gated catalog sync: list the store, pull manifests
+        for unseen bundles, honor quarantine markers.  An unreachable
+        store flips ``unreachable`` and keeps the cached catalog —
+        queries degrade, they do not fail."""
+        now = time.monotonic()
+        with self._lock:
+            if (
+                not force
+                and self._last_refresh_mono is not None
+                and now - self._last_refresh_mono < self.refresh_interval_s
+            ):
+                return
+            self._last_refresh_mono = now
+        try:
+            keys = self.store.list(BUNDLE_PREFIX)
+            markers = set(self.store.list(QUARANTINE_PREFIX))
+        except ObjectStoreError as e:
+            self._mark_unreachable(str(e))
+            return
+        with self._lock:
+            was_unreachable = self.unreachable
+            self.unreachable = False
+            self.last_error = None
+            self.counters["refreshes"] += 1
+            known = set(self._catalog) | set(self._quarantine)
+            if was_unreachable:
+                # reachability is part of every range answer (partial
+                # flag), so the flip must invalidate range ETags too
+                self._bump_catalog_locked()
+        if was_unreachable:
+            log.info("cold store reachable again (%s)", self.store.describe())
+        marked = {
+            BUNDLE_PREFIX + os.path.basename(m)[: -len(".marker")]
+            for m in markers
+            if m.endswith(".marker")
+        }
+        for key in keys:
+            if not key.endswith(BUNDLE_SUFFIX):
+                continue  # upload husk or foreign object: ignorable
+            if key in marked and key not in known:
+                with self._lock:
+                    self._quarantine[key] = "quarantine marker present"
+                continue
+            if key in known:
+                continue
+            try:
+                man = read_remote_manifest(self.store, key)
+            except BundleError as e:
+                self.quarantine(key, str(e))
+                continue
+            except ObjectStoreError as e:
+                self._mark_unreachable(str(e))
+                return
+            self._register_locked_entry(key, man)
+        # bundles deleted out from under us (archive retention by an
+        # operator) fall out of the catalog on the next refresh
+        present = set(keys)
+        with self._lock:
+            for key in [k for k in self._catalog if k not in present]:
+                del self._catalog[k]
+                self._bump_catalog_locked()
+
+    def _mark_unreachable(self, err: str) -> None:
+        """Flip to unreachable, bumping the catalog version on the
+        transition: range ETags hash the store version, and an answer
+        that just became ``partial: true`` must not 304 as the old
+        complete body."""
+        with self._lock:
+            flipped = not self.unreachable
+            self.unreachable = True
+            self.last_error = err
+            if flipped:
+                self._bump_catalog_locked()
+
+    def _bump_catalog_locked(self) -> None:
+        self._catalog_version += 1
+        self._section_memo.clear()
+        cb = self.on_change
+        if cb is not None:
+            cb()
+
+    def _register_locked_entry(self, key: str, manifest: dict) -> None:
+        with self._lock:
+            self._catalog[key] = manifest
+            self._bump_catalog_locked()
+
+    def register(self, key: str, manifest: dict) -> None:
+        """Compactor hand-off after a verified upload: the bundle enters
+        the catalog (and leaves quarantine — re-compaction over the same
+        sources is the self-heal path for a corrupt object)."""
+        with self._lock:
+            healed = key in self._quarantine
+            self._quarantine.pop(key, None)
+            self._catalog[key] = manifest
+            self._bump_catalog_locked()
+        if healed:
+            with contextlib.suppress(ObjectStoreError):
+                self.store.delete(_marker_key(key))
+            log.info("cold bundle %s healed by re-compaction", key)
+
+    def quarantine(self, key: str, reason: str) -> None:
+        """Never serve this bundle again (until a verified replacement
+        lands): drop from catalog, drop its cache file, persist a
+        marker so restarts remember, and count it for the
+        ``cold_corrupt`` alert."""
+        with self._lock:
+            already = key in self._quarantine
+            self._catalog.pop(key, None)
+            self._quarantine[key] = reason
+            self._bump_catalog_locked()
+            if not already:
+                self.counters["quarantined_total"] += 1
+        self._invalidate_cache(key)
+        if not already:
+            log.warning("cold bundle %s QUARANTINED: %s", key, reason)
+            with contextlib.suppress(ObjectStoreError):
+                self.store.put(
+                    _marker_key(key),
+                    json.dumps({"key": key, "reason": reason}).encode(),
+                )
+
+    # -- bounded, digest-checked local bundle cache --------------------------
+    def _cache_path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, os.path.basename(key))
+
+    def _invalidate_cache(self, key: str) -> None:
+        with contextlib.suppress(OSError):
+            os.remove(self._cache_path(key))
+        with self._lock:
+            for pk in [p for p in self._parsed if p[0] == key]:
+                del self._parsed[pk]
+
+    def _ensure_local(self, key: str, manifest: dict) -> "str | None":
+        """The bundle's bytes on local disk, downloading (and digest-
+        checking) on miss.  None = store unreachable (degrade) or the
+        bundle failed verification (quarantined)."""
+        path = self._cache_path(key)
+        if _cache_file_ok(path, manifest):
+            with self._lock:
+                self.counters["cache_hits"] += 1
+            with contextlib.suppress(OSError):
+                os.utime(path)  # LRU recency
+            return path
+        with self._io_lock:  # tpulint: allow[blocking-under-lock] dedicated cache-download lock: serializes fetches only; catalog reads ride _lock, never this
+            # re-check under the lock: a racing fetch may have landed it
+            if _cache_file_ok(path, manifest):
+                return path
+            try:
+                data = self.store.get(key)
+            except ObjectStoreError as e:
+                self._mark_unreachable(str(e))
+                return None
+            try:
+                parse_bundle(data, verify_digest=True)
+            except BundleError as e:
+                self.quarantine(key, str(e))
+                return None
+            try:
+                os.makedirs(self.cache_dir, exist_ok=True)
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError as e:
+                # cache volume trouble: the parsed data is still good,
+                # but without a cache file section reads can't seek —
+                # degrade this read
+                with self._lock:
+                    self.last_error = f"bundle cache write failed: {e}"
+                return None
+            with self._lock:
+                self.counters["bundle_fetches"] += 1
+        self._evict_cache(keep=os.path.basename(path))
+        return path
+
+    def _evict_cache(self, keep: str) -> None:
+        """Drop oldest cached bundles until the cache fits its budget
+        (the just-used file always survives)."""
+        try:
+            names = [
+                n for n in os.listdir(self.cache_dir)
+                if n.endswith(BUNDLE_SUFFIX)
+            ]
+            entries = []
+            total = 0
+            for n in names:
+                full = os.path.join(self.cache_dir, n)
+                st = os.stat(full)
+                entries.append((st.st_mtime, st.st_size, n, full))
+                total += st.st_size
+            entries.sort()
+            for _mt, sz, n, full in entries:
+                if total <= self.cache_max_bytes or n == keep:
+                    continue
+                with contextlib.suppress(OSError):
+                    os.remove(full)
+                    total -= sz
+                    with self._lock:
+                        self.counters["cache_evictions"] += 1
+        except OSError:
+            return
+
+    # -- section access ------------------------------------------------------
+    def _sections_for(self, rec_type: int, tier_ms: int,
+                      start_ms: int, end_ms: int) -> list:
+        """Manifest-level sparse-index scan: every (bundle key,
+        manifest, section) of the type/tier intersecting the window.
+        Memoized per catalog version — the fleet-distribution path asks
+        the same window once per series key."""
+        memo_key = (rec_type, tier_ms, start_ms, end_ms)
+        with self._lock:
+            got = self._section_memo.get(memo_key)
+            if got is not None:
+                return got
+            catalog = list(self._catalog.items())
+        out = []
+        for key, man in catalog:
+            if man.get("t1", 0) < start_ms or man.get("t0", 0) > end_ms:
+                continue
+            for sec in man.get("sections", ()):
+                if (
+                    sec.get("type") == rec_type
+                    and sec.get("tier") == tier_ms
+                    and sec.get("t1", 0) >= start_ms
+                    and sec.get("t0", 0) <= end_ms
+                ):
+                    out.append((key, man, sec))
+        out.sort(key=lambda item: item[2].get("t0", 0))
+        with self._lock:
+            if len(self._section_memo) > 256:
+                self._section_memo.clear()
+            self._section_memo[memo_key] = out
+        return out
+
+    def _load_section(self, key: str, manifest: dict, sec: dict):
+        """Decode one section (frame-CRC-verified).  A cache-local
+        failure re-downloads once (digest-checked); a failure that
+        survives the re-download is store-side corruption →
+        quarantine.  None = unavailable (degraded or quarantined)."""
+        cache_key = (key, sec["off"])
+        with self._lock:
+            got = self._parsed.get(cache_key)
+        if got is not None:
+            return got
+        for attempt in (0, 1):
+            path = self._ensure_local(key, manifest)
+            if path is None:
+                return None
+            try:
+                with open(path, "rb") as f:
+                    f.seek(sec["off"])
+                    frame = f.read(sec["len"])
+                magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(frame, 0)
+                payload = frame[_FRAME_HDR.size : _FRAME_HDR.size + plen]
+                if (
+                    magic != _MAGIC
+                    or rec_type != sec["type"]
+                    or len(payload) != plen
+                    or zlib.crc32(payload) != crc
+                ):
+                    raise BundleError("section frame failed magic/CRC")
+                obj = _SECTION_PARSERS[rec_type](payload)
+            except (BundleError, OSError, ValueError, KeyError,
+                    struct.error) as e:
+                self._invalidate_cache(key)
+                if attempt == 0:
+                    continue  # cache bit-rot: one digest-checked refetch
+                self.quarantine(key, f"section @{sec['off']}: {e}")
+                return None
+            with self._lock:
+                if len(self._parsed) >= self._parsed_max:
+                    self._parsed.pop(next(iter(self._parsed)))
+                self._parsed[cache_key] = obj
+                self.counters[
+                    f"sections_parsed_{_SECTION_NAMES[rec_type]}"
+                ] += 1
+            return obj
+        return None
+
+    # -- query surfaces (folded in by store.py behind hot coverage) ----------
+    def rollup_window(self, tier_ms: int, key: str, col: str,
+                      start_ms: int, end_ms: int) -> list:
+        """(bucket, mn, mx, sm, cnt) quads for one series from archive
+        rollup sections intersecting the window."""
+        self.refresh()
+        quads: list = []
+        for bkey, man, sec in self._sections_for(
+            _REC_ROLLUP, tier_ms, start_ms, end_ms
+        ):
+            r = self._load_section(bkey, man, sec)
+            if r is None:
+                continue
+            quads.extend(
+                q for q in r.series_quads(key, col)
+                if q[0] + tier_ms - 1 >= start_ms and q[0] <= end_ms
+            )
+        return quads
+
+    def sketch_digests(self, tier_ms: int, key: str, col: str,
+                       start_ms: int, end_ms: int):
+        """``([(bucket_ms, digest_bytes)], covered_hi_ms)`` from archive
+        sketch sections.  ``covered_hi_ms`` is the newest source stamp
+        the loaded sections cover — the hot store's gap oracle starts
+        AFTER it, so a sketch-covered archive window is answered from
+        the sparse index alone (never a raw-section decode)."""
+        self.refresh()
+        out: list = []
+        covered_hi = 0
+        for bkey, man, sec in self._sections_for(
+            _REC_SKETCH, tier_ms, start_ms, end_ms
+        ):
+            s = self._load_section(bkey, man, sec)
+            if s is None:
+                continue
+            for b, raw in s.series(key, col):
+                if b + tier_ms - 1 >= start_ms and b <= end_ms:
+                    out.append((b, raw))
+            covered_hi = max(covered_hi, sec.get("t1", 0))
+        return out, covered_hi
+
+    def raw_points(self, key: str, col: str,
+                   start_ms: int, end_ms: int) -> list:
+        """(ts_ms, value) raw points from archive raw sections — the
+        full-fidelity read for replay over expired local history."""
+        self.refresh()
+        pts: list = []
+        for bkey, man, sec in self._sections_for(
+            _REC_BLOCK, 0, start_ms, end_ms
+        ):
+            b = self._load_section(bkey, man, sec)
+            if b is None:
+                continue
+            got = b.series_points(key, col)
+            if got is None:
+                continue
+            ts_list, vals = got
+            pts.extend(
+                (t, v) for t, v in zip(ts_list, vals)
+                if start_ms <= t <= end_ms
+            )
+        return pts
+
+    # -- horizon / coverage --------------------------------------------------
+    def earliest_ms(self, tier_ms: int = 0) -> "int | None":
+        """Oldest archived source stamp for a tier (0 = raw), from
+        manifests alone — quarantined bundles never count."""
+        lo = None
+        want = (
+            {_REC_BLOCK} if tier_ms == 0 else {_REC_ROLLUP, _REC_SKETCH}
+        )
+        with self._lock:
+            manifests = list(self._catalog.values())
+        for man in manifests:
+            for sec in man.get("sections", ()):
+                if sec.get("type") in want and sec.get("tier") == tier_ms:
+                    t0 = sec.get("t0")
+                    if t0 is not None and (lo is None or t0 < lo):
+                        lo = t0
+        return lo
+
+    def latest_ms(self) -> "int | None":
+        with self._lock:
+            t1s = [m.get("t1", 0) for m in self._catalog.values()]
+        return max(t1s) if t1s else None
+
+    def series_keys(self) -> "set[str]":
+        out: set = set()
+        with self._lock:
+            for man in self._catalog.values():
+                out.update(man.get("keys", ()))
+        return out
+
+    def series_cols(self) -> "list[str]":
+        cols: dict = {}
+        with self._lock:
+            for man in self._catalog.values():
+                for c in man.get("cols", ()):
+                    cols[c] = None
+        return list(cols)
+
+    def covers_segment(self, name: str, nbytes: int) -> bool:
+        """Is this segment file's full byte range folded into a
+        VERIFIED, non-quarantined bundle?  The reclaim gate — a dark
+        store (stale catalog) answers False and reclaim pauses rather
+        than losing data."""
+        with self._lock:
+            for man in self._catalog.values():
+                for src in man.get("sources", ()):
+                    if src.get("name") == name and src.get("bytes", 0) >= nbytes:
+                        return True
+        return False
+
+    def covered_names(self) -> "set[str]":
+        with self._lock:
+            return {
+                src.get("name")
+                for man in self._catalog.values()
+                for src in man.get("sources", ())
+            }
+
+    # -- observability / lifecycle -------------------------------------------
+    def status(self) -> dict:
+        """One dict for stats() → /api/timings / healthz / alerts."""
+        with self._lock:
+            bundles = len(self._catalog)
+            bundle_bytes = sum(
+                _bundle_size(m) for m in self._catalog.values()
+            )
+            quarantined = dict(self._quarantine)
+            out = {
+                "store": self.store.describe(),
+                "unreachable": self.unreachable,
+                "last_error": self.last_error,
+                "bundles": bundles,
+                "bundle_bytes": bundle_bytes,
+                "quarantined": len(quarantined),
+                "quarantined_keys": sorted(quarantined)[:8],
+                "earliest_ms": None,
+                "latest_ms": None,
+                **{k: v for k, v in self.counters.items()},
+            }
+        out["earliest_ms"] = self.status_earliest_ms()
+        out["latest_ms"] = self.latest_ms()
+        comp = self.compactor
+        if comp is not None:
+            out["compactor"] = comp.status()
+        return out
+
+    @property
+    def quarantined_count(self) -> int:
+        """Lock-free quarantine count (len() on a dict is atomic under
+        the GIL) — /healthz reads this without touching ``_lock``."""
+        return len(self._quarantine)
+
+    def status_earliest_ms(self) -> "int | None":
+        """Oldest archived stamp across every tier."""
+        return min(
+            (e for e in (
+                self.earliest_ms(0),
+                self.earliest_ms(60_000),
+                self.earliest_ms(600_000),
+            ) if e is not None),
+            default=None,
+        )
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def _marker_key(bundle_key: str) -> str:
+    return QUARANTINE_PREFIX + os.path.basename(bundle_key) + ".marker"
+
+
+def _bundle_size(manifest: dict) -> int:
+    """Section bytes a manifest indexes (observability sizing; the
+    manifest frame + footer add a small constant on top)."""
+    return sum(int(s.get("len", 0)) for s in manifest.get("sections", ()))
+
+
+def _cache_file_ok(path: str, manifest: dict) -> bool:
+    """Cheap cache-hit validation: the file's footer must point its
+    manifest exactly past the section bytes this manifest indexes.
+    (Full digest ran at download; per-section CRCs run at parse — a
+    bit-rotted cache file fails there and re-downloads.)"""
+    body = _bundle_size(manifest)
+    try:
+        size = os.path.getsize(path)
+        if size < body + _FOOTER.size:
+            return False
+        with open(path, "rb") as f:
+            f.seek(size - _FOOTER.size)
+            tail = f.read(_FOOTER.size)
+        if len(tail) != _FOOTER.size:
+            return False
+        moff, magic = _FOOTER.unpack(tail)
+        return magic == _FOOTER_MAGIC and moff == body
+    except OSError:
+        return False

@@ -1,0 +1,402 @@
+"""The cold-tier compactor: fold sealed segment files into verified
+archive bundles, off the seal thread (and preferably off the leader).
+
+Runs wherever the segment directory is readable — on the leader as a
+background thread, or on a **follower** pointed at the leader's
+directory (the ROADMAP posture: compaction on followers so leaders
+never pay; the object store is shared, so the leader's reclaim gate
+sees follower-built bundles through its own catalog refresh).
+
+The crash-safety protocol is upload-then-verify-then-retire, with a
+failure assumed at every arrow::
+
+    pick sealed candidates ──► stage bundle locally ──► upload with
+    decorrelated-backoff retry under a deadline ──► read the object
+    BACK and re-verify the whole-bundle digest ──► only then does the
+    bundle enter the catalog (making its source segments
+    reclaim-eligible; store.py's retention pass refuses to delete
+    anything the catalog does not cover)
+
+A SIGKILL or ENOSPC at any instant therefore leaves one of exactly two
+states: a complete, verified bundle (registered or re-discovered by
+the next catalog refresh), or an ignorable husk (a torn staging file /
+a partial object that fails its digest and is rebuilt under the same
+deterministic key).  Bundle keys are derived from the source segment
+set, so a crashed-and-restarted compaction run converges on the same
+object instead of accumulating duplicates — the coldstorm drill
+(python -m tpudash.chaos coldstorm) kill -9s this loop mid-upload,
+twice, and asserts exactly that.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+import random
+import re
+import struct
+import threading
+import time
+import zlib
+
+from tpudash.tsdb.cold import (
+    BUNDLE_PREFIX,
+    BUNDLE_SUFFIX,
+    BundleError,
+    build_bundle,
+    parse_bundle,
+)
+from tpudash.tsdb.objstore import ObjectStoreError
+from tpudash.tsdb.store import (
+    _FRAME_HDR,
+    _MAGIC,
+    _REC_BLOCK,
+    _REC_ROLLUP,
+    _REC_SKETCH,
+    _parse_block,
+    _parse_rollup,
+    _parse_sketch,
+)
+
+log = logging.getLogger(__name__)
+
+_SEG_NAME = re.compile(r"^(raw|1m|10m)-(\d{6})\.seg$")
+#: dead staging files older than this are crash husks → swept
+_STAGE_GRACE_S = 3600.0
+#: decorrelated-jitter backoff bounds for upload retries, seconds
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 5.0
+
+
+class Compactor:
+    """Background folding of sealed segments into archive bundles.
+
+    ``source_dir`` is a segment directory (own, or a leader's — only
+    ever read); ``cold`` is the :class:`~tpudash.tsdb.cold.ColdTier`
+    sharing the target store.  ``include_tail`` additionally folds each
+    tier's current append target — only safe against a CLOSED store
+    (the one-shot CLI / drill path)."""
+
+    def __init__(
+        self,
+        source_dir: str,
+        cold,
+        interval_s: float = 300.0,
+        min_age_s: float = 0.0,
+        max_bundle_bytes: int = 64 << 20,
+        upload_deadline_s: float = 120.0,
+        include_tail: bool = False,
+        stage_dir: str = "",
+    ) -> None:
+        self.source_dir = source_dir
+        self.cold = cold
+        self.interval_s = max(1.0, float(interval_s))
+        self.min_age_s = max(0.0, float(min_age_s))
+        self.max_bundle_bytes = max(1 << 20, int(max_bundle_bytes))
+        self.upload_deadline_s = max(1.0, float(upload_deadline_s))
+        self.include_tail = bool(include_tail)
+        self.stage_dir = stage_dir or os.path.join(cold.cache_dir, "stage")
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._rng = random.Random(os.getpid())
+        self.runs = 0
+        self.bundles_written = 0
+        self.bytes_uploaded = 0
+        self.upload_retries = 0
+        self.last_error: "str | None" = None
+        self.last_run_ts: "float | None" = None
+        self.last_summary: "dict | None" = None
+        cold.compactor = self
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tsdb-compact", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception as e:  # noqa: BLE001 — the compaction loop must survive one bad pass  # tpulint: allow[broad-except] background cadence: one failed pass logs, the next retries
+                self.last_error = str(e)
+                log.warning("cold compaction pass failed: %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
+        self._thread = None
+
+    # -- one pass ------------------------------------------------------------
+    def run_once(self) -> dict:
+        """One compaction pass.  Returns a summary dict (also kept on
+        ``last_summary`` for status())."""
+        t0 = time.perf_counter()
+        summary = {
+            "candidates": 0,
+            "bundles_written": 0,
+            "sections": 0,
+            "bytes_uploaded": 0,
+            "upload_retries": 0,
+            "gave_up": 0,
+            "skipped": None,
+            "duration_ms": 0.0,
+        }
+        self.runs += 1
+        self.last_run_ts = time.time()  # tpulint: allow[wall-clock] operator-facing "last ran at" stamp
+        self._sweep_stage()
+        self.cold.refresh(force=True)
+        if self.cold.unreachable:
+            summary["skipped"] = "store unreachable"
+            self.last_summary = summary
+            return summary
+        groups = self._candidate_groups(summary)
+        for group in groups:
+            if self._stop.is_set():
+                break
+            folded = self._fold(group)
+            if folded is None:
+                continue
+            sections, sources, keys, cols = folded
+            now_ms = int(time.time() * 1000)  # tpulint: allow[wall-clock] manifests carry epoch stamps
+            data, manifest = build_bundle(
+                sections, sources, now_ms, keys, cols
+            )
+            key = BUNDLE_PREFIX + _bundle_name(manifest, sources)
+            staged = self._stage(key, data)
+            ok = self._upload_verify(key, data, manifest, summary)
+            if staged:
+                with contextlib.suppress(OSError):
+                    os.remove(staged)
+            if not ok:
+                summary["gave_up"] += 1
+                continue
+            self.cold.register(key, manifest)
+            summary["bundles_written"] += 1
+            summary["sections"] += len(sections)
+            summary["bytes_uploaded"] += len(data)
+            self.bundles_written += 1
+            self.bytes_uploaded += len(data)
+            log.info(
+                "cold bundle %s: %d section(s), %d bytes from %d segment(s)",
+                key, len(sections), len(data), len(sources),
+            )
+        summary["duration_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        self.last_summary = summary
+        return summary
+
+    def _candidate_groups(self, summary: dict) -> "list[list[tuple]]":
+        """Sealed, aged, not-yet-covered segment files, grouped into
+        bundle-sized sets.  Each tier's highest-sequence file is the
+        live append target — excluded unless ``include_tail``."""
+        try:
+            names = sorted(os.listdir(self.source_dir))
+        except OSError as e:
+            summary["skipped"] = f"source dir unreadable: {e}"
+            return []
+        per_tier: "dict[str, list]" = {}
+        for n in names:
+            m = _SEG_NAME.match(n)
+            if m:
+                per_tier.setdefault(m.group(1), []).append(
+                    (int(m.group(2)), n)
+                )
+        now = time.time()  # tpulint: allow[wall-clock] segment age gating compares file mtimes
+        candidates: "list[tuple]" = []
+        for tier, entries in per_tier.items():
+            entries.sort()
+            if not self.include_tail:
+                entries = entries[:-1]  # the live append target
+            for _seq, name in entries:
+                full = os.path.join(self.source_dir, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue  # reclaimed between listdir and stat
+                if st.st_size <= 0:
+                    continue
+                if self.min_age_s and now - st.st_mtime < self.min_age_s:
+                    continue
+                if self.cold.covers_segment(name, st.st_size):
+                    continue
+                candidates.append((tier, name, full, int(st.st_size)))
+        summary["candidates"] = len(candidates)
+        groups: "list[list[tuple]]" = []
+        cur: "list[tuple]" = []
+        cur_bytes = 0
+        for item in candidates:
+            if cur and cur_bytes + item[3] > self.max_bundle_bytes:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(item)
+            cur_bytes += item[3]
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def _fold(self, group: "list[tuple]"):
+        """Parse every complete CRC-framed record out of the group's
+        segment files into bundle sections.  A torn tail ends a file's
+        content (the hot loader drops it the same way) but the file
+        still counts as fully folded — unreadable garbage is not a
+        reason to hold its reclaim hostage forever."""
+        sections: list = []
+        sources: list = []
+        keys: set = set()
+        cols: set = set()
+        for _tier, name, full, size in group:
+            try:
+                with open(full, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                log.warning("cold compaction: %s unreadable: %s", full, e)
+                continue
+            off = 0
+            while off + _FRAME_HDR.size <= len(data):
+                magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, off)
+                payload = data[off + _FRAME_HDR.size
+                               : off + _FRAME_HDR.size + plen]
+                if (
+                    magic != _MAGIC
+                    or len(payload) != plen
+                    or zlib.crc32(payload) != crc
+                ):
+                    break  # torn tail / corruption: sealed prefix only
+                try:
+                    if rec_type == _REC_BLOCK:
+                        b = _parse_block(payload)
+                        sections.append((rec_type, 0, b.t0, b.t1, payload))
+                        keys.update(b.keys)
+                        cols.update(b.cols)
+                    elif rec_type == _REC_ROLLUP:
+                        r = _parse_rollup(payload)
+                        sections.append(
+                            (rec_type, r.tier_ms, r.src_t0, r.src_t1, payload)
+                        )
+                        keys.update(r.keys)
+                        cols.update(r.cols)
+                    elif rec_type == _REC_SKETCH:
+                        s = _parse_sketch(payload)
+                        sections.append(
+                            (rec_type, s.tier_ms, s.src_t0, s.src_t1, payload)
+                        )
+                        cols.update(s.cols)
+                        keys.update(
+                            k for k in s.keys if not str(k).startswith("__")
+                        )
+                    # unknown record types (newer writer): skipped — the
+                    # sparse index must only promise sections it can
+                    # name, and the live segment set still holds them
+                except (ValueError, KeyError, struct.error) as e:
+                    log.warning(
+                        "cold compaction: %s record @%d unparseable (%s); "
+                        "stopping this file", full, off, e,
+                    )
+                    break
+                off += _FRAME_HDR.size + plen
+            sources.append({"name": name, "bytes": size})
+        if not sections:
+            return None
+        return sections, sources, keys, cols
+
+    # -- staging + upload ----------------------------------------------------
+    def _stage(self, key: str, data: bytes) -> "str | None":
+        """Bundle bytes to local disk before the upload — a crash mid-
+        build can then never leave a half-written object as the only
+        copy, and the husk a kill -9 leaves here is swept by age."""
+        try:
+            os.makedirs(self.stage_dir, exist_ok=True)
+            path = os.path.join(
+                self.stage_dir, os.path.basename(key) + ".staging"
+            )
+            with open(path, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            return path
+        except OSError as e:
+            # staging is belt-and-braces; ENOSPC here must not block the
+            # upload (the object store is the durable copy)
+            log.warning("cold staging failed (%s); uploading unstaged", e)
+            return None
+
+    def _sweep_stage(self) -> None:
+        try:
+            names = os.listdir(self.stage_dir)
+        except OSError:
+            return
+        now = time.time()  # tpulint: allow[wall-clock] husk sweeping compares file mtimes
+        for n in names:
+            full = os.path.join(self.stage_dir, n)
+            with contextlib.suppress(OSError):
+                if now - os.path.getmtime(full) > _STAGE_GRACE_S:
+                    os.remove(full)
+
+    def _upload_verify(
+        self, key: str, data: bytes, manifest: dict, summary: dict
+    ) -> bool:
+        """PUT + digest read-back under the deadline, decorrelated-
+        jitter backoff between attempts.  False = gave up this pass
+        (the deterministic key makes the next pass idempotent)."""
+        deadline = time.monotonic() + self.upload_deadline_s
+        sleep_s = _BACKOFF_BASE_S
+        while True:
+            try:
+                self.cold.store.put(key, data)
+                back = self.cold.store.get(key)
+                got = parse_bundle(back, verify_digest=True)
+                if len(back) != len(data) or got.get("digest") != manifest["digest"]:
+                    raise BundleError("read-back returned a different bundle")
+                return True
+            except (ObjectStoreError, BundleError) as e:
+                self.last_error = str(e)
+                summary["upload_retries"] += 1
+                self.upload_retries += 1
+                # a torn object must not linger under the final key
+                # looking complete to a lister (delete is best-effort;
+                # the digest read-back is what actually protects readers)
+                with contextlib.suppress(ObjectStoreError):
+                    self.cold.store.delete(key)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    log.warning(
+                        "cold upload of %s gave up under its deadline: %s",
+                        key, e,
+                    )
+                    return False
+                time.sleep(min(remaining, sleep_s))
+                sleep_s = min(
+                    _BACKOFF_CAP_S,
+                    self._rng.uniform(_BACKOFF_BASE_S, sleep_s * 3),
+                )
+
+    def status(self) -> dict:
+        return {
+            "source": self.source_dir,
+            "running": self._thread is not None and self._thread.is_alive(),
+            "interval_s": self.interval_s,
+            "runs": self.runs,
+            "bundles_written": self.bundles_written,
+            "bytes_uploaded": self.bytes_uploaded,
+            "upload_retries": self.upload_retries,
+            "last_run_ts": self.last_run_ts,
+            "last_error": self.last_error,
+            "last_summary": self.last_summary,
+        }
+
+
+def _bundle_name(manifest: dict, sources: "list[dict]") -> str:
+    """Deterministic bundle object name from the source segment set —
+    a re-run after any crash converges on the same key."""
+    h = hashlib.sha256(
+        "|".join(f"{s['name']}:{s['bytes']}" for s in sources).encode()
+    ).hexdigest()[:12]
+    return f"bundle-{manifest['t0']}-{manifest['t1']}-{h}{BUNDLE_SUFFIX}"

@@ -1,0 +1,249 @@
+"""Pluggable object store for the cold tier: put/get/list/delete over
+opaque keys, with the filesystem backend first.
+
+Why an interface at all: the cold tier's crash-safety protocol
+(stage → upload → digest read-back → only then retire local segments,
+tpudash/tsdb/compact.py) is the hard part; the transport is not.  The
+:class:`ObjectStore` surface is the minimal contract that protocol
+needs — atomicity is deliberately NOT part of it (real object stores
+tear, time out, and go dark), which is why every consumer verifies
+what it reads instead of trusting what it wrote.
+
+The :class:`FilesystemStore` backend keeps the dependency-free
+constraint (a directory is the bucket) and carries **injectable fault
+hooks** (:class:`FaultPlan`) so the chaos drills can produce the
+failures a real store produces: torn uploads (a non-atomic backend
+dying mid-PUT), transient errors, and a fully dark endpoint.  An S3/GCS
+backend registers its scheme via :func:`register_backend` without this
+module growing an SDK import.
+
+Every backend error surfaces as :class:`ObjectStoreError` — callers
+handle exactly one exception type, and nothing here ever raises into a
+query path (the cold tier catches, degrades, and marks itself
+unreachable; see tpudash/tsdb/cold.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+#: staged-upload prefix FilesystemStore writes through; a crash mid-put
+#: leaves one of these — listings never surface them (ignorable husks)
+_TMP_PREFIX = ".put-"
+
+
+class ObjectStoreError(Exception):
+    """A store operation failed (transport, backend, or injected fault).
+    The cold tier treats every instance the same way: retry under the
+    deadline, then degrade — never crash, never serve a guess."""
+
+
+class FaultPlan:
+    """Injectable fault hooks for chaos drills and tests.  Mutated by
+    the test/drill thread, read by store operations; plain attribute
+    writes are atomic enough for the drills' purposes."""
+
+    def __init__(self) -> None:
+        #: every operation raises (endpoint unreachable / auth dead)
+        self.dark = False
+        #: next N puts raise AFTER writing a torn prefix to the final
+        #: key — the non-atomic-backend crash a digest read-back catches
+        self.torn_puts = 0
+        #: next N puts raise without writing anything (transient 5xx)
+        self.fail_puts = 0
+        #: next N gets raise (transient read failure)
+        self.fail_gets = 0
+        #: per-operation added latency, seconds (slows a drill's window
+        #: so kill -9 lands mid-transfer)
+        self.latency_s = 0.0
+        # observed counters (drill summaries)
+        self.puts_torn = 0
+        self.puts_failed = 0
+        self.gets_failed = 0
+
+    def _gate(self, op: str) -> None:
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+        if self.dark:
+            raise ObjectStoreError(f"injected fault: store dark ({op})")
+
+
+class ObjectStore:
+    """Abstract key→bytes store.  Keys are ``/``-separated relative
+    paths (``bundles/bundle-....tdb``); values are immutable once
+    written (overwrite = replace whole object)."""
+
+    scheme = ""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, start: int = 0, length: "int | None" = None) -> bytes:
+        raise NotImplementedError
+
+    def size(self, key: str) -> int:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> "list[str]":
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover — backends with handles
+        return
+
+    def describe(self) -> str:
+        return f"{self.scheme}://"
+
+
+def _check_key(key: str) -> str:
+    """Refuse absolute/escaping keys before they touch a filesystem."""
+    if not key or key.startswith(("/", "\\")) or ".." in key.split("/"):
+        raise ObjectStoreError(f"invalid object key {key!r}")
+    return key
+
+
+class FilesystemStore(ObjectStore):
+    """A directory as the bucket.  Writes go through a same-directory
+    temp file + ``os.replace`` so an OS-level crash cannot tear a PUT —
+    but consumers must NOT rely on that: the :class:`FaultPlan` torn-put
+    hook (and any real remote backend) produces exactly the partial
+    object the digest read-back protocol exists to catch."""
+
+    scheme = "file"
+
+    def __init__(self, root: str, faults: "FaultPlan | None" = None) -> None:
+        self.root = root
+        self.faults = faults or FaultPlan()
+        # create the bucket up front: a fresh spec must list as EMPTY,
+        # not unreachable.  A root that later VANISHES (unmounted
+        # volume) still errors — that distinction is the dark-store
+        # signal, so no exist_ok-style suppression beyond this point.
+        with contextlib.suppress(OSError):
+            os.makedirs(root, exist_ok=True)
+        #: serializes multi-writer puts to one key (compactor re-upload
+        #: racing a verify read is resolved by the digest check, not here)
+        self._put_lock = threading.Lock()
+
+    def _full(self, key: str) -> str:
+        return os.path.join(self.root, _check_key(key))
+
+    def put(self, key: str, data: bytes) -> None:
+        f = self.faults
+        f._gate("put")
+        if f.fail_puts > 0:
+            f.fail_puts -= 1
+            f.puts_failed += 1
+            raise ObjectStoreError("injected fault: put failed")
+        full = self._full(key)
+        try:
+            with self._put_lock:  # tpulint: allow[blocking-under-lock] dedicated object-PUT lock: serializes writers only; reads never take it
+                os.makedirs(os.path.dirname(full) or self.root, exist_ok=True)
+                if f.torn_puts > 0:
+                    f.torn_puts -= 1
+                    f.puts_torn += 1
+                    # the non-atomic backend dying mid-transfer: half the
+                    # bytes land on the FINAL key, then the "connection"
+                    # drops — read-back verification must catch this
+                    with open(full, "wb") as out:
+                        out.write(data[: max(1, len(data) // 2)])
+                        out.flush()
+                        os.fsync(out.fileno())
+                    raise ObjectStoreError("injected fault: torn put")
+                tmp = os.path.join(
+                    os.path.dirname(full),
+                    f"{_TMP_PREFIX}{os.path.basename(full)}.{os.getpid()}",
+                )
+                with open(tmp, "wb") as out:
+                    out.write(data)
+                    out.flush()
+                    os.fsync(out.fileno())
+                os.replace(tmp, full)
+        except OSError as e:
+            raise ObjectStoreError(f"put {key}: {e}") from e
+
+    def get(self, key: str, start: int = 0, length: "int | None" = None) -> bytes:
+        f = self.faults
+        f._gate("get")
+        if f.fail_gets > 0:
+            f.fail_gets -= 1
+            f.gets_failed += 1
+            raise ObjectStoreError("injected fault: get failed")
+        try:
+            with open(self._full(key), "rb") as fin:
+                if start:
+                    fin.seek(start)
+                return fin.read() if length is None else fin.read(length)
+        except OSError as e:
+            raise ObjectStoreError(f"get {key}: {e}") from e
+
+    def size(self, key: str) -> int:
+        self.faults._gate("size")
+        try:
+            return os.path.getsize(self._full(key))
+        except OSError as e:
+            raise ObjectStoreError(f"size {key}: {e}") from e
+
+    def list(self, prefix: str = "") -> "list[str]":
+        self.faults._gate("list")
+        try:
+            if not os.path.isdir(self.root):
+                raise ObjectStoreError(f"list: store root {self.root} missing")
+            out: "list[str]" = []
+            for dirpath, _dirs, names in os.walk(self.root):
+                rel = os.path.relpath(dirpath, self.root)
+                rel = "" if rel == "." else rel.replace(os.sep, "/") + "/"
+                for n in names:
+                    if n.startswith(_TMP_PREFIX):
+                        continue  # crash husk from a torn local put
+                    key = rel + n
+                    if key.startswith(prefix):
+                        out.append(key)
+            return sorted(out)
+        except OSError as e:
+            raise ObjectStoreError(f"list {prefix!r}: {e}") from e
+
+    def delete(self, key: str) -> None:
+        self.faults._gate("delete")
+        with contextlib.suppress(OSError):
+            os.remove(self._full(key))
+
+    def describe(self) -> str:
+        return f"file://{self.root}"
+
+
+#: scheme → factory(rest_of_spec) registry; the filesystem backend is
+#: built in, remote backends register here at import time
+_BACKENDS: "dict[str, object]" = {}
+
+
+def register_backend(scheme: str, factory) -> None:
+    """Make ``scheme://...`` specs resolvable by :func:`open_store` —
+    the plug point for an S3/GCS backend living outside this module."""
+    _BACKENDS[scheme] = factory
+
+
+def open_store(spec: str) -> ObjectStore:
+    """Resolve a ``TPUDASH_COLD_STORE`` spec to a backend: a bare path
+    or ``file:///path`` opens a :class:`FilesystemStore`; other schemes
+    go through :func:`register_backend`.  Raises ``ValueError`` on an
+    unknown scheme — a typo'd spec must fail at startup, not at the
+    first upload."""
+    if not spec:
+        raise ValueError("empty object-store spec")
+    if "://" in spec:
+        scheme, rest = spec.split("://", 1)
+        if scheme == "file":
+            return FilesystemStore(rest or "/")
+        factory = _BACKENDS.get(scheme)
+        if factory is None:
+            raise ValueError(
+                f"unknown object-store scheme {scheme!r} "
+                "(built-in: file://; others via register_backend)"
+            )
+        return factory(rest)
+    return FilesystemStore(spec)

@@ -305,7 +305,7 @@ def range_query(
             series[col] = _aggregate_quads(
                 quads, start_ms, step_ms or tier, agg
             )
-    return {
+    out = {
         "series": series,
         "resolution": win["resolution"],
         "start_s": start_ms / 1000.0,
@@ -313,3 +313,15 @@ def range_query(
         "step_s": (step_ms or 0) / 1000.0,
         "agg": agg,
     }
+    # honest degrade (the federation contract, applied to the cold
+    # tier): a window reaching below hot coverage while the object
+    # store is unreachable may be missing archived history — the answer
+    # ships what the hot tier has, flagged, never a 500 and never a
+    # silent truncation.  Checked AFTER the reads so a store that went
+    # dark mid-query still marks the result.
+    degrade = getattr(store, "cold_degrade_info", None)
+    info = degrade(start_ms) if degrade is not None else None
+    if info is not None:
+        out["partial"] = True
+        out["cold"] = info
+    return out

@@ -214,6 +214,7 @@ def take_snapshot(store, root: str, cut_head: bool = True) -> dict:
         root,
         keep=getattr(store, "snapshot_keep", 5),
         retention_s=getattr(store, "snapshot_retention_s", 0.0),
+        retire_ok=cold_retire_ok(store),
     )
     out = {
         "dir": final,
@@ -326,15 +327,55 @@ def list_snapshots(root: str) -> "list[str]":
     return out
 
 
+def cold_retire_ok(store):
+    """``retire_ok`` predicate for :func:`gc_snapshots` when ``store``
+    has a cold tier attached (None otherwise — pre-18 behaviour).
+
+    A snapshot may be deleted only when each segment file it carries
+    survives elsewhere: folded into a VERIFIED archive bundle, or still
+    present in the live directory at ≥ the snapshotted length.  A dark
+    store freezes coverage, so GC pauses; a later quarantine re-opens
+    the gate and the snapshot survives as the recovery copy."""
+    cold = getattr(store, "cold", None)
+    if cold is None:
+        return None
+
+    def _ok(snap_dir: str) -> bool:
+        try:
+            files = read_manifest(snap_dir).get("files", [])
+        except SnapshotError:
+            return True  # corrupt manifest: worthless as a backup
+        for e in files:
+            name, nbytes = e.get("name", ""), int(e.get("bytes", 0))
+            if cold.covers_segment(name, nbytes):
+                continue
+            try:
+                if os.path.getsize(os.path.join(store.path, name)) >= nbytes:
+                    continue
+            except OSError:
+                pass
+            return False
+        return True
+
+    return _ok
+
+
 def gc_snapshots(
-    root: str, keep: int = 5, retention_s: float = 0.0
+    root: str, keep: int = 5, retention_s: float = 0.0,
+    retire_ok=None,
 ) -> "list[str]":
     """Retention-aware snapshot GC: keep the newest ``keep`` complete
     snapshots, additionally dropping ones older than ``retention_s``
     (0 = no age limit) — but the newest complete snapshot ALWAYS
     survives (never delete the only backup).  Dead ``.snap-*.tmp``
     staging dirs past a grace period are swept too.  Returns what was
-    removed."""
+    removed.
+
+    ``retire_ok`` (optional ``path -> bool``) is the cold-tier
+    durability gate: a snapshot it vetoes is kept regardless of count
+    or age — when archives are the only long-horizon copy, retention
+    must never outrank an unverified upload (same contract as segment
+    reclaim, :meth:`TSDB._reclaim_segments`)."""
     removed: "list[str]" = []
     try:
         names = sorted(os.listdir(root))
@@ -352,6 +393,8 @@ def gc_snapshots(
                 continue
             if created < cutoff_ms:
                 victims.add(full)
+    if retire_ok is not None:
+        victims = {v for v in victims if retire_ok(v)}
     for full in sorted(victims):
         shutil.rmtree(full, ignore_errors=True)
         removed.append(full)

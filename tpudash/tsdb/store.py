@@ -321,6 +321,7 @@ class TSDB:
         snapshot_retention_s: float = 0.0,
         sketch_budget: int = 64,
         sketch_series: str = "10m",
+        cold=None,
     ) -> None:
         self.path = path
         #: quantile-sketch rollups (tpudash.analytics.sketch): centroid
@@ -394,14 +395,38 @@ class TSDB:
         self._sketches = {t: [] for t in TIERS_MS}
         # per-tier segment registries: [(seq, path, newest_t1_ms)]
         self._segs = {name: [] for name in _TIER_NAMES.values()}
+        #: cold tier (tpudash/tsdb/cold.py), attached via attach_cold:
+        #: queries fold archive bundles in behind hot coverage, and the
+        #: retention pass refuses to reclaim segments the cold tier has
+        #: not verified into a bundle
+        self.cold = None
         self._closed = False
+        if cold is not None:
+            # attached BEFORE the load-time retention pass: segments
+            # that expired while the process was down must face the
+            # reclaim gate too — attach_cold() after construction
+            # would leave a window where nothing vouches for them
+            self.attach_cold(cold)
         if path:
             self._load()
 
+    def attach_cold(self, cold) -> None:
+        """Wire a :class:`~tpudash.tsdb.cold.ColdTier` behind this
+        store.  Catalog changes bump ``version`` so range-result caches
+        (the server ETag) see newly archived history."""
+        cold.on_change = self._bump_version
+        self.cold = cold
+        self._bump_version()
+
+    def _bump_version(self) -> None:
+        with self._lock:
+            self.version += 1
+
     @classmethod
-    def from_config(cls, cfg) -> "TSDB":
+    def from_config(cls, cfg, cold=None) -> "TSDB":
         return cls(
             path=cfg.tsdb_path,
+            cold=cold,
             chunk_points=cfg.tsdb_chunk_points,
             retention_raw_s=cfg.tsdb_retention_raw,
             retention_1m_s=cfg.tsdb_retention_1m,
@@ -914,11 +939,31 @@ class TSDB:
                 for entry in segs:
                     expired = entry[2] > 0 and entry[2] < cut
                     if expired and entry is not segs[-1]:
+                        if not self._cold_retire_ok(entry[1]):
+                            # the cold tier has not verified this file
+                            # into a bundle (store dark, compactor
+                            # behind): PAUSE reclaim — retention never
+                            # outranks durability
+                            keep.append(entry)
+                            continue
                         with contextlib.suppress(OSError):
                             os.remove(entry[1])
                         continue
                     keep.append(entry)
                 self._segs[tier] = keep
+
+    def _cold_retire_ok(self, path: str) -> bool:
+        """May this expired segment file be deleted?  True when no cold
+        tier is configured (pre-18 behaviour), or when a verified bundle
+        covers the file's full current byte length."""
+        cold = self.cold
+        if cold is None:
+            return True
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            return True  # already gone
+        return cold.covers_segment(os.path.basename(path), nbytes)
 
     # -- queries -------------------------------------------------------------
     def raw_window(self, key: str, col: str, start_ms: int, end_ms: int):
@@ -956,8 +1001,26 @@ class TSDB:
                 for t, m in zip(ts_list, mats)
                 if start_ms <= t <= end_ms
             )
+        cold_end = self._cold_clamp(0, start_ms, end_ms)
+        if cold_end is not None:
+            pts.extend(
+                self.cold.raw_points(key, col, start_ms, cold_end)
+            )
         pts.sort(key=lambda p: p[0])
         return pts
+
+    def _cold_clamp(self, tier_ms: int, start_ms: int,
+                    end_ms: int) -> "int | None":
+        """Right edge of the window the COLD tier should answer for, or
+        None when cold has nothing to add.  Cold serves strictly before
+        hot coverage of the tier — at the boundary the hot copy wins, so
+        a record archived while still hot is never double-counted."""
+        cold = self.cold
+        if cold is None:
+            return None
+        hot_lo = self._hot_earliest_ms(tier_ms)
+        cold_end = end_ms if hot_lo is None else min(end_ms, hot_lo - 1)
+        return cold_end if cold_end >= start_ms else None
 
     def rollup_window(self, tier_ms: int, key: str, col: str,
                       start_ms: int, end_ms: int):
@@ -983,6 +1046,18 @@ class TSDB:
                 if q[0] + tier_ms - 1 >= start_ms and q[0] <= end_ms
             )
             sealed_hi = max(sealed_hi, r.src_t1)
+        cold_end = self._cold_clamp(tier_ms, start_ms, end_ms)
+        if cold_end is not None:
+            cquads = self.cold.rollup_window(tier_ms, key, col,
+                                             start_ms, cold_end)
+            if cquads:
+                quads.extend(cquads)
+                # raw fold must start after the archived coverage, but
+                # only as far as the archives actually reach — clamping
+                # to cold_end here would silence the live head on a
+                # store whose hot rollups haven't sealed yet
+                cold_hi = max(q[0] + tier_ms - 1 for q in cquads)
+                sealed_hi = max(sealed_hi, min(cold_end, cold_hi))
         live_from = max(start_ms, sealed_hi + 1)
         if live_from <= end_ms:
             for t, v in self.raw_window(key, col, live_from, end_ms):
@@ -1051,6 +1126,32 @@ class TSDB:
                     contributed = True
                 if contributed:
                     sealed_hi = max(sealed_hi, blk.src_t1)
+        if tier_ms > 0 and self.cold is not None:
+            # archived sketch digests serve the window below hot sketch
+            # coverage — same boundary discipline as the quad fold: the
+            # hot copy wins, and sealed_hi only advances as far as the
+            # archives actually reach
+            with self._lock:
+                sk_lo = min(
+                    (s.src_t0 for s in self._sketches.get(tier, [])),
+                    default=None,
+                )
+            cold_end = end_ms if sk_lo is None else min(end_ms, sk_lo - 1)
+            if cold_end >= start_ms:
+                digs, cold_hi = self.cold.sketch_digests(
+                    tier, key, col, start_ms, cold_end
+                )
+                for b, raw in digs:
+                    if b + tier - 1 < start_ms or b > end_ms:
+                        continue
+                    try:
+                        sk = QuantileSketch.from_bytes(raw, budget)
+                    except SketchError:
+                        continue  # one bad archived cell, not a dead query
+                    out.setdefault(b, []).append(sk)
+                    covered.add(b)
+                if digs:
+                    sealed_hi = max(sealed_hi, min(cold_end, cold_hi))
         # rollup_window already folds the live raw tail into quads, so
         # it doubles as the "which buckets exist at all" oracle
         if key == ALL_KEY:
@@ -1129,6 +1230,10 @@ class TSDB:
             out.update(self._head_keys)
             for keys, _cols, _ts, _m in self._pending:
                 out.update(keys)
+        cold = self.cold
+        if cold is not None:
+            cold.refresh()
+            out.update(cold.series_keys())
         out.discard(FLEET_SERIES)
         return out
 
@@ -1145,6 +1250,10 @@ class TSDB:
             if key in keys:
                 for c in block_cols:
                     cols[c] = None
+        cold = self.cold
+        if cold is not None and key in cold.series_keys():
+            for c in cold.series_cols():
+                cols.setdefault(c, None)
         return list(cols)
 
     def point_count(self, key: str) -> int:
@@ -1161,7 +1270,9 @@ class TSDB:
                 n += len(self._head_ts)
         return n
 
-    def earliest_ms(self, tier_ms: int = 0) -> "int | None":
+    def _hot_earliest_ms(self, tier_ms: int = 0) -> "int | None":
+        """Oldest HOT coverage for a tier — the boundary below which
+        cold-tier reads take over (see :meth:`_cold_clamp`)."""
         with self._lock:
             if tier_ms == 0:
                 t0s = [b.t0 for b in self._raw]
@@ -1172,7 +1283,17 @@ class TSDB:
                 t0s = [r.src_t0 for r in self._rollups.get(tier_ms, [])]
         return min(t0s) if t0s else None
 
-    def latest_ms(self) -> "int | None":
+    def earliest_ms(self, tier_ms: int = 0) -> "int | None":
+        lo = self._hot_earliest_ms(tier_ms)
+        cold = self.cold
+        if cold is not None:
+            cold.refresh()
+            c = cold.earliest_ms(tier_ms)
+            if c is not None and (lo is None or c < lo):
+                lo = c
+        return lo
+
+    def _hot_latest_ms(self) -> "int | None":
         with self._lock:
             t1s = [b.t1 for b in self._raw]
             t1s += [ts[-1] for _k, _c, ts, _m in self._pending if ts]
@@ -1181,6 +1302,16 @@ class TSDB:
             for blocks in self._rollups.values():
                 t1s += [r.t1 for r in blocks]
         return max(t1s) if t1s else None
+
+    def latest_ms(self) -> "int | None":
+        hi = self._hot_latest_ms()
+        cold = self.cold
+        if cold is not None:
+            cold.refresh()
+            c = cold.latest_ms()
+            if c is not None and (hi is None or c > hi):
+                hi = c
+        return hi
 
     def stats(self) -> dict:
         """Observability snapshot (rides /api/timings)."""
@@ -1239,11 +1370,64 @@ class TSDB:
                 "last": self.last_snapshot,
                 "last_error": self.last_snapshot_error,
             }
-        lo = self.earliest_ms(0)
-        hi = self.latest_ms()
+        hot_lo = self._hot_earliest_ms(0)
+        hot_hi = self._hot_latest_ms()
+        # span_s keeps its pre-18 meaning (hot raw span) — migrations
+        # and tests reason about "what survived in THIS directory"
         out["span_s"] = (
-            round((hi - lo) / 1000.0, 1)
-            if lo is not None and hi is not None
+            round((hot_hi - hot_lo) / 1000.0, 1)
+            if hot_lo is not None and hot_hi is not None
             else 0.0
         )
+        cold = self.cold
+        # the TRUE queryable horizon: hot ∪ verified cold, quarantined
+        # bundles excluded (they left the catalog) — what /api/range can
+        # actually answer, not what this directory happens to hold.
+        # earliest_ms refreshes the cold catalog, so it runs BEFORE
+        # cold.status() — one stats() doc never contradicts itself
+        lo = self.earliest_ms(0)
+        for t in TIERS_MS:
+            tl = self.earliest_ms(t)
+            if tl is not None and (lo is None or tl < lo):
+                lo = tl
+        hi = self.latest_ms()
+        if cold is not None:
+            out["cold"] = cold.status()
+        out["horizon"] = {
+            "earliest_ms": lo,
+            "latest_ms": hi,
+            "hot_earliest_ms": hot_lo,
+            "cold_earliest_ms": (
+                cold.status_earliest_ms() if cold is not None else None
+            ),
+            "queryable_span_s": (
+                round((hi - lo) / 1000.0, 1)
+                if lo is not None and hi is not None
+                else 0.0
+            ),
+        }
         return out
+
+    def cold_degrade_info(self, start_ms: int) -> "dict | None":
+        """Non-None when a query window starting at ``start_ms`` may be
+        missing archived history because the cold store is unreachable —
+        the signal query.py turns into ``partial: true``.  Windows fully
+        inside hot coverage answer completely and stay non-partial."""
+        cold = self.cold
+        if cold is None:
+            return None
+        cold.refresh()
+        if not cold.unreachable:
+            return None
+        hot_lo = self._hot_earliest_ms(0)
+        for t in TIERS_MS:
+            tl = self._hot_earliest_ms(t)
+            if tl is not None and (hot_lo is None or tl < hot_lo):
+                hot_lo = tl
+        if hot_lo is not None and start_ms >= hot_lo:
+            return None
+        return {
+            "cold_unreachable": True,
+            "hot_earliest_ms": hot_lo,
+            "error": cold.last_error,
+        }
