@@ -1,0 +1,672 @@
+"""The exception-contract analyzer analyzed (ISSUE 19): every
+boundcheck static rule proven on known-bad and known-good fixtures
+(direct raises, raise-from conversion, passthrough re-raise,
+interprocedural escape through helpers, ``contextlib.suppress``, the
+struct/json/int intrinsics and the unpack-of-pack exemption), the allow
+mechanism exercised, a planted non-contract decoder caught end-to-end
+through the CLI, the wireids registry's duplicate refusal, the fuzzer's
+seed determinism and corpus coverage, the clean-tree gates (static and
+fuzz), and the regression pins for every boundary hardened in this PR —
+each with the offending bytes that used to escape the contract.
+"""
+
+import asyncio
+import struct
+import textwrap
+
+import pytest
+
+from tpudash.analysis.boundcheck import (
+    BOUNDARIES,
+    RULE_BROAD,
+    RULE_ESCAPE,
+    RULE_STALE,
+    RULE_UNCHECKED,
+    RULE_WIRE_ID,
+    Boundary,
+    check_paths,
+    check_source,
+    main as boundcheck_main,
+    run_fuzz,
+)
+
+#: one decode boundary in the fixture module ``tpudash.mod`` whose
+#: contract is the fixture's own WireError subclass of ValueError
+FIX = (Boundary("tpudash.mod", "decode", ("WireError",)),)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def check(source, boundaries=FIX, path="pkg/tpudash/mod.py"):
+    return check_source(textwrap.dedent(source), path, boundaries)
+
+
+# -- rule: boundary-escape ----------------------------------------------------
+
+
+def test_escape_flags_direct_noncontract_raise():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(buf):
+            if not buf:
+                raise KeyError("empty")
+            return buf
+        """
+    )
+    assert rules_of(findings) == [RULE_ESCAPE]
+    assert findings[0].line == 5
+    assert "KeyError" in findings[0].message
+    assert "WireError" in findings[0].message
+
+
+def test_escape_clean_on_contract_and_subclass_raises():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        class TruncatedError(WireError):
+            pass
+
+        def decode(buf):
+            if not buf:
+                raise TruncatedError("empty")
+            if buf[0] != 1:
+                raise WireError("bad version")
+            return buf
+        """
+    )
+    assert findings == []
+
+
+def test_escape_clean_when_raise_from_converts():
+    findings = check(
+        """
+        import struct
+
+        class WireError(ValueError):
+            pass
+
+        def decode(buf):
+            try:
+                (n,) = struct.unpack("<I", buf[:4])
+            except struct.error as e:
+                raise WireError(str(e)) from e
+            return n
+        """
+    )
+    assert findings == []
+
+
+def test_escape_flags_struct_unpack_intrinsic():
+    findings = check(
+        """
+        import struct
+
+        class WireError(ValueError):
+            pass
+
+        def decode(buf):
+            (n,) = struct.unpack("<I", buf[:4])
+            return n
+        """
+    )
+    assert rules_of(findings) == [RULE_ESCAPE]
+    assert "struct.error" in findings[0].message
+
+
+def test_escape_exempts_unpack_of_pack_bitcast():
+    # the length of pack() output is statically fixed — a bit-cast
+    # round-trip cannot fail on input length, so no struct.error escape
+    findings = check(
+        """
+        import struct
+
+        class WireError(ValueError):
+            pass
+
+        def decode(buf):
+            (x,) = struct.unpack("<d", struct.pack("<Q", 7))
+            return x
+        """
+    )
+    assert findings == []
+
+
+def test_escape_passthrough_reraise_still_escapes():
+    # ``except IndexError: raise`` re-raises the same exception — it
+    # must NOT count as a guard that subtracts IndexError
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def _helper(b):
+            if not b:
+                raise IndexError("x")
+
+        def decode(b):
+            try:
+                _helper(b)
+            except IndexError:
+                raise
+            return b
+        """
+    )
+    assert rules_of(findings) == [RULE_ESCAPE]
+    assert "IndexError" in findings[0].message
+
+
+def test_escape_interprocedural_through_helper():
+    bad = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def _helper(b):
+            if not b:
+                raise IndexError("x")
+
+        def decode(b):
+            _helper(b)
+            return b
+        """
+    )
+    assert rules_of(bad) == [RULE_ESCAPE]
+    good = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def _helper(b):
+            if not b:
+                raise IndexError("x")
+
+        def decode(b):
+            try:
+                _helper(b)
+            except IndexError as e:
+                raise WireError("truncated") from e
+            return b
+        """
+    )
+    assert good == []
+
+
+def test_escape_contextlib_suppress_is_a_guard():
+    findings = check(
+        """
+        import contextlib
+
+        class WireError(ValueError):
+            pass
+
+        def _helper(b):
+            raise IndexError("x")
+
+        def decode(b):
+            with contextlib.suppress(IndexError):
+                _helper(b)
+            return b
+        """
+    )
+    assert findings == []
+
+
+def test_escape_json_loads_intrinsic_vs_contract():
+    src = """
+        import json
+
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            return json.loads(b)
+        """
+    # JSONDecodeError and UnicodeDecodeError are ValueErrors but not
+    # WireErrors — flagged against the narrow contract...
+    assert rules_of(check(src)) == [RULE_ESCAPE]
+    # ...and conformant against a ValueError contract
+    wide = (Boundary("tpudash.mod", "decode", ("ValueError",)),)
+    assert check(src, boundaries=wide) == []
+
+
+def test_escape_int_conversion_intrinsic():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(d):
+            return int(d["x"])
+        """,
+        boundaries=(Boundary("tpudash.mod", "decode", ("ValueError",)),),
+    )
+    assert rules_of(findings) == [RULE_ESCAPE]
+    assert "TypeError" in findings[0].message
+
+
+def test_escape_allow_marker_silences():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(buf):  # tpulint: allow[boundary-escape] legacy shim
+            raise KeyError("empty")
+        """
+    )
+    assert findings == []
+
+
+# -- rule: unchecked-boundary-call --------------------------------------------
+
+
+def test_unchecked_flags_unguarded_loop_call():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def drain(items):
+            out = []
+            for it in items:
+                out.append(decode(it))
+            return out
+        """
+    )
+    assert rules_of(findings) == [RULE_UNCHECKED]
+    assert "WireError" in findings[0].message
+
+
+def test_unchecked_clean_when_loop_catches_contract():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def drain(items):
+            out = []
+            for it in items:
+                try:
+                    out.append(decode(it))
+                except WireError:
+                    continue
+            return out
+        """
+    )
+    assert findings == []
+
+
+def test_unchecked_single_call_outside_loop_is_fine():
+    # a one-shot call site may legitimately let the contract propagate;
+    # only fan-in loops (one bad item fails the batch) are flagged
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def fetch_one(b):
+            return decode(b)
+        """
+    )
+    assert findings == []
+
+
+# -- rule: contract-too-broad -------------------------------------------------
+
+
+def test_broad_flags_except_exception_around_boundary():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def fetch(b):
+            try:
+                return decode(b)
+            except Exception:
+                return None
+        """
+    )
+    assert rules_of(findings) == [RULE_BROAD]
+    assert "WireError" in findings[0].message
+
+
+def test_broad_clean_when_catching_contract_type():
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def fetch(b):
+            try:
+                return decode(b)
+            except WireError:
+                return None
+        """
+    )
+    assert findings == []
+
+
+def test_broad_passthrough_handler_not_flagged():
+    # ``except Exception: raise`` around a boundary re-raises — it
+    # swallows nothing, so it is not a broad catch
+    findings = check(
+        """
+        class WireError(ValueError):
+            pass
+
+        def decode(b):
+            if not b:
+                raise WireError("empty")
+            return b
+
+        def fetch(b):
+            try:
+                return decode(b)
+            except Exception:
+                raise
+        """
+    )
+    assert findings == []
+
+
+# -- rule: stale-boundary -----------------------------------------------------
+
+
+def test_stale_registry_entry_flagged():
+    findings = check(
+        """
+        def decode(b):
+            return b
+        """,
+        boundaries=(Boundary("tpudash.mod", "decode_gone", ("ValueError",)),),
+    )
+    assert rules_of(findings) == [RULE_STALE]
+    assert "decode_gone" in findings[0].message
+
+
+# -- rule: wire-id-unregistered -----------------------------------------------
+
+
+def test_wire_id_literal_outside_wireids_flagged():
+    findings = check(
+        """
+        TDB1_KIND_SHINY = 9
+        """,
+        boundaries=(),
+    )
+    assert rules_of(findings) == [RULE_WIRE_ID]
+    assert "TDB1_KIND_SHINY" in findings[0].message
+
+
+def test_wire_id_import_from_registry_clean():
+    findings = check(
+        """
+        from tpudash import wireids
+
+        KIND_DELTA = wireids.TDB1_KIND_DELTA
+        MAX_POINTS = 4096
+        """,
+        boundaries=(),
+    )
+    assert findings == []
+
+
+def test_wire_id_literals_allowed_inside_wireids_module():
+    findings = check(
+        """
+        TDB1_KIND_SHINY = 9
+        """,
+        boundaries=(),
+        path="pkg/tpudash/wireids.py",
+    )
+    assert findings == []
+
+
+# -- the wireids registry itself ----------------------------------------------
+
+
+def test_wireids_freeze_refuses_duplicate_ids():
+    from tpudash import wireids
+
+    with pytest.raises(ValueError, match="duplicate"):
+        wireids._freeze(((1, "a"), (1, "b")), "test kind")
+    # the shipped tables froze cleanly at import and cover every id
+    assert wireids.TDB1_KINDS[wireids.TDB1_KIND_DELTA] == "delta"
+    assert wireids.TSB1_RECORD_TYPES[wireids.TSB1_REC_SKETCH] == "sketch"
+    assert wireids.BUS_PROTO in wireids.BUS_PROTO_COMPAT
+
+
+# -- end-to-end: planted non-contract decoder through the CLI -----------------
+
+_WIRE_QUALS = [
+    b.qual for b in BOUNDARIES if b.module == "tpudash.app.wire"
+]
+
+
+def _planted_wire_module(bad: bool) -> str:
+    body = ["class WireError(ValueError):", "    pass", ""]
+    for q in _WIRE_QUALS:
+        body.append(f"def {q}(buf):")
+        if bad and q == "split_container":
+            body.append('    raise KeyError("planted non-contract escape")')
+        else:
+            body.append('    raise WireError("nope")')
+        body.append("")
+    return "\n".join(body)
+
+
+def test_planted_noncontract_decoder_caught_end_to_end(tmp_path, capsys):
+    pkg = tmp_path / "tpudash" / "app"
+    pkg.mkdir(parents=True)
+    mod = pkg / "wire.py"
+    mod.write_text(_planted_wire_module(bad=True))
+    assert boundcheck_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    line = 4 + 3 * _WIRE_QUALS.index("split_container")
+    assert f"{mod}:{line}: [{RULE_ESCAPE}]" in out
+    assert "KeyError" in out
+    # narrow the raise at the source and the tree is clean again
+    mod.write_text(_planted_wire_module(bad=False))
+    assert boundcheck_main([str(tmp_path)]) == 0
+
+
+def test_unified_cli_bound_exit_bit_and_json(tmp_path, capsys):
+    from tpudash.analysis.cli import EXIT_BOUND, main as analysis_main
+
+    bad = tmp_path / "proto.py"
+    bad.write_text("TE_EVT_SHINY = 9\n")
+    code = analysis_main([str(tmp_path), "--json"])
+    assert code == EXIT_BOUND
+    import json as _json
+
+    report = _json.loads(capsys.readouterr().out)
+    rows = [r for r in report["findings"] if r["analyzer"] == "boundcheck"]
+    assert rows and rows[0]["rule"] == RULE_WIRE_ID
+    assert set(rows[0]) == {"analyzer", "rule", "file", "line", "message"}
+    assert report["counts"]["boundcheck"] == len(rows)
+
+
+def test_unified_cli_rules_lists_boundcheck(capsys):
+    from tpudash.analysis.cli import main as analysis_main
+
+    assert analysis_main(["--rules"]) == 0
+    out = capsys.readouterr().out
+    assert "boundcheck:" in out
+    assert RULE_ESCAPE in out and RULE_WIRE_ID in out
+
+
+# -- clean-tree gates ---------------------------------------------------------
+
+
+def test_package_checks_clean():
+    import tpudash
+
+    pkg = tpudash.__path__[0]
+    assert check_paths([pkg]) == []
+
+
+def test_fuzz_small_pass_clean_and_covers_registry():
+    result = run_fuzz(seed=1234, mutations=4)
+    assert result["violations"] == []
+    # every fuzzable boundary's codec ran real mutations
+    wanted = {b.fuzz for b in BOUNDARIES if b.fuzz}
+    assert wanted <= set(result["stats"])
+    assert all(st["mutations"] > 0 for st in result["stats"].values())
+
+
+def test_fuzz_is_deterministic_for_a_seed():
+    a = run_fuzz(seed=7, mutations=6)
+    b = run_fuzz(seed=7, mutations=6)
+    assert a["seed"] == b["seed"] == 7
+    assert a["stats"] == b["stats"]
+    assert a["violations"] == b["violations"]
+
+
+# -- regression pins: the boundaries hardened in this PR ----------------------
+# Each fixture is the offending input shape that used to escape the
+# decoder's contract (struct.error / IndexError / OverflowError /
+# UnicodeDecodeError / MemoryError-scale allocation) before ISSUE 19.
+
+
+def test_wire_split_container_refuses_inflated_head_len():
+    from tpudash.app.wire import WireError, split_container
+    from tpudash.wireids import TDB1_MAGIC, TDB1_VERSION
+
+    doc = TDB1_MAGIC + bytes([TDB1_VERSION, 1, 0, 0]) + b"\xff\xff\xff\xff"
+    with pytest.raises(WireError):
+        split_container(doc)
+
+
+def test_gorilla_truncation_and_count_inflation_raise_valueerror():
+    from tpudash.tsdb.gorilla import (
+        decode_timestamps,
+        decode_values,
+        encode_timestamps,
+        encode_values,
+    )
+
+    ts = encode_timestamps([1000, 2000, 3000])
+    vals = encode_values([1.0, 2.0, 3.0])
+    # truncated stream: used to IndexError out of the bit reader
+    with pytest.raises(ValueError):
+        decode_timestamps(ts[:1], 3)
+    with pytest.raises(ValueError):
+        decode_values(vals[:1], 3)
+    # inflated count: refused up front, no count-proportional work
+    with pytest.raises(ValueError):
+        decode_timestamps(ts, 10**6)
+    with pytest.raises(ValueError):
+        decode_values(vals, 10**6)
+    # the honest round-trip still holds
+    assert decode_timestamps(ts, 3) == [1000, 2000, 3000]
+    assert decode_values(vals, 3) == [1.0, 2.0, 3.0]
+
+
+def test_sketch_from_bytes_truncated_and_inflated_raise_sketcherror():
+    from tpudash.analytics.sketch import QuantileSketch, SketchError
+
+    sk = QuantileSketch.from_values([float(v) for v in range(32)])
+    raw = sk.to_bytes()
+    with pytest.raises(SketchError):
+        QuantileSketch.from_bytes(raw[:3])
+    # inflate the u16 centroid count past the actual payload
+    inflated = raw[:1] + b"\xff\xff" + raw[3:]
+    with pytest.raises(SketchError):
+        QuantileSketch.from_bytes(inflated)
+    assert QuantileSketch.from_bytes(raw).count == sk.count
+
+
+def test_snapshot_manifest_frame_unreadable_raises_snapshoterror():
+    from tpudash.tsdb.snapshot import SnapshotError, parse_manifest
+
+    with pytest.raises(SnapshotError):
+        parse_manifest(b"\x00")  # too short for the TSB1 frame header
+
+
+def test_cold_bundle_malformed_raises_bundleerror():
+    from tpudash.tsdb.cold import (
+        BundleError,
+        _parse_manifest_frame,
+        parse_bundle,
+    )
+
+    with pytest.raises(BundleError):
+        parse_bundle(b"tiny")  # shorter than the TDBF footer
+    with pytest.raises(BundleError):
+        _parse_manifest_frame(b"\x00")  # used to struct.error
+
+
+def test_bus_header_invalid_utf8_raises_protocol_error():
+    # the wire fuzzer's find: json.loads on BYTES decodes utf-8 first,
+    # so a garbage header used to escape as UnicodeDecodeError
+    from tpudash.broadcast.bus import BusProtocolError, read_message
+
+    body = b'\xff\xfe{"t": "seal"}\n'
+    frame = len(body).to_bytes(4, "little") + body
+    loop = asyncio.new_event_loop()
+    try:
+        reader = asyncio.StreamReader(loop=loop)
+        reader.feed_data(frame)
+        reader.feed_eof()
+        with pytest.raises(BusProtocolError):
+            loop.run_until_complete(read_message(reader))
+    finally:
+        loop.close()
+
+
+def test_summary_huge_chip_id_raises_valueerror():
+    # the wire fuzzer's other find: a chip id like 1e308 survives int()
+    # as a 309-digit integer and used to escape as OverflowError from
+    # the int64 conversion
+    from tpudash.federation.summary import summary_to_batch
+
+    doc = {
+        "v": 1,
+        "keys": ["k"],
+        "cols": ["m"],
+        "identity": {"slice": ["s0"], "chip_id": [1e308], "host": ["h"]},
+        "matrix": [[1.0]],
+    }
+    with pytest.raises(ValueError, match="malformed"):
+        summary_to_batch("child", doc)
+
+
+def test_store_parse_block_bad_bytes_stay_in_contract():
+    from tpudash.tsdb.store import _parse_block
+
+    for raw in (b"", b"\x00", b"\xff" * 16, struct.pack("<I", 2**31)):
+        with pytest.raises((ValueError, KeyError, struct.error)):
+            _parse_block(raw)

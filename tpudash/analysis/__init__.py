@@ -40,6 +40,20 @@ enforce and review alone will not keep enforced — so this package does:
   high_water}`` on ``/api/timings`` and ``/healthz`` in every role, and
   runs in pytest behind ``TPUDASH_FDCHECK=1``.
 
+- :mod:`tpudash.analysis.boundcheck` — ``python -m
+  tpudash.analysis.boundcheck`` — untrusted-input exception contracts,
+  both halves: an interprocedural static pass computing per-function
+  exception *escape sets* over asynccheck's call graph, checked against
+  a registry (``BOUNDARIES``) declaring every wire/segment/bundle/
+  summary decoder's contract type — plus fan-in loops that call a
+  boundary unguarded, ``except Exception`` wrapped around boundary
+  calls, and wire-format id constants minted outside
+  :mod:`tpudash.wireids` — and a runtime structure-aware wire fuzzer
+  (``--fuzz``) that mutates real encoder output (seeded truncations,
+  bit flips, length inflation, CRC-resealed edits, JSON shape swaps)
+  and fails on any decode that escapes its contract, hangs, or blows
+  the time budget.  Reproducible from the printed seed.
+
 ``python -m tpudash.analysis`` runs every static analyzer as one gate
 (``--json`` for the machine-readable report; distinct exit codes per
 analyzer — see :mod:`tpudash.analysis.cli`).  All of them ship with zero

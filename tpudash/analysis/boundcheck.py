@@ -1,0 +1,1456 @@
+"""boundcheck — untrusted-input exception contracts, checked twice.
+
+Every decoder that touches bytes or JSON from outside this process —
+TDB1 containers, TE stream events, TSB1 segment records, cold-archive
+bundles, snapshot manifests, gorilla streams, sketch digests, child
+summary documents, bus messages — declares a *contract*: the one
+exception family it may raise on malformed input.  A decode boundary
+that leaks ``KeyError``/``IndexError``/``struct.error`` instead turns
+one hostile byte into a crashed refresh loop, a dead replication
+session, or a wedged compactor (PR 12's seal-window crash and PR 18's
+quarantine design both trace back to exactly this class of bug).
+
+Static half (default): reuses asynccheck's interprocedural call-graph
+index to compute, per function, the set of exception types that can
+*escape* it — local ``raise`` statements plus propagation from resolved
+callees, minus enclosing ``except`` clauses and
+``contextlib.suppress``.  ``raise X(...) from e`` counts as ``X``; a
+handler that re-raises (bare ``raise`` / ``raise e``) does not subtract
+what it catches.  Rules:
+
+- ``boundary-escape`` — a registered boundary's escape set exceeds its
+  declared contract.
+- ``unchecked-boundary-call`` — a fan-in loop calls a boundary without
+  catching its contract (one bad item fails the whole batch).
+- ``contract-too-broad`` — ``except Exception`` directly around a
+  boundary call (swallows real bugs along with malformed input).
+- ``stale-boundary`` — a BOUNDARIES entry that no longer resolves.
+- ``wire-id-unregistered`` — a module-level wire-constant
+  (``KIND_*``/``EVT_*``/``_REC_*``/``PROTO*``) assigned a literal int
+  outside ``tpudash/wireids.py`` (the PR 12 collision class).
+
+Known soundness limits (the fuzzer covers what the graph cannot see):
+calls through instance variables and dynamic dispatch tables do not
+resolve; subscripts/attribute access are not modeled as raisers;
+``int()``/``float()`` count as raisers only over subscript/call
+arguments.
+
+Runtime half (``--fuzz``): a structure-aware differential fuzzer.  It
+builds a seed corpus by running every registered codec's *encoder* on
+real synthetic dashboard data, then applies deterministic seeded
+mutations — truncation at section boundaries, bit flips, length-field
+inflation, chunk excision/duplication, CRC-resealed payload edits, and
+JSON shape swaps — and asserts every decode either succeeds or raises
+only its declared contract type within a wall-time budget.  Anything
+else (IndexError, struct.error, MemoryError, a hung coroutine, a
+pathological slowdown) is a violation.  Fully reproducible from the
+printed seed.
+
+Usage::
+
+    python -m tpudash.analysis.boundcheck [paths...]
+    python -m tpudash.analysis.boundcheck --fuzz [--seconds N]
+        [--seed S] [--mutations N] [--budget-ms MS]
+
+Suppress a static finding with ``# tpulint: allow[rule] reason`` on the
+offending line or the enclosing ``def``.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import re
+import sys
+import time
+import zlib
+
+from tpudash.analysis.asynccheck import (
+    _ClassInfo,
+    _FuncInfo,
+    _ModuleInfo,
+    _resolve,
+    index_source,
+)
+from tpudash.analysis.lint import (
+    Finding,
+    _dotted,
+    iter_py_files,
+    resolve_cli_paths,
+)
+
+RULE_ESCAPE = "boundary-escape"
+RULE_UNCHECKED = "unchecked-boundary-call"
+RULE_BROAD = "contract-too-broad"
+RULE_STALE = "stale-boundary"
+RULE_WIRE_ID = "wire-id-unregistered"
+
+ALL_RULES = (
+    RULE_ESCAPE,
+    RULE_UNCHECKED,
+    RULE_BROAD,
+    RULE_STALE,
+    RULE_WIRE_ID,
+)
+
+RULE_DOCS = {
+    RULE_ESCAPE: (
+        "a registered decode boundary can leak an exception type outside "
+        "its declared contract on malformed input"
+    ),
+    RULE_UNCHECKED: (
+        "a loop calls a decode boundary without catching its contract — "
+        "one bad item fails the whole batch"
+    ),
+    RULE_BROAD: (
+        "except Exception directly around a boundary call swallows real "
+        "bugs along with malformed input — catch the contract type"
+    ),
+    RULE_STALE: "a BOUNDARIES registry entry no longer resolves to a function",
+    RULE_WIRE_ID: (
+        "a wire-format constant is assigned a literal int outside "
+        "tpudash/wireids.py — register it there to keep ids collision-free"
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# The boundary registry
+# ---------------------------------------------------------------------------
+
+
+class Boundary:
+    """One untrusted-input decoder and its declared exception contract.
+
+    ``contract`` names are exception *types* (subclasses conform);
+    ``fuzz`` names the corpus codec that must exercise this boundary in
+    ``--fuzz`` mode (None for boundaries only reachable through another
+    registered one)."""
+
+    __slots__ = ("module", "qual", "contract", "fuzz")
+
+    def __init__(self, module, qual, contract, fuzz=None):
+        self.module = module
+        self.qual = qual
+        self.contract = tuple(contract)
+        self.fuzz = fuzz
+
+
+BOUNDARIES = (
+    # TDB1 containers + TE stream events (tpudash/app/wire.py)
+    Boundary("tpudash.app.wire", "split_container", ("WireError",), "wire.container"),
+    Boundary("tpudash.app.wire", "split_bin_events", ("WireError",), "wire.events"),
+    Boundary("tpudash.app.wire", "event_body", ("WireError",), "wire.events"),
+    Boundary("tpudash.app.wire", "decode_delta", ("WireError",), "wire.delta"),
+    Boundary("tpudash.app.wire", "decode_template", ("WireError",), "wire.template"),
+    Boundary("tpudash.app.wire", "decode_cfull", ("WireError",), "wire.cfull"),
+    Boundary("tpudash.app.wire", "decode_frame", ("WireError",), "wire.frame"),
+    Boundary("tpudash.app.wire", "decode_summary", ("WireError",), "wire.summary"),
+    Boundary(
+        "tpudash.app.wire",
+        "decode_summary_delta",
+        ("WireError",),
+        "wire.summary_delta",
+    ),
+    # gorilla bit streams (count arrives from an untrusted header)
+    Boundary("tpudash.tsdb.gorilla", "decode_timestamps", ("ValueError",), "gorilla.ts"),
+    Boundary("tpudash.tsdb.gorilla", "decode_values", ("ValueError",), "gorilla.vals"),
+    # TSB1 segment record payloads
+    Boundary(
+        "tpudash.tsdb.store",
+        "_parse_block",
+        ("ValueError", "KeyError", "struct.error"),
+        "store.block",
+    ),
+    Boundary(
+        "tpudash.tsdb.store",
+        "_parse_rollup",
+        ("ValueError", "KeyError", "struct.error"),
+        "store.rollup",
+    ),
+    Boundary(
+        "tpudash.tsdb.store",
+        "_parse_sketch",
+        ("ValueError", "KeyError", "struct.error"),
+        "store.sketch",
+    ),
+    # snapshot manifests + cold-archive bundles
+    Boundary(
+        "tpudash.tsdb.snapshot", "parse_manifest", ("SnapshotError",), "snapshot.manifest"
+    ),
+    Boundary(
+        "tpudash.tsdb.cold", "_parse_manifest_frame", ("BundleError",), "cold.manifest"
+    ),
+    Boundary("tpudash.tsdb.cold", "parse_bundle", ("BundleError",), "cold.bundle"),
+    # quantile sketch digests
+    Boundary(
+        "tpudash.analytics.sketch",
+        "QuantileSketch.from_bytes",
+        ("SketchError",),
+        "sketch.digest",
+    ),
+    # federation child summary documents
+    Boundary(
+        "tpudash.federation.summary", "summary_to_batch", ("ValueError",), "summary.doc"
+    ),
+    # replication bus messages
+    Boundary(
+        "tpudash.broadcast.bus", "decode_seal", ("BusProtocolError",), "bus.seal"
+    ),
+    Boundary(
+        "tpudash.broadcast.bus",
+        "read_message",
+        ("BusProtocolError", "IncompleteReadError"),
+        "bus.frame",
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# Exception hierarchy (name-based; class scans extend it)
+# ---------------------------------------------------------------------------
+
+_EXC_PARENTS = {
+    "Exception": "BaseException",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "LookupError": "Exception",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "ValueError": "Exception",
+    "UnicodeError": "ValueError",
+    "UnicodeDecodeError": "UnicodeError",
+    "UnicodeEncodeError": "UnicodeError",
+    "JSONDecodeError": "ValueError",
+    "TypeError": "Exception",
+    "AttributeError": "Exception",
+    "NameError": "Exception",
+    "RuntimeError": "Exception",
+    "RecursionError": "RuntimeError",
+    "NotImplementedError": "RuntimeError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "PermissionError": "OSError",
+    "TimeoutError": "OSError",
+    "ConnectionError": "OSError",
+    "ConnectionResetError": "ConnectionError",
+    "BrokenPipeError": "ConnectionError",
+    "EOFError": "Exception",
+    "IncompleteReadError": "EOFError",
+    "MemoryError": "Exception",
+    "StopIteration": "Exception",
+    "StopAsyncIteration": "Exception",
+    "struct.error": "Exception",
+    "CancelledError": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "SystemExit": "BaseException",
+    "GeneratorExit": "BaseException",
+}
+
+
+def _exc_name(parts: "list[str]") -> str:
+    """Canonical short name of a dotted exception reference.
+    ``struct.error`` keeps its qualifier (its tail is too generic)."""
+    if parts[-1] == "error" and len(parts) >= 2 and parts[-2] == "struct":
+        return "struct.error"
+    return parts[-1]
+
+
+def _isa(name: str, targets, parents) -> bool:
+    """True when exception ``name`` is (a named subclass of) any type in
+    ``targets``, walking the name-based hierarchy.  Unknown names parent
+    to Exception — conservative for contracts, which never declare bare
+    Exception."""
+    cur = name
+    seen: set = set()
+    while cur is not None and cur not in seen:
+        if cur in targets:
+            return True
+        seen.add(cur)
+        if cur == "BaseException":
+            return False
+        cur = parents.get(cur, "Exception")
+    return False
+
+
+def _guarded(name: str, guards, parents) -> bool:
+    return any(_isa(name, g, parents) for g in guards)
+
+
+# ---------------------------------------------------------------------------
+# Per-function raise/call collection (second AST pass over the index)
+# ---------------------------------------------------------------------------
+
+
+class _FnExc:
+    __slots__ = ("raises", "calls")
+
+    def __init__(self):
+        self.raises: list = []  # (frozenset names, guards tuple)
+        self.calls: list = []  # (lineno, kind, payload, guards tuple, in_loop)
+
+
+_WIRE_ID_TOKENS = frozenset(("KIND", "EVT", "REC", "PROTO"))
+_WIRE_ID_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+def _is_wire_id_name(name: str) -> bool:
+    if not _WIRE_ID_RE.match(name):
+        return False
+    return any(tok in _WIRE_ID_TOKENS for tok in name.strip("_").split("_"))
+
+
+def _handler_names(handler: ast.ExceptHandler) -> frozenset:
+    if handler.type is None:
+        return frozenset({"BaseException"})
+    nodes = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    names = set()
+    for n in nodes:
+        parts = _dotted(n)
+        if parts:
+            names.add(_exc_name(parts))
+    return frozenset(names)
+
+
+def _passthrough(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises what it caught (bare ``raise`` or
+    ``raise <its var>`` anywhere in its body, nested defs excluded) —
+    its catch must not subtract from the escape set."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if (
+                handler.name
+                and isinstance(node.exc, ast.Name)
+                and node.exc.id == handler.name
+            ):
+                return True
+    return False
+
+
+class _ExcCollector(ast.NodeVisitor):
+    """Fills ``mod._exc`` (per-function raise/call events with guard
+    context), ``mod._broad_records`` (broad handlers around direct
+    calls) and ``mod._class_bases`` (exception hierarchy extension)."""
+
+    def __init__(self, mod: _ModuleInfo):
+        self.mod = mod
+        self.fn_by_line = {f.lineno: f for f in mod.funcs}
+        self.fn_stack: list = []
+        self.guards: list = []  # frozensets of caught names (innermost last)
+        self.for_depth = 0
+        self.handler_vars: set = set()
+        self.broad_ctx: list = []  # call sinks for enclosing broad-try bodies
+
+    def _cur(self) -> "_FnExc | None":
+        return self.mod._exc[id(self.fn_stack[-1])] if self.fn_stack else None
+
+    # -- scopes --------------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        fi = self.fn_by_line.get(node.lineno)
+        if fi is None:
+            self.generic_visit(node)
+            return
+        saved = (self.guards, self.for_depth, self.handler_vars, self.broad_ctx)
+        self.guards, self.for_depth = [], 0
+        self.handler_vars, self.broad_ctx = set(), []
+        self.fn_stack.append(fi)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.fn_stack.pop()
+        self.guards, self.for_depth, self.handler_vars, self.broad_ctx = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        for base in node.bases:
+            parts = _dotted(base)
+            if parts:
+                self.mod._class_bases.setdefault(node.name, _exc_name(parts))
+                break
+        for stmt in node.body:
+            self.visit(stmt)
+
+    # -- control flow --------------------------------------------------------
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self.for_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.for_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_Try(self, node):
+        hinfo = [
+            (h, _handler_names(h), _passthrough(h)) for h in node.handlers
+        ]
+        union = frozenset().union(
+            *(names for _h, names, pt in hinfo if not pt)
+        )
+        broad = [
+            (h.lineno, names)
+            for h, names, pt in hinfo
+            if not pt and (names & {"Exception", "BaseException"})
+        ]
+        sinks: list = []
+        if broad and self.fn_stack:
+            self.broad_ctx.append(sinks)
+        if union:
+            self.guards.append(union)
+        for stmt in node.body:
+            self.visit(stmt)
+        if union:
+            self.guards.pop()
+        if broad and self.fn_stack:
+            self.broad_ctx.pop()
+            fi = self.fn_stack[-1]
+            for hline, names in broad:
+                self.mod._broad_records.append(
+                    (hline, names, list(sinks), fi)
+                )
+        for h, _names, _pt in hinfo:
+            if h.name:
+                self.handler_vars.add(h.name)
+            for stmt in h.body:
+                self.visit(stmt)
+            if h.name:
+                self.handler_vars.discard(h.name)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        for stmt in node.finalbody:
+            self.visit(stmt)
+
+    def visit_With(self, node):
+        sup: set = set()
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                parts = _dotted(ce.func)
+                if parts and parts[-1] == "suppress":
+                    for a in ce.args:
+                        ap = _dotted(a)
+                        if ap:
+                            sup.add(_exc_name(ap))
+        if sup:
+            self.guards.append(frozenset(sup))
+        self.generic_visit(node)
+        if sup:
+            self.guards.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- events --------------------------------------------------------------
+    def visit_Raise(self, node):
+        self.generic_visit(node)
+        fn = self._cur()
+        if fn is None or node.exc is None:
+            return  # bare re-raise: the passthrough scan models it
+        target = node.exc
+        if isinstance(target, ast.Call):
+            target = target.func
+        parts = _dotted(target)
+        if not parts:
+            return  # dynamic raise — invisible to the name model
+        name = _exc_name(parts)
+        if name in self.handler_vars:
+            return  # `raise e`: passthrough scan models it
+        if name != "struct.error" and name[:1].islower():
+            return  # a local variable, not an exception class name
+        fn.raises.append((frozenset({name}), tuple(self.guards)))
+
+    def _intrinsic(self, parts, node) -> "frozenset | None":
+        tail = parts[-1]
+        if tail in ("unpack", "unpack_from"):
+            # unpack(fmt, pack(...)) is a bit-cast: its data length is
+            # statically fixed, so failure is not input-dependent
+            data_arg = node.args[1] if len(node.args) >= 2 else None
+            if isinstance(data_arg, ast.Call):
+                dparts = _dotted(data_arg.func)
+                if dparts and dparts[-1] == "pack":
+                    return None
+            return frozenset({"struct.error"})
+        if tail == "loads":
+            src = None
+            if len(parts) == 2:
+                src = self.mod.import_modules.get(parts[0])
+            elif len(parts) == 1:
+                src = self.mod.import_names.get("loads", ("",))[0]
+            if src == "json":
+                # loads on BYTES decodes utf-8 before parsing
+                return frozenset({"JSONDecodeError", "UnicodeDecodeError"})
+        if (
+            len(parts) == 1
+            and parts[0] in ("int", "float")
+            and node.args
+            and isinstance(node.args[0], (ast.Subscript, ast.Call))
+        ):
+            return frozenset({"ValueError", "TypeError"})
+        if tail == "decode" and len(parts) >= 2:
+            return frozenset({"UnicodeDecodeError"})
+        return None
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        fn = self._cur()
+        if fn is None:
+            return
+        parts = _dotted(node.func)
+        if not parts:
+            return
+        g = tuple(self.guards)
+        intrinsic = self._intrinsic(parts, node)
+        if intrinsic:
+            fn.raises.append((intrinsic, g))
+        kind = payload = None
+        if len(parts) == 1:
+            kind, payload = "bare", parts[0]
+        elif len(parts) == 2 and parts[0] == "self":
+            kind, payload = "self", parts[1]
+        elif len(parts) == 2:
+            kind, payload = "attr", (parts[0], parts[1])
+        if kind is not None:
+            fn.calls.append(
+                (node.lineno, kind, payload, g, self.for_depth > 0)
+            )
+            for sink in self.broad_ctx:
+                sink.append((node.lineno, kind, payload))
+
+
+def _index_and_collect(source: str, path: str):
+    mod = index_source(source, path)
+    if isinstance(mod, Finding):
+        return mod
+    mod._exc = {id(f): _FnExc() for f in mod.funcs}
+    mod._broad_records = []
+    mod._class_bases = {}
+    mod._wire_ids = []
+    tree = ast.parse(source, filename=path)
+    _ExcCollector(mod).visit(tree)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        if not (
+            isinstance(value, ast.Constant)
+            and isinstance(value.value, int)
+            and not isinstance(value.value, bool)
+        ):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and _is_wire_id_name(t.id):
+                mod._wire_ids.append((stmt.lineno, t.id))
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural escape sets + rules
+# ---------------------------------------------------------------------------
+
+
+def _resolve_ext(index, mod, fi, kind, payload):
+    """asynccheck's resolver plus class-attribute methods
+    (``QuantileSketch.from_bytes`` — local or ``from x import Class``)."""
+    if kind == "attr":
+        alias, name = payload
+        cls = mod.classes.get(alias)
+        if cls is not None and name in cls.methods:
+            return cls.methods[name]
+        ref = mod.import_names.get(alias)
+        if ref is not None:
+            tmod = index.get(ref[0])
+            if tmod is not None:
+                tgt = tmod.top.get(ref[1])
+                if isinstance(tgt, _ClassInfo) and name in tgt.methods:
+                    return tgt.methods[name]
+    return _resolve(index, mod, fi, kind, payload)
+
+
+def _escape_sets(modules, index, parents):
+    """Fixed point over the call graph: per function, the set of
+    exception type names that can escape it.  Returns ``(escape,
+    resolved)`` — resolved call events keyed by ``id(func)``."""
+    resolved: dict = {}
+    for m in modules:
+        for f in m.funcs:
+            fx = m._exc[id(f)]
+            rs = []
+            for lineno, kind, payload, g, loop in fx.calls:
+                callee = _resolve_ext(index, m, f, kind, payload)
+                if callee is not None:
+                    rs.append((lineno, callee, g, loop))
+            resolved[id(f)] = rs
+    escape = {id(f): set() for m in modules for f in m.funcs}
+    changed = True
+    while changed:
+        changed = False
+        for m in modules:
+            for f in m.funcs:
+                fx = m._exc[id(f)]
+                cur = escape[id(f)]
+                add = set()
+                for types, g in fx.raises:
+                    for t in types:
+                        if t not in cur and not _guarded(t, g, parents):
+                            add.add(t)
+                for _lineno, callee, g, _loop in resolved[id(f)]:
+                    for t in escape.get(id(callee), ()):
+                        if t not in cur and not _guarded(t, g, parents):
+                            add.add(t)
+                if add:
+                    cur |= add
+                    changed = True
+    return escape, resolved
+
+
+def analyze_modules(modules, boundaries=BOUNDARIES) -> "list[Finding]":
+    index = {m.name: m for m in modules}
+    parents = dict(_EXC_PARENTS)
+    for m in modules:
+        for cname, base in m._class_bases.items():
+            parents.setdefault(cname, base)
+    findings: list = []
+
+    for m in modules:
+        if m.name.split(".")[-1] == "wireids":
+            continue
+        for line, name in m._wire_ids:
+            if not m.allowed(RULE_WIRE_ID, line):
+                findings.append(
+                    Finding(
+                        m.path,
+                        line,
+                        RULE_WIRE_ID,
+                        f"wire constant {name} is a literal int here — "
+                        "register it in tpudash/wireids.py and import it",
+                    )
+                )
+
+    bmap: dict = {}  # id(func) -> (Boundary, _FuncInfo, _ModuleInfo)
+    for b in boundaries:
+        m = index.get(b.module)
+        if m is None:
+            continue
+        fi = next((f for f in m.funcs if f.qual == b.qual), None)
+        if fi is None:
+            if not m.allowed(RULE_STALE, 1):
+                findings.append(
+                    Finding(
+                        m.path,
+                        1,
+                        RULE_STALE,
+                        f"BOUNDARIES entry {b.module}.{b.qual} does not "
+                        "resolve — update the registry",
+                    )
+                )
+        else:
+            bmap[id(fi)] = (b, fi, m)
+
+    escape, resolved = _escape_sets(modules, index, parents)
+
+    for b, fi, m in bmap.values():
+        contract = frozenset(b.contract)
+        bad = sorted(
+            t for t in escape[id(fi)] if not _isa(t, contract, parents)
+        )
+        if bad and not m.allowed(RULE_ESCAPE, fi.lineno, fi.scope_lines):
+            findings.append(
+                Finding(
+                    m.path,
+                    fi.lineno,
+                    RULE_ESCAPE,
+                    f"boundary {b.qual} (contract {'|'.join(b.contract)}) "
+                    f"can leak {', '.join(bad)} on malformed input — "
+                    "narrow the raise at the source",
+                )
+            )
+
+    for m in modules:
+        for f in m.funcs:
+            if id(f) in bmap:
+                continue  # boundaries may compose each other freely
+            for lineno, callee, g, loop in resolved[id(f)]:
+                if not loop or id(callee) not in bmap:
+                    continue
+                b = bmap[id(callee)][0]
+                need = escape[id(callee)] or set(b.contract)
+                missing = sorted(
+                    t for t in need if not _guarded(t, g, parents)
+                )
+                if missing and not m.allowed(
+                    RULE_UNCHECKED, lineno, f.scope_lines
+                ):
+                    findings.append(
+                        Finding(
+                            m.path,
+                            lineno,
+                            RULE_UNCHECKED,
+                            f"{f.qual} calls boundary {b.qual} in a loop "
+                            f"without catching {', '.join(missing)} — one "
+                            "bad item fails the whole batch",
+                        )
+                    )
+
+    for m in modules:
+        for hline, names, sinks, fi in m._broad_records:
+            hit = None
+            for lineno, kind, payload in sinks:
+                callee = _resolve_ext(index, m, fi, kind, payload)
+                if callee is not None and id(callee) in bmap:
+                    hit = (lineno, bmap[id(callee)][0])
+                    break
+            if hit is None:
+                continue
+            scope = tuple(fi.scope_lines) + (fi.lineno,)
+            if not m.allowed(RULE_BROAD, hline, scope):
+                b = hit[1]
+                findings.append(
+                    Finding(
+                        m.path,
+                        hline,
+                        RULE_BROAD,
+                        f"except {'/'.join(sorted(names))} around boundary "
+                        f"{b.qual} (line {hit[0]}) also swallows real bugs "
+                        f"— catch {'|'.join(b.contract)}",
+                    )
+                )
+
+    findings.sort()
+    return findings
+
+
+def check_source(source: str, path: str, boundaries=BOUNDARIES):
+    mod = _index_and_collect(source, path)
+    if isinstance(mod, Finding):
+        return [mod]
+    return analyze_modules([mod], boundaries)
+
+
+def check_paths(paths: "list[str]", boundaries=BOUNDARIES):
+    findings: list = []
+    modules: list = []
+    for p in iter_py_files(paths):
+        try:
+            with open(p, encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(p, 1, "io", f"cannot read: {e}"))
+            continue
+        mod = _index_and_collect(source, p)
+        if isinstance(mod, Finding):
+            findings.append(mod)
+        else:
+            modules.append(mod)
+    findings.extend(analyze_modules(modules, boundaries))
+    findings.sort()
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Runtime half: the structure-aware wire fuzzer
+# ---------------------------------------------------------------------------
+
+
+class CorpusEntry:
+    """One fuzzable artifact: real encoder output plus the structural
+    hints mutations exploit.  ``mode`` is ``bytes`` (seed is a byte
+    string) or ``json`` (seed is a document; mutations are shape swaps).
+    ``cuts`` are section-boundary offsets for targeted truncation;
+    ``len_fields`` are ``(offset, size)`` little-endian length/count
+    fields to inflate; ``fixup`` re-seals framing CRCs after an edit so
+    mutations can reach past integrity checks."""
+
+    __slots__ = ("codec", "mode", "seed", "decode", "contract", "cuts",
+                 "len_fields", "fixup")
+
+    def __init__(self, codec, mode, seed, decode, contract,
+                 cuts=(), len_fields=(), fixup=None):
+        self.codec = codec
+        self.mode = mode
+        self.seed = seed
+        self.decode = decode
+        self.contract = tuple(contract)
+        self.cuts = tuple(cuts)
+        self.len_fields = tuple(len_fields)
+        self.fixup = fixup
+
+
+class _FuzzViolation(Exception):
+    pass
+
+
+def _tdb1_cuts(buf: bytes) -> "tuple[tuple, tuple]":
+    """(cuts, len_fields) of one TDB1 container."""
+    head_len = int.from_bytes(buf[8:12], "little")
+    head_end = 12 + head_len
+    cuts = [0, 4, 5, 8, 12, head_end, head_end + 4,
+            (head_end + 4 + len(buf)) // 2, len(buf) - 1]
+    lens = [(8, 4), (head_end, 4)]
+    return tuple(c for c in cuts if 0 <= c <= len(buf)), tuple(lens)
+
+
+def _wire_entries() -> "list[CorpusEntry]":
+    import json as _json
+
+    from tpudash.app import wire
+    from tpudash.app.delta import frame_delta
+    from tpudash.app.service import DashboardService
+    from tpudash.config import Config
+    from tpudash.sources.fixture import JsonReplaySource
+
+    cfg = Config(
+        source="synthetic", synthetic_chips=4, synthetic_slices=2,
+        refresh_interval=0.0, history_points=8,
+    )
+    svc = DashboardService(
+        cfg, JsonReplaySource.synthetic(4, frames=6, num_slices=2)
+    )
+
+    def _norm(frame: dict) -> dict:
+        # wall-clock stamps AND measured stage latencies pinned so two
+        # corpus builds are byte-identical (seed reproducibility)
+        frame = _json.loads(_json.dumps(frame))
+        for k in ("ts", "updated", "last_updated", "generated_ms"):
+            if k in frame:
+                frame[k] = 1000.0
+        for stage in (frame.get("timings") or {}).values():
+            if isinstance(stage, dict):
+                for k, v in stage.items():
+                    if isinstance(v, float):
+                        stage[k] = 1.0
+        return frame
+
+    frames = []
+    for _ in range(3):
+        frames.append(_norm(svc.render_frame()))
+    prev, cur = frames[-2], frames[-1]
+    wc = (wire.WireError,)
+    out: list = []
+
+    def _bytes_entry(codec, buf, decode):
+        cuts, lens = _tdb1_cuts(buf)
+        out.append(CorpusEntry(codec, "bytes", buf, decode, wc,
+                               cuts=cuts, len_fields=lens))
+
+    fbuf = wire.encode_frame(cur)
+    _bytes_entry("wire.container", fbuf, lambda b: wire.split_container(b))
+    _bytes_entry("wire.frame", fbuf, lambda b: wire.decode_frame(b))
+    delta = frame_delta(prev, cur)
+    dbuf = wire.encode_delta(prev, delta)
+    if dbuf is not None:
+        _bytes_entry("wire.delta", dbuf, lambda b: wire.decode_delta(b, prev))
+    tbuf = wire.encode_template(cur, "t1")
+    _bytes_entry("wire.template", tbuf, lambda b: wire.decode_template(b))
+    template = wire.decode_template(tbuf)
+    cbuf = wire.encode_cfull(cur, "t1")
+    _bytes_entry("wire.cfull", cbuf, lambda b: wire.decode_cfull(b, template))
+
+    base_doc = svc.summary_doc(binary=True)
+    svc.render_frame()
+    cur_doc = svc.summary_doc(binary=True)
+    for d in (base_doc, cur_doc):
+        d["ts"] = 1000.0
+    sbuf = wire.encode_summary(cur_doc)
+    _bytes_entry("wire.summary", sbuf, lambda b: wire.decode_summary(b))
+    base_decoded = wire.decode_summary(wire.encode_summary(base_doc))
+    sdbuf = wire.encode_summary_delta(cur_doc, base_doc, '"e1"')
+    _bytes_entry(
+        "wire.summary_delta",
+        sdbuf,
+        lambda b: wire.decode_summary_delta(b, base_decoded, '"e1"'),
+    )
+
+    from tpudash import wireids
+
+    ebuf = wire.bin_event(wireids.TE_EVT_FULL, "c1-7", fbuf)
+
+    def _ev_decode(b):
+        events, _rest = wire.split_bin_events(b)
+        for _etype, _eid, _body in events:
+            pass
+        wire.event_body(b)
+
+    idlen = ebuf[3] if len(ebuf) > 3 else 0
+    out.append(CorpusEntry(
+        "wire.events", "bytes", ebuf, _ev_decode, wc,
+        cuts=(0, 2, 3, 4, 4 + idlen, 8 + idlen, len(ebuf) - 1),
+        len_fields=((4 + idlen, 4),),
+    ))
+    return out
+
+
+def _gorilla_entries() -> "list[CorpusEntry]":
+    from tpudash.tsdb import gorilla
+
+    ts = [1000 + 250 * i + (7 if i % 5 == 0 else 0) for i in range(64)]
+    vals = [20.0 + (i % 9) * 1.25 - (0.5 if i % 4 == 0 else 0.0)
+            for i in range(64)]
+    tbuf = gorilla.encode_timestamps(ts)
+    vbuf = gorilla.encode_values(vals)
+    vc = (ValueError,)
+    n = len(ts)
+    return [
+        CorpusEntry("gorilla.ts", "bytes", tbuf,
+                    lambda b: gorilla.decode_timestamps(b, n), vc,
+                    cuts=(0, 4, 8, len(tbuf) // 2, len(tbuf) - 1)),
+        CorpusEntry("gorilla.ts", "bytes", tbuf,
+                    lambda b: gorilla.decode_timestamps(b, n * 1000), vc,
+                    cuts=(0, 8)),
+        CorpusEntry("gorilla.vals", "bytes", vbuf,
+                    lambda b: gorilla.decode_values(b, n), vc,
+                    cuts=(0, 8, len(vbuf) // 2, len(vbuf) - 1)),
+        CorpusEntry("gorilla.vals", "bytes", vbuf,
+                    lambda b: gorilla.decode_values(b, n * 1000), vc,
+                    cuts=(0, 8)),
+    ]
+
+
+def _sketch_entries() -> "list[CorpusEntry]":
+    from tpudash.analytics.sketch import QuantileSketch, SketchError
+
+    sk = QuantileSketch.from_values(
+        [float(i % 17) * 1.5 for i in range(200)]
+    )
+    raw = sk.to_bytes()
+    return [CorpusEntry(
+        "sketch.digest", "bytes", raw,
+        lambda b: QuantileSketch.from_bytes(b), (SketchError,),
+        cuts=(0, 1, 3, 11, 19, 27, len(raw) // 2, len(raw) - 1),
+        len_fields=((1, 2),),
+    )]
+
+
+def _store_payloads():
+    import numpy as np
+
+    from tpudash.analytics.sketch import QuantileSketch
+    from tpudash.tsdb import store as tstore
+
+    keys = ["s0/0", "s0/1", "s1/0"]
+    cols = ["power_w", "duty_pct"]
+    ts_ms = [1000 + 250 * i for i in range(16)]
+    stacked = np.arange(len(ts_ms) * len(keys) * len(cols),
+                        dtype=np.float64).reshape(
+        len(ts_ms), len(keys), len(cols)
+    )
+    block = tstore._encode_block(keys, cols, ts_ms, stacked)
+    bpay = tstore._block_payload(block)
+
+    nb, K, C = 3, len(keys), len(cols)
+    shape = (nb, K, C)
+    rollup = tstore.RollupBlock(
+        60_000,
+        np.array([0, 60_000, 120_000], dtype=np.int64),
+        keys, cols,
+        np.zeros(shape, dtype=np.float32),
+        np.ones(shape, dtype=np.float32),
+        np.full(shape, 2.0, dtype=np.float64),
+        np.full(shape, 4, dtype=np.int32),
+        1000, 5000,
+    )
+    rpay = tstore._rollup_payload(rollup)
+
+    enc = [
+        [
+            [QuantileSketch.from_values([float(b + k + c)] * 4).to_bytes()
+             for c in range(C)]
+            for k in range(K)
+        ]
+        for b in range(nb)
+    ]
+    sketch = tstore.SketchBlock(
+        60_000,
+        np.array([0, 60_000, 120_000], dtype=np.int64),
+        keys, cols, enc, 1000, 5000,
+    )
+    spay = tstore._sketch_payload(sketch)
+    return tstore, bpay, rpay, spay
+
+
+def _store_entries(payloads) -> "list[CorpusEntry]":
+    import struct as _struct
+
+    tstore, bpay, rpay, spay = payloads
+    contract = (ValueError, KeyError, _struct.error)
+    out = []
+    for codec, pay, fn in (
+        ("store.block", bpay, tstore._parse_block),
+        ("store.rollup", rpay, tstore._parse_rollup),
+        ("store.sketch", spay, tstore._parse_sketch),
+    ):
+        hlen = int.from_bytes(pay[:4], "little")
+        out.append(CorpusEntry(
+            codec, "bytes", pay, fn, contract,
+            cuts=(0, 2, 4, 4 + hlen, (4 + hlen + len(pay)) // 2,
+                  len(pay) - 1),
+            len_fields=((0, 4),),
+        ))
+    return out
+
+
+def _snapshot_entries() -> "list[CorpusEntry]":
+    import json as _json
+
+    from tpudash.tsdb import snapshot as snap
+
+    doc = {
+        "version": 2,
+        "created_ms": 1000,
+        "files": [
+            {"name": "seg-000001.tsb", "bytes": 4096, "crc": 7},
+            {"name": "seg-000002.tsb", "bytes": 1024, "crc": 9},
+        ],
+        "wal": "wal.tsb",
+    }
+    payload = _json.dumps(doc, separators=(",", ":")).encode()
+    frame = snap._FRAME_HDR.pack(
+        snap._MAGIC, snap._REC_MANIFEST, len(payload), zlib.crc32(payload)
+    ) + payload
+    hdr = snap._FRAME_HDR.size
+
+    def _reseal(data: bytes) -> bytes:
+        if len(data) < hdr:
+            return data
+        body = data[hdr:]
+        return snap._FRAME_HDR.pack(
+            snap._MAGIC, snap._REC_MANIFEST, len(body), zlib.crc32(body)
+        ) + body
+
+    bytes_entry = CorpusEntry(
+        "snapshot.manifest", "bytes", frame,
+        lambda b: snap.parse_manifest(b, label="fuzz"),
+        (snap.SnapshotError,),
+        cuts=(0, 4, 5, 9, hdr, hdr + len(payload) // 2, len(frame) - 1),
+        len_fields=((5, 4),),
+        fixup=_reseal,
+    )
+
+    def _doc_decode(d):
+        p = _json.dumps(d, separators=(",", ":")).encode()
+        f = snap._FRAME_HDR.pack(
+            snap._MAGIC, snap._REC_MANIFEST, len(p), zlib.crc32(p)
+        ) + p
+        snap.parse_manifest(f, label="fuzz")
+
+    json_entry = CorpusEntry(
+        "snapshot.manifest", "json", doc, _doc_decode, (snap.SnapshotError,)
+    )
+    return [bytes_entry, json_entry]
+
+
+def _cold_entries(store_payloads) -> "list[CorpusEntry]":
+    import json as _json
+
+    from tpudash import wireids
+    from tpudash.tsdb import cold
+
+    _tstore, bpay, rpay, spay = store_payloads
+    sections = [
+        (wireids.TSB1_REC_BLOCK, 0, 1000, 4750, bpay),
+        (wireids.TSB1_REC_ROLLUP, 60_000, 1000, 5000, rpay),
+        (wireids.TSB1_REC_SKETCH, 60_000, 1000, 5000, spay),
+    ]
+    sources = [{"name": "seg-000001.tsb", "bytes": len(bpay)}]
+    bundle, manifest = cold.build_bundle(
+        sections, sources, 1000, ["s0/0", "s0/1", "s1/0"],
+        ["power_w", "duty_pct"],
+    )
+    moff = len(bundle) - cold._FOOTER.size
+    body_len = int.from_bytes(bundle[moff : moff + 8], "little")
+    body = bundle[:body_len]
+    footer = bundle[moff:]
+
+    bundle_entry = CorpusEntry(
+        "cold.bundle", "bytes", bundle,
+        lambda b: cold.parse_bundle(b, verify_digest=True),
+        (cold.BundleError,),
+        cuts=(0, len(bpay) // 2, body_len, body_len + 9,
+              len(bundle) - cold._FOOTER.size, len(bundle) - 4,
+              len(bundle) - 1),
+        len_fields=((body_len + 5, 4), (moff, 8)),
+    )
+
+    def _manifest_decode(doc):
+        p = _json.dumps(doc, separators=(",", ":")).encode()
+        mframe = cold._FRAME_HDR.pack(
+            cold._MAGIC, cold._REC_BUNDLE_MANIFEST, len(p), zlib.crc32(p)
+        ) + p
+        cold.parse_bundle(body + mframe + footer, verify_digest=False)
+
+    manifest_entry = CorpusEntry(
+        "cold.manifest", "json", manifest, _manifest_decode,
+        (cold.BundleError,),
+    )
+    return [bundle_entry, manifest_entry]
+
+
+def _summary_entries() -> "list[CorpusEntry]":
+    from tpudash.federation.summary import summary_to_batch
+
+    doc = {
+        "v": 1,
+        "ts": 1000.0,
+        "node": "child-a",
+        "depth": 0,
+        "path": ["child-a"],
+        "chips": 3,
+        "identity": {
+            "slice": ["s0", "s0", "s1"],
+            "chip_id": [0, 1, 0],
+            "host": ["h0", "h0", "h1"],
+            "accel": ["v5e", "v5e", "v5e"],
+        },
+        "keys": ["s0/0", "s0/1", "s1/0"],
+        "cols": ["power_w", "duty_pct"],
+        "matrix": [[100.0, 50.0], [None, 40.0], [90.0, None]],
+        "fleet": {"power_w": 95.0},
+        "alerts": [],
+    }
+    return [CorpusEntry(
+        "summary.doc", "json", doc,
+        lambda d: summary_to_batch("child-a", d), (ValueError,),
+    )]
+
+
+def _bus_entries(loop) -> "list[CorpusEntry]":
+    from tpudash.broadcast import bus
+    from tpudash.broadcast.cohort import Seal
+
+    seal = Seal(
+        3, 7, (11, 2),
+        b"event: tick\ndata: {}\n\n", b"gz-full",
+        b"data: {}\n\n", b"gz-delta",
+        b'{"frame":1}', b"gz-frame",
+        b"bin-full", b"bin-full-gz",
+        b"bin-delta", b"bin-delta-gz",
+        tpl_id="t1", bin_tpl_raw=b"bin-tpl", bin_tpl_gz=b"bin-tpl-gz",
+    )
+    msg = bus.encode_seal(seal, 5, include_tpl=True)
+    nl = msg.index(b"\n")
+    header = __import__("json").loads(msg[4:nl])
+    body = msg[nl + 1 :]
+    contract = (bus.BusProtocolError, __import__("asyncio").IncompleteReadError)
+
+    def _frame_decode(data):
+        import asyncio as _aio
+
+        async def go():
+            r = _aio.StreamReader()
+            r.feed_data(data)
+            r.feed_eof()
+            h, b = await bus.read_message(r)
+            if isinstance(h, dict) and h.get("t") == "seal":
+                bus.decode_seal(h, b, None)
+
+        loop.run_until_complete(go())
+
+    frame_entry = CorpusEntry(
+        "bus.frame", "bytes", msg, _frame_decode, contract,
+        cuts=(0, 2, 4, nl, nl + 1, (nl + 1 + len(msg)) // 2, len(msg) - 1),
+        len_fields=((0, 4),),
+    )
+    seal_entry = CorpusEntry(
+        "bus.seal", "json", header,
+        lambda h: bus.decode_seal(h, body, None), (bus.BusProtocolError,),
+    )
+    return [frame_entry, seal_entry]
+
+
+def build_corpus(loop) -> "list[CorpusEntry]":
+    entries: list = []
+    entries.extend(_wire_entries())
+    entries.extend(_gorilla_entries())
+    entries.extend(_sketch_entries())
+    payloads = _store_payloads()
+    entries.extend(_store_entries(payloads))
+    entries.extend(_snapshot_entries())
+    entries.extend(_cold_entries(payloads))
+    entries.extend(_summary_entries())
+    entries.extend(_bus_entries(loop))
+    return entries
+
+
+_JSON_JUNK = (
+    None, [], {}, "", "junk", "-1", -1, 0, 2**40, -(2**40), 1e308, -1e308,
+    True, False, [1, "a", None], {"k": 1}, [[1]], "0" * 64,
+)
+
+
+def _json_mutate(doc, rng):
+    doc = copy.deepcopy(doc)
+    paths: list = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for k in obj:
+                paths.append((obj, k))
+                walk(obj[k])
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                paths.append((obj, i))
+                walk(v)
+
+    walk(doc)
+    if not paths:
+        return doc, "json:noop"
+    edits = rng.randrange(1, 4)
+    for _ in range(edits):
+        cont, key = paths[rng.randrange(len(paths))]
+        cont[key] = _JSON_JUNK[rng.randrange(len(_JSON_JUNK))]
+    return doc, f"json:{edits}-edits"
+
+
+_INFLATE_VALUES = (0xFFFFFFFF, 0x7FFFFFFF, 0x80000000, 0, 1, 0xFFFF)
+
+
+def _byte_mutate(data: bytes, entry: CorpusEntry, rng):
+    buf = bytearray(data)
+    kind = rng.randrange(5)
+    if kind == 0:
+        if entry.cuts and rng.random() < 0.6:
+            cut = entry.cuts[rng.randrange(len(entry.cuts))]
+        else:
+            cut = rng.randrange(len(buf) + 1)
+        buf = buf[: max(0, min(cut, len(buf)))]
+        desc = f"truncate@{len(buf)}"
+    elif kind == 1:
+        flips = rng.randrange(1, 9)
+        for _ in range(flips):
+            if not buf:
+                break
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        desc = f"bitflip:{flips}"
+    elif kind == 2:
+        if entry.len_fields and rng.random() < 0.7:
+            off, size = entry.len_fields[rng.randrange(len(entry.len_fields))]
+        else:
+            size = 4
+            off = rng.randrange(max(1, len(buf)))
+        val = _INFLATE_VALUES[rng.randrange(len(_INFLATE_VALUES))]
+        if off + size <= len(buf):
+            buf[off : off + size] = val.to_bytes(8, "little")[:size]
+        desc = f"inflate@{off}={val:#x}"
+    elif kind == 3:
+        if len(buf) > 2:
+            a = rng.randrange(len(buf))
+            del buf[a : min(len(buf), a + rng.randrange(1, 48))]
+        desc = "excise"
+    else:
+        if len(buf) > 4:
+            ln = rng.randrange(1, 24)
+            a = rng.randrange(len(buf))
+            b = rng.randrange(len(buf))
+            chunk = bytes(buf[a : a + ln])
+            buf[b : b + len(chunk)] = chunk
+        desc = "dupe-chunk"
+    out = bytes(buf)
+    if entry.fixup is not None and rng.random() < 0.5:
+        out = entry.fixup(out)
+        desc += "+reseal"
+    return out, desc
+
+
+def _run_one(entry, mutated, desc, stats, violations, budget_s):
+    st = stats[entry.codec]
+    st[0] += 1
+    t0 = time.perf_counter()
+    verdict = None
+    try:
+        entry.decode(mutated)
+        st[1] += 1
+    except entry.contract:
+        st[2] += 1
+    except MemoryError:
+        verdict = "MemoryError"
+    # the whole point: ANY other exception type is the bug being hunted
+    # tpulint: allow[broad-except] fuzz verdict collection
+    except Exception as e:
+        verdict = f"{type(e).__name__}: {e!r}"[:200]
+    elapsed = time.perf_counter() - t0
+    if verdict is None and elapsed > budget_s:
+        verdict = f"decode took {elapsed:.2f}s (budget {budget_s:.2f}s)"
+    if verdict is not None:
+        violations.append(
+            {"codec": entry.codec, "mutation": desc, "verdict": verdict}
+        )
+
+
+def run_fuzz(seed=None, mutations=None, seconds=None, budget_ms=2000.0):
+    """Run the differential fuzz pass; returns a result dict with
+    ``seed``, per-codec ``stats`` ``{codec: {mutations, ok, refused}}``
+    and ``violations``.  Deterministic for a given (seed, mutations);
+    ``seconds`` trades determinism of the *count* for a time budget."""
+    import asyncio
+    import random
+
+    if seed is None:
+        seed = int.from_bytes(__import__("os").urandom(4), "little")
+    seed = int(seed) & 0xFFFFFFFF
+    loop = asyncio.new_event_loop()
+    try:
+        entries = build_corpus(loop)
+        covered = {e.codec for e in entries}
+        missing = [
+            f"{b.module}.{b.qual} (codec {b.fuzz})"
+            for b in BOUNDARIES
+            if b.fuzz and b.fuzz not in covered
+        ]
+        stats = {e.codec: [0, 0, 0] for e in entries}
+        violations: list = []
+        budget_s = budget_ms / 1000.0
+        if missing:
+            return {
+                "seed": seed, "stats": {}, "violations": [
+                    {"codec": m, "mutation": "-",
+                     "verdict": "boundary has no fuzz corpus entry"}
+                    for m in missing
+                ],
+            }
+        # sanity: every unmutated seed must decode clean
+        for e in entries:
+            _run_one(e, e.seed, "seed(unmutated)", stats, violations,
+                     budget_s)
+            if stats[e.codec][1] == 0:
+                violations.append({
+                    "codec": e.codec, "mutation": "seed(unmutated)",
+                    "verdict": "corpus seed does not decode cleanly",
+                })
+        # deterministic structural truncations first
+        for e in entries:
+            if e.mode != "bytes":
+                continue
+            for cut in e.cuts:
+                _run_one(e, e.seed[:cut], f"truncate@{cut}", stats,
+                         violations, budget_s)
+        # seeded mutation rounds
+        per_entry = int(mutations) if mutations else 500
+        deadline = (time.monotonic() + float(seconds)) if seconds else None
+        rngs = {
+            id(e): random.Random((seed << 8) ^ zlib.crc32(
+                f"{e.codec}#{i}".encode()))
+            for i, e in enumerate(entries)
+        }
+        done = {id(e): 0 for e in entries}
+        exhausted = False
+        while not exhausted:
+            exhausted = True
+            for e in entries:
+                if deadline is None and done[id(e)] >= per_entry:
+                    continue
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                exhausted = False
+                rng = rngs[id(e)]
+                burst = 25
+                for _ in range(burst):
+                    if deadline is None and done[id(e)] >= per_entry:
+                        break
+                    if e.mode == "bytes":
+                        mutated, desc = _byte_mutate(e.seed, e, rng)
+                    else:
+                        mutated, desc = _json_mutate(e.seed, rng)
+                    _run_one(e, mutated, desc, stats, violations, budget_s)
+                    done[id(e)] += 1
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        return {
+            "seed": seed,
+            "stats": {
+                c: {"mutations": v[0], "ok": v[1], "refused": v[2]}
+                for c, v in sorted(stats.items())
+            },
+            "violations": violations,
+        }
+    finally:
+        import contextlib
+
+        with contextlib.suppress(OSError, RuntimeError):
+            loop.close()
+
+
+def fuzz_main(argv) -> int:
+    def _opt(flag, cast):
+        if flag in argv:
+            i = argv.index(flag)
+            try:
+                return cast(argv[i + 1])
+            except (IndexError, ValueError):
+                print(f"boundcheck: {flag} needs a {cast.__name__}",
+                      file=sys.stderr)
+                raise SystemExit(2) from None
+        return None
+
+    seed = _opt("--seed", int)
+    mutations = _opt("--mutations", int)
+    seconds = _opt("--seconds", float)
+    budget_ms = _opt("--budget-ms", float) or 2000.0
+    result = run_fuzz(seed=seed, mutations=mutations, seconds=seconds,
+                      budget_ms=budget_ms)
+    print(f"boundcheck --fuzz: seed={result['seed']}")
+    total = ok = refused = 0
+    for codec, st in result["stats"].items():
+        print(f"  {codec:<22} mutations={st['mutations']:<6} "
+              f"ok={st['ok']:<6} refused={st['refused']}")
+        total += st["mutations"]
+        ok += st["ok"]
+        refused += st["refused"]
+    for v in result["violations"]:
+        print(f"VIOLATION {v['codec']} [{v['mutation']}]: {v['verdict']}",
+              file=sys.stderr)
+    if result["violations"]:
+        print(
+            f"boundcheck --fuzz: {len(result['violations'])} violation(s) "
+            f"over {total} decodes (reproduce with --seed {result['seed']})",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"boundcheck --fuzz: clean — {total} decodes "
+          f"({ok} ok, {refused} refused in-contract), seed {result['seed']}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--rules" in argv:
+        for rule in ALL_RULES:
+            print(f"{rule}: {RULE_DOCS[rule]}")
+        return 0
+    if "--fuzz" in argv:
+        return fuzz_main(argv)
+    paths, err = resolve_cli_paths(argv, "boundcheck")
+    if paths is None:
+        return err
+    findings = check_paths(paths)
+    for f in findings:
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if findings:
+        print(f"boundcheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("boundcheck: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,9 +1,10 @@
 """``python -m tpudash.analysis`` — every static analyzer, one entry point.
 
 Runs tpulint (:mod:`tpudash.analysis.lint`), asynccheck
-(:mod:`tpudash.analysis.asynccheck`) and leakcheck
-(:mod:`tpudash.analysis.leakcheck`) over the same tree so CI and editors
-consume one command instead of tracking the analyzer roster:
+(:mod:`tpudash.analysis.asynccheck`), leakcheck
+(:mod:`tpudash.analysis.leakcheck`) and boundcheck
+(:mod:`tpudash.analysis.boundcheck`) over the same tree so CI and
+editors consume one command instead of tracking the analyzer roster:
 
     python -m tpudash.analysis                 # analyze the package
     python -m tpudash.analysis path/ f.py      # analyze specific trees
@@ -17,20 +18,23 @@ without parsing output:
     1   tpulint findings (bit)
     2   asynccheck findings (bit)
     8   leakcheck findings (bit)
+    16  boundcheck findings (bit)
     4   usage/internal error (bad path, nothing to scan, registry import)
 
 (a run with findings from several analyzers ORs the bits: tpulint +
-leakcheck = 9, all three = 11)
+leakcheck = 9, all four = 27)
 
 ``--json`` prints one object::
 
     {"version": 1, "clean": false,
-     "counts": {"tpulint": 1, "asynccheck": 0, "leakcheck": 0},
+     "counts": {"tpulint": 1, "asynccheck": 0, "leakcheck": 0,
+                "boundcheck": 0},
      "findings": [{"analyzer": "tpulint", "rule": "wall-clock",
                    "file": "...", "line": 12, "message": "..."}]}
 
-(racecheck, the loop-lag monitor and the resource census are runtime
-sanitizers wired through pytest — ``TPUDASH_RACECHECK=1`` /
+(boundcheck's wire fuzzer — ``python -m tpudash.analysis.boundcheck
+--fuzz`` — plus racecheck, the loop-lag monitor and the resource census
+are runtime checks; the latter three are wired through pytest — ``TPUDASH_RACECHECK=1`` /
 ``TPUDASH_LOOPCHECK=1`` / ``TPUDASH_FDCHECK=1`` — not part of this
 static pass; see docs/DEVELOPMENT.md.)
 """
@@ -40,13 +44,14 @@ from __future__ import annotations
 import json
 import sys
 
-from tpudash.analysis import asynccheck, leakcheck, lint
+from tpudash.analysis import asynccheck, boundcheck, leakcheck, lint
 
 EXIT_CLEAN = 0
 EXIT_LINT = 1
 EXIT_ASYNC = 2
 EXIT_USAGE = 4
 EXIT_LEAK = 8
+EXIT_BOUND = 16
 
 
 def run_all(paths: "list[str]") -> dict:
@@ -59,6 +64,7 @@ def run_all(paths: "list[str]") -> dict:
     )
     async_findings = asynccheck.check_paths(paths)
     leak_findings = leakcheck.check_paths(paths)
+    bound_findings = boundcheck.check_paths(paths)
     findings = [
         {
             "analyzer": analyzer,
@@ -71,6 +77,7 @@ def run_all(paths: "list[str]") -> dict:
             ("tpulint", lint_findings),
             ("asynccheck", async_findings),
             ("leakcheck", leak_findings),
+            ("boundcheck", bound_findings),
         )
         for f in sorted(batch)
     ]
@@ -81,6 +88,7 @@ def run_all(paths: "list[str]") -> dict:
             "tpulint": len(lint_findings),
             "asynccheck": len(async_findings),
             "leakcheck": len(leak_findings),
+            "boundcheck": len(bound_findings),
         },
         "findings": findings,
     }
@@ -94,6 +102,7 @@ def main(argv: "list[str] | None" = None) -> int:
             ("tpulint", lint),
             ("asynccheck", asynccheck),
             ("leakcheck", leakcheck),
+            ("boundcheck", boundcheck),
         ):
             for rule in mod.ALL_RULES:
                 print(f"{name}: {rule}: {mod.RULE_DOCS[rule]}")
@@ -116,12 +125,16 @@ def main(argv: "list[str] | None" = None) -> int:
             )
         counts = report["counts"]
         if report["clean"]:
-            print("analysis: clean (tpulint + asynccheck + leakcheck)")
+            print(
+                "analysis: clean "
+                "(tpulint + asynccheck + leakcheck + boundcheck)"
+            )
         else:
             print(
                 f"analysis: {counts['tpulint']} tpulint / "
                 f"{counts['asynccheck']} asynccheck / "
-                f"{counts['leakcheck']} leakcheck finding(s)",
+                f"{counts['leakcheck']} leakcheck / "
+                f"{counts['boundcheck']} boundcheck finding(s)",
                 file=sys.stderr,
             )
     code = EXIT_CLEAN
@@ -131,4 +144,6 @@ def main(argv: "list[str] | None" = None) -> int:
         code |= EXIT_ASYNC
     if report["counts"]["leakcheck"]:
         code |= EXIT_LEAK
+    if report["counts"]["boundcheck"]:
+        code |= EXIT_BOUND
     return code

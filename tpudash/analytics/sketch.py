@@ -245,7 +245,10 @@ class QuantileSketch:
         malformed input (wire bytes come from other processes)."""
         if len(raw) < _HDR.size:
             raise SketchError("digest truncated")
-        ver, n, count, mn, mx = _HDR.unpack_from(raw, 0)
+        try:
+            ver, n, count, mn, mx = _HDR.unpack_from(raw, 0)
+        except struct.error as e:  # belt-and-braces: length checked above
+            raise SketchError(f"digest header unreadable: {e}") from e
         if ver != _VERSION:
             raise SketchError(f"digest version {ver} != {_VERSION}")
         if len(raw) != _HDR.size + n * _CENTROID.size:
@@ -260,7 +263,10 @@ class QuantileSketch:
         # a digest holds at most ~budget/2 centroids (tens), where one
         # struct unpack + python sweep beats four vectorized numpy
         # passes — the 90-day cold path decodes ~13k digests per query
-        vals = struct.unpack_from(f"<{2 * n}f", raw, _HDR.size)
+        try:
+            vals = struct.unpack_from(f"<{2 * n}f", raw, _HDR.size)
+        except struct.error as e:  # belt-and-braces: length checked above
+            raise SketchError(f"digest centroids unreadable: {e}") from e
         means = [0.0] * n
         weights = [0.0] * n
         prev = -math.inf

@@ -74,6 +74,7 @@ import json
 import math
 import struct
 
+from tpudash import wireids
 from tpudash.app import clientlogic
 from tpudash.app.delta import (
     SCALAR_FIELDS,
@@ -82,10 +83,10 @@ from tpudash.app.delta import (
     frame_patch,
 )
 
-MAGIC = b"TDB1"
-VERSION = 1
-KIND_DELTA = 1
-KIND_SUMMARY = 3
+MAGIC = wireids.TDB1_MAGIC
+VERSION = wireids.TDB1_VERSION
+KIND_DELTA = wireids.TDB1_KIND_DELTA
+KIND_SUMMARY = wireids.TDB1_KIND_SUMMARY
 #: columnar full-frame trio (PR 11): the figure STRUCTURE — figure
 #: dicts, interned hover-text/customdata/colorscale grids, the columnar
 #: chip table, the selection — is a TEMPLATE sent once per cohort
@@ -94,16 +95,16 @@ KIND_SUMMARY = 3
 #: self-contained envelope (template + cfull concatenated) that
 #: ``/api/frame`` serves.  The old kind 2 (full frame with inline
 #: figure JSON) is retired — a kind-2 document now refuses loudly.
-KIND_TEMPLATE = 4
-KIND_CFULL = 5
-KIND_FULLC = 6
+KIND_TEMPLATE = wireids.TDB1_KIND_TEMPLATE
+KIND_CFULL = wireids.TDB1_KIND_CFULL
+KIND_FULLC = wireids.TDB1_KIND_FULLC
 #: incremental summary (PR 15): the per-chip matrix as a changed-cell
 #: bitmap + qv cells against the PARENT'S LAST-ACKED summary (named by
 #: its ETag in the head descriptor); identity/keys/cols are elided —
 #: the base document carries them, and a child falls back to the full
 #: kind-3 document unconditionally whenever identity changed or the
 #: advertised base is one it no longer holds
-KIND_SUMMARY_DELTA = 7
+KIND_SUMMARY_DELTA = wireids.TDB1_KIND_SUMMARY_DELTA
 
 #: negotiated content type for binary frames/deltas
 CONTENT_TYPE = "application/x-tpudash-bin"
@@ -113,10 +114,10 @@ STREAM_CONTENT_TYPE = "application/x-tpudash-stream"
 #: binary stream event types (the SSE analog: full / delta / keepalive,
 #: plus the figure-structure template that must precede any columnar
 #: full event whose template the client does not already hold)
-EVT_FULL = 1
-EVT_DELTA = 2
-EVT_KEEPALIVE = 3
-EVT_TEMPLATE = 4
+EVT_FULL = wireids.TE_EVT_FULL
+EVT_DELTA = wireids.TE_EVT_DELTA
+EVT_KEEPALIVE = wireids.TE_EVT_KEEPALIVE
+EVT_TEMPLATE = wireids.TE_EVT_TEMPLATE
 
 
 def bin_event(etype: int, event_id: str, body: bytes) -> bytes:
@@ -149,8 +150,11 @@ def split_bin_events(buf: bytes):
         hdr_end = pos + 4 + idlen
         if hdr_end + 4 > len(buf):
             break
-        event_id = buf[pos + 4 : hdr_end].decode("ascii")
-        (blen,) = struct.unpack_from("<I", buf, hdr_end)
+        try:
+            event_id = buf[pos + 4 : hdr_end].decode("ascii")
+        except UnicodeDecodeError as e:
+            raise WireError(f"non-ASCII stream event id: {e!r}") from e
+        blen = int.from_bytes(buf[hdr_end : hdr_end + 4], "little")
         end = hdr_end + 4 + blen
         if end > len(buf):
             break
@@ -389,7 +393,7 @@ def split_container(buf: bytes) -> "tuple[int, dict, bytes]":
     if buf[4] != VERSION:
         raise WireError(f"unsupported TDB1 version {buf[4]}")
     kind = buf[5]
-    (head_len,) = struct.unpack_from("<I", buf, 8)
+    head_len = int.from_bytes(buf[8:12], "little")
     head_end = 12 + head_len
     if head_end + 4 > len(buf):
         raise WireError("truncated TDB1 head")
@@ -397,7 +401,9 @@ def split_container(buf: bytes) -> "tuple[int, dict, bytes]":
         head = json.loads(buf[12:head_end])
     except ValueError as e:
         raise WireError(f"bad TDB1 head: {e}") from e
-    (pay_len,) = struct.unpack_from("<I", buf, head_end)
+    if not isinstance(head, dict):
+        raise WireError("TDB1 head is not an object")
+    pay_len = int.from_bytes(buf[head_end : head_end + 4], "little")
     payload = buf[head_end + 4 : head_end + 4 + pay_len]
     if len(payload) != pay_len:
         raise WireError("truncated TDB1 payload")
@@ -432,7 +438,15 @@ def decode_delta(buf: bytes, prev: "dict | None") -> dict:
     kind, head, payload = split_container(buf)
     if kind != KIND_DELTA:
         raise WireError(f"expected a delta container, got kind {kind}")
-    return clientlogic.decode_bin_sections(head, payload, prev or {})
+    try:
+        return clientlogic.decode_bin_sections(head, payload, prev or {})
+    except WireError:
+        raise
+    except (KeyError, IndexError, TypeError, ValueError, struct.error) as e:
+        # the shared browser decoder assumes a coherent document; a
+        # malformed one must refuse at THIS boundary, not escape its
+        # internals' exceptions past callers catching WireError
+        raise WireError(f"malformed delta sections: {e!r}") from e
 
 
 #: the structural half of a frame — everything the TEMPLATE carries and
@@ -612,7 +626,12 @@ def decode_template(buf: bytes) -> dict:
     kind, head, payload = split_container(buf)
     if kind != KIND_TEMPLATE:
         raise WireError(f"expected a template container, got kind {kind}")
-    return clientlogic.decode_bin_template(head, payload)
+    try:
+        return clientlogic.decode_bin_template(head, payload)
+    except WireError:
+        raise
+    except (KeyError, IndexError, TypeError, ValueError, struct.error) as e:
+        raise WireError(f"malformed template sections: {e!r}") from e
 
 
 def decode_cfull(buf: bytes, template: dict) -> dict:
@@ -626,7 +645,14 @@ def decode_cfull(buf: bytes, template: dict) -> dict:
     kind, head, payload = split_container(buf)
     if kind != KIND_CFULL:
         raise WireError(f"expected a cfull container, got kind {kind}")
-    out = clientlogic.decode_bin_cfull(head, payload, copy.deepcopy(template))
+    try:
+        out = clientlogic.decode_bin_cfull(
+            head, payload, copy.deepcopy(template)
+        )
+    except WireError:
+        raise
+    except (KeyError, IndexError, TypeError, ValueError, struct.error) as e:
+        raise WireError(f"malformed cfull sections: {e!r}") from e
     if out is None:
         raise WireError("cfull references a template this consumer lacks")
     return out
@@ -658,7 +684,12 @@ def decode_frame(buf: bytes) -> dict:
     kind, head, payload = split_container(buf)
     if kind != KIND_FULLC:
         raise WireError(f"expected a full-frame envelope, got kind {kind}")
-    tlen = int(head["_b"]["t"])
+    try:
+        tlen = int(head["_b"]["t"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed full-frame envelope head: {e!r}") from e
+    if not 0 <= tlen <= len(payload):
+        raise WireError("full-frame template length out of range")
     template = decode_template(bytes(payload[:tlen]))
     return decode_cfull(bytes(payload[tlen:]), template)
 
@@ -667,6 +698,8 @@ def event_body(evt: bytes) -> bytes:
     """The body slice of ONE complete framed stream event — how a
     worker lifts the cfull/template container back out of a seal's
     pre-framed event bytes to assemble the /api/frame envelope."""
+    if len(evt) < 4:
+        raise WireError("truncated stream event")
     idlen = evt[3]
     return evt[8 + idlen :]
 
@@ -718,21 +751,29 @@ def decode_summary(buf: bytes) -> dict:
     head_b = head.pop("_b", {})
     mx = head_b.get("mx") if isinstance(head_b, dict) else None
     if mx is not None:
-        n, c = int(mx["n"]), int(mx["c"])
-        if len(payload) != n * c * 8:
+        try:
+            n, c = int(mx["n"]), int(mx["c"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f"malformed summary matrix descriptor: {e!r}") from e
+        if n < 0 or c < 0 or len(payload) != n * c * 8:
             raise WireError("summary matrix size disagrees with descriptor")
         # copy: frombuffer views are read-only, downstream batch math
         # assumes ordinary writable arrays
         head["matrix"] = (
             np.frombuffer(payload, dtype="<f8").reshape(n, c).copy()
         )
-        ident = head.get("identity") or {}
-        head["keys"] = [
-            f"{s}/{int(cid)}"
-            for s, cid in zip(
-                ident.get("slice") or [], ident.get("chip_id") or []
-            )
-        ]
+        ident = head.get("identity")
+        if not isinstance(ident, dict):
+            ident = {}
+        try:
+            head["keys"] = [
+                f"{s}/{int(cid)}"
+                for s, cid in zip(
+                    ident.get("slice") or [], ident.get("chip_id") or []
+                )
+            ]
+        except (TypeError, ValueError) as e:
+            raise WireError(f"malformed summary identity: {e!r}") from e
     elif "mx" in (head_b or {}):
         head["keys"] = []  # table-less but valid (the no-table marker)
     return head
@@ -837,14 +878,19 @@ def decode_summary_delta(buf: bytes, base_doc: dict, base_key: str) -> dict:
     if kind != KIND_SUMMARY_DELTA:
         raise WireError(f"expected a summary delta, got kind {kind}")
     head_b = head.pop("_b", None) or {}
-    sd = head_b.get("sd") or {}
+    sd = head_b.get("sd") if isinstance(head_b, dict) else None
+    if not isinstance(sd, dict):
+        sd = {}
     if sd.get("base") != base_key:
         raise WireError(
             f"summary delta anchors on base {sd.get('base')!r}, "
             f"caller holds {base_key!r}"
         )
     base = _summary_matrix(base_doc)
-    n, c = int(sd.get("n", -1)), int(sd.get("c", -1))
+    try:
+        n, c = int(sd.get("n", -1)), int(sd.get("c", -1))
+    except (TypeError, ValueError) as e:
+        raise WireError(f"malformed summary-delta descriptor: {e!r}") from e
     if base is None or base.shape != (n, c):
         raise WireError("summary delta shape disagrees with held base")
     nbytes = (n * c + 7) // 8

@@ -99,6 +99,7 @@ import struct
 import tempfile
 import time
 
+from tpudash import wireids
 from tpudash.broadcast.cohort import Seal, SealWindow
 
 log = logging.getLogger(__name__)
@@ -108,12 +109,12 @@ log = logging.getLogger(__name__)
 #: (2: seals carry the TDB1 binary encodings; 3: fd-passing preamble,
 #: ring descriptors, per-seal figure-template delivery; 4: network
 #: TCP/TLS transport — authenticated hellos, heartbeat pings, edge role)
-PROTO = 4
+PROTO = wireids.BUS_PROTO
 
 #: protocols a mirror accepts from a publisher: 4 is additive over 3
 #: (ping/error message kinds, hello ``hb`` field) so a PROTO 3 unix
 #: publisher still snapshots an upgraded worker during a rolling deploy
-PROTO_COMPAT = frozenset({3, PROTO})
+PROTO_COMPAT = wireids.BUS_PROTO_COMPAT
 
 #: reconnect backoff for NETWORK mirrors: decorrelated jitter between
 #: the base and 3× the previous sleep, capped — a fleet of edges losing
@@ -163,7 +164,7 @@ RING_MIN_BLOB = 512
 #: the one-shot connection preamble: magic, mode (1 = ring fd follows
 #: as SCM_RIGHTS ancillary data, 0 = copying bus), ring byte size
 _PREAMBLE = struct.Struct("<4sBQ")
-_PREAMBLE_MAGIC = b"TDRP"
+_PREAMBLE_MAGIC = wireids.BUS_PREAMBLE_MAGIC
 
 
 class BusProtocolError(Exception):
@@ -504,11 +505,27 @@ def encode_seal(
 def decode_seal(
     header: dict, body: bytes, ring: "SealRing | None" = None
 ) -> Seal:
-    lens = header["lens"]
-    ring_refs = header.get("ring") or {}
+    # the header crossed the wire: every field is attacker-shaped until
+    # proven otherwise, and the contract here is BusProtocolError — a
+    # malformed seal drops THIS session, never escapes KeyError/TypeError
+    # past the mirror loop's protocol handling
+    try:
+        cid = int(header["cid"])
+        seq = int(header["seq"])
+        tick = tuple(header["tick"])
+        lens = header["lens"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise BusProtocolError(f"malformed seal header: {e!r}") from e
+    if not isinstance(lens, list) or len(lens) > len(_SEAL_BLOBS):
+        raise BusProtocolError("malformed seal blob-length table")
+    ring_refs = header.get("ring")
+    if not isinstance(ring_refs, dict):
+        ring_refs = {}
     blobs: list = []
     off = 0
     for i, ln in enumerate(lens):
+        if not isinstance(ln, int) or isinstance(ln, bool):
+            raise BusProtocolError(f"non-integer blob length {ln!r}")
         if ln == -1:
             blobs.append(None)
             continue
@@ -520,7 +537,13 @@ def decode_seal(
             ref = ring_refs.get(str(i))
             if not isinstance(ref, list) or len(ref) != 3:
                 raise BusProtocolError(f"malformed ring descriptor for {i}")
-            data = ring.read(int(ref[0]), int(ref[1]), int(ref[2]))
+            try:
+                slot, seq1, size = int(ref[0]), int(ref[1]), int(ref[2])
+            except (TypeError, ValueError) as e:
+                raise BusProtocolError(
+                    f"malformed ring descriptor for {i}: {e!r}"
+                ) from e
+            data = ring.read(slot, seq1, size)
             if data is None:
                 raise BusProtocolError(
                     f"ring slot for blob {i} was overwritten (reader lapped)"
@@ -538,9 +561,9 @@ def decode_seal(
     while len(blobs) < len(_SEAL_BLOBS):
         blobs.append(None)
     return Seal(
-        int(header["cid"]),
-        int(header["seq"]),
-        tuple(header["tick"]),
+        cid,
+        seq,
+        tick,
         *blobs[:10],
         tpl_id=header.get("tpl"),
         bin_tpl_raw=blobs[10],
@@ -563,7 +586,7 @@ async def read_message(reader: asyncio.StreamReader) -> "tuple[dict, bytes]":
                 f"torn frame: EOF after {len(e.partial)} of 4 prefix bytes"
             ) from e
         raise
-    (length,) = struct.unpack("<I", prefix)
+    length = int.from_bytes(prefix, "little")
     if not 0 < length <= MAX_MESSAGE:
         raise BusProtocolError(f"message length {length} out of bounds")
     try:
@@ -577,7 +600,9 @@ async def read_message(reader: asyncio.StreamReader) -> "tuple[dict, bytes]":
         raise BusProtocolError("message missing header line")
     try:
         header = json.loads(body[:nl])
-    except json.JSONDecodeError as e:
+    # json.loads on BYTES decodes utf-8 first: garbage raises
+    # UnicodeDecodeError, not JSONDecodeError (the wire fuzzer's find)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
         raise BusProtocolError(f"bad header JSON: {e}") from e
     if not isinstance(header, dict) or "t" not in header:
         raise BusProtocolError("header is not a typed object")
@@ -992,7 +1017,12 @@ class BusPublisher:
                 if kind == "hello":
                     self._apply_peer_hello(conn, header)
                 elif kind == "active":
-                    cids = header.get("cids") or []
+                    try:
+                        cids = [int(c) for c in header.get("cids") or []]
+                    except (TypeError, ValueError) as e:
+                        raise BusProtocolError(
+                            f"malformed active set: {e!r}"
+                        ) from e
                     if self.on_active is not None:
                         self.on_active(cids)
                 # "ping" needs no handling beyond the last_recv stamp
@@ -1454,7 +1484,12 @@ class BusMirror:
                         f"publisher refused: "
                         f"{header.get('error', 'unspecified')}"
                     )
-                n = int(header.get("n", 0))
+                try:
+                    n = int(header.get("n", 0))
+                except (TypeError, ValueError) as e:
+                    raise BusProtocolError(
+                        f"malformed sequence number: {e!r}"
+                    ) from e
                 expect_n += 1
                 if n != expect_n:
                     self.counters["sequence_gaps"] += 1
@@ -1605,13 +1640,17 @@ class BusMirror:
                     f"publisher speaks proto {header.get('proto')}, "
                     f"this worker speaks {sorted(PROTO_COMPAT)}"
                 )
-            hb = float(header.get("hb") or 0)
+            try:
+                hb = float(header.get("hb") or 0)
+                window_limit = int(header.get("window", 8))
+            except (TypeError, ValueError) as e:
+                raise BusProtocolError(f"malformed hello: {e!r}") from e
             if self.heartbeat <= 0 and hb > 0:
                 # adopt the publisher's advertised cadence: the edge
                 # needs no local knob to get blackhole detection
                 self._hb = hb
             # a (re)connected publisher defines the universe afresh
-            self.window_limit = int(header.get("window", 8))
+            self.window_limit = window_limit
             self.windows.clear()
             self.bindings.clear()
             self.templates.clear()
@@ -1652,15 +1691,28 @@ class BusMirror:
                 win.append(seal)
                 self.counters["seals_applied"] += 1
         elif kind == "binding":
-            self.bindings[str(header["sid"])] = int(header["cid"])
+            try:
+                self.bindings[str(header["sid"])] = int(header["cid"])
+            except (KeyError, TypeError, ValueError) as e:
+                raise BusProtocolError(f"malformed binding: {e!r}") from e
         elif kind == "bindings":
-            self.bindings.update(
-                {str(k): int(v) for k, v in (header.get("map") or {}).items()}
-            )
+            mapping = header.get("map") or {}
+            if not isinstance(mapping, dict):
+                raise BusProtocolError("bindings map is not an object")
+            try:
+                self.bindings.update(
+                    {str(k): int(v) for k, v in mapping.items()}
+                )
+            except (TypeError, ValueError) as e:
+                raise BusProtocolError(f"malformed bindings: {e!r}") from e
         elif kind == "evict":
-            for cid in header.get("cids") or []:
-                self.windows.pop(int(cid), None)
-                self.templates.pop(int(cid), None)
+            try:
+                cids = [int(c) for c in header.get("cids") or []]
+            except (TypeError, ValueError) as e:
+                raise BusProtocolError(f"malformed evict: {e!r}") from e
+            for cid in cids:
+                self.windows.pop(cid, None)
+                self.templates.pop(cid, None)
         self._notify()
 
     async def send_active(self) -> None:

@@ -4069,7 +4069,7 @@ def _coldstorm_dashboard_prep(work_dir: str) -> dict:
 
     import tpudash.tsdb.store as storemod
     from tpudash.tsdb import TSDB
-    from tpudash.tsdb.cold import ColdTier, parse_bundle
+    from tpudash.tsdb.cold import BundleError, ColdTier, parse_bundle
     from tpudash.tsdb.compact import Compactor
     from tpudash.tsdb.objstore import FilesystemStore
 
@@ -4121,8 +4121,11 @@ def _coldstorm_dashboard_prep(work_dir: str) -> dict:
     flipped, clean = [], []
     for name in sorted(os.listdir(bundles_dir)):
         path = os.path.join(bundles_dir, name)
-        with open(path, "rb") as fh:
-            man = parse_bundle(fh.read())
+        try:
+            with open(path, "rb") as fh:
+                man = parse_bundle(fh.read())
+        except BundleError as e:
+            return {"error": f"prep found unreadable bundle {name}: {e}"}
         if man["t0"] >= mid_ms:
             clean.append(name)
             continue

@@ -663,11 +663,11 @@ class FederatedSource(MetricsSource):
         try:
             batch = summary_to_batch(st.spec.name, res.doc)
         # the doc is UNTRUSTED wire input from another (possibly
-        # version-skewed, possibly buggy) process: ANY parse failure —
-        # ValueError from the explicit checks, KeyError/TypeError from a
-        # half-shaped doc — refuses this child, never the fleet frame
-        # tpulint: allow[broad-except] untrusted child doc; refuse per child
-        except Exception as e:  # noqa: BLE001
+        # version-skewed, possibly buggy) process.  summary_to_batch's
+        # contract is ValueError — boundcheck enforces that nothing
+        # else can escape it — so a narrow catch refuses this child
+        # without also swallowing real parent-side bugs
+        except ValueError as e:
             with self._lock:
                 st.last_ok = False
             return f"malformed summary: {type(e).__name__}: {e}"

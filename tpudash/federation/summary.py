@@ -169,32 +169,57 @@ def summary_to_batch(name: str, doc: dict) -> "SampleBatch | None":
     if "keys" not in doc or not doc.get("cols"):
         return None  # no table yet — a valid empty child
     ident = _require(doc, "identity")
-    cols = [str(c) for c in _require(doc, "cols")]
+    if not isinstance(ident, dict):
+        raise ValueError("child summary identity is not an object")
+    cols_raw = _require(doc, "cols")
+    if not isinstance(cols_raw, (list, tuple)):
+        raise ValueError("child summary cols is not a list")
+    cols = [str(c) for c in cols_raw]
     matrix = _require(doc, "matrix")
+    for key in ("slice", "chip_id", "host"):
+        if not isinstance(ident.get(key), (list, tuple)):
+            raise ValueError(f"child summary identity.{key} is not a list")
+    if not isinstance(matrix, (np.ndarray, list, tuple)):
+        raise ValueError("child summary matrix is not a table")
     slices = [f"{name}/{s}" for s in ident["slice"]]
     n = len(slices)
     if not (
         len(ident["chip_id"]) == len(ident["host"]) == len(matrix) == n
     ):
         raise ValueError("child summary identity/matrix lengths disagree")
-    if isinstance(matrix, np.ndarray):
-        # binary summary path (wire.decode_summary): the matrix arrives
-        # as the float64 block itself — no per-cell conversion at all
-        mat = np.asarray(matrix, dtype=np.float64).reshape(n, len(cols))
-    else:
-        mat = np.array(
-            [
-                [np.nan if v is None else float(v) for v in row]
-                for row in matrix
-            ],
-            dtype=np.float64,
-        ).reshape(n, len(cols))
+    # cell/id conversions stay narrow: a malformed VALUE (row not a
+    # list, cell not a number, chip id not an int) refuses this one
+    # child as the documented ValueError, never escapes as TypeError
+    try:
+        if isinstance(matrix, np.ndarray):
+            # binary summary path (wire.decode_summary): the matrix
+            # arrives as the float64 block itself — no per-cell
+            # conversion at all
+            mat = np.asarray(matrix, dtype=np.float64).reshape(n, len(cols))
+        else:
+            mat = np.array(
+                [
+                    [np.nan if v is None else float(v) for v in row]
+                    for row in matrix
+                ],
+                dtype=np.float64,
+            ).reshape(n, len(cols))
+        chip_ids = np.asarray(
+            [int(c) for c in ident["chip_id"]], dtype=np.int64
+        )
+    # OverflowError: a chip id like 1e308 survives int() as a 309-digit
+    # integer and only dies converting to int64 (the wire fuzzer's find)
+    except (TypeError, ValueError, OverflowError) as e:
+        raise ValueError(f"child summary cells malformed: {e!r}") from e
+    accel = ident.get("accel")
+    if not (isinstance(accel, (list, tuple)) and len(accel) == n):
+        accel = [""] * n
     return SampleBatch(
         metrics=cols,
         slices=slices,
         hosts=[str(h) for h in ident["host"]],
-        chip_ids=np.asarray([int(c) for c in ident["chip_id"]], dtype=np.int64),
-        accels=[str(a) for a in ident.get("accel") or [""] * n],
+        chip_ids=chip_ids,
+        accels=[str(a) for a in accel],
         matrix=mat,
     )._sorted()
 

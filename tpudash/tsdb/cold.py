@@ -51,6 +51,7 @@ import threading
 import time
 import zlib
 
+from tpudash import wireids
 from tpudash.tsdb.objstore import ObjectStoreError
 from tpudash.tsdb.store import (
     _FRAME_HDR,
@@ -69,11 +70,11 @@ log = logging.getLogger(__name__)
 #: next free type (1/2/4 = segment records reused verbatim as bundle
 #: sections, 3 = snapshot.py's MANIFEST); record types stay globally
 #: unique so any tool dispatches on type alone, whichever file it reads
-_REC_BUNDLE_MANIFEST = 5
+_REC_BUNDLE_MANIFEST = wireids.TSB1_REC_BUNDLE_MANIFEST
 #: bundle footer: manifest frame offset + magic, fixed at EOF so a
 #: reader finds the manifest with two ranged reads (tail, then frame)
 _FOOTER = struct.Struct("<Q4s")
-_FOOTER_MAGIC = b"TDBF"
+_FOOTER_MAGIC = wireids.TDBF_FOOTER_MAGIC
 
 BUNDLE_PREFIX = "bundles/"
 QUARANTINE_PREFIX = "quarantine/"
@@ -149,7 +150,10 @@ def build_bundle(sections, sources, created_ms, keys, cols):
 def _parse_manifest_frame(frame: bytes) -> dict:
     if len(frame) < _FRAME_HDR.size:
         raise BundleError("manifest frame shorter than its header")
-    magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(frame, 0)
+    try:
+        magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(frame, 0)
+    except struct.error as e:  # belt-and-braces: length checked above
+        raise BundleError(f"manifest frame unreadable: {e}") from e
     payload = frame[_FRAME_HDR.size : _FRAME_HDR.size + plen]
     if (
         magic != _MAGIC
@@ -164,6 +168,32 @@ def _parse_manifest_frame(frame: bytes) -> dict:
         raise BundleError(f"manifest payload is not JSON: {e}") from e
     if not isinstance(doc, dict) or not isinstance(doc.get("sections"), list):
         raise BundleError("manifest missing its section index")
+    # shape-validate the index HERE, so every downstream consumer
+    # (_load_section seeks, _sections_for range checks, covers_segment,
+    # _bundle_size) can subscript entries without a malformed manifest
+    # escaping KeyError/TypeError past their BundleError handling
+    for sec in doc["sections"]:
+        if not isinstance(sec, dict):
+            raise BundleError("manifest section entry is not an object")
+        for field in ("off", "len", "type", "tier", "t0", "t1"):
+            v = sec.get(field)
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise BundleError(
+                    f"manifest section {field!r} is not an integer"
+                )
+        if sec["off"] < 0 or sec["len"] < 0:
+            raise BundleError("manifest section offset/length negative")
+    sources = doc.get("sources", [])
+    if not isinstance(sources, list):
+        raise BundleError("manifest sources is not a list")
+    for src in sources:
+        if not isinstance(src, dict):
+            raise BundleError("manifest source entry is not an object")
+        if not isinstance(src.get("bytes", 0), int):
+            raise BundleError("manifest source bytes is not an integer")
+    for field in ("keys", "cols"):
+        if not isinstance(doc.get(field, []), list):
+            raise BundleError(f"manifest {field} is not a list")
     return doc
 
 
@@ -174,7 +204,10 @@ def parse_bundle(data: bytes, verify_digest: bool = True) -> dict:
     first mismatch — a bundle is trusted whole or not at all."""
     if len(data) < _FOOTER.size + _FRAME_HDR.size:
         raise BundleError("bundle shorter than footer + manifest frame")
-    moff, magic = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
+    try:
+        moff, magic = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
+    except struct.error as e:  # belt-and-braces: length checked above
+        raise BundleError(f"bundle footer unreadable: {e}") from e
     if magic != _FOOTER_MAGIC or moff > len(data) - _FOOTER.size:
         raise BundleError("bundle footer failed magic/offset validation")
     doc = _parse_manifest_frame(data[moff : len(data) - _FOOTER.size])

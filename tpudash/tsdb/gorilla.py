@@ -68,15 +68,18 @@ class _BitWriter:
 
 
 class _BitReader:
-    __slots__ = ("data", "pos")
+    __slots__ = ("data", "pos", "limit")
 
     def __init__(self, data: bytes) -> None:
         self.data = data
         self.pos = 0  # bit offset
+        self.limit = len(data) * 8
 
     def read(self, bits: int) -> int:
         out = 0
         pos = self.pos
+        if pos + bits > self.limit:
+            raise ValueError("gorilla stream truncated")
         data = self.data
         for _ in range(bits):
             byte = data[pos >> 3]
@@ -87,6 +90,8 @@ class _BitReader:
 
     def read_bit(self) -> int:
         pos = self.pos
+        if pos >= self.limit:
+            raise ValueError("gorilla stream truncated")
         bit = (self.data[pos >> 3] >> (7 - (pos & 7))) & 1
         self.pos = pos + 1
         return bit
@@ -155,8 +160,18 @@ def encode_timestamps_py(ts_ms) -> bytes:
 
 
 def decode_timestamps(data: bytes, count: int) -> "list[int]":
+    """Decode ``count`` delta-of-delta timestamps.  ``data`` and
+    ``count`` come from untrusted chunk headers: a stream too short for
+    its advertised count (truncation, or a count a tiny payload could
+    never encode) raises :class:`ValueError` — never IndexError, and
+    never count-proportional work the bytes don't back."""
     if count <= 0:
         return []
+    # cheapest possible encoding is 64 bits for the first point plus
+    # one bit per further point — an advertised count above that is a
+    # length-field inflation, refused before any decode work
+    if 64 + (count - 1) > len(data) * 8:
+        raise ValueError("gorilla count exceeds stream capacity")
     r = _BitReader(data)
     first = _signed(r.read(64), 64)
     out = [first]
@@ -224,8 +239,13 @@ def encode_values_py(values) -> bytes:
 
 
 def decode_values(data: bytes, count: int) -> "list[float]":
+    """Decode ``count`` XOR-encoded float64 values; same untrusted-input
+    contract as :func:`decode_timestamps` (ValueError on truncated or
+    count-inflated streams)."""
     if count <= 0:
         return []
+    if 64 + (count - 1) > len(data) * 8:
+        raise ValueError("gorilla count exceeds stream capacity")
     r = _BitReader(data)
     pack = struct.pack
     unpack = struct.unpack

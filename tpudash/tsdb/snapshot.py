@@ -38,13 +38,14 @@ import shutil
 import time
 import zlib
 
+from tpudash import wireids
 from tpudash.tsdb.store import _FRAME_HDR, _MAGIC
 
 log = logging.getLogger(__name__)
 
 #: manifest record type inside the shared TSB1 framing (segments use
 #: 1 = block, 2 = rollup)
-_REC_MANIFEST = 3
+_REC_MANIFEST = wireids.TSB1_REC_SNAPSHOT_MANIFEST
 MANIFEST_NAME = "MANIFEST"
 #: staging dirs older than this are dead snapshot attempts → GC fodder
 _STAGING_GRACE_S = 3600.0
@@ -99,6 +100,39 @@ def write_manifest(path: str, doc: dict) -> None:
         os.fsync(f.fileno())
 
 
+def parse_manifest(data: bytes, label: str = "manifest") -> dict:
+    """Bytes-level manifest parse + validation (the decode boundary the
+    wire fuzzer drives directly); raises SnapshotError on a torn or
+    corrupt image."""
+    if len(data) < _FRAME_HDR.size:
+        raise SnapshotError(f"{label}: manifest shorter than its frame header")
+    try:
+        magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, 0)
+    except struct.error as e:  # belt-and-braces: length checked above
+        raise SnapshotError(f"{label}: manifest frame unreadable: {e}") from e
+    payload = data[_FRAME_HDR.size : _FRAME_HDR.size + plen]
+    if (
+        magic != _MAGIC
+        or rec_type != _REC_MANIFEST
+        or len(payload) != plen
+        or zlib.crc32(payload) != crc
+    ):
+        raise SnapshotError(
+            f"{label}: manifest frame failed magic/CRC validation "
+            "(torn or corrupt — refusing the whole snapshot)"
+        )
+    try:
+        doc = json.loads(payload)
+    except ValueError as e:
+        raise SnapshotError(f"{label}: manifest payload is not JSON") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("files"), list):
+        raise SnapshotError(f"{label}: manifest missing its file list")
+    for entry in doc["files"]:
+        if not isinstance(entry, dict):
+            raise SnapshotError(f"{label}: manifest file entry is not an object")
+    return doc
+
+
 def read_manifest(snap_dir: str) -> dict:
     """Parse + validate a snapshot's manifest; raises SnapshotError on a
     missing/torn/corrupt one (a dir without a valid manifest is not a
@@ -109,27 +143,7 @@ def read_manifest(snap_dir: str) -> dict:
             data = f.read()
     except OSError as e:
         raise SnapshotError(f"{snap_dir}: no readable manifest ({e})") from e
-    if len(data) < _FRAME_HDR.size:
-        raise SnapshotError(f"{path}: manifest shorter than its frame header")
-    magic, rec_type, plen, crc = _FRAME_HDR.unpack_from(data, 0)
-    payload = data[_FRAME_HDR.size : _FRAME_HDR.size + plen]
-    if (
-        magic != _MAGIC
-        or rec_type != _REC_MANIFEST
-        or len(payload) != plen
-        or zlib.crc32(payload) != crc
-    ):
-        raise SnapshotError(
-            f"{path}: manifest frame failed magic/CRC validation "
-            "(torn or corrupt — refusing the whole snapshot)"
-        )
-    try:
-        doc = json.loads(payload)
-    except ValueError as e:
-        raise SnapshotError(f"{path}: manifest payload is not JSON") from e
-    if not isinstance(doc, dict) or not isinstance(doc.get("files"), list):
-        raise SnapshotError(f"{path}: manifest missing its file list")
-    return doc
+    return parse_manifest(data, label=path)
 
 
 def take_snapshot(store, root: str, cut_head: bool = True) -> dict:

@@ -51,6 +51,7 @@ import zlib
 
 import numpy as np
 
+from tpudash import wireids
 from tpudash.tsdb import gorilla
 from tpudash.tsdb.rollup import (
     ALL_KEY,
@@ -70,9 +71,9 @@ log = logging.getLogger(__name__)
 #: real keys never start with "__")
 FLEET_SERIES = "__fleet__"
 
-_MAGIC = b"TSB1"
-_REC_BLOCK = 1
-_REC_ROLLUP = 2
+_MAGIC = wireids.TSB1_MAGIC
+_REC_BLOCK = wireids.TSB1_REC_BLOCK
+_REC_ROLLUP = wireids.TSB1_REC_ROLLUP
 #: PR-13 record type: quantile-sketch shadows beside the rollup quads.
 #: Pre-13 readers walk past unknown record types (their loader only
 #: dispatches on 1/2 and advances by the framed length), so a segment
@@ -82,7 +83,7 @@ _REC_ROLLUP = 2
 #: 4, not 3: snapshot.py already spent 3 on its MANIFEST record inside
 #: the shared TSB1 framing — record types stay globally unique so any
 #: tool can dispatch on type alone, whichever file it is reading.
-_REC_SKETCH = 4
+_REC_SKETCH = wireids.TSB1_REC_SKETCH
 _FRAME_HDR = struct.Struct("<4sBII")  # magic, type, payload len, crc32
 
 #: segment rotation threshold — whole files are the retention unit, so
@@ -172,20 +173,37 @@ def _block_payload(b: SealedBlock) -> bytes:
     )
 
 
-def _parse_block(payload: bytes) -> SealedBlock:
+def _record_header(payload: bytes) -> "tuple[dict, int]":
+    """(header dict, body offset) of one segment-record payload.  The
+    payload is untrusted (disk bit-rot, follower replication): a header
+    that is not a JSON object refuses as ValueError here so the typed
+    parsers below can subscript it."""
     (hlen,) = struct.unpack_from("<I", payload, 0)
     header = json.loads(payload[4 : 4 + hlen])
-    off = 4 + hlen
-    ts_enc = payload[off : off + header["tl"]]
-    off += header["tl"]
+    if not isinstance(header, dict):
+        raise ValueError("segment record header is not an object")
+    return header, 4 + hlen
+
+
+def _parse_block(payload: bytes) -> SealedBlock:
+    header, off = _record_header(payload)
+    try:
+        tl = int(header["tl"])
+        vls = [int(v) for v in header["vl"]]
+        t0, t1 = int(header["t0"]), int(header["t1"])
+        count = int(header["n"])
+        keys, cols = list(header["k"]), list(header["c"])
+    except (TypeError, ValueError) as e:
+        # contract: a malformed record is ValueError/KeyError — a
+        # wrong-typed header field must not escape as TypeError
+        raise ValueError(f"malformed block header: {e!r}") from e
+    ts_enc = payload[off : off + tl]
+    off += tl
     val_enc = []
-    for vl in header["vl"]:
+    for vl in vls:
         val_enc.append(payload[off : off + vl])
         off += vl
-    return SealedBlock(
-        header["k"], header["c"], header["t0"], header["t1"], header["n"],
-        ts_enc, val_enc,
-    )
+    return SealedBlock(keys, cols, t0, t1, count, ts_enc, val_enc)
 
 
 def _rollup_payload(r: RollupBlock) -> bytes:
@@ -212,11 +230,17 @@ def _rollup_payload(r: RollupBlock) -> bytes:
 
 
 def _parse_rollup(payload: bytes) -> RollupBlock:
-    (hlen,) = struct.unpack_from("<I", payload, 0)
-    header = json.loads(payload[4 : 4 + hlen])
-    off = 4 + hlen
-    nb = header["nb"]
-    K, C = len(header["k"]), len(header["c"])
+    header, off = _record_header(payload)
+    try:
+        nb = int(header["nb"])
+        K, C = len(header["k"]), len(header["c"])
+        tier = int(header["tier"])
+        s0, s1 = int(header["s0"]), int(header["s1"])
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed rollup header: {e!r}") from e
+    if nb < 0:
+        # np.frombuffer treats a negative count as "all remaining"
+        raise ValueError("rollup bucket count negative")
     shape = (nb, K, C)
 
     def take(dtype, count):
@@ -231,8 +255,7 @@ def _parse_rollup(payload: bytes) -> RollupBlock:
     sm = take(np.float64, nb * K * C).reshape(shape)
     cnt = take(np.int32, nb * K * C).reshape(shape)
     return RollupBlock(
-        header["tier"], buckets, header["k"], header["c"], mn, mx, sm, cnt,
-        header["s0"], header["s1"],
+        tier, buckets, header["k"], header["c"], mn, mx, sm, cnt, s0, s1
     )
 
 
@@ -273,15 +296,20 @@ def _sketch_payload(s: SketchBlock) -> bytes:
 
 
 def _parse_sketch(payload: bytes) -> SketchBlock:
-    (hlen,) = struct.unpack_from("<I", payload, 0)
-    header = json.loads(payload[4 : 4 + hlen])
-    off = 4 + hlen
-    nb = int(header["nb"])
-    keys, cols = header["k"], header["c"]
-    K, C = len(keys), len(cols)
+    header, off = _record_header(payload)
+    try:
+        nb = int(header["nb"])
+        keys, cols = header["k"], header["c"]
+        K, C = len(keys), len(cols)
+        lens = [int(x) for x in header["sl"]]
+        tier = int(header["tier"])
+        s0, s1 = int(header["s0"]), int(header["s1"])
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed sketch header: {e!r}") from e
+    if nb < 0:
+        raise ValueError("sketch bucket count negative")
     buckets = np.frombuffer(payload, dtype=np.int64, count=nb, offset=off)
     off += buckets.nbytes
-    lens = header["sl"]
     if len(lens) != nb * K * C:
         raise ValueError("sketch record cell count disagrees with header")
     enc: list = []
@@ -291,7 +319,7 @@ def _parse_sketch(payload: bytes) -> SketchBlock:
         for _k in range(K):
             cells: list = []
             for _c in range(C):
-                ln = int(lens[i])
+                ln = lens[i]
                 i += 1
                 if ln <= 0:
                     cells.append(None)
@@ -300,9 +328,7 @@ def _parse_sketch(payload: bytes) -> SketchBlock:
                     off += ln
             per_bucket.append(cells)
         enc.append(per_bucket)
-    return SketchBlock(
-        header["tier"], buckets, keys, cols, enc, header["s0"], header["s1"]
-    )
+    return SketchBlock(tier, buckets, keys, cols, enc, s0, s1)
 
 
 class TSDB:

@@ -1,0 +1,139 @@
+"""Every on-wire identifier tpudash owns, in one importable table.
+
+PR 12 renumbered the sketch segment record 3→4 by hand after discovering
+snapshot.py had already spent 3 on its MANIFEST record inside the shared
+TSB1 framing — the collision survived review because each module declared
+its constants locally.  This module makes that class of bug impossible:
+
+- every wire-visible identifier (TDB1 frame kinds, TSB1 record types,
+  TE stream event types, bus protocol versions, container magics) is
+  DECLARED here and imported by the module that uses it;
+- the tables are built through :func:`_freeze`, which raises at import
+  time on a duplicate id — a collision fails every test run and CI job
+  before a single byte is written;
+- boundcheck's ``wire-id-unregistered`` rule fails the static-analysis
+  gate on any new module-level integer assignment to a wire-id-shaped
+  name (``KIND_*`` / ``_REC_*`` / ``PROTO`` / ``EVT_*``) outside this
+  module, so new identifiers cannot bypass the registry.
+
+Retired identifiers stay registered: the id is still spent (an old
+document may carry it and must refuse loudly, not be misparsed by a
+reassigned meaning).
+"""
+
+from __future__ import annotations
+
+# -- TDB1: browser/parent frame container (tpudash/app/wire.py) --------------
+TDB1_MAGIC = b"TDB1"
+TDB1_VERSION = 1
+
+TDB1_KIND_DELTA = 1
+#: retired in PR 11 (full frame with inline figure JSON); the id stays
+#: spent so an old document refuses instead of misparsing
+TDB1_KIND_FULL_RETIRED = 2
+TDB1_KIND_SUMMARY = 3
+TDB1_KIND_TEMPLATE = 4
+TDB1_KIND_CFULL = 5
+TDB1_KIND_FULLC = 6
+TDB1_KIND_SUMMARY_DELTA = 7
+
+# -- TE: binary stream event framing (tpudash/app/wire.py) -------------------
+TE_MAGIC = b"TE"
+
+TE_EVT_FULL = 1
+TE_EVT_DELTA = 2
+TE_EVT_KEEPALIVE = 3
+TE_EVT_TEMPLATE = 4
+
+# -- TSB1: tsdb segment/snapshot/bundle record framing -----------------------
+# (tpudash/tsdb/store.py, snapshot.py, cold.py, follower.py — one shared
+# frame header, record types globally unique across all three file kinds
+# so any tool dispatches on type alone, whichever file it is reading)
+TSB1_MAGIC = b"TSB1"
+
+TSB1_REC_BLOCK = 1
+TSB1_REC_ROLLUP = 2
+TSB1_REC_SNAPSHOT_MANIFEST = 3
+TSB1_REC_SKETCH = 4
+TSB1_REC_BUNDLE_MANIFEST = 5
+
+#: cold-bundle footer magic (tpudash/tsdb/cold.py)
+TDBF_FOOTER_MAGIC = b"TDBF"
+
+# -- bus: seal replication protocol (tpudash/broadcast/bus.py) ---------------
+BUS_PREAMBLE_MAGIC = b"TDRP"
+#: bump on any incompatible wire change — a version-skewed worker must
+#: fail its handshake loudly, not misparse seals quietly
+BUS_PROTO = 4
+#: protocols a mirror accepts from a publisher (4 is additive over 3)
+BUS_PROTO_COMPAT = frozenset({3, BUS_PROTO})
+
+
+def _freeze(pairs, label: str) -> "dict[int, str]":
+    """id → name table that refuses duplicates at import time."""
+    table: "dict[int, str]" = {}
+    for value, name in pairs:
+        value = int(value)
+        if value in table:
+            raise ValueError(
+                f"duplicate {label} id {value}: "
+                f"{table[value]!r} vs {name!r}"
+            )
+        table[value] = name
+    return table
+
+
+TDB1_KINDS = _freeze(
+    (
+        (TDB1_KIND_DELTA, "delta"),
+        (TDB1_KIND_FULL_RETIRED, "full (retired)"),
+        (TDB1_KIND_SUMMARY, "summary"),
+        (TDB1_KIND_TEMPLATE, "template"),
+        (TDB1_KIND_CFULL, "cfull"),
+        (TDB1_KIND_FULLC, "fullc"),
+        (TDB1_KIND_SUMMARY_DELTA, "summary-delta"),
+    ),
+    "TDB1 kind",
+)
+
+TE_EVENT_TYPES = _freeze(
+    (
+        (TE_EVT_FULL, "full"),
+        (TE_EVT_DELTA, "delta"),
+        (TE_EVT_KEEPALIVE, "keepalive"),
+        (TE_EVT_TEMPLATE, "template"),
+    ),
+    "TE event type",
+)
+
+TSB1_RECORD_TYPES = _freeze(
+    (
+        (TSB1_REC_BLOCK, "block"),
+        (TSB1_REC_ROLLUP, "rollup"),
+        (TSB1_REC_SNAPSHOT_MANIFEST, "snapshot manifest"),
+        (TSB1_REC_SKETCH, "sketch"),
+        (TSB1_REC_BUNDLE_MANIFEST, "bundle manifest"),
+    ),
+    "TSB1 record type",
+)
+
+BUS_PROTOS = _freeze(
+    (
+        (3, "fd-passing preamble, ring descriptors, template delivery"),
+        (BUS_PROTO, "network TCP/TLS transport, hellos, heartbeats"),
+    ),
+    "bus protocol",
+)
+
+#: container magics must also stay distinct — a TSB1 segment fed to the
+#: TDB1 splitter (or vice versa) refuses on magic, never misparses
+_MAGICS = _freeze(
+    (
+        (int.from_bytes(TDB1_MAGIC, "little"), "TDB1"),
+        (int.from_bytes(TSB1_MAGIC, "little"), "TSB1"),
+        (int.from_bytes(TDBF_FOOTER_MAGIC, "little"), "TDBF"),
+        (int.from_bytes(BUS_PREAMBLE_MAGIC, "little"), "TDRP"),
+        (int.from_bytes(TE_MAGIC, "little"), "TE"),
+    ),
+    "container magic",
+)
